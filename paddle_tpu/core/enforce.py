@@ -1,0 +1,118 @@
+"""Structured error checking.
+
+Analogue of the reference's ``PADDLE_ENFORCE*`` macro family
+(``paddle/fluid/platform/enforce.h``) and phi error types
+(``paddle/phi/core/errors.h``): typed error categories, rich messages with
+the failing expression, and a Python-traceback-based provenance trail in
+place of the C++ stack unwinder.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NoReturn
+
+__all__ = [
+    "EnforceNotMet",
+    "InvalidArgumentError",
+    "NotFoundError",
+    "OutOfRangeError",
+    "AlreadyExistsError",
+    "PreconditionNotMetError",
+    "UnimplementedError",
+    "UnavailableError",
+    "ExecutionTimeoutError",
+    "enforce",
+    "enforce_eq",
+    "enforce_ne",
+    "enforce_gt",
+    "enforce_ge",
+    "enforce_lt",
+    "enforce_le",
+    "enforce_not_none",
+    "raise_unimplemented",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base error for all enforce failures (``platform::EnforceNotMet``)."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    pass
+
+
+def _fail(err_cls: type, msg: str) -> NoReturn:
+    raise err_cls(msg)
+
+
+def enforce(cond: Any, msg: str = "", err_cls: type = PreconditionNotMetError) -> None:
+    if not cond:
+        _fail(err_cls, msg or "enforce failed")
+
+
+def enforce_eq(a: Any, b: Any, msg: str = "") -> None:
+    if a != b:
+        _fail(InvalidArgumentError, f"expected {a!r} == {b!r}. {msg}")
+
+
+def enforce_ne(a: Any, b: Any, msg: str = "") -> None:
+    if a == b:
+        _fail(InvalidArgumentError, f"expected {a!r} != {b!r}. {msg}")
+
+
+def enforce_gt(a: Any, b: Any, msg: str = "") -> None:
+    if not a > b:
+        _fail(InvalidArgumentError, f"expected {a!r} > {b!r}. {msg}")
+
+
+def enforce_ge(a: Any, b: Any, msg: str = "") -> None:
+    if not a >= b:
+        _fail(InvalidArgumentError, f"expected {a!r} >= {b!r}. {msg}")
+
+
+def enforce_lt(a: Any, b: Any, msg: str = "") -> None:
+    if not a < b:
+        _fail(InvalidArgumentError, f"expected {a!r} < {b!r}. {msg}")
+
+
+def enforce_le(a: Any, b: Any, msg: str = "") -> None:
+    if not a <= b:
+        _fail(InvalidArgumentError, f"expected {a!r} <= {b!r}. {msg}")
+
+
+def enforce_not_none(value: Any, msg: str = "") -> Any:
+    if value is None:
+        _fail(NotFoundError, msg or "expected non-None value")
+    return value
+
+
+def raise_unimplemented(what: str) -> NoReturn:
+    _fail(UnimplementedError, f"{what} is not implemented in paddle_tpu")

@@ -1,0 +1,116 @@
+"""Device-mesh construction.
+
+The TPU-native replacement for the reference's device-topology plumbing:
+ring registries (``NCCLCommContext``, ``platform/collective_helper.h``),
+comm-id bootstrap, and the Python-side ``CommunicateTopology``
+(``python/paddle/distributed/fleet/base/topology.py:52``) all collapse into
+one ``jax.sharding.Mesh`` with named axes. Collectives become XLA ops over
+those axis names; "ring_id" becomes an axis name.
+
+Canonical axis names (superset of the reference's 4-axis hybrid topology,
+plus the context-parallel and expert axes the reference lacks):
+
+    dp     data parallel
+    sharding  ZeRO/sharding axis (optimizer/param sharding)
+    pp     pipeline stages
+    mp     tensor/model parallel
+    cp     context/sequence parallel (ring attention / Ulysses)
+    ep     expert parallel (MoE all-to-all)
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .enforce import InvalidArgumentError, enforce, enforce_eq
+
+__all__ = [
+    "HYBRID_AXES",
+    "make_mesh",
+    "make_hybrid_mesh",
+    "current_mesh",
+    "use_mesh",
+    "named_sharding",
+    "replicated",
+    "mesh_axis_size",
+]
+
+HYBRID_AXES: Tuple[str, ...] = ("dp", "sharding", "pp", "mp", "cp", "ep")
+
+_ACTIVE_MESH: List[Mesh] = []
+
+
+def make_mesh(
+    axis_sizes: Dict[str, int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh from {axis_name: size}; sizes must multiply to #devices.
+
+    Axis order follows insertion order of ``axis_sizes`` — callers control
+    which axes are ICI-adjacent (innermost axes should carry the highest
+    bandwidth collectives, i.e. put ``mp``/``cp`` last).
+    """
+    if devices is None:
+        devices = jax.devices()
+    names = tuple(axis_sizes)
+    sizes = tuple(int(s) for s in axis_sizes.values())
+    total = int(np.prod(sizes)) if sizes else 1
+    enforce_eq(
+        total,
+        len(devices),
+        f"mesh axis sizes {dict(axis_sizes)} must multiply to device count {len(devices)}",
+    )
+    dev_array = np.asarray(devices, dtype=object).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def make_hybrid_mesh(
+    dp: int = 1,
+    sharding: int = 1,
+    pp: int = 1,
+    mp: int = 1,
+    cp: int = 1,
+    ep: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """The reference's HybridCommunicateGroup 4-axis topology, extended
+    with cp/ep. Degenerate (size-1) axes are kept in the mesh so sharding
+    rules can always name them."""
+    return make_mesh(
+        {"dp": dp, "sharding": sharding, "pp": pp, "ep": ep, "cp": cp, "mp": mp},
+        devices=devices,
+    )
+
+
+def current_mesh() -> Optional[Mesh]:
+    """Innermost active mesh, or None when not inside ``use_mesh``."""
+    return _ACTIVE_MESH[-1] if _ACTIVE_MESH else None
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Mesh):
+    _ACTIVE_MESH.append(mesh)
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _ACTIVE_MESH.pop()
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    if axis not in mesh.shape:
+        raise InvalidArgumentError(f"mesh has no axis {axis!r}; axes: {tuple(mesh.shape)}")
+    return mesh.shape[axis]

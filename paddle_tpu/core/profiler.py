@@ -1,0 +1,127 @@
+"""Host-side profiling annotations.
+
+Analogue of the reference's two-generation profiler
+(``platform/profiler.cc`` RecordEvent scopes; ``platform/profiler/``
+HostTracer + ChromeTracingLogger): a ``RecordEvent`` scope API that feeds
+both (a) ``jax.profiler`` trace annotations (→ XPlane/perfetto, the TPU
+replacement for CUPTI+chrome://tracing) and (b) a lightweight in-process
+host-event aggregator for per-scope wall-time statistics, mirroring the
+reference's CostProfiler (``distributed/common/cost_timer.h``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+__all__ = [
+    "RecordEvent",
+    "record_event",
+    "profiler_enabled",
+    "start_profiler",
+    "stop_profiler",
+    "host_event_stats",
+    "reset_host_events",
+    "CostTimer",
+]
+
+
+class _HostEvents:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._count: Dict[str, int] = {}
+        self._total: Dict[str, float] = {}
+        self._max: Dict[str, float] = {}
+
+    def add(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._count[name] = self._count.get(name, 0) + 1
+            self._total[name] = self._total.get(name, 0.0) + seconds
+            self._max[name] = max(self._max.get(name, 0.0), seconds)
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                name: {
+                    "count": self._count[name],
+                    "total_s": self._total[name],
+                    "avg_s": self._total[name] / self._count[name],
+                    "max_s": self._max[name],
+                }
+                for name in self._count
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._count.clear()
+            self._total.clear()
+            self._max.clear()
+
+
+_EVENTS = _HostEvents()
+_TRACING = threading.Event()
+_TRACE_DIR: List[Optional[str]] = [None]
+
+
+@contextlib.contextmanager
+def RecordEvent(name: str):
+    """Annotate a host scope; shows up in the jax.profiler trace and in
+    ``host_event_stats()``. Ops in the reference are auto-wrapped this way
+    inside OperatorBase::Run (operator.cc); here users and the framework's
+    train loops wrap logical phases (forward, backward, pull_sparse...)."""
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        try:
+            yield
+        finally:
+            _EVENTS.add(name, time.perf_counter() - t0)
+
+
+record_event = RecordEvent
+
+
+class CostTimer:
+    """Reference ``CostTimer`` (cost_timer.h:29): explicit start/stop timer
+    feeding the same aggregator, for non-scope-shaped measurement."""
+
+    def __init__(self, name: str) -> None:
+        self._name = name
+        self._t0 = time.perf_counter()
+
+    def stop(self) -> float:
+        dt = time.perf_counter() - self._t0
+        _EVENTS.add(self._name, dt)
+        return dt
+
+
+def start_profiler(log_dir: str = "/tmp/paddle_tpu_trace") -> None:
+    """Start a jax.profiler trace (XPlane; view with tensorboard/perfetto)."""
+    if _TRACING.is_set():
+        return
+    jax.profiler.start_trace(log_dir)
+    _TRACE_DIR[0] = log_dir
+    _TRACING.set()
+
+
+def stop_profiler() -> Optional[str]:
+    if not _TRACING.is_set():
+        return None
+    jax.profiler.stop_trace()
+    _TRACING.clear()
+    return _TRACE_DIR[0]
+
+
+def profiler_enabled() -> bool:
+    return _TRACING.is_set()
+
+
+def host_event_stats() -> Dict[str, Dict[str, float]]:
+    return _EVENTS.stats()
+
+
+def reset_host_events() -> None:
+    _EVENTS.reset()

@@ -1,0 +1,3 @@
+from .loader import DataLoader, TensorDataset
+
+__all__ = ["DataLoader", "TensorDataset"]

@@ -1,0 +1,139 @@
+"""Compiled train/eval steps.
+
+TPU replacement for the reference's executor stack (classic ``Executor``,
+``InterpreterCore``, trainer/device-worker loops — SURVEY §3.1): instead of
+interpreting a program op-by-op, the whole step (forward + backward +
+optimizer update + metric math) is traced once and compiled by XLA into a
+single device program. The ``Trainer`` below keeps dygraph ergonomics —
+construct eagerly, call ``trainer.train_step(batch)`` — while every call
+after the first runs one fused XLA executable with donated buffers (no
+host round-trips inside the step).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import nn
+from .core.enforce import PreconditionNotMetError
+from .core.flags import flag
+from .core.nan_inf import check_numerics
+from .core.profiler import RecordEvent
+from .optimizer import Optimizer
+
+__all__ = ["Trainer", "make_train_step", "make_eval_step"]
+
+
+def make_train_step(
+    model: nn.Layer,
+    optimizer: Optimizer,
+    loss_fn: Callable[..., jax.Array],
+    donate: bool = True,
+):
+    """Build a pure, jitted train step:
+
+        step(state, opt_state, rng, *batch) -> (new_state, new_opt_state, loss)
+
+    where ``state = {"params":…, "buffers":…}`` (see nn.get_state) and
+    ``batch = (*inputs, *labels)`` with ``loss_fn(outputs, *labels)``.
+    """
+
+    def step(state, opt_state, rng, inputs, labels):
+        def compute_loss(params):
+            out, new_state = nn.functional_call(
+                model,
+                {"params": params, "buffers": state["buffers"]},
+                *inputs,
+                rng=rng,
+                training=True,
+            )
+            loss = loss_fn(out, *labels)
+            return loss, new_state["buffers"]
+
+        (loss, new_buffers), grads = jax.value_and_grad(compute_loss, has_aux=True)(
+            state["params"]
+        )
+        new_params, new_opt_state = optimizer.update(grads, opt_state, state["params"])
+        return {"params": new_params, "buffers": new_buffers}, new_opt_state, loss
+
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def make_eval_step(model: nn.Layer, metric_fn: Optional[Callable[..., Any]] = None):
+    def step(state, inputs, labels):
+        out, _ = nn.functional_call(model, state, *inputs, training=False)
+        if metric_fn is None:
+            return out
+        return metric_fn(out, *labels)
+
+    return jax.jit(step)
+
+
+def _as_tuple(x) -> Tuple:
+    if isinstance(x, (tuple, list)):
+        return tuple(x)
+    return (x,)
+
+
+class Trainer:
+    """Stateful convenience wrapper over the functional step.
+
+    Mirrors the role of the reference's device-worker train loop
+    (``HogwildWorker::TrainFiles``): owns the model/optimizer state across
+    steps, feeds batches, exposes loss. Parameters live on device as
+    pytrees between steps; ``sync_model()`` writes them back into the
+    Layer for checkpointing/state_dict interop.
+    """
+
+    def __init__(
+        self,
+        model: nn.Layer,
+        optimizer: Optimizer,
+        loss_fn: Callable[..., jax.Array],
+        seed: int = 0,
+    ) -> None:
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.state = nn.get_state(model)
+        self.opt_state = optimizer.init(self.state["params"])
+        self._rng = jax.random.key(seed)
+        self._train_step = make_train_step(model, optimizer, loss_fn)
+        self._eval_step = make_eval_step(model)
+        self.global_step = 0
+
+    def train_step(self, inputs, labels) -> jax.Array:
+        """Run one compiled step; returns the loss as a device array.
+
+        The return is NOT synced to host — JAX async dispatch keeps the
+        device pipeline full while the host prepares the next batch. Call
+        ``float(loss)`` (or log every N steps) to materialize.
+        """
+        inputs, labels = _as_tuple(inputs), _as_tuple(labels)
+        self._rng, sub = jax.random.split(self._rng)
+        with RecordEvent("train_step"):
+            self.state, self.opt_state, loss = self._train_step(
+                self.state, self.opt_state, sub, inputs, labels
+            )
+        self.global_step += 1
+        if flag("check_nan_inf"):
+            check_numerics({"loss": loss}, f"step {self.global_step}")
+        return loss
+
+    def predict(self, inputs):
+        inputs = _as_tuple(inputs)
+        with RecordEvent("eval_step"):
+            return self._eval_step(self.state, inputs, ())
+
+    def sync_model(self) -> nn.Layer:
+        """Write the live pytree state back into the Layer object."""
+        nn.set_state(self.model, self.state)
+        return self.model
+
+    def state_dict(self) -> Dict[str, Any]:
+        self.sync_model()
+        return self.model.state_dict()

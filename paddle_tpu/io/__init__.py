@@ -1,0 +1,3 @@
+from .checkpoint import load, save, load_checkpoint, save_checkpoint
+
+__all__ = ["save", "load", "save_checkpoint", "load_checkpoint"]

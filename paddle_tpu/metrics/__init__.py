@@ -1,0 +1,9 @@
+"""Metrics (reference: ``framework/fleet/metrics.h`` BasicAucCalculator and
+``python/paddle/metric``). The bucketed AUC matches the reference's
+accumulate-then-globally-reduce design so it distributes over a mesh with a
+single ``psum`` (the GlooWrapper allreduce role — SURVEY §5 metrics)."""
+
+from .auc import AUC, auc_from_buckets, auc_update_buckets
+from .accuracy import Accuracy, accuracy
+
+__all__ = ["AUC", "Accuracy", "accuracy", "auc_from_buckets", "auc_update_buckets"]

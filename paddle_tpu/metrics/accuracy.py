@@ -1,0 +1,44 @@
+"""Top-k accuracy (``paddle.metric.Accuracy``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["accuracy", "Accuracy"]
+
+
+def accuracy(logits: jax.Array, labels: jax.Array, k: int = 1) -> jax.Array:
+    """In-graph top-k accuracy over a batch."""
+    labels = labels.reshape(-1)
+    if k == 1:
+        pred = jnp.argmax(logits, axis=-1)
+        return jnp.mean((pred == labels).astype(jnp.float32))
+    topk = jax.lax.top_k(logits, k)[1]
+    hit = jnp.any(topk == labels[:, None], axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))
+
+
+class Accuracy:
+    def __init__(self, topk: int = 1) -> None:
+        self.topk = topk
+        self.reset()
+
+    def reset(self) -> None:
+        self._correct = 0.0
+        self._total = 0
+
+    def update(self, logits, labels) -> None:
+        logits = np.asarray(logits)
+        labels = np.asarray(labels).reshape(-1)
+        if self.topk == 1:
+            pred = logits.argmax(-1)
+            self._correct += float((pred == labels).sum())
+        else:
+            topk = np.argsort(-logits, axis=-1)[:, : self.topk]
+            self._correct += float((topk == labels[:, None]).any(-1).sum())
+        self._total += labels.size
+
+    def accumulate(self) -> float:
+        return self._correct / max(self._total, 1)

@@ -1,0 +1,93 @@
+"""Bucketed AUC.
+
+Port of the reference's ``BasicAucCalculator``
+(``paddle/fluid/framework/fleet/metrics.h:46``): predictions are bucketed
+into ``2^N`` bins of positive/negative counts; AUC is computed from the
+cumulative bucket sums. This form is exactly distributable — workers
+accumulate local buckets in-graph, a single ``psum`` (the GlooWrapper
+allreduce in the reference) merges them, and the final table statistic is
+computed on host. Also matches ``paddle.metric.Auc``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["AUC", "auc_update_buckets", "auc_from_buckets"]
+
+
+def auc_update_buckets(
+    buckets: jax.Array,  # [2, num_buckets] float64/float32: row 0 = neg, row 1 = pos
+    preds: jax.Array,  # [N] probability of positive class
+    labels: jax.Array,  # [N] {0,1}
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """In-graph bucket accumulation (jit/psum friendly)."""
+    num_buckets = buckets.shape[1]
+    idx = jnp.clip((preds * num_buckets).astype(jnp.int32), 0, num_buckets - 1)
+    pos = labels.astype(buckets.dtype)
+    neg = 1.0 - pos
+    if mask is not None:
+        m = mask.astype(buckets.dtype)
+        pos, neg = pos * m, neg * m
+    new_neg = buckets[0].at[idx].add(neg)
+    new_pos = buckets[1].at[idx].add(pos)
+    return jnp.stack([new_neg, new_pos])
+
+
+def auc_from_buckets(buckets: np.ndarray) -> float:
+    """Trapezoidal AUC over cumulative bucket counts (metrics.cc math:
+    area += (neg_cum_delta) * (pos_cum + pos_cum_prev) / 2)."""
+    neg, pos = np.asarray(buckets[0], np.float64), np.asarray(buckets[1], np.float64)
+    tot_pos = pos.sum()
+    tot_neg = neg.sum()
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.5
+    area = 0.0
+    pos_cum = 0.0
+    # walk from highest-score bucket down (reference iterates descending)
+    for i in range(len(pos) - 1, -1, -1):
+        area += neg[i] * (pos_cum + pos_cum + pos[i]) / 2.0
+        pos_cum += pos[i]
+    return float(area / (tot_pos * tot_neg))
+
+
+class AUC:
+    """Streaming AUC metric with the reference's bucket resolution
+    (2^12 buckets ≈ table size 4096, metrics.h `_table_size`)."""
+
+    def __init__(self, num_buckets: int = 4096) -> None:
+        self.num_buckets = num_buckets
+        self.reset()
+
+    def reset(self) -> None:
+        self._buckets = np.zeros((2, self.num_buckets), np.float64)
+
+    def update(self, preds, labels, mask=None) -> None:
+        preds = np.asarray(preds).reshape(-1)
+        labels = np.asarray(labels).reshape(-1)
+        if preds.ndim and preds.shape != labels.shape and preds.size == 2 * labels.size:
+            preds = preds.reshape(labels.size, 2)[:, 1]  # two-class prob input
+        idx = np.clip((preds * self.num_buckets).astype(np.int64), 0, self.num_buckets - 1)
+        pos = labels.astype(np.float64)
+        neg = 1.0 - pos
+        if mask is not None:
+            m = np.asarray(mask, np.float64).reshape(-1)
+            pos, neg = pos * m, neg * m
+        np.add.at(self._buckets[0], idx, neg)
+        np.add.at(self._buckets[1], idx, pos)
+
+    def merge(self, other_buckets: np.ndarray) -> None:
+        """Merge buckets from other workers (the global-reduce step)."""
+        self._buckets += np.asarray(other_buckets, np.float64)
+
+    @property
+    def buckets(self) -> np.ndarray:
+        return self._buckets
+
+    def accumulate(self) -> float:
+        return auc_from_buckets(self._buckets)

@@ -1,0 +1,303 @@
+"""Functional ops (``paddle.nn.functional`` analogue).
+
+Pure jnp/lax implementations; XLA fuses elementwise chains into surrounding
+matmuls/convs, so these stay simple — no hand-written fusion. Hot sparse and
+attention paths have Pallas kernels under ``paddle_tpu.ops.pallas``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.enforce import InvalidArgumentError, enforce_eq
+from .layer import next_rng_key
+
+__all__ = [
+    "relu",
+    "gelu",
+    "sigmoid",
+    "tanh",
+    "softmax",
+    "log_softmax",
+    "dropout",
+    "linear",
+    "conv2d",
+    "max_pool2d",
+    "avg_pool2d",
+    "adaptive_avg_pool2d",
+    "batch_norm",
+    "layer_norm",
+    "embedding",
+    "one_hot",
+    "cross_entropy",
+    "softmax_with_cross_entropy",
+    "binary_cross_entropy_with_logits",
+    "mse_loss",
+    "flatten",
+]
+
+
+def relu(x: jax.Array) -> jax.Array:
+    return jnp.maximum(x, 0)
+
+
+def gelu(x: jax.Array, approximate: bool = True) -> jax.Array:
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def sigmoid(x: jax.Array) -> jax.Array:
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x: jax.Array) -> jax.Array:
+    return jnp.tanh(x)
+
+
+def softmax(x: jax.Array, axis: int = -1) -> jax.Array:
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x: jax.Array, axis: int = -1) -> jax.Array:
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def dropout(
+    x: jax.Array,
+    p: float = 0.5,
+    training: bool = True,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    if not training or p <= 0.0:
+        return x
+    if p >= 1.0:
+        return jnp.zeros_like(x)
+    key = rng if rng is not None else next_rng_key()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
+def linear(x: jax.Array, weight: jax.Array, bias: Optional[jax.Array] = None) -> jax.Array:
+    """x @ W (+ b). Weight layout [in, out] (paddle convention)."""
+    y = jnp.matmul(x, weight)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def _pair(v: Union[int, Sequence[int]]) -> Tuple[int, int]:
+    if isinstance(v, int):
+        return (v, v)
+    return (int(v[0]), int(v[1]))
+
+
+def conv2d(
+    x: jax.Array,
+    weight: jax.Array,
+    bias: Optional[jax.Array] = None,
+    stride: Union[int, Sequence[int]] = 1,
+    padding: Union[int, str, Sequence[int]] = 0,
+    dilation: Union[int, Sequence[int]] = 1,
+    groups: int = 1,
+) -> jax.Array:
+    """NCHW conv with OIHW weights (paddle layout). XLA lowers this to the
+    MXU; bf16 inputs hit the systolic array natively."""
+    strides = _pair(stride)
+    dil = _pair(dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        ph, pw = _pair(padding)
+        pad = [(ph, ph), (pw, pw)]
+    y = lax.conv_general_dilated(
+        x,
+        weight,
+        window_strides=strides,
+        padding=pad,
+        rhs_dilation=dil,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        y = y + bias.reshape(1, -1, 1, 1)
+    return y
+
+
+def max_pool2d(
+    x: jax.Array,
+    kernel_size: Union[int, Sequence[int]],
+    stride: Optional[Union[int, Sequence[int]]] = None,
+    padding: Union[int, Sequence[int]] = 0,
+) -> jax.Array:
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    ph, pw = _pair(padding)
+    return lax.reduce_window(
+        x,
+        -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+        lax.max,
+        window_dimensions=(1, 1, k[0], k[1]),
+        window_strides=(1, 1, s[0], s[1]),
+        padding=((0, 0), (0, 0), (ph, ph), (pw, pw)),
+    )
+
+
+def avg_pool2d(
+    x: jax.Array,
+    kernel_size: Union[int, Sequence[int]],
+    stride: Optional[Union[int, Sequence[int]]] = None,
+    padding: Union[int, Sequence[int]] = 0,
+) -> jax.Array:
+    k = _pair(kernel_size)
+    s = _pair(stride) if stride is not None else k
+    ph, pw = _pair(padding)
+    summed = lax.reduce_window(
+        x,
+        jnp.array(0, x.dtype),
+        lax.add,
+        window_dimensions=(1, 1, k[0], k[1]),
+        window_strides=(1, 1, s[0], s[1]),
+        padding=((0, 0), (0, 0), (ph, ph), (pw, pw)),
+    )
+    if ph == 0 and pw == 0:
+        return summed / (k[0] * k[1])
+    ones = jnp.ones(x.shape[2:], x.dtype)[None, None]
+    counts = lax.reduce_window(
+        ones,
+        jnp.array(0, x.dtype),
+        lax.add,
+        window_dimensions=(1, 1, k[0], k[1]),
+        window_strides=(1, 1, s[0], s[1]),
+        padding=((0, 0), (0, 0), (ph, ph), (pw, pw)),
+    )
+    return summed / counts
+
+
+def adaptive_avg_pool2d(x: jax.Array, output_size: Union[int, Sequence[int]]) -> jax.Array:
+    oh, ow = _pair(output_size)
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        return x.reshape(n, c, oh, h // oh, ow, w // ow).mean(axis=(3, 5))
+    raise InvalidArgumentError(
+        f"adaptive_avg_pool2d needs divisible sizes on TPU (static shapes); got {(h, w)}→{(oh, ow)}"
+    )
+
+
+def batch_norm(
+    x: jax.Array,
+    running_mean: jax.Array,
+    running_var: jax.Array,
+    weight: jax.Array,
+    bias: jax.Array,
+    training: bool,
+    momentum: float = 0.9,
+    eps: float = 1e-5,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (y, new_running_mean, new_running_var). Channel axis = 1 for
+    4-D (NCHW) input, last axis for 2-D."""
+    if x.ndim == 4:
+        axes = (0, 2, 3)
+        shape = (1, -1, 1, 1)
+    elif x.ndim == 2:
+        axes = (0,)
+        shape = (1, -1)
+    else:
+        raise InvalidArgumentError(f"batch_norm: unsupported ndim {x.ndim}")
+    if training:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        new_rm = momentum * running_mean + (1 - momentum) * mean
+        new_rv = momentum * running_var + (1 - momentum) * var
+    else:
+        mean, var = running_mean, running_var
+        new_rm, new_rv = running_mean, running_var
+    inv = lax.rsqrt(var + eps)
+    y = (x - mean.reshape(shape)) * (inv * weight).reshape(shape) + bias.reshape(shape)
+    return y.astype(x.dtype), new_rm, new_rv
+
+
+def layer_norm(
+    x: jax.Array,
+    weight: Optional[jax.Array] = None,
+    bias: Optional[jax.Array] = None,
+    eps: float = 1e-5,
+) -> jax.Array:
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    if weight is not None:
+        y = y * weight
+    if bias is not None:
+        y = y + bias
+    return y.astype(x.dtype)
+
+
+def embedding(ids: jax.Array, table: jax.Array, padding_idx: Optional[int] = None) -> jax.Array:
+    """Dense embedding lookup (``lookup_table_v2``). XLA lowers take() to an
+    efficient dynamic-gather; the sparse/PS path lives in paddle_tpu.ps."""
+    out = jnp.take(table, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids != padding_idx)[..., None]
+        out = jnp.where(mask, out, 0.0)
+    return out
+
+
+def one_hot(ids: jax.Array, num_classes: int, dtype=jnp.float32) -> jax.Array:
+    return jax.nn.one_hot(ids, num_classes, dtype=dtype)
+
+
+def cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    soft_label: bool = False,
+    reduction: str = "mean",
+    ignore_index: int = -100,
+) -> jax.Array:
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    if soft_label:
+        loss = -jnp.sum(labels * lp, axis=-1)
+    else:
+        labels = labels.reshape(logits.shape[:-1])
+        picked = jnp.take_along_axis(lp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        loss = -picked
+        mask = labels != ignore_index
+        loss = jnp.where(mask, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(mask), 1)
+            return jnp.sum(loss) / denom
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+softmax_with_cross_entropy = cross_entropy
+
+
+def binary_cross_entropy_with_logits(
+    logits: jax.Array, labels: jax.Array, reduction: str = "mean"
+) -> jax.Array:
+    labels = labels.astype(logits.dtype)
+    loss = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def mse_loss(pred: jax.Array, target: jax.Array, reduction: str = "mean") -> jax.Array:
+    loss = (pred - target.astype(pred.dtype)) ** 2
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def flatten(x: jax.Array, start_axis: int = 1) -> jax.Array:
+    return x.reshape(x.shape[:start_axis] + (-1,))

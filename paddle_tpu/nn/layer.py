@@ -1,0 +1,382 @@
+"""Layer: the module system.
+
+Replaces the reference's dygraph layer machinery (``paddle.nn.Layer`` over
+``paddle/fluid/imperative/`` Tracer/OpBase and the eager autograd in
+``paddle/fluid/eager/``) with a design fit for XLA: layers are *parameter
+containers with a pure forward*; autograd is ``jax.grad`` over a functional
+call, not a taped per-op tracer. Eager use works like dygraph
+(``layer(x)``), and the same layer drops into a jit-compiled train step via
+``functional_call(layer, state, x)`` — the whole step is one XLA program,
+which is the TPU replacement for the reference's per-op interpreter hot
+loop (SURVEY §3.1).
+
+Key ergonomics kept from the reference API:
+  - attribute-style parameter/sublayer registration (assignment registers);
+  - ``state_dict()`` / ``set_state_dict()`` with dotted names;
+  - ``train()`` / ``eval()`` mode flags;
+  - ``parameters()`` / ``named_parameters()``;
+  - ``sublayers()``, ``apply``-style traversal.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.enforce import InvalidArgumentError, NotFoundError, enforce
+
+__all__ = [
+    "Layer",
+    "LayerList",
+    "Sequential",
+    "functional_call",
+    "rng_guard",
+    "next_rng_key",
+    "global_seed",
+]
+
+# ---------------------------------------------------------------------------
+# RNG plumbing: a thread-local key stack. Eager layer construction and
+# stochastic ops (dropout) split keys from the active scope; under jit,
+# functional_call installs the traced key so randomness is functional.
+# ---------------------------------------------------------------------------
+
+
+class _RngState(threading.local):
+    def __init__(self) -> None:
+        self.key: Optional[jax.Array] = None
+        self.seed_counter: int = 0
+
+
+_RNG = _RngState()
+
+
+def global_seed(seed: int) -> None:
+    """``paddle.seed`` analogue: reset the ambient RNG stream."""
+    _RNG.key = jax.random.key(seed)
+    _RNG.seed_counter = 0
+
+
+def next_rng_key() -> jax.Array:
+    """Split one key off the ambient stream (init, dropout in eager mode).
+
+    Under jit, stochastic layers should receive an explicit key via
+    ``rng_guard``/``functional_call(rng=...)``. If called while tracing
+    *without* a guarded key, the ambient stream is left untouched (a traced
+    key must not escape into process-global state) and deterministic
+    per-call subkeys are derived instead — randomness is then fixed per
+    compilation, which is the best an unseeded traced context can do.
+    """
+    if _RNG.key is None:
+        _RNG.key = jax.random.key(0)
+    new_key, sub = jax.random.split(_RNG.key)
+    tracing_unguarded = isinstance(new_key, jax.core.Tracer) and not isinstance(
+        _RNG.key, jax.core.Tracer
+    )
+    if tracing_unguarded:
+        _RNG.seed_counter += 1
+        sub = jax.random.fold_in(sub, _RNG.seed_counter)
+    else:
+        _RNG.key = new_key
+    return sub
+
+
+@contextlib.contextmanager
+def rng_guard(key: jax.Array):
+    """Install an explicit key (traced under jit) as the ambient stream."""
+    prev = _RNG.key
+    _RNG.key = key
+    try:
+        yield
+    finally:
+        _RNG.key = prev
+
+
+# ---------------------------------------------------------------------------
+# Layer
+# ---------------------------------------------------------------------------
+
+
+class Layer:
+    """Parameter container with a pure ``forward``.
+
+    Subclasses create parameters in ``__init__`` via ``create_parameter``
+    (or plain assignment of jax arrays returned by it) and define
+    ``forward(self, *args)``. Calling the layer runs forward eagerly; for
+    compiled steps, see ``functional_call``.
+    """
+
+    def __init__(self) -> None:
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        object.__setattr__(self, "training", True)
+
+    # -- registration -----------------------------------------------------
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        params = self.__dict__.get("_parameters")
+        bufs = self.__dict__.get("_buffers")
+        subs = self.__dict__.get("_sub_layers")
+        if params is None:
+            # before Layer.__init__ ran
+            object.__setattr__(self, name, value)
+            return
+        if isinstance(value, Layer):
+            subs[name] = value
+            params.pop(name, None)
+            bufs.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif name in subs:
+            # reassigning a sublayer slot to a non-Layer deregisters it
+            # (else its parameters would linger as ghosts in state_dict)
+            subs.pop(name)
+            object.__setattr__(self, name, value)
+        elif name in params:
+            params[name] = value
+        elif name in bufs:
+            bufs[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str) -> Any:
+        # only called when normal lookup fails
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__} has no attribute {name!r}")
+
+    def create_parameter(
+        self,
+        name: str,
+        shape: Tuple[int, ...],
+        dtype: Any = jnp.float32,
+        initializer: Optional[Callable[[jax.Array, Tuple[int, ...], Any], jax.Array]] = None,
+        init_value: Optional[Any] = None,
+    ) -> jax.Array:
+        """Create + register a parameter (eager, like dygraph)."""
+        if init_value is not None:
+            value = jnp.asarray(init_value, dtype=dtype)
+        else:
+            init_fn = initializer or default_uniform_init
+            value = init_fn(next_rng_key(), shape, dtype)
+        self._parameters[name] = value
+        return value
+
+    def register_buffer(self, name: str, value: Any) -> None:
+        """Non-trainable state (BN running stats etc.)."""
+        self._buffers[name] = jnp.asarray(value)
+
+    def add_sublayer(self, name: str, layer: "Layer") -> "Layer":
+        self._sub_layers[name] = layer
+        return layer
+
+    # -- traversal --------------------------------------------------------
+
+    def named_parameters(self, prefix: str = "") -> Iterator[Tuple[str, jax.Array]]:
+        for name, p in self._parameters.items():
+            yield (prefix + name if not prefix else f"{prefix}.{name}"), p
+        for sub_name, sub in self._sub_layers.items():
+            sub_prefix = sub_name if not prefix else f"{prefix}.{sub_name}"
+            yield from sub.named_parameters(sub_prefix)
+
+    def parameters(self) -> List[jax.Array]:
+        return [p for _, p in self.named_parameters()]
+
+    def named_buffers(self, prefix: str = "") -> Iterator[Tuple[str, jax.Array]]:
+        for name, b in self._buffers.items():
+            yield (prefix + name if not prefix else f"{prefix}.{name}"), b
+        for sub_name, sub in self._sub_layers.items():
+            sub_prefix = sub_name if not prefix else f"{prefix}.{sub_name}"
+            yield from sub.named_buffers(sub_prefix)
+
+    def sublayers(self, include_self: bool = False) -> Iterator["Layer"]:
+        if include_self:
+            yield self
+        for sub in self._sub_layers.values():
+            yield from sub.sublayers(include_self=True)
+
+    def apply_to_layers(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    # -- mode -------------------------------------------------------------
+
+    def train(self) -> "Layer":
+        return self.apply_to_layers(lambda l: object.__setattr__(l, "training", True))
+
+    def eval(self) -> "Layer":
+        return self.apply_to_layers(lambda l: object.__setattr__(l, "training", False))
+
+    # -- state dict -------------------------------------------------------
+
+    def state_dict(self) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = OrderedDict()
+        for name, p in self.named_parameters():
+            out[name] = p
+        for name, b in self.named_buffers():
+            out[name] = b
+        return out
+
+    def set_state_dict(self, state: Dict[str, Any]) -> None:
+        own = {}
+        for name, _ in self.named_parameters():
+            own[name] = ("param", name)
+        for name, _ in self.named_buffers():
+            own[name] = ("buffer", name)
+        for name, value in state.items():
+            if name not in own:
+                raise NotFoundError(f"state_dict key {name!r} not found in layer")
+            self._assign_by_path(name, jnp.asarray(value))
+
+    load_dict = set_state_dict
+
+    def _locate(self, dotted: str) -> Tuple["Layer", str]:
+        parts = dotted.split(".")
+        layer: Layer = self
+        for part in parts[:-1]:
+            layer = layer._sub_layers[part]
+        return layer, parts[-1]
+
+    def _assign_by_path(self, dotted: str, value: jax.Array) -> None:
+        layer, leaf = self._locate(dotted)
+        if leaf in layer._parameters:
+            layer._parameters[leaf] = value
+        elif leaf in layer._buffers:
+            layer._buffers[leaf] = value
+        else:
+            raise NotFoundError(f"no parameter/buffer {dotted!r}")
+
+    # -- execution --------------------------------------------------------
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        raise NotImplementedError
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def __repr__(self) -> str:
+        n_params = sum(int(np.prod(p.shape)) for p in self.parameters())
+        return f"{type(self).__name__}(params={n_params})"
+
+
+class LayerList(Layer):
+    """Indexed list of sublayers (``paddle.nn.LayerList``)."""
+
+    def __init__(self, layers: Optional[List[Layer]] = None) -> None:
+        super().__init__()
+        for i, layer in enumerate(layers or []):
+            self.add_sublayer(str(i), layer)
+
+    def append(self, layer: Layer) -> "LayerList":
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def __len__(self) -> int:
+        return len(self._sub_layers)
+
+    def __iter__(self) -> Iterator[Layer]:
+        return iter(self._sub_layers.values())
+
+    def __getitem__(self, idx: int) -> Layer:
+        if idx < 0:
+            idx += len(self._sub_layers)
+        return self._sub_layers[str(idx)]
+
+
+class Sequential(Layer):
+    """``paddle.nn.Sequential``."""
+
+    def __init__(self, *layers: Layer) -> None:
+        super().__init__()
+        for i, layer in enumerate(layers):
+            self.add_sublayer(str(i), layer)
+
+    def forward(self, x: Any) -> Any:
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+    def __iter__(self) -> Iterator[Layer]:
+        return iter(self._sub_layers.values())
+
+
+# ---------------------------------------------------------------------------
+# Functional bridge: run a layer with externally supplied state. This is the
+# jit entry — params/buffers become traced pytree leaves, forward stays the
+# same code. Buffer mutations during forward are captured and returned.
+# ---------------------------------------------------------------------------
+
+
+def _split_state(state: Dict[str, Any]) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    return state.get("params", {}), state.get("buffers", {})
+
+
+def get_state(layer: Layer) -> Dict[str, Dict[str, jax.Array]]:
+    """Extract {params:{name:arr}, buffers:{name:arr}} pytree from a layer."""
+    return {
+        "params": OrderedDict(layer.named_parameters()),
+        "buffers": OrderedDict(layer.named_buffers()),
+    }
+
+
+def set_state(layer: Layer, state: Dict[str, Dict[str, Any]]) -> None:
+    for name, value in state.get("params", {}).items():
+        layer._assign_by_path(name, value)
+    for name, value in state.get("buffers", {}).items():
+        layer._assign_by_path(name, value)
+
+
+def functional_call(
+    layer: Layer,
+    state: Dict[str, Dict[str, Any]],
+    *args: Any,
+    rng: Optional[jax.Array] = None,
+    training: Optional[bool] = None,
+    **kwargs: Any,
+) -> Tuple[Any, Dict[str, Dict[str, Any]]]:
+    """Run ``layer.forward`` with ``state`` swapped in; return
+    ``(output, new_state)`` where new_state reflects buffer updates.
+
+    Safe under jit: the swap installs traced values as the layer's
+    params/buffers for the duration of the call and restores the originals
+    after tracing. Pure as long as forward only reads registered state.
+    """
+    params, buffers = _split_state(state)
+    original = get_state(layer)
+    prev_training = [(l, l.training) for l in layer.sublayers(include_self=True)]
+    try:
+        set_state(layer, {"params": params, "buffers": buffers})
+        if training is not None:
+            (layer.train() if training else layer.eval())
+        ctx = rng_guard(rng) if rng is not None else contextlib.nullcontext()
+        with ctx:
+            out = layer.forward(*args, **kwargs)
+        new_state = get_state(layer)
+        new_state["params"] = OrderedDict(params)  # forward never mutates params
+        return out, new_state
+    finally:
+        set_state(layer, original)
+        for l, t in prev_training:
+            object.__setattr__(l, "training", t)
+
+
+# ---------------------------------------------------------------------------
+# Default initializers (paddle's defaults: Xavier-uniform for weights).
+# ---------------------------------------------------------------------------
+
+
+def default_uniform_init(key: jax.Array, shape: Tuple[int, ...], dtype: Any) -> jax.Array:
+    fan_in = shape[0] if len(shape) >= 1 else 1
+    if len(shape) >= 2:
+        fan_in = int(np.prod(shape[:-1]))
+    bound = 1.0 / np.sqrt(max(fan_in, 1))
+    return jax.random.uniform(key, shape, dtype=dtype, minval=-bound, maxval=bound)

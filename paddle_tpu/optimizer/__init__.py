@@ -1,0 +1,295 @@
+"""Optimizers (``paddle.optimizer`` analogue).
+
+Pure-functional update rules over parameter pytrees — the jit-friendly
+replacement for the reference's per-op optimizer kernels
+(phi/kernels/*/sgd_kernel, adam_kernel, …). Each optimizer exposes:
+
+    opt.init(params)                       -> opt_state
+    opt.update(grads, opt_state, params)   -> (new_params, new_opt_state)
+
+Both are pure and traceable: the whole train step (fwd + bwd + update)
+compiles to one XLA program. Paddle-style conveniences (``parameters=``,
+``opt.step``) wrap the functional core for eager use.
+
+Per-feature *sparse* optimizer rules (AdaGrad with shared g2sum, show/click
+scaling — sparse_sgd_rule.cc semantics) live in ``paddle_tpu.ps.sgd_rule``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core.enforce import InvalidArgumentError
+
+__all__ = [
+    "Optimizer",
+    "SGD",
+    "Momentum",
+    "Adam",
+    "AdamW",
+    "Adagrad",
+    "ClipGradByGlobalNorm",
+    "ClipGradByNorm",
+    "ClipGradByValue",
+    "lr",
+]
+
+PyTree = Any
+
+
+def _tree_map(fn, *trees, **kwargs):
+    return jax.tree_util.tree_map(fn, *trees, **kwargs)
+
+
+def global_norm(tree: PyTree) -> jax.Array:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))
+
+
+class _GradClip:
+    def __call__(self, grads: PyTree) -> PyTree:
+        raise NotImplementedError
+
+
+class ClipGradByGlobalNorm(_GradClip):
+    """``paddle.nn.ClipGradByGlobalNorm``: scale all grads so the global
+    L2 norm is at most ``clip_norm``."""
+
+    def __init__(self, clip_norm: float) -> None:
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads: PyTree) -> PyTree:
+        norm = global_norm(grads)
+        scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-12))
+        return _tree_map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+
+
+class ClipGradByNorm(_GradClip):
+    def __init__(self, clip_norm: float) -> None:
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads: PyTree) -> PyTree:
+        def clip_one(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+            return (g * scale).astype(g.dtype)
+
+        return _tree_map(clip_one, grads)
+
+
+class ClipGradByValue(_GradClip):
+    def __init__(self, max_value: float, min_value: Optional[float] = None) -> None:
+        self.max_value = float(max_value)
+        self.min_value = float(min_value) if min_value is not None else -self.max_value
+
+    def __call__(self, grads: PyTree) -> PyTree:
+        return _tree_map(lambda g: jnp.clip(g, self.min_value, self.max_value), grads)
+
+
+class _LRSchedule:
+    """Step→lr schedule; called inside the compiled step with a traced
+    step counter so LR decay stays in-graph (the reference runs lr decay
+    server-side via GlobalStepTable — here it's just math)."""
+
+    def __call__(self, step: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+
+class _ConstantLR(_LRSchedule):
+    def __init__(self, value: float) -> None:
+        self.value = float(value)
+
+    def __call__(self, step):
+        return jnp.asarray(self.value, jnp.float32)
+
+
+class _LambdaLR(_LRSchedule):
+    def __init__(self, fn: Callable[[jax.Array], jax.Array]) -> None:
+        self.fn = fn
+
+    def __call__(self, step):
+        return jnp.asarray(self.fn(step), jnp.float32)
+
+
+class lr:
+    """Namespace of LR schedules (``paddle.optimizer.lr`` analogue)."""
+
+    @staticmethod
+    def constant(value: float) -> _LRSchedule:
+        return _ConstantLR(value)
+
+    @staticmethod
+    def exponential_decay(base_lr: float, gamma: float) -> _LRSchedule:
+        return _LambdaLR(lambda step: base_lr * jnp.power(gamma, step.astype(jnp.float32)))
+
+    @staticmethod
+    def cosine_decay(base_lr: float, t_max: int, eta_min: float = 0.0) -> _LRSchedule:
+        def fn(step):
+            t = jnp.minimum(step.astype(jnp.float32), t_max)
+            return eta_min + 0.5 * (base_lr - eta_min) * (1 + jnp.cos(jnp.pi * t / t_max))
+
+        return _LambdaLR(fn)
+
+    @staticmethod
+    def warmup_linear(base_lr: float, warmup_steps: int, total_steps: int) -> _LRSchedule:
+        def fn(step):
+            s = step.astype(jnp.float32)
+            warm = base_lr * s / jnp.maximum(warmup_steps, 1)
+            decay = base_lr * jnp.maximum(0.0, (total_steps - s) / jnp.maximum(total_steps - warmup_steps, 1))
+            return jnp.where(s < warmup_steps, warm, decay)
+
+        return _LambdaLR(fn)
+
+
+def _as_schedule(learning_rate) -> _LRSchedule:
+    if isinstance(learning_rate, _LRSchedule):
+        return learning_rate
+    return _ConstantLR(float(learning_rate))
+
+
+class Optimizer:
+    """Base: functional init/update plus an internal step counter."""
+
+    def __init__(
+        self,
+        learning_rate=0.001,
+        grad_clip: Optional[_GradClip] = None,
+        weight_decay: float = 0.0,
+    ) -> None:
+        self.schedule = _as_schedule(learning_rate)
+        self.grad_clip = grad_clip
+        self.weight_decay = float(weight_decay)
+
+    # -- functional core --------------------------------------------------
+
+    def init(self, params: PyTree) -> Dict[str, Any]:
+        return {"step": jnp.zeros((), jnp.int32), "slots": self._init_slots(params)}
+
+    def update(
+        self, grads: PyTree, opt_state: Dict[str, Any], params: PyTree
+    ) -> Tuple[PyTree, Dict[str, Any]]:
+        if self.grad_clip is not None:
+            grads = self.grad_clip(grads)
+        step = opt_state["step"]
+        lr_t = self.schedule(step)
+        new_params, new_slots = self._apply(grads, opt_state["slots"], params, lr_t, step)
+        return new_params, {"step": step + 1, "slots": new_slots}
+
+    def _init_slots(self, params: PyTree) -> PyTree:
+        raise NotImplementedError
+
+    def _apply(self, grads, slots, params, lr_t, step):
+        raise NotImplementedError
+
+    # -- decoupled/coupled weight decay helper ----------------------------
+
+    def _decay_grad(self, g, p):
+        if self.weight_decay:
+            return g + self.weight_decay * p
+        return g
+
+
+class SGD(Optimizer):
+    def _init_slots(self, params):
+        return None
+
+    def _apply(self, grads, slots, params, lr_t, step):
+        new_params = _tree_map(lambda p, g: p - lr_t * self._decay_grad(g, p), params, grads)
+        return new_params, None
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, use_nesterov=False, **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.momentum = float(momentum)
+        self.use_nesterov = use_nesterov
+
+    def _init_slots(self, params):
+        return _tree_map(jnp.zeros_like, params)
+
+    def _apply(self, grads, slots, params, lr_t, step):
+        def upd(p, g, v):
+            g = self._decay_grad(g, p)
+            v_new = self.momentum * v + g
+            if self.use_nesterov:
+                return p - lr_t * (g + self.momentum * v_new), v_new
+            return p - lr_t * v_new, v_new
+
+        pairs = _tree_map(upd, params, grads, slots)
+        new_params = _tree_map(lambda pair: pair[0], pairs, is_leaf=lambda x: isinstance(x, tuple))
+        new_slots = _tree_map(lambda pair: pair[1], pairs, is_leaf=lambda x: isinstance(x, tuple))
+        return new_params, new_slots
+
+
+class Adam(Optimizer):
+    def __init__(
+        self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, **kw
+    ) -> None:
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = float(beta1), float(beta2), float(epsilon)
+        self.decoupled = False
+
+    def _init_slots(self, params):
+        return {
+            "m": _tree_map(jnp.zeros_like, params),
+            "v": _tree_map(jnp.zeros_like, params),
+        }
+
+    def _apply(self, grads, slots, params, lr_t, step):
+        t = (step + 1).astype(jnp.float32)
+        bc1 = 1 - jnp.power(self.beta1, t)
+        bc2 = 1 - jnp.power(self.beta2, t)
+
+        def upd(p, g, m, v):
+            if self.decoupled:
+                p = p * (1 - lr_t * self.weight_decay)
+            else:
+                g = self._decay_grad(g, p)
+            m_new = self.beta1 * m + (1 - self.beta1) * g
+            v_new = self.beta2 * v + (1 - self.beta2) * jnp.square(g)
+            m_hat = m_new / bc1
+            v_hat = v_new / bc2
+            p_new = p - lr_t * m_hat / (jnp.sqrt(v_hat) + self.epsilon)
+            return p_new, m_new, v_new
+
+        triples = _tree_map(upd, params, grads, slots["m"], slots["v"])
+        is_leaf = lambda x: isinstance(x, tuple)
+        return (
+            _tree_map(lambda tr: tr[0], triples, is_leaf=is_leaf),
+            {
+                "m": _tree_map(lambda tr: tr[1], triples, is_leaf=is_leaf),
+                "v": _tree_map(lambda tr: tr[2], triples, is_leaf=is_leaf),
+            },
+        )
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, weight_decay=0.01, **kw) -> None:
+        super().__init__(learning_rate, weight_decay=weight_decay, **kw)
+        self.decoupled = True
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, initial_accumulator_value=0.0, **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.epsilon = float(epsilon)
+        self.initial_accumulator_value = float(initial_accumulator_value)
+
+    def _init_slots(self, params):
+        return _tree_map(lambda p: jnp.full_like(p, self.initial_accumulator_value), params)
+
+    def _apply(self, grads, slots, params, lr_t, step):
+        def upd(p, g, acc):
+            g = self._decay_grad(g, p)
+            acc_new = acc + jnp.square(g)
+            return p - lr_t * g / (jnp.sqrt(acc_new) + self.epsilon), acc_new
+
+        pairs = _tree_map(upd, params, grads, slots)
+        is_leaf = lambda x: isinstance(x, tuple)
+        return (
+            _tree_map(lambda pr: pr[0], pairs, is_leaf=is_leaf),
+            _tree_map(lambda pr: pr[1], pairs, is_leaf=is_leaf),
+        )

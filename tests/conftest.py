@@ -1,0 +1,28 @@
+"""Test config: force an 8-device virtual CPU platform so sharding and
+collective tests exercise real multi-device lowering without TPU hardware
+(SURVEY §4 TPU translation of the localhost-subprocess harness).
+
+The container's sitecustomize imports jax at interpreter boot with
+JAX_PLATFORMS=axon, so env vars alone are too late — use jax.config
+updates, which take effect as long as no backend has been initialized.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

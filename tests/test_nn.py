@@ -1,0 +1,98 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+
+def test_linear_shapes_and_registration():
+    pt.seed(0)
+    layer = nn.Linear(4, 3)
+    y = layer(jnp.ones((2, 4)))
+    assert y.shape == (2, 3)
+    names = dict(layer.named_parameters())
+    assert set(names) == {"weight", "bias"}
+
+
+def test_sublayer_traversal_and_state_dict():
+    pt.seed(0)
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    sd = model.state_dict()
+    assert "0.weight" in sd and "2.bias" in sd
+    # round-trip
+    sd2 = {k: np.asarray(v) + 1 for k, v in sd.items()}
+    model.set_state_dict(sd2)
+    assert np.allclose(np.asarray(model.state_dict()["0.weight"]), sd2["0.weight"])
+
+
+def test_functional_call_pure():
+    pt.seed(0)
+    model = nn.Linear(4, 2)
+    state = nn.get_state(model)
+    zeros = {"params": {k: jnp.zeros_like(v) for k, v in state["params"].items()}, "buffers": {}}
+    out, _ = nn.functional_call(model, zeros, jnp.ones((1, 4)))
+    assert np.allclose(np.asarray(out), 0.0)
+    # original params restored after functional_call
+    out2 = model(jnp.ones((1, 4)))
+    assert not np.allclose(np.asarray(out2), 0.0)
+
+
+def test_batchnorm_buffers_update_in_training():
+    pt.seed(0)
+    bn = nn.BatchNorm2D(3)
+    x = jnp.asarray(np.random.default_rng(0).normal(2.0, 1.0, (4, 3, 5, 5)).astype(np.float32))
+    bn.train()
+    y = bn(x)
+    assert y.shape == x.shape
+    assert not np.allclose(np.asarray(bn._mean), 0.0)  # running mean moved
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == x.shape
+
+
+def test_dropout_train_vs_eval():
+    pt.seed(0)
+    d = nn.Dropout(0.5)
+    x = jnp.ones((100,))
+    d.train()
+    y = d(x)
+    assert float(jnp.sum(y == 0)) > 0
+    d.eval()
+    assert np.allclose(np.asarray(d(x)), 1.0)
+
+
+def test_conv_pool_shapes():
+    pt.seed(0)
+    conv = nn.Conv2D(1, 6, 3, padding=1)
+    x = jnp.ones((2, 1, 28, 28))
+    y = conv(x)
+    assert y.shape == (2, 6, 28, 28)
+    p = nn.functional.max_pool2d(y, 2, 2)
+    assert p.shape == (2, 6, 14, 14)
+    a = nn.functional.avg_pool2d(y, 2, 2)
+    assert a.shape == (2, 6, 14, 14)
+
+
+def test_cross_entropy_matches_manual():
+    logits = jnp.asarray([[2.0, 1.0, 0.1]])
+    labels = jnp.asarray([0])
+    loss = nn.functional.cross_entropy(logits, labels)
+    manual = -jax.nn.log_softmax(logits)[0, 0]
+    assert np.allclose(float(loss), float(manual), atol=1e-6)
+
+
+def test_embedding_padding_idx():
+    pt.seed(0)
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    out = emb(jnp.asarray([[0, 1]]))
+    assert np.allclose(np.asarray(out[0, 0]), 0.0)
+    assert not np.allclose(np.asarray(out[0, 1]), 0.0)
+
+
+def test_layer_norm():
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 8)).astype(np.float32))
+    ln = nn.LayerNorm(8)
+    y = ln(x)
+    assert np.allclose(np.asarray(y.mean(-1)), 0.0, atol=1e-5)

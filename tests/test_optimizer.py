@@ -1,0 +1,53 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import optimizer as opt_mod
+
+
+def quad_loss(params):
+    return jnp.sum((params["w"] - 3.0) ** 2)
+
+
+import jax
+
+
+@pytest.mark.parametrize(
+    "opt",
+    [
+        opt_mod.SGD(learning_rate=0.1),
+        opt_mod.Momentum(learning_rate=0.05, momentum=0.9),
+        opt_mod.Adam(learning_rate=0.2),
+        opt_mod.AdamW(learning_rate=0.2, weight_decay=0.001),
+        opt_mod.Adagrad(learning_rate=0.9),
+    ],
+)
+def test_optimizers_converge_on_quadratic(opt):
+    params = {"w": jnp.zeros(4)}
+    state = opt.init(params)
+    for _ in range(150):
+        grads = jax.grad(quad_loss)(params)
+        params, state = opt.update(grads, state, params)
+    assert np.allclose(np.asarray(params["w"]), 3.0, atol=0.15)
+
+
+def test_grad_clip_global_norm():
+    clip = opt_mod.ClipGradByGlobalNorm(1.0)
+    grads = {"a": jnp.ones(4) * 10}
+    clipped = clip(grads)
+    norm = float(jnp.sqrt(jnp.sum(clipped["a"] ** 2)))
+    assert abs(norm - 1.0) < 1e-5
+
+
+def test_lr_schedule_cosine():
+    sched = opt_mod.lr.cosine_decay(1.0, t_max=100)
+    assert abs(float(sched(jnp.asarray(0))) - 1.0) < 1e-6
+    assert float(sched(jnp.asarray(100))) < 1e-6
+
+
+def test_step_counter_advances():
+    opt = opt_mod.SGD(learning_rate=0.1)
+    params = {"w": jnp.zeros(2)}
+    state = opt.init(params)
+    _, state = opt.update({"w": jnp.ones(2)}, state, params)
+    assert int(state["step"]) == 1

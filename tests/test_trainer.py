@@ -1,0 +1,106 @@
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer
+from paddle_tpu.data import DataLoader, TensorDataset
+from paddle_tpu.executor import Trainer
+from paddle_tpu.metrics import Accuracy
+from paddle_tpu.models import LeNet
+
+
+def make_blobs(n=256, dim=8, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 4, (classes, dim)).astype(np.float32)
+    labels = rng.integers(0, classes, n)
+    x = centers[labels] + rng.normal(0, 0.5, (n, dim)).astype(np.float32)
+    return x.astype(np.float32), labels.astype(np.int32)
+
+
+def test_mlp_trains_on_blobs():
+    pt.seed(0)
+    x, y = make_blobs()
+    model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+    trainer = Trainer(model, optimizer.Adam(1e-2), nn.functional.cross_entropy)
+    loader = DataLoader(TensorDataset(x, y), batch_size=64, shuffle=True, seed=0)
+    first_loss = None
+    for epoch in range(12):
+        for xb, yb in loader:
+            loss = trainer.train_step(jnp.asarray(xb), jnp.asarray(yb))
+            if first_loss is None:
+                first_loss = loss
+    assert loss < first_loss * 0.3, (first_loss, loss)
+    metric = Accuracy()
+    metric.update(trainer.predict(jnp.asarray(x)), y)
+    assert metric.accumulate() > 0.9
+
+
+def test_lenet_forward_and_one_step():
+    pt.seed(0)
+    model = LeNet(num_classes=10)
+    x = np.random.default_rng(0).normal(size=(8, 1, 28, 28)).astype(np.float32)
+    y = np.arange(8, dtype=np.int32) % 10
+    out = model(jnp.asarray(x))
+    assert out.shape == (8, 10)
+    trainer = Trainer(model, optimizer.SGD(0.01), nn.functional.cross_entropy)
+    l1 = trainer.train_step(jnp.asarray(x), jnp.asarray(y))
+    l2 = trainer.train_step(jnp.asarray(x), jnp.asarray(y))
+    assert np.isfinite(l1) and np.isfinite(l2)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    pt.seed(0)
+    from paddle_tpu.io import load_checkpoint, save_checkpoint
+
+    model = nn.Linear(4, 2)
+    trainer = Trainer(model, optimizer.Adam(1e-2), nn.functional.mse_loss)
+    x = np.ones((4, 4), np.float32)
+    y = np.zeros((4, 2), np.float32)
+    trainer.train_step(jnp.asarray(x), jnp.asarray(y))
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(path, trainer.state_dict(), step=trainer.global_step)
+    snap = load_checkpoint(path)
+    assert snap["step"] == 1
+    model2 = nn.Linear(4, 2)
+    model2.set_state_dict(snap["model"])
+    np.testing.assert_allclose(
+        np.asarray(model2.state_dict()["weight"]),
+        np.asarray(trainer.state_dict()["weight"]),
+    )
+
+
+def test_auc_metric_matches_sklearn_style():
+    from paddle_tpu.metrics import AUC
+
+    rng = np.random.default_rng(0)
+    labels = rng.integers(0, 2, 2000)
+    # informative predictions
+    preds = np.clip(labels * 0.4 + rng.uniform(0, 0.6, 2000), 0, 1)
+    m = AUC()
+    m.update(preds, labels)
+    val = m.accumulate()
+    # exact pairwise AUC for comparison
+    pos = preds[labels == 1]
+    neg = preds[labels == 0]
+    exact = (
+        (pos[:, None] > neg[None, :]).sum() + 0.5 * (pos[:, None] == neg[None, :]).sum()
+    ) / (len(pos) * len(neg))
+    assert abs(val - exact) < 0.005, (val, exact)
+
+
+def test_auc_distributed_merge():
+    from paddle_tpu.metrics import AUC
+
+    rng = np.random.default_rng(1)
+    labels = rng.integers(0, 2, 1000)
+    preds = np.clip(labels * 0.3 + rng.uniform(0, 0.7, 1000), 0, 1)
+    whole = AUC()
+    whole.update(preds, labels)
+    w1, w2 = AUC(), AUC()
+    w1.update(preds[:500], labels[:500])
+    w2.update(preds[500:], labels[500:])
+    w1.merge(w2.buckets)
+    assert abs(whole.accumulate() - w1.accumulate()) < 1e-12
