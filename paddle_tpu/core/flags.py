@@ -149,4 +149,5 @@ define_flag(
     "Informational: XLA owns device memory; kept for API parity.",
 )
 define_flag("eager_delete_tensor_gb", 0.0, "Kept for API parity (XLA GC owns memory).")
-define_flag("seed", 0, "Global default RNG seed (0 = nondeterministic per run).")
+# (the RNG seed flag is defined by paddle_tpu.nn.layer, which owns the
+# ambient RNG stream, so its on_change callback can reseed it directly)

@@ -40,7 +40,9 @@ __all__ = [
     "mesh_axis_size",
 ]
 
-HYBRID_AXES: Tuple[str, ...] = ("dp", "sharding", "pp", "mp", "cp", "ep")
+# Axis order = mesh construction order in make_hybrid_mesh (innermost last:
+# mp/cp carry the highest-bandwidth collectives, so they sit ICI-adjacent).
+HYBRID_AXES: Tuple[str, ...] = ("dp", "sharding", "pp", "ep", "cp", "mp")
 
 _ACTIVE_MESH: List[Mesh] = []
 
@@ -81,10 +83,8 @@ def make_hybrid_mesh(
     """The reference's HybridCommunicateGroup 4-axis topology, extended
     with cp/ep. Degenerate (size-1) axes are kept in the mesh so sharding
     rules can always name them."""
-    return make_mesh(
-        {"dp": dp, "sharding": sharding, "pp": pp, "ep": ep, "cp": cp, "mp": mp},
-        devices=devices,
-    )
+    sizes = {"dp": dp, "sharding": sharding, "pp": pp, "ep": ep, "cp": cp, "mp": mp}
+    return make_mesh({name: sizes[name] for name in HYBRID_AXES}, devices=devices)
 
 
 def current_mesh() -> Optional[Mesh]:

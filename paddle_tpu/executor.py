@@ -99,7 +99,11 @@ class Trainer:
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
-        self.state = nn.get_state(model)
+        # copy the initial state: the jitted step donates its input buffers,
+        # and donating the arrays still referenced by the Layer would leave
+        # the model holding deleted buffers on TPU (donation is a no-op on
+        # CPU, so only hardware runs would crash)
+        self.state = jax.tree_util.tree_map(jnp.array, nn.get_state(model))
         self.opt_state = optimizer.init(self.state["params"])
         self._rng = jax.random.key(seed)
         self._train_step = make_train_step(model, optimizer, loss_fn)

@@ -201,6 +201,9 @@ def batch_norm(
     if x.ndim == 4:
         axes = (0, 2, 3)
         shape = (1, -1, 1, 1)
+    elif x.ndim == 3:  # (N, C, L)
+        axes = (0, 2)
+        shape = (1, -1, 1)
     elif x.ndim == 2:
         axes = (0,)
         shape = (1, -1)

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import flags as _flags
 from ..core.enforce import InvalidArgumentError, NotFoundError, enforce
 
 __all__ = [
@@ -56,6 +57,12 @@ class _RngState(threading.local):
 
 _RNG = _RngState()
 
+try:  # private but stable across recent jax; fallback assumes eager
+    from jax._src.core import trace_state_clean as _trace_state_clean
+except ImportError:  # pragma: no cover
+    def _trace_state_clean() -> bool:
+        return True
+
 
 def global_seed(seed: int) -> None:
     """``paddle.seed`` analogue: reset the ambient RNG stream."""
@@ -63,28 +70,33 @@ def global_seed(seed: int) -> None:
     _RNG.seed_counter = 0
 
 
+# FLAGS_seed / set_flags({"seed": N}) reseeds the ambient stream (gflags
+# bootstrap parity); defined here so the callback can reach the RNG state.
+_flags.define_flag("seed", 0, "Global RNG seed.", on_change=global_seed)
+if _flags.flag("seed"):
+    global_seed(_flags.flag("seed"))
+
+
 def next_rng_key() -> jax.Array:
     """Split one key off the ambient stream (init, dropout in eager mode).
 
     Under jit, stochastic layers should receive an explicit key via
-    ``rng_guard``/``functional_call(rng=...)``. If called while tracing
-    *without* a guarded key, the ambient stream is left untouched (a traced
-    key must not escape into process-global state) and deterministic
-    per-call subkeys are derived instead — randomness is then fixed per
-    compilation, which is the best an unseeded traced context can do.
+    ``rng_guard``/``functional_call(rng=...)`` so the key is a traced
+    argument. If called while *tracing without a guarded key*, the ambient
+    stream is left untouched (nothing traced may escape to process-global
+    state, and the global stream must not be advanced by retracing) and a
+    deterministic per-call subkey is derived instead — randomness is then
+    fixed per compilation, the best an unseeded traced context can do.
     """
     if _RNG.key is None:
         _RNG.key = jax.random.key(0)
-    new_key, sub = jax.random.split(_RNG.key)
-    tracing_unguarded = isinstance(new_key, jax.core.Tracer) and not isinstance(
-        _RNG.key, jax.core.Tracer
-    )
-    if tracing_unguarded:
-        _RNG.seed_counter += 1
-        sub = jax.random.fold_in(sub, _RNG.seed_counter)
-    else:
-        _RNG.key = new_key
-    return sub
+    if isinstance(_RNG.key, jax.core.Tracer) or _trace_state_clean():
+        # eager, or a guarded traced stream (rng_guard restores on exit)
+        _RNG.key, sub = jax.random.split(_RNG.key)
+        return sub
+    # tracing with a concrete ambient key
+    _RNG.seed_counter += 1
+    return jax.random.fold_in(_RNG.key, _RNG.seed_counter)
 
 
 @contextlib.contextmanager

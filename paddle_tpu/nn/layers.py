@@ -46,8 +46,6 @@ class Linear(Layer):
         self.create_parameter("weight", (in_features, out_features))
         if bias_attr:
             self.create_parameter("bias", (out_features,), init_value=np.zeros(out_features, np.float32))
-        else:
-            self._has_bias = False
 
     def forward(self, x: jax.Array) -> jax.Array:
         bias = self._parameters.get("bias")

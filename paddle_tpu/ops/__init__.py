@@ -1,0 +1,3 @@
+from . import collectives
+
+__all__ = ["collectives"]

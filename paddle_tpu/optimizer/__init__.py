@@ -8,8 +8,9 @@ replacement for the reference's per-op optimizer kernels
     opt.update(grads, opt_state, params)   -> (new_params, new_opt_state)
 
 Both are pure and traceable: the whole train step (fwd + bwd + update)
-compiles to one XLA program. Paddle-style conveniences (``parameters=``,
-``opt.step``) wrap the functional core for eager use.
+compiles to one XLA program. Eager paddle-style ``opt.step()`` does not
+exist here — the Trainer/SpmdTrainer own the step loop and call
+``update`` inside the compiled program.
 
 Per-feature *sparse* optimizer rules (AdaGrad with shared g2sum, show/click
 scaling — sparse_sgd_rule.cc semantics) live in ``paddle_tpu.ps.sgd_rule``.

@@ -1,0 +1,24 @@
+"""Distributed parallelism: topology, TP layers, SPMD DP/ZeRO, pipeline,
+MoE, context parallelism (SURVEY §2.5/2.6)."""
+
+from .mp_layers import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .spmd import DataParallel, SpmdTrainer, make_sharding_rules, shard_largest_dim
+from .topology import CommunicateTopology, HybridCommunicateGroup
+
+__all__ = [
+    "ColumnParallelLinear",
+    "ParallelCrossEntropy",
+    "RowParallelLinear",
+    "VocabParallelEmbedding",
+    "DataParallel",
+    "SpmdTrainer",
+    "make_sharding_rules",
+    "shard_largest_dim",
+    "CommunicateTopology",
+    "HybridCommunicateGroup",
+]

@@ -1,0 +1,125 @@
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from paddle_tpu.core import mesh as mesh_mod
+from paddle_tpu.ops import collectives as coll
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return mesh_mod.make_mesh({"x": 8})
+
+
+def smap(mesh, in_specs, out_specs):
+    def deco(f):
+        return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+    return deco
+
+
+def test_all_reduce_sum(mesh8):
+    x = jnp.arange(8.0)
+
+    @smap(mesh8, (P("x"),), P("x"))
+    def f(x):
+        return coll.all_reduce(x, "x")
+
+    out = f(x)
+    assert np.allclose(np.asarray(out), 28.0)
+
+
+def test_all_reduce_max_min_avg(mesh8):
+    x = jnp.arange(8.0)
+
+    @smap(mesh8, (P("x"),), P("x"))
+    def f(x):
+        return jnp.stack(
+            [
+                coll.all_reduce(x, "x", coll.ReduceOp.MAX)[0],
+                coll.all_reduce(x, "x", coll.ReduceOp.MIN)[0],
+                coll.all_reduce(x, "x", coll.ReduceOp.AVG)[0],
+            ]
+        )[None]
+
+    out = np.asarray(f(x))
+    assert np.allclose(out[0], [7.0, 0.0, 3.5])
+
+
+def test_all_gather_and_split_inverse(mesh8):
+    x = jnp.arange(16.0).reshape(8, 2)
+
+    @smap(mesh8, (P("x"),), P("x"))
+    def f(x):  # x: [1, 2] per rank
+        full = coll.all_gather(x, "x", concat_axis=0)  # [8, 2]
+        back = coll.split_axis(full, "x", dim=0)  # [1, 2]
+        return back
+
+    out = f(x)
+    assert np.allclose(np.asarray(out), np.asarray(x))
+
+
+def test_reduce_scatter(mesh8):
+    x = jnp.ones((8, 8))
+
+    @smap(mesh8, (P("x"),), P("x"))
+    def f(x):  # [1, 8]
+        return coll.reduce_scatter(x.reshape(8), "x").reshape(1, 1)
+
+    out = f(x)
+    assert np.allclose(np.asarray(out).reshape(-1), 8.0)
+
+
+def test_all_to_all(mesh8):
+    # rank r holds row of 8 values = r; after a2a column exchange each rank
+    # holds one value from every rank
+    x = jnp.repeat(jnp.arange(8.0)[:, None], 8, axis=1)
+
+    @smap(mesh8, (P("x"),), P("x"))
+    def f(x):  # [1, 8] per rank -> [8, 1] per rank (row i = value from rank i)
+        return coll.all_to_all(x, "x", split_axis_=1, concat_axis=0)
+
+    out = np.asarray(f(x))  # stacked per-rank results: [64, 1]
+    assert np.allclose(out.reshape(8, 8), np.tile(np.arange(8.0), (8, 1)))
+
+
+def test_broadcast_and_reduce(mesh8):
+    x = jnp.arange(8.0)
+
+    @smap(mesh8, (P("x"),), P("x"))
+    def f(x):
+        b = coll.broadcast(x, "x", root=3)
+        r = coll.reduce(x, "x", root=2)
+        return jnp.stack([b[0], r[0]])[None]
+
+    out = np.asarray(f(x)).reshape(8, 2)
+    assert np.allclose(out[:, 0], 3.0)  # all ranks got root 3's value
+    assert out[2, 1] == 28.0 and np.allclose(np.delete(out[:, 1], 2), 0.0)
+
+
+def test_shift_ring(mesh8):
+    x = jnp.arange(8.0)
+
+    @smap(mesh8, (P("x"),), P("x"))
+    def f(x):
+        return coll.shift(x, "x", offset=1)
+
+    out = np.asarray(f(x)).reshape(-1)
+    assert np.allclose(out, np.roll(np.arange(8.0), 1))
+
+
+def test_process_group_api(mesh8):
+    pg = coll.ProcessGroup("x")
+    x = jnp.arange(8.0)
+
+    @smap(mesh8, (P("x"),), P("x"))
+    def f(x):
+        return pg.all_reduce(x) + pg.rank().astype(jnp.float32)
+
+    out = np.asarray(f(x)).reshape(-1)
+    assert np.allclose(out, 28.0 + np.arange(8))

@@ -1,0 +1,47 @@
+import numpy as np
+import pytest
+
+from paddle_tpu.core import mesh as mesh_mod
+from paddle_tpu.parallel import CommunicateTopology, HybridCommunicateGroup
+
+
+def test_rank_coord_roundtrip():
+    topo = CommunicateTopology(["dp", "pp", "mp"], [2, 2, 2])
+    assert topo.world_size() == 8
+    for r in range(8):
+        coord = topo.get_coord(r)
+        assert topo.get_rank(**coord) == r
+
+
+def test_comm_lists():
+    topo = CommunicateTopology(["dp", "mp"], [2, 4])
+    mp_groups = topo.get_comm_list("mp")
+    assert len(mp_groups) == 2 and all(len(g) == 4 for g in mp_groups)
+    dp_groups = topo.get_comm_list("dp")
+    assert len(dp_groups) == 4 and all(len(g) == 2 for g in dp_groups)
+    # groups partition the world
+    assert sorted(sum(mp_groups, [])) == list(range(8))
+
+
+def test_axis_list():
+    topo = CommunicateTopology(["dp", "mp"], [2, 4])
+    assert topo.get_axis_list("dp", 0) == [0, 1, 2, 3]
+    assert topo.get_axis_list("dp", 1) == [4, 5, 6, 7]
+
+
+def test_hybrid_group_queries():
+    topo = CommunicateTopology(["dp", "sharding", "pp", "mp"], [2, 1, 2, 2])
+    hcg = HybridCommunicateGroup(topo, global_rank=5)  # coords dp=1,sh=0,pp=0,mp=1
+    assert hcg.get_data_parallel_rank() == 1
+    assert hcg.get_model_parallel_rank() == 1
+    assert hcg.get_stage_id() == 0
+    assert hcg.is_first_stage() and not hcg.is_last_stage()
+    assert hcg.get_model_parallel_world_size() == 2
+    assert hcg.get_data_parallel_group() == [1, 5]
+
+
+def test_from_mesh():
+    m = mesh_mod.make_hybrid_mesh(dp=2, mp=4)
+    topo = CommunicateTopology.from_mesh(m)
+    assert topo.world_size() == 8
+    assert topo.get_dim("mp") == 4
