@@ -66,7 +66,8 @@ def all_reduce(x: jax.Array, axis: AxisName, op: str = ReduceOp.SUM) -> jax.Arra
     if op == ReduceOp.MIN:
         return lax.pmin(x, axis)
     if op == ReduceOp.PROD:
-        return jnp.exp(lax.psum(jnp.log(x), axis))  # no pprod primitive
+        # no pprod primitive; gather + prod handles zeros/negatives exactly
+        return jnp.prod(lax.all_gather(x, axis), axis=0)
     if op == ReduceOp.AVG:
         return lax.pmean(x, axis)
     raise InvalidArgumentError(f"unknown reduce op {op!r}")
@@ -139,6 +140,10 @@ def split_axis(x: jax.Array, axis: str, dim: int = -1) -> jax.Array:
     all_gather). Requires dim divisible by axis size."""
     n = lax.axis_size(axis)
     i = lax.axis_index(axis)
+    if x.shape[dim] % n != 0:
+        raise InvalidArgumentError(
+            f"split_axis: dim {dim} (size {x.shape[dim]}) not divisible by axis {axis!r} size {n}"
+        )
     size = x.shape[dim] // n
     return lax.dynamic_slice_in_dim(x, i * size, size, axis=dim)
 

@@ -61,10 +61,15 @@ class VocabParallelEmbedding(Layer):
         self.per_part = num_embeddings // max(mp_size, 1)
         self.mp_rank = mp_rank
         scale = 1.0 / np.sqrt(embedding_dim)
+        # fold mp_rank into the init key so each rank's vocab shard gets a
+        # distinct initialization (matching per-rank construction in the
+        # reference; without this all shards would be identical copies)
         self.create_parameter(
             "weight",
             (self.per_part, embedding_dim),
-            initializer=lambda key, shape, dtype: jax.random.normal(key, shape, dtype) * scale,
+            initializer=lambda key, shape, dtype: jax.random.normal(
+                jax.random.fold_in(key, mp_rank), shape, dtype
+            ) * scale,
         )
 
     def forward(self, ids: jax.Array) -> jax.Array:

@@ -1,0 +1,258 @@
+"""Pipeline parallelism.
+
+Replaces three reference mechanisms (SURVEY §2.6 PP row):
+- static ``pipeline_optimizer`` + ``SectionWorker`` schedulers
+  (framework/section_worker.cc:92-189, F-then-B and 1F1B),
+- the FleetExecutor interceptor runtime (compute_interceptor.cc) whose
+  credit-based message passing sequences micro-batches across ranks,
+- dygraph ``PipelineParallel`` + p2p_communication.py.
+
+TPU-native inversion: instead of an actor runtime exchanging activations
+via RPC, the schedule is *compiled*. Stages live on the ``pp`` mesh axis
+(shard_map); micro-batches advance through a ``lax.scan`` whose body runs
+the local stage and rotates activations one hop with ``ppermute`` (the
+partial_send/recv pair). Autodiff through scan+ppermute yields the reverse
+(backward) pipeline automatically — the transpose of a rotation is the
+opposite rotation — so fwd+bwd is the F-then-B schedule with XLA
+overlapping compute and ICI transfers. Bubble fraction matches the classic
+(S-1)/(M+S-1).
+
+Stages must be structurally identical (transformer-block style); per-stage
+parameters are stacked on a leading axis sharded over ``pp``. First/last
+ranks additionally apply embed/head params (replicated; their compute is
+masked out elsewhere).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import nn
+from ..core.enforce import enforce, enforce_eq
+from ..nn.layer import Layer
+
+__all__ = ["LayerDesc", "PipelineLayer", "pipeline_spmd_fn", "PipelineTrainer"]
+
+PyTree = Any
+
+
+class LayerDesc:
+    """Deferred layer construction (pp_layers.py LayerDesc): lets each pp
+    rank materialize only its own stages."""
+
+    def __init__(self, layer_cls, *args, **kwargs) -> None:
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build(self) -> Layer:
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+def _stack_states(states: Sequence[dict]) -> dict:
+    """Stack per-stage state pytrees along a new leading axis."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+
+
+class PipelineLayer(Layer):
+    """Container of S structurally identical stages plus optional
+    embed/head layers (pp_layers.py PipelineLayer analogue)."""
+
+    def __init__(
+        self,
+        stage_descs: Sequence[LayerDesc],
+        embed: Optional[Layer] = None,
+        head: Optional[Layer] = None,
+    ) -> None:
+        super().__init__()
+        self.num_stages = len(stage_descs)
+        self.stages = nn.LayerList([d.build() for d in stage_descs])
+        if embed is not None:
+            self.embed = embed
+        if head is not None:
+            self.head = head
+
+    def stage_stacked_state(self) -> dict:
+        return _stack_states([nn.get_state(s) for s in self.stages])
+
+    def aux_state(self) -> dict:
+        out = {}
+        if "embed" in self._sub_layers:
+            out["embed"] = nn.get_state(self._sub_layers["embed"])
+        if "head" in self._sub_layers:
+            out["head"] = nn.get_state(self._sub_layers["head"])
+        return out
+
+    def forward(self, x):  # serial reference path (for parity tests)
+        if "embed" in self._sub_layers:
+            x = self._sub_layers["embed"](x)
+        for s in self.stages:
+            x = s(x)
+        if "head" in self._sub_layers:
+            x = self._sub_layers["head"](x)
+        return x
+
+
+def pipeline_spmd_fn(
+    stage_apply: Callable[[PyTree, jax.Array], jax.Array],
+    num_stages: int,
+    num_micro: int,
+    pp_axis: str = "pp",
+    embed_apply: Optional[Callable[[PyTree, jax.Array], jax.Array]] = None,
+    head_apply: Optional[Callable[[PyTree, jax.Array], jax.Array]] = None,
+):
+    """Build the per-rank SPMD pipeline function.
+
+    Returns ``fn(stacked_stage_state, aux_state, x_micro) -> y_micro``
+    to be called inside shard_map with ``stacked_stage_state`` sharded on
+    the pp axis (leading dim) and ``x_micro`` of shape
+    ``[num_micro, micro_batch, ...]`` replicated. Output is the last
+    stage's head output per micro-batch, replicated via psum masking.
+    """
+
+    def fn(stacked_state, aux_state, x_micro):
+        stage = lax.axis_index(pp_axis)
+        my_state = jax.tree_util.tree_map(lambda p: p[0], stacked_state)
+        total = num_micro + num_stages - 1
+
+        if embed_apply is not None:
+            x_micro = embed_apply(aux_state.get("embed"), x_micro)
+
+        # activation shape = embed output of one micro-batch; mark it
+        # varying over pp (the replicated zeros become rank-dependent once
+        # ppermute rotates real activations through the carry)
+        act0 = lax.pcast(jnp.zeros_like(x_micro[0]), (pp_axis,), to="varying")
+
+        def tick(buf, t):
+            # stage 0 injects micro-batch t (clamped index; masked later)
+            idx = jnp.clip(t, 0, num_micro - 1)
+            x_t = lax.dynamic_index_in_dim(x_micro, idx, 0, keepdims=False)
+            inp = jnp.where(stage == 0, x_t, buf)
+            out = stage_apply(my_state, inp)
+            n = lax.axis_size(pp_axis)
+            sent = lax.ppermute(out, pp_axis, [(i, (i + 1) % n) for i in range(n)])
+            return sent, out
+
+        _, outs = lax.scan(tick, act0, jnp.arange(total))
+        # last stage's valid outputs are ticks [S-1, S-1+M)
+        y = lax.slice_in_dim(outs, num_stages - 1, num_stages - 1 + num_micro, axis=0)
+        if head_apply is not None:
+            y = head_apply(aux_state.get("head"), y)
+        # only the last stage computed real outputs; replicate via masked psum
+        is_last = (stage == num_stages - 1).astype(y.dtype)
+        y = lax.psum(y * is_last, pp_axis)
+        return y
+
+    return fn
+
+
+class PipelineTrainer:
+    """Compiled pipeline training over the pp axis of a mesh.
+
+    Mirrors the role of PipelineTrainer/SectionWorker: owns stage state,
+    runs fwd+bwd+update as one jitted SPMD program per step.
+    """
+
+    def __init__(
+        self,
+        model: PipelineLayer,
+        optimizer,
+        loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+        mesh: Mesh,
+        num_micro: int,
+        pp_axis: str = "pp",
+        seed: int = 0,
+    ) -> None:
+        enforce(pp_axis in mesh.shape, f"mesh lacks {pp_axis!r} axis")
+        enforce_eq(mesh.shape[pp_axis], model.num_stages, "stages must equal pp size")
+        self.model = model
+        self.mesh = mesh
+        self.num_micro = num_micro
+        self.optimizer = optimizer
+
+        stacked = model.stage_stacked_state()
+        aux = model.aux_state()
+        self._params = {"stages": stacked, "aux": aux}
+        self.opt_state = optimizer.init(self._params)
+
+        S = model.num_stages
+
+        def stage_apply(state, x):
+            out, _ = nn.functional_call(model.stages[0], state, x, training=True)
+            return out
+
+        def embed_apply(state, x):
+            if state is None:
+                return x
+            out, _ = nn.functional_call(model._sub_layers["embed"], state, x, training=True)
+            return out
+
+        def head_apply(state, y):
+            if state is None:
+                return y
+            out, _ = nn.functional_call(model._sub_layers["head"], state, y, training=True)
+            return out
+
+        pipe = pipeline_spmd_fn(
+            stage_apply, S, num_micro, pp_axis,
+            embed_apply if aux.get("embed") else None,
+            head_apply if aux.get("head") else None,
+        )
+
+        def spmd_loss(params, x_micro, y_micro, rng):
+            # distinct stochastic streams per pipeline stage
+            key = jax.random.fold_in(rng, lax.axis_index(pp_axis))
+            with nn.rng_guard(key):
+                preds = pipe(params["stages"], params["aux"], x_micro)
+            # mean over micro-batches of per-micro loss
+            losses = jax.vmap(loss_fn)(preds, y_micro)
+            return jnp.mean(losses)
+
+        stage_specs = jax.tree_util.tree_map(lambda _: P(pp_axis), stacked)
+        aux_specs = jax.tree_util.tree_map(lambda _: P(), aux)
+        param_specs = {"stages": stage_specs, "aux": aux_specs}
+
+        grad_fn = shard_map(
+            jax.value_and_grad(spmd_loss),
+            mesh=mesh,
+            in_specs=(param_specs, P(), P(), P()),
+            out_specs=(P(), param_specs),
+        )
+
+        def step(params, opt_state, x_micro, y_micro, rng):
+            loss, grads = grad_fn(params, x_micro, y_micro, rng)
+            new_params, new_opt = optimizer.update(grads, opt_state, params)
+            return new_params, new_opt, loss
+
+        self._step = jax.jit(step, donate_argnums=(0, 1))
+        self._rng = jax.random.key(seed)
+        self.global_step = 0
+
+    def train_step(self, x: jax.Array, y: jax.Array) -> jax.Array:
+        """x, y: [batch, ...] split into num_micro micro-batches on dim 0."""
+        B = x.shape[0]
+        enforce_eq(B % self.num_micro, 0, f"batch size {B} must be divisible by num_micro={self.num_micro}")
+        xm = x.reshape(self.num_micro, B // self.num_micro, *x.shape[1:])
+        ym = y.reshape(self.num_micro, B // self.num_micro, *y.shape[1:])
+        self._rng, sub = jax.random.split(self._rng)
+        self._params, self.opt_state, loss = self._step(
+            self._params, self.opt_state, xm, ym, sub
+        )
+        self.global_step += 1
+        return loss
+
+    def sync_model(self) -> PipelineLayer:
+        host = jax.device_get(self._params)
+        for i, stage in enumerate(self.model.stages):
+            nn.set_state(
+                stage, jax.tree_util.tree_map(lambda p: p[i], host["stages"])
+            )
+        for name in ("embed", "head"):
+            if name in host["aux"]:
+                nn.set_state(self.model._sub_layers[name], host["aux"][name])
+        return self.model

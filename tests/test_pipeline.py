@@ -1,0 +1,88 @@
+"""Pipeline parallel: compiled schedule must match the serial model
+(reference pipeline tests compare PP loss to non-PP loss)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer
+from paddle_tpu.core import mesh as mesh_mod
+from paddle_tpu.executor import Trainer
+from paddle_tpu.parallel.pipeline import LayerDesc, PipelineLayer, PipelineTrainer
+
+
+class Block(nn.Layer):
+    def __init__(self, d):
+        super().__init__()
+        self.fc = nn.Linear(d, d)
+
+    def forward(self, x):
+        return jax.nn.relu(self.fc(x)) + x
+
+
+def build(seed, d=8, stages=4):
+    pt.seed(seed)
+    return PipelineLayer(
+        [LayerDesc(Block, d) for _ in range(stages)],
+        embed=nn.Linear(4, d),
+        head=nn.Linear(d, 3),
+    )
+
+
+def test_pipeline_forward_matches_serial():
+    model = build(0)
+    mesh = mesh_mod.make_mesh({"dp": 2, "pp": 4})
+    x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    y = np.zeros((8,), np.int32)
+
+    serial_out = model(jnp.asarray(x))
+
+    pl = PipelineTrainer(
+        model, optimizer.SGD(0.0), nn.functional.cross_entropy, mesh, num_micro=4
+    )
+    # one zero-lr step just to exercise; then compare loss vs serial loss
+    loss = float(pl.train_step(jnp.asarray(x), jnp.asarray(y)))
+    serial_loss = float(nn.functional.cross_entropy(serial_out, jnp.asarray(y)))
+    np.testing.assert_allclose(loss, serial_loss, rtol=1e-4)
+
+
+def test_pipeline_training_matches_serial():
+    x = np.random.default_rng(1).normal(size=(8, 4)).astype(np.float32)
+    y = (np.random.default_rng(2).integers(0, 3, 8)).astype(np.int32)
+    mesh = mesh_mod.make_mesh({"dp": 1, "pp": 4, "mp": 2})
+
+    pl = PipelineTrainer(
+        build(0), optimizer.SGD(0.2), nn.functional.cross_entropy, mesh, num_micro=4
+    )
+    serial_model = build(0)
+    serial = Trainer(serial_model, optimizer.SGD(0.2), _micro_mean_loss)
+
+    for i in range(6):
+        lp = float(pl.train_step(jnp.asarray(x), jnp.asarray(y)))
+        ls = float(serial.train_step(jnp.asarray(x), jnp.asarray(y)))
+        np.testing.assert_allclose(lp, ls, rtol=1e-3, atol=1e-5)
+
+
+def _micro_mean_loss(out, y):
+    # serial equivalent of mean-over-microbatches of per-micro CE (4 micro)
+    losses = [
+        nn.functional.cross_entropy(out[i * 2 : (i + 1) * 2], y[i * 2 : (i + 1) * 2])
+        for i in range(4)
+    ]
+    return jnp.mean(jnp.stack(losses))
+
+
+def test_pipeline_sync_model_roundtrip():
+    model = build(3)
+    mesh = mesh_mod.make_mesh({"pp": 4, "mp": 2})
+    x = np.random.default_rng(3).normal(size=(4, 4)).astype(np.float32)
+    y = np.zeros((4,), np.int32)
+    pl = PipelineTrainer(
+        model, optimizer.SGD(0.1), nn.functional.cross_entropy, mesh, num_micro=2
+    )
+    pl.train_step(jnp.asarray(x), jnp.asarray(y))
+    pl.sync_model()  # params written back without error
+    out = model(jnp.asarray(x))
+    assert out.shape == (4, 3)
