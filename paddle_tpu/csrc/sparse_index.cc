@@ -1,0 +1,175 @@
+// Feasign index: batched open-addressing hash map uint64 key -> int32 row.
+//
+// Native core of the host-side sparse tables — the TPU-build counterpart
+// of the reference's SparseTableShard hash maps
+// (paddle/fluid/distributed/ps/table/depends/feature_value.h:30) and the
+// GPUPS dedup/build path (ps_gpu_wrapper.cc PreBuildTask). Row ids are
+// stable handles into columnar value arrays owned by Python/numpy; rows
+// freed by shrink are recycled via a free list.
+//
+// Batched API only (amortizes the FFI): lookup, lookup_or_insert, erase,
+// plus iteration support for save/shrink. Thread-safety is the caller's
+// concern — the table layer shards keys so each shard is touched by one
+// thread at a time (the reference serializes per-shard via 1-thread pools).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr int32_t kEmpty = -1;
+constexpr int32_t kTombstone = -2;
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+struct PsIndex {
+  std::vector<uint64_t> keys;   // slot -> key (valid when state >= 0)
+  std::vector<int32_t> state;   // slot -> row id | kEmpty | kTombstone
+  std::vector<uint64_t> row_keys;  // row -> key
+  std::vector<uint8_t> row_alive;  // row -> liveness
+  std::vector<int32_t> free_rows;  // recycled rows
+  uint64_t mask = 0;
+  int64_t used = 0;       // live entries
+  int64_t occupied = 0;   // live + tombstones
+
+  explicit PsIndex(uint64_t capacity_hint) {
+    uint64_t cap = 64;
+    while (cap < capacity_hint * 2) cap <<= 1;
+    keys.assign(cap, 0);
+    state.assign(cap, kEmpty);
+    mask = cap - 1;
+  }
+
+  void grow() {
+    std::vector<uint64_t> old_keys(std::move(keys));
+    std::vector<int32_t> old_state(std::move(state));
+    uint64_t cap = (mask + 1) << 1;
+    keys.assign(cap, 0);
+    state.assign(cap, kEmpty);
+    mask = cap - 1;
+    occupied = 0;
+    for (size_t i = 0; i < old_keys.size(); ++i) {
+      if (old_state[i] >= 0) {
+        uint64_t h = splitmix64(old_keys[i]) & mask;
+        while (state[h] != kEmpty) h = (h + 1) & mask;
+        keys[h] = old_keys[i];
+        state[h] = old_state[i];
+        ++occupied;
+      }
+    }
+  }
+
+  inline int32_t find(uint64_t key) const {
+    uint64_t h = splitmix64(key) & mask;
+    while (true) {
+      int32_t s = state[h];
+      if (s == kEmpty) return kEmpty;
+      if (s != kTombstone && keys[h] == key) return s;
+      h = (h + 1) & mask;
+    }
+  }
+
+  inline int32_t insert(uint64_t key) {
+    if ((occupied + 1) * 10 >= static_cast<int64_t>(mask + 1) * 7) grow();
+    uint64_t h = splitmix64(key) & mask;
+    int64_t first_tomb = -1;
+    while (true) {
+      int32_t s = state[h];
+      if (s == kEmpty) break;
+      if (s == kTombstone) {
+        if (first_tomb < 0) first_tomb = static_cast<int64_t>(h);
+      } else if (keys[h] == key) {
+        return s;  // already present
+      }
+      h = (h + 1) & mask;
+    }
+    int32_t row;
+    if (!free_rows.empty()) {
+      row = free_rows.back();
+      free_rows.pop_back();
+      row_keys[row] = key;
+      row_alive[row] = 1;
+    } else {
+      row = static_cast<int32_t>(row_keys.size());
+      row_keys.push_back(key);
+      row_alive.push_back(1);
+    }
+    uint64_t slot = first_tomb >= 0 ? static_cast<uint64_t>(first_tomb) : h;
+    if (first_tomb < 0) ++occupied;  // tombstone reuse doesn't add occupancy
+    keys[slot] = key;
+    state[slot] = row;
+    ++used;
+    return row;
+  }
+
+  inline bool erase(uint64_t key) {
+    uint64_t h = splitmix64(key) & mask;
+    while (true) {
+      int32_t s = state[h];
+      if (s == kEmpty) return false;
+      if (s != kTombstone && keys[h] == key) {
+        state[h] = kTombstone;
+        row_alive[s] = 0;
+        free_rows.push_back(s);
+        --used;
+        return true;
+      }
+      h = (h + 1) & mask;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* psidx_create(uint64_t capacity_hint) { return new PsIndex(capacity_hint); }
+
+void psidx_destroy(void* p) { delete static_cast<PsIndex*>(p); }
+
+int64_t psidx_size(void* p) { return static_cast<PsIndex*>(p)->used; }
+
+int64_t psidx_row_capacity(void* p) {
+  return static_cast<int64_t>(static_cast<PsIndex*>(p)->row_keys.size());
+}
+
+void psidx_lookup(void* p, const uint64_t* keys, int64_t n, int32_t* rows) {
+  PsIndex* idx = static_cast<PsIndex*>(p);
+  for (int64_t i = 0; i < n; ++i) rows[i] = idx->find(keys[i]);
+}
+
+// Returns the number of newly created rows; rows[] receives one row id per
+// key (insert-on-miss — memory_sparse_table.cc:443 pull semantics).
+int64_t psidx_lookup_or_insert(void* p, const uint64_t* keys, int64_t n,
+                               int32_t* rows) {
+  PsIndex* idx = static_cast<PsIndex*>(p);
+  int64_t before = idx->used;
+  for (int64_t i = 0; i < n; ++i) rows[i] = idx->insert(keys[i]);
+  return idx->used - before;
+}
+
+void psidx_erase(void* p, const uint64_t* keys, int64_t n) {
+  PsIndex* idx = static_cast<PsIndex*>(p);
+  for (int64_t i = 0; i < n; ++i) idx->erase(keys[i]);
+}
+
+// Dump all live (key, row) pairs; buffers must hold psidx_size entries.
+void psidx_items(void* p, uint64_t* out_keys, int32_t* out_rows) {
+  PsIndex* idx = static_cast<PsIndex*>(p);
+  int64_t j = 0;
+  for (size_t r = 0; r < idx->row_keys.size(); ++r) {
+    if (idx->row_alive[r]) {
+      out_keys[j] = idx->row_keys[r];
+      out_rows[j] = static_cast<int32_t>(r);
+      ++j;
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,15 @@
+"""Fleet distributed API (SURVEY §2.5)."""
+
+from .fleet import Fleet, fleet
+from .role_maker import PaddleCloudRoleMaker, Role, RoleMakerBase, UserDefinedRoleMaker
+from .strategy import DistributedStrategy
+
+__all__ = [
+    "Fleet",
+    "fleet",
+    "PaddleCloudRoleMaker",
+    "Role",
+    "RoleMakerBase",
+    "UserDefinedRoleMaker",
+    "DistributedStrategy",
+]

@@ -1,0 +1,225 @@
+"""Fleet: the distributed-training facade.
+
+Rebuild of ``fleet/base/fleet_base.py`` (init:206, minimize:1438,
+init_server:642, run_server:693, init_worker, save_persistables:824) +
+the runtime selection that ``TheOnePSRuntime`` (distributed/ps/
+the_one_ps.py:819) performs: from the strategy, stand up tables, client,
+and communicator.
+
+Single-process build: servers are in-process table registries
+(PsLocalServer pattern); multi-host control plane (DCN) plugs in behind
+PSClient. ``distributed_optimizer`` returns a wrapper that (a) keeps the
+dense path compiled (SpmdTrainer-compatible) and (b) routes sparse-table
+gradients through the communicator per the strategy's mode.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..core.enforce import PreconditionNotMetError, enforce
+from ..ps.client import LocalPsClient, PsServerHandle
+from ..ps.communicator import (
+    AsyncCommunicator,
+    GeoCommunicator,
+    HalfAsyncCommunicator,
+    SyncCommunicator,
+)
+from ..ps.table import BarrierTable, TableConfig
+from .role_maker import PaddleCloudRoleMaker, RoleMakerBase
+from .strategy import DistributedStrategy
+
+__all__ = ["Fleet", "fleet"]
+
+
+class _FleetUtil:
+    """fleet.util (base/util_factory.py shape): host-side small-collective
+    helpers. Single-process: identity; multi-host wires to the
+    coordination service."""
+
+    def all_reduce(self, value, mode: str = "sum"):
+        return value
+
+    def barrier(self) -> None:
+        pass
+
+    def get_file_shard(self, files: List[str], worker_index: int, worker_num: int) -> List[str]:
+        """Static file split across workers (util.get_file_shard)."""
+        return files[worker_index::worker_num]
+
+
+class Fleet:
+    def __init__(self) -> None:
+        self._role_maker: Optional[RoleMakerBase] = None
+        self._strategy: Optional[DistributedStrategy] = None
+        self._server: Optional[PsServerHandle] = None
+        self._client: Optional[LocalPsClient] = None
+        self._communicator = None
+        self._inited = False
+        self.util = _FleetUtil()
+        self._table_configs: Dict[int, TableConfig] = {}
+        self._server_running = threading.Event()
+
+    # -- lifecycle (fleet_base.py API names) ------------------------------
+
+    def init(
+        self,
+        role_maker: Optional[RoleMakerBase] = None,
+        is_collective: bool = False,
+        strategy: Optional[DistributedStrategy] = None,
+    ) -> "Fleet":
+        self._role_maker = role_maker or PaddleCloudRoleMaker(is_collective=is_collective)
+        self._strategy = strategy or DistributedStrategy()
+        self._is_collective = is_collective
+        # in-process server handle shared by this process's client(s)
+        self._server = PsServerHandle()
+        self._client = LocalPsClient(self._server)
+        self._inited = True
+        return self
+
+    def _check_init(self) -> None:
+        enforce(self._inited, "call fleet.init() first", PreconditionNotMetError)
+
+    # -- role queries ------------------------------------------------------
+
+    def is_worker(self) -> bool:
+        self._check_init()
+        return self._role_maker.is_worker()
+
+    def is_server(self) -> bool:
+        self._check_init()
+        return self._role_maker.is_server()
+
+    def is_first_worker(self) -> bool:
+        self._check_init()
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self) -> int:
+        self._check_init()
+        return self._role_maker.worker_index()
+
+    def worker_num(self) -> int:
+        self._check_init()
+        return self._role_maker.worker_num()
+
+    def server_num(self) -> int:
+        self._check_init()
+        return self._role_maker.server_num()
+
+    def worker_endpoints(self, to_string: bool = False):
+        eps = self._role_maker.get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_endpoints(self, to_string: bool = False):
+        eps = self._role_maker.get_pserver_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    # -- tables ------------------------------------------------------------
+
+    def register_sparse_table(self, table_id: int, config: Optional[TableConfig] = None):
+        """Declare a sparse table (the_one_ps derives these from program
+        parsing; here models declare them explicitly)."""
+        self._check_init()
+        cfg = config or TableConfig(table_id=table_id)
+        self._table_configs[table_id] = cfg
+        return self._server.create_sparse_table(table_id, cfg)
+
+    def register_dense_table(self, table_id: int, dim: int, optimizer: str = "adam",
+                             lr: float = 0.001):
+        self._check_init()
+        return self._server.create_dense_table(table_id, dim, optimizer, lr)
+
+    def register_geo_table(self, table_id: int, dim: int):
+        self._check_init()
+        return self._server.create_geo_table(table_id, dim)
+
+    @property
+    def client(self) -> LocalPsClient:
+        self._check_init()
+        return self._client
+
+    @property
+    def communicator(self):
+        return self._communicator
+
+    # -- server lifecycle --------------------------------------------------
+
+    def init_server(self, *args, **kwargs) -> None:
+        self._check_init()
+        self._server.barrier_table = BarrierTable(max(self.worker_num(), 1))
+
+    def run_server(self) -> None:
+        """In-process server 'runs' by existing; this marks it live (the
+        brpc serving loop has no analogue — tables serve via direct calls
+        intra-process and the DCN service when multi-host lands)."""
+        self._check_init()
+        self._server_running.set()
+
+    def stop_server(self) -> None:
+        self._server_running.clear()
+
+    # -- worker lifecycle --------------------------------------------------
+
+    def init_worker(self) -> None:
+        """Create the communicator per strategy mode (TheOnePSRuntime
+        _init_worker: Communicator::InitImpl + Start)."""
+        self._check_init()
+        s = self._strategy
+        if s.is_geo_mode:
+            self._communicator = GeoCommunicator(
+                self._client, geo_step=int(s.geo_configs.get("geo_step", 100))
+            )
+        elif s.a_sync:
+            k = int(s.a_sync_configs.get("k_steps", -1))
+            cls = AsyncCommunicator if k < 0 else HalfAsyncCommunicator
+            self._communicator = cls(self._client)
+        else:
+            self._communicator = SyncCommunicator(self._client)
+        self._communicator.start()
+
+    def stop_worker(self) -> None:
+        if self._communicator is not None:
+            self._communicator.stop()
+            self._communicator = None
+
+    def barrier_worker(self) -> None:
+        if self._communicator is not None:
+            self._communicator.barrier()
+
+    # -- save/load ---------------------------------------------------------
+
+    def save_persistables(self, dirname: str, mode: int = 0) -> Dict[int, int]:
+        """Save every registered sparse table (per-shard text files with
+        the accessor save-mode filter — fleet_base.py:824 →
+        FleetWrapper::SaveModel)."""
+        self._check_init()
+        out = {}
+        for table_id in self._server.sparse_tables:
+            out[table_id] = self._client.save(table_id, f"{dirname}/table_{table_id}", mode)
+        return out
+
+    def load_model(self, dirname: str) -> Dict[int, int]:
+        self._check_init()
+        out = {}
+        for table_id in self._server.sparse_tables:
+            out[table_id] = self._client.load(table_id, f"{dirname}/table_{table_id}")
+        return out
+
+    def shrink(self) -> Dict[int, int]:
+        self._check_init()
+        return {tid: self._client.shrink(tid) for tid in self._server.sparse_tables}
+
+    # -- optimizer ---------------------------------------------------------
+
+    def distributed_optimizer(self, optimizer, strategy: Optional[DistributedStrategy] = None):
+        self._check_init()
+        if strategy is not None:
+            self._strategy = strategy
+        return optimizer  # dense path stays the compiled optimizer;
+        # sparse routing happens via PsTrainer/communicator (executor layer)
+
+
+fleet = Fleet()

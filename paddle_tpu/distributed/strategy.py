@@ -1,0 +1,84 @@
+"""DistributedStrategy.
+
+Mirror of the reference's strategy object
+(``fleet/base/distributed_strategy.py:109`` backed by
+``framework/distributed_strategy.proto:26-128``): a declarative bundle of
+parallelism/optimization switches consumed by ``distributed_optimizer``.
+Only the knobs meaningful on TPU are functional; the rest are carried for
+config compatibility and readable via ``to_dict``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+__all__ = ["DistributedStrategy"]
+
+
+@dataclasses.dataclass
+class DistributedStrategy:
+    # --- PS modes (a_sync & a_sync_configs, proto:96-104) ---
+    a_sync: bool = False
+    a_sync_configs: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {
+            "k_steps": -1,          # -1: pure async; 0: sync; >0: half-async rounds
+            "max_merge_var_num": 20,
+            "send_queue_size": 20,
+            "independent_recv_thread": False,
+            "send_wait_times": 5,
+            "thread_pool_size": 8,
+            "launch_barrier": True,
+        }
+    )
+    # geo mode: a_sync + geo_configs
+    geo_sgd_mode: bool = False
+    geo_configs: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"geo_step": 100}
+    )
+
+    # --- collective / hybrid (proto Hybrid/Sharding/Recompute/AMP...) ---
+    amp: bool = False
+    amp_configs: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"init_loss_scaling": 32768.0, "use_dynamic_loss_scaling": True}
+    )
+    recompute: bool = False
+    recompute_configs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    gradient_merge: bool = False
+    gradient_merge_configs: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"k_steps": 1, "avg": True}
+    )
+    sharding: bool = False
+    sharding_configs: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"stage": 1, "sharding_degree": 1}
+    )
+    pipeline: bool = False
+    pipeline_configs: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"accumulate_steps": 1, "micro_batch_size": 1}
+    )
+    tensor_parallel: bool = False
+    tensor_parallel_configs: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"tensor_parallel_degree": 1}
+    )
+    hybrid_configs: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                                 "sharding_degree": 1, "cp_degree": 1, "ep_degree": 1}
+    )
+    lamb: bool = False
+    lars: bool = False
+    localsgd: bool = False
+    dgc: bool = False
+
+    # --- misc ---
+    find_unused_parameters: bool = False
+
+    @property
+    def is_geo_mode(self) -> bool:
+        return self.a_sync and self.geo_sgd_mode
+
+    @property
+    def is_sync_mode(self) -> bool:
+        return not self.a_sync
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)

@@ -1,0 +1,36 @@
+"""Parameter-server stack: accessors, sparse SGD rules, host tables,
+HBM embedding cache (SURVEY §2.2/2.3, Appendix A)."""
+
+from .accessor import AccessorConfig, CtrCommonAccessor, SparseAccessor, make_accessor
+from .embedding_cache import CacheConfig, HbmEmbeddingCache, cache_pull, cache_push
+from .native import FeasignIndex, native_available
+from .sgd_rule import SGDRuleConfig, make_sgd_rule
+from .table import (
+    BarrierTable,
+    GlobalStepTable,
+    MemoryDenseTable,
+    MemorySparseGeoTable,
+    MemorySparseTable,
+    TableConfig,
+)
+
+__all__ = [
+    "AccessorConfig",
+    "CtrCommonAccessor",
+    "SparseAccessor",
+    "make_accessor",
+    "CacheConfig",
+    "HbmEmbeddingCache",
+    "cache_pull",
+    "cache_push",
+    "FeasignIndex",
+    "native_available",
+    "SGDRuleConfig",
+    "make_sgd_rule",
+    "BarrierTable",
+    "GlobalStepTable",
+    "MemoryDenseTable",
+    "MemorySparseGeoTable",
+    "MemorySparseTable",
+    "TableConfig",
+]

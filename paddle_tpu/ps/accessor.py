@@ -1,0 +1,232 @@
+"""Feature-value accessors.
+
+Behavioral port of the reference accessor family
+(``paddle/fluid/distributed/ps/table/accessor.h:67``,
+``ctr_accessor.{h,cc}``, ``sparse_accessor.h`` — SURVEY Appendix A.1/A.3):
+an accessor defines the per-feature value layout and lifecycle —
+creation, pull (select), push (update), shrink, and save filtering.
+
+Layouts are structure-of-arrays here (columnar numpy) rather than the
+reference's packed float rows: same fields, vectorizable on host and
+directly liftable to device arrays.
+
+CtrCommonAccessor stored fields (ctr_accessor.h:30-70):
+    slot, unseen_days, delta_score, show, click,
+    embed_w[1], embed_state[sgd], embedx_w[dim], embedx_state[sgd]
+Push value (:71-105):  slot, show, click, embed_g[1], embedx_g[dim]
+Pull value (:107+):    show, click, embed_w[1], embedx_w[dim]
+SparseAccessor: pull drops the CTR stats (sparse_accessor.h).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .sgd_rule import SGDRuleConfig, SparseSGDRule, make_sgd_rule
+
+__all__ = ["AccessorConfig", "CtrCommonAccessor", "SparseAccessor", "make_accessor"]
+
+
+@dataclasses.dataclass
+class AccessorConfig:
+    """Mirrors CtrAccessorParameter (ps.proto): lifecycle thresholds."""
+
+    embedx_dim: int = 8
+    nonclk_coeff: float = 0.1
+    click_coeff: float = 1.0
+    base_threshold: float = 1.5
+    delta_threshold: float = 0.25
+    delta_keep_days: float = 16.0
+    show_click_decay_rate: float = 0.98
+    delete_threshold: float = 0.8
+    delete_after_unseen_days: float = 30.0
+    embedx_threshold: float = 10.0  # create embedx lazily past this score
+    embed_sgd_rule: str = "adagrad"
+    embedx_sgd_rule: str = "adagrad"
+    sgd: SGDRuleConfig = dataclasses.field(default_factory=SGDRuleConfig)
+
+
+class FeatureBlock:
+    """Columnar storage for a batch/shard of features (the SoA analogue
+    of FixedFeatureValue rows)."""
+
+    def __init__(self, n: int, accessor: "CtrCommonAccessor") -> None:
+        dim = accessor.config.embedx_dim
+        self.slot = np.zeros(n, np.int32)
+        self.unseen_days = np.zeros(n, np.float32)
+        self.delta_score = np.zeros(n, np.float32)
+        self.show = np.zeros(n, np.float32)
+        self.click = np.zeros(n, np.float32)
+        self.embed_w = np.zeros((n, 1), np.float32)
+        self.embed_state = np.zeros((n, accessor.embed_rule.state_dim), np.float32)
+        self.embedx_w = np.zeros((n, dim), np.float32)
+        self.embedx_state = np.zeros((n, accessor.embedx_rule.state_dim), np.float32)
+        self.has_embedx = np.zeros(n, bool)
+
+
+class CtrCommonAccessor:
+    """The CTR accessor: show/click statistics drive value lifecycle
+    (ctr_accessor.cc behavioral port)."""
+
+    def __init__(self, config: Optional[AccessorConfig] = None) -> None:
+        self.config = config or AccessorConfig()
+        self.embed_rule: SparseSGDRule = make_sgd_rule(
+            self.config.embed_sgd_rule, 1, self.config.sgd
+        )
+        self.embedx_rule: SparseSGDRule = make_sgd_rule(
+            self.config.embedx_sgd_rule, self.config.embedx_dim, self.config.sgd
+        )
+
+    # -- dims -------------------------------------------------------------
+
+    @property
+    def pull_dim(self) -> int:
+        """show, click, embed_w, embedx_w[dim]"""
+        return 3 + self.config.embedx_dim
+
+    @property
+    def push_dim(self) -> int:
+        """slot, show, click, embed_g, embedx_g[dim]"""
+        return 4 + self.config.embedx_dim
+
+    # -- lifecycle --------------------------------------------------------
+
+    def create(self, block: FeatureBlock, idx: np.ndarray, slots: np.ndarray,
+               rng: np.random.Generator) -> None:
+        """Initialize freshly inserted features (Create)."""
+        n = len(idx)
+        if n == 0:
+            return
+        # full reset: rows may be recycled from the shrink free list and
+        # must not inherit the dead feature's lifecycle stats
+        block.slot[idx] = slots
+        block.unseen_days[idx] = 0.0
+        block.delta_score[idx] = 0.0
+        block.show[idx] = 0.0
+        block.click[idx] = 0.0
+        w, st = self.embed_rule.init_value(n, rng)
+        block.embed_w[idx] = w
+        block.embed_state[idx] = st
+        block.embedx_w[idx] = 0.0
+        block.embedx_state[idx] = 0.0
+        # embedx is lazy (NeedExtendMF): created on push once the
+        # show/click score crosses embedx_threshold
+        block.has_embedx[idx] = False
+
+    def show_click_score(self, show: np.ndarray, click: np.ndarray) -> np.ndarray:
+        cfg = self.config
+        return (show - click) * cfg.nonclk_coeff + click * cfg.click_coeff
+
+    def select(self, block: FeatureBlock, idx: np.ndarray) -> np.ndarray:
+        """Pull: [n, pull_dim] = show, click, embed_w, embedx_w."""
+        out = np.empty((len(idx), self.pull_dim), np.float32)
+        out[:, 0] = block.show[idx]
+        out[:, 1] = block.click[idx]
+        out[:, 2] = block.embed_w[idx, 0]
+        out[:, 3:] = block.embedx_w[idx] * block.has_embedx[idx, None]
+        return out
+
+    def update(self, block: FeatureBlock, idx: np.ndarray, push: np.ndarray,
+               rng: np.random.Generator) -> None:
+        """Push: apply CTR statistics + SGD rules (ctr_accessor.cc:219)."""
+        cfg = self.config
+        push_show = push[:, 1]
+        push_click = push[:, 2]
+        block.show[idx] += push_show
+        block.click[idx] += push_click
+        block.delta_score[idx] += (
+            (push_show - push_click) * cfg.nonclk_coeff + push_click * cfg.click_coeff
+        )
+        block.unseen_days[idx] = 0.0
+
+        # embed (1-d) update with scale = push_show
+        w = block.embed_w[idx]
+        st = block.embed_state[idx]
+        self.embed_rule.update(w, st, push[:, 3:4], push_show)
+        block.embed_w[idx] = w
+        block.embed_state[idx] = st
+
+        # lazy embedx creation past threshold
+        score = self.show_click_score(block.show[idx], block.click[idx])
+        need = (~block.has_embedx[idx]) & (score >= cfg.embedx_threshold)
+        if need.any():
+            create_rows = idx[need]
+            wx, stx = self.embedx_rule.init_value(len(create_rows), rng)
+            block.embedx_w[create_rows] = wx
+            block.embedx_state[create_rows] = stx
+            block.has_embedx[create_rows] = True
+
+        # embedx update only where materialized
+        have = block.has_embedx[idx]
+        if have.any():
+            rows = idx[have]
+            wx = block.embedx_w[rows]
+            stx = block.embedx_state[rows]
+            self.embedx_rule.update(wx, stx, push[have, 4:], push_show[have])
+            block.embedx_w[rows] = wx
+            block.embedx_state[rows] = stx
+
+    def shrink(self, block: FeatureBlock, active: np.ndarray) -> np.ndarray:
+        """Daily shrink (ctr_accessor.cc:55): decay show/click; return the
+        boolean keep-mask over ``active`` rows."""
+        cfg = self.config
+        block.show[active] *= cfg.show_click_decay_rate
+        block.click[active] *= cfg.show_click_decay_rate
+        block.unseen_days[active] += 1
+        score = self.show_click_score(block.show[active], block.click[active])
+        keep = ~(
+            (score < cfg.delete_threshold)
+            | (block.unseen_days[active] > cfg.delete_after_unseen_days)
+        )
+        return keep
+
+    def save_filter(self, block: FeatureBlock, idx: np.ndarray, mode: int) -> np.ndarray:
+        """Save mode filter (ctr_accessor.cc Save): 0=all, 1=delta,
+        2=base, 3=batch-model (all, then unseen_days++ via
+        update_stat_after_save)."""
+        cfg = self.config
+        if mode in (0, 3):
+            return np.ones(len(idx), bool)
+        # base save (mode 2) zeroes the delta threshold (ctr_accessor.cc:
+        # Save sets delta_threshold=0 for param==2) — a stable feature
+        # with few recent pushes still belongs in the base model
+        delta_threshold = 0.0 if mode == 2 else cfg.delta_threshold  # 2 = base save
+        score = self.show_click_score(block.show[idx], block.click[idx])
+        keep = (
+            (score >= cfg.base_threshold)
+            & (block.delta_score[idx] >= delta_threshold)
+            & (block.unseen_days[idx] <= cfg.delta_keep_days)
+        )
+        return keep
+
+    def update_stat_after_save(self, block: FeatureBlock, idx: np.ndarray, mode: int) -> None:
+        if mode == 3:
+            block.unseen_days[idx] += 1
+        elif mode == 2:  # base save resets delta_score
+            block.delta_score[idx] = 0.0
+
+
+class SparseAccessor(CtrCommonAccessor):
+    """Pull drops CTR stats (sparse_accessor.h): [embed_w, embedx_w]."""
+
+    @property
+    def pull_dim(self) -> int:
+        return 1 + self.config.embedx_dim
+
+    def select(self, block: FeatureBlock, idx: np.ndarray) -> np.ndarray:
+        out = np.empty((len(idx), self.pull_dim), np.float32)
+        out[:, 0] = block.embed_w[idx, 0]
+        out[:, 1:] = block.embedx_w[idx] * block.has_embedx[idx, None]
+        return out
+
+
+def make_accessor(name: str, config: Optional[AccessorConfig] = None):
+    table = {"ctr": CtrCommonAccessor, "sparse": SparseAccessor,
+             "CtrCommonAccessor": CtrCommonAccessor, "SparseAccessor": SparseAccessor}
+    try:
+        return table[name](config)
+    except KeyError:
+        raise KeyError(f"unknown accessor {name!r}; have ctr/sparse")

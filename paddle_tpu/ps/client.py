@@ -1,0 +1,160 @@
+"""PSClient interface + in-process implementation.
+
+Rebuild of the reference client layer (``ps/service/ps_client.h:62`` —
+PullDense/PullSparse/PushSparseRawGradient/Flush futures over brpc) with
+the transport inverted for TPU pods: intra-pod parameter movement rides
+ICI inside compiled programs (embedding_cache), so the client's job is
+the *control plane* — table lifecycle, host-table access for pass
+build/flush, save/load, barriers.
+
+``LocalPsClient`` is the in-process no-RPC implementation (the
+reference's PsLocalClient, ps_local_client.h:227 — used by GPUPS and as
+the test double). A DCN/grpc client for multi-host CPU tables slots in
+behind the same interface.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.enforce import NotFoundError, enforce
+from .table import (
+    BarrierTable,
+    GlobalStepTable,
+    MemoryDenseTable,
+    MemorySparseGeoTable,
+    MemorySparseTable,
+    TableConfig,
+)
+
+__all__ = ["PSClient", "LocalPsClient", "PsServerHandle"]
+
+
+class PsServerHandle:
+    """In-process 'server': the table registry (what BrpcPsServer holds).
+    One per process; shared by all LocalPsClients."""
+
+    def __init__(self) -> None:
+        self.sparse_tables: Dict[int, MemorySparseTable] = {}
+        self.dense_tables: Dict[int, MemoryDenseTable] = {}
+        self.geo_tables: Dict[int, MemorySparseGeoTable] = {}
+        self.barrier_table: Optional[BarrierTable] = None
+        self.global_step: GlobalStepTable = GlobalStepTable()
+        self._lock = threading.Lock()
+
+    def create_sparse_table(self, table_id: int, config: Optional[TableConfig] = None) -> MemorySparseTable:
+        with self._lock:
+            if table_id not in self.sparse_tables:
+                cfg = config or TableConfig(table_id=table_id)
+                self.sparse_tables[table_id] = MemorySparseTable(cfg)
+            return self.sparse_tables[table_id]
+
+    def create_dense_table(self, table_id: int, dim: int, optimizer: str = "adam",
+                           lr: float = 0.001) -> MemoryDenseTable:
+        with self._lock:
+            if table_id not in self.dense_tables:
+                self.dense_tables[table_id] = MemoryDenseTable(dim, optimizer, lr)
+            return self.dense_tables[table_id]
+
+    def create_geo_table(self, table_id: int, dim: int) -> MemorySparseGeoTable:
+        with self._lock:
+            if table_id not in self.geo_tables:
+                self.geo_tables[table_id] = MemorySparseGeoTable(dim)
+            return self.geo_tables[table_id]
+
+
+class PSClient:
+    """Abstract client interface (ps_client.h API shape)."""
+
+    def pull_sparse(self, table_id: int, keys: np.ndarray, create: bool = True) -> np.ndarray:
+        raise NotImplementedError
+
+    def push_sparse(self, table_id: int, keys: np.ndarray, values: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def pull_dense(self, table_id: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def push_dense(self, table_id: int, grad: np.ndarray) -> None:
+        raise NotImplementedError
+
+    def save(self, table_id: int, dirname: str, mode: int = 0) -> int:
+        raise NotImplementedError
+
+    def load(self, table_id: int, dirname: str) -> int:
+        raise NotImplementedError
+
+    def push_geo(self, table_id: int, keys: np.ndarray, deltas: np.ndarray) -> None:
+        """GEO mode: accumulate raw parameter deltas server-side."""
+        raise NotImplementedError
+
+    def pull_geo(self, table_id: int):
+        raise NotImplementedError
+
+    def barrier(self) -> None:
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def shrink(self, table_id: int) -> int:
+        raise NotImplementedError
+
+
+class LocalPsClient(PSClient):
+    def __init__(self, server: PsServerHandle) -> None:
+        self.server = server
+
+    def _sparse(self, table_id: int) -> MemorySparseTable:
+        try:
+            return self.server.sparse_tables[table_id]
+        except KeyError:
+            raise NotFoundError(f"sparse table {table_id} not created")
+
+    def _dense(self, table_id: int) -> MemoryDenseTable:
+        try:
+            return self.server.dense_tables[table_id]
+        except KeyError:
+            raise NotFoundError(f"dense table {table_id} not created")
+
+    def pull_sparse(self, table_id, keys, create=True):
+        return self._sparse(table_id).pull_sparse(keys, create=create)
+
+    def push_sparse(self, table_id, keys, values):
+        self._sparse(table_id).push_sparse(keys, values)
+
+    def pull_dense(self, table_id):
+        return self._dense(table_id).pull_dense()
+
+    def push_dense(self, table_id, grad):
+        self._dense(table_id).push_dense(grad)
+
+    def save(self, table_id, dirname, mode=0):
+        return self._sparse(table_id).save(dirname, mode)
+
+    def load(self, table_id, dirname):
+        return self._sparse(table_id).load(dirname)
+
+    def push_geo(self, table_id, keys, deltas):
+        try:
+            geo = self.server.geo_tables[table_id]
+        except KeyError:
+            raise NotFoundError(f"geo table {table_id} not created")
+        geo.push_delta(keys, deltas)
+
+    def pull_geo(self, table_id):
+        try:
+            geo = self.server.geo_tables[table_id]
+        except KeyError:
+            raise NotFoundError(f"geo table {table_id} not created")
+        return geo.pull_geo()
+
+    def barrier(self):
+        if self.server.barrier_table is not None:
+            self.server.barrier_table.barrier()
+
+    def shrink(self, table_id):
+        return self._sparse(table_id).shrink()

@@ -1,0 +1,217 @@
+"""Trainer-side gradient communicator.
+
+Behavioral rebuild of the reference Communicator
+(``ps/service/communicator/communicator.h`` — Async :402, HalfAsync :492,
+Sync :537, Geo :566; MainThread loop communicator.cc:554): gradients are
+queued by the train loop, merged across mini-batches
+(``max_merge_var_num`` — MergeVars semantics: sum, or average when the
+optimizer is plain SGD), and pushed to the PS by a background thread —
+async PS semantics (stale pulls tolerated) have no XLA analogue, so this
+is exactly the host-side C++-thread-around-compiled-steps design the
+survey prescribes (SURVEY §7 hard part e).
+
+Modes:
+- AsyncCommunicator: free-running background merge+push.
+- HalfAsyncCommunicator: async queue, but ``barrier()`` drains and joins.
+- SyncCommunicator: push happens inline on send (queue depth 1 + drain).
+- GeoCommunicator: records deltas; a background round-robin pushes merged
+  deltas per table every ``geo_step`` sends.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.flags import define_flag, flag
+from .client import PSClient
+
+__all__ = [
+    "CommunicatorConfig",
+    "AsyncCommunicator",
+    "HalfAsyncCommunicator",
+    "SyncCommunicator",
+    "GeoCommunicator",
+]
+
+define_flag("communicator_max_merge_var_num", 20,
+            "gradient batches merged per push (communicator.h:412)")
+define_flag("communicator_send_queue_size", 20,
+            "per-table send queue depth")
+define_flag("communicator_send_wait_times", 5,
+            "merge rounds to wait before a partial push")
+define_flag("communicator_is_sgd_optimizer", True,
+            "sum (False) vs average (True) on merge (communicator.h:54)")
+
+
+class CommunicatorConfig:
+    def __init__(self) -> None:
+        self.max_merge_var_num = int(flag("communicator_max_merge_var_num"))
+        self.send_queue_size = int(flag("communicator_send_queue_size"))
+        self.send_wait_times = int(flag("communicator_send_wait_times"))
+        self.is_sgd_optimizer = bool(flag("communicator_is_sgd_optimizer"))
+
+
+class _BaseCommunicator:
+    def __init__(self, client: PSClient, config: Optional[CommunicatorConfig] = None) -> None:
+        self.client = client
+        self.config = config or CommunicatorConfig()
+        self._queues: Dict[int, "queue.Queue"] = {}
+        self._running = False
+        self._thread: Optional[threading.Thread] = None
+        self._drained = threading.Event()
+        self._drained.set()
+
+    # -- train-loop API ---------------------------------------------------
+
+    def send_sparse(self, table_id: int, keys: np.ndarray, values: np.ndarray) -> None:
+        self._queue_for(table_id).put(("sparse", keys, values))
+        self._drained.clear()
+
+    def send_dense(self, table_id: int, grad: np.ndarray) -> None:
+        self._queue_for(table_id).put(("dense", None, grad))
+        self._drained.clear()
+
+    def _queue_for(self, table_id: int) -> "queue.Queue":
+        if table_id not in self._queues:
+            self._queues[table_id] = queue.Queue(maxsize=self.config.send_queue_size)
+        return self._queues[table_id]
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._thread = threading.Thread(target=self._main_loop, daemon=True,
+                                        name="communicator-main")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if not self._running:
+            return
+        self._running = False
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        self._drain_all()
+
+    def barrier(self) -> None:
+        """Block until queued sends hit the PS (HalfAsync/Sync join)."""
+        while not self._all_empty():
+            time.sleep(0.001)
+        self._drained.wait(timeout=10)
+
+    def _all_empty(self) -> bool:
+        return all(q.empty() for q in self._queues.values())
+
+    # -- background merge+push (MainThread, communicator.cc:554) ----------
+
+    def _main_loop(self) -> None:
+        while self._running:
+            if not self._drain_once():
+                time.sleep(0.002)
+
+    def _drain_once(self) -> bool:
+        did_work = False
+        for table_id, q in list(self._queues.items()):
+            merged_sparse: List[Tuple[np.ndarray, np.ndarray]] = []
+            merged_dense: List[np.ndarray] = []
+            for _ in range(self.config.max_merge_var_num):
+                try:
+                    kind, keys, values = q.get_nowait()
+                except queue.Empty:
+                    break
+                if kind == "sparse":
+                    merged_sparse.append((keys, values))
+                else:
+                    merged_dense.append(values)
+            if merged_sparse:
+                keys = np.concatenate([k for k, _ in merged_sparse])
+                vals = np.concatenate([v for _, v in merged_sparse])
+                self.client.push_sparse(table_id, keys, vals)
+                did_work = True
+            if merged_dense:
+                acc = np.sum(merged_dense, axis=0)
+                if self.config.is_sgd_optimizer:
+                    acc = acc / len(merged_dense)  # average on merge
+                self.client.push_dense(table_id, acc)
+                did_work = True
+        if not did_work and self._all_empty():
+            self._drained.set()
+        return did_work
+
+    def _drain_all(self) -> None:
+        while self._drain_once():
+            pass
+        self._drained.set()
+
+
+class AsyncCommunicator(_BaseCommunicator):
+    """Free-running async push (a_sync=True mode)."""
+
+
+class HalfAsyncCommunicator(_BaseCommunicator):
+    """Async push + explicit barrier joins each k batches (the trainer
+    calls ``barrier()``; the reference wires it to a barrier table)."""
+
+
+class SyncCommunicator(_BaseCommunicator):
+    """Inline push on send — no background staleness."""
+
+    def start(self) -> None:  # no background thread
+        self._running = True
+
+    def stop(self) -> None:
+        self._running = False
+        self._drain_all()
+
+    def send_sparse(self, table_id, keys, values):
+        self.client.push_sparse(table_id, keys, values)
+
+    def send_dense(self, table_id, grad):
+        self.client.push_dense(table_id, grad)
+
+    def barrier(self) -> None:
+        self.client.barrier()
+
+
+class GeoCommunicator(_BaseCommunicator):
+    """GEO-SGD: the train loop applies updates locally; deltas vs the
+    last-synced snapshot are pushed every ``geo_step`` sends and merged
+    server-side (communicator.cc InitSparse/SendSparse :1208)."""
+
+    def __init__(self, client: PSClient, geo_step: int = 100,
+                 config: Optional[CommunicatorConfig] = None) -> None:
+        super().__init__(client, config)
+        self.geo_step = geo_step
+        self._send_count = 0
+        self._pending: Dict[int, List[Tuple[np.ndarray, np.ndarray]]] = {}
+        self._lock = threading.Lock()
+
+    def send_sparse_delta(self, table_id: int, keys: np.ndarray, delta: np.ndarray) -> None:
+        """delta: local_param - last_synced_param rows for ``keys``."""
+        with self._lock:
+            self._pending.setdefault(table_id, []).append((keys, delta))
+            self._send_count += 1
+            ready = self._send_count % self.geo_step == 0
+        if ready:
+            self.flush_geo()
+
+    def flush_geo(self) -> None:
+        with self._lock:
+            pending, self._pending = self._pending, {}
+        for table_id, entries in pending.items():
+            keys = np.concatenate([k for k, _ in entries])
+            deltas = np.concatenate([d for _, d in entries])
+            # merge duplicate keys by mean (GEO averages deltas)
+            uniq, inverse = np.unique(keys, return_inverse=True)
+            acc = np.zeros((len(uniq), deltas.shape[1]), np.float32)
+            cnt = np.zeros(len(uniq), np.int64)
+            np.add.at(acc, inverse, deltas)
+            np.add.at(cnt, inverse, 1)
+            acc /= np.maximum(cnt, 1)[:, None]
+            self.client.push_geo(table_id, uniq, acc)

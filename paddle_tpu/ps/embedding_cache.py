@@ -1,0 +1,259 @@
+"""HBM-resident sparse embedding cache.
+
+TPU-native rebuild of the HeterPS/GPUPS layer (SURVEY §2.3): the
+reference keeps a per-GPU ``HashTable`` of hot features built per pass
+(``PSGPUWrapper`` PreBuildTask→BuildPull→BuildGPUTask, then
+PullSparse/PushSparseGrad during the pass, EndPass→dump_to_cpu). Here:
+
+- the **feasign→cache-row map stays on host** in the native FeasignIndex
+  (hash tables are hostile to XLA's static shapes — the reference's own
+  build/serve split validates this design);
+- the **working set lives in HBM as dense row arrays** (values + per-row
+  optimizer state), donated through the jitted train step so pull
+  (gather), push (scatter) and the per-feature AdaGrad update
+  (optimizer.cuh.h math = sparse_sgd_rule AdaGrad) all fuse into the
+  step's XLA program — no host round-trip per batch;
+- multi-chip: rows shard over the mesh; the batch's row ids are global,
+  XLA turns the gather/scatter into all-to-all traffic over ICI (the
+  HeterComm walk_to_dest p2p analogue, compiler-scheduled).
+
+Value layout per cache row (mirrors heter_ps/feature_value.h semantics,
+SoA):  show, click, embed_w[1], embed_g2sum[1], embedx_w[dim],
+embedx_g2sum[1].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.enforce import enforce, enforce_le
+from .native import FeasignIndex
+from .sgd_rule import SGDRuleConfig
+from .table import MemorySparseTable
+
+__all__ = ["CacheConfig", "HbmEmbeddingCache", "cache_pull", "cache_push"]
+
+
+@dataclasses.dataclass
+class CacheConfig:
+    capacity: int = 1 << 20
+    embedx_dim: int = 8
+    sgd: SGDRuleConfig = dataclasses.field(default_factory=SGDRuleConfig)
+    nonclk_coeff: float = 0.1
+    click_coeff: float = 1.0
+    embedx_threshold: float = 10.0  # lazy mf creation score threshold
+
+
+def cache_pull(state: Dict[str, jax.Array], rows: jax.Array) -> jax.Array:
+    """In-graph pull: [n, 1+dim] = embed_w ++ embedx_w for given rows.
+    (PullSparse / CopyForPull analogue — one fused gather.)"""
+    w = jnp.concatenate([state["embed_w"], state["embedx_w"]], axis=1)
+    return jnp.take(w, rows, axis=0)
+
+
+def cache_push(
+    state: Dict[str, jax.Array],
+    rows: jax.Array,  # [n] cache rows (may repeat)
+    grads: jax.Array,  # [n, 1+dim] embed_g ++ embedx_g
+    shows: jax.Array,  # [n]
+    clicks: jax.Array,  # [n]
+    cfg: CacheConfig,
+) -> Dict[str, jax.Array]:
+    """In-graph push: merge duplicate rows (the cub sort+reduce merge_grad
+    step becomes scatter-add), then apply the per-feature AdaGrad rule
+    (optimizer.cuh.h:35-70 / sparse_sgd_rule AdaGrad) on touched rows.
+
+    All dense ops — fuses into the train step program.
+    """
+    C = state["embed_w"].shape[0]
+    sgd = cfg.sgd
+
+    # merge duplicates: scatter-add grads/shows onto per-row buckets
+    touched = jnp.zeros((C,), jnp.float32).at[rows].add(1.0)
+    show_sum = jnp.zeros((C,), jnp.float32).at[rows].add(shows)
+    click_sum = jnp.zeros((C,), jnp.float32).at[rows].add(clicks)
+    g_embed = jnp.zeros((C, 1), jnp.float32).at[rows].add(grads[:, :1])
+    g_embedx = jnp.zeros((C, cfg.embedx_dim), jnp.float32).at[rows].add(grads[:, 1:])
+
+    is_touched = touched > 0
+    scale = jnp.maximum(show_sum, 1e-10)
+
+    new_show = state["show"] + show_sum
+    new_click = state["click"] + click_sum
+
+    def adagrad(w, g2, g):
+        scaled = g / scale[:, None]
+        ratio = jnp.sqrt(sgd.initial_g2sum / (sgd.initial_g2sum + g2))
+        w_new = w - sgd.learning_rate * scaled * ratio
+        w_new = jnp.clip(w_new, sgd.weight_bounds[0], sgd.weight_bounds[1])
+        g2_new = g2 + jnp.mean(scaled * scaled, axis=1, keepdims=True)
+        return (
+            jnp.where(is_touched[:, None], w_new, w),
+            jnp.where(is_touched[:, None], g2_new, g2),
+        )
+
+    embed_w, embed_g2 = adagrad(state["embed_w"], state["embed_g2sum"], g_embed)
+
+    # lazy embedx (mf) creation: materialize once the show/click score
+    # crosses the threshold (optimizer.cuh.h:81-94; deterministic zero
+    # init here — curand-uniform init is a per-row RNG; zeros match the
+    # reference's mean and keep the step deterministic)
+    score = (new_show - new_click) * cfg.nonclk_coeff + new_click * cfg.click_coeff
+    had_mf = state["has_embedx"] > 0
+    create = (~had_mf) & (score >= cfg.embedx_threshold) & is_touched
+    has_mf_new = jnp.where(create, 1.0, state["has_embedx"])
+    update_mf = had_mf & is_touched
+    embedx_w, embedx_g2 = adagrad(state["embedx_w"], state["embedx_g2sum"], g_embedx)
+    embedx_w = jnp.where(update_mf[:, None], embedx_w, state["embedx_w"])
+    embedx_g2 = jnp.where(update_mf[:, None], embedx_g2, state["embedx_g2sum"])
+
+    return {
+        "show": new_show,
+        "click": new_click,
+        "embed_w": embed_w,
+        "embed_g2sum": embed_g2,
+        "embedx_w": embedx_w,
+        "embedx_g2sum": embedx_g2,
+        "has_embedx": has_mf_new,
+    }
+
+
+class HbmEmbeddingCache:
+    """Pass-scoped device working set over a host MemorySparseTable.
+
+    Usage (the PSGPUWrapper pass lifecycle):
+        cache.begin_pass(all_keys_of_pass)      # dedup + build + upload
+        rows = cache.lookup(batch_keys)          # host index → row ids
+        ... jitted step uses cache_pull/cache_push on cache.state ...
+        cache.end_pass()                         # flush back to host table
+    """
+
+    def __init__(
+        self,
+        table: MemorySparseTable,
+        config: Optional[CacheConfig] = None,
+        sharding=None,
+    ) -> None:
+        self.table = table
+        self.config = config or CacheConfig(
+            embedx_dim=table.accessor.config.embedx_dim
+        )
+        enforce(
+            self.config.embedx_dim == table.accessor.config.embedx_dim,
+            "cache embedx_dim must match table",
+        )
+        self._sharding = sharding
+        self._index: Optional[FeasignIndex] = None
+        self.state: Optional[Dict[str, jax.Array]] = None
+        self._pass_keys: Optional[np.ndarray] = None
+
+    # -- pass lifecycle ---------------------------------------------------
+
+    def begin_pass(self, keys: np.ndarray) -> int:
+        """PreBuildTask + BuildPull + BuildGPUTask: dedup the pass's keys,
+        pull current values from the host table, upload the working set."""
+        cfg = self.config
+        uniq = np.unique(np.ascontiguousarray(keys, np.uint64))
+        enforce_le(len(uniq), cfg.capacity, "pass working set exceeds cache capacity")
+        self._index = FeasignIndex(len(uniq) * 2)
+        rows, _ = self._index.lookup_or_insert(uniq)
+        self._pass_keys = uniq
+
+        # pull from host table (insert-on-miss: new features get created)
+        pulled = self.table.pull_sparse(uniq, create=True)  # [n, 3+dim] ctr layout
+        n = len(uniq)
+        dim = cfg.embedx_dim
+        host = {
+            "show": np.zeros(cfg.capacity, np.float32),
+            "click": np.zeros(cfg.capacity, np.float32),
+            "embed_w": np.zeros((cfg.capacity, 1), np.float32),
+            "embed_g2sum": np.zeros((cfg.capacity, 1), np.float32),
+            "embedx_w": np.zeros((cfg.capacity, dim), np.float32),
+            "embedx_g2sum": np.zeros((cfg.capacity, 1), np.float32),
+            "has_embedx": np.zeros(cfg.capacity, np.float32),
+        }
+        host["show"][rows] = pulled[:, 0]
+        host["click"][rows] = pulled[:, 1]
+        host["embed_w"][rows, 0] = pulled[:, 2]
+        host["embedx_w"][rows] = pulled[:, 3:]
+        host["has_embedx"][rows] = (np.abs(pulled[:, 3:]).sum(axis=1) > 0).astype(np.float32)
+        # g2sum state comes from the table's accessor state where present
+        self._load_g2sum(host, uniq, rows)
+
+        if self._sharding is not None:
+            self.state = {
+                k: jax.device_put(jnp.asarray(v), self._sharding) for k, v in host.items()
+            }
+        else:
+            self.state = {k: jnp.asarray(v) for k, v in host.items()}
+        return len(uniq)
+
+    def _load_g2sum(self, host: Dict[str, np.ndarray], keys: np.ndarray, rows: np.ndarray) -> None:
+        # reach into table shards for optimizer state (adagrad: 1 float)
+        for s_id in range(self.table.config.shard_num):
+            shard = self.table._shards[s_id]
+            sel = (keys % np.uint64(self.table.config.shard_num)) == s_id
+            if not sel.any():
+                continue
+            t_rows = shard.index.lookup(keys[sel])
+            ok = t_rows >= 0
+            if shard.accessor.embed_rule.state_dim >= 1:
+                host["embed_g2sum"][rows[sel][ok], 0] = shard.block.embed_state[t_rows[ok], 0]
+            if shard.accessor.embedx_rule.state_dim >= 1:
+                host["embedx_g2sum"][rows[sel][ok], 0] = shard.block.embedx_state[t_rows[ok], 0]
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        """Batch keys → cache rows (host-side; feed into the jitted step)."""
+        enforce(self._index is not None, "begin_pass first")
+        rows = self._index.lookup(np.ascontiguousarray(keys, np.uint64))
+        enforce(bool((rows >= 0).all()), "batch contains keys outside the pass working set")
+        return rows
+
+    def end_pass(self) -> None:
+        """EndPass / dump_to_cpu: write the working set back into the host
+        table (values + optimizer state, direct overwrite)."""
+        if self._index is None or self.state is None:
+            return
+        host = {k: np.asarray(v) for k, v in jax.device_get(self.state).items()}
+        keys = self._pass_keys
+        rows = self._index.lookup(keys)
+        for s_id in range(self.table.config.shard_num):
+            shard = self.table._shards[s_id]
+            sel = (keys % np.uint64(self.table.config.shard_num)) == s_id
+            if not sel.any():
+                continue
+            with shard.lock:
+                t_rows, _ = shard.index.lookup_or_insert(keys[sel])
+                shard._ensure_capacity(shard.index.row_capacity)
+                b = shard.block
+                c_rows = rows[sel]
+                # lifecycle stats: cache-trained features were seen this
+                # pass — zero unseen_days and fold the show/click growth
+                # into delta_score (else daily shrink would age out hot
+                # features and delta saves would drop them)
+                acc_cfg = shard.accessor.config
+                d_show = host["show"][c_rows] - b.show[t_rows]
+                d_click = host["click"][c_rows] - b.click[t_rows]
+                b.delta_score[t_rows] += (
+                    (d_show - d_click) * acc_cfg.nonclk_coeff + d_click * acc_cfg.click_coeff
+                )
+                b.unseen_days[t_rows] = 0.0
+                b.show[t_rows] = host["show"][c_rows]
+                b.click[t_rows] = host["click"][c_rows]
+                b.embed_w[t_rows, 0] = host["embed_w"][c_rows, 0]
+                if shard.accessor.embed_rule.state_dim >= 1:
+                    b.embed_state[t_rows, 0] = host["embed_g2sum"][c_rows, 0]
+                has = host["has_embedx"][c_rows] > 0
+                b.embedx_w[t_rows[has]] = host["embedx_w"][c_rows[has]]
+                if shard.accessor.embedx_rule.state_dim >= 1:
+                    b.embedx_state[t_rows[has], 0] = host["embedx_g2sum"][c_rows[has], 0]
+                b.has_embedx[t_rows] |= has
+                shard.mark_initialized(t_rows)
+        self._index = None
+        self.state = None
+        self._pass_keys = None

@@ -1,0 +1,311 @@
+"""ctypes bindings for the native library (csrc/).
+
+Builds ``libpaddle_tpu_native.so`` on first use (make, cached); if the
+toolchain is unavailable, ``FeasignIndex`` falls back to a pure-Python
+dict implementation with identical semantics so the framework stays
+importable (slower, flagged via ``native_available()``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["FeasignIndex", "native_available", "load_native"]
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "csrc")
+_LIB_PATH = os.path.join(_CSRC, "libpaddle_tpu_native.so")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+def load_native() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        try:
+            if not os.path.exists(_LIB_PATH) or _stale():
+                subprocess.run(
+                    ["make", "-s"], cwd=os.path.abspath(_CSRC), check=True,
+                    capture_output=True, timeout=120,
+                )
+            lib = ctypes.CDLL(os.path.abspath(_LIB_PATH))
+            _configure(lib)
+            _LIB = lib
+        except Exception:
+            _LIB = None
+        return _LIB
+
+
+def _stale() -> bool:
+    try:
+        lib_m = os.path.getmtime(_LIB_PATH)
+        return any(
+            os.path.getmtime(os.path.join(_CSRC, f)) > lib_m
+            for f in os.listdir(_CSRC)
+            if f.endswith(".cc")
+        )
+    except OSError:
+        return True
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.psidx_create.restype = ctypes.c_void_p
+    lib.psidx_create.argtypes = [ctypes.c_uint64]
+    lib.psidx_destroy.argtypes = [ctypes.c_void_p]
+    lib.psidx_size.restype = ctypes.c_int64
+    lib.psidx_size.argtypes = [ctypes.c_void_p]
+    lib.psidx_row_capacity.restype = ctypes.c_int64
+    lib.psidx_row_capacity.argtypes = [ctypes.c_void_p]
+    lib.psidx_lookup.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64, i32p]
+    lib.psidx_lookup_or_insert.restype = ctypes.c_int64
+    lib.psidx_lookup_or_insert.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64, i32p]
+    lib.psidx_erase.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64]
+    lib.psidx_items.argtypes = [ctypes.c_void_p, u64p, i32p]
+
+
+def native_available() -> bool:
+    return load_native() is not None
+
+
+def _u64(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def _i32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class FeasignIndex:
+    """Batched feasign→row map (native-backed; python-dict fallback)."""
+
+    def __init__(self, capacity_hint: int = 1024) -> None:
+        self._lib = load_native()
+        if self._lib is not None:
+            self._h = self._lib.psidx_create(ctypes.c_uint64(capacity_hint))
+        else:
+            self._d: dict = {}
+            self._free: list = []
+            self._row_keys: list = []
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_h", None):
+            lib.psidx_destroy(self._h)
+            self._h = None
+
+    def __len__(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.psidx_size(self._h))
+        return len(self._d)
+
+    @property
+    def row_capacity(self) -> int:
+        """Highest row id ever allocated + 1 (size for value arrays)."""
+        if self._lib is not None:
+            return int(self._lib.psidx_row_capacity(self._h))
+        return len(self._row_keys)
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        rows = np.empty(len(keys), np.int32)
+        if self._lib is not None:
+            self._lib.psidx_lookup(self._h, _u64(keys), len(keys), _i32(rows))
+        else:
+            for i, k in enumerate(keys):
+                rows[i] = self._d.get(int(k), -1)
+        return rows
+
+    def lookup_or_insert(self, keys: np.ndarray) -> Tuple[np.ndarray, int]:
+        """Returns (rows, num_new). Insert-on-miss pull semantics."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        rows = np.empty(len(keys), np.int32)
+        if self._lib is not None:
+            n_new = int(
+                self._lib.psidx_lookup_or_insert(self._h, _u64(keys), len(keys), _i32(rows))
+            )
+            return rows, n_new
+        n_new = 0
+        for i, k in enumerate(keys):
+            k = int(k)
+            row = self._d.get(k)
+            if row is None:
+                if self._free:
+                    row = self._free.pop()
+                    self._row_keys[row] = k
+                else:
+                    row = len(self._row_keys)
+                    self._row_keys.append(k)
+                self._d[k] = row
+                n_new += 1
+            rows[i] = row
+        return rows, n_new
+
+    def erase(self, keys: np.ndarray) -> None:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        if self._lib is not None:
+            self._lib.psidx_erase(self._h, _u64(keys), len(keys))
+        else:
+            for k in keys:
+                row = self._d.pop(int(k), None)
+                if row is not None:
+                    self._free.append(row)
+
+    def items(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(keys, rows) of all live entries (save/shrink iteration)."""
+        n = len(self)
+        keys = np.empty(n, np.uint64)
+        rows = np.empty(n, np.int32)
+        if self._lib is not None:
+            self._lib.psidx_items(self._h, _u64(keys), _i32(rows))
+        else:
+            for j, (k, r) in enumerate(self._d.items()):
+                keys[j] = k
+                rows[j] = r
+        return keys, rows
+
+
+def _configure_slotp(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.slotp_create.restype = ctypes.c_void_p
+    lib.slotp_create.argtypes = [ctypes.c_int, u8p, u8p]
+    lib.slotp_destroy.argtypes = [ctypes.c_void_p]
+    lib.slotp_parse.restype = ctypes.c_int64
+    lib.slotp_parse.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+    lib.slotp_lines.restype = ctypes.c_int64
+    lib.slotp_lines.argtypes = [ctypes.c_void_p]
+    lib.slotp_errors.restype = ctypes.c_int64
+    lib.slotp_errors.argtypes = [ctypes.c_void_p]
+    lib.slotp_slot_value_count.restype = ctypes.c_int64
+    lib.slotp_slot_value_count.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.slotp_slot_fetch.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p, i32p]
+    lib.slotp_reset.argtypes = [ctypes.c_void_p]
+
+
+class SlotParser:
+    """Batched MultiSlot text parser (native; Python fallback).
+
+    slots: list of (name, is_float, used). ``parse`` consumes a text
+    block; ``fetch`` returns {slot_name: (values, lengths)} CSR pairs for
+    the used slots and resets for the next block.
+    """
+
+    def __init__(self, slots) -> None:
+        self.slots = [(str(n), bool(f), bool(u)) for n, f, u in slots]
+        self._lib = load_native()
+        if self._lib is not None:
+            if not hasattr(self._lib, "_slotp_configured"):
+                _configure_slotp(self._lib)
+                self._lib._slotp_configured = True
+            is_float = np.asarray([f for _, f, _ in self.slots], np.uint8)
+            used = np.asarray([u for _, _, u in self.slots], np.uint8)
+            self._h = self._lib.slotp_create(
+                len(self.slots),
+                is_float.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                used.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            )
+        else:
+            self._py_rows = []
+            self._py_errors = 0
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_h", None):
+            lib.slotp_destroy(self._h)
+            self._h = None
+
+    def parse(self, text) -> int:
+        data = text.encode() if isinstance(text, str) else bytes(text)
+        if self._lib is not None:
+            return int(self._lib.slotp_parse(self._h, data, len(data)))
+        return self._py_parse(data.decode())
+
+    @property
+    def errors(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.slotp_errors(self._h))
+        return self._py_errors
+
+    @property
+    def lines(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.slotp_lines(self._h))
+        return len(self._py_rows)
+
+    def fetch(self):
+        out = {}
+        if self._lib is not None:
+            n_lines = self.lines
+            for s, (name, is_float, used) in enumerate(self.slots):
+                if not used:
+                    continue
+                count = int(self._lib.slotp_slot_value_count(self._h, s))
+                values = np.empty(count, np.float32 if is_float else np.uint64)
+                lengths = np.empty(n_lines, np.int32)
+                self._lib.slotp_slot_fetch(
+                    self._h, s, values.ctypes.data_as(ctypes.c_void_p),
+                    lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                )
+                out[name] = (values, lengths)
+            self._lib.slotp_reset(self._h)
+            return out
+        # python fallback
+        for s, (name, is_float, used) in enumerate(self.slots):
+            if not used:
+                continue
+            vals, lens = [], []
+            for row in self._py_rows:
+                v = row[s]
+                vals.extend(v)
+                lens.append(len(v))
+            out[name] = (
+                np.asarray(vals, np.float32 if is_float else np.uint64),
+                np.asarray(lens, np.int32),
+            )
+        self._py_rows = []
+        self._py_errors = 0
+        return out
+
+    def _py_parse(self, text: str) -> int:
+        ok = 0
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            toks = line.split()
+            pos = 0
+            row = []
+            good = True
+            for name, is_float, used in self.slots:
+                try:
+                    n = int(toks[pos]); pos += 1
+                    if n < 0:
+                        raise ValueError
+                    vals = toks[pos : pos + n]
+                    if len(vals) != n:
+                        raise ValueError
+                    pos += n
+                    if used:
+                        row.append([float(v) if is_float else int(v) for v in vals])
+                    else:
+                        for v in vals:
+                            float(v)
+                except (ValueError, IndexError):
+                    good = False
+                    break
+            if good:
+                self._py_rows.append(row)
+                ok += 1
+            else:
+                self._py_errors += 1
+        return ok

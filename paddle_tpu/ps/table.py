@@ -1,0 +1,434 @@
+"""Parameter-server tables.
+
+Behavioral rebuild of the reference table stack (SURVEY §2.2):
+``Table`` (distributed/ps/table/table.h:64) with Pull/Push/Load/Save/
+Shrink/Flush; ``MemorySparseTable`` (memory_sparse_table.h:37) — N local
+shards, feasign-routed, insert-on-miss pull; ``MemoryDenseTable``
+(memory_dense_table.h:27) — dense params with server-side optimizers;
+``MemorySparseGeoTable`` — GEO delta records; ``BarrierTable`` /
+``GlobalStepTable`` (barrier_table.cc:76, tensor_table.h:257).
+
+Design differences from the reference (TPU-first, not a translation):
+- values are columnar numpy blocks per shard (SoA) instead of per-row
+  heap allocations — batched vectorized accessor math, zero-copy handoff
+  to device staging;
+- the key→row map is the native C++ FeasignIndex (csrc/sparse_index.cc);
+- shard parallelism uses a thread pool over shards per request rather
+  than per-shard task queues (same serialization guarantee: one thread
+  touches a shard at a time within a request).
+
+Sharding math (Appendix A.4): server = key % num_servers is the client's
+job; within a table, shard = (key % shard_num_total) % local_shard_num.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.enforce import InvalidArgumentError, enforce, enforce_eq
+from .accessor import AccessorConfig, CtrCommonAccessor, FeatureBlock, make_accessor
+from .native import FeasignIndex
+
+__all__ = [
+    "TableConfig",
+    "MemorySparseTable",
+    "MemoryDenseTable",
+    "MemorySparseGeoTable",
+    "BarrierTable",
+    "GlobalStepTable",
+]
+
+_SAVE_MODE_ALL = 0
+_SAVE_MODE_DELTA = 1
+_SAVE_MODE_BASE = 2
+_SAVE_MODE_BATCH = 3
+
+
+@dataclasses.dataclass
+class TableConfig:
+    """Mirrors TableParameter (ps.proto:121)."""
+
+    table_id: int = 0
+    shard_num: int = 16
+    accessor: str = "ctr"
+    accessor_config: Optional[AccessorConfig] = None
+    seed: int = 0
+
+
+class _SparseShard:
+    """One local shard: FeasignIndex + growable columnar FeatureBlock."""
+
+    def __init__(self, accessor: CtrCommonAccessor, seed: int) -> None:
+        self.accessor = accessor
+        self.index = FeasignIndex(1024)
+        self.block = FeatureBlock(0, accessor)
+        self.rng = np.random.default_rng(seed)
+        self.lock = threading.Lock()
+
+    def _ensure_capacity(self, rows_needed: int) -> None:
+        cur = len(self.block.slot)
+        if rows_needed <= cur:
+            return
+        new_cap = max(1024, cur * 2, rows_needed)
+        old = self.block
+        self.block = FeatureBlock(new_cap, self.accessor)
+        for name, arr in vars(old).items():
+            if isinstance(arr, np.ndarray) and len(arr):
+                getattr(self.block, name)[: len(arr)] = arr
+
+    def pull(self, keys: np.ndarray, slots: Optional[np.ndarray], create: bool) -> np.ndarray:
+        with self.lock:
+            if create:
+                rows, n_new = self.index.lookup_or_insert(keys)
+                self._ensure_capacity(self.index.row_capacity)
+                if n_new:
+                    new_mask = self._new_rows_mask(rows)
+                    if new_mask.any():
+                        new_rows = rows[new_mask]
+                        s = slots[new_mask] if slots is not None else np.zeros(len(new_rows), np.int32)
+                        self.accessor.create(self.block, new_rows, s, self.rng)
+                        self.mark_initialized(new_rows)
+            else:
+                rows = self.index.lookup(keys)
+                self._ensure_capacity(self.index.row_capacity)
+            found = rows >= 0
+            out = np.zeros((len(keys), self.accessor.pull_dim), np.float32)
+            if found.any():
+                out[found] = self.accessor.select(self.block, rows[found])
+            return out
+
+    def _new_rows_mask(self, rows: np.ndarray) -> np.ndarray:
+        """First occurrence of each never-initialized row (vectorized).
+        Initialization is tracked explicitly — embed_state==0 is ambiguous."""
+        init = self._initialized
+        _, first_idx = np.unique(rows, return_index=True)
+        first = np.zeros(len(rows), bool)
+        first[first_idx] = True
+        return first & ~init[rows]
+
+    @property
+    def _initialized(self) -> np.ndarray:
+        if not hasattr(self, "_init_arr") or len(self._init_arr) < len(self.block.slot):
+            old = getattr(self, "_init_arr", np.zeros(0, bool))
+            self._init_arr = np.zeros(len(self.block.slot), bool)
+            self._init_arr[: len(old)] = old
+        return self._init_arr
+
+    def mark_initialized(self, rows: np.ndarray) -> None:
+        self._initialized[rows] = True
+
+    def push(self, keys: np.ndarray, push_values: np.ndarray) -> None:
+        with self.lock:
+            rows, _ = self.index.lookup_or_insert(keys)
+            self._ensure_capacity(self.index.row_capacity)
+            new_mask = self._new_rows_mask(rows)
+            if new_mask.any():
+                new_rows = rows[new_mask]
+                slots = push_values[new_mask, 0].astype(np.int32)
+                self.accessor.create(self.block, new_rows, slots, self.rng)
+                self.mark_initialized(new_rows)
+            self.accessor.update(self.block, rows, push_values, self.rng)
+
+    def shrink(self) -> int:
+        with self.lock:
+            keys, rows = self.index.items()
+            if len(rows) == 0:
+                return 0
+            keep = self.accessor.shrink(self.block, rows)
+            dead = keys[~keep]
+            self.index.erase(dead)
+            self._initialized[rows[~keep]] = False
+            return int((~keep).sum())
+
+    def save_items(self, mode: int) -> Tuple[np.ndarray, np.ndarray]:
+        with self.lock:
+            keys, rows = self.index.items()
+            if len(rows) == 0:
+                return keys, rows
+            keep = self.accessor.save_filter(self.block, rows, mode)
+            self.accessor.update_stat_after_save(self.block, rows[keep], mode)
+            return keys[keep], rows[keep]
+
+
+class MemorySparseTable:
+    """Sparse embedding table over N local shards."""
+
+    def __init__(self, config: Optional[TableConfig] = None) -> None:
+        self.config = config or TableConfig()
+        self.accessor: CtrCommonAccessor = make_accessor(
+            self.config.accessor, self.config.accessor_config
+        )
+        self._shards = [
+            _SparseShard(self.accessor, self.config.seed + i)
+            for i in range(self.config.shard_num)
+        ]
+        self._pool = ThreadPoolExecutor(max_workers=min(self.config.shard_num, 8))
+
+    # -- routing ----------------------------------------------------------
+
+    def _route(self, keys: np.ndarray) -> np.ndarray:
+        return (keys % np.uint64(self.config.shard_num)).astype(np.int64)
+
+    def _scatter_gather(self, keys: np.ndarray, fn, *per_key_args):
+        """Group keys by shard, apply fn per shard, regather results."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        shard_ids = self._route(keys)
+        order = np.argsort(shard_ids, kind="stable")
+        bounds = np.searchsorted(shard_ids[order], np.arange(self.config.shard_num + 1))
+        futures = []
+        for s in range(self.config.shard_num):
+            sel = order[bounds[s] : bounds[s + 1]]
+            if len(sel) == 0:
+                continue
+            args = [a[sel] if a is not None else None for a in per_key_args]
+            futures.append((sel, self._pool.submit(fn, self._shards[s], keys[sel], *args)))
+        results = [(sel, f.result()) for sel, f in futures]
+        return results
+
+    # -- Table interface --------------------------------------------------
+
+    def pull_sparse(
+        self, keys: np.ndarray, slots: Optional[np.ndarray] = None, create: bool = True
+    ) -> np.ndarray:
+        """Batched pull with insert-on-miss (memory_sparse_table.cc:443)."""
+        out = np.zeros((len(keys), self.accessor.pull_dim), np.float32)
+        for sel, vals in self._scatter_gather(
+            keys, lambda sh, k, s: sh.pull(k, s, create), slots
+        ):
+            out[sel] = vals
+        return out
+
+    def push_sparse(self, keys: np.ndarray, push_values: np.ndarray) -> None:
+        """Batched push: push_values [n, push_dim] (slot, show, click,
+        embed_g, embedx_g...). Duplicate keys in one push are pre-merged
+        (gradient sum, show/click sum) like the client-side dedup-merge."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        uniq, first_idx, inverse = np.unique(keys, return_index=True, return_inverse=True)
+        if len(uniq) != len(keys):
+            merged = np.zeros((len(uniq), push_values.shape[1]), np.float32)
+            np.add.at(merged, inverse, push_values)
+            # slot is categorical — take first occurrence, not the sum
+            merged[:, 0] = push_values[first_idx, 0]
+            keys, push_values = uniq, merged
+        self._scatter_gather(keys, lambda sh, k, pv: sh.push(k, pv), push_values)
+
+    def shrink(self) -> int:
+        return sum(sh.shrink() for sh in self._shards)
+
+    def size(self) -> int:
+        return sum(len(sh.index) for sh in self._shards)
+
+    def flush(self) -> None:
+        pass  # synchronous writes; parity no-op
+
+    # -- save/load (per-shard text files, Appendix A / SURVEY §5) ---------
+
+    def save(self, dirname: str, mode: int = _SAVE_MODE_ALL) -> int:
+        os.makedirs(dirname, exist_ok=True)
+        total = 0
+        dim = self.accessor.config.embedx_dim
+        for i, sh in enumerate(self._shards):
+            keys, rows = sh.save_items(mode)
+            path = os.path.join(dirname, f"part-{i:05d}.shard")
+            with open(path, "w") as f:
+                for k, r in zip(keys, rows):
+                    b = sh.block
+                    fields = [
+                        str(int(k)),
+                        str(int(b.slot[r])),
+                        f"{b.unseen_days[r]:.6g}",
+                        f"{b.delta_score[r]:.6g}",
+                        f"{b.show[r]:.6g}",
+                        f"{b.click[r]:.6g}",
+                        f"{b.embed_w[r,0]:.8g}",
+                    ]
+                    fields += [f"{v:.8g}" for v in b.embed_state[r]]
+                    if b.has_embedx[r]:
+                        fields += [f"{v:.8g}" for v in b.embedx_w[r]]
+                        fields += [f"{v:.8g}" for v in b.embedx_state[r]]
+                    f.write(" ".join(fields) + "\n")
+                    total += 1
+        with open(os.path.join(dirname, "meta.json"), "w") as f:
+            json.dump(
+                {
+                    "shard_num": self.config.shard_num,
+                    "embedx_dim": dim,
+                    "accessor": self.config.accessor,
+                    "mode": mode,
+                },
+                f,
+            )
+        return total
+
+    def load(self, dirname: str) -> int:
+        with open(os.path.join(dirname, "meta.json")) as f:
+            meta = json.load(f)
+        enforce_eq(meta["embedx_dim"], self.accessor.config.embedx_dim, "embedx_dim mismatch")
+        ed = self.accessor.embed_rule.state_dim
+        xd = self.accessor.config.embedx_dim
+        xs = self.accessor.embedx_rule.state_dim
+        total = 0
+        for i in range(meta["shard_num"]):
+            path = os.path.join(dirname, f"part-{i:05d}.shard")
+            if not os.path.exists(path):
+                continue
+            keys, rows_data = [], []
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    keys.append(np.uint64(parts[0]))
+                    rows_data.append([float(x) for x in parts[1:]])
+            if not keys:
+                continue
+            karr = np.asarray(keys, np.uint64)
+            # re-route by current shard_num (allows re-sharding on load)
+            for s in range(self.config.shard_num):
+                sel = (karr % np.uint64(self.config.shard_num)) == s
+                if not sel.any():
+                    continue
+                sh = self._shards[s]
+                with sh.lock:
+                    rows, _ = sh.index.lookup_or_insert(karr[sel])
+                    sh._ensure_capacity(sh.index.row_capacity)
+                    b = sh.block
+                    for r, data in zip(rows, [rows_data[j] for j in np.where(sel)[0]]):
+                        b.slot[r] = int(data[0])
+                        b.unseen_days[r] = data[1]
+                        b.delta_score[r] = data[2]
+                        b.show[r] = data[3]
+                        b.click[r] = data[4]
+                        b.embed_w[r, 0] = data[5]
+                        b.embed_state[r] = data[6 : 6 + ed]
+                        rest = data[6 + ed :]
+                        if len(rest) >= xd:
+                            b.embedx_w[r] = rest[:xd]
+                            b.embedx_state[r] = rest[xd : xd + xs]
+                            b.has_embedx[r] = True
+                    sh.mark_initialized(rows)
+                    total += len(rows)
+        return total
+
+
+class MemoryDenseTable:
+    """Dense params sharded across servers with server-side optimizers
+    (memory_dense_table.cc: DSGD/DAdam apply). Single-process build keeps
+    the whole dense block; the fleet layer slices per server."""
+
+    def __init__(self, dim: int, optimizer: str = "adam", lr: float = 0.001) -> None:
+        self.dim = dim
+        self.values = np.zeros(dim, np.float32)
+        self.optimizer = optimizer
+        self.lr = lr
+        if optimizer == "adam":
+            self.m = np.zeros(dim, np.float32)
+            self.v = np.zeros(dim, np.float32)
+            self.beta1, self.beta2, self.eps = 0.9, 0.999, 1e-8
+            self.t = 0
+        elif optimizer == "sgd":
+            pass
+        elif optimizer == "sum":  # raw accumulate (GEO/global-step style)
+            pass
+        else:
+            raise InvalidArgumentError(f"unknown dense optimizer {optimizer!r}")
+        self.lock = threading.Lock()
+
+    def pull_dense(self) -> np.ndarray:
+        return self.values.copy()
+
+    def push_dense(self, grad: np.ndarray) -> None:
+        with self.lock:
+            if self.optimizer == "sgd":
+                self.values -= self.lr * grad
+            elif self.optimizer == "sum":
+                self.values += grad
+            else:  # adam
+                self.t += 1
+                self.m = self.beta1 * self.m + (1 - self.beta1) * grad
+                self.v = self.beta2 * self.v + (1 - self.beta2) * grad * grad
+                m_hat = self.m / (1 - self.beta1 ** self.t)
+                v_hat = self.v / (1 - self.beta2 ** self.t)
+                self.values -= self.lr * m_hat / (np.sqrt(v_hat) + self.eps)
+
+    def set_values(self, values: np.ndarray) -> None:
+        with self.lock:
+            self.values[:] = values
+
+
+class MemorySparseGeoTable:
+    """GEO-SGD delta table (memory_sparse_geo_table + geo_recorder):
+    accumulates per-key deltas locally; ``pull_geo`` drains them."""
+
+    def __init__(self, embedding_dim: int) -> None:
+        self.dim = embedding_dim
+        self._index = FeasignIndex(256)
+        self._delta = np.zeros((0, embedding_dim), np.float32)
+        self._count = np.zeros(0, np.int32)
+        self.lock = threading.Lock()
+
+    def push_delta(self, keys: np.ndarray, delta: np.ndarray) -> None:
+        with self.lock:
+            rows, _ = self._index.lookup_or_insert(np.ascontiguousarray(keys, np.uint64))
+            cap = self._index.row_capacity
+            if cap > len(self._delta):
+                grow = max(256, cap)
+                nd = np.zeros((grow, self.dim), np.float32)
+                nc = np.zeros(grow, np.int32)
+                nd[: len(self._delta)] = self._delta
+                nc[: len(self._count)] = self._count
+                self._delta, self._count = nd, nc
+            np.add.at(self._delta, rows, delta)
+            np.add.at(self._count, rows, 1)
+
+    def pull_geo(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Drain: returns (keys, mean deltas) and resets."""
+        with self.lock:
+            keys, rows = self._index.items()
+            if len(keys) == 0:
+                return keys, np.zeros((0, self.dim), np.float32)
+            deltas = self._delta[rows] / np.maximum(self._count[rows], 1)[:, None]
+            self._index.erase(keys)
+            self._delta[rows] = 0
+            self._count[rows] = 0
+            return keys, deltas
+
+
+class BarrierTable:
+    """trainer barrier (barrier_table.cc:76): blocks until all trainers
+    arrive. In-process build uses a threading.Barrier; the distributed
+    service maps arrivals to RPC calls."""
+
+    def __init__(self, trainer_num: int) -> None:
+        self.trainer_num = trainer_num
+        self._barrier = threading.Barrier(trainer_num)
+
+    def barrier(self, timeout: Optional[float] = None) -> None:
+        self._barrier.wait(timeout=timeout)
+
+
+class GlobalStepTable:
+    """global-step accumulator + server-side LR decay hook
+    (tensor_table.h:257 GlobalStepTable runs a decay program; here the
+    decay is a callback on the accumulated step)."""
+
+    def __init__(self, decay_fn=None) -> None:
+        self._step = 0
+        self._decay_fn = decay_fn
+        self.lock = threading.Lock()
+
+    def push_step(self, n: int = 1) -> int:
+        with self.lock:
+            self._step += int(n)
+            if self._decay_fn is not None:
+                self._decay_fn(self._step)
+            return self._step
+
+    @property
+    def step(self) -> int:
+        return self._step

@@ -1,0 +1,142 @@
+"""HBM embedding cache: pass lifecycle, in-graph pull/push math parity
+with the host-table AdaGrad rule, flush-back correctness (reference:
+heter_ps/test_comm.cu pull/push on fake keys + EndPass dump)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ps import (
+    AccessorConfig,
+    CacheConfig,
+    HbmEmbeddingCache,
+    MemorySparseTable,
+    SGDRuleConfig,
+    TableConfig,
+    cache_pull,
+    cache_push,
+)
+
+
+def make_setup(embedx_threshold=0.5, capacity=64):
+    sgd = SGDRuleConfig(learning_rate=0.1, initial_g2sum=3.0)
+    acc = AccessorConfig(embedx_dim=4, embedx_threshold=embedx_threshold, sgd=sgd)
+    table = MemorySparseTable(TableConfig(shard_num=2, accessor_config=acc))
+    cache = HbmEmbeddingCache(
+        table, CacheConfig(capacity=capacity, embedx_dim=4, sgd=sgd,
+                           embedx_threshold=embedx_threshold)
+    )
+    return table, cache
+
+
+def test_pass_lifecycle_pull_push_flush():
+    table, cache = make_setup()
+    keys = np.asarray([10, 20, 30, 40], np.uint64)
+    n = cache.begin_pass(keys)
+    assert n == 4
+
+    rows = cache.lookup(keys)
+    vals = cache_pull(cache.state, jnp.asarray(rows))
+    assert vals.shape == (4, 5)
+
+    # push one gradient step with shows
+    grads = jnp.ones((4, 5), jnp.float32) * 0.5
+    cache.state = jax.jit(
+        lambda st, r, g: cache_push(st, r, g, jnp.ones(4), jnp.ones(4), cache.config)
+    )(cache.state, jnp.asarray(rows), grads)
+
+    after = np.asarray(cache_pull(cache.state, jnp.asarray(rows)))
+    assert np.abs(after[:, 0]).sum() > 0  # embed moved
+
+    cache.end_pass()
+    assert cache.state is None
+    # host table saw the flushed values
+    host_vals = table.pull_sparse(keys)
+    np.testing.assert_allclose(host_vals[:, 0], 1.0, rtol=1e-5)  # shows
+    np.testing.assert_allclose(host_vals[:, 2], after[:, 0], rtol=1e-5)  # embed_w
+
+
+def test_cache_push_matches_host_adagrad():
+    """Device AdaGrad must equal the host sparse_sgd_rule math."""
+    table, cache = make_setup(embedx_threshold=100.0)  # keep embedx lazy
+    keys = np.asarray([7], np.uint64)
+    cache.begin_pass(keys)
+    rows = jnp.asarray(cache.lookup(keys))
+
+    g = 0.3
+    show = 2.0
+    w_before = float(np.asarray(cache.state["embed_w"])[int(rows[0]), 0])
+    cache.state = cache_push(
+        cache.state, rows,
+        jnp.asarray([[g, 0, 0, 0, 0]], jnp.float32),
+        jnp.asarray([show]), jnp.asarray([0.0]), cache.config,
+    )
+    dev_w = float(np.asarray(cache.state["embed_w"])[int(rows[0]), 0])
+
+    # host-side reference math (delta, since init weight is random ±1e-4)
+    scaled = g / show
+    expect = -0.1 * scaled * np.sqrt(3.0 / 3.0)
+    np.testing.assert_allclose(dev_w - w_before, expect, rtol=1e-4)
+    g2 = float(np.asarray(cache.state["embed_g2sum"])[int(rows[0]), 0])
+    np.testing.assert_allclose(g2, scaled * scaled, rtol=1e-5)
+
+
+def test_duplicate_rows_merge_like_reference():
+    """Duplicate keys in a batch merge (sum) before one rule application —
+    the cub merge_grad semantics."""
+    table, cache = make_setup(embedx_threshold=100.0)
+    keys = np.asarray([5], np.uint64)
+    cache.begin_pass(keys)
+    r = int(cache.lookup(keys)[0])
+    w_before = float(np.asarray(cache.state["embed_w"])[r, 0])
+    rows = jnp.asarray([r, r, r])
+    grads = jnp.asarray([[0.1, 0, 0, 0, 0]] * 3, jnp.float32)
+    st = cache_push(cache.state, rows, grads, jnp.ones(3), jnp.zeros(3), cache.config)
+    # one merged update: g_sum=0.3, show_sum=3
+    scaled = 0.3 / 3.0
+    expect = -0.1 * scaled
+    np.testing.assert_allclose(
+        float(np.asarray(st["embed_w"])[r, 0]) - w_before, expect, rtol=1e-4
+    )
+    assert float(np.asarray(st["show"])[r]) == 3.0
+
+
+def test_lazy_embedx_materializes_on_device():
+    table, cache = make_setup(embedx_threshold=2.0)
+    keys = np.asarray([9], np.uint64)
+    cache.begin_pass(keys)
+    r = int(cache.lookup(keys)[0])
+    rows = jnp.asarray([r])
+    # first push: score below threshold (show=1 → score=0.1)
+    st = cache_push(cache.state, rows, jnp.ones((1, 5)) * 0.1,
+                    jnp.ones(1), jnp.zeros(1), cache.config)
+    assert float(np.asarray(st["has_embedx"])[r]) == 0.0
+    # heavy clicks push it over (click_coeff=1)
+    st2 = cache_push(st, rows, jnp.ones((1, 5)) * 0.1,
+                     jnp.asarray([5.0]), jnp.asarray([5.0]), cache.config)
+    assert float(np.asarray(st2["has_embedx"])[r]) == 1.0
+
+
+def test_lookup_outside_pass_raises():
+    table, cache = make_setup()
+    cache.begin_pass(np.asarray([1, 2], np.uint64))
+    with pytest.raises(Exception):
+        cache.lookup(np.asarray([999], np.uint64))
+
+
+def test_roundtrip_preserves_g2sum_across_passes():
+    table, cache = make_setup(embedx_threshold=100.0)
+    keys = np.asarray([11], np.uint64)
+    cache.begin_pass(keys)
+    rows = jnp.asarray(cache.lookup(keys))
+    st = cache_push(cache.state, rows, jnp.asarray([[0.5, 0, 0, 0, 0]]),
+                    jnp.ones(1), jnp.zeros(1), cache.config)
+    g2_first = float(np.asarray(st["embed_g2sum"])[int(rows[0]), 0])
+    cache.state = st
+    cache.end_pass()
+
+    cache.begin_pass(keys)
+    r2 = int(cache.lookup(keys)[0])
+    g2_reloaded = float(np.asarray(cache.state["embed_g2sum"])[r2, 0])
+    np.testing.assert_allclose(g2_reloaded, g2_first, rtol=1e-6)

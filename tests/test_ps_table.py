@@ -1,0 +1,207 @@
+"""PS table stack: sgd rules, accessor lifecycle, sparse/dense/geo/aux
+tables (reference: distributed/test/ sparse_sgd_rule_test.cc,
+ctr_accessor_test.cc, memory_sparse_table_test.cc, dense_table_test.cc,
+barrier_table_test.cc)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.ps.accessor import AccessorConfig, CtrCommonAccessor, SparseAccessor
+from paddle_tpu.ps.sgd_rule import SGDRuleConfig, make_sgd_rule
+from paddle_tpu.ps.table import (
+    BarrierTable,
+    GlobalStepTable,
+    MemoryDenseTable,
+    MemorySparseGeoTable,
+    MemorySparseTable,
+    TableConfig,
+)
+
+
+# -- sgd rules ------------------------------------------------------------
+
+
+def test_naive_rule_update_and_bounds():
+    rule = make_sgd_rule("naive", 4, SGDRuleConfig(learning_rate=1.0, weight_bounds=(-1, 1)))
+    w = np.zeros((2, 4), np.float32)
+    st = np.zeros((2, 0), np.float32)
+    rule.update(w, st, np.full((2, 4), 0.5, np.float32), np.ones(2, np.float32))
+    np.testing.assert_allclose(w, -0.5)
+    rule.update(w, st, np.full((2, 4), 5.0, np.float32), np.ones(2, np.float32))
+    np.testing.assert_allclose(w, -1.0)  # clipped
+
+
+def test_adagrad_rule_shared_g2sum():
+    cfg = SGDRuleConfig(learning_rate=0.1, initial_g2sum=3.0)
+    rule = make_sgd_rule("adagrad", 2, cfg)
+    w = np.zeros((1, 2), np.float32)
+    st = np.zeros((1, 1), np.float32)
+    g = np.asarray([[1.0, 2.0]], np.float32)
+    scale = np.asarray([2.0], np.float32)
+    rule.update(w, st, g, scale)
+    scaled = g / 2.0
+    expect_w = -0.1 * scaled * np.sqrt(3.0 / 3.0)
+    np.testing.assert_allclose(w, expect_w, rtol=1e-6)
+    np.testing.assert_allclose(st[0, 0], np.mean(scaled**2), rtol=1e-6)
+
+
+def test_std_adagrad_per_dim_state():
+    rule = make_sgd_rule("std_adagrad", 3)
+    assert rule.state_dim == 3
+
+
+def test_adam_rule_converges():
+    rule = make_sgd_rule("adam", 4, SGDRuleConfig(learning_rate=0.05))
+    rng = np.random.default_rng(0)
+    w, st = rule.init_value(1, rng)
+    target = np.asarray([[1.0, -1.0, 0.5, 2.0]], np.float32)
+    for _ in range(500):
+        g = w - target
+        rule.update(w, st, g, np.ones(1, np.float32))
+    np.testing.assert_allclose(w, target, atol=0.05)
+
+
+# -- accessor -------------------------------------------------------------
+
+
+def make_push(n, dim, show=1.0, click=0.0, g=0.1, slot=3):
+    push = np.zeros((n, 4 + dim), np.float32)
+    push[:, 0] = slot
+    push[:, 1] = show
+    push[:, 2] = click
+    push[:, 3] = g
+    push[:, 4:] = g
+    return push
+
+
+def test_ctr_accessor_push_updates_stats_and_lazy_embedx():
+    cfg = AccessorConfig(embedx_dim=4, embedx_threshold=5.0)
+    table = MemorySparseTable(TableConfig(shard_num=2, accessor_config=cfg))
+    keys = np.asarray([11, 22, 33], np.uint64)
+    vals = table.pull_sparse(keys)
+    assert vals.shape == (3, table.accessor.pull_dim)
+    # fresh rows: zero show/click, embedx absent
+    np.testing.assert_allclose(vals[:, 0], 0.0)
+    np.testing.assert_allclose(vals[:, 3:], 0.0)
+
+    # below embedx threshold: one click-less push
+    table.push_sparse(keys, make_push(3, 4, show=1.0))
+    v1 = table.pull_sparse(keys)
+    np.testing.assert_allclose(v1[:, 0], 1.0)  # show accumulated
+    np.testing.assert_allclose(v1[:, 3:], 0.0)  # embedx still lazy
+
+    # heavy clicks push score over threshold -> embedx materializes
+    table.push_sparse(keys, make_push(3, 4, show=10.0, click=10.0))
+    v2 = table.pull_sparse(keys)
+    assert np.abs(v2[:, 3:]).sum() > 0
+
+
+def test_sparse_accessor_pull_drops_stats():
+    acc = SparseAccessor(AccessorConfig(embedx_dim=4))
+    assert acc.pull_dim == 5  # embed_w + embedx
+
+
+def test_insert_on_miss_and_no_create_lookup():
+    table = MemorySparseTable(TableConfig(shard_num=4))
+    keys = np.asarray([7, 8], np.uint64)
+    table.pull_sparse(keys, create=True)
+    assert table.size() == 2
+    table.pull_sparse(np.asarray([9], np.uint64), create=False)
+    assert table.size() == 2  # no-create lookup doesn't insert
+
+
+def test_push_merges_duplicate_keys():
+    table = MemorySparseTable(TableConfig(shard_num=2))
+    keys = np.asarray([5, 5, 5], np.uint64)
+    table.push_sparse(keys, make_push(3, 8, show=1.0))
+    v = table.pull_sparse(np.asarray([5], np.uint64))
+    np.testing.assert_allclose(v[0, 0], 3.0)  # shows summed across dups
+
+
+def test_save_load_roundtrip(tmp_path):
+    cfg = AccessorConfig(embedx_dim=4, embedx_threshold=0.5)
+    table = MemorySparseTable(TableConfig(shard_num=4, accessor_config=cfg))
+    keys = np.asarray([101, 202, 303, 404], np.uint64)
+    table.pull_sparse(keys)
+    table.push_sparse(keys, make_push(4, 4, show=5.0, click=3.0))
+    before = table.pull_sparse(keys)
+    n = table.save(str(tmp_path / "model"), mode=0)
+    assert n == 4
+
+    table2 = MemorySparseTable(TableConfig(shard_num=4, accessor_config=cfg))
+    loaded = table2.load(str(tmp_path / "model"))
+    assert loaded == 4
+    after = table2.pull_sparse(keys)
+    np.testing.assert_allclose(after, before, rtol=1e-5)
+
+
+def test_save_mode_delta_filters(tmp_path):
+    cfg = AccessorConfig(embedx_dim=2, base_threshold=5.0, delta_threshold=1.0)
+    table = MemorySparseTable(TableConfig(shard_num=2, accessor_config=cfg))
+    hot = np.asarray([1], np.uint64)
+    cold = np.asarray([2], np.uint64)
+    table.push_sparse(hot, make_push(1, 2, show=20.0, click=10.0))
+    table.push_sparse(cold, make_push(1, 2, show=0.1, click=0.0))
+    n = table.save(str(tmp_path / "delta"), mode=1)
+    assert n == 1  # only the hot feature passes the delta filter
+
+
+def test_shrink_deletes_stale():
+    cfg = AccessorConfig(
+        embedx_dim=2, delete_threshold=0.5, show_click_decay_rate=0.1,
+        delete_after_unseen_days=2,
+    )
+    table = MemorySparseTable(TableConfig(shard_num=2, accessor_config=cfg))
+    keys = np.asarray([1, 2, 3], np.uint64)
+    table.push_sparse(keys, make_push(3, 2, show=0.5))
+    # aggressive decay: one shrink round kills low-score features
+    deleted = table.shrink()
+    assert deleted == 3
+    assert table.size() == 0
+
+
+# -- dense/geo/aux tables -------------------------------------------------
+
+
+def test_dense_table_adam():
+    t = MemoryDenseTable(4, optimizer="adam", lr=0.1)
+    target = np.asarray([1.0, 2.0, -1.0, 0.5], np.float32)
+    for _ in range(300):
+        t.push_dense(t.pull_dense() - target)
+    np.testing.assert_allclose(t.pull_dense(), target, atol=0.05)
+
+
+def test_geo_table_accumulates_and_drains():
+    t = MemorySparseGeoTable(4)
+    keys = np.asarray([1, 2], np.uint64)
+    t.push_delta(keys, np.ones((2, 4), np.float32))
+    t.push_delta(np.asarray([1], np.uint64), np.ones((1, 4), np.float32) * 3)
+    k, d = t.pull_geo()
+    got = {int(kk): dd for kk, dd in zip(k, d)}
+    np.testing.assert_allclose(got[1], 2.0)  # (1+3)/2 pushes
+    np.testing.assert_allclose(got[2], 1.0)
+    k2, _ = t.pull_geo()
+    assert len(k2) == 0  # drained
+
+
+def test_barrier_and_global_step():
+    import threading
+
+    b = BarrierTable(2)
+    done = []
+
+    def worker():
+        b.barrier(timeout=5)
+        done.append(1)
+
+    th = threading.Thread(target=worker)
+    th.start()
+    b.barrier(timeout=5)
+    th.join()
+    assert len(done) == 1
+
+    lrs = []
+    g = GlobalStepTable(decay_fn=lambda s: lrs.append(s))
+    g.push_step(5)
+    g.push_step(3)
+    assert g.step == 8 and lrs == [5, 8]

@@ -1,0 +1,85 @@
+"""MultiSlot parser: format compliance + the corruption cases the review
+found (short lines must not steal tokens; bad lines must roll back all
+slot buffers)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.ps.native import SlotParser, native_available
+
+SLOTS = [("click", False, True), ("feat", False, True), ("dense", True, True)]
+
+
+def make_parser():
+    return SlotParser(SLOTS)
+
+
+def test_basic_parse_and_fetch():
+    p = make_parser()
+    text = "1 1 2 101 102 1 0.5\n1 0 1 103 2 1.5 2.5\n"
+    assert p.parse(text) == 2
+    assert p.errors == 0
+    out = p.fetch()
+    np.testing.assert_array_equal(out["click"][0], [1, 0])
+    np.testing.assert_array_equal(out["click"][1], [1, 1])
+    np.testing.assert_array_equal(out["feat"][0], [101, 102, 103])
+    np.testing.assert_array_equal(out["feat"][1], [2, 1])
+    np.testing.assert_allclose(out["dense"][0], [0.5, 1.5, 2.5])
+    np.testing.assert_array_equal(out["dense"][1], [1, 2])
+
+
+def test_short_line_does_not_steal_next_line():
+    """Line declares 3 ids but has 2 — must fail cleanly, next line intact."""
+    p = make_parser()
+    text = "1 1 3 10 11\n1 0 1 42 1 2.0\n"
+    ok = p.parse(text)
+    assert ok == 1
+    assert p.errors == 1
+    out = p.fetch()
+    np.testing.assert_array_equal(out["feat"][0], [42])
+    np.testing.assert_array_equal(out["click"][0], [0])
+
+
+def test_bad_line_rolls_back_all_slots():
+    """Garbage mid-line: every slot buffer must be restored."""
+    p = make_parser()
+    text = "1 1 2 10 xx 0\n1 1 1 5 1 3.0\n"
+    ok = p.parse(text)
+    assert ok == 1 and p.errors == 1
+    out = p.fetch()
+    np.testing.assert_array_equal(out["feat"][0], [5])
+    np.testing.assert_array_equal(out["feat"][1], [1])
+    np.testing.assert_allclose(out["dense"][0], [3.0])
+
+
+def test_unused_slot_skipped_positionally():
+    p = SlotParser([("a", False, True), ("skip", False, False), ("b", False, True)])
+    text = "1 7 2 999 998 1 8\n"
+    assert p.parse(text) == 1
+    out = p.fetch()
+    assert "skip" not in out
+    np.testing.assert_array_equal(out["a"][0], [7])
+    np.testing.assert_array_equal(out["b"][0], [8])
+
+
+def test_blank_lines_ignored():
+    p = make_parser()
+    assert p.parse("\n\n1 1 1 5 1 1.0\n\n") == 1
+    assert p.errors == 0
+
+
+def test_no_trailing_newline():
+    p = make_parser()
+    assert p.parse("1 1 1 5 1 1.0") == 1
+
+
+def test_multiple_parse_calls_accumulate():
+    p = make_parser()
+    p.parse("1 1 1 5 1 1.0\n")
+    p.parse("1 0 1 6 1 2.0\n")
+    out = p.fetch()
+    np.testing.assert_array_equal(out["feat"][0], [5, 6])
+
+
+def test_native_is_available():
+    assert native_available()  # g++ is baked into this image
