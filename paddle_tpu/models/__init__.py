@@ -1,3 +1,4 @@
 from .lenet import LeNet
+from .ernie import Ernie, ErnieConfig
 
-__all__ = ["LeNet"]
+__all__ = ["LeNet", "Ernie", "ErnieConfig"]

@@ -101,12 +101,14 @@ def ring_attention(
         v_next = coll.shift(v_cur, axis, 1)
         return (out, m, denom, k_next, v_next), None
 
-    # constants entering the scan carry must be marked varying over the
-    # ring axis (they mix with rotated, rank-dependent KV blocks)
-    pv = lambda x: lax.pcast(x, (axis,), to="varying")
+    # constants entering the scan carry must carry the same
+    # varying-manual-axes type as the rotated KV blocks they mix with —
+    # derive them from q so they inherit its full vma set (q may vary
+    # over dp/other axes too when the batch is sharded)
     out0 = jnp.zeros_like(q)  # inherits 'varying' from q
-    m0 = pv(jnp.full((B, H, L), neg_big, jnp.float32))
-    d0 = pv(jnp.zeros((B, H, L), jnp.float32))
+    zeros_bhl = jnp.sum(q, axis=-1).transpose(0, 2, 1).astype(jnp.float32) * 0.0
+    m0 = zeros_bhl + neg_big
+    d0 = zeros_bhl
     # P-1 rotate-and-merge steps in the scan, then merge the final block
     # outside it — the last rotation's result would be discarded, and a
     # full-KV ppermute per layer is real ICI bandwidth
