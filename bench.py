@@ -1,0 +1,102 @@
+"""Headline benchmark: DeepFM/Criteo-shaped samples/sec/chip.
+
+BASELINE.json metric: "PaddleRec DeepFM Criteo samples/sec/chip". The
+reference publishes no absolute numbers (SURVEY §6 — README claims are
+qualitative, `published: {}`), so `vs_baseline` is reported against a
+1.0e6 samples/s/chip proxy for the GPUPS-on-A100 path the north star
+wants ≥2× of.
+
+What runs: the full GPUPS-style training step — host feasign→row lookup
+(native C index), then ONE jitted XLA program doing embedding pull
+(gather), DeepFM fwd/bwd, dense Adam update, and the per-feature CTR
+AdaGrad sparse push (scatter) on the HBM-resident cache. Criteo shape:
+26 sparse slots, 13 dense features, embedx_dim=8, DNN 400×400×400.
+
+Prints exactly one JSON line.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM, make_ctr_train_step
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.embedding_cache import CacheConfig, HbmEmbeddingCache
+    from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+    batch = int(os.environ.get("BENCH_BATCH", 4096))
+    steps = int(os.environ.get("BENCH_STEPS", 30))
+    warmup = int(os.environ.get("BENCH_WARMUP", 5))
+    pass_keys = int(os.environ.get("BENCH_PASS_KEYS", 1 << 20))
+
+    cfg = CtrConfig(num_sparse_slots=26, num_dense=13, embedx_dim=8,
+                    dnn_hidden=(400, 400, 400))
+    cache_cfg = CacheConfig(capacity=1 << 21, embedx_dim=cfg.embedx_dim,
+                            embedx_threshold=0.0)
+
+    pt.seed(0)
+    rng = np.random.default_rng(0)
+
+    table = MemorySparseTable(TableConfig(
+        shard_num=16, accessor_config=AccessorConfig(embedx_dim=cfg.embedx_dim)))
+    cache = HbmEmbeddingCache(table, cache_cfg)
+
+    # pass working set: `pass_keys` distinct feasigns, slot-tagged
+    pool = rng.integers(0, pass_keys // 26 + 1, size=(pass_keys, 26)).astype(np.uint64)
+    pool += np.arange(26, dtype=np.uint64) << np.uint64(32)
+    cache.begin_pass(pool.reshape(-1))
+
+    model = DeepFM(cfg)
+    opt = optimizer.Adam(learning_rate=1e-3)
+    params = {"params": dict(model.named_parameters()), "buffers": {}}
+    opt_state = opt.init(params)
+    step = make_ctr_train_step(model, opt, cache_cfg)
+
+    # pre-generate host-side batches (data pipeline measured separately;
+    # the reference's dataset feed is also an async producer)
+    n_batches = 8
+    batches = []
+    for b in range(n_batches):
+        idx = rng.integers(0, pass_keys, size=batch)
+        keys = pool[idx]
+        dense = rng.normal(size=(batch, cfg.num_dense)).astype(np.float32)
+        labels = (rng.random(batch) < 0.3).astype(np.int32)
+        batches.append((keys, dense, labels))
+
+    def run_one(i):
+        keys, dense, labels = batches[i % n_batches]
+        rows = jnp.asarray(cache.lookup(keys.reshape(-1)).reshape(keys.shape))
+        return step(params, opt_state, cache.state, rows,
+                    jnp.asarray(dense), jnp.asarray(labels))
+
+    for i in range(warmup):
+        params, opt_state, cache.state, loss = run_one(i)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(steps):
+        params, opt_state, cache.state, loss = run_one(i)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    samples_per_sec = batch * steps / dt
+    baseline = 1.0e6  # proxy: GPUPS-on-A100 class throughput (north star ≥2×)
+    print(json.dumps({
+        "metric": "deepfm_criteo_samples_per_sec_per_chip",
+        "value": round(samples_per_sec, 1),
+        "unit": "samples/s",
+        "vs_baseline": round(samples_per_sec / baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,4 +1,6 @@
 from .lenet import LeNet
 from .ernie import Ernie, ErnieConfig
+from .ctr import CtrConfig, DeepFM, WideDeep, make_ctr_train_step
 
-__all__ = ["LeNet", "Ernie", "ErnieConfig"]
+__all__ = ["LeNet", "Ernie", "ErnieConfig",
+           "CtrConfig", "DeepFM", "WideDeep", "make_ctr_train_step"]

@@ -1,0 +1,154 @@
+"""CTR model family: DeepFM and Wide&Deep over the sparse PS path.
+
+Reference ladder rungs 3-4 (/root/repo/BASELINE.json): "DeepFM on Criteo
+(PaddleRec, Fleet the_one_ps parameter-server mode)" and "Wide&Deep
+trillion-feature CTR (HeterPS / GPUPS sparse embedding path)". The
+reference runs these as static programs whose ``distributed_lookup_table``
+/ ``pull_gpups_sparse`` ops call the PS; here the whole step — embedding
+pull (gather), dense fwd/bwd, dense update, and the per-feature CTR
+AdaGrad push (scatter) — is ONE jitted XLA program over the HBM cache
+state (ps/embedding_cache.py), reproducing the GPUPS pass model
+(ps_gpu_wrapper.cc:759 build_task / :825 PullSparse / :893 PushSparseGrad)
+with the compiler scheduling what HeterComm hand-routed.
+
+Semantics kept for parity: show=1 per example-slot, click=label
+(FleetWrapper::PushSparseFromTensorAsync fills show/click this way,
+ps/wrapper/fleet.cc), first-order weight = embed_w, second-order/deep
+embedding = embedx_w.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.enforce import enforce, enforce_eq
+from ..nn.layer import Layer
+from ..ps.embedding_cache import CacheConfig, cache_pull, cache_push
+
+__all__ = ["CtrConfig", "DeepFM", "WideDeep", "make_ctr_train_step"]
+
+
+@dataclasses.dataclass
+class CtrConfig:
+    num_sparse_slots: int = 26       # Criteo categorical slots
+    num_dense: int = 13              # Criteo continuous features
+    embedx_dim: int = 8
+    dnn_hidden: Tuple[int, ...] = (400, 400, 400)
+
+
+class _DNN(Layer):
+    def __init__(self, in_dim: int, hidden: Tuple[int, ...]) -> None:
+        super().__init__()
+        dims = (in_dim,) + tuple(hidden) + (1,)
+        self.layers = nn.LayerList(
+            [nn.Linear(dims[i], dims[i + 1]) for i in range(len(dims) - 1)]
+        )
+
+    def forward(self, x: jax.Array) -> jax.Array:
+        for i, lin in enumerate(self.layers):
+            x = lin(x)
+            if i + 1 < len(self.layers):
+                x = nn.functional.relu(x)
+        return x[..., 0]
+
+
+class DeepFM(Layer):
+    """FM (first + second order over slot embeddings) + DNN tower
+    (PaddleRec models/rank/deepfm semantics).
+
+    forward(emb, dense_x): ``emb`` is the pulled [B, S, 1+dim] block
+    (embed_w ++ embedx_w per slot) — the embedding table itself lives in
+    the PS cache, not in this layer."""
+
+    def __init__(self, cfg: CtrConfig) -> None:
+        super().__init__()
+        self.cfg = cfg
+        self.dense_lin = nn.Linear(cfg.num_dense, 1)
+        self.dnn = _DNN(cfg.num_sparse_slots * cfg.embedx_dim + cfg.num_dense,
+                        cfg.dnn_hidden)
+
+    def forward(self, emb: jax.Array, dense_x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        w1 = emb[..., 0]                      # [B, S] first-order weights
+        v = emb[..., 1:]                      # [B, S, dim]
+        first = jnp.sum(w1, axis=-1)
+        sum_v = jnp.sum(v, axis=1)            # [B, dim]
+        sum_sq = jnp.sum(v * v, axis=1)
+        second = 0.5 * jnp.sum(sum_v * sum_v - sum_sq, axis=-1)
+        deep_in = jnp.concatenate(
+            [v.reshape(v.shape[0], cfg.num_sparse_slots * cfg.embedx_dim),
+             dense_x], axis=-1)
+        deep = self.dnn(deep_in)
+        return first + second + deep + self.dense_lin(dense_x)[..., 0]
+
+
+class WideDeep(Layer):
+    """Wide (first-order sparse + dense linear) & Deep (DNN over
+    embeddings) — PaddleRec models/rank/wide_deep semantics, the HeterPS
+    trillion-feature workload."""
+
+    def __init__(self, cfg: CtrConfig) -> None:
+        super().__init__()
+        self.cfg = cfg
+        self.wide = nn.Linear(cfg.num_dense, 1)
+        self.dnn = _DNN(cfg.num_sparse_slots * cfg.embedx_dim + cfg.num_dense,
+                        cfg.dnn_hidden)
+
+    def forward(self, emb: jax.Array, dense_x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        wide = jnp.sum(emb[..., 0], axis=-1) + self.wide(dense_x)[..., 0]
+        v = emb[..., 1:]
+        deep_in = jnp.concatenate(
+            [v.reshape(v.shape[0], cfg.num_sparse_slots * cfg.embedx_dim),
+             dense_x], axis=-1)
+        return wide + self.dnn(deep_in)
+
+
+def make_ctr_train_step(
+    model: Layer,
+    optimizer,
+    cache_cfg: CacheConfig,
+    donate: bool = True,
+) -> Callable:
+    """Build the jitted GPUPS-style step:
+
+    step(params, opt_state, cache_state, rows, dense_x, labels)
+      → (params, opt_state, cache_state, loss)
+
+    ``rows``: [B, S] cache-row ids from ``HbmEmbeddingCache.lookup``.
+    Embedding pull, dense fwd/bwd+update, and the CTR AdaGrad sparse push
+    (show=1, click=label) compile into one XLA program; cache/opt/param
+    buffers are donated so HBM is updated in place.
+    """
+
+    def step(params, opt_state, cache_state, rows, dense_x, labels):
+        B, S = rows.shape
+        flat_rows = rows.reshape(-1)
+
+        def loss_fn(params, emb):
+            out, _ = nn.functional_call(model, params, emb, dense_x,
+                                        training=True)
+            loss = nn.functional.binary_cross_entropy_with_logits(
+                out, labels.astype(jnp.float32))
+            return loss, out
+
+        emb = cache_pull(cache_state, flat_rows).reshape(B, S, -1)
+        (loss, logits), (grads, emb_grad) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True)(params, emb)
+
+        new_params, new_opt = optimizer.update(grads, opt_state, params)
+
+        shows = jnp.ones((B * S,), jnp.float32)
+        clicks = jnp.repeat(labels.astype(jnp.float32), S)
+        new_cache = cache_push(cache_state, flat_rows,
+                               emb_grad.reshape(B * S, -1), shows, clicks,
+                               cache_cfg)
+        return new_params, new_opt, new_cache, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
