@@ -1,3 +1,5 @@
 from .loader import DataLoader, TensorDataset
+from .dataset import DataGenerator, InMemoryDataset, QueueDataset, SlotDesc
 
-__all__ = ["DataLoader", "TensorDataset"]
+__all__ = ["DataLoader", "TensorDataset",
+           "DataGenerator", "InMemoryDataset", "QueueDataset", "SlotDesc"]

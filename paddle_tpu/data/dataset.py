@@ -1,0 +1,301 @@
+"""Slot-record dataset pipeline.
+
+Rebuild of the reference's C++ data layer (SURVEY §2.1 L7): `Dataset` /
+`InMemoryDataset` / `QueueDataset` (data_set.h:47,170,328) fed by the
+MultiSlot text format (data_feed.cc:893 ParseOneInstance — §A.5) with
+in-memory local/global shuffle and channel→batch delivery, plus the
+Python `fleet.data_generator` emit side
+(fleet/data_generator/data_generator.py).
+
+TPU-first differences:
+- records are SoA per slot (values + per-record lengths) — the
+  SlotRecord compact representation (data_feed.h:1390), not per-instance
+  object trees; parsing is the native C parser (csrc/slot_parser.cc);
+- batches come out as fixed-shape numpy arrays (padded/truncated to a
+  per-slot max) so the jitted step sees one shape — XLA's static-shape
+  requirement; the reference's GPU path packs batches the same way
+  (MiniBatchGpuPack, data_feed.h:528);
+- global shuffle hash-partitions records by line hash across workers
+  through a user-provided exchange function (the GlooWrapper all-to-all
+  role) and falls back to local shuffle when absent.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob as _glob
+import hashlib
+import sys
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.enforce import enforce, enforce_eq
+from ..ps.native import SlotParser
+
+__all__ = ["SlotDesc", "DataGenerator", "InMemoryDataset", "QueueDataset"]
+
+
+@dataclasses.dataclass
+class SlotDesc:
+    """One slot of the MultiSlot schema (DataFeedDesc.multi_slot_desc)."""
+
+    name: str
+    is_float: bool = False
+    is_used: bool = True
+    max_len: int = 1          # batch padding length (CTR slots are len-1)
+
+
+class DataGenerator:
+    """fleet.data_generator compatible emitter: subclass and implement
+    ``generate_sample(line)`` → iterator yielding ``[(slot, [values])]``;
+    ``run_from_stdin`` serializes to MultiSlot text lines."""
+
+    def __init__(self) -> None:
+        self._batch = 1
+
+    def set_batch(self, batch: int) -> None:
+        self._batch = batch
+
+    def generate_sample(self, line: Optional[str]):
+        raise NotImplementedError
+
+    def _serialize(self, sample: Sequence[Tuple[str, Sequence[Any]]]) -> str:
+        parts: List[str] = []
+        for _, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(str(v) for v in values)
+        return " ".join(parts)
+
+    def run_from_stdin(self, fin=None, fout=None) -> None:
+        fin = fin or sys.stdin
+        fout = fout or sys.stdout
+        for line in fin:
+            it = self.generate_sample(line)
+            for sample in it() if callable(it) else it:
+                fout.write(self._serialize(sample) + "\n")
+
+    def run_from_memory(self, lines: Optional[Sequence[str]] = None) -> List[str]:
+        out: List[str] = []
+        for line in (lines if lines is not None else [None]):
+            it = self.generate_sample(line)
+            for sample in it() if callable(it) else it:
+                out.append(self._serialize(sample))
+        return out
+
+
+class _SlotColumns:
+    """SoA storage for parsed records of one file chunk."""
+
+    def __init__(self, slots: Sequence[SlotDesc], parsed: Dict[str, tuple]) -> None:
+        self.values = {s.name: parsed[s.name][0] for s in slots if s.is_used}
+        self.lengths = {s.name: parsed[s.name][1] for s in slots if s.is_used}
+        names = [s.name for s in slots if s.is_used]
+        self.num = len(self.lengths[names[0]]) if names else 0
+
+
+class _RecordStore:
+    """All loaded records as per-slot value arrays + offsets; supports
+    permutation (shuffle) and slicing into batches."""
+
+    def __init__(self, slots: Sequence[SlotDesc]) -> None:
+        self.slots = [s for s in slots if s.is_used]
+        self._vals: Dict[str, List[np.ndarray]] = {s.name: [] for s in self.slots}
+        self._lens: Dict[str, List[np.ndarray]] = {s.name: [] for s in self.slots}
+        self.num_records = 0
+
+    def append(self, cols: _SlotColumns) -> None:
+        for s in self.slots:
+            self._vals[s.name].append(cols.values[s.name])
+            self._lens[s.name].append(cols.lengths[s.name])
+        self.num_records += cols.num
+
+    def finalize(self) -> None:
+        for s in self.slots:
+            self._vals[s.name] = [np.concatenate(self._vals[s.name])] if self._vals[s.name] else [
+                np.zeros(0, np.float32 if s.is_float else np.uint64)]
+            self._lens[s.name] = [np.concatenate(self._lens[s.name])] if self._lens[s.name] else [
+                np.zeros(0, np.int32)]
+
+    def _offsets(self, name: str) -> np.ndarray:
+        lens = self._lens[name][0]
+        off = np.zeros(len(lens) + 1, np.int64)
+        np.cumsum(lens, out=off[1:])
+        return off
+
+    def permute(self, perm: np.ndarray) -> None:
+        for s in self.slots:
+            off = self._offsets(s.name)
+            lens = self._lens[s.name][0]
+            vals = self._vals[s.name][0]
+            starts = off[:-1][perm]
+            new_lens = lens[perm]
+            # gather variable-length rows under the permutation
+            idx = np.repeat(starts, new_lens) + (
+                np.arange(int(new_lens.sum())) -
+                np.repeat(np.concatenate([[0], np.cumsum(new_lens)[:-1]]), new_lens))
+            self._vals[s.name][0] = vals[idx]
+            self._lens[s.name][0] = new_lens
+
+    def batch(self, lo: int, hi: int) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+        """Fixed-shape batch: values padded/truncated to slot.max_len."""
+        out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        n = hi - lo
+        for s in self.slots:
+            off = self._offsets(s.name)
+            lens = self._lens[s.name][0][lo:hi]
+            vals = self._vals[s.name][0]
+            dtype = np.float32 if s.is_float else np.uint64
+            padded = np.zeros((n, s.max_len), dtype)
+            take = np.minimum(lens, s.max_len)
+            for_i = np.arange(n)
+            mask_rows = np.repeat(for_i, take)
+            col_idx = np.concatenate([np.arange(t) for t in take]) if n else np.zeros(0, np.int64)
+            src_idx = np.repeat(off[lo:hi], take) + col_idx
+            padded[mask_rows, col_idx] = vals[src_idx.astype(np.int64)]
+            out[s.name] = (padded, take.astype(np.int32))
+        return out
+
+    def feasigns(self) -> np.ndarray:
+        keys = [self._vals[s.name][0] for s in self.slots if not s.is_float]
+        return np.concatenate(keys) if keys else np.zeros(0, np.uint64)
+
+
+class InMemoryDataset:
+    """data_set.h InMemoryDataset analogue: load files, shuffle, batch.
+
+    Usage (mirrors fleet dataset API):
+        ds = InMemoryDataset(slots)
+        ds.set_filelist(["part-*"])
+        ds.load_into_memory()
+        ds.local_shuffle()            # or ds.global_shuffle(exchange_fn)
+        for batch in ds.batch_iter(4096): ...
+    """
+
+    def __init__(self, slots: Sequence[SlotDesc], seed: int = 0) -> None:
+        self.slots = list(slots)
+        self._files: List[str] = []
+        self._store: Optional[_RecordStore] = None
+        self._rng = np.random.default_rng(seed)
+        self.parse_errors = 0
+
+    # -- config -----------------------------------------------------------
+
+    def set_filelist(self, patterns: Sequence[str]) -> None:
+        files: List[str] = []
+        for p in patterns:
+            hit = sorted(_glob.glob(p))
+            files.extend(hit if hit else [p])
+        self._files = files
+
+    # -- load -------------------------------------------------------------
+
+    def _parse_text(self, text: str) -> _SlotColumns:
+        p = SlotParser([(s.name, s.is_float, s.is_used) for s in self.slots])
+        p.parse(text)
+        self.parse_errors += p.errors
+        return _SlotColumns(self.slots, p.fetch())
+
+    def load_into_memory(self) -> int:
+        store = _RecordStore(self.slots)
+        for f in self._files:
+            with open(f, "r") as fh:
+                store.append(self._parse_text(fh.read()))
+        store.finalize()
+        self._store = store
+        return store.num_records
+
+    def load_from_lines(self, lines: Sequence[str]) -> int:
+        """Feed pre-generated MultiSlot lines (DataGenerator output)."""
+        store = _RecordStore(self.slots)
+        store.append(self._parse_text("\n".join(lines) + ("\n" if lines else "")))
+        store.finalize()
+        self._store = store
+        return store.num_records
+
+    # -- shuffle ----------------------------------------------------------
+
+    def local_shuffle(self) -> None:
+        enforce(self._store is not None, "load_into_memory first")
+        perm = self._rng.permutation(self._store.num_records)
+        self._store.permute(perm)
+
+    def global_shuffle(
+        self,
+        exchange: Optional[Callable[[List[List[int]]], None]] = None,
+        worker_id: int = 0,
+        worker_num: int = 1,
+    ) -> None:
+        """Hash-partition records across workers then shuffle locally.
+
+        ``exchange(partitions)`` ships record-index partitions to peers and
+        ingests theirs (the GlooWrapper global-shuffle role); without it
+        (single worker) this reduces to a seeded local shuffle keyed by
+        record hash, matching the reference's determinism property."""
+        enforce(self._store is not None, "load_into_memory first")
+        if worker_num <= 1 or exchange is None:
+            self.local_shuffle()
+            return
+        n = self._store.num_records
+        owner = np.array([hash((worker_id, i)) % worker_num for i in range(n)])
+        partitions = [list(np.nonzero(owner == w)[0]) for w in range(worker_num)]
+        exchange(partitions)
+        self.local_shuffle()
+
+    # -- consume ----------------------------------------------------------
+
+    @property
+    def num_records(self) -> int:
+        return self._store.num_records if self._store else 0
+
+    def pass_feasigns(self) -> np.ndarray:
+        """All uint64 feasigns of the loaded pass (for cache.begin_pass —
+        the PreBuildTask dedup input)."""
+        enforce(self._store is not None, "load_into_memory first")
+        return self._store.feasigns()
+
+    def batch_iter(self, batch_size: int, drop_last: bool = True
+                   ) -> Iterator[Dict[str, Tuple[np.ndarray, np.ndarray]]]:
+        enforce(self._store is not None, "load_into_memory first")
+        n = self._store.num_records
+        end = n - (n % batch_size) if drop_last else n
+        for lo in range(0, end, batch_size):
+            yield self._store.batch(lo, min(lo + batch_size, n))
+
+    def release_memory(self) -> None:
+        self._store = None
+
+
+class QueueDataset:
+    """Streaming variant (data_set.h QueueDataset): parse files chunk by
+    chunk, yield batches without materializing the pass; no shuffle."""
+
+    def __init__(self, slots: Sequence[SlotDesc], chunk_lines: int = 65536) -> None:
+        self.slots = list(slots)
+        self.chunk_lines = chunk_lines
+        self._files: List[str] = []
+        self.parse_errors = 0
+
+    def set_filelist(self, patterns: Sequence[str]) -> None:
+        files: List[str] = []
+        for p in patterns:
+            hit = sorted(_glob.glob(p))
+            files.extend(hit if hit else [p])
+        self._files = files
+
+    def batch_iter(self, batch_size: int
+                   ) -> Iterator[Dict[str, Tuple[np.ndarray, np.ndarray]]]:
+        carry: List[str] = []
+        for f in self._files:
+            with open(f, "r") as fh:
+                while True:
+                    lines = fh.readlines(self.chunk_lines * 64)
+                    if not lines:
+                        break
+                    carry.extend(lines)
+                    while len(carry) >= batch_size:
+                        chunk, carry = carry[:batch_size], carry[batch_size:]
+                        ds = InMemoryDataset(self.slots)
+                        ds.load_from_lines([l.rstrip("\n") for l in chunk])
+                        self.parse_errors += ds.parse_errors
+                        yield ds._store.batch(0, ds.num_records)
