@@ -1,6 +1,9 @@
 from .lenet import LeNet
 from .ernie import Ernie, ErnieConfig
 from .ctr import CtrConfig, DeepFM, WideDeep, make_ctr_train_step
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
 
 __all__ = ["LeNet", "Ernie", "ErnieConfig",
-           "CtrConfig", "DeepFM", "WideDeep", "make_ctr_train_step"]
+           "CtrConfig", "DeepFM", "WideDeep", "make_ctr_train_step",
+           "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+           "resnet152"]

@@ -1,0 +1,119 @@
+"""ResNet family (reference: ``python/paddle/vision/models/resnet.py``).
+
+Ladder rung 2 (/root/repo/BASELINE.json): "ResNet-50 ImageNet". NCHW
+layout like the reference API; under jit XLA re-lays-out convolutions for
+the MXU, so the Python-visible layout is a pure API choice.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Type
+
+from .. import nn
+
+__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152"]
+
+
+class BasicBlock(nn.Layer):
+    expansion = 1
+
+    def __init__(self, in_ch: int, ch: int, stride: int = 1,
+                 downsample: Optional[nn.Layer] = None) -> None:
+        super().__init__()
+        self.conv1 = nn.Conv2D(in_ch, ch, 3, stride=stride, padding=1, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(ch)
+        self.conv2 = nn.Conv2D(ch, ch, 3, stride=1, padding=1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(ch)
+        self.relu = nn.ReLU()
+        if downsample is not None:
+            self.downsample = downsample
+        self._has_down = downsample is not None
+
+    def forward(self, x):
+        identity = self.downsample(x) if self._has_down else x
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        return self.relu(y + identity)
+
+
+class BottleneckBlock(nn.Layer):
+    expansion = 4
+
+    def __init__(self, in_ch: int, ch: int, stride: int = 1,
+                 downsample: Optional[nn.Layer] = None) -> None:
+        super().__init__()
+        self.conv1 = nn.Conv2D(in_ch, ch, 1, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(ch)
+        self.conv2 = nn.Conv2D(ch, ch, 3, stride=stride, padding=1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(ch)
+        self.conv3 = nn.Conv2D(ch, ch * 4, 1, bias_attr=False)
+        self.bn3 = nn.BatchNorm2D(ch * 4)
+        self.relu = nn.ReLU()
+        if downsample is not None:
+            self.downsample = downsample
+        self._has_down = downsample is not None
+
+    def forward(self, x):
+        identity = self.downsample(x) if self._has_down else x
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        return self.relu(y + identity)
+
+
+class ResNet(nn.Layer):
+    def __init__(self, block: Type, depth_cfg: List[int],
+                 num_classes: int = 1000, in_channels: int = 3) -> None:
+        super().__init__()
+        self.conv1 = nn.Conv2D(in_channels, 64, 7, stride=2, padding=3, bias_attr=False)
+        self.bn1 = nn.BatchNorm2D(64)
+        self.relu = nn.ReLU()
+        self.maxpool = nn.MaxPool2D(3, 2, padding=1)
+        self._in_ch = 64
+        self.layer1 = self._make_layer(block, 64, depth_cfg[0], 1)
+        self.layer2 = self._make_layer(block, 128, depth_cfg[1], 2)
+        self.layer3 = self._make_layer(block, 256, depth_cfg[2], 2)
+        self.layer4 = self._make_layer(block, 512, depth_cfg[3], 2)
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.fc = nn.Linear(512 * block.expansion, num_classes)
+
+    def _make_layer(self, block: Type, ch: int, depth: int, stride: int) -> nn.Layer:
+        downsample = None
+        if stride != 1 or self._in_ch != ch * block.expansion:
+            downsample = nn.Sequential(
+                nn.Conv2D(self._in_ch, ch * block.expansion, 1, stride=stride,
+                          bias_attr=False),
+                nn.BatchNorm2D(ch * block.expansion),
+            )
+        layers = [block(self._in_ch, ch, stride, downsample)]
+        self._in_ch = ch * block.expansion
+        for _ in range(1, depth):
+            layers.append(block(self._in_ch, ch))
+        return nn.Sequential(*layers)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        x = self.avgpool(x)
+        x = x.reshape(x.shape[0], -1)
+        return self.fc(x)
+
+
+def resnet18(num_classes: int = 1000) -> ResNet:
+    return ResNet(BasicBlock, [2, 2, 2, 2], num_classes)
+
+
+def resnet34(num_classes: int = 1000) -> ResNet:
+    return ResNet(BasicBlock, [3, 4, 6, 3], num_classes)
+
+
+def resnet50(num_classes: int = 1000) -> ResNet:
+    return ResNet(BottleneckBlock, [3, 4, 6, 3], num_classes)
+
+
+def resnet101(num_classes: int = 1000) -> ResNet:
+    return ResNet(BottleneckBlock, [3, 4, 23, 3], num_classes)
+
+
+def resnet152(num_classes: int = 1000) -> ResNet:
+    return ResNet(BottleneckBlock, [3, 8, 36, 3], num_classes)

@@ -1,0 +1,39 @@
+"""ResNet family: forward shapes and a few training steps (ladder rung 2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer
+from paddle_tpu.models.resnet import resnet18, resnet50
+
+
+def test_resnet50_forward_shape():
+    pt.seed(0)
+    m = resnet50(num_classes=10)
+    x = jnp.zeros((2, 3, 64, 64), jnp.float32)
+    out = m(x)
+    assert out.shape == (2, 10)
+    n_params = sum(int(np.prod(p.shape)) for p in m.parameters())
+    # ~25.6M at 1000 classes; with 10-class fc head: ~23.5M
+    assert 20_000_000 < n_params < 30_000_000
+
+
+def test_resnet18_trains():
+    pt.seed(1)
+    m = resnet18(num_classes=4)
+    opt = optimizer.Momentum(learning_rate=0.05)
+    step = pt.make_train_step(m, opt, nn.CrossEntropyLoss())
+    state = nn.get_state(m)
+    opt_state = opt.init(state["params"])
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(8, 3, 32, 32)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 4, size=8).astype(np.int32))
+    key = jax.random.key(0)
+    first = last = None
+    for _ in range(5):
+        state, opt_state, loss = step(state, opt_state, key, (x,), (y,))
+        first = first if first is not None else float(loss)
+        last = float(loss)
+    assert last < first
