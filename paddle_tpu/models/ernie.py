@@ -40,9 +40,11 @@ from .. import nn
 from ..core.enforce import enforce, enforce_eq
 from ..nn.layer import Layer
 from ..ops import collectives as coll
+from ..ops.flash_attention import flash_attention
 from ..parallel.mp_layers import _axis_active
 from ..parallel.moe import top1_gate, top2_gate
-from ..parallel.ring_attention import local_attention, ring_attention
+from ..parallel.ring_attention import (local_attention, ring_attention,
+                                       ring_flash_attention)
 
 __all__ = ["ErnieConfig", "ErnieEmbedding", "ErnieBlock", "ErnieStage",
            "ErnieHead", "Ernie", "parallel_cross_entropy", "partition_spec"]
@@ -66,6 +68,8 @@ class ErnieConfig:
     mp_axis: Optional[str] = "mp"
     cp_axis: Optional[str] = "cp"
     ep_axis: Optional[str] = "ep"
+    # attention impl: "auto" = Pallas flash kernel on TPU, einsum elsewhere
+    attn_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -166,8 +170,14 @@ class _SelfAttention(Layer):
         H_local = y.shape[-1] // (3 * D)
         y = y.reshape(y.shape[0], L, H_local, 3, D)
         q, k, v = y[..., 0, :], y[..., 1, :], y[..., 2, :]
+        impl = cfg.attn_impl
+        if impl == "auto":
+            impl = "flash" if jax.default_backend() == "tpu" else "einsum"
         if _axis_active(cfg.cp_axis):
-            out = ring_attention(q, k, v, axis=cfg.cp_axis, causal=cfg.causal)
+            ring = ring_flash_attention if impl == "flash" else ring_attention
+            out = ring(q, k, v, axis=cfg.cp_axis, causal=cfg.causal)
+        elif impl == "flash":
+            out = flash_attention(q, k, v, causal=cfg.causal)
         else:
             out = local_attention(q, k, v, causal=cfg.causal)
         out = out.reshape(out.shape[0], L, H_local * D)  # local-head concat

@@ -1,0 +1,465 @@
+"""Pallas flash attention for TPU.
+
+The hot op the reference leaves to cuDNN/hand-CUDA becomes a Pallas
+kernel pair (fwd + bwd) built for the MXU: blockwise QK^T with an online
+softmax held in VMEM scratch, O accumulated in fp32, causal blocks
+skipped whole. Returns the per-row log-sum-exp so the cp ring
+(parallel/ring_attention.py) can merge per-device partial attentions
+without renormalizing through HBM.
+
+Layout: [B, L, H, D] (framework-wide attention layout); internally
+reshaped to [B*H, L, D] and padded to MXU tiles (D→128 multiples,
+L→block multiples). ``q_offset``/``k_offset`` shift the causal mask for
+sequence-sharded (cp) blocks; they may be traced values (axis_index).
+
+Backward: standard flash backward — recompute P = exp(S - lse) blockwise;
+dV = P^T dO, dS = P ∘ (dO V^T - Δ), dQ = dS K, dK = dS^T Q with
+Δ = rowsum(dO ∘ O) computed outside (one fused elementwise pass).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention", "flash_attention_with_lse"]
+
+NEG = -1e30
+_INTERPRET = None  # resolved per-call: pallas interpret mode off-TPU
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _out_struct(shape, dtype, *inputs):
+    """ShapeDtypeStruct carrying the union of the inputs' varying-manual-
+    axes type — required for pallas_call under shard_map (check_vma)."""
+    vma = frozenset()
+    try:
+        for x in inputs:
+            vma = vma | jax.typeof(x).vma
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    except (AttributeError, TypeError):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc, m_scr, l_scr, *, scale, causal, bq, bk, mxu):
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    q_off, k_off, k_len = offs_ref[0], offs_ref[1], offs_ref[3]
+    i = pl.program_id(1)
+    row0 = q_off + i * bq
+    col0 = k_off + j * bk
+
+    def body():
+        # MXU operands in `mxu` dtype (bf16 default: single-pass MXU with
+        # fp32 accumulation; fp32 operands = multi-pass, ~3x the cycles)
+        q = q_ref[0].astype(mxu)          # [bq, D]
+        k = k_ref[0].astype(mxu)          # [bk, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = cols < (k_off + k_len)
+        if causal:
+            rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            mask = mask & (cols <= rows)
+        s = jnp.where(mask, s, NEG)
+
+        m_prev = m_scr[:, :1]                      # [bq, 1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m_prev - m_new)             # m_prev=NEG → 0
+        l_new = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p.astype(mxu), v_ref[0].astype(mxu),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # causal block skip: block fully in the future → nothing to do
+        pl.when(row0 + bq - 1 >= col0)(body)
+    else:
+        body()
+
+    @pl.when(j == nk - 1)
+    def _():
+        l = l_scr[:, :1]
+        o_ref[0] = (acc[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse = jnp.where(l > 0, m_scr[:, :1] + jnp.log(jnp.maximum(l, 1e-30)), NEG)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+
+
+def _fwd(q, k, v, scale, causal, q_offset, k_offset, bq, bk, interpret, mxu):
+    BH, Lq, D = q.shape
+    Lk = k.shape[1]
+    nq, nk = Lq // bq, Lk // bk
+    offs = jnp.asarray(
+        jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                   jnp.asarray(k_offset, jnp.int32),
+                   jnp.asarray(Lq, jnp.int32),
+                   jnp.asarray(k.shape[1], jnp.int32)]), jnp.int32)
+
+    grid = (BH, nq, nk)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, mxu=mxu)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, bq, D), lambda b, i, j, offs: (b, i, 0)),
+                pl.BlockSpec((1, bk, D), lambda b, i, j, offs: (b, j, 0)),
+                pl.BlockSpec((1, bk, D), lambda b, i, j, offs: (b, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bq, D), lambda b, i, j, offs: (b, i, 0)),
+                pl.BlockSpec((1, bq, 128), lambda b, i, j, offs: (b, i, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bq, D), jnp.float32),
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, 128), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            _out_struct((BH, Lq, D), q.dtype, q, k, v, offs),
+            _out_struct((BH, Lq, 128), jnp.float32, q, k, v, offs),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(offs, q, k, v)
+    return out, lse[:, :, 0]
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, causal, bq, bk, mxu):
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_off, k_off, k_len = offs_ref[0], offs_ref[1], offs_ref[3]
+    i = pl.program_id(1)
+    row0 = q_off + i * bq
+    col0 = k_off + j * bk
+
+    def body():
+        q = q_ref[0].astype(mxu)
+        k = k_ref[0].astype(mxu)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = cols < (k_off + k_len)
+        if causal:
+            rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            mask = mask & (cols <= rows)
+        lse = lse_ref[0][:, :1]
+        p = jnp.where(mask & (lse > NEG / 2), jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(do_ref[0].astype(mxu),
+                                 v_ref[0].astype(mxu),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])
+        dq_acc[:] += jax.lax.dot_general(ds.astype(mxu), k,
+                                         (((1,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        pl.when(row0 + bq - 1 >= col0)(body)
+    else:
+        body()
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk, mxu):
+    i = pl.program_id(2)           # q-block index (inner loop)
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_off, k_off, k_len = offs_ref[0], offs_ref[1], offs_ref[3]
+    j = pl.program_id(1)           # k-block index (outer grid dim)
+    row0 = q_off + i * bq
+    col0 = k_off + j * bk
+
+    def body():
+        q = q_ref[0].astype(mxu)
+        k = k_ref[0].astype(mxu)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = cols < (k_off + k_len)
+        if causal:
+            rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            mask = mask & (cols <= rows)
+        lse = lse_ref[0][:, :1]
+        p = jnp.where(mask & (lse > NEG / 2), jnp.exp(s - lse), 0.0)
+        do = do_ref[0].astype(mxu)
+        dv_acc[:] += jax.lax.dot_general(p.astype(mxu), do,
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[0].astype(mxu),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1])
+        dk_acc[:] += jax.lax.dot_general(ds.astype(mxu), q,
+                                         (((0,), (0,)), ((), ())),
+                                         preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        pl.when(row0 + bq - 1 >= col0)(body)
+    else:
+        body()
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, bq, bk, interpret, mxu, res, grads):
+    q, k, v, out, lse, offs = res
+    do, dlse = grads
+    BH, Lq, D = q.shape
+    Lk = k.shape[1]
+    nq, nk = Lq // bq, Lk // bk
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                                  # [BH, Lq]
+    if dlse is not None:
+        # d(lse)/dS = P, so an lse cotangent enters dS = P∘(dP - Δ + dlse)
+        # — fold it into Δ rather than touching the kernels
+        delta = delta - dlse.astype(jnp.float32)
+    lse_pad = jnp.broadcast_to(lse[..., None], (BH, Lq, 128))
+    delta_pad = jnp.broadcast_to(delta[..., None], (BH, Lq, 128))
+
+    common_in = [
+        pl.BlockSpec((1, bq, D), lambda b, i, j, offs: (b, i, 0)),      # q
+        pl.BlockSpec((1, bk, D), lambda b, i, j, offs: (b, j, 0)),      # k
+        pl.BlockSpec((1, bk, D), lambda b, i, j, offs: (b, j, 0)),      # v
+        pl.BlockSpec((1, bq, D), lambda b, i, j, offs: (b, i, 0)),      # do
+        pl.BlockSpec((1, bq, 128), lambda b, i, j, offs: (b, i, 0)),    # lse
+        pl.BlockSpec((1, bq, 128), lambda b, i, j, offs: (b, i, 0)),    # delta
+    ]
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, mxu=mxu),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, nq, nk),
+            in_specs=common_in,
+            out_specs=[pl.BlockSpec((1, bq, D), lambda b, i, j, offs: (b, i, 0))],
+            scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        ),
+        out_shape=[_out_struct((BH, Lq, D), q.dtype, q, k, v, do, offs)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(offs, q, k, v, do, lse_pad, delta_pad)[0]
+
+    # swap block index roles: outer dim walks k blocks, inner walks q
+    dkv_in = [
+        pl.BlockSpec((1, bq, D), lambda b, j, i, offs: (b, i, 0)),      # q
+        pl.BlockSpec((1, bk, D), lambda b, j, i, offs: (b, j, 0)),      # k
+        pl.BlockSpec((1, bk, D), lambda b, j, i, offs: (b, j, 0)),      # v
+        pl.BlockSpec((1, bq, D), lambda b, j, i, offs: (b, i, 0)),      # do
+        pl.BlockSpec((1, bq, 128), lambda b, j, i, offs: (b, i, 0)),    # lse
+        pl.BlockSpec((1, bq, 128), lambda b, j, i, offs: (b, i, 0)),    # delta
+    ]
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, mxu=mxu),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(BH, nk, nq),
+            in_specs=dkv_in,
+            out_specs=[
+                pl.BlockSpec((1, bk, D), lambda b, j, i, offs: (b, j, 0)),
+                pl.BlockSpec((1, bk, D), lambda b, j, i, offs: (b, j, 0)),
+            ],
+            scratch_shapes=[pltpu.VMEM((bk, D), jnp.float32),
+                            pltpu.VMEM((bk, D), jnp.float32)],
+        ),
+        out_shape=[_out_struct((BH, Lk, D), k.dtype, q, k, v, do, offs),
+                   _out_struct((BH, Lk, D), v.dtype, q, k, v, do, offs)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(offs, q, k, v, do, lse_pad, delta_pad)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 7, 8, 9, 10))
+def _flash(q, k, v, scale, causal, q_offset, k_offset, bq, bk, interpret, precision):
+    (out, _), _ = _flash_fwd(q, k, v, scale, causal, q_offset, k_offset,
+                             bq, bk, interpret, precision)
+    return out
+
+
+def _flash_fwd(q, k, v, scale, causal, q_offset, k_offset, bq, bk, interpret, precision):
+    mxu = jnp.float32 if precision == "highest" else jnp.bfloat16
+    offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                      jnp.asarray(k_offset, jnp.int32),
+                      jnp.asarray(q.shape[1], jnp.int32),
+                      jnp.asarray(k.shape[1], jnp.int32)])
+    out, lse = _fwd(q, k, v, scale, causal, q_offset, k_offset, bq, bk,
+                    interpret, mxu)
+    return (out, lse), (q, k, v, out, lse, offs)
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, q_offset, k_offset, bq, bk,
+                    interpret, precision):
+    (out, lse), res = _flash_fwd(q, k, v, scale, causal, q_offset, k_offset,
+                                 bq, bk, interpret, precision)
+    return out, (res, (q_offset, k_offset))
+
+
+def _flash_bwd_rule(scale, causal, bq, bk, interpret, precision, saved, g):
+    res, (q_offset, k_offset) = saved
+    mxu = jnp.float32 if precision == "highest" else jnp.bfloat16
+    dq, dk, dv = _bwd(scale, causal, bq, bk, interpret, mxu, res, (g, None))
+    return dq, dk, dv, None, None
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 7, 8, 9, 10))
+def _flash_pair(q, k, v, scale, causal, q_offset, k_offset, bq, bk,
+                interpret, precision):
+    (out, lse), _ = _flash_fwd(q, k, v, scale, causal, q_offset, k_offset,
+                               bq, bk, interpret, precision)
+    return out, lse
+
+
+def _flash_pair_fwd_rule(q, k, v, scale, causal, q_offset, k_offset, bq, bk,
+                         interpret, precision):
+    (out, lse), res = _flash_fwd(q, k, v, scale, causal, q_offset, k_offset,
+                                 bq, bk, interpret, precision)
+    return (out, lse), res
+
+
+def _flash_pair_bwd_rule(scale, causal, bq, bk, interpret, precision, res, g):
+    do, dlse = g
+    mxu = jnp.float32 if precision == "highest" else jnp.bfloat16
+    dq, dk, dv = _bwd(scale, causal, bq, bk, interpret, mxu, res, (do, dlse))
+    return dq, dk, dv, None, None
+
+
+_flash_pair.defvjp(_flash_pair_fwd_rule, _flash_pair_bwd_rule)
+
+
+def flash_attention_with_lse(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    causal: bool = False,
+    q_offset=0, k_offset=0,
+    block_q: int = 512, block_k: int = 512,
+    interpret: Optional[bool] = None,
+    precision: str = "default",
+) -> Tuple[jax.Array, jax.Array]:
+    """flash attention returning (out, lse) — lse: [B, L, H] fp32.
+    Differentiable in q/k/v including through lse (the cp ring merges
+    per-device partials with lse weights, so its VJP needs dlse)."""
+    out, lse, meta = _run_padded(q, k, v, causal, q_offset, k_offset,
+                                 block_q, block_k, interpret, precision,
+                                 with_lse=True)
+    return out, lse
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    causal: bool = False,
+    q_offset=0, k_offset=0,
+    block_q: int = 512, block_k: int = 512,
+    interpret: Optional[bool] = None,
+    precision: str = "default",
+) -> jax.Array:
+    """Differentiable flash attention, [B, L, H, D] in and out."""
+    out, _, _ = _run_padded(q, k, v, causal, q_offset, k_offset,
+                            block_q, block_k, interpret, precision,
+                            with_lse=False)
+    return out
+
+
+def _run_padded(q, k, v, causal, q_offset, k_offset, block_q, block_k,
+                interpret, precision, with_lse):
+    if interpret is None:
+        interpret = not _on_tpu()
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    scale = 1.0 / float(np.sqrt(D))
+    bq = min(block_q, _round_up(Lq, 8))
+    bk = min(block_k, _round_up(Lk, 8))
+    Lq_p, Lk_p = _round_up(Lq, bq), _round_up(Lk, bk)
+    D_p = _round_up(D, 128)
+
+    def to_bh(x, L, L_p):
+        x = jnp.moveaxis(x, 2, 1).reshape(B * H, L, D)
+        return jnp.pad(x, ((0, 0), (0, L_p - L), (0, D_p - D)))
+
+    qp, kp, vp = to_bh(q, Lq, Lq_p), to_bh(k, Lk, Lk_p), to_bh(v, Lk, Lk_p)
+
+    if with_lse:
+        out, lse = _flash_pair(qp, kp, vp, scale, causal, q_offset,
+                               k_offset, bq, bk, interpret, precision)
+    else:
+        out = _flash(qp, kp, vp, scale, causal, q_offset, k_offset, bq, bk,
+                     interpret, precision)
+        lse = None
+    out = out[:, :Lq, :D].reshape(B, H, Lq, D)
+    out = jnp.moveaxis(out, 1, 2)
+    if lse is not None:
+        lse = lse[:, :Lq].reshape(B, H, Lq)
+        lse = jnp.moveaxis(lse, 1, 2)          # [B, L, H]
+    return out, lse, (bq, bk)

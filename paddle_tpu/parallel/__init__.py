@@ -9,14 +9,14 @@ from .mp_layers import (
 )
 from .moe import ExpertFFN, MoELayer, top1_gate, top2_gate
 from .pipeline import LayerDesc, PipelineLayer, PipelineTrainer, pipeline_spmd_fn
-from .ring_attention import local_attention, ring_attention, ulysses_attention
+from .ring_attention import local_attention, ring_attention, ring_flash_attention, ulysses_attention
 from .spmd import DataParallel, SpmdTrainer, make_sharding_rules, shard_largest_dim
 from .topology import CommunicateTopology, HybridCommunicateGroup
 
 __all__ = [
     "ExpertFFN", "MoELayer", "top1_gate", "top2_gate",
     "LayerDesc", "PipelineLayer", "PipelineTrainer", "pipeline_spmd_fn",
-    "local_attention", "ring_attention", "ulysses_attention",
+    "local_attention", "ring_attention", "ring_flash_attention", "ulysses_attention",
     "ColumnParallelLinear",
     "ParallelCrossEntropy",
     "RowParallelLinear",

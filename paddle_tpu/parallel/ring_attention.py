@@ -30,7 +30,7 @@ from jax import lax
 from ..core.enforce import enforce_eq
 from ..ops import collectives as coll
 
-__all__ = ["ring_attention", "ulysses_attention", "local_attention"]
+__all__ = ["ring_attention", "ring_flash_attention", "ulysses_attention", "local_attention"]
 
 
 def _block_scores(q, k, scale):
@@ -51,6 +51,51 @@ def local_attention(
         scores = jnp.where(ki <= qi, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis: str = "cp",
+    causal: bool = False,
+) -> jax.Array:
+    """Ring attention whose per-hop block attention is the Pallas flash
+    kernel (ops/flash_attention.py): each hop computes the local
+    (out, lse) for the KV block currently held, and the carry merges
+    partials with lse weights (log-add-exp combine). Differentiable —
+    flash's VJP handles dlse. Use on TPU; einsum `ring_attention` is the
+    interpret-friendly fallback."""
+    from ..ops.flash_attention import flash_attention_with_lse
+
+    P = lax.axis_size(axis)
+    rank = lax.axis_index(axis)
+    B, L, H, D = q.shape
+    q_off = rank * L
+    NEG = -1e30
+
+    def merge(out, lse, k_cur, v_cur, i):
+        src = (rank - i) % P
+        o_i, lse_i = flash_attention_with_lse(
+            q, k_cur, v_cur, causal=causal, q_offset=q_off, k_offset=src * L)
+        lse_new = jnp.logaddexp(lse, lse_i)
+        w_prev = jnp.exp(lse - lse_new)
+        w_cur = jnp.exp(lse_i - lse_new)
+        out_new = out * w_prev[..., None] + o_i * w_cur[..., None]
+        return out_new, lse_new
+
+    def step(carry, i):
+        out, lse, k_cur, v_cur = carry
+        out, lse = merge(out, lse, k_cur, v_cur, i)
+        return (out, lse, coll.shift(k_cur, axis, 1),
+                coll.shift(v_cur, axis, 1)), None
+
+    out0 = jnp.zeros_like(q)
+    lse0 = jnp.sum(q.astype(jnp.float32), axis=-1) * 0.0 + NEG  # [B, L, H], q's vma
+    (out, lse, k_last, v_last), _ = lax.scan(
+        step, (out0, lse0, k, v), jnp.arange(P - 1))
+    out, _ = merge(out, lse, k_last, v_last, P - 1)
+    return out
 
 
 def ring_attention(
