@@ -1,0 +1,206 @@
+"""Elastic training manager: membership, heartbeat, scale in/out.
+
+Reference: ``fleet/elastic/manager.py:130`` (ElasticManager) — nodes
+heartbeat into an etcd prefix, a watcher diffs the host set against the
+announced job size, and the launcher HOLDs / RESTARTs / COMPLETEs local
+trainers (ElasticStatus :53), rewriting ``DISTRIBUTED_TRAINER_ENDPOINTS``
+on scale events (:465,:486).
+
+TPU-native shape: the store is pluggable — ``MemoryStore`` in-process
+(tests, the reference mocks etcd the same way), ``FileStore`` over a
+shared filesystem for single-cluster jobs, and the jax.distributed
+coordination service / etcd can back the same interface multi-host. The
+decision logic (quorum match, fault tolerance vs scale in/out) is a pure
+function of (alive hosts, announced np), kept identical to the reference.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["ElasticStatus", "ElasticManager", "MemoryStore", "FileStore"]
+
+
+class ElasticStatus(enum.Enum):   # manager.py:53
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class MemoryStore:
+    """In-process KV with TTL (the fake-etcd test double)."""
+
+    def __init__(self) -> None:
+        self._d: Dict[str, tuple] = {}
+        self._lock = threading.Lock()
+
+    def put(self, key: str, value: str, ttl: float = 0.0) -> None:
+        with self._lock:
+            self._d[key] = (value, time.monotonic() + ttl if ttl else None)
+
+    def get(self, key: str) -> Optional[str]:
+        with self._lock:
+            v = self._d.get(key)
+            if v is None or (v[1] is not None and time.monotonic() > v[1]):
+                return None
+            return v[0]
+
+    def list_prefix(self, prefix: str) -> Dict[str, str]:
+        with self._lock:
+            now = time.monotonic()
+            return {k: v for k, (v, exp) in self._d.items()
+                    if k.startswith(prefix) and (exp is None or now <= exp)}
+
+    def delete(self, key: str) -> None:
+        with self._lock:
+            self._d.pop(key, None)
+
+
+class FileStore:
+    """Same interface over a shared directory (one file per key, mtime
+    TTL) — enough for single-cluster NFS/GCS-fuse deployments."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key.replace("/", "__"))
+
+    def put(self, key: str, value: str, ttl: float = 0.0) -> None:
+        with open(self._path(key), "w") as f:
+            json.dump({"v": value, "ttl": ttl, "t": time.time()}, f)
+
+    def get(self, key: str) -> Optional[str]:
+        try:
+            with open(self._path(key)) as f:
+                blob = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if blob["ttl"] and time.time() > blob["t"] + blob["ttl"]:
+            return None
+        return blob["v"]
+
+    def list_prefix(self, prefix: str) -> Dict[str, str]:
+        out = {}
+        p = prefix.replace("/", "__")
+        for name in os.listdir(self.root):
+            if name.startswith(p):
+                key = name.replace("__", "/")
+                v = self.get(key)
+                if v is not None:
+                    out[key] = v
+        return out
+
+    def delete(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except OSError:
+            pass
+
+
+class ElasticManager:
+    """Membership + decision loop for one node.
+
+    ``watch()`` returns an ElasticStatus the launcher acts on; the
+    callbacks let tests and controllers observe decisions."""
+
+    def __init__(
+        self,
+        store,
+        job_id: str,
+        np: int,                      # announced world size
+        host: str,
+        heartbeat_interval: float = 1.0,
+        heartbeat_ttl: float = 4.0,
+        elastic_timeout: float = 10.0,
+        min_np: Optional[int] = None,
+        max_np: Optional[int] = None,
+    ) -> None:
+        self.store = store
+        self.job_id = job_id
+        self.np = np
+        self.min_np = min_np if min_np is not None else np
+        self.max_np = max_np if max_np is not None else np
+        self.host = host
+        self._hb_int = heartbeat_interval
+        self._hb_ttl = heartbeat_ttl
+        self._timeout = elastic_timeout
+        self._prefix = f"elastic/{job_id}/nodes/"
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_change = time.monotonic()
+        self._known: List[str] = []
+
+    # -- heartbeat (lease_heartbeat manager.py:250) ------------------------
+
+    def start(self) -> None:
+        self._beat()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2 * self._hb_int)
+        self.store.delete(self._prefix + self.host)
+
+    def _beat(self) -> None:
+        self.store.put(self._prefix + self.host, json.dumps(
+            {"host": self.host, "t": time.time()}), ttl=self._hb_ttl)
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._beat()
+            self._stop.wait(self._hb_int)
+
+    # -- membership --------------------------------------------------------
+
+    def alive_hosts(self) -> List[str]:
+        return sorted(k[len(self._prefix):]
+                      for k in self.store.list_prefix(self._prefix))
+
+    def _match(self) -> bool:
+        """Quorum check (manager.py:393): host set size equals np."""
+        return len(self.alive_hosts()) == self.np
+
+    # -- decision (watch loop; manager.py:439-532) -------------------------
+
+    def watch_once(self) -> ElasticStatus:
+        hosts = self.alive_hosts()
+        n = len(hosts)
+        if hosts != self._known:
+            self._known = hosts
+            self._last_change = time.monotonic()
+        if n == self.np:
+            return ElasticStatus.HOLD          # healthy, keep running
+        waited = time.monotonic() - self._last_change
+        if n > self.np:
+            if n <= self.max_np:
+                # scale-out: adopt the larger world (rewrites np + restarts)
+                return ElasticStatus.RESTART
+            return ElasticStatus.HOLD          # beyond max: ignore extras
+        # n < np: a node died
+        if n < self.min_np:
+            if waited > self._timeout:
+                return ElasticStatus.ERROR     # unrecoverable below min_np
+            return ElasticStatus.HOLD          # grace period: node may return
+        if waited > self._timeout:
+            return ElasticStatus.RESTART       # fault tolerance: shrink world
+        return ElasticStatus.HOLD
+
+    def adopt_world(self) -> int:
+        """After RESTART: new world size + endpoint rewrite payload (the
+        DISTRIBUTED_TRAINER_ENDPOINTS update, manager.py:465)."""
+        hosts = self.alive_hosts()
+        self.np = max(min(len(hosts), self.max_np), self.min_np)
+        self.store.put(f"elastic/{self.job_id}/endpoints",
+                       json.dumps(hosts[:self.np]))
+        return self.np

@@ -1,0 +1,128 @@
+"""Process launcher: ``python -m paddle_tpu.distributed.launch``.
+
+Reference: ``python/paddle/distributed/launch`` — builds a Pod/Container
+job model, then a collective or PS controller spawns trainer/server
+subprocesses with role env vars, restarts on elastic events, and a master
+handles rendezvous (launch/controllers/*.py, job/pod.py).
+
+TPU shape: one process per host (JAX owns all local chips), roles wired
+through the same env vars the RoleMaker reads (PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, TRAINING_ROLE, PADDLE_PORT …), multi-host bootstrap
+via ``jax.distributed.initialize`` coordinates over DCN. For the PS mode
+it spawns server + trainer processes on localhost exactly like the
+reference's test harness (test_dist_fleet_base.py:311 _run_cluster).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["JobSpec", "launch_local", "main"]
+
+
+class JobSpec:
+    def __init__(self, script: List[str], nproc: int = 1, servers: int = 0,
+                 coordinator_port: int = 12355, log_dir: Optional[str] = None,
+                 env: Optional[Dict[str, str]] = None) -> None:
+        self.script = script
+        self.nproc = nproc
+        self.servers = servers
+        self.coordinator_port = coordinator_port
+        self.log_dir = log_dir
+        self.env = env or {}
+
+
+def _proc_env(spec: JobSpec, role: str, rank: int) -> Dict[str, str]:
+    env = dict(os.environ)
+    env.update(spec.env)
+    trainer_eps = ",".join(
+        f"127.0.0.1:{spec.coordinator_port + 1 + i}" for i in range(spec.nproc))
+    server_eps = ",".join(
+        f"127.0.0.1:{spec.coordinator_port + 100 + i}" for i in range(spec.servers))
+    env.update({
+        "TRAINING_ROLE": role,
+        "PADDLE_TRAINERS_NUM": str(spec.nproc),
+        "PADDLE_TRAINER_ENDPOINTS": trainer_eps,
+        "PADDLE_PSERVERS_IP_PORT_LIST": server_eps,
+        "PADDLE_COORDINATOR": f"127.0.0.1:{spec.coordinator_port}",
+        "PADDLE_WORLD_SIZE": str(spec.nproc),
+    })
+    if role == "TRAINER":
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        env["PADDLE_RANK"] = str(rank)
+    else:
+        env["PADDLE_PORT"] = str(spec.coordinator_port + 100 + rank)
+        env["POD_IP"] = "127.0.0.1"
+        env["PADDLE_SERVER_ID"] = str(rank)
+    return env
+
+
+def launch_local(spec: JobSpec, timeout: Optional[float] = None) -> int:
+    """Spawn servers then trainers on localhost; wait for trainers, then
+    terminate servers (the PS controller sequence). Returns the first
+    nonzero trainer exit code, else 0."""
+    procs: List[subprocess.Popen] = []
+    server_procs: List[subprocess.Popen] = []
+
+    def spawn(role: str, rank: int) -> subprocess.Popen:
+        env = _proc_env(spec, role, rank)
+        stdout = None
+        if spec.log_dir:
+            os.makedirs(spec.log_dir, exist_ok=True)
+            stdout = open(os.path.join(
+                spec.log_dir, f"{role.lower()}_{rank}.log"), "w")
+        return subprocess.Popen(
+            [sys.executable] + spec.script, env=env,
+            stdout=stdout, stderr=subprocess.STDOUT if stdout else None)
+
+    try:
+        for r in range(spec.servers):
+            server_procs.append(spawn("PSERVER", r))
+        for r in range(spec.nproc):
+            procs.append(spawn("TRAINER", r))
+        deadline = time.monotonic() + timeout if timeout else None
+        rc = 0
+        for p in procs:
+            left = max(0.1, deadline - time.monotonic()) if deadline else None
+            code = p.wait(timeout=left)
+            rc = rc or code
+        return rc
+    finally:
+        for p in procs + server_procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs + server_procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch trainers (and PS servers) on this host.")
+    ap.add_argument("--nproc_per_node", type=int, default=1)
+    ap.add_argument("--servers", type=int, default=0)
+    ap.add_argument("--master_port", type=int, default=12355)
+    ap.add_argument("--log_dir", default=None)
+    ap.add_argument("script", nargs=argparse.REMAINDER,
+                    help="training script and its args")
+    args = ap.parse_args(argv)
+    script = [a for a in args.script if a != "--"]
+    if not script:
+        ap.error("missing training script")
+    return launch_local(JobSpec(script, nproc=args.nproc_per_node,
+                                servers=args.servers,
+                                coordinator_port=args.master_port,
+                                log_dir=args.log_dir))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,188 @@
+"""High-level Model API (reference: ``python/paddle/hapi/model.py`` —
+Model.fit:1557, evaluate, predict, save/load, callbacks).
+
+``Model`` wraps a Layer with prepare(optimizer, loss, metrics) and runs
+compiled train/eval steps over a DataLoader-style iterable; the per-op
+dygraph/static dual engine of the reference collapses into the one jitted
+step (executor.make_train_step)."""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import nn
+from .core.enforce import PreconditionNotMetError, enforce
+from .executor import make_eval_step, make_train_step
+from .io import checkpoint as ckpt
+
+__all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint"]
+
+
+class Callback:
+    """hapi/callbacks.py shape: hooks around epochs/batches."""
+
+    def on_train_begin(self, model: "Model") -> None: ...
+    def on_train_end(self, model: "Model") -> None: ...
+    def on_epoch_begin(self, model: "Model", epoch: int) -> None: ...
+    def on_epoch_end(self, model: "Model", epoch: int,
+                     logs: Dict[str, float]) -> None: ...
+    def on_batch_end(self, model: "Model", step: int,
+                     logs: Dict[str, float]) -> None: ...
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq: int = 10, verbose: int = 1) -> None:
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_batch_end(self, model, step, logs):
+        if self.verbose and step % self.log_freq == 0:
+            msg = " ".join(f"{k}={v:.4f}" for k, v in logs.items())
+            print(f"step {step}: {msg}")
+
+    def on_epoch_end(self, model, epoch, logs):
+        if self.verbose:
+            msg = " ".join(f"{k}={v:.4f}" for k, v in logs.items())
+            print(f"epoch {epoch}: {msg}")
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_dir: str, save_freq: int = 1) -> None:
+        self.save_dir = save_dir
+        self.save_freq = save_freq
+
+    def on_epoch_end(self, model, epoch, logs):
+        if (epoch + 1) % self.save_freq == 0:
+            model.save(f"{self.save_dir}/epoch_{epoch}")
+
+
+class Model:
+    """paddle.Model analogue over a compiled step function."""
+
+    def __init__(self, network: nn.Layer) -> None:
+        self.network = network
+        self._state = None
+        self._opt = None
+        self._opt_state = None
+        self._loss = None
+        self._metrics: List[Any] = []
+        self._train_step = None
+        self._eval_step = None
+        self._rng = jax.random.key(0)
+        self.stop_training = False
+
+    # -- setup ------------------------------------------------------------
+
+    def prepare(self, optimizer=None, loss=None,
+                metrics: Optional[Sequence[Any]] = None) -> None:
+        self._opt = optimizer
+        self._loss = loss
+        self._metrics = list(metrics or [])
+        self._state = nn.get_state(self.network)
+        if optimizer is not None:
+            self._opt_state = optimizer.init(self._state["params"])
+            self._train_step = make_train_step(self.network, optimizer, loss,
+                                               donate=False)
+        self._eval_fwd = make_eval_step(self.network)
+
+    def _check_prepared(self):
+        enforce(self._state is not None, "call prepare() first",
+                PreconditionNotMetError)
+
+    # -- training ---------------------------------------------------------
+
+    def train_batch(self, inputs, labels) -> Dict[str, float]:
+        self._check_prepared()
+        self._rng, sub = jax.random.split(self._rng)
+        ins = inputs if isinstance(inputs, (tuple, list)) else (inputs,)
+        lbs = labels if isinstance(labels, (tuple, list)) else (labels,)
+        self._state, self._opt_state, loss = self._train_step(
+            self._state, self._opt_state, sub,
+            tuple(jnp.asarray(x) for x in ins),
+            tuple(jnp.asarray(y) for y in lbs))
+        return {"loss": float(loss)}
+
+    def fit(self, train_data: Iterable, eval_data: Optional[Iterable] = None,
+            epochs: int = 1, callbacks: Optional[Sequence[Callback]] = None,
+            verbose: int = 1) -> Dict[str, List[float]]:
+        self._check_prepared()
+        cbs = list(callbacks or [])
+        if verbose:
+            cbs.append(ProgBarLogger(verbose=verbose))
+        history: Dict[str, List[float]] = {"loss": []}
+        for cb in cbs:
+            cb.on_train_begin(self)
+        step = 0
+        for epoch in range(epochs):
+            for cb in cbs:
+                cb.on_epoch_begin(self, epoch)
+            losses = []
+            for batch in train_data:
+                inputs, labels = batch
+                logs = self.train_batch(inputs, labels)
+                losses.append(logs["loss"])
+                step += 1
+                for cb in cbs:
+                    cb.on_batch_end(self, step, logs)
+                if self.stop_training:
+                    break
+            epoch_logs = {"loss": float(np.mean(losses))} if losses else {}
+            if eval_data is not None:
+                epoch_logs.update(self.evaluate(eval_data, verbose=0))
+            history["loss"].append(epoch_logs.get("loss", float("nan")))
+            for cb in cbs:
+                cb.on_epoch_end(self, epoch, epoch_logs)
+            if self.stop_training:
+                break
+        for cb in cbs:
+            cb.on_train_end(self)
+        return history
+
+    # -- eval / predict ----------------------------------------------------
+
+    def evaluate(self, eval_data: Iterable, verbose: int = 0) -> Dict[str, float]:
+        self._check_prepared()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for inputs, labels in eval_data:
+            ins = inputs if isinstance(inputs, (tuple, list)) else (inputs,)
+            lbs = labels if isinstance(labels, (tuple, list)) else (labels,)
+            out = self._eval_fwd(self._state, tuple(jnp.asarray(x) for x in ins), ())
+            if self._loss is not None:
+                losses.append(float(self._loss(out, *(jnp.asarray(y) for y in lbs))))
+            for m in self._metrics:
+                m.update(np.asarray(out), *(np.asarray(y) for y in lbs))
+        logs = {}
+        if losses:
+            logs["eval_loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs[type(m).__name__.lower()] = float(m.accumulate())
+        if verbose:
+            print(" ".join(f"{k}={v:.4f}" for k, v in logs.items()))
+        return logs
+
+    def predict_batch(self, inputs):
+        self._check_prepared()
+        ins = inputs if isinstance(inputs, (tuple, list)) else (inputs,)
+        return self._eval_fwd(self._state, tuple(jnp.asarray(x) for x in ins), ())
+
+    # -- save/load ---------------------------------------------------------
+
+    def save(self, path: str) -> None:
+        self._check_prepared()
+        ckpt.save({"state": jax.device_get(self._state),
+                   "opt_state": jax.device_get(self._opt_state)}, path)
+
+    def load(self, path: str) -> None:
+        self._check_prepared()
+        blob = ckpt.load(path)
+        self._state = blob["state"]
+        if blob.get("opt_state") is not None and self._opt is not None:
+            self._opt_state = blob["opt_state"]
+        nn.set_state(self.network, self._state)

@@ -1,0 +1,177 @@
+"""Aux subsystems: hapi Model.fit, auto-checkpoint resume, elastic
+manager decisions, local launcher (SURVEY §5 + §2.1 L14)."""
+
+import os
+import sys
+import textwrap
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.elastic import (ElasticManager, ElasticStatus,
+                                            FileStore, MemoryStore)
+from paddle_tpu.distributed.launch import JobSpec, launch_local
+from paddle_tpu.hapi import Model
+from paddle_tpu.io.auto_checkpoint import CheckpointSaver, TrainEpochRange
+
+
+# -- hapi -------------------------------------------------------------------
+
+
+def _toy_data(n=64, batch=16, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 8)).astype(np.float32)
+    w = rng.normal(size=(8,)).astype(np.float32)
+    y = (x @ w > 0).astype(np.int32)
+    return [(x[i:i + batch], y[i:i + batch]) for i in range(0, n, batch)]
+
+
+def test_model_fit_learns(tmp_path):
+    pt.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = Model(net)
+    model.prepare(optimizer.Adam(learning_rate=1e-2), nn.CrossEntropyLoss())
+    data = _toy_data()
+    hist = model.fit(data, epochs=5, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0]
+
+    model.save(str(tmp_path / "m"))
+    model2 = Model(net)
+    model2.prepare(optimizer.Adam(learning_rate=1e-2), nn.CrossEntropyLoss())
+    model2.load(str(tmp_path / "m"))
+    x, y = data[0]
+    out = model2.predict_batch(x)
+    assert out.shape == (16, 2)
+    ev = model2.evaluate(data)
+    assert ev["eval_loss"] == pytest.approx(hist["loss"][-1], rel=0.5)
+
+
+# -- auto checkpoint --------------------------------------------------------
+
+
+def test_checkpoint_saver_gc(tmp_path):
+    s = CheckpointSaver(str(tmp_path), max_keep=2)
+    for i in range(4):
+        s.save({"v": i}, {"epoch": i})
+    no, payload, meta = s.get_last()
+    assert no == 3 and payload["v"] == 3 and meta["epoch"] == 3
+    assert s._ids() == [2, 3]  # older snapshots GC'd
+
+
+def test_train_epoch_range_resumes(tmp_path):
+    state = {"w": 0.0}
+
+    def run(crash_after=None):
+        seen = []
+        r = TrainEpochRange(5, "job", checkpoint_dir=str(tmp_path))
+        r.set_state_getter(lambda: dict(state))
+        r.set_state_setter(lambda s: state.update(s))
+        for epoch in r:
+            state["w"] += 1.0
+            seen.append(epoch)
+            if crash_after is not None and epoch == crash_after:
+                r.save(epoch)
+                raise RuntimeError("simulated crash")
+        return seen
+
+    with pytest.raises(RuntimeError):
+        run(crash_after=2)
+    assert state["w"] == 3.0
+    state["w"] = -100.0  # clobber; resume must restore from snapshot
+    seen = run()
+    assert seen == [3, 4]          # epochs 0-2 skipped
+    assert state["w"] == 5.0       # restored 3.0 + two more epochs
+
+
+# -- elastic ----------------------------------------------------------------
+
+
+def _mk_managers(store, n, np_=None, **kw):
+    return [ElasticManager(store, "job", np_ or n, f"host{i}",
+                           heartbeat_interval=0.05, heartbeat_ttl=0.3,
+                           elastic_timeout=0.3, **kw)
+            for i in range(n)]
+
+
+def test_elastic_healthy_holds():
+    store = MemoryStore()
+    ms = _mk_managers(store, 2)
+    for m in ms:
+        m.start()
+    try:
+        assert ms[0].watch_once() == ElasticStatus.HOLD
+        assert ms[0]._match()
+    finally:
+        for m in ms:
+            m.stop()
+
+
+def test_elastic_node_death_restarts():
+    import time
+    store = MemoryStore()
+    ms = _mk_managers(store, 3, min_np=2, max_np=3)
+    for m in ms:
+        m.start()
+    ms[2].stop()                      # node dies
+    time.sleep(0.4)                   # ttl expiry + timeout
+    st = ms[0].watch_once()
+    time.sleep(0.4)
+    st = ms[0].watch_once()
+    assert st == ElasticStatus.RESTART
+    assert ms[0].adopt_world() == 2   # shrunk world
+    for m in ms[:2]:
+        m.stop()
+
+
+def test_elastic_below_min_errors():
+    import time
+    store = MemoryStore()
+    ms = _mk_managers(store, 2, min_np=2, max_np=3)
+    ms[0].start()
+    ms[1].start()
+    ms[1].stop()
+    time.sleep(0.4)
+    ms[0].watch_once()
+    time.sleep(0.4)
+    assert ms[0].watch_once() == ElasticStatus.ERROR
+    ms[0].stop()
+
+
+def test_file_store_roundtrip(tmp_path):
+    s = FileStore(str(tmp_path))
+    s.put("elastic/j/nodes/h0", "x", ttl=100)
+    assert s.get("elastic/j/nodes/h0") == "x"
+    assert list(s.list_prefix("elastic/j/nodes/")) == ["elastic/j/nodes/h0"]
+    s.delete("elastic/j/nodes/h0")
+    assert s.get("elastic/j/nodes/h0") is None
+
+
+# -- launcher ---------------------------------------------------------------
+
+
+def test_launch_local_trainers(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        n = os.environ["PADDLE_TRAINERS_NUM"]
+        assert os.environ["TRAINING_ROLE"] == "TRAINER"
+        print(f"rank {rank}/{n} ok")
+        sys.exit(0)
+    """))
+    rc = launch_local(JobSpec([str(script)], nproc=2,
+                              log_dir=str(tmp_path / "logs")), timeout=60)
+    assert rc == 0
+    logs = sorted(os.listdir(tmp_path / "logs"))
+    assert logs == ["trainer_0.log", "trainer_1.log"]
+    assert "rank 0/2 ok" in (tmp_path / "logs" / "trainer_0.log").read_text()
+
+
+def test_launch_propagates_failure(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(3)")
+    rc = launch_local(JobSpec([str(script)], nproc=2), timeout=60)
+    assert rc == 3
