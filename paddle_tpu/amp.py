@@ -1,0 +1,159 @@
+"""Automatic mixed precision (``paddle.amp`` analogue).
+
+The reference implements AMP twice: dygraph ``paddle.amp.auto_cast`` +
+``GradScaler`` (imperative/amp_auto_cast.cc; python/paddle/amp/) and the
+static-graph ``AMPOptimizer`` meta-optimizer (fleet/meta_optimizers/
+amp_optimizer.py) that rewrites the program with cast ops and inserts
+``check_finite_and_unscale``/``update_loss_scaling`` ops.
+
+TPU-first inversion: bf16 is the native MXU dtype and needs **no loss
+scaling** — ``auto_cast`` simply runs the wrapped computation with
+low-precision inputs and XLA fuses the casts. Dynamic loss scaling is
+kept (functionally, jit-traceable) for fp16 parity: `LossScaleState` is
+a small pytree carried through the compiled step, and the
+nonfinite-skip + scale-growth logic mirrors
+``update_loss_scaling_op`` (operators/amp/update_loss_scaling_op.h):
+grow scale by ``incr_ratio`` after ``incr_every_n_steps`` consecutive
+finite steps, shrink by ``decr_ratio`` after
+``decr_every_n_nan_or_inf`` consecutive nonfinite steps, skipping the
+parameter update on nonfinite gradients.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["auto_cast", "amp_guard", "cast_model_inputs", "GradScaler", "LossScaleState"]
+
+PyTree = Any
+
+_FLOAT_DTYPES = (jnp.float32, jnp.float64, jnp.bfloat16, jnp.float16)
+
+
+class _AmpState(threading.local):
+    def __init__(self) -> None:
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+
+
+_amp_state = _AmpState()
+
+
+def amp_enabled() -> bool:
+    return _amp_state.enabled
+
+
+def amp_dtype():
+    return _amp_state.dtype
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, dtype: str = "bfloat16"):
+    """``paddle.amp.auto_cast`` analogue. Layers consult
+    ``amp_enabled()/amp_dtype()`` to pick their compute dtype; casting
+    the *inputs* is usually sufficient since XLA propagates the low
+    precision through fused elementwise chains."""
+    prev = (_amp_state.enabled, _amp_state.dtype)
+    _amp_state.enabled = bool(enable)
+    _amp_state.dtype = jnp.bfloat16 if dtype in ("bfloat16", "bf16") else jnp.float16
+    try:
+        yield
+    finally:
+        _amp_state.enabled, _amp_state.dtype = prev
+
+
+# Static-graph spelling in the reference.
+amp_guard = auto_cast
+
+
+def cast_model_inputs(tree: PyTree, dtype=None) -> PyTree:
+    """Cast floating leaves to the AMP compute dtype (cast-op insertion
+    analogue of fluid/contrib/mixed_precision/fp16_utils.py)."""
+    dt = dtype or amp_dtype()
+
+    def cast(x):
+        if hasattr(x, "dtype") and x.dtype in _FLOAT_DTYPES:
+            return x.astype(dt)
+        return x
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+class LossScaleState(NamedTuple):
+    loss_scale: jax.Array       # f32 scalar
+    good_steps: jax.Array       # i32: consecutive finite steps
+    bad_steps: jax.Array        # i32: consecutive nonfinite steps
+
+
+def all_finite(grads: PyTree) -> jax.Array:
+    """check_finite_and_unscale's finite test over a whole pytree."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    ok = jnp.asarray(True)
+    for g in leaves:
+        ok = ok & jnp.all(jnp.isfinite(g))
+    return ok
+
+
+class GradScaler:
+    """``paddle.amp.GradScaler`` parity with a functional API.
+
+    Usage inside a compiled step::
+
+        state = scaler.init()
+        loss = ... ; scaled = scaler.scale(loss, state)
+        grads = jax.grad(...)                   # grads of the scaled loss
+        grads, ok = scaler.unscale(grads, state)
+        params, opt_state = scaler.apply(ok, ...)   # cond-skip on nonfinite
+        state = scaler.update(ok, state)
+    """
+
+    def __init__(
+        self,
+        init_loss_scaling: float = 2.0 ** 15,
+        incr_ratio: float = 2.0,
+        decr_ratio: float = 0.5,
+        incr_every_n_steps: int = 1000,
+        decr_every_n_nan_or_inf: int = 2,
+        use_dynamic_loss_scaling: bool = True,
+    ) -> None:
+        self.init_loss_scaling = float(init_loss_scaling)
+        self.incr_ratio = float(incr_ratio)
+        self.decr_ratio = float(decr_ratio)
+        self.incr_every_n_steps = int(incr_every_n_steps)
+        self.decr_every_n_nan_or_inf = int(decr_every_n_nan_or_inf)
+        self.dynamic = bool(use_dynamic_loss_scaling)
+
+    def init(self) -> LossScaleState:
+        return LossScaleState(
+            loss_scale=jnp.asarray(self.init_loss_scaling, jnp.float32),
+            good_steps=jnp.zeros((), jnp.int32),
+            bad_steps=jnp.zeros((), jnp.int32),
+        )
+
+    def scale(self, loss: jax.Array, state: LossScaleState) -> jax.Array:
+        return loss * state.loss_scale.astype(loss.dtype)
+
+    def unscale(self, grads: PyTree, state: LossScaleState) -> Tuple[PyTree, jax.Array]:
+        inv = 1.0 / state.loss_scale
+        unscaled = jax.tree_util.tree_map(
+            lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+        return unscaled, all_finite(unscaled)
+
+    def update(self, found_finite: jax.Array, state: LossScaleState) -> LossScaleState:
+        if not self.dynamic:
+            return state
+        good = jnp.where(found_finite, state.good_steps + 1, 0)
+        bad = jnp.where(found_finite, 0, state.bad_steps + 1)
+        grow = good >= self.incr_every_n_steps
+        shrink = bad >= self.decr_every_n_nan_or_inf
+        scale = state.loss_scale
+        scale = jnp.where(grow, scale * self.incr_ratio, scale)
+        scale = jnp.where(shrink, jnp.maximum(scale * self.decr_ratio, 1.0), scale)
+        good = jnp.where(grow, 0, good)
+        bad = jnp.where(shrink, 0, bad)
+        return LossScaleState(scale, good, bad)

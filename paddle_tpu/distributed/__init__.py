@@ -1,6 +1,17 @@
 """Fleet distributed API (SURVEY §2.5)."""
 
 from .fleet import Fleet, fleet
+from .meta_optimizers import (
+    AMPOptimizer,
+    DGCMomentumOptimizer,
+    FP16AllReduceOptimizer,
+    GradientMergeOptimizer,
+    LocalSGDOptimizer,
+    MetaOptimizerBase,
+    RecomputeOptimizer,
+    apply_strategy,
+)
+from .recompute import recompute, recompute_sequential
 from .role_maker import PaddleCloudRoleMaker, Role, RoleMakerBase, UserDefinedRoleMaker
 from .strategy import DistributedStrategy
 

@@ -21,6 +21,7 @@ import json
 import os
 import threading
 import time
+import urllib.parse
 from typing import Callable, Dict, List, Optional
 
 __all__ = ["ElasticStatus", "ElasticManager", "MemoryStore", "FileStore"]
@@ -72,7 +73,9 @@ class FileStore:
         os.makedirs(root, exist_ok=True)
 
     def _path(self, key: str) -> str:
-        return os.path.join(self.root, key.replace("/", "__"))
+        # percent-encoding is invertible for any key (a '/'→'__' scheme
+        # corrupts keys whose segments themselves contain '__')
+        return os.path.join(self.root, urllib.parse.quote(key, safe=""))
 
     def put(self, key: str, value: str, ttl: float = 0.0) -> None:
         with open(self._path(key), "w") as f:
@@ -90,10 +93,9 @@ class FileStore:
 
     def list_prefix(self, prefix: str) -> Dict[str, str]:
         out = {}
-        p = prefix.replace("/", "__")
         for name in os.listdir(self.root):
-            if name.startswith(p):
-                key = name.replace("__", "/")
+            key = urllib.parse.unquote(name)
+            if key.startswith(prefix):
                 v = self.get(key)
                 if v is not None:
                     out[key] = v

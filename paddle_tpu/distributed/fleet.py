@@ -215,11 +215,18 @@ class Fleet:
     # -- optimizer ---------------------------------------------------------
 
     def distributed_optimizer(self, optimizer, strategy: Optional[DistributedStrategy] = None):
+        """Reference ``fleet.distributed_optimizer`` (fleet_base.py:1438):
+        selects meta-optimizers by strategy and returns the wrapped
+        optimizer. Sparse (PS) routing still happens via the
+        PsTrainer/communicator at the executor layer; dense strategy
+        flags (amp/dgc/lars/lamb/localsgd/gradient_merge/...) become
+        jit-traceable optimizer transforms (meta_optimizers.py)."""
         self._check_init()
         if strategy is not None:
             self._strategy = strategy
-        return optimizer  # dense path stays the compiled optimizer;
-        # sparse routing happens via PsTrainer/communicator (executor layer)
+        from .meta_optimizers import apply_strategy
+
+        return apply_strategy(optimizer, self._strategy)
 
 
 fleet = Fleet()

@@ -77,9 +77,13 @@ def launch_local(spec: JobSpec, timeout: Optional[float] = None) -> int:
             os.makedirs(spec.log_dir, exist_ok=True)
             stdout = open(os.path.join(
                 spec.log_dir, f"{role.lower()}_{rank}.log"), "w")
-        return subprocess.Popen(
-            [sys.executable] + spec.script, env=env,
-            stdout=stdout, stderr=subprocess.STDOUT if stdout else None)
+        try:
+            return subprocess.Popen(
+                [sys.executable] + spec.script, env=env,
+                stdout=stdout, stderr=subprocess.STDOUT if stdout else None)
+        finally:
+            if stdout is not None:
+                stdout.close()  # the child holds its own duplicate fd
 
     try:
         for r in range(spec.servers):

@@ -1,0 +1,52 @@
+"""Recompute / activation checkpointing
+(reference ``fleet/utils/recompute.py`` + the static-graph
+``RecomputeOptimizer`` backward-rewrite pass).
+
+The reference re-runs forward segments during backward by recording RNG
+state and replaying the ops. On TPU this is exactly
+``jax.checkpoint`` (rematerialization): XLA re-executes the segment in
+the backward pass, trading FLOPs for HBM — so the implementation is a
+thin policy-carrying wrapper, not a graph rewrite.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+
+__all__ = ["recompute", "recompute_sequential", "RECOMPUTE_POLICIES"]
+
+# Named remat policies (jax.checkpoint_policies): what intermediate
+# values are *saved* rather than recomputed.
+RECOMPUTE_POLICIES = {
+    "full": None,  # save nothing: recompute everything (reference default)
+    "dots": jax.checkpoint_policies.checkpoint_dots,
+    "dots_no_batch": jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    "everything_saveable": jax.checkpoint_policies.everything_saveable,
+}
+
+
+def recompute(function: Callable, *args, policy: Optional[str] = "full",
+              static_argnums: Sequence[int] = (), **kwargs) -> Any:
+    """``paddle.distributed.fleet.utils.recompute(function, *args)``
+    parity: run ``function`` now, recompute its activations in the
+    backward pass. RNG (dropout) correctness is automatic — JAX rngs
+    are explicit values, so replay is deterministic by construction
+    (the reference must snapshot/restore the RNG state by hand)."""
+    pol = RECOMPUTE_POLICIES[policy] if isinstance(policy, str) else policy
+    fn = jax.checkpoint(function, policy=pol, static_argnums=tuple(static_argnums))
+    return fn(*args, **kwargs)
+
+
+def recompute_sequential(functions: Sequence[Callable], x: Any,
+                         policy: Optional[str] = "full") -> Any:
+    """Checkpoint each segment of a sequential stack (the
+    ``recompute_interval`` pattern of the reference's PipelineLayer —
+    pp_layers.py ``_recompute``): each element of ``functions`` is one
+    remat unit."""
+    pol = RECOMPUTE_POLICIES[policy] if isinstance(policy, str) else policy
+    for fn in functions:
+        x = jax.checkpoint(fn, policy=pol)(x)
+    return x

@@ -65,9 +65,23 @@ class DistributedStrategy:
                                  "sharding_degree": 1, "cp_degree": 1, "ep_degree": 1}
     )
     lamb: bool = False
+    lamb_configs: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"lamb_weight_decay": 0.01}
+    )
     lars: bool = False
+    lars_configs: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"lars_coeff": 0.001, "lars_weight_decay": 0.0005}
+    )
     localsgd: bool = False
+    localsgd_configs: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"k_steps": 1}
+    )
     dgc: bool = False
+    dgc_configs: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"rampup_begin_step": 0, "rampup_step": 1,
+                                 "sparsity": [0.999]}
+    )
+    fp16_allreduce: bool = False
 
     # --- misc ---
     find_unused_parameters: bool = False

@@ -51,11 +51,14 @@ def make_train_step(
                 training=True,
             )
             loss = loss_fn(out, *labels)
-            return loss, new_state["buffers"]
+            # AMP loss scaling: grads are taken of the scaled loss; the
+            # AMPOptimizer unscales them inside update (amp.GradScaler)
+            scaled = (optimizer.scale_loss(loss, opt_state)
+                      if hasattr(optimizer, "scale_loss") else loss)
+            return scaled, (loss, new_state["buffers"])
 
-        (loss, new_buffers), grads = jax.value_and_grad(compute_loss, has_aux=True)(
-            state["params"]
-        )
+        (_, (loss, new_buffers)), grads = jax.value_and_grad(
+            compute_loss, has_aux=True)(state["params"])
         new_params, new_opt_state = optimizer.update(grads, opt_state, state["params"])
         return {"params": new_params, "buffers": new_buffers}, new_opt_state, loss
 

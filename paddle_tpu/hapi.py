@@ -111,6 +111,8 @@ class Model:
             epochs: int = 1, callbacks: Optional[Sequence[Callback]] = None,
             verbose: int = 1) -> Dict[str, List[float]]:
         self._check_prepared()
+        self.stop_training = False  # a previous early-stopped fit must not
+        # leak into this one (keras/paddle hapi reset it per fit)
         cbs = list(callbacks or [])
         if verbose:
             cbs.append(ProgBarLogger(verbose=verbose))
@@ -175,14 +177,17 @@ class Model:
     # -- save/load ---------------------------------------------------------
 
     def save(self, path: str) -> None:
+        """Writes the shared checkpoint schema ({"model","opt","step"},
+        io/checkpoint.py) so Model.save and save_checkpoint files are
+        interchangeable."""
         self._check_prepared()
-        ckpt.save({"state": jax.device_get(self._state),
-                   "opt_state": jax.device_get(self._opt_state)}, path)
+        ckpt.save_checkpoint(path, jax.device_get(self._state),
+                             jax.device_get(self._opt_state))
 
     def load(self, path: str) -> None:
         self._check_prepared()
-        blob = ckpt.load(path)
-        self._state = blob["state"]
-        if blob.get("opt_state") is not None and self._opt is not None:
-            self._opt_state = blob["opt_state"]
+        blob = ckpt.load_checkpoint(path)
+        self._state = blob["model"]
+        if blob.get("opt") is not None and self._opt is not None:
+            self._opt_state = blob["opt"]
         nn.set_state(self.network, self._state)

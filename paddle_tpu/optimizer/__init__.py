@@ -32,6 +32,9 @@ __all__ = [
     "Adam",
     "AdamW",
     "Adagrad",
+    "RMSProp",
+    "Lars",
+    "Lamb",
     "ClipGradByGlobalNorm",
     "ClipGradByNorm",
     "ClipGradByValue",
@@ -141,6 +144,45 @@ class lr:
             warm = base_lr * s / jnp.maximum(warmup_steps, 1)
             decay = base_lr * jnp.maximum(0.0, (total_steps - s) / jnp.maximum(total_steps - warmup_steps, 1))
             return jnp.where(s < warmup_steps, warm, decay)
+
+        return _LambdaLR(fn)
+
+    @staticmethod
+    def piecewise_decay(boundaries, values) -> _LRSchedule:
+        """``paddle.optimizer.lr.PiecewiseDecay``: step-indexed constant
+        segments."""
+        bnd = jnp.asarray(list(boundaries), jnp.int32)
+        val = jnp.asarray(list(values), jnp.float32)
+
+        def fn(step):
+            idx = jnp.sum((step >= bnd).astype(jnp.int32))
+            return val[idx]
+
+        return _LambdaLR(fn)
+
+    @staticmethod
+    def polynomial_decay(base_lr: float, decay_steps: int, end_lr: float = 0.0,
+                         power: float = 1.0) -> _LRSchedule:
+        def fn(step):
+            t = jnp.minimum(step.astype(jnp.float32), decay_steps) / decay_steps
+            return (base_lr - end_lr) * jnp.power(1.0 - t, power) + end_lr
+
+        return _LambdaLR(fn)
+
+    @staticmethod
+    def noam_decay(d_model: int, warmup_steps: int, base_lr: float = 1.0) -> _LRSchedule:
+        """``paddle.optimizer.lr.NoamDecay`` (transformer schedule)."""
+
+        def fn(step):
+            s = jnp.maximum(step.astype(jnp.float32), 1.0)
+            return base_lr * d_model ** -0.5 * jnp.minimum(s ** -0.5, s * warmup_steps ** -1.5)
+
+        return _LambdaLR(fn)
+
+    @staticmethod
+    def step_decay(base_lr: float, step_size: int, gamma: float = 0.1) -> _LRSchedule:
+        def fn(step):
+            return base_lr * jnp.power(gamma, (step // step_size).astype(jnp.float32))
 
         return _LambdaLR(fn)
 
@@ -293,4 +335,119 @@ class Adagrad(Optimizer):
         return (
             _tree_map(lambda pr: pr[0], pairs, is_leaf=is_leaf),
             _tree_map(lambda pr: pr[1], pairs, is_leaf=is_leaf),
+        )
+
+
+class RMSProp(Optimizer):
+    """``paddle.optimizer.RMSProp`` (phi/kernels rmsprop_kernel semantics:
+    centered=False, rho/epsilon/momentum)."""
+
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6, momentum=0.0, **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.rho, self.epsilon, self.momentum = float(rho), float(epsilon), float(momentum)
+
+    def _init_slots(self, params):
+        return {
+            "mean_sq": _tree_map(jnp.zeros_like, params),
+            "mom": _tree_map(jnp.zeros_like, params),
+        }
+
+    def _apply(self, grads, slots, params, lr_t, step):
+        def upd(p, g, ms, mom):
+            g = self._decay_grad(g, p)
+            ms_new = self.rho * ms + (1 - self.rho) * jnp.square(g)
+            mom_new = self.momentum * mom + lr_t * g / jnp.sqrt(ms_new + self.epsilon)
+            return p - mom_new, ms_new, mom_new
+
+        triples = _tree_map(upd, params, grads, slots["mean_sq"], slots["mom"])
+        is_leaf = lambda x: isinstance(x, tuple)
+        return (
+            _tree_map(lambda tr: tr[0], triples, is_leaf=is_leaf),
+            {
+                "mean_sq": _tree_map(lambda tr: tr[1], triples, is_leaf=is_leaf),
+                "mom": _tree_map(lambda tr: tr[2], triples, is_leaf=is_leaf),
+            },
+        )
+
+
+class Lars(Optimizer):
+    """LARS momentum (reference operators/optimizers/lars_momentum_op.cc,
+    fleet `lars` strategy): layer-wise trust ratio
+    ``local_lr = lr * lars_coeff * ||p|| / (||g|| + wd * ||p|| + eps)``,
+    then momentum on the locally-scaled gradient."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, epsilon=0.0, **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.momentum = float(momentum)
+        self.lars_coeff = float(lars_coeff)
+        self.lars_weight_decay = float(lars_weight_decay)
+        self.epsilon = float(epsilon)
+
+    def _init_slots(self, params):
+        return _tree_map(jnp.zeros_like, params)
+
+    def _apply(self, grads, slots, params, lr_t, step):
+        def upd(p, g, v):
+            pf, gf = p.astype(jnp.float32), g.astype(jnp.float32)
+            p_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
+            g_norm = jnp.sqrt(jnp.sum(jnp.square(gf)))
+            local_lr = jnp.where(
+                (p_norm > 0) & (g_norm > 0),
+                lr_t * self.lars_coeff * p_norm
+                / (g_norm + self.lars_weight_decay * p_norm + self.epsilon),
+                lr_t,
+            )
+            v_new = self.momentum * v + local_lr * (gf + self.lars_weight_decay * pf)
+            return (pf - v_new).astype(p.dtype), v_new
+
+        pairs = _tree_map(upd, params, grads, slots)
+        is_leaf = lambda x: isinstance(x, tuple)
+        return (
+            _tree_map(lambda pr: pr[0], pairs, is_leaf=is_leaf),
+            _tree_map(lambda pr: pr[1], pairs, is_leaf=is_leaf),
+        )
+
+
+class Lamb(Optimizer):
+    """LAMB (reference operators/optimizers/lamb_op.cc, fleet `lamb`
+    strategy): Adam moments + per-layer trust ratio ``||p|| / ||r||``
+    where ``r = m_hat / (sqrt(v_hat)+eps) + wd * p``."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.lamb_weight_decay = float(lamb_weight_decay)
+        self.beta1, self.beta2, self.epsilon = float(beta1), float(beta2), float(epsilon)
+
+    def _init_slots(self, params):
+        return {
+            "m": _tree_map(jnp.zeros_like, params),
+            "v": _tree_map(jnp.zeros_like, params),
+        }
+
+    def _apply(self, grads, slots, params, lr_t, step):
+        t = (step + 1).astype(jnp.float32)
+        bc1 = 1 - jnp.power(self.beta1, t)
+        bc2 = 1 - jnp.power(self.beta2, t)
+
+        def upd(p, g, m, v):
+            pf, gf = p.astype(jnp.float32), g.astype(jnp.float32)
+            m_new = self.beta1 * m + (1 - self.beta1) * gf
+            v_new = self.beta2 * v + (1 - self.beta2) * jnp.square(gf)
+            r = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + self.epsilon) \
+                + self.lamb_weight_decay * pf
+            p_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
+            r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+            trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+            return (pf - lr_t * trust * r).astype(p.dtype), m_new, v_new
+
+        triples = _tree_map(upd, params, grads, slots["m"], slots["v"])
+        is_leaf = lambda x: isinstance(x, tuple)
+        return (
+            _tree_map(lambda tr: tr[0], triples, is_leaf=is_leaf),
+            {
+                "m": _tree_map(lambda tr: tr[1], triples, is_leaf=is_leaf),
+                "v": _tree_map(lambda tr: tr[2], triples, is_leaf=is_leaf),
+            },
         )

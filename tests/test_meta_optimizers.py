@@ -1,0 +1,253 @@
+"""Meta-optimizer chain tests (reference fleet/meta_optimizers/ + its
+unittests test_fleet_amp_meta_optimizer.py, test_fleet_gradient_merge_
+meta_optimizer.py, test_fleet_localsgd_meta_optimizer.py,
+test_fleet_lars_meta_optimizer.py, test_fleet_dgc_meta_optimizer.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import optimizer as opt_mod
+from paddle_tpu.amp import GradScaler
+from paddle_tpu.distributed import DistributedStrategy, apply_strategy
+from paddle_tpu.distributed.meta_optimizers import (
+    AMPOptimizer,
+    DGCMomentumOptimizer,
+    GradientMergeOptimizer,
+    LocalSGDOptimizer,
+)
+from paddle_tpu.distributed.recompute import recompute
+
+
+def _params():
+    return {"w": jnp.ones((4, 4), jnp.float32), "b": jnp.zeros((4,), jnp.float32)}
+
+
+def _grads(scale=1.0):
+    return {"w": jnp.full((4, 4), 0.5 * scale, jnp.float32),
+            "b": jnp.full((4,), 0.1 * scale, jnp.float32)}
+
+
+class TestGradientMerge:
+    def test_applies_every_k_steps(self):
+        inner = opt_mod.SGD(learning_rate=1.0)
+        gm = GradientMergeOptimizer(inner, k_steps=3, avg=True)
+        params = _params()
+        state = gm.init(params)
+        for i in range(2):
+            params, state = gm.update(_grads(), state, params)
+            # held: params unchanged
+            np.testing.assert_allclose(params["w"], 1.0)
+        params, state = gm.update(_grads(), state, params)
+        # applied once with the averaged grad (= the grad itself here)
+        np.testing.assert_allclose(params["w"], 1.0 - 0.5, rtol=1e-6)
+        assert int(state["count"]) == 0
+
+    def test_sum_mode(self):
+        gm = GradientMergeOptimizer(opt_mod.SGD(1.0), k_steps=2, avg=False)
+        params = _params()
+        state = gm.init(params)
+        params, state = gm.update(_grads(), state, params)
+        params, state = gm.update(_grads(), state, params)
+        np.testing.assert_allclose(params["w"], 1.0 - 2 * 0.5, rtol=1e-6)
+
+    def test_jit_compiles(self):
+        gm = GradientMergeOptimizer(opt_mod.Adam(0.01), k_steps=2)
+        params = _params()
+        state = gm.init(params)
+        step = jax.jit(gm.update)
+        params, state = step(_grads(), state, params)
+        params, state = step(_grads(), state, params)
+        assert np.isfinite(np.asarray(params["w"])).all()
+
+
+class TestAMP:
+    def test_skips_nonfinite_and_decays_scale(self):
+        amp = AMPOptimizer(opt_mod.SGD(1.0), init_loss_scaling=1024.0,
+                           decr_every_n_nan_or_inf=1, decr_ratio=0.5)
+        params = _params()
+        state = amp.init(params)
+        bad = {"w": jnp.full((4, 4), jnp.nan), "b": jnp.zeros((4,))}
+        params2, state = amp.update(bad, state, params)
+        np.testing.assert_allclose(params2["w"], params["w"])  # skipped
+        assert float(state["scaler"].loss_scale) == 512.0
+
+    def test_applies_unscaled(self):
+        amp = AMPOptimizer(opt_mod.SGD(1.0), init_loss_scaling=8.0,
+                           use_dynamic_loss_scaling=False)
+        params = _params()
+        state = amp.init(params)
+        # grads of the 8x-scaled loss
+        scaled_grads = _grads(scale=8.0)
+        params, state = amp.update(scaled_grads, state, params)
+        np.testing.assert_allclose(params["w"], 1.0 - 0.5, rtol=1e-6)
+
+    def test_scale_growth(self):
+        amp = AMPOptimizer(opt_mod.SGD(0.1), init_loss_scaling=4.0,
+                           incr_every_n_steps=2, incr_ratio=2.0)
+        params = _params()
+        state = amp.init(params)
+        for _ in range(2):
+            params, state = amp.update(_grads(scale=4.0), state, params)
+        assert float(state["scaler"].loss_scale) == 8.0
+
+
+class TestGradScaler:
+    def test_roundtrip(self):
+        sc = GradScaler(init_loss_scaling=16.0)
+        st = sc.init()
+        loss = jnp.asarray(2.0)
+        assert float(sc.scale(loss, st)) == 32.0
+        grads, ok = sc.unscale({"g": jnp.asarray(32.0)}, st)
+        assert bool(ok) and float(grads["g"]) == 2.0
+
+
+class TestDGC:
+    def test_residual_bookkeeping(self):
+        dgc = DGCMomentumOptimizer(opt_mod.SGD(1.0), momentum=0.0,
+                                   rampup_begin_step=0, sparsity=[0.75])
+        params = {"w": jnp.zeros((16,), jnp.float32)}
+        grads = {"w": jnp.asarray(np.arange(16, dtype=np.float32))}
+        state = dgc.init(params)
+        params, state = dgc.update(grads, state, params)
+        # only the top quartile released; the rest retained in residual v
+        released = -np.asarray(params["w"])  # sgd lr=1: delta == released grad
+        assert (released > 0).sum() <= 5
+        v = np.asarray(state["v"]["w"])
+        np.testing.assert_allclose(released + v, np.arange(16), rtol=1e-6)
+
+    def test_pre_rampup_is_momentum(self):
+        dgc = DGCMomentumOptimizer(opt_mod.SGD(1.0), momentum=0.0,
+                                   rampup_begin_step=100, sparsity=[0.99])
+        params = _params()
+        state = dgc.init(params)
+        params, state = dgc.update(_grads(), state, params)
+        np.testing.assert_allclose(params["w"], 1.0 - 0.5, rtol=1e-6)
+
+
+class TestLocalSGD:
+    def test_sync_every_k(self):
+        calls = []
+
+        def fake_sync(tree):
+            calls.append(1)
+            return jax.tree_util.tree_map(lambda x: x * 0 + 7.0, tree)
+
+        ls = LocalSGDOptimizer(opt_mod.SGD(1.0), k_steps=2, sync_fn=fake_sync)
+        params = _params()
+        state = ls.init(params)
+        params, state = ls.update(_grads(), state, params)
+        assert float(params["w"][0, 0]) != 7.0
+        params, state = ls.update(_grads(), state, params)
+        np.testing.assert_allclose(params["w"], 7.0)
+
+    def test_pmean_under_shard_map(self):
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        devs = np.array(jax.devices()[:4]).reshape(4)
+        mesh = Mesh(devs, ("dp",))
+        ls = LocalSGDOptimizer(opt_mod.SGD(1.0), k_steps=1, axis="dp")
+        params = {"w": jnp.zeros((4, 2), jnp.float32)}
+        state = ls.init(params)
+        grads = {"w": jnp.tile(jnp.arange(4, dtype=jnp.float32)[:, None], (1, 2))}
+
+        def step(p, s, g):
+            return ls.update(g, s, p)
+
+        fn = shard_map(step, mesh=mesh,
+                       in_specs=(P("dp"), P(), P("dp")),
+                       out_specs=(P("dp"), P()))
+        new_params, _ = jax.jit(fn)(params, state, grads)
+        # per-device grads 0..3, lr 1 → local params -g, pmean → -1.5
+        np.testing.assert_allclose(new_params["w"], -1.5)
+
+
+class TestLarsLamb:
+    def test_lars_trust_ratio(self):
+        lars = opt_mod.Lars(learning_rate=0.1, momentum=0.9, lars_coeff=0.001)
+        params = _params()
+        state = lars.init(params)
+        new_params, state = lars.update(_grads(), state, params)
+        assert not np.allclose(new_params["w"], params["w"])
+        # zero-norm bias path falls back to plain lr (no NaN)
+        assert np.isfinite(np.asarray(new_params["b"])).all()
+
+    def test_lamb_matches_adam_direction(self):
+        lamb = opt_mod.Lamb(learning_rate=0.01, lamb_weight_decay=0.0)
+        params = _params()
+        state = lamb.init(params)
+        new_params, _ = lamb.update(_grads(), state, params)
+        assert np.all(np.asarray(new_params["w"]) < 1.0)
+
+    def test_rmsprop(self):
+        rms = opt_mod.RMSProp(learning_rate=0.01)
+        params = _params()
+        state = rms.init(params)
+        new_params, _ = rms.update(_grads(), state, params)
+        assert np.isfinite(np.asarray(new_params["w"])).all()
+
+
+class TestStrategyCompiler:
+    def test_chain_order(self):
+        strategy = DistributedStrategy(amp=True, gradient_merge=True,
+                                       gradient_merge_configs={"k_steps": 2})
+        base = opt_mod.Momentum(0.1)
+        chained = apply_strategy(base, strategy)
+        assert isinstance(chained, AMPOptimizer)
+        assert isinstance(chained.inner, GradientMergeOptimizer)
+        assert chained.inner.inner is base
+
+    def test_lars_swap(self):
+        strategy = DistributedStrategy(lars=True)
+        chained = apply_strategy(opt_mod.Momentum(0.1), strategy)
+        assert isinstance(chained, opt_mod.Lars)
+
+    def test_dgc_requires_momentum(self):
+        strategy = DistributedStrategy(dgc=True)
+        with pytest.raises(Exception):
+            apply_strategy(opt_mod.Adam(0.1), strategy)
+
+    def test_full_chain_trains(self):
+        strategy = DistributedStrategy(amp=True, gradient_merge=True,
+                                       gradient_merge_configs={"k_steps": 2},
+                                       localsgd=True,
+                                       localsgd_configs={"k_steps": 4})
+        # localsgd pmean needs an axis; use identity sync for the
+        # single-process numerical check
+        from paddle_tpu.distributed.meta_optimizers import LocalSGDOptimizer as LS
+
+        opt = apply_strategy(opt_mod.SGD(0.5), strategy)
+        # swap in identity sync (no named axis outside shard_map)
+        node = opt
+        while node is not None:
+            if isinstance(node, LS):
+                node._sync = lambda t: t
+            node = getattr(node, "inner", None)
+        params = _params()
+        state = opt.init(params)
+        step = jax.jit(opt.update)
+        for _ in range(4):
+            params, state = step(_grads(), state, params)
+        assert np.isfinite(np.asarray(params["w"])).all()
+        assert float(params["w"][0, 0]) < 1.0
+
+
+class TestRecompute:
+    def test_matches_plain_grad(self):
+        def f(x):
+            return jnp.sum(jnp.tanh(x @ x.T))
+
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 8)), jnp.float32)
+        g_plain = jax.grad(f)(x)
+        g_remat = jax.grad(lambda x: recompute(f, x))(x)
+        np.testing.assert_allclose(np.asarray(g_plain), np.asarray(g_remat), rtol=1e-6)
+
+    def test_policy_names(self):
+        def f(x):
+            return jnp.sum(x * x)
+
+        x = jnp.ones((4,))
+        for pol in ("full", "dots", "nothing_saveable"):
+            assert np.isfinite(float(recompute(f, x, policy=pol)))
