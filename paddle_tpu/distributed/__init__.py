@@ -1,6 +1,31 @@
 """Fleet distributed API (SURVEY §2.5)."""
 
+from .collective import (
+    Group,
+    ParallelEnv,
+    TCPStore,
+    all_gather,
+    all_reduce,
+    alltoall,
+    barrier,
+    broadcast,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+    new_group,
+    scatter,
+)
+from .auto_parallel import Engine, ProcessMesh, shard_op, shard_tensor
 from .fleet import Fleet, fleet
+from .fleet_executor import (
+    Carrier,
+    ComputeInterceptor,
+    FleetExecutor,
+    InterceptorMessage,
+    MessageBus,
+    TaskNode,
+)
 from .meta_optimizers import (
     AMPOptimizer,
     DGCMomentumOptimizer,

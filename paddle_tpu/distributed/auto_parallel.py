@@ -1,0 +1,245 @@
+"""Auto-parallel (reference ``python/paddle/distributed/auto_parallel/``).
+
+The reference's semi-automatic pipeline — ``ProcessMesh`` + per-tensor
+``dims_mapping`` dist-attrs (interface.py shard_tensor), a ``Completer``
+that propagates annotations over the graph (completion.py), a
+``Partitioner`` that rewrites the serial program into per-rank programs
+(partitioner.py), ``Resharder`` inserting send/recv for mismatched
+shardings (reshard.py), all driven by ``Engine`` (engine.py:50) —
+maps almost one-to-one onto GSPMD:
+
+- ``ProcessMesh``            → ``jax.sharding.Mesh`` (named axes)
+- ``shard_tensor(dims_mapping)`` → ``NamedSharding``/``device_put`` (data)
+  or ``lax.with_sharding_constraint`` (in-graph annotation)
+- Completer + Partitioner + Resharder → XLA's GSPMD propagation pass:
+  jit with a few annotations *is* the completion algorithm, and resharding
+  collectives are inserted by the compiler.
+
+``Engine`` keeps the reference's prepare/fit/evaluate/predict surface.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core.enforce import InvalidArgumentError, enforce
+from .. import nn
+from ..optimizer import Optimizer
+
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "annotate", "Engine"]
+
+
+class ProcessMesh:
+    """Reference ``ProcessMesh`` (process_mesh.py): an N-D array of
+    process/device ids with named dimensions. Thin wrapper producing a
+    ``jax.sharding.Mesh`` over the local device set."""
+
+    def __init__(self, mesh: Optional[Sequence] = None,
+                 dim_names: Optional[Sequence[str]] = None,
+                 shape: Optional[Sequence[int]] = None) -> None:
+        if shape is None:
+            arr = np.asarray(mesh if mesh is not None else [])
+            shape = arr.shape if arr.size else (len(jax.devices()),)
+        self.shape = tuple(int(s) for s in shape)
+        self.dim_names = list(dim_names or [f"d{i}" for i in range(len(self.shape))])
+        enforce(len(self.dim_names) == len(self.shape),
+                "dim_names must match mesh rank")
+        n = int(np.prod(self.shape))
+        devs = jax.devices()
+        enforce(n <= len(devs), f"mesh wants {n} devices, have {len(devs)}")
+        self.jax_mesh = Mesh(np.asarray(devs[:n]).reshape(self.shape),
+                             tuple(self.dim_names))
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def __repr__(self) -> str:
+        return f"ProcessMesh(shape={self.shape}, dim_names={self.dim_names})"
+
+
+def _spec_from_dims_mapping(mesh: ProcessMesh, dims_mapping: Sequence[Optional[int]]
+                            ) -> PartitionSpec:
+    """dims_mapping[i] = index of the mesh dim tensor-dim i is split
+    over, or None/-1 for replicated (the reference's convention)."""
+    entries = []
+    for m in dims_mapping:
+        if m is None or m == -1:
+            entries.append(None)
+        else:
+            enforce(0 <= m < mesh.ndim, f"dims_mapping entry {m} out of range")
+            entries.append(mesh.dim_names[m])
+    return PartitionSpec(*entries)
+
+
+def shard_tensor(x, process_mesh: ProcessMesh,
+                 dims_mapping: Sequence[Optional[int]]):
+    """Reference ``auto_parallel.shard_tensor`` (interface.py): attach a
+    sharding to a concrete array (device_put) or, when traced inside
+    jit, constrain the intermediate's sharding so GSPMD completes the
+    rest of the graph around it."""
+    spec = _spec_from_dims_mapping(process_mesh, dims_mapping)
+    sharding = NamedSharding(process_mesh.jax_mesh, spec)
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, sharding)
+    return jax.device_put(jnp.asarray(x), sharding)
+
+
+def annotate(x, process_mesh: ProcessMesh, dims_mapping: Sequence[Optional[int]]):
+    """In-graph-only spelling of shard_tensor."""
+    spec = _spec_from_dims_mapping(process_mesh, dims_mapping)
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(process_mesh.jax_mesh, spec))
+
+
+def shard_op(fn: Callable, process_mesh: ProcessMesh,
+             out_dims_mappings: Optional[Sequence[Sequence[Optional[int]]]] = None
+             ) -> Callable:
+    """Reference ``shard_op``: annotate an op's outputs. GSPMD then
+    propagates through the op body."""
+
+    def wrapped(*args, **kwargs):
+        out = fn(*args, **kwargs)
+        if out_dims_mappings is None:
+            return out
+        outs = out if isinstance(out, tuple) else (out,)
+        enforce(len(outs) == len(out_dims_mappings),
+                "one dims_mapping per output")
+        annotated = tuple(
+            annotate(o, process_mesh, dm) for o, dm in zip(outs, out_dims_mappings))
+        return annotated if isinstance(out, tuple) else annotated[0]
+
+    return wrapped
+
+
+class Engine:
+    """Reference ``Engine`` (auto_parallel/engine.py:50): prepare →
+    fit/evaluate/predict with automatic distribution. Here "planning +
+    partitioning" is jit compilation over the ProcessMesh; the returned
+    input shardings (``completion()``) show what GSPMD chose."""
+
+    def __init__(self, model: nn.Layer, loss_fn: Callable,
+                 optimizer: Optimizer, process_mesh: Optional[ProcessMesh] = None,
+                 batch_dim_mesh_axis: Optional[str] = None) -> None:
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.process_mesh = process_mesh or ProcessMesh(
+            shape=(len(jax.devices()),), dim_names=("dp",))
+        self.batch_axis = batch_dim_mesh_axis or self.process_mesh.dim_names[0]
+        self._prepared = False
+
+    # -- prepare (plan + partition, engine.py prepare/_build) ------------
+
+    def prepare(self) -> None:
+        mesh = self.process_mesh.jax_mesh
+        state = nn.get_state(self.model)
+        opt_state = self.optimizer.init(state["params"])
+        repl = NamedSharding(mesh, PartitionSpec())
+        batch_sh = NamedSharding(mesh, PartitionSpec(self.batch_axis))
+        self._state = jax.device_put(state, repl)
+        self._opt_state = jax.device_put(opt_state, repl)
+        self._rng = jax.random.key(0)
+
+        model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
+
+        def step(state, opt_state, rng, inputs, labels):
+            def compute_loss(params):
+                out, new_state = nn.functional_call(
+                    model, {"params": params, "buffers": state["buffers"]},
+                    *inputs, rng=rng, training=True)
+                loss = loss_fn(out, *labels)
+                scaled = (optimizer.scale_loss(loss, opt_state)
+                          if hasattr(optimizer, "scale_loss") else loss)
+                return scaled, (loss, new_state["buffers"])
+
+            (_, (loss, new_buffers)), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(state["params"])
+            new_params, new_opt = optimizer.update(grads, opt_state, state["params"])
+            return {"params": new_params, "buffers": new_buffers}, new_opt, loss
+
+        self._batch_sh = batch_sh
+        self._step = jax.jit(step, donate_argnums=(0, 1))
+
+        def fwd(state, inputs):
+            out, _ = nn.functional_call(model, state, *inputs, training=False)
+            return out
+
+        self._fwd = jax.jit(fwd)
+        self._prepared = True
+
+    def _shard_batch(self, arrs) -> Tuple:
+        return tuple(
+            jax.device_put(jnp.asarray(a), self._batch_sh) for a in arrs)
+
+    # -- train/eval/predict (engine.py fit:…, evaluate, predict) ---------
+
+    def fit(self, train_data: Iterable, epochs: int = 1,
+            log_every: int = 0) -> List[float]:
+        if not self._prepared:
+            self.prepare()
+        losses: List[float] = []
+        step_no = 0
+        for _ in range(epochs):
+            for inputs, labels in train_data:
+                ins = inputs if isinstance(inputs, (tuple, list)) else (inputs,)
+                lbs = labels if isinstance(labels, (tuple, list)) else (labels,)
+                self._rng, sub = jax.random.split(self._rng)
+                self._state, self._opt_state, loss = self._step(
+                    self._state, self._opt_state, sub,
+                    self._shard_batch(ins), self._shard_batch(lbs))
+                losses.append(float(loss))
+                step_no += 1
+                if log_every and step_no % log_every == 0:
+                    print(f"[auto_parallel] step {step_no} loss {losses[-1]:.4f}")
+        return losses
+
+    def evaluate(self, data: Iterable, metric_fn: Optional[Callable] = None
+                 ) -> float:
+        if not self._prepared:
+            self.prepare()
+        total, n = 0.0, 0
+        for inputs, labels in data:
+            ins = inputs if isinstance(inputs, (tuple, list)) else (inputs,)
+            lbs = labels if isinstance(labels, (tuple, list)) else (labels,)
+            out = self._fwd(self._state, self._shard_batch(ins))
+            if metric_fn is not None:
+                total += float(metric_fn(out, *lbs))
+            else:
+                total += float(self.loss_fn(out, *(jnp.asarray(l) for l in lbs)))
+            n += 1
+        return total / max(n, 1)
+
+    def predict(self, inputs):
+        if not self._prepared:
+            self.prepare()
+        ins = inputs if isinstance(inputs, (tuple, list)) else (inputs,)
+        return self._fwd(self._state, self._shard_batch(ins))
+
+    # -- introspection ----------------------------------------------------
+
+    def completion(self, example_inputs, example_labels) -> Dict[str, Any]:
+        """What the reference's Completer decides by propagation, read
+        back from the compiled executable: the shardings GSPMD chose for
+        params and outputs."""
+        if not self._prepared:
+            self.prepare()
+        ins = tuple(jnp.asarray(a) for a in (
+            example_inputs if isinstance(example_inputs, (tuple, list))
+            else (example_inputs,)))
+        lbs = tuple(jnp.asarray(a) for a in (
+            example_labels if isinstance(example_labels, (tuple, list))
+            else (example_labels,)))
+        lowered = self._step.lower(
+            self._state, self._opt_state, self._rng,
+            self._shard_batch(ins), self._shard_batch(lbs))
+        compiled = lowered.compile()
+        return {
+            "input_shardings": compiled.input_shardings,
+            "output_shardings": compiled.output_shardings,
+        }

@@ -1,0 +1,380 @@
+"""Process-level collective API (``paddle.distributed`` surface).
+
+Mirrors the reference's python/paddle/distributed/{collective.py,
+parallel.py}: ``init_parallel_env`` (parallel.py:91), ``new_group``
+(collective.py:314), eager ``all_reduce``/``all_gather``/``broadcast``/
+``scatter``/``barrier`` (collective.py:580,798,893,266) and the
+``TCPStore`` rendezvous (distributed/store/tcp_store.h:91).
+
+TPU-first split of responsibilities:
+- **In-graph** collectives (inside jit/shard_map) live in
+  ``paddle_tpu.ops.collectives`` — XLA schedules them over ICI.
+- **This module** is the *host/control plane*: multi-process bootstrap
+  rides ``jax.distributed`` (the JAX coordination service is the
+  TCPStore/NCCL-unique-id exchange equivalent over DCN), and eager
+  host-side tensor collectives use ``jax.experimental.multihost_utils``.
+  A pure-Python ``TCPStore`` is provided for rendezvous/metrics/barrier
+  where the coordination service isn't up (launcher, elastic, tests) —
+  same role as the reference's brpc-free TCP store.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.enforce import InvalidArgumentError, PreconditionNotMetError, enforce
+
+__all__ = [
+    "TCPStore",
+    "ParallelEnv",
+    "init_parallel_env",
+    "get_rank",
+    "get_world_size",
+    "is_initialized",
+    "new_group",
+    "Group",
+    "all_reduce",
+    "all_gather",
+    "broadcast",
+    "scatter",
+    "alltoall",
+    "barrier",
+]
+
+
+# ---------------------------------------------------------------------------
+# TCPStore: key-value rendezvous (tcp_store.h:91 — MasterDaemon + clients)
+# ---------------------------------------------------------------------------
+
+class _StoreState:
+    def __init__(self) -> None:
+        self.kv: Dict[str, str] = {}
+        self.cond = threading.Condition()
+
+
+class _StoreHandler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        state: _StoreState = self.server.state  # type: ignore[attr-defined]
+        for line in self.rfile:
+            try:
+                req = json.loads(line)
+            except ValueError:
+                break
+            cmd = req.get("cmd")
+            with state.cond:
+                if cmd == "set":
+                    state.kv[req["key"]] = req["value"]
+                    state.cond.notify_all()
+                    resp = {"ok": True}
+                elif cmd == "get":
+                    resp = {"ok": True, "value": state.kv.get(req["key"])}
+                elif cmd == "add":
+                    cur = int(state.kv.get(req["key"], "0")) + int(req["delta"])
+                    state.kv[req["key"]] = str(cur)
+                    state.cond.notify_all()
+                    resp = {"ok": True, "value": str(cur)}
+                elif cmd == "wait":
+                    deadline = time.monotonic() + float(req.get("timeout", 300.0))
+                    keys = req["keys"]
+                    ok = True
+                    while not all(k in state.kv for k in keys):
+                        left = deadline - time.monotonic()
+                        if left <= 0 or not state.cond.wait(timeout=min(left, 1.0)):
+                            if time.monotonic() >= deadline:
+                                ok = False
+                                break
+                    resp = {"ok": ok}
+                elif cmd == "delete":
+                    resp = {"ok": state.kv.pop(req["key"], None) is not None}
+                else:
+                    resp = {"ok": False, "error": f"unknown cmd {cmd}"}
+            self.wfile.write((json.dumps(resp) + "\n").encode())
+            self.wfile.flush()
+
+
+class _StoreServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class TCPStore:
+    """Reference ``TCPStore`` (tcp_store.h:91): rank 0 (``is_master``)
+    runs the daemon; every rank connects as a client. Blocking ``wait``
+    and atomic ``add`` give barrier/rendezvous semantics."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, timeout: float = 300.0) -> None:
+        self.timeout = float(timeout)
+        self._server: Optional[_StoreServer] = None
+        if is_master:
+            self._server = _StoreServer((host, port), _StoreHandler)
+            self._server.state = _StoreState()  # type: ignore[attr-defined]
+            port = self._server.server_address[1]
+            threading.Thread(target=self._server.serve_forever,
+                             daemon=True).start()
+        self.host, self.port = host, port
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._rfile = self._sock.makefile("rb")
+        self._lock = threading.Lock()
+        self._barrier_rounds: Dict[str, int] = {}
+
+    def _rpc(self, **req) -> Dict[str, Any]:
+        with self._lock:
+            self._sock.sendall((json.dumps(req) + "\n").encode())
+            line = self._rfile.readline()
+        if not line:
+            raise PreconditionNotMetError("TCPStore connection closed")
+        return json.loads(line)
+
+    def set(self, key: str, value: str) -> None:
+        self._rpc(cmd="set", key=key, value=value)
+
+    def get(self, key: str) -> Optional[str]:
+        return self._rpc(cmd="get", key=key)["value"]
+
+    def add(self, key: str, delta: int = 1) -> int:
+        return int(self._rpc(cmd="add", key=key, delta=delta)["value"])
+
+    def wait(self, keys: Sequence[str], timeout: Optional[float] = None) -> None:
+        ok = self._rpc(cmd="wait", keys=list(keys),
+                       timeout=timeout or self.timeout)["ok"]
+        if not ok:
+            raise PreconditionNotMetError(f"TCPStore wait timed out on {keys}")
+
+    def delete(self, key: str) -> bool:
+        return self._rpc(cmd="delete", key=key)["ok"]
+
+    def barrier(self, name: str, world_size: int,
+                timeout: Optional[float] = None) -> None:
+        # per-round keys: a reused barrier name must re-synchronize each
+        # round, so each client tracks its local round counter (all
+        # participants call barriers the same number of times)
+        rnd = self._barrier_rounds.get(name, 0)
+        self._barrier_rounds[name] = rnd + 1
+        n = self.add(f"__barrier/{name}/{rnd}/count", 1)
+        if n >= world_size:
+            self.set(f"__barrier/{name}/{rnd}/done", "1")
+        self.wait([f"__barrier/{name}/{rnd}/done"], timeout)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        finally:
+            if self._server is not None:
+                self._server.shutdown()
+                self._server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# Parallel env (parallel.py:91 init_parallel_env / ParallelEnv)
+# ---------------------------------------------------------------------------
+
+class ParallelEnv:
+    """Reads the launcher-provided env (PADDLE_TRAINER_ID /
+    PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS naming kept for
+    config compatibility; plain RANK/WORLD_SIZE also accepted)."""
+
+    def __init__(self) -> None:
+        env = os.environ
+        self.rank = int(env.get("PADDLE_TRAINER_ID", env.get("RANK", "0")))
+        self.world_size = int(env.get("PADDLE_TRAINERS_NUM",
+                                      env.get("WORLD_SIZE", "1")))
+        eps = env.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self.trainer_endpoints: List[str] = eps.split(",") if eps else []
+        self.current_endpoint = env.get(
+            "PADDLE_CURRENT_ENDPOINT",
+            self.trainer_endpoints[self.rank]
+            if self.rank < len(self.trainer_endpoints) else "")
+
+    @property
+    def nranks(self) -> int:  # legacy alias
+        return self.world_size
+
+
+_parallel_state: Dict[str, Any] = {"initialized": False, "env": None}
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None) -> ParallelEnv:
+    """``paddle.distributed.init_parallel_env`` analogue. Multi-process:
+    connects this process to the JAX coordination service
+    (``jax.distributed.initialize`` — the DCN bootstrap replacing
+    c_gen_nccl_id's TCP exchange). Single-process: records env only."""
+    env = ParallelEnv()
+    if _parallel_state["initialized"]:
+        return _parallel_state["env"]
+    if env.world_size > 1:
+        import jax
+
+        addr = coordinator_address or os.environ.get(
+            "PADDLE_MASTER",
+            env.trainer_endpoints[0] if env.trainer_endpoints else None)
+        enforce(addr is not None,
+                "multi-process init needs a coordinator address "
+                "(PADDLE_MASTER or trainer endpoint 0)")
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=env.world_size,
+                                   process_id=env.rank)
+    _parallel_state.update(initialized=True, env=env)
+    return env
+
+
+def is_initialized() -> bool:
+    return bool(_parallel_state["initialized"])
+
+
+def get_rank() -> int:
+    if _parallel_state["env"] is not None:
+        return _parallel_state["env"].rank
+    return ParallelEnv().rank
+
+
+def get_world_size() -> int:
+    if _parallel_state["env"] is not None:
+        return _parallel_state["env"].world_size
+    return ParallelEnv().world_size
+
+
+# ---------------------------------------------------------------------------
+# Groups (collective.py:314 new_group) + eager host collectives
+# ---------------------------------------------------------------------------
+
+class Group:
+    """A communicator over a subset of ranks (reference ``Group`` with
+    its ring id). Host-side eager collectives on it use the JAX
+    process-level gather; in-graph code should use mesh axes instead."""
+
+    _next_id = 0
+
+    def __init__(self, ranks: Sequence[int]) -> None:
+        self.ranks = list(ranks)
+        self.id = Group._next_id
+        Group._next_id += 1
+
+    @property
+    def nranks(self) -> int:
+        return len(self.ranks)
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __contains__(self, rank: int) -> bool:
+        return rank in self.ranks
+
+
+_default_group: Optional[Group] = None
+
+
+def _get_group(group: Optional[Group]) -> Group:
+    global _default_group
+    if group is not None:
+        return group
+    if _default_group is None:
+        _default_group = Group(list(range(get_world_size())))
+    return _default_group
+
+
+def new_group(ranks: Optional[Sequence[int]] = None) -> Group:
+    return Group(list(ranks) if ranks is not None else list(range(get_world_size())))
+
+
+def _process_allgather(x: np.ndarray) -> List[np.ndarray]:
+    """All ranks' copies of ``x`` (host arrays). Multi-process: rides the
+    coordination service via multihost_utils.process_allgather."""
+    if get_world_size() == 1:
+        return [np.asarray(x)]
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(np.asarray(x), tiled=False)
+    return [np.asarray(g) for g in gathered]
+
+
+_REDUCERS = {
+    "sum": lambda xs: np.sum(xs, axis=0),
+    "avg": lambda xs: np.mean(xs, axis=0),
+    "max": lambda xs: np.max(xs, axis=0),
+    "min": lambda xs: np.min(xs, axis=0),
+    "prod": lambda xs: np.prod(xs, axis=0),
+}
+
+
+def all_reduce(x, op: str = "sum", group: Optional[Group] = None) -> np.ndarray:
+    """Eager host all_reduce (collective.py:580). For in-graph use, see
+    ops.collectives.all_reduce over a mesh axis.
+
+    Participation contract (applies to every eager collective here):
+    the underlying process_allgather rides the JAX coordination service,
+    which is collective over **all** processes — so every rank must
+    call, even when ``group`` is a subset; ``group`` scopes the
+    *result*, not participation (unlike the reference's per-ring NCCL
+    comms)."""
+    g = _get_group(group)
+    parts = _process_allgather(np.asarray(x))
+    parts = [parts[r] for r in g.ranks if r < len(parts)]
+    if op not in _REDUCERS:
+        raise InvalidArgumentError(f"unknown reduce op {op}")
+    return _REDUCERS[op](np.stack(parts))
+
+
+def all_gather(x, group: Optional[Group] = None) -> List[np.ndarray]:
+    g = _get_group(group)
+    parts = _process_allgather(np.asarray(x))
+    return [parts[r] for r in g.ranks if r < len(parts)]
+
+
+def broadcast(x, src: int = 0, group: Optional[Group] = None) -> np.ndarray:
+    g = _get_group(group)
+    parts = _process_allgather(np.asarray(x))
+    return parts[g.ranks.index(src)] if src in g.ranks else np.asarray(x)
+
+
+def scatter(tensor_list: Optional[Sequence], src: int = 0,
+            group: Optional[Group] = None) -> np.ndarray:
+    """collective.py:893 — src rank provides the per-rank list; each rank
+    gets its slice. Implemented as broadcast-then-index (host path).
+
+    Multi-process constraint: ``broadcast_one_to_all`` needs identically
+    shaped inputs on every process, so every rank must pass a
+    ``tensor_list`` of matching shapes (non-src values are ignored) —
+    stricter than the reference's brpc scatter, which streams shapes."""
+    g = _get_group(group)
+    rank = get_rank()
+    enforce(tensor_list is not None and len(tensor_list) >= 1,
+            "scatter needs a tensor_list of matching shapes on every rank "
+            "(non-src values are ignored)")
+    if get_world_size() == 1:
+        return np.asarray(tensor_list[0])
+    stacked = np.stack([np.asarray(t) for t in tensor_list])
+    from jax.experimental import multihost_utils
+
+    stacked = multihost_utils.broadcast_one_to_all(
+        stacked, is_source=(rank == src))
+    return np.asarray(stacked)[g.get_group_rank(rank)]
+
+
+def alltoall(in_list: Sequence, group: Optional[Group] = None) -> List[np.ndarray]:
+    g = _get_group(group)
+    enforce(len(in_list) == g.nranks, "alltoall needs one tensor per rank")
+    if get_world_size() == 1:
+        return [np.asarray(t) for t in in_list]
+    rank_in_group = g.get_group_rank(get_rank())
+    stacked = np.stack([np.asarray(t) for t in in_list])
+    all_parts = _process_allgather(stacked)
+    # index by *global* rank: subgroup members exchange among themselves
+    return [all_parts[g.ranks[r]][rank_in_group] for r in range(g.nranks)]
+
+
+def barrier(group: Optional[Group] = None) -> None:
+    """collective.py:266. Multi-process: sync_global_devices over the
+    coordination service; single-process: no-op."""
+    if get_world_size() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("paddle_tpu_barrier")

@@ -1,0 +1,333 @@
+"""Fleet executor: actor-model pipeline runtime
+(reference ``paddle/fluid/distributed/fleet_executor/``).
+
+The reference runs static pipeline programs as an actor system:
+``FleetExecutor`` (fleet_executor.h:35) builds a ``Carrier``
+(carrier.h:49) holding ``Interceptor`` actors — Source, Compute
+(compute_interceptor.h:24), Amplifier, Sink — that exchange
+credit-based control messages (``interceptor_message.proto``:
+DATA_IS_READY / DATA_IS_USELESS / START / STOP) over an in-process
+queue or a brpc ``MessageBus`` across ranks.
+
+TPU-first role: XLA already schedules *device* pipelines inside one
+program (parallel/pipeline.py's 1F1B scan). This runtime covers what
+XLA cannot: **host-side** staged execution — CPU preprocessing stages
+feeding compiled TPU stages, heter pipelines, and bounded-buffer
+backpressure between asynchronous stages (the HeterSectionWorker /
+stream-pipeline role). Each ComputeInterceptor's ``fn`` is typically a
+jitted step; credits bound in-flight microbatches exactly like the
+reference's up/down buffer accounting (compute_interceptor.cc).
+
+Cross-process extension point: replace ``MessageBus`` with one backed
+by ``distributed.collective.TCPStore`` — message schema is identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import queue
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..core.enforce import InvalidArgumentError, PreconditionNotMetError, enforce
+
+__all__ = [
+    "MessageType",
+    "InterceptorMessage",
+    "MessageBus",
+    "TaskNode",
+    "Interceptor",
+    "ComputeInterceptor",
+    "SourceInterceptor",
+    "SinkInterceptor",
+    "AmplifierInterceptor",
+    "Carrier",
+    "FleetExecutor",
+]
+
+
+class MessageType(enum.Enum):
+    # interceptor_message.proto values
+    STOP = 0
+    DATA_IS_READY = 1
+    DATA_IS_USELESS = 2
+    START = 3
+
+
+@dataclasses.dataclass
+class InterceptorMessage:
+    src_id: int
+    dst_id: int
+    type: MessageType
+    payload: Any = None  # data rides the edge queues in the reference
+    # (scopes are shared); here the message carries the microbatch
+
+
+class MessageBus:
+    """In-process message routing (message_bus.cc without brpc): one
+    inbox per interceptor id."""
+
+    def __init__(self) -> None:
+        self._inboxes: Dict[int, "queue.Queue[InterceptorMessage]"] = {}
+
+    def register(self, interceptor_id: int) -> "queue.Queue[InterceptorMessage]":
+        enforce(interceptor_id not in self._inboxes,
+                f"interceptor {interceptor_id} already registered")
+        q: "queue.Queue[InterceptorMessage]" = queue.Queue()
+        self._inboxes[interceptor_id] = q
+        return q
+
+    def send(self, msg: InterceptorMessage) -> None:
+        inbox = self._inboxes.get(msg.dst_id)
+        if inbox is None:
+            raise InvalidArgumentError(f"unknown interceptor id {msg.dst_id}")
+        inbox.put(msg)
+
+
+@dataclasses.dataclass
+class TaskNode:
+    """Reference ``TaskNode`` (task_node.h): one pipeline stage.
+    ``buffer_size`` per downstream edge = the credit window (max
+    microbatches in flight on that edge)."""
+
+    task_id: int
+    fn: Optional[Callable[[Any], Any]] = None
+    role: str = "compute"            # source | compute | sink | amplifier
+    max_run_times: int = 1           # microbatch count
+    upstreams: List[int] = dataclasses.field(default_factory=list)
+    downstreams: List[Tuple[int, int]] = dataclasses.field(default_factory=list)
+    # (dst_task_id, buffer_size)
+    period: int = 1                  # amplifier window (run_per_steps)
+
+
+class Interceptor(threading.Thread):
+    """Actor base: drains its inbox, dispatching on message type
+    (interceptor.h Handle). Runs as a daemon thread until STOP."""
+
+    def __init__(self, node: TaskNode, bus: MessageBus) -> None:
+        super().__init__(daemon=True, name=f"interceptor-{node.task_id}")
+        self.node = node
+        self.bus = bus
+        self.inbox = bus.register(node.task_id)
+        self.error: Optional[BaseException] = None
+
+    def send(self, dst: int, mtype: MessageType, payload: Any = None) -> None:
+        self.bus.send(InterceptorMessage(self.node.task_id, dst, mtype, payload))
+
+    def run(self) -> None:
+        try:
+            while True:
+                msg = self.inbox.get()
+                if msg.type is MessageType.STOP:
+                    break
+                self.handle(msg)
+        except BaseException as e:  # surfaced by Carrier.wait
+            self.error = e
+
+    def handle(self, msg: InterceptorMessage) -> None:
+        raise NotImplementedError
+
+
+class ComputeInterceptor(Interceptor):
+    """compute_interceptor.cc semantics: run when every upstream has a
+    ready microbatch AND every downstream has credit; after running,
+    return DATA_IS_USELESS upstream (freeing their credit) and send
+    DATA_IS_READY + the result downstream."""
+
+    def __init__(self, node: TaskNode, bus: MessageBus) -> None:
+        super().__init__(node, bus)
+        self._ready: Dict[int, "queue.Queue[Any]"] = {
+            u: queue.Queue() for u in node.upstreams}
+        self._credits: Dict[int, int] = {d: b for d, b in node.downstreams}
+        self._run_times = 0
+
+    def handle(self, msg: InterceptorMessage) -> None:
+        if msg.type is MessageType.DATA_IS_READY:
+            self._ready[msg.src_id].put(msg.payload)
+        elif msg.type is MessageType.DATA_IS_USELESS:
+            self._credits[msg.src_id] += 1
+        self._try_run()
+
+    def _can_run(self) -> bool:
+        if self._run_times >= self.node.max_run_times:
+            return False
+        if any(q.empty() for q in self._ready.values()):
+            return False
+        return all(c > 0 for c in self._credits.values())
+
+    def _try_run(self) -> None:
+        while self._can_run():
+            args = [self._ready[u].get() for u in self.node.upstreams]
+            out = self.node.fn(*args) if self.node.fn else (
+                args[0] if len(args) == 1 else tuple(args))
+            for u in self.node.upstreams:
+                self.send(u, MessageType.DATA_IS_USELESS)
+            for d, _ in self.node.downstreams:
+                self._credits[d] -= 1
+                self.send(d, MessageType.DATA_IS_READY, out)
+            self._run_times += 1
+
+
+class SourceInterceptor(Interceptor):
+    """source_interceptor.cc: feeds ``max_run_times`` microbatches
+    downstream, respecting credit."""
+
+    def __init__(self, node: TaskNode, bus: MessageBus,
+                 feed: Optional[Sequence[Any]] = None) -> None:
+        super().__init__(node, bus)
+        self._credits: Dict[int, int] = {d: b for d, b in node.downstreams}
+        self._feed = list(feed) if feed is not None else None
+        self._sent = 0
+
+    def handle(self, msg: InterceptorMessage) -> None:
+        if msg.type is MessageType.DATA_IS_USELESS:
+            self._credits[msg.src_id] += 1
+        elif msg.type is MessageType.START:
+            pass
+        self._try_send()
+
+    def _try_send(self) -> None:
+        while (self._sent < self.node.max_run_times
+               and all(c > 0 for c in self._credits.values())):
+            item = (self._feed[self._sent]
+                    if self._feed is not None else self._sent)
+            if self.node.fn is not None:
+                item = self.node.fn(item)
+            for d, _ in self.node.downstreams:
+                self._credits[d] -= 1
+                self.send(d, MessageType.DATA_IS_READY, item)
+            self._sent += 1
+
+
+class SinkInterceptor(Interceptor):
+    """sink_interceptor.cc: consumes microbatches; signals completion
+    when ``max_run_times`` have arrived."""
+
+    def __init__(self, node: TaskNode, bus: MessageBus) -> None:
+        super().__init__(node, bus)
+        self.outputs: List[Any] = []
+        self.done = threading.Event()
+
+    def handle(self, msg: InterceptorMessage) -> None:
+        if msg.type is MessageType.DATA_IS_READY:
+            out = msg.payload
+            if self.node.fn is not None:
+                out = self.node.fn(out)
+            self.outputs.append(out)
+            self.send(msg.src_id, MessageType.DATA_IS_USELESS)
+            if len(self.outputs) >= self.node.max_run_times:
+                self.done.set()
+
+
+class AmplifierInterceptor(ComputeInterceptor):
+    """amplifier_interceptor.cc: run-at-offset / period semantics used
+    for gradient-accumulation boundaries — consumes ``period`` inputs
+    per downstream emission (fn receives the list)."""
+
+    def __init__(self, node: TaskNode, bus: MessageBus, period: int = 1) -> None:
+        super().__init__(node, bus)
+        self.period = int(period)
+        self._window: List[Any] = []
+
+    def _try_run(self) -> None:
+        while True:
+            if len(self._window) >= self.period:
+                # a full window flushes only when every downstream has
+                # credit; otherwise resume on the next DATA_IS_USELESS
+                if not all(c > 0 for c in self._credits.values()):
+                    return
+                out = (self.node.fn(list(self._window))
+                       if self.node.fn else list(self._window))
+                self._window.clear()
+                for d, _ in self.node.downstreams:
+                    self._credits[d] -= 1
+                    self.send(d, MessageType.DATA_IS_READY, out)
+                continue
+            if (self._run_times >= self.node.max_run_times
+                    or any(q.empty() for q in self._ready.values())):
+                return
+            args = [self._ready[u].get() for u in self.node.upstreams]
+            for u in self.node.upstreams:
+                self.send(u, MessageType.DATA_IS_USELESS)
+            self._window.append(args[0] if len(args) == 1 else tuple(args))
+            self._run_times += 1
+
+
+class Carrier:
+    """carrier.h:49: owns the interceptors of one rank, starts them,
+    releases the sources, and joins on the sinks."""
+
+    def __init__(self, nodes: Sequence[TaskNode],
+                 feeds: Optional[Dict[int, Sequence[Any]]] = None) -> None:
+        self.bus = MessageBus()
+        self.interceptors: Dict[int, Interceptor] = {}
+        self.sinks: List[SinkInterceptor] = []
+        self.sources: List[SourceInterceptor] = []
+        feeds = feeds or {}
+        for node in nodes:
+            if node.role == "source":
+                it: Interceptor = SourceInterceptor(node, self.bus,
+                                                    feeds.get(node.task_id))
+                self.sources.append(it)  # type: ignore[arg-type]
+            elif node.role == "sink":
+                it = SinkInterceptor(node, self.bus)
+                self.sinks.append(it)  # type: ignore[arg-type]
+            elif node.role == "amplifier":
+                it = AmplifierInterceptor(node, self.bus, period=node.period)
+            else:
+                it = ComputeInterceptor(node, self.bus)
+            self.interceptors[node.task_id] = it
+
+    def start(self) -> None:
+        for it in self.interceptors.values():
+            it.start()
+        for src in self.sources:
+            self.bus.send(InterceptorMessage(-1, src.node.task_id,
+                                             MessageType.START))
+
+    def wait(self, timeout: float = 60.0) -> None:
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        # poll so a stage exception surfaces promptly instead of
+        # masquerading as a timeout after the full wait
+        for sink in self.sinks:
+            while not sink.done.wait(0.05):
+                for it in self.interceptors.values():
+                    if it.error is not None:
+                        self.stop()
+                        raise it.error
+                if _time.monotonic() > deadline:
+                    self.stop()
+                    raise PreconditionNotMetError(
+                        f"fleet executor timed out waiting for sink "
+                        f"{sink.node.task_id}")
+        self.stop()
+        for it in self.interceptors.values():
+            if it.error is not None:
+                raise it.error
+
+    def stop(self) -> None:
+        for it in self.interceptors.values():
+            self.bus.send(InterceptorMessage(-1, it.node.task_id,
+                                             MessageType.STOP))
+        for it in self.interceptors.values():
+            it.join(timeout=5.0)
+
+
+class FleetExecutor:
+    """fleet_executor.h:35 surface: init with task nodes, ``run`` feeds
+    microbatches through and returns the sink outputs in order."""
+
+    def __init__(self, nodes: Sequence[TaskNode]) -> None:
+        self.nodes = list(nodes)
+        ids = [n.task_id for n in self.nodes]
+        enforce(len(ids) == len(set(ids)), "duplicate task ids")
+
+    def run(self, feeds: Optional[Dict[int, Sequence[Any]]] = None,
+            timeout: float = 60.0) -> Dict[int, List[Any]]:
+        carrier = Carrier(self.nodes, feeds)
+        carrier.start()
+        carrier.wait(timeout)
+        return {s.node.task_id: s.outputs for s in carrier.sinks}

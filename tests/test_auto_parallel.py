@@ -1,0 +1,112 @@
+"""Auto-parallel tests (reference unittests/auto_parallel/
+test_engine_api.py, test_shard_tensor_api.py patterns, on the 8-device
+CPU mesh)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.auto_parallel import (
+    Engine,
+    ProcessMesh,
+    annotate,
+    shard_op,
+    shard_tensor,
+)
+
+
+class TestProcessMesh:
+    def test_shape_and_names(self):
+        pm = ProcessMesh(shape=(2, 4), dim_names=("x", "y"))
+        assert pm.ndim == 2
+        assert pm.jax_mesh.shape == {"x": 2, "y": 4}
+
+    def test_too_many_devices(self):
+        with pytest.raises(Exception):
+            ProcessMesh(shape=(1000,), dim_names=("dp",))
+
+
+class TestShardTensor:
+    def test_concrete_array(self):
+        pm = ProcessMesh(shape=(8,), dim_names=("dp",))
+        x = shard_tensor(np.zeros((16, 4), np.float32), pm, [0, None])
+        assert x.sharding.spec[0] == "dp"
+
+    def test_replicated_mapping(self):
+        pm = ProcessMesh(shape=(8,), dim_names=("dp",))
+        x = shard_tensor(np.zeros((16, 4), np.float32), pm, [-1, None])
+        assert x.sharding.spec == jax.sharding.PartitionSpec(None, None) or \
+            x.sharding.spec == jax.sharding.PartitionSpec()
+
+    def test_in_graph_constraint(self):
+        pm = ProcessMesh(shape=(8,), dim_names=("dp",))
+
+        @jax.jit
+        def f(x):
+            y = x * 2
+            return annotate(y, pm, [0, None])
+
+        out = f(jnp.ones((16, 4)))
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+
+    def test_shard_op(self):
+        pm = ProcessMesh(shape=(8,), dim_names=("dp",))
+        fn = shard_op(lambda x: x + 1, pm, [[0, None]])
+        out = jax.jit(fn)(jnp.zeros((8, 2)))
+        np.testing.assert_allclose(np.asarray(out), 1.0)
+
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+class TestEngine:
+    def _data(self, n_batches=6, bs=16):
+        rng = np.random.default_rng(0)
+        w = rng.normal(size=(8, 4)).astype(np.float32)
+        out = []
+        for _ in range(n_batches):
+            x = rng.normal(size=(bs, 8)).astype(np.float32)
+            y = (x @ w).argmax(-1).astype(np.int32)
+            out.append((x, y))
+        return out
+
+    def test_fit_reduces_loss(self):
+        pt.seed(0)
+        eng = Engine(_MLP(), nn.functional.cross_entropy,
+                     optimizer.Adam(5e-3),
+                     ProcessMesh(shape=(8,), dim_names=("dp",)))
+        data = self._data()
+        losses = eng.fit(data, epochs=8)
+        assert losses[-1] < losses[0]
+
+    def test_predict_shape(self):
+        pt.seed(0)
+        eng = Engine(_MLP(), nn.functional.cross_entropy, optimizer.SGD(0.1),
+                     ProcessMesh(shape=(8,), dim_names=("dp",)))
+        out = eng.predict(np.zeros((16, 8), np.float32))
+        assert out.shape == (16, 4)
+
+    def test_evaluate(self):
+        pt.seed(0)
+        eng = Engine(_MLP(), nn.functional.cross_entropy, optimizer.SGD(0.1),
+                     ProcessMesh(shape=(8,), dim_names=("dp",)))
+        val = eng.evaluate(self._data(2))
+        assert np.isfinite(val)
+
+    def test_completion_reports_shardings(self):
+        pt.seed(0)
+        eng = Engine(_MLP(), nn.functional.cross_entropy, optimizer.SGD(0.1),
+                     ProcessMesh(shape=(8,), dim_names=("dp",)))
+        x, y = self._data(1)[0]
+        info = eng.completion(x, y)
+        assert "input_shardings" in info and "output_shardings" in info

@@ -1,0 +1,183 @@
+"""Process-level collective API tests (reference
+python/paddle/fluid/tests/unittests/test_collective_api_base.py and
+test_tcp_store.py patterns — single-process paths here; the
+multi-process path is exercised by the launcher integration)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import (
+    Group,
+    ParallelEnv,
+    TCPStore,
+    all_gather,
+    all_reduce,
+    alltoall,
+    barrier,
+    broadcast,
+    get_rank,
+    get_world_size,
+    new_group,
+    scatter,
+)
+
+
+class TestTCPStore:
+    def test_set_get(self):
+        store = TCPStore(is_master=True)
+        try:
+            store.set("k", "v")
+            assert store.get("k") == "v"
+            assert store.get("missing") is None
+        finally:
+            store.close()
+
+    def test_add_atomic_across_clients(self):
+        master = TCPStore(is_master=True)
+        clients = [TCPStore(port=master.port) for _ in range(4)]
+        try:
+            def bump(c):
+                for _ in range(50):
+                    c.add("ctr", 1)
+
+            threads = [threading.Thread(target=bump, args=(c,)) for c in clients]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert master.get("ctr") == "200"
+        finally:
+            for c in clients:
+                c.close()
+            master.close()
+
+    def test_wait_blocks_until_set(self):
+        master = TCPStore(is_master=True)
+        client = TCPStore(port=master.port)
+        try:
+            done = []
+
+            def waiter():
+                client.wait(["flag"], timeout=10.0)
+                done.append(True)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            assert not done
+            master.set("flag", "1")
+            t.join(timeout=10.0)
+            assert done
+        finally:
+            client.close()
+            master.close()
+
+    def test_wait_timeout(self):
+        store = TCPStore(is_master=True)
+        try:
+            with pytest.raises(Exception):
+                store.wait(["never"], timeout=0.3)
+        finally:
+            store.close()
+
+    def test_barrier_reusable_name(self):
+        """A barrier name reused across rounds must re-synchronize each
+        round (per-round generation keys)."""
+        master = TCPStore(is_master=True)
+        c2 = TCPStore(port=master.port)
+        try:
+            order = []
+
+            def late_second_round(store, tag, delay):
+                store.barrier("epoch", 2, timeout=10.0)
+                time.sleep(delay)
+                store.barrier("epoch", 2, timeout=10.0)
+                order.append(tag)
+
+            t1 = threading.Thread(target=late_second_round,
+                                  args=(master, "fast", 0.0))
+            t2 = threading.Thread(target=late_second_round,
+                                  args=(c2, "slow", 0.4))
+            t1.start(); t2.start()
+            t1.join(10); t2.join(10)
+            assert sorted(order) == ["fast", "slow"]
+        finally:
+            c2.close()
+            master.close()
+
+    def test_barrier(self):
+        master = TCPStore(is_master=True)
+        c2 = TCPStore(port=master.port)
+        try:
+            results = []
+
+            def enter(store, name):
+                store.barrier("b0", 2, timeout=10.0)
+                results.append(name)
+
+            t1 = threading.Thread(target=enter, args=(master, "a"))
+            t2 = threading.Thread(target=enter, args=(c2, "b"))
+            t1.start(); t2.start()
+            t1.join(10); t2.join(10)
+            assert sorted(results) == ["a", "b"]
+        finally:
+            c2.close()
+            master.close()
+
+
+class TestParallelEnv:
+    def test_defaults(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TRAINER_ID", raising=False)
+        monkeypatch.delenv("RANK", raising=False)
+        env = ParallelEnv()
+        assert env.rank == 0 and env.world_size == 1
+
+    def test_paddle_env_vars(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+        monkeypatch.setenv("PADDLE_TRAINERS_NUM", "4")
+        monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                           "h0:1,h1:1,h2:1,h3:1")
+        env = ParallelEnv()
+        assert env.rank == 2 and env.world_size == 4
+        assert env.current_endpoint == "h2:1"
+        assert env.nranks == 4
+
+
+class TestGroups:
+    def test_new_group(self):
+        g = new_group([0])
+        assert g.nranks == 1 and 0 in g
+        assert g.get_group_rank(0) == 0
+        assert g.get_group_rank(5) == -1
+
+    def test_group_ids_unique(self):
+        assert new_group([0]).id != new_group([0]).id
+
+
+class TestEagerCollectivesSingleProcess:
+    def test_all_reduce(self):
+        out = all_reduce(np.asarray([1.0, 2.0]), op="sum")
+        np.testing.assert_allclose(out, [1.0, 2.0])
+
+    def test_all_reduce_ops(self):
+        for op in ("sum", "avg", "max", "min", "prod"):
+            out = all_reduce(np.asarray([2.0]), op=op)
+            np.testing.assert_allclose(out, [2.0])
+
+    def test_all_gather(self):
+        outs = all_gather(np.asarray([3]))
+        assert len(outs) == 1
+        np.testing.assert_array_equal(outs[0], [3])
+
+    def test_broadcast_scatter_alltoall_barrier(self):
+        np.testing.assert_array_equal(broadcast(np.asarray([5])), [5])
+        np.testing.assert_array_equal(scatter([np.asarray([7])]), [7])
+        outs = alltoall([np.asarray([9])])
+        np.testing.assert_array_equal(outs[0], [9])
+        barrier()  # no-op single process
+
+    def test_rank_world(self):
+        assert get_rank() == 0
+        assert get_world_size() >= 1
