@@ -26,6 +26,9 @@ __all__ = [
     "stop_profiler",
     "host_event_stats",
     "reset_host_events",
+    "export_chrome_tracing",
+    "start_timeline",
+    "stop_timeline",
     "CostTimer",
 ]
 
@@ -67,6 +70,59 @@ _TRACING = threading.Event()
 _TRACE_DIR: List[Optional[str]] = [None]
 
 
+class _Timeline:
+    """Complete-event recording for the ChromeTracingLogger export
+    (platform/profiler/dump/chrometracing_logger.cc): one "X" (complete)
+    event per RecordEvent scope with thread id, start, duration."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.enabled = False
+        self.events: List[Dict] = []
+
+    def add(self, name: str, t0: float, dur: float) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self.events.append({
+                "name": name,
+                "ph": "X",
+                "ts": t0 * 1e6,          # chrome tracing wants microseconds
+                "dur": dur * 1e6,
+                "pid": 0,
+                "tid": threading.get_ident() % 1_000_000,
+            })
+
+
+_TIMELINE = _Timeline()
+
+
+def start_timeline() -> None:
+    """Begin recording host RecordEvent scopes for chrome://tracing
+    export (the legacy profiler's EnableProfiler analogue)."""
+    _TIMELINE.events.clear()
+    _TIMELINE.enabled = True
+
+
+def stop_timeline() -> None:
+    _TIMELINE.enabled = False
+
+
+def export_chrome_tracing(path: str) -> str:
+    """Dump recorded host events in the chrome://tracing JSON format
+    (chrometracing_logger.cc / tools/timeline.py output). Load via
+    chrome://tracing or perfetto ui. Device-side traces come from
+    start_profiler()'s XPlane dump instead."""
+    import json
+
+    with _TIMELINE._lock:
+        events = list(_TIMELINE.events)
+    blob = {"traceEvents": events, "displayTimeUnit": "ms"}
+    with open(path, "w") as f:
+        json.dump(blob, f)
+    return path
+
+
 @contextlib.contextmanager
 def RecordEvent(name: str):
     """Annotate a host scope; shows up in the jax.profiler trace and in
@@ -78,7 +134,9 @@ def RecordEvent(name: str):
         try:
             yield
         finally:
-            _EVENTS.add(name, time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            _EVENTS.add(name, dt)
+            _TIMELINE.add(name, t0, dt)
 
 
 record_event = RecordEvent

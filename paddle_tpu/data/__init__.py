@@ -1,5 +1,7 @@
 from .loader import DataLoader, TensorDataset
 from .dataset import DataGenerator, InMemoryDataset, QueueDataset, SlotDesc
+from .index_dataset import LayerWiseSampler, TreeIndex
 
 __all__ = ["DataLoader", "TensorDataset",
-           "DataGenerator", "InMemoryDataset", "QueueDataset", "SlotDesc"]
+           "DataGenerator", "InMemoryDataset", "QueueDataset", "SlotDesc",
+           "TreeIndex", "LayerWiseSampler"]

@@ -332,7 +332,8 @@ def all_gather(x, group: Optional[Group] = None) -> List[np.ndarray]:
 def broadcast(x, src: int = 0, group: Optional[Group] = None) -> np.ndarray:
     g = _get_group(group)
     parts = _process_allgather(np.asarray(x))
-    return parts[g.ranks.index(src)] if src in g.ranks else np.asarray(x)
+    # parts is indexed by GLOBAL rank (like every collective here)
+    return parts[src] if src in g.ranks and src < len(parts) else np.asarray(x)
 
 
 def scatter(tensor_list: Optional[Sequence], src: int = 0,
@@ -346,8 +347,8 @@ def scatter(tensor_list: Optional[Sequence], src: int = 0,
     stricter than the reference's brpc scatter, which streams shapes."""
     g = _get_group(group)
     rank = get_rank()
-    enforce(tensor_list is not None and len(tensor_list) >= 1,
-            "scatter needs a tensor_list of matching shapes on every rank "
+    enforce(tensor_list is not None and len(tensor_list) == g.nranks,
+            "scatter needs one tensor per group rank on every rank "
             "(non-src values are ignored)")
     if get_world_size() == 1:
         return np.asarray(tensor_list[0])
@@ -356,7 +357,12 @@ def scatter(tensor_list: Optional[Sequence], src: int = 0,
 
     stacked = multihost_utils.broadcast_one_to_all(
         stacked, is_source=(rank == src))
-    return np.asarray(stacked)[g.get_group_rank(rank)]
+    rank_in_group = g.get_group_rank(rank)
+    if rank_in_group < 0:
+        # non-members participate (coordination-service contract) but
+        # receive no slice
+        return None
+    return np.asarray(stacked)[rank_in_group]
 
 
 def alltoall(in_list: Sequence, group: Optional[Group] = None) -> List[np.ndarray]:
@@ -367,6 +373,9 @@ def alltoall(in_list: Sequence, group: Optional[Group] = None) -> List[np.ndarra
     rank_in_group = g.get_group_rank(get_rank())
     stacked = np.stack([np.asarray(t) for t in in_list])
     all_parts = _process_allgather(stacked)
+    if rank_in_group < 0:
+        # non-members participate in the gather but exchange nothing
+        return [np.asarray(t) for t in in_list]
     # index by *global* rank: subgroup members exchange among themselves
     return [all_parts[g.ranks[r]][rank_in_group] for r in range(g.nranks)]
 

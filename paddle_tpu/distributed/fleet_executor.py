@@ -228,6 +228,10 @@ class AmplifierInterceptor(ComputeInterceptor):
     def __init__(self, node: TaskNode, bus: MessageBus, period: int = 1) -> None:
         super().__init__(node, bus)
         self.period = int(period)
+        enforce(node.max_run_times % max(self.period, 1) == 0,
+                f"amplifier max_run_times ({node.max_run_times}) must be a "
+                f"multiple of period ({period}) — a partial window would "
+                f"never flush")
         self._window: List[Any] = []
 
     def _try_run(self) -> None:

@@ -5,5 +5,7 @@ single ``psum`` (the GlooWrapper allreduce role — SURVEY §5 metrics)."""
 
 from .auc import AUC, auc_from_buckets, auc_update_buckets
 from .accuracy import Accuracy, accuracy
+from .basic import MAE, RMSE, WuAUC
 
-__all__ = ["AUC", "Accuracy", "accuracy", "auc_from_buckets", "auc_update_buckets"]
+__all__ = ["AUC", "Accuracy", "accuracy", "auc_from_buckets", "auc_update_buckets",
+           "MAE", "RMSE", "WuAUC"]

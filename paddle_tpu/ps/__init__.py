@@ -1,6 +1,7 @@
 """Parameter-server stack: accessors, sparse SGD rules, host tables,
 HBM embedding cache (SURVEY §2.2/2.3, Appendix A)."""
 
+from .graph_table import GraphTable
 from .accessor import AccessorConfig, CtrCommonAccessor, SparseAccessor, make_accessor
 from .embedding_cache import CacheConfig, HbmEmbeddingCache, cache_pull, cache_push
 from .native import FeasignIndex, native_available
@@ -15,6 +16,7 @@ from .table import (
 )
 
 __all__ = [
+    "GraphTable",
     "AccessorConfig",
     "CtrCommonAccessor",
     "SparseAccessor",

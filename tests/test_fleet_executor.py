@@ -111,6 +111,16 @@ class TestPipeline:
         outs = FleetExecutor(nodes).run({0: list(range(8))}, timeout=30.0)
         assert outs[2] == [0 + 1 + 2 + 3, 4 + 5 + 6 + 7]
 
+    def test_amplifier_partial_window_rejected(self):
+        nodes = [
+            TaskNode(0, role="source", max_run_times=6, downstreams=[(1, 6)]),
+            TaskNode(1, role="amplifier", period=4, max_run_times=6,
+                     upstreams=[0], downstreams=[(2, 2)]),
+            TaskNode(2, role="sink", max_run_times=1, upstreams=[1]),
+        ]
+        with pytest.raises(Exception, match="multiple of period"):
+            FleetExecutor(nodes).run({0: list(range(6))}, timeout=5.0)
+
     def test_jitted_section_per_microbatch(self):
         """ComputeInterceptor driving a compiled TPU/CPU section — the
         actual heter-pipeline use."""
