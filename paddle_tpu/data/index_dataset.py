@@ -16,7 +16,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..core.enforce import InvalidArgumentError, NotFoundError, enforce
+from ..core.enforce import NotFoundError, enforce
 
 __all__ = ["TreeIndex", "LayerWiseSampler"]
 

@@ -228,7 +228,8 @@ class AmplifierInterceptor(ComputeInterceptor):
     def __init__(self, node: TaskNode, bus: MessageBus, period: int = 1) -> None:
         super().__init__(node, bus)
         self.period = int(period)
-        enforce(node.max_run_times % max(self.period, 1) == 0,
+        enforce(self.period >= 1, f"amplifier period must be >= 1, got {period}")
+        enforce(node.max_run_times % self.period == 0,
                 f"amplifier max_run_times ({node.max_run_times}) must be a "
                 f"multiple of period ({period}) — a partial window would "
                 f"never flush")

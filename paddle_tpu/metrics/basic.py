@@ -14,7 +14,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from ..core.enforce import InvalidArgumentError, enforce
+from ..core.enforce import enforce
 
 __all__ = ["MAE", "RMSE", "WuAUC"]
 
@@ -144,13 +144,20 @@ class WuAUC:
         s = self.state
         if not len(s["uid"]):
             return 0.0
+        # group records per user in one argsort pass (O(n log n), not a
+        # full-array mask scan per unique uid)
+        order = np.argsort(s["uid"], kind="mergesort")
+        uid_sorted = s["uid"][order]
+        starts = np.flatnonzero(
+            np.concatenate(([True], uid_sorted[1:] != uid_sorted[:-1])))
+        ends = np.concatenate((starts[1:], [len(uid_sorted)]))
         total_w, total = 0.0, 0.0
-        for uid in np.unique(s["uid"]):
-            sel = s["uid"] == uid
+        for a, b in zip(starts, ends):
+            sel = order[a:b]
             auc = self._auc(s["pred"][sel], s["label"][sel])
             if auc is None:
                 continue
-            w = float(sel.sum())
+            w = float(b - a)
             total += auc * w
             total_w += w
         return total / max(total_w, 1e-12)

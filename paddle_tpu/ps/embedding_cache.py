@@ -194,18 +194,16 @@ class HbmEmbeddingCache:
         return len(uniq)
 
     def _load_g2sum(self, host: Dict[str, np.ndarray], keys: np.ndarray, rows: np.ndarray) -> None:
-        # reach into table shards for optimizer state (adagrad: 1 float)
-        for s_id in range(self.table.config.shard_num):
-            shard = self.table._shards[s_id]
-            sel = (keys % np.uint64(self.table.config.shard_num)) == s_id
-            if not sel.any():
-                continue
-            t_rows = shard.index.lookup(keys[sel])
-            ok = t_rows >= 0
-            if shard.accessor.embed_rule.state_dim >= 1:
-                host["embed_g2sum"][rows[sel][ok], 0] = shard.block.embed_state[t_rows[ok], 0]
-            if shard.accessor.embedx_rule.state_dim >= 1:
-                host["embedx_g2sum"][rows[sel][ok], 0] = shard.block.embedx_state[t_rows[ok], 0]
+        # optimizer state via the table's backend-neutral full-row export
+        # (adagrad: 1 shared g2sum per embedding)
+        acc = self.table.accessor
+        es = acc.embed_rule.state_dim
+        xd = acc.config.embedx_dim
+        values, found = self.table.export_full(keys)
+        if es >= 1:
+            host["embed_g2sum"][rows[found], 0] = values[found, 6]
+        if acc.embedx_rule.state_dim >= 1:
+            host["embedx_g2sum"][rows[found], 0] = values[found, 7 + es + xd]
 
     def lookup(self, keys: np.ndarray) -> np.ndarray:
         """Batch keys → cache rows (host-side; feed into the jitted step)."""
@@ -222,38 +220,41 @@ class HbmEmbeddingCache:
         host = {k: np.asarray(v) for k, v in jax.device_get(self.state).items()}
         keys = self._pass_keys
         rows = self._index.lookup(keys)
-        for s_id in range(self.table.config.shard_num):
-            shard = self.table._shards[s_id]
-            sel = (keys % np.uint64(self.table.config.shard_num)) == s_id
-            if not sel.any():
-                continue
-            with shard.lock:
-                t_rows, _ = shard.index.lookup_or_insert(keys[sel])
-                shard._ensure_capacity(shard.index.row_capacity)
-                b = shard.block
-                c_rows = rows[sel]
-                # lifecycle stats: cache-trained features were seen this
-                # pass — zero unseen_days and fold the show/click growth
-                # into delta_score (else daily shrink would age out hot
-                # features and delta saves would drop them)
-                acc_cfg = shard.accessor.config
-                d_show = host["show"][c_rows] - b.show[t_rows]
-                d_click = host["click"][c_rows] - b.click[t_rows]
-                b.delta_score[t_rows] += (
-                    (d_show - d_click) * acc_cfg.nonclk_coeff + d_click * acc_cfg.click_coeff
-                )
-                b.unseen_days[t_rows] = 0.0
-                b.show[t_rows] = host["show"][c_rows]
-                b.click[t_rows] = host["click"][c_rows]
-                b.embed_w[t_rows, 0] = host["embed_w"][c_rows, 0]
-                if shard.accessor.embed_rule.state_dim >= 1:
-                    b.embed_state[t_rows, 0] = host["embed_g2sum"][c_rows, 0]
-                has = host["has_embedx"][c_rows] > 0
-                b.embedx_w[t_rows[has]] = host["embedx_w"][c_rows[has]]
-                if shard.accessor.embedx_rule.state_dim >= 1:
-                    b.embedx_state[t_rows[has], 0] = host["embedx_g2sum"][c_rows[has], 0]
-                b.has_embedx[t_rows] |= has
-                shard.mark_initialized(t_rows)
+        acc = self.table.accessor
+        es = acc.embed_rule.state_dim
+        xd = acc.config.embedx_dim
+        # NB: like the reference's PSGPUWrapper::EndPass, flush-back runs
+        # at a pass boundary with trainers quiesced — the export/modify/
+        # import below is not atomic against concurrent push_sparse on
+        # the same keys. All pass keys were created in begin_pass, so
+        # every row must still exist (a mid-pass shrink would violate
+        # the pass protocol; fail loudly rather than write stale rows).
+        old, found = self.table.export_full(keys)
+        enforce(bool(found.all()),
+                "end_pass: pass keys missing from host table (table was "
+                "shrunk or mutated mid-pass)")
+        new = old.copy()
+        # lifecycle stats: cache-trained features were seen this pass —
+        # zero unseen_days and fold the show/click growth into
+        # delta_score (else daily shrink would age out hot features and
+        # delta saves would drop them)
+        cfg = acc.config
+        d_show = host["show"][rows] - old[:, 3]
+        d_click = host["click"][rows] - old[:, 4]
+        new[:, 2] = old[:, 2] + (d_show - d_click) * cfg.nonclk_coeff + d_click * cfg.click_coeff
+        new[:, 1] = 0.0
+        new[:, 3] = host["show"][rows]
+        new[:, 4] = host["click"][rows]
+        new[:, 5] = host["embed_w"][rows, 0]
+        if es >= 1:
+            new[:, 6] = host["embed_g2sum"][rows, 0]
+        has = host["has_embedx"][rows] > 0
+        keep_old = old[:, 6 + es] != 0.0
+        new[:, 6 + es] = (has | keep_old).astype(np.float32)
+        new[has, 7 + es : 7 + es + xd] = host["embedx_w"][rows[has]]
+        if acc.embedx_rule.state_dim >= 1:
+            new[has, 7 + es + xd] = host["embedx_g2sum"][rows[has], 0]
+        self.table.import_full(keys, new)
         self._index = None
         self.state = None
         self._pass_keys = None

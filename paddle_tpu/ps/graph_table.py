@@ -18,7 +18,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..core.enforce import InvalidArgumentError, NotFoundError, enforce
+from ..core.enforce import NotFoundError, enforce
 
 __all__ = ["GraphTable"]
 
@@ -45,6 +45,9 @@ class GraphTable:
         self.shard_num = shard_num
         self._shards = [_GraphShard() for _ in range(shard_num)]
         self._locks = [threading.Lock() for _ in range(shard_num)]
+        # numpy Generators are not thread-safe; sampling serializes
+        # on this lock (shard data access keeps the per-shard locks)
+        self._rng_lock = threading.Lock()
         self._rng = np.random.default_rng(seed)
 
     def _shard(self, node_id: int) -> Tuple[_GraphShard, threading.Lock]:
@@ -83,7 +86,7 @@ class GraphTable:
     def load_edges(self, path: str, reverse: bool = False) -> int:
         """Edge file: ``src \\t dst [\\t weight]`` per line
         (common_graph_table.cc load_edges format)."""
-        n = 0
+        srcs, dsts, ws = [], [], []
         with open(path) as f:
             for line in f:
                 parts = line.split()
@@ -93,9 +96,12 @@ class GraphTable:
                 w = float(parts[2]) if len(parts) > 2 else 1.0
                 if reverse:
                     s, d = d, s
-                self.add_edges([s], [d], [w])
-                n += 1
-        return n
+                srcs.append(s)
+                dsts.append(d)
+                ws.append(w)
+        if srcs:
+            self.add_edges(srcs, dsts, ws)
+        return len(srcs)
 
     def load_nodes(self, path: str, feat_dim: Optional[int] = None) -> int:
         """Node file: ``node_id [\\t f0 f1 ...]`` per line."""
@@ -148,11 +154,13 @@ class GraphTable:
                 nz = w > 0
                 cand, w = cand[nz], w[nz]
                 k = min(sample_size, len(cand))
-                idx = self._rng.choice(len(cand), size=k, replace=False,
-                                       p=w / w.sum())
+                with self._rng_lock:
+                    idx = self._rng.choice(len(cand), size=k, replace=False,
+                                           p=w / w.sum())
             else:
                 k = min(sample_size, len(cand))
-                idx = self._rng.choice(len(cand), size=k, replace=False)
+                with self._rng_lock:
+                    idx = self._rng.choice(len(cand), size=k, replace=False)
             nbrs[i, :k] = cand[idx]
             mask[i, :k] = True
         return nbrs, mask
@@ -161,8 +169,9 @@ class GraphTable:
         """random_sample_nodes: uniform sample over all node ids."""
         all_ids = self.all_nodes()
         enforce(len(all_ids) > 0, "graph is empty")
-        return self._rng.choice(all_ids, size=size,
-                                replace=len(all_ids) < size)
+        with self._rng_lock:
+            return self._rng.choice(all_ids, size=size,
+                                    replace=len(all_ids) < size)
 
     def all_nodes(self) -> np.ndarray:
         ids: List[int] = []

@@ -16,7 +16,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-__all__ = ["FeasignIndex", "native_available", "load_native"]
+__all__ = ["FeasignIndex", "NativeSparseTableEngine", "native_available", "load_native"]
 
 _CSRC = os.path.join(os.path.dirname(__file__), "..", "csrc")
 _LIB_PATH = os.path.join(_CSRC, "libpaddle_tpu_native.so")
@@ -309,3 +309,118 @@ class SlotParser:
             else:
                 self._py_errors += 1
         return ok
+
+
+# ---------------------------------------------------------------------------
+# Native sparse-table engine (csrc/sparse_table.cc)
+# ---------------------------------------------------------------------------
+
+_RULE_IDS = {"naive": 0, "adagrad": 1, "std_adagrad": 2, "adam": 3}
+_ACCESSOR_IDS = {"ctr": 0, "CtrCommonAccessor": 0, "sparse": 1, "SparseAccessor": 1}
+
+
+def _configure_pst(lib: ctypes.CDLL) -> None:
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    lib.pst_create.restype = ctypes.c_void_p
+    lib.pst_create.argtypes = [i32p, f32p]
+    lib.pst_destroy.argtypes = [ctypes.c_void_p]
+    for fn in ("pst_pull_dim", "pst_push_dim", "pst_full_dim"):
+        getattr(lib, fn).restype = ctypes.c_int32
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.pst_size.restype = ctypes.c_int64
+    lib.pst_size.argtypes = [ctypes.c_void_p]
+    lib.pst_pull.argtypes = [ctypes.c_void_p, u64p, i32p, ctypes.c_int64,
+                             ctypes.c_int32, f32p]
+    lib.pst_push.argtypes = [ctypes.c_void_p, u64p, f32p, ctypes.c_int64]
+    lib.pst_shrink.restype = ctypes.c_int64
+    lib.pst_shrink.argtypes = [ctypes.c_void_p]
+    lib.pst_save_begin.restype = ctypes.c_int64
+    lib.pst_save_begin.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.pst_save_fetch.argtypes = [ctypes.c_void_p, u64p, f32p]
+    lib.pst_insert_full.argtypes = [ctypes.c_void_p, u64p, f32p, ctypes.c_int64]
+    lib.pst_export.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64, f32p,
+                               ctypes.POINTER(ctypes.c_uint8)]
+
+
+def _f32(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class NativeSparseTableEngine:
+    """ctypes handle over the C++ MemorySparseTable engine
+    (csrc/sparse_table.cc): shard-parallel pull/push with accessor + SGD
+    math in native code. Raises RuntimeError if the native lib is
+    unavailable — callers fall back to the Python shards."""
+
+    def __init__(self, shard_num: int, accessor: str, embedx_dim: int,
+                 embed_rule: str, embedx_rule: str, seed: int,
+                 lifecycle: Tuple[float, ...], sgd: Tuple[float, ...]) -> None:
+        self._lib = load_native()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        if not getattr(self._lib, "_pst_configured", False):
+            try:
+                _configure_pst(self._lib)
+            except AttributeError as e:  # stale .so without pst_* symbols
+                raise RuntimeError(f"native library lacks sparse-table symbols: {e}")
+            self._lib._pst_configured = True
+        iparams = np.asarray(
+            [shard_num, _ACCESSOR_IDS[accessor], embedx_dim,
+             _RULE_IDS[embed_rule], _RULE_IDS[embedx_rule], seed], np.int32)
+        fparams = np.asarray(list(lifecycle) + list(sgd), np.float32)
+        assert len(fparams) == 17, len(fparams)
+        self._h = self._lib.pst_create(_i32(iparams), _f32(fparams))
+        self.pull_dim = int(self._lib.pst_pull_dim(self._h))
+        self.push_dim = int(self._lib.pst_push_dim(self._h))
+        self.full_dim = int(self._lib.pst_full_dim(self._h))
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_h", None):
+            lib.pst_destroy(self._h)
+            self._h = None
+
+    def size(self) -> int:
+        return int(self._lib.pst_size(self._h))
+
+    def pull(self, keys: np.ndarray, slots: Optional[np.ndarray], create: bool) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        out = np.empty((len(keys), self.pull_dim), np.float32)
+        slots_arr = (np.ascontiguousarray(slots, np.int32)
+                     if slots is not None else None)
+        self._lib.pst_pull(self._h, _u64(keys),
+                           _i32(slots_arr) if slots_arr is not None else None,
+                           len(keys), 1 if create else 0, _f32(out))
+        return out
+
+    def push(self, keys: np.ndarray, push_values: np.ndarray) -> None:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        push_values = np.ascontiguousarray(push_values, np.float32)
+        self._lib.pst_push(self._h, _u64(keys), _f32(push_values), len(keys))
+
+    def shrink(self) -> int:
+        return int(self._lib.pst_shrink(self._h))
+
+    def save_items(self, mode: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(keys [n], full rows [n, full_dim]) passing the mode filter."""
+        n = int(self._lib.pst_save_begin(self._h, mode))
+        keys = np.empty(n, np.uint64)
+        values = np.empty((n, self.full_dim), np.float32)
+        self._lib.pst_save_fetch(self._h, _u64(keys), _f32(values))
+        return keys, values
+
+    def export_full(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(values [n, full_dim], found [n] bool) — no insert-on-miss."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        values = np.empty((len(keys), self.full_dim), np.float32)
+        found = np.empty(len(keys), np.uint8)
+        self._lib.pst_export(self._h, _u64(keys), len(keys), _f32(values),
+                             found.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        return values, found.astype(bool)
+
+    def insert_full(self, keys: np.ndarray, values: np.ndarray) -> None:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        values = np.ascontiguousarray(values, np.float32)
+        self._lib.pst_insert_full(self._h, _u64(keys), _f32(values), len(keys))

@@ -34,7 +34,7 @@ import numpy as np
 
 from ..core.enforce import InvalidArgumentError, enforce, enforce_eq
 from .accessor import AccessorConfig, CtrCommonAccessor, FeatureBlock, make_accessor
-from .native import FeasignIndex
+from .native import FeasignIndex, NativeSparseTableEngine
 
 __all__ = [
     "TableConfig",
@@ -60,6 +60,9 @@ class TableConfig:
     accessor: str = "ctr"
     accessor_config: Optional[AccessorConfig] = None
     seed: int = 0
+    # "auto" = native C++ engine (csrc/sparse_table.cc) when the
+    # toolchain built it, else Python shards; "python"/"native" force.
+    backend: str = "auto"
 
 
 class _SparseShard:
@@ -165,11 +168,37 @@ class MemorySparseTable:
         self.accessor: CtrCommonAccessor = make_accessor(
             self.config.accessor, self.config.accessor_config
         )
-        self._shards = [
+        self._native: Optional[NativeSparseTableEngine] = None
+        if self.config.backend in ("auto", "native"):
+            acc = self.accessor.config
+            sgd = acc.sgd
+            try:
+                self._native = NativeSparseTableEngine(
+                    self.config.shard_num, self.config.accessor, acc.embedx_dim,
+                    acc.embed_sgd_rule, acc.embedx_sgd_rule, self.config.seed,
+                    lifecycle=(acc.nonclk_coeff, acc.click_coeff,
+                               acc.base_threshold, acc.delta_threshold,
+                               acc.delta_keep_days, acc.show_click_decay_rate,
+                               acc.delete_threshold, acc.delete_after_unseen_days,
+                               acc.embedx_threshold),
+                    sgd=(sgd.learning_rate, sgd.initial_g2sum, sgd.initial_range,
+                         sgd.weight_bounds[0], sgd.weight_bounds[1],
+                         sgd.beta1, sgd.beta2, sgd.ada_epsilon),
+                )
+            except (RuntimeError, KeyError):
+                if self.config.backend == "native":
+                    raise
+                self._native = None
+        self._shards = [] if self._native is not None else [
             _SparseShard(self.accessor, self.config.seed + i)
             for i in range(self.config.shard_num)
         ]
-        self._pool = ThreadPoolExecutor(max_workers=min(self.config.shard_num, 8))
+        self._pool = None if self._native is not None else ThreadPoolExecutor(
+            max_workers=min(self.config.shard_num, 8))
+
+    @property
+    def backend(self) -> str:
+        return "native" if self._native is not None else "python"
 
     # -- routing ----------------------------------------------------------
 
@@ -198,6 +227,8 @@ class MemorySparseTable:
         self, keys: np.ndarray, slots: Optional[np.ndarray] = None, create: bool = True
     ) -> np.ndarray:
         """Batched pull with insert-on-miss (memory_sparse_table.cc:443)."""
+        if self._native is not None:
+            return self._native.pull(keys, slots, create)
         out = np.zeros((len(keys), self.accessor.pull_dim), np.float32)
         for sel, vals in self._scatter_gather(
             keys, lambda sh, k, s: sh.pull(k, s, create), slots
@@ -217,12 +248,99 @@ class MemorySparseTable:
             # slot is categorical — take first occurrence, not the sum
             merged[:, 0] = push_values[first_idx, 0]
             keys, push_values = uniq, merged
+        if self._native is not None:
+            self._native.push(keys, push_values)
+            return
         self._scatter_gather(keys, lambda sh, k, pv: sh.push(k, pv), push_values)
 
+    # -- full-row export/import (backend-neutral; the embedding-cache
+    # pass build and flush-back go through these instead of reaching
+    # into shard internals) ----------------------------------------------
+
+    @property
+    def full_dim(self) -> int:
+        """Row width of the full save layout: slot, unseen_days,
+        delta_score, show, click, embed_w, embed_state[es], has_embedx,
+        embedx_w[xd], embedx_state[xs]."""
+        return (7 + self.accessor.embed_rule.state_dim
+                + self.accessor.config.embedx_dim
+                + self.accessor.embedx_rule.state_dim)
+
+    def export_full(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """(values [n, full_dim], found [n] bool); no insert-on-miss."""
+        if self._native is not None:
+            return self._native.export_full(keys)
+        keys = np.ascontiguousarray(keys, np.uint64)
+        es = self.accessor.embed_rule.state_dim
+        xd = self.accessor.config.embedx_dim
+        out = np.zeros((len(keys), self.full_dim), np.float32)
+        found = np.zeros(len(keys), bool)
+        for sel, res in self._scatter_gather(
+            keys, lambda sh, k: self._export_shard(sh, k, es, xd)
+        ):
+            out[sel], found[sel] = res
+        return out, found
+
+    @staticmethod
+    def _export_shard(sh: _SparseShard, keys: np.ndarray, es: int, xd: int):
+        with sh.lock:
+            rows = sh.index.lookup(keys)
+            ok = rows >= 0
+            out = np.zeros((len(keys), 7 + es + xd + sh.block.embedx_state.shape[1]),
+                           np.float32)
+            r = rows[ok]
+            b = sh.block
+            out[ok, 0] = b.slot[r]
+            out[ok, 1] = b.unseen_days[r]
+            out[ok, 2] = b.delta_score[r]
+            out[ok, 3] = b.show[r]
+            out[ok, 4] = b.click[r]
+            out[ok, 5] = b.embed_w[r, 0]
+            out[np.ix_(ok, range(6, 6 + es))] = b.embed_state[r]
+            out[ok, 6 + es] = b.has_embedx[r].astype(np.float32)
+            out[np.ix_(ok, range(7 + es, 7 + es + xd))] = b.embedx_w[r]
+            out[np.ix_(ok, range(7 + es + xd, out.shape[1]))] = b.embedx_state[r]
+            return out, ok
+
+    def import_full(self, keys: np.ndarray, values: np.ndarray) -> None:
+        """Direct overwrite of full rows (insert-on-miss)."""
+        if self._native is not None:
+            self._native.insert_full(keys, values)
+            return
+        keys = np.ascontiguousarray(keys, np.uint64)
+        es = self.accessor.embed_rule.state_dim
+        xd = self.accessor.config.embedx_dim
+        self._scatter_gather(
+            keys, lambda sh, k, v: self._import_shard(sh, k, v, es, xd), values
+        )
+
+    @staticmethod
+    def _import_shard(sh: _SparseShard, keys: np.ndarray, values: np.ndarray,
+                      es: int, xd: int) -> None:
+        with sh.lock:
+            rows, _ = sh.index.lookup_or_insert(keys)
+            sh._ensure_capacity(sh.index.row_capacity)
+            b = sh.block
+            b.slot[rows] = values[:, 0].astype(np.int32)
+            b.unseen_days[rows] = values[:, 1]
+            b.delta_score[rows] = values[:, 2]
+            b.show[rows] = values[:, 3]
+            b.click[rows] = values[:, 4]
+            b.embed_w[rows, 0] = values[:, 5]
+            b.embed_state[rows] = values[:, 6 : 6 + es]
+            b.has_embedx[rows] = values[:, 6 + es] != 0.0
+            b.embedx_w[rows] = values[:, 7 + es : 7 + es + xd]
+            b.embedx_state[rows] = values[:, 7 + es + xd :]
+            sh.mark_initialized(rows)
+
     def shrink(self) -> int:
+        if self._native is not None:
+            return self._native.shrink()
         return sum(sh.shrink() for sh in self._shards)
 
     def size(self) -> int:
+        if self._native is not None:
+            return self._native.size()
         return sum(len(sh.index) for sh in self._shards)
 
     def flush(self) -> None:
@@ -233,7 +351,10 @@ class MemorySparseTable:
     def save(self, dirname: str, mode: int = _SAVE_MODE_ALL) -> int:
         os.makedirs(dirname, exist_ok=True)
         total = 0
-        dim = self.accessor.config.embedx_dim
+        if self._native is not None:
+            total = self._save_native(dirname, mode)
+            self._write_meta(dirname, mode)
+            return total
         for i, sh in enumerate(self._shards):
             keys, rows = sh.save_items(mode)
             path = os.path.join(dirname, f"part-{i:05d}.shard")
@@ -255,17 +376,45 @@ class MemorySparseTable:
                         fields += [f"{v:.8g}" for v in b.embedx_state[r]]
                     f.write(" ".join(fields) + "\n")
                     total += 1
+        self._write_meta(dirname, mode)
+        return total
+
+    def _write_meta(self, dirname: str, mode: int) -> None:
         with open(os.path.join(dirname, "meta.json"), "w") as f:
             json.dump(
                 {
                     "shard_num": self.config.shard_num,
-                    "embedx_dim": dim,
+                    "embedx_dim": self.accessor.config.embedx_dim,
                     "accessor": self.config.accessor,
                     "mode": mode,
                 },
                 f,
             )
-        return total
+
+    def _save_native(self, dirname: str, mode: int) -> int:
+        """Native path: drain the engine's save cursor into the same
+        per-shard text files the Python path writes."""
+        keys, values = self._native.save_items(mode)
+        ed = self.accessor.embed_rule.state_dim
+        xd = self.accessor.config.embedx_dim
+        xs = self.accessor.embedx_rule.state_dim
+        shard_of = (keys % np.uint64(self.config.shard_num)).astype(np.int64)
+        files = [open(os.path.join(dirname, f"part-{i:05d}.shard"), "w")
+                 for i in range(self.config.shard_num)]
+        try:
+            for j in range(len(keys)):
+                v = values[j]
+                fields = [str(int(keys[j])), str(int(v[0])), f"{v[1]:.6g}",
+                          f"{v[2]:.6g}", f"{v[3]:.6g}", f"{v[4]:.6g}",
+                          f"{v[5]:.8g}"]
+                fields += [f"{x:.8g}" for x in v[6 : 6 + ed]]
+                if v[6 + ed] != 0.0:  # has_embedx
+                    fields += [f"{x:.8g}" for x in v[7 + ed : 7 + ed + xd + xs]]
+                files[shard_of[j]].write(" ".join(fields) + "\n")
+        finally:
+            for f in files:
+                f.close()
+        return len(keys)
 
     def load(self, dirname: str) -> int:
         with open(os.path.join(dirname, "meta.json")) as f:
@@ -274,6 +423,8 @@ class MemorySparseTable:
         ed = self.accessor.embed_rule.state_dim
         xd = self.accessor.config.embedx_dim
         xs = self.accessor.embedx_rule.state_dim
+        if self._native is not None:
+            return self._load_native(dirname, meta, ed, xd, xs)
         total = 0
         for i in range(meta["shard_num"]):
             path = os.path.join(dirname, f"part-{i:05d}.shard")
@@ -313,6 +464,35 @@ class MemorySparseTable:
                             b.has_embedx[r] = True
                     sh.mark_initialized(rows)
                     total += len(rows)
+        return total
+
+    def _load_native(self, dirname: str, meta: dict, ed: int, xd: int, xs: int) -> int:
+        full = self._native.full_dim
+        total = 0
+        for i in range(meta["shard_num"]):
+            path = os.path.join(dirname, f"part-{i:05d}.shard")
+            if not os.path.exists(path):
+                continue
+            keys, rows = [], []
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    if not parts:
+                        continue
+                    keys.append(np.uint64(parts[0]))
+                    data = [float(x) for x in parts[1:]]
+                    row = np.zeros(full, np.float32)
+                    row[:6] = data[:6]
+                    row[6 : 6 + ed] = data[6 : 6 + ed]
+                    rest = data[6 + ed :]
+                    if len(rest) >= xd:
+                        row[6 + ed] = 1.0  # has_embedx
+                        row[7 + ed : 7 + ed + xd + xs] = rest[: xd + xs]
+                    rows.append(row)
+            if keys:
+                self._native.insert_full(np.asarray(keys, np.uint64),
+                                         np.stack(rows))
+                total += len(keys)
         return total
 
 
