@@ -1,0 +1,635 @@
+// Native TCP parameter-server transport: the DCN control/data plane for
+// multi-host CPU tables.
+//
+// TPU-build counterpart of the reference's brpc PS service
+// (paddle/fluid/distributed/ps/service/brpc_ps_{client,server}.cc and
+// sendrecv.proto PsCmdID command dispatch — behaviorally: one connection
+// per client/server pair, length-prefixed request frames dispatched by
+// command id to table handlers, async on the client via caller threads).
+// Intra-pod parameter movement rides ICI inside compiled XLA programs;
+// this service carries what stays host-side: pull/push of CPU-resident
+// sparse/dense tables, GEO deltas, barriers, save/load streaming.
+//
+// Wire format (little-endian, host order — same-arch cluster assumed):
+//   request:  [u64 payload_len][u32 cmd][u32 table_id][i64 n][i32 aux]
+//             [payload bytes]
+//   response: [u64 payload_len][i64 status][payload bytes]
+// status >= 0 is the command's count/result; < 0 is an error code.
+//
+// Server: accept thread + one handler thread per connection (a handful
+// of trainers per server; the reference sizes brpc thread pools
+// similarly). Tables are the sparse_table.h engine (shard-parallel, so
+// one busy connection still uses all cores).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <string>
+#include <unordered_map>
+
+#include "sparse_table.h"
+
+namespace {
+
+using pstpu::NativeTable;
+using pstpu::TableNativeConfig;
+using pstpu::table_full_dim;
+
+enum Cmd : uint32_t {
+  kCreateSparse = 1,
+  kCreateDense = 2,
+  kPullSparse = 3,
+  kPushSparse = 4,
+  kPullDense = 5,
+  kPushDense = 6,
+  kSetDense = 7,
+  kSize = 8,
+  kShrink = 9,
+  kSaveBegin = 10,
+  kSaveFetch = 11,
+  kInsertFull = 12,
+  kExport = 13,
+  kBarrier = 14,
+  kStop = 15,
+  kPing = 16,
+  kGlobalStep = 17,
+  kCreateGeo = 18,
+  kPushGeo = 19,
+  kPullGeo = 20,
+};
+
+enum Err : int64_t {
+  kErrBadCmd = -1,
+  kErrNoTable = -2,
+  kErrBadSize = -3,
+  kErrInternal = -4,
+};
+
+constexpr uint64_t kMaxPayload = 1ULL << 32;  // 4 GiB frame cap
+
+struct ReqHeader {
+  uint64_t payload_len;
+  uint32_t cmd;
+  uint32_t table_id;
+  int64_t n;
+  int32_t aux;
+} __attribute__((packed));
+
+bool read_full(int fd, void* buf, size_t len) {
+  char* p = static_cast<char*>(buf);
+  while (len > 0) {
+    ssize_t r = ::recv(fd, p, len, 0);
+    if (r <= 0) return false;
+    p += r;
+    len -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t len) {
+  const char* p = static_cast<const char*>(buf);
+  while (len > 0) {
+    ssize_t r = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    len -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// server-side dense table (memory_dense_table.cc role: server applies
+// the dense optimizer; sgd/adam/sum match the host MemoryDenseTable)
+struct DenseTable {
+  std::vector<float> values;
+  int32_t opt = 1;  // 0 sgd, 1 adam, 2 sum
+  float lr = 0.001f;
+  std::vector<float> m, v;
+  int64_t t = 0;
+  std::mutex mu;
+
+  DenseTable(int32_t dim, int32_t opt_, float lr_) : opt(opt_), lr(lr_) {
+    values.assign(dim, 0.0f);
+    if (opt == 1) {
+      m.assign(dim, 0.0f);
+      v.assign(dim, 0.0f);
+    }
+  }
+
+  void push(const float* grad) {
+    std::lock_guard<std::mutex> g(mu);
+    size_t d = values.size();
+    if (opt == 0) {
+      for (size_t i = 0; i < d; ++i) values[i] -= lr * grad[i];
+    } else if (opt == 2) {
+      for (size_t i = 0; i < d; ++i) values[i] += grad[i];
+    } else {
+      ++t;
+      const float b1 = 0.9f, b2 = 0.999f, eps = 1e-8f;
+      float b1c = 1.0f - std::pow(b1, static_cast<float>(t));
+      float b2c = 1.0f - std::pow(b2, static_cast<float>(t));
+      for (size_t i = 0; i < d; ++i) {
+        m[i] = b1 * m[i] + (1.0f - b1) * grad[i];
+        v[i] = b2 * v[i] + (1.0f - b2) * grad[i] * grad[i];
+        values[i] -= lr * (m[i] / b1c) / (std::sqrt(v[i] / b2c) + eps);
+      }
+    }
+  }
+};
+
+// server-side GEO delta table (memory_sparse_geo_table: accumulate
+// per-key deltas; pull drains means)
+struct GeoTable {
+  int32_t dim;
+  std::unordered_map<uint64_t, std::pair<std::vector<float>, int32_t>> acc;
+  std::mutex mu;
+
+  explicit GeoTable(int32_t d) : dim(d) {}
+
+  void push(const uint64_t* keys, const float* deltas, int64_t n) {
+    std::lock_guard<std::mutex> g(mu);
+    for (int64_t i = 0; i < n; ++i) {
+      auto& e = acc[keys[i]];
+      if (e.first.empty()) e.first.assign(dim, 0.0f);
+      for (int32_t j = 0; j < dim; ++j) e.first[j] += deltas[i * dim + j];
+      e.second += 1;
+    }
+  }
+
+  // drain into (keys, mean deltas)
+  void pull(std::vector<uint64_t>* keys, std::vector<float>* deltas) {
+    std::lock_guard<std::mutex> g(mu);
+    keys->reserve(acc.size());
+    deltas->reserve(acc.size() * dim);
+    for (auto& kv : acc) {
+      keys->push_back(kv.first);
+      float inv = 1.0f / std::max(kv.second.second, 1);
+      for (int32_t j = 0; j < dim; ++j)
+        deltas->push_back(kv.second.first[j] * inv);
+    }
+    acc.clear();
+  }
+};
+
+struct PsServer {
+  int listen_fd = -1;
+  int port = 0;
+  int n_trainers = 1;
+  std::atomic<bool> stopping{false};
+  std::thread accept_thread;
+  std::vector<std::thread> conn_threads;
+  std::vector<int> conn_fds;
+  std::mutex conn_mu;
+
+  std::map<uint32_t, NativeTable*> sparse;
+  std::map<uint32_t, DenseTable*> dense;
+  std::map<uint32_t, GeoTable*> geo;
+  std::mutex tables_mu;
+
+  // barrier (BarrierTable semantics: all trainers arrive, then release)
+  std::mutex bar_mu;
+  std::condition_variable bar_cv;
+  int bar_count = 0;
+  int64_t bar_gen = 0;
+
+  // global step (GlobalStepTable)
+  std::atomic<int64_t> global_step{0};
+
+  ~PsServer() {
+    for (auto& kv : sparse) delete kv.second;
+    for (auto& kv : dense) delete kv.second;
+    for (auto& kv : geo) delete kv.second;
+  }
+
+  bool start(int want_port, int trainers) {
+    n_trainers = trainers;
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(want_port));
+    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+      return false;
+    socklen_t len = sizeof(addr);
+    ::getsockname(listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+    port = ntohs(addr.sin_port);
+    if (::listen(listen_fd, 64) < 0) return false;
+    accept_thread = std::thread([this]() { accept_loop(); });
+    return true;
+  }
+
+  void accept_loop() {
+    while (!stopping.load()) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) {
+        if (stopping.load()) break;
+        continue;
+      }
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(conn_mu);
+      conn_fds.push_back(fd);
+      conn_threads.emplace_back([this, fd]() { serve_conn(fd); });
+    }
+  }
+
+  void stop() {
+    if (stopping.exchange(true)) return;
+    ::shutdown(listen_fd, SHUT_RDWR);
+    ::close(listen_fd);
+    // nudge open connections: in-flight requests finish (handler writes
+    // the response), then the next read fails and the thread exits —
+    // live trainers on other connections are NOT cut mid-request
+    {
+      std::lock_guard<std::mutex> g(conn_mu);
+      for (int fd : conn_fds) ::shutdown(fd, SHUT_RD);
+    }
+    // wake any barrier waiters so their connections can drain
+    {
+      std::lock_guard<std::mutex> g(bar_mu);
+      bar_gen++;
+      bar_count = 0;
+    }
+    bar_cv.notify_all();
+    if (accept_thread.joinable()) accept_thread.join();
+    std::vector<std::thread> ts;
+    {
+      std::lock_guard<std::mutex> g(conn_mu);
+      ts.swap(conn_threads);
+    }
+    for (auto& t : ts)
+      if (t.joinable()) t.join();
+  }
+
+  NativeTable* get_sparse(uint32_t id) {
+    std::lock_guard<std::mutex> g(tables_mu);
+    auto it = sparse.find(id);
+    return it == sparse.end() ? nullptr : it->second;
+  }
+  DenseTable* get_dense(uint32_t id) {
+    std::lock_guard<std::mutex> g(tables_mu);
+    auto it = dense.find(id);
+    return it == dense.end() ? nullptr : it->second;
+  }
+  GeoTable* get_geo(uint32_t id) {
+    std::lock_guard<std::mutex> g(tables_mu);
+    auto it = geo.find(id);
+    return it == geo.end() ? nullptr : it->second;
+  }
+
+  bool respond(int fd, int64_t status, const void* payload, uint64_t plen) {
+    uint64_t hdr[2] = {plen, static_cast<uint64_t>(status)};
+    if (!write_full(fd, hdr, sizeof(hdr))) return false;
+    if (plen && !write_full(fd, payload, plen)) return false;
+    return true;
+  }
+
+  void serve_conn(int fd) {
+    std::vector<char> buf;
+    while (true) {
+      ReqHeader h;
+      if (!read_full(fd, &h, sizeof(h))) break;
+      if (h.payload_len > kMaxPayload) break;
+      buf.resize(h.payload_len);
+      if (h.payload_len && !read_full(fd, buf.data(), h.payload_len)) break;
+      if (!handle(fd, h, buf.data())) break;
+      if (h.cmd == kStop) break;
+    }
+    ::close(fd);
+  }
+
+  bool handle(int fd, const ReqHeader& h, const char* p) {
+    switch (h.cmd) {
+      case kPing:
+        return respond(fd, 0, nullptr, 0);
+      case kCreateSparse: {
+        if (h.payload_len != 6 * 4 + 17 * 4) return respond(fd, kErrBadSize, nullptr, 0);
+        TableNativeConfig c = pstpu::parse_table_config(
+            reinterpret_cast<const int32_t*>(p),
+            reinterpret_cast<const float*>(p + 24));
+        NativeTable* t;
+        {
+          std::lock_guard<std::mutex> g(tables_mu);
+          auto it = sparse.find(h.table_id);
+          if (it == sparse.end()) {
+            t = new NativeTable(c);
+            sparse[h.table_id] = t;
+          } else {
+            t = it->second;  // idempotent re-create from another trainer
+          }
+        }
+        int32_t dims[3] = {t->shards[0]->pull_dim(), t->shards[0]->push_dim(),
+                           table_full_dim(t)};
+        return respond(fd, 0, dims, sizeof(dims));
+      }
+      case kCreateDense: {
+        if (h.payload_len != 12) return respond(fd, kErrBadSize, nullptr, 0);
+        int32_t dim, opt;
+        float lr;
+        std::memcpy(&dim, p, 4);
+        std::memcpy(&opt, p + 4, 4);
+        std::memcpy(&lr, p + 8, 4);
+        std::lock_guard<std::mutex> g(tables_mu);
+        if (!dense.count(h.table_id))
+          dense[h.table_id] = new DenseTable(dim, opt, lr);
+        return respond(fd, 0, nullptr, 0);
+      }
+      case kCreateGeo: {
+        if (h.payload_len != 4) return respond(fd, kErrBadSize, nullptr, 0);
+        int32_t dim;
+        std::memcpy(&dim, p, 4);
+        std::lock_guard<std::mutex> g(tables_mu);
+        if (!geo.count(h.table_id)) geo[h.table_id] = new GeoTable(dim);
+        return respond(fd, 0, nullptr, 0);
+      }
+      case kPullSparse: {
+        NativeTable* t = get_sparse(h.table_id);
+        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
+        int32_t pd = t->shards[0]->pull_dim();
+        uint64_t want = static_cast<uint64_t>(h.n) * (8 + 4);
+        if (h.payload_len != want) return respond(fd, kErrBadSize, nullptr, 0);
+        const uint64_t* keys = reinterpret_cast<const uint64_t*>(p);
+        const int32_t* slots = reinterpret_cast<const int32_t*>(p + h.n * 8);
+        std::vector<float> out(static_cast<size_t>(h.n) * pd);
+        t->parallel_over_shards(keys, h.n, [&](pstpu::Shard* sh, int64_t i) {
+          int32_t r = h.aux ? sh->lookup_or_insert(keys[i], slots[i])
+                            : sh->find(keys[i]);
+          float* o = out.data() + i * pd;
+          if (r >= 0)
+            sh->select_into(r, o);
+          else
+            std::fill_n(o, pd, 0.0f);
+        });
+        return respond(fd, h.n, out.data(), out.size() * 4);
+      }
+      case kPushSparse: {
+        NativeTable* t = get_sparse(h.table_id);
+        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
+        int32_t pd = t->shards[0]->push_dim();
+        uint64_t want = static_cast<uint64_t>(h.n) * (8 + 4 * pd);
+        if (h.payload_len != want) return respond(fd, kErrBadSize, nullptr, 0);
+        const uint64_t* keys = reinterpret_cast<const uint64_t*>(p);
+        const float* push = reinterpret_cast<const float*>(p + h.n * 8);
+        t->parallel_over_shards(keys, h.n, [&](pstpu::Shard* sh, int64_t i) {
+          const float* pv = push + i * pd;
+          int32_t r = sh->lookup_or_insert(keys[i], static_cast<int32_t>(pv[0]));
+          sh->push_one(r, pv);
+        });
+        return respond(fd, h.n, nullptr, 0);
+      }
+      case kPullDense: {
+        DenseTable* t = get_dense(h.table_id);
+        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
+        std::lock_guard<std::mutex> g(t->mu);
+        return respond(fd, static_cast<int64_t>(t->values.size()),
+                       t->values.data(), t->values.size() * 4);
+      }
+      case kPushDense: {
+        DenseTable* t = get_dense(h.table_id);
+        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
+        if (h.payload_len != t->values.size() * 4)
+          return respond(fd, kErrBadSize, nullptr, 0);
+        t->push(reinterpret_cast<const float*>(p));
+        return respond(fd, 0, nullptr, 0);
+      }
+      case kSetDense: {
+        DenseTable* t = get_dense(h.table_id);
+        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
+        if (h.payload_len != t->values.size() * 4)
+          return respond(fd, kErrBadSize, nullptr, 0);
+        {
+          std::lock_guard<std::mutex> g(t->mu);
+          std::memcpy(t->values.data(), p, h.payload_len);
+        }
+        return respond(fd, 0, nullptr, 0);
+      }
+      case kSize: {
+        NativeTable* t = get_sparse(h.table_id);
+        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
+        int64_t n = 0;
+        for (auto* sh : t->shards) n += sh->used;
+        return respond(fd, n, nullptr, 0);
+      }
+      case kShrink: {
+        NativeTable* t = get_sparse(h.table_id);
+        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
+        int64_t erased = 0;
+        for (auto* sh : t->shards) {
+          std::lock_guard<std::mutex> g(sh->mu);
+          erased += sh->shrink();
+        }
+        return respond(fd, erased, nullptr, 0);
+      }
+      case kSaveBegin: {
+        NativeTable* t = get_sparse(h.table_id);
+        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
+        return respond(fd, pstpu::table_save_snapshot(t, h.aux), nullptr, 0);
+      }
+      case kSaveFetch: {
+        NativeTable* t = get_sparse(h.table_id);
+        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
+        int32_t fdim = table_full_dim(t);
+        std::vector<char> out;
+        int64_t cnt;
+        {
+          std::lock_guard<std::mutex> sg(t->save_mu);
+          cnt = static_cast<int64_t>(t->save_keys.size());
+          out.resize(cnt * 8 + cnt * fdim * 4);
+          if (cnt) {
+            std::memcpy(out.data(), t->save_keys.data(), cnt * 8);
+            std::memcpy(out.data() + cnt * 8, t->save_values.data(),
+                        t->save_values.size() * 4);
+          }
+          t->save_keys.clear();
+          t->save_values.clear();
+        }
+        return respond(fd, cnt, out.data(), out.size());
+      }
+      case kInsertFull: {
+        NativeTable* t = get_sparse(h.table_id);
+        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
+        int32_t fdim = table_full_dim(t);
+        uint64_t want = static_cast<uint64_t>(h.n) * (8 + 4 * fdim);
+        if (h.payload_len != want) return respond(fd, kErrBadSize, nullptr, 0);
+        pstpu::table_insert_full(t, reinterpret_cast<const uint64_t*>(p),
+                                 reinterpret_cast<const float*>(p + h.n * 8),
+                                 h.n);
+        return respond(fd, h.n, nullptr, 0);
+      }
+      case kExport: {
+        NativeTable* t = get_sparse(h.table_id);
+        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
+        if (h.payload_len != static_cast<uint64_t>(h.n) * 8)
+          return respond(fd, kErrBadSize, nullptr, 0);
+        int32_t fdim = table_full_dim(t);
+        std::vector<char> out(static_cast<size_t>(h.n) * fdim * 4 + h.n);
+        pstpu::table_export(
+            t, reinterpret_cast<const uint64_t*>(p), h.n,
+            reinterpret_cast<float*>(out.data()),
+            reinterpret_cast<uint8_t*>(out.data() + h.n * fdim * 4));
+        return respond(fd, h.n, out.data(), out.size());
+      }
+      case kPushGeo: {
+        GeoTable* t = get_geo(h.table_id);
+        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
+        uint64_t want = static_cast<uint64_t>(h.n) * (8 + 4 * t->dim);
+        if (h.payload_len != want) return respond(fd, kErrBadSize, nullptr, 0);
+        t->push(reinterpret_cast<const uint64_t*>(p),
+                reinterpret_cast<const float*>(p + h.n * 8), h.n);
+        return respond(fd, h.n, nullptr, 0);
+      }
+      case kPullGeo: {
+        GeoTable* t = get_geo(h.table_id);
+        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
+        std::vector<uint64_t> keys;
+        std::vector<float> deltas;
+        t->pull(&keys, &deltas);
+        std::vector<char> out(keys.size() * 8 + deltas.size() * 4);
+        std::memcpy(out.data(), keys.data(), keys.size() * 8);
+        std::memcpy(out.data() + keys.size() * 8, deltas.data(),
+                    deltas.size() * 4);
+        return respond(fd, static_cast<int64_t>(keys.size()), out.data(),
+                       out.size());
+      }
+      case kBarrier: {
+        std::unique_lock<std::mutex> lk(bar_mu);
+        int64_t my_gen = bar_gen;
+        if (++bar_count >= n_trainers) {
+          bar_count = 0;
+          bar_gen++;
+          bar_cv.notify_all();
+        } else {
+          bar_cv.wait(lk, [&]() { return bar_gen != my_gen || stopping.load(); });
+        }
+        return respond(fd, 0, nullptr, 0);
+      }
+      case kGlobalStep: {
+        int64_t s = global_step.fetch_add(h.n) + h.n;
+        return respond(fd, s, nullptr, 0);
+      }
+      case kStop: {
+        respond(fd, 0, nullptr, 0);
+        // stop() joins this thread; detach the shutdown
+        std::thread([this]() { stop(); }).detach();
+        return false;
+      }
+      default:
+        return respond(fd, kErrBadCmd, nullptr, 0);
+    }
+  }
+};
+
+// client connection: synchronous request/response; a mutex serializes
+// callers (the python Communicator provides async via its own threads)
+struct PsConn {
+  int fd = -1;
+  std::mutex mu;
+
+  ~PsConn() {
+    if (fd >= 0) ::close(fd);
+  }
+
+  bool connect_to(const char* host, int port) {
+    // resolve hostnames too (cluster endpoint lists are usually names)
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    char portstr[16];
+    std::snprintf(portstr, sizeof(portstr), "%d", port);
+    if (::getaddrinfo(host, portstr, &hints, &res) != 0 || res == nullptr)
+      return false;
+    fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd < 0) {
+      ::freeaddrinfo(res);
+      return false;
+    }
+    int rc = ::connect(fd, res->ai_addr, res->ai_addrlen);
+    ::freeaddrinfo(res);
+    if (rc < 0) {
+      ::close(fd);
+      fd = -1;
+      return false;
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    return true;
+  }
+
+  // returns status; fills resp (resized). -1000 on transport failure.
+  int64_t call(uint32_t cmd, uint32_t table_id, int64_t n, int32_t aux,
+               const void* payload, uint64_t plen, std::vector<char>* resp) {
+    std::lock_guard<std::mutex> g(mu);
+    ReqHeader h{plen, cmd, table_id, n, aux};
+    if (!write_full(fd, &h, sizeof(h))) return -1000;
+    if (plen && !write_full(fd, payload, plen)) return -1000;
+    uint64_t rh[2];
+    if (!read_full(fd, rh, sizeof(rh))) return -1000;
+    if (rh[0] > kMaxPayload) return -1000;
+    resp->resize(rh[0]);
+    if (rh[0] && !read_full(fd, resp->data(), rh[0])) return -1000;
+    return static_cast<int64_t>(rh[1]);
+  }
+};
+
+thread_local std::vector<char> g_resp;
+
+}  // namespace
+
+extern "C" {
+
+// ---- server ----
+void* pss_create(int port, int n_trainers) {
+  PsServer* s = new PsServer();
+  if (!s->start(port, n_trainers)) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+int pss_port(void* h) { return static_cast<PsServer*>(h)->port; }
+int pss_stopped(void* h) {
+  return static_cast<PsServer*>(h)->stopping.load() ? 1 : 0;
+}
+void pss_stop(void* h) { static_cast<PsServer*>(h)->stop(); }
+void pss_destroy(void* h) {
+  PsServer* s = static_cast<PsServer*>(h);
+  s->stop();
+  delete s;
+}
+
+// ---- client ----
+void* psc_connect(const char* host, int port) {
+  PsConn* c = new PsConn();
+  if (!c->connect_to(host, port)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+void psc_close(void* h) { delete static_cast<PsConn*>(h); }
+
+// generic call: returns status; response payload stashed thread-locally,
+// fetched via psc_resp_len / psc_resp_copy (avoids a resp-size handshake
+// per command in the ctypes layer).
+int64_t psc_call(void* h, uint32_t cmd, uint32_t table_id, int64_t n,
+                 int32_t aux, const void* payload, uint64_t plen) {
+  return static_cast<PsConn*>(h)->call(cmd, table_id, n, aux, payload, plen,
+                                       &g_resp);
+}
+uint64_t psc_resp_len(void*) { return g_resp.size(); }
+void psc_resp_copy(void*, void* out) {
+  if (!g_resp.empty()) std::memcpy(out, g_resp.data(), g_resp.size());
+}
+
+}  // extern "C"

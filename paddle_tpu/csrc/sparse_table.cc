@@ -17,474 +17,19 @@
 //
 // C ABI only (ctypes-friendly); all batch buffers are caller-owned.
 
-#include <algorithm>
-#include <atomic>
-#include <cmath>
-#include <cstdint>
-#include <cstring>
-#include <mutex>
-#include <random>
-#include <thread>
-#include <vector>
+#include "sparse_table.h"
+
+using pstpu::NativeTable;
+using pstpu::Shard;
+using pstpu::TableNativeConfig;
+using pstpu::table_full_dim;
 
 namespace {
-
-// ---------------------------------------------------------------------------
-// config / rule ids
-// ---------------------------------------------------------------------------
-
-enum RuleId : int32_t {
-  kRuleNaive = 0,
-  kRuleAdaGrad = 1,
-  kRuleStdAdaGrad = 2,
-  kRuleAdam = 3,
-};
-
-enum AccessorId : int32_t {
-  kAccessorCtr = 0,     // pull = [show, click, embed_w, embedx_w...]
-  kAccessorSparse = 1,  // pull = [embed_w, embedx_w...]
-};
-
-struct SgdConfig {
-  float learning_rate = 0.05f;
-  float initial_g2sum = 3.0f;
-  float initial_range = 1e-4f;
-  float weight_lo = -10.0f;
-  float weight_hi = 10.0f;
-  float beta1 = 0.9f;
-  float beta2 = 0.999f;
-  float ada_epsilon = 1e-8f;
-};
-
-struct TableNativeConfig {
-  int32_t shard_num = 16;
-  int32_t accessor = kAccessorCtr;
-  int32_t embedx_dim = 8;
-  int32_t embed_rule = kRuleAdaGrad;
-  int32_t embedx_rule = kRuleAdaGrad;
-  uint64_t seed = 0;
-  // accessor lifecycle (CtrAccessorParameter mirror)
-  float nonclk_coeff = 0.1f;
-  float click_coeff = 1.0f;
-  float base_threshold = 1.5f;
-  float delta_threshold = 0.25f;
-  float delta_keep_days = 16.0f;
-  float show_click_decay_rate = 0.98f;
-  float delete_threshold = 0.8f;
-  float delete_after_unseen_days = 30.0f;
-  float embedx_threshold = 10.0f;
-  SgdConfig sgd;
-};
-
-inline int32_t rule_state_dim(int32_t rule, int32_t dim) {
-  switch (rule) {
-    case kRuleNaive: return 0;
-    case kRuleAdaGrad: return 1;
-    case kRuleStdAdaGrad: return dim;
-    case kRuleAdam: return 2 * dim + 2;
-  }
-  return 0;
-}
-
-// ---------------------------------------------------------------------------
-// SGD rules (sparse_sgd_rule.cc math, batched-of-one form)
-// ---------------------------------------------------------------------------
-
-struct SgdRule {
-  int32_t id;
-  int32_t dim;        // embedding dim this rule drives
-  int32_t state_dim;  // optimizer-state floats per feature
-  SgdConfig cfg;
-
-  SgdRule(int32_t id_, int32_t dim_, const SgdConfig& c)
-      : id(id_), dim(dim_), state_dim(rule_state_dim(id_, dim_)), cfg(c) {}
-
-  inline float clip(float w) const {
-    return std::min(std::max(w, cfg.weight_lo), cfg.weight_hi);
-  }
-
-  // init: weights uniform(-initial_range, initial_range); state zeros
-  // (adam: beta powers start at beta1/beta2).
-  void init(float* w, float* state, std::mt19937_64& rng) const {
-    std::uniform_real_distribution<float> u(-cfg.initial_range, cfg.initial_range);
-    for (int32_t i = 0; i < dim; ++i) w[i] = u(rng);
-    for (int32_t i = 0; i < state_dim; ++i) state[i] = 0.0f;
-    if (id == kRuleAdam) {
-      state[2 * dim] = cfg.beta1;
-      state[2 * dim + 1] = cfg.beta2;
-    }
-  }
-
-  // update one feature's weights in place. grad has `dim` floats; scale
-  // is the push_show scale (AdaGrad family divides by it; Adam ignores
-  // it, matching the reference).
-  void update(float* w, float* state, const float* grad, float scale) const {
-    switch (id) {
-      case kRuleNaive: {
-        for (int32_t i = 0; i < dim; ++i)
-          w[i] = clip(w[i] - cfg.learning_rate * grad[i]);
-        break;
-      }
-      case kRuleAdaGrad: {
-        float s = std::max(scale, 1e-10f);
-        float g2sum = state[0];
-        float ratio = std::sqrt(cfg.initial_g2sum / (cfg.initial_g2sum + g2sum));
-        float add = 0.0f;
-        for (int32_t i = 0; i < dim; ++i) {
-          float sg = grad[i] / s;
-          w[i] = clip(w[i] - cfg.learning_rate * sg * ratio);
-          add += sg * sg;
-        }
-        state[0] = g2sum + add / static_cast<float>(dim);
-        break;
-      }
-      case kRuleStdAdaGrad: {
-        float s = std::max(scale, 1e-10f);
-        for (int32_t i = 0; i < dim; ++i) {
-          float sg = grad[i] / s;
-          float ratio =
-              std::sqrt(cfg.initial_g2sum / (cfg.initial_g2sum + state[i]));
-          w[i] = clip(w[i] - cfg.learning_rate * sg * ratio);
-          state[i] += sg * sg;
-        }
-        break;
-      }
-      case kRuleAdam: {
-        float* m = state;
-        float* v = state + dim;
-        float b1p = state[2 * dim];
-        float b2p = state[2 * dim + 1];
-        for (int32_t i = 0; i < dim; ++i) {
-          float g = grad[i];
-          m[i] = cfg.beta1 * m[i] + (1.0f - cfg.beta1) * g;
-          v[i] = cfg.beta2 * v[i] + (1.0f - cfg.beta2) * g * g;
-          float m_hat = m[i] / (1.0f - b1p);
-          float v_hat = v[i] / (1.0f - b2p);
-          w[i] = clip(w[i] - cfg.learning_rate * m_hat /
-                                 (std::sqrt(v_hat) + cfg.ada_epsilon));
-        }
-        state[2 * dim] = b1p * cfg.beta1;
-        state[2 * dim + 1] = b2p * cfg.beta2;
-        break;
-      }
-    }
-  }
-};
-
-// ---------------------------------------------------------------------------
-// open-addressing key -> row index (same scheme as sparse_index.cc)
-// ---------------------------------------------------------------------------
-
-constexpr int32_t kEmpty = -1;
-constexpr int32_t kTombstone = -2;
-
-inline uint64_t splitmix64(uint64_t x) {
-  x += 0x9e3779b97f4a7c15ULL;
-  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
-  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
-  return x ^ (x >> 31);
-}
-
-// ---------------------------------------------------------------------------
-// shard: index + columnar feature storage + accessor math
-// ---------------------------------------------------------------------------
-
-struct Shard {
-  const TableNativeConfig* cfg;
-  SgdRule embed_rule;
-  SgdRule embedx_rule;
-  std::mt19937_64 rng;
-  std::mutex mu;
-
-  // index
-  std::vector<uint64_t> slot_keys;
-  std::vector<int32_t> slot_state;  // row | kEmpty | kTombstone
-  uint64_t mask = 0;
-  int64_t used = 0;
-  int64_t occupied = 0;
-
-  // rows (SoA). row_alive gates recycled rows.
-  std::vector<uint64_t> row_key;
-  std::vector<uint8_t> row_alive;
-  std::vector<int32_t> free_rows;
-  std::vector<int32_t> f_slot;
-  std::vector<float> f_unseen, f_delta_score, f_show, f_click;
-  std::vector<float> f_embed_w;       // [rows]
-  std::vector<float> f_embed_state;   // [rows, es]
-  std::vector<float> f_embedx_w;      // [rows, xd]
-  std::vector<float> f_embedx_state;  // [rows, xs]
-  std::vector<uint8_t> f_has_embedx;
-
-  Shard(const TableNativeConfig* c, uint64_t seed)
-      : cfg(c),
-        embed_rule(c->embed_rule, 1, c->sgd),
-        embedx_rule(c->embedx_rule, c->embedx_dim, c->sgd),
-        rng(seed) {
-    slot_keys.assign(1024, 0);
-    slot_state.assign(1024, kEmpty);
-    mask = 1023;
-  }
-
-  int32_t es() const { return embed_rule.state_dim; }
-  int32_t xd() const { return cfg->embedx_dim; }
-  int32_t xs() const { return embedx_rule.state_dim; }
-
-  void grow_index() {
-    std::vector<uint64_t> ok(std::move(slot_keys));
-    std::vector<int32_t> os(std::move(slot_state));
-    uint64_t cap = (mask + 1) << 1;
-    slot_keys.assign(cap, 0);
-    slot_state.assign(cap, kEmpty);
-    mask = cap - 1;
-    occupied = 0;
-    for (size_t i = 0; i < ok.size(); ++i) {
-      if (os[i] >= 0) {
-        uint64_t h = splitmix64(ok[i]) & mask;
-        while (slot_state[h] != kEmpty) h = (h + 1) & mask;
-        slot_keys[h] = ok[i];
-        slot_state[h] = os[i];
-        ++occupied;
-      }
-    }
-  }
-
-  int32_t find(uint64_t key) const {
-    uint64_t h = splitmix64(key) & mask;
-    while (true) {
-      int32_t s = slot_state[h];
-      if (s == kEmpty) return -1;
-      if (s >= 0 && slot_keys[h] == key) return s;
-      h = (h + 1) & mask;
-    }
-  }
-
-  int32_t alloc_row(uint64_t key) {
-    int32_t r;
-    if (!free_rows.empty()) {
-      r = free_rows.back();
-      free_rows.pop_back();
-    } else {
-      r = static_cast<int32_t>(row_key.size());
-      row_key.push_back(0);
-      row_alive.push_back(0);
-      f_slot.push_back(0);
-      f_unseen.push_back(0);
-      f_delta_score.push_back(0);
-      f_show.push_back(0);
-      f_click.push_back(0);
-      f_embed_w.push_back(0);
-      f_embed_state.resize(f_embed_state.size() + es(), 0.0f);
-      f_embedx_w.resize(f_embedx_w.size() + xd(), 0.0f);
-      f_embedx_state.resize(f_embedx_state.size() + xs(), 0.0f);
-      f_has_embedx.push_back(0);
-    }
-    row_key[r] = key;
-    row_alive[r] = 1;
-    return r;
-  }
-
-  // Create (insert-on-miss): full reset — recycled rows must not inherit
-  // the dead feature's stats.
-  void create_row(int32_t r, int32_t slot) {
-    f_slot[r] = slot;
-    f_unseen[r] = 0;
-    f_delta_score[r] = 0;
-    f_show[r] = 0;
-    f_click[r] = 0;
-    embed_rule.init(&f_embed_w[r], es() ? &f_embed_state[r * es()] : nullptr, rng);
-    std::fill_n(&f_embedx_w[static_cast<size_t>(r) * xd()], xd(), 0.0f);
-    if (xs())
-      std::fill_n(&f_embedx_state[static_cast<size_t>(r) * xs()], xs(), 0.0f);
-    f_has_embedx[r] = 0;  // embedx lazy (NeedExtendMF)
-  }
-
-  int32_t lookup_or_insert(uint64_t key, int32_t slot) {
-    uint64_t h = splitmix64(key) & mask;
-    int64_t first_tomb = -1;
-    while (true) {
-      int32_t s = slot_state[h];
-      if (s == kEmpty) {
-        uint64_t target = (first_tomb >= 0) ? static_cast<uint64_t>(first_tomb) : h;
-        int32_t r = alloc_row(key);
-        create_row(r, slot);
-        slot_keys[target] = key;
-        slot_state[target] = r;
-        ++used;
-        if (first_tomb < 0) ++occupied;
-        if (occupied * 10 >= static_cast<int64_t>(mask + 1) * 7) grow_index();
-        return r;
-      }
-      if (s == kTombstone) {
-        if (first_tomb < 0) first_tomb = static_cast<int64_t>(h);
-      } else if (slot_keys[h] == key) {
-        return s;
-      }
-      h = (h + 1) & mask;
-    }
-  }
-
-  void erase(uint64_t key) {
-    uint64_t h = splitmix64(key) & mask;
-    while (true) {
-      int32_t s = slot_state[h];
-      if (s == kEmpty) return;
-      if (s >= 0 && slot_keys[h] == key) {
-        slot_state[h] = kTombstone;
-        row_alive[s] = 0;
-        free_rows.push_back(s);
-        --used;
-        return;
-      }
-      h = (h + 1) & mask;
-    }
-  }
-
-  float show_click_score(float show, float click) const {
-    return (show - click) * cfg->nonclk_coeff + click * cfg->click_coeff;
-  }
-
-  int32_t pull_dim() const {
-    return cfg->accessor == kAccessorCtr ? 3 + xd() : 1 + xd();
-  }
-  int32_t push_dim() const { return 4 + xd(); }
-
-  // Select (pull): CTR = [show, click, embed_w, embedx_w...]; Sparse
-  // drops the stats.
-  void select_into(int32_t r, float* out) const {
-    const float* xw = &f_embedx_w[static_cast<size_t>(r) * xd()];
-    float have = f_has_embedx[r] ? 1.0f : 0.0f;
-    if (cfg->accessor == kAccessorCtr) {
-      out[0] = f_show[r];
-      out[1] = f_click[r];
-      out[2] = f_embed_w[r];
-      for (int32_t i = 0; i < xd(); ++i) out[3 + i] = xw[i] * have;
-    } else {
-      out[0] = f_embed_w[r];
-      for (int32_t i = 0; i < xd(); ++i) out[1 + i] = xw[i] * have;
-    }
-  }
-
-  // Push one merged record: [slot, show, click, embed_g, embedx_g...]
-  // (ctr_accessor.cc:219 semantics).
-  void push_one(int32_t r, const float* pv) {
-    float push_show = pv[1], push_click = pv[2];
-    f_show[r] += push_show;
-    f_click[r] += push_click;
-    f_delta_score[r] += (push_show - push_click) * cfg->nonclk_coeff +
-                        push_click * cfg->click_coeff;
-    f_unseen[r] = 0.0f;
-    embed_rule.update(&f_embed_w[r], es() ? &f_embed_state[r * es()] : nullptr,
-                      pv + 3, push_show);
-    float score = show_click_score(f_show[r], f_click[r]);
-    size_t xo = static_cast<size_t>(r) * xd();
-    if (!f_has_embedx[r] && score >= cfg->embedx_threshold) {
-      embedx_rule.init(&f_embedx_w[xo],
-                       xs() ? &f_embedx_state[static_cast<size_t>(r) * xs()] : nullptr,
-                       rng);
-      f_has_embedx[r] = 1;
-      // creation happens before the embedx update, so the fresh row
-      // consumes this push's embedx gradient (same order as the Python
-      // accessor and the reference's CtrCommonAccessor::Update)
-      embedx_rule.update(&f_embedx_w[xo],
-                         xs() ? &f_embedx_state[static_cast<size_t>(r) * xs()] : nullptr,
-                         pv + 4, push_show);
-    } else if (f_has_embedx[r]) {
-      embedx_rule.update(&f_embedx_w[xo],
-                         xs() ? &f_embedx_state[static_cast<size_t>(r) * xs()] : nullptr,
-                         pv + 4, push_show);
-    }
-  }
-
-  // Shrink (daily): decay show/click, unseen++, drop dead features.
-  int64_t shrink() {
-    int64_t erased = 0;
-    for (uint64_t h = 0; h <= mask; ++h) {
-      int32_t r = slot_state[h];
-      if (r < 0) continue;
-      f_show[r] *= cfg->show_click_decay_rate;
-      f_click[r] *= cfg->show_click_decay_rate;
-      f_unseen[r] += 1.0f;
-      float score = show_click_score(f_show[r], f_click[r]);
-      if (score < cfg->delete_threshold ||
-          f_unseen[r] > cfg->delete_after_unseen_days) {
-        slot_state[h] = kTombstone;
-        row_alive[r] = 0;
-        free_rows.push_back(r);
-        --used;
-        ++erased;
-      }
-    }
-    return erased;
-  }
-
-  bool save_keep(int32_t r, int32_t mode) const {
-    if (mode == 0 || mode == 3) return true;
-    float delta_threshold = (mode == 2) ? 0.0f : cfg->delta_threshold;
-    float score = show_click_score(f_show[r], f_click[r]);
-    return score >= cfg->base_threshold &&
-           f_delta_score[r] >= delta_threshold &&
-           f_unseen[r] <= cfg->delta_keep_days;
-  }
-
-  void update_stat_after_save(int32_t r, int32_t mode) {
-    if (mode == 3)
-      f_unseen[r] += 1.0f;
-    else if (mode == 2)
-      f_delta_score[r] = 0.0f;
-  }
-};
-
-// ---------------------------------------------------------------------------
-// table: shard fan-out
-// ---------------------------------------------------------------------------
-
-struct NativeTable {
-  TableNativeConfig cfg;
-  std::vector<Shard*> shards;
-  // save cursor state (begin/fetch protocol)
-  std::vector<uint64_t> save_keys;
-  std::vector<std::pair<int32_t, int32_t>> save_rows;  // (shard, row)
-
-  explicit NativeTable(const TableNativeConfig& c) : cfg(c) {
-    shards.reserve(cfg.shard_num);
-    for (int32_t i = 0; i < cfg.shard_num; ++i)
-      shards.push_back(new Shard(&cfg, cfg.seed + static_cast<uint64_t>(i)));
-  }
-  ~NativeTable() {
-    for (Shard* s : shards) delete s;
-  }
-
-  int32_t route(uint64_t key) const {
-    return static_cast<int32_t>(key % static_cast<uint64_t>(cfg.shard_num));
-  }
-
-  // fan a batch over shards with one worker thread per non-empty shard
-  template <typename Fn>
-  void parallel_over_shards(const uint64_t* keys, int64_t n, Fn fn) {
-    int32_t ns = cfg.shard_num;
-    std::vector<std::vector<int64_t>> per_shard(ns);
-    for (int64_t i = 0; i < n; ++i) per_shard[route(keys[i])].push_back(i);
-    std::vector<std::thread> ts;
-    for (int32_t s = 0; s < ns; ++s) {
-      if (per_shard[s].empty()) continue;
-      ts.emplace_back([&, s]() {
-        Shard* sh = shards[s];
-        std::lock_guard<std::mutex> g(sh->mu);
-        for (int64_t i : per_shard[s]) fn(sh, i);
-      });
-    }
-    for (auto& t : ts) t.join();
-  }
-};
-
-// full save/load row width: slot, unseen, delta_score, show, click,
-// embed_w, embed_state[es], has_embedx, embedx_w[xd], embedx_state[xs]
-inline int32_t full_dim(const NativeTable* t) {
-  const Shard* s = t->shards[0];
-  return 7 + s->es() + s->xd() + s->xs();
-}
-
+// unqualified name kept for the ABI bodies below; pstpu::table_full_dim
+// is the shared definition
+inline int32_t full_dim(const NativeTable* t) { return pstpu::table_full_dim(t); }
 }  // namespace
+
 
 // ---------------------------------------------------------------------------
 // C ABI
@@ -493,35 +38,8 @@ inline int32_t full_dim(const NativeTable* t) {
 extern "C" {
 
 void* pst_create(const int32_t* iparams, const float* fparams) {
-  // iparams: shard_num, accessor, embedx_dim, embed_rule, embedx_rule, seed
-  // fparams: nonclk, click, base_th, delta_th, delta_keep, decay, del_th,
-  //          del_unseen, embedx_th, lr, init_g2sum, init_range, w_lo, w_hi,
-  //          beta1, beta2, ada_eps
-  TableNativeConfig c;
-  c.shard_num = iparams[0];
-  c.accessor = iparams[1];
-  c.embedx_dim = iparams[2];
-  c.embed_rule = iparams[3];
-  c.embedx_rule = iparams[4];
-  c.seed = static_cast<uint64_t>(iparams[5]);
-  c.nonclk_coeff = fparams[0];
-  c.click_coeff = fparams[1];
-  c.base_threshold = fparams[2];
-  c.delta_threshold = fparams[3];
-  c.delta_keep_days = fparams[4];
-  c.show_click_decay_rate = fparams[5];
-  c.delete_threshold = fparams[6];
-  c.delete_after_unseen_days = fparams[7];
-  c.embedx_threshold = fparams[8];
-  c.sgd.learning_rate = fparams[9];
-  c.sgd.initial_g2sum = fparams[10];
-  c.sgd.initial_range = fparams[11];
-  c.sgd.weight_lo = fparams[12];
-  c.sgd.weight_hi = fparams[13];
-  c.sgd.beta1 = fparams[14];
-  c.sgd.beta2 = fparams[15];
-  c.sgd.ada_epsilon = fparams[16];
-  return new NativeTable(c);
+  // param order documented at pstpu::parse_table_config (sparse_table.h)
+  return new NativeTable(pstpu::parse_table_config(iparams, fparams));
 }
 
 void pst_destroy(void* h) { delete static_cast<NativeTable*>(h); }
@@ -589,105 +107,25 @@ int64_t pst_shrink(void* h) {
 // update_stat_after_save) and returns its count; fetch copies
 // keys [count] + values [count, full_dim] out and clears the cursor.
 int64_t pst_save_begin(void* h, int32_t mode) {
-  NativeTable* t = static_cast<NativeTable*>(h);
-  t->save_keys.clear();
-  t->save_rows.clear();
-  for (size_t s = 0; s < t->shards.size(); ++s) {
-    Shard* sh = t->shards[s];
-    std::lock_guard<std::mutex> g(sh->mu);
-    for (uint64_t hh = 0; hh <= sh->mask; ++hh) {
-      int32_t r = sh->slot_state[hh];
-      if (r < 0) continue;
-      if (sh->save_keep(r, mode)) {
-        sh->update_stat_after_save(r, mode);
-        t->save_keys.push_back(sh->slot_keys[hh]);
-        t->save_rows.emplace_back(static_cast<int32_t>(s), r);
-      }
-    }
-  }
-  return static_cast<int64_t>(t->save_keys.size());
+  return pstpu::table_save_snapshot(static_cast<NativeTable*>(h), mode);
 }
 
 void pst_save_fetch(void* h, uint64_t* keys_out, float* values_out) {
-  NativeTable* t = static_cast<NativeTable*>(h);
-  int32_t fd = full_dim(t);
-  for (size_t i = 0; i < t->save_keys.size(); ++i) {
-    keys_out[i] = t->save_keys[i];
-    Shard* sh = t->shards[t->save_rows[i].first];
-    int32_t r = t->save_rows[i].second;
-    float* o = values_out + i * fd;
-    int32_t es = sh->es(), xd = sh->xd(), xs = sh->xs();
-    o[0] = static_cast<float>(sh->f_slot[r]);
-    o[1] = sh->f_unseen[r];
-    o[2] = sh->f_delta_score[r];
-    o[3] = sh->f_show[r];
-    o[4] = sh->f_click[r];
-    o[5] = sh->f_embed_w[r];
-    for (int32_t j = 0; j < es; ++j) o[6 + j] = sh->f_embed_state[r * es + j];
-    o[6 + es] = sh->f_has_embedx[r] ? 1.0f : 0.0f;
-    for (int32_t j = 0; j < xd; ++j)
-      o[7 + es + j] = sh->f_embedx_w[static_cast<size_t>(r) * xd + j];
-    for (int32_t j = 0; j < xs; ++j)
-      o[7 + es + xd + j] = sh->f_embedx_state[static_cast<size_t>(r) * xs + j];
-  }
-  t->save_keys.clear();
-  t->save_rows.clear();
+  pstpu::table_save_drain(static_cast<NativeTable*>(h), keys_out, values_out);
 }
 
 // Bulk export of full rows for a key subset (cache pass-build state
 // load): no insert-on-miss; found[i]=0 rows are zero-filled.
 void pst_export(void* h, const uint64_t* keys, int64_t n, float* values_out,
                 uint8_t* found) {
-  NativeTable* t = static_cast<NativeTable*>(h);
-  int32_t fd = full_dim(t);
-  t->parallel_over_shards(keys, n, [&](Shard* sh, int64_t i) {
-    int32_t r = sh->find(keys[i]);
-    float* o = values_out + i * fd;
-    if (r < 0) {
-      std::fill_n(o, fd, 0.0f);
-      found[i] = 0;
-      return;
-    }
-    found[i] = 1;
-    int32_t es = sh->es(), xd = sh->xd(), xs = sh->xs();
-    o[0] = static_cast<float>(sh->f_slot[r]);
-    o[1] = sh->f_unseen[r];
-    o[2] = sh->f_delta_score[r];
-    o[3] = sh->f_show[r];
-    o[4] = sh->f_click[r];
-    o[5] = sh->f_embed_w[r];
-    for (int32_t j = 0; j < es; ++j) o[6 + j] = sh->f_embed_state[r * es + j];
-    o[6 + es] = sh->f_has_embedx[r] ? 1.0f : 0.0f;
-    for (int32_t j = 0; j < xd; ++j)
-      o[7 + es + j] = sh->f_embedx_w[static_cast<size_t>(r) * xd + j];
-    for (int32_t j = 0; j < xs; ++j)
-      o[7 + es + xd + j] = sh->f_embedx_state[static_cast<size_t>(r) * xs + j];
-  });
+  pstpu::table_export(static_cast<NativeTable*>(h), keys, n, values_out, found);
 }
 
 // Bulk insert of full rows (load path / cache flush-back): keys [n],
 // values [n, full_dim] in the save layout.
 void pst_insert_full(void* h, const uint64_t* keys, const float* values,
                      int64_t n) {
-  NativeTable* t = static_cast<NativeTable*>(h);
-  int32_t fd = full_dim(t);
-  t->parallel_over_shards(keys, n, [&](Shard* sh, int64_t i) {
-    const float* v = values + i * fd;
-    int32_t r = sh->lookup_or_insert(keys[i], static_cast<int32_t>(v[0]));
-    int32_t es = sh->es(), xd = sh->xd(), xs = sh->xs();
-    sh->f_slot[r] = static_cast<int32_t>(v[0]);
-    sh->f_unseen[r] = v[1];
-    sh->f_delta_score[r] = v[2];
-    sh->f_show[r] = v[3];
-    sh->f_click[r] = v[4];
-    sh->f_embed_w[r] = v[5];
-    for (int32_t j = 0; j < es; ++j) sh->f_embed_state[r * es + j] = v[6 + j];
-    sh->f_has_embedx[r] = v[6 + es] != 0.0f;
-    for (int32_t j = 0; j < xd; ++j)
-      sh->f_embedx_w[static_cast<size_t>(r) * xd + j] = v[7 + es + j];
-    for (int32_t j = 0; j < xs; ++j)
-      sh->f_embedx_state[static_cast<size_t>(r) * xs + j] = v[7 + es + xd + j];
-  });
+  pstpu::table_insert_full(static_cast<NativeTable*>(h), keys, values, n);
 }
 
 }  // extern "C"

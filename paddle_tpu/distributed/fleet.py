@@ -74,11 +74,36 @@ class Fleet:
         self._role_maker = role_maker or PaddleCloudRoleMaker(is_collective=is_collective)
         self._strategy = strategy or DistributedStrategy()
         self._is_collective = is_collective
-        # in-process server handle shared by this process's client(s)
-        self._server = PsServerHandle()
-        self._client = LocalPsClient(self._server)
+        self._transport = self._pick_transport()
+        if self._transport == "local":
+            # in-process server handle shared by this process's client(s)
+            self._server = PsServerHandle()
+            self._client = LocalPsClient(self._server)
+        else:
+            self._server = None
+            self._client = None  # workers connect in init_worker
+        self._rpc_server = None
         self._inited = True
         return self
+
+    def _pick_transport(self) -> str:
+        mode = getattr(self._strategy, "ps_transport", "auto")
+        if mode in ("local", "rpc"):
+            return mode
+        import os
+
+        eps = self._role_maker.get_pserver_endpoints()
+        if eps and os.environ.get("TRAINING_ROLE"):
+            from ..ps.rpc import rpc_available
+
+            if rpc_available():
+                return "rpc"
+        return "local"
+
+    @property
+    def transport(self) -> str:
+        self._check_init()
+        return self._transport
 
     def _check_init(self) -> None:
         enforce(self._inited, "call fleet.init() first", PreconditionNotMetError)
@@ -125,16 +150,31 @@ class Fleet:
         self._check_init()
         cfg = config or TableConfig(table_id=table_id)
         self._table_configs[table_id] = cfg
+        if self._transport == "rpc":
+            self._require_client().create_sparse_table(table_id, cfg)
+            return None
         return self._server.create_sparse_table(table_id, cfg)
 
     def register_dense_table(self, table_id: int, dim: int, optimizer: str = "adam",
                              lr: float = 0.001):
         self._check_init()
+        if self._transport == "rpc":
+            self._require_client().create_dense_table(table_id, dim, optimizer, lr)
+            return None
         return self._server.create_dense_table(table_id, dim, optimizer, lr)
 
     def register_geo_table(self, table_id: int, dim: int):
         self._check_init()
+        if self._transport == "rpc":
+            self._require_client().create_geo_table(table_id, dim)
+            return None
         return self._server.create_geo_table(table_id, dim)
+
+    def _require_client(self):
+        enforce(self._client is not None,
+                "rpc transport: call fleet.init_worker() before table "
+                "registration/use on a worker", PreconditionNotMetError)
+        return self._client
 
     @property
     def client(self) -> LocalPsClient:
@@ -149,17 +189,33 @@ class Fleet:
 
     def init_server(self, *args, **kwargs) -> None:
         self._check_init()
+        if self._transport == "rpc":
+            # bind the native TCP service at this server's endpoint port
+            from ..ps.rpc import NativePsServer
+
+            ep = self._role_maker.get_pserver_endpoints()[self._role_maker.server_index()]
+            port = int(ep.rsplit(":", 1)[1])
+            self._rpc_server = NativePsServer(port=port, n_trainers=max(self.worker_num(), 1))
+            return
         self._server.barrier_table = BarrierTable(max(self.worker_num(), 1))
 
     def run_server(self) -> None:
-        """In-process server 'runs' by existing; this marks it live (the
-        brpc serving loop has no analogue — tables serve via direct calls
-        intra-process and the DCN service when multi-host lands)."""
+        """rpc transport: block serving until a trainer sends STOP (the
+        BrpcPsServer::Start serving loop). local transport: tables serve
+        via direct calls intra-process; this marks the server live."""
         self._check_init()
         self._server_running.set()
+        if self._transport == "rpc" and self._rpc_server is not None:
+            import time
+
+            while self._server_running.is_set() and not self._rpc_server.stopped:
+                time.sleep(0.2)
 
     def stop_server(self) -> None:
         self._server_running.clear()
+        if getattr(self, "_rpc_server", None) is not None:
+            self._rpc_server.close()
+            self._rpc_server = None
 
     # -- worker lifecycle --------------------------------------------------
 
@@ -167,6 +223,8 @@ class Fleet:
         """Create the communicator per strategy mode (TheOnePSRuntime
         _init_worker: Communicator::InitImpl + Start)."""
         self._check_init()
+        if self._transport == "rpc" and self._client is None:
+            self._client = self._connect_rpc()
         s = self._strategy
         if s.is_geo_mode:
             self._communicator = GeoCommunicator(
@@ -189,6 +247,25 @@ class Fleet:
         if self._communicator is not None:
             self._communicator.barrier()
 
+    def _connect_rpc(self, timeout: float = 60.0):
+        """Connect to all pserver endpoints, retrying while servers bind
+        (BrpcPsClient connects with FLAGS_pserver_connect_timeout_ms
+        retries the same way)."""
+        import time
+
+        from ..ps.rpc import RpcPsClient, _rpc_lib
+
+        _rpc_lib()  # lib problems are permanent — fail fast, don't retry
+        eps = self._role_maker.get_pserver_endpoints()
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                return RpcPsClient(eps)
+            except PreconditionNotMetError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.2)
+
     # -- save/load ---------------------------------------------------------
 
     def save_persistables(self, dirname: str, mode: int = 0) -> Dict[int, int]:
@@ -197,20 +274,25 @@ class Fleet:
         FleetWrapper::SaveModel)."""
         self._check_init()
         out = {}
-        for table_id in self._server.sparse_tables:
+        for table_id in self._sparse_table_ids():
             out[table_id] = self._client.save(table_id, f"{dirname}/table_{table_id}", mode)
         return out
 
     def load_model(self, dirname: str) -> Dict[int, int]:
         self._check_init()
         out = {}
-        for table_id in self._server.sparse_tables:
+        for table_id in self._sparse_table_ids():
             out[table_id] = self._client.load(table_id, f"{dirname}/table_{table_id}")
         return out
 
     def shrink(self) -> Dict[int, int]:
         self._check_init()
-        return {tid: self._client.shrink(tid) for tid in self._server.sparse_tables}
+        return {tid: self._client.shrink(tid) for tid in self._sparse_table_ids()}
+
+    def _sparse_table_ids(self):
+        if self._transport == "rpc":
+            return sorted(self._table_configs)
+        return list(self._server.sparse_tables)
 
     # -- optimizer ---------------------------------------------------------
 

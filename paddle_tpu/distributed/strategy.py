@@ -31,6 +31,12 @@ class DistributedStrategy:
             "launch_barrier": True,
         }
     )
+    # PS transport: "local" = in-process tables (PsLocalClient),
+    # "rpc" = native TCP service (csrc/ps_service.cc, the brpc role),
+    # "auto" = rpc when the role maker describes a real multi-process
+    # cluster (TRAINING_ROLE + pserver endpoints), else local
+    ps_transport: str = "auto"
+
     # geo mode: a_sync + geo_configs
     geo_sgd_mode: bool = False
     geo_configs: Dict[str, Any] = dataclasses.field(

@@ -1,0 +1,470 @@
+"""TCP PS transport: Python wrappers over the native service
+(csrc/ps_service.cc) — the DCN path for multi-host CPU tables.
+
+Reference counterpart: BrpcPsServer/BrpcPsClient
+(ps/service/brpc_ps_{server,client}.cc) and the PsService command
+dispatch (sendrecv.proto). Behavioral parity points:
+- key routing: server = key % num_servers (brpc_ps_client.cc:568),
+  one request per server per pull, sub-responses joined client-side;
+- dense params split evenly across servers (DenseDimPerShard :607);
+- insert-on-miss pull, client-side duplicate-key merge before push;
+- barrier via the server-side BarrierTable (all trainers arrive).
+
+``NativePsServer`` hosts the C++ service in-process (the reference runs
+brpc servers in the trainer-0/daemon processes the same way);
+``RpcPsClient`` implements the PSClient interface over N servers.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.enforce import NotFoundError, PreconditionNotMetError, enforce
+from .accessor import AccessorConfig
+from .client import PSClient
+from .native import _ACCESSOR_IDS, _RULE_IDS, load_native
+from .table import (TableConfig, format_shard_row, merge_duplicate_keys,
+                    parse_shard_row)
+
+__all__ = ["NativePsServer", "RpcPsClient", "rpc_available"]
+
+# command ids (ps_service.cc Cmd enum)
+_CREATE_SPARSE = 1
+_CREATE_DENSE = 2
+_PULL_SPARSE = 3
+_PUSH_SPARSE = 4
+_PULL_DENSE = 5
+_PUSH_DENSE = 6
+_SET_DENSE = 7
+_SIZE = 8
+_SHRINK = 9
+_SAVE_BEGIN = 10
+_SAVE_FETCH = 11
+_INSERT_FULL = 12
+_EXPORT = 13
+_BARRIER = 14
+_STOP = 15
+_PING = 16
+_GLOBAL_STEP = 17
+_CREATE_GEO = 18
+_PUSH_GEO = 19
+_PULL_GEO = 20
+
+_DENSE_OPT_IDS = {"sgd": 0, "adam": 1, "sum": 2}
+
+
+def _configure_rpc(lib: ctypes.CDLL) -> None:
+    lib.pss_create.restype = ctypes.c_void_p
+    lib.pss_create.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.pss_port.restype = ctypes.c_int
+    lib.pss_port.argtypes = [ctypes.c_void_p]
+    lib.pss_stopped.restype = ctypes.c_int
+    lib.pss_stopped.argtypes = [ctypes.c_void_p]
+    lib.pss_stop.argtypes = [ctypes.c_void_p]
+    lib.pss_destroy.argtypes = [ctypes.c_void_p]
+    lib.psc_connect.restype = ctypes.c_void_p
+    lib.psc_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.psc_close.argtypes = [ctypes.c_void_p]
+    lib.psc_call.restype = ctypes.c_int64
+    lib.psc_call.argtypes = [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+                             ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+                             ctypes.c_uint64]
+    lib.psc_resp_len.restype = ctypes.c_uint64
+    lib.psc_resp_len.argtypes = [ctypes.c_void_p]
+    lib.psc_resp_copy.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+
+
+def _rpc_lib() -> ctypes.CDLL:
+    lib = load_native()
+    if lib is None:
+        raise PreconditionNotMetError("native library unavailable (no toolchain)")
+    if not getattr(lib, "_rpc_configured", False):
+        try:
+            _configure_rpc(lib)
+        except AttributeError as e:
+            raise PreconditionNotMetError(f"native library lacks ps-service symbols: {e}")
+        lib._rpc_configured = True
+    return lib
+
+
+def rpc_available() -> bool:
+    try:
+        _rpc_lib()
+        return True
+    except PreconditionNotMetError:
+        return False
+
+
+class NativePsServer:
+    """In-process native PS server (accept loop + handler threads live in
+    C++). ``port=0`` binds an ephemeral port (read ``.port``)."""
+
+    def __init__(self, port: int = 0, n_trainers: int = 1) -> None:
+        self._lib = _rpc_lib()
+        self._h = self._lib.pss_create(port, n_trainers)
+        enforce(self._h is not None, f"failed to bind PS server port {port}")
+        self.port = int(self._lib.pss_port(self._h))
+
+    def stop(self) -> None:
+        if self._h:
+            self._lib.pss_stop(self._h)
+
+    @property
+    def stopped(self) -> bool:
+        return self._h is None or bool(self._lib.pss_stopped(self._h))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.pss_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class _ServerConn:
+    """One TCP connection (C++ PsConn) with the call/resp protocol."""
+
+    def __init__(self, lib: ctypes.CDLL, host: str, port: int) -> None:
+        self._lib = lib
+        self._h = lib.psc_connect(host.encode(), port)
+        if not self._h:
+            raise PreconditionNotMetError(f"cannot connect to PS server {host}:{port}")
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.psc_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def call(self, cmd: int, table_id: int = 0, n: int = 0, aux: int = 0,
+             payload: Optional[bytes] = None) -> Tuple[int, bytes]:
+        buf = payload or b""
+        status = int(self._lib.psc_call(self._h, cmd, table_id, n, aux, buf, len(buf)))
+        enforce(status != -1000, "PS transport failure (server gone?)")
+        rlen = int(self._lib.psc_resp_len(self._h))
+        if not rlen:
+            return status, b""
+        resp = ctypes.create_string_buffer(rlen)
+        self._lib.psc_resp_copy(self._h, resp)
+        return status, resp.raw
+
+    def check(self, cmd: int, table_id: int = 0, n: int = 0, aux: int = 0,
+              payload: Optional[bytes] = None) -> Tuple[int, bytes]:
+        status, resp = self.call(cmd, table_id, n, aux, payload)
+        if status == -2:
+            raise NotFoundError(f"table {table_id} not created on server")
+        enforce(status >= 0, f"PS command {cmd} failed with status {status}")
+        return status, resp
+
+
+def _sparse_config_payload(cfg: TableConfig) -> bytes:
+    acc = cfg.accessor_config or AccessorConfig()
+    sgd = acc.sgd
+    ip = np.asarray([cfg.shard_num, _ACCESSOR_IDS[cfg.accessor], acc.embedx_dim,
+                     _RULE_IDS[acc.embed_sgd_rule], _RULE_IDS[acc.embedx_sgd_rule],
+                     cfg.seed], np.int32)
+    fp = np.asarray([acc.nonclk_coeff, acc.click_coeff, acc.base_threshold,
+                     acc.delta_threshold, acc.delta_keep_days,
+                     acc.show_click_decay_rate, acc.delete_threshold,
+                     acc.delete_after_unseen_days, acc.embedx_threshold,
+                     sgd.learning_rate, sgd.initial_g2sum, sgd.initial_range,
+                     sgd.weight_bounds[0], sgd.weight_bounds[1],
+                     sgd.beta1, sgd.beta2, sgd.ada_epsilon], np.float32)
+    return ip.tobytes() + fp.tobytes()
+
+
+class RpcPsClient(PSClient):
+    """PSClient over N TCP servers. Sparse keys route by
+    ``key % num_servers``; dense tables split into contiguous
+    even slices per server (DenseDimPerShard semantics)."""
+
+    def __init__(self, endpoints: Sequence[str]) -> None:
+        lib = _rpc_lib()
+        self._conns: List[_ServerConn] = []
+        for ep in endpoints:
+            host, port = ep.rsplit(":", 1)
+            self._conns.append(_ServerConn(lib, host, int(port)))
+        self._sparse_dims: Dict[int, Tuple[int, int, int]] = {}  # pull/push/full
+        self._sparse_cfgs: Dict[int, TableConfig] = {}
+        self._dense_dims: Dict[int, int] = {}
+        self._geo_dims: Dict[int, int] = {}
+
+    @property
+    def num_servers(self) -> int:
+        return len(self._conns)
+
+    def close(self) -> None:
+        for c in self._conns:
+            c.close()
+
+    # -- table lifecycle --------------------------------------------------
+
+    def create_sparse_table(self, table_id: int, config: Optional[TableConfig] = None) -> None:
+        cfg = config or TableConfig(table_id=table_id)
+        self._sparse_cfgs[table_id] = cfg
+        payload = _sparse_config_payload(cfg)
+        for c in self._conns:
+            _, resp = c.check(_CREATE_SPARSE, table_id, payload=payload)
+            dims = np.frombuffer(resp, np.int32)
+            self._sparse_dims[table_id] = (int(dims[0]), int(dims[1]), int(dims[2]))
+
+    def create_dense_table(self, table_id: int, dim: int, optimizer: str = "adam",
+                           lr: float = 0.001) -> None:
+        self._dense_dims[table_id] = dim
+        for s, c in enumerate(self._conns):
+            shard_dim = len(self._dense_slice(dim, s))
+            payload = (np.asarray([shard_dim, _DENSE_OPT_IDS[optimizer]], np.int32).tobytes()
+                       + np.asarray([lr], np.float32).tobytes())
+            c.check(_CREATE_DENSE, table_id, payload=payload)
+
+    def create_geo_table(self, table_id: int, dim: int) -> None:
+        self._geo_dims[table_id] = dim
+        payload = np.asarray([dim], np.int32).tobytes()
+        for c in self._conns:
+            c.check(_CREATE_GEO, table_id, payload=payload)
+
+    def _dense_slice(self, dim: int, server: int) -> range:
+        per = (dim + self.num_servers - 1) // self.num_servers
+        lo = min(per * server, dim)
+        return range(lo, min(lo + per, dim))
+
+    def _route(self, keys: np.ndarray) -> np.ndarray:
+        return (keys % np.uint64(self.num_servers)).astype(np.int64)
+
+    def _dims(self, table_id: int) -> Tuple[int, int, int]:
+        try:
+            return self._sparse_dims[table_id]
+        except KeyError:
+            raise NotFoundError(f"sparse table {table_id} not created via this client")
+
+    # -- PSClient interface -----------------------------------------------
+
+    def pull_sparse(self, table_id, keys, create=True, slots=None):
+        keys = np.ascontiguousarray(keys, np.uint64)
+        pull_dim = self._dims(table_id)[0]
+        out = np.zeros((len(keys), pull_dim), np.float32)
+        sv = self._route(keys)
+        slots_arr = (np.ascontiguousarray(slots, np.int32) if slots is not None
+                     else np.zeros(len(keys), np.int32))
+        for s, c in enumerate(self._conns):
+            sel = np.flatnonzero(sv == s)
+            if not len(sel):
+                continue
+            payload = keys[sel].tobytes() + slots_arr[sel].tobytes()
+            _, resp = c.check(_PULL_SPARSE, table_id, n=len(sel),
+                              aux=1 if create else 0, payload=payload)
+            out[sel] = np.frombuffer(resp, np.float32).reshape(len(sel), pull_dim)
+        return out
+
+    def push_sparse(self, table_id, keys, values):
+        keys = np.ascontiguousarray(keys, np.uint64)
+        values = np.ascontiguousarray(values, np.float32)
+        # client-side dedup-merge (brpc client merges duplicate keys
+        # before send)
+        keys, values = merge_duplicate_keys(keys, values)
+        sv = self._route(keys)
+        for s, c in enumerate(self._conns):
+            sel = np.flatnonzero(sv == s)
+            if not len(sel):
+                continue
+            payload = keys[sel].tobytes() + np.ascontiguousarray(values[sel]).tobytes()
+            c.check(_PUSH_SPARSE, table_id, n=len(sel), payload=payload)
+
+    def pull_dense(self, table_id):
+        try:
+            dim = self._dense_dims[table_id]
+        except KeyError:
+            raise NotFoundError(f"dense table {table_id} not created via this client")
+        out = np.zeros(dim, np.float32)
+        for s, c in enumerate(self._conns):
+            sl = self._dense_slice(dim, s)
+            if not len(sl):
+                continue
+            _, resp = c.check(_PULL_DENSE, table_id)
+            out[sl.start : sl.stop] = np.frombuffer(resp, np.float32)
+        return out
+
+    def push_dense(self, table_id, grad):
+        grad = np.ascontiguousarray(grad, np.float32)
+        dim = self._dense_dims[table_id]
+        for s, c in enumerate(self._conns):
+            sl = self._dense_slice(dim, s)
+            if not len(sl):
+                continue
+            c.check(_PUSH_DENSE, table_id, payload=grad[sl.start : sl.stop].tobytes())
+
+    def set_dense(self, table_id, values):
+        values = np.ascontiguousarray(values, np.float32)
+        dim = self._dense_dims[table_id]
+        for s, c in enumerate(self._conns):
+            sl = self._dense_slice(dim, s)
+            if not len(sl):
+                continue
+            c.check(_SET_DENSE, table_id, payload=values[sl.start : sl.stop].tobytes())
+
+    def push_geo(self, table_id, keys, deltas):
+        keys = np.ascontiguousarray(keys, np.uint64)
+        deltas = np.ascontiguousarray(deltas, np.float32)
+        sv = self._route(keys)
+        for s, c in enumerate(self._conns):
+            sel = np.flatnonzero(sv == s)
+            if not len(sel):
+                continue
+            payload = keys[sel].tobytes() + np.ascontiguousarray(deltas[sel]).tobytes()
+            c.check(_PUSH_GEO, table_id, n=len(sel), payload=payload)
+
+    def pull_geo(self, table_id):
+        dim = self._geo_dims[table_id]
+        all_keys, all_deltas = [], []
+        for c in self._conns:
+            cnt, resp = c.check(_PULL_GEO, table_id)
+            if cnt:
+                all_keys.append(np.frombuffer(resp[: cnt * 8], np.uint64))
+                all_deltas.append(
+                    np.frombuffer(resp[cnt * 8 :], np.float32).reshape(cnt, dim))
+        if not all_keys:
+            return np.zeros(0, np.uint64), np.zeros((0, dim), np.float32)
+        return np.concatenate(all_keys), np.concatenate(all_deltas)
+
+    def barrier(self):
+        # all-trainer barrier lives on server 0 (BarrierTable placement)
+        self._conns[0].check(_BARRIER)
+
+    def global_step(self, increment: int = 1) -> int:
+        status, _ = self._conns[0].check(_GLOBAL_STEP, n=increment)
+        return status
+
+    def shrink(self, table_id):
+        return sum(c.check(_SHRINK, table_id)[0] for c in self._conns)
+
+    def size(self, table_id) -> int:
+        return sum(c.check(_SIZE, table_id)[0] for c in self._conns)
+
+
+    def _embedx_dim(self, table_id: int) -> int:
+        cfg = self._sparse_cfgs[table_id]
+        return (cfg.accessor_config or AccessorConfig()).embedx_dim
+
+    def _embedx_state_dim(self, table_id: int) -> int:
+        """xs from full_dim = 7 + ed + xd + xs with ed derived from the
+        config's embed rule (dim 1)."""
+        from .sgd_rule import make_sgd_rule
+
+        cfg = self._sparse_cfgs[table_id]
+        acc = cfg.accessor_config or AccessorConfig()
+        return make_sgd_rule(acc.embedx_sgd_rule, acc.embedx_dim, acc.sgd).state_dim
+
+    # -- save/load (per-server shard files; accessor text format) ---------
+
+    def save(self, table_id, dirname, mode=0):
+        """Same on-disk format as MemorySparseTable.save (format_shard_row
+        + meta.json) — checkpoints are portable between the local and rpc
+        transports. Files are keyed by server index."""
+        import json
+
+        os.makedirs(dirname, exist_ok=True)
+        full_dim = self._dims(table_id)[2]
+        xd = self._embedx_dim(table_id)
+        ed = full_dim - 7 - xd - self._embedx_state_dim(table_id)
+        total = 0
+        for s, c in enumerate(self._conns):
+            cnt, _ = c.check(_SAVE_BEGIN, table_id, aux=mode)
+            _, resp = c.check(_SAVE_FETCH, table_id)
+            keys = np.frombuffer(resp[: cnt * 8], np.uint64)
+            values = np.frombuffer(resp[cnt * 8 :], np.float32).reshape(cnt, full_dim)
+            path = os.path.join(dirname, f"part-{s:05d}.shard")
+            with open(path, "w") as f:
+                for j in range(cnt):
+                    f.write(format_shard_row(keys[j], values[j], ed, xd) + "\n")
+            total += cnt
+        with open(os.path.join(dirname, "meta.json"), "w") as f:
+            json.dump({"shard_num": self.num_servers, "embedx_dim": xd,
+                       "accessor": "ctr", "mode": mode}, f)
+        return total
+
+    def load(self, table_id, dirname):
+        import json
+
+        with open(os.path.join(dirname, "meta.json")) as f:
+            meta = json.load(f)
+        full_dim = self._dims(table_id)[2]
+        xd = self._embedx_dim(table_id)
+        ed = full_dim - 7 - xd - self._embedx_state_dim(table_id)
+        enforce(meta["embedx_dim"] == xd,
+                f"embedx_dim mismatch: file {meta['embedx_dim']} != table {xd}")
+        total = 0
+        for s in range(meta["shard_num"]):
+            path = os.path.join(dirname, f"part-{s:05d}.shard")
+            if not os.path.exists(path):
+                continue
+            keys, rows = [], []
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    if not parts:
+                        continue
+                    k, row = parse_shard_row(parts, ed, xd, full_dim)
+                    keys.append(k)
+                    rows.append(row)
+            if not keys:
+                continue
+            # re-route by current server count (files may come from a
+            # different cluster size or the local transport)
+            self.import_full(table_id, np.asarray(keys, np.uint64), np.stack(rows))
+            total += len(keys)
+        return total
+
+    def export_full(self, table_id, keys):
+        """(values [n, full_dim], found [n]) across servers."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        full_dim = self._dims(table_id)[2]
+        out = np.zeros((len(keys), full_dim), np.float32)
+        found = np.zeros(len(keys), bool)
+        sv = self._route(keys)
+        for s, c in enumerate(self._conns):
+            sel = np.flatnonzero(sv == s)
+            if not len(sel):
+                continue
+            _, resp = c.check(_EXPORT, table_id, n=len(sel),
+                              payload=keys[sel].tobytes())
+            nb = len(sel) * full_dim * 4
+            out[sel] = np.frombuffer(resp[:nb], np.float32).reshape(len(sel), full_dim)
+            found[sel] = np.frombuffer(resp[nb:], np.uint8).astype(bool)
+        return out, found
+
+    def import_full(self, table_id, keys, values):
+        keys = np.ascontiguousarray(keys, np.uint64)
+        values = np.ascontiguousarray(values, np.float32)
+        sv = self._route(keys)
+        for s, c in enumerate(self._conns):
+            sel = np.flatnonzero(sv == s)
+            if not len(sel):
+                continue
+            payload = keys[sel].tobytes() + np.ascontiguousarray(values[sel]).tobytes()
+            c.check(_INSERT_FULL, table_id, n=len(sel), payload=payload)
+
+    def stop_servers(self) -> None:
+        for c in self._conns:
+            try:
+                c.call(_STOP)
+            except Exception:
+                pass

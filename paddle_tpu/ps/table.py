@@ -51,6 +51,48 @@ _SAVE_MODE_BASE = 2
 _SAVE_MODE_BATCH = 3
 
 
+def merge_duplicate_keys(keys: np.ndarray, values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Client-side dedup-merge before push (the brpc client's sparse key
+    merge): gradients/show/click sum; slot (col 0) is categorical — keep
+    the first occurrence."""
+    uniq, first_idx, inverse = np.unique(keys, return_index=True, return_inverse=True)
+    if len(uniq) == len(keys):
+        return keys, values
+    merged = np.zeros((len(uniq), values.shape[1]), np.float32)
+    np.add.at(merged, inverse, values)
+    merged[:, 0] = values[first_idx, 0]
+    return uniq, merged
+
+
+def format_shard_row(key: int, v: np.ndarray, ed: int, xd: int) -> str:
+    """One checkpoint text line from a full-layout row ([slot, unseen,
+    delta_score, show, click, embed_w, embed_state[ed], has_embedx,
+    embedx_w[xd], embedx_state...]); embedx block omitted when absent —
+    the accessor text format both table backends and the rpc transport
+    read and write."""
+    fields = [str(int(key)), str(int(v[0])), f"{v[1]:.6g}", f"{v[2]:.6g}",
+              f"{v[3]:.6g}", f"{v[4]:.6g}", f"{v[5]:.8g}"]
+    fields += [f"{x:.8g}" for x in v[6 : 6 + ed]]
+    if v[6 + ed] != 0.0:  # has_embedx
+        fields += [f"{x:.8g}" for x in v[7 + ed :]]
+    return " ".join(fields)
+
+
+def parse_shard_row(parts: List[str], ed: int, xd: int, full_dim: int
+                    ) -> Tuple[np.uint64, np.ndarray]:
+    """Inverse of format_shard_row: text fields -> (key, full row)."""
+    key = np.uint64(parts[0])
+    data = [float(x) for x in parts[1:]]
+    row = np.zeros(full_dim, np.float32)
+    row[:6] = data[:6]
+    row[6 : 6 + ed] = data[6 : 6 + ed]
+    rest = data[6 + ed :]
+    if len(rest) >= xd:
+        row[6 + ed] = 1.0
+        row[7 + ed : 7 + ed + len(rest)] = rest
+    return key, row
+
+
 @dataclasses.dataclass
 class TableConfig:
     """Mirrors TableParameter (ps.proto:121)."""
@@ -159,6 +201,26 @@ class _SparseShard:
             self.accessor.update_stat_after_save(self.block, rows[keep], mode)
             return keys[keep], rows[keep]
 
+    def full_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Full-layout export of specific rows (save path). Caller holds
+        no lock — row set comes from save_items which snapshotted."""
+        b = self.block
+        es = self.accessor.embed_rule.state_dim
+        xd = self.accessor.config.embedx_dim
+        xs = self.accessor.embedx_rule.state_dim
+        out = np.zeros((len(rows), 7 + es + xd + xs), np.float32)
+        out[:, 0] = b.slot[rows]
+        out[:, 1] = b.unseen_days[rows]
+        out[:, 2] = b.delta_score[rows]
+        out[:, 3] = b.show[rows]
+        out[:, 4] = b.click[rows]
+        out[:, 5] = b.embed_w[rows, 0]
+        out[:, 6 : 6 + es] = b.embed_state[rows]
+        out[:, 6 + es] = b.has_embedx[rows].astype(np.float32)
+        out[:, 7 + es : 7 + es + xd] = b.embedx_w[rows]
+        out[:, 7 + es + xd :] = b.embedx_state[rows]
+        return out
+
 
 class MemorySparseTable:
     """Sparse embedding table over N local shards."""
@@ -241,13 +303,7 @@ class MemorySparseTable:
         embed_g, embedx_g...). Duplicate keys in one push are pre-merged
         (gradient sum, show/click sum) like the client-side dedup-merge."""
         keys = np.ascontiguousarray(keys, np.uint64)
-        uniq, first_idx, inverse = np.unique(keys, return_index=True, return_inverse=True)
-        if len(uniq) != len(keys):
-            merged = np.zeros((len(uniq), push_values.shape[1]), np.float32)
-            np.add.at(merged, inverse, push_values)
-            # slot is categorical — take first occurrence, not the sum
-            merged[:, 0] = push_values[first_idx, 0]
-            keys, push_values = uniq, merged
+        keys, push_values = merge_duplicate_keys(keys, push_values)
         if self._native is not None:
             self._native.push(keys, push_values)
             return
@@ -349,35 +405,31 @@ class MemorySparseTable:
     # -- save/load (per-shard text files, Appendix A / SURVEY §5) ---------
 
     def save(self, dirname: str, mode: int = _SAVE_MODE_ALL) -> int:
+        """Per-shard text files in the accessor format (format_shard_row)
+        — identical bytes from either backend and the rpc transport."""
         os.makedirs(dirname, exist_ok=True)
-        total = 0
+        ed = self.accessor.embed_rule.state_dim
         if self._native is not None:
-            total = self._save_native(dirname, mode)
-            self._write_meta(dirname, mode)
-            return total
-        for i, sh in enumerate(self._shards):
-            keys, rows = sh.save_items(mode)
-            path = os.path.join(dirname, f"part-{i:05d}.shard")
-            with open(path, "w") as f:
-                for k, r in zip(keys, rows):
-                    b = sh.block
-                    fields = [
-                        str(int(k)),
-                        str(int(b.slot[r])),
-                        f"{b.unseen_days[r]:.6g}",
-                        f"{b.delta_score[r]:.6g}",
-                        f"{b.show[r]:.6g}",
-                        f"{b.click[r]:.6g}",
-                        f"{b.embed_w[r,0]:.8g}",
-                    ]
-                    fields += [f"{v:.8g}" for v in b.embed_state[r]]
-                    if b.has_embedx[r]:
-                        fields += [f"{v:.8g}" for v in b.embedx_w[r]]
-                        fields += [f"{v:.8g}" for v in b.embedx_state[r]]
-                    f.write(" ".join(fields) + "\n")
-                    total += 1
+            keys, values = self._native.save_items(mode)
+        else:
+            per = [(sh.save_items(mode), sh) for sh in self._shards]
+            keys = (np.concatenate([k for (k, _), _ in per])
+                    if per else np.zeros(0, np.uint64))
+            values = (np.concatenate([sh.full_rows(r) for (_, r), sh in per])
+                      if per else np.zeros((0, self.full_dim), np.float32))
+        shard_of = (keys % np.uint64(self.config.shard_num)).astype(np.int64)
+        xd = self.accessor.config.embedx_dim
+        files = [open(os.path.join(dirname, f"part-{i:05d}.shard"), "w")
+                 for i in range(self.config.shard_num)]
+        try:
+            for j in range(len(keys)):
+                files[shard_of[j]].write(
+                    format_shard_row(keys[j], values[j], ed, xd) + "\n")
+        finally:
+            for f in files:
+                f.close()
         self._write_meta(dirname, mode)
-        return total
+        return len(keys)
 
     def _write_meta(self, dirname: str, mode: int) -> None:
         with open(os.path.join(dirname, "meta.json"), "w") as f:
@@ -422,52 +474,6 @@ class MemorySparseTable:
         enforce_eq(meta["embedx_dim"], self.accessor.config.embedx_dim, "embedx_dim mismatch")
         ed = self.accessor.embed_rule.state_dim
         xd = self.accessor.config.embedx_dim
-        xs = self.accessor.embedx_rule.state_dim
-        if self._native is not None:
-            return self._load_native(dirname, meta, ed, xd, xs)
-        total = 0
-        for i in range(meta["shard_num"]):
-            path = os.path.join(dirname, f"part-{i:05d}.shard")
-            if not os.path.exists(path):
-                continue
-            keys, rows_data = [], []
-            with open(path) as f:
-                for line in f:
-                    parts = line.split()
-                    keys.append(np.uint64(parts[0]))
-                    rows_data.append([float(x) for x in parts[1:]])
-            if not keys:
-                continue
-            karr = np.asarray(keys, np.uint64)
-            # re-route by current shard_num (allows re-sharding on load)
-            for s in range(self.config.shard_num):
-                sel = (karr % np.uint64(self.config.shard_num)) == s
-                if not sel.any():
-                    continue
-                sh = self._shards[s]
-                with sh.lock:
-                    rows, _ = sh.index.lookup_or_insert(karr[sel])
-                    sh._ensure_capacity(sh.index.row_capacity)
-                    b = sh.block
-                    for r, data in zip(rows, [rows_data[j] for j in np.where(sel)[0]]):
-                        b.slot[r] = int(data[0])
-                        b.unseen_days[r] = data[1]
-                        b.delta_score[r] = data[2]
-                        b.show[r] = data[3]
-                        b.click[r] = data[4]
-                        b.embed_w[r, 0] = data[5]
-                        b.embed_state[r] = data[6 : 6 + ed]
-                        rest = data[6 + ed :]
-                        if len(rest) >= xd:
-                            b.embedx_w[r] = rest[:xd]
-                            b.embedx_state[r] = rest[xd : xd + xs]
-                            b.has_embedx[r] = True
-                    sh.mark_initialized(rows)
-                    total += len(rows)
-        return total
-
-    def _load_native(self, dirname: str, meta: dict, ed: int, xd: int, xs: int) -> int:
-        full = self._native.full_dim
         total = 0
         for i in range(meta["shard_num"]):
             path = os.path.join(dirname, f"part-{i:05d}.shard")
@@ -479,19 +485,13 @@ class MemorySparseTable:
                     parts = line.split()
                     if not parts:
                         continue
-                    keys.append(np.uint64(parts[0]))
-                    data = [float(x) for x in parts[1:]]
-                    row = np.zeros(full, np.float32)
-                    row[:6] = data[:6]
-                    row[6 : 6 + ed] = data[6 : 6 + ed]
-                    rest = data[6 + ed :]
-                    if len(rest) >= xd:
-                        row[6 + ed] = 1.0  # has_embedx
-                        row[7 + ed : 7 + ed + xd + xs] = rest[: xd + xs]
+                    k, row = parse_shard_row(parts, ed, xd, self.full_dim)
+                    keys.append(k)
                     rows.append(row)
             if keys:
-                self._native.insert_full(np.asarray(keys, np.uint64),
-                                         np.stack(rows))
+                # import_full re-routes by the CURRENT shard_num (allows
+                # re-sharding on load)
+                self.import_full(np.asarray(keys, np.uint64), np.stack(rows))
                 total += len(keys)
         return total
 

@@ -1,0 +1,371 @@
+"""Native TCP PS service tests.
+
+Mirrors the reference's in-process service tests
+(paddle/fluid/distributed/test/brpc_service_sparse_sgd_test.cc — real
+server + client in one process, localhost) and the subprocess cluster
+harness (test_dist_fleet_base.py _run_cluster: pserver + trainer
+subprocesses on free ports)."""
+
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.ps.accessor import AccessorConfig
+from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+rpc = pytest.importorskip("paddle_tpu.ps.rpc")
+
+pytestmark = pytest.mark.skipif(
+    not rpc.rpc_available(), reason="native toolchain unavailable")
+
+
+def _acc():
+    return AccessorConfig(sgd=SGDRuleConfig(initial_range=0.0))
+
+
+@pytest.fixture
+def cluster():
+    """Two in-process servers + a connected client."""
+    servers = [rpc.NativePsServer(n_trainers=1) for _ in range(2)]
+    client = rpc.RpcPsClient([f"127.0.0.1:{s.port}" for s in servers])
+    yield servers, client
+    client.close()
+    for s in servers:
+        s.close()
+
+
+def test_sparse_pull_push_matches_local_table(cluster):
+    _, cli = cluster
+    cfg = TableConfig(shard_num=4, accessor_config=_acc())
+    cli.create_sparse_table(0, cfg)
+    rng = np.random.default_rng(0)
+    keys = rng.integers(1, 5000, 300).astype(np.uint64)
+    slots = (keys % 26).astype(np.int32)
+    assert (cli.pull_sparse(0, keys, slots=slots) == 0).all()
+
+    push = np.zeros((300, 12), np.float32)
+    push[:, 0] = slots
+    push[:, 1] = 2.0
+    push[:, 2] = 1.0
+    push[:, 3:] = rng.normal(0, 0.1, (300, 9)).astype(np.float32)
+    cli.push_sparse(0, keys, push)
+
+    local = MemorySparseTable(TableConfig(shard_num=4, accessor_config=_acc(),
+                                          backend="native"))
+    local.pull_sparse(keys, slots)
+    local.push_sparse(keys, push)
+    np.testing.assert_allclose(
+        cli.pull_sparse(0, keys, create=False),
+        local.pull_sparse(keys, create=False), atol=1e-6)
+    assert cli.size(0) == local.size()
+
+
+def test_dense_optimizers(cluster):
+    _, cli = cluster
+    cli.create_dense_table(1, dim=7, optimizer="sgd", lr=0.5)
+    cli.set_dense(1, np.arange(7, dtype=np.float32))
+    cli.push_dense(1, np.ones(7, np.float32))
+    np.testing.assert_allclose(cli.pull_dense(1), np.arange(7) - 0.5)
+
+    cli.create_dense_table(2, dim=3, optimizer="adam", lr=0.1)
+    for _ in range(3):
+        cli.push_dense(2, np.ones(3, np.float32))
+    # match host-side MemoryDenseTable math
+    from paddle_tpu.ps.table import MemoryDenseTable
+    ref = MemoryDenseTable(3, "adam", 0.1)
+    for _ in range(3):
+        ref.push_dense(np.ones(3, np.float32))
+    np.testing.assert_allclose(cli.pull_dense(2), ref.pull_dense(), atol=1e-6)
+
+
+def test_geo_accumulate_and_drain(cluster):
+    _, cli = cluster
+    cli.create_geo_table(3, dim=4)
+    cli.push_geo(3, np.array([7, 8], np.uint64), np.ones((2, 4), np.float32))
+    cli.push_geo(3, np.array([7], np.uint64), 3 * np.ones((1, 4), np.float32))
+    k, d = cli.pull_geo(3)
+    got = dict(zip(k.tolist(), d[:, 0].tolist()))
+    assert got == {7: 2.0, 8: 1.0}  # mean over pushes per key
+    k2, _ = cli.pull_geo(3)
+    assert len(k2) == 0  # drained
+
+
+def test_save_load_roundtrip(cluster, tmp_path):
+    _, cli = cluster
+    cli.create_sparse_table(0, TableConfig(shard_num=4, accessor_config=_acc()))
+    rng = np.random.default_rng(1)
+    keys = rng.integers(1, 2000, 200).astype(np.uint64)
+    push = np.zeros((200, 12), np.float32)
+    push[:, 1] = 2.0
+    push[:, 3:] = 0.05
+    cli.push_sparse(0, keys, push)
+    before = cli.pull_sparse(0, keys, create=False)
+    n = cli.save(0, str(tmp_path), 0)
+    assert n == cli.size(0)
+
+    # fresh cluster loads the files
+    servers2 = [rpc.NativePsServer(n_trainers=1) for _ in range(2)]
+    cli2 = rpc.RpcPsClient([f"127.0.0.1:{s.port}" for s in servers2])
+    try:
+        cli2.create_sparse_table(0, TableConfig(shard_num=4, accessor_config=_acc()))
+        assert cli2.load(0, str(tmp_path)) == n
+        np.testing.assert_allclose(
+            cli2.pull_sparse(0, keys, create=False), before, atol=1e-6)
+    finally:
+        cli2.close()
+        for s in servers2:
+            s.close()
+
+
+def test_export_import_full(cluster):
+    _, cli = cluster
+    cli.create_sparse_table(0, TableConfig(shard_num=4, accessor_config=_acc()))
+    keys = np.array([11, 22, 33], np.uint64)
+    push = np.zeros((3, 12), np.float32)
+    push[:, 1] = 1.0
+    push[:, 3:] = 0.2
+    cli.push_sparse(0, keys, push)
+    vals, found = cli.export_full(0, np.array([11, 22, 99], np.uint64))
+    assert found.tolist() == [True, True, False]
+    assert (vals[2] == 0).all()
+    # import into a different id routes correctly
+    cli.create_sparse_table(5, TableConfig(shard_num=4, accessor_config=_acc()))
+    cli.import_full(5, keys, cli.export_full(0, keys)[0])
+    np.testing.assert_allclose(
+        cli.pull_sparse(5, keys, create=False),
+        cli.pull_sparse(0, keys, create=False), atol=1e-6)
+
+
+def test_barrier_blocks_until_all_trainers():
+    server = rpc.NativePsServer(n_trainers=3)
+    clients = [rpc.RpcPsClient([f"127.0.0.1:{server.port}"]) for _ in range(3)]
+    order = []
+    lock = threading.Lock()
+
+    def arrive(i, delay):
+        time.sleep(delay)
+        clients[i].barrier()
+        with lock:
+            order.append((i, time.monotonic()))
+
+    ts = [threading.Thread(target=arrive, args=(i, 0.05 * i)) for i in range(3)]
+    t0 = time.monotonic()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert len(order) == 3
+    # nobody released before the last arrival (~0.1s)
+    assert min(t for _, t in order) - t0 >= 0.09
+    for c in clients:
+        c.close()
+    server.close()
+
+
+def test_missing_table_raises(cluster):
+    _, cli = cluster
+    from paddle_tpu.core.enforce import NotFoundError
+    with pytest.raises(NotFoundError):
+        cli.pull_sparse(42, np.array([1], np.uint64))
+
+
+_SERVER_SCRIPT = """
+import sys
+import time
+from paddle_tpu.ps.rpc import NativePsServer
+s = NativePsServer(port=int(sys.argv[1]), n_trainers=int(sys.argv[2]))
+print("READY", s.port, flush=True)
+# serve until a trainer sends STOP (server stops itself) or we are killed
+time.sleep(3600)
+"""
+
+_TRAINER_SCRIPT = """
+import sys
+import numpy as np
+from paddle_tpu.ps.rpc import RpcPsClient
+from paddle_tpu.ps.table import TableConfig
+from paddle_tpu.ps.accessor import AccessorConfig
+from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+
+endpoints = sys.argv[1].split(",")
+trainer_id = int(sys.argv[2])
+cli = RpcPsClient(endpoints)
+acc = AccessorConfig(sgd=SGDRuleConfig(initial_range=0.0))
+cli.create_sparse_table(0, TableConfig(shard_num=4, accessor_config=acc))
+keys = np.arange(1, 101, dtype=np.uint64)
+cli.pull_sparse(0, keys)
+push = np.zeros((100, 12), np.float32)
+push[:, 1] = 1.0
+push[:, 3:] = 0.1
+for _ in range(5):
+    cli.push_sparse(0, keys, push)
+cli.barrier()
+out = cli.pull_sparse(0, keys, create=False)
+# both trainers pushed 5 times each -> show == 10 after the barrier
+assert np.allclose(out[:, 0], 10.0), out[:, 0][:5]
+print("TRAINER_OK", trainer_id, flush=True)
+cli.barrier()  # closing barrier: nobody stops servers mid-request
+if trainer_id == 0:
+    cli.stop_servers()
+cli.close()
+"""
+
+
+def test_multiprocess_cluster(tmp_path):
+    """2 server processes + 2 trainer processes on localhost (the
+    test_dist_fleet_base._run_cluster pattern)."""
+    env = None
+    servers = []
+    for _ in range(2):
+        p = subprocess.Popen([sys.executable, "-c", _SERVER_SCRIPT, "0", "2"],
+                             stdout=subprocess.PIPE, text=True, env=env,
+                             cwd="/root/repo")
+        line = p.stdout.readline().strip()
+        assert line.startswith("READY"), line
+        servers.append((p, int(line.split()[1])))
+    endpoints = ",".join(f"127.0.0.1:{port}" for _, port in servers)
+    trainers = [
+        subprocess.Popen([sys.executable, "-c", _TRAINER_SCRIPT, endpoints, str(i)],
+                         stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                         text=True, cwd="/root/repo")
+        for i in range(2)
+    ]
+    try:
+        for i, t in enumerate(trainers):
+            out, _ = t.communicate(timeout=60)
+            assert t.returncode == 0, out
+            assert f"TRAINER_OK {i}" in out, out
+    finally:
+        for p, _ in servers:
+            p.kill()
+        for t in trainers:
+            if t.poll() is None:
+                t.kill()
+
+
+_FLEET_SERVER = """
+import os
+from paddle_tpu.distributed.fleet import Fleet
+from paddle_tpu.distributed.strategy import DistributedStrategy
+f = Fleet()
+f.init(strategy=DistributedStrategy(a_sync=True, ps_transport="rpc"))
+assert f.is_server() and f.transport == "rpc"
+f.init_server()
+print("SERVER_READY", flush=True)
+f.run_server()   # blocks until a trainer sends STOP
+print("SERVER_DONE", flush=True)
+"""
+
+_FLEET_TRAINER = """
+import sys
+import numpy as np
+from paddle_tpu.distributed.fleet import Fleet
+from paddle_tpu.distributed.strategy import DistributedStrategy
+from paddle_tpu.ps.table import TableConfig
+from paddle_tpu.ps.accessor import AccessorConfig
+from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+
+f = Fleet()
+f.init(strategy=DistributedStrategy(a_sync=True, ps_transport="rpc"))
+assert f.is_worker() and f.transport == "rpc"
+f.init_worker()
+acc = AccessorConfig(sgd=SGDRuleConfig(initial_range=0.0))
+f.register_sparse_table(0, TableConfig(shard_num=4, accessor_config=acc))
+keys = np.arange(1, 51, dtype=np.uint64)
+f.client.pull_sparse(0, keys)
+push = np.zeros((50, 12), np.float32)
+push[:, 1] = 1.0
+push[:, 3:] = 0.1
+f.client.push_sparse(0, keys, push)
+f.client.barrier()
+out = f.client.pull_sparse(0, keys, create=False)
+assert np.allclose(out[:, 0], 2.0), out[:5, 0]  # both trainers pushed once
+print("FLEET_TRAINER_OK", flush=True)
+f.stop_worker()
+f.client.barrier()  # closing barrier: nobody stops servers mid-request
+if int(sys.argv[1]) == 0:
+    f.client.stop_servers()
+f.client.close()
+"""
+
+
+def test_fleet_rpc_cluster():
+    """Fleet facade over the rpc transport: 2 pserver + 2 trainer
+    subprocesses wired by PaddleCloud env vars (role_maker.py env
+    contract)."""
+    import os
+    import socket
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("", 0))
+            return s.getsockname()[1]
+
+    ps_ports = [free_port(), free_port()]
+    ps_list = ",".join(f"127.0.0.1:{p}" for p in ps_ports)
+    base = {"PADDLE_PSERVERS_IP_PORT_LIST": ps_list, "PADDLE_TRAINERS_NUM": "2"}
+
+    servers = []
+    for port in ps_ports:
+        env = dict(os.environ, **base, TRAINING_ROLE="PSERVER",
+                   POD_IP="127.0.0.1", PADDLE_PORT=str(port))
+        p = subprocess.Popen([sys.executable, "-c", _FLEET_SERVER],
+                             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                             text=True, env=env, cwd="/root/repo")
+        assert "SERVER_READY" in p.stdout.readline()
+        servers.append(p)
+    trainers = []
+    for i in range(2):
+        env = dict(os.environ, **base, TRAINING_ROLE="TRAINER",
+                   PADDLE_TRAINER_ID=str(i))
+        trainers.append(
+            subprocess.Popen([sys.executable, "-c", _FLEET_TRAINER, str(i)],
+                             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                             text=True, env=env, cwd="/root/repo"))
+    try:
+        for t in trainers:
+            out, _ = t.communicate(timeout=60)
+            assert t.returncode == 0 and "FLEET_TRAINER_OK" in out, out
+        for p in servers:
+            out, _ = p.communicate(timeout=30)
+            assert "SERVER_DONE" in out, out
+    finally:
+        for p in servers + trainers:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_checkpoint_portable_between_local_and_rpc(cluster, tmp_path):
+    """Local-transport checkpoints load under rpc and vice versa (the
+    ps_transport=auto scaling path)."""
+    _, cli = cluster
+    local = MemorySparseTable(TableConfig(shard_num=4, accessor_config=_acc()))
+    rng = np.random.default_rng(5)
+    keys = rng.integers(1, 1000, 150).astype(np.uint64)
+    push = np.zeros((150, 12), np.float32)
+    push[:, 1] = 2.0
+    push[:, 3:] = 0.03
+    local.pull_sparse(keys)
+    local.push_sparse(keys, push)
+    d1 = tmp_path / "local_ck"
+    n = local.save(str(d1), 0)
+
+    cli.create_sparse_table(0, TableConfig(shard_num=4, accessor_config=_acc()))
+    assert cli.load(0, str(d1)) == n
+    np.testing.assert_allclose(
+        cli.pull_sparse(0, keys, create=False),
+        local.pull_sparse(keys, create=False), atol=1e-6)
+
+    # and back: rpc save -> local load
+    d2 = tmp_path / "rpc_ck"
+    n2 = cli.save(0, str(d2), 0)
+    local2 = MemorySparseTable(TableConfig(shard_num=4, accessor_config=_acc()))
+    assert local2.load(str(d2)) == n2
+    np.testing.assert_allclose(
+        local2.pull_sparse(keys, create=False),
+        local.pull_sparse(keys, create=False), atol=1e-6)
