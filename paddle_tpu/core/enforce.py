@@ -20,6 +20,7 @@ __all__ = [
     "PreconditionNotMetError",
     "UnimplementedError",
     "UnavailableError",
+    "ExecuteError",
     "ExecutionTimeoutError",
     "enforce",
     "enforce_eq",
@@ -63,6 +64,10 @@ class UnimplementedError(EnforceNotMet, NotImplementedError):
 
 class UnavailableError(EnforceNotMet):
     pass
+
+
+class ExecuteError(EnforceNotMet):
+    """Shell/filesystem command failure (fleet/utils/fs.py ExecuteError)."""
 
 
 class ExecutionTimeoutError(EnforceNotMet, TimeoutError):

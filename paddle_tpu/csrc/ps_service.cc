@@ -64,6 +64,7 @@ enum Cmd : uint32_t {
   kCreateGeo = 18,
   kPushGeo = 19,
   kPullGeo = 20,
+  kSaveAll = 21,
 };
 
 enum Err : int64_t {
@@ -243,7 +244,8 @@ struct PsServer {
     }
   }
 
-  void stop() {
+  // signal-only: safe to call from a connection handler thread
+  void request_stop() {
     if (stopping.exchange(true)) return;
     ::shutdown(listen_fd, SHUT_RDWR);
     ::close(listen_fd);
@@ -252,7 +254,7 @@ struct PsServer {
     // live trainers on other connections are NOT cut mid-request
     {
       std::lock_guard<std::mutex> g(conn_mu);
-      for (int fd : conn_fds) ::shutdown(fd, SHUT_RD);
+      for (int cfd : conn_fds) ::shutdown(cfd, SHUT_RD);
     }
     // wake any barrier waiters so their connections can drain
     {
@@ -261,6 +263,11 @@ struct PsServer {
       bar_count = 0;
     }
     bar_cv.notify_all();
+  }
+
+  // full shutdown: join all threads. Must NOT run on a handler thread.
+  void stop() {
+    request_stop();
     if (accept_thread.joinable()) accept_thread.join();
     std::vector<std::thread> ts;
     {
@@ -306,6 +313,12 @@ struct PsServer {
       if (h.cmd == kStop) break;
     }
     ::close(fd);
+    std::lock_guard<std::mutex> g(conn_mu);
+    for (size_t i = 0; i < conn_fds.size(); ++i)
+      if (conn_fds[i] == fd) {
+        conn_fds.erase(conn_fds.begin() + i);
+        break;
+      }
   }
 
   bool handle(int fd, const ReqHeader& h, const char* p) {
@@ -430,12 +443,9 @@ struct PsServer {
         }
         return respond(fd, erased, nullptr, 0);
       }
-      case kSaveBegin: {
-        NativeTable* t = get_sparse(h.table_id);
-        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
-        return respond(fd, pstpu::table_save_snapshot(t, h.aux), nullptr, 0);
-      }
-      case kSaveFetch: {
+      case kSaveAll: {
+        // snapshot + stream in ONE command — atomic against concurrent
+        // savers (the two-phase begin/fetch protocol could interleave)
         NativeTable* t = get_sparse(h.table_id);
         if (!t) return respond(fd, kErrNoTable, nullptr, 0);
         int32_t fdim = table_full_dim(t);
@@ -443,6 +453,7 @@ struct PsServer {
         int64_t cnt;
         {
           std::lock_guard<std::mutex> sg(t->save_mu);
+          pstpu::table_save_snapshot_locked(t, h.aux);
           cnt = static_cast<int64_t>(t->save_keys.size());
           out.resize(cnt * 8 + cnt * fdim * 4);
           if (cnt) {
@@ -519,8 +530,7 @@ struct PsServer {
       }
       case kStop: {
         respond(fd, 0, nullptr, 0);
-        // stop() joins this thread; detach the shutdown
-        std::thread([this]() { stop(); }).detach();
+        request_stop();  // join happens in pss_stop/pss_destroy
         return false;
       }
       default:

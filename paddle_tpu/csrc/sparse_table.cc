@@ -59,6 +59,12 @@ int64_t pst_size(void* h) {
   return n;
 }
 
+// per-shard live-row counts (PrintTableStat support); out has shard_num slots
+void pst_shard_sizes(void* h, int64_t* out) {
+  NativeTable* t = static_cast<NativeTable*>(h);
+  for (size_t i = 0; i < t->shards.size(); ++i) out[i] = t->shards[i]->used;
+}
+
 // Pull with insert-on-miss (create != 0). keys [n], slots [n] (may be
 // null -> slot 0), out [n, pull_dim]. Missing keys w/o create pull zeros.
 void pst_pull(void* h, const uint64_t* keys, const int32_t* slots, int64_t n,

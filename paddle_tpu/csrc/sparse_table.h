@@ -543,10 +543,9 @@ inline TableNativeConfig parse_table_config(const int32_t* ip, const float* fp) 
 }
 
 // Snapshot the save keep-set (mode filter + update_stat_after_save)
-// into t->save_keys/save_values under the shard locks + save cursor
-// mutex. Returns the row count.
-inline int64_t table_save_snapshot(NativeTable* t, int32_t mode) {
-  std::lock_guard<std::mutex> sg(t->save_mu);
+// into t->save_keys/save_values under the shard locks. Caller holds
+// t->save_mu (the _locked variant); the plain wrapper takes it.
+inline int64_t table_save_snapshot_locked(NativeTable* t, int32_t mode) {
   int32_t fd = table_full_dim(t);
   t->save_keys.clear();
   t->save_values.clear();
@@ -565,6 +564,11 @@ inline int64_t table_save_snapshot(NativeTable* t, int32_t mode) {
     }
   }
   return static_cast<int64_t>(t->save_keys.size());
+}
+
+inline int64_t table_save_snapshot(NativeTable* t, int32_t mode) {
+  std::lock_guard<std::mutex> sg(t->save_mu);
+  return table_save_snapshot_locked(t, mode);
 }
 
 // Copy + clear the snapshot. Returns the count copied (0 if no snapshot).

@@ -1,7 +1,9 @@
 from .loader import DataLoader, TensorDataset
 from .dataset import DataGenerator, InMemoryDataset, QueueDataset, SlotDesc
 from .index_dataset import LayerWiseSampler, TreeIndex
+from .vision import MNIST, Cifar10, Cifar100, FashionMNIST
 
 __all__ = ["DataLoader", "TensorDataset",
            "DataGenerator", "InMemoryDataset", "QueueDataset", "SlotDesc",
-           "TreeIndex", "LayerWiseSampler"]
+           "TreeIndex", "LayerWiseSampler",
+           "MNIST", "FashionMNIST", "Cifar10", "Cifar100"]

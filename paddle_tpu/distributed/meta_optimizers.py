@@ -41,8 +41,10 @@ __all__ = [
     "LocalSGDOptimizer",
     "DGCMomentumOptimizer",
     "FP16AllReduceOptimizer",
+    "ASPOptimizer",
     "RecomputeOptimizer",
     "apply_strategy",
+    "select_runtime",
 ]
 
 PyTree = Any
@@ -251,6 +253,48 @@ class FP16AllReduceOptimizer(MetaOptimizerBase):
         return new_params, {"inner": new_inner}
 
 
+class ASPOptimizer(MetaOptimizerBase):
+    """ASP 2:4 structured sparsity (python/paddle/fluid/contrib/sparsity
+    + fleet ASP meta-optimizer): ``paddle.incubate.asp.prune_model``
+    computes per-param masks (keep the 2 largest magnitudes of every
+    contiguous 4 along the reduction dim), then the decorated optimizer
+    masks both gradients and updated params so pruned weights stay zero.
+
+    Here the mask lives in opt_state (computed at ``init`` from the
+    initial params) and is applied inside the jitted update — the mask
+    pattern is static per training run, matching the reference's
+    prune-once-then-train flow. Only matrices with inner dim % 4 == 0
+    are pruned (the reference's supported-layer check)."""
+
+    def __init__(self, inner: Optimizer, n: int = 2, m: int = 4) -> None:
+        super().__init__(inner)
+        self.n, self.m = n, m
+
+    @staticmethod
+    def _make_mask(w, n: int, m: int):
+        if getattr(w, "ndim", 0) != 2 or w.shape[-1] % m != 0:
+            return jnp.ones_like(w, dtype=jnp.bool_)
+        groups = jnp.abs(w).reshape(w.shape[0], -1, m)
+        # keep the n largest |w| per group of m
+        thresh = -jnp.sort(-groups, axis=-1)[..., n - 1 : n]
+        mask = groups >= thresh
+        # break magnitude ties deterministically: cap keeps at n by rank
+        rank = jnp.argsort(jnp.argsort(-groups, axis=-1), axis=-1)
+        mask = mask & (rank < n)
+        return mask.reshape(w.shape)
+
+    def _init_extra(self, params):
+        masks = _tmap(lambda w: self._make_mask(w, self.n, self.m), params)
+        return {"asp_mask": masks}
+
+    def update(self, grads, opt_state, params):
+        masks = opt_state["asp_mask"]
+        masked_g = _tmap(lambda g, m: g * m.astype(g.dtype), grads, masks)
+        new_params, new_inner = self.inner.update(masked_g, opt_state["inner"], params)
+        new_params = _tmap(lambda p, m: p * m.astype(p.dtype), new_params, masks)
+        return new_params, {"inner": new_inner, "asp_mask": masks}
+
+
 class RecomputeOptimizer(MetaOptimizerBase):
     """Recompute (fleet/meta_optimizers/recompute_optimizer.py) is a
     *model* transform, not an update rule: apply ``paddle_tpu.
@@ -261,6 +305,46 @@ class RecomputeOptimizer(MetaOptimizerBase):
     def update(self, grads, opt_state, params):
         new_params, new_inner = self.inner.update(grads, opt_state["inner"], params)
         return new_params, {"inner": new_inner}
+
+
+def select_runtime(strategy) -> Dict[str, Any]:
+    """The runtime-selecting half of the meta-optimizer chain. In the
+    reference these flags pick *program rewriters* (raw_program inserts
+    c_allreduce_sum; tensor_parallel_optimizer/pipeline_optimizer/
+    sharding_optimizer partition the program; ps_optimizer builds
+    trainer/server programs). TPU-first, they pick a *trainer class* and
+    its mesh axes; the optimizer chain (apply_strategy) is orthogonal.
+
+    Returns {"runtime": name, "kwargs": {...}} where name is one of
+    "ps" (a_sync/geo → fleet PsTrainer path), "hybrid"
+    (pipeline/tensor_parallel/hybrid axes → HybridParallelTrainer),
+    "spmd" (dp/sharding → SpmdTrainer), "single" (plain Trainer)."""
+    if getattr(strategy, "a_sync", False) or getattr(strategy, "geo_sgd_mode", False):
+        return {"runtime": "ps", "kwargs": {}}
+    hc = dict(getattr(strategy, "hybrid_configs", {}) or {})
+    pp = int(hc.get("pp_degree", 1))
+    mp = int(hc.get("mp_degree", 1))
+    cp = int(hc.get("cp_degree", 1))
+    ep = int(hc.get("ep_degree", 1))
+    if getattr(strategy, "pipeline", False):
+        pp = max(pp, int((getattr(strategy, "pipeline_configs", {}) or {})
+                         .get("pp_degree", 2)), 2)
+    if getattr(strategy, "tensor_parallel", False):
+        mp = max(mp, int((getattr(strategy, "tensor_parallel_configs", {}) or {})
+                         .get("tensor_parallel_degree", 2)), 2)
+    if pp > 1 or mp > 1 or cp > 1 or ep > 1:
+        return {"runtime": "hybrid",
+                "kwargs": {"dp": int(hc.get("dp_degree", 1)), "pp": pp,
+                           "mp": mp, "cp": cp, "ep": ep}}
+    zero = 0
+    if getattr(strategy, "sharding", False):
+        zero = int((getattr(strategy, "sharding_configs", {}) or {}).get("stage", 1))
+    degree = int((getattr(strategy, "sharding_configs", {}) or {})
+                 .get("sharding_degree", 1)) if zero else 1
+    if zero or getattr(strategy, "without_graph_optimization", False):
+        return {"runtime": "spmd",
+                "kwargs": {"zero_stage": zero, "sharding_degree": degree}}
+    return {"runtime": "single", "kwargs": {}}
 
 
 def apply_strategy(optimizer: Optimizer, strategy) -> Optimizer:
@@ -302,6 +386,9 @@ def apply_strategy(optimizer: Optimizer, strategy) -> Optimizer:
 
     if getattr(strategy, "fp16_allreduce", False):
         opt = FP16AllReduceOptimizer(opt)
+
+    if getattr(strategy, "asp", False):
+        opt = ASPOptimizer(opt)
 
     if getattr(strategy, "localsgd", False):
         cfg = getattr(strategy, "localsgd_configs", {}) or {}

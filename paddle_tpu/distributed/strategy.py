@@ -88,6 +88,11 @@ class DistributedStrategy:
                                  "sparsity": [0.999]}
     )
     fp16_allreduce: bool = False
+    # ASP 2:4 structured sparsity (fleet ASP meta-optimizer)
+    asp: bool = False
+    # static DP: reference raw_program_optimizer inserts c_allreduce_sum;
+    # here it selects the SpmdTrainer runtime (without_graph_optimization)
+    without_graph_optimization: bool = False
 
     # --- misc ---
     find_unused_parameters: bool = False

@@ -112,6 +112,50 @@ class Trainer:
         self._train_step = make_train_step(model, optimizer, loss_fn)
         self._eval_step = make_eval_step(model)
         self.global_step = 0
+        self._dump_fh = None
+        self._dump_fields: Tuple[str, ...] = ()
+
+    def set_dump_config(self, dump_path: str, fields=("loss",),
+                        trainer_id: int = 0) -> None:
+        """Worker debug dumps (trainer.h ParseDumpConfig / DeviceWorker
+        DumpField): append selected per-step values to a per-trainer
+        file. Field syntax: "loss", "param:<name>", "buffer:<name>",
+        "input:<i>", "label:<i>". Disable with ``dump_path=None``."""
+        if self._dump_fh is not None:
+            self._dump_fh.close()
+            self._dump_fh = None
+        self._dump_fields = tuple(fields)
+        if dump_path:
+            import os
+
+            os.makedirs(dump_path, exist_ok=True)
+            self._dump_fh = open(
+                f"{dump_path}/trainer-{trainer_id:03d}.dump", "a")
+
+    def _dump(self, inputs, labels, loss) -> None:
+        import numpy as np
+
+        def fmt(v):
+            a = np.asarray(v).reshape(-1)
+            head = " ".join(f"{x:.6g}" for x in a[:16])
+            return f"{head}{' ...' if a.size > 16 else ''}"
+
+        for f in self._dump_fields:
+            if f == "loss":
+                val = loss
+            elif f.startswith("param:"):
+                val = self.state["params"].get(f[6:])
+            elif f.startswith("buffer:"):
+                val = self.state["buffers"].get(f[7:])
+            elif f.startswith("input:"):
+                val = inputs[int(f[6:])]
+            elif f.startswith("label:"):
+                val = labels[int(f[6:])]
+            else:
+                val = None
+            if val is not None:
+                self._dump_fh.write(f"{self.global_step}\t{f}\t{fmt(val)}\n")
+        self._dump_fh.flush()
 
     def train_step(self, inputs, labels) -> jax.Array:
         """Run one compiled step; returns the loss as a device array.
@@ -129,6 +173,8 @@ class Trainer:
         self.global_step += 1
         if flag("check_nan_inf"):
             check_numerics({"loss": loss}, f"step {self.global_step}")
+        if self._dump_fh is not None:
+            self._dump(inputs, labels, loss)
         return loss
 
     def predict(self, inputs):

@@ -1,0 +1,209 @@
+"""Filesystem abstraction: LocalFS + HDFS/AFS shell wrappers.
+
+Reference counterparts: the C++ shell-out helpers ``framework/io/fs.{h,cc}``
++ ``shell.cc`` (fs_open/fs_exists/fs_mkdir dispatch local vs hdfs by
+path prefix, piping through compression converters) and the python
+``fleet/utils/fs.py`` (``LocalFS``/``HDFSClient`` with ls_dir/is_exist/
+upload/download/mkdirs/delete/mv/touch, ExecuteError retries).
+
+The HDFS client shells out to ``hadoop fs`` like the reference; it is
+gated on the binary's presence (``HDFSClient.available()``) so the
+framework degrades to LocalFS-only on machines without a Hadoop
+deployment (tests use LocalFS + a fake command). PS table save/load and
+auto-checkpoint accept any of these via the ``fs`` parameter.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import time
+from typing import List, Optional, Tuple
+
+from ..core.enforce import ExecuteError, enforce
+
+__all__ = ["FS", "LocalFS", "HDFSClient"]
+
+
+class FS:
+    """Interface (fleet/utils/fs.py FS abstract shape)."""
+
+    def ls_dir(self, path: str) -> Tuple[List[str], List[str]]:
+        """(dirs, files) directly under path."""
+        raise NotImplementedError
+
+    def is_exist(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def is_dir(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def is_file(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def mkdirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def mv(self, src: str, dst: str, overwrite: bool = False) -> None:
+        raise NotImplementedError
+
+    def touch(self, path: str, exist_ok: bool = True) -> None:
+        raise NotImplementedError
+
+    def upload(self, local_path: str, fs_path: str) -> None:
+        raise NotImplementedError
+
+    def download(self, fs_path: str, local_path: str) -> None:
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """fleet/utils/fs.py LocalFS: thin os/shutil layer with the FS API."""
+
+    def ls_dir(self, path):
+        if not os.path.exists(path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(path)):
+            (dirs if os.path.isdir(os.path.join(path, name)) else files).append(name)
+        return dirs, files
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def mv(self, src, dst, overwrite=False):
+        enforce(os.path.exists(src), f"mv: {src} does not exist", ExecuteError)
+        if overwrite and os.path.exists(dst):
+            self.delete(dst)
+        enforce(not os.path.exists(dst), f"mv: {dst} exists", ExecuteError)
+        shutil.move(src, dst)
+
+    def touch(self, path, exist_ok=True):
+        if os.path.exists(path):
+            enforce(exist_ok, f"touch: {path} exists", ExecuteError)
+            return
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        open(path, "a").close()
+
+    def upload(self, local_path, fs_path):
+        self.mkdirs(os.path.dirname(fs_path) or ".")
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path, dirs_exist_ok=True)
+        else:
+            shutil.copy2(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+
+class HDFSClient(FS):
+    """``hadoop fs`` shell wrapper (fleet/utils/fs.py HDFSClient /
+    framework/io/fs.cc hdfs_* commands): every op is a retried shell-out.
+
+    ``hadoop_bin`` defaults to $HADOOP_HOME/bin/hadoop or ``hadoop`` on
+    PATH; configs become ``-D key=value`` pairs (fs.default.name,
+    hadoop.job.ugi). Not available → construction still succeeds but
+    ``available()`` is False and ops raise ExecuteError (callers gate)."""
+
+    def __init__(self, hadoop_bin: Optional[str] = None,
+                 configs: Optional[dict] = None, time_out_ms: int = 5 * 60 * 1000,
+                 sleep_inter_ms: int = 1000, retry_times: int = 3) -> None:
+        if hadoop_bin is None:
+            home = os.environ.get("HADOOP_HOME")
+            hadoop_bin = (os.path.join(home, "bin", "hadoop") if home
+                          else shutil.which("hadoop") or "hadoop")
+        self.hadoop_bin = hadoop_bin
+        self.pre = [hadoop_bin, "fs"]
+        for k, v in (configs or {}).items():
+            self.pre += ["-D", f"{k}={v}"]
+        self.timeout = time_out_ms / 1000.0
+        self.sleep_inter = sleep_inter_ms / 1000.0
+        self.retry_times = retry_times
+
+    def available(self) -> bool:
+        return shutil.which(self.hadoop_bin) is not None or os.path.exists(self.hadoop_bin)
+
+    def _run(self, args: List[str], ok_codes=(0,)) -> Tuple[int, str]:
+        last = None
+        for attempt in range(self.retry_times):
+            try:
+                proc = subprocess.run(self.pre + args, capture_output=True,
+                                      text=True, timeout=self.timeout)
+                if proc.returncode in ok_codes:
+                    return proc.returncode, proc.stdout
+                last = ExecuteError(
+                    f"hadoop {' '.join(args)} rc={proc.returncode}: {proc.stderr[-500:]}")
+            except (OSError, subprocess.TimeoutExpired) as e:
+                last = ExecuteError(f"hadoop {' '.join(args)}: {e}")
+            time.sleep(self.sleep_inter * (attempt + 1))
+        raise last
+
+    def ls_dir(self, path):
+        rc, out = self._run(["-ls", path], ok_codes=(0, 1))
+        dirs, files = [], []
+        for line in out.splitlines():
+            fields = line.split()
+            if len(fields) < 8:
+                continue
+            name = fields[-1].rsplit("/", 1)[-1]
+            (dirs if fields[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def is_exist(self, path):
+        try:
+            rc, _ = self._run(["-test", "-e", path], ok_codes=(0, 1))
+            return rc == 0
+        except ExecuteError:
+            return False
+
+    def is_dir(self, path):
+        try:
+            rc, _ = self._run(["-test", "-d", path], ok_codes=(0, 1))
+            return rc == 0
+        except ExecuteError:
+            return False
+
+    def is_file(self, path):
+        return self.is_exist(path) and not self.is_dir(path)
+
+    def mkdirs(self, path):
+        self._run(["-mkdir", "-p", path])
+
+    def delete(self, path):
+        self._run(["-rm", "-r", "-f", path])
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite:
+            self._run(["-rm", "-r", "-f", dst])
+        self._run(["-mv", src, dst])
+
+    def touch(self, path, exist_ok=True):
+        if self.is_exist(path):
+            enforce(exist_ok, f"touch: {path} exists", ExecuteError)
+            return
+        self._run(["-touchz", path])
+
+    def upload(self, local_path, fs_path):
+        self._run(["-put", "-f", local_path, fs_path])
+
+    def download(self, fs_path, local_path):
+        self._run(["-get", fs_path, local_path])

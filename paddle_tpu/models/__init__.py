@@ -2,8 +2,12 @@ from .lenet import LeNet
 from .ernie import Ernie, ErnieConfig
 from .ctr import CtrConfig, DeepFM, WideDeep, make_ctr_train_step
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
+from .mobilenet import MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2
 
 __all__ = ["LeNet", "Ernie", "ErnieConfig",
            "CtrConfig", "DeepFM", "WideDeep", "make_ctr_train_step",
            "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
-           "resnet152"]
+           "resnet152",
+           "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+           "MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2"]

@@ -1,0 +1,161 @@
+"""MobileNet family (reference: ``python/paddle/vision/models/
+mobilenetv1.py`` / ``mobilenetv2.py``): depthwise-separable convolutions
+(v1) and inverted residuals with linear bottlenecks (v2). Depthwise =
+grouped conv with groups == channels; XLA lowers it to per-channel MXU
+work under jit."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .. import nn
+
+__all__ = ["MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2"]
+
+
+def _make_divisible(v: float, divisor: int = 8, min_value: Optional[int] = None) -> int:
+    if min_value is None:
+        min_value = divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _ConvBNReLU(nn.Layer):
+    def __init__(self, in_ch: int, out_ch: int, kernel: int = 3, stride: int = 1,
+                 groups: int = 1) -> None:
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
+                              padding=(kernel - 1) // 2, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_ch)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _DepthwiseSeparable(nn.Layer):
+    """v1 block: depthwise 3x3 + pointwise 1x1."""
+
+    def __init__(self, in_ch: int, out_ch: int, stride: int) -> None:
+        super().__init__()
+        self.dw = _ConvBNReLU(in_ch, in_ch, 3, stride=stride, groups=in_ch)
+        self.pw = _ConvBNReLU(in_ch, out_ch, 1)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(nn.Layer):
+    """mobilenetv1.py: 13 depthwise-separable blocks, width multiplier."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True) -> None:
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        def c(ch):
+            return int(ch * scale)
+
+        cfg = [  # (out_ch, stride)
+            (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1),
+            (1024, 2), (1024, 1),
+        ]
+        blocks: List[nn.Layer] = [_ConvBNReLU(3, c(32), 3, stride=2)]
+        in_ch = c(32)
+        for out_ch, stride in cfg:
+            blocks.append(_DepthwiseSeparable(in_ch, c(out_ch), stride))
+            in_ch = c(out_ch)
+        self.features = nn.Sequential(*blocks)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c(1024), num_classes)
+        self._out_ch = c(1024)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape(x.shape[0], -1)
+            x = self.fc(x)
+        return x
+
+
+class _InvertedResidual(nn.Layer):
+    """v2 block: 1x1 expand -> depthwise 3x3 -> 1x1 linear project,
+    residual when stride==1 and shapes match."""
+
+    def __init__(self, in_ch: int, out_ch: int, stride: int, expand: int) -> None:
+        super().__init__()
+        hidden = int(round(in_ch * expand))
+        self.use_res = stride == 1 and in_ch == out_ch
+        layers: List[nn.Layer] = []
+        if expand != 1:
+            layers.append(_ConvBNReLU(in_ch, hidden, 1))
+        layers.append(_ConvBNReLU(hidden, hidden, 3, stride=stride, groups=hidden))
+        layers.append(nn.Conv2D(hidden, out_ch, 1, bias_attr=False))
+        layers.append(nn.BatchNorm2D(out_ch))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        y = self.block(x)
+        return x + y if self.use_res else y
+
+
+class MobileNetV2(nn.Layer):
+    """mobilenetv2.py: inverted-residual settings table (t, c, n, s)."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000,
+                 with_pool: bool = True) -> None:
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        settings = [
+            # t, c, n, s
+            (1, 16, 1, 1),
+            (6, 24, 2, 2),
+            (6, 32, 3, 2),
+            (6, 64, 4, 2),
+            (6, 96, 3, 1),
+            (6, 160, 3, 2),
+            (6, 320, 1, 1),
+        ]
+        in_ch = _make_divisible(32 * scale)
+        last_ch = _make_divisible(1280 * max(1.0, scale))
+        blocks: List[nn.Layer] = [_ConvBNReLU(3, in_ch, 3, stride=2)]
+        for t, ch, n, s in settings:
+            out_ch = _make_divisible(ch * scale)
+            for i in range(n):
+                blocks.append(_InvertedResidual(in_ch, out_ch,
+                                                s if i == 0 else 1, t))
+                in_ch = out_ch
+        blocks.append(_ConvBNReLU(in_ch, last_ch, 1))
+        self.features = nn.Sequential(*blocks)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(nn.Dropout(0.2),
+                                            nn.Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape(x.shape[0], -1)
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v1(scale: float = 1.0, **kw) -> MobileNetV1:
+    return MobileNetV1(scale=scale, **kw)
+
+
+def mobilenet_v2(scale: float = 1.0, **kw) -> MobileNetV2:
+    return MobileNetV2(scale=scale, **kw)

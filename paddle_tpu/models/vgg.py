@@ -1,0 +1,81 @@
+"""VGG family (reference: ``python/paddle/vision/models/vgg.py`` —
+cfgs A/B/D/E = vgg11/13/16/19, optional batch_norm, 4096-wide
+classifier head)."""
+
+from __future__ import annotations
+
+from typing import List, Union
+
+from .. import nn
+
+__all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19"]
+
+_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+          512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512, 512,
+          512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def _make_features(cfg: List[Union[int, str]], batch_norm: bool) -> nn.Sequential:
+    layers: List[nn.Layer] = []
+    in_ch = 3
+    for v in cfg:
+        if v == "M":
+            layers.append(nn.MaxPool2D(2, stride=2))
+            continue
+        layers.append(nn.Conv2D(in_ch, v, 3, padding=1))
+        if batch_norm:
+            layers.append(nn.BatchNorm2D(v))
+        layers.append(nn.ReLU())
+        in_ch = v
+    return nn.Sequential(*layers)
+
+
+class VGG(nn.Layer):
+    def __init__(self, features: nn.Sequential, num_classes: int = 1000,
+                 with_pool: bool = True) -> None:
+        super().__init__()
+        self.features = features
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(7)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(512 * 7 * 7, 4096), nn.ReLU(), nn.Dropout(0.5),
+                nn.Linear(4096, 4096), nn.ReLU(), nn.Dropout(0.5),
+                nn.Linear(4096, num_classes),
+            )
+        self.num_classes = num_classes
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.reshape(x.shape[0], -1)
+            x = self.classifier(x)
+        return x
+
+
+def _vgg(cfg: str, batch_norm: bool, **kw) -> VGG:
+    return VGG(_make_features(_CFGS[cfg], batch_norm), **kw)
+
+
+def vgg11(batch_norm: bool = False, **kw) -> VGG:
+    return _vgg("A", batch_norm, **kw)
+
+
+def vgg13(batch_norm: bool = False, **kw) -> VGG:
+    return _vgg("B", batch_norm, **kw)
+
+
+def vgg16(batch_norm: bool = False, **kw) -> VGG:
+    return _vgg("D", batch_norm, **kw)
+
+
+def vgg19(batch_norm: bool = False, **kw) -> VGG:
+    return _vgg("E", batch_norm, **kw)

@@ -331,6 +331,7 @@ def _configure_pst(lib: ctypes.CDLL) -> None:
         getattr(lib, fn).argtypes = [ctypes.c_void_p]
     lib.pst_size.restype = ctypes.c_int64
     lib.pst_size.argtypes = [ctypes.c_void_p]
+    lib.pst_shard_sizes.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
     lib.pst_pull.argtypes = [ctypes.c_void_p, u64p, i32p, ctypes.c_int64,
                              ctypes.c_int32, f32p]
     lib.pst_push.argtypes = [ctypes.c_void_p, u64p, f32p, ctypes.c_int64]
@@ -372,6 +373,7 @@ class NativeSparseTableEngine:
         fparams = np.asarray(list(lifecycle) + list(sgd), np.float32)
         assert len(fparams) == 17, len(fparams)
         self._h = self._lib.pst_create(_i32(iparams), _f32(fparams))
+        self._save_lock = threading.Lock()  # begin/fetch must not interleave
         self.pull_dim = int(self._lib.pst_pull_dim(self._h))
         self.push_dim = int(self._lib.pst_push_dim(self._h))
         self.full_dim = int(self._lib.pst_full_dim(self._h))
@@ -384,6 +386,12 @@ class NativeSparseTableEngine:
 
     def size(self) -> int:
         return int(self._lib.pst_size(self._h))
+
+    def shard_sizes(self, shard_num: int) -> np.ndarray:
+        out = np.empty(shard_num, np.int64)
+        self._lib.pst_shard_sizes(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        return out
 
     def pull(self, keys: np.ndarray, slots: Optional[np.ndarray], create: bool) -> np.ndarray:
         keys = np.ascontiguousarray(keys, np.uint64)
@@ -405,10 +413,11 @@ class NativeSparseTableEngine:
 
     def save_items(self, mode: int) -> Tuple[np.ndarray, np.ndarray]:
         """(keys [n], full rows [n, full_dim]) passing the mode filter."""
-        n = int(self._lib.pst_save_begin(self._h, mode))
-        keys = np.empty(n, np.uint64)
-        values = np.empty((n, self.full_dim), np.float32)
-        self._lib.pst_save_fetch(self._h, _u64(keys), _f32(values))
+        with self._save_lock:
+            n = int(self._lib.pst_save_begin(self._h, mode))
+            keys = np.empty(n, np.uint64)
+            values = np.empty((n, self.full_dim), np.float32)
+            self._lib.pst_save_fetch(self._h, _u64(keys), _f32(values))
         return keys, values
 
     def export_full(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -424,3 +433,85 @@ class NativeSparseTableEngine:
         keys = np.ascontiguousarray(keys, np.uint64)
         values = np.ascontiguousarray(values, np.float32)
         self._lib.pst_insert_full(self._h, _u64(keys), _f32(values), len(keys))
+
+
+# ---------------------------------------------------------------------------
+# Native data feed (csrc/data_feed.cc): multithreaded file -> channel
+# ---------------------------------------------------------------------------
+
+
+def _configure_dfd(lib: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.dfd_create.restype = ctypes.c_void_p
+    lib.dfd_create.argtypes = [ctypes.c_int, u8p, u8p, ctypes.c_char_p,
+                               ctypes.c_int, ctypes.c_int]
+    lib.dfd_destroy.argtypes = [ctypes.c_void_p]
+    lib.dfd_next.restype = ctypes.c_int64
+    lib.dfd_next.argtypes = [ctypes.c_void_p]
+    lib.dfd_value_count.restype = ctypes.c_int64
+    lib.dfd_value_count.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.dfd_fetch.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p, i32p]
+    lib.dfd_release.argtypes = [ctypes.c_void_p]
+    lib.dfd_errors.restype = ctypes.c_int64
+    lib.dfd_errors.argtypes = [ctypes.c_void_p]
+
+
+class NativeDataFeed:
+    """Channel-based multithreaded reader (data_feed.cc): iterate chunks
+    of parsed slot columns as {name: (values, lengths)} dicts. Raises
+    RuntimeError when the native lib is unavailable (callers fall back
+    to the single-threaded Python path)."""
+
+    def __init__(self, slots, files, num_threads: int = 4,
+                 capacity: int = 8) -> None:
+        self.slots = [(str(n), bool(f), bool(u)) for n, f, u in slots]
+        self._lib = load_native()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        if not getattr(self._lib, "_dfd_configured", False):
+            try:
+                _configure_dfd(self._lib)
+            except AttributeError as e:
+                raise RuntimeError(f"native library lacks data-feed symbols: {e}")
+            self._lib._dfd_configured = True
+        is_float = np.asarray([f for _, f, _ in self.slots], np.uint8)
+        used = np.asarray([u for _, _, u in self.slots], np.uint8)
+        joined = "\n".join(files).encode()
+        self._h = self._lib.dfd_create(
+            len(self.slots),
+            is_float.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            used.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            joined, num_threads, capacity)
+
+    def __del__(self):
+        self.close()
+
+    def close(self) -> None:
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_h", None):
+            lib.dfd_destroy(self._h)
+            self._h = None
+
+    @property
+    def errors(self) -> int:
+        return int(self._lib.dfd_errors(self._h))
+
+    def __iter__(self):
+        while True:
+            n = int(self._lib.dfd_next(self._h))
+            if n < 0:
+                return
+            out = {}
+            for s, (name, is_float, used) in enumerate(self.slots):
+                if not used:
+                    continue
+                count = int(self._lib.dfd_value_count(self._h, s))
+                values = np.empty(count, np.float32 if is_float else np.uint64)
+                lengths = np.empty(n, np.int32)
+                self._lib.dfd_fetch(
+                    self._h, s, values.ctypes.data_as(ctypes.c_void_p),
+                    lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+                out[name] = (values, lengths)
+            self._lib.dfd_release(self._h)
+            yield out

@@ -42,7 +42,7 @@ _PUSH_DENSE = 6
 _SET_DENSE = 7
 _SIZE = 8
 _SHRINK = 9
-_SAVE_BEGIN = 10
+_SAVE_BEGIN = 10  # legacy two-phase (local engine ABI)
 _SAVE_FETCH = 11
 _INSERT_FULL = 12
 _EXPORT = 13
@@ -53,6 +53,7 @@ _GLOBAL_STEP = 17
 _CREATE_GEO = 18
 _PUSH_GEO = 19
 _PULL_GEO = 20
+_SAVE_ALL = 21
 
 _DENSE_OPT_IDS = {"sgd": 0, "adam": 1, "sum": 2}
 
@@ -387,8 +388,9 @@ class RpcPsClient(PSClient):
         ed = full_dim - 7 - xd - self._embedx_state_dim(table_id)
         total = 0
         for s, c in enumerate(self._conns):
-            cnt, _ = c.check(_SAVE_BEGIN, table_id, aux=mode)
-            _, resp = c.check(_SAVE_FETCH, table_id)
+            # single atomic command: snapshot+stream (concurrent savers
+            # cannot interleave a begin/fetch pair)
+            cnt, resp = c.check(_SAVE_ALL, table_id, aux=mode)
             keys = np.frombuffer(resp[: cnt * 8], np.uint64)
             values = np.frombuffer(resp[cnt * 8 :], np.float32).reshape(cnt, full_dim)
             path = os.path.join(dirname, f"part-{s:05d}.shard")
@@ -398,7 +400,8 @@ class RpcPsClient(PSClient):
             total += cnt
         with open(os.path.join(dirname, "meta.json"), "w") as f:
             json.dump({"shard_num": self.num_servers, "embedx_dim": xd,
-                       "accessor": "ctr", "mode": mode}, f)
+                       "accessor": self._sparse_cfgs[table_id].accessor,
+                       "mode": mode}, f)
         return total
 
     def load(self, table_id, dirname):

@@ -402,6 +402,23 @@ class MemorySparseTable:
     def flush(self) -> None:
         pass  # synchronous writes; parity no-op
 
+    def shard_sizes(self) -> np.ndarray:
+        if self._native is not None:
+            return self._native.shard_sizes(self.config.shard_num)
+        return np.asarray([len(sh.index) for sh in self._shards], np.int64)
+
+    def print_table_stat(self) -> str:
+        """PrintTableStat (table.h:122): human-readable size/balance
+        summary; returned AND printed like the reference's LOG(INFO)."""
+        sizes = self.shard_sizes()
+        total = int(sizes.sum())
+        imbalance = (float(sizes.max()) / max(sizes.mean(), 1e-9)) if total else 1.0
+        msg = (f"table {self.config.table_id}: {total} features over "
+               f"{self.config.shard_num} shards (backend={self.backend}, "
+               f"max/mean imbalance {imbalance:.2f})")
+        print(msg)
+        return msg
+
     # -- save/load (per-shard text files, Appendix A / SURVEY §5) ---------
 
     def save(self, dirname: str, mode: int = _SAVE_MODE_ALL) -> int:
@@ -419,15 +436,13 @@ class MemorySparseTable:
                       if per else np.zeros((0, self.full_dim), np.float32))
         shard_of = (keys % np.uint64(self.config.shard_num)).astype(np.int64)
         xd = self.accessor.config.embedx_dim
-        files = [open(os.path.join(dirname, f"part-{i:05d}.shard"), "w")
-                 for i in range(self.config.shard_num)]
-        try:
-            for j in range(len(keys)):
-                files[shard_of[j]].write(
-                    format_shard_row(keys[j], values[j], ed, xd) + "\n")
-        finally:
-            for f in files:
-                f.close()
+        order = np.argsort(shard_of, kind="stable")
+        bounds = np.searchsorted(shard_of[order],
+                                 np.arange(self.config.shard_num + 1))
+        for i in range(self.config.shard_num):  # one open file at a time
+            with open(os.path.join(dirname, f"part-{i:05d}.shard"), "w") as f:
+                for j in order[bounds[i] : bounds[i + 1]]:
+                    f.write(format_shard_row(keys[j], values[j], ed, xd) + "\n")
         self._write_meta(dirname, mode)
         return len(keys)
 
@@ -442,31 +457,6 @@ class MemorySparseTable:
                 },
                 f,
             )
-
-    def _save_native(self, dirname: str, mode: int) -> int:
-        """Native path: drain the engine's save cursor into the same
-        per-shard text files the Python path writes."""
-        keys, values = self._native.save_items(mode)
-        ed = self.accessor.embed_rule.state_dim
-        xd = self.accessor.config.embedx_dim
-        xs = self.accessor.embedx_rule.state_dim
-        shard_of = (keys % np.uint64(self.config.shard_num)).astype(np.int64)
-        files = [open(os.path.join(dirname, f"part-{i:05d}.shard"), "w")
-                 for i in range(self.config.shard_num)]
-        try:
-            for j in range(len(keys)):
-                v = values[j]
-                fields = [str(int(keys[j])), str(int(v[0])), f"{v[1]:.6g}",
-                          f"{v[2]:.6g}", f"{v[3]:.6g}", f"{v[4]:.6g}",
-                          f"{v[5]:.8g}"]
-                fields += [f"{x:.8g}" for x in v[6 : 6 + ed]]
-                if v[6 + ed] != 0.0:  # has_embedx
-                    fields += [f"{x:.8g}" for x in v[7 + ed : 7 + ed + xd + xs]]
-                files[shard_of[j]].write(" ".join(fields) + "\n")
-        finally:
-            for f in files:
-                f.close()
-        return len(keys)
 
     def load(self, dirname: str) -> int:
         with open(os.path.join(dirname, "meta.json")) as f:

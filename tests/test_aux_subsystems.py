@@ -175,3 +175,39 @@ def test_launch_propagates_failure(tmp_path):
     script.write_text("import sys; sys.exit(3)")
     rc = launch_local(JobSpec([str(script)], nproc=2), timeout=60)
     assert rc == 3
+
+
+def test_trainer_dump_fields(tmp_path):
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.executor import Trainer
+
+    pt.seed(0)
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    tr = Trainer(model, optimizer.SGD(0.1), nn.functional.cross_entropy)
+    tr.set_dump_config(str(tmp_path), fields=("loss", "input:0", "label:0"),
+                       trainer_id=3)
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        tr.train_step(rng.normal(size=(8, 4)).astype(np.float32),
+                      rng.integers(0, 2, 8))
+    tr.set_dump_config(None)  # close
+    lines = (tmp_path / "trainer-003.dump").read_text().strip().splitlines()
+    assert len(lines) == 9  # 3 steps x 3 fields
+    assert lines[0].split("\t")[1] == "loss"
+    steps = {int(l.split("\t")[0]) for l in lines}
+    assert steps == {1, 2, 3}
+
+
+def test_print_table_stat():
+    import numpy as np
+
+    from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+    t = MemorySparseTable(TableConfig(shard_num=4))
+    t.pull_sparse(np.arange(1, 101, dtype=np.uint64))
+    msg = t.print_table_stat()
+    assert "100 features" in msg and "4 shards" in msg
+    assert int(t.shard_sizes().sum()) == 100

@@ -87,3 +87,37 @@ def test_pass_feasigns_feed_cache():
     keys = ds.pass_feasigns()
     # click (16) + feat (32) uint64 keys
     assert keys.dtype == np.uint64 and len(keys) == 48
+
+
+def test_vision_datasets_synthetic_and_idx(tmp_path):
+    import gzip
+    import struct
+
+    import numpy as np
+
+    from paddle_tpu.data import MNIST, Cifar10, DataLoader
+
+    # synthetic fallback: deterministic, class-dependent
+    ds = MNIST(mode="train", synthetic_size=64)
+    assert len(ds) == 64
+    x, y = ds[np.arange(8)]
+    assert x.shape == (8, 1, 28, 28) and y.shape == (8,)
+    ds2 = MNIST(mode="train", synthetic_size=64)
+    np.testing.assert_array_equal(ds.labels, ds2.labels)
+    assert set(np.unique(ds.labels)) <= set(range(10))
+
+    # IDX file loading (the real MNIST on-disk format)
+    n, h, w = 5, 28, 28
+    imgs = (np.arange(n * h * w) % 255).astype(np.uint8)
+    with gzip.open(tmp_path / "train-images-idx3-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, h, w) + imgs.tobytes())
+    with gzip.open(tmp_path / "train-labels-idx1-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">II", 2049, n) + bytes([0, 1, 2, 3, 4]))
+    ds3 = MNIST(mode="train", image_path=str(tmp_path))
+    assert len(ds3) == 5 and ds3.labels.tolist() == [0, 1, 2, 3, 4]
+    assert ds3.images.max() <= 1.0
+
+    # cifar synthetic + loader integration
+    c = Cifar10(mode="test", synthetic_size=32)
+    batches = list(DataLoader(c, batch_size=8))
+    assert len(batches) == 4 and batches[0][0].shape == (8, 3, 32, 32)

@@ -1,0 +1,126 @@
+"""io/fs tests — LocalFS behavior + HDFSClient shell contract via a fake
+``hadoop`` binary (the reference tests HDFSClient the same way:
+fleet/utils/fs.py tests stub the hadoop shell)."""
+
+import os
+import stat
+
+import pytest
+
+from paddle_tpu.core.enforce import ExecuteError
+from paddle_tpu.io.fs import FS, HDFSClient, LocalFS
+
+
+@pytest.fixture
+def lfs():
+    return LocalFS()
+
+
+def test_local_roundtrip(lfs, tmp_path):
+    d = tmp_path / "a" / "b"
+    lfs.mkdirs(str(d))
+    assert lfs.is_dir(str(d))
+    f = d / "x.txt"
+    lfs.touch(str(f))
+    assert lfs.is_file(str(f))
+    dirs, files = lfs.ls_dir(str(d.parent))
+    assert dirs == ["b"] and files == []
+    dirs, files = lfs.ls_dir(str(d))
+    assert files == ["x.txt"]
+    lfs.mv(str(f), str(d / "y.txt"))
+    assert lfs.is_exist(str(d / "y.txt")) and not lfs.is_exist(str(f))
+    lfs.delete(str(d))
+    assert not lfs.is_exist(str(d))
+
+
+def test_local_mv_refuses_overwrite(lfs, tmp_path):
+    a, b = tmp_path / "a", tmp_path / "b"
+    a.write_text("1")
+    b.write_text("2")
+    with pytest.raises(ExecuteError):
+        lfs.mv(str(a), str(b))
+    lfs.mv(str(a), str(b), overwrite=True)
+    assert b.read_text() == "1"
+
+
+def test_local_upload_download(lfs, tmp_path):
+    src = tmp_path / "src.txt"
+    src.write_text("data")
+    lfs.upload(str(src), str(tmp_path / "store" / "src.txt"))
+    lfs.download(str(tmp_path / "store" / "src.txt"), str(tmp_path / "back.txt"))
+    assert (tmp_path / "back.txt").read_text() == "data"
+
+
+FAKE_HADOOP = """#!/bin/bash
+# fake `hadoop fs` over a local root for contract tests
+shift  # drop "fs"
+ROOT="$FAKE_HDFS_ROOT"
+cmd="$1"; shift
+case "$cmd" in
+  -mkdir) [ "$1" = "-p" ] && shift; mkdir -p "$ROOT/$1";;
+  -test)
+    flag="$1"; p="$ROOT/$2"
+    case "$flag" in
+      -e) [ -e "$p" ] ;;
+      -d) [ -d "$p" ] ;;
+    esac
+    exit $? ;;
+  -touchz) : > "$ROOT/$1";;
+  -rm) [ "$1" = "-r" ] && shift; [ "$1" = "-f" ] && shift; rm -rf "$ROOT/$1";;
+  -mv) mv "$ROOT/$1" "$ROOT/$2";;
+  -put) [ "$1" = "-f" ] && shift; cp -r "$1" "$ROOT/$2";;
+  -get) cp -r "$ROOT/$1" "$2";;
+  -ls)
+    p="$ROOT/$1"
+    [ -e "$p" ] || exit 1
+    for e in "$p"/*; do
+      [ -e "$e" ] || continue
+      if [ -d "$e" ]; then perm="drwxr-xr-x"; else perm="-rw-r--r--"; fi
+      echo "$perm 1 u g 0 2026-01-01 00:00 $1/$(basename "$e")"
+    done ;;
+  *) echo "unknown $cmd" >&2; exit 2;;
+esac
+"""
+
+
+@pytest.fixture
+def hdfs(tmp_path):
+    bin_path = tmp_path / "hadoop"
+    bin_path.write_text(FAKE_HADOOP)
+    bin_path.chmod(bin_path.stat().st_mode | stat.S_IEXEC)
+    root = tmp_path / "hdfs_root"
+    root.mkdir()
+    os.environ["FAKE_HDFS_ROOT"] = str(root)
+    client = HDFSClient(hadoop_bin=str(bin_path), retry_times=1,
+                        time_out_ms=10_000, sleep_inter_ms=10)
+    assert client.available()
+    return client
+
+
+def test_hdfs_contract(hdfs, tmp_path):
+    hdfs.mkdirs("models/day1")
+    assert hdfs.is_exist("models/day1") and hdfs.is_dir("models/day1")
+    hdfs.touch("models/day1/donefile")
+    assert hdfs.is_file("models/day1/donefile")
+    dirs, files = hdfs.ls_dir("models")
+    assert dirs == ["day1"]
+    dirs, files = hdfs.ls_dir("models/day1")
+    assert files == ["donefile"]
+    local = tmp_path / "local.txt"
+    local.write_text("table data")
+    hdfs.upload(str(local), "models/day1/part-0")
+    back = tmp_path / "back.txt"
+    hdfs.download("models/day1/part-0", str(back))
+    assert back.read_text() == "table data"
+    hdfs.mv("models/day1", "models/day2")
+    assert hdfs.is_exist("models/day2") and not hdfs.is_exist("models/day1")
+    hdfs.delete("models")
+    assert not hdfs.is_exist("models")
+
+
+def test_hdfs_unavailable_binary():
+    client = HDFSClient(hadoop_bin="/nonexistent/hadoop", retry_times=1,
+                        sleep_inter_ms=1)
+    assert not client.available()
+    with pytest.raises(ExecuteError):
+        client.mkdirs("x")

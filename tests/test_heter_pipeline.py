@@ -1,0 +1,134 @@
+"""Heter pipeline trainer (HeterPipelineTrainer/HeterSectionWorker
+parity: framework/heter_pipeline_trainer.cc, heter_section_worker.cc):
+CPU sections feed device sections through bounded channels."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer
+from paddle_tpu.parallel.heter_pipeline import HeterPipelineTrainer, SectionConfig
+
+
+def test_single_thread_sections_preserve_order():
+    tr = HeterPipelineTrainer([
+        SectionConfig(lambda x: x * 2),
+        SectionConfig(lambda x: x + 1),
+    ])
+    out = tr.run(range(20))
+    assert out == [x * 2 + 1 for x in range(20)]
+
+
+def test_multi_thread_section_processes_all():
+    seen = []
+    lock = threading.Lock()
+
+    def slow_double(x):
+        time.sleep(0.001)
+        with lock:
+            seen.append(x)
+        return x * 2
+
+    tr = HeterPipelineTrainer([SectionConfig(slow_double, num_threads=4)])
+    out = tr.run(range(50))
+    assert sorted(out) == [x * 2 for x in range(50)]
+    assert sorted(seen) == list(range(50))
+
+
+def test_sections_overlap_in_time():
+    """Pipelining: two 10ms sections over 8 items must beat serial."""
+    def slow(x):
+        time.sleep(0.01)
+        return x
+
+    tr = HeterPipelineTrainer([SectionConfig(slow), SectionConfig(slow)])
+    t0 = time.monotonic()
+    tr.run(range(8))
+    elapsed = time.monotonic() - t0
+    assert elapsed < 8 * 0.02 * 0.9, elapsed  # overlapped, not serial
+
+
+def test_error_propagates_without_hanging():
+    def boom(x):
+        if x == 3:
+            raise ValueError("section exploded")
+        return x
+
+    tr = HeterPipelineTrainer([SectionConfig(boom)], channel_capacity=2)
+    with pytest.raises(ValueError, match="section exploded"):
+        tr.run(range(100))
+
+
+def test_heter_ctr_training_cpu_pull_tpu_train_cpu_push():
+    """The HeterPS workload shape: CPU section pulls embeddings from the
+    host table, device section runs the jitted dense step, CPU tail
+    pushes gradients back (heter_section_worker's cpu->gpu->cpu
+    program split)."""
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+    pt.seed(0)
+    table = MemorySparseTable(TableConfig(shard_num=4,
+                                          accessor_config=AccessorConfig(embedx_dim=4)))
+    dense = nn.Linear(5 * 5, 1)  # 5 slots x (1+4) dims
+    state = nn.get_state(dense)
+    opt = optimizer.SGD(0.1)
+    opt_state = opt.init(state["params"])
+    lock = threading.Lock()
+    losses = []
+
+    @jax.jit
+    def device_step(params, emb, label):
+        def f(p, e):
+            out, _ = nn.functional_call(dense, {"params": p, "buffers": {}},
+                                        e.reshape(e.shape[0], -1))
+            return jnp.mean((out[:, 0] - label) ** 2)
+        loss, (gp, ge) = jax.value_and_grad(f, argnums=(0, 1))(params, emb)
+        return loss, gp, ge
+
+    def cpu_pull(batch):
+        keys, label = batch
+        pulled = table.pull_sparse(keys.ravel())
+        emb = pulled[:, 2:].reshape(keys.shape[0], 5, 5)
+        return keys, jnp.asarray(emb), jnp.asarray(label)
+
+    def tpu_train(item):
+        nonlocal opt_state
+        keys, emb, label = item
+        with lock:  # device section is single-threaded here; lock for clarity
+            loss, gp, ge = device_step(state["params"], emb, label)
+            new_params, opt_state = opt.update(gp, opt_state, state["params"])
+            state["params"] = new_params
+            losses.append(float(loss))
+        return keys, np.asarray(ge)
+
+    def cpu_push(item):
+        keys, ge = item
+        n = keys.size
+        push = np.zeros((n, 8), np.float32)
+        push[:, 1] = 1.0
+        push[:, 3:] = ge.reshape(n, 5)[:, :5]
+        table.push_sparse(keys.ravel(), push)
+        return keys.shape[0]
+
+    rng = np.random.default_rng(0)
+    batches = []
+    for _ in range(12):
+        keys = rng.integers(1, 500, (16, 5)).astype(np.uint64)
+        label = (keys.sum(axis=1) % 2).astype(np.float32)
+        batches.append((keys, label))
+
+    tr = HeterPipelineTrainer([
+        SectionConfig(cpu_pull, place="cpu"),
+        SectionConfig(tpu_train, place="tpu"),
+        SectionConfig(cpu_push, place="cpu"),
+    ])
+    out = tr.run(batches)
+    assert out == [16] * 12
+    assert table.size() > 0
+    assert len(losses) == 12 and losses[-1] < losses[0]

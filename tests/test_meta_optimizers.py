@@ -251,3 +251,58 @@ class TestRecompute:
         x = jnp.ones((4,))
         for pol in ("full", "dots", "nothing_saveable"):
             assert np.isfinite(float(recompute(f, x, policy=pol)))
+
+
+def test_asp_24_sparsity_masks_params():
+    import jax.numpy as jnp
+
+    from paddle_tpu import optimizer
+    from paddle_tpu.distributed.meta_optimizers import ASPOptimizer
+
+    opt = ASPOptimizer(optimizer.SGD(0.1))
+    w = jnp.asarray(np.arange(1.0, 9.0).reshape(2, 4))  # rows [1..4], [5..8]
+    params = {"w": w}
+    st = opt.init(params)
+    mask = st["asp_mask"]["w"]
+    # 2:4: keep the two largest of every 4 -> cols 2,3 of each row
+    assert mask.tolist() == [[False, False, True, True]] * 2
+    g = {"w": jnp.ones_like(w)}
+    new_params, st = opt.update(g, st, params)
+    # pruned slots stay zero; kept slots took the SGD step
+    assert (np.asarray(new_params["w"])[:, :2] == 0).all()
+    np.testing.assert_allclose(np.asarray(new_params["w"])[:, 2:],
+                               np.asarray(w)[:, 2:] - 0.1)
+
+
+def test_asp_skips_unprunable_shapes():
+    import jax.numpy as jnp
+
+    from paddle_tpu import optimizer
+    from paddle_tpu.distributed.meta_optimizers import ASPOptimizer
+
+    opt = ASPOptimizer(optimizer.SGD(0.1))
+    params = {"b": jnp.ones(5), "w3": jnp.ones((2, 3))}  # bias + indivisible
+    st = opt.init(params)
+    assert st["asp_mask"]["b"].all() and st["asp_mask"]["w3"].all()
+
+
+def test_select_runtime_mapping():
+    from paddle_tpu.distributed.meta_optimizers import select_runtime
+    from paddle_tpu.distributed.strategy import DistributedStrategy
+
+    assert select_runtime(DistributedStrategy())["runtime"] == "single"
+    assert select_runtime(DistributedStrategy(a_sync=True))["runtime"] == "ps"
+    r = select_runtime(DistributedStrategy(sharding=True,
+                                           sharding_configs={"stage": 2, "sharding_degree": 4}))
+    assert r == {"runtime": "spmd", "kwargs": {"zero_stage": 2, "sharding_degree": 4}}
+    r = select_runtime(DistributedStrategy(without_graph_optimization=True))
+    assert r["runtime"] == "spmd" and r["kwargs"]["zero_stage"] == 0
+    r = select_runtime(DistributedStrategy(pipeline=True))
+    assert r["runtime"] == "hybrid" and r["kwargs"]["pp"] >= 2
+    r = select_runtime(DistributedStrategy(tensor_parallel=True,
+                                           tensor_parallel_configs={"tensor_parallel_degree": 4}))
+    assert r["runtime"] == "hybrid" and r["kwargs"]["mp"] == 4
+    r = select_runtime(DistributedStrategy(
+        hybrid_configs={"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+                        "cp_degree": 1, "ep_degree": 1}))
+    assert r["runtime"] == "hybrid"

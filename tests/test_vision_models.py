@@ -1,0 +1,91 @@
+"""VGG + MobileNet families (reference: paddle/vision/models/vgg.py,
+mobilenetv1.py, mobilenetv2.py): shape contracts, jit-ability, and a
+small training sanity check."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer
+from paddle_tpu.models import (
+    MobileNetV1,
+    MobileNetV2,
+    mobilenet_v1,
+    mobilenet_v2,
+    vgg11,
+    vgg16,
+)
+
+
+def _forward(model, hw=32, n=2):
+    state = nn.get_state(model)
+    x = jnp.zeros((n, 3, hw, hw), jnp.float32)
+
+    @jax.jit
+    def fwd(state, x):
+        out, _ = nn.functional_call(model, state, x, training=False)
+        return out
+
+    return fwd(state, x)
+
+
+def test_vgg11_shapes():
+    pt.seed(0)
+    # classifier head expects the canonical 224 input (7x7 after 5 pools)
+    out = _forward(vgg11(num_classes=10), hw=224, n=1)
+    assert out.shape == (1, 10)
+
+
+def test_vgg16_bn_shapes():
+    pt.seed(0)
+    out = _forward(vgg16(batch_norm=True, num_classes=7), hw=224, n=1)
+    assert out.shape == (1, 7)
+
+
+def test_vgg_headless():
+    pt.seed(0)
+    out = _forward(vgg11(num_classes=0, with_pool=False), hw=64)
+    assert out.shape == (2, 512, 2, 2)
+
+
+def test_mobilenet_v1_shapes_and_scale():
+    pt.seed(0)
+    assert _forward(mobilenet_v1(num_classes=10), hw=64).shape == (2, 10)
+    m = MobileNetV1(scale=0.5, num_classes=5)
+    assert _forward(m, hw=64).shape == (2, 5)
+    # width multiplier halves channel counts
+    assert m.fc.weight.shape[0] == 512
+
+
+def test_mobilenet_v2_shapes():
+    pt.seed(0)
+    assert _forward(mobilenet_v2(num_classes=10), hw=64).shape == (2, 10)
+    assert _forward(MobileNetV2(scale=0.75, num_classes=4), hw=64).shape == (2, 4)
+
+
+def test_mobilenet_v2_residual_structure():
+    m = MobileNetV2()
+    blocks = [b for b in m.features
+              if b.__class__.__name__ == "_InvertedResidual"]
+    assert len(blocks) == 17  # sum of n in the settings table
+    assert sum(b.use_res for b in blocks) == 10  # stride-1 same-ch blocks
+
+
+def test_mobilenet_trains():
+    pt.seed(0)
+    from paddle_tpu.executor import Trainer
+
+    model = MobileNetV1(scale=0.25, num_classes=4)
+    tr = Trainer(model, optimizer.Adam(2e-3), nn.functional.cross_entropy)
+    rng = np.random.default_rng(0)
+    first = last = None
+    for _ in range(12):
+        y = rng.integers(0, 4, 16)
+        x = rng.normal(0, 0.2, (16, 3, 32, 32)).astype(np.float32)
+        x[np.arange(16), 0, 0, 0] += y  # class-dependent pixel
+        loss = float(tr.train_step(x, y))
+        first = first if first is not None else loss
+        last = loss
+    assert last < first, (first, last)
