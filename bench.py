@@ -17,18 +17,77 @@ Prints exactly one JSON line.
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
+
+METRIC = "deepfm_criteo_samples_per_sec_per_chip"
+
+
+def _emit(value: float, vs_baseline: float, **extra) -> None:
+    print(json.dumps({"metric": METRIC, "value": value, "unit": "samples/s",
+                      "vs_baseline": vs_baseline, **extra}))
+
+
+def _init_backend():
+    """Initialize the device backend up front, retrying once on transient
+    init failures (round-1 failure mode: first device op hit an
+    'Unavailable' from a stale chip lock and stack-traced with no JSON).
+    Init can also HANG outright (stale grant on the axon relay after a
+    killed process), so it runs under a watchdog: if the backend does
+    not come up in BENCH_INIT_TIMEOUT seconds, emit the diagnostic JSON
+    and exit instead of eating the driver's whole time budget."""
+    import threading
+
+    import jax
+
+    deadline = float(os.environ.get("BENCH_INIT_TIMEOUT", 180))
+    result = {}
+
+    def _init():
+        last = None
+        for attempt in range(2):
+            try:
+                result["devs"] = jax.devices()
+                return
+            except Exception as e:  # noqa: BLE001 — diagnose, don't crash
+                last = e
+                if attempt == 0:  # retry once after a cooldown
+                    try:
+                        import jax._src.xla_bridge as xb
+                        xb._clear_backends()
+                    except Exception:
+                        pass
+                    time.sleep(10)
+        result["err"] = last
+
+    t = threading.Thread(target=_init, daemon=True)
+    t.start()
+    t.join(deadline)
+    if t.is_alive():
+        _emit(0.0, 0.0, error=f"backend init hung > {deadline:.0f}s "
+                              "(stale chip grant?)")
+        sys.stdout.flush()  # os._exit skips buffer flush
+        os._exit(0)
+    if "devs" not in result:
+        raise RuntimeError(
+            f"backend init failed after retry: {result.get('err')}")
+    return result["devs"]
 
 
 def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    devs = _init_backend()
+    print(f"bench: backend={devs[0].platform} devices={len(devs)}",
+          file=sys.stderr)
+
     import paddle_tpu as pt
     from paddle_tpu import optimizer
-    from paddle_tpu.models.ctr import CtrConfig, DeepFM, make_ctr_train_step
+    from paddle_tpu.models.ctr import (CtrConfig, DeepFM,
+                                       make_ctr_train_step_from_keys)
     from paddle_tpu.ps.accessor import AccessorConfig
     from paddle_tpu.ps.embedding_cache import CacheConfig, HbmEmbeddingCache
     from paddle_tpu.ps.table import MemorySparseTable, TableConfig
@@ -48,7 +107,10 @@ def main() -> None:
 
     table = MemorySparseTable(TableConfig(
         shard_num=16, accessor_config=AccessorConfig(embedx_dim=cfg.embedx_dim)))
-    cache = HbmEmbeddingCache(table, cache_cfg)
+    # device_map: the per-batch feasign→row probe runs IN-GRAPH on the
+    # pass's cuckoo table (the reference's GPU HashTable::get) — the
+    # 1-core host ships only the low-32 key halves
+    cache = HbmEmbeddingCache(table, cache_cfg, device_map=True)
 
     # pass working set: `pass_keys` distinct feasigns, slot-tagged
     pool = rng.integers(0, pass_keys // 26 + 1, size=(pass_keys, 26)).astype(np.uint64)
@@ -59,24 +121,28 @@ def main() -> None:
     opt = optimizer.Adam(learning_rate=1e-3)
     params = {"params": dict(model.named_parameters()), "buffers": {}}
     opt_state = opt.init(params)
-    step = make_ctr_train_step(model, opt, cache_cfg)
+    step = make_ctr_train_step_from_keys(model, opt, cache_cfg,
+                                         slot_ids=np.arange(26))
 
     # pre-generate host-side batches (data pipeline measured separately;
-    # the reference's dataset feed is also an async producer)
+    # the reference's dataset feed is also an async producer). Only the
+    # low-32 key halves cross the wire — slots are static columns.
     n_batches = 8
     batches = []
     for b in range(n_batches):
         idx = rng.integers(0, pass_keys, size=batch)
-        keys = pool[idx]
+        lo32 = (pool[idx] & np.uint64(0xFFFFFFFF)).astype(np.uint32)
         dense = rng.normal(size=(batch, cfg.num_dense)).astype(np.float32)
         labels = (rng.random(batch) < 0.3).astype(np.int32)
-        batches.append((keys, dense, labels))
+        batches.append((lo32, dense, labels))
+
+    map_state = cache.device_map.state
 
     def run_one(i):
-        keys, dense, labels = batches[i % n_batches]
-        rows = jnp.asarray(cache.lookup(keys.reshape(-1)).reshape(keys.shape))
-        return step(params, opt_state, cache.state, rows,
-                    jnp.asarray(dense), jnp.asarray(labels))
+        lo32, dense, labels = batches[i % n_batches]
+        return step(params, opt_state, cache.state, map_state,
+                    jnp.asarray(lo32), jnp.asarray(dense),
+                    jnp.asarray(labels))
 
     for i in range(warmup):
         params, opt_state, cache.state, loss = run_one(i)
@@ -90,13 +156,17 @@ def main() -> None:
 
     samples_per_sec = batch * steps / dt
     baseline = 1.0e6  # proxy: GPUPS-on-A100 class throughput (north star ≥2×)
-    print(json.dumps({
-        "metric": "deepfm_criteo_samples_per_sec_per_chip",
-        "value": round(samples_per_sec, 1),
-        "unit": "samples/s",
-        "vs_baseline": round(samples_per_sec / baseline, 4),
-    }))
+    _emit(round(samples_per_sec, 1), round(samples_per_sec / baseline, 4))
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        # the driver contract is ONE JSON line on stdout, always — a crash
+        # must still produce a parseable (zero-valued) record
+        _emit(0.0, 0.0, error=f"{type(e).__name__}: {e}"[:300])
+        sys.exit(0)

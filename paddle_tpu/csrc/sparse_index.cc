@@ -12,8 +12,10 @@
 // concern — the table layer shards keys so each shard is touched by one
 // thread at a time (the reference serializes per-shard via 1-thread pools).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -142,6 +144,31 @@ int64_t psidx_row_capacity(void* p) {
 void psidx_lookup(void* p, const uint64_t* keys, int64_t n, int32_t* rows) {
   PsIndex* idx = static_cast<PsIndex*>(p);
   for (int64_t i = 0; i < n; ++i) rows[i] = idx->find(keys[i]);
+}
+
+// Parallel read-only lookup (find() never mutates): the serving-path hot
+// call — one batch of B*S feasigns per train step. Thread count is the
+// caller's choice; chunks are contiguous so writes to rows[] never share
+// cache lines across threads beyond the two boundary lines.
+void psidx_lookup_mt(void* p, const uint64_t* keys, int64_t n, int32_t* rows,
+                     int32_t n_threads) {
+  PsIndex* idx = static_cast<PsIndex*>(p);
+  if (n_threads <= 1 || n < (int64_t)1 << 14) {
+    for (int64_t i = 0; i < n; ++i) rows[i] = idx->find(keys[i]);
+    return;
+  }
+  int64_t nt = std::min<int64_t>(n_threads, 64);
+  int64_t chunk = (n + nt - 1) / nt;
+  std::vector<std::thread> threads;
+  threads.reserve(nt);
+  for (int64_t t = 0; t < nt; ++t) {
+    int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    threads.emplace_back([idx, keys, rows, lo, hi] {
+      for (int64_t i = lo; i < hi; ++i) rows[i] = idx->find(keys[i]);
+    });
+  }
+  for (auto& th : threads) th.join();
 }
 
 // Returns the number of newly created rows; rows[] receives one row id per

@@ -36,15 +36,86 @@ __all__ = ["Fleet", "fleet"]
 
 
 class _FleetUtil:
-    """fleet.util (base/util_factory.py shape): host-side small-collective
-    helpers. Single-process: identity; multi-host wires to the
-    coordination service."""
+    """fleet.util (base/util_factory.py): host-side small collectives for
+    worker-side metric reduction and sync — the GlooWrapper role
+    (framework/fleet/gloo_wrapper.h:134, metrics_py.cc reduce path).
+
+    Single worker: identity. Multi-worker: a TCPStore ring (worker 0
+    hosts the daemon; ``PADDLE_UTIL_STORE_PORT`` or the first worker
+    endpoint's host pick the address) — values are exchanged as raw
+    ndarray bytes keyed per reduction round."""
+
+    _REDUCERS = {
+        "sum": lambda xs: np.sum(xs, axis=0),
+        "avg": lambda xs: np.mean(xs, axis=0),
+        "mean": lambda xs: np.mean(xs, axis=0),
+        "max": lambda xs: np.max(xs, axis=0),
+        "min": lambda xs: np.min(xs, axis=0),
+    }
+
+    def __init__(self) -> None:
+        self._store = None
+        self._rank = 0
+        self._world = 1
+        self._round = 0
+
+    def _bind(self, store, rank: int, world: int) -> None:
+        """Attach the coordination-plane store (Fleet.init_worker)."""
+        self._store = store
+        self._rank = rank
+        self._world = world
 
     def all_reduce(self, value, mode: str = "sum"):
-        return value
+        enforce(mode in self._REDUCERS, f"unknown reduce mode {mode!r}")
+        if self._store is None or self._world <= 1:
+            return value
+        import base64
+
+        arr = np.asarray(value)
+        rnd = self._round
+        self._round += 1
+        key = f"__fleet_util/ar/{rnd}"
+        payload = base64.b64encode(arr.tobytes()).decode()
+        self._store.set(f"{key}/{self._rank}",
+                        f"{arr.dtype.str}|{','.join(map(str, arr.shape))}|{payload}")
+        self._store.wait([f"{key}/{r}" for r in range(self._world)])
+        parts = []
+        for r in range(self._world):
+            dt, shp, data = self._store.get(f"{key}/{r}").split("|", 2)
+            shape = tuple(int(s) for s in shp.split(",")) if shp else ()
+            parts.append(np.frombuffer(
+                base64.b64decode(data), dtype=np.dtype(dt)).reshape(shape))
+        out = self._REDUCERS[mode](np.stack(parts))
+        # bounded store: the last rank to finish reading reaps the round's
+        # keys (it knows everyone has read — their ack precedes its own)
+        if self._store.add(f"{key}/ack", 1) == self._world:
+            for r in range(self._world):
+                self._store.delete(f"{key}/{r}")
+            self._store.delete(f"{key}/ack")
+        return out.astype(arr.dtype, copy=False)
 
     def barrier(self) -> None:
-        pass
+        if self._store is None or self._world <= 1:
+            return
+        self._store.barrier("__fleet_util", self._world)
+
+    def shutdown(self) -> None:
+        """Check out of the coordination plane. Worker 0 hosts the store
+        daemon, so it lingers until every worker has checked out —
+        otherwise its exit races in-flight RPCs from slower ranks."""
+        if self._store is None or self._world <= 1:
+            return
+        import time
+
+        self._store.add("__fleet_util/leave", 1)
+        if self._rank == 0:
+            deadline = time.time() + 60.0
+            while time.time() < deadline:
+                n = int(self._store.get("__fleet_util/leave") or 0)
+                if n >= self._world:
+                    break
+                time.sleep(0.05)
+        self._store = None
 
     def get_file_shard(self, files: List[str], worker_index: int, worker_num: int) -> List[str]:
         """Static file split across workers (util.get_file_shard)."""
@@ -225,6 +296,7 @@ class Fleet:
         self._check_init()
         if self._transport == "rpc" and self._client is None:
             self._client = self._connect_rpc()
+        self._init_util_store()
         s = self._strategy
         if s.is_geo_mode:
             self._communicator = GeoCommunicator(
@@ -238,7 +310,43 @@ class Fleet:
             self._communicator = SyncCommunicator(self._client)
         self._communicator.start()
 
+    def _init_util_store(self) -> None:
+        """Stand up the worker coordination store behind fleet.util
+        (the GlooWrapper HTTP/HDFS-store rendezvous role): worker 0
+        hosts a TCPStore daemon, everyone connects. Port from
+        ``PADDLE_UTIL_STORE_PORT``; host from the first worker endpoint
+        (localhost fallback)."""
+        import os
+
+        world = self._role_maker.worker_num()
+        if world <= 1 or not self._role_maker.is_worker():
+            return
+        port = os.environ.get("PADDLE_UTIL_STORE_PORT")
+        if port is None:
+            return  # no coordination plane configured; util stays local
+        from .collective import TCPStore
+
+        eps = self._role_maker.get_trainer_endpoints()
+        host = eps[0].split(":")[0] if eps else "127.0.0.1"
+        rank = self._role_maker.worker_index()
+        if rank == 0:
+            store = TCPStore(host=host, port=int(port), is_master=True)
+        else:  # wait for worker 0's daemon to come up
+            import time as _time
+
+            deadline = _time.time() + 60.0
+            while True:
+                try:
+                    store = TCPStore(host=host, port=int(port))
+                    break
+                except OSError:
+                    if _time.time() > deadline:
+                        raise
+                    _time.sleep(0.2)
+        self.util._bind(store, rank, world)
+
     def stop_worker(self) -> None:
+        self.util.shutdown()
         if self._communicator is not None:
             self._communicator.stop()
             self._communicator = None

@@ -118,11 +118,16 @@ class UserDefinedRoleMaker(RoleMakerBase):
         role: Role = Role.WORKER,
         worker_num: int = 1,
         server_endpoints: Optional[List[str]] = None,
+        trainer_endpoints: Optional[List[str]] = None,
     ) -> None:
         self._current_id = current_id
         self._role = role
         self._worker_num = worker_num
         self._server_endpoints = server_endpoints or []
+        self._trainer_endpoints = trainer_endpoints or []
+
+    def get_trainer_endpoints(self) -> List[str]:
+        return list(self._trainer_endpoints)
 
     def is_worker(self) -> bool:
         return self._role == Role.WORKER

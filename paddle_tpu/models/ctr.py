@@ -29,9 +29,11 @@ import numpy as np
 from .. import nn
 from ..core.enforce import enforce, enforce_eq
 from ..nn.layer import Layer
+from ..ps.device_hash import device_hash_lookup
 from ..ps.embedding_cache import CacheConfig, cache_pull, cache_push
 
-__all__ = ["CtrConfig", "DeepFM", "WideDeep", "make_ctr_train_step"]
+__all__ = ["CtrConfig", "DeepFM", "WideDeep", "make_ctr_train_step",
+           "make_ctr_train_step_from_keys"]
 
 
 @dataclasses.dataclass
@@ -129,26 +131,76 @@ def make_ctr_train_step(
 
     def step(params, opt_state, cache_state, rows, dense_x, labels):
         B, S = rows.shape
-        flat_rows = rows.reshape(-1)
+        return _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
+                              cache_state, rows.reshape(-1), B, S, dense_x,
+                              labels)
 
-        def loss_fn(params, emb):
-            out, _ = nn.functional_call(model, params, emb, dense_x,
-                                        training=True)
-            loss = nn.functional.binary_cross_entropy_with_logits(
-                out, labels.astype(jnp.float32))
-            return loss, out
+    return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
 
-        emb = cache_pull(cache_state, flat_rows).reshape(B, S, -1)
-        (loss, logits), (grads, emb_grad) = jax.value_and_grad(
-            loss_fn, argnums=(0, 1), has_aux=True)(params, emb)
 
-        new_params, new_opt = optimizer.update(grads, opt_state, params)
+def _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
+                   cache_state, flat_rows, B, S, dense_x, labels):
+    def loss_fn(params, emb):
+        out, _ = nn.functional_call(model, params, emb, dense_x,
+                                    training=True)
+        loss = nn.functional.binary_cross_entropy_with_logits(
+            out, labels.astype(jnp.float32))
+        return loss, out
 
-        shows = jnp.ones((B * S,), jnp.float32)
-        clicks = jnp.repeat(labels.astype(jnp.float32), S)
-        new_cache = cache_push(cache_state, flat_rows,
-                               emb_grad.reshape(B * S, -1), shows, clicks,
-                               cache_cfg)
-        return new_params, new_opt, new_cache, loss
+    C = cache_state["embed_w"].shape[0]
+    emb_flat = cache_pull(cache_state, flat_rows)
+    # sentinel rows (key missing from the pass working set — only the
+    # key-fed path produces them) pull ZEROS, not the clamped last row's
+    # values: silent-miss must not read another feature's embedding
+    emb_flat = jnp.where((flat_rows < C)[:, None], emb_flat, 0.0)
+    emb = emb_flat.reshape(B, S, -1)
+    (loss, logits), (grads, emb_grad) = jax.value_and_grad(
+        loss_fn, argnums=(0, 1), has_aux=True)(params, emb)
+
+    new_params, new_opt = optimizer.update(grads, opt_state, params)
+
+    shows = jnp.ones((B * S,), jnp.float32)
+    clicks = jnp.repeat(labels.astype(jnp.float32), S)
+    new_cache = cache_push(cache_state, flat_rows,
+                           emb_grad.reshape(B * S, -1), shows, clicks,
+                           cache_cfg)
+    return new_params, new_opt, new_cache, loss
+
+
+def make_ctr_train_step_from_keys(
+    model: Layer,
+    optimizer,
+    cache_cfg: CacheConfig,
+    slot_ids,
+    donate: bool = True,
+) -> Callable:
+    """GPUPS step with IN-GRAPH key lookup — the architecture the
+    reference uses on GPU (PSGPUWorker: CopyKeys then device
+    ``HashTable::get``, heter_ps/hashtable_inl.h): the host ships only the
+    low-32 halves of the slot-tagged feasigns; the key→row probe
+    (ps/device_hash.py over the pass's cuckoo table), embedding pull,
+    fwd/bwd, dense update, and CTR AdaGrad push all compile into ONE XLA
+    program. ``slot_ids`` are the static per-column high halves
+    (key = slot_id << 32 | lo32 — the slot-tagged layout of
+    FleetWrapper::PullSparseToTensorSync inputs).
+
+    step(params, opt_state, cache_state, map_state, keys_lo, dense_x,
+         labels) → (params, opt_state, cache_state, loss)
+
+    Keys missing from the pass working set map to the capacity sentinel:
+    pushes for them are dropped; pulls clamp (pass protocol guarantees
+    batch ⊆ pass keys, matching the reference's build/serve contract).
+    """
+    slot_hi = jnp.asarray(np.asarray(slot_ids, np.uint32))[None, :]
+
+    def step(params, opt_state, cache_state, map_state, keys_lo, dense_x,
+             labels):
+        B, S = keys_lo.shape
+        hi = jnp.broadcast_to(slot_hi, (B, S)).reshape(-1)
+        rows = device_hash_lookup(map_state, hi, keys_lo.reshape(-1))
+        C = cache_state["embed_w"].shape[0]
+        rows = jnp.where(rows >= 0, rows, C)
+        return _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
+                              cache_state, rows, B, S, dense_x, labels)
 
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())

@@ -156,6 +156,17 @@ class PipelineTrainer:
 
     Mirrors the role of PipelineTrainer/SectionWorker: owns stage state,
     runs fwd+bwd+update as one jitted SPMD program per step.
+
+    ``schedule``:
+    - ``"f_then_b"`` — all forwards then all backwards (autodiff through
+      the forward scan; activation memory O(num_micro) per rank). The
+      SectionWorker F-then-B program (section_worker.cc:92-138).
+    - ``"1f1b"`` — one-forward-one-backward with a bounded 2S-slot
+      activation stash + per-stage recompute (section_worker.cc:139-189).
+    - ``"interleave"`` — Megatron-style interleaved 1F1B with
+      ``num_virtual`` chunks per rank (pipeline_parallel.py:30 dygraph
+      interleave); model must supply ``pp × num_virtual`` stages and
+      num_micro must divide by the pp size.
     """
 
     def __init__(
@@ -167,20 +178,27 @@ class PipelineTrainer:
         num_micro: int,
         pp_axis: str = "pp",
         seed: int = 0,
+        schedule: str = "f_then_b",
+        num_virtual: int = 1,
     ) -> None:
         enforce(pp_axis in mesh.shape, f"mesh lacks {pp_axis!r} axis")
-        enforce_eq(mesh.shape[pp_axis], model.num_stages, "stages must equal pp size")
+        enforce(schedule in ("f_then_b", "1f1b", "interleave"),
+                f"unknown schedule {schedule!r}")
+        V = num_virtual if schedule == "interleave" else 1
+        enforce_eq(mesh.shape[pp_axis] * V, model.num_stages,
+                   "stages must equal pp size × num_virtual")
         self.model = model
         self.mesh = mesh
         self.num_micro = num_micro
         self.optimizer = optimizer
+        self.schedule = schedule
 
         stacked = model.stage_stacked_state()
         aux = model.aux_state()
         self._params = {"stages": stacked, "aux": aux}
         self.opt_state = optimizer.init(self._params)
 
-        S = model.num_stages
+        S = model.num_stages // V
 
         def stage_apply(state, x):
             out, _ = nn.functional_call(model.stages[0], state, x, training=True)
@@ -198,36 +216,89 @@ class PipelineTrainer:
             out, _ = nn.functional_call(model._sub_layers["head"], state, y, training=True)
             return out
 
-        pipe = pipeline_spmd_fn(
-            stage_apply, S, num_micro, pp_axis,
-            embed_apply if aux.get("embed") else None,
-            head_apply if aux.get("head") else None,
-        )
+        if schedule == "f_then_b":
+            pipe = pipeline_spmd_fn(
+                stage_apply, S, num_micro, pp_axis,
+                embed_apply if aux.get("embed") else None,
+                head_apply if aux.get("head") else None,
+            )
 
-        def spmd_loss(params, x_micro, y_micro, rng):
-            # distinct stochastic streams per pipeline stage
-            key = jax.random.fold_in(rng, lax.axis_index(pp_axis))
-            with nn.rng_guard(key):
-                preds = pipe(params["stages"], params["aux"], x_micro)
-            # mean over micro-batches of per-micro loss
-            losses = jax.vmap(loss_fn)(preds, y_micro)
-            return jnp.mean(losses)
+            def spmd_loss(params, x_micro, y_micro, rng):
+                # distinct stochastic streams per pipeline stage
+                key = jax.random.fold_in(rng, lax.axis_index(pp_axis))
+                with nn.rng_guard(key):
+                    preds = pipe(params["stages"], params["aux"], x_micro)
+                # mean over micro-batches of per-micro loss
+                losses = jax.vmap(loss_fn)(preds, y_micro)
+                return jnp.mean(losses)
 
-        stage_specs = jax.tree_util.tree_map(lambda _: P(pp_axis), stacked)
-        aux_specs = jax.tree_util.tree_map(lambda _: P(), aux)
-        param_specs = {"stages": stage_specs, "aux": aux_specs}
+            stage_specs = jax.tree_util.tree_map(lambda _: P(pp_axis), stacked)
+            aux_specs = jax.tree_util.tree_map(lambda _: P(), aux)
+            param_specs = {"stages": stage_specs, "aux": aux_specs}
 
-        grad_fn = shard_map(
-            jax.value_and_grad(spmd_loss),
-            mesh=mesh,
-            in_specs=(param_specs, P(), P(), P()),
-            out_specs=(P(), param_specs),
-        )
+            grad_fn = shard_map(
+                jax.value_and_grad(spmd_loss),
+                mesh=mesh,
+                in_specs=(param_specs, P(), P(), P()),
+                out_specs=(P(), param_specs),
+            )
 
-        def step(params, opt_state, x_micro, y_micro, rng):
-            loss, grads = grad_fn(params, x_micro, y_micro, rng)
-            new_params, new_opt = optimizer.update(grads, opt_state, params)
-            return new_params, new_opt, loss
+            def step(params, opt_state, x_micro, y_micro, rng):
+                loss, grads = grad_fn(params, x_micro, y_micro, rng)
+                new_params, new_opt = optimizer.update(grads, opt_state, params)
+                return new_params, new_opt, loss
+
+        else:
+            from .pipeline_1f1b import pipeline_1f1b_fn
+
+            pipe = pipeline_1f1b_fn(
+                stage_apply, S, V, num_micro, loss_fn, pp_axis,
+                embed_apply if aux.get("embed") else None,
+                head_apply if aux.get("head") else None,
+            )
+            M = num_micro
+
+            def spmd_grad(params_vs, x_micro, y_micro, rng):
+                key = jax.random.fold_in(rng, lax.axis_index(pp_axis))
+                # local chunk view: [V, 1, ...] → [V, ...]
+                chunk_state = jax.tree_util.tree_map(
+                    lambda p: p[:, 0], params_vs["stages"])
+                with nn.rng_guard(key):
+                    loss, g_stage, g_aux = pipe(
+                        chunk_state, params_vs["aux"], x_micro, y_micro)
+                # loss/aux grads live on single ranks — replicate by psum
+                loss = lax.psum(loss, pp_axis)
+                g_aux = jax.tree_util.tree_map(
+                    lambda g: lax.psum(g, pp_axis) / M, g_aux)
+                g_stage = jax.tree_util.tree_map(
+                    lambda g: g[:, None] / M, g_stage)
+                return loss, {"stages": g_stage, "aux": g_aux}
+
+            stage_specs_vs = jax.tree_util.tree_map(
+                lambda _: P(None, pp_axis), stacked)
+            aux_specs = jax.tree_util.tree_map(lambda _: P(), aux)
+            grad_fn = shard_map(
+                spmd_grad,
+                mesh=mesh,
+                in_specs=({"stages": stage_specs_vs, "aux": aux_specs},
+                          P(), P(), P()),
+                out_specs=(P(), {"stages": stage_specs_vs, "aux": aux_specs}),
+                check_vma=False,
+            )
+
+            def step(params, opt_state, x_micro, y_micro, rng):
+                stages_vs = jax.tree_util.tree_map(
+                    lambda p: p.reshape(V, S, *p.shape[1:]),
+                    params["stages"])
+                loss, grads_vs = grad_fn(
+                    {"stages": stages_vs, "aux": params["aux"]},
+                    x_micro, y_micro, rng)
+                g_stages = jax.tree_util.tree_map(
+                    lambda g: g.reshape(V * S, *g.shape[2:]),
+                    grads_vs["stages"])
+                grads = {"stages": g_stages, "aux": grads_vs["aux"]}
+                new_params, new_opt = optimizer.update(grads, opt_state, params)
+                return new_params, new_opt, loss
 
         self._step = jax.jit(step, donate_argnums=(0, 1))
         self._rng = jax.random.key(seed)

@@ -42,19 +42,27 @@ __all__ = [
 PyTree = Any
 
 
-def shard_largest_dim(x: Any, mesh: Mesh, axis: str) -> NamedSharding:
-    """NamedSharding placing ``axis`` on the largest divisible dim of x;
-    replicated if nothing divides (small params stay replicated, like the
-    reference's minimum-size threshold for sharding segments)."""
-    n = mesh.shape[axis]
-    shape = getattr(x, "shape", ())
+def _choose_shard_dim(shape: Tuple[int, ...], n: int) -> int:
+    """Largest dim divisible by ``n`` (−1 = keep replicated). The single
+    source of truth for shard-dim choice — the stage-2 step's slicing
+    must agree with the opt-state layout this induces."""
     if n > 1 and shape:
         order = sorted(range(len(shape)), key=lambda i: -shape[i])
         for dim in order:
             if shape[dim] % n == 0 and shape[dim] >= n:
-                spec = [None] * len(shape)
-                spec[dim] = axis
-                return NamedSharding(mesh, PartitionSpec(*spec))
+                return dim
+    return -1
+
+
+def shard_largest_dim(x: Any, mesh: Mesh, axis: str) -> NamedSharding:
+    """NamedSharding placing ``axis`` on the largest divisible dim of x;
+    replicated if nothing divides (small params stay replicated, like the
+    reference's minimum-size threshold for sharding segments)."""
+    dim = _choose_shard_dim(getattr(x, "shape", ()), mesh.shape[axis])
+    if dim >= 0:
+        spec = [None] * x.ndim
+        spec[dim] = axis
+        return NamedSharding(mesh, PartitionSpec(*spec))
     return NamedSharding(mesh, PartitionSpec())
 
 
@@ -65,7 +73,10 @@ def make_sharding_rules(
     zero_stage: int = 0,
     sharding_axis: str = "sharding",
 ) -> Tuple[PyTree, PyTree]:
-    """Build (param_shardings, opt_shardings) for the given ZeRO stage."""
+    """Build (param_shardings, opt_shardings) for the given ZeRO stage.
+    (Stage 2 additionally needs explicit grad reduce-scatter collectives;
+    SpmdTrainer builds that step via shard_map — see
+    ``_build_stage2_step`` — rather than GSPMD annotations.)"""
     replicated = NamedSharding(mesh, PartitionSpec())
 
     def param_rule(x):
@@ -117,7 +128,8 @@ class SpmdTrainer:
 
         state = nn.get_state(model)
         opt_state = optimizer.init(state["params"])
-        param_sh, opt_sh = make_sharding_rules(mesh, state["params"], opt_state, zero_stage)
+        param_sh, opt_sh = make_sharding_rules(
+            mesh, state["params"], opt_state, zero_stage)
         buf_sh = jax.tree_util.tree_map(
             lambda x: NamedSharding(mesh, PartitionSpec()), state["buffers"]
         )
@@ -130,6 +142,11 @@ class SpmdTrainer:
         self.opt_state = jax.device_put(opt_state, self._opt_sh)
         self._rng = jax.random.key(seed)
         self.global_step = 0
+
+        if zero_stage == 2:
+            self._step = self._build_stage2_step(
+                model, optimizer, mesh, state, opt_state, batch_axes)
+            return
 
         def step(state, opt_state, rng, inputs, labels):
             def compute_loss(params):
@@ -156,6 +173,98 @@ class SpmdTrainer:
             out_shardings=(self._state_sh, self._opt_sh, NamedSharding(mesh, PartitionSpec())),
             donate_argnums=(0, 1),
         )
+
+    def _build_stage2_step(self, model, optimizer, mesh, state, opt_state,
+                           batch_axes):
+        """Explicit ZeRO-2 (ShardingStage2, sharding_stage2.py:43): the
+        GSPMD path cannot be trusted to emit reduce-scatter for stage-2
+        grads (XLA lowers the constrained reduction as all-reduce +
+        slice, 2× the comm), so the stage-2 step is a shard_map with the
+        collectives written out: local grads → ``psum_scatter`` onto each
+        rank's grad shard (half the bytes of all-reduce), elementwise
+        optimizer update on the local param/opt shard, ``all_gather`` of
+        the updated params. Norm-based optimizers (Lars/Lamb) see
+        per-shard norms here — same caveat as the reference's stage 2.
+        """
+        from jax import lax, shard_map
+
+        axis = "sharding"
+        K = mesh.shape[axis]
+        dp_axes = tuple(a for a in batch_axes
+                        if a != axis and a in mesh.shape and mesh.shape[a] > 1)
+        all_axes = dp_axes + ((axis,) if K > 1 else ())
+
+        dims = jax.tree_util.tree_map(
+            lambda x: _choose_shard_dim(getattr(x, "shape", ()), K),
+            state["params"])
+        param_specs = jax.tree_util.tree_map(
+            lambda _: PartitionSpec(), state["params"])
+        opt_specs = jax.tree_util.tree_map(lambda s: s.spec, self._opt_sh)
+        batch_spec = self._batch_sh.spec
+
+        def inner(state, opt_state, rng, inputs, labels):
+            params, buffers = state["params"], state["buffers"]
+            key = rng
+            for i, a in enumerate(all_axes):
+                key = jax.random.fold_in(key, lax.axis_index(a))
+
+            def compute_loss(params):
+                out, new_state = nn.functional_call(
+                    model, {"params": params, "buffers": buffers},
+                    *inputs, rng=key, training=True)
+                loss = self.loss_fn(out, *labels)
+                scaled = (optimizer.scale_loss(loss, opt_state)
+                          if hasattr(optimizer, "scale_loss") else loss)
+                return scaled, (loss, new_state["buffers"])
+
+            (_, (loss, new_buffers)), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(params)
+
+            def rs(g, d):
+                # mean over the batch shards; reduce-scatter over `axis`
+                for a in dp_axes:
+                    g = lax.pmean(g, a)
+                if d < 0 or K == 1:
+                    return lax.pmean(g, axis) if K > 1 else g
+                return lax.psum_scatter(g, axis, scatter_dimension=d,
+                                        tiled=True) / K
+
+            g_shard = jax.tree_util.tree_map(rs, grads, dims)
+
+            def my_slice(p, d):
+                if d < 0 or K == 1:
+                    return p
+                size = p.shape[d] // K
+                return lax.dynamic_slice_in_dim(
+                    p, lax.axis_index(axis) * size, size, d)
+
+            p_shard = jax.tree_util.tree_map(my_slice, params, dims)
+            new_p_shard, new_opt = optimizer.update(g_shard, opt_state, p_shard)
+
+            def gather(p, d):
+                if d < 0 or K == 1:
+                    return p
+                return lax.all_gather(p, axis, axis=d, tiled=True)
+
+            new_params = jax.tree_util.tree_map(gather, new_p_shard, dims)
+            loss = lax.pmean(loss, all_axes) if all_axes else loss
+            new_buffers = jax.tree_util.tree_map(
+                lambda b: lax.pmean(b, all_axes) if all_axes and
+                getattr(b, "dtype", None) in (jnp.float32, jnp.bfloat16)
+                else b, new_buffers)
+            return {"params": new_params, "buffers": new_buffers}, new_opt, loss
+
+        buf_specs = jax.tree_util.tree_map(
+            lambda _: PartitionSpec(), state["buffers"])
+        state_specs = {"params": param_specs, "buffers": buf_specs}
+        shmapped = shard_map(
+            inner, mesh=mesh,
+            in_specs=(state_specs, opt_specs, PartitionSpec(),
+                      batch_spec, batch_spec),
+            out_specs=(state_specs, opt_specs, PartitionSpec()),
+            check_vma=False,
+        )
+        return jax.jit(shmapped, donate_argnums=(0, 1))
 
     def train_step(self, inputs, labels) -> jax.Array:
         if not isinstance(inputs, (tuple, list)):

@@ -64,62 +64,66 @@ def cache_push(
     clicks: jax.Array,  # [n]
     cfg: CacheConfig,
 ) -> Dict[str, jax.Array]:
-    """In-graph push: merge duplicate rows (the cub sort+reduce merge_grad
-    step becomes scatter-add), then apply the per-feature AdaGrad rule
-    (optimizer.cuh.h:35-70 / sparse_sgd_rule AdaGrad) on touched rows.
+    """In-graph push, batch-scaled: dedup duplicate rows inside the batch
+    (the cub sort+reduce merge_grad step, heter_comm_inl.h:388, becomes
+    sorted-unique + segment-sum), then gather the touched rows, apply the
+    per-feature AdaGrad rule (optimizer.cuh.h:35-70 / sparse_sgd_rule
+    AdaGrad) and scatter only those rows back. Per-step HBM traffic is
+    O(batch·dim), independent of cache capacity.
 
     All dense ops — fuses into the train step program.
     """
+    n = rows.shape[0]
     C = state["embed_w"].shape[0]
     sgd = cfg.sgd
 
-    # merge duplicates: scatter-add grads/shows onto per-row buckets
-    touched = jnp.zeros((C,), jnp.float32).at[rows].add(1.0)
-    show_sum = jnp.zeros((C,), jnp.float32).at[rows].add(shows)
-    click_sum = jnp.zeros((C,), jnp.float32).at[rows].add(clicks)
-    g_embed = jnp.zeros((C, 1), jnp.float32).at[rows].add(grads[:, :1])
-    g_embedx = jnp.zeros((C, cfg.embedx_dim), jnp.float32).at[rows].add(grads[:, 1:])
+    # merge_grad: in-batch dedup. `uniq` is the (padded) set of distinct
+    # rows; padding slots get sentinel C and are dropped at scatter time.
+    uniq, inv = jnp.unique(rows, size=n, fill_value=C, return_inverse=True)
+    inv = inv.reshape(-1)
+    show_sum = jax.ops.segment_sum(shows, inv, num_segments=n)
+    click_sum = jax.ops.segment_sum(clicks, inv, num_segments=n)
+    g = jax.ops.segment_sum(grads, inv, num_segments=n)  # [n, 1+dim]
+    srows = jnp.where(uniq < C, uniq, 0)  # safe gather index for padding
 
-    is_touched = touched > 0
+    show_rows = state["show"][srows] + show_sum
+    click_rows = state["click"][srows] + click_sum
     scale = jnp.maximum(show_sum, 1e-10)
 
-    new_show = state["show"] + show_sum
-    new_click = state["click"] + click_sum
-
-    def adagrad(w, g2, g):
-        scaled = g / scale[:, None]
+    def adagrad(w, g2, g_rows):  # [n,d], [n,1], [n,d] — touched rows only
+        scaled = g_rows / scale[:, None]
         ratio = jnp.sqrt(sgd.initial_g2sum / (sgd.initial_g2sum + g2))
         w_new = w - sgd.learning_rate * scaled * ratio
         w_new = jnp.clip(w_new, sgd.weight_bounds[0], sgd.weight_bounds[1])
         g2_new = g2 + jnp.mean(scaled * scaled, axis=1, keepdims=True)
-        return (
-            jnp.where(is_touched[:, None], w_new, w),
-            jnp.where(is_touched[:, None], g2_new, g2),
-        )
+        return w_new, g2_new
 
-    embed_w, embed_g2 = adagrad(state["embed_w"], state["embed_g2sum"], g_embed)
+    embed_w_rows, embed_g2_rows = adagrad(
+        state["embed_w"][srows], state["embed_g2sum"][srows], g[:, :1])
 
     # lazy embedx (mf) creation: materialize once the show/click score
     # crosses the threshold (optimizer.cuh.h:81-94; deterministic zero
     # init here — curand-uniform init is a per-row RNG; zeros match the
     # reference's mean and keep the step deterministic)
-    score = (new_show - new_click) * cfg.nonclk_coeff + new_click * cfg.click_coeff
-    had_mf = state["has_embedx"] > 0
-    create = (~had_mf) & (score >= cfg.embedx_threshold) & is_touched
-    has_mf_new = jnp.where(create, 1.0, state["has_embedx"])
-    update_mf = had_mf & is_touched
-    embedx_w, embedx_g2 = adagrad(state["embedx_w"], state["embedx_g2sum"], g_embedx)
-    embedx_w = jnp.where(update_mf[:, None], embedx_w, state["embedx_w"])
-    embedx_g2 = jnp.where(update_mf[:, None], embedx_g2, state["embedx_g2sum"])
+    score = (show_rows - click_rows) * cfg.nonclk_coeff + click_rows * cfg.click_coeff
+    had_mf = state["has_embedx"][srows] > 0
+    create = (~had_mf) & (score >= cfg.embedx_threshold)
+    has_rows = jnp.where(create, 1.0, state["has_embedx"][srows])
+    ex_w_old = state["embedx_w"][srows]
+    ex_g2_old = state["embedx_g2sum"][srows]
+    ex_w_new, ex_g2_new = adagrad(ex_w_old, ex_g2_old, g[:, 1:])
+    ex_w_rows = jnp.where(had_mf[:, None], ex_w_new, ex_w_old)
+    ex_g2_rows = jnp.where(had_mf[:, None], ex_g2_new, ex_g2_old)
 
+    drop = dict(mode="drop")  # padding rows (sentinel C) fall away
     return {
-        "show": new_show,
-        "click": new_click,
-        "embed_w": embed_w,
-        "embed_g2sum": embed_g2,
-        "embedx_w": embedx_w,
-        "embedx_g2sum": embedx_g2,
-        "has_embedx": has_mf_new,
+        "show": state["show"].at[uniq].set(show_rows, **drop),
+        "click": state["click"].at[uniq].set(click_rows, **drop),
+        "embed_w": state["embed_w"].at[uniq].set(embed_w_rows, **drop),
+        "embed_g2sum": state["embed_g2sum"].at[uniq].set(embed_g2_rows, **drop),
+        "embedx_w": state["embedx_w"].at[uniq].set(ex_w_rows, **drop),
+        "embedx_g2sum": state["embedx_g2sum"].at[uniq].set(ex_g2_rows, **drop),
+        "has_embedx": state["has_embedx"].at[uniq].set(has_rows, **drop),
     }
 
 
@@ -138,6 +142,9 @@ class HbmEmbeddingCache:
         table: MemorySparseTable,
         config: Optional[CacheConfig] = None,
         sharding=None,
+        mesh=None,
+        axis: str = "ps",
+        device_map: bool = False,
     ) -> None:
         self.table = table
         self.config = config or CacheConfig(
@@ -148,9 +155,34 @@ class HbmEmbeddingCache:
             "cache embedx_dim must match table",
         )
         self._sharding = sharding
+        self._n_shards = 1
+        if mesh is not None:
+            # row-shard the working set over `axis` (HeterComm-style
+            # multi-chip serving, ps/sharded_cache.py); lookup() then
+            # returns GLOBAL spread row ids for sharded_cache_pull/push
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._sharding = NamedSharding(mesh, PartitionSpec(axis))
+            self._n_shards = int(mesh.shape[axis])
+            enforce(
+                self.config.capacity % self._n_shards == 0,
+                "cache capacity must divide evenly over the shard axis",
+            )
         self._index: Optional[FeasignIndex] = None
         self.state: Optional[Dict[str, jax.Array]] = None
         self._pass_keys: Optional[np.ndarray] = None
+        self._device_map_enabled = device_map
+        #: per-pass in-HBM key→row map (ps/device_hash.py; the reference's
+        #: GPU HashTable) — set by begin_pass when device_map=True
+        self.device_map = None
+
+    def _spread(self, rows: np.ndarray) -> np.ndarray:
+        """Dense index rows → shard-balanced block-partition positions."""
+        if self._n_shards == 1:
+            return rows
+        from .sharded_cache import shard_spread_rows
+
+        return shard_spread_rows(rows, self.config.capacity, self._n_shards)
 
     # -- pass lifecycle ---------------------------------------------------
 
@@ -162,6 +194,7 @@ class HbmEmbeddingCache:
         enforce_le(len(uniq), cfg.capacity, "pass working set exceeds cache capacity")
         self._index = FeasignIndex(len(uniq) * 2)
         rows, _ = self._index.lookup_or_insert(uniq)
+        rows = self._spread(rows)
         self._pass_keys = uniq
 
         # pull from host table (insert-on-miss: new features get created)
@@ -184,6 +217,11 @@ class HbmEmbeddingCache:
         host["has_embedx"][rows] = (np.abs(pulled[:, 3:]).sum(axis=1) > 0).astype(np.float32)
         # g2sum state comes from the table's accessor state where present
         self._load_g2sum(host, uniq, rows)
+
+        if self._device_map_enabled:
+            from .device_hash import DeviceKeyMap
+
+            self.device_map = DeviceKeyMap(uniq, rows)
 
         if self._sharding is not None:
             self.state = {
@@ -210,7 +248,7 @@ class HbmEmbeddingCache:
         enforce(self._index is not None, "begin_pass first")
         rows = self._index.lookup(np.ascontiguousarray(keys, np.uint64))
         enforce(bool((rows >= 0).all()), "batch contains keys outside the pass working set")
-        return rows
+        return self._spread(rows)
 
     def end_pass(self) -> None:
         """EndPass / dump_to_cpu: write the working set back into the host
@@ -219,7 +257,7 @@ class HbmEmbeddingCache:
             return
         host = {k: np.asarray(v) for k, v in jax.device_get(self.state).items()}
         keys = self._pass_keys
-        rows = self._index.lookup(keys)
+        rows = self._spread(self._index.lookup(keys))
         acc = self.table.accessor
         es = acc.embed_rule.state_dim
         xd = acc.config.embedx_dim
@@ -258,3 +296,4 @@ class HbmEmbeddingCache:
         self._index = None
         self.state = None
         self._pass_keys = None
+        self.device_map = None

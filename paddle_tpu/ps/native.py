@@ -68,6 +68,9 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.psidx_row_capacity.restype = ctypes.c_int64
     lib.psidx_row_capacity.argtypes = [ctypes.c_void_p]
     lib.psidx_lookup.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64, i32p]
+    if hasattr(lib, "psidx_lookup_mt"):
+        lib.psidx_lookup_mt.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64,
+                                        i32p, ctypes.c_int32]
     lib.psidx_lookup_or_insert.restype = ctypes.c_int64
     lib.psidx_lookup_or_insert.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64, i32p]
     lib.psidx_erase.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64]
@@ -76,6 +79,39 @@ def _configure(lib: ctypes.CDLL) -> None:
 
 def native_available() -> bool:
     return load_native() is not None
+
+
+def cuckoo_build(keys: np.ndarray, rows: np.ndarray, nbuckets: int,
+                 seed: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Build a static bucketized-cuckoo table (csrc/cuckoo.cc) mapping
+    uint64 feasign → int32 row; returns (hi, lo, row) arrays of shape
+    [nbuckets*4] for upload to HBM (ps/device_hash.py probes them
+    in-graph). Raises RuntimeError if the native lib is unavailable or
+    the build fails (caller retries with a new seed)."""
+    lib = load_native()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    if not getattr(lib, "_cuckoo_configured", False):
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        lib.cuckoo_build.restype = ctypes.c_int64
+        lib.cuckoo_build.argtypes = [u64p, i32p, ctypes.c_int64,
+                                     ctypes.c_int64, ctypes.c_uint32,
+                                     u32p, u32p, i32p]
+        lib._cuckoo_configured = True
+    keys = np.ascontiguousarray(keys, np.uint64)
+    rows = np.ascontiguousarray(rows, np.int32)
+    hi = np.empty(nbuckets * 4, np.uint32)
+    lo = np.empty(nbuckets * 4, np.uint32)
+    row = np.empty(nbuckets * 4, np.int32)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    fails = int(lib.cuckoo_build(
+        _u64(keys), _i32(rows), len(keys), nbuckets, ctypes.c_uint32(seed),
+        hi.ctypes.data_as(u32p), lo.ctypes.data_as(u32p), _i32(row)))
+    if fails:
+        raise RuntimeError(f"cuckoo build failed to place {fails} keys")
+    return hi, lo, row
 
 
 def _u64(a: np.ndarray):
@@ -120,7 +156,12 @@ class FeasignIndex:
         keys = np.ascontiguousarray(keys, np.uint64)
         rows = np.empty(len(keys), np.int32)
         if self._lib is not None:
-            self._lib.psidx_lookup(self._h, _u64(keys), len(keys), _i32(rows))
+            if hasattr(self._lib, "psidx_lookup_mt"):
+                nt = min(8, os.cpu_count() or 1)
+                self._lib.psidx_lookup_mt(self._h, _u64(keys), len(keys),
+                                          _i32(rows), nt)
+            else:
+                self._lib.psidx_lookup(self._h, _u64(keys), len(keys), _i32(rows))
         else:
             for i, k in enumerate(keys):
                 rows[i] = self._d.get(int(k), -1)

@@ -1,0 +1,179 @@
+"""Multi-chip sharded embedding serving.
+
+TPU-native rebuild of HeterComm's multi-GPU sharded KV serving
+(`/root/reference/paddle/fluid/framework/fleet/heter_ps/heter_comm_inl.h`):
+the reference routes each key to its owner GPU (`calc_shard_index`,
+`split_input_to_shard` :441), walks values through p2p staging buffers
+(`walk_to_dest` :207), and serves `pull_sparse` :479 / `push_sparse` :575
+against per-GPU hash tables. Here the cache state is a jax array sharded
+over a mesh axis (rows block-partitioned into HBM shards) and the routing
+runs *inside* the compiled step over ICI:
+
+- **pull** (`sharded_cache_pull`): all_gather the batch's global row ids
+  over the shard axis, each shard gathers the rows it owns (others
+  contribute zeros — each row has exactly one owner, so a
+  ``psum_scatter`` both sums the one-hot contributions and returns each
+  device its own batch slice. Two collectives, both compiler-scheduled
+  on ICI; the walk_to_dest p2p hop count is matched, not interpreted.
+- **push** (`sharded_cache_push`): all_gather (rows, grads, show, click),
+  then every shard runs the normal batch-scaled ``cache_push`` with
+  non-owned rows mapped to the out-of-range sentinel, which the scatter
+  drops (`mode="drop"`) — the merge_grad dedup (heter_comm_inl.h:388)
+  happens per shard on exactly the rows it owns.
+
+Bit-for-bit parity with the single-device cache: all_gather(tiled)
+reassembles the global batch in original order, so per-row segment sums
+accumulate in the same order as the unsharded push, and each row's
+AdaGrad math runs once on its owner shard with identical inputs.
+
+Host side, ``shard_spread_rows`` round-robins the dense row ids the
+FeasignIndex allocates across the block partition so hot passes fill all
+shards evenly (the `key % total_gpu` placement of calc_shard_index,
+expressed as a row permutation instead of a hash).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import nn
+from ..core.enforce import enforce, enforce_eq
+from .embedding_cache import CacheConfig, cache_pull, cache_push
+
+__all__ = [
+    "sharded_cache_pull",
+    "sharded_cache_push",
+    "shard_spread_rows",
+    "shard_unspread_rows",
+    "make_sharded_ctr_train_step",
+]
+
+Axis = Union[str, Tuple[str, ...]]
+
+
+def _axis_size(axis: Axis) -> jax.Array:
+    return lax.psum(1, axis)
+
+
+def sharded_cache_pull(state: Dict[str, jax.Array], rows: jax.Array,
+                       axis: Axis) -> jax.Array:
+    """Inside shard_map: pull [m, 1+dim] values for this device's batch
+    slice ``rows`` (global row ids, [m]) from the row-sharded cache.
+
+    HeterComm pull_sparse (heter_comm_inl.h:479) analogue: gather-where-
+    owned + psum_scatter replaces split_input_to_shard + p2p walk.
+    """
+    shard_rows = state["embed_w"].shape[0]  # local block size
+    my_start = lax.axis_index(axis) * shard_rows
+    rows_all = lax.all_gather(rows, axis, tiled=True)  # [m*K], global order
+    loc = rows_all - my_start
+    own = (loc >= 0) & (loc < shard_rows)
+    vals = cache_pull(state, jnp.clip(loc, 0, shard_rows - 1))
+    vals = jnp.where(own[:, None], vals, 0.0)
+    # each row has exactly one owner → sum assembles, scatter returns my slice
+    return lax.psum_scatter(vals, axis, scatter_dimension=0, tiled=True)
+
+
+def sharded_cache_push(
+    state: Dict[str, jax.Array],
+    rows: jax.Array,   # [m] global row ids for this device's batch slice
+    grads: jax.Array,  # [m, 1+dim]
+    shows: jax.Array,  # [m]
+    clicks: jax.Array,  # [m]
+    cfg: CacheConfig,
+    axis: Axis,
+) -> Dict[str, jax.Array]:
+    """Inside shard_map: push the batch's gradients into the row-sharded
+    cache (HeterComm push_sparse, heter_comm_inl.h:575). Each shard runs
+    the batch-scaled merge+AdaGrad (`cache_push`) on the full gathered
+    batch with non-owned rows mapped to the dropped sentinel."""
+    shard_rows = state["embed_w"].shape[0]
+    my_start = lax.axis_index(axis) * shard_rows
+    rows_all = lax.all_gather(rows, axis, tiled=True)
+    grads_all = lax.all_gather(grads, axis, tiled=True)
+    shows_all = lax.all_gather(shows, axis, tiled=True)
+    clicks_all = lax.all_gather(clicks, axis, tiled=True)
+    loc = rows_all - my_start
+    own = (loc >= 0) & (loc < shard_rows)
+    loc = jnp.where(own, loc, shard_rows)  # sentinel → dropped in cache_push
+    return cache_push(state, loc, grads_all, shows_all, clicks_all, cfg)
+
+
+def shard_spread_rows(rows: np.ndarray, capacity: int, n_shards: int) -> np.ndarray:
+    """Host-side: permute dense row ids (0,1,2,…) round-robin across the
+    block partition so shard s owns rows {r : r % n_shards == s} at block
+    offset r // n_shards (calc_shard_index's `key % total_gpu` placement
+    as a permutation). Requires capacity % n_shards == 0."""
+    block = capacity // n_shards
+    return (rows % n_shards) * block + rows // n_shards
+
+
+def shard_unspread_rows(rows: np.ndarray, capacity: int, n_shards: int) -> np.ndarray:
+    """Inverse of shard_spread_rows."""
+    block = capacity // n_shards
+    return (rows % block) * n_shards + rows // block
+
+
+def make_sharded_ctr_train_step(
+    model,
+    optimizer,
+    cache_cfg: CacheConfig,
+    mesh: Mesh,
+    axis: str = "ps",
+    donate: bool = True,
+) -> Callable:
+    """Multi-chip GPUPS step: the CTR step of models/ctr.py with the
+    batch data-parallel over ``axis`` and the embedding cache row-sharded
+    over the same devices — pull/push become in-graph all-to-all traffic
+    (PSGPUWorker::TrainFiles + HeterComm serving, compiled).
+
+    step(params, opt_state, cache_state, rows, dense_x, labels)
+      → (params, opt_state, cache_state, loss)
+
+    ``rows`` are GLOBAL spread row ids ([B, S], from
+    ``HbmEmbeddingCache.lookup`` of a mesh-sharded cache); params/opt
+    replicated, grads averaged over ``axis`` (the Reducer/allreduce role).
+    """
+    K = mesh.shape[axis]
+
+    def inner(params, opt_state, cache_state, rows, dense_x, labels):
+        B, S = rows.shape  # local slice
+        flat = rows.reshape(-1)
+        emb = sharded_cache_pull(cache_state, flat, axis).reshape(B, S, -1)
+
+        def loss_fn(params, emb):
+            out, _ = nn.functional_call(model, params, emb, dense_x,
+                                        training=True)
+            loss = nn.functional.binary_cross_entropy_with_logits(
+                out, labels.astype(jnp.float32))
+            return loss, out
+
+        (loss, _), (grads, emb_grad) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True)(params, emb)
+        # local-mean → global-mean: pmean dense grads; scale emb grads by
+        # 1/K (exact for power-of-two K) so push matches the unsharded step
+        grads = jax.tree.map(lambda g: lax.pmean(g, axis), grads)
+        emb_grad = emb_grad / K
+        loss = lax.pmean(loss, axis)
+
+        new_params, new_opt = optimizer.update(grads, opt_state, params)
+        shows = jnp.ones((B * S,), jnp.float32)
+        clicks = jnp.repeat(labels.astype(jnp.float32), S)
+        new_cache = sharded_cache_push(cache_state, flat,
+                                       emb_grad.reshape(B * S, -1), shows,
+                                       clicks, cache_cfg, axis)
+        return new_params, new_opt, new_cache, loss
+
+    shmapped = shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis)),
+        out_specs=(P(), P(), P(axis), P()),
+        check_vma=False,
+    )
+    return jax.jit(shmapped, donate_argnums=(0, 1, 2) if donate else ())

@@ -22,6 +22,42 @@ jax.config.update("jax_num_cpu_devices", 8)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Measured >5s each on the 1-core CI host (round-2 --durations run); the
+# default gate (pytest.ini addopts) excludes them — run all with -m "".
+_SLOW = {
+    "test_tp_grads_match_serial",
+    "test_moe_ep_matches_serial",
+    "test_causal_cp_matches_serial",
+    "test_cp_matches_serial",
+    "test_tp_matches_serial",
+    "test_mobilenet_v2_shapes",
+    "test_vgg11_shapes",
+    "test_mobilenet_trains",
+    "test_mobilenet_v1_shapes_and_scale",
+    "test_vgg16_bn_shapes",
+    "test_resnet50_forward_shape",
+    "test_resnet18_trains",
+    "test_multiprocess_cluster",
+    "test_fleet_rpc_cluster",
+    "test_ring_attention_backward_matches_full",
+    "test_ring_attention_matches_full",
+    "test_hybrid_moe_runs",
+    "test_hybrid_loss_decreases",
+    "test_hybrid_first_loss_matches_serial",
+    "test_moe_single_rank_runs_and_grads",
+    "test_moe_expert_parallel_matches_single_rank",
+    "test_lenet_forward_and_one_step",
+    "test_pipeline_training_matches_serial",
+    "test_launch_local_trainers",
+    "test_launch_propagates_failure",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.name.split("[")[0] in _SLOW:
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture
 def rng():

@@ -1,0 +1,95 @@
+"""Device-resident cuckoo key→row map (ps/device_hash.py + csrc/cuckoo.cc)
+— the GPU HashTable::get analogue (heter_ps/hashtable_inl.h) probed
+in-graph; and the key-fed CTR step that fuses the probe into the program.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu import optimizer
+from paddle_tpu.models.ctr import (CtrConfig, DeepFM, make_ctr_train_step,
+                                   make_ctr_train_step_from_keys)
+from paddle_tpu.ps.accessor import AccessorConfig
+from paddle_tpu.ps.device_hash import DeviceKeyMap, split_keys
+from paddle_tpu.ps.embedding_cache import CacheConfig, HbmEmbeddingCache
+from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+
+def test_device_map_exact_and_missing(rng):
+    keys = np.unique(rng.integers(1, 1 << 62, size=5000, dtype=np.uint64))
+    rows = rng.permutation(len(keys)).astype(np.int32)
+    m = DeviceKeyMap(keys, rows)
+
+    batch = keys[rng.integers(0, len(keys), size=2000)]
+    got = np.asarray(m.lookup(*[jnp.asarray(a) for a in split_keys(batch)]))
+    want = rows[np.searchsorted(keys, batch)]
+    np.testing.assert_array_equal(got, want)
+
+    miss = rng.integers(1 << 62, 1 << 63, size=500, dtype=np.uint64)
+    got = np.asarray(m.lookup(*[jnp.asarray(a) for a in split_keys(miss)]))
+    assert (got == -1).all()
+
+
+def test_device_map_low_bit_keys(rng):
+    # hi half all zeros (plain small ids) must still disambiguate
+    keys = np.unique(rng.integers(1, 1 << 30, size=4096, dtype=np.uint64))
+    rows = np.arange(len(keys), dtype=np.int32)
+    m = DeviceKeyMap(keys, rows)
+    got = np.asarray(m.lookup(*[jnp.asarray(a) for a in split_keys(keys)]))
+    np.testing.assert_array_equal(got, rows)
+
+
+def test_key_fed_step_matches_row_fed(rng):
+    """The in-graph lookup step produces the identical trajectory to the
+    host-lookup step (same rows → same math)."""
+    S, dim = 6, 4
+    ccfg = CtrConfig(num_sparse_slots=S, num_dense=3, embedx_dim=dim,
+                     dnn_hidden=(16,))
+    cache_cfg = CacheConfig(capacity=1 << 11, embedx_dim=dim,
+                            embedx_threshold=0.0)
+    n_keys, batch = 200, 16
+    # slot-tagged keys: hi = column slot id
+    lo = rng.integers(0, 1 << 20, size=(n_keys, S)).astype(np.uint64)
+    pool = lo + (np.arange(S, dtype=np.uint64) << np.uint64(32))
+
+    def build():
+        pt.seed(0)
+        table = MemorySparseTable(TableConfig(
+            shard_num=4, accessor_config=AccessorConfig(embedx_dim=dim)))
+        cache = HbmEmbeddingCache(table, cache_cfg, device_map=True)
+        cache.begin_pass(pool.reshape(-1))
+        model = DeepFM(ccfg)
+        opt = optimizer.Adam(learning_rate=1e-3)
+        params = {"params": dict(model.named_parameters()), "buffers": {}}
+        return table, cache, model, opt, params, opt.init(params)
+
+    idx = rng.integers(0, n_keys, size=(3, batch))
+    dense = rng.normal(size=(3, batch, 3)).astype(np.float32)
+    labels = (rng.random((3, batch)) < 0.4).astype(np.int32)
+
+    # row-fed reference
+    table1, cache1, model1, opt1, params1, opt_state1 = build()
+    step1 = make_ctr_train_step(model1, opt1, cache_cfg, donate=False)
+    for t in range(3):
+        keys = pool[idx[t]]
+        rows = jnp.asarray(cache1.lookup(keys.reshape(-1)).reshape(keys.shape))
+        params1, opt_state1, cache1.state, loss1 = step1(
+            params1, opt_state1, cache1.state, rows,
+            jnp.asarray(dense[t]), jnp.asarray(labels[t]))
+
+    # key-fed
+    table2, cache2, model2, opt2, params2, opt_state2 = build()
+    step2 = make_ctr_train_step_from_keys(model2, opt2, cache_cfg,
+                                          slot_ids=np.arange(S), donate=False)
+    for t in range(3):
+        lo32 = (pool[idx[t]] & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        params2, opt_state2, cache2.state, loss2 = step2(
+            params2, opt_state2, cache2.state, cache2.device_map.state,
+            jnp.asarray(lo32), jnp.asarray(dense[t]), jnp.asarray(labels[t]))
+
+    np.testing.assert_array_equal(np.asarray(loss1), np.asarray(loss2))
+    for k in cache1.state:
+        np.testing.assert_array_equal(
+            np.asarray(cache1.state[k]), np.asarray(cache2.state[k]),
+            err_msg=f"cache[{k}]")

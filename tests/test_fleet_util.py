@@ -1,0 +1,80 @@
+"""fleet.util multi-worker collectives over the TCPStore coordination
+plane (the GlooWrapper reduce role, framework/fleet/gloo_wrapper.h:134 +
+metrics_py.cc): subprocess workers must see the true global reduction,
+not their local values.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax; jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.distributed.fleet import Fleet
+    from paddle_tpu.distributed.role_maker import UserDefinedRoleMaker, Role
+
+    rank = int(sys.argv[1]); world = int(sys.argv[2])
+    rm = UserDefinedRoleMaker(
+        current_id=rank, role=Role.WORKER, worker_num=world,
+        server_endpoints=["127.0.0.1:0"],
+        trainer_endpoints=[f"127.0.0.1:{6200+i}" for i in range(world)])
+    f = Fleet().init(rm)
+    f.init_worker()
+    got = f.util.all_reduce(np.asarray([1.0 * (rank + 1), 2.0]), mode="sum")
+    f.util.barrier()
+    mx = f.util.all_reduce(np.float32(rank), mode="max")
+    f.util.barrier()  # keep rank 0's store daemon alive until all read
+    print("RESULT", got[0], got[1], float(mx), flush=True)
+    f.stop_worker()
+""")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_util_allreduce_across_processes(tmp_path):
+    world = 3
+    port = _free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PADDLE_UTIL_STORE_PORT=str(port),
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.pop("XLA_FLAGS", None)
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(r), str(world)],
+        env=env, cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        for r in range(world)]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, err[-2000:]
+        outs.append(out)
+    # sum over ranks of [rank+1, 2] = [6, 6]; max(rank) = 2
+    for out in outs:
+        line = [l for l in out.splitlines() if l.startswith("RESULT")][0]
+        _, a, b, c = line.split()
+        assert float(a) == 6.0 and float(b) == 6.0 and float(c) == 2.0, line
+
+
+def test_util_identity_single_worker():
+    from paddle_tpu.distributed.fleet import Fleet
+
+    f = Fleet().init()
+    v = np.asarray([3.0, 4.0])
+    np.testing.assert_array_equal(f.util.all_reduce(v), v)
+    f.util.barrier()  # no-op

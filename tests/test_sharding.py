@@ -110,3 +110,28 @@ def test_stages_match_single_device_trajectory():
         return [float(tr.train_step(x, y)) for x, y in batches]
 
     np.testing.assert_allclose(run(True), run(False), rtol=2e-4, atol=2e-5)
+
+
+def test_stage2_hlo_contains_reduce_scatter():
+    """ZeRO-2's defining comm pattern (sharding_stage2.py:43): grads are
+    reduce-scattered (not all-reduced full-size) and updated params
+    all-gathered. Stage 1 (GSPMD) shows the all-reduce pattern instead."""
+    pt.seed(0)
+    mesh = _mesh()
+    x = jnp.zeros((16, 16)); y = jnp.zeros((16,), jnp.int32)
+
+    def hlo(stage):
+        tr = group_sharded_parallel(_MLP(), optimizer.Adam(1e-3), 
+                                    {1: "os", 2: "os_g"}[stage]).trainer(
+            nn.functional.cross_entropy, mesh)
+        return tr._step.lower(tr.state, tr.opt_state, jax.random.key(0),
+                              (x,), (y,)).compile().as_text()
+
+    t2 = hlo(2)
+    assert t2.count("reduce-scatter") >= 2, "stage-2 grads must reduce-scatter"
+    assert t2.count("all-gather") >= 2, "stage-2 params must all-gather"
+    t1 = hlo(1)
+    # stage 2 must be strictly more reduce-scatter-shaped than stage 1's
+    # GSPMD program (don't pin stage 1 to exactly zero — XLA may learn
+    # the reassociation on its own someday)
+    assert t2.count("reduce-scatter") > t1.count("reduce-scatter")
