@@ -127,6 +127,14 @@ void pst_export(void* h, const uint64_t* keys, int64_t n, float* values_out,
   pstpu::table_export(static_cast<NativeTable*>(h), keys, n, values_out, found);
 }
 
+// Export with insert-on-miss: one shard traversal creates missing rows
+// (slots[] or 0) AND reads the full state (begin_pass build).
+void pst_export_create(void* h, const uint64_t* keys, const int32_t* slots,
+                       int64_t n, float* values_out, uint8_t* found) {
+  pstpu::table_export(static_cast<NativeTable*>(h), keys, n, values_out,
+                      found, 1, slots);
+}
+
 // Bulk insert of full rows (load path / cache flush-back): keys [n],
 // values [n, full_dim] in the save layout.
 void pst_insert_full(void* h, const uint64_t* keys, const float* values,

@@ -586,12 +586,17 @@ inline int64_t table_save_drain(NativeTable* t, uint64_t* keys_out,
   return n;
 }
 
-// Export full rows for a key subset (no insert-on-miss); found may be null.
+// Export full rows for a key subset; found may be null. With create,
+// missing keys are inserted first (slot from slots[] or 0) — the
+// single-traversal pass-build load (pull-with-create + state export in
+// one shard visit; round-1 did two full traversals here).
 inline void table_export(NativeTable* t, const uint64_t* keys, int64_t n,
-                         float* values_out, uint8_t* found) {
+                         float* values_out, uint8_t* found,
+                         int32_t create = 0, const int32_t* slots = nullptr) {
   int32_t fd = table_full_dim(t);
   t->parallel_over_shards(keys, n, [&](Shard* sh, int64_t i) {
-    int32_t r = sh->find(keys[i]);
+    int32_t r = create ? sh->lookup_or_insert(keys[i], slots ? slots[i] : 0)
+                       : sh->find(keys[i]);
     float* o = values_out + i * fd;
     if (r < 0) {
       std::fill_n(o, fd, 0.0f);

@@ -197,9 +197,13 @@ class HbmEmbeddingCache:
         rows = self._spread(rows)
         self._pass_keys = uniq
 
-        # pull from host table (insert-on-miss: new features get created)
-        pulled = self.table.pull_sparse(uniq, create=True)  # [n, 3+dim] ctr layout
-        n = len(uniq)
+        # ONE shard traversal creates missing features and exports full
+        # rows (values + optimizer state) — round 1 walked the table
+        # twice here (pull_sparse then export_full over the same keys)
+        acc = self.table.accessor
+        es = acc.embed_rule.state_dim
+        xd = acc.config.embedx_dim
+        values, _ = self.table.export_full(uniq, create=True)
         dim = cfg.embedx_dim
         host = {
             "show": np.zeros(cfg.capacity, np.float32),
@@ -210,13 +214,17 @@ class HbmEmbeddingCache:
             "embedx_g2sum": np.zeros((cfg.capacity, 1), np.float32),
             "has_embedx": np.zeros(cfg.capacity, np.float32),
         }
-        host["show"][rows] = pulled[:, 0]
-        host["click"][rows] = pulled[:, 1]
-        host["embed_w"][rows, 0] = pulled[:, 2]
-        host["embedx_w"][rows] = pulled[:, 3:]
-        host["has_embedx"][rows] = (np.abs(pulled[:, 3:]).sum(axis=1) > 0).astype(np.float32)
-        # g2sum state comes from the table's accessor state where present
-        self._load_g2sum(host, uniq, rows)
+        # full layout: slot, unseen_days, delta_score, show, click,
+        # embed_w, embed_state[es], has_embedx, embedx_w[xd], embedx_state
+        host["show"][rows] = values[:, 3]
+        host["click"][rows] = values[:, 4]
+        host["embed_w"][rows, 0] = values[:, 5]
+        if es >= 1:
+            host["embed_g2sum"][rows, 0] = values[:, 6]
+        host["has_embedx"][rows] = values[:, 6 + es]
+        host["embedx_w"][rows] = values[:, 7 + es: 7 + es + xd]
+        if acc.embedx_rule.state_dim >= 1:
+            host["embedx_g2sum"][rows, 0] = values[:, 7 + es + xd]
 
         if self._device_map_enabled:
             from .device_hash import DeviceKeyMap
@@ -230,18 +238,6 @@ class HbmEmbeddingCache:
         else:
             self.state = {k: jnp.asarray(v) for k, v in host.items()}
         return len(uniq)
-
-    def _load_g2sum(self, host: Dict[str, np.ndarray], keys: np.ndarray, rows: np.ndarray) -> None:
-        # optimizer state via the table's backend-neutral full-row export
-        # (adagrad: 1 shared g2sum per embedding)
-        acc = self.table.accessor
-        es = acc.embed_rule.state_dim
-        xd = acc.config.embedx_dim
-        values, found = self.table.export_full(keys)
-        if es >= 1:
-            host["embed_g2sum"][rows[found], 0] = values[found, 6]
-        if acc.embedx_rule.state_dim >= 1:
-            host["embedx_g2sum"][rows[found], 0] = values[found, 7 + es + xd]
 
     def lookup(self, keys: np.ndarray) -> np.ndarray:
         """Batch keys → cache rows (host-side; feed into the jitted step)."""

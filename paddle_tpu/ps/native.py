@@ -384,6 +384,10 @@ def _configure_pst(lib: ctypes.CDLL) -> None:
     lib.pst_insert_full.argtypes = [ctypes.c_void_p, u64p, f32p, ctypes.c_int64]
     lib.pst_export.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64, f32p,
                                ctypes.POINTER(ctypes.c_uint8)]
+    if hasattr(lib, "pst_export_create"):
+        lib.pst_export_create.argtypes = [ctypes.c_void_p, u64p, i32p,
+                                          ctypes.c_int64, f32p,
+                                          ctypes.POINTER(ctypes.c_uint8)]
 
 
 def _f32(a: np.ndarray):
@@ -461,13 +465,25 @@ class NativeSparseTableEngine:
             self._lib.pst_save_fetch(self._h, _u64(keys), _f32(values))
         return keys, values
 
-    def export_full(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-        """(values [n, full_dim], found [n] bool) — no insert-on-miss."""
+    def export_full(self, keys: np.ndarray, create: bool = False,
+                    slots: Optional[np.ndarray] = None) -> Tuple[np.ndarray, np.ndarray]:
+        """(values [n, full_dim], found [n] bool). With ``create``,
+        missing rows are inserted in the same shard traversal."""
         keys = np.ascontiguousarray(keys, np.uint64)
         values = np.empty((len(keys), self.full_dim), np.float32)
         found = np.empty(len(keys), np.uint8)
-        self._lib.pst_export(self._h, _u64(keys), len(keys), _f32(values),
-                             found.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        fp = found.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+        if create and hasattr(self._lib, "pst_export_create"):
+            slots_arr = (np.ascontiguousarray(slots, np.int32)
+                         if slots is not None else None)
+            self._lib.pst_export_create(
+                self._h, _u64(keys),
+                _i32(slots_arr) if slots_arr is not None else None,
+                len(keys), _f32(values), fp)
+        else:
+            if create:  # stale .so without the fused symbol: two passes
+                self.pull(keys, slots, True)
+            self._lib.pst_export(self._h, _u64(keys), len(keys), _f32(values), fp)
         return values, found.astype(bool)
 
     def insert_full(self, keys: np.ndarray, values: np.ndarray) -> None:

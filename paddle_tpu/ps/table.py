@@ -322,18 +322,28 @@ class MemorySparseTable:
                 + self.accessor.config.embedx_dim
                 + self.accessor.embedx_rule.state_dim)
 
-    def export_full(self, keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-        """(values [n, full_dim], found [n] bool); no insert-on-miss."""
+    def export_full(self, keys: np.ndarray, create: bool = False,
+                    slots: Optional[np.ndarray] = None) -> Tuple[np.ndarray, np.ndarray]:
+        """(values [n, full_dim], found [n] bool). With ``create``, missing
+        rows are inserted during the same traversal (the single-pass
+        begin_pass build: pull-with-create + optimizer-state export in
+        one shard visit instead of two full table walks)."""
         if self._native is not None:
-            return self._native.export_full(keys)
+            return self._native.export_full(keys, create=create, slots=slots)
         keys = np.ascontiguousarray(keys, np.uint64)
         es = self.accessor.embed_rule.state_dim
         xd = self.accessor.config.embedx_dim
+        slots_arr = (np.ascontiguousarray(slots, np.int32)
+                     if slots is not None else None)
+
+        def visit(sh, k, s):  # create (under the same shard lock) + export
+            if create:
+                sh.pull(k, s, True)
+            return self._export_shard(sh, k, es, xd)
+
         out = np.zeros((len(keys), self.full_dim), np.float32)
         found = np.zeros(len(keys), bool)
-        for sel, res in self._scatter_gather(
-            keys, lambda sh, k: self._export_shard(sh, k, es, xd)
-        ):
+        for sel, res in self._scatter_gather(keys, visit, slots_arr):
             out[sel], found[sel] = res
         return out, found
 

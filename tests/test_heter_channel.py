@@ -1,0 +1,160 @@
+"""Cross-process heterogeneous pipeline: CPU-stage process streams
+micro-batches over the native tensor channel (csrc/tensor_channel.cc —
+heter_client.h:83 SendAndRecv) to a device-stage process whose jitted
+step sends results back. In-process framing/backpressure tests plus the
+two-subprocess round trip (heter_pipeline_trainer.cc topology).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddle_tpu.parallel.heter_channel import (STOP, ChannelClient,
+                                               ChannelServer, channel_source)
+from paddle_tpu.ps.native import native_available
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native lib unavailable")
+
+
+def test_roundtrip_types_and_shapes(rng):
+    srv = ChannelServer(capacity=4)
+    cli = ChannelClient("127.0.0.1", srv.port)
+    item = {
+        "f32": rng.normal(size=(3, 5)).astype(np.float32),
+        "u64": rng.integers(0, 1 << 60, size=7, dtype=np.uint64),
+        "i32scalar": np.asarray(-3, np.int32),
+        "empty": np.zeros((0, 4), np.float32),
+    }
+    cli.send(item)
+    got = srv.recv(timeout=10)
+    for k in item:
+        np.testing.assert_array_equal(got[k], item[k])
+        assert got[k].dtype == item[k].dtype
+    cli.send_stop()
+    assert srv.recv(timeout=10) is STOP
+    srv.close()
+    cli.close()
+
+
+def test_stop_terminates_source(rng):
+    srv = ChannelServer(capacity=4)
+    cli = ChannelClient("127.0.0.1", srv.port)
+    for i in range(5):
+        cli.send({"i": np.asarray(i)})
+    cli.send_stop()
+    items = list(channel_source(srv, timeout=10))
+    assert [int(x["i"]) for x in items] == list(range(5))
+    srv.close()
+    cli.close()
+
+
+_DEV_STAGE = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax; jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.parallel.heter_channel import (ChannelServer,
+        ChannelClient, channel_source)
+
+    in_port, out_port = int(sys.argv[1]), int(sys.argv[2])
+    srv = ChannelServer(port=in_port, capacity=4)
+
+    @jax.jit
+    def dense_tail(x):                # the device-stage section
+        return jnp.sum(x * 2.0), jnp.mean(x)
+
+    cli = ChannelClient("127.0.0.1", out_port)
+    for item in channel_source(srv, timeout=60):
+        s, m = dense_tail(jnp.asarray(item["x"]))
+        cli.send({"idx": item["idx"], "sum": np.asarray(s),
+                  "mean": np.asarray(m)})
+    cli.send_stop()
+    srv.close(); cli.close()
+""")
+
+_CPU_STAGE = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import numpy as np
+    from paddle_tpu.parallel.heter_channel import ChannelClient
+    from paddle_tpu.parallel.heter_pipeline import (HeterPipelineTrainer,
+        SectionConfig)
+
+    dev_port = int(sys.argv[1])
+    cli = ChannelClient("127.0.0.1", dev_port)
+    rng = np.random.default_rng(0)
+    batches = [{"idx": np.asarray(i),
+                "x": rng.normal(size=(4, 8)).astype(np.float32)}
+               for i in range(6)]
+
+    def host_head(item):              # CPU-stage section: normalize
+        x = item["x"]
+        return {"idx": item["idx"], "x": (x - x.mean()) / (x.std() + 1e-6)}
+
+    def sink(item):
+        cli.send(item)
+        return item
+
+    tr = HeterPipelineTrainer([SectionConfig(host_head, place="cpu"),
+                               SectionConfig(sink, place="cpu")])
+    tr.run(iter(batches), collect=False)
+    cli.send_stop()
+    cli.close()
+""")
+
+
+@pytest.mark.slow
+def test_two_process_cpu_to_device_pipeline(tmp_path):
+    import socket
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.pop("XLA_FLAGS", None)
+
+    results = ChannelServer(capacity=16)
+    in_port = free_port()
+
+    dev = tmp_path / "dev.py"
+    dev.write_text(_DEV_STAGE)
+    cpu = tmp_path / "cpu.py"
+    cpu.write_text(_CPU_STAGE)
+    p_dev = subprocess.Popen(
+        [sys.executable, str(dev), str(in_port), str(results.port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    p_cpu = subprocess.Popen(
+        [sys.executable, str(cpu), str(in_port)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+
+    got = {}
+    try:
+        for item in channel_source(results, timeout=120):
+            got[int(item["idx"])] = (float(item["sum"]), float(item["mean"]))
+    finally:
+        for p in (p_cpu, p_dev):
+            out, err = p.communicate(timeout=60)
+            assert p.returncode == 0, err[-2000:]
+        results.close()
+
+    assert sorted(got) == list(range(6))
+    # recompute expectation: sum(2 * normalize(x)) and mean(normalize(x))
+    rng = np.random.default_rng(0)
+    for i in range(6):
+        x = rng.normal(size=(4, 8)).astype(np.float32)
+        xn = (x - x.mean()) / (x.std() + 1e-6)
+        s, m = got[i]
+        np.testing.assert_allclose(s, float(np.sum(xn * 2.0)), atol=1e-4)
+        np.testing.assert_allclose(m, float(np.mean(xn)), atol=1e-5)

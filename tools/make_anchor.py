@@ -1,0 +1,164 @@
+"""DeepFM/Criteo convergence anchor on the CPU MemorySparseTable path.
+
+BASELINE.md's first measured-baseline task (SURVEY §6): run the
+the_one_ps-style CPU-table configuration — every batch pulls from and
+pushes to the host sparse table (MemorySparseTable, CTR accessor +
+AdaGrad rules; memory_sparse_table.cc pull/push semantics), with only
+the dense fwd/bwd jitted — and record samples/sec plus the AUC-vs-step
+curve as the comparison anchor future rounds must match or beat.
+Harness shape follows the reference's fleet CTR tests
+(test_dist_fleet_base.py:311 / dist_fleet_ctr.py): synthetic
+Criteo-shaped stream, bucketed AUC metric.
+
+Synthetic task: each feasign carries a latent logit weight; the label is
+Bernoulli(sigmoid(sum of latent weights + dense effect)) — learnable,
+with a known AUC ceiling. Deterministic (seed 0).
+
+Writes ANCHOR.json. Runs on CPU only (never touches the TPU chip).
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp  # noqa: E402
+
+    import paddle_tpu as pt  # noqa: E402
+    from paddle_tpu import nn, optimizer  # noqa: E402
+    from paddle_tpu.metrics.auc import AUC  # noqa: E402
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM  # noqa: E402
+    from paddle_tpu.ps.accessor import AccessorConfig  # noqa: E402
+    from paddle_tpu.ps.table import MemorySparseTable, TableConfig  # noqa: E402
+
+    steps = int(os.environ.get("ANCHOR_STEPS", 120))
+    batch = int(os.environ.get("ANCHOR_BATCH", 512))
+    eval_every = int(os.environ.get("ANCHOR_EVAL_EVERY", 10))
+    vocab_per_slot = 4096
+
+    cfg = CtrConfig(num_sparse_slots=26, num_dense=13, embedx_dim=8,
+                    dnn_hidden=(400, 400, 400))
+    S, dim = cfg.num_sparse_slots, cfg.embedx_dim
+
+    pt.seed(0)
+    rng = np.random.default_rng(0)
+
+    # ground truth: per-feasign latent logit weights, Zipf-ish popularity
+    latent = rng.normal(0, 0.35, size=(S, vocab_per_slot)).astype(np.float32)
+    dense_w = rng.normal(0, 0.3, size=cfg.num_dense).astype(np.float32)
+    zipf_p = 1.0 / np.arange(1, vocab_per_slot + 1) ** 1.1
+    zipf_p /= zipf_p.sum()
+
+    def sample(n):
+        ids = rng.choice(vocab_per_slot, size=(n, S), p=zipf_p)
+        keys = ids.astype(np.uint64) + (np.arange(S, dtype=np.uint64) << np.uint64(32))
+        dense = rng.normal(size=(n, cfg.num_dense)).astype(np.float32)
+        logit = latent[np.arange(S)[None, :], ids].sum(axis=1) + dense @ dense_w
+        labels = (rng.random(n) < 1.0 / (1.0 + np.exp(-(logit - 1.0)))).astype(np.int32)
+        return keys, dense, labels
+
+    table = MemorySparseTable(TableConfig(
+        shard_num=16,
+        accessor_config=AccessorConfig(embedx_dim=dim, embedx_threshold=0.0)))
+    slot_ids = np.tile(np.arange(S, dtype=np.int32), batch)
+
+    model = DeepFM(cfg)
+    opt = optimizer.Adam(learning_rate=1e-3)
+    params = {"params": dict(model.named_parameters()), "buffers": {}}
+    opt_state = opt.init(params)
+
+    def loss_fn(params, emb, dense_x, labels):
+        out, _ = nn.functional_call(model, params, emb, dense_x, training=True)
+        loss = nn.functional.binary_cross_entropy_with_logits(
+            out, labels.astype(jnp.float32))
+        return loss, out
+
+    @jax.jit
+    def train_step(params, opt_state, emb, dense_x, labels):
+        (loss, logits), (grads, emb_grad) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True)(params, emb, dense_x,
+                                                   labels)
+        new_params, new_opt = opt.update(grads, opt_state, params)
+        return new_params, new_opt, loss, emb_grad, jax.nn.sigmoid(logits)
+
+    @jax.jit
+    def infer(params, emb, dense_x):
+        out, _ = nn.functional_call(model, params, emb, dense_x,
+                                    training=False)
+        return jax.nn.sigmoid(out)
+
+    def pull_emb(keys_flat, create):
+        pulled = table.pull_sparse(
+            keys_flat, slots=slot_ids[:len(keys_flat)], create=create)
+        # CTR pull layout: show, click, embed_w, embedx_w[dim]
+        return pulled[:, 2:].reshape(-1, S, 1 + dim)
+
+    eval_keys, eval_dense, eval_labels = sample(4096)
+
+    def eval_auc():
+        m = AUC()
+        emb = pull_emb(eval_keys.reshape(-1), create=False)
+        probs = np.asarray(infer(params, jnp.asarray(emb),
+                                 jnp.asarray(eval_dense)))
+        m.update(probs, eval_labels)
+        return float(m.accumulate())
+
+    curve = []
+    t0 = time.perf_counter()
+    train_time = 0.0
+    for step_i in range(steps):
+        keys, dense, labels = sample(batch)
+        flat = keys.reshape(-1)
+        ts = time.perf_counter()
+        emb = pull_emb(flat, create=True)
+        params, opt_state, loss, emb_grad, probs = train_step(
+            params, opt_state, jnp.asarray(emb), jnp.asarray(dense),
+            jnp.asarray(labels))
+        g = np.asarray(emb_grad).reshape(-1, 1 + dim)
+        push = np.empty((len(flat), 4 + dim), np.float32)
+        push[:, 0] = slot_ids
+        push[:, 1] = 1.0                                # show
+        push[:, 2] = np.repeat(labels, S)               # click
+        push[:, 3:] = g                                 # embed_g, embedx_g
+        table.push_sparse(flat, push)
+        train_time += time.perf_counter() - ts
+        if (step_i + 1) % eval_every == 0 or step_i == 0:
+            auc = eval_auc()
+            curve.append([step_i + 1, round(auc, 4)])
+            print(f"step {step_i+1}: loss {float(loss):.4f} auc {auc:.4f}",
+                  file=sys.stderr, flush=True)
+
+    out = {
+        "task": "deepfm_criteo_synthetic_cpu_table_path",
+        "mode": "the_one_ps CPU MemorySparseTable pull/push per batch",
+        "samples_per_sec": round(batch * steps / train_time, 1),
+        "steps": steps,
+        "batch": batch,
+        "final_auc": curve[-1][1],
+        "auc_curve": curve,
+        "table_features": table.size(),
+        "config": {"slots": S, "dense": cfg.num_dense, "embedx_dim": dim,
+                   "dnn": list(cfg.dnn_hidden), "vocab_per_slot": vocab_per_slot,
+                   "optimizer": "Adam 1e-3 dense + CTR AdaGrad sparse"},
+        "wall_clock_sec": round(time.perf_counter() - t0, 1),
+    }
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "ANCHOR.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"anchor": out["final_auc"],
+                      "samples_per_sec": out["samples_per_sec"]}))
+
+
+if __name__ == "__main__":
+    main()

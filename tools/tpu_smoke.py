@@ -1,0 +1,193 @@
+"""Real-TPU smoke: run the compiled hot paths once on hardware and
+record timings (VERDICT r1 weak #4: the Pallas flash kernel and the
+compiled hybrid/cache paths had only ever executed on the CPU mesh).
+
+Legs:
+1. Pallas flash attention fwd+bwd vs the einsum reference (correctness
+   on hardware + timing at a realistic shape).
+2. One compiled CTR cache step (in-graph cuckoo lookup + pull + DeepFM
+   fwd/bwd + batch-scaled AdaGrad push) — the bench inner loop.
+3. One compiled transformer train step at realistic hidden size, with
+   an MFU estimate from the analytic FLOP count.
+
+Writes TPU_SMOKE.json (committed per round). Tolerates a stuck chip:
+a watchdog emits {"ok": false, ...} instead of hanging the caller.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "TPU_SMOKE.json")
+
+
+def _write(payload) -> None:
+    with open(OUT, "w") as f:
+        json.dump(payload, f, indent=1)
+    print(json.dumps(payload)[:400])
+
+
+def _timed(fn, *args, iters=20):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters, out
+
+
+def main() -> None:
+    import threading
+
+    import jax
+
+    got = {}
+
+    def init():
+        try:
+            got["devs"] = jax.devices()
+        except Exception as e:  # noqa: BLE001
+            got["err"] = str(e)
+
+    t = threading.Thread(target=init, daemon=True)
+    t.start()
+    t.join(float(os.environ.get("SMOKE_INIT_TIMEOUT", 180)))
+    if "devs" not in got:
+        _write({"ok": False, "error": got.get("err", "backend init hung")})
+        sys.stdout.flush()
+        os._exit(0)
+
+    import jax.numpy as jnp
+
+    dev = got["devs"][0]
+    result = {"ok": True, "platform": dev.platform,
+              "device": str(dev.device_kind), "legs": {}}
+    rng = np.random.default_rng(0)
+
+    # --- leg 1: Pallas flash attention fwd/bwd vs einsum reference ------
+    from paddle_tpu.ops.flash_attention import flash_attention
+
+    B, H, L, D = 4, 8, 1024, 128
+    q, k, v = (jnp.asarray(rng.normal(size=(B, L, H, D)), jnp.float32)
+               for _ in range(3))
+
+    def ref_attn(q, k, v):
+        s = jnp.einsum("blhd,bmhd->bhlm", q, k) / np.sqrt(D)
+        mask = jnp.tril(jnp.ones((L, L), bool))
+        s = jnp.where(mask, s, -1e30)
+        return jnp.einsum("bhlm,bmhd->blhd", jax.nn.softmax(s, axis=-1), v)
+
+    flash_loss = jax.jit(jax.value_and_grad(
+        lambda q: jnp.sum(flash_attention(q, k, v, causal=True))))
+    ref_loss = jax.jit(jax.value_and_grad(
+        lambda q: jnp.sum(ref_attn(q, k, v))))
+
+    t_flash, (lf, gf) = _timed(flash_loss, q, iters=10)
+    t_ref, (lr, grf) = _timed(ref_loss, q, iters=10)
+    max_err = float(jnp.max(jnp.abs(gf - grf)) /
+                    (jnp.max(jnp.abs(grf)) + 1e-9))
+    result["legs"]["flash_attention"] = {
+        "shape": [B, L, H, D], "fwd_bwd_ms": round(t_flash * 1e3, 3),
+        "einsum_ref_ms": round(t_ref * 1e3, 3),
+        "speedup_vs_einsum": round(t_ref / t_flash, 2),
+        "grad_rel_err": round(max_err, 6),
+        "grads_match": bool(max_err < 2e-2),
+    }
+
+    # --- leg 2: CTR cache step (bench inner loop) -----------------------
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM, make_ctr_train_step_from_keys
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.embedding_cache import CacheConfig, HbmEmbeddingCache
+    from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+    pt.seed(0)
+    batch, pass_keys = 4096, 1 << 18
+    ccfg = CtrConfig(num_sparse_slots=26, num_dense=13, embedx_dim=8,
+                     dnn_hidden=(400, 400, 400))
+    cache_cfg = CacheConfig(capacity=1 << 19, embedx_dim=8,
+                            embedx_threshold=0.0)
+    table = MemorySparseTable(TableConfig(
+        shard_num=16, accessor_config=AccessorConfig(embedx_dim=8)))
+    cache = HbmEmbeddingCache(table, cache_cfg, device_map=True)
+    pool = rng.integers(0, pass_keys // 26 + 1, size=(pass_keys, 26)).astype(np.uint64)
+    pool += np.arange(26, dtype=np.uint64) << np.uint64(32)
+    cache.begin_pass(pool.reshape(-1))
+    model = DeepFM(ccfg)
+    opt = optimizer.Adam(learning_rate=1e-3)
+    params = {"params": dict(model.named_parameters()), "buffers": {}}
+    opt_state = opt.init(params)
+    step = make_ctr_train_step_from_keys(model, opt, cache_cfg,
+                                         slot_ids=np.arange(26), donate=False)
+    idx = rng.integers(0, pass_keys, size=batch)
+    lo32 = jnp.asarray((pool[idx] & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+    dense = jnp.asarray(rng.normal(size=(batch, 13)), jnp.float32)
+    labels = jnp.asarray((rng.random(batch) < 0.3).astype(np.int32))
+    ms = cache.device_map.state
+
+    def ctr_once(lo32, dense, labels):
+        return step(params, opt_state, cache.state, ms, lo32, dense, labels)[3]
+
+    t_ctr, _ = _timed(jax.jit(ctr_once), lo32, dense, labels, iters=20)
+    result["legs"]["ctr_cache_step"] = {
+        "batch": batch, "step_ms": round(t_ctr * 1e3, 3),
+        "device_samples_per_sec": round(batch / t_ctr, 0),
+    }
+
+    # --- leg 3: transformer step at realistic hidden + MFU --------------
+    from paddle_tpu import nn
+    from paddle_tpu.executor import Trainer
+    from paddle_tpu.models.ernie import Ernie, ErnieConfig
+
+    pt.seed(0)
+    ecfg = ErnieConfig(vocab_size=32768, hidden_size=1024, num_heads=16,
+                       ffn_size=4096, num_layers=8, max_seq_len=512)
+    emodel = Ernie(ecfg)
+    B2, L2 = 8, 512
+
+    def lm_loss(out, labels):
+        return nn.functional.cross_entropy(
+            out.reshape(-1, out.shape[-1]), labels.reshape(-1))
+
+    tr = Trainer(emodel, optimizer.Adam(1e-4), lm_loss)
+    ids = jnp.asarray(rng.integers(0, ecfg.vocab_size, size=(B2, L2)), jnp.int32)
+    lbl = jnp.asarray(rng.integers(0, ecfg.vocab_size, size=(B2, L2)), jnp.int32)
+
+    t_step, _ = _timed(lambda a, b: tr.train_step(a, b), ids, lbl, iters=10)
+    # analytic FLOPs: 6 * params * tokens (fwd+bwd) + attention term
+    n_params = sum(int(np.prod(p.shape))
+                   for p in dict(emodel.named_parameters()).values())
+    tokens = B2 * L2
+    attn_flops = 12 * ecfg.num_layers * B2 * L2 * L2 * ecfg.hidden_size
+    flops = 6 * n_params * tokens + attn_flops
+    peak = float(os.environ.get("SMOKE_PEAK_TFLOPS", 197e12))  # v5p f32→bf16 peak proxy
+    result["legs"]["transformer_step"] = {
+        "config": {"hidden": 1024, "layers": 8, "seq": L2, "batch": B2},
+        "step_ms": round(t_step * 1e3, 2),
+        "params_millions": round(n_params / 1e6, 1),
+        "tokens_per_sec": round(tokens / t_step, 0),
+        "mfu_pct_of_peak": round(100 * flops / t_step / peak, 2),
+    }
+
+    result["timestamp"] = time.strftime("%Y-%m-%d %H:%M:%S")
+    _write(result)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        _write({"ok": False, "error": f"{type(e).__name__}: {e}"[:300]})
