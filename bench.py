@@ -138,21 +138,34 @@ def main() -> None:
 
     map_state = cache.device_map.state
 
-    def run_one(i):
-        lo32, dense, labels = batches[i % n_batches]
+    # async H2D double-buffering (the data_feed channel role): transfers
+    # of batch i+1..i+depth overlap step i's device time
+    from paddle_tpu.data.prefetcher import device_prefetch
+
+    def stream():
+        for i in range(warmup + steps):
+            yield batches[i % n_batches]
+
+    prefetcher = device_prefetch(stream(), depth=3)
+    feeder = iter(prefetcher)
+
+    def run_one():
+        lo32, dense, labels = next(feeder)
         return step(params, opt_state, cache.state, map_state,
-                    jnp.asarray(lo32), jnp.asarray(dense),
-                    jnp.asarray(labels))
+                    lo32, dense, labels)
 
-    for i in range(warmup):
-        params, opt_state, cache.state, loss = run_one(i)
-    jax.block_until_ready(loss)
+    try:
+        for i in range(warmup):
+            params, opt_state, cache.state, loss = run_one()
+        jax.block_until_ready(loss)
 
-    t0 = time.perf_counter()
-    for i in range(steps):
-        params, opt_state, cache.state, loss = run_one(i)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i in range(steps):
+            params, opt_state, cache.state, loss = run_one()
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+    finally:
+        prefetcher.close()
 
     samples_per_sec = batch * steps / dt
     baseline = 1.0e6  # proxy: GPUPS-on-A100 class throughput (north star ≥2×)

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# CI gate (reference L0's cmake+ctest role): native build, fast test
+# gate, then the full matrix. Usage: ./ci.sh [fast|full]
+set -euo pipefail
+cd "$(dirname "$0")"
+
+echo "== native build =="
+make -C paddle_tpu/csrc -s
+
+echo "== fast gate (default: -m 'not slow') =="
+python -m pytest tests/ -q -x
+
+if [[ "${1:-fast}" == "full" ]]; then
+  echo "== full matrix (slow tests included) =="
+  python -m pytest tests/ -q -m ""
+  echo "== driver artifacts =="
+  python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8); print('dryrun OK')"
+fi
+echo "CI OK"

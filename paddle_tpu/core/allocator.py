@@ -1,0 +1,138 @@
+"""Host memory allocation (allocator facade).
+
+Python face of csrc/allocator.cc — the role the reference's
+`memory::Alloc(place, size)` facade plays for host memory
+(`paddle/fluid/memory/allocation/allocator_facade.h`, strategy
+`auto_growth_best_fit_allocator.cc`). Device/HBM allocation is owned by
+XLA/PJRT (the deliberate inversion of the reference's device allocator
+stack — SURVEY §2.1 →TPU); this arena serves the host hot paths: batch
+assembly in the data feed, channel frames, H2D staging.
+
+``HostArena.ndarray(shape, dtype)`` returns a numpy array backed by an
+arena block; the block is recycled when the array (and its views) are
+garbage collected. ``default_arena()`` is the process-wide facade
+singleton (AllocatorFacade::Instance analogue).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..ps.native import load_native
+from .enforce import PreconditionNotMetError, enforce
+
+__all__ = ["HostArena", "default_arena", "arena_ndarray"]
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.arena_create.restype = ctypes.c_void_p
+    lib.arena_create.argtypes = [ctypes.c_int64]
+    lib.arena_destroy.argtypes = [ctypes.c_void_p]
+    lib.arena_alloc.restype = ctypes.c_void_p
+    lib.arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.arena_free.restype = ctypes.c_int
+    lib.arena_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.arena_stats.argtypes = [ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_int64)]
+
+
+class _Block:
+    """Owns one arena block; numpy arrays keep it alive via ``base``.
+    Holds the ``HostArena`` OBJECT (not the raw handle): blocks must keep
+    the arena alive, else arena_destroy frees the chunks under live
+    arrays and ``__del__`` frees into a destroyed Arena."""
+
+    __slots__ = ("_owner_arena", "ptr", "size")
+
+    def __init__(self, arena: "HostArena", ptr, size):
+        self._owner_arena = arena
+        self.ptr = ptr
+        self.size = size
+
+    def __del__(self):
+        try:
+            a = self._owner_arena
+            if self.ptr and a is not None and a._h:
+                a._lib.arena_free(a._h, self.ptr)
+        except Exception:
+            pass
+
+    def as_array(self, shape, dtype) -> np.ndarray:
+        buf = (ctypes.c_char * self.size).from_address(self.ptr)
+        # the array's .base chain keeps `buf` alive; `buf._owner` keeps
+        # this block alive → arena_free fires exactly when the last
+        # view of the array is garbage-collected
+        buf._owner = self
+        arr = np.frombuffer(buf, dtype=dtype,
+                            count=int(np.prod(shape)) if shape else 1)
+        return arr.reshape(shape)
+
+
+class HostArena:
+    """Auto-growth best-fit host arena (thread-safe)."""
+
+    def __init__(self, chunk_size: int = 64 << 20) -> None:
+        lib = load_native()
+        if lib is None:
+            raise PreconditionNotMetError(
+                "host arena needs the native library (csrc/allocator.cc)")
+        if not getattr(lib, "_arena_configured", False):
+            _configure(lib)
+            lib._arena_configured = True
+        self._lib = lib
+        self._h = lib.arena_create(chunk_size)
+        enforce(self._h, "arena_create failed")
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_h", None):
+            lib.arena_destroy(self._h)
+            self._h = None
+
+    def alloc(self, size: int) -> _Block:
+        ptr = self._lib.arena_alloc(self._h, int(size))
+        enforce(ptr, f"arena alloc of {size} bytes failed")
+        return _Block(self, ptr, int(size))
+
+    def free(self, block: _Block) -> None:
+        rc = int(self._lib.arena_free(self._h, block.ptr))
+        enforce(rc == 0, "double free / foreign pointer")
+        block.ptr = None
+
+    def ndarray(self, shape: Tuple[int, ...], dtype) -> np.ndarray:
+        """Arena-backed numpy array; block recycles when unreferenced."""
+        dt = np.dtype(dtype)
+        nbytes = int(np.prod(shape)) * dt.itemsize if shape else dt.itemsize
+        return self.alloc(max(nbytes, 1)).as_array(shape, dt)
+
+    def stats(self) -> dict:
+        out = (ctypes.c_int64 * 4)()
+        self._lib.arena_stats(self._h, out)
+        return {"reserved": int(out[0]), "in_use": int(out[1]),
+                "peak": int(out[2]), "chunks": int(out[3])}
+
+
+_DEFAULT: Optional[HostArena] = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_arena() -> HostArena:
+    """Process-wide facade singleton (AllocatorFacade::Instance)."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = HostArena()
+        return _DEFAULT
+
+
+def arena_ndarray(shape, dtype) -> np.ndarray:
+    """memory::Alloc analogue for host arrays; falls back to np.empty
+    when the native lib is unavailable."""
+    try:
+        return default_arena().ndarray(tuple(shape), dtype)
+    except PreconditionNotMetError:
+        return np.empty(shape, dtype)

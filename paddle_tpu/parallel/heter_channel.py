@@ -132,8 +132,12 @@ class ChannelServer:
             raise TimeoutError("channel recv timeout")
         if rc == -2:
             return STOP
+        from ..core.allocator import arena_ndarray
+
         n = int(self._lib.tch_frame_len(self._h))
-        buf = np.empty(n, np.uint8)  # single copy out of the C++ queue;
+        # arena-backed frame buffer (allocator facade): recycled when the
+        # consumer drops the decoded batch; single copy out of the queue
+        buf = arena_ndarray((n,), np.uint8)
         self._lib.tch_frame_copy(self._h, buf.ctypes.data_as(ctypes.c_void_p))
         return _decode(buf)  # decoded arrays are views into buf
 

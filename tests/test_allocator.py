@@ -1,0 +1,92 @@
+"""Host arena allocator (core/allocator.py + csrc/allocator.cc) — the
+auto-growth best-fit strategy of the reference's host allocator facade
+(memory/allocation/auto_growth_best_fit_allocator.cc): reuse, coalesce,
+stats, lifetime-tied numpy arrays.
+"""
+
+import gc
+
+import numpy as np
+import pytest
+
+from paddle_tpu.ps.native import native_available
+
+pytestmark = pytest.mark.skipif(not native_available(),
+                                reason="native lib unavailable")
+
+
+def _arena(chunk=1 << 20):
+    from paddle_tpu.core.allocator import HostArena
+
+    return HostArena(chunk_size=chunk)
+
+
+def test_alloc_free_reuse():
+    a = _arena()
+    b1 = a.alloc(1000)
+    p1 = b1.ptr
+    a.free(b1)
+    b2 = a.alloc(900)  # best-fit should hand back the same block
+    assert b2.ptr == p1
+    a.free(b2)
+    s = a.stats()
+    assert s["in_use"] == 0 and s["chunks"] == 1
+
+
+def test_auto_growth_and_peak():
+    a = _arena(chunk=1 << 16)  # 64 KiB chunks
+    blocks = [a.alloc(40 << 10) for _ in range(4)]  # forces 4 chunks
+    s = a.stats()
+    assert s["chunks"] == 4
+    assert s["in_use"] >= 4 * (40 << 10)
+    for b in blocks:
+        a.free(b)
+    s2 = a.stats()
+    assert s2["in_use"] == 0
+    assert s2["peak"] >= s["in_use"]
+    assert s2["reserved"] == s["reserved"]  # chunks retained for reuse
+
+
+def test_coalescing_allows_big_realloc():
+    a = _arena(chunk=1 << 16)
+    blocks = [a.alloc(1 << 12) for _ in range(16)]  # fill one chunk
+    assert a.stats()["chunks"] == 1
+    for b in blocks:
+        a.free(b)
+    # freed neighbours must coalesce back into one block able to serve
+    # a chunk-sized request without growing
+    big = a.alloc(1 << 16)
+    assert a.stats()["chunks"] == 1
+    a.free(big)
+
+
+def test_double_free_rejected():
+    a = _arena()
+    b = a.alloc(128)
+    a.free(b)
+    with pytest.raises(Exception):
+        a.free(b)
+
+
+def test_ndarray_lifetime_recycles():
+    a = _arena()
+    arr = a.ndarray((256, 4), np.float32)
+    arr[:] = 3.5
+    assert a.stats()["in_use"] > 0
+    view = arr[10:20]
+    del arr
+    gc.collect()
+    assert a.stats()["in_use"] > 0  # view keeps the block alive
+    np.testing.assert_array_equal(view, np.full((10, 4), 3.5, np.float32))
+    del view
+    gc.collect()
+    assert a.stats()["in_use"] == 0  # block recycled
+
+
+def test_default_arena_facade():
+    from paddle_tpu.core.allocator import arena_ndarray, default_arena
+
+    x = arena_ndarray((16,), np.int64)
+    x[:] = np.arange(16)
+    assert default_arena().stats()["in_use"] > 0
+    np.testing.assert_array_equal(x, np.arange(16))

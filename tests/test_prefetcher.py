@@ -1,0 +1,67 @@
+"""Async device prefetcher (data/prefetcher.py) — the DataFeed
+double-buffering role (data_feed.h channels / MiniBatchGpuPack)."""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.data.prefetcher import DevicePrefetcher, device_prefetch
+
+
+def test_order_and_completeness():
+    src = (np.full((2,), i) for i in range(20))
+    got = [int(x[0]) for x in DevicePrefetcher(src, depth=3)]
+    assert got == list(range(20))
+
+
+def test_transform_applied_and_overlap():
+    slow_transformed = []
+
+    def slow_transform(x):
+        time.sleep(0.02)
+        slow_transformed.append(x)
+        return x * 2
+
+    pf = DevicePrefetcher(iter(range(10)), depth=4, transform=slow_transform)
+    time.sleep(0.15)  # producer should have run ahead ~depth items
+    assert len(slow_transformed) >= 4
+    assert list(pf) == [2 * i for i in range(10)]
+
+
+def test_device_prefetch_moves_leaves():
+    batches = [(np.ones((2, 3), np.float32), {"y": np.zeros(2, np.int32)})
+               for _ in range(3)]
+    out = list(device_prefetch(iter(batches), depth=2))
+    assert len(out) == 3
+    x, d = out[0]
+    assert isinstance(x, jnp.ndarray) and isinstance(d["y"], jnp.ndarray)
+
+
+def test_producer_exception_propagates():
+    def src():
+        yield 1
+        raise ValueError("boom")
+
+    it = DevicePrefetcher(src(), depth=2)
+    assert next(it) == 1
+    with pytest.raises(ValueError, match="boom"):
+        list(it)
+
+
+def test_close_stops_producer():
+    produced = []
+
+    def src():
+        for i in range(10_000):
+            produced.append(i)
+            yield i
+
+    pf = DevicePrefetcher(src(), depth=2)
+    next(pf)
+    pf.close()
+    time.sleep(0.1)
+    n = len(produced)
+    time.sleep(0.2)
+    assert len(produced) == n  # producer stopped
