@@ -157,6 +157,46 @@ class Trainer:
                 self._dump_fh.write(f"{self.global_step}\t{f}\t{fmt(val)}\n")
         self._dump_fh.flush()
 
+    def train_from_dataset(self, dataset, feed, batch_size: int = 256,
+                           epochs: int = 1, prefetch_depth: int = 2,
+                           drop_last: bool = True):
+        """Reference ``Executor.train_from_dataset`` (executor.py:2389 →
+        RunFromDataset → MultiTrainer device-worker loop): drive every
+        batch of ``dataset`` (an InMemoryDataset/QueueDataset) through
+        the compiled step via the async device prefetcher.
+
+        ``feed(batch_dict) -> (inputs, labels)`` adapts the dataset's
+        {slot: (values, lengths)} columns to the model. Returns the mean
+        loss per epoch (list of floats). For the sparse/PS path use
+        ``ps.ps_trainer.CtrPassTrainer`` (the PSGPUTrainer analogue).
+        """
+        import inspect
+
+        from .data.prefetcher import device_prefetch
+
+        # QueueDataset.batch_iter has no drop_last (streaming can't know
+        # the tail in advance); pass it only where supported
+        kw = ({"drop_last": drop_last}
+              if "drop_last" in inspect.signature(dataset.batch_iter).parameters
+              else {})
+
+        epoch_losses = []
+        for _ in range(int(epochs)):
+            # device_prefetch moves array leaves to device IN the
+            # producer thread — that's the transfer/compute overlap
+            pf = device_prefetch(
+                (feed(b) for b in dataset.batch_iter(batch_size, **kw)),
+                depth=prefetch_depth)
+            losses = []
+            try:
+                for inputs, labels in pf:
+                    losses.append(self.train_step(inputs, labels))
+            finally:
+                pf.close()
+            epoch_losses.append(
+                float(jnp.mean(jnp.stack(losses))) if losses else float("nan"))
+        return epoch_losses
+
     def train_step(self, inputs, labels) -> jax.Array:
         """Run one compiled step; returns the loss as a device array.
 

@@ -171,7 +171,7 @@ def make_ctr_train_step_from_keys(
     model: Layer,
     optimizer,
     cache_cfg: CacheConfig,
-    slot_ids,
+    slot_ids=None,
     donate: bool = True,
 ) -> Callable:
     """GPUPS step with IN-GRAPH key lookup — the architecture the
@@ -188,19 +188,39 @@ def make_ctr_train_step_from_keys(
          labels) → (params, opt_state, cache_state, loss)
 
     Keys missing from the pass working set map to the capacity sentinel:
-    pushes for them are dropped; pulls clamp (pass protocol guarantees
-    batch ⊆ pass keys, matching the reference's build/serve contract).
-    """
-    slot_hi = jnp.asarray(np.asarray(slot_ids, np.uint32))[None, :]
+    pushes for them are dropped; pulls return zeros (pass protocol
+    guarantees batch ⊆ pass keys, matching the build/serve contract).
 
-    def step(params, opt_state, cache_state, map_state, keys_lo, dense_x,
-             labels):
-        B, S = keys_lo.shape
-        hi = jnp.broadcast_to(slot_hi, (B, S)).reshape(-1)
-        rows = device_hash_lookup(map_state, hi, keys_lo.reshape(-1))
+    ``slot_ids=None`` selects the wide-key variant for feasigns whose
+    high halves are NOT the column slot: the step then takes
+    ``(keys_hi, keys_lo)`` instead of ``keys_lo`` (double the wire
+    bytes — prefer slot-tagged keys where the layout allows).
+    """
+    slot_hi = (jnp.asarray(np.asarray(slot_ids, np.uint32))[None, :]
+               if slot_ids is not None else None)
+
+    def _finish(params, opt_state, cache_state, hi, lo, B, S, dense_x,
+                labels, map_state):
+        rows = device_hash_lookup(map_state, hi, lo)
         C = cache_state["embed_w"].shape[0]
         rows = jnp.where(rows >= 0, rows, C)
         return _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
                               cache_state, rows, B, S, dense_x, labels)
+
+    if slot_ids is not None:
+        def step(params, opt_state, cache_state, map_state, keys_lo,
+                 dense_x, labels):
+            B, S = keys_lo.shape
+            hi = jnp.broadcast_to(slot_hi, (B, S)).reshape(-1)
+            return _finish(params, opt_state, cache_state, hi,
+                           keys_lo.reshape(-1), B, S, dense_x, labels,
+                           map_state)
+    else:
+        def step(params, opt_state, cache_state, map_state, keys_hi,
+                 keys_lo, dense_x, labels):
+            B, S = keys_lo.shape
+            return _finish(params, opt_state, cache_state,
+                           keys_hi.reshape(-1), keys_lo.reshape(-1), B, S,
+                           dense_x, labels, map_state)
 
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())

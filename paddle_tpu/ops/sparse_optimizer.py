@@ -1,0 +1,120 @@
+"""Pallas fused sparse-optimizer kernel (CTR AdaGrad row update).
+
+The reference applies its sparse optimizer on-device inside the
+hashtable update kernels (`/root/reference/paddle/fluid/framework/fleet/
+heter_ps/optimizer.cuh.h:27-100` — update_lr/update_mf/update_value with
+show/click coeffs, bounds, lazy mf creation), one GPU thread per row.
+The TPU decomposition is different: random-access gather/scatter stays
+on XLA (the hardware's bulk path — per-row DMA loops in Pallas
+serialize), and the PER-ROW OPTIMIZER MATH between gather and scatter is
+this one fused Pallas kernel: all seven state columns of a block of
+touched rows update in a single VMEM pass (one read + one write per
+operand instead of XLA's per-op fusion groups).
+
+Used by ``ps.embedding_cache.cache_push`` on TPU (jnp fallback
+elsewhere / interpret mode in tests); bit-parity with the jnp path is
+tested in tests/test_sparse_optimizer.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["ctr_adagrad_rows"]
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _kernel(show_ref, click_ref, ew_ref, eg2_ref, xw_ref, xg2_ref, has_ref,
+            dshow_ref, dclick_ref, ge_ref, gx_ref,
+            o_show, o_click, o_ew, o_eg2, o_xw, o_xg2, o_has,
+            *, lr, initial_g2sum, wmin, wmax, nonclk_coeff, click_coeff,
+            embedx_threshold):
+    show = show_ref[...] + dshow_ref[...]
+    click = click_ref[...] + dclick_ref[...]
+    scale = jnp.maximum(dshow_ref[...], 1e-10)[:, None]
+
+    # embed (1-d) AdaGrad — sparse_sgd_rule.cc:87 / optimizer.cuh.h:35
+    ge = ge_ref[...] / scale
+    eg2 = eg2_ref[...]
+    ratio_e = jnp.sqrt(initial_g2sum / (initial_g2sum + eg2))
+    ew = jnp.clip(ew_ref[...] - lr * ge * ratio_e, wmin, wmax)
+    eg2_new = eg2 + jnp.mean(ge * ge, axis=1, keepdims=True)
+
+    # lazy embedx creation on the show/click score (optimizer.cuh.h:81)
+    score = (show - click) * nonclk_coeff + click * click_coeff
+    had = has_ref[...] > 0
+    create = jnp.logical_and(jnp.logical_not(had),
+                             score >= embedx_threshold)
+    # embedx (dim-d) AdaGrad, applied only where mf already existed
+    gx = gx_ref[...] / scale
+    xg2 = xg2_ref[...]
+    ratio_x = jnp.sqrt(initial_g2sum / (initial_g2sum + xg2))
+    xw_new = jnp.clip(xw_ref[...] - lr * gx * ratio_x, wmin, wmax)
+    xg2_new = xg2 + jnp.mean(gx * gx, axis=1, keepdims=True)
+
+    o_show[...] = show
+    o_click[...] = click
+    o_ew[...] = ew
+    o_eg2[...] = eg2_new
+    o_xw[...] = jnp.where(had[:, None], xw_new, xw_ref[...])
+    o_xg2[...] = jnp.where(had[:, None], xg2_new, xg2_ref[...])
+    o_has[...] = jnp.where(create, 1.0, has_ref[...])
+
+
+def ctr_adagrad_rows(
+    rows_state: Tuple[jax.Array, ...],  # show, click, ew, eg2, xw, xg2, has
+    dshow: jax.Array,   # [n] merged show deltas
+    dclick: jax.Array,  # [n]
+    g_embed: jax.Array,   # [n, 1] merged embed grads
+    g_embedx: jax.Array,  # [n, dim]
+    *,
+    lr: float, initial_g2sum: float, weight_bounds: Tuple[float, float],
+    nonclk_coeff: float, click_coeff: float, embedx_threshold: float,
+    block: int = 1024,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, ...]:
+    """Fused per-row CTR AdaGrad over gathered rows; returns the updated
+    seven state columns in the same order. Rows are pre-merged uniques
+    (the caller's segment-sum); padding rows are fine — the caller's
+    scatter drops them."""
+    show, click, ew, eg2, xw, xg2, has = rows_state
+    n = show.shape[0]
+    dim = xw.shape[1]
+    if interpret is None:
+        interpret = not _on_tpu()
+    bn = min(block, n)
+    grid = (pl.cdiv(n, bn),)
+
+    def spec1(): return pl.BlockSpec((bn,), lambda i: (i,))
+    def spec2(d): return pl.BlockSpec((bn, d), lambda i: (i, 0))
+
+    kern = functools.partial(
+        _kernel, lr=lr, initial_g2sum=initial_g2sum,
+        wmin=weight_bounds[0], wmax=weight_bounds[1],
+        nonclk_coeff=nonclk_coeff, click_coeff=click_coeff,
+        embedx_threshold=embedx_threshold)
+    out_shapes = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                  for a in (show, click, ew, eg2, xw, xg2, has)]
+    out_specs = [spec1(), spec1(), spec2(1), spec2(1), spec2(dim),
+                 spec2(1), spec1()]
+    in_specs = [spec1(), spec1(), spec2(1), spec2(1), spec2(dim), spec2(1),
+                spec1(), spec1(), spec1(), spec2(1), spec2(dim)]
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(show, click, ew, eg2, xw, xg2, has, dshow, dclick, g_embed, g_embedx)

@@ -47,6 +47,10 @@ class CacheConfig:
     nonclk_coeff: float = 0.1
     click_coeff: float = 1.0
     embedx_threshold: float = 10.0  # lazy mf creation score threshold
+    #: run the per-row AdaGrad math as the fused Pallas kernel
+    #: (ops/sparse_optimizer.py, the optimizer.cuh.h analogue);
+    #: None = auto (on for TPU backends, jnp elsewhere)
+    pallas_update: Optional[bool] = None
 
 
 def cache_pull(state: Dict[str, jax.Array], rows: jax.Array) -> jax.Array:
@@ -86,34 +90,52 @@ def cache_push(
     g = jax.ops.segment_sum(grads, inv, num_segments=n)  # [n, 1+dim]
     srows = jnp.where(uniq < C, uniq, 0)  # safe gather index for padding
 
-    show_rows = state["show"][srows] + show_sum
-    click_rows = state["click"][srows] + click_sum
-    scale = jnp.maximum(show_sum, 1e-10)
+    gathered = (state["show"][srows], state["click"][srows],
+                state["embed_w"][srows], state["embed_g2sum"][srows],
+                state["embedx_w"][srows], state["embedx_g2sum"][srows],
+                state["has_embedx"][srows])
 
-    def adagrad(w, g2, g_rows):  # [n,d], [n,1], [n,d] — touched rows only
-        scaled = g_rows / scale[:, None]
-        ratio = jnp.sqrt(sgd.initial_g2sum / (sgd.initial_g2sum + g2))
-        w_new = w - sgd.learning_rate * scaled * ratio
-        w_new = jnp.clip(w_new, sgd.weight_bounds[0], sgd.weight_bounds[1])
-        g2_new = g2 + jnp.mean(scaled * scaled, axis=1, keepdims=True)
-        return w_new, g2_new
+    use_pallas = cfg.pallas_update
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        # fused per-row optimizer kernel (optimizer.cuh.h analogue)
+        from ..ops.sparse_optimizer import ctr_adagrad_rows
 
-    embed_w_rows, embed_g2_rows = adagrad(
-        state["embed_w"][srows], state["embed_g2sum"][srows], g[:, :1])
+        (show_rows, click_rows, embed_w_rows, embed_g2_rows, ex_w_rows,
+         ex_g2_rows, has_rows) = ctr_adagrad_rows(
+            gathered, show_sum, click_sum, g[:, :1], g[:, 1:],
+            lr=sgd.learning_rate, initial_g2sum=sgd.initial_g2sum,
+            weight_bounds=tuple(sgd.weight_bounds),
+            nonclk_coeff=cfg.nonclk_coeff, click_coeff=cfg.click_coeff,
+            embedx_threshold=cfg.embedx_threshold)
+    else:
+        show_old, click_old, ew_old, eg2_old, ex_w_old, ex_g2_old, has_old = gathered
+        show_rows = show_old + show_sum
+        click_rows = click_old + click_sum
+        scale = jnp.maximum(show_sum, 1e-10)
 
-    # lazy embedx (mf) creation: materialize once the show/click score
-    # crosses the threshold (optimizer.cuh.h:81-94; deterministic zero
-    # init here — curand-uniform init is a per-row RNG; zeros match the
-    # reference's mean and keep the step deterministic)
-    score = (show_rows - click_rows) * cfg.nonclk_coeff + click_rows * cfg.click_coeff
-    had_mf = state["has_embedx"][srows] > 0
-    create = (~had_mf) & (score >= cfg.embedx_threshold)
-    has_rows = jnp.where(create, 1.0, state["has_embedx"][srows])
-    ex_w_old = state["embedx_w"][srows]
-    ex_g2_old = state["embedx_g2sum"][srows]
-    ex_w_new, ex_g2_new = adagrad(ex_w_old, ex_g2_old, g[:, 1:])
-    ex_w_rows = jnp.where(had_mf[:, None], ex_w_new, ex_w_old)
-    ex_g2_rows = jnp.where(had_mf[:, None], ex_g2_new, ex_g2_old)
+        def adagrad(w, g2, g_rows):  # [n,d], [n,1], [n,d] — touched rows
+            scaled = g_rows / scale[:, None]
+            ratio = jnp.sqrt(sgd.initial_g2sum / (sgd.initial_g2sum + g2))
+            w_new = w - sgd.learning_rate * scaled * ratio
+            w_new = jnp.clip(w_new, sgd.weight_bounds[0], sgd.weight_bounds[1])
+            g2_new = g2 + jnp.mean(scaled * scaled, axis=1, keepdims=True)
+            return w_new, g2_new
+
+        embed_w_rows, embed_g2_rows = adagrad(ew_old, eg2_old, g[:, :1])
+
+        # lazy embedx (mf) creation: materialize once the show/click
+        # score crosses the threshold (optimizer.cuh.h:81-94;
+        # deterministic zero init — curand-uniform is per-row RNG; zeros
+        # match the reference's mean and keep the step deterministic)
+        score = (show_rows - click_rows) * cfg.nonclk_coeff + click_rows * cfg.click_coeff
+        had_mf = has_old > 0
+        create = (~had_mf) & (score >= cfg.embedx_threshold)
+        has_rows = jnp.where(create, 1.0, has_old)
+        ex_w_new, ex_g2_new = adagrad(ex_w_old, ex_g2_old, g[:, 1:])
+        ex_w_rows = jnp.where(had_mf[:, None], ex_w_new, ex_w_old)
+        ex_g2_rows = jnp.where(had_mf[:, None], ex_g2_new, ex_g2_old)
 
     drop = dict(mode="drop")  # padding rows (sentinel C) fall away
     return {

@@ -1,0 +1,160 @@
+"""Dataset-driven sparse training: the ``train_from_dataset`` role.
+
+The reference drives CTR training with `exe.train_from_dataset(program,
+dataset)` → `Executor::RunFromDataset` (executor.cc:157) →
+`PSGPUTrainer`/`MultiTrainer` whose per-device workers loop
+`device_reader->Next()` and run pull→fwd/bwd→push (ps_gpu_worker.cc:121,
+hogwild_worker.cc:212). Here the trainer drives an ``InMemoryDataset``
+through the GPUPS pass lifecycle against the HBM cache:
+
+    pass_feasigns → cache.begin_pass (dedup + build + upload + cuckoo map)
+    per batch     → ONE jitted step (in-graph key lookup, pull, fwd/bwd,
+                    dense update, CTR AdaGrad push), fed through the
+                    async device prefetcher
+    end of pass   → cache.end_pass flush back to the host table
+
+Slot-tagged keys: feasign = slot_id << 32 | id (the framework's slot
+layout — FleetWrapper::PullSparseToTensorSync tags by tensor position).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.enforce import enforce
+from ..data.prefetcher import DevicePrefetcher
+from .embedding_cache import CacheConfig, HbmEmbeddingCache
+from .table import MemorySparseTable
+
+__all__ = ["CtrPassTrainer"]
+
+
+@dataclasses.dataclass
+class _PassStats:
+    steps: int = 0
+    samples: int = 0
+    loss_sum: float = 0.0
+
+    @property
+    def mean_loss(self) -> float:
+        return self.loss_sum / max(self.steps, 1)
+
+
+class CtrPassTrainer:
+    """PSGPUTrainer analogue over (model, table, cache).
+
+    ``sparse_slots``/``dense_slots``/``label_slot`` name the dataset's
+    slots; sparse slots contribute one feasign per record (CTR layout),
+    dense slots concatenate into the float feature vector.
+    """
+
+    def __init__(
+        self,
+        model,
+        optimizer,
+        table: MemorySparseTable,
+        cache_config: CacheConfig,
+        sparse_slots: Sequence[str],
+        dense_slots: Sequence[str],
+        label_slot: str,
+        prefetch_depth: int = 3,
+    ) -> None:
+        from ..models.ctr import make_ctr_train_step_from_keys
+
+        self.model = model
+        self.optimizer = optimizer
+        self.table = table
+        self.cache = HbmEmbeddingCache(table, cache_config, device_map=True)
+        self.sparse_slots = list(sparse_slots)
+        self.dense_slots = list(dense_slots)
+        self.label_slot = label_slot
+        self.prefetch_depth = prefetch_depth
+
+        self.params = {"params": dict(model.named_parameters()), "buffers": {}}
+        self.opt_state = optimizer.init(self.params)
+        self._step = make_ctr_train_step_from_keys(
+            model, optimizer, cache_config,
+            slot_ids=np.arange(len(self.sparse_slots)))
+
+    # -- batch packing (MiniBatchGpuPack role) ---------------------------
+
+    def _pack(self, batch: Dict[str, Tuple[np.ndarray, np.ndarray]]):
+        """Dataset batch (CSR-ish padded columns) → (lo32, dense, label).
+        One feasign per sparse slot (CTR); ids are slot-tagged so only
+        the low halves go to the device."""
+        cols = []
+        for s in self.sparse_slots:
+            vals, _ = batch[s]
+            cols.append(vals[:, 0].astype(np.uint32))  # lo32 of the id
+        lo32 = np.stack(cols, axis=1)
+        dense = (np.concatenate([batch[s][0] for s in self.dense_slots], axis=1)
+                 .astype(np.float32)
+                 if self.dense_slots else
+                 np.zeros((lo32.shape[0], 0), np.float32))
+        labels = batch[self.label_slot][0][:, 0].astype(np.int32)
+        return lo32, dense, labels
+
+    def _tagged_pass_keys(self, dataset) -> np.ndarray:
+        """All slot-tagged feasigns of the pass (the PreBuildTask dedup
+        input, ps_gpu_wrapper.cc:92): one walk over the host columns."""
+        out = []
+        for batch in dataset.batch_iter(8192, drop_last=False):
+            for si, s in enumerate(self.sparse_slots):
+                v = batch[s][0][:, 0].astype(np.uint64)
+                out.append((v & np.uint64(0xFFFFFFFF))
+                           + (np.uint64(si) << np.uint64(32)))
+        return np.concatenate(out) if out else np.zeros(0, np.uint64)
+
+    # -- the RunFromDataset loop -----------------------------------------
+
+    def train_from_dataset(self, dataset, batch_size: int = 512,
+                           drop_last: bool = True) -> Dict[str, float]:
+        """One pass over ``dataset``: begin_pass → steps → end_pass.
+        Returns {'loss': mean step loss, 'steps', 'samples',
+        'samples_per_sec'}."""
+        import time
+
+        keys = self._tagged_pass_keys(dataset)
+        enforce(len(keys) > 0, "dataset has no sparse feasigns")
+        self.cache.begin_pass(keys)
+        map_state = self.cache.device_map.state
+
+        def host_batches():
+            for batch in dataset.batch_iter(batch_size, drop_last=drop_last):
+                yield self._pack(batch)
+
+        def to_device(item):
+            lo32, dense, labels = item
+            return (jnp.asarray(lo32), jnp.asarray(dense),
+                    jnp.asarray(labels))
+
+        stats = _PassStats()
+        t0 = time.perf_counter()
+        pf = DevicePrefetcher(host_batches(), depth=self.prefetch_depth,
+                              transform=to_device)
+        losses = []  # device scalars — ONE host sync at pass end
+        try:
+            for lo32, dense, labels in pf:
+                self.params, self.opt_state, self.cache.state, loss = \
+                    self._step(self.params, self.opt_state, self.cache.state,
+                               map_state, lo32, dense, labels)
+                losses.append(loss)
+                stats.steps += 1
+                stats.samples += int(labels.shape[0])
+        finally:
+            pf.close()
+        if losses:
+            stats.loss_sum = float(jnp.sum(jnp.stack(losses)))
+        dt = time.perf_counter() - t0
+        self.cache.end_pass()
+        return {
+            "loss": stats.mean_loss,
+            "steps": float(stats.steps),
+            "samples": float(stats.samples),
+            "samples_per_sec": stats.samples / max(dt, 1e-9),
+        }

@@ -93,3 +93,50 @@ def test_key_fed_step_matches_row_fed(rng):
         np.testing.assert_array_equal(
             np.asarray(cache1.state[k]), np.asarray(cache2.state[k]),
             err_msg=f"cache[{k}]")
+
+
+def test_wide_key_step_matches_slot_tagged(rng):
+    """slot_ids=None variant (explicit hi halves) gives the identical
+    trajectory when fed the same keys."""
+    S, dim = 4, 4
+    ccfg = CtrConfig(num_sparse_slots=S, num_dense=2, embedx_dim=dim,
+                     dnn_hidden=(8,))
+    cache_cfg = CacheConfig(capacity=1 << 10, embedx_dim=dim,
+                            embedx_threshold=0.0)
+    lo = rng.integers(0, 1 << 20, size=(100, S)).astype(np.uint64)
+    pool = lo + (np.arange(S, dtype=np.uint64) << np.uint64(32))
+
+    def build():
+        pt.seed(0)
+        table = MemorySparseTable(TableConfig(
+            shard_num=4, accessor_config=AccessorConfig(embedx_dim=dim)))
+        cache = HbmEmbeddingCache(table, cache_cfg, device_map=True)
+        cache.begin_pass(pool.reshape(-1))
+        model = DeepFM(ccfg)
+        opt = optimizer.Adam(learning_rate=1e-3)
+        params = {"params": dict(model.named_parameters()), "buffers": {}}
+        return cache, model, opt, params, opt.init(params)
+
+    idx = rng.integers(0, 100, size=16)
+    keys = pool[idx]
+    dense = rng.normal(size=(16, 2)).astype(np.float32)
+    labels = (rng.random(16) < 0.4).astype(np.int32)
+    lo32 = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    hi32 = (keys >> np.uint64(32)).astype(np.uint32)
+
+    c1, m1, o1, p1, s1 = build()
+    step1 = make_ctr_train_step_from_keys(m1, o1, cache_cfg,
+                                          slot_ids=np.arange(S), donate=False)
+    _, _, st1, loss1 = step1(p1, s1, c1.state, c1.device_map.state,
+                             jnp.asarray(lo32), jnp.asarray(dense),
+                             jnp.asarray(labels))
+
+    c2, m2, o2, p2, s2 = build()
+    step2 = make_ctr_train_step_from_keys(m2, o2, cache_cfg, slot_ids=None,
+                                          donate=False)
+    _, _, st2, loss2 = step2(p2, s2, c2.state, c2.device_map.state,
+                             jnp.asarray(hi32), jnp.asarray(lo32),
+                             jnp.asarray(dense), jnp.asarray(labels))
+    np.testing.assert_array_equal(np.asarray(loss1), np.asarray(loss2))
+    for k in st1:
+        np.testing.assert_array_equal(np.asarray(st1[k]), np.asarray(st2[k]))

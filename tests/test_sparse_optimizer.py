@@ -1,0 +1,87 @@
+"""Pallas fused CTR AdaGrad row kernel (ops/sparse_optimizer.py) vs the
+jnp path — parity of the optimizer.cuh.h math (interpret mode on the CPU
+mesh, same discipline as the flash-attention tests)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ps.embedding_cache import CacheConfig, cache_push
+from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+
+
+def _state(rng, C, dim):
+    return {
+        "show": jnp.asarray(rng.uniform(0, 5, C).astype(np.float32)),
+        "click": jnp.asarray(rng.uniform(0, 2, C).astype(np.float32)),
+        "embed_w": jnp.asarray(rng.normal(size=(C, 1)).astype(np.float32)),
+        "embed_g2sum": jnp.asarray(rng.uniform(0, 1, (C, 1)).astype(np.float32)),
+        "embedx_w": jnp.asarray(rng.normal(size=(C, dim)).astype(np.float32)),
+        "embedx_g2sum": jnp.asarray(rng.uniform(0, 1, (C, 1)).astype(np.float32)),
+        "has_embedx": jnp.asarray((rng.random(C) < 0.5).astype(np.float32)),
+    }
+
+
+def test_pallas_push_matches_jnp(rng):
+    C, dim, n = 512, 4, 300
+    state = _state(rng, C, dim)
+    rows = jnp.asarray(rng.integers(0, C, n), jnp.int32)
+    grads = jnp.asarray(rng.normal(size=(n, 1 + dim)).astype(np.float32))
+    shows = jnp.ones((n,), jnp.float32)
+    clicks = jnp.asarray((rng.random(n) < 0.4).astype(np.float32))
+
+    cfg_j = CacheConfig(capacity=C, embedx_dim=dim, embedx_threshold=3.0,
+                        pallas_update=False)
+    cfg_p = CacheConfig(capacity=C, embedx_dim=dim, embedx_threshold=3.0,
+                        pallas_update=True)
+    a = jax.jit(lambda st: cache_push(st, rows, grads, shows, clicks, cfg_j))(state)
+    b = jax.jit(lambda st: cache_push(st, rows, grads, shows, clicks, cfg_p))(state)
+    for k in a:
+        np.testing.assert_allclose(np.asarray(b[k]), np.asarray(a[k]),
+                                   rtol=1e-6, atol=1e-7, err_msg=k)
+    # lifecycle flags are exact
+    np.testing.assert_array_equal(np.asarray(b["has_embedx"]),
+                                  np.asarray(a["has_embedx"]))
+
+
+def test_pallas_push_unaligned_n(rng):
+    # n not a multiple of the kernel block exercises the padded tail —
+    # cache_push uses the kernel default, so shrink n below it is not
+    # enough; drive the kernel directly with block=64 over n=300
+    from paddle_tpu.ops.sparse_optimizer import ctr_adagrad_rows
+
+    C, dim, n = 256, 8, 300
+    state = _state(rng, C, dim)
+    srows = jnp.asarray(rng.integers(0, C, n), jnp.int32)
+    gathered = tuple(state[k][srows] for k in
+                     ("show", "click", "embed_w", "embed_g2sum",
+                      "embedx_w", "embedx_g2sum", "has_embedx"))
+    dshow = jnp.ones((n,), jnp.float32)
+    dclick = jnp.asarray((rng.random(n) < 0.3).astype(np.float32))
+    ge = jnp.asarray(rng.normal(size=(n, 1)).astype(np.float32))
+    gx = jnp.asarray(rng.normal(size=(n, dim)).astype(np.float32))
+    kw = dict(lr=0.05, initial_g2sum=3.0, weight_bounds=(-10.0, 10.0),
+              nonclk_coeff=0.1, click_coeff=1.0, embedx_threshold=0.0)
+    small = ctr_adagrad_rows(gathered, dshow, dclick, ge, gx, block=64, **kw)
+    full = ctr_adagrad_rows(gathered, dshow, dclick, ge, gx, block=1024, **kw)
+    for a, b in zip(small, full):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_pallas_push_in_cache_small(rng):
+    C, dim, n = 256, 8, 129
+    state = _state(rng, C, dim)
+    rows = jnp.asarray(rng.integers(0, C, n), jnp.int32)
+    grads = jnp.asarray(rng.normal(size=(n, 1 + dim)).astype(np.float32))
+    shows = jnp.ones((n,), jnp.float32)
+    clicks = jnp.zeros((n,), jnp.float32)
+    cfg = CacheConfig(capacity=C, embedx_dim=dim, embedx_threshold=0.0,
+                      pallas_update=True)
+    cfg_ref = CacheConfig(capacity=C, embedx_dim=dim, embedx_threshold=0.0,
+                          pallas_update=False)
+    b = jax.jit(lambda st: cache_push(st, rows, grads, shows, clicks, cfg))(state)
+    a = jax.jit(lambda st: cache_push(st, rows, grads, shows, clicks, cfg_ref))(state)
+    for k in a:
+        np.testing.assert_allclose(np.asarray(b[k]), np.asarray(a[k]),
+                                   rtol=1e-6, atol=1e-7, err_msg=k)
