@@ -251,7 +251,15 @@ class HbmEmbeddingCache:
         if self._device_map_enabled:
             from .device_hash import DeviceKeyMap
 
-            self.device_map = DeviceKeyMap(uniq, rows)
+            map_sharding = None
+            if self._n_shards > 1:  # __init__ set _sharding with the mesh
+                # replicate the key→row map across the serving mesh (the
+                # probe runs per device on its local batch slice)
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                map_sharding = NamedSharding(self._sharding.mesh,
+                                             PartitionSpec())
+            self.device_map = DeviceKeyMap(uniq, rows, sharding=map_sharding)
 
         if self._sharding is not None:
             self.state = {

@@ -52,6 +52,7 @@ __all__ = [
     "shard_spread_rows",
     "shard_unspread_rows",
     "make_sharded_ctr_train_step",
+    "make_sharded_ctr_train_step_from_keys",
 ]
 
 Axis = Union[str, Tuple[str, ...]]
@@ -143,36 +144,92 @@ def make_sharded_ctr_train_step(
     K = mesh.shape[axis]
 
     def inner(params, opt_state, cache_state, rows, dense_x, labels):
-        B, S = rows.shape  # local slice
         flat = rows.reshape(-1)
-        emb = sharded_cache_pull(cache_state, flat, axis).reshape(B, S, -1)
-
-        def loss_fn(params, emb):
-            out, _ = nn.functional_call(model, params, emb, dense_x,
-                                        training=True)
-            loss = nn.functional.binary_cross_entropy_with_logits(
-                out, labels.astype(jnp.float32))
-            return loss, out
-
-        (loss, _), (grads, emb_grad) = jax.value_and_grad(
-            loss_fn, argnums=(0, 1), has_aux=True)(params, emb)
-        # local-mean → global-mean: pmean dense grads; scale emb grads by
-        # 1/K (exact for power-of-two K) so push matches the unsharded step
-        grads = jax.tree.map(lambda g: lax.pmean(g, axis), grads)
-        emb_grad = emb_grad / K
-        loss = lax.pmean(loss, axis)
-
-        new_params, new_opt = optimizer.update(grads, opt_state, params)
-        shows = jnp.ones((B * S,), jnp.float32)
-        clicks = jnp.repeat(labels.astype(jnp.float32), S)
-        new_cache = sharded_cache_push(cache_state, flat,
-                                       emb_grad.reshape(B * S, -1), shows,
-                                       clicks, cache_cfg, axis)
-        return new_params, new_opt, new_cache, loss
+        return _sharded_step_body(model, optimizer, cache_cfg, axis, K,
+                                  params, opt_state, cache_state, flat,
+                                  rows.shape[0], rows.shape[1], dense_x,
+                                  labels)
 
     shmapped = shard_map(
         inner, mesh=mesh,
         in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis)),
+        out_specs=(P(), P(), P(axis), P()),
+        check_vma=False,
+    )
+    return jax.jit(shmapped, donate_argnums=(0, 1, 2) if donate else ())
+
+
+def _sharded_step_body(model, optimizer, cache_cfg, axis, K, params,
+                       opt_state, cache_state, flat_rows, B, S, dense_x,
+                       labels):
+    """Per-rank body of the multi-chip CTR step: sharded pull, local
+    fwd/bwd, grad pmean (Reducer role), sharded push. ``flat_rows`` are
+    GLOBAL spread row ids for this rank's batch slice; sentinel rows
+    (≥ global capacity) pull zeros and drop their pushes."""
+    emb = sharded_cache_pull(cache_state, flat_rows, axis).reshape(B, S, -1)
+
+    def loss_fn(params, emb):
+        out, _ = nn.functional_call(model, params, emb, dense_x,
+                                    training=True)
+        loss = nn.functional.binary_cross_entropy_with_logits(
+            out, labels.astype(jnp.float32))
+        return loss, out
+
+    (loss, _), (grads, emb_grad) = jax.value_and_grad(
+        loss_fn, argnums=(0, 1), has_aux=True)(params, emb)
+    # local-mean → global-mean: pmean dense grads; scale emb grads by
+    # 1/K (exact for power-of-two K) so push matches the unsharded step
+    grads = jax.tree.map(lambda g: lax.pmean(g, axis), grads)
+    emb_grad = emb_grad / K
+    loss = lax.pmean(loss, axis)
+
+    new_params, new_opt = optimizer.update(grads, opt_state, params)
+    shows = jnp.ones((B * S,), jnp.float32)
+    clicks = jnp.repeat(labels.astype(jnp.float32), S)
+    new_cache = sharded_cache_push(cache_state, flat_rows,
+                                   emb_grad.reshape(B * S, -1), shows,
+                                   clicks, cache_cfg, axis)
+    return new_params, new_opt, new_cache, loss
+
+
+def make_sharded_ctr_train_step_from_keys(
+    model,
+    optimizer,
+    cache_cfg: CacheConfig,
+    mesh: Mesh,
+    slot_ids,
+    axis: str = "ps",
+    donate: bool = True,
+) -> Callable:
+    """Multi-chip GPUPS step with IN-GRAPH key lookup: each device probes
+    its local batch slice's slot-tagged keys against the replicated
+    per-pass cuckoo map (ps/device_hash.py — the HeterComm CopyKeys +
+    HashTable::get front half) and serves pull/push from the row-sharded
+    cache over ``axis``. The complete compiled analogue of
+    PSGPUWorker::TrainFiles on a multi-chip mesh.
+
+    step(params, opt_state, cache_state, map_state, keys_lo, dense_x,
+         labels) → (params, opt_state, cache_state, loss)
+    """
+    from .device_hash import device_hash_lookup
+
+    K = mesh.shape[axis]
+    slot_hi = jnp.asarray(np.asarray(slot_ids, np.uint32))[None, :]
+
+    def inner(params, opt_state, cache_state, map_state, keys_lo, dense_x,
+              labels):
+        B, S = keys_lo.shape  # local slice
+        hi = jnp.broadcast_to(slot_hi, (B, S)).reshape(-1)
+        rows = device_hash_lookup(map_state, hi, keys_lo.reshape(-1))
+        C_total = cache_state["embed_w"].shape[0] * K  # global capacity
+        rows = jnp.where(rows >= 0, rows, C_total)  # sentinel: no owner
+        return _sharded_step_body(model, optimizer, cache_cfg, axis, K,
+                                  params, opt_state, cache_state, rows, B, S,
+                                  dense_x, labels)
+
+    shmapped = shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(), P(), P(axis), P(), P(axis), P(axis), P(axis)),
         out_specs=(P(), P(), P(axis), P()),
         check_vma=False,
     )

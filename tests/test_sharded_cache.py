@@ -161,3 +161,62 @@ def test_sharded_ctr_end_to_end_vs_single_device(rng):
     assert np.isfinite(got_loss)
     np.testing.assert_allclose(got_loss, ref_loss, rtol=1e-4)
     np.testing.assert_allclose(got_vals, ref_vals, rtol=2e-4, atol=1e-5)
+
+
+def test_sharded_key_fed_matches_row_fed(rng):
+    """In-graph lookup + sharded serving: identical trajectory to the
+    host-lookup sharded step (the complete multi-chip GPUPS worker)."""
+    from paddle_tpu.ps.sharded_cache import make_sharded_ctr_train_step_from_keys
+
+    dim, S = 4, 5
+    ccfg = CtrConfig(num_sparse_slots=S, num_dense=3, embedx_dim=dim,
+                     dnn_hidden=(8,))
+    cache_cfg = CacheConfig(capacity=1 << 12, embedx_dim=dim,
+                            embedx_threshold=0.0)
+    lo = rng.integers(0, 1 << 20, size=(200, S)).astype(np.uint64)
+    pool = lo + (np.arange(S, dtype=np.uint64) << np.uint64(32))
+    mesh = _mesh()
+
+    def build(device_map):
+        pt.seed(0)
+        table = MemorySparseTable(TableConfig(
+            shard_num=4, accessor_config=AccessorConfig(embedx_dim=dim)))
+        cache = HbmEmbeddingCache(table, cache_cfg, mesh=mesh, axis="ps",
+                                  device_map=device_map)
+        cache.begin_pass(pool.reshape(-1))
+        model = DeepFM(ccfg)
+        opt = optimizer.Adam(learning_rate=1e-3)
+        params = {"params": dict(model.named_parameters()), "buffers": {}}
+        return cache, model, opt, params, opt.init(params)
+
+    idx = rng.integers(0, 200, size=(3, 16))
+    dense = rng.normal(size=(3, 16, 3)).astype(np.float32)
+    labels = (rng.random((3, 16)) < 0.4).astype(np.int32)
+
+    c1, m1, o1, p1, s1 = build(device_map=False)
+    step1 = make_sharded_ctr_train_step(m1, o1, cache_cfg, mesh, axis="ps",
+                                        donate=False)
+    for t in range(3):
+        keys = pool[idx[t]]
+        rows = jnp.asarray(c1.lookup(keys.reshape(-1)).reshape(keys.shape))
+        p1, s1, c1.state, loss1 = step1(p1, s1, c1.state, rows,
+                                        jnp.asarray(dense[t]),
+                                        jnp.asarray(labels[t]))
+
+    c2, m2, o2, p2, s2 = build(device_map=True)
+    step2 = make_sharded_ctr_train_step_from_keys(
+        m2, o2, cache_cfg, mesh, slot_ids=np.arange(S), axis="ps",
+        donate=False)
+    for t in range(3):
+        lo32 = (pool[idx[t]] & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        p2, s2, c2.state, loss2 = step2(p2, s2, c2.state,
+                                        c2.device_map.state,
+                                        jnp.asarray(lo32),
+                                        jnp.asarray(dense[t]),
+                                        jnp.asarray(labels[t]))
+
+    np.testing.assert_array_equal(np.asarray(loss1), np.asarray(loss2))
+    for k in c1.state:
+        np.testing.assert_array_equal(np.asarray(c1.state[k]),
+                                      np.asarray(c2.state[k]),
+                                      err_msg=f"state[{k}]")
