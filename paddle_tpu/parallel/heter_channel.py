@@ -78,7 +78,8 @@ def _encode(item: Dict[str, Any]) -> bytes:
     enforce(isinstance(item, dict), "channel items are dicts of arrays")
     parts = [_MAGIC, struct.pack("<I", len(item))]
     for name, val in item.items():
-        arr = np.ascontiguousarray(val)
+        arr = np.asarray(val)  # tobytes() below emits C-order bytes for
+        # any layout (and ascontiguousarray would promote 0-d to 1-d)
         nb = name.encode()
         db = arr.dtype.str.encode()
         parts.append(struct.pack("<HH B", len(nb), len(db), arr.ndim))

@@ -55,10 +55,15 @@ class VocabParallelEmbedding(Layer):
     def __init__(self, num_embeddings: int, embedding_dim: int, mp_size: int = 1,
                  mp_rank: int = 0, mesh_axis: Optional[str] = "mp") -> None:
         super().__init__()
-        enforce_eq(num_embeddings % max(mp_size, 1), 0, "vocab must divide mp size")
+        # Megatron-style vocab padding: round the sharded vocab up to a
+        # multiple of mp_size; padded rows exist but no real id reaches
+        # them (ids < num_embeddings), so their init values are inert —
+        # non-divisible vocabularies keep working
+        mp = max(mp_size, 1)
         self.num_embeddings = num_embeddings
+        self.padded_vocab = ((num_embeddings + mp - 1) // mp) * mp
         self.mesh_axis = mesh_axis if mp_size > 1 else None
-        self.per_part = num_embeddings // max(mp_size, 1)
+        self.per_part = self.padded_vocab // mp
         self.mp_rank = mp_rank
         scale = 1.0 / np.sqrt(embedding_dim)
         # fold mp_rank into the init key so each rank's vocab shard gets a
@@ -78,7 +83,10 @@ class VocabParallelEmbedding(Layer):
         rank = lax.axis_index(self.mesh_axis)
         start = rank * self.per_part
         local = ids - start
-        in_range = (local >= 0) & (local < self.per_part)
+        # ids ≥ num_embeddings (incl. the padded tail rows) contribute
+        # zeros on every rank — the documented c_embedding semantics
+        in_range = ((local >= 0) & (local < self.per_part)
+                    & (ids < self.num_embeddings))
         safe = jnp.clip(local, 0, self.per_part - 1)
         out = jnp.take(self.weight, safe, axis=0)
         out = jnp.where(in_range[..., None], out, 0.0)

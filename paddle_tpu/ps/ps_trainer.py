@@ -110,6 +110,76 @@ class CtrPassTrainer:
                            + (np.uint64(si) << np.uint64(32)))
         return np.concatenate(out) if out else np.zeros(0, np.uint64)
 
+    # -- checkpoint / resume (fleet.save_persistables role) --------------
+
+    def save(self, dirname: str, mode: int = 0) -> None:
+        """Persist the full training state: sparse table shards (accessor
+        save format + mode filter, fleet.save_persistables →
+        FleetWrapper::SaveModel) and the dense params/opt snapshot.
+        Call at a pass boundary (cache flushed)."""
+        import os
+
+        from ..io.checkpoint import save_checkpoint
+
+        enforce(self.cache.state is None,
+                "save at a pass boundary (after end_pass)")
+        os.makedirs(dirname, exist_ok=True)
+        self.table.save(os.path.join(dirname, "sparse"), mode=mode)
+        save_checkpoint(os.path.join(dirname, "dense"),
+                        self.params, self.opt_state)
+
+    def load(self, dirname: str) -> None:
+        """Restore table + dense state saved by :meth:`save`."""
+        import os
+
+        from ..io.checkpoint import load_checkpoint
+
+        self.table.load(os.path.join(dirname, "sparse"))
+        snap = load_checkpoint(os.path.join(dirname, "dense"))
+        self.params = snap["model"]
+        self.opt_state = snap["opt"]
+
+    # -- evaluation (worker AUC metric role, metrics_py.cc) --------------
+
+    def evaluate(self, dataset, batch_size: int = 1024):
+        """AUC over ``dataset`` against the HOST table state (pull
+        create=False — unseen features contribute zeros), the reference's
+        in-training metric pass. Returns {"auc": float,
+        "auc_buckets": [2, B] ndarray} — multi-worker callers sum the
+        buckets across workers via ``fleet.util.all_reduce`` and recompute
+        (metrics/auc.auc_from_buckets), the GlooWrapper reduce pattern."""
+        import jax.nn as jnn
+
+        from .. import nn
+        from ..metrics.auc import AUC
+
+        if not hasattr(self, "_infer"):
+            model = self.model
+
+            def infer(params, emb, dense_x):
+                out, _ = nn.functional_call(model, params, emb, dense_x,
+                                            training=False)
+                return jnn.sigmoid(out)
+
+            self._infer = jax.jit(infer)
+
+        S = len(self.sparse_slots)
+        dim = self.cache.config.embedx_dim
+        metric = AUC()
+        for batch in dataset.batch_iter(batch_size, drop_last=False):
+            lo32, dense, labels = self._pack(batch)
+            keys = (lo32.astype(np.uint64)
+                    + (np.arange(S, dtype=np.uint64) << np.uint64(32))).reshape(-1)
+            pulled = self.table.pull_sparse(keys, create=False)
+            # trailing 1+dim columns = embed_w ++ embedx for BOTH accessor
+            # layouts (CTR prefixes show/click; Sparse doesn't)
+            emb = pulled[:, -(1 + dim):].reshape(-1, S, 1 + dim)
+            probs = np.asarray(self._infer(self.params, jnp.asarray(emb),
+                                           jnp.asarray(dense)))
+            metric.update(probs, labels)
+        return {"auc": float(metric.accumulate()),
+                "auc_buckets": metric._buckets.copy()}
+
     # -- the RunFromDataset loop -----------------------------------------
 
     def train_from_dataset(self, dataset, batch_size: int = 512,

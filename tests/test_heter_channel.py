@@ -158,3 +158,12 @@ def test_two_process_cpu_to_device_pipeline(tmp_path):
         s, m = got[i]
         np.testing.assert_allclose(s, float(np.sum(xn * 2.0)), atol=1e-4)
         np.testing.assert_allclose(m, float(np.mean(xn)), atol=1e-5)
+
+
+def test_scalar_shape_preserved(rng):
+    srv = ChannelServer(capacity=2)
+    cli = ChannelClient("127.0.0.1", srv.port)
+    cli.send({"s": np.asarray(7, np.int64), "v": np.asarray([7], np.int64)})
+    got = srv.recv(timeout=10)
+    assert got["s"].shape == () and got["v"].shape == (1,)
+    srv.close(); cli.close()

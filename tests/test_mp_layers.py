@@ -121,3 +121,37 @@ def test_parallel_cross_entropy_grad_matches_serial(mesh):
         jax.grad(loss_fn), mesh=mesh, in_specs=(P(None, "mp"), P(None)), out_specs=P(None, "mp")
     )(jnp.asarray(logits), jnp.asarray(labels))
     np.testing.assert_allclose(np.asarray(grad), np.asarray(serial_grad), rtol=1e-4, atol=1e-5)
+
+
+def test_vocab_parallel_padded_non_divisible():
+    """Non-divisible vocab pads up (Megatron-style): gather over mp still
+    returns each real id's row exactly once."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu as pt
+    from paddle_tpu.core import mesh as mesh_mod
+    from paddle_tpu.parallel.mp_layers import VocabParallelEmbedding
+
+    vocab, dim, mp = 13, 8, 4  # 13 % 4 != 0 → padded to 16
+    mesh = mesh_mod.make_mesh({"dp": 2, "mp": mp})
+    pt.seed(0)
+    layers = [VocabParallelEmbedding(vocab, dim, mp_size=mp, mp_rank=r)
+              for r in range(mp)]
+    assert layers[0].per_part == 4
+    import numpy as np
+
+    full = np.concatenate([np.asarray(l.weight) for l in layers])[:vocab]
+    stacked = jnp.stack([l.weight for l in layers])  # [mp, per, dim]
+
+    ids = jnp.asarray(np.arange(vocab, dtype=np.int32))
+
+    def fwd(w_local, ids):
+        layers[0].weight = w_local[0]
+        return layers[0](ids)
+
+    out = jax.jit(shard_map(
+        fwd, mesh=mesh, in_specs=(P("mp"), P()), out_specs=P(),
+        check_vma=False))(stacked, ids)
+    np.testing.assert_allclose(np.asarray(out), full, rtol=1e-6)
