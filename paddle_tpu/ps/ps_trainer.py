@@ -31,7 +31,27 @@ from ..data.prefetcher import DevicePrefetcher
 from .embedding_cache import CacheConfig, HbmEmbeddingCache
 from .table import MemorySparseTable
 
-__all__ = ["CtrPassTrainer"]
+__all__ = ["CtrPassTrainer", "CtrStreamTrainer"]
+
+
+def _slot_tagged_keys(batch, sparse_slots) -> np.ndarray:
+    """[B, S] slot-tagged feasigns (slot_id << 32 | lo32) from a dataset
+    batch's sparse columns — THE key-layout definition both trainers
+    share."""
+    cols = []
+    for si, s in enumerate(sparse_slots):
+        v = batch[s][0][:, 0].astype(np.uint64)
+        cols.append((v & np.uint64(0xFFFFFFFF))
+                    + (np.uint64(si) << np.uint64(32)))
+    return np.stack(cols, axis=1)
+
+
+def _dense_and_labels(batch, dense_slots, label_slot, n_rows: int):
+    dense = (np.concatenate([batch[s][0] for s in dense_slots], axis=1)
+             .astype(np.float32)
+             if dense_slots else np.zeros((n_rows, 0), np.float32))
+    labels = batch[label_slot][0][:, 0].astype(np.int32)
+    return dense, labels
 
 
 @dataclasses.dataclass
@@ -87,27 +107,17 @@ class CtrPassTrainer:
         """Dataset batch (CSR-ish padded columns) → (lo32, dense, label).
         One feasign per sparse slot (CTR); ids are slot-tagged so only
         the low halves go to the device."""
-        cols = []
-        for s in self.sparse_slots:
-            vals, _ = batch[s]
-            cols.append(vals[:, 0].astype(np.uint32))  # lo32 of the id
-        lo32 = np.stack(cols, axis=1)
-        dense = (np.concatenate([batch[s][0] for s in self.dense_slots], axis=1)
-                 .astype(np.float32)
-                 if self.dense_slots else
-                 np.zeros((lo32.shape[0], 0), np.float32))
-        labels = batch[self.label_slot][0][:, 0].astype(np.int32)
+        tagged = _slot_tagged_keys(batch, self.sparse_slots)
+        lo32 = (tagged & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        dense, labels = _dense_and_labels(batch, self.dense_slots,
+                                          self.label_slot, lo32.shape[0])
         return lo32, dense, labels
 
     def _tagged_pass_keys(self, dataset) -> np.ndarray:
         """All slot-tagged feasigns of the pass (the PreBuildTask dedup
         input, ps_gpu_wrapper.cc:92): one walk over the host columns."""
-        out = []
-        for batch in dataset.batch_iter(8192, drop_last=False):
-            for si, s in enumerate(self.sparse_slots):
-                v = batch[s][0][:, 0].astype(np.uint64)
-                out.append((v & np.uint64(0xFFFFFFFF))
-                           + (np.uint64(si) << np.uint64(32)))
+        out = [_slot_tagged_keys(b, self.sparse_slots).reshape(-1)
+               for b in dataset.batch_iter(8192, drop_last=False)]
         return np.concatenate(out) if out else np.zeros(0, np.uint64)
 
     # -- checkpoint / resume (fleet.save_persistables role) --------------
@@ -180,7 +190,7 @@ class CtrPassTrainer:
         return {"auc": float(metric.accumulate()),
                 "auc_buckets": metric._buckets.copy()}
 
-    # -- the RunFromDataset loop -----------------------------------------
+    # -- the RunFromDataset loop (see class docstring) --------------------
 
     def train_from_dataset(self, dataset, batch_size: int = 512,
                            drop_last: bool = True) -> Dict[str, float]:
@@ -222,6 +232,129 @@ class CtrPassTrainer:
             stats.loss_sum = float(jnp.sum(jnp.stack(losses)))
         dt = time.perf_counter() - t0
         self.cache.end_pass()
+        return {
+            "loss": stats.mean_loss,
+            "steps": float(stats.steps),
+            "samples": float(stats.samples),
+            "samples_per_sec": stats.samples / max(dt, 1e-9),
+        }
+
+
+class CtrStreamTrainer:
+    """the_one_ps CPU-table worker loop (streaming, no pass build).
+
+    The reference's non-GPUPS CTR path: `HogwildWorker::TrainFiles`
+    (hogwild_worker.cc:212) pulls from the host MemorySparseTable per
+    batch (`distributed_lookup_table` → PullSparseToTensorSync), runs the
+    dense fwd/bwd, and pushes gradients — synchronously or through the
+    async Communicator queue (communicator.cc:554 MainThread merge+send).
+    Works with streaming datasets (QueueDataset) since no pass-wide key
+    scan is needed; the HBM-cache pass path (CtrPassTrainer) is the
+    higher-throughput choice when the working set fits.
+
+    With a ``communicator``, BOTH pulls and pushes route through its
+    PSClient under ``table_id`` (pushes async via the queue) — the table
+    may be remote; ``table`` is then unused and may be None. Without
+    one, ``table`` is the local host table accessed synchronously.
+    """
+
+    def __init__(
+        self,
+        model,
+        optimizer,
+        table: Optional[MemorySparseTable],
+        sparse_slots: Sequence[str],
+        dense_slots: Sequence[str],
+        label_slot: str,
+        communicator=None,   # route via its PSClient (pushes async)
+        table_id: int = 0,
+        embedx_dim: Optional[int] = None,
+    ) -> None:
+        from .. import nn
+
+        enforce(table is not None or communicator is not None,
+                "need a local table or a communicator-wrapped client")
+        self.model = model
+        self.table = table
+        self.sparse_slots = list(sparse_slots)
+        self.dense_slots = list(dense_slots)
+        self.label_slot = label_slot
+        self.communicator = communicator
+        self.table_id = table_id
+        if embedx_dim is not None:
+            self._dim = int(embedx_dim)
+        else:
+            enforce(table is not None,
+                    "pass embedx_dim when no local table is given")
+            self._dim = table.accessor.config.embedx_dim
+        self._pull_width = 1 + self._dim
+
+        self.params = {"params": dict(model.named_parameters()), "buffers": {}}
+        self.opt_state = optimizer.init(self.params)
+        opt = optimizer
+
+        def loss_fn(params, emb, dense_x, labels):
+            out, _ = nn.functional_call(model, params, emb, dense_x,
+                                        training=True)
+            loss = nn.functional.binary_cross_entropy_with_logits(
+                out, labels.astype(jnp.float32))
+            return loss, out
+
+        @jax.jit
+        def step(params, opt_state, emb, dense_x, labels):
+            (loss, _), (grads, emb_grad) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(params, emb, dense_x,
+                                                       labels)
+            new_params, new_opt = opt.update(grads, opt_state, params)
+            return new_params, new_opt, loss, emb_grad
+
+        self._step = step
+
+    def train_from_dataset(self, dataset, batch_size: int = 512,
+                           drop_last: bool = True) -> Dict[str, float]:
+        import inspect
+        import time
+
+        S = len(self.sparse_slots)
+        slot_ids = np.tile(np.arange(S, dtype=np.int32), batch_size)
+        # streaming QueueDataset.batch_iter has no drop_last
+        kw = ({"drop_last": drop_last} if "drop_last" in
+              inspect.signature(dataset.batch_iter).parameters else {})
+        stats = _PassStats()
+        t0 = time.perf_counter()
+        for batch in dataset.batch_iter(batch_size, **kw):
+            keys = _slot_tagged_keys(batch, self.sparse_slots)
+            flat = keys.reshape(-1)
+            dense, labels = _dense_and_labels(batch, self.dense_slots,
+                                              self.label_slot, keys.shape[0])
+
+            if self.communicator is not None:  # same client as the pushes
+                pulled = self.communicator.client.pull_sparse(
+                    self.table_id, flat, create=True)
+            else:
+                pulled = self.table.pull_sparse(
+                    flat, slots=slot_ids[:len(flat)], create=True)
+            emb = pulled[:, -self._pull_width:].reshape(
+                keys.shape[0], S, self._pull_width)
+            self.params, self.opt_state, loss, emb_grad = self._step(
+                self.params, self.opt_state, jnp.asarray(emb),
+                jnp.asarray(dense), jnp.asarray(labels))
+            g = np.asarray(emb_grad).reshape(-1, self._pull_width)
+            push = np.empty((len(flat), 4 + self._dim), np.float32)
+            push[:, 0] = slot_ids[:len(flat)]
+            push[:, 1] = 1.0                        # show
+            push[:, 2] = np.repeat(labels, S)       # click
+            push[:, 3:] = g
+            if self.communicator is not None:
+                self.communicator.send_sparse(self.table_id, flat, push)
+            else:
+                self.table.push_sparse(flat, push)
+            stats.steps += 1
+            stats.samples += int(labels.shape[0])
+            stats.loss_sum += float(loss)
+        dt = time.perf_counter() - t0
+        if self.communicator is not None:
+            self.communicator.barrier()  # drain the async send queues
         return {
             "loss": stats.mean_loss,
             "steps": float(stats.steps),

@@ -172,3 +172,83 @@ def test_save_load_resume(rng, tmp_path):
     b.load(str(tmp_path / "ck"))
     lb = b.train_from_dataset(ds, batch_size=128)["loss"]
     np.testing.assert_allclose(lb, la, rtol=1e-5)
+
+
+def test_stream_trainer_sync_learns(rng):
+    """CtrStreamTrainer (the_one_ps CPU-table worker loop): direct
+    pull/push against the host table learns the synthetic signal."""
+    from paddle_tpu.ps.ps_trainer import CtrStreamTrainer
+
+    pt.seed(0)
+    ds = InMemoryDataset(_slots(), seed=0)
+    ds.load_from_lines(_lines(rng, 2048))
+    cfg = CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=4,
+                    dnn_hidden=(16, 16))
+    table = MemorySparseTable(TableConfig(
+        shard_num=4,
+        accessor_config=AccessorConfig(embedx_dim=4, embedx_threshold=0.0)))
+    tr = CtrStreamTrainer(DeepFM(cfg), optimizer.Adam(1e-2), table,
+                          sparse_slots=[f"s{i}" for i in range(S)],
+                          dense_slots=[f"d{i}" for i in range(D)],
+                          label_slot="label")
+    losses = [tr.train_from_dataset(ds, batch_size=256)["loss"]
+              for _ in range(5)]
+    assert losses[-1] < losses[0] * 0.8, losses
+    assert table.size() > 0
+
+
+def test_stream_trainer_async_communicator(rng):
+    """Async push through the Communicator queue converges too (stale
+    pushes tolerated — the a_sync mode semantics)."""
+    from paddle_tpu.ps.client import LocalPsClient, PsServerHandle
+    from paddle_tpu.ps.communicator import AsyncCommunicator
+    from paddle_tpu.ps.ps_trainer import CtrStreamTrainer
+
+    pt.seed(0)
+    ds = InMemoryDataset(_slots(), seed=0)
+    ds.load_from_lines(_lines(rng, 2048))
+    cfg = CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=4,
+                    dnn_hidden=(16, 16))
+    server = PsServerHandle()
+    table = server.create_sparse_table(0, TableConfig(
+        shard_num=4,
+        accessor_config=AccessorConfig(embedx_dim=4, embedx_threshold=0.0)))
+    comm = AsyncCommunicator(LocalPsClient(server))
+    comm.start()
+    try:
+        tr = CtrStreamTrainer(DeepFM(cfg), optimizer.Adam(1e-2), table,
+                              sparse_slots=[f"s{i}" for i in range(S)],
+                              dense_slots=[f"d{i}" for i in range(D)],
+                              label_slot="label", communicator=comm,
+                              table_id=0)
+        losses = [tr.train_from_dataset(ds, batch_size=256)["loss"]
+                  for _ in range(5)]
+    finally:
+        comm.stop()
+    assert losses[-1] < losses[0] * 0.85, losses
+
+
+def test_stream_trainer_queue_dataset(rng, tmp_path):
+    """Streaming source (QueueDataset) drives the worker loop — no
+    pass-wide key scan needed."""
+    from paddle_tpu.data.dataset import QueueDataset
+    from paddle_tpu.ps.ps_trainer import CtrStreamTrainer
+
+    pt.seed(0)
+    path = tmp_path / "part-0.txt"
+    path.write_text("\n".join(_lines(rng, 1024)))
+    cfg = CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=4,
+                    dnn_hidden=(16,))
+    table = MemorySparseTable(TableConfig(
+        shard_num=4,
+        accessor_config=AccessorConfig(embedx_dim=4, embedx_threshold=0.0)))
+    tr = CtrStreamTrainer(DeepFM(cfg), optimizer.Adam(1e-2), table,
+                          sparse_slots=[f"s{i}" for i in range(S)],
+                          dense_slots=[f"d{i}" for i in range(D)],
+                          label_slot="label")
+    losses = []
+    for _ in range(3):
+        qd = QueueDataset(_slots())
+        qd.set_filelist([str(path)])
+        losses.append(tr.train_from_dataset(qd, batch_size=128)["loss"])
+    assert losses[-1] < losses[0], losses
