@@ -129,23 +129,32 @@ def make_ctr_train_step(
     buffers are donated so HBM is updated in place.
     """
 
-    def step(params, opt_state, cache_state, rows, dense_x, labels):
+    def step(params, opt_state, cache_state, rows, dense_x, labels,
+             weights=None):
         B, S = rows.shape
         return _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
                               cache_state, rows.reshape(-1), B, S, dense_x,
-                              labels)
+                              labels, weights)
 
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
 
 
 def _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
-                   cache_state, flat_rows, B, S, dense_x, labels):
+                   cache_state, flat_rows, B, S, dense_x, labels,
+                   weights=None):
+    """``weights`` ([B] 0/1, optional): tail-batch padding mask — the
+    reference pads the final mini-batch to a fixed shape rather than
+    recompiling; padded examples contribute neither loss nor pushes."""
+
     def loss_fn(params, emb):
         out, _ = nn.functional_call(model, params, emb, dense_x,
                                     training=True)
-        loss = nn.functional.binary_cross_entropy_with_logits(
-            out, labels.astype(jnp.float32))
-        return loss, out
+        per = nn.functional.binary_cross_entropy_with_logits(
+            out, labels.astype(jnp.float32), reduction="none")
+        if weights is None:
+            return jnp.mean(per), out
+        w = weights.astype(jnp.float32)
+        return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0), out
 
     C = cache_state["embed_w"].shape[0]
     emb_flat = cache_pull(cache_state, flat_rows)
@@ -159,8 +168,12 @@ def _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
 
     new_params, new_opt = optimizer.update(grads, opt_state, params)
 
-    shows = jnp.ones((B * S,), jnp.float32)
-    clicks = jnp.repeat(labels.astype(jnp.float32), S)
+    if weights is None:
+        shows = jnp.ones((B * S,), jnp.float32)
+        clicks = jnp.repeat(labels.astype(jnp.float32), S)
+    else:
+        shows = jnp.repeat(weights.astype(jnp.float32), S)
+        clicks = jnp.repeat(labels.astype(jnp.float32), S) * shows
     new_cache = cache_push(cache_state, flat_rows,
                            emb_grad.reshape(B * S, -1), shows, clicks,
                            cache_cfg)
@@ -200,27 +213,28 @@ def make_ctr_train_step_from_keys(
                if slot_ids is not None else None)
 
     def _finish(params, opt_state, cache_state, hi, lo, B, S, dense_x,
-                labels, map_state):
+                labels, map_state, weights):
         rows = device_hash_lookup(map_state, hi, lo)
         C = cache_state["embed_w"].shape[0]
         rows = jnp.where(rows >= 0, rows, C)
         return _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
-                              cache_state, rows, B, S, dense_x, labels)
+                              cache_state, rows, B, S, dense_x, labels,
+                              weights)
 
     if slot_ids is not None:
         def step(params, opt_state, cache_state, map_state, keys_lo,
-                 dense_x, labels):
+                 dense_x, labels, weights=None):
             B, S = keys_lo.shape
             hi = jnp.broadcast_to(slot_hi, (B, S)).reshape(-1)
             return _finish(params, opt_state, cache_state, hi,
                            keys_lo.reshape(-1), B, S, dense_x, labels,
-                           map_state)
+                           map_state, weights)
     else:
         def step(params, opt_state, cache_state, map_state, keys_hi,
-                 keys_lo, dense_x, labels):
+                 keys_lo, dense_x, labels, weights=None):
             B, S = keys_lo.shape
             return _finish(params, opt_state, cache_state,
                            keys_hi.reshape(-1), keys_lo.reshape(-1), B, S,
-                           dense_x, labels, map_state)
+                           dense_x, labels, map_state, weights)
 
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())

@@ -54,6 +54,28 @@ def _dense_and_labels(batch, dense_slots, label_slot, n_rows: int):
     return dense, labels
 
 
+_PAD_LO32 = np.uint32(0xFFFFFFFF)  # padding key (missing from any pass →
+#                                    sentinel row: pulls zeros, push drops)
+
+
+def _pad_tail(lo32, dense, labels, target_b: int):
+    """Pad a short tail batch up to ``target_b`` (the reference pads the
+    final mini-batch to a fixed shape instead of recompiling; weights
+    mask the padding out of loss/pushes)."""
+    b = lo32.shape[0]
+    weights = np.ones(target_b, np.float32)
+    if b == target_b:
+        return lo32, dense, labels, weights
+    pad = target_b - b
+    weights[b:] = 0.0
+    lo32 = np.concatenate(
+        [lo32, np.full((pad, lo32.shape[1]), _PAD_LO32, np.uint32)])
+    dense = np.concatenate(
+        [dense, np.zeros((pad, dense.shape[1]), np.float32)])
+    labels = np.concatenate([labels, np.zeros(pad, np.int32)])
+    return lo32, dense, labels, weights
+
+
 @dataclasses.dataclass
 class _PassStats:
     steps: int = 0
@@ -206,12 +228,16 @@ class CtrPassTrainer:
 
         def host_batches():
             for batch in dataset.batch_iter(batch_size, drop_last=drop_last):
-                yield self._pack(batch)
+                lo32, dense, labels = self._pack(batch)
+                n_real = lo32.shape[0]  # pre-pad count (host-side)
+                # fixed step shape: pad the tail batch instead of
+                # recompiling (weights mask loss + pushes)
+                yield _pad_tail(lo32, dense, labels, batch_size) + (n_real,)
 
         def to_device(item):
-            lo32, dense, labels = item
+            lo32, dense, labels, weights, n_real = item
             return (jnp.asarray(lo32), jnp.asarray(dense),
-                    jnp.asarray(labels))
+                    jnp.asarray(labels), jnp.asarray(weights), n_real)
 
         stats = _PassStats()
         t0 = time.perf_counter()
@@ -219,13 +245,14 @@ class CtrPassTrainer:
                               transform=to_device)
         losses = []  # device scalars — ONE host sync at pass end
         try:
-            for lo32, dense, labels in pf:
+            for lo32, dense, labels, weights, n_real in pf:
                 self.params, self.opt_state, self.cache.state, loss = \
                     self._step(self.params, self.opt_state, self.cache.state,
-                               map_state, lo32, dense, labels)
+                               map_state, lo32, dense, labels,
+                               weights=weights)
                 losses.append(loss)
                 stats.steps += 1
-                stats.samples += int(labels.shape[0])
+                stats.samples += n_real  # host count — no device sync
         finally:
             pf.close()
         if losses:

@@ -1,0 +1,93 @@
+"""Multi-process distributed backend: two localhost processes × 4
+virtual CPU devices each join one jax.distributed job (the DCN bootstrap
+replacing c_gen_nccl_id's TCP exchange, SURVEY §2.4 →TPU) and run
+(a) eager host collectives (ProcessGroup role) and (b) ONE compiled
+psum over the global 8-device mesh — the reference's
+test_dist_base-style localhost-subprocess harness.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+
+    rank = int(sys.argv[1]); world = int(sys.argv[2]); port = sys.argv[3]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["RANK"] = str(rank)
+    os.environ["WORLD_SIZE"] = str(world)
+    os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.distributed import collective as C
+
+    env = C.init_parallel_env()
+    assert env.rank == rank and env.world_size == world
+    assert len(jax.devices()) == world * 4, len(jax.devices())
+
+    # (a) eager host collectives
+    got = C.all_reduce(np.asarray([1.0 + rank, 10.0]), op="sum")
+    assert got.tolist() == [sum(1.0 + r for r in range(world)), 10.0 * world], got
+    b = C.broadcast(np.asarray([rank * 7.0]), src=1)
+    assert b.tolist() == [7.0], b
+    gathered = C.all_gather(np.asarray([float(rank)]))
+    assert [g.tolist() for g in gathered] == [[0.0], [1.0]]
+    C.barrier()
+
+    # (b) compiled psum over the GLOBAL 8-device mesh
+    import jax.numpy as jnp
+    from jax import lax, shard_map
+    from jax.experimental import multihost_utils
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()).reshape(world * 4), ("dp",))
+    local = np.full((4, 2), float(rank + 1), np.float32)  # 4 local shards
+    garr = multihost_utils.host_local_array_to_global_array(
+        local, mesh, P("dp"))
+    out = jax.jit(shard_map(lambda x: lax.psum(x, "dp"), mesh=mesh,
+                            in_specs=P("dp"), out_specs=P()))(garr)
+    total = float(np.asarray(out.addressable_data(0))[0, 0])
+    # sum over 8 shards: 4 shards of 1.0 + 4 shards of 2.0 = 12
+    assert total == 12.0, total
+    print("WORKER_OK", rank, flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_jax_distributed(tmp_path):
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    procs = []
+    for r in range(2):
+        env = dict(os.environ,
+                   PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), str(r), "2", str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True))
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            assert p.returncode == 0, err[-3000:]
+            assert "WORKER_OK" in out
+    finally:
+        for p in procs:  # never leak distributed workers on failure
+            if p.poll() is None:
+                p.kill()

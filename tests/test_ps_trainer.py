@@ -252,3 +252,24 @@ def test_stream_trainer_queue_dataset(rng, tmp_path):
         qd.set_filelist([str(path)])
         losses.append(tr.train_from_dataset(qd, batch_size=128)["loss"])
     assert losses[-1] < losses[0], losses
+
+
+def test_tail_batch_padded_not_recompiled(rng):
+    """drop_last=False: the short tail batch pads to the fixed step shape
+    (one compiled shape; padded rows excluded from loss/samples)."""
+    pt.seed(0)
+    ds = InMemoryDataset(_slots(), seed=0)
+    ds.load_from_lines(_lines(rng, 300))  # 300 = 2*128 + 44 tail
+    cfg = CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=4,
+                    dnn_hidden=(8,))
+    table = MemorySparseTable(TableConfig(
+        shard_num=4, accessor_config=AccessorConfig(embedx_dim=4)))
+    tr = CtrPassTrainer(
+        DeepFM(cfg), optimizer.Adam(1e-2), table,
+        CacheConfig(capacity=1 << 10, embedx_dim=4, embedx_threshold=0.0),
+        sparse_slots=[f"s{i}" for i in range(S)],
+        dense_slots=[f"d{i}" for i in range(D)], label_slot="label")
+    out = tr.train_from_dataset(ds, batch_size=128, drop_last=False)
+    assert out["steps"] == 3
+    assert out["samples"] == 300  # padding rows not counted
+    assert np.isfinite(out["loss"])
