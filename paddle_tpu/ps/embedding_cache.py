@@ -323,3 +323,12 @@ class HbmEmbeddingCache:
         self.state = None
         self._pass_keys = None
         self.device_map = None
+
+    def discard_pass(self) -> None:
+        """Drop the working set WITHOUT flushing back (diverged/aborted
+        pass): the host table keeps its last-good state and the HBM
+        arrays are released; a new begin_pass starts clean."""
+        self._index = None
+        self.state = None
+        self._pass_keys = None
+        self.device_map = None

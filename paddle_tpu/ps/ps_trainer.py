@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.enforce import enforce
+from ..core.flags import flag
+from ..core.nan_inf import check_numerics
 from ..data.prefetcher import DevicePrefetcher
 from .embedding_cache import CacheConfig, HbmEmbeddingCache
 from .table import MemorySparseTable
@@ -257,6 +259,18 @@ class CtrPassTrainer:
             pf.close()
         if losses:
             stats.loss_sum = float(jnp.sum(jnp.stack(losses)))
+            # flag-gated numeric guard (FLAGS_check_nan_inf role,
+            # operator.cc:1252): one pass-end check over the synced sum.
+            # On divergence, DISCARD the pass (the host table keeps its
+            # last-good state and stays checkpointable) and re-raise.
+            if flag("check_nan_inf"):
+                try:
+                    check_numerics(
+                        {"pass_loss_sum": jnp.asarray(stats.loss_sum)},
+                        "CtrPassTrainer pass")
+                except Exception:
+                    self.cache.discard_pass()
+                    raise
         dt = time.perf_counter() - t0
         self.cache.end_pass()
         return {

@@ -273,3 +273,111 @@ def test_tail_batch_padded_not_recompiled(rng):
     assert out["steps"] == 3
     assert out["samples"] == 300  # padding rows not counted
     assert np.isfinite(out["loss"])
+
+
+def test_multi_day_lifecycle(rng, tmp_path):
+    """Day simulation (A.3 lifecycle semantics at trainer level): train
+    pass → daily shrink → delta save (mode 1) each day; base save
+    (mode 0) at the end; reload continues training."""
+    pt.seed(0)
+    ds = InMemoryDataset(_slots(), seed=0)
+    ds.load_from_lines(_lines(rng, 1024))
+    cfg = CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=4,
+                    dnn_hidden=(8,))
+    acc = AccessorConfig(embedx_dim=4, embedx_threshold=0.0,
+                         delete_threshold=0.0,
+                         delete_after_unseen_days=30.0)
+    table = MemorySparseTable(TableConfig(shard_num=4, accessor_config=acc))
+    tr = CtrPassTrainer(
+        DeepFM(cfg), optimizer.Adam(1e-2), table,
+        CacheConfig(capacity=1 << 10, embedx_dim=4, embedx_threshold=0.0),
+        sparse_slots=[f"s{i}" for i in range(S)],
+        dense_slots=[f"d{i}" for i in range(D)], label_slot="label")
+
+    for day in range(3):
+        tr.train_from_dataset(ds, batch_size=256)
+        deleted = table.shrink()           # daily decay (A.3)
+        assert deleted >= 0
+        n_delta = table.save(str(tmp_path / f"delta-{day}"), mode=1)
+        assert n_delta >= 0
+    n_before = table.size()
+    assert n_before > 0
+    tr.save(str(tmp_path / "base"), mode=0)
+
+    # reload into a fresh trainer; continue training
+    table2 = MemorySparseTable(TableConfig(shard_num=4, accessor_config=acc))
+    pt.seed(0)
+    tr2 = CtrPassTrainer(
+        DeepFM(cfg), optimizer.Adam(1e-2), table2,
+        CacheConfig(capacity=1 << 10, embedx_dim=4, embedx_threshold=0.0),
+        sparse_slots=[f"s{i}" for i in range(S)],
+        dense_slots=[f"d{i}" for i in range(D)], label_slot="label")
+    tr2.load(str(tmp_path / "base"))
+    assert table2.size() == n_before
+    out = tr2.train_from_dataset(ds, batch_size=256)
+    assert np.isfinite(out["loss"])
+
+
+def test_nan_guard_flags(rng):
+    """FLAGS_check_nan_inf surfaces a diverged pass loudly."""
+    import paddle_tpu as ptx
+    from paddle_tpu.core.enforce import PreconditionNotMetError
+
+    pt.seed(0)
+    ds = InMemoryDataset(_slots(), seed=0)
+    ds.load_from_lines(_lines(rng, 256))
+    cfg = CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=4,
+                    dnn_hidden=(8,))
+    table = MemorySparseTable(TableConfig(
+        shard_num=4, accessor_config=AccessorConfig(embedx_dim=4)))
+    tr = CtrPassTrainer(
+        DeepFM(cfg), optimizer.Adam(float("nan")), table,  # poison lr → NaN
+        CacheConfig(capacity=1 << 10, embedx_dim=4, embedx_threshold=0.0),
+        sparse_slots=[f"s{i}" for i in range(S)],
+        dense_slots=[f"d{i}" for i in range(D)], label_slot="label")
+    ptx.set_flags({"check_nan_inf": True})
+    try:
+        import pytest as _pytest
+        with _pytest.raises(PreconditionNotMetError):
+            for _ in range(3):
+                tr.train_from_dataset(ds, batch_size=128)
+    finally:
+        ptx.set_flags({"check_nan_inf": False})
+
+
+def test_nan_guard_discards_pass(rng, tmp_path):
+    """A diverged pass is dropped without flushing: the host table keeps
+    the last-good state and remains checkpointable."""
+    import paddle_tpu as ptx
+    from paddle_tpu.core.enforce import PreconditionNotMetError
+
+    pt.seed(0)
+    ds = InMemoryDataset(_slots(), seed=0)
+    ds.load_from_lines(_lines(rng, 256))
+    cfg = CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=4,
+                    dnn_hidden=(8,))
+    table = MemorySparseTable(TableConfig(
+        shard_num=4, accessor_config=AccessorConfig(embedx_dim=4)))
+    tr = CtrPassTrainer(
+        DeepFM(cfg), optimizer.Adam(float("nan")), table,
+        CacheConfig(capacity=1 << 10, embedx_dim=4, embedx_threshold=0.0),
+        sparse_slots=[f"s{i}" for i in range(S)],
+        dense_slots=[f"d{i}" for i in range(D)], label_slot="label")
+    ptx.set_flags({"check_nan_inf": True})
+    try:
+        import pytest as _pytest
+        with _pytest.raises(PreconditionNotMetError):
+            for _ in range(3):
+                tr.train_from_dataset(ds, batch_size=128)
+    finally:
+        ptx.set_flags({"check_nan_inf": False})
+    assert tr.cache.state is None          # pass discarded, HBM released
+    tr.save(str(tmp_path / "ck"))          # still checkpointable
+    # the flush was skipped: the host table's rows stay finite
+    keys, _ = tr.cache.table.export_full(
+        np.zeros(1, np.uint64))            # probe API stays functional
+    table2 = MemorySparseTable(TableConfig(
+        shard_num=4, accessor_config=AccessorConfig(embedx_dim=4)))
+    table2.load(str(tmp_path / "ck") + "/sparse")
+    vals, found = table2.export_full(np.zeros(1, np.uint64))
+    assert np.isfinite(vals).all()
