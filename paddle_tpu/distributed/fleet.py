@@ -386,6 +386,15 @@ class Fleet:
             out[table_id] = self._client.save(table_id, f"{dirname}/table_{table_id}", mode)
         return out
 
+    def save_inference_model(self, dirname: str, fn, params,
+                             example_inputs, freeze: bool = False) -> None:
+        """Export the serving function (fleet_base.py:787): a portable
+        StableHLO artifact + params — see io/inference.py."""
+        self._check_init()
+        from ..io.inference import save_inference_model as _save
+
+        _save(dirname, fn, params, example_inputs, freeze=freeze)
+
     def load_model(self, dirname: str) -> Dict[int, int]:
         self._check_init()
         out = {}

@@ -1,3 +1,7 @@
 from .checkpoint import load, save, load_checkpoint, save_checkpoint
+from .inference import (InferencePredictor, load_inference_model,
+                        save_inference_model)
 
-__all__ = ["save", "load", "save_checkpoint", "load_checkpoint"]
+__all__ = ["save", "load", "save_checkpoint", "load_checkpoint",
+           "save_inference_model", "load_inference_model",
+           "InferencePredictor"]

@@ -1,0 +1,120 @@
+"""Inference-model export/serving (save_inference_model).
+
+The reference exports a pruned serving program + persistables
+(`fleet.save_inference_model`, fleet_base.py:787; the Paddle Inference
+engine then loads and executes it). The TPU-native artifact is a
+serialized **StableHLO export** of the jitted predict function
+(``jax.export``): portable across processes and compatible JAX/XLA
+versions, compiled on load for whatever backend serves it — the
+whole-program analogue of the reference's program+params directory.
+
+Layout under ``dirname/``:
+- ``model.stablehlo``  — serialized Exported (graph + embedded weights
+  when ``freeze=True``, else weights are call-time inputs)
+- ``params.npz`` + ``params.meta.json`` — the parameter-pytree
+  checkpoint (so serving can refresh weights without re-exporting when
+  ``freeze=False``)
+- ``manifest.json``    — input tree structure / shapes / dtypes
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..core.enforce import PreconditionNotMetError, enforce
+from .checkpoint import load_checkpoint, save_checkpoint
+
+__all__ = ["save_inference_model", "load_inference_model", "InferencePredictor"]
+
+
+def _plain(tree):
+    """Normalize containers to the checkpoint's canonical structure: the
+    export pins the exact pytree type/keys, and the params reloaded in
+    the serving process come back as plain dicts with STRING keys
+    (checkpoint serialization stringifies keys) — so normalize the same
+    way on the export side. Namedtuples are rebuilt field-wise."""
+    if isinstance(tree, dict):
+        return {str(k): _plain(v) for k, v in tree.items()}
+    if isinstance(tree, tuple) and hasattr(tree, "_fields"):  # namedtuple
+        return type(tree)(*[_plain(v) for v in tree])
+    if isinstance(tree, (list, tuple)):
+        return type(tree)(_plain(v) for v in tree)
+    return tree
+
+
+def save_inference_model(
+    dirname: str,
+    fn: Callable,
+    params: Any,
+    example_inputs: Tuple,
+    freeze: bool = False,
+) -> None:
+    """Export ``fn(params, *inputs)`` for serving.
+
+    ``freeze=True`` bakes the current params into the graph as constants
+    (single-file deploy, the reference's persistables-pruned program);
+    ``freeze=False`` (default) keeps params as a call-time input and
+    saves them alongside, so a newer checkpoint can be dropped in.
+    """
+    os.makedirs(dirname, exist_ok=True)
+    params = _plain(params)
+    if freeze:
+        def frozen(*inputs):
+            return fn(params, *inputs)
+
+        exp = jax.export.export(jax.jit(frozen))(*example_inputs)
+    else:
+        exp = jax.export.export(jax.jit(fn))(params, *example_inputs)
+        save_checkpoint(os.path.join(dirname, "params"), params)
+    with open(os.path.join(dirname, "model.stablehlo"), "wb") as f:
+        f.write(exp.serialize())
+    manifest = {
+        "freeze": freeze,
+        "inputs": [
+            {"shape": list(np.shape(x)),
+             "dtype": str(np.asarray(x).dtype) if not hasattr(x, "dtype")
+             else str(x.dtype)}
+            for x in example_inputs
+        ],
+    }
+    with open(os.path.join(dirname, "manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=1)
+
+
+class InferencePredictor:
+    """Loaded serving handle (the Paddle Inference ``Predictor`` role):
+    ``predictor(*inputs)`` runs the compiled program on the current
+    backend."""
+
+    def __init__(self, dirname: str) -> None:
+        path = os.path.join(dirname, "model.stablehlo")
+        enforce(os.path.exists(path),
+                f"no inference model at {dirname}", PreconditionNotMetError)
+        with open(path, "rb") as f:
+            self._exported = jax.export.deserialize(f.read())
+        with open(os.path.join(dirname, "manifest.json")) as f:
+            self.manifest = json.load(f)
+        self._params = None
+        if not self.manifest["freeze"]:
+            self._params = _plain(load_checkpoint(
+                os.path.join(dirname, "params"))["model"])
+
+    def set_params(self, params: Any) -> None:
+        """Swap in a newer checkpoint (freeze=False exports only)."""
+        enforce(not self.manifest["freeze"],
+                "frozen exports have no swappable params")
+        self._params = _plain(params)
+
+    def __call__(self, *inputs):
+        if self.manifest["freeze"]:
+            return self._exported.call(*inputs)
+        return self._exported.call(self._params, *inputs)
+
+
+def load_inference_model(dirname: str) -> InferencePredictor:
+    return InferencePredictor(dirname)
