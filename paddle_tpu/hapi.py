@@ -176,11 +176,32 @@ class Model:
 
     # -- save/load ---------------------------------------------------------
 
-    def save(self, path: str) -> None:
-        """Writes the shared checkpoint schema ({"model","opt","step"},
-        io/checkpoint.py) so Model.save and save_checkpoint files are
-        interchangeable."""
+    def save(self, path: str, training: bool = True,
+             example_inputs=None) -> None:
+        """``training=True`` (default) writes the shared checkpoint
+        schema ({"model","opt","step"}, io/checkpoint.py) so Model.save
+        and save_checkpoint files are interchangeable.
+
+        ``training=False`` exports the SERVING artifact instead (the
+        reference's ``Model.save(path, training=False)`` inference-model
+        export, hapi/model.py): a StableHLO export of the eval forward —
+        pass ``example_inputs`` (tuple of example/abstract arrays)."""
         self._check_prepared()
+        if not training:
+            from .io.inference import save_inference_model
+
+            enforce(example_inputs is not None,
+                    "training=False export needs example_inputs",
+                    PreconditionNotMetError)
+            if not isinstance(example_inputs, (tuple, list)):
+                example_inputs = (example_inputs,)  # bare-array convention
+
+            def serve(state, *ins):
+                return self._eval_fwd(state, tuple(ins), ())
+
+            save_inference_model(path, serve, jax.device_get(self._state),
+                                 tuple(example_inputs))
+            return
         ckpt.save_checkpoint(path, jax.device_get(self._state),
                              jax.device_get(self._opt_state))
 

@@ -29,6 +29,7 @@ import numpy as np
 from ..core.enforce import enforce
 from ..core.flags import flag
 from ..core.nan_inf import check_numerics
+from ..core.profiler import RecordEvent
 from ..data.prefetcher import DevicePrefetcher
 from .embedding_cache import CacheConfig, HbmEmbeddingCache
 from .table import MemorySparseTable
@@ -223,9 +224,10 @@ class CtrPassTrainer:
         'samples_per_sec'}."""
         import time
 
-        keys = self._tagged_pass_keys(dataset)
-        enforce(len(keys) > 0, "dataset has no sparse feasigns")
-        self.cache.begin_pass(keys)
+        with RecordEvent("ctr_pass_build"):  # PreBuildTask..BuildGPUTask
+            keys = self._tagged_pass_keys(dataset)
+            enforce(len(keys) > 0, "dataset has no sparse feasigns")
+            self.cache.begin_pass(keys)
         map_state = self.cache.device_map.state
 
         def host_batches():
@@ -248,10 +250,11 @@ class CtrPassTrainer:
         losses = []  # device scalars — ONE host sync at pass end
         try:
             for lo32, dense, labels, weights, n_real in pf:
-                self.params, self.opt_state, self.cache.state, loss = \
-                    self._step(self.params, self.opt_state, self.cache.state,
-                               map_state, lo32, dense, labels,
-                               weights=weights)
+                with RecordEvent("ctr_train_step"):
+                    self.params, self.opt_state, self.cache.state, loss = \
+                        self._step(self.params, self.opt_state,
+                                   self.cache.state, map_state, lo32, dense,
+                                   labels, weights=weights)
                 losses.append(loss)
                 stats.steps += 1
                 stats.samples += n_real  # host count — no device sync

@@ -94,3 +94,29 @@ def test_cross_process_serving(tmp_path):
                          capture_output=True, text=True, timeout=180)
     assert out.returncode == 0, out.stderr[-2000:]
     assert "SERVE_OK" in out.stdout
+
+
+def test_hapi_model_save_inference(tmp_path, rng):
+    """hapi Model.save(path, training=False) exports the serving
+    artifact (reference hapi/model.py inference-model export)."""
+    from paddle_tpu import optimizer
+    from paddle_tpu.hapi import Model
+
+    pt.seed(0)
+    net = LeNet(num_classes=10)
+    m = Model(net)
+    m.prepare(optimizer.Adam(1e-3), nn.functional.cross_entropy)
+    x = np.random.default_rng(0).normal(size=(2, 1, 28, 28)).astype(np.float32)
+    want = np.asarray(m.predict_batch(x))
+
+    m.save(str(tmp_path / "serve"), training=False, example_inputs=(x,))
+    pred = load_inference_model(str(tmp_path / "serve"))
+    np.testing.assert_allclose(np.asarray(pred(x)), want, rtol=1e-5)
+
+    # bare-array convention (same as predict_batch)
+    m.save(str(tmp_path / "serve2"), training=False, example_inputs=x)
+    pred2 = load_inference_model(str(tmp_path / "serve2"))
+    np.testing.assert_allclose(np.asarray(pred2(x)), want, rtol=1e-5)
+
+    with pytest.raises(Exception):
+        m.save(str(tmp_path / "bad"), training=False)  # needs examples
