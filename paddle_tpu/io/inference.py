@@ -76,7 +76,8 @@ def save_inference_model(
     manifest = {
         "freeze": freeze,
         "inputs": [
-            {"shape": list(np.shape(x)),
+            # dims stringified: symbolic-shape exports ("b") are legal
+            {"shape": [str(d) for d in getattr(x, "shape", np.shape(x))],
              "dtype": str(np.asarray(x).dtype) if not hasattr(x, "dtype")
              else str(x.dtype)}
             for x in example_inputs

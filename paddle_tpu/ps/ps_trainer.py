@@ -174,6 +174,40 @@ class CtrPassTrainer:
         self.params = snap["model"]
         self.opt_state = snap["opt"]
 
+    def _infer_fn(self):
+        """The ONE inference definition shared by evaluate() and the
+        serving export: (params, pulled emb, dense) → CTR probability."""
+        import jax.nn as jnn
+
+        from .. import nn
+
+        model = self.model
+
+        def infer(params, emb, dense_x):
+            out, _ = nn.functional_call(model, params, emb, dense_x,
+                                        training=False)
+            return jnn.sigmoid(out)
+
+        return infer
+
+    def save_inference_model(self, dirname: str) -> None:
+        """Export the dense serving graph (fleet.save_inference_model on
+        a PS program: the lookup stays server-side — the reference prunes
+        ``distributed_lookup_table`` into the serving split — and the
+        artifact takes (pulled embeddings [B,S,1+dim], dense [B,D]) and
+        returns CTR probabilities). Pair with ``table.pull_sparse`` (or a
+        serving PS client) at inference time."""
+        from ..io.inference import save_inference_model as _save
+
+        serve = self._infer_fn()
+        S = len(self.sparse_slots)
+        dim = self.cache.config.embedx_dim
+        # batch-polymorphic export: serving batch size is a symbolic dim
+        (b,) = jax.export.symbolic_shape("b")
+        emb = jax.ShapeDtypeStruct((b, S, 1 + dim), jnp.float32)
+        dense = jax.ShapeDtypeStruct((b, len(self.dense_slots)), jnp.float32)
+        _save(dirname, serve, self.params, (emb, dense))
+
     # -- evaluation (worker AUC metric role, metrics_py.cc) --------------
 
     def evaluate(self, dataset, batch_size: int = 1024):
@@ -183,20 +217,10 @@ class CtrPassTrainer:
         "auc_buckets": [2, B] ndarray} — multi-worker callers sum the
         buckets across workers via ``fleet.util.all_reduce`` and recompute
         (metrics/auc.auc_from_buckets), the GlooWrapper reduce pattern."""
-        import jax.nn as jnn
-
-        from .. import nn
         from ..metrics.auc import AUC
 
         if not hasattr(self, "_infer"):
-            model = self.model
-
-            def infer(params, emb, dense_x):
-                out, _ = nn.functional_call(model, params, emb, dense_x,
-                                            training=False)
-                return jnn.sigmoid(out)
-
-            self._infer = jax.jit(infer)
+            self._infer = jax.jit(self._infer_fn())
 
         S = len(self.sparse_slots)
         dim = self.cache.config.embedx_dim

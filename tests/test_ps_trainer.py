@@ -381,3 +381,42 @@ def test_nan_guard_discards_pass(rng, tmp_path):
     table2.load(str(tmp_path / "ck") + "/sparse")
     vals, found = table2.export_full(np.zeros(1, np.uint64))
     assert np.isfinite(vals).all()
+
+
+def test_ctr_serving_export(rng, tmp_path):
+    """The PS serving split: exported dense graph (batch-polymorphic)
+    scores (pulled emb, dense) identically to evaluate()'s infer."""
+    from paddle_tpu.io.inference import load_inference_model
+
+    pt.seed(0)
+    ds = InMemoryDataset(_slots(), seed=0)
+    ds.load_from_lines(_lines(rng, 512))
+    cfg = CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=4,
+                    dnn_hidden=(8,))
+    table = MemorySparseTable(TableConfig(
+        shard_num=4, accessor_config=AccessorConfig(embedx_dim=4)))
+    tr = CtrPassTrainer(
+        DeepFM(cfg), optimizer.Adam(1e-2), table,
+        CacheConfig(capacity=1 << 10, embedx_dim=4, embedx_threshold=0.0),
+        sparse_slots=[f"s{i}" for i in range(S)],
+        dense_slots=[f"d{i}" for i in range(D)], label_slot="label")
+    tr.train_from_dataset(ds, batch_size=128)
+    tr.save_inference_model(str(tmp_path / "serve"))
+
+    pred = load_inference_model(str(tmp_path / "serve"))
+    # serving: pull embeddings from the table, score with the artifact
+    for B in (3, 17):  # batch-polymorphic
+        keys = rng.integers(0, 64, size=(B, S)).astype(np.uint64)
+        tagged = (keys + (np.arange(S, dtype=np.uint64) << np.uint64(32)))
+        pulled = table.pull_sparse(tagged.reshape(-1), create=False)
+        emb = pulled[:, -5:].reshape(B, S, 5).astype(np.float32)
+        dense = rng.normal(size=(B, D)).astype(np.float32)
+        probs = np.asarray(pred(emb, dense))
+        assert probs.shape == (B,)
+        # parity with the in-framework inference on identical inputs
+        from paddle_tpu import nn as _nn
+        import jax.numpy as _jnp
+        out, _ = _nn.functional_call(tr.model, tr.params, _jnp.asarray(emb),
+                                     _jnp.asarray(dense), training=False)
+        want = np.asarray(1.0 / (1.0 + np.exp(-np.asarray(out))))
+        np.testing.assert_allclose(probs, want, rtol=1e-5, atol=1e-6)
