@@ -210,21 +210,32 @@ class CtrPassTrainer:
 
     # -- evaluation (worker AUC metric role, metrics_py.cc) --------------
 
-    def evaluate(self, dataset, batch_size: int = 1024):
+    def evaluate(self, dataset, batch_size: int = 1024,
+                 user_slot: Optional[str] = None):
         """AUC over ``dataset`` against the HOST table state (pull
         create=False — unseen features contribute zeros), the reference's
         in-training metric pass. Returns {"auc": float,
         "auc_buckets": [2, B] ndarray} — multi-worker callers sum the
         buckets across workers via ``fleet.util.all_reduce`` and recompute
-        (metrics/auc.auc_from_buckets), the GlooWrapper reduce pattern."""
-        from ..metrics.auc import AUC
+        (metrics/auc.auc_from_buckets), the GlooWrapper reduce pattern.
 
+        ``user_slot`` names a sparse slot carrying the user/group id; when
+        given, the result also includes ``wuauc`` (user-weighted AUC, the
+        CTR-serving ranking metric — metrics.h WuaucCalculator)."""
+        from ..metrics.auc import AUC
+        from ..metrics.basic import WuAUC
+
+        if user_slot is not None:
+            enforce(user_slot in self.sparse_slots,
+                    f"user_slot {user_slot!r} must be a sparse slot "
+                    f"(have {self.sparse_slots})")
         if not hasattr(self, "_infer"):
             self._infer = jax.jit(self._infer_fn())
 
         S = len(self.sparse_slots)
         dim = self.cache.config.embedx_dim
         metric = AUC()
+        wu = WuAUC() if user_slot is not None else None
         for batch in dataset.batch_iter(batch_size, drop_last=False):
             lo32, dense, labels = self._pack(batch)
             keys = (lo32.astype(np.uint64)
@@ -236,8 +247,19 @@ class CtrPassTrainer:
             probs = np.asarray(self._infer(self.params, jnp.asarray(emb),
                                            jnp.asarray(dense)))
             metric.update(probs, labels)
-        return {"auc": float(metric.accumulate()),
-                "auc_buckets": metric._buckets.copy()}
+            if wu is not None:
+                uids = batch[user_slot][0][:, 0].astype(np.int64)
+                wu.update(uids, probs, labels)
+        out = {"auc": float(metric.accumulate()),
+               "auc_buckets": metric._buckets.copy()}
+        if wu is not None:
+            # raw (uid, pred, label) records: the mergeable state — a
+            # multi-worker wuauc needs the records gathered (the
+            # reference groups by uid after a global shuffle), unlike
+            # AUC whose buckets just sum
+            out["wuauc"] = float(wu.accumulate())
+            out["wuauc_state"] = wu.state
+        return out
 
     # -- the RunFromDataset loop (see class docstring) --------------------
 

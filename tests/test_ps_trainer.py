@@ -420,3 +420,24 @@ def test_ctr_serving_export(rng, tmp_path):
                                      _jnp.asarray(dense), training=False)
         want = np.asarray(1.0 / (1.0 + np.exp(-np.asarray(out))))
         np.testing.assert_allclose(probs, want, rtol=1e-5, atol=1e-6)
+
+
+def test_evaluate_wuauc(rng):
+    """user_slot adds the user-weighted AUC (WuaucCalculator role)."""
+    pt.seed(0)
+    ds = InMemoryDataset(_slots(), seed=0)
+    ds.load_from_lines(_lines(rng, 1024))
+    cfg = CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=4,
+                    dnn_hidden=(16,))
+    table = MemorySparseTable(TableConfig(
+        shard_num=4, accessor_config=AccessorConfig(embedx_dim=4)))
+    tr = CtrPassTrainer(
+        DeepFM(cfg), optimizer.Adam(1e-2), table,
+        CacheConfig(capacity=1 << 10, embedx_dim=4, embedx_threshold=0.0),
+        sparse_slots=[f"s{i}" for i in range(S)],
+        dense_slots=[f"d{i}" for i in range(D)], label_slot="label")
+    for _ in range(3):
+        tr.train_from_dataset(ds, batch_size=256)
+    out = tr.evaluate(ds, user_slot="s0")  # slot 0 doubles as the uid
+    assert 0.0 <= out["wuauc"] <= 1.0
+    assert out["wuauc"] > 0.5  # learned signal ranks within users too
