@@ -13,6 +13,7 @@
 // thread at a time (the reference serializes per-shard via 1-thread pools).
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <thread>
@@ -184,6 +185,136 @@ int64_t psidx_lookup_or_insert(void* p, const uint64_t* keys, int64_t n,
 void psidx_erase(void* p, const uint64_t* keys, int64_t n) {
   PsIndex* idx = static_cast<PsIndex*>(p);
   for (int64_t i = 0; i < n; ++i) idx->erase(keys[i]);
+}
+
+// Parallel feasign dedup — the reference's 16-thread PreBuildTask shard
+// dedup (ps_gpu_wrapper.cc:92): hash-partition the input into buckets,
+// dedup each bucket with a local open-addressing set, concatenate.
+// Output order is deterministic (bucket-major, first-seen within each
+// bucket) but NOT sorted; callers that need sorted order sort the
+// (much smaller) unique set afterwards. Returns the unique count;
+// `out` must hold up to n entries.
+int64_t ps_dedup_u64(const uint64_t* keys, int64_t n, uint64_t* out,
+                     int32_t n_threads) {
+  if (n <= 0) return 0;
+  int64_t nt = std::max<int64_t>(1, std::min<int64_t>(n_threads, 64));
+  if (n < (int64_t)1 << 15) nt = 1;
+  // Buckets: sized so each bucket's dedup set stays cache-resident
+  // (~64k keys/bucket), independent of thread count; threads just pick
+  // buckets off a shared counter.
+  uint64_t nb = 1;
+  while (nb < static_cast<uint64_t>(n >> 16) && nb < 4096) nb <<= 1;
+  while (nb < static_cast<uint64_t>(nt) * 4) nb <<= 1;
+  int shift = 64 - __builtin_ctzll(nb);
+
+  // Pass 1: per-(thread, bucket) counts over contiguous input chunks.
+  int64_t chunk = (n + nt - 1) / nt;
+  std::vector<std::vector<int64_t>> counts(nt, std::vector<int64_t>(nb, 0));
+  {
+    std::vector<std::thread> ths;
+    for (int64_t t = 0; t < nt; ++t) {
+      int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
+      if (lo >= hi) break;
+      ths.emplace_back([&, t, lo, hi] {
+        auto& c = counts[t];
+        for (int64_t i = lo; i < hi; ++i)
+          ++c[splitmix64(keys[i]) >> shift];
+      });
+    }
+    for (auto& th : ths) th.join();
+  }
+
+  // Offsets: bucket-major, thread order within a bucket (keeps first-seen
+  // order deterministic and equal to sequential order within a bucket).
+  std::vector<int64_t> bucket_start(nb + 1, 0);
+  for (uint64_t b = 0; b < nb; ++b) {
+    int64_t s = 0;
+    for (int64_t t = 0; t < nt; ++t) s += counts[t][b];
+    bucket_start[b + 1] = bucket_start[b] + s;
+  }
+  std::vector<std::vector<int64_t>> cursor(nt, std::vector<int64_t>(nb));
+  for (uint64_t b = 0; b < nb; ++b) {
+    int64_t pos = bucket_start[b];
+    for (int64_t t = 0; t < nt; ++t) {
+      cursor[t][b] = pos;
+      pos += counts[t][b];
+    }
+  }
+
+  // Pass 2: scatter into bucket-contiguous scratch.
+  std::vector<uint64_t> part(n);
+  {
+    std::vector<std::thread> ths;
+    for (int64_t t = 0; t < nt; ++t) {
+      int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
+      if (lo >= hi) break;
+      ths.emplace_back([&, t, lo, hi] {
+        auto& cur = cursor[t];
+        for (int64_t i = lo; i < hi; ++i) {
+          uint64_t b = splitmix64(keys[i]) >> shift;
+          part[cur[b]++] = keys[i];
+        }
+      });
+    }
+    for (auto& th : ths) th.join();
+  }
+
+  // Pass 3: per-bucket dedup (parallel over buckets) into thread-local
+  // vectors, then compact into `out`.
+  std::vector<std::vector<uint64_t>> uniq(nb);
+  {
+    std::vector<std::thread> ths;
+    std::atomic<uint64_t> next{0};
+    for (int64_t t = 0; t < nt; ++t) {
+      ths.emplace_back([&] {
+        for (uint64_t b; (b = next.fetch_add(1)) < nb;) {
+          int64_t lo = bucket_start[b], hi = bucket_start[b + 1];
+          int64_t m = hi - lo;
+          if (m == 0) continue;
+          uint64_t cap = 64;
+          while (static_cast<int64_t>(cap) < m * 2) cap <<= 1;
+          std::vector<uint64_t> set_keys(cap, 0);
+          std::vector<uint8_t> set_used(cap, 0);
+          uint64_t mask = cap - 1;
+          auto& u = uniq[b];
+          u.reserve(m);
+          for (int64_t i = lo; i < hi; ++i) {
+            uint64_t k = part[i];
+            uint64_t h = splitmix64(k * 0x9e3779b97f4a7c15ULL + 1) & mask;
+            bool seen = false;
+            while (set_used[h]) {
+              if (set_keys[h] == k) { seen = true; break; }
+              h = (h + 1) & mask;
+            }
+            if (!seen) {
+              set_used[h] = 1;
+              set_keys[h] = k;
+              u.push_back(k);
+            }
+          }
+        }
+      });
+    }
+    for (auto& th : ths) th.join();
+  }
+  std::vector<int64_t> out_start(nb + 1, 0);
+  for (uint64_t b = 0; b < nb; ++b)
+    out_start[b + 1] = out_start[b] + static_cast<int64_t>(uniq[b].size());
+  {
+    std::vector<std::thread> ths;
+    std::atomic<uint64_t> next{0};
+    for (int64_t t = 0; t < nt; ++t) {
+      ths.emplace_back([&] {
+        for (uint64_t b; (b = next.fetch_add(1)) < nb;) {
+          if (!uniq[b].empty())
+            std::memcpy(out + out_start[b], uniq[b].data(),
+                        uniq[b].size() * sizeof(uint64_t));
+        }
+      });
+    }
+    for (auto& th : ths) th.join();
+  }
+  return out_start[nb];
 }
 
 // Dump all live (key, row) pairs; buffers must hold psidx_size entries.

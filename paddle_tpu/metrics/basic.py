@@ -140,8 +140,8 @@ class WuAUC:
         ranks[order] = mean_rank_per_run[run_of_sorted]
         return (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
 
-    def accumulate(self) -> float:
-        s = self.state
+    def accumulate(self, state: Optional[Dict[str, np.ndarray]] = None) -> float:
+        s = state if state is not None else self.state
         if not len(s["uid"]):
             return 0.0
         # group records per user in one argsort pass (O(n log n), not a

@@ -212,7 +212,9 @@ class HbmEmbeddingCache:
         """PreBuildTask + BuildPull + BuildGPUTask: dedup the pass's keys,
         pull current values from the host table, upload the working set."""
         cfg = self.config
-        uniq = np.unique(np.ascontiguousarray(keys, np.uint64))
+        from .native import dedup_u64
+
+        uniq = dedup_u64(keys)  # parallel PreBuildTask-style dedup
         enforce_le(len(uniq), cfg.capacity, "pass working set exceeds cache capacity")
         self._index = FeasignIndex(len(uniq) * 2)
         rows, _ = self._index.lookup_or_insert(uniq)

@@ -16,7 +16,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-__all__ = ["FeasignIndex", "NativeSparseTableEngine", "native_available", "load_native"]
+__all__ = ["FeasignIndex", "NativeSparseTableEngine", "native_available",
+           "load_native", "dedup_u64"]
 
 _CSRC = os.path.join(os.path.dirname(__file__), "..", "csrc")
 _LIB_PATH = os.path.join(_CSRC, "libpaddle_tpu_native.so")
@@ -75,6 +76,10 @@ def _configure(lib: ctypes.CDLL) -> None:
     lib.psidx_lookup_or_insert.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64, i32p]
     lib.psidx_erase.argtypes = [ctypes.c_void_p, u64p, ctypes.c_int64]
     lib.psidx_items.argtypes = [ctypes.c_void_p, u64p, i32p]
+    if hasattr(lib, "ps_dedup_u64"):
+        lib.ps_dedup_u64.restype = ctypes.c_int64
+        lib.ps_dedup_u64.argtypes = [u64p, ctypes.c_int64, u64p,
+                                     ctypes.c_int32]
 
 
 def native_available() -> bool:
@@ -112,6 +117,23 @@ def cuckoo_build(keys: np.ndarray, rows: np.ndarray, nbuckets: int,
     if fails:
         raise RuntimeError(f"cuckoo build failed to place {fails} keys")
     return hi, lo, row
+
+
+def dedup_u64(keys: np.ndarray, n_threads: Optional[int] = None) -> np.ndarray:
+    """Parallel distinct-keys extraction (the PreBuildTask 16-thread shard
+    dedup, ps_gpu_wrapper.cc:92): hash-partitioned bucket dedup across
+    threads. Returns the unique keys in a deterministic (but unsorted)
+    order; falls back to np.unique without the native lib."""
+    keys = np.ascontiguousarray(keys, np.uint64).reshape(-1)
+    lib = load_native()
+    if lib is None or not hasattr(lib, "ps_dedup_u64"):
+        return np.unique(keys)
+    if n_threads is None:
+        n_threads = min(16, os.cpu_count() or 1)
+    out = np.empty(len(keys), np.uint64)
+    n = int(lib.ps_dedup_u64(_u64(keys), len(keys), _u64(out),
+                             ctypes.c_int32(n_threads)))
+    return out[:n].copy()
 
 
 def _u64(a: np.ndarray):

@@ -257,8 +257,9 @@ class CtrPassTrainer:
             # multi-worker wuauc needs the records gathered (the
             # reference groups by uid after a global shuffle), unlike
             # AUC whose buckets just sum
-            out["wuauc"] = float(wu.accumulate())
-            out["wuauc_state"] = wu.state
+            st = wu.state  # concatenate the records once
+            out["wuauc"] = float(wu.accumulate(st))
+            out["wuauc_state"] = st
         return out
 
     # -- the RunFromDataset loop (see class docstring) --------------------

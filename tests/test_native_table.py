@@ -132,3 +132,18 @@ def test_sparse_accessor_pull_layout():
     np.testing.assert_allclose(
         tn.pull_sparse(keys, create=False), tp.pull_sparse(keys, create=False),
         atol=1e-5)
+
+
+def test_dedup_u64_matches_np_unique():
+    from paddle_tpu.ps.native import dedup_u64
+
+    rng = np.random.default_rng(11)
+    for n, hi in [(0, 1), (1, 1), (257, 40), (50_000, 900), (200_000, 1 << 40)]:
+        keys = rng.integers(0, hi, size=n).astype(np.uint64)
+        got = dedup_u64(keys)
+        want = np.unique(keys)
+        assert got.shape == want.shape
+        np.testing.assert_array_equal(np.sort(got), want)
+    # deterministic order across calls
+    keys = rng.integers(0, 1000, size=100_000).astype(np.uint64)
+    np.testing.assert_array_equal(dedup_u64(keys), dedup_u64(keys))
