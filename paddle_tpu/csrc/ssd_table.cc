@@ -1,0 +1,668 @@
+// SSD sparse table: two-tier feasign store = RAM hot tier (NativeTable,
+// sparse_table.h) + per-shard append-only log files for the cold tier.
+//
+// TPU-build counterpart of the reference's SSD table direction — the
+// vintage ships only rocksdb scaffolding
+// (paddle/fluid/distributed/ps/table/depends/rocksdb_warpper.h, no table
+// class wired in), but the capability it targets is the trillion-feature
+// scale claim (README.md:31-34): the full feature population lives on
+// disk, the active working set in RAM, the per-pass working set in HBM
+// (ps/embedding_cache.py). Design here is log-structured rather than
+// rocksdb: each shard owns one data file of fixed-width records
+// [u64 key, u32 flag, full_dim floats]; an in-memory open-addressing
+// index maps key -> latest record ordinal; updates append (latest wins
+// on replay), deletes append a tombstone record, compaction rewrites
+// live records. Crash recovery = sequential replay at open.
+//
+// Tier protocol (invariant: a key is live in at most ONE tier):
+//   pull/push/export: RAM hit -> serve; else disk hit -> PROMOTE the row
+//     into RAM (erasing the disk index entry) and serve; else
+//     insert-on-miss into RAM when `create`.
+//   spill(budget): move the coldest RAM rows (highest unseen_days, then
+//     lowest show/click score) to disk until RAM fits the budget.
+//   shrink: RAM shrink (decay + delete) plus a disk sweep applying the
+//     same decay/delete lifecycle (ctr_accessor.cc:55-135 semantics).
+//   save: RAM keep-set snapshot + disk rows passing the same mode
+//     filter; update_stat_after_save rewrites affected disk rows.
+//
+// C ABI (sst_*) mirrors sparse_table.cc's pst_* so the Python layer
+// swaps engines; extra entry points: spill, compact, stats, load_cold.
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdio>
+#include <string>
+
+#include "sparse_table.h"
+
+namespace {
+
+using pstpu::NativeTable;
+using pstpu::Shard;
+using pstpu::TableNativeConfig;
+using pstpu::table_full_dim;
+
+constexpr int64_t kIdxEmpty = -1;
+constexpr int64_t kIdxTomb = -2;
+
+// open-addressing key -> record ordinal (same probing scheme as the
+// other native indexes)
+struct DiskIndex {
+  std::vector<uint64_t> keys;
+  std::vector<int64_t> vals;  // ordinal | kIdxEmpty | kIdxTomb
+  uint64_t mask = 0;
+  int64_t used = 0, occupied = 0;
+
+  DiskIndex() {
+    keys.assign(1024, 0);
+    vals.assign(1024, kIdxEmpty);
+    mask = 1023;
+  }
+
+  void grow() {
+    std::vector<uint64_t> ok(std::move(keys));
+    std::vector<int64_t> ov(std::move(vals));
+    uint64_t cap = (mask + 1) << 1;
+    keys.assign(cap, 0);
+    vals.assign(cap, kIdxEmpty);
+    mask = cap - 1;
+    occupied = 0;
+    for (size_t i = 0; i < ok.size(); ++i) {
+      if (ov[i] >= 0) {
+        uint64_t h = pstpu::splitmix64(ok[i]) & mask;
+        while (vals[h] != kIdxEmpty) h = (h + 1) & mask;
+        keys[h] = ok[i];
+        vals[h] = ov[i];
+        ++occupied;
+      }
+    }
+  }
+
+  int64_t find(uint64_t key) const {
+    uint64_t h = pstpu::splitmix64(key) & mask;
+    while (true) {
+      int64_t v = vals[h];
+      if (v == kIdxEmpty) return -1;
+      if (v >= 0 && keys[h] == key) return v;
+      h = (h + 1) & mask;
+    }
+  }
+
+  void upsert(uint64_t key, int64_t ord) {
+    uint64_t h = pstpu::splitmix64(key) & mask;
+    int64_t first_tomb = -1;
+    while (true) {
+      int64_t v = vals[h];
+      if (v == kIdxEmpty) {
+        uint64_t t = first_tomb >= 0 ? static_cast<uint64_t>(first_tomb) : h;
+        keys[t] = key;
+        vals[t] = ord;
+        ++used;
+        if (first_tomb < 0) ++occupied;
+        if (occupied * 10 >= static_cast<int64_t>(mask + 1) * 7) grow();
+        return;
+      }
+      if (v == kIdxTomb) {
+        if (first_tomb < 0) first_tomb = static_cast<int64_t>(h);
+      } else if (keys[h] == key) {
+        vals[h] = ord;  // overwrite (newer record)
+        return;
+      }
+      h = (h + 1) & mask;
+    }
+  }
+
+  bool erase(uint64_t key) {
+    uint64_t h = pstpu::splitmix64(key) & mask;
+    while (true) {
+      int64_t v = vals[h];
+      if (v == kIdxEmpty) return false;
+      if (v >= 0 && keys[h] == key) {
+        vals[h] = kIdxTomb;
+        --used;
+        return true;
+      }
+      h = (h + 1) & mask;
+    }
+  }
+
+  template <typename Fn>
+  void for_each(Fn fn) const {
+    for (uint64_t h = 0; h <= mask; ++h)
+      if (vals[h] >= 0) fn(keys[h], vals[h]);
+  }
+};
+
+struct DiskShard {
+  std::string path;
+  int fd = -1;
+  DiskIndex index;
+  int64_t n_records = 0;  // appended records incl. garbage + tombstones
+  std::mutex mu;
+  // IO scratch reused across records (guarded by mu) — promote/sweep
+  // paths must not pay a heap allocation per record
+  std::vector<uint8_t> io_buf;
+  std::vector<float> row_buf;
+};
+
+struct SsdTable {
+  NativeTable* mem;
+  std::vector<DiskShard*> disk;
+  std::string dir;
+  int32_t fdim;       // full row width (floats)
+  int64_t rec_bytes;  // 8 (key) + 4 (flag) + 4*fdim
+  // save snapshot buffers (begin/fetch protocol, same as NativeTable)
+  std::mutex save_mu;
+
+  explicit SsdTable(const TableNativeConfig& c, const std::string& d)
+      : mem(new NativeTable(c)), dir(d) {
+    fdim = table_full_dim(mem);
+    rec_bytes = 8 + 4 + 4 * static_cast<int64_t>(fdim);
+  }
+  ~SsdTable() {
+    for (DiskShard* s : disk) {
+      if (s->fd >= 0) close(s->fd);
+      delete s;
+    }
+    delete mem;
+  }
+};
+
+// -- record IO (shard lock held) --------------------------------------------
+
+bool read_record(SsdTable* t, DiskShard* d, int64_t ord, uint64_t* key,
+                 uint32_t* flag, float* vals) {
+  d->io_buf.resize(t->rec_bytes);
+  uint8_t* buf = d->io_buf.data();
+  ssize_t got = pread(d->fd, buf, t->rec_bytes, ord * t->rec_bytes);
+  if (got != static_cast<ssize_t>(t->rec_bytes)) return false;
+  std::memcpy(key, buf, 8);
+  std::memcpy(flag, buf + 8, 4);
+  std::memcpy(vals, buf + 12, 4 * static_cast<size_t>(t->fdim));
+  return true;
+}
+
+// append one record; returns its ordinal
+int64_t append_record(SsdTable* t, DiskShard* d, uint64_t key, uint32_t flag,
+                      const float* vals) {
+  d->io_buf.resize(t->rec_bytes);
+  uint8_t* buf = d->io_buf.data();
+  std::memcpy(buf, &key, 8);
+  std::memcpy(buf + 8, &flag, 4);
+  if (vals)
+    std::memcpy(buf + 12, vals, 4 * static_cast<size_t>(t->fdim));
+  else
+    std::memset(buf + 12, 0, 4 * static_cast<size_t>(t->fdim));
+  int64_t ord = d->n_records;
+  if (pwrite(d->fd, buf, t->rec_bytes, ord * t->rec_bytes) !=
+      static_cast<ssize_t>(t->rec_bytes))
+    return -1;
+  d->n_records = ord + 1;
+  return ord;
+}
+
+void replay_shard(SsdTable* t, DiskShard* d) {
+  off_t sz = lseek(d->fd, 0, SEEK_END);
+  int64_t n = sz / t->rec_bytes;  // trailing partial record ignored
+  d->n_records = n;
+  std::vector<uint8_t> buf(t->rec_bytes);
+  for (int64_t ord = 0; ord < n; ++ord) {
+    if (pread(d->fd, buf.data(), t->rec_bytes, ord * t->rec_bytes) !=
+        static_cast<ssize_t>(t->rec_bytes))
+      break;
+    uint64_t key;
+    uint32_t flag;
+    std::memcpy(&key, buf.data(), 8);
+    std::memcpy(&flag, buf.data() + 8, 4);
+    if (flag)
+      d->index.upsert(key, ord);
+    else
+      d->index.erase(key);
+  }
+}
+
+// rewrite live records sequentially into a fresh file (shard lock held)
+bool compact_shard(SsdTable* t, DiskShard* d) {
+  std::string tmp = d->path + ".compact";
+  int nfd = open(tmp.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
+  if (nfd < 0) return false;
+  // sequential read order: sort live ordinals
+  std::vector<std::pair<int64_t, uint64_t>> live;
+  live.reserve(d->index.used);
+  d->index.for_each([&](uint64_t k, int64_t ord) { live.push_back({ord, k}); });
+  std::sort(live.begin(), live.end());
+  std::vector<uint8_t> buf(t->rec_bytes);
+  DiskIndex fresh;
+  int64_t out_ord = 0;
+  for (auto& [ord, key] : live) {
+    if (pread(d->fd, buf.data(), t->rec_bytes, ord * t->rec_bytes) !=
+        static_cast<ssize_t>(t->rec_bytes))
+      continue;
+    if (pwrite(nfd, buf.data(), t->rec_bytes, out_ord * t->rec_bytes) !=
+        static_cast<ssize_t>(t->rec_bytes)) {
+      close(nfd);
+      unlink(tmp.c_str());
+      return false;
+    }
+    fresh.upsert(key, out_ord);
+    ++out_ord;
+  }
+  // durability: the new log must be on stable storage BEFORE it replaces
+  // the old one, and the rename itself must reach the directory — a
+  // crash mid-compaction must never lose rows that were already durable
+  if (fsync(nfd) != 0) {
+    close(nfd);
+    unlink(tmp.c_str());
+    return false;
+  }
+  if (rename(tmp.c_str(), d->path.c_str()) != 0) {
+    close(nfd);
+    unlink(tmp.c_str());
+    return false;
+  }
+  std::string dir = d->path.substr(0, d->path.find_last_of('/'));
+  int dfd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+  if (dfd >= 0) {
+    fsync(dfd);
+    close(dfd);
+  }
+  close(d->fd);
+  d->fd = nfd;
+  d->index = std::move(fresh);
+  d->n_records = out_ord;
+  return true;
+}
+
+void maybe_compact(SsdTable* t, DiskShard* d) {
+  if (d->n_records > 4096 && d->n_records > 4 * std::max<int64_t>(d->index.used, 1))
+    compact_shard(t, d);
+}
+
+// -- tier logic (both shard locks held) -------------------------------------
+
+// disk -> RAM promotion; returns the RAM row or -1 if not on disk
+int32_t promote(SsdTable* t, Shard* sh, DiskShard* d, uint64_t key) {
+  int64_t ord = d->index.find(key);
+  if (ord < 0) return -1;
+  uint64_t k;
+  uint32_t flag;
+  d->row_buf.resize(t->fdim);
+  if (!read_record(t, d, ord, &k, &flag, d->row_buf.data()) || !flag ||
+      k != key)
+    return -1;
+  int32_t r = sh->lookup_or_insert(key, static_cast<int32_t>(d->row_buf[0]));
+  sh->import_row(r, d->row_buf.data());
+  d->index.erase(key);  // index-only: the file record becomes garbage
+  return r;
+}
+
+// fan a batch over shards, holding BOTH tier locks per shard (mem first,
+// disk second — consistent order across all entry points)
+template <typename Fn>
+void fan_out(SsdTable* t, const uint64_t* keys, int64_t n, Fn fn) {
+  int32_t ns = t->mem->cfg.shard_num;
+  std::vector<std::vector<int64_t>> per(ns);
+  for (int64_t i = 0; i < n; ++i)
+    per[static_cast<int32_t>(keys[i] % static_cast<uint64_t>(ns))].push_back(i);
+  std::vector<std::thread> ts;
+  for (int32_t s = 0; s < ns; ++s) {
+    if (per[s].empty()) continue;
+    ts.emplace_back([&, s]() {
+      Shard* sh = t->mem->shards[s];
+      DiskShard* d = t->disk[s];
+      std::lock_guard<std::mutex> g1(sh->mu);
+      std::lock_guard<std::mutex> g2(d->mu);
+      for (int64_t i : per[s]) fn(sh, d, i);
+    });
+  }
+  for (auto& th : ts) th.join();
+}
+
+template <typename Fn>
+void per_shard(SsdTable* t, Fn fn) {
+  std::vector<std::thread> ts;
+  for (size_t s = 0; s < t->mem->shards.size(); ++s) {
+    ts.emplace_back([&, s]() {
+      Shard* sh = t->mem->shards[s];
+      DiskShard* d = t->disk[s];
+      std::lock_guard<std::mutex> g1(sh->mu);
+      std::lock_guard<std::mutex> g2(d->mu);
+      fn(sh, d, static_cast<int32_t>(s));
+    });
+  }
+  for (auto& th : ts) th.join();
+}
+
+bool save_keep_values(const TableNativeConfig& c, const float* v,
+                      int32_t mode) {
+  if (mode == 0 || mode == 3) return true;
+  float dth = (mode == 2) ? 0.0f : c.delta_threshold;
+  float score = (v[3] - v[4]) * c.nonclk_coeff + v[4] * c.click_coeff;
+  return score >= c.base_threshold && v[2] >= dth && v[1] <= c.delta_keep_days;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* sst_create(const int32_t* iparams, const float* fparams,
+                 const char* dir) {
+  TableNativeConfig c = pstpu::parse_table_config(iparams, fparams);
+  if (mkdir(dir, 0755) != 0 && errno != EEXIST) return nullptr;
+  SsdTable* t = new SsdTable(c, dir);
+  for (int32_t s = 0; s < c.shard_num; ++s) {
+    DiskShard* d = new DiskShard();
+    d->path = std::string(dir) + "/ssd_shard_" + std::to_string(s) + ".dat";
+    d->fd = open(d->path.c_str(), O_RDWR | O_CREAT, 0644);
+    if (d->fd < 0) {
+      delete d;
+      delete t;
+      return nullptr;
+    }
+    replay_shard(t, d);
+    t->disk.push_back(d);
+  }
+  return t;
+}
+
+void sst_destroy(void* h) { delete static_cast<SsdTable*>(h); }
+
+int32_t sst_pull_dim(void* h) {
+  return static_cast<SsdTable*>(h)->mem->shards[0]->pull_dim();
+}
+int32_t sst_push_dim(void* h) {
+  return static_cast<SsdTable*>(h)->mem->shards[0]->push_dim();
+}
+int32_t sst_full_dim(void* h) { return static_cast<SsdTable*>(h)->fdim; }
+
+// rows live in RAM / rows live on disk / disk file bytes (incl. garbage)
+void sst_stats(void* h, int64_t* out3) {
+  SsdTable* t = static_cast<SsdTable*>(h);
+  int64_t mem = 0, dsk = 0, bytes = 0;
+  for (Shard* s : t->mem->shards) mem += s->used;
+  for (DiskShard* d : t->disk) {
+    std::lock_guard<std::mutex> g(d->mu);
+    dsk += d->index.used;
+    bytes += d->n_records * t->rec_bytes;
+  }
+  out3[0] = mem;
+  out3[1] = dsk;
+  out3[2] = bytes;
+}
+
+// per-shard live rows across both tiers (PrintTableStat support)
+void sst_shard_sizes(void* h, int64_t* out) {
+  SsdTable* t = static_cast<SsdTable*>(h);
+  for (size_t s = 0; s < t->mem->shards.size(); ++s) {
+    std::lock_guard<std::mutex> g(t->disk[s]->mu);
+    out[s] = t->mem->shards[s]->used + t->disk[s]->index.used;
+  }
+}
+
+int64_t sst_size(void* h) {
+  int64_t s3[3];
+  sst_stats(h, s3);
+  return s3[0] + s3[1];
+}
+
+// Pull (select layout) with disk fallback + promotion; insert-on-miss
+// into RAM when create != 0.
+void sst_pull(void* h, const uint64_t* keys, const int32_t* slots, int64_t n,
+              int32_t create, float* out) {
+  SsdTable* t = static_cast<SsdTable*>(h);
+  int32_t pd = t->mem->shards[0]->pull_dim();
+  fan_out(t, keys, n, [&](Shard* sh, DiskShard* d, int64_t i) {
+    int32_t r = sh->find(keys[i]);
+    if (r < 0) r = promote(t, sh, d, keys[i]);
+    if (r < 0 && create)
+      r = sh->lookup_or_insert(keys[i], slots ? slots[i] : 0);
+    float* o = out + i * pd;
+    if (r >= 0)
+      sh->select_into(r, o);
+    else
+      std::fill_n(o, pd, 0.0f);
+  });
+}
+
+// Push merged records (promotes cold rows first; creates on miss).
+void sst_push(void* h, const uint64_t* keys, const float* push, int64_t n) {
+  SsdTable* t = static_cast<SsdTable*>(h);
+  int32_t pd = t->mem->shards[0]->push_dim();
+  fan_out(t, keys, n, [&](Shard* sh, DiskShard* d, int64_t i) {
+    const float* pv = push + i * pd;
+    int32_t r = sh->find(keys[i]);
+    if (r < 0) r = promote(t, sh, d, keys[i]);
+    if (r < 0) r = sh->lookup_or_insert(keys[i], static_cast<int32_t>(pv[0]));
+    sh->push_one(r, pv);
+  });
+}
+
+// Full-row export with disk fallback; create promotes/creates so the
+// pass-build gets one traversal exactly like pst_export_create.
+void sst_export(void* h, const uint64_t* keys, const int32_t* slots,
+                int64_t n, int32_t create, float* values_out, uint8_t* found) {
+  SsdTable* t = static_cast<SsdTable*>(h);
+  int32_t fd = t->fdim;
+  fan_out(t, keys, n, [&](Shard* sh, DiskShard* d, int64_t i) {
+    int32_t r = sh->find(keys[i]);
+    if (r < 0) r = promote(t, sh, d, keys[i]);
+    if (r < 0 && create)
+      r = sh->lookup_or_insert(keys[i], slots ? slots[i] : 0);
+    float* o = values_out + i * fd;
+    if (r < 0) {
+      std::fill_n(o, fd, 0.0f);
+      if (found) found[i] = 0;
+      return;
+    }
+    if (found) found[i] = 1;
+    sh->export_row(r, o);
+  });
+}
+
+// Bulk full-row insert into the HOT tier (cache flush-back) — erases any
+// stale cold copy so the one-tier invariant holds.
+void sst_insert_full(void* h, const uint64_t* keys, const float* values,
+                     int64_t n) {
+  SsdTable* t = static_cast<SsdTable*>(h);
+  int32_t fd = t->fdim;
+  fan_out(t, keys, n, [&](Shard* sh, DiskShard* d, int64_t i) {
+    const float* v = values + i * fd;
+    int32_t r = sh->lookup_or_insert(keys[i], static_cast<int32_t>(v[0]));
+    sh->import_row(r, v);
+    if (d->index.erase(keys[i]))
+      append_record(t, d, keys[i], 0, nullptr);  // tombstone for replay
+  });
+}
+
+// Bulk full-row insert into the COLD tier (bulk model load: the feature
+// population goes to disk; training promotes what it touches).
+void sst_load_cold(void* h, const uint64_t* keys, const float* values,
+                   int64_t n) {
+  SsdTable* t = static_cast<SsdTable*>(h);
+  int32_t fd = t->fdim;
+  fan_out(t, keys, n, [&](Shard* sh, DiskShard* d, int64_t i) {
+    sh->erase(keys[i]);  // hot copy (if any) is superseded
+    int64_t ord = append_record(t, d, keys[i], 1, values + i * fd);
+    if (ord >= 0) d->index.upsert(keys[i], ord);
+  });
+}
+
+// Spill the coldest RAM rows to disk until at most `budget` rows stay
+// hot (global budget, split evenly across shards). Coldness order:
+// highest unseen_days first, then lowest show/click score. Returns the
+// number of rows spilled.
+int64_t sst_spill(void* h, int64_t budget) {
+  SsdTable* t = static_cast<SsdTable*>(h);
+  int32_t ns = t->mem->cfg.shard_num;
+  int64_t per = budget / ns;
+  std::vector<int64_t> spilled(ns, 0);
+  per_shard(t, [&](Shard* sh, DiskShard* d, int32_t s) {
+    if (sh->used <= per) return;
+    struct Cold {
+      float unseen, score;
+      uint64_t key;
+      int32_t row;
+    };
+    std::vector<Cold> live;
+    live.reserve(sh->used);
+    for (uint64_t hh = 0; hh <= sh->mask; ++hh) {
+      int32_t r = sh->slot_state[hh];
+      if (r < 0) continue;
+      live.push_back({sh->f_unseen[r],
+                      sh->show_click_score(sh->f_show[r], sh->f_click[r]),
+                      sh->slot_keys[hh], r});
+    }
+    int64_t excess = static_cast<int64_t>(live.size()) - per;
+    std::nth_element(live.begin(), live.begin() + excess, live.end(),
+                     [](const Cold& a, const Cold& b) {
+                       if (a.unseen != b.unseen) return a.unseen > b.unseen;
+                       return a.score < b.score;
+                     });
+    std::vector<float> row(t->fdim);
+    for (int64_t i = 0; i < excess; ++i) {
+      sh->export_row(live[i].row, row.data());
+      int64_t ord = append_record(t, d, live[i].key, 1, row.data());
+      if (ord < 0) break;  // disk full — keep the row hot
+      d->index.upsert(live[i].key, ord);
+      sh->erase(live[i].key);
+      ++spilled[s];
+    }
+    maybe_compact(t, d);
+  });
+  int64_t tot = 0;
+  for (int64_t v : spilled) tot += v;
+  return tot;
+}
+
+// Lifecycle shrink over BOTH tiers: decay show/click, unseen_days++,
+// delete dead features (ctr_accessor Shrink semantics). Disk rows are
+// rewritten in place in the log (append + index update).
+int64_t sst_shrink(void* h) {
+  SsdTable* t = static_cast<SsdTable*>(h);
+  std::vector<int64_t> erased(t->mem->shards.size(), 0);
+  const TableNativeConfig& c = t->mem->cfg;
+  per_shard(t, [&](Shard* sh, DiskShard* d, int32_t s) {
+    erased[s] = sh->shrink();
+    // disk sweep: collect entries first (rewrites mutate the index)
+    std::vector<std::pair<uint64_t, int64_t>> entries;
+    entries.reserve(d->index.used);
+    d->index.for_each([&](uint64_t k, int64_t ord) { entries.push_back({k, ord}); });
+    std::vector<float> v(t->fdim);
+    for (auto& [key, ord] : entries) {
+      uint64_t k;
+      uint32_t flag;
+      if (!read_record(t, d, ord, &k, &flag, v.data()) || !flag) continue;
+      v[3] *= c.show_click_decay_rate;
+      v[4] *= c.show_click_decay_rate;
+      v[1] += 1.0f;
+      float score = (v[3] - v[4]) * c.nonclk_coeff + v[4] * c.click_coeff;
+      if (score < c.delete_threshold || v[1] > c.delete_after_unseen_days) {
+        d->index.erase(key);
+        append_record(t, d, key, 0, nullptr);
+        ++erased[s];
+      } else {
+        int64_t nord = append_record(t, d, key, 1, v.data());
+        if (nord >= 0) d->index.upsert(key, nord);
+      }
+    }
+    maybe_compact(t, d);
+  });
+  int64_t tot = 0;
+  for (int64_t e : erased) tot += e;
+  return tot;
+}
+
+int64_t sst_compact(void* h) {
+  SsdTable* t = static_cast<SsdTable*>(h);
+  per_shard(t, [&](Shard*, DiskShard* d, int32_t) { compact_shard(t, d); });
+  int64_t bytes = 0;
+  for (DiskShard* d : t->disk) bytes += d->n_records * t->rec_bytes;
+  return bytes;
+}
+
+// Save protocol (begin/fetch), both tiers; same mode semantics as the
+// RAM engine. Disk rows needing update_stat_after_save (modes 2/3) are
+// rewritten in the log. Both tier locks are held together PER SHARD so
+// the snapshot is atomic against concurrent promote/spill on that shard
+// (a key's tiers live in one shard; cross-shard skew is fine — the RAM
+// engine has the same per-shard granularity).
+int64_t sst_save_begin(void* h, int32_t mode) {
+  SsdTable* t = static_cast<SsdTable*>(h);
+  std::lock_guard<std::mutex> sg(t->save_mu);
+  std::lock_guard<std::mutex> mg(t->mem->save_mu);
+  t->mem->save_keys.clear();
+  t->mem->save_values.clear();
+  const TableNativeConfig& c = t->mem->cfg;
+  int32_t fd = t->fdim;
+  for (size_t s = 0; s < t->mem->shards.size(); ++s) {
+    Shard* sh = t->mem->shards[s];
+    DiskShard* d = t->disk[s];
+    std::lock_guard<std::mutex> g1(sh->mu);
+    std::lock_guard<std::mutex> g2(d->mu);
+    // hot tier (the table_save_snapshot_locked body, one shard)
+    for (uint64_t hh = 0; hh <= sh->mask; ++hh) {
+      int32_t r = sh->slot_state[hh];
+      if (r < 0) continue;
+      if (!sh->save_keep(r, mode)) continue;
+      sh->update_stat_after_save(r, mode);
+      t->mem->save_keys.push_back(sh->slot_keys[hh]);
+      size_t off = t->mem->save_values.size();
+      t->mem->save_values.resize(off + fd);
+      sh->export_row(r, t->mem->save_values.data() + off);
+    }
+    // cold tier sweep
+    std::vector<std::pair<uint64_t, int64_t>> entries;
+    entries.reserve(d->index.used);
+    d->index.for_each([&](uint64_t k, int64_t ord) { entries.push_back({k, ord}); });
+    std::vector<float> v(fd);
+    for (auto& [key, ord] : entries) {
+      uint64_t k;
+      uint32_t flag;
+      if (!read_record(t, d, ord, &k, &flag, v.data()) || !flag) continue;
+      if (!save_keep_values(c, v.data(), mode)) continue;
+      // update_stat_after_save applies BEFORE the snapshot copy — the
+      // RAM engine exports after updating
+      bool dirty = false;
+      if (mode == 3) {
+        v[1] += 1.0f;
+        dirty = true;
+      } else if (mode == 2) {
+        v[2] = 0.0f;
+        dirty = true;
+      }
+      t->mem->save_keys.push_back(key);
+      size_t off = t->mem->save_values.size();
+      t->mem->save_values.resize(off + fd);
+      std::memcpy(t->mem->save_values.data() + off, v.data(),
+                  4 * static_cast<size_t>(fd));
+      if (dirty) {
+        int64_t nord = append_record(t, d, key, 1, v.data());
+        if (nord >= 0) d->index.upsert(key, nord);
+      }
+    }
+    // modes 2/3 rewrite every kept cold row — without compaction here,
+    // repeated checkpoints grow the log unboundedly
+    maybe_compact(t, d);
+  }
+  return static_cast<int64_t>(t->mem->save_keys.size());
+}
+
+void sst_save_fetch(void* h, uint64_t* keys_out, float* values_out) {
+  SsdTable* t = static_cast<SsdTable*>(h);
+  std::lock_guard<std::mutex> sg(t->save_mu);
+  pstpu::table_save_drain(t->mem, keys_out, values_out);
+}
+
+void sst_flush(void* h) {
+  SsdTable* t = static_cast<SsdTable*>(h);
+  for (DiskShard* d : t->disk) {
+    std::lock_guard<std::mutex> g(d->mu);
+    fsync(d->fd);
+  }
+}
+
+}  // extern "C"

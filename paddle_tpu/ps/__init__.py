@@ -12,6 +12,7 @@ from .table import (
     MemoryDenseTable,
     MemorySparseGeoTable,
     MemorySparseTable,
+    SsdSparseTable,
     TableConfig,
 )
 
@@ -34,5 +35,6 @@ __all__ = [
     "MemoryDenseTable",
     "MemorySparseGeoTable",
     "MemorySparseTable",
+    "SsdSparseTable",
     "TableConfig",
 ]

@@ -16,8 +16,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-__all__ = ["FeasignIndex", "NativeSparseTableEngine", "native_available",
-           "load_native", "dedup_u64"]
+__all__ = ["FeasignIndex", "NativeSparseTableEngine", "SsdTableEngine",
+           "native_available", "load_native", "dedup_u64"]
 
 _CSRC = os.path.join(os.path.dirname(__file__), "..", "csrc")
 _LIB_PATH = os.path.join(_CSRC, "libpaddle_tpu_native.so")
@@ -512,6 +512,170 @@ class NativeSparseTableEngine:
         keys = np.ascontiguousarray(keys, np.uint64)
         values = np.ascontiguousarray(values, np.float32)
         self._lib.pst_insert_full(self._h, _u64(keys), _f32(values), len(keys))
+
+
+# ---------------------------------------------------------------------------
+# SSD (two-tier) sparse-table engine (csrc/ssd_table.cc)
+# ---------------------------------------------------------------------------
+
+
+def _configure_sst(lib: ctypes.CDLL) -> None:
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.sst_create.restype = ctypes.c_void_p
+    lib.sst_create.argtypes = [i32p, f32p, ctypes.c_char_p]
+    lib.sst_destroy.argtypes = [ctypes.c_void_p]
+    for fn in ("sst_pull_dim", "sst_push_dim", "sst_full_dim"):
+        getattr(lib, fn).restype = ctypes.c_int32
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.sst_size.restype = ctypes.c_int64
+    lib.sst_size.argtypes = [ctypes.c_void_p]
+    lib.sst_stats.argtypes = [ctypes.c_void_p, i64p]
+    lib.sst_shard_sizes.argtypes = [ctypes.c_void_p, i64p]
+    lib.sst_pull.argtypes = [ctypes.c_void_p, u64p, i32p, ctypes.c_int64,
+                             ctypes.c_int32, f32p]
+    lib.sst_push.argtypes = [ctypes.c_void_p, u64p, f32p, ctypes.c_int64]
+    lib.sst_export.argtypes = [ctypes.c_void_p, u64p, i32p, ctypes.c_int64,
+                               ctypes.c_int32, f32p, u8p]
+    lib.sst_insert_full.argtypes = [ctypes.c_void_p, u64p, f32p, ctypes.c_int64]
+    lib.sst_load_cold.argtypes = [ctypes.c_void_p, u64p, f32p, ctypes.c_int64]
+    lib.sst_spill.restype = ctypes.c_int64
+    lib.sst_spill.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.sst_shrink.restype = ctypes.c_int64
+    lib.sst_shrink.argtypes = [ctypes.c_void_p]
+    lib.sst_compact.restype = ctypes.c_int64
+    lib.sst_compact.argtypes = [ctypes.c_void_p]
+    lib.sst_save_begin.restype = ctypes.c_int64
+    lib.sst_save_begin.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.sst_save_fetch.argtypes = [ctypes.c_void_p, u64p, f32p]
+    lib.sst_flush.argtypes = [ctypes.c_void_p]
+
+
+class SsdTableEngine:
+    """ctypes handle over the two-tier C++ SSD table (csrc/ssd_table.cc):
+    RAM hot tier + per-shard append-only log files with promote-on-access
+    and cold spill. Same method surface as NativeSparseTableEngine plus
+    spill/compact/stats/load_cold. Native-only — there is no Python
+    fallback for the disk tier."""
+
+    def __init__(self, shard_num: int, accessor: str, embedx_dim: int,
+                 embed_rule: str, embedx_rule: str, seed: int,
+                 lifecycle: Tuple[float, ...], sgd: Tuple[float, ...],
+                 path: str) -> None:
+        self._lib = load_native()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        if not getattr(self._lib, "_sst_configured", False):
+            try:
+                _configure_sst(self._lib)
+            except AttributeError as e:  # stale .so without sst_* symbols
+                raise RuntimeError(f"native library lacks ssd-table symbols: {e}")
+            self._lib._sst_configured = True
+        iparams = np.asarray(
+            [shard_num, _ACCESSOR_IDS[accessor], embedx_dim,
+             _RULE_IDS[embed_rule], _RULE_IDS[embedx_rule], seed], np.int32)
+        fparams = np.asarray(list(lifecycle) + list(sgd), np.float32)
+        assert len(fparams) == 17, len(fparams)
+        self._h = self._lib.sst_create(_i32(iparams), _f32(fparams),
+                                       str(path).encode())
+        if not self._h:
+            raise RuntimeError(f"ssd table open failed at {path!r}")
+        self._save_lock = threading.Lock()
+        self._shard_num = shard_num
+        self.pull_dim = int(self._lib.sst_pull_dim(self._h))
+        self.push_dim = int(self._lib.sst_push_dim(self._h))
+        self.full_dim = int(self._lib.sst_full_dim(self._h))
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_h", None):
+            lib.sst_destroy(self._h)
+            self._h = None
+
+    def close(self) -> None:
+        if getattr(self, "_h", None):
+            self._lib.sst_destroy(self._h)
+            self._h = None
+
+    def size(self) -> int:
+        return int(self._lib.sst_size(self._h))
+
+    def stats(self) -> Tuple[int, int, int]:
+        """(hot rows, cold rows, disk bytes incl. log garbage)."""
+        out = np.empty(3, np.int64)
+        self._lib.sst_stats(self._h, out.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int64)))
+        return int(out[0]), int(out[1]), int(out[2])
+
+    def shard_sizes(self, shard_num: int) -> np.ndarray:
+        out = np.empty(shard_num, np.int64)
+        self._lib.sst_shard_sizes(self._h, out.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int64)))
+        return out
+
+    def pull(self, keys: np.ndarray, slots, create: bool) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        out = np.empty((len(keys), self.pull_dim), np.float32)
+        slots_arr = (np.ascontiguousarray(slots, np.int32)
+                     if slots is not None else None)
+        self._lib.sst_pull(self._h, _u64(keys),
+                           _i32(slots_arr) if slots_arr is not None else None,
+                           len(keys), 1 if create else 0, _f32(out))
+        return out
+
+    def push(self, keys: np.ndarray, push_values: np.ndarray) -> None:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        push_values = np.ascontiguousarray(push_values, np.float32)
+        self._lib.sst_push(self._h, _u64(keys), _f32(push_values), len(keys))
+
+    def shrink(self) -> int:
+        return int(self._lib.sst_shrink(self._h))
+
+    def spill(self, budget: int) -> int:
+        """Move the coldest hot rows to disk until ≤ budget stay hot."""
+        return int(self._lib.sst_spill(self._h, ctypes.c_int64(budget)))
+
+    def compact(self) -> int:
+        """Rewrite the logs to live records only; returns disk bytes after."""
+        return int(self._lib.sst_compact(self._h))
+
+    def flush(self) -> None:
+        self._lib.sst_flush(self._h)
+
+    def save_items(self, mode: int) -> Tuple[np.ndarray, np.ndarray]:
+        with self._save_lock:
+            n = int(self._lib.sst_save_begin(self._h, mode))
+            keys = np.empty(n, np.uint64)
+            values = np.empty((n, self.full_dim), np.float32)
+            self._lib.sst_save_fetch(self._h, _u64(keys), _f32(values))
+        return keys, values
+
+    def export_full(self, keys: np.ndarray, create: bool = False,
+                    slots=None) -> Tuple[np.ndarray, np.ndarray]:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        values = np.empty((len(keys), self.full_dim), np.float32)
+        found = np.empty(len(keys), np.uint8)
+        slots_arr = (np.ascontiguousarray(slots, np.int32)
+                     if slots is not None else None)
+        self._lib.sst_export(self._h, _u64(keys),
+                             _i32(slots_arr) if slots_arr is not None else None,
+                             len(keys), 1 if create else 0, _f32(values),
+                             found.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+        return values, found.astype(bool)
+
+    def insert_full(self, keys: np.ndarray, values: np.ndarray) -> None:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        values = np.ascontiguousarray(values, np.float32)
+        self._lib.sst_insert_full(self._h, _u64(keys), _f32(values), len(keys))
+
+    def load_cold(self, keys: np.ndarray, values: np.ndarray) -> None:
+        """Bulk-load full rows straight into the disk tier."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        values = np.ascontiguousarray(values, np.float32)
+        self._lib.sst_load_cold(self._h, _u64(keys), _f32(values), len(keys))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,236 @@
+"""SsdSparseTable: two-tier (RAM + disk log) table semantics.
+
+Covers the tier protocol (promote-on-access, spill), crash recovery by
+log replay, two-tier shrink/save, compaction, and drop-in use under the
+HBM embedding cache. Reference lineage: the rocksdb SSD-table direction
+scaffolded at ps/table/depends/rocksdb_warpper.h (SURVEY §2.2).
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.ps.accessor import AccessorConfig
+from paddle_tpu.ps.native import native_available
+from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+from paddle_tpu.ps.table import MemorySparseTable, SsdSparseTable, TableConfig
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native library unavailable")
+
+
+def _acc(**kw):
+    kw.setdefault("sgd", SGDRuleConfig(initial_range=0.0))
+    kw.setdefault("embedx_dim", 4)
+    kw.setdefault("embedx_threshold", 0.0)
+    return AccessorConfig(**kw)
+
+
+def _cfg(**kw):
+    kw.setdefault("shard_num", 4)
+    kw.setdefault("accessor_config", _acc())
+    return TableConfig(**kw)
+
+
+def _push_batch(table, rng, n=200, key_hi=1000):
+    keys = rng.integers(1, key_hi, size=n).astype(np.uint64)
+    push = np.zeros((n, table.accessor.push_dim), np.float32)
+    push[:, 0] = (keys % 8).astype(np.float32)          # slot
+    push[:, 1] = 1.0                                    # show
+    push[:, 2] = (rng.random(n) < 0.3).astype(np.float32)  # click
+    push[:, 3:] = rng.normal(size=(n, push.shape[1] - 3)).astype(np.float32)
+    table.push_sparse(keys, push)
+    return keys
+
+
+def test_parity_with_memory_table(tmp_path):
+    rng1, rng2 = np.random.default_rng(3), np.random.default_rng(3)
+    mem = MemorySparseTable(_cfg())
+    ssd = SsdSparseTable(str(tmp_path / "t"), _cfg())
+    for _ in range(5):
+        _push_batch(mem, rng1)
+        _push_batch(ssd, rng2)
+    probe = np.arange(1, 1000, dtype=np.uint64)
+    np.testing.assert_allclose(
+        ssd.pull_sparse(probe, create=False),
+        mem.pull_sparse(probe, create=False), atol=1e-6)
+    assert ssd.size() == mem.size()
+
+
+def test_spill_promote_roundtrip(tmp_path):
+    rng = np.random.default_rng(4)
+    ssd = SsdSparseTable(str(tmp_path / "t"), _cfg())
+    keys = _push_batch(ssd, rng, n=400, key_hi=500)
+    before = ssd.pull_sparse(np.unique(keys), create=False)
+    total = ssd.size()
+
+    spilled = ssd.spill(hot_budget=total // 4)
+    st = ssd.stats()
+    assert spilled > 0 and st["cold_rows"] == spilled
+    assert st["hot_rows"] + st["cold_rows"] == total
+
+    # pulls see identical values regardless of tier; access promotes
+    after = ssd.pull_sparse(np.unique(keys), create=False)
+    np.testing.assert_allclose(after, before, atol=1e-6)
+    st2 = ssd.stats()
+    assert st2["cold_rows"] == 0 and st2["hot_rows"] == total
+
+
+def test_push_into_cold_rows_promotes(tmp_path):
+    """Pushing to a spilled key must promote it and apply the gradient
+    exactly as a hot push would (mirror against a RAM table)."""
+    rng1, rng2 = np.random.default_rng(5), np.random.default_rng(5)
+    mem = MemorySparseTable(_cfg())
+    ssd = SsdSparseTable(str(tmp_path / "t"), _cfg())
+    _push_batch(mem, rng1, n=300, key_hi=300)
+    _push_batch(ssd, rng2, n=300, key_hi=300)
+    ssd.spill(hot_budget=0)  # everything cold
+    assert ssd.stats()["hot_rows"] == 0
+    _push_batch(mem, rng1, n=300, key_hi=300)
+    _push_batch(ssd, rng2, n=300, key_hi=300)
+    probe = np.arange(1, 300, dtype=np.uint64)
+    np.testing.assert_allclose(
+        ssd.pull_sparse(probe, create=False),
+        mem.pull_sparse(probe, create=False), atol=1e-6)
+
+
+def test_log_replay_recovery(tmp_path):
+    """Rows on disk survive process restart (reopen replays the logs);
+    hot-tier rows are volatile unless spilled or saved — spill all, then
+    reopen and compare."""
+    rng = np.random.default_rng(6)
+    path = str(tmp_path / "t")
+    ssd = SsdSparseTable(path, _cfg())
+    keys = np.unique(_push_batch(ssd, rng, n=500, key_hi=800))
+    want = ssd.pull_sparse(keys, create=False)
+    ssd.spill(hot_budget=0)
+    ssd.flush()
+    ssd.close()
+
+    back = SsdSparseTable(path, _cfg())
+    st = back.stats()
+    assert st["hot_rows"] == 0 and st["cold_rows"] == len(keys)
+    np.testing.assert_allclose(back.pull_sparse(keys, create=False), want,
+                               atol=1e-6)
+
+
+def test_two_tier_shrink_matches_memory_table(tmp_path):
+    """shrink() applies decay + delete on BOTH tiers; mirror a RAM table
+    (same pushes, same shrink count and post-state)."""
+    cfg_kw = dict(accessor_config=_acc(delete_threshold=0.5,
+                                       show_click_decay_rate=0.5))
+    rng1, rng2 = np.random.default_rng(7), np.random.default_rng(7)
+    mem = MemorySparseTable(_cfg(**cfg_kw))
+    ssd = SsdSparseTable(str(tmp_path / "t"), _cfg(**cfg_kw))
+    _push_batch(mem, rng1, n=300, key_hi=400)
+    _push_batch(ssd, rng2, n=300, key_hi=400)
+    ssd.spill(hot_budget=ssd.size() // 2)  # half cold, half hot
+    e_mem = mem.shrink()
+    e_ssd = ssd.shrink()
+    assert e_ssd == e_mem
+    assert ssd.size() == mem.size()
+    probe = np.arange(1, 400, dtype=np.uint64)
+    np.testing.assert_allclose(
+        ssd.pull_sparse(probe, create=False),
+        mem.pull_sparse(probe, create=False), atol=1e-6)
+
+
+@pytest.mark.parametrize("mode", [0, 1, 2, 3])
+def test_save_modes_match_memory_table(tmp_path, mode):
+    cfg_kw = dict(accessor_config=_acc(base_threshold=1.0,
+                                       delta_threshold=0.1))
+    rng1, rng2 = np.random.default_rng(8), np.random.default_rng(8)
+    mem = MemorySparseTable(_cfg(**cfg_kw))
+    ssd = SsdSparseTable(str(tmp_path / "t"), _cfg(**cfg_kw))
+    _push_batch(mem, rng1, n=250, key_hi=300)
+    _push_batch(ssd, rng2, n=250, key_hi=300)
+    ssd.spill(hot_budget=ssd.size() // 3)
+
+    k1, v1 = mem._native.save_items(mode)
+    k2, v2 = ssd._native.save_items(mode)
+    o1, o2 = np.argsort(k1), np.argsort(k2)
+    np.testing.assert_array_equal(k1[o1], k2[o2])
+    np.testing.assert_allclose(v1[o1], v2[o2], atol=1e-6)
+
+
+def test_load_cold_and_compaction(tmp_path):
+    rng = np.random.default_rng(9)
+    ssd = SsdSparseTable(str(tmp_path / "t"), _cfg())
+    n = 1000
+    keys = np.arange(1, n + 1, dtype=np.uint64)
+    values = np.zeros((n, ssd.full_dim), np.float32)
+    values[:, 3] = 5.0  # show
+    values[:, 5] = rng.normal(size=n).astype(np.float32)  # embed_w
+    ssd.load_cold(keys, values)
+    st = ssd.stats()
+    assert st["cold_rows"] == n and st["hot_rows"] == 0
+    got = ssd.pull_sparse(keys[:10], create=False)
+    np.testing.assert_allclose(got[:, 2], values[:10, 5], atol=1e-6)
+
+    # churn: repeated spill/promote grows the log; compaction shrinks it
+    for _ in range(6):
+        ssd.pull_sparse(keys, create=False)   # promote all
+        ssd.spill(hot_budget=0)               # spill all (appends)
+    grown = ssd.stats()["disk_bytes"]
+    ssd.compact()
+    shrunk = ssd.stats()["disk_bytes"]
+    assert shrunk < grown
+    np.testing.assert_allclose(
+        ssd.pull_sparse(keys[:10], create=False)[:, 2], values[:10, 5],
+        atol=1e-6)
+
+
+def test_cache_pass_over_ssd_table(tmp_path):
+    """HbmEmbeddingCache works unchanged over the SSD table: begin_pass
+    promotes/creates, end_pass flushes back hot."""
+    from paddle_tpu.ps.embedding_cache import CacheConfig, HbmEmbeddingCache
+
+    rng = np.random.default_rng(10)
+    ssd = SsdSparseTable(str(tmp_path / "t"), _cfg())
+    seed_keys = np.unique(_push_batch(ssd, rng, n=200, key_hi=250))
+    ssd.spill(hot_budget=0)  # population starts cold
+
+    cache = HbmEmbeddingCache(ssd, CacheConfig(
+        capacity=1 << 10, embedx_dim=4, embedx_threshold=0.0))
+    pass_keys = np.arange(1, 400, dtype=np.uint64)  # cold + brand-new keys
+    cache.begin_pass(pass_keys)
+    rows = cache.lookup(seed_keys)
+    from paddle_tpu.ps.embedding_cache import cache_pull
+
+    pulled = np.asarray(cache_pull(cache.state, rows))
+    want = ssd.pull_sparse(seed_keys, create=False)[:, -pulled.shape[1]:]
+    np.testing.assert_allclose(pulled, want, atol=1e-5)
+    cache.end_pass()
+    assert ssd.size() >= len(pass_keys)
+
+
+def test_save_load_roundtrip_lands_cold(tmp_path):
+    """save() -> load() roundtrip restores into the DISK tier (a
+    larger-than-RAM population must not be rehydrated into RAM)."""
+    rng = np.random.default_rng(12)
+    ssd = SsdSparseTable(str(tmp_path / "a"), _cfg())
+    keys = np.unique(_push_batch(ssd, rng, n=300, key_hi=400))
+    want = ssd.pull_sparse(keys, create=False)
+    n = ssd.save(str(tmp_path / "ckpt"), mode=0)
+    assert n == len(keys)
+
+    fresh = SsdSparseTable(str(tmp_path / "b"), _cfg())
+    assert fresh.load(str(tmp_path / "ckpt")) == n
+    st = fresh.stats()
+    assert st["hot_rows"] == 0 and st["cold_rows"] == n
+    np.testing.assert_allclose(fresh.pull_sparse(keys, create=False), want,
+                               atol=1e-6)
+
+
+def test_repeated_mode3_saves_bounded_disk(tmp_path):
+    """Daily batch saves (mode 3) rewrite every cold row; compaction in
+    the save path must keep disk growth bounded."""
+    rng = np.random.default_rng(13)
+    ssd = SsdSparseTable(str(tmp_path / "t"), _cfg())
+    _push_batch(ssd, rng, n=2000, key_hi=3000)
+    ssd.spill(hot_budget=0)
+    live = ssd.stats()["cold_rows"]
+    rec_bytes = 8 + 4 + 4 * ssd.full_dim
+    for _ in range(12):
+        ssd._native.save_items(mode=3)
+    # bound: compaction threshold is 4x live data
+    assert ssd.stats()["disk_bytes"] <= 5 * live * rec_bytes
