@@ -64,6 +64,35 @@ struct TableNativeConfig {
   SgdConfig sgd;
 };
 
+// -- lifecycle math shared by the RAM and SSD engines (one definition:
+// the disk tier must keep/delete/decay EXACTLY like the hot tier) ------
+
+inline float show_click_score(const TableNativeConfig& c, float show,
+                              float click) {
+  return (show - click) * c.nonclk_coeff + click * c.click_coeff;
+}
+
+// Save keep filter (ctr_accessor.cc:55-135 semantics; mode 0=all,
+// 1=delta, 2=base, 3=batch).
+inline bool save_keep(const TableNativeConfig& c, float score,
+                      float delta_score, float unseen, int32_t mode) {
+  if (mode == 0 || mode == 3) return true;
+  float dth = (mode == 2) ? 0.0f : c.delta_threshold;
+  return score >= c.base_threshold && delta_score >= dth &&
+         unseen <= c.delta_keep_days;
+}
+
+// Daily shrink step on one feature: decay + age; returns true when the
+// feature is dead (delete it).
+inline bool shrink_one(const TableNativeConfig& c, float* show, float* click,
+                       float* unseen) {
+  *show *= c.show_click_decay_rate;
+  *click *= c.show_click_decay_rate;
+  *unseen += 1.0f;
+  float score = show_click_score(c, *show, *click);
+  return score < c.delete_threshold || *unseen > c.delete_after_unseen_days;
+}
+
 inline int32_t rule_state_dim(int32_t rule, int32_t dim) {
   switch (rule) {
     case kRuleNaive: return 0;
@@ -328,7 +357,7 @@ struct Shard {
   }
 
   float show_click_score(float show, float click) const {
-    return (show - click) * cfg->nonclk_coeff + click * cfg->click_coeff;
+    return pstpu::show_click_score(*cfg, show, click);
   }
 
   int32_t pull_dim() const {
@@ -389,12 +418,7 @@ struct Shard {
     for (uint64_t h = 0; h <= mask; ++h) {
       int32_t r = slot_state[h];
       if (r < 0) continue;
-      f_show[r] *= cfg->show_click_decay_rate;
-      f_click[r] *= cfg->show_click_decay_rate;
-      f_unseen[r] += 1.0f;
-      float score = show_click_score(f_show[r], f_click[r]);
-      if (score < cfg->delete_threshold ||
-          f_unseen[r] > cfg->delete_after_unseen_days) {
+      if (shrink_one(*cfg, &f_show[r], &f_click[r], &f_unseen[r])) {
         slot_state[h] = kTombstone;
         row_alive[r] = 0;
         free_rows.push_back(r);
@@ -441,12 +465,8 @@ struct Shard {
   }
 
   bool save_keep(int32_t r, int32_t mode) const {
-    if (mode == 0 || mode == 3) return true;
-    float delta_threshold = (mode == 2) ? 0.0f : cfg->delta_threshold;
-    float score = show_click_score(f_show[r], f_click[r]);
-    return score >= cfg->base_threshold &&
-           f_delta_score[r] >= delta_threshold &&
-           f_unseen[r] <= cfg->delta_keep_days;
+    return pstpu::save_keep(*cfg, show_click_score(f_show[r], f_click[r]),
+                            f_delta_score[r], f_unseen[r], mode);
   }
 
   void update_stat_after_save(int32_t r, int32_t mode) {

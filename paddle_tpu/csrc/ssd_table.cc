@@ -337,12 +337,11 @@ void per_shard(SsdTable* t, Fn fn) {
   for (auto& th : ts) th.join();
 }
 
+// full-row layout: v[1]=unseen, v[2]=delta_score, v[3]=show, v[4]=click
 bool save_keep_values(const TableNativeConfig& c, const float* v,
                       int32_t mode) {
-  if (mode == 0 || mode == 3) return true;
-  float dth = (mode == 2) ? 0.0f : c.delta_threshold;
-  float score = (v[3] - v[4]) * c.nonclk_coeff + v[4] * c.click_coeff;
-  return score >= c.base_threshold && v[2] >= dth && v[1] <= c.delta_keep_days;
+  return pstpu::save_keep(c, pstpu::show_click_score(c, v[3], v[4]), v[2],
+                          v[1], mode);
 }
 
 }  // namespace
@@ -464,7 +463,10 @@ void sst_export(void* h, const uint64_t* keys, const int32_t* slots,
 }
 
 // Bulk full-row insert into the HOT tier (cache flush-back) — erases any
-// stale cold copy so the one-tier invariant holds.
+// stale cold copy from the INDEX only (same semantics as promote): the
+// newer value lives in volatile RAM, so the stale file record must stay
+// replayable — a tombstone here would make a crash lose the feature
+// outright instead of resurrecting the stale copy.
 void sst_insert_full(void* h, const uint64_t* keys, const float* values,
                      int64_t n) {
   SsdTable* t = static_cast<SsdTable*>(h);
@@ -473,8 +475,7 @@ void sst_insert_full(void* h, const uint64_t* keys, const float* values,
     const float* v = values + i * fd;
     int32_t r = sh->lookup_or_insert(keys[i], static_cast<int32_t>(v[0]));
     sh->import_row(r, v);
-    if (d->index.erase(keys[i]))
-      append_record(t, d, keys[i], 0, nullptr);  // tombstone for replay
+    d->index.erase(keys[i]);
   });
 }
 
@@ -556,11 +557,7 @@ int64_t sst_shrink(void* h) {
       uint64_t k;
       uint32_t flag;
       if (!read_record(t, d, ord, &k, &flag, v.data()) || !flag) continue;
-      v[3] *= c.show_click_decay_rate;
-      v[4] *= c.show_click_decay_rate;
-      v[1] += 1.0f;
-      float score = (v[3] - v[4]) * c.nonclk_coeff + v[4] * c.click_coeff;
-      if (score < c.delete_threshold || v[1] > c.delete_after_unseen_days) {
+      if (pstpu::shrink_one(c, &v[3], &v[4], &v[1])) {
         d->index.erase(key);
         append_record(t, d, key, 0, nullptr);
         ++erased[s];

@@ -1,19 +1,20 @@
-"""Pallas fused sparse-optimizer kernel (CTR AdaGrad row update).
+"""Pallas fused sparse-optimizer kernel (per-row CTR update, all rules).
 
 The reference applies its sparse optimizer on-device inside the
 hashtable update kernels (`/root/reference/paddle/fluid/framework/fleet/
 heter_ps/optimizer.cuh.h:27-100` — update_lr/update_mf/update_value with
-show/click coeffs, bounds, lazy mf creation), one GPU thread per row.
-The TPU decomposition is different: random-access gather/scatter stays
-on XLA (the hardware's bulk path — per-row DMA loops in Pallas
-serialize), and the PER-ROW OPTIMIZER MATH between gather and scatter is
-this one fused Pallas kernel: all seven state columns of a block of
-touched rows update in a single VMEM pass (one read + one write per
-operand instead of XLA's per-op fusion groups).
-
-Used by ``ps.embedding_cache.cache_push`` on TPU (jnp fallback
-elsewhere / interpret mode in tests); bit-parity with the jnp path is
-tested in tests/test_sparse_optimizer.py.
+show/click coeffs, bounds, lazy mf creation), one GPU thread per row;
+the CPU server supports the full rule family (sparse_sgd_rule.h:27-135:
+naive / AdaGrad shared-g2sum / StdAdaGrad per-dim / Adam). The TPU
+decomposition: random-access gather/scatter stays on XLA (the hardware's
+bulk path — per-row DMA loops in Pallas serialize), and the PER-ROW
+OPTIMIZER MATH between gather and scatter is one fused Pallas kernel:
+every state column of a block of touched rows updates in a single VMEM
+pass. All four reference rules are supported for both the embed (1-d)
+and embedx (dim-d) blocks; the rule math lives in ``rule_update`` which
+is shared verbatim by the kernel body and the jnp fallback
+(``ps.embedding_cache.cache_push`` uses the kernel on TPU, jnp
+elsewhere; bit-parity is tested in tests/test_sparse_optimizer.py).
 """
 
 from __future__ import annotations
@@ -25,7 +26,56 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["ctr_adagrad_rows"]
+__all__ = ["ctr_sparse_rows", "rule_update", "rule_state_dim",
+           "rule_init_state"]
+
+
+def rule_state_dim(rule: str, dim: int) -> int:
+    """Optimizer-state floats per feature (sparse_sgd_rule slot dims)."""
+    return {"naive": 0, "adagrad": 1, "std_adagrad": dim,
+            "adam": 2 * dim + 2}[rule]
+
+
+def rule_init_state(rule: str, n: int, dim: int, *, beta1: float,
+                    beta2: float):
+    """Fresh-feature optimizer state (zeros; Adam's beta powers start at
+    beta1/beta2 — sparse_sgd_rule.cc InitValueWork)."""
+    sd = rule_state_dim(rule, dim)
+    st = jnp.zeros((n, sd), jnp.float32)
+    if rule == "adam":
+        st = st.at[:, 2 * dim].set(beta1).at[:, 2 * dim + 1].set(beta2)
+    return st
+
+
+def rule_update(rule: str, w, state, g, scale, *, lr, initial_g2sum,
+                wmin, wmax, beta1, beta2, eps):
+    """One batched rule step on touched rows: (w [n,d], state [n,sd],
+    g [n,d] merged grads, scale [n,1] push_show) -> (w', state').
+    Exact sparse_sgd_rule.cc math (SURVEY Appendix A.2); Adam ignores
+    the scale like the reference."""
+    clip = lambda x: jnp.clip(x, wmin, wmax)
+    if rule == "naive":
+        return clip(w - lr * g), state
+    if rule == "adagrad":  # one shared g2sum per feature
+        sg = g / scale
+        ratio = jnp.sqrt(initial_g2sum / (initial_g2sum + state))
+        w2 = clip(w - lr * sg * ratio)
+        return w2, state + jnp.mean(sg * sg, axis=1, keepdims=True)
+    if rule == "std_adagrad":  # per-dim g2sum
+        sg = g / scale
+        ratio = jnp.sqrt(initial_g2sum / (initial_g2sum + state))
+        return clip(w - lr * sg * ratio), state + sg * sg
+    if rule == "adam":
+        d = w.shape[1]
+        m, v = state[:, :d], state[:, d:2 * d]
+        b1p, b2p = state[:, 2 * d:2 * d + 1], state[:, 2 * d + 1:2 * d + 2]
+        m2 = beta1 * m + (1.0 - beta1) * g
+        v2 = beta2 * v + (1.0 - beta2) * g * g
+        m_hat = m2 / (1.0 - b1p)
+        v_hat = v2 / (1.0 - b2p)
+        w2 = clip(w - lr * m_hat / (jnp.sqrt(v_hat) + eps))
+        return w2, jnp.concatenate([m2, v2, b1p * beta1, b2p * beta2], axis=1)
+    raise KeyError(f"unknown sparse sgd rule {rule!r}")
 
 
 def _on_tpu() -> bool:
@@ -35,64 +85,90 @@ def _on_tpu() -> bool:
         return False
 
 
-def _kernel(show_ref, click_ref, ew_ref, eg2_ref, xw_ref, xg2_ref, has_ref,
+def _kernel(show_ref, click_ref, ew_ref, es_ref, xw_ref, xs_ref, has_ref,
             dshow_ref, dclick_ref, ge_ref, gx_ref,
-            o_show, o_click, o_ew, o_eg2, o_xw, o_xg2, o_has,
-            *, lr, initial_g2sum, wmin, wmax, nonclk_coeff, click_coeff,
-            embedx_threshold):
+            o_show, o_click, o_ew, o_es, o_xw, o_xs, o_has,
+            *, embed_rule, embedx_rule, dim, lr, initial_g2sum, wmin, wmax,
+            beta1, beta2, eps, nonclk_coeff, click_coeff, embedx_threshold,
+            create_applies_grad):
+    upd = functools.partial(rule_update, lr=lr, initial_g2sum=initial_g2sum,
+                            wmin=wmin, wmax=wmax, beta1=beta1, beta2=beta2,
+                            eps=eps)
     show = show_ref[...] + dshow_ref[...]
     click = click_ref[...] + dclick_ref[...]
     scale = jnp.maximum(dshow_ref[...], 1e-10)[:, None]
 
-    # embed (1-d) AdaGrad — sparse_sgd_rule.cc:87 / optimizer.cuh.h:35
-    ge = ge_ref[...] / scale
-    eg2 = eg2_ref[...]
-    ratio_e = jnp.sqrt(initial_g2sum / (initial_g2sum + eg2))
-    ew = jnp.clip(ew_ref[...] - lr * ge * ratio_e, wmin, wmax)
-    eg2_new = eg2 + jnp.mean(ge * ge, axis=1, keepdims=True)
+    es = rule_state_dim(embed_rule, 1)
+    xs = rule_state_dim(embedx_rule, dim)
+    # state refs carry max(sd, 1) columns; stateless rules ignore them
+    ew, es_new = upd(embed_rule, ew_ref[...], es_ref[..., :max(es, 1)],
+                     ge_ref[...], scale)
 
-    # lazy embedx creation on the show/click score (optimizer.cuh.h:81)
+    # lazy embedx creation on the show/click score: created rows start
+    # from INIT state; create_applies_grad selects CPU (create + apply,
+    # ctr_accessor.cc order) vs GPU (create only, optimizer.cuh.h:81-94)
     score = (show - click) * nonclk_coeff + click * click_coeff
     had = has_ref[...] > 0
     create = jnp.logical_and(jnp.logical_not(had),
                              score >= embedx_threshold)
-    # embedx (dim-d) AdaGrad, applied only where mf already existed
-    gx = gx_ref[...] / scale
-    xg2 = xg2_ref[...]
-    ratio_x = jnp.sqrt(initial_g2sum / (initial_g2sum + xg2))
-    xw_new = jnp.clip(xw_ref[...] - lr * gx * ratio_x, wmin, wmax)
-    xg2_new = xg2 + jnp.mean(gx * gx, axis=1, keepdims=True)
+    apply_mask = jnp.logical_or(had, create) if create_applies_grad else had
+    n = show.shape[0]
+    if xs > 0:
+        init = rule_init_state(embedx_rule, n, dim, beta1=beta1, beta2=beta2)
+        st_base = jnp.where(create[:, None], init, xs_ref[...])
+    else:
+        st_base = xs_ref[...][:, :max(xs, 1)]
+    xw_new, xs_new = upd(embedx_rule, xw_ref[...], st_base, gx_ref[...],
+                         scale)
 
     o_show[...] = show
     o_click[...] = click
     o_ew[...] = ew
-    o_eg2[...] = eg2_new
-    o_xw[...] = jnp.where(had[:, None], xw_new, xw_ref[...])
-    o_xg2[...] = jnp.where(had[:, None], xg2_new, xg2_ref[...])
+    if es > 0:
+        o_es[...] = es_new
+    else:
+        o_es[...] = es_ref[...]
+    o_xw[...] = jnp.where(apply_mask[:, None], xw_new, xw_ref[...])
+    if xs > 0:
+        o_xs[...] = jnp.where(apply_mask[:, None], xs_new, st_base)
+    else:
+        o_xs[...] = xs_ref[...]
     o_has[...] = jnp.where(create, 1.0, has_ref[...])
 
 
-def ctr_adagrad_rows(
-    rows_state: Tuple[jax.Array, ...],  # show, click, ew, eg2, xw, xg2, has
+def ctr_sparse_rows(
+    rows_state: Tuple[jax.Array, ...],  # show, click, ew, estate, xw, xstate, has
     dshow: jax.Array,   # [n] merged show deltas
     dclick: jax.Array,  # [n]
     g_embed: jax.Array,   # [n, 1] merged embed grads
     g_embedx: jax.Array,  # [n, dim]
     *,
+    embed_rule: str, embedx_rule: str,
     lr: float, initial_g2sum: float, weight_bounds: Tuple[float, float],
+    beta1: float, beta2: float, eps: float,
     nonclk_coeff: float, click_coeff: float, embedx_threshold: float,
+    create_applies_grad: bool = True,
     block: int = 1024,
     interpret: Optional[bool] = None,
 ) -> Tuple[jax.Array, ...]:
-    """Fused per-row CTR AdaGrad over gathered rows; returns the updated
+    """Fused per-row CTR update over gathered rows; returns the updated
     seven state columns in the same order. Rows are pre-merged uniques
     (the caller's segment-sum); padding rows are fine — the caller's
-    scatter drops them."""
-    show, click, ew, eg2, xw, xg2, has = rows_state
+    scatter drops them. State columns may be zero-width (naive rule): a
+    one-column dummy is threaded through the kernel and sliced away."""
+    show, click, ew, estate, xw, xstate, has = rows_state
     n = show.shape[0]
     dim = xw.shape[1]
+    es = rule_state_dim(embed_rule, 1)
+    xs = rule_state_dim(embedx_rule, dim)
+    assert estate.shape[1] == es and xstate.shape[1] == xs, \
+        (estate.shape, es, xstate.shape, xs)
     if interpret is None:
         interpret = not _on_tpu()
+    # zero-width state -> one dummy column through the kernel
+    estate_k = estate if es > 0 else jnp.zeros((n, 1), jnp.float32)
+    xstate_k = xstate if xs > 0 else jnp.zeros((n, 1), jnp.float32)
+    wes, wxs = estate_k.shape[1], xstate_k.shape[1]
     bn = min(block, n)
     grid = (pl.cdiv(n, bn),)
 
@@ -100,21 +176,31 @@ def ctr_adagrad_rows(
     def spec2(d): return pl.BlockSpec((bn, d), lambda i: (i, 0))
 
     kern = functools.partial(
-        _kernel, lr=lr, initial_g2sum=initial_g2sum,
+        _kernel, embed_rule=embed_rule, embedx_rule=embedx_rule, dim=dim,
+        lr=lr, initial_g2sum=initial_g2sum,
         wmin=weight_bounds[0], wmax=weight_bounds[1],
+        beta1=beta1, beta2=beta2, eps=eps,
         nonclk_coeff=nonclk_coeff, click_coeff=click_coeff,
-        embedx_threshold=embedx_threshold)
+        embedx_threshold=embedx_threshold,
+        create_applies_grad=create_applies_grad)
     out_shapes = [jax.ShapeDtypeStruct(a.shape, a.dtype)
-                  for a in (show, click, ew, eg2, xw, xg2, has)]
-    out_specs = [spec1(), spec1(), spec2(1), spec2(1), spec2(dim),
-                 spec2(1), spec1()]
-    in_specs = [spec1(), spec1(), spec2(1), spec2(1), spec2(dim), spec2(1),
-                spec1(), spec1(), spec1(), spec2(1), spec2(dim)]
-    return pl.pallas_call(
+                  for a in (show, click, ew, estate_k, xw, xstate_k, has)]
+    out_specs = [spec1(), spec1(), spec2(1), spec2(wes), spec2(dim),
+                 spec2(wxs), spec1()]
+    in_specs = [spec1(), spec1(), spec2(1), spec2(wes), spec2(dim),
+                spec2(wxs), spec1(), spec1(), spec1(), spec2(1), spec2(dim)]
+    out = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shapes,
         interpret=interpret,
-    )(show, click, ew, eg2, xw, xg2, has, dshow, dclick, g_embed, g_embedx)
+    )(show, click, ew, estate_k, xw, xstate_k, has, dshow, dclick,
+      g_embed, g_embedx)
+    o_show, o_click, o_ew, o_es, o_xw, o_xs, o_has = out
+    if es == 0:
+        o_es = estate
+    if xs == 0:
+        o_xs = xstate
+    return o_show, o_click, o_ew, o_es, o_xw, o_xs, o_has

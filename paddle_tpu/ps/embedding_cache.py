@@ -18,8 +18,10 @@ PullSparse/PushSparseGrad during the pass, EndPass→dump_to_cpu). Here:
   HeterComm walk_to_dest p2p analogue, compiler-scheduled).
 
 Value layout per cache row (mirrors heter_ps/feature_value.h semantics,
-SoA):  show, click, embed_w[1], embed_g2sum[1], embedx_w[dim],
-embedx_g2sum[1].
+SoA):  show, click, embed_w[1], embed_state[es], embedx_w[dim],
+embedx_state[xs], has_embedx — where es/xs are the optimizer-state
+widths of the configured sparse SGD rules (shared-g2sum AdaGrad: 1;
+StdAdaGrad: dim; Adam: 2·dim+2; naive: 0).
 """
 
 from __future__ import annotations
@@ -47,7 +49,17 @@ class CacheConfig:
     nonclk_coeff: float = 0.1
     click_coeff: float = 1.0
     embedx_threshold: float = 10.0  # lazy mf creation score threshold
-    #: run the per-row AdaGrad math as the fused Pallas kernel
+    #: per-feature rules (sparse_sgd_rule registry names); must match the
+    #: host table's accessor so flush-back state round-trips
+    embed_rule: str = "adagrad"
+    embedx_rule: str = "adagrad"
+    #: lazy-embedx creation semantics. The reference's CPU accessor
+    #: creates the mf block then applies this push's gradient
+    #: (ctr_accessor.cc Update order); its GPU optimizer creates WITHOUT
+    #: applying (optimizer.cuh.h:81-94 inits and returns). True = CPU
+    #: order (default — bit-parity with the host tables); False = GPU.
+    create_applies_grad: bool = True
+    #: run the per-row optimizer math as the fused Pallas kernel
     #: (ops/sparse_optimizer.py, the optimizer.cuh.h analogue);
     #: None = auto (on for TPU backends, jnp elsewhere)
     pallas_update: Optional[bool] = None
@@ -91,60 +103,78 @@ def cache_push(
     srows = jnp.where(uniq < C, uniq, 0)  # safe gather index for padding
 
     gathered = (state["show"][srows], state["click"][srows],
-                state["embed_w"][srows], state["embed_g2sum"][srows],
-                state["embedx_w"][srows], state["embedx_g2sum"][srows],
+                state["embed_w"][srows], state["embed_state"][srows],
+                state["embedx_w"][srows], state["embedx_state"][srows],
                 state["has_embedx"][srows])
+
+    from ..ops.sparse_optimizer import rule_init_state, rule_update
 
     use_pallas = cfg.pallas_update
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
         # fused per-row optimizer kernel (optimizer.cuh.h analogue)
-        from ..ops.sparse_optimizer import ctr_adagrad_rows
+        from ..ops.sparse_optimizer import ctr_sparse_rows
 
-        (show_rows, click_rows, embed_w_rows, embed_g2_rows, ex_w_rows,
-         ex_g2_rows, has_rows) = ctr_adagrad_rows(
+        (show_rows, click_rows, embed_w_rows, embed_st_rows, ex_w_rows,
+         ex_st_rows, has_rows) = ctr_sparse_rows(
             gathered, show_sum, click_sum, g[:, :1], g[:, 1:],
+            embed_rule=cfg.embed_rule, embedx_rule=cfg.embedx_rule,
             lr=sgd.learning_rate, initial_g2sum=sgd.initial_g2sum,
             weight_bounds=tuple(sgd.weight_bounds),
+            beta1=sgd.beta1, beta2=sgd.beta2, eps=sgd.ada_epsilon,
             nonclk_coeff=cfg.nonclk_coeff, click_coeff=cfg.click_coeff,
-            embedx_threshold=cfg.embedx_threshold)
+            embedx_threshold=cfg.embedx_threshold,
+            create_applies_grad=cfg.create_applies_grad)
     else:
-        show_old, click_old, ew_old, eg2_old, ex_w_old, ex_g2_old, has_old = gathered
+        show_old, click_old, ew_old, est_old, ex_w_old, ex_st_old, has_old = gathered
         show_rows = show_old + show_sum
         click_rows = click_old + click_sum
-        scale = jnp.maximum(show_sum, 1e-10)
+        scale = jnp.maximum(show_sum, 1e-10)[:, None]
+        import functools
 
-        def adagrad(w, g2, g_rows):  # [n,d], [n,1], [n,d] — touched rows
-            scaled = g_rows / scale[:, None]
-            ratio = jnp.sqrt(sgd.initial_g2sum / (sgd.initial_g2sum + g2))
-            w_new = w - sgd.learning_rate * scaled * ratio
-            w_new = jnp.clip(w_new, sgd.weight_bounds[0], sgd.weight_bounds[1])
-            g2_new = g2 + jnp.mean(scaled * scaled, axis=1, keepdims=True)
-            return w_new, g2_new
-
-        embed_w_rows, embed_g2_rows = adagrad(ew_old, eg2_old, g[:, :1])
+        upd = functools.partial(
+            rule_update, lr=sgd.learning_rate,
+            initial_g2sum=sgd.initial_g2sum,
+            wmin=sgd.weight_bounds[0], wmax=sgd.weight_bounds[1],
+            beta1=sgd.beta1, beta2=sgd.beta2, eps=sgd.ada_epsilon)
+        embed_w_rows, embed_st_rows = upd(cfg.embed_rule, ew_old, est_old,
+                                          g[:, :1], scale)
 
         # lazy embedx (mf) creation: materialize once the show/click
-        # score crosses the threshold (optimizer.cuh.h:81-94;
-        # deterministic zero init — curand-uniform is per-row RNG; zeros
-        # match the reference's mean and keep the step deterministic)
+        # score crosses the threshold (deterministic zero init —
+        # curand-uniform is per-row RNG; zeros match the reference's
+        # mean and keep the step deterministic). Created rows start from
+        # INIT state; create_applies_grad picks whether this push's
+        # gradient also applies (CPU ctr_accessor.cc order) or not
+        # (GPU optimizer.cuh.h:81-94).
         score = (show_rows - click_rows) * cfg.nonclk_coeff + click_rows * cfg.click_coeff
         had_mf = has_old > 0
         create = (~had_mf) & (score >= cfg.embedx_threshold)
         has_rows = jnp.where(create, 1.0, has_old)
-        ex_w_new, ex_g2_new = adagrad(ex_w_old, ex_g2_old, g[:, 1:])
-        ex_w_rows = jnp.where(had_mf[:, None], ex_w_new, ex_w_old)
-        ex_g2_rows = jnp.where(had_mf[:, None], ex_g2_new, ex_g2_old)
+        apply_mask = (had_mf | create) if cfg.create_applies_grad else had_mf
+        if ex_st_old.shape[1]:
+            init = rule_init_state(cfg.embedx_rule, n, cfg.embedx_dim,
+                                   beta1=sgd.beta1, beta2=sgd.beta2)
+            st_base = jnp.where(create[:, None], init, ex_st_old)
+        else:
+            st_base = ex_st_old
+        ex_w_new, ex_st_new = upd(cfg.embedx_rule, ex_w_old, st_base,
+                                  g[:, 1:], scale)
+        ex_w_rows = jnp.where(apply_mask[:, None], ex_w_new, ex_w_old)
+        if ex_st_old.shape[1]:
+            ex_st_rows = jnp.where(apply_mask[:, None], ex_st_new, st_base)
+        else:
+            ex_st_rows = ex_st_old
 
     drop = dict(mode="drop")  # padding rows (sentinel C) fall away
     return {
         "show": state["show"].at[uniq].set(show_rows, **drop),
         "click": state["click"].at[uniq].set(click_rows, **drop),
         "embed_w": state["embed_w"].at[uniq].set(embed_w_rows, **drop),
-        "embed_g2sum": state["embed_g2sum"].at[uniq].set(embed_g2_rows, **drop),
+        "embed_state": state["embed_state"].at[uniq].set(embed_st_rows, **drop),
         "embedx_w": state["embedx_w"].at[uniq].set(ex_w_rows, **drop),
-        "embedx_g2sum": state["embedx_g2sum"].at[uniq].set(ex_g2_rows, **drop),
+        "embedx_state": state["embedx_state"].at[uniq].set(ex_st_rows, **drop),
         "has_embedx": state["has_embedx"].at[uniq].set(has_rows, **drop),
     }
 
@@ -169,12 +199,24 @@ class HbmEmbeddingCache:
         device_map: bool = False,
     ) -> None:
         self.table = table
+        acc_cfg = table.accessor.config
         self.config = config or CacheConfig(
-            embedx_dim=table.accessor.config.embedx_dim
+            embedx_dim=acc_cfg.embedx_dim,
+            embed_rule=acc_cfg.embed_sgd_rule,
+            embedx_rule=acc_cfg.embedx_sgd_rule,
         )
         enforce(
-            self.config.embedx_dim == table.accessor.config.embedx_dim,
+            self.config.embedx_dim == acc_cfg.embedx_dim,
             "cache embedx_dim must match table",
+        )
+        # flush-back writes optimizer state into the table's columns —
+        # the rules (and so the state layouts) must agree
+        enforce(
+            self.config.embed_rule == acc_cfg.embed_sgd_rule
+            and self.config.embedx_rule == acc_cfg.embedx_sgd_rule,
+            f"cache rules ({self.config.embed_rule}/{self.config.embedx_rule})"
+            f" must match table accessor ({acc_cfg.embed_sgd_rule}/"
+            f"{acc_cfg.embedx_sgd_rule})",
         )
         self._sharding = sharding
         self._n_shards = 1
@@ -226,6 +268,7 @@ class HbmEmbeddingCache:
         # twice here (pull_sparse then export_full over the same keys)
         acc = self.table.accessor
         es = acc.embed_rule.state_dim
+        xs = acc.embedx_rule.state_dim
         xd = acc.config.embedx_dim
         values, _ = self.table.export_full(uniq, create=True)
         dim = cfg.embedx_dim
@@ -233,9 +276,9 @@ class HbmEmbeddingCache:
             "show": np.zeros(cfg.capacity, np.float32),
             "click": np.zeros(cfg.capacity, np.float32),
             "embed_w": np.zeros((cfg.capacity, 1), np.float32),
-            "embed_g2sum": np.zeros((cfg.capacity, 1), np.float32),
+            "embed_state": np.zeros((cfg.capacity, es), np.float32),
             "embedx_w": np.zeros((cfg.capacity, dim), np.float32),
-            "embedx_g2sum": np.zeros((cfg.capacity, 1), np.float32),
+            "embedx_state": np.zeros((cfg.capacity, xs), np.float32),
             "has_embedx": np.zeros(cfg.capacity, np.float32),
         }
         # full layout: slot, unseen_days, delta_score, show, click,
@@ -243,12 +286,10 @@ class HbmEmbeddingCache:
         host["show"][rows] = values[:, 3]
         host["click"][rows] = values[:, 4]
         host["embed_w"][rows, 0] = values[:, 5]
-        if es >= 1:
-            host["embed_g2sum"][rows, 0] = values[:, 6]
+        host["embed_state"][rows] = values[:, 6 : 6 + es]
         host["has_embedx"][rows] = values[:, 6 + es]
         host["embedx_w"][rows] = values[:, 7 + es: 7 + es + xd]
-        if acc.embedx_rule.state_dim >= 1:
-            host["embedx_g2sum"][rows, 0] = values[:, 7 + es + xd]
+        host["embedx_state"][rows] = values[:, 7 + es + xd : 7 + es + xd + xs]
 
         if self._device_map_enabled:
             from .device_hash import DeviceKeyMap
@@ -288,6 +329,7 @@ class HbmEmbeddingCache:
         rows = self._spread(self._index.lookup(keys))
         acc = self.table.accessor
         es = acc.embed_rule.state_dim
+        xs = acc.embedx_rule.state_dim
         xd = acc.config.embedx_dim
         # NB: like the reference's PSGPUWrapper::EndPass, flush-back runs
         # at a pass boundary with trainers quiesced — the export/modify/
@@ -312,14 +354,12 @@ class HbmEmbeddingCache:
         new[:, 3] = host["show"][rows]
         new[:, 4] = host["click"][rows]
         new[:, 5] = host["embed_w"][rows, 0]
-        if es >= 1:
-            new[:, 6] = host["embed_g2sum"][rows, 0]
+        new[:, 6 : 6 + es] = host["embed_state"][rows]
         has = host["has_embedx"][rows] > 0
         keep_old = old[:, 6 + es] != 0.0
         new[:, 6 + es] = (has | keep_old).astype(np.float32)
         new[has, 7 + es : 7 + es + xd] = host["embedx_w"][rows[has]]
-        if acc.embedx_rule.state_dim >= 1:
-            new[has, 7 + es + xd] = host["embedx_g2sum"][rows[has], 0]
+        new[has, 7 + es + xd : 7 + es + xd + xs] = host["embedx_state"][rows[has]]
         self.table.import_full(keys, new)
         self._index = None
         self.state = None

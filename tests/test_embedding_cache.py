@@ -78,7 +78,7 @@ def test_cache_push_matches_host_adagrad():
     scaled = g / show
     expect = -0.1 * scaled * np.sqrt(3.0 / 3.0)
     np.testing.assert_allclose(dev_w - w_before, expect, rtol=1e-4)
-    g2 = float(np.asarray(cache.state["embed_g2sum"])[int(rows[0]), 0])
+    g2 = float(np.asarray(cache.state["embed_state"])[int(rows[0]), 0])
     np.testing.assert_allclose(g2, scaled * scaled, rtol=1e-5)
 
 
@@ -132,11 +132,11 @@ def test_roundtrip_preserves_g2sum_across_passes():
     rows = jnp.asarray(cache.lookup(keys))
     st = cache_push(cache.state, rows, jnp.asarray([[0.5, 0, 0, 0, 0]]),
                     jnp.ones(1), jnp.zeros(1), cache.config)
-    g2_first = float(np.asarray(st["embed_g2sum"])[int(rows[0]), 0])
+    g2_first = float(np.asarray(st["embed_state"])[int(rows[0]), 0])
     cache.state = st
     cache.end_pass()
 
     cache.begin_pass(keys)
     r2 = int(cache.lookup(keys)[0])
-    g2_reloaded = float(np.asarray(cache.state["embed_g2sum"])[r2, 0])
+    g2_reloaded = float(np.asarray(cache.state["embed_state"])[r2, 0])
     np.testing.assert_allclose(g2_reloaded, g2_first, rtol=1e-6)

@@ -37,9 +37,9 @@ def _fresh_state(capacity, dim, rng):
         "show": jnp.asarray(rng.uniform(0, 5, n).astype(np.float32)),
         "click": jnp.asarray(rng.uniform(0, 2, n).astype(np.float32)),
         "embed_w": jnp.asarray(rng.normal(size=(n, 1)).astype(np.float32)),
-        "embed_g2sum": jnp.asarray(rng.uniform(0, 1, (n, 1)).astype(np.float32)),
+        "embed_state": jnp.asarray(rng.uniform(0, 1, (n, 1)).astype(np.float32)),
         "embedx_w": jnp.asarray(rng.normal(size=(n, dim)).astype(np.float32)),
-        "embedx_g2sum": jnp.asarray(rng.uniform(0, 1, (n, 1)).astype(np.float32)),
+        "embedx_state": jnp.asarray(rng.uniform(0, 1, (n, 1)).astype(np.float32)),
         "has_embedx": jnp.asarray((rng.random(n) < 0.5).astype(np.float32)),
     }
 

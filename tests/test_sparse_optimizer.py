@@ -1,39 +1,58 @@
-"""Pallas fused CTR AdaGrad row kernel (ops/sparse_optimizer.py) vs the
-jnp path — parity of the optimizer.cuh.h math (interpret mode on the CPU
-mesh, same discipline as the flash-attention tests)."""
+"""Pallas fused CTR row kernel (ops/sparse_optimizer.py) vs the jnp
+path — parity of the optimizer.cuh.h / sparse_sgd_rule.cc math across
+the whole rule family (interpret mode on the CPU mesh, same discipline
+as the flash-attention tests) — plus device-vs-host-table parity."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
+from paddle_tpu.ops.sparse_optimizer import (ctr_sparse_rows,
+                                             rule_state_dim)
 from paddle_tpu.ps.embedding_cache import CacheConfig, cache_push
 from paddle_tpu.ps.sgd_rule import SGDRuleConfig
 
+RULES = ["naive", "adagrad", "std_adagrad", "adam"]
 
-def _state(rng, C, dim):
-    return {
+
+def _state(rng, C, dim, embed_rule="adagrad", embedx_rule="adagrad"):
+    es = rule_state_dim(embed_rule, 1)
+    xs = rule_state_dim(embedx_rule, dim)
+    st = {
         "show": jnp.asarray(rng.uniform(0, 5, C).astype(np.float32)),
         "click": jnp.asarray(rng.uniform(0, 2, C).astype(np.float32)),
         "embed_w": jnp.asarray(rng.normal(size=(C, 1)).astype(np.float32)),
-        "embed_g2sum": jnp.asarray(rng.uniform(0, 1, (C, 1)).astype(np.float32)),
+        "embed_state": jnp.asarray(rng.uniform(0, 1, (C, es)).astype(np.float32)),
         "embedx_w": jnp.asarray(rng.normal(size=(C, dim)).astype(np.float32)),
-        "embedx_g2sum": jnp.asarray(rng.uniform(0, 1, (C, 1)).astype(np.float32)),
+        "embedx_state": jnp.asarray(rng.uniform(0, 1, (C, xs)).astype(np.float32)),
         "has_embedx": jnp.asarray((rng.random(C) < 0.5).astype(np.float32)),
     }
+    if embed_rule == "adam" and es:
+        st["embed_state"] = st["embed_state"].at[:, -2:].set(0.9)
+    if embedx_rule == "adam" and xs:
+        st["embedx_state"] = st["embedx_state"].at[:, -2:].set(0.9)
+    return st
 
 
-def test_pallas_push_matches_jnp(rng):
+@pytest.mark.parametrize("create_applies_grad", [True, False])
+@pytest.mark.parametrize("embed_rule,embedx_rule",
+                         [(r, r) for r in RULES] + [("adagrad", "adam"),
+                                                    ("naive", "std_adagrad")])
+def test_pallas_push_matches_jnp(rng, embed_rule, embedx_rule,
+                                 create_applies_grad):
     C, dim, n = 512, 4, 300
-    state = _state(rng, C, dim)
+    state = _state(rng, C, dim, embed_rule, embedx_rule)
     rows = jnp.asarray(rng.integers(0, C, n), jnp.int32)
     grads = jnp.asarray(rng.normal(size=(n, 1 + dim)).astype(np.float32))
     shows = jnp.ones((n,), jnp.float32)
     clicks = jnp.asarray((rng.random(n) < 0.4).astype(np.float32))
 
-    cfg_j = CacheConfig(capacity=C, embedx_dim=dim, embedx_threshold=3.0,
-                        pallas_update=False)
-    cfg_p = CacheConfig(capacity=C, embedx_dim=dim, embedx_threshold=3.0,
-                        pallas_update=True)
+    kw = dict(capacity=C, embedx_dim=dim, embedx_threshold=3.0,
+              embed_rule=embed_rule, embedx_rule=embedx_rule,
+              create_applies_grad=create_applies_grad)
+    cfg_j = CacheConfig(pallas_update=False, **kw)
+    cfg_p = CacheConfig(pallas_update=True, **kw)
     a = jax.jit(lambda st: cache_push(st, rows, grads, shows, clicks, cfg_j))(state)
     b = jax.jit(lambda st: cache_push(st, rows, grads, shows, clicks, cfg_p))(state)
     for k in a:
@@ -44,26 +63,64 @@ def test_pallas_push_matches_jnp(rng):
                                   np.asarray(a["has_embedx"]))
 
 
+@pytest.mark.parametrize("rule", RULES)
+def test_cache_push_matches_host_table(rng, rule):
+    """Device cache push == host MemorySparseTable push for the same
+    merged records, for every rule (the parity-critical A.2 math)."""
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.embedding_cache import HbmEmbeddingCache
+    from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+    dim = 4
+    acc = AccessorConfig(embedx_dim=dim, embedx_threshold=0.0,
+                         embed_sgd_rule=rule, embedx_sgd_rule=rule,
+                         sgd=SGDRuleConfig(initial_range=0.0))
+    mirror = MemorySparseTable(TableConfig(shard_num=2, accessor_config=acc))
+    backing = MemorySparseTable(TableConfig(shard_num=2, accessor_config=acc))
+    cache = HbmEmbeddingCache(backing, CacheConfig(
+        capacity=256, embedx_dim=dim, embedx_threshold=0.0,
+        embed_rule=rule, embedx_rule=rule))
+
+    keys = np.arange(1, 101, dtype=np.uint64)
+    cache.begin_pass(keys)
+    for it in range(3):
+        bkeys = rng.integers(1, 101, size=64).astype(np.uint64)
+        push = np.zeros((64, 4 + dim), np.float32)
+        push[:, 1] = 1.0
+        push[:, 2] = (rng.random(64) < 0.4).astype(np.float32)
+        push[:, 3:] = rng.normal(size=(64, 1 + dim)).astype(np.float32)
+        mirror.push_sparse(bkeys, push)
+
+        rows = jnp.asarray(cache.lookup(bkeys), jnp.int32)
+        cache.state = cache_push(
+            cache.state, rows, jnp.asarray(push[:, 3:]),
+            jnp.asarray(push[:, 1]), jnp.asarray(push[:, 2]), cache.config)
+    cache.end_pass()
+
+    np.testing.assert_allclose(
+        backing.pull_sparse(keys, create=False),
+        mirror.pull_sparse(keys, create=False), rtol=1e-5, atol=1e-6)
+
+
 def test_pallas_push_unaligned_n(rng):
     # n not a multiple of the kernel block exercises the padded tail —
-    # cache_push uses the kernel default, so shrink n below it is not
-    # enough; drive the kernel directly with block=64 over n=300
-    from paddle_tpu.ops.sparse_optimizer import ctr_adagrad_rows
-
+    # drive the kernel directly with block=64 over n=300
     C, dim, n = 256, 8, 300
     state = _state(rng, C, dim)
     srows = jnp.asarray(rng.integers(0, C, n), jnp.int32)
     gathered = tuple(state[k][srows] for k in
-                     ("show", "click", "embed_w", "embed_g2sum",
-                      "embedx_w", "embedx_g2sum", "has_embedx"))
+                     ("show", "click", "embed_w", "embed_state",
+                      "embedx_w", "embedx_state", "has_embedx"))
     dshow = jnp.ones((n,), jnp.float32)
     dclick = jnp.asarray((rng.random(n) < 0.3).astype(np.float32))
     ge = jnp.asarray(rng.normal(size=(n, 1)).astype(np.float32))
     gx = jnp.asarray(rng.normal(size=(n, dim)).astype(np.float32))
-    kw = dict(lr=0.05, initial_g2sum=3.0, weight_bounds=(-10.0, 10.0),
+    kw = dict(embed_rule="adagrad", embedx_rule="adagrad",
+              lr=0.05, initial_g2sum=3.0, weight_bounds=(-10.0, 10.0),
+              beta1=0.9, beta2=0.999, eps=1e-8,
               nonclk_coeff=0.1, click_coeff=1.0, embedx_threshold=0.0)
-    small = ctr_adagrad_rows(gathered, dshow, dclick, ge, gx, block=64, **kw)
-    full = ctr_adagrad_rows(gathered, dshow, dclick, ge, gx, block=1024, **kw)
+    small = ctr_sparse_rows(gathered, dshow, dclick, ge, gx, block=64, **kw)
+    full = ctr_sparse_rows(gathered, dshow, dclick, ge, gx, block=1024, **kw)
     for a, b in zip(small, full):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-6, atol=1e-7)
