@@ -51,8 +51,11 @@ def rule_update(rule: str, w, state, g, scale, *, lr, initial_g2sum,
                 wmin, wmax, beta1, beta2, eps):
     """One batched rule step on touched rows: (w [n,d], state [n,sd],
     g [n,d] merged grads, scale [n,1] push_show) -> (w', state').
-    Exact sparse_sgd_rule.cc math (SURVEY Appendix A.2); Adam ignores
-    the scale like the reference."""
+    Mirrors this repo's host rules (ps/sgd_rule.py) exactly — which
+    follow sparse_sgd_rule.cc (SURVEY Appendix A.2) except that Adam
+    adds epsilon to the bias-corrected sqrt(v_hat) rather than the
+    reference's raw sqrt(v) (an eps-placement difference only). Adam
+    ignores the scale like the reference."""
     clip = lambda x: jnp.clip(x, wmin, wmax)
     if rule == "naive":
         return clip(w - lr * g), state
@@ -85,55 +88,66 @@ def _on_tpu() -> bool:
         return False
 
 
-def _kernel(show_ref, click_ref, ew_ref, es_ref, xw_ref, xs_ref, has_ref,
-            dshow_ref, dclick_ref, ge_ref, gx_ref,
-            o_show, o_click, o_ew, o_es, o_xw, o_xs, o_has,
-            *, embed_rule, embedx_rule, dim, lr, initial_g2sum, wmin, wmax,
-            beta1, beta2, eps, nonclk_coeff, click_coeff, embedx_threshold,
-            create_applies_grad):
+def fused_row_update(show, click, ew, estate, xw, xstate, has,
+                     dshow, dclick, ge, gx,
+                     *, embed_rule, embedx_rule, dim, lr, initial_g2sum,
+                     wmin, wmax, beta1, beta2, eps, nonclk_coeff,
+                     click_coeff, embedx_threshold, create_applies_grad):
+    """The complete per-row CTR update on plain arrays (touched rows,
+    pre-merged): show/click accumulation, embed rule step, lazy embedx
+    creation, embedx rule step. ONE definition shared by the Pallas
+    kernel body and the jnp fallback — divergence between the two paths
+    is structurally impossible. Returns the seven updated columns.
+
+    State arrays may carry one extra dummy column when the rule is
+    stateless (the kernel's block specs need width >= 1); the rule
+    ignores it and it round-trips unchanged."""
     upd = functools.partial(rule_update, lr=lr, initial_g2sum=initial_g2sum,
                             wmin=wmin, wmax=wmax, beta1=beta1, beta2=beta2,
                             eps=eps)
-    show = show_ref[...] + dshow_ref[...]
-    click = click_ref[...] + dclick_ref[...]
-    scale = jnp.maximum(dshow_ref[...], 1e-10)[:, None]
+    show_new = show + dshow
+    click_new = click + dclick
+    scale = jnp.maximum(dshow, 1e-10)[:, None]
 
     es = rule_state_dim(embed_rule, 1)
     xs = rule_state_dim(embedx_rule, dim)
-    # state refs carry max(sd, 1) columns; stateless rules ignore them
-    ew, es_new = upd(embed_rule, ew_ref[...], es_ref[..., :max(es, 1)],
-                     ge_ref[...], scale)
+    ew_new, es_new = upd(embed_rule, ew, estate[:, :max(es, 1)], ge, scale)
 
     # lazy embedx creation on the show/click score: created rows start
     # from INIT state; create_applies_grad selects CPU (create + apply,
     # ctr_accessor.cc order) vs GPU (create only, optimizer.cuh.h:81-94)
-    score = (show - click) * nonclk_coeff + click * click_coeff
-    had = has_ref[...] > 0
+    score = (show_new - click_new) * nonclk_coeff + click_new * click_coeff
+    had = has > 0
     create = jnp.logical_and(jnp.logical_not(had),
                              score >= embedx_threshold)
     apply_mask = jnp.logical_or(had, create) if create_applies_grad else had
     n = show.shape[0]
     if xs > 0:
         init = rule_init_state(embedx_rule, n, dim, beta1=beta1, beta2=beta2)
-        st_base = jnp.where(create[:, None], init, xs_ref[...])
+        st_base = jnp.where(create[:, None], init, xstate)
     else:
-        st_base = xs_ref[...][:, :max(xs, 1)]
-    xw_new, xs_new = upd(embedx_rule, xw_ref[...], st_base, gx_ref[...],
-                         scale)
+        st_base = xstate[:, :max(xs, 1)]
+    xw_new, xs_new = upd(embedx_rule, xw, st_base, gx, scale)
 
-    o_show[...] = show
-    o_click[...] = click
-    o_ew[...] = ew
-    if es > 0:
-        o_es[...] = es_new
-    else:
-        o_es[...] = es_ref[...]
-    o_xw[...] = jnp.where(apply_mask[:, None], xw_new, xw_ref[...])
-    if xs > 0:
-        o_xs[...] = jnp.where(apply_mask[:, None], xs_new, st_base)
-    else:
-        o_xs[...] = xs_ref[...]
-    o_has[...] = jnp.where(create, 1.0, has_ref[...])
+    return (show_new, click_new, ew_new,
+            es_new if es > 0 else estate,
+            jnp.where(apply_mask[:, None], xw_new, xw),
+            jnp.where(apply_mask[:, None], xs_new, st_base) if xs > 0 else xstate,
+            jnp.where(create, 1.0, has))
+
+
+def _kernel(show_ref, click_ref, ew_ref, es_ref, xw_ref, xs_ref, has_ref,
+            dshow_ref, dclick_ref, ge_ref, gx_ref,
+            o_show, o_click, o_ew, o_es, o_xw, o_xs, o_has,
+            **fused_kwargs):
+    outs = fused_row_update(
+        show_ref[...], click_ref[...], ew_ref[...], es_ref[...],
+        xw_ref[...], xs_ref[...], has_ref[...],
+        dshow_ref[...], dclick_ref[...], ge_ref[...], gx_ref[...],
+        **fused_kwargs)
+    for ref, val in zip((o_show, o_click, o_ew, o_es, o_xw, o_xs, o_has),
+                        outs):
+        ref[...] = val
 
 
 def ctr_sparse_rows(

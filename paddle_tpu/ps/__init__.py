@@ -13,6 +13,7 @@ from .table import (
     MemorySparseGeoTable,
     MemorySparseTable,
     SsdSparseTable,
+    make_sparse_table,
     TableConfig,
 )
 
@@ -36,5 +37,6 @@ __all__ = [
     "MemorySparseGeoTable",
     "MemorySparseTable",
     "SsdSparseTable",
+    "make_sparse_table",
     "TableConfig",
 ]

@@ -28,6 +28,7 @@ from .table import (
     MemorySparseGeoTable,
     MemorySparseTable,
     TableConfig,
+    make_sparse_table,
 )
 
 __all__ = ["PSClient", "LocalPsClient", "PsServerHandle"]
@@ -49,7 +50,7 @@ class PsServerHandle:
         with self._lock:
             if table_id not in self.sparse_tables:
                 cfg = config or TableConfig(table_id=table_id)
-                self.sparse_tables[table_id] = MemorySparseTable(cfg)
+                self.sparse_tables[table_id] = make_sparse_table(cfg)
             return self.sparse_tables[table_id]
 
     def create_dense_table(self, table_id: int, dim: int, optimizer: str = "adam",

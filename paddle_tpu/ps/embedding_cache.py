@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.enforce import enforce, enforce_le
+from ..ops.sparse_optimizer import ctr_sparse_rows, fused_row_update
 from .native import FeasignIndex
 from .sgd_rule import SGDRuleConfig
 from .table import MemorySparseTable
@@ -107,15 +108,11 @@ def cache_push(
                 state["embedx_w"][srows], state["embedx_state"][srows],
                 state["has_embedx"][srows])
 
-    from ..ops.sparse_optimizer import rule_init_state, rule_update
-
     use_pallas = cfg.pallas_update
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
         # fused per-row optimizer kernel (optimizer.cuh.h analogue)
-        from ..ops.sparse_optimizer import ctr_sparse_rows
-
         (show_rows, click_rows, embed_w_rows, embed_st_rows, ex_w_rows,
          ex_st_rows, has_rows) = ctr_sparse_rows(
             gathered, show_sum, click_sum, g[:, :1], g[:, 1:],
@@ -127,45 +124,19 @@ def cache_push(
             embedx_threshold=cfg.embedx_threshold,
             create_applies_grad=cfg.create_applies_grad)
     else:
-        show_old, click_old, ew_old, est_old, ex_w_old, ex_st_old, has_old = gathered
-        show_rows = show_old + show_sum
-        click_rows = click_old + click_sum
-        scale = jnp.maximum(show_sum, 1e-10)[:, None]
-        import functools
-
-        upd = functools.partial(
-            rule_update, lr=sgd.learning_rate,
+        # same math, no kernel: fused_row_update is the single shared
+        # definition of the whole per-row update
+        (show_rows, click_rows, embed_w_rows, embed_st_rows, ex_w_rows,
+         ex_st_rows, has_rows) = fused_row_update(
+            *gathered, show_sum, click_sum, g[:, :1], g[:, 1:],
+            embed_rule=cfg.embed_rule, embedx_rule=cfg.embedx_rule,
+            dim=cfg.embedx_dim, lr=sgd.learning_rate,
             initial_g2sum=sgd.initial_g2sum,
             wmin=sgd.weight_bounds[0], wmax=sgd.weight_bounds[1],
-            beta1=sgd.beta1, beta2=sgd.beta2, eps=sgd.ada_epsilon)
-        embed_w_rows, embed_st_rows = upd(cfg.embed_rule, ew_old, est_old,
-                                          g[:, :1], scale)
-
-        # lazy embedx (mf) creation: materialize once the show/click
-        # score crosses the threshold (deterministic zero init —
-        # curand-uniform is per-row RNG; zeros match the reference's
-        # mean and keep the step deterministic). Created rows start from
-        # INIT state; create_applies_grad picks whether this push's
-        # gradient also applies (CPU ctr_accessor.cc order) or not
-        # (GPU optimizer.cuh.h:81-94).
-        score = (show_rows - click_rows) * cfg.nonclk_coeff + click_rows * cfg.click_coeff
-        had_mf = has_old > 0
-        create = (~had_mf) & (score >= cfg.embedx_threshold)
-        has_rows = jnp.where(create, 1.0, has_old)
-        apply_mask = (had_mf | create) if cfg.create_applies_grad else had_mf
-        if ex_st_old.shape[1]:
-            init = rule_init_state(cfg.embedx_rule, n, cfg.embedx_dim,
-                                   beta1=sgd.beta1, beta2=sgd.beta2)
-            st_base = jnp.where(create[:, None], init, ex_st_old)
-        else:
-            st_base = ex_st_old
-        ex_w_new, ex_st_new = upd(cfg.embedx_rule, ex_w_old, st_base,
-                                  g[:, 1:], scale)
-        ex_w_rows = jnp.where(apply_mask[:, None], ex_w_new, ex_w_old)
-        if ex_st_old.shape[1]:
-            ex_st_rows = jnp.where(apply_mask[:, None], ex_st_new, st_base)
-        else:
-            ex_st_rows = ex_st_old
+            beta1=sgd.beta1, beta2=sgd.beta2, eps=sgd.ada_epsilon,
+            nonclk_coeff=cfg.nonclk_coeff, click_coeff=cfg.click_coeff,
+            embedx_threshold=cfg.embedx_threshold,
+            create_applies_grad=cfg.create_applies_grad)
 
     drop = dict(mode="drop")  # padding rows (sentinel C) fall away
     return {
@@ -204,6 +175,10 @@ class HbmEmbeddingCache:
             embedx_dim=acc_cfg.embedx_dim,
             embed_rule=acc_cfg.embed_sgd_rule,
             embedx_rule=acc_cfg.embedx_sgd_rule,
+            sgd=acc_cfg.sgd,
+            nonclk_coeff=acc_cfg.nonclk_coeff,
+            click_coeff=acc_cfg.click_coeff,
+            embedx_threshold=acc_cfg.embedx_threshold,
         )
         enforce(
             self.config.embedx_dim == acc_cfg.embedx_dim,
@@ -218,6 +193,18 @@ class HbmEmbeddingCache:
             f" must match table accessor ({acc_cfg.embed_sgd_rule}/"
             f"{acc_cfg.embedx_sgd_rule})",
         )
+        # ... and so must the hyperparameters the DEVICE math uses —
+        # a cache training Adam with different betas than the host rule
+        # would silently corrupt the flushed-back optimizer state.
+        # (initial_range is host-init-only; the lifecycle coeffs
+        # nonclk/click/embedx_threshold stay free cache knobs.)
+        for f in ("learning_rate", "initial_g2sum", "weight_bounds",
+                  "beta1", "beta2", "ada_epsilon"):
+            enforce(
+                getattr(self.config.sgd, f) == getattr(acc_cfg.sgd, f),
+                f"cache sgd.{f} ({getattr(self.config.sgd, f)}) must match "
+                f"table accessor sgd.{f} ({getattr(acc_cfg.sgd, f)})",
+            )
         self._sharding = sharding
         self._n_shards = 1
         if mesh is not None:

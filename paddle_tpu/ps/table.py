@@ -40,6 +40,7 @@ __all__ = [
     "TableConfig",
     "MemorySparseTable",
     "SsdSparseTable",
+    "make_sparse_table",
     "MemoryDenseTable",
     "MemorySparseGeoTable",
     "BarrierTable",
@@ -106,6 +107,10 @@ class TableConfig:
     # "auto" = native C++ engine (csrc/sparse_table.cc) when the
     # toolchain built it, else Python shards; "python"/"native" force.
     backend: str = "auto"
+    # "memory" = RAM-only (MemorySparseTable); "ssd" = two-tier RAM +
+    # disk logs (SsdSparseTable, requires ssd_path)
+    storage: str = "memory"
+    ssd_path: Optional[str] = None
 
 
 class _SparseShard:
@@ -574,6 +579,20 @@ class SsdSparseTable(MemorySparseTable):
 
     def close(self) -> None:
         self._native.close()
+
+
+def make_sparse_table(config: TableConfig) -> "MemorySparseTable":
+    """Storage-selected sparse-table factory (the_one_ps table-class
+    derivation role): config.storage picks MemorySparseTable or
+    SsdSparseTable (which needs ``ssd_path``)."""
+    if config.storage == "memory":
+        return MemorySparseTable(config)
+    if config.storage == "ssd":
+        enforce(config.ssd_path is not None,
+                "TableConfig.storage='ssd' requires ssd_path")
+        return SsdSparseTable(config.ssd_path, config)
+    raise InvalidArgumentError(
+        f"unknown table storage {config.storage!r}; have memory|ssd")
 
 
 class MemoryDenseTable:

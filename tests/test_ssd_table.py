@@ -234,3 +234,52 @@ def test_repeated_mode3_saves_bounded_disk(tmp_path):
         ssd._native.save_items(mode=3)
     # bound: compaction threshold is 4x live data
     assert ssd.stats()["disk_bytes"] <= 5 * live * rec_bytes
+
+
+@pytest.mark.slow
+def test_pass_trainer_over_ssd_table(tmp_path, rng):
+    """CtrPassTrainer (PSGPUTrainer role) runs unchanged over the SSD
+    table via the make_sparse_table factory, with spill between passes —
+    the GPUPS + SSD tier composition (multi-day stream over a population
+    larger than the hot budget)."""
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.data.dataset import InMemoryDataset, SlotDesc
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM
+    from paddle_tpu.ps.embedding_cache import CacheConfig
+    from paddle_tpu.ps.ps_trainer import CtrPassTrainer
+    from paddle_tpu.ps.table import make_sparse_table
+
+    S, D = 4, 3
+    pt.seed(0)
+    lines = []
+    for _ in range(1024):
+        ids = rng.integers(0, 64, S)
+        dense = rng.normal(size=D)
+        label = int((ids % 5 == 0).sum() + dense[0] > 1.0)
+        parts = [f"1 {v}" for v in ids] + [f"1 {v:.4f}" for v in dense]
+        parts.append(f"1 {label}")
+        lines.append(" ".join(parts))
+    slots = ([SlotDesc(f"s{i}", is_float=False, max_len=1) for i in range(S)]
+             + [SlotDesc(f"d{i}", is_float=True, max_len=1) for i in range(D)]
+             + [SlotDesc("label", is_float=True, max_len=1)])
+    ds = InMemoryDataset(slots, seed=0)
+    ds.load_from_lines(lines)
+
+    table = make_sparse_table(_cfg(storage="ssd",
+                                   ssd_path=str(tmp_path / "tbl")))
+    cfg = CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=4,
+                    dnn_hidden=(16, 16))
+    tr = CtrPassTrainer(
+        DeepFM(cfg), optimizer.Adam(1e-2), table,
+        CacheConfig(capacity=1 << 10, embedx_dim=4, embedx_threshold=0.0),
+        sparse_slots=[f"s{i}" for i in range(S)],
+        dense_slots=[f"d{i}" for i in range(D)], label_slot="label")
+
+    losses = [tr.train_from_dataset(ds, batch_size=256)["loss"]]
+    table.spill(hot_budget=0)  # age the whole population to disk
+    assert table.stats()["hot_rows"] == 0
+    for _ in range(3):  # later passes promote from disk and keep learning
+        losses.append(tr.train_from_dataset(ds, batch_size=256)["loss"])
+    assert losses[-1] < losses[0] * 0.95, losses
+    assert table.size() > 0
