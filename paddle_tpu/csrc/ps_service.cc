@@ -32,16 +32,57 @@
 #include <cstdio>
 #include <cstring>
 #include <map>
+#include <memory>
 #include <string>
 #include <unordered_map>
 
 #include "sparse_table.h"
+
+// two-tier SSD table engine (ssd_table.cc, same shared library): the
+// server routes a table's commands to this ABI when the create request
+// asks for storage=ssd
+extern "C" {
+void* sst_create(const int32_t* iparams, const float* fparams, const char* dir);
+void sst_destroy(void* h);
+int32_t sst_pull_dim(void* h);
+int32_t sst_push_dim(void* h);
+int32_t sst_full_dim(void* h);
+int64_t sst_size(void* h);
+void sst_stats(void* h, int64_t* out3);
+void sst_pull(void* h, const uint64_t* keys, const int32_t* slots, int64_t n,
+              int32_t create, float* out);
+void sst_push(void* h, const uint64_t* keys, const float* push, int64_t n);
+void sst_export(void* h, const uint64_t* keys, const int32_t* slots,
+                int64_t n, int32_t create, float* values_out, uint8_t* found);
+void sst_insert_full(void* h, const uint64_t* keys, const float* values,
+                     int64_t n);
+int64_t sst_spill(void* h, int64_t budget);
+int64_t sst_shrink(void* h);
+int64_t sst_compact(void* h);
+int64_t sst_save_begin(void* h, int32_t mode);
+void sst_save_fetch(void* h, uint64_t* keys_out, float* values_out);
+}
 
 namespace {
 
 using pstpu::NativeTable;
 using pstpu::TableNativeConfig;
 using pstpu::table_full_dim;
+
+// a sparse table is one of the two engines
+struct SparseRef {
+  NativeTable* mem = nullptr;
+  void* ssd = nullptr;
+  int32_t pull_dim() const {
+    return mem ? mem->shards[0]->pull_dim() : sst_pull_dim(ssd);
+  }
+  int32_t push_dim() const {
+    return mem ? mem->shards[0]->push_dim() : sst_push_dim(ssd);
+  }
+  int32_t full_dim() const {
+    return mem ? table_full_dim(mem) : sst_full_dim(ssd);
+  }
+};
 
 enum Cmd : uint32_t {
   kCreateSparse = 1,
@@ -65,6 +106,9 @@ enum Cmd : uint32_t {
   kPushGeo = 19,
   kPullGeo = 20,
   kSaveAll = 21,
+  kSpill = 22,   // aux unused; n = hot-row budget (SSD tables)
+  kStats = 23,   // -> [hot_rows, cold_rows, disk_bytes] i64[3]
+  kCompact = 24,
 };
 
 enum Err : int64_t {
@@ -189,10 +233,14 @@ struct PsServer {
   std::vector<int> conn_fds;
   std::mutex conn_mu;
 
-  std::map<uint32_t, NativeTable*> sparse;
+  std::map<uint32_t, SparseRef> sparse;
   std::map<uint32_t, DenseTable*> dense;
   std::map<uint32_t, GeoTable*> geo;
   std::mutex tables_mu;
+  // per-table: the sst two-phase save (begin fills, fetch drains) must
+  // not interleave between two savers of the SAME table; different
+  // tables save concurrently
+  std::map<uint32_t, std::unique_ptr<std::mutex>> ssd_save_mu;
 
   // barrier (BarrierTable semantics: all trainers arrive, then release)
   std::mutex bar_mu;
@@ -204,7 +252,10 @@ struct PsServer {
   std::atomic<int64_t> global_step{0};
 
   ~PsServer() {
-    for (auto& kv : sparse) delete kv.second;
+    for (auto& kv : sparse) {
+      delete kv.second.mem;
+      if (kv.second.ssd) sst_destroy(kv.second.ssd);
+    }
     for (auto& kv : dense) delete kv.second;
     for (auto& kv : geo) delete kv.second;
   }
@@ -278,10 +329,12 @@ struct PsServer {
       if (t.joinable()) t.join();
   }
 
-  NativeTable* get_sparse(uint32_t id) {
+  bool get_sparse(uint32_t id, SparseRef* out) {
     std::lock_guard<std::mutex> g(tables_mu);
     auto it = sparse.find(id);
-    return it == sparse.end() ? nullptr : it->second;
+    if (it == sparse.end()) return false;
+    *out = it->second;
+    return true;
   }
   DenseTable* get_dense(uint32_t id) {
     std::lock_guard<std::mutex> g(tables_mu);
@@ -326,23 +379,52 @@ struct PsServer {
       case kPing:
         return respond(fd, 0, nullptr, 0);
       case kCreateSparse: {
-        if (h.payload_len != 6 * 4 + 17 * 4) return respond(fd, kErrBadSize, nullptr, 0);
+        // payload: iparams[6 i32] + fparams[17 f32], optionally followed
+        // by [i32 storage][u32 path_len][path] (storage 1 = ssd)
+        constexpr uint64_t kBase = 6 * 4 + 17 * 4;
+        if (h.payload_len < kBase) return respond(fd, kErrBadSize, nullptr, 0);
+        int32_t storage = 0;
+        std::string path;
+        if (h.payload_len > kBase) {
+          if (h.payload_len < kBase + 8) return respond(fd, kErrBadSize, nullptr, 0);
+          uint32_t plen;
+          std::memcpy(&storage, p + kBase, 4);
+          std::memcpy(&plen, p + kBase + 4, 4);
+          if (h.payload_len != kBase + 8 + plen)
+            return respond(fd, kErrBadSize, nullptr, 0);
+          path.assign(p + kBase + 8, plen);
+        }
         TableNativeConfig c = pstpu::parse_table_config(
             reinterpret_cast<const int32_t*>(p),
             reinterpret_cast<const float*>(p + 24));
-        NativeTable* t;
+        // build the engine OUTSIDE tables_mu: an SSD create replays the
+        // whole cold-tier log, and that must not stall other tables'
+        // traffic. Losing a create race destroys the duplicate.
+        SparseRef fresh;
+        if (storage == 1) {
+          fresh.ssd = sst_create(reinterpret_cast<const int32_t*>(p),
+                                 reinterpret_cast<const float*>(p + 24),
+                                 path.c_str());
+          if (!fresh.ssd) return respond(fd, kErrInternal, nullptr, 0);
+        } else {
+          fresh.mem = new NativeTable(c);
+        }
+        SparseRef t;
         {
           std::lock_guard<std::mutex> g(tables_mu);
           auto it = sparse.find(h.table_id);
-          if (it == sparse.end()) {
-            t = new NativeTable(c);
-            sparse[h.table_id] = t;
-          } else {
+          if (it != sparse.end()) {
             t = it->second;  // idempotent re-create from another trainer
+          } else {
+            t = fresh;
+            fresh = SparseRef{};
+            sparse[h.table_id] = t;
+            if (t.ssd) ssd_save_mu[h.table_id] = std::make_unique<std::mutex>();
           }
         }
-        int32_t dims[3] = {t->shards[0]->pull_dim(), t->shards[0]->push_dim(),
-                           table_full_dim(t)};
+        delete fresh.mem;
+        if (fresh.ssd) sst_destroy(fresh.ssd);
+        int32_t dims[3] = {t.pull_dim(), t.push_dim(), t.full_dim()};
         return respond(fd, 0, dims, sizeof(dims));
       }
       case kCreateDense: {
@@ -366,38 +448,46 @@ struct PsServer {
         return respond(fd, 0, nullptr, 0);
       }
       case kPullSparse: {
-        NativeTable* t = get_sparse(h.table_id);
-        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
-        int32_t pd = t->shards[0]->pull_dim();
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
+        int32_t pd = t.pull_dim();
         uint64_t want = static_cast<uint64_t>(h.n) * (8 + 4);
         if (h.payload_len != want) return respond(fd, kErrBadSize, nullptr, 0);
         const uint64_t* keys = reinterpret_cast<const uint64_t*>(p);
         const int32_t* slots = reinterpret_cast<const int32_t*>(p + h.n * 8);
         std::vector<float> out(static_cast<size_t>(h.n) * pd);
-        t->parallel_over_shards(keys, h.n, [&](pstpu::Shard* sh, int64_t i) {
-          int32_t r = h.aux ? sh->lookup_or_insert(keys[i], slots[i])
-                            : sh->find(keys[i]);
-          float* o = out.data() + i * pd;
-          if (r >= 0)
-            sh->select_into(r, o);
-          else
-            std::fill_n(o, pd, 0.0f);
-        });
+        if (t.ssd) {
+          sst_pull(t.ssd, keys, slots, h.n, h.aux, out.data());
+        } else {
+          t.mem->parallel_over_shards(keys, h.n, [&](pstpu::Shard* sh, int64_t i) {
+            int32_t r = h.aux ? sh->lookup_or_insert(keys[i], slots[i])
+                              : sh->find(keys[i]);
+            float* o = out.data() + i * pd;
+            if (r >= 0)
+              sh->select_into(r, o);
+            else
+              std::fill_n(o, pd, 0.0f);
+          });
+        }
         return respond(fd, h.n, out.data(), out.size() * 4);
       }
       case kPushSparse: {
-        NativeTable* t = get_sparse(h.table_id);
-        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
-        int32_t pd = t->shards[0]->push_dim();
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
+        int32_t pd = t.push_dim();
         uint64_t want = static_cast<uint64_t>(h.n) * (8 + 4 * pd);
         if (h.payload_len != want) return respond(fd, kErrBadSize, nullptr, 0);
         const uint64_t* keys = reinterpret_cast<const uint64_t*>(p);
         const float* push = reinterpret_cast<const float*>(p + h.n * 8);
-        t->parallel_over_shards(keys, h.n, [&](pstpu::Shard* sh, int64_t i) {
-          const float* pv = push + i * pd;
-          int32_t r = sh->lookup_or_insert(keys[i], static_cast<int32_t>(pv[0]));
-          sh->push_one(r, pv);
-        });
+        if (t.ssd) {
+          sst_push(t.ssd, keys, push, h.n);
+        } else {
+          t.mem->parallel_over_shards(keys, h.n, [&](pstpu::Shard* sh, int64_t i) {
+            const float* pv = push + i * pd;
+            int32_t r = sh->lookup_or_insert(keys[i], static_cast<int32_t>(pv[0]));
+            sh->push_one(r, pv);
+          });
+        }
         return respond(fd, h.n, nullptr, 0);
       }
       case kPullDense: {
@@ -427,67 +517,109 @@ struct PsServer {
         return respond(fd, 0, nullptr, 0);
       }
       case kSize: {
-        NativeTable* t = get_sparse(h.table_id);
-        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
+        if (t.ssd) return respond(fd, sst_size(t.ssd), nullptr, 0);
         int64_t n = 0;
-        for (auto* sh : t->shards) n += sh->used;
+        for (auto* sh : t.mem->shards) n += sh->used;
         return respond(fd, n, nullptr, 0);
       }
       case kShrink: {
-        NativeTable* t = get_sparse(h.table_id);
-        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
+        if (t.ssd) return respond(fd, sst_shrink(t.ssd), nullptr, 0);
         int64_t erased = 0;
-        for (auto* sh : t->shards) {
+        for (auto* sh : t.mem->shards) {
           std::lock_guard<std::mutex> g(sh->mu);
           erased += sh->shrink();
         }
         return respond(fd, erased, nullptr, 0);
       }
+      case kSpill: {
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
+        // RAM-only tables have nothing to spill — 0, not an error
+        return respond(fd, t.ssd ? sst_spill(t.ssd, h.n) : 0, nullptr, 0);
+      }
+      case kStats: {
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
+        int64_t s3[3] = {0, 0, 0};
+        if (t.ssd) {
+          sst_stats(t.ssd, s3);
+        } else {
+          for (auto* sh : t.mem->shards) s3[0] += sh->used;
+        }
+        return respond(fd, 0, s3, sizeof(s3));
+      }
+      case kCompact: {
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
+        return respond(fd, t.ssd ? sst_compact(t.ssd) : 0, nullptr, 0);
+      }
       case kSaveAll: {
         // snapshot + stream in ONE command — atomic against concurrent
         // savers (the two-phase begin/fetch protocol could interleave)
-        NativeTable* t = get_sparse(h.table_id);
-        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
-        int32_t fdim = table_full_dim(t);
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
+        int32_t fdim = t.full_dim();
         std::vector<char> out;
         int64_t cnt;
-        {
-          std::lock_guard<std::mutex> sg(t->save_mu);
-          pstpu::table_save_snapshot_locked(t, h.aux);
-          cnt = static_cast<int64_t>(t->save_keys.size());
+        if (t.ssd) {
+          std::mutex* save_mu;
+          {
+            std::lock_guard<std::mutex> g(tables_mu);
+            save_mu = ssd_save_mu.at(h.table_id).get();
+          }
+          std::lock_guard<std::mutex> sg(*save_mu);
+          cnt = sst_save_begin(t.ssd, h.aux);
+          out.resize(cnt * 8 + cnt * fdim * 4);
+          if (cnt)
+            sst_save_fetch(t.ssd, reinterpret_cast<uint64_t*>(out.data()),
+                           reinterpret_cast<float*>(out.data() + cnt * 8));
+        } else {
+          std::lock_guard<std::mutex> sg(t.mem->save_mu);
+          pstpu::table_save_snapshot_locked(t.mem, h.aux);
+          cnt = static_cast<int64_t>(t.mem->save_keys.size());
           out.resize(cnt * 8 + cnt * fdim * 4);
           if (cnt) {
-            std::memcpy(out.data(), t->save_keys.data(), cnt * 8);
-            std::memcpy(out.data() + cnt * 8, t->save_values.data(),
-                        t->save_values.size() * 4);
+            std::memcpy(out.data(), t.mem->save_keys.data(), cnt * 8);
+            std::memcpy(out.data() + cnt * 8, t.mem->save_values.data(),
+                        t.mem->save_values.size() * 4);
           }
-          t->save_keys.clear();
-          t->save_values.clear();
+          t.mem->save_keys.clear();
+          t.mem->save_values.clear();
         }
         return respond(fd, cnt, out.data(), out.size());
       }
       case kInsertFull: {
-        NativeTable* t = get_sparse(h.table_id);
-        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
-        int32_t fdim = table_full_dim(t);
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
+        int32_t fdim = t.full_dim();
         uint64_t want = static_cast<uint64_t>(h.n) * (8 + 4 * fdim);
         if (h.payload_len != want) return respond(fd, kErrBadSize, nullptr, 0);
-        pstpu::table_insert_full(t, reinterpret_cast<const uint64_t*>(p),
-                                 reinterpret_cast<const float*>(p + h.n * 8),
-                                 h.n);
+        const uint64_t* keys = reinterpret_cast<const uint64_t*>(p);
+        const float* vals = reinterpret_cast<const float*>(p + h.n * 8);
+        if (t.ssd)
+          sst_insert_full(t.ssd, keys, vals, h.n);
+        else
+          pstpu::table_insert_full(t.mem, keys, vals, h.n);
         return respond(fd, h.n, nullptr, 0);
       }
       case kExport: {
-        NativeTable* t = get_sparse(h.table_id);
-        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
         if (h.payload_len != static_cast<uint64_t>(h.n) * 8)
           return respond(fd, kErrBadSize, nullptr, 0);
-        int32_t fdim = table_full_dim(t);
+        int32_t fdim = t.full_dim();
         std::vector<char> out(static_cast<size_t>(h.n) * fdim * 4 + h.n);
-        pstpu::table_export(
-            t, reinterpret_cast<const uint64_t*>(p), h.n,
-            reinterpret_cast<float*>(out.data()),
-            reinterpret_cast<uint8_t*>(out.data() + h.n * fdim * 4));
+        const uint64_t* keys = reinterpret_cast<const uint64_t*>(p);
+        float* vals = reinterpret_cast<float*>(out.data());
+        uint8_t* found = reinterpret_cast<uint8_t*>(out.data() + h.n * fdim * 4);
+        if (t.ssd)
+          sst_export(t.ssd, keys, nullptr, h.n, 0, vals, found);
+        else
+          pstpu::table_export(t.mem, keys, h.n, vals, found);
         return respond(fd, h.n, out.data(), out.size());
       }
       case kPushGeo: {

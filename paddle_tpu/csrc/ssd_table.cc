@@ -351,7 +351,19 @@ extern "C" {
 void* sst_create(const int32_t* iparams, const float* fparams,
                  const char* dir) {
   TableNativeConfig c = pstpu::parse_table_config(iparams, fparams);
-  if (mkdir(dir, 0755) != 0 && errno != EEXIST) return nullptr;
+  // mkdir -p: the table directory is often nested (e.g. a per-server
+  // subdirectory under a job path)
+  {
+    std::string path(dir);
+    for (size_t pos = 1; pos <= path.size(); ++pos) {
+      if (pos == path.size() || path[pos] == '/') {
+        std::string prefix = path.substr(0, pos);
+        if (!prefix.empty() && mkdir(prefix.c_str(), 0755) != 0 &&
+            errno != EEXIST)
+          return nullptr;
+      }
+    }
+  }
   SsdTable* t = new SsdTable(c, dir);
   for (int32_t s = 0; s < c.shard_num; ++s) {
     DiskShard* d = new DiskShard();

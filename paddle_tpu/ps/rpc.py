@@ -54,6 +54,9 @@ _CREATE_GEO = 18
 _PUSH_GEO = 19
 _PULL_GEO = 20
 _SAVE_ALL = 21
+_SPILL = 22
+_STATS = 23
+_COMPACT = 24
 
 _DENSE_OPT_IDS = {"sgd": 0, "adam": 1, "sum": 2}
 
@@ -222,11 +225,43 @@ class RpcPsClient(PSClient):
     def create_sparse_table(self, table_id: int, config: Optional[TableConfig] = None) -> None:
         cfg = config or TableConfig(table_id=table_id)
         self._sparse_cfgs[table_id] = cfg
-        payload = _sparse_config_payload(cfg)
-        for c in self._conns:
+        base = _sparse_config_payload(cfg)
+        if cfg.storage == "ssd":
+            enforce(cfg.ssd_path is not None,
+                    "TableConfig.storage='ssd' requires ssd_path")
+        for idx, c in enumerate(self._conns):
+            payload = base
+            if cfg.storage == "ssd":
+                # each (table, server) pair owns its own disk directory;
+                # one job path can host many tables and same-host servers
+                path = f"{cfg.ssd_path}/table{table_id}/server{idx}".encode()
+                payload = (base + np.asarray([1], np.int32).tobytes()
+                           + np.asarray([len(path)], np.uint32).tobytes()
+                           + path)
             _, resp = c.check(_CREATE_SPARSE, table_id, payload=payload)
             dims = np.frombuffer(resp, np.int32)
             self._sparse_dims[table_id] = (int(dims[0]), int(dims[1]), int(dims[2]))
+
+    # -- SSD-tier management (no-ops on RAM-only tables) ------------------
+
+    def spill(self, table_id: int, hot_budget: int) -> int:
+        """Per-server spill to at most hot_budget hot rows each; returns
+        total rows spilled."""
+        return sum(int(c.check(_SPILL, table_id, n=int(hot_budget))[0])
+                   for c in self._conns)
+
+    def table_stats(self, table_id: int) -> Dict[str, int]:
+        out = {"hot_rows": 0, "cold_rows": 0, "disk_bytes": 0}
+        for c in self._conns:
+            _, resp = c.check(_STATS, table_id)
+            s3 = np.frombuffer(resp, np.int64)
+            out["hot_rows"] += int(s3[0])
+            out["cold_rows"] += int(s3[1])
+            out["disk_bytes"] += int(s3[2])
+        return out
+
+    def compact(self, table_id: int) -> int:
+        return sum(int(c.check(_COMPACT, table_id)[0]) for c in self._conns)
 
     def create_dense_table(self, table_id: int, dim: int, optimizer: str = "adam",
                            lr: float = 0.001) -> None:
