@@ -283,6 +283,89 @@ class InMemoryDataset:
     def release_memory(self) -> None:
         self._store = None
 
+    # -- SlotRecord binary format (data_feed.h:1390 SlotRecord role) ------
+
+    def save_slot_record(self, path: str) -> int:
+        """Write the loaded pass as ONE compact binary file: a JSON
+        header describing per-slot column layout, then the raw value and
+        length arrays back to back. The reference's SlotRecord is its
+        compact binary representation feeding GPUPS
+        (SlotRecordInMemoryDataFeed, data_feed.h:1390) — this is the
+        at-rest form: parse text once, reload every later pass at
+        memory-bandwidth speed. Returns the number of records."""
+        import json as _json
+
+        enforce(self._store is not None, "load_into_memory first")
+        st = self._store
+        header: Dict = {"num_records": st.num_records, "slots": []}
+        blobs: List[np.ndarray] = []
+        off = 0
+        for s in st.slots:
+            vals = st._vals[s.name][0]
+            lens = st._lens[s.name][0]
+            ent = {"name": s.name, "is_float": bool(s.is_float),
+                   "max_len": int(s.max_len),
+                   "val_dtype": str(vals.dtype), "val_off": off,
+                   "val_n": int(len(vals))}
+            off += vals.nbytes
+            ent.update({"len_off": off, "len_n": int(len(lens))})
+            off += lens.nbytes
+            header["slots"].append(ent)
+            blobs += [vals, lens]
+        hdr = _json.dumps(header).encode()
+        with open(path, "wb") as f:
+            f.write(b"PTSR0001")
+            f.write(np.asarray([len(hdr)], np.uint64).tobytes())
+            f.write(hdr)
+            for b in blobs:
+                # buffer protocol — no transient bytes copy of multi-GB
+                # columns
+                f.write(memoryview(np.ascontiguousarray(b)).cast("B"))
+            f.flush()
+            os.fsync(f.fileno())
+        return st.num_records
+
+    def load_slot_record(self, path: str, mmap: bool = True) -> int:
+        """Load a pass saved by :meth:`save_slot_record`. With ``mmap``
+        the column arrays are memory-mapped (zero-copy until touched) —
+        multi-pass training re-reads the same pass file per day without
+        re-parsing text."""
+        import json as _json
+
+        with open(path, "rb") as f:
+            magic = f.read(8)
+            enforce(magic == b"PTSR0001", f"not a SlotRecord file: {path}")
+            (hlen,) = np.frombuffer(f.read(8), np.uint64)
+            header = _json.loads(f.read(int(hlen)).decode())
+            base = f.tell()
+        by_name = {e["name"]: e for e in header["slots"]}
+        store = _RecordStore(self.slots)
+        data = (np.memmap(path, np.uint8, mode="r", offset=base) if mmap
+                else np.fromfile(path, np.uint8, offset=base))
+        # fail fast on truncated/partial files: every declared column
+        # must fit the actual data section, and lengths must cover the
+        # declared record count
+        for e in header["slots"]:
+            end = e["len_off"] + e["len_n"] * 4
+            enforce(end <= len(data),
+                    f"SlotRecord file truncated: {path} (need {end} data "
+                    f"bytes for slot {e['name']!r}, have {len(data)})")
+            enforce_eq(e["len_n"], header["num_records"],
+                       f"slot {e['name']!r} length column count")
+        for s in store.slots:
+            enforce(s.name in by_name, f"slot {s.name!r} missing in {path}")
+            e = by_name[s.name]
+            enforce_eq(bool(s.is_float), e["is_float"],
+                       f"slot {s.name!r} float/id type mismatch")
+            vd = np.dtype(e["val_dtype"])
+            vals = data[e["val_off"]: e["val_off"] + e["val_n"] * vd.itemsize].view(vd)
+            lens = data[e["len_off"]: e["len_off"] + e["len_n"] * 4].view(np.int32)
+            store._vals[s.name] = [vals]
+            store._lens[s.name] = [lens]
+        store.num_records = int(header["num_records"])
+        self._store = store
+        return store.num_records
+
 
 class QueueDataset:
     """Streaming variant (data_set.h QueueDataset): parse files chunk by

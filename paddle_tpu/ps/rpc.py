@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..core.enforce import NotFoundError, PreconditionNotMetError, enforce
+from ..core.profiler import RecordEvent
 from .accessor import AccessorConfig
 from .client import PSClient
 from .native import _ACCESSOR_IDS, _RULE_IDS, load_native
@@ -295,6 +296,12 @@ class RpcPsClient(PSClient):
     # -- PSClient interface -----------------------------------------------
 
     def pull_sparse(self, table_id, keys, create=True, slots=None):
+        # client-side CostProfiler scope (brpc_ps_client's
+        # pserver_client_pull_sparse probe)
+        with RecordEvent("pserver_client_pull_sparse"):
+            return self._pull_sparse(table_id, keys, create, slots)
+
+    def _pull_sparse(self, table_id, keys, create=True, slots=None):
         keys = np.ascontiguousarray(keys, np.uint64)
         pull_dim = self._dims(table_id)[0]
         out = np.zeros((len(keys), pull_dim), np.float32)
@@ -312,6 +319,10 @@ class RpcPsClient(PSClient):
         return out
 
     def push_sparse(self, table_id, keys, values):
+        with RecordEvent("pserver_client_push_sparse"):
+            return self._push_sparse(table_id, keys, values)
+
+    def _push_sparse(self, table_id, keys, values):
         keys = np.ascontiguousarray(keys, np.uint64)
         values = np.ascontiguousarray(values, np.float32)
         # client-side dedup-merge (brpc client merges duplicate keys

@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.enforce import InvalidArgumentError, enforce, enforce_eq
+from ..core.profiler import RecordEvent
 from .accessor import AccessorConfig, CtrCommonAccessor, FeatureBlock, make_accessor
 from .native import FeasignIndex, NativeSparseTableEngine
 
@@ -295,25 +296,29 @@ class MemorySparseTable:
         self, keys: np.ndarray, slots: Optional[np.ndarray] = None, create: bool = True
     ) -> np.ndarray:
         """Batched pull with insert-on-miss (memory_sparse_table.cc:443)."""
-        if self._native is not None:
-            return self._native.pull(keys, slots, create)
-        out = np.zeros((len(keys), self.accessor.pull_dim), np.float32)
-        for sel, vals in self._scatter_gather(
-            keys, lambda sh, k, s: sh.pull(k, s, create), slots
-        ):
-            out[sel] = vals
-        return out
+        # scope name matches the reference's CostProfiler probe in
+        # MemorySparseTable::PullSparse (memory_sparse_table.cc:419)
+        with RecordEvent("pserver_sparse_select_all"):
+            if self._native is not None:
+                return self._native.pull(keys, slots, create)
+            out = np.zeros((len(keys), self.accessor.pull_dim), np.float32)
+            for sel, vals in self._scatter_gather(
+                keys, lambda sh, k, s: sh.pull(k, s, create), slots
+            ):
+                out[sel] = vals
+            return out
 
     def push_sparse(self, keys: np.ndarray, push_values: np.ndarray) -> None:
         """Batched push: push_values [n, push_dim] (slot, show, click,
         embed_g, embedx_g...). Duplicate keys in one push are pre-merged
         (gradient sum, show/click sum) like the client-side dedup-merge."""
-        keys = np.ascontiguousarray(keys, np.uint64)
-        keys, push_values = merge_duplicate_keys(keys, push_values)
-        if self._native is not None:
-            self._native.push(keys, push_values)
-            return
-        self._scatter_gather(keys, lambda sh, k, pv: sh.push(k, pv), push_values)
+        with RecordEvent("pserver_sparse_update_all"):
+            keys = np.ascontiguousarray(keys, np.uint64)
+            keys, push_values = merge_duplicate_keys(keys, push_values)
+            if self._native is not None:
+                self._native.push(keys, push_values)
+                return
+            self._scatter_gather(keys, lambda sh, k, pv: sh.push(k, pv), push_values)
 
     # -- full-row export/import (backend-neutral; the embedding-cache
     # pass build and flush-back go through these instead of reaching
@@ -334,24 +339,25 @@ class MemorySparseTable:
         rows are inserted during the same traversal (the single-pass
         begin_pass build: pull-with-create + optimizer-state export in
         one shard visit instead of two full table walks)."""
-        if self._native is not None:
-            return self._native.export_full(keys, create=create, slots=slots)
-        keys = np.ascontiguousarray(keys, np.uint64)
-        es = self.accessor.embed_rule.state_dim
-        xd = self.accessor.config.embedx_dim
-        slots_arr = (np.ascontiguousarray(slots, np.int32)
-                     if slots is not None else None)
+        with RecordEvent("pserver_sparse_export_full"):
+            if self._native is not None:
+                return self._native.export_full(keys, create=create, slots=slots)
+            keys = np.ascontiguousarray(keys, np.uint64)
+            es = self.accessor.embed_rule.state_dim
+            xd = self.accessor.config.embedx_dim
+            slots_arr = (np.ascontiguousarray(slots, np.int32)
+                         if slots is not None else None)
 
-        def visit(sh, k, s):  # create (under the same shard lock) + export
-            if create:
-                sh.pull(k, s, True)
-            return self._export_shard(sh, k, es, xd)
+            def visit(sh, k, s):  # create (under the same shard lock) + export
+                if create:
+                    sh.pull(k, s, True)
+                return self._export_shard(sh, k, es, xd)
 
-        out = np.zeros((len(keys), self.full_dim), np.float32)
-        found = np.zeros(len(keys), bool)
-        for sel, res in self._scatter_gather(keys, visit, slots_arr):
-            out[sel], found[sel] = res
-        return out, found
+            out = np.zeros((len(keys), self.full_dim), np.float32)
+            found = np.zeros(len(keys), bool)
+            for sel, res in self._scatter_gather(keys, visit, slots_arr):
+                out[sel], found[sel] = res
+            return out, found
 
     @staticmethod
     def _export_shard(sh: _SparseShard, keys: np.ndarray, es: int, xd: int):
@@ -376,15 +382,16 @@ class MemorySparseTable:
 
     def import_full(self, keys: np.ndarray, values: np.ndarray) -> None:
         """Direct overwrite of full rows (insert-on-miss)."""
-        if self._native is not None:
-            self._native.insert_full(keys, values)
-            return
-        keys = np.ascontiguousarray(keys, np.uint64)
-        es = self.accessor.embed_rule.state_dim
-        xd = self.accessor.config.embedx_dim
-        self._scatter_gather(
-            keys, lambda sh, k, v: self._import_shard(sh, k, v, es, xd), values
-        )
+        with RecordEvent("pserver_sparse_import_full"):
+            if self._native is not None:
+                self._native.insert_full(keys, values)
+                return
+            keys = np.ascontiguousarray(keys, np.uint64)
+            es = self.accessor.embed_rule.state_dim
+            xd = self.accessor.config.embedx_dim
+            self._scatter_gather(
+                keys, lambda sh, k, v: self._import_shard(sh, k, v, es, xd), values
+            )
 
     @staticmethod
     def _import_shard(sh: _SparseShard, keys: np.ndarray, values: np.ndarray,

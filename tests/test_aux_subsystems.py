@@ -211,3 +211,25 @@ def test_print_table_stat():
     msg = t.print_table_stat()
     assert "100 features" in msg and "4 shards" in msg
     assert int(t.shard_sizes().sum()) == 100
+
+
+def test_ps_op_cost_profiling():
+    """PS ops feed the CostProfiler aggregator under the reference's
+    scope names (cost_timer.h probes: pserver_sparse_select_all in
+    MemorySparseTable::PullSparse, memory_sparse_table.cc:419)."""
+    import numpy as np
+
+    from paddle_tpu.core.profiler import host_event_stats, reset_host_events
+    from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+    reset_host_events()
+    t = MemorySparseTable(TableConfig(shard_num=2))
+    keys = np.arange(1, 100, dtype=np.uint64)
+    t.pull_sparse(keys)
+    push = np.zeros((99, t.accessor.push_dim), np.float32)
+    push[:, 1] = 1.0
+    t.push_sparse(keys, push)
+    st = host_event_stats()
+    assert st["pserver_sparse_select_all"]["count"] == 1
+    assert st["pserver_sparse_update_all"]["count"] == 1
+    assert st["pserver_sparse_update_all"]["avg_s"] > 0
