@@ -303,6 +303,10 @@ class InMemoryDataset:
         for s in st.slots:
             vals = st._vals[s.name][0]
             lens = st._lens[s.name][0]
+            # load hardcodes 4-byte lengths; catch a drifted dtype at
+            # save time rather than as garbled batches after reload
+            enforce_eq(lens.dtype, np.dtype(np.int32),
+                       f"slot {s.name!r} length dtype")
             ent = {"name": s.name, "is_float": bool(s.is_float),
                    "max_len": int(s.max_len),
                    "val_dtype": str(vals.dtype), "val_off": off,

@@ -4,10 +4,25 @@ from .ctr import CtrConfig, DeepFM, WideDeep, make_ctr_train_step
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .mobilenet import MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2
+from .alexnet import AlexNet, alexnet
+from .googlenet import GoogLeNet, googlenet
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
+from .densenet import (DenseNet, densenet121, densenet161, densenet169,
+                       densenet201)
+from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_25,
+                           shufflenet_v2_x0_5, shufflenet_v2_x1_0,
+                           shufflenet_v2_x1_5, shufflenet_v2_x2_0)
 
 __all__ = ["LeNet", "Ernie", "ErnieConfig",
            "CtrConfig", "DeepFM", "WideDeep", "make_ctr_train_step",
            "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
            "resnet152",
            "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
-           "MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2"]
+           "MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2",
+           "AlexNet", "alexnet",
+           "GoogLeNet", "googlenet",
+           "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+           "DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201",
+           "ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+           "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0"]

@@ -1,0 +1,104 @@
+"""DenseNet (reference: ``python/paddle/vision/models/densenet.py``):
+dense blocks where every layer concatenates all previous feature maps
+(BN-ReLU-1x1 bottleneck → BN-ReLU-3x3, growth rate k), with
+half-channel transitions. Configs 121/161/169/201."""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from .. import nn
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201"]
+
+_CONFIGS = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+}
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_ch: int, growth: int, bn_size: int = 4) -> None:
+        super().__init__()
+        self.bn1 = nn.BatchNorm2D(in_ch)
+        self.conv1 = nn.Conv2D(in_ch, bn_size * growth, 1, bias_attr=False)
+        self.bn2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        y = self.conv1(self.relu(self.bn1(x)))
+        y = self.conv2(self.relu(self.bn2(y)))
+        return jnp.concatenate([x, y], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_ch: int, out_ch: int) -> None:
+        super().__init__()
+        self.bn = nn.BatchNorm2D(in_ch)
+        self.conv = nn.Conv2D(in_ch, out_ch, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, stride=2)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.bn(x))))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers: int = 121, num_classes: int = 1000) -> None:
+        super().__init__()
+        if layers not in _CONFIGS:
+            raise ValueError(f"unsupported densenet depth {layers}; "
+                             f"have {sorted(_CONFIGS)}")
+        init_ch, growth, blocks = _CONFIGS[layers]
+        self.num_classes = num_classes
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, init_ch, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_ch), nn.ReLU(),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        mods: List[nn.Layer] = []
+        ch = init_ch
+        for bi, n_layers in enumerate(blocks):
+            for _ in range(n_layers):
+                mods.append(_DenseLayer(ch, growth))
+                ch += growth
+            if bi != len(blocks) - 1:
+                mods.append(_Transition(ch, ch // 2))
+                ch //= 2
+        self.blocks = nn.Sequential(*mods)
+        self.bn_final = nn.BatchNorm2D(ch)
+        self.relu = nn.ReLU()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        x = self.pool(self.relu(self.bn_final(x)))
+        if self.num_classes > 0:
+            x = x.reshape(x.shape[0], -1)
+            x = self.fc(x)
+        return x
+
+
+def densenet121(**kw) -> DenseNet:
+    return DenseNet(layers=121, **kw)
+
+
+def densenet161(**kw) -> DenseNet:
+    return DenseNet(layers=161, **kw)
+
+
+def densenet169(**kw) -> DenseNet:
+    return DenseNet(layers=169, **kw)
+
+
+def densenet201(**kw) -> DenseNet:
+    return DenseNet(layers=201, **kw)

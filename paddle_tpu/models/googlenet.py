@@ -1,0 +1,111 @@
+"""GoogLeNet / Inception v1 (reference: ``python/paddle/vision/models/
+googlenet.py``): parallel 1x1 / 3x3 / 5x5 / pool branches concatenated
+on channels. The reference's forward returns (out, aux1, aux2) in
+training; the aux heads exist here too and are returned when
+``with_aux`` — branch concatenation is a channel-axis ``concat`` that
+XLA fuses with the following conv."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+class _ConvReLU(nn.Layer):
+    def __init__(self, in_ch, out_ch, kernel, stride=1, padding=0) -> None:
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
+                              padding=padding)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.conv(x))
+
+
+class _Inception(nn.Layer):
+    def __init__(self, in_ch, c1, c3r, c3, c5r, c5, proj) -> None:
+        super().__init__()
+        self.b1 = _ConvReLU(in_ch, c1, 1)
+        self.b2 = nn.Sequential(_ConvReLU(in_ch, c3r, 1),
+                                _ConvReLU(c3r, c3, 3, padding=1))
+        self.b3 = nn.Sequential(_ConvReLU(in_ch, c5r, 1),
+                                _ConvReLU(c5r, c5, 5, padding=2))
+        self.pool = nn.MaxPool2D(3, stride=1, padding=1)
+        self.b4 = _ConvReLU(in_ch, proj, 1)
+
+    def forward(self, x):
+        return jnp.concatenate(
+            [self.b1(x), self.b2(x), self.b3(x), self.b4(self.pool(x))], axis=1)
+
+
+class _AuxHead(nn.Layer):
+    """Training-time auxiliary classifier (googlenet.py out1/out2)."""
+
+    def __init__(self, in_ch, num_classes) -> None:
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(4)
+        self.conv = _ConvReLU(in_ch, 128, 1)
+        self.fc1 = nn.Linear(128 * 4 * 4, 1024)
+        self.relu = nn.ReLU()
+        self.drop = nn.Dropout(0.7)
+        self.fc2 = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.pool(x))
+        x = x.reshape(x.shape[0], -1)
+        return self.fc2(self.drop(self.relu(self.fc1(x))))
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes: int = 1000, with_aux: bool = False) -> None:
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_aux = with_aux
+        self.stem = nn.Sequential(
+            _ConvReLU(3, 64, 7, stride=2, padding=3), nn.MaxPool2D(3, stride=2, padding=1),
+            _ConvReLU(64, 64, 1), _ConvReLU(64, 192, 3, padding=1),
+            nn.MaxPool2D(3, stride=2, padding=1),
+        )
+        self.i3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2, padding=1)
+        self.i5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.dropout = nn.Dropout(0.4)
+        if num_classes > 0:
+            self.fc = nn.Linear(1024, num_classes)
+            if with_aux:
+                self.aux1 = _AuxHead(512, num_classes)
+                self.aux2 = _AuxHead(528, num_classes)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        x = self.i4a(x)
+        a1 = self.aux1(x) if (self.with_aux and self.num_classes > 0) else None
+        x = self.i4c(self.i4b(x))
+        x = self.i4d(x)
+        a2 = self.aux2(x) if (self.with_aux and self.num_classes > 0) else None
+        x = self.pool4(self.i4e(x))
+        x = self.i5b(self.i5a(x))
+        x = self.dropout(self.avgpool(x))
+        if self.num_classes > 0:
+            x = x.reshape(x.shape[0], -1)
+            x = self.fc(x)
+        if self.with_aux and self.num_classes > 0:
+            return x, a1, a2
+        return x
+
+
+def googlenet(**kw) -> GoogLeNet:
+    return GoogLeNet(**kw)

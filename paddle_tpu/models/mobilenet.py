@@ -23,17 +23,20 @@ def _make_divisible(v: float, divisor: int = 8, min_value: Optional[int] = None)
 
 
 class _ConvBNReLU(nn.Layer):
+    """Shared conv-BN(-ReLU) block (also used by shufflenetv2)."""
+
     def __init__(self, in_ch: int, out_ch: int, kernel: int = 3, stride: int = 1,
-                 groups: int = 1) -> None:
+                 groups: int = 1, act: bool = True) -> None:
         super().__init__()
         self.conv = nn.Conv2D(in_ch, out_ch, kernel, stride=stride,
                               padding=(kernel - 1) // 2, groups=groups,
                               bias_attr=False)
         self.bn = nn.BatchNorm2D(out_ch)
-        self.relu = nn.ReLU()
+        self.relu = nn.ReLU() if act else None
 
     def forward(self, x):
-        return self.relu(self.bn(self.conv(x)))
+        x = self.bn(self.conv(x))
+        return self.relu(x) if self.relu is not None else x
 
 
 class _DepthwiseSeparable(nn.Layer):

@@ -1,0 +1,119 @@
+"""ShuffleNetV2 (reference: ``python/paddle/vision/models/
+shufflenetv2.py``): channel split + shuffle units. The channel shuffle
+is a reshape/transpose pair — pure layout work XLA folds into the
+surrounding convs."""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from .. import nn
+from .mobilenet import _ConvBNReLU
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+           "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0"]
+
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512),
+    0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+_REPEATS = (4, 8, 4)
+
+
+def _channel_shuffle(x, groups: int = 2):
+    n, c, h, w = x.shape
+    x = x.reshape(n, groups, c // groups, h, w)
+    x = jnp.transpose(x, (0, 2, 1, 3, 4))
+    return x.reshape(n, c, h, w)
+
+
+class _ShuffleUnit(nn.Layer):
+    """stride-1: split channels, transform one half, concat, shuffle.
+    stride-2: both halves transformed (no split), spatial downsample."""
+
+    def __init__(self, in_ch: int, out_ch: int, stride: int) -> None:
+        super().__init__()
+        self.stride = stride
+        branch_ch = out_ch // 2
+        if stride == 1:
+            self.branch = nn.Sequential(
+                _ConvBNReLU(in_ch // 2, branch_ch, 1),
+                _ConvBNReLU(branch_ch, branch_ch, 3, groups=branch_ch, act=False),
+                _ConvBNReLU(branch_ch, branch_ch, 1),
+            )
+        else:
+            self.short = nn.Sequential(
+                _ConvBNReLU(in_ch, in_ch, 3, stride=2, groups=in_ch, act=False),
+                _ConvBNReLU(in_ch, branch_ch, 1),
+            )
+            self.branch = nn.Sequential(
+                _ConvBNReLU(in_ch, branch_ch, 1),
+                _ConvBNReLU(branch_ch, branch_ch, 3, stride=2,
+                            groups=branch_ch, act=False),
+                _ConvBNReLU(branch_ch, branch_ch, 1),
+            )
+
+    def forward(self, x):
+        if self.stride == 1:
+            half = x.shape[1] // 2
+            keep, work = x[:, :half], x[:, half:]
+            out = jnp.concatenate([keep, self.branch(work)], axis=1)
+        else:
+            out = jnp.concatenate([self.short(x), self.branch(x)], axis=1)
+        return _channel_shuffle(out)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000) -> None:
+        super().__init__()
+        if scale not in _STAGE_OUT:
+            raise ValueError(f"unsupported scale {scale}; have {sorted(_STAGE_OUT)}")
+        c0, c1, c2, c3, c_last = _STAGE_OUT[scale]
+        self.num_classes = num_classes
+        self.stem = nn.Sequential(_ConvBNReLU(3, c0, 3, stride=2),
+                                  nn.MaxPool2D(3, stride=2, padding=1))
+        mods: List[nn.Layer] = []
+        in_ch = c0
+        for out_ch, reps in zip((c1, c2, c3), _REPEATS):
+            mods.append(_ShuffleUnit(in_ch, out_ch, stride=2))
+            for _ in range(reps - 1):
+                mods.append(_ShuffleUnit(out_ch, out_ch, stride=1))
+            in_ch = out_ch
+        self.stages = nn.Sequential(*mods)
+        self.head = _ConvBNReLU(in_ch, c_last, 1)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c_last, num_classes)
+
+    def forward(self, x):
+        x = self.head(self.stages(self.stem(x)))
+        x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.reshape(x.shape[0], -1)
+            x = self.fc(x)
+        return x
+
+
+def shufflenet_v2_x0_25(**kw):
+    return ShuffleNetV2(scale=0.25, **kw)
+
+
+def shufflenet_v2_x0_5(**kw):
+    return ShuffleNetV2(scale=0.5, **kw)
+
+
+def shufflenet_v2_x1_0(**kw):
+    return ShuffleNetV2(scale=1.0, **kw)
+
+
+def shufflenet_v2_x1_5(**kw):
+    return ShuffleNetV2(scale=1.5, **kw)
+
+
+def shufflenet_v2_x2_0(**kw):
+    return ShuffleNetV2(scale=2.0, **kw)

@@ -1,0 +1,73 @@
+"""SqueezeNet (reference: ``python/paddle/vision/models/squeezenet.py``):
+fire modules — 1x1 squeeze then parallel 1x1/3x3 expand concatenated —
+versions 1.0 and 1.1."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class _Fire(nn.Layer):
+    def __init__(self, in_ch, squeeze, e1, e3) -> None:
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_ch, squeeze, 1)
+        self.relu = nn.ReLU()
+        self.e1 = nn.Conv2D(squeeze, e1, 1)
+        self.e3 = nn.Conv2D(squeeze, e3, 3, padding=1)
+
+    def forward(self, x):
+        x = self.relu(self.squeeze(x))
+        return jnp.concatenate([self.relu(self.e1(x)),
+                                self.relu(self.e3(x))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    def __init__(self, version: str = "1.0", num_classes: int = 1000) -> None:
+        super().__init__()
+        self.num_classes = num_classes
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, stride=2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, stride=2), _Fire(512, 64, 256, 256),
+            )
+        elif version == "1.1":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, stride=2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
+            )
+        else:
+            raise ValueError(f"unknown squeezenet version {version!r}")
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.5), nn.Conv2D(512, num_classes, 1), nn.ReLU())
+        self.pool = nn.AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        x = self.pool(x)
+        return x.reshape(x.shape[0], -1)
+
+
+def squeezenet1_0(**kw) -> SqueezeNet:
+    return SqueezeNet(version="1.0", **kw)
+
+
+def squeezenet1_1(**kw) -> SqueezeNet:
+    return SqueezeNet(version="1.1", **kw)

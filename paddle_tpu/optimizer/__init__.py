@@ -32,6 +32,8 @@ __all__ = [
     "Adam",
     "AdamW",
     "Adagrad",
+    "Adadelta",
+    "Adamax",
     "RMSProp",
     "Lars",
     "Lamb",
@@ -335,6 +337,78 @@ class Adagrad(Optimizer):
         return (
             _tree_map(lambda pr: pr[0], pairs, is_leaf=is_leaf),
             _tree_map(lambda pr: pr[1], pairs, is_leaf=is_leaf),
+        )
+
+
+class Adadelta(Optimizer):
+    """``paddle.optimizer.Adadelta`` (phi adadelta_kernel semantics):
+    accumulated squared grads + accumulated squared updates, rho decay."""
+
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6, **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.rho, self.epsilon = float(rho), float(epsilon)
+
+    def _init_slots(self, params):
+        return {
+            "avg_sq_grad": _tree_map(jnp.zeros_like, params),
+            "avg_sq_update": _tree_map(jnp.zeros_like, params),
+        }
+
+    def _apply(self, grads, slots, params, lr_t, step):
+        def upd(p, g, ag, au):
+            g = self._decay_grad(g, p)
+            ag_new = self.rho * ag + (1 - self.rho) * jnp.square(g)
+            update = (jnp.sqrt(au + self.epsilon)
+                      / jnp.sqrt(ag_new + self.epsilon)) * g
+            au_new = self.rho * au + (1 - self.rho) * jnp.square(update)
+            return p - lr_t * update, ag_new, au_new
+
+        triples = _tree_map(upd, params, grads, slots["avg_sq_grad"],
+                            slots["avg_sq_update"])
+        is_leaf = lambda x: isinstance(x, tuple)
+        return (
+            _tree_map(lambda tr: tr[0], triples, is_leaf=is_leaf),
+            {
+                "avg_sq_grad": _tree_map(lambda tr: tr[1], triples, is_leaf=is_leaf),
+                "avg_sq_update": _tree_map(lambda tr: tr[2], triples, is_leaf=is_leaf),
+            },
+        )
+
+
+class Adamax(Optimizer):
+    """``paddle.optimizer.Adamax`` (phi adamax_kernel semantics): Adam
+    with an infinity-norm second moment."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kw) -> None:
+        super().__init__(learning_rate, **kw)
+        self.beta1, self.beta2, self.epsilon = float(beta1), float(beta2), float(epsilon)
+
+    def _init_slots(self, params):
+        return {
+            "m": _tree_map(jnp.zeros_like, params),
+            "u": _tree_map(jnp.zeros_like, params),
+        }
+
+    def _apply(self, grads, slots, params, lr_t, step):
+        t = (step + 1).astype(jnp.float32)
+        bc1 = 1 - jnp.power(self.beta1, t)
+
+        def upd(p, g, m, u):
+            g = self._decay_grad(g, p)
+            m_new = self.beta1 * m + (1 - self.beta1) * g
+            u_new = jnp.maximum(self.beta2 * u, jnp.abs(g))
+            p_new = p - lr_t * (m_new / bc1) / (u_new + self.epsilon)
+            return p_new, m_new, u_new
+
+        triples = _tree_map(upd, params, grads, slots["m"], slots["u"])
+        is_leaf = lambda x: isinstance(x, tuple)
+        return (
+            _tree_map(lambda tr: tr[0], triples, is_leaf=is_leaf),
+            {
+                "m": _tree_map(lambda tr: tr[1], triples, is_leaf=is_leaf),
+                "u": _tree_map(lambda tr: tr[2], triples, is_leaf=is_leaf),
+            },
         )
 
 

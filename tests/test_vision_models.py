@@ -89,3 +89,69 @@ def test_mobilenet_trains():
         first = first if first is not None else loss
         last = loss
     assert last < first, (first, last)
+
+
+# -- round-2 additions: alexnet / googlenet / squeezenet / densenet /
+# shufflenetv2 (reference paddle/vision/models parity) -----------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("ctor,hw", [
+    # alexnet's 6x6 adaptive pool needs the canonical 224 input
+    (lambda: pt.models.alexnet(num_classes=10), 224),
+    (lambda: pt.models.squeezenet1_1(num_classes=10), 96),
+    (lambda: pt.models.shufflenet_v2_x0_25(num_classes=10), 64),
+])
+def test_new_families_forward_shapes(ctor, hw):
+    pt.seed(0)
+    out = _forward(ctor(), hw=hw, n=2)
+    assert out.shape == (2, 10)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.slow
+def test_googlenet_main_and_aux():
+    pt.seed(0)
+    m = pt.models.googlenet(num_classes=10, with_aux=True)
+    state = nn.get_state(m)
+    # aux heads adaptive-pool to 4x4: input 128 -> 8x8 at the aux taps
+    # (divisible; 96 -> 6x6 is not)
+    x = jnp.zeros((1, 3, 128, 128), jnp.float32)
+
+    @jax.jit
+    def fwd(state, x):
+        (out, a1, a2), _ = nn.functional_call(m, state, x, training=True,
+                                              rng=jax.random.key(0))
+        return out, a1, a2
+
+    out, a1, a2 = fwd(state, x)
+    assert out.shape == a1.shape == a2.shape == (1, 10)
+
+
+@pytest.mark.slow
+def test_densenet121_forward():
+    pt.seed(0)
+    out = _forward(pt.models.densenet121(num_classes=10), hw=64, n=1)
+    assert out.shape == (1, 10)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_new_optimizers_learn():
+    """Adadelta/Adamax step a tiny regression problem downhill."""
+    pt.seed(0)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(64, 4)).astype(np.float32))
+    w_true = jnp.asarray(rng.normal(size=(4, 1)).astype(np.float32))
+    y = x @ w_true
+    # Adadelta starts slowly by design (update magnitude bootstraps from
+    # the accumulated-update estimate) — give it more steps
+    for opt, steps, gate in ((optimizer.Adadelta(learning_rate=1.0), 300, 0.7),
+                             (optimizer.Adamax(learning_rate=0.1), 60, 0.5)):
+        model = nn.Linear(4, 1)
+        from paddle_tpu.executor import Trainer
+
+        tr = Trainer(model, opt, nn.functional.mse_loss)
+        first = float(tr.train_step(x, y))
+        for _ in range(steps):
+            last = float(tr.train_step(x, y))
+        assert last < first * gate, (type(opt).__name__, first, last)
