@@ -144,7 +144,7 @@ class TestLocalSGD:
 
     def test_pmean_under_shard_map(self):
         from jax.sharding import Mesh, PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
+        from jax import shard_map
 
         devs = np.array(jax.devices()[:4]).reshape(4)
         mesh = Mesh(devs, ("dp",))

@@ -1,0 +1,133 @@
+"""SSD-tier capacity demonstration: a feature population far larger
+than the hot budget, trained pass-by-pass through the full tier stack
+(disk cold tier -> RAM hot tier -> HBM pass cache -> flush back ->
+spill), with timings. The mechanism behind the reference's
+trillion-feature scale claim (README.md:31-34) on one host: population
+size is bounded by DISK, the hot tier by a configured budget, the HBM
+working set by the pass.
+
+Emits one JSON line (committed as SSD_SCALE.json by the round driver or
+by hand). Env knobs: SSD_DEMO_POP (population), SSD_DEMO_HOT (hot
+budget), SSD_DEMO_PASSES, SSD_DEMO_PASS_KEYS, SSD_DEMO_DIR.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("SSD_DEMO_CPU", "1") == "1":
+        jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM, make_ctr_train_step
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.embedding_cache import CacheConfig, HbmEmbeddingCache
+    from paddle_tpu.ps.table import SsdSparseTable, TableConfig
+
+    pop = int(os.environ.get("SSD_DEMO_POP", 20_000_000))
+    hot_budget = int(os.environ.get("SSD_DEMO_HOT", 1_000_000))
+    n_passes = int(os.environ.get("SSD_DEMO_PASSES", 3))
+    pass_keys = int(os.environ.get("SSD_DEMO_PASS_KEYS", 200_000))
+    base = os.environ.get("SSD_DEMO_DIR") or tempfile.mkdtemp(prefix="ssd_demo_")
+    cleanup = "SSD_DEMO_DIR" not in os.environ
+
+    pt.seed(0)
+    rng = np.random.default_rng(0)
+    dim = 8
+    acc = AccessorConfig(embedx_dim=dim, embedx_threshold=0.0)
+    table = SsdSparseTable(os.path.join(base, "tbl"),
+                           TableConfig(shard_num=16, accessor_config=acc))
+
+    # cold-load the population in chunks (bulk model load at scale)
+    chunk = 1_000_000
+    t0 = time.perf_counter()
+    fd = table.full_dim
+    for lo in range(0, pop, chunk):
+        n = min(chunk, pop - lo)
+        keys = np.arange(lo + 1, lo + 1 + n, dtype=np.uint64)
+        vals = np.zeros((n, fd), np.float32)
+        vals[:, 3] = 1.0  # show
+        vals[:, 5] = 0.01 * rng.standard_normal(n).astype(np.float32)
+        table.load_cold(keys, vals)
+    load_s = time.perf_counter() - t0
+    st0 = table.stats()
+
+    cfg = CtrConfig(num_sparse_slots=8, num_dense=4, embedx_dim=dim,
+                    dnn_hidden=(64, 64))
+    cache = HbmEmbeddingCache(table, CacheConfig(
+        capacity=1 << 18, embedx_dim=dim, embedx_threshold=0.0))
+    model = DeepFM(cfg)
+    opt = optimizer.Adam(1e-3)
+    params = {"params": dict(model.named_parameters()), "buffers": {}}
+    ostate = opt.init(params)
+    step = make_ctr_train_step(model, opt, cache.config)
+
+    passes = []
+    for p in range(n_passes):
+        keys = rng.integers(1, pop + 1,
+                            size=(pass_keys // 8, 8)).astype(np.uint64)
+        t0 = time.perf_counter()
+        n_uniq = cache.begin_pass(keys.reshape(-1))
+        build_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        steps = 20
+        for it in range(steps):
+            b = rng.integers(0, keys.shape[0], size=512)
+            rows = cache.lookup(keys[b].reshape(-1)).reshape(512, 8)
+            dense = rng.standard_normal((512, 4)).astype(np.float32)
+            lab = (keys[b, 0] % 2).astype(np.int32)
+            params, ostate, cache.state, loss = step(
+                params, ostate, cache.state, rows, dense, lab)
+        jax.block_until_ready(loss)
+        steps_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        cache.end_pass()
+        flush_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        spilled = table.spill(hot_budget)
+        spill_s = time.perf_counter() - t0
+        st = table.stats()
+        passes.append({"uniq": int(n_uniq), "build_s": round(build_s, 2),
+                       "steps_s": round(steps_s, 2),
+                       "flush_s": round(flush_s, 2),
+                       "spill_s": round(spill_s, 2), "spilled": int(spilled),
+                       "hot_rows": st["hot_rows"]})
+
+    st = table.stats()
+    out = {
+        "population": pop,
+        "hot_budget": hot_budget,
+        "disk_bytes_after_load": st0["disk_bytes"],
+        "cold_load_s": round(load_s, 2),
+        "cold_load_rows_per_s": round(pop / load_s),
+        "passes": passes,
+        "final": {"hot_rows": st["hot_rows"], "cold_rows": st["cold_rows"],
+                  "disk_bytes": st["disk_bytes"]},
+        "hot_fraction": round(st["hot_rows"] / max(pop, 1), 6),
+    }
+    print(json.dumps(out))
+    table.close()
+    if cleanup:
+        shutil.rmtree(base, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — artifact must be one JSON line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}))
+        sys.exit(0)
