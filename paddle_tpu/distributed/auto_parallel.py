@@ -221,6 +221,34 @@ class Engine:
         ins = inputs if isinstance(inputs, (tuple, list)) else (inputs,)
         return self._fwd(self._state, self._shard_batch(ins))
 
+    # -- checkpoint (engine.py save/load surface) -------------------------
+
+    def save(self, path: str) -> None:
+        """Persist model + optimizer state AND the rng stream (reference
+        Engine.save) — resumed training continues the same stochastic
+        trajectory (dropout keys), not a fresh one."""
+        from ..io.checkpoint import save_checkpoint
+
+        enforce(self._prepared, "prepare()/fit() before save")
+        payload = {"state": jax.device_get(self._state),
+                   "rng": jax.device_get(jax.random.key_data(self._rng))}
+        save_checkpoint(path, payload,
+                        opt_state=jax.device_get(self._opt_state))
+
+    def load(self, path: str) -> None:
+        """Restore a snapshot saved by :meth:`save`; arrays are placed
+        back onto the engine's mesh (replicated, as prepare() does)."""
+        from ..io.checkpoint import load_checkpoint
+
+        if not self._prepared:
+            self.prepare()
+        snap = load_checkpoint(path)
+        repl = NamedSharding(self.process_mesh.jax_mesh, PartitionSpec())
+        self._state = jax.device_put(snap["model"]["state"], repl)
+        self._rng = jax.random.wrap_key_data(
+            jnp.asarray(snap["model"]["rng"]))
+        self._opt_state = jax.device_put(snap["opt"], repl)
+
     # -- introspection ----------------------------------------------------
 
     def completion(self, example_inputs, example_labels) -> Dict[str, Any]:

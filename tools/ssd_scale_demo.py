@@ -34,8 +34,11 @@ def main() -> None:
     from paddle_tpu.ps.table import SsdSparseTable, TableConfig
 
     pop = int(os.environ.get("SSD_DEMO_POP", 20_000_000))
-    hot_budget = int(os.environ.get("SSD_DEMO_HOT", 1_000_000))
-    n_passes = int(os.environ.get("SSD_DEMO_PASSES", 3))
+    # hot budget BELOW passes x working-set so the coldest-first spill
+    # actually evicts (with 4 x ~199k-unique passes, ~790k promoted rows
+    # squeeze into 400k)
+    hot_budget = int(os.environ.get("SSD_DEMO_HOT", 400_000))
+    n_passes = int(os.environ.get("SSD_DEMO_PASSES", 4))
     pass_keys = int(os.environ.get("SSD_DEMO_PASS_KEYS", 200_000))
     base = os.environ.get("SSD_DEMO_DIR") or tempfile.mkdtemp(prefix="ssd_demo_")
     cleanup = "SSD_DEMO_DIR" not in os.environ
@@ -46,6 +49,23 @@ def main() -> None:
     acc = AccessorConfig(embedx_dim=dim, embedx_threshold=0.0)
     table = SsdSparseTable(os.path.join(base, "tbl"),
                            TableConfig(shard_num=16, accessor_config=acc))
+    try:
+        _run(table, pop, hot_budget, n_passes, pass_keys, rng, dim)
+    finally:
+        table.close()
+        if cleanup:
+            shutil.rmtree(base, ignore_errors=True)
+
+
+def _run(table, pop, hot_budget, n_passes, pass_keys, rng, dim) -> None:
+    import jax
+    import numpy as np
+    import time
+
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM, make_ctr_train_step
+    from paddle_tpu.ps.embedding_cache import CacheConfig, HbmEmbeddingCache
 
     # cold-load the population in chunks (bulk model load at scale)
     chunk = 1_000_000
@@ -117,9 +137,6 @@ def main() -> None:
         "hot_fraction": round(st["hot_rows"] / max(pop, 1), 6),
     }
     print(json.dumps(out))
-    table.close()
-    if cleanup:
-        shutil.rmtree(base, ignore_errors=True)
 
 
 if __name__ == "__main__":
