@@ -33,7 +33,7 @@ from ..ps.device_hash import device_hash_lookup
 from ..ps.embedding_cache import CacheConfig, cache_pull, cache_push
 
 __all__ = ["CtrConfig", "DeepFM", "WideDeep", "make_ctr_train_step",
-           "make_ctr_train_step_from_keys"]
+           "make_ctr_train_step_from_keys", "make_ctr_pooled_train_step"]
 
 
 @dataclasses.dataclass
@@ -139,12 +139,10 @@ def make_ctr_train_step(
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
 
 
-def _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
-                   cache_state, flat_rows, B, S, dense_x, labels,
-                   weights=None):
-    """``weights`` ([B] 0/1, optional): tail-batch padding mask — the
-    reference pads the final mini-batch to a fixed shape rather than
-    recompiling; padded examples contribute neither loss nor pushes."""
+def _make_loss_fn(model, dense_x, labels, weights):
+    """Weighted BCE over the model's logits; ``weights`` ([B] 0/1,
+    optional) is the tail-batch padding mask — padded examples
+    contribute neither loss nor pushes."""
 
     def loss_fn(params, emb):
         out, _ = nn.functional_call(model, params, emb, dense_x,
@@ -156,28 +154,102 @@ def _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
         w = weights.astype(jnp.float32)
         return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0), out
 
+    return loss_fn
+
+
+def _push_stats(labels, weights, n_cols, real=None):
+    """Per-position (show, click) for the sparse push: show=1 per real
+    example-position, click=label (FleetWrapper::PushSparseFromTensorAsync
+    semantics); ``real`` ([B*n_cols] 0/1, optional) masks padding
+    positions of multi-valued slots."""
+    if weights is None:
+        shows = jnp.ones((labels.shape[0] * n_cols,), jnp.float32)
+    else:
+        shows = jnp.repeat(weights.astype(jnp.float32), n_cols)
+    if real is not None:
+        shows = shows * real
+    clicks = jnp.repeat(labels.astype(jnp.float32), n_cols) * shows
+    return shows, clicks
+
+
+def _masked_pull(cache_state, flat_rows):
+    """Pull with sentinel masking: rows >= capacity (key missing from
+    the pass working set, or multi-value padding) pull ZEROS, not the
+    clamped last row's values — silent-miss must not read another
+    feature's embedding."""
     C = cache_state["embed_w"].shape[0]
-    emb_flat = cache_pull(cache_state, flat_rows)
-    # sentinel rows (key missing from the pass working set — only the
-    # key-fed path produces them) pull ZEROS, not the clamped last row's
-    # values: silent-miss must not read another feature's embedding
-    emb_flat = jnp.where((flat_rows < C)[:, None], emb_flat, 0.0)
-    emb = emb_flat.reshape(B, S, -1)
-    (loss, logits), (grads, emb_grad) = jax.value_and_grad(
-        loss_fn, argnums=(0, 1), has_aux=True)(params, emb)
+    emb_flat = cache_pull(cache_state, jnp.minimum(flat_rows, C - 1))
+    return jnp.where((flat_rows < C)[:, None], emb_flat, 0.0)
+
+
+def _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
+                   cache_state, flat_rows, B, S, dense_x, labels,
+                   weights=None):
+    emb = _masked_pull(cache_state, flat_rows).reshape(B, S, -1)
+    (loss, _), (grads, emb_grad) = jax.value_and_grad(
+        _make_loss_fn(model, dense_x, labels, weights),
+        argnums=(0, 1), has_aux=True)(params, emb)
 
     new_params, new_opt = optimizer.update(grads, opt_state, params)
-
-    if weights is None:
-        shows = jnp.ones((B * S,), jnp.float32)
-        clicks = jnp.repeat(labels.astype(jnp.float32), S)
-    else:
-        shows = jnp.repeat(weights.astype(jnp.float32), S)
-        clicks = jnp.repeat(labels.astype(jnp.float32), S) * shows
+    shows, clicks = _push_stats(labels, weights, S)
     new_cache = cache_push(cache_state, flat_rows,
                            emb_grad.reshape(B * S, -1), shows, clicks,
                            cache_cfg)
     return new_params, new_opt, new_cache, loss
+
+
+def make_ctr_pooled_train_step(
+    model: Layer,
+    optimizer,
+    cache_cfg: CacheConfig,
+    slot_of_column,
+    donate: bool = True,
+) -> Callable:
+    """GPUPS step for MULTI-VALUED sparse slots: each slot carries up to
+    max_len feasigns per example and their embeddings SUM-POOL into the
+    slot representation (the reference's
+    ``FleetWrapper::PullSparseToTensorSync`` accumulates multiple
+    feasigns into one output tensor slice, ps/wrapper/fleet.cc:110; push
+    hands the slot gradient to every contributing feasign with show=1
+    each — PushSparseFromTensorAsync :169).
+
+    ``slot_of_column``: static [T] int array mapping each padded key
+    column to its slot (T = sum of per-slot max_lens, S slots).
+    ``rows``: [B, T] cache rows from ``HbmEmbeddingCache.lookup``;
+    PADDING positions must hold the capacity sentinel C — they pull
+    zeros (identity for the sum-pool) and their pushes are dropped.
+
+    step(params, opt_state, cache_state, rows, dense_x, labels)
+      → (params, opt_state, cache_state, loss)
+    """
+    seg = jnp.asarray(np.asarray(slot_of_column, np.int32))
+    S = int(np.asarray(slot_of_column).max()) + 1
+
+    def step(params, opt_state, cache_state, rows, dense_x, labels,
+             weights=None):
+        B, T = rows.shape
+        C = cache_state["embed_w"].shape[0]
+        flat = rows.reshape(-1)
+        emb_pos = _masked_pull(cache_state, flat).reshape(B, T, -1)
+        # sum-pool columns into slots: [B, T, 1+dim] → [B, S, 1+dim]
+        pooled = jax.ops.segment_sum(
+            jnp.swapaxes(emb_pos, 0, 1), seg, num_segments=S)
+        pooled = jnp.swapaxes(pooled, 0, 1)
+
+        (loss, _), (grads, pooled_grad) = jax.value_and_grad(
+            _make_loss_fn(model, dense_x, labels, weights),
+            argnums=(0, 1), has_aux=True)(params, pooled)
+        new_params, new_opt = optimizer.update(grads, opt_state, params)
+
+        # sum-pool ⇒ each contributing position receives the slot grad
+        pos_grad = pooled_grad[:, seg, :].reshape(B * T, -1)
+        real = (flat < C).astype(jnp.float32)
+        shows, clicks = _push_stats(labels, weights, T, real=real)
+        new_cache = cache_push(cache_state, flat, pos_grad, shows, clicks,
+                               cache_cfg)
+        return new_params, new_opt, new_cache, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
 
 
 def make_ctr_train_step_from_keys(

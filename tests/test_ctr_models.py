@@ -82,3 +82,117 @@ def test_ctr_learns_and_flushes(model_cls):
     cache.end_pass()
     pulled = table.pull_sparse(np.unique(keys), create=False)
     assert np.abs(pulled[:, 2]).sum() > 0  # embed_w learned non-zero
+
+
+def test_pooled_step_matches_single_valued(rng):
+    """With every slot max_len=1 the pooled step must be bit-identical
+    to make_ctr_train_step."""
+    import jax.numpy as jnp
+
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.ctr import (CtrConfig, DeepFM,
+                                       make_ctr_pooled_train_step,
+                                       make_ctr_train_step)
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.embedding_cache import CacheConfig, HbmEmbeddingCache
+    from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+    import paddle_tpu as pt
+
+    S, dim, B = 5, 4, 32
+    cfg = CtrConfig(num_sparse_slots=S, num_dense=3, embedx_dim=dim,
+                    dnn_hidden=(16,))
+    ccfg = CacheConfig(capacity=256, embedx_dim=dim, embedx_threshold=0.0)
+
+    def build():
+        pt.seed(0)
+        table = MemorySparseTable(TableConfig(
+            shard_num=2, accessor_config=AccessorConfig(embedx_dim=dim)))
+        cache = HbmEmbeddingCache(table, ccfg)
+        cache.begin_pass(np.arange(1, 200, dtype=np.uint64))
+        model = DeepFM(cfg)
+        opt = optimizer.Adam(1e-2)
+        params = {"params": dict(model.named_parameters()), "buffers": {}}
+        return cache, model, opt, params, opt.init(params)
+
+    keys = rng.integers(1, 200, size=(B, S)).astype(np.uint64)
+    dense = rng.normal(size=(B, 3)).astype(np.float32)
+    labels = (rng.random(B) < 0.4).astype(np.int32)
+
+    cache1, m1, o1, p1, s1 = build()
+    step1 = make_ctr_train_step(m1, o1, ccfg, donate=False)
+    rows1 = jnp.asarray(cache1.lookup(keys.reshape(-1)).reshape(B, S))
+    p1, s1, st1, l1 = step1(p1, s1, cache1.state, rows1, dense, labels)
+
+    cache2, m2, o2, p2, s2 = build()
+    step2 = make_ctr_pooled_train_step(m2, o2, ccfg, np.arange(S),
+                                       donate=False)
+    rows2 = jnp.asarray(cache2.lookup(keys.reshape(-1)).reshape(B, S))
+    p2, s2, st2, l2 = step2(p2, s2, cache2.state, rows2, dense, labels)
+
+    np.testing.assert_allclose(float(l2), float(l1), rtol=1e-6)
+    for k in st1:
+        np.testing.assert_allclose(np.asarray(st2[k]), np.asarray(st1[k]),
+                                   atol=1e-6, err_msg=k)
+
+
+def test_pooled_step_variable_length_slots(rng):
+    """Multi-valued slots: padded positions (sentinel rows) contribute
+    nothing; real positions all receive the slot gradient; training
+    learns."""
+    import jax.numpy as jnp
+
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.ctr import (CtrConfig, DeepFM,
+                                       make_ctr_pooled_train_step)
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.embedding_cache import CacheConfig, HbmEmbeddingCache
+    from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+    import paddle_tpu as pt
+
+    pt.seed(0)
+    S, dim, B = 3, 4, 64
+    max_lens = [2, 3, 1]          # slots 0..2, T = 6 padded key columns
+    seg = np.repeat(np.arange(S), max_lens)
+    cfg = CtrConfig(num_sparse_slots=S, num_dense=2, embedx_dim=dim,
+                    dnn_hidden=(16,))
+    ccfg = CacheConfig(capacity=512, embedx_dim=dim, embedx_threshold=0.0)
+    C = ccfg.capacity
+    table = MemorySparseTable(TableConfig(
+        shard_num=2, accessor_config=AccessorConfig(embedx_dim=dim)))
+    cache = HbmEmbeddingCache(table, ccfg)
+    cache.begin_pass(np.arange(1, 300, dtype=np.uint64))
+    model = DeepFM(cfg)
+    opt = optimizer.Adam(1e-2)
+    params = {"params": dict(model.named_parameters()), "buffers": {}}
+    ostate = opt.init(params)
+    step = make_ctr_pooled_train_step(model, opt, ccfg, seg, donate=False)
+
+    losses = []
+    for it in range(40):
+        T = len(seg)
+        keys = rng.integers(1, 300, size=(B, T)).astype(np.uint64)
+        rows = cache.lookup(keys.reshape(-1)).reshape(B, T)
+        # random tail padding within each slot -> sentinel C
+        lens = {s: rng.integers(1, ml + 1, size=B)
+                for s, ml in enumerate(max_lens)}
+        col = 0
+        for s, ml in enumerate(max_lens):
+            for j in range(ml):
+                rows[lens[s] <= j, col] = C
+                col += 1
+        dense = rng.normal(size=(B, 2)).astype(np.float32)
+        labels = (keys[:, 0] % 2).astype(np.int32)
+        params, ostate, cache.state, loss = step(
+            params, ostate, cache.state, jnp.asarray(rows), dense, labels)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.05, (losses[0], losses[-1])
+    # the padding invariant: sentinel pushes must NOT leak into rows
+    # outside the pass working set — the pass allocated rows 0..298
+    # (299 keys), so every later row's stats stay exactly zero
+    st = cache.state
+    shows = np.asarray(st["show"])
+    assert shows[:299].max() > 0
+    np.testing.assert_array_equal(shows[299:], 0.0)
+    np.testing.assert_array_equal(np.asarray(st["embed_w"])[299:], 0.0)

@@ -27,10 +27,7 @@ def main() -> None:
     import numpy as np
 
     import paddle_tpu as pt
-    from paddle_tpu import optimizer
-    from paddle_tpu.models.ctr import CtrConfig, DeepFM, make_ctr_train_step
     from paddle_tpu.ps.accessor import AccessorConfig
-    from paddle_tpu.ps.embedding_cache import CacheConfig, HbmEmbeddingCache
     from paddle_tpu.ps.table import SsdSparseTable, TableConfig
 
     pop = int(os.environ.get("SSD_DEMO_POP", 20_000_000))
@@ -60,9 +57,7 @@ def main() -> None:
 def _run(table, pop, hot_budget, n_passes, pass_keys, rng, dim) -> None:
     import jax
     import numpy as np
-    import time
 
-    import paddle_tpu as pt
     from paddle_tpu import optimizer
     from paddle_tpu.models.ctr import CtrConfig, DeepFM, make_ctr_train_step
     from paddle_tpu.ps.embedding_cache import CacheConfig, HbmEmbeddingCache
