@@ -62,3 +62,45 @@ def pytest_collection_modifyitems(config, items):
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def launch_two_workers(worker_src: str, tmp_path, timeout: float = 240):
+    """Spawn two localhost jax.distributed worker processes running
+    ``worker_src`` (argv: rank world port) and return their outputs.
+    Guarantees cleanup: workers are killed on timeout or assertion
+    failure — never leak distributed processes into later tests."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(worker_src)
+    procs = []
+    for r in range(2):
+        env = dict(os.environ,
+                   PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+        env.pop("XLA_FLAGS", None)
+        env.pop("JAX_PLATFORMS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script), str(r), "2", str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = []
+    try:
+        for r, p in enumerate(procs):
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+            assert p.returncode == 0, f"rank {r} failed:\n{out[-3000:]}"
+            assert f"WORKER_OK {r}" in out, out[-3000:]
+    finally:
+        for p in procs:  # never leak distributed workers on failure
+            if p.poll() is None:
+                p.kill()
+    return outs

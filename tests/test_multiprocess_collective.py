@@ -6,14 +6,11 @@ psum over the global 8-device mesh — the reference's
 test_dist_base-style localhost-subprocess harness.
 """
 
-import os
-import socket
-import subprocess
-import sys
 import textwrap
 
-import numpy as np
 import pytest
+
+from conftest import launch_two_workers
 
 _WORKER = textwrap.dedent("""
     import os, sys
@@ -64,30 +61,4 @@ _WORKER = textwrap.dedent("""
 
 @pytest.mark.slow
 def test_two_process_jax_distributed(tmp_path):
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    script = tmp_path / "worker.py"
-    script.write_text(_WORKER)
-    procs = []
-    for r in range(2):
-        env = dict(os.environ,
-                   PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
-        env.pop("XLA_FLAGS", None)
-        env.pop("JAX_PLATFORMS", None)
-        procs.append(subprocess.Popen(
-            [sys.executable, str(script), str(r), "2", str(port)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True))
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=240)
-            assert p.returncode == 0, err[-3000:]
-            assert "WORKER_OK" in out
-    finally:
-        for p in procs:  # never leak distributed workers on failure
-            if p.poll() is None:
-                p.kill()
+    launch_two_workers(_WORKER, tmp_path)

@@ -1,0 +1,102 @@
+"""Multi-HOST sharded embedding serving: two localhost jax.distributed
+processes x 4 virtual CPU devices form one global 8-device "ps" mesh and
+serve row-sharded cache pull/push across the process boundary — the
+DCN-spanning version of the HeterComm serving path (SURVEY §2.4 →TPU:
+intra-host hops ride ICI, cross-host hops ride DCN, both inside the same
+compiled program). Each rank verifies its addressable shards numerically
+match the single-device reference (atol 1e-5).
+"""
+
+import textwrap
+
+import pytest
+
+from conftest import launch_two_workers
+
+_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+
+    rank = int(sys.argv[1]); world = int(sys.argv[2]); port = sys.argv[3]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["RANK"] = str(rank)
+    os.environ["WORLD_SIZE"] = str(world)
+    os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.distributed import collective as C
+
+    env = C.init_parallel_env()
+    n_dev = world * 4
+    assert len(jax.devices()) == n_dev
+
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.ps.embedding_cache import (CacheConfig, cache_pull,
+                                               cache_push)
+    from paddle_tpu.ps.sharded_cache import (sharded_cache_pull,
+                                             sharded_cache_push)
+
+    # identical host-side state on every rank (same seed)
+    Cap, dim, B = 256, 4, 16
+    rng = np.random.default_rng(0)
+    host = {
+        "show": rng.uniform(0, 5, Cap).astype(np.float32),
+        "click": rng.uniform(0, 2, Cap).astype(np.float32),
+        "embed_w": rng.normal(size=(Cap, 1)).astype(np.float32),
+        "embed_state": rng.uniform(0, 1, (Cap, 1)).astype(np.float32),
+        "embedx_w": rng.normal(size=(Cap, dim)).astype(np.float32),
+        "embedx_state": rng.uniform(0, 1, (Cap, 1)).astype(np.float32),
+        "has_embedx": (rng.random(Cap) < 0.5).astype(np.float32),
+    }
+    rows = rng.integers(0, Cap, B).astype(np.int32)
+    grads = rng.normal(size=(B, 1 + dim)).astype(np.float32)
+    shows = np.ones(B, np.float32)
+    clicks = (rng.random(B) < 0.4).astype(np.float32)
+    cfg = CacheConfig(capacity=Cap, embedx_dim=dim, embedx_threshold=1.0)
+
+    mesh = Mesh(np.array(jax.devices()), ("ps",))
+    row_sh = NamedSharding(mesh, P("ps"))
+
+    def to_global(a):
+        sh = NamedSharding(mesh, P(*(["ps"] + [None] * (a.ndim - 1))))
+        return jax.make_array_from_callback(a.shape, sh, lambda i: a[i])
+
+    state_g = {k: to_global(v) for k, v in host.items()}
+    rows_g, grads_g, shows_g, clicks_g = (to_global(x) for x in
+                                          (rows, grads, shows, clicks))
+
+    pull = jax.jit(shard_map(
+        lambda st, r: sharded_cache_pull(st, r, "ps"),
+        mesh=mesh, in_specs=(P("ps"), P("ps")), out_specs=P("ps")))
+    out = pull(state_g, rows_g)
+    ref = np.asarray(cache_pull(
+        {k: jnp.asarray(v) for k, v in host.items()}, jnp.asarray(rows)))
+    for shard in out.addressable_shards:
+        np.testing.assert_allclose(np.asarray(shard.data),
+                                   ref[shard.index], atol=1e-6)
+
+    push = jax.jit(shard_map(
+        lambda st, r, g, s, c: sharded_cache_push(st, r, g, s, c, cfg, "ps"),
+        mesh=mesh, in_specs=(P("ps"),) * 5, out_specs=P("ps")))
+    new_g = push(state_g, rows_g, grads_g, shows_g, clicks_g)
+    new_ref = cache_push(
+        {k: jnp.asarray(v) for k, v in host.items()}, jnp.asarray(rows),
+        jnp.asarray(grads), jnp.asarray(shows), jnp.asarray(clicks), cfg)
+    for k in new_ref:
+        refk = np.asarray(new_ref[k])
+        for shard in new_g[k].addressable_shards:
+            np.testing.assert_allclose(np.asarray(shard.data),
+                                       refk[shard.index], atol=1e-5,
+                                       err_msg=k)
+    print("WORKER_OK", rank, flush=True)
+""")
+
+
+@pytest.mark.slow
+def test_two_process_sharded_cache(tmp_path):
+    launch_two_workers(_WORKER, tmp_path)
