@@ -125,15 +125,17 @@ def main() -> None:
                                          slot_ids=np.arange(26))
 
     # pre-generate host-side batches (data pipeline measured separately;
-    # the reference's dataset feed is also an async producer). Only the
-    # low-32 key halves cross the wire — slots are static columns.
+    # the reference's dataset feed is also an async producer). Narrow
+    # wire dtypes — lo32 key halves, f16 dense, int8 labels (the step
+    # casts to f32/int32 in-graph): the tunnel link is the bottleneck,
+    # so wire bytes are throughput.
     n_batches = 8
     batches = []
     for b in range(n_batches):
         idx = rng.integers(0, pass_keys, size=batch)
         lo32 = (pool[idx] & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-        dense = rng.normal(size=(batch, cfg.num_dense)).astype(np.float32)
-        labels = (rng.random(batch) < 0.3).astype(np.int32)
+        dense = rng.normal(size=(batch, cfg.num_dense)).astype(np.float16)
+        labels = (rng.random(batch) < 0.3).astype(np.int8)
         batches.append((lo32, dense, labels))
 
     map_state = cache.device_map.state

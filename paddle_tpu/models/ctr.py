@@ -185,6 +185,10 @@ def _masked_pull(cache_state, flat_rows):
 def _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
                    cache_state, flat_rows, B, S, dense_x, labels,
                    weights=None):
+    # hosts may ship dense/labels in narrow wire dtypes (f16 / int8 —
+    # the H2D link is the CTR bottleneck, MEASURED.md); compute is f32
+    dense_x = dense_x.astype(jnp.float32)
+    labels = labels.astype(jnp.int32)
     emb = _masked_pull(cache_state, flat_rows).reshape(B, S, -1)
     (loss, _), (grads, emb_grad) = jax.value_and_grad(
         _make_loss_fn(model, dense_x, labels, weights),
@@ -227,6 +231,10 @@ def make_ctr_pooled_train_step(
 
     def step(params, opt_state, cache_state, rows, dense_x, labels,
              weights=None):
+        # same narrow-wire contract as _ctr_step_body: f16/int8 inputs
+        # up-cast here, compute is f32
+        dense_x = dense_x.astype(jnp.float32)
+        labels = labels.astype(jnp.int32)
         B, T = rows.shape
         C = cache_state["embed_w"].shape[0]
         flat = rows.reshape(-1)

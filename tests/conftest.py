@@ -81,7 +81,7 @@ def launch_two_workers(worker_src: str, tmp_path, timeout: float = 240):
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     script = tmp_path / "worker.py"
-    script.write_text(worker_src)
+    script.write_text(DISTRIBUTED_WORKER_PREAMBLE + worker_src)
     procs = []
     for r in range(2):
         env = dict(os.environ,
@@ -104,3 +104,27 @@ def launch_two_workers(worker_src: str, tmp_path, timeout: float = 240):
             if p.poll() is None:
                 p.kill()
     return outs
+
+
+#: shared bootstrap for two-process jax.distributed worker scripts
+#: (argv: rank world port); launch_two_workers prepends this to the
+#: worker source so the env/config dance lives in exactly one place
+DISTRIBUTED_WORKER_PREAMBLE = """
+import os, sys
+import numpy as np
+
+rank = int(sys.argv[1]); world = int(sys.argv[2]); port = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+os.environ["RANK"] = str(rank)
+os.environ["WORLD_SIZE"] = str(world)
+os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from paddle_tpu.distributed import collective as C
+
+env = C.init_parallel_env()
+assert env.rank == rank and env.world_size == world
+assert len(jax.devices()) == world * 4, len(jax.devices())
+"""

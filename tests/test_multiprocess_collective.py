@@ -13,24 +13,6 @@ import pytest
 from conftest import launch_two_workers
 
 _WORKER = textwrap.dedent("""
-    import os, sys
-    import numpy as np
-
-    rank = int(sys.argv[1]); world = int(sys.argv[2]); port = sys.argv[3]
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    os.environ["RANK"] = str(rank)
-    os.environ["WORLD_SIZE"] = str(world)
-    os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-
-    from paddle_tpu.distributed import collective as C
-
-    env = C.init_parallel_env()
-    assert env.rank == rank and env.world_size == world
-    assert len(jax.devices()) == world * 4, len(jax.devices())
-
     # (a) eager host collectives
     got = C.all_reduce(np.asarray([1.0 + rank, 10.0]), op="sum")
     assert got.tolist() == [sum(1.0 + r for r in range(world)), 10.0 * world], got

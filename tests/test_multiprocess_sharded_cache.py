@@ -14,24 +14,6 @@ import pytest
 from conftest import launch_two_workers
 
 _WORKER = textwrap.dedent("""
-    import os, sys
-    import numpy as np
-
-    rank = int(sys.argv[1]); world = int(sys.argv[2]); port = sys.argv[3]
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
-    os.environ["RANK"] = str(rank)
-    os.environ["WORLD_SIZE"] = str(world)
-    os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-
-    from paddle_tpu.distributed import collective as C
-
-    env = C.init_parallel_env()
-    n_dev = world * 4
-    assert len(jax.devices()) == n_dev
-
     import jax.numpy as jnp
     from jax import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -60,7 +42,6 @@ _WORKER = textwrap.dedent("""
     cfg = CacheConfig(capacity=Cap, embedx_dim=dim, embedx_threshold=1.0)
 
     mesh = Mesh(np.array(jax.devices()), ("ps",))
-    row_sh = NamedSharding(mesh, P("ps"))
 
     def to_global(a):
         sh = NamedSharding(mesh, P(*(["ps"] + [None] * (a.ndim - 1))))
