@@ -33,7 +33,8 @@ from ..ps.device_hash import device_hash_lookup
 from ..ps.embedding_cache import CacheConfig, cache_pull, cache_push
 
 __all__ = ["CtrConfig", "DeepFM", "WideDeep", "make_ctr_train_step",
-           "make_ctr_train_step_from_keys", "make_ctr_pooled_train_step"]
+           "make_ctr_train_step_from_keys", "make_ctr_pooled_train_step",
+           "make_ctr_train_step_packed", "pack_ctr_batch"]
 
 
 @dataclasses.dataclass
@@ -260,6 +261,78 @@ def make_ctr_pooled_train_step(
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
 
 
+def pack_ctr_batch(lo32: np.ndarray, dense: np.ndarray,
+                   labels: np.ndarray) -> np.ndarray:
+    """Host side: one contiguous uint8 buffer per step —
+    [lo32 u32 | dense f16 | labels i8] — so the H2D path pays ONE
+    transfer + dispatch instead of three (the tunnel link's per-transfer
+    overhead is material at sub-ms step times, MEASURED.md). Shapes are
+    checked: a transposed array would repack to the same byte count and
+    silently scramble examples."""
+    B = labels.shape[0]
+    enforce(lo32.ndim == 2 and lo32.shape[0] == B,
+            f"lo32 must be [B={B}, S], got {lo32.shape}")
+    enforce(dense.ndim == 2 and dense.shape[0] == B,
+            f"dense must be [B={B}, D], got {dense.shape}")
+    # single host copy: byte views concatenated once, no bytes objects
+    return np.concatenate([
+        np.ascontiguousarray(lo32, np.uint32).view(np.uint8).ravel(),
+        np.ascontiguousarray(dense, np.float16).view(np.uint8).ravel(),
+        np.ascontiguousarray(labels, np.int8).view(np.uint8).ravel(),
+    ])
+
+
+def make_ctr_train_step_packed(
+    model: Layer,
+    optimizer,
+    cache_cfg: CacheConfig,
+    slot_ids,
+    batch_size: int,
+    num_dense: int,
+    donate: bool = True,
+) -> Callable:
+    """The from-keys GPUPS step over a SINGLE packed wire buffer
+    (``pack_ctr_batch``): the step bitcasts the buffer back into
+    lo32/dense/labels in-graph (static offsets — B, S, D are trace-time
+    constants) and continues exactly like make_ctr_train_step_from_keys.
+
+    step(params, opt_state, cache_state, map_state, packed_u8)
+      → (params, opt_state, cache_state, loss)
+    """
+    from jax import lax
+
+    slot_hi = jnp.asarray(np.asarray(slot_ids, np.uint32))
+    B, S, D = int(batch_size), int(slot_hi.shape[0]), int(num_dense)
+    o_dense = B * S * 4
+    o_label = o_dense + B * D * 2
+    total = o_label + B
+
+    def step(params, opt_state, cache_state, map_state, packed,
+             weights=None):
+        enforce_eq(packed.shape[0], total, "packed batch size")
+        lo = lax.bitcast_convert_type(
+            packed[:o_dense].reshape(B * S, 4), jnp.uint32)
+        dense_x = lax.bitcast_convert_type(
+            packed[o_dense:o_label].reshape(B, D, 2), jnp.float16)
+        labels = lax.bitcast_convert_type(packed[o_label:], jnp.int8)
+        hi = jnp.broadcast_to(slot_hi[None, :], (B, S)).reshape(-1)
+        rows = _lookup_rows(cache_state, map_state, hi, lo)
+        return _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
+                              cache_state, rows, B, S, dense_x, labels,
+                              weights)
+
+    return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
+
+
+def _lookup_rows(cache_state, map_state, hi, lo):
+    """In-graph key→row probe with the missing-key sentinel contract:
+    keys outside the pass working set map to capacity C (zero pull,
+    dropped push) — ONE definition for the packed and from-keys steps."""
+    rows = device_hash_lookup(map_state, hi, lo)
+    C = cache_state["embed_w"].shape[0]
+    return jnp.where(rows >= 0, rows, C)
+
+
 def make_ctr_train_step_from_keys(
     model: Layer,
     optimizer,
@@ -294,9 +367,7 @@ def make_ctr_train_step_from_keys(
 
     def _finish(params, opt_state, cache_state, hi, lo, B, S, dense_x,
                 labels, map_state, weights):
-        rows = device_hash_lookup(map_state, hi, lo)
-        C = cache_state["embed_w"].shape[0]
-        rows = jnp.where(rows >= 0, rows, C)
+        rows = _lookup_rows(cache_state, map_state, hi, lo)
         return _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
                               cache_state, rows, B, S, dense_x, labels,
                               weights)

@@ -196,3 +196,63 @@ def test_pooled_step_variable_length_slots(rng):
     assert shows[:299].max() > 0
     np.testing.assert_array_equal(shows[299:], 0.0)
     np.testing.assert_array_equal(np.asarray(st["embed_w"])[299:], 0.0)
+
+
+def test_packed_step_matches_from_keys(rng):
+    """Single-buffer packed wire format: bitwise-identical results to
+    the three-array from-keys step (same dtypes both sides)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.ctr import (CtrConfig, DeepFM, pack_ctr_batch,
+                                       make_ctr_train_step_from_keys,
+                                       make_ctr_train_step_packed)
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.embedding_cache import CacheConfig, HbmEmbeddingCache
+    from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+    S, D, B, dim = 6, 4, 32, 4
+    ccfg = CacheConfig(capacity=512, embedx_dim=dim, embedx_threshold=0.0)
+
+    def build():
+        pt.seed(0)
+        table = MemorySparseTable(TableConfig(
+            shard_num=2, accessor_config=AccessorConfig(embedx_dim=dim)))
+        cache = HbmEmbeddingCache(table, ccfg, device_map=True)
+        pool = rng2.integers(1, 1 << 18, size=(80, S)).astype(np.uint64)
+        pool += np.arange(S, dtype=np.uint64) << np.uint64(32)
+        cache.begin_pass(pool.reshape(-1))
+        model = DeepFM(CtrConfig(num_sparse_slots=S, num_dense=D,
+                                 embedx_dim=dim, dnn_hidden=(16,)))
+        opt = optimizer.Adam(1e-2)
+        params = {"params": dict(model.named_parameters()), "buffers": {}}
+        return cache, pool, model, opt, params, opt.init(params)
+
+    rng2 = np.random.default_rng(7)
+    cache1, pool, m1, o1, p1, s1 = build()
+    rng2 = np.random.default_rng(7)
+    cache2, _, m2, o2, p2, s2 = build()
+
+    idx = rng.integers(0, 80, size=B)
+    lo32 = (pool[idx] & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    dense = rng.normal(size=(B, D)).astype(np.float16)
+    labels = (rng.random(B) < 0.4).astype(np.int8)
+
+    step_k = make_ctr_train_step_from_keys(m1, o1, ccfg,
+                                           slot_ids=np.arange(S),
+                                           donate=False)
+    p1, s1, st1, l1 = step_k(p1, s1, cache1.state, cache1.device_map.state,
+                             jnp.asarray(lo32), jnp.asarray(dense),
+                             jnp.asarray(labels))
+
+    step_p = make_ctr_train_step_packed(m2, o2, ccfg, np.arange(S), B, D,
+                                        donate=False)
+    packed = jnp.asarray(pack_ctr_batch(lo32, dense, labels))
+    p2, s2, st2, l2 = step_p(p2, s2, cache2.state, cache2.device_map.state,
+                             packed)
+
+    np.testing.assert_array_equal(float(l2), float(l1))
+    for k in st1:
+        np.testing.assert_array_equal(np.asarray(st2[k]), np.asarray(st1[k]),
+                                      err_msg=k)
