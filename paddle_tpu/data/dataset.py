@@ -124,18 +124,25 @@ class _RecordStore:
         np.cumsum(lens, out=off[1:])
         return off
 
+    def _gather_rows(self, name: str, indices: np.ndarray):
+        """Vectorized variable-length row gather: (lens, values) of the
+        given records for one slot. Safe for empty index sets."""
+        off = self._offsets(name)
+        lens = self._lens[name][0][indices]
+        vals = self._vals[name][0]
+        total = int(lens.sum())
+        if total == 0:
+            return lens, np.zeros(0, vals.dtype)
+        starts = off[:-1][indices]
+        idx = np.repeat(starts, lens) + (
+            np.arange(total)
+            - np.repeat(np.concatenate([[0], np.cumsum(lens)[:-1]]), lens))
+        return lens, vals[idx.astype(np.int64)]
+
     def permute(self, perm: np.ndarray) -> None:
         for s in self.slots:
-            off = self._offsets(s.name)
-            lens = self._lens[s.name][0]
-            vals = self._vals[s.name][0]
-            starts = off[:-1][perm]
-            new_lens = lens[perm]
-            # gather variable-length rows under the permutation
-            idx = np.repeat(starts, new_lens) + (
-                np.arange(int(new_lens.sum())) -
-                np.repeat(np.concatenate([[0], np.cumsum(new_lens)[:-1]]), new_lens))
-            self._vals[s.name][0] = vals[idx]
+            new_lens, new_vals = self._gather_rows(s.name, perm)
+            self._vals[s.name][0] = new_vals
             self._lens[s.name][0] = new_lens
 
     def batch(self, lo: int, hi: int) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
@@ -160,6 +167,54 @@ class _RecordStore:
     def feasigns(self) -> np.ndarray:
         keys = [self._vals[s.name][0] for s in self.slots if not s.is_float]
         return np.concatenate(keys) if keys else np.zeros(0, np.uint64)
+
+    # -- record subset wire format (global-shuffle exchange) -------------
+
+    def extract_bytes(self, indices: np.ndarray) -> bytes:
+        """Serialize the given records: [u32 n] then per slot (in slot
+        order) [u32 n_values][lens i32][values raw]."""
+        indices = np.ascontiguousarray(indices, np.int64)
+        parts = [np.asarray([len(indices)], np.uint32).tobytes()]
+        for s in self.slots:
+            lens, gather = self._gather_rows(s.name, indices)
+            parts.append(np.asarray([len(gather)], np.uint32).tobytes())
+            parts.append(np.ascontiguousarray(lens, np.int32).tobytes())
+            parts.append(np.ascontiguousarray(gather).tobytes())
+        return b"".join(parts)
+
+    def ingest_bytes(self, blob: bytes) -> int:
+        """Append records serialized by :meth:`extract_bytes` (slot
+        schemas must match). Returns the record count ingested."""
+        if not blob:  # empty partition
+            return 0
+        view = memoryview(blob)
+        (n,) = np.frombuffer(view[:4], np.uint32)
+        o = 4
+        cols_v, cols_l = {}, {}
+        for s in self.slots:
+            (nv,) = np.frombuffer(view[o:o + 4], np.uint32)
+            o += 4
+            lens = np.frombuffer(view[o:o + 4 * n], np.int32)
+            o += 4 * int(n)
+            dtype = np.float32 if s.is_float else np.uint64
+            nbytes = int(nv) * dtype().itemsize
+            vals = np.frombuffer(view[o:o + nbytes], dtype)
+            o += nbytes
+            cols_v[s.name] = vals.copy()
+            cols_l[s.name] = lens.copy()
+        if n:
+            for s in self.slots:
+                self._vals[s.name][0] = np.concatenate(
+                    [self._vals[s.name][0], cols_v[s.name]])
+                self._lens[s.name][0] = np.concatenate(
+                    [self._lens[s.name][0], cols_l[s.name]])
+            self.num_records += int(n)
+        return int(n)
+
+    def keep_only(self, indices: np.ndarray) -> None:
+        """Drop every record not in ``indices`` (order preserved)."""
+        self.permute(np.ascontiguousarray(indices, np.int64))
+        self.num_records = len(indices)
 
 
 class InMemoryDataset:
@@ -240,24 +295,51 @@ class InMemoryDataset:
 
     def global_shuffle(
         self,
-        exchange: Optional[Callable[[List[List[int]]], None]] = None,
+        exchange: Optional[Callable[[List[bytes]], List[bytes]]] = None,
         worker_id: int = 0,
         worker_num: int = 1,
+        util=None,
     ) -> None:
-        """Hash-partition records across workers then shuffle locally.
+        """Redistribute RECORDS across workers, then shuffle locally —
+        the reference's GlooWrapper-backed dataset global shuffle
+        (data_set.cc: each worker assigns every local record a random
+        destination, ships the serialized records all-to-all, ingests
+        what arrives, then shuffles locally).
 
-        ``exchange(partitions)`` ships record-index partitions to peers and
-        ingests theirs (the GlooWrapper global-shuffle role); without it
-        (single worker) this reduces to a seeded local shuffle keyed by
-        record hash, matching the reference's determinism property."""
+        Transport: pass ``util`` (``fleet.util`` — uses
+        ``all_to_all_bytes``) or a raw ``exchange(blobs)->blobs``
+        callable taking one serialized-record blob per destination and
+        returning one per source. Single worker (or neither transport):
+        reduces to a seeded local shuffle."""
         enforce(self._store is not None, "load_into_memory first")
+        if util is not None:
+            # the util's bound rank/world are authoritative — mismatched
+            # caller-supplied ids would silently lose/duplicate records
+            u_rank, u_world = util._rank, util._world
+            enforce(worker_id in (0, u_rank) and worker_num in (1, u_world),
+                    f"worker_id/num ({worker_id}/{worker_num}) contradict "
+                    f"the bound util rank/world ({u_rank}/{u_world})")
+            worker_id, worker_num = u_rank, u_world
+            if exchange is None:
+                exchange = util.all_to_all_bytes
         if worker_num <= 1 or exchange is None:
             self.local_shuffle()
             return
-        n = self._store.num_records
-        owner = np.array([hash((worker_id, i)) % worker_num for i in range(n)])
-        partitions = [list(np.nonzero(owner == w)[0]) for w in range(worker_num)]
-        exchange(partitions)
+        st = self._store
+        n = st.num_records
+        dest = self._rng.integers(0, worker_num, size=n)
+        # own partition stays in place (keep_only below) — ship an empty
+        # blob to self rather than round-tripping it through the store
+        blobs = [st.extract_bytes(np.flatnonzero(dest == w))
+                 if w != worker_id else b""
+                 for w in range(worker_num)]
+        received = exchange(blobs)
+        enforce(len(received) == worker_num,
+                "exchange must return one blob per source worker")
+        st.keep_only(np.flatnonzero(dest == worker_id))
+        for src, blob in enumerate(received):
+            if src != worker_id:  # own partition already kept in place
+                st.ingest_bytes(blob)
         self.local_shuffle()
 
     # -- consume ----------------------------------------------------------

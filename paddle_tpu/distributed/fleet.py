@@ -94,6 +94,37 @@ class _FleetUtil:
             self._store.delete(f"{key}/ack")
         return out.astype(arr.dtype, copy=False)
 
+    def all_to_all_bytes(self, blobs) -> list:
+        """Personalized all-to-all of raw byte blobs (``blobs[dst]`` goes
+        to rank dst; returns one received blob per src) — the transport
+        behind the dataset GLOBAL SHUFFLE (the reference redistributes
+        records worker→worker through GlooWrapper, data_set.cc
+        global_shuffle). Rides the coordination store: fine for the
+        control-plane-sized exchanges tests and moderate passes use; a
+        bulk-data deployment would point this at the PS TCP transport."""
+        enforce(len(blobs) == max(self._world, 1),
+                f"need one blob per rank ({self._world}), got {len(blobs)}")
+        if self._store is None or self._world <= 1:
+            return [blobs[0]]
+        import base64
+
+        rnd = self._round
+        self._round += 1
+        key = f"__fleet_util/a2a/{rnd}"
+        for dst, blob in enumerate(blobs):
+            self._store.set(f"{key}/{self._rank}->{dst}",
+                            base64.b64encode(blob).decode())
+        want = [f"{key}/{src}->{self._rank}" for src in range(self._world)]
+        self._store.wait(want)
+        out = [base64.b64decode(self._store.get(k)) for k in want]
+        # bounded store: last reader reaps the round's keys
+        if self._store.add(f"{key}/ack", 1) == self._world:
+            for src in range(self._world):
+                for dst in range(self._world):
+                    self._store.delete(f"{key}/{src}->{dst}")
+            self._store.delete(f"{key}/ack")
+        return out
+
     def barrier(self) -> None:
         if self._store is None or self._world <= 1:
             return

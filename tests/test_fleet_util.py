@@ -78,3 +78,64 @@ def test_util_identity_single_worker():
     v = np.asarray([3.0, 4.0])
     np.testing.assert_array_equal(f.util.all_reduce(v), v)
     f.util.barrier()  # no-op
+
+
+_SHUFFLE_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax; jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.data.dataset import InMemoryDataset, SlotDesc
+    from paddle_tpu.distributed.fleet import Fleet
+    from paddle_tpu.distributed.role_maker import UserDefinedRoleMaker, Role
+
+    rank = int(sys.argv[1]); world = int(sys.argv[2])
+    rm = UserDefinedRoleMaker(
+        current_id=rank, role=Role.WORKER, worker_num=world,
+        server_endpoints=["127.0.0.1:0"],
+        trainer_endpoints=[f"127.0.0.1:{6300+i}" for i in range(world)])
+    f = Fleet().init(rm)
+    f.init_worker()
+
+    slots = [SlotDesc("ids", is_float=False, max_len=1)]
+    lo, hi = rank * 50, rank * 50 + 50
+    ds = InMemoryDataset(slots, seed=rank)
+    ds.load_from_lines([f"1 {i}" for i in range(lo, hi)])
+    ds.global_shuffle(worker_id=rank, worker_num=world, util=f.util)
+    f.util.barrier()
+
+    # union across workers must be exactly 0..99: all_reduce a count
+    # histogram of the ids this worker now holds
+    ids = ds.pass_feasigns().astype(np.int64)
+    hist = np.bincount(ids, minlength=100).astype(np.float64)
+    total = f.util.all_reduce(hist, mode="sum")
+    assert total.shape[0] >= 100 and (total[:100] == 1.0).all(), total[:100]
+    f.util.barrier()
+    print("SHUFFLE_OK", rank, ds.num_records, flush=True)
+    f.stop_worker()
+""")
+
+
+def test_global_shuffle_across_processes(tmp_path):
+    world = 2
+    port = _free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, PADDLE_UTIL_STORE_PORT=str(port),
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    env.pop("XLA_FLAGS", None)
+    script = tmp_path / "worker.py"
+    script.write_text(_SHUFFLE_WORKER)
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(r), str(world)],
+        env=env, cwd=repo, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True) for r in range(world)]
+    try:
+        for r, p in enumerate(procs):
+            out, err = p.communicate(timeout=180)
+            assert p.returncode == 0, f"rank {r}:\n{err[-3000:]}"
+            assert f"SHUFFLE_OK {r}" in out, out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
