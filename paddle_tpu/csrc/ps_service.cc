@@ -607,19 +607,24 @@ struct PsServer {
         return respond(fd, h.n, nullptr, 0);
       }
       case kExport: {
+        // aux==1: export WITH insert-on-miss (the pass-build BuildPull
+        // from remote shards) — payload then carries [keys][slots i32]
         SparseRef t;
         if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
-        if (h.payload_len != static_cast<uint64_t>(h.n) * 8)
-          return respond(fd, kErrBadSize, nullptr, 0);
+        uint64_t want = static_cast<uint64_t>(h.n) * (h.aux ? 12 : 8);
+        if (h.payload_len != want) return respond(fd, kErrBadSize, nullptr, 0);
         int32_t fdim = t.full_dim();
         std::vector<char> out(static_cast<size_t>(h.n) * fdim * 4 + h.n);
         const uint64_t* keys = reinterpret_cast<const uint64_t*>(p);
+        const int32_t* slots =
+            h.aux ? reinterpret_cast<const int32_t*>(p + h.n * 8) : nullptr;
         float* vals = reinterpret_cast<float*>(out.data());
         uint8_t* found = reinterpret_cast<uint8_t*>(out.data() + h.n * fdim * 4);
         if (t.ssd)
-          sst_export(t.ssd, keys, nullptr, h.n, 0, vals, found);
+          sst_export(t.ssd, keys, slots, h.n, h.aux ? 1 : 0, vals, found);
         else
-          pstpu::table_export(t.mem, keys, h.n, vals, found);
+          pstpu::table_export(t.mem, keys, h.n, vals, found, h.aux ? 1 : 0,
+                              slots);
         return respond(fd, h.n, out.data(), out.size());
       }
       case kPushGeo: {

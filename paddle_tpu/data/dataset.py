@@ -182,34 +182,56 @@ class _RecordStore:
             parts.append(np.ascontiguousarray(gather).tobytes())
         return b"".join(parts)
 
-    def ingest_bytes(self, blob: bytes) -> int:
-        """Append records serialized by :meth:`extract_bytes` (slot
-        schemas must match). Returns the record count ingested."""
-        if not blob:  # empty partition
-            return 0
+    def _parse_record_blob(self, blob: bytes):
+        """Validate + decode one extract_bytes blob → (n, cols_v, cols_l).
+        A malformed transport result (truncated, reordered, echoed back)
+        must fail HERE, not as an IndexError in a later batch gather."""
         view = memoryview(blob)
+        enforce(len(blob) >= 4, "record blob too short for its header")
         (n,) = np.frombuffer(view[:4], np.uint32)
         o = 4
         cols_v, cols_l = {}, {}
         for s in self.slots:
+            enforce(o + 4 <= len(blob), f"record blob truncated at slot {s.name!r}")
             (nv,) = np.frombuffer(view[o:o + 4], np.uint32)
             o += 4
-            lens = np.frombuffer(view[o:o + 4 * n], np.int32)
+            lens = np.frombuffer(view[o:o + 4 * int(n)], np.int32)
             o += 4 * int(n)
             dtype = np.float32 if s.is_float else np.uint64
             nbytes = int(nv) * dtype().itemsize
+            enforce(o + nbytes <= len(blob),
+                    f"record blob truncated in slot {s.name!r} values")
+            enforce(len(lens) == int(n) and int(lens.sum()) == int(nv),
+                    f"record blob inconsistent for slot {s.name!r} "
+                    f"(lens sum {int(lens.sum()) if len(lens) == int(n) else '?'} "
+                    f"vs {int(nv)} values)")
             vals = np.frombuffer(view[o:o + nbytes], dtype)
             o += nbytes
             cols_v[s.name] = vals.copy()
             cols_l[s.name] = lens.copy()
-        if n:
-            for s in self.slots:
-                self._vals[s.name][0] = np.concatenate(
-                    [self._vals[s.name][0], cols_v[s.name]])
-                self._lens[s.name][0] = np.concatenate(
-                    [self._lens[s.name][0], cols_l[s.name]])
-            self.num_records += int(n)
-        return int(n)
+        enforce(o == len(blob), "record blob has trailing bytes")
+        return int(n), cols_v, cols_l
+
+    def ingest_bytes(self, blob: bytes) -> int:
+        """Append records serialized by :meth:`extract_bytes` (slot
+        schemas must match). Returns the record count ingested."""
+        return self.ingest_many([blob])
+
+    def ingest_many(self, blobs) -> int:
+        """Append records from several blobs with ONE concatenation per
+        slot column (the per-source repeated full-array copies would
+        dominate a many-worker shuffle)."""
+        parsed = [self._parse_record_blob(b) for b in blobs if b]
+        total = sum(n for n, _, _ in parsed)
+        if not total:
+            return 0
+        for s in self.slots:
+            self._vals[s.name][0] = np.concatenate(
+                [self._vals[s.name][0]] + [cv[s.name] for n, cv, _ in parsed if n])
+            self._lens[s.name][0] = np.concatenate(
+                [self._lens[s.name][0]] + [cl[s.name] for n, _, cl in parsed if n])
+        self.num_records += total
+        return total
 
     def keep_only(self, indices: np.ndarray) -> None:
         """Drop every record not in ``indices`` (order preserved)."""
@@ -315,7 +337,7 @@ class InMemoryDataset:
         if util is not None:
             # the util's bound rank/world are authoritative — mismatched
             # caller-supplied ids would silently lose/duplicate records
-            u_rank, u_world = util._rank, util._world
+            u_rank, u_world = util.rank, util.world_size
             enforce(worker_id in (0, u_rank) and worker_num in (1, u_world),
                     f"worker_id/num ({worker_id}/{worker_num}) contradict "
                     f"the bound util rank/world ({u_rank}/{u_world})")
@@ -337,9 +359,8 @@ class InMemoryDataset:
         enforce(len(received) == worker_num,
                 "exchange must return one blob per source worker")
         st.keep_only(np.flatnonzero(dest == worker_id))
-        for src, blob in enumerate(received):
-            if src != worker_id:  # own partition already kept in place
-                st.ingest_bytes(blob)
+        st.ingest_many(blob for src, blob in enumerate(received)
+                       if src != worker_id)  # own partition kept in place
         self.local_shuffle()
 
     # -- consume ----------------------------------------------------------

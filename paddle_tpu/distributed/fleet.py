@@ -65,6 +65,37 @@ class _FleetUtil:
         self._rank = rank
         self._world = world
 
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def world_size(self) -> int:
+        return self._world
+
+    def _store_round(self, tag: str, outgoing: Dict[str, str],
+                     want: List[str], all_keys: List[str]) -> List[str]:
+        """One store-mediated exchange round: set my ``outgoing`` values
+        (keys relative to the round namespace), wait for + read the
+        ``want`` keys, then last-reader-reaps ``all_keys`` (the round's
+        complete key set, same on every rank) — the bounded-store
+        protocol shared by all_reduce and all_to_all_bytes."""
+        rnd = self._round
+        self._round += 1
+        ns = f"__fleet_util/{tag}/{rnd}"
+        for k, v in outgoing.items():
+            self._store.set(f"{ns}/{k}", v)
+        want_full = [f"{ns}/{k}" for k in want]
+        self._store.wait(want_full)
+        out = [self._store.get(k) for k in want_full]
+        # bounded store: the last rank to finish reading reaps the
+        # round's keys (it knows everyone has read — their ack precedes)
+        if self._store.add(f"{ns}/ack", 1) == self._world:
+            for k in all_keys:
+                self._store.delete(f"{ns}/{k}")
+            self._store.delete(f"{ns}/ack")
+        return out
+
     def all_reduce(self, value, mode: str = "sum"):
         enforce(mode in self._REDUCERS, f"unknown reduce mode {mode!r}")
         if self._store is None or self._world <= 1:
@@ -72,26 +103,20 @@ class _FleetUtil:
         import base64
 
         arr = np.asarray(value)
-        rnd = self._round
-        self._round += 1
-        key = f"__fleet_util/ar/{rnd}"
         payload = base64.b64encode(arr.tobytes()).decode()
-        self._store.set(f"{key}/{self._rank}",
-                        f"{arr.dtype.str}|{','.join(map(str, arr.shape))}|{payload}")
-        self._store.wait([f"{key}/{r}" for r in range(self._world)])
+        ranks = [str(r) for r in range(self._world)]
+        got = self._store_round(
+            "ar",
+            {str(self._rank):
+                 f"{arr.dtype.str}|{','.join(map(str, arr.shape))}|{payload}"},
+            ranks, ranks)
         parts = []
-        for r in range(self._world):
-            dt, shp, data = self._store.get(f"{key}/{r}").split("|", 2)
+        for item in got:
+            dt, shp, data = item.split("|", 2)
             shape = tuple(int(s) for s in shp.split(",")) if shp else ()
             parts.append(np.frombuffer(
                 base64.b64decode(data), dtype=np.dtype(dt)).reshape(shape))
         out = self._REDUCERS[mode](np.stack(parts))
-        # bounded store: the last rank to finish reading reaps the round's
-        # keys (it knows everyone has read — their ack precedes its own)
-        if self._store.add(f"{key}/ack", 1) == self._world:
-            for r in range(self._world):
-                self._store.delete(f"{key}/{r}")
-            self._store.delete(f"{key}/ack")
         return out.astype(arr.dtype, copy=False)
 
     def all_to_all_bytes(self, blobs) -> list:
@@ -108,22 +133,14 @@ class _FleetUtil:
             return [blobs[0]]
         import base64
 
-        rnd = self._round
-        self._round += 1
-        key = f"__fleet_util/a2a/{rnd}"
-        for dst, blob in enumerate(blobs):
-            self._store.set(f"{key}/{self._rank}->{dst}",
-                            base64.b64encode(blob).decode())
-        want = [f"{key}/{src}->{self._rank}" for src in range(self._world)]
-        self._store.wait(want)
-        out = [base64.b64decode(self._store.get(k)) for k in want]
-        # bounded store: last reader reaps the round's keys
-        if self._store.add(f"{key}/ack", 1) == self._world:
-            for src in range(self._world):
-                for dst in range(self._world):
-                    self._store.delete(f"{key}/{src}->{dst}")
-            self._store.delete(f"{key}/ack")
-        return out
+        got = self._store_round(
+            "a2a",
+            {f"{self._rank}->{dst}": base64.b64encode(blob).decode()
+             for dst, blob in enumerate(blobs)},
+            [f"{src}->{self._rank}" for src in range(self._world)],
+            [f"{src}->{dst}" for src in range(self._world)
+             for dst in range(self._world)])
+        return [base64.b64decode(v) for v in got]
 
     def barrier(self) -> None:
         if self._store is None or self._world <= 1:

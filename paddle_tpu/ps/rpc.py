@@ -31,7 +31,8 @@ from .native import _ACCESSOR_IDS, _RULE_IDS, load_native
 from .table import (TableConfig, format_shard_row, merge_duplicate_keys,
                     parse_shard_row)
 
-__all__ = ["NativePsServer", "RpcPsClient", "rpc_available"]
+__all__ = ["NativePsServer", "RpcPsClient", "RemoteSparseTable",
+           "rpc_available"]
 
 # command ids (ps_service.cc Cmd enum)
 _CREATE_SPARSE = 1
@@ -482,19 +483,27 @@ class RpcPsClient(PSClient):
             total += len(keys)
         return total
 
-    def export_full(self, table_id, keys):
-        """(values [n, full_dim], found [n]) across servers."""
+    def export_full(self, table_id, keys, create=False, slots=None):
+        """(values [n, full_dim], found [n]) across servers. With
+        ``create``, missing rows are inserted server-side in the same
+        traversal (the multi-node pass-build BuildPull,
+        ps_gpu_wrapper.cc:299)."""
         keys = np.ascontiguousarray(keys, np.uint64)
         full_dim = self._dims(table_id)[2]
         out = np.zeros((len(keys), full_dim), np.float32)
         found = np.zeros(len(keys), bool)
+        slots_arr = (np.ascontiguousarray(slots, np.int32)
+                     if slots is not None else np.zeros(len(keys), np.int32))
         sv = self._route(keys)
         for s, c in enumerate(self._conns):
             sel = np.flatnonzero(sv == s)
             if not len(sel):
                 continue
+            payload = keys[sel].tobytes()
+            if create:
+                payload += slots_arr[sel].tobytes()
             _, resp = c.check(_EXPORT, table_id, n=len(sel),
-                              payload=keys[sel].tobytes())
+                              aux=1 if create else 0, payload=payload)
             nb = len(sel) * full_dim * 4
             out[sel] = np.frombuffer(resp[:nb], np.float32).reshape(len(sel), full_dim)
             found[sel] = np.frombuffer(resp[nb:], np.uint8).astype(bool)
@@ -517,3 +526,60 @@ class RpcPsClient(PSClient):
                 c.call(_STOP)
             except Exception:
                 pass
+
+
+class RemoteSparseTable:
+    """Table-shaped view over a sparse table living on RPC servers.
+
+    The adapter that makes the GPUPS pass path multi-node: the
+    HBM embedding cache and CtrPassTrainer consume the local Table API
+    (accessor metadata + export_full/import_full/pull/push/save/load);
+    this class serves that API from ``RpcPsClient`` — begin_pass's
+    insert-on-miss state export becomes the reference's BuildPull from
+    remote shards (ps_gpu_wrapper.cc:299: "multi-node: brpc to remote
+    shards"), end_pass's import_full the EndPass flush-back.
+
+    Construct after ``client.create_sparse_table(table_id, cfg)`` with
+    the same config (the accessor metadata must match the servers').
+    """
+
+    def __init__(self, client: RpcPsClient, table_id: int,
+                 config: TableConfig) -> None:
+        from .accessor import make_accessor
+
+        self._client = client
+        self._table_id = int(table_id)
+        self.config = config
+        self.accessor = make_accessor(config.accessor, config.accessor_config)
+
+    # -- the surface HbmEmbeddingCache / CtrPassTrainer consume ----------
+
+    def pull_sparse(self, keys, slots=None, create=True):
+        return self._client.pull_sparse(self._table_id, keys, create=create,
+                                        slots=slots)
+
+    def push_sparse(self, keys, push_values):
+        self._client.push_sparse(self._table_id, keys, push_values)
+
+    def export_full(self, keys, create=False, slots=None):
+        return self._client.export_full(self._table_id, keys, create=create,
+                                        slots=slots)
+
+    def import_full(self, keys, values):
+        self._client.import_full(self._table_id, keys, values)
+
+    def size(self) -> int:
+        return self._client.size(self._table_id)
+
+    def shrink(self) -> int:
+        return self._client.shrink(self._table_id)
+
+    def save(self, dirname: str, mode: int = 0) -> int:
+        return self._client.save(self._table_id, dirname, mode=mode)
+
+    def load(self, dirname: str) -> int:
+        return self._client.load(self._table_id, dirname)
+
+    @property
+    def full_dim(self) -> int:
+        return self._client._dims(self._table_id)[2]

@@ -425,3 +425,74 @@ def test_ssd_table_over_rpc(tmp_path):
     cli2.close()
     for s in servers2:
         s.stop()
+
+
+def test_pass_trainer_over_remote_table(tmp_path):
+    """Multi-node GPUPS: CtrPassTrainer's pass lifecycle served by TWO
+    RPC servers through RemoteSparseTable — begin_pass's insert-on-miss
+    state export is the reference's BuildPull from remote shards
+    (ps_gpu_wrapper.cc:299), end_pass the flush-back; the remote end
+    state matches a local-table run on identical data."""
+    import jax
+    import paddle_tpu as pt
+    import paddle_tpu.ps.rpc as rpc
+    from paddle_tpu import optimizer
+    from paddle_tpu.data.dataset import InMemoryDataset, SlotDesc
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.embedding_cache import CacheConfig
+    from paddle_tpu.ps.ps_trainer import CtrPassTrainer
+    from paddle_tpu.ps.rpc import RemoteSparseTable
+
+    S, D = 3, 2
+    acc = AccessorConfig(embedx_dim=4, embedx_threshold=0.0,
+                         sgd=SGDRuleConfig(initial_range=0.0))
+    cfg = TableConfig(shard_num=4, accessor_config=acc)
+    rng = np.random.default_rng(0)
+    lines = []
+    for _ in range(512):
+        ids = rng.integers(0, 48, S)
+        dense = rng.normal(size=D)
+        label = int((ids % 5 == 0).sum() + dense[0] > 1.0)
+        lines.append(" ".join([f"1 {v}" for v in ids]
+                              + [f"1 {v:.4f}" for v in dense]
+                              + [f"1 {label}"]))
+    slots = ([SlotDesc(f"s{i}", is_float=False, max_len=1) for i in range(S)]
+             + [SlotDesc(f"d{i}", is_float=True, max_len=1) for i in range(D)]
+             + [SlotDesc("label", is_float=True, max_len=1)])
+
+    def run(table):
+        pt.seed(0)
+        ds = InMemoryDataset(slots, seed=0)
+        ds.load_from_lines(lines)
+        tr = CtrPassTrainer(
+            DeepFM(CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=4,
+                             dnn_hidden=(8,))),
+            optimizer.Adam(1e-2), table,
+            CacheConfig(capacity=1 << 9, embedx_dim=4, embedx_threshold=0.0),
+            sparse_slots=[f"s{i}" for i in range(S)],
+            dense_slots=[f"d{i}" for i in range(D)], label_slot="label")
+        out = tr.train_from_dataset(ds, batch_size=128)
+        assert np.isfinite(out["loss"])
+        return out["loss"]
+
+    servers = [rpc.NativePsServer(n_trainers=1) for _ in range(2)]
+    cli = rpc.RpcPsClient([f"127.0.0.1:{s.port}" for s in servers])
+    cli.create_sparse_table(0, cfg)
+    remote = RemoteSparseTable(cli, 0, cfg)
+    loss_remote = run(remote)
+
+    local = MemorySparseTable(cfg)
+    loss_local = run(local)
+
+    np.testing.assert_allclose(loss_remote, loss_local, rtol=1e-5)
+    # end-of-pass table contents match across transports
+    probe = np.unique((rng.integers(0, 48, 400)
+                       + (rng.integers(0, S, 400).astype(np.uint64) << np.uint64(32))))
+    np.testing.assert_allclose(
+        cli.pull_sparse(0, probe, create=False),
+        local.pull_sparse(probe, create=False), atol=1e-5)
+    assert remote.size() == local.size()
+    cli.close()
+    for s in servers:
+        s.stop()
