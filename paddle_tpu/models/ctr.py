@@ -262,24 +262,36 @@ def make_ctr_pooled_train_step(
 
 
 def pack_ctr_batch(lo32: np.ndarray, dense: np.ndarray,
-                   labels: np.ndarray) -> np.ndarray:
+                   labels: np.ndarray,
+                   weights: Optional[np.ndarray] = None) -> np.ndarray:
     """Host side: one contiguous uint8 buffer per step —
-    [lo32 u32 | dense f16 | labels i8] — so the H2D path pays ONE
-    transfer + dispatch instead of three (the tunnel link's per-transfer
-    overhead is material at sub-ms step times, MEASURED.md). Shapes are
-    checked: a transposed array would repack to the same byte count and
-    silently scramble examples."""
+    [lo32 u32 | dense f16 | labels i8 | weights u8?] — so the H2D path
+    pays ONE transfer + dispatch instead of three or four (the tunnel
+    link's per-transfer overhead is material at sub-ms step times,
+    MEASURED.md). ``weights`` (0/1 tail-padding mask) is optional; the
+    unpacking step must be built with the matching ``with_weights``.
+    Shapes are checked: a transposed array would repack to the same
+    byte count and silently scramble examples."""
     B = labels.shape[0]
     enforce(lo32.ndim == 2 and lo32.shape[0] == B,
             f"lo32 must be [B={B}, S], got {lo32.shape}")
     enforce(dense.ndim == 2 and dense.shape[0] == B,
             f"dense must be [B={B}, D], got {dense.shape}")
     # single host copy: byte views concatenated once, no bytes objects
-    return np.concatenate([
+    parts = [
         np.ascontiguousarray(lo32, np.uint32).view(np.uint8).ravel(),
         np.ascontiguousarray(dense, np.float16).view(np.uint8).ravel(),
         np.ascontiguousarray(labels, np.int8).view(np.uint8).ravel(),
-    ])
+    ]
+    if weights is not None:
+        enforce(weights.shape == (B,), f"weights must be [B={B}]")
+        w = np.asarray(weights)
+        # the u8 wire column carries the 0/1 tail-padding MASK only —
+        # fractional importance weights would silently floor to 0
+        enforce(bool(((w == 0) | (w == 1)).all()),
+                "packed weights must be a 0/1 padding mask")
+        parts.append(np.ascontiguousarray(w, np.uint8).ravel())
+    return np.concatenate(parts)
 
 
 def make_ctr_train_step_packed(
@@ -289,6 +301,7 @@ def make_ctr_train_step_packed(
     slot_ids,
     batch_size: int,
     num_dense: int,
+    with_weights: bool = False,
     donate: bool = True,
 ) -> Callable:
     """The from-keys GPUPS step over a SINGLE packed wire buffer
@@ -305,16 +318,19 @@ def make_ctr_train_step_packed(
     B, S, D = int(batch_size), int(slot_hi.shape[0]), int(num_dense)
     o_dense = B * S * 4
     o_label = o_dense + B * D * 2
-    total = o_label + B
+    o_weight = o_label + B
+    total = o_weight + (B if with_weights else 0)
 
-    def step(params, opt_state, cache_state, map_state, packed,
-             weights=None):
+    def step(params, opt_state, cache_state, map_state, packed):
         enforce_eq(packed.shape[0], total, "packed batch size")
         lo = lax.bitcast_convert_type(
             packed[:o_dense].reshape(B * S, 4), jnp.uint32)
         dense_x = lax.bitcast_convert_type(
             packed[o_dense:o_label].reshape(B, D, 2), jnp.float16)
-        labels = lax.bitcast_convert_type(packed[o_label:], jnp.int8)
+        labels = lax.bitcast_convert_type(
+            packed[o_label:o_weight], jnp.int8)
+        weights = (packed[o_weight:].astype(jnp.float32)
+                   if with_weights else None)
         hi = jnp.broadcast_to(slot_hi[None, :], (B, S)).reshape(-1)
         rows = _lookup_rows(cache_state, map_state, hi, lo)
         return _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,

@@ -20,7 +20,7 @@ layout — FleetWrapper::PullSparseToTensorSync tags by tensor position).
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Iterable, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -109,8 +109,6 @@ class CtrPassTrainer:
         label_slot: str,
         prefetch_depth: int = 3,
     ) -> None:
-        from ..models.ctr import make_ctr_train_step_from_keys
-
         self.model = model
         self.optimizer = optimizer
         self.table = table
@@ -122,9 +120,22 @@ class CtrPassTrainer:
 
         self.params = {"params": dict(model.named_parameters()), "buffers": {}}
         self.opt_state = optimizer.init(self.params)
-        self._step = make_ctr_train_step_from_keys(
-            model, optimizer, cache_config,
-            slot_ids=np.arange(len(self.sparse_slots)))
+        # one compiled step per batch size (packed single-buffer wire:
+        # offsets bake B in); train_from_dataset reuses across passes
+        self._packed_steps: Dict[int, Any] = {}
+
+    def _packed_step(self, batch_size: int):
+        from ..models.ctr import make_ctr_train_step_packed
+
+        step = self._packed_steps.get(batch_size)
+        if step is None:
+            step = make_ctr_train_step_packed(
+                self.model, self.optimizer, self.cache.config,
+                slot_ids=np.arange(len(self.sparse_slots)),
+                batch_size=batch_size, num_dense=len(self.dense_slots),
+                with_weights=True)
+            self._packed_steps[batch_size] = step
+        return step
 
     # -- batch packing (MiniBatchGpuPack role) ---------------------------
 
@@ -277,18 +288,26 @@ class CtrPassTrainer:
             self.cache.begin_pass(keys)
         map_state = self.cache.device_map.state
 
+        from ..models.ctr import pack_ctr_batch
+
+        step = self._packed_step(batch_size)
+
         def host_batches():
             for batch in dataset.batch_iter(batch_size, drop_last=drop_last):
                 lo32, dense, labels = self._pack(batch)
                 n_real = lo32.shape[0]  # pre-pad count (host-side)
                 # fixed step shape: pad the tail batch instead of
-                # recompiling (weights mask loss + pushes)
-                yield _pad_tail(lo32, dense, labels, batch_size) + (n_real,)
+                # recompiling (weights mask loss + pushes); ONE packed
+                # buffer per step (lo32 | f16 dense | i8 labels | u8
+                # weights) — single H2D transfer on the tunnel
+                lo32, dense, labels, weights = _pad_tail(
+                    lo32, dense, labels, batch_size)
+                yield pack_ctr_batch(lo32, dense, labels,
+                                     weights=weights), n_real
 
         def to_device(item):
-            lo32, dense, labels, weights, n_real = item
-            return (jnp.asarray(lo32), jnp.asarray(dense),
-                    jnp.asarray(labels), jnp.asarray(weights), n_real)
+            packed, n_real = item
+            return jnp.asarray(packed), n_real
 
         stats = _PassStats()
         t0 = time.perf_counter()
@@ -296,12 +315,11 @@ class CtrPassTrainer:
                               transform=to_device)
         losses = []  # device scalars — ONE host sync at pass end
         try:
-            for lo32, dense, labels, weights, n_real in pf:
+            for packed, n_real in pf:
                 with RecordEvent("ctr_train_step"):
                     self.params, self.opt_state, self.cache.state, loss = \
-                        self._step(self.params, self.opt_state,
-                                   self.cache.state, map_state, lo32, dense,
-                                   labels, weights=weights)
+                        step(self.params, self.opt_state,
+                             self.cache.state, map_state, packed)
                 losses.append(loss)
                 stats.steps += 1
                 stats.samples += n_real  # host count — no device sync

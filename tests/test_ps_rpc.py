@@ -487,7 +487,7 @@ def test_pass_trainer_over_remote_table(tmp_path):
 
     np.testing.assert_allclose(loss_remote, loss_local, rtol=1e-5)
     # end-of-pass table contents match across transports
-    probe = np.unique((rng.integers(0, 48, 400)
+    probe = np.unique((rng.integers(0, 48, 400).astype(np.uint64)
                        + (rng.integers(0, S, 400).astype(np.uint64) << np.uint64(32))))
     np.testing.assert_allclose(
         cli.pull_sparse(0, probe, create=False),
