@@ -277,10 +277,19 @@ def pack_ctr_batch(lo32: np.ndarray, dense: np.ndarray,
             f"lo32 must be [B={B}, S], got {lo32.shape}")
     enforce(dense.ndim == 2 and dense.shape[0] == B,
             f"dense must be [B={B}, D], got {dense.shape}")
+    # f16 wire: fine for normalized CTR features (Criteo's are
+    # log-transformed); an unnormalized column overflowing f16 must fail
+    # HERE, loudly, not as a silent inf/NaN pass downstream
+    with np.errstate(over="ignore"):  # overflow handled by the enforce
+        dense16 = np.ascontiguousarray(dense, np.float16)
+    enforce(bool(np.isfinite(dense16).all())
+            or not bool(np.isfinite(np.asarray(dense)).all()),
+            "dense features overflow the f16 wire format (|x| > 65504); "
+            "normalize them or widen the wire")
     # single host copy: byte views concatenated once, no bytes objects
     parts = [
         np.ascontiguousarray(lo32, np.uint32).view(np.uint8).ravel(),
-        np.ascontiguousarray(dense, np.float16).view(np.uint8).ravel(),
+        dense16.view(np.uint8).ravel(),
         np.ascontiguousarray(labels, np.int8).view(np.uint8).ravel(),
     ]
     if weights is not None:

@@ -256,3 +256,16 @@ def test_packed_step_matches_from_keys(rng):
     for k in st1:
         np.testing.assert_array_equal(np.asarray(st2[k]), np.asarray(st1[k]),
                                       err_msg=k)
+
+
+def test_packed_wire_rejects_f16_overflow(rng):
+    from paddle_tpu.models.ctr import pack_ctr_batch
+
+    lo32 = rng.integers(0, 100, size=(4, 2)).astype(np.uint32)
+    labels = np.zeros(4, np.int8)
+    ok = rng.normal(size=(4, 3)).astype(np.float32)
+    pack_ctr_batch(lo32, ok, labels)  # fine
+    bad = ok.copy()
+    bad[1, 2] = 1e6  # overflows f16
+    with pytest.raises(Exception, match="f16 wire"):
+        pack_ctr_batch(lo32, bad, labels)
