@@ -88,8 +88,11 @@ class DeviceKeyMap:
     threads through, for donation).
     """
 
-    def __init__(self, keys: np.ndarray, rows: np.ndarray,
-                 sharding=None) -> None:
+    @staticmethod
+    def build_host(keys: np.ndarray, rows: np.ndarray):
+        """Host-only cuckoo build (the pre_build_thread half): returns
+        the host arrays to upload later. Touches no device state, so it
+        can run in a background thread while the previous pass trains."""
         from .native import native_available
 
         if not native_available():
@@ -110,14 +113,26 @@ class DeviceKeyMap:
                 last_err = e
         else:
             raise RuntimeError(f"cuckoo build failed for {n} keys: {last_err}")
-        self.nbuckets = nb
+        return {"hi": hi.reshape(nb, 4), "lo": lo.reshape(nb, 4),
+                "row": row.reshape(nb, 4), "seed": np.uint32(seed), "nb": nb}
+
+    def __init__(self, keys: Optional[np.ndarray] = None,
+                 rows: Optional[np.ndarray] = None,
+                 sharding=None, host_built=None) -> None:
+        # exactly one construction path: fresh (keys, rows) OR a
+        # prebuilt host table — passing both invites a mismatched pair
+        enforce((host_built is None) != (keys is None),
+                "pass either keys/rows or host_built, not both")
+        built = host_built if host_built is not None else \
+            self.build_host(keys, rows)
+        self.nbuckets = built["nb"]
         put = (lambda a: jax.device_put(a, sharding)) if sharding is not None \
             else jnp.asarray
         self.state: Dict[str, jax.Array] = {
-            "hi": put(hi.reshape(nb, 4)),
-            "lo": put(lo.reshape(nb, 4)),
-            "row": put(row.reshape(nb, 4)),
-            "seed": jnp.asarray(np.uint32(seed)),
+            "hi": put(built["hi"]),
+            "lo": put(built["lo"]),
+            "row": put(built["row"]),
+            "seed": jnp.asarray(built["seed"]),
         }
 
     def lookup(self, keys_hi: jax.Array, keys_lo: jax.Array) -> jax.Array:

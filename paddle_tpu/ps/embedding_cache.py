@@ -237,17 +237,43 @@ class HbmEmbeddingCache:
 
     # -- pass lifecycle ---------------------------------------------------
 
-    def begin_pass(self, keys: np.ndarray) -> int:
-        """PreBuildTask + BuildPull + BuildGPUTask: dedup the pass's keys,
-        pull current values from the host table, upload the working set."""
+    def prepare_pass(self, keys: np.ndarray) -> dict:
+        """The HOST-ONLY half of begin_pass (the reference's
+        pre_build_thread work, ps_gpu_wrapper.cc:733: dedup + row
+        assignment + cuckoo build): touches neither the table nor device
+        state, so it can run in a background thread while the PREVIOUS
+        pass trains. Activate with :meth:`activate_pass` after the
+        previous end_pass — table values are only read then, so the
+        overlap changes nothing numerically."""
         cfg = self.config
         from .native import dedup_u64
 
         uniq = dedup_u64(keys)  # parallel PreBuildTask-style dedup
-        enforce_le(len(uniq), cfg.capacity, "pass working set exceeds cache capacity")
-        self._index = FeasignIndex(len(uniq) * 2)
-        rows, _ = self._index.lookup_or_insert(uniq)
+        enforce_le(len(uniq), cfg.capacity,
+                   "pass working set exceeds cache capacity")
+        index = FeasignIndex(len(uniq) * 2)
+        rows, _ = index.lookup_or_insert(uniq)
         rows = self._spread(rows)
+        prepared = {"uniq": uniq, "index": index, "rows": rows,
+                    "map_host": None}
+        if self._device_map_enabled:
+            from .device_hash import DeviceKeyMap
+
+            prepared["map_host"] = DeviceKeyMap.build_host(uniq, rows)
+        return prepared
+
+    def begin_pass(self, keys: np.ndarray) -> int:
+        """PreBuildTask + BuildPull + BuildGPUTask: dedup the pass's keys,
+        pull current values from the host table, upload the working set."""
+        return self.activate_pass(self.prepare_pass(keys))
+
+    def activate_pass(self, prepared: dict) -> int:
+        """The device half of begin_pass: export current table values
+        for the prepared key set (insert-on-miss) and upload the working
+        set + key map."""
+        cfg = self.config
+        uniq, rows = prepared["uniq"], prepared["rows"]
+        self._index = prepared["index"]
         self._pass_keys = uniq
 
         # ONE shard traversal creates missing features and exports full
@@ -289,7 +315,8 @@ class HbmEmbeddingCache:
 
                 map_sharding = NamedSharding(self._sharding.mesh,
                                              PartitionSpec())
-            self.device_map = DeviceKeyMap(uniq, rows, sharding=map_sharding)
+            self.device_map = DeviceKeyMap(
+                sharding=map_sharding, host_built=prepared["map_host"])
 
         if self._sharding is not None:
             self.state = {

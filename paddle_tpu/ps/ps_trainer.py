@@ -280,12 +280,64 @@ class CtrPassTrainer:
         """One pass over ``dataset``: begin_pass → steps → end_pass.
         Returns {'loss': mean step loss, 'steps', 'samples',
         'samples_per_sec'}."""
+        return self._run_pass(dataset, None, batch_size, drop_last)
+
+    def train_passes(self, datasets: Iterable, batch_size: int = 512,
+                     drop_last: bool = True) -> list:
+        """Multi-day stream: train each dataset as one pass, OVERLAPPING
+        the next pass's host build (dedup + row assignment + cuckoo —
+        cache.prepare_pass) with the current pass's training, the
+        reference's pre_build_thread pattern (ps_gpu_wrapper.cc:733).
+        Table reads/uploads still happen at the pass boundary, so
+        results are identical to sequential train_from_dataset calls."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        _END = object()
+
+        it = iter(datasets)
+        try:
+            current = next(it)
+        except StopIteration:
+            return []
+        prepared = self._prepare(current)
+        results = []
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            while True:
+                # the background task also PULLS the next dataset: a lazy
+                # day-loading generator overlaps its IO with training too
+                def _bg():
+                    try:
+                        ds = next(it)
+                    except StopIteration:
+                        return _END
+                    return ds, self._prepare(ds)
+
+                fut = pool.submit(_bg)
+                try:
+                    results.append(self._run_pass(current, prepared,
+                                                  batch_size, drop_last))
+                finally:
+                    # never leave a prepare thread running past an
+                    # exception (it holds native calls mid-flight)
+                    nxt = fut.result()
+                if nxt is _END:
+                    return results
+                current, prepared = nxt
+
+    def _prepare(self, dataset) -> dict:
+        with RecordEvent("ctr_pass_prepare"):
+            keys = self._tagged_pass_keys(dataset)
+            enforce(len(keys) > 0, "dataset has no sparse feasigns")
+            return self.cache.prepare_pass(keys)
+
+    def _run_pass(self, dataset, prepared: Optional[dict],
+                  batch_size: int, drop_last: bool) -> Dict[str, float]:
         import time
 
         with RecordEvent("ctr_pass_build"):  # PreBuildTask..BuildGPUTask
-            keys = self._tagged_pass_keys(dataset)
-            enforce(len(keys) > 0, "dataset has no sparse feasigns")
-            self.cache.begin_pass(keys)
+            if prepared is None:
+                prepared = self._prepare(dataset)
+            self.cache.activate_pass(prepared)
         map_state = self.cache.device_map.state
 
         from ..models.ctr import pack_ctr_batch

@@ -441,3 +441,42 @@ def test_evaluate_wuauc(rng):
     out = tr.evaluate(ds, user_slot="s0")  # slot 0 doubles as the uid
     assert 0.0 <= out["wuauc"] <= 1.0
     assert out["wuauc"] > 0.5  # learned signal ranks within users too
+
+
+def test_train_passes_overlapped_matches_sequential(rng):
+    """train_passes (background next-pass prepare, the pre_build_thread
+    pattern) must produce bit-identical table state to sequential
+    train_from_dataset calls over the same day stream."""
+    def build():
+        pt.seed(0)
+        table = MemorySparseTable(TableConfig(
+            shard_num=4, accessor_config=AccessorConfig(
+                embedx_dim=4, embedx_threshold=0.0)))
+        tr = CtrPassTrainer(
+            DeepFM(CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=4,
+                             dnn_hidden=(8,))),
+            optimizer.Adam(1e-2), table,
+            CacheConfig(capacity=1 << 10, embedx_dim=4,
+                        embedx_threshold=0.0),
+            sparse_slots=[f"s{i}" for i in range(S)],
+            dense_slots=[f"d{i}" for i in range(D)], label_slot="label")
+        return table, tr
+
+    days = []
+    for day in range(3):
+        day_rng = np.random.default_rng(100 + day)
+        ds = InMemoryDataset(_slots(), seed=day)
+        ds.load_from_lines(_lines(day_rng, 384, vocab=48))
+        days.append(ds)
+
+    t1, tr1 = build()
+    r1 = tr1.train_passes(days, batch_size=128)
+    t2, tr2 = build()
+    r2 = [tr2.train_from_dataset(d, batch_size=128) for d in days]
+
+    for a, b in zip(r1, r2):
+        np.testing.assert_allclose(a["loss"], b["loss"], rtol=1e-6)
+    probe = np.arange(0, 5000, dtype=np.uint64)
+    np.testing.assert_array_equal(t1.pull_sparse(probe, create=False),
+                                  t2.pull_sparse(probe, create=False))
+    assert len(r1) == 3
