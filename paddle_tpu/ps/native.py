@@ -119,6 +119,28 @@ def cuckoo_build(keys: np.ndarray, rows: np.ndarray, nbuckets: int,
     return hi, lo, row
 
 
+def table_native_params(shard_num: int, accessor: str, acc_cfg,
+                        seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(iparams i32[6], fparams f32[17]) for the native table ABI — the
+    ONE definition of the layout `pstpu::parse_table_config`
+    (csrc/sparse_table.h) reads, shared by the in-process engines and
+    the RPC create payload. ``acc_cfg`` is an AccessorConfig."""
+    sgd = acc_cfg.sgd
+    ip = np.asarray(
+        [shard_num, _ACCESSOR_IDS[accessor], acc_cfg.embedx_dim,
+         _RULE_IDS[acc_cfg.embed_sgd_rule], _RULE_IDS[acc_cfg.embedx_sgd_rule],
+         seed], np.int32)
+    fp = np.asarray(
+        [acc_cfg.nonclk_coeff, acc_cfg.click_coeff, acc_cfg.base_threshold,
+         acc_cfg.delta_threshold, acc_cfg.delta_keep_days,
+         acc_cfg.show_click_decay_rate, acc_cfg.delete_threshold,
+         acc_cfg.delete_after_unseen_days, acc_cfg.embedx_threshold,
+         sgd.learning_rate, sgd.initial_g2sum, sgd.initial_range,
+         sgd.weight_bounds[0], sgd.weight_bounds[1],
+         sgd.beta1, sgd.beta2, sgd.ada_epsilon], np.float32)
+    return ip, fp
+
+
 def dedup_u64(keys: np.ndarray, n_threads: Optional[int] = None) -> np.ndarray:
     """Parallel distinct-keys extraction (the PreBuildTask 16-thread shard
     dedup, ps_gpu_wrapper.cc:92): hash-partitioned bucket dedup across
@@ -422,9 +444,8 @@ class NativeSparseTableEngine:
     math in native code. Raises RuntimeError if the native lib is
     unavailable — callers fall back to the Python shards."""
 
-    def __init__(self, shard_num: int, accessor: str, embedx_dim: int,
-                 embed_rule: str, embedx_rule: str, seed: int,
-                 lifecycle: Tuple[float, ...], sgd: Tuple[float, ...]) -> None:
+    def __init__(self, shard_num: int, accessor: str, acc_cfg,
+                 seed: int) -> None:
         self._lib = load_native()
         if self._lib is None:
             raise RuntimeError("native library unavailable")
@@ -434,11 +455,8 @@ class NativeSparseTableEngine:
             except AttributeError as e:  # stale .so without pst_* symbols
                 raise RuntimeError(f"native library lacks sparse-table symbols: {e}")
             self._lib._pst_configured = True
-        iparams = np.asarray(
-            [shard_num, _ACCESSOR_IDS[accessor], embedx_dim,
-             _RULE_IDS[embed_rule], _RULE_IDS[embedx_rule], seed], np.int32)
-        fparams = np.asarray(list(lifecycle) + list(sgd), np.float32)
-        assert len(fparams) == 17, len(fparams)
+        iparams, fparams = table_native_params(shard_num, accessor, acc_cfg,
+                                               seed)
         self._h = self._lib.pst_create(_i32(iparams), _f32(fparams))
         self._save_lock = threading.Lock()  # begin/fetch must not interleave
         self.pull_dim = int(self._lib.pst_pull_dim(self._h))
@@ -561,10 +579,8 @@ class SsdTableEngine:
     spill/compact/stats/load_cold. Native-only — there is no Python
     fallback for the disk tier."""
 
-    def __init__(self, shard_num: int, accessor: str, embedx_dim: int,
-                 embed_rule: str, embedx_rule: str, seed: int,
-                 lifecycle: Tuple[float, ...], sgd: Tuple[float, ...],
-                 path: str) -> None:
+    def __init__(self, shard_num: int, accessor: str, acc_cfg,
+                 seed: int, path: str) -> None:
         self._lib = load_native()
         if self._lib is None:
             raise RuntimeError("native library unavailable")
@@ -574,11 +590,8 @@ class SsdTableEngine:
             except AttributeError as e:  # stale .so without sst_* symbols
                 raise RuntimeError(f"native library lacks ssd-table symbols: {e}")
             self._lib._sst_configured = True
-        iparams = np.asarray(
-            [shard_num, _ACCESSOR_IDS[accessor], embedx_dim,
-             _RULE_IDS[embed_rule], _RULE_IDS[embedx_rule], seed], np.int32)
-        fparams = np.asarray(list(lifecycle) + list(sgd), np.float32)
-        assert len(fparams) == 17, len(fparams)
+        iparams, fparams = table_native_params(shard_num, accessor, acc_cfg,
+                                               seed)
         self._h = self._lib.sst_create(_i32(iparams), _f32(fparams),
                                        str(path).encode())
         if not self._h:

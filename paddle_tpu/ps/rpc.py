@@ -27,7 +27,7 @@ from ..core.enforce import NotFoundError, PreconditionNotMetError, enforce
 from ..core.profiler import RecordEvent
 from .accessor import AccessorConfig
 from .client import PSClient
-from .native import _ACCESSOR_IDS, _RULE_IDS, load_native
+from .native import load_native, table_native_params
 from .table import (TableConfig, format_shard_row, merge_duplicate_keys,
                     parse_shard_row)
 
@@ -183,18 +183,9 @@ class _ServerConn:
 
 
 def _sparse_config_payload(cfg: TableConfig) -> bytes:
-    acc = cfg.accessor_config or AccessorConfig()
-    sgd = acc.sgd
-    ip = np.asarray([cfg.shard_num, _ACCESSOR_IDS[cfg.accessor], acc.embedx_dim,
-                     _RULE_IDS[acc.embed_sgd_rule], _RULE_IDS[acc.embedx_sgd_rule],
-                     cfg.seed], np.int32)
-    fp = np.asarray([acc.nonclk_coeff, acc.click_coeff, acc.base_threshold,
-                     acc.delta_threshold, acc.delta_keep_days,
-                     acc.show_click_decay_rate, acc.delete_threshold,
-                     acc.delete_after_unseen_days, acc.embedx_threshold,
-                     sgd.learning_rate, sgd.initial_g2sum, sgd.initial_range,
-                     sgd.weight_bounds[0], sgd.weight_bounds[1],
-                     sgd.beta1, sgd.beta2, sgd.ada_epsilon], np.float32)
+    ip, fp = table_native_params(cfg.shard_num, cfg.accessor,
+                                 cfg.accessor_config or AccessorConfig(),
+                                 cfg.seed)
     return ip.tobytes() + fp.tobytes()
 
 

@@ -239,21 +239,10 @@ class MemorySparseTable:
         )
         self._native: Optional[NativeSparseTableEngine] = None
         if self.config.backend in ("auto", "native"):
-            acc = self.accessor.config
-            sgd = acc.sgd
             try:
                 self._native = NativeSparseTableEngine(
-                    self.config.shard_num, self.config.accessor, acc.embedx_dim,
-                    acc.embed_sgd_rule, acc.embedx_sgd_rule, self.config.seed,
-                    lifecycle=(acc.nonclk_coeff, acc.click_coeff,
-                               acc.base_threshold, acc.delta_threshold,
-                               acc.delta_keep_days, acc.show_click_decay_rate,
-                               acc.delete_threshold, acc.delete_after_unseen_days,
-                               acc.embedx_threshold),
-                    sgd=(sgd.learning_rate, sgd.initial_g2sum, sgd.initial_range,
-                         sgd.weight_bounds[0], sgd.weight_bounds[1],
-                         sgd.beta1, sgd.beta2, sgd.ada_epsilon),
-                )
+                    self.config.shard_num, self.config.accessor,
+                    self.accessor.config, self.config.seed)
             except (RuntimeError, KeyError):
                 if self.config.backend == "native":
                     raise
@@ -535,22 +524,10 @@ class SsdSparseTable(MemorySparseTable):
         self.accessor = make_accessor(
             self.config.accessor, self.config.accessor_config
         )
-        acc = self.accessor.config
-        sgd = acc.sgd
         # native-only: the disk tier has no Python fallback
         self._native = SsdTableEngine(
-            self.config.shard_num, self.config.accessor, acc.embedx_dim,
-            acc.embed_sgd_rule, acc.embedx_sgd_rule, self.config.seed,
-            lifecycle=(acc.nonclk_coeff, acc.click_coeff,
-                       acc.base_threshold, acc.delta_threshold,
-                       acc.delta_keep_days, acc.show_click_decay_rate,
-                       acc.delete_threshold, acc.delete_after_unseen_days,
-                       acc.embedx_threshold),
-            sgd=(sgd.learning_rate, sgd.initial_g2sum, sgd.initial_range,
-                 sgd.weight_bounds[0], sgd.weight_bounds[1],
-                 sgd.beta1, sgd.beta2, sgd.ada_epsilon),
-            path=self.path,
-        )
+            self.config.shard_num, self.config.accessor,
+            self.accessor.config, self.config.seed, path=self.path)
         self._shards = []
         self._pool = None
 
