@@ -80,3 +80,36 @@ def test_ring_attention_backward_matches_full(mesh, qkv):
     )(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
     for g, rg in zip(grads, ref_grads):
         np.testing.assert_allclose(np.asarray(g), np.asarray(rg), rtol=3e-4, atol=3e-5)
+
+
+@pytest.mark.slow
+def test_ring_attention_long_sequence_cp8():
+    """Long-context: a 2048-token causal sequence over the full 8-way cp
+    axis (256 tokens/rank) still matches full attention — the scale
+    regime the ring exists for, not just the toy lengths above. Also
+    runs fwd+bwd so the rotation's VJP is exercised at length."""
+    mesh8 = mesh_mod.make_mesh({"cp": 8})
+    Bl, Ll, Hl, Dl = 1, 2048, 4, 32
+    rng = np.random.default_rng(1)
+    q, k, v = (jnp.asarray(rng.normal(size=(Bl, Ll, Hl, Dl)), jnp.float32)
+               for _ in range(3))
+
+    # differentiate w.r.t. ALL of q, k, v — the k/v cotangents flow
+    # through the ppermute rotation's transpose, the path this test
+    # exists to pin at length
+    ref_loss, ref_grads = jax.value_and_grad(
+        lambda q, k, v: jnp.sum(local_attention(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+
+    ring = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis="cp", causal=True),
+        mesh=mesh8,
+        in_specs=(P(None, "cp"), P(None, "cp"), P(None, "cp")),
+        out_specs=P(None, "cp"))
+    loss, grads = jax.jit(jax.value_and_grad(
+        lambda q, k, v: jnp.sum(ring(q, k, v) ** 2),
+        argnums=(0, 1, 2)))(q, k, v)
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+    for g, rg in zip(grads, ref_grads):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(rg),
+                                   rtol=2e-3, atol=2e-3)
