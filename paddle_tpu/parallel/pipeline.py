@@ -167,6 +167,11 @@ class PipelineTrainer:
       ``num_virtual`` chunks per rank (pipeline_parallel.py:30 dygraph
       interleave); model must supply ``pp × num_virtual`` stages and
       num_micro must divide by the pp size.
+
+    When the mesh has a ``dp_axis`` axis, each micro-batch SHARDS over
+    it and the loss is the mean of the per-shard means — ``loss_fn``
+    must therefore be a per-batch MEAN reduction (sum-style losses
+    would silently scale by 1/dp). Single-axis meshes replicate.
     """
 
     def __init__(
@@ -180,6 +185,7 @@ class PipelineTrainer:
         seed: int = 0,
         schedule: str = "f_then_b",
         num_virtual: int = 1,
+        dp_axis: str = "dp",
     ) -> None:
         enforce(pp_axis in mesh.shape, f"mesh lacks {pp_axis!r} axis")
         enforce(schedule in ("f_then_b", "1f1b", "interleave"),
@@ -216,6 +222,18 @@ class PipelineTrainer:
             out, _ = nn.functional_call(model._sub_layers["head"], state, y, training=True)
             return out
 
+        # batch parallelism: when the mesh has the dp axis, micro-batches
+        # shard over it (dim 1 of [M, micro, ...]) instead of every dp
+        # rank redundantly computing the full batch
+        dp_axis = dp_axis if dp_axis in mesh.shape else None
+        dp_n = mesh.shape.get(dp_axis, 1) if dp_axis else 1
+        self._dp_n = dp_n
+        data_spec = P(None, dp_axis) if dp_axis else P()
+
+        def global_mean(local):
+            # local = mean over this rank's batch shard (equal sizes)
+            return (lax.psum(local / dp_n, dp_axis) if dp_axis else local)
+
         if schedule == "f_then_b":
             pipe = pipeline_spmd_fn(
                 stage_apply, S, num_micro, pp_axis,
@@ -230,7 +248,7 @@ class PipelineTrainer:
                     preds = pipe(params["stages"], params["aux"], x_micro)
                 # mean over micro-batches of per-micro loss
                 losses = jax.vmap(loss_fn)(preds, y_micro)
-                return jnp.mean(losses)
+                return global_mean(jnp.mean(losses))
 
             stage_specs = jax.tree_util.tree_map(lambda _: P(pp_axis), stacked)
             aux_specs = jax.tree_util.tree_map(lambda _: P(), aux)
@@ -239,7 +257,7 @@ class PipelineTrainer:
             grad_fn = shard_map(
                 jax.value_and_grad(spmd_loss),
                 mesh=mesh,
-                in_specs=(param_specs, P(), P(), P()),
+                in_specs=(param_specs, data_spec, data_spec, P()),
                 out_specs=(P(), param_specs),
             )
 
@@ -266,12 +284,18 @@ class PipelineTrainer:
                 with nn.rng_guard(key):
                     loss, g_stage, g_aux = pipe(
                         chunk_state, params_vs["aux"], x_micro, y_micro)
-                # loss/aux grads live on single ranks — replicate by psum
-                loss = lax.psum(loss, pp_axis)
+                # loss/aux grads live on single pp ranks — replicate by
+                # psum; explicit grads also need the dp batch reduction
+                # the f_then_b path gets implicitly from autodiff
+                loss = global_mean(lax.psum(loss, pp_axis))
+                dp_axes = (pp_axis,) + ((dp_axis,) if dp_axis else ())
                 g_aux = jax.tree_util.tree_map(
-                    lambda g: lax.psum(g, pp_axis) / M, g_aux)
+                    lambda g: lax.psum(g, dp_axes) / (M * dp_n), g_aux)
+                if dp_axis:
+                    g_stage = jax.tree_util.tree_map(
+                        lambda g: lax.psum(g, dp_axis), g_stage)
                 g_stage = jax.tree_util.tree_map(
-                    lambda g: g[:, None] / M, g_stage)
+                    lambda g: g[:, None] / (M * dp_n), g_stage)
                 return loss, {"stages": g_stage, "aux": g_aux}
 
             stage_specs_vs = jax.tree_util.tree_map(
@@ -281,7 +305,7 @@ class PipelineTrainer:
                 spmd_grad,
                 mesh=mesh,
                 in_specs=({"stages": stage_specs_vs, "aux": aux_specs},
-                          P(), P(), P()),
+                          data_spec, data_spec, P()),
                 out_specs=(P(), {"stages": stage_specs_vs, "aux": aux_specs}),
                 check_vma=False,
             )
@@ -305,9 +329,13 @@ class PipelineTrainer:
         self.global_step = 0
 
     def train_step(self, x: jax.Array, y: jax.Array) -> jax.Array:
-        """x, y: [batch, ...] split into num_micro micro-batches on dim 0."""
+        """x, y: [batch, ...] split into num_micro micro-batches on dim 0
+        (each micro-batch then shards over the mesh's dp axis)."""
         B = x.shape[0]
         enforce_eq(B % self.num_micro, 0, f"batch size {B} must be divisible by num_micro={self.num_micro}")
+        enforce_eq((B // self.num_micro) % self._dp_n, 0,
+                   f"micro-batch {B // self.num_micro} must divide over "
+                   f"dp={self._dp_n}")
         xm = x.reshape(self.num_micro, B // self.num_micro, *x.shape[1:])
         ym = y.reshape(self.num_micro, B // self.num_micro, *y.shape[1:])
         self._rng, sub = jax.random.split(self._rng)

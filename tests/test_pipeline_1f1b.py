@@ -39,6 +39,17 @@ def _data(n=16):
     return jnp.asarray(x), jnp.asarray(y)
 
 
+def _micro_mean_loss(micro):
+    """Serial-reference loss: mean over micro-batches of per-micro CE."""
+    def loss(out, yy):
+        m = out.shape[0] // micro
+        losses = [nn.functional.cross_entropy(out[i*m:(i+1)*m],
+                                              yy[i*m:(i+1)*m])
+                  for i in range(micro)]
+        return jnp.mean(jnp.stack(losses))
+    return loss
+
+
 def test_1f1b_matches_f_then_b_trajectory():
     mesh = mesh_mod.make_mesh({"dp": 2, "pp": 4})
     x, y = _data(16)
@@ -68,13 +79,7 @@ def test_interleave_matches_f_then_b_trajectory():
     micro = 4
     from paddle_tpu.executor import Trainer
 
-    def micro_mean_loss(out, yy):
-        m = out.shape[0] // micro
-        losses = [nn.functional.cross_entropy(out[i*m:(i+1)*m], yy[i*m:(i+1)*m])
-                  for i in range(micro)]
-        return jnp.mean(jnp.stack(losses))
-
-    s = Trainer(serial, optimizer.SGD(0.2), micro_mean_loss)
+    s = Trainer(serial, optimizer.SGD(0.2), _micro_mean_loss(micro))
     for i in range(5):
         lb = float(b.train_step(x, y))
         ls = float(s.train_step(x, y))
@@ -116,3 +121,27 @@ def test_1f1b_bounds_activation_memory():
     ofo = temp_bytes("1f1b")
     # the 1F1B program's transient working set must be well below F-then-B
     assert ofo < 0.7 * ftb, (ofo, ftb)
+
+
+@pytest.mark.slow
+def test_dp_sharded_batch_matches_serial():
+    """dp now SHARDS micro-batches (previously replicated): both
+    schedules on a dp=2×pp=4 mesh must follow the serial single-model
+    trajectory on identical data — the dp loss/grad reduction has to be
+    exact, not just self-consistent."""
+    from paddle_tpu.executor import Trainer
+
+    x, y = _data(16)
+    micro = 4
+
+    for schedule in ("f_then_b", "1f1b"):
+        mesh = mesh_mod.make_mesh({"dp": 2, "pp": 4})
+        tr = PipelineTrainer(build(0), optimizer.SGD(0.2),
+                             nn.functional.cross_entropy, mesh,
+                             num_micro=micro, schedule=schedule)
+        serial = Trainer(build(0), optimizer.SGD(0.2), _micro_mean_loss(micro))
+        for i in range(4):
+            lp = float(tr.train_step(x, y))
+            ls = float(serial.train_step(x, y))
+            np.testing.assert_allclose(lp, ls, rtol=1e-3, atol=1e-5,
+                                       err_msg=f"{schedule} step {i}")
