@@ -133,3 +133,56 @@ def test_file_shard_util():
     files = [f"f{i}" for i in range(10)]
     assert fleet.util.get_file_shard(files, 0, 3) == ["f0", "f3", "f6", "f9"]
     assert fleet.util.get_file_shard(files, 2, 3) == ["f2", "f5", "f8"]
+
+
+def test_daily_ops_cycle_over_ssd(tmp_path):
+    """The production daily loop through the FLEET facade over an SSD
+    table: train-ish pushes → base save (mode 2, resets delta) → more
+    pushes → delta save (mode 1 keeps only freshly-updated features) →
+    shrink (decay + delete) → spill. Accessor lifecycle semantics
+    (ctr_accessor.cc:55-135) exercised end to end at the facade level."""
+    import numpy as np
+
+    from paddle_tpu.distributed.fleet import Fleet
+    from paddle_tpu.distributed.role_maker import Role, UserDefinedRoleMaker
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+    from paddle_tpu.ps.table import TableConfig
+
+    f = Fleet().init(UserDefinedRoleMaker(
+        current_id=0, role=Role.WORKER, worker_num=1,
+        server_endpoints=["127.0.0.1:0"]))
+    acc = AccessorConfig(embedx_dim=4, embedx_threshold=0.0,
+                         base_threshold=0.0, delta_threshold=0.05,
+                         delete_threshold=0.0,
+                         sgd=SGDRuleConfig(initial_range=0.0))
+    tbl = f.register_sparse_table(0, TableConfig(
+        table_id=0, shard_num=4, storage="ssd",
+        ssd_path=str(tmp_path / "tiers"), accessor_config=acc))
+    rng = np.random.default_rng(0)
+
+    def day_push(keys):
+        push = np.zeros((len(keys), tbl.accessor.push_dim), np.float32)
+        push[:, 1] = 1.0
+        push[:, 2] = (rng.random(len(keys)) < 0.5).astype(np.float32)
+        push[:, 3:] = rng.normal(0, 0.1, (len(keys), 5)).astype(np.float32)
+        tbl.push_sparse(keys, push)
+
+    day1 = np.arange(1, 301, dtype=np.uint64)
+    day_push(day1)
+    base = f.save_persistables(str(tmp_path / "base"), mode=2)
+    assert base[0] == 300  # base save resets delta_score
+
+    day2 = np.arange(201, 401, dtype=np.uint64)  # 100 old + 100 new keys
+    day_push(day2)
+    delta = f.save_persistables(str(tmp_path / "delta"), mode=1)
+    # delta keeps only features whose delta_score regrew since the base
+    # save: exactly the 200 keys pushed on day 2
+    assert delta[0] == 200
+
+    erased = f.shrink()
+    assert erased[0] >= 0
+    tbl.spill(hot_budget=0)
+    assert tbl.stats()["hot_rows"] == 0
+    assert tbl.size() == 400 - erased[0]
+    f.stop_worker()
