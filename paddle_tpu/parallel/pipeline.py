@@ -111,8 +111,11 @@ def pipeline_spmd_fn(
     Returns ``fn(stacked_stage_state, aux_state, x_micro) -> y_micro``
     to be called inside shard_map with ``stacked_stage_state`` sharded on
     the pp axis (leading dim) and ``x_micro`` of shape
-    ``[num_micro, micro_batch, ...]`` replicated. Output is the last
-    stage's head output per micro-batch, replicated via psum masking.
+    ``[num_micro, micro_batch, ...]`` — identical across pp ranks;
+    callers may shard the micro_batch dim over a dp axis (the trainer
+    does), in which case each rank pipelines its own batch shard.
+    Output is the last stage's head output per micro-batch (same
+    dp-sharding as the input), replicated over pp via psum masking.
     """
 
     def fn(stacked_state, aux_state, x_micro):

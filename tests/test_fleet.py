@@ -181,8 +181,12 @@ def test_daily_ops_cycle_over_ssd(tmp_path):
     assert delta[0] == 200
 
     erased = f.shrink()
-    assert erased[0] >= 0
+    # deterministic with this config: every feature has show=1 (score
+    # 0.098 >= delete_threshold 0 after decay) and unseen_days=1 <= 30,
+    # so nothing may be erased — a shrink regression that over-deletes
+    # fails here (the erase path itself is pinned by the table tests)
+    assert erased[0] == 0, erased
     tbl.spill(hot_budget=0)
     assert tbl.stats()["hot_rows"] == 0
-    assert tbl.size() == 400 - erased[0]
+    assert tbl.size() == 400
     f.stop_worker()
