@@ -95,12 +95,6 @@ def test_hybrid_realistic_width_converges():
     steps must reduce loss — exercises sharding-constraint edges the
     tiny shapes cannot (head dims, ffn splits, vocab partitions all
     > 1 element per shard)."""
-    import numpy as np
-
-    from paddle_tpu import optimizer
-    from paddle_tpu.core import mesh as mesh_mod
-    from paddle_tpu.parallel.hybrid import HybridParallelTrainer
-
     cfg = ErnieConfig(vocab_size=512, hidden_size=128, num_heads=4,
                       ffn_size=256, num_layers=4, max_seq_len=128)
     mesh = mesh_mod.make_mesh({"dp": 1, "pp": 2, "cp": 2, "mp": 2})
