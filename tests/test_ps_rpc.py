@@ -496,3 +496,55 @@ def test_pass_trainer_over_remote_table(tmp_path):
     cli.close()
     for s in servers:
         s.stop()
+
+
+def test_stream_trainer_over_remote_table():
+    """CtrStreamTrainer (the_one_ps worker loop) pulls/pushes straight
+    through RemoteSparseTable — the hogwild CPU path against remote
+    servers, no communicator required."""
+    import jax
+    import paddle_tpu as pt
+    import paddle_tpu.ps.rpc as rpc
+    from paddle_tpu import optimizer
+    from paddle_tpu.data.dataset import InMemoryDataset, SlotDesc
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.ps_trainer import CtrStreamTrainer
+    from paddle_tpu.ps.rpc import RemoteSparseTable
+
+    S, D = 3, 2
+    cfg = TableConfig(shard_num=4, accessor_config=AccessorConfig(
+        embedx_dim=4, embedx_threshold=0.0))
+    server = rpc.NativePsServer(n_trainers=1)
+    cli = rpc.RpcPsClient([f"127.0.0.1:{server.port}"])
+    cli.create_sparse_table(0, cfg)
+    remote = RemoteSparseTable(cli, 0, cfg)
+
+    rng = np.random.default_rng(0)
+    lines = []
+    for _ in range(512):
+        ids = rng.integers(0, 48, S)
+        dense = rng.normal(size=D)
+        label = int((ids % 5 == 0).sum() + dense[0] > 1.0)
+        lines.append(" ".join([f"1 {v}" for v in ids]
+                              + [f"1 {v:.4f}" for v in dense]
+                              + [f"1 {label}"]))
+    slots = ([SlotDesc(f"s{i}", is_float=False, max_len=1) for i in range(S)]
+             + [SlotDesc(f"d{i}", is_float=True, max_len=1) for i in range(D)]
+             + [SlotDesc("label", is_float=True, max_len=1)])
+    ds = InMemoryDataset(slots, seed=0)
+    ds.load_from_lines(lines)
+
+    pt.seed(0)
+    tr = CtrStreamTrainer(
+        DeepFM(CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=4,
+                         dnn_hidden=(8,))),
+        optimizer.Adam(1e-2), remote,
+        sparse_slots=[f"s{i}" for i in range(S)],
+        dense_slots=[f"d{i}" for i in range(D)], label_slot="label")
+    losses = [tr.train_from_dataset(ds, batch_size=128)["loss"]
+              for _ in range(4)]
+    assert losses[-1] < losses[0] * 0.95, losses
+    assert remote.size() > 0
+    cli.close()
+    server.stop()

@@ -205,3 +205,31 @@ def test_barrier_and_global_step():
     g.push_step(5)
     g.push_step(3)
     assert g.step == 8 and lrs == [5, 8]
+
+
+def test_accessor_defaults_match_reference_constants():
+    """The CtrAccessor lifecycle defaults are parity-critical (SURVEY
+    Appendix A; reference CtrAccessorParameter defaults in
+    distributed/ps.proto / the_one_ps table config): pin them so a
+    refactor cannot silently drift the training semantics."""
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+
+    a = AccessorConfig()
+    assert a.nonclk_coeff == pytest.approx(0.1)
+    assert a.click_coeff == pytest.approx(1.0)
+    assert a.base_threshold == pytest.approx(1.5)
+    assert a.delta_threshold == pytest.approx(0.25)
+    assert a.delta_keep_days == pytest.approx(16.0)
+    assert a.show_click_decay_rate == pytest.approx(0.98)
+    assert a.delete_threshold == pytest.approx(0.8)
+    assert a.delete_after_unseen_days == pytest.approx(30.0)
+    assert a.embedx_dim == 8
+    assert a.embedx_threshold == pytest.approx(10.0)
+    assert a.embed_sgd_rule == "adagrad" and a.embedx_sgd_rule == "adagrad"
+
+    s = SGDRuleConfig()
+    assert s.learning_rate == pytest.approx(0.05)
+    assert s.initial_g2sum == pytest.approx(3.0)
+    assert s.initial_range == pytest.approx(1e-4)
+    assert tuple(s.weight_bounds) == (-10.0, 10.0)
