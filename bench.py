@@ -80,6 +80,11 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
+    # BENCH_PLATFORM=cpu: in-process backend override for CI validation
+    # (env vars alone cannot override the boot-registered axon platform)
+    if os.environ.get("BENCH_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
     devs = _init_backend()
     print(f"bench: backend={devs[0].platform} devices={len(devs)}",
           file=sys.stderr)

@@ -15,5 +15,28 @@ if [[ "${1:-fast}" == "full" ]]; then
   python -m pytest tests/ -q -m ""
   echo "== driver artifacts =="
   python -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8); print('dryrun OK')"
+  echo "== artifact tools smoke (tiny shapes, CPU) =="
+  PYTHONPATH="$PWD:${PYTHONPATH:-}" SSD_DEMO_POP=200000 SSD_DEMO_PASS_KEYS=20000 \
+    SSD_DEMO_PASSES=1 python tools/ssd_scale_demo.py | python -c \
+    "import json,sys; d=json.load(sys.stdin); assert 'error' not in d, d; print('ssd_scale_demo OK')"
+  PYTHONPATH="$PWD:${PYTHONPATH:-}" WD_POP=200000 WD_RECORDS=5000 WD_DAYS=1 \
+    python tools/widedeep_daily.py | python -c \
+    "import json,sys; d=json.load(sys.stdin); assert 'error' not in d, d; print('widedeep_daily OK')"
+  # bench/tpu_smoke intentionally exit 0 on failure (one-JSON-line
+  # driver contract), so they must run as SUBPROCESSES with the check
+  # in a separate process — an in-process runpy assert would be skipped
+  # by their sys.exit(0) error paths
+  SMOKE_OUT=/tmp/ci_tpu_smoke_light.json SMOKE_LIGHT=1 SMOKE_INIT_TIMEOUT=30 \
+    SMOKE_PLATFORM=cpu python tools/tpu_smoke.py > /dev/null
+  python -c "
+import json
+d = json.load(open('/tmp/ci_tpu_smoke_light.json')); assert d['ok'], d
+print('tpu_smoke (light) OK')"
+  BENCH_STEPS=5 BENCH_WARMUP=1 BENCH_PASS_KEYS=$((1 << 14)) \
+    BENCH_INIT_TIMEOUT=60 BENCH_PLATFORM=cpu python bench.py | python -c "
+import json, sys
+line = [l for l in sys.stdin.read().splitlines() if l.startswith('{')][-1]
+d = json.loads(line); assert d['value'] > 0 and 'error' not in d, d
+print('bench (cpu) OK')"
 fi
 echo "CI OK"

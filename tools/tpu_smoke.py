@@ -26,8 +26,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
-OUT = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "TPU_SMOKE.json")
+# SMOKE_OUT overrides the artifact path (CI's light-mode validation
+# must not clobber the canonical real-TPU artifact at the repo root)
+OUT = os.environ.get("SMOKE_OUT") or os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "TPU_SMOKE.json")
 
 
 def _write(payload) -> None:
@@ -52,6 +54,11 @@ def main() -> None:
     import threading
 
     import jax
+
+    # SMOKE_PLATFORM=cpu: force a backend in-process (env vars alone
+    # cannot override the boot-registered axon platform)
+    if os.environ.get("SMOKE_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["SMOKE_PLATFORM"])
 
     got = {}
 
