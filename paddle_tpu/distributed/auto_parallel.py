@@ -227,26 +227,23 @@ class Engine:
         """Persist model + optimizer state AND the rng stream (reference
         Engine.save) — resumed training continues the same stochastic
         trajectory (dropout keys), not a fresh one."""
-        from ..io.checkpoint import save_checkpoint
+        from ..io.checkpoint import save_train_state
 
         enforce(self._prepared, "prepare()/fit() before save")
-        payload = {"state": jax.device_get(self._state),
-                   "rng": jax.device_get(jax.random.key_data(self._rng))}
-        save_checkpoint(path, payload,
-                        opt_state=jax.device_get(self._opt_state))
+        save_train_state(path, self._state, opt_state=self._opt_state,
+                         rng=self._rng)
 
     def load(self, path: str) -> None:
         """Restore a snapshot saved by :meth:`save`; arrays are placed
         back onto the engine's mesh (replicated, as prepare() does)."""
-        from ..io.checkpoint import load_checkpoint
+        from ..io.checkpoint import load_train_state
 
         if not self._prepared:
             self.prepare()
-        snap = load_checkpoint(path)
+        snap = load_train_state(path)
         repl = NamedSharding(self.process_mesh.jax_mesh, PartitionSpec())
-        self._state = jax.device_put(snap["model"]["state"], repl)
-        self._rng = jax.random.wrap_key_data(
-            jnp.asarray(snap["model"]["rng"]))
+        self._state = jax.device_put(snap["state"], repl)
+        self._rng = snap["rng"] if snap["rng"] is not None else self._rng
         self._opt_state = jax.device_put(snap["opt"], repl)
 
     # -- introspection ----------------------------------------------------

@@ -95,3 +95,37 @@ def save_checkpoint(path: str, state: Any, opt_state: Any = None, step: int = 0)
 def load_checkpoint(path: str) -> Dict[str, Any]:
     """Load a snapshot: {"model": …, "opt": … (structure intact), "step"}."""
     return load(path)
+
+
+def save_train_state(path: str, state: Any, opt_state: Any = None,
+                     rng=None, step: int = 0) -> None:
+    """Trainer snapshot convention shared by the distributed trainers
+    (hybrid, auto-parallel Engine): model state + optimizer + rng stream
+    + step under the standard {"model", "opt", "step"} schema. The rng
+    key is serialized via jax.random.key_data."""
+    import jax
+
+    payload = {"state": jax.device_get(state)}
+    if rng is not None:
+        payload["rng"] = jax.device_get(jax.random.key_data(rng))
+    save_checkpoint(path, payload,
+                    opt_state=jax.device_get(opt_state), step=step)
+
+
+def load_train_state(path: str) -> Dict[str, Any]:
+    """Inverse of save_train_state: {"state", "opt", "rng" (key or
+    None), "step"}. Containers come back as plain dicts — graft values
+    into live pytrees by key path if the consumer's tree types matter
+    (e.g. shard_map in_specs built from OrderedDicts)."""
+    import jax
+    import jax.numpy as jnp
+
+    snap = load_checkpoint(path)
+    rng = snap["model"].get("rng")
+    return {
+        "state": snap["model"]["state"],
+        "opt": snap["opt"],
+        "rng": (jax.random.wrap_key_data(jnp.asarray(rng))
+                if rng is not None else None),
+        "step": int(snap.get("step", 0)),
+    }

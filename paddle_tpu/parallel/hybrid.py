@@ -145,6 +145,52 @@ class HybridParallelTrainer:
         self._rng = jax.random.key(seed)
         self.global_step = 0
 
+    def save(self, path: str) -> None:
+        """Persist params + optimizer state + rng + step (the shared
+        trainer-snapshot schema; layout-independent — params live at
+        GLOBAL shapes, so a checkpoint written on one mesh restores
+        onto any other)."""
+        from ..io.checkpoint import save_train_state
+
+        save_train_state(path, self.params, opt_state=self.opt_state,
+                         rng=self._rng, step=self.global_step)
+
+    def load(self, path: str) -> None:
+        """Restore a snapshot saved by :meth:`save`; resumed training
+        continues the same step count and rng stream. Values restore
+        INTO the live pytrees by key path — loaded containers are plain
+        dicts while shard_map's in_specs were built from the OrderedDict
+        state trees — and each leaf is device_put with its current
+        leaf's sharding so the compiled step's cache stays valid (a
+        wholesale swap to uncommitted arrays would trigger a second
+        full compile)."""
+        from ..io.checkpoint import load_train_state
+
+        from jax.sharding import NamedSharding
+
+        def restore_like(template, loaded):
+            def get(path, cur):
+                node = loaded
+                for p in path:
+                    node = node[p.key if hasattr(p, "key") else p.idx]
+                arr = jnp.asarray(node)
+                # reuse the live leaf's MESH sharding (set by a prior
+                # compiled step) so the jit cache stays valid; a fresh
+                # trainer's single-device leaves stay uncommitted and
+                # the first step places them per in_specs
+                sh = getattr(cur, "sharding", None)
+                if isinstance(sh, NamedSharding):
+                    return jax.device_put(arr, sh)
+                return arr
+
+            return jax.tree_util.tree_map_with_path(get, template)
+
+        snap = load_train_state(path)
+        self.params = restore_like(self.params, snap["state"])
+        self.opt_state = restore_like(self.opt_state, snap["opt"])
+        self._rng = snap["rng"]
+        self.global_step = snap["step"]
+
     def train_step(self, ids, labels):
         """ids/labels: [batch, seq] global arrays; batch must divide
         num_micro (micro-batching) — dp/cp sharding happens via GSPMD."""
