@@ -1,7 +1,10 @@
-from .checkpoint import load, save, load_checkpoint, save_checkpoint
+from .checkpoint import (graft_into, load, load_checkpoint,
+                         load_train_state, save, save_checkpoint,
+                         save_train_state)
 from .inference import (InferencePredictor, load_inference_model,
                         save_inference_model)
 
 __all__ = ["save", "load", "save_checkpoint", "load_checkpoint",
+           "save_train_state", "load_train_state", "graft_into",
            "save_inference_model", "load_inference_model",
            "InferencePredictor"]

@@ -26,7 +26,11 @@ import numpy as np
 
 from ..core.enforce import InvalidArgumentError, NotFoundError
 
-__all__ = ["save", "load", "save_checkpoint", "load_checkpoint"]
+__all__ = ["save", "load", "save_checkpoint", "load_checkpoint",
+    "save_train_state",
+    "load_train_state",
+    "graft_into",
+]
 
 _ARR = "__arr__"
 
@@ -129,3 +133,36 @@ def load_train_state(path: str) -> Dict[str, Any]:
                 if rng is not None else None),
         "step": int(snap.get("step", 0)),
     }
+
+
+def graft_into(template, loaded):
+    """Restore ``loaded`` values INTO the live ``template`` pytree by
+    key path: loaded containers are plain dicts after deserialization
+    while trainers' trees may be OrderedDicts (shard_map in_specs were
+    built from them), so structures must not be swapped wholesale. Each
+    leaf is device_put with the template leaf's mesh sharding when one
+    was set by a compiled step (keeps the jit cache valid); fresh
+    single-device leaves stay uncommitted for the next step to place."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    def get(path, cur):
+        node = loaded
+        for p in path:
+            if hasattr(p, "key"):
+                k = p.key
+                # the save format coerces dict keys to str; look up the
+                # coerced form when the original key type is absent
+                if isinstance(node, dict) and k not in node:
+                    k = str(k)
+                node = node[k]
+            else:
+                node = node[p.idx]
+        arr = jnp.asarray(node)
+        sh = getattr(cur, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            return jax.device_put(arr, sh)
+        return arr
+
+    return jax.tree_util.tree_map_with_path(get, template)

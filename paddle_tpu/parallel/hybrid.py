@@ -164,31 +164,13 @@ class HybridParallelTrainer:
         leaf's sharding so the compiled step's cache stays valid (a
         wholesale swap to uncommitted arrays would trigger a second
         full compile)."""
-        from ..io.checkpoint import load_train_state
-
-        from jax.sharding import NamedSharding
-
-        def restore_like(template, loaded):
-            def get(path, cur):
-                node = loaded
-                for p in path:
-                    node = node[p.key if hasattr(p, "key") else p.idx]
-                arr = jnp.asarray(node)
-                # reuse the live leaf's MESH sharding (set by a prior
-                # compiled step) so the jit cache stays valid; a fresh
-                # trainer's single-device leaves stay uncommitted and
-                # the first step places them per in_specs
-                sh = getattr(cur, "sharding", None)
-                if isinstance(sh, NamedSharding):
-                    return jax.device_put(arr, sh)
-                return arr
-
-            return jax.tree_util.tree_map_with_path(get, template)
+        from ..io.checkpoint import graft_into, load_train_state
 
         snap = load_train_state(path)
-        self.params = restore_like(self.params, snap["state"])
-        self.opt_state = restore_like(self.opt_state, snap["opt"])
-        self._rng = snap["rng"]
+        self.params = graft_into(self.params, snap["state"])
+        self.opt_state = graft_into(self.opt_state, snap["opt"])
+        if snap["rng"] is not None:
+            self._rng = snap["rng"]
         self.global_step = snap["step"]
 
     def train_step(self, ids, labels):

@@ -331,6 +331,27 @@ class PipelineTrainer:
         self._rng = jax.random.key(seed)
         self.global_step = 0
 
+    def save(self, path: str) -> None:
+        """Persist stage+aux params, optimizer state, rng, and step
+        (shared trainer-snapshot schema)."""
+        from ..io.checkpoint import save_train_state
+
+        save_train_state(path, self._params, opt_state=self.opt_state,
+                         rng=self._rng, step=self.global_step)
+
+    def load(self, path: str) -> None:
+        """Restore a snapshot saved by :meth:`save`; values graft into
+        the live pytrees (container types preserved, mesh shardings
+        reused where a compiled step set them)."""
+        from ..io.checkpoint import graft_into, load_train_state
+
+        snap = load_train_state(path)
+        self._params = graft_into(self._params, snap["state"])
+        self.opt_state = graft_into(self.opt_state, snap["opt"])
+        if snap["rng"] is not None:
+            self._rng = snap["rng"]
+        self.global_step = snap["step"]
+
     def train_step(self, x: jax.Array, y: jax.Array) -> jax.Array:
         """x, y: [batch, ...] split into num_micro micro-batches on dim 0
         (each micro-batch then shards over the mesh's dp axis)."""

@@ -266,6 +266,30 @@ class SpmdTrainer:
         )
         return jax.jit(shmapped, donate_argnums=(0, 1))
 
+    def save(self, path: str) -> None:
+        """Persist state + optimizer + rng + step (shared trainer-
+        snapshot schema, io/checkpoint.save_train_state)."""
+        from ..io.checkpoint import save_train_state
+
+        save_train_state(path, self.state, opt_state=self.opt_state,
+                         rng=self._rng, step=self.global_step)
+
+    def load(self, path: str) -> None:
+        """Restore a snapshot saved by :meth:`save`: values graft into
+        the live pytrees and are re-placed with the trainer's sharding
+        rules (the checkpoint itself is layout-independent)."""
+        from ..io.checkpoint import graft_into, load_train_state
+
+        snap = load_train_state(path)
+        # graft by key path: loaded containers are plain dicts while the
+        # live trees are OrderedDicts, and the live leaves already carry
+        # the trainer's NamedShardings (set at init), which graft reuses
+        self.state = graft_into(self.state, snap["state"])
+        self.opt_state = graft_into(self.opt_state, snap["opt"])
+        if snap["rng"] is not None:
+            self._rng = snap["rng"]
+        self.global_step = snap["step"]
+
     def train_step(self, inputs, labels) -> jax.Array:
         if not isinstance(inputs, (tuple, list)):
             inputs = (inputs,)

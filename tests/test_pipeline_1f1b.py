@@ -145,3 +145,26 @@ def test_dp_sharded_batch_matches_serial():
             ls = float(serial.train_step(x, y))
             np.testing.assert_allclose(lp, ls, rtol=1e-3, atol=1e-5,
                                        err_msg=f"{schedule} step {i}")
+
+
+def test_pipeline_trainer_save_load_resume(tmp_path):
+    """Checkpoint/resume for the pipeline trainer (both schedules):
+    restored runs continue the exact trajectory."""
+    x, y = _data(16)
+    for schedule in ("f_then_b", "1f1b"):
+        mesh = mesh_mod.make_mesh({"dp": 2, "pp": 4})
+        a = PipelineTrainer(build(0), optimizer.SGD(0.2),
+                            nn.functional.cross_entropy, mesh, num_micro=4,
+                            schedule=schedule)
+        for _ in range(2):
+            a.train_step(x, y)
+        a.save(str(tmp_path / schedule))
+        la = [float(a.train_step(x, y)) for _ in range(2)]
+
+        b = PipelineTrainer(build(1), optimizer.SGD(0.2),
+                            nn.functional.cross_entropy, mesh, num_micro=4,
+                            schedule=schedule)
+        b.load(str(tmp_path / schedule))
+        assert b.global_step == 2
+        lb = [float(b.train_step(x, y)) for _ in range(2)]
+        np.testing.assert_allclose(lb, la, rtol=1e-5, err_msg=schedule)

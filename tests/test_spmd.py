@@ -69,3 +69,36 @@ def test_zero3_params_sharded():
     )
     w = spmd.state["params"]["0.weight"]
     assert any("sharding" in (s or ()) for s in w.sharding.spec), w.sharding.spec
+
+
+def test_spmd_trainer_save_load_resume(tmp_path):
+    """SpmdTrainer checkpoint/resume (ZeRO stage 1): the restored run
+    continues the exact trajectory with state re-placed per the
+    trainer's sharding rules."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.core import mesh as mesh_mod
+    from paddle_tpu.parallel.spmd import SpmdTrainer
+
+    mesh = mesh_mod.make_mesh({"dp": 2, "sharding": 4})
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 8)).astype(np.float32)
+    y = rng.integers(0, 4, size=32).astype(np.int32)
+
+    pt.seed(0)
+    a = SpmdTrainer(nn.Linear(8, 4), optimizer.Adam(1e-2),
+                    nn.functional.cross_entropy, mesh, zero_stage=1)
+    for _ in range(3):
+        a.train_step(x, y)
+    a.save(str(tmp_path / "snap"))
+    la = [float(a.train_step(x, y)) for _ in range(3)]
+
+    pt.seed(5)
+    b = SpmdTrainer(nn.Linear(8, 4), optimizer.Adam(1e-2),
+                    nn.functional.cross_entropy, mesh, zero_stage=1)
+    b.load(str(tmp_path / "snap"))
+    assert b.global_step == 3
+    lb = [float(b.train_step(x, y)) for _ in range(3)]
+    np.testing.assert_allclose(lb, la, rtol=1e-5)
