@@ -49,6 +49,10 @@ _SLOW = {
     "test_lenet_forward_and_one_step",
     "test_pipeline_training_matches_serial",
     "test_launch_local_trainers",
+    "test_hybrid_save_load_resume",
+    "test_pipeline_trainer_save_load_resume",
+    "test_auto_checkpoint_resumes_day_stream",
+    "test_train_passes_overlapped_matches_sequential",
     "test_launch_propagates_failure",
 }
 
