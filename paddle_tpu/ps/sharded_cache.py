@@ -7,24 +7,38 @@ the reference routes each key to its owner GPU (`calc_shard_index`,
 (`walk_to_dest` :207), and serves `pull_sparse` :479 / `push_sparse` :575
 against per-GPU hash tables. Here the cache state is a jax array sharded
 over a mesh axis (rows block-partitioned into HBM shards) and the routing
-runs *inside* the compiled step over ICI:
+runs *inside* the compiled step over ICI.
 
-- **pull** (`sharded_cache_pull`): all_gather the batch's global row ids
-  over the shard axis, each shard gathers the rows it owns (others
-  contribute zeros — each row has exactly one owner, so a
-  ``psum_scatter`` both sums the one-hot contributions and returns each
-  device its own batch slice. Two collectives, both compiler-scheduled
-  on ICI; the walk_to_dest p2p hop count is matched, not interpreted.
-- **push** (`sharded_cache_push`): all_gather (rows, grads, show, click),
-  then every shard runs the normal batch-scaled ``cache_push`` with
-  non-owned rows mapped to the out-of-range sentinel, which the scatter
-  drops (`mode="drop"`) — the merge_grad dedup (heter_comm_inl.h:388)
-  happens per shard on exactly the rows it owns.
+Two routing strategies:
 
-Bit-for-bit parity with the single-device cache: all_gather(tiled)
-reassembles the global batch in original order, so per-row segment sums
-accumulate in the same order as the unsharded push, and each row's
-AdaGrad math runs once on its owner shard with identical inputs.
+- **key-routed all-to-all** (``routed_cache_pull`` / ``routed_cache_push``
+  — the default, the true split_input_to_shard analogue): each device
+  dedups its batch slice locally (the merge_grad step,
+  heter_comm_inl.h:388), partitions the unique row ids by owner shard
+  into fixed-capacity buckets ``[K, cap]``, and ONE ``lax.all_to_all``
+  ships each shard exactly the slice it owns (walk_to_dest :207 as a
+  compiler-scheduled ICI collective). The owner serves / updates
+  O(batch/K) rows and pull results ride a second all_to_all back. Per
+  -chip FLOPs and HBM traffic are O(batch·dim/K·cap_factor) — independent
+  of the shard count, matching pull :479 / push :575. XLA needs static
+  shapes where brpc sends variable-length messages, so buckets carry a
+  slack factor and an in-graph **overflow counter** reports any dropped
+  entry loudly (no silent truncation; see ``check_route_overflow``).
+- **gathered** (``sharded_cache_pull`` / ``sharded_cache_push``, the
+  round-2 formulation, kept as the dense fallback and as the parity
+  oracle): all_gather the ENTIRE global batch to every shard; each shard
+  does the full batch's work. O(batch·K) per-chip — correct but does not
+  scale with K.
+
+Bit-for-bit parity with the single-device cache: routing is stable —
+device-major bucket order preserves each row's occurrence order, so
+per-row segment sums accumulate in the same order as the unsharded push,
+and each row's AdaGrad math runs once on its owner shard with identical
+inputs. Local pre-dedup (``pre_dedup=True``, the default — it is what
+caps hot-key bucket load) pre-merges duplicates, which changes the f32
+scatter-add sequence per row (~1-ulp differences); pass
+``pre_dedup=False`` for strict bitwise parity with the single-device
+push.
 
 Host side, ``shard_spread_rows`` round-robins the dense row ids the
 FeasignIndex allocates across the block partition so hot passes fill all
@@ -49,6 +63,10 @@ from .embedding_cache import CacheConfig, cache_pull, cache_push
 __all__ = [
     "sharded_cache_pull",
     "sharded_cache_push",
+    "routed_cache_pull",
+    "routed_cache_push",
+    "route_bucket_capacity",
+    "check_route_overflow",
     "shard_spread_rows",
     "shard_unspread_rows",
     "make_sharded_ctr_train_step",
@@ -60,6 +78,160 @@ Axis = Union[str, Tuple[str, ...]]
 
 def _axis_size(axis: Axis) -> jax.Array:
     return lax.psum(1, axis)
+
+
+# ---------------------------------------------------------------------------
+# key-routed all-to-all serving (split_input_to_shard / walk_to_dest)
+# ---------------------------------------------------------------------------
+
+
+def route_bucket_capacity(m: int, K: int, cap_factor: float = 2.0) -> int:
+    """Static per-destination bucket capacity for routing ``m`` local rows
+    over ``K`` shards. Mean load is m/K; ``cap_factor`` is the slack over
+    the mean (the reference's brpc messages are variable-length — XLA
+    buckets are the static-shape equivalent, sized like an MoE capacity
+    factor). +8 absolute slack keeps tiny batches safe; rounded up to the
+    8-lane sublane for TPU layouts. With host-side `shard_spread_rows`
+    round-robin placement and pre-dedup, per-bucket load is a tight
+    binomial around m/K — factor 2 is ~100σ at production batch sizes."""
+    cap = int(np.ceil(cap_factor * m / K)) + 8
+    cap = (cap + 7) // 8 * 8
+    return min(m, cap)
+
+
+def check_route_overflow(overflow) -> None:
+    """Raise if a routed pull/push reported dropped entries (bucket
+    capacity exceeded). Hosts should call this on the step's overflow
+    output at whatever cadence they sync losses."""
+    n = int(overflow)
+    enforce(
+        n == 0,
+        f"sharded-cache routing overflow: {n} row(s) exceeded the "
+        "per-shard bucket capacity and were dropped. Raise cap_factor on "
+        "the sharded step (or check shard_spread_rows placement).")
+
+
+def _route_to_buckets(owner, K: int, cap: int, payloads, fills,
+                      presorted: bool = False):
+    """Partition ``m`` local entries into per-destination buckets
+    (split_input_to_shard, heter_comm_inl.h:441, with static shapes).
+
+    owner: [m] int32 in [0, K]; K marks invalid entries (never routed).
+    payloads/fills: arrays of leading dim m and their padding values.
+    Returns (buckets [K, cap, ...] per payload, src [K, cap] int32 with
+    m = padding, overflow count). Stable: entries keep their original
+    relative order inside each bucket (device-major order downstream
+    preserves per-row f32 accumulation order vs the unsharded push).
+    ``presorted``: owner is already non-decreasing (true after
+    jnp.unique — block ownership is monotone in row id), skipping the
+    O(m log m) sort on the hot path."""
+    m = owner.shape[0]
+    if presorted:
+        order, so = jnp.arange(m), owner
+    else:
+        order = jnp.argsort(owner, stable=True)
+        so = owner[order]
+    start = jnp.searchsorted(so, jnp.arange(K + 1))  # bucket group starts
+    pos = jnp.arange(m) - start[so]  # rank within the destination bucket
+    overflow = jnp.sum((so < K) & (pos >= cap)).astype(jnp.int32)
+    buckets = []
+    for p, fill in zip(payloads, fills):
+        b = jnp.full((K, cap) + p.shape[1:], fill, p.dtype)
+        # owner K / pos >= cap are out-of-bounds → mode="drop" discards
+        buckets.append(b.at[so, pos].set(p[order], mode="drop"))
+    src = jnp.full((K, cap), m, jnp.int32)
+    src = src.at[so, pos].set(order.astype(jnp.int32), mode="drop")
+    return buckets, src, overflow
+
+
+def _owner_of(rows, shard_rows: int, K: int):
+    """Owner shard of each global row id; K for sentinel/out-of-range."""
+    valid = (rows >= 0) & (rows < shard_rows * K)
+    return jnp.where(valid, rows // shard_rows, K).astype(jnp.int32)
+
+
+def routed_cache_pull(
+    state: Dict[str, jax.Array],
+    rows: jax.Array,  # [m] global row ids for this device's batch slice
+    axis: Axis,
+    cap_factor: float = 2.0,
+    pre_dedup: bool = True,
+) -> Tuple[jax.Array, jax.Array]:
+    """Inside shard_map: key-routed pull — this device's [m] global rows
+    → ([m, 1+dim] values, overflow count). The HeterComm pull_sparse
+    chain (heter_comm_inl.h:479): local merge (dedup), split to shard,
+    all_to_all request, owner gathers O(m/K) rows, all_to_all reply,
+    scatter back to batch order. Sentinel rows (no owner) pull zeros."""
+    K = int(_axis_size(axis))
+    shard_rows = state["embed_w"].shape[0]
+    m = rows.shape[0]
+    my_start = lax.axis_index(axis) * shard_rows
+    rows = rows.astype(jnp.int32)
+    if pre_dedup:
+        # request each distinct row once (CopyKeys dedup half)
+        lookup, inv = jnp.unique(rows, size=m, fill_value=shard_rows * K,
+                                 return_inverse=True)
+        inv = inv.reshape(-1)
+    else:
+        lookup = rows
+    cap = route_bucket_capacity(m, K, cap_factor)
+    (breq,), src, overflow = _route_to_buckets(
+        _owner_of(lookup, shard_rows, K), K, cap, [lookup], [0],
+        presorted=pre_dedup)
+    req = lax.all_to_all(breq, axis, 0, 0)  # [K, cap] rows I serve
+    loc = jnp.clip(req.reshape(-1) - my_start, 0, shard_rows - 1)
+    vals = cache_pull(state, loc).reshape(K, cap, -1)
+    back = lax.all_to_all(vals, axis, 0, 0)  # [K, cap, D] my requests
+    D = back.shape[-1]
+    uvals = jnp.zeros((m + 1, D), back.dtype)
+    uvals = uvals.at[src.reshape(-1)].set(back.reshape(K * cap, D))[:m]
+    out = uvals[inv] if pre_dedup else uvals
+    return out, lax.psum(overflow, axis)
+
+
+def routed_cache_push(
+    state: Dict[str, jax.Array],
+    rows: jax.Array,   # [m] global row ids for this device's batch slice
+    grads: jax.Array,  # [m, 1+dim]
+    shows: jax.Array,  # [m]
+    clicks: jax.Array,  # [m]
+    cfg: CacheConfig,
+    axis: Axis,
+    cap_factor: float = 2.0,
+    pre_dedup: bool = True,
+) -> Tuple[Dict[str, jax.Array], jax.Array]:
+    """Inside shard_map: key-routed push (heter_comm_inl.h:575): local
+    merge_grad (segment-sum duplicates), split to shard, ONE all_to_all
+    pair ships each owner only its rows+grads, owner runs the batch
+    -scaled `cache_push` over O(m·cap_factor) rows — per-chip update work
+    independent of the shard count. Returns (new_state, overflow)."""
+    K = int(_axis_size(axis))
+    shard_rows = state["embed_w"].shape[0]
+    C_total = shard_rows * K
+    m = rows.shape[0]
+    my_start = lax.axis_index(axis) * shard_rows
+    rows = rows.astype(jnp.int32)
+    payload = jnp.concatenate(
+        [grads, shows[:, None], clicks[:, None]], axis=1)
+    if pre_dedup:
+        # merge_grad: per-device partial sums, one wire entry per row
+        uniq, inv = jnp.unique(rows, size=m, fill_value=C_total,
+                               return_inverse=True)
+        inv = inv.reshape(-1)
+        payload = jax.ops.segment_sum(payload, inv, num_segments=m)
+        rows = uniq
+    cap = route_bucket_capacity(m, K, cap_factor)
+    (brow, bpay), _, overflow = _route_to_buckets(
+        _owner_of(rows, shard_rows, K), K, cap,
+        [rows, payload], [C_total, 0.0], presorted=pre_dedup)
+    rrow = lax.all_to_all(brow, axis, 0, 0).reshape(-1)
+    rpay = lax.all_to_all(bpay, axis, 0, 0).reshape(K * cap, -1)
+    loc = rrow - my_start
+    own = (loc >= 0) & (loc < shard_rows)
+    loc = jnp.where(own, loc, shard_rows)  # sentinel → dropped in cache_push
+    new_state = cache_push(state, loc, rpay[:, :-2], rpay[:, -2],
+                           rpay[:, -1], cfg)
+    return new_state, lax.psum(overflow, axis)
 
 
 def sharded_cache_pull(state: Dict[str, jax.Array], rows: jax.Array,
@@ -128,6 +300,9 @@ def make_sharded_ctr_train_step(
     mesh: Mesh,
     axis: str = "ps",
     donate: bool = True,
+    routing: str = "alltoall",
+    cap_factor: float = 2.0,
+    pre_dedup: bool = True,
 ) -> Callable:
     """Multi-chip GPUPS step: the CTR step of models/ctr.py with the
     batch data-parallel over ``axis`` and the embedding cache row-sharded
@@ -135,12 +310,18 @@ def make_sharded_ctr_train_step(
     (PSGPUWorker::TrainFiles + HeterComm serving, compiled).
 
     step(params, opt_state, cache_state, rows, dense_x, labels)
-      → (params, opt_state, cache_state, loss)
+      → (params, opt_state, cache_state, loss, overflow)
 
     ``rows`` are GLOBAL spread row ids ([B, S], from
     ``HbmEmbeddingCache.lookup`` of a mesh-sharded cache); params/opt
     replicated, grads averaged over ``axis`` (the Reducer/allreduce role).
+    ``routing``: "alltoall" (key-routed, O(batch/K) per shard — the
+    split_input_to_shard path) or "allgather" (dense fallback, O(batch·K)
+    per shard). ``overflow`` is 0 unless a routed bucket dropped entries
+    (check with :func:`check_route_overflow`; always 0 for allgather).
     """
+    enforce(routing in ("alltoall", "allgather"),
+            f"routing must be 'alltoall' or 'allgather', got {routing!r}")
     K = mesh.shape[axis]
 
     def inner(params, opt_state, cache_state, rows, dense_x, labels):
@@ -148,12 +329,12 @@ def make_sharded_ctr_train_step(
         return _sharded_step_body(model, optimizer, cache_cfg, axis, K,
                                   params, opt_state, cache_state, flat,
                                   rows.shape[0], rows.shape[1], dense_x,
-                                  labels)
+                                  labels, routing, cap_factor, pre_dedup)
 
     shmapped = shard_map(
         inner, mesh=mesh,
         in_specs=(P(), P(), P(axis), P(axis), P(axis), P(axis)),
-        out_specs=(P(), P(), P(axis), P()),
+        out_specs=(P(), P(), P(axis), P(), P()),
         check_vma=False,
     )
     return jax.jit(shmapped, donate_argnums=(0, 1, 2) if donate else ())
@@ -161,12 +342,19 @@ def make_sharded_ctr_train_step(
 
 def _sharded_step_body(model, optimizer, cache_cfg, axis, K, params,
                        opt_state, cache_state, flat_rows, B, S, dense_x,
-                       labels):
+                       labels, routing="alltoall", cap_factor=2.0,
+                       pre_dedup=True):
     """Per-rank body of the multi-chip CTR step: sharded pull, local
     fwd/bwd, grad pmean (Reducer role), sharded push. ``flat_rows`` are
     GLOBAL spread row ids for this rank's batch slice; sentinel rows
     (≥ global capacity) pull zeros and drop their pushes."""
-    emb = sharded_cache_pull(cache_state, flat_rows, axis).reshape(B, S, -1)
+    if routing == "alltoall":
+        emb, ov_pull = routed_cache_pull(cache_state, flat_rows, axis,
+                                         cap_factor, pre_dedup)
+    else:
+        emb = sharded_cache_pull(cache_state, flat_rows, axis)
+        ov_pull = jnp.int32(0)
+    emb = emb.reshape(B, S, -1)
 
     def loss_fn(params, emb):
         out, _ = nn.functional_call(model, params, emb, dense_x,
@@ -186,10 +374,16 @@ def _sharded_step_body(model, optimizer, cache_cfg, axis, K, params,
     new_params, new_opt = optimizer.update(grads, opt_state, params)
     shows = jnp.ones((B * S,), jnp.float32)
     clicks = jnp.repeat(labels.astype(jnp.float32), S)
-    new_cache = sharded_cache_push(cache_state, flat_rows,
-                                   emb_grad.reshape(B * S, -1), shows,
-                                   clicks, cache_cfg, axis)
-    return new_params, new_opt, new_cache, loss
+    if routing == "alltoall":
+        new_cache, ov_push = routed_cache_push(
+            cache_state, flat_rows, emb_grad.reshape(B * S, -1), shows,
+            clicks, cache_cfg, axis, cap_factor, pre_dedup)
+    else:
+        new_cache = sharded_cache_push(cache_state, flat_rows,
+                                       emb_grad.reshape(B * S, -1), shows,
+                                       clicks, cache_cfg, axis)
+        ov_push = jnp.int32(0)
+    return new_params, new_opt, new_cache, loss, ov_pull + ov_push
 
 
 def make_sharded_ctr_train_step_from_keys(
@@ -200,6 +394,9 @@ def make_sharded_ctr_train_step_from_keys(
     slot_ids,
     axis: str = "ps",
     donate: bool = True,
+    routing: str = "alltoall",
+    cap_factor: float = 2.0,
+    pre_dedup: bool = True,
 ) -> Callable:
     """Multi-chip GPUPS step with IN-GRAPH key lookup: each device probes
     its local batch slice's slot-tagged keys against the replicated
@@ -209,10 +406,12 @@ def make_sharded_ctr_train_step_from_keys(
     PSGPUWorker::TrainFiles on a multi-chip mesh.
 
     step(params, opt_state, cache_state, map_state, keys_lo, dense_x,
-         labels) → (params, opt_state, cache_state, loss)
+         labels) → (params, opt_state, cache_state, loss, overflow)
     """
     from .device_hash import device_hash_lookup
 
+    enforce(routing in ("alltoall", "allgather"),
+            f"routing must be 'alltoall' or 'allgather', got {routing!r}")
     K = mesh.shape[axis]
     slot_hi = jnp.asarray(np.asarray(slot_ids, np.uint32))[None, :]
 
@@ -225,12 +424,13 @@ def make_sharded_ctr_train_step_from_keys(
         rows = jnp.where(rows >= 0, rows, C_total)  # sentinel: no owner
         return _sharded_step_body(model, optimizer, cache_cfg, axis, K,
                                   params, opt_state, cache_state, rows, B, S,
-                                  dense_x, labels)
+                                  dense_x, labels, routing, cap_factor,
+                                  pre_dedup)
 
     shmapped = shard_map(
         inner, mesh=mesh,
         in_specs=(P(), P(), P(axis), P(), P(axis), P(axis), P(axis)),
-        out_specs=(P(), P(), P(axis), P()),
+        out_specs=(P(), P(), P(axis), P(), P()),
         check_vma=False,
     )
     return jax.jit(shmapped, donate_argnums=(0, 1, 2) if donate else ())

@@ -20,7 +20,9 @@ _WORKER = textwrap.dedent("""
 
     from paddle_tpu.ps.embedding_cache import (CacheConfig, cache_pull,
                                                cache_push)
-    from paddle_tpu.ps.sharded_cache import (sharded_cache_pull,
+    from paddle_tpu.ps.sharded_cache import (routed_cache_pull,
+                                             routed_cache_push,
+                                             sharded_cache_pull,
                                              sharded_cache_push)
 
     # identical host-side state on every rank (same seed)
@@ -74,6 +76,31 @@ _WORKER = textwrap.dedent("""
             np.testing.assert_allclose(np.asarray(shard.data),
                                        refk[shard.index], atol=1e-5,
                                        err_msg=k)
+
+    # key-routed all-to-all serving: the split_input_to_shard path, with
+    # the inter-host hop riding DCN inside the same compiled program
+    pull_r = jax.jit(shard_map(
+        lambda st, r: routed_cache_pull(st, r, "ps"),
+        mesh=mesh, in_specs=(P("ps"), P("ps")), out_specs=(P("ps"), P()),
+        check_vma=False))
+    out_r, ov = pull_r(state_g, rows_g)
+    assert int(ov) == 0
+    for shard in out_r.addressable_shards:
+        np.testing.assert_allclose(np.asarray(shard.data),
+                                   ref[shard.index], atol=1e-6)
+    push_r = jax.jit(shard_map(
+        lambda st, r, g, s, c: routed_cache_push(
+            st, r, g, s, c, cfg, "ps", 2.0, False),
+        mesh=mesh, in_specs=(P("ps"),) * 5, out_specs=(P("ps"), P()),
+        check_vma=False))
+    new_r, ov = push_r(state_g, rows_g, grads_g, shows_g, clicks_g)
+    assert int(ov) == 0
+    for k in new_ref:
+        refk = np.asarray(new_ref[k])
+        for shard in new_r[k].addressable_shards:
+            np.testing.assert_allclose(np.asarray(shard.data),
+                                       refk[shard.index], atol=1e-5,
+                                       err_msg="routed " + k)
     print("WORKER_OK", rank, flush=True)
 """)
 
