@@ -1,4 +1,4 @@
-"""Hybrid parallelism: one compiled SPMD step over a dp×pp×cp×mp mesh.
+"""Hybrid parallelism: one compiled SPMD step over a dp×pp×cp×mp(×sh) mesh.
 
 The reference composes its four-way hybrid (dp, pp, sharding, mp) out of
 separate mechanisms — ``HybridCommunicateGroup`` builds comm groups
@@ -11,15 +11,28 @@ axis's collectives together and overlaps them with compute on ICI.
 Axes (superset of the reference's, adding cp/ep — SURVEY §2.6):
   dp  batch;        pp  pipeline stages (compiled 1F-then-B schedule,
   see parallel.pipeline);  cp  sequence shard (ring attention);
-  mp  tensor parallel.  ep rides dp (the standard MoE deployment: expert
-  shards exchange tokens across the data-parallel group).
+  mp  tensor parallel;  sh  sharding/ZeRO (below).  ep rides dp (the
+  standard MoE deployment: expert shards exchange tokens across the
+  data-parallel group).
+
+``sh`` is the reference's 4th hybrid axis — the *sharding* group of
+``topology.py:133`` / ``sharding_optimizer.py``: an inner data-parallel
+group (the batch splits over dp×sh) whose ranks additionally partition
+the optimizer state. Params and grads stay at global shapes in the
+step; every optimizer SLOT leaf is device-sharded over "sh" on its
+first free divisible dim (composing with the pp chunk-stacking dim and
+any mp dims already in the param's spec), so the update compute and
+slot memory scale 1/sh and XLA inserts the param all-gather the
+reference's sharding-stage-1 broadcast does. Checkpoints stay
+layout-independent (global shapes), so a snapshot restores across
+different sh factorizations.
 
 Gradient synchronization (replaces the reference's Reducer / c_allreduce
 insertion): none is written by hand. shard_map's varying-manual-axes type
 system transposes the implicit broadcast of every replicated parameter
 into a psum over exactly the axes it was replicated on (verified: jax
 0.9 returns full-batch grads for P()-spec params), so each grad leaf
-comes back with its parameter's own layout — dp/cp batch reduction,
+comes back with its parameter's own layout — dp/sh/cp batch reduction,
 pp masking for embed/head, and per-shard mp/ep grads all fall out of
 autodiff.
 """
@@ -53,6 +66,19 @@ def _spec_tree(state: PyTree, cfg: ErnieConfig, leading_pp: bool) -> PyTree:
         state)
 
 
+def _insert_sh(spec: P, shape: Tuple[int, ...], sh: int) -> P:
+    """Add the "sh" axis to a param's PartitionSpec on the first free dim
+    divisible by the sharding degree (sharding_optimizer.py's param→rank
+    assignment, expressed as one more mesh dim in the slot's layout).
+    Leaves with no divisible free dim stay replicated over sh — the same
+    remainder the reference leaves on every rank."""
+    spec_t = tuple(spec) + (None,) * (len(shape) - len(spec))
+    for i, (ax, d) in enumerate(zip(spec_t, shape)):
+        if ax is None and d and d % sh == 0:
+            return P(*spec_t[:i], "sh", *spec_t[i + 1:])
+    return P(*spec_t)
+
+
 class HybridParallelTrainer:
     """dp×pp×cp×mp training of an Ernie-family model in one jitted step.
 
@@ -71,6 +97,9 @@ class HybridParallelTrainer:
     ) -> None:
         for ax in ("dp", "pp", "cp", "mp"):
             enforce(ax in mesh.shape, f"hybrid mesh lacks axis {ax!r}")
+        # optional 5th axis: the sharding/ZeRO group (topology.py:133's
+        # 4th); an inner dp group whose ranks partition the opt state
+        self.sh = int(mesh.shape.get("sh", 1))
         pp = mesh.shape["pp"]
         enforce_eq(cfg.num_layers % pp, 0, "num_layers must divide pp")
         if cfg.num_experts:
@@ -112,6 +141,14 @@ class HybridParallelTrainer:
                                 embed_apply, head_apply)
 
         dp_n, cp_n = mesh.shape["dp"], mesh.shape["cp"]
+        # the sharding group is an inner data-parallel group: the batch
+        # splits over dp×sh and the loss reduces over both
+        batch_axes = ("dp", "sh") if self.sh > 1 else ("dp",)
+        batch_n = dp_n * (self.sh if self.sh > 1 else 1)
+        # mp=1 takes the serial CE path (no mp psum), so mark the loss
+        # replicated over mp with an identity psum or the out_specs=P()
+        # vma check rejects the program
+        mp_extra = ("mp",) if mesh.shape["mp"] == 1 else ()
 
         def spmd_loss(params, ids_micro, labels_micro, rng):
             key = jax.random.fold_in(rng, lax.axis_index("pp"))
@@ -120,15 +157,17 @@ class HybridParallelTrainer:
             ce = parallel_cross_entropy(logits, labels_micro, cfg.vocab_size,
                                         cfg.mp_axis)
             local = jnp.mean(ce)
-            # mean over the dp×cp token grid (equal shard sizes)
-            return lax.psum(local / (dp_n * cp_n), ("dp", "cp"))
+            # mean over the (dp×sh)×cp token grid (equal shard sizes)
+            return lax.psum(local / (batch_n * cp_n),
+                            batch_axes + ("cp",) + mp_extra)
 
         def spmd_step(params, ids_micro, labels_micro, rng):
             return jax.value_and_grad(spmd_loss)(params, ids_micro,
                                                  labels_micro, rng)
 
-        # ids/labels: [num_micro, B_local, L_local] → batch over dp, seq over cp
-        data_spec = P(None, "dp", "cp")
+        # ids/labels: [num_micro, B_local, L_local] → batch over dp(×sh),
+        # seq over cp
+        data_spec = P(None, batch_axes, "cp")
         grad_fn = shard_map(
             spmd_step,
             mesh=mesh,
@@ -136,14 +175,53 @@ class HybridParallelTrainer:
             out_specs=(P(), self._param_specs),
         )
 
+        # ZeRO: shard every optimizer slot leaf over "sh" (params/grads
+        # stay global — XLA slices the update and all-gathers new params,
+        # the compiled form of sharding_optimizer's update+broadcast)
+        self._opt_shardings = None
+        if self.sh > 1:
+            from jax.sharding import NamedSharding
+
+            opt_specs = self._opt_spec_tree()
+            self._opt_shardings = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(mesh, spec), opt_specs,
+                is_leaf=lambda x: isinstance(x, P))
+            self.opt_state = jax.tree_util.tree_map(
+                jax.device_put, self.opt_state, self._opt_shardings)
+
         def step(params, opt_state, ids_micro, labels_micro, rng):
             loss, grads = grad_fn(params, ids_micro, labels_micro, rng)
             new_params, new_opt = optimizer.update(grads, opt_state, params)
+            if self._opt_shardings is not None:
+                new_opt = jax.tree_util.tree_map(
+                    lax.with_sharding_constraint, new_opt,
+                    self._opt_shardings)
             return new_params, new_opt, loss
 
         self._step = jax.jit(step, donate_argnums=(0, 1))
         self._rng = jax.random.key(seed)
         self.global_step = 0
+
+    def _opt_spec_tree(self):
+        """PartitionSpecs for the optimizer state: slot subtrees that
+        mirror the params tree get each param's spec with "sh" inserted
+        (:func:`_insert_sh`); anything else (step counter, scalar
+        schedule state) replicates."""
+        pstruct = jax.tree_util.tree_structure(self.params)
+        pspecs = self._param_specs
+
+        def mirror(sub):
+            if sub is None:
+                return None
+            if jax.tree_util.tree_structure(sub) == pstruct:
+                return jax.tree_util.tree_map(
+                    lambda spec, leaf: _insert_sh(spec, leaf.shape, self.sh),
+                    pspecs, sub)
+            if isinstance(sub, dict):
+                return type(sub)((k, mirror(v)) for k, v in sub.items())
+            return jax.tree_util.tree_map(lambda _: P(), sub)
+
+        return {"step": P(), "slots": mirror(self.opt_state["slots"])}
 
     def save(self, path: str) -> None:
         """Persist params + optimizer state + rng + step (the shared

@@ -166,7 +166,10 @@ def routed_cache_pull(
     shard_rows = state["embed_w"].shape[0]
     m = rows.shape[0]
     my_start = lax.axis_index(axis) * shard_rows
+    # negative sentinels → the canonical out-of-range sentinel, so the
+    # sorted-unique output stays owner-ordered (presorted routing below)
     rows = rows.astype(jnp.int32)
+    rows = jnp.where(rows < 0, shard_rows * K, rows)
     if pre_dedup:
         # request each distinct row once (CopyKeys dedup half)
         lookup, inv = jnp.unique(rows, size=m, fill_value=shard_rows * K,
@@ -211,6 +214,7 @@ def routed_cache_push(
     m = rows.shape[0]
     my_start = lax.axis_index(axis) * shard_rows
     rows = rows.astype(jnp.int32)
+    rows = jnp.where(rows < 0, C_total, rows)  # keep sorted-unique owner-ordered
     payload = jnp.concatenate(
         [grads, shows[:, None], clicks[:, None]], axis=1)
     if pre_dedup:
