@@ -22,9 +22,11 @@
 // one busy connection still uses all cores).
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <netdb.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -132,7 +134,8 @@ bool read_full(int fd, void* buf, size_t len) {
   char* p = static_cast<char*>(buf);
   while (len > 0) {
     ssize_t r = ::recv(fd, p, len, 0);
-    if (r <= 0) return false;
+    if (r < 0 && errno == EINTR) continue;
+    if (r <= 0) return false;  // errno survives for the caller (timeout?)
     p += r;
     len -= static_cast<size_t>(r);
   }
@@ -143,6 +146,7 @@ bool write_full(int fd, const void* buf, size_t len) {
   const char* p = static_cast<const char*>(buf);
   while (len > 0) {
     ssize_t r = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (r < 0 && errno == EINTR) continue;
     if (r <= 0) return false;
     p += r;
     len -= static_cast<size_t>(r);
@@ -677,7 +681,10 @@ struct PsServer {
 };
 
 // client connection: synchronous request/response; a mutex serializes
-// callers (the python Communicator provides async via its own threads)
+// callers (the python Communicator provides async via its own threads).
+// Timeouts mirror the brpc client's FLAGS_pserver_connect_timeout_ms /
+// FLAGS_pserver_timeout_ms knobs (brpc_ps_client.cc:24-45): connect via
+// non-blocking + poll deadline, per-call IO via SO_RCVTIMEO/SO_SNDTIMEO.
 struct PsConn {
   int fd = -1;
   std::mutex mu;
@@ -686,7 +693,14 @@ struct PsConn {
     if (fd >= 0) ::close(fd);
   }
 
-  bool connect_to(const char* host, int port) {
+  void set_io_timeout(int io_ms) {
+    if (fd < 0) return;
+    timeval tv{io_ms / 1000, (io_ms % 1000) * 1000};  // 0 = block forever
+    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+  }
+
+  bool connect_to(const char* host, int port, int connect_ms, int io_ms) {
     // resolve hostnames too (cluster endpoint lists are usually names)
     addrinfo hints{};
     hints.ai_family = AF_INET;
@@ -701,31 +715,59 @@ struct PsConn {
       ::freeaddrinfo(res);
       return false;
     }
-    int rc = ::connect(fd, res->ai_addr, res->ai_addrlen);
+    bool ok;
+    if (connect_ms > 0) {
+      int fl = ::fcntl(fd, F_GETFL, 0);
+      ::fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+      int rc = ::connect(fd, res->ai_addr, res->ai_addrlen);
+      ok = rc == 0;
+      if (rc < 0 && errno == EINPROGRESS) {
+        pollfd pfd{fd, POLLOUT, 0};
+        if (::poll(&pfd, 1, connect_ms) == 1) {
+          int err = 0;
+          socklen_t elen = sizeof(err);
+          ::getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+          ok = err == 0;
+        }
+      }
+      if (ok) ::fcntl(fd, F_SETFL, fl);  // back to blocking IO
+    } else {
+      ok = ::connect(fd, res->ai_addr, res->ai_addrlen) == 0;
+    }
     ::freeaddrinfo(res);
-    if (rc < 0) {
+    if (!ok) {
       ::close(fd);
       fd = -1;
       return false;
     }
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (io_ms > 0) set_io_timeout(io_ms);
     return true;
   }
 
-  // returns status; fills resp (resized). -1000 on transport failure.
+  // returns status; fills resp (resized). -1000 on transport failure
+  // (peer reset/gone), -1001 on IO deadline expiry. Either way the
+  // protocol stream is undefined afterwards — callers must reconnect
+  // before reusing the handle.
   int64_t call(uint32_t cmd, uint32_t table_id, int64_t n, int32_t aux,
                const void* payload, uint64_t plen, std::vector<char>* resp) {
     std::lock_guard<std::mutex> g(mu);
+    if (fd < 0) return -1000;
     ReqHeader h{plen, cmd, table_id, n, aux};
-    if (!write_full(fd, &h, sizeof(h))) return -1000;
-    if (plen && !write_full(fd, payload, plen)) return -1000;
+    errno = 0;
+    if (!write_full(fd, &h, sizeof(h))) return io_status();
+    if (plen && !write_full(fd, payload, plen)) return io_status();
     uint64_t rh[2];
-    if (!read_full(fd, rh, sizeof(rh))) return -1000;
+    if (!read_full(fd, rh, sizeof(rh))) return io_status();
     if (rh[0] > kMaxPayload) return -1000;
     resp->resize(rh[0]);
-    if (rh[0] && !read_full(fd, resp->data(), rh[0])) return -1000;
+    if (rh[0] && !read_full(fd, resp->data(), rh[0])) return io_status();
     return static_cast<int64_t>(rh[1]);
+  }
+
+  static int64_t io_status() {
+    return (errno == EAGAIN || errno == EWOULDBLOCK) ? -1001 : -1000;
   }
 };
 
@@ -756,13 +798,19 @@ void pss_destroy(void* h) {
 }
 
 // ---- client ----
-void* psc_connect(const char* host, int port) {
+void* psc_connect2(const char* host, int port, int connect_ms, int io_ms) {
   PsConn* c = new PsConn();
-  if (!c->connect_to(host, port)) {
+  if (!c->connect_to(host, port, connect_ms, io_ms)) {
     delete c;
     return nullptr;
   }
   return c;
+}
+void* psc_connect(const char* host, int port) {
+  return psc_connect2(host, port, 0, 0);  // legacy: blocking, no deadline
+}
+void psc_set_timeout(void* h, int io_ms) {
+  static_cast<PsConn*>(h)->set_io_timeout(io_ms);
 }
 void psc_close(void* h) { delete static_cast<PsConn*>(h); }
 

@@ -19,17 +19,30 @@ from __future__ import annotations
 
 import ctypes
 import os
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..core.enforce import NotFoundError, PreconditionNotMetError, enforce
+from ..core.flags import define_flag, flag
 from ..core.profiler import RecordEvent
 from .accessor import AccessorConfig
 from .client import PSClient
 from .native import load_native, table_native_params
 from .table import (TableConfig, format_shard_row, merge_duplicate_keys,
                     parse_shard_row)
+
+# transport robustness knobs (the brpc client's FLAGS_pserver_* family,
+# brpc_ps_client.cc:24-45); env-overridable as FLAGS_pserver_*
+define_flag("pserver_connect_timeout_ms", 10000,
+            "PS client TCP connect deadline (0 = blocking)")
+define_flag("pserver_timeout_ms", 30000,
+            "PS client per-call IO deadline (0 = block forever)")
+define_flag("pserver_max_retry", 3,
+            "attempts per PS call across reconnects before failing")
+define_flag("pserver_retry_backoff_ms", 100,
+            "base backoff between PS call retries (doubles per attempt)")
 
 __all__ = ["NativePsServer", "RpcPsClient", "RemoteSparseTable",
            "rpc_available"]
@@ -74,6 +87,10 @@ def _configure_rpc(lib: ctypes.CDLL) -> None:
     lib.pss_destroy.argtypes = [ctypes.c_void_p]
     lib.psc_connect.restype = ctypes.c_void_p
     lib.psc_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.psc_connect2.restype = ctypes.c_void_p
+    lib.psc_connect2.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                                 ctypes.c_int]
+    lib.psc_set_timeout.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.psc_close.argtypes = [ctypes.c_void_p]
     lib.psc_call.restype = ctypes.c_int64
     lib.psc_call.argtypes = [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
@@ -142,18 +159,44 @@ class NativePsServer:
 
 
 class _ServerConn:
-    """One TCP connection (C++ PsConn) with the call/resp protocol."""
+    """One TCP connection (C++ PsConn) with the call/resp protocol,
+    hardened like the brpc channel (brpc_ps_client.cc:24-45): connect
+    and per-call IO deadlines from the FLAGS_pserver_* family, bounded
+    retry with exponential backoff, and reconnect-on-reset (a transport
+    failure leaves the framed stream undefined, so the socket is
+    rebuilt, never reused). Retries give at-least-once semantics for
+    non-idempotent commands (push, global_step) exactly as brpc's
+    channel retry does; ``retries=0`` opts a call out (barrier)."""
 
     def __init__(self, lib: ctypes.CDLL, host: str, port: int) -> None:
+        import threading
+
         self._lib = lib
-        self._h = lib.psc_connect(host.encode(), port)
+        self._host, self._port = host, port
+        self._h = None
+        # serializes the whole call/close/reconnect/set_timeout sequence:
+        # the C++ mutex only protects a single psc_call, but reconnect
+        # DELETES the PsConn — without this lock a trainer-thread retry
+        # could free the handle under the Communicator's in-flight push
+        self._mu = threading.RLock()
+        with self._mu:
+            self._connect()
+
+    def _connect(self) -> None:
+        self._h = self._lib.psc_connect2(
+            self._host.encode(), self._port,
+            int(flag("pserver_connect_timeout_ms")),
+            int(flag("pserver_timeout_ms")))
         if not self._h:
-            raise PreconditionNotMetError(f"cannot connect to PS server {host}:{port}")
+            raise PreconditionNotMetError(
+                f"cannot connect to PS server {self._host}:{self._port} "
+                f"(connect timeout {flag('pserver_connect_timeout_ms')} ms)")
 
     def close(self) -> None:
-        if self._h:
-            self._lib.psc_close(self._h)
-            self._h = None
+        with self._mu:
+            if self._h:
+                self._lib.psc_close(self._h)
+                self._h = None
 
     def __del__(self):
         try:
@@ -161,11 +204,16 @@ class _ServerConn:
         except Exception:
             pass
 
-    def call(self, cmd: int, table_id: int = 0, n: int = 0, aux: int = 0,
-             payload: Optional[bytes] = None) -> Tuple[int, bytes]:
-        buf = payload or b""
-        status = int(self._lib.psc_call(self._h, cmd, table_id, n, aux, buf, len(buf)))
-        enforce(status != -1000, "PS transport failure (server gone?)")
+    def _call_once(self, cmd, table_id, n, aux, buf) -> Tuple[int, bytes]:
+        status = int(self._lib.psc_call(self._h, cmd, table_id, n, aux, buf,
+                                        len(buf)))
+        if status <= -1000:
+            # undefined stream state: drop the socket before any retry
+            self.close()
+            kind = "timed out" if status == -1001 else "reset/refused"
+            raise PreconditionNotMetError(
+                f"PS transport to {self._host}:{self._port} {kind} "
+                f"(cmd {cmd})")
         rlen = int(self._lib.psc_resp_len(self._h))
         if not rlen:
             return status, b""
@@ -173,9 +221,42 @@ class _ServerConn:
         self._lib.psc_resp_copy(self._h, resp)
         return status, resp.raw
 
+    def call(self, cmd: int, table_id: int = 0, n: int = 0, aux: int = 0,
+             payload: Optional[bytes] = None,
+             retries: Optional[int] = None,
+             block: bool = False) -> Tuple[int, bytes]:
+        """``retries``: attempts beyond the first (default
+        FLAGS_pserver_max_retry - 1). ``block``: disable the IO deadline
+        for this call (barrier legitimately waits on other trainers)."""
+        buf = payload or b""
+        if retries is None:
+            retries = max(0, int(flag("pserver_max_retry")) - 1)
+        backoff = int(flag("pserver_retry_backoff_ms")) / 1000.0
+        last: Optional[Exception] = None
+        for attempt in range(retries + 1):
+            try:
+                with self._mu:  # one caller owns connect/call/close at a time
+                    if self._h is None:
+                        self._connect()
+                    if block:
+                        self._lib.psc_set_timeout(self._h, 0)
+                    try:
+                        return self._call_once(cmd, table_id, n, aux, buf)
+                    finally:
+                        if block and self._h:
+                            self._lib.psc_set_timeout(
+                                self._h, int(flag("pserver_timeout_ms")))
+            except PreconditionNotMetError as e:
+                last = e
+                if attempt < retries:
+                    time.sleep(backoff * (2 ** attempt))
+        raise PreconditionNotMetError(
+            f"PS server {self._host}:{self._port} unreachable after "
+            f"{retries + 1} attempt(s): {last}")
+
     def check(self, cmd: int, table_id: int = 0, n: int = 0, aux: int = 0,
-              payload: Optional[bytes] = None) -> Tuple[int, bytes]:
-        status, resp = self.call(cmd, table_id, n, aux, payload)
+              payload: Optional[bytes] = None, **kw) -> Tuple[int, bytes]:
+        status, resp = self.call(cmd, table_id, n, aux, payload, **kw)
         if status == -2:
             raise NotFoundError(f"table {table_id} not created on server")
         enforce(status >= 0, f"PS command {cmd} failed with status {status}")
@@ -385,8 +466,10 @@ class RpcPsClient(PSClient):
         return np.concatenate(all_keys), np.concatenate(all_deltas)
 
     def barrier(self):
-        # all-trainer barrier lives on server 0 (BarrierTable placement)
-        self._conns[0].check(_BARRIER)
+        # all-trainer barrier lives on server 0 (BarrierTable placement);
+        # block=True lifts the IO deadline (waiting on peers is not a
+        # fault) and retries=0 avoids double-arrival on a flaky link
+        self._conns[0].check(_BARRIER, block=True, retries=0)
 
     def global_step(self, increment: int = 1) -> int:
         status, _ = self._conns[0].check(_GLOBAL_STEP, n=increment)
@@ -514,7 +597,7 @@ class RpcPsClient(PSClient):
     def stop_servers(self) -> None:
         for c in self._conns:
             try:
-                c.call(_STOP)
+                c.call(_STOP, retries=0)  # a gone server is already stopped
             except Exception:
                 pass
 

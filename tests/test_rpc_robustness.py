@@ -1,0 +1,172 @@
+"""PS transport robustness: timeouts, bounded retry, reconnect and
+failover when servers die mid-training.
+
+Reference counterpart: the brpc client's FLAGS_pserver_* deadline/retry
+family (brpc_ps_client.cc:24-45) and the elastic manager's expectation
+that a dead pserver surfaces as a clean, bounded error rather than a
+hang (fleet/elastic/manager.py).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.ps.accessor import AccessorConfig
+from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+from paddle_tpu.ps.table import TableConfig
+
+rpc = pytest.importorskip("paddle_tpu.ps.rpc")
+
+pytestmark = pytest.mark.skipif(
+    not rpc.rpc_available(), reason="native toolchain unavailable")
+
+_SERVER_SCRIPT = """
+import sys
+import time
+from paddle_tpu.ps.rpc import NativePsServer
+s = NativePsServer(port=int(sys.argv[1]), n_trainers=1)
+print("READY", s.port, flush=True)
+time.sleep(3600)
+"""
+
+
+def _acc():
+    return AccessorConfig(sgd=SGDRuleConfig(initial_range=0.0))
+
+
+def _spawn_server(port=0):
+    p = subprocess.Popen([sys.executable, "-c", _SERVER_SCRIPT, str(port)],
+                         stdout=subprocess.PIPE, text=True, cwd=_REPO_ROOT)
+    line = p.stdout.readline().strip()
+    assert line.startswith("READY"), line
+    return p, int(line.split()[1])
+
+
+@pytest.fixture
+def fast_flags():
+    """Short deadlines so failure paths stay test-sized; restored after."""
+    saved = pt.get_flags(["pserver_connect_timeout_ms", "pserver_timeout_ms",
+                          "pserver_max_retry", "pserver_retry_backoff_ms"])
+    pt.set_flags({"pserver_connect_timeout_ms": 1000,
+                  "pserver_timeout_ms": 800,
+                  "pserver_max_retry": 2,
+                  "pserver_retry_backoff_ms": 20})
+    yield
+    pt.set_flags(saved)
+
+
+def test_kill_server_mid_training_raises_bounded(fast_flags):
+    """SIGKILL a live server mid-training: the next call fails with a
+    clean PreconditionNotMetError naming the endpoint, within the
+    retry×timeout budget — never a hang, never a wedged trainer."""
+    proc, port = _spawn_server()
+    try:
+        cli = rpc.RpcPsClient([f"127.0.0.1:{port}"])
+        cli.create_sparse_table(0, TableConfig(shard_num=4,
+                                               accessor_config=_acc()))
+        keys = np.arange(1, 64, dtype=np.uint64)
+        assert (cli.pull_sparse(0, keys) == 0).all()  # training under way
+
+        proc.kill()
+        proc.wait()
+        t0 = time.monotonic()
+        with pytest.raises(Exception, match="unreachable|refused|reset"):
+            cli.pull_sparse(0, keys)
+        elapsed = time.monotonic() - t0
+        # 2 attempts × (≤1s connect) + backoff — well under the 30s the
+        # old transport would hang for (forever, on a half-open peer)
+        assert elapsed < 10, elapsed
+        cli.close()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_unresponsive_server_call_times_out(fast_flags):
+    """A server that accepts but never answers (wedged host) trips the
+    per-call IO deadline instead of blocking the trainer forever."""
+    lst = socket.socket()
+    lst.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(4)
+    port = lst.getsockname()[1]
+    accepted = []
+    import threading
+
+    def sink():
+        try:
+            while True:
+                c, _ = lst.accept()
+                accepted.append(c)  # read nothing, answer nothing
+        except OSError:
+            pass
+
+    th = threading.Thread(target=sink, daemon=True)
+    th.start()
+    try:
+        cli = rpc.RpcPsClient([f"127.0.0.1:{port}"])
+        t0 = time.monotonic()
+        with pytest.raises(Exception, match="unreachable|timed out"):
+            cli.create_sparse_table(0, TableConfig(shard_num=4,
+                                                   accessor_config=_acc()))
+        elapsed = time.monotonic() - t0
+        assert elapsed < 10, elapsed  # 2 × 0.8s deadline + backoff
+        cli.close()
+    finally:
+        lst.close()
+        for c in accepted:
+            c.close()
+
+
+def test_failover_to_restarted_server(fast_flags):
+    """Stretch goal: kill a server, restart it on the same port, and the
+    SAME client object recovers via reconnect — re-create the table,
+    reload the checkpoint, keep training (the elastic resume loop)."""
+    proc, port = _spawn_server()
+    cli = None
+    try:
+        cfg = TableConfig(shard_num=4, accessor_config=_acc())
+        cli = rpc.RpcPsClient([f"127.0.0.1:{port}"])
+        cli.create_sparse_table(0, cfg)
+        keys = np.arange(1, 128, dtype=np.uint64)
+        push = np.zeros((len(keys), 12), np.float32)
+        push[:, 1] = 1.0
+        push[:, 3:] = 0.25
+        cli.pull_sparse(0, keys)
+        cli.push_sparse(0, keys, push)
+        before = cli.pull_sparse(0, keys, create=False)
+
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as ckpt:
+            cli.save(0, ckpt)
+
+            proc.kill()
+            proc.wait()
+            with pytest.raises(Exception, match="unreachable"):
+                cli.pull_sparse(0, keys, create=False)
+
+            proc, port2 = _spawn_server(port)  # same endpoint comes back
+            assert port2 == port
+            # the client's retry loop reconnects transparently; state is
+            # restored from the checkpoint (auto-checkpoint resume role)
+            cli.create_sparse_table(0, cfg)
+            cli.load(0, ckpt)
+        after = cli.pull_sparse(0, keys, create=False)
+        np.testing.assert_allclose(after, before, atol=1e-6)
+        # and training continues
+        cli.push_sparse(0, keys, push)
+        assert cli.size(0) == len(keys)
+    finally:
+        if cli is not None:
+            cli.close()
+        if proc.poll() is None:
+            proc.kill()
