@@ -30,9 +30,11 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <chrono>
 #include <condition_variable>
 #include <cstdio>
 #include <cstring>
+#include <ctime>
 #include <map>
 #include <memory>
 #include <string>
@@ -135,7 +137,7 @@ bool read_full(int fd, void* buf, size_t len) {
   while (len > 0) {
     ssize_t r = ::recv(fd, p, len, 0);
     if (r < 0 && errno == EINTR) continue;
-    if (r <= 0) return false;  // errno survives for the caller (timeout?)
+    if (r <= 0) return false;
     p += r;
     len -= static_cast<size_t>(r);
   }
@@ -661,7 +663,22 @@ struct PsServer {
           bar_gen++;
           bar_cv.notify_all();
         } else {
-          bar_cv.wait(lk, [&]() { return bar_gen != my_gen || stopping.load(); });
+          // wait in slices, watching the waiter's own connection: if the
+          // client gave up (deadline) or died, CANCEL its arrival — a
+          // phantom arrival would release the next generation with n-1
+          // real trainers, permanently desynchronizing the group
+          for (;;) {
+            if (bar_cv.wait_for(lk, std::chrono::milliseconds(100), [&]() {
+                  return bar_gen != my_gen || stopping.load();
+                }))
+              break;
+            char probe;
+            ssize_t r = ::recv(fd, &probe, 1, MSG_PEEK | MSG_DONTWAIT);
+            if (r == 0 || (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
+              if (bar_gen == my_gen) --bar_count;  // still un-released
+              return false;  // drop the connection; no response owed
+            }
+          }
         }
         return respond(fd, 0, nullptr, 0);
       }
@@ -683,24 +700,29 @@ struct PsServer {
 // client connection: synchronous request/response; a mutex serializes
 // callers (the python Communicator provides async via its own threads).
 // Timeouts mirror the brpc client's FLAGS_pserver_connect_timeout_ms /
-// FLAGS_pserver_timeout_ms knobs (brpc_ps_client.cc:24-45): connect via
-// non-blocking + poll deadline, per-call IO via SO_RCVTIMEO/SO_SNDTIMEO.
+// FLAGS_pserver_timeout_ms knobs (brpc_ps_client.cc:24-45). The socket
+// stays non-blocking; every send/recv waits via poll against ONE
+// absolute deadline for the whole RPC — a per-syscall SO_RCVTIMEO would
+// let a server dripping bytes stretch a "30s" call indefinitely.
+static int64_t now_ms() {
+  timespec ts;
+  ::clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
 struct PsConn {
   int fd = -1;
+  int io_ms = 0;  // whole-call budget; 0 = no deadline
   std::mutex mu;
 
   ~PsConn() {
     if (fd >= 0) ::close(fd);
   }
 
-  void set_io_timeout(int io_ms) {
-    if (fd < 0) return;
-    timeval tv{io_ms / 1000, (io_ms % 1000) * 1000};  // 0 = block forever
-    ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-    ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
-  }
+  void set_io_timeout(int ms) { io_ms = ms; }
 
-  bool connect_to(const char* host, int port, int connect_ms, int io_ms) {
+  bool connect_to(const char* host, int port, int connect_ms, int io_ms_) {
+    io_ms = io_ms_;
     // resolve hostnames too (cluster endpoint lists are usually names)
     addrinfo hints{};
     hints.ai_family = AF_INET;
@@ -715,24 +737,30 @@ struct PsConn {
       ::freeaddrinfo(res);
       return false;
     }
-    bool ok;
-    if (connect_ms > 0) {
-      int fl = ::fcntl(fd, F_GETFL, 0);
-      ::fcntl(fd, F_SETFL, fl | O_NONBLOCK);
-      int rc = ::connect(fd, res->ai_addr, res->ai_addrlen);
-      ok = rc == 0;
-      if (rc < 0 && errno == EINPROGRESS) {
+    int fl = ::fcntl(fd, F_GETFL, 0);
+    ::fcntl(fd, F_SETFL, fl | O_NONBLOCK);  // stays non-blocking for life
+    int rc = ::connect(fd, res->ai_addr, res->ai_addrlen);
+    bool ok = rc == 0;
+    if (rc < 0 && errno == EINPROGRESS) {
+      int64_t deadline = connect_ms > 0 ? now_ms() + connect_ms : 0;
+      for (;;) {
+        int wait = -1;
+        if (deadline) {
+          int64_t rem = deadline - now_ms();
+          if (rem <= 0) break;  // timed out
+          wait = static_cast<int>(rem);
+        }
         pollfd pfd{fd, POLLOUT, 0};
-        if (::poll(&pfd, 1, connect_ms) == 1) {
+        int pr = ::poll(&pfd, 1, wait);
+        if (pr < 0 && errno == EINTR) continue;  // signal ≠ failure
+        if (pr == 1) {
           int err = 0;
           socklen_t elen = sizeof(err);
           ::getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen);
           ok = err == 0;
         }
+        break;
       }
-      if (ok) ::fcntl(fd, F_SETFL, fl);  // back to blocking IO
-    } else {
-      ok = ::connect(fd, res->ai_addr, res->ai_addrlen) == 0;
     }
     ::freeaddrinfo(res);
     if (!ok) {
@@ -742,32 +770,75 @@ struct PsConn {
     }
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    if (io_ms > 0) set_io_timeout(io_ms);
+    // detect a silently dead peer even on deadline-less calls (barrier):
+    // probe after 30s idle, 3 probes 10s apart → ~60s to surface (the
+    // kernel defaults of 2h idle would defeat the purpose)
+    ::setsockopt(fd, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+    int idle = 30, intvl = 10, cnt = 3;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_KEEPIDLE, &idle, sizeof(idle));
+    ::setsockopt(fd, IPPROTO_TCP, TCP_KEEPINTVL, &intvl, sizeof(intvl));
+    ::setsockopt(fd, IPPROTO_TCP, TCP_KEEPCNT, &cnt, sizeof(cnt));
     return true;
   }
 
-  // returns status; fills resp (resized). -1000 on transport failure
-  // (peer reset/gone), -1001 on IO deadline expiry. Either way the
-  // protocol stream is undefined afterwards — callers must reconnect
-  // before reusing the handle.
-  int64_t call(uint32_t cmd, uint32_t table_id, int64_t n, int32_t aux,
-               const void* payload, uint64_t plen, std::vector<char>* resp) {
-    std::lock_guard<std::mutex> g(mu);
-    if (fd < 0) return -1000;
-    ReqHeader h{plen, cmd, table_id, n, aux};
-    errno = 0;
-    if (!write_full(fd, &h, sizeof(h))) return io_status();
-    if (plen && !write_full(fd, payload, plen)) return io_status();
-    uint64_t rh[2];
-    if (!read_full(fd, rh, sizeof(rh))) return io_status();
-    if (rh[0] > kMaxPayload) return -1000;
-    resp->resize(rh[0]);
-    if (rh[0] && !read_full(fd, resp->data(), rh[0])) return io_status();
-    return static_cast<int64_t>(rh[1]);
+  // one fully-sent/received buffer under the call's absolute deadline;
+  // 0 ok, -1000 peer reset/gone, -1001 deadline expired
+  int64_t io_full(void* buf, size_t len, bool wr, int64_t deadline) {
+    char* p = static_cast<char*>(buf);
+    while (len > 0) {
+      ssize_t r = wr ? ::send(fd, p, len, MSG_NOSIGNAL)
+                     : ::recv(fd, p, len, 0);
+      if (r > 0) {
+        p += r;
+        len -= static_cast<size_t>(r);
+        continue;
+      }
+      if (r == 0) return -1000;  // orderly shutdown mid-frame
+      if (errno == EINTR) continue;
+      if (errno != EAGAIN && errno != EWOULDBLOCK) return -1000;
+      int wait = -1;
+      if (deadline) {
+        int64_t rem = deadline - now_ms();
+        if (rem <= 0) return -1001;
+        wait = static_cast<int>(rem);
+      }
+      pollfd pfd{fd, static_cast<short>(wr ? POLLOUT : POLLIN), 0};
+      int pr = ::poll(&pfd, 1, wait);
+      if (pr < 0) {
+        if (errno == EINTR) continue;
+        return -1000;
+      }
+      if (pr == 0) return -1001;
+      // POLLERR/POLLHUP: fall through — the next send/recv reports it
+    }
+    return 0;
   }
 
-  static int64_t io_status() {
-    return (errno == EAGAIN || errno == EWOULDBLOCK) ? -1001 : -1000;
+  // returns status; fills resp (resized). -1000 on transport failure
+  // (peer reset/gone), -1001 on whole-call deadline expiry. Either way
+  // the protocol stream is undefined afterwards — callers must
+  // reconnect before reusing the handle. ``io_override``: per-call
+  // deadline in ms (-1 = connection default, 0 = none).
+  int64_t call(uint32_t cmd, uint32_t table_id, int64_t n, int32_t aux,
+               const void* payload, uint64_t plen, std::vector<char>* resp,
+               int io_override = -1) {
+    std::lock_guard<std::mutex> g(mu);
+    if (fd < 0) return -1000;
+    int ms = io_override >= 0 ? io_override : io_ms;
+    int64_t deadline = ms > 0 ? now_ms() + ms : 0;
+    ReqHeader h{plen, cmd, table_id, n, aux};
+    int64_t rc;
+    if ((rc = io_full(&h, sizeof(h), true, deadline)) != 0) return rc;
+    if (plen && (rc = io_full(const_cast<void*>(payload), plen, true,
+                              deadline)) != 0)
+      return rc;
+    uint64_t rh[2];
+    if ((rc = io_full(rh, sizeof(rh), false, deadline)) != 0) return rc;
+    if (rh[0] > kMaxPayload) return -1000;
+    resp->resize(rh[0]);
+    if (rh[0] && (rc = io_full(resp->data(), rh[0], false, deadline)) != 0)
+      return rc;
+    return static_cast<int64_t>(rh[1]);
   }
 };
 
@@ -821,6 +892,13 @@ int64_t psc_call(void* h, uint32_t cmd, uint32_t table_id, int64_t n,
                  int32_t aux, const void* payload, uint64_t plen) {
   return static_cast<PsConn*>(h)->call(cmd, table_id, n, aux, payload, plen,
                                        &g_resp);
+}
+// per-call deadline variant: timeout_ms -1 = connection default, 0 = none
+int64_t psc_call2(void* h, uint32_t cmd, uint32_t table_id, int64_t n,
+                  int32_t aux, const void* payload, uint64_t plen,
+                  int32_t timeout_ms) {
+  return static_cast<PsConn*>(h)->call(cmd, table_id, n, aux, payload, plen,
+                                       &g_resp, timeout_ms);
 }
 uint64_t psc_resp_len(void*) { return g_resp.size(); }
 void psc_resp_copy(void*, void* out) {

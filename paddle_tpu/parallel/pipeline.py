@@ -168,8 +168,9 @@ class PipelineTrainer:
       activation stash + per-stage recompute (section_worker.cc:139-189).
     - ``"interleave"`` — Megatron-style interleaved 1F1B with
       ``num_virtual`` chunks per rank (pipeline_parallel.py:30 dygraph
-      interleave); model must supply ``pp × num_virtual`` stages and
-      num_micro must divide by the pp size.
+      interleave); model must supply ``pp × num_virtual`` stages.
+      Arbitrary micro counts are handled by masking the padded tail of
+      the schedule (see parallel/pipeline_1f1b.py).
 
     When the mesh has a ``dp_axis`` axis, each micro-batch SHARDS over
     it and the loss is the mean of the per-shard means — ``loss_fn``

@@ -33,7 +33,17 @@ l = v·S + r lives on rank l mod S):
 
 The final logical stage seeds the backward in the same tick as its
 forward (head + loss vjp); chunk-0-rank-0 backward feeds the embed vjp.
-Total ticks: MV + 2SV − 2. Interleave requires M to be a multiple of S.
+Total ticks: MpV + 2SV − 2.
+
+Arbitrary micro counts (the reference's schedules accept any M —
+section_worker.cc, pipeline_parallel.py:30): the enumeration walks
+micros in groups of S, so for V > 1 the micro count is PADDED to
+Mp = ceil(M/S)·S and the phantom tail items (micro id ≥ M) are masked
+out of every effect — stash writes, loss, grad accumulation. The
+padded schedule is literally the Mp-micro schedule with some items
+inert, so the ring-slot non-collision proof carries over unchanged;
+phantom items still tick the ring rotations with (finite) garbage that
+only ever flows into other phantom items' masked accumulations.
 """
 
 from __future__ import annotations
@@ -82,11 +92,10 @@ def pipeline_1f1b_fn(
     S, V, M = num_stages, num_virtual, num_micro
     SV = S * V
     R = 2 * SV  # stash ring slots
-    if V > 1 and M % S != 0:
-        raise ValueError(
-            f"interleaved schedule needs num_micro % num_stages == 0 "
-            f"(got M={M}, S={S})")
-    total_ticks = M * V + 2 * SV - 2
+    # pad the micro enumeration to whole groups of S; tail items masked
+    Mp = M if V == 1 else -(-M // S) * S
+    MVp = Mp * V
+    total_ticks = MVp + 2 * SV - 2
 
     def fn(chunk_state, aux_state, x_micro, y_micro):
         r = lax.axis_index(pp_axis)
@@ -120,10 +129,11 @@ def pipeline_1f1b_fn(
 
             # ---------------- forward work item ----------------
             u = t - r
-            fwd_ok = (u >= 0) & (u < M * V)
-            uc = jnp.clip(u, 0, M * V - 1)
+            fwd_ok = (u >= 0) & (u < MVp)
+            uc = jnp.clip(u, 0, MVp - 1)
             v = (uc // S) % V
             f = (uc % S) + S * (uc // SV)
+            fwd_ok &= f < M  # phantom tail micro (padding) — inert
             x_f = lax.dynamic_index_in_dim(x_micro, jnp.clip(f, 0, M - 1), 0,
                                            keepdims=False)
             first_logical = (r == 0) & (v == 0)
@@ -163,11 +173,12 @@ def pipeline_1f1b_fn(
             w_sel = jnp.zeros((), jnp.int32)
             for j in range(V):
                 w = t + r + S * j - (2 * SV - 2)
-                ok = (w >= 0) & (w < M * V) & ((w % SV) < S)
+                ok = (w >= 0) & (w < MVp) & ((w % SV) < S)
+                ok &= (w % SV) + S * (w // SV) < M  # phantom tail micro
                 j_b = jnp.where(ok, j, j_b)
                 w_sel = jnp.where(ok, w, w_sel)
                 bwd_ok = bwd_ok | ok
-            wc = jnp.clip(w_sel, 0, M * V - 1)
+            wc = jnp.clip(w_sel, 0, MVp - 1)
             f_b = (wc % SV) + S * (wc // SV)
             l_b = r + S * j_b
             slot_b = (wc + S * j_b) % R

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -43,6 +44,12 @@ define_flag("pserver_max_retry", 3,
             "attempts per PS call across reconnects before failing")
 define_flag("pserver_retry_backoff_ms", 100,
             "base backoff between PS call retries (doubles per attempt)")
+define_flag("pserver_long_call_timeout_ms", 600000,
+            "deadline for table-scale commands (save/load/export/shrink/"
+            "compact/ssd-create) whose runtime grows with table size")
+define_flag("pserver_barrier_timeout_ms", 1800000,
+            "barrier wait bound — generous (peers may legitimately be "
+            "minutes behind) but finite, so a dead server still surfaces")
 
 __all__ = ["NativePsServer", "RpcPsClient", "RemoteSparseTable",
            "rpc_available"]
@@ -76,6 +83,11 @@ _COMPACT = 24
 _DENSE_OPT_IDS = {"sgd": 0, "adam": 1, "sum": 2}
 
 
+def _long_ms() -> int:
+    """Deadline for commands whose runtime scales with table size."""
+    return int(flag("pserver_long_call_timeout_ms"))
+
+
 def _configure_rpc(lib: ctypes.CDLL) -> None:
     lib.pss_create.restype = ctypes.c_void_p
     lib.pss_create.argtypes = [ctypes.c_int, ctypes.c_int]
@@ -96,6 +108,10 @@ def _configure_rpc(lib: ctypes.CDLL) -> None:
     lib.psc_call.argtypes = [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
                              ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
                              ctypes.c_uint64]
+    lib.psc_call2.restype = ctypes.c_int64
+    lib.psc_call2.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                              ctypes.c_uint32, ctypes.c_int64, ctypes.c_int32,
+                              ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int32]
     lib.psc_resp_len.restype = ctypes.c_uint64
     lib.psc_resp_len.argtypes = [ctypes.c_void_p]
     lib.psc_resp_copy.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
@@ -169,8 +185,6 @@ class _ServerConn:
     channel retry does; ``retries=0`` opts a call out (barrier)."""
 
     def __init__(self, lib: ctypes.CDLL, host: str, port: int) -> None:
-        import threading
-
         self._lib = lib
         self._host, self._port = host, port
         self._h = None
@@ -179,8 +193,7 @@ class _ServerConn:
         # DELETES the PsConn — without this lock a trainer-thread retry
         # could free the handle under the Communicator's in-flight push
         self._mu = threading.RLock()
-        with self._mu:
-            self._connect()
+        self._connect()
 
     def _connect(self) -> None:
         self._h = self._lib.psc_connect2(
@@ -204,9 +217,11 @@ class _ServerConn:
         except Exception:
             pass
 
-    def _call_once(self, cmd, table_id, n, aux, buf) -> Tuple[int, bytes]:
-        status = int(self._lib.psc_call(self._h, cmd, table_id, n, aux, buf,
-                                        len(buf)))
+    def _call_once(self, cmd, table_id, n, aux, buf,
+                   timeout_ms) -> Tuple[int, bytes]:
+        status = int(self._lib.psc_call2(
+            self._h, cmd, table_id, n, aux, buf, len(buf),
+            -1 if timeout_ms is None else timeout_ms))
         if status <= -1000:
             # undefined stream state: drop the socket before any retry
             self.close()
@@ -224,10 +239,11 @@ class _ServerConn:
     def call(self, cmd: int, table_id: int = 0, n: int = 0, aux: int = 0,
              payload: Optional[bytes] = None,
              retries: Optional[int] = None,
-             block: bool = False) -> Tuple[int, bytes]:
+             timeout_ms: Optional[int] = None) -> Tuple[int, bytes]:
         """``retries``: attempts beyond the first (default
-        FLAGS_pserver_max_retry - 1). ``block``: disable the IO deadline
-        for this call (barrier legitimately waits on other trainers)."""
+        FLAGS_pserver_max_retry - 1). ``timeout_ms``: whole-call deadline
+        override for this call (long table-scale commands, barrier);
+        None = FLAGS_pserver_timeout_ms, 0 = no deadline."""
         buf = payload or b""
         if retries is None:
             retries = max(0, int(flag("pserver_max_retry")) - 1)
@@ -238,14 +254,8 @@ class _ServerConn:
                 with self._mu:  # one caller owns connect/call/close at a time
                     if self._h is None:
                         self._connect()
-                    if block:
-                        self._lib.psc_set_timeout(self._h, 0)
-                    try:
-                        return self._call_once(cmd, table_id, n, aux, buf)
-                    finally:
-                        if block and self._h:
-                            self._lib.psc_set_timeout(
-                                self._h, int(flag("pserver_timeout_ms")))
+                    return self._call_once(cmd, table_id, n, aux, buf,
+                                           timeout_ms)
             except PreconditionNotMetError as e:
                 last = e
                 if attempt < retries:
@@ -312,7 +322,8 @@ class RpcPsClient(PSClient):
                 payload = (base + np.asarray([1], np.int32).tobytes()
                            + np.asarray([len(path)], np.uint32).tobytes()
                            + path)
-            _, resp = c.check(_CREATE_SPARSE, table_id, payload=payload)
+            _, resp = c.check(_CREATE_SPARSE, table_id, payload=payload,
+                              timeout_ms=_long_ms())
             dims = np.frombuffer(resp, np.int32)
             self._sparse_dims[table_id] = (int(dims[0]), int(dims[1]), int(dims[2]))
 
@@ -321,7 +332,8 @@ class RpcPsClient(PSClient):
     def spill(self, table_id: int, hot_budget: int) -> int:
         """Per-server spill to at most hot_budget hot rows each; returns
         total rows spilled."""
-        return sum(int(c.check(_SPILL, table_id, n=int(hot_budget))[0])
+        return sum(int(c.check(_SPILL, table_id, n=int(hot_budget),
+                               timeout_ms=_long_ms(), retries=0)[0])
                    for c in self._conns)
 
     def table_stats(self, table_id: int) -> Dict[str, int]:
@@ -335,7 +347,8 @@ class RpcPsClient(PSClient):
         return out
 
     def compact(self, table_id: int) -> int:
-        return sum(int(c.check(_COMPACT, table_id)[0]) for c in self._conns)
+        return sum(int(c.check(_COMPACT, table_id, timeout_ms=_long_ms(),
+                               retries=0)[0]) for c in self._conns)
 
     def create_dense_table(self, table_id: int, dim: int, optimizer: str = "adam",
                            lr: float = 0.001) -> None:
@@ -467,16 +480,19 @@ class RpcPsClient(PSClient):
 
     def barrier(self):
         # all-trainer barrier lives on server 0 (BarrierTable placement);
-        # block=True lifts the IO deadline (waiting on peers is not a
-        # fault) and retries=0 avoids double-arrival on a flaky link
-        self._conns[0].check(_BARRIER, block=True, retries=0)
+        # a long-but-finite deadline (peers may legitimately lag, but a
+        # silently dead server must still surface) and retries=0 so a
+        # flaky link can't double-arrive
+        self._conns[0].check(_BARRIER, retries=0,
+                             timeout_ms=int(flag("pserver_barrier_timeout_ms")))
 
     def global_step(self, increment: int = 1) -> int:
         status, _ = self._conns[0].check(_GLOBAL_STEP, n=increment)
         return status
 
     def shrink(self, table_id):
-        return sum(c.check(_SHRINK, table_id)[0] for c in self._conns)
+        return sum(c.check(_SHRINK, table_id, timeout_ms=_long_ms(),
+                           retries=0)[0] for c in self._conns)
 
     def size(self, table_id) -> int:
         return sum(c.check(_SIZE, table_id)[0] for c in self._conns)
@@ -511,7 +527,8 @@ class RpcPsClient(PSClient):
         for s, c in enumerate(self._conns):
             # single atomic command: snapshot+stream (concurrent savers
             # cannot interleave a begin/fetch pair)
-            cnt, resp = c.check(_SAVE_ALL, table_id, aux=mode)
+            cnt, resp = c.check(_SAVE_ALL, table_id, aux=mode,
+                                timeout_ms=_long_ms(), retries=0)
             keys = np.frombuffer(resp[: cnt * 8], np.uint64)
             values = np.frombuffer(resp[cnt * 8 :], np.float32).reshape(cnt, full_dim)
             path = os.path.join(dirname, f"part-{s:05d}.shard")
@@ -577,7 +594,8 @@ class RpcPsClient(PSClient):
             if create:
                 payload += slots_arr[sel].tobytes()
             _, resp = c.check(_EXPORT, table_id, n=len(sel),
-                              aux=1 if create else 0, payload=payload)
+                              aux=1 if create else 0, payload=payload,
+                              timeout_ms=_long_ms())
             nb = len(sel) * full_dim * 4
             out[sel] = np.frombuffer(resp[:nb], np.float32).reshape(len(sel), full_dim)
             found[sel] = np.frombuffer(resp[nb:], np.uint8).astype(bool)
@@ -592,7 +610,8 @@ class RpcPsClient(PSClient):
             if not len(sel):
                 continue
             payload = keys[sel].tobytes() + np.ascontiguousarray(values[sel]).tobytes()
-            c.check(_INSERT_FULL, table_id, n=len(sel), payload=payload)
+            c.check(_INSERT_FULL, table_id, n=len(sel), payload=payload,
+                    timeout_ms=_long_ms())
 
     def stop_servers(self) -> None:
         for c in self._conns:

@@ -1,0 +1,216 @@
+"""Elastic end-to-end with the PS stack: 2 workers + 1 PS server; a
+worker is SIGKILLed mid-pass, the survivor's ElasticManager detects the
+heartbeat loss, scales the world in, restores the table from the last
+complete auto-checkpoint and finishes the job solo — final table state
+is exactly-once consistent.
+
+Reference loop: fleet/elastic/manager.py:439-532 (watch → RESTART →
+endpoint rewrite) + incubate/checkpoint/auto_checkpoint.py resume; the
+consistency oracle is the additive show counter (every (pass, partition)
+must land exactly once despite the crash + replay).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.elastic import FileStore
+
+rpc = pytest.importorskip("paddle_tpu.ps.rpc")
+
+pytestmark = pytest.mark.skipif(
+    not rpc.rpc_available(), reason="native toolchain unavailable")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SERVER_SCRIPT = """
+import sys, time
+from paddle_tpu.ps.rpc import NativePsServer
+s = NativePsServer(port=0, n_trainers=1)
+print("READY", s.port, flush=True)
+time.sleep(3600)
+"""
+
+# Per-pass work: worker w pulls+pushes show=1 on its partition's keys.
+# The leader (rank 0) soft-syncs pass completion through the elastic
+# store (the BarrierTable is n_trainers-static, so dynamic membership
+# coordinates through the store like the reference's etcd), checkpoints
+# the table each completed pass, and on RESTART adopts the smaller
+# world, reloads the last complete checkpoint and replays from there.
+_WORKER_SCRIPT = """
+import json, os, sys, time
+import numpy as np
+from paddle_tpu.distributed.elastic import (ElasticManager, ElasticStatus,
+                                            FileStore)
+from paddle_tpu.ps.accessor import AccessorConfig
+from paddle_tpu.ps.rpc import RpcPsClient
+from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+from paddle_tpu.ps.table import TableConfig
+
+store_dir, endpoint, host, n_passes = sys.argv[1:5]
+P, NPART = int(n_passes), 2
+rank = int(host.split("-")[1])
+store = FileStore(store_dir)
+em = ElasticManager(store, "job", np=2, host=host,
+                    heartbeat_interval=0.2, heartbeat_ttl=1.2,
+                    elastic_timeout=1.0, min_np=1, max_np=2)
+em.start()
+
+cfg = TableConfig(shard_num=4, accessor_config=AccessorConfig(
+    sgd=SGDRuleConfig(initial_range=0.0)))
+cli = RpcPsClient([endpoint])
+cli.create_sparse_table(0, cfg)  # idempotent across trainers
+push_dim = 12
+
+# start gate: wait for BOTH members to heartbeat before training, or the
+# leader's first watch could scale in against a peer that is still
+# booting its interpreter (the reference's launcher joins the etcd
+# prefix before exec'ing trainers for the same reason)
+gate = time.time() + 30
+while len(em.alive_hosts()) < 2 and time.time() < gate:
+    time.sleep(0.1)
+assert len(em.alive_hosts()) == 2, em.alive_hosts()
+em._last_change = time.monotonic()  # membership settled; arm the timer
+
+
+def keys_of(part):
+    return (1 + part * 1000 + np.arange(50)).astype(np.uint64)
+
+
+def train(p, part):
+    keys = keys_of(part)
+    cli.pull_sparse(0, keys)
+    push = np.zeros((len(keys), push_dim), np.float32)
+    push[:, 1] = 1.0            # show += 1: the exactly-once oracle
+    push[:, 3:] = 0.01 * (p + 1)
+    cli.push_sparse(0, keys, push)
+    store.put(f"done/{p}/{part}", "1")
+
+
+def ckpt_dir(p):
+    return os.path.join(store_dir, f"table_ckpt_{p}")
+
+
+if rank == 1:
+    # victim: finishes passes 0..1, then stalls mid-pass 2 (after pull,
+    # before push) and waits for the SIGKILL the test delivers
+    for p in range(P):
+        if p == 2:
+            cli.pull_sparse(0, keys_of(1))
+            store.put("victim_at_pass", "2")
+            time.sleep(3600)
+        train(p, 1)
+        while int(store.get("completed") or -1) < p:
+            time.sleep(0.05)
+    sys.exit(0)
+
+# leader (rank 0)
+my_parts = [0]
+p = 0
+while p < P:
+    for part in my_parts:
+        train(p, part)
+    # wait for every partition of pass p (soft barrier over the store)
+    redo = False
+    while not all(store.get(f"done/{p}/{part}") for part in range(NPART)):
+        st = em.watch_once()
+        if st == ElasticStatus.RESTART:
+            new_np = em.adopt_world()
+            assert new_np == 1, new_np
+            store.put("scaled_in", "1")
+            my_parts = list(range(NPART))   # survivor owns all partitions
+            lp = int(store.get("completed") or -1)
+            if lp >= 0:
+                # restore: overwrite the live table from the last COMPLETE
+                # pass checkpoint (discards the aborted pass's partial
+                # pushes, ours included) and replay from there
+                cli.load(0, ckpt_dir(lp))
+            p = lp  # incremented below; replay starts at lp + 1
+            redo = True
+            break
+        assert st != ElasticStatus.ERROR, "dropped below min_np"
+        time.sleep(0.05)
+    if not redo:
+        cli.save(0, ckpt_dir(p))
+        store.put("completed", str(p))
+    p += 1
+
+em.stop()
+cli.stop_servers()
+cli.close()
+print("LEADER_DONE", flush=True)
+"""
+
+
+def test_elastic_scale_in_resumes_consistently(tmp_path):
+    n_passes = 6
+    store_dir = str(tmp_path / "store")
+    server = subprocess.Popen([sys.executable, "-c", _SERVER_SCRIPT],
+                              stdout=subprocess.PIPE, text=True,
+                              cwd=_REPO_ROOT)
+    procs = [server]
+    try:
+        line = server.stdout.readline().strip()
+        assert line.startswith("READY"), line
+        endpoint = f"127.0.0.1:{line.split()[1]}"
+
+        def spawn(host):
+            return subprocess.Popen(
+                [sys.executable, "-c", _WORKER_SCRIPT, store_dir, endpoint,
+                 host, str(n_passes)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                cwd=_REPO_ROOT)
+
+        leader = spawn("worker-0")
+        victim = spawn("worker-1")
+        procs += [leader, victim]
+
+        # wait for the victim to stall mid-pass, then SIGKILL it
+        store = FileStore(store_dir)
+        deadline = time.monotonic() + 60
+        while store.get("victim_at_pass") is None:
+            assert time.monotonic() < deadline, "victim never reached pass 2"
+            assert victim.poll() is None, victim.communicate()[0]
+            time.sleep(0.1)
+        victim.kill()
+        victim.wait()
+
+        out, _ = leader.communicate(timeout=120)
+        assert leader.returncode == 0, out
+        assert "LEADER_DONE" in out, out
+        assert store.get("scaled_in") == "1", "leader never scaled in"
+
+        # consistency: every (pass, partition) applied exactly once —
+        # show == n_passes on every key of BOTH partitions, including the
+        # dead worker's partition replayed by the survivor (the leader
+        # stopped the server after training, so read the final pass's
+        # published checkpoint)
+        final = os.path.join(store_dir, f"table_ckpt_{n_passes - 1}")
+        assert os.path.isdir(final)
+        import json
+
+        with open(os.path.join(final, "meta.json")) as f:
+            meta = json.load(f)
+        rows = {}
+        for s in range(meta["shard_num"]):
+            path = os.path.join(final, f"part-{s:05d}.shard")
+            if not os.path.exists(path):
+                continue
+            with open(path) as f:
+                for ln in f:
+                    parts = ln.split()
+                    if parts:
+                        rows[int(parts[0])] = float(parts[4])  # show column
+        expect = {int(k) for part in range(2)
+                  for k in (1 + part * 1000 + np.arange(50))}
+        assert set(rows) == expect, (len(rows), len(expect))
+        bad = {k: v for k, v in rows.items() if v != n_passes}
+        assert not bad, f"{len(bad)} keys with wrong show count: {list(bad.items())[:5]}"
+    finally:
+        for pproc in procs:
+            if pproc.poll() is None:
+                pproc.kill()

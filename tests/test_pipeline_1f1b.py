@@ -87,12 +87,31 @@ def test_interleave_matches_f_then_b_trajectory():
                                    err_msg=f"step {i}")
 
 
-def test_interleave_rejects_bad_micro():
-    mesh = mesh_mod.make_mesh({"dp": 2, "pp": 4})
-    with pytest.raises(ValueError):
-        PipelineTrainer(build(0, stages=8), optimizer.SGD(0.1),
-                        nn.functional.cross_entropy, mesh, num_micro=6,
-                        schedule="interleave", num_virtual=2)
+@pytest.mark.parametrize("S,V,M", [(2, 2, 3), (2, 3, 5), (4, 2, 6),
+                                   (4, 2, 7), (2, 2, 4), (4, 4, 5)])
+def test_interleave_arbitrary_micro_matches_serial(S, V, M):
+    """Property grid over (pp size, virtual chunks, micro count) with M
+    NOT divisible by S (plus one divisible control): the padded-tail
+    interleave schedule must match the serial model's loss exactly for
+    every geometry (pipeline_parallel.py:30 accepts arbitrary M)."""
+    mesh = mesh_mod.make_mesh({"dp": 8 // S, "pp": S})
+    n = M * (8 // S)  # one row per dp shard per micro-batch
+    rng = np.random.default_rng(S * 100 + V * 10 + M)
+    x = jnp.asarray(rng.normal(size=(n, 4)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 3, n), jnp.int32)
+
+    b = PipelineTrainer(build(0, stages=S * V), optimizer.SGD(0.2),
+                        nn.functional.cross_entropy, mesh, num_micro=M,
+                        schedule="interleave", num_virtual=V)
+    from paddle_tpu.executor import Trainer
+
+    s = Trainer(build(0, stages=S * V), optimizer.SGD(0.2),
+                _micro_mean_loss(M))
+    for i in range(3):
+        lb = float(b.train_step(x, y))
+        ls = float(s.train_step(x, y))
+        np.testing.assert_allclose(lb, ls, rtol=1e-3, atol=1e-5,
+                                   err_msg=f"S={S} V={V} M={M} step {i}")
 
 
 @pytest.mark.slow

@@ -54,11 +54,15 @@ def _spawn_server(port=0):
 def fast_flags():
     """Short deadlines so failure paths stay test-sized; restored after."""
     saved = pt.get_flags(["pserver_connect_timeout_ms", "pserver_timeout_ms",
-                          "pserver_max_retry", "pserver_retry_backoff_ms"])
+                          "pserver_max_retry", "pserver_retry_backoff_ms",
+                          "pserver_long_call_timeout_ms",
+                          "pserver_barrier_timeout_ms"])
     pt.set_flags({"pserver_connect_timeout_ms": 1000,
                   "pserver_timeout_ms": 800,
                   "pserver_max_retry": 2,
-                  "pserver_retry_backoff_ms": 20})
+                  "pserver_retry_backoff_ms": 20,
+                  "pserver_long_call_timeout_ms": 1500,
+                  "pserver_barrier_timeout_ms": 2000})
     yield
     pt.set_flags(saved)
 
@@ -124,6 +128,64 @@ def test_unresponsive_server_call_times_out(fast_flags):
         lst.close()
         for c in accepted:
             c.close()
+
+
+def test_barrier_deadline_is_finite(fast_flags):
+    """A barrier against a world that never completes (peer died before
+    arriving) trips the generous-but-finite barrier deadline instead of
+    wedging the trainer forever."""
+    lib = rpc._rpc_lib()
+    h = lib.pss_create(0, 2)  # 2-trainer barrier; only 1 will arrive
+    port = int(lib.pss_port(h))
+    try:
+        cli = rpc.RpcPsClient([f"127.0.0.1:{port}"])
+        t0 = time.monotonic()
+        with pytest.raises(Exception, match="unreachable|timed out"):
+            cli.barrier()
+        assert 1.0 < time.monotonic() - t0 < 10
+        cli.close()
+    finally:
+        lib.pss_destroy(h)
+
+
+def test_barrier_timeout_cancels_arrival(fast_flags):
+    """A trainer whose barrier timed out must NOT leave a phantom
+    arrival: the server cancels the count when the waiter's connection
+    drops, so the next generation still requires every live trainer."""
+    import threading
+
+    lib = rpc._rpc_lib()
+    h = lib.pss_create(0, 2)
+    port = int(lib.pss_port(h))
+    try:
+        a = rpc.RpcPsClient([f"127.0.0.1:{port}"])
+        with pytest.raises(Exception, match="unreachable|timed out"):
+            a.barrier()  # arrives alone, times out, disconnects
+        a.close()
+        time.sleep(0.3)  # let the server notice the hangup and cancel
+
+        b = rpc.RpcPsClient([f"127.0.0.1:{port}"])
+        c = rpc.RpcPsClient([f"127.0.0.1:{port}"])
+        released = []
+
+        def arrive(cli, tag):
+            cli.barrier()
+            released.append(tag)
+
+        tb = threading.Thread(target=arrive, args=(b, "b"), daemon=True)
+        tb.start()
+        time.sleep(0.7)
+        # with a phantom arrival counted, b alone would have released
+        assert released == [], "barrier released with a phantom arrival"
+        tc = threading.Thread(target=arrive, args=(c, "c"), daemon=True)
+        tc.start()
+        tb.join(5)
+        tc.join(5)
+        assert sorted(released) == ["b", "c"]
+        b.close()
+        c.close()
+    finally:
+        lib.pss_destroy(h)
 
 
 def test_failover_to_restarted_server(fast_flags):
