@@ -40,6 +40,7 @@
 #include <string>
 #include <unordered_map>
 
+#include "graph_store.h"
 #include "sparse_table.h"
 
 // two-tier SSD table engine (ssd_table.cc, same shared library): the
@@ -113,6 +114,18 @@ enum Cmd : uint32_t {
   kSpill = 22,   // aux unused; n = hot-row budget (SSD tables)
   kStats = 23,   // -> [hot_rows, cold_rows, disk_bytes] i64[3]
   kCompact = 24,
+  // graph service (common_graph_table.cc over the PS transport; the
+  // graph brpc service role). Node ids partition client-side by
+  // id % num_servers; edges live with their SRC node.
+  kCreateGraph = 25,         // aux = shard_num (0 → 16)
+  kGraphAddNodes = 26,       // n ids; aux = feat_dim; payload ids [+ feats]
+  kGraphAddEdges = 27,       // n edges; payload src + dst + w
+  kGraphSampleNeighbors = 28,  // n ids; aux = k | weighted<<30 → nbrs+mask
+  kGraphDegree = 29,         // n ids → i32 degrees
+  kGraphNodeFeat = 30,       // n ids; aux = feat_dim → f32 [n, feat_dim]
+  kGraphSetNodeFeat = 31,    // n ids; aux = feat_dim; payload ids + feats
+  kGraphSampleNodes = 32,    // n = count → u64 ids (uniform, this server)
+  kGraphStats = 33,          // → i64 [nodes, edges]
 };
 
 enum Err : int64_t {
@@ -242,6 +255,7 @@ struct PsServer {
   std::map<uint32_t, SparseRef> sparse;
   std::map<uint32_t, DenseTable*> dense;
   std::map<uint32_t, GeoTable*> geo;
+  std::map<uint32_t, pstpu::GraphStore*> graphs;
   std::mutex tables_mu;
   // per-table: the sst two-phase save (begin fills, fetch drains) must
   // not interleave between two savers of the SAME table; different
@@ -264,6 +278,7 @@ struct PsServer {
     }
     for (auto& kv : dense) delete kv.second;
     for (auto& kv : geo) delete kv.second;
+    for (auto& kv : graphs) delete kv.second;
   }
 
   bool start(int want_port, int trainers) {
@@ -351,6 +366,11 @@ struct PsServer {
     std::lock_guard<std::mutex> g(tables_mu);
     auto it = geo.find(id);
     return it == geo.end() ? nullptr : it->second;
+  }
+  pstpu::GraphStore* get_graph(uint32_t id) {
+    std::lock_guard<std::mutex> g(tables_mu);
+    auto it = graphs.find(id);
+    return it == graphs.end() ? nullptr : it->second;
   }
 
   bool respond(int fd, int64_t status, const void* payload, uint64_t plen) {
@@ -563,6 +583,103 @@ struct PsServer {
         if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
         return respond(fd, t.ssd ? sst_compact(t.ssd) : 0, nullptr, 0);
       }
+      case kCreateGraph: {
+        std::lock_guard<std::mutex> g(tables_mu);
+        if (graphs.find(h.table_id) == graphs.end())
+          graphs[h.table_id] = new pstpu::GraphStore(
+              h.aux > 0 ? h.aux : 16, /*seed=*/h.table_id + 1);
+        return respond(fd, 0, nullptr, 0);
+      }
+      case kGraphAddNodes: {
+        pstpu::GraphStore* gt = get_graph(h.table_id);
+        if (!gt) return respond(fd, kErrNoTable, nullptr, 0);
+        int fdim = h.aux;
+        uint64_t want = h.n * 8 + (fdim > 0 ? h.n * fdim * 4 : 0);
+        if (h.payload_len != want) return respond(fd, kErrBadSize, nullptr, 0);
+        gt->add_nodes(reinterpret_cast<const uint64_t*>(p), h.n,
+                      fdim > 0 ? reinterpret_cast<const float*>(p + h.n * 8)
+                               : nullptr,
+                      fdim);
+        return respond(fd, h.n, nullptr, 0);
+      }
+      case kGraphAddEdges: {
+        pstpu::GraphStore* gt = get_graph(h.table_id);
+        if (!gt) return respond(fd, kErrNoTable, nullptr, 0);
+        if (h.payload_len != static_cast<uint64_t>(h.n) * 20)
+          return respond(fd, kErrBadSize, nullptr, 0);
+        gt->add_edges(reinterpret_cast<const uint64_t*>(p),
+                      reinterpret_cast<const uint64_t*>(p + h.n * 8),
+                      reinterpret_cast<const float*>(p + h.n * 16), h.n);
+        return respond(fd, h.n, nullptr, 0);
+      }
+      case kGraphSampleNeighbors: {
+        pstpu::GraphStore* gt = get_graph(h.table_id);
+        if (!gt) return respond(fd, kErrNoTable, nullptr, 0);
+        if (h.payload_len != static_cast<uint64_t>(h.n) * 8)
+          return respond(fd, kErrBadSize, nullptr, 0);
+        int k = h.aux & 0xFFFF;
+        bool weighted = (h.aux >> 30) & 1;
+        // bound the RESPONSE to the frame cap too — a legitimate-looking
+        // (n, k) pair can demand gigabytes the client would reject anyway
+        if (k <= 0 || static_cast<uint64_t>(h.n) * k * 9 > kMaxPayload)
+          return respond(fd, kErrBadSize, nullptr, 0);
+        std::vector<char> out(h.n * k * 9);  // u64 nbrs ++ u8 mask
+        gt->sample_neighbors(
+            reinterpret_cast<const uint64_t*>(p), h.n, k, weighted,
+            reinterpret_cast<uint64_t*>(out.data()),
+            reinterpret_cast<uint8_t*>(out.data() + h.n * k * 8));
+        return respond(fd, h.n, out.data(), out.size());
+      }
+      case kGraphDegree: {
+        pstpu::GraphStore* gt = get_graph(h.table_id);
+        if (!gt) return respond(fd, kErrNoTable, nullptr, 0);
+        if (h.payload_len != static_cast<uint64_t>(h.n) * 8)
+          return respond(fd, kErrBadSize, nullptr, 0);
+        std::vector<int32_t> out(h.n);
+        gt->degrees(reinterpret_cast<const uint64_t*>(p), h.n, out.data());
+        return respond(fd, h.n, out.data(), out.size() * 4);
+      }
+      case kGraphNodeFeat: {
+        pstpu::GraphStore* gt = get_graph(h.table_id);
+        if (!gt) return respond(fd, kErrNoTable, nullptr, 0);
+        int fdim = h.aux;
+        if (fdim <= 0 || h.payload_len != static_cast<uint64_t>(h.n) * 8 ||
+            static_cast<uint64_t>(h.n) * fdim * 4 > kMaxPayload)
+          return respond(fd, kErrBadSize, nullptr, 0);
+        std::vector<float> out(h.n * fdim);
+        gt->node_feat(reinterpret_cast<const uint64_t*>(p), h.n, fdim,
+                      out.data());
+        return respond(fd, h.n, out.data(), out.size() * 4);
+      }
+      case kGraphSetNodeFeat: {
+        pstpu::GraphStore* gt = get_graph(h.table_id);
+        if (!gt) return respond(fd, kErrNoTable, nullptr, 0);
+        int fdim = h.aux;
+        if (fdim <= 0 ||
+            h.payload_len != static_cast<uint64_t>(h.n) * (8 + fdim * 4))
+          return respond(fd, kErrBadSize, nullptr, 0);
+        bool ok = gt->set_node_feat(
+            reinterpret_cast<const uint64_t*>(p), h.n, fdim,
+            reinterpret_cast<const float*>(p + h.n * 8));
+        return respond(fd, ok ? h.n : kErrNoTable, nullptr, 0);
+      }
+      case kGraphSampleNodes: {
+        pstpu::GraphStore* gt = get_graph(h.table_id);
+        if (!gt) return respond(fd, kErrNoTable, nullptr, 0);
+        // no payload bounds h.n here — validate before allocating
+        if (h.n <= 0 || static_cast<uint64_t>(h.n) * 8 > kMaxPayload)
+          return respond(fd, kErrBadSize, nullptr, 0);
+        std::vector<uint64_t> out(h.n);
+        int64_t got = gt->sample_nodes(h.n, out.data());
+        return respond(fd, got, out.data(), got * 8);
+      }
+      case kGraphStats: {
+        pstpu::GraphStore* gt = get_graph(h.table_id);
+        if (!gt) return respond(fd, kErrNoTable, nullptr, 0);
+        int64_t out[2];
+        gt->stats(&out[0], &out[1]);
+        return respond(fd, 0, out, sizeof(out));
+      }
       case kSaveAll: {
         // snapshot + stream in ONE command — atomic against concurrent
         // savers (the two-phase begin/fetch protocol could interleave)
@@ -718,8 +835,6 @@ struct PsConn {
   ~PsConn() {
     if (fd >= 0) ::close(fd);
   }
-
-  void set_io_timeout(int ms) { io_ms = ms; }
 
   bool connect_to(const char* host, int port, int connect_ms, int io_ms_) {
     io_ms = io_ms_;
@@ -879,9 +994,6 @@ void* psc_connect2(const char* host, int port, int connect_ms, int io_ms) {
 }
 void* psc_connect(const char* host, int port) {
   return psc_connect2(host, port, 0, 0);  // legacy: blocking, no deadline
-}
-void psc_set_timeout(void* h, int io_ms) {
-  static_cast<PsConn*>(h)->set_io_timeout(io_ms);
 }
 void psc_close(void* h) { delete static_cast<PsConn*>(h); }
 

@@ -31,7 +31,8 @@ from ..core.enforce import InvalidArgumentError, enforce
 from .. import nn
 from ..optimizer import Optimizer
 
-__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "annotate", "Engine"]
+__all__ = ["ProcessMesh", "shard_tensor", "shard_op", "annotate",
+           "complete_shardings", "reshard", "Engine"]
 
 
 class ProcessMesh:
@@ -117,21 +118,178 @@ def shard_op(fn: Callable, process_mesh: ProcessMesh,
     return wrapped
 
 
+def _named_leaf_layers(layer, prefix=""):
+    """Ordered (name, layer) leaves that own parameters — registration
+    order, which matches forward order for the sequential compositions
+    the completion rules cover."""
+    out = []
+    if layer._parameters:
+        out.append((prefix, layer))
+    for sub_name, sub in layer._sub_layers.items():
+        sub_prefix = sub_name if not prefix else f"{prefix}.{sub_name}"
+        out.extend(_named_leaf_layers(sub, sub_prefix))
+    return out
+
+
+def _axis_of(spec_entry):
+    return spec_entry if isinstance(spec_entry, str) else None
+
+
+def _canon(*entries) -> PartitionSpec:
+    """Canonical spec: trailing replicated dims dropped (so results
+    compare equal to hand-written PartitionSpecs)."""
+    es = list(entries)
+    while es and es[-1] is None:
+        es.pop()
+    return PartitionSpec(*es)
+
+
+def complete_shardings(
+    model,
+    process_mesh: ProcessMesh,
+    annotations: Dict[str, Sequence[Optional[int]]],
+) -> Dict[str, PartitionSpec]:
+    """The Completer (reference ``auto_parallel/completion.py``): from one
+    or two user dist-attr hints, derive a PartitionSpec for EVERY
+    parameter by greedy propagation over the layer graph.
+
+    ``annotations``: {param_name: dims_mapping} in the reference's
+    convention (entry = mesh-dim index or -1/None for replicated).
+
+    Two passes over the ordered parameter-owning leaves:
+
+    - **backward** (right-to-left): a user hint that row-shards a
+      Linear's input dim over axis *a* demands its producer emit
+      *a*-sharded features — an unannotated upstream Linear is assigned
+      the column-parallel layout (out dim on *a*), the Megatron pairing
+      completion.py derives from op dist-attr rules.
+    - **forward** (left-to-right): track the mesh axis the activation's
+      feature dim is currently sharded over; a column-parallel Linear
+      shards its bias and the downstream activation; an unannotated
+      Linear consuming *a*-sharded features becomes row-parallel (in dim
+      on *a*, replicated output — XLA inserts the psum); LayerNorm/other
+      1-D params replicate.
+
+    The result feeds ``Engine`` parameter placement; XLA's GSPMD then
+    completes every *intermediate* tensor (the rest of completion.py's
+    job) during jit."""
+    mesh = process_mesh
+    leaves = _named_leaf_layers(model)
+    user: Dict[str, PartitionSpec] = {
+        name: _spec_from_dims_mapping(mesh, dm)
+        for name, dm in annotations.items()
+    }
+    from ..nn.layers import Conv2D, Embedding, LayerNorm, Linear
+
+    assigned: Dict[str, PartitionSpec] = {}  # per-layer weight specs
+
+    def w_name(name):
+        return f"{name}.weight" if name else "weight"
+
+    # -- backward pass: produce col-parallel partners for row hints ------
+    need: Optional[str] = None  # axis the producer's output must carry
+    for name, layer in reversed(leaves):
+        wn = w_name(name)
+        if isinstance(layer, Linear):
+            if wn in user:
+                spec = tuple(user[wn])
+                need = _axis_of(spec[0]) if spec else None
+            elif need is not None:
+                assigned[wn] = PartitionSpec(None, need)  # column-parallel
+                need = None
+            else:
+                need = None
+        elif isinstance(layer, LayerNorm):
+            pass  # feature-preserving: the demand flows through
+        else:
+            need = None
+
+    # -- forward pass: propagate the activation's feature-dim axis ------
+    specs: Dict[str, PartitionSpec] = {}
+    act: Optional[str] = None
+    for name, layer in leaves:
+        wn = w_name(name)
+        pnames = list(layer._parameters)
+
+        def put(pname, spec):
+            full = f"{name}.{pname}" if name else pname
+            specs[full] = user.get(full, spec)
+
+        if isinstance(layer, Linear):
+            if wn in user:
+                w = user[wn]
+            elif wn in assigned:
+                w = assigned[wn]
+            elif act is not None:
+                w = PartitionSpec(act, None)  # row-parallel completion
+            else:
+                w = PartitionSpec()
+            w = tuple(w) + (None,) * (2 - len(w))
+            specs[wn] = _canon(*w)
+            out_ax = _axis_of(w[1])
+            if "bias" in pnames:
+                put("bias", _canon(out_ax))
+            act = out_ax  # row-parallel output is psum'd → replicated
+        elif isinstance(layer, Embedding):
+            w = tuple(user.get(wn, PartitionSpec()))
+            specs[wn] = _canon(*w)
+            hidden_ax = _axis_of(w[1]) if len(w) > 1 else None
+            act = hidden_ax  # vocab-parallel output psums → replicated
+        elif isinstance(layer, Conv2D):
+            if wn in user:
+                w = tuple(user[wn])
+            elif act is not None:
+                w = (None, act, None, None)  # in-channels (row analogue)
+            else:
+                w = ()
+            specs[wn] = _canon(*w)
+            out_ax = _axis_of(w[0]) if len(w) > 0 else None
+            if "bias" in pnames:
+                put("bias", _canon(out_ax))
+            act = out_ax
+        else:
+            # LayerNorm/BatchNorm/etc: 1-D params replicate (the norm
+            # reads full features; GSPMD gathers if needed)
+            for pname in pnames:
+                put(pname, PartitionSpec())
+    return specs
+
+
+def reshard(x, process_mesh: ProcessMesh,
+            dims_mapping: Sequence[Optional[int]]):
+    """The Resharder (reference ``auto_parallel/reshard.py``): move a
+    tensor between shardings — including between DIFFERENT process
+    meshes (pipeline program sections). Eagerly this is a device_put
+    (XLA runtime moves/reassembles shards, the send/recv insertion
+    reshard.py does by hand); on a traced value it becomes a sharding
+    constraint and GSPMD inserts the collective."""
+    spec = _spec_from_dims_mapping(process_mesh, dims_mapping)
+    sharding = NamedSharding(process_mesh.jax_mesh, spec)
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, sharding)
+    return jax.device_put(x, sharding)
+
+
 class Engine:
     """Reference ``Engine`` (auto_parallel/engine.py:50): prepare →
     fit/evaluate/predict with automatic distribution. Here "planning +
     partitioning" is jit compilation over the ProcessMesh; the returned
-    input shardings (``completion()``) show what GSPMD chose."""
+    input shardings (``completion()``) show what GSPMD chose. Pass
+    ``annotations`` ({param_name: dims_mapping}, one or two hints) to
+    have :func:`complete_shardings` derive every parameter's layout."""
 
     def __init__(self, model: nn.Layer, loss_fn: Callable,
                  optimizer: Optimizer, process_mesh: Optional[ProcessMesh] = None,
-                 batch_dim_mesh_axis: Optional[str] = None) -> None:
+                 batch_dim_mesh_axis: Optional[str] = None,
+                 annotations: Optional[Dict[str, Sequence[Optional[int]]]] = None,
+                 ) -> None:
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.process_mesh = process_mesh or ProcessMesh(
             shape=(len(jax.devices()),), dim_names=("dp",))
         self.batch_axis = batch_dim_mesh_axis or self.process_mesh.dim_names[0]
+        self.annotations = annotations or {}
         self._prepared = False
 
     # -- prepare (plan + partition, engine.py prepare/_build) ------------
@@ -142,8 +300,35 @@ class Engine:
         opt_state = self.optimizer.init(state["params"])
         repl = NamedSharding(mesh, PartitionSpec())
         batch_sh = NamedSharding(mesh, PartitionSpec(self.batch_axis))
-        self._state = jax.device_put(state, repl)
-        self._opt_state = jax.device_put(opt_state, repl)
+        if self.annotations:
+            # completion: one or two hints → a spec for every parameter;
+            # placement seeds GSPMD, which completes the intermediates
+            self.param_specs = complete_shardings(
+                self.model, self.process_mesh, self.annotations)
+            placed = {
+                name: jax.device_put(
+                    arr, NamedSharding(mesh, self.param_specs.get(
+                        name, PartitionSpec())))
+                for name, arr in state["params"].items()
+            }
+            from ..optimizer import map_param_slots
+
+            # optimizer slots mirror the params dict → same layouts
+            slot_sh = map_param_slots(
+                opt_state["slots"], state["params"],
+                mirror_fn=lambda sub: type(sub)(
+                    (n, NamedSharding(mesh, self.param_specs.get(
+                        n, PartitionSpec()))) for n in sub),
+                other_leaf_fn=lambda _: repl)
+            opt_state = jax.tree_util.tree_map(
+                jax.device_put, opt_state, {"step": repl, "slots": slot_sh})
+            self._state = {"params": placed,
+                           "buffers": jax.device_put(state["buffers"], repl)}
+            self._opt_state = opt_state
+        else:
+            self.param_specs = None
+            self._state = jax.device_put(state, repl)
+            self._opt_state = jax.device_put(opt_state, repl)
         self._rng = jax.random.key(0)
 
         model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer

@@ -50,6 +50,28 @@ def _tree_map(fn, *trees, **kwargs):
     return jax.tree_util.tree_map(fn, *trees, **kwargs)
 
 
+def map_param_slots(slots: PyTree, params: PyTree, mirror_fn: Callable,
+                    other_leaf_fn: Callable) -> PyTree:
+    """Walk an optimizer's slot tree: apply ``mirror_fn`` to each maximal
+    subtree whose pytree structure equals ``params``'s (Momentum's slots,
+    each of Adam's m/v, …), recurse through container dicts, and map any
+    remaining leaves with ``other_leaf_fn`` (scalar schedule state). The
+    ONE place that encodes "slots mirror the params tree" — used by the
+    hybrid trainer's ZeRO slot sharding and the auto-parallel Engine."""
+    pstruct = jax.tree_util.tree_structure(params)
+
+    def rec(sub):
+        if sub is None:
+            return None
+        if jax.tree_util.tree_structure(sub) == pstruct:
+            return mirror_fn(sub)
+        if isinstance(sub, dict):
+            return type(sub)((k, rec(v)) for k, v in sub.items())
+        return jax.tree_util.tree_map(other_leaf_fn, sub)
+
+    return rec(slots)
+
+
 def global_norm(tree: PyTree) -> jax.Array:
     leaves = jax.tree_util.tree_leaves(tree)
     return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))

@@ -207,21 +207,16 @@ class HybridParallelTrainer:
         mirror the params tree get each param's spec with "sh" inserted
         (:func:`_insert_sh`); anything else (step counter, scalar
         schedule state) replicates."""
-        pstruct = jax.tree_util.tree_structure(self.params)
+        from ..optimizer import map_param_slots
+
         pspecs = self._param_specs
-
-        def mirror(sub):
-            if sub is None:
-                return None
-            if jax.tree_util.tree_structure(sub) == pstruct:
-                return jax.tree_util.tree_map(
-                    lambda spec, leaf: _insert_sh(spec, leaf.shape, self.sh),
-                    pspecs, sub)
-            if isinstance(sub, dict):
-                return type(sub)((k, mirror(v)) for k, v in sub.items())
-            return jax.tree_util.tree_map(lambda _: P(), sub)
-
-        return {"step": P(), "slots": mirror(self.opt_state["slots"])}
+        slots = map_param_slots(
+            self.opt_state["slots"], self.params,
+            mirror_fn=lambda sub: jax.tree_util.tree_map(
+                lambda spec, leaf: _insert_sh(spec, leaf.shape, self.sh),
+                pspecs, sub),
+            other_leaf_fn=lambda _: P())
+        return {"step": P(), "slots": slots}
 
     def save(self, path: str) -> None:
         """Persist params + optimizer state + rng + step (the shared

@@ -102,7 +102,6 @@ def _configure_rpc(lib: ctypes.CDLL) -> None:
     lib.psc_connect2.restype = ctypes.c_void_p
     lib.psc_connect2.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
                                  ctypes.c_int]
-    lib.psc_set_timeout.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.psc_close.argtypes = [ctypes.c_void_p]
     lib.psc_call.restype = ctypes.c_int64
     lib.psc_call.argtypes = [ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,

@@ -1,0 +1,128 @@
+"""Distributed graph sampling over the PS transport
+(csrc/graph_store.h + ps/graph_client.py): multi-server partition vs
+the local ps/graph_table.py GraphTable oracle.
+
+Reference: common_graph_table.cc served through the graph brpc service
+(graph_brpc_server/client) — node-id partitioning, per-server sampling,
+client-side join.
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.enforce import NotFoundError
+from paddle_tpu.ps.graph_table import GraphTable
+
+rpc = pytest.importorskip("paddle_tpu.ps.rpc")
+from paddle_tpu.ps.graph_client import DistGraphClient  # noqa: E402
+
+pytestmark = pytest.mark.skipif(
+    not rpc.rpc_available(), reason="native toolchain unavailable")
+
+N_SERVERS = 3
+FEAT_DIM = 5
+
+
+@pytest.fixture
+def cluster():
+    servers = [rpc.NativePsServer(n_trainers=1) for _ in range(N_SERVERS)]
+    client = rpc.RpcPsClient([f"127.0.0.1:{s.port}" for s in servers])
+    yield client
+    client.close()
+    for s in servers:
+        s.close()
+
+
+def _build(graph_like, rng):
+    """Same deterministic graph into any GraphTable-shaped object."""
+    nodes = np.arange(1, 201, dtype=np.uint64)
+    feats = rng.normal(size=(len(nodes), FEAT_DIM)).astype(np.float32)
+    graph_like.add_graph_node(nodes, feats)
+    src = rng.choice(nodes, 1200)
+    dst = rng.choice(nodes, 1200)
+    w = rng.uniform(0.1, 2.0, 1200).astype(np.float32)
+    w[::7] = 0.0  # zero-weight edges: legal input, unsamplable weighted
+    graph_like.add_edges(src, dst, w)
+    return nodes, feats, src, dst, w
+
+
+def test_partitioned_sampling_matches_local_oracle(cluster):
+    rng = np.random.default_rng(0)
+    dist = DistGraphClient(cluster, table_id=7)
+    nodes, feats, src, dst, w = _build(dist, rng)
+    local = GraphTable(shard_num=4)
+    _build(local, np.random.default_rng(0))
+
+    # topology counters agree across the partition
+    assert dist.node_count == local.node_count == len(nodes)
+    assert dist.edge_count == local.edge_count == len(src)
+
+    # degrees: exact per-node parity with the local table
+    q = rng.choice(nodes, 64, replace=False)
+    np.testing.assert_array_equal(dist.get_node_degree(q),
+                                  local.get_node_degree(q))
+
+    # features: bit-exact roundtrip through the owner servers
+    idx = {int(n): i for i, n in enumerate(nodes)}
+    got = dist.get_node_feat(q, FEAT_DIM)
+    want = np.stack([feats[idx[int(n)]] for n in q])
+    np.testing.assert_array_equal(got, want)
+
+    # neighbor sampling: per-node mask count = min(k, samplable degree),
+    # every sampled id is a true neighbor, zero-weight edges never appear
+    adj, wpos, adj_cnt, wpos_cnt = {}, {}, {}, {}
+    for s, d, ww in zip(src, dst, w):
+        adj.setdefault(int(s), set()).add(int(d))
+        adj_cnt[int(s)] = adj_cnt.get(int(s), 0) + 1
+        if ww > 0:
+            wpos.setdefault(int(s), set()).add(int(d))
+            wpos_cnt[int(s)] = wpos_cnt.get(int(s), 0) + 1
+    for weighted in (True, False):
+        k = 6
+        nbrs, mask = dist.sample_neighbors(q, k, weighted=weighted)
+        assert nbrs.shape == mask.shape == (len(q), k)
+        for i, n in enumerate(q):
+            cand = (wpos if weighted else adj).get(int(n), set())
+            cnt = (wpos_cnt if weighted else adj_cnt).get(int(n), 0)
+            got_n = set(nbrs[i][mask[i]].tolist())
+            assert got_n <= cand, (n, got_n - cand)
+            # without replacement over EDGES (parallel edges count
+            # separately — multigraph semantics, as in the local table)
+            assert mask[i].sum() == min(k, cnt), n
+
+    # uniform node sampling covers only real nodes, from every server
+    samp = dist.sample_nodes(300)
+    assert len(samp) == 300
+    assert set(samp.tolist()) <= set(int(n) for n in nodes)
+    assert len({int(s) % N_SERVERS for s in samp}) == N_SERVERS
+
+
+def test_set_node_feat_and_missing_node(cluster):
+    rng = np.random.default_rng(1)
+    dist = DistGraphClient(cluster, table_id=9)
+    nodes = np.arange(10, 20, dtype=np.uint64)
+    dist.add_graph_node(nodes)
+    new = rng.normal(size=(len(nodes), FEAT_DIM)).astype(np.float32)
+    dist.set_node_feat(nodes, new)
+    np.testing.assert_array_equal(dist.get_node_feat(nodes, FEAT_DIM), new)
+    with pytest.raises(NotFoundError):
+        dist.set_node_feat(np.asarray([999], np.uint64),
+                           np.zeros((1, FEAT_DIM), np.float32))
+
+
+def test_graph_trainer_swaps_local_for_distributed(cluster):
+    """The swap contract: a sampling loop written against GraphTable
+    runs unchanged against DistGraphClient (same padded shapes)."""
+    rng = np.random.default_rng(2)
+
+    def two_hop(g):
+        seeds = np.asarray([1, 2, 3], np.uint64)
+        n1, m1 = g.sample_neighbors(seeds, 4, weighted=False)
+        n2, m2 = g.sample_neighbors(n1.reshape(-1), 4, weighted=False)
+        return (n1.shape, m1.shape, n2.shape, m2.shape)
+
+    local = GraphTable(shard_num=2)
+    _build(local, np.random.default_rng(3))
+    dist = DistGraphClient(cluster, table_id=11)
+    _build(dist, np.random.default_rng(3))
+    assert two_hop(local) == two_hop(dist)
