@@ -92,13 +92,19 @@ struct GraphStore {
       auto it = s.nodes.find(ids[i]);
       if (it == s.nodes.end() || it->second.nbrs.empty()) continue;
       const Node& node = it->second;
-      if (weighted) {
+      bool use_weights = weighted;
+      if (use_weights) {
         keyed.clear();
         for (size_t j = 0; j < node.nbrs.size(); ++j) {
           float w = node.weights[j];
           if (w <= 0.0f) continue;  // unsamplable without replacement
           keyed.emplace_back(std::pow(uni(rng), 1.0 / w), node.nbrs[j]);
         }
+        // all-zero weights: fall back to uniform over ALL edges — the
+        // local GraphTable oracle's `w.sum() > 0` fallback
+        if (keyed.empty()) use_weights = false;
+      }
+      if (use_weights) {
         g.unlock();
         int kk = std::min<int>(k, keyed.size());
         std::partial_sort(keyed.begin(), keyed.begin() + kk, keyed.end(),
@@ -150,8 +156,9 @@ struct GraphStore {
     return true;
   }
 
-  // uniform over this server's node set, with replacement when count
-  // exceeds the population (random_sample_nodes)
+  // uniform over this server's node set — WITHOUT replacement when the
+  // population covers the request, with replacement only beyond it
+  // (GraphTable.sample_nodes' replace=len(all)<size semantics)
   int64_t sample_nodes(int64_t count, uint64_t* out) {
     std::vector<uint64_t> all;
     for (Shard& s : shards_) {
@@ -160,8 +167,16 @@ struct GraphStore {
     }
     if (all.empty()) return 0;
     std::mt19937_64 rng(seed_ ^ (sample_counter_.fetch_add(1) * 0xD1B54A32D192ED03ULL));
-    std::uniform_int_distribution<size_t> pick(0, all.size() - 1);
-    for (int64_t i = 0; i < count; ++i) out[i] = all[pick(rng)];
+    if (static_cast<size_t>(count) <= all.size()) {
+      for (int64_t j = 0; j < count; ++j) {  // partial Fisher–Yates
+        std::uniform_int_distribution<size_t> pick(j, all.size() - 1);
+        std::swap(all[j], all[pick(rng)]);
+        out[j] = all[j];
+      }
+    } else {
+      std::uniform_int_distribution<size_t> pick(0, all.size() - 1);
+      for (int64_t j = 0; j < count; ++j) out[j] = all[pick(rng)];
+    }
     return count;
   }
 

@@ -401,6 +401,13 @@ struct PsServer {
   }
 
   bool handle(int fd, const ReqHeader& h, const char* p) {
+    // global count sanity bound BEFORE any `h.n * width` arithmetic: a
+    // huge n would overflow the int64 size checks (n*8 ≡ 0 mod 2^64)
+    // and bypass them into out-of-bounds reads. No legitimate command
+    // carries more elements than the frame cap has bytes; with
+    // n ≤ kMaxPayload every downstream n·width product fits in 64 bits.
+    if (h.n < 0 || static_cast<uint64_t>(h.n) > kMaxPayload)
+      return respond(fd, kErrBadSize, nullptr, 0);
     switch (h.cmd) {
       case kPing:
         return respond(fd, 0, nullptr, 0);

@@ -472,7 +472,11 @@ struct Shard {
   void update_stat_after_save(int32_t r, int32_t mode) {
     if (mode == 3)
       f_unseen[r] += 1.0f;
-    else if (mode == 2)
+    else if (mode == 1 || mode == 2)
+      // mode 1: delta-save keep-set resets delta_score so repeated
+      // deltas don't re-emit unchanged rows (CtrCommonAccessor::
+      // UpdateStatAfterSave param=1); mode 2 additionally starts a
+      // fresh delta epoch at base saves (deliberate superset)
       f_delta_score[r] = 0.0f;
   }
 };

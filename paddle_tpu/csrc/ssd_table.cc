@@ -394,7 +394,10 @@ int32_t sst_full_dim(void* h) { return static_cast<SsdTable*>(h)->fdim; }
 void sst_stats(void* h, int64_t* out3) {
   SsdTable* t = static_cast<SsdTable*>(h);
   int64_t mem = 0, dsk = 0, bytes = 0;
-  for (Shard* s : t->mem->shards) mem += s->used;
+  for (Shard* s : t->mem->shards) {
+    std::lock_guard<std::mutex> g(s->mu);  // `used` mutates under this
+    mem += s->used;
+  }
   for (DiskShard* d : t->disk) {
     std::lock_guard<std::mutex> g(d->mu);
     dsk += d->index.used;
@@ -409,8 +412,13 @@ void sst_stats(void* h, int64_t* out3) {
 void sst_shard_sizes(void* h, int64_t* out) {
   SsdTable* t = static_cast<SsdTable*>(h);
   for (size_t s = 0; s < t->mem->shards.size(); ++s) {
+    int64_t mem;
+    {
+      std::lock_guard<std::mutex> g(t->mem->shards[s]->mu);
+      mem = t->mem->shards[s]->used;
+    }
     std::lock_guard<std::mutex> g(t->disk[s]->mu);
-    out[s] = t->mem->shards[s]->used + t->disk[s]->index.used;
+    out[s] = mem + t->disk[s]->index.used;
   }
 }
 
@@ -639,7 +647,11 @@ int64_t sst_save_begin(void* h, int32_t mode) {
       if (mode == 3) {
         v[1] += 1.0f;
         dirty = true;
-      } else if (mode == 2) {
+      } else if (mode == 1 || mode == 2) {
+        // mode 1: the reference resets delta_score on rows a delta save
+        // kept (CtrCommonAccessor::UpdateStatAfterSave param=1) so
+        // repeated deltas don't re-emit unchanged rows; mode 2 keeps the
+        // round-1 behavior of starting a fresh delta epoch at base saves
         v[2] = 0.0f;
         dirty = true;
       }

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ..core.enforce import enforce
+
 __all__ = ["ctr_sparse_rows", "rule_update", "rule_state_dim",
            "rule_init_state"]
 
@@ -175,8 +177,11 @@ def ctr_sparse_rows(
     dim = xw.shape[1]
     es = rule_state_dim(embed_rule, 1)
     xs = rule_state_dim(embedx_rule, dim)
-    assert estate.shape[1] == es and xstate.shape[1] == xs, \
-        (estate.shape, es, xstate.shape, xs)
+    # enforce (not assert): a mismatched cache/table state layout must
+    # fail loudly even under python -O, not corrupt rows silently
+    enforce(estate.shape[1] == es and xstate.shape[1] == xs,
+            f"optimizer-state width mismatch: estate {estate.shape} vs "
+            f"{es}, xstate {xstate.shape} vs {xs}")
     if interpret is None:
         interpret = not _on_tpu()
     # zero-width state -> one dummy column through the kernel

@@ -205,7 +205,11 @@ class CtrCommonAccessor:
     def update_stat_after_save(self, block: FeatureBlock, idx: np.ndarray, mode: int) -> None:
         if mode == 3:
             block.unseen_days[idx] += 1
-        elif mode == 2:  # base save resets delta_score
+        elif mode in (1, 2):
+            # mode 1: the delta save's keep-set resets delta_score so the
+            # next delta doesn't re-emit unchanged rows (ctr_accessor.cc
+            # UpdateStatAfterSave param=1); mode 2 starts a fresh delta
+            # epoch at base saves (deliberate superset of the reference)
             block.delta_score[idx] = 0.0
 
 

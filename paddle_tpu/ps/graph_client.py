@@ -90,25 +90,19 @@ class DistGraphClient:
                 continue
             payload = (src[sel].tobytes() + dst[sel].tobytes()
                        + w[sel].tobytes())
+            # retries=0: edge insertion is append-only — a timeout-then-
+            # retry would duplicate the batch and permanently skew the
+            # sampling distribution (unlike idempotent node/feat sets)
             c.check(_ADD_EDGES, self._tid, n=len(sel), payload=payload,
-                    timeout_ms=_long_ms())
+                    timeout_ms=_long_ms(), retries=0)
         # dst nodes register on their own owners (degree-0 endpoints must
         # exist for sampling/feat queries, load_edges parity)
         self.add_graph_node(np.unique(dst))
 
     def load_edges(self, path: str, reverse: bool = False) -> int:
-        srcs, dsts, ws = [], [], []
-        with open(path) as f:
-            for line in f:
-                parts = line.split()
-                if len(parts) < 2:
-                    continue
-                s, d = int(parts[0]), int(parts[1])
-                if reverse:
-                    s, d = d, s
-                srcs.append(s)
-                dsts.append(d)
-                ws.append(float(parts[2]) if len(parts) > 2 else 1.0)
+        from .graph_table import parse_edge_file
+
+        srcs, dsts, ws = parse_edge_file(path, reverse)
         if srcs:
             self.add_edges(srcs, dsts, ws)
         return len(srcs)

@@ -20,7 +20,29 @@ import numpy as np
 
 from ..core.enforce import NotFoundError, enforce
 
-__all__ = ["GraphTable"]
+__all__ = ["GraphTable", "parse_edge_file"]
+
+
+def parse_edge_file(path: str, reverse: bool = False
+                    ) -> Tuple[List[int], List[int], List[float]]:
+    """``src \\t dst [\\t weight]`` per line (common_graph_table.cc
+    load_edges format) — the ONE parser both the local table and the
+    distributed client load through."""
+    srcs: List[int] = []
+    dsts: List[int] = []
+    ws: List[float] = []
+    with open(path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) < 2:
+                continue
+            s, d = int(parts[0]), int(parts[1])
+            if reverse:
+                s, d = d, s
+            srcs.append(s)
+            dsts.append(d)
+            ws.append(float(parts[2]) if len(parts) > 2 else 1.0)
+    return srcs, dsts, ws
 
 
 class _GraphShard:
@@ -84,21 +106,7 @@ class GraphTable:
                 dshard.weights.setdefault(d, [])
 
     def load_edges(self, path: str, reverse: bool = False) -> int:
-        """Edge file: ``src \\t dst [\\t weight]`` per line
-        (common_graph_table.cc load_edges format)."""
-        srcs, dsts, ws = [], [], []
-        with open(path) as f:
-            for line in f:
-                parts = line.split()
-                if len(parts) < 2:
-                    continue
-                s, d = int(parts[0]), int(parts[1])
-                w = float(parts[2]) if len(parts) > 2 else 1.0
-                if reverse:
-                    s, d = d, s
-                srcs.append(s)
-                dsts.append(d)
-                ws.append(w)
+        srcs, dsts, ws = parse_edge_file(path, reverse)
         if srcs:
             self.add_edges(srcs, dsts, ws)
         return len(srcs)

@@ -316,10 +316,17 @@ class CtrPassTrainer:
                 try:
                     results.append(self._run_pass(current, prepared,
                                                   batch_size, drop_last))
-                finally:
+                except BaseException:
                     # never leave a prepare thread running past an
-                    # exception (it holds native calls mid-flight)
-                    nxt = fut.result()
+                    # exception (it holds native calls mid-flight) — but
+                    # keep the TRAINING failure primary: a secondary
+                    # prepare error must not mask this traceback
+                    try:
+                        fut.result()
+                    except Exception:
+                        pass
+                    raise
+                nxt = fut.result()
                 if nxt is _END:
                     return results
                 current, prepared = nxt

@@ -1,0 +1,39 @@
+"""CI gate for the v2 convergence anchor (tools/make_anchor_v2.py):
+the stream path (per-batch host-table pull/push) and the pass path
+(per-day HBM working set, in-graph fused push) must produce AUC curves
+within epsilon ON IDENTICAL DATA over an SSD-backed population — the
+reference's expectation that GPUPS training converges like the CPU
+table path (test_dist_fleet_base.py:311 harness role).
+
+Runs the same harness as the full-scale anchor at reduced scale.
+"""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+from paddle_tpu.ps import rpc  # noqa: E402  (native toolchain probe)
+
+pytestmark = pytest.mark.skipif(
+    not rpc.rpc_available(), reason="native toolchain unavailable (SSD tier)")
+
+
+@pytest.mark.slow
+def test_stream_and_pass_paths_auc_parity(tmp_path):
+    from make_anchor_v2 import run_anchor
+
+    out = run_anchor(pop=260_000, days=2, steps_per_day=40, batch=256,
+                     eval_every=10, dnn=(64, 64), hot=4000, fresh=500,
+                     base_dir=str(tmp_path))
+    gates = out["gates"]
+    assert gates["parity_ok"], gates
+    # both paths actually learned (not trivially-equal flat curves)
+    assert out["paths"]["stream"]["final_auc"] > 0.58, out["paths"]["stream"]
+    assert out["paths"]["pass"]["final_auc"] > 0.58, out["paths"]["pass"]
+    # the SSD population really backs the run: cold features got promoted
+    # (table size counts resident + cold rows at full population scale)
+    assert out["paths"]["stream"]["table_features"] >= 260_000 // 26 * 26

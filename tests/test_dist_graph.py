@@ -97,6 +97,22 @@ def test_partitioned_sampling_matches_local_oracle(cluster):
     assert len({int(s) % N_SERVERS for s in samp}) == N_SERVERS
 
 
+def test_sample_nodes_without_replacement_and_zero_weight_fallback(cluster):
+    """Oracle-parity details: sample_nodes(population) is a permutation
+    (no duplicates, full coverage), and a node whose edges ALL have zero
+    weight still samples uniformly under weighted=True (the local
+    table's w.sum()>0 fallback)."""
+    dist = DistGraphClient(cluster, table_id=13)
+    nodes = np.arange(1, 61, dtype=np.uint64)
+    dist.add_graph_node(nodes)
+    dist.add_edges([7, 7, 7], [8, 9, 10], [0.0, 0.0, 0.0])
+    samp = dist.sample_nodes(len(nodes))
+    assert sorted(samp.tolist()) == sorted(int(n) for n in nodes)
+    nbrs, mask = dist.sample_neighbors([7], 2, weighted=True)
+    assert mask[0].sum() == 2
+    assert set(nbrs[0][mask[0]].tolist()) <= {8, 9, 10}
+
+
 def test_set_node_feat_and_missing_node(cluster):
     rng = np.random.default_rng(1)
     dist = DistGraphClient(cluster, table_id=9)
