@@ -34,7 +34,8 @@ from ..ps.embedding_cache import CacheConfig, cache_pull, cache_push
 
 __all__ = ["CtrConfig", "DeepFM", "WideDeep", "make_ctr_train_step",
            "make_ctr_train_step_from_keys", "make_ctr_pooled_train_step",
-           "make_ctr_train_step_packed", "pack_ctr_batch"]
+           "make_ctr_train_step_packed", "make_ctr_train_step_slab",
+           "pack_ctr_batch"]
 
 
 @dataclasses.dataclass
@@ -303,6 +304,29 @@ def pack_ctr_batch(lo32: np.ndarray, dense: np.ndarray,
     return np.concatenate(parts)
 
 
+def _packed_layout(B: int, S: int, D: int, with_weights: bool):
+    o_dense = B * S * 4
+    o_label = o_dense + B * D * 2
+    o_weight = o_label + B
+    total = o_weight + (B if with_weights else 0)
+    return o_dense, o_label, o_weight, total
+
+
+def _unpack_ctr(packed, B, S, D, o_dense, o_label, o_weight, with_weights):
+    """In-graph bitcast of ONE packed wire buffer back into
+    (lo32, dense, labels, weights) — static offsets."""
+    from jax import lax
+
+    lo = lax.bitcast_convert_type(
+        packed[:o_dense].reshape(B * S, 4), jnp.uint32)
+    dense_x = lax.bitcast_convert_type(
+        packed[o_dense:o_label].reshape(B, D, 2), jnp.float16)
+    labels = lax.bitcast_convert_type(packed[o_label:o_weight], jnp.int8)
+    weights = (packed[o_weight:].astype(jnp.float32)
+               if with_weights else None)
+    return lo, dense_x, labels, weights
+
+
 def make_ctr_train_step_packed(
     model: Layer,
     optimizer,
@@ -321,30 +345,73 @@ def make_ctr_train_step_packed(
     step(params, opt_state, cache_state, map_state, packed_u8)
       → (params, opt_state, cache_state, loss)
     """
-    from jax import lax
-
     slot_hi = jnp.asarray(np.asarray(slot_ids, np.uint32))
     B, S, D = int(batch_size), int(slot_hi.shape[0]), int(num_dense)
-    o_dense = B * S * 4
-    o_label = o_dense + B * D * 2
-    o_weight = o_label + B
-    total = o_weight + (B if with_weights else 0)
+    o_dense, o_label, o_weight, total = _packed_layout(B, S, D, with_weights)
 
     def step(params, opt_state, cache_state, map_state, packed):
         enforce_eq(packed.shape[0], total, "packed batch size")
-        lo = lax.bitcast_convert_type(
-            packed[:o_dense].reshape(B * S, 4), jnp.uint32)
-        dense_x = lax.bitcast_convert_type(
-            packed[o_dense:o_label].reshape(B, D, 2), jnp.float16)
-        labels = lax.bitcast_convert_type(
-            packed[o_label:o_weight], jnp.int8)
-        weights = (packed[o_weight:].astype(jnp.float32)
-                   if with_weights else None)
+        lo, dense_x, labels, weights = _unpack_ctr(
+            packed, B, S, D, o_dense, o_label, o_weight, with_weights)
         hi = jnp.broadcast_to(slot_hi[None, :], (B, S)).reshape(-1)
         rows = _lookup_rows(cache_state, map_state, hi, lo)
         return _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
                               cache_state, rows, B, S, dense_x, labels,
                               weights)
+
+    return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
+
+
+def make_ctr_train_step_slab(
+    model: Layer,
+    optimizer,
+    cache_cfg: CacheConfig,
+    slot_ids,
+    batch_size: int,
+    num_dense: int,
+    slab: int,
+    with_weights: bool = False,
+    donate: bool = True,
+) -> Callable:
+    """``slab`` packed train steps per DISPATCH: a ``lax.scan`` over a
+    device-resident [slab, total] stack of packed wire buffers runs the
+    whole per-batch pipeline (unpack → probe → pull → fwd/bwd → update →
+    push) ``slab`` times inside one XLA program — per-dispatch host
+    overhead (the measured ~0.1 ms on the tunneled host, MEASURED.md)
+    amortizes by 1/slab, and the slab uploads as ONE transfer. The wire
+    format and per-step math are byte-identical to the packed step
+    (bitwise-parity tested), so the host pipeline just stacks ``slab``
+    ``pack_ctr_batch`` rows.
+
+    step(params, opt_state, cache_state, map_state, packed_slab[slab,·])
+      → (params, opt_state, cache_state, losses [slab])
+    """
+    from jax import lax
+
+    slot_hi = jnp.asarray(np.asarray(slot_ids, np.uint32))
+    B, S, D = int(batch_size), int(slot_hi.shape[0]), int(num_dense)
+    o_dense, o_label, o_weight, total = _packed_layout(B, S, D, with_weights)
+    slab = int(slab)
+    enforce(slab >= 1, "slab >= 1")
+
+    def step(params, opt_state, cache_state, map_state, packed_slab):
+        enforce_eq(tuple(packed_slab.shape), (slab, total),
+                   "packed slab shape")
+        hi = jnp.broadcast_to(slot_hi[None, :], (B, S)).reshape(-1)
+
+        def one(carry, packed):
+            params, opt_state, cache_state = carry
+            lo, dense_x, labels, weights = _unpack_ctr(
+                packed, B, S, D, o_dense, o_label, o_weight, with_weights)
+            rows = _lookup_rows(cache_state, map_state, hi, lo)
+            params, opt_state, cache_state, loss = _ctr_step_body(
+                model, optimizer, cache_cfg, params, opt_state,
+                cache_state, rows, B, S, dense_x, labels, weights)
+            return (params, opt_state, cache_state), loss
+
+        (params, opt_state, cache_state), losses = lax.scan(
+            one, (params, opt_state, cache_state), packed_slab)
+        return params, opt_state, cache_state, losses
 
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
 

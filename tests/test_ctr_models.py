@@ -269,3 +269,69 @@ def test_packed_wire_rejects_f16_overflow(rng):
     bad[1, 2] = 1e6  # overflows f16
     with pytest.raises(Exception, match="f16 wire"):
         pack_ctr_batch(lo32, bad, labels)
+
+
+def test_slab_step_matches_sequential_packed(rng):
+    """The slab lax.scan (N steps per dispatch) walks a bitwise-identical
+    trajectory to N sequential packed steps — the slab is a pure dispatch
+    amortization, not a numerics change."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.ctr import (CtrConfig, DeepFM, pack_ctr_batch,
+                                       make_ctr_train_step_packed,
+                                       make_ctr_train_step_slab)
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.embedding_cache import CacheConfig, HbmEmbeddingCache
+    from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+    S, D, B, dim, slab = 6, 4, 32, 4, 5
+    ccfg = CacheConfig(capacity=512, embedx_dim=dim, embedx_threshold=0.0)
+
+    def build():
+        pt.seed(0)
+        table = MemorySparseTable(TableConfig(
+            shard_num=2, accessor_config=AccessorConfig(embedx_dim=dim)))
+        cache = HbmEmbeddingCache(table, ccfg, device_map=True)
+        rng2 = np.random.default_rng(7)
+        pool = rng2.integers(1, 1 << 18, size=(80, S)).astype(np.uint64)
+        pool += np.arange(S, dtype=np.uint64) << np.uint64(32)
+        cache.begin_pass(pool.reshape(-1))
+        model = DeepFM(CtrConfig(num_sparse_slots=S, num_dense=D,
+                                 embedx_dim=dim, dnn_hidden=(16,)))
+        opt = optimizer.Adam(1e-2)
+        params = {"params": dict(model.named_parameters()), "buffers": {}}
+        return cache, pool, model, opt, params, opt.init(params)
+
+    cache1, pool, m1, o1, p1, s1 = build()
+    cache2, _, m2, o2, p2, s2 = build()
+
+    packs = []
+    for _ in range(slab):
+        idx = rng.integers(0, 80, size=B)
+        lo32 = (pool[idx] & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        dense = rng.normal(size=(B, D)).astype(np.float16)
+        labels = (rng.random(B) < 0.4).astype(np.int8)
+        packs.append(pack_ctr_batch(lo32, dense, labels))
+
+    step_p = make_ctr_train_step_packed(m1, o1, ccfg, np.arange(S), B, D,
+                                        donate=False)
+    losses1 = []
+    st1 = cache1.state
+    for pk in packs:
+        p1, s1, st1, l1 = step_p(p1, s1, st1, cache1.device_map.state,
+                                 jnp.asarray(pk))
+        losses1.append(float(l1))
+
+    step_s = make_ctr_train_step_slab(m2, o2, ccfg, np.arange(S), B, D,
+                                      slab=slab, donate=False)
+    p2, s2, st2, losses2 = step_s(p2, s2, cache2.state,
+                                  cache2.device_map.state,
+                                  jnp.asarray(np.stack(packs)))
+
+    np.testing.assert_array_equal(np.asarray(losses2),
+                                  np.asarray(losses1, np.float32))
+    for k in st1:
+        np.testing.assert_array_equal(np.asarray(st2[k]), np.asarray(st1[k]),
+                                      err_msg=k)

@@ -159,6 +159,38 @@ def main() -> None:
         "device_samples_per_sec": round(batch / t_ctr, 0),
     }
 
+    # --- leg 2b: slab-scan CTR step (BENCH_SLAB path: N packed steps
+    # per dispatch; isolates how much of the per-step wall time was
+    # dispatch overhead vs device compute) ------------------------------
+    from paddle_tpu.models.ctr import (make_ctr_train_step_slab,
+                                       pack_ctr_batch)
+
+    slab_n = 8
+    step_sl = make_ctr_train_step_slab(model, opt, cache_cfg,
+                                       slot_ids=np.arange(26),
+                                       batch_size=batch, num_dense=13,
+                                       slab=slab_n, donate=False)
+    packs = np.stack([
+        pack_ctr_batch(
+            (pool[rng.integers(0, pass_keys, size=batch)]
+             & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+            rng.normal(size=(batch, 13)).astype(np.float16),
+            (rng.random(batch) < 0.3).astype(np.int8))
+        for _ in range(slab_n)])
+    packs_d = jnp.asarray(packs)
+
+    def slab_once(packs_d):
+        return step_sl(params, opt_state, cache.state, ms, packs_d)[3]
+
+    t_slab, _ = _timed(jax.jit(slab_once), packs_d,
+                       iters=max(2, iters // slab_n))
+    result["legs"]["ctr_slab_step"] = {
+        "batch": batch, "slab": slab_n,
+        "dispatch_ms": round(t_slab * 1e3, 3),
+        "per_step_ms": round(t_slab / slab_n * 1e3, 3),
+        "device_samples_per_sec": round(batch * slab_n / t_slab, 0),
+    }
+
     # --- leg 3: transformer step at realistic hidden + MFU --------------
     from paddle_tpu import nn
     from paddle_tpu.executor import Trainer
