@@ -148,16 +148,12 @@ def main() -> None:
     # dtypes — lo32 key halves, f16 dense, int8 labels, unpacked
     # in-graph: the tunnel link is the bottleneck, so wire bytes and
     # per-transfer dispatches are throughput.
+    from paddle_tpu.models.ctr import make_random_packs
+
     n_batches = 8
     batches = []
     for b in range(n_batches):
-        packs = []
-        for _ in range(slab):
-            idx = rng.integers(0, pass_keys, size=batch)
-            lo32 = (pool[idx] & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-            dense = rng.normal(size=(batch, cfg.num_dense)).astype(np.float16)
-            labels = (rng.random(batch) < 0.3).astype(np.int8)
-            packs.append(pack_ctr_batch(lo32, dense, labels))
+        packs = make_random_packs(rng, pool, batch, cfg.num_dense, slab)
         batches.append(np.stack(packs) if slab > 1 else packs[0])
 
     map_state = cache.device_map.state

@@ -35,7 +35,7 @@ from ..ps.embedding_cache import CacheConfig, cache_pull, cache_push
 __all__ = ["CtrConfig", "DeepFM", "WideDeep", "make_ctr_train_step",
            "make_ctr_train_step_from_keys", "make_ctr_pooled_train_step",
            "make_ctr_train_step_packed", "make_ctr_train_step_slab",
-           "pack_ctr_batch"]
+           "pack_ctr_batch", "make_random_packs"]
 
 
 @dataclasses.dataclass
@@ -302,6 +302,21 @@ def pack_ctr_batch(lo32: np.ndarray, dense: np.ndarray,
                 "packed weights must be a 0/1 padding mask")
         parts.append(np.ascontiguousarray(w, np.uint8).ravel())
     return np.concatenate(parts)
+
+
+def make_random_packs(rng, pool: np.ndarray, batch: int, num_dense: int,
+                      n: int, p_click: float = 0.3) -> list:
+    """``n`` random packed wire buffers drawn from a slot-tagged key pool
+    [rows, S] — the ONE place bench/smoke/tests get the random-batch
+    recipe, so a wire-format change can't drift between them."""
+    packs = []
+    for _ in range(n):
+        idx = rng.integers(0, len(pool), size=batch)
+        lo32 = (pool[idx] & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+        dense = rng.normal(size=(batch, num_dense)).astype(np.float16)
+        labels = (rng.random(batch) < p_click).astype(np.int8)
+        packs.append(pack_ctr_batch(lo32, dense, labels))
+    return packs
 
 
 def _packed_layout(B: int, S: int, D: int, with_weights: bool):

@@ -307,13 +307,9 @@ def test_slab_step_matches_sequential_packed(rng):
     cache1, pool, m1, o1, p1, s1 = build()
     cache2, _, m2, o2, p2, s2 = build()
 
-    packs = []
-    for _ in range(slab):
-        idx = rng.integers(0, 80, size=B)
-        lo32 = (pool[idx] & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-        dense = rng.normal(size=(B, D)).astype(np.float16)
-        labels = (rng.random(B) < 0.4).astype(np.int8)
-        packs.append(pack_ctr_batch(lo32, dense, labels))
+    from paddle_tpu.models.ctr import make_random_packs
+
+    packs = make_random_packs(rng, pool, B, D, slab, p_click=0.4)
 
     step_p = make_ctr_train_step_packed(m1, o1, ccfg, np.arange(S), B, D,
                                         donate=False)

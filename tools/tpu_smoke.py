@@ -163,21 +163,15 @@ def main() -> None:
     # per dispatch; isolates how much of the per-step wall time was
     # dispatch overhead vs device compute) ------------------------------
     from paddle_tpu.models.ctr import (make_ctr_train_step_slab,
-                                       pack_ctr_batch)
+                                       make_random_packs)
 
     slab_n = 8
     step_sl = make_ctr_train_step_slab(model, opt, cache_cfg,
                                        slot_ids=np.arange(26),
                                        batch_size=batch, num_dense=13,
                                        slab=slab_n, donate=False)
-    packs = np.stack([
-        pack_ctr_batch(
-            (pool[rng.integers(0, pass_keys, size=batch)]
-             & np.uint64(0xFFFFFFFF)).astype(np.uint32),
-            rng.normal(size=(batch, 13)).astype(np.float16),
-            (rng.random(batch) < 0.3).astype(np.int8))
-        for _ in range(slab_n)])
-    packs_d = jnp.asarray(packs)
+    packs_d = jnp.asarray(np.stack(
+        make_random_packs(rng, pool, batch, 13, slab_n)))
 
     def slab_once(packs_d):
         return step_sl(params, opt_state, cache.state, ms, packs_d)[3]
