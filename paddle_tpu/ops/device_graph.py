@@ -1,0 +1,188 @@
+"""Device-resident graph table: in-graph neighbor sampling and random
+walks.
+
+The reference keeps a GPU mirror of the graph for walk generation —
+``fleet/heter_ps/graph_gpu_ps_table.h`` (node/edge arrays in device
+memory, sample kernels) feeding ``GraphDataGenerator``'s deepwalk-style
+walks into training. TPU-native form: the adjacency lives in HBM as a
+**degree-capped padded neighbor matrix** (static shapes — XLA needs
+them; the cap is explicit and counted, never silent), node ids map to
+rows through the same per-pass cuckoo map the embedding cache uses
+(ps/device_hash.py), and sampling/walks are pure jax.random programs
+that fuse into the training step:
+
+- ``sample_neighbors(state, rng, hi, lo, k)`` — uniform with
+  replacement over each node's true neighbors (the GPU
+  ``graph_neighbor_sample`` kernel's contract), padded + masked;
+- ``random_walk(state, rng, hi, lo, length)`` — ``lax.scan`` of
+  gather+sample steps; a walk that reaches a degree-0 or unknown node
+  stays there (mask marks the live prefix, the generator's walk
+  truncation).
+
+Weighted sampling uses each row's prefix-CDF + ``searchsorted`` —
+O(log max_deg) per draw, branch-free.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.enforce import enforce
+from ..ps.device_hash import DeviceKeyMap, device_hash_lookup, split_keys
+
+__all__ = ["DeviceGraph"]
+
+
+class DeviceGraph:
+    """Padded-CSR device mirror of a host ``GraphTable``.
+
+    ``state`` pytree (HBM-resident, feed through jitted steps):
+      nbr_hi/nbr_lo [N, max_deg] u32   neighbor key halves (padded 0)
+      cdf           [N, max_deg] f32   per-row weight prefix-CDF (0 pad)
+      deg           [N]          i32   KEPT degree (min(true, max_deg);
+                                       truncation is counted in
+                                       ``capped_rows``)
+      map                              cuckoo node-key→row map
+    """
+
+    def __init__(self, state: Dict[str, jax.Array], max_deg: int,
+                 capped_rows: int) -> None:
+        self.state = state
+        self.max_deg = int(max_deg)
+        #: rows whose true degree exceeded max_deg (their kept neighbors
+        #: are the first max_deg by insertion order) — surfaced, never
+        #: silent (the GPU table truncates the same way)
+        self.capped_rows = int(capped_rows)
+
+    # -- build (host → HBM; the build_graph_from_cpu role) ---------------
+
+    @staticmethod
+    def from_graph_table(graph, max_deg: int = 32,
+                         sharding=None) -> "DeviceGraph":
+        """Upload a host ``ps/graph_table.py`` GraphTable (or anything
+        with ``all_nodes`` + per-node neighbors/weights via
+        ``_shard``)."""
+        nodes = graph.all_nodes()
+        nbrs = np.zeros((len(nodes), max_deg), np.uint64)
+        w = np.zeros((len(nodes), max_deg), np.float32)
+        deg = np.zeros(len(nodes), np.int32)
+        for i, nid in enumerate(nodes):
+            shard, lock = graph._shard(int(nid))
+            with lock:
+                cand = shard.neighbors.get(int(nid), [])
+                ww = shard.weights.get(int(nid), [])
+            deg[i] = len(cand)
+            k = min(len(cand), max_deg)
+            nbrs[i, :k] = np.asarray(cand[:k], np.uint64)
+            w[i, :k] = np.asarray(ww[:k], np.float32)
+        return DeviceGraph.from_arrays(np.asarray(nodes, np.uint64), nbrs,
+                                       deg, w, sharding=sharding)
+
+    @staticmethod
+    def from_arrays(nodes: np.ndarray, nbrs: np.ndarray, deg: np.ndarray,
+                    weights: Optional[np.ndarray] = None,
+                    sharding=None) -> "DeviceGraph":
+        n, max_deg = nbrs.shape
+        enforce(len(nodes) == n and len(deg) == n, "shape mismatch")
+        capped_rows = int((np.asarray(deg) > max_deg).sum())
+        kept = np.minimum(deg, max_deg)
+        if weights is None:
+            weights = (np.arange(max_deg)[None, :] < kept[:, None]
+                       ).astype(np.float32)
+        w = np.where(np.arange(max_deg)[None, :] < kept[:, None],
+                     np.maximum(weights, 0.0), 0.0)
+        cdf = np.cumsum(w, axis=1, dtype=np.float32)
+        hi, lo = split_keys(nbrs.reshape(-1))
+        key_map = DeviceKeyMap(keys=nodes,
+                               rows=np.arange(n, dtype=np.int32),
+                               sharding=sharding)
+        state = {
+            "nbr_hi": jnp.asarray(hi.reshape(n, max_deg)),
+            "nbr_lo": jnp.asarray(lo.reshape(n, max_deg)),
+            "cdf": jnp.asarray(cdf),
+            "deg": jnp.asarray(kept.astype(np.int32)),
+            "map": key_map.state,
+        }
+        if sharding is not None:
+            state = {k: (jax.device_put(v, sharding) if k != "map" else v)
+                     for k, v in state.items()}
+        return DeviceGraph(state, max_deg, capped_rows)
+
+    # -- in-graph ops ----------------------------------------------------
+
+    @staticmethod
+    def lookup_rows(state, hi, lo):
+        """[n] int32 rows, −1 for unknown nodes."""
+        return device_hash_lookup(state["map"], hi, lo)
+
+    @staticmethod
+    def _samplable(state, rows):
+        """Valid row AND kept degree > 0 AND positive weight mass — a
+        known node whose kept weights all clamp to 0 must mask out, not
+        surface the padding key as a 'neighbor'."""
+        r = jnp.clip(rows, 0, state["deg"].shape[0] - 1)
+        return ((rows >= 0) & (jnp.take(state["deg"], r) > 0)
+                & (state["cdf"][r, -1] > 0))
+
+    @staticmethod
+    def _draw(state, rng, rows, shape):
+        """Weighted draw of ONE neighbor slot per (row, draw): CDF
+        inverse via searchsorted. rows −1/degree-0 → slot 0 (callers
+        mask)."""
+        r = jnp.clip(rows, 0, state["cdf"].shape[0] - 1)
+        cdf = state["cdf"][r]                     # [..., max_deg]
+        total = cdf[..., -1:]
+        u = jax.random.uniform(rng, shape) * jnp.maximum(total[..., 0], 1e-30)
+        slot = jnp.sum((cdf < u[..., None]).astype(jnp.int32), axis=-1)
+        return jnp.minimum(slot, state["cdf"].shape[1] - 1)
+
+    @staticmethod
+    def sample_neighbors(state, rng, hi, lo, k: int
+                         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """[n] node key halves → (nbr_hi [n,k], nbr_lo [n,k], mask [n,k])
+        — k weighted draws WITH replacement per node (the GPU sample
+        kernel's contract; without-replacement stays host/server-side)."""
+        rows = DeviceGraph.lookup_rows(state, hi, lo)
+        ok = DeviceGraph._samplable(state, rows)
+        slot = DeviceGraph._draw(state, rng, rows[:, None], (hi.shape[0], k))
+        r = jnp.clip(rows, 0, state["deg"].shape[0] - 1)
+        nh = jnp.take_along_axis(state["nbr_hi"][r], slot, axis=1)
+        nl = jnp.take_along_axis(state["nbr_lo"][r], slot, axis=1)
+        mask = jnp.broadcast_to(ok[:, None], nh.shape)
+        return (jnp.where(mask, nh, 0), jnp.where(mask, nl, 0), mask)
+
+    @staticmethod
+    def random_walk(state, rng, hi, lo, length: int
+                    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """Deepwalk generator: [n] start keys → (walk_hi, walk_lo
+        [n, length+1], live [n, length+1]) — a lax.scan of single-draw
+        steps; dead ends freeze (live goes False from there on)."""
+        n = hi.shape[0]
+
+        def step(carry, key):
+            chi, clo, alive = carry
+            rows = DeviceGraph.lookup_rows(state, chi, clo)
+            ok = alive & DeviceGraph._samplable(state, rows)
+            slot = DeviceGraph._draw(state, key, rows, (n,))
+            r = jnp.clip(rows, 0, state["deg"].shape[0] - 1)
+            nh = jnp.take_along_axis(state["nbr_hi"][r], slot[:, None],
+                                     axis=1)[:, 0]
+            nl = jnp.take_along_axis(state["nbr_lo"][r], slot[:, None],
+                                     axis=1)[:, 0]
+            nh = jnp.where(ok, nh, chi)
+            nl = jnp.where(ok, nl, clo)
+            return (nh, nl, ok), (nh, nl, ok)
+
+        keys = jax.random.split(rng, length)
+        init = (hi.astype(jnp.uint32), lo.astype(jnp.uint32),
+                jnp.ones(n, bool))
+        _, (wh, wl, alive) = lax.scan(step, init, keys)
+        walk_hi = jnp.concatenate([hi[None, :], wh], axis=0).T
+        walk_lo = jnp.concatenate([lo[None, :], wl], axis=0).T
+        live = jnp.concatenate([jnp.ones((1, n), bool), alive], axis=0).T
+        return walk_hi, walk_lo, live

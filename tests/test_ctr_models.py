@@ -279,9 +279,10 @@ def test_slab_step_matches_sequential_packed(rng):
 
     import paddle_tpu as pt
     from paddle_tpu import optimizer
-    from paddle_tpu.models.ctr import (CtrConfig, DeepFM, pack_ctr_batch,
+    from paddle_tpu.models.ctr import (CtrConfig, DeepFM,
                                        make_ctr_train_step_packed,
-                                       make_ctr_train_step_slab)
+                                       make_ctr_train_step_slab,
+                                       make_random_packs)
     from paddle_tpu.ps.accessor import AccessorConfig
     from paddle_tpu.ps.embedding_cache import CacheConfig, HbmEmbeddingCache
     from paddle_tpu.ps.table import MemorySparseTable, TableConfig
@@ -306,8 +307,6 @@ def test_slab_step_matches_sequential_packed(rng):
 
     cache1, pool, m1, o1, p1, s1 = build()
     cache2, _, m2, o2, p2, s2 = build()
-
-    from paddle_tpu.models.ctr import make_random_packs
 
     packs = make_random_packs(rng, pool, B, D, slab, p_click=0.4)
 

@@ -1,0 +1,136 @@
+"""Device-resident graph table (ops/device_graph.py): in-graph neighbor
+sampling and deepwalk random walks vs the host GraphTable adjacency
+(the graph_gpu_ps_table.h / GraphDataGenerator roles, TPU-native)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.device_graph import DeviceGraph
+from paddle_tpu.ps.device_hash import split_keys
+from paddle_tpu.ps.graph_table import GraphTable
+from paddle_tpu.ps.native import native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native cuckoo unavailable")
+
+
+def _graph(rng, n_nodes=64, n_edges=400):
+    g = GraphTable(shard_num=4)
+    nodes = np.arange(1, n_nodes + 1, dtype=np.uint64)
+    g.add_graph_node(nodes)
+    src = rng.choice(nodes, n_edges)
+    dst = rng.choice(nodes, n_edges)
+    w = rng.uniform(0.5, 2.0, n_edges).astype(np.float32)
+    g.add_edges(src, dst, w)
+    adj = {}
+    for s, d in zip(src, dst):
+        adj.setdefault(int(s), set()).add(int(d))
+    return g, nodes, adj
+
+
+def _keys64(hi, lo):
+    return (np.asarray(hi, np.uint64) << np.uint64(32)) | np.asarray(
+        lo, np.uint64)
+
+
+def test_sample_neighbors_stays_on_edges(rng):
+    g, nodes, adj = _graph(rng)
+    dg = DeviceGraph.from_graph_table(g, max_deg=32)
+    assert dg.capped_rows == 0
+    q = rng.choice(nodes, 20, replace=False)
+    hi, lo = split_keys(q)
+    fn = jax.jit(lambda r, h, l: DeviceGraph.sample_neighbors(
+        dg.state, r, h, l, 8))
+    nh, nl, mask = fn(jax.random.key(0), jnp.asarray(hi), jnp.asarray(lo))
+    nh, nl, mask = map(np.asarray, (nh, nl, mask))
+    for i, nid in enumerate(q):
+        cand = adj.get(int(nid), set())
+        if not cand:
+            assert not mask[i].any()
+            continue
+        assert mask[i].all()  # with replacement: every draw valid
+        got = set(_keys64(nh[i], nl[i]).tolist())
+        assert got <= cand, (nid, got - cand)
+
+
+def test_sample_unknown_and_isolated_nodes_masked(rng):
+    g, nodes, _ = _graph(rng)
+    g.add_graph_node([999])  # isolated: registered, no edges
+    dg = DeviceGraph.from_graph_table(g, max_deg=32)
+    q = np.asarray([999, 123456789], np.uint64)  # isolated + unknown
+    hi, lo = split_keys(q)
+    _, _, mask = DeviceGraph.sample_neighbors(
+        dg.state, jax.random.key(1), jnp.asarray(hi), jnp.asarray(lo), 4)
+    assert not np.asarray(mask).any()
+
+
+def test_random_walks_follow_edges_and_freeze_at_dead_ends(rng):
+    g = GraphTable(shard_num=2)
+    # a path graph 1→2→3→4 plus a sink node 4 (no out-edges): walks
+    # must follow the chain and freeze at the sink
+    g.add_edges([1, 2, 3], [2, 3, 4])
+    dg = DeviceGraph.from_graph_table(g, max_deg=4)
+    hi, lo = split_keys(np.asarray([1, 4], np.uint64))
+    wh, wl, live = jax.jit(lambda r, h, l: DeviceGraph.random_walk(
+        dg.state, r, h, l, 5))(jax.random.key(0), jnp.asarray(hi),
+                               jnp.asarray(lo))
+    walks = _keys64(np.asarray(wh), np.asarray(wl))
+    live = np.asarray(live)
+    np.testing.assert_array_equal(walks[0, :4], [1, 2, 3, 4])
+    assert live[0, :4].all() and not live[0, 4:].any()
+    np.testing.assert_array_equal(walks[0, 4:], 4)  # frozen at the sink
+    np.testing.assert_array_equal(walks[1], 4)      # started at the sink
+    assert live[1, 0] and not live[1, 1:].any()
+
+
+def test_weighted_sampling_respects_weights(rng):
+    g = GraphTable(shard_num=2)
+    # node 1 → {2 (w 9), 3 (w 1)}: draws should favor 2 roughly 9:1
+    g.add_edges([1, 1], [2, 3], [9.0, 1.0])
+    dg = DeviceGraph.from_graph_table(g, max_deg=4)
+    hi, lo = split_keys(np.asarray([1], np.uint64))
+    nh, nl, mask = DeviceGraph.sample_neighbors(
+        dg.state, jax.random.key(2), jnp.asarray(hi), jnp.asarray(lo), 2000)
+    drawn = _keys64(np.asarray(nh)[0], np.asarray(nl)[0])
+    frac2 = (drawn == 2).mean()
+    assert 0.85 < frac2 < 0.95, frac2  # 9:1 odds within sampling noise
+
+
+def test_degree_cap_is_counted_not_silent(rng):
+    g = GraphTable(shard_num=2)
+    g.add_edges(np.ones(10, np.int64), np.arange(2, 12))
+    dg = DeviceGraph.from_graph_table(g, max_deg=4)
+    assert dg.capped_rows == 1
+    hi, lo = split_keys(np.asarray([1], np.uint64))
+    nh, nl, mask = DeviceGraph.sample_neighbors(
+        dg.state, jax.random.key(3), jnp.asarray(hi), jnp.asarray(lo), 16)
+    # capped row samples only its kept (first max_deg) neighbors
+    drawn = set(_keys64(np.asarray(nh)[0], np.asarray(nl)[0]).tolist())
+    assert drawn <= {2, 3, 4, 5}
+
+
+def test_zero_weight_mass_node_is_masked(rng):
+    """A known node whose kept weights all clamp to zero must mask out —
+    not surface the padding key 0 as a live neighbor/walk step."""
+    nodes = np.asarray([5], np.uint64)
+    nbrs = np.asarray([[7, 8, 0, 0]], np.uint64)
+    deg = np.asarray([2], np.int32)
+    dg = DeviceGraph.from_arrays(nodes, nbrs, deg,
+                                 weights=np.zeros((1, 4), np.float32))
+    hi, lo = split_keys(nodes)
+    _, _, mask = DeviceGraph.sample_neighbors(
+        dg.state, jax.random.key(0), jnp.asarray(hi), jnp.asarray(lo), 4)
+    assert not np.asarray(mask).any()
+    wh, wl, live = DeviceGraph.random_walk(
+        dg.state, jax.random.key(0), jnp.asarray(hi), jnp.asarray(lo), 3)
+    assert not np.asarray(live)[0, 1:].any()
+    np.testing.assert_array_equal(_keys64(np.asarray(wh), np.asarray(wl))[0], 5)
+
+
+def test_from_arrays_counts_capping(rng):
+    nodes = np.asarray([1], np.uint64)
+    nbrs = np.asarray([[2, 3]], np.uint64)
+    dg = DeviceGraph.from_arrays(nodes, nbrs, np.asarray([9], np.int32))
+    assert dg.capped_rows == 1
