@@ -22,6 +22,12 @@ if [[ "${1:-fast}" == "full" ]]; then
   PYTHONPATH="$PWD:${PYTHONPATH:-}" WD_POP=200000 WD_RECORDS=5000 WD_DAYS=1 \
     python tools/widedeep_daily.py | python -c \
     "import json,sys; d=json.load(sys.stdin); assert 'error' not in d, d; print('widedeep_daily OK')"
+  PYTHONPATH="$PWD:${PYTHONPATH:-}" ANCHOR_POP=130000 ANCHOR_DAYS=1 \
+    ANCHOR_STEPS_PER_DAY=20 ANCHOR_BATCH=256 ANCHOR_EVAL_EVERY=5 \
+    ANCHOR_OUT=/tmp/ci_anchor_v2.json \
+    python tools/make_anchor_v2.py | python -c \
+    "import json,sys; d=json.loads(sys.stdin.read().splitlines()[-1]); \
+assert d['gates']['parity_ok'], d; print('anchor_v2 parity OK')"
   # bench/tpu_smoke intentionally exit 0 on failure (one-JSON-line
   # driver contract), so they must run as SUBPROCESSES with the check
   # in a separate process — an in-process runpy assert would be skipped

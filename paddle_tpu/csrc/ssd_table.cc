@@ -597,7 +597,12 @@ int64_t sst_compact(void* h) {
   SsdTable* t = static_cast<SsdTable*>(h);
   per_shard(t, [&](Shard*, DiskShard* d, int32_t) { compact_shard(t, d); });
   int64_t bytes = 0;
-  for (DiskShard* d : t->disk) bytes += d->n_records * t->rec_bytes;
+  for (DiskShard* d : t->disk) {
+    // n_records mutates under the disk mutex (append/spill workers of a
+    // CONCURRENT caller may still be running) — read it under the lock
+    std::lock_guard<std::mutex> g(d->mu);
+    bytes += d->n_records * t->rec_bytes;
+  }
   return bytes;
 }
 

@@ -75,6 +75,10 @@ def run_anchor(pop=10_000_000, days=6, steps_per_day=150, batch=512,
                     dnn_hidden=tuple(dnn))
     S, dim = cfg.num_sparse_slots, cfg.embedx_dim
     pop_per_slot = pop // S
+    # scale the hot window / daily fresh slice into the population so
+    # reduced-scale runs (CI smoke) keep the same day structure
+    hot = min(hot, max(2, pop_per_slot // 2))
+    fresh = max(1, min(fresh, (pop_per_slot - hot) // max(days, 1)))
     base = base_dir or tempfile.mkdtemp(prefix="anchor_v2_")
     cleanup = base_dir is None
     rng = np.random.default_rng(0)
@@ -85,7 +89,9 @@ def run_anchor(pop=10_000_000, days=6, steps_per_day=150, batch=512,
 
     def sample(n, day, day_rng):
         ids = day_rng.choice(hot, size=(n, S), p=zipf_p).astype(np.uint64)
-        lo = hot + day * fresh
+        # fresh window clamped INSIDE the population: at tiny scales the
+        # per-day stride can run past it (then later days reuse the tail)
+        lo = min(hot + day * fresh, pop_per_slot - 1)
         is_fresh = day_rng.random((n, S)) < 0.15
         fresh_ids = day_rng.integers(
             lo, min(lo + fresh, pop_per_slot), size=(n, S)).astype(np.uint64)
@@ -259,7 +265,8 @@ def run_anchor(pop=10_000_000, days=6, steps_per_day=150, batch=512,
     sa = results["stream"]["auc_curve"]
     pa = results["pass"]["auc_curve"]
     assert len(sa) == len(pa)
-    warm = max(1, len(sa) // 5)  # ignore the pre-learning head
+    # ignore the pre-learning head, but never empty the comparison set
+    warm = min(max(1, len(sa) // 5), len(sa) - 1)
     gaps = [abs(a[2] - b[2]) for a, b in zip(sa[warm:], pa[warm:])]
     final_gap = abs(results["stream"]["final_auc"]
                     - results["pass"]["final_auc"])
@@ -307,9 +314,10 @@ def main() -> None:
         days=int(os.environ.get("ANCHOR_DAYS", 6)),
         steps_per_day=int(os.environ.get("ANCHOR_STEPS_PER_DAY", 150)),
         batch=int(os.environ.get("ANCHOR_BATCH", 512)),
+        eval_every=int(os.environ.get("ANCHOR_EVAL_EVERY", 25)),
     )
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "ANCHOR.json")
+    path = os.environ.get("ANCHOR_OUT") or os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "ANCHOR.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({"final_auc_stream": out["paths"]["stream"]["final_auc"],
