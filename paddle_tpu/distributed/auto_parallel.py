@@ -32,7 +32,7 @@ from .. import nn
 from ..optimizer import Optimizer
 
 __all__ = ["ProcessMesh", "shard_tensor", "shard_op", "annotate",
-           "complete_shardings", "reshard", "Engine"]
+           "complete_shardings", "reshard", "plan_strategy", "Engine"]
 
 
 class ProcessMesh:
@@ -253,6 +253,82 @@ def complete_shardings(
             for pname in pnames:
                 put(pname, PartitionSpec())
     return specs
+
+
+def plan_strategy(model, n_devices: Optional[int] = None,
+                  per_device_bytes: float = 16e9,
+                  state_multiplier: float = 4.0,
+                  ) -> Tuple[ProcessMesh, Dict[str, Sequence[Optional[int]]]]:
+    """The Planner (reference ``auto_parallel/planner_v2.py`` role):
+    pick a (dp, mp) mesh factorization and the dist-attr hints that make
+    the model fit, automatically.
+
+    Memory model: training state ≈ ``state_multiplier`` × param bytes
+    (f32 params + grads + Adam m/v). If that fits one device, pure data
+    parallel wins (no comms beyond grad allreduce). Otherwise choose the
+    smallest power-of-two ``mp`` that brings the per-device share under
+    budget, and emit one column-parallel hint per large Megatron pair —
+    :func:`complete_shardings` then derives the row partners, biases and
+    norms. Returns ``(ProcessMesh(dp, mp), annotations)`` ready for
+    :class:`Engine`.
+
+    This is deliberately a greedy heuristic, not the reference's full
+    cost-model search — it covers the planner's decision (which axis,
+    which tensors) with an auditable rule."""
+    devs = n_devices if n_devices is not None else len(jax.devices())
+    params = dict(model.named_parameters())
+    total = sum(int(np.prod(p.shape)) * np.dtype(p.dtype).itemsize
+                for p in params.values())
+    need = total * state_multiplier
+
+    # mp walks power-of-two DIVISORS of the device count only — a
+    # non-power-of-two slice gets the largest usable factor, never a
+    # "cannot factor" crash
+    mp = 1
+    while need / mp > per_device_bytes:
+        nxt = mp * 2
+        if nxt > devs or devs % nxt != 0:
+            break
+        mp = nxt
+
+    annotations: Dict[str, Sequence[Optional[int]]] = {}
+    if mp > 1:
+        # hint the large shardable weights (Linears in alternating
+        # col/row Megatron pairs, Embeddings vocab- or hidden-parallel);
+        # completion fills the rest. Only dims divisible by mp qualify.
+        from ..nn.layers import Embedding, Linear
+
+        sizes = [int(np.prod(l._parameters["weight"].shape))
+                 for _, l in _named_leaf_layers(model)
+                 if isinstance(l, (Linear, Embedding))
+                 and "weight" in l._parameters]
+        threshold = max(sizes, default=0) // 4
+        col_next = True
+        for name, layer in _named_leaf_layers(model):
+            w = layer._parameters.get("weight")
+            wn = f"{name}.weight" if name else "weight"
+            if w is None or int(np.prod(w.shape)) < threshold:
+                continue
+            if isinstance(layer, Linear):
+                if col_next and w.shape[1] % mp == 0:
+                    annotations[wn] = [-1, 1]   # column-parallel
+                    col_next = False
+                elif not col_next and w.shape[0] % mp == 0:
+                    annotations[wn] = [1, -1]   # row-parallel partner
+                    col_next = True
+            elif isinstance(layer, Embedding):
+                if w.shape[0] % mp == 0:
+                    annotations[wn] = [1, -1]   # vocab-parallel
+                elif w.shape[1] % mp == 0:
+                    annotations[wn] = [-1, 1]   # hidden-parallel
+        if not annotations:
+            # nothing shardable at this mp (odd dims, embedding-free
+            # budget blowup): an mp the plan cannot use would halve dp
+            # for zero memory relief — fall back to pure dp, honestly
+            mp = 1
+    dp = devs // mp
+    mesh = ProcessMesh(shape=(dp, mp), dim_names=("dp", "mp"))
+    return mesh, annotations
 
 
 def reshard(x, process_mesh: ProcessMesh,
