@@ -1,0 +1,151 @@
+"""PaddleRec-style YAML job config → framework objects.
+
+The reference's PS jobs are configured through PaddleRec YAML files
+(``hyper_parameters`` + ``runner`` blocks; e.g. the unittests/ps/
+``*_ps_config.yaml`` family) that ``ps_dnn_trainer.py``'s
+``get_user_defined_strategy`` turns into a ``DistributedStrategy`` and a
+model; ``test_the_one_ps.py`` diff-tests that derivation WITHOUT running
+a job. This module keeps that user surface: the same YAML schema loads
+into (:class:`CtrConfig`, :class:`TableConfig`,
+:class:`DistributedStrategy`, trainer selection), so a PaddleRec rank
+job moves over by pointing at its existing config.
+
+Mapping notes (documented divergences, not guesses):
+- ``sparse_inputs_slots`` counts the label slot (PaddleRec convention) —
+  the model gets N−1 sparse slots;
+- ``sparse_feature_dim`` is the per-feature embedding vector the model
+  consumes; in the CTR accessor layout that vector is
+  ``embed_w ++ embedx`` → ``embedx_dim = sparse_feature_dim − 1``;
+- ``sync_mode`` selects both the strategy flags (exactly the reference's
+  get_user_defined_strategy branches) and the trainer: ``gpubox``/
+  ``heter`` run the pass path (HBM cache, CtrPassTrainer role),
+  ``sync``/``async``/``geo`` the stream path (CtrStreamTrainer).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple, Union
+
+from ..core.enforce import InvalidArgumentError, enforce
+from .accessor import AccessorConfig
+from .table import TableConfig
+
+__all__ = ["PsJobConfig", "load_ps_config"]
+
+_MODES = ("sync", "async", "geo", "heter", "gpubox")
+
+
+@dataclasses.dataclass
+class PsJobConfig:
+    """Everything a PS job derives from one YAML file."""
+
+    sync_mode: str
+    thread_num: int
+    num_sparse_slots: int
+    sparse_feature_number: int
+    dense_input_dim: int
+    fc_sizes: Tuple[int, ...]
+    optimizer_class: str
+    learning_rate: float
+    table: TableConfig
+    strategy: Any          # DistributedStrategy
+    trainer: str           # "CtrPassTrainer" | "CtrStreamTrainer"
+    raw: Dict[str, Any]
+
+    def make_model_config(self):
+        from ..models.ctr import CtrConfig
+
+        return CtrConfig(
+            num_sparse_slots=self.num_sparse_slots,
+            num_dense=self.dense_input_dim,
+            embedx_dim=self.table.accessor_config.embedx_dim,
+            dnn_hidden=self.fc_sizes,
+        )
+
+    def make_optimizer(self):
+        from .. import optimizer as opt_mod
+
+        cls = getattr(opt_mod, self.optimizer_class, None)
+        enforce(cls is not None,
+                f"unknown optimizer class {self.optimizer_class!r}")
+        return cls(learning_rate=self.learning_rate)
+
+
+def _get(cfg: Dict[str, Any], dotted: str, default=None):
+    cur: Any = cfg
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return default
+        cur = cur[part]
+    return cur
+
+
+def load_ps_config(source: Union[str, Dict[str, Any]]) -> PsJobConfig:
+    """Load a PaddleRec-style YAML file (path) or an equivalent dict."""
+    if isinstance(source, str):
+        import yaml
+
+        with open(source) as f:
+            cfg = yaml.safe_load(f)
+    else:
+        cfg = dict(source)
+    # YAML spells an empty block as null — treat it like a missing one
+    enforce(isinstance(cfg, dict)
+            and isinstance(cfg.get("hyper_parameters"), dict),
+            "config needs a non-empty hyper_parameters block")
+    hp = cfg["hyper_parameters"]
+
+    slots_with_label = int(hp.get("sparse_inputs_slots", 27))
+    feature_dim = int(hp.get("sparse_feature_dim", 9))
+    enforce(feature_dim >= 2, "sparse_feature_dim must be >= 2 "
+            "(embed_w + at least one embedx column)")
+    opt_cfg = hp.get("optimizer", {}) or {}
+
+    sync_mode = str(_get(cfg, "runner.sync_mode", "async")).lower()
+    if sync_mode not in _MODES:
+        raise InvalidArgumentError(
+            f"runner.sync_mode must be one of {_MODES}, got {sync_mode!r}")
+
+    # strategy flags: get_user_defined_strategy's branches
+    from ..distributed.strategy import DistributedStrategy
+
+    strategy = DistributedStrategy()
+    if sync_mode == "sync":
+        strategy.a_sync = False
+    elif sync_mode == "async":
+        strategy.a_sync = True
+    elif sync_mode == "geo":
+        strategy.a_sync = True
+        strategy.geo_sgd_mode = True
+        strategy.geo_configs["geo_step"] = int(_get(cfg, "runner.geo_step",
+                                                    100))
+    elif sync_mode == "heter":
+        strategy.a_sync = True
+        strategy.a_sync_configs["heter_worker_device_guard"] = "tpu"
+    elif sync_mode == "gpubox":
+        strategy.a_sync = True
+        strategy.a_sync_configs["use_ps_gpu"] = 1
+
+    table = TableConfig(
+        shard_num=int(_get(cfg, "runner.thread_num", 16)),
+        accessor_config=AccessorConfig(embedx_dim=feature_dim - 1),
+    )
+
+    return PsJobConfig(
+        sync_mode=sync_mode,
+        thread_num=int(_get(cfg, "runner.thread_num", 16)),
+        num_sparse_slots=slots_with_label - 1,
+        sparse_feature_number=int(hp.get("sparse_feature_number", 1 << 20)),
+        dense_input_dim=int(hp.get("dense_input_dim", 13)),
+        # `fc_sizes:` with no value parses as None — same as absent
+        fc_sizes=tuple(int(x) for x in
+                       (hp.get("fc_sizes") or (400, 400, 400))),
+        optimizer_class=str(opt_cfg.get("class", "Adam")),
+        learning_rate=float(opt_cfg.get("learning_rate", 1e-3)),
+        table=table,
+        strategy=strategy,
+        trainer=("CtrPassTrainer" if sync_mode in ("gpubox", "heter")
+                 else "CtrStreamTrainer"),
+        raw=cfg,
+    )
