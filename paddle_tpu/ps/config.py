@@ -66,7 +66,10 @@ class PsJobConfig:
     def make_optimizer(self):
         from .. import optimizer as opt_mod
 
-        cls = getattr(opt_mod, self.optimizer_class, None)
+        # case-insensitive: PaddleRec configs commonly spell `class: adam`
+        by_name = {n.lower(): getattr(opt_mod, n) for n in opt_mod.__all__
+                   if isinstance(getattr(opt_mod, n, None), type)}
+        cls = by_name.get(self.optimizer_class.lower())
         enforce(cls is not None,
                 f"unknown optimizer class {self.optimizer_class!r}")
         return cls(learning_rate=self.learning_rate)
@@ -78,7 +81,13 @@ def _get(cfg: Dict[str, Any], dotted: str, default=None):
         if not isinstance(cur, dict) or part not in cur:
             return default
         cur = cur[part]
-    return cur
+    # an explicit `key:` with no value parses as None — same as absent
+    return default if cur is None else cur
+
+
+def _hp(hp: Dict[str, Any], key: str, default):
+    v = hp.get(key, default)
+    return default if v is None else v
 
 
 def load_ps_config(source: Union[str, Dict[str, Any]]) -> PsJobConfig:
@@ -96,11 +105,11 @@ def load_ps_config(source: Union[str, Dict[str, Any]]) -> PsJobConfig:
             "config needs a non-empty hyper_parameters block")
     hp = cfg["hyper_parameters"]
 
-    slots_with_label = int(hp.get("sparse_inputs_slots", 27))
-    feature_dim = int(hp.get("sparse_feature_dim", 9))
+    slots_with_label = int(_hp(hp, "sparse_inputs_slots", 27))
+    feature_dim = int(_hp(hp, "sparse_feature_dim", 9))
     enforce(feature_dim >= 2, "sparse_feature_dim must be >= 2 "
             "(embed_w + at least one embedx column)")
-    opt_cfg = hp.get("optimizer", {}) or {}
+    opt_cfg = _hp(hp, "optimizer", {})
 
     sync_mode = str(_get(cfg, "runner.sync_mode", "async")).lower()
     if sync_mode not in _MODES:
@@ -136,13 +145,12 @@ def load_ps_config(source: Union[str, Dict[str, Any]]) -> PsJobConfig:
         sync_mode=sync_mode,
         thread_num=int(_get(cfg, "runner.thread_num", 16)),
         num_sparse_slots=slots_with_label - 1,
-        sparse_feature_number=int(hp.get("sparse_feature_number", 1 << 20)),
-        dense_input_dim=int(hp.get("dense_input_dim", 13)),
-        # `fc_sizes:` with no value parses as None — same as absent
-        fc_sizes=tuple(int(x) for x in
-                       (hp.get("fc_sizes") or (400, 400, 400))),
-        optimizer_class=str(opt_cfg.get("class", "Adam")),
-        learning_rate=float(opt_cfg.get("learning_rate", 1e-3)),
+        sparse_feature_number=int(_hp(hp, "sparse_feature_number", 1 << 20)),
+        dense_input_dim=int(_hp(hp, "dense_input_dim", 13)),
+        fc_sizes=tuple(int(x) for x in _hp(hp, "fc_sizes",
+                                           (400, 400, 400))),
+        optimizer_class=str(opt_cfg.get("class") or "Adam"),
+        learning_rate=float(opt_cfg.get("learning_rate") or 1e-3),
         table=table,
         strategy=strategy,
         trainer=("CtrPassTrainer" if sync_mode in ("gpubox", "heter")

@@ -108,6 +108,7 @@ class CtrPassTrainer:
         dense_slots: Sequence[str],
         label_slot: str,
         prefetch_depth: int = 3,
+        slab: int = 1,
     ) -> None:
         self.model = model
         self.optimizer = optimizer
@@ -117,24 +118,34 @@ class CtrPassTrainer:
         self.dense_slots = list(dense_slots)
         self.label_slot = label_slot
         self.prefetch_depth = prefetch_depth
+        #: train steps per dispatch (lax.scan over a packed stack —
+        #: bitwise-identical to sequential steps, amortizes the
+        #: per-dispatch host cost; tail batches run single steps)
+        self.slab = int(slab)
 
         self.params = {"params": dict(model.named_parameters()), "buffers": {}}
         self.opt_state = optimizer.init(self.params)
-        # one compiled step per batch size (packed single-buffer wire:
-        # offsets bake B in); train_from_dataset reuses across passes
-        self._packed_steps: Dict[int, Any] = {}
+        # one compiled step per (batch size, slab) — packed wire offsets
+        # bake B in; train_from_dataset reuses across passes
+        self._packed_steps: Dict[Tuple[int, int], Any] = {}
 
-    def _packed_step(self, batch_size: int):
-        from ..models.ctr import make_ctr_train_step_packed
+    def _packed_step(self, batch_size: int, slab: int = 1):
+        from ..models.ctr import (make_ctr_train_step_packed,
+                                  make_ctr_train_step_slab)
 
-        step = self._packed_steps.get(batch_size)
+        step = self._packed_steps.get((batch_size, slab))
         if step is None:
-            step = make_ctr_train_step_packed(
-                self.model, self.optimizer, self.cache.config,
-                slot_ids=np.arange(len(self.sparse_slots)),
-                batch_size=batch_size, num_dense=len(self.dense_slots),
-                with_weights=True)
-            self._packed_steps[batch_size] = step
+            kw = dict(slot_ids=np.arange(len(self.sparse_slots)),
+                      batch_size=batch_size,
+                      num_dense=len(self.dense_slots), with_weights=True)
+            if slab > 1:
+                step = make_ctr_train_step_slab(
+                    self.model, self.optimizer, self.cache.config,
+                    slab=slab, **kw)
+            else:
+                step = make_ctr_train_step_packed(
+                    self.model, self.optimizer, self.cache.config, **kw)
+            self._packed_steps[(batch_size, slab)] = step
         return step
 
     # -- batch packing (MiniBatchGpuPack role) ---------------------------
@@ -350,6 +361,9 @@ class CtrPassTrainer:
         from ..models.ctr import pack_ctr_batch
 
         step = self._packed_step(batch_size)
+        slab = max(1, self.slab)
+        slab_step = (self._packed_step(batch_size, slab) if slab > 1
+                     else None)
 
         def host_batches():
             for batch in dataset.batch_iter(batch_size, drop_last=drop_last):
@@ -364,23 +378,45 @@ class CtrPassTrainer:
                 yield pack_ctr_batch(lo32, dense, labels,
                                      weights=weights), n_real
 
+        def host_groups():
+            # group `slab` packed buffers per dispatch; the tail of the
+            # pass (fewer than slab) falls back to single steps
+            buf, reals = [], []
+            for packed, n_real in host_batches():
+                buf.append(packed)
+                reals.append(n_real)
+                if len(buf) == slab:
+                    yield np.stack(buf), sum(reals), True
+                    buf, reals = [], []
+            for packed, n_real in zip(buf, reals):
+                yield packed, n_real, False
+
         def to_device(item):
-            packed, n_real = item
-            return jnp.asarray(packed), n_real
+            packed, n_real, is_slab = item
+            return jnp.asarray(packed), n_real, is_slab
 
         stats = _PassStats()
         t0 = time.perf_counter()
-        pf = DevicePrefetcher(host_batches(), depth=self.prefetch_depth,
+        pf = DevicePrefetcher(host_groups() if slab > 1 else (
+                                  (p, n, False) for p, n in host_batches()),
+                              depth=self.prefetch_depth,
                               transform=to_device)
         losses = []  # device scalars — ONE host sync at pass end
         try:
-            for packed, n_real in pf:
+            for packed, n_real, is_slab in pf:
                 with RecordEvent("ctr_train_step"):
-                    self.params, self.opt_state, self.cache.state, loss = \
-                        step(self.params, self.opt_state,
-                             self.cache.state, map_state, packed)
-                losses.append(loss)
-                stats.steps += 1
+                    if is_slab:
+                        self.params, self.opt_state, self.cache.state, ls = \
+                            slab_step(self.params, self.opt_state,
+                                      self.cache.state, map_state, packed)
+                        losses.append(jnp.sum(ls))
+                        stats.steps += slab
+                    else:
+                        self.params, self.opt_state, self.cache.state, loss = \
+                            step(self.params, self.opt_state,
+                                 self.cache.state, map_state, packed)
+                        losses.append(loss)
+                        stats.steps += 1
                 stats.samples += n_real  # host count — no device sync
         finally:
             pf.close()

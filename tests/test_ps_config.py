@@ -132,3 +132,22 @@ def test_yaml_null_blocks_handled(tmp_path):
     job = load_ps_config({"hyper_parameters": {"fc_sizes": None},
                           "runner": {"sync_mode": "async"}})
     assert job.fc_sizes == (400, 400, 400)
+
+
+def test_null_scalars_and_lowercase_optimizer(tmp_path):
+    p = tmp_path / "nulls.yaml"
+    p.write_text(
+        "hyper_parameters:\n"
+        "  optimizer:\n"
+        "    class: adam\n"
+        "    learning_rate:\n"
+        "  sparse_inputs_slots:\n"
+        "  sparse_feature_dim: 10\n"
+        "runner:\n"
+        "  sync_mode: async\n"
+        "  thread_num:\n")
+    job = load_ps_config(str(p))
+    assert job.num_sparse_slots == 26      # default despite explicit null
+    assert job.thread_num == 16
+    assert job.learning_rate == 1e-3
+    assert type(job.make_optimizer()).__name__ == "Adam"  # lowercase ok

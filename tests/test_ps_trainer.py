@@ -557,3 +557,57 @@ def test_auto_checkpoint_resumes_day_stream(tmp_path, rng):
     np.testing.assert_allclose(
         t2.pull_sparse(probe, create=False),
         t_ref.pull_sparse(probe, create=False), rtol=1e-6, atol=1e-8)
+
+
+def test_slab_pass_matches_single_step_pass():
+    """CtrPassTrainer with slab>1 (scan-dispatched groups) walks a
+    bitwise-identical trajectory to slab=1, including a tail that
+    doesn't fill the last slab."""
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.data.dataset import InMemoryDataset, SlotDesc
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.embedding_cache import CacheConfig
+    from paddle_tpu.ps.ps_trainer import CtrPassTrainer
+    from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+    S, D = 4, 3
+    slots = ([SlotDesc(f"s{i}", is_float=False, max_len=1) for i in range(S)]
+             + [SlotDesc(f"d{i}", is_float=True, max_len=1) for i in range(D)]
+             + [SlotDesc("label", is_float=True, max_len=1)])
+    rng = np.random.default_rng(0)
+    lines = []
+    for _ in range(330):  # 330 rows / batch 32 → 10 full + tail of 10
+        parts = [f"1 {rng.integers(1, 64)}" for _ in range(S)]
+        parts += [f"1 {rng.normal():.4f}" for _ in range(D)]
+        parts.append(f"1 {rng.integers(0, 2)}")
+        lines.append(" ".join(parts))
+
+    def run(slab):
+        pt.seed(0)
+        table = MemorySparseTable(TableConfig(
+            shard_num=4, accessor_config=AccessorConfig(embedx_dim=4)))
+        tr = CtrPassTrainer(
+            DeepFM(CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=4,
+                             dnn_hidden=(16,))),
+            optimizer.Adam(1e-2), table,
+            CacheConfig(capacity=1 << 12, embedx_dim=4,
+                        embedx_threshold=0.0),
+            sparse_slots=[f"s{i}" for i in range(S)],
+            dense_slots=[f"d{i}" for i in range(D)], label_slot="label",
+            slab=slab)
+        ds = InMemoryDataset(slots, seed=1)
+        ds.load_from_lines(lines)
+        out = tr.train_from_dataset(ds, batch_size=32, drop_last=False)
+        keys = np.unique(tr._tagged_pass_keys(ds))
+        vals, found = table.export_full(keys)
+        assert found.all()
+        return out, vals
+
+    out1, vals1 = run(slab=1)
+    out4, vals4 = run(slab=4)
+    assert out1["steps"] == out4["steps"] == 11
+    assert out1["samples"] == out4["samples"] == 330
+    np.testing.assert_allclose(out4["loss"], out1["loss"], rtol=1e-6)
+    np.testing.assert_array_equal(vals4, vals1)
