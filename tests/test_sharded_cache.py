@@ -261,6 +261,44 @@ def test_routed_negative_sentinel_rows(rng):
             np.asarray(state["embed_w"])[untouched])
 
 
+def test_routed_hot_key_batches_fit_with_dedup(rng):
+    """Production-shaped adversarial load: a super-hot key in ~35% of
+    the batch (the default-feasign pattern in real CTR data). Without
+    dedup that shard's bucket would need 0.35·m > cap at factor 2/K=8;
+    local pre-dedup (the default) collapses the duplicates so the batch
+    routes overflow-free, and results still match the oracle."""
+    capacity, dim, n = 1 << 10, 4, 512
+    cfg = CacheConfig(capacity=capacity, embedx_dim=dim, embedx_threshold=3.0)
+    state = _fresh_state(capacity, dim, rng)
+    mesh = _mesh()
+    shard = NamedSharding(mesh, P("ps"))
+    ss = {k: jax.device_put(v, shard) for k, v in state.items()}
+    rows = np.asarray(rng.integers(0, capacity, n), np.int32)
+    hot = int(rows[0])
+    rows[rng.random(n) < 0.35] = hot  # one key dominates the batch
+    rows = jnp.asarray(rows)
+    pull_fn, push_fn = _routed_fns(mesh, cfg, pre_dedup=True)
+    vals, ov = pull_fn(ss, rows)
+    assert int(ov) == 0, "hot-key batch overflowed despite pre-dedup"
+    np.testing.assert_array_equal(np.asarray(vals),
+                                  np.asarray(jax.jit(cache_pull)(state, rows)))
+    grads = jnp.asarray(rng.normal(size=(n, 1 + dim)).astype(np.float32))
+    shows = jnp.ones((n,), jnp.float32)
+    clicks = jnp.asarray((rng.random(n) < 0.4).astype(np.float32))
+    new_state, ov = push_fn(ss, rows, grads, shows, clicks)
+    assert int(ov) == 0
+    ref = jax.jit(lambda st, r, g, s, c: cache_push(st, r, g, s, c, cfg))(
+        state, rows, grads, shows, clicks)
+    for k in ref:
+        np.testing.assert_allclose(np.asarray(new_state[k]),
+                                   np.asarray(ref[k]), rtol=3e-5, atol=1e-6,
+                                   err_msg=f"state[{k}]")
+    # the same batch WITHOUT dedup must report the overflow loudly
+    _, push_raw = _routed_fns(mesh, cfg, pre_dedup=False)
+    _, ov_raw = push_raw(ss, rows, grads, shows, clicks)
+    assert int(ov_raw) > 0, "raw routing should overflow on the hot key"
+
+
 def test_routed_work_scales_inverse_with_shards():
     """VERDICT r2 #2 'done' criterion: per-shard touched rows are
     O(batch·cap_factor), independent of the shard count K — vs the

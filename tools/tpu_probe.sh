@@ -20,6 +20,12 @@ print('devices:', d)
 " >>"$LOG" 2>&1; then
     echo "$ts RECOVERED — capturing evidence" >>"$LOG"
     BENCH_INIT_TIMEOUT=300 timeout 1800 python bench.py >BENCH_RECOVERY.json 2>>"$LOG"
+    # slab sweep: how much of the wall time was dispatch (BENCH_DECOMP
+    # term 4) — one line per slab setting
+    for SLAB in 1 16 32; do
+      BENCH_SLAB=$SLAB BENCH_INIT_TIMEOUT=300 timeout 1200 python bench.py \
+        >>BENCH_SLAB_SWEEP.jsonl 2>>"$LOG"
+    done
     timeout 2400 python tools/tpu_smoke.py >TPU_SMOKE.json 2>>"$LOG"
     echo "$ts evidence captured" >>"$LOG"
     touch RECOVERED.flag
