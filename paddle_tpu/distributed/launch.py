@@ -23,7 +23,7 @@ import sys
 import time
 from typing import Dict, List, Optional
 
-__all__ = ["JobSpec", "launch_local", "main"]
+__all__ = ["JobSpec", "launch_local", "elastic_launch_local", "main"]
 
 
 class JobSpec:
@@ -63,6 +63,36 @@ def _proc_env(spec: JobSpec, role: str, rank: int) -> Dict[str, str]:
     return env
 
 
+def _spawn(spec: JobSpec, role: str, rank: int,
+           log_suffix: str = "") -> subprocess.Popen:
+    """One trainer/server subprocess with role env + optional log file
+    (shared by the plain and elastic launchers)."""
+    env = _proc_env(spec, role, rank)
+    stdout = None
+    if spec.log_dir:
+        os.makedirs(spec.log_dir, exist_ok=True)
+        stdout = open(os.path.join(
+            spec.log_dir, f"{role.lower()}_{rank}{log_suffix}.log"), "w")
+    try:
+        return subprocess.Popen(
+            [sys.executable] + spec.script, env=env,
+            stdout=stdout, stderr=subprocess.STDOUT if stdout else None)
+    finally:
+        if stdout is not None:
+            stdout.close()  # the child holds its own duplicate fd
+
+
+def _terminate(procs) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    for p in procs:
+        try:
+            p.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
 def launch_local(spec: JobSpec, timeout: Optional[float] = None) -> int:
     """Spawn servers then trainers on localhost; wait for trainers, then
     terminate servers (the PS controller sequence). Returns the first
@@ -70,26 +100,11 @@ def launch_local(spec: JobSpec, timeout: Optional[float] = None) -> int:
     procs: List[subprocess.Popen] = []
     server_procs: List[subprocess.Popen] = []
 
-    def spawn(role: str, rank: int) -> subprocess.Popen:
-        env = _proc_env(spec, role, rank)
-        stdout = None
-        if spec.log_dir:
-            os.makedirs(spec.log_dir, exist_ok=True)
-            stdout = open(os.path.join(
-                spec.log_dir, f"{role.lower()}_{rank}.log"), "w")
-        try:
-            return subprocess.Popen(
-                [sys.executable] + spec.script, env=env,
-                stdout=stdout, stderr=subprocess.STDOUT if stdout else None)
-        finally:
-            if stdout is not None:
-                stdout.close()  # the child holds its own duplicate fd
-
     try:
         for r in range(spec.servers):
-            server_procs.append(spawn("PSERVER", r))
+            server_procs.append(_spawn(spec, "PSERVER", r))
         for r in range(spec.nproc):
-            procs.append(spawn("TRAINER", r))
+            procs.append(_spawn(spec, "TRAINER", r))
         deadline = time.monotonic() + timeout if timeout else None
         rc = 0
         for p in procs:
@@ -98,14 +113,104 @@ def launch_local(spec: JobSpec, timeout: Optional[float] = None) -> int:
             rc = rc or code
         return rc
     finally:
-        for p in procs + server_procs:
-            if p.poll() is None:
-                p.send_signal(signal.SIGTERM)
-        for p in procs + server_procs:
-            try:
-                p.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        _terminate(procs + server_procs)
+
+
+def elastic_launch_local(
+    spec: JobSpec,
+    min_np: Optional[int] = None,
+    max_np: Optional[int] = None,
+    heartbeat_interval: float = 0.3,
+    heartbeat_ttl: float = 1.0,
+    elastic_timeout: float = 1.5,
+    max_restarts: int = 3,
+    timeout: Optional[float] = None,
+) -> int:
+    """The elastic controller loop (fleet/elastic/manager.py:439-532 +
+    the launcher's restart path): supervise local trainer processes,
+    heartbeat each LIVE process into the elastic store, and act on the
+    ElasticManager's decision — HOLD keeps running, RESTART kills the
+    survivors and relaunches every trainer with the world size and
+    endpoint env REWRITTEN to the shrunken (or grown) membership
+    (manager.py:465's DISTRIBUTED_TRAINER_ENDPOINTS update), ERROR gives
+    up below ``min_np``. Trainer scripts are expected to resume from
+    their checkpoints (io/auto_checkpoint) — restarts re-exec them.
+
+    Returns 0 when a generation of trainers all exit cleanly; nonzero on
+    ERROR / restart budget exhaustion / timeout."""
+    from .elastic import ElasticManager, ElasticStatus, MemoryStore
+
+    min_np = min_np if min_np is not None else spec.nproc
+    max_np = max_np if max_np is not None else spec.nproc
+    store = MemoryStore()
+    deadline = time.monotonic() + timeout if timeout else None
+    np_now = spec.nproc
+    restarts = 0
+
+    server_procs: List[subprocess.Popen] = []
+    trainers: List[subprocess.Popen] = []
+
+    try:
+        for r in range(spec.servers):
+            server_procs.append(_spawn(spec, "PSERVER", r))
+
+        while True:
+            gen_spec = JobSpec(spec.script, nproc=np_now,
+                               servers=spec.servers,
+                               coordinator_port=spec.coordinator_port,
+                               log_dir=spec.log_dir, env=spec.env)
+            trainers = [_spawn(gen_spec, "TRAINER", r, f".g{restarts}")
+                        for r in range(np_now)]
+            mgr = ElasticManager(store, job_id="launch", np=np_now,
+                                 host="supervisor",
+                                 heartbeat_interval=heartbeat_interval,
+                                 heartbeat_ttl=heartbeat_ttl,
+                                 elastic_timeout=elastic_timeout,
+                                 min_np=min_np, max_np=max_np)
+            # the supervisor beats on BEHALF of each live process —
+            # process liveness is the health signal a single-host
+            # controller has (multi-host nodes heartbeat themselves)
+            prefix = mgr._prefix
+            decision = None
+            while True:
+                if deadline and time.monotonic() > deadline:
+                    return 124
+                for r, p in enumerate(trainers):
+                    # a CLEAN exit keeps its membership (that rank's
+                    # partition is done, not dead) — only a crash or a
+                    # hang-kill stops the heartbeat and shrinks the world
+                    if p.poll() is None or p.poll() == 0:
+                        store.put(prefix + f"rank{r}", "1",
+                                  ttl=heartbeat_ttl)
+                if all(p.poll() == 0 for p in trainers):
+                    return 0  # generation completed cleanly
+                status = mgr.watch_once()
+                if status is ElasticStatus.RESTART:
+                    alive = sum(p.poll() is None for p in trainers)
+                    decision = max(min(max(alive, 1), max_np), min_np)
+                    break
+                if status is ElasticStatus.ERROR:
+                    return 1  # unrecoverable below min_np
+                if (all(p.poll() is not None for p in trainers)
+                        and any(p.poll() != 0 for p in trainers)
+                        and status is ElasticStatus.HOLD):
+                    # whole generation gone before the ttl expired —
+                    # skip the grace wait, go straight to restart
+                    decision = max(min_np, 1)
+                    break
+                time.sleep(heartbeat_interval)
+
+            _terminate(trainers)  # kill survivors; relaunch the world
+            for r in range(np_now):
+                store.delete(prefix + f"rank{r}")
+            restarts += 1
+            if restarts > max_restarts:
+                return 1
+            np_now = decision
+    finally:
+        # every exit path (completion, ERROR, timeout, restart budget)
+        # reaps the CURRENT generation too — no orphaned trainers
+        _terminate(trainers + server_procs)
 
 
 def main(argv: Optional[List[str]] = None) -> int:

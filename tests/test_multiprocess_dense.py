@@ -16,7 +16,10 @@ _WORKER = textwrap.dedent("""
     from jax import lax, shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("dp", "mp"))
+    # transpose so each mp PAIR spans the two processes (global
+    # device order is process-major): the Megatron psum really
+    # crosses the process boundary
+    mesh = Mesh(np.array(jax.devices()).reshape(2, 4).T, ("dp", "mp"))
     rngh = np.random.default_rng(0)
     D, H, O, B = 8, 16, 4, 16
     W1 = rngh.normal(0, 0.5, (D, H)).astype(np.float32)
