@@ -165,6 +165,12 @@ class ElasticManager:
 
     # -- membership --------------------------------------------------------
 
+    def member_key(self, name: str) -> str:
+        """Store key for one member's heartbeat (public so supervisors
+        that beat on BEHALF of processes — the single-host launcher —
+        don't reach into the key layout)."""
+        return self._prefix + name
+
     def alive_hosts(self) -> List[str]:
         return sorted(k[len(self._prefix):]
                       for k in self.store.list_prefix(self._prefix))

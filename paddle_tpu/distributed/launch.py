@@ -170,7 +170,6 @@ def elastic_launch_local(
             # the supervisor beats on BEHALF of each live process —
             # process liveness is the health signal a single-host
             # controller has (multi-host nodes heartbeat themselves)
-            prefix = mgr._prefix
             decision = None
             while True:
                 if deadline and time.monotonic() > deadline:
@@ -180,14 +179,17 @@ def elastic_launch_local(
                     # partition is done, not dead) — only a crash or a
                     # hang-kill stops the heartbeat and shrinks the world
                     if p.poll() is None or p.poll() == 0:
-                        store.put(prefix + f"rank{r}", "1",
+                        store.put(mgr.member_key(f"rank{r}"), "1",
                                   ttl=heartbeat_ttl)
                 if all(p.poll() == 0 for p in trainers):
                     return 0  # generation completed cleanly
                 status = mgr.watch_once()
                 if status is ElasticStatus.RESTART:
-                    alive = sum(p.poll() is None for p in trainers)
-                    decision = max(min(max(alive, 1), max_np), min_np)
+                    # adopt_world counts store membership (live OR
+                    # cleanly-finished ranks — same predicate as the
+                    # heartbeats), clamps to [min_np, max_np] and
+                    # publishes the endpoint rewrite (manager.py:465)
+                    decision = max(mgr.adopt_world(), 1)
                     break
                 if status is ElasticStatus.ERROR:
                     return 1  # unrecoverable below min_np
@@ -202,7 +204,7 @@ def elastic_launch_local(
 
             _terminate(trainers)  # kill survivors; relaunch the world
             for r in range(np_now):
-                store.delete(prefix + f"rank{r}")
+                store.delete(mgr.member_key(f"rank{r}"))
             restarts += 1
             if restarts > max_restarts:
                 return 1

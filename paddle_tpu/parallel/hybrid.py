@@ -44,6 +44,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -119,11 +120,30 @@ class HybridParallelTrainer:
         aux = {"embed": nn.get_state(self._embed_tmpl),
                "head": nn.get_state(self._head_tmpl)}
         self.params = {"stages": stacked, "aux": aux}
-        self.opt_state = optimizer.init(self.params)
 
         stage_specs = _spec_tree(stacked, cfg, leading_pp=True)
         aux_specs = {k: _spec_tree(v, cfg, leading_pp=False) for k, v in aux.items()}
         self._param_specs = {"stages": stage_specs, "aux": aux_specs}
+
+        # multi-HOST: the mesh spans processes, so params/batches must be
+        # GLOBAL jax.Arrays (each host holds identical full values — the
+        # same seed built them — and contributes its local shards)
+        self._multihost = jax.process_count() > 1
+        if self._multihost:
+            from jax.sharding import NamedSharding
+
+            self.params = jax.tree_util.tree_map(
+                self._globalize, self.params, self._param_specs)
+            # ONE cached compiled identity re-lays-out pytrees replicated
+            # for checkpointing (jit caches per tree structure: params
+            # and opt state each compile once across all saves)
+            self._gather = jax.jit(
+                lambda t: t, out_shardings=NamedSharding(mesh, P()))
+            # init under jit: eager zeros_like on non-addressable global
+            # arrays is not computable host-side
+            self.opt_state = jax.jit(optimizer.init)(self.params)
+        else:
+            self.opt_state = optimizer.init(self.params)
 
         def stage_apply(state, x):
             out, _ = nn.functional_call(self._stage_tmpl, state, x, training=True)
@@ -168,6 +188,7 @@ class HybridParallelTrainer:
         # ids/labels: [num_micro, B_local, L_local] → batch over dp(×sh),
         # seq over cp
         data_spec = P(None, batch_axes, "cp")
+        self._data_spec = data_spec
         grad_fn = shard_map(
             spmd_step,
             mesh=mesh,
@@ -202,6 +223,16 @@ class HybridParallelTrainer:
         self._rng = jax.random.key(seed)
         self.global_step = 0
 
+    def _globalize(self, x, spec):
+        """Host value (identical on every process) → global jax.Array
+        sharded per ``spec`` over the trainer's mesh."""
+        from jax.sharding import NamedSharding
+
+        arr = np.asarray(x)
+        sh = NamedSharding(self.mesh, spec if isinstance(spec, P) else P())
+        return jax.make_array_from_callback(arr.shape, sh,
+                                            lambda idx: arr[idx])
+
     def _opt_spec_tree(self):
         """PartitionSpecs for the optimizer state: slot subtrees that
         mirror the params tree get each param's spec with "sh" inserted
@@ -222,10 +253,17 @@ class HybridParallelTrainer:
         """Persist params + optimizer state + rng + step (the shared
         trainer-snapshot schema; layout-independent — params live at
         GLOBAL shapes, so a checkpoint written on one mesh restores
-        onto any other)."""
+        onto any other). Multi-host: sharded leaves are re-laid-out
+        replicated (one compiled identity) so every process can read the
+        full values; process 0 writes."""
         from ..io.checkpoint import save_train_state
 
-        save_train_state(path, self.params, opt_state=self.opt_state,
+        params, opt = self.params, self.opt_state
+        if self._multihost:
+            params, opt = self._gather(params), self._gather(opt)
+            if jax.process_index() != 0:
+                return
+        save_train_state(path, params, opt_state=opt,
                          rng=self._rng, step=self.global_step)
 
     def load(self, path: str) -> None:
@@ -252,9 +290,23 @@ class HybridParallelTrainer:
         B = ids.shape[0]
         enforce_eq(B % self.num_micro, 0, "batch must divide num_micro")
         m = self.num_micro
-        ids_m = ids.reshape(m, B // m, *ids.shape[1:])
-        labels_m = labels.reshape(m, B // m, *labels.shape[1:])
         self._rng, sub = jax.random.split(self._rng)
+        if self._multihost:
+            # every process feeds the SAME host batch; shard it into one
+            # global array per the data spec (the mesh spans processes)
+            ids_m = self._globalize(
+                np.asarray(ids).reshape(m, B // m, *ids.shape[1:]),
+                self._data_spec)
+            labels_m = self._globalize(
+                np.asarray(labels).reshape(m, B // m, *labels.shape[1:]),
+                self._data_spec)
+            sub = jax.random.wrap_key_data(
+                self._globalize(jax.random.key_data(sub), P()))
+        else:
+            # single-host: reshape stays wherever the caller's arrays
+            # live (no forced device→host copy on the hot path)
+            ids_m = ids.reshape(m, B // m, *ids.shape[1:])
+            labels_m = labels.reshape(m, B // m, *labels.shape[1:])
         self.params, self.opt_state, loss = self._step(
             self.params, self.opt_state, ids_m, labels_m, sub)
         self.global_step += 1

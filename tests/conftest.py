@@ -54,6 +54,8 @@ _SLOW = {
     "test_auto_checkpoint_resumes_day_stream",
     "test_train_passes_overlapped_matches_sequential",
     "test_launch_propagates_failure",
+    "test_elastic_launch_restarts_and_completes",
+    "test_elastic_launch_gives_up_below_min_np",
 }
 
 
