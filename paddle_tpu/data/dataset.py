@@ -256,6 +256,7 @@ class InMemoryDataset:
         self._store: Optional[_RecordStore] = None
         self._rng = np.random.default_rng(seed)
         self.parse_errors = 0
+        self._pipe_command: Optional[str] = None
 
     # -- config -----------------------------------------------------------
 
@@ -265,6 +266,14 @@ class InMemoryDataset:
             hit = sorted(_glob.glob(p))
             files.extend(hit if hit else [p])
         self._files = files
+
+    def set_pipe_command(self, cmd: Optional[str]) -> None:
+        """Preprocess each input file through a shell command before slot
+        parsing — the reference DataFeed's ``pipe_command`` (PaddleRec
+        jobs run their feature extractors this way: the raw log streams
+        through the command's stdin and MultiSlot lines come out).
+        ``None`` restores direct reads (the native threaded feed)."""
+        self._pipe_command = cmd
 
     # -- load -------------------------------------------------------------
 
@@ -282,6 +291,31 @@ class InMemoryDataset:
         for f in self._files:  # fail fast on bad paths (the native feed
             if not os.path.exists(f):  # would just count an error)
                 raise FileNotFoundError(f"dataset file not found: {f}")
+        if self._pipe_command:
+            # pipe path: one preprocessor subprocess per file, overlapped
+            # by a thread pool (the reference forks pipe_command per
+            # reader thread the same way); output parses like file text
+            import subprocess
+            from concurrent.futures import ThreadPoolExecutor
+
+            def run_pipe(path):
+                with open(path, "rb") as fh:
+                    out = subprocess.run(
+                        self._pipe_command, shell=True, stdin=fh,
+                        capture_output=True)
+                if out.returncode != 0:
+                    raise RuntimeError(
+                        f"pipe_command failed on {path} "
+                        f"(rc {out.returncode}): "
+                        f"{out.stderr.decode(errors='replace')[:500]}")
+                return out.stdout.decode()
+
+            with ThreadPoolExecutor(max_workers=num_threads) as pool:
+                for text in pool.map(run_pipe, self._files):
+                    store.append(self._parse_text(text))
+            store.finalize()
+            self._store = store
+            return store.num_records
         try:
             from ..ps.native import NativeDataFeed
 

@@ -233,3 +233,39 @@ def test_ps_op_cost_profiling():
     assert st["pserver_sparse_select_all"]["count"] == 1
     assert st["pserver_sparse_update_all"]["count"] == 1
     assert st["pserver_sparse_update_all"]["avg_s"] > 0
+
+
+def test_timeline_merges_worker_traces(tmp_path):
+    """tools/timeline.py: per-worker chrome traces merge into one file
+    with a named pid lane per worker (the reference timeline tool)."""
+    import json
+    import sys
+
+    sys.path.insert(0, str(
+        __import__("pathlib").Path(__file__).resolve().parents[1] / "tools"))
+    from timeline import merge_traces
+
+    from paddle_tpu.core.profiler import (RecordEvent, export_chrome_tracing,
+                                          start_timeline, stop_timeline)
+
+    files = []
+    for w in range(2):
+        start_timeline()
+        with RecordEvent(f"work_{w}"):
+            pass
+        stop_timeline()
+        p = tmp_path / f"worker{w}.json"
+        export_chrome_tracing(str(p))
+        files.append(str(p))
+
+    out = tmp_path / "merged.json"
+    n = merge_traces(files, str(out))
+    blob = json.loads(out.read_text())
+    evs = blob["traceEvents"]
+    assert n == len(evs)
+    lanes = {e["args"]["name"] for e in evs if e.get("ph") == "M"}
+    assert lanes == {"worker0", "worker1"}
+    pids = {e["pid"] for e in evs if e.get("ph") == "X"}
+    assert pids == {0, 1}
+    names = {e["name"] for e in evs if e.get("ph") == "X"}
+    assert {"work_0", "work_1"} <= names

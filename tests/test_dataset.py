@@ -259,3 +259,40 @@ def test_global_shuffle_empty_partitions():
     # and an explicit keep-nothing works
     st.keep_only(np.zeros(0, np.int64))
     assert st.num_records == 0
+
+
+def test_pipe_command_preprocessing(tmp_path):
+    """DataFeed pipe_command parity: raw logs stream through a shell
+    preprocessor; the dataset parses the command's output. A failing
+    command surfaces loudly with its stderr."""
+    from paddle_tpu.data.dataset import InMemoryDataset, SlotDesc
+
+    slots = [SlotDesc("a", is_float=False, max_len=1),
+             SlotDesc("label", is_float=True, max_len=1)]
+    raw = tmp_path / "raw.txt"
+    # raw format: "id label" — the pipe turns it into MultiSlot lines
+    raw.write_text("7 1\n9 0\n")
+    ds = InMemoryDataset(slots)
+    ds.set_filelist([str(raw)])
+    ds.set_pipe_command("awk '{print \"1 \" $1 \" 1 \" $2}'")
+    n = ds.load_into_memory()
+    assert n == 2
+    batch = next(ds.batch_iter(2, drop_last=False))
+    np.testing.assert_array_equal(batch["a"][0][:, 0], [7, 9])
+    np.testing.assert_array_equal(batch["label"][0][:, 0], [1.0, 0.0])
+
+    ds2 = InMemoryDataset(slots)
+    ds2.set_filelist([str(raw)])
+    ds2.set_pipe_command("exit 3")
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="pipe_command failed"):
+        ds2.load_into_memory()
+
+    # None restores the direct read path
+    ok = tmp_path / "ok.txt"
+    ok.write_text("1 7 1 1\n")
+    ds3 = InMemoryDataset(slots)
+    ds3.set_filelist([str(ok)])
+    ds3.set_pipe_command(None)
+    assert ds3.load_into_memory() == 1

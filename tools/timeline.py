@@ -1,0 +1,52 @@
+"""Merge per-worker profiler traces into one chrome://tracing view.
+
+Reference counterpart: ``tools/timeline.py`` — it collects each
+worker's profiler dump and emits a single chrome-trace JSON with one
+process lane per worker. Here workers write chrome-trace JSON directly
+(``paddle_tpu.core.profiler.export_chrome_tracing``); this tool merges
+them, assigning each input file its own pid lane (named after the file)
+so a multi-worker job reads as one timeline in chrome://tracing or the
+perfetto UI.
+
+Usage:
+    python tools/timeline.py worker0.json worker1.json -o merged.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def merge_traces(paths, output):
+    events = []
+    for pid, path in enumerate(paths):
+        with open(path) as f:
+            blob = json.load(f)
+        name = os.path.splitext(os.path.basename(path))[0]
+        # one metadata record names the lane (chrome trace convention)
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": name}})
+        for ev in blob.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            events.append(ev)
+    with open(output, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return len(events)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="tools/timeline.py",
+        description="Merge per-worker chrome-trace JSONs into one timeline")
+    ap.add_argument("inputs", nargs="+", help="per-worker trace files")
+    ap.add_argument("-o", "--output", default="timeline.json")
+    args = ap.parse_args(argv)
+    n = merge_traces(args.inputs, args.output)
+    print(f"wrote {args.output}: {n} events from {len(args.inputs)} workers")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
