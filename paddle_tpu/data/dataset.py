@@ -308,11 +308,20 @@ class InMemoryDataset:
                         f"pipe_command failed on {path} "
                         f"(rc {out.returncode}): "
                         f"{out.stderr.decode(errors='replace')[:500]}")
-                return out.stdout.decode()
+                # lenient decode: a stray non-UTF-8 byte in a raw log
+                # becomes a parse error (the native feed's tolerance),
+                # not a crash without file context
+                return out.stdout.decode(errors="replace")
 
             with ThreadPoolExecutor(max_workers=num_threads) as pool:
-                for text in pool.map(run_pipe, self._files):
-                    store.append(self._parse_text(text))
+                # submit in waves so finished whole-file outputs don't
+                # pile up unboundedly ahead of the serial parser (the
+                # native feed's channel provides this backpressure)
+                files = list(self._files)
+                for lo in range(0, len(files), num_threads):
+                    for text in pool.map(run_pipe,
+                                         files[lo:lo + num_threads]):
+                        store.append(self._parse_text(text))
             store.finalize()
             self._store = store
             return store.num_records

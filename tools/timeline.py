@@ -23,11 +23,13 @@ def merge_traces(paths, output):
     for pid, path in enumerate(paths):
         with open(path) as f:
             blob = json.load(f)
+        # both legal chrome-trace forms: {"traceEvents": [...]} or [...]
+        evs = blob if isinstance(blob, list) else blob.get("traceEvents", [])
         name = os.path.splitext(os.path.basename(path))[0]
         # one metadata record names the lane (chrome trace convention)
         events.append({"name": "process_name", "ph": "M", "pid": pid,
                        "args": {"name": name}})
-        for ev in blob.get("traceEvents", []):
+        for ev in evs:
             ev = dict(ev)
             ev["pid"] = pid
             events.append(ev)
