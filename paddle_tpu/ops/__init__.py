@@ -1,3 +1,4 @@
 from . import collectives
+from .device_graph import DeviceGraph
 
-__all__ = ["collectives"]
+__all__ = ["collectives", "DeviceGraph"]

@@ -1,6 +1,7 @@
 """Parameter-server stack: accessors, sparse SGD rules, host tables,
 HBM embedding cache (SURVEY §2.2/2.3, Appendix A)."""
 
+from .config import PsJobConfig, load_ps_config
 from .graph_table import GraphTable
 from .accessor import AccessorConfig, CtrCommonAccessor, SparseAccessor, make_accessor
 from .embedding_cache import CacheConfig, HbmEmbeddingCache, cache_pull, cache_push
@@ -18,6 +19,8 @@ from .table import (
 )
 
 __all__ = [
+    "PsJobConfig",
+    "load_ps_config",
     "GraphTable",
     "AccessorConfig",
     "CtrCommonAccessor",
