@@ -61,6 +61,7 @@ from ..core.enforce import enforce, enforce_eq
 from .embedding_cache import CacheConfig, cache_pull, cache_push
 
 __all__ = [
+    "routed_dedup",
     "sharded_cache_pull",
     "sharded_cache_push",
     "routed_cache_pull",
@@ -144,6 +145,28 @@ def _route_to_buckets(owner, K: int, cap: int, payloads, fills,
     return buckets, src, overflow
 
 
+def _canonical_rows(rows: jax.Array, sentinel: int) -> jax.Array:
+    """int32 rows with negative miss markers mapped to the canonical
+    out-of-range sentinel (keeps sorted-unique output owner-ordered)."""
+    rows = rows.astype(jnp.int32)
+    return jnp.where(rows < 0, sentinel, rows)
+
+
+def routed_dedup(rows: jax.Array, sentinel: int
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """The local merge (CopyKeys/merge_grad dedup half) shared by
+    routed pull and push: sorted-unique rows (padded with ``sentinel``)
+    + inverse positions. Compute ONCE per step when pull and push see
+    the same batch rows — the sort is the routing's main local cost.
+    Canonicalizes internally (idempotent): negative miss markers become
+    the sentinel so the sorted-unique output stays owner-ordered."""
+    rows = _canonical_rows(rows, sentinel)
+    m = rows.shape[0]
+    uniq, inv = jnp.unique(rows, size=m, fill_value=sentinel,
+                           return_inverse=True)
+    return uniq, inv.reshape(-1)
+
+
 def _owner_of(rows, shard_rows: int, K: int):
     """Owner shard of each global row id; K for sentinel/out-of-range."""
     valid = (rows >= 0) & (rows < shard_rows * K)
@@ -156,25 +179,26 @@ def routed_cache_pull(
     axis: Axis,
     cap_factor: float = 2.0,
     pre_dedup: bool = True,
+    dedup: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Inside shard_map: key-routed pull — this device's [m] global rows
     → ([m, 1+dim] values, overflow count). The HeterComm pull_sparse
     chain (heter_comm_inl.h:479): local merge (dedup), split to shard,
     all_to_all request, owner gathers O(m/K) rows, all_to_all reply,
-    scatter back to batch order. Sentinel rows (no owner) pull zeros."""
+    scatter back to batch order. Sentinel rows (no owner) pull zeros.
+    ``dedup``: a precomputed ``(uniq, inv)`` pair (from
+    :func:`routed_dedup`) so a step doing pull AND push on the same rows
+    sorts once, not twice."""
     K = int(_axis_size(axis))
     shard_rows = state["embed_w"].shape[0]
     m = rows.shape[0]
     my_start = lax.axis_index(axis) * shard_rows
-    # negative sentinels → the canonical out-of-range sentinel, so the
-    # sorted-unique output stays owner-ordered (presorted routing below)
-    rows = rows.astype(jnp.int32)
-    rows = jnp.where(rows < 0, shard_rows * K, rows)
+    rows = _canonical_rows(rows, shard_rows * K)
+    enforce(dedup is None or pre_dedup,
+            "dedup= requires pre_dedup=True (raw routing ignores it)")
     if pre_dedup:
-        # request each distinct row once (CopyKeys dedup half)
-        lookup, inv = jnp.unique(rows, size=m, fill_value=shard_rows * K,
-                                 return_inverse=True)
-        inv = inv.reshape(-1)
+        lookup, inv = dedup if dedup is not None else routed_dedup(
+            rows, shard_rows * K)
     else:
         lookup = rows
     cap = route_bucket_capacity(m, K, cap_factor)
@@ -202,26 +226,28 @@ def routed_cache_push(
     axis: Axis,
     cap_factor: float = 2.0,
     pre_dedup: bool = True,
+    dedup: Optional[Tuple[jax.Array, jax.Array]] = None,
 ) -> Tuple[Dict[str, jax.Array], jax.Array]:
     """Inside shard_map: key-routed push (heter_comm_inl.h:575): local
     merge_grad (segment-sum duplicates), split to shard, ONE all_to_all
     pair ships each owner only its rows+grads, owner runs the batch
     -scaled `cache_push` over O(m·cap_factor) rows — per-chip update work
-    independent of the shard count. Returns (new_state, overflow)."""
+    independent of the shard count. Returns (new_state, overflow).
+    ``dedup``: precomputed ``(uniq, inv)`` (see :func:`routed_dedup`)."""
     K = int(_axis_size(axis))
     shard_rows = state["embed_w"].shape[0]
     C_total = shard_rows * K
     m = rows.shape[0]
     my_start = lax.axis_index(axis) * shard_rows
-    rows = rows.astype(jnp.int32)
-    rows = jnp.where(rows < 0, C_total, rows)  # keep sorted-unique owner-ordered
+    rows = _canonical_rows(rows, C_total)
+    enforce(dedup is None or pre_dedup,
+            "dedup= requires pre_dedup=True (raw routing ignores it)")
     payload = jnp.concatenate(
         [grads, shows[:, None], clicks[:, None]], axis=1)
     if pre_dedup:
         # merge_grad: per-device partial sums, one wire entry per row
-        uniq, inv = jnp.unique(rows, size=m, fill_value=C_total,
-                               return_inverse=True)
-        inv = inv.reshape(-1)
+        uniq, inv = dedup if dedup is not None else routed_dedup(
+            rows, C_total)
         payload = jax.ops.segment_sum(payload, inv, num_segments=m)
         rows = uniq
     cap = route_bucket_capacity(m, K, cap_factor)
@@ -352,9 +378,15 @@ def _sharded_step_body(model, optimizer, cache_cfg, axis, K, params,
     fwd/bwd, grad pmean (Reducer role), sharded push. ``flat_rows`` are
     GLOBAL spread row ids for this rank's batch slice; sentinel rows
     (≥ global capacity) pull zeros and drop their pushes."""
+    dedup = None
     if routing == "alltoall":
+        if pre_dedup:
+            # pull and push see the SAME batch rows — sort once, use twice
+            C_total = cache_state["embed_w"].shape[0] * K
+            flat_rows = _canonical_rows(flat_rows, C_total)
+            dedup = routed_dedup(flat_rows, C_total)
         emb, ov_pull = routed_cache_pull(cache_state, flat_rows, axis,
-                                         cap_factor, pre_dedup)
+                                         cap_factor, pre_dedup, dedup=dedup)
     else:
         emb = sharded_cache_pull(cache_state, flat_rows, axis)
         ov_pull = jnp.int32(0)
@@ -381,7 +413,7 @@ def _sharded_step_body(model, optimizer, cache_cfg, axis, K, params,
     if routing == "alltoall":
         new_cache, ov_push = routed_cache_push(
             cache_state, flat_rows, emb_grad.reshape(B * S, -1), shows,
-            clicks, cache_cfg, axis, cap_factor, pre_dedup)
+            clicks, cache_cfg, axis, cap_factor, pre_dedup, dedup=dedup)
     else:
         new_cache = sharded_cache_push(cache_state, flat_rows,
                                        emb_grad.reshape(B * S, -1), shows,

@@ -447,3 +447,43 @@ def test_sharded_key_fed_matches_row_fed(rng, routing):
         np.testing.assert_array_equal(np.asarray(c1.state[k]),
                                       np.asarray(c2.state[k]),
                                       err_msg=f"state[{k}]")
+
+
+def test_shared_dedup_matches_per_call(rng):
+    """The step's shared routed_dedup (sort once, use in pull AND push)
+    is bit-identical to each call doing its own dedup — including with
+    negative miss markers, which routed_dedup canonicalizes itself."""
+    from paddle_tpu.ps.sharded_cache import routed_dedup
+
+    capacity, dim, n = 1 << 9, 4, 128
+    cfg = CacheConfig(capacity=capacity, embedx_dim=dim)
+    state = _fresh_state(capacity, dim, rng)
+    mesh = _mesh()
+    shard = NamedSharding(mesh, P("ps"))
+    ss = {k: jax.device_put(v, shard) for k, v in state.items()}
+    rows = np.asarray(rng.integers(0, capacity, n), np.int32)
+    rows[:: 5] = -1  # miss markers: dedup must canonicalize them
+    rows = jnp.asarray(rows)
+    grads = jnp.asarray(rng.normal(size=(n, 1 + dim)).astype(np.float32))
+    shows = jnp.ones((n,), jnp.float32)
+    clicks = jnp.asarray((rng.random(n) < 0.4).astype(np.float32))
+
+    def run(shared):
+        def body(st, r, g, s, c):
+            d = routed_dedup(r, capacity) if shared else None
+            vals, ov1 = routed_cache_pull(st, r, "ps", dedup=d)
+            new, ov2 = routed_cache_push(st, r, g, s, c, cfg, "ps", dedup=d)
+            return new, vals, ov1 + ov2
+
+        fn = jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(P("ps"),) + (P("ps"),) * 4,
+            out_specs=(P("ps"), P("ps"), P()), check_vma=False))
+        return fn(ss, rows, grads, shows, clicks)
+
+    st1, v1, ov1 = run(shared=True)
+    st2, v2, ov2 = run(shared=False)
+    assert int(ov1) == int(ov2) == 0
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    for k in st1:
+        np.testing.assert_array_equal(np.asarray(st1[k]),
+                                      np.asarray(st2[k]), err_msg=k)

@@ -41,6 +41,7 @@ def main() -> None:
     from paddle_tpu.ps.embedding_cache import CacheConfig
     from paddle_tpu.ps.sharded_cache import (routed_cache_pull,
                                              routed_cache_push,
+                                             routed_dedup,
                                              sharded_cache_pull,
                                              sharded_cache_push)
 
@@ -81,8 +82,11 @@ def main() -> None:
 
             if routing == "alltoall":
                 def body(st, r, g, s, c):
-                    vals, _ = routed_cache_pull(st, r, "ps")
-                    new, ov = routed_cache_push(st, r, g, s, c, cfg, "ps")
+                    # shared local merge, as the production step does
+                    d = routed_dedup(r, capacity)
+                    vals, _ = routed_cache_pull(st, r, "ps", dedup=d)
+                    new, ov = routed_cache_push(st, r, g, s, c, cfg, "ps",
+                                                dedup=d)
                     return new, jnp.sum(vals), ov
             else:
                 def body(st, r, g, s, c):
