@@ -32,7 +32,8 @@ from ..nn.layer import Layer
 from ..ps.device_hash import device_hash_lookup
 from ..ps.embedding_cache import CacheConfig, cache_pull, cache_push
 
-__all__ = ["CtrConfig", "DeepFM", "WideDeep", "make_ctr_train_step",
+__all__ = ["CtrConfig", "DeepFM", "WideDeep", "DCN", "XDeepFM",
+           "make_ctr_train_step",
            "make_ctr_train_step_from_keys", "make_ctr_pooled_train_step",
            "make_ctr_train_step_packed", "make_ctr_train_step_slab",
            "pack_ctr_batch", "make_random_packs"]
@@ -112,6 +113,79 @@ class WideDeep(Layer):
             [v.reshape(v.shape[0], cfg.num_sparse_slots * cfg.embedx_dim),
              dense_x], axis=-1)
         return wide + self.dnn(deep_in)
+
+
+class DCN(Layer):
+    """Deep & Cross Network (PaddleRec models/rank/dcn semantics): an
+    explicit feature-cross tower ``x_{l+1} = x0 * (w_l · x_l) + b_l +
+    x_l`` alongside the DNN, combined linearly. Same (emb, dense)
+    interface as DeepFM — the embedding table lives in the PS cache."""
+
+    def __init__(self, cfg: CtrConfig, num_cross: int = 3) -> None:
+        super().__init__()
+        self.cfg = cfg
+        d = cfg.num_sparse_slots * cfg.embedx_dim + cfg.num_dense
+        self.num_cross = num_cross
+        self.cross = nn.LayerList(
+            [nn.Linear(d, 1) for _ in range(num_cross)])
+        self.dnn = _DNN(d, cfg.dnn_hidden)
+        self.combine = nn.Linear(d + 1, 1)
+
+    def forward(self, emb: jax.Array, dense_x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        v = emb[..., 1:]
+        x0 = jnp.concatenate(
+            [v.reshape(v.shape[0], cfg.num_sparse_slots * cfg.embedx_dim),
+             dense_x], axis=-1)
+        x = x0
+        for lin in self.cross:
+            # x0 * (w·x) + b + x  (bias lives in the Linear)
+            x = x0 * lin(x) + x
+        deep = self.dnn(x0)
+        out = self.combine(jnp.concatenate([x, deep[:, None]], axis=-1))
+        return out[..., 0] + jnp.sum(emb[..., 0], axis=-1)
+
+
+class XDeepFM(Layer):
+    """xDeepFM (PaddleRec models/rank/xdeepfm): Compressed Interaction
+    Network over the slot embeddings (vector-wise explicit crosses of
+    bounded order) + DNN + first-order terms."""
+
+    def __init__(self, cfg: CtrConfig,
+                 cin_layers: Tuple[int, ...] = (16, 16)) -> None:
+        super().__init__()
+        self.cfg = cfg
+        self.cin_sizes = tuple(cin_layers)
+        S = cfg.num_sparse_slots
+        prev = S
+        self.cin = nn.LayerList([])
+        for h in self.cin_sizes:
+            # one 1x1 conv per CIN layer ≡ Linear over the S*prev
+            # pairwise-product channels, applied per embedding dim
+            self.cin.append(nn.Linear(S * prev, h, bias_attr=False))
+            prev = h
+        self.cin_out = nn.Linear(sum(self.cin_sizes), 1)
+        self.dnn = _DNN(S * cfg.embedx_dim + cfg.num_dense, cfg.dnn_hidden)
+        self.dense_lin = nn.Linear(cfg.num_dense, 1)
+
+    def forward(self, emb: jax.Array, dense_x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        S, D = cfg.num_sparse_slots, cfg.embedx_dim
+        v = emb[..., 1:]                       # [B, S, D]
+        x0 = v
+        xk = v
+        pooled = []
+        for lin in self.cin:
+            # pairwise products [B, S, Hk, D] → linear over (S·Hk) per dim
+            z = (x0[:, :, None, :] * xk[:, None, :, :]).reshape(
+                v.shape[0], -1, D)             # [B, S*Hk, D]
+            xk = lin(z.transpose(0, 2, 1)).transpose(0, 2, 1)  # [B, H, D]
+            pooled.append(jnp.sum(xk, axis=-1))  # sum-pool over dim
+        cin = self.cin_out(jnp.concatenate(pooled, axis=-1))[..., 0]
+        deep_in = jnp.concatenate(
+            [v.reshape(v.shape[0], S * D), dense_x], axis=-1)
+        return (cin + self.dnn(deep_in) + self.dense_lin(dense_x)[..., 0]
+                + jnp.sum(emb[..., 0], axis=-1))
 
 
 def make_ctr_train_step(

@@ -9,7 +9,8 @@ import pytest
 import paddle_tpu as pt
 from paddle_tpu import optimizer
 from paddle_tpu.metrics.auc import AUC
-from paddle_tpu.models.ctr import CtrConfig, DeepFM, WideDeep, make_ctr_train_step
+from paddle_tpu.models.ctr import (CtrConfig, DCN, DeepFM, WideDeep,
+                                   XDeepFM, make_ctr_train_step)
 from paddle_tpu.ps.embedding_cache import CacheConfig, HbmEmbeddingCache
 from paddle_tpu.ps.accessor import AccessorConfig
 from paddle_tpu.ps.table import MemorySparseTable, TableConfig
@@ -30,7 +31,7 @@ def _synth(rng, n, cfg, vocab=64):
     return keys, dense, labels
 
 
-@pytest.mark.parametrize("model_cls", [DeepFM, WideDeep])
+@pytest.mark.parametrize("model_cls", [DeepFM, WideDeep, DCN, XDeepFM])
 def test_ctr_learns_and_flushes(model_cls):
     pt.seed(0)
     rng = np.random.default_rng(0)
