@@ -260,18 +260,24 @@ def _masked_pull(cache_state, flat_rows):
 
 def _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
                    cache_state, flat_rows, B, S, dense_x, labels,
-                   weights=None):
+                   weights=None, loss_builder=None):
     # hosts may ship dense/labels in narrow wire dtypes (f16 / int8 —
-    # the H2D link is the CTR bottleneck, MEASURED.md); compute is f32
+    # the H2D link is the CTR bottleneck, MEASURED.md); compute is f32.
+    # ``loss_builder`` (default: single-task weighted BCE) lets model
+    # families with their own objective (multitask) reuse this body —
+    # masked pull, tail weights, push stats — without copying it.
     dense_x = dense_x.astype(jnp.float32)
     labels = labels.astype(jnp.int32)
     emb = _masked_pull(cache_state, flat_rows).reshape(B, S, -1)
+    builder = loss_builder or _make_loss_fn
     (loss, _), (grads, emb_grad) = jax.value_and_grad(
-        _make_loss_fn(model, dense_x, labels, weights),
+        builder(model, dense_x, labels, weights),
         argnums=(0, 1), has_aux=True)(params, emb)
 
     new_params, new_opt = optimizer.update(grads, opt_state, params)
-    shows, clicks = _push_stats(labels, weights, S)
+    # the click task is column 0 when labels carry multiple tasks
+    click_labels = labels if labels.ndim == 1 else labels[:, 0]
+    shows, clicks = _push_stats(click_labels, weights, S)
     new_cache = cache_push(cache_state, flat_rows,
                            emb_grad.reshape(B * S, -1), shows, clicks,
                            cache_cfg)

@@ -1,0 +1,164 @@
+"""Multi-task CTR models: ESMM and MMoE over the sparse PS path.
+
+PaddleRec's multitask family (models/multitask/{esmm,mmoe}) — the
+production pattern behind conversion modeling: shared slot embeddings
+(pulled from the PS cache like every CTR model here), per-task towers.
+
+- **ESMM** (Entire Space Multi-task Model): p(click) and p(conversion |
+  click) towers over shared embeddings; the conversion target trains
+  through p(ctcvr) = p(ctr) · p(cvr) on the ENTIRE space (labels are
+  (click, conversion-AND-click)), which sidesteps the sample-selection
+  bias of training CVR on clicked impressions only.
+- **MMoE** (Multi-gate Mixture-of-Experts): shared expert MLPs, one
+  softmax gate per task mixing expert outputs, then per-task towers.
+
+Both keep the family's ``forward(emb, dense_x)`` interface (``emb`` =
+pulled [B, S, 1+dim] block) and return one logit per task —
+``make_multitask_train_step`` builds the fused pull→fwd/bwd→update→push
+program over the HBM cache for any such model.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn.layer import Layer
+from ..ps.embedding_cache import CacheConfig
+from .ctr import CtrConfig, _DNN, _ctr_step_body
+
+__all__ = ["ESMM", "MMoE", "make_multitask_train_step"]
+
+
+class ESMM(Layer):
+    def __init__(self, cfg: CtrConfig) -> None:
+        super().__init__()
+        self.cfg = cfg
+        d = cfg.num_sparse_slots * cfg.embedx_dim + cfg.num_dense
+        self.ctr_tower = _DNN(d, cfg.dnn_hidden)
+        self.cvr_tower = _DNN(d, cfg.dnn_hidden)
+
+    def forward(self, emb: jax.Array, dense_x: jax.Array
+                ) -> Tuple[jax.Array, jax.Array]:
+        cfg = self.cfg
+        v = emb[..., 1:]
+        x = jnp.concatenate(
+            [v.reshape(v.shape[0], cfg.num_sparse_slots * cfg.embedx_dim),
+             dense_x], axis=-1)
+        first = jnp.sum(emb[..., 0], axis=-1)
+        return self.ctr_tower(x) + first, self.cvr_tower(x)
+
+    @staticmethod
+    def loss_vec(logits, labels):
+        """Per-example loss [B]; labels [B, 2] = (click, conversion).
+        The CVR tower trains through p(ctcvr) = p(ctr)·p(cvr) over the
+        entire space."""
+        ctr_logit, cvr_logit = logits
+        click = labels[:, 0].astype(jnp.float32)
+        conv = labels[:, 1].astype(jnp.float32)  # implies click=1
+        l_ctr = nn.functional.binary_cross_entropy_with_logits(
+            ctr_logit, click, reduction="none")
+        p_ctcvr = jax.nn.sigmoid(ctr_logit) * jax.nn.sigmoid(cvr_logit)
+        eps = 1e-7
+        l_ctcvr = -(conv * jnp.log(p_ctcvr + eps)
+                    + (1 - conv) * jnp.log(1 - p_ctcvr + eps))
+        return l_ctr + l_ctcvr
+
+    @staticmethod
+    def loss(logits, labels):
+        return jnp.mean(ESMM.loss_vec(logits, labels))
+
+    @staticmethod
+    def predict(logits):
+        ctr_logit, cvr_logit = logits
+        p_ctr = jax.nn.sigmoid(ctr_logit)
+        return p_ctr, p_ctr * jax.nn.sigmoid(cvr_logit)
+
+
+class MMoE(Layer):
+    def __init__(self, cfg: CtrConfig, num_experts: int = 4,
+                 num_tasks: int = 2, expert_dim: int = 32) -> None:
+        super().__init__()
+        self.cfg = cfg
+        d = cfg.num_sparse_slots * cfg.embedx_dim + cfg.num_dense
+        self.num_tasks = num_tasks
+        self.experts = nn.LayerList(
+            [nn.Linear(d, expert_dim) for _ in range(num_experts)])
+        self.gates = nn.LayerList(
+            [nn.Linear(d, num_experts) for _ in range(num_tasks)])
+        self.towers = nn.LayerList(
+            [_DNN(expert_dim, cfg.dnn_hidden) for _ in range(num_tasks)])
+
+    def forward(self, emb: jax.Array, dense_x: jax.Array):
+        cfg = self.cfg
+        v = emb[..., 1:]
+        x = jnp.concatenate(
+            [v.reshape(v.shape[0], cfg.num_sparse_slots * cfg.embedx_dim),
+             dense_x], axis=-1)
+        ex = jnp.stack([nn.functional.relu(e(x)) for e in self.experts],
+                       axis=1)                     # [B, E, De]
+        first = jnp.sum(emb[..., 0], axis=-1)
+        outs = []
+        for gate, tower in zip(self.gates, self.towers):
+            w = jax.nn.softmax(gate(x), axis=-1)   # [B, E]
+            mixed = jnp.einsum("be,bed->bd", w, ex)
+            outs.append(tower(mixed) + first)
+        return tuple(outs)
+
+    @staticmethod
+    def loss_vec(logits, labels):
+        """Per-example loss [B]; labels [B, T]: independent BCE per
+        task (mmoe semantics)."""
+        total = 0.0
+        for t, logit in enumerate(logits):
+            total = total + nn.functional.binary_cross_entropy_with_logits(
+                logit, labels[:, t].astype(jnp.float32), reduction="none")
+        return total
+
+    @staticmethod
+    def loss(logits, labels):
+        return jnp.mean(MMoE.loss_vec(logits, labels))
+
+    @staticmethod
+    def predict(logits):
+        return tuple(jax.nn.sigmoid(l) for l in logits)
+
+
+def make_multitask_train_step(model: Layer, optimizer,
+                              cache_cfg: CacheConfig,
+                              loss_vec: Callable = None,
+                              donate: bool = True) -> Callable:
+    """Fused multitask GPUPS step over the HBM cache — delegates to the
+    family's shared step body (masked sentinel pull, tail-padding
+    weights, push stats with click = labels[:, 0]) with the model's own
+    per-example objective:
+
+    step(params, opt_state, cache_state, rows, dense_x, labels[B, T],
+         weights=None) → (params, opt_state, cache_state, loss)
+    """
+    loss_vec = loss_vec or type(model).loss_vec
+
+    def loss_builder(model_, dense_x, labels, weights):
+        def loss_fn(params, emb):
+            out, _ = nn.functional_call(model_, params, emb, dense_x,
+                                        training=True)
+            per = loss_vec(out, labels)
+            if weights is None:
+                return jnp.mean(per), out
+            w = weights.astype(jnp.float32)
+            return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0), out
+
+        return loss_fn
+
+    def step(params, opt_state, cache_state, rows, dense_x, labels,
+             weights=None):
+        B, S = rows.shape
+        return _ctr_step_body(model, optimizer, cache_cfg, params,
+                              opt_state, cache_state, rows.reshape(-1),
+                              B, S, dense_x, labels, weights,
+                              loss_builder=loss_builder)
+
+    return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
