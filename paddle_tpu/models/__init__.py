@@ -1,6 +1,9 @@
 from .lenet import LeNet
 from .ernie import Ernie, ErnieConfig
-from .ctr import CtrConfig, DeepFM, WideDeep, make_ctr_train_step
+from .ctr import (CtrConfig, DCN, DeepFM, WideDeep, XDeepFM,
+                  make_ctr_train_step)
+from .din import DIN, make_ctr_attention_train_step
+from .multitask import ESMM, MMoE, make_multitask_train_step
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .mobilenet import MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2

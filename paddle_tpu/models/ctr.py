@@ -215,6 +215,15 @@ def make_ctr_train_step(
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
 
 
+def _weighted_mean(per: jax.Array, weights) -> jax.Array:
+    """Mean of per-example losses under the optional [B] 0/1 tail-batch
+    padding mask — THE reduction every CTR-family objective shares."""
+    if weights is None:
+        return jnp.mean(per)
+    w = weights.astype(jnp.float32)
+    return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0)
+
+
 def _make_loss_fn(model, dense_x, labels, weights):
     """Weighted BCE over the model's logits; ``weights`` ([B] 0/1,
     optional) is the tail-batch padding mask — padded examples
@@ -225,10 +234,7 @@ def _make_loss_fn(model, dense_x, labels, weights):
                                     training=True)
         per = nn.functional.binary_cross_entropy_with_logits(
             out, labels.astype(jnp.float32), reduction="none")
-        if weights is None:
-            return jnp.mean(per), out
-        w = weights.astype(jnp.float32)
-        return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0), out
+        return _weighted_mean(per, weights), out
 
     return loss_fn
 
@@ -249,35 +255,42 @@ def _push_stats(labels, weights, n_cols, real=None):
 
 
 def _masked_pull(cache_state, flat_rows):
-    """Pull with sentinel masking: rows >= capacity (key missing from
-    the pass working set, or multi-value padding) pull ZEROS, not the
-    clamped last row's values — silent-miss must not read another
-    feature's embedding."""
-    C = cache_state["embed_w"].shape[0]
-    emb_flat = cache_pull(cache_state, jnp.minimum(flat_rows, C - 1))
-    return jnp.where((flat_rows < C)[:, None], emb_flat, 0.0)
+    """Kept as the family-internal name; ``cache_pull`` itself is
+    sentinel-safe now (rows ≥ capacity pull zeros)."""
+    return cache_pull(cache_state, flat_rows)
 
 
 def _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
                    cache_state, flat_rows, B, S, dense_x, labels,
-                   weights=None, loss_builder=None):
+                   weights=None, loss_builder=None, with_real=False):
     # hosts may ship dense/labels in narrow wire dtypes (f16 / int8 —
     # the H2D link is the CTR bottleneck, MEASURED.md); compute is f32.
     # ``loss_builder`` (default: single-task weighted BCE) lets model
-    # families with their own objective (multitask) reuse this body —
-    # masked pull, tail weights, push stats — without copying it.
+    # families with their own objective (multitask, attention) reuse
+    # this body — masked pull, tail weights, push stats — without
+    # copying it. ``with_real``: derive the [B, S] real-position mask
+    # from the sentinel and hand it to the builder (attention models
+    # consume it; push stats mask padding positions with it).
     dense_x = dense_x.astype(jnp.float32)
     labels = labels.astype(jnp.int32)
     emb = _masked_pull(cache_state, flat_rows).reshape(B, S, -1)
     builder = loss_builder or _make_loss_fn
+    real = None
+    if with_real:
+        C = cache_state["embed_w"].shape[0]
+        real = (flat_rows < C).astype(jnp.float32).reshape(B, S)
+        built = builder(model, dense_x, labels, weights, real)
+    else:
+        built = builder(model, dense_x, labels, weights)
     (loss, _), (grads, emb_grad) = jax.value_and_grad(
-        builder(model, dense_x, labels, weights),
-        argnums=(0, 1), has_aux=True)(params, emb)
+        built, argnums=(0, 1), has_aux=True)(params, emb)
 
     new_params, new_opt = optimizer.update(grads, opt_state, params)
     # the click task is column 0 when labels carry multiple tasks
     click_labels = labels if labels.ndim == 1 else labels[:, 0]
-    shows, clicks = _push_stats(click_labels, weights, S)
+    shows, clicks = _push_stats(click_labels, weights, S,
+                                real=None if real is None
+                                else real.reshape(-1))
     new_cache = cache_push(cache_state, flat_rows,
                            emb_grad.reshape(B * S, -1), shows, clicks,
                            cache_cfg)

@@ -1,0 +1,108 @@
+"""DIN — Deep Interest Network over variable-length behavior slots.
+
+PaddleRec models/rank/din: the user's behavior sequence (a multi-valued
+slot) is pooled by a local activation unit — an MLP scoring each
+behavior against the TARGET item — instead of sum-pooling. Here the
+per-position embeddings come from the same padded-column layout the
+pooled step uses (``slot_of_column``; padding positions hold the cache
+sentinel), and ``make_ctr_attention_train_step`` hands the model the
+positions AND the real-position mask, so attention can exclude padding
+exactly (masked softmax), not by hoping padded embeddings stay zero.
+
+Column layout: the first ``num_target_cols`` columns are single-valued
+context/target slots; the rest are the behavior sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..nn.layer import Layer
+from ..ps.embedding_cache import CacheConfig
+from .ctr import _DNN, _ctr_step_body, _weighted_mean
+
+__all__ = ["DIN", "make_ctr_attention_train_step"]
+
+
+class DIN(Layer):
+    def __init__(self, num_target_cols: int, num_behavior_cols: int,
+                 num_dense: int, embedx_dim: int,
+                 dnn_hidden: Tuple[int, ...] = (64, 32),
+                 att_hidden: int = 16) -> None:
+        super().__init__()
+        self.num_target_cols = num_target_cols
+        self.num_behavior_cols = num_behavior_cols
+        self.embedx_dim = embedx_dim
+        d = embedx_dim
+        # local activation unit: score(b_j | target) from
+        # [target, b_j, target*b_j, target-b_j]
+        self.att1 = nn.Linear(4 * d, att_hidden)
+        self.att2 = nn.Linear(att_hidden, 1)
+        self.dnn = _DNN(num_target_cols * d + d + num_dense, dnn_hidden)
+        self.dense_lin = nn.Linear(num_dense, 1)
+
+    def forward(self, emb: jax.Array, real: jax.Array,
+                dense_x: jax.Array) -> jax.Array:
+        """emb [B, T, 1+dim] per-position pulls; real [B, T] 0/1 mask;
+        dense_x [B, D]."""
+        G = self.num_target_cols
+        v = emb[..., 1:]                          # [B, T, dim]
+        target = v[:, :G, :]                       # [B, G, dim]
+        behav = v[:, G:, :]                        # [B, Tb, dim]
+        bmask = real[:, G:]                        # [B, Tb]
+        t = jnp.mean(target, axis=1, keepdims=True)  # [B, 1, dim] summary
+        feats = jnp.concatenate(
+            [jnp.broadcast_to(t, behav.shape), behav, t * behav,
+             t - behav], axis=-1)                  # [B, Tb, 4d]
+        scores = self.att2(nn.functional.relu(self.att1(feats)))[..., 0]
+        scores = jnp.where(bmask > 0, scores, -1e30)  # mask padding OUT
+        w = jax.nn.softmax(scores, axis=-1) * (
+            bmask.sum(-1, keepdims=True) > 0)      # all-pad rows → 0
+        interest = jnp.einsum("bt,btd->bd", w, behav)
+        x = jnp.concatenate(
+            [target.reshape(target.shape[0], -1), interest, dense_x],
+            axis=-1)
+        first = jnp.sum(emb[..., 0] * real, axis=-1)
+        return self.dnn(x) + self.dense_lin(dense_x)[..., 0] + first
+
+
+def make_ctr_attention_train_step(
+    model: Layer,
+    optimizer,
+    cache_cfg: CacheConfig,
+    donate: bool = True,
+) -> Callable:
+    """GPUPS step for attention models over padded columns — delegates
+    to the family's shared body (masked pull, tail weights, push stats)
+    in ``with_real`` mode: the in-graph real-position mask goes to the
+    model (``model(emb, real, dense)``) and masks padding out of the
+    push stats. Each REAL position receives its own gradient.
+
+    step(params, opt_state, cache_state, rows [B, T], dense_x, labels,
+         weights=None) → (params, opt_state, cache_state, loss)
+    """
+
+    def loss_builder(model_, dense_x, labels, weights, real):
+        def loss_fn(params, emb):
+            out, _ = nn.functional_call(model_, params, emb, real,
+                                        dense_x, training=True)
+            per = nn.functional.binary_cross_entropy_with_logits(
+                out, labels.astype(jnp.float32), reduction="none")
+            return _weighted_mean(per, weights), out
+
+        return loss_fn
+
+    def step(params, opt_state, cache_state, rows, dense_x, labels,
+             weights=None):
+        B, T = rows.shape
+        return _ctr_step_body(model, optimizer, cache_cfg, params,
+                              opt_state, cache_state, rows.reshape(-1),
+                              B, T, dense_x, labels, weights,
+                              loss_builder=loss_builder, with_real=True)
+
+    return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())

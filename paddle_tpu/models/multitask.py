@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from .. import nn
 from ..nn.layer import Layer
 from ..ps.embedding_cache import CacheConfig
-from .ctr import CtrConfig, _DNN, _ctr_step_body
+from .ctr import CtrConfig, _DNN, _ctr_step_body, _weighted_mean
 
 __all__ = ["ESMM", "MMoE", "make_multitask_train_step"]
 
@@ -145,11 +145,7 @@ def make_multitask_train_step(model: Layer, optimizer,
         def loss_fn(params, emb):
             out, _ = nn.functional_call(model_, params, emb, dense_x,
                                         training=True)
-            per = loss_vec(out, labels)
-            if weights is None:
-                return jnp.mean(per), out
-            w = weights.astype(jnp.float32)
-            return jnp.sum(per * w) / jnp.maximum(jnp.sum(w), 1.0), out
+            return _weighted_mean(loss_vec(out, labels), weights), out
 
         return loss_fn
 

@@ -68,9 +68,16 @@ class CacheConfig:
 
 def cache_pull(state: Dict[str, jax.Array], rows: jax.Array) -> jax.Array:
     """In-graph pull: [n, 1+dim] = embed_w ++ embedx_w for given rows.
-    (PullSparse / CopyForPull analogue — one fused gather.)"""
+    (PullSparse / CopyForPull analogue — one fused gather.)
+
+    SENTINEL-SAFE: rows ≥ capacity (missing key / padding) pull ZEROS.
+    Without the mask, a sentinel row would read the clamped last row's
+    values under jit — another feature's embedding — and NaN-fill in
+    eager mode; both are silent corruption."""
+    C = state["embed_w"].shape[0]
     w = jnp.concatenate([state["embed_w"], state["embedx_w"]], axis=1)
-    return jnp.take(w, rows, axis=0)
+    pulled = jnp.take(w, jnp.minimum(rows, C - 1), axis=0)
+    return jnp.where((rows < C)[:, None], pulled, 0.0)
 
 
 def cache_push(
