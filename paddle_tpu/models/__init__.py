@@ -3,6 +3,7 @@ from .ernie import Ernie, ErnieConfig
 from .ctr import (CtrConfig, DCN, DeepFM, WideDeep, XDeepFM,
                   make_ctr_train_step)
 from .din import DIN, make_ctr_attention_train_step
+from .dssm import DSSM, make_dssm_train_step
 from .multitask import ESMM, MMoE, make_multitask_train_step
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19

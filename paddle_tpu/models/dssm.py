@@ -1,0 +1,121 @@
+"""DSSM — two-tower recall/match model over the sparse PS path.
+
+PaddleRec models/recall/dssm (and the match family generally): a query
+tower and a doc tower embed their own slot groups into one space;
+training scores the in-batch cosine similarities with a softmax over
+negatives (every other doc in the batch), the standard two-tower recall
+objective. Serving exports the towers separately (doc embeddings go to
+an ANN index; the query tower runs online).
+
+Embeddings pull from the PS cache like every model here: the step takes
+ONE [B, Sq+Sd] row block (query slots first), both towers' gradients
+flow back through the same fused pull/push.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn.layer import Layer
+from ..ps.embedding_cache import CacheConfig
+from .ctr import _ctr_step_body
+
+__all__ = ["DSSM", "make_dssm_train_step"]
+
+
+class _Tower(Layer):
+    def __init__(self, in_dim: int, hidden: Tuple[int, ...], out: int) -> None:
+        super().__init__()
+        dims = (in_dim,) + tuple(hidden) + (out,)
+        self.layers = nn.LayerList(
+            [nn.Linear(dims[i], dims[i + 1]) for i in range(len(dims) - 1)])
+
+    def forward(self, x: jax.Array) -> jax.Array:
+        for i, lin in enumerate(self.layers):
+            x = lin(x)
+            if i + 1 < len(self.layers):
+                x = nn.functional.relu(x)
+        return x
+
+
+class DSSM(Layer):
+    """forward(emb, dense_x) → (q [B, out], d [B, out]) L2-normalized
+    tower outputs; ``emb`` is the pulled [B, Sq+Sd, 1+dim] block."""
+
+    def __init__(self, num_query_slots: int, num_doc_slots: int,
+                 embedx_dim: int, hidden: Tuple[int, ...] = (64, 32),
+                 out_dim: int = 16) -> None:
+        super().__init__()
+        self.sq, self.sd = num_query_slots, num_doc_slots
+        # towers consume the FULL per-slot vector (embed_w ++ embedx):
+        # the CTR accessor creates embx lazily (all-zero until the first
+        # push), and a purely-bilinear objective over zeros is an exact
+        # saddle — the eagerly-initialized embed_w column breaks it
+        self.query_tower = _Tower(num_query_slots * (1 + embedx_dim),
+                                  hidden, out_dim)
+        self.doc_tower = _Tower(num_doc_slots * (1 + embedx_dim), hidden,
+                                out_dim)
+
+    def forward(self, emb: jax.Array, dense_x: jax.Array
+                ) -> Tuple[jax.Array, jax.Array]:
+        B = emb.shape[0]
+        q = self.query_tower(emb[:, :self.sq, :].reshape(B, -1))
+        d = self.doc_tower(emb[:, self.sq:, :].reshape(B, -1))
+
+        def norm(x):
+            # smoothed L2 normalize: x/max(‖x‖, eps) has a 1/‖x‖-scale
+            # backward that EXPLODES at the near-zero outputs of a cold
+            # tower (embeddings init ~1e-4) — rsqrt(‖x‖² + eps²) keeps
+            # the gradient bounded while converging to unit vectors
+            return x * jax.lax.rsqrt(
+                jnp.sum(x * x, axis=-1, keepdims=True) + 1e-6)
+
+        return norm(q), norm(d)
+
+    @staticmethod
+    def loss_vec(outputs, labels, temperature: float = 0.1):
+        """In-batch softmax over negatives: row i's positive is doc i,
+        every other doc in the batch is a negative (labels unused — the
+        pairing IS the supervision). Returns per-example loss [B]."""
+        q, d = outputs
+        logits = (q @ d.T) / temperature           # [B, B]
+        return -jax.nn.log_softmax(logits, axis=-1).diagonal()
+
+
+def make_dssm_train_step(model: DSSM, optimizer, cache_cfg: CacheConfig,
+                         temperature: float = 0.1,
+                         donate: bool = True) -> Callable:
+    """Two-tower in-batch-negatives step over the HBM cache, through the
+    family's shared body (masked pull, tail weights, push stats):
+
+    step(params, opt_state, cache_state, rows [B, Sq+Sd], dense_x,
+         labels [B], weights=None) → (params, opt_state, cache_state,
+         loss)
+
+    ``labels`` feed only the accessor's click statistic (1 = a real
+    click/pair); the contrastive objective needs no explicit label.
+    """
+    from .ctr import _weighted_mean
+
+    def loss_builder(model_, dense_x, labels, weights):
+        def loss_fn(params, emb):
+            out, _ = nn.functional_call(model_, params, emb, dense_x,
+                                        training=True)
+            per = DSSM.loss_vec(out, labels, temperature)
+            return _weighted_mean(per, weights), out
+
+        return loss_fn
+
+    def step(params, opt_state, cache_state, rows, dense_x, labels,
+             weights=None):
+        B, S = rows.shape
+        return _ctr_step_body(model, optimizer, cache_cfg, params,
+                              opt_state, cache_state, rows.reshape(-1),
+                              B, S, dense_x, labels, weights,
+                              loss_builder=loss_builder)
+
+    return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
