@@ -48,9 +48,14 @@ class CtrConfig:
 
 
 class _DNN(Layer):
-    def __init__(self, in_dim: int, hidden: Tuple[int, ...]) -> None:
+    """Relu MLP tower; ``out_dim=1`` (the default) squeezes to a logit
+    — the ONE tower definition the whole model family shares."""
+
+    def __init__(self, in_dim: int, hidden: Tuple[int, ...],
+                 out_dim: int = 1) -> None:
         super().__init__()
-        dims = (in_dim,) + tuple(hidden) + (1,)
+        dims = (in_dim,) + tuple(hidden) + (out_dim,)
+        self.out_dim = out_dim
         self.layers = nn.LayerList(
             [nn.Linear(dims[i], dims[i + 1]) for i in range(len(dims) - 1)]
         )
@@ -60,7 +65,7 @@ class _DNN(Layer):
             x = lin(x)
             if i + 1 < len(self.layers):
                 x = nn.functional.relu(x)
-        return x[..., 0]
+        return x[..., 0] if self.out_dim == 1 else x
 
 
 class DeepFM(Layer):

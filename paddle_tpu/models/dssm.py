@@ -22,24 +22,9 @@ import jax.numpy as jnp
 from .. import nn
 from ..nn.layer import Layer
 from ..ps.embedding_cache import CacheConfig
-from .ctr import _ctr_step_body
+from .ctr import _DNN, _ctr_step_body, _weighted_mean
 
 __all__ = ["DSSM", "make_dssm_train_step"]
-
-
-class _Tower(Layer):
-    def __init__(self, in_dim: int, hidden: Tuple[int, ...], out: int) -> None:
-        super().__init__()
-        dims = (in_dim,) + tuple(hidden) + (out,)
-        self.layers = nn.LayerList(
-            [nn.Linear(dims[i], dims[i + 1]) for i in range(len(dims) - 1)])
-
-    def forward(self, x: jax.Array) -> jax.Array:
-        for i, lin in enumerate(self.layers):
-            x = lin(x)
-            if i + 1 < len(self.layers):
-                x = nn.functional.relu(x)
-        return x
 
 
 class DSSM(Layer):
@@ -55,10 +40,10 @@ class DSSM(Layer):
         # the CTR accessor creates embx lazily (all-zero until the first
         # push), and a purely-bilinear objective over zeros is an exact
         # saddle — the eagerly-initialized embed_w column breaks it
-        self.query_tower = _Tower(num_query_slots * (1 + embedx_dim),
-                                  hidden, out_dim)
-        self.doc_tower = _Tower(num_doc_slots * (1 + embedx_dim), hidden,
-                                out_dim)
+        self.query_tower = _DNN(num_query_slots * (1 + embedx_dim),
+                                hidden, out_dim=out_dim)
+        self.doc_tower = _DNN(num_doc_slots * (1 + embedx_dim), hidden,
+                              out_dim=out_dim)
 
     def forward(self, emb: jax.Array, dense_x: jax.Array
                 ) -> Tuple[jax.Array, jax.Array]:
@@ -77,12 +62,23 @@ class DSSM(Layer):
         return norm(q), norm(d)
 
     @staticmethod
-    def loss_vec(outputs, labels, temperature: float = 0.1):
+    def loss_vec(outputs, labels, temperature: float = 0.1,
+                 weights=None):
         """In-batch softmax over negatives: row i's positive is doc i,
         every other doc in the batch is a negative (labels unused — the
-        pairing IS the supervision). Returns per-example loss [B]."""
+        pairing IS the supervision). ``weights`` ([B] 0/1 tail-padding
+        mask): padded DOC COLUMNS are masked out of every softmax — a
+        padded example must not act as a fake negative for real queries
+        (the family's padding contract). Returns per-example loss [B]."""
         q, d = outputs
         logits = (q @ d.T) / temperature           # [B, B]
+        if weights is not None:
+            logits = logits + (-1e30) * (
+                1.0 - weights.astype(jnp.float32))[None, :]
+            # keep each row's own diagonal finite even when that row is
+            # padded (its loss is zeroed by the row mask downstream)
+            logits = logits + jnp.diag(
+                1e30 * (1.0 - weights.astype(jnp.float32)))
         return -jax.nn.log_softmax(logits, axis=-1).diagonal()
 
 
@@ -99,13 +95,11 @@ def make_dssm_train_step(model: DSSM, optimizer, cache_cfg: CacheConfig,
     ``labels`` feed only the accessor's click statistic (1 = a real
     click/pair); the contrastive objective needs no explicit label.
     """
-    from .ctr import _weighted_mean
-
     def loss_builder(model_, dense_x, labels, weights):
         def loss_fn(params, emb):
             out, _ = nn.functional_call(model_, params, emb, dense_x,
                                         training=True)
-            per = DSSM.loss_vec(out, labels, temperature)
+            per = DSSM.loss_vec(out, labels, temperature, weights)
             return _weighted_mean(per, weights), out
 
         return loss_fn

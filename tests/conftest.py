@@ -56,6 +56,7 @@ _SLOW = {
     "test_launch_propagates_failure",
     "test_elastic_launch_restarts_and_completes",
     "test_elastic_launch_gives_up_below_min_np",
+    "test_dssm_learns_pairing_and_ranks_true_doc",
 }
 
 

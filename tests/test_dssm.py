@@ -84,3 +84,29 @@ def test_dssm_learns_pairing_and_ranks_true_doc():
         total += B
     top1 = hits / total
     assert top1 > 0.25, top1  # chance = 1/128 ≈ 0.008
+
+
+def test_padded_examples_are_not_fake_negatives():
+    """The padding contract: a tail batch's padded rows must not act as
+    in-batch negatives — real rows' losses are identical whether the
+    batch carries padding or not."""
+    import jax
+
+    pt.seed(0)
+    rng = np.random.default_rng(3)
+    model = DSSM(SQ, SD, DIM)
+    params = {"params": dict(model.named_parameters()), "buffers": {}}
+    B, Breal = 8, 5
+    emb = jnp.asarray(rng.normal(scale=0.1, size=(B, SQ + SD, 1 + DIM)),
+                      jnp.float32)
+    dense = jnp.zeros((B, 1), jnp.float32)
+    w = jnp.asarray((np.arange(B) < Breal).astype(np.float32))
+    out_full, _ = nn.functional_call(model, params, emb, dense,
+                                     training=False)
+    per_masked = DSSM.loss_vec(out_full, None, 0.2, weights=w)
+    out_real, _ = nn.functional_call(model, params, emb[:Breal], dense[:Breal],
+                                     training=False)
+    per_real = DSSM.loss_vec(out_real, None, 0.2)
+    np.testing.assert_allclose(np.asarray(per_masked)[:Breal],
+                               np.asarray(per_real), rtol=1e-5)
+    assert np.isfinite(np.asarray(per_masked)).all()
