@@ -57,6 +57,14 @@ _SLOW = {
     "test_elastic_launch_restarts_and_completes",
     "test_elastic_launch_gives_up_below_min_np",
     "test_dssm_learns_pairing_and_ranks_true_doc",
+    "test_sharded_key_fed_matches_row_fed",
+    "test_elastic_scale_in_resumes_consistently",
+    "test_hybrid_sharding_axis_shards_opt_state",
+    "test_routed_hot_key_batches_fit_with_dedup",
+    "test_routed_negative_sentinel_rows",
+    "test_din_learns_match_signal_and_ignores_padding",
+    "test_multitask_learns_both_tasks",
+    "test_slab_pass_matches_single_step_pass",
 }
 
 
