@@ -107,6 +107,10 @@ def fused_row_update(show, click, ew, estate, xw, xstate, has,
     upd = functools.partial(rule_update, lr=lr, initial_g2sum=initial_g2sum,
                             wmin=wmin, wmax=wmax, beta1=beta1, beta2=beta2,
                             eps=eps)
+    # Mosaic lowers [n] -> [n,1] reshapes only for 32-bit types, so bool
+    # masks broadcast to columns via f32 + compare, never via i1 reshape
+    col = lambda m: m.astype(jnp.float32)[:, None] > 0.5
+
     show_new = show + dshow
     click_new = click + dclick
     scale = jnp.maximum(dshow, 1e-10)[:, None]
@@ -126,15 +130,15 @@ def fused_row_update(show, click, ew, estate, xw, xstate, has,
     n = show.shape[0]
     if xs > 0:
         init = rule_init_state(embedx_rule, n, dim, beta1=beta1, beta2=beta2)
-        st_base = jnp.where(create[:, None], init, xstate)
+        st_base = jnp.where(col(create), init, xstate)
     else:
         st_base = xstate[:, :max(xs, 1)]
     xw_new, xs_new = upd(embedx_rule, xw, st_base, gx, scale)
 
     return (show_new, click_new, ew_new,
             es_new if es > 0 else estate,
-            jnp.where(apply_mask[:, None], xw_new, xw),
-            jnp.where(apply_mask[:, None], xs_new, st_base) if xs > 0 else xstate,
+            jnp.where(col(apply_mask), xw_new, xw),
+            jnp.where(col(apply_mask), xs_new, st_base) if xs > 0 else xstate,
             jnp.where(create, 1.0, has))
 
 

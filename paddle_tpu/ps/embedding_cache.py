@@ -39,7 +39,8 @@ from .native import FeasignIndex
 from .sgd_rule import SGDRuleConfig
 from .table import MemorySparseTable
 
-__all__ = ["CacheConfig", "HbmEmbeddingCache", "cache_pull", "cache_push"]
+__all__ = ["CacheConfig", "HbmEmbeddingCache", "cache_pull", "cache_push",
+           "cache_push_dense", "cache_push_sparse"]
 
 
 @dataclasses.dataclass
@@ -61,9 +62,22 @@ class CacheConfig:
     #: order (default — bit-parity with the host tables); False = GPU.
     create_applies_grad: bool = True
     #: run the per-row optimizer math as the fused Pallas kernel
-    #: (ops/sparse_optimizer.py, the optimizer.cuh.h analogue);
-    #: None = auto (on for TPU backends, jnp elsewhere)
+    #: (ops/sparse_optimizer.py, the optimizer.cuh.h analogue); only
+    #: meaningful for the "sparse" push mode. None = auto (on for TPU
+    #: backends, jnp elsewhere)
     pallas_update: Optional[bool] = None
+    #: push formulation. "sparse": the reference's merge_grad shape —
+    #: sorted-unique dedup, gather touched rows, rule kernel, scatter
+    #: back (O(batch) HBM traffic but sort/gather/scatter-bound on TPU:
+    #: measured 25 ms at batch 4096x26, BENCH_DECOMP.md). "dense": one
+    #: duplicate-safe 2-D scatter-add of [grads|show|click] into a
+    #: [C+1, 3+dim] accumulator, then the SAME fused_row_update math
+    #: streamed over the whole table with a touched-row mask — no sort,
+    #: no unique, no row gather/scatter; pure sequential HBM traffic
+    #: O(capacity·width) that XLA fuses into one pass (~0.7 ms at
+    #: C=2M). "auto": dense on TPU, sparse elsewhere (keeps CPU-path
+    #: tests bit-identical to the reference formulation).
+    push_mode: str = "auto"
 
 
 def cache_pull(state: Dict[str, jax.Array], rows: jax.Array) -> jax.Array:
@@ -88,14 +102,92 @@ def cache_push(
     clicks: jax.Array,  # [n]
     cfg: CacheConfig,
 ) -> Dict[str, jax.Array]:
-    """In-graph push, batch-scaled: dedup duplicate rows inside the batch
+    """In-graph push (PushSparseGrad / merge_grad analogue). Dispatches
+    on ``cfg.push_mode`` — see CacheConfig; both modes apply the same
+    ``fused_row_update`` math to the same per-row summed deltas, so they
+    agree up to f32 re-association of duplicate-row sums."""
+    mode = cfg.push_mode
+    if mode == "auto":
+        mode = "dense" if jax.default_backend() == "tpu" else "sparse"
+    if mode == "dense":
+        return cache_push_dense(state, rows, grads, shows, clicks, cfg)
+    enforce(mode == "sparse", f"unknown push_mode {cfg.push_mode!r}")
+    return cache_push_sparse(state, rows, grads, shows, clicks, cfg)
+
+
+def cache_push_dense(
+    state: Dict[str, jax.Array],
+    rows: jax.Array,
+    grads: jax.Array,
+    shows: jax.Array,
+    clicks: jax.Array,
+    cfg: CacheConfig,
+) -> Dict[str, jax.Array]:
+    """TPU-first push: ONE duplicate-safe 2-D scatter-add merges the
+    batch ([grads | show | click] rows into a [C+1, 3+dim] accumulator —
+    the sentinel row C collects and drops padding/missing keys), then
+    the per-row optimizer math runs VECTORIZED over the full table and a
+    touched mask (summed show > 0) selects which rows keep their update.
+
+    Rationale: the reference's merge_grad (cub sort + reduce,
+    heter_comm_inl.h:388) exists because GPUs update rows one-thread-
+    per-row; on TPU a sort + row gather/scatter of ~100k rows costs
+    ~25 ms while streaming the whole 2M-row table through the VPU costs
+    <1 ms (BENCH_DECOMP.md) — so the TPU shape of "merge then update
+    touched rows" is "scatter-add then masked dense update". "Touched"
+    means PRESENT IN THE BATCH (an occurrence count rides the
+    accumulator), exactly the sparse path's `uniq` membership — so a
+    row whose occurrences all carry show=0 still gets the rule applied
+    at zero delta (Adam decays m/v there, like the sparse path and the
+    host table), and rows absent from the batch are bit-untouched.
+    """
+    C = state["embed_w"].shape[0]
+    sgd = cfg.sgd
+    dim = cfg.embedx_dim
+    ones = jnp.ones((rows.shape[0], 1), jnp.float32)
+    upd = jnp.concatenate(
+        [grads.astype(jnp.float32), shows[:, None], clicks[:, None], ones],
+        axis=1)  # [n, 4+dim]: grads | show | click | occurrence count
+    acc = jnp.zeros((C + 1, upd.shape[1]), jnp.float32)
+    acc = acc.at[rows].add(upd)[:C]
+    ge, gx = acc[:, :1], acc[:, 1:1 + dim]
+    dshow, dclick = acc[:, 1 + dim], acc[:, 2 + dim]
+    touched = acc[:, 3 + dim] > 0
+
+    outs = fused_row_update(
+        state["show"], state["click"], state["embed_w"],
+        state["embed_state"], state["embedx_w"], state["embedx_state"],
+        state["has_embedx"], dshow, dclick, ge, gx,
+        embed_rule=cfg.embed_rule, embedx_rule=cfg.embedx_rule,
+        dim=dim, lr=sgd.learning_rate, initial_g2sum=sgd.initial_g2sum,
+        wmin=sgd.weight_bounds[0], wmax=sgd.weight_bounds[1],
+        beta1=sgd.beta1, beta2=sgd.beta2, eps=sgd.ada_epsilon,
+        nonclk_coeff=cfg.nonclk_coeff, click_coeff=cfg.click_coeff,
+        embedx_threshold=cfg.embedx_threshold,
+        create_applies_grad=cfg.create_applies_grad)
+
+    names = ("show", "click", "embed_w", "embed_state", "embedx_w",
+             "embedx_state", "has_embedx")
+    tcol = touched[:, None]
+    return {k: jnp.where(touched if new.ndim == 1 else tcol, new, state[k])
+            for k, new in zip(names, outs)}
+
+
+def cache_push_sparse(
+    state: Dict[str, jax.Array],
+    rows: jax.Array,  # [n] cache rows (may repeat)
+    grads: jax.Array,  # [n, 1+dim] embed_g ++ embedx_g
+    shows: jax.Array,  # [n]
+    clicks: jax.Array,  # [n]
+    cfg: CacheConfig,
+) -> Dict[str, jax.Array]:
+    """The merge_grad-shaped push: dedup duplicate rows inside the batch
     (the cub sort+reduce merge_grad step, heter_comm_inl.h:388, becomes
     sorted-unique + segment-sum), then gather the touched rows, apply the
-    per-feature AdaGrad rule (optimizer.cuh.h:35-70 / sparse_sgd_rule
-    AdaGrad) and scatter only those rows back. Per-step HBM traffic is
-    O(batch·dim), independent of cache capacity.
-
-    All dense ops — fuses into the train step program.
+    per-feature CTR rule (optimizer.cuh.h:35-70 / sparse_sgd_rule) and
+    scatter only those rows back. Per-step HBM traffic is O(batch·dim),
+    independent of cache capacity — the right shape for hosts/CPU; on
+    TPU prefer push_mode="dense" (sort and row scatter dominate there).
     """
     n = rows.shape[0]
     C = state["embed_w"].shape[0]

@@ -51,8 +51,11 @@ def test_pallas_push_matches_jnp(rng, embed_rule, embedx_rule,
     kw = dict(capacity=C, embedx_dim=dim, embedx_threshold=3.0,
               embed_rule=embed_rule, embedx_rule=embedx_rule,
               create_applies_grad=create_applies_grad)
-    cfg_j = CacheConfig(pallas_update=False, **kw)
-    cfg_p = CacheConfig(pallas_update=True, **kw)
+    # pin the merge_grad-shaped path: "auto" would resolve to the dense
+    # push on TPU backends, which never calls the Pallas kernel — these
+    # tests exist to cover ctr_sparse_rows
+    cfg_j = CacheConfig(pallas_update=False, push_mode="sparse", **kw)
+    cfg_p = CacheConfig(pallas_update=True, push_mode="sparse", **kw)
     a = jax.jit(lambda st: cache_push(st, rows, grads, shows, clicks, cfg_j))(state)
     b = jax.jit(lambda st: cache_push(st, rows, grads, shows, clicks, cfg_p))(state)
     for k in a:
@@ -134,11 +137,63 @@ def test_pallas_push_in_cache_small(rng):
     shows = jnp.ones((n,), jnp.float32)
     clicks = jnp.zeros((n,), jnp.float32)
     cfg = CacheConfig(capacity=C, embedx_dim=dim, embedx_threshold=0.0,
-                      pallas_update=True)
+                      pallas_update=True, push_mode="sparse")
     cfg_ref = CacheConfig(capacity=C, embedx_dim=dim, embedx_threshold=0.0,
-                          pallas_update=False)
+                          pallas_update=False, push_mode="sparse")
     b = jax.jit(lambda st: cache_push(st, rows, grads, shows, clicks, cfg))(state)
     a = jax.jit(lambda st: cache_push(st, rows, grads, shows, clicks, cfg_ref))(state)
     for k in a:
         np.testing.assert_allclose(np.asarray(b[k]), np.asarray(a[k]),
                                    rtol=1e-6, atol=1e-7, err_msg=k)
+
+
+@pytest.mark.parametrize("create_applies_grad", [True, False])
+@pytest.mark.parametrize("embed_rule,embedx_rule",
+                         [(r, r) for r in RULES] + [("adagrad", "adam"),
+                                                    ("naive", "std_adagrad")])
+def test_dense_push_matches_sparse(rng, embed_rule, embedx_rule,
+                                   create_applies_grad):
+    """push_mode="dense" (scatter-add + masked full-table update — the
+    TPU hot path) == push_mode="sparse" (the merge_grad shape) up to f32
+    re-association of duplicate-row sums, including: heavy duplicates,
+    the capacity sentinel, zero-show masked padding rows (must stay
+    bit-untouched), and untouched rows (must stay bit-untouched)."""
+    C, dim, n = 512, 4, 600
+    state = _state(rng, C, dim, embed_rule, embedx_rule)
+    # heavy duplication (rows drawn from 64) + sentinel padding tail
+    rows = rng.integers(0, 64, n).astype(np.int32)
+    rows[-40:] = C  # missing-key / padding sentinel
+    # row 100 appears ONLY at masked positions: both paths must still
+    # apply the rule at zero delta (Adam decays m/v) — batch presence,
+    # not show, decides "touched"
+    rows[10:20] = 100
+    rows = jnp.asarray(rows)
+    grads = rng.normal(size=(n, 1 + dim)).astype(np.float32)
+    shows = np.ones((n,), np.float32)
+    # a masked (weight=0) position ships zero show AND zero grad
+    shows[10:20] = 0.0
+    grads[10:20] = 0.0
+    clicks = (rng.random(n) < 0.4).astype(np.float32) * shows
+    grads, shows, clicks = map(jnp.asarray, (grads, shows, clicks))
+
+    kw = dict(capacity=C, embedx_dim=dim, embedx_threshold=3.0,
+              embed_rule=embed_rule, embedx_rule=embedx_rule,
+              create_applies_grad=create_applies_grad,
+              pallas_update=False)
+    cfg_s = CacheConfig(push_mode="sparse", **kw)
+    cfg_d = CacheConfig(push_mode="dense", **kw)
+    a = jax.jit(lambda st: cache_push(st, rows, grads, shows, clicks, cfg_s))(state)
+    b = jax.jit(lambda st: cache_push(st, rows, grads, shows, clicks, cfg_d))(state)
+    for k in a:
+        np.testing.assert_allclose(np.asarray(b[k]), np.asarray(a[k]),
+                                   rtol=2e-5, atol=1e-6, err_msg=k)
+    np.testing.assert_array_equal(np.asarray(b["has_embedx"]),
+                                  np.asarray(a["has_embedx"]))
+    # rows absent from the batch are bit-identical in the dense path
+    touched = np.zeros(C, bool)
+    r_np = np.asarray(rows)
+    touched[r_np[r_np < C]] = True
+    for k in a:
+        np.testing.assert_array_equal(
+            np.asarray(b[k])[~touched[: C]],
+            np.asarray(state[k])[~touched[: C]], err_msg=f"untouched {k}")
