@@ -101,6 +101,9 @@ def main() -> None:
     batch = int(os.environ.get("BENCH_BATCH", 4096))
     steps = int(os.environ.get("BENCH_STEPS", 30))
     warmup = int(os.environ.get("BENCH_WARMUP", 5))
+    # bf16 matmuls (f32 accumulation) for the dense tower — the MXU's
+    # native rate; sparse/optimizer state stays f32 throughout
+    amp_on = os.environ.get("BENCH_AMP", "1") == "1"
     pass_keys = int(os.environ.get("BENCH_PASS_KEYS", 1 << 20))
     # BENCH_SLAB > 1: run `slab` train steps per dispatch (one scan over
     # a device-resident stack of packed buffers) — amortizes the ~0.1 ms
@@ -173,23 +176,35 @@ def main() -> None:
         packed = next(feeder)
         return step(params, opt_state, cache.state, map_state, packed)
 
-    try:
-        for i in range(warmup):
-            params, opt_state, cache.state, loss = run_one()
-        jax.block_until_ready(loss)
+    # sync discipline: a tiny D2H fetch, NOT block_until_ready — on the
+    # axon relay block_until_ready can return before the computation
+    # finishes (measured 2026-07-31: 20 chained 8k matmuls "completed"
+    # in 0.4 ms by block, 192 ms by fetch), which would over-report
+    # throughput by the queue tail
+    _sync = lambda x: np.asarray(jax.tree_util.tree_leaves(x)[0].ravel()[:1])
 
-        t0 = time.perf_counter()
-        for i in range(steps):
-            params, opt_state, cache.state, loss = run_one()
-        jax.block_until_ready(loss)
-        dt = time.perf_counter() - t0
+    from paddle_tpu.amp import auto_cast
+
+    try:
+        # auto_cast is consulted at TRACE time (first call below), so the
+        # context wraps the loops, not the step construction
+        with auto_cast(enable=amp_on):
+            for i in range(warmup):
+                params, opt_state, cache.state, loss = run_one()
+            _sync(loss)
+
+            t0 = time.perf_counter()
+            for i in range(steps):
+                params, opt_state, cache.state, loss = run_one()
+            _sync(loss)
+            dt = time.perf_counter() - t0
     finally:
         prefetcher.close()
 
     samples_per_sec = batch * slab * steps / dt
     baseline = 1.0e6  # proxy: GPUPS-on-A100 class throughput (north star ≥2×)
     _emit(round(samples_per_sec, 1), round(samples_per_sec / baseline, 4),
-          slab=slab)
+          slab=slab, amp=amp_on)
 
 
 if __name__ == "__main__":

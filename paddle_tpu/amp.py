@@ -57,7 +57,16 @@ def auto_cast(enable: bool = True, dtype: str = "bfloat16"):
     """``paddle.amp.auto_cast`` analogue. Layers consult
     ``amp_enabled()/amp_dtype()`` to pick their compute dtype; casting
     the *inputs* is usually sufficient since XLA propagates the low
-    precision through fused elementwise chains."""
+    precision through fused elementwise chains.
+
+    TRACE-TIME contract (the imperative reference casts per-op at
+    runtime; under jit there is no runtime): the state is read when a
+    jitted function is first TRACED, and the amp state is NOT part of
+    jit's cache key. A step traced outside the context stays f32 even
+    if later called inside it — and one traced inside keeps computing
+    in the amp dtype after the context exits. Make the FIRST call of a
+    jitted step inside the context (or build separate jitted callables
+    per mode)."""
     prev = (_amp_state.enabled, _amp_state.dtype)
     _amp_state.enabled = bool(enable)
     _amp_state.dtype = jnp.bfloat16 if dtype in ("bfloat16", "bf16") else jnp.float16

@@ -81,8 +81,21 @@ def dropout(
 
 
 def linear(x: jax.Array, weight: jax.Array, bias: Optional[jax.Array] = None) -> jax.Array:
-    """x @ W (+ b). Weight layout [in, out] (paddle convention)."""
-    y = jnp.matmul(x, weight)
+    """x @ W (+ b). Weight layout [in, out] (paddle convention).
+
+    Under ``amp.auto_cast`` (checked at trace time, like the context's
+    contract says) the matmul runs in the amp dtype — bf16 feeds the
+    MXU at full rate with f32 accumulation on TPU — and the result is
+    cast back to the input dtype, so parameters, bias math, and
+    everything downstream stay f32."""
+    from .. import amp
+
+    if amp.amp_enabled() and x.dtype == jnp.float32:
+        dt = amp.amp_dtype()
+        y = jnp.matmul(x.astype(dt), weight.astype(dt),
+                       preferred_element_type=jnp.float32)
+    else:
+        y = jnp.matmul(x, weight)
     if bias is not None:
         y = y + bias
     return y
@@ -104,7 +117,11 @@ def conv2d(
     groups: int = 1,
 ) -> jax.Array:
     """NCHW conv with OIHW weights (paddle layout). XLA lowers this to the
-    MXU; bf16 inputs hit the systolic array natively."""
+    MXU; bf16 inputs hit the systolic array natively. Under
+    ``amp.auto_cast`` (trace-time, same contract as :func:`linear`) the
+    conv computes in the amp dtype with f32 accumulation."""
+    from .. import amp
+
     strides = _pair(stride)
     dil = _pair(dilation)
     if isinstance(padding, str):
@@ -112,6 +129,11 @@ def conv2d(
     else:
         ph, pw = _pair(padding)
         pad = [(ph, ph), (pw, pw)]
+    conv_kw = {}
+    if amp.amp_enabled() and x.dtype == jnp.float32:
+        dt = amp.amp_dtype()
+        x, weight = x.astype(dt), weight.astype(dt)
+        conv_kw["preferred_element_type"] = jnp.float32
     y = lax.conv_general_dilated(
         x,
         weight,
@@ -120,6 +142,7 @@ def conv2d(
         rhs_dilation=dil,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=groups,
+        **conv_kw,
     )
     if bias is not None:
         y = y + bias.reshape(1, -1, 1, 1)

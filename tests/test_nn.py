@@ -96,3 +96,43 @@ def test_layer_norm():
     ln = nn.LayerNorm(8)
     y = ln(x)
     assert np.allclose(np.asarray(y.mean(-1)), 0.0, atol=1e-5)
+
+
+def test_auto_cast_linear_and_conv_compute_bf16():
+    """amp.auto_cast's contract: dense ops consult the amp state at
+    trace time — the matmul/conv runs in bf16 with f32 accumulation and
+    the output (and gradients) stay f32."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu import amp
+    from paddle_tpu.nn import functional as F
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(16, 32)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(32, 8)).astype(np.float32))
+    b = jnp.zeros((8,), jnp.float32)
+    ref = F.linear(x, w, b)
+    with amp.auto_cast(enable=True):
+        out = jax.jit(F.linear)(x, w, b)
+        g = jax.jit(jax.grad(lambda w: F.linear(x, w, b).sum()))(w)
+    assert out.dtype == jnp.float32 and g.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-2, atol=2e-2)
+    # the cast must actually be in the traced program (backend-neutral
+    # check: on TPU the DEFAULT precision also rounds to bf16, so value
+    # comparison can't distinguish the paths)
+    with amp.auto_cast(enable=True):
+        jaxpr = str(jax.make_jaxpr(F.linear)(x, w, b))
+    assert "bfloat16" in jaxpr, jaxpr
+    jaxpr_off = str(jax.make_jaxpr(F.linear)(x, w, b))
+    assert "bfloat16" not in jaxpr_off, jaxpr_off
+
+    xc = jnp.asarray(rng.normal(size=(2, 3, 8, 8)).astype(np.float32))
+    wc = jnp.asarray(rng.normal(size=(4, 3, 3, 3)).astype(np.float32))
+    refc = F.conv2d(xc, wc)
+    with amp.auto_cast(enable=True):
+        outc = jax.jit(lambda x, w: F.conv2d(x, w))(xc, wc)
+    assert outc.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(outc), np.asarray(refc),
+                               rtol=5e-2, atol=5e-2)
