@@ -121,11 +121,14 @@ def test_auto_cast_linear_and_conv_compute_bf16():
                                rtol=2e-2, atol=2e-2)
     # the cast must actually be in the traced program (backend-neutral
     # check: on TPU the DEFAULT precision also rounds to bf16, so value
-    # comparison can't distinguish the paths)
+    # comparison can't distinguish the paths). Fresh wrapper per mode:
+    # jax caches traces per function object, so re-tracing F.linear
+    # itself would replay the amp-on jaxpr — the exact trace-time
+    # pitfall auto_cast's docstring warns about.
     with amp.auto_cast(enable=True):
-        jaxpr = str(jax.make_jaxpr(F.linear)(x, w, b))
-    assert "bfloat16" in jaxpr, jaxpr
-    jaxpr_off = str(jax.make_jaxpr(F.linear)(x, w, b))
+        jaxpr_on = str(jax.make_jaxpr(lambda x, w, b: F.linear(x, w, b))(x, w, b))
+    assert "bfloat16" in jaxpr_on, jaxpr_on
+    jaxpr_off = str(jax.make_jaxpr(lambda x, w, b: F.linear(x, w, b))(x, w, b))
     assert "bfloat16" not in jaxpr_off, jaxpr_off
 
     xc = jnp.asarray(rng.normal(size=(2, 3, 8, 8)).astype(np.float32))
