@@ -176,12 +176,10 @@ def main() -> None:
         packed = next(feeder)
         return step(params, opt_state, cache.state, map_state, packed)
 
-    # sync discipline: a tiny D2H fetch, NOT block_until_ready — on the
-    # axon relay block_until_ready can return before the computation
-    # finishes (measured 2026-07-31: 20 chained 8k matmuls "completed"
-    # in 0.4 ms by block, 192 ms by fetch), which would over-report
-    # throughput by the queue tail
-    _sync = lambda x: np.asarray(jax.tree_util.tree_leaves(x)[0].ravel()[:1])
+    # sync discipline: a tiny D2H fetch, NOT block_until_ready, which
+    # the axon relay can satisfy before the computation finishes — THE
+    # shared sync primitive (see its docstring for the measurement)
+    from paddle_tpu.core.profiler import fetch_sync as _sync
 
     from paddle_tpu.amp import auto_cast
 

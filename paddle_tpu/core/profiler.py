@@ -20,6 +20,8 @@ import jax
 
 __all__ = [
     "RecordEvent",
+    "fetch_sync",
+    "timed",
     "record_event",
     "profiler_enabled",
     "start_profiler",
@@ -183,3 +185,51 @@ def host_event_stats() -> Dict[str, Dict[str, float]]:
 
 def reset_host_events() -> None:
     _EVENTS.reset()
+
+
+def fetch_sync(x):
+    """Force completion of ``x`` via a one-element D2H fetch and return
+    that element. THE device-sync primitive for wall-clock measurement:
+    on the axon relay ``jax.block_until_ready`` can return before the
+    computation finishes (MEASURED.md 2026-07-31 — 20 chained 8k
+    matmuls "done" in 0.4 ms by block, 192 ms by fetch), so any timing
+    synced by it silently under-reports."""
+    import numpy as np
+
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    return np.asarray(leaf.ravel()[0:1])
+
+
+def timed(fn, *args, iters: int = 20, _retries: int = 2):
+    """Measure fn's per-call device time: run ``iters`` chained
+    dispatches, fetch-sync once at the end, and subtract the fetch
+    latency (min of 3 samples on an already-ready value — one sample
+    jitters by tens of ms on the tunnel). If the loop total doesn't
+    clear the latency floor (fast op, few iters), retry with 5x iters
+    rather than emit a garbage number; raises RuntimeError when the
+    measurement can't be made trustworthy."""
+    import time as _time
+
+    out = fn(*args)
+    fetch_sync(out)
+    lat = min(_t(lambda: fetch_sync(out)) for _ in range(3))
+    t0 = _time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    fetch_sync(out)
+    dt = _time.perf_counter() - t0 - lat
+    if dt <= lat:  # signal below the sync-latency noise floor
+        if _retries > 0:
+            return timed(fn, *args, iters=iters * 5, _retries=_retries - 1)
+        raise RuntimeError(
+            f"timed(): loop total {dt + lat:.4f}s does not clear the "
+            f"fetch-latency floor {lat:.4f}s at iters={iters}")
+    return dt / iters, out
+
+
+def _t(f):
+    import time as _time
+
+    t0 = _time.perf_counter()
+    f()
+    return _time.perf_counter() - t0

@@ -39,15 +39,12 @@ def _write(payload) -> None:
 
 
 def _timed(fn, *args, iters=20):
-    import jax
+    """paddle_tpu.core.profiler.timed — the shared fetch-synced
+    measurement (block_until_ready lies on the axon relay; see
+    fetch_sync's docstring)."""
+    from paddle_tpu.core.profiler import timed
 
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters, out
+    return timed(fn, *args, iters=iters)
 
 
 def main() -> None:
@@ -176,14 +173,43 @@ def main() -> None:
     def slab_once(packs_d):
         return step_sl(params, opt_state, cache.state, ms, packs_d)[3]
 
-    t_slab, _ = _timed(jax.jit(slab_once), packs_d,
-                       iters=max(2, iters // slab_n))
+    # amp mirror of bench.py: trace the slab step under auto_cast so the
+    # dense tower hits the MXU in bf16 (state/push math stays f32)
+    from paddle_tpu.amp import auto_cast
+
+    with auto_cast(enable=True):
+        t_slab, _ = _timed(jax.jit(slab_once), packs_d,
+                           iters=max(2, iters // slab_n))
     result["legs"]["ctr_slab_step"] = {
-        "batch": batch, "slab": slab_n,
+        "batch": batch, "slab": slab_n, "amp": True,
         "dispatch_ms": round(t_slab * 1e3, 3),
         "per_step_ms": round(t_slab / slab_n * 1e3, 3),
         "device_samples_per_sec": round(batch * slab_n / t_slab, 0),
     }
+
+    # --- leg 2c: push formulations head-to-head (the round-3 redesign:
+    # dense scatter-add + masked full-table update vs the merge_grad-
+    # shaped sort/gather/scatter path, both compiled on hardware) -------
+    import dataclasses as _dc
+
+    from paddle_tpu.ps.embedding_cache import cache_push
+
+    rows_c = jnp.asarray(
+        rng.integers(0, cache_cfg.capacity, size=batch * 26), jnp.int32)
+    grads_c = jnp.asarray(rng.normal(size=(batch * 26, 9)), jnp.float32)
+    shows_c = jnp.ones((batch * 26,), jnp.float32)
+    clicks_c = jnp.asarray(
+        (rng.random(batch * 26) < 0.3).astype(np.float32))
+    leg2c = {}
+    for mode in ("dense", "sparse"):
+        mcfg = _dc.replace(cache_cfg, push_mode=mode)
+        t_push, _ = _timed(
+            jax.jit(lambda st, r, g, s, c, _m=mcfg: cache_push(
+                st, r, g, s, c, _m)),
+            cache.state, rows_c, grads_c, shows_c, clicks_c, iters=iters)
+        leg2c[mode] = round(t_push * 1e3, 3)
+    result["legs"]["cache_push_modes_ms"] = {
+        "rows": batch * 26, "capacity": cache_cfg.capacity, **leg2c}
 
     # --- leg 3: transformer step at realistic hidden + MFU --------------
     from paddle_tpu import nn
