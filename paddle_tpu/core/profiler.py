@@ -201,35 +201,39 @@ def fetch_sync(x):
 
 
 def timed(fn, *args, iters: int = 20, _retries: int = 2):
-    """Measure fn's per-call device time: run ``iters`` chained
-    dispatches, fetch-sync once at the end, and subtract the fetch
-    latency (min of 3 samples on an already-ready value — one sample
-    jitters by tens of ms on the tunnel). If the loop total doesn't
-    clear the latency floor (fast op, few iters), retry with 5x iters
-    rather than emit a garbage number; raises RuntimeError when the
-    measurement can't be made trustworthy."""
-    import time as _time
+    """Measure fn's per-call device time: enqueue ``iters`` dispatches,
+    fetch-sync once at the end, and subtract the fetch latency (min of
+    3 samples on an already-ready value — one sample jitters by tens of
+    ms on the tunnel). The dispatches are independent, but a single
+    final fetch still bounds them all: one chip executes enqueued XLA
+    programs in order on its execution stream (the relay forwards one
+    queue), so the last output materializing implies every earlier
+    launch retired — the relay's unreliable *readiness* signaling
+    (fetch_sync's reason to exist) does not reorder execution.
 
+    Signal-to-noise gate: the loop total must exceed 2x the fetch
+    latency (dt <= lat after subtraction means op time is below the
+    sync noise); retries with 5x iters, then raises RuntimeError rather
+    than emit a garbage number."""
     out = fn(*args)
     fetch_sync(out)
     lat = min(_t(lambda: fetch_sync(out)) for _ in range(3))
-    t0 = _time.perf_counter()
+    t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
     fetch_sync(out)
-    dt = _time.perf_counter() - t0 - lat
-    if dt <= lat:  # signal below the sync-latency noise floor
+    dt = time.perf_counter() - t0 - lat
+    if dt <= lat:  # loop total <= 2x latency: below the noise floor
         if _retries > 0:
             return timed(fn, *args, iters=iters * 5, _retries=_retries - 1)
         raise RuntimeError(
-            f"timed(): loop total {dt + lat:.4f}s does not clear the "
-            f"fetch-latency floor {lat:.4f}s at iters={iters}")
+            f"timed(): loop total {dt + lat:.4f}s is within 2x the fetch-"
+            f"latency noise floor ({lat:.4f}s) at iters={iters}; op too "
+            "fast to resolve over this link")
     return dt / iters, out
 
 
 def _t(f):
-    import time as _time
-
-    t0 = _time.perf_counter()
+    t0 = time.perf_counter()
     f()
-    return _time.perf_counter() - t0
+    return time.perf_counter() - t0
