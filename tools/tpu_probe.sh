@@ -26,6 +26,11 @@ print('devices:', d)
       BENCH_SLAB=$SLAB BENCH_INIT_TIMEOUT=300 timeout 1200 python bench.py \
         >>BENCH_SLAB_SWEEP.jsonl 2>>"$LOG"
     done
+    # batch sweep: per-sample overheads fall with batch; wire grows
+    for BATCH in 8192 16384; do
+      BENCH_BATCH=$BATCH BENCH_INIT_TIMEOUT=300 timeout 1200 python bench.py \
+        >>BENCH_BATCH_SWEEP.jsonl 2>>"$LOG"
+    done
     timeout 2400 python tools/tpu_smoke.py >TPU_SMOKE.json 2>>"$LOG"
     echo "$ts evidence captured" >>"$LOG"
     touch RECOVERED.flag
