@@ -89,6 +89,26 @@ def main() -> None:
     print(f"bench: backend={devs[0].platform} devices={len(devs)}",
           file=sys.stderr)
 
+    # overall deadline AFTER init: a run that wedges mid-measurement
+    # self-exits with a diagnostic JSON instead of being externally
+    # killed — an external SIGTERM on a grant-holding process is what
+    # wedges the relay (MEASURED.md 2026-07-31). Self-exit closes the
+    # process bottom-up and is the least-bad bounded option.
+    import threading
+
+    deadline = float(os.environ.get("BENCH_DEADLINE", 900))
+
+    def _expire():
+        _emit(0.0, 0.0, error=f"run exceeded BENCH_DEADLINE={deadline:.0f}s "
+                              "after successful init (device wedged "
+                              "mid-run?)")
+        sys.stdout.flush()
+        os._exit(0)
+
+    timer = threading.Timer(deadline, _expire)
+    timer.daemon = True
+    timer.start()
+
     import paddle_tpu as pt
     from paddle_tpu import optimizer
     from paddle_tpu.models.ctr import (CtrConfig, DeepFM, pack_ctr_batch,

@@ -1,0 +1,225 @@
+"""DeepWalk / skip-gram graph embeddings over the sparse PS path.
+
+The reference's graph-learning loop (graph4rec): ``GraphDataGenerator``
+(`/root/reference/paddle/fluid/framework/data_feed.cc` gpu_graph mode)
+pulls deepwalk-style random walks from the GPU graph table
+(`fleet/heter_ps/graph_gpu_ps_table.h`), windows them into skip-gram
+pairs on device, and feeds them to a sparse-embedding model trained
+through the PS (`ps_gpu_wrapper.cc` PullSparse/PushSparseGrad). Here
+that whole loop is ONE jitted XLA program per step:
+
+  walk (lax.scan over the DeviceGraph) → window pairing (static
+  shifts) → negative draws → cuckoo key→row probe → cache_pull →
+  SGNS loss fwd/bwd → cache_push
+
+Two logical embedding tables (skip-gram's input/center and
+output/context matrices) live in ONE HbmEmbeddingCache by slot-tagging
+the node key's high half (center = slot 0, context = slot 1) — the
+same slot-tagged key layout the CTR steps use, so the pass lifecycle,
+flush-back, checkpointing and the sharded/routed serving paths all
+apply unchanged.
+
+Negative sampling: uniform over the pass's node pool, drawn in-graph
+from the pool key arrays (the generator's neg-sample table role).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.enforce import enforce
+from ..ops.device_graph import DeviceGraph
+from ..ps.embedding_cache import CacheConfig, cache_pull, cache_push
+from ..ps.device_hash import device_hash_lookup
+
+__all__ = ["DeepWalkConfig", "tag_center", "tag_context",
+           "make_deepwalk_train_step", "init_node_embeddings",
+           "node_embeddings", "link_prediction_auc"]
+
+CENTER_SLOT = np.uint32(0)
+CONTEXT_SLOT = np.uint32(1)
+
+
+@dataclasses.dataclass
+class DeepWalkConfig:
+    walk_len: int = 8          # steps per walk (walk has walk_len+1 nodes)
+    window: int = 2            # skip-gram window radius
+    negatives: int = 4         # negative draws per positive pair
+    embed_dim: int = 16        # must equal cache embedx_dim
+
+
+def tag_center(nodes: np.ndarray) -> np.ndarray:
+    """uint64 feasigns for the center/input embedding table."""
+    return (np.uint64(CENTER_SLOT) << np.uint64(32)) | np.asarray(
+        nodes, np.uint64)
+
+
+def tag_context(nodes: np.ndarray) -> np.ndarray:
+    """uint64 feasigns for the context/output embedding table."""
+    return (np.uint64(CONTEXT_SLOT) << np.uint64(32)) | np.asarray(
+        nodes, np.uint64)
+
+
+def _pairs_from_walks(wh, wl, live, window: int):
+    """Static-shift window pairing: walks [B, T] → (center, context,
+    valid) each [B, T-1, 2*window] as (hi, lo) pairs. Pair (t, t+d) is
+    valid when the walk was still live at t+d (dead ends freeze and
+    must not produce self-pairs); both directions are emitted."""
+    B, T = wh.shape
+    ch, cl, xh, xl, ok = [], [], [], [], []
+    for d in range(1, window + 1):
+        if d >= T:
+            break
+        # forward: center t, context t+d
+        v = live[:, d:]
+        ch.append(wh[:, :-d]); cl.append(wl[:, :-d])
+        xh.append(wh[:, d:]); xl.append(wl[:, d:])
+        ok.append(v)
+        # backward: center t+d, context t
+        ch.append(wh[:, d:]); cl.append(wl[:, d:])
+        xh.append(wh[:, :-d]); xl.append(wl[:, :-d])
+        ok.append(v)
+    pad = lambda a: jnp.pad(a, ((0, 0), (0, (T - 1) - a.shape[1])))
+    cat = lambda xs: jnp.stack([pad(x) for x in xs], axis=2)
+    return (cat(ch), cat(cl), cat(xh), cat(xl),
+            cat([o.astype(jnp.float32) for o in ok]))
+
+
+def make_deepwalk_train_step(
+    graph: DeviceGraph,
+    cache_cfg: CacheConfig,
+    cfg: DeepWalkConfig,
+    pool_lo: np.ndarray,  # [N] low-32 halves of the pass's node ids
+    donate: bool = True,
+) -> Callable:
+    """Build the jitted walk→pair→SGNS→push step:
+
+    step(cache_state, map_state, start_lo, rng)
+      → (cache_state, loss)
+
+    ``start_lo``: [B] low-32 node ids to start walks from (node ids are
+    assumed < 2^32, the graph-table convention; the slot tag supplies
+    the high half). ``map_state``: the embedding cache's device key map
+    (both tagged key sets must be in the pass). The whole graph walk +
+    training is one XLA program — there is no host work per step.
+    """
+    enforce(cfg.embed_dim == cache_cfg.embedx_dim,
+            "DeepWalkConfig.embed_dim must equal cache embedx_dim")
+    W, K, L = int(cfg.window), int(cfg.negatives), int(cfg.walk_len)
+    pool_lo_d = jnp.asarray(np.asarray(pool_lo, np.uint32))
+    gstate = graph.state
+
+    def step(cache_state, map_state, start_lo, rng):
+        B = start_lo.shape[0]
+        r_walk, r_neg = jax.random.split(rng)
+        hi0 = jnp.zeros((B,), jnp.uint32)  # raw node keys walk the graph
+        wh, wl, live = DeviceGraph.random_walk(
+            gstate, r_walk, hi0, start_lo.astype(jnp.uint32), L)
+        ch, cl, xh, xl, valid = _pairs_from_walks(wh, wl, live, W)
+        # [B, T-1, 2W] → flat [P]
+        P = ch.size
+        cl_f = cl.reshape(-1)
+        xl_f = xl.reshape(-1)
+        valid_f = valid.reshape(-1)
+
+        # negatives: uniform over the pool per positive pair
+        neg_idx = jax.random.randint(r_neg, (P, K), 0, pool_lo_d.shape[0])
+        nl_f = pool_lo_d[neg_idx]  # [P, K]
+
+        C = cache_state["embed_w"].shape[0]
+
+        def rows_of(tag, lo):
+            hi = jnp.full(lo.shape, tag, jnp.uint32)
+            r = device_hash_lookup(map_state, hi.reshape(-1), lo.reshape(-1))
+            return jnp.where(r >= 0, r, C).reshape(lo.shape)
+
+        rows_c = rows_of(np.uint32(CENTER_SLOT), cl_f)          # [P]
+        rows_x = rows_of(np.uint32(CONTEXT_SLOT), xl_f)         # [P]
+        rows_n = rows_of(np.uint32(CONTEXT_SLOT), nl_f)         # [P, K]
+
+        all_rows = jnp.concatenate(
+            [rows_c, rows_x, rows_n.reshape(-1)])
+
+        def loss_fn(pulled):
+            d = cfg.embed_dim
+            vc = pulled[:P, 1:1 + d]                            # centers
+            vx = pulled[P:2 * P, 1:1 + d]                       # contexts
+            vn = pulled[2 * P:, 1:1 + d].reshape(P, K, d)       # negatives
+            pos = jnp.sum(vc * vx, axis=-1)
+            neg = jnp.einsum("pd,pkd->pk", vc, vn)
+            # SGNS: -log σ(pos) - Σ log σ(-neg), masked by pair validity.
+            # SUM over pairs, not mean: word2vec applies the full
+            # gradient per (center, context) sample, and the sparse
+            # AdaGrad's show-scale already averages over a key's
+            # appearances — a mean here would shrink every update by
+            # the pair count and freeze training.
+            per = (jax.nn.softplus(-pos)
+                   + jnp.sum(jax.nn.softplus(neg), axis=-1))
+            total = jnp.sum(per * valid_f)
+            return total, total / jnp.maximum(jnp.sum(valid_f), 1.0)
+
+        pulled = cache_pull(cache_state, all_rows)
+        (_, loss), g_pulled = jax.value_and_grad(
+            loss_fn, has_aux=True)(pulled)
+
+        # push: show=1 per valid appearance (negatives count as
+        # appearances of the context table — the generator pushes every
+        # touched key), click=0 (no click semantics for graphs)
+        shows = jnp.concatenate(
+            [valid_f, valid_f, jnp.repeat(valid_f, K)])
+        clicks = jnp.zeros_like(shows)
+        new_cache = cache_push(cache_state, all_rows, g_pulled, shows,
+                               clicks, cache_cfg)
+        return new_cache, loss
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def init_node_embeddings(table, nodes: np.ndarray, rng: np.random.Generator,
+                         scale: float = 0.1) -> None:
+    """Force-create both tagged tables' rows with uniform ±scale embedx
+    (word2vec-style init). SGNS is purely bilinear — zero-initialized
+    embeddings are an exact saddle (every gradient is zero), so the
+    device path's lazy zero-create can never start learning; the
+    reference's graph models likewise random-init their embedding
+    matrices. Call once before the first ``begin_pass``."""
+    acc = table.accessor
+    es = acc.embed_rule.state_dim
+    xd = acc.config.embedx_dim
+    for tag in (tag_center, tag_context):
+        keys = tag(nodes)
+        vals, _ = table.export_full(keys, create=True)
+        vals[:, 6 + es] = 1.0  # has_embedx
+        vals[:, 7 + es: 7 + es + xd] = rng.uniform(
+            -scale, scale, (len(keys), xd)).astype(np.float32)
+        table.import_full(keys, vals)
+
+
+def node_embeddings(cache, nodes: np.ndarray) -> np.ndarray:
+    """Pull the center-table embeddings for ``nodes`` (host-side eval
+    helper; uses the cache's host index)."""
+    rows = cache.lookup(tag_center(nodes))
+    emb = cache_pull(cache.state, jnp.asarray(rows, jnp.int32))
+    return np.asarray(emb)[:, 1:]
+
+
+def link_prediction_auc(cache, edges: np.ndarray,
+                        non_edges: np.ndarray) -> float:
+    """AUC of cos-similarity scores: true edges vs non-edges (the
+    standard deepwalk eval; both inputs are [n, 2] node-id arrays)."""
+    def score(pairs):
+        a = node_embeddings(cache, pairs[:, 0])
+        b = node_embeddings(cache, pairs[:, 1])
+        na = np.linalg.norm(a, axis=1) + 1e-9
+        nb = np.linalg.norm(b, axis=1) + 1e-9
+        return np.sum(a * b, axis=1) / (na * nb)
+
+    pos, neg = score(edges), score(non_edges)
+    # exact pairwise AUC (small eval sets)
+    return float(np.mean((pos[:, None] > neg[None, :]).astype(np.float64)
+                         + 0.5 * (pos[:, None] == neg[None, :])))

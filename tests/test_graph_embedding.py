@@ -1,0 +1,122 @@
+"""DeepWalk skip-gram over the sparse PS path (models/graph_embedding):
+the GraphDataGenerator → sparse-training loop of the reference's graph
+stack (data_feed gpu_graph mode + graph_gpu_ps_table walks feeding
+PullSparse/PushSparseGrad) as one jitted step — walks, window pairing,
+negative sampling, pull, SGNS, push, all in-graph."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.models.graph_embedding import (DeepWalkConfig,
+                                               init_node_embeddings,
+                                               link_prediction_auc,
+                                               make_deepwalk_train_step,
+                                               node_embeddings, tag_center,
+                                               tag_context)
+from paddle_tpu.ops.device_graph import DeviceGraph
+from paddle_tpu.ps.accessor import AccessorConfig
+from paddle_tpu.ps.embedding_cache import CacheConfig, HbmEmbeddingCache
+from paddle_tpu.ps.graph_table import GraphTable
+from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+
+def _two_clique_graph(k=10, bridge=1):
+    """Two k-cliques (nodes 0..k-1 and k..2k-1) joined by `bridge`
+    edges — walks mix within communities, rarely across."""
+    g = GraphTable(shard_num=4, seed=0)
+    src, dst = [], []
+    for base in (0, k):
+        for i in range(k):
+            for j in range(k):
+                if i != j:
+                    src.append(base + i)
+                    dst.append(base + j)
+    for b in range(bridge):
+        src += [b, k + b]
+        dst += [k + b, b]
+    g.add_graph_node(list(range(2 * k)))
+    g.add_edges(src, dst)
+    return g
+
+
+def _setup(rng, k=10, dim=16):
+    g = _two_clique_graph(k)
+    nodes = np.arange(2 * k, dtype=np.uint64)
+    dgraph = DeviceGraph.from_graph_table(g, max_deg=32)
+
+    sgd = SGDRuleConfig(learning_rate=0.3, initial_g2sum=1.0)
+    acc = AccessorConfig(embedx_dim=dim, embedx_threshold=0.0, sgd=sgd)
+    table = MemorySparseTable(TableConfig(shard_num=4, accessor_config=acc))
+    cache_cfg = CacheConfig(capacity=1 << 8, embedx_dim=dim,
+                            embedx_threshold=0.0, sgd=sgd)
+    init_node_embeddings(table, nodes, rng, scale=0.1)
+    cache = HbmEmbeddingCache(table, cache_cfg, device_map=True)
+    cache.begin_pass(np.concatenate([tag_center(nodes), tag_context(nodes)]))
+    return g, dgraph, table, cache, cache_cfg, nodes
+
+
+def test_deepwalk_learns_communities(rng):
+    k, dim = 10, 16
+    g, dgraph, table, cache, cache_cfg, nodes = _setup(rng, k, dim)
+    cfg = DeepWalkConfig(walk_len=6, window=2, negatives=4, embed_dim=dim)
+    step = make_deepwalk_train_step(dgraph, cache_cfg, cfg,
+                                    pool_lo=nodes.astype(np.uint32))
+    ms = cache.device_map.state
+
+    key = jax.random.PRNGKey(0)
+    losses = []
+    for it in range(60):
+        key, k1, k2 = jax.random.split(key, 3)
+        starts = jnp.asarray(
+            jax.random.randint(k1, (64,), 0, 2 * k), jnp.uint32)
+        cache.state, loss = step(cache.state, ms, starts, k2)
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, (losses[0], losses[-1])
+
+    # link prediction: intra-clique edges vs cross-clique non-edges
+    intra = np.array([[i, j] for i in range(k) for j in range(k) if i != j]
+                     + [[k + i, k + j] for i in range(k) for j in range(k)
+                        if i != j])
+    cross = np.array([[i, k + j] for i in range(2, k) for j in range(2, k)])
+    auc = link_prediction_auc(cache, intra, cross)
+    assert auc > 0.8, auc
+
+    # flush-back: embeddings survive the pass lifecycle
+    cache.end_pass()
+    cache.begin_pass(np.concatenate([tag_center(nodes), tag_context(nodes)]))
+    auc2 = link_prediction_auc(cache, intra, cross)
+    np.testing.assert_allclose(auc2, auc, atol=1e-6)
+
+
+def test_deepwalk_dead_end_pairs_masked(rng):
+    """An isolated node's walk freezes at the start; its pairs must be
+    fully masked — a push from a frozen self-pair would train
+    center==context and corrupt the table."""
+    g = GraphTable(shard_num=2, seed=0)
+    g.add_graph_node([0, 1, 2])
+    g.add_edges([0, 1], [1, 0])  # node 2 isolated
+    nodes = np.arange(3, dtype=np.uint64)
+    dgraph = DeviceGraph.from_graph_table(g, max_deg=4)
+    dim = 8
+    sgd = SGDRuleConfig(learning_rate=0.2)
+    acc = AccessorConfig(embedx_dim=dim, embedx_threshold=0.0, sgd=sgd)
+    table = MemorySparseTable(TableConfig(shard_num=2, accessor_config=acc))
+    cache_cfg = CacheConfig(capacity=1 << 6, embedx_dim=dim,
+                            embedx_threshold=0.0, sgd=sgd)
+    init_node_embeddings(table, nodes, rng, scale=0.1)
+    cache = HbmEmbeddingCache(table, cache_cfg, device_map=True)
+    cache.begin_pass(np.concatenate([tag_center(nodes), tag_context(nodes)]))
+    before = node_embeddings(cache, np.array([2], np.uint64)).copy()
+
+    cfg = DeepWalkConfig(walk_len=4, window=2, negatives=0, embed_dim=dim)
+    step = make_deepwalk_train_step(dgraph, cache_cfg, cfg,
+                                    pool_lo=nodes.astype(np.uint32))
+    starts = jnp.asarray(np.array([2, 2, 2, 2], np.uint32))
+    cache.state, loss = step(cache.state, cache.device_map.state, starts,
+                             jax.random.PRNGKey(1))
+    after = node_embeddings(cache, np.array([2], np.uint64))
+    np.testing.assert_array_equal(before, after)
