@@ -20,6 +20,7 @@ maps almost one-to-one onto GSPMD:
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import jax
@@ -32,7 +33,8 @@ from .. import nn
 from ..optimizer import Optimizer
 
 __all__ = ["ProcessMesh", "shard_tensor", "shard_op", "annotate",
-           "complete_shardings", "reshard", "plan_strategy", "Engine"]
+           "complete_shardings", "reshard", "plan_strategy", "Engine",
+           "ClusterSpec", "estimate_plan_cost", "choose_strategy"]
 
 
 class ProcessMesh:
@@ -255,6 +257,40 @@ def complete_shardings(
     return specs
 
 
+def _mp_annotations(model, mp: int) -> Dict[str, Sequence[Optional[int]]]:
+    """The planner's hint rule, shared by :func:`plan_strategy` and
+    :func:`choose_strategy`: large Linears in alternating Megatron
+    col/row pairs, Embeddings vocab- or hidden-parallel; completion
+    fills the rest. Only dims divisible by mp qualify."""
+    from ..nn.layers import Embedding, Linear
+
+    annotations: Dict[str, Sequence[Optional[int]]] = {}
+    sizes = [int(np.prod(l._parameters["weight"].shape))
+             for _, l in _named_leaf_layers(model)
+             if isinstance(l, (Linear, Embedding))
+             and "weight" in l._parameters]
+    threshold = max(sizes, default=0) // 4
+    col_next = True
+    for name, layer in _named_leaf_layers(model):
+        w = layer._parameters.get("weight")
+        wn = f"{name}.weight" if name else "weight"
+        if w is None or int(np.prod(w.shape)) < threshold:
+            continue
+        if isinstance(layer, Linear):
+            if col_next and w.shape[1] % mp == 0:
+                annotations[wn] = [-1, 1]   # column-parallel
+                col_next = False
+            elif not col_next and w.shape[0] % mp == 0:
+                annotations[wn] = [1, -1]   # row-parallel partner
+                col_next = True
+        elif isinstance(layer, Embedding):
+            if w.shape[0] % mp == 0:
+                annotations[wn] = [1, -1]   # vocab-parallel
+            elif w.shape[1] % mp == 0:
+                annotations[wn] = [-1, 1]   # hidden-parallel
+    return annotations
+
+
 def plan_strategy(model, n_devices: Optional[int] = None,
                   per_device_bytes: float = 16e9,
                   state_multiplier: float = 4.0,
@@ -293,34 +329,7 @@ def plan_strategy(model, n_devices: Optional[int] = None,
 
     annotations: Dict[str, Sequence[Optional[int]]] = {}
     if mp > 1:
-        # hint the large shardable weights (Linears in alternating
-        # col/row Megatron pairs, Embeddings vocab- or hidden-parallel);
-        # completion fills the rest. Only dims divisible by mp qualify.
-        from ..nn.layers import Embedding, Linear
-
-        sizes = [int(np.prod(l._parameters["weight"].shape))
-                 for _, l in _named_leaf_layers(model)
-                 if isinstance(l, (Linear, Embedding))
-                 and "weight" in l._parameters]
-        threshold = max(sizes, default=0) // 4
-        col_next = True
-        for name, layer in _named_leaf_layers(model):
-            w = layer._parameters.get("weight")
-            wn = f"{name}.weight" if name else "weight"
-            if w is None or int(np.prod(w.shape)) < threshold:
-                continue
-            if isinstance(layer, Linear):
-                if col_next and w.shape[1] % mp == 0:
-                    annotations[wn] = [-1, 1]   # column-parallel
-                    col_next = False
-                elif not col_next and w.shape[0] % mp == 0:
-                    annotations[wn] = [1, -1]   # row-parallel partner
-                    col_next = True
-            elif isinstance(layer, Embedding):
-                if w.shape[0] % mp == 0:
-                    annotations[wn] = [1, -1]   # vocab-parallel
-                elif w.shape[1] % mp == 0:
-                    annotations[wn] = [-1, 1]   # hidden-parallel
+        annotations = _mp_annotations(model, mp)
         if not annotations:
             # nothing shardable at this mp (odd dims, embedding-free
             # budget blowup): an mp the plan cannot use would halve dp
@@ -329,6 +338,157 @@ def plan_strategy(model, n_devices: Optional[int] = None,
     dp = devs // mp
     mesh = ProcessMesh(shape=(dp, mp), dim_names=("dp", "mp"))
     return mesh, annotations
+
+
+@dataclasses.dataclass
+class ClusterSpec:
+    """The reference ``auto_parallel/cluster.py`` role: what the cost
+    model needs to know about the machine — per-axis interconnect
+    bandwidth. Convention: when ``hosts > 1`` the OUTERMOST mesh axis
+    is the one laid across hosts (jax device order enumerates
+    host-major), so that axis's collectives ride DCN; every inner axis
+    rides ICI."""
+
+    ici_gbytes_per_s: float = 90.0   # v5e all-reduce effective BW/chip
+    dcn_gbytes_per_s: float = 6.0    # typical inter-host effective BW
+    hosts: int = 1
+
+    def axis_bw(self, axis_index: int, axis_size: int) -> float:
+        if axis_size <= 1:
+            return float("inf")
+        if self.hosts > 1 and axis_index == 0:
+            return self.dcn_gbytes_per_s
+        return self.ici_gbytes_per_s
+
+
+def estimate_plan_cost(model, mesh: ProcessMesh,
+                       annotations: Dict[str, Sequence[Optional[int]]],
+                       batch_tokens: int,
+                       cluster: Optional[ClusterSpec] = None,
+                       state_multiplier: float = 4.0) -> Dict[str, float]:
+    """Analytic per-step cost of a (mesh, annotations) plan — the
+    reference cost model's estimate (``auto_parallel/cost_model.py``,
+    ``cost/comm_op_cost.py``) in closed form for the two dominant
+    collectives of a dp x mp plan:
+
+    - dp gradient all-reduce: ring volume 2·(dp-1)/dp · param_bytes
+      over the dp axis's link (mp-sharded tensors all-reduce only their
+      1/mp shard);
+    - mp activation all-reduce: each column->row Megatron pair psums a
+      [batch_tokens, out_dim] activation in fwd and its gradient in bwd
+      (2 x ring volume), where out_dim is the row-parallel layer's
+      output width.
+
+    Returns an auditable dict: bytes and seconds per term plus
+    ``per_device_state_bytes`` (the memory-fit input) and ``total_s``.
+    Absolute numbers are estimates; their ORDER over candidate plans is
+    what ``choose_strategy`` consumes — the reference's cost model has
+    the same contract.
+    """
+    cluster = cluster or ClusterSpec()
+    dims = dict(zip(mesh.dim_names, mesh.shape))
+    dp = int(dims.get("dp", 1))
+    mp = int(dims.get("mp", 1))
+    names = list(mesh.dim_names)
+    dp_ax = names.index("dp") if "dp" in names else 0
+    mp_ax = names.index("mp") if "mp" in names else 1
+
+    params = dict(model.named_parameters())
+    sharded_bytes = 0.0
+    unsharded_bytes = 0.0
+    for name, p in params.items():
+        b = float(int(np.prod(p.shape)) * np.dtype(p.dtype).itemsize)
+        sharded = name in annotations and any(
+            d is not None and d >= 0
+            for d in annotations[name])
+        if sharded:
+            sharded_bytes += b
+        else:
+            unsharded_bytes += b
+    # mp shards only the ANNOTATED tensors (completion shards a few
+    # more — partners, biases — so this memory estimate is conservative,
+    # never optimistic); grads all-reduce at the same granularity
+    dp_grad_bytes = sharded_bytes / mp + unsharded_bytes
+    ring = lambda n: 2.0 * (n - 1) / n if n > 1 else 0.0
+    dp_s = (ring(dp) * dp_grad_bytes
+            / (cluster.axis_bw(dp_ax, dp) * 1e9))
+
+    # one fwd psum + one bwd psum per column->row pair, activation width
+    # = the ROW layer's output dim (its [in, out][1])
+    mp_act_bytes = 0.0
+    if mp > 1:
+        for name, spec in annotations.items():
+            p = params.get(name)
+            if p is None or len(p.shape) != 2:
+                continue
+            if list(spec)[:2] == [1, -1] or list(spec)[:2] == [1, None]:
+                # row-parallel: output [batch_tokens, out] is psummed
+                mp_act_bytes += 2.0 * batch_tokens * int(p.shape[1]) * 4.0
+        # dp shards the batch: each mp group psums its local batch slice
+        mp_act_bytes /= max(dp, 1)
+    mp_s = (ring(mp) * mp_act_bytes
+            / (cluster.axis_bw(mp_ax, mp) * 1e9))
+
+    per_device_state = (sharded_bytes / mp + unsharded_bytes) * state_multiplier
+    return {
+        "dp": dp, "mp": mp,
+        "dp_allreduce_bytes": dp_grad_bytes * ring(dp),
+        "dp_allreduce_s": dp_s,
+        "mp_activation_bytes": mp_act_bytes * ring(mp),
+        "mp_activation_s": mp_s,
+        "per_device_state_bytes": per_device_state,
+        "total_s": dp_s + mp_s,
+    }
+
+
+def choose_strategy(model, batch_tokens: int,
+                    n_devices: Optional[int] = None,
+                    per_device_bytes: float = 16e9,
+                    cluster: Optional[ClusterSpec] = None,
+                    state_multiplier: float = 4.0,
+                    ) -> Tuple[ProcessMesh,
+                               Dict[str, Sequence[Optional[int]]],
+                               List[Dict[str, float]]]:
+    """The Planner's cost-model search (reference planner_v2 + cost
+    model, ``auto_parallel/planner_v2.py``/``cost_model.py``): enumerate
+    every power-of-two (dp, mp) factorization of the device count,
+    derive each one's dist-attr hints (the same rule
+    :func:`plan_strategy` applies), drop plans that don't fit
+    ``per_device_bytes`` or can't actually shard anything at their mp,
+    and return the feasible plan with the lowest estimated step comm
+    time. Also returns the full scored candidate list (auditable — the
+    reference logs the same).
+
+    When nothing fits, falls back to the MEMORY-minimizing candidate
+    (largest usable mp — plan_strategy's escalation behavior), since
+    memory, not comms, is then the binding constraint."""
+    devs = n_devices if n_devices is not None else len(jax.devices())
+    cluster = cluster or ClusterSpec()
+    candidates: List[Dict[str, float]] = []
+    plans = {}
+    mp = 1
+    while mp <= devs:
+        if devs % mp == 0:
+            mesh = ProcessMesh(shape=(devs // mp, mp),
+                               dim_names=("dp", "mp"))
+            ann = _mp_annotations(model, mp) if mp > 1 else {}
+            if mp == 1 or ann:  # an mp that shards nothing is not a plan
+                cost = estimate_plan_cost(model, mesh, ann, batch_tokens,
+                                          cluster, state_multiplier)
+                cost["fits"] = bool(
+                    cost["per_device_state_bytes"] <= per_device_bytes)
+                candidates.append(cost)
+                plans[(devs // mp, mp)] = (mesh, ann)
+        mp *= 2
+    feasible = [c for c in candidates if c["fits"]]
+    if feasible:
+        best = min(feasible, key=lambda c: c["total_s"])
+    else:
+        # nothing fits: minimize MEMORY, not comms — the binding
+        # constraint decides (plan_strategy's max-usable-mp behavior)
+        best = min(candidates, key=lambda c: c["per_device_state_bytes"])
+    mesh, ann = plans[(int(best["dp"]), int(best["mp"]))]
+    return mesh, ann, candidates
 
 
 def reshard(x, process_mesh: ProcessMesh,

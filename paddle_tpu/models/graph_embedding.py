@@ -138,9 +138,17 @@ def make_deepwalk_train_step(
             r = device_hash_lookup(map_state, hi.reshape(-1), lo.reshape(-1))
             return jnp.where(r >= 0, r, C).reshape(lo.shape)
 
-        rows_c = rows_of(np.uint32(CENTER_SLOT), cl_f)          # [P]
-        rows_x = rows_of(np.uint32(CONTEXT_SLOT), xl_f)         # [P]
-        rows_n = rows_of(np.uint32(CONTEXT_SLOT), nl_f)         # [P, K]
+        # invalid pairs (dead-end masked AND the zero-padding of short
+        # window shifts) force the sentinel row: a padded lo of 0 would
+        # otherwise resolve to REAL node 0's row, whose optimizer state
+        # a decaying rule (Adam) would spuriously advance every step
+        live_pair = valid_f > 0
+        rows_c = jnp.where(live_pair,
+                           rows_of(np.uint32(CENTER_SLOT), cl_f), C)
+        rows_x = jnp.where(live_pair,
+                           rows_of(np.uint32(CONTEXT_SLOT), xl_f), C)
+        rows_n = jnp.where(live_pair[:, None],
+                           rows_of(np.uint32(CONTEXT_SLOT), nl_f), C)
 
         all_rows = jnp.concatenate(
             [rows_c, rows_x, rows_n.reshape(-1)])

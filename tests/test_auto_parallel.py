@@ -323,3 +323,80 @@ class TestPlanner:
                                        per_device_bytes=pbytes * 2.5)
         assert mesh.jax_mesh.shape["mp"] == 2
         assert ann.get("emb.weight") == [1, -1]  # vocab-parallel
+
+
+class TestCostModel:
+    """choose_strategy + estimate_plan_cost — the reference planner's
+    cost-model search (planner_v2.py + cost_model.py): enumerate
+    feasible (dp, mp) factorizations, score estimated step comm time,
+    pick the cheapest that fits memory."""
+
+    def test_roomy_budget_prefers_pure_dp(self):
+        mesh, ann, cands = auto.choose_strategy(
+            _Mlp(), batch_tokens=4096, n_devices=8, per_device_bytes=16e9)
+        assert mesh.jax_mesh.shape == {"dp": 8, "mp": 1}
+        assert ann == {}
+        # the candidate list is the auditable scoreboard
+        assert any(c["mp"] > 1 for c in cands)
+        pure = next(c for c in cands if c["mp"] == 1)
+        assert all(pure["total_s"] <= c["total_s"] for c in cands
+                   if c["fits"])
+
+    def test_tight_budget_picks_cheapest_feasible(self):
+        m = _Mlp(d=16, h=32)
+        pbytes = sum(int(np.prod(p.shape)) * 4
+                     for _, p in m.named_parameters())
+        mesh, ann, cands = auto.choose_strategy(
+            m, batch_tokens=64, n_devices=8,
+            per_device_bytes=pbytes * 2.5)
+        assert mesh.jax_mesh.shape["mp"] >= 2
+        assert ann
+        chosen = next(c for c in cands
+                      if c["dp"] == mesh.jax_mesh.shape["dp"]
+                      and c["mp"] == mesh.jax_mesh.shape["mp"])
+        assert chosen["fits"]
+        feas = [c for c in cands if c["fits"]]
+        assert all(chosen["total_s"] <= c["total_s"] for c in feas)
+        # memory estimate actually shrinks with mp
+        by_mp = {c["mp"]: c["per_device_state_bytes"] for c in cands}
+        assert by_mp[2] < by_mp[1]
+
+    def test_cross_host_dp_charges_dcn(self):
+        """With the dp axis laid across hosts, the same plan's dp
+        all-reduce must cost more than single-host — the cluster spec
+        is load-bearing, not decorative."""
+        m = _Mlp()
+        one = auto.estimate_plan_cost(
+            m, auto.ProcessMesh(shape=(8, 1), dim_names=("dp", "mp")),
+            {}, batch_tokens=4096, cluster=auto.ClusterSpec(hosts=1))
+        two = auto.estimate_plan_cost(
+            m, auto.ProcessMesh(shape=(8, 1), dim_names=("dp", "mp")),
+            {}, batch_tokens=4096, cluster=auto.ClusterSpec(hosts=2))
+        assert two["dp_allreduce_s"] > one["dp_allreduce_s"] * 5
+        assert two["dp_allreduce_bytes"] == one["dp_allreduce_bytes"]
+
+    def test_mp_cost_scales_with_batch(self):
+        m = _Mlp(d=16, h=32)
+        mesh = auto.ProcessMesh(shape=(4, 2), dim_names=("dp", "mp"))
+        ann = {"fc2.weight": [1, -1]}  # row-parallel: psums activations
+        small = auto.estimate_plan_cost(m, mesh, ann, batch_tokens=64)
+        big = auto.estimate_plan_cost(m, mesh, ann, batch_tokens=6400)
+        assert big["mp_activation_s"] > small["mp_activation_s"] * 50
+        # dp all-reduce is batch-independent
+        assert big["dp_allreduce_s"] == small["dp_allreduce_s"]
+
+    def test_chosen_plan_trains_end_to_end(self):
+        pt.seed(0)
+        m = _Mlp()
+        pbytes = sum(int(np.prod(p.shape)) * 4
+                     for _, p in m.named_parameters())
+        mesh, ann, _ = auto.choose_strategy(
+            m, batch_tokens=16, n_devices=8,
+            per_device_bytes=pbytes * 2.5)
+        eng = auto.Engine(m, nn.functional.cross_entropy, optimizer.SGD(0.1),
+                          mesh, batch_dim_mesh_axis="dp", annotations=ann)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(16, 16)).astype(np.float32)
+        y = rng.integers(0, 4, 16).astype(np.int32)
+        losses = eng.fit([((x,), (y,))] * 6)
+        assert np.isfinite(losses).all() and losses[-1] < losses[0]

@@ -102,11 +102,17 @@ def test_deepwalk_dead_end_pairs_masked(rng):
     nodes = np.arange(3, dtype=np.uint64)
     dgraph = DeviceGraph.from_graph_table(g, max_deg=4)
     dim = 8
+    # Adam rules: a spurious zero-delta update would still decay m/v
+    # and advance the beta powers — so this test catches padded or
+    # frozen pairs leaking into the push as STATE corruption, not just
+    # weight movement
     sgd = SGDRuleConfig(learning_rate=0.2)
-    acc = AccessorConfig(embedx_dim=dim, embedx_threshold=0.0, sgd=sgd)
+    acc = AccessorConfig(embedx_dim=dim, embedx_threshold=0.0, sgd=sgd,
+                         embed_sgd_rule="adam", embedx_sgd_rule="adam")
     table = MemorySparseTable(TableConfig(shard_num=2, accessor_config=acc))
     cache_cfg = CacheConfig(capacity=1 << 6, embedx_dim=dim,
-                            embedx_threshold=0.0, sgd=sgd)
+                            embedx_threshold=0.0, sgd=sgd,
+                            embed_rule="adam", embedx_rule="adam")
     init_node_embeddings(table, nodes, rng, scale=0.1)
     cache = HbmEmbeddingCache(table, cache_cfg, device_map=True)
     cache.begin_pass(np.concatenate([tag_center(nodes), tag_context(nodes)]))
@@ -115,8 +121,14 @@ def test_deepwalk_dead_end_pairs_masked(rng):
     cfg = DeepWalkConfig(walk_len=4, window=2, negatives=0, embed_dim=dim)
     step = make_deepwalk_train_step(dgraph, cache_cfg, cfg,
                                     pool_lo=nodes.astype(np.uint32))
+    state_before = {k: np.asarray(v).copy() for k, v in cache.state.items()}
     starts = jnp.asarray(np.array([2, 2, 2, 2], np.uint32))
     cache.state, loss = step(cache.state, cache.device_map.state, starts,
                              jax.random.PRNGKey(1))
     after = node_embeddings(cache, np.array([2], np.uint64))
     np.testing.assert_array_equal(before, after)
+    # node 2's walks froze at the start: with every pair masked, NO row
+    # may advance (under Adam even a zero-delta touch decays state)
+    for k, v in cache.state.items():
+        np.testing.assert_array_equal(np.asarray(v), state_before[k],
+                                      err_msg=k)
