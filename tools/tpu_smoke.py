@@ -263,8 +263,9 @@ def main() -> None:
     lbl = jnp.asarray(rng.integers(0, ecfg.vocab_size, size=(B2, L2)), jnp.int32)
 
     def leg_transformer():
-        t_step, _ = _timed(lambda a, b: tr.train_step(a, b), ids, lbl,
-                           iters=min(iters, 10))
+        with auto_cast(enable=True):  # bf16 linear/conv contractions
+            t_step, _ = _timed(lambda a, b: tr.train_step(a, b), ids, lbl,
+                               iters=min(iters, 10))
         # analytic FLOPs: 6 * params * tokens (fwd+bwd) + attention term
         n_params = sum(int(np.prod(p.shape))
                        for p in dict(emodel.named_parameters()).values())
@@ -276,6 +277,7 @@ def main() -> None:
         return {
             "config": {"hidden": ecfg.hidden_size, "layers": ecfg.num_layers,
                        "seq": L2, "batch": B2},
+            "amp": True,
             "step_ms": round(t_step * 1e3, 2),
             "params_millions": round(n_params / 1e6, 1),
             "tokens_per_sec": round(tokens / t_step, 0),
