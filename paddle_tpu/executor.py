@@ -32,6 +32,8 @@ def make_train_step(
     optimizer: Optimizer,
     loss_fn: Callable[..., jax.Array],
     donate: bool = True,
+    amp: bool = False,
+    amp_dtype: str = "bfloat16",
 ):
     """Build a pure, jitted train step:
 
@@ -39,26 +41,43 @@ def make_train_step(
 
     where ``state = {"params":…, "buffers":…}`` (see nn.get_state) and
     ``batch = (*inputs, *labels)`` with ``loss_fn(outputs, *labels)``.
+
+    ``amp=True``: the step body traces under ``amp.auto_cast`` — dense
+    contractions (linear/conv) run in ``amp_dtype`` with f32
+    accumulation, params/grads/updates stay f32. Putting the context
+    INSIDE the traced body (rather than around the first call) makes
+    the mode a property of the step, immune to auto_cast's trace-time
+    call-site pitfall.
     """
+    import contextlib
+
+    from .amp import auto_cast
 
     def step(state, opt_state, rng, inputs, labels):
-        def compute_loss(params):
-            out, new_state = nn.functional_call(
-                model,
-                {"params": params, "buffers": state["buffers"]},
-                *inputs,
-                rng=rng,
-                training=True,
-            )
-            loss = loss_fn(out, *labels)
-            # AMP loss scaling: grads are taken of the scaled loss; the
-            # AMPOptimizer unscales them inside update (amp.GradScaler)
-            scaled = (optimizer.scale_loss(loss, opt_state)
-                      if hasattr(optimizer, "scale_loss") else loss)
-            return scaled, (loss, new_state["buffers"])
+        # amp=False must be a NO-OP context, not auto_cast(enable=False):
+        # entering the disabled context would stomp an amp state set by
+        # an enclosing call-site auto_cast (the two patterns compose)
+        ctx = auto_cast(enable=True, dtype=amp_dtype) if amp \
+            else contextlib.nullcontext()
+        with ctx:
+            def compute_loss(params):
+                out, new_state = nn.functional_call(
+                    model,
+                    {"params": params, "buffers": state["buffers"]},
+                    *inputs,
+                    rng=rng,
+                    training=True,
+                )
+                loss = loss_fn(out, *labels)
+                # AMP loss scaling: grads are taken of the scaled loss;
+                # the AMPOptimizer unscales them inside update
+                # (amp.GradScaler)
+                scaled = (optimizer.scale_loss(loss, opt_state)
+                          if hasattr(optimizer, "scale_loss") else loss)
+                return scaled, (loss, new_state["buffers"])
 
-        (_, (loss, new_buffers)), grads = jax.value_and_grad(
-            compute_loss, has_aux=True)(state["params"])
+            (_, (loss, new_buffers)), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(state["params"])
         new_params, new_opt_state = optimizer.update(grads, opt_state, state["params"])
         return {"params": new_params, "buffers": new_buffers}, new_opt_state, loss
 
@@ -98,6 +117,8 @@ class Trainer:
         optimizer: Optimizer,
         loss_fn: Callable[..., jax.Array],
         seed: int = 0,
+        amp: bool = False,
+        amp_dtype: str = "bfloat16",
     ) -> None:
         self.model = model
         self.optimizer = optimizer
@@ -109,7 +130,8 @@ class Trainer:
         self.state = jax.tree_util.tree_map(jnp.array, nn.get_state(model))
         self.opt_state = optimizer.init(self.state["params"])
         self._rng = jax.random.key(seed)
-        self._train_step = make_train_step(model, optimizer, loss_fn)
+        self._train_step = make_train_step(model, optimizer, loss_fn,
+                                           amp=amp, amp_dtype=amp_dtype)
         self._eval_step = make_eval_step(model)
         self.global_step = 0
         self._dump_fh = None

@@ -104,3 +104,39 @@ def test_auc_distributed_merge():
     w2.update(preds[500:], labels[500:])
     w1.merge(w2.buckets)
     assert abs(whole.accumulate() - w1.accumulate()) < 1e-12
+
+
+def test_trainer_amp_trains_and_is_bf16_in_trace(rng):
+    """Trainer(amp=True): the step body traces under auto_cast — bf16
+    contractions appear in the compiled program regardless of where the
+    first call happens, and training still converges."""
+    import paddle_tpu as pt
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.executor import Trainer, make_train_step
+
+    pt.seed(0)
+    model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+    tr = Trainer(model, optimizer.Adam(5e-3),
+                 nn.functional.cross_entropy, amp=True)
+    centers = rng.normal(size=(2, 8)).astype(np.float32) * 2
+    x = np.concatenate([centers[y] + 0.3 * rng.normal(size=(64, 8))
+                        for y in (0, 1)]).astype(np.float32)
+    y = np.repeat(np.arange(2), 64).astype(np.int32)
+    losses = [float(tr.train_step(x, y)) for _ in range(40)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5
+
+    # the amp mode is a property of the step, not of the call site
+    step = make_train_step(model, optimizer.Adam(5e-3),
+                           nn.functional.cross_entropy, donate=False,
+                           amp=True)
+    import jax
+    state = {"params": dict(model.named_parameters()), "buffers": {}}
+    opt_state = optimizer.Adam(5e-3).init(state["params"])
+    lowered = step.lower(state, opt_state, jax.random.key(0), (x,), (y,))
+    assert "bf16" in lowered.as_text()
+    step_f32 = make_train_step(model, optimizer.Adam(5e-3),
+                               nn.functional.cross_entropy, donate=False)
+    lowered32 = step_f32.lower(state, opt_state, jax.random.key(0),
+                               (x,), (y,))
+    assert "bf16" not in lowered32.as_text()

@@ -258,14 +258,15 @@ def main() -> None:
         return nn.functional.cross_entropy(
             out.reshape(-1, out.shape[-1]), labels.reshape(-1))
 
-    tr = Trainer(emodel, optimizer.Adam(1e-4), lm_loss)
+    tr = Trainer(emodel, optimizer.Adam(1e-4), lm_loss, amp=True)
     ids = jnp.asarray(rng.integers(0, ecfg.vocab_size, size=(B2, L2)), jnp.int32)
     lbl = jnp.asarray(rng.integers(0, ecfg.vocab_size, size=(B2, L2)), jnp.int32)
 
     def leg_transformer():
-        with auto_cast(enable=True):  # bf16 linear/conv contractions
-            t_step, _ = _timed(lambda a, b: tr.train_step(a, b), ids, lbl,
-                               iters=min(iters, 10))
+        # amp is a property of the Trainer's step (amp=True above), not
+        # of this call site
+        t_step, _ = _timed(lambda a, b: tr.train_step(a, b), ids, lbl,
+                           iters=min(iters, 10))
         # analytic FLOPs: 6 * params * tokens (fwd+bwd) + attention term
         n_params = sum(int(np.prod(p.shape))
                        for p in dict(emodel.named_parameters()).values())
