@@ -89,8 +89,13 @@ def cache_pull(state: Dict[str, jax.Array], rows: jax.Array) -> jax.Array:
     values under jit — another feature's embedding — and NaN-fill in
     eager mode; both are silent corruption."""
     C = state["embed_w"].shape[0]
-    w = jnp.concatenate([state["embed_w"], state["embedx_w"]], axis=1)
-    pulled = jnp.take(w, jnp.minimum(rows, C - 1), axis=0)
+    safe = jnp.minimum(rows, C - 1)
+    # gather each column block THEN concat the [n, ·] results — never
+    # concat the [C, ·] table first (XLA may materialize the 72 MB temp
+    # every step at bench scale)
+    pulled = jnp.concatenate(
+        [jnp.take(state["embed_w"], safe, axis=0),
+         jnp.take(state["embedx_w"], safe, axis=0)], axis=1)
     return jnp.where((rows < C)[:, None], pulled, 0.0)
 
 

@@ -1,0 +1,186 @@
+"""On-chip step decomposition: time each CTR hot-path component with the
+trustworthy sync (core.profiler.timed) and write DECOMP.json.
+
+The interactive counterpart of BENCH_DECOMP.md — run when the chip is
+reachable to attribute the step time term by term (probe, pull, tower
+fwd/bwd f32 vs amp, scatter-add, full-table update, push dense vs
+sparse, whole slab step). Safe-exit discipline: init under a watchdog
+(emit-and-exit, never hang the caller), bounded run time, clean exit
+(no external kills — MEASURED.md 2026-07-31).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+OUT = os.environ.get("DECOMP_OUT") or os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "DECOMP.json")
+
+
+def _write(payload) -> None:
+    with open(OUT, "w") as f:
+        json.dump(payload, f, indent=1)
+    print(json.dumps(payload)[:400])
+
+
+def main() -> None:
+    import threading
+
+    import jax
+
+    if os.environ.get("DECOMP_PLATFORM"):
+        jax.config.update("jax_platforms", os.environ["DECOMP_PLATFORM"])
+
+    got = {}
+
+    def init():
+        try:
+            got["devs"] = jax.devices()
+        except Exception as e:  # noqa: BLE001
+            got["err"] = str(e)
+
+    t = threading.Thread(target=init, daemon=True)
+    t.start()
+    t.join(float(os.environ.get("DECOMP_INIT_TIMEOUT", 180)))
+    if "devs" not in got:
+        _write({"ok": False, "error": got.get("err", "backend init hung")})
+        sys.stdout.flush()
+        os._exit(0)
+
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.amp import auto_cast
+    from paddle_tpu.core.profiler import timed
+    from paddle_tpu.models.ctr import (CtrConfig, DeepFM, _make_loss_fn,
+                                       make_ctr_train_step_slab,
+                                       make_random_packs)
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.device_hash import device_hash_lookup
+    from paddle_tpu.ps.embedding_cache import (CacheConfig, HbmEmbeddingCache,
+                                               cache_pull, cache_push)
+    from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+    light = os.environ.get("DECOMP_LIGHT") == "1"
+    batch = int(os.environ.get("DECOMP_BATCH", 256 if light else 4096))
+    pass_keys = 1 << (14 if light else 20)
+    iters = 3 if light else 20
+    cap = 1 << (15 if light else 21)
+
+    result = {"ok": True, "platform": got["devs"][0].platform,
+              "light": light, "batch": batch, "capacity": cap, "ms": {}}
+
+    def leg(name, body):
+        try:
+            t_s, _ = body()
+            result["ms"][name] = round(t_s * 1e3, 3)
+        except Exception as e:  # noqa: BLE001
+            result["ms"][name] = f"error: {type(e).__name__}: {e}"[:160]
+            result["ok"] = False
+
+    cfg = CtrConfig(num_sparse_slots=26, num_dense=13, embedx_dim=8,
+                    dnn_hidden=(64,) if light else (400, 400, 400))
+    cache_cfg = CacheConfig(capacity=cap, embedx_dim=8, embedx_threshold=0.0)
+    pt.seed(0)
+    rng = np.random.default_rng(0)
+    table = MemorySparseTable(TableConfig(
+        shard_num=16, accessor_config=AccessorConfig(embedx_dim=8)))
+    cache = HbmEmbeddingCache(table, cache_cfg, device_map=True)
+    pool = rng.integers(0, pass_keys // 26 + 1,
+                        size=(pass_keys, 26)).astype(np.uint64)
+    pool += np.arange(26, dtype=np.uint64) << np.uint64(32)
+    t0 = time.perf_counter()
+    cache.begin_pass(pool.reshape(-1))
+    result["begin_pass_s"] = round(time.perf_counter() - t0, 2)
+    ms = cache.device_map.state
+
+    n = batch * 26
+    idx = rng.integers(0, len(pool), size=batch)
+    keys = pool[idx]
+    hi = jnp.asarray((keys >> np.uint64(32)).astype(np.uint32).reshape(-1))
+    lo = jnp.asarray((keys & np.uint64(0xFFFFFFFF)).astype(np.uint32).reshape(-1))
+
+    probe = jax.jit(lambda ms, hi, lo: device_hash_lookup(ms, hi, lo))
+    leg("cuckoo_probe", lambda: timed(probe, ms, hi, lo, iters=iters))
+    p = probe(ms, hi, lo)
+    rows = jnp.where(p >= 0, p, cap)
+
+    pull = jax.jit(cache_pull)
+    leg("cache_pull", lambda: timed(pull, cache.state, rows, iters=iters))
+    emb3 = pull(cache.state, rows).reshape(batch, 26, -1)
+
+    model = DeepFM(cfg)
+    params = {"params": dict(model.named_parameters()), "buffers": {}}
+    dense_x = jnp.zeros((batch, 13))
+    labels = jnp.zeros((batch,), jnp.int32)
+
+    def fwdbwd(params, emb3):
+        loss_fn = _make_loss_fn(model, dense_x, labels, None)
+        (loss, _), (g, eg) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True)(params, emb3)
+        return loss, eg
+
+    leg("fwd_bwd_f32", lambda: timed(jax.jit(fwdbwd), params, emb3,
+                                     iters=iters))
+    with auto_cast(enable=True):
+        leg("fwd_bwd_amp", lambda: timed(
+            jax.jit(lambda p, e: fwdbwd(p, e)), params, emb3, iters=iters))
+
+    grads = jnp.ones((n, 9))
+    shows = jnp.ones((n,))
+    clicks = jnp.zeros((n,))
+    for mode in ("dense", "sparse"):
+        mcfg = dataclasses.replace(cache_cfg, push_mode=mode)
+        leg(f"push_{mode}", lambda _m=mcfg: timed(
+            jax.jit(lambda st, r, g, s, c: cache_push(st, r, g, s, c, _m)),
+            cache.state, rows, grads, shows, clicks, iters=iters))
+
+    # scatter-add alone (the dense push's only indexed op)
+    upd = jnp.concatenate([grads, shows[:, None], clicks[:, None],
+                           jnp.ones((n, 1))], axis=1)
+
+    def scat(st_w, rows, upd):
+        acc = jnp.zeros((cap + 1, upd.shape[1]), jnp.float32)
+        return acc.at[rows].add(upd)[:cap].sum()
+
+    leg("scatter_add_acc", lambda: timed(jax.jit(scat), cache.state["embedx_w"],
+                                         rows, upd, iters=iters))
+
+    # whole slab step (bench inner loop), amp
+    slab = 8
+    step = make_ctr_train_step_slab(model, optimizer.Adam(1e-3), cache_cfg,
+                                    slot_ids=np.arange(26), batch_size=batch,
+                                    num_dense=13, slab=slab, donate=False)
+    packs = jnp.asarray(np.stack(make_random_packs(rng, pool, batch, 13, slab)))
+    opt_state = optimizer.Adam(1e-3).init(params)
+    with auto_cast(enable=True):
+        leg("slab8_dispatch", lambda: timed(
+            jax.jit(lambda p, o, cs, m, pk: step(p, o, cs, m, pk)[3]),
+            params, opt_state, cache.state, ms, packs,
+            iters=max(2, iters // slab)))
+    if isinstance(result["ms"].get("slab8_dispatch"), float):
+        per = result["ms"]["slab8_dispatch"] / slab
+        result["per_step_ms"] = round(per, 3)
+        result["samples_per_sec"] = round(batch / (per / 1e3), 0)
+
+    result["timestamp"] = time.strftime("%Y-%m-%d %H:%M:%S")
+    _write(result)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        _write({"ok": False, "error": f"{type(e).__name__}: {e}"[:300]})
