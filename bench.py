@@ -131,7 +131,9 @@ def _run_measurement() -> None:
 
     batch = int(os.environ.get("BENCH_BATCH", 4096))
     steps = int(os.environ.get("BENCH_STEPS", 30))
-    warmup = int(os.environ.get("BENCH_WARMUP", 5))
+    # >= 1: the first call compiles AND run_attempt's post-warmup sync
+    # reads the last warmup loss
+    warmup = max(1, int(os.environ.get("BENCH_WARMUP", 5)))
     # bf16 matmuls (f32 accumulation) for the dense tower — the MXU's
     # native rate; sparse/optimizer state stays f32 throughout
     amp_on = os.environ.get("BENCH_AMP", "1") == "1"
@@ -161,20 +163,11 @@ def _run_measurement() -> None:
     pool += np.arange(26, dtype=np.uint64) << np.uint64(32)
     cache.begin_pass(pool.reshape(-1))
 
+    import dataclasses
+
     model = DeepFM(cfg)
     opt = optimizer.Adam(learning_rate=1e-3)
-    params = {"params": dict(model.named_parameters()), "buffers": {}}
-    opt_state = opt.init(params)
-    if slab > 1:
-        step = make_ctr_train_step_slab(model, opt, cache_cfg,
-                                        slot_ids=np.arange(26),
-                                        batch_size=batch,
-                                        num_dense=cfg.num_dense, slab=slab)
-    else:
-        step = make_ctr_train_step_packed(model, opt, cache_cfg,
-                                          slot_ids=np.arange(26),
-                                          batch_size=batch,
-                                          num_dense=cfg.num_dense)
+    params0 = {k: np.asarray(v) for k, v in model.named_parameters()}
 
     # pre-generate host-side batches (data pipeline measured separately;
     # the reference's dataset feed is also an async producer). Each
@@ -190,50 +183,91 @@ def _run_measurement() -> None:
         packs = make_random_packs(rng, pool, batch, cfg.num_dense, slab)
         batches.append(np.stack(packs) if slab > 1 else packs[0])
 
-    map_state = cache.device_map.state
-
-    # async H2D double-buffering (the data_feed channel role): transfers
-    # of batch i+1..i+depth overlap step i's device time
-    from paddle_tpu.data.prefetcher import device_prefetch
-
-    def stream():
-        for i in range(warmup + steps):
-            yield batches[i % n_batches]
-
-    prefetcher = device_prefetch(stream(), depth=3)
-    feeder = iter(prefetcher)
-
-    def run_one():
-        packed = next(feeder)
-        return step(params, opt_state, cache.state, map_state, packed)
-
     # sync discipline: a tiny D2H fetch, NOT block_until_ready, which
     # the axon relay can satisfy before the computation finishes — THE
     # shared sync primitive (see its docstring for the measurement)
-    from paddle_tpu.core.profiler import fetch_sync as _sync
-
     from paddle_tpu.amp import auto_cast
+    from paddle_tpu.core.profiler import fetch_sync as _sync
+    from paddle_tpu.data.prefetcher import device_prefetch
 
-    try:
-        # auto_cast is consulted at TRACE time (first call below), so the
-        # context wraps the loops, not the step construction
-        with auto_cast(enable=amp_on):
-            for i in range(warmup):
-                params, opt_state, cache.state, loss = run_one()
-            _sync(loss)
+    def build_step(ccfg):
+        if slab > 1:
+            return make_ctr_train_step_slab(
+                model, opt, ccfg, slot_ids=np.arange(26), batch_size=batch,
+                num_dense=cfg.num_dense, slab=slab)
+        return make_ctr_train_step_packed(
+            model, opt, ccfg, slot_ids=np.arange(26), batch_size=batch,
+            num_dense=cfg.num_dense)
 
-            t0 = time.perf_counter()
-            for i in range(steps):
-                params, opt_state, cache.state, loss = run_one()
-            _sync(loss)
-            dt = time.perf_counter() - t0
-    finally:
-        prefetcher.close()
+    def run_attempt(ccfg, use_amp):
+        """Full warmup + measurement for one (push_mode, amp) config.
+        Raises on compile/run failure; the caller rebuilds state."""
+        step = build_step(ccfg)
+        params = {"params": {k: jnp.asarray(v) for k, v in params0.items()},
+                  "buffers": {}}
+        opt_state = opt.init(params)
+        map_state = cache.device_map.state
+        cache_state = cache.state
+        # async H2D double-buffering (the data_feed channel role)
+        prefetcher = device_prefetch(
+            (batches[i % n_batches] for i in range(warmup + steps)), depth=3)
+        feeder = iter(prefetcher)
+        try:
+            # auto_cast is consulted at TRACE time (first call below), so
+            # the context wraps the loops, not the step construction
+            with auto_cast(enable=use_amp):
+                for i in range(warmup):
+                    params, opt_state, cache_state, loss = step(
+                        params, opt_state, cache_state, map_state,
+                        next(feeder))
+                _sync(loss)
+                t0 = time.perf_counter()
+                for i in range(steps):
+                    params, opt_state, cache_state, loss = step(
+                        params, opt_state, cache_state, map_state,
+                        next(feeder))
+                _sync(loss)
+                dt = time.perf_counter() - t0
+        finally:
+            prefetcher.close()
+        cache.state = cache_state
+        return dt
+
+    # graceful-degradation ladder: the dense push and the amp tower are
+    # this round's NEW hot paths — a novel hardware compile failure in
+    # either must cost the attempt, not the headline metric. State is
+    # rebuilt from the host table after a failed attempt because the
+    # donated buffers may already be consumed.
+    # push modes are pinned explicitly (not "auto") so the emitted mode
+    # label is truthful on every backend and the sparse rung is a real
+    # alternative program even on CPU
+    attempts = ([("amp+dense", True, "dense")] if amp_on else []) + [
+        ("dense", False, "dense"), ("sparse", False, "sparse")]
+    dt = None
+    errors = []
+    force_fail = os.environ.get("BENCH_FORCE_FAIL", "").split(",")
+    for idx, (name, use_amp, push) in enumerate(attempts):
+        ccfg = dataclasses.replace(cache_cfg, push_mode=push)
+        try:
+            if name in force_fail:  # CI knob: prove the ladder engages
+                raise RuntimeError("forced by BENCH_FORCE_FAIL")
+            dt = run_attempt(ccfg, use_amp)
+            mode_used = name
+            break
+        except Exception as e:  # noqa: BLE001 — degrade, don't die
+            errors.append(f"{name}: {type(e).__name__}: {e}"[:160])
+            print(f"bench: attempt {name!r} failed, degrading: {e}",
+                  file=sys.stderr)
+            if idx + 1 < len(attempts):  # state rebuild only helps a retry
+                cache.begin_pass(pool.reshape(-1))
+    if dt is None:
+        raise RuntimeError("; ".join(errors))
 
     samples_per_sec = batch * slab * steps / dt
     baseline = 1.0e6  # proxy: GPUPS-on-A100 class throughput (north star ≥2×)
+    extra = {"degraded_from": errors} if errors else {}
     _emit(round(samples_per_sec, 1), round(samples_per_sec / baseline, 4),
-          slab=slab, amp=amp_on)
+          slab=slab, mode=mode_used, **extra)
 
 
 if __name__ == "__main__":
