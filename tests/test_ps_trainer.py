@@ -611,3 +611,57 @@ def test_slab_pass_matches_single_step_pass():
     assert out1["samples"] == out4["samples"] == 330
     np.testing.assert_allclose(out4["loss"], out1["loss"], rtol=1e-6)
     np.testing.assert_array_equal(vals4, vals1)
+
+
+@pytest.mark.slow
+def test_dense_push_trajectory_matches_sparse(rng):
+    """Chained-trajectory parity of the TPU hot path: the SAME pass
+    trainer run with push_mode="dense" vs "sparse" over multiple passes
+    stays numerically together (per-step parity is exact to f32
+    reassociation; this pins that the drift doesn't compound over
+    hundreds of steps of feedback through the cache)."""
+    results = {}
+    for mode in ("sparse", "dense"):
+        pt.seed(0)
+        ds = InMemoryDataset(_slots(), seed=0)
+        r = np.random.default_rng(7)
+        lines = []
+        for _ in range(2048):
+            ids = r.integers(0, 64, S)
+            dense = r.normal(size=D)
+            label = int((ids % 5 == 0).sum() + dense[0]
+                        + r.normal(scale=0.5) > 1.0)
+            parts = [f"1 {v}" for v in ids] + \
+                    [f"1 {v:.4f}" for v in dense] + [f"1 {label}"]
+            lines.append(" ".join(parts))
+        ds.load_from_lines(lines)
+
+        cfg = CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=4,
+                        dnn_hidden=(16, 16))
+        cache_cfg = CacheConfig(capacity=1 << 10, embedx_dim=4,
+                                embedx_threshold=0.0, push_mode=mode)
+        table = MemorySparseTable(TableConfig(
+            shard_num=4, accessor_config=AccessorConfig(embedx_dim=4)))
+        tr = CtrPassTrainer(
+            DeepFM(cfg), optimizer.Adam(1e-2), table, cache_cfg,
+            sparse_slots=[f"s{i}" for i in range(S)],
+            dense_slots=[f"d{i}" for i in range(D)],
+            label_slot="label")
+        losses = [tr.train_from_dataset(ds, batch_size=256)["loss"]
+                  for _ in range(5)]  # 5 passes x 8 steps, cache feedback
+        auc = tr.evaluate(ds, batch_size=256)["auc"]
+        results[mode] = (np.asarray(losses), auc, table)
+
+    l_s, auc_s, t_s = results["sparse"]
+    l_d, auc_d, t_d = results["dense"]
+    np.testing.assert_allclose(l_d, l_s, rtol=2e-3, atol=2e-4)
+    assert abs(auc_d - auc_s) < 2e-3, (auc_s, auc_d)
+    # flushed host tables agree row-for-row over the dataset's feasigns
+    assert t_s.size() == t_d.size()
+    sample = (np.arange(64, dtype=np.uint64)
+              + (np.uint64(0) << np.uint64(32)))  # slot-0 vocabulary
+    v_s, f_s = t_s.export_full(sample)
+    v_d, f_d = t_d.export_full(sample)
+    np.testing.assert_array_equal(f_d, f_s)
+    assert f_s.sum() > 32  # the sample really hits trained rows
+    np.testing.assert_allclose(v_d[f_d], v_s[f_s], rtol=2e-3, atol=2e-4)
