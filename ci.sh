@@ -44,5 +44,15 @@ import json, sys
 line = [l for l in sys.stdin.read().splitlines() if l.startswith('{')][-1]
 d = json.loads(line); assert d['value'] > 0 and 'error' not in d, d
 print('bench (cpu) OK')"
+  # the graceful-degradation ladder must actually engage (a hardware
+  # compile failure in a new hot path costs an attempt, not the metric)
+  BENCH_STEPS=3 BENCH_WARMUP=1 BENCH_BATCH=256 BENCH_PASS_KEYS=$((1 << 13)) \
+    BENCH_INIT_TIMEOUT=60 BENCH_PLATFORM=cpu \
+    BENCH_FORCE_FAIL=amp+dense,dense python bench.py | python -c "
+import json, sys
+line = [l for l in sys.stdin.read().splitlines() if l.startswith('{')][-1]
+d = json.loads(line)
+assert d['value'] > 0 and d['mode'] == 'sparse' and d['degraded_from'], d
+print('bench degradation ladder OK')"
 fi
 echo "CI OK"
