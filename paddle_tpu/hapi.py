@@ -79,15 +79,33 @@ class Model:
     # -- setup ------------------------------------------------------------
 
     def prepare(self, optimizer=None, loss=None,
-                metrics: Optional[Sequence[Any]] = None) -> None:
+                metrics: Optional[Sequence[Any]] = None,
+                amp_configs=None) -> None:
+        """``amp_configs``: the reference Model.prepare's mixed-precision
+        knob (hapi/model.py amp_configs) — accepts "O1"/"O2", True, or a
+        dict with "level"; anything except None/"O0"/False enables bf16
+        contractions in the train step (amp is a property of the step —
+        executor.make_train_step(amp=True))."""
         self._opt = optimizer
         self._loss = loss
         self._metrics = list(metrics or [])
         self._state = nn.get_state(self.network)
+        if isinstance(amp_configs, dict):
+            # a dict without "level" means O1 in the reference
+            # (hapi/model.py _check_amp_configs defaults the level)
+            level = amp_configs.get("level", "O1")
+        else:
+            level = amp_configs
+        if isinstance(level, bool) or level is None:
+            amp_on = bool(level)
+        else:
+            enforce(level in ("O0", "O1", "O2"),
+                    f"amp_configs level must be O0/O1/O2, got {level!r}")
+            amp_on = level != "O0"
         if optimizer is not None:
             self._opt_state = optimizer.init(self._state["params"])
             self._train_step = make_train_step(self.network, optimizer, loss,
-                                               donate=False)
+                                               donate=False, amp=amp_on)
         self._eval_fwd = make_eval_step(self.network)
 
     def _check_prepared(self):
