@@ -26,7 +26,7 @@ from the pool key arrays (the generator's neg-sample table role).
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

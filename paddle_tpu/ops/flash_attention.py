@@ -31,7 +31,6 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["flash_attention", "flash_attention_with_lse"]
 
 NEG = -1e30
-_INTERPRET = None  # resolved per-call: pallas interpret mode off-TPU
 
 
 def _on_tpu() -> bool:

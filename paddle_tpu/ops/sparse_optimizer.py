@@ -20,7 +20,7 @@ elsewhere; bit-parity is tested in tests/test_sparse_optimizer.py).
 from __future__ import annotations
 
 import functools
-from typing import Dict, Optional, Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp

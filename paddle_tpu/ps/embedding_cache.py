@@ -27,7 +27,7 @@ StdAdaGrad: dim; Adam: 2·dim+2; naive: 0).
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, Optional, Tuple
+from typing import Dict, Optional
 
 import jax
 import jax.numpy as jnp
