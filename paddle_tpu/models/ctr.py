@@ -33,6 +33,7 @@ from ..ps.device_hash import device_hash_lookup
 from ..ps.embedding_cache import CacheConfig, cache_pull, cache_push
 
 __all__ = ["CtrConfig", "DeepFM", "WideDeep", "DCN", "XDeepFM",
+           "export_ctr_inference",
            "make_ctr_train_step",
            "make_ctr_train_step_from_keys", "make_ctr_pooled_train_step",
            "make_ctr_train_step_packed", "make_ctr_train_step_slab",
@@ -594,3 +595,61 @@ def make_ctr_train_step_from_keys(
                            dense_x, labels, map_state, weights)
 
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
+
+
+def export_ctr_inference(dirname: str, model: Layer, cache, slot_ids,
+                         num_dense: int, freeze: bool = False) -> None:
+    """``fleet.save_inference_model`` for the CTR serving path: export
+    probe → pull → forward → sigmoid as one portable program
+    (io/inference.py StableHLO export). The exported parameters are the
+    dense model params plus the PRUNED serving tables — embed_w /
+    embedx_w only; optimizer state, show/click and lifecycle stats are
+    training-only and dropped, the reference's persistables pruning
+    (save_inference_model prunes the program to feed→fetch and keeps
+    only referenced persistables) — plus the pass's key→row map.
+
+    Serving input: (lo32 [B, S] uint32, dense [B, D] float32) → pctr
+    [B] float32. Missing keys probe to the sentinel and contribute zero
+    embeddings, the serving-side contract for out-of-pass features.
+    """
+    from ..io.inference import save_inference_model
+
+    enforce(cache.state is not None, "begin_pass first", )
+    enforce(cache.device_map is not None,
+            "export_ctr_inference needs device_map=True on the cache "
+            "(the serving program probes the pass's key map in-graph)")
+    slot_hi = np.asarray(slot_ids, np.uint32)
+    S, D = int(slot_hi.shape[0]), int(num_dense)
+    serving = {
+        "model": {"params": dict(model.named_parameters()), "buffers": {}},
+        "tables": {"embed_w": cache.state["embed_w"],
+                   "embedx_w": cache.state["embedx_w"]},
+        "map": cache.device_map.state,
+    }
+    slot_hi_d = jnp.asarray(slot_hi)
+
+    def _pull_emb(params, lo32):
+        B = lo32.shape[0]
+        t = params["tables"]
+        C = t["embed_w"].shape[0]
+        hi = jnp.broadcast_to(slot_hi_d[None, :], (B, S)).reshape(-1)
+        rows = device_hash_lookup(params["map"], hi,
+                                  lo32.reshape(-1).astype(jnp.uint32))
+        rows = jnp.where(rows >= 0, rows, C)
+        # THE training pull (sentinel-safe gather) — serving and
+        # training cannot diverge on layout or masking
+        return cache_pull(t, rows).reshape(B, S, -1)
+
+    def serve_fn(params, lo32, dense_x):
+        # the Layer is a trace-time closure, not exported data
+        out, _ = nn.functional_call(model, params["model"],
+                                    _pull_emb(params, lo32),
+                                    dense_x.astype(jnp.float32),
+                                    training=False)
+        return jax.nn.sigmoid(out)
+
+    # batch-polymorphic export: serving batch size is a deploy-time choice
+    (b,) = jax.export.symbolic_shape("b")
+    example = (jax.ShapeDtypeStruct((b, S), jnp.uint32),
+               jax.ShapeDtypeStruct((b, D), jnp.float32))
+    save_inference_model(dirname, serve_fn, serving, example, freeze=freeze)

@@ -120,3 +120,78 @@ def test_hapi_model_save_inference(tmp_path, rng):
 
     with pytest.raises(Exception):
         m.save(str(tmp_path / "bad"), training=False)  # needs examples
+
+
+def test_ctr_serving_export(tmp_path, rng):
+    """export_ctr_inference: the CTR probe→pull→forward→sigmoid path
+    exports as one portable program with PRUNED serving tables (no
+    optimizer state); the loaded predictor matches in-process scores
+    and zero-fills out-of-pass keys."""
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.io.inference import load_inference_model
+    from paddle_tpu.models.ctr import (CtrConfig, DeepFM,
+                                       export_ctr_inference)
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.embedding_cache import (CacheConfig,
+                                               HbmEmbeddingCache,
+                                               cache_pull)
+    from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+    pt.seed(0)
+    S, D, dim = 4, 3, 4
+    cfg = CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=dim,
+                    dnn_hidden=(8,))
+    table = MemorySparseTable(TableConfig(
+        shard_num=2, accessor_config=AccessorConfig(embedx_dim=dim)))
+    cache_cfg = CacheConfig(capacity=1 << 8, embedx_dim=dim,
+                            embedx_threshold=0.0)
+    cache = HbmEmbeddingCache(table, cache_cfg, device_map=True)
+    lo = rng.integers(1, 1000, size=(64, S)).astype(np.uint64)
+    pool = lo + (np.arange(S, dtype=np.uint64) << np.uint64(32))
+    cache.begin_pass(pool.reshape(-1))
+    # give the tables non-trivial values
+    cache.state["embed_w"] = jnp.asarray(
+        rng.normal(size=cache.state["embed_w"].shape).astype(np.float32))
+    cache.state["embedx_w"] = jnp.asarray(
+        rng.normal(size=cache.state["embedx_w"].shape).astype(np.float32))
+
+    model = DeepFM(cfg)
+    export_ctr_inference(str(tmp_path / "serve"), model, cache,
+                         slot_ids=np.arange(S), num_dense=D)
+    pred = load_inference_model(str(tmp_path / "serve"))
+
+    lo32 = (pool[:8] & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    dense = rng.normal(size=(8, D)).astype(np.float32)
+    got = np.asarray(pred(jnp.asarray(lo32), jnp.asarray(dense)))
+
+    # in-process reference: host lookup + pull + forward
+    rows = cache.lookup(pool[:8].reshape(-1))
+    emb = cache_pull(cache.state, jnp.asarray(rows, jnp.int32)).reshape(
+        8, S, -1)
+    out, _ = nn.functional_call(
+        model, {"params": dict(model.named_parameters()), "buffers": {}},
+        emb, jnp.asarray(dense), training=False)
+    want = np.asarray(jax.nn.sigmoid(out))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    assert ((got > 0) & (got < 1)).all()
+
+    # out-of-pass keys → sentinel → zero embeddings (not garbage)
+    lo_miss = np.full((2, S), 0xFFFFFF, np.uint32)
+    p_miss = np.asarray(pred(jnp.asarray(lo_miss),
+                             jnp.zeros((2, D), np.float32)))
+    out0, _ = nn.functional_call(
+        model, {"params": dict(model.named_parameters()), "buffers": {}},
+        jnp.zeros((2, S, 1 + dim)), jnp.zeros((2, D)), training=False)
+    np.testing.assert_allclose(p_miss, np.asarray(jax.nn.sigmoid(out0)),
+                               rtol=1e-5, atol=1e-6)
+
+    # the export carries NO optimizer state (persistables pruning)
+    import json as _json
+    man = _json.load(open(tmp_path / "serve" / "manifest.json"))
+    assert man["freeze"] is False
+    from paddle_tpu.io.checkpoint import load_checkpoint
+    saved = load_checkpoint(str(tmp_path / "serve" / "params"))["model"]
+    assert set(saved["tables"].keys()) == {"embed_w", "embedx_w"}
