@@ -33,7 +33,7 @@ from ..ps.device_hash import device_hash_lookup
 from ..ps.embedding_cache import CacheConfig, cache_pull, cache_push
 
 __all__ = ["CtrConfig", "DeepFM", "WideDeep", "DCN", "XDeepFM",
-           "export_ctr_inference",
+           "export_ctr_inference", "serving_pull",
            "make_ctr_train_step",
            "make_ctr_train_step_from_keys", "make_ctr_pooled_train_step",
            "make_ctr_train_step_packed", "make_ctr_train_step_slab",
@@ -597,6 +597,21 @@ def make_ctr_train_step_from_keys(
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
 
 
+def serving_pull(tables, map_state, slot_hi_d, lo32):
+    """THE serving-side probe→pull ([B, S] lo32 keys → [B, S, 1+dim]
+    embeddings) — shared by every serving export so serving and
+    training cannot diverge on sentinel masking or row layout: the
+    probe is device_hash_lookup and the gather is the training
+    cache_pull (rows ≥ C zero-fill)."""
+    B, S = lo32.shape
+    C = tables["embed_w"].shape[0]
+    hi = jnp.broadcast_to(slot_hi_d[None, :], (B, S)).reshape(-1)
+    rows = device_hash_lookup(map_state, hi,
+                              lo32.reshape(-1).astype(jnp.uint32))
+    rows = jnp.where(rows >= 0, rows, C)
+    return cache_pull(tables, rows).reshape(B, S, -1)
+
+
 def export_ctr_inference(dirname: str, model: Layer, cache, slot_ids,
                          num_dense: int, freeze: bool = False) -> None:
     """``fleet.save_inference_model`` for the CTR serving path: export
@@ -628,22 +643,10 @@ def export_ctr_inference(dirname: str, model: Layer, cache, slot_ids,
     }
     slot_hi_d = jnp.asarray(slot_hi)
 
-    def _pull_emb(params, lo32):
-        B = lo32.shape[0]
-        t = params["tables"]
-        C = t["embed_w"].shape[0]
-        hi = jnp.broadcast_to(slot_hi_d[None, :], (B, S)).reshape(-1)
-        rows = device_hash_lookup(params["map"], hi,
-                                  lo32.reshape(-1).astype(jnp.uint32))
-        rows = jnp.where(rows >= 0, rows, C)
-        # THE training pull (sentinel-safe gather) — serving and
-        # training cannot diverge on layout or masking
-        return cache_pull(t, rows).reshape(B, S, -1)
-
     def serve_fn(params, lo32, dense_x):
         # the Layer is a trace-time closure, not exported data
-        out, _ = nn.functional_call(model, params["model"],
-                                    _pull_emb(params, lo32),
+        emb = serving_pull(params["tables"], params["map"], slot_hi_d, lo32)
+        out, _ = nn.functional_call(model, params["model"], emb,
                                     dense_x.astype(jnp.float32),
                                     training=False)
         return jax.nn.sigmoid(out)

@@ -14,17 +14,28 @@ flow back through the same fused pull/push.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Callable, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import nn
 from ..nn.layer import Layer
 from ..ps.embedding_cache import CacheConfig
 from .ctr import _DNN, _ctr_step_body, _weighted_mean
 
-__all__ = ["DSSM", "make_dssm_train_step"]
+__all__ = ["DSSM", "make_dssm_train_step", "export_dssm_towers"]
+
+
+def _l2_normalize(x):
+    """Smoothed L2 normalize: x/max(‖x‖, eps) has a 1/‖x‖-scale
+    backward that EXPLODES at the near-zero outputs of a cold tower
+    (embeddings init ~1e-4) — rsqrt(‖x‖² + eps²) keeps the gradient
+    bounded while converging to unit vectors. ONE definition for
+    training forward and the serving exports."""
+    return x * jax.lax.rsqrt(jnp.sum(x * x, axis=-1, keepdims=True) + 1e-6)
 
 
 class DSSM(Layer):
@@ -51,15 +62,7 @@ class DSSM(Layer):
         q = self.query_tower(emb[:, :self.sq, :].reshape(B, -1))
         d = self.doc_tower(emb[:, self.sq:, :].reshape(B, -1))
 
-        def norm(x):
-            # smoothed L2 normalize: x/max(‖x‖, eps) has a 1/‖x‖-scale
-            # backward that EXPLODES at the near-zero outputs of a cold
-            # tower (embeddings init ~1e-4) — rsqrt(‖x‖² + eps²) keeps
-            # the gradient bounded while converging to unit vectors
-            return x * jax.lax.rsqrt(
-                jnp.sum(x * x, axis=-1, keepdims=True) + 1e-6)
-
-        return norm(q), norm(d)
+        return _l2_normalize(q), _l2_normalize(d)
 
     @staticmethod
     def loss_vec(outputs, labels, temperature: float = 0.1,
@@ -113,3 +116,72 @@ def make_dssm_train_step(model: DSSM, optimizer, cache_cfg: CacheConfig,
                               loss_builder=loss_builder)
 
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
+
+
+def export_dssm_towers(dirname: str, model: DSSM, cache, query_slot_ids,
+                       doc_slot_ids) -> None:
+    """The two-tower deployment split the module docstring promises:
+    ``<dirname>/query`` serves the ONLINE tower (query keys → normalized
+    query vector) and ``<dirname>/doc`` the OFFLINE one (doc keys →
+    normalized doc vectors for the ANN index build) — each a portable
+    batch-polymorphic program with the PRUNED serving tables
+    (embed_w/embedx_w + the pass key map; no optimizer state), the same
+    persistables pruning as export_ctr_inference."""
+    import os
+
+    from ..core.enforce import enforce
+    from ..io.inference import save_inference_model
+    from .ctr import serving_pull
+
+    enforce(cache.state is not None, "begin_pass first")
+    enforce(cache.device_map is not None,
+            "export_dssm_towers needs device_map=True on the cache")
+    tables = {"embed_w": cache.state["embed_w"],
+              "embedx_w": cache.state["embedx_w"]}
+    map_state = cache.device_map.state
+
+    def tower_fn(slot_ids, tower):
+        slot_hi_d = jnp.asarray(np.asarray(slot_ids, np.uint32))
+        S = int(slot_hi_d.shape[0])
+
+        def fn(params, lo32):
+            B = lo32.shape[0]
+            emb = serving_pull(params["tables"], params["map"], slot_hi_d,
+                               lo32).reshape(B, -1)
+            with _bind_params(tower, params["model"]):
+                x = tower(emb)
+            return _l2_normalize(x)
+
+        return fn, S
+
+    for which, slot_ids, tower in (
+            ("query", query_slot_ids, model.query_tower),
+            ("doc", doc_slot_ids, model.doc_tower)):
+        # each artifact is self-contained (tables + map + ITS tower's
+        # params only — the other tower's weights are pruned, the same
+        # persistables discipline as the tables themselves)
+        serving = {"model": {"params": dict(tower.named_parameters()),
+                             "buffers": {}},
+                   "tables": tables, "map": map_state}
+        fn, S = tower_fn(slot_ids, tower)
+        (b,) = jax.export.symbolic_shape(f"b_{which}")
+        example = (jax.ShapeDtypeStruct((b, S), jnp.uint32),)
+        save_inference_model(os.path.join(dirname, which), fn, serving,
+                             example)
+
+
+@contextlib.contextmanager
+def _bind_params(model, state):
+    """Bind traced params into the model for a TOWER-ONLY call: the
+    towers are sub-Layers, and nn.functional_call on the whole model
+    would demand both towers' inputs — so swap the state with the same
+    primitives functional_call uses (trace-time only, restored after)."""
+    from ..nn.layer import get_state, set_state
+
+    original = get_state(model)
+    set_state(model, {"params": state["params"],
+                      "buffers": state.get("buffers", {})})
+    try:
+        yield
+    finally:
+        set_state(model, original)
