@@ -77,3 +77,32 @@ def test_profiler_host_events():
         pass
     stats = profiler.host_event_stats()
     assert stats["unit_scope"]["count"] == 1
+
+
+def test_profiler_timed_gate_and_retry(monkeypatch):
+    """core.profiler.timed: measurements below the fetch-latency noise
+    floor retry with 5x iters and ultimately fail LOUDLY (a garbage
+    number in a committed artifact is worse than an error)."""
+    import jax.numpy as jnp
+    import pytest
+
+    from paddle_tpu.core import profiler
+
+    # a real (cheap) op on CPU clears the ~µs fetch latency easily
+    t, out = profiler.timed(lambda x: x + 1, jnp.zeros((64,)), iters=3)
+    assert t > 0 and float(out[0]) == 1.0
+
+    # force a huge synthetic fetch latency: the op can never clear it
+    real_fetch = profiler.fetch_sync
+    calls = {"n": 0}
+
+    def slow_fetch(x):
+        calls["n"] += 1
+        import time as _t
+        _t.sleep(0.05)
+        return real_fetch(x)
+
+    monkeypatch.setattr(profiler, "fetch_sync", slow_fetch)
+    with pytest.raises(RuntimeError, match="noise floor"):
+        profiler.timed(lambda x: x + 1, jnp.zeros((4,)), iters=1)
+    assert calls["n"] >= 3 * 4  # warmup+3 lat samples+final, per retry
