@@ -597,23 +597,29 @@ def make_ctr_train_step_from_keys(
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
 
 
-def serving_pull(tables, map_state, slot_hi_d, lo32):
+def serving_pull(tables, map_state, slot_hi_d, lo32, with_real=False):
     """THE serving-side probe→pull ([B, S] lo32 keys → [B, S, 1+dim]
     embeddings) — shared by every serving export so serving and
     training cannot diverge on sentinel masking or row layout: the
     probe is device_hash_lookup and the gather is the training
-    cache_pull (rows ≥ C zero-fill)."""
+    cache_pull (rows ≥ C zero-fill). ``with_real`` also returns the
+    [B, S] 0/1 real-position mask (attention models consume it — the
+    training steps' with_real contract)."""
     B, S = lo32.shape
     C = tables["embed_w"].shape[0]
     hi = jnp.broadcast_to(slot_hi_d[None, :], (B, S)).reshape(-1)
     rows = device_hash_lookup(map_state, hi,
                               lo32.reshape(-1).astype(jnp.uint32))
     rows = jnp.where(rows >= 0, rows, C)
-    return cache_pull(tables, rows).reshape(B, S, -1)
+    emb = cache_pull(tables, rows).reshape(B, S, -1)
+    if with_real:
+        return emb, (rows < C).astype(jnp.float32).reshape(B, S)
+    return emb
 
 
 def export_ctr_inference(dirname: str, model: Layer, cache, slot_ids,
-                         num_dense: int, freeze: bool = False) -> None:
+                         num_dense: int, freeze: bool = False,
+                         with_real: bool = False) -> None:
     """``fleet.save_inference_model`` for the CTR serving path: export
     probe → pull → forward → sigmoid as one portable program
     (io/inference.py StableHLO export). The exported parameters are the
@@ -624,9 +630,12 @@ def export_ctr_inference(dirname: str, model: Layer, cache, slot_ids,
     only referenced persistables) — plus the pass's key→row map.
 
     Serving input: (lo32 [B, S] uint32, dense [B, D] float32) → pctr
-    [B] float32. Missing keys probe to the sentinel and contribute zero
-    embeddings, the serving-side contract for out-of-pass features.
-    """
+    [B] float32 (or a tuple of per-task probabilities for multitask
+    models — sigmoid applies per output leaf). Missing keys probe to
+    the sentinel and contribute zero embeddings, the serving-side
+    contract for out-of-pass features. ``with_real=True`` feeds the
+    model the [B, S] real-position mask as its second argument (the
+    attention family's with_real step contract — DIN)."""
     from ..io.inference import save_inference_model
 
     enforce(cache.state is not None, "begin_pass first", )
@@ -645,11 +654,24 @@ def export_ctr_inference(dirname: str, model: Layer, cache, slot_ids,
 
     def serve_fn(params, lo32, dense_x):
         # the Layer is a trace-time closure, not exported data
-        emb = serving_pull(params["tables"], params["map"], slot_hi_d, lo32)
-        out, _ = nn.functional_call(model, params["model"], emb,
-                                    dense_x.astype(jnp.float32),
+        if with_real:
+            emb, real = serving_pull(params["tables"], params["map"],
+                                     slot_hi_d, lo32, with_real=True)
+            args = (emb, real, dense_x.astype(jnp.float32))
+        else:
+            emb = serving_pull(params["tables"], params["map"], slot_hi_d,
+                               lo32)
+            args = (emb, dense_x.astype(jnp.float32))
+        out, _ = nn.functional_call(model, params["model"], *args,
                                     training=False)
-        return jax.nn.sigmoid(out)
+        # the model's OWN logits→probability mapping when it defines one
+        # (ESMM.predict returns (pCTR, pCTCVR = pCTR·pCVR) — the exact
+        # quantity offline eval scored; serving must not diverge from
+        # it); plain sigmoid per leaf otherwise
+        predict = getattr(type(model), "predict", None)
+        if predict is not None:
+            return predict(out)
+        return jax.tree_util.tree_map(jax.nn.sigmoid, out)
 
     # batch-polymorphic export: serving batch size is a deploy-time choice
     (b,) = jax.export.symbolic_shape("b")

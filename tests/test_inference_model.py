@@ -195,3 +195,88 @@ def test_ctr_serving_export(tmp_path, rng):
     from paddle_tpu.io.checkpoint import load_checkpoint
     saved = load_checkpoint(str(tmp_path / "serve" / "params"))["model"]
     assert set(saved["tables"].keys()) == {"embed_w", "embedx_w"}
+
+
+def test_family_serving_exports(tmp_path, rng):
+    """The export generalizes across the family: DIN (with_real — the
+    attention mask derives from the sentinel in-graph) and ESMM
+    (multitask — sigmoid per output leaf)."""
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import nn
+    from paddle_tpu.io.inference import load_inference_model
+    from paddle_tpu.models.ctr import export_ctr_inference
+    from paddle_tpu.models.din import DIN
+    from paddle_tpu.models.multitask import ESMM
+    from paddle_tpu.models.ctr import CtrConfig
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.embedding_cache import (CacheConfig,
+                                               HbmEmbeddingCache,
+                                               cache_pull)
+    from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+    pt.seed(0)
+    S, D, dim = 6, 3, 4
+    table = MemorySparseTable(TableConfig(
+        shard_num=2, accessor_config=AccessorConfig(embedx_dim=dim)))
+    cache_cfg = CacheConfig(capacity=1 << 8, embedx_dim=dim,
+                            embedx_threshold=0.0)
+    cache = HbmEmbeddingCache(table, cache_cfg, device_map=True)
+    lo = rng.integers(1, 500, size=(32, S)).astype(np.uint64)
+    pool = lo + (np.arange(S, dtype=np.uint64) << np.uint64(32))
+    cache.begin_pass(pool.reshape(-1))
+    cache.state["embedx_w"] = jnp.asarray(
+        rng.normal(size=cache.state["embedx_w"].shape).astype(np.float32))
+
+    B = 4
+    lo32 = (pool[:B] & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    dense = rng.normal(size=(B, D)).astype(np.float32)
+    rows = cache.lookup(pool[:B].reshape(-1))
+    emb = cache_pull(cache.state, jnp.asarray(rows, jnp.int32)).reshape(
+        B, S, -1)
+
+    # DIN: with_real (target cols 0-1, behavior cols 2-5). The LAST
+    # two behavior positions of every row are OUT-OF-PASS keys — the
+    # in-graph sentinel must zero both their embeddings AND their
+    # real-mask entries (an all-in-pass batch would leave the mask
+    # path untested: it would equal the constant ones reference)
+    din = DIN(num_target_cols=2, num_behavior_cols=4, num_dense=D,
+              embedx_dim=dim, dnn_hidden=(8,))
+    export_ctr_inference(str(tmp_path / "din"), din, cache,
+                         slot_ids=np.arange(S), num_dense=D,
+                         with_real=True)
+    lo32_miss = lo32.copy()
+    lo32_miss[:, -2:] = 0xFFFFFF  # not in the pass
+    got = np.asarray(load_inference_model(str(tmp_path / "din"))(
+        jnp.asarray(lo32_miss), jnp.asarray(dense)))
+    emb_m = np.asarray(emb).copy()
+    emb_m[:, -2:, :] = 0.0
+    real_m = np.ones((B, S), np.float32)
+    real_m[:, -2:] = 0.0
+    out, _ = nn.functional_call(
+        din, {"params": dict(din.named_parameters()), "buffers": {}},
+        jnp.asarray(emb_m), jnp.asarray(real_m), jnp.asarray(dense),
+        training=False)
+    np.testing.assert_allclose(got, np.asarray(jax.nn.sigmoid(out)),
+                               rtol=1e-5, atol=1e-6)
+
+    # ESMM: per-leaf sigmoid over (ctr, cvr)
+    esmm = ESMM(CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=dim,
+                          dnn_hidden=(8,)))
+    export_ctr_inference(str(tmp_path / "esmm"), esmm, cache,
+                         slot_ids=np.arange(S), num_dense=D)
+    pred = load_inference_model(str(tmp_path / "esmm"))
+    pctr, pctcvr = pred(jnp.asarray(lo32), jnp.asarray(dense))
+    logits, _ = nn.functional_call(
+        esmm, {"params": dict(esmm.named_parameters()), "buffers": {}},
+        emb, jnp.asarray(dense), training=False)
+    # serving MUST ship the model's own predict mapping: ESMM's second
+    # output is pCTCVR = pCTR * pCVR, the quantity offline eval scored
+    want_pctr, want_pctcvr = ESMM.predict(logits)
+    np.testing.assert_allclose(np.asarray(pctr), np.asarray(want_pctr),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(pctcvr),
+                               np.asarray(want_pctcvr),
+                               rtol=1e-5, atol=1e-6)
+    assert (np.asarray(pctcvr) <= np.asarray(pctr) + 1e-6).all()
