@@ -619,7 +619,7 @@ def serving_pull(tables, map_state, slot_hi_d, lo32, with_real=False):
 
 def export_ctr_inference(dirname: str, model: Layer, cache, slot_ids,
                          num_dense: int, freeze: bool = False,
-                         with_real: bool = False) -> None:
+                         with_real: bool = False, params=None) -> None:
     """``fleet.save_inference_model`` for the CTR serving path: export
     probe → pull → forward → sigmoid as one portable program
     (io/inference.py StableHLO export). The exported parameters are the
@@ -644,8 +644,13 @@ def export_ctr_inference(dirname: str, model: Layer, cache, slot_ids,
             "(the serving program probes the pass's key map in-graph)")
     slot_hi = np.asarray(slot_ids, np.uint32)
     S, D = int(slot_hi.shape[0]), int(num_dense)
+    # ``params``: trained param dict override — trainers whose jitted
+    # steps DONATE their buffers hold the live params themselves; the
+    # Layer's own arrays may be stale/deleted there
     serving = {
-        "model": {"params": dict(model.named_parameters()), "buffers": {}},
+        "model": {"params": dict(params if params is not None
+                                 else model.named_parameters()),
+                  "buffers": {}},
         "tables": {"embed_w": cache.state["embed_w"],
                    "embedx_w": cache.state["embedx_w"]},
         "map": cache.device_map.state,

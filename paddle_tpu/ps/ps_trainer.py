@@ -212,13 +212,37 @@ class CtrPassTrainer:
 
         return infer
 
-    def save_inference_model(self, dirname: str) -> None:
-        """Export the dense serving graph (fleet.save_inference_model on
-        a PS program: the lookup stays server-side — the reference prunes
-        ``distributed_lookup_table`` into the serving split — and the
-        artifact takes (pulled embeddings [B,S,1+dim], dense [B,D]) and
-        returns CTR probabilities). Pair with ``table.pull_sparse`` (or a
-        serving PS client) at inference time."""
+    def save_inference_model(self, dirname: str, fused: bool = False,
+                             keys: Optional[np.ndarray] = None) -> None:
+        """Export the serving artifact, two deploy shapes:
+
+        - default (``fused=False``): the DENSE graph only
+          (fleet.save_inference_model on a PS program — the reference
+          prunes ``distributed_lookup_table`` into the serving split):
+          the artifact takes (pulled embeddings [B,S,1+dim], dense
+          [B,D]) and returns CTR probabilities; pair with
+          ``table.pull_sparse`` (or a serving PS client) at inference
+          time.
+        - ``fused=True``: the WHOLE serving program — in-graph key
+          probe + table pull + forward + sigmoid (models/ctr.py
+          export_ctr_inference) with this trainer's trained params and
+          persistables-pruned tables. Needs an active pass: pass
+          ``keys`` (the serving key universe — a fresh pass is built
+          from the host table) or call before end_pass.
+        """
+        if fused:
+            from ..models.ctr import export_ctr_inference
+
+            if keys is not None:
+                self.cache.begin_pass(np.ascontiguousarray(keys, np.uint64))
+            enforce(self.cache.state is not None,
+                    "no active pass to export: pass `keys` (the serving "
+                    "key universe) or call before end_pass")
+            export_ctr_inference(dirname, self.model, self.cache,
+                                 slot_ids=np.arange(len(self.sparse_slots)),
+                                 num_dense=len(self.dense_slots),
+                                 params=self.params["params"])
+            return
         from ..io.inference import save_inference_model as _save
 
         serve = self._infer_fn()

@@ -665,3 +665,48 @@ def test_dense_push_trajectory_matches_sparse(rng):
     np.testing.assert_array_equal(f_d, f_s)
     assert f_s.sum() > 32  # the sample really hits trained rows
     np.testing.assert_allclose(v_d[f_d], v_s[f_s], rtol=2e-3, atol=2e-4)
+
+
+def test_pass_trainer_save_inference_model(tmp_path, rng):
+    """Trainer-level deploy: train passes, flush, then export the
+    serving program over a chosen key universe; the loaded predictor
+    scores with the TRAINED params (donation-safe) and table values."""
+    import jax
+
+    from paddle_tpu.io.inference import load_inference_model
+
+    pt.seed(0)
+    ds = InMemoryDataset(_slots(), seed=0)
+    ds.load_from_lines(_lines(rng, 1024))
+    cfg = CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=4,
+                    dnn_hidden=(16,))
+    cache_cfg = CacheConfig(capacity=1 << 10, embedx_dim=4,
+                            embedx_threshold=0.0)
+    table = MemorySparseTable(TableConfig(
+        shard_num=4, accessor_config=AccessorConfig(embedx_dim=4)))
+    tr = CtrPassTrainer(DeepFM(cfg), optimizer.Adam(1e-2), table, cache_cfg,
+                        sparse_slots=[f"s{i}" for i in range(S)],
+                        dense_slots=[f"d{i}" for i in range(D)],
+                        label_slot="label")
+    tr.train_from_dataset(ds, batch_size=256)  # ends with end_pass
+
+    # serving universe: slot-tagged vocab 0..63 per slot
+    vocab = np.arange(64, dtype=np.uint64)
+    keys = np.concatenate([
+        vocab + (np.uint64(si) << np.uint64(32)) for si in range(S)])
+    tr.save_inference_model(str(tmp_path / "serve"), fused=True, keys=keys)
+    pred = load_inference_model(str(tmp_path / "serve"))
+
+    import jax.numpy as jnp
+
+    lo32 = rng.integers(0, 64, size=(8, S)).astype(np.uint32)
+    dense = rng.normal(size=(8, D)).astype(np.float32)
+    p = np.asarray(pred(jnp.asarray(lo32), jnp.asarray(dense)))
+    assert p.shape == (8,) and ((p > 0) & (p < 1)).all()
+
+    # the export really carries the TRAINED dense params
+    from paddle_tpu.io.checkpoint import load_checkpoint
+    saved = load_checkpoint(str(tmp_path / "serve" / "params"))["model"]
+    for k, v in tr.params["params"].items():
+        np.testing.assert_array_equal(np.asarray(saved["model"]["params"][k]),
+                                      np.asarray(v), err_msg=k)
