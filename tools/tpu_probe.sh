@@ -42,5 +42,5 @@ print('devices:', d)
   else
     echo "$ts probe: backend init hung/failed (>90s)" >>"$LOG"
   fi
-  sleep 900
+  sleep 300
 done
