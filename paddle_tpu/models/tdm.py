@@ -1,0 +1,202 @@
+"""TDM — tree-based deep match over the sparse PS path.
+
+The reference's tree-retrieval stack (PaddleRec models/treebased/tdm +
+`paddle/fluid/distributed/index_dataset/`): items live at the leaves of
+a K-ary tree (`index_wrapper.cc` TreeIndex), training samples per-layer
+positives (the target's ancestors) and uniform negatives
+(`index_sampler.cc` LayerWiseSampler), every tree NODE owns an
+embedding in the sparse PS, and serving walks the tree with beam
+search, scoring candidates with the trained user×node tower.
+
+TPU shape of the loop: the tree and sampler stay host-side
+(pointer-chasing, data/index_dataset.py), their fixed-shape outputs
+feed ONE jitted step — user-behavior pull (the user is represented by
+the leaf embeddings of their behavior items, masked mean) + candidate
+node pull + DNN score + BCE + push — over the HBM embedding cache;
+beam-search retrieval runs a host loop over levels around a jitted
+padded scorer (the reference's BeamSearchSampler role).
+
+Node keys are the RAW tree codes (one node table, hi=0): behavior
+items score through their leaf codes, so user and candidate towers
+share the single node embedding space, like the reference's one
+`tdm_embedding` table.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.enforce import enforce
+from ..data.index_dataset import LayerWiseSampler, TreeIndex
+from ..nn.layer import Layer
+from ..ps.embedding_cache import CacheConfig, cache_pull, cache_push
+from .ctr import _DNN
+
+__all__ = ["TDM", "make_tdm_train_step", "tdm_sample_batch",
+           "beam_search_retrieve", "node_keys"]
+
+
+def node_keys(codes: np.ndarray) -> np.ndarray:
+    """Tree codes → uint64 feasigns (one node table, hi=0)."""
+    return np.asarray(codes, np.uint64)
+
+
+class TDM(Layer):
+    """forward(user_emb [B,U,1+dim], node_emb [B,T,1+dim], user_real
+    [B,U]) → logits [B,T]: masked-mean user representation from the
+    behavior leaves, concat with each candidate node's embedding,
+    shared DNN scores every (user, node) pair (PaddleRec tdm's
+    input-layer + fc tower)."""
+
+    def __init__(self, embedx_dim: int,
+                 hidden: Tuple[int, ...] = (64, 32)) -> None:
+        super().__init__()
+        d = 1 + embedx_dim
+        self.dnn = _DNN(2 * d, hidden, out_dim=1)
+
+    def forward(self, user_emb: jax.Array, node_emb: jax.Array,
+                user_real: jax.Array) -> jax.Array:
+        B, T = node_emb.shape[0], node_emb.shape[1]
+        w = user_real.astype(jnp.float32)[:, :, None]
+        denom = jnp.maximum(jnp.sum(w, axis=1), 1.0)
+        user = jnp.sum(user_emb * w, axis=1) / denom       # [B, 1+dim]
+        pair = jnp.concatenate(
+            [jnp.broadcast_to(user[:, None, :], (B, T, user.shape[-1])),
+             node_emb], axis=-1)                            # [B, T, 2(1+dim)]
+        return self.dnn(pair.reshape(B * T, -1)).reshape(B, T)
+
+
+def tdm_sample_batch(sampler: LayerWiseSampler, targets: np.ndarray
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """LayerWiseSampler output regrouped to fixed [B, T] (codes, labels)
+    — T is static for a given (tree, layer_counts): one positive +
+    min(count_l, layer_size_l - 1) negatives per sampled layer."""
+    pair, codes, labels = sampler.sample(targets)
+    B = len(targets)
+    T = len(codes) // B
+    enforce(T * B == len(codes),
+            "sampler output is not batch-uniform (tree layers smaller "
+            "than layer_counts at varying depths?)")
+    return (codes.reshape(B, T), labels.reshape(B, T).astype(np.float32))
+
+
+def make_tdm_train_step(model: TDM, optimizer, cache_cfg: CacheConfig,
+                        donate: bool = True) -> Callable:
+    """step(params, opt_state, cache_state, rows_user [B,U],
+    rows_node [B,T], labels [B,T]) → (params, opt_state, cache_state,
+    loss). Rows come from ``cache.lookup`` over node_keys; sentinel
+    rows (padding behavior slots) pull zeros and are masked out of the
+    user mean; pushes: show=1 per touched node, click=label for
+    candidates (the positive ancestor is the "clicked" node)."""
+
+    def step(params, opt_state, cache_state, rows_user, rows_node, labels):
+        B, U = rows_user.shape
+        T = rows_node.shape[1]
+        C = cache_state["embed_w"].shape[0]
+        user_real = (rows_user < C).astype(jnp.float32)
+        emb_u = cache_pull(cache_state, rows_user.reshape(-1)).reshape(
+            B, U, -1)
+        emb_n = cache_pull(cache_state, rows_node.reshape(-1)).reshape(
+            B, T, -1)
+
+        def loss_fn(params, emb_u, emb_n):
+            out, _ = nn.functional_call(model, params, emb_u, emb_n,
+                                        user_real, training=True)
+            per = nn.functional.binary_cross_entropy_with_logits(
+                out, labels, reduction="none")
+            return jnp.mean(per)
+
+        loss, (grads, g_u, g_n) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1, 2))(params, emb_u, emb_n)
+        new_params, new_opt = optimizer.update(grads, opt_state, params)
+
+        all_rows = jnp.concatenate(
+            [rows_user.reshape(-1), rows_node.reshape(-1)])
+        all_grads = jnp.concatenate(
+            [g_u.reshape(B * U, -1), g_n.reshape(B * T, -1)])
+        shows = jnp.concatenate(
+            [user_real.reshape(-1), jnp.ones((B * T,), jnp.float32)])
+        clicks = jnp.concatenate(
+            [jnp.zeros((B * U,), jnp.float32), labels.reshape(-1)])
+        new_cache = cache_push(cache_state, all_rows, all_grads, shows,
+                               clicks, cache_cfg)
+        return new_params, new_opt, new_cache, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
+
+
+def _beam_scorer(model: TDM):
+    """One jitted scorer per model (weak-cached): explicit (params,
+    state, rows…) arguments so serving pays trace+compile once per
+    shape, never per request — a closure over params/state would bake
+    the whole embedding table in as constants and recompile every
+    call."""
+    fn = _SCORERS.get(model)
+    if fn is None:
+        def score(params, state, user_rows, user_real, cand_rows,
+                  cand_mask):
+            dim1 = state["embed_w"].shape[1] + state["embedx_w"].shape[1]
+            emb_u = cache_pull(state, user_rows.reshape(-1)).reshape(
+                1, -1, dim1)
+            emb_n = cache_pull(state, cand_rows.reshape(-1)).reshape(
+                1, cand_rows.shape[1], dim1)
+            out, _ = nn.functional_call(model, params, emb_u, emb_n,
+                                        user_real, training=False)
+            return jnp.where(cand_mask, out[0], -jnp.inf)
+
+        fn = jax.jit(score)
+        _SCORERS[model] = fn
+    return fn
+
+
+_SCORERS = weakref.WeakKeyDictionary()
+
+
+def beam_search_retrieve(tree: TreeIndex, model: TDM, params, cache,
+                         user_items: Sequence[int], k: int = 8
+                         ) -> list:
+    """Serving: walk the tree root→leaves keeping the top-``k`` nodes
+    per level by the trained score (index_sampler.h BeamSearchSampler
+    role). Host loop over levels; each level scores its ≤ k·branch
+    candidates with one jitted padded call (scorer compiled once per
+    model+shape, _beam_scorer). Returns up to ``k`` item ids (beam
+    leaves that are real items, best first)."""
+    C = cache.state["embed_w"].shape[0]
+    user_rows = jnp.asarray(
+        cache.lookup(node_keys([int(tree.get_travel_codes(i)[0])
+                                for i in user_items])), jnp.int32)[None, :]
+    # same convention as the train step: sentinel rows drop out of the
+    # user mean (lookup enforces residency today, but padded callers
+    # must not silently average zero rows in)
+    user_real = (user_rows < C).astype(jnp.float32)
+    score = _beam_scorer(model)
+
+    pad_to = k * tree.branch
+    beam = [0]  # root
+    for level in range(1, tree.height + 1):
+        cand = []
+        for b in beam:
+            for c in range(tree.branch):
+                child = b * tree.branch + 1 + c
+                if child < tree.total_node_num():
+                    cand.append(child)
+        if not cand:
+            break
+        rows = cache.lookup(node_keys(cand))
+        padded = np.full(pad_to, 0, np.int32)
+        mask = np.zeros(pad_to, bool)
+        padded[:len(cand)] = rows
+        mask[:len(cand)] = True
+        s = np.asarray(score(params, cache.state, user_rows, user_real,
+                             jnp.asarray(padded)[None, :],
+                             jnp.asarray(mask)))
+        order = np.argsort(-s[:len(cand)])
+        beam = [cand[i] for i in order[:k]]
+    items = tree.get_items_of_codes(beam)
+    return [i for i in items if i is not None][:k]

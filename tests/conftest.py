@@ -25,6 +25,7 @@ import pytest  # noqa: E402
 # Measured >5s each on the 1-core CI host (round-2 --durations run); the
 # default gate (pytest.ini addopts) excludes them — run all with -m "".
 _SLOW = {
+    "test_tdm_learns_and_retrieves",
     "test_tp_grads_match_serial",
     "test_moe_ep_matches_serial",
     "test_causal_cp_matches_serial",
