@@ -52,7 +52,12 @@ def main() -> None:
     shard_counts = [int(k) for k in
                     os.environ.get("RS_SHARDS", "2,4,8").split(",")]
     capacity = 1 << 18
-    cfg = CacheConfig(capacity=capacity, embedx_dim=dim, embedx_threshold=0.0)
+    # RS_PUSH_MODE: "sparse" (default — the merge_grad shape, the
+    # original artifact) or "dense" (the TPU hot path: per-shard
+    # O(C/K) streaming — its cost FALLS as K grows)
+    push_mode = os.environ.get("RS_PUSH_MODE", "sparse")
+    cfg = CacheConfig(capacity=capacity, embedx_dim=dim,
+                      embedx_threshold=0.0, push_mode=push_mode)
     rng = np.random.default_rng(0)
     devices = jax.devices()
 
@@ -69,7 +74,7 @@ def main() -> None:
         }
 
     out = {"batch": B, "slots": S, "dim": dim, "steps": steps,
-           "capacity": capacity, "modes": {}}
+           "capacity": capacity, "push_mode": push_mode, "modes": {}}
     m_global = B * S  # rows per step, total (each of K devices holds m/K)
 
     for routing in ("alltoall", "allgather"):
@@ -123,8 +128,10 @@ def main() -> None:
         m: round(out["modes"][m][hi] / out["modes"][m][lo], 2)
         for m in out["modes"]
     }
-    path = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "ROUTED_SCALING.json")
+    path = os.environ.get("RS_OUT") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "ROUTED_SCALING.json" if push_mode == "sparse"
+        else "ROUTED_SCALING_DENSE.json")
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out))
