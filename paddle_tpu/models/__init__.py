@@ -5,6 +5,10 @@ from .ctr import (CtrConfig, DCN, DeepFM, WideDeep, XDeepFM,
 from .din import DIN, make_ctr_attention_train_step
 from .dssm import DSSM, make_dssm_train_step
 from .multitask import ESMM, MMoE, make_multitask_train_step
+from .graph_embedding import (DeepWalkConfig, make_deepwalk_train_step,
+                              init_node_embeddings, link_prediction_auc)
+from .tdm import TDM, make_tdm_train_step, beam_search_retrieve
+from .gru4rec import GRU4Rec, make_gru4rec_train_step
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
 from .mobilenet import MobileNetV1, MobileNetV2, mobilenet_v1, mobilenet_v2
@@ -19,6 +23,11 @@ from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_25,
 
 __all__ = ["LeNet", "Ernie", "ErnieConfig",
            "CtrConfig", "DeepFM", "WideDeep", "make_ctr_train_step",
+           "DCN", "XDeepFM", "DIN", "DSSM", "ESMM", "MMoE",
+           "DeepWalkConfig", "make_deepwalk_train_step",
+           "init_node_embeddings", "link_prediction_auc",
+           "TDM", "make_tdm_train_step", "beam_search_retrieve",
+           "GRU4Rec", "make_gru4rec_train_step",
            "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
            "resnet152",
            "VGG", "vgg11", "vgg13", "vgg16", "vgg19",

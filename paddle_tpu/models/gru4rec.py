@@ -1,0 +1,101 @@
+"""GRU4Rec — session-based next-item recall over the sparse PS path
+(PaddleRec models/recall/gru4rec).
+
+The reference runs a GRU over the session's item-embedding sequence
+(its `gru` op per timestep) and scores the next item with a softmax
+over candidates; items live in a sparse embedding table. Here the
+session tower is ``nn.GRU`` (one lax.scan), item embeddings come from
+the HBM embedding cache (keys = item ids, one table), and training
+uses in-batch negatives (each example's target is every other
+example's negative — the DSSM objective, shared), all in ONE jitted
+step: pull sequence + target rows → GRU → project → in-batch softmax →
+push grads to every touched row.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..nn.layer import Layer
+from ..ps.embedding_cache import CacheConfig, cache_pull, cache_push
+from .dssm import DSSM, _l2_normalize
+
+__all__ = ["GRU4Rec", "make_gru4rec_train_step", "item_keys"]
+
+
+def item_keys(item_ids: np.ndarray) -> np.ndarray:
+    """Item ids → uint64 feasigns (one item table, hi=0)."""
+    return np.asarray(item_ids, np.uint64)
+
+
+class GRU4Rec(Layer):
+    """forward(seq_emb [B, T, 1+dim], target_emb [B, 1+dim], lengths
+    [B]) → (session_vec [B, out], item_vec [B, out]) L2-normalized —
+    the two-tower contract, so DSSM.loss_vec (in-batch negatives)
+    scores it unchanged."""
+
+    def __init__(self, embedx_dim: int, hidden: int = 32,
+                 out_dim: int = 16) -> None:
+        super().__init__()
+        d = 1 + embedx_dim
+        self.gru = nn.GRU(d, hidden)
+        self.sess_proj = nn.Linear(hidden, out_dim)
+        self.item_proj = nn.Linear(d, out_dim)
+
+    def forward(self, seq_emb: jax.Array, target_emb: jax.Array,
+                lengths: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        _, h_n = self.gru(seq_emb, lengths)
+        u = self.sess_proj(h_n[-1])
+        v = self.item_proj(target_emb)
+        return _l2_normalize(u), _l2_normalize(v)
+
+
+def make_gru4rec_train_step(model: GRU4Rec, optimizer,
+                            cache_cfg: CacheConfig,
+                            temperature: float = 0.1,
+                            donate: bool = True) -> Callable:
+    """step(params, opt_state, cache_state, rows_seq [B, T],
+    rows_target [B], lengths [B]) → (params, opt_state, cache_state,
+    loss). Sequence padding rows carry the capacity sentinel (zero
+    pull, dropped push) AND sit past ``lengths`` so the GRU freezes
+    through them; in-batch negatives via DSSM.loss_vec."""
+
+    def step(params, opt_state, cache_state, rows_seq, rows_target,
+             lengths):
+        B, T = rows_seq.shape
+        # ONE gather for sequence + target rows (the family pattern —
+        # the push below concatenates the same row set)
+        all_rows = jnp.concatenate([rows_seq.reshape(-1), rows_target])
+        pulled = cache_pull(cache_state, all_rows)
+        emb_seq = pulled[:B * T].reshape(B, T, -1)
+        emb_tgt = pulled[B * T:]
+
+        def loss_fn(params, emb_seq, emb_tgt):
+            (u, v), _ = nn.functional_call(model, params, emb_seq,
+                                           emb_tgt, lengths,
+                                           training=True)
+            per = DSSM.loss_vec((u, v), None, temperature=temperature)
+            return jnp.mean(per)
+
+        loss, (grads, g_seq, g_tgt) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1, 2))(params, emb_seq, emb_tgt)
+        new_params, new_opt = optimizer.update(grads, opt_state, params)
+
+        C = cache_state["embed_w"].shape[0]
+        seq_real = (rows_seq.reshape(-1) < C).astype(jnp.float32)
+        all_grads = jnp.concatenate(
+            [g_seq.reshape(B * T, -1), g_tgt])
+        shows = jnp.concatenate(
+            [seq_real, jnp.ones((B,), jnp.float32)])
+        clicks = jnp.concatenate(
+            [jnp.zeros((B * T,), jnp.float32), jnp.ones((B,), jnp.float32)])
+        new_cache = cache_push(cache_state, all_rows, all_grads, shows,
+                               clicks, cache_cfg)
+        return new_params, new_opt, new_cache, loss
+
+    return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())

@@ -12,6 +12,7 @@ from .layer import (
     rng_guard,
     set_state,
 )
+from .rnn import GRU, LSTM
 from .layers import (
     AdaptiveAvgPool2D,
     AvgPool2D,

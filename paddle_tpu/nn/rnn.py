@@ -1,0 +1,171 @@
+"""Recurrent layers (``paddle.nn.GRU`` / ``paddle.nn.LSTM`` analogues).
+
+The reference executes RNNs as per-timestep ops inside its interpreter
+(operators/rnn_op, cudnn on GPU); here the whole sequence is ONE
+``lax.scan`` per layer — the TPU-legal recurrence form (static trip
+count, carried state, XLA fuses the gate math into a few kernels per
+step). Batch-major [B, T, D] in/out, stacked layers, optional
+per-example lengths mask (positions ≥ length carry the last real state
+forward and output zeros — the padded-batch contract the rest of the
+framework uses).
+
+Gate order follows paddle's weight layout: GRU concatenates
+[reset, update, candidate] (r, z, c) along the 3H axis; LSTM
+concatenates [input, forget, cell, output] (i, f, c, o) along 4H —
+ported checkpoints keep their column meaning.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .layer import Layer
+
+__all__ = ["GRU", "LSTM"]
+
+
+def _uniform(bound):
+    def init(key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+    return init
+
+
+class _RNNBase(Layer):
+    def __init__(self, input_size: int, hidden_size: int,
+                 num_layers: int, gates: int) -> None:
+        super().__init__()
+        self.input_size = int(input_size)
+        self.hidden_size = int(hidden_size)
+        self.num_layers = int(num_layers)
+        bound = 1.0 / np.sqrt(hidden_size)
+        for l in range(num_layers):
+            d_in = input_size if l == 0 else hidden_size
+            self.create_parameter(f"w_ih_{l}", (d_in, gates * hidden_size),
+                                  initializer=_uniform(bound))
+            self.create_parameter(f"w_hh_{l}", (hidden_size, gates * hidden_size),
+                                  initializer=_uniform(bound))
+            self.create_parameter(f"b_ih_{l}", (gates * hidden_size,),
+                                  init_value=np.zeros(gates * hidden_size,
+                                                      np.float32))
+            self.create_parameter(f"b_hh_{l}", (gates * hidden_size,),
+                                  init_value=np.zeros(gates * hidden_size,
+                                                      np.float32))
+
+    def _mask(self, lengths, T):
+        if lengths is None:
+            return None
+        return (jnp.arange(T)[None, :]
+                < lengths.astype(jnp.int32)[:, None])  # [B, T]
+
+
+class GRU(_RNNBase):
+    """forward(x [B, T, D], lengths [B]? ) → (out [B, T, H], h_n
+    [num_layers, B, H]). Padded steps (t ≥ length) freeze the state and
+    output zeros."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 num_layers: int = 1) -> None:
+        super().__init__(input_size, hidden_size, num_layers, gates=3)
+
+    def forward(self, x: jax.Array,
+                lengths: Optional[jax.Array] = None
+                ) -> Tuple[jax.Array, jax.Array]:
+        B, T = x.shape[0], x.shape[1]
+        H = self.hidden_size
+        mask = self._mask(lengths, T)
+        finals = []
+        for l in range(self.num_layers):
+            w_ih = getattr(self, f"w_ih_{l}")
+            w_hh = getattr(self, f"w_hh_{l}")
+            b_ih = getattr(self, f"b_ih_{l}")
+            b_hh = getattr(self, f"b_hh_{l}")
+            # batch the input projection over all timesteps at once
+            # (one big MXU matmul); only the recurrent half scans
+            xg = x @ w_ih + b_ih                        # [B, T, 3H]
+
+            def step(h, inp):
+                xg_t, m_t = inp
+                hg = h @ w_hh + b_hh                     # [B, 3H]
+                r = jax.nn.sigmoid(xg_t[:, :H] + hg[:, :H])
+                z = jax.nn.sigmoid(xg_t[:, H:2 * H] + hg[:, H:2 * H])
+                c = jnp.tanh(xg_t[:, 2 * H:] + r * hg[:, 2 * H:])
+                h_new = (1.0 - z) * c + z * h
+                if m_t is not None:
+                    keep = m_t[:, None]
+                    h_new = jnp.where(keep, h_new, h)
+                    out = jnp.where(keep, h_new, 0.0)
+                else:
+                    out = h_new
+                return h_new, out
+
+            h0 = jnp.zeros((B, H), x.dtype)
+            xs = (jnp.swapaxes(xg, 0, 1),
+                  jnp.swapaxes(mask, 0, 1) if mask is not None else None)
+            if mask is None:
+                h_n, outs = lax.scan(lambda h, xg_t: step(h, (xg_t, None)),
+                                     h0, xs[0])
+            else:
+                h_n, outs = lax.scan(step, h0, xs)
+            x = jnp.swapaxes(outs, 0, 1)                 # [B, T, H]
+            finals.append(h_n)
+        return x, jnp.stack(finals)
+
+
+class LSTM(_RNNBase):
+    """forward(x [B, T, D], lengths [B]?) → (out [B, T, H],
+    (h_n, c_n) each [num_layers, B, H])."""
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 num_layers: int = 1) -> None:
+        super().__init__(input_size, hidden_size, num_layers, gates=4)
+
+    def forward(self, x: jax.Array,
+                lengths: Optional[jax.Array] = None):
+        B, T = x.shape[0], x.shape[1]
+        H = self.hidden_size
+        mask = self._mask(lengths, T)
+        h_finals, c_finals = [], []
+        for l in range(self.num_layers):
+            w_ih = getattr(self, f"w_ih_{l}")
+            w_hh = getattr(self, f"w_hh_{l}")
+            b_ih = getattr(self, f"b_ih_{l}")
+            b_hh = getattr(self, f"b_hh_{l}")
+            xg = x @ w_ih + b_ih                         # [B, T, 4H]
+
+            def step(carry, inp):
+                h, c = carry
+                xg_t, m_t = inp
+                g = xg_t + h @ w_hh + b_hh               # [B, 4H]
+                i = jax.nn.sigmoid(g[:, :H])
+                f = jax.nn.sigmoid(g[:, H:2 * H])
+                cc = jnp.tanh(g[:, 2 * H:3 * H])
+                o = jax.nn.sigmoid(g[:, 3 * H:])
+                c_new = f * c + i * cc
+                h_new = o * jnp.tanh(c_new)
+                if m_t is not None:
+                    keep = m_t[:, None]
+                    h_new = jnp.where(keep, h_new, h)
+                    c_new = jnp.where(keep, c_new, c)
+                    out = jnp.where(keep, h_new, 0.0)
+                else:
+                    out = h_new
+                return (h_new, c_new), out
+
+            init = (jnp.zeros((B, H), x.dtype), jnp.zeros((B, H), x.dtype))
+            xs_t = jnp.swapaxes(xg, 0, 1)
+            if mask is None:
+                (h_n, c_n), outs = lax.scan(
+                    lambda hc, xg_t: step(hc, (xg_t, None)), init, xs_t)
+            else:
+                (h_n, c_n), outs = lax.scan(
+                    step, init, (xs_t, jnp.swapaxes(mask, 0, 1)))
+            x = jnp.swapaxes(outs, 0, 1)
+            h_finals.append(h_n)
+            c_finals.append(c_n)
+        return x, (jnp.stack(h_finals), jnp.stack(c_finals))
