@@ -122,7 +122,7 @@ def _run_measurement() -> None:
 
     import paddle_tpu as pt
     from paddle_tpu import optimizer
-    from paddle_tpu.models.ctr import (CtrConfig, DeepFM, pack_ctr_batch,
+    from paddle_tpu.models.ctr import (CtrConfig, DeepFM,
                                        make_ctr_train_step_packed,
                                        make_ctr_train_step_slab)
     from paddle_tpu.ps.accessor import AccessorConfig

@@ -638,7 +638,7 @@ def export_ctr_inference(dirname: str, model: Layer, cache, slot_ids,
     attention family's with_real step contract — DIN)."""
     from ..io.inference import save_inference_model
 
-    enforce(cache.state is not None, "begin_pass first", )
+    enforce(cache.state is not None, "begin_pass first")
     enforce(cache.device_map is not None,
             "export_ctr_inference needs device_map=True on the cache "
             "(the serving program probes the pass's key map in-graph)")

@@ -100,10 +100,13 @@ def make_tdm_train_step(model: TDM, optimizer, cache_cfg: CacheConfig,
         T = rows_node.shape[1]
         C = cache_state["embed_w"].shape[0]
         user_real = (rows_user < C).astype(jnp.float32)
-        emb_u = cache_pull(cache_state, rows_user.reshape(-1)).reshape(
-            B, U, -1)
-        emb_n = cache_pull(cache_state, rows_node.reshape(-1)).reshape(
-            B, T, -1)
+        # ONE gather for user + candidate rows (the push below
+        # concatenates the same row set)
+        all_rows = jnp.concatenate(
+            [rows_user.reshape(-1), rows_node.reshape(-1)])
+        pulled = cache_pull(cache_state, all_rows)
+        emb_u = pulled[:B * U].reshape(B, U, -1)
+        emb_n = pulled[B * U:].reshape(B, T, -1)
 
         def loss_fn(params, emb_u, emb_n):
             out, _ = nn.functional_call(model, params, emb_u, emb_n,
@@ -116,8 +119,6 @@ def make_tdm_train_step(model: TDM, optimizer, cache_cfg: CacheConfig,
             loss_fn, argnums=(0, 1, 2))(params, emb_u, emb_n)
         new_params, new_opt = optimizer.update(grads, opt_state, params)
 
-        all_rows = jnp.concatenate(
-            [rows_user.reshape(-1), rows_node.reshape(-1)])
         all_grads = jnp.concatenate(
             [g_u.reshape(B * U, -1), g_n.reshape(B * T, -1)])
         shows = jnp.concatenate(
