@@ -8,7 +8,7 @@ of NCCL/brpc, and C++ for host-side native components (slot parsing,
 feasign sharding, host tables). See SURVEY.md for the reference map.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"  # round 3
 
 from . import core, data, io, metrics, models, nn, optimizer
 from .core import (
