@@ -400,3 +400,19 @@ class TestCostModel:
         y = rng.integers(0, 4, 16).astype(np.int32)
         losses = eng.fit([((x,), (y,))] * 6)
         assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+    def test_nothing_fits_falls_back_to_memory_minimizing(self):
+        """When no plan fits the budget, the binding constraint is
+        memory: choose_strategy must return the candidate with the
+        smallest per-device state (largest usable mp), not the
+        comm-cheapest (pure dp — the WORST memory choice)."""
+        m = _Mlp(d=16, h=32)
+        mesh, ann, cands = auto.choose_strategy(
+            m, batch_tokens=64, n_devices=8, per_device_bytes=1.0)
+        assert not any(c["fits"] for c in cands)
+        chosen = next(c for c in cands
+                      if c["dp"] == mesh.jax_mesh.shape["dp"]
+                      and c["mp"] == mesh.jax_mesh.shape["mp"])
+        assert chosen["per_device_state_bytes"] == min(
+            c["per_device_state_bytes"] for c in cands)
+        assert mesh.jax_mesh.shape["mp"] > 1 and ann
