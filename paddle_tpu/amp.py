@@ -28,7 +28,7 @@ from typing import Any, Callable, Dict, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["auto_cast", "amp_guard", "cast_model_inputs", "GradScaler", "LossScaleState"]
+__all__ = ["auto_cast", "amp_guard", "cast_model_inputs", "step_ctx", "GradScaler", "LossScaleState"]
 
 PyTree = Any
 
@@ -166,3 +166,17 @@ class GradScaler:
         good = jnp.where(grow, 0, good)
         bad = jnp.where(shrink, 0, bad)
         return LossScaleState(scale, good, bad)
+
+
+def step_ctx(enable: bool, dtype: str = "bfloat16"):
+    """THE amp-inside-the-traced-body pattern, shared by every step
+    builder (executor.make_train_step, the CTR factories): returns
+    ``auto_cast(enable=True, dtype=...)`` when enabled and a TRUE no-op
+    ``nullcontext`` otherwise — entering auto_cast(enable=False) would
+    stomp an amp state set by an enclosing call-site context (the two
+    patterns must compose). Placing the context inside the traced body
+    makes precision a property of the compiled step, immune to
+    auto_cast's trace-time call-site pitfall."""
+    if enable:
+        return auto_cast(enable=True, dtype=dtype)
+    return contextlib.nullcontext()

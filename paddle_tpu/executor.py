@@ -49,17 +49,10 @@ def make_train_step(
     the mode a property of the step, immune to auto_cast's trace-time
     call-site pitfall.
     """
-    import contextlib
-
-    from .amp import auto_cast
+    from .amp import step_ctx
 
     def step(state, opt_state, rng, inputs, labels):
-        # amp=False must be a NO-OP context, not auto_cast(enable=False):
-        # entering the disabled context would stomp an amp state set by
-        # an enclosing call-site auto_cast (the two patterns compose)
-        ctx = auto_cast(enable=True, dtype=amp_dtype) if amp \
-            else contextlib.nullcontext()
-        with ctx:
+        with step_ctx(amp, amp_dtype):
             def compute_loss(params):
                 out, new_state = nn.functional_call(
                     model,

@@ -30,6 +30,7 @@ from .. import nn
 from ..core.enforce import enforce, enforce_eq
 from ..nn.layer import Layer
 from ..ps.device_hash import device_hash_lookup
+from ..amp import step_ctx
 from ..ps.embedding_cache import CacheConfig, cache_pull, cache_push
 
 __all__ = ["CtrConfig", "DeepFM", "WideDeep", "DCN", "XDeepFM",
@@ -309,6 +310,7 @@ def make_ctr_pooled_train_step(
     cache_cfg: CacheConfig,
     slot_of_column,
     donate: bool = True,
+    amp: bool = False,
 ) -> Callable:
     """GPUPS step for MULTI-VALUED sparse slots: each slot carries up to
     max_len feasigns per example and their embeddings SUM-POOL into the
@@ -332,6 +334,7 @@ def make_ctr_pooled_train_step(
 
     def step(params, opt_state, cache_state, rows, dense_x, labels,
              weights=None):
+      with step_ctx(amp):
         # same narrow-wire contract as _ctr_step_body: f16/int8 inputs
         # up-cast here, compute is f32
         dense_x = dense_x.astype(jnp.float32)
@@ -450,6 +453,7 @@ def make_ctr_train_step_packed(
     num_dense: int,
     with_weights: bool = False,
     donate: bool = True,
+    amp: bool = False,
 ) -> Callable:
     """The from-keys GPUPS step over a SINGLE packed wire buffer
     (``pack_ctr_batch``): the step bitcasts the buffer back into
@@ -465,13 +469,14 @@ def make_ctr_train_step_packed(
 
     def step(params, opt_state, cache_state, map_state, packed):
         enforce_eq(packed.shape[0], total, "packed batch size")
-        lo, dense_x, labels, weights = _unpack_ctr(
-            packed, B, S, D, o_dense, o_label, o_weight, with_weights)
-        hi = jnp.broadcast_to(slot_hi[None, :], (B, S)).reshape(-1)
-        rows = _lookup_rows(cache_state, map_state, hi, lo)
-        return _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
-                              cache_state, rows, B, S, dense_x, labels,
-                              weights)
+        with step_ctx(amp):
+            lo, dense_x, labels, weights = _unpack_ctr(
+                packed, B, S, D, o_dense, o_label, o_weight, with_weights)
+            hi = jnp.broadcast_to(slot_hi[None, :], (B, S)).reshape(-1)
+            rows = _lookup_rows(cache_state, map_state, hi, lo)
+            return _ctr_step_body(model, optimizer, cache_cfg, params,
+                                  opt_state, cache_state, rows, B, S,
+                                  dense_x, labels, weights)
 
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
 
@@ -486,6 +491,7 @@ def make_ctr_train_step_slab(
     slab: int,
     with_weights: bool = False,
     donate: bool = True,
+    amp: bool = False,
 ) -> Callable:
     """``slab`` packed train steps per DISPATCH: a ``lax.scan`` over a
     device-resident [slab, total] stack of packed wire buffers runs the
@@ -523,8 +529,9 @@ def make_ctr_train_step_slab(
                 cache_state, rows, B, S, dense_x, labels, weights)
             return (params, opt_state, cache_state), loss
 
-        (params, opt_state, cache_state), losses = lax.scan(
-            one, (params, opt_state, cache_state), packed_slab)
+        with step_ctx(amp):
+            (params, opt_state, cache_state), losses = lax.scan(
+                one, (params, opt_state, cache_state), packed_slab)
         return params, opt_state, cache_state, losses
 
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
@@ -545,6 +552,7 @@ def make_ctr_train_step_from_keys(
     cache_cfg: CacheConfig,
     slot_ids=None,
     donate: bool = True,
+    amp: bool = False,
 ) -> Callable:
     """GPUPS step with IN-GRAPH key lookup — the architecture the
     reference uses on GPU (PSGPUWorker: CopyKeys then device
@@ -573,10 +581,11 @@ def make_ctr_train_step_from_keys(
 
     def _finish(params, opt_state, cache_state, hi, lo, B, S, dense_x,
                 labels, map_state, weights):
-        rows = _lookup_rows(cache_state, map_state, hi, lo)
-        return _ctr_step_body(model, optimizer, cache_cfg, params, opt_state,
-                              cache_state, rows, B, S, dense_x, labels,
-                              weights)
+        with step_ctx(amp):
+            rows = _lookup_rows(cache_state, map_state, hi, lo)
+            return _ctr_step_body(model, optimizer, cache_cfg, params,
+                                  opt_state, cache_state, rows, B, S,
+                                  dense_x, labels, weights)
 
     if slot_ids is not None:
         def step(params, opt_state, cache_state, map_state, keys_lo,

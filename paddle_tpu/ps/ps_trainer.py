@@ -109,6 +109,7 @@ class CtrPassTrainer:
         label_slot: str,
         prefetch_depth: int = 3,
         slab: int = 1,
+        amp: bool = False,
     ) -> None:
         self.model = model
         self.optimizer = optimizer
@@ -122,6 +123,9 @@ class CtrPassTrainer:
         #: bitwise-identical to sequential steps, amortizes the
         #: per-dispatch host cost; tail batches run single steps)
         self.slab = int(slab)
+        #: bf16 contractions in the dense tower (f32 accumulation and
+        #: state) — precision is a property of the compiled steps
+        self.amp = bool(amp)
 
         self.params = {"params": dict(model.named_parameters()), "buffers": {}}
         self.opt_state = optimizer.init(self.params)
@@ -137,7 +141,8 @@ class CtrPassTrainer:
         if step is None:
             kw = dict(slot_ids=np.arange(len(self.sparse_slots)),
                       batch_size=batch_size,
-                      num_dense=len(self.dense_slots), with_weights=True)
+                      num_dense=len(self.dense_slots), with_weights=True,
+                      amp=self.amp)
             if slab > 1:
                 step = make_ctr_train_step_slab(
                     self.model, self.optimizer, self.cache.config,

@@ -26,6 +26,7 @@ import pytest  # noqa: E402
 # default gate (pytest.ini addopts) excludes them — run all with -m "".
 _SLOW = {
     "test_tdm_learns_and_retrieves",
+    "test_pass_trainer_amp_trains",
     "test_tp_grads_match_serial",
     "test_moe_ep_matches_serial",
     "test_causal_cp_matches_serial",

@@ -710,3 +710,53 @@ def test_pass_trainer_save_inference_model(tmp_path, rng):
     for k, v in tr.params["params"].items():
         np.testing.assert_array_equal(np.asarray(saved["model"]["params"][k]),
                                       np.asarray(v), err_msg=k)
+
+
+def test_pass_trainer_amp_trains(rng):
+    """CtrPassTrainer(amp=True): bf16 contractions are in the compiled
+    step (precision is a step property, not a call-site context) and
+    training still learns."""
+    pt.seed(0)
+    ds = InMemoryDataset(_slots(), seed=0)
+    ds.load_from_lines(_lines(rng, 1024))
+    cfg = CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=4,
+                    dnn_hidden=(16,))
+    table = MemorySparseTable(TableConfig(
+        shard_num=4, accessor_config=AccessorConfig(embedx_dim=4)))
+    tr = CtrPassTrainer(
+        DeepFM(cfg), optimizer.Adam(1e-2), table,
+        CacheConfig(capacity=1 << 10, embedx_dim=4, embedx_threshold=0.0),
+        sparse_slots=[f"s{i}" for i in range(S)],
+        dense_slots=[f"d{i}" for i in range(D)], label_slot="label",
+        amp=True)
+    losses = [tr.train_from_dataset(ds, batch_size=256)["loss"]
+              for _ in range(4)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+    from paddle_tpu.models.ctr import make_ctr_train_step_packed
+    step = make_ctr_train_step_packed(
+        DeepFM(cfg), optimizer.Adam(1e-2),
+        CacheConfig(capacity=1 << 10, embedx_dim=4, embedx_threshold=0.0),
+        slot_ids=np.arange(S), batch_size=8, num_dense=D, donate=False,
+        amp=True)
+    # bf16 must be IN the lowered program regardless of call site
+    import jax
+    from paddle_tpu.models.ctr import make_random_packs
+    from paddle_tpu.ps.embedding_cache import HbmEmbeddingCache
+
+    cache = HbmEmbeddingCache(table, CacheConfig(
+        capacity=1 << 10, embedx_dim=4, embedx_threshold=0.0),
+        device_map=True)
+    pool = np.arange(64, dtype=np.uint64).reshape(-1, 1) + \
+        (np.arange(S, dtype=np.uint64) << np.uint64(32))[None, :]
+    cache.begin_pass(pool.reshape(-1))
+    m = DeepFM(cfg)
+    params = {"params": dict(m.named_parameters()), "buffers": {}}
+    opt_state = optimizer.Adam(1e-2).init(params)
+    packs = make_random_packs(np.random.default_rng(0), pool, 8, D, 1)
+    import jax.numpy as jnp
+    txt = step.lower(params, opt_state, cache.state,
+                     cache.device_map.state,
+                     jnp.asarray(packs[0])).as_text()
+    assert "bf16" in txt
