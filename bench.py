@@ -186,23 +186,22 @@ def _run_measurement() -> None:
     # sync discipline: a tiny D2H fetch, NOT block_until_ready, which
     # the axon relay can satisfy before the computation finishes — THE
     # shared sync primitive (see its docstring for the measurement)
-    from paddle_tpu.amp import auto_cast
     from paddle_tpu.core.profiler import fetch_sync as _sync
     from paddle_tpu.data.prefetcher import device_prefetch
 
-    def build_step(ccfg):
+    def build_step(ccfg, use_amp):
         if slab > 1:
             return make_ctr_train_step_slab(
                 model, opt, ccfg, slot_ids=np.arange(26), batch_size=batch,
-                num_dense=cfg.num_dense, slab=slab)
+                num_dense=cfg.num_dense, slab=slab, amp=use_amp)
         return make_ctr_train_step_packed(
             model, opt, ccfg, slot_ids=np.arange(26), batch_size=batch,
-            num_dense=cfg.num_dense)
+            num_dense=cfg.num_dense, amp=use_amp)
 
     def run_attempt(ccfg, use_amp):
         """Full warmup + measurement for one (push_mode, amp) config.
         Raises on compile/run failure; the caller rebuilds state."""
-        step = build_step(ccfg)
+        step = build_step(ccfg, use_amp)
         params = {"params": {k: jnp.asarray(v) for k, v in params0.items()},
                   "buffers": {}}
         opt_state = opt.init(params)
@@ -213,21 +212,20 @@ def _run_measurement() -> None:
             (batches[i % n_batches] for i in range(warmup + steps)), depth=3)
         feeder = iter(prefetcher)
         try:
-            # auto_cast is consulted at TRACE time (first call below), so
-            # the context wraps the loops, not the step construction
-            with auto_cast(enable=use_amp):
-                for i in range(warmup):
-                    params, opt_state, cache_state, loss = step(
-                        params, opt_state, cache_state, map_state,
-                        next(feeder))
-                _sync(loss)
-                t0 = time.perf_counter()
-                for i in range(steps):
-                    params, opt_state, cache_state, loss = step(
-                        params, opt_state, cache_state, map_state,
-                        next(feeder))
-                _sync(loss)
-                dt = time.perf_counter() - t0
+            # amp is a property of the built step (factory amp=), not of
+            # this call site
+            for i in range(warmup):
+                params, opt_state, cache_state, loss = step(
+                    params, opt_state, cache_state, map_state,
+                    next(feeder))
+            _sync(loss)
+            t0 = time.perf_counter()
+            for i in range(steps):
+                params, opt_state, cache_state, loss = step(
+                    params, opt_state, cache_state, map_state,
+                    next(feeder))
+            _sync(loss)
+            dt = time.perf_counter() - t0
         finally:
             prefetcher.close()
         cache.state = cache_state

@@ -107,7 +107,8 @@ def _run(table, pop, n_days, n_records, hot_budget, base, S, D, dim):
         CacheConfig(capacity=1 << 18, embedx_dim=dim, embedx_threshold=0.0),
         sparse_slots=[f"s{i}" for i in range(S)],
         dense_slots=[f"d{i}" for i in range(D)], label_slot="label",
-        slab=int(os.environ.get("WD_SLAB", "1")))
+        slab=int(os.environ.get("WD_SLAB", "1")),
+        amp=os.environ.get("WD_AMP", "0") == "1")
 
     days = [make_day(d) for d in range(n_days)]
     t0 = time.perf_counter()
