@@ -257,6 +257,11 @@ def _run_measurement() -> None:
             print(f"bench: attempt {name!r} failed, degrading: {e}",
                   file=sys.stderr)
             if idx + 1 < len(attempts):  # state rebuild only helps a retry
+                # benchmark-only: begin_pass without end_pass deliberately
+                # DROPS the failed attempt's device-side pass state (fresh
+                # rebuild from the host table; run_attempt writes
+                # cache.state back only on success). Training loops must
+                # end_pass first — don't copy this pattern.
                 cache.begin_pass(pool.reshape(-1))
     if dt is None:
         raise RuntimeError("; ".join(errors))

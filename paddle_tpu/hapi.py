@@ -85,7 +85,15 @@ class Model:
         knob (hapi/model.py amp_configs) — accepts "O1"/"O2", True, or a
         dict with "level"; anything except None/"O0"/False enables bf16
         contractions in the train step (amp is a property of the step —
-        executor.make_train_step(amp=True))."""
+        executor.make_train_step(amp=True)).
+
+        Note: "O2" is treated the same as "O1" here (bf16 contractions,
+        f32 params/master weights). The reference's O2 additionally casts
+        parameters to the low dtype ("pure fp16/bf16" with decorated
+        master weights); on TPU the O1 scheme is the idiomatic choice —
+        bf16 MXU matmuls with f32 accumulation/params — and loses no MXU
+        throughput, so ported O2 configs get O1 semantics rather than
+        bf16 parameter storage."""
         self._opt = optimizer
         self._loss = loss
         self._metrics = list(metrics or [])

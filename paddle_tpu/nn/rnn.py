@@ -11,8 +11,13 @@ framework uses).
 
 Gate order follows paddle's weight layout: GRU concatenates
 [reset, update, candidate] (r, z, c) along the 3H axis; LSTM
-concatenates [input, forget, cell, output] (i, f, c, o) along 4H —
-ported checkpoints keep their column meaning.
+concatenates [input, forget, cell, output] (i, f, c, o) along 4H.
+
+Checkpoint layout: weights here are stored [in, gates*H] (right-matmul
+``x @ w``), TRANSPOSED relative to the reference's rnn ``weight_ih``/
+``weight_hh`` [gates*hidden, in] layout. Ported paddle RNN weights must
+be transposed on import — gate-chunk ORDER along the gates*H axis is
+preserved, only the axes swap. Use :func:`import_paddle_rnn_weight`.
 """
 
 from __future__ import annotations
@@ -24,9 +29,21 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..core.enforce import InvalidArgumentError, enforce
 from .layer import Layer
 
-__all__ = ["GRU", "LSTM"]
+__all__ = ["GRU", "LSTM", "import_paddle_rnn_weight"]
+
+
+def import_paddle_rnn_weight(w):
+    """Convert a reference rnn ``weight_ih``/``weight_hh`` matrix
+    ([gates*hidden, in]) to this module's [in, gates*H] layout. Gate
+    chunk order (r,z,c / i,f,c,o) is unchanged; biases need no
+    conversion."""
+    w = np.asarray(w)
+    enforce(w.ndim == 2, f"expected a 2-D rnn weight, got shape {w.shape}",
+            InvalidArgumentError)
+    return np.ascontiguousarray(w.T)
 
 
 def _uniform(bound):

@@ -157,16 +157,18 @@ def main() -> None:
 
     # whole slab step (bench inner loop), amp
     slab = 8
+    # factory-level amp (not a call-site auto_cast context — the step is
+    # factory-built and the first trace must see the amp flag)
     step = make_ctr_train_step_slab(model, optimizer.Adam(1e-3), cache_cfg,
                                     slot_ids=np.arange(26), batch_size=batch,
-                                    num_dense=13, slab=slab, donate=False)
+                                    num_dense=13, slab=slab, donate=False,
+                                    amp=True)
     packs = jnp.asarray(np.stack(make_random_packs(rng, pool, batch, 13, slab)))
     opt_state = optimizer.Adam(1e-3).init(params)
-    with auto_cast(enable=True):
-        leg("slab8_dispatch", lambda: timed(
-            jax.jit(lambda p, o, cs, m, pk: step(p, o, cs, m, pk)[3]),
-            params, opt_state, cache.state, ms, packs,
-            iters=max(2, iters // slab)))
+    leg("slab8_dispatch", lambda: timed(
+        jax.jit(lambda p, o, cs, m, pk: step(p, o, cs, m, pk)[3]),
+        params, opt_state, cache.state, ms, packs,
+        iters=max(2, iters // slab)))
     if isinstance(result["ms"].get("slab8_dispatch"), float):
         per = result["ms"]["slab8_dispatch"] / slab
         result["per_step_ms"] = round(per, 3)

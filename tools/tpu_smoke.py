@@ -185,24 +185,22 @@ def main() -> None:
                                        make_random_packs)
 
     slab_n = 8
+    # amp mirror of bench.py: factory-level amp (bf16 dense tower on the
+    # MXU, state/push math stays f32) — not a call-site auto_cast, which
+    # only works if the first trace happens inside the context
     step_sl = make_ctr_train_step_slab(model, opt, cache_cfg,
                                        slot_ids=np.arange(26),
                                        batch_size=batch, num_dense=13,
-                                       slab=slab_n, donate=False)
+                                       slab=slab_n, donate=False, amp=True)
     packs_d = jnp.asarray(np.stack(
         make_random_packs(rng, pool, batch, 13, slab_n)))
 
     def slab_once(packs_d):
         return step_sl(params, opt_state, cache.state, ms, packs_d)[3]
 
-    # amp mirror of bench.py: trace the slab step under auto_cast so the
-    # dense tower hits the MXU in bf16 (state/push math stays f32)
-    from paddle_tpu.amp import auto_cast
-
     def leg_slab():
-        with auto_cast(enable=True):
-            t_slab, _ = _timed(jax.jit(slab_once), packs_d,
-                               iters=max(2, iters // slab_n))
+        t_slab, _ = _timed(jax.jit(slab_once), packs_d,
+                           iters=max(2, iters // slab_n))
         return {
             "batch": batch, "slab": slab_n, "amp": True,
             "dispatch_ms": round(t_slab * 1e3, 3),
