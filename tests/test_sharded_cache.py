@@ -386,7 +386,39 @@ def test_sharded_ctr_end_to_end_vs_single_device(rng):
     np.testing.assert_allclose(got_vals, ref_vals, rtol=2e-4, atol=1e-5)
 
 
-@pytest.mark.parametrize("routing", ["alltoall", "allgather"])
+def test_select_routing_rule():
+    """The calibrated decision rule (tools/routed_grid.py →
+    ROUTED_GRID.json): never mix sides (mixed combos pay both the dedup
+    sort and the full-batch gather — measured worst), route both at
+    K ≥ 4, gather both below."""
+    from paddle_tpu.ps.sharded_cache import select_routing
+
+    for push_mode in ("dense", "sparse"):
+        assert select_routing(1024, 1 << 14, 2, push_mode) == (
+            "allgather", "allgather")
+        for k in (4, 8, 64):
+            assert select_routing(1024, 1 << 14, k, push_mode) == (
+                "alltoall", "alltoall")
+    with pytest.raises(Exception, match="push_mode"):
+        select_routing(1024, 1 << 14, 8, "bogus")
+
+
+def test_routing_arg_validation():
+    from paddle_tpu.core.enforce import EnforceNotMet
+
+    ccfg = CtrConfig(num_sparse_slots=2, num_dense=2, embedx_dim=4)
+    cache_cfg = CacheConfig(capacity=1 << 10, embedx_dim=4)
+    model = DeepFM(ccfg)
+    opt = optimizer.Adam(1e-3)
+    for bad in ("routed", ("alltoall",), ("alltoall", "nope"), 7):
+        with pytest.raises(EnforceNotMet, match="routing"):
+            make_sharded_ctr_train_step(model, opt, cache_cfg, _mesh(),
+                                        routing=bad)
+
+
+@pytest.mark.parametrize("routing", ["alltoall", "allgather", "auto",
+                                     ("alltoall", "allgather"),
+                                     ("allgather", "alltoall")])
 def test_sharded_key_fed_matches_row_fed(rng, routing):
     """In-graph lookup + sharded serving: identical trajectory to the
     host-lookup sharded step (the complete multi-chip GPUPS worker),
