@@ -530,41 +530,66 @@ class Engine:
 
     # -- prepare (plan + partition, engine.py prepare/_build) ------------
 
+    def _place_state(self, state, opt_state):
+        """Place a (state, opt_state) pair onto the engine's mesh per
+        ``param_specs`` (annotated prepare) or replicated. Shared by
+        :meth:`prepare` and :meth:`load` so a restore lands on EXACTLY
+        the placements training used — a sharded engine must not
+        silently come back replicated (reference Engine.load restores
+        dist-attrs with the checkpoint)."""
+        mesh = self.process_mesh.jax_mesh
+        repl = NamedSharding(mesh, PartitionSpec())
+
+        # normalize containers to plain dicts: nn.get_state hands
+        # OrderedDicts, the checkpoint loader plain dicts — a mixed tree
+        # breaks tree_map inside optimizer.update (dict vs OrderedDict
+        # are different pytree node types) and a prepare/load mismatch
+        # would silently retrace the compiled step
+        def plain(tree):
+            if isinstance(tree, dict):
+                return {k: plain(v) for k, v in tree.items()}
+            return tree
+
+        state, opt_state = plain(state), plain(opt_state)
+        if not self.param_specs:
+            return (jax.device_put(state, repl),
+                    jax.device_put(opt_state, repl))
+        # device_put shards numpy/host arrays directly — no jnp.asarray,
+        # which would materialize the FULL array on one device first
+        placed = {
+            name: jax.device_put(
+                arr, NamedSharding(mesh, self.param_specs.get(
+                    name, PartitionSpec())))
+            for name, arr in state["params"].items()
+        }
+        from ..optimizer import map_param_slots
+
+        # optimizer slots mirror the params dict → same layouts
+        slot_sh = map_param_slots(
+            opt_state["slots"], state["params"],
+            mirror_fn=lambda sub: type(sub)(
+                (n, NamedSharding(mesh, self.param_specs.get(
+                    n, PartitionSpec()))) for n in sub),
+            other_leaf_fn=lambda _: repl)
+        opt_state = jax.tree_util.tree_map(
+            jax.device_put, opt_state, {"step": repl, "slots": slot_sh})
+        return ({"params": placed,
+                 "buffers": jax.device_put(state["buffers"], repl)},
+                opt_state)
+
     def prepare(self) -> None:
         mesh = self.process_mesh.jax_mesh
         state = nn.get_state(self.model)
         opt_state = self.optimizer.init(state["params"])
-        repl = NamedSharding(mesh, PartitionSpec())
         batch_sh = NamedSharding(mesh, PartitionSpec(self.batch_axis))
         if self.annotations:
             # completion: one or two hints → a spec for every parameter;
             # placement seeds GSPMD, which completes the intermediates
             self.param_specs = complete_shardings(
                 self.model, self.process_mesh, self.annotations)
-            placed = {
-                name: jax.device_put(
-                    arr, NamedSharding(mesh, self.param_specs.get(
-                        name, PartitionSpec())))
-                for name, arr in state["params"].items()
-            }
-            from ..optimizer import map_param_slots
-
-            # optimizer slots mirror the params dict → same layouts
-            slot_sh = map_param_slots(
-                opt_state["slots"], state["params"],
-                mirror_fn=lambda sub: type(sub)(
-                    (n, NamedSharding(mesh, self.param_specs.get(
-                        n, PartitionSpec()))) for n in sub),
-                other_leaf_fn=lambda _: repl)
-            opt_state = jax.tree_util.tree_map(
-                jax.device_put, opt_state, {"step": repl, "slots": slot_sh})
-            self._state = {"params": placed,
-                           "buffers": jax.device_put(state["buffers"], repl)}
-            self._opt_state = opt_state
         else:
             self.param_specs = None
-            self._state = jax.device_put(state, repl)
-            self._opt_state = jax.device_put(opt_state, repl)
+        self._state, self._opt_state = self._place_state(state, opt_state)
         self._rng = jax.random.key(0)
 
         model, loss_fn, optimizer = self.model, self.loss_fn, self.optimizer
@@ -656,16 +681,22 @@ class Engine:
 
     def load(self, path: str) -> None:
         """Restore a snapshot saved by :meth:`save`; arrays are placed
-        back onto the engine's mesh (replicated, as prepare() does)."""
+        back onto the engine's mesh with the SAME placements prepare()
+        chose — ``param_specs`` placement for an annotated engine,
+        replicated otherwise (reference Engine load restores dist-attrs;
+        a sharded model restored replicated would OOM or silently train
+        replicated at planner-scale sizes). The checkpoint holds full
+        (unsharded) host arrays, so loading into an engine prepared on a
+        DIFFERENT mesh or annotation set is a reshard: device_put lays
+        each array out per the new engine's specs."""
         from ..io.checkpoint import load_train_state
 
         if not self._prepared:
             self.prepare()
         snap = load_train_state(path)
-        repl = NamedSharding(self.process_mesh.jax_mesh, PartitionSpec())
-        self._state = jax.device_put(snap["state"], repl)
+        self._state, self._opt_state = self._place_state(
+            snap["state"], snap["opt"])
         self._rng = snap["rng"] if snap["rng"] is not None else self._rng
-        self._opt_state = jax.device_put(snap["opt"], repl)
 
     # -- introspection ----------------------------------------------------
 

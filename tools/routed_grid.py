@@ -12,7 +12,9 @@ acceptance gate is that it never is.
 
 CPU devices share one host, so absolute numbers are not TPU numbers,
 but the per-shard WORK ratios the decision rule keys on show directly.
-Re-run on hardware (RG_PLATFORM unset) when the chip allows.
+Re-run on hardware with RG_PLATFORM=tpu when the chip allows (the
+default is cpu; note a single chip can only measure K=1 — the
+multi-chip grid needs a pod).
 
 Writes ROUTED_GRID.json. Env: RG_BATCHES ("128,1024"), RG_SLOTS (26),
 RG_DIM (8), RG_STEPS (10), RG_SHARDS ("2,8"), RG_CAPS ("65536,1048576").
@@ -24,11 +26,6 @@ import os
 import sys
 import time
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
