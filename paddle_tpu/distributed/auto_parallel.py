@@ -150,6 +150,7 @@ def complete_shardings(
     model,
     process_mesh: ProcessMesh,
     annotations: Dict[str, Sequence[Optional[int]]],
+    example_inputs: Optional[Sequence[Any]] = None,
 ) -> Dict[str, PartitionSpec]:
     """The Completer (reference ``auto_parallel/completion.py``): from one
     or two user dist-attr hints, derive a PartitionSpec for EVERY
@@ -158,7 +159,15 @@ def complete_shardings(
     ``annotations``: {param_name: dims_mapping} in the reference's
     convention (entry = mesh-dim index or -1/None for replicated).
 
-    Two passes over the ordered parameter-owning leaves:
+    With ``example_inputs`` (arrays or ShapeDtypeStructs), completion
+    runs on the TRACED dataflow graph (completion.py — jaxpr-level:
+    handles branching QKV, residual blocks, fused weights, repeated
+    -block hint expansion; the reference Completer's arbitrary-graph
+    coverage). Without inputs, the legacy sequential-chain walk below
+    applies — correct for Linear/Embedding/Conv chains only.
+
+    Sequential fallback: two passes over the ordered parameter-owning
+    leaves:
 
     - **backward** (right-to-left): a user hint that row-shards a
       Linear's input dim over axis *a* demands its producer emit
@@ -175,6 +184,11 @@ def complete_shardings(
     The result feeds ``Engine`` parameter placement; XLA's GSPMD then
     completes every *intermediate* tensor (the rest of completion.py's
     job) during jit."""
+    if example_inputs is not None:
+        from .completion import complete_shardings_traced
+
+        return complete_shardings_traced(model, process_mesh, annotations,
+                                         example_inputs)
     mesh = process_mesh
     leaves = _named_leaf_layers(model)
     user: Dict[str, PartitionSpec] = {
@@ -257,11 +271,61 @@ def complete_shardings(
     return specs
 
 
-def _mp_annotations(model, mp: int) -> Dict[str, Sequence[Optional[int]]]:
+def _pipeline_stages(model, graph=None) -> int:
+    """Largest homogeneous repeated-block count in the model — the max
+    usable pipeline depth (reference planner partitions programs at
+    block boundaries; a model with no repeated blocks can't pipeline).
+    Counted from LayerList children whose entries share one class.
+
+    With a traced param graph (completion.trace_param_graph), a
+    candidate list must also be SEQUENTIAL in the dataflow — block i
+    consuming block i-1's outputs. A LayerList of parallel experts
+    (MoE) or multi-branch heads is structurally homogeneous but has no
+    stage boundaries; the trace tells them apart."""
+    from ..nn.layer import LayerList
+
+    def sequential(prefix: str, n: int) -> bool:
+        if graph is None:
+            return True  # structural fallback: assume sequential
+        for i in range(1, n):
+            prev = {u.name for u in graph.uses
+                    if u.name.startswith(f"{prefix}.{i - 1}.")}
+            cur = [u for u in graph.uses
+                   if u.name.startswith(f"{prefix}.{i}.")]
+            if not cur or not any(u.preds & prev for u in cur):
+                return False
+        return True
+
+    best = 1
+    stack = [(model, "")]
+    while stack:
+        layer, prefix = stack.pop()
+        for name, sub in layer._sub_layers.items():
+            q = f"{prefix}.{name}" if prefix else name
+            if (isinstance(sub, LayerList) and len(sub) > 1
+                    and len({type(b) for b in sub}) == 1
+                    and sequential(q, len(sub))):
+                best = max(best, len(sub))
+            stack.append((sub, q))
+    return best
+
+
+def _mp_annotations(model, mp: int,
+                    example_inputs: Optional[Sequence[Any]] = None,
+                    ) -> Dict[str, Sequence[Optional[int]]]:
     """The planner's hint rule, shared by :func:`plan_strategy` and
     :func:`choose_strategy`: large Linears in alternating Megatron
     col/row pairs, Embeddings vocab- or hidden-parallel; completion
-    fills the rest. Only dims divisible by mp qualify."""
+    fills the rest. Only dims divisible by mp qualify.
+
+    With ``example_inputs`` the pairing runs on the TRACED dataflow
+    (completion.mp_annotations_traced — exact for branching graphs,
+    fused QKV, residuals); otherwise on registration order (sequential
+    chains only)."""
+    if example_inputs is not None:
+        from .completion import mp_annotations_traced
+
+        return mp_annotations_traced(model, mp, 1, example_inputs)
     from ..nn.layers import Embedding, Linear
 
     annotations: Dict[str, Sequence[Optional[int]]] = {}
@@ -352,6 +416,8 @@ class ClusterSpec:
     ici_gbytes_per_s: float = 90.0   # v5e all-reduce effective BW/chip
     dcn_gbytes_per_s: float = 6.0    # typical inter-host effective BW
     hosts: int = 1
+    device_tflops: float = 197.0     # v5e bf16 peak — feeds the pp
+    # bubble term only (plan-invariant compute divides out elsewhere)
 
     def axis_bw(self, axis_index: int, axis_size: int) -> float:
         if axis_size <= 1:
@@ -365,19 +431,32 @@ def estimate_plan_cost(model, mesh: ProcessMesh,
                        annotations: Dict[str, Sequence[Optional[int]]],
                        batch_tokens: int,
                        cluster: Optional[ClusterSpec] = None,
-                       state_multiplier: float = 4.0) -> Dict[str, float]:
+                       state_multiplier: float = 4.0,
+                       microbatches: int = 8) -> Dict[str, float]:
     """Analytic per-step cost of a (mesh, annotations) plan — the
     reference cost model's estimate (``auto_parallel/cost_model.py``,
-    ``cost/comm_op_cost.py``) in closed form for the two dominant
-    collectives of a dp x mp plan:
+    ``cost/comm_op_cost.py``) in closed form for the dominant terms of
+    a dp × mp × pp plan:
 
     - dp gradient all-reduce: ring volume 2·(dp-1)/dp · param_bytes
       over the dp axis's link (mp-sharded tensors all-reduce only their
-      1/mp shard);
-    - mp activation all-reduce: each column->row Megatron pair psums a
+      1/mp shard; pp shards the params across stages → 1/pp);
+    - mp activation all-reduce: each column→row Megatron pair psums a
       [batch_tokens, out_dim] activation in fwd and its gradient in bwd
-      (2 x ring volume), where out_dim is the row-parallel layer's
-      output width.
+      (2 × ring volume), where out_dim is the row-parallel layer's
+      output width;
+    - mp UNPAIRED column-parallel output all-gather: a col-annotated
+      weight with no row partner leaves its activation mp-sharded; the
+      next (replicated-weight) consumer forces an all-gather of the
+      full [batch_tokens, out] — charged per unpaired col (pairing
+      follows annotation-dict order, which both hint rules emit in
+      dataflow order);
+    - pp bubble: 1F1B idle fraction (pp-1)/microbatches of the
+      per-device compute time (compute itself is plan-invariant —
+      flops/device = flops/devices for every factorization — so only
+      the bubble enters ``total_s``);
+    - pp p2p: boundary activation sends, 2 × (pp-1) stage hops of
+      [batch_tokens/dp, hidden] each way.
 
     Returns an auditable dict: bytes and seconds per term plus
     ``per_device_state_bytes`` (the memory-fit input) and ``total_s``.
@@ -389,6 +468,7 @@ def estimate_plan_cost(model, mesh: ProcessMesh,
     dims = dict(zip(mesh.dim_names, mesh.shape))
     dp = int(dims.get("dp", 1))
     mp = int(dims.get("mp", 1))
+    pp = int(dims.get("pp", 1))
     names = list(mesh.dim_names)
     dp_ax = names.index("dp") if "dp" in names else 0
     mp_ax = names.index("mp") if "mp" in names else 1
@@ -396,8 +476,11 @@ def estimate_plan_cost(model, mesh: ProcessMesh,
     params = dict(model.named_parameters())
     sharded_bytes = 0.0
     unsharded_bytes = 0.0
+    total_count = 0
     for name, p in params.items():
-        b = float(int(np.prod(p.shape)) * np.dtype(p.dtype).itemsize)
+        cnt = int(np.prod(p.shape))
+        total_count += cnt
+        b = float(cnt * np.dtype(p.dtype).itemsize)
         sharded = name in annotations and any(
             d is not None and d >= 0
             for d in annotations[name])
@@ -407,37 +490,70 @@ def estimate_plan_cost(model, mesh: ProcessMesh,
             unsharded_bytes += b
     # mp shards only the ANNOTATED tensors (completion shards a few
     # more — partners, biases — so this memory estimate is conservative,
-    # never optimistic); grads all-reduce at the same granularity
-    dp_grad_bytes = sharded_bytes / mp + unsharded_bytes
+    # never optimistic); grads all-reduce at the same granularity.
+    # pp splits stages: uniform 1/pp share approximation.
+    dp_grad_bytes = (sharded_bytes / mp + unsharded_bytes) / pp
     ring = lambda n: 2.0 * (n - 1) / n if n > 1 else 0.0
     dp_s = (ring(dp) * dp_grad_bytes
             / (cluster.axis_bw(dp_ax, dp) * 1e9))
 
-    # one fwd psum + one bwd psum per column->row pair, activation width
-    # = the ROW layer's output dim (its [in, out][1])
+    # mp activation collectives: walk annotations in order keeping the
+    # open column-parallel stack — row partners psum, unpaired cols at
+    # the end all-gather their sharded output
     mp_act_bytes = 0.0
+    mp_gather_bytes = 0.0
     if mp > 1:
+        open_col_widths: List[float] = []
         for name, spec in annotations.items():
             p = params.get(name)
             if p is None or len(p.shape) != 2:
                 continue
-            if list(spec)[:2] == [1, -1] or list(spec)[:2] == [1, None]:
-                # row-parallel: output [batch_tokens, out] is psummed
+            s2 = list(spec)[:2]
+            if s2 == [1, -1] or s2 == [1, None]:
+                # row-parallel: output [batch_tokens, out] is psummed.
+                # A row partner closes ALL open cols — separate Q/K/V
+                # emit col,col,col,row and the one row output absorbs
+                # all three (mp_annotations_traced's `closing` loop
+                # discards every pred); pop-one would charge the other
+                # two phantom gathers
                 mp_act_bytes += 2.0 * batch_tokens * int(p.shape[1]) * 4.0
-        # dp shards the batch: each mp group psums its local batch slice
-        mp_act_bytes /= max(dp, 1)
-    mp_s = (ring(mp) * mp_act_bytes
-            / (cluster.axis_bw(mp_ax, mp) * 1e9))
+                open_col_widths.clear()
+            elif s2 == [-1, 1] or s2 == [None, 1]:
+                open_col_widths.append(float(p.shape[1]))
+        for width in open_col_widths:  # ADVICE r3: unpaired col gathers
+            mp_gather_bytes += 2.0 * batch_tokens * width * 4.0
+        # dp/pp shard the batch/stages: each group sees its local slice
+        mp_act_bytes /= max(dp, 1) * max(pp, 1)
+        mp_gather_bytes /= max(dp, 1) * max(pp, 1)
+    mp_bw = cluster.axis_bw(mp_ax, mp) * 1e9
+    mp_s = (ring(mp) * mp_act_bytes + ring(mp) * mp_gather_bytes) / mp_bw
 
-    per_device_state = (sharded_bytes / mp + unsharded_bytes) * state_multiplier
+    # pp: bubble fraction of per-device compute + boundary p2p
+    bubble_s = 0.0
+    pp_p2p_s = 0.0
+    if pp > 1:
+        flops = 6.0 * total_count * batch_tokens  # fwd 2PB + bwd 4PB
+        compute_s = flops / (dp * mp * pp) / (cluster.device_tflops * 1e12)
+        bubble_s = compute_s * (pp - 1) / max(microbatches, 1)
+        two_d = [min(int(p.shape[0]), int(p.shape[1]))
+                 for p in params.values() if len(p.shape) == 2]
+        hidden = float(max(two_d, default=0))
+        pp_p2p_s = (2.0 * (pp - 1) * (batch_tokens / dp) * hidden * 4.0
+                    / (cluster.ici_gbytes_per_s * 1e9))
+
+    per_device_state = ((sharded_bytes / mp + unsharded_bytes) / pp
+                        * state_multiplier)
     return {
-        "dp": dp, "mp": mp,
+        "dp": dp, "mp": mp, "pp": pp,
         "dp_allreduce_bytes": dp_grad_bytes * ring(dp),
         "dp_allreduce_s": dp_s,
         "mp_activation_bytes": mp_act_bytes * ring(mp),
+        "mp_gather_bytes": mp_gather_bytes * ring(mp),
         "mp_activation_s": mp_s,
+        "pp_bubble_s": bubble_s,
+        "pp_p2p_s": pp_p2p_s,
         "per_device_state_bytes": per_device_state,
-        "total_s": dp_s + mp_s,
+        "total_s": dp_s + mp_s + bubble_s + pp_p2p_s,
     }
 
 
@@ -446,39 +562,79 @@ def choose_strategy(model, batch_tokens: int,
                     per_device_bytes: float = 16e9,
                     cluster: Optional[ClusterSpec] = None,
                     state_multiplier: float = 4.0,
+                    microbatches: int = 8,
+                    example_inputs: Optional[Sequence[Any]] = None,
                     ) -> Tuple[ProcessMesh,
                                Dict[str, Sequence[Optional[int]]],
                                List[Dict[str, float]]]:
     """The Planner's cost-model search (reference planner_v2 + cost
     model, ``auto_parallel/planner_v2.py``/``cost_model.py``): enumerate
-    every power-of-two (dp, mp) factorization of the device count,
-    derive each one's dist-attr hints (the same rule
-    :func:`plan_strategy` applies), drop plans that don't fit
+    every power-of-two (dp, mp, pp) factorization of the device count
+    (pp capped by the model's repeated-block depth,
+    :func:`_pipeline_stages`), derive each one's dist-attr hints (the
+    same rule :func:`plan_strategy` applies; dataflow-exact when
+    ``example_inputs`` is given), drop plans that don't fit
     ``per_device_bytes`` or can't actually shard anything at their mp,
-    and return the feasible plan with the lowest estimated step comm
-    time. Also returns the full scored candidate list (auditable — the
-    reference logs the same).
+    and return the feasible plan with the lowest estimated step
+    overhead (comm + pipeline bubble — per-device compute is
+    plan-invariant and excluded). Also returns the full scored
+    candidate list (auditable — the reference logs the same).
 
     When nothing fits, falls back to the MEMORY-minimizing candidate
-    (largest usable mp — plan_strategy's escalation behavior), since
-    memory, not comms, is then the binding constraint."""
+    (plan_strategy's escalation behavior), since memory, not comms, is
+    then the binding constraint. A model that cannot shard at any mp
+    (odd dims) but stacks repeated blocks gets its memory relief from
+    pp — the (dp, mp, pp) answer the round-3 dp×mp-only search could
+    not return.
+
+    Execution split (mirrors the reference's planner/partitioner
+    separation): dp/mp plans run through :class:`Engine` (GSPMD); a
+    pp>1 plan must run through the pipeline trainer
+    (``paddle_tpu.parallel.hybrid``/``parallel.pipeline``), which
+    partitions the blocks into real stages — Engine rejects pp>1
+    meshes loudly rather than replicate across the axis."""
     devs = n_devices if n_devices is not None else len(jax.devices())
     cluster = cluster or ClusterSpec()
+    graph = None
+    if example_inputs is not None:
+        from .completion import trace_param_graph
+
+        graph = trace_param_graph(model, example_inputs)  # trace ONCE
+    max_pp = _pipeline_stages(model, graph)
     candidates: List[Dict[str, float]] = []
     plans = {}
+    ann_cache: Dict[int, Dict] = {}
+
+    def ann_for(mp: int):
+        if mp not in ann_cache:
+            if graph is not None:
+                from .completion import mp_annotations_traced
+
+                ann_cache[mp] = mp_annotations_traced(
+                    model, mp, 1, example_inputs, graph=graph)
+            else:
+                ann_cache[mp] = _mp_annotations(model, mp)
+        return ann_cache[mp]
+
     mp = 1
     while mp <= devs:
-        if devs % mp == 0:
-            mesh = ProcessMesh(shape=(devs // mp, mp),
-                               dim_names=("dp", "mp"))
-            ann = _mp_annotations(model, mp) if mp > 1 else {}
-            if mp == 1 or ann:  # an mp that shards nothing is not a plan
-                cost = estimate_plan_cost(model, mesh, ann, batch_tokens,
-                                          cluster, state_multiplier)
-                cost["fits"] = bool(
-                    cost["per_device_state_bytes"] <= per_device_bytes)
-                candidates.append(cost)
-                plans[(devs // mp, mp)] = (mesh, ann)
+        pp = 1
+        while mp * pp <= devs and pp <= max_pp:
+            if devs % (mp * pp) == 0:
+                dp = devs // (mp * pp)
+                mesh = ProcessMesh(shape=(dp, mp, pp),
+                                   dim_names=("dp", "mp", "pp"))
+                ann = ann_for(mp) if mp > 1 else {}
+                if mp == 1 or ann:  # an mp that shards nothing: no plan
+                    cost = estimate_plan_cost(model, mesh, ann,
+                                              batch_tokens, cluster,
+                                              state_multiplier,
+                                              microbatches)
+                    cost["fits"] = bool(
+                        cost["per_device_state_bytes"] <= per_device_bytes)
+                    candidates.append(cost)
+                    plans[(dp, mp, pp)] = (mesh, ann)
+            pp *= 2
         mp *= 2
     feasible = [c for c in candidates if c["fits"]]
     if feasible:
@@ -487,7 +643,7 @@ def choose_strategy(model, batch_tokens: int,
         # nothing fits: minimize MEMORY, not comms — the binding
         # constraint decides (plan_strategy's max-usable-mp behavior)
         best = min(candidates, key=lambda c: c["per_device_state_bytes"])
-    mesh, ann = plans[(int(best["dp"]), int(best["mp"]))]
+    mesh, ann = plans[(int(best["dp"]), int(best["mp"]), int(best["pp"]))]
     return mesh, ann, candidates
 
 
@@ -518,6 +674,7 @@ class Engine:
                  optimizer: Optimizer, process_mesh: Optional[ProcessMesh] = None,
                  batch_dim_mesh_axis: Optional[str] = None,
                  annotations: Optional[Dict[str, Sequence[Optional[int]]]] = None,
+                 example_inputs: Optional[Sequence[Any]] = None,
                  ) -> None:
         self.model = model
         self.loss_fn = loss_fn
@@ -526,6 +683,9 @@ class Engine:
             shape=(len(jax.devices()),), dim_names=("dp",))
         self.batch_axis = batch_dim_mesh_axis or self.process_mesh.dim_names[0]
         self.annotations = annotations or {}
+        # example_inputs (arrays or ShapeDtypeStructs): enables traced
+        # graph-aware completion (branching models — see completion.py)
+        self.example_inputs = example_inputs
         self._prepared = False
 
     # -- prepare (plan + partition, engine.py prepare/_build) ------------
@@ -578,6 +738,16 @@ class Engine:
                 opt_state)
 
     def prepare(self) -> None:
+        dims = dict(zip(self.process_mesh.dim_names,
+                        self.process_mesh.shape))
+        enforce(dims.get("pp", 1) == 1,
+                "Engine executes dp/mp (GSPMD) plans only — a pp>1 plan "
+                "from choose_strategy must run through the pipeline "
+                "trainer (paddle_tpu.parallel.hybrid / parallel.pipeline"
+                "), which actually partitions stages. Engine placement "
+                "would replicate params across pp and the planner's "
+                "1/pp memory relief would not materialize.",
+                InvalidArgumentError)
         mesh = self.process_mesh.jax_mesh
         state = nn.get_state(self.model)
         opt_state = self.optimizer.init(state["params"])
@@ -586,7 +756,8 @@ class Engine:
             # completion: one or two hints → a spec for every parameter;
             # placement seeds GSPMD, which completes the intermediates
             self.param_specs = complete_shardings(
-                self.model, self.process_mesh, self.annotations)
+                self.model, self.process_mesh, self.annotations,
+                example_inputs=self.example_inputs)
         else:
             self.param_specs = None
         self._state, self._opt_state = self._place_state(state, opt_state)

@@ -405,7 +405,7 @@ class TestCostModel:
     def test_roomy_budget_prefers_pure_dp(self):
         mesh, ann, cands = auto.choose_strategy(
             _Mlp(), batch_tokens=4096, n_devices=8, per_device_bytes=16e9)
-        assert mesh.jax_mesh.shape == {"dp": 8, "mp": 1}
+        assert dict(mesh.jax_mesh.shape) == {"dp": 8, "mp": 1, "pp": 1}
         assert ann == {}
         # the candidate list is the auditable scoreboard
         assert any(c["mp"] > 1 for c in cands)
@@ -487,3 +487,228 @@ class TestCostModel:
         assert chosen["per_device_state_bytes"] == min(
             c["per_device_state_bytes"] for c in cands)
         assert mesh.jax_mesh.shape["mp"] > 1 and ann
+
+
+class TestTracedCompletion:
+    """Graph-aware completion (completion.py, VERDICT r3 #3): the jaxpr
+    trace handles branching/residual models the sequential walk cannot —
+    ERNIE's fused QKV, residual skips, repeated blocks."""
+
+    def _ernie(self):
+        from paddle_tpu.models.ernie import Ernie, ErnieConfig
+
+        pt.seed(0)
+        cfg = ErnieConfig(vocab_size=128, hidden_size=32, num_heads=4,
+                          ffn_size=64, num_layers=2, max_seq_len=16,
+                          mp_axis=None, cp_axis=None, ep_axis=None)
+        return Ernie(cfg), jax.ShapeDtypeStruct((2, 16), np.int32)
+
+    def test_two_hints_shard_the_whole_encoder(self):
+        """One col hint on block-0 QKV + one on block-0 ffn-in expand
+        across blocks and complete to the full Megatron layout: col QKV
+        + row out-proj, col ffn-in + row ffn-out, sharded QKV bias,
+        replicated norms."""
+        model, ids = self._ernie()
+        mesh = auto.ProcessMesh(shape=(2, 4), dim_names=("dp", "mp"))
+        specs = auto.complete_shardings(
+            model, mesh,
+            {"blocks.0.attn.qkv_w": [-1, 1], "blocks.0.ffn.w_in": [-1, 1]},
+            example_inputs=[ids])
+        P = PartitionSpec
+        for b in range(2):
+            assert specs[f"blocks.{b}.attn.qkv_w"] == P(None, "mp"), b
+            assert specs[f"blocks.{b}.attn.qkv_b"] == P("mp"), b
+            assert specs[f"blocks.{b}.attn.proj_w"] == P("mp"), b
+            assert specs[f"blocks.{b}.ffn.w_in"] == P(None, "mp"), b
+            assert specs[f"blocks.{b}.ffn.b_in"] == P("mp"), b
+            assert specs[f"blocks.{b}.ffn.w_out"] == P("mp"), b
+        # row outputs psum -> replicated biases; norms replicate
+        assert specs["blocks.0.attn.proj_b"] == P()
+        assert specs["blocks.0.ln1.weight"] == P()
+        assert specs["embed.word_emb"] == P()
+
+    def test_ernie_sharded_matches_replicated(self):
+        """The deliverable: ERNIE sharded from TWO hints follows the
+        replicated loss trajectory (GSPMD completes the intermediates
+        around the placed params)."""
+        from paddle_tpu.models.ernie import parallel_cross_entropy  # noqa: F401
+
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 128, size=(4, 16)).astype(np.int32)
+        labels = rng.integers(0, 128, size=(4, 16)).astype(np.int32)
+
+        def lm_loss(out, lbl):
+            return nn.functional.cross_entropy(
+                out.reshape(-1, out.shape[-1]), lbl.reshape(-1))
+
+        def build(annotations):
+            model, sds = self._ernie()
+            return auto.Engine(
+                model, lm_loss, optimizer.SGD(0.05),
+                auto.ProcessMesh(shape=(2, 4), dim_names=("dp", "mp")),
+                batch_dim_mesh_axis="dp", annotations=annotations,
+                example_inputs=[sds])
+
+        data = [((ids,), (labels,))] * 3
+        ref = build(None).fit(data)
+        eng = build({"blocks.0.attn.qkv_w": [-1, 1],
+                     "blocks.0.ffn.w_in": [-1, 1]})
+        got = eng.fit(data)
+        np.testing.assert_allclose(got, ref, rtol=2e-4, atol=1e-5)
+        w = eng._state["params"]["blocks.1.ffn.w_out"]
+        assert "mp" in tuple(w.sharding.spec)  # really sharded
+
+    def test_traced_planner_rule_is_megatron_exact(self):
+        """mp_annotations_traced pairs by DATAFLOW: residual edges do
+        not mis-pair (the registration-order rule's failure mode)."""
+        from paddle_tpu.distributed.completion import mp_annotations_traced
+
+        model, ids = self._ernie()
+        ann = mp_annotations_traced(model, 4, 1, [ids])
+        assert ann["embed.word_emb"] == [1, -1]      # vocab-parallel
+        for b in range(2):
+            assert ann[f"blocks.{b}.attn.qkv_w"] == [-1, 1]
+            assert ann[f"blocks.{b}.attn.proj_w"] == [1, -1]
+            assert ann[f"blocks.{b}.ffn.w_in"] == [-1, 1]
+            assert ann[f"blocks.{b}.ffn.w_out"] == [1, -1]
+        assert ann["head.w"] == [-1, 1]              # col head -> par CE
+
+
+class TestPlannerPP:
+    """choose_strategy's pp axis (VERDICT r3 #3): pipeline partitioning
+    enters the search with a bubble cost term."""
+
+    def _stacked_odd(self, n_blocks=8, d=33):
+        """Repeated blocks with ODD dims: mp cannot shard anything, so
+        only pp can relieve memory."""
+
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(d, d)
+
+            def forward(self, x):
+                return jax.nn.relu(self.fc(x))
+
+        class Stacked(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.blocks = nn.LayerList([Block() for _ in range(n_blocks)])
+
+            def forward(self, x):
+                for b in self.blocks:
+                    x = b(x)
+                return x
+
+        return Stacked()
+
+    def test_pp_relieves_memory_when_mp_cannot(self):
+        m = self._stacked_odd()
+        pbytes = sum(int(np.prod(p.shape)) * 4
+                     for _, p in m.named_parameters())
+        # budget: fits only at a >=2-way split; mp shards nothing (odd)
+        mesh, ann, cands = auto.choose_strategy(
+            m, batch_tokens=64, n_devices=8,
+            per_device_bytes=pbytes * 4.0 / 2 * 1.01)
+        assert mesh.jax_mesh.shape["pp"] >= 2
+        assert mesh.jax_mesh.shape["mp"] == 1 and ann == {}
+        chosen = next(c for c in cands
+                      if (c["dp"], c["mp"], c["pp"]) == tuple(
+                          mesh.jax_mesh.shape.values()))
+        assert chosen["fits"] and chosen["pp_bubble_s"] > 0
+
+    def test_pp_capped_by_block_depth(self):
+        """A model with no repeated blocks never gets pp > 1."""
+        _, _, cands = auto.choose_strategy(
+            _Mlp(), batch_tokens=64, n_devices=8, per_device_bytes=1.0)
+        assert all(c["pp"] == 1 for c in cands)
+
+    def test_pipeline_stages_counts_layerlists(self):
+        from paddle_tpu.distributed.auto_parallel import _pipeline_stages
+
+        assert _pipeline_stages(_Mlp()) == 1
+        assert _pipeline_stages(self._stacked_odd(n_blocks=6)) == 6
+
+    def test_bubble_shrinks_with_microbatches(self):
+        m = self._stacked_odd(d=32)  # even: mp usable too, but test pp
+        mesh = auto.ProcessMesh(shape=(2, 1, 4), dim_names=("dp", "mp", "pp"))
+        few = auto.estimate_plan_cost(m, mesh, {}, 4096, microbatches=2)
+        many = auto.estimate_plan_cost(m, mesh, {}, 4096, microbatches=32)
+        assert few["pp_bubble_s"] > many["pp_bubble_s"] * 10
+
+
+class TestUnpairedColGatherCost:
+    """ADVICE r3: a column-parallel annotation with no row partner must
+    charge its output all-gather — otherwise the search is biased toward
+    mp for models with a lone col layer."""
+
+    def test_lone_col_charges_gather(self):
+        m = _Mlp(d=16, h=32)
+        mesh = auto.ProcessMesh(shape=(4, 2), dim_names=("dp", "mp"))
+        lone = auto.estimate_plan_cost(m, mesh, {"fc2.weight": [-1, 1]},
+                                       batch_tokens=4096)
+        assert lone["mp_gather_bytes"] > 0
+        assert lone["mp_activation_s"] > 0
+
+    def test_paired_col_row_charges_no_gather(self):
+        m = _Mlp(d=16, h=32)
+        mesh = auto.ProcessMesh(shape=(4, 2), dim_names=("dp", "mp"))
+        paired = auto.estimate_plan_cost(
+            m, mesh, {"fc2.weight": [-1, 1], "fc3.weight": [1, -1]},
+            batch_tokens=4096)
+        assert paired["mp_gather_bytes"] == 0
+
+def test_parallel_experts_are_not_pipeline_stages():
+    """A homogeneous LayerList applied in PARALLEL (MoE experts) must
+    not count as pipeline depth — the traced dataflow shows no
+    block-to-block edges (review finding: structural guess alone
+    over-pipelines)."""
+    from paddle_tpu.distributed.auto_parallel import _pipeline_stages
+    from paddle_tpu.distributed.completion import trace_param_graph
+
+    class Experts(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.experts = nn.LayerList(
+                [nn.Linear(16, 16) for _ in range(4)])
+
+        def forward(self, x):
+            return sum(e(x) for e in self.experts)
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(16, 16)
+
+        def forward(self, x):
+            return jax.nn.relu(self.fc(x))
+
+    class Stacked(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.blocks = nn.LayerList([Block() for _ in range(4)])
+
+        def forward(self, x):
+            for b in self.blocks:
+                x = b(x)
+            return x
+
+    sds = jax.ShapeDtypeStruct((4, 16), np.float32)
+    m = Experts()
+    g = trace_param_graph(m, [sds])
+    assert _pipeline_stages(m, g) == 1          # trace: parallel
+    assert _pipeline_stages(m) == 4             # structural fallback
+    seq = Stacked()
+    gs = trace_param_graph(seq, [sds])
+    assert _pipeline_stages(seq, gs) == 4       # trace: sequential
+
+
+def test_engine_rejects_pp_mesh():
+    from paddle_tpu.core.enforce import EnforceNotMet
+
+    mesh = auto.ProcessMesh(shape=(2, 1, 4), dim_names=("dp", "mp", "pp"))
+    eng = auto.Engine(_Mlp(), nn.functional.cross_entropy,
+                      optimizer.SGD(0.1), mesh,
+                      batch_dim_mesh_axis="dp")
+    with pytest.raises(EnforceNotMet, match="pipeline"):
+        eng.prepare()
