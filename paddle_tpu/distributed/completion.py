@@ -36,6 +36,13 @@ Axis placement is derived from the traced contraction, not from an
 [in, out] convention: col-parallel shards the param's NON-contracted
 dim, row-parallel its contracted dim — fused/transposed layouts come
 out right automatically.
+
+Control flow: ``scan`` bodies are walked as one symbolic iteration
+(RNN cell weights enter as consts and record normally), ``cond``
+branches all walk with outputs unioned, ``while`` bodies walk once.
+Cross-iteration carry dependencies inside a scan are not unrolled —
+uses and direct producer edges are exact, carry-chain ancestry is
+approximate.
 """
 
 from __future__ import annotations
@@ -155,6 +162,17 @@ def trace_param_graph(model, example_inputs: Sequence[Any]) -> ParamGraph:
                                  frozenset(preds), counter[0]))
             counter[0] += 1
 
+    def map_into(inner_invars, outer_vars, keep_psrc=True):
+        """Seed an inner jaxpr's invars from outer vars (stale entries
+        from a previous walk of the same cached jaxpr cleared)."""
+        for iv, ov in zip(inner_invars, outer_vars):
+            p = rd_psrc(ov) if keep_psrc else None
+            if p is not None and len(iv.aval.shape) == len(p[1]):
+                psrc[id(iv)] = p
+            else:
+                psrc.pop(id(iv), None)
+            actsrc[id(iv)] = rd_act(ov)
+
     def walk(jx):
         for eqn in jx.eqns:
             prim = eqn.primitive.name
@@ -163,6 +181,47 @@ def trace_param_graph(model, example_inputs: Sequence[Any]) -> ParamGraph:
                 if k in eqn.params:
                     sub = eqn.params[k]
                     break
+            if prim == "scan" and sub is not None:
+                # one symbolic iteration: invars = consts ++ carry ++ xs.
+                # xs enter the body with the scan axis stripped, so
+                # their param dim-maps don't transfer (psrc dropped by
+                # map_into's rank check); consts/carry map 1:1 — RNN
+                # weights are consts, which is the case that matters
+                inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+                map_into(inner.invars, eqn.invars)
+                walk(inner)
+                for i, ov in enumerate(eqn.outvars):
+                    if i < len(inner.outvars):
+                        actsrc[id(ov)] = rd_act(inner.outvars[i])
+                    psrc.pop(id(ov), None)
+                continue
+            if prim == "cond" and "branches" in eqn.params:
+                # cond: walk every branch (operands follow the index);
+                # outputs union across branches
+                branches = eqn.params["branches"]
+                outs = [frozenset()] * len(eqn.outvars)
+                for br in branches:
+                    inner = br.jaxpr if hasattr(br, "jaxpr") else br
+                    map_into(inner.invars, eqn.invars[1:])
+                    walk(inner)
+                    outs = [o | rd_act(iv)
+                            for o, iv in zip(outs, inner.outvars)]
+                for ov, o in zip(eqn.outvars, outs):
+                    actsrc[id(ov)] = o
+                    psrc.pop(id(ov), None)
+                continue
+            if prim == "while" and "body_jaxpr" in eqn.params:
+                body = eqn.params["body_jaxpr"]
+                inner = body.jaxpr if hasattr(body, "jaxpr") else body
+                n_const = (int(eqn.params.get("cond_nconsts", 0))
+                           + int(eqn.params.get("body_nconsts", 0)))
+                map_into(inner.invars,
+                         eqn.invars[int(eqn.params.get("cond_nconsts", 0)):])
+                walk(inner)
+                for ov, iv in zip(eqn.outvars, inner.outvars):
+                    actsrc[id(ov)] = rd_act(iv)
+                    psrc.pop(id(ov), None)
+                continue
             if prim in _CALL_PRIMS and sub is not None:
                 inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
                 # stale entries from a previous walk of the SAME cached
@@ -392,10 +451,10 @@ def complete_shardings_traced(
         shape = graph.shapes[name]
         if name in role:
             kind, axis, sdim = role[name]
-            size = shape[sdim] if sdim < len(shape) else 0
             mesh_sizes = dict(zip(process_mesh.dim_names,
                                   process_mesh.shape))
-            if size % max(mesh_sizes.get(axis, 1), 1) != 0:
+            if (sdim >= len(shape)  # hint dims_mapping longer than param
+                    or shape[sdim] % max(mesh_sizes.get(axis, 1), 1) != 0):
                 specs[name] = PartitionSpec()   # indivisible: replicate
                 continue
             entries = [None] * len(shape)

@@ -18,16 +18,23 @@ stream-pipeline role). Each ComputeInterceptor's ``fn`` is typically a
 jitted step; credits bound in-flight microbatches exactly like the
 reference's up/down buffer accounting (compute_interceptor.cc).
 
-Cross-process extension point: replace ``MessageBus`` with one backed
-by ``distributed.collective.TCPStore`` — message schema is identical.
+Cross-process: ``RemoteMessageBus`` carries the SAME message schema
+over a framed-TCP channel between ranks (the brpc ``MessageBus``
+message_bus.cc role) — interceptors are placed on ranks via
+``Carrier(local_ids=...)``, sends route transparently, and the
+credit-based backpressure works unchanged across the wire.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import enum
+import pickle
 import queue
+import socket
+import struct
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..core.enforce import InvalidArgumentError, PreconditionNotMetError, enforce
@@ -36,6 +43,7 @@ __all__ = [
     "MessageType",
     "InterceptorMessage",
     "MessageBus",
+    "RemoteMessageBus",
     "TaskNode",
     "Interceptor",
     "ComputeInterceptor",
@@ -83,6 +91,162 @@ class MessageBus:
         if inbox is None:
             raise InvalidArgumentError(f"unknown interceptor id {msg.dst_id}")
         inbox.put(msg)
+
+
+class RemoteMessageBus(MessageBus):
+    """Cross-rank interceptor message bus — the brpc ``MessageBus``
+    (message_bus.cc) role on a framed-TCP channel (4-byte length prefix
+    + pickled InterceptorMessage; the sibling of ps/rpc.py's framing).
+
+    ``rank_addrs``: {rank: (host, port)} — this rank LISTENS on its own
+    entry; ``interceptor_ranks``: {task_id: rank} placement map. A send
+    whose destination lives on another rank rides a persistent client
+    socket to that rank's listener, which injects it into the local
+    inbox — interceptor code is identical either way, and the
+    DATA_IS_USELESS credit returns travel the reverse path, so the
+    buffer_size windows throttle ACROSS processes exactly as they do
+    in-process."""
+
+    _FRAME = struct.Struct("<I")
+    _MAX_FRAME = 1 << 30
+
+    def __init__(self, rank: int, rank_addrs: Dict[int, Tuple[str, int]],
+                 interceptor_ranks: Dict[int, int],
+                 connect_timeout: float = 30.0) -> None:
+        super().__init__()
+        self.rank = int(rank)
+        self._addrs = dict(rank_addrs)
+        self._placement = dict(interceptor_ranks)
+        self._connect_timeout = float(connect_timeout)
+        self._peers: Dict[int, socket.socket] = {}
+        self._send_locks: Dict[int, threading.Lock] = {}
+        self._peer_lock = threading.Lock()  # guards the two maps only
+        self._closing = False
+        host, port = self._addrs[self.rank]
+        self._listener = socket.create_server((host, port), backlog=8,
+                                              reuse_port=False)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"msgbus-accept-{rank}")
+        self._accept_thread.start()
+
+    # -- wire helpers -----------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True,
+                             name=f"msgbus-conn-{self.rank}").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                while True:
+                    hdr = self._recv_exact(conn, self._FRAME.size)
+                    if hdr is None:
+                        return
+                    (n,) = self._FRAME.unpack(hdr)
+                    enforce(n <= self._MAX_FRAME,
+                            f"message frame too large: {n}")
+                    body = self._recv_exact(conn, n)
+                    if body is None:
+                        return
+                    self._deliver(pickle.loads(body))
+        except (OSError, pickle.UnpicklingError):
+            if not self._closing:
+                raise
+
+    def _deliver(self, msg: InterceptorMessage,
+                 register_timeout: float = 10.0) -> None:
+        """Local delivery with a registration grace window: a peer's
+        first DATA_IS_READY can arrive between this rank's bus
+        construction (listener up) and its Carrier registering inboxes
+        — a startup race, not an error. Bounded retry, then raise."""
+        deadline = time.monotonic() + register_timeout
+        while True:
+            try:
+                MessageBus.send(self, msg)
+                return
+            except InvalidArgumentError:
+                if self._closing or time.monotonic() > deadline:
+                    if self._closing:
+                        return  # late message during shutdown: drop
+                    raise
+                time.sleep(0.01)
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _peer(self, rank: int) -> socket.socket:
+        # connect OUTSIDE the map lock: a slow/absent peer must not
+        # stall sends to healthy peers or close() for connect_timeout.
+        # A racing duplicate connect publishes one socket, closes the
+        # loser.
+        with self._peer_lock:
+            sock = self._peers.get(rank)
+        if sock is not None:
+            return sock
+        host, port = self._addrs[rank]
+        deadline = time.monotonic() + self._connect_timeout
+        while True:  # the peer's listener may not be up yet
+            try:
+                sock = socket.create_connection((host, port), timeout=5.0)
+                break
+            except OSError:
+                if self._closing or time.monotonic() > deadline:
+                    raise
+                time.sleep(0.05)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        with self._peer_lock:
+            existing = self._peers.get(rank)
+            if existing is not None:
+                sock.close()
+                return existing
+            self._peers[rank] = sock
+            self._send_locks[rank] = threading.Lock()
+            return sock
+
+    # -- MessageBus surface ----------------------------------------------
+
+    def send(self, msg: InterceptorMessage) -> None:
+        dst_rank = self._placement.get(msg.dst_id, self.rank)
+        if dst_rank == self.rank:
+            MessageBus.send(self, msg)
+            return
+        body = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        frame = self._FRAME.pack(len(body)) + body
+        try:
+            sock = self._peer(dst_rank)
+            with self._send_locks[dst_rank]:  # frame-interleave guard
+                sock.sendall(frame)
+        except OSError:
+            if not self._closing:
+                raise
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._peer_lock:
+            for sock in self._peers.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._peers.clear()
 
 
 @dataclasses.dataclass
@@ -261,16 +425,29 @@ class AmplifierInterceptor(ComputeInterceptor):
 
 class Carrier:
     """carrier.h:49: owns the interceptors of one rank, starts them,
-    releases the sources, and joins on the sinks."""
+    releases the sources, and joins on the sinks.
+
+    Multi-rank (the reference's Carrier + brpc MessageBus split): pass a
+    :class:`RemoteMessageBus` and ``local_ids`` — only the local nodes'
+    interceptors are constructed, but the FULL topology is known so the
+    completion STOP broadcast reaches every rank. A rank with no local
+    sink (e.g. the source rank) completes when the sink rank's broadcast
+    STOP drains its interceptors."""
 
     def __init__(self, nodes: Sequence[TaskNode],
-                 feeds: Optional[Dict[int, Sequence[Any]]] = None) -> None:
-        self.bus = MessageBus()
+                 feeds: Optional[Dict[int, Sequence[Any]]] = None,
+                 bus: Optional[MessageBus] = None,
+                 local_ids: Optional[Sequence[int]] = None) -> None:
+        self.bus = bus if bus is not None else MessageBus()
+        self.all_ids = [n.task_id for n in nodes]
         self.interceptors: Dict[int, Interceptor] = {}
         self.sinks: List[SinkInterceptor] = []
         self.sources: List[SourceInterceptor] = []
         feeds = feeds or {}
+        local = set(local_ids) if local_ids is not None else None
         for node in nodes:
+            if local is not None and node.task_id not in local:
+                continue
             if node.role == "source":
                 it: Interceptor = SourceInterceptor(node, self.bus,
                                                     feeds.get(node.task_id))
@@ -292,31 +469,54 @@ class Carrier:
                                              MessageType.START))
 
     def wait(self, timeout: float = 60.0) -> None:
-        import time as _time
+        deadline = time.monotonic() + timeout
 
-        deadline = _time.monotonic() + timeout
+        def check_errors():
+            for it in self.interceptors.values():
+                if it.error is not None:
+                    self.stop()
+                    raise it.error
+
         # poll so a stage exception surfaces promptly instead of
         # masquerading as a timeout after the full wait
         for sink in self.sinks:
             while not sink.done.wait(0.05):
-                for it in self.interceptors.values():
-                    if it.error is not None:
-                        self.stop()
-                        raise it.error
-                if _time.monotonic() > deadline:
+                check_errors()
+                if time.monotonic() > deadline:
                     self.stop()
                     raise PreconditionNotMetError(
                         f"fleet executor timed out waiting for sink "
                         f"{sink.node.task_id}")
+        if not self.sinks:
+            # sink lives on another rank: done when its Carrier's STOP
+            # broadcast (routed by the RemoteMessageBus) drains us
+            for it in self.interceptors.values():
+                while it.is_alive():
+                    it.join(timeout=0.05)
+                    check_errors()
+                    if time.monotonic() > deadline:
+                        self.stop()
+                        raise PreconditionNotMetError(
+                            "fleet executor timed out waiting for remote "
+                            f"completion of interceptor {it.node.task_id}")
+            # a thread that ERRORED and exited also fails is_alive() —
+            # the final check keeps a dead pipeline from reporting clean
+            check_errors()
+            return
         self.stop()
         for it in self.interceptors.values():
             if it.error is not None:
                 raise it.error
 
     def stop(self) -> None:
-        for it in self.interceptors.values():
-            self.bus.send(InterceptorMessage(-1, it.node.task_id,
-                                             MessageType.STOP))
+        # broadcast STOP over the FULL topology — cross-rank ids ride
+        # the remote bus (best-effort: a peer may already be down)
+        for task_id in self.all_ids:
+            try:
+                self.bus.send(InterceptorMessage(-1, task_id,
+                                                 MessageType.STOP))
+            except (InvalidArgumentError, OSError):
+                pass  # interceptor not local and no route / peer gone
         for it in self.interceptors.values():
             it.join(timeout=5.0)
 
