@@ -1,0 +1,224 @@
+"""Elastic scale-OUT end-to-end (VERDICT r3 #7): a solo worker + 1 PS
+server; a NEW worker announces itself mid-job, the leader's
+ElasticManager sees the grown world (watch_once → RESTART,
+manager.py:465 _update_elastic_scale_out), adopts it (np 1→2, endpoint
+rewrite), redistributes partitions to the joiner, and the job finishes
+with every (pass, partition) applied exactly once — the join boundary
+neither drops nor double-applies work.
+
+Mirror of test_elastic_e2e's scale-in flow; the consistency oracle is
+the same additive show counter.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.elastic import FileStore
+
+rpc = pytest.importorskip("paddle_tpu.ps.rpc")
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(not rpc.rpc_available(),
+                       reason="native toolchain unavailable"),
+]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SERVER_SCRIPT = """
+import sys, time
+from paddle_tpu.ps.rpc import NativePsServer
+s = NativePsServer(port=0, n_trainers=1)
+print("READY", s.port, flush=True)
+time.sleep(3600)
+"""
+
+# Leader (worker-0) starts SOLO owning both partitions. At the pass
+# boundary where the joiner's heartbeat appears, watch_once returns
+# RESTART (n=2 > np=1), the leader adopts the larger world, hands
+# partition 1 to the joiner from the NEXT pass, and both soft-sync
+# through the store (done/completed keys) exactly like the scale-in
+# test. The leader deliberately holds at pass 3 until the join lands so
+# the scenario is deterministic.
+_WORKER_SCRIPT = """
+import json, os, sys, time
+import numpy as np
+from paddle_tpu.distributed.elastic import (ElasticManager, ElasticStatus,
+                                            FileStore)
+from paddle_tpu.ps.accessor import AccessorConfig
+from paddle_tpu.ps.rpc import RpcPsClient
+from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+from paddle_tpu.ps.table import TableConfig
+
+store_dir, endpoint, host, n_passes = sys.argv[1:5]
+P, NPART = int(n_passes), 2
+rank = int(host.split("-")[1])
+store = FileStore(store_dir)
+em = ElasticManager(store, "job", np=1 if rank == 0 else 2, host=host,
+                    heartbeat_interval=0.2, heartbeat_ttl=1.2,
+                    elastic_timeout=1.0, min_np=1, max_np=2)
+em.start()
+
+cfg = TableConfig(shard_num=4, accessor_config=AccessorConfig(
+    sgd=SGDRuleConfig(initial_range=0.0)))
+cli = RpcPsClient([endpoint])
+cli.create_sparse_table(0, cfg)  # idempotent across trainers
+push_dim = 12
+
+
+def keys_of(part):
+    return (1 + part * 1000 + np.arange(50)).astype(np.uint64)
+
+
+def train(p, part):
+    keys = keys_of(part)
+    cli.pull_sparse(0, keys)
+    push = np.zeros((len(keys), push_dim), np.float32)
+    push[:, 1] = 1.0            # show += 1: the exactly-once oracle
+    push[:, 3:] = 0.01 * (p + 1)
+    cli.push_sparse(0, keys, push)
+    store.put(f"done/{p}/{part}", "1")
+
+
+def ckpt_dir(p):
+    return os.path.join(store_dir, f"table_ckpt_{p}")
+
+
+if rank == 1:
+    # joiner: heartbeat announces us; wait for the leader's assignment,
+    # then own partition 1 from the published resume pass onward
+    gate = time.time() + 60
+    while store.get("parts/worker-1") is None and time.time() < gate:
+        time.sleep(0.05)
+    assert store.get("parts/worker-1") == "1", "never assigned a partition"
+    start_pass = int(store.get("resume_from"))
+    for p in range(start_pass, P):
+        train(p, 1)
+        store.put("joiner_passes", str(p - start_pass + 1))
+        while int(store.get("completed") or -1) < p:
+            time.sleep(0.05)
+    em.stop()
+    cli.close()
+    print("JOINER_DONE", flush=True)
+    sys.exit(0)
+
+# leader (worker-0): solo start, scale out when the joiner appears
+my_parts = [0, 1]
+scaled = False
+for p in range(P):
+    if not scaled:
+        if p == 3:
+            # hold the job open until the join lands (deterministic)
+            gate = time.time() + 60
+            while em.watch_once() != ElasticStatus.RESTART:
+                assert time.time() < gate, "joiner never announced"
+                time.sleep(0.05)
+            st = ElasticStatus.RESTART
+        else:
+            st = em.watch_once()
+        if st == ElasticStatus.RESTART:
+            new_np = em.adopt_world()          # scale OUT: np 1 -> 2
+            assert new_np == 2, new_np
+            store.put("scaled_out", "1")
+            store.put("resume_from", str(p))   # joiner starts at pass p
+            store.put("parts/worker-1", "1")   # redistribute
+            my_parts = [0]
+            scaled = True
+    for part in my_parts:
+        train(p, part)
+    if scaled:
+        # wait for the joiner's partition before sealing the pass
+        gate = time.time() + 60
+        while not store.get(f"done/{p}/1"):
+            assert time.time() < gate, f"joiner stalled at pass {p}"
+            time.sleep(0.05)
+    cli.save(0, ckpt_dir(p))
+    store.put("completed", str(p))
+
+assert scaled, "job finished without ever scaling out"
+em.stop()
+# let the joiner observe the final completed key before the server stops
+time.sleep(0.5)
+cli.stop_servers()
+cli.close()
+print("LEADER_DONE", flush=True)
+"""
+
+
+def test_elastic_scale_out_redistributes_exactly_once(tmp_path):
+    n_passes = 6
+    store_dir = str(tmp_path / "store")
+    server = subprocess.Popen([sys.executable, "-c", _SERVER_SCRIPT],
+                              stdout=subprocess.PIPE, text=True,
+                              cwd=_REPO_ROOT)
+    procs = [server]
+    try:
+        line = server.stdout.readline().strip()
+        assert line.startswith("READY"), line
+        endpoint = f"127.0.0.1:{line.split()[1]}"
+
+        def spawn(host):
+            return subprocess.Popen(
+                [sys.executable, "-c", _WORKER_SCRIPT, store_dir, endpoint,
+                 host, str(n_passes)],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                cwd=_REPO_ROOT)
+
+        leader = spawn("worker-0")
+        procs.append(leader)
+
+        # let the solo leader make progress, THEN join a new worker
+        store = FileStore(store_dir)
+        deadline = time.monotonic() + 60
+        while int(store.get("completed") or -1) < 1:
+            assert time.monotonic() < deadline, "leader made no progress"
+            assert leader.poll() is None, leader.communicate()[0]
+            time.sleep(0.1)
+        joiner = spawn("worker-1")
+        procs.append(joiner)
+
+        out, _ = leader.communicate(timeout=120)
+        assert leader.returncode == 0, out
+        assert "LEADER_DONE" in out, out
+        jout, _ = joiner.communicate(timeout=60)
+        assert joiner.returncode == 0, jout
+        assert "JOINER_DONE" in jout, jout
+        assert store.get("scaled_out") == "1", "leader never scaled out"
+        # the joiner really did a share of the passes
+        assert int(store.get("joiner_passes") or 0) >= 1
+        # adopt_world rewrote the endpoint set to the larger world
+        eps = json.loads(store.get("elastic/job/endpoints") or "[]")
+        assert eps == ["worker-0", "worker-1"], eps
+
+        # consistency oracle: every (pass, partition) exactly once —
+        # show == n_passes on every key of both partitions, across the
+        # ownership handoff
+        final = os.path.join(store_dir, f"table_ckpt_{n_passes - 1}")
+        assert os.path.isdir(final)
+        with open(os.path.join(final, "meta.json")) as f:
+            meta = json.load(f)
+        rows = {}
+        for s in range(meta["shard_num"]):
+            path = os.path.join(final, f"part-{s:05d}.shard")
+            if not os.path.exists(path):
+                continue
+            with open(path) as f:
+                for ln in f:
+                    parts = ln.split()
+                    if parts:
+                        rows[int(parts[0])] = float(parts[4])  # show col
+        expect = {int(k) for part in range(2)
+                  for k in (1 + part * 1000 + np.arange(50))}
+        assert set(rows) == expect, (len(rows), len(expect))
+        bad = {k: v for k, v in rows.items() if v != n_passes}
+        assert not bad, f"{len(bad)} keys wrong: {list(bad.items())[:5]}"
+    finally:
+        for pproc in procs:
+            if pproc.poll() is None:
+                pproc.kill()
