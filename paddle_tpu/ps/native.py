@@ -560,6 +560,7 @@ def _configure_sst(lib: ctypes.CDLL) -> None:
                                ctypes.c_int32, f32p, u8p]
     lib.sst_insert_full.argtypes = [ctypes.c_void_p, u64p, f32p, ctypes.c_int64]
     lib.sst_load_cold.argtypes = [ctypes.c_void_p, u64p, f32p, ctypes.c_int64]
+    lib.sst_load_cold.restype = ctypes.c_int64
     lib.sst_spill.restype = ctypes.c_int64
     lib.sst_spill.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.sst_shrink.restype = ctypes.c_int64
@@ -685,10 +686,17 @@ class SsdTableEngine:
         self._lib.sst_insert_full(self._h, _u64(keys), _f32(values), len(keys))
 
     def load_cold(self, keys: np.ndarray, values: np.ndarray) -> None:
-        """Bulk-load full rows straight into the disk tier."""
+        """Bulk-load full rows straight into the disk tier. Raises on a
+        short load (ENOSPC-style partial write — the engine truncates
+        the partial slice so the log stays replay-consistent)."""
         keys = np.ascontiguousarray(keys, np.uint64)
         values = np.ascontiguousarray(values, np.float32)
-        self._lib.sst_load_cold(self._h, _u64(keys), _f32(values), len(keys))
+        loaded = self._lib.sst_load_cold(self._h, _u64(keys), _f32(values),
+                                         len(keys))
+        if loaded != len(keys):
+            raise OSError(
+                f"load_cold wrote only {loaded}/{len(keys)} rows "
+                "(disk full or IO error; partial slice truncated)")
 
 
 # ---------------------------------------------------------------------------

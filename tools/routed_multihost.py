@@ -1,0 +1,165 @@
+"""Multi-HOST routed-vs-gathered serving TIMING (VERDICT r3 #9): two
+localhost jax.distributed processes × 4 virtual CPU devices form one
+global 8-device "ps" mesh; both routing formulations run the full
+pull+push serving step with the inter-host hop crossing the process
+boundary — the DCN regime, where the routed path's O(batch/K) wire
+volume matters most (HeterComm multi-node push, heter_comm_inl.h:686).
+
+test_multiprocess_sharded_cache pins CORRECTNESS of this exact setup;
+this tool records the TIMING artifact (ROUTED_MULTIHOST.json).
+Localhost loopback is not a real DCN, but the per-shard work and wire
+volume ratios the architecture changes are measured, not modeled.
+
+Env: RM_BATCH (4096), RM_DIM (8), RM_CAP (262144), RM_STEPS (10).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import textwrap
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = textwrap.dedent("""
+    import json, os, sys, time
+    import numpy as np
+
+    rank = int(sys.argv[1]); world = int(sys.argv[2]); port = sys.argv[3]
+    out_path = sys.argv[4]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["RANK"] = str(rank)
+    os.environ["WORLD_SIZE"] = str(world)
+    os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.distributed import collective as C
+
+    env = C.init_parallel_env()
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.ps.embedding_cache import CacheConfig
+    from paddle_tpu.ps.sharded_cache import (routed_cache_pull,
+                                             routed_cache_push, routed_dedup,
+                                             sharded_cache_pull,
+                                             sharded_cache_push)
+
+    B = int(os.environ.get("RM_BATCH", 4096))
+    dim = int(os.environ.get("RM_DIM", 8))
+    Cap = int(os.environ.get("RM_CAP", 262144))
+    steps = int(os.environ.get("RM_STEPS", 10))
+
+    rng = np.random.default_rng(0)
+    host = {
+        "show": rng.uniform(0, 5, Cap).astype(np.float32),
+        "click": rng.uniform(0, 2, Cap).astype(np.float32),
+        "embed_w": rng.normal(size=(Cap, 1)).astype(np.float32),
+        "embed_state": rng.uniform(0, 1, (Cap, 1)).astype(np.float32),
+        "embedx_w": rng.normal(size=(Cap, dim)).astype(np.float32),
+        "embedx_state": rng.uniform(0, 1, (Cap, 1)).astype(np.float32),
+        "has_embedx": (rng.random(Cap) < 0.5).astype(np.float32),
+    }
+    rows = rng.integers(0, Cap, B).astype(np.int32)
+    grads = rng.normal(size=(B, 1 + dim)).astype(np.float32)
+    shows = np.ones(B, np.float32)
+    clicks = (rng.random(B) < 0.4).astype(np.float32)
+    cfg = CacheConfig(capacity=Cap, embedx_dim=dim, embedx_threshold=1.0,
+                      push_mode="sparse")
+
+    mesh = Mesh(np.array(jax.devices()), ("ps",))
+
+    def to_global(a):
+        sh = NamedSharding(mesh, P(*(["ps"] + [None] * (a.ndim - 1))))
+        return jax.make_array_from_callback(a.shape, sh, lambda i: a[i])
+
+    rows_g, grads_g, shows_g, clicks_g = (to_global(x) for x in
+                                          (rows, grads, shows, clicks))
+
+    def routed_body(st, r, g, s, c):
+        d = routed_dedup(r, Cap)
+        vals, _ = routed_cache_pull(st, r, "ps", dedup=d)
+        new, ov = routed_cache_push(st, r, g, s, c, cfg, "ps", dedup=d)
+        return new, jnp.sum(vals), ov
+
+    def gathered_body(st, r, g, s, c):
+        vals = sharded_cache_pull(st, r, "ps")
+        new = sharded_cache_push(st, r, g, s, c, cfg, "ps")
+        return new, jnp.sum(vals), jnp.int32(0)
+
+    result = {}
+    for name, body in (("alltoall", routed_body), ("allgather", gathered_body)):
+        state_g = {k: to_global(v) for k, v in host.items()}
+        fn = jax.jit(shard_map(
+            body, mesh=mesh, in_specs=(P("ps"),) + (P("ps"),) * 4,
+            out_specs=(P("ps"), P(), P()), check_vma=False),
+            donate_argnums=(0,))
+        st, val, ov = fn(state_g, rows_g, grads_g, shows_g, clicks_g)
+        jax.block_until_ready(val)
+        assert int(ov) == 0
+        best = float("inf")
+        for _ in range(3):  # min-of-3 (same estimator as routed_grid)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                st, val, ov = fn(st, rows_g, grads_g, shows_g, clicks_g)
+            jax.block_until_ready(val)
+            best = min(best, (time.perf_counter() - t0) / steps)
+        result[name] = round(best * 1e3, 3)
+
+    if rank == 0:
+        out = {
+            "hosts": world, "devices": world * 4, "batch": B, "dim": dim,
+            "capacity": Cap, "steps": steps, "push_mode": "sparse",
+            "ms_per_step": result,
+            "routed_vs_gathered": round(
+                result["alltoall"] / result["allgather"], 3),
+        }
+        with open(out_path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps(out), flush=True)
+    print("WORKER_OK", rank, flush=True)
+""")
+
+
+def main() -> None:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    out_path = os.environ.get("RM_OUT") or os.path.join(
+        _REPO, "ROUTED_MULTIHOST.json")
+    with tempfile.TemporaryDirectory() as td:
+        script = os.path.join(td, "worker.py")
+        with open(script, "w") as f:
+            f.write(_WORKER)
+        procs = []
+        for r in range(2):
+            env = dict(os.environ,
+                       PYTHONPATH=_REPO + os.pathsep +
+                       os.environ.get("PYTHONPATH", ""))
+            env.pop("XLA_FLAGS", None)
+            env.pop("JAX_PLATFORMS", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, script, str(r), "2", str(port), out_path],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        try:
+            for r, p in enumerate(procs):
+                out, _ = p.communicate(timeout=600)
+                assert p.returncode == 0, f"rank {r} failed:\n{out[-3000:]}"
+                assert f"WORKER_OK {r}" in out, out[-2000:]
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -18,6 +18,21 @@ import sys
 import tempfile
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _rss_bytes() -> int:
+    """Host resident set — the FeasignIndex/cold-index memory profile
+    the 100M-row run exists to measure (VERDICT r4 #5)."""
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return 0
+
 
 def main() -> None:
     import jax
@@ -62,24 +77,33 @@ def _run(table, pop, hot_budget, n_passes, pass_keys, rng, dim) -> None:
     from paddle_tpu.models.ctr import CtrConfig, DeepFM, make_ctr_train_step
     from paddle_tpu.ps.embedding_cache import CacheConfig, HbmEmbeddingCache
 
-    # cold-load the population in chunks (bulk model load at scale)
+    # cold-load the population in chunks (bulk model load at scale);
+    # per-chunk rates expose load-time degradation (index growth)
     chunk = 1_000_000
+    rss_start = _rss_bytes()
     t0 = time.perf_counter()
     fd = table.full_dim
+    chunk_rates = []
     for lo in range(0, pop, chunk):
         n = min(chunk, pop - lo)
         keys = np.arange(lo + 1, lo + 1 + n, dtype=np.uint64)
         vals = np.zeros((n, fd), np.float32)
         vals[:, 3] = 1.0  # show
         vals[:, 5] = 0.01 * rng.standard_normal(n).astype(np.float32)
+        tc = time.perf_counter()
         table.load_cold(keys, vals)
+        chunk_rates.append(n / (time.perf_counter() - tc))
     load_s = time.perf_counter() - t0
     st0 = table.stats()
+    rss_after_load = _rss_bytes()
 
     cfg = CtrConfig(num_sparse_slots=8, num_dense=4, embedx_dim=dim,
                     dnn_hidden=(64, 64))
+    # HBM pass-cache capacity tracks the pass working set (x1.25 slack,
+    # min 2^18) — a fixed 2^18 cap rejected the 400k-key XL passes
+    cap = 1 << int(np.ceil(np.log2(max(pass_keys * 1.25, 1 << 18))))
     cache = HbmEmbeddingCache(table, CacheConfig(
-        capacity=1 << 18, embedx_dim=dim, embedx_threshold=0.0))
+        capacity=cap, embedx_dim=dim, embedx_threshold=0.0))
     model = DeepFM(cfg)
     opt = optimizer.Adam(1e-3)
     params = {"params": dict(model.named_parameters()), "buffers": {}}
@@ -126,10 +150,19 @@ def _run(table, pop, hot_budget, n_passes, pass_keys, rng, dim) -> None:
         "disk_bytes_after_load": st0["disk_bytes"],
         "cold_load_s": round(load_s, 2),
         "cold_load_rows_per_s": round(pop / load_s),
+        # first vs last chunk: does the cold index degrade with size?
+        "load_rate_first_chunk": round(chunk_rates[0]),
+        "load_rate_last_chunk": round(chunk_rates[-1]),
         "passes": passes,
         "final": {"hot_rows": st["hot_rows"], "cold_rows": st["cold_rows"],
                   "disk_bytes": st["disk_bytes"]},
         "hot_fraction": round(st["hot_rows"] / max(pop, 1), 6),
+        # FeasignIndex / cold-index host memory (VmRSS deltas)
+        "rss_start_bytes": rss_start,
+        "rss_after_load_bytes": rss_after_load,
+        "rss_final_bytes": _rss_bytes(),
+        "index_bytes_per_row": round(
+            (rss_after_load - rss_start) / max(pop, 1), 2),
     }
     print(json.dumps(out))
 
