@@ -87,13 +87,15 @@ class Model:
         contractions in the train step (amp is a property of the step —
         executor.make_train_step(amp=True)).
 
-        Note: "O2" is treated the same as "O1" here (bf16 contractions,
-        f32 params/master weights). The reference's O2 additionally casts
-        parameters to the low dtype ("pure fp16/bf16" with decorated
-        master weights); on TPU the O1 scheme is the idiomatic choice —
-        bf16 MXU matmuls with f32 accumulation/params — and loses no MXU
-        throughput, so ported O2 configs get O1 semantics rather than
-        bf16 parameter storage."""
+        "O2" is the reference's pure-low-precision mode
+        (``paddle.amp.decorate(level='O2')`` + multi_precision
+        optimizers): parameters are STORED bf16 (half the HBM, fed to
+        the MXU with no per-step casts) while the update runs in f32
+        against master weights carried by
+        :class:`paddle_tpu.optimizer.MasterWeights`. Masters are
+        initialized from the f32 parameters BEFORE the bf16 cast, so
+        decoration loses nothing. "O1" keeps f32 storage and casts
+        contractions per step."""
         self._opt = optimizer
         self._loss = loss
         self._metrics = list(metrics or [])
@@ -106,12 +108,26 @@ class Model:
             level = amp_configs
         if isinstance(level, bool) or level is None:
             amp_on = bool(level)
+            level = "O1" if amp_on else "O0"
         else:
             enforce(level in ("O0", "O1", "O2"),
                     f"amp_configs level must be O0/O1/O2, got {level!r}")
             amp_on = level != "O0"
         if optimizer is not None:
-            self._opt_state = optimizer.init(self._state["params"])
+            if level == "O2":
+                from .optimizer import MasterWeights
+
+                if not isinstance(optimizer, MasterWeights):
+                    optimizer = MasterWeights(optimizer)
+                self._opt = optimizer
+                # masters from the f32 originals, THEN cast storage
+                self._opt_state = optimizer.init(self._state["params"])
+                self._state["params"] = type(self._state["params"])(
+                    (k, v.astype(jnp.bfloat16)
+                     if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                    for k, v in self._state["params"].items())
+            else:
+                self._opt_state = optimizer.init(self._state["params"])
             self._train_step = make_train_step(self.network, optimizer, loss,
                                                donate=False, amp=amp_on)
         self._eval_fwd = make_eval_step(self.network)

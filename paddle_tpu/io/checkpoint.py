@@ -43,7 +43,15 @@ def _encode(obj: Any, arrays: List[np.ndarray]) -> Any:
         tag = "__list__" if isinstance(obj, list) else "__tuple__"
         return {tag: [_encode(v, arrays) for v in obj]}
     if hasattr(obj, "shape") or isinstance(obj, np.generic):
-        arrays.append(np.asarray(obj))
+        a = np.asarray(obj)
+        if a.dtype.kind == "V":
+            # ml_dtypes extended dtype (bfloat16, fp8 — O2 param
+            # storage): np.savez silently degrades these to raw void
+            # ('|V2'), so store a same-width unsigned view plus the
+            # dtype name and view back on load
+            arrays.append(a.view(np.dtype(f"u{a.dtype.itemsize}")))
+            return {_ARR: len(arrays) - 1, "__dtype__": a.dtype.name}
+        arrays.append(a)
         return {_ARR: len(arrays) - 1}
     if obj is None or isinstance(obj, (bool, int, float, str)):
         return obj
@@ -53,7 +61,12 @@ def _encode(obj: Any, arrays: List[np.ndarray]) -> Any:
 def _decode(spec: Any, arrays: Dict[str, np.ndarray]) -> Any:
     if isinstance(spec, dict):
         if _ARR in spec:
-            return arrays[f"a{spec[_ARR]}"]
+            arr = arrays[f"a{spec[_ARR]}"]
+            if "__dtype__" in spec:
+                import ml_dtypes
+
+                return arr.view(getattr(ml_dtypes, spec["__dtype__"]))
+            return arr
         if "__dict__" in spec:
             return {k: _decode(v, arrays) for k, v in spec["__dict__"]}
         if "__list__" in spec:

@@ -37,6 +37,7 @@ __all__ = [
     "RMSProp",
     "Lars",
     "Lamb",
+    "MasterWeights",
     "ClipGradByGlobalNorm",
     "ClipGradByNorm",
     "ClipGradByValue",
@@ -547,3 +548,68 @@ class Lamb(Optimizer):
                 "v": _tree_map(lambda tr: tr[2], triples, is_leaf=is_leaf),
             },
         )
+
+
+class MasterWeights:
+    """O2 mixed-precision master-weight wrapper — the reference's
+    ``paddle.amp.decorate(level='O2')`` + the ``multi_precision`` flag
+    of its optimizer kernels (phi adam/momentum ``MasterParam``
+    variants): the MODEL's parameters live in a low dtype (bf16 halves
+    their HBM and feeds the MXU directly) while the optimizer update
+    runs in f32 against a master copy carried in the wrapper's state.
+
+    Functional drop-in for :class:`Optimizer`::
+
+        opt = MasterWeights(Adam(1e-3))
+        state  = opt.init(bf16_params)      # masters = f32(params)
+        new_bf16, state = opt.update(grads, state, bf16_params)
+
+    ``update`` upcasts the (possibly bf16) grads, steps the inner
+    optimizer on the f32 masters, and returns the masters cast back to
+    each param's storage dtype — the low-precision params never
+    accumulate rounding across steps (they are pure projections of the
+    master). Non-float params (int embedding tables etc.) pass through
+    untouched.
+    """
+
+    def __init__(self, inner: Optimizer) -> None:
+        if not isinstance(inner, Optimizer):
+            raise InvalidArgumentError(
+                f"MasterWeights wraps an Optimizer, got {type(inner).__name__}")
+        if hasattr(inner, "scale_loss") or hasattr(inner, "inner"):
+            # Meta-optimizer wrappers (AMPOptimizer, GradientMerge, …)
+            # carry namespaced state ({'inner': ..., 'scaler': ...}) and
+            # a scale_loss hook this wrapper neither reshapes nor
+            # delegates — half-applying them would silently mis-scale
+            # every update. Compose the other way around:
+            # Meta(MasterWeights(plain_opt)).
+            raise InvalidArgumentError(
+                f"MasterWeights cannot wrap {type(inner).__name__}: wrap "
+                "the PLAIN optimizer and put the meta-optimizer outside — "
+                "e.g. AMPOptimizer(MasterWeights(Adam(...)))")
+        self.inner = inner
+
+    @staticmethod
+    def _to_master(p):
+        return p.astype(jnp.float32) if jnp.issubdtype(p.dtype, jnp.floating) else p
+
+    def init(self, params: PyTree) -> Dict[str, Any]:
+        master = _tree_map(self._to_master, params)
+        inner_state = self.inner.init(master)
+        return {"step": inner_state["step"],
+                "slots": {"master": master, "inner": inner_state["slots"]}}
+
+    def update(self, grads: PyTree, opt_state: Dict[str, Any],
+               params: PyTree) -> Tuple[PyTree, Dict[str, Any]]:
+        slots = opt_state["slots"]
+        g32 = _tree_map(self._to_master, grads)
+        inner_state = {"step": opt_state["step"], "slots": slots["inner"]}
+        new_master, new_inner = self.inner.update(g32, inner_state,
+                                                  slots["master"])
+        new_params = _tree_map(
+            lambda m, p: m.astype(p.dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating) else m,
+            new_master, params)
+        return new_params, {"step": new_inner["step"],
+                            "slots": {"master": new_master,
+                                      "inner": new_inner["slots"]}}
