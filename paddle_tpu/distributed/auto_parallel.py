@@ -34,7 +34,8 @@ from ..optimizer import Optimizer
 
 __all__ = ["ProcessMesh", "shard_tensor", "shard_op", "annotate",
            "complete_shardings", "reshard", "plan_strategy", "Engine",
-           "ClusterSpec", "estimate_plan_cost", "choose_strategy"]
+           "ClusterSpec", "estimate_plan_cost", "choose_strategy",
+           "hybrid_trainer_from_plan"]
 
 
 class ProcessMesh:
@@ -645,6 +646,35 @@ def choose_strategy(model, batch_tokens: int,
         best = min(candidates, key=lambda c: c["per_device_state_bytes"])
     mesh, ann = plans[(int(best["dp"]), int(best["mp"]), int(best["pp"]))]
     return mesh, ann, candidates
+
+
+def hybrid_trainer_from_plan(cfg, process_mesh: ProcessMesh, optimizer,
+                             num_micro: int = 2, seed: int = 0):
+    """Execute a :func:`choose_strategy` (dp, mp, pp) plan — the
+    planner/partitioner split of the reference (planner_v2 emits the
+    plan, the Partitioner + pipeline runtime execute it): dp/mp-only
+    plans run through :class:`Engine` (GSPMD), while a pp-bearing plan
+    runs HERE, through the pipeline trainer
+    (``parallel.hybrid.HybridParallelTrainer``) on a 4-axis
+    dp×pp×cp×mp mesh (cp=1) built from the plan's factorization.
+
+    ``cfg`` is the model's :class:`~paddle_tpu.models.ernie.ErnieConfig`
+    (the hybrid trainer's model family); ``process_mesh`` is the
+    planner's mesh. Returns the ready trainer — one ``train_step(ids,
+    labels)`` per batch."""
+    from jax.sharding import Mesh as JaxMesh
+
+    from ..parallel.hybrid import HybridParallelTrainer
+
+    dims = dict(zip(process_mesh.dim_names, process_mesh.shape))
+    dp = int(dims.get("dp", 1))
+    mp = int(dims.get("mp", 1))
+    pp = int(dims.get("pp", 1))
+    n = dp * mp * pp
+    devs = np.asarray(jax.devices()[:n]).reshape(dp, pp, 1, mp)
+    mesh = JaxMesh(devs, ("dp", "pp", "cp", "mp"))
+    return HybridParallelTrainer(cfg, mesh, optimizer,
+                                 num_micro=num_micro, seed=seed)
 
 
 def reshard(x, process_mesh: ProcessMesh,
