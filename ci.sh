@@ -65,8 +65,11 @@ print('bench degradation ladder OK')"
   trap 'make -C paddle_tpu/csrc -s' EXIT
   make -C paddle_tpu/csrc SANITIZE=thread -s
   rm -f /tmp/ci_tsan_report*
+  # exitcode=0: TSAN's default exit-66-if-anything-reported would mask
+  # pytest's own status behind unavoidable third-party noise — the grep
+  # below is the gate for OUR code, pytest's exit code for the tests
   LD_PRELOAD="$(gcc -print-file-name=libtsan.so)" \
-    TSAN_OPTIONS="suppressions=$PWD/paddle_tpu/csrc/tsan.supp,halt_on_error=0,log_path=/tmp/ci_tsan_report" \
+    TSAN_OPTIONS="suppressions=$PWD/paddle_tpu/csrc/tsan.supp,halt_on_error=0,exitcode=0,log_path=/tmp/ci_tsan_report" \
     python -m pytest tests/test_table_concurrency.py tests/test_ssd_table.py \
       tests/test_native_table.py tests/test_ps_rpc.py \
       tests/test_rpc_robustness.py tests/test_dist_graph.py -q -m ""
