@@ -507,20 +507,40 @@ def estimate_plan_cost(model, mesh: ProcessMesh,
         open_col_widths: List[float] = []
         for name, spec in annotations.items():
             p = params.get(name)
-            if p is None or len(p.shape) != 2:
+            if p is None or len(p.shape) not in (2, 4):
                 continue
-            s2 = list(spec)[:2]
-            if s2 == [1, -1] or s2 == [1, None]:
+            sdims = [d for d, m in enumerate(spec)
+                     if m is not None and m >= 0]
+            if len(sdims) != 1:
+                continue
+            sdim = sdims[0]
+            # role + activation width by layout: 2-D [in, out] (row =
+            # dim 0, width = out); 4-D OIHW conv (col = out-chan dim 0,
+            # row = in-chan dim 1, width = out channels; a spatial
+            # shard is not a Megatron pattern and charges nothing)
+            if len(p.shape) == 2:
+                if sdim == 0:
+                    is_row, width = True, float(p.shape[1])
+                else:
+                    is_row, width = False, float(p.shape[1])
+            else:
+                if sdim == 0:
+                    is_row, width = False, float(p.shape[0])
+                elif sdim == 1:
+                    is_row, width = True, float(p.shape[0])
+                else:
+                    continue
+            if is_row:
                 # row-parallel: output [batch_tokens, out] is psummed.
                 # A row partner closes ALL open cols — separate Q/K/V
                 # emit col,col,col,row and the one row output absorbs
                 # all three (mp_annotations_traced's `closing` loop
                 # discards every pred); pop-one would charge the other
                 # two phantom gathers
-                mp_act_bytes += 2.0 * batch_tokens * int(p.shape[1]) * 4.0
+                mp_act_bytes += 2.0 * batch_tokens * width * 4.0
                 open_col_widths.clear()
-            elif s2 == [-1, 1] or s2 == [None, 1]:
-                open_col_widths.append(float(p.shape[1]))
+            else:
+                open_col_widths.append(width)
         for width in open_col_widths:  # ADVICE r3: unpaired col gathers
             mp_gather_bytes += 2.0 * batch_tokens * width * 4.0
         # dp/pp shard the batch/stages: each group sees its local slice

@@ -83,11 +83,12 @@ class ParamUse:
     """One traced use of a parameter inside the forward."""
 
     name: str
-    kind: str                 # "matmul" | "gather"
-    contracted_dim: Optional[int]  # param dim contracted (matmul only)
+    kind: str                 # "matmul" | "conv" | "gather"
+    contracted_dim: Optional[int]  # param dim contracted / conv in-chan
     ndim: int
     preds: frozenset         # matmul/gather param names feeding the input
     order: int               # position in trace order
+    out_dim: Optional[int] = None  # non-contracted feature dim (col side)
 
 
 @dataclasses.dataclass
@@ -155,11 +156,14 @@ def trace_param_graph(model, example_inputs: Sequence[Any]) -> ParamGraph:
             return None
         return psrc.get(id(v))
 
-    def record(name, kind, cdim, ndim, preds):
+    def record(name, kind, cdim, ndim, preds, out_dim=None):
         if name not in seen:
             seen.add(name)
+            if out_dim is None and kind == "matmul" and ndim == 2 \
+                    and cdim is not None:
+                out_dim = 1 - cdim
             uses.append(ParamUse(name, kind, cdim, ndim,
-                                 frozenset(preds), counter[0]))
+                                 frozenset(preds), counter[0], out_dim))
             counter[0] += 1
 
     def map_into(inner_invars, outer_vars, keep_psrc=True):
@@ -266,6 +270,24 @@ def trace_param_graph(model, example_inputs: Sequence[Any]) -> ParamGraph:
                     for ov in eqn.outvars:
                         actsrc[id(ov)] = frozenset([wp[0]])
                     continue
+            elif prim == "conv_general_dilated":
+                # kernel side: rhs_spec gives (out-feature pos,
+                # in-feature pos, spatial...) — channel-parallel convs
+                # pair exactly like col/row matmuls (out-chan = col dim,
+                # in-chan = contracted dim)
+                p = rd_psrc(eqn.invars[1])
+                if p is not None:
+                    dn = eqn.params["dimension_numbers"]
+                    rhs_spec = tuple(dn.rhs_spec)
+                    dm = p[1]
+                    out_pos = dm[rhs_spec[0]]
+                    in_pos = dm[rhs_spec[1]]
+                    if out_pos is not None and in_pos is not None:
+                        record(p[0], "conv", in_pos, len(dm),
+                               rd_act(eqn.invars[0]), out_dim=out_pos)
+                        for ov in eqn.outvars:
+                            actsrc[id(ov)] = frozenset([p[0]])
+                        continue
             elif prim in ("gather", "take", "dynamic_slice"):
                 p = rd_psrc(eqn.invars[0])
                 if p is not None and len(p[1]) >= 1:
@@ -398,9 +420,16 @@ def complete_shardings_traced(
                                  len(graph.shapes.get(name, ())))
         if sdim is None or axis is None:
             return None
-        if u is None or u.kind != "matmul" or u.contracted_dim is None:
+        if (u is None or u.kind not in ("matmul", "conv")
+                or u.contracted_dim is None):
             return ("fixed", axis, sdim)
-        return (("row" if sdim == u.contracted_dim else "col"), axis, sdim)
+        if sdim == u.contracted_dim:
+            return ("row", axis, sdim)
+        if sdim == u.out_dim:
+            return ("col", axis, sdim)
+        # a hint on any OTHER dim (a conv spatial dim) is not a Megatron
+        # role: honor the placement, propagate nothing
+        return ("fixed", axis, sdim)
 
     for name, dm in hints.items():
         if name not in graph.shapes:
@@ -418,32 +447,29 @@ def complete_shardings_traced(
             if u is None:
                 continue
             if kind == "col":
-                # successors: unannotated matmuls consuming P's output
+                # successors: unannotated matmuls/convs consuming P's
+                # output
                 for s in graph.uses:
-                    if (s.kind == "matmul" and s.name not in role
+                    if (s.kind in ("matmul", "conv") and s.name not in role
                             and name in s.preds
                             and s.contracted_dim is not None):
                         role[s.name] = ("row", axis, s.contracted_dim)
                         changed = True
                 # siblings: same exact input activation (separate Q/K/V)
                 for s in graph.uses:
-                    if (s.kind == "matmul" and s.name not in role
+                    if (s.kind in ("matmul", "conv") and s.name not in role
                             and s.preds == u.preds
-                            and s.contracted_dim is not None):
-                        ndim = s.ndim
-                        out_dim = 1 - s.contracted_dim if ndim == 2 else None
-                        if out_dim is not None:
-                            role[s.name] = ("col", axis, out_dim)
-                            changed = True
+                            and s.out_dim is not None):
+                        role[s.name] = ("col", axis, s.out_dim)
+                        changed = True
             elif kind == "row":
                 # backward completion: producers become column-parallel
                 for pname in u.preds:
                     pu = graph.use_of(pname)
-                    if (pu is not None and pu.kind == "matmul"
+                    if (pu is not None and pu.kind in ("matmul", "conv")
                             and pname not in role
-                            and pu.contracted_dim is not None
-                            and pu.ndim == 2):
-                        role[pname] = ("col", axis, 1 - pu.contracted_dim)
+                            and pu.out_dim is not None):
+                        role[pname] = ("col", axis, pu.out_dim)
                         changed = True
 
     # -- emit specs ------------------------------------------------------
@@ -510,14 +536,15 @@ def mp_annotations_traced(model, mp: int, mp_dim: int,
             elif len(shape) > 1 and shape[1] % mp == 0:
                 ann[u.name] = dm_for(len(shape), 1)   # hidden-parallel
             continue
-        if u.kind != "matmul" or u.contracted_dim is None or u.ndim != 2:
+        if (u.kind not in ("matmul", "conv") or u.contracted_dim is None
+                or u.out_dim is None):
             continue
         closing = [p for p in u.preds if p in open_cols]
         if closing and shape[u.contracted_dim] % mp == 0:
-            ann[u.name] = dm_for(2, u.contracted_dim)  # row partner
+            ann[u.name] = dm_for(u.ndim, u.contracted_dim)  # row partner
             for p in closing:
                 open_cols.discard(p)
-        elif shape[1 - u.contracted_dim] % mp == 0:
-            ann[u.name] = dm_for(2, 1 - u.contracted_dim)  # column
+        elif shape[u.out_dim] % mp == 0:
+            ann[u.name] = dm_for(u.ndim, u.out_dim)  # column
             open_cols.add(u.name)
     return ann

@@ -558,6 +558,88 @@ class TestTracedCompletion:
         w = eng._state["params"]["blocks.1.ffn.w_out"]
         assert "mp" in tuple(w.sharding.spec)  # really sharded
 
+    def test_conv_chain_completes_channel_parallel(self):
+        """Convolutions trace as col/row pairs on their channel dims
+        (conv_general_dilated rhs_spec): a col hint on conv1's
+        out-channels derives conv2 as the in-channel row partner."""
+        from paddle_tpu.distributed.completion import trace_param_graph
+
+        class ConvNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.c1 = nn.Conv2D(3, 16, 3, padding=1)
+                self.c2 = nn.Conv2D(16, 32, 3, padding=1)
+
+            def forward(self, x):
+                return self.c2(jax.nn.relu(self.c1(x)))
+
+        sds = jax.ShapeDtypeStruct((2, 3, 8, 8), np.float32)
+        g = trace_param_graph(ConvNet(), [sds])
+        uses = {u.name: u for u in g.uses}
+        assert uses["c1.weight"].kind == "conv"
+        assert uses["c1.weight"].out_dim == 0          # out-channels
+        assert uses["c1.weight"].contracted_dim == 1   # in-channels
+        assert "c1.weight" in uses["c2.weight"].preds
+        mesh = auto.ProcessMesh(shape=(2, 4), dim_names=("dp", "mp"))
+        specs = auto.complete_shardings(
+            ConvNet(), mesh, {"c1.weight": [1, -1, -1, -1]},
+            example_inputs=[sds])
+        P = PartitionSpec
+        assert specs["c1.weight"] == P("mp")           # col: out-chan
+        assert specs["c2.weight"] == P(None, "mp")     # row: in-chan
+
+    def test_conv_spatial_hint_propagates_nothing(self):
+        """A hint on a conv KERNEL dim is not a Megatron role (review
+        finding): honor the placement if divisible, derive no partners."""
+        from paddle_tpu import nn as pnn
+
+        class ConvNet(pnn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.c1 = pnn.Conv2D(3, 16, 4, padding=1)
+                self.c2 = pnn.Conv2D(16, 32, 3, padding=1)
+
+            def forward(self, x):
+                return self.c2(jax.nn.relu(self.c1(x)))
+
+        sds = jax.ShapeDtypeStruct((2, 3, 8, 8), np.float32)
+        mesh = auto.ProcessMesh(shape=(2, 4), dim_names=("dp", "mp"))
+        specs = auto.complete_shardings(
+            ConvNet(), mesh, {"c1.weight": [-1, -1, 1, -1]},  # kernel H
+            example_inputs=[sds])
+        # kernel dim 4 % mp 4 == 0: placement honored, but c2 stays
+        # UNSHARDED — no bogus row partner
+        assert specs["c1.weight"] == PartitionSpec(None, None, "mp")
+        assert specs["c2.weight"] == PartitionSpec()
+
+    def test_conv_annotations_charge_mp_cost(self):
+        """4-D conv channel-parallel annotations must charge mp
+        activation comm (review finding: a zero-cost mp biases the
+        planner toward sharding conv models)."""
+
+        class ConvNet(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.c1 = nn.Conv2D(8, 64, 3, padding=1)
+                self.c2 = nn.Conv2D(64, 64, 3, padding=1)
+
+            def forward(self, x):
+                return self.c2(jax.nn.relu(self.c1(x)))
+
+        mesh = auto.ProcessMesh(shape=(2, 4), dim_names=("dp", "mp"))
+        # col(out-chan dim 0) + row(in-chan dim 1): OIHW convention
+        cost = auto.estimate_plan_cost(
+            ConvNet(), mesh,
+            {"c1.weight": [1, -1, -1, -1],   # mp on dim 0 = col
+             "c2.weight": [-1, 1, -1, -1]},  # mp on dim 1 = row
+            batch_tokens=4096)
+        assert cost["mp_activation_s"] > 0
+        assert cost["mp_gather_bytes"] == 0  # the pair closed
+        lone = auto.estimate_plan_cost(
+            ConvNet(), mesh, {"c1.weight": [1, -1, -1, -1]},
+            batch_tokens=4096)
+        assert lone["mp_gather_bytes"] > 0   # unpaired col gathers
+
     def test_traced_planner_rule_is_megatron_exact(self):
         """mp_annotations_traced pairs by DATAFLOW: residual edges do
         not mis-pair (the registration-order rule's failure mode)."""

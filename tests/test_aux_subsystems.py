@@ -386,6 +386,36 @@ def test_master_weights_rejects_meta_optimizer():
         optimizer.MasterWeights(AMPOptimizer(optimizer.Adam(1e-3)))
 
 
+def test_amp_optimizer_composes_outside_master_weights(rng):
+    """The DOCUMENTED composition — AMPOptimizer(MasterWeights(plain))
+    — actually trains: dynamic loss scaling outside, f32 masters
+    inside, bf16 params throughout."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import optimizer
+    from paddle_tpu.distributed.meta_optimizers import AMPOptimizer
+
+    p32 = {"w": jnp.asarray(rng.normal(size=(8, 4)), jnp.float32)}
+    opt = AMPOptimizer(optimizer.MasterWeights(optimizer.Adam(1e-2)))
+    state = opt.init(p32)
+    params = jax.tree.map(lambda p: p.astype(jnp.bfloat16), p32)
+    g = {"w": jnp.full((8, 4), 0.01, jnp.float32)}
+    for _ in range(5):
+        # grads of the SCALED loss, as the step factory produces them
+        sg = jax.tree.map(
+            lambda x: x * state["scaler"].loss_scale, g)
+        params, state = opt.update(sg, state, params)
+    assert params["w"].dtype == jnp.bfloat16
+    masters = state["inner"]["slots"]["master"]["w"]
+    assert masters.dtype == jnp.float32
+    assert np.isfinite(np.asarray(masters)).all()
+    # the params moved (updates were not skipped / zeroed by scaling)
+    assert not np.array_equal(
+        np.asarray(params["w"]).view(np.uint16),
+        np.asarray(p32["w"].astype(jnp.bfloat16)).view(np.uint16))
+
+
 def test_master_weights_matches_f32_trajectory(rng):
     """MasterWeights(Adam) fed the SAME f32 grads reproduces plain f32
     Adam's master trajectory exactly (the wrapper adds no math), while
