@@ -509,8 +509,9 @@ def estimate_plan_cost(model, mesh: ProcessMesh,
             p = params.get(name)
             if p is None or len(p.shape) not in (2, 4):
                 continue
-            sdims = [d for d, m in enumerate(spec)
-                     if m is not None and m >= 0]
+            # only MP-axis shards are mp collectives — a dp-axis shard
+            # (ZeRO-style placement) must not charge phantom psums
+            sdims = [d for d, m in enumerate(spec) if m == mp_ax]
             if len(sdims) != 1:
                 continue
             sdim = sdims[0]
@@ -585,6 +586,7 @@ def choose_strategy(model, batch_tokens: int,
                     state_multiplier: float = 4.0,
                     microbatches: int = 8,
                     example_inputs: Optional[Sequence[Any]] = None,
+                    allow_pp: bool = True,
                     ) -> Tuple[ProcessMesh,
                                Dict[str, Sequence[Optional[int]]],
                                List[Dict[str, float]]]:
@@ -621,7 +623,7 @@ def choose_strategy(model, batch_tokens: int,
         from .completion import trace_param_graph
 
         graph = trace_param_graph(model, example_inputs)  # trace ONCE
-    max_pp = _pipeline_stages(model, graph)
+    max_pp = _pipeline_stages(model, graph) if allow_pp else 1
     candidates: List[Dict[str, float]] = []
     plans = {}
     ann_cache: Dict[int, Dict] = {}
@@ -725,17 +727,38 @@ class Engine:
                  batch_dim_mesh_axis: Optional[str] = None,
                  annotations: Optional[Dict[str, Sequence[Optional[int]]]] = None,
                  example_inputs: Optional[Sequence[Any]] = None,
+                 plan: Optional[str] = None,
+                 batch_tokens: int = 4096,
+                 per_device_bytes: float = 16e9,
                  ) -> None:
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        # example_inputs (arrays or ShapeDtypeStructs): enables traced
+        # graph-aware completion (branching models — see completion.py)
+        self.example_inputs = example_inputs
+        if plan == "auto":
+            # the reference Engine's semi-auto mode: the cost-model
+            # planner picks the (dp, mp) factorization AND the hints
+            # (pp excluded — Engine executes GSPMD plans; pp plans run
+            # via hybrid_trainer_from_plan)
+            enforce(process_mesh is None and not annotations,
+                    "plan='auto' derives mesh and annotations — don't "
+                    "also pass them", InvalidArgumentError)
+            process_mesh, planned_ann, _ = choose_strategy(
+                model, batch_tokens=batch_tokens,
+                per_device_bytes=per_device_bytes,
+                example_inputs=example_inputs, allow_pp=False)
+            annotations = planned_ann
+            batch_dim_mesh_axis = batch_dim_mesh_axis or "dp"
+        else:
+            enforce(plan is None,
+                    f"plan must be None or 'auto', got {plan!r}",
+                    InvalidArgumentError)
         self.process_mesh = process_mesh or ProcessMesh(
             shape=(len(jax.devices()),), dim_names=("dp",))
         self.batch_axis = batch_dim_mesh_axis or self.process_mesh.dim_names[0]
         self.annotations = annotations or {}
-        # example_inputs (arrays or ShapeDtypeStructs): enables traced
-        # graph-aware completion (branching models — see completion.py)
-        self.example_inputs = example_inputs
         self._prepared = False
 
     # -- prepare (plan + partition, engine.py prepare/_build) ------------

@@ -110,18 +110,34 @@ class Trainer:
         optimizer: Optimizer,
         loss_fn: Callable[..., jax.Array],
         seed: int = 0,
-        amp: bool = False,
+        amp=False,
         amp_dtype: str = "bfloat16",
     ) -> None:
         self.model = model
-        self.optimizer = optimizer
         self.loss_fn = loss_fn
+        # ``amp`` accepts hapi's level strings too: "O0"/False,
+        # "O1"/True (bf16 contractions), "O2" (bf16 PARAM STORAGE with
+        # f32 masters — optimizer auto-wrapped in MasterWeights)
+        o2 = amp == "O2"
+        if isinstance(amp, str):
+            from .core.enforce import enforce as _enforce
+
+            _enforce(amp in ("O0", "O1", "O2"),
+                     f"amp must be bool or O0/O1/O2, got {amp!r}")
+            amp = amp != "O0"
         # copy the initial state: the jitted step donates its input buffers,
         # and donating the arrays still referenced by the Layer would leave
         # the model holding deleted buffers on TPU (donation is a no-op on
         # CPU, so only hardware runs would crash)
         self.state = jax.tree_util.tree_map(jnp.array, nn.get_state(model))
-        self.opt_state = optimizer.init(self.state["params"])
+        if o2:
+            from .optimizer import decorate_o2
+
+            optimizer, self.opt_state, self.state["params"] = decorate_o2(
+                optimizer, self.state["params"])
+        else:
+            self.opt_state = optimizer.init(self.state["params"])
+        self.optimizer = optimizer
         self._rng = jax.random.key(seed)
         self._train_step = make_train_step(model, optimizer, loss_fn,
                                            amp=amp, amp_dtype=amp_dtype)

@@ -115,17 +115,11 @@ class Model:
             amp_on = level != "O0"
         if optimizer is not None:
             if level == "O2":
-                from .optimizer import MasterWeights
+                from .optimizer import decorate_o2
 
-                if not isinstance(optimizer, MasterWeights):
-                    optimizer = MasterWeights(optimizer)
+                optimizer, self._opt_state, self._state["params"] = \
+                    decorate_o2(optimizer, self._state["params"])
                 self._opt = optimizer
-                # masters from the f32 originals, THEN cast storage
-                self._opt_state = optimizer.init(self._state["params"])
-                self._state["params"] = type(self._state["params"])(
-                    (k, v.astype(jnp.bfloat16)
-                     if jnp.issubdtype(v.dtype, jnp.floating) else v)
-                    for k, v in self._state["params"].items())
             else:
                 self._opt_state = optimizer.init(self._state["params"])
             self._train_step = make_train_step(self.network, optimizer, loss,

@@ -38,6 +38,7 @@ __all__ = [
     "Lars",
     "Lamb",
     "MasterWeights",
+    "decorate_o2",
     "ClipGradByGlobalNorm",
     "ClipGradByNorm",
     "ClipGradByValue",
@@ -613,3 +614,34 @@ class MasterWeights:
         return new_params, {"step": new_inner["step"],
                             "slots": {"master": new_master,
                                       "inner": new_inner["slots"]}}
+
+
+def decorate_o2(optimizer, params: PyTree):
+    """O2 decoration (``paddle.amp.decorate(level='O2')``), shared by
+    ``executor.Trainer(amp="O2")`` and ``hapi.Model.prepare``: ensure a
+    :class:`MasterWeights` sits in the optimizer chain (inserted around
+    the INNERMOST plain optimizer, so AMPOptimizer(Adam) becomes
+    AMPOptimizer(MasterWeights(Adam)) and an already-decorated chain is
+    left alone), initialize state with masters from the f32 ``params``,
+    and return the bf16 storage params.
+
+    Returns ``(optimizer, opt_state, bf16_params)``.
+    """
+    cur, holder = optimizer, None
+    while cur is not None and not isinstance(cur, MasterWeights):
+        nxt = getattr(cur, "inner", None)
+        if nxt is None:
+            break
+        holder, cur = cur, nxt
+    if not isinstance(cur, MasterWeights):
+        wrapped = MasterWeights(cur)
+        if holder is None:
+            optimizer = wrapped
+        else:
+            holder.inner = wrapped
+    opt_state = optimizer.init(params)  # masters from the f32 originals
+    bf16 = type(params)(
+        (k, v.astype(jnp.bfloat16)
+         if jnp.issubdtype(v.dtype, jnp.floating) else v)
+        for k, v in params.items())
+    return optimizer, opt_state, bf16

@@ -829,3 +829,42 @@ def test_planner_pp_plan_executes_via_hybrid_trainer():
                          jnp.int32)
     losses = [float(trainer.train_step(ids, labels)) for _ in range(4)]
     assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+
+
+def test_engine_plan_auto_semi_automatic():
+    """Engine(plan='auto') — the reference Engine's semi-auto mode: the
+    cost-model planner derives mesh AND annotations; the user supplies
+    only model/loss/optimizer (+ example_inputs for traced hints)."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 16)).astype(np.float32)
+    y = rng.integers(0, 4, 16).astype(np.int32)
+    pt.seed(0)
+    eng = auto.Engine(_Mlp(), nn.functional.cross_entropy,
+                      optimizer.SGD(0.1), plan="auto",
+                      example_inputs=[jax.ShapeDtypeStruct((16, 16),
+                                                           np.float32)])
+    assert "pp" not in dict(zip(eng.process_mesh.dim_names,
+                                eng.process_mesh.shape)) or \
+        dict(zip(eng.process_mesh.dim_names, eng.process_mesh.shape))["pp"] == 1
+    losses = eng.fit([((x,), (y,))] * 4)
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    # conflicting arguments rejected
+    with pytest.raises(Exception, match="auto"):
+        auto.Engine(_Mlp(), nn.functional.cross_entropy, optimizer.SGD(0.1),
+                    plan="auto",
+                    process_mesh=auto.ProcessMesh(shape=(8,),
+                                                  dim_names=("dp",)))
+    with pytest.raises(Exception, match="plan"):
+        auto.Engine(_Mlp(), nn.functional.cross_entropy, optimizer.SGD(0.1),
+                    plan="semi")
+
+
+def test_dp_axis_shard_charges_no_mp_cost():
+    """A param sharded on the DP axis (ZeRO-style placement) is not an
+    mp collective — the cost walk keys on the mp axis only (review
+    finding: phantom psums inflated mixed plans)."""
+    m = _Mlp(d=16, h=32)
+    mesh = auto.ProcessMesh(shape=(4, 2), dim_names=("dp", "mp"))
+    cost = auto.estimate_plan_cost(m, mesh, {"fc2.weight": [0, -1]},
+                                   batch_tokens=4096)
+    assert cost["mp_activation_s"] == 0 and cost["mp_gather_bytes"] == 0

@@ -437,3 +437,34 @@ def test_master_weights_matches_f32_trajectory(rng):
     np.testing.assert_array_equal(
         np.asarray(os_["slots"]["master"]["w"]), np.asarray(p32["w"]))
     assert p16["w"].dtype == jnp.bfloat16
+
+
+def test_decorate_o2_composes_with_meta_wrappers(rng):
+    """decorate_o2 inserts MasterWeights around the INNERMOST plain
+    optimizer: AMPOptimizer(Adam) becomes AMPOptimizer(MasterWeights(
+    Adam)); already-decorated chains are left alone (review finding:
+    the naive isinstance check dead-ended the documented composition)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import optimizer
+    from paddle_tpu.distributed.meta_optimizers import AMPOptimizer
+    from paddle_tpu.optimizer import MasterWeights, decorate_o2
+
+    p32 = {"w": jnp.asarray(rng.normal(size=(4, 4)), jnp.float32)}
+
+    # meta wrapper outside: MasterWeights inserted inside
+    opt, state, p16 = decorate_o2(AMPOptimizer(optimizer.Adam(1e-2)), p32)
+    assert isinstance(opt, AMPOptimizer)
+    assert isinstance(opt.inner, MasterWeights)
+    assert p16["w"].dtype == jnp.bfloat16
+    g = jax.tree.map(lambda x: x * state["scaler"].loss_scale,
+                     {"w": jnp.full((4, 4), 0.01, jnp.float32)})
+    p16, state = opt.update(g, state, p16)
+    assert p16["w"].dtype == jnp.bfloat16
+
+    # already decorated: unchanged, not double-wrapped
+    pre = AMPOptimizer(MasterWeights(optimizer.Adam(1e-2)))
+    opt2, _, _ = decorate_o2(pre, p32)
+    assert opt2 is pre and isinstance(opt2.inner, MasterWeights)
+    assert not isinstance(opt2.inner.inner, MasterWeights)

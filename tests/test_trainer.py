@@ -140,3 +140,28 @@ def test_trainer_amp_trains_and_is_bf16_in_trace(rng):
     lowered32 = step_f32.lower(state, opt_state, jax.random.key(0),
                                (x,), (y,))
     assert "bf16" not in lowered32.as_text()
+
+
+def test_trainer_amp_o2_master_weights():
+    """Trainer(amp="O2"): bf16 parameter storage + f32 masters (the
+    hapi amp_configs="O2" semantics on the low-level Trainer)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.optimizer import MasterWeights
+
+    pt.seed(0)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 8)).astype(np.float32)
+    y = (x.sum(-1) > 0).astype(np.int32)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    tr = Trainer(model, optimizer.Adam(5e-3), nn.functional.cross_entropy,
+                 amp="O2")
+    assert isinstance(tr.optimizer, MasterWeights)
+    for p in tr.state["params"].values():
+        assert p.dtype == jnp.bfloat16
+    losses = [float(tr.train_step((x,), (y,))) for _ in range(20)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    masters = tr.opt_state["slots"]["master"]
+    for k, p in tr.state["params"].items():
+        np.testing.assert_array_equal(
+            np.asarray(p), np.asarray(masters[k].astype(jnp.bfloat16)), k)
