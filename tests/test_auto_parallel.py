@@ -588,6 +588,41 @@ class TestTracedCompletion:
         assert specs["c1.weight"] == P("mp")           # col: out-chan
         assert specs["c2.weight"] == P(None, "mp")     # row: in-chan
 
+    def test_two_tower_hint_stays_in_its_tower(self):
+        """Branch isolation (DSSM-style two towers): a col hint in tower
+        A derives A's row partner but must NOT leak into tower B — the
+        towers share only the INPUT, and the sibling rule requires the
+        same activation, which B's deeper layers don't see."""
+
+        class TwoTower(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a1 = nn.Linear(16, 32)
+                self.a2 = nn.Linear(32, 8)
+                self.b1 = nn.Linear(16, 32)
+                self.b2 = nn.Linear(32, 8)
+
+            def forward(self, x):
+                a = self.a2(jax.nn.relu(self.a1(x)))
+                b = self.b2(jax.nn.relu(self.b1(x)))
+                return (a * b).sum(-1)
+
+        sds = jax.ShapeDtypeStruct((4, 16), np.float32)
+        mesh = auto.ProcessMesh(shape=(2, 4), dim_names=("dp", "mp"))
+        specs = auto.complete_shardings(
+            TwoTower(), mesh, {"a1.weight": [-1, 1]}, example_inputs=[sds])
+        P = PartitionSpec
+        assert specs["a1.weight"] == P(None, "mp")
+        assert specs["a2.weight"] == P("mp")       # A's row partner
+        # the SIBLING rule legitimately cols b1 (same input activation
+        # — Megatron-valid, b2 closes it); but A's pair must not force
+        # anything deeper in B than its own col/row pair
+        assert specs["b2.weight"] in (P(), P("mp"))
+        if specs["b1.weight"] == P(None, "mp"):
+            assert specs["b2.weight"] == P("mp")   # closed pair, valid
+        else:
+            assert specs["b2.weight"] == P()
+
     def test_conv_spatial_hint_propagates_nothing(self):
         """A hint on a conv KERNEL dim is not a Megatron role (review
         finding): honor the placement if divisible, derive no partners."""
