@@ -89,6 +89,11 @@ class ParamUse:
     preds: frozenset         # matmul/gather param names feeding the input
     order: int               # position in trace order
     out_dim: Optional[int] = None  # non-contracted feature dim (col side)
+    act_id: Optional[int] = None   # identity of the concrete activation
+    # var consumed (trace-local): two uses are siblings (Q/K/V) only if
+    # they consume the SAME var — ancestor-set equality alone would make
+    # any two first-layer matmuls on different raw inputs (both with
+    # empty preds) "siblings" and over-shard unrelated towers
 
 
 @dataclasses.dataclass
@@ -145,6 +150,29 @@ def trace_param_graph(model, example_inputs: Sequence[Any]) -> ParamGraph:
     seen: Set[str] = set()
     bias_of: Dict[str, str] = {}
     counter = [0]
+    # var id -> canonical activation identity: identity ops (dtype cast,
+    # copy) and call boundaries preserve "same activation" for the
+    # sibling (Q/K/V) test even when AMP inserts per-consumer converts.
+    # Identities are FRESH per eqn output per walk (monotonic counter),
+    # never the raw id(var): jax caches the jaxpr of a repeatedly-called
+    # jitted sub-function, so inner vars are the SAME objects on every
+    # invocation — id(var) would alias activations across unrelated
+    # invocations and re-open the false-sibling bug this field fixes
+    canon: Dict[int, int] = {}
+    _canon_next = [0]
+
+    def fresh_id() -> int:
+        _canon_next[0] += 1
+        return _canon_next[0]
+
+    def canon_of(v) -> Optional[int]:
+        if not hasattr(v, "aval") or type(v).__name__ == "Literal":
+            return None
+        c = canon.get(id(v))
+        if c is None:  # constvar or unwalked source: stable-but-unique
+            c = fresh_id()
+            canon[id(v)] = c
+        return c
 
     def rd_act(v) -> frozenset:
         if not hasattr(v, "aval") or type(v).__name__ == "Literal":
@@ -156,14 +184,15 @@ def trace_param_graph(model, example_inputs: Sequence[Any]) -> ParamGraph:
             return None
         return psrc.get(id(v))
 
-    def record(name, kind, cdim, ndim, preds, out_dim=None):
+    def record(name, kind, cdim, ndim, preds, out_dim=None, act_id=None):
         if name not in seen:
             seen.add(name)
             if out_dim is None and kind == "matmul" and ndim == 2 \
                     and cdim is not None:
                 out_dim = 1 - cdim
             uses.append(ParamUse(name, kind, cdim, ndim,
-                                 frozenset(preds), counter[0], out_dim))
+                                 frozenset(preds), counter[0], out_dim,
+                                 act_id))
             counter[0] += 1
 
     def map_into(inner_invars, outer_vars, keep_psrc=True):
@@ -176,6 +205,8 @@ def trace_param_graph(model, example_inputs: Sequence[Any]) -> ParamGraph:
             else:
                 psrc.pop(id(iv), None)
             actsrc[id(iv)] = rd_act(ov)
+            c = canon_of(ov)
+            canon[id(iv)] = c if c is not None else fresh_id()
 
     def walk(jx):
         for eqn in jx.eqns:
@@ -198,6 +229,7 @@ def trace_param_graph(model, example_inputs: Sequence[Any]) -> ParamGraph:
                     if i < len(inner.outvars):
                         actsrc[id(ov)] = rd_act(inner.outvars[i])
                     psrc.pop(id(ov), None)
+                    canon[id(ov)] = fresh_id()
                 continue
             if prim == "cond" and "branches" in eqn.params:
                 # cond: walk every branch (operands follow the index);
@@ -213,6 +245,7 @@ def trace_param_graph(model, example_inputs: Sequence[Any]) -> ParamGraph:
                 for ov, o in zip(eqn.outvars, outs):
                     actsrc[id(ov)] = o
                     psrc.pop(id(ov), None)
+                    canon[id(ov)] = fresh_id()
                 continue
             if prim == "while" and "body_jaxpr" in eqn.params:
                 body = eqn.params["body_jaxpr"]
@@ -225,6 +258,7 @@ def trace_param_graph(model, example_inputs: Sequence[Any]) -> ParamGraph:
                 for ov, iv in zip(eqn.outvars, inner.outvars):
                     actsrc[id(ov)] = rd_act(iv)
                     psrc.pop(id(ov), None)
+                    canon[id(ov)] = fresh_id()
                 continue
             if prim in _CALL_PRIMS and sub is not None:
                 inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
@@ -238,6 +272,11 @@ def trace_param_graph(model, example_inputs: Sequence[Any]) -> ParamGraph:
                     else:
                         psrc.pop(id(iv), None)
                     actsrc[id(iv)] = rd_act(ov)
+                    c = canon_of(ov)
+                    if c is not None:
+                        canon[id(iv)] = c
+                    else:
+                        canon.pop(id(iv), None)
                 walk(inner)
                 for iv, ov in zip(eqn.outvars, inner.outvars):
                     p = rd_psrc(ov)
@@ -246,6 +285,8 @@ def trace_param_graph(model, example_inputs: Sequence[Any]) -> ParamGraph:
                     else:
                         psrc.pop(id(iv), None)
                     actsrc[id(iv)] = rd_act(ov)
+                    c = canon_of(ov)
+                    canon[id(iv)] = c if c is not None else fresh_id()
                 continue
 
             union = frozenset().union(*(rd_act(v) for v in eqn.invars)) \
@@ -263,10 +304,10 @@ def trace_param_graph(model, example_inputs: Sequence[Any]) -> ParamGraph:
                         c = int(cdims[0]) if len(cdims) == 1 else None
                         pdim = p[1][c] if c is not None else None
                         other = lhs if v is rhs else rhs
-                        wp = (p[0], pdim, rd_act(other))
+                        wp = (p[0], pdim, rd_act(other), canon_of(other))
                         break
                 if wp is not None:
-                    record(wp[0], "matmul", wp[1], 2, wp[2])
+                    record(wp[0], "matmul", wp[1], 2, wp[2], act_id=wp[3])
                     for ov in eqn.outvars:
                         actsrc[id(ov)] = frozenset([wp[0]])
                     continue
@@ -284,7 +325,8 @@ def trace_param_graph(model, example_inputs: Sequence[Any]) -> ParamGraph:
                     in_pos = dm[rhs_spec[1]]
                     if out_pos is not None and in_pos is not None:
                         record(p[0], "conv", in_pos, len(dm),
-                               rd_act(eqn.invars[0]), out_dim=out_pos)
+                               rd_act(eqn.invars[0]), out_dim=out_pos,
+                               act_id=canon_of(eqn.invars[0]))
                         for ov in eqn.outvars:
                             actsrc[id(ov)] = frozenset([p[0]])
                         continue
@@ -358,6 +400,11 @@ def trace_param_graph(model, example_inputs: Sequence[Any]) -> ParamGraph:
                 actsrc[id(ov)] = union
                 if not view_set:
                     psrc.pop(id(ov), None)
+                if prim in ("convert_element_type", "copy") and eqn.invars:
+                    c = canon_of(eqn.invars[0])
+                    canon[id(ov)] = c if c is not None else fresh_id()
+                else:
+                    canon[id(ov)] = fresh_id()
 
     walk(jaxpr)
     shapes = {n: tuple(int(s) for s in np.shape(params[n])) for n in pnames}
@@ -456,9 +503,14 @@ def complete_shardings_traced(
                         role[s.name] = ("row", axis, s.contracted_dim)
                         changed = True
                 # siblings: same exact input activation (separate Q/K/V)
+                # — keyed on the concrete traced var (act_id), not the
+                # param-ancestor set: two first-layer matmuls on
+                # DIFFERENT raw inputs both have empty preds and must
+                # not be treated as siblings (advisor r4 finding)
                 for s in graph.uses:
                     if (s.kind in ("matmul", "conv") and s.name not in role
-                            and s.preds == u.preds
+                            and u.act_id is not None
+                            and s.act_id == u.act_id
                             and s.out_dim is not None):
                         role[s.name] = ("col", axis, s.out_dim)
                         changed = True

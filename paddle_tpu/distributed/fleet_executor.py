@@ -23,12 +23,21 @@ over a framed-TCP channel between ranks (the brpc ``MessageBus``
 message_bus.cc role) — interceptors are placed on ranks via
 ``Carrier(local_ids=...)``, sends route transparently, and the
 credit-based backpressure works unchanged across the wire.
+
+Security: frames are pickled Python objects. Listener ports MUST be
+cluster-internal (firewalled to job peers) — like the reference's brpc
+endpoints. Pass ``secret=`` to :class:`RemoteMessageBus` to require an
+HMAC-SHA256 tag on every frame; frames with a missing/wrong tag are
+dropped before unpickling, so a stray connection cannot execute code.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import enum
+import hashlib
+import hmac
+import logging
 import pickle
 import queue
 import socket
@@ -38,6 +47,8 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..core.enforce import InvalidArgumentError, PreconditionNotMetError, enforce
+
+logger = logging.getLogger(__name__)
 
 __all__ = [
     "MessageType",
@@ -92,6 +103,15 @@ class MessageBus:
             raise InvalidArgumentError(f"unknown interceptor id {msg.dst_id}")
         inbox.put(msg)
 
+    def send_best_effort(self, msg: InterceptorMessage) -> None:
+        """Fire-and-forget delivery for control broadcasts (STOP): never
+        raises, never waits on a down peer (RemoteMessageBus overrides
+        with a short one-shot connect instead of the retry loop)."""
+        try:
+            self.send(msg)
+        except (InvalidArgumentError, OSError):
+            pass
+
 
 class RemoteMessageBus(MessageBus):
     """Cross-rank interceptor message bus — the brpc ``MessageBus``
@@ -105,19 +125,33 @@ class RemoteMessageBus(MessageBus):
     inbox — interceptor code is identical either way, and the
     DATA_IS_USELESS credit returns travel the reverse path, so the
     buffer_size windows throttle ACROSS processes exactly as they do
-    in-process."""
+    in-process.
+
+    ``secret`` (recommended): a job-shared key. Each frame then carries
+    an HMAC-SHA256 tag over the body, verified with a constant-time
+    compare BEFORE ``pickle.loads`` — an unauthenticated connection
+    (pickle is code execution) gets its frames dropped and the
+    connection closed. Without a secret the bus trusts the network;
+    deploy only on cluster-internal/firewalled ports (see module
+    docstring)."""
 
     _FRAME = struct.Struct("<I")
     _MAX_FRAME = 1 << 30
+    _TAG_LEN = hashlib.sha256().digest_size
 
     def __init__(self, rank: int, rank_addrs: Dict[int, Tuple[str, int]],
                  interceptor_ranks: Dict[int, int],
-                 connect_timeout: float = 30.0) -> None:
+                 connect_timeout: float = 30.0,
+                 secret: Optional[bytes] = None,
+                 register_grace: float = 10.0) -> None:
         super().__init__()
         self.rank = int(rank)
         self._addrs = dict(rank_addrs)
         self._placement = dict(interceptor_ranks)
         self._connect_timeout = float(connect_timeout)
+        self._secret = bytes(secret) if secret is not None else None
+        self._register_grace = float(register_grace)
+        self.last_error: Optional[str] = None
         self._peers: Dict[int, socket.socket] = {}
         self._send_locks: Dict[int, threading.Lock] = {}
         self._peer_lock = threading.Lock()  # guards the two maps only
@@ -155,27 +189,57 @@ class RemoteMessageBus(MessageBus):
                     body = self._recv_exact(conn, n)
                     if body is None:
                         return
-                    self._deliver(pickle.loads(body))
+                    if self._secret is not None:
+                        if len(body) < self._TAG_LEN:
+                            logger.error("msgbus rank %d: short frame from "
+                                         "%s, closing", self.rank,
+                                         conn.getpeername())
+                            return
+                        tag, body = body[:self._TAG_LEN], body[self._TAG_LEN:]
+                        want = hmac.new(self._secret, body,
+                                        hashlib.sha256).digest()
+                        if not hmac.compare_digest(tag, want):
+                            logger.error("msgbus rank %d: bad HMAC from %s, "
+                                         "closing connection (frame dropped "
+                                         "before deserialization)",
+                                         self.rank, conn.getpeername())
+                            return
+                    if not self._deliver(pickle.loads(body)):
+                        return  # routing failure already logged; close so
+                        # the sender sees a reset instead of a black hole
         except (OSError, pickle.UnpicklingError):
             if not self._closing:
                 raise
 
     def _deliver(self, msg: InterceptorMessage,
-                 register_timeout: float = 10.0) -> None:
+                 register_timeout: Optional[float] = None) -> bool:
         """Local delivery with a registration grace window: a peer's
         first DATA_IS_READY can arrive between this rank's bus
         construction (listener up) and its Carrier registering inboxes
-        — a startup race, not an error. Bounded retry, then raise."""
+        — a startup race, not an error. Bounded retry; on expiry the
+        drop is LOGGED and recorded on the bus (``last_error``) and
+        False is returned so the caller closes the connection — a
+        raise here would die unseen in the daemon receive thread and
+        surface only as a remote-side timeout."""
+        if register_timeout is None:
+            register_timeout = self._register_grace
         deadline = time.monotonic() + register_timeout
         while True:
             try:
                 MessageBus.send(self, msg)
-                return
+                return True
             except InvalidArgumentError:
-                if self._closing or time.monotonic() > deadline:
-                    if self._closing:
-                        return  # late message during shutdown: drop
-                    raise
+                if self._closing:
+                    return True  # late message during shutdown: drop
+                if time.monotonic() > deadline:
+                    err = (f"msgbus rank {self.rank}: no interceptor "
+                           f"{msg.dst_id} registered after "
+                           f"{register_timeout}s grace — dropping "
+                           f"{msg.type.name} from {msg.src_id} and closing "
+                           f"the connection")
+                    logger.error(err)
+                    self.last_error = err
+                    return False
                 time.sleep(0.01)
 
     @staticmethod
@@ -219,13 +283,20 @@ class RemoteMessageBus(MessageBus):
 
     # -- MessageBus surface ----------------------------------------------
 
+    def _frame_bytes(self, msg: InterceptorMessage) -> bytes:
+        """Serialize + (optionally) sign + length-prefix one message —
+        the single definition of the wire format for BOTH send paths."""
+        body = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+        if self._secret is not None:
+            body = hmac.new(self._secret, body, hashlib.sha256).digest() + body
+        return self._FRAME.pack(len(body)) + body
+
     def send(self, msg: InterceptorMessage) -> None:
         dst_rank = self._placement.get(msg.dst_id, self.rank)
         if dst_rank == self.rank:
             MessageBus.send(self, msg)
             return
-        body = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
-        frame = self._FRAME.pack(len(body)) + body
+        frame = self._frame_bytes(msg)
         try:
             sock = self._peer(dst_rank)
             with self._send_locks[dst_rank]:  # frame-interleave guard
@@ -233,6 +304,38 @@ class RemoteMessageBus(MessageBus):
         except OSError:
             if not self._closing:
                 raise
+
+    def send_best_effort(self, msg: InterceptorMessage) -> None:
+        """STOP-broadcast path: cached socket, else up to 3 bounded
+        one-shot 2s connects — no connect_timeout retry loop, so
+        Carrier.stop over N down peers costs seconds, not minutes,
+        while STOP (completion-critical for sinkless ranks) still
+        survives a transient connect failure."""
+        dst_rank = self._placement.get(msg.dst_id, self.rank)
+        if dst_rank == self.rank:
+            MessageBus.send_best_effort(self, msg)
+            return
+        frame = self._frame_bytes(msg)
+        try:
+            with self._peer_lock:
+                sock = self._peers.get(dst_rank)
+            if sock is not None:
+                with self._send_locks[dst_rank]:
+                    sock.sendall(frame)
+                return
+            host, port = self._addrs[dst_rank]
+            for attempt in range(3):
+                try:
+                    with socket.create_connection((host, port),
+                                                  timeout=2.0) as s:
+                        s.sendall(frame)
+                    return
+                except OSError:
+                    if attempt == 2:
+                        raise
+                    time.sleep(0.1)
+        except OSError:
+            pass  # peer down: best-effort by contract
 
     def close(self) -> None:
         self._closing = True
@@ -510,14 +613,14 @@ class Carrier:
 
     def stop(self) -> None:
         # broadcast STOP over the FULL topology — cross-rank ids ride
-        # the remote bus (best-effort: a peer may already be down)
+        # the remote bus; best-effort with a one-shot connect so N down
+        # peers cost at most ~2s each, not connect_timeout each
         for task_id in self.all_ids:
-            try:
-                self.bus.send(InterceptorMessage(-1, task_id,
-                                                 MessageType.STOP))
-            except (InvalidArgumentError, OSError):
-                pass  # interceptor not local and no route / peer gone
+            self.bus.send_best_effort(InterceptorMessage(-1, task_id,
+                                                         MessageType.STOP))
         for it in self.interceptors.values():
+            if it.ident is None:
+                continue  # never started (stop before start is legal)
             it.join(timeout=5.0)
 
 

@@ -35,6 +35,18 @@ __all__ = ["save", "load", "save_checkpoint", "load_checkpoint",
 _ARR = "__arr__"
 
 
+def _is_ml_dtype(dt: np.dtype) -> bool:
+    """True only for ml_dtypes extended scalars (bfloat16, float8_*…),
+    whose numpy kind is 'V' but which have a named ml_dtypes type —
+    distinguishes them from genuine structured/record dtypes."""
+    try:
+        import ml_dtypes
+    except ImportError:
+        return False
+    t = getattr(ml_dtypes, dt.name, None)
+    return t is not None and np.dtype(t) == dt
+
+
 def _encode(obj: Any, arrays: List[np.ndarray]) -> Any:
     """Replace array leaves with {"__arr__": idx}; keep JSON-able scalars."""
     if isinstance(obj, dict):
@@ -44,11 +56,13 @@ def _encode(obj: Any, arrays: List[np.ndarray]) -> Any:
         return {tag: [_encode(v, arrays) for v in obj]}
     if hasattr(obj, "shape") or isinstance(obj, np.generic):
         a = np.asarray(obj)
-        if a.dtype.kind == "V":
+        if a.dtype.kind == "V" and _is_ml_dtype(a.dtype):
             # ml_dtypes extended dtype (bfloat16, fp8 — O2 param
             # storage): np.savez silently degrades these to raw void
             # ('|V2'), so store a same-width unsigned view plus the
-            # dtype name and view back on load
+            # dtype name and view back on load. Genuine structured/
+            # record arrays (also kind 'V') fall through to the plain
+            # append — they round-trip through savez natively.
             arrays.append(a.view(np.dtype(f"u{a.dtype.itemsize}")))
             return {_ARR: len(arrays) - 1, "__dtype__": a.dtype.name}
         arrays.append(a)

@@ -623,6 +623,69 @@ class TestTracedCompletion:
         else:
             assert specs["b2.weight"] == P()
 
+    def test_separate_inputs_are_not_siblings(self):
+        """Advisor r4 (medium): two first-layer matmuls consuming
+        DIFFERENT raw inputs both have empty param-ancestor sets; the
+        sibling rule must key on the concrete activation (act_id), so a
+        col hint on tower A's first layer does NOT col-shard tower B's
+        first layer (they share no activation at all)."""
+
+        class TwoInput(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a1 = nn.Linear(16, 32)
+                self.b1 = nn.Linear(24, 32)
+
+            def forward(self, x, y):
+                return (self.a1(x) + self.b1(y)).sum(-1)
+
+        sx = jax.ShapeDtypeStruct((4, 16), np.float32)
+        sy = jax.ShapeDtypeStruct((4, 24), np.float32)
+        mesh = auto.ProcessMesh(shape=(2, 4), dim_names=("dp", "mp"))
+        specs = auto.complete_shardings(
+            TwoInput(), mesh, {"a1.weight": [-1, 1]},
+            example_inputs=[sx, sy])
+        P = PartitionSpec
+        assert specs["a1.weight"] == P(None, "mp")
+        assert specs["b1.weight"] == P(), specs["b1.weight"]
+
+    def test_shared_jitted_subfn_not_siblings(self):
+        """jax caches the jaxpr of a repeatedly-called jitted
+        sub-function, so inner vars are the SAME objects on every
+        invocation — activation identity must be fresh per invocation
+        (per walk), or two towers calling the same jitted tower fn with
+        different params collide on act_id and false-sibling."""
+        import jax as _jax
+
+        @_jax.jit
+        def tower(w1, w2, x):
+            return _jax.nn.relu(x @ w1) @ w2
+
+        class SharedFn(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a1 = nn.Linear(16, 32)
+                self.a2 = nn.Linear(32, 8)
+                self.b1 = nn.Linear(16, 32)
+                self.b2 = nn.Linear(32, 8)
+
+            def forward(self, x, y):
+                a = tower(self.a1.weight, self.a2.weight, x)
+                b = tower(self.b1.weight, self.b2.weight, y)
+                return (a + b).sum(-1)
+
+        sx = jax.ShapeDtypeStruct((4, 16), np.float32)
+        sy = jax.ShapeDtypeStruct((4, 16), np.float32)
+        mesh = auto.ProcessMesh(shape=(2, 4), dim_names=("dp", "mp"))
+        specs = auto.complete_shardings(
+            SharedFn(), mesh, {"a1.weight": [-1, 1]},
+            example_inputs=[sx, sy])
+        P = PartitionSpec
+        assert specs["a1.weight"] == P(None, "mp")
+        assert specs["a2.weight"] == P("mp")       # its own row partner
+        assert specs["b1.weight"] == P(), specs["b1.weight"]
+        assert specs["b2.weight"] == P(), specs["b2.weight"]
+
     def test_conv_spatial_hint_propagates_nothing(self):
         """A hint on a conv KERNEL dim is not a Megatron role (review
         finding): honor the placement if divisible, derive no partners."""

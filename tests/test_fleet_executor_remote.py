@@ -140,3 +140,133 @@ def test_remote_bus_single_process_loopback():
     assert sink.outputs == [10, 11, 12, 13]
     bus0.close()
     bus1.close()
+
+
+def _free_ports(n):
+    ports = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        s.close()
+    return ports
+
+
+def test_remote_bus_hmac_roundtrip():
+    """With a shared secret, frames carry an HMAC tag and the pipeline
+    works unchanged (tag verified before unpickling)."""
+    from paddle_tpu.distributed.fleet_executor import (
+        Carrier, RemoteMessageBus, TaskNode)
+
+    ports = _free_ports(2)
+    addrs = {0: ("127.0.0.1", ports[0]), 1: ("127.0.0.1", ports[1])}
+    placement = {0: 0, 1: 1, 2: 1}
+    N = 3
+    nodes = [
+        TaskNode(task_id=0, role="source", max_run_times=N,
+                 downstreams=[(1, 2)]),
+        TaskNode(task_id=1, role="compute", fn=lambda x: x * 3,
+                 max_run_times=N, upstreams=[0], downstreams=[(2, 2)]),
+        TaskNode(task_id=2, role="sink", max_run_times=N, upstreams=[1]),
+    ]
+    secret = b"job-shared-key"
+    bus0 = RemoteMessageBus(0, addrs, placement, secret=secret)
+    bus1 = RemoteMessageBus(1, addrs, placement, secret=secret)
+    c0 = Carrier(nodes, feeds={0: list(range(N))}, bus=bus0, local_ids=[0])
+    c1 = Carrier(nodes, bus=bus1, local_ids=[1, 2])
+    c1.start()
+    c0.start()
+    c1.wait(timeout=30.0)
+    c0.wait(timeout=30.0)
+    assert c1.sinks[0].outputs == [0, 3, 6]
+    bus0.close()
+    bus1.close()
+
+
+def test_remote_bus_hmac_rejects_unauthenticated():
+    """A raw connection pushing an unsigned pickle frame at a
+    secret-protected listener gets dropped BEFORE deserialization: a
+    poison payload's reducer never runs and the bus stays healthy."""
+    import pickle
+    import struct
+    import time
+
+    from paddle_tpu.distributed.fleet_executor import (
+        InterceptorMessage, MessageType, RemoteMessageBus)
+
+    (port,) = _free_ports(1)
+    bus = RemoteMessageBus(0, {0: ("127.0.0.1", port)}, {0: 0},
+                           secret=b"right-key")
+    inbox = bus.register(7)
+    hits = []
+
+    class Poison:
+        def __reduce__(self):
+            return (hits.append, ("executed",))
+
+    msg = InterceptorMessage(1, 7, MessageType.DATA_IS_READY, Poison())
+    body = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    with socket.create_connection(("127.0.0.1", port), timeout=5.0) as s:
+        s.sendall(struct.pack("<I", len(body)) + body)  # no HMAC tag
+        # server closes on auth failure; recv returns b"" on close
+        s.settimeout(5.0)
+        assert s.recv(1) == b""
+    time.sleep(0.1)
+    assert hits == [], "unauthenticated frame was deserialized!"
+    assert inbox.empty()
+    bus.close()
+
+
+def test_carrier_stop_fast_on_dead_peer():
+    """Carrier.stop over a never-started peer must not spin the
+    connect-retry loop for connect_timeout per rank (advisor r4): the
+    best-effort one-shot connect bounds it to ~2s."""
+    import time
+
+    from paddle_tpu.distributed.fleet_executor import (
+        Carrier, RemoteMessageBus, TaskNode)
+
+    ports = _free_ports(2)
+    addrs = {0: ("127.0.0.1", ports[0]), 1: ("127.0.0.1", ports[1])}
+    placement = {0: 0, 1: 1}
+    nodes = [
+        TaskNode(task_id=0, role="source", max_run_times=1,
+                 downstreams=[(1, 1)]),
+        TaskNode(task_id=1, role="sink", max_run_times=1, upstreams=[0]),
+    ]
+    # long connect_timeout: the OLD path would spin ~30s on the dead rank
+    bus = RemoteMessageBus(0, addrs, placement, connect_timeout=30.0)
+    carrier = Carrier(nodes, feeds={0: [0]}, bus=bus, local_ids=[0])
+    t0 = time.monotonic()
+    carrier.stop()  # rank 1 never started
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10.0, f"STOP broadcast stalled {elapsed:.1f}s"
+    bus.close()
+
+
+def test_deliver_unknown_interceptor_logs_and_closes():
+    """A frame for an id that never registers is logged + recorded on
+    the bus and the connection is closed (not a silent daemon-thread
+    death)."""
+    import pickle
+    import struct
+    import time
+
+    from paddle_tpu.distributed.fleet_executor import (
+        InterceptorMessage, MessageType, RemoteMessageBus)
+
+    (port,) = _free_ports(1)
+    bus = RemoteMessageBus(0, {0: ("127.0.0.1", port)}, {0: 0},
+                           register_grace=0.5)
+    msg = InterceptorMessage(1, 999, MessageType.DATA_IS_READY, None)
+    body = pickle.dumps(msg, protocol=pickle.HIGHEST_PROTOCOL)
+    with socket.create_connection(("127.0.0.1", port), timeout=5.0) as s:
+        s.sendall(struct.pack("<I", len(body)) + body)
+        s.settimeout(20.0)
+        # after the (shortened) grace the server closes the connection
+        assert s.recv(1) == b""
+    deadline = time.monotonic() + 5.0
+    while bus.last_error is None and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert bus.last_error is not None and "999" in bus.last_error
+    bus.close()
