@@ -40,6 +40,8 @@
 #include <string>
 #include <unordered_map>
 
+#include <zlib.h>
+
 #include "graph_store.h"
 #include "sparse_table.h"
 
@@ -66,6 +68,11 @@ int64_t sst_shrink(void* h);
 int64_t sst_compact(void* h);
 int64_t sst_save_begin(void* h, int32_t mode);
 void sst_save_fetch(void* h, uint64_t* keys_out, float* values_out);
+int64_t sst_load_cold(void* h, const uint64_t* keys, const float* values,
+                      int64_t n);
+int64_t sst_save_file(void* h, const char* path, int32_t mode,
+                      int32_t use_gzip);
+int64_t sst_load_file(void* h, const char* path, int32_t use_gzip);
 }
 
 namespace {
@@ -126,6 +133,12 @@ enum Cmd : uint32_t {
   kGraphSetNodeFeat = 31,    // n ids; aux = feat_dim; payload ids + feats
   kGraphSampleNodes = 32,    // n = count → u64 ids (uniform, this server)
   kGraphStats = 33,          // → i64 [nodes, edges]
+  // bulk model load/save for populations that must not stage in client
+  // RAM or cross the wire as one frame (the 1e9-row regime)
+  kLoadCold = 34,   // n rows; payload keys + full rows → cold tier (SSD)
+  kSaveFile = 35,   // aux = mode | gzip<<8; payload = server-local path;
+                    // server streams its shard to the file itself
+  kLoadFile = 36,   // aux = gzip<<8; payload = path; streams it back in
 };
 
 enum Err : int64_t {
@@ -136,6 +149,80 @@ enum Err : int64_t {
 };
 
 constexpr uint64_t kMaxPayload = 1ULL << 32;  // 4 GiB frame cap
+
+// RAM-engine shard-file save/load (kSaveFile/kLoadFile for mem tables;
+// the SSD engine has streaming equivalents in ssd_table.cc). The mem
+// snapshot is RAM-bounded by construction, so staging it is fine.
+int64_t mem_save_file(NativeTable* t, const char* path, int32_t mode,
+                      int32_t use_gzip) {
+  int32_t fdim = table_full_dim(t);
+  int32_t ed = pstpu::rule_state_dim(t->cfg.embed_rule, 1);
+  std::lock_guard<std::mutex> sg(t->save_mu);
+  int64_t n = pstpu::table_save_snapshot_locked(t, mode);
+  gzFile gz = nullptr;
+  FILE* fp = nullptr;
+  if (use_gzip ? !(gz = gzopen(path, "wb")) : !(fp = std::fopen(path, "w"))) {
+    t->save_keys.clear();
+    t->save_values.clear();
+    return -1;
+  }
+  std::vector<char> line(64 + 24 * static_cast<size_t>(fdim));
+  bool ok = true;
+  for (int64_t i = 0; ok && i < n; ++i) {
+    int len = pstpu::format_text_row(line.data(), line.size(),
+                                     t->save_keys[i],
+                                     t->save_values.data() + i * fdim,
+                                     fdim, ed);
+    ok = use_gzip ? gzwrite(gz, line.data(), len) == len
+                  : std::fwrite(line.data(), 1, len, fp) == (size_t)len;
+  }
+  if (use_gzip ? gzclose(gz) != Z_OK : std::fclose(fp) != 0) ok = false;
+  t->save_keys.clear();
+  t->save_values.clear();
+  if (!ok) {
+    std::remove(path);
+    return -1;
+  }
+  return n;
+}
+
+int64_t mem_load_file(NativeTable* t, const char* path, int32_t use_gzip) {
+  int32_t fdim = table_full_dim(t);
+  int32_t ed = pstpu::rule_state_dim(t->cfg.embed_rule, 1);
+  gzFile gz = nullptr;
+  FILE* fp = nullptr;
+  if (use_gzip ? !(gz = gzopen(path, "rb")) : !(fp = std::fopen(path, "r")))
+    return -1;
+  const int64_t kBatch = 1 << 19;
+  std::vector<uint64_t> keys;
+  std::vector<float> vals;
+  std::vector<char> line(64 + 32 * static_cast<size_t>(fdim));
+  std::vector<float> row(fdim);
+  int64_t loaded = 0;
+  auto flush = [&]() {
+    if (keys.empty()) return;
+    pstpu::table_insert_full(t, keys.data(), vals.data(),
+                             static_cast<int64_t>(keys.size()));
+    loaded += static_cast<int64_t>(keys.size());
+    keys.clear();
+    vals.clear();
+  };
+  while (true) {
+    char* got = use_gzip ? gzgets(gz, line.data(), (int)line.size())
+                         : std::fgets(line.data(), (int)line.size(), fp);
+    if (!got) break;
+    uint64_t key;
+    if (!pstpu::parse_text_row(line.data(), &key, row.data(), fdim, ed,
+                               t->cfg.embedx_dim))
+      continue;
+    keys.push_back(key);
+    vals.insert(vals.end(), row.begin(), row.end());
+    if (static_cast<int64_t>(keys.size()) >= kBatch) flush();
+  }
+  flush();
+  if (use_gzip) gzclose(gz); else std::fclose(fp);
+  return loaded;
+}
 
 struct ReqHeader {
   uint64_t payload_len;
@@ -589,6 +676,43 @@ struct PsServer {
         SparseRef t;
         if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
         return respond(fd, t.ssd ? sst_compact(t.ssd) : 0, nullptr, 0);
+      }
+      case kLoadCold: {
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
+        int32_t fdim = t.full_dim();
+        uint64_t want = static_cast<uint64_t>(h.n) * (8 + 4 * fdim);
+        if (h.payload_len != want) return respond(fd, kErrBadSize, nullptr, 0);
+        const uint64_t* keys = reinterpret_cast<const uint64_t*>(p);
+        const float* vals = reinterpret_cast<const float*>(p + h.n * 8);
+        int64_t got;
+        if (t.ssd) {
+          got = sst_load_cold(t.ssd, keys, vals, h.n);
+        } else {
+          pstpu::table_insert_full(t.mem, keys, vals, h.n);
+          got = h.n;  // RAM engine has no cold tier: hot insert
+        }
+        return respond(fd, got, nullptr, 0);
+      }
+      case kSaveFile: {
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
+        if (!h.payload_len) return respond(fd, kErrBadSize, nullptr, 0);
+        int32_t mode = h.aux & 0xff, gz = (h.aux >> 8) & 1;
+        std::string path(p, h.payload_len);
+        int64_t cnt = t.ssd ? sst_save_file(t.ssd, path.c_str(), mode, gz)
+                            : mem_save_file(t.mem, path.c_str(), mode, gz);
+        return respond(fd, cnt < 0 ? kErrInternal : cnt, nullptr, 0);
+      }
+      case kLoadFile: {
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
+        if (!h.payload_len) return respond(fd, kErrBadSize, nullptr, 0);
+        int32_t gz = (h.aux >> 8) & 1;
+        std::string path(p, h.payload_len);
+        int64_t cnt = t.ssd ? sst_load_file(t.ssd, path.c_str(), gz)
+                            : mem_load_file(t.mem, path.c_str(), gz);
+        return respond(fd, cnt < 0 ? kErrInternal : cnt, nullptr, 0);
       }
       case kCreateGraph: {
         std::lock_guard<std::mutex> g(tables_mu);

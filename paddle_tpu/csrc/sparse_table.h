@@ -7,6 +7,8 @@
 #include <atomic>
 #include <cmath>
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <random>
@@ -641,6 +643,63 @@ inline void table_insert_full(NativeTable* t, const uint64_t* keys,
     int32_t r = sh->lookup_or_insert(keys[i], static_cast<int32_t>(v[0]));
     sh->import_row(r, v);
   });
+}
+
+// -- accessor checkpoint text row -------------------------------------------
+// ONE definition of the shard-file line format, shared by the RAM and
+// SSD engines' server-side save/load (ps_service kSaveFile/kLoadFile)
+// and byte-compatible with the Python writer/parser
+// (ps/table.py format_shard_row / parse_shard_row): fields are
+//   key slot unseen delta_score show click embed_w embed_state[ed]
+//   [embedx_w[xd] embedx_state...]     (embedx block omitted when the
+// has_embedx flag at v[6+ed] is 0). %g precisions match the Python
+// f-strings exactly (.6g head stats, .8g weights/state).
+
+inline int format_text_row(char* buf, size_t cap, uint64_t key,
+                           const float* v, int32_t fd, int32_t ed) {
+  int off = std::snprintf(buf, cap, "%llu %d %.6g %.6g %.6g %.6g %.8g",
+                          static_cast<unsigned long long>(key),
+                          static_cast<int>(v[0]), v[1], v[2], v[3], v[4],
+                          v[5]);
+  for (int32_t i = 0; i < ed; ++i)
+    off += std::snprintf(buf + off, cap - off, " %.8g", v[6 + i]);
+  if (v[6 + ed] != 0.0f)
+    for (int32_t i = 7 + ed; i < fd; ++i)
+      off += std::snprintf(buf + off, cap - off, " %.8g", v[i]);
+  buf[off++] = '\n';
+  buf[off] = '\0';
+  return off;
+}
+
+// Parse one line into (key, full row). Returns false on a malformed
+// line (short head). A tail with >= xd floats sets the has_embedx flag;
+// anything shorter leaves the embedx block zero (row never promoted).
+inline bool parse_text_row(const char* line, uint64_t* key, float* row,
+                           int32_t fd, int32_t ed, int32_t xd) {
+  char* end = nullptr;
+  unsigned long long k = std::strtoull(line, &end, 10);
+  if (end == line) return false;
+  *key = static_cast<uint64_t>(k);
+  const char* p = end;
+  std::memset(row, 0, sizeof(float) * static_cast<size_t>(fd));
+  int32_t head = 6 + ed;
+  for (int32_t i = 0; i < head; ++i) {
+    float v = std::strtof(p, &end);
+    if (end == p) return false;
+    row[i] = v;
+    p = end;
+  }
+  int32_t tmax = fd - head - 1;
+  int32_t cnt = 0;
+  while (cnt < tmax) {
+    float v = std::strtof(p, &end);
+    if (end == p) break;
+    row[head + 1 + cnt] = v;
+    p = end;
+    ++cnt;
+  }
+  if (cnt >= xd && xd > 0) row[head] = 1.0f;
+  return true;
 }
 
 }  // namespace pstpu

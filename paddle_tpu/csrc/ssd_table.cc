@@ -32,6 +32,7 @@
 #include <sys/stat.h>
 #include <sys/types.h>
 #include <unistd.h>
+#include <zlib.h>
 
 #include <cerrno>
 #include <cstdio>
@@ -738,6 +739,140 @@ void sst_flush(void* h) {
     std::lock_guard<std::mutex> g(d->mu);
     fsync(d->fd);
   }
+}
+
+// Streaming checkpoint save straight to a shard file (text format of
+// sparse_table.h format_text_row, optionally gzip'd) — the save path
+// for populations whose snapshot cannot be materialized in RAM (the
+// begin/fetch protocol stages the WHOLE keep-set; at 1e9 rows that is
+// tens of GB). Same per-shard atomicity, filter and
+// update_stat_after_save semantics as sst_save_begin. Returns rows
+// written, or -1 on an IO error (partial file removed).
+int64_t sst_save_file(void* h, const char* path, int32_t mode,
+                      int32_t use_gzip) {
+  SsdTable* t = static_cast<SsdTable*>(h);
+  std::lock_guard<std::mutex> sg(t->save_mu);
+  const TableNativeConfig& c = t->mem->cfg;
+  int32_t fd = t->fdim;
+  int32_t ed = pstpu::rule_state_dim(c.embed_rule, 1);
+  gzFile gz = nullptr;
+  FILE* fp = nullptr;
+  if (use_gzip) {
+    gz = gzopen(path, "wb");
+    if (!gz) return -1;
+  } else {
+    fp = std::fopen(path, "w");
+    if (!fp) return -1;
+  }
+  std::vector<char> line(64 + 24 * static_cast<size_t>(fd));
+  int64_t written = 0;
+  bool io_ok = true;
+  auto emit = [&](uint64_t key, const float* v) {
+    int len = pstpu::format_text_row(line.data(), line.size(), key, v, fd, ed);
+    if (use_gzip ? gzwrite(gz, line.data(), len) != len
+                 : std::fwrite(line.data(), 1, len, fp) != (size_t)len)
+      io_ok = false;
+    else
+      ++written;
+  };
+  for (size_t s = 0; io_ok && s < t->mem->shards.size(); ++s) {
+    Shard* sh = t->mem->shards[s];
+    DiskShard* d = t->disk[s];
+    std::lock_guard<std::mutex> g1(sh->mu);
+    std::lock_guard<std::mutex> g2(d->mu);
+    std::vector<float> row(fd);
+    for (uint64_t hh = 0; io_ok && hh <= sh->mask; ++hh) {
+      int32_t r = sh->slot_state[hh];
+      if (r < 0) continue;
+      if (!sh->save_keep(r, mode)) continue;
+      sh->update_stat_after_save(r, mode);
+      sh->export_row(r, row.data());
+      emit(sh->slot_keys[hh], row.data());
+    }
+    std::vector<std::pair<uint64_t, int64_t>> entries;
+    entries.reserve(d->index.used);
+    d->index.for_each([&](uint64_t k, int64_t ord) { entries.push_back({k, ord}); });
+    for (auto& [key, ord] : entries) {
+      if (!io_ok) break;
+      uint64_t k;
+      uint32_t flag;
+      if (!read_record(t, d, ord, &k, &flag, row.data()) || !flag) continue;
+      if (!save_keep_values(c, row.data(), mode)) continue;
+      bool dirty = false;
+      if (mode == 3) {
+        row[1] += 1.0f;
+        dirty = true;
+      } else if (mode == 1 || mode == 2) {
+        row[2] = 0.0f;
+        dirty = true;
+      }
+      emit(key, row.data());
+      if (dirty) {
+        int64_t nord = append_record(t, d, key, 1, row.data());
+        if (nord >= 0) d->index.upsert(key, nord);
+      }
+    }
+    maybe_compact(t, d);
+  }
+  if (use_gzip ? gzclose(gz) != Z_OK : std::fclose(fp) != 0) io_ok = false;
+  if (!io_ok) {
+    std::remove(path);
+    return -1;
+  }
+  return written;
+}
+
+// Streaming load of a shard file (plain or gzip text) into the COLD
+// tier in bounded batches (the restart/reload path at populations that
+// must not stage in RAM). Returns rows loaded, or -(parsed+1) when the
+// underlying bulk load fell short (disk full).
+int64_t sst_load_file(void* h, const char* path, int32_t use_gzip) {
+  SsdTable* t = static_cast<SsdTable*>(h);
+  const TableNativeConfig& c = t->mem->cfg;
+  int32_t fd = t->fdim;
+  int32_t ed = pstpu::rule_state_dim(c.embed_rule, 1);
+  gzFile gz = nullptr;
+  FILE* fp = nullptr;
+  if (use_gzip) {
+    gz = gzopen(path, "rb");
+    if (!gz) return -1;
+  } else {
+    fp = std::fopen(path, "r");
+    if (!fp) return -1;
+  }
+  const int64_t kBatch = 1 << 19;  // ~0.5M rows per cold-tier append wave
+  std::vector<uint64_t> keys;
+  std::vector<float> vals;
+  keys.reserve(kBatch);
+  vals.reserve(kBatch * fd);
+  std::vector<char> line(64 + 32 * static_cast<size_t>(fd));
+  std::vector<float> row(fd);
+  int64_t loaded = 0;
+  bool short_load = false;
+  auto flush_batch = [&]() {
+    if (keys.empty()) return;
+    int64_t got = sst_load_cold(h, keys.data(), vals.data(),
+                                static_cast<int64_t>(keys.size()));
+    loaded += got;
+    if (got != static_cast<int64_t>(keys.size())) short_load = true;
+    keys.clear();
+    vals.clear();
+  };
+  while (!short_load) {
+    char* got = use_gzip ? gzgets(gz, line.data(), (int)line.size())
+                         : std::fgets(line.data(), (int)line.size(), fp);
+    if (!got) break;
+    uint64_t key;
+    if (!pstpu::parse_text_row(line.data(), &key, row.data(), fd, ed,
+                               c.embedx_dim))
+      continue;
+    keys.push_back(key);
+    vals.insert(vals.end(), row.begin(), row.end());
+    if (static_cast<int64_t>(keys.size()) >= kBatch) flush_batch();
+  }
+  if (!short_load) flush_batch();
+  if (use_gzip) gzclose(gz); else std::fclose(fp);
+  return short_load ? -(loaded + 1) : loaded;
 }
 
 }  // extern "C"

@@ -79,6 +79,9 @@ _SAVE_ALL = 21
 _SPILL = 22
 _STATS = 23
 _COMPACT = 24
+_LOAD_COLD = 34
+_SAVE_FILE = 35
+_LOAD_FILE = 36
 
 _DENSE_OPT_IDS = {"sgd": 0, "adam": 1, "sum": 2}
 
@@ -612,6 +615,93 @@ class RpcPsClient(PSClient):
             c.check(_INSERT_FULL, table_id, n=len(sel), payload=payload,
                     timeout_ms=_long_ms())
 
+    def load_cold(self, table_id, keys, values, chunk: int = 1 << 21) -> int:
+        """Bulk cold-tier model load across servers (the 1e9-row build
+        path): keys route by ``key % num_servers``; each server's slice
+        ships in bounded chunks (frames stay far under the 4 GiB cap and
+        client RAM stays flat). SSD-backed tables append to their disk
+        logs; RAM tables hot-insert. Returns rows durably loaded."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        values = np.ascontiguousarray(values, np.float32)
+        full_dim = self._dims(table_id)[2]
+        enforce(values.shape == (len(keys), full_dim),
+                f"load_cold values shape {values.shape} != "
+                f"({len(keys)}, {full_dim})")
+        sv = self._route(keys)
+        total = 0
+        for s, c in enumerate(self._conns):
+            sel = np.flatnonzero(sv == s)
+            for lo in range(0, len(sel), chunk):
+                part = sel[lo : lo + chunk]
+                payload = (keys[part].tobytes()
+                           + np.ascontiguousarray(values[part]).tobytes())
+                cnt, _ = c.check(_LOAD_COLD, table_id, n=len(part),
+                                 payload=payload, timeout_ms=_long_ms())
+                total += int(cnt)
+        return total
+
+    def save_local(self, table_id, dirname, mode: int = 0,
+                   converter: Optional[str] = None) -> int:
+        """Server-side save: each server streams ITS shard straight to
+        ``dirname/part-{s:05d}.shard[.gz]`` — nothing crosses the wire,
+        so populations that cannot stage in RAM (or in one 4 GiB frame)
+        save fine. ``dirname`` must be reachable by the servers (same
+        host or shared FS — the reference's HDFS/AFS role). converter
+        "gzip" compresses server-side (zlib; files interoperate with the
+        Python gzip converter and the local-table loader)."""
+        from .table import converter_entry
+
+        enforce(converter in (None, "gzip"),
+                f"server-side save supports converter None|'gzip', "
+                f"got {converter!r}")
+        suffix = converter_entry(converter)[0]
+        os.makedirs(dirname, exist_ok=True)
+        aux = int(mode) | ((1 if converter == "gzip" else 0) << 8)
+        total = 0
+        for s, c in enumerate(self._conns):
+            path = os.path.join(dirname, f"part-{s:05d}.shard{suffix}")
+            cnt, _ = c.check(_SAVE_FILE, table_id, aux=aux,
+                             payload=path.encode(), timeout_ms=0,
+                             retries=0)
+            total += int(cnt)
+        import json
+
+        with open(os.path.join(dirname, "meta.json"), "w") as f:
+            json.dump({"shard_num": self.num_servers,
+                       "embedx_dim": self._embedx_dim(table_id),
+                       "accessor": self._sparse_cfgs[table_id].accessor,
+                       "mode": mode, "converter": converter}, f)
+        return total
+
+    def load_local(self, table_id, dirname) -> int:
+        """Server-side load of a ``save_local`` checkpoint. Requires the
+        SAME server count the save was made with (file s holds exactly
+        the keys ≡ s mod shard_num — a different count would misroute);
+        for re-sharding restores use ``load`` (client-side re-route)."""
+        import json
+
+        with open(os.path.join(dirname, "meta.json")) as f:
+            meta = json.load(f)
+        enforce(meta["shard_num"] == self.num_servers,
+                f"save_local checkpoint has {meta['shard_num']} shards but "
+                f"{self.num_servers} servers are up — use load() to "
+                f"re-route client-side")
+        from .table import converter_entry
+
+        conv = meta.get("converter")
+        suffix = converter_entry(conv)[0]
+        aux = (1 if conv == "gzip" else 0) << 8
+        total = 0
+        for s, c in enumerate(self._conns):
+            path = os.path.join(dirname, f"part-{s:05d}.shard{suffix}")
+            if not os.path.exists(path):
+                continue
+            cnt, _ = c.check(_LOAD_FILE, table_id, aux=aux,
+                             payload=path.encode(), timeout_ms=0,
+                             retries=0)
+            total += int(cnt)
+        return total
+
     def stop_servers(self) -> None:
         for c in self._conns:
             try:
@@ -671,6 +761,23 @@ class RemoteSparseTable:
 
     def load(self, dirname: str) -> int:
         return self._client.load(self._table_id, dirname)
+
+    def load_cold(self, keys, values) -> int:
+        return self._client.load_cold(self._table_id, keys, values)
+
+    def save_local(self, dirname: str, mode: int = 0,
+                   converter: Optional[str] = None) -> int:
+        return self._client.save_local(self._table_id, dirname, mode=mode,
+                                       converter=converter)
+
+    def load_local(self, dirname: str) -> int:
+        return self._client.load_local(self._table_id, dirname)
+
+    def spill(self, hot_budget: int) -> int:
+        return self._client.spill(self._table_id, hot_budget)
+
+    def stats(self) -> Dict[str, int]:
+        return self._client.table_stats(self._table_id)
 
     @property
     def full_dim(self) -> int:

@@ -39,6 +39,8 @@ from .native import FeasignIndex, NativeSparseTableEngine
 
 __all__ = [
     "TableConfig",
+    "register_converter",
+    "converter_entry",
     "MemorySparseTable",
     "SsdSparseTable",
     "make_sparse_table",
@@ -52,6 +54,49 @@ _SAVE_MODE_ALL = 0
 _SAVE_MODE_DELTA = 1
 _SAVE_MODE_BASE = 2
 _SAVE_MODE_BATCH = 3
+
+
+# -- save/load data converters -----------------------------------------------
+# The reference pipes table shard files through named converter/
+# deconverter programs on save/load (accessor.h:42 DataConverter, :95
+# GetConverter, :141 Converter; afs_warpper.h:123 — AFS shard
+# compression). Here a converter is (suffix, open_for_write,
+# open_for_read) over text streams; "gzip" ships built-in and is also
+# understood server-side by the native RPC save (zlib gzFile — the
+# files interoperate).
+
+_CONVERTERS: Dict[str, Tuple[str, object, object]] = {}
+
+
+def register_converter(name: str, suffix: str, open_write, open_read) -> None:
+    """Register a named shard-file converter. ``open_write(path)`` /
+    ``open_read(path)`` return text-mode file objects."""
+    _CONVERTERS[name] = (suffix, open_write, open_read)
+
+
+def _gzip_open_w(path):
+    import gzip
+
+    return gzip.open(path, "wt")
+
+
+def _gzip_open_r(path):
+    import gzip
+
+    return gzip.open(path, "rt")
+
+
+register_converter("gzip", ".gz", _gzip_open_w, _gzip_open_r)
+
+
+def converter_entry(name: Optional[str]):
+    """(suffix, open_write, open_read) for ``name``; identity when None."""
+    if name is None:
+        return "", (lambda p: open(p, "w")), (lambda p: open(p))
+    enforce(name in _CONVERTERS,
+            f"unknown save converter {name!r} (registered: "
+            f"{sorted(_CONVERTERS)})")
+    return _CONVERTERS[name]
 
 
 def merge_duplicate_keys(keys: np.ndarray, values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -112,6 +157,9 @@ class TableConfig:
     # disk logs (SsdSparseTable, requires ssd_path)
     storage: str = "memory"
     ssd_path: Optional[str] = None
+    # named shard-file converter applied on save/load (the reference's
+    # accessor DataConverter / AFS compression role); "gzip" built-in
+    converter: Optional[str] = None
 
 
 class _SparseShard:
@@ -433,10 +481,15 @@ class MemorySparseTable:
 
     # -- save/load (per-shard text files, Appendix A / SURVEY §5) ---------
 
-    def save(self, dirname: str, mode: int = _SAVE_MODE_ALL) -> int:
+    def save(self, dirname: str, mode: int = _SAVE_MODE_ALL,
+             converter: Optional[str] = None) -> int:
         """Per-shard text files in the accessor format (format_shard_row)
-        — identical bytes from either backend and the rpc transport."""
+        — identical bytes from either backend and the rpc transport.
+        ``converter`` (default ``config.converter``) pipes each shard
+        file through a registered converter (e.g. "gzip")."""
         os.makedirs(dirname, exist_ok=True)
+        conv = converter if converter is not None else self.config.converter
+        suffix, open_w, _ = converter_entry(conv)
         ed = self.accessor.embed_rule.state_dim
         if self._native is not None:
             keys, values = self._native.save_items(mode)
@@ -452,13 +505,15 @@ class MemorySparseTable:
         bounds = np.searchsorted(shard_of[order],
                                  np.arange(self.config.shard_num + 1))
         for i in range(self.config.shard_num):  # one open file at a time
-            with open(os.path.join(dirname, f"part-{i:05d}.shard"), "w") as f:
+            path = os.path.join(dirname, f"part-{i:05d}.shard{suffix}")
+            with open_w(path) as f:
                 for j in order[bounds[i] : bounds[i + 1]]:
                     f.write(format_shard_row(keys[j], values[j], ed, xd) + "\n")
-        self._write_meta(dirname, mode)
+        self._write_meta(dirname, mode, conv)
         return len(keys)
 
-    def _write_meta(self, dirname: str, mode: int) -> None:
+    def _write_meta(self, dirname: str, mode: int,
+                    converter: Optional[str] = None) -> None:
         with open(os.path.join(dirname, "meta.json"), "w") as f:
             json.dump(
                 {
@@ -466,6 +521,7 @@ class MemorySparseTable:
                     "embedx_dim": self.accessor.config.embedx_dim,
                     "accessor": self.config.accessor,
                     "mode": mode,
+                    "converter": converter,
                 },
                 f,
             )
@@ -474,15 +530,16 @@ class MemorySparseTable:
         with open(os.path.join(dirname, "meta.json")) as f:
             meta = json.load(f)
         enforce_eq(meta["embedx_dim"], self.accessor.config.embedx_dim, "embedx_dim mismatch")
+        suffix, _, open_r = converter_entry(meta.get("converter"))
         ed = self.accessor.embed_rule.state_dim
         xd = self.accessor.config.embedx_dim
         total = 0
         for i in range(meta["shard_num"]):
-            path = os.path.join(dirname, f"part-{i:05d}.shard")
+            path = os.path.join(dirname, f"part-{i:05d}.shard{suffix}")
             if not os.path.exists(path):
                 continue
             keys, rows = [], []
-            with open(path) as f:
+            with open_r(path) as f:
                 for line in f:
                     parts = line.split()
                     if not parts:
