@@ -5,6 +5,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
@@ -204,6 +205,28 @@ inline uint64_t splitmix64(uint64_t x) {
   return x ^ (x >> 31);
 }
 
+// Per-INSTANCE hash salt for every linear-probing index. Load-bearing,
+// found the hard way at 0.66e9 rows (round 5): checkpoint saves emit
+// rows in the SOURCE index's hash order, and re-inserting keys in
+// home-slot order into a linear-probing table is the classic quadratic
+// pathology — the occupied slots form one solid run, every insert
+// whose home falls inside it probes to the run's end (millions of
+// probes, below any full-table guard), and a 1e8-row restore "hangs".
+// Salting each index instance randomly means no two tables agree on
+// home order, so any iteration order of one table is random order for
+// another. Process-local entropy only — hash order was never a
+// persisted contract (files are keyed text; values replay by key).
+inline uint64_t next_hash_salt() {
+  // counter makes instances within a process distinct; the clock makes
+  // instance #k of one process distinct from instance #k of another
+  // (the restore case: fresh server processes re-creating tables in
+  // the same order as the savers did)
+  static std::atomic<uint64_t> ctr{0x243F6A8885A308D3ULL};
+  uint64_t now = static_cast<uint64_t>(
+      std::chrono::steady_clock::now().time_since_epoch().count());
+  return splitmix64(ctr.fetch_add(0x9E3779B97F4A7C15ULL) ^ now);
+}
+
 // ---------------------------------------------------------------------------
 // shard: index + columnar feature storage + accessor math
 // ---------------------------------------------------------------------------
@@ -219,8 +242,13 @@ struct Shard {
   std::vector<uint64_t> slot_keys;
   std::vector<int32_t> slot_state;  // row | kEmpty | kTombstone
   uint64_t mask = 0;
+  uint64_t hash_salt = next_hash_salt();  // see next_hash_salt()
   int64_t used = 0;
   int64_t occupied = 0;
+
+  uint64_t slot_of(uint64_t key) const {
+    return splitmix64(key ^ hash_salt) & mask;
+  }
 
   // rows (SoA). row_alive gates recycled rows.
   std::vector<uint64_t> row_key;
@@ -258,7 +286,7 @@ struct Shard {
     occupied = 0;
     for (size_t i = 0; i < ok.size(); ++i) {
       if (os[i] >= 0) {
-        uint64_t h = splitmix64(ok[i]) & mask;
+        uint64_t h = slot_of(ok[i]);
         while (slot_state[h] != kEmpty) h = (h + 1) & mask;
         slot_keys[h] = ok[i];
         slot_state[h] = os[i];
@@ -268,7 +296,7 @@ struct Shard {
   }
 
   int32_t find(uint64_t key) const {
-    uint64_t h = splitmix64(key) & mask;
+    uint64_t h = slot_of(key);
     while (true) {
       int32_t s = slot_state[h];
       if (s == kEmpty) return -1;
@@ -318,7 +346,7 @@ struct Shard {
   }
 
   int32_t lookup_or_insert(uint64_t key, int32_t slot) {
-    uint64_t h = splitmix64(key) & mask;
+    uint64_t h = slot_of(key);
     int64_t first_tomb = -1;
     while (true) {
       int32_t s = slot_state[h];
@@ -343,7 +371,8 @@ struct Shard {
   }
 
   void erase(uint64_t key) {
-    uint64_t h = splitmix64(key) & mask;
+    uint64_t h = slot_of(key);
+    uint64_t probes = 0;
     while (true) {
       int32_t s = slot_state[h];
       if (s == kEmpty) return;
@@ -355,6 +384,15 @@ struct Shard {
         return;
       }
       h = (h + 1) & mask;
+      if (++probes > mask + 1) {
+        std::fprintf(stderr,
+                     "Shard.erase: full-table probe (cap=%llu used=%d "
+                     "state[0..3]=%d,%d,%d,%d) — no empty slot\n",
+                     (unsigned long long)(mask + 1), (int)used,
+                     (int)slot_state[0], (int)slot_state[1],
+                     (int)slot_state[2], (int)slot_state[3]);
+        std::abort();
+      }
     }
   }
 

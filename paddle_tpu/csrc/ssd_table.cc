@@ -56,7 +56,16 @@ struct DiskIndex {
   std::vector<uint64_t> keys;
   std::vector<int64_t> vals;  // ordinal | kIdxEmpty | kIdxTomb
   uint64_t mask = 0;
+  // per-instance salt (pstpu::next_hash_salt rationale): restores feed
+  // this index keys in the SAVER index's hash order — unsalted, that
+  // insertion order is home-slot-sorted and linear probing goes
+  // quadratic (the 0.66e9-row restore "hang")
+  uint64_t salt = pstpu::next_hash_salt();
   int64_t used = 0, occupied = 0;
+
+  uint64_t slot_of(uint64_t key) const {
+    return pstpu::splitmix64(key ^ salt) & mask;
+  }
 
   DiskIndex() {
     keys.assign(1024, 0);
@@ -74,7 +83,7 @@ struct DiskIndex {
     occupied = 0;
     for (size_t i = 0; i < ok.size(); ++i) {
       if (ov[i] >= 0) {
-        uint64_t h = pstpu::splitmix64(ok[i]) & mask;
+        uint64_t h = slot_of(ok[i]);
         while (vals[h] != kIdxEmpty) h = (h + 1) & mask;
         keys[h] = ok[i];
         vals[h] = ov[i];
@@ -84,18 +93,28 @@ struct DiskIndex {
   }
 
   int64_t find(uint64_t key) const {
-    uint64_t h = pstpu::splitmix64(key) & mask;
+    uint64_t h = slot_of(key);
+    uint64_t probes = 0;
     while (true) {
       int64_t v = vals[h];
       if (v == kIdxEmpty) return -1;
       if (v >= 0 && keys[h] == key) return v;
       h = (h + 1) & mask;
+      if (++probes > mask + 1) {
+        std::fprintf(stderr,
+                     "DiskIndex.find: full-table probe (cap=%llu used=%lld "
+                     "occupied=%lld) — invariant broken\n",
+                     (unsigned long long)(mask + 1), (long long)used,
+                     (long long)occupied);
+        std::abort();
+      }
     }
   }
 
   void upsert(uint64_t key, int64_t ord) {
-    uint64_t h = pstpu::splitmix64(key) & mask;
+    uint64_t h = slot_of(key);
     int64_t first_tomb = -1;
+    uint64_t probes = 0;
     while (true) {
       int64_t v = vals[h];
       if (v == kIdxEmpty) {
@@ -114,11 +133,19 @@ struct DiskIndex {
         return;
       }
       h = (h + 1) & mask;
+      if (++probes > mask + 1) {
+        std::fprintf(stderr,
+                     "DiskIndex.upsert: full-table probe (cap=%llu used=%lld "
+                     "occupied=%lld) — invariant broken\n",
+                     (unsigned long long)(mask + 1), (long long)used,
+                     (long long)occupied);
+        std::abort();
+      }
     }
   }
 
   bool erase(uint64_t key) {
-    uint64_t h = pstpu::splitmix64(key) & mask;
+    uint64_t h = slot_of(key);
     while (true) {
       int64_t v = vals[h];
       if (v == kIdxEmpty) return false;
@@ -551,11 +578,19 @@ int64_t sst_load_cold(void* h, const uint64_t* keys, const float* values,
         return;  // this shard stops; `loaded` reports the shortfall
       }
       d->n_records = ord0 + static_cast<int64_t>(nb);
+      if (getenv("SST_DEBUG"))
+        std::fprintf(stderr, "slice wrote ord0=%lld nb=%zu\n",
+                     (long long)ord0, nb);
       for (size_t j = 0; j < nb; ++j) {
         int64_t i = idx[lo + j];
         sh->erase(keys[i]);  // hot copy (if any) is superseded
         d->index.upsert(keys[i], ord0 + static_cast<int64_t>(j));
       }
+      if (getenv("SST_DEBUG"))
+        std::fprintf(stderr, "slice indexed ord0=%lld cap=%llu occ=%lld\n",
+                     (long long)ord0,
+                     (unsigned long long)(d->index.mask + 1),
+                     (long long)d->index.occupied);
       loaded.fetch_add(static_cast<int64_t>(nb));
     }
   });
@@ -758,7 +793,9 @@ int64_t sst_save_file(void* h, const char* path, int32_t mode,
   gzFile gz = nullptr;
   FILE* fp = nullptr;
   if (use_gzip) {
-    gz = gzopen(path, "wb");
+    // level 1: the save is CPU-bound on zlib at 1e9 rows; fast-level
+    // ratio on this low-entropy text is within ~25% of default-6
+    gz = gzopen(path, "wb1");
     if (!gz) return -1;
   } else {
     fp = std::fopen(path, "w");

@@ -179,6 +179,66 @@ def test_load_cold_and_compaction(tmp_path):
         atol=1e-6)
 
 
+@pytest.mark.slow
+def test_hash_order_reload_not_quadratic(tmp_path):
+    """Round-5 regression (found at 0.66e9 rows): a checkpoint emits
+    rows in the SAVER index's hash order; re-inserting keys in home-slot
+    order into an UNSALTED linear-probing index is quadratic — the
+    occupied slots form one solid run and every insert probes to its end
+    (the restore at scale "hung" at ~10M rows/shard with zero IO). The
+    per-instance hash salt (pstpu::next_hash_salt) decorrelates saver
+    and loader home orders; this drives save_file→load_file at a
+    single-shard scale where the unsalted engine takes tens of minutes
+    and asserts it completes in bounded time with exact row counts."""
+    import ctypes
+    import time
+
+    from paddle_tpu.ps.native import load_native
+
+    lib = load_native()
+    lib.sst_save_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int32, ctypes.c_int32]
+    lib.sst_save_file.restype = ctypes.c_int64
+    lib.sst_load_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_int32]
+    lib.sst_load_file.restype = ctypes.c_int64
+
+    n = 8_000_000
+    # shard_num=1 concentrates every key in ONE index — the earliest
+    # onset of the pathology (matches the production single-residue
+    # concentration)
+    t = SsdSparseTable(str(tmp_path / "a"), _cfg(shard_num=1))
+    fd = t.full_dim
+    wave = 1 << 21
+    for lo in range(0, n, wave):
+        m = min(wave, n - lo)
+        keys = (np.arange(m, dtype=np.uint64) + lo + 1)
+        vals = np.zeros((m, fd), np.float32)
+        vals[:, 3] = 1.0
+        vals[:, 5] = 0.01
+        t.load_cold(keys, vals)
+    ck = str(tmp_path / "part.shard.gz")
+    saved = lib.sst_save_file(t._native._h, ck.encode(), 0, 1)
+    assert saved == n
+    t.close()
+
+    t2 = SsdSparseTable(str(tmp_path / "b"), _cfg(shard_num=1))
+    t0 = time.perf_counter()
+    got = lib.sst_load_file(t2._native._h, ck.encode(), 1)
+    dt = time.perf_counter() - t0
+    assert got == n
+    # salted: ~20-30s even on the busy 1-core host; unsalted: >10 min
+    assert dt < 240, f"hash-order reload took {dt:.0f}s — quadratic again?"
+    # spot parity through the full pull path
+    rng = np.random.default_rng(0)
+    sample = rng.choice(np.arange(1, n + 1, dtype=np.uint64), 200,
+                        replace=False)
+    vals, found = t2.export_full(sample)
+    assert found.all()
+    np.testing.assert_allclose(vals[:, 3], 1.0)
+    t2.close()
+
+
 def test_cache_pass_over_ssd_table(tmp_path):
     """HbmEmbeddingCache works unchanged over the SSD table: begin_pass
     promotes/creates, end_pass flushes back hot."""

@@ -83,7 +83,93 @@ def spawn_servers(n):
     return procs, ports
 
 
+def restore_only(ckpt: str) -> None:
+    """Re-run ONLY the restore leg against an existing save_local
+    checkpoint (DIST_RESTORE_ONLY=<ckpt_dir>): fresh server processes,
+    fresh SSD directories, server-side load, parity against a sample
+    PARSED FROM THE CHECKPOINT TEXT itself (ground truth travels in the
+    artifact, so the original client's in-memory sample isn't needed).
+    Exists because the first full run's restore leg hit the hash-order
+    quadratic-probing bug — build/save/pass numbers from that run stand
+    (they completed before the bug bit), and redoing 1.5 h of build to
+    re-measure a 15-minute leg after the fix would say nothing new."""
+    import gzip
+    import json as _json
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu.ps.rpc as rpc
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+    from paddle_tpu.ps.table import TableConfig, parse_shard_row
+
+    n_servers = int(os.environ.get("DIST_SERVERS", 4))
+    dim = int(os.environ.get("DIST_DIM", 4))
+    base = os.environ.get("DIST_DIR") or tempfile.mkdtemp(prefix="dist_rest_")
+    acc = AccessorConfig(embedx_dim=dim, embedx_threshold=0.0,
+                         sgd=SGDRuleConfig(initial_range=0.0))
+    with open(os.path.join(ckpt, "meta.json")) as f:
+        meta = _json.load(f)
+    assert meta["shard_num"] == n_servers, (meta, n_servers)
+
+    # ground-truth sample: first K parseable lines of each shard file
+    ed = 1  # adagrad embed state
+    want = {}
+    for s in range(n_servers):
+        path = os.path.join(ckpt, f"part-{s:05d}.shard.gz")
+        with gzip.open(path, "rt") as f:
+            for _, line in zip(range(500), f):
+                parts = line.split()
+                if parts:
+                    k, row = parse_shard_row(parts, ed, dim, 7 + ed + dim + 1)
+                    want[int(k)] = row
+    sample = np.asarray(sorted(want), np.uint64)
+
+    out = {"mode": "restore_only", "ckpt": ckpt, "n_servers": n_servers,
+           "host_cores": os.cpu_count()}
+    procs, cli = [], None
+    try:
+        procs, ports = spawn_servers(n_servers)
+        cli = rpc.RpcPsClient([f"127.0.0.1:{p}" for p in ports])
+        cfg = TableConfig(shard_num=8, accessor_config=acc, storage="ssd",
+                          ssd_path=os.path.join(base, "tiers_restore"))
+        cli.create_sparse_table(0, cfg)
+        t0 = time.perf_counter()
+        restored = cli.load_local(0, ckpt)
+        load_s = time.perf_counter() - t0
+        got, found = cli.export_full(0, sample)
+        expect = np.stack([want[int(k)] for k in sample])
+        parity = bool(found.all()) and bool(
+            np.allclose(got, expect, rtol=1e-6, atol=1e-9))
+        out["restore"] = {"rows": int(restored), "seconds": round(load_s, 1),
+                          "rows_per_s": round(restored / max(load_s, 1e-9)),
+                          "sampled_parity": parity,
+                          "sample_size": int(len(sample)),
+                          "stats": cli.table_stats(0),
+                          "server_rss": [_rss_bytes(p.pid) for p in procs]}
+        out["ok"] = parity
+    finally:
+        try:
+            if cli is not None:
+                cli.stop_servers()
+                cli.close()
+        except Exception:
+            pass
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        shutil.rmtree(os.path.join(base, "tiers_restore"),
+                      ignore_errors=True)
+    print(json.dumps(out))
+
+
 def main() -> None:
+    if os.environ.get("DIST_RESTORE_ONLY"):
+        restore_only(os.environ["DIST_RESTORE_ONLY"])
+        return
     import jax
 
     jax.config.update("jax_platforms", "cpu")
