@@ -27,7 +27,9 @@ import numpy as np
 
 from .sgd_rule import SGDRuleConfig, SparseSGDRule, make_sgd_rule
 
-__all__ = ["AccessorConfig", "CtrCommonAccessor", "SparseAccessor", "make_accessor"]
+__all__ = ["AccessorConfig", "CtrCommonAccessor", "SparseAccessor",
+           "CtrDoubleAccessor", "CommMergeAccessor", "TensorAccessor",
+           "make_accessor"]
 
 
 @dataclasses.dataclass
@@ -51,15 +53,17 @@ class AccessorConfig:
 
 class FeatureBlock:
     """Columnar storage for a batch/shard of features (the SoA analogue
-    of FixedFeatureValue rows)."""
+    of FixedFeatureValue rows). show/click dtype comes from the accessor
+    (float32 for ctr/sparse; float64 for the double accessor)."""
 
     def __init__(self, n: int, accessor: "CtrCommonAccessor") -> None:
         dim = accessor.config.embedx_dim
+        stat = getattr(accessor, "stat_dtype", np.float32)
         self.slot = np.zeros(n, np.int32)
         self.unseen_days = np.zeros(n, np.float32)
         self.delta_score = np.zeros(n, np.float32)
-        self.show = np.zeros(n, np.float32)
-        self.click = np.zeros(n, np.float32)
+        self.show = np.zeros(n, stat)
+        self.click = np.zeros(n, stat)
         self.embed_w = np.zeros((n, 1), np.float32)
         self.embed_state = np.zeros((n, accessor.embed_rule.state_dim), np.float32)
         self.embedx_w = np.zeros((n, dim), np.float32)
@@ -212,6 +216,22 @@ class CtrCommonAccessor:
             # epoch at base saves (deliberate superset of the reference)
             block.delta_score[idx] = 0.0
 
+    # -- shard-file text format (ParseToString/ParseFromString role) ------
+
+    def format_row(self, key: int, full_row: np.ndarray) -> str:
+        """One checkpoint text line from a full-layout row; accessors
+        with a distinct save format (ctr_double) override BOTH hooks."""
+        from .table import format_shard_row
+
+        return format_shard_row(key, full_row, self.embed_rule.state_dim,
+                                self.config.embedx_dim)
+
+    def parse_row(self, parts, full_dim: int):
+        from .table import parse_shard_row
+
+        return parse_shard_row(parts, self.embed_rule.state_dim,
+                               self.config.embedx_dim, full_dim)
+
 
 class SparseAccessor(CtrCommonAccessor):
     """Pull drops CTR stats (sparse_accessor.h): [embed_w, embedx_w]."""
@@ -227,10 +247,121 @@ class SparseAccessor(CtrCommonAccessor):
         return out
 
 
-def make_accessor(name: str, config: Optional[AccessorConfig] = None):
-    table = {"ctr": CtrCommonAccessor, "sparse": SparseAccessor,
-             "CtrCommonAccessor": CtrCommonAccessor, "SparseAccessor": SparseAccessor}
+class CtrDoubleAccessor(CtrCommonAccessor):
+    """DownpourCtrDoubleAccessor behavioral port
+    (ctr_double_accessor.h:27): show/click accumulate in FLOAT64 — a
+    float32 accumulator stops absorbing +1.0 increments at ~1.7e7
+    impressions, so head features' CTR statistics (and every lifecycle
+    decision derived from them) silently freeze; the double layout is
+    the reference's fix for exactly that regime.
+
+    Distinct save format (ctr_double_accessor.cc ParseToString — field
+    ORDER differs from ctr and there is no explicit has_embedx flag):
+        key unseen_days delta_score show click embed_w embed_g2sum slot
+            [embedx_g2sum embedx_w...]
+    with the embedx tail emitted iff the show/click score clears
+    embedx_threshold at save time (the reference casts the doubles to
+    float in the text — precision is an IN-MEMORY property). Both SGD
+    rules must be single-state (adagrad g2sum), as in the reference.
+    """
+
+    stat_dtype = np.float64
+
+    def __init__(self, config: Optional[AccessorConfig] = None) -> None:
+        super().__init__(config)
+        if self.embed_rule.state_dim != 1 or self.embedx_rule.state_dim != 1:
+            raise KeyError(
+                "ctr_double requires single-state (g2sum/adagrad) sgd rules "
+                f"(got embed state {self.embed_rule.state_dim}, embedx state "
+                f"{self.embedx_rule.state_dim}) — ctr_double_accessor.h "
+                "stores exactly one g2sum per rule")
+
+    def format_row(self, key: int, v: np.ndarray) -> str:
+        # full-layout v = [slot, unseen, delta, show, click, embed_w,
+        # g2sum, has_embedx, embedx_w[xd], embedx_g2sum]
+        xd = self.config.embedx_dim
+        fields = [str(int(key)), f"{v[1]:.6g}", f"{v[2]:.6g}", f"{v[3]:.6g}",
+                  f"{v[4]:.6g}", f"{v[5]:.8g}", f"{v[6]:.8g}",
+                  str(int(v[0]))]
+        score = float(self.show_click_score(np.float64(v[3]),
+                                            np.float64(v[4])))
+        if v[7] != 0.0 and score >= self.config.embedx_threshold:
+            fields.append(f"{v[8 + xd]:.8g}")            # embedx_g2sum
+            fields += [f"{x:.8g}" for x in v[8 : 8 + xd]]
+        return " ".join(fields)
+
+    def parse_row(self, parts, full_dim: int):
+        xd = self.config.embedx_dim
+        key = np.uint64(parts[0])
+        data = [float(x) for x in parts[1:]]
+        row = np.zeros(full_dim, np.float32)
+        row[1:7] = data[:6]       # unseen delta show click embed_w g2sum
+        row[0] = data[6]          # slot
+        rest = data[7:]
+        if len(rest) >= 1 + xd:
+            row[7] = 1.0
+            row[8 + xd] = rest[0]             # embedx_g2sum
+            row[8 : 8 + xd] = rest[1 : 1 + xd]
+        return key, row
+
+
+class CommMergeAccessor:
+    """CommMergeAccessor (tensor_accessor.h/.cc): the accessor role the
+    Communicator's gradient merge goes through — values are flat
+    ``fea_dim`` float vectors, ``merge`` sums update buffers elementwise
+    (Eigen u_mat += o_mat), ``select``/``update`` are no-ops (the dense
+    table's server-side optimizer owns the apply), features never shrink
+    and always save."""
+
+    def __init__(self, config: Optional[AccessorConfig] = None) -> None:
+        self.config = config or AccessorConfig()
+
+    @property
+    def select_dim(self) -> int:
+        return self.config.embedx_dim
+
+    @property
+    def update_dim(self) -> int:
+        return self.config.embedx_dim
+
+    def merge(self, update: np.ndarray, other: np.ndarray) -> np.ndarray:
+        update += other
+        return update
+
+    def shrink(self, values: np.ndarray) -> bool:
+        return False  # comm values have no lifecycle
+
+    def save_filter(self, values: np.ndarray, mode: int) -> bool:
+        return True   # always dump
+
+
+class TensorAccessor(CommMergeAccessor):
+    """Accessor role for server-side tensor/dense tables (the
+    TensorTable/GlobalStepTable value path — tensor_table.h:257): same
+    merge-sum semantics as CommMergeAccessor; kept as a distinct name so
+    TableConfig/YAML can select it the way the reference's
+    TableParameter.accessor_class does."""
+
+
+_ACCESSOR_CLASSES = {
+    "ctr": CtrCommonAccessor, "sparse": SparseAccessor,
+    "ctr_double": CtrDoubleAccessor,
+    "comm_merge": CommMergeAccessor, "tensor": TensorAccessor,
+    "CtrCommonAccessor": CtrCommonAccessor,
+    "SparseAccessor": SparseAccessor,
+    "DownpourCtrDoubleAccessor": CtrDoubleAccessor,
+    "CommMergeAccessor": CommMergeAccessor,
+    "TensorAccessor": TensorAccessor,
+}
+
+
+def accessor_class(name: str):
     try:
-        return table[name](config)
+        return _ACCESSOR_CLASSES[name]
     except KeyError:
-        raise KeyError(f"unknown accessor {name!r}; have ctr/sparse")
+        raise KeyError(f"unknown accessor {name!r}; have "
+                       f"ctr/sparse/ctr_double/comm_merge/tensor")
+
+
+def make_accessor(name: str, config: Optional[AccessorConfig] = None):
+    return accessor_class(name)(config)

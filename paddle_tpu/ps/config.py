@@ -136,9 +136,27 @@ def load_ps_config(source: Union[str, Dict[str, Any]]) -> PsJobConfig:
         strategy.a_sync = True
         strategy.a_sync_configs["use_ps_gpu"] = 1
 
+    # accessor class is selectable the way TableAccessorParameter.
+    # accessor_class is (the_one_ps.py:135-140 defaulting): either key
+    # accepts the registry names (ctr / sparse / ctr_double / ... or the
+    # reference class names CtrCommonAccessor / DownpourCtrDoubleAccessor)
+    accessor_name = (_get(cfg, "table_parameters.accessor_class")
+                     or _get(cfg, "runner.accessor_class") or "ctr")
+    from .accessor import CtrCommonAccessor, accessor_class as _resolve
+
+    # fail fast at CONFIG time: unknown names raise, and non-feature
+    # accessors (comm_merge/tensor — the Communicator/dense roles) are
+    # rejected here rather than as an AttributeError deep inside table
+    # construction or the first checkpoint save
+    enforce(issubclass(_resolve(accessor_name), CtrCommonAccessor),
+            f"accessor_class {accessor_name!r} is not a sparse feature "
+            f"accessor (use ctr / sparse / ctr_double; comm_merge and "
+            f"tensor are communicator/dense-table roles)")
     table = TableConfig(
         shard_num=int(_get(cfg, "runner.thread_num", 16)),
+        accessor=accessor_name,
         accessor_config=AccessorConfig(embedx_dim=feature_dim - 1),
+        converter=_get(cfg, "table_parameters.converter"),
     )
 
     return PsJobConfig(

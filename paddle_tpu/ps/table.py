@@ -490,7 +490,6 @@ class MemorySparseTable:
         os.makedirs(dirname, exist_ok=True)
         conv = converter if converter is not None else self.config.converter
         suffix, open_w, _ = converter_entry(conv)
-        ed = self.accessor.embed_rule.state_dim
         if self._native is not None:
             keys, values = self._native.save_items(mode)
         else:
@@ -500,15 +499,15 @@ class MemorySparseTable:
             values = (np.concatenate([sh.full_rows(r) for (_, r), sh in per])
                       if per else np.zeros((0, self.full_dim), np.float32))
         shard_of = (keys % np.uint64(self.config.shard_num)).astype(np.int64)
-        xd = self.accessor.config.embedx_dim
         order = np.argsort(shard_of, kind="stable")
         bounds = np.searchsorted(shard_of[order],
                                  np.arange(self.config.shard_num + 1))
+        fmt = self.accessor.format_row  # accessor-defined text format
         for i in range(self.config.shard_num):  # one open file at a time
             path = os.path.join(dirname, f"part-{i:05d}.shard{suffix}")
             with open_w(path) as f:
                 for j in order[bounds[i] : bounds[i + 1]]:
-                    f.write(format_shard_row(keys[j], values[j], ed, xd) + "\n")
+                    f.write(fmt(keys[j], values[j]) + "\n")
         self._write_meta(dirname, mode, conv)
         return len(keys)
 
@@ -530,9 +529,20 @@ class MemorySparseTable:
         with open(os.path.join(dirname, "meta.json")) as f:
             meta = json.load(f)
         enforce_eq(meta["embedx_dim"], self.accessor.config.embedx_dim, "embedx_dim mismatch")
+        if "accessor" in meta:
+            # accessors define the text format — a ctr_double file is
+            # not parseable as ctr (field order differs)
+            from .accessor import accessor_class
+
+            writer = accessor_class(meta["accessor"])
+            # format compatibility = same parse_row implementation
+            # (ctr/sparse share the common format; ctr_double overrides)
+            enforce(getattr(writer, "parse_row", None)
+                    is type(self.accessor).parse_row,
+                    f"checkpoint written by accessor {meta['accessor']!r} "
+                    f"cannot load into {self.config.accessor!r}")
         suffix, _, open_r = converter_entry(meta.get("converter"))
-        ed = self.accessor.embed_rule.state_dim
-        xd = self.accessor.config.embedx_dim
+        parse = self.accessor.parse_row  # accessor-defined text format
         total = 0
         for i in range(meta["shard_num"]):
             path = os.path.join(dirname, f"part-{i:05d}.shard{suffix}")
@@ -544,7 +554,7 @@ class MemorySparseTable:
                     parts = line.split()
                     if not parts:
                         continue
-                    k, row = parse_shard_row(parts, ed, xd, self.full_dim)
+                    k, row = parse(parts, self.full_dim)
                     keys.append(k)
                     rows.append(row)
             if keys:
