@@ -422,14 +422,15 @@ class TestCostModel:
             per_device_bytes=pbytes * 2.5)
         assert mesh.jax_mesh.shape["mp"] >= 2
         assert ann
-        chosen = next(c for c in cands
-                      if c["dp"] == mesh.jax_mesh.shape["dp"]
-                      and c["mp"] == mesh.jax_mesh.shape["mp"])
+        # the selected candidate (sh/recompute variants share a mesh, so
+        # look up the chosen flag, not the first dp/mp match)
+        chosen = next(c for c in cands if c.get("chosen"))
         assert chosen["fits"]
         feas = [c for c in cands if c["fits"]]
         assert all(chosen["total_s"] <= c["total_s"] for c in feas)
-        # memory estimate actually shrinks with mp
-        by_mp = {c["mp"]: c["per_device_state_bytes"] for c in cands}
+        # memory estimate actually shrinks with mp (same sh/rc variant)
+        by_mp = {c["mp"]: c["per_device_state_bytes"] for c in cands
+                 if c["sh"] == 0 and not c["recompute"]}
         assert by_mp[2] < by_mp[1]
 
     def test_cross_host_dp_charges_dcn(self):
@@ -475,18 +476,25 @@ class TestCostModel:
     def test_nothing_fits_falls_back_to_memory_minimizing(self):
         """When no plan fits the budget, the binding constraint is
         memory: choose_strategy must return the candidate with the
-        smallest per-device state (largest usable mp), not the
-        comm-cheapest (pure dp — the WORST memory choice)."""
+        smallest per-device state. With the sh axis in the search that
+        is ZeRO-3 (+recompute) over the full dp width — every state
+        term divides by ALL devices with no mp remainder — not the
+        largest-mp plan the 2-axis search used to fall back to."""
         m = _Mlp(d=16, h=32)
         mesh, ann, cands = auto.choose_strategy(
             m, batch_tokens=64, n_devices=8, per_device_bytes=1.0)
         assert not any(c["fits"] for c in cands)
-        chosen = next(c for c in cands
-                      if c["dp"] == mesh.jax_mesh.shape["dp"]
-                      and c["mp"] == mesh.jax_mesh.shape["mp"])
-        assert chosen["per_device_state_bytes"] == min(
+        best = next(c for c in cands if c.get("chosen"))
+        assert best["per_device_state_bytes"] == min(
             c["per_device_state_bytes"] for c in cands)
-        assert mesh.jax_mesh.shape["mp"] > 1 and ann
+        assert best["sh"] == 3 and best["recompute"]
+        # with sh excluded (an executor that can't ZeRO), the fallback
+        # reverts to the largest usable mp
+        mesh2, ann2, cands2 = auto.choose_strategy(
+            m, batch_tokens=64, n_devices=8, per_device_bytes=1.0,
+            allow_sh=False)
+        assert not any(c["fits"] for c in cands2)
+        assert mesh2.jax_mesh.shape["mp"] > 1 and ann2
 
 
 class TestTracedCompletion:
@@ -786,10 +794,12 @@ class TestPlannerPP:
         m = self._stacked_odd()
         pbytes = sum(int(np.prod(p.shape)) * 4
                      for _, p in m.named_parameters())
-        # budget: fits only at a >=2-way split; mp shards nothing (odd)
+        # budget: fits only at a >=2-way split; mp shards nothing (odd).
+        # allow_sh=False: with ZeRO in the search, sh-2 fits with no
+        # bubble and correctly wins — this test pins the PP axis itself
         mesh, ann, cands = auto.choose_strategy(
             m, batch_tokens=64, n_devices=8,
-            per_device_bytes=pbytes * 4.0 / 2 * 1.01)
+            per_device_bytes=pbytes * 4.0 / 2 * 1.01, allow_sh=False)
         assert mesh.jax_mesh.shape["pp"] >= 2
         assert mesh.jax_mesh.shape["mp"] == 1 and ann == {}
         chosen = next(c for c in cands
@@ -911,10 +921,13 @@ def test_planner_pp_plan_executes_via_hybrid_trainer():
     pbytes = sum(int(np.prod(p.shape)) * 4
                  for _, p in model.named_parameters())
     sds = jax.ShapeDtypeStruct((2, 16), np.int32)
+    # allow_sh=False: with ZeRO in the search space a memory-bound plan
+    # correctly prefers sh over pp (no bubble) — this test exercises the
+    # pp EXECUTION path, so restrict the planner to dp×mp×pp
     mesh, ann, cands = auto.choose_strategy(
         model, batch_tokens=64, n_devices=8,
         per_device_bytes=pbytes * 4.0 / 2 * 1.01,
-        example_inputs=[sds])
+        example_inputs=[sds], allow_sh=False)
     dims = dict(zip(mesh.dim_names, mesh.shape))
     assert dims["pp"] >= 2 and dims["mp"] == 1 and ann == {}, dims
 
@@ -955,6 +968,122 @@ def test_engine_plan_auto_semi_automatic():
     with pytest.raises(Exception, match="plan"):
         auto.Engine(_Mlp(), nn.functional.cross_entropy, optimizer.SGD(0.1),
                     plan="semi")
+
+
+class TestPlannerShAxis:
+    """choose_strategy's sh (ZeRO) axis + recompute (VERDICT r4 #5):
+    memory relief no longer has pp as its only lever."""
+
+    def _model(self):
+        pt.seed(0)
+        return _Mlp(d=64, h=128)
+
+    def test_zero2_fits_gets_sh_not_pp(self):
+        """A model that fits under ZeRO-2 but not plain dp (or any dp×mp
+        — odd dims shard nothing, no repeated blocks so pp is capped at
+        1) must get an sh plan: memory relief the 3-axis search could
+        not provide at all. Budget sits between the sh1 and sh2 memory
+        lines, so the planner must actually reach for stage 2."""
+        pt.seed(0)
+        m = _Mlp(d=15, h=33)  # odd dims: mp shards nothing; max_pp == 1
+        cands0 = auto.estimate_plan_cost(
+            m, auto.ProcessMesh(shape=(8, 1, 1),
+                                dim_names=("dp", "mp", "pp")), {},
+            batch_tokens=64)
+        sh1 = auto.estimate_plan_cost(
+            m, auto.ProcessMesh(shape=(8, 1, 1),
+                                dim_names=("dp", "mp", "pp")), {},
+            batch_tokens=64, sh=1)
+        sh2 = auto.estimate_plan_cost(
+            m, auto.ProcessMesh(shape=(8, 1, 1),
+                                dim_names=("dp", "mp", "pp")), {},
+            batch_tokens=64, sh=2)
+        budget = (sh1["per_device_state_bytes"]
+                  + sh2["per_device_state_bytes"]) / 2
+        assert budget < cands0["per_device_state_bytes"]
+        mesh, ann, cands = auto.choose_strategy(
+            m, batch_tokens=64, n_devices=8, per_device_bytes=budget)
+        best = next(c for c in cands if c.get("chosen"))
+        dims = dict(zip(mesh.dim_names, mesh.shape))
+        assert dims["pp"] == 1 and dims["mp"] == 1, dims
+        assert best["sh"] == 2 and best["fits"], best
+        assert not best["recompute"]  # stage relief suffices; no extra fwd
+
+    def test_sh_memory_ladder(self):
+        """Each ZeRO stage monotonically reduces per-device state, and
+        stage 3 charges the extra param all-gather."""
+        m = self._model()
+        mesh = auto.ProcessMesh(shape=(8, 1, 1), dim_names=("dp", "mp", "pp"))
+        costs = [auto.estimate_plan_cost(m, mesh, {}, batch_tokens=256,
+                                         sh=s) for s in (0, 1, 2, 3)]
+        mems = [c["per_device_state_bytes"] for c in costs]
+        assert mems[0] > mems[1] > mems[2] > mems[3]
+        assert costs[3]["sh_extra_s"] > 0
+        assert costs[0]["sh_extra_s"] == 0
+        assert costs[2]["total_s"] == costs[0]["total_s"]  # rs+ag ≡ ring
+
+    def test_recompute_trades_memory_for_compute(self):
+        m = self._model()
+        mesh = auto.ProcessMesh(shape=(8, 1, 1), dim_names=("dp", "mp", "pp"))
+        base = auto.estimate_plan_cost(m, mesh, {}, batch_tokens=65536)
+        rc = auto.estimate_plan_cost(m, mesh, {}, batch_tokens=65536,
+                                     recompute=True)
+        assert rc["activation_bytes"] < base["activation_bytes"]
+        assert rc["recompute_s"] > 0 and rc["total_s"] > base["total_s"]
+        assert base["recompute_s"] == 0
+
+    def test_sh_noop_on_single_dp(self):
+        m = self._model()
+        mesh = auto.ProcessMesh(shape=(1, 1, 1), dim_names=("dp", "mp", "pp"))
+        c = auto.estimate_plan_cost(m, mesh, {}, batch_tokens=64, sh=3)
+        assert c["sh"] == 0  # ZeRO over a 1-wide dp axis is a no-op
+
+    def test_tie_break_prefers_least_mechanism(self):
+        """With a roomy budget every stage fits at equal comm cost —
+        the chosen plan must be sh=0, recompute=False."""
+        m = self._model()
+        _, _, cands = auto.choose_strategy(
+            m, batch_tokens=64, n_devices=8, per_device_bytes=1e12)
+        best = next(c for c in cands if c.get("chosen"))
+        assert best["sh"] == 0 and best["recompute"] is False
+
+
+@pytest.mark.slow
+def test_planner_sh_pp_plan_executes_via_hybrid_trainer():
+    """Execute a pp>1 plan WITH a ZeRO group on the 8-device mesh
+    (hybrid trainer's sh axis) and check loss parity vs the same model
+    trained on one device — the planner→executor bridge at a non-toy
+    factorization (VERDICT r4 #5 'drive one pp>1 plan end-to-end')."""
+    from paddle_tpu.models.ernie import ErnieConfig
+
+    cfg = ErnieConfig(vocab_size=64, hidden_size=32, num_heads=4,
+                      ffn_size=64, num_layers=2, max_seq_len=16,
+                      dropout=0.0)
+    mesh = auto.ProcessMesh(shape=(4, 1, 2), dim_names=("dp", "mp", "pp"))
+    pt.seed(0)
+    # sh=2 is a group WIDTH (2 of the 4 dp ranks form the ZeRO slot
+    # group) — a stage-1 execution at half width; see the fn docstring
+    trainer = auto.hybrid_trainer_from_plan(cfg, mesh, optimizer.SGD(0.1),
+                                            num_micro=2, sh=2)
+    assert "sh" in trainer.mesh.shape and trainer.mesh.shape["sh"] == 2
+
+    rng = np.random.default_rng(0)
+    # batch divides num_micro × (dp_inner × sh): 2 micros × 4 = 8
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(8, 16)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(8, 16)),
+                         jnp.int32)
+
+    # single-device oracle: serial Ernie assembled from the trainer's
+    # OWN params (init order differs between the staged and serial
+    # constructions) — the test_hybrid parity pattern
+    from test_hybrid import _serial_loss_from_trainer
+
+    serial = _serial_loss_from_trainer(trainer, trainer.cfg, ids, labels)
+    first = float(trainer.train_step(ids, labels))
+    np.testing.assert_allclose(first, serial, rtol=1e-4)
+    losses = [first] + [float(trainer.train_step(ids, labels))
+                        for _ in range(3)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
 
 
 def test_dp_axis_shard_charges_no_mp_cost():
