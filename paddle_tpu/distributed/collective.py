@@ -57,7 +57,15 @@ __all__ = [
 class _StoreState:
     def __init__(self) -> None:
         self.kv: Dict[str, str] = {}
+        # lease expiry per key, on the MASTER's monotonic clock (one
+        # clock for the whole cluster — client wall clocks don't enter,
+        # so skewed hosts can't fake-expire a live member's lease)
+        self.expire: Dict[str, float] = {}
         self.cond = threading.Condition()
+
+    def alive(self, key: str) -> bool:
+        exp = self.expire.get(key)
+        return exp is None or time.monotonic() <= exp
 
 
 class _StoreHandler(socketserver.StreamRequestHandler):
@@ -72,10 +80,19 @@ class _StoreHandler(socketserver.StreamRequestHandler):
             with state.cond:
                 if cmd == "set":
                     state.kv[req["key"]] = req["value"]
+                    # ttl (seconds) starts a lease on the MASTER clock;
+                    # absent/0 = permanent (etcd put-with-lease role)
+                    ttl = float(req.get("ttl") or 0.0)
+                    if ttl > 0:
+                        state.expire[req["key"]] = time.monotonic() + ttl
+                    else:
+                        state.expire.pop(req["key"], None)
                     state.cond.notify_all()
                     resp = {"ok": True}
                 elif cmd == "get":
-                    resp = {"ok": True, "value": state.kv.get(req["key"])}
+                    k = req["key"]
+                    v = state.kv.get(k) if state.alive(k) else None
+                    resp = {"ok": True, "value": v}
                 elif cmd == "add":
                     cur = int(state.kv.get(req["key"], "0")) + int(req["delta"])
                     state.kv[req["key"]] = str(cur)
@@ -94,6 +111,15 @@ class _StoreHandler(socketserver.StreamRequestHandler):
                     resp = {"ok": ok}
                 elif cmd == "delete":
                     resp = {"ok": state.kv.pop(req["key"], None) is not None}
+                elif cmd == "list":
+                    # prefix enumeration (etcd get-prefix role) — the
+                    # elastic membership scan rides this; expired leases
+                    # are invisible (master-clock expiry)
+                    pfx = req.get("prefix", "")
+                    resp = {"ok": True,
+                            "items": {k: v for k, v in state.kv.items()
+                                      if k.startswith(pfx)
+                                      and state.alive(k)}}
                 else:
                     resp = {"ok": False, "error": f"unknown cmd {cmd}"}
             self.wfile.write((json.dumps(resp) + "\n").encode())
@@ -134,8 +160,13 @@ class TCPStore:
             raise PreconditionNotMetError("TCPStore connection closed")
         return json.loads(line)
 
-    def set(self, key: str, value: str) -> None:
-        self._rpc(cmd="set", key=key, value=value)
+    def set(self, key: str, value: str, ttl: float = 0.0) -> None:
+        """``ttl`` > 0 starts a lease on the MASTER's monotonic clock
+        (the key expires from get/list ttl seconds after the master
+        receives this set — client clocks never enter, so cross-host
+        skew cannot fake-expire a live lease or immortalize a dead
+        one)."""
+        self._rpc(cmd="set", key=key, value=value, ttl=float(ttl))
 
     def get(self, key: str) -> Optional[str]:
         return self._rpc(cmd="get", key=key)["value"]
@@ -151,6 +182,10 @@ class TCPStore:
 
     def delete(self, key: str) -> bool:
         return self._rpc(cmd="delete", key=key)["ok"]
+
+    def list(self, prefix: str = "") -> Dict[str, str]:
+        """All keys under a prefix (etcd get-prefix role)."""
+        return dict(self._rpc(cmd="list", prefix=prefix)["items"])
 
     def barrier(self, name: str, world_size: int,
                 timeout: Optional[float] = None) -> None:

@@ -24,7 +24,8 @@ import time
 import urllib.parse
 from typing import Callable, Dict, List, Optional
 
-__all__ = ["ElasticStatus", "ElasticManager", "MemoryStore", "FileStore"]
+__all__ = ["ElasticStatus", "ElasticManager", "MemoryStore", "FileStore",
+           "TcpElasticStore", "store_from_spec"]
 
 
 class ElasticStatus(enum.Enum):   # manager.py:53
@@ -106,6 +107,61 @@ class FileStore:
             os.remove(self._path(key))
         except OSError:
             pass
+
+
+class TcpElasticStore:
+    """The elastic store over the cluster-wide :class:`TCPStore`
+    (``distributed/collective.py``) — the CROSS-HOST membership backend
+    the reference gets from etcd leases (manager.py:250
+    lease_heartbeat): every node heartbeats ``put(key, host, ttl)`` and
+    the lease expires on the MASTER's monotonic clock (TCPStore
+    ``set(ttl=)``), so skewed node wall clocks can neither fake-expire
+    a live member nor immortalize a dead one — the single-clock
+    property etcd leases provide. Construct one per node over the same
+    (host, port) — rank 0 (or the launcher master) passes
+    ``is_master=True`` exactly as the collective bootstrap does."""
+
+    def __init__(self, tcp_store=None, host: str = "127.0.0.1",
+                 port: int = 0, is_master: bool = False) -> None:
+        if tcp_store is None:
+            from .collective import TCPStore
+
+            tcp_store = TCPStore(host=host, port=port, is_master=is_master)
+        self.store = tcp_store
+        self.host, self.port = self.store.host, self.store.port
+
+    def put(self, key: str, value: str, ttl: float = 0.0) -> None:
+        self.store.set(key, value, ttl=ttl)
+
+    def get(self, key: str) -> Optional[str]:
+        return self.store.get(key)
+
+    def list_prefix(self, prefix: str) -> Dict[str, str]:
+        return self.store.list(prefix)
+
+    def delete(self, key: str) -> None:
+        self.store.delete(key)
+
+    def close(self) -> None:
+        self.store.close()
+
+
+def store_from_spec(spec: str):
+    """Construct an elastic store from a launcher-style spec string —
+    how worker processes receive their membership backend (the
+    reference passes an etcd endpoint the same way): ``file:<dir>``,
+    ``tcp:<host>:<port>`` (client of a running TCPStore master), or
+    ``memory:`` (single-process tests)."""
+    kind, _, rest = spec.partition(":")
+    if kind == "file":
+        return FileStore(rest)
+    if kind == "tcp":
+        host, _, port = rest.rpartition(":")
+        return TcpElasticStore(host=host or "127.0.0.1", port=int(port))
+    if kind == "memory":
+        return MemoryStore()
+    raise ValueError(f"unknown elastic store spec {spec!r} "
+                     f"(file:<dir> | tcp:<host>:<port> | memory:)")
 
 
 class ElasticManager:
