@@ -1,16 +1,22 @@
-"""Multi-HOST routed-vs-gathered serving TIMING (VERDICT r3 #9): two
-localhost jax.distributed processes × 4 virtual CPU devices form one
-global 8-device "ps" mesh; both routing formulations run the full
-pull+push serving step with the inter-host hop crossing the process
-boundary — the DCN regime, where the routed path's O(batch/K) wire
-volume matters most (HeterComm multi-node push, heter_comm_inl.h:686).
+"""Multi-HOST routed-vs-gathered serving TIMING (VERDICT r3 #9 sparse;
+r4 #8 dense + K sweep): two localhost jax.distributed processes × N
+virtual CPU devices form one global "ps" mesh; both routing
+formulations run the full pull+push serving step with the inter-host
+hop crossing the process boundary — the DCN regime, where the routed
+path's O(batch/K) wire volume matters most (HeterComm multi-node push,
+heter_comm_inl.h:686).
 
 test_multiprocess_sharded_cache pins CORRECTNESS of this exact setup;
-this tool records the TIMING artifact (ROUTED_MULTIHOST.json).
-Localhost loopback is not a real DCN, but the per-shard work and wire
-volume ratios the architecture changes are measured, not modeled.
+this tool records the TIMING artifacts:
+- ROUTED_MULTIHOST.json        (push_mode=sparse, K=8 — the r3 run)
+- ROUTED_MULTIHOST_DENSE.json  (push_mode=dense — the TPU default —
+  over K ∈ {2,4,8}; decides whether the dense path should ever route
+  the push side over DCN)
 
-Env: RM_BATCH (4096), RM_DIM (8), RM_CAP (262144), RM_STEPS (10).
+Localhost loopback is NOT a real DCN — label every citation loopback.
+
+Env: RM_BATCH (4096), RM_DIM (8), RM_CAP (262144), RM_STEPS (10),
+RM_MODE (dense|sparse, default dense), RM_KS ("2,4,8"), RM_OUT.
 """
 
 import json
@@ -30,8 +36,11 @@ _WORKER = textwrap.dedent("""
 
     rank = int(sys.argv[1]); world = int(sys.argv[2]); port = sys.argv[3]
     out_path = sys.argv[4]
+    devs_per_proc = int(sys.argv[5])
+    push_mode = sys.argv[6]
     os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devs_per_proc}")
     os.environ["RANK"] = str(rank)
     os.environ["WORLD_SIZE"] = str(world)
     os.environ["PADDLE_MASTER"] = f"127.0.0.1:{port}"
@@ -71,7 +80,7 @@ _WORKER = textwrap.dedent("""
     shows = np.ones(B, np.float32)
     clicks = (rng.random(B) < 0.4).astype(np.float32)
     cfg = CacheConfig(capacity=Cap, embedx_dim=dim, embedx_threshold=1.0,
-                      push_mode="sparse")
+                      push_mode=push_mode)
 
     mesh = Mesh(np.array(jax.devices()), ("ps",))
 
@@ -114,9 +123,9 @@ _WORKER = textwrap.dedent("""
 
     if rank == 0:
         out = {
-            "hosts": world, "devices": world * 4, "batch": B, "dim": dim,
-            "capacity": Cap, "steps": steps, "push_mode": "sparse",
-            "ms_per_step": result,
+            "hosts": world, "devices": world * devs_per_proc, "batch": B,
+            "dim": dim, "capacity": Cap, "steps": steps,
+            "push_mode": push_mode, "ms_per_step": result,
             "routed_vs_gathered": round(
                 result["alltoall"] / result["allgather"], 3),
         }
@@ -127,13 +136,11 @@ _WORKER = textwrap.dedent("""
 """)
 
 
-def main() -> None:
+def _run_once(devs_per_proc: int, push_mode: str, tmp_out: str) -> dict:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
-    out_path = os.environ.get("RM_OUT") or os.path.join(
-        _REPO, "ROUTED_MULTIHOST.json")
     with tempfile.TemporaryDirectory() as td:
         script = os.path.join(td, "worker.py")
         with open(script, "w") as f:
@@ -146,7 +153,8 @@ def main() -> None:
             env.pop("XLA_FLAGS", None)
             env.pop("JAX_PLATFORMS", None)
             procs.append(subprocess.Popen(
-                [sys.executable, script, str(r), "2", str(port), out_path],
+                [sys.executable, script, str(r), "2", str(port), tmp_out,
+                 str(devs_per_proc), push_mode],
                 env=env, stdout=subprocess.PIPE,
                 stderr=subprocess.STDOUT, text=True))
         try:
@@ -158,6 +166,41 @@ def main() -> None:
             for p in procs:
                 if p.poll() is None:
                     p.kill()
+    with open(tmp_out) as f:
+        return json.load(f)
+
+
+def main() -> None:
+    mode = os.environ.get("RM_MODE", "dense")
+    if mode == "sparse":
+        # the r3 artifact shape: one K=8 run, its own file
+        out_path = os.environ.get("RM_OUT") or os.path.join(
+            _REPO, "ROUTED_MULTIHOST.json")
+        res = _run_once(4, "sparse", out_path)
+        print(json.dumps(res))
+        print("ok")
+        return
+    ks = [int(k) for k in os.environ.get("RM_KS", "2,4,8").split(",")]
+    out_path = os.environ.get("RM_OUT") or os.path.join(
+        _REPO, "ROUTED_MULTIHOST_DENSE.json")
+    runs = {}
+    with tempfile.TemporaryDirectory() as td:
+        for k in ks:
+            assert k % 2 == 0, "K must split over the 2 host processes"
+            tmp = os.path.join(td, f"k{k}.json")
+            runs[str(k)] = _run_once(k // 2, "dense", tmp)
+    out = {
+        "push_mode": "dense",
+        "transport": "loopback TCP (2 jax.distributed procs, one host) — "
+                     "NOT a real DCN; ratios not absolute times are the "
+                     "evidence",
+        "runs_by_K": runs,
+        "routed_vs_gathered_by_K": {
+            k: v["routed_vs_gathered"] for k, v in runs.items()},
+    }
+    with open(out_path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
     print("ok")
 
 
