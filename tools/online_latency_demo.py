@@ -142,9 +142,11 @@ def main() -> None:
                                        drop_last=False)
             t_trained = time.perf_counter()   # incl. async queue drain
 
-            cache = HbmEmbeddingCache(table, CacheConfig(
-                capacity=cap, embedx_dim=dim, embedx_threshold=0.0,
-                device_map=True))
+            cache = HbmEmbeddingCache(
+                table,
+                CacheConfig(capacity=cap, embedx_dim=dim,
+                            embedx_threshold=0.0),
+                device_map=True)
             cache.begin_pass(serve_keys)      # read-only: no end_pass
             t_refreshed = time.perf_counter()
             export_ctr_inference(export_dir, model, cache, slot_hi, D,
