@@ -634,7 +634,7 @@ def choose_strategy(model, batch_tokens: int,
                     microbatches: int = 8,
                     example_inputs: Optional[Sequence[Any]] = None,
                     allow_pp: bool = True,
-                    allow_sh: bool = True,
+                    allow_sh=True,  # bool, or int = max ZeRO stage
                     ) -> Tuple[ProcessMesh,
                                Dict[str, Sequence[Optional[int]]],
                                List[Dict[str, float]]]:
@@ -715,8 +715,14 @@ def choose_strategy(model, batch_tokens: int,
                     # relief without the pp bubble. Enumeration order
                     # (sh ↑, recompute last) is the tie-break: at equal
                     # cost the LEAST mechanism wins.
-                    sh_stages = (0, 1, 2, 3) if (dp > 1 and allow_sh) \
-                        else (0,)
+                    # allow_sh: True = all stages, False/0 = none, an
+                    # int caps the stage (Engine passes 1 — the stage
+                    # its GSPMD executor delivers)
+                    if dp > 1 and allow_sh:
+                        max_stage = 3 if allow_sh is True else int(allow_sh)
+                        sh_stages = tuple(range(0, max_stage + 1))
+                    else:
+                        sh_stages = (0,)
                     for sh in sh_stages:
                         for rc in (False, True):
                             cost = estimate_plan_cost(
@@ -792,6 +798,20 @@ def hybrid_trainer_from_plan(cfg, process_mesh: ProcessMesh, optimizer,
                                  num_micro=num_micro, seed=seed)
 
 
+def _insert_axis_spec(spec: PartitionSpec, shape: Sequence[int],
+                      axis: str, size: int) -> PartitionSpec:
+    """Add ``axis`` to a PartitionSpec on the first FREE dim divisible
+    by ``size``; unchanged when no dim qualifies (the tensor stays at
+    its parameter layout — same fallback as the hybrid trainer's sh
+    insertion)."""
+    t = tuple(spec) if spec is not None else ()
+    t = t + (None,) * (len(shape) - len(t))
+    for i, (ax, d) in enumerate(zip(t, shape)):
+        if ax is None and d and d % size == 0:
+            return PartitionSpec(*t[:i], axis, *t[i + 1:])
+    return spec
+
+
 def reshard(x, process_mesh: ProcessMesh,
             dims_mapping: Sequence[Optional[int]]):
     """The Resharder (reference ``auto_parallel/reshard.py``): move a
@@ -823,6 +843,7 @@ class Engine:
                  plan: Optional[str] = None,
                  batch_tokens: int = 4096,
                  per_device_bytes: float = 16e9,
+                 sharding_stage: int = 0,
                  ) -> None:
         self.model = model
         self.loss_fn = loss_fn
@@ -830,6 +851,18 @@ class Engine:
         # example_inputs (arrays or ShapeDtypeStructs): enables traced
         # graph-aware completion (branching models — see completion.py)
         self.example_inputs = example_inputs
+        # stage-1 ZeRO (optimizer-state sharding over dp): slots persist
+        # device-sharded between steps; the elementwise update computes
+        # shard-locally and GSPMD all-gathers params for the forward —
+        # sharding_optimizer.py stage-1 semantics executed by placement.
+        # Stages 2-3 (grad/param sharding) need the explicit shard_map
+        # formulation — parallel/spmd.py / parallel/sharding.py — and
+        # are rejected here loudly.
+        enforce(sharding_stage in (0, 1),
+                f"Engine executes sharding stage 0 or 1; stage "
+                f"{sharding_stage} (grad/param sharding) runs through "
+                f"parallel.spmd / parallel.sharding", InvalidArgumentError)
+        self.sharding_stage = int(sharding_stage)
         if plan == "auto":
             # the reference Engine's semi-auto mode: the cost-model
             # planner picks the (dp, mp) factorization AND the hints
@@ -838,15 +871,16 @@ class Engine:
             enforce(process_mesh is None and not annotations,
                     "plan='auto' derives mesh and annotations — don't "
                     "also pass them", InvalidArgumentError)
-            # pp and sh excluded: Engine executes GSPMD dp/mp plans —
-            # pp plans run via hybrid_trainer_from_plan, sh via the
-            # hybrid trainer's ZeRO axis / parallel.sharding
-            process_mesh, planned_ann, _ = choose_strategy(
+            # pp excluded (pipeline trainer executes those); sh capped
+            # at stage 1 — the stage Engine can actually deliver
+            process_mesh, planned_ann, cands = choose_strategy(
                 model, batch_tokens=batch_tokens,
                 per_device_bytes=per_device_bytes,
                 example_inputs=example_inputs, allow_pp=False,
-                allow_sh=False)
+                allow_sh=1)
             annotations = planned_ann
+            chosen = next(c for c in cands if c.get("chosen"))
+            self.sharding_stage = int(chosen["sh"])
             batch_dim_mesh_axis = batch_dim_mesh_axis or "dp"
         else:
             enforce(plan is None,
@@ -881,25 +915,44 @@ class Engine:
             return tree
 
         state, opt_state = plain(state), plain(opt_state)
-        if not self.param_specs:
+        stage1 = (self.sharding_stage >= 1
+                  and dict(zip(self.process_mesh.dim_names,
+                               self.process_mesh.shape)
+                           ).get(self.batch_axis, 1) > 1)
+        if not self.param_specs and not stage1:
             return (jax.device_put(state, repl),
                     jax.device_put(opt_state, repl))
+
+        def pspec(name):
+            return (self.param_specs or {}).get(name, PartitionSpec())
+
         # device_put shards numpy/host arrays directly — no jnp.asarray,
         # which would materialize the FULL array on one device first
         placed = {
-            name: jax.device_put(
-                arr, NamedSharding(mesh, self.param_specs.get(
-                    name, PartitionSpec())))
+            name: jax.device_put(arr, NamedSharding(mesh, pspec(name)))
             for name, arr in state["params"].items()
         }
         from ..optimizer import map_param_slots
 
-        # optimizer slots mirror the params dict → same layouts
+        # optimizer slots mirror the params dict → same layouts; under
+        # stage-1 ZeRO each slot additionally shards over the dp axis
+        # on its first free divisible dim (sharding_optimizer.py's
+        # param→rank assignment expressed as placement; the elementwise
+        # update computes shard-locally, GSPMD gathers params for fwd)
+        def slot_spec(name):
+            base = pspec(name)
+            if not stage1:
+                return base
+            return _insert_axis_spec(base, state["params"][name].shape,
+                                     self.batch_axis,
+                                     dict(zip(self.process_mesh.dim_names,
+                                              self.process_mesh.shape))
+                                     [self.batch_axis])
+
         slot_sh = map_param_slots(
             opt_state["slots"], state["params"],
             mirror_fn=lambda sub: type(sub)(
-                (n, NamedSharding(mesh, self.param_specs.get(
-                    n, PartitionSpec()))) for n in sub),
+                (n, NamedSharding(mesh, slot_spec(n))) for n in sub),
             other_leaf_fn=lambda _: repl)
         opt_state = jax.tree_util.tree_map(
             jax.device_put, opt_state, {"step": repl, "slots": slot_sh})
@@ -948,10 +1001,30 @@ class Engine:
             (_, (loss, new_buffers)), grads = jax.value_and_grad(
                 compute_loss, has_aux=True)(state["params"])
             new_params, new_opt = optimizer.update(grads, opt_state, state["params"])
-            return {"params": new_params, "buffers": new_buffers}, new_opt, loss
+
+            def plain(tree):  # functional_call returns OrderedDicts;
+                # the carried state (and out_shardings pytree) is plain
+                if isinstance(tree, dict):
+                    return {k: plain(v) for k, v in tree.items()}
+                return tree
+
+            return ({"params": new_params, "buffers": plain(new_buffers)},
+                    new_opt, loss)
 
         self._batch_sh = batch_sh
-        self._step = jax.jit(step, donate_argnums=(0, 1))
+        if self.sharding_stage >= 1:
+            # pin the carried-state output shardings to the placements:
+            # without this the compiler may gather the slots once and
+            # keep them replicated, silently un-doing stage 1 after the
+            # first step
+            sharding_of = lambda t: jax.tree_util.tree_map(
+                lambda a: a.sharding, t)
+            self._step = jax.jit(
+                step, donate_argnums=(0, 1),
+                out_shardings=(sharding_of(self._state),
+                               sharding_of(self._opt_state), None))
+        else:
+            self._step = jax.jit(step, donate_argnums=(0, 1))
 
         def fwd(state, inputs):
             out, _ = nn.functional_call(model, state, *inputs, training=False)
