@@ -153,30 +153,48 @@ constexpr uint64_t kMaxPayload = 1ULL << 32;  // 4 GiB frame cap
 // RAM-engine shard-file save/load (kSaveFile/kLoadFile for mem tables;
 // the SSD engine has streaming equivalents in ssd_table.cc). The mem
 // snapshot is RAM-bounded by construction, so staging it is fine.
+// Format selector matches sst_save_file: 0 text, 1 gzip text, 2 raw
+// binary ([u32 magic,u32 ver,u32 fdim,u32 rsvd] + [u64 key][f32 row]).
+constexpr uint32_t kMemBinMagic = 0x42535450u;  // 'PTSB'
+
 int64_t mem_save_file(NativeTable* t, const char* path, int32_t mode,
-                      int32_t use_gzip) {
+                      int32_t fmt) {
   int32_t fdim = table_full_dim(t);
   int32_t ed = pstpu::rule_state_dim(t->cfg.embed_rule, 1);
   std::lock_guard<std::mutex> sg(t->save_mu);
   int64_t n = pstpu::table_save_snapshot_locked(t, mode);
+  bool binary = fmt == 2;
   gzFile gz = nullptr;
   FILE* fp = nullptr;
-  if (use_gzip ? !(gz = gzopen(path, "wb")) : !(fp = std::fopen(path, "w"))) {
+  if (fmt == 1 ? !(gz = gzopen(path, "wb1"))
+               : !(fp = std::fopen(path, binary ? "wb" : "w"))) {
     t->save_keys.clear();
     t->save_values.clear();
     return -1;
   }
-  std::vector<char> line(64 + 24 * static_cast<size_t>(fdim));
   bool ok = true;
-  for (int64_t i = 0; ok && i < n; ++i) {
-    int len = pstpu::format_text_row(line.data(), line.size(),
-                                     t->save_keys[i],
-                                     t->save_values.data() + i * fdim,
-                                     fdim, ed);
-    ok = use_gzip ? gzwrite(gz, line.data(), len) == len
-                  : std::fwrite(line.data(), 1, len, fp) == (size_t)len;
+  if (binary) {
+    uint32_t hdr[4] = {kMemBinMagic, 1u, static_cast<uint32_t>(fdim), 0u};
+    ok = std::fwrite(hdr, 1, sizeof(hdr), fp) == sizeof(hdr);
   }
-  if (use_gzip ? gzclose(gz) != Z_OK : std::fclose(fp) != 0) ok = false;
+  std::vector<char> line(64 + 24 * static_cast<size_t>(fdim));
+  size_t rec = 8 + 4 * static_cast<size_t>(fdim);
+  for (int64_t i = 0; ok && i < n; ++i) {
+    if (binary) {
+      std::memcpy(line.data(), &t->save_keys[i], 8);
+      std::memcpy(line.data() + 8, t->save_values.data() + i * fdim,
+                  4 * static_cast<size_t>(fdim));
+      ok = std::fwrite(line.data(), 1, rec, fp) == rec;
+    } else {
+      int len = pstpu::format_text_row(line.data(), line.size(),
+                                       t->save_keys[i],
+                                       t->save_values.data() + i * fdim,
+                                       fdim, ed);
+      ok = gz ? gzwrite(gz, line.data(), len) == len
+              : std::fwrite(line.data(), 1, (size_t)len, fp) == (size_t)len;
+    }
+  }
+  if (gz ? gzclose(gz) != Z_OK : std::fclose(fp) != 0) ok = false;
   t->save_keys.clear();
   t->save_values.clear();
   if (!ok) {
@@ -186,12 +204,43 @@ int64_t mem_save_file(NativeTable* t, const char* path, int32_t mode,
   return n;
 }
 
-int64_t mem_load_file(NativeTable* t, const char* path, int32_t use_gzip) {
+int64_t mem_load_file(NativeTable* t, const char* path, int32_t fmt) {
   int32_t fdim = table_full_dim(t);
   int32_t ed = pstpu::rule_state_dim(t->cfg.embed_rule, 1);
+  if (fmt == 2) {
+    FILE* bf = std::fopen(path, "rb");
+    if (!bf) return -1;
+    uint32_t hdr[4];
+    if (std::fread(hdr, 1, sizeof(hdr), bf) != sizeof(hdr) ||
+        hdr[0] != kMemBinMagic || hdr[1] != 1u ||
+        hdr[2] != static_cast<uint32_t>(fdim)) {
+      std::fclose(bf);
+      return -1;
+    }
+    const int64_t kBatch = 1 << 19;
+    size_t rec = 8 + 4 * static_cast<size_t>(fdim);
+    std::vector<uint8_t> buf(static_cast<size_t>(kBatch) * rec);
+    std::vector<uint64_t> keys(kBatch);
+    std::vector<float> vals(static_cast<size_t>(kBatch) * fdim);
+    int64_t loaded = 0;
+    while (true) {
+      size_t got = std::fread(buf.data(), rec, kBatch, bf);
+      if (!got) break;
+      for (size_t j = 0; j < got; ++j) {
+        std::memcpy(&keys[j], buf.data() + j * rec, 8);
+        std::memcpy(vals.data() + j * fdim, buf.data() + j * rec + 8,
+                    4 * static_cast<size_t>(fdim));
+      }
+      pstpu::table_insert_full(t, keys.data(), vals.data(),
+                               static_cast<int64_t>(got));
+      loaded += static_cast<int64_t>(got);
+    }
+    std::fclose(bf);
+    return loaded;
+  }
   gzFile gz = nullptr;
   FILE* fp = nullptr;
-  if (use_gzip ? !(gz = gzopen(path, "rb")) : !(fp = std::fopen(path, "r")))
+  if (fmt == 1 ? !(gz = gzopen(path, "rb")) : !(fp = std::fopen(path, "r")))
     return -1;
   const int64_t kBatch = 1 << 19;
   std::vector<uint64_t> keys;
@@ -208,8 +257,8 @@ int64_t mem_load_file(NativeTable* t, const char* path, int32_t use_gzip) {
     vals.clear();
   };
   while (true) {
-    char* got = use_gzip ? gzgets(gz, line.data(), (int)line.size())
-                         : std::fgets(line.data(), (int)line.size(), fp);
+    char* got = gz ? gzgets(gz, line.data(), (int)line.size())
+                   : std::fgets(line.data(), (int)line.size(), fp);
     if (!got) break;
     uint64_t key;
     if (!pstpu::parse_text_row(line.data(), &key, row.data(), fdim, ed,
@@ -220,7 +269,7 @@ int64_t mem_load_file(NativeTable* t, const char* path, int32_t use_gzip) {
     if (static_cast<int64_t>(keys.size()) >= kBatch) flush();
   }
   flush();
-  if (use_gzip) gzclose(gz); else std::fclose(fp);
+  if (gz) gzclose(gz); else std::fclose(fp);
   return loaded;
 }
 
@@ -698,20 +747,20 @@ struct PsServer {
         SparseRef t;
         if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
         if (!h.payload_len) return respond(fd, kErrBadSize, nullptr, 0);
-        int32_t mode = h.aux & 0xff, gz = (h.aux >> 8) & 1;
+        int32_t mode = h.aux & 0xff, fmt = (h.aux >> 8) & 0xff;
         std::string path(p, h.payload_len);
-        int64_t cnt = t.ssd ? sst_save_file(t.ssd, path.c_str(), mode, gz)
-                            : mem_save_file(t.mem, path.c_str(), mode, gz);
+        int64_t cnt = t.ssd ? sst_save_file(t.ssd, path.c_str(), mode, fmt)
+                            : mem_save_file(t.mem, path.c_str(), mode, fmt);
         return respond(fd, cnt < 0 ? kErrInternal : cnt, nullptr, 0);
       }
       case kLoadFile: {
         SparseRef t;
         if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
         if (!h.payload_len) return respond(fd, kErrBadSize, nullptr, 0);
-        int32_t gz = (h.aux >> 8) & 1;
+        int32_t fmt = (h.aux >> 8) & 0xff;
         std::string path(p, h.payload_len);
-        int64_t cnt = t.ssd ? sst_load_file(t.ssd, path.c_str(), gz)
-                            : mem_load_file(t.mem, path.c_str(), gz);
+        int64_t cnt = t.ssd ? sst_load_file(t.ssd, path.c_str(), fmt)
+                            : mem_load_file(t.mem, path.c_str(), fmt);
         return respond(fd, cnt < 0 ? kErrInternal : cnt, nullptr, 0);
       }
       case kCreateGraph: {

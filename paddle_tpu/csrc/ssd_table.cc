@@ -776,13 +776,23 @@ void sst_flush(void* h) {
   }
 }
 
-// Streaming checkpoint save straight to a shard file (text format of
-// sparse_table.h format_text_row, optionally gzip'd) — the save path
+// Streaming checkpoint save straight to a shard file — the save path
 // for populations whose snapshot cannot be materialized in RAM (the
 // begin/fetch protocol stages the WHOLE keep-set; at 1e9 rows that is
 // tens of GB). Same per-shard atomicity, filter and
 // update_stat_after_save semantics as sst_save_begin. Returns rows
 // written, or -1 on an IO error (partial file removed).
+//
+// format (the use_gzip arg doubles as a format selector):
+//   0 = plain text (sparse_table.h format_text_row)
+//   1 = gzip'd text (zlib level 1; portable, compact on low-entropy
+//       rows, but CPU-bound on zlib+printf at 1e9 rows)
+//   2 = RAW BINARY: header [u32 'PTSB', u32 version=1, u32 fdim,
+//       u32 reserved] then fixed records [u64 key][f32 full_row[fdim]]
+//       — runs at IO speed (no format/parse CPU), trading bytes for
+//       throughput on high-entropy rows; same filter semantics
+constexpr uint32_t kBinMagic = 0x42535450u;  // 'PTSB'
+
 int64_t sst_save_file(void* h, const char* path, int32_t mode,
                       int32_t use_gzip) {
   SsdTable* t = static_cast<SsdTable*>(h);
@@ -792,25 +802,44 @@ int64_t sst_save_file(void* h, const char* path, int32_t mode,
   int32_t ed = pstpu::rule_state_dim(c.embed_rule, 1);
   gzFile gz = nullptr;
   FILE* fp = nullptr;
-  if (use_gzip) {
+  bool binary = use_gzip == 2;
+  if (use_gzip == 1) {
     // level 1: the save is CPU-bound on zlib at 1e9 rows; fast-level
     // ratio on this low-entropy text is within ~25% of default-6
     gz = gzopen(path, "wb1");
     if (!gz) return -1;
   } else {
-    fp = std::fopen(path, "w");
+    fp = std::fopen(path, binary ? "wb" : "w");
     if (!fp) return -1;
+    if (binary) {
+      uint32_t hdr[4] = {kBinMagic, 1u, static_cast<uint32_t>(fd), 0u};
+      if (std::fwrite(hdr, 1, sizeof(hdr), fp) != sizeof(hdr)) {
+        std::fclose(fp);
+        std::remove(path);
+        return -1;
+      }
+    }
   }
   std::vector<char> line(64 + 24 * static_cast<size_t>(fd));
   int64_t written = 0;
   bool io_ok = true;
+  size_t rec = 8 + 4 * static_cast<size_t>(fd);
   auto emit = [&](uint64_t key, const float* v) {
-    int len = pstpu::format_text_row(line.data(), line.size(), key, v, fd, ed);
-    if (use_gzip ? gzwrite(gz, line.data(), len) != len
-                 : std::fwrite(line.data(), 1, len, fp) != (size_t)len)
-      io_ok = false;
-    else
+    bool ok;
+    if (binary) {
+      std::memcpy(line.data(), &key, 8);
+      std::memcpy(line.data() + 8, v, 4 * static_cast<size_t>(fd));
+      ok = std::fwrite(line.data(), 1, rec, fp) == rec;
+    } else {
+      int len = pstpu::format_text_row(line.data(), line.size(), key, v,
+                                       fd, ed);
+      ok = gz ? gzwrite(gz, line.data(), len) == len
+              : std::fwrite(line.data(), 1, (size_t)len, fp) == (size_t)len;
+    }
+    if (ok)
       ++written;
+    else
+      io_ok = false;
   };
   for (size_t s = 0; io_ok && s < t->mem->shards.size(); ++s) {
     Shard* sh = t->mem->shards[s];
@@ -851,7 +880,7 @@ int64_t sst_save_file(void* h, const char* path, int32_t mode,
     }
     maybe_compact(t, d);
   }
-  if (use_gzip ? gzclose(gz) != Z_OK : std::fclose(fp) != 0) io_ok = false;
+  if (gz ? gzclose(gz) != Z_OK : std::fclose(fp) != 0) io_ok = false;
   if (!io_ok) {
     std::remove(path);
     return -1;
@@ -859,18 +888,52 @@ int64_t sst_save_file(void* h, const char* path, int32_t mode,
   return written;
 }
 
-// Streaming load of a shard file (plain or gzip text) into the COLD
-// tier in bounded batches (the restart/reload path at populations that
-// must not stage in RAM). Returns rows loaded, or -(parsed+1) when the
-// underlying bulk load fell short (disk full).
+// Streaming load of a shard file (format per sst_save_file: 0 text,
+// 1 gzip text, 2 raw binary) into the COLD tier in bounded batches
+// (the restart/reload path at populations that must not stage in RAM).
+// Returns rows loaded, or -(parsed+1) when the underlying bulk load
+// fell short (disk full), or -1 on open/header errors.
 int64_t sst_load_file(void* h, const char* path, int32_t use_gzip) {
   SsdTable* t = static_cast<SsdTable*>(h);
   const TableNativeConfig& c = t->mem->cfg;
   int32_t fd = t->fdim;
   int32_t ed = pstpu::rule_state_dim(c.embed_rule, 1);
+  if (use_gzip == 2) {
+    FILE* bf = std::fopen(path, "rb");
+    if (!bf) return -1;
+    uint32_t hdr[4];
+    if (std::fread(hdr, 1, sizeof(hdr), bf) != sizeof(hdr) ||
+        hdr[0] != kBinMagic || hdr[1] != 1u ||
+        hdr[2] != static_cast<uint32_t>(fd)) {
+      std::fclose(bf);
+      return -1;  // wrong magic/version or fdim mismatch
+    }
+    const int64_t kBatch = 1 << 19;
+    size_t rec = 8 + 4 * static_cast<size_t>(fd);
+    std::vector<uint8_t> buf(static_cast<size_t>(kBatch) * rec);
+    std::vector<uint64_t> keys(kBatch);
+    std::vector<float> vals(static_cast<size_t>(kBatch) * fd);
+    int64_t loaded = 0;
+    bool short_load = false;
+    while (!short_load) {
+      size_t got = std::fread(buf.data(), rec, kBatch, bf);
+      if (!got) break;
+      for (size_t j = 0; j < got; ++j) {
+        std::memcpy(&keys[j], buf.data() + j * rec, 8);
+        std::memcpy(vals.data() + j * fd, buf.data() + j * rec + 8,
+                    4 * static_cast<size_t>(fd));
+      }
+      int64_t n = sst_load_cold(h, keys.data(), vals.data(),
+                                static_cast<int64_t>(got));
+      loaded += n;
+      if (n != static_cast<int64_t>(got)) short_load = true;
+    }
+    std::fclose(bf);
+    return short_load ? -(loaded + 1) : loaded;
+  }
   gzFile gz = nullptr;
   FILE* fp = nullptr;
-  if (use_gzip) {
+  if (use_gzip == 1) {
     gz = gzopen(path, "rb");
     if (!gz) return -1;
   } else {
@@ -896,8 +959,8 @@ int64_t sst_load_file(void* h, const char* path, int32_t use_gzip) {
     vals.clear();
   };
   while (!short_load) {
-    char* got = use_gzip ? gzgets(gz, line.data(), (int)line.size())
-                         : std::fgets(line.data(), (int)line.size(), fp);
+    char* got = gz ? gzgets(gz, line.data(), (int)line.size())
+                   : std::fgets(line.data(), (int)line.size(), fp);
     if (!got) break;
     uint64_t key;
     if (!pstpu::parse_text_row(line.data(), &key, row.data(), fd, ed,
@@ -908,7 +971,7 @@ int64_t sst_load_file(void* h, const char* path, int32_t use_gzip) {
     if (static_cast<int64_t>(keys.size()) >= kBatch) flush_batch();
   }
   if (!short_load) flush_batch();
-  if (use_gzip) gzclose(gz); else std::fclose(fp);
+  if (gz) gzclose(gz); else std::fclose(fp);
   return short_load ? -(loaded + 1) : loaded;
 }
 
