@@ -297,11 +297,19 @@ struct Shard {
 
   int32_t find(uint64_t key) const {
     uint64_t h = slot_of(key);
+    uint64_t probes = 0;
     while (true) {
       int32_t s = slot_state[h];
       if (s == kEmpty) return -1;
       if (s >= 0 && slot_keys[h] == key) return s;
       h = (h + 1) & mask;
+      if (++probes > mask + 1) {
+        std::fprintf(stderr, "Shard.find: full-table probe (cap=%llu "
+                             "used=%lld occupied=%lld)\n",
+                     (unsigned long long)(mask + 1), (long long)used,
+                     (long long)occupied);
+        std::abort();
+      }
     }
   }
 
@@ -348,7 +356,15 @@ struct Shard {
   int32_t lookup_or_insert(uint64_t key, int32_t slot) {
     uint64_t h = slot_of(key);
     int64_t first_tomb = -1;
+    uint64_t probes = 0;
     while (true) {
+      if (probes++ > mask + 1) {
+        std::fprintf(stderr, "Shard.lookup_or_insert: full-table probe "
+                             "(cap=%llu used=%lld occupied=%lld)\n",
+                     (unsigned long long)(mask + 1), (long long)used,
+                     (long long)occupied);
+        std::abort();
+      }
       int32_t s = slot_state[h];
       if (s == kEmpty) {
         uint64_t target = (first_tomb >= 0) ? static_cast<uint64_t>(first_tomb) : h;

@@ -19,7 +19,9 @@ chosen is recorded in the artifact ("largest that fits, stated").
 Emits one JSON line (committed as DIST_SCALE.json). Knobs:
 DIST_SERVERS (4), DIST_POP ("auto"), DIST_POP_CAP (1e9), DIST_DIM (4),
 DIST_PASSES (3), DIST_PASS_KEYS (400k), DIST_HOT_FRACTION (0.02),
-DIST_DIR (tmp), DIST_CHUNK (4M rows per load_cold wave).
+DIST_DIR (tmp), DIST_CHUNK (4M rows per load_cold wave),
+DIST_CONVERTER (gzip | raw — the committed artifact used gzip; raw is
+~6x faster at ~2x the bytes, see the save_local docstring).
 
 Single-core host caveat (MEASURED.md): run ALONE in the foreground;
 rates measured under concurrent load are garbage.
@@ -230,8 +232,9 @@ def main() -> None:
         assert cli.load_cold(0, keys, make_vals(keys), chunk=chunk) == probe_n
         probe_rate = probe_n / (time.perf_counter() - t0)
         t0 = time.perf_counter()
+        conv = os.environ.get("DIST_CONVERTER", "gzip")
         saved = cli.save_local(0, os.path.join(base, "probe_ckpt"), mode=0,
-                               converter="gzip")
+                               converter=conv)
         probe_save_rate = saved / (time.perf_counter() - t0)
         save_bytes_row = _du(os.path.join(base, "probe_ckpt")) / max(saved, 1)
         shutil.rmtree(os.path.join(base, "probe_ckpt"))
@@ -340,7 +343,7 @@ def main() -> None:
         # -- mode-0 save (server-side streaming, gzip) ----------------------
         ckpt = os.path.join(base, "ckpt")
         t0 = time.perf_counter()
-        saved = cli.save_local(0, ckpt, mode=0, converter="gzip")
+        saved = cli.save_local(0, ckpt, mode=0, converter=conv)
         save_s = time.perf_counter() - t0
         out["save"] = {"rows": int(saved), "seconds": round(save_s, 1),
                        "rows_per_s": round(saved / max(save_s, 1e-9)),
