@@ -622,6 +622,18 @@ class SsdSparseTable(MemorySparseTable):
         the population goes cold; training promotes what it touches)."""
         self._native.load_cold(keys, values)
 
+    def save_file(self, path: str, mode: int = 0, fmt: str = "gzip") -> int:
+        """STREAMING single-file save (native sst_save_file — the
+        RPC server-side save's local twin): nothing staged in RAM, so
+        beyond-RAM populations save without the snapshot protocol.
+        fmt: "text" | "gzip" | "raw" (fixed binary, ~6× faster)."""
+        return self._native.save_file(path, mode=mode, fmt=fmt)
+
+    def load_file(self, path: str, fmt: str = "gzip") -> int:
+        """Streaming load of a :meth:`save_file` file into the cold
+        tier."""
+        return self._native.load_file(path, fmt=fmt)
+
     def _load_rows(self, keys: np.ndarray, values: np.ndarray) -> None:
         # checkpoint load() lands in the disk tier — restoring a
         # larger-than-RAM population through the hot tier would defeat
