@@ -381,6 +381,16 @@ def select_routing(m_local: int, shard_rows: int, K: int,
     enforce(push_mode in ("dense", "sparse"),
             f"push_mode must be 'dense' or 'sparse', got {push_mode!r}")
     del m_local, shard_rows  # regime keys reserved for hw recalibration
+    # multi-PROCESS meshes route at every K: the cross-process sweeps
+    # (ROUTED_MULTIHOST_DENSE.json — routed/gathered 0.92x at K=2,
+    # 0.82x at K=4, 0.60x at K=8 dense; ROUTED_MULTIHOST.json 0.52x
+    # sparse K=8) show the gathered formulation's full-batch volume
+    # already loses once a process boundary is in the path, including
+    # the K=2 cell where the single-process grid preferred gathering
+    import jax
+
+    if jax.process_count() > 1:
+        return "alltoall", "alltoall"
     if K < 4:
         return "allgather", "allgather"
     return "alltoall", "alltoall"
