@@ -87,6 +87,28 @@ def save_inference_model(
         json.dump(manifest, f, indent=1)
 
 
+def refresh_inference_params(dirname: str, params: Any) -> None:
+    """Overwrite ONLY the params checkpoint of an existing unfrozen
+    export — the online-learning refresh path: between serving updates
+    only the values change (tables, dense params), so re-tracing and
+    re-serializing the StableHLO program (the dominant cost of a full
+    ``save_inference_model``, ~200 ms on the 10M-feature online loop)
+    is pure waste. The program file and manifest must already exist;
+    callers own shape compatibility (same capacities/dims as the
+    original export — the predictor will fail loudly otherwise)."""
+    manifest_path = os.path.join(dirname, "manifest.json")
+    enforce(os.path.exists(os.path.join(dirname, "model.stablehlo"))
+            and os.path.exists(manifest_path),
+            f"no existing export at {dirname} to refresh — call "
+            f"save_inference_model first", PreconditionNotMetError)
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    enforce(not manifest["freeze"],
+            "frozen exports bake params into the program — re-export "
+            "instead of refreshing", PreconditionNotMetError)
+    save_checkpoint(os.path.join(dirname, "params"), _plain(params))
+
+
 class InferencePredictor:
     """Loaded serving handle (the Paddle Inference ``Predictor`` role):
     ``predictor(*inputs)`` runs the compiled program on the current

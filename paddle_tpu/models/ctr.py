@@ -628,7 +628,8 @@ def serving_pull(tables, map_state, slot_hi_d, lo32, with_real=False):
 
 def export_ctr_inference(dirname: str, model: Layer, cache, slot_ids,
                          num_dense: int, freeze: bool = False,
-                         with_real: bool = False, params=None) -> None:
+                         with_real: bool = False, params=None,
+                         refresh_only: bool = False) -> None:
     """``fleet.save_inference_model`` for the CTR serving path: export
     probe → pull → forward → sigmoid as one portable program
     (io/inference.py StableHLO export). The exported parameters are the
@@ -644,8 +645,14 @@ def export_ctr_inference(dirname: str, model: Layer, cache, slot_ids,
     the sentinel and contribute zero embeddings, the serving-side
     contract for out-of-pass features. ``with_real=True`` feeds the
     model the [B, S] real-position mask as its second argument (the
-    attention family's with_real step contract — DIN)."""
-    from ..io.inference import save_inference_model
+    attention family's with_real step contract — DIN).
+
+    ``refresh_only=True``: overwrite just the serving VALUES (model
+    params + tables + key map) of an existing unfrozen export — the
+    online-learning refresh, skipping the program re-trace/re-serialize
+    (the dominant export cost). Shapes must match the original export
+    (same capacity/dims — true between refreshes of one serving job)."""
+    from ..io.inference import refresh_inference_params, save_inference_model
 
     enforce(cache.state is not None, "begin_pass first")
     enforce(cache.device_map is not None,
@@ -664,6 +671,10 @@ def export_ctr_inference(dirname: str, model: Layer, cache, slot_ids,
                    "embedx_w": cache.state["embedx_w"]},
         "map": cache.device_map.state,
     }
+    if refresh_only:
+        enforce(not freeze, "refresh_only applies to unfrozen exports")
+        refresh_inference_params(dirname, serving)
+        return
     slot_hi_d = jnp.asarray(slot_hi)
 
     def serve_fn(params, lo32, dense_x):

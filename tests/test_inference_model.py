@@ -196,6 +196,38 @@ def test_ctr_serving_export(tmp_path, rng):
     saved = load_checkpoint(str(tmp_path / "serve" / "params"))["model"]
     assert set(saved["tables"].keys()) == {"embed_w", "embedx_w"}
 
+    # refresh_only (the online path): mutate the tables, overwrite only
+    # the serving values — the program file is untouched byte-for-byte,
+    # and a fresh predictor serves the NEW values
+    import os
+    prog = tmp_path / "serve" / "model.stablehlo"
+    prog_bytes = prog.read_bytes()
+    prog_mtime = os.path.getmtime(prog)
+    cache.state["embed_w"] = cache.state["embed_w"] * 2.0
+    export_ctr_inference(str(tmp_path / "serve"), model, cache,
+                         slot_ids=np.arange(S), num_dense=D,
+                         refresh_only=True)
+    assert prog.read_bytes() == prog_bytes
+    assert os.path.getmtime(prog) == prog_mtime
+    pred2 = load_inference_model(str(tmp_path / "serve"))
+    got2 = np.asarray(pred2(jnp.asarray(lo32), jnp.asarray(dense)))
+    rows2 = cache.lookup(pool[:8].reshape(-1))
+    emb2 = cache_pull(cache.state, jnp.asarray(rows2, jnp.int32)).reshape(
+        8, S, -1)
+    out2, _ = nn.functional_call(
+        model, {"params": dict(model.named_parameters()), "buffers": {}},
+        emb2, jnp.asarray(dense), training=False)
+    np.testing.assert_allclose(got2, np.asarray(jax.nn.sigmoid(out2)),
+                               rtol=1e-5, atol=1e-6)
+    assert not np.allclose(got2, got)  # the refresh really moved scores
+
+    # refresh without a prior export fails loudly
+    import pytest as _pytest
+    with _pytest.raises(Exception, match="refresh"):
+        export_ctr_inference(str(tmp_path / "nowhere"), model, cache,
+                             slot_ids=np.arange(S), num_dense=D,
+                             refresh_only=True)
+
 
 def test_family_serving_exports(tmp_path, rng):
     """The export generalizes across the family: DIN (with_real — the

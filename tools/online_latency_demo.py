@@ -149,8 +149,12 @@ def main() -> None:
                 device_map=True)
             cache.begin_pass(serve_keys)      # read-only: no end_pass
             t_refreshed = time.perf_counter()
+            # round 0 exports the full program; later rounds overwrite
+            # only the serving values (refresh_inference_params) — the
+            # shapes are identical between refreshes by construction
             export_ctr_inference(export_dir, model, cache, slot_hi, D,
-                                 params=trainer.params["params"])
+                                 params=trainer.params["params"],
+                                 refresh_only=r > 0)
             t_exported = time.perf_counter()
 
             embed = np.asarray(cache.state["embed_w"])
