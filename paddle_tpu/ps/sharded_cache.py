@@ -381,15 +381,19 @@ def select_routing(m_local: int, shard_rows: int, K: int,
     enforce(push_mode in ("dense", "sparse"),
             f"push_mode must be 'dense' or 'sparse', got {push_mode!r}")
     del m_local, shard_rows  # regime keys reserved for hw recalibration
-    # multi-PROCESS meshes route at every K: the cross-process sweeps
-    # (ROUTED_MULTIHOST_DENSE.json — routed/gathered 0.92x at K=2,
-    # 0.82x at K=4, 0.60x at K=8 dense; ROUTED_MULTIHOST.json 0.52x
-    # sparse K=8) show the gathered formulation's full-batch volume
-    # already loses once a process boundary is in the path, including
-    # the K=2 cell where the single-process grid preferred gathering
+    # multi-PROCESS meshes in DENSE mode route at every K: the
+    # cross-process sweep (ROUTED_MULTIHOST_DENSE.json) measured
+    # routed/gathered 0.92x at K=2, 0.82x at K=4, 0.60x at K=8 — the
+    # gathered formulation's full-batch volume loses once a process
+    # boundary is in the path. Sparse mode does NOT flip at K=2: its
+    # routed path pays the dedup sort, and the sparse sweep
+    # (ROUTED_MULTIHOST_SPARSE.json) measured 1.28x at K=2 (routing
+    # WORSE) vs 0.75x at K=4 / 0.55x at K=8 — so sparse keeps the K>=4
+    # threshold everywhere. Measure, don't extrapolate: the first
+    # version of this branch assumed the dense K=2 flip carried over.
     import jax
 
-    if jax.process_count() > 1:
+    if jax.process_count() > 1 and push_mode == "dense":
         return "alltoall", "alltoall"
     if K < 4:
         return "allgather", "allgather"

@@ -172,7 +172,7 @@ def _run_once(devs_per_proc: int, push_mode: str, tmp_out: str) -> dict:
 
 def main() -> None:
     mode = os.environ.get("RM_MODE", "dense")
-    if mode == "sparse":
+    if mode == "sparse" and "RM_KS" not in os.environ:
         # the r3 artifact shape: one K=8 run, its own file
         out_path = os.environ.get("RM_OUT") or os.path.join(
             _REPO, "ROUTED_MULTIHOST.json")
@@ -182,15 +182,15 @@ def main() -> None:
         return
     ks = [int(k) for k in os.environ.get("RM_KS", "2,4,8").split(",")]
     out_path = os.environ.get("RM_OUT") or os.path.join(
-        _REPO, "ROUTED_MULTIHOST_DENSE.json")
+        _REPO, f"ROUTED_MULTIHOST_{mode.upper()}.json")
     runs = {}
     with tempfile.TemporaryDirectory() as td:
         for k in ks:
             assert k % 2 == 0, "K must split over the 2 host processes"
             tmp = os.path.join(td, f"k{k}.json")
-            runs[str(k)] = _run_once(k // 2, "dense", tmp)
+            runs[str(k)] = _run_once(k // 2, mode, tmp)
     out = {
-        "push_mode": "dense",
+        "push_mode": mode,
         "transport": "loopback TCP (2 jax.distributed procs, one host) — "
                      "NOT a real DCN; ratios not absolute times are the "
                      "evidence",
