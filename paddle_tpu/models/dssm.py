@@ -119,18 +119,22 @@ def make_dssm_train_step(model: DSSM, optimizer, cache_cfg: CacheConfig,
 
 
 def export_dssm_towers(dirname: str, model: DSSM, cache, query_slot_ids,
-                       doc_slot_ids) -> None:
+                       doc_slot_ids, refresh_only: bool = False) -> None:
     """The two-tower deployment split the module docstring promises:
     ``<dirname>/query`` serves the ONLINE tower (query keys → normalized
     query vector) and ``<dirname>/doc`` the OFFLINE one (doc keys →
     normalized doc vectors for the ANN index build) — each a portable
     batch-polymorphic program with the PRUNED serving tables
     (embed_w/embedx_w + the pass key map; no optimizer state), the same
-    persistables pruning as export_ctr_inference."""
+    persistables pruning as export_ctr_inference.
+
+    ``refresh_only=True``: overwrite only the serving VALUES of both
+    existing exports (the online-update path — program re-trace
+    skipped; see refresh_inference_params)."""
     import os
 
     from ..core.enforce import enforce
-    from ..io.inference import save_inference_model
+    from ..io.inference import refresh_inference_params, save_inference_model
     from .ctr import serving_pull
 
     enforce(cache.state is not None, "begin_pass first")
@@ -163,6 +167,9 @@ def export_dssm_towers(dirname: str, model: DSSM, cache, query_slot_ids,
         serving = {"model": {"params": dict(tower.named_parameters()),
                              "buffers": {}},
                    "tables": tables, "map": map_state}
+        if refresh_only:
+            refresh_inference_params(os.path.join(dirname, which), serving)
+            continue
         fn, S = tower_fn(slot_ids, tower)
         (b,) = jax.export.symbolic_shape(f"b_{which}")
         example = (jax.ShapeDtypeStruct((b, S), jnp.uint32),)

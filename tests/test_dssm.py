@@ -166,3 +166,21 @@ def test_dssm_tower_export(tmp_path):
                                atol=1e-5)
     np.testing.assert_allclose(d_vec, np.asarray(d_ref), rtol=1e-5,
                                atol=1e-5)
+
+    # params-only refresh (the online query-tower update): mutate the
+    # tables, overwrite values — the programs are untouched and a fresh
+    # predictor serves moved vectors
+    import os
+
+    prog = tmp_path / "query" / "model.stablehlo"
+    before = prog.read_bytes()
+    cache.state["embed_w"] = cache.state["embed_w"] * 2.0
+    export_dssm_towers(str(tmp_path), model, cache,
+                       query_slot_ids=np.zeros(SQ, np.uint32),
+                       doc_slot_ids=np.ones(SD, np.uint32),
+                       refresh_only=True)
+    assert prog.read_bytes() == before
+    q2 = np.asarray(load_inference_model(str(tmp_path / "query"))(
+        jnp.asarray(lo[:, :SQ])))
+    assert not np.allclose(q2, q_vec)
+    np.testing.assert_allclose(np.linalg.norm(q2, axis=1), 1.0, atol=1e-3)
