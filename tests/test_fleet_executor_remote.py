@@ -217,6 +217,29 @@ def test_remote_bus_hmac_rejects_unauthenticated():
     bus.close()
 
 
+def test_remote_bus_mismatched_secrets_fail_closed():
+    """Two ranks configured with DIFFERENT secrets: every cross-rank
+    frame fails verification and is dropped before unpickling — the
+    receiving inbox stays empty (fail CLOSED, no partial trust), and
+    the receiver records nothing as delivered."""
+    import time
+
+    from paddle_tpu.distributed.fleet_executor import (
+        InterceptorMessage, MessageType, RemoteMessageBus)
+
+    ports = _free_ports(2)
+    addrs = {0: ("127.0.0.1", ports[0]), 1: ("127.0.0.1", ports[1])}
+    placement = {0: 0, 7: 1}
+    bus0 = RemoteMessageBus(0, addrs, placement, secret=b"key-A")
+    bus1 = RemoteMessageBus(1, addrs, placement, secret=b"key-B")
+    inbox = bus1.register(7)
+    bus0.send(InterceptorMessage(0, 7, MessageType.DATA_IS_READY, "x"))
+    time.sleep(0.3)
+    assert inbox.empty()  # dropped at the HMAC check, never delivered
+    bus0.close()
+    bus1.close()
+
+
 def test_carrier_stop_fast_on_dead_peer():
     """Carrier.stop over a never-started peer must not spin the
     connect-retry loop for connect_timeout per rank (advisor r4): the
