@@ -1,0 +1,208 @@
+"""Distributed endurance: the multi-day lifecycle at multi-server scale.
+
+DIST_SCALE.json proved the 0.67e9-row build/save/restore composition;
+this artifact stresses what that run only touched (3 passes): the
+SUSTAINED loop — pass → train → flush → spill → (periodic) shrink +
+delta save — over a 4-server SSD-sharded population for many rounds,
+watching the trajectories that reveal slow leaks:
+
+  - per-pass build/step/flush rates (drift = accumulating cost),
+  - per-server RSS (index/arena leaks),
+  - cold-tier disk bytes (the shrink sweep REWRITES kept rows into the
+    log; without compaction the logs grow unboundedly — sst_shrink's
+    maybe_compact is the mechanism under test),
+  - table row counts (shrink's decay/delete lifecycle at scale).
+
+Emits one JSON line (committed as DIST_ENDURANCE.json). Knobs:
+DE_SERVERS (4), DE_POP (100M), DE_PASSES (30), DE_PASS_KEYS (400k),
+DE_SHRINK_EVERY (10), DE_DIR. Single-core host: run ALONE.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dist_scale_demo import _du, _rss_bytes, spawn_servers  # noqa: E402
+
+
+def main() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.ps.rpc as rpc
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM, make_ctr_train_step
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.embedding_cache import CacheConfig, HbmEmbeddingCache
+    from paddle_tpu.ps.rpc import RemoteSparseTable
+    from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+    from paddle_tpu.ps.table import TableConfig
+
+    n_servers = int(os.environ.get("DE_SERVERS", 4))
+    pop = int(float(os.environ.get("DE_POP", 100_000_000)))
+    n_passes = int(os.environ.get("DE_PASSES", 30))
+    pass_keys = int(os.environ.get("DE_PASS_KEYS", 400_000))
+    shrink_every = int(os.environ.get("DE_SHRINK_EVERY", 10))
+    dim = 4
+    base = os.environ.get("DE_DIR") or tempfile.mkdtemp(prefix="dist_end_")
+    cleanup = "DE_DIR" not in os.environ
+    os.makedirs(base, exist_ok=True)
+
+    pt.seed(0)
+    rng = np.random.default_rng(0)
+    acc = AccessorConfig(embedx_dim=dim, embedx_threshold=0.0,
+                         # survivable lifecycle at this cadence: gentle
+                         # decay, delete only long-unseen rows
+                         show_click_decay_rate=0.98,
+                         delete_threshold=0.05,
+                         delete_after_unseen_days=8.0,
+                         sgd=SGDRuleConfig(initial_range=0.0))
+
+    out = {"n_servers": n_servers, "population": pop, "passes": n_passes,
+           "pass_keys": pass_keys, "shrink_every": shrink_every,
+           "host_cores": os.cpu_count()}
+    procs, cli = [], None
+    try:
+        procs, ports = spawn_servers(n_servers)
+        cli = rpc.RpcPsClient([f"127.0.0.1:{p}" for p in ports])
+        cfg = TableConfig(shard_num=8, accessor_config=acc, storage="ssd",
+                          ssd_path=os.path.join(base, "tiers"))
+        cli.create_sparse_table(0, cfg)
+        full_dim = cli._dims(0)[2]
+
+        t0 = time.perf_counter()
+        chunk = 4_000_000
+        for lo in range(0, pop, chunk):
+            n = min(chunk, pop - lo)
+            keys = np.arange(lo + 1, lo + 1 + n, dtype=np.uint64)
+            vals = np.zeros((n, full_dim), np.float32)
+            vals[:, 0] = keys % 26
+            vals[:, 3] = 1.0
+            vals[:, 5] = 0.01 * rng.standard_normal(n).astype(np.float32)
+            vals[:, 7] = 1.0
+            vals[:, 8:8 + dim] = 0.01 * rng.standard_normal(
+                (n, dim)).astype(np.float32)
+            assert cli.load_cold(0, keys, vals) == n
+        out["build"] = {"rows": pop,
+                        "seconds": round(time.perf_counter() - t0, 1)}
+
+        remote = RemoteSparseTable(cli, 0, cfg)
+        hot_pool = max(pop // 50, pass_keys)
+        cap = 1 << int(np.ceil(np.log2(max(pass_keys * 1.25, 1 << 18))))
+        cache = HbmEmbeddingCache(remote, CacheConfig(
+            capacity=cap, embedx_dim=dim, embedx_threshold=0.0))
+        ccfg = CtrConfig(num_sparse_slots=8, num_dense=4, embedx_dim=dim,
+                         dnn_hidden=(64, 64))
+        model = DeepFM(ccfg)
+        opt = optimizer.Adam(1e-3)
+        params = {"params": dict(model.named_parameters()), "buffers": {}}
+        ostate = opt.init(params)
+        step = make_ctr_train_step(model, opt, cache.config)
+
+        rounds = []
+        ckpt_dir = os.path.join(base, "delta_ckpts")
+        os.makedirs(ckpt_dir, exist_ok=True)
+        for pno in range(n_passes):
+            hot = rng.integers(1, hot_pool + 1,
+                               size=int(pass_keys * 0.9)).astype(np.uint64)
+            tail = rng.integers(1, pop + 1,
+                                size=pass_keys - len(hot)).astype(np.uint64)
+            pk = np.concatenate([hot, tail]).reshape(-1, 8)
+            t0 = time.perf_counter()
+            n_uniq = cache.begin_pass(pk.reshape(-1))
+            build_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for _ in range(10):
+                b = rng.integers(0, pk.shape[0], size=512)
+                rows = cache.lookup(pk[b].reshape(-1)).reshape(512, 8)
+                dense = rng.standard_normal((512, 4)).astype(np.float32)
+                lab = (pk[b, 0] % 2).astype(np.int32)
+                params, ostate, cache.state, loss = step(
+                    params, ostate, cache.state, rows, dense, lab)
+            jax.block_until_ready(loss)
+            steps_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            cache.end_pass()
+            flush_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            spilled = cli.spill(0, hot_budget=hot_pool)
+            spill_s = time.perf_counter() - t0
+
+            rec = {"pass": pno, "uniq": int(n_uniq),
+                   "build_s": round(build_s, 2),
+                   "steps_s": round(steps_s, 2),
+                   "flush_s": round(flush_s, 2),
+                   "spill_s": round(spill_s, 2), "spilled": int(spilled),
+                   "loss": round(float(loss), 4)}
+            if (pno + 1) % shrink_every == 0:
+                # the daily boundary: decay + delete sweep over BOTH
+                # tiers, then a delta save of the changed keep-set
+                t0 = time.perf_counter()
+                erased = cli.shrink(0)
+                rec["shrink_s"] = round(time.perf_counter() - t0, 1)
+                rec["shrink_erased"] = int(erased)
+                t0 = time.perf_counter()
+                saved = cli.save_local(
+                    0, os.path.join(ckpt_dir, f"d{pno}"), mode=1,
+                    converter="raw")
+                rec["delta_save_s"] = round(time.perf_counter() - t0, 1)
+                rec["delta_rows"] = int(saved)
+            st = cli.table_stats(0)
+            rec["stats"] = st
+            rec["server_rss"] = [_rss_bytes(p.pid) for p in procs]
+            rec["client_rss"] = _rss_bytes()
+            rounds.append(rec)
+        out["rounds"] = rounds
+
+        first, last = rounds[0], rounds[-1]
+        d0 = first["stats"]["disk_bytes"]
+        d1 = last["stats"]["disk_bytes"]
+        r0 = sum(first["server_rss"])
+        r1 = sum(last["server_rss"])
+        out["trajectories"] = {
+            "disk_bytes_first_to_last": [d0, d1],
+            "disk_growth_frac": round((d1 - d0) / max(d0, 1), 4),
+            "server_rss_first_to_last": [r0, r1],
+            "rss_growth_frac": round((r1 - r0) / max(r0, 1), 4),
+            "build_s_first_to_last": [first["build_s"], last["build_s"]],
+            "flush_s_first_to_last": [first["flush_s"], last["flush_s"]],
+        }
+        # gates: bounded growth — a leak shows up as monotone unbounded
+        # RSS or disk (shrink rewrites + compaction must hold disk near
+        # the live-row footprint; allow slack for hot-tier promotion and
+        # log garbage between compactions)
+        out["ok"] = bool(out["trajectories"]["disk_growth_frac"] < 0.5
+                         and out["trajectories"]["rss_growth_frac"] < 0.5)
+    finally:
+        try:
+            if cli is not None:
+                cli.stop_servers()
+                cli.close()
+        except Exception:
+            pass
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if cleanup:
+            shutil.rmtree(base, ignore_errors=True)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — artifact must be one JSON line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({"ok": False,
+                          "error": f"{type(e).__name__}: {e}"[:300]}))
+        sys.exit(0)
