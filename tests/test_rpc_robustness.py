@@ -188,6 +188,86 @@ def test_barrier_timeout_cancels_arrival(fast_flags):
         lib.pss_destroy(h)
 
 
+def test_bulk_load_survives_server_crash_and_replay(fast_flags, tmp_path):
+    """The 1e9-path crash story: SIGKILL a server mid-bulk-load, restart
+    it on the same SSD directories (cold-tier log replay), re-issue the
+    failed chunk (client retries are at-least-once — duplicate appends
+    are benign: the index keeps the newest record, compaction reclaims
+    the garbage) and finish the load; every row is present with the
+    right values and compact() shrinks the log back."""
+    import paddle_tpu.ps.rpc as _rpc
+    from paddle_tpu.ps.accessor import AccessorConfig
+
+    proc, port = _spawn_server()
+    cli = None
+    acc = AccessorConfig(embedx_dim=4, embedx_threshold=0.0,
+                         sgd=SGDRuleConfig(initial_range=0.0))
+    cfg = TableConfig(shard_num=4, accessor_config=acc, storage="ssd",
+                      ssd_path=str(tmp_path / "tiers"))
+    try:
+        cli = _rpc.RpcPsClient([f"127.0.0.1:{port}"])
+        cli.create_sparse_table(0, cfg)
+        full_dim = cli._dims(0)[2]
+        rng = np.random.default_rng(7)
+        n = 30_000
+        keys = np.arange(1, n + 1, dtype=np.uint64)
+        vals = np.zeros((n, full_dim), np.float32)
+        vals[:, 3] = 1.0
+        vals[:, 5] = rng.normal(0, 0.01, n).astype(np.float32)
+
+        half = n // 2
+        assert cli.load_cold(0, keys[:half], vals[:half]) == half
+        proc.kill()
+        proc.wait()
+        with pytest.raises(Exception, match="unreachable"):
+            cli.load_cold(0, keys[half:], vals[half:])
+
+        # restart on the SAME directories: the cold log replays
+        proc, port2 = _spawn_server(port)
+        assert port2 == port
+        cli.create_sparse_table(0, cfg)
+        st = cli.table_stats(0)
+        assert st["cold_rows"] == half  # replayed, nothing lost
+        # at-least-once retry: re-issue the whole failed chunk PLUS an
+        # overlap of already-loaded rows (a retried frame the server
+        # had actually applied before dying)
+        overlap = keys[half - 1000 : half]
+        assert cli.load_cold(0, np.concatenate([overlap, keys[half:]]),
+                             np.concatenate([vals[half - 1000 : half],
+                                             vals[half:]])) == n - half + 1000
+        # at-least-once means a client-side timeout can leave an EARLIER
+        # attempt still applying server-side after the retry succeeded
+        # (fast_flags' 1.5 s long-call deadline makes this reproducible
+        # on the 1-core host) — counts are eventually consistent, so
+        # poll to quiescence before asserting
+        deadline = time.monotonic() + 15
+        while True:
+            st = cli.table_stats(0)
+            if st["cold_rows"] == n or time.monotonic() > deadline:
+                break
+            time.sleep(0.2)
+        assert st["cold_rows"] == n  # duplicates shadowed, not counted
+        sample = rng.choice(keys, 500, replace=False)
+        got, found = cli.export_full(0, sample)
+        assert found.all()
+        np.testing.assert_allclose(got, vals[sample.astype(np.int64) - 1],
+                                   atol=1e-6)
+        disk_before = cli.table_stats(0)["disk_bytes"]
+        cli.compact(0)
+        st2 = cli.table_stats(0)
+        assert st2["disk_bytes"] <= disk_before  # garbage reclaimed
+        # export_full PROMOTED the sampled rows to the hot tier (the
+        # documented tier protocol) — the invariant is total rows, not
+        # cold rows
+        assert st2["hot_rows"] + st2["cold_rows"] == n
+        assert st2["hot_rows"] == len(sample)
+    finally:
+        if cli is not None:
+            cli.close()
+        if proc.poll() is None:
+            proc.kill()
+
+
 def test_failover_to_restarted_server(fast_flags):
     """Stretch goal: kill a server, restart it on the same port, and the
     SAME client object recovers via reconnect — re-create the table,
