@@ -25,7 +25,8 @@ from ..nn.layer import Layer
 from ..ps.embedding_cache import CacheConfig, cache_pull, cache_push
 from .dssm import DSSM, _l2_normalize
 
-__all__ = ["GRU4Rec", "make_gru4rec_train_step", "item_keys"]
+__all__ = ["GRU4Rec", "make_gru4rec_train_step", "item_keys",
+           "export_gru4rec_towers"]
 
 
 def item_keys(item_ids: np.ndarray) -> np.ndarray:
@@ -99,3 +100,75 @@ def make_gru4rec_train_step(model: GRU4Rec, optimizer,
         return new_params, new_opt, new_cache, loss
 
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
+
+
+def export_gru4rec_towers(dirname: str, model: GRU4Rec, cache,
+                          max_len: int, refresh_only: bool = False) -> None:
+    """Session-recall deployment split (the DSSM-towers pattern for the
+    sequence family): ``<dirname>/session`` serves the ONLINE tower —
+    (item lo32 [b, max_len] uint32, lengths [b] int32) → normalized
+    session vector — and ``<dirname>/item`` the OFFLINE one (item lo32
+    [b] → normalized item vectors for the ANN index build). Both are
+    portable batch-polymorphic programs with the PRUNED serving tables
+    (embed_w/embedx_w + the pass key map; no optimizer state) and each
+    tower's OWN dense params only. Out-of-pass/padding item ids probe
+    to the sentinel and contribute zero embeddings; padding positions
+    past ``lengths`` are frozen by the GRU's length masking, the same
+    contract as training. ``max_len`` is the deploy-time session length
+    (the scan is static; pad shorter sessions, set ``lengths``).
+
+    ``refresh_only=True`` overwrites only the serving values of both
+    existing exports (the online refresh; program re-trace skipped)."""
+    import os
+
+    from ..core.enforce import enforce
+    from ..io.inference import refresh_inference_params, save_inference_model
+    from ..nn.layer import get_state
+    from .ctr import serving_pull
+    from .dssm import _bind_params
+
+    enforce(cache.state is not None, "begin_pass first")
+    enforce(cache.device_map is not None,
+            "export_gru4rec_towers needs device_map=True on the cache")
+    tables = {"embed_w": cache.state["embed_w"],
+              "embedx_w": cache.state["embedx_w"]}
+    map_state = cache.device_map.state
+    # one item table: every key lives in hi=0 (item_keys), so every
+    # serving column shares slot_hi 0
+    sess_hi = jnp.zeros((int(max_len),), jnp.uint32)
+    item_hi = jnp.zeros((1,), jnp.uint32)
+
+    def sess_fn(params, lo32, lengths):
+        emb = serving_pull(params["tables"], params["map"], sess_hi, lo32)
+        with _bind_params(model.gru, params["model"]["gru"]):
+            with _bind_params(model.sess_proj,
+                              params["model"]["sess_proj"]):
+                _, h_n = model.gru(emb, lengths)
+                u = model.sess_proj(h_n[-1])
+        return _l2_normalize(u)
+
+    def item_fn(params, lo32):
+        emb = serving_pull(params["tables"], params["map"], item_hi,
+                           lo32)[:, 0, :]
+        with _bind_params(model.item_proj, params["model"]["item_proj"]):
+            v = model.item_proj(emb)
+        return _l2_normalize(v)
+
+    for which, fn, sub_states, example in (
+            ("session", sess_fn,
+             {"gru": get_state(model.gru),
+              "sess_proj": get_state(model.sess_proj)}, None),
+            ("item", item_fn,
+             {"item_proj": get_state(model.item_proj)}, None)):
+        serving = {"model": sub_states, "tables": tables, "map": map_state}
+        if refresh_only:
+            refresh_inference_params(os.path.join(dirname, which), serving)
+            continue
+        (b,) = jax.export.symbolic_shape(f"b_{which}")
+        if which == "session":
+            example = (jax.ShapeDtypeStruct((b, int(max_len)), jnp.uint32),
+                       jax.ShapeDtypeStruct((b,), jnp.int32))
+        else:
+            example = (jax.ShapeDtypeStruct((b, 1), jnp.uint32),)
+        save_inference_model(os.path.join(dirname, which), fn, serving,
+                             example)

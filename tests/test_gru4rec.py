@@ -114,3 +114,73 @@ def test_gru4rec_learns_session_recall(rng):
     # negatives per example
     ranks_cross = ((scores > np.diag(scores)[:, None]) & ~same).sum(1)
     assert float(np.mean(ranks_cross)) < 3.0, ranks_cross.mean()
+
+
+def test_gru4rec_tower_exports(rng, tmp_path):
+    """export_gru4rec_towers: the session tower (keys+lengths →
+    normalized session vector, GRU scan inside a batch-polymorphic
+    portable program) and the item tower (keys → normalized vectors)
+    match the in-process forward; padding past lengths and out-of-pass
+    ids hit the sentinel; refresh_only swaps values without touching
+    the programs."""
+    from paddle_tpu.io.inference import load_inference_model
+    from paddle_tpu.models.gru4rec import export_gru4rec_towers
+    from paddle_tpu.ps.embedding_cache import cache_pull
+
+    pt.seed(0)
+    dim = 8
+    acc = AccessorConfig(embedx_dim=dim, embedx_threshold=0.0,
+                         sgd=SGDRuleConfig(initial_range=0.0))
+    table = MemorySparseTable(TableConfig(shard_num=2, accessor_config=acc))
+    cache_cfg = CacheConfig(capacity=1 << 8, embedx_dim=dim,
+                            embedx_threshold=0.0)
+    cache = HbmEmbeddingCache(table, cache_cfg, device_map=True)
+    cache.begin_pass(item_keys(np.arange(N_ITEMS)))
+    cache.state["embedx_w"] = jnp.asarray(
+        rng.normal(scale=0.1,
+                   size=cache.state["embedx_w"].shape).astype(np.float32))
+    cache.state["embed_w"] = jnp.asarray(
+        rng.normal(scale=0.1,
+                   size=cache.state["embed_w"].shape).astype(np.float32))
+
+    model = GRU4Rec(embedx_dim=dim, hidden=16, out_dim=8)
+    export_gru4rec_towers(str(tmp_path), model, cache, max_len=T)
+    sess = load_inference_model(str(tmp_path / "session"))
+    item = load_inference_model(str(tmp_path / "item"))
+
+    seq, lengths, target, _ = _sessions(rng, 8)
+    C = cache_cfg.capacity
+    # serving feeds RAW lo32 ids; pad positions use an out-of-pass id
+    lo = seq.astype(np.uint32)
+    pad = np.arange(T)[None, :] >= lengths[:, None]
+    lo = np.where(pad, np.uint32(0xFFFFFF), lo)
+    u = np.asarray(sess(jnp.asarray(lo), jnp.asarray(lengths, jnp.int32)))
+    v = np.asarray(item(jnp.asarray(target[:, None].astype(np.uint32))))
+    assert u.shape == (8, 8) and v.shape == (8, 8)
+    np.testing.assert_allclose(np.linalg.norm(u, axis=1), 1.0, atol=1e-3)
+    np.testing.assert_allclose(np.linalg.norm(v, axis=1), 1.0, atol=1e-3)
+
+    # in-process oracle through the training forward
+    rows_seq = cache.lookup(item_keys(seq.reshape(-1))).reshape(
+        seq.shape).astype(np.int32)
+    rows_seq = np.where(pad, C, rows_seq)
+    rows_tgt = cache.lookup(item_keys(target)).astype(np.int32)
+    emb_seq = cache_pull(cache.state, jnp.asarray(rows_seq.reshape(-1))
+                         ).reshape(8, T, -1)
+    emb_tgt = cache_pull(cache.state, jnp.asarray(rows_tgt))
+    (u_ref, v_ref), _ = nn.functional_call(
+        model, {"params": dict(model.named_parameters()), "buffers": {}},
+        emb_seq, emb_tgt, jnp.asarray(lengths), training=False)
+    np.testing.assert_allclose(u, np.asarray(u_ref), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(v, np.asarray(v_ref), rtol=1e-5, atol=1e-5)
+
+    # refresh_only: tables move, programs byte-identical, vectors move
+    prog = tmp_path / "session" / "model.stablehlo"
+    before = prog.read_bytes()
+    cache.state["embedx_w"] = cache.state["embedx_w"] * 2.0
+    export_gru4rec_towers(str(tmp_path), model, cache, max_len=T,
+                          refresh_only=True)
+    assert prog.read_bytes() == before
+    u2 = np.asarray(load_inference_model(str(tmp_path / "session"))(
+        jnp.asarray(lo), jnp.asarray(lengths, jnp.int32)))
+    assert not np.allclose(u2, u)
