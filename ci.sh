@@ -1,8 +1,18 @@
 #!/usr/bin/env bash
-# CI gate (reference L0's cmake+ctest role): native build, fast test
-# gate, then the full matrix. Usage: ./ci.sh [fast|full]
+# CI gate (reference L0's cmake+ctest role): graftlint, native build,
+# fast test gate, then the full matrix. Usage: ./ci.sh [lint|fast|full]
 set -euo pipefail
 cd "$(dirname "$0")"
+
+# graftlint first, in every mode: a host-sync or lock-order violation
+# fails in seconds, not after the pytest matrix (docs/STATIC_ANALYSIS.md)
+echo "== graftlint (tracer safety / lock order / conventions) =="
+python tools/lint/run.py
+
+if [[ "${1:-fast}" == "lint" ]]; then
+  echo "CI OK (lint only)"
+  exit 0
+fi
 
 echo "== native build =="
 make -C paddle_tpu/csrc -s
@@ -78,6 +88,39 @@ print('bench degradation ladder OK')"
     exit 1
   fi
   echo "TSAN sweep OK (no reports in our .so)"
+
+  echo "== ASAN sweep (same surfaces; heap/stack/use-after-free) =="
+  # same contract as TSAN: detect_leaks=0 because the uninstrumented
+  # Python/jax runtime "leaks" by design at interpreter exit; exitcode=0
+  # so pytest's status gates the tests and the grep gates OUR .so
+  make -C paddle_tpu/csrc SANITIZE=address -s
+  rm -f /tmp/ci_asan_report*
+  LD_PRELOAD="$(gcc -print-file-name=libasan.so)" \
+    ASAN_OPTIONS="detect_leaks=0,halt_on_error=0,exitcode=0,log_path=/tmp/ci_asan_report" \
+    python -m pytest tests/test_table_concurrency.py tests/test_ssd_table.py \
+      tests/test_native_table.py tests/test_ps_rpc.py \
+      tests/test_rpc_robustness.py tests/test_dist_graph.py -q -m ""
+  if grep -l "libpaddle_tpu_native" /tmp/ci_asan_report* 2>/dev/null; then
+    echo "ASAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_asan_report*)"
+    exit 1
+  fi
+  echo "ASAN sweep OK (no reports in our .so)"
+
+  echo "== UBSAN sweep (same surfaces; UB: overflow/alignment/bounds) =="
+  # UBSAN's runtime is linked into the sanitized .so itself, so no
+  # LD_PRELOAD; halt_on_error=0 collects every report into the log
+  make -C paddle_tpu/csrc SANITIZE=undefined -s
+  rm -f /tmp/ci_ubsan_report*
+  UBSAN_OPTIONS="print_stacktrace=1,halt_on_error=0,log_path=/tmp/ci_ubsan_report" \
+    python -m pytest tests/test_table_concurrency.py tests/test_ssd_table.py \
+      tests/test_native_table.py tests/test_ps_rpc.py \
+      tests/test_rpc_robustness.py tests/test_dist_graph.py -q -m ""
+  if grep -l "libpaddle_tpu_native" /tmp/ci_ubsan_report* 2>/dev/null; then
+    echo "UBSAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_ubsan_report*)"
+    exit 1
+  fi
+  echo "UBSAN sweep OK (no reports in our .so)"
+
   make -C paddle_tpu/csrc -s   # restore the normal flavor now
   trap - EXIT
 fi

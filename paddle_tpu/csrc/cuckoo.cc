@@ -19,6 +19,12 @@
 // The 32-bit mixer below must match _mix32 in ps/device_hash.py
 // bit-for-bit — the device probe recomputes these hashes with jnp uint32
 // arithmetic.
+//
+// Lock hierarchy (checked by tools/lint/lock_order.py): NONE — the
+// build is single-threaded per call and owns its output buffers; there
+// are no mutexes in this translation unit. Callers running builds in a
+// background thread (DeviceKeyMap.build_host) must not share the output
+// arrays until the build returns.
 
 #include <cstdint>
 #include <cstring>

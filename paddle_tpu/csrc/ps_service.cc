@@ -20,6 +20,17 @@
 // of trainers per server; the reference sizes brpc thread pools
 // similarly). Tables are the sparse_table.h engine (shard-parallel, so
 // one busy connection still uses all cores).
+//
+// Lock hierarchy (checked by tools/lint/lock_order.py): the registry
+// lock tables_mu is released BEFORE any per-table lock is taken (see
+// kSaveAll: the ssd_save_mu pointer is copied out under tables_mu, then
+// locked after the scope closes) — the declared order below is the only
+// legal nesting if a future handler ever must hold both. conn_mu and
+// bar_mu are leaf locks. The table engines' internal order
+// (save_mu < shard_mu < ...) is declared where those locks live
+// (sparse_table.h, ssd_table.cc).
+// LOCK ORDER: tables_mu < save_mu < shard_mu
+// LOCK ORDER: tables_mu < dense_mu
 
 #include <arpa/inet.h>
 #include <fcntl.h>

@@ -15,6 +15,12 @@
 // out over shards and joins, with a per-shard mutex making concurrent
 // requests safe).
 //
+// Lock hierarchy (checked by tools/lint/lock_order.py): the snapshot
+// paths take the table-wide save_mu, then each shard's mu — declared in
+// sparse_table.h where both locks live. This file only ever holds ONE
+// per-shard mu at a time (shards are independent; never lock two).
+// LOCK ORDER: save_mu < shard_mu
+//
 // C ABI only (ctypes-friendly); all batch buffers are caller-owned.
 
 #include "sparse_table.h"

@@ -1,6 +1,13 @@
 // Native MemorySparseTable engine — shared structs (see sparse_table.cc
 // for provenance and the C ABI; ps_service.cc embeds these for the
 // server-side tables).
+//
+// Lock hierarchy (checked by tools/lint/lock_order.py; grammar in
+// docs/STATIC_ANALYSIS.md): table_save_snapshot takes the table-wide
+// save_mu, and the *_locked body then takes each shard's mu in turn —
+// so save_mu always precedes any shard mu, and no two shard mus are
+// ever held together.
+// LOCK ORDER: save_mu < shard_mu
 #pragma once
 
 #include <algorithm>

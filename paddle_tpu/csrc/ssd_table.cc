@@ -27,6 +27,11 @@
 //
 // C ABI (sst_*) mirrors sparse_table.cc's pst_* so the Python layer
 // swaps engines; extra entry points: spill, compact, stats, load_cold.
+//
+// Lock hierarchy (checked statically by tools/lint/lock_order.py —
+// nested acquisitions carry a `// LOCK: name` tag and must follow the
+// declared order; see docs/STATIC_ANALYSIS.md):
+// LOCK ORDER: ssd_save_mu < mem_save_mu < shard_mu < disk_mu
 
 #include <fcntl.h>
 #include <sys/stat.h>
@@ -343,8 +348,8 @@ void fan_out_batched(SsdTable* t, const uint64_t* keys, int64_t n, Fn fn) {
     ts.emplace_back([&, s]() {
       Shard* sh = t->mem->shards[s];
       DiskShard* d = t->disk[s];
-      std::lock_guard<std::mutex> g1(sh->mu);
-      std::lock_guard<std::mutex> g2(d->mu);
+      std::lock_guard<std::mutex> g1(sh->mu);  // LOCK: shard_mu
+      std::lock_guard<std::mutex> g2(d->mu);   // LOCK: disk_mu
       fn(sh, d, per[s]);
     });
   }
@@ -366,8 +371,8 @@ void per_shard(SsdTable* t, Fn fn) {
     ts.emplace_back([&, s]() {
       Shard* sh = t->mem->shards[s];
       DiskShard* d = t->disk[s];
-      std::lock_guard<std::mutex> g1(sh->mu);
-      std::lock_guard<std::mutex> g2(d->mu);
+      std::lock_guard<std::mutex> g1(sh->mu);  // LOCK: shard_mu
+      std::lock_guard<std::mutex> g2(d->mu);   // LOCK: disk_mu
       fn(sh, d, static_cast<int32_t>(s));
     });
   }
@@ -708,8 +713,8 @@ int64_t sst_compact(void* h) {
 // engine has the same per-shard granularity).
 int64_t sst_save_begin(void* h, int32_t mode) {
   SsdTable* t = static_cast<SsdTable*>(h);
-  std::lock_guard<std::mutex> sg(t->save_mu);
-  std::lock_guard<std::mutex> mg(t->mem->save_mu);
+  std::lock_guard<std::mutex> sg(t->save_mu);       // LOCK: ssd_save_mu
+  std::lock_guard<std::mutex> mg(t->mem->save_mu);  // LOCK: mem_save_mu
   t->mem->save_keys.clear();
   t->mem->save_values.clear();
   const TableNativeConfig& c = t->mem->cfg;
@@ -717,8 +722,8 @@ int64_t sst_save_begin(void* h, int32_t mode) {
   for (size_t s = 0; s < t->mem->shards.size(); ++s) {
     Shard* sh = t->mem->shards[s];
     DiskShard* d = t->disk[s];
-    std::lock_guard<std::mutex> g1(sh->mu);
-    std::lock_guard<std::mutex> g2(d->mu);
+    std::lock_guard<std::mutex> g1(sh->mu);  // LOCK: shard_mu
+    std::lock_guard<std::mutex> g2(d->mu);  // LOCK: disk_mu
     // hot tier (the table_save_snapshot_locked body, one shard)
     for (uint64_t hh = 0; hh <= sh->mask; ++hh) {
       int32_t r = sh->slot_state[hh];
@@ -773,7 +778,7 @@ int64_t sst_save_begin(void* h, int32_t mode) {
 
 void sst_save_fetch(void* h, uint64_t* keys_out, float* values_out) {
   SsdTable* t = static_cast<SsdTable*>(h);
-  std::lock_guard<std::mutex> sg(t->save_mu);
+  std::lock_guard<std::mutex> sg(t->save_mu);  // LOCK: ssd_save_mu
   pstpu::table_save_drain(t->mem, keys_out, values_out);
 }
 
@@ -805,7 +810,7 @@ constexpr uint32_t kBinMagic = 0x42535450u;  // 'PTSB'
 int64_t sst_save_file(void* h, const char* path, int32_t mode,
                       int32_t use_gzip) {
   SsdTable* t = static_cast<SsdTable*>(h);
-  std::lock_guard<std::mutex> sg(t->save_mu);
+  std::lock_guard<std::mutex> sg(t->save_mu);  // LOCK: ssd_save_mu
   const TableNativeConfig& c = t->mem->cfg;
   int32_t fd = t->fdim;
   int32_t ed = pstpu::rule_state_dim(c.embed_rule, 1);
@@ -853,8 +858,8 @@ int64_t sst_save_file(void* h, const char* path, int32_t mode,
   for (size_t s = 0; io_ok && s < t->mem->shards.size(); ++s) {
     Shard* sh = t->mem->shards[s];
     DiskShard* d = t->disk[s];
-    std::lock_guard<std::mutex> g1(sh->mu);
-    std::lock_guard<std::mutex> g2(d->mu);
+    std::lock_guard<std::mutex> g1(sh->mu);  // LOCK: shard_mu
+    std::lock_guard<std::mutex> g2(d->mu);  // LOCK: disk_mu
     std::vector<float> row(fd);
     for (uint64_t hh = 0; io_ok && hh <= sh->mask; ++hh) {
       int32_t r = sh->slot_state[hh];

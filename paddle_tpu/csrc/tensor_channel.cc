@@ -15,6 +15,13 @@
 // from all connections merge into one MPMC queue (multiple upstream
 // workers, multiple downstream consumers — HeterSectionWorker
 // concurrency). All blocking ops honor a timeout.
+//
+// Lock hierarchy (checked by tools/lint/lock_order.py): the queue's mu
+// and the server's conn_mu are LEAF locks — each critical section holds
+// exactly one of them and never acquires the other (the reader thread
+// releases conn_mu before blocking on a queue push). Any future nesting
+// must add a LOCK ORDER decl here and LOCK tags at the sites.
+// LOCK ORDER: conn_mu < queue_mu
 
 #include <arpa/inet.h>
 #include <netinet/in.h>

@@ -157,8 +157,8 @@ class _FleetUtil:
 
         self._store.add("__fleet_util/leave", 1)
         if self._rank == 0:
-            deadline = time.time() + 60.0
-            while time.time() < deadline:
+            deadline = time.perf_counter() + 60.0
+            while time.perf_counter() < deadline:
                 n = int(self._store.get("__fleet_util/leave") or 0)
                 if n >= self._world:
                     break
@@ -382,13 +382,13 @@ class Fleet:
         else:  # wait for worker 0's daemon to come up
             import time as _time
 
-            deadline = _time.time() + 60.0
+            deadline = _time.perf_counter() + 60.0
             while True:
                 try:
                     store = TCPStore(host=host, port=int(port))
                     break
                 except OSError:
-                    if _time.time() > deadline:
+                    if _time.perf_counter() > deadline:
                         raise
                     _time.sleep(0.2)
         self.util._bind(store, rank, world)

@@ -144,11 +144,11 @@ def make_deepwalk_train_step(
         # a decaying rule (Adam) would spuriously advance every step
         live_pair = valid_f > 0
         rows_c = jnp.where(live_pair,
-                           rows_of(np.uint32(CENTER_SLOT), cl_f), C)
+                           rows_of(jnp.uint32(CENTER_SLOT), cl_f), C)
         rows_x = jnp.where(live_pair,
-                           rows_of(np.uint32(CONTEXT_SLOT), xl_f), C)
+                           rows_of(jnp.uint32(CONTEXT_SLOT), xl_f), C)
         rows_n = jnp.where(live_pair[:, None],
-                           rows_of(np.uint32(CONTEXT_SLOT), nl_f), C)
+                           rows_of(jnp.uint32(CONTEXT_SLOT), nl_f), C)
 
         all_rows = jnp.concatenate(
             [rows_c, rows_x, rows_n.reshape(-1)])

@@ -20,6 +20,7 @@ dV = P^T dO, dS = P ∘ (dO V^T - Δ), dQ = dS K, dK = dS^T Q with
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional, Tuple
 
 import jax
@@ -27,6 +28,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+if not hasattr(pltpu, "CompilerParams"):
+    # jax < 0.6 names the pallas params class TPUCompilerParams
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
 
 __all__ = ["flash_attention", "flash_attention_with_lse"]
 
@@ -437,7 +442,7 @@ def _run_padded(q, k, v, causal, q_offset, k_offset, block_q, block_k,
         interpret = not _on_tpu()
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
-    scale = 1.0 / float(np.sqrt(D))
+    scale = 1.0 / math.sqrt(D)
     bq = min(block_q, _round_up(Lq, 8))
     bk = min(block_k, _round_up(Lk, 8))
     Lq_p, Lk_p = _round_up(Lq, bq), _round_up(Lk, bk)

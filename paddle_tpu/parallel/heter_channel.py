@@ -161,13 +161,13 @@ class ChannelClient:
 
     def __init__(self, host: str, port: int, connect_timeout: float = 60.0) -> None:
         self._lib = _lib()
-        deadline = time.time() + connect_timeout
+        deadline = time.perf_counter() + connect_timeout
         self._h = None
         while True:
             self._h = self._lib.tch_connect(host.encode(), port)
             if self._h:
                 break
-            if time.time() > deadline:
+            if time.perf_counter() > deadline:
                 raise PreconditionNotMetError(
                     f"cannot connect channel to {host}:{port}")
             time.sleep(0.2)

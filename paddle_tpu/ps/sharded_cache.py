@@ -48,6 +48,7 @@ expressed as a row permutation instead of a hash).
 
 from __future__ import annotations
 
+import math
 from typing import Callable, Dict, Optional, Tuple, Union
 
 import jax
@@ -97,7 +98,7 @@ def route_bucket_capacity(m: int, K: int, cap_factor: float = 2.0) -> int:
     8-lane sublane for TPU layouts. With host-side `shard_spread_rows`
     round-robin placement and pre-dedup, per-bucket load is a tight
     binomial around m/K — factor 2 is ~100σ at production batch sizes."""
-    cap = int(np.ceil(cap_factor * m / K)) + 8
+    cap = math.ceil(cap_factor * m / K) + 8
     cap = (cap + 7) // 8 * 8
     return min(m, cap)
 

@@ -17,7 +17,15 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # older jax: the XLA_FLAGS fallback above provides the 8 devices
+
+# jax < 0.5: paddle_tpu installs compat shims (jax.shard_map with
+# check_vma translation, lax.axis_size) on import — pull them in before
+# any test module does `from jax import shard_map`
+import paddle_tpu  # noqa: E402,F401
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,397 @@
+"""graftlint self-tests: every rule in both directions (fires on the
+violation fixture, stays quiet on the clean one), allowlist filtering,
+and the run.py gate on the real tree."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(REPO, "tools", "lint"))
+
+import conventions  # noqa: E402
+import lock_order  # noqa: E402
+import tracer_safety  # noqa: E402
+from common import load_allowlist, split_new_and_allowed  # noqa: E402
+
+
+def _tracer_diags(tmp_path, source):
+    pkg = tmp_path / "paddle_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(source))
+    return tracer_safety.run(str(tmp_path))
+
+
+def _rules(diags):
+    return {d.rule for d in diags}
+
+
+# -- tracer-safety ----------------------------------------------------------
+
+def test_host_sync_in_jit_flagged(tmp_path):
+    diags = _tracer_diags(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.item()
+    """)
+    assert _rules(diags) == {"host-sync-item"}
+    assert diags[0].path == "paddle_tpu/mod.py"
+    assert diags[0].line == 6
+
+
+def test_host_sync_outside_jit_not_flagged(tmp_path):
+    diags = _tracer_diags(tmp_path, """
+        def host_helper(x):
+            return x.item()
+    """)
+    assert diags == []
+
+
+def test_numpy_call_in_traced_callee_flagged(tmp_path):
+    # reachability: the violation is in a helper CALLED from jitted code
+    diags = _tracer_diags(tmp_path, """
+        import jax
+        import numpy as np
+
+        def helper(x):
+            return np.asarray(x)
+
+        @jax.jit
+        def step(x):
+            return helper(x)
+    """)
+    assert _rules(diags) == {"host-sync-np"}
+
+
+def test_shard_map_callsite_wrap_flagged(tmp_path):
+    diags = _tracer_diags(tmp_path, """
+        import jax
+        from jax import shard_map
+
+        def make(mesh):
+            def inner(x):
+                jax.device_get(x)
+                return x
+            return jax.jit(shard_map(inner, mesh=mesh))
+    """)
+    assert _rules(diags) == {"host-sync-device-get"}
+
+
+def test_tracer_branch_and_block_flagged(tmp_path):
+    diags = _tracer_diags(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            if jnp.any(x > 0):
+                x = x + 1
+            x.block_until_ready()
+            return x
+    """)
+    assert _rules(diags) == {"tracer-branch", "host-sync-block"}
+
+
+def test_float_cast_on_param_flagged_shape_exempt(tmp_path):
+    diags = _tracer_diags(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            n = int(x.shape[0])   # static: fine
+            return x * float(x)   # concretizes: flagged
+    """)
+    assert _rules(diags) == {"host-float-cast"}
+    assert all(d.line == 7 for d in diags)
+
+
+def test_float_cast_on_derived_value_flagged(tmp_path):
+    # taint flows through local assignments, not just direct params
+    diags = _tracer_diags(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            y = x * 2
+            return float(y)
+    """)
+    assert _rules(diags) == {"host-float-cast"}
+    assert [d.line for d in diags] == [7]
+
+
+def test_branch_on_param_compare_flagged_config_exempt(tmp_path):
+    # `if x > 0` is the canonical TracerBoolConversionError; string
+    # equality / is-tests / bare truthiness are static config dispatch
+    diags = _tracer_diags(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(x, mode="sum", flag=True, opt=None):
+            if mode == "sum":      # static config: fine
+                x = x + 1
+            if opt is None:        # static config: fine
+                x = x + 2
+            if flag:               # bare truthiness: fine
+                x = x + 3
+            y = x - 1
+            if y > 0:              # tracer compare: flagged
+                x = x + 4
+            return x
+    """)
+    assert _rules(diags) == {"tracer-branch"}
+    assert [d.line for d in diags] == [13]
+
+
+def test_host_print_flagged_only_inside_trace(tmp_path):
+    diags = _tracer_diags(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            print("debug", x)
+            return x
+
+        def host_log(x):
+            print("fine here", x)
+    """)
+    assert _rules(diags) == {"host-print"}
+    assert [d.line for d in diags] == [6]
+
+
+def test_global_mutation_flagged(tmp_path):
+    diags = _tracer_diags(tmp_path, """
+        import jax
+        _CALLS = 0
+
+        @jax.jit
+        def step(x):
+            global _CALLS
+            _CALLS += 1
+            return x
+    """)
+    assert _rules(diags) == {"global-mutation"}
+
+
+def test_ignore_comment_suppresses(tmp_path):
+    diags = _tracer_diags(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x.item()  # graftlint: ignore[host-sync-item]
+    """)
+    assert diags == []
+
+
+def test_traced_comment_marks_root(tmp_path):
+    diags = _tracer_diags(tmp_path, """
+        # graftlint: traced
+        def bench_hot_path(x):
+            return x.item()
+    """)
+    assert _rules(diags) == {"host-sync-item"}
+
+
+# -- lock-order -------------------------------------------------------------
+
+def _lock_diags(tmp_path, source, name="fixture.cc"):
+    d = tmp_path / "paddle_tpu" / "csrc"
+    d.mkdir(parents=True)
+    (d / name).write_text(textwrap.dedent(source))
+    return lock_order.run(str(tmp_path))
+
+
+GOOD_CC = """
+    // LOCK ORDER: outer_mu < inner_mu
+    void f(T* t) {
+      std::lock_guard<std::mutex> a(t->mu);  // LOCK: outer_mu
+      std::lock_guard<std::mutex> b(t->sub->mu);  // LOCK: inner_mu
+    }
+"""
+
+
+def test_lock_order_clean_file_passes(tmp_path):
+    assert _lock_diags(tmp_path, GOOD_CC) == []
+
+
+def test_lock_order_inversion_flagged(tmp_path):
+    diags = _lock_diags(tmp_path, """
+        // LOCK ORDER: outer_mu < inner_mu
+        void f(T* t) {
+          std::lock_guard<std::mutex> b(t->sub->mu);  // LOCK: inner_mu
+          std::lock_guard<std::mutex> a(t->mu);  // LOCK: outer_mu
+        }
+    """)
+    assert _rules(diags) == {"lock-order"}
+
+
+def test_lock_order_cycle_flagged(tmp_path):
+    diags = _lock_diags(tmp_path, """
+        // LOCK ORDER: a_mu < b_mu
+        // LOCK ORDER: b_mu < a_mu
+        void f() {}
+    """)
+    assert _rules(diags) == {"lock-order-cycle"}
+
+
+def test_unannotated_nesting_flagged(tmp_path):
+    diags = _lock_diags(tmp_path, """
+        void f(T* t) {
+          std::lock_guard<std::mutex> a(t->mu);
+          std::lock_guard<std::mutex> b(t->other_mu);
+        }
+    """)
+    assert _rules(diags) == {"lock-unannotated"}
+
+
+def test_scoped_guard_released_before_second_lock(tmp_path):
+    # the ps_service.cc kSaveAll pattern: registry lock scoped out
+    # before the per-table lock — NOT nested
+    diags = _lock_diags(tmp_path, """
+        void f(T* t) {
+          std::mutex* save_mu;
+          {
+            std::lock_guard<std::mutex> g(t->tables_mu);
+            save_mu = t->lookup();
+          }
+          std::lock_guard<std::mutex> sg(*save_mu);
+        }
+    """)
+    assert diags == []
+
+
+def test_real_csrc_tree_is_clean():
+    assert lock_order.run(REPO) == []
+
+
+# -- conventions ------------------------------------------------------------
+
+def _conv_diags(tmp_path, source, fname="paddle_tpu/mod.py"):
+    p = tmp_path / fname
+    p.parent.mkdir(parents=True, exist_ok=True)
+    if fname.startswith("paddle_tpu"):
+        init = tmp_path / "paddle_tpu" / "__init__.py"
+        if not init.exists():
+            init.write_text("")
+    (tmp_path / "tools").mkdir(exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    return conventions.run(str(tmp_path))
+
+
+def test_time_time_flagged_perf_counter_ok(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        import time
+
+        def measure(fn):
+            t0 = time.perf_counter()   # fine
+            fn()
+            wall = time.time()         # flagged
+            return time.perf_counter() - t0, wall
+    """)
+    assert [d.rule for d in diags] == ["time-time"]
+    assert diags[0].line == 7
+
+
+def test_from_time_import_time_flagged(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        from time import time as now
+
+        def deadline():
+            return now() + 60
+    """)
+    assert [d.rule for d in diags] == ["time-time"]
+    assert diags[0].line == 5
+
+
+def test_conventions_tolerates_missing_tools_dir(tmp_path):
+    pkg = tmp_path / "paddle_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    assert conventions.run(str(tmp_path)) == []
+
+
+def test_bare_except_and_mutable_default_flagged(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        def f(xs=[], opts=None):
+            try:
+                return xs
+            except:
+                return None
+    """)
+    assert _rules(diags) == {"bare-except", "mutable-default"}
+
+
+def test_env_read_outside_config_flagged(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        import os
+        PORT = os.environ.get("MY_PORT")
+        HOST = os.environ["MY_HOST"]
+        DBG = os.getenv("DBG")
+    """)
+    assert [d.rule for d in diags] == ["env-read"] * 3
+
+
+def test_env_read_in_config_module_ok(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        import os
+        PORT = os.environ.get("MY_PORT")
+    """, fname="paddle_tpu/core/flags.py")
+    assert diags == []
+
+
+# -- allowlist + driver -----------------------------------------------------
+
+def test_allowlist_filters_and_reports_stale(tmp_path):
+    from common import Diagnostic
+    allow = tmp_path / "allow.txt"
+    allow.write_text(
+        "a/b.py:3:time-time  # wall timestamp\n"
+        "gone.py:1:bare-except  # removed long ago\n")
+    entries = load_allowlist(str(allow))
+    diags = [Diagnostic("a/b.py", 3, "time-time", "m"),
+             Diagnostic("a/b.py", 9, "time-time", "m")]
+    new, allowed, stale = split_new_and_allowed(diags, entries)
+    assert [d.line for d in new] == [9]
+    assert [d.line for d in allowed] == [3]
+    assert stale == ["gone.py:1:bare-except"]
+
+
+def test_allowlist_requires_justification(tmp_path):
+    allow = tmp_path / "allow.txt"
+    allow.write_text("a/b.py:3:time-time\n")
+    with pytest.raises(ValueError, match="justification"):
+        load_allowlist(str(allow))
+
+
+def test_run_py_green_on_tree_and_red_on_violation(tmp_path):
+    # the committed tree must gate green
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint", "run.py"),
+         "--json", str(tmp_path / "s.json")],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+    summary = json.loads((tmp_path / "s.json").read_text())
+    assert summary["new"] == 0
+    assert set(summary["per_pass"]) == {
+        "tracer_safety", "lock_order", "conventions"}
+
+    # an injected violation must turn the gate red with file:line:rule
+    bad = tmp_path / "tree" / "paddle_tpu"
+    bad.mkdir(parents=True)
+    (bad / "__init__.py").write_text("")
+    (bad / "hot.py").write_text(
+        "import jax\n\n@jax.jit\ndef step(x):\n    return x.item()\n")
+    (tmp_path / "tree" / "tools").mkdir()
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint", "run.py"),
+         "--root", str(tmp_path / "tree")],
+        capture_output=True, text=True)
+    assert out.returncode == 1
+    assert "paddle_tpu/hot.py:5: [host-sync-item]" in out.stdout

@@ -1,0 +1,123 @@
+"""Shared graftlint infrastructure: diagnostics, allowlist, file walking.
+
+A diagnostic is (path, line, rule, message) with ``path`` repo-relative
+and '/'-separated. The allowlist (tools/lint/allow.txt) grandfathers
+known sites one `path:line:rule` per entry; the gate is "no NEW
+violations", so a diagnostic is only fatal if its exact key is absent.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+IGNORE_RE = re.compile(r"#\s*graftlint:\s*ignore\[([\w,\- ]+)\]")
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def line_ignores(source_lines: List[str], lineno: int) -> Set[str]:
+    """Rules suppressed by a `# graftlint: ignore[...]` on this line."""
+    if 1 <= lineno <= len(source_lines):
+        m = IGNORE_RE.search(source_lines[lineno - 1])
+        if m:
+            return {r.strip() for r in m.group(1).split(",")}
+    return set()
+
+
+@dataclass(frozen=True)
+class Diagnostic:
+    path: str   # repo-relative, '/'-separated
+    line: int
+    rule: str
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}:{self.line}:{self.rule}"
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def relpath(path: str, root: str = REPO_ROOT) -> str:
+    return os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+
+
+def walk_py(root: str, subdirs: Iterable[str], files: Iterable[str] = ()
+            ) -> List[str]:
+    """All .py files under root/<subdir> for each subdir, plus explicit
+    root-relative ``files``, absolute paths, sorted."""
+    out = []
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    for f in files:
+        p = os.path.join(root, f)
+        if os.path.exists(p):
+            out.append(p)
+    return sorted(out)
+
+
+def load_allowlist(path: str) -> Dict[str, int]:
+    """Parse allow.txt → {key: line_number_in_allowlist}.
+
+    Entry grammar (one per line): ``path:line:rule`` followed by an
+    optional ``# justification`` comment. Blank lines and full-line
+    comments are skipped. A justification is REQUIRED on every entry
+    (enforced here) so the file stays reviewable.
+    """
+    entries: Dict[str, int] = {}
+    if not os.path.exists(path):
+        return entries
+    with open(path, encoding="utf-8") as f:
+        for i, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            entry, sep, comment = line.partition("#")
+            entry = entry.strip()
+            if not sep or not comment.strip():
+                raise ValueError(
+                    f"{path}:{i}: allowlist entry needs a '# justification' "
+                    f"comment: {line!r}")
+            parts = entry.rsplit(":", 2)
+            if len(parts) != 3 or not parts[1].isdigit():
+                raise ValueError(
+                    f"{path}:{i}: malformed entry {entry!r} "
+                    "(want path:line:rule)")
+            entries[entry] = i
+    return entries
+
+
+def split_new_and_allowed(
+    diags: List[Diagnostic], allow: Dict[str, int]
+) -> Tuple[List[Diagnostic], List[Diagnostic], List[str]]:
+    """Partition into (new, allowlisted) and report stale allow entries."""
+    new, allowed = [], []
+    seen = set()
+    for d in diags:
+        seen.add(d.key)
+        (allowed if d.key in allow else new).append(d)
+    stale = sorted(k for k in allow if k not in seen)
+    return new, allowed, stale

@@ -1,0 +1,138 @@
+"""graftlint pass 3: project conventions for the Python layer.
+
+  time-time        ``time.time()`` — wall clock steps on NTP slew and is
+                   the wrong tool for measuring latency; use
+                   ``time.perf_counter()`` for durations/deadlines.
+                   Genuine wall-clock timestamps (heartbeat payloads,
+                   checkpoint metadata) go in the allowlist with a
+                   justification.
+  bare-except      ``except:`` swallows KeyboardInterrupt/SystemExit;
+                   name the exception (or ``except Exception``).
+  mutable-default  mutable default argument (list/dict/set literal or
+                   constructor) — shared across calls.
+  env-read         ``os.environ`` / ``os.getenv`` read outside the
+                   config modules (core/flags.py, ps/config.py,
+                   distributed bootstrap). Env reads scattered through
+                   library code make runs irreproducible; route them
+                   through flags.
+
+Scope: ``paddle_tpu/`` and ``bench.py`` for all rules; ``tools/`` for
+time-time only (demo drivers legitimately read their own env knobs).
+Suppression: trailing ``# graftlint: ignore[rule]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Set
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from common import (Diagnostic, dotted, line_ignores,  # noqa: E402
+                    relpath, walk_py)
+
+# modules whose job is reading process-level configuration
+ENV_READ_OK = {
+    "paddle_tpu/core/flags.py",       # the flags registry itself
+    "paddle_tpu/ps/config.py",        # PS table config
+    "paddle_tpu/distributed/role_maker.py",   # PADDLE_* bootstrap env
+    "paddle_tpu/distributed/launch.py",       # launcher materializes env
+    "bench.py",                               # driver owns its BENCH_* knobs
+}
+
+_MUTABLE_CTORS = {"list", "dict", "set"}
+
+
+def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+    rel = relpath(path, root)
+    lines = src.splitlines()
+    diags: List[Diagnostic] = []
+
+    def emit(node: ast.AST, rule: str, msg: str) -> None:
+        if rule in rules and rule not in line_ignores(lines, node.lineno):
+            diags.append(Diagnostic(rel, node.lineno, rule, msg))
+
+    # names that call the wall clock: `time.time` via any module alias
+    # (`import time as _time`), plus bare aliases of
+    # `from time import time [as now]`
+    time_mod_aliases = {"time"}
+    time_func_aliases: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    time_mod_aliases.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "time" and not node.level:
+                for a in node.names:
+                    if a.name == "time":
+                        time_func_aliases.add(a.asname or "time")
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            is_wall_clock = name in time_func_aliases
+            if name and "." in name:
+                mod, _, attr = name.rpartition(".")
+                is_wall_clock |= mod in time_mod_aliases and attr == "time"
+            if is_wall_clock:
+                emit(node, "time-time",
+                     "time.time() measures wall clock — use "
+                     "time.perf_counter() for durations/deadlines "
+                     "(allowlist genuine timestamps)")
+            if name in ("os.environ.get", "os.getenv") and \
+                    rel not in ENV_READ_OK:
+                emit(node, "env-read",
+                     f"`{name}` outside config modules — route through "
+                     "core.flags / ps.config")
+        elif isinstance(node, ast.Subscript):
+            if dotted(node.value) == "os.environ" and \
+                    isinstance(node.ctx, ast.Load) and rel not in ENV_READ_OK:
+                emit(node, "env-read",
+                     "`os.environ[...]` read outside config modules — "
+                     "route through core.flags / ps.config")
+        elif isinstance(node, ast.ExceptHandler):
+            if node.type is None:
+                emit(node, "bare-except",
+                     "bare `except:` catches KeyboardInterrupt/SystemExit "
+                     "— use `except Exception` or narrower")
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in (node.args.defaults + node.args.kw_defaults):
+                if default is None:
+                    continue
+                bad = isinstance(default, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(default, ast.Call)
+                    and dotted(default.func) in _MUTABLE_CTORS
+                    and not default.args and not default.keywords)
+                if bad:
+                    emit(default, "mutable-default",
+                         f"mutable default argument in `{node.name}()` is "
+                         "shared across calls — default to None")
+    return diags
+
+
+def run(root: str) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    all_rules = {"time-time", "bare-except", "mutable-default", "env-read"}
+    for p in walk_py(root, ("paddle_tpu",), ("bench.py",)):
+        diags.extend(check_file(p, root, all_rules))
+    tools_dir = os.path.join(root, "tools")
+    tool_files = sorted(os.listdir(tools_dir)) if os.path.isdir(tools_dir) \
+        else []
+    for p in walk_py(root, (), tuple(
+            f"tools/{f}" for f in tool_files if f.endswith(".py"))):
+        diags.extend(check_file(p, root, {"time-time"}))
+    return sorted(diags, key=lambda d: (d.path, d.line, d.rule))
+
+
+if __name__ == "__main__":
+    from common import REPO_ROOT
+    for d in run(REPO_ROOT):
+        print(d)

@@ -1,0 +1,243 @@
+"""graftlint pass 2: static lock-order checking for csrc/*.cc.
+
+Grammar (see docs/STATIC_ANALYSIS.md):
+
+  // LOCK ORDER: a < b < c     declares a partial order over lock names
+                               (anywhere in the file; decls merge)
+  // LOCK: name                trailing comment on an acquisition line,
+                               naming the lock being acquired
+
+Acquisitions are RAII guards (``std::lock_guard`` / ``unique_lock`` /
+``shared_lock`` / ``scoped_lock``). A guard's scope is tracked by brace
+depth: it is held until its enclosing block closes. When a guard is
+acquired while another is held, that is NESTED locking and both locks
+must be (a) named — via ``// LOCK:`` tag or an unambiguous default (the
+final member segment of the mutex expression, ``t->save_mu`` →
+``save_mu``) — and (b) ordered outer < inner by the declared partial
+order. Rules:
+
+  lock-order-cycle   the declared order itself has a cycle
+  lock-unannotated   nested acquisition whose lock name is not in the
+                     declared order (add a LOCK ORDER decl / LOCK tag)
+  lock-order         nested acquisition that contradicts the declared
+                     order (inner not reachable from outer)
+
+This is a textual single-translation-unit analysis: it sees lexical
+nesting inside one function body, not inter-procedural chains — the
+annotations plus the TSAN sweep cover the rest.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+from typing import Dict, List, Set, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from common import Diagnostic, relpath  # noqa: E402
+
+_ORDER_RE = re.compile(r"//\s*LOCK ORDER:\s*(.+)$")
+_TAG_RE = re.compile(r"//\s*LOCK:\s*(\w+)")
+_GUARD_RE = re.compile(
+    r"std::(lock_guard|unique_lock|shared_lock|scoped_lock)\s*"
+    r"(?:<[^>]*>)?\s+\w+\s*[({]([^;{}]*)[)}]\s*;")
+
+
+def _default_name(expr: str) -> str:
+    """`t->shards[s]->mu` → `mu`; `*save_mu` → `save_mu`."""
+    expr = expr.split(",")[0].strip().lstrip("*&")
+    expr = re.sub(r"\[[^\]]*\]", "", expr)
+    expr = re.sub(r"\([^)]*\)", "", expr)
+    for sep in ("->", "."):
+        expr = expr.split(sep)[-1] if sep in expr else expr
+    return expr.strip()
+
+
+def _parse_order(lines: List[str], path: str) -> Tuple[
+        Dict[str, Set[str]], List[Diagnostic]]:
+    """Declared edges {a: {b,...}} meaning a < b, + syntax diagnostics."""
+    edges: Dict[str, Set[str]] = {}
+    diags: List[Diagnostic] = []
+    for i, line in enumerate(lines, 1):
+        m = _ORDER_RE.search(line)
+        if not m:
+            continue
+        names = [n.strip() for n in m.group(1).split("<")]
+        if len(names) < 2 or not all(re.fullmatch(r"\w+", n) for n in names):
+            diags.append(Diagnostic(path, i, "lock-order-syntax",
+                                    f"malformed LOCK ORDER decl: "
+                                    f"{m.group(1).strip()!r} "
+                                    "(want `a < b [< c ...]`)"))
+            continue
+        for a, b in zip(names, names[1:]):
+            edges.setdefault(a, set()).add(b)
+            edges.setdefault(b, set())
+    return edges, diags
+
+
+def _find_cycle(edges: Dict[str, Set[str]]) -> List[str]:
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in edges}
+    stack: List[str] = []
+
+    def dfs(n: str):
+        color[n] = GRAY
+        stack.append(n)
+        for m in sorted(edges.get(n, ())):
+            if color[m] == GRAY:
+                return stack[stack.index(m):] + [m]
+            if color[m] == WHITE:
+                cyc = dfs(m)
+                if cyc:
+                    return cyc
+        color[n] = BLACK
+        stack.pop()
+        return None
+
+    for n in sorted(edges):
+        if color[n] == WHITE:
+            cyc = dfs(n)
+            if cyc:
+                return cyc
+    return []
+
+
+def _reachable(edges: Dict[str, Set[str]], a: str, b: str) -> bool:
+    seen, work = set(), [a]
+    while work:
+        n = work.pop()
+        if n == b:
+            return True
+        if n in seen:
+            continue
+        seen.add(n)
+        work.extend(edges.get(n, ()))
+    return False
+
+
+def _strip_comments_keep_lines(src: str) -> str:
+    """Remove /*...*/ and //... and string/char literals, preserving
+    newlines so line numbers survive."""
+    out = []
+    i, n = 0, len(src)
+    while i < n:
+        c = src[i]
+        if c == "/" and i + 1 < n and src[i + 1] == "/":
+            j = src.find("\n", i)
+            i = n if j < 0 else j
+        elif c == "/" and i + 1 < n and src[i + 1] == "*":
+            j = src.find("*/", i + 2)
+            seg = src[i:(n if j < 0 else j + 2)]
+            out.append("\n" * seg.count("\n"))
+            i = n if j < 0 else j + 2
+        elif c in "\"'":
+            q, j = c, i + 1
+            while j < n and src[j] != q:
+                j += 2 if src[j] == "\\" else 1
+            out.append(" ")
+            i = min(j + 1, n)
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def check_file(path: str, root: str) -> List[Diagnostic]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    rel = relpath(path, root)
+    raw_lines = src.splitlines()
+    edges, diags = _parse_order(raw_lines, rel)
+
+    cyc = _find_cycle(edges)
+    if cyc:
+        diags.append(Diagnostic(rel, 1, "lock-order-cycle",
+                                "declared LOCK ORDER has a cycle: "
+                                + " < ".join(cyc)))
+        return diags
+
+    code = _strip_comments_keep_lines(src)
+    # events (offset-ordered): every guard acquisition and every brace,
+    # so guard scopes follow real lexical block structure
+    acquisitions = []  # (offset, lineno, kind, mutex_exprs, tag_name)
+    line_starts = [0]
+    for i, c in enumerate(code):
+        if c == "\n":
+            line_starts.append(i + 1)
+
+    def line_of(off: int) -> int:
+        lo, hi = 0, len(line_starts) - 1
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            if line_starts[mid] <= off:
+                lo = mid
+            else:
+                hi = mid - 1
+        return lo + 1
+
+    for m in _GUARD_RE.finditer(code):
+        lineno = line_of(m.start())
+        raw = raw_lines[lineno - 1] if lineno <= len(raw_lines) else ""
+        tag = _TAG_RE.search(raw)
+        acquisitions.append((m.start(), lineno, m.group(1),
+                             m.group(2).split(","),
+                             tag.group(1) if tag else None))
+
+    depth = 0
+    held: List[Tuple[str, int, int]] = []  # (name, depth_at_acq, line)
+    ai = 0
+    for off, c in enumerate(code):
+        while ai < len(acquisitions) and acquisitions[ai][0] == off:
+            _, lineno, kind, exprs, tag_name = acquisitions[ai]
+            ai += 1
+            # scoped_lock(a, b, ...) locks all deadlock-free; others take
+            # the mutex as first arg (later args are lock-policy tags)
+            mutexes = exprs if kind == "scoped_lock" else exprs[:1]
+            for k, me in enumerate(mutexes):
+                me = me.strip()
+                if not me or me in ("std::defer_lock", "std::adopt_lock",
+                                    "std::try_to_lock"):
+                    continue
+                name = tag_name if (tag_name and k == 0) else _default_name(me)
+                atomic_peer = kind == "scoped_lock" and k > 0
+                for hname, _, hline in held:
+                    if atomic_peer:
+                        continue
+                    if hname not in edges or name not in edges:
+                        missing = name if name not in edges else hname
+                        diags.append(Diagnostic(
+                            rel, lineno, "lock-unannotated",
+                            f"nested acquisition of `{name}` while "
+                            f"`{hname}` held (line {hline}) but "
+                            f"`{missing}` is not in any LOCK ORDER decl"))
+                    elif not _reachable(edges, hname, name):
+                        diags.append(Diagnostic(
+                            rel, lineno, "lock-order",
+                            f"acquires `{name}` while holding `{hname}` "
+                            f"(line {hline}) — declared order does not "
+                            f"allow {hname} < {name}"))
+                held.append((name, depth, lineno))
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            held = [h for h in held if h[1] <= depth]
+    return diags
+
+
+def run(root: str, subdir: str = "paddle_tpu/csrc") -> List[Diagnostic]:
+    base = os.path.join(root, subdir)
+    diags: List[Diagnostic] = []
+    if not os.path.isdir(base):
+        return diags
+    for fn in sorted(os.listdir(base)):
+        if fn.endswith((".cc", ".h")):
+            diags.extend(check_file(os.path.join(base, fn), root))
+    return sorted(diags, key=lambda d: (d.path, d.line, d.rule))
+
+
+if __name__ == "__main__":
+    from common import REPO_ROOT
+    for d in run(REPO_ROOT):
+        print(d)

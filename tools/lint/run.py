@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""graftlint driver: run all three passes, apply the allowlist, report.
+
+Usage:
+  python tools/lint/run.py              # gate: exit 1 on NEW violations
+  python tools/lint/run.py --json F    # also write machine-readable summary
+  python tools/lint/run.py --all       # show allowlisted hits too (for
+                                       # regenerating/pruning allow.txt)
+
+Diagnostics print as `path:line: [rule] message`. The allowlist
+(tools/lint/allow.txt) grandfathers existing sites; stale entries (no
+longer firing) are reported as warnings so the file shrinks over time —
+they do not fail the gate (line drift would otherwise make every
+refactor red).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import conventions  # noqa: E402
+import lock_order  # noqa: E402
+import tracer_safety  # noqa: E402
+from common import (REPO_ROOT, load_allowlist,  # noqa: E402
+                    split_new_and_allowed)
+
+ALLOW_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "allow.txt")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="graftlint driver")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="write a machine-readable JSON summary")
+    ap.add_argument("--all", action="store_true",
+                    help="also print allowlisted diagnostics")
+    ap.add_argument("--root", default=REPO_ROOT, help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    passes = {
+        "tracer_safety": tracer_safety.run,
+        "lock_order": lock_order.run,
+        "conventions": conventions.run,
+    }
+    diags = []
+    per_pass = {}
+    for name, fn in passes.items():
+        got = fn(args.root)
+        per_pass[name] = len(got)
+        diags.extend(got)
+
+    allow = load_allowlist(ALLOW_PATH)
+    new, allowed, stale = split_new_and_allowed(diags, allow)
+
+    for d in new:
+        print(d)
+    if args.all:
+        for d in allowed:
+            print(f"{d}  [allowlisted]")
+    for key in stale:
+        print(f"warning: stale allowlist entry (no longer fires): {key}",
+              file=sys.stderr)
+
+    summary = {
+        "total": len(diags),
+        "new": len(new),
+        "allowlisted": len(allowed),
+        "stale_allowlist_entries": stale,
+        "per_pass": per_pass,
+        "violations": [
+            {"path": d.path, "line": d.line, "rule": d.rule,
+             "message": d.message, "allowlisted": d.key in allow}
+            for d in diags
+        ],
+    }
+    if args.json:
+        with open(args.json, "w", encoding="utf-8") as f:
+            json.dump(summary, f, indent=2)
+
+    if new:
+        print(f"\ngraftlint: {len(new)} new violation(s) "
+              f"({len(allowed)} allowlisted). Fix them, or — for cold/debug "
+              "paths only — add `path:line:rule  # justification` to "
+              "tools/lint/allow.txt (see docs/STATIC_ANALYSIS.md).",
+              file=sys.stderr)
+        return 1
+    print(f"graftlint OK: 0 new violations "
+          f"({len(allowed)} allowlisted, {len(stale)} stale entries)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

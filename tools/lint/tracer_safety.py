@@ -1,0 +1,456 @@
+"""graftlint pass 1: tracer safety — no host syncs inside traced code.
+
+Walks every module under ``paddle_tpu/`` (plus ``bench.py``), resolves
+the set of functions REACHABLE from tracing entry points (``jax.jit`` /
+``pjit`` / ``shard_map`` decorators or call-site wraps, ``lax.scan`` /
+``cond`` / ``while_loop`` bodies, ``grad`` / ``value_and_grad`` /
+``vmap`` / ``pmap`` targets), and flags operations that force a device →
+host sync or a trace-time side effect inside that set:
+
+  host-sync-item        ``.item()`` / ``.tolist()`` on a value
+  host-sync-block       ``.block_until_ready()``
+  host-sync-device-get  ``jax.device_get(...)``
+  host-sync-np          ``np.asarray`` / ``np.array`` / ``np.ceil`` … —
+                        any call into the host numpy module
+  host-float-cast       ``float(x)`` / ``int(x)`` / ``bool(x)`` where x
+                        is (derived by local assignment from) a traced
+                        -function parameter or a ``jnp``/``lax``
+                        expression; ``.shape`` / ``.ndim`` / ``.dtype``
+                        / ``len()`` chains are static and exempt, as are
+                        results of opaque (non-jnp) helper calls
+  tracer-branch         ``if``/``while`` on a ``jnp``/``lax`` expression
+                        or an order/eq comparison of a param-derived
+                        value (a concretization error or a silent host
+                        sync); string-literal equality, ``is``/``in``
+                        tests and bare param truthiness are treated as
+                        static config dispatch and exempt
+  global-mutation       ``global`` declaration inside traced code
+  host-print            ``print()`` inside traced code (trace-time side
+                        effect: fires once per compile, not per step)
+
+Resolution is intentionally syntactic (same-module name lookup +
+``from x import y`` aliases + ``self.method``); it is precise enough for
+this tree and fails open (unresolvable callees are skipped, not
+guessed). Suppression: a trailing ``# graftlint: ignore[rule]`` comment
+skips that line; a ``# graftlint: traced`` comment on the line above a
+``def`` marks an extra traced root (for hot paths invoked by drivers
+the linter cannot see, e.g. registered bench step builders).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from common import (Diagnostic, dotted, line_ignores,  # noqa: E402
+                    relpath, walk_py)
+
+# Callables whose function-valued arguments are traced by JAX.
+TRACE_WRAPPERS = {
+    "jit", "jax.jit", "pjit", "jax.pjit",
+    "shard_map", "jax.shard_map", "jax.experimental.shard_map.shard_map",
+    "vmap", "jax.vmap", "pmap", "jax.pmap",
+    "grad", "jax.grad", "value_and_grad", "jax.value_and_grad",
+    "checkpoint", "jax.checkpoint", "remat", "jax.remat",
+    "lax.scan", "jax.lax.scan", "lax.cond", "jax.lax.cond",
+    "lax.while_loop", "jax.lax.while_loop",
+    "lax.fori_loop", "jax.lax.fori_loop",
+    "lax.switch", "jax.lax.switch", "lax.map", "jax.lax.map",
+    "lax.associative_scan", "jax.lax.associative_scan",
+}
+PARTIAL_NAMES = {"partial", "functools.partial"}
+NUMPY_MODULES = {"numpy"}
+JNP_PREFIXES = ("jnp.", "jax.numpy.", "lax.", "jax.lax.")
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+_TRACED_RE = re.compile(r"#\s*graftlint:\s*traced\b")
+
+
+@dataclass
+class FuncDef:
+    module: str                       # dotted module name
+    path: str                         # repo-relative file path
+    name: str                         # bare name
+    node: ast.AST                     # FunctionDef / AsyncFunctionDef
+    params: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class ModuleInfo:
+    path: str                               # repo-relative
+    modname: str
+    tree: ast.Module
+    source_lines: List[str]
+    # local alias -> fully qualified 'module' or 'module.name' target
+    imports: Dict[str, str] = field(default_factory=dict)
+    # bare function name -> defs (module-level, methods, nested)
+    funcs: Dict[str, List[FuncDef]] = field(default_factory=dict)
+    np_aliases: Set[str] = field(default_factory=set)   # e.g. {'np'}
+    jnp_aliases: Set[str] = field(default_factory=set)  # e.g. {'jnp','lax'}
+
+
+def _modname_for(path: str, root: str) -> str:
+    rel = relpath(path, root)
+    mod = rel[:-3].replace("/", ".")
+    return mod[:-9] if mod.endswith(".__init__") else mod
+
+
+def _collect_module(path: str, root: str) -> Optional[ModuleInfo]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return None
+    mi = ModuleInfo(path=relpath(path, root), modname=_modname_for(path, root),
+                    tree=tree, source_lines=src.splitlines())
+    # base package for level-1 relative imports: a package __init__ is
+    # its own base (`from . import x` in paddle_tpu/__init__.py means
+    # paddle_tpu.x), while for a plain module it is the parent package
+    is_pkg = os.path.basename(path) == "__init__.py"
+    pkg_parts = mi.modname.split(".") if is_pkg \
+        else mi.modname.split(".")[:-1]
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                alias = a.asname or a.name.split(".")[0]
+                target = a.name if a.asname else a.name.split(".")[0]
+                mi.imports[alias] = target
+                if a.name in NUMPY_MODULES:
+                    mi.np_aliases.add(alias)
+                if a.name in ("jax.numpy", "jax.lax"):
+                    mi.jnp_aliases.add(alias)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module is None and node.level == 0:
+                continue
+            if node.level:  # relative import → absolute
+                base = pkg_parts[:len(pkg_parts) - node.level + 1]
+                modname = ".".join(base + ([node.module] if node.module else []))
+            else:
+                modname = node.module
+            for a in node.names:
+                alias = a.asname or a.name
+                mi.imports[alias] = f"{modname}.{a.name}"
+                if modname in ("jax.numpy", "jax.lax", "jax") and \
+                        a.name in ("numpy", "lax"):
+                    mi.jnp_aliases.add(alias)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            args = node.args
+            params = {a.arg for a in
+                      args.posonlyargs + args.args + args.kwonlyargs}
+            if args.vararg:
+                params.add(args.vararg.arg)
+            if args.kwarg:
+                params.add(args.kwarg.arg)
+            params.discard("self")
+            params.discard("cls")
+            fd = FuncDef(module=mi.modname, path=mi.path, name=node.name,
+                         node=node, params=params)
+            mi.funcs.setdefault(node.name, []).append(fd)
+    return mi
+
+
+class _Index:
+    def __init__(self, modules: List[ModuleInfo]):
+        self.by_name: Dict[str, ModuleInfo] = {m.modname: m for m in modules}
+        self.modules = modules
+
+    def resolve_callable(self, mi: ModuleInfo, name: str) -> List[FuncDef]:
+        """Resolve a bare or dotted callable name used in ``mi``."""
+        # bare name defined in this module (any nesting level)
+        if name in mi.funcs:
+            return mi.funcs[name]
+        # imported symbol: alias -> module.symbol
+        target = mi.imports.get(name.split(".")[0])
+        if target is None:
+            return []
+        if "." in name:  # mod_alias.func
+            rest = name.split(".")[1:]
+            target = ".".join([target] + rest[:-1])
+            sym = rest[-1]
+        else:            # from mod import func [as alias]
+            target, _, sym = target.rpartition(".")
+            if not target:
+                return []
+        other = self.by_name.get(target)
+        if other is None:
+            return []
+        return other.funcs.get(sym, [])
+
+
+def _is_trace_wrapper(mi: ModuleInfo, call: ast.Call) -> bool:
+    name = dotted(call.func)
+    if name is None:
+        return False
+    if name in TRACE_WRAPPERS:
+        return True
+    # partial(jax.jit, ...) used as decorator or wrapper
+    if name in PARTIAL_NAMES and call.args:
+        inner = dotted(call.args[0])
+        return inner in TRACE_WRAPPERS
+    # alias resolution: `from jax import jit as j` etc.
+    target = mi.imports.get(name.split(".")[0])
+    if target:
+        full = ".".join([target] + name.split(".")[1:])
+        return full in TRACE_WRAPPERS
+    return False
+
+
+def _traced_roots(mi: ModuleInfo, index: _Index) -> List[FuncDef]:
+    roots: List[FuncDef] = []
+    # decorator-marked and comment-marked defs
+    for defs in mi.funcs.values():
+        for fd in defs:
+            node = fd.node
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and _is_trace_wrapper(mi, dec):
+                    roots.append(fd)
+                elif dotted(dec) in TRACE_WRAPPERS:
+                    roots.append(fd)
+            ln = node.lineno - 2  # line above `def` (0-based)
+            for probe in (ln, ln - len(node.decorator_list)):
+                if 0 <= probe < len(mi.source_lines) and \
+                        _TRACED_RE.search(mi.source_lines[probe]):
+                    roots.append(fd)
+    # call-site wraps: jax.jit(f), shard_map(f, ...), lax.scan(f, ...)
+    for node in ast.walk(mi.tree):
+        if isinstance(node, ast.Call) and _is_trace_wrapper(mi, node):
+            cands = node.args
+            if dotted(node.func) in PARTIAL_NAMES:
+                cands = node.args[1:]
+            for arg in cands:
+                name = dotted(arg)
+                if name:
+                    roots.extend(index.resolve_callable(mi, name))
+    return roots
+
+
+def _callees(mi: ModuleInfo, fd: FuncDef, index: _Index) -> List[FuncDef]:
+    out: List[FuncDef] = []
+    for node in ast.walk(fd.node):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func)
+        if name is None:
+            continue
+        if name.startswith("self."):
+            name = name[len("self."):]
+        out.extend(index.resolve_callable(mi, name))
+        # function-valued args of tracing combinators inside traced code
+        if _is_trace_wrapper(mi, node):
+            for arg in node.args:
+                an = dotted(arg)
+                if an:
+                    out.extend(index.resolve_callable(mi, an))
+    return out
+
+
+def _expr_is_static(node: ast.AST) -> bool:
+    """True for `.shape`/`.ndim`/`.dtype` chains and len() — static at
+    trace time, so casting/branching on them is fine."""
+    if isinstance(node, ast.Subscript):
+        return _expr_is_static(node.value)
+    if isinstance(node, ast.Attribute):
+        return node.attr in STATIC_ATTRS
+    if isinstance(node, ast.Call):
+        return dotted(node.func) == "len"
+    return False
+
+
+def _contains_jnp_call(mi: ModuleInfo, node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = dotted(sub.func)
+            if not name:
+                continue
+            head = name.split(".")[0]
+            if name.startswith(JNP_PREFIXES) or head in mi.jnp_aliases:
+                return True
+    return False
+
+
+def _has_tainted_name(node: ast.AST, tainted: Set[str]) -> bool:
+    """A name from ``tainted`` appears outside a static
+    `.shape`/`.ndim`/`.dtype`/`len()` chain (those are trace-time
+    constants even on tracers, so they don't propagate taint)."""
+    if _expr_is_static(node):
+        return False
+    if isinstance(node, ast.Name):
+        return node.id in tainted
+    return any(_has_tainted_name(c, tainted)
+               for c in ast.iter_child_nodes(node))
+
+
+def _tainted_names(fd: FuncDef) -> Set[str]:
+    """Parameters plus local names (transitively) assigned from them —
+    a syntactic over-approximation of "may hold a tracer"."""
+    tainted = set(fd.params)
+    changed = True
+    while changed:
+        changed = False
+        for node in ast.walk(fd.node):
+            if not isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                continue
+            value = node.value
+            if value is None or not _has_tainted_name(value, tainted):
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                names = [t] if isinstance(t, ast.Name) else [
+                    e for e in ast.walk(t) if isinstance(e, ast.Name)]
+                for n in names:
+                    if n.id not in tainted:
+                        tainted.add(n.id)
+                        changed = True
+    return tainted
+
+
+def _scan_traced_function(mi: ModuleInfo, fd: FuncDef) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+
+    def emit(node: ast.AST, rule: str, msg: str) -> None:
+        line = getattr(node, "lineno", fd.node.lineno)
+        if rule not in line_ignores(mi.source_lines, line):
+            diags.append(Diagnostic(mi.path, line, rule,
+                                    f"{msg} (in traced `{fd.name}`)"))
+
+    own_nested = {n for n in ast.walk(fd.node)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  and n is not fd.node}
+    tainted = _tainted_names(fd)
+
+    def _is_tainted_expr(node: ast.AST) -> bool:
+        """Param-derived without an intervening opaque (non-jnp) call —
+        casting/branching on a helper's return is usually static
+        trace-time math, so don't guess there."""
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and not _expr_is_static(sub):
+                name = dotted(sub.func)
+                head = (name or "").split(".")[0]
+                if not (name and (name.startswith(JNP_PREFIXES)
+                                  or head in mi.jnp_aliases)):
+                    return False  # opaque call: don't guess
+        return _has_tainted_name(node, tainted)
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            if node in own_nested:
+                return  # nested defs are scanned as their own units
+            self.generic_visit(node)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node: ast.Call):
+            name = dotted(node.func)
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                if attr in ("item", "tolist"):
+                    emit(node, "host-sync-item",
+                         f"`.{attr}()` forces a device→host sync")
+                elif attr == "block_until_ready":
+                    emit(node, "host-sync-block",
+                         "`.block_until_ready()` blocks inside traced code")
+            if name:
+                head = name.split(".")[0]
+                if name in ("jax.device_get", "device_get"):
+                    emit(node, "host-sync-device-get",
+                         "`jax.device_get` pulls values to host")
+                elif head in mi.np_aliases:
+                    emit(node, "host-sync-np",
+                         f"host numpy call `{name}` in traced code "
+                         "(use jnp, or hoist to trace-time constants)")
+                elif name in ("float", "int", "bool") and len(node.args) == 1:
+                    arg = node.args[0]
+                    if not _expr_is_static(arg) and (
+                            _contains_jnp_call(mi, arg)
+                            or _is_tainted_expr(arg)):
+                        emit(node, "host-float-cast",
+                             f"`{name}()` on a traced value concretizes "
+                             "(host sync)")
+                elif name == "print":
+                    emit(node, "host-print",
+                         "print() in traced code fires at trace time only")
+            self.generic_visit(node)
+
+        def _branch(self, node, kind):
+            test = node.test
+            if _expr_is_static(test):
+                self.generic_visit(node)
+                return
+            # jnp/lax expression in the test, OR an ORDER/EQ comparison
+            # involving a param-derived value (`if x > 0:` — the
+            # canonical TracerBoolConversionError). NOT flagged: bare
+            # truthiness of a param (`if pre_dedup:`), comparisons
+            # against string literals (`if mode == "sum"`), and
+            # is/in tests — those are static config dispatch, which is
+            # everywhere in traced builders and fine at trace time.
+            cmp_tainted = (
+                isinstance(test, ast.Compare)
+                and not any(isinstance(op, (ast.Is, ast.IsNot, ast.In,
+                                            ast.NotIn))
+                            for op in test.ops)
+                and not any(isinstance(c, ast.Constant)
+                            and isinstance(c.value, str)
+                            for c in [test.left] + test.comparators)
+                and _is_tainted_expr(test))
+            if _contains_jnp_call(mi, test) or cmp_tainted:
+                emit(node, "tracer-branch",
+                     f"`{kind}` on a traced expression — concretization "
+                     "error or silent host sync (use lax.cond/jnp.where)")
+            self.generic_visit(node)
+
+        def visit_If(self, node):
+            self._branch(node, "if")
+
+        def visit_While(self, node):
+            self._branch(node, "while")
+
+        def visit_Global(self, node: ast.Global):
+            emit(node, "global-mutation",
+                 "`global` mutation inside traced code is a trace-time "
+                 "side effect")
+
+    V().visit(fd.node)
+    return diags
+
+
+def run(root: str, subdirs=("paddle_tpu",), files=("bench.py",)
+        ) -> List[Diagnostic]:
+    modules = [m for m in (_collect_module(p, root)
+                           for p in walk_py(root, subdirs, files))
+               if m is not None]
+    index = _Index(modules)
+
+    # seed with roots, then close over the call graph
+    reachable: Dict[int, Tuple[ModuleInfo, FuncDef]] = {}
+    work: List[Tuple[ModuleInfo, FuncDef]] = []
+    for mi in modules:
+        for fd in _traced_roots(mi, index):
+            if id(fd.node) not in reachable:
+                reachable[id(fd.node)] = (mi, fd)
+                work.append((mi, fd))
+    while work:
+        mi, fd = work.pop()
+        for callee in _callees(mi, fd, index):
+            if id(callee.node) not in reachable:
+                cmi = index.by_name[callee.module]
+                reachable[id(callee.node)] = (cmi, callee)
+                work.append((cmi, callee))
+
+    diags: List[Diagnostic] = []
+    for mi, fd in reachable.values():
+        diags.extend(_scan_traced_function(mi, fd))
+    return sorted(diags, key=lambda d: (d.path, d.line, d.rule))
+
+
+if __name__ == "__main__":
+    from common import REPO_ROOT
+    for d in run(REPO_ROOT):
+        print(d)
