@@ -82,7 +82,8 @@ print('bench degradation ladder OK')"
     TSAN_OPTIONS="suppressions=$PWD/paddle_tpu/csrc/tsan.supp,halt_on_error=0,exitcode=0,log_path=/tmp/ci_tsan_report" \
     python -m pytest tests/test_table_concurrency.py tests/test_ssd_table.py \
       tests/test_native_table.py tests/test_ps_rpc.py \
-      tests/test_rpc_robustness.py tests/test_dist_graph.py -q -m ""
+      tests/test_rpc_robustness.py tests/test_dist_graph.py \
+      tests/test_rpc_parallel.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_tsan_report* 2>/dev/null; then
     echo "TSAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_tsan_report*)"
     exit 1
@@ -99,7 +100,8 @@ print('bench degradation ladder OK')"
     ASAN_OPTIONS="detect_leaks=0,halt_on_error=0,exitcode=0,log_path=/tmp/ci_asan_report" \
     python -m pytest tests/test_table_concurrency.py tests/test_ssd_table.py \
       tests/test_native_table.py tests/test_ps_rpc.py \
-      tests/test_rpc_robustness.py tests/test_dist_graph.py -q -m ""
+      tests/test_rpc_robustness.py tests/test_dist_graph.py \
+      tests/test_rpc_parallel.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_asan_report* 2>/dev/null; then
     echo "ASAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_asan_report*)"
     exit 1
@@ -114,7 +116,8 @@ print('bench degradation ladder OK')"
   UBSAN_OPTIONS="print_stacktrace=1,halt_on_error=0,log_path=/tmp/ci_ubsan_report" \
     python -m pytest tests/test_table_concurrency.py tests/test_ssd_table.py \
       tests/test_native_table.py tests/test_ps_rpc.py \
-      tests/test_rpc_robustness.py tests/test_dist_graph.py -q -m ""
+      tests/test_rpc_robustness.py tests/test_dist_graph.py \
+      tests/test_rpc_parallel.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_ubsan_report* 2>/dev/null; then
     echo "UBSAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_ubsan_report*)"
     exit 1

@@ -31,7 +31,14 @@ if not hasattr(_jax, "shard_map"):
                 kwargs["check_rep"] = kwargs.pop("check_vma")
             # this tree annotates replication with the vma system
             # (lax.pcast), which old jax's check_rep cannot see — its
-            # checker would reject valid programs, so default it off
+            # checker would reject valid programs, so default it off.
+            # AD CAVEAT (jax 0.4.x, either check_rep setting): the
+            # transpose of lax.psum inside shard_map is another psum,
+            # NOT the vma-era identity-on-replicated-cotangents — any
+            # loss that differentiates THROUGH a cross-shard psum comes
+            # back scaled by the axis size unless the site pins its own
+            # VJP (see ParallelCrossEntropy._psum_replicated) or reduces
+            # grads explicitly outside AD (see the pipeline trainers).
             kwargs.setdefault("check_rep", False)
             return _shard_map(*args, **kwargs)
 

@@ -25,12 +25,18 @@
 // lock tables_mu is released BEFORE any per-table lock is taken (see
 // kSaveAll: the ssd_save_mu pointer is copied out under tables_mu, then
 // locked after the scope closes) — the declared order below is the only
-// legal nesting if a future handler ever must hold both. conn_mu and
-// bar_mu are leaf locks. The table engines' internal order
+// legal nesting if a future handler ever must hold both. conn_mu,
+// bar_mu, the per-dense/geo-table mu and the client-side PsConn mu are
+// LEAF locks: nothing may be acquired while one is held — the lint
+// enforces this via the LOCK LEAF decl, which is what keeps the
+// interleaved per-connection request path (N handler threads hitting
+// the same tables while the parallel client fans out) deadlock-free by
+// construction. The table engines' internal order
 // (save_mu < shard_mu < ...) is declared where those locks live
 // (sparse_table.h, ssd_table.cc).
 // LOCK ORDER: tables_mu < save_mu < shard_mu
 // LOCK ORDER: tables_mu < dense_mu
+// LOCK LEAF: conn_mu bar_mu mu
 
 #include <arpa/inet.h>
 #include <fcntl.h>
@@ -160,6 +166,38 @@ enum Err : int64_t {
 };
 
 constexpr uint64_t kMaxPayload = 1ULL << 32;  // 4 GiB frame cap
+
+// fp32 -> IEEE fp16 with round-to-nearest-even (no F16C dependency —
+// this must build on any host the toolchain targets). Used by the
+// optional half-precision pull wire format (kPullSparse aux & 2):
+// halves the dominant PS->trainer byte stream when the table config
+// opts in; values re-widen client-side.
+inline uint16_t f32_to_f16(float f) {
+  uint32_t x;
+  std::memcpy(&x, &f, 4);
+  uint32_t sign = (x >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((x >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = x & 0x7fffffu;
+  if (exp >= 0x1f) {  // overflow/inf/nan
+    if (((x >> 23) & 0xff) == 0xff && mant)
+      return static_cast<uint16_t>(sign | 0x7e00u);  // nan (quiet)
+    return static_cast<uint16_t>(sign | 0x7c00u);    // inf / overflow
+  }
+  if (exp <= 0) {  // subnormal or zero
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    mant |= 0x800000u;  // implicit leading 1
+    uint32_t shift = static_cast<uint32_t>(14 - exp);
+    uint32_t half = mant >> shift;
+    uint32_t rem = mant & ((1u << shift) - 1);
+    uint32_t halfway = 1u << (shift - 1);
+    if (rem > halfway || (rem == halfway && (half & 1))) half++;
+    return static_cast<uint16_t>(sign | half);
+  }
+  uint32_t half = (static_cast<uint32_t>(exp) << 10) | (mant >> 13);
+  uint32_t rem = mant & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) half++;  // RNE
+  return static_cast<uint16_t>(sign | half);
+}
 
 // RAM-engine shard-file save/load (kSaveFile/kLoadFile for mem tables;
 // the SSD engine has streaming equivalents in ssd_table.cc). The mem
@@ -628,26 +666,35 @@ struct PsServer {
         return respond(fd, 0, nullptr, 0);
       }
       case kPullSparse: {
+        // aux bit 0: insert-on-miss; aux bit 1: fp16 wire values (the
+        // table-config pull_wire_dtype knob — halves response bytes)
         SparseRef t;
         if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
         int32_t pd = t.pull_dim();
+        int32_t create = h.aux & 1;
+        bool wire_f16 = (h.aux & 2) != 0;
         uint64_t want = static_cast<uint64_t>(h.n) * (8 + 4);
         if (h.payload_len != want) return respond(fd, kErrBadSize, nullptr, 0);
         const uint64_t* keys = reinterpret_cast<const uint64_t*>(p);
         const int32_t* slots = reinterpret_cast<const int32_t*>(p + h.n * 8);
         std::vector<float> out(static_cast<size_t>(h.n) * pd);
         if (t.ssd) {
-          sst_pull(t.ssd, keys, slots, h.n, h.aux, out.data());
+          sst_pull(t.ssd, keys, slots, h.n, create, out.data());
         } else {
           t.mem->parallel_over_shards(keys, h.n, [&](pstpu::Shard* sh, int64_t i) {
-            int32_t r = h.aux ? sh->lookup_or_insert(keys[i], slots[i])
-                              : sh->find(keys[i]);
+            int32_t r = create ? sh->lookup_or_insert(keys[i], slots[i])
+                               : sh->find(keys[i]);
             float* o = out.data() + i * pd;
             if (r >= 0)
               sh->select_into(r, o);
             else
               std::fill_n(o, pd, 0.0f);
           });
+        }
+        if (wire_f16) {
+          std::vector<uint16_t> half(out.size());
+          for (size_t i = 0; i < out.size(); ++i) half[i] = f32_to_f16(out[i]);
+          return respond(fd, h.n, half.data(), half.size() * 2);
         }
         return respond(fd, h.n, out.data(), out.size() * 4);
       }
@@ -1018,10 +1065,20 @@ static int64_t now_ms() {
   return static_cast<int64_t>(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
 }
 
+// coalesce threshold for scatter-gather sends: below this the header +
+// parts memcpy into the connection's reusable size-classed buffer and
+// ship as ONE send (TCP_NODELAY would otherwise put each tiny part on
+// the wire alone); above it each part streams straight from caller
+// memory — zero client-side staging for bulk payloads.
+constexpr uint64_t kCoalesceMax = 64 * 1024;
+
 struct PsConn {
   int fd = -1;
   int io_ms = 0;  // whole-call budget; 0 = no deadline
   std::mutex mu;
+  // reused across calls, grown in powers of two, never shrunk: the
+  // per-call allocation the tobytes() framing used to pay is gone
+  std::vector<char> sendbuf;
 
   ~PsConn() {
     if (fd >= 0) ::close(fd);
@@ -1128,16 +1185,51 @@ struct PsConn {
   int64_t call(uint32_t cmd, uint32_t table_id, int64_t n, int32_t aux,
                const void* payload, uint64_t plen, std::vector<char>* resp,
                int io_override = -1) {
-    std::lock_guard<std::mutex> g(mu);
+    const void* parts[1] = {payload};
+    uint64_t lens[1] = {plen};
+    return callv(cmd, table_id, n, aux, plen ? 1 : 0, parts, lens, resp,
+                 io_override);
+  }
+
+  // scatter-gather call: the request payload is the concatenation of
+  // `nparts` caller-owned buffers (numpy arrays on the Python side) —
+  // nothing is re-materialized per call. Small frames coalesce into
+  // sendbuf (one send); large frames stream each part directly.
+  int64_t callv(uint32_t cmd, uint32_t table_id, int64_t n, int32_t aux,
+                int32_t nparts, const void* const* parts,
+                const uint64_t* lens, std::vector<char>* resp,
+                int io_override = -1) {
+    std::lock_guard<std::mutex> g(mu);  // LOCK: mu
     if (fd < 0) return -1000;
+    uint64_t plen = 0;
+    for (int32_t i = 0; i < nparts; ++i) plen += lens[i];
     int ms = io_override >= 0 ? io_override : io_ms;
     int64_t deadline = ms > 0 ? now_ms() + ms : 0;
     ReqHeader h{plen, cmd, table_id, n, aux};
     int64_t rc;
-    if ((rc = io_full(&h, sizeof(h), true, deadline)) != 0) return rc;
-    if (plen && (rc = io_full(const_cast<void*>(payload), plen, true,
-                              deadline)) != 0)
-      return rc;
+    if (sizeof(h) + plen <= kCoalesceMax) {
+      uint64_t total = sizeof(h) + plen;
+      if (sendbuf.size() < total) {
+        uint64_t cap = sendbuf.empty() ? 4096 : sendbuf.size();
+        while (cap < total) cap *= 2;
+        sendbuf.resize(cap);
+      }
+      std::memcpy(sendbuf.data(), &h, sizeof(h));
+      uint64_t off = sizeof(h);
+      for (int32_t i = 0; i < nparts; ++i) {
+        if (lens[i]) std::memcpy(sendbuf.data() + off, parts[i], lens[i]);
+        off += lens[i];
+      }
+      if ((rc = io_full(sendbuf.data(), total, true, deadline)) != 0)
+        return rc;
+    } else {
+      if ((rc = io_full(&h, sizeof(h), true, deadline)) != 0) return rc;
+      for (int32_t i = 0; i < nparts; ++i) {
+        if (lens[i] && (rc = io_full(const_cast<void*>(parts[i]), lens[i],
+                                     true, deadline)) != 0)
+          return rc;
+      }
+    }
     uint64_t rh[2];
     if ((rc = io_full(rh, sizeof(rh), false, deadline)) != 0) return rc;
     if (rh[0] > kMaxPayload) return -1000;
@@ -1203,9 +1295,24 @@ int64_t psc_call2(void* h, uint32_t cmd, uint32_t table_id, int64_t n,
   return static_cast<PsConn*>(h)->call(cmd, table_id, n, aux, payload, plen,
                                        &g_resp, timeout_ms);
 }
+// scatter-gather variant: the payload is parts[0..nparts) concatenated
+// (each a caller-owned buffer, e.g. a numpy array) — no client-side
+// re-materialization of the frame
+int64_t psc_callv(void* h, uint32_t cmd, uint32_t table_id, int64_t n,
+                  int32_t aux, int32_t nparts, const void* const* parts,
+                  const uint64_t* lens, int32_t timeout_ms) {
+  return static_cast<PsConn*>(h)->callv(cmd, table_id, n, aux, nparts, parts,
+                                        lens, &g_resp, timeout_ms);
+}
 uint64_t psc_resp_len(void*) { return g_resp.size(); }
 void psc_resp_copy(void*, void* out) {
   if (!g_resp.empty()) std::memcpy(out, g_resp.data(), g_resp.size());
+}
+// zero-copy view of the calling thread's last response: valid until
+// that thread's next psc_call*/psc_close — callers must consume (or
+// copy out) before issuing another call on the same thread
+const void* psc_resp_ptr(void*) {
+  return g_resp.empty() ? nullptr : g_resp.data();
 }
 
 }  // extern "C"

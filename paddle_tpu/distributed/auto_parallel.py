@@ -1012,19 +1012,19 @@ class Engine:
                     new_opt, loss)
 
         self._batch_sh = batch_sh
-        if self.sharding_stage >= 1:
-            # pin the carried-state output shardings to the placements:
-            # without this the compiler may gather the slots once and
-            # keep them replicated, silently un-doing stage 1 after the
-            # first step
-            sharding_of = lambda t: jax.tree_util.tree_map(
-                lambda a: a.sharding, t)
-            self._step = jax.jit(
-                step, donate_argnums=(0, 1),
-                out_shardings=(sharding_of(self._state),
-                               sharding_of(self._opt_state), None))
-        else:
-            self._step = jax.jit(step, donate_argnums=(0, 1))
+        # pin the carried-state output shardings to the placements
+        # _place_state chose — for EVERY engine, not just stage 1.
+        # Without the pin the compiler is free to re-lay-out params and
+        # slots after the first step (stage 1: gathers the slots and
+        # un-does ZeRO; annotated engines under jax≥0.4.37: GSPMD drifts
+        # params off param_specs, so a later save→load→fit would land on
+        # different placements than the run it resumed and retrace)
+        sharding_of = lambda t: jax.tree_util.tree_map(
+            lambda a: a.sharding, t)
+        self._step = jax.jit(
+            step, donate_argnums=(0, 1),
+            out_shardings=(sharding_of(self._state),
+                           sharding_of(self._opt_state), None))
 
         def fwd(state, inputs):
             out, _ = nn.functional_call(model, state, *inputs, training=False)

@@ -151,6 +151,24 @@ class RowParallelLinear(Layer):
         return y
 
 
+def _psum_replicated_impl(x, axis_name):
+    """psum of a value whose DOWNSTREAM cotangent is replicated over
+    ``axis_name`` (every shard computes the same loss from the summed
+    result): the correct per-shard gradient is that cotangent unscaled.
+    jax 0.4.x shard_map transposes a plain psum into another psum (with
+    either check_rep setting), which would scale such gradients by the
+    axis size — the custom VJP pins the identity backward, and stays
+    correct under the vma-era semantics too."""
+    return lax.psum(x, axis_name)
+
+
+# axis_name is static (a string), not a differentiable input
+_psum_replicated = jax.custom_vjp(_psum_replicated_impl, nondiff_argnums=(1,))
+_psum_replicated.defvjp(
+    lambda x, axis_name: (lax.psum(x, axis_name), None),
+    lambda axis_name, _, ct: (ct,))
+
+
 class ParallelCrossEntropy(Layer):
     """Cross entropy over vocab-sharded logits (mp_layers.py:249 +
     c_softmax_with_cross_entropy_op.cu): logits' last dim is the local
@@ -173,13 +191,16 @@ class ParallelCrossEntropy(Layer):
         # correctness of the softmax grad and because pmax lacks a VJP
         local_max = lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
         global_max = lax.pmax(local_max, axis)
+        # the two reductions below are DIFFERENTIATED — they use the
+        # pinned-VJP psum (see _psum_replicated_impl) so the loss grad
+        # does not come back scaled by the mp size under jax 0.4.x
         sumexp = jnp.sum(jnp.exp(logits - global_max), axis=-1, keepdims=True)
-        lse = jnp.log(lax.psum(sumexp, axis)) + global_max  # [..., 1]
+        lse = jnp.log(_psum_replicated(sumexp, axis)) + global_max  # [..., 1]
         # picked logit: only the owning shard contributes
         local_label = labels - start
         in_range = (local_label >= 0) & (local_label < per)
         safe = jnp.clip(local_label, 0, per - 1)
         picked = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
         picked = jnp.where(in_range, picked, 0.0)
-        picked = lax.psum(picked, axis)
+        picked = _psum_replicated(picked, axis)
         return lse[..., 0] - picked

@@ -245,21 +245,50 @@ class PipelineTrainer:
                 head_apply if aux.get("head") else None,
             )
 
-            def spmd_loss(params, x_micro, y_micro, rng):
+            def spmd_local_loss(params, x_micro, y_micro, rng):
                 # distinct stochastic streams per pipeline stage
                 key = jax.random.fold_in(rng, lax.axis_index(pp_axis))
                 with nn.rng_guard(key):
                     preds = pipe(params["stages"], params["aux"], x_micro)
-                # mean over micro-batches of per-micro loss
+                # mean over micro-batches of per-micro loss, COUNTED ON
+                # THE LAST pp RANK ONLY. preds are pp-replicated, but
+                # under jax 0.4.x the transpose of pipe's masked psum
+                # delivers the SUM of every seeding rank's cotangent
+                # (see the __init__ shim note) — letting all S ranks
+                # seed an identical loss would scale every gradient by S
                 losses = jax.vmap(loss_fn)(preds, y_micro)
-                return global_mean(jnp.mean(losses))
+                r = lax.axis_index(pp_axis)
+                return jnp.where(r == lax.axis_size(pp_axis) - 1,
+                                 jnp.mean(losses), 0.0)
+
+            def spmd_vg(params, x_micro, y_micro, rng):
+                loss, grads = jax.value_and_grad(spmd_local_loss)(
+                    params, x_micro, y_micro, rng)
+                # explicit cross-rank reductions, NOT autodiff through a
+                # psum'd loss (whose 0.4.x transpose would hand every dp
+                # rank its own unreduced gradient, silently training on
+                # one shard's data). aux grads live on single pp ranks —
+                # embed's chain ends on rank 0, head's on rank S-1 — so
+                # they replicate by pp-psum exactly as the 1f1b branch
+                # does below; the loss value does the same.
+                loss = global_mean(lax.psum(loss, pp_axis))
+                red_axes = lambda extra: extra + (
+                    (dp_axis,) if dp_axis else ())
+                g_stage = grads["stages"]
+                if dp_axis:
+                    g_stage = jax.tree_util.tree_map(
+                        lambda g: lax.psum(g, dp_axis) / dp_n, g_stage)
+                g_aux = jax.tree_util.tree_map(
+                    lambda g: lax.psum(g, red_axes((pp_axis,))) / dp_n,
+                    grads["aux"])
+                return loss, {"stages": g_stage, "aux": g_aux}
 
             stage_specs = jax.tree_util.tree_map(lambda _: P(pp_axis), stacked)
             aux_specs = jax.tree_util.tree_map(lambda _: P(), aux)
             param_specs = {"stages": stage_specs, "aux": aux_specs}
 
             grad_fn = shard_map(
-                jax.value_and_grad(spmd_loss),
+                spmd_vg,
                 mesh=mesh,
                 in_specs=(param_specs, data_spec, data_spec, P()),
                 out_specs=(P(), param_specs),

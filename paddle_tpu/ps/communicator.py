@@ -23,6 +23,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from concurrent.futures import Future, ThreadPoolExecutor, wait
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -46,6 +47,12 @@ define_flag("communicator_send_wait_times", 5,
             "merge rounds to wait before a partial push")
 define_flag("communicator_is_sgd_optimizer", True,
             "sum (False) vs average (True) on merge (communicator.h:54)")
+define_flag("communicator_pull_ahead", 1,
+            "sparse pull prefetch depth for stream trainers: batch N+k's "
+            "pull issues while batch N computes (double-buffered at 1). "
+            "Pulls are stale by at most k queued pushes — the async-PS "
+            "contract; Sync mode and local tables ignore it (exact "
+            "per-batch ordering). 0 disables")
 
 
 class CommunicatorConfig:
@@ -65,6 +72,13 @@ class _BaseCommunicator:
         self._thread: Optional[threading.Thread] = None
         self._drained = threading.Event()
         self._drained.set()
+        # double-buffered pull prefetch (pull_sparse_async): the train
+        # loop overlaps batch N+1's pull with batch N's compute; barrier
+        # must drain these too (a HalfAsync join means "no PS traffic
+        # from me is outstanding", pulls included)
+        self._pull_pool: Optional[ThreadPoolExecutor] = None
+        self._pull_mu = threading.Lock()
+        self._inflight_pulls: set = set()
 
     # -- train-loop API ---------------------------------------------------
 
@@ -75,6 +89,37 @@ class _BaseCommunicator:
     def send_dense(self, table_id: int, grad: np.ndarray) -> None:
         self._queue_for(table_id).put(("dense", None, grad))
         self._drained.clear()
+
+    def pull_sparse_async(self, table_id: int, keys: np.ndarray,
+                          create: bool = True) -> "Future":
+        """Issue a pull on a background worker; returns a Future whose
+        ``result()`` is the pulled values. The pull observes whatever
+        pushes have ALREADY drained to the PS — stale by up to the queue
+        depth, the async-PS contract. ``barrier()`` waits for in-flight
+        pulls as well as queued sends."""
+        with self._pull_mu:
+            if self._pull_pool is None:
+                self._pull_pool = ThreadPoolExecutor(
+                    max_workers=2, thread_name_prefix="communicator-pull")
+            fut = self._pull_pool.submit(self.client.pull_sparse, table_id,
+                                         keys, create)
+            self._inflight_pulls.add(fut)
+        fut.add_done_callback(self._pull_done)
+        return fut
+
+    def _pull_done(self, fut) -> None:
+        with self._pull_mu:
+            self._inflight_pulls.discard(fut)
+
+    def _drain_pulls(self) -> None:
+        """Wait (without consuming results — the train loop owns those,
+        including any exception) until no pull is in flight."""
+        while True:
+            with self._pull_mu:
+                futs = list(self._inflight_pulls)
+            if not futs:
+                return
+            wait(futs)
 
     def _queue_for(self, table_id: int) -> "queue.Queue":
         if table_id not in self._queues:
@@ -98,12 +143,22 @@ class _BaseCommunicator:
         if self._thread is not None:
             self._thread.join(timeout=10)
         self._drain_all()
+        self._shutdown_pull_pool()
+
+    def _shutdown_pull_pool(self) -> None:
+        self._drain_pulls()
+        with self._pull_mu:
+            pool, self._pull_pool = self._pull_pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
 
     def barrier(self) -> None:
-        """Block until queued sends hit the PS (HalfAsync/Sync join)."""
+        """Block until queued sends hit the PS AND in-flight prefetch
+        pulls complete (HalfAsync/Sync join)."""
         while not self._all_empty():
             time.sleep(0.001)
         self._drained.wait(timeout=10)
+        self._drain_pulls()
 
     def _all_empty(self) -> bool:
         return all(q.empty() for q in self._queues.values())
@@ -160,7 +215,15 @@ class HalfAsyncCommunicator(_BaseCommunicator):
 
 
 class SyncCommunicator(_BaseCommunicator):
-    """Inline push on send — no background staleness."""
+    """Inline push on send — no background staleness. Pull-ahead is
+    REJECTED in this mode (a prefetched pull would miss the current
+    batch's inline push); CtrStreamTrainer forces depth 0 here."""
+
+    def pull_sparse_async(self, table_id, keys, create=True):
+        raise RuntimeError(
+            "SyncCommunicator is strictly ordered: a prefetched pull "
+            "would miss the current batch's inline push — pull through "
+            "client.pull_sparse, or use Async/HalfAsync for pull-ahead")
 
     def start(self) -> None:  # no background thread
         self._running = True
@@ -168,6 +231,7 @@ class SyncCommunicator(_BaseCommunicator):
     def stop(self) -> None:
         self._running = False
         self._drain_all()
+        self._shutdown_pull_pool()
 
     def send_sparse(self, table_id, keys, values):
         self.client.push_sparse(table_id, keys, values)
@@ -176,6 +240,7 @@ class SyncCommunicator(_BaseCommunicator):
         self.client.push_dense(table_id, grad)
 
     def barrier(self) -> None:
+        self._drain_pulls()  # no pull may straddle the barrier
         self.client.barrier()
 
 

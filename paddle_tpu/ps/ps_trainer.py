@@ -502,8 +502,10 @@ class CtrStreamTrainer:
         communicator=None,   # route via its PSClient (pushes async)
         table_id: int = 0,
         embedx_dim: Optional[int] = None,
+        pull_ahead: Optional[int] = None,
     ) -> None:
         from .. import nn
+        from .communicator import SyncCommunicator
 
         enforce(table is not None or communicator is not None,
                 "need a local table or a communicator-wrapped client")
@@ -514,6 +516,18 @@ class CtrStreamTrainer:
         self.label_slot = label_slot
         self.communicator = communicator
         self.table_id = table_id
+        #: sparse pull prefetch depth — batch N+k's pull issues (via
+        #: communicator.pull_sparse_async) while batch N computes,
+        #: hiding PS round-trip latency behind the step. Defaults to
+        #: FLAGS_communicator_pull_ahead for Async/HalfAsync
+        #: communicators; forced 0 for Sync mode and local tables, whose
+        #: contract is exact pull-after-push ordering per batch.
+        if communicator is None or isinstance(communicator, SyncCommunicator):
+            self.pull_ahead = 0
+        elif pull_ahead is None:
+            self.pull_ahead = max(0, int(flag("communicator_pull_ahead")))
+        else:
+            self.pull_ahead = max(0, int(pull_ahead))
         if embedx_dim is not None:
             self._dim = int(embedx_dim)
         else:
@@ -547,6 +561,7 @@ class CtrStreamTrainer:
                            drop_last: bool = True) -> Dict[str, float]:
         import inspect
         import time
+        from collections import deque
 
         S = len(self.sparse_slots)
         slot_ids = np.tile(np.arange(S, dtype=np.int32), batch_size)
@@ -554,14 +569,25 @@ class CtrStreamTrainer:
         kw = ({"drop_last": drop_last} if "drop_last" in
               inspect.signature(dataset.batch_iter).parameters else {})
         stats = _PassStats()
-        t0 = time.perf_counter()
-        for batch in dataset.batch_iter(batch_size, **kw):
+        depth = self.pull_ahead
+
+        def _prep(batch):
             keys = _slot_tagged_keys(batch, self.sparse_slots)
             flat = keys.reshape(-1)
             dense, labels = _dense_and_labels(batch, self.dense_slots,
                                               self.label_slot, keys.shape[0])
+            # pull-ahead: kick batch N+depth's pull NOW so it overlaps
+            # the compiled steps in front of it (double-buffered at 1)
+            fut = (self.communicator.pull_sparse_async(
+                       self.table_id, flat, create=True)
+                   if depth > 0 else None)
+            return keys, flat, dense, labels, fut
 
-            if self.communicator is not None:  # same client as the pushes
+        def _run(item):
+            keys, flat, dense, labels, fut = item
+            if fut is not None:
+                pulled = fut.result()
+            elif self.communicator is not None:  # same client as the pushes
                 pulled = self.communicator.client.pull_sparse(
                     self.table_id, flat, create=True)
             else:
@@ -585,9 +611,24 @@ class CtrStreamTrainer:
             stats.steps += 1
             stats.samples += int(labels.shape[0])
             stats.loss_sum += float(loss)
+
+        t0 = time.perf_counter()
+        window: deque = deque()  # batches with an issued (or due) pull
+        try:
+            for batch in dataset.batch_iter(batch_size, **kw):
+                window.append(_prep(batch))
+                if len(window) > depth:
+                    _run(window.popleft())
+            while window:
+                _run(window.popleft())
+        finally:
+            # an exception mid-pass must not leave prefetched pulls in
+            # flight (their worker would race the caller's recovery)
+            if depth > 0:
+                self.communicator._drain_pulls()
         dt = time.perf_counter() - t0
         if self.communicator is not None:
-            self.communicator.barrier()  # drain the async send queues
+            self.communicator.barrier()  # drain sends AND prefetch pulls
         return {
             "loss": stats.mean_loss,
             "steps": float(stats.steps),

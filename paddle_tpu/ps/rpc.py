@@ -21,7 +21,8 @@ import ctypes
 import os
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -50,6 +51,12 @@ define_flag("pserver_long_call_timeout_ms", 600000,
 define_flag("pserver_barrier_timeout_ms", 1800000,
             "barrier wait bound — generous (peers may legitimately be "
             "minutes behind) but finite, so a dead server still surfaces")
+define_flag("ps_rpc_parallel", True,
+            "fan multi-shard PS calls out concurrently (one in-flight "
+            "call per server connection, results scattered back by "
+            "routing index) so per-step latency is max(shards), not "
+            "sum(shards); False forces the serial per-server loop "
+            "(debugging / deterministic call interleaving)")
 
 __all__ = ["NativePsServer", "RpcPsClient", "RemoteSparseTable",
            "rpc_available"]
@@ -91,6 +98,9 @@ def _long_ms() -> int:
     return int(flag("pserver_long_call_timeout_ms"))
 
 
+_EMPTY_RESP = b""
+
+
 def _configure_rpc(lib: ctypes.CDLL) -> None:
     lib.pss_create.restype = ctypes.c_void_p
     lib.pss_create.argtypes = [ctypes.c_int, ctypes.c_int]
@@ -117,6 +127,17 @@ def _configure_rpc(lib: ctypes.CDLL) -> None:
     lib.psc_resp_len.restype = ctypes.c_uint64
     lib.psc_resp_len.argtypes = [ctypes.c_void_p]
     lib.psc_resp_copy.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    # scatter-gather + zero-copy response symbols (rebuild the .so if a
+    # stale build lacks them — _rpc_lib raises through the AttributeError)
+    lib.psc_callv.restype = ctypes.c_int64
+    lib.psc_callv.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                              ctypes.c_uint32, ctypes.c_int64, ctypes.c_int32,
+                              ctypes.c_int32,
+                              ctypes.POINTER(ctypes.c_void_p),
+                              ctypes.POINTER(ctypes.c_uint64),
+                              ctypes.c_int32]
+    lib.psc_resp_ptr.restype = ctypes.c_void_p
+    lib.psc_resp_ptr.argtypes = [ctypes.c_void_p]
 
 
 def _rpc_lib() -> ctypes.CDLL:
@@ -219,10 +240,10 @@ class _ServerConn:
         except Exception:
             pass
 
-    def _call_once(self, cmd, table_id, n, aux, buf,
-                   timeout_ms) -> Tuple[int, bytes]:
-        status = int(self._lib.psc_call2(
-            self._h, cmd, table_id, n, aux, buf, len(buf),
+    def _call_once(self, cmd, table_id, n, aux, parts, lens, nparts,
+                   timeout_ms, view):
+        status = int(self._lib.psc_callv(
+            self._h, cmd, table_id, n, aux, nparts, parts, lens,
             -1 if timeout_ms is None else timeout_ms))
         if status <= -1000:
             # undefined stream state: drop the socket before any retry
@@ -233,20 +254,60 @@ class _ServerConn:
                 f"(cmd {cmd})")
         rlen = int(self._lib.psc_resp_len(self._h))
         if not rlen:
-            return status, b""
+            return status, _EMPTY_RESP
+        if view:
+            # zero-copy view over the calling thread's native response
+            # buffer — valid ONLY until this thread's next call on any
+            # connection (thread-local storage); consumers scatter it
+            # into their output arrays before returning
+            ptr = self._lib.psc_resp_ptr(self._h)
+            return status, np.ctypeslib.as_array(
+                ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)),
+                shape=(rlen,))
         resp = ctypes.create_string_buffer(rlen)
         self._lib.psc_resp_copy(self._h, resp)
         return status, resp.raw
 
     def call(self, cmd: int, table_id: int = 0, n: int = 0, aux: int = 0,
-             payload: Optional[bytes] = None,
+             payload: Union[bytes, np.ndarray, Sequence[np.ndarray],
+                            None] = None,
              retries: Optional[int] = None,
-             timeout_ms: Optional[int] = None) -> Tuple[int, bytes]:
-        """``retries``: attempts beyond the first (default
+             timeout_ms: Optional[int] = None,
+             view: bool = False):
+        """``payload``: bytes, one ndarray, or a sequence of C-contiguous
+        ndarrays sent scatter-gather (concatenated on the wire with NO
+        client-side re-materialization — the arrays themselves are the
+        frame). ``retries``: attempts beyond the first (default
         FLAGS_pserver_max_retry - 1). ``timeout_ms``: whole-call deadline
         override for this call (long table-scale commands, barrier);
-        None = FLAGS_pserver_timeout_ms, 0 = no deadline."""
-        buf = payload or b""
+        None = FLAGS_pserver_timeout_ms, 0 = no deadline. ``view``: the
+        response is returned as a uint8 ndarray view over this THREAD's
+        reused native buffer — zero-copy, but only valid until the same
+        thread's next call; pass False (bytes copy) to retain it."""
+        if payload is None:
+            parts: Tuple = ()
+        elif isinstance(payload, (bytes, bytearray, np.ndarray)):
+            parts = (payload,)
+        else:
+            parts = tuple(payload)
+        nparts = len(parts)
+        ptrs = (ctypes.c_void_p * max(nparts, 1))()
+        lens = (ctypes.c_uint64 * max(nparts, 1))()
+        keep = []  # pins bytes parts for the whole call (incl. retries)
+        for i, part in enumerate(parts):
+            if isinstance(part, np.ndarray):
+                # the frame is read linearly from the base pointer — a
+                # strided view would silently ship the wrong elements
+                enforce(part.flags["C_CONTIGUOUS"],
+                        "scatter-gather payload parts must be "
+                        "C-contiguous (use np.ascontiguousarray)")
+                ptrs[i] = part.ctypes.data
+                lens[i] = part.nbytes
+            else:
+                b = bytes(part)
+                keep.append(b)
+                ptrs[i] = ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p)
+                lens[i] = len(b)
         if retries is None:
             retries = max(0, int(flag("pserver_max_retry")) - 1)
         backoff = int(flag("pserver_retry_backoff_ms")) / 1000.0
@@ -256,8 +317,8 @@ class _ServerConn:
                 with self._mu:  # one caller owns connect/call/close at a time
                     if self._h is None:
                         self._connect()
-                    return self._call_once(cmd, table_id, n, aux, buf,
-                                           timeout_ms)
+                    return self._call_once(cmd, table_id, n, aux, ptrs, lens,
+                                           nparts, timeout_ms, view)
             except PreconditionNotMetError as e:
                 last = e
                 if attempt < retries:
@@ -267,7 +328,7 @@ class _ServerConn:
             f"{retries + 1} attempt(s): {last}")
 
     def check(self, cmd: int, table_id: int = 0, n: int = 0, aux: int = 0,
-              payload: Optional[bytes] = None, **kw) -> Tuple[int, bytes]:
+              payload=None, **kw):
         status, resp = self.call(cmd, table_id, n, aux, payload, **kw)
         if status == -2:
             raise NotFoundError(f"table {table_id} not created on server")
@@ -285,7 +346,16 @@ def _sparse_config_payload(cfg: TableConfig) -> bytes:
 class RpcPsClient(PSClient):
     """PSClient over N TCP servers. Sparse keys route by
     ``key % num_servers``; dense tables split into contiguous
-    even slices per server (DenseDimPerShard semantics)."""
+    even slices per server (DenseDimPerShard semantics).
+
+    Multi-shard commands fan out CONCURRENTLY (one worker per server
+    connection, one in-flight call per connection, sub-responses
+    scattered back by routing index) unless ``FLAGS_ps_rpc_parallel``
+    is off — per-call wall-clock is max over shards instead of the
+    serial loop's sum. The per-connection mutex still serializes
+    overlapping operations from different trainer threads on the same
+    connection, so interleaved pull/push streams stay frame-correct.
+    """
 
     def __init__(self, endpoints: Sequence[str]) -> None:
         lib = _rpc_lib()
@@ -297,25 +367,70 @@ class RpcPsClient(PSClient):
         self._sparse_cfgs: Dict[int, TableConfig] = {}
         self._dense_dims: Dict[int, int] = {}
         self._geo_dims: Dict[int, int] = {}
+        self._wire_f16: Dict[int, bool] = {}  # table → fp16 pull values
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._pool_mu = threading.Lock()
 
     @property
     def num_servers(self) -> int:
         return len(self._conns)
 
     def close(self) -> None:
+        with self._pool_mu:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=True)
         for c in self._conns:
             c.close()
+
+    # -- concurrent shard fan-out ----------------------------------------
+
+    def _executor(self) -> ThreadPoolExecutor:
+        with self._pool_mu:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=len(self._conns),
+                    thread_name_prefix="ps-rpc")
+            return self._pool
+
+    def _fanout(self, tasks: List):
+        """Run one zero-arg task per participating server. Parallel when
+        FLAGS_ps_rpc_parallel and more than one task; the serial path
+        preserves server order exactly. Always drains every task before
+        returning/raising (no call may still be in flight when the op
+        ends — barrier semantics depend on it); the first exception
+        propagates. Returns results in task order."""
+        if len(tasks) <= 1 or not flag("ps_rpc_parallel"):
+            return [t() for t in tasks]
+        futs = [self._executor().submit(t) for t in tasks]
+        results, first_err = [], None
+        for f in futs:
+            try:
+                results.append(f.result())
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                if first_err is None:
+                    first_err = e
+                results.append(None)
+        if first_err is not None:
+            raise first_err
+        return results
 
     # -- table lifecycle --------------------------------------------------
 
     def create_sparse_table(self, table_id: int, config: Optional[TableConfig] = None) -> None:
         cfg = config or TableConfig(table_id=table_id)
+        wire = getattr(cfg, "pull_wire_dtype", "fp32")
+        enforce(wire in ("fp32", "fp16"),
+                f"TableConfig.pull_wire_dtype must be 'fp32' or 'fp16', "
+                f"got {wire!r}")
         self._sparse_cfgs[table_id] = cfg
+        self._wire_f16[table_id] = wire == "fp16"
         base = _sparse_config_payload(cfg)
         if cfg.storage == "ssd":
             enforce(cfg.ssd_path is not None,
                     "TableConfig.storage='ssd' requires ssd_path")
-        for idx, c in enumerate(self._conns):
+
+        def mk(idx, c):
             payload = base
             if cfg.storage == "ssd":
                 # each (table, server) pair owns its own disk directory;
@@ -324,33 +439,46 @@ class RpcPsClient(PSClient):
                 payload = (base + np.asarray([1], np.int32).tobytes()
                            + np.asarray([len(path)], np.uint32).tobytes()
                            + path)
+            # parallel across servers: an SSD create replays the whole
+            # cold-tier log, so a cluster restart pays max(server logs)
             _, resp = c.check(_CREATE_SPARSE, table_id, payload=payload,
                               timeout_ms=_long_ms())
             dims = np.frombuffer(resp, np.int32)
-            self._sparse_dims[table_id] = (int(dims[0]), int(dims[1]), int(dims[2]))
+            return int(dims[0]), int(dims[1]), int(dims[2])
+
+        all_dims = self._fanout([lambda idx=i, c=c: mk(idx, c)
+                                 for i, c in enumerate(self._conns)])
+        enforce(len(set(all_dims)) == 1,
+                f"servers disagree on table {table_id} dims: {all_dims} "
+                "(mismatched accessor configs across trainers?)")
+        self._sparse_dims[table_id] = all_dims[0]
 
     # -- SSD-tier management (no-ops on RAM-only tables) ------------------
 
     def spill(self, table_id: int, hot_budget: int) -> int:
         """Per-server spill to at most hot_budget hot rows each; returns
         total rows spilled."""
-        return sum(int(c.check(_SPILL, table_id, n=int(hot_budget),
-                               timeout_ms=_long_ms(), retries=0)[0])
-                   for c in self._conns)
+        return sum(self._fanout(
+            [lambda c=c: int(c.check(_SPILL, table_id, n=int(hot_budget),
+                                     timeout_ms=_long_ms(), retries=0)[0])
+             for c in self._conns]))
 
     def table_stats(self, table_id: int) -> Dict[str, int]:
-        out = {"hot_rows": 0, "cold_rows": 0, "disk_bytes": 0}
-        for c in self._conns:
+        def one(c):
             _, resp = c.check(_STATS, table_id)
             s3 = np.frombuffer(resp, np.int64)
-            out["hot_rows"] += int(s3[0])
-            out["cold_rows"] += int(s3[1])
-            out["disk_bytes"] += int(s3[2])
-        return out
+            return int(s3[0]), int(s3[1]), int(s3[2])
+
+        stats = self._fanout([lambda c=c: one(c) for c in self._conns])
+        return {"hot_rows": sum(s[0] for s in stats),
+                "cold_rows": sum(s[1] for s in stats),
+                "disk_bytes": sum(s[2] for s in stats)}
 
     def compact(self, table_id: int) -> int:
-        return sum(int(c.check(_COMPACT, table_id, timeout_ms=_long_ms(),
-                               retries=0)[0]) for c in self._conns)
+        return sum(self._fanout(
+            [lambda c=c: int(c.check(_COMPACT, table_id,
+                                     timeout_ms=_long_ms(), retries=0)[0])
+             for c in self._conns]))
 
     def create_dense_table(self, table_id: int, dim: int, optimizer: str = "adam",
                            lr: float = 0.001) -> None:
@@ -389,6 +517,18 @@ class RpcPsClient(PSClient):
         with RecordEvent("pserver_client_pull_sparse"):
             return self._pull_sparse(table_id, keys, create, slots)
 
+    def _shard_sel(self, sv: np.ndarray):
+        """(server, conn, sel) for servers with work; ``sel`` is None
+        when one server owns every key (skip the gather copy)."""
+        out = []
+        for s, c in enumerate(self._conns):
+            sel = np.flatnonzero(sv == s)
+            if len(sel) == len(sv):
+                out.append((s, c, None))
+            elif len(sel):
+                out.append((s, c, sel))
+        return out
+
     def _pull_sparse(self, table_id, keys, create=True, slots=None):
         keys = np.ascontiguousarray(keys, np.uint64)
         pull_dim = self._dims(table_id)[0]
@@ -396,14 +536,25 @@ class RpcPsClient(PSClient):
         sv = self._route(keys)
         slots_arr = (np.ascontiguousarray(slots, np.int32) if slots is not None
                      else np.zeros(len(keys), np.int32))
-        for s, c in enumerate(self._conns):
-            sel = np.flatnonzero(sv == s)
-            if not len(sel):
-                continue
-            payload = keys[sel].tobytes() + slots_arr[sel].tobytes()
-            _, resp = c.check(_PULL_SPARSE, table_id, n=len(sel),
-                              aux=1 if create else 0, payload=payload)
-            out[sel] = np.frombuffer(resp, np.float32).reshape(len(sel), pull_dim)
+        f16 = self._wire_f16.get(table_id, False)
+        aux = (1 if create else 0) | (2 if f16 else 0)
+
+        def one(c, sel):
+            kp = keys if sel is None else keys[sel]
+            sp = slots_arr if sel is None else slots_arr[sel]
+            _, resp = c.check(_PULL_SPARSE, table_id, n=len(kp), aux=aux,
+                              payload=(kp, sp), view=True)
+            vals = (resp.view(np.float16).astype(np.float32) if f16
+                    else resp.view(np.float32))
+            # scatter before returning: `resp` is this worker thread's
+            # reused native buffer (dead at its next call)
+            if sel is None:
+                out[:] = vals.reshape(len(kp), pull_dim)
+            else:
+                out[sel] = vals.reshape(len(kp), pull_dim)
+
+        self._fanout([lambda c=c, sel=sel: one(c, sel)
+                      for _, c, sel in self._shard_sel(sv)])
         return out
 
     def push_sparse(self, table_id, keys, values):
@@ -417,12 +568,14 @@ class RpcPsClient(PSClient):
         # before send)
         keys, values = merge_duplicate_keys(keys, values)
         sv = self._route(keys)
-        for s, c in enumerate(self._conns):
-            sel = np.flatnonzero(sv == s)
-            if not len(sel):
-                continue
-            payload = keys[sel].tobytes() + np.ascontiguousarray(values[sel]).tobytes()
-            c.check(_PUSH_SPARSE, table_id, n=len(sel), payload=payload)
+
+        def one(c, sel):
+            kp = keys if sel is None else keys[sel]
+            vp = values if sel is None else values[sel]
+            c.check(_PUSH_SPARSE, table_id, n=len(kp), payload=(kp, vp))
+
+        self._fanout([lambda c=c, sel=sel: one(c, sel)
+                      for _, c, sel in self._shard_sel(sv)])
 
     def pull_dense(self, table_id):
         try:
@@ -430,55 +583,67 @@ class RpcPsClient(PSClient):
         except KeyError:
             raise NotFoundError(f"dense table {table_id} not created via this client")
         out = np.zeros(dim, np.float32)
-        for s, c in enumerate(self._conns):
-            sl = self._dense_slice(dim, s)
-            if not len(sl):
-                continue
-            _, resp = c.check(_PULL_DENSE, table_id)
-            out[sl.start : sl.stop] = np.frombuffer(resp, np.float32)
+
+        def one(c, sl):
+            _, resp = c.check(_PULL_DENSE, table_id, view=True)
+            out[sl.start : sl.stop] = resp.view(np.float32)
+
+        self._fanout([lambda c=c, sl=self._dense_slice(dim, s): one(c, sl)
+                      for s, c in enumerate(self._conns)
+                      if len(self._dense_slice(dim, s))])
         return out
 
     def push_dense(self, table_id, grad):
         grad = np.ascontiguousarray(grad, np.float32)
         dim = self._dense_dims[table_id]
-        for s, c in enumerate(self._conns):
-            sl = self._dense_slice(dim, s)
-            if not len(sl):
-                continue
-            c.check(_PUSH_DENSE, table_id, payload=grad[sl.start : sl.stop].tobytes())
+        # contiguous slice views — the gradient ships straight from the
+        # caller's buffer, no per-server copy at all
+        self._fanout(
+            [lambda c=c, sl=self._dense_slice(dim, s):
+             c.check(_PUSH_DENSE, table_id, payload=grad[sl.start : sl.stop])
+             for s, c in enumerate(self._conns)
+             if len(self._dense_slice(dim, s))])
 
     def set_dense(self, table_id, values):
         values = np.ascontiguousarray(values, np.float32)
         dim = self._dense_dims[table_id]
-        for s, c in enumerate(self._conns):
-            sl = self._dense_slice(dim, s)
-            if not len(sl):
-                continue
-            c.check(_SET_DENSE, table_id, payload=values[sl.start : sl.stop].tobytes())
+        self._fanout(
+            [lambda c=c, sl=self._dense_slice(dim, s):
+             c.check(_SET_DENSE, table_id, payload=values[sl.start : sl.stop])
+             for s, c in enumerate(self._conns)
+             if len(self._dense_slice(dim, s))])
 
     def push_geo(self, table_id, keys, deltas):
         keys = np.ascontiguousarray(keys, np.uint64)
         deltas = np.ascontiguousarray(deltas, np.float32)
         sv = self._route(keys)
-        for s, c in enumerate(self._conns):
-            sel = np.flatnonzero(sv == s)
-            if not len(sel):
-                continue
-            payload = keys[sel].tobytes() + np.ascontiguousarray(deltas[sel]).tobytes()
-            c.check(_PUSH_GEO, table_id, n=len(sel), payload=payload)
+
+        def one(c, sel):
+            kp = keys if sel is None else keys[sel]
+            dp = deltas if sel is None else deltas[sel]
+            c.check(_PUSH_GEO, table_id, n=len(kp), payload=(kp, dp))
+
+        self._fanout([lambda c=c, sel=sel: one(c, sel)
+                      for _, c, sel in self._shard_sel(sv)])
 
     def pull_geo(self, table_id):
         dim = self._geo_dims[table_id]
-        all_keys, all_deltas = [], []
-        for c in self._conns:
-            cnt, resp = c.check(_PULL_GEO, table_id)
-            if cnt:
-                all_keys.append(np.frombuffer(resp[: cnt * 8], np.uint64))
-                all_deltas.append(
-                    np.frombuffer(resp[cnt * 8 :], np.float32).reshape(cnt, dim))
-        if not all_keys:
+
+        def one(c):
+            cnt, resp = c.check(_PULL_GEO, table_id, view=True)
+            if not cnt:
+                return None
+            # copy out of the thread's reused view before returning
+            return (resp[: cnt * 8].view(np.uint64).copy(),
+                    resp[cnt * 8 :].view(np.float32)
+                    .reshape(cnt, dim).copy())
+
+        got = [g for g in self._fanout([lambda c=c: one(c)
+                                        for c in self._conns]) if g]
+        if not got:
             return np.zeros(0, np.uint64), np.zeros((0, dim), np.float32)
-        return np.concatenate(all_keys), np.concatenate(all_deltas)
+        return (np.concatenate([k for k, _ in got]),
+                np.concatenate([d for _, d in got]))
 
     def barrier(self):
         # all-trainer barrier lives on server 0 (BarrierTable placement);
@@ -493,11 +658,15 @@ class RpcPsClient(PSClient):
         return status
 
     def shrink(self, table_id):
-        return sum(c.check(_SHRINK, table_id, timeout_ms=_long_ms(),
-                           retries=0)[0] for c in self._conns)
+        # parallel: the shrink sweep is a whole-table rewrite per server
+        # (~minutes at 1e8 rows) — the daily boundary pays max, not sum
+        return sum(self._fanout(
+            [lambda c=c: c.check(_SHRINK, table_id, timeout_ms=_long_ms(),
+                                 retries=0)[0] for c in self._conns]))
 
     def size(self, table_id) -> int:
-        return sum(c.check(_SIZE, table_id)[0] for c in self._conns)
+        return sum(self._fanout([lambda c=c: c.check(_SIZE, table_id)[0]
+                                 for c in self._conns]))
 
 
     def _embedx_dim(self, table_id: int) -> int:
@@ -588,32 +757,40 @@ class RpcPsClient(PSClient):
         slots_arr = (np.ascontiguousarray(slots, np.int32)
                      if slots is not None else np.zeros(len(keys), np.int32))
         sv = self._route(keys)
-        for s, c in enumerate(self._conns):
-            sel = np.flatnonzero(sv == s)
-            if not len(sel):
-                continue
-            payload = keys[sel].tobytes()
-            if create:
-                payload += slots_arr[sel].tobytes()
-            _, resp = c.check(_EXPORT, table_id, n=len(sel),
-                              aux=1 if create else 0, payload=payload,
-                              timeout_ms=_long_ms())
-            nb = len(sel) * full_dim * 4
-            out[sel] = np.frombuffer(resp[:nb], np.float32).reshape(len(sel), full_dim)
-            found[sel] = np.frombuffer(resp[nb:], np.uint8).astype(bool)
+
+        def one(c, sel):
+            kp = keys if sel is None else keys[sel]
+            parts = (kp, slots_arr if sel is None else slots_arr[sel]) \
+                if create else (kp,)
+            _, resp = c.check(_EXPORT, table_id, n=len(kp),
+                              aux=1 if create else 0, payload=parts,
+                              timeout_ms=_long_ms(), view=True)
+            nb = len(kp) * full_dim * 4
+            vals = resp[:nb].view(np.float32).reshape(len(kp), full_dim)
+            if sel is None:
+                out[:] = vals
+                found[:] = resp[nb:] != 0
+            else:
+                out[sel] = vals
+                found[sel] = resp[nb:] != 0
+
+        self._fanout([lambda c=c, sel=sel: one(c, sel)
+                      for _, c, sel in self._shard_sel(sv)])
         return out, found
 
     def import_full(self, table_id, keys, values):
         keys = np.ascontiguousarray(keys, np.uint64)
         values = np.ascontiguousarray(values, np.float32)
         sv = self._route(keys)
-        for s, c in enumerate(self._conns):
-            sel = np.flatnonzero(sv == s)
-            if not len(sel):
-                continue
-            payload = keys[sel].tobytes() + np.ascontiguousarray(values[sel]).tobytes()
-            c.check(_INSERT_FULL, table_id, n=len(sel), payload=payload,
+
+        def one(c, sel):
+            kp = keys if sel is None else keys[sel]
+            vp = values if sel is None else values[sel]
+            c.check(_INSERT_FULL, table_id, n=len(kp), payload=(kp, vp),
                     timeout_ms=_long_ms())
+
+        self._fanout([lambda c=c, sel=sel: one(c, sel)
+                      for _, c, sel in self._shard_sel(sv)])
 
     def load_cold(self, table_id, keys, values, chunk: int = 1 << 21) -> int:
         """Bulk cold-tier model load across servers (the 1e9-row build
@@ -628,17 +805,22 @@ class RpcPsClient(PSClient):
                 f"load_cold values shape {values.shape} != "
                 f"({len(keys)}, {full_dim})")
         sv = self._route(keys)
-        total = 0
-        for s, c in enumerate(self._conns):
-            sel = np.flatnonzero(sv == s)
+
+        def one(c, sel):
+            # chunks WITHIN a server stay sequential (bounded frames,
+            # flat client RAM); servers load in parallel
+            done = 0
             for lo in range(0, len(sel), chunk):
                 part = sel[lo : lo + chunk]
-                payload = (keys[part].tobytes()
-                           + np.ascontiguousarray(values[part]).tobytes())
                 cnt, _ = c.check(_LOAD_COLD, table_id, n=len(part),
-                                 payload=payload, timeout_ms=_long_ms())
-                total += int(cnt)
-        return total
+                                 payload=(keys[part], values[part]),
+                                 timeout_ms=_long_ms())
+                done += int(cnt)
+            return done
+
+        return sum(self._fanout(
+            [lambda c=c, sel=np.flatnonzero(sv == s): one(c, sel)
+             for s, c in enumerate(self._conns)]))
 
     _SAVE_FORMATS = {None: (0, ""), "gzip": (1, ".gz"), "raw": (2, ".bin")}
 
@@ -660,13 +842,14 @@ class RpcPsClient(PSClient):
         fmt, suffix = self._SAVE_FORMATS[converter]
         os.makedirs(dirname, exist_ok=True)
         aux = int(mode) | (fmt << 8)
-        total = 0
-        for s, c in enumerate(self._conns):
-            path = os.path.join(dirname, f"part-{s:05d}.shard{suffix}")
-            cnt, _ = c.check(_SAVE_FILE, table_id, aux=aux,
-                             payload=path.encode(), timeout_ms=0,
-                             retries=0)
-            total += int(cnt)
+        # parallel: each server streams ITS shard to its own file —
+        # checkpoint wall-clock is the largest shard, not the sum
+        total = sum(self._fanout(
+            [lambda c=c, path=os.path.join(
+                dirname, f"part-{s:05d}.shard{suffix}"):
+             int(c.check(_SAVE_FILE, table_id, aux=aux,
+                         payload=path.encode(), timeout_ms=0, retries=0)[0])
+             for s, c in enumerate(self._conns)]))
         import json
 
         with open(os.path.join(dirname, "meta.json"), "w") as f:
@@ -694,16 +877,14 @@ class RpcPsClient(PSClient):
                 f"unknown save_local converter {conv!r} in meta.json")
         fmt, suffix = self._SAVE_FORMATS[conv]
         aux = fmt << 8
-        total = 0
-        for s, c in enumerate(self._conns):
-            path = os.path.join(dirname, f"part-{s:05d}.shard{suffix}")
-            if not os.path.exists(path):
-                continue
-            cnt, _ = c.check(_LOAD_FILE, table_id, aux=aux,
-                             payload=path.encode(), timeout_ms=0,
-                             retries=0)
-            total += int(cnt)
-        return total
+        return sum(self._fanout(
+            [lambda c=c, path=path:
+             int(c.check(_LOAD_FILE, table_id, aux=aux,
+                         payload=path.encode(), timeout_ms=0, retries=0)[0])
+             for s, c in enumerate(self._conns)
+             for path in [os.path.join(dirname,
+                                       f"part-{s:05d}.shard{suffix}")]
+             if os.path.exists(path)]))
 
     def stop_servers(self) -> None:
         for c in self._conns:

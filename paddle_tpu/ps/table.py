@@ -160,6 +160,12 @@ class TableConfig:
     # named shard-file converter applied on save/load (the reference's
     # accessor DataConverter / AFS compression role); "gzip" built-in
     converter: Optional[str] = None
+    # pull-value encoding on the RPC wire (local tables ignore it):
+    # "fp32" exact, or "fp16" — halves the dominant PS→trainer byte
+    # stream; values re-widen client-side (IEEE half round-trip, ~3
+    # decimal digits — fine for serving/eval pulls, keep fp32 where
+    # bit-exact training state matters)
+    pull_wire_dtype: str = "fp32"
 
 
 class _SparseShard:

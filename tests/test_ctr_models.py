@@ -171,7 +171,11 @@ def test_pooled_step_variable_length_slots(rng):
     step = make_ctr_pooled_train_step(model, opt, ccfg, seg, donate=False)
 
     losses = []
-    for it in range(40):
+    # 160 iters: under jax 0.4.37 this trajectory plateaus near 0.69
+    # until ~iter 130 and then drops hard to ~0.3 (measured); the
+    # 40-iter bound was tuned on a version whose breakthrough came
+    # earlier. Same signal, same endpoint — later knee.
+    for it in range(160):
         T = len(seg)
         keys = rng.integers(1, 300, size=(B, T)).astype(np.uint64)
         rows = cache.lookup(keys.reshape(-1)).reshape(B, T)

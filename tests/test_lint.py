@@ -267,6 +267,44 @@ def test_scoped_guard_released_before_second_lock(tmp_path):
     assert diags == []
 
 
+def test_lock_leaf_violation_flagged(tmp_path):
+    # a LEAF lock must be innermost: acquiring anything while it is
+    # held fires, even if an ORDER decl would have allowed the nesting
+    diags = _lock_diags(tmp_path, """
+        // LOCK LEAF: conn_mu
+        // LOCK ORDER: conn_mu < tables_mu
+        void f(T* t) {
+          std::lock_guard<std::mutex> g(t->conn_mu);
+          std::lock_guard<std::mutex> h(t->tables_mu);
+        }
+    """)
+    assert "lock-leaf" in _rules(diags)
+    # declaring successors for a leaf is itself a decl error
+    assert "lock-order-syntax" in _rules(diags)
+
+
+def test_lock_leaf_nests_under_ordered_locks(tmp_path):
+    # the other direction is the contract: a leaf may be taken while
+    # any outer lock is held, with NO ORDER decl needed for it
+    diags = _lock_diags(tmp_path, """
+        // LOCK ORDER: tables_mu < save_mu
+        // LOCK LEAF: bar_mu
+        void f(T* t) {
+          std::lock_guard<std::mutex> g(t->tables_mu);
+          std::lock_guard<std::mutex> h(t->bar_mu);
+        }
+    """)
+    assert diags == []
+
+
+def test_lock_leaf_malformed_decl_flagged(tmp_path):
+    diags = _lock_diags(tmp_path, """
+        // LOCK LEAF: conn-mu!
+        void f() {}
+    """)
+    assert _rules(diags) == {"lock-order-syntax"}
+
+
 def test_real_csrc_tree_is_clean():
     assert lock_order.run(REPO) == []
 

@@ -242,7 +242,12 @@ class TestRecompute:
         x = jnp.asarray(np.random.default_rng(0).normal(size=(8, 8)), jnp.float32)
         g_plain = jax.grad(f)(x)
         g_remat = jax.grad(lambda x: recompute(f, x))(x)
-        np.testing.assert_allclose(np.asarray(g_plain), np.asarray(g_remat), rtol=1e-6)
+        # remat re-executes the forward inside the backward; XLA fuses
+        # the two programs differently (jax 0.4.37 CPU: ~3e-6 rel on a
+        # couple of elements), so bitwise equality is not the contract —
+        # f32-roundoff agreement is
+        np.testing.assert_allclose(np.asarray(g_plain), np.asarray(g_remat),
+                                   rtol=1e-5, atol=1e-6)
 
     def test_policy_names(self):
         def f(x):

