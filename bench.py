@@ -316,9 +316,51 @@ def _run_measurement() -> None:
     samples_per_sec = batch * slab * steps / dt
     baseline = 1.0e6  # proxy: GPUPS-on-A100 class throughput (north star ≥2×)
     extra = {"degraded_from": errors} if errors else {}
+    dense = _dense_comm_attempt()
+    if dense is not None:
+        extra["dense_comm"] = dense
     _emit(round(samples_per_sec, 1), round(samples_per_sec / baseline, 4),
           slab=slab, mode=mode_used,
           platform=jax.devices()[0].platform, **extra)
+
+
+def _dense_comm_attempt():
+    """Dense-DP comm ladder (fused+int8 → fused+bf16 → fused fp32 →
+    unfused; tools/dense_comm_bench.py): step time + hlo_bytes-measured
+    collective bytes/step, platform-tagged, embedded in the ONE bench
+    emission under ``dense_comm``. Multi-device backends run in-process
+    (real ICI); a 1-device backend (the CPU CI rung) re-runs in a
+    subprocess with 8 virtual CPU devices so the collectives exist at
+    all. A failure here costs the field, never the headline metric."""
+    if os.environ.get("BENCH_DENSE_COMM", "1") != "1":
+        return None
+    try:
+        import jax
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        if len(jax.devices()) > 1:
+            sys.path.insert(0, os.path.join(here, "tools"))
+            import dense_comm_bench
+
+            return dense_comm_bench.run()
+        import subprocess
+
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": (env.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8").strip(),
+        })
+        env.setdefault("DCB_BATCH", "512")
+        env.setdefault("DCB_STEPS", "5")
+        out = subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "dense_comm_bench.py")],
+            env=env, capture_output=True, text=True, timeout=300)
+        line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+        return json.loads(line)
+    except Exception as e:  # noqa: BLE001 — optional field, never fatal
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
 if __name__ == "__main__":

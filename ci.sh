@@ -17,8 +17,15 @@ fi
 echo "== native build =="
 make -C paddle_tpu/csrc -s
 
+echo "== comm-fusion fast checks (fused dense-DP collectives + hlo_bytes) =="
+# fail the fused-bucket/quantized-collective layer in seconds, before the
+# full matrix — these cover the wire-byte acceptance gates directly
+python -m pytest tests/test_comm_fusion.py tests/test_hlo_bytes.py -q
+
 echo "== fast gate (default: -m 'not slow') =="
-python -m pytest tests/ -q -x
+# comm-fusion/hlo_bytes already ran above — don't pay them twice
+python -m pytest tests/ -q -x \
+  --ignore=tests/test_comm_fusion.py --ignore=tests/test_hlo_bytes.py
 
 if [[ "${1:-fast}" == "full" ]]; then
   echo "== full matrix (slow tests included) =="
@@ -54,6 +61,19 @@ import json, sys
 line = [l for l in sys.stdin.read().splitlines() if l.startswith('{')][-1]
 d = json.loads(line); assert d['value'] > 0 and 'error' not in d, d
 print('bench (cpu) OK')"
+  # dense-DP comm ladder: int8 must actually shrink the wire (hlo_bytes-
+  # measured ≥3.5× fewer collective bytes than fused fp32)
+  JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    DCB_BATCH=256 DCB_STEPS=3 DCB_HIDDEN=128 \
+    python tools/dense_comm_bench.py | python -c "
+import json, sys
+d = json.loads([l for l in sys.stdin.read().splitlines() if l.startswith('{')][-1])
+assert 'error' not in d, d
+ladder = {r['mode']: r for r in d['ladder']}
+i8 = ladder['fused+int8']['collective_wire_bytes_per_step']
+f32 = ladder['fused+fp32']['collective_wire_bytes_per_step']
+assert f32 >= 3.5 * i8, ladder
+print('dense comm ladder OK (int8 moves %.1fx fewer bytes)' % (f32 / i8))"
   # the graceful-degradation ladder must actually engage (a hardware
   # compile failure in a new hot path costs an attempt, not the metric)
   BENCH_STEPS=3 BENCH_WARMUP=1 BENCH_BATCH=256 BENCH_PASS_KEYS=$((1 << 13)) \

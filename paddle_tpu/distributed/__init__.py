@@ -26,10 +26,12 @@ from .fleet_executor import (
     MessageBus,
     TaskNode,
 )
+from .comm_fusion import CommFusionConfig, DpGradReducer
 from .meta_optimizers import (
     AMPOptimizer,
     DGCMomentumOptimizer,
     FP16AllReduceOptimizer,
+    FusedAllReduceOptimizer,
     GradientMergeOptimizer,
     LocalSGDOptimizer,
     MetaOptimizerBase,

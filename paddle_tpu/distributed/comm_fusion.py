@@ -1,0 +1,565 @@
+"""Fused-bucket, block-quantized gradient collectives for the dense DP path.
+
+The reference fuses small dense gradients into flat coalesce buffers
+before NCCL allreduce (``imperative/reducer.h:126`` bucketed Reducer,
+``fuse_all_reduce_ops`` + ``fuse_grad_size_in_MB`` in the static graph)
+because collective time on many small tensors is launch-bound, not
+bandwidth-bound (PAPERS.md: densification, arxiv 1905.04035). TPU-first
+the same holds for ICI: one program, few big collectives. This module
+packs the grad pytree into ≤``max_buckets`` per-dtype flat buckets with
+a stable layout cached per (pytree shapes, world size, config), and
+reduces each bucket with an explicit in-graph collective:
+
+- fp32 (``quant="none"``): ONE ``psum`` per bucket — bit-identical to
+  the per-tensor psum baseline (elementwise reduction over the same
+  replica group), so fusion alone never changes numerics;
+- ``quant="bf16"`` (or an outer FP16AllReduceOptimizer's wire dtype):
+  EQuARX-style two-stage (arxiv 2506.17615) — cast, ``all_to_all``
+  (the scatter half of a reduce-scatter at wire width), accumulate in
+  fp32, re-cast, ``all_gather``; the sum happens at fp32 even though
+  every byte on the wire is half-width;
+- ``quant="int8"``: same two stages with block-wise int8 quantization
+  (per-``block_size`` fp32 absmax scales, requantized between stages)
+  plus an fp32 error-feedback residual carried in opt_state, so the
+  quantization error is re-injected next step instead of lost.
+
+Buckets are laid out in ``K`` rank-aligned segments (row ``r`` of the
+``(K, seg_total)`` bucket holds rank ``r``'s flat slice of every leaf),
+so the stage-1 output IS a rank's shard of every tensor: ZeRO
+(ShardingStage1/2) consumes it directly — reduce-scatter + sharded
+update + param all-gather — instead of allreduce-then-slice.
+
+``DpGradReducer`` is installed into the meta-optimizer chain by
+``apply_strategy(..., reducer=...)`` (meta_optimizers.py): gradients
+reach the chain PRE-reduction and exactly one wrapper performs the
+collective, which is what lets FP16AllReduce/DGC genuinely shrink what
+crosses ICI and lets GradientMerge's held steps skip the collective
+entirely. See docs/OPERATIONS.md "Dense comm compression tuning".
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.enforce import enforce
+
+__all__ = [
+    "CommFusionConfig",
+    "BucketLayout",
+    "DpGradReducer",
+    "build_layout",
+]
+
+PyTree = Any
+_tmap = jax.tree_util.tree_map
+
+_QUANT_MODES = ("none", "bf16", "int8")
+# dtypes whose buckets may ride the wire narrower than they are stored
+_FLOAT_DTYPES = ("float32", "bfloat16", "float16")
+
+
+@dataclasses.dataclass(frozen=True)
+class CommFusionConfig:
+    """Dense-DP comm fusion knobs (reference: ``fuse_all_reduce_ops`` /
+    ``fuse_grad_size_in_MB`` in DistributedStrategy; quant knobs are the
+    EQuARX extension). ``fuse=False`` keeps the per-tensor psum baseline
+    (the unfused rung of the bench degradation ladder)."""
+
+    fuse: bool = True
+    bucket_mb: float = 4.0        # flat-buffer size cap per bucket
+    max_buckets: int = 8          # hard cap across all dtype groups
+    quant: str = "none"           # none | bf16 | int8
+    block_size: int = 256         # elements per int8 scale block
+    error_feedback: bool = True   # fp32 residual in opt_state (int8 only)
+
+    def __post_init__(self):
+        enforce(self.quant in _QUANT_MODES,
+                f"quant must be one of {_QUANT_MODES}, got {self.quant!r}")
+        enforce(self.bucket_mb > 0, "bucket_mb must be positive")
+        enforce(self.max_buckets >= 1, "max_buckets must be >= 1")
+        enforce(self.block_size >= 1, "block_size must be >= 1")
+
+    @classmethod
+    def from_configs(cls, cfg: Optional[Dict[str, Any]]) -> "CommFusionConfig":
+        """Build from a strategy's ``comm_fusion_configs`` dict (unknown
+        keys ignored, reference-style)."""
+        cfg = dict(cfg or {})
+        kw = {f.name: cfg[f.name] for f in dataclasses.fields(cls)
+              if f.name in cfg}
+        if "fuse_grad_size_in_MB" in cfg:   # reference knob name
+            kw.setdefault("bucket_mb", float(cfg["fuse_grad_size_in_MB"]))
+        return cls(**kw)
+
+
+class _Slot:
+    """One leaf's place in a bucket: row-aligned so segment ``r`` of the
+    bucket holds this leaf's flat elements [r*seg_len, (r+1)*seg_len)."""
+
+    __slots__ = ("index", "shape", "dtype", "size", "seg_len", "offset")
+
+    def __init__(self, index, shape, dtype, size, seg_len, offset):
+        self.index = index
+        self.shape = shape
+        self.dtype = dtype
+        self.size = size
+        self.seg_len = seg_len
+        self.offset = offset
+
+
+class _Bucket:
+    __slots__ = ("slots", "seg_total", "dtype", "quantizable")
+
+    def __init__(self, slots, seg_total, dtype, quantizable):
+        self.slots = slots
+        self.seg_total = seg_total   # per-rank columns incl. block tail pad
+        self.dtype = dtype
+        self.quantizable = quantizable
+
+
+class BucketLayout:
+    """Stable bucket assignment for one (leaf metadata, K, config)."""
+
+    __slots__ = ("buckets", "K", "n_leaves")
+
+    def __init__(self, buckets, K, n_leaves):
+        self.buckets = buckets
+        self.K = K
+        self.n_leaves = n_leaves
+
+
+_LAYOUT_CACHE: Dict[Tuple, BucketLayout] = {}
+
+
+def build_layout(meta: Sequence[Tuple[Tuple[int, ...], str]], K: int,
+                 config: CommFusionConfig) -> BucketLayout:
+    """Assign leaves (given as ``(shape, dtype_name)`` in flatten order)
+    to per-dtype, size-capped buckets. Deterministic and cached: the
+    same pytree structure always gets the same layout, so the compiled
+    step and any error-feedback state stay valid across calls."""
+    key = (tuple((tuple(s), d) for s, d in meta), K, config)
+    hit = _LAYOUT_CACHE.get(key)
+    if hit is not None:
+        return hit
+
+    by_dtype: Dict[str, List[int]] = {}
+    for i, (_, d) in enumerate(meta):
+        by_dtype.setdefault(d, []).append(i)
+
+    cap_bytes = max(int(config.bucket_mb * (1 << 20)), 1)
+    while True:
+        groups: List[Tuple[str, List[List[int]]]] = []
+        total = 0
+        for d in sorted(by_dtype):
+            itemsize = jnp.dtype(d).itemsize
+            cur: List[int] = []
+            cur_bytes = 0
+            dbuckets: List[List[int]] = []
+            for i in by_dtype[d]:
+                sz = int(math.prod(meta[i][0])) * itemsize
+                if cur and cur_bytes + sz > cap_bytes:
+                    dbuckets.append(cur)
+                    cur, cur_bytes = [], 0
+                cur.append(i)
+                cur_bytes += sz
+            if cur:
+                dbuckets.append(cur)
+            groups.append((d, dbuckets))
+            total += len(dbuckets)
+        if total <= config.max_buckets:
+            break
+        if total == len(by_dtype):
+            # one bucket per dtype group is the floor — growing the cap
+            # can't reduce the count below the number of distinct grad
+            # dtypes, so accept (max_buckets is a target, not a promise
+            # the dtype mix can always honor)
+            break
+        cap_bytes *= 2   # grow the cap until the count fits the budget
+
+    block = config.block_size
+    buckets = []
+    for d, dbuckets in groups:
+        quantizable = d in _FLOAT_DTYPES
+        for idxs in dbuckets:
+            slots, off = [], 0
+            for i in idxs:
+                size = int(math.prod(meta[i][0]))
+                seg_len = -(-size // K)   # ceil: flat leaf padded to K*seg
+                # (0-element leaves get seg_len 0 and pack/unpack as
+                # empty slices — never a ragged pad)
+                slots.append(_Slot(i, tuple(meta[i][0]), d, size, seg_len, off))
+                off += seg_len
+            # block-align segments only when int8 quant is on (scale
+            # blocks must not straddle ranks); cast/fp32 wires need none
+            pad_block = quantizable and config.quant == "int8"
+            seg_total = -(-off // block) * block if pad_block else off
+            buckets.append(_Bucket(tuple(slots), seg_total, d, quantizable))
+
+    layout = BucketLayout(tuple(buckets), K, len(meta))
+    _LAYOUT_CACHE[key] = layout
+    return layout
+
+
+def _pack_bucket(leaves: Sequence[jax.Array], bucket: _Bucket, K: int) -> jax.Array:
+    """Leaves → the bucket's ``(K, seg_total)`` rank-aligned buffer."""
+    parts = []
+    for s in bucket.slots:
+        x = leaves[s.index].reshape(-1)
+        pad = s.seg_len * K - s.size
+        if pad:
+            x = jnp.concatenate([x, jnp.zeros((pad,), x.dtype)])
+        parts.append(x.reshape(K, s.seg_len))
+    out = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+    tail = bucket.seg_total - out.shape[1]
+    if tail:
+        out = jnp.concatenate(
+            [out, jnp.zeros((K, tail), out.dtype)], axis=1)
+    return out
+
+
+def _unpack_bucket(buf: jax.Array, bucket: _Bucket, K: int) -> List[jax.Array]:
+    """Inverse of :func:`_pack_bucket` (list ordered like bucket.slots)."""
+    out = []
+    for s in bucket.slots:
+        x = buf[:, s.offset:s.offset + s.seg_len].reshape(-1)[:s.size]
+        out.append(x.reshape(s.shape).astype(s.dtype))
+    return out
+
+
+def _split_segment(seg: jax.Array, bucket: _Bucket) -> List[jax.Array]:
+    """One rank's reduced ``(seg_total,)`` segment → per-slot flat
+    ``(seg_len,)`` shards (still padded; elementwise updates don't care)."""
+    return [seg[s.offset:s.offset + s.seg_len] for s in bucket.slots]
+
+
+def _join_segment(parts: Sequence[jax.Array], bucket: _Bucket) -> jax.Array:
+    seg = jnp.concatenate([p.reshape(-1) for p in parts])
+    tail = bucket.seg_total - seg.shape[0]
+    if tail:
+        seg = jnp.concatenate([seg, jnp.zeros((tail,), seg.dtype)])
+    return seg
+
+
+def _quant_int8(x: jax.Array, block: int) -> Tuple[jax.Array, jax.Array]:
+    """Block-wise symmetric int8: per-block fp32 absmax scales (the
+    EQuARX block granularity; scale overhead = 4/block bytes/elem)."""
+    shp = x.shape
+    xb = x.reshape(shp[:-1] + (shp[-1] // block, block))
+    amax = jnp.max(jnp.abs(xb), axis=-1, keepdims=True)
+    scale = amax / 127.0
+    inv = jnp.where(scale > 0, 1.0 / scale, 0.0)
+    q = jnp.clip(jnp.round(xb * inv), -127, 127).astype(jnp.int8)
+    return q.reshape(shp), scale[..., 0]
+
+
+def _dequant_int8(q: jax.Array, scale: jax.Array, block: int) -> jax.Array:
+    shp = q.shape
+    qb = q.reshape(shp[:-1] + (shp[-1] // block, block)).astype(jnp.float32)
+    return (qb * scale[..., None]).reshape(shp)
+
+
+class DpGradReducer:
+    """Explicit dense-DP gradient reducer, shared by the whole
+    meta-optimizer chain of one trainer.
+
+    Static per trainer: the reduction axes (``axes``, reduced jointly)
+    and the :class:`CommFusionConfig`. Trace-time-mutable (set by OUTER
+    wrappers while the chain's ``update`` traces, single-threaded):
+
+    - :meth:`wire_dtype` — FP16AllReduceOptimizer routes its dtype here,
+      so the cast happens ON the wire instead of round-tripping before
+      the collective (the PR-2-era no-op this PR retires);
+    - :meth:`suspended` — LocalSGDOptimizer steps its inner chain with
+      local gradients; no grad collective while suspended.
+
+    ``shard=True`` (ZeRO stage 1/2): :meth:`reduce_to_shards` stops
+    after stage 1 — each rank keeps its reduce-scattered flat shard of
+    every leaf — and :meth:`gather_params_from_shards` all-gathers the
+    updated params, one fused collective per bucket.
+    """
+
+    def __init__(self, axes: Sequence[str], axis_sizes: Sequence[int],
+                 config: Optional[CommFusionConfig] = None,
+                 shard: bool = False) -> None:
+        self.axes = tuple(axes)
+        self.sizes = tuple(int(s) for s in axis_sizes)
+        enforce(len(self.axes) == len(self.sizes),
+                "axes and axis_sizes must align")
+        self.K = int(math.prod(self.sizes)) if self.sizes else 1
+        self.config = config or CommFusionConfig()
+        self.shard = bool(shard)
+        self.installed = False    # set by apply_strategy / the trainer
+        self._wire_stack: List[Any] = []
+        self._suspend = 0
+
+    # -- trace-time chain hooks -------------------------------------------
+
+    @contextlib.contextmanager
+    def wire_dtype(self, dtype):
+        """Override the wire dtype for collectives traced inside (used
+        by FP16AllReduceOptimizer). Ignored when quant="int8" — int8 is
+        already narrower."""
+        self._wire_stack.append(dtype)
+        try:
+            yield
+        finally:
+            self._wire_stack.pop()
+
+    @contextlib.contextmanager
+    def suspended(self):
+        """No grad collectives while active (LocalSGD inner steps)."""
+        self._suspend += 1
+        try:
+            yield
+        finally:
+            self._suspend -= 1
+
+    @property
+    def active(self) -> bool:
+        return self.K > 1 and self._suspend == 0
+
+    def uses_error_feedback(self) -> bool:
+        return (self.K > 1 and self.config.fuse
+                and self.config.quant == "int8" and self.config.error_feedback)
+
+    def _wire_mode(self, bucket: _Bucket) -> Tuple[str, Any]:
+        """Resolve ("psum"|"cast"|"int8", wire_dtype) for one bucket."""
+        if not bucket.quantizable:
+            return "psum", None
+        if self.config.quant == "int8":
+            return "int8", None
+        if self.config.quant == "bf16":
+            return "cast", jnp.bfloat16
+        if self._wire_stack:
+            return "cast", self._wire_stack[-1]
+        return "psum", None
+
+    # -- layout ------------------------------------------------------------
+
+    def layout_for(self, tree: PyTree) -> BucketLayout:
+        leaves = jax.tree_util.tree_leaves(tree)
+        meta = tuple((tuple(x.shape), jnp.result_type(x).name) for x in leaves)
+        return build_layout(meta, self.K, self.config)
+
+    def _my_index(self) -> jax.Array:
+        idx = jnp.zeros((), jnp.int32)
+        for a in self.axes:
+            idx = idx * lax.axis_size(a) + lax.axis_index(a)
+        return idx
+
+    # -- error feedback ----------------------------------------------------
+
+    def init_ef(self, params: PyTree) -> Dict[str, jax.Array]:
+        """Zero residuals, one flat fp32 buffer per quantized bucket.
+        PER-RANK state (each rank's own quantization error): the trainer
+        expands it with a leading world dim (meta_optimizers
+        ``local_state_keys`` contract)."""
+        if not self.uses_error_feedback():
+            return {}
+        layout = self.layout_for(params)
+        return {f"b{i}": jnp.zeros((b.seg_total * self.K,), jnp.float32)
+                for i, b in enumerate(layout.buckets) if b.quantizable}
+
+    # -- bucket reductions -------------------------------------------------
+
+    def _two_stage_cast(self, b2d, dtype, out_dtype, gather):
+        """reduce_scatter at wire width with fp32 accumulation:
+        all_to_all moves each rank's quantized chunks, the sum happens
+        AFTER widening (EQuARX's accuracy trick), then the reduced
+        segment is re-narrowed for the all_gather.
+
+        The chunk sum runs AT wire precision (the reference's
+        fp16_allreduce sums its fp16 buffers too): under
+        --xla_allow_excess_precision (default on) XLA elides a
+        f32→bf16→f32 convert pair around a pure data-movement
+        collective, silently re-widening the wire to f32 — an
+        optimization_barrier does not stop that pass, but genuine
+        narrow arithmetic adjacent to the collective does. The
+        compiled-HLO element type, not numerics, is the contract here
+        (tools/hlo_bytes.py asserts it). K is almost always a power of
+        two, so the /K mean is exact even at bf16."""
+        wire = b2d.astype(dtype)
+        recv = lax.all_to_all(wire, self.axes, split_axis=0, concat_axis=0,
+                              tiled=True)
+        seg = jnp.sum(recv, axis=0) / jnp.asarray(self.K, dtype)
+        if not gather:
+            return seg.astype(jnp.float32)
+        gat = lax.all_gather(seg, self.axes, axis=0, tiled=False)
+        return gat.astype(out_dtype)
+
+    def _two_stage_int8(self, b2d, ef, gather):
+        block = self.config.block_size
+        x = b2d.astype(jnp.float32)
+        if ef is not None:
+            x = x + ef
+        q, sc = _quant_int8(x, block)
+        new_ef = x - _dequant_int8(q, sc, block) if ef is not None else None
+        qr = lax.all_to_all(q, self.axes, split_axis=0, concat_axis=0,
+                            tiled=True)
+        scr = lax.all_to_all(sc, self.axes, split_axis=0, concat_axis=0,
+                             tiled=True)
+        seg = jnp.sum(_dequant_int8(qr, scr, block), axis=0) / self.K
+        if not gather:
+            return seg, new_ef
+        q2, s2 = _quant_int8(seg, block)
+        qg = lax.all_gather(q2, self.axes, axis=0, tiled=False)
+        sg = lax.all_gather(s2, self.axes, axis=0, tiled=False)
+        return _dequant_int8(qg, sg, block), new_ef
+
+    def _reduce_bucket(self, b2d, bucket, ef, gather=True):
+        """One bucket's collective; returns (reduced, new_ef) where
+        ``reduced`` is (K, seg_total) when gather else the (seg_total,)
+        rank segment."""
+        mode, dtype = self._wire_mode(bucket)
+        if mode == "cast":
+            out = self._two_stage_cast(b2d, dtype, bucket.dtype, gather)
+            return out, ef
+        if mode == "int8":
+            out, new_ef = self._two_stage_int8(b2d, ef, gather)
+            if gather:
+                out = out.astype(bucket.dtype)
+            return out, new_ef
+        # psum: fp32 (or non-float) — ONE collective, bit-identical to
+        # the per-tensor baseline
+        flat = b2d.reshape(-1)
+        if gather:
+            red = lax.psum(flat, self.axes) / self.K
+            return red.reshape(b2d.shape), ef
+        red = lax.psum_scatter(flat, self.axes, scatter_dimension=0,
+                               tiled=True) / self.K
+        return red, ef
+
+    # -- public reduce APIs -------------------------------------------------
+
+    def reduce(self, grads: PyTree, ef: Optional[Dict[str, jax.Array]] = None
+               ) -> Tuple[PyTree, Dict[str, jax.Array]]:
+        """Mean-reduce the grad pytree over the dp axes; full tree out."""
+        ef = ef or {}
+        if not self.active:
+            return grads, ef
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        if not leaves:
+            return grads, ef
+        if not self.config.fuse:
+            # per-tensor baseline — still honor a cast wire dtype
+            # (config bf16 or an outer FP16AllReduce override): the
+            # per-tensor collective rides narrow and re-widens after.
+            # int8 needs the bucket/block machinery and is ignored
+            # unfused (the fp32 baseline is the point of this rung).
+            wire = (jnp.bfloat16 if self.config.quant == "bf16"
+                    else (self._wire_stack[-1] if self._wire_stack else None))
+            if wire is not None:
+                red = [(lax.psum(g.astype(wire), self.axes)
+                        / jnp.asarray(self.K, wire)).astype(g.dtype)
+                       for g in leaves]
+            else:
+                red = [lax.psum(g, self.axes) / self.K for g in leaves]
+            return jax.tree_util.tree_unflatten(treedef, red), ef
+        layout = self.layout_for(grads)
+        out = [None] * len(leaves)
+        new_ef = dict(ef)
+        for i, bucket in enumerate(layout.buckets):
+            b2d = _pack_bucket(leaves, bucket, self.K)
+            ef_i = ef.get(f"b{i}")
+            red, ef_o = self._reduce_bucket(
+                b2d, bucket, None if ef_i is None else
+                ef_i.reshape(self.K, bucket.seg_total))
+            if ef_o is not None:
+                new_ef[f"b{i}"] = ef_o.reshape(-1)
+            for s, leaf in zip(bucket.slots, _unpack_bucket(red, bucket, self.K)):
+                out[s.index] = leaf
+        return jax.tree_util.tree_unflatten(treedef, out), new_ef
+
+    def reduce_to_shards(self, grads: PyTree,
+                         ef: Optional[Dict[str, jax.Array]] = None
+                         ) -> Tuple[PyTree, Dict[str, jax.Array]]:
+        """Mean reduce-scatter: each rank keeps its flat ``(seg_len,)``
+        shard of every leaf (same treedef, flat-shard leaves) — the
+        ZeRO-1/2 consumption path, no allreduce-then-slice."""
+        ef = ef or {}
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        layout = self.layout_for(grads)
+        out = [None] * len(leaves)
+        new_ef = dict(ef)
+        for i, bucket in enumerate(layout.buckets):
+            b2d = _pack_bucket(leaves, bucket, self.K)
+            if not self.config.fuse:
+                # unfused baseline: full psum, slice my segment
+                red = lax.psum(b2d.reshape(-1), self.axes) / self.K
+                seg = lax.dynamic_slice_in_dim(
+                    red.reshape(self.K, bucket.seg_total),
+                    self._my_index(), 1, 0)[0]
+            else:
+                ef_i = ef.get(f"b{i}")
+                seg, ef_o = self._reduce_bucket(
+                    b2d, bucket, None if ef_i is None else
+                    ef_i.reshape(self.K, bucket.seg_total), gather=False)
+                if ef_o is not None:
+                    new_ef[f"b{i}"] = ef_o.reshape(-1)
+            for s, part in zip(bucket.slots, _split_segment(seg, bucket)):
+                out[s.index] = part.astype(s.dtype)
+        return jax.tree_util.tree_unflatten(treedef, out), new_ef
+
+    def slice_local_shards(self, tree: PyTree) -> PyTree:
+        """Each rank's own flat shard of every leaf, NO collective
+        (params entering the sharded update; LocalSGD-suspended steps)."""
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        layout = self.layout_for(tree)
+        my = self._my_index()
+        out = [None] * len(leaves)
+        for bucket in layout.buckets:
+            b2d = _pack_bucket(leaves, bucket, self.K)
+            seg = lax.dynamic_slice_in_dim(b2d, my, 1, 0)[0]
+            for s, part in zip(bucket.slots, _split_segment(seg, bucket)):
+                out[s.index] = part
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def gather_params_from_shards(self, shard_tree: PyTree,
+                                  template: PyTree) -> PyTree:
+        """Updated per-leaf flat shards → full params: one fused
+        all_gather per bucket (the stage-1 'broadcast' of the reference,
+        compiled)."""
+        shards, _ = jax.tree_util.tree_flatten(shard_tree)
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        layout = self.layout_for(template)
+        out = [None] * len(leaves)
+        for bucket in layout.buckets:
+            seg = _join_segment([shards[s.index] for s in bucket.slots], bucket)
+            gat = lax.all_gather(seg, self.axes, axis=0, tiled=False)
+            for s, leaf in zip(bucket.slots, _unpack_bucket(gat, bucket, self.K)):
+                out[s.index] = leaf
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def global_shard_template(self, params: PyTree) -> PyTree:
+        """HOST-side: each leaf as its zero-padded flat ``(K*seg_len,)``
+        global buffer — what the inner optimizer's state is initialized
+        over in shard mode. Sharding dim0 over the joint dp axes hands
+        every rank exactly its :meth:`reduce_to_shards` shard."""
+        import numpy as np
+
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        layout = self.layout_for(params)
+        out = [None] * len(leaves)
+        for bucket in layout.buckets:
+            for s in bucket.slots:
+                x = np.asarray(leaves[s.index]).reshape(-1)
+                flat = np.zeros((s.seg_len * self.K,), x.dtype)
+                flat[:s.size] = x
+                out[s.index] = jnp.asarray(flat)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    # -- small helpers for the chain ----------------------------------------
+
+    def sync_all_finite(self, ok: jax.Array) -> jax.Array:
+        """AMP's nonfinite-skip flag must be UNIFORM across ranks under
+        the pre-reduction contract (each rank checked its own local
+        grads): all ranks skip iff any rank saw a nonfinite."""
+        if not self.active:
+            return ok
+        return lax.psum(ok.astype(jnp.int32), self.axes) == self.K

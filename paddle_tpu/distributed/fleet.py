@@ -461,19 +461,29 @@ class Fleet:
 
     # -- optimizer ---------------------------------------------------------
 
-    def distributed_optimizer(self, optimizer, strategy: Optional[DistributedStrategy] = None):
+    def distributed_optimizer(self, optimizer,
+                              strategy: Optional[DistributedStrategy] = None,
+                              reducer=None):
         """Reference ``fleet.distributed_optimizer`` (fleet_base.py:1438):
         selects meta-optimizers by strategy and returns the wrapped
         optimizer. Sparse (PS) routing still happens via the
         PsTrainer/communicator at the executor layer; dense strategy
         flags (amp/dgc/lars/lamb/localsgd/gradient_merge/...) become
-        jit-traceable optimizer transforms (meta_optimizers.py)."""
+        jit-traceable optimizer transforms (meta_optimizers.py).
+
+        ``reducer`` (comm_fusion.DpGradReducer) builds the chain on the
+        PRE-reduction contract — dense dp gradients cross ICI as fused,
+        optionally bf16/int8-quantized bucket collectives owned by the
+        chain itself. Trainers that know their mesh usually build this
+        themselves: ``SpmdTrainer(..., strategy=..., comm=...)`` derives
+        the reducer from the mesh's batch axes and calls apply_strategy
+        with it; pass one here only when wiring a custom step."""
         self._check_init()
         if strategy is not None:
             self._strategy = strategy
         from .meta_optimizers import apply_strategy
 
-        return apply_strategy(optimizer, self._strategy)
+        return apply_strategy(optimizer, self._strategy, reducer=reducer)
 
 
 fleet = Fleet()

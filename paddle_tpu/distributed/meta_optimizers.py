@@ -18,8 +18,30 @@ donates like any other state.
 
 ``apply_strategy`` is the StrategyCompiler analogue: given a
 DistributedStrategy it builds the wrapper chain (innermost to
-outermost: base-swap lars/lamb → dgc → fp16_allreduce → localsgd →
-gradient_merge → amp).
+outermost: base-swap lars/lamb → dgc → [fused dp reduce] →
+fp16_allreduce → localsgd → gradient_merge → amp).
+
+PRE-REDUCTION CONTRACT (comm_fusion.py): when a
+:class:`~paddle_tpu.distributed.comm_fusion.DpGradReducer` is passed to
+``apply_strategy``, gradients reach the chain UNREDUCED (the trainer's
+shard_map computes local grads; no AD-inserted psum) and exactly one
+wrapper — :class:`FusedAllReduceOptimizer`, inserted innermost —
+performs the explicit fused-bucket collective. That placement is what
+makes the wrappers' comm claims real for the first time:
+
+- FP16AllReduce routes its dtype to the wire (the collective itself is
+  bf16) instead of casting and casting back upstream of an fp32 psum;
+- DGC's released tensor is what gets reduced — the residual never
+  crosses ICI;
+- GradientMerge's held steps never trace the collective (it sits inside
+  the apply branch of the cond) — zero ICI traffic on non-apply steps;
+- LocalSGD suspends the reducer entirely: inner steps are genuinely
+  local, and only its every-k param averaging communicates.
+
+Wrapper state that is per-rank under this contract (GM's ``acc``,
+DGC's ``u``/``v``, the reducer's error-feedback residual) is declared
+via ``local_state_keys`` / ``state_layout`` so the trainer can give it
+a leading world dim, sharded over the dp axes.
 """
 
 from __future__ import annotations
@@ -41,6 +63,7 @@ __all__ = [
     "LocalSGDOptimizer",
     "DGCMomentumOptimizer",
     "FP16AllReduceOptimizer",
+    "FusedAllReduceOptimizer",
     "ASPOptimizer",
     "RecomputeOptimizer",
     "apply_strategy",
@@ -54,6 +77,11 @@ _tmap = jax.tree_util.tree_map
 class MetaOptimizerBase(Optimizer):
     """Wrapper base: delegates to ``inner`` and namespaces extra state."""
 
+    #: extra-state keys holding PER-RANK values under the pre-reduction
+    #: contract (accumulated/residual LOCAL gradients); the trainer
+    #: expands these with a leading world dim sharded over the dp axes
+    local_state_keys: Tuple[str, ...] = ()
+
     def __init__(self, inner: Optimizer) -> None:
         self.inner = inner
         # expose the outermost grad_clip contract
@@ -65,6 +93,24 @@ class MetaOptimizerBase(Optimizer):
 
     def _init_extra(self, params: PyTree) -> Dict[str, Any]:
         return {}
+
+    def state_layout(self, opt_state: Dict[str, Any]) -> Dict[str, Any]:
+        """Tag tree congruent with ``opt_state``: each leaf is one of
+        "rep" (replicated across dp ranks), "local" (per-rank; trainer
+        adds a leading world dim) or "shard" (flat 1/K shard per rank —
+        ZeRO slots under a shard-mode reducer). Consumed by
+        SpmdTrainer's fused step to derive in/out specs."""
+        out: Dict[str, Any] = {}
+        for k, sub in opt_state.items():
+            if k == "inner":
+                inner = self.inner
+                out[k] = (inner.state_layout(sub)
+                          if isinstance(inner, MetaOptimizerBase)
+                          else _tmap(lambda _: "rep", sub))
+            else:
+                tag = "local" if k in self.local_state_keys else "rep"
+                out[k] = _tmap(lambda _, t=tag: t, sub)
+        return out
 
     def update(self, grads, opt_state, params):
         raise NotImplementedError
@@ -83,11 +129,12 @@ class AMPOptimizer(MetaOptimizerBase):
     def __init__(self, inner: Optimizer, init_loss_scaling: float = 2.0 ** 15,
                  incr_every_n_steps: int = 1000, decr_every_n_nan_or_inf: int = 2,
                  incr_ratio: float = 2.0, decr_ratio: float = 0.5,
-                 use_dynamic_loss_scaling: bool = True) -> None:
+                 use_dynamic_loss_scaling: bool = True, reducer=None) -> None:
         super().__init__(inner)
         self.scaler = GradScaler(init_loss_scaling, incr_ratio, decr_ratio,
                                  incr_every_n_steps, decr_every_n_nan_or_inf,
                                  use_dynamic_loss_scaling)
+        self.reducer = reducer
 
     def _init_extra(self, params):
         return {"scaler": self.scaler.init()}
@@ -98,6 +145,12 @@ class AMPOptimizer(MetaOptimizerBase):
     def update(self, grads, opt_state, params):
         sstate: LossScaleState = opt_state["scaler"]
         grads, ok = self.scaler.unscale(grads, sstate)
+        if self.reducer is not None:
+            # pre-reduction contract: each rank checked only its LOCAL
+            # grads — the skip/apply decision must be uniform or the dp
+            # replicas diverge (and a cond with collectives inside would
+            # take different branches per rank)
+            ok = self.reducer.sync_all_finite(ok)
 
         def apply(_):
             return self.inner.update(grads, opt_state["inner"], params)
@@ -113,7 +166,16 @@ class GradientMergeOptimizer(MetaOptimizerBase):
     """Gradient accumulation over ``k_steps`` micro-steps
     (fleet/meta_optimizers/gradient_merge_optimizer.py; the reference
     wraps the program body in a conditional block keyed on a step
-    counter — here the same cond lives inside the compiled step)."""
+    counter — here the same cond lives inside the compiled step).
+
+    Pre-reduction contract: ``acc`` accumulates LOCAL grads (per-rank
+    state, hence ``local_state_keys``); the fused collective lives in
+    the inner chain, INSIDE the apply branch — held steps compile to a
+    conditional whose taken branch has no collective at all, so merged
+    steps cost one reduction instead of k (tools/hlo_bytes.py verifies
+    the collectives sit inside the HLO conditional)."""
+
+    local_state_keys = ("acc",)
 
     def __init__(self, inner: Optimizer, k_steps: int = 1, avg: bool = True) -> None:
         super().__init__(inner)
@@ -155,21 +217,34 @@ class LocalSGDOptimizer(MetaOptimizerBase):
     ``lax.pmean`` over that axis."""
 
     def __init__(self, inner: Optimizer, k_steps: int = 1, axis: str = "dp",
-                 sync_fn: Optional[Callable[[PyTree], PyTree]] = None) -> None:
+                 sync_fn: Optional[Callable[[PyTree], PyTree]] = None,
+                 reducer=None) -> None:
         super().__init__(inner)
         self.k_steps = int(k_steps)
+        # axis may be one name or a tuple (the reducer's joint dp axes)
         self.axis = axis
+        axes = (axis,) if isinstance(axis, str) else tuple(axis)
+        self.reducer = reducer
         # pcast back to 'varying' so both lax.cond branches carry the
         # same manual-axes type under shard_map
         self._sync = sync_fn or (lambda tree: _tmap(
-            lambda x: lax.pcast(lax.pmean(x, self.axis), (self.axis,), to="varying"),
+            lambda x: lax.pcast(lax.pmean(x, axes), axes, to="varying"),
             tree))
 
     def _init_extra(self, params):
         return {"count": jnp.zeros((), jnp.int32)}
 
     def update(self, grads, opt_state, params):
-        new_params, new_inner = self.inner.update(grads, opt_state["inner"], params)
+        if self.reducer is not None:
+            # localsgd's whole comm saving: inner steps use LOCAL grads
+            # — no per-step gradient collective; only the every-k param
+            # averaging below crosses ICI
+            with self.reducer.suspended():
+                new_params, new_inner = self.inner.update(
+                    grads, opt_state["inner"], params)
+        else:
+            new_params, new_inner = self.inner.update(
+                grads, opt_state["inner"], params)
         count = opt_state["count"] + 1
         ready = count >= self.k_steps
         new_params = lax.cond(ready, self._sync, lambda t: t, new_params)
@@ -185,9 +260,12 @@ class DGCMomentumOptimizer(MetaOptimizerBase):
     accumulation ``v += u``, then only the top-``(1-sparsity)`` fraction
     of ``|v|`` is released to the allreduce + update this step; the rest
     stays in the residual. Sparsity ramps along ``sparsity`` every
-    ``rampup_step`` steps. Under shard_map dp the released tensor is
-    what crosses ICI — the comm saving the reference gets from sparse
-    allreduce."""
+    ``rampup_step`` steps. Under the pre-reduction contract the released
+    tensor is what feeds the inner chain's fused collective — the
+    residual genuinely never crosses ICI (the comm saving the reference
+    gets from sparse allreduce); ``u``/``v`` are per-rank state."""
+
+    local_state_keys = ("u", "v")
 
     def __init__(self, inner: Optimizer, momentum: float = 0.9,
                  rampup_begin_step: int = 0, rampup_step: int = 1,
@@ -238,19 +316,120 @@ class DGCMomentumOptimizer(MetaOptimizerBase):
 
 class FP16AllReduceOptimizer(MetaOptimizerBase):
     """fp16_allreduce (fleet/meta_optimizers/fp16_allreduce_optimizer.py):
-    gradients cross the wire in half precision. In-graph, casting the
-    grads to bf16 before they feed the (XLA-inserted) psum makes the
-    collective ride ICI at half width; cast back for the update."""
+    gradients cross the wire in half precision.
 
-    def __init__(self, inner: Optimizer, dtype=jnp.bfloat16) -> None:
+    With a ``reducer`` (the explicit fused-collective path,
+    comm_fusion.py) the dtype is routed to the bucket collectives
+    themselves — the dp gradient collective's ELEMENT TYPE becomes
+    ``dtype`` and half the bytes ride ICI (regression-tested via
+    tools/hlo_bytes.py, which is what caught the previous version:
+    casting to bf16 and back UPSTREAM of the AD-inserted fp32 psum
+    passed every numeric test while moving zero fewer bytes).
+
+    Without a reducer (serial, dp=1, or the legacy GSPMD path where XLA
+    inserts the psum upstream of this wrapper) no wire narrowing is
+    possible here; the round-trip cast is kept solely so the serial
+    path reproduces the distributed path's wire PRECISION."""
+
+    def __init__(self, inner: Optimizer, dtype=jnp.bfloat16, reducer=None) -> None:
         super().__init__(inner)
         self.dtype = dtype
+        self.reducer = reducer
 
     def update(self, grads, opt_state, params):
+        r = self.reducer
+        if r is not None and r.active:
+            with r.wire_dtype(self.dtype):
+                new_params, new_inner = self.inner.update(
+                    grads, opt_state["inner"], params)
+            return new_params, {"inner": new_inner}
         half = _tmap(lambda g: g.astype(self.dtype), grads)
-        restored = _tmap(lambda h, g: h.astype(g.dtype), half, grads)
+        restored = _tmap(lambda h, g: h.astype(g.dtype), half, grads)  # graftlint: ignore[cast-roundtrip] — intentional wire-precision simulation on the no-reducer path (see docstring)
         new_params, new_inner = self.inner.update(restored, opt_state["inner"], params)
         return new_params, {"inner": new_inner}
+
+
+class FusedAllReduceOptimizer(MetaOptimizerBase):
+    """THE reduction point of the pre-reduction contract: mean-reduces
+    the (possibly DGC-compressed, possibly wire-dtype-overridden)
+    gradients over the dp axes with the reducer's fused-bucket
+    collectives, then hands them to the base optimizer.
+
+    ``apply_strategy`` inserts it innermost (inside DGC's compression,
+    inside FP16AllReduce's wire-dtype scope, inside GradientMerge's
+    apply branch). Holds the fp32 error-feedback residual (int8 quant)
+    as per-rank state.
+
+    Shard-mode reducer (ZeRO stage 1/2): the inner optimizer was
+    initialized over flat 1/K shards (``global_shard_template``) and
+    consumes the reduce-scattered segment directly — update compute and
+    slot memory scale 1/K and the updated params come back via one
+    fused all_gather per bucket, never allreduce-then-slice."""
+
+    local_state_keys = ("ef",)
+
+    def __init__(self, inner: Optimizer, reducer) -> None:
+        super().__init__(inner)
+        enforce(reducer is not None, "FusedAllReduceOptimizer needs a reducer")
+        self.reducer = reducer
+        self._param_treedef = None
+
+    def init(self, params):
+        self._param_treedef = jax.tree_util.tree_structure(params)
+        if self.reducer.shard and self.reducer.K > 1:
+            inner_params = self.reducer.global_shard_template(params)
+        else:
+            inner_params = params
+        return {"inner": self.inner.init(inner_params),
+                "ef": self.reducer.init_ef(params)}
+
+    def state_layout(self, opt_state):
+        r = self.reducer
+        inner_st = opt_state["inner"]
+        if r.shard and r.K > 1:
+            # base slots mirror the (flat-shard) param tree → "shard";
+            # schedule/step scalars replicate
+            from ..optimizer import map_param_slots
+
+            treedef = self._param_treedef
+
+            def tag_tree(sub, tag):
+                return _tmap(lambda _, t=tag: t, sub)
+
+            inner_tags = {}
+            for k, sub in inner_st.items():
+                if k == "slots":
+                    template = jax.tree_util.tree_unflatten(
+                        treedef, [0] * treedef.num_leaves)
+                    inner_tags[k] = map_param_slots(
+                        sub, template,
+                        mirror_fn=lambda s: tag_tree(s, "shard"),
+                        other_leaf_fn=lambda _: "rep")
+                else:
+                    inner_tags[k] = tag_tree(sub, "rep")
+        else:
+            inner = self.inner
+            inner_tags = (inner.state_layout(inner_st)
+                          if isinstance(inner, MetaOptimizerBase)
+                          else _tmap(lambda _: "rep", inner_st))
+        return {"inner": inner_tags,
+                "ef": _tmap(lambda _: "local", opt_state["ef"])}
+
+    def update(self, grads, opt_state, params):
+        r = self.reducer
+        ef = opt_state["ef"]
+        if r.shard and r.K > 1:
+            if r.active:
+                g_sh, new_ef = r.reduce_to_shards(grads, ef)
+            else:  # suspended (LocalSGD): local shard, no collective
+                g_sh, new_ef = r.slice_local_shards(grads), ef
+            p_sh = r.slice_local_shards(params)
+            new_p_sh, new_inner = self.inner.update(g_sh, opt_state["inner"], p_sh)
+            new_params = r.gather_params_from_shards(new_p_sh, params)
+        else:
+            red, new_ef = r.reduce(grads, ef)
+            new_params, new_inner = self.inner.update(red, opt_state["inner"], params)
+        return new_params, {"inner": new_inner, "ef": new_ef}
 
 
 class ASPOptimizer(MetaOptimizerBase):
@@ -347,13 +526,23 @@ def select_runtime(strategy) -> Dict[str, Any]:
     return {"runtime": "single", "kwargs": {}}
 
 
-def apply_strategy(optimizer: Optimizer, strategy) -> Optimizer:
+def apply_strategy(optimizer: Optimizer, strategy, reducer=None) -> Optimizer:
     """StrategyCompiler analogue (fleet/base/strategy_compiler.py):
     build the wrapper chain a DistributedStrategy implies. Conflicting
     combos follow the reference's ``_can_apply`` rules: lars/lamb swap
     the base optimizer; dgc requires a momentum-family base and
-    excludes amp's loss scaling on the same grads."""
+    excludes amp's loss scaling on the same grads.
+
+    ``reducer`` (comm_fusion.DpGradReducer) switches the chain to the
+    PRE-REDUCTION contract: a FusedAllReduceOptimizer is inserted
+    innermost (inside DGC's compression) and the dtype/suspend hooks of
+    FP16AllReduce/LocalSGD/AMP are wired to it. Without a reducer the
+    chain behaves exactly as before (grads arrive already reduced —
+    serial trainers and the GSPMD path)."""
     opt = optimizer
+
+    def synced(o: Optimizer) -> Optimizer:
+        return FusedAllReduceOptimizer(o, reducer) if reducer is not None else o
 
     # base swaps (reference: LarsOptimizer/LambOptimizer replace the op);
     # the user's grad_clip carries over to the swapped-in optimizer
@@ -379,20 +568,28 @@ def apply_strategy(optimizer: Optimizer, strategy) -> Optimizer:
         inner = SGD(learning_rate=opt.schedule, grad_clip=opt.grad_clip,
                     weight_decay=opt.weight_decay)
         opt = DGCMomentumOptimizer(
-            inner, momentum=getattr(opt, "momentum", 0.0),
+            synced(inner), momentum=getattr(opt, "momentum", 0.0),
             rampup_begin_step=cfg.get("rampup_begin_step", 0),
             rampup_step=cfg.get("rampup_step", 1),
             sparsity=cfg.get("sparsity", [0.999]))
+    else:
+        # no compression stage: the fused reduction wraps the base
+        # directly (still innermost — everything below sees raw local
+        # grads, everything above the chain's single collective)
+        opt = synced(opt)
 
     if getattr(strategy, "fp16_allreduce", False):
-        opt = FP16AllReduceOptimizer(opt)
+        opt = FP16AllReduceOptimizer(opt, reducer=reducer)
 
     if getattr(strategy, "asp", False):
         opt = ASPOptimizer(opt)
 
     if getattr(strategy, "localsgd", False):
         cfg = getattr(strategy, "localsgd_configs", {}) or {}
-        opt = LocalSGDOptimizer(opt, k_steps=cfg.get("k_steps", 1))
+        opt = LocalSGDOptimizer(
+            opt, k_steps=cfg.get("k_steps", 1),
+            axis=(reducer.axes if reducer is not None else "dp"),
+            reducer=reducer)
 
     if getattr(strategy, "gradient_merge", False):
         cfg = getattr(strategy, "gradient_merge_configs", {}) or {}
@@ -411,6 +608,9 @@ def apply_strategy(optimizer: Optimizer, strategy) -> Optimizer:
             decr_every_n_nan_or_inf=cfg.get("decr_every_n_nan_or_inf", 2),
             incr_ratio=cfg.get("incr_ratio", 2.0),
             decr_ratio=cfg.get("decr_ratio", 0.5),
-            use_dynamic_loss_scaling=cfg.get("use_dynamic_loss_scaling", True))
+            use_dynamic_loss_scaling=cfg.get("use_dynamic_loss_scaling", True),
+            reducer=reducer)
 
+    if reducer is not None:
+        reducer.installed = True
     return opt

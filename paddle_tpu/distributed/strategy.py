@@ -88,6 +88,15 @@ class DistributedStrategy:
                                  "sparsity": [0.999]}
     )
     fp16_allreduce: bool = False
+    # --- dense-DP comm fusion (reference: fuse_all_reduce_ops +
+    # fuse_grad_size_in_MB, proto:62-64; quant knobs are the EQuARX
+    # extension — distributed/comm_fusion.py) ---
+    fuse_all_reduce_ops: bool = False
+    fuse_grad_size_in_MB: int = 32
+    comm_fusion_configs: Dict[str, Any] = dataclasses.field(
+        default_factory=lambda: {"max_buckets": 8, "quant": "none",
+                                 "block_size": 256, "error_feedback": True}
+    )
     # ASP 2:4 structured sparsity (fleet ASP meta-optimizer)
     asp: bool = False
     # static DP: reference raw_program_optimizer inserts c_allreduce_sum;

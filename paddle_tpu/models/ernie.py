@@ -93,21 +93,36 @@ def _take_rows(table: jax.Array, ids: jax.Array, total_rows: int,
 
 
 def parallel_cross_entropy(logits: jax.Array, labels: jax.Array,
-                           vocab_size: int, axis: Optional[str] = "mp") -> jax.Array:
+                           vocab_size: int, axis: Optional[str] = "mp",
+                           pinned_vjp: bool = False) -> jax.Array:
     """Per-token CE over vocab-sharded logits (c_softmax_with_cross_entropy
     semantics; see parallel/mp_layers.py ParallelCrossEntropy). Works on
-    full logits too (serial path)."""
+    full logits too (serial path).
+
+    ``pinned_vjp``: the two differentiated mp reductions use the
+    pinned-identity-VJP psum (the PR-2 mp_layers treatment). REQUIRED
+    inside a ``check_rep=False``/``check_vma=False`` shard_map where all
+    cross-rank reductions are explicit (hybrid's step): there, jax
+    0.4.x's plain psum→psum transpose would scale the logits gradient —
+    and everything upstream — by the mp size (the exact constant-×mp
+    gradient error test_hybrid_grads_match_serial pins down). Leave
+    False under a rep-tracking shard_map (the default ``check_rep=True``
+    harnesses, e.g. test_ernie's TP parity), where the tracker pairs
+    the plain psum with the correct transpose itself and a pinned VJP
+    would break that pairing."""
     per = logits.shape[-1]
     if not _axis_active(axis) or per == vocab_size:
         return nn.functional.cross_entropy(logits, labels, reduction="none")
+    psum = coll.psum_replicated if pinned_vjp else lax.psum
     start = lax.axis_index(axis) * per
     local_max = lax.stop_gradient(jnp.max(logits, axis=-1, keepdims=True))
     gmax = lax.pmax(local_max, axis)
-    lse = jnp.log(lax.psum(jnp.sum(jnp.exp(logits - gmax), axis=-1, keepdims=True), axis)) + gmax
+    lse = jnp.log(psum(
+        jnp.sum(jnp.exp(logits - gmax), axis=-1, keepdims=True), axis)) + gmax
     local = labels - start
     ok = (local >= 0) & (local < per)
     picked = jnp.take_along_axis(logits, jnp.clip(local, 0, per - 1)[..., None], axis=-1)[..., 0]
-    picked = lax.psum(jnp.where(ok, picked, 0.0), axis)
+    picked = psum(jnp.where(ok, picked, 0.0), axis)
     return lse[..., 0] - picked
 
 

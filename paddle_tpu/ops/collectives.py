@@ -42,6 +42,8 @@ __all__ = [
     "axis_size",
     "barrier",
     "split_axis",
+    "psum_replicated",
+    "spec_reduced_grads",
     "ProcessGroup",
     "ReduceOp",
 ]
@@ -97,6 +99,48 @@ def ppermute(x: jax.Array, axis: str, perm: Sequence[tuple]) -> jax.Array:
     """partial_send/recv pairs → a single compiled permutation
     (PP p2p and ring-attention KV rotation both use this)."""
     return lax.ppermute(x, axis, perm)
+
+
+def _psum_replicated_impl(x, axis_name):
+    """psum of a value whose DOWNSTREAM cotangent is replicated over
+    ``axis_name`` (every shard computes the same loss from the summed
+    result): the correct per-shard gradient is that cotangent unscaled.
+    jax 0.4.x shard_map transposes a plain psum into another psum (with
+    either check_rep setting), which would scale such gradients by the
+    axis size — the custom VJP pins the identity backward, and stays
+    correct under the vma-era semantics too. ``axis_name`` may be one
+    axis or a tuple of axes (the mp CE reductions and the hybrid loss
+    reduction both route through here — shared by mp_layers/hybrid)."""
+    return lax.psum(x, axis_name)
+
+
+# axis_name is static (a string or tuple), not a differentiable input
+psum_replicated = jax.custom_vjp(_psum_replicated_impl, nondiff_argnums=(1,))
+psum_replicated.defvjp(
+    lambda x, axis_name: (lax.psum(x, axis_name), None),
+    lambda axis_name, _, ct: (ct,))
+
+
+def spec_reduced_grads(grads, specs, mesh_shape) -> jax.Array:
+    """Explicit spec-driven gradient reduction for a ``check_rep=False``
+    / ``check_vma=False`` shard_map step where autodiff inserts NO
+    cross-rank reductions (every differentiated psum pinned via
+    :func:`psum_replicated`): each rank then holds only its own partial
+    contribution, and the true gradient of a param is the psum over
+    every mesh axis the param is NOT sharded on — batch/sequence shards
+    and tensor-parallel partials sum to the full gradient, while
+    disjoint contributions (pipeline-stage-owned aux params) are zero
+    off their owning rank. Axes IN the param's spec hold that rank's
+    own shard and are left alone. Shared by the hybrid trainer and the
+    TP parity tests (one definition for the next jax-drift fix)."""
+    def reduce_one(g, spec):
+        in_spec = {a for e in tuple(spec)
+                   for a in (e if isinstance(e, tuple) else (e,)) if a}
+        red = tuple(a for a in mesh_shape
+                    if a not in in_spec and mesh_shape[a] > 1)
+        return lax.psum(g, red) if red else g
+
+    return jax.tree_util.tree_map(reduce_one, grads, specs)
 
 
 def shift(x: jax.Array, axis: str, offset: int = 1) -> jax.Array:

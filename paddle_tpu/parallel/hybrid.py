@@ -50,6 +50,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import nn
 from ..core.enforce import enforce, enforce_eq
+from ..ops import collectives as coll
 from ..models.ernie import (Ernie, ErnieConfig, ErnieEmbedding, ErnieHead,
                             ErnieStage, parallel_cross_entropy, partition_spec)
 from .pipeline import pipeline_spmd_fn
@@ -174,26 +175,50 @@ class HybridParallelTrainer:
             key = jax.random.fold_in(rng, lax.axis_index("pp"))
             with nn.rng_guard(key):
                 logits = pipe(params["stages"], params["aux"], ids_micro)
+            # pinned_vjp: this step runs check_vma=False with every
+            # reduction explicit — see parallel_cross_entropy's docstring
             ce = parallel_cross_entropy(logits, labels_micro, cfg.vocab_size,
-                                        cfg.mp_axis)
+                                        cfg.mp_axis, pinned_vjp=True)
             local = jnp.mean(ce)
-            # mean over the (dp×sh)×cp token grid (equal shard sizes)
-            return lax.psum(local / (batch_n * cp_n),
-                            batch_axes + ("cp",) + mp_extra)
+            # mean over the (dp×sh)×cp token grid (equal shard sizes).
+            # The loss psum is DIFFERENTIATED (value_and_grad below) and
+            # its cotangent is replicated over these axes, so it must be
+            # the pinned-VJP psum: jax 0.4.x shard_map transposes a plain
+            # psum into another psum, scaling every grad by the axis-size
+            # product (the latent issue flagged in CHANGES.md PR 2 — the
+            # slow hybrid parity tests failed at baseline because of it).
+            return coll.psum_replicated(local / (batch_n * cp_n),
+                                        batch_axes + ("cp",) + mp_extra)
+
+        mesh_shape = dict(mesh.shape)
 
         def spmd_step(params, ids_micro, labels_micro, rng):
-            return jax.value_and_grad(spmd_loss)(params, ids_micro,
-                                                 labels_micro, rng)
+            loss, grads = jax.value_and_grad(spmd_loss)(params, ids_micro,
+                                                        labels_micro, rng)
+            # explicit spec-driven reductions (the pipeline-trainer
+            # treatment from PR 2): check_rep=False + pinned-VJP psums
+            # keep every cotangent PARTIAL per rank, so each param
+            # psums over exactly the axes it is replicated on — see
+            # coll.spec_reduced_grads
+            grads = coll.spec_reduced_grads(grads, self._param_specs,
+                                            mesh_shape)
+            return loss, grads
 
         # ids/labels: [num_micro, B_local, L_local] → batch over dp(×sh),
         # seq over cp
         data_spec = P(None, batch_axes, "cp")
         self._data_spec = data_spec
+        # check_vma=False: every reduction in this step is EXPLICIT
+        # (pinned-VJP psums in the loss, the pipe's masked psum and the
+        # PCE internals) — jax 0.4.x's rep-tracking rewrite must not
+        # second-guess the backward (it misrouted it; see pipeline.py's
+        # masked-psum note and test_hybrid_grads_match_serial)
         grad_fn = shard_map(
             spmd_step,
             mesh=mesh,
             in_specs=(self._param_specs, data_spec, data_spec, P()),
             out_specs=(P(), self._param_specs),
+            check_vma=False,
         )
 
         # ZeRO: shard every optimizer slot leaf over "sh" (params/grads
@@ -219,7 +244,36 @@ class HybridParallelTrainer:
                     self._opt_shardings)
             return new_params, new_opt, loss
 
-        self._step = jax.jit(step, donate_argnums=(0, 1))
+        # PIN carried-state shardings on the step (the Engine treatment
+        # from PR 2): without them the first call compiles against
+        # uncommitted inputs while later calls compile against whatever
+        # output layout GSPMD chose, and on jax 0.4.37 those two
+        # executables COMPUTE DIFFERENT VALUES (the steady-state one
+        # disagreed with the serial forward oracle by ~5%, which is what
+        # actually failed test_hybrid_save_load_resume — a resumed
+        # trainer starts on the fresh executable while the donor
+        # continued on the drifted one). One pinned layout ⇒ one
+        # executable ⇒ save/load and cross-mesh parity are exact.
+        from jax.sharding import NamedSharding
+
+        ns = lambda spec: NamedSharding(mesh, spec)
+        param_shardings = jax.tree_util.tree_map(
+            ns, self._param_specs, is_leaf=lambda x: isinstance(x, P))
+        opt_shardings = (self._opt_shardings if self._opt_shardings is not None
+                         else jax.tree_util.tree_map(lambda _: ns(P()),
+                                                     self.opt_state))
+        if self._multihost:
+            # opt state came out of jit(init) with GSPMD-chosen layouts;
+            # re-place it to match the pinned step signature
+            self.opt_state = jax.tree_util.tree_map(
+                jax.device_put, self.opt_state, opt_shardings)
+        data_sh = ns(self._data_spec)
+        self._step = jax.jit(
+            step,
+            in_shardings=(param_shardings, opt_shardings, data_sh, data_sh,
+                          ns(P())),
+            out_shardings=(param_shardings, opt_shardings, ns(P())),
+            donate_argnums=(0, 1))
         self._rng = jax.random.key(seed)
         self.global_step = 0
 

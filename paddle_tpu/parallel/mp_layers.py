@@ -151,22 +151,9 @@ class RowParallelLinear(Layer):
         return y
 
 
-def _psum_replicated_impl(x, axis_name):
-    """psum of a value whose DOWNSTREAM cotangent is replicated over
-    ``axis_name`` (every shard computes the same loss from the summed
-    result): the correct per-shard gradient is that cotangent unscaled.
-    jax 0.4.x shard_map transposes a plain psum into another psum (with
-    either check_rep setting), which would scale such gradients by the
-    axis size — the custom VJP pins the identity backward, and stays
-    correct under the vma-era semantics too."""
-    return lax.psum(x, axis_name)
-
-
-# axis_name is static (a string), not a differentiable input
-_psum_replicated = jax.custom_vjp(_psum_replicated_impl, nondiff_argnums=(1,))
-_psum_replicated.defvjp(
-    lambda x, axis_name: (lax.psum(x, axis_name), None),
-    lambda axis_name, _, ct: (ct,))
+# pinned-VJP psum (moved to ops.collectives so hybrid.py's loss
+# reduction shares the one definition); see its docstring
+_psum_replicated = coll.psum_replicated
 
 
 class ParallelCrossEntropy(Layer):

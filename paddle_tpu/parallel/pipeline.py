@@ -35,6 +35,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .. import nn
 from ..core.enforce import enforce, enforce_eq
 from ..nn.layer import Layer
+from ..ops import collectives as coll
 
 __all__ = ["LayerDesc", "PipelineLayer", "pipeline_spmd_fn", "PipelineTrainer"]
 
@@ -146,9 +147,21 @@ def pipeline_spmd_fn(
         y = lax.slice_in_dim(outs, num_stages - 1, num_stages - 1 + num_micro, axis=0)
         if head_apply is not None:
             y = head_apply(aux_state.get("head"), y)
-        # only the last stage computed real outputs; replicate via masked psum
+        # only the last stage computed real outputs; replicate via masked
+        # psum. The psum is DIFFERENTIATED by callers (hybrid's
+        # value_and_grad runs straight through the pipe), and its
+        # downstream cotangent is replicated over pp (every rank computes
+        # the same loss from the replicated output) — so it must be the
+        # pinned-VJP psum: jax 0.4.x transposes a plain psum into another
+        # psum, and with the no-op pcast shim the rep-tracker misroutes
+        # the backward entirely (head grads came back ZERO, stage grads
+        # ~2x — caught against the serial-grad oracle, see
+        # test_hybrid_grads_match_serial). The is_last mask then hands
+        # the unscaled cotangent to the last rank's path only, which is
+        # also exactly what the f_then_b trainer's masked local loss
+        # seeds, so both callers stay correct.
         is_last = (stage == num_stages - 1).astype(y.dtype)
-        y = lax.psum(y * is_last, pp_axis)
+        y = coll.psum_replicated(y * is_last, pp_axis)
         return y
 
     return fn

@@ -49,12 +49,19 @@ class _ShardingStage:
         loss_fn: Callable,
         mesh: Mesh,
         batch_axes: Sequence[str] = ("dp", "sharding"),
+        comm=None,
         **kw,
     ) -> SpmdTrainer:
+        """``comm`` (CommFusionConfig) routes stage-1/2 gradients through
+        the fused explicit reduce-scatter: the optimizer consumes each
+        rank's bucket shard directly (optionally bf16/int8-quantized on
+        the wire) instead of GSPMD's allreduce-then-slice — see
+        parallel/spmd.py's fused path."""
         enforce("sharding" in mesh.axis_names,
                 "mesh needs a 'sharding' axis for group-sharded training")
         return SpmdTrainer(self.model, self.optimizer, loss_fn, mesh,
-                           zero_stage=self.stage, batch_axes=batch_axes, **kw)
+                           zero_stage=self.stage, batch_axes=batch_axes,
+                           comm=comm, **kw)
 
 
 class ShardingStage1(_ShardingStage):

@@ -107,6 +107,18 @@ class SpmdTrainer:
     Covers DP (batch over ``dp``+``sharding``), ZeRO stages 0-3, and —
     because parameters can carry any extra shardings the model's layers
     imply under GSPMD — composes with tensor-parallel param shardings.
+
+    ``comm`` (a :class:`~paddle_tpu.distributed.comm_fusion.
+    CommFusionConfig` or its dict form) switches the dense gradient
+    reduction to the EXPLICIT fused-bucket path: the step becomes a
+    shard_map over the batch axes, gradients reach the optimizer chain
+    pre-reduction, and the chain's FusedAllReduceOptimizer performs
+    ≤``max_buckets`` per-dtype bucket collectives with optional
+    bf16/int8 block quantization (docs/OPERATIONS.md "Dense comm
+    compression tuning"). ``strategy`` builds the meta-optimizer chain
+    (``apply_strategy``) wired to that reducer. With ``comm=None`` (or
+    a 1-device batch) every path is byte-for-byte the previous GSPMD
+    behavior.
     """
 
     def __init__(
@@ -118,13 +130,30 @@ class SpmdTrainer:
         zero_stage: int = 0,
         batch_axes: Sequence[str] = ("dp", "sharding"),
         seed: int = 0,
+        comm=None,
+        strategy=None,
     ) -> None:
         enforce(0 <= zero_stage <= 3, "zero_stage in [0,3]")
         self.model = model
-        self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.zero_stage = zero_stage
+
+        axes = tuple(a for a in batch_axes
+                     if a in mesh.shape and mesh.shape[a] > 1)
+        comm_cfg = self._resolve_comm(comm, strategy)
+        fused = comm_cfg is not None and axes and zero_stage <= 2
+        if fused:
+            state = nn.get_state(model)
+            self._build_fused_dp_step(
+                model, optimizer, mesh, state, axes, comm_cfg, strategy,
+                zero_stage, seed)
+            return
+        if strategy is not None:
+            from ..distributed.meta_optimizers import apply_strategy
+
+            optimizer = apply_strategy(optimizer, strategy)
+        self.optimizer = optimizer
 
         state = nn.get_state(model)
         opt_state = optimizer.init(state["params"])
@@ -173,6 +202,151 @@ class SpmdTrainer:
             out_shardings=(self._state_sh, self._opt_sh, NamedSharding(mesh, PartitionSpec())),
             donate_argnums=(0, 1),
         )
+
+    @staticmethod
+    def _resolve_comm(comm, strategy):
+        """Normalize the comm-fusion request: an explicit ``comm`` wins;
+        otherwise a strategy with ``fuse_all_reduce_ops`` supplies its
+        ``comm_fusion_configs``/``fuse_grad_size_in_MB`` knobs."""
+        from ..distributed.comm_fusion import CommFusionConfig
+
+        if comm is not None:
+            if isinstance(comm, CommFusionConfig):
+                return comm
+            return CommFusionConfig.from_configs(dict(comm))
+        if strategy is not None and getattr(strategy, "fuse_all_reduce_ops",
+                                            False):
+            cfg = dict(getattr(strategy, "comm_fusion_configs", {}) or {})
+            cfg.setdefault("fuse_grad_size_in_MB",
+                           getattr(strategy, "fuse_grad_size_in_MB", 32))
+            return CommFusionConfig.from_configs(cfg)
+        return None
+
+    def _build_fused_dp_step(self, model, optimizer, mesh, state, axes,
+                             comm_cfg, strategy, zero_stage, seed):
+        """The explicit dense-DP path: one shard_map over the batch axes
+        whose gradients reach the optimizer chain PRE-reduction; the
+        chain's FusedAllReduceOptimizer runs the per-bucket collectives
+        (psum for fp32, two-stage all_to_all/all_gather for bf16/int8).
+        ZeRO stage 1/2 hands the inner optimizer the reduce-scattered
+        flat shard directly (never allreduce-then-slice); params stay
+        replicated at global shapes (stage-3 stays on the GSPMD path).
+        """
+        import numpy as np
+
+        from jax import lax, shard_map
+        from ..distributed.comm_fusion import DpGradReducer
+        from ..distributed.meta_optimizers import (FusedAllReduceOptimizer,
+                                                   LocalSGDOptimizer,
+                                                   MetaOptimizerBase,
+                                                   apply_strategy)
+
+        sizes = tuple(mesh.shape[a] for a in axes)
+        reducer = DpGradReducer(axes, sizes, comm_cfg,
+                                shard=zero_stage in (1, 2))
+        if strategy is not None:
+            optimizer = apply_strategy(optimizer, strategy, reducer=reducer)
+        elif not isinstance(optimizer, MetaOptimizerBase):
+            optimizer = FusedAllReduceOptimizer(optimizer, reducer)
+        else:
+            enforce(False, "fused comm path: pass a plain optimizer (auto-"
+                           "wrapped) or a strategy= to build the chain; a "
+                           "pre-built meta-optimizer chain has no reducer "
+                           "installed")
+        node = optimizer
+        while isinstance(node, MetaOptimizerBase):
+            enforce(not isinstance(node, LocalSGDOptimizer),
+                    "localsgd keeps per-rank params between syncs, which "
+                    "this trainer's replicated-param step cannot represent "
+                    "— run localsgd on the GSPMD path (comm=None)")
+            node = node.inner
+        self.optimizer = optimizer
+        self.reducer = reducer
+        K = reducer.K
+
+        opt_state = optimizer.init(state["params"])
+        tags = optimizer.state_layout(opt_state)
+
+        # per-rank ("local") state gets a leading world dim; everything
+        # else keeps its shape. Specs: rep→replicated, local/shard→dim0
+        # split jointly over the batch axes.
+        joint = tuple(axes)
+
+        def expand(x, tag):
+            if tag != "local":
+                return x
+            a = np.asarray(x)
+            return jnp.asarray(np.broadcast_to(a, (K,) + a.shape).copy())
+
+        opt_state = jax.tree_util.tree_map(expand, opt_state, tags)
+        spec_of = lambda tag: (PartitionSpec() if tag == "rep"
+                               else PartitionSpec(joint))
+        opt_specs = jax.tree_util.tree_map(spec_of, tags)
+        self._opt_sh = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), opt_specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
+        replicated = NamedSharding(mesh, PartitionSpec())
+        self._state_sh = jax.tree_util.tree_map(lambda _: replicated, state)
+        self._batch_sh = NamedSharding(mesh, PartitionSpec(joint))
+
+        self.state = jax.device_put(state, self._state_sh)
+        self.opt_state = jax.device_put(opt_state, self._opt_sh)
+        self._rng = jax.random.key(seed)
+        self.global_step = 0
+
+        loss_fn = self.loss_fn
+
+        def inner(state, opt_state, rng, inputs, labels):
+            params, buffers = state["params"], state["buffers"]
+            key = rng
+            for a in axes:
+                key = jax.random.fold_in(key, lax.axis_index(a))
+            # local block of per-rank state is (1, *shape) — drop the dim
+            opt_local = jax.tree_util.tree_map(
+                lambda x, t: x.reshape(x.shape[1:]) if t == "local" else x,
+                opt_state, tags)
+
+            def compute_loss(params):
+                out, new_state = nn.functional_call(
+                    model, {"params": params, "buffers": buffers},
+                    *inputs, rng=key, training=True)
+                loss = loss_fn(out, *labels)
+                scaled = (optimizer.scale_loss(loss, opt_local)
+                          if hasattr(optimizer, "scale_loss") else loss)
+                return scaled, (loss, new_state["buffers"])
+
+            # LOCAL gradients — no AD-inserted psum; the optimizer chain
+            # owns the (fused, compressible) reduction
+            (_, (loss, new_buffers)), grads = jax.value_and_grad(
+                compute_loss, has_aux=True)(params)
+            new_params, new_opt = optimizer.update(grads, opt_local, params)
+            new_opt = jax.tree_util.tree_map(
+                lambda x, t: x[None] if t == "local" else x, new_opt, tags)
+            loss = lax.pmean(loss, axes)
+            new_buffers = jax.tree_util.tree_map(
+                lambda b: lax.pmean(b, axes)
+                if getattr(b, "dtype", None) in (jnp.float32, jnp.bfloat16)
+                else b, new_buffers)
+            return ({"params": new_params, "buffers": new_buffers},
+                    new_opt, loss)
+
+        state_specs = jax.tree_util.tree_map(lambda _: PartitionSpec(), state)
+        batch_spec = PartitionSpec(joint)
+        shmapped = shard_map(
+            inner, mesh=mesh,
+            in_specs=(state_specs, opt_specs, PartitionSpec(),
+                      batch_spec, batch_spec),
+            out_specs=(state_specs, opt_specs, PartitionSpec()),
+            check_vma=False,
+        )
+        # pin carried-state shardings: ONE executable across first and
+        # steady-state calls (the hybrid/Engine GSPMD-drift treatment)
+        self._step = jax.jit(
+            shmapped,
+            in_shardings=(self._state_sh, self._opt_sh, replicated,
+                          self._batch_sh, self._batch_sh),
+            out_shardings=(self._state_sh, self._opt_sh, replicated),
+            donate_argnums=(0, 1))
 
     def _build_stage2_step(self, model, optimizer, mesh, state, opt_state,
                            batch_axes):

@@ -1,0 +1,674 @@
+"""Fused-bucket, block-quantized dense-DP gradient collectives
+(distributed/comm_fusion.py + the pre-reduction meta-optimizer contract
+in meta_optimizers.py + parallel/spmd.py's fused step).
+
+Acceptance gates covered here:
+- fused fp32 bucketed reduction is BIT-IDENTICAL to the per-tensor psum
+  baseline on the LeNet and DeepFM dense paths (8-device CPU mesh);
+- int8 + error feedback trains LeNet (synthetic MNIST-shaped data) to
+  within 0.5% of fp32 accuracy;
+- the compiled step's dp gradient collectives number ≤ the configured
+  bucket count, and int8 moves ≥3.5× fewer collective bytes than fp32
+  (tools/hlo_bytes.py on the post-optimization HLO);
+- FP16AllReduce routes bf16 onto the WIRE (collective element type in
+  the pre-optimization HLO — XLA CPU float-normalization re-widens
+  bf16 collectives post-opt; TPU executes them natively);
+- composition DGC → fp16_allreduce → localsgd → gradient_merge under
+  the pre-reduction contract, incl. GradientMerge's held steps skipping
+  the collective entirely (in the HLO conditional).
+"""
+
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as pt
+from paddle_tpu import nn, optimizer
+from paddle_tpu.core import mesh as mesh_mod
+from paddle_tpu.distributed import DistributedStrategy
+from paddle_tpu.distributed.comm_fusion import (CommFusionConfig,
+                                                DpGradReducer, build_layout)
+from paddle_tpu.distributed.comm_fusion import (_dequant_int8, _pack_bucket,
+                                                _quant_int8, _unpack_bucket)
+from paddle_tpu.distributed.meta_optimizers import (
+    DGCMomentumOptimizer, FP16AllReduceOptimizer, FusedAllReduceOptimizer,
+    GradientMergeOptimizer, LocalSGDOptimizer, apply_strategy)
+from paddle_tpu.models import LeNet
+from paddle_tpu.models.ctr import CtrConfig, DeepFM
+from paddle_tpu.parallel import SpmdTrainer
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+import hlo_bytes  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# layout + quantization units
+# ---------------------------------------------------------------------------
+
+def test_layout_caps_dtype_groups_and_cache():
+    meta = tuple([((64, 64), "float32")] * 6 + [((128,), "int32")] * 2)
+    cfg = CommFusionConfig(bucket_mb=0.02, max_buckets=5)  # 20KiB cap
+    layout = build_layout(meta, 4, cfg)
+    assert len(layout.buckets) <= 5
+    # per-dtype buckets: no bucket mixes dtypes
+    for b in layout.buckets:
+        assert len({s.dtype for s in b.slots}) == 1
+    # every leaf appears exactly once
+    seen = sorted(s.index for b in layout.buckets for s in b.slots)
+    assert seen == list(range(len(meta)))
+    # cache: identical request returns the identical object
+    assert build_layout(meta, 4, cfg) is layout
+    assert build_layout(meta, 8, cfg) is not layout
+
+
+def test_layout_grows_cap_to_respect_max_buckets():
+    meta = tuple([((1024,), "float32")] * 64)  # 4KiB each
+    cfg = CommFusionConfig(bucket_mb=0.001, max_buckets=3)  # 1KiB cap
+    layout = build_layout(meta, 2, cfg)
+    assert len(layout.buckets) <= 3
+
+
+def test_layout_terminates_when_dtypes_exceed_max_buckets():
+    """One bucket per dtype group is the floor: more distinct dtypes
+    than max_buckets must yield that floor, not an infinite cap-growth
+    loop (hung trainer construction before the fix)."""
+    meta = (((4,), "float32"), ((4,), "bfloat16"), ((4,), "int32"))
+    layout = build_layout(meta, 2, CommFusionConfig(max_buckets=1))
+    assert len(layout.buckets) == 3
+
+
+def test_pack_unpack_roundtrip_odd_shapes():
+    rng = np.random.default_rng(0)
+    leaves = [jnp.asarray(rng.normal(size=s).astype(np.float32))
+              for s in [(3, 5), (7,), (1,), (2, 3, 4)]]
+    meta = tuple((tuple(x.shape), "float32") for x in leaves)
+    layout = build_layout(meta, 4, CommFusionConfig())
+    out = [None] * len(leaves)
+    for b in layout.buckets:
+        buf = _pack_bucket(leaves, b, 4)
+        assert buf.shape == (4, b.seg_total)
+        for s, leaf in zip(b.slots, _unpack_bucket(buf, b, 4)):
+            out[s.index] = leaf
+    for a, b_ in zip(leaves, out):
+        assert np.array_equal(np.asarray(a), np.asarray(b_))
+
+
+def test_pack_unpack_zero_size_leaf():
+    """0-element leaves get seg_len 0 and pack/unpack as empty slices
+    (the `or 1` sizing previously produced a ragged pad and a
+    trace-time reshape error)."""
+    leaves = [jnp.ones((3, 2), jnp.float32), jnp.zeros((0,), jnp.float32)]
+    meta = tuple((tuple(x.shape), "float32") for x in leaves)
+    layout = build_layout(meta, 4, CommFusionConfig())
+    out = [None] * len(leaves)
+    for b in layout.buckets:
+        buf = _pack_bucket(leaves, b, 4)
+        for s, leaf in zip(b.slots, _unpack_bucket(buf, b, 4)):
+            out[s.index] = leaf
+    assert out[1].shape == (0,)
+    assert np.array_equal(np.asarray(out[0]), np.asarray(leaves[0]))
+
+
+def test_int8_block_quant_error_bound():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(4, 256)).astype(np.float32) * 10)
+    q, sc = _quant_int8(x, 64)
+    assert q.dtype == jnp.int8 and sc.shape == (4, 4)
+    err = np.abs(np.asarray(x - _dequant_int8(q, sc, 64)))
+    amax = np.abs(np.asarray(x)).reshape(4, 4, 64).max(-1)
+    assert (err.reshape(4, 4, 64) <= amax[..., None] / 127.0 + 1e-6).all()
+    # zero block stays exactly zero
+    z = jnp.zeros((1, 64), jnp.float32)
+    qz, sz = _quant_int8(z, 64)
+    assert np.array_equal(np.asarray(_dequant_int8(qz, sz, 64)), np.asarray(z))
+
+
+# ---------------------------------------------------------------------------
+# parity: fused fp32 ≡ per-tensor psum baseline (bitwise)
+# ---------------------------------------------------------------------------
+
+def _bitwise_equal_trees(a, b):
+    fa = {jax.tree_util.keystr(k): v
+          for k, v in jax.tree_util.tree_leaves_with_path(a)}
+    fb = {jax.tree_util.keystr(k): v
+          for k, v in jax.tree_util.tree_leaves_with_path(b)}
+    assert fa.keys() == fb.keys()
+    return all(np.array_equal(np.asarray(fa[k]), np.asarray(fb[k]))
+               for k in fa)
+
+
+def test_fused_fp32_bit_identical_lenet():
+    """Acceptance: fusion alone never changes numerics — the per-bucket
+    psum is elementwise the same reduction as one psum per tensor."""
+    mesh = mesh_mod.make_mesh({"dp": 8})
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 1, 28, 28)).astype(np.float32)
+    y = (np.arange(16) % 10).astype(np.int32)
+
+    def build(comm):
+        pt.seed(0)
+        return SpmdTrainer(LeNet(num_classes=10), optimizer.SGD(0.05),
+                           nn.functional.cross_entropy, mesh,
+                           batch_axes=("dp",), comm=comm)
+
+    base = build(CommFusionConfig(fuse=False))
+    fused = build(CommFusionConfig(bucket_mb=0.05, max_buckets=4))
+    for _ in range(3):
+        lb = base.train_step(x, y)
+        lf = fused.train_step(x, y)
+    assert float(lb) == float(lf)
+    assert _bitwise_equal_trees(jax.device_get(base.state["params"]),
+                                jax.device_get(fused.state["params"]))
+
+
+def test_fused_fp32_bit_identical_deepfm_dense():
+    cfg = CtrConfig(num_sparse_slots=6, num_dense=5, embedx_dim=4,
+                    dnn_hidden=(32, 16))
+    mesh = mesh_mod.make_mesh({"dp": 8})
+    rng = np.random.default_rng(0)
+    emb = rng.normal(size=(32, 6, 5)).astype(np.float32) * 0.1
+    dense = rng.normal(size=(32, 5)).astype(np.float32)
+    y = (rng.random(32) < 0.4).astype(np.int32)
+
+    def build(comm):
+        pt.seed(0)
+        return SpmdTrainer(DeepFM(cfg), optimizer.SGD(0.1),
+                           nn.functional.binary_cross_entropy_with_logits,
+                           mesh, batch_axes=("dp",), comm=comm)
+
+    base = build(CommFusionConfig(fuse=False))
+    fused = build(CommFusionConfig(max_buckets=2))
+    for _ in range(3):
+        lb = base.train_step((emb, dense), y)
+        lf = fused.train_step((emb, dense), y)
+    assert float(lb) == float(lf)
+    assert _bitwise_equal_trees(jax.device_get(base.state["params"]),
+                                jax.device_get(fused.state["params"]))
+
+
+def test_fused_matches_single_device_trainer():
+    """Fused dp=8 follows the serial trajectory exactly (mean-loss
+    discipline: local mean + mean-reduce == global mean)."""
+    from paddle_tpu.executor import Trainer
+
+    mesh = mesh_mod.make_mesh({"dp": 2, "sharding": 4})
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = rng.integers(0, 3, 64).astype(np.int32)
+
+    def fresh():
+        pt.seed(0)
+        return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 3))
+
+    serial = Trainer(fresh(), optimizer.SGD(0.1), nn.functional.cross_entropy)
+    fused = SpmdTrainer(fresh(), optimizer.SGD(0.1),
+                        nn.functional.cross_entropy, mesh,
+                        comm=CommFusionConfig())
+    for _ in range(5):
+        ls = float(serial.train_step(jnp.asarray(x), jnp.asarray(y)))
+        lf = float(fused.train_step(x, y))
+    np.testing.assert_allclose(ls, lf, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# int8 + error feedback accuracy (LeNet / synthetic MNIST)
+# ---------------------------------------------------------------------------
+
+def _mnist_like(rng, n):
+    """10 fixed digit-blob prototypes + noise, 28×28×1."""
+    protos = (np.random.default_rng(99).random((10, 28, 28)) < 0.2
+              ).astype(np.float32)
+    y = rng.integers(0, 10, n).astype(np.int32)
+    x = protos[y] + rng.normal(0, 0.25, (n, 28, 28)).astype(np.float32)
+    return x[:, None, :, :].astype(np.float32), y
+
+
+def test_int8_error_feedback_trains_lenet_to_fp32_accuracy():
+    """Acceptance: the int8 path with error feedback lands within 0.5%
+    of fp32 eval accuracy on the LeNet/MNIST-shaped task."""
+    mesh = mesh_mod.make_mesh({"dp": 8})
+    rng = np.random.default_rng(0)
+    batches = [_mnist_like(rng, 64) for _ in range(4)]
+    xte, yte = _mnist_like(np.random.default_rng(7), 256)
+
+    def run(comm):
+        pt.seed(0)
+        tr = SpmdTrainer(LeNet(num_classes=10), optimizer.Momentum(0.05, 0.9),
+                         nn.functional.cross_entropy, mesh,
+                         batch_axes=("dp",), comm=comm)
+        for i in range(60):
+            xtr, ytr = batches[i % len(batches)]
+            tr.train_step(xtr, ytr)
+        model = tr.sync_model()
+        logits = model(jnp.asarray(xte))
+        return float(np.mean(np.argmax(np.asarray(logits), -1) == yte))
+
+    acc_fp32 = run(CommFusionConfig())
+    acc_int8 = run(CommFusionConfig(quant="int8", block_size=128,
+                                    error_feedback=True))
+    assert acc_fp32 > 0.85, acc_fp32   # the task is actually learned
+    assert acc_int8 >= acc_fp32 - 0.005, (acc_int8, acc_fp32)
+
+
+def test_int8_error_feedback_residual_is_carried():
+    """EF state lives in opt_state, starts zero, becomes nonzero after a
+    step (the quantization error is retained, not lost)."""
+    mesh = mesh_mod.make_mesh({"dp": 8})
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(16, 8)).astype(np.float32)
+    y = rng.integers(0, 3, 16).astype(np.int32)
+    pt.seed(0)
+    tr = SpmdTrainer(nn.Linear(8, 3), optimizer.SGD(0.1),
+                     nn.functional.cross_entropy, mesh, batch_axes=("dp",),
+                     comm=CommFusionConfig(quant="int8", block_size=64))
+    ef0 = jax.device_get(tr.opt_state["ef"])
+    assert ef0 and all(np.all(np.asarray(v) == 0) for v in ef0.values())
+    tr.train_step(x, y)
+    ef1 = jax.device_get(tr.opt_state["ef"])
+    assert any(np.any(np.asarray(v) != 0) for v in ef1.values())
+    # per-rank: leading world dim, sharded over the dp axes
+    leaf = next(iter(tr.opt_state["ef"].values()))
+    assert leaf.shape[0] == 8 and "dp" in str(leaf.sharding.spec)
+
+
+# ---------------------------------------------------------------------------
+# wire acceptance via hlo_bytes
+# ---------------------------------------------------------------------------
+
+def _fresh_mlp(seed=0):
+    pt.seed(seed)
+    return nn.Sequential(nn.Linear(8, 64), nn.ReLU(), nn.Linear(64, 64),
+                         nn.ReLU(), nn.Linear(64, 3))
+
+
+def _compiled(tr, x, y):
+    return tr._step.lower(tr.state, tr.opt_state, jax.random.key(0),
+                          (jnp.asarray(x),), (jnp.asarray(y),)).compile()
+
+
+def test_bucket_count_and_int8_byte_acceptance():
+    """Acceptance: fused dp grad collectives ≤ configured bucket count;
+    int8 moves ≥3.5× fewer wire bytes than fused fp32."""
+    mesh = mesh_mod.make_mesh({"dp": 8})
+    x = np.zeros((64, 8), np.float32)
+    y = np.zeros((64,), np.int32)
+
+    def grad_coll(comm):
+        tr = SpmdTrainer(_fresh_mlp(), optimizer.SGD(0.1),
+                         nn.functional.cross_entropy, mesh,
+                         batch_axes=("dp",), comm=comm)
+        rep = hlo_bytes.report_compiled(_compiled(tr, x, y), num_devices=8)
+        return hlo_bytes.grad_collectives(rep)
+
+    fused = grad_coll(CommFusionConfig(max_buckets=2))
+    assert 1 <= len(fused) <= 2, fused   # ≤ bucket count (one psum each)
+    unfused = grad_coll(CommFusionConfig(fuse=False))
+    # the baseline starts one-per-tensor; XLA's own combiner may merge
+    # some, but the fused program must never have MORE collectives
+    assert len(fused) <= len(unfused)
+    int8 = grad_coll(CommFusionConfig(quant="int8", max_buckets=2,
+                                      block_size=64))
+    assert {c["dtype"] for c in int8} == {"s8"}
+    wb_f32 = sum(c["wire_bytes"] for c in fused)
+    wb_int8 = sum(c["wire_bytes"] for c in int8)
+    assert wb_f32 >= 3.5 * wb_int8, (wb_f32, wb_int8)
+
+
+def test_fp16_allreduce_wire_dtype_regression():
+    """Satellite regression: with fp16_allreduce the dp collective's
+    ELEMENT TYPE is bf16 — the old cast-and-cast-back passed every
+    numeric test while moving zero fewer bytes. Asserted on the
+    PRE-optimization HLO: XLA CPU's float-normalization pass legalizes
+    bf16 collectives back to f32 (no native bf16 on CPU); TPU backends
+    keep and execute the narrow type."""
+    mesh = mesh_mod.make_mesh({"dp": 8})
+    x = jnp.zeros((64, 8), jnp.float32)
+    y = jnp.zeros((64,), jnp.int32)
+
+    def wire_dtypes(strategy):
+        tr = SpmdTrainer(_fresh_mlp(), optimizer.SGD(0.1),
+                         nn.functional.cross_entropy, mesh,
+                         batch_axes=("dp",),
+                         comm=CommFusionConfig(max_buckets=2),
+                         strategy=strategy)
+        low = tr._step.lower(tr.state, tr.opt_state, jax.random.key(0),
+                             (x,), (y,))
+        rep = hlo_bytes.report(low.as_text("hlo"), num_devices=8)
+        return {c["dtype"] for c in hlo_bytes.grad_collectives(rep)}
+
+    assert wire_dtypes(DistributedStrategy(fp16_allreduce=True)) == {"bf16"}
+    assert wire_dtypes(None) == {"f32"}
+
+
+def test_strategy_fuse_all_reduce_ops_enables_fusion():
+    """The reference knob names work end to end: fuse_all_reduce_ops +
+    comm_fusion_configs on the strategy select the fused path."""
+    mesh = mesh_mod.make_mesh({"dp": 8})
+    strat = DistributedStrategy(
+        fuse_all_reduce_ops=True,
+        comm_fusion_configs={"max_buckets": 2, "quant": "int8",
+                             "block_size": 64})
+    tr = SpmdTrainer(_fresh_mlp(), optimizer.SGD(0.1),
+                     nn.functional.cross_entropy, mesh, batch_axes=("dp",),
+                     strategy=strat)
+    rep = hlo_bytes.report_compiled(
+        _compiled(tr, np.zeros((64, 8), np.float32),
+                  np.zeros((64,), np.int32)), num_devices=8)
+    assert {c["dtype"] for c in hlo_bytes.grad_collectives(rep)} == {"s8"}
+
+
+# ---------------------------------------------------------------------------
+# ZeRO: reduce-scattered shard consumed directly
+# ---------------------------------------------------------------------------
+
+def test_zero1_fused_shards_slots_and_matches_stage0():
+    """Stage-1 fused: slots live as flat 1/K shards (memory 1/K) and the
+    trajectory is BIT-identical to the fused stage-0 run — the shard
+    update is the same elementwise math on the reduce-scattered segment
+    (reduce-scatter + all-gather ≡ the all-reduce, verified bitwise)."""
+    mesh = mesh_mod.make_mesh({"dp": 2, "sharding": 4})
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = rng.integers(0, 3, 64).astype(np.int32)
+
+    def build(stage):
+        pt.seed(0)
+        return SpmdTrainer(_fresh_mlp(), optimizer.Adam(1e-2),
+                           nn.functional.cross_entropy, mesh,
+                           zero_stage=stage, comm=CommFusionConfig())
+
+    z0, z1 = build(0), build(1)
+    for _ in range(4):
+        l0 = z0.train_step(x, y)
+        l1 = z1.train_step(x, y)
+    assert float(l0) == float(l1)
+    assert _bitwise_equal_trees(jax.device_get(z0.state["params"]),
+                                jax.device_get(z1.state["params"]))
+    # slots are FLAT, jointly sharded over (dp, sharding); each device
+    # holds 1/8
+    m = z1.opt_state["inner"]["slots"]["m"]
+    for leaf in jax.tree_util.tree_leaves(m):
+        assert leaf.ndim == 1 and leaf.shape[0] % 8 == 0
+        assert leaf.addressable_shards[0].data.size * 8 == leaf.size
+
+
+def test_zero2_fused_hlo_has_reduce_scatter_no_full_allreduce():
+    mesh = mesh_mod.make_mesh({"dp": 1, "sharding": 8})
+    tr = SpmdTrainer(_fresh_mlp(), optimizer.Adam(1e-2),
+                     nn.functional.cross_entropy, mesh, zero_stage=2,
+                     comm=CommFusionConfig(max_buckets=2))
+    rep = hlo_bytes.report_compiled(
+        _compiled(tr, np.zeros((64, 8), np.float32),
+                  np.zeros((64,), np.int32)), num_devices=8)
+    ops = [c["op"] for c in hlo_bytes.grad_collectives(rep)]
+    assert "reduce-scatter" in ops, ops    # grads scatter…
+    assert "all-gather" in ops, ops        # …updated params gather
+    assert "all-reduce" not in ops, ops    # never allreduce-then-slice
+
+
+# ---------------------------------------------------------------------------
+# meta-optimizer composition under the pre-reduction contract
+# ---------------------------------------------------------------------------
+
+def test_composition_order_and_reducer_wiring():
+    reducer = DpGradReducer(("dp",), (4,), CommFusionConfig(quant="int8",
+                                                            block_size=64))
+    strat = DistributedStrategy(
+        dgc=True, fp16_allreduce=True, localsgd=True,
+        localsgd_configs={"k_steps": 2},
+        gradient_merge=True, gradient_merge_configs={"k_steps": 2})
+    chain = apply_strategy(optimizer.Momentum(0.1), strat, reducer=reducer)
+    assert isinstance(chain, GradientMergeOptimizer)
+    assert isinstance(chain.inner, LocalSGDOptimizer)
+    assert isinstance(chain.inner.inner, FP16AllReduceOptimizer)
+    assert isinstance(chain.inner.inner.inner, DGCMomentumOptimizer)
+    assert isinstance(chain.inner.inner.inner.inner, FusedAllReduceOptimizer)
+    assert reducer.installed
+    # state layout tags: GM acc + DGC u/v + EF are per-rank local
+    params = {"w": jnp.ones((8, 8), jnp.float32)}
+    st = chain.init(params)
+    tags = chain.state_layout(st)
+    assert set(jax.tree_util.tree_leaves(tags["acc"])) == {"local"}
+    inner3 = tags["inner"]["inner"]["inner"]
+    assert set(jax.tree_util.tree_leaves(inner3["u"])) == {"local"}
+    assert set(jax.tree_util.tree_leaves(inner3["v"])) == {"local"}
+    assert set(jax.tree_util.tree_leaves(inner3["inner"]["ef"])) == {"local"}
+    # base optimizer state (SGD: just the step counter) replicates
+    assert set(jax.tree_util.tree_leaves(
+        inner3["inner"]["inner"])) == {"rep"}
+
+
+def test_full_stack_dgc_fp16_localsgd_gm_semantics():
+    """DGC → fp16 → localsgd → gm on a 4-rank dp group with fully
+    per-rank state: held GM steps change nothing, applied steps update
+    locally (localsgd: no grad collective), and localsgd's k-th applied
+    step re-syncs params across ranks."""
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("dp",))
+    reducer = DpGradReducer(("dp",), (4,), CommFusionConfig())
+    strat = DistributedStrategy(
+        dgc=True, dgc_configs={"rampup_begin_step": 100},  # dense pre-rampup
+        fp16_allreduce=True, localsgd=True, localsgd_configs={"k_steps": 2},
+        gradient_merge=True, gradient_merge_configs={"k_steps": 2})
+    chain = apply_strategy(optimizer.Momentum(0.5, momentum=0.0), strat,
+                           reducer=reducer)
+
+    params0 = {"w": jnp.ones((4, 8), jnp.float32)}
+    st0 = chain.init(params0)
+    R = 4
+    expand = lambda t: jax.tree_util.tree_map(
+        lambda x: jnp.asarray(np.broadcast_to(
+            np.asarray(x), (R,) + np.asarray(x).shape).copy()), t)
+    params, st = expand(params0), expand(st0)
+    # distinct grads per rank
+    g = jnp.asarray(np.arange(R * 32, dtype=np.float32).reshape(R, 4, 8)
+                    / 100.0)
+
+    def step(p, s, gr):
+        sq = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)
+        ex = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+        np_, ns_ = chain.update({"w": gr[0]}, sq(s), sq(p))
+        return ex(np_), ex(ns_)
+
+    fn = jax.jit(shard_map(
+        step, mesh=mesh,
+        in_specs=(P("dp"), P("dp"), P("dp")),
+        out_specs=(P("dp"), P("dp")), check_vma=False))
+
+    p1, s1 = fn(params, st, g)
+    # GM k=2: step 1 held — params untouched
+    assert np.array_equal(np.asarray(p1["w"]), np.asarray(params["w"]))
+    p2, s2 = fn(p1, s1, g)
+    # step 2 applied with LOCAL grads: ranks diverge (localsgd inner)
+    w2 = np.asarray(p2["w"])
+    assert not np.array_equal(w2, np.asarray(params["w"]))
+    assert not np.allclose(w2[0], w2[1])
+    p3, s3 = fn(p2, s2, g)
+    assert np.array_equal(np.asarray(p3["w"]), w2)   # held again
+    p4, s4 = fn(p3, s3, g)
+    # 2nd applied step = localsgd sync: all ranks equal again
+    w4 = np.asarray(p4["w"])
+    assert not np.array_equal(w4, w2)
+    for r in range(1, R):
+        np.testing.assert_allclose(w4[r], w4[0], rtol=1e-6)
+
+
+def test_gradient_merge_held_steps_skip_collective_in_hlo():
+    """Satellite: with GM in the chain every dp grad collective lives in
+    the HLO conditional's apply branch — a held step executes ZERO grad
+    collectives (no wasted ICI traffic)."""
+    mesh = mesh_mod.make_mesh({"dp": 8})
+    strat = DistributedStrategy(
+        dgc=True, fp16_allreduce=True,
+        gradient_merge=True, gradient_merge_configs={"k_steps": 2})
+    tr = SpmdTrainer(_fresh_mlp(), optimizer.Momentum(0.1),
+                     nn.functional.cross_entropy, mesh, batch_axes=("dp",),
+                     comm=CommFusionConfig(max_buckets=2), strategy=strat)
+    rep = hlo_bytes.report_compiled(
+        _compiled(tr, np.zeros((64, 8), np.float32),
+                  np.zeros((64,), np.int32)), num_devices=8)
+    grad = hlo_bytes.grad_collectives(rep)
+    assert grad, "expected dp grad collectives"
+    assert all(c["in_conditional"] for c in grad), grad
+    # and the chain still trains
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = rng.integers(0, 3, 64).astype(np.int32)
+    first = float(tr.train_step(x, y))
+    for _ in range(6):
+        last = float(tr.train_step(x, y))
+    assert np.isfinite(last) and last < first
+
+
+def test_gm_fused_matches_serial_gm():
+    """GM k=2 over the fused dp path ≡ serial GM trainer on the full
+    batch (the merged-apply semantics survive the contract change)."""
+    from paddle_tpu.executor import Trainer
+
+    mesh = mesh_mod.make_mesh({"dp": 8})
+    strat = DistributedStrategy(gradient_merge=True,
+                                gradient_merge_configs={"k_steps": 2})
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = rng.integers(0, 3, 64).astype(np.int32)
+
+    pt.seed(0)
+    serial = Trainer(nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                   nn.Linear(16, 3)),
+                     apply_strategy(optimizer.SGD(0.1), strat),
+                     nn.functional.cross_entropy)
+    pt.seed(0)
+    fused = SpmdTrainer(nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                                      nn.Linear(16, 3)),
+                        optimizer.SGD(0.1), nn.functional.cross_entropy,
+                        mesh, batch_axes=("dp",), comm=CommFusionConfig(),
+                        strategy=strat)
+    for _ in range(4):
+        ls = float(serial.train_step(jnp.asarray(x), jnp.asarray(y)))
+        lf = float(fused.train_step(x, y))
+    np.testing.assert_allclose(ls, lf, rtol=1e-6)
+
+
+def test_localsgd_rejected_on_fused_trainer():
+    mesh = mesh_mod.make_mesh({"dp": 8})
+    strat = DistributedStrategy(localsgd=True)
+    with pytest.raises(Exception, match="localsgd"):
+        SpmdTrainer(_fresh_mlp(), optimizer.SGD(0.1),
+                    nn.functional.cross_entropy, mesh, batch_axes=("dp",),
+                    comm=CommFusionConfig(), strategy=strat)
+
+
+def test_amp_nonfinite_skip_is_uniform_across_ranks():
+    """One rank's local nan must make EVERY rank skip (sync_all_finite):
+    params stay put, the loss scale halves, training resumes cleanly."""
+    mesh = mesh_mod.make_mesh({"dp": 8})
+    strat = DistributedStrategy(
+        amp=True, amp_configs={"init_loss_scaling": 1024.0,
+                               "decr_every_n_nan_or_inf": 1})
+    tr = SpmdTrainer(_fresh_mlp(), optimizer.SGD(0.1),
+                     nn.functional.cross_entropy, mesh, batch_axes=("dp",),
+                     comm=CommFusionConfig(), strategy=strat)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(64, 8)).astype(np.float32)
+    y = rng.integers(0, 3, 64).astype(np.int32)
+    p0 = jax.device_get(tr.state["params"])
+    bad = x.copy()
+    bad[:8] = np.nan          # rank 0's dp shard only
+    tr.train_step(bad, y)
+    p1 = jax.device_get(tr.state["params"])
+    assert _bitwise_equal_trees(p0, p1)   # skipped everywhere
+    assert float(tr.opt_state["scaler"].loss_scale) == 512.0
+    l2 = float(tr.train_step(x, y))       # clean batch applies again
+    assert np.isfinite(l2)
+
+
+def test_fused_trainer_save_load_resume(tmp_path):
+    """Expanded per-rank EF state + flat-shard slots survive the
+    checkpoint roundtrip; the restored run continues the trajectory."""
+    mesh = mesh_mod.make_mesh({"dp": 2, "sharding": 4})
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 8)).astype(np.float32)
+    y = rng.integers(0, 3, 32).astype(np.int32)
+    comm = CommFusionConfig(quant="int8", block_size=64)
+
+    pt.seed(0)
+    a = SpmdTrainer(nn.Linear(8, 3), optimizer.Adam(1e-2),
+                    nn.functional.cross_entropy, mesh, zero_stage=1,
+                    comm=comm)
+    for _ in range(3):
+        a.train_step(x, y)
+    a.save(str(tmp_path / "snap"))
+    la = [float(a.train_step(x, y)) for _ in range(3)]
+
+    pt.seed(5)
+    b = SpmdTrainer(nn.Linear(8, 3), optimizer.Adam(1e-2),
+                    nn.functional.cross_entropy, mesh, zero_stage=1,
+                    comm=comm)
+    b.load(str(tmp_path / "snap"))
+    lb = [float(b.train_step(x, y)) for _ in range(3)]
+    np.testing.assert_allclose(lb, la, rtol=1e-5)
+
+
+def test_dp1_path_unchanged():
+    """A 1-device batch group ignores comm fusion entirely (serial/dp=1
+    path byte-for-byte the GSPMD behavior)."""
+    mesh = mesh_mod.make_mesh({"dp": 1, "sharding": 1, "mp": 8})
+    tr = SpmdTrainer(_fresh_mlp(), optimizer.SGD(0.1),
+                     nn.functional.cross_entropy, mesh,
+                     comm=CommFusionConfig())
+    assert not hasattr(tr, "reducer")
+    x = np.zeros((8, 8), np.float32)
+    y = np.zeros((8,), np.int32)
+    assert np.isfinite(float(tr.train_step(x, y)))
+
+
+def test_unfused_rung_still_honors_wire_dtype():
+    """fuse=False + fp16_allreduce: the per-tensor baseline collectives
+    still ride at bf16 (previously the wire override was silently
+    dropped on the unfused rung)."""
+    mesh = mesh_mod.make_mesh({"dp": 8})
+    tr = SpmdTrainer(_fresh_mlp(), optimizer.SGD(0.1),
+                     nn.functional.cross_entropy, mesh, batch_axes=("dp",),
+                     comm=CommFusionConfig(fuse=False),
+                     strategy=DistributedStrategy(fp16_allreduce=True))
+    low = tr._step.lower(tr.state, tr.opt_state, jax.random.key(0),
+                         (jnp.zeros((64, 8), jnp.float32),),
+                         (jnp.zeros((64,), jnp.int32),))
+    rep = hlo_bytes.report(low.as_text("hlo"), num_devices=8)
+    assert {c["dtype"]
+            for c in hlo_bytes.grad_collectives(rep)} == {"bf16"}
+
+
+def test_reducer_wire_override_and_suspend():
+    """Unit: wire_dtype narrows the reduced mean to ~bf16 precision;
+    suspended() returns local grads untouched."""
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("dp",))
+    r = DpGradReducer(("dp",), (8,), CommFusionConfig())
+    g = jnp.asarray(np.linspace(0.001, 1.0, 8 * 16, dtype=np.float32)
+                    .reshape(8, 16))
+
+    def f(gr):
+        tree = {"g": gr[0]}
+        plain, _ = r.reduce(tree, {})
+        with r.wire_dtype(jnp.bfloat16):
+            cast, _ = r.reduce(tree, {})
+        with r.suspended():
+            local, _ = r.reduce(tree, {})
+        return plain["g"][None], cast["g"][None], local["g"][None]
+
+    plain, cast, local = jax.jit(shard_map(
+        f, mesh=mesh, in_specs=(P("dp"),),
+        out_specs=(P("dp"),) * 3, check_vma=False))(g)
+    expect = np.asarray(g).mean(0)
+    np.testing.assert_allclose(np.asarray(plain)[0], expect, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(cast)[0], expect, rtol=2e-2)
+    assert float(np.max(np.abs(np.asarray(cast)[0] - expect))) > 0  # lossy
+    np.testing.assert_allclose(np.asarray(local)[0], np.asarray(g)[0])

@@ -122,6 +122,16 @@ def test_moe_ep_matches_serial():
 
 
 def test_tp_grads_match_serial():
+    """TP+DP gradients vs jax.grad of the serial model — written in the
+    sanctioned explicit-reduction pattern (the hybrid trainer's): jax
+    0.4.x shard_map cannot be trusted to transpose psums through this
+    model (this test failed at PR-2 baseline with the rep-tracking
+    form), so the loss psum and the PCE reductions are pinned-VJP
+    (``pinned_vjp=True``), the shard_map runs ``check_vma=False``, and
+    each param's grad is explicitly psum'd over every mesh axis it is
+    NOT sharded on."""
+    from paddle_tpu.ops import collectives as coll
+
     pt.seed(4)
     model = Ernie(CFG)
     state = nn.get_state(model)
@@ -133,12 +143,15 @@ def test_tp_grads_match_serial():
     def f(st, ids, labels):
         def loss(st):
             out, _ = nn.functional_call(model, st, ids, training=False)
-            ce = parallel_cross_entropy(out, labels, CFG.vocab_size, "mp")
-            return jax.lax.psum(jnp.mean(ce) / 2, ("dp",))
-        return jax.grad(loss)(st)
+            ce = parallel_cross_entropy(out, labels, CFG.vocab_size, "mp",
+                                        pinned_vjp=True)
+            return coll.psum_replicated(jnp.mean(ce) / 2, ("dp",))
+
+        grads = jax.grad(loss)(st)
+        return coll.spec_reduced_grads(grads, specs, dict(mesh.shape))
 
     gd = shard_map(f, mesh=mesh, in_specs=(specs, P("dp", None), P("dp", None)),
-                   out_specs=specs)(state, ids, labels)
+                   out_specs=specs, check_vma=False)(state, ids, labels)
     for name, g in gs["params"].items():
         np.testing.assert_allclose(np.asarray(gd["params"][name]),
                                    np.asarray(g), rtol=2e-3, atol=1e-5,

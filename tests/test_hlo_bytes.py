@@ -1,0 +1,117 @@
+"""tools/hlo_bytes.py: the HLO collective byte/type reporter that backs
+the comm-compression acceptance gates (element types, wire bytes,
+conditional placement)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu  # noqa: F401  (shims)
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+import hlo_bytes  # noqa: E402
+
+_HAND = """\
+HloModule toy
+
+%add (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %s = f32[] add(f32[] %a, f32[] %b)
+}
+
+%branch_true (p: f32[1,256]) -> f32[1,256] {
+  %p = f32[1,256]{1,0} parameter(0)
+  ROOT %ar = f32[1,256]{1,0} all-reduce(f32[1,256]{1,0} %p), replica_groups={{0,1,2,3}}, to_apply=%add
+}
+
+%branch_false (p: f32[1,256]) -> f32[1,256] {
+  ROOT %p = f32[1,256]{1,0} parameter(0)
+}
+
+ENTRY %main (x: f32[1,256], k: s32[]) -> f32[1,256] {
+  %x = f32[1,256]{1,0} parameter(0)
+  %k = s32[] parameter(1)
+  %rs = bf16[64]{0} reduce-scatter(bf16[256]{0} %conv), replica_groups=[2,4]<=[8], to_apply=%add
+  %ag = s8[8,256]{1,0} all-gather(s8[1,256]{1,0} %q), replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+  ROOT %c = (f32[1,256]{1,0}) conditional(s32[] %k, f32[1,256]{1,0} %x, f32[1,256]{1,0} %x), branch_computations={%branch_true, %branch_false}
+}
+"""
+
+
+def test_parses_ops_dtypes_bytes_groups():
+    rep = hlo_bytes.report(_HAND, num_devices=8)
+    by_op = {c["op"]: c for c in rep["collectives"]}
+    assert set(by_op) == {"all-reduce", "reduce-scatter", "all-gather"}
+    ar = by_op["all-reduce"]
+    assert ar["dtype"] == "f32" and ar["result_bytes"] == 256 * 4
+    assert ar["group_size"] == 4
+    # ring all-reduce: 2*(3/4)*1024
+    assert abs(ar["wire_bytes"] - 2 * 0.75 * 1024) < 1e-6
+    rs = by_op["reduce-scatter"]
+    assert rs["dtype"] == "bf16" and rs["result_bytes"] == 64 * 2
+    assert rs["group_size"] == 4        # iota form [2,4]<=[8]
+    assert rs["operand_bytes"] == 256 * 2
+    ag = by_op["all-gather"]
+    assert ag["dtype"] == "s8" and ag["result_bytes"] == 8 * 256
+    assert abs(ag["wire_bytes"] - (7 / 8) * 8 * 256) < 1e-6
+
+
+def test_conditional_reachability():
+    rep = hlo_bytes.report(_HAND, num_devices=8)
+    flags = {c["op"]: c["in_conditional"] for c in rep["collectives"]}
+    assert flags["all-reduce"] is True      # lives in %branch_true
+    assert flags["reduce-scatter"] is False
+    assert flags["all-gather"] is False
+
+
+def test_grad_collectives_filters_scalars():
+    small = """\
+ENTRY %m (x: f32[]) -> f32[] {
+  %x = f32[] parameter(0)
+  ROOT %ar = f32[] all-reduce(f32[] %x), replica_groups={{0,1}}, to_apply=%a
+}
+"""
+    rep = hlo_bytes.report(small, num_devices=2)
+    assert rep["n_collectives"] == 1
+    assert hlo_bytes.grad_collectives(rep) == []
+
+
+def test_compiled_psum_program_report():
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("dp",))
+
+    def f(x):
+        return lax.psum(x, "dp")
+
+    fn = jax.jit(shard_map(f, mesh=mesh, in_specs=(P("dp"),),
+                           out_specs=P("dp")))
+    x = jnp.zeros((8, 1024), jnp.float32)
+    rep = hlo_bytes.report_compiled(fn.lower(x).compile(), num_devices=8)
+    ar = [c for c in rep["collectives"] if c["op"] == "all-reduce"]
+    assert len(ar) == 1
+    assert ar[0]["dtype"] == "f32" and ar[0]["result_bytes"] == 1024 * 4
+    assert ar[0]["group_size"] == 8
+
+
+def test_cli_one_json(tmp_path):
+    p = tmp_path / "m.hlo"
+    p.write_text(_HAND)
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "tools", "hlo_bytes.py"),
+         str(p), "--devices", "8"],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    d = json.loads(out.stdout)
+    assert d["n_collectives"] == 3
+    assert d["wire_bytes_by_dtype"]["s8"] > 0

@@ -194,3 +194,59 @@ def test_hybrid_sharding_matches_unsharded_and_restores(tmp_path):
     assert c.global_step == a.global_step - 2
     lc = [float(c.train_step(ids, labels)) for _ in range(2)]
     np.testing.assert_allclose(lc, la, rtol=2e-5)
+
+
+@pytest.mark.slow
+def test_hybrid_grads_match_serial():
+    """The serial-gradient oracle that caught PR 3's fix targets: under
+    jax 0.4.x the un-pinned psums (hybrid loss, pipe masked psum, the
+    standalone parallel_cross_entropy) plus the rep-tracker's confusion
+    over the no-op pcast shim produced grads that were ×mp on aux
+    params and ZERO on the head — while every loss-only test passed.
+    One SGD(lr=1) step must now reproduce jax.grad of the equivalent
+    serial model to fp32 roundoff on every parameter."""
+    pt.seed(0)
+    mesh = mesh_mod.make_mesh({"dp": 2, "pp": 2, "cp": 1, "mp": 2})
+    tr = HybridParallelTrainer(CFG, mesh, optimizer.SGD(1.0), num_micro=2)
+    ids, labels = _data(CFG, batch=8, seq=8)
+
+    params = jax.device_get(tr.params)
+    serial = Ernie(CFG)
+    n_stages = next(iter(params["stages"]["params"].values())).shape[0]
+    bps = CFG.num_layers // n_stages
+    state = {"params": {}, "buffers": {}}
+    for group in ("params", "buffers"):
+        for name, arr in params["stages"][group].items():
+            parts = name.split(".")
+            for s in range(n_stages):
+                i = s * bps + int(parts[1])
+                state[group][".".join(["blocks", str(i)] + parts[2:])] = arr[s]
+        for name, arr in params["aux"]["embed"][group].items():
+            state[group]["embed." + name] = arr
+        for name, arr in params["aux"]["head"][group].items():
+            state[group]["head." + name] = arr
+
+    def loss_fn(p):
+        out, _ = nn.functional_call(
+            serial, {"params": p, "buffers": state["buffers"]}, ids,
+            training=False)
+        ce = nn.functional.cross_entropy(out, labels, reduction="none")
+        return jnp.mean(ce)
+
+    gs = jax.grad(loss_fn)(state["params"])
+    tr.train_step(ids, labels)          # SGD lr=1: delta == gradient
+    p1 = jax.device_get(tr.params)
+
+    for name, arr in params["stages"]["params"].items():
+        g = np.asarray(arr) - np.asarray(p1["stages"]["params"][name])
+        rest = name.split(".", 2)[2]
+        b = int(name.split(".")[1])
+        for s in range(n_stages):
+            np.testing.assert_allclose(
+                g[s], np.asarray(gs[f"blocks.{s * bps + b}.{rest}"]),
+                atol=5e-6, err_msg=f"stage{s}.{name}")
+    for an in ("embed", "head"):
+        for pn, arr in params["aux"][an]["params"].items():
+            g = np.asarray(arr) - np.asarray(p1["aux"][an]["params"][pn])
+            np.testing.assert_allclose(g, np.asarray(gs[f"{an}.{pn}"]),
+                                       atol=5e-6, err_msg=f"{an}.{pn}")

@@ -384,6 +384,87 @@ def test_env_read_in_config_module_ok(tmp_path):
     assert diags == []
 
 
+def test_cast_roundtrip_direct_chain_flagged(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        import jax.numpy as jnp
+
+        def f(g):
+            return g.astype(jnp.bfloat16).astype(jnp.float32)
+    """)
+    assert _rules(diags) == {"cast-roundtrip"}
+
+
+def test_cast_roundtrip_tree_map_pair_flagged(tmp_path):
+    # the FP16AllReduceOptimizer bug shape: narrow then immediately widen
+    diags = _conv_diags(tmp_path, """
+        import jax, jax.numpy as jnp
+        _tmap = jax.tree_util.tree_map
+
+        def update(self, grads):
+            half = _tmap(lambda g: g.astype(self.dtype), grads)
+            restored = _tmap(lambda h, g: h.astype(g.dtype), half, grads)
+            return restored
+    """)
+    assert _rules(diags) == {"cast-roundtrip"}
+    assert diags[0].line == 7            # flagged at the widening
+
+
+def test_cast_roundtrip_plain_var_pair_flagged(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        import jax.numpy as jnp
+
+        def f(g):
+            h = g.astype(jnp.bfloat16)
+            r = h.astype(jnp.float32)
+            return r
+    """)
+    assert _rules(diags) == {"cast-roundtrip"}
+
+
+def test_cast_roundtrip_intervening_collective_ok(tmp_path):
+    # a collective (or any op) between narrow and widen is the REAL
+    # wire pattern — must not flag
+    diags = _conv_diags(tmp_path, """
+        import jax, jax.numpy as jnp
+        from jax import lax
+        _tmap = jax.tree_util.tree_map
+
+        def update(grads, axes):
+            half = _tmap(lambda g: g.astype(jnp.bfloat16), grads)
+            reduced = _tmap(lambda h: lax.psum(h, axes), half)
+            restored = _tmap(lambda h, g: h.astype(g.dtype), reduced, grads)
+            return restored
+
+        def plain(g):
+            h = g.astype(jnp.bfloat16)
+            s = lax.psum(h, "dp")
+            return s.astype(jnp.float32)
+    """)
+    assert "cast-roundtrip" not in _rules(diags)
+
+
+def test_cast_roundtrip_single_cast_ok(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        import jax.numpy as jnp
+
+        def f(g):
+            return g.astype(jnp.float32)
+    """)
+    assert diags == []
+
+
+def test_cast_roundtrip_ignore_comment(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        import jax.numpy as jnp
+
+        def f(g):
+            h = g.astype(jnp.bfloat16)
+            r = h.astype(jnp.float32)  # graftlint: ignore[cast-roundtrip] — precision sim
+            return r
+    """)
+    assert "cast-roundtrip" not in _rules(diags)
+
+
 # -- allowlist + driver -----------------------------------------------------
 
 def test_allowlist_filters_and_reports_stale(tmp_path):

@@ -1,0 +1,226 @@
+"""hlo_bytes — per-collective element types and byte counts from compiled HLO.
+
+The proof layer for comm compression: numeric tests cannot tell a wire
+narrowing from a cast round-trip upstream of an fp32 psum (the
+FP16AllReduce bug class this PR retires), but the compiled HLO can.
+This walks an XLA module's text (``jit(f).lower(...).compile()
+.as_text()``) and reports every collective with:
+
+- ``op``            all-reduce | reduce-scatter | all-gather | all-to-all
+                    | collective-permute (``-start`` async forms folded in)
+- ``dtype``/``shape``/``result_bytes``  from the instruction's result
+  (tuple results summed; for reduce-scatter the per-rank output)
+- ``operand_bytes`` the payload entering the collective
+- ``group_size``    parsed from ``replica_groups`` (explicit or iota form)
+- ``wire_bytes``    ring-estimate of bytes a participant moves:
+                    all-reduce 2(N-1)/N·payload, reduce-scatter /
+                    all-to-all (N-1)/N·operand, all-gather
+                    (N-1)/N·result, permute = operand
+- ``computation``/``in_conditional``  whether the collective lives in
+  (or is only reachable through) a conditional branch — how we prove
+  GradientMerge's held steps skip the dp reduction entirely.
+
+Library: ``report(hlo_text)``, ``report_compiled(compiled)``,
+``grad_collectives(rep, min_bytes=1024)`` (drops scalar loss/flag
+psums). CLI: ``python tools/hlo_bytes.py FILE [--min-bytes N]`` (or
+``-`` for stdin) prints the JSON summary.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1,
+    "f8e5m2": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+_COLLECTIVES = ("all-reduce", "reduce-scatter", "all-gather", "all-to-all",
+                "collective-permute")
+
+# one typed buffer: dtype[d0,d1,...]{layout} — layout/suffixes optional
+_SHAPE_RE = re.compile(r"\b([a-z0-9]+)\[([0-9,]*)\]")
+# an instruction line: %name = <result-type> opcode(...)
+_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([\w.\-]+)\s*=\s*(.*?)\s+"
+                       r"([a-z][\w\-]*)\(")
+# computation header: [ENTRY] %name (params) -> ret {  (params may hold
+# nested tuple parens, hence the greedy match anchored on the arrow)
+_COMP_RE = re.compile(r"^(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->")
+_GROUPS_EXPLICIT_RE = re.compile(r"replica_groups=\{\{([^}]*)\}")
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
+_CALLED_RE = re.compile(
+    r"(?:to_apply|branch_computations|true_computation|false_computation|"
+    r"condition|body|calls|called_computations)="
+    r"\{?%?([\w.\-]+(?:,\s*%?[\w.\-]+)*)\}?")
+_COND_REFS_RE = re.compile(
+    r"(?:branch_computations=\{([^}]*)\}|true_computation=%?([\w.\-]+)|"
+    r"false_computation=%?([\w.\-]+))")
+
+
+def _buffer_bytes(type_str: str) -> tuple:
+    """(total bytes, first dtype, first shape) over every typed buffer in
+    a result-type string (handles tuples)."""
+    total, dtype, shape = 0, None, None
+    for m in _SHAPE_RE.finditer(type_str):
+        d, dims = m.group(1), m.group(2)
+        if d not in _DTYPE_BYTES:
+            continue
+        n = 1
+        if dims:
+            for x in dims.split(","):
+                n *= int(x)
+        total += n * _DTYPE_BYTES[d]
+        if dtype is None:
+            dtype, shape = d, [int(x) for x in dims.split(",")] if dims else []
+    return total, dtype, shape
+
+
+def _group_size(line: str, default: int) -> int:
+    m = _GROUPS_EXPLICIT_RE.search(line)
+    if m:
+        ids = [x for x in m.group(1).split(",") if x.strip() != ""]
+        return max(len(ids), 1)
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:  # [G,S]<=[N]: G groups of size S
+        return max(int(m.group(2)), 1)
+    return default
+
+
+def _wire_bytes(op: str, operand: int, result: int, n: int) -> float:
+    if n <= 1:
+        return 0.0
+    f = (n - 1) / n
+    if op == "all-reduce":
+        return 2.0 * f * result
+    if op == "reduce-scatter":
+        return f * operand
+    if op == "all-gather":
+        return f * result
+    if op == "all-to-all":
+        return f * operand
+    return float(operand)   # collective-permute
+
+
+def report(hlo_text: str, num_devices: Optional[int] = None) -> Dict[str, Any]:
+    """Parse one HLO module's text into the collective report."""
+    lines = hlo_text.splitlines()
+    current = "entry"
+    calls: Dict[str, set] = {}
+    cond_roots: set = set()
+    collectives: List[Dict[str, Any]] = []
+
+    for line in lines:
+        cm = _COMP_RE.match(line)
+        if cm and line.rstrip().endswith("{"):
+            current = cm.group(1)
+            calls.setdefault(current, set())
+        im = _INSTR_RE.match(line)
+        if not im:
+            continue
+        result_type, opcode = im.group(2), im.group(3)
+        for tm in _CALLED_RE.finditer(line):
+            for name in tm.group(1).split(","):
+                calls.setdefault(current, set()).add(name.strip().lstrip("%"))
+        if opcode == "conditional":
+            for gm in _COND_REFS_RE.finditer(line):
+                blob = gm.group(1) or gm.group(2) or gm.group(3) or ""
+                for name in blob.split(","):
+                    name = name.strip().lstrip("%")
+                    if name:
+                        cond_roots.add(name)
+        base = opcode[:-6] if opcode.endswith("-start") else opcode
+        if base not in _COLLECTIVES or opcode.endswith("-done"):
+            continue
+        res_bytes, dtype, shape = _buffer_bytes(result_type)
+        # operand buffers: typed buffers inside the (...) args
+        args = line[im.end():]
+        op_bytes, _, _ = _buffer_bytes(args.split(", channel_id")[0]
+                                       .split(", replica_groups")[0])
+        if base == "all-reduce" and op_bytes == 0:
+            op_bytes = res_bytes
+        n = _group_size(line, num_devices or 1)
+        collectives.append({
+            "op": base, "dtype": dtype, "shape": shape,
+            "result_bytes": res_bytes, "operand_bytes": op_bytes or res_bytes,
+            "group_size": n,
+            "wire_bytes": _wire_bytes(base, op_bytes or res_bytes,
+                                      res_bytes, n),
+            "computation": current,
+        })
+
+    # a computation is "conditional" if it is a cond branch or reachable
+    # only through one (transitive closure over the call graph)
+    in_cond = set()
+    frontier = set(cond_roots)
+    while frontier:
+        c = frontier.pop()
+        if c in in_cond:
+            continue
+        in_cond.add(c)
+        frontier |= calls.get(c, set())
+    for c in collectives:
+        c["in_conditional"] = c["computation"] in in_cond
+
+    totals: Dict[str, float] = {}
+    by_dtype: Dict[str, float] = {}
+    for c in collectives:
+        totals[c["op"]] = totals.get(c["op"], 0.0) + c["wire_bytes"]
+        if c["dtype"]:
+            by_dtype[c["dtype"]] = by_dtype.get(c["dtype"], 0.0) + c["wire_bytes"]
+    return {
+        "n_collectives": len(collectives),
+        "collectives": collectives,
+        "wire_bytes_total": sum(c["wire_bytes"] for c in collectives),
+        "wire_bytes_by_op": totals,
+        "wire_bytes_by_dtype": by_dtype,
+    }
+
+
+def report_compiled(compiled, num_devices: Optional[int] = None) -> Dict[str, Any]:
+    """Report for a jax ``Compiled`` object (``jit(f).lower(...)
+    .compile()``); concatenates every module's text."""
+    try:
+        text = compiled.as_text()
+    except AttributeError:   # raw module list
+        text = "\n".join(m.to_string() for m in compiled.hlo_modules())
+    return report(text, num_devices=num_devices)
+
+
+def grad_collectives(rep: Dict[str, Any], min_bytes: int = 1024
+                     ) -> List[Dict[str, Any]]:
+    """The data-plane collectives: big enough to be gradient/param
+    traffic (drops the scalar loss pmean / AMP finite-flag psums)."""
+    return [c for c in rep["collectives"]
+            if c["op"] != "collective-permute"
+            and max(c["result_bytes"], c["operand_bytes"]) >= min_bytes]
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description="per-collective bytes from HLO")
+    ap.add_argument("file", help="HLO text file, or - for stdin")
+    ap.add_argument("--min-bytes", type=int, default=0,
+                    help="only report collectives moving >= this many bytes")
+    ap.add_argument("--devices", type=int, default=None,
+                    help="device count fallback when replica_groups is absent")
+    args = ap.parse_args(argv)
+    text = sys.stdin.read() if args.file == "-" else open(args.file).read()
+    rep = report(text, num_devices=args.devices)
+    if args.min_bytes:
+        rep["collectives"] = [c for c in rep["collectives"]
+                              if max(c["result_bytes"], c["operand_bytes"])
+                              >= args.min_bytes]
+        rep["n_collectives"] = len(rep["collectives"])
+    json.dump(rep, sys.stdout, indent=1)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,6 +15,18 @@
                    distributed bootstrap). Env reads scattered through
                    library code make runs irreproducible; route them
                    through flags.
+  cast-roundtrip   a value narrowed with ``.astype(...)`` is immediately
+                   widened back with no intervening collective/op —
+                   either a direct ``x.astype(a).astype(b)`` chain or
+                   the tree_map pair form
+                   (``h = tmap(lambda g: g.astype(d), grads)`` followed
+                   by ``tmap(lambda h, g: h.astype(g.dtype), half, …)``
+                   with no use of ``h`` in between). Numerically it
+                   simulates wire precision while moving zero fewer
+                   bytes — the FP16AllReduceOptimizer bug class; route
+                   the dtype to the collective (comm_fusion) instead.
+                   Intentional precision simulation gets an ignore with
+                   a justification.
 
 Scope: ``paddle_tpu/`` and ``bench.py`` for all rules; ``tools/`` for
 time-time only (demo drivers legitimately read their own env knobs).
@@ -42,6 +54,108 @@ ENV_READ_OK = {
 }
 
 _MUTABLE_CTORS = {"list", "dict", "set"}
+
+_TREE_MAP_BASES = {"tree_map", "_tmap", "tmap", "tree_multimap"}
+
+
+def _is_tree_map(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted(node.func)
+    if not name:
+        return False
+    return (name.rsplit(".", 1)[-1] in _TREE_MAP_BASES
+            or name == "jax.tree.map")
+
+
+def _astype_call(node: ast.AST):
+    """The Attribute node of a direct ``<expr>.astype(...)`` call."""
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "astype"):
+        return node.func
+    return None
+
+
+def _lambda_body_astype(call: ast.Call):
+    """For a tree-map call whose first arg is a lambda whose body is a
+    direct ``.astype(...)``, return that lambda; else None."""
+    if not call.args or not isinstance(call.args[0], ast.Lambda):
+        return None
+    lam = call.args[0]
+    return lam if _astype_call(lam.body) is not None else None
+
+
+def _names_in(node: ast.AST):
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _roundtrip_in_block(stmts, emit) -> None:
+    """Scan one statement list for the narrow-then-immediately-widen
+    pair: ``h = <cast-producing stmt>`` whose NEXT use is itself a
+    direct ``.astype`` of ``h`` (plain or tree_map form). An intervening
+    statement that touches ``h`` (a collective, a reducer call, any op)
+    clears the pending match — that is the "no intervening op" test."""
+    pending = {}   # var name -> ("direct"|"tmap", assign lineno)
+    for st in stmts:
+        used = _names_in(st)
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 and \
+                isinstance(st.targets[0], ast.Name):
+            tgt = st.targets[0].id
+            val = st.value
+            # does this statement WIDEN a pending narrow?
+            hit = None
+            if isinstance(val, ast.Call):
+                att = _astype_call(val)
+                if att is not None:
+                    base = dotted(att.value)
+                    if base in pending and pending[base][0] == "direct":
+                        hit = base
+                elif _is_tree_map(val) and _lambda_body_astype(val) is not None:
+                    lam = val.args[0]
+                    att2 = _astype_call(lam.body)
+                    if isinstance(att2.value, ast.Name) and \
+                            att2.value.id in {a.arg for a in lam.args.args}:
+                        for a in val.args[1:]:
+                            if isinstance(a, ast.Name) and a.id in pending \
+                                    and pending[a.id][0] == "tmap":
+                                hit = a.id
+                                break
+            if hit is not None:
+                emit(st, "cast-roundtrip",
+                     f"`{hit}` was narrowed with .astype() and is widened "
+                     "right back with no intervening collective/op — a "
+                     "wire-width no-op (FP16AllReduce bug class); route "
+                     "the dtype to the collective (comm_fusion) or add an "
+                     "ignore with justification")
+                pending.pop(hit, None)
+            # any other use of a pending name clears it (intervening op)
+            for name in list(pending):
+                if name in used and name != hit:
+                    pending.pop(name)
+            # does this statement NARROW (start a pending match)?
+            if isinstance(val, ast.Call):
+                if _astype_call(val) is not None:
+                    pending[tgt] = ("direct", st.lineno)
+                elif _is_tree_map(val) and _lambda_body_astype(val) is not None:
+                    pending[tgt] = ("tmap", st.lineno)
+                elif tgt in pending:
+                    pending.pop(tgt)
+            elif tgt in pending:
+                pending.pop(tgt)
+        else:
+            for name in list(pending):
+                if name in used:
+                    pending.pop(name)
+
+
+def _iter_blocks(fn: ast.AST):
+    """Every statement list inside a function (body + nested blocks)."""
+    for node in ast.walk(fn):
+        for field in ("body", "orelse", "finalbody"):
+            block = getattr(node, field, None)
+            if isinstance(block, list) and block and \
+                    isinstance(block[0], ast.stmt):
+                yield block
 
 
 def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
@@ -87,6 +201,13 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
                      "time.time() measures wall clock — use "
                      "time.perf_counter() for durations/deadlines "
                      "(allowlist genuine timestamps)")
+            att = _astype_call(node)
+            if att is not None and _astype_call(att.value) is not None:
+                emit(node, "cast-roundtrip",
+                     "chained `.astype(a).astype(b)` narrows and widens in "
+                     "place — a wire-width no-op (FP16AllReduce bug class); "
+                     "route the dtype to the collective (comm_fusion) or "
+                     "add an ignore with justification")
             if name in ("os.environ.get", "os.getenv") and \
                     rel not in ENV_READ_OK:
                 emit(node, "env-read",
@@ -115,12 +236,16 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
                     emit(default, "mutable-default",
                          f"mutable default argument in `{node.name}()` is "
                          "shared across calls — default to None")
+
+    for block in _iter_blocks(tree):
+        _roundtrip_in_block(block, emit)
     return diags
 
 
 def run(root: str) -> List[Diagnostic]:
     diags: List[Diagnostic] = []
-    all_rules = {"time-time", "bare-except", "mutable-default", "env-read"}
+    all_rules = {"time-time", "bare-except", "mutable-default", "env-read",
+                 "cast-roundtrip"}
     for p in walk_py(root, ("paddle_tpu",), ("bench.py",)):
         diags.extend(check_file(p, root, all_rules))
     tools_dir = os.path.join(root, "tools")
