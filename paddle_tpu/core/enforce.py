@@ -18,6 +18,7 @@ __all__ = [
     "OutOfRangeError",
     "AlreadyExistsError",
     "PreconditionNotMetError",
+    "PsTransportError",
     "UnimplementedError",
     "UnavailableError",
     "ExecuteError",
@@ -56,6 +57,16 @@ class AlreadyExistsError(EnforceNotMet):
 
 class PreconditionNotMetError(EnforceNotMet):
     pass
+
+
+class PsTransportError(PreconditionNotMetError):
+    """A PS CONNECTION died (reset / refused / whole-call deadline):
+    the framed stream is undefined and the server may be gone. Distinct
+    from plain PreconditionNotMetError so HA failover and the circuit
+    breaker (ps/ha.py, RpcPsClient._shard_op) react ONLY to transport
+    deaths — a healthy server's application-level rejection must never
+    be misread as a dead server. Injected faults (ps/faultpoints.py
+    FaultInjected) subclass this so chaos walks the same paths."""
 
 
 class UnimplementedError(EnforceNotMet, NotImplementedError):

@@ -151,3 +151,10 @@ define_flag(
 define_flag("eager_delete_tensor_gb", 0.0, "Kept for API parity (XLA GC owns memory).")
 # (the RNG seed flag is defined by paddle_tpu.nn.layer, which owns the
 # ambient RNG stream, so its on_change callback can reseed it directly)
+# Cross-cutting chaos switch: read by BOTH the transport faultpoint sites
+# (ps/rpc.py) and the HA harness (ps/ha.py), so it lives here rather than
+# at either point of use. Format and actions: ps/faultpoints.py.
+define_flag("ps_faultpoints", "",
+            "arm PS fault-injection sites: 'site=action[:k=v]*[;...]' — "
+            "actions delay-ms/drop-frame/close-socket/kill-shard/"
+            "corrupt-epoch (ps/faultpoints.py; chaos testing only)")

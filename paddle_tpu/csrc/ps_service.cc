@@ -34,9 +34,15 @@
 // construction. The table engines' internal order
 // (save_mu < shard_mu < ...) is declared where those locks live
 // (sparse_table.h, ssd_table.cc).
+// The HA additions keep the same discipline: oplog_mu (oplog ring +
+// catalog + staging), gate_mu (mutation pause gate), and fault_mu
+// (chaos faultpoints) are all LEAF locks — the tap/gate/fault sections
+// in handle() acquire exactly one of them, release it, and only then
+// enter table code; the replication shipper thread (Python-side,
+// through pss_oplog_next) likewise touches only oplog_mu.
 // LOCK ORDER: tables_mu < save_mu < shard_mu
 // LOCK ORDER: tables_mu < dense_mu
-// LOCK LEAF: conn_mu bar_mu mu
+// LOCK LEAF: conn_mu bar_mu mu oplog_mu gate_mu fault_mu
 
 #include <arpa/inet.h>
 #include <fcntl.h>
@@ -52,6 +58,7 @@
 #include <cstdio>
 #include <cstring>
 #include <ctime>
+#include <deque>
 #include <map>
 #include <memory>
 #include <string>
@@ -90,6 +97,7 @@ int64_t sst_load_cold(void* h, const uint64_t* keys, const float* values,
 int64_t sst_save_file(void* h, const char* path, int32_t mode,
                       int32_t use_gzip);
 int64_t sst_load_file(void* h, const char* path, int32_t use_gzip);
+uint64_t sst_digest(void* h);
 }
 
 namespace {
@@ -156,6 +164,19 @@ enum Cmd : uint32_t {
   kSaveFile = 35,   // aux = mode | gzip<<8; payload = server-local path;
                     // server streams its shard to the file itself
   kLoadFile = 36,   // aux = gzip<<8; payload = path; streams it back in
+  // -- HA / replication (ps/ha.py drives these; docs/OPERATIONS.md §6) --
+  kReplicate = 37,  // apply a primary's oplog entry: payload = inner
+                    // frame [ReqHeader][payload]; n = oplog seq (-1 =
+                    // untracked catalog replay); aux = primary's epoch —
+                    // rejected with kErrStaleEpoch when behind ours
+                    // (a demoted primary cannot overwrite its successor)
+  kEpoch = 38,      // n < 0: read; n >= 0: set epoch = n. status = epoch
+  kReplState = 39,  // n < 0: read → i64[2]{applied_seq, epoch};
+                    // n >= 0: set applied_seq = n (post-snapshot rebase)
+  kDigest = 40,     // → u64 order-independent content digest (row_hash)
+  kDenseSnap = 41,  // dense table full state → [i64 t][values][m][v]
+                    // (m/v present only for adam); status = dim
+  kDenseRestore = 42,  // payload as kDenseSnap's response; replaces state
 };
 
 enum Err : int64_t {
@@ -163,7 +184,50 @@ enum Err : int64_t {
   kErrNoTable = -2,
   kErrBadSize = -3,
   kErrInternal = -4,
+  kErrStaleEpoch = -5,  // kReplicate from a fenced (demoted) primary
+  kErrSeqGap = -6,      // kReplicate seq skipped entries — resync needed
 };
+
+// commands whose application changes table state: these are the ops a
+// primary taps into its oplog for the backup (pull/export only when the
+// insert-on-miss bit is set — a miss creates a row). kLoadFile/kSaveFile
+// are deliberately NOT replicated: they are operator restore/backup
+// flows with server-local paths (ha.py documents the restriction).
+inline bool is_mutating_cmd(uint32_t cmd, int32_t aux, int64_t n) {
+  switch (cmd) {
+    case kPushSparse:
+    case kPushDense:
+    case kSetDense:
+    case kInsertFull:
+    case kLoadCold:
+    case kPushGeo:
+    case kPullGeo:
+    case kShrink:
+    case kDenseRestore:
+      return true;
+    // the shared step counter survives failover; an n == 0 call is a
+    // pure READ and must stay ungated — the snapshot path reads it
+    // from a primary whose mutations are paused
+    case kGlobalStep:
+      return n != 0;
+    // creates ride the oplog too, so a live backup sees a table exist
+    // BEFORE its first replicated push (the separate catalog covers
+    // rejoin, where the ring may have dropped them)
+    case kCreateSparse:
+    case kCreateDense:
+    case kCreateGeo:
+      return true;
+    case kPullSparse:
+    case kExport:
+      return (aux & 1) != 0;
+    default:
+      return false;
+  }
+}
+
+inline bool is_create_cmd(uint32_t cmd) {
+  return cmd == kCreateSparse || cmd == kCreateDense || cmd == kCreateGeo;
+}
 
 constexpr uint64_t kMaxPayload = 1ULL << 32;  // 4 GiB frame cap
 
@@ -456,6 +520,147 @@ struct PsServer {
   // global step (GlobalStepTable)
   std::atomic<int64_t> global_step{0};
 
+  // -- HA / replication state (ps/ha.py ReplicationManager is the
+  // consumer; see docs/OPERATIONS.md §6) ------------------------------
+  // routing epoch: bumped by the failover coordinator on promotion;
+  // kReplicate frames carry the sender's epoch and are fenced below it
+  std::atomic<int64_t> epoch{0};
+  // last kReplicate seq applied (backup role; seqs start at 1, so 0 =
+  // nothing applied — a post-snapshot rebase sets this to the snapshot
+  // cut S and the tail resumes at S+1)
+  std::atomic<int64_t> applied_seq{0};
+  // oplog ring (primary role): every mutating request frame, stamped
+  // with a monotonically increasing seq; the Python shipper thread
+  // drains it via pss_oplog_next and forwards kReplicate frames.
+  // Bounded: overflow drops the OLDEST entry (oplog_dropped counts) —
+  // the shipper detects the seq gap and falls back to a full snapshot.
+  struct OplogEntry {
+    int64_t seq;
+    std::vector<char> frame;  // [ReqHeader][payload]
+  };
+  std::atomic<bool> repl_enabled{false};
+  size_t oplog_cap = 1 << 16;
+  int64_t oplog_seq = 0;
+  int64_t oplog_dropped = 0;
+  std::deque<OplogEntry> oplog;
+  std::mutex oplog_mu;  // leaf: append/pop only, nothing nests inside
+  std::condition_variable oplog_cv;
+  // create-command frames, replayed to a rejoining backup before the
+  // data snapshot (recorded unconditionally — creates are rare/small)
+  std::vector<std::vector<char>> catalog;
+  // staging buffer for pss_oplog_next / pss_catalog_get (single
+  // consumer: the one shipper thread)
+  std::vector<char> staged;
+
+  // mutation pause gate: full-snapshot sync quiesces writers so the
+  // snapshot + seq rebase is a consistent cut (mutators block briefly —
+  // within the client IO deadline — rather than fail)
+  std::mutex gate_mu;  // leaf: only the gate fields live under it
+  std::condition_variable gate_cv;
+  bool gate_paused = false;
+  int gate_active = 0;
+
+  // deterministic fault injection (the chaos-test harness; armed via
+  // pss_arm_fault or ha.py faultpoints). A fault matches requests by
+  // cmd (0 = any), counts matches, and fires once `after` is reached:
+  //   kill-shard  → request_stop() and drop the connection
+  //   drop-frame  → drop the connection without responding
+  //   delay-ms    → sleep `param` ms before handling (stays armed)
+  struct Fault {
+    uint32_t cmd = 0;
+    int64_t after = 0;
+    int64_t param = 0;
+    int64_t seen = 0;
+    bool armed = true;
+  };
+  std::map<std::string, Fault> faults;
+  std::mutex fault_mu;  // leaf
+
+  void log_op(const ReqHeader& h, const char* p) {
+    std::lock_guard<std::mutex> g(oplog_mu);  // LOCK: oplog_mu
+    if (!repl_enabled.load()) return;
+    OplogEntry e;
+    e.seq = ++oplog_seq;
+    e.frame.resize(sizeof(ReqHeader) + h.payload_len);
+    std::memcpy(e.frame.data(), &h, sizeof(ReqHeader));
+    if (h.payload_len)
+      std::memcpy(e.frame.data() + sizeof(ReqHeader), p, h.payload_len);
+    oplog.push_back(std::move(e));
+    while (oplog.size() > oplog_cap) {
+      oplog.pop_front();
+      ++oplog_dropped;
+    }
+    oplog_cv.notify_one();
+  }
+
+  void log_catalog(const ReqHeader& h, const char* p) {
+    std::lock_guard<std::mutex> g(oplog_mu);  // LOCK: oplog_mu
+    std::vector<char> f(sizeof(ReqHeader) + h.payload_len);
+    std::memcpy(f.data(), &h, sizeof(ReqHeader));
+    if (h.payload_len) std::memcpy(f.data() + sizeof(ReqHeader), p, h.payload_len);
+    catalog.push_back(std::move(f));
+  }
+
+  void gate_enter() {
+    std::unique_lock<std::mutex> lk(gate_mu);  // LOCK: gate_mu
+    gate_cv.wait(lk, [&]() { return !gate_paused || stopping.load(); });
+    ++gate_active;
+  }
+
+  void gate_exit() {
+    {
+      std::lock_guard<std::mutex> g(gate_mu);  // LOCK: gate_mu
+      --gate_active;
+    }
+    gate_cv.notify_all();
+  }
+
+  // RAII so every respond() path in the mutating switch releases the gate
+  struct MutGuard {
+    PsServer* s;
+    bool on;
+    MutGuard(PsServer* srv, bool enable) : s(srv), on(enable) {
+      if (on) s->gate_enter();
+    }
+    ~MutGuard() {
+      if (on) s->gate_exit();
+    }
+  };
+
+  void pause_mutations(bool on) {
+    std::unique_lock<std::mutex> lk(gate_mu);  // LOCK: gate_mu
+    gate_paused = on;
+    if (on)
+      gate_cv.wait(lk, [&]() { return gate_active == 0 || stopping.load(); });
+    else
+      gate_cv.notify_all();
+  }
+
+  // fault check for one request; returns the armed action to take
+  // ("" = none). delay-ms sleeps here and keeps going.
+  std::string fault_action(uint32_t cmd) {
+    int64_t delay = 0;
+    std::string act;
+    {
+      std::lock_guard<std::mutex> g(fault_mu);  // LOCK: fault_mu
+      for (auto& kv : faults) {
+        Fault& f = kv.second;
+        if (!f.armed || (f.cmd != 0 && f.cmd != cmd)) continue;
+        if (++f.seen < f.after) continue;
+        if (kv.first == "delay-ms") {
+          delay = f.param;  // stays armed: every matching op is slowed
+        } else {
+          f.armed = false;  // kill-shard / drop-frame fire once
+          act = kv.first;
+          break;
+        }
+      }
+    }
+    if (delay > 0)
+      std::this_thread::sleep_for(std::chrono::milliseconds(delay));
+    return act;
+  }
+
   ~PsServer() {
     for (auto& kv : sparse) {
       delete kv.second.mem;
@@ -520,6 +725,10 @@ struct PsServer {
       bar_count = 0;
     }
     bar_cv.notify_all();
+    // wake the oplog shipper and any gate-blocked mutators: both wait
+    // on predicates that include stopping
+    oplog_cv.notify_all();
+    gate_cv.notify_all();
   }
 
   // full shutdown: join all threads. Must NOT run on a handler thread.
@@ -533,6 +742,16 @@ struct PsServer {
     }
     for (auto& t : ts)
       if (t.joinable()) t.join();
+  }
+
+  // lock-free row-count probe (Shard::used is atomic): runs TWICE per
+  // replicated pull-with-create to detect inserts, so it must not
+  // serialize against the shard locks the traversal holds
+  static int64_t sparse_rows(const SparseRef& t) {
+    if (t.ssd) return sst_size(t.ssd);
+    int64_t n = 0;
+    for (auto* sh : t.mem->shards) n += sh->used.load();
+    return n;
   }
 
   bool get_sparse(uint32_t id, SparseRef* out) {
@@ -565,6 +784,235 @@ struct PsServer {
     return true;
   }
 
+  // -- create bodies, shared by the interactive path (handle) and the
+  // replication catalog-replay path (apply_op) -------------------------
+
+  int64_t do_create_sparse(const ReqHeader& h, const char* p, int32_t dims[3]) {
+    // payload: iparams[6 i32] + fparams[17 f32], optionally followed
+    // by [i32 storage][u32 path_len][path] (storage 1 = ssd)
+    constexpr uint64_t kBase = 6 * 4 + 17 * 4;
+    if (h.payload_len < kBase) return kErrBadSize;
+    int32_t storage = 0;
+    std::string path;
+    if (h.payload_len > kBase) {
+      if (h.payload_len < kBase + 8) return kErrBadSize;
+      uint32_t plen;
+      std::memcpy(&storage, p + kBase, 4);
+      std::memcpy(&plen, p + kBase + 4, 4);
+      if (h.payload_len != kBase + 8 + plen) return kErrBadSize;
+      path.assign(p + kBase + 8, plen);
+    }
+    TableNativeConfig c = pstpu::parse_table_config(
+        reinterpret_cast<const int32_t*>(p),
+        reinterpret_cast<const float*>(p + 24));
+    // build the engine OUTSIDE tables_mu: an SSD create replays the
+    // whole cold-tier log, and that must not stall other tables'
+    // traffic. Losing a create race destroys the duplicate.
+    SparseRef fresh;
+    if (storage == 1) {
+      fresh.ssd = sst_create(reinterpret_cast<const int32_t*>(p),
+                             reinterpret_cast<const float*>(p + 24),
+                             path.c_str());
+      if (!fresh.ssd) return kErrInternal;
+    } else {
+      fresh.mem = new NativeTable(c);
+    }
+    SparseRef t;
+    {
+      std::lock_guard<std::mutex> g(tables_mu);
+      auto it = sparse.find(h.table_id);
+      if (it != sparse.end()) {
+        t = it->second;  // idempotent re-create from another trainer
+      } else {
+        t = fresh;
+        fresh = SparseRef{};
+        sparse[h.table_id] = t;
+        if (t.ssd) ssd_save_mu[h.table_id] = std::make_unique<std::mutex>();
+      }
+    }
+    delete fresh.mem;
+    if (fresh.ssd) sst_destroy(fresh.ssd);
+    dims[0] = t.pull_dim();
+    dims[1] = t.push_dim();
+    dims[2] = t.full_dim();
+    return 0;
+  }
+
+  int64_t do_create_dense(const ReqHeader& h, const char* p) {
+    if (h.payload_len != 12) return kErrBadSize;
+    int32_t dim, opt;
+    float lr;
+    std::memcpy(&dim, p, 4);
+    std::memcpy(&opt, p + 4, 4);
+    std::memcpy(&lr, p + 8, 4);
+    std::lock_guard<std::mutex> g(tables_mu);
+    if (!dense.count(h.table_id))
+      dense[h.table_id] = new DenseTable(dim, opt, lr);
+    return 0;
+  }
+
+  int64_t do_create_geo(const ReqHeader& h, const char* p) {
+    if (h.payload_len != 4) return kErrBadSize;
+    int32_t dim;
+    std::memcpy(&dim, p, 4);
+    std::lock_guard<std::mutex> g(tables_mu);
+    if (!geo.count(h.table_id)) geo[h.table_id] = new GeoTable(dim);
+    return 0;
+  }
+
+  int64_t do_dense_restore(const ReqHeader& h, const char* p) {
+    DenseTable* t = get_dense(h.table_id);
+    if (!t) return kErrNoTable;
+    std::lock_guard<std::mutex> g(t->mu);
+    size_t d = t->values.size();
+    size_t want = 8 + 4 * d * (t->opt == 1 ? 3 : 1);
+    if (h.payload_len != want) return kErrBadSize;
+    std::memcpy(&t->t, p, 8);
+    std::memcpy(t->values.data(), p + 8, 4 * d);
+    if (t->opt == 1) {
+      std::memcpy(t->m.data(), p + 8 + 4 * d, 4 * d);
+      std::memcpy(t->v.data(), p + 8 + 8 * d, 4 * d);
+    }
+    return 0;
+  }
+
+  // Apply one replicated frame WITHOUT a socket response (pull/export
+  // outputs are discarded — only the insert-on-miss side effect
+  // matters). Validation is kept in lockstep with handle() so a frame
+  // that failed on the primary fails identically on the backup.
+  int64_t apply_op(const ReqHeader& h, const char* p) {
+    if (h.n < 0 || static_cast<uint64_t>(h.n) > kMaxPayload) return kErrBadSize;
+    switch (h.cmd) {
+      case kCreateSparse: {
+        int32_t dims[3];
+        return do_create_sparse(h, p, dims);
+      }
+      case kCreateDense:
+        return do_create_dense(h, p);
+      case kCreateGeo:
+        return do_create_geo(h, p);
+      case kDenseRestore:
+        return do_dense_restore(h, p);
+      case kPullSparse: {  // replicated only with aux&1: the row creates
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return kErrNoTable;
+        int32_t pd = t.pull_dim();
+        if (h.payload_len != static_cast<uint64_t>(h.n) * 12) return kErrBadSize;
+        const uint64_t* keys = reinterpret_cast<const uint64_t*>(p);
+        const int32_t* slots = reinterpret_cast<const int32_t*>(p + h.n * 8);
+        std::vector<float> out(static_cast<size_t>(h.n) * pd);
+        if (t.ssd) {
+          sst_pull(t.ssd, keys, slots, h.n, 1, out.data());
+        } else {
+          t.mem->parallel_over_shards(keys, h.n, [&](pstpu::Shard* sh, int64_t i) {
+            int32_t r = sh->lookup_or_insert(keys[i], slots[i]);
+            sh->select_into(r, out.data() + i * pd);
+          });
+        }
+        return h.n;
+      }
+      case kExport: {  // replicated only with aux&1
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return kErrNoTable;
+        if (h.payload_len != static_cast<uint64_t>(h.n) * 12) return kErrBadSize;
+        int32_t fdim = t.full_dim();
+        const uint64_t* keys = reinterpret_cast<const uint64_t*>(p);
+        const int32_t* slots = reinterpret_cast<const int32_t*>(p + h.n * 8);
+        std::vector<float> vals(static_cast<size_t>(h.n) * fdim);
+        std::vector<uint8_t> found(h.n);
+        if (t.ssd)
+          sst_export(t.ssd, keys, slots, h.n, 1, vals.data(), found.data());
+        else
+          pstpu::table_export(t.mem, keys, h.n, vals.data(), found.data(), 1,
+                              slots);
+        return h.n;
+      }
+      case kPushSparse: {
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return kErrNoTable;
+        int32_t pd = t.push_dim();
+        if (h.payload_len != static_cast<uint64_t>(h.n) * (8 + 4 * pd))
+          return kErrBadSize;
+        const uint64_t* keys = reinterpret_cast<const uint64_t*>(p);
+        const float* push = reinterpret_cast<const float*>(p + h.n * 8);
+        if (t.ssd) {
+          sst_push(t.ssd, keys, push, h.n);
+        } else {
+          t.mem->parallel_over_shards(keys, h.n, [&](pstpu::Shard* sh, int64_t i) {
+            const float* pv = push + i * pd;
+            int32_t r = sh->lookup_or_insert(keys[i], static_cast<int32_t>(pv[0]));
+            sh->push_one(r, pv);
+          });
+        }
+        return h.n;
+      }
+      case kPushDense: {
+        DenseTable* t = get_dense(h.table_id);
+        if (!t) return kErrNoTable;
+        if (h.payload_len != t->values.size() * 4) return kErrBadSize;
+        t->push(reinterpret_cast<const float*>(p));
+        return 0;
+      }
+      case kSetDense: {
+        DenseTable* t = get_dense(h.table_id);
+        if (!t) return kErrNoTable;
+        if (h.payload_len != t->values.size() * 4) return kErrBadSize;
+        std::lock_guard<std::mutex> g(t->mu);
+        std::memcpy(t->values.data(), p, h.payload_len);
+        return 0;
+      }
+      case kInsertFull:
+      case kLoadCold: {
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return kErrNoTable;
+        int32_t fdim = t.full_dim();
+        if (h.payload_len != static_cast<uint64_t>(h.n) * (8 + 4 * fdim))
+          return kErrBadSize;
+        const uint64_t* keys = reinterpret_cast<const uint64_t*>(p);
+        const float* vals = reinterpret_cast<const float*>(p + h.n * 8);
+        if (t.ssd) {
+          if (h.cmd == kLoadCold) return sst_load_cold(t.ssd, keys, vals, h.n);
+          sst_insert_full(t.ssd, keys, vals, h.n);
+        } else {
+          pstpu::table_insert_full(t.mem, keys, vals, h.n);
+        }
+        return h.n;
+      }
+      case kPushGeo: {
+        GeoTable* t = get_geo(h.table_id);
+        if (!t) return kErrNoTable;
+        if (h.payload_len != static_cast<uint64_t>(h.n) * (8 + 4 * t->dim))
+          return kErrBadSize;
+        t->push(reinterpret_cast<const uint64_t*>(p),
+                reinterpret_cast<const float*>(p + h.n * 8), h.n);
+        return h.n;
+      }
+      case kPullGeo: {  // primary drained — backup must drop the same acc
+        GeoTable* t = get_geo(h.table_id);
+        if (!t) return kErrNoTable;
+        std::vector<uint64_t> keys;
+        std::vector<float> deltas;
+        t->pull(&keys, &deltas);
+        return static_cast<int64_t>(keys.size());
+      }
+      case kShrink: {
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return kErrNoTable;
+        if (t.ssd) return sst_shrink(t.ssd);
+        int64_t erased = 0;
+        for (auto* sh : t.mem->shards) {
+          std::lock_guard<std::mutex> g(sh->mu);
+          erased += sh->shrink();
+        }
+        return erased;
+      }
+      case kGlobalStep:
+        return global_step.fetch_add(h.n) + h.n;
+      default:
+        return kErrBadCmd;
+    }
+  }
+
   void serve_conn(int fd) {
     std::vector<char> buf;
     while (true) {
@@ -591,80 +1039,60 @@ struct PsServer {
     // and bypass them into out-of-bounds reads. No legitimate command
     // carries more elements than the frame cap has bytes; with
     // n ≤ kMaxPayload every downstream n·width product fits in 64 bits.
-    if (h.n < 0 || static_cast<uint64_t>(h.n) > kMaxPayload)
-      return respond(fd, kErrBadSize, nullptr, 0);
+    if (h.n < 0 || static_cast<uint64_t>(h.n) > kMaxPayload) {
+      // exemptions: kEpoch reads with n = -1; kReplicate/kReplState
+      // carry an oplog SEQ in n (any int64 >= -1, NOT an element
+      // count — a long-lived shard's lifetime mutation count exceeds
+      // the 2^32 frame-cap bound this check enforces for count-shaped
+      // n, and a snapshot rebase must be able to SET such a cut)
+      bool ok = h.cmd == kEpoch && h.n == -1;
+      ok = ok || ((h.cmd == kReplicate || h.cmd == kReplState) && h.n >= -1);
+      if (!ok) return respond(fd, kErrBadSize, nullptr, 0);
+    }
+    // deterministic fault injection (chaos harness): fires BEFORE any
+    // state change so a dropped/killed request is all-or-nothing
+    {
+      std::string act = fault_action(h.cmd);
+      if (act == "kill-shard") {
+        request_stop();  // the whole server dies, like a SIGKILL'd host
+        return false;
+      }
+      if (act == "drop-frame") return false;  // vanish without a response
+      if (act == "close-socket") {
+        ::shutdown(fd, SHUT_RDWR);
+        return false;
+      }
+    }
+    bool mutating = is_mutating_cmd(h.cmd, h.aux, h.n);
+    // snapshot quiesce gate + oplog tap: mutating requests block while a
+    // full-sync pauses writers, then land in the oplog in the order this
+    // serialized section admits them. NB the tap happens before the
+    // apply; with multiple client connections the engine-apply order of
+    // racing same-key pushes may differ from oplog order (async
+    // replication tolerates bounded divergence; sync-mode bit-identical
+    // guarantees assume serialized pushes — ps/ha.py docstring).
+    MutGuard mg(this, mutating);
+    // pull/export-with-create defer their tap into the case body: when
+    // the traversal inserts NOTHING the op is a state no-op and skipping
+    // it halves steady-state replication traffic (a stream trainer
+    // re-pulls the same working set every batch). All other mutators tap
+    // here, before the apply.
+    bool deferred_tap = h.cmd == kPullSparse || h.cmd == kExport;
+    if (mutating && !deferred_tap && repl_enabled.load()) log_op(h, p);
+    if (is_create_cmd(h.cmd)) log_catalog(h, p);
     switch (h.cmd) {
       case kPing:
         return respond(fd, 0, nullptr, 0);
       case kCreateSparse: {
-        // payload: iparams[6 i32] + fparams[17 f32], optionally followed
-        // by [i32 storage][u32 path_len][path] (storage 1 = ssd)
-        constexpr uint64_t kBase = 6 * 4 + 17 * 4;
-        if (h.payload_len < kBase) return respond(fd, kErrBadSize, nullptr, 0);
-        int32_t storage = 0;
-        std::string path;
-        if (h.payload_len > kBase) {
-          if (h.payload_len < kBase + 8) return respond(fd, kErrBadSize, nullptr, 0);
-          uint32_t plen;
-          std::memcpy(&storage, p + kBase, 4);
-          std::memcpy(&plen, p + kBase + 4, 4);
-          if (h.payload_len != kBase + 8 + plen)
-            return respond(fd, kErrBadSize, nullptr, 0);
-          path.assign(p + kBase + 8, plen);
-        }
-        TableNativeConfig c = pstpu::parse_table_config(
-            reinterpret_cast<const int32_t*>(p),
-            reinterpret_cast<const float*>(p + 24));
-        // build the engine OUTSIDE tables_mu: an SSD create replays the
-        // whole cold-tier log, and that must not stall other tables'
-        // traffic. Losing a create race destroys the duplicate.
-        SparseRef fresh;
-        if (storage == 1) {
-          fresh.ssd = sst_create(reinterpret_cast<const int32_t*>(p),
-                                 reinterpret_cast<const float*>(p + 24),
-                                 path.c_str());
-          if (!fresh.ssd) return respond(fd, kErrInternal, nullptr, 0);
-        } else {
-          fresh.mem = new NativeTable(c);
-        }
-        SparseRef t;
-        {
-          std::lock_guard<std::mutex> g(tables_mu);
-          auto it = sparse.find(h.table_id);
-          if (it != sparse.end()) {
-            t = it->second;  // idempotent re-create from another trainer
-          } else {
-            t = fresh;
-            fresh = SparseRef{};
-            sparse[h.table_id] = t;
-            if (t.ssd) ssd_save_mu[h.table_id] = std::make_unique<std::mutex>();
-          }
-        }
-        delete fresh.mem;
-        if (fresh.ssd) sst_destroy(fresh.ssd);
-        int32_t dims[3] = {t.pull_dim(), t.push_dim(), t.full_dim()};
+        int32_t dims[3];
+        int64_t st = do_create_sparse(h, p, dims);
+        if (st < 0) return respond(fd, st, nullptr, 0);
         return respond(fd, 0, dims, sizeof(dims));
       }
-      case kCreateDense: {
-        if (h.payload_len != 12) return respond(fd, kErrBadSize, nullptr, 0);
-        int32_t dim, opt;
-        float lr;
-        std::memcpy(&dim, p, 4);
-        std::memcpy(&opt, p + 4, 4);
-        std::memcpy(&lr, p + 8, 4);
-        std::lock_guard<std::mutex> g(tables_mu);
-        if (!dense.count(h.table_id))
-          dense[h.table_id] = new DenseTable(dim, opt, lr);
-        return respond(fd, 0, nullptr, 0);
-      }
-      case kCreateGeo: {
-        if (h.payload_len != 4) return respond(fd, kErrBadSize, nullptr, 0);
-        int32_t dim;
-        std::memcpy(&dim, p, 4);
-        std::lock_guard<std::mutex> g(tables_mu);
-        if (!geo.count(h.table_id)) geo[h.table_id] = new GeoTable(dim);
-        return respond(fd, 0, nullptr, 0);
-      }
+      case kCreateDense:
+        return respond(fd, do_create_dense(h, p), nullptr, 0);
+      case kCreateGeo:
+        return respond(fd, do_create_geo(h, p), nullptr, 0);
       case kPullSparse: {
         // aux bit 0: insert-on-miss; aux bit 1: fp16 wire values (the
         // table-config pull_wire_dtype knob — halves response bytes)
@@ -677,6 +1105,11 @@ struct PsServer {
         if (h.payload_len != want) return respond(fd, kErrBadSize, nullptr, 0);
         const uint64_t* keys = reinterpret_cast<const uint64_t*>(p);
         const int32_t* slots = reinterpret_cast<const int32_t*>(p + h.n * 8);
+        // deferred tap: only replicate this pull if it actually INSERTS
+        // (row-count delta; exact under one connection's serialized
+        // stream — the same window the sync bit-identity contract names)
+        bool tap = create && repl_enabled.load();
+        int64_t rows_before = tap ? sparse_rows(t) : 0;
         std::vector<float> out(static_cast<size_t>(h.n) * pd);
         if (t.ssd) {
           sst_pull(t.ssd, keys, slots, h.n, create, out.data());
@@ -691,6 +1124,7 @@ struct PsServer {
               std::fill_n(o, pd, 0.0f);
           });
         }
+        if (tap && sparse_rows(t) != rows_before) log_op(h, p);
         if (wire_f16) {
           std::vector<uint16_t> half(out.size());
           for (size_t i = 0; i < out.size(); ++i) half[i] = f32_to_f16(out[i]);
@@ -746,10 +1180,7 @@ struct PsServer {
       case kSize: {
         SparseRef t;
         if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
-        if (t.ssd) return respond(fd, sst_size(t.ssd), nullptr, 0);
-        int64_t n = 0;
-        for (auto* sh : t.mem->shards) n += sh->used;
-        return respond(fd, n, nullptr, 0);
+        return respond(fd, sparse_rows(t), nullptr, 0);
       }
       case kShrink: {
         SparseRef t;
@@ -981,11 +1412,15 @@ struct PsServer {
             h.aux ? reinterpret_cast<const int32_t*>(p + h.n * 8) : nullptr;
         float* vals = reinterpret_cast<float*>(out.data());
         uint8_t* found = reinterpret_cast<uint8_t*>(out.data() + h.n * fdim * 4);
+        // same deferred no-insert-no-tap rule as kPullSparse above
+        bool tap = (h.aux & 1) && repl_enabled.load();
+        int64_t rows_before = tap ? sparse_rows(t) : 0;
         if (t.ssd)
           sst_export(t.ssd, keys, slots, h.n, h.aux ? 1 : 0, vals, found);
         else
           pstpu::table_export(t.mem, keys, h.n, vals, found, h.aux ? 1 : 0,
                               slots);
+        if (tap && sparse_rows(t) != rows_before) log_op(h, p);
         return respond(fd, h.n, out.data(), out.size());
       }
       case kPushGeo: {
@@ -1010,6 +1445,95 @@ struct PsServer {
         return respond(fd, static_cast<int64_t>(keys.size()), out.data(),
                        out.size());
       }
+      case kReplicate: {
+        // apply a primary's oplog entry. n = seq (-1 = untracked catalog
+        // replay), aux = sender's epoch. Epoch fencing first: a demoted
+        // primary (network-partitioned through its own death sentence)
+        // must not overwrite the promoted successor's state.
+        if (static_cast<int64_t>(h.aux) < epoch.load())
+          return respond(fd, kErrStaleEpoch, nullptr, 0);
+        if (h.payload_len < sizeof(ReqHeader))
+          return respond(fd, kErrBadSize, nullptr, 0);
+        ReqHeader ih;
+        std::memcpy(&ih, p, sizeof(ih));
+        if (ih.payload_len != h.payload_len - sizeof(ReqHeader))
+          return respond(fd, kErrBadSize, nullptr, 0);
+        int64_t seq = h.n;
+        if (seq >= 0) {
+          int64_t expect = applied_seq.load() + 1;
+          if (seq < expect)  // replay after reconnect: ack idempotently
+            return respond(fd, seq, nullptr, 0);
+          if (seq > expect)  // entries lost — shipper must full-sync
+            return respond(fd, kErrSeqGap, nullptr, 0);
+        }
+        int64_t st = apply_op(ih, p + sizeof(ReqHeader));
+        // a frame that fails VALIDATION failed identically on the
+        // primary (the tap happens before the case body's payload
+        // checks, and apply_op's checks are kept in lockstep): state
+        // changed on NEITHER side, so ack it and advance — otherwise
+        // one malformed client request would wedge the backup into an
+        // endless drop/resync loop. kErrNoTable is in the same class:
+        // creates ride the SAME ordered stream, so a table missing here
+        // at seq K was also missing on the primary at its tap time.
+        bool rejected = st == kErrBadSize || st == kErrBadCmd ||
+                        st == kErrNoTable;
+        if (rejected) st = 0;
+        if (st < 0) return respond(fd, st, nullptr, 0);
+        if (seq >= 0) applied_seq.store(seq);
+        // chain the inner frame into OUR oplog too: a promoted backup
+        // already holds the history its own backups will need (no-op
+        // rejected frames aren't worth forwarding further)
+        if (!rejected) {
+          if (is_mutating_cmd(ih.cmd, ih.aux, ih.n) && repl_enabled.load())
+            log_op(ih, p + sizeof(ReqHeader));
+          if (is_create_cmd(ih.cmd)) log_catalog(ih, p + sizeof(ReqHeader));
+        }
+        return respond(fd, seq >= 0 ? seq : st, nullptr, 0);
+      }
+      case kEpoch: {
+        if (h.n >= 0) epoch.store(h.n);
+        return respond(fd, epoch.load(), nullptr, 0);
+      }
+      case kReplState: {
+        if (h.n >= 0) {
+          applied_seq.store(h.n);
+          return respond(fd, h.n, nullptr, 0);
+        }
+        int64_t oseq, opend;
+        {
+          std::lock_guard<std::mutex> g(oplog_mu);  // LOCK: oplog_mu
+          oseq = oplog_seq;
+          opend = static_cast<int64_t>(oplog.size());
+        }
+        // applied/epoch answer "how caught up is this backup"; the
+        // oplog pair answers "how far ahead is this primary" — together
+        // a CLIENT can run a cross-process sync-replication barrier
+        // (ha.drain_remote) with no shared store
+        int64_t out[4] = {applied_seq.load(), epoch.load(), oseq, opend};
+        return respond(fd, 0, out, sizeof(out));
+      }
+      case kDigest: {
+        SparseRef t;
+        if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
+        uint64_t dg = t.ssd ? sst_digest(t.ssd) : pstpu::table_digest(t.mem);
+        return respond(fd, 0, &dg, sizeof(dg));
+      }
+      case kDenseSnap: {
+        DenseTable* t = get_dense(h.table_id);
+        if (!t) return respond(fd, kErrNoTable, nullptr, 0);
+        std::lock_guard<std::mutex> g(t->mu);
+        size_t d = t->values.size();
+        std::vector<char> out(8 + 4 * d * (t->opt == 1 ? 3 : 1));
+        std::memcpy(out.data(), &t->t, 8);
+        std::memcpy(out.data() + 8, t->values.data(), 4 * d);
+        if (t->opt == 1) {
+          std::memcpy(out.data() + 8 + 4 * d, t->m.data(), 4 * d);
+          std::memcpy(out.data() + 8 + 8 * d, t->v.data(), 4 * d);
+        }
+        return respond(fd, static_cast<int64_t>(d), out.data(), out.size());
+      }
+      case kDenseRestore:
+        return respond(fd, do_dense_restore(h, p), nullptr, 0);
       case kBarrier: {
         std::unique_lock<std::mutex> lk(bar_mu);
         int64_t my_gen = bar_gen;
@@ -1023,9 +1547,17 @@ struct PsServer {
           // phantom arrival would release the next generation with n-1
           // real trainers, permanently desynchronizing the group
           for (;;) {
-            if (bar_cv.wait_for(lk, std::chrono::milliseconds(100), [&]() {
-                  return bar_gen != my_gen || stopping.load();
-                }))
+            // system_clock wait_until (NOT wait_for/steady): libstdc++
+            // lowers the steady-clock wait to pthread_cond_clockwait,
+            // which gcc-10's TSAN doesn't intercept — the invisible
+            // unlock inside the wait turns every later bar_mu/oplog_mu
+            // acquisition into ghost double-lock/race reports. The
+            // 100 ms slice has no steady-clock correctness dependence.
+            if (bar_cv.wait_until(
+                    lk, std::chrono::system_clock::now() +
+                            std::chrono::milliseconds(100), [&]() {
+                      return bar_gen != my_gen || stopping.load();
+                    }))
               break;
             char probe;
             ssize_t r = ::recv(fd, &probe, 1, MSG_PEEK | MSG_DONTWAIT);
@@ -1264,6 +1796,100 @@ void pss_destroy(void* h) {
   PsServer* s = static_cast<PsServer*>(h);
   s->stop();
   delete s;
+}
+
+// ---- server HA / replication / chaos ABI (ps/ha.py consumes) ----
+
+void pss_set_replication(void* h, int enable, int64_t cap_entries) {
+  PsServer* s = static_cast<PsServer*>(h);
+  std::lock_guard<std::mutex> g(s->oplog_mu);
+  s->repl_enabled.store(enable != 0);
+  if (cap_entries > 0) s->oplog_cap = static_cast<size_t>(cap_entries);
+  if (!enable) s->oplog.clear();
+}
+
+// Pop the next oplog entry into the staging buffer (SINGLE consumer:
+// the one shipper thread). Returns its seq, -1 on timeout, -2 when the
+// server is stopping and the ring is drained.
+int64_t pss_oplog_next(void* h, int32_t timeout_ms) {
+  PsServer* s = static_cast<PsServer*>(h);
+  std::unique_lock<std::mutex> lk(s->oplog_mu);
+  // system_clock wait_until, not wait_for: see the kBarrier comment
+  // (pthread_cond_clockwait is invisible to gcc-10 TSAN)
+  s->oplog_cv.wait_until(
+      lk, std::chrono::system_clock::now() +
+              std::chrono::milliseconds(timeout_ms), [&]() {
+        return !s->oplog.empty() || s->stopping.load();
+      });
+  if (s->oplog.empty()) return s->stopping.load() ? -2 : -1;
+  PsServer::OplogEntry e = std::move(s->oplog.front());
+  s->oplog.pop_front();
+  s->staged = std::move(e.frame);
+  return e.seq;
+}
+
+uint64_t pss_staged_len(void* h) {
+  return static_cast<PsServer*>(h)->staged.size();
+}
+const void* pss_staged_ptr(void* h) {
+  PsServer* s = static_cast<PsServer*>(h);
+  return s->staged.empty() ? nullptr : s->staged.data();
+}
+
+int64_t pss_oplog_seq(void* h) {
+  PsServer* s = static_cast<PsServer*>(h);
+  std::lock_guard<std::mutex> g(s->oplog_mu);
+  return s->oplog_seq;
+}
+int64_t pss_oplog_pending(void* h) {
+  PsServer* s = static_cast<PsServer*>(h);
+  std::lock_guard<std::mutex> g(s->oplog_mu);
+  return static_cast<int64_t>(s->oplog.size());
+}
+int64_t pss_oplog_dropped(void* h) {
+  PsServer* s = static_cast<PsServer*>(h);
+  std::lock_guard<std::mutex> g(s->oplog_mu);
+  return s->oplog_dropped;
+}
+
+int64_t pss_catalog_count(void* h) {
+  PsServer* s = static_cast<PsServer*>(h);
+  std::lock_guard<std::mutex> g(s->oplog_mu);
+  return static_cast<int64_t>(s->catalog.size());
+}
+// stage catalog frame i for pss_staged_ptr/len; returns its length
+int64_t pss_catalog_get(void* h, int64_t i) {
+  PsServer* s = static_cast<PsServer*>(h);
+  std::lock_guard<std::mutex> g(s->oplog_mu);
+  if (i < 0 || i >= static_cast<int64_t>(s->catalog.size())) return -1;
+  s->staged = s->catalog[static_cast<size_t>(i)];
+  return static_cast<int64_t>(s->staged.size());
+}
+
+void pss_pause_mutations(void* h, int on) {
+  static_cast<PsServer*>(h)->pause_mutations(on != 0);
+}
+
+int64_t pss_epoch(void* h) { return static_cast<PsServer*>(h)->epoch.load(); }
+void pss_set_epoch(void* h, int64_t e) {
+  static_cast<PsServer*>(h)->epoch.store(e);
+}
+int64_t pss_applied_seq(void* h) {
+  return static_cast<PsServer*>(h)->applied_seq.load();
+}
+
+// arm a deterministic faultpoint: name in {kill-shard, drop-frame,
+// close-socket, delay-ms}; cmd 0 = any command; fires once `after`
+// matching requests have been seen (delay-ms stays armed, param = ms)
+void pss_arm_fault(void* h, const char* name, uint32_t cmd, int64_t after,
+                   int64_t param) {
+  PsServer* s = static_cast<PsServer*>(h);
+  std::lock_guard<std::mutex> g(s->fault_mu);
+  PsServer::Fault f;
+  f.cmd = cmd;
+  f.after = after;
+  f.param = param;
+  s->faults[name] = f;
 }
 
 // ---- client ----

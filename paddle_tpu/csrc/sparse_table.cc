@@ -148,4 +148,11 @@ void pst_insert_full(void* h, const uint64_t* keys, const float* values,
   pstpu::table_insert_full(static_cast<NativeTable*>(h), keys, values, n);
 }
 
+// Order-independent content digest (pstpu::row_hash over every live
+// row, wrapping-add combine) — HA replica consistency checks compare
+// this across servers instead of shipping rows.
+uint64_t pst_digest(void* h) {
+  return pstpu::table_digest(static_cast<NativeTable*>(h));
+}
+
 }  // extern "C"

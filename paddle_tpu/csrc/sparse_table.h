@@ -250,7 +250,10 @@ struct Shard {
   std::vector<int32_t> slot_state;  // row | kEmpty | kTombstone
   uint64_t mask = 0;
   uint64_t hash_salt = next_hash_salt();  // see next_hash_salt()
-  int64_t used = 0;
+  // atomic so size probes (pst_size, ps_service sparse_rows — the
+  // replication insert-detector on the pull hot path) read it WITHOUT
+  // taking the shard lock; all writes still happen under mu
+  std::atomic<int64_t> used{0};
   int64_t occupied = 0;
 
   uint64_t slot_of(uint64_t key) const {
@@ -761,6 +764,46 @@ inline bool parse_text_row(const char* line, uint64_t* key, float* row,
   }
   if (cnt >= xd && xd > 0) row[head] = 1.0f;
   return true;
+}
+
+// -- content digest ---------------------------------------------------------
+// Order-independent 64-bit digest of a table's full logical content:
+// per-row FNV-1a over [key bytes ++ full-row float bytes], combined with
+// wrapping ADD so shard layout, index salt, and iteration order do not
+// matter — two replicas that hold bit-identical rows produce the same
+// digest regardless of how their hash tables arranged them. Shared by
+// the RAM engine (here), the SSD engine (ssd_table.cc hashes both
+// tiers), and the PS service's kDigest command, which is how the HA
+// tests assert primary ≡ backup without shipping every row.
+
+inline uint64_t row_hash(uint64_t key, const float* v, int32_t fd) {
+  uint64_t h = 14695981039346656037ULL;  // FNV-1a offset basis
+  auto mix = [&h](const void* b, size_t n) {
+    const uint8_t* q = static_cast<const uint8_t*>(b);
+    for (size_t i = 0; i < n; ++i) {
+      h ^= q[i];
+      h *= 1099511628211ULL;  // FNV prime
+    }
+  };
+  mix(&key, 8);
+  mix(v, 4 * static_cast<size_t>(fd));
+  return h;
+}
+
+inline uint64_t table_digest(NativeTable* t) {
+  int32_t fd = table_full_dim(t);
+  std::vector<float> row(fd);
+  uint64_t dg = 0;
+  for (Shard* sh : t->shards) {
+    std::lock_guard<std::mutex> g(sh->mu);  // LOCK: shard_mu
+    for (uint64_t hh = 0; hh <= sh->mask; ++hh) {
+      int32_t r = sh->slot_state[hh];
+      if (r < 0) continue;
+      sh->export_row(r, row.data());
+      dg += row_hash(sh->slot_keys[hh], row.data(), fd);
+    }
+  }
+  return dg;
 }
 
 }  // namespace pstpu

@@ -470,6 +470,33 @@ int64_t sst_size(void* h) {
   return s3[0] + s3[1];
 }
 
+// Order-independent content digest over BOTH tiers (pstpu::row_hash,
+// wrapping-add combine) — the tier invariant (a key is live in at most
+// one tier) makes the sum well-defined, and the per-row bytes match the
+// RAM engine's export layout, so a RAM replica and an SSD replica of
+// the same logical table digest identically.
+uint64_t sst_digest(void* h) {
+  SsdTable* t = static_cast<SsdTable*>(h);
+  uint64_t dg = pstpu::table_digest(t->mem);  // hot tier (takes shard_mu)
+  int32_t fd = t->fdim;
+  for (DiskShard* d : t->disk) {
+    std::lock_guard<std::mutex> g(d->mu);  // LOCK: disk_mu
+    std::vector<std::pair<uint64_t, int64_t>> entries;
+    entries.reserve(d->index.used);
+    d->index.for_each([&](uint64_t k, int64_t ord) {
+      entries.push_back({k, ord});
+    });
+    std::vector<float> v(fd);
+    for (auto& [key, ord] : entries) {
+      uint64_t k;
+      uint32_t flag;
+      if (!read_record(t, d, ord, &k, &flag, v.data()) || !flag) continue;
+      dg += pstpu::row_hash(key, v.data(), fd);
+    }
+  }
+  return dg;
+}
+
 // Pull (select layout) with disk fallback + promotion; insert-on-miss
 // into RAM when create != 0.
 void sst_pull(void* h, const uint64_t* keys, const int32_t* slots, int64_t n,

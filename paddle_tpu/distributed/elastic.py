@@ -25,7 +25,7 @@ import urllib.parse
 from typing import Callable, Dict, List, Optional
 
 __all__ = ["ElasticStatus", "ElasticManager", "MemoryStore", "FileStore",
-           "TcpElasticStore", "store_from_spec"]
+           "TcpElasticStore", "store_from_spec", "Lease"]
 
 
 class ElasticStatus(enum.Enum):   # manager.py:53
@@ -144,6 +144,58 @@ class TcpElasticStore:
 
     def close(self) -> None:
         self.store.close()
+
+
+class Lease:
+    """One TTL'd liveness key over any elastic store — the building
+    block the ElasticManager heartbeat and the PS HA failure detector
+    (ps/ha.py) share. ``start()`` refreshes the key from a daemon
+    thread every ``interval``; a holder that dies stops refreshing and
+    the key expires after ``ttl`` on the STORE's clock (TcpElasticStore
+    gives the etcd-lease single-clock property). ``release()`` deletes
+    the key immediately (graceful deregistration); plain ``stop()``
+    leaves it to expire (how a crash looks to watchers)."""
+
+    def __init__(self, store, key: str, value: str = "", ttl: float = 1.0,
+                 interval: Optional[float] = None) -> None:
+        self.store = store
+        self.key = key
+        self.value = value
+        self.ttl = ttl
+        self.interval = interval if interval is not None else ttl / 3.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def refresh(self, value: Optional[str] = None) -> None:
+        if value is not None:
+            self.value = value
+        self.store.put(self.key, self.value, ttl=self.ttl)
+
+    def start(self) -> "Lease":
+        self.refresh()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"lease:{self.key}")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.refresh()
+
+    def stop(self) -> None:
+        """Stop refreshing; the key expires by TTL (crash semantics)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval)
+
+    def release(self) -> None:
+        """Graceful deregistration: stop AND delete the key now."""
+        self.stop()
+        self.store.delete(self.key)
+
+    @staticmethod
+    def alive(store, key: str) -> bool:
+        return store.get(key) is not None
 
 
 def store_from_spec(spec: str):

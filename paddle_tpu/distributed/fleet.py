@@ -383,6 +383,7 @@ class Fleet:
             import time as _time
 
             deadline = _time.perf_counter() + 60.0
+            wait = 0.05
             while True:
                 try:
                     store = TCPStore(host=host, port=int(port))
@@ -390,7 +391,8 @@ class Fleet:
                 except OSError:
                     if _time.perf_counter() > deadline:
                         raise
-                    _time.sleep(0.2)
+                    _time.sleep(wait)
+                    wait = min(wait * 2, 2.0)  # don't herd a slow master
         self.util._bind(store, rank, world)
 
     def stop_worker(self) -> None:
@@ -414,13 +416,15 @@ class Fleet:
         _rpc_lib()  # lib problems are permanent — fail fast, don't retry
         eps = self._role_maker.get_pserver_endpoints()
         deadline = time.monotonic() + timeout
+        wait = 0.05
         while True:
             try:
                 return RpcPsClient(eps)
             except PreconditionNotMetError:
                 if time.monotonic() >= deadline:
                     raise
-                time.sleep(0.2)
+                time.sleep(wait)
+                wait = min(wait * 2, 2.0)  # every trainer retries this
 
     # -- save/load ---------------------------------------------------------
 

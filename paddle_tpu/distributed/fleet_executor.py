@@ -224,6 +224,7 @@ class RemoteMessageBus(MessageBus):
         if register_timeout is None:
             register_timeout = self._register_grace
         deadline = time.monotonic() + register_timeout
+        wait = 0.002
         while True:
             try:
                 MessageBus.send(self, msg)
@@ -240,7 +241,8 @@ class RemoteMessageBus(MessageBus):
                     logger.error(err)
                     self.last_error = err
                     return False
-                time.sleep(0.01)
+                time.sleep(wait)
+                wait = min(wait * 2, 0.05)  # registration races resolve fast
 
     @staticmethod
     def _recv_exact(conn: socket.socket, n: int) -> Optional[bytes]:
@@ -263,6 +265,7 @@ class RemoteMessageBus(MessageBus):
             return sock
         host, port = self._addrs[rank]
         deadline = time.monotonic() + self._connect_timeout
+        wait = 0.02
         while True:  # the peer's listener may not be up yet
             try:
                 sock = socket.create_connection((host, port), timeout=5.0)
@@ -270,7 +273,8 @@ class RemoteMessageBus(MessageBus):
             except OSError:
                 if self._closing or time.monotonic() > deadline:
                     raise
-                time.sleep(0.05)
+                time.sleep(wait)
+                wait = min(wait * 2, 1.0)  # all ranks dial rank 0 at once
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         with self._peer_lock:
             existing = self._peers.get(rank)
@@ -333,7 +337,7 @@ class RemoteMessageBus(MessageBus):
                 except OSError:
                     if attempt == 2:
                         raise
-                    time.sleep(0.1)
+                    time.sleep(0.1 * 2 ** attempt)
         except OSError:
             pass  # peer down: best-effort by contract
 

@@ -2,6 +2,7 @@
 HBM embedding cache (SURVEY §2.2/2.3, Appendix A)."""
 
 from .config import PsJobConfig, load_ps_config
+from .faultpoints import arm_faultpoint, disarm_faultpoints, faultpoint
 from .graph_table import GraphTable
 from .accessor import AccessorConfig, CtrCommonAccessor, SparseAccessor, make_accessor
 from .embedding_cache import CacheConfig, HbmEmbeddingCache, cache_pull, cache_push
@@ -21,6 +22,9 @@ from .table import (
 __all__ = [
     "PsJobConfig",
     "load_ps_config",
+    "arm_faultpoint",
+    "disarm_faultpoints",
+    "faultpoint",
     "GraphTable",
     "AccessorConfig",
     "CtrCommonAccessor",

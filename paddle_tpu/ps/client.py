@@ -104,6 +104,12 @@ class PSClient:
     def shrink(self, table_id: int) -> int:
         raise NotImplementedError
 
+    def digest(self, table_id: int):
+        """Order-independent content digest(s) of a sparse table — the
+        HA replica-consistency probe (ps/ha.py; kDigest on the rpc
+        transport, table.digest locally)."""
+        raise NotImplementedError
+
 
 class LocalPsClient(PSClient):
     def __init__(self, server: PsServerHandle) -> None:
@@ -159,3 +165,6 @@ class LocalPsClient(PSClient):
 
     def shrink(self, table_id):
         return self._sparse(table_id).shrink()
+
+    def digest(self, table_id):
+        return self._sparse(table_id).digest()

@@ -72,6 +72,13 @@ class _BaseCommunicator:
         self._thread: Optional[threading.Thread] = None
         self._drained = threading.Event()
         self._drained.set()
+        # a push that dies on the background thread must not vanish: the
+        # error is stored and re-raised at the next barrier()/stop() —
+        # otherwise the queue never drains and the trainer "finishes"
+        # with silently lost gradients (the HA failover tests kill
+        # servers mid-queue exactly to exercise this)
+        self._error: Optional[BaseException] = None
+        self._push_thread_dead = False  # sticky: _error is consumed once
         # double-buffered pull prefetch (pull_sparse_async): the train
         # loop overlaps batch N+1's pull with batch N's compute; barrier
         # must drain these too (a HalfAsync join means "no PS traffic
@@ -96,16 +103,34 @@ class _BaseCommunicator:
         ``result()`` is the pulled values. The pull observes whatever
         pushes have ALREADY drained to the PS — stale by up to the queue
         depth, the async-PS contract. ``barrier()`` waits for in-flight
-        pulls as well as queued sends."""
+        pulls as well as queued sends.
+
+        Failover replay: an in-flight prefetch pull that dies on a
+        transport failure re-resolves the HA routing table
+        (``client.refresh_routing``, ps/ha.py) and replays ONCE against
+        the promoted backup before surfacing the error — the train loop
+        consuming the future never learns its primary died mid-pull."""
         with self._pull_mu:
             if self._pull_pool is None:
                 self._pull_pool = ThreadPoolExecutor(
                     max_workers=2, thread_name_prefix="communicator-pull")
-            fut = self._pull_pool.submit(self.client.pull_sparse, table_id,
+            fut = self._pull_pool.submit(self._pull_with_replay, table_id,
                                          keys, create)
             self._inflight_pulls.add(fut)
         fut.add_done_callback(self._pull_done)
         return fut
+
+    def _pull_with_replay(self, table_id: int, keys: np.ndarray,
+                          create: bool):
+        try:
+            return self.client.pull_sparse(table_id, keys, create)
+        except Exception:
+            # the client's own _shard_op failover may have timed out
+            # mid-promotion; one refresh-and-replay covers the window
+            refresh = getattr(self.client, "refresh_routing", None)
+            if refresh is None or not refresh():
+                raise
+            return self.client.pull_sparse(table_id, keys, create)
 
     def _pull_done(self, fut) -> None:
         with self._pull_mu:
@@ -142,8 +167,10 @@ class _BaseCommunicator:
         self._running = False
         if self._thread is not None:
             self._thread.join(timeout=10)
-        self._drain_all()
+        if not self._push_thread_dead:
+            self._drain_all()
         self._shutdown_pull_pool()
+        self.check_error()
 
     def _shutdown_pull_pool(self) -> None:
         self._drain_pulls()
@@ -152,13 +179,32 @@ class _BaseCommunicator:
         if pool is not None:
             pool.shutdown(wait=True)
 
+    def check_error(self) -> None:
+        """Re-raise a background push failure. The original exception
+        surfaces once; AFTER that the communicator stays failed — a dead
+        push thread can never drain the queues, so any later join with
+        queued work raises again instead of spinning forever."""
+        err, self._error = self._error, None
+        if err is not None:
+            raise err
+        if self._push_thread_dead and not self._all_empty():
+            from ..core.enforce import PreconditionNotMetError
+
+            raise PreconditionNotMetError(
+                "communicator push thread died earlier; queued gradients "
+                "remain undrained — restart the communicator")
+
     def barrier(self) -> None:
         """Block until queued sends hit the PS AND in-flight prefetch
-        pulls complete (HalfAsync/Sync join)."""
+        pulls complete (HalfAsync/Sync join). Raises a failure the
+        background push thread hit (nothing may be silently lost)."""
         while not self._all_empty():
+            if self._push_thread_dead:
+                break  # the push thread is dead; don't spin forever
             time.sleep(0.001)
         self._drained.wait(timeout=10)
         self._drain_pulls()
+        self.check_error()
 
     def _all_empty(self) -> bool:
         return all(q.empty() for q in self._queues.values())
@@ -167,8 +213,14 @@ class _BaseCommunicator:
 
     def _main_loop(self) -> None:
         while self._running:
-            if not self._drain_once():
-                time.sleep(0.002)
+            try:
+                if not self._drain_once():
+                    time.sleep(0.002)
+            except BaseException as e:  # noqa: BLE001 — surfaced at barrier
+                self._error = e
+                self._push_thread_dead = True
+                self._drained.set()  # nothing more will drain
+                return
 
     def _drain_once(self) -> bool:
         did_work = False

@@ -1,0 +1,178 @@
+"""Deterministic fault injection for the PS stack (the chaos harness).
+
+Every failover path in ps/ha.py exists because something dies at the
+worst moment; this registry makes those moments *schedulable* so tests
+exercise them deterministically instead of hoping production does.
+Instrumented sites call :func:`faultpoint` with a site name (threaded
+through ``ps/rpc.py``; the C++ server has its own mirror, armed via
+``NativePsServer.arm_fault`` → ``pss_arm_fault`` in
+``csrc/ps_service.cc``). A site is inert — one dict probe — until a
+test or operator arms it with :func:`arm_faultpoint` or the
+``FLAGS_ps_faultpoints`` flag/env.
+
+Actions (the ISSUE 4 vocabulary):
+
+- ``delay-ms``   — sleep ``ms`` at the site (latency injection).
+- ``drop-frame`` — raise a transport error as if the frame vanished.
+- ``close-socket`` — invoke the site's ``close`` context callable (the
+  connection drops mid-protocol), then raise the transport error.
+- ``kill-shard`` — invoke the site's ``kill`` context callable (the
+  hosting server stops, like a SIGKILL'd shard host).
+- ``corrupt-epoch`` — return the spec so the site substitutes
+  ``spec.param`` for the real epoch (stale-primary fencing tests).
+
+Scheduling: a spec fires once ``after`` matching hits have been seen
+(default 1 = first hit), then every ``every`` further hits (0 = only
+the threshold hit), at most ``count`` times total (0 = unlimited).
+``cmd`` restricts matching to one wire command id (None = any).
+
+Flag format (``FLAGS_ps_faultpoints``):
+``site=action[:k=v]*[;site=action...]`` — e.g.
+``rpc.send=delay-ms:ms=20`` or ``rpc.send=drop-frame:after=100``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+from ..core.enforce import PsTransportError
+from ..core.flags import flag
+
+__all__ = ["FaultSpec", "faultpoint", "arm_faultpoint", "disarm_faultpoints",
+           "armed_faultpoints", "FaultInjected"]
+
+# FLAGS_ps_faultpoints itself is defined in core/flags.py (it is read
+# from both the transport sites and the HA harness)
+
+_ACTIONS = frozenset({"delay-ms", "drop-frame", "close-socket", "kill-shard",
+                      "corrupt-epoch"})
+
+
+class FaultInjected(PsTransportError):
+    """Transport-shaped error raised by drop-frame/close-socket faults —
+    a subclass of the real transport error so every retry/failover path
+    treats it exactly like the failure it simulates."""
+
+
+@dataclass
+class FaultSpec:
+    name: str
+    action: str
+    cmd: Optional[int] = None   # restrict to one wire command (None = any)
+    after: int = 1              # fire once this many matching hits seen
+    every: int = 0              # then every k further hits (0 = just once)
+    count: int = 0              # max fires (0 = unlimited)
+    ms: int = 0                 # delay-ms duration
+    param: int = 0              # corrupt-epoch substitute value
+    seen: int = field(default=0, repr=False)
+    fired: int = field(default=0, repr=False)
+
+    def _should_fire(self) -> bool:
+        if self.count and self.fired >= self.count:
+            return False
+        if self.seen < self.after:
+            return False
+        if self.seen == self.after:
+            return True
+        return self.every > 0 and (self.seen - self.after) % self.every == 0
+
+
+_mu = threading.Lock()
+_armed: Dict[str, FaultSpec] = {}
+_flag_loaded = False
+
+
+def _load_flag_specs() -> None:
+    global _flag_loaded
+    _flag_loaded = True
+    raw = str(flag("ps_faultpoints")).strip()
+    if not raw:
+        return
+    for part in raw.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        site, _, rhs = part.partition("=")
+        bits = rhs.split(":")
+        kw: Dict[str, int] = {}
+        for b in bits[1:]:
+            k, _, v = b.partition("=")
+            kw[k.strip()] = int(v)
+        arm_faultpoint(site.strip(), bits[0].strip(), **kw)
+
+
+def arm_faultpoint(name: str, action: str, cmd: Optional[int] = None,
+                   after: int = 1, every: int = 0, count: int = 0,
+                   ms: int = 0, param: int = 0) -> FaultSpec:
+    """Arm ``action`` at site ``name``; returns the live spec (tests can
+    read ``.fired``). One spec per site — re-arming replaces it."""
+    if action not in _ACTIONS:
+        raise ValueError(f"unknown faultpoint action {action!r} "
+                         f"(have {sorted(_ACTIONS)})")
+    spec = FaultSpec(name=name, action=action, cmd=cmd, after=after,
+                     every=every, count=count, ms=ms, param=param)
+    with _mu:
+        _armed[name] = spec
+    return spec
+
+
+def disarm_faultpoints(name: Optional[str] = None) -> None:
+    """Disarm one site, or every site when ``name`` is None (test
+    teardown — chaos must never leak into the next test)."""
+    with _mu:
+        if name is None:
+            _armed.clear()
+        else:
+            _armed.pop(name, None)
+
+
+def armed_faultpoints() -> Dict[str, FaultSpec]:
+    with _mu:
+        return dict(_armed)
+
+
+def faultpoint(name: str, cmd: Optional[int] = None,
+               **ctx: Any) -> Optional[FaultSpec]:
+    """Instrumentation site: no-op (one dict probe) unless ``name`` is
+    armed and the schedule fires. Generic actions run here; sites pass
+    ``close=``/``kill=`` callables for the socket/server-scoped ones.
+    Returns the spec when the action is advisory (corrupt-epoch) so the
+    site applies it; None otherwise."""
+    if not _armed:
+        if _flag_loaded:
+            return None
+        # load OUTSIDE _mu: _load_flag_specs arms via arm_faultpoint,
+        # which takes _mu itself (a racing double-load just re-arms the
+        # same specs — idempotent)
+        _load_flag_specs()
+        if not _armed:
+            return None
+    with _mu:
+        spec = _armed.get(name)
+        if spec is None or (spec.cmd is not None and cmd is not None
+                            and spec.cmd != cmd):
+            return None
+        spec.seen += 1
+        if not spec._should_fire():
+            return None
+        spec.fired += 1
+        action = spec.action
+    if action == "delay-ms":
+        time.sleep(spec.ms / 1000.0)
+        return None
+    if action == "drop-frame":
+        raise FaultInjected(f"faultpoint {name}: frame dropped")
+    if action == "close-socket":
+        close = ctx.get("close")
+        if callable(close):
+            close()
+        raise FaultInjected(f"faultpoint {name}: socket closed mid-call")
+    if action == "kill-shard":
+        kill = ctx.get("kill")
+        if callable(kill):
+            kill()
+        return spec
+    return spec  # corrupt-epoch: the site applies spec.param

@@ -1,0 +1,991 @@
+"""PS high availability: shard replication, failure detection, failover.
+
+Without this subsystem a single dead PS shard loses its slice of the
+feature table and kills the job — PR 2's transport retry/backoff
+(``FLAGS_pserver_*``) survives transient faults only. Here fault
+tolerance is first-class (the tier Parallax-style PS architectures put
+it at, cf. PAPERS.md):
+
+- **Replication** — each table shard runs R replicas. The primary taps
+  every mutating request frame into a sequence-numbered oplog ring
+  (``csrc/ps_service.cc`` ``log_op``); a :class:`ReplicationManager`
+  shipper thread forwards entries to the backups as ``kReplicate``
+  frames (bounded lag = the ring), with a full-snapshot sync (pause →
+  catalog replay → kSaveAll/kInsertFull + dense snapshot → seq rebase →
+  resume) for late joiners and ring overflows. ``sync=True`` adds a
+  :meth:`ReplicationManager.drain` barrier so primary ≡ backup is
+  checkable bit-identically (``kDigest``) at quiet points.
+- **Failure detection** — every replica heartbeats a TTL'd
+  :class:`~paddle_tpu.distributed.elastic.Lease` into the elastic store
+  (MemoryStore / FileStore / TcpElasticStore — the same backends the
+  elastic manager uses); the client wraps each endpoint in a
+  :class:`CircuitBreaker` (N consecutive transport failures open it, a
+  cooldown probe half-opens, one success closes).
+- **Failover** — a :class:`FailoverCoordinator` watches the leases:
+  when a primary's lease expires past the grace window and a live
+  backup exists, it bumps the routing epoch, FENCES the promoted server
+  first (``kEpoch`` set — the demoted primary's replication stream now
+  bounces with ``kErrStaleEpoch``), then publishes the epoch-stamped
+  routing table. ``RpcPsClient._shard_op`` consults an :class:`HARouter`
+  on transport failure and replays the op against the promoted backup;
+  in-flight ``pull_sparse_async`` prefetch pulls ride the same path. A
+  restarted server rejoins as a backup via catalog replay + snapshot +
+  oplog tail catch-up (the coordinator re-adds any alive replica-set
+  member to the routing table; the primary's shipper attaches it).
+- **Chaos** — every path above is exercised deterministically through
+  the :mod:`~paddle_tpu.ps.faultpoints` registry (client sites) and
+  ``NativePsServer.arm_fault`` (server sites: kill-shard / drop-frame /
+  close-socket / delay-ms counted per command). ``tools/chaos_ps.py``
+  measures recovery time and steady-state replication overhead.
+
+Ordering caveat (documented at the csrc tap): the oplog records
+mutations in the order the server's serialized tap admits them, which
+with MULTIPLE client connections can differ from the engines' internal
+apply order for racing same-key pushes — async replication tolerates
+the bounded divergence; the sync-mode bit-identical guarantee assumes
+serialized pushes (one trainer connection per server, which is how the
+client transport works). SSD-backed tables replicate ops once both
+replicas are created with their own ``ssd_path``; catalog replay to a
+REJOINING backup re-uses the create frame's path and is therefore
+RAM-table-only (the runbook's restore flow covers SSD).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.enforce import (PreconditionNotMetError, PsTransportError,
+                            enforce)
+from ..core.flags import define_flag, flag
+from ..distributed.elastic import Lease, MemoryStore
+from . import rpc as _rpc
+from .faultpoints import (FaultInjected, arm_faultpoint, disarm_faultpoints,
+                          faultpoint)
+from .rpc import NativePsServer, RpcPsClient, make_conn, send_replicate
+
+__all__ = [
+    "CircuitBreaker",
+    "RoutingTable",
+    "HARouter",
+    "ReplicationManager",
+    "HAServer",
+    "FailoverCoordinator",
+    "HACluster",
+    "drain_remote",
+    "faultpoint",
+    "arm_faultpoint",
+    "disarm_faultpoints",
+    "FaultInjected",
+]
+
+define_flag("ps_replication_factor", 2,
+            "replicas per PS shard (1 = replication off; ha.HACluster "
+            "default topology)")
+define_flag("ps_ha_oplog_cap", 1 << 16,
+            "oplog ring entries a primary buffers per shard — the "
+            "bounded replication lag; overflow drops the oldest entry "
+            "and the shipper falls back to a full snapshot sync")
+define_flag("ps_ha_heartbeat_ms", 200,
+            "PS shard heartbeat refresh interval")
+define_flag("ps_ha_lease_ttl_ms", 1000,
+            "PS shard lease TTL — a dead shard is detectable after at "
+            "most ttl + failover grace")
+define_flag("ps_ha_failover_grace_ms", 300,
+            "extra wait after a lease expires before promoting (rides "
+            "out store blips without flapping)")
+define_flag("ps_breaker_failures", 3,
+            "consecutive transport failures before a client opens an "
+            "endpoint's circuit breaker (fail fast instead of paying "
+            "timeout*retries per call)")
+define_flag("ps_breaker_cooldown_ms", 3000,
+            "open-breaker cooldown before one half-open probe")
+define_flag("ps_ha_failover_timeout_ms", 10000,
+            "how long a failed client call waits for the coordinator "
+            "to publish a promoted replacement before giving up")
+
+_HDR = struct.Struct("<QIIqi")  # ReqHeader: payload_len cmd table_id n aux
+
+
+def _route_key(job_id: str) -> str:
+    return f"ps/{job_id}/route"
+
+
+def _hb_key(job_id: str, endpoint: str) -> str:
+    return f"ps/{job_id}/hb/{endpoint}"
+
+
+def _hb_prefix(job_id: str) -> str:
+    return f"ps/{job_id}/hb/"
+
+
+# ---------------------------------------------------------------------------
+# client-side failure detection
+# ---------------------------------------------------------------------------
+
+class CircuitBreaker:
+    """Per-endpoint breaker: CLOSED → (N consecutive failures) → OPEN →
+    (cooldown) → HALF_OPEN (exactly one probe) → CLOSED on success /
+    back to OPEN on failure. ``clock`` is injectable for tests."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, failures: Optional[int] = None,
+                 cooldown_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.failures = (failures if failures is not None
+                         else int(flag("ps_breaker_failures")))
+        self.cooldown_s = (cooldown_s if cooldown_s is not None
+                           else int(flag("ps_breaker_cooldown_ms")) / 1000.0)
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    @property
+    def state(self) -> str:
+        with self._mu:
+            return self._state
+
+    def allow(self) -> bool:
+        """May a call be attempted now? OPEN fails fast; after the
+        cooldown exactly ONE caller gets the half-open probe."""
+        with self._mu:
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN:
+                if self._clock() - self._opened_at < self.cooldown_s:
+                    return False
+                self._state = self.HALF_OPEN
+                self._probing = True
+                return True
+            # HALF_OPEN: only the probe owner is in flight
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+
+    def record(self, ok: bool) -> None:
+        with self._mu:
+            if ok:
+                self._state = self.CLOSED
+                self._consecutive = 0
+                self._probing = False
+                return
+            self._consecutive += 1
+            self._probing = False
+            if self._state == self.HALF_OPEN or \
+                    self._consecutive >= self.failures:
+                self._state = self.OPEN
+                self._opened_at = self._clock()
+
+
+class RoutingTable:
+    """The epoch-stamped routing document in the elastic store:
+    ``{"epoch": E, "shards": [{"primary": ep, "backups": [...],
+    "replicas": [...]}, ...]}``. The coordinator is the only writer;
+    epochs only move forward."""
+
+    def __init__(self, store, job_id: str) -> None:
+        self.store = store
+        self.key = _route_key(job_id)
+
+    def publish(self, epoch: int, shards: List[dict]) -> None:
+        self.store.put(self.key, json.dumps(
+            {"epoch": int(epoch), "shards": shards}))
+
+    def read(self) -> Tuple[int, List[dict]]:
+        raw = self.store.get(self.key)
+        if raw is None:
+            return 0, []
+        doc = json.loads(raw)
+        return int(doc.get("epoch", 0)), list(doc.get("shards", []))
+
+    def primaries(self) -> List[str]:
+        _, shards = self.read()
+        return [sh["primary"] for sh in shards]
+
+
+class HARouter:
+    """The client's view of the HA control plane: resolves the routing
+    table, breaker-gates endpoints, and answers ``failover()`` — "my
+    call to this primary died; who replaced it?" — by polling the store
+    (with backoff) until the coordinator publishes a different primary
+    for the shard or the failover timeout passes. Plugs into
+    ``RpcPsClient(endpoints, router=...)``."""
+
+    def __init__(self, store, job_id: str,
+                 failures: Optional[int] = None,
+                 cooldown_s: Optional[float] = None,
+                 failover_timeout_s: Optional[float] = None,
+                 poll_s: float = 0.02) -> None:
+        self.routing_table = RoutingTable(store, job_id)
+        self._failures = failures
+        self._cooldown_s = cooldown_s
+        self.failover_timeout_s = (
+            failover_timeout_s if failover_timeout_s is not None
+            else int(flag("ps_ha_failover_timeout_ms")) / 1000.0)
+        self.poll_s = poll_s
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._mu = threading.Lock()
+
+    def breaker(self, endpoint: str) -> CircuitBreaker:
+        with self._mu:
+            b = self._breakers.get(endpoint)
+            if b is None:
+                b = self._breakers[endpoint] = CircuitBreaker(
+                    self._failures, self._cooldown_s)
+            return b
+
+    # -- RpcPsClient protocol ---------------------------------------------
+
+    def routing(self) -> Tuple[int, List[str]]:
+        epoch, shards = self.routing_table.read()
+        return epoch, [sh["primary"] for sh in shards]
+
+    def allow(self, endpoint: str) -> bool:
+        return self.breaker(endpoint).allow()
+
+    def record(self, endpoint: str, ok: bool) -> None:
+        self.breaker(endpoint).record(ok)
+
+    def failover(self, shard: int, bad_endpoint: str) -> Optional[str]:
+        """Block until the routing table names a primary for ``shard``
+        other than ``bad_endpoint`` (the coordinator needs lease-expiry
+        + grace to notice the death); None when the timeout passes with
+        no promotion — the caller re-raises its transport error."""
+        deadline = time.monotonic() + self.failover_timeout_s
+        wait = self.poll_s
+        while True:
+            _, eps = self.routing()
+            ep = eps[shard] if shard < len(eps) else None
+            if ep and ep != bad_endpoint:
+                return ep
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(wait)
+            wait = min(wait * 2, 0.25)  # backoff: the store is shared
+
+
+# ---------------------------------------------------------------------------
+# replication (primary side)
+# ---------------------------------------------------------------------------
+
+class ReplicationManager:
+    """The primary's oplog shipper. One daemon thread pops entries from
+    the server's ring (``pss_oplog_next``) and forwards each to every
+    attached backup as a ``kReplicate`` frame stamped with the current
+    routing epoch. Late joiners and ring overflows take the snapshot
+    path: pause mutations → replay the create catalog → stream every
+    sparse table (kSaveAll → chunked kInsertFull) and dense table
+    (kDenseSnap → kDenseRestore) → rebase the backup's applied_seq to
+    the cut → resume; the tail then ships from the ring. A backup that
+    answers ``kErrStaleEpoch`` means WE are fenced (demoted): shipping
+    stops and ``fenced`` is set."""
+
+    _SNAP_CHUNK = 1 << 16  # rows per kInsertFull frame during snapshot
+
+    def __init__(self, server: NativePsServer, endpoint: str, shard: int,
+                 routing: RoutingTable, sync: bool = False,
+                 oplog_cap: Optional[int] = None, epoch: int = 0) -> None:
+        self.server = server
+        self.endpoint = endpoint
+        self.shard = shard
+        self.routing = routing
+        self.sync = sync
+        self.epoch = int(epoch)
+        self.fenced = False
+        self._cap = (oplog_cap if oplog_cap is not None
+                     else int(flag("ps_ha_oplog_cap")))
+        self._backups: Dict[str, dict] = {}  # ep -> {conn, acked}
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._self_conn = None
+        self._last_route_poll = 0.0
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "ReplicationManager":
+        self.server.set_replication(True, self._cap)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"ps-repl:{self.shard}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        with self._mu:
+            for st in self._backups.values():
+                st["conn"].close()
+            self._backups.clear()
+        if self._self_conn is not None:
+            self._self_conn.close()
+            self._self_conn = None
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
+
+    # -- observability ----------------------------------------------------
+
+    def lag(self) -> dict:
+        seq = self.server.oplog_seq()
+        with self._mu:
+            acked = {ep: st["acked"] for ep, st in self._backups.items()}
+        return {"seq": seq, "pending": self.server.oplog_pending(),
+                "dropped": self.server.oplog_dropped(), "acked": acked}
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Sync-replication barrier: block until every attached backup
+        has acked the newest oplog seq (primary ≡ backup for every op
+        that happened before the call)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            lg = self.lag()
+            if not self.fenced and lg["pending"] == 0 and all(
+                    a >= lg["seq"] for a in lg["acked"].values()):
+                return
+            enforce(time.monotonic() < deadline,
+                    f"replication drain timed out: {lg}")
+            time.sleep(0.005)
+
+    # -- shipper ----------------------------------------------------------
+
+    def _poll_routing(self) -> None:
+        now = time.monotonic()
+        if now - self._last_route_poll < 0.1:
+            return
+        self._last_route_poll = now
+        epoch, shards = self.routing.read()
+        if not shards or self.shard >= len(shards):
+            return
+        self.epoch = max(self.epoch, epoch)
+        sh = shards[self.shard]
+        if sh["primary"] != self.endpoint:
+            return  # demoted; HAServer will stop us
+        want = [ep for ep in sh.get("backups", []) if ep != self.endpoint]
+        with self._mu:
+            have = set(self._backups)
+        for ep in want:
+            if ep not in have:
+                self._attach(ep)
+        for ep in have - set(want):
+            with self._mu:
+                st = self._backups.pop(ep, None)
+            if st is not None:
+                st["conn"].close()
+
+    def _attach(self, ep: str) -> None:
+        """Adopt ``ep`` as a backup: read its applied_seq AND epoch and
+        let the gap logic decide between ring tail and full snapshot."""
+        try:
+            conn = make_conn(ep)
+            _, resp = conn.check(_rpc._REPL_STATE, n=-1, retries=0)
+            st = np.frombuffer(resp, np.int64)
+            applied, remote_epoch = int(st[0]), int(st[1])
+        except PreconditionNotMetError:
+            return  # not reachable yet; next routing poll retries
+        if remote_epoch > self.epoch:
+            # the "backup" outranks us: WE are a demoted primary working
+            # off a stale routing read — fence NOW instead of shipping
+            # entries that will bounce one by one
+            conn.close()
+            self.fenced = True
+            return
+        if applied > self.server.oplog_seq():
+            # the cursor was numbered by a DIFFERENT primary's oplog
+            # (promotion chains renumber from each server's own ring) —
+            # comparing it against OUR seqs would silently skip every
+            # ship; force the snapshot path, which rebases it into our
+            # seq space
+            applied = -1
+        with self._mu:
+            self._backups[ep] = {"conn": conn, "acked": applied}
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._poll_routing()
+            if self.fenced:
+                return
+            seq, frame = self.server.oplog_next(timeout_ms=50)
+            if seq == -2:
+                return  # server stopped
+            if seq == -1:
+                # idle: a backup that attached AFTER its entries were
+                # popped (rejoin racing the tail) would otherwise wait
+                # for the next push forever — snapshot it now
+                self._catch_up_idle()
+                continue
+            self._ship(seq, frame)
+
+    def _catch_up_idle(self) -> None:
+        if self.server.oplog_pending() != 0:
+            return  # the ring tail will cover the lag — no snapshot
+        top = self.server.oplog_seq()
+        with self._mu:
+            lagging = [(ep, st) for ep, st in self._backups.items()
+                       if st["acked"] < top]
+        for ep, st in lagging:
+            self._full_sync(ep, st)
+
+    def _ship(self, seq: int, frame: bytes) -> None:
+        with self._mu:
+            backups = list(self._backups.items())
+        for ep, st in backups:
+            if st["acked"] >= seq:
+                continue  # snapshot rebase already covers this entry
+            if st["acked"] + 1 != seq:
+                # ring dropped entries before this backup consumed them
+                # (overflow or late attach): full snapshot, then the
+                # rebase makes this frame redundant
+                self._full_sync(ep, st)
+                continue
+            try:
+                status = send_replicate(st["conn"], frame, seq, self.epoch,
+                                        retries=0)
+            except PsTransportError:
+                self._drop_backup(ep)  # dead backup; rejoin re-attaches
+                continue
+            if status == seq:
+                st["acked"] = seq
+            elif status == _rpc_err_seq_gap:
+                self._full_sync(ep, st)
+            elif status == _rpc_err_stale_epoch:
+                # the backup outranks us — we are the demoted primary
+                self.fenced = True
+                return
+            else:
+                self._drop_backup(ep)
+
+    def _drop_backup(self, ep: str) -> None:
+        with self._mu:
+            st = self._backups.pop(ep, None)
+        if st is not None:
+            st["conn"].close()
+
+    # -- snapshot sync ----------------------------------------------------
+
+    def _catalog_tables(self) -> Tuple[List[int], List[int], List[int]]:
+        sparse, dense, geo = [], [], []
+        for frame in self.server.catalog():
+            _, cmd, tid, _, _ = _HDR.unpack_from(frame, 0)
+            if cmd == _rpc._CREATE_SPARSE and tid not in sparse:
+                sparse.append(tid)
+            elif cmd == _rpc._CREATE_DENSE and tid not in dense:
+                dense.append(tid)
+            elif cmd == _rpc._CREATE_GEO and tid not in geo:
+                geo.append(tid)
+        return sparse, dense, geo
+
+    def _self(self):
+        if self._self_conn is None:
+            self._self_conn = make_conn(self.endpoint)
+        return self._self_conn
+
+    def _full_sync(self, ep: str, st: dict) -> None:
+        """Snapshot+rebase one backup. Mutations pause for the duration
+        (writers block within their IO deadline — the cut is consistent
+        and the tail replays exactly once). Covers sparse tables (full
+        rows), dense tables (values + optimizer moments + step) and the
+        global step counter; GEO accumulators are deliberately NOT
+        snapshotted — reading them drains them (kPullGeo), and losing
+        at most one un-pulled delta round on a rejoin is within
+        GEO-SGD's staleness contract (live geo pushes DO replicate)."""
+        conn = st["conn"]
+        self.server.pause_mutations(True)
+        try:
+            # 1. catalog replay (idempotent creates, seq = -1 untracked)
+            for frame in self.server.catalog():
+                status = send_replicate(conn, frame, -1, self.epoch, retries=0)
+                if status == _rpc_err_stale_epoch:
+                    self.fenced = True
+                    return
+                enforce(status >= 0,
+                        f"catalog replay to {ep} failed with {status}")
+            cut = self.server.oplog_seq()
+            sparse, dense, _ = self._catalog_tables()
+            me = self._self()
+            # 2. sparse tables: full snapshot off ourselves, chunked into
+            # the backup (overwrites row-for-row; a FRESH backup ends
+            # bit-identical — the rejoin contract)
+            for tid in sparse:
+                cnt, resp = me.check(_rpc._SAVE_ALL, tid, aux=0,
+                                     timeout_ms=_rpc._long_ms(), retries=0)
+                if not cnt:
+                    continue
+                keys = np.frombuffer(resp[: cnt * 8], np.uint64)
+                fdim = (len(resp) - cnt * 8) // 4 // cnt
+                vals = np.frombuffer(resp[cnt * 8 :], np.float32).reshape(
+                    cnt, fdim)
+                for lo in range(0, cnt, self._SNAP_CHUNK):
+                    kp = np.ascontiguousarray(keys[lo : lo + self._SNAP_CHUNK])
+                    vp = np.ascontiguousarray(vals[lo : lo + self._SNAP_CHUNK])
+                    conn.check(_rpc._INSERT_FULL, tid, n=len(kp),
+                               payload=(kp, vp),
+                               timeout_ms=_rpc._long_ms(), retries=0)
+            # 3. dense tables: full state incl. optimizer moments + step
+            for tid in dense:
+                _, blob = me.check(_rpc._DENSE_SNAP, tid,
+                                   timeout_ms=_rpc._long_ms(), retries=0)
+                conn.check(_rpc._DENSE_RESTORE, tid, payload=bytes(blob),
+                           timeout_ms=_rpc._long_ms(), retries=0)
+            # 4. the shared step counter: top the backup's up to ours
+            cur_p, _ = me.check(_rpc._GLOBAL_STEP, n=0, retries=0)
+            cur_b, _ = conn.check(_rpc._GLOBAL_STEP, n=0, retries=0)
+            if cur_p != cur_b:
+                conn.check(_rpc._GLOBAL_STEP, n=cur_p - cur_b, retries=0)
+            # 5. rebase: the backup now holds everything up to `cut`
+            conn.check(_rpc._REPL_STATE, n=cut, retries=0)
+            st["acked"] = cut
+        except PreconditionNotMetError:
+            self._drop_backup(ep)
+        finally:
+            self.server.pause_mutations(False)
+
+
+_rpc_err_stale_epoch = -5  # ps_service.cc kErrStaleEpoch
+_rpc_err_seq_gap = -6      # ps_service.cc kErrSeqGap
+
+
+def drain_remote(primary_ep: str, backup_eps: List[str],
+                 timeout: float = 30.0) -> None:
+    """Cross-process sync-replication barrier over the WIRE (no shared
+    store, no in-process handles): poll kReplState until every backup's
+    applied_seq has caught the primary's oplog_seq and the primary's
+    ring is empty — the multiprocess analogue of
+    :meth:`ReplicationManager.drain`."""
+    conns = {ep: make_conn(ep) for ep in [primary_ep] + list(backup_eps)}
+
+    def state(ep):
+        _, resp = conns[ep].check(_rpc._REPL_STATE, n=-1, retries=0)
+        st = np.frombuffer(resp, np.int64)
+        return int(st[0]), int(st[2]), int(st[3])  # applied, oseq, pending
+
+    try:
+        deadline = time.monotonic() + timeout
+        while True:
+            _, oseq, pending = state(primary_ep)
+            if pending == 0 and all(state(ep)[0] >= oseq
+                                    for ep in backup_eps):
+                return
+            enforce(time.monotonic() < deadline,
+                    f"drain_remote({primary_ep}) timed out at seq {oseq}")
+            time.sleep(0.005)
+    finally:
+        for c in conns.values():
+            c.close()
+
+
+# ---------------------------------------------------------------------------
+# server wrapper + coordinator
+# ---------------------------------------------------------------------------
+
+class HAServer:
+    """One shard replica: a :class:`NativePsServer` plus the HA duties —
+    a heartbeat lease in the elastic store, and (while the routing table
+    names it primary) a :class:`ReplicationManager`. Roles follow the
+    routing table: a promoted backup starts shipping to the remaining
+    replicas; a demoted primary stops. ``kill()`` emulates host death
+    (server stops, lease left to EXPIRE); ``stop()`` deregisters
+    gracefully."""
+
+    def __init__(self, store, job_id: str, shard: int,
+                 host: str = "127.0.0.1", port: int = 0, n_trainers: int = 1,
+                 sync: bool = False, hb_interval: Optional[float] = None,
+                 hb_ttl: Optional[float] = None,
+                 oplog_cap: Optional[int] = None) -> None:
+        self.store = store
+        self.job_id = job_id
+        self.shard = int(shard)
+        self.sync = sync
+        self.server = NativePsServer(port=port, n_trainers=n_trainers)
+        self.endpoint = f"{host}:{self.server.port}"
+        self.routing = RoutingTable(store, job_id)
+        self._hb_interval = (hb_interval if hb_interval is not None
+                             else int(flag("ps_ha_heartbeat_ms")) / 1000.0)
+        self._hb_ttl = (hb_ttl if hb_ttl is not None
+                        else int(flag("ps_ha_lease_ttl_ms")) / 1000.0)
+        self._oplog_cap = oplog_cap
+        self.rm: Optional[ReplicationManager] = None
+        self._stop = threading.Event()
+        self._graceful = False
+        self._thread: Optional[threading.Thread] = None
+        self._lease = Lease(store, _hb_key(job_id, self.endpoint),
+                            json.dumps({"shard": self.shard}),
+                            ttl=self._hb_ttl, interval=self._hb_interval)
+
+    def start(self) -> "HAServer":
+        # record from birth: creates/pushes that land before a backup
+        # attaches replay from the ring (no snapshot needed at bring-up)
+        self.server.set_replication(True, self._oplog_cap
+                                    or int(flag("ps_ha_oplog_cap")))
+        self._lease.refresh()
+        self._thread = threading.Thread(target=self._hb_loop, daemon=True,
+                                        name=f"ps-ha:{self.endpoint}")
+        self._thread.start()
+        return self
+
+    def _hb_loop(self) -> None:
+        while not self._stop.is_set():
+            if self.server.stopped:
+                break
+            # chaos site: arm kill-shard here to schedule a death by
+            # heartbeat count (the csrc arm_fault schedules by op count)
+            faultpoint("ha.heartbeat", kill=self.kill)
+            if self.server.stopped:
+                break
+            self._lease.refresh()
+            self._sync_role()
+            self._stop.wait(self._hb_interval)
+        if self._graceful:
+            self.store.delete(self._lease.key)
+        # else: crash semantics — the lease expires on its TTL
+        if self.rm is not None:
+            self.rm.stop()
+            self.rm = None
+
+    def _sync_role(self) -> None:
+        epoch, shards = self.routing.read()
+        if not shards or self.shard >= len(shards):
+            return
+        sh = shards[self.shard]
+        if sh["primary"] == self.endpoint:
+            if self.rm is None:
+                self.rm = ReplicationManager(
+                    self.server, self.endpoint, self.shard, self.routing,
+                    sync=self.sync, oplog_cap=self._oplog_cap,
+                    epoch=max(epoch, self.server.epoch)).start()
+            else:
+                self.rm.set_epoch(max(epoch, self.server.epoch))
+        elif self.rm is not None:
+            self.rm.stop()
+            self.rm = None
+
+    def kill(self) -> None:
+        """Simulated host death NOW: the server stops mid-traffic and
+        the lease is left to expire — exactly what the failure detector
+        must notice."""
+        self._stop.set()
+        self.server.stop()
+
+    def stop(self) -> None:
+        """Graceful shutdown: deregister the lease immediately."""
+        self._graceful = True
+        self._stop.set()
+        self.server.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        if self.rm is not None:
+            self.rm.stop()
+            self.rm = None
+        self.store.delete(self._lease.key)
+
+    def close(self) -> None:
+        self.stop()
+        self.server.close()
+
+
+class FailoverCoordinator:
+    """The control loop that turns expired leases into promotions. One
+    instance per job (launcher/trainer-0 sidecar). Each scan:
+
+    - a shard whose primary lease is gone past the grace window and
+      which has a live backup → promote: FENCE the backup first
+      (``kEpoch`` = new epoch, so the demoted primary's replication
+      stream bounces), then publish the bumped routing table;
+    - an alive replica-set member absent from the routing entry (a
+      restarted server) → re-add as backup (the primary's shipper
+      attaches it with snapshot + tail).
+    """
+
+    def __init__(self, store, job_id: str, grace_s: Optional[float] = None,
+                 poll_s: float = 0.05,
+                 on_promote: Optional[Callable[[int, str, str], None]] = None
+                 ) -> None:
+        self.store = store
+        self.job_id = job_id
+        self.routing = RoutingTable(store, job_id)
+        self.grace_s = (grace_s if grace_s is not None
+                        else int(flag("ps_ha_failover_grace_ms")) / 1000.0)
+        self.poll_s = poll_s
+        self.on_promote = on_promote
+        self.promotions = 0
+        self._missing_since: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _alive(self) -> set:
+        pref = _hb_prefix(self.job_id)
+        return {k[len(pref):] for k in self.store.list_prefix(pref)}
+
+    def _is_fresh(self, ep: str) -> bool:
+        """A rejoin candidate must be a FRESH restart: no applied
+        replication history AND an empty own oplog (a stale ex-primary
+        has tapped mutations and would diverge — insert-only snapshots
+        cannot delete its phantom rows)."""
+        try:
+            conn = make_conn(ep)
+            try:
+                _, resp = conn.check(_rpc._REPL_STATE, n=-1, retries=0)
+            finally:
+                conn.close()
+        except PreconditionNotMetError:
+            return False
+        st = np.frombuffer(resp, np.int64)
+        return int(st[0]) == 0 and int(st[2]) == 0  # applied, oplog_seq
+
+    def step(self) -> int:
+        """One scan; returns promotions performed (exposed for
+        deterministic unit tests — the thread just loops this)."""
+        epoch, shards = self.routing.read()
+        if not shards:
+            return 0
+        alive = self._alive()
+        now = time.monotonic()
+        changed = False
+        promoted = 0
+        for si, sh in enumerate(shards):
+            prim = sh["primary"]
+            if prim in alive:
+                self._missing_since.pop(prim, None)
+                # rejoin: any alive replica-set member not routed yet —
+                # but only a FRESH server (empty oplog + no applied
+                # history). A recovered STALE primary holds phantom rows
+                # the snapshot (insert-only) can never delete; the
+                # runbook's contract is "restart a fresh process".
+                for ep in sh.get("replicas", []):
+                    if ep != prim and ep in alive \
+                            and ep not in sh.get("backups", []) \
+                            and self._is_fresh(ep):
+                        sh.setdefault("backups", []).append(ep)
+                        changed = True
+                continue
+            first = self._missing_since.setdefault(prim, now)
+            if now - first < self.grace_s:
+                continue
+            cands = [b for b in sh.get("backups", []) if b in alive]
+            if not cands:
+                continue  # nothing to promote — page the operator
+            new_prim = cands[0]
+            new_epoch = epoch + 1
+            try:
+                # fence BEFORE publishing: from this instant the old
+                # primary's kReplicate stream is rejected
+                conn = make_conn(new_prim)
+                conn.check(_rpc._EPOCH, n=new_epoch, retries=0)
+                conn.close()
+            except PreconditionNotMetError:
+                continue  # can't fence → don't promote this scan
+            sh["primary"] = new_prim
+            sh["backups"] = [b for b in sh["backups"] if b != new_prim]
+            epoch = new_epoch
+            changed = True
+            promoted += 1
+            self.promotions += 1
+            if self.on_promote is not None:
+                self.on_promote(si, prim, new_prim)
+        if changed:
+            self.routing.publish(epoch, shards)
+        return promoted
+
+    def start(self) -> "FailoverCoordinator":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"ps-ha-coord:{self.job_id}")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.step()
+            except PreconditionNotMetError:
+                continue  # store/endpoint blip; next scan retries
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# in-process harness
+# ---------------------------------------------------------------------------
+
+class HACluster:
+    """S shards × R replicas of in-process servers + coordinator — the
+    chaos-test/demo harness (tests/test_ps_ha.py, tools/chaos_ps.py).
+    Publishes the initial routing (epoch 0: replica 0 of each shard is
+    primary), starts heartbeats and the coordinator, and hands out
+    router-wired clients. ``sync=True`` makes :meth:`drain` a
+    bit-identical barrier (primary ≡ backups, checkable via
+    :meth:`digests`)."""
+
+    def __init__(self, num_shards: int = 2, replication: Optional[int] = None,
+                 store=None, job_id: str = "ps-ha", sync: bool = True,
+                 n_trainers: int = 1, hb_interval: float = 0.05,
+                 hb_ttl: float = 0.4, grace_s: float = 0.1,
+                 coordinator_poll_s: float = 0.05) -> None:
+        self.store = store if store is not None else MemoryStore()
+        self.job_id = job_id
+        self.num_shards = num_shards
+        self.replication = (replication if replication is not None
+                            else int(flag("ps_replication_factor")))
+        self.sync = sync
+        self.routing = RoutingTable(self.store, job_id)
+        self.servers: List[List[HAServer]] = []
+        self._n_trainers = n_trainers
+        self._hb_interval = hb_interval
+        self._hb_ttl = hb_ttl
+        shards_doc = []
+        for si in range(num_shards):
+            replicas = [HAServer(self.store, job_id, si,
+                                 n_trainers=n_trainers, sync=sync,
+                                 hb_interval=hb_interval, hb_ttl=hb_ttl)
+                        for _ in range(self.replication)]
+            self.servers.append(replicas)
+            eps = [r.endpoint for r in replicas]
+            shards_doc.append({"primary": eps[0], "backups": eps[1:],
+                               "replicas": eps})
+        self.routing.publish(0, shards_doc)
+        for row in self.servers:
+            for r in row:
+                r.start()
+        self.coordinator = FailoverCoordinator(
+            self.store, job_id, grace_s=grace_s,
+            poll_s=coordinator_poll_s).start()
+        self._clients: List[RpcPsClient] = []
+
+    # -- topology accessors ----------------------------------------------
+
+    def replica(self, shard: int, endpoint: str) -> HAServer:
+        for r in self.servers[shard]:
+            if r.endpoint == endpoint:
+                return r
+        raise KeyError(endpoint)
+
+    def primary(self, shard: int) -> HAServer:
+        _, shards = self.routing.read()
+        return self.replica(shard, shards[shard]["primary"])
+
+    def backups(self, shard: int) -> List[HAServer]:
+        _, shards = self.routing.read()
+        return [self.replica(shard, ep)
+                for ep in shards[shard].get("backups", [])]
+
+    # -- client / chaos surface ------------------------------------------
+
+    def router(self, **kw) -> HARouter:
+        return HARouter(self.store, self.job_id, **kw)
+
+    def client(self, with_router: bool = True, **router_kw) -> RpcPsClient:
+        cli = RpcPsClient(self.routing.primaries(),
+                          router=self.router(**router_kw)
+                          if with_router else None)
+        self._clients.append(cli)
+        return cli
+
+    def kill_primary(self, shard: int) -> str:
+        """Host-death the shard's current primary NOW; returns its
+        endpoint (for rejoin bookkeeping)."""
+        p = self.primary(shard)
+        p.kill()
+        return p.endpoint
+
+    def restart_replica(self, shard: int, endpoint: str) -> HAServer:
+        """Bring a FRESH server back on a dead replica's endpoint (the
+        operator restart in the runbook): its heartbeat reappears, the
+        coordinator re-adds it to the routing table as a backup, and the
+        shard's primary attaches it — catalog replay + full snapshot +
+        oplog tail catch-up (the rejoin path)."""
+        old = self.replica(shard, endpoint)
+        enforce(old.server.stopped, f"{endpoint} is still alive")
+        old.close()
+        host, port = endpoint.rsplit(":", 1)
+        fresh = HAServer(self.store, self.job_id, shard, host=host,
+                         port=int(port), n_trainers=self._n_trainers,
+                         sync=self.sync, hb_interval=self._hb_interval,
+                         hb_ttl=self._hb_ttl).start()
+        row = self.servers[shard]
+        row[row.index(old)] = fresh
+        return fresh
+
+    def wait_promoted(self, shard: int, old_primary: str,
+                      timeout: float = 10.0) -> str:
+        deadline = time.monotonic() + timeout
+        while True:
+            _, shards = self.routing.read()
+            ep = shards[shard]["primary"]
+            if ep != old_primary:
+                return ep
+            enforce(time.monotonic() < deadline,
+                    f"no promotion for shard {shard} within {timeout}s")
+            time.sleep(0.01)
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Sync-replication barrier across the cluster: every live
+        backup in the routing table is ATTACHED to its primary's
+        shipper and has acked every oplog entry. Waits through the
+        shipper's startup/attach lag (role changes ride the heartbeat
+        tick), so a drain right after bring-up or a promotion is safe —
+        an unattached backup must not vacuously pass the barrier."""
+        deadline = time.monotonic() + timeout
+        for si in range(self.num_shards):
+            while True:
+                _, shards = self.routing.read()
+                sh = shards[si]
+                prim = self.replica(si, sh["primary"])
+                alive = {ep for ep in sh.get("backups", [])
+                         if not self.replica(si, ep).server.stopped}
+                rm = prim.rm
+                if not prim.server.stopped and rm is not None and \
+                        alive <= set(rm.lag()["acked"]):
+                    rm.drain(max(0.01, deadline - time.monotonic()))
+                    break
+                enforce(time.monotonic() < deadline,
+                        f"drain: shard {si} shipper not attached to "
+                        f"{alive} within {timeout}s")
+                time.sleep(0.01)
+
+    def digests(self, table_id: int, shard: int) -> Dict[str, int]:
+        """Per-replica content digests for one shard (live replicas
+        only) — the primary ≡ backup bit-identity check."""
+        out = {}
+        for r in self.servers[shard]:
+            if r.server.stopped:
+                continue
+            conn = make_conn(r.endpoint)
+            try:
+                _, resp = conn.check(_rpc._DIGEST, table_id)
+                out[r.endpoint] = int(np.frombuffer(resp, np.uint64)[0])
+            finally:
+                conn.close()
+        return out
+
+    def stop(self) -> None:
+        self.coordinator.stop()
+        for cli in self._clients:
+            try:
+                cli.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        for row in self.servers:
+            for r in row:
+                try:
+                    r.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def __enter__(self) -> "HACluster":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -432,6 +432,9 @@ def _configure_pst(lib: ctypes.CDLL) -> None:
         lib.pst_export_create.argtypes = [ctypes.c_void_p, u64p, i32p,
                                           ctypes.c_int64, f32p,
                                           ctypes.POINTER(ctypes.c_uint8)]
+    if hasattr(lib, "pst_digest"):
+        lib.pst_digest.restype = ctypes.c_uint64
+        lib.pst_digest.argtypes = [ctypes.c_void_p]
 
 
 def _f32(a: np.ndarray):
@@ -530,6 +533,14 @@ class NativeSparseTableEngine:
         keys = np.ascontiguousarray(keys, np.uint64)
         values = np.ascontiguousarray(values, np.float32)
         self._lib.pst_insert_full(self._h, _u64(keys), _f32(values), len(keys))
+
+    def digest(self) -> int:
+        """Order-independent content digest (pst_digest / pstpu::
+        table_digest): equal across replicas holding identical rows."""
+        if not hasattr(self._lib, "pst_digest"):
+            raise RuntimeError("stale native library lacks pst_digest — "
+                               "rebuild paddle_tpu/csrc")
+        return int(self._lib.pst_digest(self._h))
 
 
 # ---------------------------------------------------------------------------

@@ -628,7 +628,11 @@ class CtrStreamTrainer:
                 self.communicator._drain_pulls()
         dt = time.perf_counter() - t0
         if self.communicator is not None:
-            self.communicator.barrier()  # drain sends AND prefetch pulls
+            # drains sends AND prefetch pulls, and RAISES any failure the
+            # background push thread hit mid-pass (a PS shard death that
+            # out-ran failover must fail the pass loudly, not lose
+            # whatever gradients were queued behind the dead connection)
+            self.communicator.barrier()
         return {
             "loss": stats.mean_loss,
             "steps": float(stats.steps),

@@ -26,11 +26,13 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-from ..core.enforce import NotFoundError, PreconditionNotMetError, enforce
+from ..core.enforce import (NotFoundError, PreconditionNotMetError,
+                            PsTransportError, enforce)
 from ..core.flags import define_flag, flag
 from ..core.profiler import RecordEvent
 from .accessor import AccessorConfig
 from .client import PSClient
+from .faultpoints import faultpoint
 from .native import load_native, table_native_params
 from .table import (TableConfig, format_shard_row, merge_duplicate_keys,
                     parse_shard_row)
@@ -59,7 +61,8 @@ define_flag("ps_rpc_parallel", True,
             "(debugging / deterministic call interleaving)")
 
 __all__ = ["NativePsServer", "RpcPsClient", "RemoteSparseTable",
-           "rpc_available"]
+           "rpc_available", "make_conn", "send_replicate",
+           "PsTransportError"]
 
 # command ids (ps_service.cc Cmd enum)
 _CREATE_SPARSE = 1
@@ -89,6 +92,14 @@ _COMPACT = 24
 _LOAD_COLD = 34
 _SAVE_FILE = 35
 _LOAD_FILE = 36
+# HA / replication commands (ps_service.cc kReplicate..kDenseRestore;
+# ps/ha.py is the driver — see docs/OPERATIONS.md §6)
+_REPLICATE = 37
+_EPOCH = 38
+_REPL_STATE = 39
+_DIGEST = 40
+_DENSE_SNAP = 41
+_DENSE_RESTORE = 42
 
 _DENSE_OPT_IDS = {"sgd": 0, "adam": 1, "sum": 2}
 
@@ -138,6 +149,26 @@ def _configure_rpc(lib: ctypes.CDLL) -> None:
                               ctypes.c_int32]
     lib.psc_resp_ptr.restype = ctypes.c_void_p
     lib.psc_resp_ptr.argtypes = [ctypes.c_void_p]
+    # HA / replication / chaos server ABI (ps/ha.py ReplicationManager)
+    lib.pss_set_replication.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                        ctypes.c_int64]
+    lib.pss_oplog_next.restype = ctypes.c_int64
+    lib.pss_oplog_next.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+    lib.pss_staged_len.restype = ctypes.c_uint64
+    lib.pss_staged_len.argtypes = [ctypes.c_void_p]
+    lib.pss_staged_ptr.restype = ctypes.c_void_p
+    lib.pss_staged_ptr.argtypes = [ctypes.c_void_p]
+    for fn in ("pss_oplog_seq", "pss_oplog_pending", "pss_oplog_dropped",
+               "pss_catalog_count", "pss_epoch", "pss_applied_seq"):
+        getattr(lib, fn).restype = ctypes.c_int64
+        getattr(lib, fn).argtypes = [ctypes.c_void_p]
+    lib.pss_catalog_get.restype = ctypes.c_int64
+    lib.pss_catalog_get.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.pss_pause_mutations.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.pss_set_epoch.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.pss_arm_fault.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                  ctypes.c_uint32, ctypes.c_int64,
+                                  ctypes.c_int64]
 
 
 def _rpc_lib() -> ctypes.CDLL:
@@ -179,6 +210,76 @@ class NativePsServer:
     def stopped(self) -> bool:
         return self._h is None or bool(self._lib.pss_stopped(self._h))
 
+    # -- HA / replication surface (ps/ha.py ReplicationManager) ----------
+
+    def set_replication(self, enable: bool, cap_entries: int = 0) -> None:
+        """Start/stop tapping mutating request frames into the oplog
+        ring (bounded at ``cap_entries``; overflow drops the oldest and
+        the shipper detects the seq gap → full snapshot resync)."""
+        self._lib.pss_set_replication(self._h, 1 if enable else 0,
+                                      int(cap_entries))
+
+    def oplog_next(self, timeout_ms: int = 100):
+        """Pop the next oplog entry (SINGLE consumer — the shipper
+        thread). Returns ``(seq, frame_bytes)``, ``(-1, None)`` on
+        timeout, ``(-2, None)`` once the server is stopping and the
+        ring has drained."""
+        seq = int(self._lib.pss_oplog_next(self._h, int(timeout_ms)))
+        if seq < 0:
+            return seq, None
+        n = int(self._lib.pss_staged_len(self._h))
+        buf = ctypes.create_string_buffer(n)
+        ctypes.memmove(buf, self._lib.pss_staged_ptr(self._h), n)
+        return seq, buf.raw
+
+    def oplog_seq(self) -> int:
+        return int(self._lib.pss_oplog_seq(self._h))
+
+    def oplog_pending(self) -> int:
+        return int(self._lib.pss_oplog_pending(self._h))
+
+    def oplog_dropped(self) -> int:
+        return int(self._lib.pss_oplog_dropped(self._h))
+
+    def catalog(self):
+        """Every create-table frame seen so far (replayed to a
+        rejoining backup before the data snapshot)."""
+        out = []
+        for i in range(int(self._lib.pss_catalog_count(self._h))):
+            n = int(self._lib.pss_catalog_get(self._h, i))
+            if n < 0:
+                continue
+            buf = ctypes.create_string_buffer(n)
+            ctypes.memmove(buf, self._lib.pss_staged_ptr(self._h), n)
+            out.append(buf.raw)
+        return out
+
+    def pause_mutations(self, paused: bool) -> None:
+        """Quiesce writers (they block, within their IO deadline) while
+        a snapshot + seq rebase takes a consistent cut."""
+        self._lib.pss_pause_mutations(self._h, 1 if paused else 0)
+
+    @property
+    def epoch(self) -> int:
+        return int(self._lib.pss_epoch(self._h))
+
+    def set_epoch(self, epoch: int) -> None:
+        self._lib.pss_set_epoch(self._h, int(epoch))
+
+    @property
+    def applied_seq(self) -> int:
+        return int(self._lib.pss_applied_seq(self._h))
+
+    def arm_fault(self, name: str, cmd: int = 0, after: int = 1,
+                  param: int = 0) -> None:
+        """Arm a server-side faultpoint (kill-shard / drop-frame /
+        close-socket / delay-ms): fires once ``after`` matching requests
+        (``cmd`` 0 = any) have been handled; delay-ms stays armed with
+        ``param`` ms. The deterministic 'die mid-run' switch the chaos
+        tests flip (csrc/ps_service.cc fault_action)."""
+        self._lib.pss_arm_fault(self._h, name.encode(), int(cmd),
+                                int(after), int(param))
+
     def close(self) -> None:
         if self._h:
             self._lib.pss_destroy(self._h)
@@ -210,6 +311,7 @@ class _ServerConn:
     def __init__(self, lib: ctypes.CDLL, host: str, port: int) -> None:
         self._lib = lib
         self._host, self._port = host, port
+        self.endpoint = f"{host}:{port}"
         self._h = None
         # serializes the whole call/close/reconnect/set_timeout sequence:
         # the C++ mutex only protects a single psc_call, but reconnect
@@ -224,7 +326,7 @@ class _ServerConn:
             int(flag("pserver_connect_timeout_ms")),
             int(flag("pserver_timeout_ms")))
         if not self._h:
-            raise PreconditionNotMetError(
+            raise PsTransportError(
                 f"cannot connect to PS server {self._host}:{self._port} "
                 f"(connect timeout {flag('pserver_connect_timeout_ms')} ms)")
 
@@ -249,7 +351,7 @@ class _ServerConn:
             # undefined stream state: drop the socket before any retry
             self.close()
             kind = "timed out" if status == -1001 else "reset/refused"
-            raise PreconditionNotMetError(
+            raise PsTransportError(
                 f"PS transport to {self._host}:{self._port} {kind} "
                 f"(cmd {cmd})")
         rlen = int(self._lib.psc_resp_len(self._h))
@@ -314,16 +416,20 @@ class _ServerConn:
         last: Optional[Exception] = None
         for attempt in range(retries + 1):
             try:
+                # chaos site: delay-ms / drop-frame / close-socket land
+                # here, INSIDE the retry loop, so an injected fault walks
+                # the exact transport-recovery path a real one would
+                faultpoint("rpc.call", cmd=cmd, close=self.close)
                 with self._mu:  # one caller owns connect/call/close at a time
                     if self._h is None:
                         self._connect()
                     return self._call_once(cmd, table_id, n, aux, ptrs, lens,
                                            nparts, timeout_ms, view)
-            except PreconditionNotMetError as e:
+            except PsTransportError as e:
                 last = e
                 if attempt < retries:
                     time.sleep(backoff * (2 ** attempt))
-        raise PreconditionNotMetError(
+        raise PsTransportError(
             f"PS server {self._host}:{self._port} unreachable after "
             f"{retries + 1} attempt(s): {last}")
 
@@ -334,6 +440,29 @@ class _ServerConn:
             raise NotFoundError(f"table {table_id} not created on server")
         enforce(status >= 0, f"PS command {cmd} failed with status {status}")
         return status, resp
+
+
+def make_conn(endpoint: str) -> "_ServerConn":
+    """One hardened connection to ``endpoint`` ("host:port") — the
+    replication shipper's channel to a backup (ps/ha.py)."""
+    host, port = endpoint.rsplit(":", 1)
+    return _ServerConn(_rpc_lib(), host, int(port))
+
+
+def send_replicate(conn: "_ServerConn", frame: bytes, seq: int,
+                   epoch: int, retries: Optional[int] = None) -> int:
+    """Ship one oplog entry (``frame`` = [ReqHeader][payload] as produced
+    by ``NativePsServer.oplog_next``) to a backup as a kReplicate
+    command. Returns the server's status: the acked seq, or the negative
+    error (-5 stale epoch = we are fenced; -6 seq gap = backup needs a
+    full snapshot resync). The chaos site ``repl.ship`` can corrupt the
+    epoch stamp to exercise the fencing path deterministically."""
+    spec = faultpoint("repl.ship", close=conn.close)
+    if spec is not None and spec.action == "corrupt-epoch":
+        epoch = spec.param
+    status, _ = conn.call(_REPLICATE, 0, n=int(seq), aux=int(epoch),
+                          payload=frame, retries=retries)
+    return int(status)
 
 
 def _sparse_config_payload(cfg: TableConfig) -> bytes:
@@ -357,8 +486,10 @@ class RpcPsClient(PSClient):
     connection, so interleaved pull/push streams stay frame-correct.
     """
 
-    def __init__(self, endpoints: Sequence[str]) -> None:
+    def __init__(self, endpoints: Sequence[str],
+                 router: Optional[object] = None) -> None:
         lib = _rpc_lib()
+        self._lib = lib
         self._conns: List[_ServerConn] = []
         for ep in endpoints:
             host, port = ep.rsplit(":", 1)
@@ -370,10 +501,106 @@ class RpcPsClient(PSClient):
         self._wire_f16: Dict[int, bool] = {}  # table → fp16 pull values
         self._pool: Optional[ThreadPoolExecutor] = None
         self._pool_mu = threading.Lock()
+        #: HA router (ps/ha.py HARouter): resolves the epoch-stamped
+        #: routing table, gates endpoints through the circuit breaker,
+        #: and answers "who replaced this dead primary?". None = the
+        #: static single-replica topology (behavior unchanged).
+        self._router = router
+        self._conns_mu = threading.Lock()  # serializes failover conn swaps
 
     @property
     def num_servers(self) -> int:
         return len(self._conns)
+
+    # -- HA failover (router-gated; no-ops when router is None) -----------
+
+    def _swap_conn(self, s: int, endpoint: str) -> None:
+        """Point shard ``s`` at ``endpoint`` (promoted backup). Another
+        thread may have swapped already — endpoint equality makes the
+        swap idempotent; the loser's stale conn is closed."""
+        with self._conns_mu:
+            if self._conns[s].endpoint == endpoint:
+                return
+            host, port = endpoint.rsplit(":", 1)
+            old, self._conns[s] = self._conns[s], _ServerConn(
+                self._lib, host, int(port))
+        old.close()
+
+    def refresh_routing(self) -> bool:
+        """Re-resolve every shard's endpoint from the router's current
+        routing table; returns True if any connection moved. Callers
+        holding failed futures (communicator pull prefetch) refresh and
+        replay; without a router this is a no-op."""
+        if self._router is None:
+            return False
+        _, eps = self._router.routing()
+        moved = False
+        for s, ep in enumerate(eps[: len(self._conns)]):
+            if ep and ep != self._conns[s].endpoint:
+                self._swap_conn(s, ep)
+                moved = True
+        return moved
+
+    def _shard_op(self, s: int, fn):
+        """Run ``fn(conn)`` against shard ``s``'s current server. With a
+        router: breaker-gate the endpoint (an OPEN breaker fails fast
+        instead of paying the full timeout·retries again), and on a
+        TRANSPORT death (PsTransportError — the connection is gone, not
+        a server-side rejection) ask the router for the promoted
+        replacement (it watches the epoch-stamped routing table) and
+        replay ``fn`` there. Application errors (NotFoundError, enforce
+        failures on negative statuses) pass straight through and never
+        touch the breaker — a healthy server's rejection must not open
+        its breaker or trigger a failover wait."""
+        c = self._conns[s]
+        r = self._router
+        if r is None:
+            return fn(c)
+        ep = c.endpoint
+        if not r.allow(ep):
+            # breaker open: don't burn a timeout — jump straight to
+            # re-resolution (the coordinator may have promoted already)
+            new_ep = r.failover(s, ep)
+            if new_ep is None or new_ep == ep:
+                raise PsTransportError(
+                    f"PS shard {s} endpoint {ep} circuit breaker open "
+                    f"and no promoted replacement published")
+            self._swap_conn(s, new_ep)
+            c = self._conns[s]
+            ep = c.endpoint
+        try:
+            out = fn(c)
+        except PsTransportError:
+            r.record(ep, ok=False)
+            new_ep = r.failover(s, ep)
+            if new_ep is None or new_ep == ep:
+                raise
+            self._swap_conn(s, new_ep)
+            out = fn(self._conns[s])
+            r.record(new_ep, ok=True)
+            return out
+        except BaseException:
+            # an application-level rejection means the server RESPONDED:
+            # the transport is alive — record success so a HALF_OPEN
+            # probe releases (otherwise the probe slot leaks and the
+            # breaker locks the healthy endpoint out forever)
+            r.record(ep, ok=True)
+            raise
+        r.record(ep, ok=True)
+        return out
+
+    def _direct(self, server: int, fn):
+        """Server-TARGETED call: no breaker, no failover replay. For
+        introspection (repl_state, epoch, dense snapshots) the answer
+        must come from the addressed server or fail — a transparent
+        replay on a promoted replacement would report the wrong
+        server's state as if it were the dead one's."""
+        return fn(self._conns[server])
+
+    def _task(self, s: int, fn):
+        """Zero-arg fan-out task bound to shard index (NOT to a conn
+        object — failover may swap the conn between submit and run)."""
+        return lambda: self._shard_op(s, fn)
 
     def close(self) -> None:
         with self._pool_mu:
@@ -446,8 +673,8 @@ class RpcPsClient(PSClient):
             dims = np.frombuffer(resp, np.int32)
             return int(dims[0]), int(dims[1]), int(dims[2])
 
-        all_dims = self._fanout([lambda idx=i, c=c: mk(idx, c)
-                                 for i, c in enumerate(self._conns)])
+        all_dims = self._fanout([self._task(i, lambda c, idx=i: mk(idx, c))
+                                 for i in range(self.num_servers)])
         enforce(len(set(all_dims)) == 1,
                 f"servers disagree on table {table_id} dims: {all_dims} "
                 "(mismatched accessor configs across trainers?)")
@@ -459,9 +686,10 @@ class RpcPsClient(PSClient):
         """Per-server spill to at most hot_budget hot rows each; returns
         total rows spilled."""
         return sum(self._fanout(
-            [lambda c=c: int(c.check(_SPILL, table_id, n=int(hot_budget),
-                                     timeout_ms=_long_ms(), retries=0)[0])
-             for c in self._conns]))
+            [self._task(s, lambda c: int(
+                c.check(_SPILL, table_id, n=int(hot_budget),
+                        timeout_ms=_long_ms(), retries=0)[0]))
+             for s in range(self.num_servers)]))
 
     def table_stats(self, table_id: int) -> Dict[str, int]:
         def one(c):
@@ -469,31 +697,39 @@ class RpcPsClient(PSClient):
             s3 = np.frombuffer(resp, np.int64)
             return int(s3[0]), int(s3[1]), int(s3[2])
 
-        stats = self._fanout([lambda c=c: one(c) for c in self._conns])
+        stats = self._fanout([self._task(s, one)
+                              for s in range(self.num_servers)])
         return {"hot_rows": sum(s[0] for s in stats),
                 "cold_rows": sum(s[1] for s in stats),
                 "disk_bytes": sum(s[2] for s in stats)}
 
     def compact(self, table_id: int) -> int:
+        # default retries (unlike shrink/spill): a compaction that is
+        # re-run after a deadline expiry just rewrites live records
+        # again — idempotent, so at-least-once delivery is safe, and a
+        # loaded host blowing the long-call deadline once shouldn't
+        # fail the daily boundary
         return sum(self._fanout(
-            [lambda c=c: int(c.check(_COMPACT, table_id,
-                                     timeout_ms=_long_ms(), retries=0)[0])
-             for c in self._conns]))
+            [self._task(s, lambda c: int(
+                c.check(_COMPACT, table_id, timeout_ms=_long_ms())[0]))
+             for s in range(self.num_servers)]))
 
     def create_dense_table(self, table_id: int, dim: int, optimizer: str = "adam",
                            lr: float = 0.001) -> None:
         self._dense_dims[table_id] = dim
-        for s, c in enumerate(self._conns):
+        for s in range(self.num_servers):
             shard_dim = len(self._dense_slice(dim, s))
             payload = (np.asarray([shard_dim, _DENSE_OPT_IDS[optimizer]], np.int32).tobytes()
                        + np.asarray([lr], np.float32).tobytes())
-            c.check(_CREATE_DENSE, table_id, payload=payload)
+            self._shard_op(s, lambda c, pl=payload: c.check(
+                _CREATE_DENSE, table_id, payload=pl))
 
     def create_geo_table(self, table_id: int, dim: int) -> None:
         self._geo_dims[table_id] = dim
         payload = np.asarray([dim], np.int32).tobytes()
-        for c in self._conns:
-            c.check(_CREATE_GEO, table_id, payload=payload)
+        for s in range(self.num_servers):
+            self._shard_op(s, lambda c: c.check(_CREATE_GEO, table_id,
+                                                payload=payload))
 
     def _dense_slice(self, dim: int, server: int) -> range:
         per = (dim + self.num_servers - 1) // self.num_servers
@@ -518,15 +754,15 @@ class RpcPsClient(PSClient):
             return self._pull_sparse(table_id, keys, create, slots)
 
     def _shard_sel(self, sv: np.ndarray):
-        """(server, conn, sel) for servers with work; ``sel`` is None
-        when one server owns every key (skip the gather copy)."""
+        """(server, sel) for servers with work; ``sel`` is None when one
+        server owns every key (skip the gather copy)."""
         out = []
-        for s, c in enumerate(self._conns):
+        for s in range(self.num_servers):
             sel = np.flatnonzero(sv == s)
             if len(sel) == len(sv):
-                out.append((s, c, None))
+                out.append((s, None))
             elif len(sel):
-                out.append((s, c, sel))
+                out.append((s, sel))
         return out
 
     def _pull_sparse(self, table_id, keys, create=True, slots=None):
@@ -553,8 +789,8 @@ class RpcPsClient(PSClient):
             else:
                 out[sel] = vals.reshape(len(kp), pull_dim)
 
-        self._fanout([lambda c=c, sel=sel: one(c, sel)
-                      for _, c, sel in self._shard_sel(sv)])
+        self._fanout([self._task(s, lambda c, sel=sel: one(c, sel))
+                      for s, sel in self._shard_sel(sv)])
         return out
 
     def push_sparse(self, table_id, keys, values):
@@ -574,8 +810,8 @@ class RpcPsClient(PSClient):
             vp = values if sel is None else values[sel]
             c.check(_PUSH_SPARSE, table_id, n=len(kp), payload=(kp, vp))
 
-        self._fanout([lambda c=c, sel=sel: one(c, sel)
-                      for _, c, sel in self._shard_sel(sv)])
+        self._fanout([self._task(s, lambda c, sel=sel: one(c, sel))
+                      for s, sel in self._shard_sel(sv)])
 
     def pull_dense(self, table_id):
         try:
@@ -588,8 +824,9 @@ class RpcPsClient(PSClient):
             _, resp = c.check(_PULL_DENSE, table_id, view=True)
             out[sl.start : sl.stop] = resp.view(np.float32)
 
-        self._fanout([lambda c=c, sl=self._dense_slice(dim, s): one(c, sl)
-                      for s, c in enumerate(self._conns)
+        self._fanout([self._task(s, lambda c, sl=self._dense_slice(dim, s):
+                                 one(c, sl))
+                      for s in range(self.num_servers)
                       if len(self._dense_slice(dim, s))])
         return out
 
@@ -599,18 +836,20 @@ class RpcPsClient(PSClient):
         # contiguous slice views — the gradient ships straight from the
         # caller's buffer, no per-server copy at all
         self._fanout(
-            [lambda c=c, sl=self._dense_slice(dim, s):
-             c.check(_PUSH_DENSE, table_id, payload=grad[sl.start : sl.stop])
-             for s, c in enumerate(self._conns)
+            [self._task(s, lambda c, sl=self._dense_slice(dim, s):
+                        c.check(_PUSH_DENSE, table_id,
+                                payload=grad[sl.start : sl.stop]))
+             for s in range(self.num_servers)
              if len(self._dense_slice(dim, s))])
 
     def set_dense(self, table_id, values):
         values = np.ascontiguousarray(values, np.float32)
         dim = self._dense_dims[table_id]
         self._fanout(
-            [lambda c=c, sl=self._dense_slice(dim, s):
-             c.check(_SET_DENSE, table_id, payload=values[sl.start : sl.stop])
-             for s, c in enumerate(self._conns)
+            [self._task(s, lambda c, sl=self._dense_slice(dim, s):
+                        c.check(_SET_DENSE, table_id,
+                                payload=values[sl.start : sl.stop]))
+             for s in range(self.num_servers)
              if len(self._dense_slice(dim, s))])
 
     def push_geo(self, table_id, keys, deltas):
@@ -623,8 +862,8 @@ class RpcPsClient(PSClient):
             dp = deltas if sel is None else deltas[sel]
             c.check(_PUSH_GEO, table_id, n=len(kp), payload=(kp, dp))
 
-        self._fanout([lambda c=c, sel=sel: one(c, sel)
-                      for _, c, sel in self._shard_sel(sv)])
+        self._fanout([self._task(s, lambda c, sel=sel: one(c, sel))
+                      for s, sel in self._shard_sel(sv)])
 
     def pull_geo(self, table_id):
         dim = self._geo_dims[table_id]
@@ -638,8 +877,9 @@ class RpcPsClient(PSClient):
                     resp[cnt * 8 :].view(np.float32)
                     .reshape(cnt, dim).copy())
 
-        got = [g for g in self._fanout([lambda c=c: one(c)
-                                        for c in self._conns]) if g]
+        got = [g for g in self._fanout([self._task(s, one)
+                                        for s in range(self.num_servers)])
+               if g]
         if not got:
             return np.zeros(0, np.uint64), np.zeros((0, dim), np.float32)
         return (np.concatenate([k for k, _ in got]),
@@ -649,24 +889,82 @@ class RpcPsClient(PSClient):
         # all-trainer barrier lives on server 0 (BarrierTable placement);
         # a long-but-finite deadline (peers may legitimately lag, but a
         # silently dead server must still surface) and retries=0 so a
-        # flaky link can't double-arrive
-        self._conns[0].check(_BARRIER, retries=0,
-                             timeout_ms=int(flag("pserver_barrier_timeout_ms")))
+        # flaky link can't double-arrive on the SAME server. Routed
+        # through _shard_op: a barrier racing a primary→backup promotion
+        # re-resolves the routing table and re-arrives on the PROMOTED
+        # server instead of surfacing a spurious dead-server error (the
+        # old primary never registered the failed arrival, so this
+        # cannot double-count). Known tradeoff: a barrier that expires
+        # its 30-min deadline against a HEALTHY server (peers truly
+        # wedged) is indistinguishable from a dead server at the
+        # transport level, so it pays one failover wait and counts one
+        # breaker failure — acceptable at that timescale.
+        self._shard_op(0, lambda c: c.check(
+            _BARRIER, retries=0,
+            timeout_ms=int(flag("pserver_barrier_timeout_ms"))))
 
     def global_step(self, increment: int = 1) -> int:
-        status, _ = self._conns[0].check(_GLOBAL_STEP, n=increment)
+        status, _ = self._shard_op(
+            0, lambda c: c.check(_GLOBAL_STEP, n=increment))
         return status
 
     def shrink(self, table_id):
         # parallel: the shrink sweep is a whole-table rewrite per server
         # (~minutes at 1e8 rows) — the daily boundary pays max, not sum
         return sum(self._fanout(
-            [lambda c=c: c.check(_SHRINK, table_id, timeout_ms=_long_ms(),
-                                 retries=0)[0] for c in self._conns]))
+            [self._task(s, lambda c: c.check(_SHRINK, table_id,
+                                             timeout_ms=_long_ms(),
+                                             retries=0)[0])
+             for s in range(self.num_servers)]))
 
     def size(self, table_id) -> int:
-        return sum(self._fanout([lambda c=c: c.check(_SIZE, table_id)[0]
-                                 for c in self._conns]))
+        return sum(self._fanout(
+            [self._task(s, lambda c: c.check(_SIZE, table_id)[0])
+             for s in range(self.num_servers)]))
+
+    # -- HA helpers (ps/ha.py drives these; docs/OPERATIONS.md §6) --------
+
+    def digest(self, table_id: int) -> List[int]:
+        """Per-server order-independent content digests (kDigest) — two
+        replicas of a shard holding bit-identical rows digest equal."""
+        def one(c):
+            _, resp = c.check(_DIGEST, table_id)
+            return int(np.frombuffer(resp, np.uint64)[0])
+
+        return self._fanout([self._task(s, one)
+                             for s in range(self.num_servers)])
+
+    def server_epoch(self, server: int, set_to: Optional[int] = None) -> int:
+        """Read (or set) one server's routing epoch (kEpoch). The
+        failover coordinator sets the promoted backup's epoch BEFORE
+        publishing the new routing table, fencing the demoted primary's
+        replication stream."""
+        status, _ = self._direct(
+            server, lambda c: c.check(
+                _EPOCH, n=-1 if set_to is None else int(set_to)))
+        return status
+
+    def repl_state(self, server: int) -> Tuple[int, int, int, int]:
+        """(applied_seq, epoch, oplog_seq, oplog_pending) of one server
+        (kReplState read) — enough to run a cross-process replication
+        drain barrier with no shared store (ha.drain_remote)."""
+        _, resp = self._direct(
+            server, lambda c: c.check(_REPL_STATE, n=-1))
+        st = np.frombuffer(resp, np.int64)
+        return int(st[0]), int(st[1]), int(st[2]), int(st[3])
+
+    def dense_snapshot(self, table_id: int, server: int) -> bytes:
+        """One server's dense-table full state (values + optimizer
+        moments + step; kDenseSnap) — the rejoin snapshot payload."""
+        _, resp = self._direct(
+            server, lambda c: c.check(_DENSE_SNAP, table_id,
+                                      timeout_ms=_long_ms()))
+        return bytes(resp)
+
+    def dense_restore(self, table_id: int, server: int, blob: bytes) -> None:
+        self._direct(
+            server, lambda c: c.check(_DENSE_RESTORE, table_id, payload=blob,
+                                      timeout_ms=_long_ms()))
 
 
     def _embedx_dim(self, table_id: int) -> int:
@@ -695,11 +993,12 @@ class RpcPsClient(PSClient):
         xd = self._embedx_dim(table_id)
         ed = full_dim - 7 - xd - self._embedx_state_dim(table_id)
         total = 0
-        for s, c in enumerate(self._conns):
+        for s in range(self.num_servers):
             # single atomic command: snapshot+stream (concurrent savers
             # cannot interleave a begin/fetch pair)
-            cnt, resp = c.check(_SAVE_ALL, table_id, aux=mode,
-                                timeout_ms=_long_ms(), retries=0)
+            cnt, resp = self._shard_op(s, lambda c: c.check(
+                _SAVE_ALL, table_id, aux=mode,
+                timeout_ms=_long_ms(), retries=0))
             keys = np.frombuffer(resp[: cnt * 8], np.uint64)
             values = np.frombuffer(resp[cnt * 8 :], np.float32).reshape(cnt, full_dim)
             path = os.path.join(dirname, f"part-{s:05d}.shard")
@@ -774,8 +1073,8 @@ class RpcPsClient(PSClient):
                 out[sel] = vals
                 found[sel] = resp[nb:] != 0
 
-        self._fanout([lambda c=c, sel=sel: one(c, sel)
-                      for _, c, sel in self._shard_sel(sv)])
+        self._fanout([self._task(s, lambda c, sel=sel: one(c, sel))
+                      for s, sel in self._shard_sel(sv)])
         return out, found
 
     def import_full(self, table_id, keys, values):
@@ -789,8 +1088,8 @@ class RpcPsClient(PSClient):
             c.check(_INSERT_FULL, table_id, n=len(kp), payload=(kp, vp),
                     timeout_ms=_long_ms())
 
-        self._fanout([lambda c=c, sel=sel: one(c, sel)
-                      for _, c, sel in self._shard_sel(sv)])
+        self._fanout([self._task(s, lambda c, sel=sel: one(c, sel))
+                      for s, sel in self._shard_sel(sv)])
 
     def load_cold(self, table_id, keys, values, chunk: int = 1 << 21) -> int:
         """Bulk cold-tier model load across servers (the 1e9-row build
@@ -819,8 +1118,8 @@ class RpcPsClient(PSClient):
             return done
 
         return sum(self._fanout(
-            [lambda c=c, sel=np.flatnonzero(sv == s): one(c, sel)
-             for s, c in enumerate(self._conns)]))
+            [self._task(s, lambda c, sel=np.flatnonzero(sv == s): one(c, sel))
+             for s in range(self.num_servers)]))
 
     _SAVE_FORMATS = {None: (0, ""), "gzip": (1, ".gz"), "raw": (2, ".bin")}
 
@@ -845,11 +1144,12 @@ class RpcPsClient(PSClient):
         # parallel: each server streams ITS shard to its own file —
         # checkpoint wall-clock is the largest shard, not the sum
         total = sum(self._fanout(
-            [lambda c=c, path=os.path.join(
+            [self._task(s, lambda c, path=os.path.join(
                 dirname, f"part-{s:05d}.shard{suffix}"):
-             int(c.check(_SAVE_FILE, table_id, aux=aux,
-                         payload=path.encode(), timeout_ms=0, retries=0)[0])
-             for s, c in enumerate(self._conns)]))
+                int(c.check(_SAVE_FILE, table_id, aux=aux,
+                            payload=path.encode(), timeout_ms=0,
+                            retries=0)[0]))
+             for s in range(self.num_servers)]))
         import json
 
         with open(os.path.join(dirname, "meta.json"), "w") as f:
@@ -878,10 +1178,11 @@ class RpcPsClient(PSClient):
         fmt, suffix = self._SAVE_FORMATS[conv]
         aux = fmt << 8
         return sum(self._fanout(
-            [lambda c=c, path=path:
-             int(c.check(_LOAD_FILE, table_id, aux=aux,
-                         payload=path.encode(), timeout_ms=0, retries=0)[0])
-             for s, c in enumerate(self._conns)
+            [self._task(s, lambda c, path=path:
+                        int(c.check(_LOAD_FILE, table_id, aux=aux,
+                                    payload=path.encode(), timeout_ms=0,
+                                    retries=0)[0]))
+             for s in range(self.num_servers)
              for path in [os.path.join(dirname,
                                        f"part-{s:05d}.shard{suffix}")]
              if os.path.exists(path)]))
@@ -962,6 +1263,9 @@ class RemoteSparseTable:
 
     def stats(self) -> Dict[str, int]:
         return self._client.table_stats(self._table_id)
+
+    def digest(self) -> List[int]:
+        return self._client.digest(self._table_id)
 
     @property
     def full_dim(self) -> int:

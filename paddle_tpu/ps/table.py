@@ -41,6 +41,7 @@ __all__ = [
     "TableConfig",
     "register_converter",
     "converter_entry",
+    "row_digest",
     "MemorySparseTable",
     "SsdSparseTable",
     "make_sparse_table",
@@ -97,6 +98,25 @@ def converter_entry(name: Optional[str]):
             f"unknown save converter {name!r} (registered: "
             f"{sorted(_CONVERTERS)})")
     return _CONVERTERS[name]
+
+
+def row_digest(keys: np.ndarray, values: np.ndarray) -> int:
+    """Python mirror of the native content digest (pstpu::row_hash,
+    sparse_table.h): per-row FNV-1a over [key bytes ++ full-row float
+    bytes], combined with wrapping 64-bit ADD — order-independent, so it
+    matches the servers' kDigest for the same logical rows regardless of
+    shard layout. Test-scale tool (pure-python byte loop); the engines
+    answer digests natively."""
+    mask = 0xFFFFFFFFFFFFFFFF
+    total = 0
+    keys = np.ascontiguousarray(keys, np.uint64)
+    values = np.ascontiguousarray(values, np.float32)
+    for i in range(len(keys)):
+        h = 0xCBF29CE484222325
+        for b in keys[i].tobytes() + values[i].tobytes():
+            h = ((h ^ b) * 0x100000001B3) & mask
+        total = (total + h) & mask
+    return total
 
 
 def merge_duplicate_keys(keys: np.ndarray, values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -464,6 +484,21 @@ class MemorySparseTable:
         if self._native is not None:
             return self._native.size()
         return sum(len(sh.index) for sh in self._shards)
+
+    def digest(self) -> int:
+        """Order-independent content digest — the same FNV-over-rows sum
+        the servers answer for kDigest (pstpu::row_hash), so a local
+        oracle table can be compared against a remote replica without
+        shipping rows. Python-backend tables compute it from the mode-0
+        save snapshot with the identical per-row hash."""
+        if self._native is not None:
+            return self._native.digest()
+        per = [(sh.save_items(_SAVE_MODE_ALL), sh) for sh in self._shards]
+        keys = (np.concatenate([k for (k, _), _ in per])
+                if per else np.zeros(0, np.uint64))
+        values = (np.concatenate([sh.full_rows(r) for (_, r), sh in per])
+                  if per else np.zeros((0, self.full_dim), np.float32))
+        return row_digest(keys, values)
 
     def flush(self) -> None:
         pass  # synchronous writes; parity no-op

@@ -49,6 +49,8 @@ _SLOW = {
     "test_resnet18_trains",
     "test_multiprocess_cluster",
     "test_fleet_rpc_cluster",
+    "test_multiprocess_failover_kill_minus_nine",
+    "test_stream_trainer_survives_kill_shard_bit_identical",
     "test_ring_attention_backward_matches_full",
     "test_ring_attention_matches_full",
     "test_hybrid_moe_runs",

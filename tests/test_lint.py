@@ -453,6 +453,112 @@ def test_cast_roundtrip_single_cast_ok(tmp_path):
     assert diags == []
 
 
+def test_sleep_no_backoff_constant_retry_flagged(tmp_path):
+    # the thundering-herd shape: fixed interval between retry attempts
+    diags = _conv_diags(tmp_path, """
+        import time
+
+        def connect(dial):
+            while True:
+                try:
+                    return dial()
+                except OSError:
+                    time.sleep(0.2)
+    """)
+    assert _rules(diags) == {"sleep-no-backoff"}
+
+
+def test_sleep_no_backoff_from_import_alias_flagged(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        from time import sleep as snooze
+
+        def connect(dial):
+            for attempt in range(5):
+                try:
+                    return dial()
+                except OSError:
+                    snooze(1)
+    """)
+    assert _rules(diags) == {"sleep-no-backoff"}
+
+
+def test_sleep_exponential_backoff_ok(tmp_path):
+    # the sanctioned ps/rpc.py pattern: duration grows per attempt
+    diags = _conv_diags(tmp_path, """
+        import time
+
+        def connect(dial):
+            backoff = 0.1
+            for attempt in range(5):
+                try:
+                    return dial()
+                except OSError:
+                    time.sleep(backoff * (2 ** attempt))
+    """)
+    assert "sleep-no-backoff" not in _rules(diags)
+
+
+def test_sleep_polling_loop_without_except_ok(tmp_path):
+    # a plain poll loop retries nothing — constant interval is fine
+    diags = _conv_diags(tmp_path, """
+        import time
+
+        def wait_for(cond):
+            while not cond():
+                time.sleep(0.01)
+    """)
+    assert "sleep-no-backoff" not in _rules(diags)
+
+
+def test_sleep_exiting_handler_ok(tmp_path):
+    # the except handler LEAVES the loop (return) — that is an exit
+    # path, not a retry; the idle sleep next to it must not flag
+    diags = _conv_diags(tmp_path, """
+        import time
+
+        def pump(step):
+            while True:
+                try:
+                    step()
+                except RuntimeError:
+                    return
+                time.sleep(0.002)
+    """)
+    assert "sleep-no-backoff" not in _rules(diags)
+
+
+def test_sleep_nested_polling_loop_inside_retry_ok(tmp_path):
+    # innermost-loop scoping: the constant-sleep POLL loop nested in a
+    # retrying outer loop is not itself a retry loop
+    diags = _conv_diags(tmp_path, """
+        import time
+
+        def run(step, ready, backoff=0.1):
+            for attempt in range(3):
+                try:
+                    while not ready():
+                        time.sleep(0.01)
+                    return step()
+                except OSError:
+                    time.sleep(backoff * (2 ** attempt))
+    """)
+    assert "sleep-no-backoff" not in _rules(diags)
+
+
+def test_sleep_no_backoff_ignore_comment(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        import time
+
+        def connect(dial):
+            while True:
+                try:
+                    return dial()
+                except OSError:
+                    time.sleep(10)  # graftlint: ignore[sleep-no-backoff] — single cooldown
+    """)
+    assert "sleep-no-backoff" not in _rules(diags)
+
+
 def test_cast_roundtrip_ignore_comment(tmp_path):
     diags = _conv_diags(tmp_path, """
         import jax.numpy as jnp

@@ -1,0 +1,856 @@
+"""PS high availability: replication, failure detection, failover,
+and the deterministic fault-injection harness (ps/ha.py + the
+kReplicate/kEpoch/kDigest wire commands in csrc/ps_service.cc).
+
+Layers under test, bottom-up: the faultpoint registry and circuit
+breaker (pure python), the oplog/epoch wire protocol (two in-process
+servers), the full HACluster control loop (heartbeats → coordinator →
+promotion → client failover → rejoin), and the e2e acceptance runs —
+CtrStreamTrainer surviving a kill-shard faultpoint mid-run with
+sync-replication bit-identity against a fault-free oracle, plus a true
+multiprocess variant (SIGKILL'd server process, FileStore leases)."""
+
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.ps.accessor import AccessorConfig
+from paddle_tpu.ps.faultpoints import (FaultInjected, arm_faultpoint,
+                                       disarm_faultpoints, faultpoint)
+from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+from paddle_tpu.ps.table import MemorySparseTable, TableConfig, row_digest
+
+rpc = pytest.importorskip("paddle_tpu.ps.rpc")
+
+pytestmark = pytest.mark.skipif(
+    not rpc.rpc_available(), reason="native toolchain unavailable")
+
+from paddle_tpu.ps import ha  # noqa: E402  (needs the native lib)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    yield
+    disarm_faultpoints()
+
+
+def _acc():
+    return AccessorConfig(sgd=SGDRuleConfig(initial_range=0.0))
+
+
+def _cfg():
+    return TableConfig(shard_num=4, accessor_config=_acc())
+
+
+def _push(rng, keys, width=12):
+    push = np.zeros((len(keys), width), np.float32)
+    push[:, 0] = (keys % 8).astype(np.float32)
+    push[:, 1] = 1.0
+    push[:, 3:] = rng.normal(0, 0.1, (len(keys), width - 3)).astype(np.float32)
+    return push
+
+
+# ---------------------------------------------------------------------------
+# faultpoint registry
+# ---------------------------------------------------------------------------
+
+def test_faultpoint_unarmed_is_noop():
+    assert faultpoint("nowhere") is None
+
+
+def test_faultpoint_schedule_after_every_count():
+    spec = arm_faultpoint("site", "corrupt-epoch", after=3, every=2, count=2,
+                          param=99)
+    fired = [i for i in range(10) if faultpoint("site") is not None]
+    # hits 1..10: threshold at 3, then every 2 → 3,5 (count caps at 2)
+    assert fired == [2, 4]
+    assert spec.fired == 2
+
+
+def test_faultpoint_drop_frame_raises_transport_error():
+    arm_faultpoint("site", "drop-frame")
+    with pytest.raises(FaultInjected):
+        faultpoint("site")
+    assert faultpoint("site") is None  # count=0 means unlimited? no: fired
+    # unlimited count keeps firing on every hit
+    arm_faultpoint("site", "drop-frame", every=1)
+    for _ in range(3):
+        with pytest.raises(FaultInjected):
+            faultpoint("site")
+
+
+def test_faultpoint_flag_arming(monkeypatch):
+    """FLAGS_ps_faultpoints parses site=action[:k=v]* and arms lazily on
+    the FIRST faultpoint() probe (the env-driven chaos path)."""
+    import paddle_tpu as pt
+    from paddle_tpu.ps import faultpoints as fp
+
+    monkeypatch.setattr(fp, "_flag_loaded", False)
+    pt.set_flags({"ps_faultpoints":
+                  "rpc.call=delay-ms:ms=1:after=2;other=drop-frame"})
+    try:
+        t0 = time.perf_counter()
+        assert faultpoint("rpc.call") is None      # hit 1 < after
+        faultpoint("rpc.call")                     # hit 2 → 1ms delay
+        assert time.perf_counter() - t0 >= 0.001
+        with pytest.raises(FaultInjected):
+            faultpoint("other")
+    finally:
+        pt.set_flags({"ps_faultpoints": ""})
+        disarm_faultpoints()
+
+
+def test_faultpoint_cmd_filter_and_kill_callback():
+    killed = []
+    arm_faultpoint("site", "kill-shard", cmd=4)
+    assert faultpoint("site", cmd=3, kill=lambda: killed.append(1)) is None
+    assert faultpoint("site", cmd=4, kill=lambda: killed.append(1)) is not None
+    assert killed == [1]
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker
+# ---------------------------------------------------------------------------
+
+def test_breaker_open_half_open_close():
+    t = [0.0]
+    b = ha.CircuitBreaker(failures=3, cooldown_s=5.0, clock=lambda: t[0])
+    assert b.state == b.CLOSED and b.allow()
+    for _ in range(3):
+        b.record(ok=False)
+    assert b.state == b.OPEN
+    assert not b.allow()          # open: fail fast
+    t[0] = 4.9
+    assert not b.allow()          # cooldown not elapsed
+    t[0] = 5.1
+    assert b.allow()              # the ONE half-open probe
+    assert b.state == b.HALF_OPEN
+    assert not b.allow()          # second caller blocked while probing
+    b.record(ok=True)
+    assert b.state == b.CLOSED and b.allow()
+
+
+def test_breaker_half_open_failure_reopens():
+    t = [0.0]
+    b = ha.CircuitBreaker(failures=1, cooldown_s=1.0, clock=lambda: t[0])
+    b.record(ok=False)
+    assert b.state == b.OPEN
+    t[0] = 1.5
+    assert b.allow()
+    b.record(ok=False)            # probe failed → re-open, cooldown resets
+    assert b.state == b.OPEN
+    t[0] = 2.0
+    assert not b.allow()
+    t[0] = 2.6
+    assert b.allow()
+
+
+# ---------------------------------------------------------------------------
+# oplog / epoch wire protocol (two bare servers)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def pair():
+    prim = rpc.NativePsServer(n_trainers=1)
+    back = rpc.NativePsServer(n_trainers=1)
+    prim.set_replication(True)
+    cp = rpc.RpcPsClient([f"127.0.0.1:{prim.port}"])
+    cb = rpc.RpcPsClient([f"127.0.0.1:{back.port}"])
+    yield prim, back, cp, cb
+    cp.close()
+    cb.close()
+    prim.close()
+    back.close()
+
+
+def _ship_all(prim, back_conn, epoch=0):
+    while True:
+        seq, frame = prim.oplog_next(timeout_ms=50)
+        if seq < 0:
+            return
+        st = rpc.send_replicate(back_conn, frame, seq, epoch)
+        assert st == seq, (st, seq)
+
+
+def test_oplog_orders_and_replays_mutations(pair):
+    prim, back, cp, cb = pair
+    cp.create_sparse_table(0, _cfg())
+    cb.create_sparse_table(0, _cfg())
+    rng = np.random.default_rng(0)
+    keys = rng.integers(1, 3000, 200).astype(np.uint64)
+    cp.pull_sparse(0, keys)                   # create=True → replicated
+    for _ in range(3):
+        cp.push_sparse(0, keys, _push(rng, keys))
+    # seqs are strictly increasing and frames decode to the issued ops
+    import struct
+    seen = []
+    bconn = rpc.make_conn(f"127.0.0.1:{back.port}")
+    try:
+        last = 0
+        while True:
+            seq, frame = prim.oplog_next(timeout_ms=50)
+            if seq < 0:
+                break
+            assert seq == last + 1, "oplog seq must be gapless"
+            last = seq
+            _, cmd, tid, _, _ = struct.unpack_from("<QIIqi", frame, 0)
+            seen.append(cmd)
+            assert rpc.send_replicate(bconn, frame, seq, 0) == seq
+        # create (tapped, applied idempotently), pull-create, 3 pushes
+        assert seen == [rpc._CREATE_SPARSE, rpc._PULL_SPARSE,
+                        rpc._PUSH_SPARSE, rpc._PUSH_SPARSE, rpc._PUSH_SPARSE]
+        assert cp.digest(0) == cb.digest(0)
+        np.testing.assert_array_equal(cp.pull_sparse(0, keys, create=False),
+                                      cb.pull_sparse(0, keys, create=False))
+    finally:
+        bconn.close()
+
+
+def test_epoch_fencing_rejects_stale_primary(pair):
+    prim, back, cp, cb = pair
+    cp.create_sparse_table(0, _cfg())
+    rng = np.random.default_rng(1)
+    keys = np.arange(1, 50, dtype=np.uint64)
+    cp.push_sparse(0, keys, _push(rng, keys))
+    bconn = rpc.make_conn(f"127.0.0.1:{back.port}")
+    try:
+        back.set_epoch(7)  # the backup has been promoted at epoch 7
+        seq, frame = prim.oplog_next(timeout_ms=100)
+        assert seq >= 1
+        # stale stream (epoch < 7) is fenced, nothing applied
+        assert rpc.send_replicate(bconn, frame, seq, epoch=3) == -5
+        # current-epoch stream applies
+        assert rpc.send_replicate(bconn, frame, seq, epoch=7) == seq
+        # duplicate replay after reconnect acks idempotently
+        assert rpc.send_replicate(bconn, frame, seq, epoch=7) == seq
+        # a seq that skips ahead reports the gap (backup needs a snapshot)
+        assert rpc.send_replicate(bconn, frame, seq + 5, epoch=7) == -6
+    finally:
+        bconn.close()
+
+
+def test_corrupt_epoch_faultpoint_exercises_fence(pair):
+    prim, back, cp, cb = pair
+    cp.create_sparse_table(0, _cfg())
+    back.set_epoch(2)
+    bconn = rpc.make_conn(f"127.0.0.1:{back.port}")
+    try:
+        seq, frame = prim.oplog_next(timeout_ms=100)
+        arm_faultpoint("repl.ship", "corrupt-epoch", param=0)
+        assert rpc.send_replicate(bconn, frame, seq, epoch=2) == -5
+        disarm_faultpoints("repl.ship")
+        assert rpc.send_replicate(bconn, frame, seq, epoch=2) == seq
+    finally:
+        bconn.close()
+
+
+def test_replicate_accepts_seq_beyond_32_bits(pair):
+    """The oplog seq rides ReqHeader.n and is NOT an element count — a
+    long-lived shard's lifetime mutation count exceeds the 2^32 frame
+    bound, and kReplicate must keep flowing there."""
+    prim, back, cp, cb = pair
+    cp.create_sparse_table(0, _cfg())
+    cb.create_sparse_table(0, _cfg())
+    rng = np.random.default_rng(0)
+    keys = np.arange(1, 30, dtype=np.uint64)
+    cp.push_sparse(0, keys, _push(rng, keys))
+    bconn = rpc.make_conn(f"127.0.0.1:{back.port}")
+    try:
+        big = (1 << 33) + 7
+        back.set_epoch(0)
+        # rebase the backup as if it had applied big-1 entries already
+        bconn.check(rpc._REPL_STATE, n=big - 1)
+        frames = []
+        while True:
+            seq, frame = prim.oplog_next(timeout_ms=50)
+            if seq < 0:
+                break
+            frames.append(frame)
+        assert rpc.send_replicate(bconn, frames[-1], big, epoch=0) == big
+        assert back.applied_seq == big
+    finally:
+        bconn.close()
+
+
+def test_replicate_acks_frames_the_primary_also_rejected(pair):
+    """A malformed mutating frame (tapped before the primary's payload
+    validation rejected it) must ACK on the backup instead of wedging
+    replication — state changed on neither side."""
+    import struct
+
+    prim, back, cp, cb = pair
+    cp.create_sparse_table(0, _cfg())
+    cb.create_sparse_table(0, _cfg())
+    bconn = rpc.make_conn(f"127.0.0.1:{back.port}")
+    try:
+        # hand-build a kPushSparse frame whose payload is the wrong size
+        bad_payload = b"\x00" * 24
+        inner = struct.pack("<QIIqi", len(bad_payload), rpc._PUSH_SPARSE,
+                            0, 5, 0) + bad_payload
+        assert rpc.send_replicate(bconn, inner, 1, epoch=0) == 1
+        assert back.applied_seq == 1  # advanced despite the rejection
+        # and the stream keeps flowing afterwards
+        rng = np.random.default_rng(0)
+        keys = np.arange(1, 20, dtype=np.uint64)
+        cp.push_sparse(0, keys, _push(rng, keys))
+        _ship_all(prim, bconn)
+        assert cp.digest(0) == cb.digest(0)
+    finally:
+        bconn.close()
+
+
+def test_global_step_replicates_and_reads_stay_ungated(pair):
+    prim, back, cp, cb = pair
+    bconn = rpc.make_conn(f"127.0.0.1:{back.port}")
+    try:
+        prim.pause_mutations(True)
+        # an n=0 read is NOT gated (the snapshot path reads it from a
+        # paused primary) ...
+        assert cp.global_step(0) == 0
+        prim.pause_mutations(False)
+        # ... but increments are, and they replicate
+        assert cp.global_step(5) == 5
+        _ship_all(prim, bconn)
+        assert cb.global_step(0) == 5
+    finally:
+        bconn.close()
+
+
+def test_foreign_seq_cursor_forces_snapshot_rebase():
+    """A backup whose applied_seq was numbered by a DIFFERENT primary
+    (promotion chain) must be re-synced via snapshot, not silently
+    skipped by cursor comparison against the new primary's seqs."""
+    store = ha.MemoryStore()
+    routing = ha.RoutingTable(store, "foreign")
+    prim = rpc.NativePsServer(n_trainers=1)
+    back = rpc.NativePsServer(n_trainers=1)
+    pep, bep = f"127.0.0.1:{prim.port}", f"127.0.0.1:{back.port}"
+    routing.publish(0, [{"primary": pep, "backups": [bep],
+                         "replicas": [pep, bep]}])
+    cp = rpc.RpcPsClient([pep])
+    cb = rpc.RpcPsClient([bep])
+    rm = None
+    try:
+        prim.set_replication(True)
+        cb.create_sparse_table(0, _cfg())
+        # the backup claims a cursor far beyond the fresh primary's ring
+        bconn = rpc.make_conn(bep)
+        bconn.check(rpc._REPL_STATE, n=100_000)
+        bconn.close()
+        cp.create_sparse_table(0, _cfg())
+        rng = np.random.default_rng(0)
+        keys = rng.integers(1, 2000, 150).astype(np.uint64)
+        cp.push_sparse(0, keys, _push(rng, keys))
+        rm = ha.ReplicationManager(prim, pep, 0, routing).start()
+        deadline = time.monotonic() + 20
+        while cp.digest(0) != cb.digest(0):
+            assert time.monotonic() < deadline, \
+                (rm.lag(), cp.digest(0), cb.digest(0))
+            time.sleep(0.02)
+    finally:
+        if rm is not None:
+            rm.stop()
+        cp.close()
+        cb.close()
+        prim.close()
+        back.close()
+
+
+def test_application_errors_do_not_trip_breaker_or_failover():
+    """Server-side rejections (missing table, bad sizes) are NOT
+    transport deaths: they pass through _shard_op untouched, never
+    record a breaker failure, and never wait on the failover timeout."""
+    from paddle_tpu.core.enforce import NotFoundError
+
+    with ha.HACluster(num_shards=1, replication=2, sync=False) as c:
+        cli = c.client(failures=2, cooldown_s=60.0, failover_timeout_s=5.0)
+        cli.create_sparse_table(0, _cfg())
+        ep = c.primary(0).endpoint
+        keys = np.arange(1, 10, dtype=np.uint64)
+        t0 = time.perf_counter()
+        for _ in range(4):  # > breaker threshold
+            with pytest.raises(NotFoundError):
+                cli.pull_sparse(42, keys)  # table never created
+        # fast (no failover waits) and the healthy endpoint stays CLOSED
+        assert time.perf_counter() - t0 < 2.0
+        assert cli._router.breaker(ep).state == ha.CircuitBreaker.CLOSED
+        cli.pull_sparse(0, keys)  # still healthy
+
+
+def test_shard_op_app_error_releases_half_open_probe():
+    """A server-side rejection during a HALF_OPEN probe proves the
+    transport is ALIVE — it must release the probe (record success),
+    not leak it and lock the healthy endpoint out forever."""
+    server = rpc.NativePsServer(n_trainers=1)
+    ep = f"127.0.0.1:{server.port}"
+
+    class StubRouter:
+        def __init__(self):
+            self.b = ha.CircuitBreaker(failures=1, cooldown_s=0.01)
+
+        def routing(self):
+            return 0, [ep]
+
+        def allow(self, endpoint):
+            return self.b.allow()
+
+        def record(self, endpoint, ok):
+            self.b.record(ok)
+
+        def failover(self, shard, bad):
+            return None
+
+    router = StubRouter()
+    cli = rpc.RpcPsClient([ep], router=router)
+    try:
+        router.b.record(ok=False)  # force OPEN
+        assert router.b.state == ha.CircuitBreaker.OPEN
+        time.sleep(0.02)  # past cooldown → next allow() is THE probe
+        from paddle_tpu.core.enforce import NotFoundError
+        with pytest.raises(NotFoundError):
+            cli.digest(99)  # reaches the server; rejected kErrNoTable
+        # the probe released and the server answered → breaker CLOSED
+        assert router.b.state == ha.CircuitBreaker.CLOSED
+        cli.create_sparse_table(0, _cfg())  # endpoint fully usable
+    finally:
+        cli.close()
+        server.close()
+
+
+def test_communicator_stays_failed_after_first_error_surfaces():
+    """Once the background push thread dies, the FIRST barrier raises
+    the original error and every later join with queued work raises
+    again (a dead thread can never drain) instead of hanging."""
+    from paddle_tpu.core.enforce import PreconditionNotMetError
+    from paddle_tpu.ps.communicator import AsyncCommunicator
+
+    class DoomedClient:
+        def push_sparse(self, table_id, keys, values):
+            raise PsTransportError("server gone")
+
+        def pull_sparse(self, table_id, keys, create=True):
+            return np.zeros((len(keys), 1), np.float32)
+
+    from paddle_tpu.core.enforce import PsTransportError
+
+    comm = AsyncCommunicator(DoomedClient())
+    comm.start()
+    keys = np.arange(3, dtype=np.uint64)
+    comm.send_sparse(0, keys, np.zeros((3, 4), np.float32))
+    with pytest.raises(PsTransportError):
+        comm.barrier()
+    comm.send_sparse(0, keys, np.zeros((3, 4), np.float32))
+    t0 = time.perf_counter()
+    with pytest.raises(PreconditionNotMetError):
+        comm.barrier()  # raises again, promptly — no infinite spin
+    assert time.perf_counter() - t0 < 15.0
+    with pytest.raises(PreconditionNotMetError):
+        comm.stop()
+
+
+def test_server_fault_drop_frame_and_delay(pair):
+    prim, _, cp, _ = pair
+    cp.create_sparse_table(0, _cfg())
+    keys = np.arange(1, 20, dtype=np.uint64)
+    # drop-frame: the next matching request's connection dies without a
+    # response; the client transport reconnects and retries through
+    prim.arm_fault("drop-frame", cmd=rpc._PULL_SPARSE, after=1)
+    out = cp.pull_sparse(0, keys, create=False)
+    assert out.shape[0] == len(keys)
+    # delay-ms: armed latency is observable
+    prim.arm_fault("delay-ms", cmd=rpc._PULL_SPARSE, after=1, param=120)
+    t0 = time.perf_counter()
+    cp.pull_sparse(0, keys, create=False)
+    assert time.perf_counter() - t0 >= 0.1
+
+
+# ---------------------------------------------------------------------------
+# HACluster: replication + failover + rejoin
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def cluster():
+    with ha.HACluster(num_shards=2, replication=2, sync=True) as c:
+        yield c
+
+
+def test_sync_replication_bit_identical_at_barrier(cluster):
+    cli = cluster.client()
+    cli.create_sparse_table(0, _cfg())
+    rng = np.random.default_rng(0)
+    keys = rng.integers(1, 5000, 500).astype(np.uint64)
+    cli.pull_sparse(0, keys)
+    cli.push_sparse(0, keys, _push(rng, keys))
+    cluster.drain()
+    for shard in range(2):
+        dg = cluster.digests(0, shard)
+        assert len(dg) == 2 and len(set(dg.values())) == 1, dg
+
+
+def test_failover_reroutes_pulls_and_pushes(cluster):
+    cli = cluster.client()
+    cli.create_sparse_table(0, _cfg())
+    rng = np.random.default_rng(0)
+    keys = rng.integers(1, 5000, 300).astype(np.uint64)
+    cli.pull_sparse(0, keys)
+    cli.push_sparse(0, keys, _push(rng, keys))
+    cluster.drain()
+    before = cli.pull_sparse(0, keys, create=False)
+    dead = cluster.kill_primary(0)
+    # the next pull fails over to the promoted backup and sees the
+    # replicated state bit-identically
+    after = cli.pull_sparse(0, keys, create=False)
+    np.testing.assert_array_equal(before, after)
+    assert cluster.wait_promoted(0, dead) != dead
+    # pushes keep training through the new primary
+    cli.push_sparse(0, keys, _push(rng, keys))
+    cluster.drain()
+    assert np.abs(cli.pull_sparse(0, keys, create=False) - before).sum() > 0
+
+
+def test_barrier_rides_through_promotion(cluster):
+    """The satellite bugfix: barrier runs retries=0, so one racing a
+    primary→backup promotion must re-resolve the routing table and
+    arrive on the promoted server instead of raising dead-server."""
+    cli = cluster.client()
+    cli.create_sparse_table(0, _cfg())
+    dead = cluster.kill_primary(0)
+    cli.barrier()  # must NOT raise: re-resolves to the promoted backup
+    assert cluster.wait_promoted(0, dead) != dead
+
+
+def test_in_flight_async_pull_replays_across_failover(cluster):
+    from paddle_tpu.ps.communicator import AsyncCommunicator
+
+    cli = cluster.client()
+    cli.create_sparse_table(0, _cfg())
+    rng = np.random.default_rng(0)
+    keys = rng.integers(1, 4000, 256).astype(np.uint64)
+    cli.pull_sparse(0, keys)
+    cli.push_sparse(0, keys, _push(rng, keys))
+    cluster.drain()
+    want = cli.pull_sparse(0, keys, create=False)
+    comm = AsyncCommunicator(cli)
+    comm.start()
+    try:
+        # kill the shard-0 primary ON the next pull command it sees:
+        # the prefetch pull is in flight when the server dies under it
+        cluster.primary(0).server.arm_fault(
+            "kill-shard", cmd=rpc._PULL_SPARSE, after=1)
+        fut = comm.pull_sparse_async(0, keys, create=False)
+        got = fut.result(timeout=30)  # drains/replays via failover
+        np.testing.assert_array_equal(got, want)
+    finally:
+        comm.stop()
+
+
+def test_rejoin_snapshot_and_tail_catch_up(cluster):
+    cli = cluster.client()
+    cli.create_sparse_table(0, _cfg())
+    cli.create_dense_table(1, dim=16, optimizer="adam", lr=0.05)
+    rng = np.random.default_rng(0)
+    keys = rng.integers(1, 5000, 400).astype(np.uint64)
+    cli.pull_sparse(0, keys)
+    cli.push_sparse(0, keys, _push(rng, keys))
+    cli.push_dense(1, np.ones(16, np.float32))
+    cluster.drain()
+    dead = cluster.kill_primary(0)
+    new_prim = cluster.wait_promoted(0, dead)
+    # keep training while the replica is down (its ring entry is gone —
+    # rejoin MUST go through catalog replay + snapshot, not the tail)
+    for _ in range(3):
+        cli.push_sparse(0, keys, _push(rng, keys))
+        cli.push_dense(1, np.ones(16, np.float32))
+    cluster.restart_replica(0, dead)
+    deadline = time.monotonic() + 15
+    while True:
+        _, shards = cluster.routing.read()
+        if dead in shards[0]["backups"]:
+            break
+        assert time.monotonic() < deadline, shards
+        time.sleep(0.05)
+    cli.push_sparse(0, keys, _push(rng, keys))  # tail traffic post-rejoin
+    cluster.drain()
+    dg = cluster.digests(0, 0)
+    assert len(dg) == 2 and len(set(dg.values())) == 1, dg
+    # dense state (values + adam moments + step) caught up bit-identically
+    # (each shard-0 replica holds the first 16/2 = 8 dims of the split)
+    a = rpc.RpcPsClient([new_prim])
+    b = rpc.RpcPsClient([dead])
+    a._dense_dims[1] = b._dense_dims[1] = 8
+    try:
+        np.testing.assert_array_equal(a.pull_dense(1), b.pull_dense(1))
+    finally:
+        a.close()
+        b.close()
+
+
+def test_oplog_overflow_falls_back_to_snapshot():
+    """A backup that attaches after the bounded ring dropped entries
+    must come up via the full snapshot, not a corrupt tail."""
+    store = ha.MemoryStore()
+    routing = ha.RoutingTable(store, "ovf")
+    prim = rpc.NativePsServer(n_trainers=1)
+    back = rpc.NativePsServer(n_trainers=1)
+    pep, bep = f"127.0.0.1:{prim.port}", f"127.0.0.1:{back.port}"
+    routing.publish(0, [{"primary": pep, "backups": [bep],
+                         "replicas": [pep, bep]}])
+    cp = rpc.RpcPsClient([pep])
+    cb = rpc.RpcPsClient([bep])
+    rm = None
+    try:
+        prim.set_replication(True, cap_entries=8)  # tiny ring
+        cp.create_sparse_table(0, _cfg())
+        rng = np.random.default_rng(0)
+        keys = rng.integers(1, 3000, 200).astype(np.uint64)
+        for _ in range(30):  # >> ring capacity before any shipping
+            cp.push_sparse(0, keys, _push(rng, keys))
+        assert prim.oplog_dropped() > 0
+        rm = ha.ReplicationManager(prim, pep, 0, routing,
+                                   oplog_cap=8).start()
+        deadline = time.monotonic() + 20
+        while True:
+            lg = rm.lag()
+            if lg["acked"].get(bep, -1) >= lg["seq"] and lg["pending"] == 0:
+                break
+            assert time.monotonic() < deadline, lg
+            time.sleep(0.01)
+        assert cp.digest(0) == cb.digest(0)
+    finally:
+        if rm is not None:
+            rm.stop()
+        cp.close()
+        cb.close()
+        prim.close()
+        back.close()
+
+
+def test_breaker_opens_after_repeated_failures_without_promotion():
+    """Replication factor 1: nothing to promote — after N consecutive
+    transport failures the endpoint's breaker opens and subsequent
+    calls fail FAST instead of paying timeout*retries each."""
+    import paddle_tpu as pt
+
+    old = pt.get_flags(["pserver_connect_timeout_ms", "pserver_timeout_ms",
+                        "pserver_max_retry", "pserver_retry_backoff_ms"])
+    pt.set_flags({"pserver_connect_timeout_ms": 200,
+                  "pserver_timeout_ms": 300,
+                  "pserver_max_retry": 1,
+                  "pserver_retry_backoff_ms": 10})
+    try:
+        with ha.HACluster(num_shards=1, replication=1, sync=False) as c:
+            cli = c.client(failures=2, cooldown_s=60.0,
+                           failover_timeout_s=0.2)
+            cli.create_sparse_table(0, _cfg())
+            keys = np.arange(1, 20, dtype=np.uint64)
+            cli.pull_sparse(0, keys)
+            ep = c.primary(0).endpoint
+            c.kill_primary(0)
+            from paddle_tpu.core.enforce import PreconditionNotMetError
+            for _ in range(2):
+                with pytest.raises(PreconditionNotMetError):
+                    cli.pull_sparse(0, keys, create=False)
+            assert cli._router.breaker(ep).state == ha.CircuitBreaker.OPEN
+            t0 = time.perf_counter()
+            with pytest.raises(PreconditionNotMetError):
+                cli.pull_sparse(0, keys, create=False)
+            # fail-fast path: no connect/call timeout was paid, only the
+            # (short) failover wait for a promotion that can't happen
+            assert time.perf_counter() - t0 < 1.0
+    finally:
+        pt.set_flags(old)
+
+
+# ---------------------------------------------------------------------------
+# e2e: CtrStreamTrainer survives kill-shard; sync mode is bit-identical
+# ---------------------------------------------------------------------------
+
+def _make_stream_data(n=384, S=3, D=2, seed=0):
+    from paddle_tpu.data.dataset import InMemoryDataset, SlotDesc
+
+    rng = np.random.default_rng(seed)
+    lines = []
+    for _ in range(n):
+        ids = rng.integers(0, 48, S)
+        dense = rng.normal(size=D)
+        label = int((ids % 5 == 0).sum() + dense[0] > 1.0)
+        lines.append(" ".join([f"1 {v}" for v in ids]
+                              + [f"1 {v:.4f}" for v in dense]
+                              + [f"1 {label}"]))
+    slots = ([SlotDesc(f"s{i}", is_float=False, max_len=1) for i in range(S)]
+             + [SlotDesc(f"d{i}", is_float=True, max_len=1) for i in range(D)]
+             + [SlotDesc("label", is_float=True, max_len=1)])
+    ds = InMemoryDataset(slots, seed=0)
+    ds.load_from_lines(lines)
+    return ds
+
+
+def _run_stream_trainer(cli, cluster=None, kill_after_pushes=None):
+    """One deterministic CtrStreamTrainer run against ``cli``'s table 0.
+    With ``kill_after_pushes``, the shard-0 primary is armed to die on
+    its Nth push — mid-run, under traffic. ``cluster`` (sync mode)
+    drains after every batch so every acked op is on the backup before
+    the next lands: the kill point then loses NOTHING and the run is
+    bit-identical to a fault-free one."""
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM
+    from paddle_tpu.ps.communicator import SyncCommunicator
+    from paddle_tpu.ps.ps_trainer import CtrStreamTrainer
+
+    S, D = 3, 2
+    ds = _make_stream_data(S=S, D=D)
+    cli.create_sparse_table(0, _cfg())
+    if kill_after_pushes is not None:
+        cluster.primary(0).server.arm_fault(
+            "kill-shard", cmd=rpc._PUSH_SPARSE, after=kill_after_pushes)
+
+    comm = SyncCommunicator(cli)
+    if cluster is not None:
+        base_send = comm.send_sparse
+
+        def send_and_drain(table_id, keys, values):
+            base_send(table_id, keys, values)
+            cluster.drain()  # sync replication: nothing acked-but-unshipped
+
+        comm.send_sparse = send_and_drain
+    comm.start()
+    pt.seed(0)
+    tr = CtrStreamTrainer(
+        DeepFM(CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=8,
+                         dnn_hidden=(8,))),
+        optimizer.Adam(1e-2), None, communicator=comm, table_id=0,
+        embedx_dim=8,
+        sparse_slots=[f"s{i}" for i in range(S)],
+        dense_slots=[f"d{i}" for i in range(D)], label_slot="label")
+    out = tr.train_from_dataset(ds, batch_size=128)
+    comm.stop()
+    assert np.isfinite(out["loss"])
+    probe = np.unique(
+        (np.arange(0, 48, dtype=np.uint64)[None, :]
+         + (np.arange(S, dtype=np.uint64)[:, None] << np.uint64(32)))
+        .reshape(-1))
+    return out, cli.pull_sparse(0, probe, create=False)
+
+
+def test_stream_trainer_survives_kill_shard_bit_identical():
+    """THE acceptance run: kill a PS shard mid-CtrStreamTrainer via the
+    armed kill-shard faultpoint; training completes through failover,
+    and with sync replication the final pulled params are BIT-identical
+    to a fault-free run."""
+    with ha.HACluster(num_shards=2, replication=2, sync=True) as oracle:
+        cli = oracle.client()
+        _, params_ok = _run_stream_trainer(cli, cluster=oracle)
+
+    with ha.HACluster(num_shards=2, replication=2, sync=True) as chaotic:
+        cli = chaotic.client()
+        t0 = time.perf_counter()
+        out, params_chaos = _run_stream_trainer(cli, cluster=chaotic,
+                                                kill_after_pushes=2)
+        dt = time.perf_counter() - t0
+        # the primary really died and a backup really took over
+        assert chaotic.coordinator.promotions >= 1
+        assert chaotic.servers[0][0].server.stopped
+    assert out["steps"] == 3.0  # 384 rows / 128, drop_last
+    np.testing.assert_array_equal(params_chaos, params_ok)
+    assert np.isfinite(dt)
+
+
+_HA_SERVER_SCRIPT = """
+import sys, time
+from paddle_tpu.distributed.elastic import FileStore
+from paddle_tpu.ps.ha import HAServer
+store = FileStore(sys.argv[1])
+s = HAServer(store, sys.argv[2], int(sys.argv[3]), n_trainers=1,
+             hb_interval=0.1, hb_ttl=0.6)
+s.start()
+print("READY", s.endpoint, flush=True)
+while not s.server.stopped:
+    time.sleep(0.1)
+print("DEAD", flush=True)
+"""
+
+
+def test_multiprocess_failover_kill_minus_nine(tmp_path):
+    """True multiprocess e2e: 2 replicas of one shard in separate
+    PROCESSES over a FileStore; the primary is SIGKILL'd mid-traffic
+    (nothing graceful anywhere), the parent's coordinator promotes the
+    backup, and the client's pulls keep answering from the replicated
+    state. Bit-identity is asserted for everything drained BEFORE the
+    kill (drain_remote — the wire-level sync barrier)."""
+    from paddle_tpu.distributed.elastic import FileStore
+
+    store_dir = str(tmp_path / "store")
+    store = FileStore(store_dir)
+    procs = []
+    eps = []
+    try:
+        for _ in range(2):
+            p = subprocess.Popen(
+                [sys.executable, "-c", _HA_SERVER_SCRIPT, store_dir, "mp", "0"],
+                stdout=subprocess.PIPE, text=True, cwd="/root/repo")
+            line = p.stdout.readline().strip()
+            assert line.startswith("READY"), line
+            procs.append(p)
+            eps.append(line.split()[1])
+        routing = ha.RoutingTable(store, "mp")
+        routing.publish(0, [{"primary": eps[0], "backups": [eps[1]],
+                             "replicas": eps}])
+        coord = ha.FailoverCoordinator(store, "mp", grace_s=0.2,
+                                       poll_s=0.05).start()
+        try:
+            cli = rpc.RpcPsClient([eps[0]],
+                                  router=ha.HARouter(store, "mp"))
+            cli.create_sparse_table(0, _cfg())
+            rng = np.random.default_rng(0)
+            keys = rng.integers(1, 4000, 300).astype(np.uint64)
+            cli.pull_sparse(0, keys)
+            cli.push_sparse(0, keys, _push(rng, keys))
+            ha.drain_remote(eps[0], [eps[1]])
+            want = cli.pull_sparse(0, keys, create=False)
+            procs[0].kill()  # SIGKILL: no cleanup, lease expires by TTL
+            got = cli.pull_sparse(0, keys, create=False)  # fails over
+            np.testing.assert_array_equal(got, want)
+            assert routing.read()[1][0]["primary"] == eps[1]
+            # and the job keeps training on the survivor
+            cli.push_sparse(0, keys, _push(rng, keys))
+            assert np.abs(cli.pull_sparse(0, keys, create=False)
+                          - want).sum() > 0
+            cli.close()
+        finally:
+            coord.stop()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def test_digest_matches_local_oracle():
+    """kDigest over the wire == MemorySparseTable.digest() == the
+    python row_digest mirror for identical content — the probe the
+    replica-consistency checks stand on."""
+    server = rpc.NativePsServer(n_trainers=1)
+    cli = rpc.RpcPsClient([f"127.0.0.1:{server.port}"])
+    try:
+        cli.create_sparse_table(0, _cfg())
+        local = MemorySparseTable(_cfg())
+        rng = np.random.default_rng(3)
+        keys = np.unique(rng.integers(1, 2000, 300).astype(np.uint64))
+        slots = (keys % 8).astype(np.int32)
+        push = _push(rng, keys)
+        push[:, 0] = slots
+        cli.pull_sparse(0, keys, slots=slots)
+        cli.push_sparse(0, keys, push)
+        local.pull_sparse(keys, slots=slots)
+        local.push_sparse(keys, push)
+        (remote_digest,) = cli.digest(0)
+        assert remote_digest == local.digest()
+        vals, found = local.export_full(keys)
+        assert found.all()
+        assert remote_digest == row_digest(keys, vals)
+    finally:
+        cli.close()
+        server.close()

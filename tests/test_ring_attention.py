@@ -9,6 +9,7 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from paddle_tpu.core import mesh as mesh_mod
+from paddle_tpu.ops import collectives as coll
 from paddle_tpu.parallel.ring_attention import (
     local_attention,
     ring_attention,
@@ -70,7 +71,12 @@ def test_ring_attention_backward_matches_full(mesh, qkv):
 
     def ring_loss(q, k, v):
         out = ring_attention(q, k, v, axis="cp", causal=True)
-        return jax.lax.psum(jnp.sum(out ** 2), "cp")
+        # pinned-VJP psum: the loss cotangent is replicated over cp, and
+        # jax-0.4.x shard_map transposes a plain psum into another psum,
+        # scaling every grad by the axis size (the parallel_cross_entropy
+        # drift fixed in PR 2/3) — psum_replicated pins the identity
+        # backward so per-rank cotangents stay unscaled
+        return coll.psum_replicated(jnp.sum(out ** 2), "cp")
 
     grads = shard_map(
         jax.grad(ring_loss, argnums=(0, 1, 2)),

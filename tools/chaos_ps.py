@@ -1,0 +1,200 @@
+"""PS high-availability chaos demo: measured failover + replication cost.
+
+Drives the full HA control loop (ps/ha.py) end to end and emits one
+JSON line for the bench trajectory:
+
+- **recovery time** — N trials of: replicated cluster under live
+  CtrStream-style traffic, kill-shard the primary via the armed
+  faultpoint, time from the kill to the first successful client call
+  answered by the promoted backup (lease expiry + grace + promotion +
+  client re-route). Reported as p50/p95 ms.
+- **steady-state replication overhead** — the CtrStreamTrainer
+  microbench run against a replication-factor-1 cluster vs an async
+  replication-factor-2 cluster (same data, same seeds, steady-state
+  pass timed after a warm-up pass); overhead % = throughput loss from
+  the oplog tap + shipper + backup apply sharing the host.
+
+Env knobs: CHAOS_TRIALS (default 5), CHAOS_ROWS (dataset rows),
+CHAOS_BATCH, CHAOS_OUT (also write JSON to this path), CHAOS_CPU=0 to
+keep the ambient jax platform. Exits 0 with an "error" field on
+failure (one-JSON-line driver contract).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def _make_dataset(rows, S, D, seed=0):
+    import numpy as np
+
+    from paddle_tpu.data.dataset import InMemoryDataset, SlotDesc
+
+    rng = np.random.default_rng(seed)
+    lines = []
+    for _ in range(rows):
+        ids = rng.integers(0, 96, S)
+        dense = rng.normal(size=D)
+        label = int((ids % 5 == 0).sum() + dense[0] > 1.0)
+        lines.append(" ".join([f"1 {v}" for v in ids]
+                              + [f"1 {v:.4f}" for v in dense]
+                              + [f"1 {label}"]))
+    slots = ([SlotDesc(f"s{i}", is_float=False, max_len=1) for i in range(S)]
+             + [SlotDesc(f"d{i}", is_float=True, max_len=1) for i in range(D)]
+             + [SlotDesc("label", is_float=True, max_len=1)])
+    ds = InMemoryDataset(slots, seed=0)
+    ds.load_from_lines(lines)
+    return ds
+
+
+class _StreamBench:
+    """One CtrStreamTrainer kept alive across passes so A/B configs can
+    be measured INTERLEAVED (pass-paired ambient load — on a small host
+    the load noise otherwise dwarfs the shipping cost this measures)."""
+
+    def __init__(self, cluster, ds, S, D, batch):
+        import paddle_tpu as pt
+        from paddle_tpu import optimizer
+        from paddle_tpu.models.ctr import CtrConfig, DeepFM
+        from paddle_tpu.ps.communicator import SyncCommunicator
+        from paddle_tpu.ps.ps_trainer import CtrStreamTrainer
+        from paddle_tpu.ps.table import TableConfig
+        from paddle_tpu.ps.accessor import AccessorConfig
+        from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+
+        self.ds, self.batch = ds, batch
+        cli = cluster.client()
+        cli.create_sparse_table(0, TableConfig(
+            shard_num=4, accessor_config=AccessorConfig(
+                sgd=SGDRuleConfig(initial_range=0.0))))
+        self.comm = SyncCommunicator(cli)
+        self.comm.start()
+        pt.seed(0)
+        self.tr = CtrStreamTrainer(
+            DeepFM(CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=8,
+                             dnn_hidden=(16,))),
+            optimizer.Adam(1e-2), None, communicator=self.comm, table_id=0,
+            embedx_dim=8,
+            sparse_slots=[f"s{i}" for i in range(S)],
+            dense_slots=[f"d{i}" for i in range(D)], label_slot="label")
+
+    def run_pass(self) -> float:
+        import numpy as np
+
+        out = self.tr.train_from_dataset(self.ds, batch_size=self.batch)
+        assert np.isfinite(out["loss"])
+        return out["samples_per_sec"]
+
+    def close(self) -> None:
+        self.comm.stop()
+
+
+def _recovery_trial(rpc, ha, cfg, rng):
+    """One kill→recover measurement; returns milliseconds."""
+    import numpy as np
+
+    with ha.HACluster(num_shards=1, replication=2, sync=False,
+                      hb_interval=0.05, hb_ttl=0.4, grace_s=0.1) as cluster:
+        cli = cluster.client()
+        cli.create_sparse_table(0, cfg)
+        keys = rng.integers(1, 50_000, 2048).astype(np.uint64)
+        push = np.zeros((len(keys), 12), np.float32)
+        push[:, 1] = 1.0
+        push[:, 3:] = rng.normal(0, 0.1, (len(keys), 9)).astype(np.float32)
+        cli.pull_sparse(0, keys)
+        for _ in range(5):
+            cli.push_sparse(0, keys, push)
+        # die on the NEXT pull the primary sees (armed faultpoint)
+        cluster.primary(0).server.arm_fault(
+            "kill-shard", cmd=rpc._PULL_SPARSE, after=1)
+        t0 = time.perf_counter()
+        out = cli.pull_sparse(0, keys, create=False)  # rides the failover
+        dt = (time.perf_counter() - t0) * 1000.0
+        assert out.shape == (len(keys), cli._dims(0)[0])
+        assert cluster.coordinator.promotions >= 1
+        return dt
+
+
+def main() -> None:
+    out = {"bench": "chaos_ps"}
+    path = os.environ.get("CHAOS_OUT")
+    try:
+        import jax
+
+        if os.environ.get("CHAOS_CPU", "1") == "1":
+            jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+
+        from paddle_tpu.ps import ha, rpc
+        from paddle_tpu.ps.accessor import AccessorConfig
+        from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+        from paddle_tpu.ps.table import TableConfig
+
+        out["platform"] = jax.devices()[0].platform
+
+        trials = int(os.environ.get("CHAOS_TRIALS", 5))
+        rows = int(os.environ.get("CHAOS_ROWS", 512))
+        batch = int(os.environ.get("CHAOS_BATCH", 128))
+        cfg = TableConfig(shard_num=4, accessor_config=AccessorConfig(
+            sgd=SGDRuleConfig(initial_range=0.0)))
+        rng = np.random.default_rng(0)
+
+        # -- recovery time distribution --------------------------------
+        times = sorted(_recovery_trial(rpc, ha, cfg, rng)
+                       for _ in range(trials))
+        out["recovery_trials"] = trials
+        out["recovery_ms_p50"] = round(_pct(times, 0.50), 1)
+        out["recovery_ms_p95"] = round(_pct(times, 0.95), 1)
+        out["recovery_ms_all"] = [round(t, 1) for t in times]
+
+        # -- steady-state async replication overhead -------------------
+        # interleaved A/B: the plain and replicated trainers alternate
+        # passes (best-of over rounds), so ambient load hits both
+        S, D = 3, 2
+        rounds = int(os.environ.get("CHAOS_AB_ROUNDS", 5))
+        ds = _make_dataset(rows, S, D)
+        with ha.HACluster(num_shards=1, replication=1, sync=False) as base, \
+                ha.HACluster(num_shards=1, replication=2, sync=False) as repl:
+            a = _StreamBench(base, ds, S, D, batch)
+            b = _StreamBench(repl, ds, S, D, batch)
+            a.run_pass()  # compile warm-up, both configs
+            b.run_pass()
+            rate_plain = rate_repl = 0.0
+            for r in range(rounds):
+                # alternate the slot order: an A/A control shows ~10%
+                # systematic bias toward whichever config runs first in
+                # a round — alternating + best-of cancels it
+                first, second = (a, b) if r % 2 == 0 else (b, a)
+                r1, r2 = first.run_pass(), second.run_pass()
+                ra, rb = (r1, r2) if r % 2 == 0 else (r2, r1)
+                rate_plain = max(rate_plain, ra)
+                rate_repl = max(rate_repl, rb)
+            a.close()
+            b.close()
+            repl.drain()  # async mode still drains clean at exit
+        out["stream_samples_per_sec_plain"] = round(rate_plain, 1)
+        out["stream_samples_per_sec_replicated"] = round(rate_repl, 1)
+        out["repl_overhead_pct"] = round(
+            max(0.0, (1.0 - rate_repl / max(rate_plain, 1e-9)) * 100.0), 2)
+    except Exception as e:  # noqa: BLE001 — one-JSON-line contract
+        out["error"] = f"{type(e).__name__}: {e}"
+    line = json.dumps(out)
+    print(line)
+    if path:
+        with open(path, "w") as f:
+            f.write(line + "\n")
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

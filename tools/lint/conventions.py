@@ -27,6 +27,15 @@
                    the dtype to the collective (comm_fusion) instead.
                    Intentional precision simulation gets an ignore with
                    a justification.
+  sleep-no-backoff a RETRY loop (a loop whose body contains an except
+                   handler) that sleeps a bare CONSTANT between
+                   attempts. Fixed-interval retries hammer a struggling
+                   server in lockstep across every client — the thundering
+                   herd that turns one slow shard into a dead one; back
+                   off exponentially instead (``base * 2 ** attempt``,
+                   the pattern ``ps/rpc.py`` _ServerConn.call follows).
+                   Plain polling loops (no except) are fine, as is any
+                   sleep whose duration is computed from a variable.
 
 Scope: ``paddle_tpu/`` and ``bench.py`` for all rules; ``tools/`` for
 time-time only (demo drivers legitimately read their own env knobs).
@@ -175,9 +184,11 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
 
     # names that call the wall clock: `time.time` via any module alias
     # (`import time as _time`), plus bare aliases of
-    # `from time import time [as now]`
+    # `from time import time [as now]`; sleep aliases tracked the same
+    # way for the retry-backoff rule
     time_mod_aliases = {"time"}
     time_func_aliases: Set[str] = set()
+    sleep_func_aliases: Set[str] = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
@@ -188,6 +199,54 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
                 for a in node.names:
                     if a.name == "time":
                         time_func_aliases.add(a.asname or "time")
+                    elif a.name == "sleep":
+                        sleep_func_aliases.add(a.asname or "sleep")
+
+    def _is_sleep(call: ast.Call) -> bool:
+        name = dotted(call.func)
+        if name in sleep_func_aliases:
+            return True
+        if name and "." in name:
+            mod, _, attr = name.rpartition(".")
+            return mod in time_mod_aliases and attr == "sleep"
+        return False
+
+    # sleep-no-backoff: a loop that both catches exceptions (a retry
+    # loop) and sleeps a literal constant between attempts. Innermost
+    # enclosing loop decides, so a constant-sleep POLLING loop nested
+    # inside a retrying outer loop is not flagged.
+    loops = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.While, ast.For, ast.AsyncFor))]
+    inner_loops = {id(sub) for lp in loops for sub in ast.walk(lp)
+                   if sub is not lp
+                   and isinstance(sub, (ast.While, ast.For, ast.AsyncFor))}
+    for lp in loops:
+        nested = [sub for sub in ast.walk(lp)
+                  if sub is not lp
+                  and isinstance(sub, (ast.While, ast.For, ast.AsyncFor))]
+        in_nested = {id(x) for n2 in nested for x in ast.walk(n2)}
+        own = [sub for sub in ast.walk(lp) if id(sub) not in in_nested]
+
+        def _retries(handler: ast.ExceptHandler) -> bool:
+            # a handler that unconditionally leaves the loop (return /
+            # raise / break at its top level) is an exit path, not a
+            # retry — only handlers that fall back into the loop count
+            return not any(isinstance(st, (ast.Return, ast.Raise, ast.Break))
+                           for st in handler.body)
+
+        if not any(isinstance(s, ast.ExceptHandler) and _retries(s)
+                   for s in own):
+            continue
+        for s in own:
+            if isinstance(s, ast.Call) and _is_sleep(s) and s.args and \
+                    isinstance(s.args[0], ast.Constant) and \
+                    isinstance(s.args[0].value, (int, float)):
+                emit(s, "sleep-no-backoff",
+                     "retry loop sleeps a constant between attempts — "
+                     "fixed-interval retries from every client hammer a "
+                     "struggling server in lockstep; back off "
+                     "exponentially (base * 2 ** attempt, the ps/rpc.py "
+                     "pattern) or justify with an ignore")
 
     for node in ast.walk(tree):
         if isinstance(node, ast.Call):
@@ -245,7 +304,7 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
 def run(root: str) -> List[Diagnostic]:
     diags: List[Diagnostic] = []
     all_rules = {"time-time", "bare-except", "mutable-default", "env-read",
-                 "cast-roundtrip"}
+                 "cast-roundtrip", "sleep-no-backoff"}
     for p in walk_py(root, ("paddle_tpu",), ("bench.py",)):
         diags.extend(check_file(p, root, all_rules))
     tools_dir = os.path.join(root, "tools")
