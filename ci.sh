@@ -1,10 +1,14 @@
 #!/usr/bin/env bash
 # CI gate (reference L0's cmake+ctest role): graftlint, native build,
 # fast test gate, then the full matrix.
-# Usage: ./ci.sh [lint|fast|full|chaos]
+# Usage: ./ci.sh [lint|fast|full|chaos|ckpt]
 #   chaos — PS high-availability fast-gate: every failover/replication
 #   test with faultpoints armed (incl. the slow e2e kill-shard runs)
 #   plus the chaos_ps demo with its recovery/overhead acceptance checks.
+#   ckpt — crash-consistent job-checkpoint gate: the full
+#   test_job_checkpoint.py matrix incl. the slow SIGKILL-the-job
+#   mid-save e2e (restart + checksum-fallback + bit-identical resume),
+#   plus the chaos_ckpt demo's save/restore/pause-window measurements.
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -46,6 +50,29 @@ print('chaos_ps OK: recovery p50=%.0fms p95=%.0fms, repl overhead %.1f%%'
   }
   check_chaos || { echo "chaos_ps retry (ambient-load outlier)"; check_chaos; }
   echo "CI OK (chaos)"
+  exit 0
+fi
+
+if [[ "${1:-fast}" == "ckpt" ]]; then
+  echo "== ckpt gate: crash-consistent job checkpointing (SIGKILL e2e) =="
+  # -m "" includes the slow acceptance run: SIGKILL the whole job
+  # (trainers + PS) mid-save under an armed kill-job faultpoint,
+  # restart, fall back past a deliberately-corrupted newest checkpoint
+  # (checksum-detected), resume bit-identical to a fault-free oracle
+  python -m pytest tests/test_job_checkpoint.py -q -m ""
+  echo "== chaos_ckpt demo (save/restore latency + pause window) =="
+  PYTHONPATH="$PWD:${PYTHONPATH:-}" CHAOS_CKPT_TRIALS=3 \
+    CHAOS_CKPT_ROWS=20000 python tools/chaos_ckpt.py | python -c "
+import json, sys
+d = json.loads([l for l in sys.stdin.read().splitlines()
+                if l.startswith('{')][-1])
+assert 'error' not in d, d
+assert d['fallback_ok'], d
+assert d['save_ms_p95'] > 0 and d['restore_ms_p95'] > 0, d
+assert 0 < d['pause_ms_p95'] < d['save_ms_p95'], d  # gate excludes bulk IO
+print('chaos_ckpt OK: save p95=%.0fms restore p95=%.0fms pause p95=%.1fms'
+      % (d['save_ms_p95'], d['restore_ms_p95'], d['pause_ms_p95']))"
+  echo "CI OK (ckpt)"
   exit 0
 fi
 
@@ -135,7 +162,8 @@ print('bench degradation ladder OK')"
     python -m pytest tests/test_table_concurrency.py tests/test_ssd_table.py \
       tests/test_native_table.py tests/test_ps_rpc.py \
       tests/test_rpc_robustness.py tests/test_dist_graph.py \
-      tests/test_rpc_parallel.py tests/test_ps_ha.py -q -m ""
+      tests/test_rpc_parallel.py tests/test_ps_ha.py \
+      tests/test_job_checkpoint.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_tsan_report* 2>/dev/null; then
     echo "TSAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_tsan_report*)"
     exit 1
@@ -153,7 +181,8 @@ print('bench degradation ladder OK')"
     python -m pytest tests/test_table_concurrency.py tests/test_ssd_table.py \
       tests/test_native_table.py tests/test_ps_rpc.py \
       tests/test_rpc_robustness.py tests/test_dist_graph.py \
-      tests/test_rpc_parallel.py tests/test_ps_ha.py -q -m ""
+      tests/test_rpc_parallel.py tests/test_ps_ha.py \
+      tests/test_job_checkpoint.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_asan_report* 2>/dev/null; then
     echo "ASAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_asan_report*)"
     exit 1
@@ -169,7 +198,8 @@ print('bench degradation ladder OK')"
     python -m pytest tests/test_table_concurrency.py tests/test_ssd_table.py \
       tests/test_native_table.py tests/test_ps_rpc.py \
       tests/test_rpc_robustness.py tests/test_dist_graph.py \
-      tests/test_rpc_parallel.py tests/test_ps_ha.py -q -m ""
+      tests/test_rpc_parallel.py tests/test_ps_ha.py \
+      tests/test_job_checkpoint.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_ubsan_report* 2>/dev/null; then
     echo "UBSAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_ubsan_report*)"
     exit 1

@@ -418,12 +418,18 @@ class InMemoryDataset:
         enforce(self._store is not None, "load_into_memory first")
         return self._store.feasigns()
 
-    def batch_iter(self, batch_size: int, drop_last: bool = True
+    def batch_iter(self, batch_size: int, drop_last: bool = True,
+                   start_batch: int = 0
                    ) -> Iterator[Dict[str, Tuple[np.ndarray, np.ndarray]]]:
+        """``start_batch`` is the resume cursor (the job-checkpoint
+        stream position, io/job_checkpoint.py): skip that many leading
+        batches — for the in-memory store a pure index offset, so a
+        restarted job re-enters the stream at the cut for free. The
+        record order must match the saved run's (same seed/shuffle)."""
         enforce(self._store is not None, "load_into_memory first")
         n = self._store.num_records
         end = n - (n % batch_size) if drop_last else n
-        for lo in range(0, end, batch_size):
+        for lo in range(start_batch * batch_size, end, batch_size):
             yield self._store.batch(lo, min(lo + batch_size, n))
 
     def release_memory(self) -> None:
@@ -534,8 +540,12 @@ class QueueDataset:
             files.extend(hit if hit else [p])
         self._files = files
 
-    def batch_iter(self, batch_size: int
+    def batch_iter(self, batch_size: int, start_batch: int = 0
                    ) -> Iterator[Dict[str, Tuple[np.ndarray, np.ndarray]]]:
+        """``start_batch`` resumes the stream at a saved cursor
+        (io/job_checkpoint.py): the skipped batches' lines are read but
+        never slot-parsed — the fast-forward costs IO, not parse."""
+        skip = int(start_batch)
         carry: List[str] = []
         for f in self._files:
             with open(f, "r") as fh:
@@ -546,6 +556,9 @@ class QueueDataset:
                     carry.extend(lines)
                     while len(carry) >= batch_size:
                         chunk, carry = carry[:batch_size], carry[batch_size:]
+                        if skip > 0:
+                            skip -= 1
+                            continue
                         ds = InMemoryDataset(self.slots)
                         ds.load_from_lines([l.rstrip("\n") for l in chunk])
                         self.parse_errors += ds.parse_errors

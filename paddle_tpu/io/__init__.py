@@ -3,8 +3,11 @@ from .checkpoint import (graft_into, load, load_checkpoint,
                          save_train_state)
 from .inference import (InferencePredictor, load_inference_model,
                         save_inference_model)
+from .job_checkpoint import (CorruptCheckpointError, JobCheckpointManager,
+                             RestoredJob, verify_checkpoint)
 
 __all__ = ["save", "load", "save_checkpoint", "load_checkpoint",
            "save_train_state", "load_train_state", "graft_into",
            "save_inference_model", "load_inference_model",
-           "InferencePredictor"]
+           "InferencePredictor", "JobCheckpointManager", "RestoredJob",
+           "CorruptCheckpointError", "verify_checkpoint"]

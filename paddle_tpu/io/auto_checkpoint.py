@@ -13,12 +13,12 @@ from __future__ import annotations
 
 import json
 import os
-import shutil
 import time
 from typing import Any, Callable, Dict, Iterator, Optional
 
 from ..core.enforce import enforce
 from . import checkpoint as ckpt
+from .fs import gc_snapshots, publish_atomic, scan_snapshot_ids
 
 __all__ = ["TrainEpochRange", "train_epoch_range", "CheckpointSaver"]
 
@@ -33,24 +33,21 @@ class CheckpointSaver:
         os.makedirs(root, exist_ok=True)
 
     def _ids(self):
-        out = []
-        for name in os.listdir(self.root):
-            if name.startswith("ckpt_") and not name.endswith(".tmp"):
-                try:
-                    out.append(int(name.split("_")[1]))
-                except ValueError:
-                    pass
-        return sorted(out)
+        return scan_snapshot_ids(self.root)
 
     def save(self, payload: Any, meta: Dict[str, Any]) -> int:
-        no = (self._ids()[-1] + 1) if self._ids() else 0
+        ids = self._ids()   # one directory scan, not one per use
+        no = (ids[-1] + 1) if ids else 0
         tmp = os.path.join(self.root, f"ckpt_{no}.tmp")
         final = os.path.join(self.root, f"ckpt_{no}")
         os.makedirs(tmp, exist_ok=True)
         ckpt.save(payload, os.path.join(tmp, "state"))
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(meta, f)
-        os.replace(tmp, final)     # atomic publish
+        # fsync files + dirs BEFORE the rename publishes: os.replace
+        # alone can land while the data blocks are still dirty page
+        # cache — a crash then publishes a directory of torn files
+        publish_atomic(tmp, final)
         self.clean_redundant()
         return no
 
@@ -65,10 +62,7 @@ class CheckpointSaver:
         return no, ckpt.load(os.path.join(d, "state")), meta
 
     def clean_redundant(self) -> None:
-        ids = self._ids()
-        for no in ids[:-self.max_keep] if self.max_keep > 0 else []:
-            shutil.rmtree(os.path.join(self.root, f"ckpt_{no}"),
-                          ignore_errors=True)
+        gc_snapshots(self.root, self.max_keep)
 
 
 class TrainEpochRange:
@@ -77,6 +71,26 @@ class TrainEpochRange:
     State to snapshot is registered via ``set_state_getter/setter`` (the
     reference hooks exe/program state the same way); ``save()`` may be
     called mid-epoch for step-level granularity."""
+
+    _needs_step_skip = False
+    _cursor_consumed = False
+
+    @property
+    def step_in_epoch(self) -> int:
+        """Completed steps of the (re-entered) epoch. READING it counts
+        as consuming the cursor — the caller is handling the skip
+        themselves, whether they read BEFORE the epoch loop or inside
+        the epoch body; callers that neither read it nor use
+        :meth:`steps` on a mid-epoch resume fail loudly at the epoch's
+        end instead of silently re-training the completed steps."""
+        self._needs_step_skip = False
+        self._cursor_consumed = True
+        return self._step_in_epoch
+
+    @step_in_epoch.setter
+    def step_in_epoch(self, v: int) -> None:
+        self._step_in_epoch = int(v)
+        self._cursor_consumed = False  # a fresh cursor is unconsumed
 
     def __init__(self, max_epoch_num: int, name: str,
                  checkpoint_dir: Optional[str] = None,
@@ -109,15 +123,49 @@ class TrainEpochRange:
             self._pending_restore = None
 
     def save(self, epoch: int, step: int = 0) -> None:
+        """``step > 0`` marks a MID-epoch snapshot: a restart re-enters
+        ``epoch`` itself (not ``epoch + 1``) with ``step_in_epoch`` set,
+        and :meth:`steps` skips the completed steps."""
         enforce(self._get_state is not None, "set_state_getter first")
         self._saver.save(self._get_state(), {"epoch": epoch, "step": step,
                                              "time": time.time()})
         self._last_save = time.monotonic()
 
+    def steps(self, iterable) -> Iterator:
+        """Wrap the inner step loop: ``for step, item in r.steps(data)``.
+        On the epoch a mid-epoch snapshot re-entered, the first
+        ``step_in_epoch`` items are skipped (they trained before the
+        crash); every other epoch passes through untouched."""
+        skip, self._step_in_epoch = self._step_in_epoch, 0
+        self._needs_step_skip = False
+        self._cursor_consumed = True
+        for i, item in enumerate(iterable):
+            if i < skip:
+                continue
+            yield i, item
+
     def __iter__(self) -> Iterator[int]:
-        start = self.restored_epoch + 1
+        # a mid-epoch snapshot (step > 0) re-enters ITS epoch partway —
+        # restarting it from scratch would re-train the completed steps
+        resume_mid = self._step_in_epoch > 0
+        start = (self.restored_epoch if resume_mid
+                 else self.restored_epoch + 1)
+        # a caller may consume the cursor BEFORE this loop starts (read
+        # step_in_epoch, skip the steps themselves) — re-arming the
+        # guard here would kill that correct resume at the epoch's end
+        self._needs_step_skip = resume_mid and not self._cursor_consumed
         for epoch in range(start, self.max_epoch_num):
             yield epoch
+            # a mid-epoch resume whose caller ran a plain inner loop
+            # (no steps()/step_in_epoch consumption) has just RE-TRAINED
+            # the completed steps on top of the restored state — fail
+            # loudly now rather than silently corrupt the weights
+            enforce(not self._needs_step_skip,
+                    f"resumed epoch {epoch} mid-way (step_in_epoch was "
+                    "set) but the completed steps were never skipped — "
+                    "wrap the inner loop in r.steps(iterable) or consume "
+                    "r.step_in_epoch before training")
+            self._step_in_epoch = 0   # later epochs start clean
             if self._get_state is not None and (
                     self._inter <= 0 or
                     time.monotonic() - self._last_save >= self._inter):

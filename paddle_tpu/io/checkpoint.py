@@ -95,8 +95,11 @@ def _paths(path: str) -> Tuple[str, str]:
     return base + ".npz", base + ".meta.json"
 
 
-def save(obj: Any, path: str) -> None:
-    """Save any pytree (dicts/lists/tuples of arrays + scalars)."""
+def save(obj: Any, path: str) -> Tuple[str, str]:
+    """Save any pytree (dicts/lists/tuples of arrays + scalars).
+    Returns the two files written ``(npz_path, meta_path)`` so callers
+    that need durability/integrity (auto_checkpoint's fsync-before-
+    publish, job_checkpoint's CRC32C manifest) can address them."""
     arrays: List[np.ndarray] = []
     spec = _encode(obj, arrays)
     npz_path, meta_path = _paths(path)
@@ -104,6 +107,7 @@ def save(obj: Any, path: str) -> None:
     np.savez(npz_path, **{f"a{i}": a for i, a in enumerate(arrays)})
     with open(meta_path, "w") as f:
         json.dump({"format": "paddle_tpu.v1", "tree": spec}, f)
+    return npz_path, meta_path
 
 
 def load(path: str) -> Any:
@@ -118,9 +122,10 @@ def load(path: str) -> Any:
     return _decode(meta["tree"], arrays)
 
 
-def save_checkpoint(path: str, state: Any, opt_state: Any = None, step: int = 0) -> None:
+def save_checkpoint(path: str, state: Any, opt_state: Any = None,
+                    step: int = 0) -> Tuple[str, str]:
     """Save a full training snapshot (model + optimizer + progress)."""
-    save({"model": state, "opt": opt_state, "step": int(step)}, path)
+    return save({"model": state, "opt": opt_state, "step": int(step)}, path)
 
 
 def load_checkpoint(path: str) -> Dict[str, Any]:
@@ -129,7 +134,7 @@ def load_checkpoint(path: str) -> Dict[str, Any]:
 
 
 def save_train_state(path: str, state: Any, opt_state: Any = None,
-                     rng=None, step: int = 0) -> None:
+                     rng=None, step: int = 0) -> Tuple[str, str]:
     """Trainer snapshot convention shared by the distributed trainers
     (hybrid, auto-parallel Engine): model state + optimizer + rng stream
     + step under the standard {"model", "opt", "step"} schema. The rng
@@ -139,8 +144,8 @@ def save_train_state(path: str, state: Any, opt_state: Any = None,
     payload = {"state": jax.device_get(state)}
     if rng is not None:
         payload["rng"] = jax.device_get(jax.random.key_data(rng))
-    save_checkpoint(path, payload,
-                    opt_state=jax.device_get(opt_state), step=step)
+    return save_checkpoint(path, payload,
+                           opt_state=jax.device_get(opt_state), step=step)
 
 
 def load_train_state(path: str) -> Dict[str, Any]:

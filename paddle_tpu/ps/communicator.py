@@ -194,10 +194,14 @@ class _BaseCommunicator:
                 "communicator push thread died earlier; queued gradients "
                 "remain undrained — restart the communicator")
 
-    def barrier(self) -> None:
-        """Block until queued sends hit the PS AND in-flight prefetch
-        pulls complete (HalfAsync/Sync join). Raises a failure the
-        background push thread hit (nothing may be silently lost)."""
+    def quiesce(self) -> None:
+        """LOCAL traffic barrier: block until THIS trainer's queued
+        sends have hit the PS and its in-flight prefetch pulls are done,
+        and surface any background push failure. Unlike :meth:`barrier`
+        this never involves the other trainers — it is the
+        consistent-cut prerequisite the job checkpoint takes
+        (io/job_checkpoint.py): one trainer quiescing for a snapshot
+        must not rendezvous on a barrier table the others aren't at."""
         while not self._all_empty():
             if self._push_thread_dead:
                 break  # the push thread is dead; don't spin forever
@@ -205,6 +209,12 @@ class _BaseCommunicator:
         self._drained.wait(timeout=10)
         self._drain_pulls()
         self.check_error()
+
+    def barrier(self) -> None:
+        """Block until queued sends hit the PS AND in-flight prefetch
+        pulls complete (HalfAsync/Sync join). Raises a failure the
+        background push thread hit (nothing may be silently lost)."""
+        self.quiesce()
 
     def _all_empty(self) -> bool:
         return all(q.empty() for q in self._queues.values())

@@ -18,8 +18,17 @@ Actions (the ISSUE 4 vocabulary):
   connection drops mid-protocol), then raise the transport error.
 - ``kill-shard`` — invoke the site's ``kill`` context callable (the
   hosting server stops, like a SIGKILL'd shard host).
+- ``kill-job`` — same dispatch as ``kill-shard`` (invoke ``kill``) under
+  the name the checkpoint sites use: their ``kill`` callable SIGKILLs
+  the whole process (``io/job_checkpoint.py`` — preemption mid-save).
 - ``corrupt-epoch`` — return the spec so the site substitutes
   ``spec.param`` for the real epoch (stale-primary fencing tests).
+- ``truncate-artifact`` — chop ``param`` bytes (default: half) off the
+  end of the file named by the site's ``path`` context (torn write: the
+  crash landed between the data write and its fsync).
+- ``flip-bytes`` — XOR ``0xFF`` into the byte at offset ``param``
+  (default: the middle) of the site's ``path`` file (silent media/bus
+  corruption under an intact length).
 
 Scheduling: a spec fires once ``after`` matching hits have been seen
 (default 1 = first hit), then every ``every`` further hits (0 = only
@@ -33,6 +42,7 @@ Flag format (``FLAGS_ps_faultpoints``):
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -48,7 +58,8 @@ __all__ = ["FaultSpec", "faultpoint", "arm_faultpoint", "disarm_faultpoints",
 # from both the transport sites and the HA harness)
 
 _ACTIONS = frozenset({"delay-ms", "drop-frame", "close-socket", "kill-shard",
-                      "corrupt-epoch"})
+                      "kill-job", "corrupt-epoch", "truncate-artifact",
+                      "flip-bytes"})
 
 
 class FaultInjected(PsTransportError):
@@ -170,9 +181,28 @@ def faultpoint(name: str, cmd: Optional[int] = None,
         if callable(close):
             close()
         raise FaultInjected(f"faultpoint {name}: socket closed mid-call")
-    if action == "kill-shard":
+    if action in ("kill-shard", "kill-job"):
         kill = ctx.get("kill")
         if callable(kill):
             kill()
         return spec
+    if action == "truncate-artifact":
+        path = ctx.get("path")
+        if path and os.path.exists(path):
+            size = os.path.getsize(path)
+            cut = spec.param if spec.param > 0 else max(1, size // 2)
+            with open(path, "r+b") as f:
+                f.truncate(max(0, size - cut))
+        return None
+    if action == "flip-bytes":
+        path = ctx.get("path")
+        if path and os.path.exists(path) and os.path.getsize(path) > 0:
+            size = os.path.getsize(path)
+            off = min(spec.param if spec.param > 0 else size // 2, size - 1)
+            with open(path, "r+b") as f:
+                f.seek(off)
+                b = f.read(1)
+                f.seek(off)
+                f.write(bytes([b[0] ^ 0xFF]))
+        return None
     return spec  # corrupt-epoch: the site applies spec.param

@@ -77,6 +77,7 @@ __all__ = [
     "HAServer",
     "FailoverCoordinator",
     "HACluster",
+    "CheckpointGate",
     "drain_remote",
     "faultpoint",
     "arm_faultpoint",
@@ -586,6 +587,68 @@ def drain_remote(primary_ep: str, backup_eps: List[str],
 
 
 # ---------------------------------------------------------------------------
+# consistent-cut gate (job checkpoint)
+# ---------------------------------------------------------------------------
+
+class CheckpointGate:
+    """Mutation gate for a globally consistent job snapshot
+    (io/job_checkpoint.JobCheckpointManager): on entry every shard
+    PRIMARY pauses mutations (the same ``pause_mutations`` primitive the
+    rejoin full-sync uses — writers block within their IO deadline, and
+    the pause nests safely with a concurrent full-sync's own pair), and
+    for a ``sync`` cluster replication is drained first so the cut is
+    also primary ≡ backup. Reads (kSaveAll, kDenseSnap, kGlobalStep
+    n=0) stay ungated — the capture streams them off the paused
+    primaries. Exit resumes mutations even when the capture raised.
+
+    Construct from an :class:`HACluster` (``cluster.checkpoint_gate()``)
+    or from an explicit list of in-process ``NativePsServer`` handles
+    (plain non-HA deployments checkpoint too).
+    """
+
+    def __init__(self, cluster: Optional["HACluster"] = None,
+                 servers: Optional[list] = None,
+                 drain: bool = True, drain_timeout: float = 30.0) -> None:
+        enforce((cluster is None) != (servers is None),
+                "CheckpointGate needs exactly one of cluster= / servers=")
+        self.cluster = cluster
+        self.servers = list(servers) if servers is not None else None
+        self.drain = drain
+        self.drain_timeout = drain_timeout
+        self._paused: list = []
+
+    def _targets(self) -> list:
+        if self.servers is not None:
+            return self.servers
+        return [self.cluster.primary(si).server
+                for si in range(self.cluster.num_shards)]
+
+    def __enter__(self) -> "CheckpointGate":
+        targets = self._targets()
+        paused = []
+        try:
+            for srv in targets:
+                srv.pause_mutations(True)
+                paused.append(srv)
+            if self.drain and self.cluster is not None and self.cluster.sync:
+                # draining while paused works because kReplicate frames
+                # apply on the BACKUPS, which this gate does not pause —
+                # after the drain the backups hold exactly the cut
+                self.cluster.drain(self.drain_timeout)
+        except BaseException:
+            for srv in reversed(paused):
+                srv.pause_mutations(False)
+            raise
+        self._paused = paused
+        return self
+
+    def __exit__(self, *exc) -> None:
+        paused, self._paused = self._paused, []
+        for srv in reversed(paused):
+            srv.pause_mutations(False)
+
+
+# ---------------------------------------------------------------------------
 # server wrapper + coordinator
 # ---------------------------------------------------------------------------
 
@@ -885,6 +948,12 @@ class HACluster:
 
     def router(self, **kw) -> HARouter:
         return HARouter(self.store, self.job_id, **kw)
+
+    def checkpoint_gate(self, **kw) -> CheckpointGate:
+        """The consistent-cut mutation gate a
+        :class:`~paddle_tpu.io.job_checkpoint.JobCheckpointManager`
+        holds while capturing this cluster's tables."""
+        return CheckpointGate(cluster=self, **kw)
 
     def client(self, with_router: bool = True, **router_kw) -> RpcPsClient:
         cli = RpcPsClient(self.routing.primaries(),

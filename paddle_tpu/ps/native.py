@@ -588,6 +588,9 @@ def _configure_sst(lib: ctypes.CDLL) -> None:
     lib.sst_load_file.restype = ctypes.c_int64
     lib.sst_load_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                   ctypes.c_int32]
+    if hasattr(lib, "sst_digest"):
+        lib.sst_digest.restype = ctypes.c_uint64
+        lib.sst_digest.argtypes = [ctypes.c_void_p]
 
 
 class SsdTableEngine:
@@ -675,6 +678,18 @@ class SsdTableEngine:
 
     def flush(self) -> None:
         self._lib.sst_flush(self._h)
+
+    def digest(self) -> int:
+        """Order-independent content digest over BOTH tiers
+        (csrc sst_digest: hot-tier table_digest + per-row hashes of the
+        live disk records) — equal to a RAM replica's digest for the
+        same logical rows. Was bound C-side since the HA PR but never
+        exposed here; the job checkpoint's capture/restore digest
+        verification needs it."""
+        if not hasattr(self._lib, "sst_digest"):
+            raise RuntimeError("stale native library lacks sst_digest — "
+                               "rebuild paddle_tpu/csrc")
+        return int(self._lib.sst_digest(self._h))
 
     def save_items(self, mode: int) -> Tuple[np.ndarray, np.ndarray]:
         with self._save_lock:

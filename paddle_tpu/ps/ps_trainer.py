@@ -556,20 +556,75 @@ class CtrStreamTrainer:
             return new_params, new_opt, loss, emb_grad
 
         self._step = step
+        #: completed-batch cursor of the LAST (or current)
+        #: train_from_dataset run — the stream position a job
+        #: checkpoint records and a restarted job resumes from
+        self.batches_done = 0
+
+    # -- job checkpoint surface (io/job_checkpoint.py) --------------------
+
+    def train_state(self) -> Dict[str, Any]:
+        """The dense tier of a job snapshot: params + optimizer state
+        (save_train_state schema; no rng — the stream step is
+        deterministic given the pulled rows)."""
+        return {"state": self.params, "opt": self.opt_state}
+
+    def restore_train_state(self, dense: Dict[str, Any]) -> None:
+        """Inverse of :meth:`train_state` — accepts the dict
+        ``load_train_state``/``RestoredJob.dense`` returns."""
+        self.params = dense["state"]
+        self.opt_state = dense["opt"]
 
     def train_from_dataset(self, dataset, batch_size: int = 512,
-                           drop_last: bool = True) -> Dict[str, float]:
+                           drop_last: bool = True,
+                           start_batch: "int | Dict[str, Any]" = 0,
+                           checkpoint=None, checkpoint_every: int = 0
+                           ) -> Dict[str, float]:
+        """``start_batch`` re-enters the stream at a saved cursor —
+        pass ``RestoredJob.cursor`` itself (the dict form validates
+        that ``batch_size`` matches the one the cursor was recorded
+        under; a batch offset at a different size is a WRONG record
+        offset) or a raw batch index; ``checkpoint`` (a
+        JobCheckpointManager this trainer's table(s)
+        are registered with) snapshots the whole job every
+        ``checkpoint_every`` completed batches: the communicator is
+        quiesced first (no queued push or in-flight prefetch pull
+        straddles the cut), then the manager gates PS mutations and
+        captures tables + dense state + this cursor as one cut. The
+        resume-exact contract (restart bit-identical to an oracle)
+        holds in sync mode (pull_ahead 0); async modes resume within
+        their usual staleness envelope."""
         import inspect
         import time
         from collections import deque
 
+        if isinstance(start_batch, dict):
+            # the saved cursor: its batch offset counts batches OF THE
+            # RECORDED SIZE — resuming at a different batch_size would
+            # silently re-enter the stream at the wrong record offset
+            # (or re-train records), exactly the silent-wrong-position
+            # class the checkpoint checksums exist to rule out
+            saved_bs = start_batch.get("batch_size")
+            enforce(saved_bs is None or int(saved_bs) == int(batch_size),
+                    f"cursor was recorded at batch_size={saved_bs}; "
+                    f"resuming at batch_size={batch_size} re-enters the "
+                    "stream at the wrong record offset — resume with "
+                    "the saved batch_size")
+            start_batch = int(start_batch.get("batch", 0))
         S = len(self.sparse_slots)
         slot_ids = np.tile(np.arange(S, dtype=np.int32), batch_size)
-        # streaming QueueDataset.batch_iter has no drop_last
-        kw = ({"drop_last": drop_last} if "drop_last" in
-              inspect.signature(dataset.batch_iter).parameters else {})
+        # streaming QueueDataset.batch_iter has no drop_last; older
+        # dataset shims may predate the start_batch cursor
+        params = inspect.signature(dataset.batch_iter).parameters
+        kw = {k: v for k, v in (("drop_last", drop_last),
+                                ("start_batch", start_batch))
+              if k in params}
+        enforce(start_batch == 0 or "start_batch" in params,
+                f"{type(dataset).__name__}.batch_iter has no start_batch "
+                "cursor — cannot resume mid-stream")
         stats = _PassStats()
         depth = self.pull_ahead
+        self.batches_done = int(start_batch)
 
         def _prep(batch):
             keys = _slot_tagged_keys(batch, self.sparse_slots)
@@ -611,6 +666,8 @@ class CtrStreamTrainer:
             stats.steps += 1
             stats.samples += int(labels.shape[0])
             stats.loss_sum += float(loss)
+            self.batches_done += 1
+            self._maybe_checkpoint(checkpoint, checkpoint_every, batch_size)
 
         t0 = time.perf_counter()
         window: deque = deque()  # batches with an issued (or due) pull
@@ -639,3 +696,17 @@ class CtrStreamTrainer:
             "samples": float(stats.samples),
             "samples_per_sec": stats.samples / max(dt, 1e-9),
         }
+
+    def _maybe_checkpoint(self, checkpoint, every: int,
+                          batch_size: int) -> None:
+        if checkpoint is None or every <= 0 or \
+                self.batches_done % every != 0:
+            return
+        if self.communicator is not None:
+            # local quiesce, NOT barrier(): sync mode's barrier is a
+            # cross-trainer rendezvous the others aren't at
+            self.communicator.quiesce()
+        checkpoint.save(step=self.batches_done,
+                        cursor={"batch": self.batches_done,
+                                "batch_size": int(batch_size)},
+                        dense=self.train_state())

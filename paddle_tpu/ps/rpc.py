@@ -201,6 +201,8 @@ class NativePsServer:
         self._h = self._lib.pss_create(port, n_trainers)
         enforce(self._h is not None, f"failed to bind PS server port {port}")
         self.port = int(self._lib.pss_port(self._h))
+        self._pause_mu = threading.Lock()
+        self._pause_depth = 0
 
     def stop(self) -> None:
         if self._h:
@@ -256,8 +258,21 @@ class NativePsServer:
 
     def pause_mutations(self, paused: bool) -> None:
         """Quiesce writers (they block, within their IO deadline) while
-        a snapshot + seq rebase takes a consistent cut."""
-        self._lib.pss_pause_mutations(self._h, 1 if paused else 0)
+        a snapshot + seq rebase takes a consistent cut. Pause/resume
+        pairs NEST (depth-counted): a job-checkpoint gate
+        (io/job_checkpoint.py) overlapping a rejoin full-sync
+        (ha.ReplicationManager._full_sync) must not have the inner
+        pair's resume release the outer gate mid-capture."""
+        with self._pause_mu:
+            # validate BEFORE mutating: an unmatched resume must not
+            # leave the counter at -1 (the next legitimate pause would
+            # then "reach" depth 0 and never pause the C side — a
+            # silently inconsistent checkpoint cut)
+            enforce(paused or self._pause_depth > 0,
+                    "pause_mutations(False) without a matching pause")
+            self._pause_depth += 1 if paused else -1
+            self._lib.pss_pause_mutations(
+                self._h, 1 if self._pause_depth > 0 else 0)
 
     @property
     def epoch(self) -> int:
@@ -982,6 +997,38 @@ class RpcPsClient(PSClient):
 
     # -- save/load (per-server shard files; accessor text format) ---------
 
+    def _save_all_items(self, server: int, table_id: int, mode: int = 0
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+        """One server's full-row export via the single atomic kSaveAll
+        command (snapshot+stream — concurrent savers cannot interleave a
+        begin/fetch pair): (keys [n] u64, values [n, full_dim] f32)."""
+        full_dim = self._dims(table_id)[2]
+        cnt, resp = self._shard_op(server, lambda c: c.check(
+            _SAVE_ALL, table_id, aux=mode,
+            timeout_ms=_long_ms(), retries=0))
+        keys = np.frombuffer(resp[: cnt * 8], np.uint64)
+        values = np.frombuffer(resp[cnt * 8:], np.float32).reshape(
+            cnt, full_dim)
+        return keys, values
+
+    def snapshot_items(self, table_id, mode: int = 0
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+        """Whole-table export staged in RAM across every server —
+        the job-checkpoint capture path (io/job_checkpoint.py):
+        binary-exact full rows (keys [n] u64, values [n, full_dim]
+        f32), so the restored table digests identical to the capture.
+        Take it under a mutation gate (ha.CheckpointGate) for a
+        consistent cut; kSaveAll itself reads a paused primary fine.
+        Servers export in PARALLEL (_fanout) — the gate hold, i.e. the
+        training stall, is max(shards), not sum(shards)."""
+        parts = self._fanout(
+            [lambda s=s: self._save_all_items(s, table_id, mode)
+             for s in range(self.num_servers)])  # zero-arg tasks:
+        # _save_all_items is already _shard_op-wrapped (failover replay)
+        keys = np.concatenate([k for k, _ in parts])
+        values = np.concatenate([v for _, v in parts])
+        return keys, values
+
     def save(self, table_id, dirname, mode=0):
         """Same on-disk format as MemorySparseTable.save (format_shard_row
         + meta.json) — checkpoints are portable between the local and rpc
@@ -989,18 +1036,12 @@ class RpcPsClient(PSClient):
         import json
 
         os.makedirs(dirname, exist_ok=True)
-        full_dim = self._dims(table_id)[2]
         xd = self._embedx_dim(table_id)
-        ed = full_dim - 7 - xd - self._embedx_state_dim(table_id)
+        ed = self._dims(table_id)[2] - 7 - xd - self._embedx_state_dim(table_id)
         total = 0
         for s in range(self.num_servers):
-            # single atomic command: snapshot+stream (concurrent savers
-            # cannot interleave a begin/fetch pair)
-            cnt, resp = self._shard_op(s, lambda c: c.check(
-                _SAVE_ALL, table_id, aux=mode,
-                timeout_ms=_long_ms(), retries=0))
-            keys = np.frombuffer(resp[: cnt * 8], np.uint64)
-            values = np.frombuffer(resp[cnt * 8 :], np.float32).reshape(cnt, full_dim)
+            keys, values = self._save_all_items(s, table_id, mode)
+            cnt = len(keys)
             path = os.path.join(dirname, f"part-{s:05d}.shard")
             with open(path, "w") as f:
                 for j in range(cnt):
@@ -1257,6 +1298,9 @@ class RemoteSparseTable:
 
     def load_local(self, dirname: str) -> int:
         return self._client.load_local(self._table_id, dirname)
+
+    def snapshot_items(self, mode: int = 0):
+        return self._client.snapshot_items(self._table_id, mode=mode)
 
     def spill(self, hot_budget: int) -> int:
         return self._client.spill(self._table_id, hot_budget)

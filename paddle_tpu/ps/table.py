@@ -485,6 +485,23 @@ class MemorySparseTable:
             return self._native.size()
         return sum(len(sh.index) for sh in self._shards)
 
+    def snapshot_items(self, mode: int = _SAVE_MODE_ALL
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+        """The save-path export staged in RAM: (keys [n] u64, full rows
+        [n, full_dim] f32) after the accessor's mode filter — one
+        consistent per-shard sweep. This is the job-checkpoint capture
+        primitive (io/job_checkpoint.py): binary-exact, unlike
+        :meth:`save`'s %.8g text rendering, so a restored table digests
+        identical to the captured one."""
+        if self._native is not None:
+            return self._native.save_items(mode)
+        per = [(sh.save_items(mode), sh) for sh in self._shards]
+        keys = (np.concatenate([k for (k, _), _ in per])
+                if per else np.zeros(0, np.uint64))
+        values = (np.concatenate([sh.full_rows(r) for (_, r), sh in per])
+                  if per else np.zeros((0, self.full_dim), np.float32))
+        return keys, values
+
     def digest(self) -> int:
         """Order-independent content digest — the same FNV-over-rows sum
         the servers answer for kDigest (pstpu::row_hash), so a local
@@ -493,12 +510,7 @@ class MemorySparseTable:
         save snapshot with the identical per-row hash."""
         if self._native is not None:
             return self._native.digest()
-        per = [(sh.save_items(_SAVE_MODE_ALL), sh) for sh in self._shards]
-        keys = (np.concatenate([k for (k, _), _ in per])
-                if per else np.zeros(0, np.uint64))
-        values = (np.concatenate([sh.full_rows(r) for (_, r), sh in per])
-                  if per else np.zeros((0, self.full_dim), np.float32))
-        return row_digest(keys, values)
+        return row_digest(*self.snapshot_items(_SAVE_MODE_ALL))
 
     def flush(self) -> None:
         pass  # synchronous writes; parity no-op
@@ -531,14 +543,7 @@ class MemorySparseTable:
         os.makedirs(dirname, exist_ok=True)
         conv = converter if converter is not None else self.config.converter
         suffix, open_w, _ = converter_entry(conv)
-        if self._native is not None:
-            keys, values = self._native.save_items(mode)
-        else:
-            per = [(sh.save_items(mode), sh) for sh in self._shards]
-            keys = (np.concatenate([k for (k, _), _ in per])
-                    if per else np.zeros(0, np.uint64))
-            values = (np.concatenate([sh.full_rows(r) for (_, r), sh in per])
-                      if per else np.zeros((0, self.full_dim), np.float32))
+        keys, values = self.snapshot_items(mode)
         shard_of = (keys % np.uint64(self.config.shard_num)).astype(np.int64)
         order = np.argsort(shard_of, kind="stable")
         bounds = np.searchsorted(shard_of[order],

@@ -86,6 +86,85 @@ def test_train_epoch_range_resumes(tmp_path):
     assert state["w"] == 5.0       # restored 3.0 + two more epochs
 
 
+def test_train_epoch_range_resumes_mid_epoch_steps(tmp_path):
+    """A MID-epoch snapshot (save(epoch, step)) must re-enter ITS epoch
+    and skip exactly the completed steps — not restart the epoch from
+    scratch (the pre-fix behavior re-trained them) and not skip to the
+    next epoch (which would silently drop the unfinished tail)."""
+    state = {"w": 0.0}
+    steps_per_epoch = 4
+
+    def run(crash_at=None):
+        trained = []  # (epoch, step) actually trained this run
+        r = TrainEpochRange(2, "midjob", checkpoint_dir=str(tmp_path))
+        r.set_state_getter(lambda: dict(state))
+        r.set_state_setter(lambda s: state.update(s))
+        for epoch in r:
+            for step, _ in r.steps(range(steps_per_epoch)):
+                state["w"] += 1.0
+                trained.append((epoch, step))
+                if crash_at is not None and (epoch, step) == crash_at:
+                    r.save(epoch, step=step + 1)  # steps 0..step done
+                    raise RuntimeError("simulated crash")
+        return trained
+
+    with pytest.raises(RuntimeError):
+        run(crash_at=(1, 1))
+    assert state["w"] == 6.0  # epoch 0 (4 steps) + epoch-1 steps 0-1
+    state["w"] = -100.0
+    trained = run()
+    # resume re-enters epoch 1 at step 2: no step replayed, none dropped
+    assert trained == [(1, 2), (1, 3)]
+    assert state["w"] == 8.0
+
+
+def test_train_epoch_range_mid_epoch_resume_requires_cursor(tmp_path):
+    """A mid-epoch resume whose caller runs a PLAIN inner loop (neither
+    r.steps() nor a step_in_epoch read) silently re-trains the
+    completed steps — the range must fail loudly at that epoch's end
+    instead of corrupting the restored weights."""
+    state = {"w": 0.0}
+    r = TrainEpochRange(3, "midguard", checkpoint_dir=str(tmp_path))
+    r.set_state_getter(lambda: dict(state))
+    r.set_state_setter(lambda s: state.update(s))
+    r.save(0, step=2)   # mid-epoch snapshot of epoch 0, then "crash"
+
+    r2 = TrainEpochRange(3, "midguard", checkpoint_dir=str(tmp_path))
+    r2.set_state_getter(lambda: dict(state))
+    r2.set_state_setter(lambda s: state.update(s))
+    with pytest.raises(Exception, match="never skipped"):
+        for epoch in r2:
+            pass   # plain loop: cursor never consumed
+
+    # consuming the cursor (reading step_in_epoch) satisfies the guard
+    r3 = TrainEpochRange(3, "midguard", checkpoint_dir=str(tmp_path))
+    r3.set_state_getter(lambda: dict(state))
+    r3.set_state_setter(lambda s: state.update(s))
+    seen = []
+    for epoch in r3:
+        seen.append((epoch, r3.step_in_epoch))
+    assert seen[0] == (0, 2) and [e for e, _ in seen] == [0, 1, 2]
+
+
+def test_train_epoch_range_cursor_consumed_before_loop(tmp_path):
+    """Reading step_in_epoch BEFORE the epoch loop (the documented
+    consume-before-the-loop pattern: the caller skips the completed
+    steps themselves) must satisfy the skip guard — __iter__ must not
+    re-arm it and kill the correct resume at the epoch's end."""
+    state = {"w": 0.0}
+    r = TrainEpochRange(2, "preloop", checkpoint_dir=str(tmp_path))
+    r.set_state_getter(lambda: dict(state))
+    r.set_state_setter(lambda s: state.update(s))
+    r.save(0, step=2)   # mid-epoch snapshot of epoch 0, then "crash"
+
+    r2 = TrainEpochRange(2, "preloop", checkpoint_dir=str(tmp_path))
+    r2.set_state_getter(lambda: dict(state))
+    r2.set_state_setter(lambda s: state.update(s))
+    assert r2.step_in_epoch == 2   # consumed before the loop starts
+    seen = [epoch for epoch in r2]   # must NOT raise "never skipped"
+    assert seen == [0, 1]
+
+
 # -- elastic ----------------------------------------------------------------
 
 

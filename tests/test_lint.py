@@ -571,6 +571,91 @@ def test_cast_roundtrip_ignore_comment(tmp_path):
     assert "cast-roundtrip" not in _rules(diags)
 
 
+def test_atomic_publish_unfsynced_replace_flagged(tmp_path):
+    # the torn-checkpoint shape: write + rename-publish, no fsync
+    diags = _conv_diags(tmp_path, """
+        import json
+        import os
+
+        def publish(payload, tmp, final):
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, final)
+    """)
+    assert _rules(diags) == {"atomic-publish"}
+
+
+def test_atomic_publish_rename_from_import_alias_flagged(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        from os import rename as mv
+
+        def publish(tmp, final):
+            open(tmp, "w").write("x")
+            mv(tmp, final)
+    """)
+    assert _rules(diags) == {"atomic-publish"}
+
+
+def test_atomic_publish_fsynced_ok(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        import os
+
+        def publish(tmp, final):
+            with open(tmp, "w") as f:
+                f.write("x")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, final)
+            fd = os.open(os.path.dirname(final), os.O_RDONLY)
+            os.fsync(fd)
+            os.close(fd)
+    """)
+    assert "atomic-publish" not in _rules(diags)
+
+
+def test_atomic_publish_fsync_helper_counts_as_evidence(tmp_path):
+    # the io/fs.py helpers carry fsync in their name — calling them is
+    # the sanctioned pattern, not a violation
+    diags = _conv_diags(tmp_path, """
+        import os
+
+        from paddle_tpu.io.fs import fsync_tree
+
+        def publish(tmp, final):
+            fsync_tree(tmp)
+            os.replace(tmp, final)
+    """)
+    assert "atomic-publish" not in _rules(diags)
+
+
+def test_atomic_publish_module_scope_and_ignore(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        import os
+
+        os.replace("a.tmp", "a")
+    """)
+    assert _rules(diags) == {"atomic-publish"}
+    # module-scope evidence must itself be module-scope: an fsync
+    # buried in a (never-called) function body is not evidence for an
+    # import-time publish
+    diags = _conv_diags(tmp_path, """
+        import os
+
+        def helper(p):
+            os.fsync(p)
+
+        os.replace("a.tmp", "a")
+    """)
+    assert _rules(diags) == {"atomic-publish"}
+    diags = _conv_diags(tmp_path, """
+        import os
+
+        def swap_scratch(a, b):
+            os.replace(a, b)  # graftlint: ignore[atomic-publish] — tmp scratch, not a durable publish
+    """)
+    assert "atomic-publish" not in _rules(diags)
+
+
 # -- allowlist + driver -----------------------------------------------------
 
 def test_allowlist_filters_and_reports_stale(tmp_path):

@@ -1,0 +1,127 @@
+"""Job-checkpoint chaos demo: measured save/restore latency + pause cost.
+
+Drives io/job_checkpoint.py end to end against a replicated HA cluster
+under live traffic and emits one JSON line for the bench trajectory:
+
+- **save latency** — N trials of a full blocking job snapshot (gate →
+  capture sparse + dense + cursor → CRC32C → fsync → atomic publish)
+  of a populated table; p50/p95 ms.
+- **pause window** — the mutation-gate hold time per capture (the
+  training stall a checkpoint costs — capture only, the bulk IO is
+  gated OUT of this window); p50/p95 ms.
+- **restore latency** — verify + load + import into a fresh table +
+  digest check; p50/p95 ms.
+- **fallback check** — the newest checkpoint is deliberately
+  bit-flipped; the load must checksum-detect it and fall back
+  (``fallback_ok``).
+
+Env knobs: CHAOS_CKPT_TRIALS (default 5), CHAOS_CKPT_ROWS (default
+20000), CHAOS_CKPT_OUT (also write JSON there), CHAOS_CKPT_CPU=0 to
+keep the ambient jax platform. Exits 0 with an "error" field on
+failure (one-JSON-line driver contract).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _pct(sorted_vals, q):
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def main() -> None:
+    out = {"bench": "chaos_ckpt"}
+    path = os.environ.get("CHAOS_CKPT_OUT")
+    try:
+        import shutil
+        import tempfile
+
+        import jax
+
+        if os.environ.get("CHAOS_CKPT_CPU", "1") == "1":
+            jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+
+        from paddle_tpu.io.job_checkpoint import JobCheckpointManager
+        from paddle_tpu.ps import ha, rpc
+        from paddle_tpu.ps.accessor import AccessorConfig
+        from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+        from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+        out["platform"] = jax.devices()[0].platform
+        trials = int(os.environ.get("CHAOS_CKPT_TRIALS", 5))
+        rows = int(os.environ.get("CHAOS_CKPT_ROWS", 20000))
+        out["trials"], out["rows"] = trials, rows
+
+        cfg = TableConfig(shard_num=4, accessor_config=AccessorConfig(
+            sgd=SGDRuleConfig(initial_range=0.0)))
+        rng = np.random.default_rng(0)
+        root = tempfile.mkdtemp(prefix="chaos_ckpt_")
+        dense = {"state": {"w": rng.normal(size=4096).astype(np.float32)},
+                 "opt": {"m": rng.normal(size=4096).astype(np.float32)}}
+        save_ms, restore_ms = [], []
+        with ha.HACluster(num_shards=2, replication=2, sync=True) as cluster:
+            cli = cluster.client()
+            cli.create_sparse_table(0, cfg)
+            remote = rpc.RemoteSparseTable(cli, 0, cfg)
+            keys = rng.integers(0, 1 << 40, rows).astype(np.uint64)
+            cli.pull_sparse(0, keys, create=True)
+            push = np.zeros((len(keys), 12), np.float32)
+            push[:, 1] = 1.0
+            push[:, 3:] = rng.normal(0, 0.1, (len(keys), 9)).astype(np.float32)
+            cli.push_sparse(0, keys, push)
+            mgr = JobCheckpointManager(root, max_keep=trials + 2,
+                                       gate=cluster.checkpoint_gate())
+            mgr.register_sparse("ctr", remote)
+            for i in range(trials):
+                t0 = time.perf_counter()
+                mgr.save(step=i, cursor={"batch": i}, dense=dense,
+                         blocking=True)
+                save_ms.append((time.perf_counter() - t0) * 1000.0)
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                r = mgr.load_latest()
+                fresh = MemorySparseTable(cfg)
+                r.restore_sparse("ctr", fresh)
+                restore_ms.append((time.perf_counter() - t0) * 1000.0)
+            # corruption fallback: flip one byte in the newest artifact
+            newest = mgr._ids()[-1]
+            art = os.path.join(root, f"ckpt_{newest}", "sparse_ctr.npz")
+            with open(art, "r+b") as f:
+                f.seek(os.path.getsize(art) // 2)
+                b = f.read(1)
+                f.seek(-1, 1)
+                f.write(bytes([b[0] ^ 0xFF]))
+            r = mgr.load_latest()
+            out["fallback_ok"] = bool(r.ckpt_id == newest - 1
+                                      and len(mgr.fallbacks) == 1)
+            pause = sorted(mgr.pause_ms)
+            mgr.stop()
+        shutil.rmtree(root, ignore_errors=True)
+        save_ms.sort()
+        restore_ms.sort()
+        out["save_ms_p50"] = round(_pct(save_ms, 0.50), 1)
+        out["save_ms_p95"] = round(_pct(save_ms, 0.95), 1)
+        out["restore_ms_p50"] = round(_pct(restore_ms, 0.50), 1)
+        out["restore_ms_p95"] = round(_pct(restore_ms, 0.95), 1)
+        out["pause_ms_p50"] = round(_pct(pause, 0.50), 2)
+        out["pause_ms_p95"] = round(_pct(pause, 0.95), 2)
+    except Exception as e:  # noqa: BLE001 — one-JSON-line contract
+        out["error"] = f"{type(e).__name__}: {e}"
+    line = json.dumps(out)
+    print(line)
+    if path:
+        with open(path, "w") as f:
+            f.write(line + "\n")
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

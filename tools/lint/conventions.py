@@ -36,6 +36,17 @@
                    the pattern ``ps/rpc.py`` _ServerConn.call follows).
                    Plain polling loops (no except) are fine, as is any
                    sleep whose duration is computed from a variable.
+  atomic-publish   an ``os.replace``/``os.rename`` publish in a scope
+                   that never fsyncs: the rename can land while the
+                   renamed content is still dirty page cache, so a crash
+                   publishes empty/partial files — the torn-checkpoint
+                   bug class ``io/job_checkpoint.py`` exists to prevent.
+                   fsync the written files and the parent directory
+                   first (``io.fs.fsync_file``/``fsync_dir``, or
+                   ``publish_atomic`` which does the whole dance);
+                   any call whose name mentions fsync counts as
+                   evidence. Non-durable renames (tmp scratch, caches)
+                   get an ignore with a justification.
 
 Scope: ``paddle_tpu/`` and ``bench.py`` for all rules; ``tools/`` for
 time-time only (demo drivers legitimately read their own env knobs).
@@ -157,6 +168,76 @@ def _roundtrip_in_block(stmts, emit) -> None:
                     pending.pop(name)
 
 
+_PUBLISH_ATTRS = {"replace", "rename"}
+
+
+def _check_atomic_publish(tree: ast.AST, emit, os_aliases: Set[str],
+                          pub_bare: Set[str]) -> None:
+    """Flag os.replace/os.rename calls whose enclosing scope (nearest
+    function, else the module) shows no fsync evidence — any call whose
+    name mentions fsync, or publish_atomic (which fsyncs internally)."""
+
+    def is_publish(call: ast.Call) -> bool:
+        name = dotted(call.func)
+        if name in pub_bare:
+            return True
+        if name and "." in name:
+            mod, _, attr = name.rpartition(".")
+            return mod in os_aliases and attr in _PUBLISH_ATTRS
+        return False
+
+    def has_fsync(scope: ast.AST) -> bool:
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Call):
+                nm = dotted(sub.func) or ""
+                last = nm.rsplit(".", 1)[-1]
+                if "fsync" in last or last == "publish_atomic":
+                    return True
+        return False
+
+    msg = ("os.replace/os.rename publishes files that were never fsynced "
+           "— a crash can publish empty/partial content (the torn-"
+           "checkpoint class); fsync the written files and the parent "
+           "directory first (io.fs.fsync_file/fsync_dir/publish_atomic) "
+           "or justify with an ignore")
+    # nearest enclosing function owns each publish (ast.walk is
+    # breadth-first: outer functions come before nested ones, so the
+    # innermost assignment wins)
+    owner = {}
+    for fn in [n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call) and is_publish(sub):
+                owner[id(sub)] = (sub, fn)
+    scope_ok: dict = {}
+    for sub, fn in owner.values():
+        ok = scope_ok.get(id(fn))
+        if ok is None:
+            ok = scope_ok[id(fn)] = has_fsync(fn)
+        if not ok:
+            emit(sub, "atomic-publish", msg)
+    in_fn: set = set()
+    for fn in [n for n in ast.walk(tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]:
+        in_fn.update(map(id, ast.walk(fn)))
+    module_pubs = [sub for sub in ast.walk(tree)
+                   if isinstance(sub, ast.Call) and is_publish(sub)
+                   and id(sub) not in owner]
+    if module_pubs:
+        # module-scope evidence must itself be at module scope: an
+        # fsync buried in some (possibly never-called) function body is
+        # not evidence that the import-time publish was fsynced
+        module_fsync = any(
+            isinstance(sub, ast.Call) and id(sub) not in in_fn
+            and ("fsync" in (dotted(sub.func) or "").rsplit(".", 1)[-1]
+                 or (dotted(sub.func) or "").rsplit(".", 1)[-1]
+                 == "publish_atomic")
+            for sub in ast.walk(tree))
+        if not module_fsync:
+            for sub in module_pubs:
+                emit(sub, "atomic-publish", msg)
+
+
 def _iter_blocks(fn: ast.AST):
     """Every statement list inside a function (body + nested blocks)."""
     for node in ast.walk(fn):
@@ -189,11 +270,15 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
     time_mod_aliases = {"time"}
     time_func_aliases: Set[str] = set()
     sleep_func_aliases: Set[str] = set()
+    os_mod_aliases = {"os"}
+    publish_bare: Set[str] = set()  # from os import replace/rename [as x]
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
                 if a.name == "time":
                     time_mod_aliases.add(a.asname or "time")
+                elif a.name == "os":
+                    os_mod_aliases.add(a.asname or "os")
         elif isinstance(node, ast.ImportFrom):
             if node.module == "time" and not node.level:
                 for a in node.names:
@@ -201,6 +286,12 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
                         time_func_aliases.add(a.asname or "time")
                     elif a.name == "sleep":
                         sleep_func_aliases.add(a.asname or "sleep")
+            elif node.module == "os" and not node.level:
+                for a in node.names:
+                    if a.name in _PUBLISH_ATTRS:
+                        publish_bare.add(a.asname or a.name)
+
+    _check_atomic_publish(tree, emit, os_mod_aliases, publish_bare)
 
     def _is_sleep(call: ast.Call) -> bool:
         name = dotted(call.func)
@@ -304,7 +395,7 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
 def run(root: str) -> List[Diagnostic]:
     diags: List[Diagnostic] = []
     all_rules = {"time-time", "bare-except", "mutable-default", "env-read",
-                 "cast-roundtrip", "sleep-no-backoff"}
+                 "cast-roundtrip", "sleep-no-backoff", "atomic-publish"}
     for p in walk_py(root, ("paddle_tpu",), ("bench.py",)):
         diags.extend(check_file(p, root, all_rules))
     tools_dir = os.path.join(root, "tools")
