@@ -109,17 +109,27 @@ def _init_backend():
     t.join(deadline)
     if t.is_alive():
         reason = f"backend init hung > {deadline:.0f}s (stale chip grant?)"
-        already_cpu = (os.environ.get("BENCH_CPU_RETRY")
-                       or os.environ.get("BENCH_PLATFORM") == "cpu"
-                       or os.environ.get("JAX_PLATFORMS") == "cpu")
-        if not already_cpu:
+        # recursion guard: ONLY the explicit child marker. The old guard
+        # also matched JAX_PLATFORMS=cpu in the *parent* env — but the
+        # axon shim boot-registers the device platform regardless of env
+        # (see main()), so the driver exporting JAX_PLATFORMS=cpu still
+        # hung here and then SKIPPED the retry: r01-r05's 0.0 emissions.
+        # The child re-asserts cpu via jax.config (BENCH_PLATFORM), which
+        # does override the boot registration, so it cannot hang the
+        # same way — and its marker stops any further recursion.
+        if not os.environ.get("BENCH_CPU_RETRY"):
             _retry_on_cpu(reason)  # does not return
         _emit(0.0, 0.0, error=reason)
         sys.stdout.flush()  # os._exit skips buffer flush
         os._exit(0)
     if "devs" not in result:
-        raise RuntimeError(
-            f"backend init failed after retry: {result.get('err')}")
+        # hard init failures (r01's mode: 'Unavailable' stack trace, no
+        # JSON) get the same CPU fallback as hangs — a CPU number still
+        # anchors the trajectory
+        reason = f"backend init failed after retry: {result.get('err')}"
+        if not os.environ.get("BENCH_CPU_RETRY"):
+            _retry_on_cpu(reason)  # does not return
+        raise RuntimeError(reason)
     return result["devs"]
 
 
@@ -127,10 +137,16 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
 
-    # BENCH_PLATFORM=cpu: in-process backend override for CI validation
-    # (env vars alone cannot override the boot-registered axon platform)
-    if os.environ.get("BENCH_PLATFORM"):
-        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    # In-process backend override: env vars alone cannot override the
+    # boot-registered axon platform, so an env-level platform request —
+    # BENCH_PLATFORM (our CI knob) or JAX_PLATFORMS (the driver exports
+    # cpu) — must be re-asserted through jax.config to actually take.
+    # Without this, a driver-exported JAX_PLATFORMS=cpu run still
+    # init'ed the device backend and hung (BENCH_r01-r05 value:0.0).
+    plat = (os.environ.get("BENCH_PLATFORM")
+            or os.environ.get("JAX_PLATFORMS"))
+    if plat:
+        jax.config.update("jax_platforms", plat)
 
     devs = _init_backend()
     print(f"bench: backend={devs[0].platform} devices={len(devs)}",
@@ -319,6 +335,9 @@ def _run_measurement() -> None:
     dense = _dense_comm_attempt()
     if dense is not None:
         extra["dense_comm"] = dense
+    sparse_hot = _sparse_hot_attempt()
+    if sparse_hot is not None:
+        extra["sparse_hot"] = sparse_hot
     _emit(round(samples_per_sec, 1), round(samples_per_sec / baseline, 4),
           slab=slab, mode=mode_used,
           platform=jax.devices()[0].platform, **extra)
@@ -359,6 +378,27 @@ def _dense_comm_attempt():
             env=env, capture_output=True, text=True, timeout=300)
         line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
         return json.loads(line)
+    except Exception as e:  # noqa: BLE001 — optional field, never fatal
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _sparse_hot_attempt():
+    """Hot-tier vs RPC-only sparse rung (tools/sparse_hot_bench.py):
+    steady-state samples/sec, per-step PS RPC count, hit-rate —
+    embedded in the ONE bench emission under ``sparse_hot``. Runs
+    in-process: the PS cluster is loopback RPC and the default config
+    needs no collectives, so any backend works. A failure here costs
+    the field, never the headline metric."""
+    if os.environ.get("BENCH_SPARSE_HOT", "1") != "1":
+        return None
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        tools = os.path.join(here, "tools")
+        if tools not in sys.path:
+            sys.path.insert(0, tools)
+        import sparse_hot_bench
+
+        return sparse_hot_bench.run()
     except Exception as e:  # noqa: BLE001 — optional field, never fatal
         return {"error": f"{type(e).__name__}: {e}"[:200]}
 

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI gate (reference L0's cmake+ctest role): graftlint, native build,
 # fast test gate, then the full matrix.
-# Usage: ./ci.sh [lint|fast|full|chaos|ckpt]
+# Usage: ./ci.sh [lint|fast|full|chaos|ckpt|hot_tier]
 #   chaos — PS high-availability fast-gate: every failover/replication
 #   test with faultpoints armed (incl. the slow e2e kill-shard runs)
 #   plus the chaos_ps demo with its recovery/overhead acceptance checks.
@@ -9,6 +9,9 @@
 #   test_job_checkpoint.py matrix incl. the slow SIGKILL-the-job
 #   mid-save e2e (restart + checksum-fallback + bit-identical resume),
 #   plus the chaos_ckpt demo's save/restore/pause-window measurements.
+#   hot_tier — persistent HBM hot-embedding-tier gate: RPC-only parity
+#   (bit-identical through eviction churn + checkpoint/restore) and the
+#   sparse_hot bench with its 0-RPC warm-steady-state assertion.
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -76,15 +79,42 @@ print('chaos_ckpt OK: save p95=%.0fms restore p95=%.0fms pause p95=%.1fms'
   exit 0
 fi
 
+if [[ "${1:-fast}" == "hot_tier" ]]; then
+  echo "== hot_tier gate: HBM tier ≡ RPC-only parity + 0-RPC warm steps =="
+  python -m pytest tests/test_hot_tier.py -q -m ""
+  echo "== sparse_hot bench (0 RPC/step warm + speedup vs RPC-only) =="
+  PYTHONPATH="$PWD:${PYTHONPATH:-}" SHB_SAMPLES=2048 \
+    python tools/sparse_hot_bench.py | python -c "
+import json, sys
+d = json.loads([l for l in sys.stdin.read().splitlines()
+                if l.startswith('{')][-1])
+assert 'error' not in d, d
+# THE acceptance counter: a warm steady-state step performs ZERO PS
+# RPCs (RpcPsClient.op_counts delta over the measured epoch)
+assert d['hot_tier']['rpc_per_step'] == 0.0, d['hot_tier']
+assert d['hot_tier']['hit_rate'] == 1.0, d['hot_tier']
+assert d['rpc_only']['rpc_per_step'] > 0, d['rpc_only']
+print('sparse_hot OK: %.0f samples/s, %.2fx vs rpc-only, 0 rpc/step warm'
+      % (d['value'], d['speedup_vs_rpc_only']))"
+  echo "CI OK (hot_tier)"
+  exit 0
+fi
+
+echo "== hot-tier fast checks (parity / eviction churn / 0-RPC warm) =="
+# the hot tier's bit-parity contract is the cheapest place to catch a
+# sparse-rule or flush-back regression — fail it before the full matrix
+python -m pytest tests/test_hot_tier.py -q
+
 echo "== comm-fusion fast checks (fused dense-DP collectives + hlo_bytes) =="
 # fail the fused-bucket/quantized-collective layer in seconds, before the
 # full matrix — these cover the wire-byte acceptance gates directly
 python -m pytest tests/test_comm_fusion.py tests/test_hlo_bytes.py -q
 
 echo "== fast gate (default: -m 'not slow') =="
-# comm-fusion/hlo_bytes already ran above — don't pay them twice
+# hot-tier/comm-fusion/hlo_bytes already ran above — don't pay them twice
 python -m pytest tests/ -q -x \
-  --ignore=tests/test_comm_fusion.py --ignore=tests/test_hlo_bytes.py
+  --ignore=tests/test_comm_fusion.py --ignore=tests/test_hlo_bytes.py \
+  --ignore=tests/test_hot_tier.py
 
 if [[ "${1:-fast}" == "full" ]]; then
   echo "== full matrix (slow tests included) =="
@@ -114,8 +144,12 @@ assert d['gates']['parity_ok'], d; print('anchor_v2 parity OK')"
 import json
 d = json.load(open('/tmp/ci_tpu_smoke_light.json')); assert d['ok'], d
 print('tpu_smoke (light) OK')"
+  # BENCH_SPARSE_HOT=0: the dedicated sparse_hot gate below already
+  # runs (and asserts on) the hot-tier bench — the embedded emission
+  # would pay two more PS clusters + 4 DeepFM epochs here, unasserted
   BENCH_STEPS=5 BENCH_WARMUP=1 BENCH_PASS_KEYS=$((1 << 14)) \
-    BENCH_INIT_TIMEOUT=60 BENCH_PLATFORM=cpu python bench.py | python -c "
+    BENCH_INIT_TIMEOUT=60 BENCH_PLATFORM=cpu BENCH_SPARSE_HOT=0 \
+    python bench.py | python -c "
 import json, sys
 line = [l for l in sys.stdin.read().splitlines() if l.startswith('{')][-1]
 d = json.loads(line); assert d['value'] > 0 and 'error' not in d, d
@@ -133,10 +167,22 @@ i8 = ladder['fused+int8']['collective_wire_bytes_per_step']
 f32 = ladder['fused+fp32']['collective_wire_bytes_per_step']
 assert f32 >= 3.5 * i8, ladder
 print('dense comm ladder OK (int8 moves %.1fx fewer bytes)' % (f32 / i8))"
+  # hot-embedding tier: a warm steady-state step must perform ZERO PS
+  # RPCs (RpcPsClient.op_counts — the ISSUE 6 acceptance counter) and
+  # the tier must not lose to the RPC-only path it replaces
+  PYTHONPATH="$PWD:${PYTHONPATH:-}" JAX_PLATFORMS=cpu SHB_SAMPLES=2048 \
+    python tools/sparse_hot_bench.py | python -c "
+import json, sys
+d = json.loads([l for l in sys.stdin.read().splitlines() if l.startswith('{')][-1])
+assert 'error' not in d, d
+assert d['hot_tier']['rpc_per_step'] == 0.0, d['hot_tier']
+assert d['hot_tier']['hit_rate'] == 1.0, d['hot_tier']
+print('sparse_hot OK: 0 rpc/step warm, %.2fx vs rpc-only'
+      % d['speedup_vs_rpc_only'])"
   # the graceful-degradation ladder must actually engage (a hardware
   # compile failure in a new hot path costs an attempt, not the metric)
   BENCH_STEPS=3 BENCH_WARMUP=1 BENCH_BATCH=256 BENCH_PASS_KEYS=$((1 << 13)) \
-    BENCH_INIT_TIMEOUT=60 BENCH_PLATFORM=cpu \
+    BENCH_INIT_TIMEOUT=60 BENCH_PLATFORM=cpu BENCH_SPARSE_HOT=0 \
     BENCH_FORCE_FAIL=amp+dense,dense python bench.py | python -c "
 import json, sys
 line = [l for l in sys.stdin.read().splitlines() if l.startswith('{')][-1]

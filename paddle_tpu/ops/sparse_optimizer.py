@@ -49,6 +49,32 @@ def rule_init_state(rule: str, n: int, dim: int, *, beta1: float,
     return st
 
 
+def _m32(a, b):
+    """f32 multiply with PINNED operand binding and IEEE rounding.
+
+    The sparse rules must produce the same bits as the host engines
+    (csrc builds with -ffp-contract=off; numpy never contracts) — the
+    hot embedding tier round-trips rows between them. Two XLA behaviors
+    break that on a plain ``a * b`` chain:
+
+    - LLVM contracts a single-use `mul` feeding an `add`/`sub` into one
+      FMA (no intermediate rounding);
+    - the HLO algebraic simplifier re-associates scalar-constant mul
+      chains (``lr*sg*ratio`` becomes ``sg*(lr*ratio)`` — the constant
+      sinks onto the narrower broadcast operand).
+
+    Every pure seal was tried and folded away (optimization_barrier,
+    reduce_precision(8,23), bitcast pairs, min/max(±inf), +0.0); what
+    holds is making the product MULTI-USE via ``t + 0*t``: LLVM only
+    forms fmuladd from a single-use mul, XLA keeps ``0*x`` under strict
+    inf/nan semantics, and the add consumer breaks the mul-chain pattern
+    the re-associator matches on. Cost: one extra fused mul+add per
+    element. Known edge: t=±inf becomes NaN here (0·inf) — already
+    -diverged training only, and the nan/inf guard surfaces it anyway."""
+    t = a * b
+    return t + jnp.float32(0.0) * t
+
+
 def rule_update(rule: str, w, state, g, scale, *, lr, initial_g2sum,
                 wmin, wmax, beta1, beta2, eps):
     """One batched rule step on touched rows: (w [n,d], state [n,sd],
@@ -59,27 +85,41 @@ def rule_update(rule: str, w, state, g, scale, *, lr, initial_g2sum,
     reference's raw sqrt(v) (an eps-placement difference only). Adam
     ignores the scale like the reference."""
     clip = lambda x: jnp.clip(x, wmin, wmax)
+    lrf = jnp.float32(lr)
     if rule == "naive":
-        return clip(w - lr * g), state
+        return clip(w - _m32(lrf, g)), state
     if rule == "adagrad":  # one shared g2sum per feature
         sg = g / scale
         ratio = jnp.sqrt(initial_g2sum / (initial_g2sum + state))
-        w2 = clip(w - lr * sg * ratio)
-        return w2, state + jnp.mean(sg * sg, axis=1, keepdims=True)
+        w2 = clip(w - _m32(_m32(lrf, sg), ratio))
+        # g2sum accumulates in the native table's association (sequential
+        # over dims, ONE divide at the end — sparse_table.h kRuleAdaGrad);
+        # jnp.mean's tree reduce re-associates the f32 sum and breaks
+        # bit-parity with the host/PS rows the hot tier must round-trip
+        add = _m32(sg[:, 0], sg[:, 0])
+        for i in range(1, g.shape[1]):
+            add = add + _m32(sg[:, i], sg[:, i])
+        return w2, state + (add / jnp.float32(g.shape[1]))[:, None]
     if rule == "std_adagrad":  # per-dim g2sum
         sg = g / scale
         ratio = jnp.sqrt(initial_g2sum / (initial_g2sum + state))
-        return clip(w - lr * sg * ratio), state + sg * sg
+        return (clip(w - _m32(_m32(lrf, sg), ratio)), state + _m32(sg, sg))
     if rule == "adam":
         d = w.shape[1]
         m, v = state[:, :d], state[:, d:2 * d]
         b1p, b2p = state[:, 2 * d:2 * d + 1], state[:, 2 * d + 1:2 * d + 2]
-        m2 = beta1 * m + (1.0 - beta1) * g
-        v2 = beta2 * v + (1.0 - beta2) * g * g
-        m_hat = m2 / (1.0 - b1p)
-        v_hat = v2 / (1.0 - b2p)
-        w2 = clip(w - lr * m_hat / (jnp.sqrt(v_hat) + eps))
-        return w2, jnp.concatenate([m2, v2, b1p * beta1, b2p * beta2], axis=1)
+        # (1 - beta) must round through f32 like the native rule's
+        # `1.0f - cfg.beta1` — the python-double difference (1e-8 on
+        # beta1=0.9) compounds into m/v and breaks row bit-parity
+        b1f, b2f = jnp.float32(beta1), jnp.float32(beta2)
+        one = jnp.float32(1.0)
+        m2 = _m32(b1f, m) + _m32(one - b1f, g)
+        v2 = _m32(b2f, v) + _m32(_m32(one - b2f, g), g)
+        m_hat = m2 / (one - b1p)
+        v_hat = v2 / (one - b2p)
+        w2 = clip(w - _m32(lrf, m_hat) / (jnp.sqrt(v_hat) + eps))
+        return w2, jnp.concatenate(
+            [m2, v2, _m32(b1p, b1f), _m32(b2p, b2f)], axis=1)
     raise KeyError(f"unknown sparse sgd rule {rule!r}")
 
 
@@ -122,7 +162,11 @@ def fused_row_update(show, click, ew, estate, xw, xstate, has,
     # lazy embedx creation on the show/click score: created rows start
     # from INIT state; create_applies_grad selects CPU (create + apply,
     # ctr_accessor.cc order) vs GPU (create only, optimizer.cuh.h:81-94)
-    score = (show_new - click_new) * nonclk_coeff + click_new * click_coeff
+    # the host computes this over totals too (pstpu::show_click_score);
+    # both products sealed so the create-threshold compare sees the same
+    # bits as the PS and creation fires on the same push
+    score = (_m32(show_new - click_new, jnp.float32(nonclk_coeff))
+             + _m32(click_new, jnp.float32(click_coeff)))
     had = has > 0
     create = jnp.logical_and(jnp.logical_not(had),
                              score >= embedx_threshold)

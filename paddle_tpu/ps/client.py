@@ -70,7 +70,11 @@ class PsServerHandle:
 class PSClient:
     """Abstract client interface (ps_client.h API shape)."""
 
-    def pull_sparse(self, table_id: int, keys: np.ndarray, create: bool = True) -> np.ndarray:
+    def pull_sparse(self, table_id: int, keys: np.ndarray,
+                    create: bool = True, slots=None) -> np.ndarray:
+        """``slots`` tags rows CREATED by this pull with their slot id
+        (per-slot save filters / shrink policies read it); existing rows
+        are untouched."""
         raise NotImplementedError
 
     def push_sparse(self, table_id: int, keys: np.ndarray, values: np.ndarray) -> None:
@@ -127,8 +131,9 @@ class LocalPsClient(PSClient):
         except KeyError:
             raise NotFoundError(f"dense table {table_id} not created")
 
-    def pull_sparse(self, table_id, keys, create=True):
-        return self._sparse(table_id).pull_sparse(keys, create=create)
+    def pull_sparse(self, table_id, keys, create=True, slots=None):
+        return self._sparse(table_id).pull_sparse(keys, create=create,
+                                                  slots=slots)
 
     def push_sparse(self, table_id, keys, values):
         self._sparse(table_id).push_sparse(keys, values)

@@ -98,12 +98,15 @@ class _BaseCommunicator:
         self._drained.clear()
 
     def pull_sparse_async(self, table_id: int, keys: np.ndarray,
-                          create: bool = True) -> "Future":
+                          create: bool = True, slots=None) -> "Future":
         """Issue a pull on a background worker; returns a Future whose
         ``result()`` is the pulled values. The pull observes whatever
         pushes have ALREADY drained to the PS — stale by up to the queue
         depth, the async-PS contract. ``barrier()`` waits for in-flight
-        pulls as well as queued sends.
+        pulls as well as queued sends. ``slots`` rides through to the
+        create path so freshly inserted rows carry their slot metadata
+        (the local-table path always did; per-slot save filters and
+        shrink policies read it).
 
         Failover replay: an in-flight prefetch pull that dies on a
         transport failure re-resolves the HA routing table
@@ -115,22 +118,42 @@ class _BaseCommunicator:
                 self._pull_pool = ThreadPoolExecutor(
                     max_workers=2, thread_name_prefix="communicator-pull")
             fut = self._pull_pool.submit(self._pull_with_replay, table_id,
-                                         keys, create)
+                                         keys, create, slots)
+            self._inflight_pulls.add(fut)
+        fut.add_done_callback(self._pull_done)
+        return fut
+
+    def fetch_async(self, fn) -> "Future":
+        """Run an arbitrary zero-arg PS fetch on the pull workers,
+        tracked like a prefetch pull — ``quiesce()``/``barrier()`` wait
+        for it, so no fetch straddles a checkpoint cut. The hot tier's
+        miss prefetch (ps/hot_tier.py) rides this: its cold-row
+        ``export_full`` overlaps the compiled steps in front of it
+        exactly as ``pull_sparse_async`` overlaps RPC-only pulls. The
+        callable owns its own failover story (client ops replay through
+        ``_shard_op``); no refresh-and-replay wrapper here."""
+        with self._pull_mu:
+            if self._pull_pool is None:
+                self._pull_pool = ThreadPoolExecutor(
+                    max_workers=2, thread_name_prefix="communicator-pull")
+            fut = self._pull_pool.submit(fn)
             self._inflight_pulls.add(fut)
         fut.add_done_callback(self._pull_done)
         return fut
 
     def _pull_with_replay(self, table_id: int, keys: np.ndarray,
-                          create: bool):
+                          create: bool, slots=None):
         try:
-            return self.client.pull_sparse(table_id, keys, create)
+            return self.client.pull_sparse(table_id, keys, create,
+                                           slots=slots)
         except Exception:
             # the client's own _shard_op failover may have timed out
             # mid-promotion; one refresh-and-replay covers the window
             refresh = getattr(self.client, "refresh_routing", None)
             if refresh is None or not refresh():
                 raise
-            return self.client.pull_sparse(table_id, keys, create)
+            return self.client.pull_sparse(table_id, keys, create,
+                                           slots=slots)
 
     def _pull_done(self, fut) -> None:
         with self._pull_mu:
@@ -281,7 +304,7 @@ class SyncCommunicator(_BaseCommunicator):
     REJECTED in this mode (a prefetched pull would miss the current
     batch's inline push); CtrStreamTrainer forces depth 0 here."""
 
-    def pull_sparse_async(self, table_id, keys, create=True):
+    def pull_sparse_async(self, table_id, keys, create=True, slots=None):
         raise RuntimeError(
             "SyncCommunicator is strictly ordered: a prefetched pull "
             "would miss the current batch's inline push — pull through "

@@ -30,7 +30,8 @@ import numpy as np
 from ..core.enforce import enforce
 from .native import cuckoo_build
 
-__all__ = ["DeviceKeyMap", "device_hash_lookup", "split_keys"]
+__all__ = ["DeviceKeyMap", "DynamicDeviceKeyMap", "device_hash_lookup",
+           "dynamic_map_lookup", "split_keys"]
 
 _SLOTS = 4
 _SEED2_XOR = np.uint32(0x7FEB352D)
@@ -137,3 +138,251 @@ class DeviceKeyMap:
 
     def lookup(self, keys_hi: jax.Array, keys_lo: jax.Array) -> jax.Array:
         return device_hash_lookup(self.state, keys_hi, keys_lo)
+
+
+# ---------------------------------------------------------------------------
+# dynamic (insert/evict-capable) key→row map — the persistent hot tier's
+# front half (ps/hot_tier.py). The static cuckoo map above is built once
+# per pass; a cross-step tier needs residency to CHANGE cheaply, so this
+# map is bucketized LINEAR PROBING: host-side mutations patch a bounded
+# probe window, the in-graph probe stays two bucket-row gathers (the
+# same layout-friendly pattern as the cuckoo probe — never slot-wise).
+# ---------------------------------------------------------------------------
+
+_EMPTY = np.int32(-1)
+_TOMB = np.int32(-2)
+
+
+def _mix32_np(hi: np.ndarray, lo: np.ndarray, seed: int) -> np.ndarray:
+    """numpy mirror of ``_mix32`` — the host mirror and the in-graph
+    probe MUST hash identically (uint32 wraparound math)."""
+    with np.errstate(over="ignore"):
+        h = np.uint32(seed) ^ hi.astype(np.uint32)
+        h = h * np.uint32(0x85EBCA6B)
+        h = h ^ (h >> np.uint32(13))
+        h = h ^ lo.astype(np.uint32)
+        h = h * np.uint32(0xC2B2AE35)
+        h = h ^ (h >> np.uint32(16))
+    return h
+
+
+def dynamic_map_lookup(table: Dict[str, jax.Array], keys_hi: jax.Array,
+                       keys_lo: jax.Array, probe_buckets: int = 2
+                       ) -> jax.Array:
+    """In-graph probe of a :class:`DynamicDeviceKeyMap`: [n] int32 rows
+    (−1 = missing). ``probe_buckets`` consecutive bucket-ROW gathers;
+    inserts guarantee placement inside that window (else the host
+    rebuilt), so no early-exit-on-empty logic is needed."""
+    mask = jnp.uint32(table["row"].shape[0] - 1)  # nbuckets (power of 2)
+    hi = keys_hi.astype(jnp.uint32)
+    lo = keys_lo.astype(jnp.uint32)
+    b0 = _mix32(hi, lo, table["seed"]) & mask
+    found = jnp.full(hi.shape, -1, jnp.int32)
+    for t in range(probe_buckets):
+        b = ((b0 + jnp.uint32(t)) & mask).astype(jnp.int32)
+        bh = jnp.take(table["hi"], b, axis=0)    # [n, B]
+        bl = jnp.take(table["lo"], b, axis=0)
+        br = jnp.take(table["row"], b, axis=0)
+        match = (bh == hi[:, None]) & (bl == lo[:, None]) & (br >= 0)
+        hit = jnp.max(jnp.where(match, br, -1), axis=1)
+        found = jnp.where(found >= 0, found, hit)
+    return found
+
+
+class DynamicDeviceKeyMap:
+    """Insert/evict-capable feasign→row map living in HBM.
+
+    Generalizes :class:`DeviceKeyMap` from a build-once-per-pass cuckoo
+    table to the PERSISTENT tier's front half: the host keeps the
+    authoritative mirror (numpy arrays — membership decisions, miss
+    detection and eviction bookkeeping are host control-plane work) and
+    every mutation queues a bounded set of slot patches that one jitted
+    scatter applies to the device arrays before the next step closes
+    over them. The hot path — per-batch key→row resolution inside the
+    compiled step — is :func:`dynamic_map_lookup`, two bucket-row
+    gathers, branch-free.
+
+    Scheme: ``nbuckets × bucket_slots`` slots, bucketized linear probing
+    over a ``probe_buckets``-bucket window (load factor ≤ 0.5 by
+    construction). An insert that cannot place inside its window — or
+    tombstone pressure past 25% — triggers a deterministic REBUILD
+    (reseed from a fixed sequence, then grow): layout changes only,
+    never values, so rebuilds are invisible to training numerics.
+    """
+
+    _SEEDS = (0x1234ABCD, 0x9E3779B9, 0xDEADBEEF, 0x2545F491)
+
+    def __init__(self, capacity: int, sharding=None, bucket_slots: int = 8,
+                 probe_buckets: int = 2) -> None:
+        enforce(capacity > 0, "capacity must be positive")
+        self.capacity = int(capacity)
+        self.bucket_slots = int(bucket_slots)
+        self.probe_buckets = int(probe_buckets)
+        self._sharding = sharding
+        nb = 64
+        while nb * bucket_slots < 2 * self.capacity:
+            nb <<= 1
+        self._seed_idx = 0
+        self._init_arrays(nb)
+        self.rebuilds = 0
+        self._dev: Optional[Dict[str, jax.Array]] = None
+        self._patches: list = []   # (bucket, lane) pending device writes
+        self._full_upload = True   # first device_state uploads everything
+
+    def _init_arrays(self, nb: int) -> None:
+        self.nbuckets = nb
+        B = self.bucket_slots
+        self.hi = np.zeros((nb, B), np.uint32)
+        self.lo = np.zeros((nb, B), np.uint32)
+        self.row = np.full((nb, B), _EMPTY, np.int32)
+        self.seed = np.uint32(self._SEEDS[self._seed_idx])
+        self.used = 0
+        self.tombstones = 0
+
+    # -- host mirror ------------------------------------------------------
+
+    # graftlint: hot-path
+    def lookup_host(self, keys: np.ndarray) -> np.ndarray:
+        """[n] int32 rows, −1 = missing (vectorized; the control-plane
+        twin of the in-graph probe — identical hash math)."""
+        if len(keys) == 0:
+            return np.zeros(0, np.int32)
+        hi, lo = split_keys(keys)
+        mask = np.uint32(self.nbuckets - 1)
+        b0 = _mix32_np(hi, lo, self.seed) & mask
+        found = np.full(len(keys), -1, np.int32)
+        for t in range(self.probe_buckets):
+            b = (b0 + np.uint32(t)) & mask
+            match = ((self.hi[b] == hi[:, None]) & (self.lo[b] == lo[:, None])
+                     & (self.row[b] >= 0))
+            hit = np.max(np.where(match, self.row[b], -1), axis=1)
+            found = np.where(found >= 0, found, hit).astype(np.int32)
+        return found
+
+    def _place_one(self, hi: np.uint32, lo: np.uint32, row: int) -> bool:
+        """Insert one key (must not be present). False = window full."""
+        mask = np.uint32(self.nbuckets - 1)
+        b0 = _mix32_np(np.asarray([hi], np.uint32),
+                       np.asarray([lo], np.uint32), self.seed)[0] & mask
+        for t in range(self.probe_buckets):
+            b = int((b0 + np.uint32(t)) & mask)
+            for l in range(self.bucket_slots):
+                if self.row[b, l] < 0:
+                    if self.row[b, l] == _TOMB:
+                        self.tombstones -= 1
+                    self.hi[b, l] = hi
+                    self.lo[b, l] = lo
+                    self.row[b, l] = row
+                    self.used += 1
+                    self._patches.append((b, l))
+                    return True
+        return False
+
+    def insert(self, keys: np.ndarray, rows: np.ndarray) -> None:
+        """Insert keys (absent ones — a present key is an error: the
+        tier never re-inserts a resident id). Rebuilds deterministically
+        when a probe window fills or tombstones exceed 25% load."""
+        enforce(len(keys) == len(rows), "keys/rows length mismatch")
+        enforce(self.used + len(keys) <= self.capacity,
+                "DynamicDeviceKeyMap over capacity")
+        if self.tombstones * 4 > self.nbuckets * self.bucket_slots:
+            self._rebuild(grow=False)
+        hi, lo = split_keys(keys)
+        for i in range(len(keys)):
+            while not self._place_one(hi[i], lo[i], int(rows[i])):
+                self._rebuild(grow=self._seed_idx + 1 >= len(self._SEEDS))
+
+    def remove(self, keys: np.ndarray) -> None:
+        """Evict keys (tombstone their slots); missing key = error."""
+        if len(keys) == 0:
+            return
+        hi, lo = split_keys(keys)
+        mask = np.uint32(self.nbuckets - 1)
+        b0s = _mix32_np(hi, lo, self.seed) & mask
+        for i in range(len(keys)):
+            placed = False
+            for t in range(self.probe_buckets):
+                b = int((b0s[i] + np.uint32(t)) & mask)
+                for l in range(self.bucket_slots):
+                    if (self.row[b, l] >= 0 and self.hi[b, l] == hi[i]
+                            and self.lo[b, l] == lo[i]):
+                        self.row[b, l] = _TOMB
+                        self.used -= 1
+                        self.tombstones += 1
+                        self._patches.append((b, l))
+                        placed = True
+                        break
+                if placed:
+                    break
+            enforce(placed, f"remove: key {keys[i]} not in map")
+
+    def items(self):
+        """(keys u64, rows i32) of every resident entry (rebuild fuel)."""
+        live = self.row >= 0
+        keys = (self.hi[live].astype(np.uint64) << np.uint64(32)) \
+            | self.lo[live].astype(np.uint64)
+        return keys, self.row[live].copy()
+
+    def _rebuild(self, grow: bool) -> None:
+        # snapshot EVERY resident entry up front — a failed attempt
+        # below must retry with this full list, never re-harvest
+        # items() from a half-rebuilt table (that drops the tail)
+        keys, rows = self.items()
+        # deterministic layout: re-insert in ascending row order
+        order = np.argsort(rows, kind="stable")
+        keys, rows = keys[order], rows[order]
+        hi, lo = split_keys(keys)
+        nb = self.nbuckets * 2 if grow else self.nbuckets
+        while True:
+            self._seed_idx = (self._seed_idx + 1) % len(self._SEEDS)
+            self._init_arrays(nb)
+            self.rebuilds += 1
+            self._full_upload = True
+            self._patches.clear()
+            if all(self._place_one(hi[i], lo[i], int(rows[i]))
+                   for i in range(len(keys))):
+                return
+            # pathological seed: rotate again, growing once the seed
+            # sequence is exhausted (terminates: load ≤ 0.5 halves
+            # every growth)
+            if self._seed_idx + 1 >= len(self._SEEDS):
+                nb <<= 1
+
+    # -- device arrays ----------------------------------------------------
+
+    def _put(self, a: np.ndarray) -> jax.Array:
+        if self._sharding is not None:
+            return jax.device_put(a, self._sharding)
+        return jnp.asarray(a)
+
+    # graftlint: hot-path
+    def device_state(self) -> Dict[str, jax.Array]:
+        """Device arrays for the compiled step, refreshed from the host
+        mirror: pending slot patches apply as one scatter per array; a
+        rebuild re-uploads wholesale. Steady state (no mutations since
+        the last call) returns the cached dict untouched."""
+        if self._dev is None or self._full_upload:
+            self._dev = {"hi": self._put(self.hi), "lo": self._put(self.lo),
+                         "row": self._put(self.row),
+                         "seed": jnp.asarray(self.seed)}
+            self._full_upload = False
+            self._patches.clear()
+            return self._dev
+        if self._patches:
+            # host patch lists, not device arrays — no D2H transfer
+            b = np.asarray([p[0] for p in self._patches],  # graftlint: ignore[hot-host-transfer]
+                           np.int32)
+            l = np.asarray([p[1] for p in self._patches],  # graftlint: ignore[hot-host-transfer]
+                           np.int32)
+            self._dev = {
+                "hi": self._dev["hi"].at[b, l].set(self.hi[b, l]),
+                "lo": self._dev["lo"].at[b, l].set(self.lo[b, l]),
+                "row": self._dev["row"].at[b, l].set(self.row[b, l]),
+                "seed": self._dev["seed"],
+            }
+            self._patches.clear()
+        return self._dev
+
+    def lookup(self, keys_hi: jax.Array, keys_lo: jax.Array) -> jax.Array:
+        return dynamic_map_lookup(self.device_state(), keys_hi, keys_lo,
+                                  self.probe_buckets)

@@ -503,6 +503,7 @@ class CtrStreamTrainer:
         table_id: int = 0,
         embedx_dim: Optional[int] = None,
         pull_ahead: Optional[int] = None,
+        hot_tier=None,       # HotEmbeddingTier | HotTierConfig | None
     ) -> None:
         from .. import nn
         from .communicator import SyncCommunicator
@@ -561,6 +562,46 @@ class CtrStreamTrainer:
         #: checkpoint records and a restarted job resumes from
         self.batches_done = 0
 
+        #: persistent HBM hot-embedding tier (ps/hot_tier.py): warm ids
+        #: resolve/pull/push INSIDE the compiled step — a warm
+        #: steady-state batch performs ZERO PS RPCs; misses backfill
+        #: from the PS (prefetched on the communicator's pull workers
+        #: when pull-ahead is on) and evictions write dirty rows back
+        self.hot_tier = None
+        self._hot_step = None
+        if hot_tier is not None:
+            from .hot_tier import (HotEmbeddingTier, HotTierConfig,
+                                   make_hot_ctr_train_step,
+                                   make_sharded_hot_train_step)
+
+            if isinstance(hot_tier, HotTierConfig):
+                cold = table
+                if cold is None:
+                    cli = communicator.client
+                    if hasattr(cli, "_sparse"):  # LocalPsClient
+                        cold = cli._sparse(table_id)
+                    else:  # RpcPsClient — full-row view over the wire
+                        from .rpc import RemoteSparseTable
+
+                        cold = RemoteSparseTable(
+                            cli, table_id, cli.sparse_config(table_id))
+                hot_tier = HotEmbeddingTier(cold, hot_tier)
+            self.hot_tier = hot_tier
+            enforce(self.hot_tier.cache_config.embedx_dim == self._dim,
+                    "hot tier embedx_dim must match the trainer's")
+            slot_ids = np.arange(len(self.sparse_slots))
+            tc = self.hot_tier.config
+            pb = self.hot_tier.device_map.probe_buckets
+            if tc.mesh is not None:
+                self._hot_step = make_sharded_hot_train_step(
+                    model, optimizer, self.hot_tier.cache_config, tc.mesh,
+                    slot_ids=slot_ids, axis=tc.axis, routing=tc.routing,
+                    cap_factor=tc.cap_factor, probe_buckets=pb)
+            else:
+                self._hot_step = make_hot_ctr_train_step(
+                    model, optimizer, self.hot_tier.cache_config,
+                    slot_ids=slot_ids, probe_buckets=pb)
+
     # -- job checkpoint surface (io/job_checkpoint.py) --------------------
 
     def train_state(self) -> Dict[str, Any]:
@@ -574,6 +615,12 @@ class CtrStreamTrainer:
         ``load_train_state``/``RestoredJob.dense`` returns."""
         self.params = dense["state"]
         self.opt_state = dense["opt"]
+        if self.hot_tier is not None:
+            # the cold table was (or is about to be) rebuilt from the
+            # checkpoint — the resident set is stale relative to it;
+            # restart cold and refill on miss (resume-exact: rows
+            # round-trip the PS bit-for-bit)
+            self.hot_tier.drop()
 
     def train_from_dataset(self, dataset, batch_size: int = 512,
                            drop_last: bool = True,
@@ -626,6 +673,10 @@ class CtrStreamTrainer:
         depth = self.pull_ahead
         self.batches_done = int(start_batch)
 
+        if self.hot_tier is not None:
+            return self._train_hot(dataset, batch_size, kw, stats, depth,
+                                   checkpoint, checkpoint_every)
+
         def _prep(batch):
             keys = _slot_tagged_keys(batch, self.sparse_slots)
             flat = keys.reshape(-1)
@@ -634,7 +685,8 @@ class CtrStreamTrainer:
             # pull-ahead: kick batch N+depth's pull NOW so it overlaps
             # the compiled steps in front of it (double-buffered at 1)
             fut = (self.communicator.pull_sparse_async(
-                       self.table_id, flat, create=True)
+                       self.table_id, flat, create=True,
+                       slots=slot_ids[:len(flat)])
                    if depth > 0 else None)
             return keys, flat, dense, labels, fut
 
@@ -644,7 +696,8 @@ class CtrStreamTrainer:
                 pulled = fut.result()
             elif self.communicator is not None:  # same client as the pushes
                 pulled = self.communicator.client.pull_sparse(
-                    self.table_id, flat, create=True)
+                    self.table_id, flat, create=True,
+                    slots=slot_ids[:len(flat)])
             else:
                 pulled = self.table.pull_sparse(
                     flat, slots=slot_ids[:len(flat)], create=True)
@@ -697,6 +750,87 @@ class CtrStreamTrainer:
             "samples_per_sec": stats.samples / max(dt, 1e-9),
         }
 
+    def _train_hot(self, dataset, batch_size: int, kw: Dict[str, Any],
+                   stats: "_PassStats", depth: int, checkpoint,
+                   checkpoint_every: int) -> Dict[str, float]:
+        """The hot-tier loop: residency is ensured host-side per batch
+        (warm batch → pure mirror lookups, ZERO PS RPCs), then ONE
+        compiled step does map probe → pull → fwd/bwd → dense update →
+        CTR push entirely in HBM. Misses backfill full rows from the
+        cold store — prefetched on the communicator's pull workers when
+        pull-ahead is on — and evictions write dirty rows back, so the
+        PS sees exactly the end_pass-style flush traffic, never
+        per-batch pulls/pushes."""
+        import time
+        from collections import deque
+
+        tier = self.hot_tier
+        sharded = tier.config.mesh is not None
+        overflow = None  # device scalar accumulator (sharded routing)
+
+        # graftlint: hot-path
+        def _prep(batch):
+            keys = _slot_tagged_keys(batch, self.sparse_slots)
+            flat = keys.reshape(-1)
+            dense, labels = _dense_and_labels(batch, self.dense_slots,
+                                              self.label_slot, keys.shape[0])
+            if depth > 0:
+                # issue the COLD fetch for batch N+depth's misses now —
+                # warm batches fetch nothing, so this is free in steady
+                # state and hides the PS round-trip when residency moves
+                tier.prefetch(flat, self.communicator)
+            return keys, flat, dense, labels
+
+        # graftlint: hot-path
+        def _run(item):
+            nonlocal overflow
+            keys, flat, dense, labels = item
+            tier.ensure(flat)
+            lo32 = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+            map_state = tier.device_map.device_state()
+            out = self._hot_step(self.params, self.opt_state, tier.state,
+                                 map_state, jnp.asarray(lo32),
+                                 jnp.asarray(dense), jnp.asarray(labels))
+            self.params, self.opt_state, tier.state, loss = out[:4]
+            if sharded:
+                ov = out[4]
+                overflow = ov if overflow is None else overflow + ov
+            stats.steps += 1
+            stats.samples += int(labels.shape[0])
+            stats.loss_sum += float(loss)
+            self.batches_done += 1
+            self._maybe_checkpoint(checkpoint, checkpoint_every, batch_size)
+
+        t0 = time.perf_counter()
+        window: deque = deque()
+        try:
+            for batch in dataset.batch_iter(batch_size, **kw):
+                window.append(_prep(batch))
+                if len(window) > depth:
+                    _run(window.popleft())
+            while window:
+                _run(window.popleft())
+        finally:
+            if depth > 0 and self.communicator is not None:
+                self.communicator._drain_pulls()
+        if overflow is not None:
+            from .sharded_cache import check_route_overflow
+
+            check_route_overflow(overflow)
+        dt = time.perf_counter() - t0
+        if self.communicator is not None:
+            self.communicator.barrier()
+        return {
+            "loss": stats.mean_loss,
+            "steps": float(stats.steps),
+            "samples": float(stats.samples),
+            "samples_per_sec": stats.samples / max(dt, 1e-9),
+            # the observability satellite: hit-rate/churn/occupancy ride
+            # the result dict so benches and chaos gates assert on
+            # counters, not timing alone
+            "hot_tier": tier.stats(),
+        }
+
     def _maybe_checkpoint(self, checkpoint, every: int,
                           batch_size: int) -> None:
         if checkpoint is None or every <= 0 or \
@@ -706,6 +840,12 @@ class CtrStreamTrainer:
             # local quiesce, NOT barrier(): sync mode's barrier is a
             # cross-trainer rendezvous the others aren't at
             self.communicator.quiesce()
+        if self.hot_tier is not None:
+            # flush-dirty-then-snapshot: every resident row's training
+            # lands in the cold table BEFORE the manager gates mutations
+            # and digests the cut — the captured checkpoint is complete
+            # without knowing the tier exists
+            self.hot_tier.flush()
         checkpoint.save(step=self.batches_done,
                         cursor={"batch": self.batches_done,
                                 "batch_size": int(batch_size)},

@@ -21,6 +21,7 @@ import ctypes
 import os
 import threading
 import time
+from collections import Counter
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 
@@ -522,6 +523,24 @@ class RpcPsClient(PSClient):
         #: static single-replica topology (behavior unchanged).
         self._router = router
         self._conns_mu = threading.Lock()  # serializes failover conn swaps
+        #: per-op RPC counts (one count per client op, regardless of how
+        #: many shards it fans out to). The hot-tier CI gate asserts a
+        #: warm steady-state step performs ZERO of these, and
+        #: tools/sparse_hot_bench.py reports rpc/step from the deltas.
+        self.op_counts: Counter = Counter()
+        self._count_mu = threading.Lock()
+
+    def _op_count(self, op: str) -> None:
+        with self._count_mu:
+            self.op_counts[op] += 1
+
+    def reset_op_counts(self) -> Dict[str, int]:
+        """Snapshot-and-zero: returns the counts accumulated since the
+        last reset (delta reads for the bench / 0-RPC assertions)."""
+        with self._count_mu:
+            out = dict(self.op_counts)
+            self.op_counts.clear()
+        return out
 
     @property
     def num_servers(self) -> int:
@@ -762,9 +781,18 @@ class RpcPsClient(PSClient):
 
     # -- PSClient interface -----------------------------------------------
 
+    def sparse_config(self, table_id: int) -> TableConfig:
+        """The TableConfig this client created ``table_id`` with — the
+        accessor metadata a full-row view (RemoteSparseTable) needs."""
+        cfg = self._sparse_cfgs.get(table_id)
+        enforce(cfg is not None,
+                f"sparse table {table_id} not created via this client")
+        return cfg
+
     def pull_sparse(self, table_id, keys, create=True, slots=None):
         # client-side CostProfiler scope (brpc_ps_client's
         # pserver_client_pull_sparse probe)
+        self._op_count("pull_sparse")
         with RecordEvent("pserver_client_pull_sparse"):
             return self._pull_sparse(table_id, keys, create, slots)
 
@@ -809,6 +837,7 @@ class RpcPsClient(PSClient):
         return out
 
     def push_sparse(self, table_id, keys, values):
+        self._op_count("push_sparse")
         with RecordEvent("pserver_client_push_sparse"):
             return self._push_sparse(table_id, keys, values)
 
@@ -829,6 +858,7 @@ class RpcPsClient(PSClient):
                       for s, sel in self._shard_sel(sv)])
 
     def pull_dense(self, table_id):
+        self._op_count("pull_dense")
         try:
             dim = self._dense_dims[table_id]
         except KeyError:
@@ -846,6 +876,7 @@ class RpcPsClient(PSClient):
         return out
 
     def push_dense(self, table_id, grad):
+        self._op_count("push_dense")
         grad = np.ascontiguousarray(grad, np.float32)
         dim = self._dense_dims[table_id]
         # contiguous slice views — the gradient ships straight from the
@@ -868,6 +899,7 @@ class RpcPsClient(PSClient):
              if len(self._dense_slice(dim, s))])
 
     def push_geo(self, table_id, keys, deltas):
+        self._op_count("push_geo")
         keys = np.ascontiguousarray(keys, np.uint64)
         deltas = np.ascontiguousarray(deltas, np.float32)
         sv = self._route(keys)
@@ -881,6 +913,7 @@ class RpcPsClient(PSClient):
                       for s, sel in self._shard_sel(sv)])
 
     def pull_geo(self, table_id):
+        self._op_count("pull_geo")
         dim = self._geo_dims[table_id]
 
         def one(c):
@@ -919,6 +952,7 @@ class RpcPsClient(PSClient):
             timeout_ms=int(flag("pserver_barrier_timeout_ms"))))
 
     def global_step(self, increment: int = 1) -> int:
+        self._op_count("global_step")
         status, _ = self._shard_op(
             0, lambda c: c.check(_GLOBAL_STEP, n=increment))
         return status
@@ -1090,6 +1124,7 @@ class RpcPsClient(PSClient):
         ``create``, missing rows are inserted server-side in the same
         traversal (the multi-node pass-build BuildPull,
         ps_gpu_wrapper.cc:299)."""
+        self._op_count("export_full")
         keys = np.ascontiguousarray(keys, np.uint64)
         full_dim = self._dims(table_id)[2]
         out = np.zeros((len(keys), full_dim), np.float32)
@@ -1119,6 +1154,7 @@ class RpcPsClient(PSClient):
         return out, found
 
     def import_full(self, table_id, keys, values):
+        self._op_count("import_full")
         keys = np.ascontiguousarray(keys, np.uint64)
         values = np.ascontiguousarray(values, np.float32)
         sv = self._route(keys)

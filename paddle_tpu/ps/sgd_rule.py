@@ -119,7 +119,14 @@ class SparseAdaGradSGDRule(SparseSGDRule):
         ratio = np.sqrt(cfg.initial_g2sum / (cfg.initial_g2sum + g2sum))
         w -= cfg.learning_rate * scaled_g * ratio[:, None]
         self._clip(w)
-        g2sum += np.mean(scaled_g * scaled_g, axis=1)
+        # sequential-over-dims association, one divide — matches the
+        # native rule (sparse_table.h kRuleAdaGrad) bit-for-bit, which
+        # the device mirror (ops/sparse_optimizer.rule_update) pins too
+        sq = scaled_g * scaled_g
+        add = sq[:, 0].copy()
+        for i in range(1, sq.shape[1]):
+            add += sq[:, i]
+        g2sum += add / np.float32(sq.shape[1])
 
 
 class StdAdaGradSGDRule(SparseSGDRule):
@@ -166,16 +173,21 @@ class SparseAdamSGDRule(SparseSGDRule):
         v = state[:, d : 2 * d]
         b1p = state[:, 2 * d]
         b2p = state[:, 2 * d + 1]
-        m *= cfg.beta1
-        m += (1 - cfg.beta1) * g
-        v *= cfg.beta2
-        v += (1 - cfg.beta2) * g * g
-        m_hat = m / (1 - b1p)[:, None]
-        v_hat = v / (1 - b2p)[:, None]
+        # (1 - beta) rounds through f32 like the native `1.0f - beta1`
+        # — the python-double variant differs by ~1e-8 and breaks row
+        # bit-parity between the table backends and the device tier
+        b1, b2 = np.float32(cfg.beta1), np.float32(cfg.beta2)
+        one = np.float32(1.0)
+        m *= b1
+        m += (one - b1) * g
+        v *= b2
+        v += (one - b2) * g * g
+        m_hat = m / (one - b1p)[:, None]
+        v_hat = v / (one - b2p)[:, None]
         w -= cfg.learning_rate * m_hat / (np.sqrt(v_hat) + cfg.ada_epsilon)
         self._clip(w)
-        state[:, 2 * d] *= cfg.beta1
-        state[:, 2 * d + 1] *= cfg.beta2
+        state[:, 2 * d] *= b1
+        state[:, 2 * d + 1] *= b2
 
 
 _RULES = {

@@ -199,6 +199,98 @@ def test_traced_comment_marks_root(tmp_path):
     assert _rules(diags) == {"host-sync-item"}
 
 
+# -- hot-path host transfers (pass 1b) --------------------------------------
+
+def _hot_diags(tmp_path, source):
+    pkg = tmp_path / "paddle_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(source))
+    return tracer_safety.run_hot_path(str(tmp_path))
+
+
+def test_hot_path_np_asarray_in_root_flagged(tmp_path):
+    diags = _hot_diags(tmp_path, """
+        import numpy as np
+
+        # graftlint: hot-path
+        def warm_step(state):
+            return np.asarray(state["rows"])
+    """)
+    assert _rules(diags) == {"hot-host-transfer"}
+    assert diags[0].line == 6
+
+
+def test_hot_path_device_get_in_callee_flagged(tmp_path):
+    # reachability: the transfer hides in a helper CALLED from the root
+    diags = _hot_diags(tmp_path, """
+        import jax
+        import numpy as np
+
+        def helper(x):
+            return jax.device_get(x)
+
+        # graftlint: hot-path
+        def warm_step(state):
+            return helper(state)
+    """)
+    assert _rules(diags) == {"hot-host-transfer"}
+    assert diags[0].line == 6
+
+
+def test_hot_path_cold_marked_callee_not_flagged(tmp_path):
+    # a cold-path boundary stops traversal: the writeback/miss handlers
+    # own their transfers by design
+    diags = _hot_diags(tmp_path, """
+        import numpy as np
+
+        # graftlint: cold-path
+        def writeback(state):
+            return np.asarray(state["rows"])
+
+        # graftlint: hot-path
+        def warm_step(state):
+            return writeback(state)
+    """)
+    assert diags == []
+
+
+def test_hot_path_unmarked_function_not_flagged(tmp_path):
+    # no hot-path roots → host numpy anywhere is fine
+    diags = _hot_diags(tmp_path, """
+        import numpy as np
+
+        def host_helper(x):
+            return np.asarray(x)
+    """)
+    assert diags == []
+
+
+def test_hot_path_plain_np_math_not_flagged(tmp_path):
+    # only ndarray-MATERIALIZING conversions flag; host math on the
+    # control-plane mirror (zeros/where/lexsort...) is the design
+    diags = _hot_diags(tmp_path, """
+        import numpy as np
+
+        # graftlint: hot-path
+        def warm_step(keys):
+            mask = np.zeros(4, bool)
+            return np.where(mask, keys, 0)
+    """)
+    assert diags == []
+
+
+def test_hot_path_ignore_comment(tmp_path):
+    diags = _hot_diags(tmp_path, """
+        import numpy as np
+
+        # graftlint: hot-path
+        def warm_step(patches):
+            return np.asarray(patches)  # graftlint: ignore[hot-host-transfer]
+    """)
+    assert diags == []
+
+
 # -- lock-order -------------------------------------------------------------
 
 def _lock_diags(tmp_path, source, name="fixture.cc"):
@@ -690,7 +782,7 @@ def test_run_py_green_on_tree_and_red_on_violation(tmp_path):
     summary = json.loads((tmp_path / "s.json").read_text())
     assert summary["new"] == 0
     assert set(summary["per_pass"]) == {
-        "tracer_safety", "lock_order", "conventions"}
+        "tracer_safety", "hot_path", "lock_order", "conventions"}
 
     # an injected violation must turn the gate red with file:line:rule
     bad = tmp_path / "tree" / "paddle_tpu"

@@ -44,6 +44,7 @@ def main(argv=None) -> int:
 
     passes = {
         "tracer_safety": tracer_safety.run,
+        "hot_path": tracer_safety.run_hot_path,
         "lock_order": lock_order.run,
         "conventions": conventions.run,
     }

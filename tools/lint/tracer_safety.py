@@ -28,6 +28,16 @@ host sync or a trace-time side effect inside that set:
   host-print            ``print()`` inside traced code (trace-time side
                         effect: fires once per compile, not per step)
 
+Pass 1b (``run_hot_path``, registered separately in run.py) reuses the
+same module index and call-graph closure for one more rule:
+
+  hot-host-transfer     ``np.asarray`` / ``np.array`` / ``jax.device_get``
+                        in a function reachable from a
+                        ``# graftlint: hot-path`` root without crossing a
+                        ``# graftlint: cold-path`` boundary — the
+                        hot-embedding tier's zero-host-round-trip warm
+                        step must not regrow per-step D2H syncs
+
 Resolution is intentionally syntactic (same-module name lookup +
 ``from x import y`` aliases + ``self.method``); it is precise enough for
 this tree and fails open (unresolvable callees are skipped, not
@@ -419,6 +429,110 @@ def _scan_traced_function(mi: ModuleInfo, fd: FuncDef) -> List[Diagnostic]:
 
     V().visit(fd.node)
     return diags
+
+
+# ---------------------------------------------------------------------------
+# pass 1b: hot-path host transfers — the persistent hot-embedding tier
+# (ps/hot_tier.py) exists so a warm step performs ZERO host round-trips;
+# an `np.asarray`/`jax.device_get` on a device array anywhere in the
+# per-batch step path silently reintroduces a device→host sync per step
+# with no functional symptom (bit-parity holds, throughput quietly
+# dies). Roots are marked `# graftlint: hot-path` above the def; the
+# same syntactic call-graph closure as pass 1 follows callees, EXCEPT
+# into functions marked `# graftlint: cold-path` (the miss/eviction/
+# writeback handlers — those are RPC-bound by design and own their
+# transfers). Within the hot set, every np.ndarray-returning conversion
+# (`np.asarray` / `np.array`, any numpy alias) and `jax.device_get` is
+# flagged; `# graftlint: ignore[hot-host-transfer]` suppresses a line
+# whose argument is provably host data (e.g. python lists).
+# ---------------------------------------------------------------------------
+
+_HOT_RE = re.compile(r"#\s*graftlint:\s*hot-path\b")
+_COLD_RE = re.compile(r"#\s*graftlint:\s*cold-path\b")
+
+
+def _marked(mi: ModuleInfo, fd: FuncDef, regex: re.Pattern) -> bool:
+    """Marker comment on the line above ``def`` (or above the decorator
+    stack) — same probing as `# graftlint: traced`."""
+    node = fd.node
+    ln = node.lineno - 2  # line above `def` (0-based)
+    for probe in (ln, ln - len(node.decorator_list)):
+        if 0 <= probe < len(mi.source_lines) and \
+                regex.search(mi.source_lines[probe]):
+            return True
+    return False
+
+
+def _scan_hot_function(mi: ModuleInfo, fd: FuncDef) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    own_nested = {n for n in ast.walk(fd.node)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  and n is not fd.node}
+
+    def emit(node: ast.AST, msg: str) -> None:
+        line = getattr(node, "lineno", fd.node.lineno)
+        if "hot-host-transfer" not in line_ignores(mi.source_lines, line):
+            diags.append(Diagnostic(
+                mi.path, line, "hot-host-transfer",
+                f"{msg} (reachable from hot-tier step path via "
+                f"`{fd.name}`)"))
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node):
+            if node in own_nested:
+                return  # nested defs scan as their own units (if reached)
+            self.generic_visit(node)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node: ast.Call):
+            name = dotted(node.func)
+            if name:
+                head, sym = name.split(".")[0], name.split(".")[-1]
+                if name in ("jax.device_get", "device_get"):
+                    emit(node, "`jax.device_get` is a per-step device→host "
+                               "transfer on the warm path")
+                elif head in mi.np_aliases and sym in ("asarray", "array"):
+                    emit(node, f"`{name}` materializes an np.ndarray — a "
+                               "host transfer when handed a device array; "
+                               "keep warm-path data in jnp or mark the "
+                               "function `# graftlint: cold-path`")
+            self.generic_visit(node)
+
+    V().visit(fd.node)
+    return diags
+
+
+def run_hot_path(root: str, subdirs=("paddle_tpu",), files=("bench.py",)
+                 ) -> List[Diagnostic]:
+    modules = [m for m in (_collect_module(p, root)
+                           for p in walk_py(root, subdirs, files))
+               if m is not None]
+    index = _Index(modules)
+
+    reachable: Dict[int, Tuple[ModuleInfo, FuncDef]] = {}
+    work: List[Tuple[ModuleInfo, FuncDef]] = []
+    for mi in modules:
+        for defs in mi.funcs.values():
+            for fd in defs:
+                if _marked(mi, fd, _HOT_RE) and id(fd.node) not in reachable:
+                    reachable[id(fd.node)] = (mi, fd)
+                    work.append((mi, fd))
+    while work:
+        mi, fd = work.pop()
+        for callee in _callees(mi, fd, index):
+            if id(callee.node) in reachable:
+                continue
+            cmi = index.by_name[callee.module]
+            if _marked(cmi, callee, _COLD_RE):
+                continue  # declared cold: owns its transfers
+            reachable[id(callee.node)] = (cmi, callee)
+            work.append((cmi, callee))
+
+    diags: List[Diagnostic] = []
+    for mi, fd in reachable.values():
+        diags.extend(_scan_hot_function(mi, fd))
+    return sorted(diags, key=lambda d: (d.path, d.line, d.rule))
 
 
 def run(root: str, subdirs=("paddle_tpu",), files=("bench.py",)

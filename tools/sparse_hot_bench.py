@@ -1,0 +1,150 @@
+"""Hot-tier vs RPC-only sparse-embedding bench (ROADMAP item 1 rung).
+
+Two identical seeded DeepFM streams train against a real 2-shard RPC PS
+cluster (NativePsServer + RpcPsClient + HalfAsyncCommunicator — the
+production transport, not a local table):
+
+- **rpc_only** — every batch pulls/pushes over the RPC wire (the PR-2
+  overlapped path);
+- **hot_tier** — the persistent HBM tier (ps/hot_tier.py): after one
+  admission epoch the working set is device-resident and the measured
+  epoch's steps run entirely in-graph.
+
+Both measure their SECOND epoch (compile warm, rows created — the
+steady state the tier exists for) and report samples/sec, the per-step
+PS RPC count (RpcPsClient.op_counts deltas — the hot-tier CI gate's
+counter), and the tier's hit-rate/occupancy stats. The headline
+``value`` is hot-tier samples/sec; ``speedup_vs_rpc_only`` and the
+0-RPC claim ride the record for the CI full gate.
+
+Standalone: prints exactly ONE JSON line (driver contract). Importable:
+``run()`` returns the record — bench.py embeds it in its single
+emission under ``sparse_hot``. Env knobs: SHB_BATCH, SHB_SAMPLES,
+SHB_NID, SHB_CAPACITY, SHB_SLOTS.
+"""
+
+import json
+import os
+import sys
+import time
+
+METRIC = "sparse_hot_samples_per_sec"
+
+
+def run() -> dict:
+    import jax
+    import numpy as np
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.data.dataset import InMemoryDataset, SlotDesc
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM
+    from paddle_tpu.ps import rpc
+    from paddle_tpu.ps.communicator import HalfAsyncCommunicator
+    from paddle_tpu.ps.hot_tier import HotTierConfig
+    from paddle_tpu.ps.ps_trainer import CtrStreamTrainer
+    from paddle_tpu.ps.table import TableConfig
+
+    S = int(os.environ.get("SHB_SLOTS", 8))
+    D = 4
+    batch = int(os.environ.get("SHB_BATCH", 256))
+    n_samples = int(os.environ.get("SHB_SAMPLES", 4096))
+    nid = int(os.environ.get("SHB_NID", 1500))
+    capacity = int(os.environ.get("SHB_CAPACITY", 1 << 14))
+
+    rng = np.random.default_rng(0)
+    lines = []
+    for _ in range(n_samples):
+        ids = rng.integers(0, nid, S)
+        dense = rng.normal(size=D)
+        label = int((ids % 5 == 0).sum() + dense[0] > 1.0)
+        lines.append(" ".join([f"1 {v}" for v in ids]
+                              + [f"1 {v:.4f}" for v in dense]
+                              + [f"1 {label}"]))
+    slots = ([SlotDesc(f"s{i}", is_float=False, max_len=1) for i in range(S)]
+             + [SlotDesc(f"d{i}", is_float=True, max_len=1) for i in range(D)]
+             + [SlotDesc("label", is_float=True, max_len=1)])
+    ds = InMemoryDataset(slots, seed=0)
+    ds.load_from_lines(lines)
+
+    def measure(hot):
+        servers = [rpc.NativePsServer(n_trainers=1) for _ in range(2)]
+        client = rpc.RpcPsClient([f"127.0.0.1:{s.port}" for s in servers])
+        try:
+            client.create_sparse_table(
+                0, TableConfig(table_id=0, shard_num=4, accessor="ctr"))
+            comm = HalfAsyncCommunicator(client)
+            comm.start()
+            pt.seed(0)
+            tr = CtrStreamTrainer(
+                DeepFM(CtrConfig(num_sparse_slots=S, num_dense=D,
+                                 embedx_dim=8, dnn_hidden=(64, 64))),
+                optimizer.Adam(1e-2), None, embedx_dim=8,
+                sparse_slots=[f"s{i}" for i in range(S)],
+                dense_slots=[f"d{i}" for i in range(D)],
+                label_slot="label", communicator=comm, table_id=0,
+                hot_tier=hot)
+            tr.train_from_dataset(ds, batch_size=batch)  # warm-up epoch
+            pre = tr.hot_tier.stats() if hot is not None else None
+            client.reset_op_counts()
+            t0 = time.perf_counter()
+            out = tr.train_from_dataset(ds, batch_size=batch)
+            wall = time.perf_counter() - t0
+            counts = client.reset_op_counts()
+            comm.stop()
+            steps = max(out["steps"], 1.0)
+            rec = {
+                # wall-clock rate, not the result dict's (which excludes
+                # the trailing barrier drain the RPC path relies on)
+                "samples_per_sec": round(out["samples"] / wall, 1),
+                "rpc_per_step": round(sum(counts.values()) / steps, 3),
+                "rpc_ops": dict(counts),
+                "steps": int(steps),
+            }
+            if hot is not None:
+                st = out["hot_tier"]
+                total = ((st["hits"] - pre["hits"])
+                         + (st["misses"] - pre["misses"]))
+                rec["hit_rate"] = round(
+                    (st["hits"] - pre["hits"]) / max(total, 1), 4)
+                rec["occupancy"] = st["occupancy"]
+                rec["evictions"] = st["evictions"]
+            return rec
+        finally:
+            client.close()
+            for s in servers:
+                s.stop()
+
+    rpc_only = measure(None)
+    hot = measure(HotTierConfig(capacity=capacity))
+
+    out = {
+        "metric": METRIC, "value": hot["samples_per_sec"],
+        "unit": "samples/s", "hot_tier": hot, "rpc_only": rpc_only,
+        "speedup_vs_rpc_only": round(
+            hot["samples_per_sec"] / max(rpc_only["samples_per_sec"], 1e-9),
+            3),
+        "batch": batch, "n_samples": n_samples, "key_universe": nid * S,
+        "capacity": capacity,
+        "platform": jax.devices()[0].platform,
+    }
+    return out
+
+
+def main() -> None:
+    try:
+        rec = run()
+    except Exception as e:  # noqa: BLE001 — one-JSON-line contract
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        rec = {"metric": METRIC, "value": 0.0,
+               "error": f"{type(e).__name__}: {e}"[:300]}
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
