@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI gate (reference L0's cmake+ctest role): graftlint, native build,
 # fast test gate, then the full matrix.
-# Usage: ./ci.sh [lint|fast|full|chaos|ckpt|hot_tier]
+# Usage: ./ci.sh [lint|fast|full|chaos|ckpt|hot_tier|serving]
 #   chaos — PS high-availability fast-gate: every failover/replication
 #   test with faultpoints armed (incl. the slow e2e kill-shard runs)
 #   plus the chaos_ps demo with its recovery/overhead acceptance checks.
@@ -12,6 +12,10 @@
 #   hot_tier — persistent HBM hot-embedding-tier gate: RPC-only parity
 #   (bit-identical through eviction churn + checkpoint/restore) and the
 #   sparse_hot bench with its 0-RPC warm-steady-state assertion.
+#   serving — online-serving-plane gate: the full serving suite (incl.
+#   the chaos-gated kill-the-primary-mid-serve reattach/convergence
+#   acceptance test) plus the serving bench with its zero-RPC-warm and
+#   freshness thresholds asserted.
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -100,6 +104,38 @@ print('sparse_hot OK: %.0f samples/s, %.2fx vs rpc-only, 0 rpc/step warm'
   exit 0
 fi
 
+if [[ "${1:-fast}" == "serving" ]]; then
+  echo "== serving gate: oplog-fed replicas + frontend (chaos incl.) =="
+  # -m "" for symmetry with the other gates (the serving suite is all
+  # fast today — the failover acceptance test included)
+  python -m pytest tests/test_serving.py -q -m ""
+  echo "== serving bench (warm p99 + push→servable freshness) =="
+  # thresholds carry shared-2-core-host headroom (the committed
+  # SERVING.json shows the quiet-host numbers: single-digit warm p99,
+  # freshness p95 well under the 100 ms SLO); one retry absorbs
+  # ambient-load outliers, the zero-RPC and zero-failure asserts are
+  # exact on every attempt
+  check_serving() {
+    PYTHONPATH="$PWD:${PYTHONPATH:-}" JAX_PLATFORMS=cpu SB_REQUESTS=1000 \
+      python tools/serving_bench.py | python -c "
+import json, sys
+d = json.loads([l for l in sys.stdin.read().splitlines()
+                if l.startswith('{')][-1])
+assert 'error' not in d, d
+assert d['warm']['rpc_per_request'] == 0.0, d['warm']
+assert d['warm']['shed'] == 0 and d['warm']['deadline_misses'] == 0, d['warm']
+assert d['freshness_failures'] == 0, d['freshness']
+assert d['warm']['request_ms']['p99_ms'] <= 50.0, d['warm']
+assert d['freshness']['p95_ms'] <= 250.0, d['freshness']
+print('serving OK: warm p99=%.1fms qps=%.0f, push→servable p95=%.1fms'
+      % (d['warm']['request_ms']['p99_ms'], d['warm']['qps'],
+         d['freshness']['p95_ms']))"
+  }
+  check_serving || { echo "serving retry (ambient-load outlier)"; check_serving; }
+  echo "CI OK (serving)"
+  exit 0
+fi
+
 echo "== hot-tier fast checks (parity / eviction churn / 0-RPC warm) =="
 # the hot tier's bit-parity contract is the cheapest place to catch a
 # sparse-rule or flush-back regression — fail it before the full matrix
@@ -179,6 +215,19 @@ assert d['hot_tier']['rpc_per_step'] == 0.0, d['hot_tier']
 assert d['hot_tier']['hit_rate'] == 1.0, d['hot_tier']
 print('sparse_hot OK: 0 rpc/step warm, %.2fx vs rpc-only'
       % d['speedup_vs_rpc_only'])"
+  # serving plane: warm requests perform ZERO RPCs and every freshness
+  # probe lands (the dedicated `serving` gate asserts the latency
+  # thresholds too — this full-gate copy pins the exact invariants at
+  # a smaller scale)
+  PYTHONPATH="$PWD:${PYTHONPATH:-}" JAX_PLATFORMS=cpu SB_KEYS=5000 \
+    SB_REQUESTS=500 SB_PROBES=10 python tools/serving_bench.py | python -c "
+import json, sys
+d = json.loads([l for l in sys.stdin.read().splitlines() if l.startswith('{')][-1])
+assert 'error' not in d, d
+assert d['warm']['rpc_per_request'] == 0.0, d['warm']
+assert d['freshness_failures'] == 0, d['freshness']
+print('serving OK: warm p99=%.1fms, push→servable p95=%.1fms, 0 rpc warm'
+      % (d['warm']['request_ms']['p99_ms'], d['freshness']['p95_ms']))"
   # the graceful-degradation ladder must actually engage (a hardware
   # compile failure in a new hot path costs an attempt, not the metric)
   BENCH_STEPS=3 BENCH_WARMUP=1 BENCH_BATCH=256 BENCH_PASS_KEYS=$((1 << 13)) \
@@ -203,13 +252,18 @@ print('bench degradation ladder OK')"
   # exitcode=0: TSAN's default exit-66-if-anything-reported would mask
   # pytest's own status behind unavoidable third-party noise — the grep
   # below is the gate for OUR code, pytest's exit code for the tests
-  LD_PRELOAD="$(gcc -print-file-name=libtsan.so)" \
+  # OPENBLAS_NUM_THREADS=1: numpy-2.x's OpenBLAS pool spawns at import
+  # and deadlocks every LATER fork under the sanitizer preload (the
+  # first lazy `np.testing` import runs an lscpu subprocess — the whole
+  # sweep wedged there, 0% CPU). BLAS parallelism buys nothing under a
+  # 10-20x sanitizer anyway.
+  LD_PRELOAD="$(gcc -print-file-name=libtsan.so)" OPENBLAS_NUM_THREADS=1 \
     TSAN_OPTIONS="suppressions=$PWD/paddle_tpu/csrc/tsan.supp,halt_on_error=0,exitcode=0,log_path=/tmp/ci_tsan_report" \
     python -m pytest tests/test_table_concurrency.py tests/test_ssd_table.py \
       tests/test_native_table.py tests/test_ps_rpc.py \
       tests/test_rpc_robustness.py tests/test_dist_graph.py \
       tests/test_rpc_parallel.py tests/test_ps_ha.py \
-      tests/test_job_checkpoint.py -q -m ""
+      tests/test_job_checkpoint.py tests/test_serving.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_tsan_report* 2>/dev/null; then
     echo "TSAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_tsan_report*)"
     exit 1
@@ -222,13 +276,13 @@ print('bench degradation ladder OK')"
   # so pytest's status gates the tests and the grep gates OUR .so
   make -C paddle_tpu/csrc SANITIZE=address -s
   rm -f /tmp/ci_asan_report*
-  LD_PRELOAD="$(gcc -print-file-name=libasan.so)" \
+  LD_PRELOAD="$(gcc -print-file-name=libasan.so)" OPENBLAS_NUM_THREADS=1 \
     ASAN_OPTIONS="detect_leaks=0,halt_on_error=0,exitcode=0,log_path=/tmp/ci_asan_report" \
     python -m pytest tests/test_table_concurrency.py tests/test_ssd_table.py \
       tests/test_native_table.py tests/test_ps_rpc.py \
       tests/test_rpc_robustness.py tests/test_dist_graph.py \
       tests/test_rpc_parallel.py tests/test_ps_ha.py \
-      tests/test_job_checkpoint.py -q -m ""
+      tests/test_job_checkpoint.py tests/test_serving.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_asan_report* 2>/dev/null; then
     echo "ASAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_asan_report*)"
     exit 1
@@ -240,12 +294,13 @@ print('bench degradation ladder OK')"
   # LD_PRELOAD; halt_on_error=0 collects every report into the log
   make -C paddle_tpu/csrc SANITIZE=undefined -s
   rm -f /tmp/ci_ubsan_report*
-  UBSAN_OPTIONS="print_stacktrace=1,halt_on_error=0,log_path=/tmp/ci_ubsan_report" \
+  OPENBLAS_NUM_THREADS=1 \
+    UBSAN_OPTIONS="print_stacktrace=1,halt_on_error=0,log_path=/tmp/ci_ubsan_report" \
     python -m pytest tests/test_table_concurrency.py tests/test_ssd_table.py \
       tests/test_native_table.py tests/test_ps_rpc.py \
       tests/test_rpc_robustness.py tests/test_dist_graph.py \
       tests/test_rpc_parallel.py tests/test_ps_ha.py \
-      tests/test_job_checkpoint.py -q -m ""
+      tests/test_job_checkpoint.py tests/test_serving.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_ubsan_report* 2>/dev/null; then
     echo "UBSAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_ubsan_report*)"
     exit 1

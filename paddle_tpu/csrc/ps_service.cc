@@ -186,6 +186,7 @@ enum Err : int64_t {
   kErrInternal = -4,
   kErrStaleEpoch = -5,  // kReplicate from a fenced (demoted) primary
   kErrSeqGap = -6,      // kReplicate seq skipped entries — resync needed
+  kErrReadOnly = -7,    // training-plane mutation on a read-only replica
 };
 
 // commands whose application changes table state: these are the ops a
@@ -227,6 +228,33 @@ inline bool is_mutating_cmd(uint32_t cmd, int32_t aux, int64_t n) {
 
 inline bool is_create_cmd(uint32_t cmd) {
   return cmd == kCreateSparse || cmd == kCreateDense || cmd == kCreateGeo;
+}
+
+// the subset of mutating commands a READ-ONLY replica (serving plane,
+// ps/serving) refuses from direct clients: the streaming TRAINING data
+// plane. The replication/bootstrap plane stays open — kReplicate applies
+// via apply_op (never passes this check), and the shipper's full-sync
+// path sends kInsertFull / kDenseRestore / kGlobalStep / creates
+// directly, so those must keep working for the snapshot catch-up of the
+// very replica this flag protects. kPullSparse's insert-on-miss bit is
+// DOWNGRADED instead (missing rows read as zeros — the serving contract
+// for out-of-population features), so a sloppy serve client cannot
+// create phantom rows that diverge from the primary.
+inline bool is_training_plane_cmd(uint32_t cmd, int32_t aux) {
+  switch (cmd) {
+    case kPushSparse:
+    case kPushDense:
+    case kSetDense:
+    case kPushGeo:
+    case kPullGeo:  // reading GEO DRAINS it — state-changing
+    case kShrink:
+    case kLoadCold:
+      return true;
+    case kExport:  // create-export is the pass-build path, not serving
+      return (aux & 1) != 0;
+    default:
+      return false;
+  }
 }
 
 constexpr uint64_t kMaxPayload = 1ULL << 32;  // 4 GiB frame cap
@@ -529,6 +557,15 @@ struct PsServer {
   // nothing applied — a post-snapshot rebase sets this to the snapshot
   // cut S and the tail resumes at S+1)
   std::atomic<int64_t> applied_seq{0};
+  // read-only attach mode (serving replicas, paddle_tpu/serving): direct
+  // training-plane mutations bounce with kErrReadOnly; replication and
+  // snapshot-plane commands still apply (see is_training_plane_cmd)
+  std::atomic<bool> read_only{false};
+  // bumped whenever DENSE state changes (direct or replicated apply):
+  // the serving replica's feed watcher reads this counter instead of
+  // polling table bytes — a dense-tower refresh triggers exactly when
+  // the change feed delivered one
+  std::atomic<int64_t> dense_version{0};
   // oplog ring (primary role): every mutating request frame, stamped
   // with a monotonically increasing seq; the Python shipper thread
   // drains it via pss_oplog_next and forwards kReplicate frames.
@@ -873,6 +910,7 @@ struct PsServer {
       std::memcpy(t->m.data(), p + 8 + 4 * d, 4 * d);
       std::memcpy(t->v.data(), p + 8 + 8 * d, 4 * d);
     }
+    dense_version.fetch_add(1);
     return 0;
   }
 
@@ -951,14 +989,18 @@ struct PsServer {
         if (!t) return kErrNoTable;
         if (h.payload_len != t->values.size() * 4) return kErrBadSize;
         t->push(reinterpret_cast<const float*>(p));
+        dense_version.fetch_add(1);
         return 0;
       }
       case kSetDense: {
         DenseTable* t = get_dense(h.table_id);
         if (!t) return kErrNoTable;
         if (h.payload_len != t->values.size() * 4) return kErrBadSize;
-        std::lock_guard<std::mutex> g(t->mu);
-        std::memcpy(t->values.data(), p, h.payload_len);
+        {
+          std::lock_guard<std::mutex> g(t->mu);
+          std::memcpy(t->values.data(), p, h.payload_len);
+        }
+        dense_version.fetch_add(1);
         return 0;
       }
       case kInsertFull:
@@ -1033,7 +1075,9 @@ struct PsServer {
       }
   }
 
-  bool handle(int fd, const ReqHeader& h, const char* p) {
+  // h by VALUE: read-only mode may downgrade a pull's insert-on-miss
+  // bit before dispatch (24 trivially-copyable bytes)
+  bool handle(int fd, ReqHeader h, const char* p) {
     // global count sanity bound BEFORE any `h.n * width` arithmetic: a
     // huge n would overflow the int64 size checks (n*8 ≡ 0 mod 2^64)
     // and bypass them into out-of-bounds reads. No legitimate command
@@ -1062,6 +1106,17 @@ struct PsServer {
         ::shutdown(fd, SHUT_RDWR);
         return false;
       }
+    }
+    // read-only attach mode (serving replicas): refuse the training
+    // data plane outright, BEFORE the pause gate and the oplog tap — a
+    // refused request must neither block on the gate nor land in the
+    // ring. A pull's insert-on-miss bit is downgraded instead so a
+    // serve client reading an out-of-population key gets zeros, not a
+    // phantom row the primary never created.
+    if (read_only.load()) {
+      if (is_training_plane_cmd(h.cmd, h.aux))
+        return respond(fd, kErrReadOnly, nullptr, 0);
+      if (h.cmd == kPullSparse) h.aux &= ~1;
     }
     bool mutating = is_mutating_cmd(h.cmd, h.aux, h.n);
     // snapshot quiesce gate + oplog tap: mutating requests block while a
@@ -1164,6 +1219,7 @@ struct PsServer {
         if (h.payload_len != t->values.size() * 4)
           return respond(fd, kErrBadSize, nullptr, 0);
         t->push(reinterpret_cast<const float*>(p));
+        dense_version.fetch_add(1);
         return respond(fd, 0, nullptr, 0);
       }
       case kSetDense: {
@@ -1175,6 +1231,7 @@ struct PsServer {
           std::lock_guard<std::mutex> g(t->mu);
           std::memcpy(t->values.data(), p, h.payload_len);
         }
+        dense_version.fetch_add(1);
         return respond(fd, 0, nullptr, 0);
       }
       case kSize: {
@@ -1876,6 +1933,17 @@ void pss_set_epoch(void* h, int64_t e) {
 }
 int64_t pss_applied_seq(void* h) {
   return static_cast<PsServer*>(h)->applied_seq.load();
+}
+
+// ---- serving-plane attach mode (paddle_tpu/serving consumes) ----
+void pss_set_read_only(void* h, int on) {
+  static_cast<PsServer*>(h)->read_only.store(on != 0);
+}
+int pss_read_only(void* h) {
+  return static_cast<PsServer*>(h)->read_only.load() ? 1 : 0;
+}
+int64_t pss_dense_version(void* h) {
+  return static_cast<PsServer*>(h)->dense_version.load();
 }
 
 // arm a deterministic faultpoint: name in {kill-shard, drop-frame,

@@ -242,7 +242,16 @@ struct Shard {
   const TableNativeConfig* cfg;
   SgdRule embed_rule;
   SgdRule embedx_rule;
-  std::mt19937_64 rng;
+  // Row-init randomness is a PURE FUNCTION of (key, table seed), NOT a
+  // stream-positioned generator. A shared-seed stream only stays
+  // aligned between a primary and a subscriber that replayed every
+  // frame from draw zero; a snapshot-attached subscriber (rejoined
+  // backup, serving replica) copies rows but not the generator
+  // position, so the first lazily-initialized embedx after the cut
+  // would draw different values on each side — a silent bit-divergence
+  // the change-feed digests caught. Keyed init makes every catch-up
+  // path (live tail, snapshot+tail, mixed) converge bit-for-bit.
+  uint64_t init_seed;
   std::mutex mu;
 
   // index
@@ -276,10 +285,17 @@ struct Shard {
       : cfg(c),
         embed_rule(c->embed_rule, 1, c->sgd),
         embedx_rule(c->embedx_rule, c->embedx_dim, c->sgd),
-        rng(seed) {
+        init_seed(seed) {
     slot_keys.assign(1024, 0);
     slot_state.assign(1024, kEmpty);
     mask = 1023;
+  }
+
+  // per-key init generator; the salt decorrelates the embed draw from
+  // the embedx draw for the same key (same distribution bounds would
+  // otherwise make embed_w == embedx_w[0] on every fresh row)
+  std::mt19937_64 init_rng(uint64_t key, uint64_t salt) const {
+    return std::mt19937_64(splitmix64(key ^ init_seed ^ salt));
   }
 
   int32_t es() const { return embed_rule.state_dim; }
@@ -356,7 +372,8 @@ struct Shard {
     f_delta_score[r] = 0;
     f_show[r] = 0;
     f_click[r] = 0;
-    embed_rule.init(&f_embed_w[r], es() ? &f_embed_state[r * es()] : nullptr, rng);
+    std::mt19937_64 g = init_rng(row_key[r], 0xA0761D6478BD642FULL);
+    embed_rule.init(&f_embed_w[r], es() ? &f_embed_state[r * es()] : nullptr, g);
     std::fill_n(&f_embedx_w[static_cast<size_t>(r) * xd()], xd(), 0.0f);
     if (xs())
       std::fill_n(&f_embedx_state[static_cast<size_t>(r) * xs()], xs(), 0.0f);
@@ -461,9 +478,10 @@ struct Shard {
     float score = show_click_score(f_show[r], f_click[r]);
     size_t xo = static_cast<size_t>(r) * xd();
     if (!f_has_embedx[r] && score >= cfg->embedx_threshold) {
+      std::mt19937_64 g = init_rng(row_key[r], 0xE7037ED1A0B428DBULL);
       embedx_rule.init(&f_embedx_w[xo],
                        xs() ? &f_embedx_state[static_cast<size_t>(r) * xs()] : nullptr,
-                       rng);
+                       g);
       f_has_embedx[r] = 1;
       // creation happens before the embedx update, so the fresh row
       // consumes this push's embedx gradient (same order as the Python

@@ -115,6 +115,7 @@ class InferencePredictor:
     backend."""
 
     def __init__(self, dirname: str) -> None:
+        self.dirname = dirname
         path = os.path.join(dirname, "model.stablehlo")
         enforce(os.path.exists(path),
                 f"no inference model at {dirname}", PreconditionNotMetError)
@@ -132,6 +133,18 @@ class InferencePredictor:
         enforce(not self.manifest["freeze"],
                 "frozen exports have no swappable params")
         self._params = _plain(params)
+
+    def reload_params(self) -> None:
+        """Re-read ONLY the params checkpoint of this export — the
+        serving half of the ``refresh_inference_params`` values-only
+        delta: after a refresh (export loop) or a feed-triggered dense
+        sync (paddle_tpu/serving replica ``dense_version`` watcher)
+        rewrote ``params.npz``, the loaded program keeps serving and
+        just swaps values. No re-deserialize, no re-compile."""
+        enforce(not self.manifest["freeze"],
+                "frozen exports have no swappable params")
+        self._params = _plain(load_checkpoint(
+            os.path.join(self.dirname, "params"))["model"])
 
     def __call__(self, *inputs):
         if self.manifest["freeze"]:

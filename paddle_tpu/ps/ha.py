@@ -78,6 +78,7 @@ __all__ = [
     "FailoverCoordinator",
     "HACluster",
     "CheckpointGate",
+    "observer_key",
     "drain_remote",
     "faultpoint",
     "arm_faultpoint",
@@ -123,6 +124,21 @@ def _hb_key(job_id: str, endpoint: str) -> str:
 
 def _hb_prefix(job_id: str) -> str:
     return f"ps/{job_id}/hb/"
+
+
+def _obs_prefix(job_id: str, shard: int) -> str:
+    """Observer registrations for one shard: read-only oplog subscribers
+    (serving replicas, paddle_tpu/serving). Observers ship exactly like
+    backups — snapshot + tail + epoch fencing — but live OUTSIDE the
+    routing document: the coordinator never promotes one, and their
+    TTL'd leases (not the coordinator) decide attachment, so a dead
+    serving replica detaches by expiry without touching failover
+    state."""
+    return f"ps/{job_id}/obs/{shard}/"
+
+
+def observer_key(job_id: str, shard: int, endpoint: str) -> str:
+    return _obs_prefix(job_id, shard) + endpoint
 
 
 # ---------------------------------------------------------------------------
@@ -196,6 +212,7 @@ class RoutingTable:
 
     def __init__(self, store, job_id: str) -> None:
         self.store = store
+        self.job_id = job_id
         self.key = _route_key(job_id)
 
     def publish(self, epoch: int, shards: List[dict]) -> None:
@@ -226,8 +243,21 @@ class HARouter:
                  failures: Optional[int] = None,
                  cooldown_s: Optional[float] = None,
                  failover_timeout_s: Optional[float] = None,
-                 poll_s: float = 0.02) -> None:
+                 poll_s: float = 0.02, qos: str = "train") -> None:
         self.routing_table = RoutingTable(store, job_id)
+        enforce(qos in ("train", "serve"),
+                f"HARouter qos must be 'train' or 'serve', got {qos!r}")
+        #: QoS class: a "serve" router defaults its breaker thresholds
+        #: from the FLAGS_ps_serve_breaker_* family (trip faster, probe
+        #: sooner). Breakers live PER ROUTER INSTANCE, so a serve client
+        #: with its own router can never open — or be blocked by — the
+        #: training client's breakers (ROADMAP item 5's first QoS seam).
+        self.qos = qos
+        if qos == "serve":
+            if failures is None:
+                failures = int(flag("ps_serve_breaker_failures"))
+            if cooldown_s is None:
+                cooldown_s = int(flag("ps_serve_breaker_cooldown_ms")) / 1000.0
         self._failures = failures
         self._cooldown_s = cooldown_s
         self.failover_timeout_s = (
@@ -374,6 +404,17 @@ class ReplicationManager:
         if sh["primary"] != self.endpoint:
             return  # demoted; HAServer will stop us
         want = [ep for ep in sh.get("backups", []) if ep != self.endpoint]
+        # read-only observers (serving replicas, paddle_tpu/serving):
+        # TTL-leased registrations under the observer prefix. They ride
+        # the SAME ship/snapshot/fence machinery as backups — the oplog
+        # as a change feed — but never appear in the routing document,
+        # so the coordinator cannot promote one and a crashed replica
+        # detaches by lease expiry on the next poll.
+        pref = _obs_prefix(self.routing.job_id, self.shard)
+        for key in self.routing.store.list_prefix(pref):
+            ep = key[len(pref):]
+            if ep != self.endpoint and ep not in want:
+                want.append(ep)
         with self._mu:
             have = set(self._backups)
         for ep in want:
@@ -402,6 +443,18 @@ class ReplicationManager:
             conn.close()
             self.fenced = True
             return
+        if remote_epoch < self.epoch:
+            # fence the subscriber UP to our epoch before the first ship:
+            # the coordinator only fences the PROMOTED server, so a
+            # surviving subscriber (second backup, serving observer)
+            # still carries the old epoch — and would keep accepting a
+            # demoted primary's stream alongside ours. Epochs only move
+            # forward; our own ships carry aux=self.epoch and still pass.
+            try:
+                conn.check(_rpc._EPOCH, n=self.epoch, retries=0)
+            except PreconditionNotMetError:
+                conn.close()
+                return  # next routing poll retries the attach
         if applied > self.server.oplog_seq():
             # the cursor was numbered by a DIFFERENT primary's oplog
             # (promotion chains renumber from each server's own ring) —
@@ -955,10 +1008,14 @@ class HACluster:
         holds while capturing this cluster's tables."""
         return CheckpointGate(cluster=self, **kw)
 
-    def client(self, with_router: bool = True, **router_kw) -> RpcPsClient:
+    def client(self, with_router: bool = True, qos: str = "train",
+               **router_kw) -> RpcPsClient:
+        """Router-wired client. ``qos="serve"`` yields the serving read
+        class: its own router (own breaker instances) plus the short
+        serve deadline/no-retry transport defaults (ps/rpc.py)."""
         cli = RpcPsClient(self.routing.primaries(),
-                          router=self.router(**router_kw)
-                          if with_router else None)
+                          router=self.router(qos=qos, **router_kw)
+                          if with_router else None, qos=qos)
         self._clients.append(cli)
         return cli
 

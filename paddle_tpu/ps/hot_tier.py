@@ -78,6 +78,12 @@ class HotTierConfig:
     #: sharded-step routing knob (ps/sharded_cache.py select_routing)
     routing: Any = "auto"
     cap_factor: float = 2.0
+    #: miss semantics: True (training) creates missing rows in the cold
+    #: store (export_full(create=True) — the pass-build contract); False
+    #: (read-only serving, paddle_tpu/serving) fetches WITHOUT creating —
+    #: out-of-population keys admit as zero rows (the serving contract),
+    #: and a read-only cold store (serving replica) accepts the fetch
+    create_on_miss: bool = True
     #: in-graph push formulation (embedding_cache.resolve_push_mode):
     #: "dense" streams the whole capacity through the rule (the TPU
     #: shape — cost ∝ capacity), "sparse" sorts/dedups the batch (cost
@@ -243,7 +249,8 @@ class HotEmbeddingTier:
         if len(missing) == 0:
             return
         fetch = (lambda m=missing, s=slots:
-                 (m, self.table.export_full(m, create=True, slots=s)))
+                 (m, self.table.export_full(
+                     m, create=self.config.create_on_miss, slots=s)))
         if communicator is not None:
             fut = communicator.fetch_async(fetch)
         else:
@@ -314,8 +321,8 @@ class HotEmbeddingTier:
                 # set past what it fetched — the sync cold path covers
                 # the remainder
                 missing, slots = self._missing_of(keys)
-                values, _ = self.table.export_full(missing, create=True,
-                                                   slots=slots)
+                values, _ = self.table.export_full(
+                    missing, create=self.config.create_on_miss, slots=slots)
                 self.counters["cold_fetches"] += 1
                 self._admit(missing, values, keys)
                 rows = self.device_map.lookup_host(keys)
@@ -436,6 +443,27 @@ class HotEmbeddingTier:
         path: the cold store was just rebuilt from a checkpoint — the
         tier refills on miss)."""
         self._reset_resident_set()
+
+    def invalidate(self, keys: np.ndarray) -> int:
+        """Forget just these keys' resident rows so the next ensure()
+        re-fetches them from the cold store — the serving plane's
+        bounded-staleness refresh (a row older than the freshness budget
+        is dropped, not served). Dirty rows write back first (a training
+        tier calling this loses nothing); read-only serving tiers
+        (``mark_dirty=False`` readers) never have dirty rows, so the
+        common path is a pure map/control-plane edit — no device I/O.
+        Returns the number of rows dropped."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        rows = self.device_map.lookup_host(keys)
+        rows = np.unique(rows[rows >= 0])
+        if len(rows) == 0:
+            return 0
+        self.writeback(rows[self._dirty[rows]])
+        self.device_map.remove(self._keys[rows])
+        self._valid[rows] = False
+        self._dirty[rows] = False
+        self._free.extend(int(r) for r in rows)
+        return len(rows)
 
     # -- observability ----------------------------------------------------
 

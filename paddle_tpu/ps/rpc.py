@@ -60,6 +60,27 @@ define_flag("ps_rpc_parallel", True,
             "routing index) so per-step latency is max(shards), not "
             "sum(shards); False forces the serial per-server loop "
             "(debugging / deterministic call interleaving)")
+# serve-path QoS class (ps/serving frontends; first concrete step of the
+# ROADMAP item-5 QoS ladder): serving reads are latency-bound and
+# shedding-friendly, so they get a SHORT deadline and at most one
+# attempt instead of riding the training client's patient retry budget —
+# and their OWN circuit-breaker thresholds, so a serving brown-out can
+# never trip the training client's breaker (or vice versa)
+define_flag("pserver_serve_timeout_ms", 2000,
+            "per-call IO deadline for qos='serve' PS clients (serving "
+            "reads fail fast and shed instead of queueing behind long "
+            "training calls)")
+define_flag("pserver_serve_max_retry", 1,
+            "attempts per PS call for qos='serve' clients (1 = no "
+            "retry: the frontend's admission control owns the retry "
+            "policy, not the transport)")
+define_flag("ps_serve_breaker_failures", 2,
+            "consecutive transport failures before a SERVE-qos client "
+            "opens an endpoint's breaker (trip faster than training: "
+            "every blocked serve call is user-visible latency)")
+define_flag("ps_serve_breaker_cooldown_ms", 500,
+            "open-breaker cooldown for serve-qos clients before one "
+            "half-open probe")
 
 __all__ = ["NativePsServer", "RpcPsClient", "RemoteSparseTable",
            "rpc_available", "make_conn", "send_replicate",
@@ -167,6 +188,13 @@ def _configure_rpc(lib: ctypes.CDLL) -> None:
     lib.pss_catalog_get.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.pss_pause_mutations.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.pss_set_epoch.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    # serving-plane attach mode (paddle_tpu/serving; rebuild a stale .so
+    # if these are missing — _rpc_lib raises through the AttributeError)
+    lib.pss_set_read_only.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.pss_read_only.restype = ctypes.c_int
+    lib.pss_read_only.argtypes = [ctypes.c_void_p]
+    lib.pss_dense_version.restype = ctypes.c_int64
+    lib.pss_dense_version.argtypes = [ctypes.c_void_p]
     lib.pss_arm_fault.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                   ctypes.c_uint32, ctypes.c_int64,
                                   ctypes.c_int64]
@@ -286,6 +314,29 @@ class NativePsServer:
     def applied_seq(self) -> int:
         return int(self._lib.pss_applied_seq(self._h))
 
+    # -- serving-plane attach mode (paddle_tpu/serving) ------------------
+
+    def set_read_only(self, on: bool) -> None:
+        """Serving-replica mode: direct training-plane mutations (push,
+        geo, shrink, create-exports, bulk load) bounce with
+        ``kErrReadOnly``; insert-on-miss pulls are downgraded to plain
+        reads (missing rows read as zeros — the serving contract for
+        out-of-population features). The replication/bootstrap plane
+        (kReplicate, snapshot inserts, dense restore, creates) stays
+        open — it is how this replica stays fresh."""
+        self._lib.pss_set_read_only(self._h, 1 if on else 0)
+
+    @property
+    def read_only(self) -> bool:
+        return bool(self._lib.pss_read_only(self._h))
+
+    @property
+    def dense_version(self) -> int:
+        """Count of applied dense mutations (direct or replicated) —
+        the serving replica's feed watcher triggers dense-tower
+        refreshes off this counter instead of diffing table bytes."""
+        return int(self._lib.pss_dense_version(self._h))
+
     def arm_fault(self, name: str, cmd: int = 0, after: int = 1,
                   param: int = 0) -> None:
         """Arm a server-side faultpoint (kill-shard / drop-frame /
@@ -324,11 +375,18 @@ class _ServerConn:
     non-idempotent commands (push, global_step) exactly as brpc's
     channel retry does; ``retries=0`` opts a call out (barrier)."""
 
-    def __init__(self, lib: ctypes.CDLL, host: str, port: int) -> None:
+    def __init__(self, lib: ctypes.CDLL, host: str, port: int,
+                 io_timeout_flag: str = "pserver_timeout_ms",
+                 max_retry_flag: str = "pserver_max_retry") -> None:
         self._lib = lib
         self._host, self._port = host, port
         self.endpoint = f"{host}:{port}"
         self._h = None
+        # QoS class: serve-path conns resolve their (shorter) IO deadline
+        # and (smaller) attempt budget from different flags — both are
+        # read live at (re)connect/call time like the train path always did
+        self._io_flag = io_timeout_flag
+        self._retry_flag = max_retry_flag
         # serializes the whole call/close/reconnect/set_timeout sequence:
         # the C++ mutex only protects a single psc_call, but reconnect
         # DELETES the PsConn — without this lock a trainer-thread retry
@@ -340,7 +398,7 @@ class _ServerConn:
         self._h = self._lib.psc_connect2(
             self._host.encode(), self._port,
             int(flag("pserver_connect_timeout_ms")),
-            int(flag("pserver_timeout_ms")))
+            int(flag(self._io_flag)))
         if not self._h:
             raise PsTransportError(
                 f"cannot connect to PS server {self._host}:{self._port} "
@@ -427,7 +485,7 @@ class _ServerConn:
                 ptrs[i] = ctypes.cast(ctypes.c_char_p(b), ctypes.c_void_p)
                 lens[i] = len(b)
         if retries is None:
-            retries = max(0, int(flag("pserver_max_retry")) - 1)
+            retries = max(0, int(flag(self._retry_flag)) - 1)
         backoff = int(flag("pserver_retry_backoff_ms")) / 1000.0
         last: Optional[Exception] = None
         for attempt in range(retries + 1):
@@ -454,6 +512,10 @@ class _ServerConn:
         status, resp = self.call(cmd, table_id, n, aux, payload, **kw)
         if status == -2:
             raise NotFoundError(f"table {table_id} not created on server")
+        if status == -7:
+            raise PreconditionNotMetError(
+                f"PS server {self.endpoint} is READ-ONLY (serving "
+                f"replica) — training-plane command {cmd} refused")
         enforce(status >= 0, f"PS command {cmd} failed with status {status}")
         return status, resp
 
@@ -503,13 +565,29 @@ class RpcPsClient(PSClient):
     """
 
     def __init__(self, endpoints: Sequence[str],
-                 router: Optional[object] = None) -> None:
+                 router: Optional[object] = None,
+                 qos: str = "train") -> None:
         lib = _rpc_lib()
         self._lib = lib
+        enforce(qos in ("train", "serve"),
+                f"RpcPsClient qos must be 'train' or 'serve', got {qos!r}")
+        #: QoS class. "serve" = the read-mostly online-serving path:
+        #: short per-call deadline (FLAGS_pserver_serve_timeout_ms), no
+        #: transport retries by default (the frontend's admission control
+        #: owns retry policy), and — when a router is attached — its OWN
+        #: breaker thresholds/instances, so serving reads can neither
+        #: trip the training client's breaker nor wedge behind long
+        #: training calls (docs/OPERATIONS.md §12).
+        self.qos = qos
+        conn_kw = {}
+        if qos == "serve":
+            conn_kw = dict(io_timeout_flag="pserver_serve_timeout_ms",
+                           max_retry_flag="pserver_serve_max_retry")
+        self._conn_kw = conn_kw
         self._conns: List[_ServerConn] = []
         for ep in endpoints:
             host, port = ep.rsplit(":", 1)
-            self._conns.append(_ServerConn(lib, host, int(port)))
+            self._conns.append(_ServerConn(lib, host, int(port), **conn_kw))
         self._sparse_dims: Dict[int, Tuple[int, int, int]] = {}  # pull/push/full
         self._sparse_cfgs: Dict[int, TableConfig] = {}
         self._dense_dims: Dict[int, int] = {}
@@ -557,7 +635,7 @@ class RpcPsClient(PSClient):
                 return
             host, port = endpoint.rsplit(":", 1)
             old, self._conns[s] = self._conns[s], _ServerConn(
-                self._lib, host, int(port))
+                self._lib, host, int(port), **self._conn_kw)
         old.close()
 
     def refresh_routing(self) -> bool:

@@ -1,0 +1,58 @@
+"""Online serving plane: oplog-subscribed read replicas + a batched
+low-latency frontend (ROADMAP item 2).
+
+The training side already ships every mutation through a seq-numbered
+replication oplog (ps/ha.py, PR 4); this package repurposes that stream
+as a **change feed** so serving freshness rides replication instead of
+the batch arrival→export loop (ONLINE.json measured that loop at
+p95 ≈ 1.38 s — orders of magnitude off interactive serving):
+
+- :class:`~paddle_tpu.serving.replica.ServingReplica` — a read-only
+  ``NativePsServer`` that registers a TTL'd *observer* lease; the
+  primaries' ``ReplicationManager`` attaches it exactly like a backup
+  (snapshot + oplog tail + epoch fencing) but the coordinator can never
+  promote it. Sparse tables stay continuously fresh; dense towers
+  refresh off the feed's ``dense_version`` counter (values-only — the
+  ``refresh_inference_params`` delta without the export loop).
+- :class:`~paddle_tpu.serving.frontend.ServingFrontend` — micro-batching
+  (coalesce up to ``max_batch``/``max_delay_us``), a bounded admission
+  queue with load shedding (reject-with-retry-after, never unbounded
+  growth), and per-request deadlines.
+- :mod:`~paddle_tpu.serving.lookup` — warm-path embedding sources:
+  ``CachedLookup`` serves resident rows through the
+  ``HotEmbeddingTier`` read path (bounded staleness, zero RPCs on warm
+  keys), ``ReplicaLookup`` reads the replica's host table directly.
+
+Every read goes to the replica: serving performs **zero training-PS
+RPCs** by construction, and the serve-path clients run in their own QoS
+class (short deadlines, separate circuit breakers — ps/rpc.py
+``qos="serve"``). During a failover the replica keeps serving
+stale-but-bounded data (``status()["since_last_apply_s"]`` exposes the
+blip) and re-attaches on the promoted primary's epoch.
+
+Operational guide: docs/OPERATIONS.md §12. Bench: tools/serving_bench.py
+(committed SERVING.json).
+"""
+
+from .frontend import (DeadlineExceeded, FrontendConfig, PendingResult,
+                       RequestRejected, ServingFrontend)
+from .lookup import CachedLookup, ReplicaLookup
+from .metrics import FreshnessProbe, LatencyRecorder
+from .replica import (DenseTowerPublisher, DenseTowerSync, ServingReplica,
+                      make_serve_client)
+
+__all__ = [
+    "ServingReplica",
+    "ServingFrontend",
+    "FrontendConfig",
+    "PendingResult",
+    "RequestRejected",
+    "DeadlineExceeded",
+    "ReplicaLookup",
+    "CachedLookup",
+    "DenseTowerPublisher",
+    "DenseTowerSync",
+    "make_serve_client",
+    "LatencyRecorder",
+    "FreshnessProbe",
+]

@@ -1,0 +1,131 @@
+"""Warm-path embedding lookup sources for the serving frontend.
+
+Two implementations of the one-method contract
+``lookup(keys [n] u64) -> values [n, d] f32``:
+
+- :class:`ReplicaLookup` — the host path: every lookup is a
+  ``pull_sparse(create=False)`` against the serving replica's local
+  table (serve-QoS client, zero training-PS RPCs). Missing keys read
+  as zeros — the serving contract for out-of-population features.
+- :class:`CachedLookup` — the device path: the
+  :class:`~paddle_tpu.ps.hot_tier.HotEmbeddingTier` read path
+  (``ensure(mark_dirty=False)`` + in-graph ``cache_pull`` gather) over
+  a replica cold view, so WARM keys never leave resident state — zero
+  RPCs of any kind, the single-digit-ms regime. Staleness is bounded:
+  a resident row older than ``freshness_budget_s`` is dropped and
+  re-fetched *only when the feed has advanced past its fetch point*
+  (``replica.applied_seq``), so an idle feed re-fetches nothing and a
+  busy feed refreshes each warm row at most once per budget.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.enforce import enforce
+from ..ps.embedding_cache import cache_pull
+from ..ps.hot_tier import HotEmbeddingTier
+
+__all__ = ["ReplicaLookup", "CachedLookup"]
+
+
+class ReplicaLookup:
+    """Direct host-table reads from the serving replica."""
+
+    def __init__(self, client, table_id: int) -> None:
+        self._client = client
+        self.table_id = int(table_id)
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        return self._client.pull_sparse(self.table_id, keys, create=False)
+
+    @property
+    def dim(self) -> int:
+        return self._client._dims(self.table_id)[0]
+
+
+class CachedLookup:
+    """Resident-state reads through a read-only hot tier.
+
+    ``tier`` must be built with ``create_on_miss=False`` over the
+    replica's :meth:`~paddle_tpu.serving.replica.ServingReplica.
+    serve_view` (the replica is read-only — a create-on-miss tier
+    would be refused, and rightly so). ``replica`` provides the feed
+    cursor for the staleness bound; pass None to disable refresh (a
+    static table served from HBM)."""
+
+    def __init__(self, tier: HotEmbeddingTier, replica=None,
+                 freshness_budget_s: float = 0.05) -> None:
+        enforce(not tier.config.create_on_miss,
+                "CachedLookup needs a read-only tier "
+                "(HotTierConfig(create_on_miss=False)) — a serving "
+                "lookup must never create rows")
+        self.tier = tier
+        self.replica = replica
+        self.freshness_budget_s = freshness_budget_s
+        C = tier.config.capacity
+        # per-row fetch stamps: which feed seq the row was fetched
+        # under, and when — the two sides of the staleness predicate
+        self._row_seq = np.zeros(C, np.int64)
+        self._row_t = np.zeros(C, np.float64)
+        self.refreshes = 0
+        # eager gather jitted once (the in-graph read path of the
+        # compiled serving step, standalone)
+        self._pull = jax.jit(cache_pull)
+
+    def _refresh_stale(self, keys: np.ndarray, rows: np.ndarray,
+                       seq: int, now: float) -> int:
+        """Invalidate resident-but-stale rows; returns how many dropped
+        (``rows`` is the caller's host-map probe — reused, not re-run:
+        this sits on the warm path whose p99 the bench gates)."""
+        res = rows >= 0
+        if not res.any():
+            return 0
+        rres = rows[res]
+        stale = (self._row_seq[rres] < seq) & \
+                (now - self._row_t[rres] > self.freshness_budget_s)
+        if not stale.any():
+            return 0
+        stale_keys = np.unique(keys[res][stale])
+        dropped = self.tier.invalidate(stale_keys)
+        self.refreshes += len(stale_keys)
+        return dropped
+
+    def lookup(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, np.uint64)
+        now = time.perf_counter()
+        seq: Optional[int] = (self.replica.applied_seq
+                              if self.replica is not None else None)
+        pre = self.tier.device_map.lookup_host(keys)
+        if seq is not None and self._refresh_stale(keys, pre, seq, now):
+            pre = self.tier.device_map.lookup_host(keys)  # rare: rows left
+        rows = self.tier.ensure(keys, mark_dirty=False)
+        fetched = np.unique(rows[pre < 0])
+        if len(fetched):
+            self._row_seq[fetched] = seq if seq is not None else 0
+            self._row_t[fetched] = now
+        # pad the gather to a power-of-2 bucket: micro-batches arrive
+        # at whatever size the frontend coalesced, and an unpadded jit
+        # recompiles per new length — hundred-ms outliers that would
+        # swamp the warm p99. Padded slots gather row 0 (always
+        # allocated) and are sliced off below.
+        n = len(rows)
+        cap = 1 << (max(n, 1) - 1).bit_length()
+        if cap != n:
+            rows = np.concatenate([rows, np.zeros(cap - n, rows.dtype)])
+        return np.asarray(
+            self._pull(self.tier.state, jnp.asarray(rows)))[:n]
+
+    @property
+    def dim(self) -> int:
+        return 1 + self.tier.cache_config.embedx_dim
+
+    def stats(self) -> dict:
+        out = self.tier.stats()
+        out["staleness_refreshes"] = self.refreshes
+        return out

@@ -51,6 +51,36 @@ def test_roundtrip_and_param_swap(tmp_path, rng):
                                rtol=1e-6)
 
 
+def test_reload_params_values_only(tmp_path, rng):
+    # the serving half of the refresh_inference_params delta: another
+    # process rewrites params.npz (export-loop refresh or a serving
+    # replica's feed-triggered dense sync) and a LOADED predictor swaps
+    # values in place — no re-deserialize, no re-compile
+    from paddle_tpu.io.inference import refresh_inference_params
+
+    model, state, predict, x = _model_and_inputs()
+    save_inference_model(str(tmp_path / "m"), predict, state, (x,))
+    pred = load_inference_model(str(tmp_path / "m"))
+    want = np.asarray(pred(x))
+
+    state2 = {"params": {k: v * 0.5 for k, v in state["params"].items()},
+              "buffers": state["buffers"]}
+    refresh_inference_params(str(tmp_path / "m"), state2)
+    np.testing.assert_allclose(np.asarray(pred(x)), want, rtol=1e-6)  # stale
+    pred.reload_params()
+    got = np.asarray(pred(x))
+    assert not np.allclose(got, want)
+    np.testing.assert_allclose(got, np.asarray(predict(state2, x)),
+                               rtol=1e-6)
+
+    # frozen exports have nothing to swap — fail loudly, not silently
+    save_inference_model(str(tmp_path / "f"), predict, state, (x,),
+                         freeze=True)
+    frozen = load_inference_model(str(tmp_path / "f"))
+    with pytest.raises(Exception, match="frozen"):
+        frozen.reload_params()
+
+
 def test_frozen_export(tmp_path):
     model, state, predict, x = _model_and_inputs()
     want = np.asarray(predict(state, x))

@@ -748,6 +748,79 @@ def test_atomic_publish_module_scope_and_ignore(tmp_path):
     assert "atomic-publish" not in _rules(diags)
 
 
+def test_unbounded_queue_flagged_in_threaded_module(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        import queue
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self.q = queue.Queue()
+    """)
+    assert _rules(diags) == {"unbounded-queue"}
+    # deque without maxlen in a threaded module fires too (the PR 5
+    # retrofit class), including the from-import alias form
+    diags = _conv_diags(tmp_path, """
+        import threading
+        from collections import deque as dq
+
+        history = dq()
+    """)
+    assert _rules(diags) == {"unbounded-queue"}
+
+
+def test_unbounded_queue_maxsize_zero_is_unbounded(tmp_path):
+    # Queue(maxsize=0) means INFINITE — the bound must be real
+    diags = _conv_diags(tmp_path, """
+        import queue
+        import threading
+
+        q = queue.Queue(maxsize=0)
+    """)
+    assert _rules(diags) == {"unbounded-queue"}
+
+
+def test_bounded_queue_and_deque_ok(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        import collections
+        import queue
+        import threading
+
+        class Pump:
+            def __init__(self, cap):
+                self.q = queue.Queue(maxsize=cap)
+                self.lifo = queue.LifoQueue(8)
+                self.ring = collections.deque(maxlen=512)
+    """)
+    assert "unbounded-queue" not in _rules(diags)
+
+
+def test_unbounded_queue_unthreaded_module_ok(tmp_path):
+    # no threading import = no producer/consumer concurrency to outrun;
+    # a plain deque window in single-threaded code is fine
+    diags = _conv_diags(tmp_path, """
+        from collections import deque
+
+        def window(it, depth):
+            w = deque()
+            for x in it:
+                w.append(x)
+                if len(w) > depth:
+                    yield w.popleft()
+    """)
+    assert "unbounded-queue" not in _rules(diags)
+
+
+def test_unbounded_queue_ignore_comment(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        import queue
+        import threading
+
+        inbox = queue.Queue()  # graftlint: ignore[unbounded-queue] — credit-bounded
+    """)
+    assert "unbounded-queue" not in _rules(diags)
+
+
 # -- allowlist + driver -----------------------------------------------------
 
 def test_allowlist_filters_and_reports_stale(tmp_path):

@@ -1,0 +1,472 @@
+"""Online serving plane (paddle_tpu/serving + the read-only attach mode
+in csrc/ps_service.cc and the serve QoS class in ps/rpc.py).
+
+Layers under test, bottom-up: read-only server semantics, the serve-QoS
+transport/breaker isolation, replica subscription catch-up
+(snapshot → tail → digest-equal vs the primary), bounded staleness
+under concurrent pushes, the feed-triggered dense-tower sync, the
+frontend's micro-batching / admission control / deadlines, the cached
+warm path's staleness bound, and the acceptance scenario: kill the
+primary mid-serve (server-side chaos faultpoint), the replica keeps
+answering, re-attaches on the promoted epoch, digests converge."""
+
+import threading
+import time
+
+import numpy as np
+# numpy lazy-loads np.testing, and ITS import runs a subprocess (SVE
+# probe). Under the TSAN sweep, a fork once the cluster/lease/shipper
+# threads are live deadlocks the child — import it NOW, while this is
+# the only thread.
+import numpy.testing  # noqa: F401
+import pytest
+
+from paddle_tpu.ps.accessor import AccessorConfig
+from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+from paddle_tpu.ps.table import TableConfig
+
+rpc = pytest.importorskip("paddle_tpu.ps.rpc")
+
+pytestmark = pytest.mark.skipif(
+    not rpc.rpc_available(), reason="native toolchain unavailable")
+
+from paddle_tpu.ps import ha  # noqa: E402  (needs the native lib)
+from paddle_tpu.serving import (CachedLookup, DeadlineExceeded,  # noqa: E402
+                                DenseTowerPublisher, DenseTowerSync,
+                                FrontendConfig, FreshnessProbe,
+                                ReplicaLookup, RequestRejected,
+                                ServingFrontend, ServingReplica)
+
+
+def _acc(dim=4):
+    return AccessorConfig(embedx_dim=dim, embedx_threshold=0.0,
+                          sgd=SGDRuleConfig(initial_range=0.01))
+
+
+def _cfg(dim=4):
+    return TableConfig(shard_num=4, accessor_config=_acc(dim))
+
+
+def _push(rng, keys, width):
+    push = np.zeros((len(keys), width), np.float32)
+    push[:, 1] = 1.0
+    push[:, 2:] = rng.normal(0, 0.1, (len(keys), width - 2)).astype(np.float32)
+    return push
+
+
+def _cluster(**kw):
+    kw.setdefault("num_shards", 1)
+    kw.setdefault("replication", 1)
+    kw.setdefault("sync", True)
+    return ha.HACluster(**kw)
+
+
+def _replica(cluster, shard=0, **kw):
+    kw.setdefault("hb_interval", 0.05)
+    kw.setdefault("hb_ttl", 0.4)
+    return ServingReplica(cluster.store, cluster.job_id, shard=shard, **kw)
+
+
+def _wait_digest_match(cluster, shard, serve_cli, table_id=0, timeout=10.0):
+    """Poll until the replica's digest equals the shard primary's;
+    returns the matching digest (assertion fail on timeout)."""
+    deadline = time.monotonic() + timeout
+    while True:
+        prim = cluster.primary(shard)
+        dg_p = cluster.digests(table_id, shard).get(prim.endpoint)
+        dg_r = serve_cli.digest(table_id)[0]
+        if dg_p is not None and dg_p == dg_r:
+            return dg_r
+        assert time.monotonic() < deadline, \
+            f"replica digest {dg_r} never converged to primary {dg_p}"
+        time.sleep(0.02)
+
+
+# ---------------------------------------------------------------------------
+# read-only attach mode + serve QoS
+# ---------------------------------------------------------------------------
+
+def test_read_only_replica_refuses_training_plane():
+    with _cluster() as cluster:
+        cli = cluster.client()
+        cli.create_sparse_table(0, _cfg())
+        rng = np.random.default_rng(0)
+        keys = np.arange(64, dtype=np.uint64)
+        cli.pull_sparse(0, keys)
+        with _replica(cluster) as rep:
+            serve = rep.client()
+            serve.create_sparse_table(0, _cfg())   # bootstrap: allowed
+            width = serve._dims(0)[1]
+            # training data plane bounces with the read-only error
+            from paddle_tpu.core.enforce import PreconditionNotMetError
+            with pytest.raises(PreconditionNotMetError, match="READ-ONLY"):
+                serve.push_sparse(0, keys[:4], _push(rng, keys[:4], width))
+            # insert-on-miss pulls DOWNGRADE: zeros back, no phantom row
+            sz0 = serve.size(0)
+            out = serve.pull_sparse(
+                0, np.asarray([1 << 50], np.uint64), create=True)
+            assert serve.size(0) == sz0
+            assert np.abs(out).sum() == 0.0
+            assert rep.status()["read_only"]
+
+
+def test_serve_qos_deadline_class_and_breaker_isolation():
+    from paddle_tpu.core.flags import flag
+
+    # serve conns resolve their IO deadline AND attempt budget from the
+    # serve flag family — live at call time, like every pserver_* flag
+    with _cluster() as cluster:
+        serve_cli = cluster.client(qos="serve")
+        train_cli = cluster.client()
+        assert serve_cli._conns[0]._io_flag == "pserver_serve_timeout_ms"
+        assert serve_cli._conns[0]._retry_flag == "pserver_serve_max_retry"
+        assert int(flag("pserver_serve_max_retry")) == 1  # no retries
+        assert train_cli._conns[0]._io_flag == "pserver_timeout_ms"
+        assert train_cli._conns[0]._retry_flag == "pserver_max_retry"
+        # breakers are per-router-instance AND serve uses its own
+        # thresholds: transport failures recorded on the serve router
+        # open ITS breaker only — the training client keeps calling
+        ep = serve_cli._conns[0].endpoint
+        srouter, trouter = serve_cli._router, train_cli._router
+        assert srouter.qos == "serve"
+        assert srouter.breaker(ep).failures == \
+            int(flag("ps_serve_breaker_failures"))
+        for _ in range(srouter.breaker(ep).failures):
+            srouter.record(ep, ok=False)
+        assert srouter.breaker(ep).state == ha.CircuitBreaker.OPEN
+        assert trouter.breaker(ep).state == ha.CircuitBreaker.CLOSED
+        assert trouter.allow(ep)
+
+
+# ---------------------------------------------------------------------------
+# subscription catch-up + staleness + dense feed
+# ---------------------------------------------------------------------------
+
+def test_replica_subscription_catch_up_digest_equal():
+    """Late subscriber: the primary already holds rows whose oplog
+    entries were consumed long ago — attach must take the snapshot path
+    (catalog replay + kSaveAll/kInsertFull + rebase), then the tail,
+    ending digest-equal with the primary."""
+    with _cluster() as cluster:
+        cli = cluster.client()
+        cli.create_sparse_table(0, _cfg())
+        rng = np.random.default_rng(1)
+        keys = rng.integers(0, 1 << 40, 2000).astype(np.uint64)
+        cli.pull_sparse(0, keys)
+        width = cli._dims(0)[1]
+        cli.push_sparse(0, keys, _push(rng, keys, width))
+        with _replica(cluster) as rep:
+            serve = rep.client()
+            serve.create_sparse_table(0, _cfg())
+            _wait_digest_match(cluster, 0, serve)
+            # tail: a post-attach push flows through the feed (no new
+            # snapshot needed) and digests stay equal after drain
+            cli.push_sparse(0, keys[:100], _push(rng, keys[:100], width))
+            cluster.drain()
+            prim = cluster.primary(0)
+            assert cluster.digests(0, 0)[prim.endpoint] == \
+                serve.digest(0)[0]
+            assert rep.status()["applied_seq"] > 0
+
+
+def test_replica_bounded_staleness_under_concurrent_pushes():
+    """Freshness SLO shape: while a writer hammers the table, a marker
+    push becomes SERVABLE on the replica within the probe timeout,
+    every time (freshness_failures == 0) — the push→servable metric
+    SERVING.json gates at p95 ≤ 100 ms."""
+    with _cluster() as cluster:
+        cli = cluster.client()
+        cli.create_sparse_table(0, _cfg())
+        rng = np.random.default_rng(2)
+        keys = np.arange(512, dtype=np.uint64)
+        cli.pull_sparse(0, keys)
+        width = cli._dims(0)[1]
+        marker_key = np.asarray([1 << 41], np.uint64)
+        cli.pull_sparse(0, marker_key)
+        with _replica(cluster) as rep:
+            serve = rep.client()
+            serve.create_sparse_table(0, _cfg())
+            _wait_digest_match(cluster, 0, serve)
+            stop = threading.Event()
+
+            def writer():
+                while not stop.is_set():
+                    cli.push_sparse(0, keys, _push(rng, keys, width))
+
+            th = threading.Thread(target=writer)
+            th.start()
+            try:
+                probe = FreshnessProbe(timeout_s=5.0)
+                marker = [0.0]
+
+                def write():
+                    marker[0] += 1.0
+                    mp = np.zeros((1, width), np.float32)
+                    # click stat (push layout [slot, show, click, ...]):
+                    # additive, so the cumulative value is >= marker the
+                    # moment THIS push is applied — and it reads back
+                    # directly as pull column 1
+                    mp[0, 2] = marker[0]
+                    cli.push_sparse(0, marker_key, mp)
+
+                def read():
+                    return serve.pull_sparse(0, marker_key,
+                                             create=False)[0, 1]
+
+                for _ in range(5):
+                    probe.measure(write, read,
+                                  lambda v, m=marker: v >= m[0])
+            finally:
+                stop.set()
+                th.join()
+            st = probe.stats()
+            assert st["failures"] == 0, st
+            assert st["p95_ms"] < 5000, st
+            # the feed applied entries recently (bounded staleness)
+            assert rep.status()["since_last_apply_s"] < 5.0
+
+
+def test_dense_tower_feed_triggered_sync():
+    """The values-only dense delta path: publisher set_dense →
+    replicated apply bumps dense_version → replica watcher pulls and
+    rebuilds the pytree — no export loop, no byte polling."""
+    with _cluster() as cluster:
+        cli = cluster.client()
+        params = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                  "b": np.zeros(3, np.float32)}
+        pub = DenseTowerPublisher(cli, 7, params)
+        with _replica(cluster) as rep:
+            got = []
+            DenseTowerSync(rep, 7, pub.dim, pub.unravel,
+                           sink=lambda p: got.append(p))
+            pub.publish({"w": params["w"] + 1.0, "b": params["b"] + 2.0})
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if got and np.allclose(np.asarray(got[-1]["b"]), 2.0):
+                    break
+                time.sleep(0.01)
+            assert got, "dense sync never fired"
+            np.testing.assert_allclose(np.asarray(got[-1]["w"]),
+                                       params["w"] + 1.0)
+            np.testing.assert_allclose(np.asarray(got[-1]["b"]), 2.0)
+            assert rep.status()["dense_refreshes"] >= 1
+            assert rep.status()["sync_errors"] == 0
+
+
+# ---------------------------------------------------------------------------
+# frontend: micro-batching, shedding, deadlines
+# ---------------------------------------------------------------------------
+
+class _StubLookup:
+    """Deterministic source: value row = [key, key+0.5]; counts calls
+    and can inject latency (shedding tests)."""
+
+    def __init__(self, delay_s=0.0):
+        self.calls = 0
+        self.keys_seen = 0
+        self.delay_s = delay_s
+
+    def lookup(self, keys):
+        self.calls += 1
+        self.keys_seen += len(keys)
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        k = keys.astype(np.float64)
+        return np.stack([k, k + 0.5], axis=1).astype(np.float32)
+
+
+def test_frontend_micro_batches_and_scatters_correctly():
+    src = _StubLookup()
+    with ServingFrontend(src, config=FrontendConfig(
+            max_batch=16, max_delay_us=5000, queue_cap=256)) as fe:
+        pending = [(i, fe.submit(np.arange(i * 8, i * 8 + 8,
+                                           dtype=np.uint64),
+                                 deadline_ms=5000))
+                   for i in range(48)]
+        for i, p in pending:
+            out = p.result(10)
+            assert out.shape == (8, 2)
+            np.testing.assert_allclose(
+                out[:, 0], np.arange(i * 8, i * 8 + 8, dtype=np.float32))
+        st = fe.stats()
+        assert st["served"] == 48
+        # coalescing happened: far fewer lookup calls than requests
+        assert src.calls <= 48 // 2, (src.calls, st)
+        assert st["avg_batch"] > 1
+
+
+def test_frontend_infer_receives_stacked_batch():
+    src = _StubLookup()
+
+    def infer(emb, dense):
+        # [B, S, d] × [B, D] → per-request scalar
+        return emb[:, :, 0].sum(axis=1) + dense[:, 0]
+
+    with ServingFrontend(src, infer=infer, config=FrontendConfig(
+            max_batch=8, max_delay_us=2000, queue_cap=64)) as fe:
+        keys = np.asarray([3, 4], np.uint64)
+        out = fe(keys, dense=np.asarray([10.0], np.float32),
+                 deadline_ms=5000)
+        assert float(out) == 3 + 4 + 10.0
+
+
+def test_frontend_admission_control_sheds_under_overload():
+    src = _StubLookup(delay_s=0.05)
+    fe = ServingFrontend(src, config=FrontendConfig(
+        max_batch=4, max_delay_us=100, queue_cap=4, retry_after_ms=7.0))
+    try:
+        accepted, shed = [], 0
+        for _ in range(64):
+            try:
+                accepted.append(fe.submit(np.arange(4, dtype=np.uint64),
+                                          deadline_ms=30000))
+            except RequestRejected as e:
+                shed += 1
+                assert e.retry_after_ms == 7.0
+        assert shed > 0, "overload never shed"
+        assert fe.stats()["shed"] == shed
+        # everything ADMITTED completes (bounded queue drains; nothing
+        # is silently dropped)
+        for p in accepted:
+            assert p.result(30).shape == (4, 2)
+    finally:
+        fe.stop()
+    # post-stop submits are refused, queued work was failed loudly
+    with pytest.raises(RequestRejected):
+        fe.submit(np.arange(4, dtype=np.uint64))
+
+
+def test_frontend_deadline_dropped_before_lookup():
+    src = _StubLookup(delay_s=0.03)
+    with ServingFrontend(src, config=FrontendConfig(
+            max_batch=2, max_delay_us=100, queue_cap=64)) as fe:
+        # saturate the worker so later submits sit in the queue past
+        # their deadline
+        slow = [fe.submit(np.arange(2, dtype=np.uint64), deadline_ms=30000)
+                for _ in range(6)]
+        doomed = fe.submit(np.arange(2, dtype=np.uint64), deadline_ms=1)
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(30)
+        for p in slow:
+            p.result(30)
+        st = fe.stats()
+        assert st["deadline_dropped"] >= 1
+        # the doomed request's keys were never looked up
+        assert src.keys_seen == 2 * 6
+
+
+# ---------------------------------------------------------------------------
+# warm path: cached lookup over the replica
+# ---------------------------------------------------------------------------
+
+def test_cached_lookup_warm_zero_rpc_and_staleness_bound():
+    from paddle_tpu.ps.hot_tier import HotEmbeddingTier, HotTierConfig
+
+    with _cluster() as cluster:
+        cli = cluster.client()
+        cli.create_sparse_table(0, _cfg())
+        rng = np.random.default_rng(3)
+        keys = np.arange(256, dtype=np.uint64)
+        cli.pull_sparse(0, keys)
+        width = cli._dims(0)[1]
+        cli.push_sparse(0, keys, _push(rng, keys, width))
+        with _replica(cluster) as rep:
+            serve = rep.client()
+            view = rep.serve_view(0, _cfg(), client=serve)
+            _wait_digest_match(cluster, 0, serve)
+            tier = HotEmbeddingTier(view, HotTierConfig(
+                capacity=1 << 10, create_on_miss=False))
+            cl = CachedLookup(tier, replica=rep, freshness_budget_s=0.03)
+            v0 = cl.lookup(keys)
+            assert v0.shape == (len(keys), 1 + 4)
+            # WARM: repeated lookups perform zero RPCs of any kind
+            serve.reset_op_counts()
+            v1 = cl.lookup(keys)
+            assert serve.reset_op_counts() == {}
+            np.testing.assert_array_equal(v0, v1)
+            # idle feed: rows stay resident past the budget (no churn)
+            time.sleep(0.05)
+            serve.reset_op_counts()
+            cl.lookup(keys)
+            assert serve.reset_op_counts() == {}
+            # a push that ADVANCES the feed makes warm rows refresh
+            # once their budget expires — bounded staleness
+            cli.push_sparse(0, keys[:16], _push(rng, keys[:16], width))
+            cluster.drain()
+            time.sleep(0.05)  # budget expiry
+            v2 = cl.lookup(keys[:16])
+            assert not np.allclose(v1[:16], v2)
+            assert cl.refreshes >= 16
+            # the refreshed values match the replica's table exactly
+            direct = ReplicaLookup(serve, 0).lookup(keys[:16])
+            np.testing.assert_array_equal(v2[:, 0], direct[:, 2])
+
+
+# ---------------------------------------------------------------------------
+# acceptance: serve through failover (chaos-gated)
+# ---------------------------------------------------------------------------
+
+def test_serve_through_failover_reattach_and_converge():
+    """Kill the primary mid-serve via the server-side chaos faultpoint
+    (armed kill-shard on the Nth push — deterministic death under
+    traffic). The replica must keep answering throughout (stale but
+    bounded), re-attach once the coordinator promotes the backup, and
+    end digest-identical to the new primary."""
+    with _cluster(replication=2) as cluster:
+        cli = cluster.client()
+        cli.create_sparse_table(0, _cfg())
+        rng = np.random.default_rng(4)
+        keys = np.arange(400, dtype=np.uint64)
+        cli.pull_sparse(0, keys)
+        width = cli._dims(0)[1]
+        cli.push_sparse(0, keys, _push(rng, keys, width))
+        with _replica(cluster) as rep:
+            serve = rep.client()
+            serve.create_sparse_table(0, _cfg())
+            _wait_digest_match(cluster, 0, serve)
+            epoch0 = rep.status()["epoch"]
+            prim = cluster.primary(0)
+            # chaos: the 3rd push from now kills the primary mid-run
+            prim.server.arm_fault("kill-shard", cmd=rpc._PUSH_SPARSE,
+                                  after=3)
+            serve_errors = 0
+            promoted = []
+
+            def reader():
+                # serve continuously through the death+promotion window
+                nonlocal serve_errors
+                while not promoted:
+                    try:
+                        out = serve.pull_sparse(0, keys[:32], create=False)
+                        assert out.shape == (32, cli._dims(0)[0])
+                    except Exception:  # noqa: BLE001 — counted, asserted 0
+                        serve_errors += 1
+                    time.sleep(0.005)
+
+            th = threading.Thread(target=reader)
+            th.start()
+            try:
+                # pushes ride the router: the one that hits the armed
+                # fault replays against the promoted backup
+                for _ in range(6):
+                    cli.push_sparse(0, keys[:64],
+                                    _push(rng, keys[:64], width))
+                    time.sleep(0.02)
+                new_prim = cluster.wait_promoted(0, prim.endpoint)
+            finally:
+                promoted.append(True)
+                th.join()
+            assert serve_errors == 0, \
+                f"{serve_errors} serve reads failed during failover"
+            # more traffic through the new primary, then convergence
+            cli.push_sparse(0, keys, _push(rng, keys, width))
+            deadline = time.monotonic() + 15
+            while True:
+                dg = cluster.digests(0, 0).get(new_prim)
+                if dg is not None and dg == serve.digest(0)[0]:
+                    break
+                assert time.monotonic() < deadline, "never reconverged"
+                time.sleep(0.05)
+            st = rep.status()
+            assert st["epoch"] > epoch0, st    # re-attached on new epoch
+            assert st["epoch_changes"] >= 1, st

@@ -36,6 +36,18 @@
                    the pattern ``ps/rpc.py`` _ServerConn.call follows).
                    Plain polling loops (no except) are fine, as is any
                    sleep whose duration is computed from a variable.
+  unbounded-queue  ``queue.Queue()`` / ``queue.LifoQueue()`` /
+                   ``collections.deque()`` constructed WITHOUT a bound
+                   (no ``maxsize``/``maxlen``, or ``maxsize<=0``) in a
+                   module that imports ``threading`` — threaded
+                   producer/consumer code. A producer that outruns its
+                   consumer grows memory and tail latency without limit
+                   (the class PR 5 had to retrofit bounded deques for,
+                   and the failure mode serving admission control
+                   exists to prevent): bound the queue and make the
+                   producer block or shed at the bound. Flow-controlled
+                   cases (credit protocols) get an ignore/allowlist
+                   entry with the justification.
   atomic-publish   an ``os.replace``/``os.rename`` publish in a scope
                    that never fsyncs: the rename can land while the
                    renamed content is still dirty page cache, so a crash
@@ -170,6 +182,35 @@ def _roundtrip_in_block(stmts, emit) -> None:
 
 _PUBLISH_ATTRS = {"replace", "rename"}
 
+_QUEUE_ATTRS = {"Queue", "LifoQueue"}
+
+
+def _queue_bound_arg(call: ast.Call, kind: str):
+    """The bounding argument node of a Queue/deque constructor call:
+    Queue(maxsize)/LifoQueue(maxsize) take it as arg 0 or ``maxsize=``;
+    deque(iterable, maxlen) as arg 1 or ``maxlen=``. None = absent."""
+    kw_name = "maxsize" if kind == "queue" else "maxlen"
+    pos = 0 if kind == "queue" else 1
+    if len(call.args) > pos:
+        return call.args[pos]
+    for kw in call.keywords:
+        if kw.arg == kw_name:
+            return kw.value
+    return None
+
+
+def _queue_is_unbounded(call: ast.Call, kind: str) -> bool:
+    arg = _queue_bound_arg(call, kind)
+    if arg is None:
+        return True
+    if isinstance(arg, ast.Constant):
+        if arg.value is None:
+            return True  # deque(it, maxlen=None)
+        if kind == "queue" and isinstance(arg.value, (int, float)) \
+                and arg.value <= 0:
+            return True  # Queue(maxsize=0) means INFINITE
+    return False
+
 
 def _check_atomic_publish(tree: ast.AST, emit, os_aliases: Set[str],
                           pub_bare: Set[str]) -> None:
@@ -272,6 +313,11 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
     sleep_func_aliases: Set[str] = set()
     os_mod_aliases = {"os"}
     publish_bare: Set[str] = set()  # from os import replace/rename [as x]
+    queue_mod_aliases: Set[str] = set()   # import queue [as q]
+    coll_mod_aliases: Set[str] = set()    # import collections [as c]
+    queue_bare: Set[str] = set()   # from queue import Queue/LifoQueue [as x]
+    deque_bare: Set[str] = set()   # from collections import deque [as x]
+    threaded = False               # module imports threading
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
@@ -279,6 +325,12 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
                     time_mod_aliases.add(a.asname or "time")
                 elif a.name == "os":
                     os_mod_aliases.add(a.asname or "os")
+                elif a.name == "queue":
+                    queue_mod_aliases.add(a.asname or "queue")
+                elif a.name == "collections":
+                    coll_mod_aliases.add(a.asname or "collections")
+                elif a.name == "threading":
+                    threaded = True
         elif isinstance(node, ast.ImportFrom):
             if node.module == "time" and not node.level:
                 for a in node.names:
@@ -290,6 +342,30 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
                 for a in node.names:
                     if a.name in _PUBLISH_ATTRS:
                         publish_bare.add(a.asname or a.name)
+            elif node.module == "queue" and not node.level:
+                for a in node.names:
+                    if a.name in _QUEUE_ATTRS:
+                        queue_bare.add(a.asname or a.name)
+            elif node.module == "collections" and not node.level:
+                for a in node.names:
+                    if a.name == "deque":
+                        deque_bare.add(a.asname or a.name)
+            elif node.module == "threading" and not node.level:
+                threaded = True
+
+    def _queue_kind(call: ast.Call):
+        name = dotted(call.func)
+        if name in queue_bare:
+            return "queue"
+        if name in deque_bare:
+            return "deque"
+        if name and "." in name:
+            mod, _, attr = name.rpartition(".")
+            if mod in queue_mod_aliases and attr in _QUEUE_ATTRS:
+                return "queue"
+            if mod in coll_mod_aliases and attr == "deque":
+                return "deque"
+        return None
 
     _check_atomic_publish(tree, emit, os_mod_aliases, publish_bare)
 
@@ -358,6 +434,17 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
                      "place — a wire-width no-op (FP16AllReduce bug class); "
                      "route the dtype to the collective (comm_fusion) or "
                      "add an ignore with justification")
+            if threaded:
+                kind = _queue_kind(node)
+                if kind is not None and _queue_is_unbounded(node, kind):
+                    emit(node, "unbounded-queue",
+                         "unbounded queue.Queue()/deque() in a module "
+                         "that runs threads — a producer that outruns "
+                         "its consumer grows memory and tail latency "
+                         "without limit; bound it (maxsize=/maxlen=) "
+                         "and block or shed at the bound (the serving "
+                         "admission-control pattern), or justify a "
+                         "flow-controlled case with an ignore")
             if name in ("os.environ.get", "os.getenv") and \
                     rel not in ENV_READ_OK:
                 emit(node, "env-read",
@@ -395,7 +482,8 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
 def run(root: str) -> List[Diagnostic]:
     diags: List[Diagnostic] = []
     all_rules = {"time-time", "bare-except", "mutable-default", "env-read",
-                 "cast-roundtrip", "sleep-no-backoff", "atomic-publish"}
+                 "cast-roundtrip", "sleep-no-backoff", "atomic-publish",
+                 "unbounded-queue"}
     for p in walk_py(root, ("paddle_tpu",), ("bench.py",)):
         diags.extend(check_file(p, root, all_rules))
     tools_dir = os.path.join(root, "tools")

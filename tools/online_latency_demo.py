@@ -1,25 +1,35 @@
-"""Online-learning latency: record-arrival → updated-serving-export
-(VERDICT r4 next #7).
+"""Online-learning latency: record-arrival → servable, two ways
+(VERDICT r4 next #7; ISSUE 7 change-feed column).
 
 The reference's banner claim includes REAL-TIME update of huge sparse
 models (README.md:31-34): records stream in, trainers push through the
 async communicator (the_one_ps a_sync mode), and the serving side keeps
-serving fresh parameters. This artifact measures that loop end to end on
-the repo's own pieces:
+serving fresh parameters. This artifact measures that loop end to end
+on the repo's own pieces, as TWO columns of the same JSON:
 
-    stream batch arrives (MultiSlot text) → CtrStreamTrainer (pull →
-    jitted step → push via AsyncCommunicator) → queues drained →
-    serving refresh (fresh HbmEmbeddingCache begin_pass over the
-    serving keys — read-only: no end_pass flush) →
-    export_ctr_inference writes the new serving program+tables.
+- **export loop** (the legacy baseline): stream batch arrives
+  (MultiSlot text) → CtrStreamTrainer (pull → jitted step → push via
+  AsyncCommunicator) → queues drained → serving refresh (fresh
+  HbmEmbeddingCache begin_pass over the serving keys — read-only: no
+  end_pass flush) → export_ctr_inference writes the new serving
+  program+tables. Freshness = a new export on disk.
+- **change feed** (paddle_tpu/serving): the same stream trains against
+  an HA cluster whose oplog a read-only ServingReplica subscribes to;
+  freshness = the round's last push APPLIED on the replica (a marker
+  push ordered behind the round in the oplog ring becomes visible
+  through the serve read path). No refresh pass, no export, no
+  re-serialize — the feed carries each mutation as it happens.
 
-Per round it records component times and the total arrival→export-
-on-disk latency; the artifact reports p50/p95 plus a freshness check
-(the exported embed_w for streamed keys really moved each round).
-
-Emits one JSON line (committed as ONLINE.json). Knobs: ONLINE_POP
-(preloaded population, default 2e6), ONLINE_ROUNDS (20), ONLINE_BATCH
-(512), ONLINE_SERVE_KEYS (50k). Single-core host: run ALONE.
+Per round each column records component times and the total
+arrival→servable latency; the artifact reports p50/p95 plus a
+freshness check (served embed_w for streamed keys really moved each
+round). Emits one JSON line (committed as ONLINE.json). Knobs:
+ONLINE_POP (export-loop preloaded population, default 2e6),
+ONLINE_ROUNDS (20), ONLINE_BATCH (512), ONLINE_SERVE_KEYS (50k),
+ONLINE_FEED_POP (change-feed preload, default 200k — per-op feed
+latency is table-size independent, unlike the export loop),
+ONLINE_FULL_EXPORT=1 adds the full-export-every-round column.
+Single-core host: run ALONE.
 """
 
 import json
@@ -126,12 +136,18 @@ def main() -> None:
             lines.append(" ".join(parts))
         return lines
 
-    rows = []
-    prev_embed = None
-    export_dir = os.path.join(base, "serve")
-    fresh_fail = 0
-    stream_path = os.path.join(base, "stream.txt")
-    try:
+    def percentiles(rows):
+        totals = sorted(x["total_s"] for x in rows)
+        return {
+            "latency_p50_s": totals[len(totals) // 2],
+            "latency_p95_s": totals[min(int(len(totals) * 0.95),
+                                        len(totals) - 1)],
+            "latency_max_s": totals[-1],
+            "components_last": rows[-1],
+        }
+
+    def export_loop_rounds(export_dir, refresh_after_first):
+        rows, fresh_fail, prev_embed = [], 0, None
         for r in range(rounds):
             with open(stream_path, "w") as f:
                 f.write("\n".join(make_batch_lines()))
@@ -149,12 +165,14 @@ def main() -> None:
                 device_map=True)
             cache.begin_pass(serve_keys)      # read-only: no end_pass
             t_refreshed = time.perf_counter()
-            # round 0 exports the full program; later rounds overwrite
-            # only the serving values (refresh_inference_params) — the
-            # shapes are identical between refreshes by construction
+            # refresh_after_first: round 0 exports the full program,
+            # later rounds overwrite only the serving values
+            # (refresh_inference_params) — the shapes are identical
+            # between refreshes by construction
             export_ctr_inference(export_dir, model, cache, slot_hi, D,
                                  params=trainer.params["params"],
-                                 refresh_only=r > 0)
+                                 refresh_only=refresh_after_first
+                                 and r > 0)
             t_exported = time.perf_counter()
 
             embed = np.asarray(cache.state["embed_w"])
@@ -167,30 +185,176 @@ def main() -> None:
                 "export_s": round(t_exported - t_refreshed, 4),
                 "total_s": round(t_exported - t_arrive, 4),
             })
+        return rows, fresh_fail
+
+    stream_path = os.path.join(base, "stream.txt")
+    try:
+        rows, fresh_fail = export_loop_rounds(
+            os.path.join(base, "serve"), refresh_after_first=True)
+        full_export = None
+        if os.environ.get("ONLINE_FULL_EXPORT", "0") == "1":
+            f_rows, _ = export_loop_rounds(
+                os.path.join(base, "serve_full"),
+                refresh_after_first=False)
+            full_export = percentiles(f_rows)
+        feed = _change_feed_rounds(base, rounds, batch, make_batch_lines,
+                                   slots, acc, dim, hot_ids)
     finally:
         comm.stop()
         shutil.rmtree(base, ignore_errors=True)
 
-    totals = sorted(x["total_s"] for x in rows)
     out = {
         "population": int(vocab) * S,
         "serve_keys": int(len(serve_keys)),
         "batch": batch,
         "rounds": rounds,
         "preload_s": round(preload_s, 2),
-        "latency_p50_s": totals[len(totals) // 2],
-        "latency_p95_s": totals[min(int(len(totals) * 0.95),
-                                    len(totals) - 1)],
-        "latency_max_s": totals[-1],
-        "components_last": rows[-1],
+        **percentiles(rows),
         "freshness_failures": fresh_fail,
-        "ok": fresh_fail == 0,
+        "ok": fresh_fail == 0 and feed.get("freshness_failures") == 0,
         "host_cores": os.cpu_count(),
-        "note": ("arrival→updated-serving-export, async communicator "
-                 "drained per round; single CPU core — chip-hosted "
-                 "serving would overlap train/export"),
+        "note": ("arrival→updated-serving-export (baseline column) vs "
+                 "arrival→applied-on-replica over the replication "
+                 "change feed (change_feed column, paddle_tpu/serving);"
+                 " async communicator drained per round; single CPU "
+                 "core — chip-hosted serving would overlap "
+                 "train/export"),
+        "change_feed": feed,
     }
+    if full_export is not None:
+        out["full_export_every_round_run"] = full_export
     print(json.dumps(out))
+
+
+def _change_feed_rounds(base, rounds, batch, make_batch_lines, slots,
+                        acc, dim, hot_ids):
+    """The change-feed column: the same stream shape trains against an
+    HA cluster (RpcPsClient + HalfAsyncCommunicator over NativePsServer
+    primaries) with a read-only ServingReplica subscribed to the oplog.
+    Per round, a marker push issued AFTER the round's training pushes
+    is ordered behind them in the (single-shard, FIFO) oplog ring — the
+    moment it is visible through the serve read path, every push of the
+    round is servable. total_s = arrival → servable, no export."""
+    import time
+
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.data.dataset import QueueDataset
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM
+    from paddle_tpu.ps import ha
+    from paddle_tpu.ps.communicator import HalfAsyncCommunicator
+    from paddle_tpu.ps.ps_trainer import CtrStreamTrainer
+    from paddle_tpu.ps.table import TableConfig
+    from paddle_tpu.serving import ReplicaLookup, ServingReplica
+
+    feed_pop = int(float(os.environ.get("ONLINE_FEED_POP", 200_000)))
+    S, D = 8, 4
+    rng = np.random.default_rng(7)
+    stream_path = os.path.join(base, "feed_stream.txt")
+
+    with ha.HACluster(num_shards=1, replication=1, sync=False) as cluster:
+        cli = cluster.client()
+        cli.create_sparse_table(0, TableConfig(
+            shard_num=8, accessor_config=acc))
+        # preload: the live population the stream updates (the feed's
+        # per-op latency is table-size independent — recorded, not
+        # matched to the export column's ANCHOR-scale table)
+        t0 = time.perf_counter()
+        width = None
+        for lo in range(0, feed_pop, 1 << 15):
+            n = min(1 << 15, feed_pop - lo)
+            ids = np.arange(lo, lo + n, dtype=np.uint64)
+            keys = (np.uint64(lo % S) << np.uint64(32)) + ids
+            cli.pull_sparse(0, keys)
+            if width is None:
+                width = cli._dims(0)[1]
+            push = np.zeros((n, width), np.float32)
+            push[:, 1] = 1.0
+            push[:, 3:] = 0.01 * rng.standard_normal(
+                (n, width - 3)).astype(np.float32)
+            cli.push_sparse(0, keys, push)
+        preload_s = time.perf_counter() - t0
+
+        comm = HalfAsyncCommunicator(cli)
+        comm.start()
+        pt.seed(0)
+        trainer = CtrStreamTrainer(
+            DeepFM(CtrConfig(num_sparse_slots=S, num_dense=D,
+                             embedx_dim=dim, dnn_hidden=(64, 64))),
+            optimizer.Adam(1e-3), None, embedx_dim=dim,
+            sparse_slots=[f"s{i}" for i in range(S)],
+            dense_slots=[f"d{i}" for i in range(D)],
+            label_slot="label", communicator=comm, table_id=0)
+
+        rep = ServingReplica(cluster.store, cluster.job_id, shard=0)
+        try:
+            serve = rep.client()
+            serve.create_sparse_table(0, TableConfig(
+                shard_num=8, accessor_config=acc))
+            lookup = ReplicaLookup(serve, 0)
+            # wait for the subscription snapshot to land
+            prim = cluster.primary(0)
+            deadline = time.monotonic() + 120
+            while cluster.digests(0, 0).get(prim.endpoint) != \
+                    serve.digest(0)[0]:
+                if time.monotonic() > deadline:
+                    raise TimeoutError("replica never caught up")
+                time.sleep(0.05)
+
+            marker_key = np.asarray([np.uint64(1) << np.uint64(41)],
+                                    np.uint64)
+            cli.pull_sparse(0, marker_key)
+            # probe slot-0 keys from the streamed hot-id set: a round
+            # trains a few hundred of them, so "none of 128 probes
+            # moved" means the feed really went stale
+            probe_keys = rng.choice(hot_ids, 128,
+                                    replace=False).astype(np.uint64)
+            rows, fresh_fail, marker, prev = [], 0, 0.0, None
+            for r in range(rounds):
+                with open(stream_path, "w") as f:
+                    f.write("\n".join(make_batch_lines()))
+                ds = QueueDataset(slots)
+                ds.set_filelist([stream_path])
+                t_arrive = time.perf_counter()
+                trainer.train_from_dataset(ds, batch_size=batch,
+                                           drop_last=False)
+                t_trained = time.perf_counter()  # pushes acked on the PS
+                marker += 1.0
+                mp = np.zeros((1, width), np.float32)
+                mp[0, 2] = marker  # click stat: additive, pull col 1
+                cli.push_sparse(0, marker_key, mp)
+                while lookup.lookup(marker_key)[0, 1] < marker:
+                    time.sleep(0.0002)
+                t_servable = time.perf_counter()
+                served = lookup.lookup(probe_keys)
+                if prev is not None and np.allclose(served, prev):
+                    fresh_fail += 1  # served state did not move
+                prev = served
+                rows.append({
+                    "train_s": round(t_trained - t_arrive, 4),
+                    "feed_s": round(t_servable - t_trained, 4),
+                    "total_s": round(t_servable - t_arrive, 4),
+                })
+            totals = sorted(x["total_s"] for x in rows)
+            feeds = sorted(x["feed_s"] for x in rows)
+            return {
+                "population": feed_pop,
+                "preload_s": round(preload_s, 2),
+                "latency_p50_s": totals[len(totals) // 2],
+                "latency_p95_s": totals[min(int(len(totals) * 0.95),
+                                            len(totals) - 1)],
+                "push_to_servable_p50_s": feeds[len(feeds) // 2],
+                "push_to_servable_p95_s": feeds[
+                    min(int(len(feeds) * 0.95), len(feeds) - 1)],
+                "components_last": rows[-1],
+                "freshness_failures": fresh_fail,
+                "replica": rep.status(),
+            }
+        finally:
+            comm.stop()
+            rep.close()
 
 
 if __name__ == "__main__":

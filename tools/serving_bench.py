@@ -1,0 +1,338 @@
+"""Online-serving-plane bench (ROADMAP item 2 rung; ISSUE 7 acceptance).
+
+Drives the real plane end to end — an HA training cluster
+(NativePsServer + ReplicationManager), a :class:`ServingReplica`
+subscribed to the oplog change feed, the dense-tower values-only sync,
+and a :class:`ServingFrontend` micro-batching requests over the warm
+``CachedLookup`` path — and measures the two SLOs SERVING.json gates:
+
+- **warm latency**: lookup+infer request latency (submit → delivered)
+  with the working set resident in the hot tier — zero RPCs of any
+  kind per warm request, counted, not assumed. Target: p99 in
+  single-digit ms at the bench batch size.
+- **freshness**: push→servable — a marker stat pushed on the TRAINING
+  client, polled until visible through the SERVING path — measured
+  under concurrent writer traffic. Target: p95 ≤ 100 ms with
+  ``freshness_failures == 0``, vs the ≈1.38 s p95 arrival→export loop
+  in the committed ONLINE.json (quoted as the baseline column).
+
+Standalone: prints exactly ONE JSON line (driver contract). Importable:
+``run()`` returns the record. Env knobs: SB_KEYS (warm population,
+default 20k), SB_BATCH (frontend max_batch, 64), SB_REQUESTS (warm
+requests measured, 2000), SB_CONCURRENCY (closed-loop submitters, 8),
+SB_PROBES (freshness probes, 25), SB_DIM (embedx dim, 8). Shared-host
+note: ambient load on a 2-core CI box moves the p99 by 2-3x between
+runs — the CI gate thresholds carry headroom for that; the committed
+SERVING.json is a quiet-host run.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+METRIC = "serving_warm_p99_ms"
+
+
+def run() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from paddle_tpu.ps import ha
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.hot_tier import HotEmbeddingTier, HotTierConfig
+    from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+    from paddle_tpu.ps.table import TableConfig
+    from paddle_tpu.serving import (CachedLookup, DenseTowerPublisher,
+                                    DenseTowerSync, FreshnessProbe,
+                                    FrontendConfig, ReplicaLookup,
+                                    ServingFrontend, ServingReplica)
+
+    S, D = 8, 4                       # sparse slots per request / dense feats
+    xd = int(os.environ.get("SB_DIM", 8))
+    n_keys = int(float(os.environ.get("SB_KEYS", 20_000)))
+    max_batch = int(os.environ.get("SB_BATCH", 64))
+    n_requests = int(float(os.environ.get("SB_REQUESTS", 2000)))
+    concurrency = int(os.environ.get("SB_CONCURRENCY", 8))
+    n_probes = int(os.environ.get("SB_PROBES", 25))
+
+    rng = np.random.default_rng(0)
+    cfg = TableConfig(shard_num=8, accessor_config=AccessorConfig(
+        embedx_dim=xd, embedx_threshold=0.0,
+        sgd=SGDRuleConfig(initial_range=0.01)))
+
+    with ha.HACluster(num_shards=1, replication=1, sync=False) as cluster:
+        train_cli = cluster.client()
+        train_cli.create_sparse_table(0, cfg)
+        keys = np.arange(n_keys, dtype=np.uint64)
+        width = None
+
+        # preload: create + one push so embedx is initialized (the warm
+        # population a serving frontend would carry)
+        t0 = time.perf_counter()
+        chunk = 1 << 15
+        for lo in range(0, n_keys, chunk):
+            kc = keys[lo:lo + chunk]
+            train_cli.pull_sparse(0, kc)
+            if width is None:
+                width = train_cli._dims(0)[1]
+            push = np.zeros((len(kc), width), np.float32)
+            push[:, 1] = 1.0
+            push[:, 3:] = 0.01 * rng.standard_normal(
+                (len(kc), width - 3)).astype(np.float32)
+            train_cli.push_sparse(0, kc, push)
+        preload_s = time.perf_counter() - t0
+
+        # dense tower: tiny MLP head over [B, S*(1+xd)] emb ++ [B, D]
+        x_dim = S * (1 + xd) + D
+        params = {"w1": 0.1 * rng.standard_normal((x_dim, 16)).astype(
+                      np.float32),
+                  "b1": np.zeros(16, np.float32),
+                  "w2": 0.1 * rng.standard_normal((16, 1)).astype(np.float32),
+                  "b2": np.zeros(1, np.float32)}
+        pub = DenseTowerPublisher(train_cli, 7, params)
+        pub.publish(params)
+
+        rep = ServingReplica(cluster.store, cluster.job_id, shard=0)
+        frontend = None
+        try:
+            serve_cli = rep.client()
+            view = rep.serve_view(0, cfg, client=serve_cli)
+
+            # subscription catch-up: poll until digest-equal (the
+            # snapshot path for a late joiner, then the live tail)
+            t0 = time.perf_counter()
+            prim = cluster.primary(0)
+            deadline = t0 + 60
+            while True:
+                dg = cluster.digests(0, 0).get(prim.endpoint)
+                if dg is not None and dg == serve_cli.digest(0)[0]:
+                    break
+                if time.perf_counter() > deadline:
+                    raise TimeoutError("replica never converged to primary")
+                time.sleep(0.02)
+            catch_up_s = time.perf_counter() - t0
+
+            # feed-triggered dense sync into the jitted infer's params
+            live = {"params": jax.device_put(params)}
+
+            def _mlp(p, emb, dense):
+                x = jnp.concatenate(
+                    [emb.reshape(emb.shape[0], -1), dense], axis=1)
+                h = jnp.tanh(x @ p["w1"] + p["b1"])
+                return (h @ p["w2"] + p["b2"]).reshape(-1)
+
+            infer_jit = jax.jit(_mlp)
+
+            def infer(emb, dense):
+                # micro-batches arrive at whatever size coalesced —
+                # pad rows up to the next power of two so XLA compiles
+                # a handful of bucket shapes once, not every size (an
+                # unpadded jit recompiles per new B: ~200 ms outliers
+                # that swamp the p99 this bench exists to measure)
+                B = emb.shape[0]
+                Bp = 1 << (max(B, 1) - 1).bit_length()
+                if Bp != B:
+                    emb = np.concatenate(
+                        [emb, np.zeros((Bp - B,) + emb.shape[1:],
+                                       emb.dtype)])
+                    dense = np.concatenate(
+                        [dense, np.zeros((Bp - B, dense.shape[1]),
+                                         dense.dtype)])
+                return np.asarray(
+                    infer_jit(live["params"], emb, dense))[:B]
+
+            sync = DenseTowerSync(
+                rep, 7, pub.dim, pub.unravel,
+                sink=lambda p: live.__setitem__(
+                    "params", jax.device_put(p)))
+
+            lookup = CachedLookup(
+                HotEmbeddingTier(view, HotTierConfig(
+                    capacity=1 << int(np.ceil(np.log2(n_keys * 2))),
+                    create_on_miss=False)),
+                replica=rep, freshness_budget_s=0.05)
+            frontend = ServingFrontend(
+                lookup, infer=infer,
+                config=FrontendConfig(max_batch=max_batch,
+                                      max_delay_us=200, queue_cap=4096,
+                                      default_deadline_ms=1000.0))
+
+            # -- phase 1: warm lookup+infer latency (idle feed) --------
+            n_prime = min(max(4 * concurrency, 128), n_requests)
+            req_keys = rng.integers(0, n_keys,
+                                    (n_requests + n_prime, S)).astype(
+                np.uint64)
+            req_dense = rng.standard_normal(
+                (n_requests + n_prime, D)).astype(np.float32)
+            # admit the working set + compile every bucket shape once
+            # (both jits: the frontend's infer and the CachedLookup
+            # gather — each pads to pow-2 buckets, so warm traffic
+            # never compiles)
+            frontend(req_keys[0], dense=req_dense[0], timeout=60)
+            lookup.lookup(keys)
+            Bp = 1
+            while Bp <= max_batch:
+                infer(np.zeros((Bp, S, 1 + xd), np.float32),
+                      np.zeros((Bp, D), np.float32))
+                lookup.lookup(keys[: Bp * S])
+                Bp <<= 1
+            nxt = [1]
+            mu = threading.Lock()
+
+            def submitter(limit):
+                while True:
+                    with mu:
+                        i = nxt[0]
+                        if i >= limit:
+                            return
+                        nxt[0] += 1
+                    frontend.submit(req_keys[i], dense=req_dense[i]) \
+                            .result(30)
+
+            def drive(limit):
+                threads = [threading.Thread(target=submitter,
+                                            args=(limit,))
+                           for _ in range(concurrency)]
+                t0 = time.perf_counter()
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                return time.perf_counter() - t0
+
+            # priming burst: the first concurrent rounds pay one-time
+            # costs no steady-state request ever sees again (thread
+            # stack page-ins, allocator growth, XLA thread-pool spin-up)
+            drive(n_prime)
+            frontend.reset_stats()
+            serve_cli.reset_op_counts()
+            # a CPython GC pause mid-batch lands straight in the p99 —
+            # collect now, hold GC for the bounded measurement window
+            # (the same knob a production serving process would tune)
+            import gc
+            gc.collect()
+            gc.disable()
+            try:
+                warm_wall = drive(n_prime + n_requests)
+            finally:
+                gc.enable()
+            warm_rpc_ops = serve_cli.reset_op_counts()
+            st = frontend.stats()
+
+            # -- phase 2: push→servable freshness under writer load ----
+            marker_key = np.asarray([np.uint64(1) << np.uint64(41)],
+                                    np.uint64)
+            train_cli.pull_sparse(0, marker_key)
+            direct = ReplicaLookup(serve_cli, 0)
+            hot = keys[:4096]
+            stop = threading.Event()
+
+            def writer():
+                w = np.zeros((len(hot), width), np.float32)
+                while not stop.is_set():
+                    w[:, 1] = 1.0
+                    w[:, 3:] = 0.01 * rng.standard_normal(
+                        (len(hot), width - 3)).astype(np.float32)
+                    train_cli.push_sparse(0, hot, w)
+
+            probe = FreshnessProbe(timeout_s=5.0)
+            marker = [0.0]
+
+            def write():
+                marker[0] += 1.0
+                mp = np.zeros((1, width), np.float32)
+                mp[0, 2] = marker[0]   # click stat: additive, pull col 1
+                train_cli.push_sparse(0, marker_key, mp)
+
+            wth = threading.Thread(target=writer)
+            wth.start()
+            try:
+                for _ in range(n_probes):
+                    probe.measure(write,
+                                  lambda: direct.lookup(marker_key)[0, 1],
+                                  lambda v, m=marker: v >= m[0])
+            finally:
+                stop.set()
+                wth.join()
+            fresh = probe.stats()
+
+            # dense feed really drove the tower at least once
+            pub.publish({k: v + 1.0 for k, v in params.items()})
+            deadline = time.perf_counter() + 10
+            while sync.syncs < 2 and time.perf_counter() < deadline:
+                time.sleep(0.01)
+
+            baseline = {}
+            online_path = os.path.join(repo, "ONLINE.json")
+            if os.path.exists(online_path):
+                with open(online_path) as f:
+                    oj = json.load(f)
+                baseline = {
+                    "export_loop_p50_s": oj.get("latency_p50_s"),
+                    "export_loop_p95_s": oj.get("latency_p95_s"),
+                }
+                if fresh["p95_ms"] > 0 and baseline["export_loop_p95_s"]:
+                    baseline["freshness_speedup_p95"] = round(
+                        baseline["export_loop_p95_s"] * 1e3
+                        / fresh["p95_ms"], 1)
+
+            out = {
+                "metric": METRIC,
+                "value": st["request"]["p99_ms"],
+                "unit": "ms",
+                "warm": {
+                    "request_ms": st["request"],
+                    "serve_batch_ms": st["serve_batch"],
+                    "requests": st["served"],
+                    "qps": round(st["served"] / warm_wall, 1),
+                    "avg_batch": st.get("avg_batch", 1.0),
+                    "deadline_misses": st["deadline_misses"],
+                    "shed": st["shed"],
+                    # THE zero-RPC claim: warm requests touched neither
+                    # the training PS (by construction — the client only
+                    # knows the replica) nor the replica itself
+                    "rpc_ops_during_warm": dict(warm_rpc_ops),
+                    "rpc_per_request": round(
+                        sum(warm_rpc_ops.values()) / max(st["served"], 1),
+                        4),
+                },
+                "freshness": fresh,
+                "freshness_failures": fresh["failures"],
+                "catch_up_s": round(catch_up_s, 3),
+                "dense_syncs": sync.syncs,
+                "replica": rep.status(),
+                "vs_online_export_loop": baseline,
+                "population": n_keys,
+                "batch": max_batch,
+                "concurrency": concurrency,
+                "preload_s": round(preload_s, 2),
+                "platform": jax.devices()[0].platform,
+                "host_cores": os.cpu_count(),
+            }
+            return out
+        finally:
+            if frontend is not None:
+                frontend.stop()
+            rep.close()
+
+
+def main() -> None:
+    try:
+        rec = run()
+    except Exception as e:  # noqa: BLE001 — one-JSON-line contract
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        rec = {"metric": METRIC, "value": 0.0,
+               "error": f"{type(e).__name__}: {e}"[:300]}
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
