@@ -18,6 +18,8 @@ from typing import Dict, List, Optional
 
 import jax
 
+from ..obs import trace as _obs_trace
+
 __all__ = [
     "RecordEvent",
     "fetch_sync",
@@ -119,7 +121,11 @@ def export_chrome_tracing(path: str) -> str:
 
     with _TIMELINE._lock:
         events = list(_TIMELINE.events)
-    blob = {"traceEvents": events, "displayTimeUnit": "ms"}
+    # clockSyncUs: this process's wall anchor for its perf_counter
+    # timestamps — tools/timeline.py aligns multi-worker lanes by it
+    # instead of interleaving raw per-host monotonic clocks
+    blob = {"traceEvents": events, "displayTimeUnit": "ms",
+            "clockSyncUs": _obs_trace.EPOCH_ANCHOR_US}
     with open(path, "w") as f:
         json.dump(blob, f)
     return path
@@ -130,9 +136,17 @@ def RecordEvent(name: str):
     """Annotate a host scope; shows up in the jax.profiler trace and in
     ``host_event_stats()``. Ops in the reference are auto-wrapped this way
     inside OperatorBase::Run (operator.cc); here users and the framework's
-    train loops wrap logical phases (forward, backward, pull_sparse...)."""
+    train loops wrap logical phases (forward, backward, pull_sparse...).
+
+    While distributed tracing is on (``obs.trace.start_tracing``) every
+    RecordEvent scope ALSO opens an obs span — the existing annotations
+    (``pserver_client_pull_sparse``, ``ctr_train_step``, …) become the
+    client side of the cross-process timeline for free; tracing off
+    costs one module-bool check."""
     t0 = time.perf_counter()
-    with jax.profiler.TraceAnnotation(name):
+    obs = (_obs_trace.span(name) if _obs_trace.tracing_enabled()
+           else contextlib.nullcontext())
+    with jax.profiler.TraceAnnotation(name), obs:
         try:
             yield
         finally:

@@ -40,9 +40,14 @@
 // in handle() acquire exactly one of them, release it, and only then
 // enter table code; the replication shipper thread (Python-side,
 // through pss_oplog_next) likewise touches only oplog_mu.
+// The observability additions (ISSUE 8) follow the same discipline:
+// obs_mu (per-table wire counters + the bounded server-span ring) is a
+// LEAF lock — obs_account() and the kObsSnap handler acquire exactly
+// it, never while holding any other lock, and never enter table code
+// under it.
 // LOCK ORDER: tables_mu < save_mu < shard_mu
 // LOCK ORDER: tables_mu < dense_mu
-// LOCK LEAF: conn_mu bar_mu mu oplog_mu gate_mu fault_mu
+// LOCK LEAF: conn_mu bar_mu mu oplog_mu gate_mu fault_mu obs_mu
 
 #include <arpa/inet.h>
 #include <fcntl.h>
@@ -177,6 +182,15 @@ enum Cmd : uint32_t {
   kDenseSnap = 41,  // dense table full state → [i64 t][values][m][v]
                     // (m/v present only for adam); status = dim
   kDenseRestore = 42,  // payload as kDenseSnap's response; replaces state
+  // -- observability (paddle_tpu/obs drives this; docs/OPERATIONS.md §13) --
+  kObsSnap = 43,  // per-table wire counters + server-side trace spans:
+                  // aux&1 drains the span ring, aux&2 resets the wire
+                  // counters. Response: [u32 n_tables][u32 n_spans]
+                  // [i64 spans_dropped] ++ n_tables × WireRec(48B) ++
+                  // n_spans × SpanRec(64B) — obs/trace.py mirrors the
+                  // two record structs (SERVER_WIRE_STRUCT /
+                  // SERVER_SPAN_STRUCT); drift = parse failure in
+                  // tests, not silent misreads (sizes are asserted).
 };
 
 enum Err : int64_t {
@@ -420,7 +434,35 @@ struct ReqHeader {
   uint32_t table_id;
   int64_t n;
   int32_t aux;
+  // fixed trace-context field (paddle_tpu/obs/trace.py wire_context):
+  // zero when tracing is off/unsampled — the header NEVER grows beyond
+  // these 16 bytes for tracing (the obs CI gate asserts it). A nonzero
+  // trace_id makes the server record a span for this request keyed by
+  // span_id (the CLIENT span), fetched later via kObsSnap. Rides the
+  // oplog/replication frames untouched (apply_op ignores it).
+  uint64_t trace_id;
+  uint64_t span_id;
 } __attribute__((packed));
+
+// obs timestamp helpers: wall anchor for cross-process merge, steady
+// for durations (same split obs/trace.py uses python-side)
+inline int64_t mono_us() {
+  timespec ts;
+  ::clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+inline int64_t wall_us() {
+  timespec ts;
+  ::clock_gettime(CLOCK_REALTIME, &ts);
+  return static_cast<int64_t>(ts.tv_sec) * 1000000 + ts.tv_nsec / 1000;
+}
+
+// per-handler-thread obs scratch (one handler thread per connection):
+// respond() records the response payload size; gate_enter() records the
+// time a mutating request waited on the pause gate — both consumed by
+// obs_account() after the handler returns.
+thread_local uint64_t t_resp_bytes = 0;
+thread_local int64_t t_gate_wait_us = 0;
 
 bool read_full(int fd, void* buf, size_t len) {
   char* p = static_cast<char*>(buf);
@@ -613,6 +655,90 @@ struct PsServer {
   std::map<std::string, Fault> faults;
   std::mutex fault_mu;  // leaf
 
+  // -- observability (kObsSnap; paddle_tpu/obs consumes) ----------------
+  // per-table wire accounting: "in" = client→server payload bytes/rows
+  // (pushes, inserts), "out" = server→client response bytes/rows
+  // (pulls, exports). One leaf-lock acquisition per DATA request — the
+  // requests themselves move kilobytes to gigabytes, so the counter is
+  // noise next to the socket IO it measures.
+  struct WireStat {
+    int64_t in_bytes = 0, out_bytes = 0, in_rows = 0, out_rows = 0,
+            reqs = 0;
+  };
+  std::map<uint32_t, WireStat> wire;
+  // server-side trace spans, recorded only for requests whose header
+  // carried a nonzero trace_id (sampled client spans). Bounded ring:
+  // overflow drops the OLDEST and counts it — a forgotten drain can
+  // never grow the server.
+  struct ObsSpan {
+    uint64_t trace_id, span_id;
+    uint32_t cmd, table_id;
+    int64_t ts_us, dur_us, gate_us;
+    uint64_t req_bytes, resp_bytes;
+  } __attribute__((packed));
+  static_assert(sizeof(ObsSpan) == 64, "obs/trace.py SERVER_SPAN_STRUCT");
+  std::deque<ObsSpan> obs_spans;
+  size_t obs_spans_cap = 4096;
+  int64_t obs_spans_dropped = 0;
+  std::mutex obs_mu;  // leaf: counters/ring only, nothing nests inside
+
+  // commands whose payloads are table data worth metering (the control
+  // plane — barriers, epochs, stats reads — is not wire accounting)
+  static bool is_data_cmd(uint32_t cmd) {
+    switch (cmd) {
+      case kPullSparse:
+      case kPushSparse:
+      case kPullDense:
+      case kPushDense:
+      case kSetDense:
+      case kInsertFull:
+      case kExport:
+      case kSaveAll:
+      case kLoadCold:
+      case kPushGeo:
+      case kPullGeo:
+        return true;
+      default:
+        return false;
+    }
+  }
+
+  void obs_account(const ReqHeader& h, int64_t ts_us, int64_t dur_us) {
+    bool data = is_data_cmd(h.cmd);
+    if (!data && h.trace_id == 0) return;
+    std::lock_guard<std::mutex> g(obs_mu);  // LOCK: obs_mu
+    if (data) {
+      WireStat& w = wire[h.table_id];
+      w.reqs += 1;
+      w.in_bytes += static_cast<int64_t>(h.payload_len);
+      w.out_bytes += static_cast<int64_t>(t_resp_bytes);
+      switch (h.cmd) {
+        case kPushSparse:
+        case kInsertFull:
+        case kLoadCold:
+        case kPushGeo:
+          w.in_rows += h.n;
+          break;
+        case kPullSparse:
+        case kExport:
+          w.out_rows += h.n;
+          break;
+        default:
+          break;  // dense/geo-pull/save: bytes carry the signal
+      }
+    }
+    if (h.trace_id != 0) {
+      ObsSpan s{h.trace_id, h.span_id, h.cmd, h.table_id, ts_us, dur_us,
+                t_gate_wait_us, sizeof(ReqHeader) + h.payload_len,
+                t_resp_bytes};
+      obs_spans.push_back(s);
+      while (obs_spans.size() > obs_spans_cap) {
+        obs_spans.pop_front();
+        ++obs_spans_dropped;
+      }
+    }
+  }
+
   void log_op(const ReqHeader& h, const char* p) {
     std::lock_guard<std::mutex> g(oplog_mu);  // LOCK: oplog_mu
     if (!repl_enabled.load()) return;
@@ -640,7 +766,15 @@ struct PsServer {
 
   void gate_enter() {
     std::unique_lock<std::mutex> lk(gate_mu);  // LOCK: gate_mu
-    gate_cv.wait(lk, [&]() { return !gate_paused || stopping.load(); });
+    if (gate_paused && !stopping.load()) {
+      // the one genuine QUEUE in this server: mutators blocked behind a
+      // snapshot gate. Measured only on the blocked path (the unpaused
+      // fast path pays zero clock reads) and surfaced as the span's
+      // gate_us — "where did this slow push wait" in the merged trace.
+      int64_t w0 = mono_us();
+      gate_cv.wait(lk, [&]() { return !gate_paused || stopping.load(); });
+      t_gate_wait_us += mono_us() - w0;
+    }
     ++gate_active;
   }
 
@@ -815,6 +949,7 @@ struct PsServer {
   }
 
   bool respond(int fd, int64_t status, const void* payload, uint64_t plen) {
+    t_resp_bytes = plen + 16;  // obs wire accounting (payload + resp hdr)
     uint64_t hdr[2] = {plen, static_cast<uint64_t>(status)};
     if (!write_full(fd, hdr, sizeof(hdr))) return false;
     if (plen && !write_full(fd, payload, plen)) return false;
@@ -1063,7 +1198,15 @@ struct PsServer {
       if (h.payload_len > kMaxPayload) break;
       buf.resize(h.payload_len);
       if (h.payload_len && !read_full(fd, buf.data(), h.payload_len)) break;
-      if (!handle(fd, h, buf.data())) break;
+      // obs wrapper: service time is frame-parsed → response-written,
+      // the span the client's wire context (trace_id/span_id) keys
+      t_resp_bytes = 0;
+      t_gate_wait_us = 0;
+      int64_t ob_ts = wall_us();
+      int64_t ob_t0 = mono_us();
+      bool ok = handle(fd, h, buf.data());
+      obs_account(h, ob_ts, mono_us() - ob_t0);
+      if (!ok) break;
       if (h.cmd == kStop) break;
     }
     ::close(fd);
@@ -1591,6 +1734,47 @@ struct PsServer {
       }
       case kDenseRestore:
         return respond(fd, do_dense_restore(h, p), nullptr, 0);
+      case kObsSnap: {
+        // per-table wire counters + the server-span ring, one frame.
+        // aux&1 drains the spans (the aggregator's normal read); aux&2
+        // zeroes the wire counters (bench epochs take deltas).
+        bool drain = (h.aux & 1) != 0;
+        bool reset_wire = (h.aux & 2) != 0;
+        std::vector<char> out;
+        {
+          std::lock_guard<std::mutex> g(obs_mu);  // LOCK: obs_mu
+          uint32_t nt = static_cast<uint32_t>(wire.size());
+          uint32_t ns = static_cast<uint32_t>(obs_spans.size());
+          out.resize(16 + static_cast<size_t>(nt) * 48 +
+                     static_cast<size_t>(ns) * sizeof(ObsSpan));
+          char* w = out.data();
+          std::memcpy(w, &nt, 4);
+          std::memcpy(w + 4, &ns, 4);
+          std::memcpy(w + 8, &obs_spans_dropped, 8);
+          w += 16;
+          for (auto& kv : wire) {
+            uint32_t tid = kv.first, pad = 0;
+            std::memcpy(w, &tid, 4);
+            std::memcpy(w + 4, &pad, 4);
+            std::memcpy(w + 8, &kv.second.in_bytes, 8);
+            std::memcpy(w + 16, &kv.second.out_bytes, 8);
+            std::memcpy(w + 24, &kv.second.in_rows, 8);
+            std::memcpy(w + 32, &kv.second.out_rows, 8);
+            std::memcpy(w + 40, &kv.second.reqs, 8);
+            w += 48;
+          }
+          for (auto& s : obs_spans) {
+            std::memcpy(w, &s, sizeof(ObsSpan));
+            w += sizeof(ObsSpan);
+          }
+          if (drain) {
+            obs_spans.clear();
+            obs_spans_dropped = 0;
+          }
+          if (reset_wire) wire.clear();
+        }
+        return respond(fd, 0, out.data(), out.size());
+      }
       case kBarrier: {
         std::unique_lock<std::mutex> lk(bar_mu);
         int64_t my_gen = bar_gen;
@@ -1777,7 +1961,7 @@ struct PsConn {
     const void* parts[1] = {payload};
     uint64_t lens[1] = {plen};
     return callv(cmd, table_id, n, aux, plen ? 1 : 0, parts, lens, resp,
-                 io_override);
+                 io_override, 0, 0);
   }
 
   // scatter-gather call: the request payload is the concatenation of
@@ -1787,14 +1971,15 @@ struct PsConn {
   int64_t callv(uint32_t cmd, uint32_t table_id, int64_t n, int32_t aux,
                 int32_t nparts, const void* const* parts,
                 const uint64_t* lens, std::vector<char>* resp,
-                int io_override = -1) {
+                int io_override = -1, uint64_t trace_id = 0,
+                uint64_t span_id = 0) {
     std::lock_guard<std::mutex> g(mu);  // LOCK: mu
     if (fd < 0) return -1000;
     uint64_t plen = 0;
     for (int32_t i = 0; i < nparts; ++i) plen += lens[i];
     int ms = io_override >= 0 ? io_override : io_ms;
     int64_t deadline = ms > 0 ? now_ms() + ms : 0;
-    ReqHeader h{plen, cmd, table_id, n, aux};
+    ReqHeader h{plen, cmd, table_id, n, aux, trace_id, span_id};
     int64_t rc;
     if (sizeof(h) + plen <= kCoalesceMax) {
       uint64_t total = sizeof(h) + plen;
@@ -1997,6 +2182,16 @@ int64_t psc_callv(void* h, uint32_t cmd, uint32_t table_id, int64_t n,
                   const uint64_t* lens, int32_t timeout_ms) {
   return static_cast<PsConn*>(h)->callv(cmd, table_id, n, aux, nparts, parts,
                                         lens, &g_resp, timeout_ms);
+}
+// trace-context variant (paddle_tpu/obs): stamps the caller's sampled
+// span into the frame header's fixed context field; (0, 0) = untraced
+int64_t psc_callv2(void* h, uint32_t cmd, uint32_t table_id, int64_t n,
+                   int32_t aux, int32_t nparts, const void* const* parts,
+                   const uint64_t* lens, int32_t timeout_ms,
+                   uint64_t trace_id, uint64_t span_id) {
+  return static_cast<PsConn*>(h)->callv(cmd, table_id, n, aux, nparts, parts,
+                                        lens, &g_resp, timeout_ms, trace_id,
+                                        span_id);
 }
 uint64_t psc_resp_len(void*) { return g_resp.size(); }
 void psc_resp_copy(void*, void* out) {

@@ -1,0 +1,39 @@
+"""Unified observability plane (ISSUE 8, ROADMAP items 3/5 feed).
+
+Three legs, one package:
+
+- ``registry`` — the job-wide metrics registry: pre-bound
+  counter/gauge/histogram handles (create at module/constructor scope,
+  increment lock-cheap on the hot path), bounded label cardinality, a
+  JSON snapshot exporter, and a null-handle mode
+  (``FLAGS_obs_metrics=0``) that compiles the whole plane out for
+  overhead baselines.
+- ``trace`` — cross-process trace propagation: a compact
+  (trace_id, span_id) context rides the PS RPC frame header
+  (ps/rpc.py → csrc/ps_service.cc) so a trainer-side pull span links
+  via chrome-trace flow events to the exact shard's server-side span.
+  Sampled, default-off; tracing off costs one module-flag check.
+- ``aggregate`` — merges per-process registry snapshots (trainer,
+  communicator workers, PS shards via the kObsSnap command, serving
+  replicas) into ONE job-wide view, and per-shard server spans into
+  ONE merged chrome trace (tools/obs_trace_demo.py).
+
+Per-table wire accounting (bytes/rows/observed density per direction,
+client- and server-side) lives on the registry under the
+``ps_client_*`` / ``ps_server_*`` families — the measured-sparsity
+feed Parallax-style auto-placement (ROADMAP item 3) will read.
+"""
+
+from . import aggregate, registry, trace
+from .registry import (REGISTRY, CounterGroup, Registry, counter, gauge,
+                       histogram, metrics_enabled, snapshot)
+from .trace import (current_span, mark_retried, span, start_tracing,
+                    stop_tracing, tracing_enabled, wire_context)
+
+__all__ = [
+    "registry", "trace", "aggregate",
+    "Registry", "REGISTRY", "CounterGroup",
+    "counter", "gauge", "histogram", "snapshot", "metrics_enabled",
+    "span", "start_tracing", "stop_tracing", "tracing_enabled",
+    "wire_context", "current_span", "mark_retried",
+]

@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.flags import define_flag, flag
+from ..obs import trace as _trace
 from .client import PSClient
 
 __all__ = [
@@ -113,15 +114,24 @@ class _BaseCommunicator:
         (``client.refresh_routing``, ps/ha.py) and replays ONCE against
         the promoted backup before surfacing the error — the train loop
         consuming the future never learns its primary died mid-pull."""
+        # the submitting thread's sampled span (usually the train-step
+        # span) travels with the pull: the worker adopts it so the wire
+        # frame carries the trace context and a failover replay marks
+        # THAT span retried (obs/trace.py)
+        ctx = _trace.current_span()
         with self._pull_mu:
             if self._pull_pool is None:
                 self._pull_pool = ThreadPoolExecutor(
                     max_workers=2, thread_name_prefix="communicator-pull")
-            fut = self._pull_pool.submit(self._pull_with_replay, table_id,
+            fut = self._pull_pool.submit(self._pull_traced, ctx, table_id,
                                          keys, create, slots)
             self._inflight_pulls.add(fut)
         fut.add_done_callback(self._pull_done)
         return fut
+
+    def _pull_traced(self, ctx, table_id, keys, create, slots):
+        with _trace.with_span(ctx):
+            return self._pull_with_replay(table_id, keys, create, slots)
 
     def fetch_async(self, fn) -> "Future":
         """Run an arbitrary zero-arg PS fetch on the pull workers,
@@ -152,6 +162,7 @@ class _BaseCommunicator:
             refresh = getattr(self.client, "refresh_routing", None)
             if refresh is None or not refresh():
                 raise
+            _trace.mark_retried()  # same span id — a replay, not a new op
             return self.client.pull_sparse(table_id, keys, create,
                                            slots=slots)
 

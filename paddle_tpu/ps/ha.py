@@ -111,7 +111,10 @@ define_flag("ps_ha_failover_timeout_ms", 10000,
             "how long a failed client call waits for the coordinator "
             "to publish a promoted replacement before giving up")
 
-_HDR = struct.Struct("<QIIqi")  # ReqHeader: payload_len cmd table_id n aux
+# ReqHeader: payload_len cmd table_id n aux trace_id span_id (the
+# trailing two u64 are the obs plane's fixed trace-context field —
+# csrc/ps_service.cc ReqHeader; 44 bytes packed)
+_HDR = struct.Struct("<QIIqiQQ")
 
 
 def _route_key(job_id: str) -> str:
@@ -531,7 +534,7 @@ class ReplicationManager:
     def _catalog_tables(self) -> Tuple[List[int], List[int], List[int]]:
         sparse, dense, geo = [], [], []
         for frame in self.server.catalog():
-            _, cmd, tid, _, _ = _HDR.unpack_from(frame, 0)
+            _, cmd, tid, _, _, _, _ = _HDR.unpack_from(frame, 0)
             if cmd == _rpc._CREATE_SPARSE and tid not in sparse:
                 sparse.append(tid)
             elif cmd == _rpc._CREATE_DENSE and tid not in dense:

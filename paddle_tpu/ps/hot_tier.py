@@ -50,6 +50,7 @@ import numpy as np
 
 from .. import nn
 from ..core.enforce import enforce
+from ..obs.registry import CounterGroup
 from .device_hash import DynamicDeviceKeyMap, dynamic_map_lookup
 from .embedding_cache import CacheConfig, cache_pull, cache_push
 
@@ -91,6 +92,9 @@ class HotTierConfig:
     #: tight can prefer "dense" even off-TPU: its capacity-stream can
     #: undercut the sparse mode's per-key sort at large batches.
     push_mode: str = "auto"
+
+
+_TIER_SEQ = iter(range(1, 1 << 30))  # per-process tier tag allocator
 
 
 def _pow2_pad(n: int, floor: int = 8) -> int:
@@ -179,8 +183,16 @@ class HotEmbeddingTier:
         self._clock = 0
         self._prefetched: Dict[int, Any] = {}   # id(batch keys) → future
         self._reset_resident_set()
-        self.counters = {"hits": 0, "misses": 0, "evictions": 0,
-                         "writebacks": 0, "cold_fetches": 0, "flushes": 0}
+        # registry-backed counters (obs/registry.py CounterGroup): the
+        # dict-shaped increments below are unchanged, but every count
+        # also lands in the job-wide ``hot_tier_events`` family labeled
+        # by a per-process tier tag — ``stats()`` stays the exact local
+        # accessor PR 6 tests and benches read
+        self.counters = CounterGroup(
+            "hot_tier_events",
+            ("hits", "misses", "evictions", "writebacks", "cold_fetches",
+             "flushes"),
+            max_series=1024, tier=str(next(_TIER_SEQ)))
 
     def _reset_resident_set(self) -> None:
         """Fresh map/state/control-plane — cold construction AND the

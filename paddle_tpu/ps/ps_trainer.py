@@ -691,36 +691,41 @@ class CtrStreamTrainer:
             return keys, flat, dense, labels, fut
 
         def _run(item):
-            keys, flat, dense, labels, fut = item
-            if fut is not None:
-                pulled = fut.result()
-            elif self.communicator is not None:  # same client as the pushes
-                pulled = self.communicator.client.pull_sparse(
-                    self.table_id, flat, create=True,
-                    slots=slot_ids[:len(flat)])
-            else:
-                pulled = self.table.pull_sparse(
-                    flat, slots=slot_ids[:len(flat)], create=True)
-            emb = pulled[:, -self._pull_width:].reshape(
-                keys.shape[0], S, self._pull_width)
-            self.params, self.opt_state, loss, emb_grad = self._step(
-                self.params, self.opt_state, jnp.asarray(emb),
-                jnp.asarray(dense), jnp.asarray(labels))
-            g = np.asarray(emb_grad).reshape(-1, self._pull_width)
-            push = np.empty((len(flat), 4 + self._dim), np.float32)
-            push[:, 0] = slot_ids[:len(flat)]
-            push[:, 1] = 1.0                        # show
-            push[:, 2] = np.repeat(labels, S)       # click
-            push[:, 3:] = g
-            if self.communicator is not None:
-                self.communicator.send_sparse(self.table_id, flat, push)
-            else:
-                self.table.push_sparse(flat, push)
-            stats.steps += 1
-            stats.samples += int(labels.shape[0])
-            stats.loss_sum += float(loss)
-            self.batches_done += 1
-            self._maybe_checkpoint(checkpoint, checkpoint_every, batch_size)
+            # RecordEvent = trace ROOT while obs tracing is on: one
+            # sampled stream step becomes one cross-process trace whose
+            # pull/push child spans flow-link to the PS shards' spans
+            with RecordEvent("ctr_stream_step"):
+                keys, flat, dense, labels, fut = item
+                if fut is not None:
+                    pulled = fut.result()
+                elif self.communicator is not None:  # same client as pushes
+                    pulled = self.communicator.client.pull_sparse(
+                        self.table_id, flat, create=True,
+                        slots=slot_ids[:len(flat)])
+                else:
+                    pulled = self.table.pull_sparse(
+                        flat, slots=slot_ids[:len(flat)], create=True)
+                emb = pulled[:, -self._pull_width:].reshape(
+                    keys.shape[0], S, self._pull_width)
+                self.params, self.opt_state, loss, emb_grad = self._step(
+                    self.params, self.opt_state, jnp.asarray(emb),
+                    jnp.asarray(dense), jnp.asarray(labels))
+                g = np.asarray(emb_grad).reshape(-1, self._pull_width)
+                push = np.empty((len(flat), 4 + self._dim), np.float32)
+                push[:, 0] = slot_ids[:len(flat)]
+                push[:, 1] = 1.0                        # show
+                push[:, 2] = np.repeat(labels, S)       # click
+                push[:, 3:] = g
+                if self.communicator is not None:
+                    self.communicator.send_sparse(self.table_id, flat, push)
+                else:
+                    self.table.push_sparse(flat, push)
+                stats.steps += 1
+                stats.samples += int(labels.shape[0])
+                stats.loss_sum += float(loss)
+                self.batches_done += 1
+                self._maybe_checkpoint(checkpoint, checkpoint_every,
+                                       batch_size)
 
         t0 = time.perf_counter()
         window: deque = deque()  # batches with an issued (or due) pull
@@ -783,8 +788,13 @@ class CtrStreamTrainer:
 
         # graftlint: hot-path
         def _run(item):
-            nonlocal overflow
             keys, flat, dense, labels = item
+            with RecordEvent("ctr_hot_step"):
+                _run_body(keys, flat, dense, labels)
+
+        # graftlint: hot-path
+        def _run_body(keys, flat, dense, labels):
+            nonlocal overflow
             tier.ensure(flat)
             lo32 = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
             map_state = tier.device_map.device_state()

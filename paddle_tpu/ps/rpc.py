@@ -31,6 +31,9 @@ from ..core.enforce import (NotFoundError, PreconditionNotMetError,
                             PsTransportError, enforce)
 from ..core.flags import define_flag, flag
 from ..core.profiler import RecordEvent
+from ..obs import registry as _obs_registry
+from ..obs import trace as _trace
+from ..obs.registry import CounterGroup
 from .accessor import AccessorConfig
 from .client import PSClient
 from .faultpoints import faultpoint
@@ -122,13 +125,34 @@ _REPL_STATE = 39
 _DIGEST = 40
 _DENSE_SNAP = 41
 _DENSE_RESTORE = 42
+_OBS_SNAP = 43
 
 _DENSE_OPT_IDS = {"sgd": 0, "adam": 1, "sum": 2}
+
+# client-op names the registry family ``ps_client_ops`` pre-binds (a
+# fixed set: handle creation happens once per client, at __init__)
+_OP_NAMES = ("pull_sparse", "push_sparse", "pull_dense", "push_dense",
+             "push_geo", "pull_geo", "export_full", "import_full",
+             "global_step")
+_CLIENT_SEQ = iter(range(1, 1 << 30))  # per-process client tag allocator
+
+# wire frame header sizes (csrc ReqHeader / response header) — the
+# request header is the 28 legacy bytes + the fixed trace-context
+# field; test_obs.py pins ha._HDR.size against the same sum
+_REQ_HEADER_BYTES = 28 + _trace.WIRE_CONTEXT_BYTES
+_RESP_HEADER_BYTES = 16  # [u64 payload_len][i64 status]
 
 
 def _long_ms() -> int:
     """Deadline for commands whose runtime scales with table size."""
     return int(flag("pserver_long_call_timeout_ms"))
+
+
+def _run_with_span(span, task):
+    """Fan-out worker shim: run ``task`` with the submitting thread's
+    span adopted (obs/trace.py with_span)."""
+    with _trace.with_span(span):
+        return task()
 
 
 _EMPTY_RESP = b""
@@ -171,6 +195,16 @@ def _configure_rpc(lib: ctypes.CDLL) -> None:
                               ctypes.c_int32]
     lib.psc_resp_ptr.restype = ctypes.c_void_p
     lib.psc_resp_ptr.argtypes = [ctypes.c_void_p]
+    # trace-context call (obs plane): psc_callv + the fixed 16-byte
+    # (trace_id, span_id) header field (zeroes when untraced)
+    lib.psc_callv2.restype = ctypes.c_int64
+    lib.psc_callv2.argtypes = [ctypes.c_void_p, ctypes.c_uint32,
+                               ctypes.c_uint32, ctypes.c_int64,
+                               ctypes.c_int32, ctypes.c_int32,
+                               ctypes.POINTER(ctypes.c_void_p),
+                               ctypes.POINTER(ctypes.c_uint64),
+                               ctypes.c_int32, ctypes.c_uint64,
+                               ctypes.c_uint64]
     # HA / replication / chaos server ABI (ps/ha.py ReplicationManager)
     lib.pss_set_replication.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                         ctypes.c_int64]
@@ -418,9 +452,12 @@ class _ServerConn:
 
     def _call_once(self, cmd, table_id, n, aux, parts, lens, nparts,
                    timeout_ms, view):
-        status = int(self._lib.psc_callv(
+        # the sampled span open on THIS thread rides the frame header's
+        # fixed context field; (0, 0) — one module-flag check — otherwise
+        trace_id, span_id = _trace.wire_context()
+        status = int(self._lib.psc_callv2(
             self._h, cmd, table_id, n, aux, nparts, parts, lens,
-            -1 if timeout_ms is None else timeout_ms))
+            -1 if timeout_ms is None else timeout_ms, trace_id, span_id))
         if status <= -1000:
             # undefined stream state: drop the socket before any retry
             self.close()
@@ -429,6 +466,12 @@ class _ServerConn:
                 f"PS transport to {self._host}:{self._port} {kind} "
                 f"(cmd {cmd})")
         rlen = int(self._lib.psc_resp_len(self._h))
+        if span_id:  # traced: attach wire bytes to the client span
+            sp = _trace.current_span()
+            if sp is not None:
+                sp.add_bytes(tx=_REQ_HEADER_BYTES
+                             + sum(lens[i] for i in range(nparts)),
+                             rx=_RESP_HEADER_BYTES + rlen)
         if not rlen:
             return status, _EMPTY_RESP
         if view:
@@ -502,6 +545,10 @@ class _ServerConn:
             except PsTransportError as e:
                 last = e
                 if attempt < retries:
+                    # the re-send is a REPLAY of the same logical op —
+                    # the open span (if any) records it, so the merged
+                    # timeline shows retried RPCs, not phantom extras
+                    _trace.mark_retried()
                     time.sleep(backoff * (2 ** attempt))
         raise PsTransportError(
             f"PS server {self._host}:{self._port} unreachable after "
@@ -601,24 +648,79 @@ class RpcPsClient(PSClient):
         #: static single-replica topology (behavior unchanged).
         self._router = router
         self._conns_mu = threading.Lock()  # serializes failover conn swaps
-        #: per-op RPC counts (one count per client op, regardless of how
-        #: many shards it fans out to). The hot-tier CI gate asserts a
-        #: warm steady-state step performs ZERO of these, and
-        #: tools/sparse_hot_bench.py reports rpc/step from the deltas.
-        self.op_counts: Counter = Counter()
+        # per-op RPC counts, REGISTRY-BACKED (obs/registry.py): one
+        # count per client op regardless of shard fan-out, under the
+        # job-wide family ``ps_client_ops`` labeled by op and a
+        # per-process client tag. ``op_counts``/``reset_op_counts``
+        # stay the exact per-client accessors the hot-tier 0-RPC gate
+        # and tools/sparse_hot_bench.py always read (CounterGroup keeps
+        # a lock-free local mirror), so PR 6/7 tests pass unchanged.
+        self._client_tag = f"{qos}{next(_CLIENT_SEQ)}"
+        self._ops = CounterGroup("ps_client_ops", _OP_NAMES,
+                                 max_series=1024, client=self._client_tag)
+        self._op_base: Dict[str, int] = {op: 0 for op in _OP_NAMES}
         self._count_mu = threading.Lock()
+        # per-table wire/density handles, bound at table-create time
+        # (the cold path — the metric-in-hot-path lint rule's contract)
+        self._tbl_obs: Dict[int, Dict[str, object]] = {}
 
     def _op_count(self, op: str) -> None:
         with self._count_mu:
-            self.op_counts[op] += 1
+            self._ops[op] += 1
+
+    @property
+    def op_counts(self) -> Counter:
+        """Per-op counts since the last :meth:`reset_op_counts` (thin
+        shim over the registry-backed handles; zero entries omitted)."""
+        with self._count_mu:
+            return Counter({op: self._ops[op] - self._op_base[op]
+                            for op in _OP_NAMES
+                            if self._ops[op] - self._op_base[op]})
 
     def reset_op_counts(self) -> Dict[str, int]:
         """Snapshot-and-zero: returns the counts accumulated since the
-        last reset (delta reads for the bench / 0-RPC assertions)."""
+        last reset (delta reads for the bench / 0-RPC assertions). The
+        registry totals keep running — only this client's delta window
+        resets."""
         with self._count_mu:
-            out = dict(self.op_counts)
-            self.op_counts.clear()
+            out = {}
+            for op in _OP_NAMES:
+                d = self._ops[op] - self._op_base[op]
+                if d:
+                    out[op] = d
+                self._op_base[op] = self._ops[op]
         return out
+
+    def _bind_table_obs(self, table_id: int) -> Optional[Dict[str, object]]:
+        """Pre-bind this table's wire-accounting handles (per-table
+        bytes/rows per direction + observed-density gauges — the
+        measured-sparsity feed for ROADMAP item 3 auto-placement).
+        Called from the create path only; the hot path does one dict
+        lookup. With FLAGS_obs_metrics=0 nothing is bound at all: the
+        accounting blocks (including their np.count_nonzero density
+        scans — the costliest part of the instrumentation) must
+        short-circuit on the .get() miss, not feed null handles."""
+        if not _obs_registry.metrics_enabled():
+            self._tbl_obs.pop(table_id, None)
+            return None
+        t = str(table_id)
+        reg = _obs_registry.REGISTRY
+        m = {
+            "pull_bytes": reg.counter("ps_client_wire_bytes",
+                                      table=t, dir="pull"),
+            "push_bytes": reg.counter("ps_client_wire_bytes",
+                                      table=t, dir="push"),
+            "pull_rows": reg.counter("ps_client_wire_rows",
+                                     table=t, dir="pull"),
+            "push_rows": reg.counter("ps_client_wire_rows",
+                                     table=t, dir="push"),
+            "pull_density": reg.gauge("ps_client_density",
+                                      table=t, dir="pull"),
+            "push_density": reg.gauge("ps_client_density",
+                                      table=t, dir="push"),
+        }
+        self._tbl_obs[table_id] = m
+        return m
 
     @property
     def num_servers(self) -> int:
@@ -688,6 +790,10 @@ class RpcPsClient(PSClient):
             if new_ep is None or new_ep == ep:
                 raise
             self._swap_conn(s, new_ep)
+            # the promoted-backup REPLAY of the same logical op: the
+            # open span keeps its id (no orphan/duplicate spans in the
+            # merged trace) and is marked retried
+            _trace.mark_retried()
             out = fn(self._conns[s])
             r.record(new_ep, ok=True)
             return out
@@ -741,6 +847,13 @@ class RpcPsClient(PSClient):
         propagates. Returns results in task order."""
         if len(tasks) <= 1 or not flag("ps_rpc_parallel"):
             return [t() for t in tasks]
+        # trace-context propagation: the op's sampled span lives in the
+        # CALLER thread's TLS; fan-out workers re-enter it so their
+        # wire frames carry the context and their retries mark it
+        cur = _trace.current_span()
+        if cur is not None:
+            tasks = [
+                (lambda t=t: _run_with_span(cur, t)) for t in tasks]
         futs = [self._executor().submit(t) for t in tasks]
         results, first_err = [], None
         for f in futs:
@@ -791,6 +904,7 @@ class RpcPsClient(PSClient):
                 f"servers disagree on table {table_id} dims: {all_dims} "
                 "(mismatched accessor configs across trainers?)")
         self._sparse_dims[table_id] = all_dims[0]
+        self._bind_table_obs(table_id)
 
     # -- SSD-tier management (no-ops on RAM-only tables) ------------------
 
@@ -829,6 +943,7 @@ class RpcPsClient(PSClient):
     def create_dense_table(self, table_id: int, dim: int, optimizer: str = "adam",
                            lr: float = 0.001) -> None:
         self._dense_dims[table_id] = dim
+        self._bind_table_obs(table_id)
         for s in range(self.num_servers):
             shard_dim = len(self._dense_slice(dim, s))
             payload = (np.asarray([shard_dim, _DENSE_OPT_IDS[optimizer]], np.int32).tobytes()
@@ -912,6 +1027,14 @@ class RpcPsClient(PSClient):
 
         self._fanout([self._task(s, lambda c, sel=sel: one(c, sel))
                       for s, sel in self._shard_sel(sv)])
+        m = self._tbl_obs.get(table_id)
+        if m is not None:
+            m["pull_rows"].add(len(keys))
+            m["pull_bytes"].add(keys.nbytes + slots_arr.nbytes
+                                + out.size * (2 if f16 else 4))
+            if out.size:
+                m["pull_density"].set(
+                    float(np.count_nonzero(out)) / out.size)
         return out
 
     def push_sparse(self, table_id, keys, values):
@@ -934,6 +1057,19 @@ class RpcPsClient(PSClient):
 
         self._fanout([self._task(s, lambda c, sel=sel: one(c, sel))
                       for s, sel in self._shard_sel(sv)])
+        m = self._tbl_obs.get(table_id)
+        if m is not None:
+            m["push_rows"].add(len(keys))
+            m["push_bytes"].add(keys.nbytes + values.nbytes)
+            # observed push density over the GRADIENT block (the push
+            # layout's leading slot/show/click columns are always set):
+            # the per-table measured sparsity Parallax-style placement
+            # (ROADMAP item 3) reads as its signal
+            g = values[:, 3:] if values.ndim == 2 and \
+                values.shape[1] > 3 else values
+            if g.size:
+                m["push_density"].set(
+                    float(np.count_nonzero(g)) / g.size)
 
     def pull_dense(self, table_id):
         self._op_count("pull_dense")
@@ -951,12 +1087,23 @@ class RpcPsClient(PSClient):
                                  one(c, sl))
                       for s in range(self.num_servers)
                       if len(self._dense_slice(dim, s))])
+        m = self._tbl_obs.get(table_id)
+        if m is not None:
+            m["pull_bytes"].add(out.nbytes)
         return out
 
     def push_dense(self, table_id, grad):
         self._op_count("push_dense")
         grad = np.ascontiguousarray(grad, np.float32)
         dim = self._dense_dims[table_id]
+        m = self._tbl_obs.get(table_id)
+        if m is not None:
+            m["push_bytes"].add(grad.nbytes)
+            if grad.size:
+                # dense-gradient sparsity: the Parallax signal for
+                # moving a sparse-ish dense grad ONTO the PS wire
+                m["push_density"].set(
+                    float(np.count_nonzero(grad)) / grad.size)
         # contiguous slice views — the gradient ships straight from the
         # caller's buffer, no per-server copy at all
         self._fanout(
@@ -1229,6 +1376,10 @@ class RpcPsClient(PSClient):
 
         self._fanout([self._task(s, lambda c, sel=sel: one(c, sel))
                       for s, sel in self._shard_sel(sv)])
+        m = self._tbl_obs.get(table_id)
+        if m is not None:
+            m["pull_rows"].add(len(keys))
+            m["pull_bytes"].add(keys.nbytes + out.nbytes + found.nbytes)
         return out, found
 
     def import_full(self, table_id, keys, values):
@@ -1245,6 +1396,10 @@ class RpcPsClient(PSClient):
 
         self._fanout([self._task(s, lambda c, sel=sel: one(c, sel))
                       for s, sel in self._shard_sel(sv)])
+        m = self._tbl_obs.get(table_id)
+        if m is not None:
+            m["push_rows"].add(len(keys))
+            m["push_bytes"].add(keys.nbytes + values.nbytes)
 
     def load_cold(self, table_id, keys, values, chunk: int = 1 << 21) -> int:
         """Bulk cold-tier model load across servers (the 1e9-row build

@@ -30,7 +30,10 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..core.enforce import enforce
+from ..obs.registry import CounterGroup
 from .metrics import LatencyRecorder
+
+_FRONTEND_SEQ = iter(range(1, 1 << 30))  # per-process frontend tag
 
 __all__ = ["FrontendConfig", "ServingFrontend", "PendingResult",
            "RequestRejected", "DeadlineExceeded"]
@@ -128,14 +131,21 @@ class ServingFrontend:
         self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=cfg.queue_cap)
         self._keys_per_req: Optional[int] = None
         self._mu = threading.Lock()
-        self.counters: Dict[str, int] = {
-            "accepted": 0, "served": 0, "shed": 0, "deadline_dropped": 0,
-            "deadline_misses": 0, "batches": 0, "errors": 0}
+        # registry-backed (obs/registry.py CounterGroup): the dict
+        # increments below are unchanged, the job-wide snapshot sees
+        # the admission/shedding counters under serving_frontend_events
+        self.counters: CounterGroup = CounterGroup(
+            "serving_frontend_events",
+            ("accepted", "served", "shed", "deadline_dropped",
+             "deadline_misses", "batches", "errors"),
+            max_series=1024, frontend=str(next(_FRONTEND_SEQ)))
         #: end-to-end request latency (submit → result delivered)
-        self.request_latency = LatencyRecorder(cfg.latency_window)
+        self.request_latency = LatencyRecorder(cfg.latency_window,
+                                               name="frontend_request")
         #: lookup+infer time per micro-batch (the compute floor the
         #: SERVING.json single-digit-ms acceptance names)
-        self.serve_latency = LatencyRecorder(cfg.latency_window)
+        self.serve_latency = LatencyRecorder(cfg.latency_window,
+                                             name="frontend_serve")
         self._stopping = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serving-frontend")

@@ -15,20 +15,37 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from ..obs import registry as _obs_registry
+
 __all__ = ["LatencyRecorder", "FreshnessProbe"]
+
+_REC_SEQ = iter(range(1, 1 << 30))  # per-process recorder tag allocator
 
 
 class LatencyRecorder:
     """Sliding-window latency percentiles: record seconds, read
     p50/p95/p99 over the last ``window`` samples. Thread-safe (the
-    frontend worker records while operators read stats)."""
+    frontend worker records while operators read stats).
 
-    def __init__(self, window: int = 4096) -> None:
+    Registry-backed (ISSUE 8 migration): every ``record`` also lands in
+    the job-wide ``serving_latency_s`` histogram family (labeled by
+    ``name`` — the frontend names its recorders request/serve/…), so
+    the aggregated snapshot carries serving latency next to the PS wire
+    counters. ``percentiles()`` stays the exact ring-based accessor the
+    PR 7 tests and SERVING.json thresholds read."""
+
+    def __init__(self, window: int = 4096,
+                 name: Optional[str] = None) -> None:
         self._ring: deque = deque(maxlen=window)
         self._mu = threading.Lock()
         self.count = 0
+        self._hist = _obs_registry.REGISTRY.histogram(
+            "serving_latency_s", max_series=1024,
+            recorder=name if name is not None
+            else f"latency{next(_REC_SEQ)}")
 
     def record(self, seconds: float) -> None:
+        self._hist.observe(seconds)
         with self._mu:
             self._ring.append(seconds)
             self.count += 1
@@ -69,11 +86,17 @@ class FreshnessProbe:
 
     def __init__(self, window: int = 1024, timeout_s: float = 5.0,
                  poll_s: float = 0.0005) -> None:
-        self.latency = LatencyRecorder(window)
+        self.latency = LatencyRecorder(window, name="freshness")
         self.timeout_s = timeout_s
         self.poll_s = poll_s
         self.failures = 0
         self.probes = 0
+        # job-wide counters next to the latency histogram: a broken
+        # feed shows up in the aggregate, not only in local stats()
+        self._c_probes = _obs_registry.REGISTRY.counter(
+            "serving_freshness_probes", outcome="ok")
+        self._c_failures = _obs_registry.REGISTRY.counter(
+            "serving_freshness_probes", outcome="timeout")
 
     def measure(self, write, read, target) -> Optional[float]:
         """``write()`` publishes the marker (returns None); ``read()``
@@ -88,9 +111,11 @@ class FreshnessProbe:
             if target(read()):
                 dt = time.perf_counter() - t0
                 self.latency.record(dt)
+                self._c_probes.inc()
                 return dt
             if time.perf_counter() >= deadline:
                 self.failures += 1
+                self._c_failures.inc()
                 self.latency.record(self.timeout_s)
                 return None
             time.sleep(self.poll_s)

@@ -855,7 +855,8 @@ def test_run_py_green_on_tree_and_red_on_violation(tmp_path):
     summary = json.loads((tmp_path / "s.json").read_text())
     assert summary["new"] == 0
     assert set(summary["per_pass"]) == {
-        "tracer_safety", "hot_path", "lock_order", "conventions"}
+        "tracer_safety", "hot_path", "lock_order", "conventions",
+        "obs_metrics"}
 
     # an injected violation must turn the gate red with file:line:rule
     bad = tmp_path / "tree" / "paddle_tpu"
@@ -870,3 +871,132 @@ def test_run_py_green_on_tree_and_red_on_violation(tmp_path):
         capture_output=True, text=True)
     assert out.returncode == 1
     assert "paddle_tpu/hot.py:5: [host-sync-item]" in out.stdout
+
+
+# -- metric-in-hot-path (obs_metrics pass) ----------------------------------
+
+import obs_metrics  # noqa: E402
+
+
+def _obs_diags(tmp_path, source):
+    pkg = tmp_path / "paddle_tpu"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(source))
+    return obs_metrics.run(str(tmp_path))
+
+
+def test_metric_creation_in_loop_flagged(tmp_path):
+    diags = _obs_diags(tmp_path, """
+        from paddle_tpu.obs import registry
+
+        def setup(tables):
+            for t in tables:
+                h = registry.counter("fam", table=t)
+                h.inc()
+    """)
+    assert _rules(diags) == {"metric-in-hot-path"}
+    assert diags[0].line == 6
+
+
+def test_metric_increment_in_loop_not_flagged(tmp_path):
+    diags = _obs_diags(tmp_path, """
+        from paddle_tpu.obs import registry
+
+        H = registry.counter("fam", table="0")
+
+        def run(items):
+            for it in items:
+                H.inc()
+    """)
+    assert diags == []
+
+
+def test_metric_creation_in_hot_path_callee_flagged(tmp_path):
+    # reachability: the creation hides in a helper CALLED from the root
+    diags = _obs_diags(tmp_path, """
+        def helper(reg, x):
+            c = reg.counter("fam")
+            c.inc()
+            return x
+
+        # graftlint: hot-path
+        def step(reg, x):
+            return helper(reg, x)
+    """)
+    assert _rules(diags) == {"metric-in-hot-path"}
+    assert diags[0].line == 3
+
+
+def test_metric_creation_constructor_scope_not_flagged(tmp_path):
+    diags = _obs_diags(tmp_path, """
+        class Tier:
+            def __init__(self, reg):
+                self.h = reg.counter("fam", tier="0")
+                self.g = {k: reg.counter("fam", key=k)
+                          for k in ("hits", "misses")}
+    """)
+    assert diags == []  # comprehension bulk-bind is the sanctioned idiom
+
+
+def test_metric_creation_behind_cold_path_not_flagged(tmp_path):
+    diags = _obs_diags(tmp_path, """
+        # graftlint: cold-path
+        def bind(reg):
+            return reg.counter("fam")
+
+        # graftlint: hot-path
+        def step(reg, x):
+            return bind(reg)
+    """)
+    assert diags == []
+
+
+def test_metric_countergroup_in_loop_flagged(tmp_path):
+    diags = _obs_diags(tmp_path, """
+        from paddle_tpu.obs.registry import CounterGroup
+
+        def f(xs):
+            while xs:
+                g = CounterGroup("fam", ("a",))
+                xs.pop()
+    """)
+    assert _rules(diags) == {"metric-in-hot-path"}
+
+
+def test_metric_variable_family_not_flagged(tmp_path):
+    # the registry's own internals forward VARIABLE family names — not
+    # a creation site by this rule's (syntactic) definition
+    diags = _obs_diags(tmp_path, """
+        def forward(reg, name):
+            for _ in range(2):
+                reg.counter(name)
+    """)
+    assert diags == []
+
+
+def test_metric_ignore_comment_suppresses(tmp_path):
+    diags = _obs_diags(tmp_path, """
+        from paddle_tpu.obs import registry
+
+        def setup(tables):
+            for t in tables:
+                registry.counter("fam", table=t)  # graftlint: ignore[metric-in-hot-path]
+    """)
+    assert diags == []
+
+
+def test_metric_nested_def_in_loop_not_flagged(tmp_path):
+    # a def inside a loop does not EXECUTE its body per iteration
+    diags = _obs_diags(tmp_path, """
+        from paddle_tpu.obs import registry
+
+        def setup(tables):
+            out = []
+            for t in tables:
+                def bind(t=t):
+                    return registry.counter("fam", table=t)
+                out.append(bind)
+            return out
+    """)
+    assert diags == []

@@ -1,0 +1,558 @@
+"""Unified observability plane (ISSUE 8): registry semantics, trace
+propagation through the RPC wire into the C++ shard and back, failover
+replay marking, job-wide aggregation, and the timeline merge."""
+
+import json
+import os
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.flags import get_flags, set_flags
+from paddle_tpu.obs import aggregate, registry, trace
+from paddle_tpu.obs.registry import CounterGroup, Registry
+from paddle_tpu.ps import ha, rpc
+from paddle_tpu.ps.table import TableConfig
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _cfg(tid=0):
+    return TableConfig(table_id=tid, shard_num=4, accessor="ctr")
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off_after():
+    yield
+    trace.stop_tracing()
+    trace.drain_spans()
+
+
+# -- registry ---------------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    reg = Registry()
+    c = reg.counter("reqs", table="0")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    g = reg.gauge("density", table="0")
+    g.set(1.0)
+    g.set(0.5)
+    assert g.value == 0.5
+    assert 0.5 < g.ewma < 1.0  # EWMA lags the last write
+    h = reg.histogram("lat", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    hs = h.hist()
+    assert hs["count"] == 3 and hs["buckets"] == [1, 1, 1]
+    snap = reg.snapshot()
+    assert snap["metrics"]["reqs"]["series"][0]["value"] == 5
+    assert snap["metrics"]["reqs"]["series"][0]["labels"] == {"table": "0"}
+    assert snap["process"]["pid"] == os.getpid()
+
+
+def test_same_labels_same_handle_distinct_labels_distinct():
+    reg = Registry()
+    a = reg.counter("fam", table="0")
+    b = reg.counter("fam", table="0")
+    c = reg.counter("fam", table="1")
+    assert a is b and a is not c
+    with pytest.raises(ValueError):
+        reg.gauge("fam")  # kind mismatch on an existing family
+
+
+def test_label_cardinality_bounded():
+    reg = Registry()
+    handles = [reg.counter("fam", max_series=4, k=str(i))
+               for i in range(10)]
+    for h in handles:
+        h.inc()
+    snap = reg.snapshot()["metrics"]["fam"]
+    assert snap["dropped_series"] == 6
+    series = {tuple(sorted(s["labels"].items())): s["value"]
+              for s in snap["series"]}
+    # 4 admitted label-sets + ONE shared overflow series holding the rest
+    assert series[(("overflow", "true"),)] == 6
+    assert len(series) == 5
+
+
+def test_disabled_mode_null_handles():
+    was = get_flags(["obs_metrics"])["obs_metrics"]
+    set_flags({"obs_metrics": False})
+    try:
+        reg = Registry()
+        c = reg.counter("fam")
+        c.inc(100)
+        assert c.value == 0
+        assert reg.snapshot()["metrics"] == {}
+        # all creations share the one null handle — zero per-site cost
+        assert reg.gauge("g") is reg.histogram("h")
+    finally:
+        set_flags({"obs_metrics": was})
+
+
+def test_counter_thread_consistency():
+    reg = Registry()
+    c = reg.counter("fam")
+    h = reg.histogram("lat", buckets=(0.5,))
+
+    def work():
+        for _ in range(10000):
+            c.inc()
+            h.observe(0.1)
+
+    ts = [threading.Thread(target=work) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert c.value == 80000
+    assert h.hist()["count"] == 80000
+
+
+def test_counter_group_mirrors_registry():
+    reg = Registry()
+    g = CounterGroup("fam", ("hits", "misses"), registry=reg, tier="1")
+    g["hits"] += 3
+    g["misses"] += 1
+    assert g["hits"] == 3 and dict(g.items())["misses"] == 1
+    series = {s["labels"]["key"]: s["value"]
+              for s in reg.snapshot()["metrics"]["fam"]["series"]}
+    assert series == {"hits": 3, "misses": 1}
+    # a LOWER write resets only the local window (monotonic registry)
+    g["hits"] = 0
+    assert g["hits"] == 0
+    series = {s["labels"]["key"]: s["value"]
+              for s in reg.snapshot()["metrics"]["fam"]["series"]}
+    assert series["hits"] == 3
+
+
+def test_merge_snapshots_sums_counters_and_lists_processes():
+    reg1, reg2 = Registry(), Registry()
+    reg1.set_role("a")
+    reg2.set_role("b")
+    reg1.counter("fam", t="0").inc(2)
+    reg2.counter("fam", t="0").inc(3)
+    reg2.counter("fam", t="1").inc(7)
+    reg1.histogram("lat", buckets=(1.0,)).observe(0.5)
+    reg2.histogram("lat", buckets=(1.0,)).observe(2.0)
+    job = aggregate.merge_snapshots([reg1.snapshot(), reg2.snapshot()])
+    assert [p["role"] for p in job["processes"]] == ["a", "b"]
+    series = {tuple(sorted(s["labels"].items())): s["value"]
+              for s in job["metrics"]["fam"]["series"]}
+    assert series[(("t", "0"),)] == 5 and series[(("t", "1"),)] == 7
+    lat = job["metrics"]["lat"]["series"][0]
+    assert lat["count"] == 2 and lat["buckets"] == [1, 1]
+
+
+# -- trace core -------------------------------------------------------------
+
+def test_span_nesting_ids_and_wire_context():
+    trace.start_tracing(sample=1.0)
+    assert trace.wire_context() == (0, 0)  # no open span yet
+    with trace.span("root") as root:
+        rid = trace.wire_context()
+        assert rid == (root.trace_id, root.span_id)
+        with trace.span("child") as child:
+            assert child.trace_id == root.trace_id
+            assert child.parent_id == root.span_id
+            assert child.span_id != root.span_id
+            assert trace.wire_context()[1] == child.span_id
+        assert trace.wire_context()[1] == root.span_id
+    trace.stop_tracing()
+    spans = trace.drain_spans()
+    assert [s.name for s in spans] == ["child", "root"]  # close order
+    assert len({s.span_id for s in spans}) == 2
+
+
+def test_tracing_off_is_zero_context():
+    assert not trace.tracing_enabled()
+    with trace.span("x") as s:
+        assert s is None
+        assert trace.wire_context() == (0, 0)
+    assert trace.drain_spans() == []
+    trace.start_tracing(sample=0.0)  # on but unsampled
+    with trace.span("x") as s:
+        assert s is None and trace.wire_context() == (0, 0)
+
+
+def test_wire_struct_contract():
+    # the fixed header: 28 legacy bytes + the 16-byte context field —
+    # csrc ReqHeader, ha._HDR and the obs structs must agree byte-wise
+    assert trace.WIRE_CONTEXT_BYTES == 16
+    assert ha._HDR.size == 28 + trace.WIRE_CONTEXT_BYTES
+    assert trace.SERVER_SPAN_STRUCT.size == 64
+    assert trace.SERVER_WIRE_STRUCT.size == 48
+
+
+def test_span_ring_bounded():
+    trace.start_tracing(sample=1.0, ring=8)
+    for i in range(20):
+        with trace.span(f"s{i}"):
+            pass
+    assert len(trace.drain_spans()) == 8
+    assert trace.dropped_spans() == 12
+
+
+# -- RPC e2e ----------------------------------------------------------------
+
+needs_rpc = pytest.mark.skipif(not rpc.rpc_available(),
+                               reason="native toolchain unavailable")
+
+
+@pytest.fixture()
+def cluster2():
+    servers = [rpc.NativePsServer(n_trainers=1) for _ in range(2)]
+    client = rpc.RpcPsClient([f"127.0.0.1:{s.port}" for s in servers])
+    client.create_sparse_table(0, _cfg())
+    try:
+        yield servers, client
+    finally:
+        client.stop_servers()
+        client.close()
+        for s in servers:
+            s.close()
+
+
+@needs_rpc
+def test_trace_context_reaches_server_and_links(cluster2):
+    servers, client = cluster2
+    keys = np.arange(1, 101, dtype=np.uint64)
+    client.pull_sparse(0, keys)  # untraced warm-up
+    for s in range(2):
+        aggregate.fetch_server_obs(client, s, drain=True)
+
+    trace.start_tracing(sample=1.0)
+    with trace.span("step"):
+        client.pull_sparse(0, keys)
+        client.push_sparse(0, keys, np.ones((100, 12), np.float32))
+    trace.stop_tracing()
+    spans = {s.name: s for s in trace.drain_spans()}
+    pull = spans["pserver_client_pull_sparse"]
+    assert pull.attrs["rpc"] and pull.attrs["tx_bytes"] > 0 \
+        and pull.attrs["rx_bytes"] > 0
+
+    srv = []
+    for s in range(2):
+        _, sp = aggregate.fetch_server_obs(client, s, drain=True)
+        srv.extend(sp)
+    pull_srv = [s for s in srv if s["cmd"] == rpc._PULL_SPARSE]
+    # both shards served a slice of THE SAME client span (fan-out), so
+    # both server spans carry its id — no orphans, no duplicates beyond
+    # the genuine per-shard fan-out
+    assert len(pull_srv) == 2
+    assert {s["span_id"] for s in pull_srv} == {pull.span_id}
+    assert all(s["trace_id"] == pull.trace_id for s in srv)
+    assert all(s["dur_us"] >= 0 and s["req_bytes"] > 0 for s in srv)
+
+
+@needs_rpc
+def test_untraced_requests_record_no_server_spans(cluster2):
+    servers, client = cluster2
+    for s in range(2):
+        aggregate.fetch_server_obs(client, s, drain=True)
+    client.pull_sparse(0, np.arange(1, 50, dtype=np.uint64))
+    for s in range(2):
+        _, spans = aggregate.fetch_server_obs(client, s, drain=True)
+        assert spans == []  # wire counters still accumulate
+    snap, _ = aggregate.fetch_server_obs(client, 0)
+    series = snap["metrics"]["ps_server_wire_bytes"]["series"]
+    assert any(r["value"] > 0 for r in series)
+
+
+@needs_rpc
+def test_server_wire_accounting_rows_and_directions(cluster2):
+    servers, client = cluster2
+    for s in range(2):
+        aggregate.fetch_server_obs(client, s, drain=True)  # note: spans only
+    base = aggregate.job_snapshot(client)
+    base_rows = {f"{r['labels']['dir']}": r["value"]
+                 for r in base["metrics"]["ps_server_wire_rows"]["series"]}
+    keys = np.arange(1, 201, dtype=np.uint64)
+    client.pull_sparse(0, keys)
+    client.push_sparse(0, keys, np.ones((200, 12), np.float32))
+    job = aggregate.job_snapshot(client)
+    rows = {f"{r['labels']['dir']}": r["value"]
+            for r in job["metrics"]["ps_server_wire_rows"]["series"]}
+    assert rows["out"] - base_rows.get("out", 0) == 200   # pulled
+    assert rows["in"] - base_rows.get("in", 0) == 200     # pushed
+    # client-side view exists too, with density gauges in (0, 1]
+    dens = job["metrics"]["ps_client_density"]["series"]
+    assert any(0 < r["value"] <= 1.0 for r in dens)
+    assert len(job["processes"]) >= 3
+
+
+@needs_rpc
+def test_op_counts_shim_exact_and_independent(cluster2):
+    servers, client = cluster2
+    client.reset_op_counts()
+    keys = np.arange(1, 10, dtype=np.uint64)
+    client.pull_sparse(0, keys)
+    client.pull_sparse(0, keys)
+    client.push_sparse(0, keys, np.ones((9, 12), np.float32))
+    assert client.op_counts == {"pull_sparse": 2, "push_sparse": 1}
+    assert client.reset_op_counts() == {"pull_sparse": 2,
+                                        "push_sparse": 1}
+    assert client.reset_op_counts() == {}
+    # a second client's window is its own (distinct registry label)
+    other = rpc.RpcPsClient([client._conns[0].endpoint])
+    try:
+        other._sparse_dims[0] = client._sparse_dims[0]
+        other.pull_sparse(0, keys)
+        assert other.op_counts == {"pull_sparse": 1}
+        assert client.op_counts == {}
+    finally:
+        other.close()
+
+
+@needs_rpc
+def test_failover_replay_marks_span_retried_no_duplicate_ids():
+    """PR 4 failover + tracing: the replayed pull keeps ITS span id
+    (marked retried) and exactly one server span exists for it — on
+    the promoted replacement."""
+    sA = rpc.NativePsServer(n_trainers=1)
+    sB = rpc.NativePsServer(n_trainers=1)
+    epA, epB = f"127.0.0.1:{sA.port}", f"127.0.0.1:{sB.port}"
+
+    class StubRouter:
+        def routing(self):
+            return 0, [epB]
+
+        def allow(self, endpoint):
+            return True
+
+        def record(self, endpoint, ok):
+            pass
+
+        def failover(self, shard, bad):
+            return epB
+
+    flags_was = get_flags(["pserver_max_retry", "pserver_timeout_ms"])
+    set_flags({"pserver_max_retry": 1, "pserver_timeout_ms": 2000})
+    cli = rpc.RpcPsClient([epA], router=StubRouter())
+    cliB = rpc.RpcPsClient([epB])
+    try:
+        cli.create_sparse_table(0, _cfg())
+        cliB.create_sparse_table(0, _cfg())
+        keys = np.arange(1, 50, dtype=np.uint64)
+        cli.pull_sparse(0, keys)
+        sA.stop()  # kill the primary under the client
+
+        trace.start_tracing(sample=1.0)
+        with trace.span("step"):
+            cli.pull_sparse(0, keys)  # dies on A → replays on B
+        trace.stop_tracing()
+        spans = trace.drain_spans()
+        pulls = [s for s in spans
+                 if s.name == "pserver_client_pull_sparse"]
+        assert len(pulls) == 1  # ONE logical span, not one per attempt
+        assert pulls[0].attrs.get("retried") is True
+        assert len({s.span_id for s in spans}) == len(spans)
+
+        _, srv = aggregate.fetch_server_obs(cliB, 0, drain=True)
+        served = [s for s in srv if s["span_id"] == pulls[0].span_id]
+        assert len(served) == 1  # exactly one server span — no orphans
+        assert served[0]["cmd"] == rpc._PULL_SPARSE
+    finally:
+        set_flags(flags_was)
+        cli.close()
+        cliB.stop_servers()
+        cliB.close()
+        sA.close()
+        sB.close()
+
+
+@needs_rpc
+def test_registry_consistent_under_concurrent_communicator_workers():
+    """Concurrent push/pull workers (HalfAsync queue drain + async
+    prefetch pulls) against live shards: the registry's per-table row
+    counters land EXACTLY (distinct keys per send, so client-side
+    dedup-merge can't collapse rows)."""
+    from paddle_tpu.ps.communicator import HalfAsyncCommunicator
+
+    servers = [rpc.NativePsServer(n_trainers=1) for _ in range(2)]
+    client = rpc.RpcPsClient([f"127.0.0.1:{s.port}" for s in servers])
+    tid = 7  # a fresh table id → fresh per-table registry series
+    try:
+        client.create_sparse_table(tid, _cfg(tid))
+        comm = HalfAsyncCommunicator(client)
+        comm.start()
+        rows_h = client._tbl_obs[tid]["push_rows"]
+        pull_h = client._tbl_obs[tid]["pull_rows"]
+        base_push, base_pull = rows_h.value, pull_h.value
+
+        N_SENDS, N_KEYS = 40, 32
+
+        def sender(worker):
+            for i in range(N_SENDS):
+                lo = (worker * N_SENDS + i) * N_KEYS + 1
+                keys = np.arange(lo, lo + N_KEYS, dtype=np.uint64)
+                comm.send_sparse(tid, keys,
+                                 np.ones((N_KEYS, 12), np.float32))
+                comm.pull_sparse_async(tid, keys).result()
+
+        ts = [threading.Thread(target=sender, args=(w,)) for w in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        comm.barrier()
+        comm.stop()
+        total = 4 * N_SENDS * N_KEYS
+        assert rows_h.value - base_push == total
+        assert pull_h.value - base_pull == total
+    finally:
+        client.stop_servers()
+        client.close()
+        for s in servers:
+            s.close()
+
+
+# -- chrome export + timeline merge ----------------------------------------
+
+def test_flow_events_in_chrome_export(tmp_path):
+    trace.start_tracing(sample=1.0)
+    with trace.span("op") as s:
+        s.add_bytes(tx=10, rx=20)
+    trace.stop_tracing()
+    path = trace.export_chrome_trace(str(tmp_path / "t.json"),
+                                     process_name="trainer")
+    blob = json.load(open(path))
+    assert blob["clockSyncUs"] > 0
+    evs = blob["traceEvents"]
+    assert any(e.get("ph") == "s" and e.get("cat") == "rpc_flow"
+               for e in evs)
+    xs = [e for e in evs if e.get("ph") == "X"]
+    assert xs[0]["args"]["tx_bytes"] == 10
+    # raw perf-counter ts: the blob anchor is what wall-aligns them
+    assert xs[0]["ts"] < 1e14
+
+
+def test_server_spans_to_chrome_flow_finish():
+    spans = [{"trace_id": 1, "span_id": 42, "cmd": 3, "table_id": 0,
+              "ts_us": 1000, "dur_us": 50, "gate_us": 10,
+              "req_bytes": 64, "resp_bytes": 256}]
+    evs = aggregate.server_spans_to_chrome(spans, pid=0,
+                                           process_name="shard0")
+    fl = [e for e in evs if e.get("ph") == "f"]
+    assert len(fl) == 1 and fl[0]["id"] == 42
+    x = [e for e in evs if e.get("ph") == "X" and e["name"] != "gate_wait"]
+    assert x[0]["args"]["resp_bytes"] == 256
+    assert any(e["name"] == "gate_wait" for e in evs)
+
+
+def test_timeline_merge_aligns_clocks_and_deconflicts_pids(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import timeline
+
+    # worker A booted "late": small raw ts, large anchor; worker B
+    # early: big raw ts, small anchor. On raw clocks A sorts first;
+    # wall-aligned, B's event happened first. Both files use pid 0.
+    a = {"traceEvents": [{"name": "a", "ph": "X", "ts": 10.0, "dur": 1,
+                          "pid": 0, "tid": 0}],
+         "clockSyncUs": 2_000_000.0}
+    b = {"traceEvents": [{"name": "b", "ph": "X", "ts": 500_000.0,
+                          "dur": 1, "pid": 0, "tid": 0}],
+         "clockSyncUs": 1_000_000.0}
+    pa, pb = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    json.dump(a, open(pa, "w"))
+    json.dump(b, open(pb, "w"))
+    out = str(tmp_path / "m.json")
+    timeline.merge_traces([pa, pb], out)
+    evs = json.load(open(out))["traceEvents"]
+    xa = next(e for e in evs if e["name"] == "a")
+    xb = next(e for e in evs if e["name"] == "b")
+    assert xa["pid"] != xb["pid"]  # same original pid, distinct lanes
+    assert xb["ts"] < xa["ts"]     # wall order, not raw-clock order
+    assert min(xa["ts"], xb["ts"]) == 0.0  # re-zeroed axis
+    names = {e["args"]["name"] for e in evs
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert names == {"a", "b"}
+
+
+def test_timeline_merge_preserves_multi_pid_files(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import timeline
+
+    blob = {"traceEvents": [
+        {"name": "process_name", "ph": "M", "pid": 0,
+         "args": {"name": "trainer"}},
+        {"name": "t", "ph": "X", "ts": 1.0, "dur": 1, "pid": 0, "tid": 0},
+        {"name": "process_name", "ph": "M", "pid": 1,
+         "args": {"name": "shard"}},
+        {"name": "s", "ph": "X", "ts": 2.0, "dur": 1, "pid": 1, "tid": 0},
+    ], "clockSyncUs": 0.0}
+    p = str(tmp_path / "multi.json")
+    json.dump(blob, open(p, "w"))
+    out = str(tmp_path / "m.json")
+    timeline.merge_traces([p], out)
+    evs = json.load(open(out))["traceEvents"]
+    t = next(e for e in evs if e["name"] == "t")
+    s = next(e for e in evs if e["name"] == "s")
+    assert t["pid"] != s["pid"]  # the file's internal lanes survive
+
+
+def test_unsampled_root_suppresses_child_sampling():
+    """Children INHERIT an unsampled root's decision: no re-roll, no
+    orphan root spans, no wire context — even if the sample rate rises
+    mid-scope (regression: children used to roll independently)."""
+    trace.start_tracing(sample=0.0)
+    with trace.span("root") as r:
+        assert r is None
+        trace._sample_rate = 1.0  # a child re-roll would now sample
+        with trace.span("child") as c:
+            assert c is None
+            assert trace.wire_context() == (0, 0)
+    trace.stop_tracing()
+    assert trace.drain_spans() == []
+    # and a FRESH root after the unsampled scope samples normally
+    trace.start_tracing(sample=1.0)
+    with trace.span("root2") as r2:
+        assert r2 is not None
+    trace.stop_tracing()
+    assert [s.name for s in trace.drain_spans()] == ["root2"]
+
+
+def test_merge_histogram_bounds_conflict_marked_not_corrupted():
+    """Same family, different bucket ladders across processes: the
+    merge keeps the first ladder internally consistent
+    (sum(buckets) == count) and marks the conflict instead of adding
+    count/sum it cannot bucket."""
+    r1, r2 = Registry(), Registry()
+    r1.histogram("lat", buckets=(1.0,)).observe(0.5)
+    r2.histogram("lat", buckets=(2.0, 4.0)).observe(0.5)
+    job = aggregate.merge_snapshots([r1.snapshot(), r2.snapshot()])
+    s = job["metrics"]["lat"]["series"][0]
+    assert s["bounds_conflict"] is True
+    assert s["count"] == 1 and sum(s["buckets"]) == s["count"]
+
+
+@needs_rpc
+def test_disabled_metrics_skip_wire_accounting_entirely():
+    """FLAGS_obs_metrics=0 at client build: NO per-table handles bind,
+    so the accounting blocks (incl. their density count_nonzero scans)
+    short-circuit — while the op_counts accessor stays exact (its
+    CounterGroup local mirror is flag-independent)."""
+    was = get_flags(["obs_metrics"])["obs_metrics"]
+    set_flags({"obs_metrics": False})
+    server = client = None
+    try:
+        server = rpc.NativePsServer(n_trainers=1)
+        client = rpc.RpcPsClient([f"127.0.0.1:{server.port}"])
+        client.create_sparse_table(0, _cfg())
+        assert client._tbl_obs == {}  # nothing bound, nothing scanned
+        keys = np.arange(1, 10, dtype=np.uint64)
+        client.pull_sparse(0, keys)
+        client.push_sparse(0, keys, np.ones((9, 12), np.float32))
+        assert client.op_counts == {"pull_sparse": 1, "push_sparse": 1}
+    finally:
+        set_flags({"obs_metrics": was})
+        if client is not None:
+            client.stop_servers()
+            client.close()
+        if server is not None:
+            server.close()

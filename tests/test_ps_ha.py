@@ -287,9 +287,11 @@ def test_replicate_acks_frames_the_primary_also_rejected(pair):
     bconn = rpc.make_conn(f"127.0.0.1:{back.port}")
     try:
         # hand-build a kPushSparse frame whose payload is the wrong size
+        # (header layout incl. the obs trace-context field — ps/ha.py
+        # _HDR mirrors csrc ReqHeader)
         bad_payload = b"\x00" * 24
-        inner = struct.pack("<QIIqi", len(bad_payload), rpc._PUSH_SPARSE,
-                            0, 5, 0) + bad_payload
+        inner = struct.pack("<QIIqiQQ", len(bad_payload), rpc._PUSH_SPARSE,
+                            0, 5, 0, 0, 0) + bad_payload
         assert rpc.send_replicate(bconn, inner, 1, epoch=0) == 1
         assert back.applied_seq == 1  # advanced despite the rejection
         # and the stream keeps flowing afterwards

@@ -1,0 +1,178 @@
+"""graftlint pass: ``metric-in-hot-path`` — registry handles bind at
+module/constructor scope, never per call.
+
+The obs metrics registry (paddle_tpu/obs/registry.py) splits its API
+asymmetrically on purpose: handle CREATION
+(``registry.counter("fam", table="0")``, ``gauge``, ``histogram``,
+``CounterGroup(...)``) takes the registry lock, canonicalizes labels
+and walks the cardinality bound — a cold-path cost; handle USE
+(``.inc``/``.add``/``.set``/``.observe``) is the lock-cheap hot-path
+call. Creating a handle per request/step silently turns every
+increment into a registry transaction AND invites unbounded label
+churn — the exact failure the bounded-cardinality design exists to
+contain. This pass flags handle *creation* (never increments):
+
+- inside any ``for``/``while`` body (comprehensions at constructor
+  scope are the sanctioned bulk-bind idiom and are exempt), anywhere
+  in the tree;
+- anywhere in a function reachable from a ``# graftlint: hot-path``
+  root without crossing ``# graftlint: cold-path`` (the same
+  call-graph closure as the hot-host-transfer pass).
+
+A creation call is recognized syntactically: a call whose final
+attribute is ``counter``/``gauge``/``histogram`` (or the bare/dotted
+``CounterGroup`` constructor) with a STRING LITERAL first argument —
+the family name. Variable-named families (the registry's own
+internals, generic re-export shims) are not creations at the call
+site and pass. Suppression: trailing
+``# graftlint: ignore[metric-in-hot-path]``; known-bounded sites go in
+tools/lint/allow.txt with a justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from common import (Diagnostic, dotted, line_ignores,  # noqa: E402
+                    walk_py)
+from tracer_safety import (FuncDef, ModuleInfo, _callees,  # noqa: E402
+                           _collect_module, _COLD_RE, _HOT_RE, _Index,
+                           _marked)
+
+RULE = "metric-in-hot-path"
+_CREATORS = {"counter", "gauge", "histogram"}
+_CTOR = "CounterGroup"
+
+
+def _is_creation(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted(node.func)
+    if name is None:
+        return False
+    sym = name.split(".")[-1]
+    if sym != _CTOR and sym not in _CREATORS:
+        return False
+    return bool(node.args) and isinstance(node.args[0], ast.Constant) \
+        and isinstance(node.args[0].value, str)
+
+
+def _emit(mi: ModuleInfo, node: ast.AST, msg: str,
+          out: List[Diagnostic]) -> None:
+    line = getattr(node, "lineno", 1)
+    if RULE not in line_ignores(mi.source_lines, line):
+        out.append(Diagnostic(mi.path, line, RULE, msg))
+
+
+def _scan_loops(mi: ModuleInfo) -> List[Diagnostic]:
+    """Creation calls lexically inside for/while bodies (module scope
+    and function bodies alike — a loop is a loop)."""
+    diags: List[Diagnostic] = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.loop_depth = 0
+
+        def _loop(self, node) -> None:
+            self.loop_depth += 1
+            self.generic_visit(node)
+            self.loop_depth -= 1
+
+        visit_For = visit_While = visit_AsyncFor = _loop
+
+        def visit_Call(self, node: ast.Call) -> None:
+            if self.loop_depth > 0 and _is_creation(node):
+                _emit(mi, node,
+                      "metric handle created inside a loop — bind the "
+                      "handle once at module/constructor scope (a dict "
+                      "comprehension or obs.registry.CounterGroup) and "
+                      "increment it here", diags)
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node) -> None:
+            # a nested def's body does not execute per loop iteration
+            depth, self.loop_depth = self.loop_depth, 0
+            self.generic_visit(node)
+            self.loop_depth = depth
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+    V().visit(mi.tree)
+    return diags
+
+
+def _scan_hot(mi: ModuleInfo, fd: FuncDef) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    own_nested = {n for n in ast.walk(fd.node)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  and n is not fd.node}
+
+    class V(ast.NodeVisitor):
+        def visit_FunctionDef(self, node) -> None:
+            if node in own_nested:
+                return  # nested defs scan as their own units (if reached)
+            self.generic_visit(node)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Call(self, node: ast.Call) -> None:
+            if _is_creation(node):
+                _emit(mi, node,
+                      f"metric handle created on the hot path (reachable "
+                      f"from a `# graftlint: hot-path` root via "
+                      f"`{fd.name}`) — pre-bind it at constructor scope "
+                      "and increment here", diags)
+            self.generic_visit(node)
+
+    V().visit(fd.node)
+    return diags
+
+
+def run(root: str, subdirs=("paddle_tpu",), files=("bench.py",)
+        ) -> List[Diagnostic]:
+    modules = [m for m in (_collect_module(p, root)
+                           for p in walk_py(root, subdirs, files))
+               if m is not None]
+    index = _Index(modules)
+
+    diags: List[Diagnostic] = []
+    for mi in modules:
+        diags.extend(_scan_loops(mi))
+
+    # the same hot-path closure as tracer_safety.run_hot_path: roots
+    # marked `# graftlint: hot-path`, stopping at `# graftlint: cold-path`
+    reachable: Dict[int, Tuple[ModuleInfo, FuncDef]] = {}
+    work: List[Tuple[ModuleInfo, FuncDef]] = []
+    for mi in modules:
+        for defs in mi.funcs.values():
+            for fd in defs:
+                if _marked(mi, fd, _HOT_RE) and id(fd.node) not in reachable:
+                    reachable[id(fd.node)] = (mi, fd)
+                    work.append((mi, fd))
+    while work:
+        mi, fd = work.pop()
+        for callee in _callees(mi, fd, index):
+            if id(callee.node) in reachable:
+                continue
+            cmi = index.by_name[callee.module]
+            if _marked(cmi, callee, _COLD_RE):
+                continue  # declared cold: may bind handles
+            reachable[id(callee.node)] = (cmi, callee)
+            work.append((cmi, callee))
+    seen = {(d.path, d.line) for d in diags}
+    for mi, fd in reachable.values():
+        for d in _scan_hot(mi, fd):
+            if (d.path, d.line) not in seen:  # loop hit already covers it
+                seen.add((d.path, d.line))
+                diags.append(d)
+    return sorted(diags, key=lambda d: (d.path, d.line, d.rule))
+
+
+if __name__ == "__main__":
+    from common import REPO_ROOT
+    for d in run(REPO_ROOT):
+        print(d)

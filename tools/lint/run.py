@@ -25,6 +25,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import conventions  # noqa: E402
 import lock_order  # noqa: E402
+import obs_metrics  # noqa: E402
 import tracer_safety  # noqa: E402
 from common import (REPO_ROOT, load_allowlist,  # noqa: E402
                     split_new_and_allowed)
@@ -47,6 +48,7 @@ def main(argv=None) -> int:
         "hot_path": tracer_safety.run_hot_path,
         "lock_order": lock_order.run,
         "conventions": conventions.run,
+        "obs_metrics": obs_metrics.run,
     }
     diags = []
     per_pass = {}

@@ -1,0 +1,152 @@
+"""Cross-process trace demo: ONE merged chrome trace for a sampled
+CtrStreamTrainer step over a real 2-shard NativePsServer cluster
+(ISSUE 8 acceptance artifact — committed as OBS_TRACE.json).
+
+What the artifact shows (load it in chrome://tracing or perfetto):
+
+- a ``trainer`` lane with the sampled ``ctr_stream_step`` root spans
+  and their ``pserver_client_pull_sparse`` / push children (wire bytes
+  in args);
+- one lane per PS shard with the server-side spans the shards recorded
+  against the SAME trace ids (service time, gate wait, request and
+  response bytes in args);
+- FLOW ARROWS from each trainer-side pull/push span to the exact
+  shard-side span that served it — the client span's id rode the RPC
+  frame header's fixed trace-context field and the server recorded its
+  span under it, so the two halves bind by id with no clock guesswork.
+
+The merge itself goes through tools/timeline.py (clockSyncUs
+alignment + pid de-conflict), i.e. this demo also exercises the
+multi-worker merge path end to end.
+
+Standalone: prints exactly ONE JSON line (driver contract) and writes
+OBS_TRACE.json (env OBS_TRACE_OUT overrides). Env knobs: OTD_BATCHES,
+OTD_BATCH, OTD_SLOTS, OTD_NID.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+
+def run(out_path: str) -> dict:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM
+    from paddle_tpu.obs import aggregate, registry, trace
+    from paddle_tpu.ps import rpc
+    from paddle_tpu.ps.communicator import SyncCommunicator
+    from paddle_tpu.ps.ps_trainer import CtrStreamTrainer
+    from paddle_tpu.ps.table import TableConfig
+
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    import timeline
+
+    from obs_overhead_bench import _make_dataset  # one shared generator
+
+    S = int(os.environ.get("OTD_SLOTS", 8))
+    D = 4
+    batch = int(os.environ.get("OTD_BATCH", 256))
+    n_batches = int(os.environ.get("OTD_BATCHES", 8))
+    nid = int(os.environ.get("OTD_NID", 1000))
+    ds = _make_dataset(S, D, batch, n_batches, nid=nid)
+
+    registry.set_process_role("trainer")
+    servers = [rpc.NativePsServer(n_trainers=1) for _ in range(2)]
+    client = rpc.RpcPsClient([f"127.0.0.1:{s.port}" for s in servers])
+    try:
+        client.create_sparse_table(
+            0, TableConfig(table_id=0, shard_num=4, accessor="ctr"))
+        comm = SyncCommunicator(client)  # pulls/pushes inline → traced
+        comm.start()
+        cfg = CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=8,
+                        dnn_hidden=(64, 64))
+        trainer = CtrStreamTrainer(
+            DeepFM(cfg), optimizer.Adam(1e-3), None,
+            sparse_slots=[f"s{i}" for i in range(S)],
+            dense_slots=[f"d{i}" for i in range(D)], label_slot="label",
+            communicator=comm, table_id=0, embedx_dim=8)
+        # warm one epoch UNSAMPLED (compile + row creation), then the
+        # sampled epoch the artifact shows
+        trainer.train_from_dataset(ds, batch_size=batch)
+        for s in range(client.num_servers):
+            aggregate.fetch_server_obs(client, s, drain=True)  # discard
+        trace.start_tracing(sample=1.0)
+        result = trainer.train_from_dataset(ds, batch_size=batch)
+        trace.stop_tracing()
+        comm.stop()
+
+        tmp = tempfile.mkdtemp(prefix="obs_trace_")
+        trainer_file = os.path.join(tmp, "trainer.json")
+        trace.export_chrome_trace(trainer_file, pid=0,
+                                  process_name="trainer")
+        lanes = [trainer_file]
+        shard_spans = 0
+        snaps = [registry.snapshot()]
+        for s in range(client.num_servers):
+            snap, spans = aggregate.fetch_server_obs(client, s, drain=True)
+            snaps.append(snap)
+            shard_spans += len(spans)
+            evs = aggregate.server_spans_to_chrome(
+                spans, pid=0, process_name=f"ps_shard_{s}")
+            lane = os.path.join(tmp, f"ps_shard_{s}.json")
+            with open(lane, "w") as f:
+                # server span ts are wall-epoch µs already → anchor 0
+                json.dump({"traceEvents": evs, "clockSyncUs": 0.0}, f)
+            lanes.append(lane)
+        n_events = timeline.merge_traces(lanes, out_path)
+
+        # -- acceptance self-check on the committed artifact -------------
+        with open(out_path) as f:
+            merged = json.load(f)["traceEvents"]
+        flows_s = {e["id"] for e in merged if e.get("ph") == "s"}
+        flows_f = {e["id"] for e in merged if e.get("ph") == "f"}
+        linked = flows_s & flows_f
+        client_pulls = [e for e in merged
+                        if e.get("name") == "pserver_client_pull_sparse"]
+        server_pulls = [e for e in merged
+                        if e.get("name") == "ps_server_pull_sparse"]
+        assert linked, "no client span flow-linked to a server span"
+        assert client_pulls and server_pulls, "missing pull spans"
+        assert all("tx_bytes" in e["args"] for e in client_pulls)
+        assert all(e["args"]["req_bytes"] > 0 for e in server_pulls)
+        job = aggregate.merge_snapshots(snaps)
+        wire = job["metrics"]["ps_server_wire_bytes"]["series"]
+        return {
+            "metric": "obs_trace_demo",
+            "out": out_path,
+            "events": n_events,
+            "steps": int(result["steps"]),
+            "client_pull_spans": len(client_pulls),
+            "server_pull_spans": len(server_pulls),
+            "flow_links": len(linked),
+            "shard_spans": shard_spans,
+            "job_processes": len(job["processes"]),
+            "server_wire_bytes": {f"{r['labels']['table']}/"
+                                  f"{r['labels']['dir']}": r["value"]
+                                  for r in wire},
+        }
+    finally:
+        client.stop_servers()
+        client.close()
+        for s in servers:
+            s.close()
+
+
+def main() -> int:
+    out = os.environ.get("OBS_TRACE_OUT", os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "OBS_TRACE.json"))
+    try:
+        rec = run(out)
+    except Exception as e:  # one-JSON-line driver contract
+        rec = {"metric": "obs_trace_demo", "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

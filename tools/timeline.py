@@ -2,11 +2,28 @@
 
 Reference counterpart: ``tools/timeline.py`` — it collects each
 worker's profiler dump and emits a single chrome-trace JSON with one
-process lane per worker. Here workers write chrome-trace JSON directly
-(``paddle_tpu.core.profiler.export_chrome_tracing``); this tool merges
-them, assigning each input file its own pid lane (named after the file)
-so a multi-worker job reads as one timeline in chrome://tracing or the
-perfetto UI.
+process lane per worker. Workers write chrome-trace JSON directly
+(``paddle_tpu.core.profiler.export_chrome_tracing`` or
+``paddle_tpu.obs.trace.export_chrome_trace``); this tool merges them
+into one timeline for chrome://tracing / the perfetto UI.
+
+Two merge corrections (ISSUE 8 satellite):
+
+- **Clock alignment.** Every exporter stamps its blob with
+  ``clockSyncUs`` — the process's wall-clock anchor for its
+  ``perf_counter`` timestamps. Raw per-host monotonic clocks have
+  arbitrary origins, so merging on them interleaves lanes nonsensically
+  (a worker booted 100 s later appears 100 s "ahead"). The merge
+  shifts each file's events by its anchor relative to the EARLIEST
+  anchor, putting every lane on one shared epoch while keeping the
+  numbers small. Files without an anchor (pre-obs exports) merge
+  unshifted with a warning.
+- **Pid de-conflict.** A single input may legitimately carry SEVERAL
+  pid lanes (the obs trace demo emits trainer + one lane per PS
+  shard). Each DISTINCT (file, original pid) pair maps to a fresh
+  output pid — lanes never collide across files and multi-lane files
+  keep their internal structure (the old behavior flattened every
+  event onto the file's index, silently merging a file's lanes).
 
 Usage:
     python tools/timeline.py worker0.json worker1.json -o merged.json
@@ -19,20 +36,60 @@ import sys
 
 
 def merge_traces(paths, output):
-    events = []
-    for pid, path in enumerate(paths):
+    blobs = []
+    for path in paths:
         with open(path) as f:
             blob = json.load(f)
         # both legal chrome-trace forms: {"traceEvents": [...]} or [...]
         evs = blob if isinstance(blob, list) else blob.get("traceEvents", [])
+        sync = None if isinstance(blob, list) else blob.get("clockSyncUs")
+        blobs.append((path, evs, sync))
+
+    anchors = [s for _, _, s in blobs if s is not None]
+    base = min(anchors) if anchors else 0.0
+    for path, _, sync in blobs:
+        if sync is None and anchors:
+            print(f"warning: {path} has no clockSyncUs anchor — its lane "
+                  "merges unshifted and may interleave on a raw "
+                  "monotonic clock", file=sys.stderr)
+
+    events = []
+    pid_map = {}  # (file index, original pid) → output pid
+
+    def out_pid(fi, orig):
+        key = (fi, orig)
+        if key not in pid_map:
+            pid_map[key] = len(pid_map)
+        return pid_map[key]
+
+    for fi, (path, evs, sync) in enumerate(blobs):
+        shift = (sync - base) if sync is not None else 0.0
         name = os.path.splitext(os.path.basename(path))[0]
-        # one metadata record names the lane (chrome trace convention)
-        events.append({"name": "process_name", "ph": "M", "pid": pid,
-                       "args": {"name": name}})
+        named_lanes = set()
         for ev in evs:
             ev = dict(ev)
+            pid = out_pid(fi, ev.get("pid", 0))
             ev["pid"] = pid
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                named_lanes.add(pid)
+            else:
+                for k in ("ts",):
+                    if k in ev:
+                        ev[k] = ev[k] + shift
             events.append(ev)
+        # one metadata record names each unnamed lane (chrome convention)
+        for (f2, orig), pid in list(pid_map.items()):
+            if f2 == fi and pid not in named_lanes:
+                lane = name if orig == 0 else f"{name}:{orig}"
+                events.append({"name": "process_name", "ph": "M",
+                               "pid": pid, "args": {"name": lane}})
+                named_lanes.add(pid)
+    # re-zero the merged axis (anchors can be wall-epoch-sized — the
+    # lanes stay aligned, the viewer gets small numbers)
+    t0 = min((ev["ts"] for ev in events if "ts" in ev), default=0.0)
+    for ev in events:
+        if "ts" in ev:
+            ev["ts"] -= t0
     with open(output, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
     return len(events)
