@@ -104,7 +104,7 @@ def _init_backend():
                     time.sleep(10)
         result["err"] = last
 
-    t = threading.Thread(target=_init, daemon=True)
+    t = threading.Thread(target=_init, daemon=True, name="bench-jax-init")
     t.start()
     t.join(deadline)
     if t.is_alive():

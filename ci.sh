@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI gate (reference L0's cmake+ctest role): graftlint, native build,
 # fast test gate, then the full matrix.
-# Usage: ./ci.sh [lint|fast|full|chaos|ckpt|hot_tier|serving|obs]
+# Usage: ./ci.sh [lint|fast|full|chaos|ckpt|hot_tier|serving|obs|slo]
 #   chaos — PS high-availability fast-gate: every failover/replication
 #   test with faultpoints armed (incl. the slow e2e kill-shard runs)
 #   plus the chaos_ps demo with its recovery/overhead acceptance checks.
@@ -23,6 +23,13 @@
 #   header (tracing off adds ZERO bytes beyond it), and the ≥3-process
 #   job snapshot with per-table wire bytes + observed density; the
 #   trace demo re-generates the flow-linked cross-process timeline.
+#   slo — continuous-telemetry gate: the time-series/SLO/flight-recorder
+#   suites (incl. the slow kill-shard e2e), then the slo_demo run — a
+#   delay-ms faultpoint armed mid-stream must make the watchdog fire the
+#   step-time burn-rate alert, dump a postmortem bundle that parses and
+#   contains the firing window, and the live exporter's /metrics must
+#   validate as well-formed OpenMetrics; the overhead bench re-asserts
+#   the sampler+watchdog cost inside the 2% budget.
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -140,6 +147,57 @@ print('serving OK: warm p99=%.1fms qps=%.0f, push→servable p95=%.1fms'
   }
   check_serving || { echo "serving retry (ambient-load outlier)"; check_serving; }
   echo "CI OK (serving)"
+  exit 0
+fi
+
+if [[ "${1:-fast}" == "slo" ]]; then
+  echo "== slo gate: continuous telemetry / watchdog / flight recorder =="
+  # -m "" includes the slow e2e: kill-shard mid-CtrStreamTrainer →
+  # failover/breaker alerts + a postmortem bundle with the failing
+  # request spans and the recovery visible in the metric timeline
+  python -m pytest tests/test_slo.py tests/test_flightrec.py -q -m ""
+  echo "== slo demo (injected degradation → alert → bundle → exporter) =="
+  check_slo() {
+    PYTHONPATH="$PWD:${PYTHONPATH:-}" JAX_PLATFORMS=cpu \
+      SLO_OUT=/tmp/ci_obs_timeseries.json python tools/slo_demo.py \
+      | python -c "
+import json, sys
+d = json.loads([l for l in sys.stdin.read().splitlines()
+                if l.startswith('{')][-1])
+assert 'error' not in d, d
+assert d['alert']['rule'] == 'step_time_p95', d['alert']
+assert d['alert_cleared'], d
+assert d['bundle']['alert_in_degraded_window'], d['bundle']
+assert d['bundle']['spans'] > 0, d['bundle']
+assert d['bundle']['alert_instants_in_trace'] > 0, d['bundle']
+assert d['openmetrics_ok'] and d['openmetrics_families'] > 5, d
+assert d['timeline_alert_instants'] > 0, d
+print('slo demo OK: alert @%.1fms threshold, bundle %s (%d spans), '
+      '%d OpenMetrics families'
+      % (d['threshold_ms'], d['bundle']['reason'], d['bundle']['spans'],
+         d['openmetrics_families']))"
+  }
+  check_slo || { echo "slo demo retry (ambient-load outlier)"; check_slo; }
+  echo "== obs overhead bench (sampler+watchdog inside the 2% budget) =="
+  # same one-retry discipline as the obs gate: the min-over-passes
+  # estimator still loses to whole-pass noisy-neighbor weather on this
+  # VM (±30% swings observed at zero local load)
+  check_slo_overhead() {
+    PYTHONPATH="$PWD:${PYTHONPATH:-}" JAX_PLATFORMS=cpu \
+      python tools/obs_overhead_bench.py | python -c "
+import json, sys
+d = json.loads([l for l in sys.stdin.read().splitlines()
+                if l.startswith('{')][-1])
+assert 'error' not in d, d
+assert d['value'] <= 2.0, d
+assert d['sampler_ticks'] > 0 and d['watchdog_evaluations'] > 0, d
+assert d['alerts_fired'] == 0, d  # healthy run: nothing may fire
+print('slo overhead OK: %+.2f%% with %d sampler ticks, %d rule evals'
+      % (d['value'], d['sampler_ticks'], d['watchdog_evaluations']))"
+  }
+  check_slo_overhead || { echo "slo overhead retry (ambient-load outlier)"; \
+    check_slo_overhead; }
+  echo "CI OK (slo)"
   exit 0
 fi
 
@@ -316,7 +374,7 @@ print('bench degradation ladder OK')"
       tests/test_rpc_robustness.py tests/test_dist_graph.py \
       tests/test_rpc_parallel.py tests/test_ps_ha.py \
       tests/test_job_checkpoint.py tests/test_serving.py \
-      tests/test_obs.py -q -m ""
+      tests/test_obs.py tests/test_slo.py tests/test_flightrec.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_tsan_report* 2>/dev/null; then
     echo "TSAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_tsan_report*)"
     exit 1
@@ -336,7 +394,7 @@ print('bench degradation ladder OK')"
       tests/test_rpc_robustness.py tests/test_dist_graph.py \
       tests/test_rpc_parallel.py tests/test_ps_ha.py \
       tests/test_job_checkpoint.py tests/test_serving.py \
-      tests/test_obs.py -q -m ""
+      tests/test_obs.py tests/test_slo.py tests/test_flightrec.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_asan_report* 2>/dev/null; then
     echo "ASAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_asan_report*)"
     exit 1
@@ -355,7 +413,7 @@ print('bench degradation ladder OK')"
       tests/test_rpc_robustness.py tests/test_dist_graph.py \
       tests/test_rpc_parallel.py tests/test_ps_ha.py \
       tests/test_job_checkpoint.py tests/test_serving.py \
-      tests/test_obs.py -q -m ""
+      tests/test_obs.py tests/test_slo.py tests/test_flightrec.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_ubsan_report* 2>/dev/null; then
     echo "UBSAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_ubsan_report*)"
     exit 1

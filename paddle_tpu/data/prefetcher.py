@@ -61,7 +61,8 @@ class DevicePrefetcher:
                         if stop.is_set():
                             return
 
-        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="device-prefetcher")
         self._thread.start()
         # abandoned mid-stream → stop the producer (it would otherwise
         # spin forever pinning `depth` device batches)

@@ -145,7 +145,7 @@ class TCPStore:
             self._server.state = _StoreState()  # type: ignore[attr-defined]
             port = self._server.server_address[1]
             threading.Thread(target=self._server.serve_forever,
-                             daemon=True).start()
+                             daemon=True, name="tcp-store-server").start()
         self.host, self.port = host, port
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._rfile = self._sock.makefile("rb")

@@ -253,7 +253,8 @@ class ElasticManager:
 
     def start(self) -> None:
         self._beat()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="lease-heartbeat")
         self._thread.start()
 
     def stop(self) -> None:

@@ -66,6 +66,8 @@ import numpy as np
 
 from ..core.enforce import (NotFoundError, PreconditionNotMetError, enforce)
 from ..core.flags import define_flag, flag
+from ..obs import registry as _obs_registry
+from ..obs import trace as _obs_trace
 from ..ps.faultpoints import faultpoint
 from . import checkpoint as ckpt
 from .fs import (crc32c, crc32c_file, fsync_dir, fsync_file, gc_snapshots,
@@ -255,6 +257,12 @@ class JobCheckpointManager:
         self.pause_ms: "deque" = deque(maxlen=512)  # gate hold/capture
         self.fallbacks: "deque" = deque(maxlen=64)  # (id, reason) @load
         self._clean_stale_tmp()
+        # obs: set at every publish — (now - gauge) is the checkpoint
+        # AGE the SLO watchdog's staleness rule alarms on
+        self._g_last_pub = _obs_registry.REGISTRY.gauge(
+            "job_checkpoint_last_wall_s")
+        self._c_published = _obs_registry.REGISTRY.counter(
+            "job_checkpoints_published")
 
     # -- registration ------------------------------------------------------
 
@@ -448,6 +456,8 @@ class JobCheckpointManager:
         os.replace(tmp, final)   # atomic publish of the whole snapshot
         fsync_dir(self.root)
         self.saves += 1
+        self._g_last_pub.set(_obs_trace.wall_s())
+        self._c_published.inc()
         self._gc()
 
     def _gc(self) -> None:

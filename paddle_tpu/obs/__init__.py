@@ -1,6 +1,7 @@
-"""Unified observability plane (ISSUE 8, ROADMAP items 3/5 feed).
+"""Unified observability plane (ISSUE 8 + ISSUE 10, ROADMAP items 3/5
+feed).
 
-Three legs, one package:
+Seven legs, one package:
 
 - ``registry`` — the job-wide metrics registry: pre-bound
   counter/gauge/histogram handles (create at module/constructor scope,
@@ -17,6 +18,19 @@ Three legs, one package:
   communicator workers, PS shards via the kObsSnap command, serving
   replicas) into ONE job-wide view, and per-shard server spans into
   ONE merged chrome trace (tools/obs_trace_demo.py).
+- ``timeseries`` — the always-on sampler: periodic snapshots into a
+  bounded delta-compressed ring (counters as rates, gauges as last,
+  histograms as bucket deltas); ``JobCollector`` fans the tick out
+  over kObsSnap so ONE ring holds the whole job's curves.
+- ``exporter`` — a stdlib read-only HTTP endpoint per trainer serving
+  OpenMetrics text + JSON history for the whole job (PS shards stay
+  RPC-only; the trainer proxies them).
+- ``slo`` — declarative SLO rules with multi-window burn-rate
+  evaluation; alerts land in a bounded log AND back in the registry.
+- ``flightrec`` — the crash flight recorder: a cheap always-on tail of
+  spans/metric deltas/alerts that dumps an atomic postmortem bundle on
+  failover promotion, breaker open, faultpoint fire, uncaught
+  trainer/serving exception, or SIGTERM.
 
 Per-table wire accounting (bytes/rows/observed density per direction,
 client- and server-side) lives on the registry under the
@@ -24,16 +38,42 @@ client- and server-side) lives on the registry under the
 feed Parallax-style auto-placement (ROADMAP item 3) will read.
 """
 
-from . import aggregate, registry, trace
+from . import aggregate, flightrec, registry, slo, timeseries, trace
+from .flightrec import FlightRecorder
 from .registry import (REGISTRY, CounterGroup, Registry, counter, gauge,
                        histogram, metrics_enabled, snapshot)
+from .slo import Alert, SloRule, SloWatchdog, default_rules
+from .timeseries import JobCollector, MetricRing, Sampler
 from .trace import (current_span, mark_retried, span, start_tracing,
                     stop_tracing, tracing_enabled, wire_context)
 
+# the exporter stays LAZY (PEP 562): it drags in http.server, which
+# every PS shard / communicator / test process importing ps.rpc (and
+# therefore obs) would otherwise pay at startup without ever serving
+_LAZY_EXPORTER = {"exporter", "ObsExporter", "to_openmetrics",
+                  "parse_openmetrics"}
+
+
+def __getattr__(name):
+    if name in _LAZY_EXPORTER:
+        # importlib, not `from . import`: the fromlist probe re-enters
+        # this __getattr__ before the submodule lands (recursion)
+        import importlib
+
+        _exporter = importlib.import_module(".exporter", __name__)
+        return _exporter if name == "exporter" else getattr(_exporter, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
-    "registry", "trace", "aggregate",
+    "registry", "trace", "aggregate", "timeseries", "exporter", "slo",
+    "flightrec",
     "Registry", "REGISTRY", "CounterGroup",
     "counter", "gauge", "histogram", "snapshot", "metrics_enabled",
     "span", "start_tracing", "stop_tracing", "tracing_enabled",
     "wire_context", "current_span", "mark_retried",
+    "MetricRing", "Sampler", "JobCollector",
+    "ObsExporter", "to_openmetrics", "parse_openmetrics",
+    "SloRule", "SloWatchdog", "Alert", "default_rules",
+    "FlightRecorder",
 ]

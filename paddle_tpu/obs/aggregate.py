@@ -81,7 +81,8 @@ def merge_snapshots(snaps: List[Dict[str, Any]]) -> Dict[str, Any]:
 # PS shard side (kObsSnap)
 # ---------------------------------------------------------------------------
 
-def fetch_server_obs(client, server: int, drain: bool = True
+def fetch_server_obs(client, server: int, drain: bool = True,
+                     retries: Optional[int] = None
                      ) -> Tuple[Dict[str, Any], List[Dict[str, Any]]]:
     """One shard's observability state via kObsSnap, addressed to
     ``server`` (no failover replay — a promoted replacement's counters
@@ -91,11 +92,14 @@ def fetch_server_obs(client, server: int, drain: bool = True
     ``ps_server_requests`` labeled by table and direction), ``spans``
     as dicts {trace_id, span_id, cmd, table_id, ts_us, dur_us,
     gate_us, req_bytes, resp_bytes}. ``drain`` pops the span buffer
-    (wire counters always persist)."""
+    (wire counters always persist). ``retries=0`` fails fast — the
+    continuous sampler passes it so a dead shard costs one tick, not
+    the transport's full retry budget per tick."""
     from ..ps.rpc import _OBS_SNAP  # lazy: rpc imports obs at module load
 
+    kw = {} if retries is None else {"retries": retries}
     _, resp = client._direct(
-        server, lambda c: c.check(_OBS_SNAP, aux=1 if drain else 0))
+        server, lambda c: c.check(_OBS_SNAP, aux=1 if drain else 0, **kw))
     buf = bytes(resp)
     n_tables, n_spans, spans_dropped = np.frombuffer(
         buf[:16], dtype=np.dtype([("t", "<u4"), ("s", "<u4"),
@@ -117,17 +121,25 @@ def fetch_server_obs(client, server: int, drain: bool = True
                       "dur_us": dur_us, "gate_us": gate_us,
                       "req_bytes": req_b, "resp_bytes": resp_b})
     bytes_series, rows_series, req_series = [], [], []
+    # the shard label keeps distinct shards' cumulative counters from
+    # ALIASING onto one merged series: without it, one shard missing a
+    # collector tick (dead mid-failover) makes the merged value DROP,
+    # which the time-series ring reads as a counter restart and
+    # re-adds the shard's whole history as one tick's delta when it
+    # returns — a spurious spike exactly in the incident window
     for tid, in_b, out_b, in_r, out_r, reqs in wires:
         t = str(tid)
-        bytes_series.append({"labels": {"table": t, "dir": "in"},
-                             "value": in_b})
-        bytes_series.append({"labels": {"table": t, "dir": "out"},
-                             "value": out_b})
-        rows_series.append({"labels": {"table": t, "dir": "in"},
-                            "value": in_r})
-        rows_series.append({"labels": {"table": t, "dir": "out"},
-                            "value": out_r})
-        req_series.append({"labels": {"table": t}, "value": reqs})
+        sh = str(server)
+        bytes_series.append({"labels": {"table": t, "dir": "in",
+                                        "shard": sh}, "value": in_b})
+        bytes_series.append({"labels": {"table": t, "dir": "out",
+                                        "shard": sh}, "value": out_b})
+        rows_series.append({"labels": {"table": t, "dir": "in",
+                                       "shard": sh}, "value": in_r})
+        rows_series.append({"labels": {"table": t, "dir": "out",
+                                       "shard": sh}, "value": out_r})
+        req_series.append({"labels": {"table": t, "shard": sh},
+                           "value": reqs})
     snap = {
         "process": {"role": f"ps_shard_{server}",
                     "endpoint": getattr(client._conns[server], "endpoint",

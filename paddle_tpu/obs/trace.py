@@ -39,8 +39,8 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 __all__ = [
     "Span", "span", "start_tracing", "stop_tracing", "tracing_enabled",
     "wire_context", "current_span", "mark_retried", "with_span",
-    "drain_spans", "spans_to_chrome", "export_chrome_trace",
-    "WIRE_CONTEXT_BYTES", "EPOCH_ANCHOR_US",
+    "drain_spans", "peek_spans", "spans_to_chrome", "export_chrome_trace",
+    "WIRE_CONTEXT_BYTES", "EPOCH_ANCHOR_US", "wall_s",
 ]
 
 #: bytes the trace context occupies in the RPC frame header — fixed
@@ -53,6 +53,14 @@ WIRE_CONTEXT_BYTES = 16
 # genuine wall-clock anchor, not a duration measurement:
 _EPOCH_OFF = time.time() - time.perf_counter()  # graftlint: ignore[time-time]
 EPOCH_ANCHOR_US = _EPOCH_OFF * 1e6
+
+
+def wall_s() -> float:
+    """Wall-clock seconds on the SAME anchored axis every span/export
+    uses (the once-per-process anchor + perf_counter): monotonic within
+    the process, comparable across processes — what the obs time-series
+    ring and SLO alerts stamp their records with."""
+    return _EPOCH_OFF + time.perf_counter()
 
 _enabled = False
 _sample_rate = 1.0
@@ -242,6 +250,14 @@ def drain_spans() -> List[Span]:
         out = list(_RING)
         _RING.clear()
     return out
+
+
+def peek_spans() -> List[Span]:
+    """Snapshot WITHOUT clearing — the flight recorder's tail read: a
+    postmortem dump must not consume the spans a later explicit export
+    (or a second trigger) still wants."""
+    with _MU:
+        return list(_RING)
 
 
 def dropped_spans() -> int:

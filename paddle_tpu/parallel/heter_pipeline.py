@@ -91,7 +91,8 @@ class HeterPipelineTrainer:
         threads = []
         for i, sec in enumerate(self.sections):
             for _ in range(sec.num_threads):
-                t = threading.Thread(target=worker, args=(i,), daemon=True)
+                t = threading.Thread(target=worker, args=(i,), daemon=True,
+                                     name=f"heter-stage-{i}")
                 t.start()
                 threads.append(t)
 
@@ -107,7 +108,8 @@ class HeterPipelineTrainer:
                     results.append(item)
             sink_done.set()
 
-        sink_thread = threading.Thread(target=sink, daemon=True)
+        sink_thread = threading.Thread(target=sink, daemon=True,
+                                       name="heter-sink")
         sink_thread.start()
 
         # feed

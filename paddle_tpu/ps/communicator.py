@@ -29,6 +29,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core.flags import define_flag, flag
+from ..obs import registry as _obs_registry
 from ..obs import trace as _trace
 from .client import PSClient
 
@@ -54,6 +55,9 @@ define_flag("communicator_pull_ahead", 1,
             "Pulls are stale by at most k queued pushes — the async-PS "
             "contract; Sync mode and local tables ignore it (exact "
             "per-batch ordering). 0 disables")
+
+
+_COMM_SEQ = iter(range(1, 1 << 30))  # per-process communicator tag
 
 
 class CommunicatorConfig:
@@ -87,6 +91,16 @@ class _BaseCommunicator:
         self._pull_pool: Optional[ThreadPoolExecutor] = None
         self._pull_mu = threading.Lock()
         self._inflight_pulls: set = set()
+        # obs (pre-bound, cold path): merged-push throughput counters +
+        # the send-queue depth gauge — the sampler turns these into the
+        # backlog curve that shows a communicator falling behind its PS
+        tag = f"{type(self).__name__}{next(_COMM_SEQ)}"
+        self._c_merged = _obs_registry.REGISTRY.counter(
+            "communicator_merged_batches", max_series=256, comm=tag)
+        self._c_pushes = _obs_registry.REGISTRY.counter(
+            "communicator_pushes", max_series=256, comm=tag)
+        self._g_depth = _obs_registry.REGISTRY.gauge(
+            "communicator_queue_depth", max_series=256, comm=tag)
 
     # -- train-loop API ---------------------------------------------------
 
@@ -268,7 +282,9 @@ class _BaseCommunicator:
 
     def _drain_once(self) -> bool:
         did_work = False
+        depth = 0
         for table_id, q in list(self._queues.items()):
+            depth += q.qsize()
             merged_sparse: List[Tuple[np.ndarray, np.ndarray]] = []
             merged_dense: List[np.ndarray] = []
             for _ in range(self.config.max_merge_var_num):
@@ -285,12 +301,17 @@ class _BaseCommunicator:
                 vals = np.concatenate([v for _, v in merged_sparse])
                 self.client.push_sparse(table_id, keys, vals)
                 did_work = True
+                self._c_merged.inc(len(merged_sparse))
+                self._c_pushes.inc()
             if merged_dense:
                 acc = np.sum(merged_dense, axis=0)
                 if self.config.is_sgd_optimizer:
                     acc = acc / len(merged_dense)  # average on merge
                 self.client.push_dense(table_id, acc)
                 did_work = True
+                self._c_merged.inc(len(merged_dense))
+                self._c_pushes.inc()
+        self._g_depth.set(depth)
         if not did_work and self._all_empty():
             self._drained.set()
         return did_work
@@ -331,9 +352,13 @@ class SyncCommunicator(_BaseCommunicator):
 
     def send_sparse(self, table_id, keys, values):
         self.client.push_sparse(table_id, keys, values)
+        self._c_merged.inc()
+        self._c_pushes.inc()
 
     def send_dense(self, table_id, grad):
         self.client.push_dense(table_id, grad)
+        self._c_merged.inc()
+        self._c_pushes.inc()
 
     def barrier(self) -> None:
         self._drain_pulls()  # no pull may straddle the barrier

@@ -50,6 +50,8 @@ from typing import Any, Dict, Optional
 
 from ..core.enforce import PsTransportError
 from ..core.flags import flag
+from ..obs import flightrec as _flightrec
+from ..obs import registry as _obs_registry
 
 __all__ = ["FaultSpec", "faultpoint", "arm_faultpoint", "disarm_faultpoints",
            "armed_faultpoints", "FaultInjected"]
@@ -94,6 +96,9 @@ class FaultSpec:
 _mu = threading.Lock()
 _armed: Dict[str, FaultSpec] = {}
 _flag_loaded = False
+# per-site fired counters, bound at ARM time (the cold path — the
+# faultpoint() probe itself may sit on an RPC hot path)
+_fired_counters: Dict[str, object] = {}
 
 
 def _load_flag_specs() -> None:
@@ -127,6 +132,9 @@ def arm_faultpoint(name: str, action: str, cmd: Optional[int] = None,
                      every=every, count=count, ms=ms, param=param)
     with _mu:
         _armed[name] = spec
+        if name not in _fired_counters:
+            _fired_counters[name] = _obs_registry.REGISTRY.counter(
+                "ps_faultpoints_fired", max_series=1024, site=name)
     return spec
 
 
@@ -171,6 +179,13 @@ def faultpoint(name: str, cmd: Optional[int] = None,
             return None
         spec.fired += 1
         action = spec.action
+        counter = _fired_counters.get(name)
+    # outside _mu: the counter is lock-cheap but the flight-recorder
+    # notify may dump a postmortem bundle (a fired chaos faultpoint is
+    # exactly a moment worth keeping)
+    if counter is not None:
+        counter.inc()
+    _flightrec.notify("faultpoint", site=name, action=action)
     if action == "delay-ms":
         time.sleep(spec.ms / 1000.0)
         return None

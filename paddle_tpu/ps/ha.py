@@ -64,6 +64,8 @@ from ..core.enforce import (PreconditionNotMetError, PsTransportError,
                             enforce)
 from ..core.flags import define_flag, flag
 from ..distributed.elastic import Lease, MemoryStore
+from ..obs import flightrec as _flightrec
+from ..obs import registry as _obs_registry
 from . import rpc as _rpc
 from .faultpoints import (FaultInjected, arm_faultpoint, disarm_faultpoints,
                           faultpoint)
@@ -151,13 +153,20 @@ def observer_key(job_id: str, shard: int, endpoint: str) -> str:
 class CircuitBreaker:
     """Per-endpoint breaker: CLOSED → (N consecutive failures) → OPEN →
     (cooldown) → HALF_OPEN (exactly one probe) → CLOSED on success /
-    back to OPEN on failure. ``clock`` is injectable for tests."""
+    back to OPEN on failure. ``clock`` is injectable for tests.
+
+    ``name`` labels the endpoint in the obs plane: every transition to
+    OPEN increments the job-wide ``ps_breaker_open`` counter (the SLO
+    watchdog's breaker-open-count signal) and notifies the flight
+    recorder — a breaker opening is exactly the moment whose preceding
+    telemetry a postmortem bundle exists to keep."""
 
     CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
     def __init__(self, failures: Optional[int] = None,
                  cooldown_s: Optional[float] = None,
-                 clock: Callable[[], float] = time.monotonic) -> None:
+                 clock: Callable[[], float] = time.monotonic,
+                 name: str = "-") -> None:
         self.failures = (failures if failures is not None
                          else int(flag("ps_breaker_failures")))
         self.cooldown_s = (cooldown_s if cooldown_s is not None
@@ -168,6 +177,11 @@ class CircuitBreaker:
         self._consecutive = 0
         self._opened_at = 0.0
         self._probing = False
+        self.name = str(name)
+        self.opens = 0
+        # pre-bound (breaker creation is the cold path)
+        self._c_open = _obs_registry.REGISTRY.counter(
+            "ps_breaker_open", max_series=1024, endpoint=self.name)
 
     @property
     def state(self) -> str:
@@ -193,6 +207,7 @@ class CircuitBreaker:
             return True
 
     def record(self, ok: bool) -> None:
+        opened = False
         with self._mu:
             if ok:
                 self._state = self.CLOSED
@@ -203,8 +218,17 @@ class CircuitBreaker:
             self._probing = False
             if self._state == self.HALF_OPEN or \
                     self._consecutive >= self.failures:
+                opened = self._state != self.OPEN
                 self._state = self.OPEN
                 self._opened_at = self._clock()
+                if opened:
+                    self.opens += 1
+        if opened:
+            # outside _mu: the notify may dump a postmortem bundle (IO)
+            # and must never serialize behind the breaker's hot lock
+            self._c_open.inc()
+            _flightrec.notify("breaker_open", endpoint=self.name,
+                              consecutive_failures=self._consecutive)
 
 
 class RoutingTable:
@@ -275,7 +299,7 @@ class HARouter:
             b = self._breakers.get(endpoint)
             if b is None:
                 b = self._breakers[endpoint] = CircuitBreaker(
-                    self._failures, self._cooldown_s)
+                    self._failures, self._cooldown_s, name=endpoint)
             return b
 
     # -- RpcPsClient protocol ---------------------------------------------
@@ -344,6 +368,11 @@ class ReplicationManager:
         self._thread: Optional[threading.Thread] = None
         self._self_conn = None
         self._last_route_poll = 0.0
+        # per-backup lag gauges bind lazily at first export (backups
+        # attach at runtime); the pending gauge is shared per shard
+        self._lag_gauges: Dict[str, object] = {}
+        self._g_pending = _obs_registry.REGISTRY.gauge(
+            "ps_replication_pending_entries", shard=str(shard))
 
     # -- lifecycle --------------------------------------------------------
 
@@ -377,6 +406,28 @@ class ReplicationManager:
             acked = {ep: st["acked"] for ep, st in self._backups.items()}
         return {"seq": seq, "pending": self.server.oplog_pending(),
                 "dropped": self.server.oplog_dropped(), "acked": acked}
+
+    def export_metrics(self) -> None:
+        """Sampler probe (obs/timeseries.py): publish the per-backup
+        acked-cursor gap as ``ps_replication_lag_entries`` gauges — the
+        replication-lag curve the SLO watchdog's rule reads."""
+        lg = self.lag()
+        # bulk-bind new backups' gauges (comprehension = the sanctioned
+        # cold-bind idiom); the loop below only sets pre-bound handles
+        self._lag_gauges.update({
+            ep: _obs_registry.REGISTRY.gauge(
+                "ps_replication_lag_entries", max_series=1024,
+                shard=str(self.shard), backup=ep)
+            for ep in lg["acked"] if ep not in self._lag_gauges})
+        for ep, acked in lg["acked"].items():
+            self._lag_gauges[ep].set(max(0, lg["seq"] - acked))
+        # a DETACHED backup's gauge must not freeze at its last lag —
+        # the replication_lag alert would never clear and every later
+        # scrape would report a dead replica's lag as live
+        for ep, g in self._lag_gauges.items():
+            if ep not in lg["acked"]:
+                g.set(0)
+        self._g_pending.set(lg["pending"])
 
     def drain(self, timeout: float = 30.0) -> None:
         """Sync-replication barrier: block until every attached backup
@@ -841,6 +892,10 @@ class FailoverCoordinator:
         self._missing_since: Dict[str, float] = {}
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # obs: promotions are a job-wide counter (the watchdog's
+        # failover rule) AND a flight-recorder trigger
+        self._c_promotions = _obs_registry.REGISTRY.counter(
+            "ha_promotions", job=str(job_id))
 
     def _alive(self) -> set:
         pref = _hb_prefix(self.job_id)
@@ -910,6 +965,10 @@ class FailoverCoordinator:
             changed = True
             promoted += 1
             self.promotions += 1
+            self._c_promotions.inc()
+            _flightrec.notify("failover_promotion", shard=si,
+                              old_primary=prim, new_primary=new_prim,
+                              epoch=new_epoch)
             if self.on_promote is not None:
                 self.on_promote(si, prim, new_prim)
         if changed:
@@ -1021,6 +1080,16 @@ class HACluster:
                           if with_router else None, qos=qos)
         self._clients.append(cli)
         return cli
+
+    def obs_probe(self) -> None:
+        """Sampler probe (obs/timeseries.py ``add_probe``): export every
+        live primary's replication lag gauges — one call wires the
+        cluster's replication-lag curves into a job sampler."""
+        for row in self.servers:
+            for r in row:
+                rm = r.rm
+                if rm is not None and not r.server.stopped:
+                    rm.export_metrics()
 
     def kill_primary(self, shard: int) -> str:
         """Host-death the shard's current primary NOW; returns its

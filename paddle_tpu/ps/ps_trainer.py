@@ -30,6 +30,8 @@ from ..core.enforce import enforce
 from ..core.flags import flag
 from ..core.nan_inf import check_numerics
 from ..core.profiler import RecordEvent
+from ..obs import flightrec as _flightrec
+from ..obs import registry as _obs_registry
 from ..data.prefetcher import DevicePrefetcher
 from .embedding_cache import CacheConfig, HbmEmbeddingCache
 from .table import MemorySparseTable
@@ -561,6 +563,11 @@ class CtrStreamTrainer:
         #: train_from_dataset run — the stream position a job
         #: checkpoint records and a restarted job resumes from
         self.batches_done = 0
+        # obs: per-step wall time as a job-wide histogram — the curve
+        # the step-time SLO rule (obs/slo.py) burns against. Bound here
+        # (cold path); observed once per step (lock-cheap)
+        self._h_step = _obs_registry.REGISTRY.histogram(
+            "trainer_step_time_s", table=str(table_id))
 
         #: persistent HBM hot-embedding tier (ps/hot_tier.py): warm ids
         #: resolve/pull/push INSIDE the compiled step — a warm
@@ -627,6 +634,28 @@ class CtrStreamTrainer:
                            start_batch: "int | Dict[str, Any]" = 0,
                            checkpoint=None, checkpoint_every: int = 0
                            ) -> Dict[str, float]:
+        """See :meth:`_train_from_dataset` — this wrapper only adds the
+        flight-recorder hook: an exception that escapes the stream loop
+        (a failover that out-ran every replay, a poisoned batch, NaN
+        guard) notifies ``trainer_exception`` so the postmortem bundle
+        with the last steps' telemetry is dumped BEFORE the stack
+        unwinds past anyone who could still read it."""
+        try:
+            return self._train_from_dataset(
+                dataset, batch_size=batch_size, drop_last=drop_last,
+                start_batch=start_batch, checkpoint=checkpoint,
+                checkpoint_every=checkpoint_every)
+        except BaseException as e:
+            _flightrec.notify("trainer_exception",
+                              error=f"{type(e).__name__}: {e}",
+                              batches_done=self.batches_done)
+            raise
+
+    def _train_from_dataset(self, dataset, batch_size: int = 512,
+                            drop_last: bool = True,
+                            start_batch: "int | Dict[str, Any]" = 0,
+                            checkpoint=None, checkpoint_every: int = 0
+                            ) -> Dict[str, float]:
         """``start_batch`` re-enters the stream at a saved cursor —
         pass ``RestoredJob.cursor`` itself (the dict form validates
         that ``batch_size`` matches the one the cursor was recorded
@@ -694,6 +723,7 @@ class CtrStreamTrainer:
             # RecordEvent = trace ROOT while obs tracing is on: one
             # sampled stream step becomes one cross-process trace whose
             # pull/push child spans flow-link to the PS shards' spans
+            t_step = time.perf_counter()
             with RecordEvent("ctr_stream_step"):
                 keys, flat, dense, labels, fut = item
                 if fut is not None:
@@ -724,6 +754,7 @@ class CtrStreamTrainer:
                 stats.samples += int(labels.shape[0])
                 stats.loss_sum += float(loss)
                 self.batches_done += 1
+                self._h_step.observe(time.perf_counter() - t_step)
                 self._maybe_checkpoint(checkpoint, checkpoint_every,
                                        batch_size)
 
@@ -789,8 +820,10 @@ class CtrStreamTrainer:
         # graftlint: hot-path
         def _run(item):
             keys, flat, dense, labels = item
+            t_step = time.perf_counter()
             with RecordEvent("ctr_hot_step"):
                 _run_body(keys, flat, dense, labels)
+            self._h_step.observe(time.perf_counter() - t_step)
 
         # graftlint: hot-path
         def _run_body(keys, flat, dense, labels):

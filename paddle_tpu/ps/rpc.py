@@ -31,6 +31,7 @@ from ..core.enforce import (NotFoundError, PreconditionNotMetError,
                             PsTransportError, enforce)
 from ..core.flags import define_flag, flag
 from ..core.profiler import RecordEvent
+from ..obs import flightrec as _flightrec
 from ..obs import registry as _obs_registry
 from ..obs import trace as _trace
 from ..obs.registry import CounterGroup
@@ -784,8 +785,15 @@ class RpcPsClient(PSClient):
             ep = c.endpoint
         try:
             out = fn(c)
-        except PsTransportError:
+        except PsTransportError as e:
             r.record(ep, ok=False)
+            # tail note (no dump): the transport death + replay land in
+            # the flight recorder's event ring so a later bundle shows
+            # the failing requests leading up to whatever triggered it
+            rec = _flightrec.installed()
+            if rec is not None:
+                rec.note("transport_error", shard=s, endpoint=ep,
+                         error=f"{type(e).__name__}: {e}")
             new_ep = r.failover(s, ep)
             if new_ep is None or new_ep == ep:
                 raise

@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from ..core.enforce import enforce
+from ..obs import flightrec as _flightrec
 from ..obs.registry import CounterGroup
 from .metrics import LatencyRecorder
 
@@ -249,6 +250,12 @@ class ServingFrontend:
         except BaseException as e:  # noqa: BLE001 — delivered per-request
             with self._mu:
                 self.counters["errors"] += 1
+            # a lookup/infer failure on the serve path is a flight-
+            # recorder trigger: the bundle holds the spans and latency
+            # curves of the requests that led here
+            _flightrec.notify("serving_exception",
+                              error=f"{type(e).__name__}: {e}",
+                              batch=len(live))
             for r in live:
                 r.fail(e)
             return

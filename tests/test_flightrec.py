@@ -1,0 +1,309 @@
+"""Crash flight recorder (ISSUE 10): bundle structure + atomic publish,
+rate limiting + GC, the module hook surface, every wired trigger site
+(breaker open, faultpoint, trainer/serving exception, SIGTERM), and THE
+slow e2e: kill-shard mid-CtrStreamTrainer → watchdog failover/breaker
+alerts + a postmortem bundle whose merged trace carries the failing
+(replayed) request spans and whose metric timeline shows the recovery."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.obs import flightrec, registry, slo, timeseries, trace
+from paddle_tpu.obs.flightrec import FlightRecorder
+from paddle_tpu.ps import ha, rpc
+from paddle_tpu.ps.faultpoints import arm_faultpoint, disarm_faultpoints
+from paddle_tpu.ps.table import TableConfig
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+@pytest.fixture(autouse=True)
+def _clean_hooks():
+    yield
+    flightrec.uninstall()
+    disarm_faultpoints()
+    trace.stop_tracing()
+    trace.drain_spans()
+
+
+def _cfg(tid=0):
+    return TableConfig(table_id=tid, shard_num=4, accessor="ctr")
+
+
+# -- bundle mechanics -------------------------------------------------------
+
+def test_trigger_dumps_parseable_atomic_bundle(tmp_path):
+    ring = timeseries.MetricRing()
+    reg = registry.Registry()
+    reg.counter("c").inc(3)
+    ring.append(reg.snapshot(), t=1.0)
+    wd = slo.SloWatchdog(ring)
+    rec = FlightRecorder(str(tmp_path), ring=ring, watchdog=wd,
+                         min_interval_s=0.0)
+    rec.note("transport_error", shard=0, endpoint="127.0.0.1:1")
+    trace.start_tracing(sample=1.0)
+    with trace.span("incident_step"):
+        pass
+    path = rec.trigger("unit_test", detail="x")
+    assert path is not None and os.path.isdir(path)
+    # nothing unpublished left behind (atomic-publish contract)
+    assert not [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+    man = json.load(open(os.path.join(path, "manifest.json")))
+    assert man["reason"] == "unit_test" and man["info"]["detail"] == "x"
+    assert man["process"]["pid"] == os.getpid()
+    assert set(man["files"]) == {"trace.json", "timeline.json",
+                                 "alerts.json", "events.json"}
+    tr = json.load(open(os.path.join(path, "trace.json")))
+    names = {e.get("name") for e in tr["traceEvents"]}
+    assert "incident_step" in names                 # the span tail
+    assert "EVENT transport_error" in names         # noted events
+    # the span tail was PEEKED, not drained — a later export still owns it
+    assert any(s.name == "incident_step" for s in trace.peek_spans())
+    tl = json.load(open(os.path.join(path, "timeline.json")))
+    assert tl["records"][0]["t"] == 1.0
+    ev = json.load(open(os.path.join(path, "events.json")))["events"]
+    assert ev[0]["kind"] == "transport_error"
+
+
+def test_rate_limit_gc_and_restart_numbering(tmp_path):
+    rec = FlightRecorder(str(tmp_path), min_interval_s=3600.0, keep=2)
+    p1 = rec.trigger("first")
+    assert p1 is not None
+    assert rec.trigger("suppressed") is None        # inside the interval
+    assert rec.suppressed == 1
+    rec2 = FlightRecorder(str(tmp_path), min_interval_s=0.0, keep=2)
+    p2 = rec2.trigger("second")
+    p3 = rec2.trigger("third")
+    # a restarted recorder numbers past the survivors, never clobbers
+    assert [os.path.basename(p) for p in (p1, p2, p3)] == [
+        "postmortem_1", "postmortem_2", "postmortem_3"]
+    assert [os.path.basename(b) for b in rec2.bundles()] == [
+        "postmortem_2", "postmortem_3"]             # keep=2 GC'd the first
+
+
+def test_module_hooks_and_dump_on_policy(tmp_path):
+    # no recorder installed: notify is a no-op returning None
+    assert flightrec.notify("breaker_open", endpoint="x") is None
+    rec = flightrec.install(FlightRecorder(str(tmp_path), min_interval_s=0.0,
+                                           dump_on={"faultpoint"}))
+    assert flightrec.installed() is rec
+    assert flightrec.notify("slo_alert", rule="r") is None  # note-only kind
+    assert len(rec.events()) == 1
+    path = flightrec.notify("faultpoint", site="s", action="delay-ms")
+    assert path is not None and os.path.isdir(path)
+    flightrec.uninstall()
+    assert flightrec.notify("faultpoint") is None
+
+
+def test_trigger_never_raises(tmp_path, monkeypatch):
+    rec = FlightRecorder(str(tmp_path), min_interval_s=0.0)
+    monkeypatch.setattr(rec, "_dump",
+                        lambda *a, **k: (_ for _ in ()).throw(OSError("disk")))
+    assert rec.trigger("boom") is None
+    assert rec.dump_errors == 1 and "disk" in rec.last_error
+
+
+# -- wired trigger sites ----------------------------------------------------
+
+def test_breaker_open_counts_and_triggers(tmp_path):
+    rec = flightrec.install(FlightRecorder(str(tmp_path), min_interval_s=0.0))
+    before = {tuple(sorted(s["labels"].items())): s["value"]
+              for s in registry.snapshot()["metrics"]
+              .get("ps_breaker_open", {}).get("series", [])}
+    b = ha.CircuitBreaker(failures=2, cooldown_s=60.0, name="ep-test:1")
+    b.record(False)
+    assert not rec.bundles()                        # not open yet
+    b.record(False)                                 # transition → OPEN
+    assert b.state == ha.CircuitBreaker.OPEN and b.opens == 1
+    b.record(False)                                 # already open: no re-fire
+    assert b.opens == 1
+    kinds = [e["kind"] for e in rec.events()]
+    assert kinds.count("breaker_open") == 1
+    assert len(rec.bundles()) == 1                  # default dump_on kind
+    after = {tuple(sorted(s["labels"].items())): s["value"]
+             for s in registry.snapshot()["metrics"]
+             ["ps_breaker_open"]["series"]}
+    key = (("endpoint", "ep-test:1"),)
+    assert after[key] == before.get(key, 0) + 1
+
+
+def test_faultpoint_fire_counts_and_notifies(tmp_path):
+    from paddle_tpu.ps.faultpoints import faultpoint
+
+    rec = flightrec.install(FlightRecorder(str(tmp_path), min_interval_s=0.0))
+    arm_faultpoint("fr.site", "delay-ms", ms=0, after=2)
+    faultpoint("fr.site")                           # hit 1: below after
+    assert not rec.events()
+    faultpoint("fr.site")                           # hit 2: fires
+    ev = rec.events()
+    assert ev and ev[0]["kind"] == "faultpoint" and \
+        ev[0]["site"] == "fr.site" and ev[0]["action"] == "delay-ms"
+    assert rec.bundles()                            # default dump_on kind
+    series = {tuple(sorted(s["labels"].items())): s["value"]
+              for s in registry.snapshot()["metrics"]
+              ["ps_faultpoints_fired"]["series"]}
+    assert series[(("site", "fr.site"),)] >= 1
+
+
+def test_trainer_exception_notifies_and_reraises(tmp_path, monkeypatch):
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM
+    from paddle_tpu.ps.ps_trainer import CtrStreamTrainer
+    from paddle_tpu.ps.table import MemorySparseTable
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from test_ps_ha import _make_stream_data
+
+    rec = flightrec.install(FlightRecorder(str(tmp_path), min_interval_s=0.0))
+    S, D = 3, 2
+    tr = CtrStreamTrainer(
+        DeepFM(CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=8,
+                         dnn_hidden=(8,))),
+        optimizer.Adam(1e-2), MemorySparseTable(_cfg()),
+        sparse_slots=[f"s{i}" for i in range(S)],
+        dense_slots=[f"d{i}" for i in range(D)], label_slot="label")
+
+    def boom(*a, **k):
+        raise RuntimeError("poisoned batch")
+
+    monkeypatch.setattr(tr, "_step", boom)
+    with pytest.raises(RuntimeError, match="poisoned batch"):
+        tr.train_from_dataset(_make_stream_data(n=128, S=S, D=D),
+                              batch_size=64)
+    ev = [e for e in rec.events() if e["kind"] == "trainer_exception"]
+    assert ev and "poisoned batch" in ev[0]["error"]
+    assert rec.bundles()
+
+
+def test_serving_exception_notifies(tmp_path):
+    from paddle_tpu.serving.frontend import FrontendConfig, ServingFrontend
+
+    class BadLookup:
+        def lookup(self, keys):
+            raise RuntimeError("replica gone")
+
+    rec = flightrec.install(FlightRecorder(str(tmp_path), min_interval_s=0.0))
+    with ServingFrontend(BadLookup(),
+                         config=FrontendConfig(max_delay_us=0)) as fe:
+        with pytest.raises(RuntimeError, match="replica gone"):
+            fe(np.arange(4, dtype=np.uint64), deadline_ms=2000)
+    ev = [e for e in rec.events() if e["kind"] == "serving_exception"]
+    assert ev and "replica gone" in ev[0]["error"]
+    assert rec.bundles()
+
+
+_SIGTERM_SCRIPT = """
+import os, signal, sys, time
+sys.path.insert(0, {repo!r})
+from paddle_tpu.obs import flightrec
+rec = flightrec.install(flightrec.FlightRecorder(sys.argv[1],
+                                                 min_interval_s=0.0))
+assert flightrec.install_signal_handler()
+print("READY", flush=True)
+os.kill(os.getpid(), signal.SIGTERM)
+time.sleep(10)   # never reached: the chained default disposition kills us
+"""
+
+
+def test_sigterm_dumps_bundle_then_terminates(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-c", _SIGTERM_SCRIPT.format(repo=REPO),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert "READY" in proc.stdout
+    assert proc.returncode != 0                     # terminated by SIGTERM
+    bundle = os.path.join(tmp_path, "postmortem_1")
+    assert os.path.isdir(bundle)
+    man = json.load(open(os.path.join(bundle, "manifest.json")))
+    assert man["reason"] == "sigterm"
+    assert man["info"]["signal"] == 15
+
+
+# -- THE e2e acceptance (slow): kill-shard under the full always-on layer --
+
+@pytest.mark.slow
+def test_e2e_kill_shard_alerts_and_postmortem_bundle(tmp_path):
+    """ISSUE 10 acceptance: kill-shard faultpoint mid-CtrStreamTrainer
+    → the watchdog raises breaker/failover alerts, the flight recorder
+    publishes an atomic bundle whose merged trace contains the failing
+    (replayed) request spans and whose metric timeline shows the
+    recovery."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from test_ps_ha import _run_stream_trainer
+
+    with ha.HACluster(num_shards=2, replication=2, sync=True) as cluster:
+        cli = cluster.client()
+        ring = timeseries.MetricRing(capacity=4096)
+        sampler = timeseries.JobCollector(client=cli, period_s=0.05,
+                                          ring=ring)
+        sampler.add_probe(cluster.obs_probe)
+        wd = slo.SloWatchdog(ring, [
+            slo.SloRule("breaker_open", "ps_breaker_open",
+                        kind="threshold", field="delta", agg="rate",
+                        threshold=0.0, windows=((30.0, 1.0),)),
+            slo.SloRule("failover_promotion", "ha_promotions",
+                        kind="threshold", field="delta", agg="rate",
+                        threshold=0.0, windows=((30.0, 1.0),)),
+        ])
+        wd.attach(sampler)
+        rec = flightrec.install(FlightRecorder(
+            str(tmp_path), ring=ring, watchdog=wd, client=cli,
+            min_interval_s=0.0))
+        trace.start_tracing(sample=1.0, ring=1 << 17)
+        sampler.start()
+        try:
+            out, _ = _run_stream_trainer(cli, cluster=cluster,
+                                         kill_after_pushes=2)
+        finally:
+            sampler.stop()
+            trace.stop_tracing()
+        assert cluster.coordinator.promotions >= 1
+        assert out["steps"] == 3.0
+        t_promo = next(e["t"] for e in rec.events()
+                       if e["kind"] == "failover_promotion")
+        sampler.tick()                  # final deterministic tick
+        wd.evaluate()
+        # -- alerts: the failover fired; breaker may or may not have
+        # OPENED (3 consecutive failures vs promotion latency), but the
+        # promotion alert is deterministic
+        fired = {a["rule"] for a in wd.alerts()}
+        assert "failover_promotion" in fired, (fired, wd.alerts())
+
+        # -- a bundle was AUTO-dumped by a failure trigger mid-run
+        auto = [json.load(open(os.path.join(b, "manifest.json")))
+                for b in rec.bundles()]
+        assert any(m["reason"] in ("failover_promotion", "breaker_open",
+                                   "faultpoint") for m in auto), auto
+
+        # -- the postmortem view at quiesce: merged trace has the
+        # failing (replayed) request spans; the timeline shows recovery
+        final = rec.trigger("e2e_postmortem")
+        assert final is not None
+        tr = json.load(open(os.path.join(final, "trace.json")))
+        retried = [e for e in tr["traceEvents"]
+                   if e.get("ph") == "X" and e.get("args", {}).get("retried")]
+        assert retried, "no replayed request span in the merged trace"
+        instants = {e["name"] for e in tr["traceEvents"]
+                    if e.get("ph") == "i"}
+        assert "ALERT failover_promotion" in instants
+        assert "EVENT failover_promotion" in instants
+        tl = json.load(open(os.path.join(final, "timeline.json")))["records"]
+        steps_after = sum(
+            s.get("delta", 0)
+            for r in tl if r["t"] > t_promo
+            for s in r["metrics"].get("trainer_step_time_s", {}).get(
+                "series", [])
+            if "count" in s
+            for s in [{"delta": s["count"]}])
+        assert steps_after > 0, "metric timeline shows no post-promotion steps"
+        # replication-lag probe fed the job history (the acked-cursor gap)
+        lag_curve = [r for r in tl
+                     if "ps_replication_lag_entries" in r["metrics"]]
+        assert lag_curve, "obs_probe never exported replication lag"

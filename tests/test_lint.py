@@ -1000,3 +1000,85 @@ def test_metric_nested_def_in_loop_not_flagged(tmp_path):
             return out
     """)
     assert diags == []
+
+
+# -- anonymous-thread (ISSUE 10 satellite) ----------------------------------
+
+def test_anonymous_thread_flagged(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        import threading
+
+        def start(fn):
+            t = threading.Thread(target=fn, daemon=True)
+            t.start()
+    """)
+    assert _rules(diags) == {"anonymous-thread"}
+
+
+def test_anonymous_thread_from_import_and_alias_flagged(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        from threading import Thread as T
+
+        def start(fn):
+            T(target=fn).start()
+    """)
+    assert _rules(diags) == {"anonymous-thread"}
+    diags = _conv_diags(tmp_path, """
+        import threading as th
+
+        def start(fn):
+            th.Thread(target=fn).start()
+    """)
+    assert _rules(diags) == {"anonymous-thread"}
+
+
+def test_named_thread_ok(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        import threading
+
+        def start(fn, shard):
+            t = threading.Thread(target=fn, daemon=True,
+                                 name=f"ps-repl:{shard}")
+            t.start()
+    """)
+    assert "anonymous-thread" not in _rules(diags)
+
+
+def test_non_thread_call_named_thread_elsewhere_ok(tmp_path):
+    # only the threading module's Thread counts — an unrelated Thread
+    # symbol (a local class, another library) is not this rule's business
+    diags = _conv_diags(tmp_path, """
+        class Thread:
+            def __init__(self, target=None):
+                self.target = target
+
+        def start(fn):
+            Thread(target=fn)
+    """)
+    assert "anonymous-thread" not in _rules(diags)
+
+
+def test_anonymous_thread_ignore_comment(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        import threading
+
+        def start(fn):
+            threading.Thread(target=fn).start()  # graftlint: ignore[anonymous-thread]
+    """)
+    assert "anonymous-thread" not in _rules(diags)
+
+
+def test_anonymous_thread_checked_in_tools_scope(tmp_path):
+    # tools/ demo drivers run threads that land in the same merged
+    # traces — the rule applies there too (unlike most conventions)
+    (tmp_path / "paddle_tpu").mkdir(exist_ok=True)
+    (tmp_path / "paddle_tpu" / "__init__.py").write_text("")
+    (tmp_path / "tools").mkdir(exist_ok=True)
+    (tmp_path / "tools" / "demo.py").write_text(textwrap.dedent("""
+        import threading
+
+        t = threading.Thread(target=print)
+    """))
+    diags = conventions.run(str(tmp_path))
+    assert ("tools/demo.py", "anonymous-thread") in {
+        (d.path, d.rule) for d in diags}

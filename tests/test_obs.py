@@ -269,15 +269,21 @@ def test_server_wire_accounting_rows_and_directions(cluster2):
     servers, client = cluster2
     for s in range(2):
         aggregate.fetch_server_obs(client, s, drain=True)  # note: spans only
-    base = aggregate.job_snapshot(client)
-    base_rows = {f"{r['labels']['dir']}": r["value"]
-                 for r in base["metrics"]["ps_server_wire_rows"]["series"]}
+    def rows_by_dir(snap):
+        # per-shard series (the shard label keeps shards' cumulative
+        # counters from aliasing in the time-series ring) sum per dir
+        out: dict = {}
+        for r in snap["metrics"]["ps_server_wire_rows"]["series"]:
+            d = r["labels"]["dir"]
+            out[d] = out.get(d, 0) + r["value"]
+        return out
+
+    base_rows = rows_by_dir(aggregate.job_snapshot(client))
     keys = np.arange(1, 201, dtype=np.uint64)
     client.pull_sparse(0, keys)
     client.push_sparse(0, keys, np.ones((200, 12), np.float32))
     job = aggregate.job_snapshot(client)
-    rows = {f"{r['labels']['dir']}": r["value"]
-            for r in job["metrics"]["ps_server_wire_rows"]["series"]}
+    rows = rows_by_dir(job)
     assert rows["out"] - base_rows.get("out", 0) == 200   # pulled
     assert rows["in"] - base_rows.get("in", 0) == 200     # pushed
     # client-side view exists too, with density gauges in (0, 1]

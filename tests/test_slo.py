@@ -1,0 +1,496 @@
+"""Continuous telemetry plane (ISSUE 10): delta-compressed time-series
+ring, sampler/collector, OpenMetrics exporter (rendering + strict
+parse, label escaping), SLO watchdog multi-window burn-rate semantics,
+and the obs/aggregate histogram bounds_conflict path."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu.obs import aggregate, exporter, registry, slo, timeseries
+from paddle_tpu.obs import flightrec
+from paddle_tpu.obs.registry import Registry
+from paddle_tpu.obs.timeseries import MetricRing, Sampler, quantile_from_hist
+
+
+@pytest.fixture(autouse=True)
+def _no_recorder_leak():
+    yield
+    flightrec.uninstall()
+
+
+# -- quantile helper --------------------------------------------------------
+
+def test_quantile_from_hist_interpolates():
+    bounds = (0.1, 1.0, 10.0)
+    #          ≤0.1  ≤1  ≤10  +inf
+    buckets = [0,    10, 0,   0]
+    # all mass inside (0.1, 1.0]: linear interpolation inside the bucket
+    assert quantile_from_hist(bounds, buckets, 0.5) == pytest.approx(0.55)
+    assert quantile_from_hist(bounds, buckets, 1.0) == pytest.approx(1.0)
+    # +inf bucket clamps to the largest finite bound
+    assert quantile_from_hist(bounds, [0, 0, 0, 5], 0.99) == 10.0
+    assert quantile_from_hist(bounds, [0, 0, 0, 0], 0.5) == 0.0
+
+
+# -- MetricRing -------------------------------------------------------------
+
+def _snap_with(reg):
+    return reg.snapshot()
+
+
+def test_ring_counter_rates_gauge_last_hist_deltas():
+    reg = Registry()
+    c = reg.counter("reqs", table="0")
+    g = reg.gauge("dens")
+    h = reg.histogram("lat", buckets=(0.1, 1.0))
+    ring = MetricRing()
+    c.inc(10)
+    g.set(0.5)
+    h.observe(0.05)
+    ring.append(_snap_with(reg), t=100.0)
+    c.inc(6)
+    g.set(0.25)
+    h.observe(5.0)
+    ring.append(_snap_with(reg), t=102.0)
+    # counter → rate (delta / dt); first tick has no rate basis
+    assert ring.series("reqs", "rate") == [(100.0, 0.0), (102.0, 3.0)]
+    assert ring.series("reqs", "delta") == [(100.0, 10.0), (102.0, 6.0)]
+    # gauge → last value
+    assert ring.series("dens", "value") == [(100.0, 0.5), (102.0, 0.25)]
+    # histogram → per-tick bucket deltas
+    recs = ring.records()
+    assert recs[1]["metrics"]["lat"]["series"][0]["buckets"] == [0, 0, 1]
+    assert recs[1]["metrics"]["lat"]["series"][0]["count"] == 1
+
+
+def test_ring_counter_restart_rebases_not_negative():
+    ring = MetricRing()
+    mk = lambda v: {"metrics": {"c": {"type": "counter", "series": [
+        {"labels": {}, "value": v}]}}}
+    ring.append(mk(100), t=10.0)
+    ring.append(mk(3), t=11.0)   # process restarted: 3 < 100
+    deltas = [v for _, v in ring.series("c", "delta")]
+    assert deltas == [100.0, 3.0]  # re-based, no negative spike
+
+
+def test_ring_bounded_capacity_and_window_queries():
+    ring = MetricRing(capacity=4)
+    reg = Registry()
+    h = reg.histogram("lat", buckets=(0.1, 1.0))
+    for i in range(8):
+        h.observe(0.05 if i < 6 else 5.0)
+        ring.append(_snap_with(reg), t=float(i))
+    assert len(ring) == 4  # oldest ticks dropped
+    # window over the surviving ticks: 2 bad of 4
+    bad, count = ring.bad_fraction("lat", 1.0, window_s=10.0, now=7.0)
+    assert count == 4 and bad == pytest.approx(0.5)
+    # windowed quantile input sums bucket deltas
+    bounds, acc, _ = ring.window_hist("lat", 10.0, now=7.0)
+    assert sum(acc) == 4 and acc[-1] == 2
+
+
+def test_ring_label_subset_match_and_reduce():
+    ring = MetricRing()
+    snap = {"metrics": {"wire": {"type": "counter", "series": [
+        {"labels": {"table": "0", "dir": "in"}, "value": 10},
+        {"labels": {"table": "1", "dir": "in"}, "value": 30},
+        {"labels": {"table": "0", "dir": "out"}, "value": 5}]}}}
+    ring.append(snap, t=1.0)
+    assert ring.series("wire", "delta", labels={"dir": "in"}) == [(1.0, 40.0)]
+    assert ring.series("wire", "delta", labels={"table": "0", "dir": "out"}
+                       ) == [(1.0, 5.0)]
+
+
+def test_ring_histogram_bounds_conflict_marked_not_corrupted():
+    ring = MetricRing()
+    mk = lambda bounds: {"metrics": {"lat": {"type": "histogram", "series": [
+        {"labels": {}, "count": 3, "sum": 1.0, "bounds": list(bounds),
+         "buckets": [1] * (len(bounds) + 1)}]}}}
+    ring.append(mk((0.1, 1.0)), t=1.0)
+    ring.append(mk((0.5, 2.0)), t=2.0)   # different ladder, same family
+    recs = ring.records()
+    assert recs[1]["metrics"]["lat"]["series"][0] == {
+        "labels": {}, "bounds_conflict": True}
+    # the family ladder stays the FIRST one
+    assert ring.bounds("lat") == (0.1, 1.0)
+
+
+# -- Sampler ----------------------------------------------------------------
+
+def test_sampler_tick_probes_listeners_and_errors():
+    reg = Registry()
+    c = reg.counter("x")
+    probed, seen = [], []
+    s = Sampler(period_s=99.0, snapshot_fn=reg.snapshot, name="t-sampler")
+    s.add_probe(lambda: probed.append(1))
+    s.on_sample(lambda t: seen.append(t))
+    c.inc(2)
+    rec = s.tick(t=50.0)
+    assert rec["t"] == 50.0 and probed == [1] and seen == [50.0]
+    assert s.ticks == 1 and s.errors == 0
+
+    # a failing snapshot costs one tick, not the sampler
+    def boom():
+        raise RuntimeError("shard died")
+
+    bad = Sampler(period_s=99.0, snapshot_fn=boom)
+    assert bad.tick() is None
+    assert bad.errors == 1 and "shard died" in bad.last_error
+    # a failing listener is counted but the tick still landed
+    s2 = Sampler(period_s=99.0, snapshot_fn=reg.snapshot)
+    s2.on_sample(lambda t: (_ for _ in ()).throw(RuntimeError("l")))
+    assert s2.tick(t=1.0) is not None
+    assert s2.errors == 1 and s2.ticks == 1
+
+
+def test_sampler_thread_named_and_stops():
+    reg = Registry()
+    s = Sampler(period_s=0.01, snapshot_fn=reg.snapshot, name="obs-sampler")
+    s.start()
+    try:
+        names = [t.name for t in threading.enumerate()]
+        assert "obs-sampler" in names  # anonymous-thread rule's point
+        deadline = 100
+        while s.ticks == 0 and deadline:
+            deadline -= 1
+            threading.Event().wait(0.01)
+        assert s.ticks > 0
+    finally:
+        s.stop()
+    assert all(t.name != "obs-sampler" for t in threading.enumerate())
+
+
+# -- SLO watchdog -----------------------------------------------------------
+
+def _burn_ring(good_then_bad, t0=0.0, dt=1.0):
+    """Ring with one 2-bucket histogram: 'g' ticks observe 0.05 (good),
+    'b' ticks 5.0 (bad vs threshold 1.0)."""
+    reg = Registry()
+    h = reg.histogram("lat", buckets=(0.1, 1.0))
+    ring = MetricRing()
+    t = t0
+    for ch in good_then_bad:
+        h.observe(0.05 if ch == "g" else 5.0)
+        ring.append(reg.snapshot(), t=t)
+        t += dt
+    return ring, t - dt
+
+
+def test_watchdog_multiwindow_fires_and_clears():
+    ring, now = _burn_ring("gggggggggg")
+    rule = slo.SloRule("lat_p", "lat", threshold=1.0, budget=0.25,
+                       windows=((8.0, 1.0), (3.0, 1.0)))
+    wd = slo.SloWatchdog(ring, [rule])
+    assert wd.evaluate(now=now) == []          # healthy: nothing fires
+    ring2, now2 = _burn_ring("gggggbbbbb")
+    wd2 = slo.SloWatchdog(ring2, [rule])
+    fired = wd2.evaluate(now=now2)
+    assert [a.rule for a in fired] == ["lat_p"]
+    assert wd2.active() == ["lat_p"]
+    # active rule does not re-fire while burning
+    assert wd2.evaluate(now=now2) == []
+    assert len(wd2.alerts()) == 1
+    # recovery: short window clears first; once ALL windows are below
+    # budget*factor the alert clears and the rule re-arms
+    reg_alert = wd2.alerts()[0]
+    assert reg_alert["cleared_t"] is None
+    ring3, now3 = _burn_ring("gbbgggggggggggggg")
+    wd3 = slo.SloWatchdog(ring3, [rule])
+    assert wd3.evaluate(now=now3) == [] and wd3.active() == []
+
+
+def test_watchdog_short_window_gates_stale_burn():
+    # bad ticks exist in the LONG window but the last 3 ticks are clean:
+    # the short window refuses → no fire (the fast-clear half of the
+    # multi-window pair)
+    ring, now = _burn_ring("bbbbbggg")
+    rule = slo.SloRule("lat_p", "lat", threshold=1.0, budget=0.25,
+                       windows=((8.0, 1.0), (2.5, 1.0)))
+    wd = slo.SloWatchdog(ring, [rule])
+    assert wd.evaluate(now=now) == []
+
+
+def test_watchdog_threshold_rules_value_rate_age():
+    ring = MetricRing()
+    snap = lambda v: {"metrics": {"lag": {"type": "gauge", "series": [
+        {"labels": {}, "value": v}]}}}
+    for i, v in enumerate([10, 20, 5000]):
+        ring.append(snap(v), t=float(i))
+    wd = slo.SloWatchdog(ring, [slo.SloRule(
+        "lag", "lag", kind="threshold", agg="max", threshold=1000,
+        windows=((10.0, 1.0),))])
+    assert [a.rule for a in wd.evaluate(now=2.0)] == ["lag"]
+
+    # rate: counter deltas > 0 in the window (the breaker-open shape)
+    ring2 = MetricRing()
+    csnap = lambda v: {"metrics": {"opens": {"type": "counter", "series": [
+        {"labels": {}, "value": v}]}}}
+    ring2.append(csnap(0), t=0.0)
+    ring2.append(csnap(0), t=1.0)
+    wd2 = slo.SloWatchdog(ring2, [slo.SloRule(
+        "opens", "opens", kind="threshold", field="delta", agg="rate",
+        threshold=0.0, windows=((10.0, 1.0),))])
+    assert wd2.evaluate(now=1.0) == []
+    ring2.append(csnap(2), t=2.0)
+    assert [a.rule for a in wd2.evaluate(now=2.0)] == ["opens"]
+
+    # age: now - wall-timestamp gauge (checkpoint staleness shape)
+    ring3 = MetricRing()
+    gsnap = lambda v: {"metrics": {"ckpt": {"type": "gauge", "series": [
+        {"labels": {}, "value": v}]}}}
+    ring3.append(gsnap(1000.0), t=1001.0)
+    wd3 = slo.SloWatchdog(ring3, [slo.SloRule(
+        "stale", "ckpt", kind="threshold", agg="age", threshold=600,
+        windows=((10.0, 1.0),))])
+    assert wd3.evaluate(now=1001.0) == []           # age 1 s
+    ring3.append(gsnap(1000.0), t=1700.0)
+    assert [a.rule for a in wd3.evaluate(now=1700.0)] == ["stale"]
+
+
+def test_watchdog_alerts_are_metrics_and_log_bounded():
+    reg_before = registry.snapshot()["metrics"].get("slo_alerts")
+    ring, now = _burn_ring("ggbbbb")
+    rule = slo.SloRule("m_rule", "lat", threshold=1.0, budget=0.25,
+                       windows=((6.0, 1.0),))
+    wd = slo.SloWatchdog(ring, [rule], log_cap=2)
+    wd.evaluate(now=now)
+    snap = registry.snapshot()["metrics"]
+    series = {tuple(sorted(s["labels"].items())): s["value"]
+              for s in snap["slo_alerts"]["series"]}
+    assert series[(("rule", "m_rule"),)] >= 1
+    active = {tuple(sorted(s["labels"].items())): s["value"]
+              for s in snap["slo_alert_active"]["series"]}
+    assert active[(("rule", "m_rule"),)] == 1.0
+    # bounded log
+    for i in range(5):
+        wd._log.append(slo.Alert(f"r{i}", "lat", 0.0, 1.0, "burn_rate", {}))
+    assert len(wd.alerts()) == 2
+    with pytest.raises(ValueError):
+        wd.add_rule(rule)  # duplicate name
+
+
+def test_watchdog_alert_notifies_flightrec(tmp_path):
+    rec = flightrec.install(flightrec.FlightRecorder(
+        str(tmp_path), dump_on=set(), min_interval_s=0.0))
+    ring, now = _burn_ring("bbbb")
+    wd = slo.SloWatchdog(ring, [slo.SloRule(
+        "fr_rule", "lat", threshold=1.0, budget=0.25,
+        windows=((6.0, 1.0),))])
+    wd.evaluate(now=now)
+    kinds = [e["kind"] for e in rec.events()]
+    assert "slo_alert" in kinds
+
+
+def test_default_rules_cover_the_issue_slos():
+    rules = {r.name for r in slo.default_rules()}
+    assert {"step_time_p95", "serving_p99", "freshness_p95",
+            "breaker_open", "failover_promotion", "replication_lag",
+            "checkpoint_staleness"} <= rules
+
+
+# -- obs/aggregate bounds_conflict (direct coverage satellite) --------------
+
+def _hist_snap(bounds, buckets, count, total):
+    return {"process": {"role": "p"},
+            "metrics": {"lat": {"type": "histogram", "dropped_series": 0,
+                                "series": [{"labels": {"k": "v"},
+                                            "count": count, "sum": total,
+                                            "bounds": list(bounds),
+                                            "buckets": list(buckets)}]}}}
+
+
+def test_aggregate_bounds_conflict_keeps_first_ladder_intact():
+    a = _hist_snap((0.1, 1.0), [1, 2, 3], 6, 9.0)
+    b = _hist_snap((0.5, 2.0), [4, 4, 4], 12, 20.0)
+    merged = aggregate.merge_snapshots([a, b])
+    s = merged["metrics"]["lat"]["series"][0]
+    # first ladder's data intact, conflict marked, count == sum(buckets)
+    assert s["bounds"] == [0.1, 1.0]
+    assert s["buckets"] == [1, 2, 3]
+    assert s["count"] == 6 and s["sum"] == 9.0
+    assert s["bounds_conflict"] is True
+    assert sum(s["buckets"]) == s["count"]
+    # same-ladder merge still sums (the conflict is per label-set)
+    c = _hist_snap((0.1, 1.0), [1, 0, 0], 1, 0.05)
+    ok = aggregate.merge_snapshots([a, c])["metrics"]["lat"]["series"][0]
+    assert ok["buckets"] == [2, 2, 3] and ok["count"] == 7
+    assert "bounds_conflict" not in ok
+
+
+def test_openmetrics_skips_conflicted_series():
+    merged = aggregate.merge_snapshots([
+        _hist_snap((0.1, 1.0), [1, 2, 3], 6, 9.0),
+        _hist_snap((0.5, 2.0), [4, 4, 4], 12, 20.0)])
+    text = exporter.to_openmetrics(merged)
+    # a known-corrupt percentile must not reach a scraper as data
+    assert "lat_bucket" not in text
+    exporter.parse_openmetrics(text)  # still well-formed
+
+
+# -- OpenMetrics rendering + strict parse (escaping satellite) --------------
+
+def test_openmetrics_label_escaping_round_trip():
+    reg = Registry()
+    nasty = 'back\\slash "quoted" new\nline'
+    reg.counter("evil", path=nasty).inc(3)
+    text = exporter.to_openmetrics(reg.snapshot())
+    # escaped on the wire: no raw newline inside the sample line
+    sample = [ln for ln in text.splitlines() if ln.startswith("evil_total")]
+    assert len(sample) == 1
+    assert '\\\\' in sample[0] and '\\"' in sample[0] and '\\n' in sample[0]
+    fams = exporter.parse_openmetrics(text)
+    (_, labels, value), = fams["evil"]["samples"]
+    assert labels["path"] == nasty and value == 3.0
+
+
+def test_openmetrics_histogram_cumulative_and_counter_total():
+    reg = Registry()
+    h = reg.histogram("lat_s", buckets=(0.1, 1.0), table="0")
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    reg.counter("reqs_total").inc(2)   # *_total family keeps ONE suffix
+    text = exporter.to_openmetrics(reg.snapshot())
+    fams = exporter.parse_openmetrics(text)
+    buckets = [(lbl["le"], v) for n, lbl, v in fams["lat_s"]["samples"]
+               if n == "lat_s_bucket"]
+    assert buckets == [("0.1", 1.0), ("1", 2.0), ("+Inf", 3.0)]
+    assert ("reqs_total", {}, 2.0) in fams["reqs"]["samples"]
+    assert "reqs_total_total" not in text
+    assert text.endswith("# EOF\n")
+
+
+def test_openmetrics_parser_rejects_malformations():
+    with pytest.raises(ValueError, match="EOF"):
+        exporter.parse_openmetrics('# TYPE x counter\nx_total 1\n')
+    with pytest.raises(ValueError, match="TYPE"):
+        exporter.parse_openmetrics('x_total 1\n# EOF\n')
+    with pytest.raises(ValueError, match="belong"):
+        exporter.parse_openmetrics(
+            '# TYPE x counter\ny_total 1\n# EOF\n')
+    with pytest.raises(ValueError, match="escape"):
+        exporter.parse_openmetrics(
+            '# TYPE x counter\nx_total{a="\\q"} 1\n# EOF\n')
+    with pytest.raises(ValueError, match="cumulative"):
+        exporter.parse_openmetrics(
+            '# TYPE h histogram\nh_bucket{le="1"} 5\n'
+            'h_bucket{le="+Inf"} 3\n# EOF\n')
+    with pytest.raises(ValueError, match="count"):
+        exporter.parse_openmetrics(
+            '# TYPE h histogram\nh_bucket{le="+Inf"} 3\nh_count 4\n# EOF\n')
+    with pytest.raises(ValueError, match="value"):
+        exporter.parse_openmetrics('# TYPE x gauge\nx nope\n# EOF\n')
+
+
+# -- HTTP exporter ----------------------------------------------------------
+
+def test_exporter_endpoints_and_read_only():
+    reg = Registry()
+    reg.counter("scraped").inc(7)
+    ring = MetricRing()
+    ring.append(reg.snapshot(), t=1.0)
+    alerts = [{"rule": "r", "t": 1.0}]
+    with exporter.ObsExporter(reg.snapshot, ring=ring,
+                              alerts_fn=lambda: alerts) as exp:
+        with urllib.request.urlopen(f"{exp.url}/metrics", timeout=10) as r:
+            body = r.read().decode()
+            assert r.headers["Content-Type"] == exporter.CONTENT_TYPE
+        fams = exporter.parse_openmetrics(body)
+        assert ("scraped_total", {}, 7.0) in fams["scraped"]["samples"]
+        with urllib.request.urlopen(f"{exp.url}/history.json",
+                                    timeout=10) as r:
+            hist = json.load(r)
+        assert hist["records"][0]["t"] == 1.0
+        with urllib.request.urlopen(f"{exp.url}/alerts.json",
+                                    timeout=10) as r:
+            assert json.load(r)["alerts"] == alerts
+        with urllib.request.urlopen(f"{exp.url}/healthz", timeout=10) as r:
+            assert json.load(r)["ok"] is True
+        # read-only: POST is 405, unknown path 404
+        req = urllib.request.Request(f"{exp.url}/metrics", data=b"x",
+                                     method="POST")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 405
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{exp.url}/nope", timeout=10)
+        assert ei.value.code == 404
+    # stopped: the port no longer answers
+    with pytest.raises(Exception):
+        urllib.request.urlopen(f"{exp.url}/healthz", timeout=0.5)
+
+
+# -- job collector over a real shard pair (RPC fan-out leg) -----------------
+
+def test_job_collector_merges_shards_and_tolerates_death():
+    from paddle_tpu.ps import rpc
+    from paddle_tpu.ps.table import TableConfig
+
+    servers = [rpc.NativePsServer(n_trainers=1) for _ in range(2)]
+    client = rpc.RpcPsClient([f"127.0.0.1:{s.port}" for s in servers])
+    try:
+        client.create_sparse_table(
+            0, TableConfig(table_id=0, shard_num=4, accessor="ctr"))
+        import numpy as np
+
+        keys = np.arange(64, dtype=np.uint64)
+        client.pull_sparse(0, keys, create=True)
+        coll = timeseries.JobCollector(client=client, period_s=99.0)
+        rec = coll.tick(t=1.0)
+        assert rec is not None and coll.shard_errors == 0
+        merged = coll.latest()
+        roles = {p.get("role") for p in merged["processes"]}
+        assert {"ps_shard_0", "ps_shard_1"} <= roles
+        assert len(merged["processes"]) >= 3  # + this process
+        wire = merged["metrics"]["ps_server_wire_bytes"]["series"]
+        assert any(s["value"] > 0 for s in wire)
+        # kill one shard: the next tick still lands, error counted
+        servers[0].stop()
+        rec2 = coll.tick(t=2.0)
+        assert rec2 is not None
+        assert coll.shard_errors >= 1
+        assert coll.ticks == 2 and coll.errors == 0
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
+            s.close()
+
+
+# -- timeline.py sloAlerts instant events (satellite) -----------------------
+
+def test_timeline_renders_slo_alerts_as_instants(tmp_path):
+    import os
+    import sys
+
+    REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import timeline
+
+    # one span lane on a wall anchor + the watchdog's alert log: the
+    # alert must land as a GLOBAL instant event, wall-aligned with the
+    # span (anchor + raw ts == alert wall seconds * 1e6)
+    blob = {"traceEvents": [
+                {"name": "step", "ph": "X", "ts": 500_000.0, "dur": 100,
+                 "pid": 0, "tid": 0}],
+            "clockSyncUs": 1_000_000.0,
+            "sloAlerts": [{"rule": "step_time_p95", "t": 1.5,
+                           "threshold": 0.1, "cleared_t": 2.0}]}
+    p = str(tmp_path / "lane.json")
+    json.dump(blob, open(p, "w"))
+    out = str(tmp_path / "merged.json")
+    timeline.merge_traces([p], out)
+    evs = json.load(open(out))["traceEvents"]
+    step = next(e for e in evs if e["name"] == "step")
+    alert = next(e for e in evs if e["name"] == "ALERT step_time_p95")
+    clear = next(e for e in evs if e["name"] == "CLEAR step_time_p95")
+    assert alert["ph"] == "i" and alert["s"] == "g"
+    assert alert["args"]["threshold"] == 0.1
+    # the span's wall time is anchor+ts = 1.5 s — the alert fired at
+    # that same instant, so after merge+re-zero they coincide
+    assert alert["ts"] == pytest.approx(step["ts"])
+    assert clear["ts"] == pytest.approx(step["ts"] + 0.5e6)
+    assert alert["pid"] == step["pid"]

@@ -48,6 +48,13 @@
                    producer block or shed at the bound. Flow-controlled
                    cases (credit protocols) get an ignore/allowlist
                    entry with the justification.
+  anonymous-thread ``threading.Thread(...)`` created without ``name=``.
+                   Thread names are the lane labels in chrome traces,
+                   flight-recorder bundles, py-spy dumps and TSAN
+                   reports — an anonymous ``Thread-7`` makes every one
+                   of those unattributable. Name the thread after its
+                   role (``name="obs-sampler"``,
+                   ``name=f"ps-repl:{shard}"``).
   atomic-publish   an ``os.replace``/``os.rename`` publish in a scope
                    that never fsyncs: the rename can land while the
                    renamed content is still dirty page cache, so a crash
@@ -61,7 +68,8 @@
                    get an ignore with a justification.
 
 Scope: ``paddle_tpu/`` and ``bench.py`` for all rules; ``tools/`` for
-time-time only (demo drivers legitimately read their own env knobs).
+time-time and anonymous-thread only (demo drivers legitimately read
+their own env knobs, but their threads show up in the same traces).
 Suppression: trailing ``# graftlint: ignore[rule]``.
 """
 
@@ -318,6 +326,8 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
     queue_bare: Set[str] = set()   # from queue import Queue/LifoQueue [as x]
     deque_bare: Set[str] = set()   # from collections import deque [as x]
     threaded = False               # module imports threading
+    threading_mod_aliases: Set[str] = set()  # import threading [as t]
+    thread_bare: Set[str] = set()  # from threading import Thread [as T]
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
@@ -331,6 +341,7 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
                     coll_mod_aliases.add(a.asname or "collections")
                 elif a.name == "threading":
                     threaded = True
+                    threading_mod_aliases.add(a.asname or "threading")
         elif isinstance(node, ast.ImportFrom):
             if node.module == "time" and not node.level:
                 for a in node.names:
@@ -352,6 +363,9 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
                         deque_bare.add(a.asname or a.name)
             elif node.module == "threading" and not node.level:
                 threaded = True
+                for a in node.names:
+                    if a.name == "Thread":
+                        thread_bare.add(a.asname or "Thread")
 
     def _queue_kind(call: ast.Call):
         name = dotted(call.func)
@@ -445,6 +459,18 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
                          "and block or shed at the bound (the serving "
                          "admission-control pattern), or justify a "
                          "flow-controlled case with an ignore")
+            is_thread_ctor = name in thread_bare
+            if name and "." in name:
+                mod, _, attr = name.rpartition(".")
+                is_thread_ctor |= (mod in threading_mod_aliases
+                                   and attr == "Thread")
+            if is_thread_ctor and not any(kw.arg == "name"
+                                          for kw in node.keywords):
+                emit(node, "anonymous-thread",
+                     "threading.Thread() without name= — anonymous "
+                     "Thread-N lanes make traces, flight-recorder "
+                     "bundles and sanitizer reports unattributable; "
+                     "name the thread after its role")
             if name in ("os.environ.get", "os.getenv") and \
                     rel not in ENV_READ_OK:
                 emit(node, "env-read",
@@ -483,7 +509,7 @@ def run(root: str) -> List[Diagnostic]:
     diags: List[Diagnostic] = []
     all_rules = {"time-time", "bare-except", "mutable-default", "env-read",
                  "cast-roundtrip", "sleep-no-backoff", "atomic-publish",
-                 "unbounded-queue"}
+                 "unbounded-queue", "anonymous-thread"}
     for p in walk_py(root, ("paddle_tpu",), ("bench.py",)):
         diags.extend(check_file(p, root, all_rules))
     tools_dir = os.path.join(root, "tools")
@@ -491,7 +517,7 @@ def run(root: str) -> List[Diagnostic]:
         else []
     for p in walk_py(root, (), tuple(
             f"tools/{f}" for f in tool_files if f.endswith(".py"))):
-        diags.extend(check_file(p, root, {"time-time"}))
+        diags.extend(check_file(p, root, {"time-time", "anonymous-thread"}))
     return sorted(diags, key=lambda d: (d.path, d.line, d.rule))
 
 

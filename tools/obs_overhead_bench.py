@@ -1,8 +1,17 @@
-"""Obs-plane overhead gate: metrics-always-on vs metrics-compiled-out
-on the DeepFM stream step (ISSUE 8 CI budget: ≤ 2 %), plus the
-tracing-off wire contract (the RPC header carries EXACTLY the fixed
-16-byte context field, zeroed) and the job-wide snapshot acceptance
-(≥ 3 processes, per-table wire bytes + observed density).
+"""Obs-plane overhead gate: the ALWAYS-ON layer (metrics handles +
+ISSUE 10 sampler thread + SLO watchdog) vs metrics-compiled-out on the
+DeepFM stream step (CI budget: ≤ 2 %), plus the tracing-off wire
+contract (the RPC header carries EXACTLY the fixed 16-byte context
+field, zeroed) and the job-wide snapshot acceptance (≥ 3 processes,
+per-table wire bytes + observed density).
+
+The ON arm now runs exactly what a production trainer runs
+continuously: live registry handles AND a JobCollector sampling the
+whole job (local snapshot + one kObsSnap per shard) every
+OOB_SAMPLE_PERIOD seconds with the stock SLO rule set evaluated per
+tick. The sampler's kObsSnap RPCs share the cluster with both arms'
+training traffic — deliberately: that contention IS part of the
+always-on cost the 2% budget must cover.
 
 Methodology (the chaos_ps interleaved-A/B discipline): TWO identical
 seeded DeepFM stream trainers (SYNC communicator — inline pull/push
@@ -31,7 +40,8 @@ bounds the quiet-weather overhead. Tracing stays OFF in both arms
 wire assertion covers the header side).
 
 Standalone: prints exactly ONE JSON line (driver contract). Env knobs:
-OOB_BATCH, OOB_STEPS, OOB_ROUNDS, OOB_PASSES, OOB_SLOTS, OOB_NID.
+OOB_BATCH, OOB_STEPS, OOB_ROUNDS, OOB_PASSES, OOB_SLOTS, OOB_NID,
+OOB_SAMPLE_PERIOD.
 """
 
 import json
@@ -80,7 +90,7 @@ def run() -> dict:
     from paddle_tpu import optimizer
     from paddle_tpu.core.flags import get_flags, set_flags
     from paddle_tpu.models.ctr import CtrConfig, DeepFM
-    from paddle_tpu.obs import aggregate, registry, trace
+    from paddle_tpu.obs import aggregate, registry, slo, timeseries, trace
     from paddle_tpu.ps import ha, rpc
     from paddle_tpu.ps.communicator import SyncCommunicator
     from paddle_tpu.ps.ps_trainer import CtrStreamTrainer
@@ -126,6 +136,16 @@ def run() -> dict:
         return client, comm, tr
 
     arms = {"on": build(True), "off": build(False)}
+    # the ISSUE 10 always-on layer rides the ON arm for the WHOLE
+    # measurement (warm-up included): job sampler + stock SLO rules.
+    # Thresholds are production-shaped — nothing fires on a healthy
+    # run, so the measured cost is evaluation, not alert handling.
+    sampler = timeseries.JobCollector(
+        client=arms["on"][0],
+        period_s=float(os.environ.get("OOB_SAMPLE_PERIOD", 0.25)))
+    watchdog = slo.SloWatchdog(sampler.ring, slo.default_rules())
+    watchdog.attach(sampler)
+    sampler.start()
     try:
         # warm-up: compile + row creation + the process's slow settle
         # (page cache / allocator arenas / predictors — measured ~45 →
@@ -208,6 +228,11 @@ def run() -> dict:
             "rounds": rounds,
             "passes": passes,
             "steps_per_round": steps,
+            "sampler_ticks": sampler.ticks,
+            "sampler_errors": sampler.errors,
+            "watchdog_rules": len(watchdog.rules),
+            "watchdog_evaluations": watchdog.evaluations,
+            "alerts_fired": len(watchdog.alerts()),
             "wire_header_bytes": hdr_bytes,
             "trace_ctx_bytes": ctx_bytes,
             "tracing_off_extra_header_bytes": hdr_bytes - 28 - ctx_bytes,
@@ -217,6 +242,7 @@ def run() -> dict:
             "client_density": dens,
         }
     finally:
+        sampler.stop()
         for client, comm, _ in arms.values():
             try:
                 comm.stop()

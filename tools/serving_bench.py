@@ -197,8 +197,9 @@ def run() -> dict:
 
             def drive(limit):
                 threads = [threading.Thread(target=submitter,
-                                            args=(limit,))
-                           for _ in range(concurrency)]
+                                            args=(limit,),
+                                            name=f"sb-submit-{ti}")
+                           for ti in range(concurrency)]
                 t0 = time.perf_counter()
                 for th in threads:
                     th.start()
@@ -250,7 +251,7 @@ def run() -> dict:
                 mp[0, 2] = marker[0]   # click stat: additive, pull col 1
                 train_cli.push_sparse(0, marker_key, mp)
 
-            wth = threading.Thread(target=writer)
+            wth = threading.Thread(target=writer, name="sb-writer")
             wth.start()
             try:
                 for _ in range(n_probes):

@@ -1,0 +1,288 @@
+"""SLO pipeline demo: the DeepFM stream under the always-on sampler,
+with one injected degradation — the committed OBS_TIMESERIES.json
+artifact (ISSUE 10 satellite: the headline perf trajectory as durable
+CURVES, not a single number).
+
+What one run produces:
+
+1. a 2-shard RPC PS cluster + SyncCommunicator DeepFM stream trainer,
+   with a :class:`~paddle_tpu.obs.timeseries.JobCollector` thread
+   sampling trainer + both shards and a
+   :class:`~paddle_tpu.obs.slo.SloWatchdog` attached to its ticks;
+2. a WARM phase that calibrates the step-time SLO threshold from the
+   observed p95 (platform-independent: the artifact is meaningful on
+   any box);
+3. a DEGRADED phase: a ``delay-ms`` faultpoint armed on the client
+   ``rpc.call`` site (every pull pays the delay) until the watchdog's
+   multi-window burn-rate rule FIRES — the alert dumps a flight-
+   recorder bundle (``dump_on={"slo_alert"}``);
+4. a RECOVERY phase (faultpoint disarmed) until the alert CLEARS;
+5. the artifact: step-time p95 / step-rate / per-table wire-density
+   and wire-byte curves, the alert record, the bundle's self-check
+   (alert inside the degraded window, merged trace parses, spans
+   present), an OpenMetrics scrape of the live exporter validated by
+   the strict parser, and a tools/timeline.py merge showing the alert
+   as an instant event against the span lanes.
+
+Standalone: prints exactly ONE JSON line (driver contract) and writes
+OBS_TIMESERIES.json (env SLO_OUT overrides). Env knobs: SLO_SLOTS,
+SLO_BATCH, SLO_STEPS, SLO_MAX_EPOCHS, SLO_PERIOD.
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+METRIC = "slo_timeseries_demo"
+
+
+def run(out_path: str) -> dict:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM
+    from paddle_tpu.obs import exporter as om
+    from paddle_tpu.obs import flightrec, registry, slo, timeseries, trace
+    from paddle_tpu.ps import rpc
+    from paddle_tpu.ps.communicator import SyncCommunicator
+    from paddle_tpu.ps.faultpoints import arm_faultpoint, disarm_faultpoints
+    from paddle_tpu.ps.ps_trainer import CtrStreamTrainer
+    from paddle_tpu.ps.table import TableConfig
+
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    import timeline
+
+    from obs_overhead_bench import _make_dataset  # one shared generator
+
+    S = int(os.environ.get("SLO_SLOTS", 8))
+    D = 4
+    batch = int(os.environ.get("SLO_BATCH", 256))
+    steps = int(os.environ.get("SLO_STEPS", 6))
+    max_epochs = int(os.environ.get("SLO_MAX_EPOCHS", 12))
+    period = float(os.environ.get("SLO_PERIOD", 0.1))
+    ds = _make_dataset(S, D, batch, steps, nid=1000)
+
+    registry.set_process_role("trainer")
+    servers = [rpc.NativePsServer(n_trainers=1) for _ in range(2)]
+    client = rpc.RpcPsClient([f"127.0.0.1:{s.port}" for s in servers])
+    sampler = exp = None
+    try:
+        client.create_sparse_table(
+            0, TableConfig(table_id=0, shard_num=4, accessor="ctr"))
+        comm = SyncCommunicator(client)
+        comm.start()
+        pt.seed(0)
+        trainer = CtrStreamTrainer(
+            DeepFM(CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=8,
+                             dnn_hidden=(64, 64))),
+            optimizer.Adam(1e-3), None,
+            sparse_slots=[f"s{i}" for i in range(S)],
+            dense_slots=[f"d{i}" for i in range(D)], label_slot="label",
+            communicator=comm, table_id=0, embedx_dim=8)
+
+        # -- the always-on layer -----------------------------------------
+        ring = timeseries.MetricRing(capacity=2048)
+        sampler = timeseries.JobCollector(client=client, period_s=period,
+                                          ring=ring).start()
+        wd = slo.SloWatchdog(ring)
+        wd.attach(sampler)
+        bundle_dir = tempfile.mkdtemp(prefix="slo_demo_flightrec_")
+        rec = flightrec.install(flightrec.FlightRecorder(
+            bundle_dir, ring=ring, watchdog=wd, client=client,
+            dump_on={"slo_alert"}, min_interval_s=0.0))
+        exp = om.ObsExporter(sampler.latest, ring=ring,
+                             alerts_fn=wd.alerts).start()
+
+        # -- warm phase: compile + calibrate the objective ---------------
+        warm_ms = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            r = trainer.train_from_dataset(ds, batch_size=batch)
+            comm.barrier()
+            warm_ms.append((time.perf_counter() - t0) / r["steps"] * 1e3)
+        time.sleep(2.5 * period)   # let the sampler see the warm tail
+        # calibrate from the FASTEST warm epoch (the ring's p95 curve
+        # still carries the first epoch's compile step — multi-hundred
+        # ms — which would inflate the objective past any injectable
+        # delay); 4× the steady-state step is a tight-but-honest SLO
+        threshold_s = max(4.0 * min(warm_ms) / 1e3, 0.02)
+        wd.add_rule(slo.SloRule(
+            "step_time_p95", "trainer_step_time_s",
+            threshold=threshold_s, budget=0.2,
+            windows=((40 * period, 1.0), (10 * period, 1.0))))
+
+        # -- degraded phase: delay every pull until the rule fires -------
+        delay_ms = max(100, int(threshold_s * 1e3 * 2))
+        # sample=1.0: every degraded step records a span, so the bundle
+        # the alert dumps deterministically contains the slow steps (a
+        # fractional sample can dump before any root happened to be
+        # sampled — the gate asserts spans > 0)
+        trace.start_tracing(sample=1.0)
+        degrade_t0 = trace.wall_s()
+        arm_faultpoint("rpc.call", "delay-ms", cmd=rpc._PULL_SPARSE,
+                       ms=delay_ms, every=1)
+        degraded_epochs = 0
+        try:
+            for _ in range(max_epochs):
+                trainer.train_from_dataset(ds, batch_size=batch)
+                comm.barrier()
+                degraded_epochs += 1
+                if any(a["rule"] == "step_time_p95" and a["cleared_t"] is None
+                       for a in wd.alerts()):
+                    break
+        finally:
+            disarm_faultpoints()
+        degrade_t1 = trace.wall_s()
+        alerts_fired = [a for a in wd.alerts()
+                        if a["rule"] == "step_time_p95"]
+        assert alerts_fired, (
+            f"watchdog never fired after {degraded_epochs} degraded epochs "
+            f"(threshold {threshold_s * 1e3:.1f} ms, delay {delay_ms} ms)")
+        alert = alerts_fired[0]
+        assert degrade_t0 <= alert["t"] <= degrade_t1 + period, alert
+
+        # -- recovery phase: the alert must CLEAR ------------------------
+        recovery_epochs = 0
+        for _ in range(max_epochs):
+            trainer.train_from_dataset(ds, batch_size=batch)
+            comm.barrier()
+            recovery_epochs += 1
+            if "step_time_p95" not in wd.active():
+                break
+        time.sleep(2.5 * period)
+        trace.stop_tracing()
+        cleared = "step_time_p95" not in wd.active()
+
+        # -- bundle self-check -------------------------------------------
+        bundles = rec.bundles()
+        assert bundles, "alert did not dump a flight-recorder bundle"
+        with open(os.path.join(bundles[0], "manifest.json")) as f:
+            manifest = json.load(f)
+        with open(os.path.join(bundles[0], "trace.json")) as f:
+            btrace = json.load(f)
+        with open(os.path.join(bundles[0], "alerts.json")) as f:
+            balerts = json.load(f)["alerts"]
+        in_window = [a for a in balerts
+                     if a["rule"] == "step_time_p95"
+                     and degrade_t0 <= a["t"] <= degrade_t1 + period]
+        assert in_window, (balerts, degrade_t0, degrade_t1)
+        alert_instants = [e for e in btrace["traceEvents"]
+                          if e.get("ph") == "i"
+                          and e["name"].startswith("ALERT")]
+        assert alert_instants, "bundle trace has no alert instant event"
+
+        # -- exporter scrape, validated as well-formed OpenMetrics -------
+        with urllib.request.urlopen(f"{exp.url}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        fams = om.parse_openmetrics(text)
+        assert "trainer_step_time_s" in fams and "slo_alerts" in fams, \
+            sorted(fams)
+
+        # -- timeline merge: alert instants against the span lanes -------
+        tmp = tempfile.mkdtemp(prefix="slo_demo_tl_")
+        lane = os.path.join(tmp, "trainer.json")
+        trace.export_chrome_trace(lane, pid=0, process_name="trainer")
+        with open(lane) as f:
+            blob = json.load(f)
+        blob["sloAlerts"] = wd.alerts()
+        with open(lane, "w") as f:
+            json.dump(blob, f)
+        merged_path = os.path.join(tmp, "merged.json")
+        n_events = timeline.merge_traces([lane], merged_path)
+        with open(merged_path) as f:
+            merged = json.load(f)["traceEvents"]
+        tl_alerts = [e for e in merged if e.get("cat") == "slo_alert"]
+        assert any(e["name"] == "ALERT step_time_p95" for e in tl_alerts)
+
+        # -- the committed curves ----------------------------------------
+        t_base = ring.records()[0]["t"] if len(ring) else 0.0
+
+        def curve(pairs, scale=1.0, nd=3):
+            return [[round(t - t_base, 3), round(v * scale, nd)]
+                    for t, v in pairs]
+
+        density = {}
+        byte_rate = {}
+        for d in ("push", "pull"):
+            density[d] = curve(ring.series(
+                "ps_client_density", "value", labels={"dir": d},
+                reduce="mean"), nd=4)
+            byte_rate[d] = curve(ring.series(
+                "ps_server_wire_bytes", "rate", labels={"dir": "in" if
+                                                        d == "push"
+                                                        else "out"}), nd=0)
+        rec_out = {
+            "metric": METRIC,
+            "platform": jax.devices()[0].platform,
+            "out": out_path,
+            "period_s": period,
+            "ticks": sampler.ticks,
+            "tick_errors": sampler.errors,
+            "warm_ms_per_step": round(min(warm_ms), 2),
+            "threshold_ms": round(threshold_s * 1e3, 2),
+            "delay_ms": delay_ms,
+            "degraded_epochs": degraded_epochs,
+            "recovery_epochs": recovery_epochs,
+            "alert": alert,
+            "alert_cleared": cleared,
+            "bundle": {
+                "path": bundles[0],
+                "reason": manifest["reason"],
+                "spans": manifest["spans"],
+                "alerts": manifest["alerts"],
+                "alert_in_degraded_window": bool(in_window),
+                "alert_instants_in_trace": len(alert_instants),
+            },
+            "openmetrics_ok": True,
+            "openmetrics_families": len(fams),
+            "timeline_events": n_events,
+            "timeline_alert_instants": len(tl_alerts),
+            "curves": {
+                "step_time_p95_ms": curve(
+                    ring.series("trainer_step_time_s", "p95"), 1e3),
+                "step_rate_per_s": curve(
+                    ring.series("trainer_step_time_s", "count")),
+                "wire_density": density,
+                "server_wire_bytes_per_tick": byte_rate,
+            },
+        }
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(rec_out, f, indent=1, sort_keys=True)
+        comm.stop()
+        return rec_out
+    finally:
+        from paddle_tpu.obs import flightrec as _fr
+
+        _fr.uninstall()
+        if exp is not None:
+            exp.stop()
+        if sampler is not None:
+            sampler.stop()
+        client.stop_servers()
+        client.close()
+        for s in servers:
+            s.close()
+
+
+def main() -> int:
+    out = os.environ.get("SLO_OUT", os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "OBS_TIMESERIES.json"))
+    try:
+        rec = run(out)
+        rec = {k: v for k, v in rec.items() if k != "curves"}  # short line
+    except Exception as e:  # one-JSON-line driver contract
+        rec = {"metric": METRIC, "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,6 +25,15 @@ Two merge corrections (ISSUE 8 satellite):
   keep their internal structure (the old behavior flattened every
   event onto the file's index, silently merging a file's lanes).
 
+SLO alerts (ISSUE 10 satellite): a blob may carry a top-level
+``sloAlerts`` list (the ``obs.slo.SloWatchdog`` alert-log dicts, wall
+seconds in ``t``). Each renders as a GLOBAL INSTANT event
+(``ph: "i"``, ``s: "g"`` — the full-height line chrome://tracing draws)
+named ``ALERT <rule>``, so a triage sees "the watchdog fired HERE"
+against the span lanes; a ``cleared_t`` adds the matching
+``CLEAR <rule>`` instant. Alert timestamps are already wall-anchored,
+so they shift by the shared base only (not the blob's own anchor).
+
 Usage:
     python tools/timeline.py worker0.json worker1.json -o merged.json
 """
@@ -43,11 +52,12 @@ def merge_traces(paths, output):
         # both legal chrome-trace forms: {"traceEvents": [...]} or [...]
         evs = blob if isinstance(blob, list) else blob.get("traceEvents", [])
         sync = None if isinstance(blob, list) else blob.get("clockSyncUs")
-        blobs.append((path, evs, sync))
+        alerts = [] if isinstance(blob, list) else blob.get("sloAlerts", [])
+        blobs.append((path, evs, sync, alerts))
 
-    anchors = [s for _, _, s in blobs if s is not None]
+    anchors = [s for _, _, s, _ in blobs if s is not None]
     base = min(anchors) if anchors else 0.0
-    for path, _, sync in blobs:
+    for path, _, sync, _ in blobs:
         if sync is None and anchors:
             print(f"warning: {path} has no clockSyncUs anchor — its lane "
                   "merges unshifted and may interleave on a raw "
@@ -62,7 +72,7 @@ def merge_traces(paths, output):
             pid_map[key] = len(pid_map)
         return pid_map[key]
 
-    for fi, (path, evs, sync) in enumerate(blobs):
+    for fi, (path, evs, sync, alerts) in enumerate(blobs):
         shift = (sync - base) if sync is not None else 0.0
         name = os.path.splitext(os.path.basename(path))[0]
         named_lanes = set()
@@ -77,6 +87,21 @@ def merge_traces(paths, output):
                     if k in ev:
                         ev[k] = ev[k] + shift
             events.append(ev)
+        for a in alerts:
+            # alert timestamps are wall seconds already — only the
+            # shared base applies, never the blob's own anchor shift
+            pid = out_pid(fi, 0)
+            rule = a.get("rule", "?")
+            events.append({"name": f"ALERT {rule}", "cat": "slo_alert",
+                           "ph": "i", "s": "g",
+                           "ts": float(a.get("t", 0.0)) * 1e6 - base,
+                           "pid": pid, "tid": 0, "args": dict(a)})
+            if a.get("cleared_t") is not None:
+                events.append({"name": f"CLEAR {rule}", "cat": "slo_alert",
+                               "ph": "i", "s": "g",
+                               "ts": float(a["cleared_t"]) * 1e6 - base,
+                               "pid": pid, "tid": 0,
+                               "args": {"rule": rule}})
         # one metadata record names each unnamed lane (chrome convention)
         for (f2, orig), pid in list(pid_map.items()):
             if f2 == fi and pid not in named_lanes:

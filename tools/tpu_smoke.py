@@ -93,7 +93,7 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001
             got["err"] = str(e)
 
-    t = threading.Thread(target=init, daemon=True)
+    t = threading.Thread(target=init, daemon=True, name="tpu-smoke-init")
     t.start()
     t.join(float(os.environ.get("SMOKE_INIT_TIMEOUT", 180)))
     if "devs" not in got:
