@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI gate (reference L0's cmake+ctest role): graftlint, native build,
 # fast test gate, then the full matrix.
-# Usage: ./ci.sh [lint|fast|full|chaos|ckpt|hot_tier|serving|obs|slo]
+# Usage: ./ci.sh [lint|fast|full|chaos|ckpt|hot_tier|serving|obs|slo|reshard]
 #   chaos — PS high-availability fast-gate: every failover/replication
 #   test with faultpoints armed (incl. the slow e2e kill-shard runs)
 #   plus the chaos_ps demo with its recovery/overhead acceptance checks.
@@ -30,6 +30,16 @@
 #   contains the firing window, and the live exporter's /metrics must
 #   validate as well-formed OpenMetrics; the overhead bench re-asserts
 #   the sampler+watchdog cost inside the 2% budget.
+#   reshard — live elastic resharding + SLO-driven autoscaling gate:
+#   the full reshard/autoscale suites incl. the slow chaos e2e (grow
+#   2→4 and shrink back mid-CtrStreamTrainer with an armed kill-shard
+#   during one migration — digests prove zero lost/doubled rows, final
+#   state bit-identical to an unresharded oracle), then the closed-loop
+#   diurnal-ramp demo: an injected traffic wave fires the step-time
+#   SLO, the autoscaler grows the shard set live, the wave passes, the
+#   alert clears and it shrinks back — RESHARD.json records the
+#   cutover pause p50/p95 (asserted well under the full-copy bootstrap
+#   time) and the scale-event journal.
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -198,6 +208,41 @@ print('slo overhead OK: %+.2f%% with %d sampler ticks, %d rule evals'
   check_slo_overhead || { echo "slo overhead retry (ambient-load outlier)"; \
     check_slo_overhead; }
   echo "CI OK (slo)"
+  exit 0
+fi
+
+if [[ "${1:-fast}" == "reshard" ]]; then
+  echo "== reshard gate: live elastic resharding + SLO autoscaling =="
+  # -m "" includes the slow chaos e2e: grow 2→4 + shrink 4→2 mid-
+  # CtrStreamTrainer with a kill-shard during one migration, final
+  # state bit-identical to an unresharded oracle
+  python -m pytest tests/test_reshard.py tests/test_autoscale.py -q -m ""
+  echo "== reshard demo (wave → SLO fire → grow → clear → shrink) =="
+  check_reshard() {
+    PYTHONPATH="$PWD:${PYTHONPATH:-}" JAX_PLATFORMS=cpu \
+      RESHARD_OUT=/tmp/ci_reshard.json python tools/reshard_demo.py \
+      | python -c "
+import json, sys
+d = json.loads([l for l in sys.stdin.read().splitlines()
+                if l.startswith('{')][-1])
+assert 'error' not in d, d
+assert d['scaled_up']['to_shards'] == 4, d['scaled_up']
+assert d['scaled_down']['to_shards'] == 2, d['scaled_down']
+assert d['alert_cleared'] and d['shards_final'] == 2, d
+# gate-hold must be a small fraction of the full-copy bootstrap —
+# the reason snapshot+tail+fence beats stop-the-world
+assert 0 < d['gate_hold_over_copy'] < 0.5, d
+assert d['trainer_np_target'] == 2, d
+print('reshard demo OK: wave fired %s, grow pause %.0fms vs copy '
+      '%.0fms (ratio %.2f), shrink pause %.0fms, journal closed the '
+      'loop'
+      % (d['alert']['rule'], d['scaled_up']['cutover_pause_ms'],
+         d['scaled_up']['bootstrap_s'] * 1e3, d['gate_hold_over_copy'],
+         d['scaled_down']['cutover_pause_ms']))"
+  }
+  check_reshard || { echo "reshard demo retry (ambient-load outlier)"; \
+    check_reshard; }
+  echo "CI OK (reshard)"
   exit 0
 fi
 
@@ -374,7 +419,8 @@ print('bench degradation ladder OK')"
       tests/test_rpc_robustness.py tests/test_dist_graph.py \
       tests/test_rpc_parallel.py tests/test_ps_ha.py \
       tests/test_job_checkpoint.py tests/test_serving.py \
-      tests/test_obs.py tests/test_slo.py tests/test_flightrec.py -q -m ""
+      tests/test_obs.py tests/test_slo.py tests/test_flightrec.py \
+      tests/test_reshard.py tests/test_autoscale.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_tsan_report* 2>/dev/null; then
     echo "TSAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_tsan_report*)"
     exit 1
@@ -394,7 +440,8 @@ print('bench degradation ladder OK')"
       tests/test_rpc_robustness.py tests/test_dist_graph.py \
       tests/test_rpc_parallel.py tests/test_ps_ha.py \
       tests/test_job_checkpoint.py tests/test_serving.py \
-      tests/test_obs.py tests/test_slo.py tests/test_flightrec.py -q -m ""
+      tests/test_obs.py tests/test_slo.py tests/test_flightrec.py \
+      tests/test_reshard.py tests/test_autoscale.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_asan_report* 2>/dev/null; then
     echo "ASAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_asan_report*)"
     exit 1
@@ -413,7 +460,8 @@ print('bench degradation ladder OK')"
       tests/test_rpc_robustness.py tests/test_dist_graph.py \
       tests/test_rpc_parallel.py tests/test_ps_ha.py \
       tests/test_job_checkpoint.py tests/test_serving.py \
-      tests/test_obs.py tests/test_slo.py tests/test_flightrec.py -q -m ""
+      tests/test_obs.py tests/test_slo.py tests/test_flightrec.py \
+      tests/test_reshard.py tests/test_autoscale.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_ubsan_report* 2>/dev/null; then
     echo "UBSAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_ubsan_report*)"
     exit 1

@@ -19,6 +19,7 @@ __all__ = [
     "AlreadyExistsError",
     "PreconditionNotMetError",
     "PsTransportError",
+    "WrongShardError",
     "UnimplementedError",
     "UnavailableError",
     "ExecuteError",
@@ -67,6 +68,17 @@ class PsTransportError(PreconditionNotMetError):
     deaths — a healthy server's application-level rejection must never
     be misread as a dead server. Injected faults (ps/faultpoints.py
     FaultInjected) subclass this so chaos walks the same paths."""
+
+
+class WrongShardError(PreconditionNotMetError):
+    """A keyed PS data op carried a key OUTSIDE the addressed server's
+    (modulus, residue) ownership class (csrc kErrWrongShard): the client
+    routed with a stale shard topology — a live reshard (ps/reshard.py)
+    moved the key's residue class. The server rejected the frame WHOLE
+    (no state changed), so the client re-resolves the epoch-stamped
+    routing table, rebuilds its connection set, and replays exactly the
+    bounced keys (RpcPsClient misroute replay). NOT a transport error:
+    the server answered, so the breaker and failover paths stay cold."""
 
 
 class UnimplementedError(EnforceNotMet, NotImplementedError):

@@ -182,6 +182,21 @@ enum Cmd : uint32_t {
   kDenseSnap = 41,  // dense table full state → [i64 t][values][m][v]
                     // (m/v present only for adam); status = dim
   kDenseRestore = 42,  // payload as kDenseSnap's response; replaces state
+  // -- live elastic resharding (ps/reshard.py; docs/OPERATIONS.md §15) --
+  kRetain = 44,   // n = modulus (0 = read), aux = residue. Sets this
+                  // server's key-OWNERSHIP predicate (key % n == aux;
+                  // aux = -1 owns NOTHING — the retiring-shard fence)
+                  // and, when 0 <= aux < n, erases every RAM-table row
+                  // outside it (the key-range filter a reshard cutover
+                  // applies after migrating the moved residues away).
+                  // Once ownership is set, keyed data commands carrying
+                  // a non-owned key bounce whole with kErrWrongShard —
+                  // a stale-topology client re-resolves the routing
+                  // table and replays (RpcPsClient misroute replay).
+                  // Pause-EXEMPT (issued while the cutover gate holds
+                  // writers) but tapped into the oplog, so a shard's
+                  // backups converge to the same retained row set.
+                  // n = 0 reads: payload i64[2]{modulus, residue}.
   // -- observability (paddle_tpu/obs drives this; docs/OPERATIONS.md §13) --
   kObsSnap = 43,  // per-table wire counters + server-side trace spans:
                   // aux&1 drains the span ring, aux&2 resets the wire
@@ -201,6 +216,12 @@ enum Err : int64_t {
   kErrStaleEpoch = -5,  // kReplicate from a fenced (demoted) primary
   kErrSeqGap = -6,      // kReplicate seq skipped entries — resync needed
   kErrReadOnly = -7,    // training-plane mutation on a read-only replica
+  kErrWrongShard = -8,  // keyed data op carrying a key outside this
+                        // server's (modulus, residue) ownership — the
+                        // client routed with a STALE shard topology and
+                        // must re-resolve the routing table and replay
+                        // (rejected whole, before any state change, so
+                        // the replay applies each key exactly once)
 };
 
 // commands whose application changes table state: these are the ops a
@@ -235,6 +256,28 @@ inline bool is_mutating_cmd(uint32_t cmd, int32_t aux, int64_t n) {
     case kPullSparse:
     case kExport:
       return (aux & 1) != 0;
+    // ownership install + row drop must reach the shard's backups (the
+    // retained row set is part of the replicated state); n == 0 reads
+    // stay untapped
+    case kRetain:
+      return n != 0;
+    default:
+      return false;
+  }
+}
+
+// keyed data commands whose payload leads with [u64 keys × n] — the
+// set the ownership fence (kRetain / kErrWrongShard) scans. Kept in
+// lockstep with the case bodies' payload layouts.
+inline bool is_keyed_data_cmd(uint32_t cmd) {
+  switch (cmd) {
+    case kPullSparse:
+    case kPushSparse:
+    case kExport:
+    case kInsertFull:
+    case kLoadCold:
+    case kPushGeo:
+      return true;
     default:
       return false;
   }
@@ -254,7 +297,7 @@ inline bool is_create_cmd(uint32_t cmd) {
 // DOWNGRADED instead (missing rows read as zeros — the serving contract
 // for out-of-population features), so a sloppy serve client cannot
 // create phantom rows that diverge from the primary.
-inline bool is_training_plane_cmd(uint32_t cmd, int32_t aux) {
+inline bool is_training_plane_cmd(uint32_t cmd, int32_t aux, int64_t n) {
   switch (cmd) {
     case kPushSparse:
     case kPushDense:
@@ -266,6 +309,12 @@ inline bool is_training_plane_cmd(uint32_t cmd, int32_t aux) {
       return true;
     case kExport:  // create-export is the pass-build path, not serving
       return (aux & 1) != 0;
+    // reshard control plane: the APPLY (n > 0) reaches replicas via
+    // the replication stream (apply_op), never directly; the n == 0
+    // ownership READ is introspection (an operator re-attaching a
+    // serving observer inspects its fence) and stays open
+    case kRetain:
+      return n != 0;
     default:
       return false;
   }
@@ -603,6 +652,16 @@ struct PsServer {
   // training-plane mutations bounce with kErrReadOnly; replication and
   // snapshot-plane commands still apply (see is_training_plane_cmd)
   std::atomic<bool> read_only{false};
+  // key-ownership predicate (live resharding, ps/reshard.py): when
+  // own_mod > 0, a direct keyed data command carrying any key with
+  // key % own_mod != own_res bounces whole with kErrWrongShard — the
+  // deterministic stale-topology fence that makes a client re-resolve
+  // the epoch-stamped routing table. 0 = own everything (the static-
+  // topology default); own_res = -1 owns nothing (a retiring shard).
+  // The replication plane (kReplicate → apply_op) bypasses the check:
+  // a bootstrap snapshot deliberately carries not-yet-owned residues.
+  std::atomic<int64_t> own_mod{0};
+  std::atomic<int64_t> own_res{0};
   // bumped whenever DENSE state changes (direct or replicated apply):
   // the serving replica's feed watcher reads this counter instead of
   // polling table bytes — a dense-tower refresh triggers exactly when
@@ -1053,6 +1112,37 @@ struct PsServer {
   // outputs are discarded — only the insert-on-miss side effect
   // matters). Validation is kept in lockstep with handle() so a frame
   // that failed on the primary fails identically on the backup.
+  // kRetain body, shared by the interactive path and the replication
+  // apply (a shard's backups must converge to the same ownership AND
+  // the same retained row set). Returns rows erased (>= 0) or an error.
+  int64_t do_retain(int64_t mod, int64_t res) {
+    if (mod <= 0) return kErrBadSize;
+    std::vector<SparseRef> tabs;
+    {
+      std::lock_guard<std::mutex> g(tables_mu);
+      for (auto& kv : sparse) tabs.push_back(kv.second);
+    }
+    // erase needs the RAM engine's slot walk; SSD cold tiers have no
+    // retain (ps/reshard.py refuses SSD tables before it starts) —
+    // fail BEFORE installing ownership, so a refused retain leaves the
+    // server serving its old key set instead of half-fenced
+    if (res >= 0 && res < mod)
+      for (auto& t : tabs)
+        if (t.ssd) return kErrInternal;
+    own_mod.store(mod);
+    own_res.store(res);
+    if (res < 0 || res >= mod) return 0;  // fence-only: rows untouched
+    int64_t erased = 0;
+    for (auto& t : tabs) {
+      for (auto* sh : t.mem->shards) {
+        std::lock_guard<std::mutex> g(sh->mu);
+        erased += sh->retain(static_cast<uint64_t>(mod),
+                             static_cast<uint64_t>(res));
+      }
+    }
+    return erased;
+  }
+
   int64_t apply_op(const ReqHeader& h, const char* p) {
     if (h.n < 0 || static_cast<uint64_t>(h.n) > kMaxPayload) return kErrBadSize;
     switch (h.cmd) {
@@ -1185,6 +1275,8 @@ struct PsServer {
       }
       case kGlobalStep:
         return global_step.fetch_add(h.n) + h.n;
+      case kRetain:
+        return do_retain(h.n, h.aux);
       default:
         return kErrBadCmd;
     }
@@ -1257,7 +1349,7 @@ struct PsServer {
     // serve client reading an out-of-population key gets zeros, not a
     // phantom row the primary never created.
     if (read_only.load()) {
-      if (is_training_plane_cmd(h.cmd, h.aux))
+      if (is_training_plane_cmd(h.cmd, h.aux, h.n))
         return respond(fd, kErrReadOnly, nullptr, 0);
       if (h.cmd == kPullSparse) h.aux &= ~1;
     }
@@ -1269,7 +1361,33 @@ struct PsServer {
     // racing same-key pushes may differ from oplog order (async
     // replication tolerates bounded divergence; sync-mode bit-identical
     // guarantees assume serialized pushes — ps/ha.py docstring).
-    MutGuard mg(this, mutating);
+    // kRetain is pause-EXEMPT: the reshard cutover issues it while the
+    // mutation gate already holds every writer out — gating it too
+    // would deadlock the cutover against its own gate. It still taps
+    // (below), so backups replay the same retain at the same point in
+    // the op stream.
+    MutGuard mg(this, mutating && h.cmd != kRetain);
+    // key-ownership fence (live resharding): reject a stale-topology
+    // client's frame WHOLE — before the tap and any apply, so the
+    // bounced keys changed state nowhere and the client's
+    // re-resolve-and-replay applies each key exactly once. MUST sit
+    // AFTER the gate: a mutator that blocked through a reshard cutover
+    // re-validates against the ownership the cutover installed while
+    // it waited (checked before the gate, it would re-create the very
+    // rows the cutover just migrated away). Keys lead every keyed
+    // payload; the length guard defers short frames to kErrBadSize.
+    {
+      int64_t om = own_mod.load(std::memory_order_relaxed);
+      if (om > 0 && is_keyed_data_cmd(h.cmd) && h.n > 0 &&
+          h.payload_len >= static_cast<uint64_t>(h.n) * 8) {
+        int64_t orr = own_res.load(std::memory_order_relaxed);
+        const uint64_t* keys = reinterpret_cast<const uint64_t*>(p);
+        for (int64_t i = 0; i < h.n; ++i)
+          if (static_cast<int64_t>(keys[i] % static_cast<uint64_t>(om)) !=
+              orr)
+            return respond(fd, kErrWrongShard, nullptr, 0);
+      }
+    }
     // pull/export-with-create defer their tap into the case body: when
     // the traversal inserts NOTHING the op is a state no-op and skipping
     // it halves steady-state replication traffic (a stream trainer
@@ -1713,10 +1831,30 @@ struct PsServer {
         return respond(fd, 0, out, sizeof(out));
       }
       case kDigest: {
+        // n > 0: digest restricted to keys with key % n == aux — the
+        // reshard migration check (digests are wrapping sums of row
+        // hashes, so class digests ADD: no row lost or doubled across
+        // a cutover is an O(1) equality). n = 0: whole table.
         SparseRef t;
         if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
-        uint64_t dg = t.ssd ? sst_digest(t.ssd) : pstpu::table_digest(t.mem);
+        uint64_t dg;
+        if (h.n > 0) {
+          if (t.ssd || h.aux < 0 || h.aux >= h.n)
+            return respond(fd, kErrBadSize, nullptr, 0);
+          dg = pstpu::table_digest_filtered(
+              t.mem, static_cast<uint64_t>(h.n),
+              static_cast<uint64_t>(h.aux));
+        } else {
+          dg = t.ssd ? sst_digest(t.ssd) : pstpu::table_digest(t.mem);
+        }
         return respond(fd, 0, &dg, sizeof(dg));
+      }
+      case kRetain: {
+        if (h.n == 0) {  // ownership read (introspection/tests)
+          int64_t out[2] = {own_mod.load(), own_res.load()};
+          return respond(fd, 0, out, sizeof(out));
+        }
+        return respond(fd, do_retain(h.n, h.aux), nullptr, 0);
       }
       case kDenseSnap: {
         DenseTable* t = get_dense(h.table_id);

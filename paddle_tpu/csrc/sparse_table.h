@@ -513,6 +513,26 @@ struct Shard {
     return erased;
   }
 
+  // Retain (live resharding, ps/reshard.py): drop every row whose key
+  // falls outside the (modulus, residue) ownership class — the
+  // key-range filter a reshard cutover applies after the migrated
+  // residues have been copied off this shard. Caller holds mu.
+  int64_t retain(uint64_t mod, uint64_t res) {
+    int64_t erased = 0;
+    for (uint64_t h = 0; h <= mask; ++h) {
+      int32_t r = slot_state[h];
+      if (r < 0) continue;
+      if (slot_keys[h] % mod != res) {
+        slot_state[h] = kTombstone;
+        row_alive[r] = 0;
+        free_rows.push_back(r);
+        --used;
+        ++erased;
+      }
+    }
+    return erased;
+  }
+
   // full-row layout helpers (save/export/import share one definition;
   // layout: slot, unseen, delta_score, show, click, embed_w,
   // embed_state[es], has_embedx, embedx_w[xd], embedx_state[xs])
@@ -817,6 +837,29 @@ inline uint64_t table_digest(NativeTable* t) {
     for (uint64_t hh = 0; hh <= sh->mask; ++hh) {
       int32_t r = sh->slot_state[hh];
       if (r < 0) continue;
+      sh->export_row(r, row.data());
+      dg += row_hash(sh->slot_keys[hh], row.data(), fd);
+    }
+  }
+  return dg;
+}
+
+// Digest restricted to one (modulus, residue) key class — the reshard
+// verification primitive (ps/reshard.py): the digest is a wrapping SUM
+// of per-row hashes, so digest(all) == digest(class A) + digest(class
+// B) for any partition, and "no row lost or doubled" across a
+// migration is an O(1) equality over these filtered sums.
+inline uint64_t table_digest_filtered(NativeTable* t, uint64_t mod,
+                                      uint64_t res) {
+  int32_t fd = table_full_dim(t);
+  std::vector<float> row(fd);
+  uint64_t dg = 0;
+  for (Shard* sh : t->shards) {
+    std::lock_guard<std::mutex> g(sh->mu);  // LOCK: shard_mu
+    for (uint64_t hh = 0; hh <= sh->mask; ++hh) {
+      int32_t r = sh->slot_state[hh];
+      if (r < 0) continue;
+      if (sh->slot_keys[hh] % mod != res) continue;
       sh->export_row(r, row.data());
       dg += row_hash(sh->slot_keys[hh], row.data(), fd);
     }

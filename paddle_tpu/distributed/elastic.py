@@ -25,7 +25,23 @@ import urllib.parse
 from typing import Callable, Dict, List, Optional
 
 __all__ = ["ElasticStatus", "ElasticManager", "MemoryStore", "FileStore",
-           "TcpElasticStore", "store_from_spec", "Lease"]
+           "TcpElasticStore", "store_from_spec", "Lease",
+           "set_desired_np", "desired_np_key"]
+
+
+def desired_np_key(job_id: str) -> str:
+    return f"elastic/{job_id}/desired_np"
+
+
+def set_desired_np(store, job_id: str, np_: int) -> None:
+    """Publish a TARGET trainer world size for ``job_id`` — the
+    autoscaler's trainer-count lever (ps/autoscale.py). Every node's
+    :class:`ElasticManager` adopts the target on its next watch tick
+    (clamped to its own [min_np, max_np]) and the normal quorum
+    machinery turns the mismatch into HOLD/RESTART decisions the
+    launcher acts on — scaling trainers IS a restart in the reference
+    model (manager.py:465), so the store key is the whole interface."""
+    store.put(desired_np_key(job_id), json.dumps({"np": int(np_)}))
 
 
 class ElasticStatus(enum.Enum):   # manager.py:53
@@ -290,7 +306,33 @@ class ElasticManager:
 
     # -- decision (watch loop; manager.py:439-532) -------------------------
 
+    def desired_np(self) -> Optional[int]:
+        """The published target world size (``set_desired_np``), or
+        None when no autoscaler has spoken."""
+        raw = self.store.get(desired_np_key(self.job_id))
+        if raw is None:
+            return None
+        try:
+            return int(json.loads(raw).get("np"))
+        except (ValueError, TypeError):
+            return None
+
+    def adopt_desired_np(self) -> bool:
+        """Clamp-and-adopt the published target into ``self.np`` so the
+        quorum check below compares live hosts against the
+        AUTOSCALER'S world, not the launch-time announcement. Returns
+        True when the announced size changed."""
+        want = self.desired_np()
+        if want is None:
+            return False
+        want = max(self.min_np, min(int(want), self.max_np))
+        if want == self.np:
+            return False
+        self.np = want
+        return True
+
     def watch_once(self) -> ElasticStatus:
+        self.adopt_desired_np()
         hosts = self.alive_hosts()
         n = len(hosts)
         if hosts != self._known:

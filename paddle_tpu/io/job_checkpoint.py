@@ -319,6 +319,15 @@ class JobCheckpointManager:
         with gate:
             tables = {}
             for name, t in self._tables.items():
+                # live reshard (ps/reshard.py): a capture client that
+                # only READS never trips the key-ownership fence, so it
+                # must re-resolve the topology explicitly — under the
+                # gate, whose control_mu pins the routing doc — or a
+                # post-cutover capture would snapshot the OLD server
+                # set and silently miss every migrated row
+                refresh = getattr(t, "refresh_routing", None)
+                if refresh is not None:
+                    refresh()
                 keys, values = t.snapshot_items(0)
                 # digest under the gate: the same cut the arrays came
                 # from (native-fast; the python mirror is row_digest)

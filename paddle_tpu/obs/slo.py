@@ -147,7 +147,8 @@ class SloWatchdog:
 
     def __init__(self, ring: MetricRing,
                  rules: Sequence[SloRule] = (),
-                 log_cap: int = 512) -> None:
+                 log_cap: int = 512,
+                 period_s: float = 1.0) -> None:
         self.ring = ring
         self.rules: List[SloRule] = []
         self._handles: Dict[str, Tuple[Any, Any]] = {}
@@ -156,9 +157,44 @@ class SloWatchdog:
         self._log: deque = deque(maxlen=int(log_cap))
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        #: own-thread evaluation cadence (start() default) — a
+        #: constructor knob, not a buried literal (injectable-clock
+        #: lint rule); sampler-attached watchdogs never use it
+        self.period_s = float(period_s)
         self.evaluations = 0
+        # push-style subscriptions (the controller input for
+        # ps/autoscale.py): fire/clear transition callbacks, invoked
+        # OUTSIDE the watchdog lock — the flight-recorder hook
+        # contract: a subscriber that blocks (or reshards a cluster)
+        # must never serialize rule evaluation behind itself
+        self._on_fire: List[Any] = []
+        self._on_clear: List[Any] = []
+        self.subscriber_errors = 0
         for r in rules:
             self.add_rule(r)
+
+    def on_fire(self, fn) -> "SloWatchdog":
+        """Subscribe to rule FIRE transitions: ``fn(alert)`` runs on
+        the evaluating thread, outside the lock, once per transition
+        (an already-active rule does not re-notify). Subscriber
+        exceptions are counted (``subscriber_errors``) and swallowed —
+        a broken controller must not kill the watchdog."""
+        self._on_fire.append(fn)
+        return self
+
+    def on_clear(self, fn) -> "SloWatchdog":
+        """Subscribe to rule CLEAR transitions: ``fn(alert)`` with the
+        original alert (``cleared_t`` now set). Same contract as
+        :meth:`on_fire`."""
+        self._on_clear.append(fn)
+        return self
+
+    def _notify(self, subs: List[Any], alert: Alert) -> None:
+        for fn in list(subs):
+            try:
+                fn(alert)
+            except Exception:  # noqa: BLE001 — subscriber owns its errors
+                self.subscriber_errors += 1
 
     def add_rule(self, rule: SloRule) -> "SloWatchdog":
         with self._mu:
@@ -185,6 +221,7 @@ class SloWatchdog:
         for rule in rules:
             fires, detail = rule.evaluate(self.ring, now)
             counter, gauge = self._handles[rule.name]
+            fired_alert = cleared_alert = None
             with self._mu:
                 active = self._active.get(rule.name)
                 if fires and active is None:
@@ -195,17 +232,23 @@ class SloWatchdog:
                     self._active[rule.name] = alert
                     self._log.append(alert)
                     fired.append(alert)
+                    fired_alert = alert
                 elif not fires and active is not None:
                     active.cleared_t = now
                     del self._active[rule.name]
-            if fires and any(a.rule == rule.name for a in fired):
+                    cleared_alert = active
+            # transitions notify OUTSIDE _mu (flight-recorder contract)
+            if fired_alert is not None:
                 counter.inc()
                 gauge.set(1.0)
                 _flightrec.notify("slo_alert", rule=rule.name,
                                   family=rule.family, windows=detail,
                                   threshold=rule.threshold)
+                self._notify(self._on_fire, fired_alert)
             elif not fires:
                 gauge.set(0.0)
+                if cleared_alert is not None:
+                    self._notify(self._on_clear, cleared_alert)
         return fired
 
     # -- introspection -----------------------------------------------------
@@ -226,14 +269,15 @@ class SloWatchdog:
         sampler.on_sample(lambda t: self.evaluate(now=t))
         return self
 
-    def start(self, period_s: float = 1.0) -> "SloWatchdog":
+    def start(self, period_s: Optional[float] = None) -> "SloWatchdog":
         """Own evaluation thread, for rings fed by something other than
-        a local sampler."""
+        a local sampler. ``period_s`` defaults to the constructor's."""
+        period = self.period_s if period_s is None else float(period_s)
         if self._thread is None:
             self._stop.clear()
 
             def loop() -> None:
-                while not self._stop.wait(period_s):
+                while not self._stop.wait(period):
                     self.evaluate()
 
             self._thread = threading.Thread(target=loop, daemon=True,

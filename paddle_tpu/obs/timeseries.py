@@ -289,6 +289,16 @@ class MetricRing:
                 good += n * (threshold - lo) / max(hi - lo, 1e-12)
         return max(0.0, 1.0 - good / total), total
 
+    def last_value(self, family: str, field: str = "value",
+                   labels: Optional[Dict[str, str]] = None,
+                   reduce: str = "max") -> Optional[float]:
+        """The newest tick's reduced value of one curve (None when the
+        family has no samples yet) — the cheap point probe controllers
+        use between full window evaluations (ps/autoscale.py reads
+        step-time p95 / wire-byte rates this way)."""
+        s = self.series(family, field, labels, reduce)
+        return s[-1][1] if s else None
+
     def window_values(self, family: str, field: str, window_s: float,
                       labels: Optional[Dict[str, str]] = None,
                       reduce: str = "sum",

@@ -69,9 +69,14 @@ class CommunicatorConfig:
 
 
 class _BaseCommunicator:
-    def __init__(self, client: PSClient, config: Optional[CommunicatorConfig] = None) -> None:
+    def __init__(self, client: PSClient,
+                 config: Optional[CommunicatorConfig] = None,
+                 idle_s: float = 0.002) -> None:
         self.client = client
         self.config = config or CommunicatorConfig()
+        #: merge-loop idle backoff (constructor-injectable — the
+        #: uninjectable-clock lint contract for thread control loops)
+        self.idle_s = float(idle_s)
         self._queues: Dict[int, "queue.Queue"] = {}
         self._running = False
         self._thread: Optional[threading.Thread] = None
@@ -273,7 +278,7 @@ class _BaseCommunicator:
         while self._running:
             try:
                 if not self._drain_once():
-                    time.sleep(0.002)
+                    time.sleep(self.idle_s)
             except BaseException as e:  # noqa: BLE001 — surfaced at barrier
                 self._error = e
                 self._push_thread_dead = True

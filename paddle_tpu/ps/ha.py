@@ -53,6 +53,7 @@ RAM-table-only (the runbook's restore flow covers SSD).
 from __future__ import annotations
 
 import json
+import random
 import struct
 import threading
 import time
@@ -270,8 +271,18 @@ class HARouter:
                  failures: Optional[int] = None,
                  cooldown_s: Optional[float] = None,
                  failover_timeout_s: Optional[float] = None,
-                 poll_s: float = 0.02, qos: str = "train") -> None:
+                 poll_s: float = 0.02, qos: str = "train",
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep,
+                 jitter_seed: Optional[int] = None) -> None:
         self.routing_table = RoutingTable(store, job_id)
+        # injectable timing (uninjectable-clock lint rule): tests drive
+        # wait_for_primary deterministically; the jitter stream is
+        # seedable so its sequence is pinnable too
+        self._clock = clock
+        self._sleep = sleep
+        self._jitter = random.Random(jitter_seed if jitter_seed is not None
+                                     else id(self) & 0xFFFFFFFF)
         enforce(qos in ("train", "serve"),
                 f"HARouter qos must be 'train' or 'serve', got {qos!r}")
         #: QoS class: a "serve" router defaults its breaker thresholds
@@ -315,20 +326,41 @@ class HARouter:
         self.breaker(endpoint).record(ok)
 
     def failover(self, shard: int, bad_endpoint: str) -> Optional[str]:
-        """Block until the routing table names a primary for ``shard``
-        other than ``bad_endpoint`` (the coordinator needs lease-expiry
-        + grace to notice the death); None when the timeout passes with
-        no promotion — the caller re-raises its transport error."""
-        deadline = time.monotonic() + self.failover_timeout_s
+        """Block until a primary other than ``bad_endpoint`` is
+        published for ``shard`` (the ``_shard_op`` replay path); None
+        when the timeout passes with no promotion — the caller
+        re-raises its transport error."""
+        return self.wait_for_primary(shard, bad_endpoint)
+
+    def wait_for_primary(self, shard: int,
+                         bad_endpoint: Optional[str] = None,
+                         timeout_s: Optional[float] = None) -> Optional[str]:
+        """Poll the routing table until it names a primary for
+        ``shard`` (optionally one OTHER than ``bad_endpoint``), with
+        exponential backoff plus per-router jitter. The backoff alone
+        is not enough at scale: a 4→8-shard cutover (or a promotion)
+        makes EVERY client re-resolve at the same instant, and
+        identical backoff schedules keep them polling the shared
+        elastic store in lockstep — the same thundering herd the
+        sleep-no-backoff lint rule exists for, one level up. The jitter
+        stream is seeded per router (``jitter_seed``) and the
+        clock/sleep pair is constructor-injectable, so tests pin the
+        exact schedule (the injectable-clock pattern)."""
+        deadline = self._clock() + (timeout_s if timeout_s is not None
+                                    else self.failover_timeout_s)
         wait = self.poll_s
         while True:
             _, eps = self.routing()
             ep = eps[shard] if shard < len(eps) else None
             if ep and ep != bad_endpoint:
                 return ep
-            if time.monotonic() >= deadline:
+            now = self._clock()
+            if now >= deadline:
                 return None
-            time.sleep(wait)
+            # jittered backoff in [0.5, 1.5)·wait, clipped to the
+            # remaining budget so the deadline stays honest
+            self._sleep(min(wait * (0.5 + self._jitter.random()),
+                            max(deadline - now, 0.0)))
             wait = min(wait * 2, 0.25)  # backoff: the store is shared
 
 
@@ -352,7 +384,9 @@ class ReplicationManager:
 
     def __init__(self, server: NativePsServer, endpoint: str, shard: int,
                  routing: RoutingTable, sync: bool = False,
-                 oplog_cap: Optional[int] = None, epoch: int = 0) -> None:
+                 oplog_cap: Optional[int] = None, epoch: int = 0,
+                 route_poll_s: float = 0.1, pop_timeout_ms: int = 50,
+                 clock: Callable[[], float] = time.monotonic) -> None:
         self.server = server
         self.endpoint = endpoint
         self.shard = shard
@@ -360,12 +394,19 @@ class ReplicationManager:
         self.sync = sync
         self.epoch = int(epoch)
         self.fenced = False
+        # injectable timing (uninjectable-clock lint rule): the shipper
+        # loop's routing-poll cadence and ring-pop timeout are
+        # constructor knobs, not buried literals
+        self._route_poll_s = float(route_poll_s)
+        self._pop_timeout_ms = int(pop_timeout_ms)
+        self._clock = clock
         self._cap = (oplog_cap if oplog_cap is not None
                      else int(flag("ps_ha_oplog_cap")))
         self._backups: Dict[str, dict] = {}  # ep -> {conn, acked}
         self._mu = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._bg_syncs: List[threading.Thread] = []
         self._self_conn = None
         self._last_route_poll = 0.0
         # per-backup lag gauges bind lazily at first export (backups
@@ -387,6 +428,13 @@ class ReplicationManager:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=10)
+        # background migrate syncs must not outlive us: a straggler
+        # still pausing/snapshotting would touch the server handle
+        # after the owner destroys it (use-after-free). The server's
+        # request_stop wakes any gate wait, so these joins are bounded.
+        for t in self._bg_syncs:
+            t.join(timeout=10)
+        self._bg_syncs.clear()
         with self._mu:
             for st in self._backups.values():
                 st["conn"].close()
@@ -410,8 +458,19 @@ class ReplicationManager:
     def export_metrics(self) -> None:
         """Sampler probe (obs/timeseries.py): publish the per-backup
         acked-cursor gap as ``ps_replication_lag_entries`` gauges — the
-        replication-lag curve the SLO watchdog's rule reads."""
+        replication-lag curve the SLO watchdog's rule reads. MIGRATE
+        subscribers (reshard bootstrap targets) are excluded like they
+        are from :meth:`drain`: their cursor legitimately trails by the
+        whole history mid-copy, and ``replication_lag`` is a stock
+        autoscaler up-rule — counting the bootstrap's own lag would
+        fire the alert that triggers MORE scaling (positive feedback
+        to max_shards)."""
+        with self._mu:
+            migrate_eps = {ep for ep, st in self._backups.items()
+                           if st.get("migrate")}
         lg = self.lag()
+        lg["acked"] = {ep: a for ep, a in lg["acked"].items()
+                       if ep not in migrate_eps}
         # bulk-bind new backups' gauges (comprehension = the sanctioned
         # cold-bind idiom); the loop below only sets pre-bound handles
         self._lag_gauges.update({
@@ -431,23 +490,32 @@ class ReplicationManager:
 
     def drain(self, timeout: float = 30.0) -> None:
         """Sync-replication barrier: block until every attached backup
-        has acked the newest oplog seq (primary ≡ backup for every op
-        that happened before the call)."""
+        AND plain observer has acked the newest oplog seq (primary ≡
+        backup for every op that happened before the call). MIGRATE
+        subscribers (reshard bootstrap targets, ps/reshard.py) are
+        excluded: their catch-up inserts land on a server that may
+        itself be behind a checkpoint gate — a drain that waited on
+        them could deadlock against the very gate that called it (the
+        reshard cutover runs its own targeted drain instead)."""
         deadline = time.monotonic() + timeout
         while True:
-            lg = self.lag()
-            if not self.fenced and lg["pending"] == 0 and all(
-                    a >= lg["seq"] for a in lg["acked"].values()):
+            with self._mu:
+                acked = {ep: st["acked"] for ep, st in self._backups.items()
+                         if not st.get("migrate")}
+            seq = self.server.oplog_seq()
+            if not self.fenced and self.server.oplog_pending() == 0 and \
+                    all(a >= seq for a in acked.values()):
                 return
             enforce(time.monotonic() < deadline,
-                    f"replication drain timed out: {lg}")
+                    f"replication drain timed out: seq {seq}, "
+                    f"acked {acked}")
             time.sleep(0.005)
 
     # -- shipper ----------------------------------------------------------
 
     def _poll_routing(self) -> None:
-        now = time.monotonic()
-        if now - self._last_route_poll < 0.1:
+        now = self._clock()
+        if now - self._last_route_poll < self._route_poll_s:
             return
         self._last_route_poll = now
         epoch, shards = self.routing.read()
@@ -463,24 +531,35 @@ class ReplicationManager:
         # the SAME ship/snapshot/fence machinery as backups — the oplog
         # as a change feed — but never appear in the routing document,
         # so the coordinator cannot promote one and a crashed replica
-        # detaches by lease expiry on the next poll.
+        # detaches by lease expiry on the next poll. A registration
+        # whose value carries {"mode": "migrate"} is a RESHARD target
+        # (ps/reshard.py): it bootstraps sparse tables only — no dense
+        # snapshot, no global-step top-up — because it is (or feeds) a
+        # LIVE server with its own dense state, not a fresh backup.
         pref = _obs_prefix(self.routing.job_id, self.shard)
-        for key in self.routing.store.list_prefix(pref):
+        migrate = set()
+        for key, val in self.routing.store.list_prefix(pref).items():
             ep = key[len(pref):]
-            if ep != self.endpoint and ep not in want:
-                want.append(ep)
+            if ep == self.endpoint or ep in want:
+                continue
+            want.append(ep)
+            try:
+                if val and json.loads(val).get("mode") == "migrate":
+                    migrate.add(ep)
+            except (ValueError, AttributeError):
+                pass  # legacy/foreign registration value: plain observer
         with self._mu:
             have = set(self._backups)
         for ep in want:
             if ep not in have:
-                self._attach(ep)
+                self._attach(ep, migrate=ep in migrate)
         for ep in have - set(want):
             with self._mu:
                 st = self._backups.pop(ep, None)
             if st is not None:
                 st["conn"].close()
 
-    def _attach(self, ep: str) -> None:
+    def _attach(self, ep: str, migrate: bool = False) -> None:
         """Adopt ``ep`` as a backup: read its applied_seq AND epoch and
         let the gap logic decide between ring tail and full snapshot."""
         try:
@@ -516,15 +595,29 @@ class ReplicationManager:
             # ship; force the snapshot path, which rebases it into our
             # seq space
             applied = -1
+        if migrate:
+            # a reshard-migration target NEVER takes the from-birth
+            # ring tail: it is a LIVE server (or a fresh one about to
+            # own a subset), and our ring's chained history contains
+            # frames that are poison out of context — the full-copy
+            # kInsertFull of OUR bootstrap (stale values that would
+            # overwrite the target's fresher rows) and past kRetain
+            # ownership frames (which would erase the target's own key
+            # classes wholesale). Force the snapshot path: it copies
+            # CURRENT rows only and rebases the cursor past the whole
+            # history.
+            applied = -1
         with self._mu:
-            self._backups[ep] = {"conn": conn, "acked": applied}
+            self._backups[ep] = {"conn": conn, "acked": applied,
+                                 "migrate": migrate}
 
     def _loop(self) -> None:
         while not self._stop.is_set():
             self._poll_routing()
             if self.fenced:
                 return
-            seq, frame = self.server.oplog_next(timeout_ms=50)
+            seq, frame = self.server.oplog_next(
+                timeout_ms=self._pop_timeout_ms)
             if seq == -2:
                 return  # server stopped
             if seq == -1:
@@ -535,6 +628,37 @@ class ReplicationManager:
                 continue
             self._ship(seq, frame)
 
+    def _sync_migrate_bg(self, ep: str, st: dict) -> None:
+        """Run a MIGRATE target's full_sync on its own thread. The
+        shipper must never block behind one: the target is a LIVE
+        routed server whose mutation gate a concurrent job-checkpoint
+        capture may be holding — a shipper stuck on that gate starves
+        the shard's own backups, and the capture's sync drain waits on
+        exactly those backups (gate → backup → shipper → gate: a
+        deadlock resolved only by timeouts). While ``syncing`` the
+        shipper skips this cursor; the snapshot rebase covers whatever
+        lands meanwhile."""
+        st["syncing"] = True
+
+        def run():
+            try:
+                with self._mu:
+                    if self._backups.get(ep) is not st:
+                        return  # detached while queued: nothing to sync
+                if self._stop.is_set():
+                    return
+                self._full_sync(ep, st)
+            finally:
+                st["syncing"] = False
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"ps-migrate:{self.shard}->{ep}")
+        # prune finished stragglers so a long-lived shipper doesn't
+        # accumulate thread handles across many reshard cycles
+        self._bg_syncs = [x for x in self._bg_syncs if x.is_alive()]
+        self._bg_syncs.append(t)
+        t.start()
+
     def _catch_up_idle(self) -> None:
         if self.server.oplog_pending() != 0:
             return  # the ring tail will cover the lag — no snapshot
@@ -543,19 +667,29 @@ class ReplicationManager:
             lagging = [(ep, st) for ep, st in self._backups.items()
                        if st["acked"] < top]
         for ep, st in lagging:
-            self._full_sync(ep, st)
+            if st.get("syncing"):
+                continue  # background migrate sync owns this cursor
+            if st.get("migrate"):
+                self._sync_migrate_bg(ep, st)
+            else:
+                self._full_sync(ep, st)
 
     def _ship(self, seq: int, frame: bytes) -> None:
         with self._mu:
             backups = list(self._backups.items())
         for ep, st in backups:
+            if st.get("syncing"):
+                continue  # background migrate sync owns this cursor
             if st["acked"] >= seq:
                 continue  # snapshot rebase already covers this entry
             if st["acked"] + 1 != seq:
                 # ring dropped entries before this backup consumed them
                 # (overflow or late attach): full snapshot, then the
                 # rebase makes this frame redundant
-                self._full_sync(ep, st)
+                if st.get("migrate"):
+                    self._sync_migrate_bg(ep, st)
+                else:
+                    self._full_sync(ep, st)
                 continue
             try:
                 status = send_replicate(st["conn"], frame, seq, self.epoch,
@@ -595,9 +729,13 @@ class ReplicationManager:
         return sparse, dense, geo
 
     def _self(self):
-        if self._self_conn is None:
-            self._self_conn = make_conn(self.endpoint)
-        return self._self_conn
+        # under _mu: the shipper's full_sync and a background migrate
+        # sync (_sync_migrate_bg) may race the lazy connect; the conn
+        # itself serializes concurrent calls internally
+        with self._mu:
+            if self._self_conn is None:
+                self._self_conn = make_conn(self.endpoint)
+            return self._self_conn
 
     def _full_sync(self, ep: str, st: dict) -> None:
         """Snapshot+rebase one backup. Mutations pause for the duration
@@ -619,6 +757,32 @@ class ReplicationManager:
                     return
                 enforce(status >= 0,
                         f"catalog replay to {ep} failed with {status}")
+            # 1b. ownership predicate (live resharding, ps/reshard.py):
+            # rows alone are not the replicated state — a backup
+            # attached AFTER a reshard must carry the primary's
+            # key-ownership fence too, or its later promotion would
+            # silently ACCEPT stale-topology traffic instead of
+            # bouncing it (phantom rows for classes that moved away).
+            # Shipped replicate-wrapped (seq -1, like the catalog) so
+            # read-only serving observers accept it; MIGRATE targets
+            # are skipped — the controller installs their predicate at
+            # cutover, and the source's predicate would erase the very
+            # classes they exist to receive.
+            if not st.get("migrate"):
+                _, own_resp = self._self().check(_rpc._RETAIN, n=0,
+                                                 retries=0)
+                own = np.frombuffer(own_resp, np.int64)
+                if int(own[0]) > 0:
+                    frame = _HDR.pack(0, _rpc._RETAIN, 0, int(own[0]),
+                                      int(own[1]), 0, 0)
+                    status = send_replicate(conn, frame, -1, self.epoch,
+                                            retries=0)
+                    if status == _rpc_err_stale_epoch:
+                        self.fenced = True
+                        return
+                    enforce(status >= 0,
+                            f"ownership replay to {ep} failed with "
+                            f"{status}")
             cut = self.server.oplog_seq()
             sparse, dense, _ = self._catalog_tables()
             me = self._self()
@@ -640,17 +804,24 @@ class ReplicationManager:
                     conn.check(_rpc._INSERT_FULL, tid, n=len(kp),
                                payload=(kp, vp),
                                timeout_ms=_rpc._long_ms(), retries=0)
-            # 3. dense tables: full state incl. optimizer moments + step
-            for tid in dense:
-                _, blob = me.check(_rpc._DENSE_SNAP, tid,
-                                   timeout_ms=_rpc._long_ms(), retries=0)
-                conn.check(_rpc._DENSE_RESTORE, tid, payload=bytes(blob),
-                           timeout_ms=_rpc._long_ms(), retries=0)
-            # 4. the shared step counter: top the backup's up to ours
-            cur_p, _ = me.check(_rpc._GLOBAL_STEP, n=0, retries=0)
-            cur_b, _ = conn.check(_rpc._GLOBAL_STEP, n=0, retries=0)
-            if cur_p != cur_b:
-                conn.check(_rpc._GLOBAL_STEP, n=cur_p - cur_b, retries=0)
+            # 3+4. dense tables (full state incl. optimizer moments +
+            # step) and the shared step counter — SKIPPED for a
+            # reshard-migration target (ps/reshard.py): that subscriber
+            # is (or feeds) a LIVE server with its own dense state and
+            # step; a fresh backup copies both. NB the step top-up is a
+            # DELTA (cur_p - cur_b) and would go negative against a
+            # target that out-counts this primary — exactly the
+            # migration case, never the fresh-backup case.
+            if not st.get("migrate"):
+                for tid in dense:
+                    _, blob = me.check(_rpc._DENSE_SNAP, tid,
+                                       timeout_ms=_rpc._long_ms(), retries=0)
+                    conn.check(_rpc._DENSE_RESTORE, tid, payload=bytes(blob),
+                               timeout_ms=_rpc._long_ms(), retries=0)
+                cur_p, _ = me.check(_rpc._GLOBAL_STEP, n=0, retries=0)
+                cur_b, _ = conn.check(_rpc._GLOBAL_STEP, n=0, retries=0)
+                if cur_p != cur_b:
+                    conn.check(_rpc._GLOBAL_STEP, n=cur_p - cur_b, retries=0)
             # 5. rebase: the backup now holds everything up to `cut`
             conn.check(_rpc._REPL_STATE, n=cut, retries=0)
             st["acked"] = cut
@@ -727,14 +898,29 @@ class CheckpointGate:
     def _targets(self) -> list:
         if self.servers is not None:
             return self.servers
+        # the ROUTED topology, not cluster.num_shards: mid-reshard the
+        # cluster may carry spawned-but-unrouted shard rows (bootstrap
+        # targets) that the capture client cannot see and the gate must
+        # not try to resolve — control_mu pins the doc while held
+        _, shards = self.cluster.routing.read()
         return [self.cluster.primary(si).server
-                for si in range(self.cluster.num_shards)]
+                for si in range(len(shards))]
 
     def __enter__(self) -> "CheckpointGate":
-        targets = self._targets()
+        self._locked = False
+        if self.cluster is not None:
+            # serialize against a reshard cutover (cluster.control_mu):
+            # the depth-counted pauses NEST fine, but a capture
+            # interleaved with the cutover's retain would snapshot a
+            # half-migrated key set — rows already dropped from the
+            # source shard while this capture's client still routes to
+            # it. Taking the mutex ALSO pins the shard set for the
+            # whole `with gate:` block (targets can't move mid-capture)
+            self.cluster.control_mu.acquire()
+            self._locked = True
         paused = []
         try:
-            for srv in targets:
+            for srv in self._targets():
                 srv.pause_mutations(True)
                 paused.append(srv)
             if self.drain and self.cluster is not None and self.cluster.sync:
@@ -745,6 +931,9 @@ class CheckpointGate:
         except BaseException:
             for srv in reversed(paused):
                 srv.pause_mutations(False)
+            if self._locked:
+                self._locked = False
+                self.cluster.control_mu.release()
             raise
         self._paused = paused
         return self
@@ -753,6 +942,9 @@ class CheckpointGate:
         paused, self._paused = self._paused, []
         for srv in reversed(paused):
             srv.pause_mutations(False)
+        if getattr(self, "_locked", False):
+            self._locked = False
+            self.cluster.control_mu.release()
 
 
 # ---------------------------------------------------------------------------
@@ -891,7 +1083,9 @@ class FailoverCoordinator:
         self.promotions = 0
         self._missing_since: Dict[str, float] = {}
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._suspended = threading.Event()
+        self._step_mu = threading.Lock()  # one scan at a time; suspend()
+        self._thread: Optional[threading.Thread] = None  # barriers on it
         # obs: promotions are a job-wide counter (the watchdog's
         # failover rule) AND a flight-recorder trigger
         self._c_promotions = _obs_registry.REGISTRY.counter(
@@ -920,6 +1114,17 @@ class FailoverCoordinator:
     def step(self) -> int:
         """One scan; returns promotions performed (exposed for
         deterministic unit tests — the thread just loops this)."""
+        with self._step_mu:
+            # re-check UNDER the lock: the loop's unlocked check can
+            # pass just before suspend() sets the event and takes the
+            # barrier — without this, that scan would read the
+            # pre-cutover routing doc and publish it back over the
+            # reshard's flip (suspend()'s whole point is ONE writer)
+            if self._suspended.is_set():
+                return 0
+            return self._step_locked()
+
+    def _step_locked(self) -> int:
         epoch, shards = self.routing.read()
         if not shards:
             return 0
@@ -981,8 +1186,24 @@ class FailoverCoordinator:
         self._thread.start()
         return self
 
+    def suspend(self) -> None:
+        """Pause scans (no promotions, no publishes). The routing table
+        has ONE writer; a reshard cutover (ps/reshard.py) must briefly
+        become that writer — a scan racing its publish could clobber
+        the flipped document with a stale read-modify-write. The
+        suspension window is the (ms-scale) cutover, not the
+        bootstrap; call :meth:`resume_scans` right after."""
+        self._suspended.set()
+        with self._step_mu:
+            pass  # barrier: any in-flight scan finishes before we return
+
+    def resume_scans(self) -> None:
+        self._suspended.clear()
+
     def _loop(self) -> None:
         while not self._stop.wait(self.poll_s):
+            if self._suspended.is_set():
+                continue
             try:
                 self.step()
             except PreconditionNotMetError:
@@ -1014,7 +1235,6 @@ class HACluster:
                  coordinator_poll_s: float = 0.05) -> None:
         self.store = store if store is not None else MemoryStore()
         self.job_id = job_id
-        self.num_shards = num_shards
         self.replication = (replication if replication is not None
                             else int(flag("ps_replication_factor")))
         self.sync = sync
@@ -1023,6 +1243,14 @@ class HACluster:
         self._n_trainers = n_trainers
         self._hb_interval = hb_interval
         self._hb_ttl = hb_ttl
+        #: single-owner control-plane mutex: a reshard CUTOVER
+        #: (ps/reshard.py) and a job-checkpoint capture (CheckpointGate)
+        #: both pause primaries — the depth-counted gates nest fine, but
+        #: a capture interleaved with the cutover's retain step would
+        #: snapshot a half-migrated key set (rows already dropped from
+        #: the source while the capture client still routes to it).
+        #: RLock: a holder's nested gate may re-acquire.
+        self.control_mu = threading.RLock()
         shards_doc = []
         for si in range(num_shards):
             replicas = [HAServer(self.store, job_id, si,
@@ -1043,6 +1271,42 @@ class HACluster:
         self._clients: List[RpcPsClient] = []
 
     # -- topology accessors ----------------------------------------------
+
+    @property
+    def num_shards(self) -> int:
+        """Live shard count — DYNAMIC: a reshard (ps/reshard.py) grows
+        and shrinks ``self.servers`` at cutover."""
+        return len(self.servers)
+
+    def spawn_shard(self, shard: int,
+                    replication: Optional[int] = None) -> List[HAServer]:
+        """Bring up one NEW shard row (a full replica set) OUTSIDE the
+        routing table — the reshard grow path's raw material: the
+        servers heartbeat leases but own no keys and take no traffic
+        until the cutover publishes their routing entry."""
+        n = replication if replication is not None else self.replication
+        enforce(shard == len(self.servers),
+                f"spawn_shard({shard}): shards are routing positions — "
+                f"the next new row is {len(self.servers)}")
+        row = [HAServer(self.store, self.job_id, shard,
+                        n_trainers=self._n_trainers, sync=self.sync,
+                        hb_interval=self._hb_interval, hb_ttl=self._hb_ttl)
+               for _ in range(n)]
+        self.servers.append(row)
+        for r in row:
+            r.start()
+        return row
+
+    def retire_shard(self, shard: int) -> List[HAServer]:
+        """Drop the TRAILING shard row from the topology (post-shrink
+        cutover): the row leaves ``self.servers`` immediately; stopping
+        the (fenced, lame-duck) servers is the caller's job once stale
+        clients have re-resolved. Returns the removed row."""
+        enforce(shard == len(self.servers) - 1,
+                f"retire_shard({shard}): only the trailing shard "
+                f"({len(self.servers) - 1}) can retire — shard indices "
+                "are routing positions")
+        return self.servers.pop()
 
     def replica(self, shard: int, endpoint: str) -> HAServer:
         for r in self.servers[shard]:
@@ -1136,9 +1400,14 @@ class HACluster:
         tick), so a drain right after bring-up or a promotion is safe —
         an unattached backup must not vacuously pass the barrier."""
         deadline = time.monotonic() + timeout
-        for si in range(self.num_shards):
+        # drain the ROUTED shards (mid-reshard the server list may be
+        # wider than the routing doc: bootstrap targets drain through
+        # their source's shipper, not as shards of their own yet)
+        for si in range(len(self.routing.read()[1])):
             while True:
                 _, shards = self.routing.read()
+                if si >= len(shards):
+                    break  # a concurrent shrink retired this index
                 sh = shards[si]
                 prim = self.replica(si, sh["primary"])
                 alive = {ep for ep in sh.get("backups", [])

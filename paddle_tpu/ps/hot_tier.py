@@ -191,7 +191,7 @@ class HotEmbeddingTier:
         self.counters = CounterGroup(
             "hot_tier_events",
             ("hits", "misses", "evictions", "writebacks", "cold_fetches",
-             "flushes"),
+             "flushes", "reshards"),
             max_series=1024, tier=str(next(_TIER_SEQ)))
 
     def _reset_resident_set(self) -> None:
@@ -455,6 +455,25 @@ class HotEmbeddingTier:
         path: the cold store was just rebuilt from a checkpoint — the
         tier refills on miss)."""
         self._reset_resident_set()
+
+    def on_reshard(self, plan=None) -> int:
+        """Live-reshard hook (ps/reshard.py ``on_pre_cutover`` /
+        CtrStreamTrainer.on_reshard): flush dirty resident rows and
+        KEEP the resident set — the opposite of :meth:`drop`.
+
+        Residency is keyed by feasign, not by PS shard, so a topology
+        flip moves nothing in HBM: rows whose key class migrated simply
+        have a different cold home, and the tier's writebacks/misses
+        reach it through the client's re-resolved routing. The flush
+        matters for FRESHNESS, not correctness — a dirty resident row's
+        training lands in the cold store BEFORE the migration drains,
+        so the moved copy (and any serving replica subscribed to the
+        new shard) carries it instead of waiting for the row's next
+        eviction. Call from the TRAINING thread (a batch boundary), the
+        same contract as :meth:`flush`. Returns rows flushed."""
+        n = self.flush()
+        self.counters["reshards"] += 1
+        return n
 
     def invalidate(self, keys: np.ndarray) -> int:
         """Forget just these keys' resident rows so the next ensure()

@@ -617,6 +617,29 @@ class CtrStreamTrainer:
         deterministic given the pulled rows)."""
         return {"state": self.params, "opt": self.opt_state}
 
+    # -- live-reshard surface (ps/reshard.py) -----------------------------
+
+    def on_reshard(self) -> None:
+        """Trainer-side reshard participation, called from the TRAINING
+        thread at a batch boundary (tests/demos; a production loop
+        wires it to the controller's journal or an operator signal).
+        Strictly optional — the data plane self-corrects either way
+        (misrouted ops bounce and replay) — but it tightens the window:
+        the communicator quiesces (no queued push straddles the
+        cutover), the hot tier flushes dirty residents WITHOUT dropping
+        the resident set (HotEmbeddingTier.on_reshard — warm hit rate
+        survives the topology flip), and the client re-resolves the
+        routing table proactively instead of paying one bounced op."""
+        if self.communicator is not None:
+            self.communicator.quiesce()
+        if self.hot_tier is not None:
+            self.hot_tier.on_reshard()
+        if self.communicator is not None:
+            refresh = getattr(self.communicator.client, "refresh_routing",
+                              None)
+            if refresh is not None:
+                refresh()
+
     def restore_train_state(self, dense: Dict[str, Any]) -> None:
         """Inverse of :meth:`train_state` — accepts the dict
         ``load_train_state``/``RestoredJob.dense`` returns."""

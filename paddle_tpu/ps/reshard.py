@@ -1,0 +1,657 @@
+"""Live elastic resharding: grow/shrink a running HACluster's shard set
+while trainers keep streaming (ROADMAP item 2; docs/OPERATIONS.md §15).
+
+The shard set has been frozen at job launch since PR 0; every primitive
+a zero-downtime reshard needs already exists — this module composes
+them into a :class:`ReshardController`:
+
+- **plan** — routing is ``key % num_servers``, so a "key range" is a
+  residue class under the new modulus. Growing S → m·S splits each
+  shard's keys into m classes (class ``s + j·S`` moves to the new shard
+  with that index — single-source by construction); shrinking 2S → S
+  drains each retiring shard ``r`` onto survivor ``r % S`` (shrink
+  steps halve: two concurrent retirees draining into one survivor
+  would interleave their replication seq spaces).
+- **bootstrap** — the new shard's primary registers under the source
+  shard's OBSERVER prefix with ``{"mode": "migrate"}``: the source's
+  :class:`~.ha.ReplicationManager` attaches it with the exact PR 4
+  snapshot + oplog-tail machinery (catalog replay → kSaveAll/
+  kInsertFull full rows → seq rebase → live tail; dense state and the
+  global-step top-up are skipped — the target is, or feeds, a live
+  server with its own). Training continues throughout; the source
+  pauses mutations only for the snapshot portion, exactly as a backup
+  rejoin does. A source primary killed mid-migration is survivable:
+  the registration is a TTL'd lease the controller refreshes, so the
+  PROMOTED primary re-attaches it and the bootstrap restarts from its
+  own (bit-identical, sync-mode) copy.
+- **cutover** — the only window that gates writers, measured in
+  ``pause_ms``: pause source primaries → drain the tail → verify with
+  FILTERED content digests (kDigest n/aux: digests are wrapping sums
+  of row hashes, so "no row lost or doubled" is an O(1) equality per
+  moving class) → detach the migration subscription → kRetain the new
+  shards down to their residue class → publish the epoch-bumped
+  routing table → kRetain the sources (drops the moved classes and
+  installs the ownership fence; tapped, so backups converge) → resume.
+  The :class:`~.ha.FailoverCoordinator` suspends its scans for the
+  publish (the routing doc keeps a single writer at a time), and
+  ``cluster.control_mu`` serializes the cutover against a concurrent
+  :class:`~.ha.CheckpointGate` capture.
+- **client re-route** — nothing is broadcast to trainers: a client
+  holding the old topology gets a whole-frame ``kErrWrongShard``
+  bounce from the ownership fence, re-resolves the epoch-stamped
+  routing table, rebuilds its connection set, and replays exactly the
+  bounced keys (``RpcPsClient`` misroute replay). In-flight ops ride
+  the same path; the trainer never observes an error.
+- **shrink mirror** — retiring shards are fenced OUT (``kRetain``
+  residue -1: they answer every keyed op with the bounce) and kept as
+  lame ducks until stale clients have re-resolved, then their leases
+  release and the servers stop.
+
+Scope (enforced before anything moves): sparse RAM tables only — SSD
+tables, PS-side dense tables and GEO accumulators refuse (their
+migration stories are different subsystems; docs/OPERATIONS.md §15.5).
+Timing is constructor-injectable (clock/sleep — the uninjectable-clock
+lint rule); every scale operation appends to ``events`` and notifies
+the flight recorder.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from ..core.enforce import PreconditionNotMetError, enforce
+from ..obs import flightrec as _flightrec
+from ..obs import registry as _obs_registry
+from ..obs import trace as _obs_trace
+from . import rpc as _rpc
+from .faultpoints import faultpoint
+from .ha import _HDR, HACluster, Lease, make_conn, observer_key
+
+__all__ = ["Migration", "ReshardPlan", "ReshardError", "plan_grow",
+           "plan_shrink", "ReshardController"]
+
+
+class ReshardError(PreconditionNotMetError):
+    """A reshard step failed verification (digest mismatch, bootstrap
+    timeout, unsupported table class). The controller resumes paused
+    primaries before raising — the cluster keeps serving on the OLD
+    topology; no routing flip is published on a failed verify."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Migration:
+    """One moving residue class: keys with ``key % modulus == residue``
+    leave shard ``src`` for shard ``dst``."""
+
+    src: int
+    dst: int
+    modulus: int
+    residue: int
+
+
+@dataclasses.dataclass(frozen=True)
+class ReshardPlan:
+    direction: str               # "grow" | "shrink"
+    old_n: int
+    new_n: int
+    migrations: tuple
+
+
+def plan_grow(old_n: int, factor: int = 2) -> ReshardPlan:
+    """S → factor·S. Modulo routing makes integer multiples the clean
+    split: every key of new shard ``d`` lives on exactly ``d % S``
+    today (k ≡ d (mod m·S) ⇒ k ≡ d (mod S)) — one source per
+    migration, no cross-shard shuffle of the KEPT classes."""
+    enforce(old_n >= 1 and factor >= 2,
+            f"plan_grow needs old_n >= 1 and factor >= 2, "
+            f"got {old_n}, {factor}")
+    new_n = old_n * factor
+    migs = tuple(Migration(src=d % old_n, dst=d, modulus=new_n, residue=d)
+                 for d in range(old_n, new_n))
+    return ReshardPlan("grow", old_n, new_n, migs)
+
+
+def plan_shrink(old_n: int, divisor: int = 2) -> ReshardPlan:
+    """m·S → S with m == 2 per operation: each retiring shard ``r``
+    drains onto survivor ``r % S``. Halving only — two retirees
+    draining into ONE survivor would interleave two replication seq
+    spaces on its ``applied_seq`` cursor; an 8→2 shrink runs as two
+    halvings (the autoscaler steps by 2 anyway)."""
+    enforce(divisor == 2, f"plan_shrink supports divisor=2 per step "
+            f"(chain halvings for more), got {divisor}")
+    enforce(old_n % divisor == 0 and old_n // divisor >= 1,
+            f"cannot shrink {old_n} shards by {divisor}")
+    new_n = old_n // divisor
+    migs = tuple(Migration(src=r, dst=r % new_n, modulus=old_n, residue=r)
+                 for r in range(new_n, old_n))
+    return ReshardPlan("shrink", old_n, new_n, migs)
+
+
+class ReshardController:
+    """Grow/shrink a live :class:`~.ha.HACluster`. One instance per
+    job; operations are serialized on an internal lock (an autoscaler
+    worker and an operator CLI must not interleave cutovers).
+
+    ``clock``/``sleep`` are injectable (deterministic tests); every
+    wait re-resolves the CURRENT source primary from the routing table,
+    so a mid-migration failover costs a re-bootstrap, not the
+    operation."""
+
+    def __init__(self, cluster: HACluster,
+                 catchup_lag: int = 64,
+                 catchup_timeout_s: float = 60.0,
+                 cutover_timeout_s: float = 30.0,
+                 detach_timeout_s: float = 10.0,
+                 lame_duck_s: float = 0.5,
+                 poll_s: float = 0.01,
+                 clock: Callable[[], float] = time.monotonic,
+                 sleep: Callable[[float], None] = time.sleep) -> None:
+        self.cluster = cluster
+        self.catchup_lag = int(catchup_lag)
+        self.catchup_timeout_s = float(catchup_timeout_s)
+        self.cutover_timeout_s = float(cutover_timeout_s)
+        self.detach_timeout_s = float(detach_timeout_s)
+        self.lame_duck_s = float(lame_duck_s)
+        self.poll_s = float(poll_s)
+        self._clock = clock
+        self._sleep = sleep
+        self._op_mu = threading.Lock()
+        self._ctrl_conns: Dict[str, object] = {}
+        #: cutover gate-hold milliseconds (the demo's p50/p95 artifact)
+        self.pause_ms: deque = deque(maxlen=512)
+        #: bootstrap (full-copy + catch-up) seconds per operation
+        self.bootstrap_s: deque = deque(maxlen=512)
+        #: scale-event journal (mirrored into the elastic store under
+        #: ``ps/<job>/reshard/<n>`` so operators and the autoscaler
+        #: read one history)
+        self.events: List[dict] = []
+        self._pre_cutover: List[Callable[[ReshardPlan], None]] = []
+        # obs: shard count is a curve; reshards are counted incidents
+        self._g_shards = _obs_registry.REGISTRY.gauge(
+            "ps_shard_count", job=str(cluster.job_id))
+        self._c_reshards = _obs_registry.REGISTRY.counter(
+            "ps_reshards", job=str(cluster.job_id))
+        self._g_shards.set(cluster.num_shards)
+
+    # -- wiring ------------------------------------------------------------
+
+    def on_pre_cutover(self, fn: Callable[[ReshardPlan], None]) -> None:
+        """Subscribe to the moment right before the cutover gate: a
+        :class:`~.hot_tier.HotEmbeddingTier` owner flushes dirty
+        resident rows here (``tier.on_reshard`` — the migration then
+        carries their freshest state), tests inject checkpoints, etc.
+        Called on the CONTROLLER's thread; keep it bounded."""
+        self._pre_cutover.append(fn)
+
+    # -- introspection -----------------------------------------------------
+
+    def _journal(self, event: dict) -> None:
+        event = dict(event, t=_obs_trace.wall_s())
+        self.events.append(event)
+        self.cluster.store.put(
+            f"ps/{self.cluster.job_id}/reshard/{len(self.events)}",
+            json.dumps(event))
+        _flightrec.notify("reshard", **{k: v for k, v in event.items()
+                                        if k not in ("t", "kind")})
+
+    def stats(self) -> dict:
+        return {
+            "num_shards": self.cluster.num_shards,
+            "events": list(self.events),
+            "pause_ms": list(self.pause_ms),
+            "bootstrap_s": list(self.bootstrap_s),
+        }
+
+    # -- shared plumbing ---------------------------------------------------
+
+    def _primary_server(self, shard: int):
+        """The CURRENT primary HAServer of ``shard`` (re-resolved from
+        the routing table each call — failovers move it)."""
+        return self.cluster.primary(shard)
+
+    def _conn(self, endpoint: str):
+        """Cached per-endpoint control connection. The digest verifies,
+        retains and epoch fences all run INSIDE the cutover gate whose
+        hold time is the headline pause metric — a fresh TCP connect
+        per call would pay O(migrations × tables) handshakes while
+        every writer is blocked. Ops are serialized on ``_op_mu``; the
+        cache closes at each operation's end (``_close_conns``)."""
+        c = self._ctrl_conns.get(endpoint)
+        if c is None:
+            c = self._ctrl_conns[endpoint] = make_conn(endpoint)
+        return c
+
+    def _close_conns(self) -> None:
+        conns, self._ctrl_conns = self._ctrl_conns, {}
+        for c in conns.values():
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+
+    def _check(self, endpoint: str, cmd: int, table_id: int = 0, n: int = 0,
+               aux: int = 0):
+        return self._conn(endpoint).check(cmd, table_id, n=n, aux=aux,
+                                          timeout_ms=_rpc._long_ms(),
+                                          retries=0)
+
+    def _digest(self, endpoint: str, table_id: int, modulus: int = 0,
+                residue: int = 0) -> int:
+        import numpy as np
+
+        _, resp = self._check(endpoint, _rpc._DIGEST, table_id,
+                              n=modulus, aux=residue)
+        return int(np.frombuffer(resp, np.uint64)[0])
+
+    def _retain(self, endpoint: str, modulus: int, residue: int) -> int:
+        status, _ = self._check(endpoint, _rpc._RETAIN, n=modulus,
+                                aux=residue)
+        return int(status)
+
+    def _catalog(self, server) -> List[int]:
+        """Sparse table ids from the catalog; REFUSES what this
+        subsystem cannot migrate (SSD cold tiers, PS dense tables, GEO
+        accumulators) before anything moves."""
+        sparse: List[int] = []
+        base = 6 * 4 + 17 * 4  # sparse-create iparams+fparams payload
+        for frame in server.catalog():
+            plen, cmd, tid, _, _, _, _ = _HDR.unpack_from(frame, 0)
+            if cmd == _rpc._CREATE_SPARSE:
+                enforce(plen <= base,
+                        "reshard: SSD-backed sparse tables are not "
+                        "retainable (cold-tier key filter) — restore "
+                        "through save/load instead", ReshardError)
+                if tid not in sparse:
+                    sparse.append(tid)
+            else:
+                enforce(cmd not in (_rpc._CREATE_DENSE, _rpc._CREATE_GEO),
+                        "reshard: PS-side dense/GEO tables pin the "
+                        "server count (dense dim slices re-split, GEO "
+                        "drains on read) — not migratable yet",
+                        ReshardError)
+        enforce(sparse, "reshard: no sparse tables to migrate",
+                ReshardError)
+        return sparse
+
+    def _register_migration(self, mig: Migration, target_ep: str) -> Lease:
+        """TTL'd migrate-mode observer registration: the source shard's
+        ReplicationManager attaches ``target_ep`` with snapshot + tail;
+        the lease (refreshed by this controller) survives a source
+        failover — the promoted primary re-attaches it."""
+        return Lease(self.cluster.store,
+                     observer_key(self.cluster.job_id, mig.src, target_ep),
+                     json.dumps({"mode": "migrate", "dst_shard": mig.dst}),
+                     ttl=4 * self.cluster._hb_ttl,
+                     interval=self.cluster._hb_ttl).start()
+
+    def _acked(self, src_shard: int, target_ep: str) -> int:
+        """The SOURCE shipper's acked cursor for ``target_ep`` — the
+        only cursor in the source's OWN seq space. The target server's
+        ``applied_seq`` is NOT trustworthy here: a survivor that was
+        promoted from a backup carries a stale nonzero cursor from its
+        prior life (a foreign seq space) that can instantly — and
+        wrongly — 'satisfy' catch-up before the copy even ran; the
+        shipper cursor starts at -1 for a migrate attach and only
+        reaches the snapshot cut through an actual rebase. -1 = not
+        attached / not yet synced."""
+        rm = self._primary_server(src_shard).rm
+        if rm is None:
+            return -1
+        return rm.lag()["acked"].get(target_ep, -1)
+
+    def _wait_catchup(self, migs: List[Migration],
+                      targets: Dict[int, object]) -> None:
+        """Block until every migration target has applied the source's
+        stream to within ``catchup_lag`` entries (the bounded tail the
+        cutover gate then drains). Source primaries re-resolve every
+        poll — a kill mid-bootstrap costs a re-attach, not the wait."""
+        deadline = self._clock() + self.catchup_timeout_s
+        pending = list(migs)
+        while pending:
+            faultpoint("reshard.bootstrap")
+            still = []
+            for m in pending:
+                seq = self._primary_server(m.src).server.oplog_seq()
+                acked = self._acked(m.src, targets[m.dst].endpoint)
+                if not (acked >= 0 and seq - acked <= self.catchup_lag):
+                    still.append(m)
+            pending = still
+            if not pending:
+                return
+            enforce(self._clock() < deadline,
+                    f"reshard bootstrap: {len(pending)} migration(s) "
+                    f"not caught up within {self.catchup_timeout_s}s "
+                    f"(first: {pending[0]})", ReshardError)
+            self._sleep(self.poll_s)
+
+    def _drain_into(self, migs: List[Migration],
+                    targets: Dict[int, object]) -> None:
+        """Under the gate (sources paused — seq frozen): wait until
+        each source's shipper has an ACK from its target for the final
+        seq (the shipper cursor is rebased into the source's seq space
+        by the snapshot — see :meth:`_acked`)."""
+        deadline = self._clock() + self.cutover_timeout_s
+        for m in migs:
+            ep = targets[m.dst].endpoint
+            while True:
+                src = self._primary_server(m.src).server
+                seq = src.oplog_seq()
+                acked = self._acked(m.src, ep)
+                if acked >= seq and src.oplog_pending() == 0:
+                    break
+                enforce(self._clock() < deadline,
+                        f"reshard cutover drain timed out ({m}: "
+                        f"acked {acked} < seq {seq})", ReshardError)
+                self._sleep(self.poll_s / 2)
+
+    def _wait_detached(self, migs: List[Migration],
+                       targets: Dict[int, object]) -> None:
+        """After deleting the migrate registrations: wait until each
+        source's shipper dropped the target — entries logged AFTER the
+        cutover (the source's own kRetain included) must not ship to a
+        shard that now owns a different key set."""
+        deadline = self._clock() + self.detach_timeout_s
+        # ALL migrations polled in one loop (their shippers detach in
+        # parallel): this wait sits inside the cutover gate hold, and a
+        # per-migration sequence would pay one ring-pop timeout EACH
+        pending = {(m.src, targets[m.dst].endpoint) for m in migs}
+        while pending:
+            done = set()
+            for src, ep in pending:
+                rm = self._primary_server(src).rm
+                if rm is None or ep not in rm.lag()["acked"]:
+                    done.add((src, ep))
+                else:
+                    # nudge: zero the shipper's routing-poll cooldown
+                    # so its NEXT loop iteration re-reads the store and
+                    # drops the released registration — the detach then
+                    # costs one ring-pop timeout, not a route-poll
+                    # period
+                    rm._last_route_poll = 0.0
+            pending -= done
+            if not pending:
+                return
+            enforce(self._clock() < deadline,
+                    f"reshard cutover: source shippers still attached "
+                    f"to {sorted(pending)}", ReshardError)
+            self._sleep(self.poll_s / 2)
+
+    def _drain_sync_backups(self, shards: List[int]) -> None:
+        """Sync clusters: the sources' own backups ack everything
+        (including the just-tapped kRetain) before the gate releases —
+        replica digests agree the instant the cutover ends."""
+        if not self.cluster.sync:
+            return
+        for s in shards:
+            rm = self._primary_server(s).rm
+            if rm is not None:
+                rm.drain(self.cutover_timeout_s)
+
+    # -- grow --------------------------------------------------------------
+
+    def grow(self, factor: int = 2,
+             replication: Optional[int] = None) -> dict:
+        """S → factor·S live. Returns the operation record (also
+        appended to ``events``)."""
+        with self._op_mu:
+            try:
+                return self._grow(factor, replication)
+            finally:
+                self._close_conns()
+
+    def _grow(self, factor: int, replication: Optional[int]) -> dict:
+        cluster = self.cluster
+        plan = plan_grow(cluster.num_shards, factor)
+        self._catalog(self._primary_server(0).server)
+        t0 = self._clock()
+        # 1. raw material: full replica rows for the new shards, leased
+        # and heartbeating but outside the routing table
+        for d in range(plan.old_n, plan.new_n):
+            cluster.spawn_shard(d, replication)
+        targets = {d: cluster.servers[d][0] for d in range(plan.old_n,
+                                                           plan.new_n)}
+        # 2. bootstrap: snapshot + oplog tail via the source shards'
+        # ReplicationManagers (the PR 4 machinery, migrate mode)
+        leases = [self._register_migration(m, targets[m.dst].endpoint)
+                  for m in plan.migrations]
+        try:
+            self._wait_catchup(list(plan.migrations), targets)
+            boot_s = self._clock() - t0
+            # 3. cutover
+            pause_ms, moved = self._cutover_grow(plan, targets, leases)
+        except BaseException:
+            for lease in leases:
+                lease.release()
+            raise
+        self.bootstrap_s.append(boot_s)
+        self.pause_ms.append(pause_ms)
+        self._g_shards.set(cluster.num_shards)
+        self._c_reshards.inc()
+        rec = {"kind": "reshard", "direction": "grow",
+               "from_shards": plan.old_n, "to_shards": plan.new_n,
+               "bootstrap_s": round(boot_s, 6),
+               "cutover_pause_ms": round(pause_ms, 3),
+               "rows_moved": int(moved)}
+        self._journal(rec)
+        return rec
+
+    def _cutover_grow(self, plan: ReshardPlan, targets: Dict[int, object],
+                      leases: List[Lease]) -> tuple:
+        cluster = self.cluster
+        migs = list(plan.migrations)
+        srcs = sorted({m.src for m in migs})
+        tables = self._catalog(self._primary_server(0).server)
+        for fn in self._pre_cutover:
+            fn(plan)
+        faultpoint("reshard.cutover")
+        cluster.coordinator.suspend()
+        paused = []
+        t0 = time.perf_counter()
+        try:
+            with cluster.control_mu:
+                # pause source primaries (depth-counted; nests with a
+                # concurrent CheckpointGate) and drain the tails — from
+                # here the moving classes are frozen
+                for s in srcs:
+                    srv = self._primary_server(s).server
+                    srv.pause_mutations(True)
+                    paused.append(srv)
+                self._drain_into(migs, targets)
+                # verify EVERY moving class arrived bit-identically
+                # (filtered digests add: lost or doubled rows cannot
+                # hide), and record the kept classes for the post-
+                # retain check
+                keep = {}
+                for s in srcs:
+                    src_ep = self._primary_server(s).endpoint
+                    for tid in tables:
+                        keep[(s, tid)] = self._digest(
+                            src_ep, tid, plan.new_n, s)
+                for m in migs:
+                    src_ep = self._primary_server(m.src).endpoint
+                    for tid in tables:
+                        want = self._digest(src_ep, tid, m.modulus,
+                                            m.residue)
+                        got = self._digest(targets[m.dst].endpoint, tid,
+                                           m.modulus, m.residue)
+                        enforce(got == want,
+                                f"reshard grow: migrated class digest "
+                                f"mismatch (table {tid}, {m}: "
+                                f"{got:#x} != {want:#x}) — aborting "
+                                "before the flip", ReshardError)
+                # detach the migration subscriptions BEFORE any retain:
+                # the source's tapped kRetain must not ship to the new
+                # shard (it would drop the very rows it just received)
+                for lease in leases:
+                    lease.release()
+                self._wait_detached(migs, targets)
+                # the new shards keep only their residue class and
+                # start bouncing everything else
+                for m in migs:
+                    self._retain(targets[m.dst].endpoint, m.modulus,
+                                 m.residue)
+                # flip: epoch-fence the new primaries, then publish the
+                # widened routing doc (coordinator scans are suspended
+                # — single writer)
+                epoch, shards_doc = cluster.routing.read()
+                new_epoch = epoch + 1
+                for d in range(plan.old_n, plan.new_n):
+                    row = cluster.servers[d]
+                    self._check(targets[d].endpoint, _rpc._EPOCH,
+                                n=new_epoch)
+                    eps = [r.endpoint for r in row]
+                    shards_doc.append({"primary": eps[0],
+                                       "backups": eps[1:],
+                                       "replicas": eps})
+                cluster.routing.publish(new_epoch, shards_doc)
+                # sources drop the moved classes and install their
+                # fence (pause-exempt, tapped — backups converge)
+                moved = 0
+                for s in srcs:
+                    moved += self._retain(self._primary_server(s).endpoint,
+                                          plan.new_n, s)
+                    for tid in tables:
+                        got = self._digest(self._primary_server(s).endpoint,
+                                           tid)
+                        enforce(got == keep[(s, tid)],
+                                f"reshard grow: source {s} kept-class "
+                                f"digest mismatch on table {tid}",
+                                ReshardError)
+                self._drain_sync_backups(srcs)
+        finally:
+            for srv in reversed(paused):
+                srv.pause_mutations(False)
+            cluster.coordinator.resume_scans()
+        return (time.perf_counter() - t0) * 1000.0, moved
+
+    # -- shrink ------------------------------------------------------------
+
+    def shrink(self, divisor: int = 2) -> dict:
+        """m·S → S live (divisor 2 per step). The retiring shards stay
+        up fenced-out for ``lame_duck_s`` so stale clients bounce and
+        re-resolve instead of hitting dead sockets, then stop."""
+        with self._op_mu:
+            try:
+                return self._shrink(divisor)
+            finally:
+                self._close_conns()
+
+    def _shrink(self, divisor: int) -> dict:
+        cluster = self.cluster
+        plan = plan_shrink(cluster.num_shards, divisor)
+        self._catalog(self._primary_server(0).server)
+        t0 = self._clock()
+        targets = {m.dst: self._primary_server(m.dst)
+                   for m in plan.migrations}
+        # widen every survivor's ownership to the POST-shrink predicate
+        # up front (row-wise a no-op: k ≡ t (mod 2S) ⇒ k ≡ t (mod S)):
+        # the bootstrap's kInsertFull stream carries the retiree's
+        # class, which the survivor's CURRENT (pre-shrink) fence would
+        # bounce. Widening early is safe — no client routes the
+        # incoming class to the survivor until the flip publishes —
+        # and the tap replicates the new predicate to its backups.
+        for t_shard in range(plan.new_n):
+            self._retain(self._primary_server(t_shard).endpoint,
+                         plan.new_n, t_shard)
+        # bootstrap SEQUENTIALLY per migration: a survivor's
+        # applied_seq cursor follows one retiree's stream at a time
+        leases = []
+        try:
+            for m in plan.migrations:
+                lease = self._register_migration(
+                    m, targets[m.dst].endpoint)
+                leases.append(lease)
+                self._wait_catchup([m], {m.dst: targets[m.dst]})
+            boot_s = self._clock() - t0
+            pause_ms = self._cutover_shrink(plan, targets, leases)
+        except BaseException:
+            for lease in leases:
+                lease.release()
+            raise
+        # lame duck: fenced retirees keep answering (with bounces)
+        # while stale clients re-resolve, then leave gracefully
+        self._sleep(self.lame_duck_s)
+        retired = []
+        for r in reversed(range(plan.new_n, plan.old_n)):
+            retired.extend(cluster.retire_shard(r))
+        for srv in retired:
+            try:
+                srv.stop()
+                srv.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        self.bootstrap_s.append(boot_s)
+        self.pause_ms.append(pause_ms)
+        self._g_shards.set(cluster.num_shards)
+        self._c_reshards.inc()
+        rec = {"kind": "reshard", "direction": "shrink",
+               "from_shards": plan.old_n, "to_shards": plan.new_n,
+               "bootstrap_s": round(boot_s, 6),
+               "cutover_pause_ms": round(pause_ms, 3)}
+        self._journal(rec)
+        return rec
+
+    def _cutover_shrink(self, plan: ReshardPlan,
+                        targets: Dict[int, object],
+                        leases: List[Lease]) -> float:
+        cluster = self.cluster
+        migs = list(plan.migrations)
+        tables = self._catalog(self._primary_server(0).server)
+        for fn in self._pre_cutover:
+            fn(plan)
+        faultpoint("reshard.cutover")
+        cluster.coordinator.suspend()
+        paused = []
+        t0 = time.perf_counter()
+        try:
+            with cluster.control_mu:
+                # pause the RETIREES only: survivors keep taking their
+                # own traffic — the retirees' residue classes are
+                # frozen (clients still route them to the retirees,
+                # whose mutations now block)
+                for m in migs:
+                    srv = self._primary_server(m.src).server
+                    srv.pause_mutations(True)
+                    paused.append(srv)
+                self._drain_into(migs, targets)
+                # every retiree row must sit bit-identical in its
+                # survivor (class digest on the survivor == the
+                # retiree's whole digest — the retiree only ever owned
+                # that class)
+                for m in migs:
+                    src_ep = self._primary_server(m.src).endpoint
+                    for tid in tables:
+                        want = self._digest(src_ep, tid)
+                        got = self._digest(targets[m.dst].endpoint, tid,
+                                           m.modulus, m.residue)
+                        enforce(got == want,
+                                f"reshard shrink: drained class digest "
+                                f"mismatch (table {tid}, {m}: "
+                                f"{got:#x} != {want:#x}) — aborting "
+                                "before the flip", ReshardError)
+                for lease in leases:
+                    lease.release()
+                self._wait_detached(migs, targets)
+                # survivors already own the widened predicate (set at
+                # bootstrap start); retirees fence OUT now — own
+                # nothing, keep rows for the post-mortem window
+                for m in migs:
+                    self._retain(self._primary_server(m.src).endpoint,
+                                 plan.new_n, -1)
+                epoch, shards_doc = cluster.routing.read()
+                cluster.routing.publish(epoch + 1, shards_doc[:plan.new_n])
+                # survivors only: the retirees' shard indices just left
+                # the routing doc (their backups die with them; the
+                # fence retain was tapped and ships on a best-effort
+                # tail during the lame-duck window)
+                self._drain_sync_backups(sorted({m.dst for m in migs}))
+        finally:
+            for srv in reversed(paused):
+                srv.pause_mutations(False)
+            cluster.coordinator.resume_scans()
+        return (time.perf_counter() - t0) * 1000.0

@@ -28,7 +28,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 import numpy as np
 
 from ..core.enforce import (NotFoundError, PreconditionNotMetError,
-                            PsTransportError, enforce)
+                            PsTransportError, WrongShardError, enforce)
 from ..core.flags import define_flag, flag
 from ..core.profiler import RecordEvent
 from ..obs import flightrec as _flightrec
@@ -127,6 +127,9 @@ _DIGEST = 40
 _DENSE_SNAP = 41
 _DENSE_RESTORE = 42
 _OBS_SNAP = 43
+# live elastic resharding (ps/reshard.py; docs/OPERATIONS.md §15):
+# n = modulus (0 = read ownership), aux = residue (-1 = fence out)
+_RETAIN = 44
 
 _DENSE_OPT_IDS = {"sgd": 0, "adam": 1, "sum": 2}
 
@@ -564,8 +567,25 @@ class _ServerConn:
             raise PreconditionNotMetError(
                 f"PS server {self.endpoint} is READ-ONLY (serving "
                 f"replica) — training-plane command {cmd} refused")
+        if status == -8:
+            raise WrongShardError(
+                f"PS server {self.endpoint} no longer owns a key in "
+                f"this request (cmd {cmd}, table {table_id}) — the "
+                "shard topology moved (live reshard); re-resolve the "
+                "routing table and replay")
         enforce(status >= 0, f"PS command {cmd} failed with status {status}")
         return status, resp
+
+
+class _ColdBounce(Exception):
+    """Internal to RpcPsClient.load_cold: carries the UNSENT remainder
+    of a shard's slice when a chunk bounces kErrWrongShard mid-load
+    (earlier chunks on that shard already landed — exactly-once replay
+    must exclude them)."""
+
+    def __init__(self, pending):
+        super().__init__("load_cold chunk bounced")
+        self.pending = pending
 
 
 def make_conn(endpoint: str) -> "_ServerConn":
@@ -649,6 +669,10 @@ class RpcPsClient(PSClient):
         #: static single-replica topology (behavior unchanged).
         self._router = router
         self._conns_mu = threading.Lock()  # serializes failover conn swaps
+        # live resharding (ps/reshard.py): a grow replaces the fan-out
+        # pool with a wider one; pools that may still carry in-flight
+        # fan-outs retire here and shut down at close()
+        self._retired_pools: List[ThreadPoolExecutor] = []
         # per-op RPC counts, REGISTRY-BACKED (obs/registry.py): one
         # count per client op regardless of shard fan-out, under the
         # job-wide family ``ps_client_ops`` labeled by op and a
@@ -742,19 +766,72 @@ class RpcPsClient(PSClient):
         old.close()
 
     def refresh_routing(self) -> bool:
-        """Re-resolve every shard's endpoint from the router's current
-        routing table; returns True if any connection moved. Callers
-        holding failed futures (communicator pull prefetch) refresh and
-        replay; without a router this is a no-op."""
+        """Re-resolve every shard's endpoint AND the shard COUNT from
+        the router's current routing table; returns True if the
+        connection set changed. Callers holding failed futures
+        (communicator pull prefetch) refresh and replay; a
+        :class:`~paddle_tpu.core.enforce.WrongShardError` bounce
+        (live reshard moved a key class) lands here too — the client
+        rebuilds its topology and the op replays the bounced keys.
+        Without a router this is a no-op."""
         if self._router is None:
             return False
         _, eps = self._router.routing()
-        moved = False
-        for s, ep in enumerate(eps[: len(self._conns)]):
-            if ep and ep != self._conns[s].endpoint:
-                self._swap_conn(s, ep)
-                moved = True
-        return moved
+        if not eps:
+            return False
+        with self._conns_mu:
+            if [c.endpoint for c in self._conns] == list(eps):
+                return False
+            have = {c.endpoint for c in self._conns}
+        # build the NEW connections OUTSIDE _conns_mu: every _shard_op
+        # takes that lock on the data hot path, and a TCP connect here
+        # can block up to the connect deadline per endpoint — holding
+        # the lock through it would stall all concurrent ops for the
+        # whole flip. On a partial failure the already-built strays
+        # close instead of leaking.
+        built: Dict[str, _ServerConn] = {}
+        try:
+            for ep in eps:
+                if ep not in have:
+                    host, port = ep.rsplit(":", 1)
+                    built[ep] = _ServerConn(self._lib, host, int(port),
+                                            **self._conn_kw)
+        except BaseException:
+            for c in built.values():
+                c.close()
+            raise
+        stale: List[_ServerConn] = []
+        with self._conns_mu:
+            old = self._conns
+            conns: List[_ServerConn] = []
+            for ep in eps:
+                cur = next((c for c in old if c.endpoint == ep), None)
+                if cur is not None:
+                    conns.append(cur)  # keep live conns across the flip
+                elif ep in built:
+                    conns.append(built.pop(ep))
+                else:
+                    # endpoint appeared between snapshot and build (a
+                    # concurrent refresh raced us): rare — pay the
+                    # in-lock connect only for this stray
+                    host, port = ep.rsplit(":", 1)
+                    conns.append(_ServerConn(self._lib, host, int(port),
+                                             **self._conn_kw))
+            stale = [c for c in old if c not in conns]
+            self._conns = conns
+        for c in built.values():  # built for an endpoint a concurrent
+            c.close()             # refresh already covered — unused
+        for c in stale:
+            c.close()
+        # widen the fan-out pool if the topology grew; the old pool may
+        # carry in-flight fan-outs, so it retires instead of shutting
+        # down under them (close() drains the retirees)
+        with self._pool_mu:
+            if self._pool is not None and \
+                    len(self._conns) > self._pool._max_workers:
+                self._retired_pools.append(self._pool)
+                self._pool = None
+        return True
 
     def _shard_op(self, s: int, fn):
         """Run ``fn(conn)`` against shard ``s``'s current server. With a
@@ -767,7 +844,15 @@ class RpcPsClient(PSClient):
         failures on negative statuses) pass straight through and never
         touch the breaker — a healthy server's rejection must not open
         its breaker or trigger a failover wait."""
-        c = self._conns[s]
+        with self._conns_mu:
+            if s >= len(self._conns):
+                # a live reshard SHRANK the topology under this op: the
+                # shard index no longer exists — same recovery as a
+                # server-side kErrWrongShard bounce (re-resolve+replay)
+                raise WrongShardError(
+                    f"shard {s} is beyond the current topology "
+                    f"({len(self._conns)} servers) — stale routing")
+            c = self._conns[s]
         r = self._router
         if r is None:
             return fn(c)
@@ -775,6 +860,7 @@ class RpcPsClient(PSClient):
         if not r.allow(ep):
             # breaker open: don't burn a timeout — jump straight to
             # re-resolution (the coordinator may have promoted already)
+            self._raise_if_shrunk(s, r)
             new_ep = r.failover(s, ep)
             if new_ep is None or new_ep == ep:
                 raise PsTransportError(
@@ -794,6 +880,11 @@ class RpcPsClient(PSClient):
             if rec is not None:
                 rec.note("transport_error", shard=s, endpoint=ep,
                          error=f"{type(e).__name__}: {e}")
+            # a shard index the routing table no longer carries is a
+            # SHRINK, not a dead primary: convert to the misroute path
+            # now instead of waiting the failover budget for a
+            # promotion that can never come
+            self._raise_if_shrunk(s, r)
             new_ep = r.failover(s, ep)
             if new_ep is None or new_ep == ep:
                 raise
@@ -815,6 +906,14 @@ class RpcPsClient(PSClient):
         r.record(ep, ok=True)
         return out
 
+    @staticmethod
+    def _raise_if_shrunk(s: int, router) -> None:
+        _, eps = router.routing()
+        if eps and s >= len(eps):
+            raise WrongShardError(
+                f"shard {s} left the topology ({len(eps)} shards "
+                "published) — stale routing")
+
     def _direct(self, server: int, fn):
         """Server-TARGETED call: no breaker, no failover replay. For
         introspection (repl_state, epoch, dense snapshots) the answer
@@ -828,11 +927,51 @@ class RpcPsClient(PSClient):
         object — failover may swap the conn between submit and run)."""
         return lambda: self._shard_op(s, fn)
 
+    # -- live-reshard misroute replay (ps/reshard.py) ---------------------
+
+    _REROUTE_HOPS = 8
+
+    def _bounce_guard(self, s: int, fn, misrouted: List, sel, n_keys: int):
+        """Fan-out task wrapper for keyed ops: a kErrWrongShard bounce
+        (or a stale shard index after a shrink) records WHICH key
+        positions bounced instead of failing the op — the server
+        rejected the frame whole, so the op re-resolves the topology
+        and replays exactly those keys, each applied exactly once.
+        Without a router there is nothing to re-resolve; the error
+        propagates. ``misrouted`` appends are GIL-atomic (list.append
+        from fan-out workers)."""
+        def run():
+            try:
+                self._shard_op(s, fn)
+            except WrongShardError:
+                if self._router is None:
+                    raise
+                misrouted.append(np.arange(n_keys, dtype=np.int64)
+                                 if sel is None else sel)
+        return run
+
+    def _reroute_backoff(self, hops: int) -> None:
+        """Between misroute replays: re-resolve the routing table, and
+        when it has not changed yet (a cutover installs the ownership
+        fence a moment before it publishes the flipped routing doc)
+        back off briefly — the publish is milliseconds away, not a
+        failover wait. Raises once the hop budget is spent: a topology
+        that stays stale means the reshard wedged mid-cutover."""
+        enforce(hops < self._REROUTE_HOPS,
+                f"misrouted PS op: topology still stale after {hops} "
+                "re-resolves (reshard wedged mid-cutover?)",
+                WrongShardError)
+        if not self.refresh_routing() and hops > 0:
+            time.sleep(min(0.002 * (2 ** hops), 0.1))
+
     def close(self) -> None:
         with self._pool_mu:
             pool, self._pool = self._pool, None
+            retired, self._retired_pools = self._retired_pools, []
         if pool is not None:
             pool.shutdown(wait=True)
+        for p in retired:
+            p.shutdown(wait=True)
         for c in self._conns:
             c.close()
 
@@ -1009,7 +1148,8 @@ class RpcPsClient(PSClient):
                 out.append((s, sel))
         return out
 
-    def _pull_sparse(self, table_id, keys, create=True, slots=None):
+    def _pull_sparse(self, table_id, keys, create=True, slots=None,
+                     _hops=0):
         keys = np.ascontiguousarray(keys, np.uint64)
         pull_dim = self._dims(table_id)[0]
         out = np.zeros((len(keys), pull_dim), np.float32)
@@ -1033,9 +1173,16 @@ class RpcPsClient(PSClient):
             else:
                 out[sel] = vals.reshape(len(kp), pull_dim)
 
-        self._fanout([self._task(s, lambda c, sel=sel: one(c, sel))
+        misrouted: List[np.ndarray] = []
+        self._fanout([self._bounce_guard(s, lambda c, sel=sel: one(c, sel),
+                                         misrouted, sel, len(keys))
                       for s, sel in self._shard_sel(sv)])
-        m = self._tbl_obs.get(table_id)
+        if misrouted:
+            self._reroute_backoff(_hops)
+            idx = np.concatenate(misrouted)
+            out[idx] = self._pull_sparse(table_id, keys[idx], create,
+                                         slots_arr[idx], _hops=_hops + 1)
+        m = self._tbl_obs.get(table_id) if _hops == 0 else None
         if m is not None:
             m["pull_rows"].add(len(keys))
             m["pull_bytes"].add(keys.nbytes + slots_arr.nbytes
@@ -1050,7 +1197,7 @@ class RpcPsClient(PSClient):
         with RecordEvent("pserver_client_push_sparse"):
             return self._push_sparse(table_id, keys, values)
 
-    def _push_sparse(self, table_id, keys, values):
+    def _push_sparse(self, table_id, keys, values, _hops=0):
         keys = np.ascontiguousarray(keys, np.uint64)
         values = np.ascontiguousarray(values, np.float32)
         # client-side dedup-merge (brpc client merges duplicate keys
@@ -1063,9 +1210,19 @@ class RpcPsClient(PSClient):
             vp = values if sel is None else values[sel]
             c.check(_PUSH_SPARSE, table_id, n=len(kp), payload=(kp, vp))
 
-        self._fanout([self._task(s, lambda c, sel=sel: one(c, sel))
+        misrouted: List[np.ndarray] = []
+        self._fanout([self._bounce_guard(s, lambda c, sel=sel: one(c, sel),
+                                         misrouted, sel, len(keys))
                       for s, sel in self._shard_sel(sv)])
-        m = self._tbl_obs.get(table_id)
+        if misrouted:
+            # the bounced slice changed state NOWHERE (whole-frame
+            # rejection), so replaying only it applies each gradient
+            # exactly once even though the other shards' slices landed
+            self._reroute_backoff(_hops)
+            idx = np.concatenate(misrouted)
+            self._push_sparse(table_id, keys[idx], values[idx],
+                              _hops=_hops + 1)
+        m = self._tbl_obs.get(table_id) if _hops == 0 else None
         if m is not None:
             m["push_rows"].add(len(keys))
             m["push_bytes"].add(keys.nbytes + values.nbytes)
@@ -1131,8 +1288,9 @@ class RpcPsClient(PSClient):
              for s in range(self.num_servers)
              if len(self._dense_slice(dim, s))])
 
-    def push_geo(self, table_id, keys, deltas):
-        self._op_count("push_geo")
+    def push_geo(self, table_id, keys, deltas, _hops=0):
+        if _hops == 0:
+            self._op_count("push_geo")
         keys = np.ascontiguousarray(keys, np.uint64)
         deltas = np.ascontiguousarray(deltas, np.float32)
         sv = self._route(keys)
@@ -1142,8 +1300,14 @@ class RpcPsClient(PSClient):
             dp = deltas if sel is None else deltas[sel]
             c.check(_PUSH_GEO, table_id, n=len(kp), payload=(kp, dp))
 
-        self._fanout([self._task(s, lambda c, sel=sel: one(c, sel))
+        misrouted: List[np.ndarray] = []
+        self._fanout([self._bounce_guard(s, lambda c, sel=sel: one(c, sel),
+                                         misrouted, sel, len(keys))
                       for s, sel in self._shard_sel(sv)])
+        if misrouted:
+            self._reroute_backoff(_hops)
+            idx = np.concatenate(misrouted)
+            self.push_geo(table_id, keys[idx], deltas[idx], _hops=_hops + 1)
 
     def pull_geo(self, table_id):
         self._op_count("pull_geo")
@@ -1216,6 +1380,56 @@ class RpcPsClient(PSClient):
         return self._fanout([self._task(s, one)
                              for s in range(self.num_servers)])
 
+    # -- live-reshard control surface (ps/reshard.py drives these) --------
+
+    def digest_routed(self, table_id: int) -> List[int]:
+        """Per-server digests of each server's ROUTED key class
+        (``key % num_servers == s``) — the capture-consistent
+        companion of :meth:`snapshot_items`: mid-reshard, a migrating
+        class in flight on two servers digests exactly once. Identity
+        to :meth:`digest` in steady state. SSD-backed tables have no
+        filtered digest (and cannot reshard — the controller refuses
+        them — so no in-flight class can ever double-count): they take
+        the plain per-server digest."""
+        cfg = self._sparse_cfgs.get(table_id)
+        if cfg is not None and cfg.storage == "ssd":
+            return self.digest(table_id)
+        n = self.num_servers
+        return [self.digest_at(s, table_id, n, s) for s in range(n)]
+
+    def digest_at(self, server: int, table_id: int, modulus: int = 0,
+                  residue: int = 0) -> int:
+        """ONE server's content digest, optionally restricted to keys
+        with ``key % modulus == residue`` (kDigest n/aux). Digests are
+        wrapping sums of per-row hashes, so class digests ADD — the
+        reshard controller's no-row-lost-or-doubled check is an O(1)
+        equality over these. Server-targeted (no failover replay): the
+        answer must come from the addressed replica or fail."""
+        _, resp = self._direct(server, lambda c: c.check(
+            _DIGEST, table_id, n=int(modulus), aux=int(residue),
+            timeout_ms=_long_ms()))
+        return int(np.frombuffer(resp, np.uint64)[0])
+
+    def retain(self, server: int, modulus: int, residue: int) -> int:
+        """Install ``server``'s key-ownership predicate and (when
+        ``0 <= residue < modulus``) drop every row outside it — the
+        reshard cutover's key-range filter (kRetain; tapped, so the
+        shard's backups converge). ``residue=-1`` fences the server out
+        of the data plane entirely (a retiring shard: every keyed op
+        bounces kErrWrongShard until the stale client re-resolves).
+        Returns rows erased."""
+        status, _ = self._direct(server, lambda c: c.check(
+            _RETAIN, n=int(modulus), aux=int(residue),
+            timeout_ms=_long_ms(), retries=0))
+        return int(status)
+
+    def ownership(self, server: int) -> Tuple[int, int]:
+        """One server's (modulus, residue) ownership predicate
+        ((0, 0) = owns everything — the static-topology default)."""
+        _, resp = self._direct(server, lambda c: c.check(_RETAIN, n=0))
+        st = np.frombuffer(resp, np.int64)
+        return int(st[0]), int(st[1])
+
     def server_epoch(self, server: int, set_to: Optional[int] = None) -> int:
         """Read (or set) one server's routing epoch (kEpoch). The
         failover coordinator sets the promoted backup's epoch BEFORE
@@ -1287,13 +1501,27 @@ class RpcPsClient(PSClient):
         Take it under a mutation gate (ha.CheckpointGate) for a
         consistent cut; kSaveAll itself reads a paused primary fine.
         Servers export in PARALLEL (_fanout) — the gate hold, i.e. the
-        training stall, is max(shards), not sum(shards)."""
+        training stall, is max(shards), not sum(shards).
+
+        Each server's export is filtered to the rows the CURRENT
+        routing assigns it (``key % num_servers == s``): during a live
+        reshard's bootstrap window the migrating key class exists on
+        TWO servers (the copy is the mechanism), and an unfiltered
+        union would capture it twice — the routed filter makes the
+        capture exactly-once at every instant. In steady state every
+        row already satisfies it (modulo routing), so this is the
+        identity."""
+        n = self.num_servers
         parts = self._fanout(
             [lambda s=s: self._save_all_items(s, table_id, mode)
-             for s in range(self.num_servers)])  # zero-arg tasks:
+             for s in range(n)])  # zero-arg tasks:
         # _save_all_items is already _shard_op-wrapped (failover replay)
-        keys = np.concatenate([k for k, _ in parts])
-        values = np.concatenate([v for _, v in parts])
+        routed = []
+        for s, (k, v) in enumerate(parts):
+            own = (k % np.uint64(n)).astype(np.int64) == s
+            routed.append((k[own], v[own]) if not own.all() else (k, v))
+        keys = np.concatenate([k for k, _ in routed])
+        values = np.concatenate([v for _, v in routed])
         return keys, values
 
     def save(self, table_id, dirname, mode=0):
@@ -1352,12 +1580,14 @@ class RpcPsClient(PSClient):
             total += len(keys)
         return total
 
-    def export_full(self, table_id, keys, create=False, slots=None):
+    def export_full(self, table_id, keys, create=False, slots=None,
+                    _hops=0):
         """(values [n, full_dim], found [n]) across servers. With
         ``create``, missing rows are inserted server-side in the same
         traversal (the multi-node pass-build BuildPull,
         ps_gpu_wrapper.cc:299)."""
-        self._op_count("export_full")
+        if _hops == 0:
+            self._op_count("export_full")
         keys = np.ascontiguousarray(keys, np.uint64)
         full_dim = self._dims(table_id)[2]
         out = np.zeros((len(keys), full_dim), np.float32)
@@ -1382,16 +1612,25 @@ class RpcPsClient(PSClient):
                 out[sel] = vals
                 found[sel] = resp[nb:] != 0
 
-        self._fanout([self._task(s, lambda c, sel=sel: one(c, sel))
+        misrouted: List[np.ndarray] = []
+        self._fanout([self._bounce_guard(s, lambda c, sel=sel: one(c, sel),
+                                         misrouted, sel, len(keys))
                       for s, sel in self._shard_sel(sv)])
-        m = self._tbl_obs.get(table_id)
+        if misrouted:
+            self._reroute_backoff(_hops)
+            idx = np.concatenate(misrouted)
+            out[idx], found[idx] = self.export_full(
+                table_id, keys[idx], create, slots_arr[idx],
+                _hops=_hops + 1)
+        m = self._tbl_obs.get(table_id) if _hops == 0 else None
         if m is not None:
             m["pull_rows"].add(len(keys))
             m["pull_bytes"].add(keys.nbytes + out.nbytes + found.nbytes)
         return out, found
 
-    def import_full(self, table_id, keys, values):
-        self._op_count("import_full")
+    def import_full(self, table_id, keys, values, _hops=0):
+        if _hops == 0:
+            self._op_count("import_full")
         keys = np.ascontiguousarray(keys, np.uint64)
         values = np.ascontiguousarray(values, np.float32)
         sv = self._route(keys)
@@ -1402,14 +1641,22 @@ class RpcPsClient(PSClient):
             c.check(_INSERT_FULL, table_id, n=len(kp), payload=(kp, vp),
                     timeout_ms=_long_ms())
 
-        self._fanout([self._task(s, lambda c, sel=sel: one(c, sel))
+        misrouted: List[np.ndarray] = []
+        self._fanout([self._bounce_guard(s, lambda c, sel=sel: one(c, sel),
+                                         misrouted, sel, len(keys))
                       for s, sel in self._shard_sel(sv)])
-        m = self._tbl_obs.get(table_id)
+        if misrouted:
+            self._reroute_backoff(_hops)
+            idx = np.concatenate(misrouted)
+            self.import_full(table_id, keys[idx], values[idx],
+                             _hops=_hops + 1)
+        m = self._tbl_obs.get(table_id) if _hops == 0 else None
         if m is not None:
             m["push_rows"].add(len(keys))
             m["push_bytes"].add(keys.nbytes + values.nbytes)
 
-    def load_cold(self, table_id, keys, values, chunk: int = 1 << 21) -> int:
+    def load_cold(self, table_id, keys, values, chunk: int = 1 << 21,
+                  _hops=0) -> int:
         """Bulk cold-tier model load across servers (the 1e9-row build
         path): keys route by ``key % num_servers``; each server's slice
         ships in bounded chunks (frames stay far under the 4 GiB cap and
@@ -1422,22 +1669,53 @@ class RpcPsClient(PSClient):
                 f"load_cold values shape {values.shape} != "
                 f"({len(keys)}, {full_dim})")
         sv = self._route(keys)
+        done_rows = [0] * self.num_servers
 
-        def one(c, sel):
+        def one(c, s, sel):
             # chunks WITHIN a server stay sequential (bounded frames,
-            # flat client RAM); servers load in parallel
-            done = 0
+            # flat client RAM); servers load in parallel. Completed
+            # chunks accumulate per shard so a misroute replay after a
+            # mid-load reshard replays only this shard's UNSENT keys
+            # (a bounced chunk changed nothing server-side).
             for lo in range(0, len(sel), chunk):
                 part = sel[lo : lo + chunk]
-                cnt, _ = c.check(_LOAD_COLD, table_id, n=len(part),
-                                 payload=(keys[part], values[part]),
-                                 timeout_ms=_long_ms())
-                done += int(cnt)
-            return done
+                try:
+                    cnt, _ = c.check(_LOAD_COLD, table_id, n=len(part),
+                                     payload=(keys[part], values[part]),
+                                     timeout_ms=_long_ms())
+                except WrongShardError:
+                    raise _ColdBounce(sel[lo:])
+                done_rows[s] += int(cnt)
 
-        return sum(self._fanout(
-            [self._task(s, lambda c, sel=np.flatnonzero(sv == s): one(c, sel))
-             for s in range(self.num_servers)]))
+        misrouted: List[np.ndarray] = []
+
+        def guarded(s, sel):
+            def run():
+                try:
+                    self._shard_op(s, lambda c: one(c, s, sel))
+                except _ColdBounce as b:
+                    if self._router is None:
+                        raise WrongShardError(
+                            "load_cold bounced with no router to "
+                            "re-resolve — stale static topology")
+                    misrouted.append(b.pending)
+                except WrongShardError:
+                    # stale shard index (shrunk topology): nothing of
+                    # this shard's slice was sent
+                    if self._router is None:
+                        raise
+                    misrouted.append(sel)
+            return run
+
+        self._fanout([guarded(s, np.flatnonzero(sv == s))
+                      for s in range(self.num_servers)])
+        total = sum(done_rows)
+        if misrouted:
+            self._reroute_backoff(_hops)
+            idx = np.concatenate(misrouted)
+            total += self.load_cold(table_id, keys[idx], values[idx],
+                                    chunk=chunk, _hops=_hops + 1)
+        return total
 
     _SAVE_FORMATS = {None: (0, ""), "gzip": (1, ".gz"), "raw": (2, ".bin")}
 
@@ -1579,6 +1857,14 @@ class RemoteSparseTable:
     def snapshot_items(self, mode: int = 0):
         return self._client.snapshot_items(self._table_id, mode=mode)
 
+    def refresh_routing(self) -> bool:
+        """Re-resolve the client's shard topology (live reshard): a
+        capture path that only ever READS (kSaveAll/kDigest are not
+        key-fenced) would otherwise keep snapshotting the pre-reshard
+        server set — a silently PARTIAL capture. The job-checkpoint
+        manager calls this under its gate before every capture."""
+        return self._client.refresh_routing()
+
     def spill(self, hot_budget: int) -> int:
         return self._client.spill(self._table_id, hot_budget)
 
@@ -1586,7 +1872,11 @@ class RemoteSparseTable:
         return self._client.table_stats(self._table_id)
 
     def digest(self) -> List[int]:
-        return self._client.digest(self._table_id)
+        # routed per-server digests: exactly-once per key class even
+        # mid-reshard (steady state: identical to the plain kDigest
+        # sum) — the job-checkpoint capture digests THE SAME row set
+        # snapshot_items exports
+        return self._client.digest_routed(self._table_id)
 
     @property
     def full_dim(self) -> int:

@@ -122,10 +122,15 @@ class ServingFrontend:
     worker stacks them to [B,S]."""
 
     def __init__(self, lookup, infer: Optional[Callable] = None,
-                 config: Optional[FrontendConfig] = None) -> None:
+                 config: Optional[FrontendConfig] = None,
+                 idle_pop_s: float = 0.02) -> None:
         self.lookup = lookup
         self.infer = infer
         self.config = config or FrontendConfig()
+        #: worker's idle queue-pop timeout — bounds stop() latency and
+        #: is constructor-injectable (uninjectable-clock lint contract;
+        #: the batching cadence itself lives in FrontendConfig)
+        self.idle_pop_s = float(idle_pop_s)
         cfg = self.config
         enforce(cfg.max_batch > 0 and cfg.queue_cap > 0,
                 "FrontendConfig max_batch/queue_cap must be positive")
@@ -202,7 +207,7 @@ class ServingFrontend:
         cfg = self.config
         while True:
             try:
-                first = self._q.get(timeout=0.02)
+                first = self._q.get(timeout=self.idle_pop_s)
             except queue.Empty:
                 if self._stopping.is_set():
                     return

@@ -1,0 +1,194 @@
+"""SLO-driven autoscaler (ps/autoscale.py): hysteresis, cooldowns,
+bounds, journal — all under an injected clock and a fake controller
+(the real actuator is covered by tests/test_reshard.py) — plus the
+watchdog wiring and the elastic desired-np trainer surface."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import elastic
+from paddle_tpu.obs.slo import SloRule, SloWatchdog
+from paddle_tpu.obs.timeseries import MetricRing
+from paddle_tpu.ps.autoscale import AutoscaleConfig, Autoscaler
+
+
+class _FakeCluster:
+    job_id = "as-test"
+
+    def __init__(self, n=2):
+        self.n = n
+        self.store = elastic.MemoryStore()
+
+    @property
+    def num_shards(self):
+        return self.n
+
+
+class _FakeController:
+    def __init__(self, n=2, fail=False):
+        self.cluster = _FakeCluster(n)
+        self.ops = []
+        self.fail = fail
+
+    def grow(self, factor):
+        if self.fail:
+            raise RuntimeError("boom")
+        self.cluster.n *= factor
+        self.ops.append(("grow", self.cluster.n))
+        return {"cutover_pause_ms": 1.0, "bootstrap_s": 0.01}
+
+    def shrink(self, factor):
+        self.cluster.n //= factor
+        self.ops.append(("shrink", self.cluster.n))
+        return {"cutover_pause_ms": 1.0, "bootstrap_s": 0.01}
+
+
+class _Alert:
+    def __init__(self, rule):
+        self.rule = rule
+
+
+def _scaler(ctrl=None, **cfg_kw):
+    ctrl = ctrl or _FakeController()
+    t = [0.0]
+    cfg = AutoscaleConfig(min_shards=2, max_shards=8, cooldown_up_s=5.0,
+                          cooldown_down_s=10.0, clear_hold_s=4.0, **cfg_kw)
+    return ctrl, t, Autoscaler(ctrl, config=cfg, clock=lambda: t[0])
+
+
+def test_scale_up_on_alert_and_cooldown():
+    ctrl, t, a = _scaler()
+    assert a.step() is None                      # quiet, at min
+    a.notify_fire(_Alert("step_time_p95"))
+    assert a.step() == "up" and ctrl.cluster.n == 4
+    t[0] = 2.0
+    assert a.step() is None                      # up-cooldown holds
+    t[0] = 6.0
+    assert a.step() == "up" and ctrl.cluster.n == 8
+
+
+def test_max_bound_refuses_and_journals():
+    ctrl, t, a = _scaler(ctrl=_FakeController(n=8))
+    a.notify_fire(_Alert("serving_p99"))
+    assert a.step() is None
+    assert a.events[-1]["kind"] == "scale_refused"
+    assert a.events[-1]["reason"] == "max_shards"
+    assert ctrl.ops == []
+
+
+def test_scale_down_needs_quiet_hold_and_cooldown():
+    ctrl, t, a = _scaler()
+    a.notify_fire(_Alert("step_time_p95"))
+    assert a.step() == "up"                      # n=4 at t=0
+    a.notify_clear(_Alert("step_time_p95"))      # quiet_since = 0
+    t[0] = 2.0
+    assert a.step() is None                      # quiet-hold (4s) not met
+    t[0] = 5.0                                   # quiet met, down-cooldown
+    assert a.step() is None                      # (10s from scale) not met
+    t[0] = 11.0
+    assert a.step() == "down" and ctrl.cluster.n == 2
+    t[0] = 30.0
+    assert a.step() is None                      # at min: never below
+
+
+def test_non_up_rule_alerts_are_ignored():
+    ctrl, t, a = _scaler()
+    a.notify_fire(_Alert("checkpoint_staleness"))  # not an up-rule
+    assert a.step() is None
+    assert ctrl.ops == []
+    assert a.active_up_rules() == []
+
+
+def test_alert_must_clear_before_down_even_after_cooldowns():
+    ctrl, t, a = _scaler()
+    a.notify_fire(_Alert("replication_lag"))
+    assert a.step() == "up"                      # 2 → 4
+    t[0] = 100.0                                 # cooldowns long past —
+    assert a.step() == "up"                      # still burning: UP again
+    t[0] = 200.0
+    assert a.step() is None                      # at max: refused…
+    assert all(op != "shrink" for op, _ in ctrl.ops)  # …never DOWN
+    assert ctrl.cluster.n == 8
+
+
+def test_failed_scale_is_journaled_and_cooled_down():
+    ctrl = _FakeController(fail=True)
+    _, t, a = _scaler(ctrl=ctrl)
+    a.notify_fire(_Alert("step_time_p95"))
+    assert a.step() is None
+    assert a.errors == 1
+    assert a.events[-1]["kind"] == "scale_failed"
+    t[0] = 1.0
+    assert a.step() is None                      # cooldown after failure:
+    assert a.errors == 1                         # no hot-looping the break
+
+
+def test_journal_mirrors_into_elastic_store():
+    ctrl, t, a = _scaler()
+    a.notify_fire(_Alert("step_time_p95"))
+    a.step()
+    keys = ctrl.cluster.store.list_prefix("ps/as-test/scale/")
+    assert len(keys) == 1
+
+
+def test_trainer_np_target_published():
+    ctrl = _FakeController()
+    t = [0.0]
+    cfg = AutoscaleConfig(min_shards=2, max_shards=8, cooldown_up_s=1.0,
+                          trainer_np=lambda shards: shards * 2,
+                          elastic_job_id="job-x")
+    a = Autoscaler(ctrl, config=cfg, clock=lambda: t[0])
+    a.notify_fire(_Alert("step_time_p95"))
+    assert a.step() == "up"
+    mgr = elastic.ElasticManager(ctrl.cluster.store, "job-x", np=2,
+                                 host="h0", min_np=1, max_np=64)
+    assert mgr.desired_np() == 8                 # 4 shards × 2
+    assert mgr.adopt_desired_np() and mgr.np == 8
+
+
+def test_elastic_adopt_clamps_and_watch_consumes(monkeypatch):
+    store = elastic.MemoryStore()
+    mgr = elastic.ElasticManager(store, "j2", np=2, host="h0",
+                                 min_np=2, max_np=4)
+    assert mgr.desired_np() is None
+    assert not mgr.adopt_desired_np()
+    elastic.set_desired_np(store, "j2", 16)
+    assert mgr.adopt_desired_np() and mgr.np == 4  # clamped to max_np
+    # watch_once adopts the target, so quorum is judged against it
+    store.put(mgr.member_key("h0"), "{}", ttl=10)
+    store.put(mgr.member_key("h1"), "{}", ttl=10)
+    elastic.set_desired_np(store, "j2", 2)
+    assert mgr.watch_once() == elastic.ElasticStatus.HOLD
+    assert mgr.np == 2
+
+
+# ---------------------------------------------------------------------------
+# SloWatchdog push subscriptions drive the loop end to end
+# ---------------------------------------------------------------------------
+
+def _ring_with(values, t0=1000.0):
+    ring = MetricRing()
+    for i, v in enumerate(values):
+        ring.append({"metrics": {"g": {"type": "gauge", "series": [
+            {"labels": {}, "value": v}]}}}, t=t0 + i)
+    return ring, t0 + len(values) - 1
+
+
+def test_watchdog_fire_and_clear_drive_autoscaler():
+    ring, now = _ring_with([5.0, 5.0, 5.0])
+    wd = SloWatchdog(ring, [SloRule("step_time_p95", "g", kind="threshold",
+                                    agg="max", threshold=1.0,
+                                    windows=((10.0, 1.0),))])
+    ctrl, t, a = _scaler()
+    wd.on_fire(a.notify_fire)
+    wd.on_clear(a.notify_clear)
+    assert [al.rule for al in wd.evaluate(now=now)] == ["step_time_p95"]
+    assert a.active_up_rules() == ["step_time_p95"]
+    assert a.step() == "up"
+    # recovery: fresh ring values under threshold → clear → (hysteresis
+    # later lets it come down; the transition plumbing is what we pin)
+    for i in range(3):
+        ring.append({"metrics": {"g": {"type": "gauge", "series": [
+            {"labels": {}, "value": 0.1}]}}}, t=now + 20 + i)
+    wd.evaluate(now=now + 22)
+    assert a.active_up_rules() == []

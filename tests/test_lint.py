@@ -856,7 +856,7 @@ def test_run_py_green_on_tree_and_red_on_violation(tmp_path):
     assert summary["new"] == 0
     assert set(summary["per_pass"]) == {
         "tracer_safety", "hot_path", "lock_order", "conventions",
-        "obs_metrics"}
+        "obs_metrics", "control_loops"}
 
     # an injected violation must turn the gate red with file:line:rule
     bad = tmp_path / "tree" / "paddle_tpu"
@@ -1082,3 +1082,150 @@ def test_anonymous_thread_checked_in_tools_scope(tmp_path):
     diags = conventions.run(str(tmp_path))
     assert ("tools/demo.py", "anonymous-thread") in {
         (d.path, d.rule) for d in diags}
+
+
+# ---------------------------------------------------------------------------
+# pass 6: control-loop timing injectability (uninjectable-clock)
+# ---------------------------------------------------------------------------
+
+import control_loops  # noqa: E402
+
+
+def _loop_diags(tmp_path, source, fname="paddle_tpu/mod.py"):
+    p = tmp_path / fname
+    p.parent.mkdir(parents=True, exist_ok=True)
+    init = tmp_path / "paddle_tpu" / "__init__.py"
+    if not init.exists():
+        init.write_text("")
+    p.write_text(textwrap.dedent(source))
+    return control_loops.run(str(tmp_path))
+
+
+_LOOP_BODY = """
+    import threading
+    import time
+
+    class Poller:
+        def __init__(self{extra}):
+            self._stop = threading.Event()
+            self._t = threading.Thread(target=self._loop, daemon=True,
+                                       name="poller")
+
+        def _loop(self):
+            while not self._stop.is_set():
+                time.sleep(0.1)
+"""
+
+
+def test_uninjectable_clock_flagged(tmp_path):
+    diags = _loop_diags(tmp_path, _LOOP_BODY.format(extra=""))
+    assert _rules(diags) == {"uninjectable-clock"}
+
+
+def test_uninjectable_clock_cadence_param_passes(tmp_path):
+    diags = _loop_diags(tmp_path,
+                        _LOOP_BODY.format(extra=", poll_s=0.1"))
+    assert not diags
+
+
+def test_uninjectable_clock_clock_param_passes(tmp_path):
+    diags = _loop_diags(tmp_path,
+                        _LOOP_BODY.format(extra=", clock=time.monotonic"))
+    assert not diags
+
+
+def test_uninjectable_clock_event_wait_deadline_flagged(tmp_path):
+    # <event>.wait(x) IS the loop cadence; a bare .wait() is a signal
+    diags = _loop_diags(tmp_path, """
+        import threading
+
+        class Waiter:
+            def __init__(self):
+                self._stop = threading.Event()
+                self._t = threading.Thread(target=self._loop, daemon=True,
+                                           name="w")
+
+            def _loop(self):
+                while not self._stop.wait(0.5):
+                    pass
+    """)
+    assert _rules(diags) == {"uninjectable-clock"}
+
+
+def test_uninjectable_clock_bare_wait_passes(tmp_path):
+    diags = _loop_diags(tmp_path, """
+        import threading
+
+        class Waiter:
+            def __init__(self):
+                self._go = threading.Event()
+                self._t = threading.Thread(target=self._loop, daemon=True,
+                                           name="w")
+
+            def _loop(self):
+                while True:
+                    self._go.wait()
+    """)
+    assert not diags
+
+
+def test_uninjectable_clock_helper_one_level_flagged(tmp_path):
+    # the _loop delegates its waiting to a self._helper(): still a
+    # control loop — the one-level closure catches it
+    diags = _loop_diags(tmp_path, """
+        import threading
+        import time
+
+        class Delegating:
+            def __init__(self):
+                self._t = threading.Thread(target=self._loop, daemon=True,
+                                           name="d")
+
+            def _tick(self):
+                time.sleep(0.01)
+
+            def _loop(self):
+                while True:
+                    self._tick()
+    """)
+    assert _rules(diags) == {"uninjectable-clock"}
+
+
+def test_uninjectable_clock_no_thread_passes(tmp_path):
+    # sleeping WITHOUT running a thread control loop is not this rule's
+    # business (sleep-no-backoff covers retry loops)
+    diags = _loop_diags(tmp_path, """
+        import time
+
+        class Plain:
+            def wait_a_bit(self):
+                time.sleep(0.1)
+    """)
+    assert not diags
+
+
+def test_uninjectable_clock_ignore_comment(tmp_path):
+    diags = _loop_diags(tmp_path, """
+        import threading
+        import time
+
+        class Poller:  # graftlint: ignore[uninjectable-clock]
+            def __init__(self):
+                self._t = threading.Thread(target=self._loop, daemon=True,
+                                           name="p")
+
+            def _loop(self):
+                time.sleep(0.1)
+    """)
+    assert not diags
+
+
+def test_uninjectable_clock_reshard_and_autoscale_ship_clean():
+    # the satellite contract: the new control-plane classes themselves
+    # pass the rule they motivated
+    import os as _os
+    from common import REPO_ROOT
+    for mod in ("paddle_tpu/ps/reshard.py", "paddle_tpu/ps/autoscale.py"):
+        diags = control_loops.check_file(
+            _os.path.join(REPO_ROOT, mod), REPO_ROOT)
+        assert not diags, diags

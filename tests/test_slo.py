@@ -494,3 +494,68 @@ def test_timeline_renders_slo_alerts_as_instants(tmp_path):
     assert alert["ts"] == pytest.approx(step["ts"])
     assert clear["ts"] == pytest.approx(step["ts"] + 0.5e6)
     assert alert["pid"] == step["pid"]
+
+
+# ---------------------------------------------------------------------------
+# push subscriptions: on_fire / on_clear (the ps/autoscale.py input)
+# ---------------------------------------------------------------------------
+
+def test_watchdog_on_fire_and_on_clear_transitions_only():
+    rule = slo.SloRule("lat_p", "lat", threshold=1.0, budget=0.25,
+                       windows=((8.0, 1.0), (3.0, 1.0)))
+    ring, now = _burn_ring("gggggbbbbb")
+    wd = slo.SloWatchdog(ring, [rule])
+    fired, cleared = [], []
+    wd.on_fire(lambda a: fired.append(a.rule))
+    wd.on_clear(lambda a: cleared.append((a.rule, a.cleared_t)))
+    wd.evaluate(now=now)
+    assert fired == ["lat_p"] and cleared == []
+    # still burning: ACTIVE, not a transition — no re-notify spam
+    wd.evaluate(now=now)
+    assert fired == ["lat_p"]
+    # recover on the same ring: new good ticks clear every window
+    reg = Registry()
+    h = reg.histogram("lat", buckets=(0.1, 1.0))
+    t = now + 1
+    for _ in range(12):
+        h.observe(0.05)
+        ring.append(reg.snapshot(), t=t)
+        t += 1.0
+    wd.evaluate(now=t - 1)
+    assert cleared and cleared[0][0] == "lat_p"
+    assert cleared[0][1] is not None           # the original alert,
+    assert wd.active() == []                   # cleared_t stamped
+    # healthy steady state: neither hook re-fires
+    wd.evaluate(now=t - 1)
+    assert len(fired) == 1 and len(cleared) == 1
+
+
+def test_watchdog_subscriber_errors_counted_not_fatal():
+    rule = slo.SloRule("lat_p", "lat", threshold=1.0, budget=0.25,
+                       windows=((3.0, 1.0),))
+    ring, now = _burn_ring("bbbb")
+    wd = slo.SloWatchdog(ring, [rule])
+    seen = []
+
+    def broken(alert):
+        raise RuntimeError("subscriber bug")
+
+    wd.on_fire(broken)
+    wd.on_fire(lambda a: seen.append(a.rule))  # later subscribers run
+    fired = wd.evaluate(now=now)
+    assert [a.rule for a in fired] == ["lat_p"]
+    assert wd.subscriber_errors == 1
+    assert seen == ["lat_p"]
+
+
+def test_watchdog_on_fire_not_called_while_healthy():
+    rule = slo.SloRule("lat_p", "lat", threshold=1.0, budget=0.25,
+                       windows=((8.0, 1.0),))
+    ring, now = _burn_ring("gggggggg")
+    wd = slo.SloWatchdog(ring, [rule])
+    called = []
+    wd.on_fire(lambda a: called.append(a))
+    wd.on_clear(lambda a: called.append(a))
+    assert wd.evaluate(now=now) == []
+    assert called == []                        # no fire, and no clear
+    #                                           for a never-fired rule

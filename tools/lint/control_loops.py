@@ -1,0 +1,202 @@
+"""graftlint pass 6: control-loop timing injectability.
+
+  uninjectable-clock  a class that runs its own CONTROL LOOP — it
+                   constructs a ``threading.Thread`` whose ``target``
+                   is one of its own methods — and whose loop reads
+                   time (``time.sleep``/``time.monotonic``/
+                   ``time.perf_counter``/``<event>.wait(...)``) while
+                   its ``__init__`` exposes NO timing injection point.
+                   Such a class can only be tested by real sleeping:
+                   the test either races the loop (flaky under load —
+                   the class of bug every "bump the sleep and rerun"
+                   commit is apologizing for) or pays wall-clock per
+                   case. Make the timing constructor-injectable —
+                   either the cadence itself (``period_s=``,
+                   ``poll_s=``, ``hb_interval=`` …) or the clock/sleep
+                   callables (``clock=time.monotonic``,
+                   ``sleep=time.sleep``) — the way Sampler(period_s),
+                   Lease(interval), CircuitBreaker(clock) and
+                   ReshardController(clock, sleep) already do.
+
+An ``__init__`` parameter counts as a timing injection point when its
+name is one of the CLOCK names (clock, sleep, sleep_fn, now, now_fn,
+timer, tick) or contains one of the CADENCE fragments (interval,
+period, poll, timeout, ttl, cooldown, grace, idle, lag, duck, hold,
+delay, backoff, every, _s / _ms suffixes are NOT required — the
+fragment match is substring, case-insensitive).
+
+The loop-body scan covers the thread-target method plus one level of
+``self._helper()`` calls (a ``_loop`` that delegates its waiting to
+``_poll_once`` is still a control loop).
+
+Scope: ``paddle_tpu/`` (library control loops; tools/ demo drivers die
+with their process). Suppression: trailing
+``# graftlint: ignore[uninjectable-clock]`` on the ``class`` line, or
+an allow.txt entry with justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Optional, Set
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from common import (Diagnostic, dotted, line_ignores,  # noqa: E402
+                    relpath, walk_py)
+
+RULE = "uninjectable-clock"
+
+_CLOCK_PARAM_NAMES = {"clock", "sleep", "sleep_fn", "sleep_s", "now",
+                      "now_fn", "timer", "tick"}
+_CADENCE_FRAGMENTS = ("interval", "period", "poll", "timeout", "ttl",
+                      "cooldown", "grace", "idle", "lag", "duck", "hold",
+                      "delay", "backoff", "every")
+
+_TIME_FUNCS = {"sleep", "monotonic", "perf_counter", "time"}
+
+
+def _init_injects_timing(init: ast.FunctionDef) -> bool:
+    args = list(init.args.posonlyargs) + list(init.args.args) + \
+        list(init.args.kwonlyargs)
+    for a in args:
+        name = a.arg.lower()
+        if name in _CLOCK_PARAM_NAMES:
+            return True
+        if any(frag in name for frag in _CADENCE_FRAGMENTS):
+            return True
+    return False
+
+
+def _self_thread_targets(cls: ast.ClassDef) -> Dict[str, ast.Call]:
+    """Method names used as ``target=self.<m>`` in a Thread
+    construction anywhere in the class (module-alias and from-import
+    Thread forms are the caller's concern — we match on the keyword
+    shape: any Call with a ``target=self.X`` keyword and a name ending
+    in 'Thread')."""
+    out: Dict[str, ast.Call] = {}
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted(node.func) or ""
+        if not name.rsplit(".", 1)[-1].endswith("Thread"):
+            continue
+        for kw in node.keywords:
+            if kw.arg == "target" and isinstance(kw.value, ast.Attribute) \
+                    and isinstance(kw.value.value, ast.Name) \
+                    and kw.value.value.id == "self":
+                out[kw.value.attr] = node
+    return out
+
+
+def _timing_call(node: ast.Call, time_aliases: Set[str],
+                 bare_time_funcs: Set[str]) -> bool:
+    name = dotted(node.func)
+    if name in bare_time_funcs:
+        return True
+    if name and "." in name:
+        mod, _, attr = name.rpartition(".")
+        if mod in time_aliases and attr in _TIME_FUNCS:
+            return True
+        # <event>.wait(x) — threading.Event/Condition waits ARE the
+        # loop cadence; a bare .wait() (no deadline) is a pure signal
+        if attr == "wait" and node.args:
+            return True
+    return False
+
+
+def _method_map(cls: ast.ClassDef) -> Dict[str, ast.FunctionDef]:
+    return {n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+def _loop_reads_time(target: ast.FunctionDef,
+                     methods: Dict[str, ast.FunctionDef],
+                     time_aliases: Set[str],
+                     bare_time_funcs: Set[str]) -> Optional[ast.Call]:
+    """The first timing call in the thread target or one level of its
+    ``self._helper()`` callees."""
+    scopes = [target]
+    for node in ast.walk(target):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self" \
+                and node.func.attr in methods:
+            scopes.append(methods[node.func.attr])
+    for scope in scopes:
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Call) and _timing_call(
+                    node, time_aliases, bare_time_funcs):
+                return node
+    return None
+
+
+def check_file(path: str, root: str) -> List[Diagnostic]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+    rel = relpath(path, root)
+    lines = src.splitlines()
+    diags: List[Diagnostic] = []
+
+    time_aliases = {"time"}
+    bare_time_funcs: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time":
+                    time_aliases.add(a.asname or "time")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "time" and not node.level:
+                for a in node.names:
+                    if a.name in _TIME_FUNCS:
+                        bare_time_funcs.add(a.asname or a.name)
+
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        targets = _self_thread_targets(cls)
+        if not targets:
+            continue
+        methods = _method_map(cls)
+        init = methods.get("__init__")
+        if init is not None and _init_injects_timing(init):
+            continue
+        for mname in sorted(targets):
+            m = methods.get(mname)
+            if m is None:
+                continue
+            hit = _loop_reads_time(m, methods, time_aliases,
+                                   bare_time_funcs)
+            if hit is None:
+                continue
+            if RULE in line_ignores(lines, cls.lineno):
+                continue
+            diags.append(Diagnostic(
+                rel, cls.lineno, RULE,
+                f"`{cls.name}` runs a thread control loop "
+                f"(`{mname}` sleeps/reads the clock at line "
+                f"{hit.lineno}) but __init__ exposes no timing "
+                "injection point — deterministic tests are impossible; "
+                "take the cadence (period_s=/poll_s=/…) or the "
+                "clock/sleep callables as constructor parameters "
+                "(the Sampler/Lease/CircuitBreaker pattern), or "
+                "justify with an ignore/allowlist entry"))
+            break  # one diagnostic per class
+    return diags
+
+
+def run(root: str) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for p in walk_py(root, ("paddle_tpu",)):
+        diags.extend(check_file(p, root))
+    return sorted(diags, key=lambda d: (d.path, d.line, d.rule))
+
+
+if __name__ == "__main__":
+    from common import REPO_ROOT
+    for d in run(REPO_ROOT):
+        print(d)

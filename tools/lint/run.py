@@ -23,6 +23,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+import control_loops  # noqa: E402
 import conventions  # noqa: E402
 import lock_order  # noqa: E402
 import obs_metrics  # noqa: E402
@@ -49,6 +50,7 @@ def main(argv=None) -> int:
         "lock_order": lock_order.run,
         "conventions": conventions.run,
         "obs_metrics": obs_metrics.run,
+        "control_loops": control_loops.run,
     }
     diags = []
     per_pass = {}

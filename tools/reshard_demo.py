@@ -1,0 +1,276 @@
+"""Closed-loop elastic reshard demo: the cluster breathes with traffic
+(ISSUE 11 acceptance; the committed RESHARD.json artifact).
+
+A diurnal traffic wave hits a live 2-shard PS job and the control loop
+runs END TO END with nobody's hand on the wheel:
+
+1. a 2-shard HACluster (sync replication ×2) + SyncCommunicator DeepFM
+   stream trainer, preloaded with a RESHARD_ROWS-row table so the
+   migration copies real bulk; an obs Sampler feeds a MetricRing and a
+   SloWatchdog (step-time burn-rate rule calibrated from the warm p95,
+   the slo_demo discipline);
+2. an :class:`~paddle_tpu.ps.autoscale.Autoscaler` subscribes to the
+   watchdog (``on_fire``/``on_clear``) and drives a
+   :class:`~paddle_tpu.ps.reshard.ReshardController` from its own
+   worker thread;
+3. the WAVE arrives (a ``delay-ms`` faultpoint on every client pull —
+   the injectable stand-in for peak traffic): the step-time SLO fires
+   → the autoscaler grows 2 → 4 LIVE (snapshot+tail bootstrap, ms-scale
+   cutover gate) while the trainer keeps streaming;
+4. the wave passes (faultpoint disarmed): the alert clears, the
+   quiet-hold and cooldown pass, and the autoscaler shrinks 4 → 2 —
+   the full breath, journaled;
+5. the artifact records the step-time p95 and shard-count curves, the
+   alert timeline, the scale-event journal (autoscaler decisions +
+   controller operations + the trainer-np target published through the
+   elastic store), and the cutover economics: gate-hold pause p50/p95
+   vs the full-copy bootstrap time — the pause must be a small
+   fraction of the copy (the whole point of snapshot+tail+fence over
+   stop-the-world).
+
+Standalone: prints exactly ONE JSON line (driver contract) and writes
+RESHARD.json (env RESHARD_OUT overrides). Env knobs: RESHARD_ROWS,
+RESHARD_SLOTS, RESHARD_BATCH, RESHARD_STEPS, RESHARD_MAX_EPOCHS,
+RESHARD_PERIOD.
+"""
+
+import json
+import os
+import sys
+import time
+
+METRIC = "reshard_demo"
+
+
+def _pctile(xs, q):
+    if not xs:
+        return 0.0
+    xs = sorted(xs)
+    i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+    return xs[i]
+
+
+def run(out_path: str) -> dict:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import jax
+    import numpy as np
+
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM
+    from paddle_tpu.obs import slo, timeseries
+    from paddle_tpu.ps import ha, rpc
+    from paddle_tpu.ps.autoscale import AutoscaleConfig, Autoscaler
+    from paddle_tpu.ps.communicator import SyncCommunicator
+    from paddle_tpu.ps.faultpoints import arm_faultpoint, disarm_faultpoints
+    from paddle_tpu.ps.ps_trainer import CtrStreamTrainer
+    from paddle_tpu.ps.reshard import ReshardController
+    from paddle_tpu.ps.table import TableConfig
+    from paddle_tpu.distributed import elastic as el
+
+    sys.path.insert(0, os.path.join(repo, "tools"))
+    from obs_overhead_bench import _make_dataset
+
+    S = int(os.environ.get("RESHARD_SLOTS", 6))
+    D = 4
+    rows = int(os.environ.get("RESHARD_ROWS", 150000))
+    batch = int(os.environ.get("RESHARD_BATCH", 256))
+    steps = int(os.environ.get("RESHARD_STEPS", 6))
+    max_epochs = int(os.environ.get("RESHARD_MAX_EPOCHS", 40))
+    period = float(os.environ.get("RESHARD_PERIOD", 0.1))
+    ds = _make_dataset(S, D, batch, steps, nid=2000)
+
+    sampler = scaler = None
+    cluster = ha.HACluster(num_shards=2, replication=2, sync=True,
+                           job_id="reshard-demo")
+    try:
+        client = cluster.client()
+        client.create_sparse_table(
+            0, TableConfig(table_id=0, shard_num=8, accessor="ctr"))
+        # preload the bulk the migration must move: the bootstrap copy
+        # scales with this, the cutover gate hold must NOT
+        bulk = np.arange(1, rows + 1, dtype=np.uint64)
+        for lo in range(0, rows, 1 << 15):
+            client.pull_sparse(0, bulk[lo:lo + (1 << 15)])
+        cluster.drain()
+        comm = SyncCommunicator(client)
+        comm.start()
+        pt.seed(0)
+        trainer = CtrStreamTrainer(
+            DeepFM(CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=8,
+                             dnn_hidden=(32, 32))),
+            optimizer.Adam(1e-3), None,
+            sparse_slots=[f"s{i}" for i in range(S)],
+            dense_slots=[f"d{i}" for i in range(D)], label_slot="label",
+            communicator=comm, table_id=0, embedx_dim=8)
+
+        # -- control plane -----------------------------------------------
+        ring = timeseries.MetricRing(capacity=4096)
+        sampler = timeseries.Sampler(period_s=period, ring=ring).start()
+        wd = slo.SloWatchdog(ring)
+        wd.attach(sampler)
+        ctrl = ReshardController(cluster)
+        scaler = Autoscaler(
+            ctrl, watchdog=wd, ring=ring,
+            config=AutoscaleConfig(
+                min_shards=2, max_shards=4, factor=2,
+                up_rules=("step_time_p95",),
+                cooldown_up_s=3.0, cooldown_down_s=3.0, clear_hold_s=1.5,
+                trainer_np=lambda shards: shards,
+                elastic_job_id="reshard-demo"),
+            poll_s=0.2).start()
+
+        # -- warm + calibrate --------------------------------------------
+        warm_ms = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            r = trainer.train_from_dataset(ds, batch_size=batch)
+            comm.barrier()
+            warm_ms.append((time.perf_counter() - t0) / r["steps"] * 1e3)
+        time.sleep(2.5 * period)
+        threshold_s = max(4.0 * min(warm_ms) / 1e3, 0.02)
+        wd.add_rule(slo.SloRule(
+            "step_time_p95", "trainer_step_time_s",
+            threshold=threshold_s, budget=0.2,
+            windows=((40 * period, 1.0), (10 * period, 1.0))))
+
+        # -- the wave arrives --------------------------------------------
+        delay_ms = max(100, int(threshold_s * 1e3 * 2))
+        wave_t0 = time.time()  # graftlint: ignore[time-time] — artifact wall timestamps
+        arm_faultpoint("rpc.call", "delay-ms", cmd=rpc._PULL_SPARSE,
+                       ms=delay_ms, every=1)
+        up_epochs = 0
+        try:
+            for _ in range(max_epochs):
+                trainer.train_from_dataset(ds, batch_size=batch)
+                comm.barrier()
+                up_epochs += 1
+                if any(e["kind"] == "scale" and e["direction"] == "up"
+                       for e in scaler.events):
+                    break
+        finally:
+            disarm_faultpoints()   # the wave passes
+        scaled_up = [e for e in scaler.events if e["kind"] == "scale"
+                     and e["direction"] == "up"]
+        assert scaled_up, (
+            f"autoscaler never scaled up after {up_epochs} wave epochs "
+            f"(alerts: {wd.alerts()}, journal: {list(scaler.events)})")
+        assert cluster.num_shards == 4, cluster.num_shards
+        alerts = [a for a in wd.alerts() if a["rule"] == "step_time_p95"]
+        assert alerts and alerts[0]["t"] >= 0
+
+        # -- recovery: alert clears, cluster exhales ---------------------
+        # wall-clock bounded, not epoch bounded: the exhale waits out
+        # REAL hysteresis time (quiet-hold + down-cooldown), and calm
+        # epochs are tens of ms each
+        down_epochs = 0
+        calm_deadline = time.perf_counter() + max(
+            30.0, 10 * (scaler.config.clear_hold_s
+                        + scaler.config.cooldown_down_s))
+        while time.perf_counter() < calm_deadline:
+            trainer.train_from_dataset(ds, batch_size=batch)
+            comm.barrier()
+            down_epochs += 1
+            if any(e["kind"] == "scale" and e["direction"] == "down"
+                   for e in scaler.events):
+                break
+        scaled_down = [e for e in scaler.events if e["kind"] == "scale"
+                       and e["direction"] == "down"]
+        assert scaled_down, (
+            f"autoscaler never scaled back down after {down_epochs} "
+            f"calm epochs (active: {wd.active()}, "
+            f"journal: {list(scaler.events)})")
+        assert cluster.num_shards == 2
+        cleared = "step_time_p95" not in wd.active()
+        wave_t1 = time.time()  # graftlint: ignore[time-time] — artifact wall timestamps
+
+        # -- trainer-np lever: the target rode the elastic store ---------
+        mgr = el.ElasticManager(cluster.store, "reshard-demo", np=2,
+                                host="demo", min_np=1, max_np=16)
+        trainer_np_target = mgr.desired_np()
+
+        # -- cutover economics -------------------------------------------
+        pauses = list(ctrl.pause_ms)
+        boots = list(ctrl.bootstrap_s)
+        pause_p95_ms = _pctile(pauses, 0.95)
+        copy_min_ms = min(boots) * 1e3 if boots else 0.0
+        # THE point of snapshot+tail+fence: the writers-blocked window
+        # is a small fraction of the time a stop-the-world copy of the
+        # same rows takes (the bootstrap measures exactly that copy)
+        assert pause_p95_ms < copy_min_ms / 2, (pauses, boots)
+
+        t_base = ring.records()[0]["t"] if len(ring) else 0.0
+
+        def curve(pairs, scale=1.0, nd=3):
+            return [[round(t - t_base, 3), round(v * scale, nd)]
+                    for t, v in pairs]
+
+        rec_out = {
+            "metric": METRIC,
+            "platform": jax.devices()[0].platform,
+            "out": out_path,
+            "rows": rows,
+            "period_s": period,
+            "warm_ms_per_step": round(min(warm_ms), 2),
+            "threshold_ms": round(threshold_s * 1e3, 2),
+            "delay_ms": delay_ms,
+            "wave_epochs": up_epochs,
+            "calm_epochs": down_epochs,
+            "wave_span_s": round(wave_t1 - wave_t0, 2),
+            "alert": alerts[0],
+            "alert_cleared": cleared,
+            "scaled_up": scaled_up[0],
+            "scaled_down": scaled_down[0],
+            "shards_final": cluster.num_shards,
+            "trainer_np_target": trainer_np_target,
+            "cutover_pause_ms": {
+                "all": [round(p, 2) for p in pauses],
+                "p50": round(_pctile(pauses, 0.5), 2),
+                "p95": round(pause_p95_ms, 2),
+            },
+            "bootstrap_copy_s": [round(b, 3) for b in boots],
+            "gate_hold_over_copy": round(
+                pause_p95_ms / max(copy_min_ms, 1e-9), 4),
+            "scale_journal": list(scaler.events),
+            "reshard_journal": list(ctrl.events),
+            "curves": {
+                "step_time_p95_ms": curve(
+                    ring.series("trainer_step_time_s", "p95"), 1e3),
+                "shard_count": curve(
+                    ring.series("ps_shard_count", "value", reduce="last")),
+                "slo_alert_active": curve(
+                    ring.series("slo_alert_active", "value",
+                                labels={"rule": "step_time_p95"},
+                                reduce="last")),
+            },
+        }
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(rec_out, f, indent=1, sort_keys=True)
+        comm.stop()
+        return rec_out
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        if sampler is not None:
+            sampler.stop()
+        cluster.stop()
+
+
+def main() -> int:
+    out = os.environ.get("RESHARD_OUT", os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "RESHARD.json"))
+    try:
+        rec = run(out)
+        rec = {k: v for k, v in rec.items()
+               if k not in ("curves", "scale_journal", "reshard_journal")}
+    except Exception as e:  # one-JSON-line driver contract
+        rec = {"metric": METRIC, "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
