@@ -109,8 +109,14 @@ fi
 
 if [[ "${1:-fast}" == "hot_tier" ]]; then
   echo "== hot_tier gate: HBM tier ≡ RPC-only parity + 0-RPC warm steps =="
-  python -m pytest tests/test_hot_tier.py -q -m ""
-  echo "== sparse_hot bench (0 RPC/step warm + speedup vs RPC-only) =="
+  # test_hot_kernels.py is the Pallas(interpret) ≡ jnp kernel parity
+  # matrix (probe+gather / scatter+apply, all rules, unaligned n);
+  # test_hot_tier.py carries the tier-level matrix (eviction churn,
+  # adam, checkpoint/restore, banked sharded mesh) incl. the pallas
+  # variants — both run before the bench so a rule/kernels regression
+  # fails in seconds
+  python -m pytest tests/test_hot_tier.py tests/test_hot_kernels.py -q -m ""
+  echo "== sparse_hot bench (single-chip + multi-host rung) =="
   PYTHONPATH="$PWD:${PYTHONPATH:-}" SHB_SAMPLES=2048 \
     python tools/sparse_hot_bench.py | python -c "
 import json, sys
@@ -122,8 +128,22 @@ assert 'error' not in d, d
 assert d['hot_tier']['rpc_per_step'] == 0.0, d['hot_tier']
 assert d['hot_tier']['hit_rate'] == 1.0, d['hot_tier']
 assert d['rpc_only']['rpc_per_step'] > 0, d['rpc_only']
-print('sparse_hot OK: %.0f samples/s, %.2fx vs rpc-only, 0 rpc/step warm'
-      % (d['value'], d['speedup_vs_rpc_only']))"
+# the multi-host rung (8 virtual CPU devices in a subprocess when the
+# backend is single-device): warm sharded steps are 0-RPC too, and the
+# hlo_bytes proof — the routed all_to_all id/vector exchange moves
+# FEWER collective bytes than the gathered (all_gather+reduce_scatter)
+# formulation. Byte counts come from the compiled HLO, so this assert
+# is deterministic on a noisy box where timing is not.
+s = d['sharded']; assert 'error' not in s, s
+assert s['rpc_per_step'] == 0.0 and s['hit_rate'] == 1.0, s
+assert s['shards'] == 8 and s['banks'] == 8, s
+ex = s['exchange']
+assert 0 < ex['alltoall']['exchange_bytes'] \
+    < ex['gathered']['exchange_bytes'], ex
+print('sparse_hot OK: %.0f samples/s single (%.2fx vs rpc-only), '
+      '%.0f samples/s sharded, a2a exchange %.2fx of gathered bytes'
+      % (d['value'], d['speedup_vs_rpc_only'], s['samples_per_sec'],
+         ex['alltoall_over_gathered']))"
   echo "CI OK (hot_tier)"
   exit 0
 fi
@@ -294,7 +314,8 @@ fi
 echo "== hot-tier fast checks (parity / eviction churn / 0-RPC warm) =="
 # the hot tier's bit-parity contract is the cheapest place to catch a
 # sparse-rule or flush-back regression — fail it before the full matrix
-python -m pytest tests/test_hot_tier.py -q
+# (test_hot_kernels.py = the fused Pallas-kernel half of the contract)
+python -m pytest tests/test_hot_tier.py tests/test_hot_kernels.py -q
 
 echo "== comm-fusion fast checks (fused dense-DP collectives + hlo_bytes) =="
 # fail the fused-bucket/quantized-collective layer in seconds, before the
@@ -305,7 +326,7 @@ echo "== fast gate (default: -m 'not slow') =="
 # hot-tier/comm-fusion/hlo_bytes already ran above — don't pay them twice
 python -m pytest tests/ -q -x \
   --ignore=tests/test_comm_fusion.py --ignore=tests/test_hlo_bytes.py \
-  --ignore=tests/test_hot_tier.py
+  --ignore=tests/test_hot_tier.py --ignore=tests/test_hot_kernels.py
 
 if [[ "${1:-fast}" == "full" ]]; then
   echo "== full matrix (slow tests included) =="
@@ -360,9 +381,13 @@ assert f32 >= 3.5 * i8, ladder
 print('dense comm ladder OK (int8 moves %.1fx fewer bytes)' % (f32 / i8))"
   # hot-embedding tier: a warm steady-state step must perform ZERO PS
   # RPCs (RpcPsClient.op_counts — the ISSUE 6 acceptance counter) and
-  # the tier must not lose to the RPC-only path it replaces
+  # the tier must not lose to the RPC-only path it replaces.
+  # SHB_SHARDED=0: the dedicated hot_tier gate asserts the multi-host
+  # rung (8-virtual-dev subprocess + exchange-byte proof) — the
+  # embedded copy here would pay another PS cluster + mesh compile
+  # unasserted
   PYTHONPATH="$PWD:${PYTHONPATH:-}" JAX_PLATFORMS=cpu SHB_SAMPLES=2048 \
-    python tools/sparse_hot_bench.py | python -c "
+    SHB_SHARDED=0 python tools/sparse_hot_bench.py | python -c "
 import json, sys
 d = json.loads([l for l in sys.stdin.read().splitlines() if l.startswith('{')][-1])
 assert 'error' not in d, d

@@ -31,7 +31,7 @@ from ..core.enforce import enforce
 from .native import cuckoo_build
 
 __all__ = ["DeviceKeyMap", "DynamicDeviceKeyMap", "device_hash_lookup",
-           "dynamic_map_lookup", "split_keys"]
+           "dynamic_map_lookup", "dynamic_probe_buckets", "split_keys"]
 
 _SLOTS = 4
 _SEED2_XOR = np.uint32(0x7FEB352D)
@@ -147,10 +147,25 @@ class DeviceKeyMap:
 # map is bucketized LINEAR PROBING: host-side mutations patch a bounded
 # probe window, the in-graph probe stays two bucket-row gathers (the
 # same layout-friendly pattern as the cuckoo probe — never slot-wise).
+#
+# BANKS ("Scalable Hash Table for NUMA Systems", PAPERS.md): with
+# ``banks > 1`` the bucket array partitions into ``banks`` contiguous
+# regions and every key hashes FIRST to its bank (a FIXED seed — bank
+# membership survives reseed/grow rebuilds) and then to a bucket inside
+# that bank's region; the probe window wraps within the bank. The hot
+# tier allocates a key's ROW from the same bank's row block, so a bank
+# is a self-contained residency unit: on a GSPMD mesh bank blocks align
+# with the row-shard blocks and a key's owner shard is a pure function
+# of the key — the ``all_to_all`` id/vector exchange ships each id
+# straight to the HBM bank that holds it (the NUMA-local access the
+# paper's per-node banks buy on CPUs).
 # ---------------------------------------------------------------------------
 
 _EMPTY = np.int32(-1)
 _TOMB = np.int32(-2)
+#: fixed bank-hash seed — NEVER rotated (rows must not migrate between
+#: banks when the probe seed rotates on a rebuild)
+_BANK_SEED = 0x243F6A88
 
 
 def _mix32_np(hi: np.ndarray, lo: np.ndarray, seed: int) -> np.ndarray:
@@ -166,20 +181,41 @@ def _mix32_np(hi: np.ndarray, lo: np.ndarray, seed: int) -> np.ndarray:
     return h
 
 
+def dynamic_probe_buckets(nbuckets: int, keys_hi: jax.Array,
+                          keys_lo: jax.Array, seed, probe_buckets: int,
+                          banks: int = 1):
+    """The probe-window bucket ids ([n] int32 per window step) of a
+    :class:`DynamicDeviceKeyMap` — ONE definition of the bank+bucket
+    hash shared by the jnp probe below and the fused Pallas kernels
+    (ops/hot_kernels.py), so the two paths cannot drift. With banks,
+    the window wraps WITHIN the key's bank region."""
+    hi = keys_hi.astype(jnp.uint32)
+    lo = keys_lo.astype(jnp.uint32)
+    nbpb = nbuckets // banks          # buckets per bank (both pow2)
+    local_mask = jnp.uint32(nbpb - 1)
+    base = jnp.uint32(0)
+    if banks > 1:
+        bank = _mix32(hi, lo, jnp.uint32(_BANK_SEED)) & jnp.uint32(banks - 1)
+        base = bank * jnp.uint32(nbpb)
+    b0 = _mix32(hi, lo, seed) & local_mask
+    return [(base + ((b0 + jnp.uint32(t)) & local_mask)).astype(jnp.int32)
+            for t in range(probe_buckets)]
+
+
 def dynamic_map_lookup(table: Dict[str, jax.Array], keys_hi: jax.Array,
-                       keys_lo: jax.Array, probe_buckets: int = 2
-                       ) -> jax.Array:
+                       keys_lo: jax.Array, probe_buckets: int = 2,
+                       banks: int = 1) -> jax.Array:
     """In-graph probe of a :class:`DynamicDeviceKeyMap`: [n] int32 rows
     (−1 = missing). ``probe_buckets`` consecutive bucket-ROW gathers;
     inserts guarantee placement inside that window (else the host
-    rebuilt), so no early-exit-on-empty logic is needed."""
-    mask = jnp.uint32(table["row"].shape[0] - 1)  # nbuckets (power of 2)
+    rebuilt), so no early-exit-on-empty logic is needed. This is the
+    REFERENCE formulation (two separate bucket-row gathers); the fused
+    Pallas probe (ops/hot_kernels.py) must stay bit-identical to it."""
     hi = keys_hi.astype(jnp.uint32)
     lo = keys_lo.astype(jnp.uint32)
-    b0 = _mix32(hi, lo, table["seed"]) & mask
     found = jnp.full(hi.shape, -1, jnp.int32)
-    for t in range(probe_buckets):
-        b = ((b0 + jnp.uint32(t)) & mask).astype(jnp.int32)
+    for b in dynamic_probe_buckets(table["row"].shape[0], hi, lo,
+                                   table["seed"], probe_buckets, banks):
         bh = jnp.take(table["hi"], b, axis=0)    # [n, B]
         bl = jnp.take(table["lo"], b, axis=0)
         br = jnp.take(table["row"], b, axis=0)
@@ -208,23 +244,37 @@ class DynamicDeviceKeyMap:
     tombstone pressure past 25% — triggers a deterministic REBUILD
     (reseed from a fixed sequence, then grow): layout changes only,
     never values, so rebuilds are invisible to training numerics.
+
+    ``banks`` (power of two) partitions the buckets into per-bank
+    regions (see the section comment above): keys hash to a bank with a
+    FIXED seed and probe only inside it, so bank membership is stable
+    across rebuilds and the hot tier can pin a bank's rows to one HBM
+    shard. ``banks=1`` is bit-for-bit the unbanked layout.
     """
 
     _SEEDS = (0x1234ABCD, 0x9E3779B9, 0xDEADBEEF, 0x2545F491)
 
     def __init__(self, capacity: int, sharding=None, bucket_slots: int = 8,
-                 probe_buckets: int = 2) -> None:
+                 probe_buckets: int = 2, banks: int = 1) -> None:
         enforce(capacity > 0, "capacity must be positive")
         self.capacity = int(capacity)
         self.bucket_slots = int(bucket_slots)
         self.probe_buckets = int(probe_buckets)
+        self.banks = int(banks)
+        enforce(self.banks >= 1 and (self.banks & (self.banks - 1)) == 0,
+                f"banks must be a power of two, got {banks}")
         self._sharding = sharding
-        nb = 64
+        nb = max(64, self.banks)
         while nb * bucket_slots < 2 * self.capacity:
             nb <<= 1
         self._seed_idx = 0
         self._init_arrays(nb)
         self.rebuilds = 0
+        #: mutation counter — bumps on every insert/remove/rebuild, so
+        #: callers can cache lookup_host results across a batch window
+        #: and invalidate precisely (the hot tier's prefetch→ensure
+        #: single-scan optimization)
+        self.version = 0
         self._dev: Optional[Dict[str, jax.Array]] = None
         self._patches: list = []   # (bucket, lane) pending device writes
         self._full_upload = True   # first device_state uploads everything
@@ -241,6 +291,27 @@ class DynamicDeviceKeyMap:
 
     # -- host mirror ------------------------------------------------------
 
+    def _bank_local_np(self, hi: np.ndarray, lo: np.ndarray):
+        """(bank-region base bucket, in-bank probe start) per key — the
+        numpy twin of :func:`dynamic_probe_buckets`'s hash math."""
+        nbpb = self.nbuckets // self.banks
+        local = _mix32_np(hi, lo, self.seed) & np.uint32(nbpb - 1)
+        if self.banks == 1:
+            return np.zeros_like(local), local
+        bank = _mix32_np(hi, lo, np.uint32(_BANK_SEED)) \
+            & np.uint32(self.banks - 1)
+        return bank * np.uint32(nbpb), local
+
+    def bank_of(self, keys: np.ndarray) -> np.ndarray:
+        """[n] int32 bank of each key (fixed hash — stable across
+        rebuilds/reseeds; all zeros when banks == 1)."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        if self.banks == 1:
+            return np.zeros(len(keys), np.int32)
+        hi, lo = split_keys(keys)
+        return (_mix32_np(hi, lo, np.uint32(_BANK_SEED))
+                & np.uint32(self.banks - 1)).astype(np.int32)
+
     # graftlint: hot-path
     def lookup_host(self, keys: np.ndarray) -> np.ndarray:
         """[n] int32 rows, −1 = missing (vectorized; the control-plane
@@ -248,11 +319,11 @@ class DynamicDeviceKeyMap:
         if len(keys) == 0:
             return np.zeros(0, np.int32)
         hi, lo = split_keys(keys)
-        mask = np.uint32(self.nbuckets - 1)
-        b0 = _mix32_np(hi, lo, self.seed) & mask
+        base, local = self._bank_local_np(hi, lo)
+        local_mask = np.uint32(self.nbuckets // self.banks - 1)
         found = np.full(len(keys), -1, np.int32)
         for t in range(self.probe_buckets):
-            b = (b0 + np.uint32(t)) & mask
+            b = base + ((local + np.uint32(t)) & local_mask)
             match = ((self.hi[b] == hi[:, None]) & (self.lo[b] == lo[:, None])
                      & (self.row[b] >= 0))
             hit = np.max(np.where(match, self.row[b], -1), axis=1)
@@ -261,11 +332,12 @@ class DynamicDeviceKeyMap:
 
     def _place_one(self, hi: np.uint32, lo: np.uint32, row: int) -> bool:
         """Insert one key (must not be present). False = window full."""
-        mask = np.uint32(self.nbuckets - 1)
-        b0 = _mix32_np(np.asarray([hi], np.uint32),
-                       np.asarray([lo], np.uint32), self.seed)[0] & mask
+        local_mask = np.uint32(self.nbuckets // self.banks - 1)
+        base, local = self._bank_local_np(np.asarray([hi], np.uint32),
+                                          np.asarray([lo], np.uint32))
+        base, b0 = int(base[0]), local[0]
         for t in range(self.probe_buckets):
-            b = int((b0 + np.uint32(t)) & mask)
+            b = base + int((b0 + np.uint32(t)) & local_mask)
             for l in range(self.bucket_slots):
                 if self.row[b, l] < 0:
                     if self.row[b, l] == _TOMB:
@@ -287,6 +359,7 @@ class DynamicDeviceKeyMap:
                 "DynamicDeviceKeyMap over capacity")
         if self.tombstones * 4 > self.nbuckets * self.bucket_slots:
             self._rebuild(grow=False)
+        self.version += 1
         hi, lo = split_keys(keys)
         for i in range(len(keys)):
             while not self._place_one(hi[i], lo[i], int(rows[i])):
@@ -296,13 +369,14 @@ class DynamicDeviceKeyMap:
         """Evict keys (tombstone their slots); missing key = error."""
         if len(keys) == 0:
             return
+        self.version += 1
         hi, lo = split_keys(keys)
-        mask = np.uint32(self.nbuckets - 1)
-        b0s = _mix32_np(hi, lo, self.seed) & mask
+        local_mask = np.uint32(self.nbuckets // self.banks - 1)
+        bases, b0s = self._bank_local_np(hi, lo)
         for i in range(len(keys)):
             placed = False
             for t in range(self.probe_buckets):
-                b = int((b0s[i] + np.uint32(t)) & mask)
+                b = int(bases[i]) + int((b0s[i] + np.uint32(t)) & local_mask)
                 for l in range(self.bucket_slots):
                     if (self.row[b, l] >= 0 and self.hi[b, l] == hi[i]
                             and self.lo[b, l] == lo[i]):
@@ -328,6 +402,7 @@ class DynamicDeviceKeyMap:
         # below must retry with this full list, never re-harvest
         # items() from a half-rebuilt table (that drops the tail)
         keys, rows = self.items()
+        self.version += 1
         # deterministic layout: re-insert in ascending row order
         order = np.argsort(rows, kind="stable")
         keys, rows = keys[order], rows[order]
@@ -385,4 +460,4 @@ class DynamicDeviceKeyMap:
 
     def lookup(self, keys_hi: jax.Array, keys_lo: jax.Array) -> jax.Array:
         return dynamic_map_lookup(self.device_state(), keys_hi, keys_lo,
-                                  self.probe_buckets)
+                                  self.probe_buckets, self.banks)

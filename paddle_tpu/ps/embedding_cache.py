@@ -40,7 +40,8 @@ from .sgd_rule import SGDRuleConfig
 from .table import MemorySparseTable
 
 __all__ = ["CacheConfig", "HbmEmbeddingCache", "cache_pull", "cache_push",
-           "cache_push_dense", "cache_push_sparse", "resolve_push_mode"]
+           "cache_push_dense", "cache_push_sparse", "merge_sparse_grads",
+           "resolve_push_mode"]
 
 
 def resolve_push_mode(mode: str) -> str:
@@ -186,6 +187,25 @@ def cache_push_dense(
             for k, new in zip(names, outs)}
 
 
+def merge_sparse_grads(rows: jax.Array, grads: jax.Array, shows: jax.Array,
+                       clicks: jax.Array, capacity: int):
+    """merge_grad: in-batch dedup (the cub sort+reduce step,
+    heter_comm_inl.h:388, as sorted-unique + segment-sum). ``uniq`` is
+    the (padded) set of distinct rows; padding slots get the sentinel
+    ``capacity`` and are dropped at scatter time. ONE definition shared
+    by :func:`cache_push_sparse` and the fused Pallas scatter+apply
+    kernel (ops/hot_kernels.py) — the f32 merge association is part of
+    the bit-parity contract, so the two paths must not drift."""
+    n = rows.shape[0]
+    uniq, inv = jnp.unique(rows, size=n, fill_value=capacity,
+                           return_inverse=True)
+    inv = inv.reshape(-1)
+    show_sum = jax.ops.segment_sum(shows, inv, num_segments=n)
+    click_sum = jax.ops.segment_sum(clicks, inv, num_segments=n)
+    g = jax.ops.segment_sum(grads, inv, num_segments=n)  # [n, 1+dim]
+    return uniq, show_sum, click_sum, g
+
+
 def cache_push_sparse(
     state: Dict[str, jax.Array],
     rows: jax.Array,  # [n] cache rows (may repeat)
@@ -206,13 +226,8 @@ def cache_push_sparse(
     C = state["embed_w"].shape[0]
     sgd = cfg.sgd
 
-    # merge_grad: in-batch dedup. `uniq` is the (padded) set of distinct
-    # rows; padding slots get sentinel C and are dropped at scatter time.
-    uniq, inv = jnp.unique(rows, size=n, fill_value=C, return_inverse=True)
-    inv = inv.reshape(-1)
-    show_sum = jax.ops.segment_sum(shows, inv, num_segments=n)
-    click_sum = jax.ops.segment_sum(clicks, inv, num_segments=n)
-    g = jax.ops.segment_sum(grads, inv, num_segments=n)  # [n, 1+dim]
+    uniq, show_sum, click_sum, g = merge_sparse_grads(rows, grads, shows,
+                                                      clicks, C)
     srows = jnp.where(uniq < C, uniq, 0)  # safe gather index for padding
 
     gathered = (state["show"][srows], state["click"][srows],

@@ -51,6 +51,8 @@ import numpy as np
 from .. import nn
 from ..core.enforce import enforce
 from ..obs.registry import CounterGroup
+from ..ops.hot_kernels import (hot_probe, hot_probe_gather,
+                               hot_scatter_apply, resolve_hot_kernels)
 from .device_hash import DynamicDeviceKeyMap, dynamic_map_lookup
 from .embedding_cache import CacheConfig, cache_pull, cache_push
 
@@ -70,7 +72,10 @@ class HotTierConfig:
     #: (oldest last appearance); ties break by row id — deterministic
     policy: str = "lfu"
     #: extra victims evicted per shortfall (amortizes writeback RPCs;
-    #: 0 = evict exactly the shortfall)
+    #: 0 = evict exactly the shortfall). PER BANK on a banked tier:
+    #: each short bank evicts its own shortfall + evict_batch extras
+    #: (bank-local churn has bank-local hysteresis), so a batch short
+    #: in every bank writes back up to banks × evict_batch extras
     evict_batch: int = 0
     #: GSPMD mesh + axis: row-shard the tier state over the mesh (the
     #: per-chip-sharded serving layout; None = single-chip)
@@ -92,6 +97,22 @@ class HotTierConfig:
     #: tight can prefer "dense" even off-TPU: its capacity-stream can
     #: undercut the sparse mode's per-key sort at large batches.
     push_mode: str = "auto"
+    #: sparse-kernel implementation (ops/hot_kernels.py): "pallas" runs
+    #: the fused probe+gather and scatter+apply kernels (interpret mode
+    #: off-TPU — the CI/parity configuration), "jnp" the reference
+    #: formulation (two bucket gathers + separate gather + unique/
+    #: gather/update/scatter), "auto" = pallas on TPU, jnp elsewhere.
+    #: The pallas push is the SPARSE (merge_grad) formulation — pair it
+    #: with push_mode="sparse" (or "auto" off-TPU) when pinning parity
+    #: against the jnp oracle.
+    kernels: str = "auto"
+    #: NUMA-style bucket/row banks (ps/device_hash.py): keys hash to a
+    #: bank with a FIXED seed; a bank's rows live in one contiguous HBM
+    #: block that never crosses a mesh-shard boundary, so the sharded
+    #: step's all_to_all ships every id straight to the host that owns
+    #: it. None = one bank per mesh shard (sharded) or 1 (single-chip);
+    #: must be a power of two and a multiple of the shard count.
+    banks: Optional[int] = None
 
 
 _TIER_SEQ = iter(range(1, 1 << 30))  # per-process tier tag allocator
@@ -168,6 +189,20 @@ class HotEmbeddingTier:
             # exchanges over ICI)
             self._map_sharding = NamedSharding(mesh, PartitionSpec())
 
+        # bank layout: default one bank per mesh shard so a key's row
+        # block IS its owner shard's HBM (bank blocks must tile shard
+        # blocks — banks % shards == 0 keeps them nested)
+        self._banks = (self.config.banks if self.config.banks is not None
+                       else max(self._n_shards, 1))
+        enforce(self._banks >= 1
+                and (self._banks & (self._banks - 1)) == 0,
+                f"banks must be a power of two, got {self._banks}")
+        enforce(C % self._banks == 0,
+                "hot-tier capacity must divide evenly over the banks")
+        enforce(self._banks % self._n_shards == 0,
+                f"banks ({self._banks}) must be a multiple of the mesh "
+                f"shard count ({self._n_shards})")
+
         ec = table.accessor
         self._es = ec.embed_rule.state_dim
         self._xs = ec.embedx_rule.state_dim
@@ -182,6 +217,12 @@ class HotEmbeddingTier:
         self._tick = np.zeros(C, np.int64)
         self._clock = 0
         self._prefetched: Dict[int, Any] = {}   # id(batch keys) → future
+        # prefetch→ensure single-scan: prefetch's host-mirror probe is
+        # cached (keyed by the keys ARRAY OBJECT — the reference held
+        # here keeps its id unique) and ensure() reuses it when the map
+        # hasn't mutated since (version match), halving the warm path's
+        # per-batch mirror scans
+        self._probe_cache: Dict[int, Tuple[Any, np.ndarray, int]] = {}
         self._reset_resident_set()
         # registry-backed counters (obs/registry.py CounterGroup): the
         # dict-shaped increments below are unchanged, but every count
@@ -197,22 +238,29 @@ class HotEmbeddingTier:
     def _reset_resident_set(self) -> None:
         """Fresh map/state/control-plane — cold construction AND the
         post-restore drop() share this so the two can never
-        desynchronize (same spread layout, same fill order)."""
+        desynchronize (same bank layout, same fill order)."""
         C = self.config.capacity
-        self.device_map = DynamicDeviceKeyMap(C, sharding=self._map_sharding)
+        self.device_map = DynamicDeviceKeyMap(C, sharding=self._map_sharding,
+                                              banks=self._banks)
         self.state = self._fresh_state()
         self._valid[:] = False
         self._dirty[:] = False
         self._freq[:] = 0
         self._tick[:] = 0
         self._keys[:] = 0
-        # free spread-row ids, round-robin over shards so residency
-        # fills every shard evenly (shard_spread_rows placement)
-        block = C // self._n_shards
-        order = np.arange(C)
-        self._free = list(((order % self._n_shards) * block
-                           + order // self._n_shards)[::-1])
+        # per-bank free row lists: bank b owns the contiguous block
+        # [b·C/banks, (b+1)·C/banks) — the bucketized bank layout. Keys
+        # hash uniformly over banks (DynamicDeviceKeyMap.bank_of), so
+        # residency fills every bank (and therefore every mesh shard —
+        # bank blocks tile shard blocks) evenly, replacing the old
+        # round-robin spread with a placement the in-graph routing can
+        # derive from the key alone.
+        Cb = C // self._banks
+        self._free = [list(range(b * Cb, (b + 1) * Cb))[::-1]
+                      for b in range(self._banks)]
+        self._row_bank = np.arange(C) // Cb  # row id → owning bank
         self._prefetched.clear()
+        self._probe_cache.clear()
 
     # -- state ------------------------------------------------------------
 
@@ -257,7 +305,13 @@ class HotEmbeddingTier:
         determinism holds only without overlapping prefetches (the sync
         trainer does not prefetch; async modes accept the same staleness
         envelope as their pull-ahead)."""
-        missing, slots = self._missing_of(keys)
+        keys = np.ascontiguousarray(keys, np.uint64)
+        rows = self.device_map.lookup_host(keys)
+        if len(self._probe_cache) > 64:   # unconsumed callers — bound it
+            self._probe_cache.clear()
+        self._probe_cache[id(keys)] = (keys, rows,
+                                       self.device_map.version)
+        missing, slots = self._missing_of(keys, rows=rows)
         if len(missing) == 0:
             return
         fetch = (lambda m=missing, s=slots:
@@ -285,13 +339,14 @@ class HotEmbeddingTier:
         return hash((len(keys), int(keys[0]), int(keys[-1]),
                      int(keys[len(keys) // 2])))
 
-    def _missing_of(self, keys: np.ndarray
+    def _missing_of(self, keys: np.ndarray, rows: Optional[np.ndarray] = None
                     ) -> Tuple[np.ndarray, np.ndarray]:
         """First-occurrence-order unique non-resident keys + their slot
         ids (key>>32). Order matters: the PS creates missing rows in
         request order, and the RPC-only oracle's pull creates the same
         new keys in the same order — same per-shard rng draws."""
-        rows = self.device_map.lookup_host(keys)
+        if rows is None:
+            rows = self.device_map.lookup_host(keys)
         miss = keys[rows < 0]
         if len(miss) == 0:
             return miss, miss
@@ -314,7 +369,12 @@ class HotEmbeddingTier:
         writeback)."""
         keys = np.ascontiguousarray(keys, np.uint64)
         self._clock += 1
-        rows = self.device_map.lookup_host(keys)
+        cached = self._probe_cache.pop(id(keys), None)
+        if cached is not None and cached[0] is keys \
+                and cached[2] == self.device_map.version:
+            rows = cached[1]   # prefetch's scan, map unchanged since
+        else:
+            rows = self.device_map.lookup_host(keys)
         n_hit = int((rows >= 0).sum())
         self.counters["hits"] += n_hit
         self.counters["misses"] += len(keys) - n_hit
@@ -350,11 +410,13 @@ class HotEmbeddingTier:
                batch_keys: np.ndarray) -> None:
         if len(missing) == 0:
             return
-        need = len(missing) - len(self._free)
-        if need > 0:
-            self._evict(need, batch_keys)
-        new_rows = np.asarray([self._free.pop() for _ in range(len(missing))],
-                              np.int64)
+        # per-bank shortfall: each key admits into ITS bank's row block
+        bk = self.device_map.bank_of(missing)
+        counts = np.bincount(bk, minlength=self._banks)
+        needs = counts - np.asarray([len(f) for f in self._free])
+        if (needs > 0).any():
+            self._evict(np.maximum(needs, 0), batch_keys)
+        new_rows = np.asarray([self._free[b].pop() for b in bk], np.int64)
         cols = self._full_to_cols(values)
         k = _pow2_pad(len(missing))
         pad_rows = np.full(k, self.config.capacity, np.int64)
@@ -372,26 +434,37 @@ class HotEmbeddingTier:
         self._freq[new_rows] = 0
         self._tick[new_rows] = self._clock
 
-    def _evict(self, need: int, batch_keys: np.ndarray) -> None:
-        """Deterministic victim selection + dirty writeback."""
+    def _evict(self, needs: np.ndarray, batch_keys: np.ndarray) -> None:
+        """Deterministic victim selection + dirty writeback. ``needs``
+        is the PER-BANK shortfall — victims come from the short bank's
+        own row block (a key can only admit into its bank, so evicting
+        elsewhere would not free a usable slot)."""
         protect = np.zeros(self.config.capacity, bool)
         r = self.device_map.lookup_host(batch_keys)
         protect[r[r >= 0]] = True
-        cand = np.flatnonzero(self._valid & ~protect)
-        count = min(need + int(self.config.evict_batch), len(cand))
-        enforce(count >= need,
-                "hot tier capacity smaller than one batch's working set — "
-                "raise HotTierConfig.capacity")
-        if self.config.policy == "lfu":
-            order = np.lexsort((cand, self._tick[cand], self._freq[cand]))
-        else:  # lru
-            order = np.lexsort((cand, self._freq[cand], self._tick[cand]))
-        victims = cand[order[:count]]
+        evictable = self._valid & ~protect
+        victims_all = []
+        for b in np.flatnonzero(needs > 0):
+            need = int(needs[b])
+            cand = np.flatnonzero(evictable & (self._row_bank == b))
+            count = min(need + int(self.config.evict_batch), len(cand))
+            enforce(count >= need,
+                    f"hot tier bank {b} smaller than one batch's working "
+                    "set — raise HotTierConfig.capacity (per-bank budget "
+                    "is capacity/banks)")
+            if self.config.policy == "lfu":
+                order = np.lexsort((cand, self._tick[cand], self._freq[cand]))
+            else:  # lru
+                order = np.lexsort((cand, self._freq[cand], self._tick[cand]))
+            victims_all.append(cand[order[:count]])
+        victims = np.concatenate(victims_all) if victims_all else \
+            np.zeros(0, np.int64)
         self.writeback(victims[self._dirty[victims]])
         self.device_map.remove(self._keys[victims])
         self._valid[victims] = False
         self._dirty[victims] = False
-        self._free.extend(int(v) for v in victims)
+        for v in victims:
+            self._free[self._row_bank[v]].append(int(v))
         self.counters["evictions"] += len(victims)
 
     # -- flush-back (EndPass semantics, incremental) ----------------------
@@ -493,7 +566,8 @@ class HotEmbeddingTier:
         self.device_map.remove(self._keys[rows])
         self._valid[rows] = False
         self._dirty[rows] = False
-        self._free.extend(int(r) for r in rows)
+        for r in rows:
+            self._free[self._row_bank[r]].append(int(r))
         return len(rows)
 
     # -- observability ----------------------------------------------------
@@ -510,6 +584,9 @@ class HotEmbeddingTier:
             "dirty": int((self._valid & self._dirty).sum()),
             "map_rebuilds": self.device_map.rebuilds,
             "shards": self._n_shards,
+            "banks": self._banks,
+            "kernels": "pallas" if resolve_hot_kernels(self.config.kernels)
+                       else "jnp",
         }
 
 
@@ -535,39 +612,53 @@ def _stream_loss_fn(model, dense_x, labels):
 
 def make_hot_ctr_train_step(model, optimizer, cache_cfg: CacheConfig,
                             slot_ids: Sequence[int], donate: bool = True,
-                            probe_buckets: int = 2):
+                            probe_buckets: int = 2, banks: int = 1,
+                            kernels: str = "auto"):
     """Single-chip hot-tier step: in-graph map probe → in-graph pull →
     fwd/bwd → dense update → in-graph CTR push. A warm batch never
     touches the host beyond shipping the lo32 key halves.
-    ``probe_buckets`` MUST be the map's own window (the trainer passes
-    ``tier.device_map.probe_buckets``): a narrower in-graph probe than
-    the host mirror's would silently miss host-resident keys.
+    ``probe_buckets`` and ``banks`` MUST be the map's own layout (the
+    trainer passes ``tier.device_map.probe_buckets``/``.banks``): a
+    narrower in-graph probe than the host mirror's would silently miss
+    host-resident keys. ``kernels`` selects the fused Pallas
+    probe+gather / scatter+apply kernels (ops/hot_kernels.py) vs the
+    jnp reference formulation — bit-identical by contract.
 
     step(params, opt_state, tier_state, map_state, keys_lo [B,S] u32,
          dense_x, labels) → (params, opt_state, tier_state, loss)
     """
     slot_hi = jnp.asarray(np.asarray(slot_ids, np.uint32))[None, :]
+    use_pallas = resolve_hot_kernels(kernels)
 
     def step(params, opt_state, tier_state, map_state, keys_lo, dense_x,
              labels):
         B, S = keys_lo.shape
         hi = jnp.broadcast_to(slot_hi, (B, S)).reshape(-1)
-        rows = dynamic_map_lookup(map_state, hi, keys_lo.reshape(-1),
-                                  probe_buckets)
         C = tier_state["embed_w"].shape[0]
-        # ensure() guarantees residency; sentinel-map anyway (a miss
-        # pulls zeros and drops its push instead of corrupting row C-1)
-        rows = jnp.where(rows >= 0, rows, C)
-        emb = cache_pull(tier_state, rows).reshape(B, S, -1)
+        if use_pallas:
+            # ONE kernel pass: probe buckets + matched value row
+            rows, emb = hot_probe_gather(
+                map_state, hi, keys_lo.reshape(-1), tier_state,
+                probe_buckets=probe_buckets, banks=banks)
+            rows = jnp.where(rows >= 0, rows, C)
+            emb = emb.reshape(B, S, -1)
+        else:
+            rows = dynamic_map_lookup(map_state, hi, keys_lo.reshape(-1),
+                                      probe_buckets, banks)
+            # ensure() guarantees residency; sentinel-map anyway (a miss
+            # pulls zeros and drops its push instead of corrupting C-1)
+            rows = jnp.where(rows >= 0, rows, C)
+            emb = cache_pull(tier_state, rows).reshape(B, S, -1)
         loss_fn = _stream_loss_fn(model, dense_x, labels)
         (loss, _), (grads, emb_grad) = jax.value_and_grad(
             loss_fn, argnums=(0, 1), has_aux=True)(params, emb)
         new_params, new_opt = optimizer.update(grads, opt_state, params)
         shows = jnp.ones((B * S,), jnp.float32)
         clicks = jnp.repeat(labels.astype(jnp.float32), S)
-        new_tier = cache_push(tier_state, rows,
-                              emb_grad.reshape(B * S, -1), shows, clicks,
-                              cache_cfg)
+        push = hot_scatter_apply if use_pallas else cache_push
+        new_tier = push(tier_state, rows,
+                        emb_grad.reshape(B * S, -1), shows, clicks,
+                        cache_cfg)
         return new_params, new_opt, new_tier, loss
 
     # donate ONLY the tier state (the HBM-scale buffer): params/opt are
@@ -582,13 +673,18 @@ def make_sharded_hot_train_step(model, optimizer, cache_cfg: CacheConfig,
                                 axis: str = "ps", donate: bool = True,
                                 routing="auto", cap_factor: float = 2.0,
                                 pre_dedup: bool = True,
-                                probe_buckets: int = 2):
-    """Multi-chip hot-tier step: each device probes its LOCAL batch
-    slice against the replicated dynamic map, then the row exchange
-    rides the keyed tier's ``all_to_all`` routing (ps/sharded_cache.py
-    routed pull/push over the spread-sharded rows) — the persistent-tier
-    upgrade of ``make_sharded_ctr_train_step_from_keys`` (static per-pass
-    cuckoo → cross-step insert/evict map).
+                                probe_buckets: int = 2, banks: int = 1,
+                                kernels: str = "auto"):
+    """Multi-host hot-tier step: each device probes its LOCAL batch
+    slice against the replicated dynamic map (the fused Pallas probe
+    when ``kernels`` selects it), then the id/vector exchange rides the
+    keyed tier's ``all_to_all`` routing (ps/sharded_cache.py routed
+    pull/push) and the OWNER shard applies the fused scatter+optimizer
+    kernel on its local bank block. With the banked map (``banks`` a
+    multiple of the shard count) a key's row lives in its hash-bank's
+    block, which never crosses a shard boundary — the exchange ships
+    each id straight to the HBM bank that holds it, and each host's
+    residency/eviction/writeback is a self-contained bank set.
 
     step(params, opt_state, tier_state, map_state, keys_lo, dense_x,
          labels) → (params, opt_state, tier_state, loss, overflow)
@@ -601,19 +697,27 @@ def make_sharded_hot_train_step(model, optimizer, cache_cfg: CacheConfig,
     _check_routing_arg(routing)
     K = mesh.shape[axis]
     slot_hi = jnp.asarray(np.asarray(slot_ids, np.uint32))[None, :]
+    use_pallas = resolve_hot_kernels(kernels)
+    # the owner-side push: the fused kernel is a drop-in cache_push with
+    # sparse-formulation semantics (hot_kernels.hot_scatter_apply)
+    push_fn = hot_scatter_apply if use_pallas else None
 
     def inner(params, opt_state, tier_state, map_state, keys_lo, dense_x,
               labels):
         B, S = keys_lo.shape  # local slice
         hi = jnp.broadcast_to(slot_hi, (B, S)).reshape(-1)
-        rows = dynamic_map_lookup(map_state, hi, keys_lo.reshape(-1),
-                                  probe_buckets)
+        if use_pallas:
+            rows = hot_probe(map_state, hi, keys_lo.reshape(-1),
+                             probe_buckets=probe_buckets, banks=banks)
+        else:
+            rows = dynamic_map_lookup(map_state, hi, keys_lo.reshape(-1),
+                                      probe_buckets, banks)
         C_total = tier_state["embed_w"].shape[0] * K  # global capacity
         rows = jnp.where(rows >= 0, rows, C_total)  # sentinel: no owner
         return _sharded_step_body(model, optimizer, cache_cfg, axis, K,
                                   params, opt_state, tier_state, rows, B, S,
                                   dense_x, labels, routing, cap_factor,
-                                  pre_dedup)
+                                  pre_dedup, push_fn=push_fn)
 
     shmapped = shard_map(
         inner, mesh=mesh,

@@ -599,15 +599,18 @@ class CtrStreamTrainer:
             slot_ids = np.arange(len(self.sparse_slots))
             tc = self.hot_tier.config
             pb = self.hot_tier.device_map.probe_buckets
+            bks = self.hot_tier.device_map.banks
             if tc.mesh is not None:
                 self._hot_step = make_sharded_hot_train_step(
                     model, optimizer, self.hot_tier.cache_config, tc.mesh,
                     slot_ids=slot_ids, axis=tc.axis, routing=tc.routing,
-                    cap_factor=tc.cap_factor, probe_buckets=pb)
+                    cap_factor=tc.cap_factor, probe_buckets=pb, banks=bks,
+                    kernels=tc.kernels)
             else:
                 self._hot_step = make_hot_ctr_train_step(
                     model, optimizer, self.hot_tier.cache_config,
-                    slot_ids=slot_ids, probe_buckets=pb)
+                    slot_ids=slot_ids, probe_buckets=pb, banks=bks,
+                    kernels=tc.kernels)
 
     # -- job checkpoint surface (io/job_checkpoint.py) --------------------
 
@@ -826,59 +829,92 @@ class CtrStreamTrainer:
         tier = self.hot_tier
         sharded = tier.config.mesh is not None
         overflow = None  # device scalar accumulator (sharded routing)
+        # deferred loss sync: the hot step is fully in-graph, so keeping
+        # the loss as a DEVICE scalar lets the dispatch return while the
+        # chip still computes — the next batch's host work (key tagging,
+        # ensure() mirror lookups, H2D) overlaps the step in front of it
+        # (the CtrPassTrainer losses-list pattern). The pass-end
+        # conversion runs the SAME per-step float() accumulation, so the
+        # reported mean loss is bit-identical to the per-step sync.
+        losses: list = []
+
+        from ..data.prefetcher import DevicePrefetcher
+
+        # batch PACKING (dataset column slicing, key tagging, H2D
+        # staging) is pure read-only work — it runs on the prefetcher
+        # thread and overlaps the compiled steps, exactly the
+        # CtrPassTrainer feed pattern. Tier mutations (prefetch issue,
+        # ensure) STAY on the training thread: the host mirror is not
+        # thread-safe and the creation-order determinism contract
+        # depends on the single consumer.
+        def _packed_batches():
+            for batch in dataset.batch_iter(batch_size, **kw):
+                keys = _slot_tagged_keys(batch, self.sparse_slots)
+                flat = keys.reshape(-1)
+                dense, labels = _dense_and_labels(
+                    batch, self.dense_slots, self.label_slot, keys.shape[0])
+                lo32 = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+                yield (flat, jnp.asarray(lo32), jnp.asarray(dense),
+                       jnp.asarray(labels), int(labels.shape[0]))
 
         # graftlint: hot-path
-        def _prep(batch):
-            keys = _slot_tagged_keys(batch, self.sparse_slots)
-            flat = keys.reshape(-1)
-            dense, labels = _dense_and_labels(batch, self.dense_slots,
-                                              self.label_slot, keys.shape[0])
+        def _prep(item):
             if depth > 0:
                 # issue the COLD fetch for batch N+depth's misses now —
                 # warm batches fetch nothing, so this is free in steady
                 # state and hides the PS round-trip when residency moves
-                tier.prefetch(flat, self.communicator)
-            return keys, flat, dense, labels
+                tier.prefetch(item[0], self.communicator)
+            return item
 
         # graftlint: hot-path
         def _run(item):
-            keys, flat, dense, labels = item
             t_step = time.perf_counter()
             with RecordEvent("ctr_hot_step"):
-                _run_body(keys, flat, dense, labels)
+                _run_body(*item)
             self._h_step.observe(time.perf_counter() - t_step)
 
         # graftlint: hot-path
-        def _run_body(keys, flat, dense, labels):
+        def _run_body(flat, lo32, dense, labels, n_real):
             nonlocal overflow
             tier.ensure(flat)
-            lo32 = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
             map_state = tier.device_map.device_state()
             out = self._hot_step(self.params, self.opt_state, tier.state,
-                                 map_state, jnp.asarray(lo32),
-                                 jnp.asarray(dense), jnp.asarray(labels))
+                                 map_state, lo32, dense, labels)
             self.params, self.opt_state, tier.state, loss = out[:4]
             if sharded:
                 ov = out[4]
                 overflow = ov if overflow is None else overflow + ov
+            losses.append(loss)  # device scalar — no sync here
+            if len(losses) >= 4096:
+                # bounded retention: steps this old finished long ago,
+                # so draining the prefix costs no overlap (same
+                # per-item float() order as the pass-end drain)
+                for l in losses:
+                    stats.loss_sum += float(l)
+                losses.clear()
             stats.steps += 1
-            stats.samples += int(labels.shape[0])
-            stats.loss_sum += float(loss)
+            stats.samples += n_real
             self.batches_done += 1
             self._maybe_checkpoint(checkpoint, checkpoint_every, batch_size)
 
         t0 = time.perf_counter()
         window: deque = deque()
+        pf = DevicePrefetcher(_packed_batches(), depth=max(depth, 2))
         try:
-            for batch in dataset.batch_iter(batch_size, **kw):
-                window.append(_prep(batch))
+            for item in pf:
+                window.append(_prep(item))
                 if len(window) > depth:
                     _run(window.popleft())
             while window:
                 _run(window.popleft())
         finally:
+            pf.close()
             if depth > 0 and self.communicator is not None:
                 self.communicator._drain_pulls()
+        # ONE host sync for the whole pass (per-item float() keeps the
+        # accumulation association identical to a per-step sync)
+        for l in losses:
+            stats.loss_sum += float(l)
         if overflow is not None:
             from .sharded_cache import check_route_overflow
 

@@ -340,6 +340,157 @@ def test_hot_tier_stats_and_drop():
     assert tier.stats()["occupancy"] == 3
 
 
+# ---------------------------------------------------------------------------
+# fused Pallas kernels (ops/hot_kernels.py) — tier-level parity matrix.
+# Kernel-level parity (vs the jnp formulations, every rule, unaligned n)
+# is pinned in tests/test_hot_kernels.py; here the kernels run inside
+# the REAL compiled steps (interpret mode on CPU) and must reproduce
+# the jnp tier AND the RPC-only oracle bit-for-bit through eviction
+# churn, adam rules, checkpoint/restore and the sharded banked mesh.
+# ---------------------------------------------------------------------------
+
+
+def test_hot_tier_pallas_parity_through_eviction_churn():
+    """kernels="pallas" (interpret) ≡ kernels="jnp" ≡ RPC-only oracle
+    under heavy eviction/readmission churn: dense params/opt bitwise,
+    table rows bitwise between the two tiers (same flush points ⇒ full
+    equality incl. delta_score), rows-mod-delta vs the oracle."""
+    ds = make_data(nid=400)
+    ta = MemorySparseTable(TableConfig(shard_num=4, accessor="ctr"))
+    a = make_trainer(ta)
+    a.train_from_dataset(ds, batch_size=64)
+    tb = MemorySparseTable(TableConfig(shard_num=4, accessor="ctr"))
+    b = make_trainer(tb, hot=HotTierConfig(capacity=224, kernels="jnp"))
+    b.train_from_dataset(ds, batch_size=64)
+    b.hot_tier.flush()
+    tc = MemorySparseTable(TableConfig(shard_num=4, accessor="ctr"))
+    c = make_trainer(tc, hot=HotTierConfig(capacity=224, kernels="pallas"))
+    rc = c.train_from_dataset(ds, batch_size=64)
+    c.hot_tier.flush()
+    st = rc["hot_tier"]
+    assert st["evictions"] > 0 and st["kernels"] == "pallas"
+    _assert_bitwise_equal(_leaves(a.params), _leaves(c.params))
+    _assert_bitwise_equal(_leaves(b.params), _leaves(c.params))
+    _assert_bitwise_equal(_leaves(b.opt_state), _leaves(c.opt_state))
+    kb, vb = _sorted_items(tb)
+    kc, vc = _sorted_items(tc)
+    np.testing.assert_array_equal(kb, kc)
+    np.testing.assert_array_equal(vb, vc)  # incl. delta_score
+    _assert_rows_equal_mod_delta(ta, tc)
+
+
+def test_hot_tier_pallas_adam_rule_parity():
+    """The adam half of the kernel parity matrix at tier level: an
+    adam/adam accessor trains bit-identically through the fused
+    kernels (m/v moments and beta powers round-trip the writeback)."""
+    from paddle_tpu.ps.accessor import AccessorConfig
+
+    acc = AccessorConfig(embed_sgd_rule="adam", embedx_sgd_rule="adam")
+    ds = make_data(nid=120)
+    ta = MemorySparseTable(TableConfig(shard_num=4, accessor="ctr",
+                                       accessor_config=acc))
+    a = make_trainer(ta)
+    a.train_from_dataset(ds, batch_size=64)
+    tb = MemorySparseTable(TableConfig(shard_num=4, accessor="ctr",
+                                       accessor_config=acc))
+    b = make_trainer(tb, hot=HotTierConfig(capacity=256, kernels="pallas"))
+    b.train_from_dataset(ds, batch_size=64)
+    b.hot_tier.flush()
+    _assert_bitwise_equal(_leaves(a.params), _leaves(b.params))
+    _assert_rows_equal_mod_delta(ta, tb)
+
+
+def test_hot_tier_pallas_checkpoint_restore_parity():
+    """Mid-stream checkpoint → restore → resume with kernels="pallas":
+    final digests AND dense state bitwise equal to an uninterrupted
+    pallas oracle (the kernels change nothing about the flush-dirty-
+    then-snapshot contract)."""
+    from paddle_tpu.io.job_checkpoint import JobCheckpointManager
+
+    tmp = tempfile.mkdtemp()
+    ds = make_data(n=384, nid=120)
+    cfg = lambda: HotTierConfig(capacity=256, kernels="pallas")  # noqa: E731
+    ta = MemorySparseTable(TableConfig(shard_num=4, accessor="ctr"))
+    a = make_trainer(ta, hot=cfg())
+    mga = JobCheckpointManager(os.path.join(tmp, "a"), max_keep=8)
+    mga.register_sparse("ctr", ta)
+    a.train_from_dataset(ds, batch_size=128, checkpoint=mga,
+                         checkpoint_every=2)
+    mga.stop()
+    a.hot_tier.flush()
+
+    tb = MemorySparseTable(TableConfig(shard_num=4, accessor="ctr"))
+    b = make_trainer(tb, hot=cfg())
+    mgr = JobCheckpointManager(os.path.join(tmp, "b"), max_keep=8)
+    mgr.register_sparse("ctr", tb)
+    b.train_from_dataset(ds, batch_size=128, checkpoint=mgr,
+                         checkpoint_every=2)
+    mgr.wait()
+    restored = mgr.load_latest()
+
+    tc = MemorySparseTable(TableConfig(shard_num=4, accessor="ctr"))
+    c = make_trainer(tc, hot=cfg())
+    restored.restore_sparse("ctr", tc)
+    c.restore_train_state(restored.dense)
+    assert c.hot_tier.stats()["occupancy"] == 0
+    c.train_from_dataset(ds, batch_size=128, start_batch=restored.cursor)
+    c.hot_tier.flush()
+    mgr.stop()
+    assert tc.digest() == ta.digest()
+    _assert_bitwise_equal(_leaves(a.params), _leaves(c.params))
+    _assert_bitwise_equal(_leaves(a.opt_state), _leaves(c.opt_state))
+
+
+def test_hot_tier_banked_single_chip_parity():
+    """banks > 1 on a single chip (the NUMA bucket-per-bank layout)
+    changes row PLACEMENT only: training results are bit-identical to
+    the unbanked tier (ample capacity — no eviction-timing skew)."""
+    ds = make_data(n=256, nid=60)
+    ta = MemorySparseTable(TableConfig(shard_num=4, accessor="ctr"))
+    a = make_trainer(ta, hot=HotTierConfig(capacity=512))
+    a.train_from_dataset(ds, batch_size=64)
+    a.hot_tier.flush()
+    tb = MemorySparseTable(TableConfig(shard_num=4, accessor="ctr"))
+    b = make_trainer(tb, hot=HotTierConfig(capacity=512, banks=4,
+                                           kernels="pallas"))
+    rb = b.train_from_dataset(ds, batch_size=64)
+    b.hot_tier.flush()
+    assert rb["hot_tier"]["banks"] == 4
+    _assert_bitwise_equal(_leaves(a.params), _leaves(b.params))
+    ka, va = _sorted_items(ta)
+    kb, vb = _sorted_items(tb)
+    np.testing.assert_array_equal(ka, kb)
+    np.testing.assert_array_equal(va, vb)
+
+
+def test_hot_tier_sharded_banked_pallas_matches_jnp_bitwise():
+    """8-shard mesh, banked map (one bank per shard — a key's row block
+    IS its owner's HBM): the pallas sharded step (fused local probe +
+    owner-side scatter+apply behind the all_to_all exchange) is
+    BIT-identical to the jnp sharded step — same routing, same merge
+    association, same sealed rule bits."""
+    ds = make_data(n=512, nid=60)
+    mesh = mesh_mod.make_mesh({"ps": 8})
+    tb = MemorySparseTable(TableConfig(shard_num=4, accessor="ctr"))
+    b = make_trainer(tb, HotTierConfig(capacity=512, mesh=mesh, axis="ps",
+                                       kernels="jnp"))
+    rb = b.train_from_dataset(ds, batch_size=128)
+    b.hot_tier.flush()
+    assert rb["hot_tier"]["shards"] == 8 and rb["hot_tier"]["banks"] == 8
+    tc = MemorySparseTable(TableConfig(shard_num=4, accessor="ctr"))
+    c = make_trainer(tc, HotTierConfig(capacity=512, mesh=mesh, axis="ps",
+                                       kernels="pallas"))
+    rc = c.train_from_dataset(ds, batch_size=128)
+    c.hot_tier.flush()
+    assert rc["loss"] == rb["loss"]
+    _assert_bitwise_equal(_leaves(b.params), _leaves(c.params))
+    _assert_bitwise_equal(_leaves(b.opt_state), _leaves(c.opt_state))
+    kb, vb = _sorted_items(tb)
+    kc, vc = _sorted_items(tc)
+    np.testing.assert_array_equal(kb, kc)
+    np.testing.assert_array_equal(vb, vc)
+
+
 def test_hot_tier_rejects_mismatched_embedx_dim():
     table = MemorySparseTable(TableConfig(shard_num=2, accessor="ctr"))
     pt.seed(0)
