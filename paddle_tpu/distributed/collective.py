@@ -154,8 +154,11 @@ class TCPStore:
 
     def _rpc(self, **req) -> Dict[str, Any]:
         with self._lock:
-            self._sock.sendall((json.dumps(req) + "\n").encode())
-            line = self._rfile.readline()
+            # the lock EXISTS to serialize request/response pairing on
+            # this one socket — the IO is the protected resource, there
+            # is no hot path behind it (store bootstrap control plane)
+            self._sock.sendall((json.dumps(req) + "\n").encode())  # graftlint: lock-ok wire-pairing mutex, control plane
+            line = self._rfile.readline()  # graftlint: lock-ok wire-pairing mutex, control plane
         if not line:
             raise PreconditionNotMetError("TCPStore connection closed")
         return json.loads(line)

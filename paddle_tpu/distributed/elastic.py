@@ -19,6 +19,8 @@ from __future__ import annotations
 import enum
 import json
 import os
+# lock discipline (tools/lint/py_locks.py; docs/STATIC_ANALYSIS.md):
+# LOCK LEAF: _lock
 import threading
 import time
 import urllib.parse

@@ -237,14 +237,18 @@ class JobCheckpointManager:
         self._wq: "queue.Queue[_Snapshot]" = queue.Queue(
             maxsize=(queue_depth if queue_depth is not None
                      else int(flag("job_ckpt_queue_depth"))))
-        # two locks with disjoint concerns so the writer NEVER contends
-        # with a producer blocked on the bounded queue: _mu orders
-        # lifecycle (stopped flag, put-vs-shutdown-sentinel, id
-        # allocation) among producers; _err_mu guards only the error
-        # latch (the writer's sole lock — it must stay acquirable while
-        # a producer holds _mu inside a blocking put, else an erroring
-        # writer and a backpressured save() deadlock)
+        # two locks with disjoint concerns: _mu orders lifecycle
+        # (stopped flag, in-flight-put accounting, id allocation) among
+        # producers; _err_mu guards only the error latch (the writer's
+        # sole lock). The backpressured queue put itself happens with
+        # NEITHER lock held — a producer parked on a full queue must
+        # not block other savers' id allocation or stop(); _inflight
+        # (condition on _mu) is what keeps the put-vs-shutdown-sentinel
+        # ordering instead (blocking-under-lock lint rule).
+        # LOCK LEAF: _mu _err_mu
         self._mu = threading.Lock()
+        self._inflight = 0                      # accepted, put not landed
+        self._quiesced = threading.Condition(self._mu)
         self._err_mu = threading.Lock()
         self._error: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
@@ -294,23 +298,27 @@ class JobCheckpointManager:
         if blocking:
             self._write(snap)
         else:
-            # the stopped-check and the put are ATOMIC under _mu so a
-            # concurrent stop() cannot slot its shutdown sentinel
-            # between them — a snapshot enqueued behind the sentinel
-            # would silently never be written
+            # admission (stopped-check + in-flight count) is atomic
+            # under _mu; the bounded put happens OUTSIDE it. stop()
+            # flips _stopped under _mu and then waits for _inflight to
+            # reach zero before enqueuing its shutdown sentinel, so
+            # every admitted snapshot still lands AHEAD of the sentinel
+            # — but a producer parked on a full queue (writer lagging)
+            # no longer holds _mu, so concurrent savers' id allocation
+            # and stop() itself stay responsive while it waits.
             with self._mu:
                 enforce(not self._stopped,
                         "JobCheckpointManager stopped during capture — "
                         "snapshot discarded")
                 self._ensure_writer()
-                # bounded: blocks when the writer lags. Holding _mu
-                # through the put keeps stop() (which takes _mu to set
-                # _stopped) ordered AFTER it, so no snapshot lands
-                # behind the shutdown sentinel. Deadlock-free because
-                # the writer thread only ever takes _err_mu, never _mu
-                # — it keeps draining (freeing queue slots) while a
-                # producer blocks here
-                self._wq.put(snap)
+                self._inflight += 1
+            try:
+                self._wq.put(snap)  # backpressure: blocks, lock-free
+            finally:
+                with self._mu:
+                    self._inflight -= 1
+                    if self._inflight == 0:
+                        self._quiesced.notify_all()
         return snap.ckpt_id
 
     def _capture(self, step, cursor, dense) -> _Snapshot:
@@ -376,13 +384,20 @@ class JobCheckpointManager:
 
     def stop(self) -> None:
         """Drain the writer and shut it down; surfaces latched errors.
-        The queue is FIFO and _stopped flips under _mu, so every
-        snapshot a save() managed to enqueue sits AHEAD of the shutdown
-        sentinel and still gets written."""
+        The queue is FIFO, _stopped flips under _mu, and the sentinel
+        waits for in-flight puts to land, so every snapshot a save()
+        was admitted for sits AHEAD of the shutdown sentinel and still
+        gets written."""
         with self._mu:
             if self._stopped:
                 return
             self._stopped = True
+            while self._inflight:
+                # an admitted save() is parked on the full queue; the
+                # writer keeps draining (it never takes _mu), the put
+                # lands, and the producer notifies. Waiting here keeps
+                # the sentinel BEHIND every admitted snapshot.
+                self._quiesced.wait()
             thread = self._thread
         if thread is not None and thread.is_alive():
             self._wq.put(None)

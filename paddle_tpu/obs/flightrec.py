@@ -40,6 +40,8 @@ from __future__ import annotations
 import json
 import os
 import shutil
+# lock discipline (tools/lint/py_locks.py; docs/STATIC_ANALYSIS.md):
+# LOCK LEAF: _mu
 import threading
 from collections import deque
 from typing import Any, Dict, List, Optional, Set

@@ -35,6 +35,11 @@ The watchdog either attaches to a :class:`~.timeseries.Sampler`
 from __future__ import annotations
 
 import dataclasses
+# lock discipline (tools/lint/py_locks.py; docs/STATIC_ANALYSIS.md):
+# `_mu` guards rule/alert state only and is a LEAF; subscriber
+# callbacks + flight-recorder notifies fire OUTSIDE it (the
+# callback-under-lock contract this module motivated).
+# LOCK LEAF: _mu
 import threading
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple

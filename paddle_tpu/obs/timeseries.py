@@ -33,6 +33,11 @@ curves and trace lanes line up in a postmortem bundle.
 
 from __future__ import annotations
 
+# lock discipline (tools/lint/py_locks.py; docs/STATIC_ANALYSIS.md):
+# `_mu` guards the delta-encoder ring, `_latest_mu` the exporter's
+# latest-snapshot cell; they are disjoint LEAVES (the sampler thread
+# holds at most one at a time, never both).
+# LOCK LEAF: _mu _latest_mu
 import threading
 import time
 from collections import deque

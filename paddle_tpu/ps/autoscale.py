@@ -45,6 +45,8 @@ from __future__ import annotations
 
 import dataclasses
 import json
+# lock discipline (tools/lint/py_locks.py; docs/STATIC_ANALYSIS.md):
+# LOCK LEAF: _mu
 import threading
 import time
 from collections import deque

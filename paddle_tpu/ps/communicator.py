@@ -21,6 +21,10 @@ Modes:
 from __future__ import annotations
 
 import queue
+# lock discipline (tools/lint/py_locks.py; docs/STATIC_ANALYSIS.md):
+# `_pull_mu` fences the prefetch double-buffer swap; `_lock` guards the
+# GEO accumulator. Both are LEAVES: the actual pulls/pushes run outside.
+# LOCK LEAF: _pull_mu _lock
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor, wait

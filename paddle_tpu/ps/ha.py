@@ -55,6 +55,14 @@ from __future__ import annotations
 import json
 import random
 import struct
+# lock discipline (tools/lint/py_locks.py; docs/STATIC_ANALYSIS.md):
+# every mutex here is a LEAF — breaker/coordinator/server `_mu` and the
+# coordinator's `_step_mu` guard small in-memory state and may never
+# nest another lock or block. The cluster-wide `control_mu` (RLock) is
+# the control plane's OUTERMOST lock: reshard cutovers and checkpoint
+# gates serialize under it before touching any server state.
+# LOCK ORDER: control_mu < _mu
+# LOCK LEAF: _mu _step_mu
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -729,13 +737,24 @@ class ReplicationManager:
         return sparse, dense, geo
 
     def _self(self):
-        # under _mu: the shipper's full_sync and a background migrate
-        # sync (_sync_migrate_bg) may race the lazy connect; the conn
-        # itself serializes concurrent calls internally
+        # the shipper's full_sync and a background migrate sync
+        # (_sync_migrate_bg) may race the lazy connect; the TCP connect
+        # itself happens OUTSIDE _mu (it can block up to the connect
+        # deadline — blocking-under-lock lint rule) and the loser of
+        # the double-checked swap closes its stray conn. The conn
+        # serializes concurrent calls internally.
+        with self._mu:
+            conn = self._self_conn
+        if conn is not None:
+            return conn
+        conn = make_conn(self.endpoint)
         with self._mu:
             if self._self_conn is None:
-                self._self_conn = make_conn(self.endpoint)
-            return self._self_conn
+                self._self_conn = conn
+                return conn
+            stray, conn = conn, self._self_conn
+        stray.close()
+        return conn
 
     def _full_sync(self, ep: str, st: dict) -> None:
         """Snapshot+rebase one backup. Mutations pause for the duration

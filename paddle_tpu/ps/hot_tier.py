@@ -36,6 +36,13 @@ with the tier enabled reproduces the RPC-only trainer's pulled rows and
 dense params EXACTLY, through eviction churn and checkpoint/restore
 (tests/test_hot_tier.py pins all three). Known non-goal: ``delta_score``
 folds per flush (the established end_pass association), not per push.
+
+Concurrency note (py_locks lint contract): this module is deliberately
+LOCK-FREE — the tier is single-threaded per host (the trainer's step
+loop owns it; miss-path prefetch hands results back through the
+communicator's own synchronized buffers), so it carries no mutexes and
+no `# LOCK` annotations. Adding a thread here means adding locks AND
+the pass-7 decls that govern them; do not share a tier across threads.
 """
 
 from __future__ import annotations

@@ -59,6 +59,12 @@ from __future__ import annotations
 
 import dataclasses
 import json
+# lock discipline (tools/lint/py_locks.py; docs/STATIC_ANALYSIS.md):
+# `_op_mu` serializes whole reshard operations (one grow/shrink at a
+# time); the RPC work happens in helper methods that take no client
+# locks themselves — the client's `_conns_mu` and the cluster's
+# `control_mu` order UNDER the operation, never around it.
+# LOCK ORDER: _op_mu < control_mu
 import threading
 import time
 from collections import deque

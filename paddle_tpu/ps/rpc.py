@@ -19,6 +19,13 @@ from __future__ import annotations
 
 import ctypes
 import os
+# lock discipline (tools/lint/py_locks.py; docs/STATIC_ANALYSIS.md):
+# all client/server mutexes are LEAVES. `_mu` is the per-connection
+# wire mutex (serializes connect/call/close on ONE socket — the IO is
+# the protected resource); `_conns_mu` only swaps connection lists
+# (connects build OUTSIDE it); `_pool_mu`/`_count_mu`/`_pause_mu`
+# guard scalars.
+# LOCK LEAF: _mu _pause_mu _conns_mu _pool_mu _count_mu
 import threading
 import time
 from collections import Counter
@@ -756,14 +763,24 @@ class RpcPsClient(PSClient):
     def _swap_conn(self, s: int, endpoint: str) -> None:
         """Point shard ``s`` at ``endpoint`` (promoted backup). Another
         thread may have swapped already — endpoint equality makes the
-        swap idempotent; the loser's stale conn is closed."""
+        swap idempotent; the loser's stale conn is closed. The TCP
+        connect happens OUTSIDE _conns_mu: _shard_op takes that lock on
+        the data hot path, and holding it through a connect deadline
+        would stall every healthy shard's ops behind one failover
+        (blocking-under-lock lint rule)."""
         with self._conns_mu:
-            if self._conns[s].endpoint == endpoint:
+            if s >= len(self._conns) or \
+                    self._conns[s].endpoint == endpoint:
                 return
-            host, port = endpoint.rsplit(":", 1)
-            old, self._conns[s] = self._conns[s], _ServerConn(
-                self._lib, host, int(port), **self._conn_kw)
-        old.close()
+        host, port = endpoint.rsplit(":", 1)
+        fresh = _ServerConn(self._lib, host, int(port), **self._conn_kw)
+        with self._conns_mu:
+            if s >= len(self._conns) or \
+                    self._conns[s].endpoint == endpoint:
+                stale = fresh       # raced: another swap (or a shrink) won
+            else:
+                stale, self._conns[s] = self._conns[s], fresh
+        stale.close()
 
     def refresh_routing(self) -> bool:
         """Re-resolve every shard's endpoint AND the shard COUNT from
@@ -816,7 +833,7 @@ class RpcPsClient(PSClient):
                     # in-lock connect only for this stray
                     host, port = ep.rsplit(":", 1)
                     conns.append(_ServerConn(self._lib, host, int(port),
-                                             **self._conn_kw))
+                                             **self._conn_kw))  # graftlint: lock-ok rare stray from a raced refresh; rebuilding outside would just re-race
             stale = [c for c in old if c not in conns]
             self._conns = conns
         for c in built.values():  # built for an endpoint a concurrent

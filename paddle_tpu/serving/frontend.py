@@ -23,6 +23,11 @@ from __future__ import annotations
 
 import dataclasses
 import queue
+# lock discipline (tools/lint/py_locks.py; docs/STATIC_ANALYSIS.md):
+# `_mu` fences admission counters + the stopping flag and is a LEAF;
+# queue ops under it are the _nowait forms only, and result delivery /
+# failure callbacks run with no lock held.
+# LOCK LEAF: _mu
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional

@@ -15,6 +15,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -605,3 +606,50 @@ def test_job_sigkill_mid_save_resume_bit_identical(tmp_path):
         np.testing.assert_array_equal(a, b)
     for a, b in zip(_leaves(got["opt"]), _leaves(want["opt"])):
         np.testing.assert_array_equal(a, b)
+
+
+def test_backpressured_save_does_not_hold_lifecycle_lock(tmp_path):
+    """Regression (py_locks blocking-under-lock): a save() parked on a
+    FULL writer queue must not hold _mu — other savers' admission/id
+    allocation and stop() stay responsive while it waits, and stop()
+    still orders its shutdown sentinel BEHIND every admitted
+    snapshot."""
+    import threading
+
+    mgr = _mgr(tmp_path, queue_depth=1)
+    release = threading.Event()
+    wrote = []
+    real_write = mgr._write
+
+    def slow_write(snap):
+        release.wait(20)
+        real_write(snap)
+        wrote.append(snap.ckpt_id)
+
+    mgr._write = slow_write
+    # writer busy on snap 0; snap 1 fills the queue; snap 2 must park
+    # on the bounded put — formerly while holding _mu
+    mgr.save(step=0, dense=_dense(0))
+    t2 = threading.Thread(
+        target=lambda: [mgr.save(step=1, dense=_dense(1)),
+                        mgr.save(step=2, dense=_dense(2))],
+        name="ckpt-producer")
+    t2.start()
+    deadline = time.perf_counter() + 10
+    while mgr._wq.qsize() < 1 and time.perf_counter() < deadline:
+        time.sleep(0.01)
+    # the lifecycle lock must be FREE while the producer is parked
+    got_mu = mgr._mu.acquire(timeout=2)
+    assert got_mu, "_mu held through a backpressured queue put"
+    mgr._mu.release()
+    # stop() (concurrent with the parked producer) must not deadlock
+    # and must write everything that was admitted
+    stopper = threading.Thread(target=mgr.stop, name="ckpt-stopper")
+    stopper.start()
+    time.sleep(0.05)
+    release.set()
+    t2.join(timeout=20)
+    stopper.join(timeout=20)
+    assert not t2.is_alive() and not stopper.is_alive()
+    assert wrote == [0, 1, 2]          # FIFO, nothing behind the sentinel
+    assert mgr.load_latest().step == 2

@@ -855,8 +855,8 @@ def test_run_py_green_on_tree_and_red_on_violation(tmp_path):
     summary = json.loads((tmp_path / "s.json").read_text())
     assert summary["new"] == 0
     assert set(summary["per_pass"]) == {
-        "tracer_safety", "hot_path", "lock_order", "conventions",
-        "obs_metrics", "control_loops"}
+        "tracer_safety", "hot_path", "lock_order", "py_locks",
+        "wire_contract", "conventions", "obs_metrics", "control_loops"}
 
     # an injected violation must turn the gate red with file:line:rule
     bad = tmp_path / "tree" / "paddle_tpu"
@@ -1229,3 +1229,731 @@ def test_uninjectable_clock_reshard_and_autoscale_ship_clean():
         diags = control_loops.check_file(
             _os.path.join(REPO_ROOT, mod), REPO_ROOT)
         assert not diags, diags
+
+
+# ---------------------------------------------------------------------------
+# pass 7: Python lock discipline (py_locks)
+# ---------------------------------------------------------------------------
+
+import py_locks  # noqa: E402
+
+
+def _pylock_diags(tmp_path, source, fname="paddle_tpu/mod.py"):
+    p = tmp_path / fname
+    p.parent.mkdir(parents=True, exist_ok=True)
+    init = tmp_path / "paddle_tpu" / "__init__.py"
+    if not init.exists():
+        init.write_text("")
+    p.write_text(textwrap.dedent(source))
+    return py_locks.run(str(tmp_path))
+
+
+def test_pylock_sleep_under_lock_flagged(tmp_path):
+    diags = _pylock_diags(tmp_path, """
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def f(self):
+                with self._mu:
+                    time.sleep(0.1)
+    """)
+    assert _rules(diags) == {"blocking-under-lock"}
+    assert diags[0].line == 11
+
+
+def test_pylock_sleep_outside_lock_ok(tmp_path):
+    diags = _pylock_diags(tmp_path, """
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def f(self):
+                with self._mu:
+                    x = 1
+                time.sleep(0.1)
+                return x
+    """)
+    assert diags == []
+
+
+def test_pylock_bounded_queue_put_under_lock_flagged(tmp_path):
+    # the JobCheckpointManager writer-path bug shape this rule was
+    # built for: a backpressured put parks every thread needing _mu
+    diags = _pylock_diags(tmp_path, """
+        import queue
+        import threading
+
+        class W:
+            def __init__(self, cap):
+                self._mu = threading.Lock()
+                self._wq = queue.Queue(maxsize=cap)
+
+            def submit(self, item):
+                with self._mu:
+                    self._wq.put(item)
+    """)
+    assert _rules(diags) == {"blocking-under-lock"}
+
+
+def test_pylock_put_nowait_and_unbounded_put_ok(tmp_path):
+    diags = _pylock_diags(tmp_path, """
+        import queue
+        import threading
+
+        class W:
+            def __init__(self, cap):
+                self._mu = threading.Lock()
+                self._wq = queue.Queue(maxsize=cap)
+                self._log = queue.Queue()
+
+            def submit(self, item):
+                with self._mu:
+                    self._wq.put_nowait(item)
+                    self._log.put(item)   # unbounded: never blocks
+    """)
+    assert "blocking-under-lock" not in _rules(diags)
+
+
+def test_pylock_queue_get_under_lock_flagged(tmp_path):
+    diags = _pylock_diags(tmp_path, """
+        import queue
+        import threading
+
+        class W:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._wq = queue.Queue()
+
+            def pop(self):
+                with self._mu:
+                    return self._wq.get()
+    """)
+    assert _rules(diags) == {"blocking-under-lock"}
+
+
+def test_pylock_rpc_call_under_lock_flagged_lock_ok_escapes(tmp_path):
+    diags = _pylock_diags(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self, conn):
+                self._mu = threading.Lock()
+                self.conn = conn
+
+            def f(self):
+                with self._mu:
+                    return self.conn.call(3)
+    """)
+    assert _rules(diags) == {"blocking-under-lock"}
+    diags = _pylock_diags(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self, conn):
+                self._mu = threading.Lock()
+                self.conn = conn
+
+            def f(self):
+                with self._mu:
+                    return self.conn.call(3)  # graftlint: lock-ok wire mutex serializes exactly this
+    """)
+    assert diags == []
+
+
+def test_pylock_lock_ok_without_reason_is_syntax_error(tmp_path):
+    diags = _pylock_diags(tmp_path, """
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def f(self):
+                with self._mu:
+                    time.sleep(1)  # graftlint: lock-ok
+    """)
+    assert _rules(diags) == {"lock-ok-syntax"}
+
+
+def test_pylock_thread_join_under_lock_flagged_str_join_ok(tmp_path):
+    diags = _pylock_diags(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self, t):
+                self._mu = threading.Lock()
+                self._t = t
+
+            def stop(self):
+                with self._mu:
+                    self._t.join()
+
+            def render(self, parts, sep):
+                with self._mu:
+                    return ",".join(parts) + sep.join(parts)
+    """)
+    assert [d.rule for d in diags] == ["blocking-under-lock"]
+    assert diags[0].line == 11
+
+
+def test_pylock_event_wait_under_lock_flagged_cv_wait_ok(tmp_path):
+    diags = _pylock_diags(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._cv = threading.Condition(self._mu)
+                self._ev = threading.Event()
+
+            def bad(self):
+                with self._mu:
+                    self._ev.wait(1.0)
+
+            def good(self):
+                with self._cv:
+                    while not self.ready:
+                        self._cv.wait()
+                    self._cv.notify_all()
+    """)
+    assert [d.rule for d in diags] == ["blocking-under-lock"]
+    assert diags[0].line == 12
+
+
+def test_pylock_param_callback_under_lock_flagged(tmp_path):
+    diags = _pylock_diags(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def subscribe_and_fire(self, fn):
+                with self._mu:
+                    fn()
+    """)
+    assert _rules(diags) == {"callback-under-lock"}
+
+
+def test_pylock_subscriber_loop_under_lock_flagged_snapshot_ok(tmp_path):
+    diags = _pylock_diags(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._on_fire = []
+
+            def bad(self, alert):
+                with self._mu:
+                    for fn in self._on_fire:
+                        fn(alert)
+
+            def good(self, alert):
+                with self._mu:
+                    subs = list(self._on_fire)
+                for fn in subs:
+                    fn(alert)
+    """)
+    assert [d.rule for d in diags] == ["callback-under-lock"]
+    assert diags[0].line == 12
+
+
+def test_pylock_notify_method_under_lock_flagged(tmp_path):
+    diags = _pylock_diags(tmp_path, """
+        import threading
+
+        class W:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def transition(self, alert):
+                with self._mu:
+                    self.state = "open"
+                    self._notify(alert)
+
+            def _notify(self, alert):
+                pass
+    """)
+    assert _rules(diags) == {"callback-under-lock"}
+
+
+def test_pylock_order_inversion_and_unannotated(tmp_path):
+    diags = _pylock_diags(tmp_path, """
+        # LOCK ORDER: outer_mu < inner_mu
+        import threading
+
+        class C:
+            def __init__(self):
+                self.outer_mu = threading.Lock()
+                self.inner_mu = threading.Lock()
+                self.other_mu = threading.Lock()
+
+            def inverted(self):
+                with self.inner_mu:
+                    with self.outer_mu:
+                        pass
+
+            def unannotated(self):
+                with self.outer_mu:
+                    with self.other_mu:
+                        pass
+    """)
+    assert _rules(diags) == {"lock-order", "lock-unannotated"}
+    diags = _pylock_diags(tmp_path, """
+        # LOCK ORDER: outer_mu < inner_mu
+        import threading
+
+        class C:
+            def __init__(self):
+                self.outer_mu = threading.Lock()
+                self.inner_mu = threading.Lock()
+
+            def ordered(self):
+                with self.outer_mu:
+                    with self.inner_mu:
+                        pass
+    """)
+    assert diags == []
+
+
+def test_pylock_leaf_violation_and_leaf_nests_under_outer(tmp_path):
+    diags = _pylock_diags(tmp_path, """
+        # LOCK LEAF: hot_mu
+        import threading
+
+        class C:
+            def __init__(self):
+                self.hot_mu = threading.Lock()
+                self.big_mu = threading.Lock()
+
+            def bad(self):
+                with self.hot_mu:
+                    with self.big_mu:
+                        pass
+    """)
+    assert _rules(diags) == {"lock-leaf"}
+    diags = _pylock_diags(tmp_path, """
+        # LOCK ORDER: big_mu < mid_mu
+        # LOCK LEAF: hot_mu
+        import threading
+
+        class C:
+            def __init__(self):
+                self.hot_mu = threading.Lock()
+                self.big_mu = threading.Lock()
+
+            def good(self):
+                with self.big_mu:
+                    with self.hot_mu:
+                        pass
+    """)
+    assert diags == []
+
+
+def test_pylock_cycle_flagged(tmp_path):
+    diags = _pylock_diags(tmp_path, """
+        # LOCK ORDER: a_mu < b_mu
+        # LOCK ORDER: b_mu < a_mu
+        import threading
+    """)
+    assert _rules(diags) == {"lock-order-cycle"}
+
+
+def test_pylock_acquire_release_region(tmp_path):
+    # acquire()/release() pairs scope a region in statement order
+    diags = _pylock_diags(tmp_path, """
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def bad(self):
+                self._mu.acquire()
+                time.sleep(0.1)
+                self._mu.release()
+
+            def good(self):
+                self._mu.acquire()
+                x = 1
+                self._mu.release()
+                time.sleep(0.1)
+                return x
+    """)
+    assert [d.rule for d in diags] == ["blocking-under-lock"]
+    assert diags[0].line == 11
+
+
+def test_pylock_lock_tag_names_acquisition(tmp_path):
+    # `# LOCK: name` renames an acquisition for ORDER/LEAF purposes
+    diags = _pylock_diags(tmp_path, """
+        # LOCK LEAF: breaker_mu
+        import threading
+
+        class Breaker:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._aux_mu = threading.Lock()
+
+            def bad(self):
+                with self._mu:  # LOCK: breaker_mu
+                    with self._aux_mu:
+                        pass
+    """)
+    assert _rules(diags) == {"lock-leaf"}
+
+
+def test_pylock_nested_def_under_lock_not_flagged(tmp_path):
+    # a def inside the region does not EXECUTE under the lock
+    diags = _pylock_diags(tmp_path, """
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def f(self):
+                with self._mu:
+                    def later():
+                        time.sleep(1)
+                    self.cb = later
+    """)
+    assert diags == []
+
+
+def test_pylock_ignore_comment(tmp_path):
+    diags = _pylock_diags(tmp_path, """
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def f(self):
+                with self._mu:
+                    time.sleep(1)  # graftlint: ignore[blocking-under-lock]
+    """)
+    assert diags == []
+
+
+def test_pylock_real_tree_is_clean():
+    # the 12 annotated threading modules (and everything else) pass
+    assert py_locks.run(REPO) == []
+
+
+# ---------------------------------------------------------------------------
+# pass 8: cross-language wire contract (wire_contract)
+# ---------------------------------------------------------------------------
+
+import shutil  # noqa: E402
+
+import wire_contract  # noqa: E402
+
+
+def _wire_tree(tmp_path):
+    """Scratch copy of every file the pass reads."""
+    for rel in wire_contract.RELEVANT_FILES:
+        src = os.path.join(REPO, rel)
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        shutil.copy(src, dst)
+    return str(tmp_path)
+
+
+def _perturb(tmp_path, rel, old, new):
+    p = tmp_path / rel
+    s = p.read_text()
+    assert old in s, f"fixture drift: {old!r} not in {rel}"
+    p.write_text(s.replace(old, new))
+
+
+def test_wire_clean_tree_passes(tmp_path):
+    root = _wire_tree(tmp_path)
+    assert wire_contract.run(root) == []
+
+
+def test_wire_cmd_id_perturbation_fails(tmp_path):
+    root = _wire_tree(tmp_path)
+    _perturb(tmp_path, "paddle_tpu/csrc/ps_service.cc",
+             "kObsSnap = 43", "kObsSnap = 45")
+    assert "wire-cmd-drift" in _rules(wire_contract.run(root))
+
+
+def test_wire_python_mirror_perturbation_fails(tmp_path):
+    root = _wire_tree(tmp_path)
+    _perturb(tmp_path, "paddle_tpu/ps/rpc.py", "_RETAIN = 44", "_RETAIN = 46")
+    assert "wire-cmd-mirror" in _rules(wire_contract.run(root))
+
+
+def test_wire_missing_mirror_fails(tmp_path):
+    root = _wire_tree(tmp_path)
+    _perturb(tmp_path, "paddle_tpu/ps/rpc.py", "_OBS_SNAP = 43", "")
+    assert "wire-cmd-mirror" in _rules(wire_contract.run(root))
+
+
+def test_wire_error_code_perturbation_fails_both_sides(tmp_path):
+    root = _wire_tree(tmp_path)
+    _perturb(tmp_path, "paddle_tpu/ps/ha.py",
+             "_rpc_err_stale_epoch = -5", "_rpc_err_stale_epoch = -55")
+    assert "wire-err-mirror" in _rules(wire_contract.run(root))
+    root2 = _wire_tree(tmp_path / "b")
+    _perturb(tmp_path / "b", "paddle_tpu/csrc/ps_service.cc",
+             "kErrSeqGap = -6", "kErrSeqGap = -66")
+    got = _rules(wire_contract.run(root2))
+    assert "wire-err-drift" in got
+
+
+def test_wire_header_perturbation_fails(tmp_path):
+    root = _wire_tree(tmp_path)
+    _perturb(tmp_path, "paddle_tpu/ps/ha.py",
+             '_HDR = struct.Struct("<QIIqiQQ")',
+             '_HDR = struct.Struct("<QIIqiQ")')
+    assert "wire-header-drift" in _rules(wire_contract.run(root))
+
+
+def test_wire_classification_perturbation_fails(tmp_path):
+    # dropping a cmd from the ownership-fence scan must not pass review
+    root = _wire_tree(tmp_path)
+    _perturb(tmp_path, "paddle_tpu/csrc/ps_service.cc",
+             "inline bool is_keyed_data_cmd(uint32_t cmd) {\n  switch (cmd) {\n    case kPullSparse:",
+             "inline bool is_keyed_data_cmd(uint32_t cmd) {\n  switch (cmd) {")
+    assert "wire-class-drift" in _rules(wire_contract.run(root))
+
+
+def test_wire_untapped_mutation_rule(monkeypatch):
+    # a gate-checked mutation that is neither tapped nor local_only is
+    # exactly the replication hole the rule exists for
+    spec = wire_contract.CONTRACT["kLoadCold"]
+    broken = wire_contract.CmdSpec(spec.id, spec.py, tap="no",
+                                   gate=spec.gate, keyed=spec.keyed)
+    monkeypatch.setitem(wire_contract.CONTRACT, "kLoadCold", broken)
+    got = _rules(wire_contract.run(REPO))
+    assert "wire-untapped-mutation" in got
+    assert "wire-class-drift" in got   # tap mismatch vs csrc too
+
+
+def test_wire_contract_real_tree_is_clean():
+    assert wire_contract.run(REPO) == []
+
+
+# ---------------------------------------------------------------------------
+# driver satellites: stale-allowlist gate, --changed, per-pass timings
+# ---------------------------------------------------------------------------
+
+def test_stale_allowlist_entry_fails_full_gate(tmp_path, monkeypatch):
+    import run as runner
+    bad = tmp_path / "tree" / "paddle_tpu"
+    bad.mkdir(parents=True)
+    (bad / "__init__.py").write_text("")
+    (bad / "hot.py").write_text(
+        "import jax\n\n@jax.jit\ndef step(x):\n    return x.item()\n")
+    (tmp_path / "tree" / "tools").mkdir()
+    allow = tmp_path / "allow.txt"
+    # entry at the WRONG line: the finding is new AND the entry is stale
+    allow.write_text("paddle_tpu/hot.py:99:host-sync-item  # why: moved\n")
+    monkeypatch.setattr(runner, "ALLOW_PATH", str(allow))
+    assert runner.main(["--root", str(tmp_path / "tree")]) == 1
+    # fixing the line makes both go away
+    allow.write_text("paddle_tpu/hot.py:5:host-sync-item  # why: legit\n")
+    assert runner.main(["--root", str(tmp_path / "tree")]) == 0
+    # stale-only (violation gone, entry remains) still fails
+    (bad / "hot.py").write_text("def step(x):\n    return x\n")
+    assert runner.main(["--root", str(tmp_path / "tree")]) == 1
+
+
+def test_changed_mode_filters_and_skips_staleness(tmp_path, monkeypatch):
+    import run as runner
+    tree = tmp_path / "tree"
+    pkg = tree / "paddle_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "hot.py").write_text(
+        "import jax\n\n@jax.jit\ndef step(x):\n    return x.item()\n")
+    (pkg / "other.py").write_text(
+        "import jax\n\n@jax.jit\ndef leak(x):\n    return x.tolist()\n")
+    (tree / "tools").mkdir()
+    allow = tmp_path / "allow.txt"
+    allow.write_text("gone.py:1:bare-except  # why: stale on purpose\n")
+    monkeypatch.setattr(runner, "ALLOW_PATH", str(allow))
+    # full run: both violations + the stale entry -> red
+    assert runner.main(["--root", str(tree)]) == 1
+    # changed = only other.py: hot.py's violation invisible, staleness
+    # skipped; other.py's violation still gates
+    monkeypatch.setattr(runner, "changed_files",
+                        lambda root: {"paddle_tpu/other.py"})
+    summary = tmp_path / "s.json"
+    assert runner.main(["--root", str(tree), "--changed",
+                        "--json", str(summary)]) == 1
+    s = json.loads(summary.read_text())
+    assert s["changed_mode"] and s["changed_files"] == ["paddle_tpu/other.py"]
+    assert {v["rule"] for v in s["violations"]} == {"host-sync-item"}
+    assert s["stale_allowlist_entries"] == []
+    # empty changed set short-circuits green
+    monkeypatch.setattr(runner, "changed_files", lambda root: set())
+    assert runner.main(["--root", str(tree), "--changed"]) == 0
+
+
+def test_json_summary_carries_timings_and_why(tmp_path, monkeypatch):
+    import run as runner
+    tree = tmp_path / "tree"
+    pkg = tree / "paddle_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "hot.py").write_text(
+        "import jax\n\n@jax.jit\ndef step(x):\n    return x.item()\n")
+    (tree / "tools").mkdir()
+    allow = tmp_path / "allow.txt"
+    allow.write_text(
+        "paddle_tpu/hot.py:5:host-sync-item  # why: demo justification\n")
+    monkeypatch.setattr(runner, "ALLOW_PATH", str(allow))
+    summary = tmp_path / "s.json"
+    assert runner.main(["--root", str(tree), "--json", str(summary)]) == 0
+    s = json.loads(summary.read_text())
+    assert set(s["per_pass"]) == {
+        "tracer_safety", "hot_path", "lock_order", "py_locks",
+        "wire_contract", "conventions", "obs_metrics", "control_loops"}
+    for rec in s["per_pass"].values():
+        assert rec["wall_ms"] >= 0 and rec["violations"] >= 0
+    assert s["wall_s"] >= 0
+    v = [x for x in s["violations"] if x["allowlisted"]]
+    assert v and v[0]["why"] == "demo justification"
+
+
+def test_pylock_malformed_decl_flagged(tmp_path):
+    diags = _pylock_diags(tmp_path, """
+        # LOCK ORDER: a_mu <
+        # LOCK LEAF: bad-name!
+        import threading
+    """)
+    assert _rules(diags) == {"lock-order-syntax"}
+    diags = _pylock_diags(tmp_path, """
+        # LOCK ORDER: a_mu < b_mu
+        # LOCK LEAF: c_mu
+        import threading
+    """)
+    assert diags == []
+
+
+def test_time_budget_warning_is_soft(tmp_path, monkeypatch, capsys):
+    import run as runner
+    tree = tmp_path / "tree"
+    (tree / "paddle_tpu").mkdir(parents=True)
+    (tree / "paddle_tpu" / "__init__.py").write_text("")
+    (tree / "tools").mkdir()
+    allow = tmp_path / "allow.txt"
+    allow.write_text("")
+    monkeypatch.setattr(runner, "ALLOW_PATH", str(allow))
+    monkeypatch.setattr(runner, "TIME_BUDGET_S", 0.0)
+    # over budget still exits 0 (soft), but names the slowest pass
+    assert runner.main(["--root", str(tree)]) == 0
+    err = capsys.readouterr().err
+    assert "soft budget" in err and "slowest pass" in err
+
+
+def test_pylock_lambda_under_lock_not_flagged(tmp_path):
+    # a lambda stored under the lock runs LATER, not under it
+    diags = _pylock_diags(tmp_path, """
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def f(self):
+                with self._mu:
+                    self.cb = lambda: time.sleep(1)
+    """)
+    assert diags == []
+
+
+def test_pylock_cv_wait_bound_to_other_lock_flagged(tmp_path):
+    # Condition(self._other).wait() under _mu releases _other, NOT the
+    # held _mu — the held lock stays parked for the whole wait
+    diags = _pylock_diags(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._other = threading.Lock()
+                self._cv = threading.Condition(self._other)
+
+            def bad(self):
+                with self._mu:
+                    self._cv.wait()
+    """)
+    assert _rules(diags) == {"blocking-under-lock"}
+
+
+def test_pylock_cv_wait_bound_to_held_lock_ok(tmp_path):
+    # the JobCheckpointManager pattern: Condition(self._mu).wait()
+    # under `with self._mu:` IS the cv protocol (it releases _mu)
+    diags = _pylock_diags(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._quiesced = threading.Condition(self._mu)
+
+            def good(self):
+                with self._mu:
+                    while self.busy:
+                        self._quiesced.wait()
+                    self._quiesced.notify_all()
+    """)
+    assert diags == []
+
+
+def test_pylock_lock_ok_does_not_waive_ordering_rules(tmp_path):
+    # lock-ok is scoped to callback/blocking; an ordering violation on
+    # the same line still fires (only the audited allowlist may waive it)
+    diags = _pylock_diags(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._other_mu = threading.Lock()
+
+            def f(self):
+                with self._mu:
+                    with self._other_mu:  # graftlint: lock-ok not a waiver for ordering
+                        pass
+    """)
+    assert _rules(diags) == {"lock-unannotated"}
+
+
+def test_changed_files_handles_spaces_in_paths(tmp_path):
+    import subprocess as sp
+
+    import run as runner
+    repo = tmp_path / "r"
+    repo.mkdir()
+
+    def g(*args):
+        sp.run(["git", "-C", str(repo), "-c", "user.email=t@t",
+                "-c", "user.name=t", *args], check=True,
+               capture_output=True)
+
+    g("init", "-q")
+    (repo / "base.py").write_text("x = 1\n")
+    g("add", "-A")
+    g("commit", "-qm", "base")
+    (repo / "my mod.py").write_text("y = 2\n")   # untracked, space in name
+    (repo / "base.py").write_text("x = 3\n")     # modified
+    got = runner.changed_files(str(repo))
+    assert got == {"base.py", "my mod.py"}

@@ -856,3 +856,47 @@ def test_digest_matches_local_oracle():
     finally:
         cli.close()
         server.close()
+
+
+def test_self_conn_lazy_connect_outside_lock(monkeypatch):
+    """Regression (py_locks blocking-under-lock): ReplicationManager._self
+    builds its TCP conn OUTSIDE _mu (double-checked swap); racing callers get
+    ONE shared conn and the loser's stray is closed."""
+    import threading as _threading
+
+    class FakeConn:
+        def __init__(self):
+            self.closed = False
+
+        def close(self):
+            self.closed = True
+
+    built = []
+
+    def fake_make_conn(endpoint):
+        c = FakeConn()
+        built.append(c)
+        barrier.wait(timeout=5)     # both racers connect concurrently
+        return c
+
+    monkeypatch.setattr(ha, "make_conn", fake_make_conn)
+    srv = ha.ReplicationManager.__new__(ha.ReplicationManager)
+    srv._mu = _threading.Lock()
+    srv._self_conn = None
+    srv.endpoint = "127.0.0.1:0"
+    barrier = _threading.Barrier(2)
+    got = []
+    ts = [_threading.Thread(target=lambda: got.append(srv._self()),
+                            name=f"self-conn-racer-{i}") for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert len(got) == 2 and got[0] is got[1]
+    assert len(built) == 2
+    winner = got[0]
+    strays = [c for c in built if c is not winner]
+    assert len(strays) == 1 and strays[0].closed
+    assert not winner.closed
+    # subsequent calls reuse the cached conn without connecting again
+    assert srv._self() is winner and len(built) == 2

@@ -691,3 +691,62 @@ def test_stream_trainer_over_remote_table():
     assert remote.size() > 0
     cli.close()
     server.stop()
+
+
+def test_swap_conn_connects_outside_lock_and_handles_races(monkeypatch):
+    """Regression (py_locks blocking-under-lock): _swap_conn builds the
+    replacement conn OUTSIDE _conns_mu (a connect deadline must not
+    stall healthy shards' ops) and closes the fresh conn when a
+    concurrent swap or a topology shrink wins the race."""
+    from paddle_tpu.ps import rpc as rpc_mod
+
+    class FakeConn:
+        def __init__(self, endpoint):
+            self.endpoint = endpoint
+            self.closed = False
+
+        def close(self):
+            self.closed = True
+
+    lock_free_during_connect = []
+
+    def fake_server_conn(lib, host, port, **kw):
+        # the regression: the client lock must be FREE while connecting
+        lock_free_during_connect.append(
+            cli._conns_mu.acquire(timeout=0.5))
+        cli._conns_mu.release()
+        return FakeConn(f"{host}:{port}")
+
+    monkeypatch.setattr(rpc_mod, "_ServerConn", fake_server_conn)
+    cli = rpc_mod.RpcPsClient.__new__(rpc_mod.RpcPsClient)
+    cli._conns_mu = threading.Lock()
+    cli._lib = None
+    cli._conn_kw = {}
+    old = FakeConn("127.0.0.1:1000")
+    cli._conns = [old]
+
+    cli._swap_conn(0, "127.0.0.1:2000")
+    assert lock_free_during_connect == [True]
+    assert cli._conns[0].endpoint == "127.0.0.1:2000"
+    assert old.closed and not cli._conns[0].closed
+
+    # idempotent: same endpoint again is a no-op (no connect at all)
+    cli._swap_conn(0, "127.0.0.1:2000")
+    assert len(lock_free_during_connect) == 1
+
+    # raced: another thread swaps to the target endpoint between the
+    # check and the install -> the fresh conn is the stray and closes
+    current = cli._conns[0]
+
+    def racing_server_conn(lib, host, port, **kw):
+        c = FakeConn(f"{host}:{port}")
+        cli._conns[0] = FakeConn(f"{host}:{port}")   # the racer wins
+        return c
+
+    monkeypatch.setattr(rpc_mod, "_ServerConn", racing_server_conn)
+    cli._swap_conn(0, "127.0.0.1:3000")
+    assert cli._conns[0].endpoint == "127.0.0.1:3000"
+    # a shrink mid-swap: index beyond topology is a clean no-op
+    cli._conns = []
+    cli._swap_conn(0, "127.0.0.1:4000")
+    assert cli._conns == []

@@ -1,0 +1,108 @@
+"""Runtime wire-contract drift guard (tier-1).
+
+Reuses the graftlint wire_contract pass's two extractors as a library
+and pins the csrc↔python mirror in plain pytest, so protocol drift
+fails `pytest tests/` even for someone who never runs `ci.sh lint`.
+The lint pass is the commit-time gate; this is the belt to its braces
+(and the static complement of the PR 4 runtime digest machinery).
+"""
+
+import os
+import struct
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(REPO, "tools", "lint"))
+
+import wire_contract as wc  # noqa: E402
+
+CSRC = os.path.join(REPO, "paddle_tpu", "csrc", "ps_service.cc")
+
+
+@pytest.fixture(scope="module")
+def cs():
+    return wc.extract_csrc(CSRC)
+
+
+@pytest.fixture(scope="module")
+def py():
+    return wc.extract_python(REPO)
+
+
+def test_every_csrc_cmd_id_mirrored(cs, py):
+    assert cs.cmds, "extractor found no Cmd enum"
+    for name, (val, _line) in cs.cmds.items():
+        spec = wc.CONTRACT.get(name)
+        assert spec is not None, f"csrc cmd {name} not in CONTRACT"
+        assert spec.id == val, f"{name}: contract {spec.id} != csrc {val}"
+        if spec.py is not None:
+            mod, const = spec.py
+            got = py.consts[mod].get(const)
+            assert got is not None, f"python mirror {const} missing"
+            assert got[0] == val, f"{const} = {got[0]} != csrc {name} = {val}"
+    # and nothing in the contract has silently left the enum
+    assert set(wc.CONTRACT) == set(cs.cmds)
+
+
+def test_error_codes_mirrored(cs, py):
+    assert set(wc.ERR_CONTRACT) == set(cs.errs)
+    for name, (val, mirror) in wc.ERR_CONTRACT.items():
+        assert cs.errs[name][0] == val
+        if mirror is None:
+            continue
+        kind, nm = mirror
+        if kind == "ha":
+            assert py.consts["ha"][nm][0] == val, \
+                f"ha.{nm} != csrc {name} = {val}"
+        else:
+            got = py.raises.get(val)
+            assert got is not None, \
+                f"_ServerConn.check maps nothing for status {val} ({name})"
+            assert got[0] == nm, \
+                f"status {val}: raises {got[0]}, contract wants {nm}"
+
+
+def test_req_header_layout_and_size(cs, py):
+    fields = cs.structs["ReqHeader"]
+    fmt = wc.struct_format(fields)
+    assert py.hdr_format is not None
+    assert py.hdr_format.replace(" ", "") == fmt, \
+        f"ha._HDR {py.hdr_format!r} != csrc ReqHeader {fmt!r}"
+    size = struct.calcsize(fmt)
+    assert py.req_header_bytes == size, \
+        f"rpc._REQ_HEADER_BYTES {py.req_header_bytes} != packed {size}"
+    # the fixed trace-context field is exactly the obs plane's constant
+    assert py.wire_context_bytes == 16
+    assert size == 28 + py.wire_context_bytes
+
+
+def test_obs_span_layout(cs, py):
+    fmt = wc.struct_format(cs.structs["ObsSpan"])
+    assert py.span_format is not None
+    assert py.span_format.replace(" ", "") == fmt
+    assert struct.calcsize(fmt) == 64  # the csrc static_assert's twin
+
+
+def test_classification_tables_match_contract(cs):
+    # the full cross-validation (tap/gate/keyed/create + the
+    # untapped-mutation rule) — identical to the lint gate
+    diags = wc.check(REPO)
+    assert diags == [], [str(d) for d in diags]
+
+
+def test_python_mirrors_agree_with_runtime_modules():
+    # the extractor reads source; make sure source == imported runtime
+    # (a conditional re-definition would fool a static extractor)
+    from paddle_tpu.obs import trace
+    from paddle_tpu.ps import graph_client, ha, rpc
+    py = wc.extract_python(REPO)
+    for key, mod in (("rpc", rpc), ("graph", graph_client), ("ha", ha)):
+        for const, (val, _ln) in py.consts[key].items():
+            runtime = getattr(mod, const, None)
+            if isinstance(runtime, int):
+                assert runtime == val, f"{key}.{const}: {runtime} != {val}"
+    assert trace.WIRE_CONTEXT_BYTES == py.wire_context_bytes
+    assert ha._HDR.format.lstrip("<") == py.hdr_format.lstrip("<")
+    assert ha._HDR.size == py.req_header_bytes

@@ -60,10 +60,13 @@ def relpath(path: str, root: str = REPO_ROOT) -> str:
     return os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
 
 
-def walk_py(root: str, subdirs: Iterable[str], files: Iterable[str] = ()
-            ) -> List[str]:
+def walk_py(root: str, subdirs: Iterable[str], files: Iterable[str] = (),
+            only: Optional[Set[str]] = None) -> List[str]:
     """All .py files under root/<subdir> for each subdir, plus explicit
-    root-relative ``files``, absolute paths, sorted."""
+    root-relative ``files``, absolute paths, sorted. ``only`` (a set of
+    repo-relative paths — run.py's --changed view) restricts the result;
+    every pass routes its file discovery through here so the filter
+    cannot be forgotten in a new pass."""
     out = []
     for sub in subdirs:
         base = os.path.join(root, sub)
@@ -76,18 +79,28 @@ def walk_py(root: str, subdirs: Iterable[str], files: Iterable[str] = ()
         p = os.path.join(root, f)
         if os.path.exists(p):
             out.append(p)
+    if only is not None:
+        out = [p for p in out if relpath(p, root) in only]
     return sorted(out)
 
 
-def load_allowlist(path: str) -> Dict[str, int]:
-    """Parse allow.txt → {key: line_number_in_allowlist}.
+@dataclass(frozen=True)
+class AllowEntry:
+    line: int   # line number inside allow.txt
+    why: str    # the justification text (an optional `why:` prefix is
+                # stripped) — surfaced in run.py's JSON summary
 
-    Entry grammar (one per line): ``path:line:rule`` followed by an
-    optional ``# justification`` comment. Blank lines and full-line
-    comments are skipped. A justification is REQUIRED on every entry
-    (enforced here) so the file stays reviewable.
+
+def load_allowlist(path: str) -> Dict[str, AllowEntry]:
+    """Parse allow.txt → {key: AllowEntry}.
+
+    Entry grammar (one per line): ``path:line:rule`` followed by a
+    ``# justification`` comment (equivalently ``# why: justification``).
+    Blank lines and full-line comments are skipped. A justification is
+    REQUIRED on every entry (enforced here) so the file stays
+    reviewable; run.py surfaces it per-violation in the JSON summary.
     """
-    entries: Dict[str, int] = {}
+    entries: Dict[str, AllowEntry] = {}
     if not os.path.exists(path):
         return entries
     with open(path, encoding="utf-8") as f:
@@ -97,21 +110,24 @@ def load_allowlist(path: str) -> Dict[str, int]:
                 continue
             entry, sep, comment = line.partition("#")
             entry = entry.strip()
-            if not sep or not comment.strip():
+            comment = comment.strip()
+            if not sep or not comment:
                 raise ValueError(
                     f"{path}:{i}: allowlist entry needs a '# justification' "
                     f"comment: {line!r}")
+            if comment.lower().startswith("why:"):
+                comment = comment[4:].strip()
             parts = entry.rsplit(":", 2)
             if len(parts) != 3 or not parts[1].isdigit():
                 raise ValueError(
                     f"{path}:{i}: malformed entry {entry!r} "
                     "(want path:line:rule)")
-            entries[entry] = i
+            entries[entry] = AllowEntry(i, comment)
     return entries
 
 
 def split_new_and_allowed(
-    diags: List[Diagnostic], allow: Dict[str, int]
+    diags: List[Diagnostic], allow: Dict[str, "AllowEntry"]
 ) -> Tuple[List[Diagnostic], List[Diagnostic], List[str]]:
     """Partition into (new, allowlisted) and report stale allow entries."""
     new, allowed = [], []

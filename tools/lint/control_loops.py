@@ -189,9 +189,9 @@ def check_file(path: str, root: str) -> List[Diagnostic]:
     return diags
 
 
-def run(root: str) -> List[Diagnostic]:
+def run(root: str, only=None) -> List[Diagnostic]:
     diags: List[Diagnostic] = []
-    for p in walk_py(root, ("paddle_tpu",)):
+    for p in walk_py(root, ("paddle_tpu",), only=only):
         diags.extend(check_file(p, root))
     return sorted(diags, key=lambda d: (d.path, d.line, d.rule))
 

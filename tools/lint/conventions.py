@@ -505,18 +505,19 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
     return diags
 
 
-def run(root: str) -> List[Diagnostic]:
+def run(root: str, only=None) -> List[Diagnostic]:
     diags: List[Diagnostic] = []
     all_rules = {"time-time", "bare-except", "mutable-default", "env-read",
                  "cast-roundtrip", "sleep-no-backoff", "atomic-publish",
                  "unbounded-queue", "anonymous-thread"}
-    for p in walk_py(root, ("paddle_tpu",), ("bench.py",)):
+    for p in walk_py(root, ("paddle_tpu",), ("bench.py",), only=only):
         diags.extend(check_file(p, root, all_rules))
     tools_dir = os.path.join(root, "tools")
     tool_files = sorted(os.listdir(tools_dir)) if os.path.isdir(tools_dir) \
         else []
     for p in walk_py(root, (), tuple(
-            f"tools/{f}" for f in tool_files if f.endswith(".py"))):
+            f"tools/{f}" for f in tool_files if f.endswith(".py")),
+            only=only):
         diags.extend(check_file(p, root, {"time-time", "anonymous-thread"}))
     return sorted(diags, key=lambda d: (d.path, d.line, d.rule))
 

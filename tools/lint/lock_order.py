@@ -264,14 +264,18 @@ def check_file(path: str, root: str) -> List[Diagnostic]:
     return diags
 
 
-def run(root: str, subdir: str = "paddle_tpu/csrc") -> List[Diagnostic]:
+def run(root: str, subdir: str = "paddle_tpu/csrc",
+        only=None) -> List[Diagnostic]:
     base = os.path.join(root, subdir)
     diags: List[Diagnostic] = []
     if not os.path.isdir(base):
         return diags
     for fn in sorted(os.listdir(base)):
         if fn.endswith((".cc", ".h")):
-            diags.extend(check_file(os.path.join(base, fn), root))
+            p = os.path.join(base, fn)
+            if only is not None and relpath(p, root) not in only:
+                continue
+            diags.extend(check_file(p, root))
     return sorted(diags, key=lambda d: (d.path, d.line, d.rule))
 
 

@@ -38,7 +38,7 @@ from typing import Dict, List, Tuple
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from common import (Diagnostic, dotted, line_ignores,  # noqa: E402
-                    walk_py)
+                    relpath, walk_py)
 from tracer_safety import (FuncDef, ModuleInfo, _callees,  # noqa: E402
                            _collect_module, _COLD_RE, _HOT_RE, _Index,
                            _marked)
@@ -132,10 +132,10 @@ def _scan_hot(mi: ModuleInfo, fd: FuncDef) -> List[Diagnostic]:
     return diags
 
 
-def run(root: str, subdirs=("paddle_tpu",), files=("bench.py",)
-        ) -> List[Diagnostic]:
+def run(root: str, subdirs=("paddle_tpu",), files=("bench.py",),
+        only=None) -> List[Diagnostic]:
     modules = [m for m in (_collect_module(p, root)
-                           for p in walk_py(root, subdirs, files))
+                           for p in walk_py(root, subdirs, files, only=only))
                if m is not None]
     index = _Index(modules)
 

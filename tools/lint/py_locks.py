@@ -1,0 +1,711 @@
+"""graftlint pass 7: Python lock discipline for the threading modules.
+
+The csrc side has had a static lock checker since PR 1 (lock_order.py);
+the Python side of the same system — ha/rpc/reshard/autoscale/
+communicator/hot_tier, job_checkpoint, slo/flightrec/timeseries,
+serving/frontend, elastic — grew the SAME bug classes PR after PR and
+relied on human review to catch them: callbacks invoked under a lock,
+blocking RPC/socket/queue ops under a hot-path mutex, and lock-order
+inversions between sibling mutexes. This pass ports the csrc grammar to
+Python comments and adds the two Python-specific rules.
+
+Grammar (docs/STATIC_ANALYSIS.md):
+
+  # LOCK ORDER: a < b < c    partial order over lock names (anywhere in
+                             the file; decls merge)
+  # LOCK LEAF: a b           leaf locks: while one is held NO other
+                             lock may be acquired (and nothing may
+                             block under them by convention — the
+                             blocking rules apply everywhere)
+  # LOCK: name               trailing comment on an acquisition line,
+                             naming the lock (default: the final
+                             attribute segment, ``self._mu`` → ``_mu``)
+  # graftlint: lock-ok <reason>
+                             trailing escape for callback-under-lock /
+                             blocking-under-lock on that line; the
+                             reason is REQUIRED (empty → lock-ok-syntax)
+
+Lock-scope regions come from the AST: ``with self._mu:`` bodies (for
+attributes assigned ``threading.Lock/RLock/Condition`` anywhere in the
+class, module-level lock variables, or any ``with`` target whose final
+segment LOOKS like a lock: ``*_mu``/``*_lock``/``*_cv``/…), plus
+``x.acquire()`` … ``x.release()`` pairs tracked in statement order.
+Nested ``def``/``lambda`` bodies do not execute under the lock and are
+skipped.
+
+Rules:
+
+  lock-order-cycle     the declared order itself has a cycle
+  lock-order-syntax    malformed decl / leaf with declared successors
+  lock-unannotated     nested acquisition whose lock name is not in the
+                       declared order
+  lock-order           nested acquisition contradicting the order
+  lock-leaf            acquiring anything while a declared LEAF lock is
+                       held
+  callback-under-lock  calling a caller-supplied or subscribed callable
+                       inside a lock region: a function parameter, an
+                       ``on_*``/``notify*``/``*callback*``/``*_cb``/
+                       ``*hook*`` name, or a variable bound by
+                       iterating a subscriber-ish collection
+                       (``for fn in self._on_fire: fn(...)``). The
+                       callee can take arbitrary locks or block — the
+                       CircuitBreaker/SloWatchdog contract is notify
+                       AFTER release. ``cond.notify{,_all}()`` on a
+                       tracked lock/condition is exempt (that is the
+                       condition-variable protocol, not a callback).
+  blocking-under-lock  a blocking operation inside a lock region:
+                       ``time.sleep``, socket IO, thread/queue
+                       ``join``, ``<q>.put`` on a BOUNDED queue /
+                       ``<q>.get`` (the nowait forms are fine),
+                       ``<event>.wait``, future ``.result``, and the
+                       PS RPC surface (``conn.call/check``,
+                       ``make_conn``, ``send_replicate``, client
+                       pull/push ops). ``cv.wait()`` under its OWN
+                       region is the condition protocol and exempt.
+
+Like the csrc pass this is lexical and per-function: it sees nesting
+and calls inside one body, not interprocedural chains — the annotations
+plus the TSAN sweep cover the rest.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from common import Diagnostic, dotted, line_ignores, relpath, walk_py  # noqa: E402
+from lock_order import _find_cycle, _reachable  # noqa: E402
+
+_ORDER_RE = re.compile(r"#\s*LOCK ORDER:\s*(.+)$")
+_LEAF_RE = re.compile(r"#\s*LOCK LEAF:\s*(.+)$")
+_TAG_RE = re.compile(r"#\s*LOCK:\s*(\w+)")
+_LOCK_OK_RE = re.compile(r"#\s*graftlint:\s*lock-ok\b[:\s]*(.*)$")
+
+# a `with X:` whose final segment matches this is a lock region even
+# when the assignment site is in another class/module (cross-object
+# locks like `self.cluster.control_mu`)
+_LOCKISH_NAME_RE = re.compile(r"(^|_)(mu|mutex|lock|cv|cond)$")
+
+# callee names that denote caller-supplied / subscribed callables
+_CALLBACK_NAME_RE = re.compile(
+    r"^_?(on_[a-z0-9_]+|notify(_[a-z0-9_]+)?|[a-z0-9_]*callback[a-z0-9_]*"
+    r"|[a-z0-9_]+_cb|[a-z0-9_]*hook[a-z0-9_]*)$")
+
+# attribute names that hold subscriber/listener collections: calling a
+# loop variable bound from one of these is a callback invocation
+_SUBSCRIBER_ATTR_RE = re.compile(
+    r"^_?(subs|subscribers|listeners|callbacks|watchers|observers|hooks"
+    r"|on_[a-z0-9_]+)$")
+
+# blocking method names on arbitrary receivers (socket IO + the PS RPC
+# client surface — `conn.call(...)` / `c.check(...)` IS a TCP roundtrip)
+_BLOCKING_METHODS = {
+    "recv": "socket recv", "recv_into": "socket recv",
+    "sendall": "socket send", "connect": "socket connect",
+    "accept": "socket accept", "readline": "socket read",
+    "failover": "routing-store poll",
+    "call": "PS RPC", "check": "PS RPC",
+    "send_replicate": "replication RPC",
+    "drain_remote": "replication RPC",
+    "pull_sparse": "PS RPC", "push_sparse": "PS RPC",
+    "pull_dense": "PS RPC", "push_dense": "PS RPC",
+    "insert_full": "PS RPC", "export_full": "PS RPC",
+    "snapshot_items": "PS RPC", "global_step": "PS RPC",
+    "barrier": "PS barrier",
+    "result": "future result",
+}
+
+# module-level blocking callables (resolved through import aliases)
+_BLOCKING_FUNCS = {
+    "time.sleep": "sleep",
+    "socket.create_connection": "socket connect",
+    "socket.getaddrinfo": "DNS resolution",
+}
+_LOCAL_BLOCKING_FUNCS = {"make_conn": "TCP connect",
+                         "_ServerConn": "TCP connect"}
+
+_THREADING_LOCKS = {"threading.Lock", "threading.RLock",
+                    "threading.Condition"}
+_QUEUE_CLASSES = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue"}
+
+
+def _parse_decls(lines: List[str], path: str) -> Tuple[
+        Dict[str, Set[str]], Set[str], List[Diagnostic]]:
+    """Same semantics as lock_order._parse_order, '#' comment grammar."""
+    edges: Dict[str, Set[str]] = {}
+    leaves: Set[str] = set()
+    diags: List[Diagnostic] = []
+    for i, line in enumerate(lines, 1):
+        lm = _LEAF_RE.search(line)
+        if lm:
+            names = lm.group(1).split()
+            if not names or not all(re.fullmatch(r"\w+", n) for n in names):
+                diags.append(Diagnostic(path, i, "lock-order-syntax",
+                                        f"malformed LOCK LEAF decl: "
+                                        f"{lm.group(1).strip()!r} "
+                                        "(want `a [b ...]`)"))
+                continue
+            leaves.update(names)
+            continue
+        m = _ORDER_RE.search(line)
+        if not m:
+            continue
+        names = [n.strip() for n in m.group(1).split("<")]
+        if len(names) < 2 or not all(re.fullmatch(r"\w+", n) for n in names):
+            diags.append(Diagnostic(path, i, "lock-order-syntax",
+                                    f"malformed LOCK ORDER decl: "
+                                    f"{m.group(1).strip()!r} "
+                                    "(want `a < b [< c ...]`)"))
+            continue
+        for a, b in zip(names, names[1:]):
+            edges.setdefault(a, set()).add(b)
+            edges.setdefault(b, set())
+    return edges, leaves, diags
+
+
+class _Aliases:
+    """Resolve dotted callee names through the module's imports:
+    `th.Lock` → `threading.Lock`, `sleep` (from time import sleep) →
+    `time.sleep`."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.mod: Dict[str, str] = {}    # local name -> module path
+        self.sym: Dict[str, str] = {}    # local name -> module.symbol
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.mod[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    self.sym[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def resolve(self, name: Optional[str]) -> Optional[str]:
+        if not name:
+            return None
+        head, _, rest = name.partition(".")
+        if rest and head in self.mod:
+            return f"{self.mod[head]}.{rest}"
+        if not rest and name in self.sym:
+            return self.sym[name]
+        return name
+
+
+@dataclass
+class _Held:
+    name: str
+    line: int
+    obj: Optional[str]  # final attr segment of the lock expr, for exemptions
+
+
+@dataclass
+class _FileCtx:
+    rel: str
+    lines: List[str]
+    aliases: _Aliases
+    edges: Dict[str, Set[str]]
+    leaves: Set[str]
+    locks_mod: Set[str] = field(default_factory=set)       # module-level names
+    locks_attr: Set[str] = field(default_factory=set)      # self.X across file
+    cond_bound: Dict[str, str] = field(default_factory=dict)  # cv -> its lock
+    queues_bounded: Set[str] = field(default_factory=set)  # attr/var names
+    queues_all: Set[str] = field(default_factory=set)
+    diags: List[Diagnostic] = field(default_factory=list)
+
+
+def _final_segment(node: ast.AST) -> Optional[str]:
+    d = dotted(node)
+    return d.rsplit(".", 1)[-1] if d else None
+
+
+def _collect_locks(tree: ast.Module, ctx: _FileCtx) -> None:
+    """Find lock/queue objects by their construction sites."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        callee = ctx.aliases.resolve(dotted(node.value.func))
+        for tgt in targets:
+            if isinstance(tgt, ast.Attribute) and \
+                    isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id == "self":
+                name, is_attr = tgt.attr, True
+            elif isinstance(tgt, ast.Name):
+                name, is_attr = tgt.id, False
+            else:
+                continue
+            if callee in _THREADING_LOCKS:
+                (ctx.locks_attr if is_attr else ctx.locks_mod).add(name)
+                if callee == "threading.Condition":
+                    # Condition(lock) waits/notifies on THAT lock; a
+                    # bare Condition() owns its own
+                    bound = (_final_segment(node.value.args[0])
+                             if node.value.args else None)
+                    ctx.cond_bound[name] = bound or name
+            elif callee in _QUEUE_CLASSES:
+                ctx.queues_all.add(name)
+                if _queue_is_bounded(node.value):
+                    ctx.queues_bounded.add(name)
+
+
+def _queue_is_bounded(call: ast.Call) -> bool:
+    """Queue(maxsize=N): bounded unless maxsize is literally <= 0 or
+    absent. A non-literal maxsize is assumed bounded (that is the point
+    of passing one)."""
+    arg = None
+    if call.args:
+        arg = call.args[0]
+    for kw in call.keywords:
+        if kw.arg == "maxsize":
+            arg = kw.value
+    if arg is None:
+        return False
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, (int, float)):
+        return arg.value > 0
+    return True
+
+
+def _lock_name_of_with_item(item: ast.withitem, ctx: _FileCtx
+                            ) -> Optional[Tuple[str, str]]:
+    """(lock name, final attr segment) when the context expr is a lock."""
+    expr = item.context_expr
+    seg = _final_segment(expr)
+    if seg is None:
+        return None
+    if isinstance(expr, ast.Name):
+        if seg in ctx.locks_mod or _LOCKISH_NAME_RE.search(seg):
+            return seg, seg
+        return None
+    if isinstance(expr, ast.Attribute):
+        if seg in ctx.locks_attr or seg in ctx.locks_mod or \
+                _LOCKISH_NAME_RE.search(seg):
+            return seg, seg
+    return None
+
+
+#: the ONLY rules `# graftlint: lock-ok` may waive — ordering/leaf
+#: violations have no justified form and need the audited allowlist
+_LOCK_OK_RULES = {"callback-under-lock", "blocking-under-lock"}
+
+
+def _suppressed(ctx: _FileCtx, line: int, rule: str, end_line: int) -> bool:
+    """An ignore[] / lock-ok escape anywhere on the statement's lines
+    (a call can span several) suppresses the diagnostic; lock-ok only
+    waives the callback/blocking rules."""
+    for ln in range(line, min(end_line, line + 8) + 1):
+        if rule in line_ignores(ctx.lines, ln):
+            return True
+        if rule not in _LOCK_OK_RULES:
+            continue
+        if 1 <= ln <= len(ctx.lines):
+            m = _LOCK_OK_RE.search(ctx.lines[ln - 1])
+            if m:
+                if m.group(1).strip():
+                    return True
+                ctx.diags.append(Diagnostic(
+                    ctx.rel, ln, "lock-ok-syntax",
+                    "`# graftlint: lock-ok` needs a reason (`# graftlint: "
+                    "lock-ok <why this cannot block/deadlock>`)"))
+                return True  # malformed escape reported; don't double up
+    return False
+
+
+def _emit(ctx: _FileCtx, line: int, rule: str, msg: str,
+          end_line: Optional[int] = None) -> None:
+    if not _suppressed(ctx, line, rule, end_line or line):
+        ctx.diags.append(Diagnostic(ctx.rel, line, rule, msg))
+
+
+class _FunctionScan:
+    """One function body: track held locks in statement order, check
+    nesting against the declared order, and classify calls made while
+    any lock is held."""
+
+    def __init__(self, func: ast.AST, ctx: _FileCtx) -> None:
+        self.ctx = ctx
+        self.func = func
+        self.params: Set[str] = set()
+        args = getattr(func, "args", None)
+        if args is not None:
+            for a in (args.posonlyargs + args.args + args.kwonlyargs):
+                if a.arg != "self":
+                    self.params.add(a.arg)
+        self.subscriber_vars: Set[str] = set()
+        self.held: List[_Held] = []
+
+    # -- region bookkeeping -------------------------------------------------
+
+    def _tag_or(self, line: int, default: str) -> str:
+        if 1 <= line <= len(self.ctx.lines):
+            m = _TAG_RE.search(self.ctx.lines[line - 1])
+            if m:
+                return m.group(1)
+        return default
+
+    def _push(self, name: str, line: int, obj: Optional[str]) -> None:
+        ctx = self.ctx
+        for h in self.held:
+            if h.name == name:      # RLock reentry / same lock: not nesting
+                continue
+            if h.name in ctx.leaves:
+                _emit(ctx, line, "lock-leaf",
+                      f"acquires `{name}` while leaf lock `{h.name}` is "
+                      f"held (line {h.line}) — LOCK LEAF locks must be "
+                      "innermost")
+            elif name in ctx.leaves:
+                continue            # a leaf nests under anything by contract
+            elif h.name not in ctx.edges or name not in ctx.edges:
+                missing = name if name not in ctx.edges else h.name
+                _emit(ctx, line, "lock-unannotated",
+                      f"nested acquisition of `{name}` while `{h.name}` "
+                      f"held (line {h.line}) but `{missing}` is not in any "
+                      "LOCK ORDER decl")
+            elif not _reachable(ctx.edges, h.name, name):
+                _emit(ctx, line, "lock-order",
+                      f"acquires `{name}` while holding `{h.name}` (line "
+                      f"{h.line}) — declared order does not allow "
+                      f"{h.name} < {name}")
+        self.held.append(_Held(name, line, obj))
+
+    def _pop(self, name: str) -> None:
+        for i in range(len(self.held) - 1, -1, -1):
+            if self.held[i].name == name:
+                del self.held[i]
+                return
+
+    # -- statement walk -----------------------------------------------------
+
+    def scan(self) -> None:
+        self._scan_body(list(getattr(self.func, "body", [])))
+
+    def _scan_body(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self._scan_stmt(stmt)
+
+    def _acquire_release(self, stmt: ast.stmt) -> Optional[Tuple[str, str,
+                                                                 ast.Call]]:
+        """('acquire'|'release', lock name, call) for `x.acquire()` /
+        `x.release()` expression statements."""
+        if not (isinstance(stmt, ast.Expr) and
+                isinstance(stmt.value, ast.Call) and
+                isinstance(stmt.value.func, ast.Attribute)):
+            return None
+        meth = stmt.value.func.attr
+        if meth not in ("acquire", "release"):
+            return None
+        obj = _final_segment(stmt.value.func.value)
+        if obj is None:
+            return None
+        return meth, obj, stmt.value
+
+    def _scan_stmt(self, stmt: ast.stmt) -> None:
+        ctx = self.ctx
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # a nested def does not run under the lock
+        ar = self._acquire_release(stmt)
+        if ar is not None:
+            meth, obj, call = ar
+            name = self._tag_or(stmt.lineno, obj)
+            if meth == "acquire":
+                if self.held:
+                    self._check_calls_outside_regions(stmt)
+                self._push(name, stmt.lineno, obj)
+            else:
+                self._pop(name)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            pushed = []
+            for item in stmt.items:
+                got = _lock_name_of_with_item(item, ctx)
+                if got is None:
+                    if self.held:
+                        self._check_expr(item.context_expr)
+                    continue
+                seg, obj = got
+                name = self._tag_or(stmt.lineno, seg)
+                self._push(name, stmt.lineno, obj)
+                pushed.append(name)
+            self._scan_body(stmt.body)
+            for name in reversed(pushed):
+                self._pop(name)
+            return
+        if isinstance(stmt, ast.For):
+            self._note_subscriber_iter(stmt)
+            if self.held:
+                self._check_expr(stmt.iter)
+            self._scan_body(stmt.body)
+            self._scan_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.While):
+            if self.held:
+                self._check_expr(stmt.test)
+            self._scan_body(stmt.body)
+            self._scan_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.If):
+            if self.held:
+                self._check_expr(stmt.test)
+            self._scan_body(stmt.body)
+            self._scan_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self._scan_body(stmt.body)
+            for h in stmt.handlers:
+                self._scan_body(h.body)
+            self._scan_body(stmt.orelse)
+            self._scan_body(stmt.finalbody)
+            return
+        # leaf statement: check every call in it when a lock is held
+        self._note_subscriber_assign(stmt)
+        if self.held:
+            self._check_calls_outside_regions(stmt)
+
+    def _check_calls_outside_regions(self, node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            self._check_expr(child)
+
+    # -- subscriber-variable tracking ---------------------------------------
+
+    def _unwrap_iterable(self, node: ast.AST) -> Optional[str]:
+        """Final attr segment of the underlying collection:
+        `list(self._subs)`, `self._subs.copy()`, `self._subs[:]` →
+        `_subs`."""
+        while True:
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Name) and f.id in ("list", "tuple",
+                                                        "sorted", "reversed",
+                                                        "iter", "enumerate") \
+                        and node.args:
+                    node = node.args[0]
+                    continue
+                if isinstance(f, ast.Attribute) and f.attr in ("copy",
+                                                               "values",
+                                                               "items"):
+                    node = f.value
+                    continue
+                return None
+            if isinstance(node, ast.Subscript):
+                node = node.value
+                continue
+            return _final_segment(node)
+
+    def _note_subscriber_iter(self, stmt: ast.For) -> None:
+        seg = self._unwrap_iterable(stmt.iter)
+        if seg and _SUBSCRIBER_ATTR_RE.match(seg):
+            for t in ast.walk(stmt.target):
+                if isinstance(t, ast.Name):
+                    self.subscriber_vars.add(t.id)
+
+    def _note_subscriber_assign(self, stmt: ast.stmt) -> None:
+        if not isinstance(stmt, ast.Assign):
+            return
+        seg = self._unwrap_iterable(stmt.value)
+        if seg and _SUBSCRIBER_ATTR_RE.match(seg):
+            for tgt in stmt.targets:
+                for t in ast.walk(tgt):
+                    if isinstance(t, ast.Name):
+                        self.subscriber_vars.add(t.id)
+
+    # -- call classification --------------------------------------------------
+
+    def _check_expr(self, node: ast.AST) -> None:
+        # manual walk so deferred bodies (lambda / nested def) are
+        # truly skipped — ast.walk would descend into their children
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            if isinstance(n, ast.Call):
+                self._check_call(n)
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _innermost(self) -> _Held:
+        return self.held[-1]
+
+    def _cv_protocol_ok(self, recv: ast.AST) -> bool:
+        """True when `recv.wait()/notify*()` is the condition-variable
+        protocol on the lock currently held: the receiver — or the lock
+        its Condition was constructed over — is the INNERMOST held
+        lock. Waiting on a condition bound to some OTHER mutex does not
+        release the held one; it parks it for the whole wait."""
+        seg = _final_segment(recv)
+        if seg is None or not self.held:
+            return False
+        h = self.held[-1]
+        names = {h.name, h.obj}
+        if seg in names:
+            return True
+        bound = self.ctx.cond_bound.get(seg)
+        return bound is not None and bound in names
+
+    def _check_call(self, call: ast.Call) -> None:
+        ctx = self.ctx
+        line = call.lineno
+        end = getattr(call, "end_lineno", None) or line
+
+        def emit(rule: str, msg: str) -> None:
+            _emit(ctx, line, rule, msg, end)
+
+        lock = self._innermost().name
+        f = call.func
+
+        # callback-under-lock ------------------------------------------------
+        if isinstance(f, ast.Name):
+            if f.id in self.params:
+                emit("callback-under-lock",
+                      f"calls caller-supplied callable `{f.id}` while "
+                      f"holding `{lock}` — invoke callbacks after release "
+                      "(the subscriber can take arbitrary locks or block)")
+                return
+            if f.id in self.subscriber_vars:
+                emit("callback-under-lock",
+                      f"invokes subscribed callable `{f.id}` while holding "
+                      f"`{lock}` — snapshot the subscriber list under the "
+                      "lock, notify after release")
+                return
+        seg = _final_segment(f) if isinstance(f, (ast.Name, ast.Attribute)) \
+            else None
+        if seg and _CALLBACK_NAME_RE.match(seg):
+            recv = f.value if isinstance(f, ast.Attribute) else None
+            if not (recv is not None and
+                    self._cv_protocol_ok(recv)):
+                emit("callback-under-lock",
+                      f"calls `{seg}` while holding `{lock}` — "
+                      "notify/callback invocations must happen outside "
+                      "lock regions (flight-recorder/SLO-subscriber "
+                      "contract)")
+                return
+
+        # blocking-under-lock ------------------------------------------------
+        resolved = ctx.aliases.resolve(dotted(f))
+        if resolved in _BLOCKING_FUNCS:
+            emit("blocking-under-lock",
+                  f"{_BLOCKING_FUNCS[resolved]} (`{resolved}`) while "
+                  f"holding `{lock}` — every waiter on the lock now waits "
+                  "on the IO too")
+            return
+        if isinstance(f, ast.Name) and f.id in _LOCAL_BLOCKING_FUNCS:
+            emit("blocking-under-lock",
+                  f"{_LOCAL_BLOCKING_FUNCS[f.id]} (`{f.id}`) while holding "
+                  f"`{lock}` — build connections outside the lock, swap "
+                  "the reference under it")
+            return
+        if not isinstance(f, ast.Attribute):
+            return
+        meth = f.attr
+        recv_seg = _final_segment(f.value)
+        if meth == "wait":
+            if not self._cv_protocol_ok(f.value):
+                emit("blocking-under-lock",
+                      f"`.wait()` on `{recv_seg or '?'}` while holding "
+                      f"`{lock}` — only a Condition may wait under its own "
+                      "lock (it releases it); anything else parks the lock")
+            return
+        if meth == "join":
+            if self._join_is_blocking(call, recv_seg):
+                emit("blocking-under-lock",
+                      f"`.join()` on `{recv_seg or '?'}` while holding "
+                      f"`{lock}` — joining a thread/queue under a lock the "
+                      "joined work may need is the canonical deadlock")
+            return
+        if meth in ("put", "get"):
+            if recv_seg in ctx.queues_all:
+                nowait = any(kw.arg == "block" and
+                             isinstance(kw.value, ast.Constant) and
+                             kw.value.value is False
+                             for kw in call.keywords)
+                bounded = recv_seg in ctx.queues_bounded
+                if not nowait and (meth == "get" or bounded):
+                    emit("blocking-under-lock",
+                          f"blocking `.{meth}()` on "
+                          f"{'bounded ' if bounded else ''}queue "
+                          f"`{recv_seg}` while holding `{lock}` — a full/"
+                          "empty queue parks every thread that needs the "
+                          "lock (use the _nowait form, or move the "
+                          "blocking op outside the region)")
+            return
+        if meth in _BLOCKING_METHODS:
+            emit("blocking-under-lock",
+                  f"{_BLOCKING_METHODS[meth]} (`.{meth}()`) while holding "
+                  f"`{lock}` — blocking IO under a mutex serializes the "
+                  "whole plane behind one wire round-trip")
+
+    @staticmethod
+    def _join_is_blocking(call: ast.Call, recv_seg: Optional[str]) -> bool:
+        # `" ".join(parts)` / `os.path.join(a, b)` are string/path joins
+        if recv_seg == "path":
+            return False
+        if isinstance(call.func, ast.Attribute) and \
+                isinstance(call.func.value, ast.Constant):
+            return False
+        if len(call.args) > 1:
+            return False
+        if call.args and not (isinstance(call.args[0], ast.Constant) and
+                              isinstance(call.args[0].value, (int, float))):
+            return False
+        return True
+
+
+def check_file(path: str, root: str) -> List[Diagnostic]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    rel = relpath(path, root)
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Diagnostic(rel, e.lineno or 1, "lock-order-syntax",
+                           f"unparsable: {e.msg}")]
+    edges, leaves, diags = _parse_decls(lines, rel)
+    for leaf in sorted(leaves):
+        if edges.get(leaf):
+            diags.append(Diagnostic(
+                rel, 1, "lock-order-syntax",
+                f"`{leaf}` declared LOCK LEAF but has successors in a "
+                f"LOCK ORDER decl ({', '.join(sorted(edges[leaf]))}) — "
+                "a leaf lock is innermost by definition"))
+    cyc = _find_cycle(edges)
+    if cyc:
+        diags.append(Diagnostic(rel, 1, "lock-order-cycle",
+                                "declared LOCK ORDER has a cycle: "
+                                + " < ".join(cyc)))
+        return diags
+
+    ctx = _FileCtx(rel=rel, lines=lines, aliases=_Aliases(tree),
+                   edges=edges, leaves=leaves, diags=diags)
+    _collect_locks(tree, ctx)
+    if not (ctx.locks_attr or ctx.locks_mod or
+            "# LOCK" in src or ".acquire()" in src):
+        return diags
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _FunctionScan(node, ctx).scan()
+    return diags
+
+
+def run(root: str, subdirs=("paddle_tpu",), files=(),
+        only: Optional[Set[str]] = None) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for p in walk_py(root, subdirs, files, only=only):
+        diags.extend(check_file(p, root))
+    return sorted(diags, key=lambda d: (d.path, d.line, d.rule))
+
+
+if __name__ == "__main__":
+    from common import REPO_ROOT
+    for d in run(REPO_ROOT):
+        print(d)

@@ -503,10 +503,10 @@ def _scan_hot_function(mi: ModuleInfo, fd: FuncDef) -> List[Diagnostic]:
     return diags
 
 
-def run_hot_path(root: str, subdirs=("paddle_tpu",), files=("bench.py",)
-                 ) -> List[Diagnostic]:
+def run_hot_path(root: str, subdirs=("paddle_tpu",), files=("bench.py",),
+                 only=None) -> List[Diagnostic]:
     modules = [m for m in (_collect_module(p, root)
-                           for p in walk_py(root, subdirs, files))
+                           for p in walk_py(root, subdirs, files, only=only))
                if m is not None]
     index = _Index(modules)
 
@@ -535,10 +535,10 @@ def run_hot_path(root: str, subdirs=("paddle_tpu",), files=("bench.py",)
     return sorted(diags, key=lambda d: (d.path, d.line, d.rule))
 
 
-def run(root: str, subdirs=("paddle_tpu",), files=("bench.py",)
-        ) -> List[Diagnostic]:
+def run(root: str, subdirs=("paddle_tpu",), files=("bench.py",),
+        only=None) -> List[Diagnostic]:
     modules = [m for m in (_collect_module(p, root)
-                           for p in walk_py(root, subdirs, files))
+                           for p in walk_py(root, subdirs, files, only=only))
                if m is not None]
     index = _Index(modules)
 

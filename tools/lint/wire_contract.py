@@ -1,0 +1,566 @@
+"""graftlint pass 8: cross-language wire-contract drift checker.
+
+The PS wire protocol lives in TWO languages: csrc/ps_service.cc owns
+the Cmd/Err enums, the packed ReqHeader, and the per-cmd classification
+predicates (tapped-for-replication, pause-gate/read-only plane,
+key-ownership fence); ps/rpc.py, ps/ha.py, ps/graph_client.py and
+obs/trace.py hand-mirror the values Python needs (`_PULL_SPARSE = 3`,
+`_HDR = struct.Struct("<QIIqiQQ")`, `_rpc_err_stale_epoch = -5`, …).
+Until this pass, one comment and one pinned test defended that mirror;
+everything else was convention. This is the static complement of the
+PR 4 digest machinery: digests catch divergence at RUNTIME, this pass
+catches it at commit time.
+
+Three sources are cross-validated:
+
+1. a csrc extractor (line-based, clang-free, like lock_order.py):
+   Cmd/Err enum values, ReqHeader/ObsSpan packed field layouts, and
+   the four classification switches (`is_mutating_cmd` = the oplog
+   tap, `is_training_plane_cmd` = the read-only/pause gate,
+   `is_keyed_data_cmd` = the ownership fence scan, `is_create_cmd`);
+2. a Python extractor: module-level int constants in rpc/graph_client,
+   ha's `_rpc_err_*` + `_HDR`, trace's `WIRE_CONTEXT_BYTES` +
+   `SERVER_SPAN_STRUCT`, and the `status → exception` mapping inside
+   `_ServerConn.check` (AST);
+3. CONTRACT below — the reviewed table every cmd must appear in. A new
+   csrc cmd fails the gate until it is classified here, which is where
+   "mutating but deliberately NOT replicated" must be said out loud
+   (`local_only=True`: operator save/load flows with server-local
+   paths, the epoch/seq fencing plane, the unreplicated graph service).
+
+Rules (all fatal; none are allowlisted in practice — drift is a bug):
+
+  wire-cmd-drift        csrc Cmd enum vs CONTRACT (value/missing/extra)
+  wire-cmd-mirror       Python cmd constant missing or value drift
+  wire-err-drift        csrc Err enum vs CONTRACT
+  wire-err-mirror       Python error mirror (const or raised exception)
+                        missing or value drift
+  wire-header-drift     ReqHeader fields vs ha._HDR format vs
+                        rpc._REQ_HEADER_BYTES vs trace.WIRE_CONTEXT_BYTES;
+                        ObsSpan vs trace.SERVER_SPAN_STRUCT
+  wire-class-drift      tap/gate/keyed/create classification in csrc
+                        disagrees with CONTRACT
+  wire-untapped-mutation a cmd the gate treats as a mutation is neither
+                        tapped for replication nor declared local_only
+
+tests/test_wire_contract.py reuses :func:`extract_csrc` and
+:func:`extract_python` as a library so the same pins also fail plain
+pytest (tier-1), not just the lint gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import struct
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from common import Diagnostic, dotted, relpath  # noqa: E402
+
+# ---------------------------------------------------------------------------
+# the reviewed contract: every wire command, classified
+# ---------------------------------------------------------------------------
+# fields: id; py = Python mirror constant (module key, name) or None;
+# tap  = is_mutating_cmd     (oplog tap)          yes/no/cond
+# gate = is_training_plane_cmd (read-only refuse + obs gate class)
+# keyed = is_keyed_data_cmd   (payload leads with [u64 keys × n])
+# local_only = mutates server state but is DELIBERATELY untapped
+#              (operator flows with local paths; fencing plane; the
+#              unreplicated graph service)
+
+
+@dataclass(frozen=True)
+class CmdSpec:
+    id: int
+    py: Optional[Tuple[str, str]]
+    tap: str = "no"
+    gate: str = "no"
+    keyed: bool = False
+    create: bool = False
+    local_only: bool = False
+
+
+CONTRACT: Dict[str, CmdSpec] = {
+    "kCreateSparse": CmdSpec(1, ("rpc", "_CREATE_SPARSE"), tap="yes",
+                             create=True),
+    "kCreateDense": CmdSpec(2, ("rpc", "_CREATE_DENSE"), tap="yes",
+                            create=True),
+    "kPullSparse": CmdSpec(3, ("rpc", "_PULL_SPARSE"), tap="cond",
+                           keyed=True),
+    "kPushSparse": CmdSpec(4, ("rpc", "_PUSH_SPARSE"), tap="yes",
+                           gate="yes", keyed=True),
+    "kPullDense": CmdSpec(5, ("rpc", "_PULL_DENSE")),
+    "kPushDense": CmdSpec(6, ("rpc", "_PUSH_DENSE"), tap="yes", gate="yes"),
+    "kSetDense": CmdSpec(7, ("rpc", "_SET_DENSE"), tap="yes", gate="yes"),
+    "kSize": CmdSpec(8, ("rpc", "_SIZE")),
+    "kShrink": CmdSpec(9, ("rpc", "_SHRINK"), tap="yes", gate="yes"),
+    "kSaveBegin": CmdSpec(10, ("rpc", "_SAVE_BEGIN")),
+    "kSaveFetch": CmdSpec(11, ("rpc", "_SAVE_FETCH")),
+    "kInsertFull": CmdSpec(12, ("rpc", "_INSERT_FULL"), tap="yes",
+                           keyed=True),
+    "kExport": CmdSpec(13, ("rpc", "_EXPORT"), tap="cond", gate="cond",
+                       keyed=True),
+    "kBarrier": CmdSpec(14, ("rpc", "_BARRIER")),
+    "kStop": CmdSpec(15, ("rpc", "_STOP"), local_only=True),
+    "kPing": CmdSpec(16, ("rpc", "_PING")),
+    "kGlobalStep": CmdSpec(17, ("rpc", "_GLOBAL_STEP"), tap="cond"),
+    "kCreateGeo": CmdSpec(18, ("rpc", "_CREATE_GEO"), tap="yes",
+                          create=True),
+    "kPushGeo": CmdSpec(19, ("rpc", "_PUSH_GEO"), tap="yes", gate="yes",
+                        keyed=True),
+    "kPullGeo": CmdSpec(20, ("rpc", "_PULL_GEO"), tap="yes", gate="yes"),
+    "kSaveAll": CmdSpec(21, ("rpc", "_SAVE_ALL")),
+    "kSpill": CmdSpec(22, ("rpc", "_SPILL"), local_only=True),
+    "kStats": CmdSpec(23, ("rpc", "_STATS")),
+    "kCompact": CmdSpec(24, ("rpc", "_COMPACT"), local_only=True),
+    # graph service: mutates the graph table but the graph plane is NOT
+    # replicated (no oplog tap by design) — hence local_only
+    "kCreateGraph": CmdSpec(25, ("graph", "_CREATE_GRAPH"),
+                            local_only=True),
+    "kGraphAddNodes": CmdSpec(26, ("graph", "_ADD_NODES"), local_only=True),
+    "kGraphAddEdges": CmdSpec(27, ("graph", "_ADD_EDGES"), local_only=True),
+    "kGraphSampleNeighbors": CmdSpec(28, ("graph", "_SAMPLE_NEIGHBORS")),
+    "kGraphDegree": CmdSpec(29, ("graph", "_DEGREE")),
+    "kGraphNodeFeat": CmdSpec(30, ("graph", "_NODE_FEAT")),
+    "kGraphSetNodeFeat": CmdSpec(31, ("graph", "_SET_NODE_FEAT"),
+                                 local_only=True),
+    "kGraphSampleNodes": CmdSpec(32, ("graph", "_SAMPLE_NODES")),
+    "kGraphStats": CmdSpec(33, ("graph", "_GRAPH_STATS")),
+    # operator bulk save/load: server-local paths, deliberately
+    # unreplicated (ha.py documents the restriction)
+    "kLoadCold": CmdSpec(34, ("rpc", "_LOAD_COLD"), tap="yes", gate="yes",
+                         keyed=True),
+    "kSaveFile": CmdSpec(35, ("rpc", "_SAVE_FILE"), local_only=True),
+    "kLoadFile": CmdSpec(36, ("rpc", "_LOAD_FILE"), local_only=True),
+    # HA / replication control plane: the fence itself must never
+    # replicate (a demoted primary's stream is what it fences out)
+    "kReplicate": CmdSpec(37, ("rpc", "_REPLICATE"), local_only=True),
+    "kEpoch": CmdSpec(38, ("rpc", "_EPOCH"), local_only=True),
+    "kReplState": CmdSpec(39, ("rpc", "_REPL_STATE"), local_only=True),
+    "kDigest": CmdSpec(40, ("rpc", "_DIGEST")),
+    "kDenseSnap": CmdSpec(41, ("rpc", "_DENSE_SNAP")),
+    "kDenseRestore": CmdSpec(42, ("rpc", "_DENSE_RESTORE"), tap="yes"),
+    "kObsSnap": CmdSpec(43, ("rpc", "_OBS_SNAP"), local_only=True),
+    "kRetain": CmdSpec(44, ("rpc", "_RETAIN"), tap="cond", gate="cond"),
+}
+
+# error codes: py mirror is either a module-level constant in ha.py or
+# the exception _ServerConn.check raises for that status (or None)
+ERR_CONTRACT: Dict[str, Tuple[int, Optional[Tuple[str, str]]]] = {
+    "kErrBadCmd": (-1, None),
+    "kErrNoTable": (-2, ("raise", "NotFoundError")),
+    "kErrBadSize": (-3, None),
+    "kErrInternal": (-4, None),
+    "kErrStaleEpoch": (-5, ("ha", "_rpc_err_stale_epoch")),
+    "kErrSeqGap": (-6, ("ha", "_rpc_err_seq_gap")),
+    "kErrReadOnly": (-7, ("raise", "PreconditionNotMetError")),
+    "kErrWrongShard": (-8, ("raise", "WrongShardError")),
+}
+
+_CTYPE_FMT = {"uint64_t": "Q", "int64_t": "q", "uint32_t": "I",
+              "int32_t": "i", "uint16_t": "H", "int16_t": "h",
+              "uint8_t": "B", "int8_t": "b", "double": "d", "float": "f"}
+
+_CSRC = "paddle_tpu/csrc/ps_service.cc"
+_PY_FILES = {"rpc": "paddle_tpu/ps/rpc.py",
+             "graph": "paddle_tpu/ps/graph_client.py",
+             "ha": "paddle_tpu/ps/ha.py",
+             "trace": "paddle_tpu/obs/trace.py"}
+# the pass's own file is relevant too: a CONTRACT edit must re-run the
+# cross-validation in --changed mode
+RELEVANT_FILES = (_CSRC, *_PY_FILES.values(),
+                  "tools/lint/wire_contract.py")
+
+
+# ---------------------------------------------------------------------------
+# csrc extractor (line-based; no clang)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class CsrcContract:
+    cmds: Dict[str, Tuple[int, int]] = field(default_factory=dict)  # val,line
+    errs: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+    structs: Dict[str, List[Tuple[str, str, int]]] = \
+        field(default_factory=dict)            # name -> [(ctype, field, line)]
+    classify: Dict[str, Dict[str, str]] = \
+        field(default_factory=dict)            # fn -> {cmd: yes|no|cond}
+
+
+_ENUM_START_RE = re.compile(r"enum\s+(\w+)\s*(?::\s*\w+)?\s*\{")
+_ENUM_ENTRY_RE = re.compile(r"^\s*(k\w+)\s*=\s*(-?\d+)\s*,?")
+_STRUCT_START_RE = re.compile(r"struct\s+(\w+)\s*\{")
+_FIELD_RE = re.compile(r"^\s*(\w+)\s+(\w+(?:\s*,\s*\w+)*)\s*(?:=[^;]*)?;")
+_FN_START_RE = re.compile(r"inline\s+bool\s+(is_\w+)\s*\(")
+_CASE_RE = re.compile(r"^\s*case\s+(k\w+)\s*:")
+_RETURN_RE = re.compile(r"^\s*return\s+([^;]+);")
+
+
+def extract_csrc(path: str) -> CsrcContract:
+    out = CsrcContract()
+    with open(path, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+
+    mode = None          # ("enum", name) | ("struct", name) | ("fn", name)
+    pending_cases: List[str] = []
+    default_seen = False
+    for i, raw in enumerate(lines, 1):
+        line = raw.split("//")[0]
+        if mode is None:
+            m = _ENUM_START_RE.search(line)
+            if m and m.group(1) in ("Cmd", "Err"):
+                mode = ("enum", m.group(1))
+                continue
+            m = _STRUCT_START_RE.search(line)
+            if m and m.group(1) in ("ReqHeader", "ObsSpan"):
+                mode = ("struct", m.group(1))
+                out.structs[m.group(1)] = []
+                continue
+            m = _FN_START_RE.search(line)
+            if m:
+                mode = ("fn", m.group(1))
+                out.classify[m.group(1)] = {}
+                pending_cases, default_seen = [], False
+            continue
+        kind, name = mode
+        if kind == "enum":
+            m = _ENUM_ENTRY_RE.match(line)
+            if m:
+                tgt = out.cmds if name == "Cmd" else out.errs
+                tgt[m.group(1)] = (int(m.group(2)), i)
+            if "}" in line:
+                mode = None
+        elif kind == "struct":
+            m = _FIELD_RE.match(line)
+            if m and m.group(1) in _CTYPE_FMT:
+                for fname in m.group(2).split(","):
+                    out.structs[name].append((m.group(1), fname.strip(), i))
+            if "}" in line:
+                mode = None
+        elif kind == "fn":
+            m = _CASE_RE.match(line)
+            if m:
+                pending_cases.append(m.group(1))
+            if re.match(r"^\s*default\s*:", line):
+                default_seen = True
+            m = _RETURN_RE.match(line)
+            if m:
+                expr = m.group(1).strip()
+                verdict = {"true": "yes", "false": "no"}.get(expr, "cond")
+                if default_seen:
+                    # `default: return X;` ends the switch for us
+                    mode = None
+                    continue
+                if not pending_cases and "==" in expr:
+                    # the `return cmd == kA || cmd == kB;` one-liner form
+                    for c in re.findall(r"k\w+", expr):
+                        out.classify[name][c] = "yes"
+                    mode = None
+                    continue
+                for c in pending_cases:
+                    out.classify[name][c] = verdict
+                pending_cases = []
+            if re.match(r"^\}", raw):
+                mode = None
+    return out
+
+
+def struct_format(fields: List[Tuple[str, str, int]]) -> str:
+    return "<" + "".join(_CTYPE_FMT[t] for t, _, _ in fields)
+
+
+# ---------------------------------------------------------------------------
+# Python extractor
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PyContract:
+    consts: Dict[str, Dict[str, Tuple[int, int]]] = \
+        field(default_factory=dict)   # module key -> {NAME: (value, line)}
+    raises: Dict[int, Tuple[str, int]] = \
+        field(default_factory=dict)   # status -> (exception name, line)
+    hdr_format: Optional[str] = None
+    hdr_line: int = 0
+    span_format: Optional[str] = None
+    span_line: int = 0
+    req_header_bytes: Optional[int] = None
+    req_header_line: int = 0
+    wire_context_bytes: Optional[int] = None
+
+
+def _int_consts(tree: ast.Module) -> Dict[str, Tuple[int, int]]:
+    out: Dict[str, Tuple[int, int]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            v = node.value
+            neg = isinstance(v, ast.UnaryOp) and isinstance(v.op, ast.USub)
+            if neg:
+                v = v.operand
+            if isinstance(v, ast.Constant) and isinstance(v.value, int) \
+                    and not isinstance(v.value, bool):
+                out[node.targets[0].id] = (-v.value if neg else v.value,
+                                           node.lineno)
+    return out
+
+
+def _struct_literal(tree: ast.Module, name: str) -> Tuple[Optional[str], int]:
+    """`NAME = struct.Struct("<fmt>")` → (fmt, line)."""
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == name and \
+                isinstance(node.value, ast.Call) and \
+                dotted(node.value.func) in ("struct.Struct", "Struct") and \
+                node.value.args and \
+                isinstance(node.value.args[0], ast.Constant):
+            return str(node.value.args[0].value), node.lineno
+    return None, 0
+
+
+def extract_python(root: str) -> PyContract:
+    out = PyContract()
+    trees: Dict[str, ast.Module] = {}
+    for key, rel in _PY_FILES.items():
+        p = os.path.join(root, rel)
+        if not os.path.exists(p):
+            continue
+        with open(p, encoding="utf-8") as f:
+            trees[key] = ast.parse(f.read())
+        out.consts[key] = _int_consts(trees[key])
+
+    if "trace" in out.consts:
+        got = out.consts["trace"].get("WIRE_CONTEXT_BYTES")
+        out.wire_context_bytes = got[0] if got else None
+    if "trace" in trees:
+        out.span_format, out.span_line = _struct_literal(
+            trees["trace"], "SERVER_SPAN_STRUCT")
+    if "ha" in trees:
+        out.hdr_format, out.hdr_line = _struct_literal(trees["ha"], "_HDR")
+
+    rpc_tree = trees.get("rpc")
+    if rpc_tree is not None:
+        # _REQ_HEADER_BYTES = 28 + _trace.WIRE_CONTEXT_BYTES
+        for node in rpc_tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    node.targets[0].id == "_REQ_HEADER_BYTES":
+                out.req_header_line = node.lineno
+                v = node.value
+                if isinstance(v, ast.Constant):
+                    out.req_header_bytes = int(v.value)
+                elif isinstance(v, ast.BinOp) and \
+                        isinstance(v.op, ast.Add) and \
+                        isinstance(v.left, ast.Constant) and \
+                        (dotted(v.right) or "").endswith(
+                            "WIRE_CONTEXT_BYTES") and \
+                        out.wire_context_bytes is not None:
+                    out.req_header_bytes = (int(v.left.value)
+                                            + out.wire_context_bytes)
+        # `if status == -N: raise Exc(...)` inside any `check` function
+        for node in ast.walk(rpc_tree):
+            if not (isinstance(node, ast.FunctionDef) and
+                    node.name == "check"):
+                continue
+            for st in ast.walk(node):
+                if not (isinstance(st, ast.If) and
+                        isinstance(st.test, ast.Compare) and
+                        len(st.test.ops) == 1 and
+                        isinstance(st.test.ops[0], ast.Eq)):
+                    continue
+                rhs = st.test.comparators[0]
+                neg = isinstance(rhs, ast.UnaryOp) and \
+                    isinstance(rhs.op, ast.USub)
+                lit = rhs.operand if neg else rhs
+                if not (isinstance(lit, ast.Constant) and
+                        isinstance(lit.value, int)):
+                    continue
+                status = -lit.value if neg else lit.value
+                for b in st.body:
+                    if isinstance(b, ast.Raise) and b.exc is not None:
+                        exc = b.exc.func if isinstance(b.exc, ast.Call) \
+                            else b.exc
+                        nm = dotted(exc)
+                        if nm:
+                            out.raises[status] = (nm.rsplit(".", 1)[-1],
+                                                  st.lineno)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# cross-validation
+# ---------------------------------------------------------------------------
+
+def check(root: str) -> List[Diagnostic]:
+    csrc_path = os.path.join(root, _CSRC)
+    if not os.path.exists(csrc_path):
+        return []   # scratch trees / partial checkouts: fail open
+    rel_csrc = _CSRC
+    cs = extract_csrc(csrc_path)
+    py = extract_python(root)
+    diags: List[Diagnostic] = []
+
+    def d(path: str, line: int, rule: str, msg: str) -> None:
+        diags.append(Diagnostic(path, line, rule, msg))
+
+    # -- cmd enum vs contract ------------------------------------------------
+    for name, spec in CONTRACT.items():
+        got = cs.cmds.get(name)
+        if got is None:
+            d(rel_csrc, 1, "wire-cmd-drift",
+              f"contract cmd `{name}` (= {spec.id}) is missing from the "
+              "csrc Cmd enum")
+        elif got[0] != spec.id:
+            d(rel_csrc, got[1], "wire-cmd-drift",
+              f"`{name}` = {got[0]} in csrc but {spec.id} in the contract "
+              "(tools/lint/wire_contract.py CONTRACT)")
+    for name, (val, line) in cs.cmds.items():
+        if name not in CONTRACT:
+            d(rel_csrc, line, "wire-cmd-drift",
+              f"csrc cmd `{name}` = {val} is not classified in the "
+              "contract — add a CmdSpec (tap/gate/keyed/local_only) to "
+              "tools/lint/wire_contract.py")
+
+    # -- python cmd mirrors --------------------------------------------------
+    for name, spec in CONTRACT.items():
+        if spec.py is None:
+            continue
+        mod, const = spec.py
+        rel_py = _PY_FILES[mod]
+        got = py.consts.get(mod, {}).get(const)
+        if got is None:
+            d(rel_py, 1, "wire-cmd-mirror",
+              f"`{const}` (mirror of csrc {name} = {spec.id}) is missing")
+        elif got[0] != spec.id:
+            d(rel_py, got[1], "wire-cmd-mirror",
+              f"`{const}` = {got[0]} but csrc {name} = {spec.id}")
+
+    # -- err enum + mirrors --------------------------------------------------
+    for name, (val, mirror) in ERR_CONTRACT.items():
+        got = cs.errs.get(name)
+        if got is None:
+            d(rel_csrc, 1, "wire-err-drift",
+              f"contract error `{name}` (= {val}) missing from the csrc "
+              "Err enum")
+        elif got[0] != val:
+            d(rel_csrc, got[1], "wire-err-drift",
+              f"`{name}` = {got[0]} in csrc but {val} in the contract")
+        if mirror is None:
+            continue
+        kind, nm = mirror
+        if kind == "ha":
+            got_py = py.consts.get("ha", {}).get(nm)
+            if got_py is None:
+                d(_PY_FILES["ha"], 1, "wire-err-mirror",
+                  f"`{nm}` (mirror of csrc {name} = {val}) is missing")
+            elif got_py[0] != val:
+                d(_PY_FILES["ha"], got_py[1], "wire-err-mirror",
+                  f"`{nm}` = {got_py[0]} but csrc {name} = {val}")
+        elif kind == "raise":
+            got_r = py.raises.get(val)
+            if got_r is None:
+                d(_PY_FILES["rpc"], 1, "wire-err-mirror",
+                  f"_ServerConn.check does not map status {val} "
+                  f"(csrc {name}) to `{nm}`")
+            elif got_r[0] != nm:
+                d(_PY_FILES["rpc"], got_r[1], "wire-err-mirror",
+                  f"_ServerConn.check raises `{got_r[0]}` for status "
+                  f"{val} but the contract says `{nm}` (csrc {name})")
+    for val, (exc, line) in py.raises.items():
+        if not any(v == val for v, _ in ERR_CONTRACT.values()):
+            d(_PY_FILES["rpc"], line, "wire-err-mirror",
+              f"_ServerConn.check maps status {val} (`{exc}`) but no csrc "
+              "error code has that value")
+
+    # -- header layouts ------------------------------------------------------
+    req = cs.structs.get("ReqHeader")
+    if not req:
+        d(rel_csrc, 1, "wire-header-drift",
+          "could not extract `struct ReqHeader` field layout")
+    else:
+        fmt = struct_format(req)
+        size = struct.calcsize(fmt)
+        if py.hdr_format is not None:
+            py_fmt = py.hdr_format.replace(" ", "")
+            if py_fmt != fmt:
+                d(_PY_FILES["ha"], py.hdr_line, "wire-header-drift",
+                  f"ha._HDR format {py.hdr_format!r} != csrc ReqHeader "
+                  f"layout {fmt!r} "
+                  f"({', '.join(f'{t} {n}' for t, n, _ in req)})")
+            elif struct.calcsize(py_fmt) != size:
+                d(_PY_FILES["ha"], py.hdr_line, "wire-header-drift",
+                  f"ha._HDR size {struct.calcsize(py_fmt)} != csrc "
+                  f"ReqHeader packed size {size}")
+        if py.req_header_bytes is not None and py.req_header_bytes != size:
+            d(_PY_FILES["rpc"], py.req_header_line, "wire-header-drift",
+              f"rpc._REQ_HEADER_BYTES = {py.req_header_bytes} != csrc "
+              f"ReqHeader packed size {size}")
+        if py.wire_context_bytes is not None:
+            trace_fields = [n for _, n, _ in req
+                            if n in ("trace_id", "span_id")]
+            tb = sum(struct.calcsize(_CTYPE_FMT[t])
+                     for t, n, _ in req if n in ("trace_id", "span_id"))
+            if len(trace_fields) != 2 or tb != py.wire_context_bytes:
+                d(rel_csrc, req[0][2], "wire-header-drift",
+                  f"ReqHeader trace-context fields ({tb} bytes across "
+                  f"{len(trace_fields)} fields) != "
+                  f"trace.WIRE_CONTEXT_BYTES = {py.wire_context_bytes}")
+    span = cs.structs.get("ObsSpan")
+    if span and py.span_format is not None:
+        fmt = struct_format(span)
+        if py.span_format.replace(" ", "") != fmt:
+            d(_PY_FILES["trace"], py.span_line, "wire-header-drift",
+              f"trace.SERVER_SPAN_STRUCT {py.span_format!r} != csrc "
+              f"ObsSpan layout {fmt!r}")
+
+    # -- classification ------------------------------------------------------
+    fn_field = {"is_mutating_cmd": "tap", "is_training_plane_cmd": "gate",
+                "is_keyed_data_cmd": "keyed", "is_create_cmd": "create"}
+    for fn, fld in fn_field.items():
+        table = cs.classify.get(fn)
+        if table is None:
+            d(rel_csrc, 1, "wire-class-drift",
+              f"could not extract the `{fn}` switch")
+            continue
+        for name, spec in CONTRACT.items():
+            want = getattr(spec, fld)
+            if isinstance(want, bool):
+                want = "yes" if want else "no"
+            got = table.get(name, "no")
+            if got != want:
+                line = cs.cmds.get(name, (0, 1))[1]
+                d(rel_csrc, line, "wire-class-drift",
+                  f"`{name}`: csrc {fn} says {got!r} but the contract "
+                  f"says {want!r} — if the behavior changed, update BOTH "
+                  "the contract and every consumer of this class "
+                  "(replication tap / read-only gate / ownership fence)")
+        for name in table:
+            if name not in CONTRACT:
+                d(rel_csrc, 1, "wire-class-drift",
+                  f"`{fn}` classifies unknown cmd `{name}`")
+
+    # -- every gated mutation must be tapped or declared local-only ----------
+    for name, spec in CONTRACT.items():
+        if spec.gate != "no" and spec.tap == "no" and not spec.local_only:
+            line = cs.cmds.get(name, (0, 1))[1]
+            d(rel_csrc, line, "wire-untapped-mutation",
+              f"`{name}` is gate-checked as a mutation but neither "
+              "tapped for replication (is_mutating_cmd) nor declared "
+              "local_only in the contract — a backup would silently "
+              "miss it")
+    return diags
+
+
+def run(root: str, only=None) -> List[Diagnostic]:
+    if only is not None and not any(f in only for f in RELEVANT_FILES):
+        return []
+    return sorted(check(root), key=lambda d: (d.path, d.line, d.rule))
+
+
+if __name__ == "__main__":
+    from common import REPO_ROOT
+    for diag in run(REPO_ROOT):
+        print(diag)
