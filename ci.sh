@@ -343,11 +343,20 @@ echo "== comm-fusion fast checks (fused dense-DP collectives + hlo_bytes) =="
 # full matrix — these cover the wire-byte acceptance gates directly
 python -m pytest tests/test_comm_fusion.py tests/test_hlo_bytes.py -q
 
+echo "== sparse-wire + placement fast checks (quantized push wire / swap) =="
+# the ISSUE 14 loop: quantized push wire (EF parity, drain-at-quiesce,
+# replicated-frame bit-identity, csrc dequant rejection) and the
+# density-measured placement swap at a live reshard epoch fence —
+# cheapest place to catch an encode/decode or swap-accounting regression
+python -m pytest tests/test_sparse_wire.py tests/test_placement.py -q
+
 echo "== fast gate (default: -m 'not slow') =="
-# hot-tier/comm-fusion/hlo_bytes already ran above — don't pay them twice
+# hot-tier/comm-fusion/hlo_bytes/sparse-wire already ran above — don't
+# pay them twice
 python -m pytest tests/ -q -x \
   --ignore=tests/test_comm_fusion.py --ignore=tests/test_hlo_bytes.py \
-  --ignore=tests/test_hot_tier.py --ignore=tests/test_hot_kernels.py
+  --ignore=tests/test_hot_tier.py --ignore=tests/test_hot_kernels.py \
+  --ignore=tests/test_sparse_wire.py --ignore=tests/test_placement.py
 
 if [[ "${1:-fast}" == "full" ]]; then
   echo "== full matrix (slow tests included) =="
@@ -387,6 +396,22 @@ import json, sys
 line = [l for l in sys.stdin.read().splitlines() if l.startswith('{')][-1]
 d = json.loads(line); assert d['value'] > 0 and 'error' not in d, d
 print('bench (cpu) OK')"
+  # sparse push-wire ladder: the int8 wire must actually shrink the
+  # SPARSE RPC push stream — ≥3× fewer bytes than fp32, asserted from
+  # the PR 8 per-table byte counters (steady-state wire; the terminal
+  # error-feedback drain is reported apart as a checkpoint-boundary
+  # cost). Byte counts are exact — deterministic on a noisy box.
+  PYTHONPATH="$PWD:${PYTHONPATH:-}" JAX_PLATFORMS=cpu SWB_STEPS=8 \
+    python tools/sparse_wire_bench.py | python -c "
+import json, sys
+d = json.loads([l for l in sys.stdin.read().splitlines() if l.startswith('{')][-1])
+assert 'error' not in d, d
+assert d['value'] >= 3.0, d
+by = {r['wire']: r for r in d['ladder']}
+assert by['int8']['residual_rows_drained'] > 0, by  # EF really drained
+assert by['fp16']['push_wire_bytes'] < by['fp32']['push_wire_bytes'], by
+print('sparse wire ladder OK (int8 moves %.2fx fewer push bytes; '
+      'fp16 %.2fx)' % (d['value'], d['ratio_fp32_over_fp16']))"
   # dense-DP comm ladder: int8 must actually shrink the wire (hlo_bytes-
   # measured ≥3.5× fewer collective bytes than fused fp32)
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
@@ -466,7 +491,8 @@ print('bench degradation ladder OK')"
       tests/test_rpc_parallel.py tests/test_ps_ha.py \
       tests/test_job_checkpoint.py tests/test_serving.py \
       tests/test_obs.py tests/test_slo.py tests/test_flightrec.py \
-      tests/test_reshard.py tests/test_autoscale.py -q -m ""
+      tests/test_reshard.py tests/test_autoscale.py \
+      tests/test_sparse_wire.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_tsan_report* 2>/dev/null; then
     echo "TSAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_tsan_report*)"
     exit 1
@@ -487,7 +513,8 @@ print('bench degradation ladder OK')"
       tests/test_rpc_parallel.py tests/test_ps_ha.py \
       tests/test_job_checkpoint.py tests/test_serving.py \
       tests/test_obs.py tests/test_slo.py tests/test_flightrec.py \
-      tests/test_reshard.py tests/test_autoscale.py -q -m ""
+      tests/test_reshard.py tests/test_autoscale.py \
+      tests/test_sparse_wire.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_asan_report* 2>/dev/null; then
     echo "ASAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_asan_report*)"
     exit 1
@@ -507,7 +534,8 @@ print('bench degradation ladder OK')"
       tests/test_rpc_parallel.py tests/test_ps_ha.py \
       tests/test_job_checkpoint.py tests/test_serving.py \
       tests/test_obs.py tests/test_slo.py tests/test_flightrec.py \
-      tests/test_reshard.py tests/test_autoscale.py -q -m ""
+      tests/test_reshard.py tests/test_autoscale.py \
+      tests/test_sparse_wire.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_ubsan_report* 2>/dev/null; then
     echo "UBSAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_ubsan_report*)"
     exit 1

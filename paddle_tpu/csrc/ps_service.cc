@@ -79,6 +79,8 @@
 // asks for storage=ssd
 extern "C" {
 void* sst_create(const int32_t* iparams, const float* fparams, const char* dir);
+void* sst_create2(const int32_t* iparams, const float* fparams,
+                  const char* dir, int32_t flags);
 void sst_destroy(void* h);
 int32_t sst_pull_dim(void* h);
 int32_t sst_push_dim(void* h);
@@ -322,37 +324,26 @@ inline bool is_training_plane_cmd(uint32_t cmd, int32_t aux, int64_t n) {
 
 constexpr uint64_t kMaxPayload = 1ULL << 32;  // 4 GiB frame cap
 
-// fp32 -> IEEE fp16 with round-to-nearest-even (no F16C dependency —
-// this must build on any host the toolchain targets). Used by the
-// optional half-precision pull wire format (kPullSparse aux & 2):
-// halves the dominant PS->trainer byte stream when the table config
-// opts in; values re-widen client-side.
-inline uint16_t f32_to_f16(float f) {
-  uint32_t x;
-  std::memcpy(&x, &f, 4);
-  uint32_t sign = (x >> 16) & 0x8000u;
-  int32_t exp = static_cast<int32_t>((x >> 23) & 0xff) - 127 + 15;
-  uint32_t mant = x & 0x7fffffu;
-  if (exp >= 0x1f) {  // overflow/inf/nan
-    if (((x >> 23) & 0xff) == 0xff && mant)
-      return static_cast<uint16_t>(sign | 0x7e00u);  // nan (quiet)
-    return static_cast<uint16_t>(sign | 0x7c00u);    // inf / overflow
-  }
-  if (exp <= 0) {  // subnormal or zero
-    if (exp < -10) return static_cast<uint16_t>(sign);
-    mant |= 0x800000u;  // implicit leading 1
-    uint32_t shift = static_cast<uint32_t>(14 - exp);
-    uint32_t half = mant >> shift;
-    uint32_t rem = mant & ((1u << shift) - 1);
-    uint32_t halfway = 1u << (shift - 1);
-    if (rem > halfway || (rem == halfway && (half & 1))) half++;
-    return static_cast<uint16_t>(sign | half);
-  }
-  uint32_t half = (static_cast<uint32_t>(exp) << 10) | (mant >> 13);
-  uint32_t rem = mant & 0x1fffu;
-  if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) half++;  // RNE
-  return static_cast<uint16_t>(sign | half);
-}
+// fp16 wire conversions live in sparse_table.h (pstpu::f32_to_f16 /
+// f16_to_f32 — shared with the SSD fp16 record format). Used by the
+// half-precision pull wire (kPullSparse aux & 2) and the quantized
+// push wire (PushWireFlag below).
+using pstpu::f16_to_f32;
+using pstpu::f32_to_f16;
+
+// push-value wire encodings (kPushSparse aux bit flags; the client
+// resolves them from TableConfig.push_wire_dtype). The server — and a
+// backup replaying the tapped frame, which carries the SAME aux —
+// dequantizes before apply, so server state stays fp32 and primary ≡
+// backup bit-identically. Mirrored in ps/rpc.py (_PUSH_WIRE_*) and
+// pinned by graftlint pass 8 (tools/lint/wire_contract.py
+// FLAG_CONTRACT) — drift fails tier-1.
+enum PushWireFlag : int32_t {
+  kPushWireF16 = 1,         // gradient columns ride IEEE fp16
+  kPushWireI8 = 2,          // int8 gradients + per-block fp32 scales
+  kPushWireBlockShift = 8,  // (aux >> shift) & 0xffff = int8 block size
+};
+
 
 // RAM-engine shard-file save/load (kSaveFile/kLoadFile for mem tables;
 // the SSD engine has streaming equivalents in ssd_table.cc). The mem
@@ -492,6 +483,70 @@ struct ReqHeader {
   uint64_t trace_id;
   uint64_t span_id;
 } __attribute__((packed));
+
+// Decode a kPushSparse payload into fp32 push rows [n, pd]. The fp32
+// wire returns a pointer straight into the frame (zero-copy); the
+// quantized wires widen into `scratch`. Keys always LEAD the payload
+// regardless of encoding, so the key-ownership fence and the oplog tap
+// see one shape. The 3-column head (slot/show/click) stays exact fp32
+// in every encoding: counts feed the lifecycle stats and slot feeds
+// row creation — only the gradient block is quantized. Layouts:
+//   fp32: [keys u64 x n][rows f32 n x pd]
+//   f16:  [keys][head f32 n x 3][grad f16 n x gd]            gd = pd-3
+//   i8:   [keys][head f32 n x 3][scales f32 n x nblk][grad i8 n x gd]
+//         nblk = ceil(gd / block); blocks tile a ROW (never straddle
+//         rows), the last block of a row may be ragged
+int64_t decode_push_rows(const ReqHeader& h, const char* p, int32_t pd,
+                         std::vector<float>* scratch, const float** rows) {
+  int64_t n = h.n;
+  int32_t flags = h.aux & 0xff;
+  if (!(flags & (kPushWireF16 | kPushWireI8))) {
+    if (h.payload_len != static_cast<uint64_t>(n) * (8 + 4 * pd))
+      return kErrBadSize;
+    *rows = reinterpret_cast<const float*>(p + n * 8);
+    return 0;
+  }
+  int32_t gd = pd - 3;
+  if (gd <= 0) return kErrBadSize;  // no gradient block to quantize
+  // validate the frame length BEFORE sizing scratch from the
+  // wire-supplied n: a malformed/hostile header (huge n, small
+  // payload) must reject with kErrBadSize, not throw out of resize
+  // and take the server down
+  const char* q = p + n * 8;
+  const float* head = reinterpret_cast<const float*>(q);
+  q += n * 12;
+  if (flags & kPushWireI8) {
+    int64_t block = (h.aux >> kPushWireBlockShift) & 0xffff;
+    if (block <= 0) return kErrBadSize;
+    int64_t nblk = (gd + block - 1) / block;
+    uint64_t want = static_cast<uint64_t>(n) * (8 + 12 + 4 * nblk + gd);
+    if (h.payload_len != want) return kErrBadSize;
+    scratch->resize(static_cast<size_t>(n) * pd);
+    const float* scales = reinterpret_cast<const float*>(q);
+    const int8_t* grad = reinterpret_cast<const int8_t*>(q + n * nblk * 4);
+    for (int64_t i = 0; i < n; ++i) {
+      float* o = scratch->data() + i * pd;
+      std::memcpy(o, head + i * 3, 12);
+      const float* sc = scales + i * nblk;
+      const int8_t* g = grad + i * gd;
+      for (int32_t j = 0; j < gd; ++j)
+        o[3 + j] = static_cast<float>(g[j]) * sc[j / block];
+    }
+  } else {
+    uint64_t want = static_cast<uint64_t>(n) * (8 + 12 + 2 * gd);
+    if (h.payload_len != want) return kErrBadSize;
+    scratch->resize(static_cast<size_t>(n) * pd);
+    const uint16_t* grad = reinterpret_cast<const uint16_t*>(q);
+    for (int64_t i = 0; i < n; ++i) {
+      float* o = scratch->data() + i * pd;
+      std::memcpy(o, head + i * 3, 12);
+      const uint16_t* g = grad + i * gd;
+      for (int32_t j = 0; j < gd; ++j) o[3 + j] = f16_to_f32(g[j]);
+    }
+  }
+  *rows = scratch->data();
+  return 0;
+}
 
 // obs timestamp helpers: wall anchor for cross-process merge, steady
 // for durations (same split obs/trace.py uses python-side)
@@ -1020,7 +1075,10 @@ struct PsServer {
 
   int64_t do_create_sparse(const ReqHeader& h, const char* p, int32_t dims[3]) {
     // payload: iparams[6 i32] + fparams[17 f32], optionally followed
-    // by [i32 storage][u32 path_len][path] (storage 1 = ssd)
+    // by [i32 storage][u32 path_len][path]. storage low byte: 1 = ssd;
+    // storage bit 8: fp16 value columns in the SSD records
+    // (TableConfig.ssd_value_dtype="fp16") — old clients send exactly
+    // 1, which decodes identically
     constexpr uint64_t kBase = 6 * 4 + 17 * 4;
     if (h.payload_len < kBase) return kErrBadSize;
     int32_t storage = 0;
@@ -1040,10 +1098,10 @@ struct PsServer {
     // whole cold-tier log, and that must not stall other tables'
     // traffic. Losing a create race destroys the duplicate.
     SparseRef fresh;
-    if (storage == 1) {
-      fresh.ssd = sst_create(reinterpret_cast<const int32_t*>(p),
-                             reinterpret_cast<const float*>(p + 24),
-                             path.c_str());
+    if ((storage & 0xff) == 1) {
+      fresh.ssd = sst_create2(reinterpret_cast<const int32_t*>(p),
+                              reinterpret_cast<const float*>(p + 24),
+                              path.c_str(), (storage >> 8) & 1);
       if (!fresh.ssd) return kErrInternal;
     } else {
       fresh.mem = new NativeTable(c);
@@ -1194,10 +1252,14 @@ struct PsServer {
         SparseRef t;
         if (!get_sparse(h.table_id, &t)) return kErrNoTable;
         int32_t pd = t.push_dim();
-        if (h.payload_len != static_cast<uint64_t>(h.n) * (8 + 4 * pd))
-          return kErrBadSize;
+        // quantized wire (PushWireFlag in h.aux): the tapped frame
+        // carries the SAME encoded bytes the primary decoded, so this
+        // dequant is bit-identical to the primary's apply
+        std::vector<float> wide;
+        const float* push;
+        int64_t st = decode_push_rows(h, p, pd, &wide, &push);
+        if (st < 0) return st;
         const uint64_t* keys = reinterpret_cast<const uint64_t*>(p);
-        const float* push = reinterpret_cast<const float*>(p + h.n * 8);
         if (t.ssd) {
           sst_push(t.ssd, keys, push, h.n);
         } else {
@@ -1452,10 +1514,13 @@ struct PsServer {
         SparseRef t;
         if (!get_sparse(h.table_id, &t)) return respond(fd, kErrNoTable, nullptr, 0);
         int32_t pd = t.push_dim();
-        uint64_t want = static_cast<uint64_t>(h.n) * (8 + 4 * pd);
-        if (h.payload_len != want) return respond(fd, kErrBadSize, nullptr, 0);
+        // dequant-before-apply (PushWireFlag in h.aux): server state
+        // stays fp32; a bad encoding rejects whole BEFORE any apply
+        std::vector<float> wide;
+        const float* push;
+        int64_t st = decode_push_rows(h, p, pd, &wide, &push);
+        if (st < 0) return respond(fd, st, nullptr, 0);
         const uint64_t* keys = reinterpret_cast<const uint64_t*>(p);
-        const float* push = reinterpret_cast<const float*>(p + h.n * 8);
         if (t.ssd) {
           sst_push(t.ssd, keys, push, h.n);
         } else {

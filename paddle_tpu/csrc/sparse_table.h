@@ -114,6 +114,70 @@ inline int32_t rule_state_dim(int32_t rule, int32_t dim) {
 }
 
 // ---------------------------------------------------------------------------
+// IEEE fp16 <-> fp32 (no F16C dependency — must build on any host the
+// toolchain targets). Shared by the half-precision pull/push wire
+// formats (ps_service.cc) and the SSD fp16 record format
+// (ssd_table.cc); numpy's float16 casts produce the identical bits
+// (both are IEEE round-to-nearest-even), which is what lets the Python
+// client and the C++ server agree byte-for-byte.
+// ---------------------------------------------------------------------------
+
+inline uint16_t f32_to_f16(float f) {
+  uint32_t x;
+  std::memcpy(&x, &f, 4);
+  uint32_t sign = (x >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((x >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = x & 0x7fffffu;
+  if (exp >= 0x1f) {  // overflow/inf/nan
+    if (((x >> 23) & 0xff) == 0xff && mant)
+      return static_cast<uint16_t>(sign | 0x7e00u);  // nan (quiet)
+    return static_cast<uint16_t>(sign | 0x7c00u);    // inf / overflow
+  }
+  if (exp <= 0) {  // subnormal or zero
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    mant |= 0x800000u;  // implicit leading 1
+    uint32_t shift = static_cast<uint32_t>(14 - exp);
+    uint32_t half = mant >> shift;
+    uint32_t rem = mant & ((1u << shift) - 1);
+    uint32_t halfway = 1u << (shift - 1);
+    if (rem > halfway || (rem == halfway && (half & 1))) half++;
+    return static_cast<uint16_t>(sign | half);
+  }
+  uint32_t half = (static_cast<uint32_t>(exp) << 10) | (mant >> 13);
+  uint32_t rem = mant & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) half++;  // RNE
+  return static_cast<uint16_t>(sign | half);
+}
+
+inline float f16_to_f32(uint16_t h) {
+  uint32_t sign = static_cast<uint32_t>(h & 0x8000u) << 16;
+  int32_t exp = (h >> 10) & 0x1f;
+  uint32_t mant = h & 0x3ffu;
+  uint32_t bits;
+  if (exp == 0x1f) {  // inf / nan (widening keeps the payload)
+    bits = sign | 0x7f800000u | (mant << 13);
+  } else if (exp == 0) {
+    if (!mant) {
+      bits = sign;  // signed zero
+    } else {        // subnormal: renormalize into fp32's range
+      exp = 1;
+      while (!(mant & 0x400u)) {
+        mant <<= 1;
+        --exp;
+      }
+      mant &= 0x3ffu;
+      bits = sign | (static_cast<uint32_t>(exp - 15 + 127) << 23) |
+             (mant << 13);
+    }
+  } else {
+    bits = sign | (static_cast<uint32_t>(exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+// ---------------------------------------------------------------------------
 // SGD rules (sparse_sgd_rule.cc math, batched-of-one form)
 // ---------------------------------------------------------------------------
 

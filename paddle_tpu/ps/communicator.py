@@ -227,6 +227,10 @@ class _BaseCommunicator:
         if not self._push_thread_dead:
             self._drain_all()
         self._shutdown_pull_pool()
+        if not self._push_thread_dead:
+            drain = getattr(self.client, "drain_push_residuals", None)
+            if drain is not None:
+                drain()
         self.check_error()
 
     def _shutdown_pull_pool(self) -> None:
@@ -265,6 +269,13 @@ class _BaseCommunicator:
             time.sleep(0.001)
         self._drained.wait(timeout=10)
         self._drain_pulls()
+        # quantized-push error-feedback residuals drain exactly like
+        # queued pushes: after quiesce() NO training signal lives
+        # client-side, so a checkpoint cut taken now is digest-complete
+        # (rpc.RpcPsClient.drain_push_residuals; fp32-wire)
+        drain = getattr(self.client, "drain_push_residuals", None)
+        if drain is not None:
+            drain()
         self.check_error()
 
     def barrier(self) -> None:
@@ -358,6 +369,9 @@ class SyncCommunicator(_BaseCommunicator):
         self._running = False
         self._drain_all()
         self._shutdown_pull_pool()
+        drain = getattr(self.client, "drain_push_residuals", None)
+        if drain is not None:
+            drain()
 
     def send_sparse(self, table_id, keys, values):
         self.client.push_sparse(table_id, keys, values)

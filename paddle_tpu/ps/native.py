@@ -556,6 +556,10 @@ def _configure_sst(lib: ctypes.CDLL) -> None:
     u8p = ctypes.POINTER(ctypes.c_uint8)
     lib.sst_create.restype = ctypes.c_void_p
     lib.sst_create.argtypes = [i32p, f32p, ctypes.c_char_p]
+    # flags bit 0 = fp16 value columns on disk (ssd_value_dtype="fp16");
+    # a stale .so without the symbol raises through the AttributeError
+    lib.sst_create2.restype = ctypes.c_void_p
+    lib.sst_create2.argtypes = [i32p, f32p, ctypes.c_char_p, ctypes.c_int32]
     lib.sst_destroy.argtypes = [ctypes.c_void_p]
     for fn in ("sst_pull_dim", "sst_push_dim", "sst_full_dim"):
         getattr(lib, fn).restype = ctypes.c_int32
@@ -601,7 +605,7 @@ class SsdTableEngine:
     fallback for the disk tier."""
 
     def __init__(self, shard_num: int, accessor: str, acc_cfg,
-                 seed: int, path: str) -> None:
+                 seed: int, path: str, value_f16: bool = False) -> None:
         self._lib = load_native()
         if self._lib is None:
             raise RuntimeError("native library unavailable")
@@ -613,8 +617,9 @@ class SsdTableEngine:
             self._lib._sst_configured = True
         iparams, fparams = table_native_params(shard_num, accessor, acc_cfg,
                                                seed)
-        self._h = self._lib.sst_create(_i32(iparams), _f32(fparams),
-                                       str(path).encode())
+        self._h = self._lib.sst_create2(_i32(iparams), _f32(fparams),
+                                        str(path).encode(),
+                                        1 if value_f16 else 0)
         if not self._h:
             raise RuntimeError(f"ssd table open failed at {path!r}")
         self._save_lock = threading.Lock()

@@ -506,6 +506,7 @@ class CtrStreamTrainer:
         embedx_dim: Optional[int] = None,
         pull_ahead: Optional[int] = None,
         hot_tier=None,       # HotEmbeddingTier | HotTierConfig | None
+        placement=None,      # distributed.placement.PlacementManager
     ) -> None:
         from .. import nn
         from .communicator import SyncCommunicator
@@ -531,6 +532,17 @@ class CtrStreamTrainer:
             self.pull_ahead = max(0, int(flag("communicator_pull_ahead")))
         else:
             self.pull_ahead = max(0, int(pull_ahead))
+        #: measured auto-placement (distributed/placement.py): per-batch
+        #: poll() may swap this table PS↔collective at an epoch fence —
+        #: prefetched pulls would straddle the swap plane, so placement
+        #: forces exact per-batch ordering (pull_ahead 0), and the hot
+        #: tier owns its own residency story (mutually exclusive)
+        self.placement = placement
+        if placement is not None:
+            enforce(hot_tier is None,
+                    "placement and hot_tier are mutually exclusive — "
+                    "the tier already owns this table's residency")
+            self.pull_ahead = 0
         if embedx_dim is not None:
             self._dim = int(embedx_dim)
         else:
@@ -642,12 +654,23 @@ class CtrStreamTrainer:
                               None)
             if refresh is not None:
                 refresh()
+        if self.placement is not None:
+            # the reshard's pre-cutover hook already fenced the manager;
+            # this batch boundary is the first safe point after it —
+            # apply any armed swap now instead of waiting a batch
+            self.placement.poll(self)
 
     def restore_train_state(self, dense: Dict[str, Any]) -> None:
         """Inverse of :meth:`train_state` — accepts the dict
         ``load_train_state``/``RestoredJob.dense`` returns."""
         self.params = dense["state"]
         self.opt_state = dense["opt"]
+        if self.placement is not None:
+            # the PS was (or is about to be) rebuilt from the
+            # checkpoint — a collective-plane residence is stale
+            # relative to it; fall back to the PS plane and let the
+            # policy re-densify from fresh density samples
+            self.placement.reset_to_ps()
         if self.hot_tier is not None:
             # the cold table was (or is about to be) rebuilt from the
             # checkpoint — the resident set is stale relative to it;
@@ -752,7 +775,17 @@ class CtrStreamTrainer:
             t_step = time.perf_counter()
             with RecordEvent("ctr_stream_step"):
                 keys, flat, dense, labels, fut = item
-                if fut is not None:
+                # measured-placement hook: a swap armed by the policy
+                # (and fenced by a reshard epoch) executes HERE, at the
+                # batch boundary — never mid-push
+                lt = None
+                if self.placement is not None:
+                    self.placement.poll(self)
+                    lt = self.placement.local_table
+                if lt is not None:  # collective-plane local residence
+                    pulled = lt.pull_sparse(
+                        flat, slots=slot_ids[:len(flat)], create=True)
+                elif fut is not None:
                     pulled = fut.result()
                 elif self.communicator is not None:  # same client as pushes
                     pulled = self.communicator.client.pull_sparse(
@@ -772,7 +805,13 @@ class CtrStreamTrainer:
                 push[:, 1] = 1.0                        # show
                 push[:, 2] = np.repeat(labels, S)       # click
                 push[:, 3:] = g
-                if self.communicator is not None:
+                if lt is not None:
+                    lt.push_sparse(flat, push)
+                    # local pushes never cross the wire counters — feed
+                    # the placement window directly so sparsify-back
+                    # still has a live signal
+                    self.placement.observe_push(push)
+                elif self.communicator is not None:
                     self.communicator.send_sparse(self.table_id, flat, push)
                 else:
                     self.table.push_sparse(flat, push)
@@ -942,6 +981,11 @@ class CtrStreamTrainer:
             # local quiesce, NOT barrier(): sync mode's barrier is a
             # cross-trainer rendezvous the others aren't at
             self.communicator.quiesce()
+        if self.placement is not None:
+            # collective-plane residents write back (without leaving
+            # the plane) so the captured PS table is complete — same
+            # contract as the hot tier's flush-dirty-then-snapshot
+            self.placement.flush()
         if self.hot_tier is not None:
             # flush-dirty-then-snapshot: every resident row's training
             # lands in the cold table BEFORE the manager gates mutations

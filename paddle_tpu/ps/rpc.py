@@ -24,8 +24,10 @@ import os
 # wire mutex (serializes connect/call/close on ONE socket — the IO is
 # the protected resource); `_conns_mu` only swaps connection lists
 # (connects build OUTSIDE it); `_pool_mu`/`_count_mu`/`_pause_mu`
-# guard scalars.
-# LOCK LEAF: _mu _pause_mu _conns_mu _pool_mu _count_mu
+# guard scalars. `_ef_mu` guards the error-feedback residual store
+# (gather/quantize/scatter is atomic per push; network sends happen
+# outside it).
+# LOCK LEAF: _mu _pause_mu _conns_mu _pool_mu _count_mu _ef_mu
 import threading
 import time
 from collections import Counter
@@ -92,6 +94,12 @@ define_flag("ps_serve_breaker_failures", 2,
 define_flag("ps_serve_breaker_cooldown_ms", 500,
             "open-breaker cooldown for serve-qos clients before one "
             "half-open probe")
+define_flag("ps_push_ef_max_rows", 1 << 20,
+            "per-table cap on client-side error-feedback residual rows "
+            "(push_wire_dtype='int8'): past it the whole table's "
+            "residuals drain over the fp32 wire and the store restarts "
+            "empty — bounds client RAM at ~4*gd bytes/row without ever "
+            "dropping training signal")
 
 __all__ = ["NativePsServer", "RpcPsClient", "RemoteSparseTable",
            "rpc_available", "make_conn", "send_replicate",
@@ -137,6 +145,13 @@ _OBS_SNAP = 43
 # live elastic resharding (ps/reshard.py; docs/OPERATIONS.md §15):
 # n = modulus (0 = read ownership), aux = residue (-1 = fence out)
 _RETAIN = 44
+
+# push-value wire encodings (csrc PushWireFlag — kPushSparse aux bits;
+# TableConfig.push_wire_dtype resolves them at create time). Pinned
+# against the csrc enum by graftlint pass 8 (wire_contract FLAG_CONTRACT)
+_PUSH_WIRE_F16 = 1
+_PUSH_WIRE_I8 = 2
+_PUSH_WIRE_BLOCK_SHIFT = 8
 
 _DENSE_OPT_IDS = {"sgd": 0, "adam": 1, "sum": 2}
 
@@ -625,6 +640,40 @@ def _sparse_config_payload(cfg: TableConfig) -> bytes:
     return ip.tobytes() + fp.tobytes()
 
 
+def _quant_push_int8(grad: np.ndarray, block: int
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Block-wise symmetric int8 over the gradient block (the PR 3
+    comm_fusion scheme, numpy form): per-block fp32 absmax scales,
+    blocks tile a ROW (nblk = ceil(gd/block); the last block may be
+    ragged — the zero pad never raises a block's absmax). Returns
+    (q int8 [n, gd], scales f32 [n, nblk])."""
+    n, gd = grad.shape
+    nblk = -(-gd // block)
+    pad = nblk * block - gd
+    g = np.pad(grad, ((0, 0), (0, pad))) if pad else grad
+    gb = g.reshape(n, nblk, block)
+    amax = np.max(np.abs(gb), axis=2)
+    scales = (amax / np.float32(127.0)).astype(np.float32)
+    inv = np.where(scales > 0, np.float32(1.0) / scales,
+                   np.float32(0.0)).astype(np.float32)
+    q = np.clip(np.rint(gb * inv[:, :, None]), -127, 127).astype(np.int8)
+    return np.ascontiguousarray(q.reshape(n, nblk * block)[:, :gd]), scales
+
+
+def _dequant_push_int8(q: np.ndarray, scales: np.ndarray, block: int
+                       ) -> np.ndarray:
+    """Inverse of :func:`_quant_push_int8` — float32(q) * scale, the
+    IDENTICAL f32 multiply csrc decode_push_rows applies, so the
+    client's error-feedback residual is computed against exactly the
+    values the server (and every replaying backup) adds to the rows."""
+    n, gd = q.shape
+    nblk = scales.shape[1]
+    pad = nblk * block - gd
+    qq = np.pad(q, ((0, 0), (0, pad))) if pad else q
+    out = qq.reshape(n, nblk, block).astype(np.float32) * scales[:, :, None]
+    return out.reshape(n, nblk * block)[:, :gd]
+
+
 class RpcPsClient(PSClient):
     """PSClient over N TCP servers. Sparse keys route by
     ``key % num_servers``; dense tables split into contiguous
@@ -668,6 +717,14 @@ class RpcPsClient(PSClient):
         self._dense_dims: Dict[int, int] = {}
         self._geo_dims: Dict[int, int] = {}
         self._wire_f16: Dict[int, bool] = {}  # table → fp16 pull values
+        # table → (push wire dtype, int8 block, error feedback on)
+        self._push_wire: Dict[int, Tuple[str, int, bool]] = {}
+        # error-feedback residual store: table → {key → f32 grad-block
+        # residual}. Folded into the next push of that key, drained
+        # over the fp32 wire at drain_push_residuals() (quiesce/
+        # checkpoint cuts — no training signal lives here across a cut)
+        self._push_ef: Dict[int, Dict[int, np.ndarray]] = {}
+        self._ef_mu = threading.Lock()  # LOCK: _ef_mu (leaf — see header)
         self._pool: Optional[ThreadPoolExecutor] = None
         self._pool_mu = threading.Lock()
         #: HA router (ps/ha.py HARouter): resolves the epoch-stamped
@@ -735,8 +792,25 @@ class RpcPsClient(PSClient):
         if not _obs_registry.metrics_enabled():
             self._tbl_obs.pop(table_id, None)
             return None
+        # lazy: distributed/__init__ pulls jax-heavy modules and
+        # distributed.fleet imports back into ps.* (cycle) — this is
+        # the cold create path, so the import cost lands exactly once
+        from ..distributed.placement import DensitySeries
         t = str(table_id)
         reg = _obs_registry.REGISTRY
+
+        def window(direction: str) -> DensitySeries:
+            # the windowed density series (EWMA via the Gauge's alpha-
+            # 0.2 view + min/max over the last W samples) the placement
+            # pass reads instead of one batch's last-write sample
+            return DensitySeries(
+                gauge=reg.gauge("ps_client_density", table=t,
+                                dir=direction),
+                gmin=reg.gauge("ps_client_density_min", table=t,
+                               dir=direction),
+                gmax=reg.gauge("ps_client_density_max", table=t,
+                               dir=direction))
+
         m = {
             "pull_bytes": reg.counter("ps_client_wire_bytes",
                                       table=t, dir="pull"),
@@ -746,13 +820,19 @@ class RpcPsClient(PSClient):
                                      table=t, dir="pull"),
             "push_rows": reg.counter("ps_client_wire_rows",
                                      table=t, dir="push"),
-            "pull_density": reg.gauge("ps_client_density",
-                                      table=t, dir="pull"),
-            "push_density": reg.gauge("ps_client_density",
-                                      table=t, dir="push"),
+            "pull_window": window("pull"),
+            "push_window": window("push"),
         }
         self._tbl_obs[table_id] = m
         return m
+
+    def density_series(self, table_id: int, direction: str = "push"):
+        """The windowed density series for one (table, direction) —
+        the measured-sparsity feed distributed/placement.py consumes.
+        None when metrics are compiled out (FLAGS_obs_metrics=0) or the
+        table was not created via this client."""
+        m = self._tbl_obs.get(table_id)
+        return None if m is None else m.get(f"{direction}_window")
 
     @property
     def num_servers(self) -> int:
@@ -1039,8 +1119,22 @@ class RpcPsClient(PSClient):
         enforce(wire in ("fp32", "fp16"),
                 f"TableConfig.pull_wire_dtype must be 'fp32' or 'fp16', "
                 f"got {wire!r}")
+        push_wire = getattr(cfg, "push_wire_dtype", "fp32")
+        enforce(push_wire in ("fp32", "fp16", "int8"),
+                f"TableConfig.push_wire_dtype must be 'fp32', 'fp16' or "
+                f"'int8', got {push_wire!r}")
+        block = int(getattr(cfg, "push_wire_block", 128))
+        enforce(1 <= block <= 0xFFFF,
+                f"TableConfig.push_wire_block must be in [1, 65535], "
+                f"got {block}")
+        ssd_vals = getattr(cfg, "ssd_value_dtype", "fp32")
+        enforce(ssd_vals in ("fp32", "fp16"),
+                f"TableConfig.ssd_value_dtype must be 'fp32' or 'fp16', "
+                f"got {ssd_vals!r}")
         self._sparse_cfgs[table_id] = cfg
         self._wire_f16[table_id] = wire == "fp16"
+        self._push_wire[table_id] = (
+            push_wire, block, bool(getattr(cfg, "push_error_feedback", True)))
         base = _sparse_config_payload(cfg)
         if cfg.storage == "ssd":
             enforce(cfg.ssd_path is not None,
@@ -1050,9 +1144,12 @@ class RpcPsClient(PSClient):
             payload = base
             if cfg.storage == "ssd":
                 # each (table, server) pair owns its own disk directory;
-                # one job path can host many tables and same-host servers
+                # one job path can host many tables and same-host servers.
+                # storage low byte = 1 (ssd); bit 8 = fp16 value columns
+                # in the cold-tier records (ssd_value_dtype)
+                storage = 1 | (0x100 if ssd_vals == "fp16" else 0)
                 path = f"{cfg.ssd_path}/table{table_id}/server{idx}".encode()
-                payload = (base + np.asarray([1], np.int32).tobytes()
+                payload = (base + np.asarray([storage], np.int32).tobytes()
                            + np.asarray([len(path)], np.uint32).tobytes()
                            + path)
             # parallel across servers: an SSD create replays the whole
@@ -1205,7 +1302,7 @@ class RpcPsClient(PSClient):
             m["pull_bytes"].add(keys.nbytes + slots_arr.nbytes
                                 + out.size * (2 if f16 else 4))
             if out.size:
-                m["pull_density"].set(
+                m["pull_window"].update(
                     float(np.count_nonzero(out)) / out.size)
         return out
 
@@ -1214,18 +1311,88 @@ class RpcPsClient(PSClient):
         with RecordEvent("pserver_client_push_sparse"):
             return self._push_sparse(table_id, keys, values)
 
-    def _push_sparse(self, table_id, keys, values, _hops=0):
+    def _push_sparse(self, table_id, keys, values, _wire=None):
         keys = np.ascontiguousarray(keys, np.uint64)
         values = np.ascontiguousarray(values, np.float32)
         # client-side dedup-merge (brpc client merges duplicate keys
         # before send)
         keys, values = merge_duplicate_keys(keys, values)
+        wire, block, ef_on = ((_wire, 0, False) if _wire is not None else
+                              self._push_wire.get(table_id,
+                                                  ("fp32", 0, False)))
+        gd = values.shape[1] - 3 if values.ndim == 2 else 0
+        if wire == "fp32" or gd <= 0:
+            enc, aux = None, 0
+            wire_bytes = keys.nbytes + values.nbytes
+        else:
+            # quantize ONCE for the whole merged batch, BEFORE routing:
+            # a misroute replay re-sends the same encoded slices, so the
+            # error-feedback residual (already advanced for these rows)
+            # is never double-counted and every shard applies exactly
+            # the bytes this encode produced
+            head = np.ascontiguousarray(values[:, :3])
+            grad = values[:, 3:]
+            if wire == "fp16":
+                enc = (head, np.ascontiguousarray(grad.astype(np.float16)))
+                aux = _PUSH_WIRE_F16
+            else:
+                blk = min(block, gd)
+                if ef_on:
+                    with self._ef_mu:
+                        g = grad + self._ef_gather(table_id, keys, gd)
+                        q, scales = _quant_push_int8(g, blk)
+                        self._ef_scatter(
+                            table_id, keys,
+                            g - _dequant_push_int8(q, scales, blk))
+                        overflow = len(self._push_ef.get(table_id, ())) > \
+                            int(flag("ps_push_ef_max_rows"))
+                else:
+                    q, scales = _quant_push_int8(grad, blk)
+                    overflow = False
+                enc = (head, scales, q)
+                aux = _PUSH_WIRE_I8 | (blk << _PUSH_WIRE_BLOCK_SHIFT)
+            wire_bytes = keys.nbytes + sum(a.nbytes for a in enc)
+        self._push_encoded(table_id, keys,
+                           values if enc is None else None, enc, aux, 0)
+        if enc is not None and aux & _PUSH_WIRE_I8 and ef_on and overflow:
+            # bounded client RAM: past the cap the whole table's
+            # residuals drain over the fp32 wire (outside _ef_mu — the
+            # drain is itself a network push)
+            self.drain_push_residuals(table_id)
+        m = self._tbl_obs.get(table_id)
+        if m is not None:
+            m["push_rows"].add(len(keys))
+            # ACTUAL wire bytes (quantized payload, not the fp32 rows)
+            # — the counter the ≥3x sparse-push-reduction CI gate reads
+            m["push_bytes"].add(wire_bytes)
+            # observed push density over the GRADIENT block (the push
+            # layout's leading slot/show/click columns are always set):
+            # the per-table measured sparsity the Parallax placement
+            # pass (distributed/placement.py) reads as its signal,
+            # smoothed into an EWMA + min/max-over-window series
+            g = values[:, 3:] if values.ndim == 2 and \
+                values.shape[1] > 3 else values
+            if g.size:
+                m["push_window"].update(
+                    float(np.count_nonzero(g)) / g.size)
+
+    def _push_encoded(self, table_id, keys, values, enc, aux, _hops):
+        """Route + fan out one ALREADY-ENCODED push batch. ``enc`` is
+        None (fp32 wire: ``values`` ships raw) or the tuple of encoded
+        parts (head [, scales], grad) whose row-slices replay verbatim
+        on a kErrWrongShard bounce."""
         sv = self._route(keys)
 
         def one(c, sel):
             kp = keys if sel is None else keys[sel]
-            vp = values if sel is None else values[sel]
-            c.check(_PUSH_SPARSE, table_id, n=len(kp), payload=(kp, vp))
+            if enc is None:
+                parts = (kp, values if sel is None else values[sel])
+            else:
+                parts = (kp,) + tuple(
+                    a if sel is None else np.ascontiguousarray(a[sel])
+                    for a in enc)
+            c.check(_PUSH_SPARSE, table_id, n=len(kp), aux=aux,
+                    payload=parts)
 
         misrouted: List[np.ndarray] = []
         self._fanout([self._bounce_guard(s, lambda c, sel=sel: one(c, sel),
@@ -1237,21 +1404,71 @@ class RpcPsClient(PSClient):
             # exactly once even though the other shards' slices landed
             self._reroute_backoff(_hops)
             idx = np.concatenate(misrouted)
-            self._push_sparse(table_id, keys[idx], values[idx],
-                              _hops=_hops + 1)
-        m = self._tbl_obs.get(table_id) if _hops == 0 else None
-        if m is not None:
-            m["push_rows"].add(len(keys))
-            m["push_bytes"].add(keys.nbytes + values.nbytes)
-            # observed push density over the GRADIENT block (the push
-            # layout's leading slot/show/click columns are always set):
-            # the per-table measured sparsity Parallax-style placement
-            # (ROADMAP item 3) reads as its signal
-            g = values[:, 3:] if values.ndim == 2 and \
-                values.shape[1] > 3 else values
-            if g.size:
-                m["push_density"].set(
-                    float(np.count_nonzero(g)) / g.size)
+            self._push_encoded(
+                table_id, keys[idx],
+                None if values is None else values[idx],
+                None if enc is None else tuple(a[idx] for a in enc),
+                aux, _hops + 1)
+
+    # -- error-feedback residuals (push_wire_dtype="int8") ----------------
+
+    def _ef_gather(self, table_id: int, keys: np.ndarray, gd: int
+                   ) -> np.ndarray:
+        """Residual rows for ``keys`` (zeros for keys never quantized).
+        Caller holds _ef_mu."""
+        store = self._push_ef.setdefault(table_id, {})
+        out = np.zeros((len(keys), gd), np.float32)
+        for i, k in enumerate(keys.tolist()):
+            r = store.get(k)
+            if r is not None:
+                out[i] = r
+        return out
+
+    def _ef_scatter(self, table_id: int, keys: np.ndarray,
+                    resid: np.ndarray) -> None:
+        """Store the fresh residuals (caller holds _ef_mu)."""
+        store = self._push_ef.setdefault(table_id, {})
+        for i, k in enumerate(keys.tolist()):
+            store[k] = resid[i].copy()
+
+    def push_residual_rows(self, table_id: Optional[int] = None) -> int:
+        """Residual rows currently held client-side (tests/introspection;
+        0 after a drain — the digest-consistency contract)."""
+        with self._ef_mu:
+            if table_id is not None:
+                return len(self._push_ef.get(table_id, ()))
+            return sum(len(s) for s in self._push_ef.values())
+
+    def drain_push_residuals(self, table_id: Optional[int] = None) -> int:
+        """Push every queued error-feedback residual over the fp32 wire
+        and clear the store; returns rows drained. Communicator.quiesce()
+        calls this (like its queued pushes) so a checkpoint cut is
+        digest-consistent: after the drain, NO training signal lives
+        client-side — the captured server rows are the whole state.
+        Drain rows carry show=1.0/click=0: the AdaGrad family divides
+        the gradient by push_show, so a zero show would amplify the
+        residual ~1e10x instead of applying it (one synthetic
+        impression per drained key is the corresponding stats cost)."""
+        with self._ef_mu:
+            if table_id is None:
+                drained = {t: s for t, s in self._push_ef.items() if s}
+                self._push_ef = {}
+            else:
+                drained = {table_id: self._push_ef.pop(table_id, {})}
+        total = 0
+        for tid, store in drained.items():
+            if not store:
+                continue
+            keys = np.fromiter(store.keys(), np.uint64, len(store))
+            pd = self._dims(tid)[1]
+            vals = np.zeros((len(keys), pd), np.float32)
+            vals[:, 1] = 1.0  # show (see docstring)
+            resid = np.stack(list(store.values()))
+            vals[:, 3:3 + resid.shape[1]] = resid
+            self._op_count("push_sparse")
+            self._push_sparse(tid, keys, vals, _wire="fp32")
+            total += len(keys)
+        return total
 
     def pull_dense(self, table_id):
         self._op_count("pull_dense")
@@ -1284,7 +1501,7 @@ class RpcPsClient(PSClient):
             if grad.size:
                 # dense-gradient sparsity: the Parallax signal for
                 # moving a sparse-ish dense grad ONTO the PS wire
-                m["push_density"].set(
+                m["push_window"].update(
                     float(np.count_nonzero(grad)) / grad.size)
         # contiguous slice views — the gradient ships straight from the
         # caller's buffer, no per-server copy at all

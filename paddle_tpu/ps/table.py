@@ -186,6 +186,27 @@ class TableConfig:
     # decimal digits — fine for serving/eval pulls, keep fp32 where
     # bit-exact training state matters)
     pull_wire_dtype: str = "fp32"
+    # push-GRADIENT encoding on the RPC wire, symmetric with
+    # pull_wire_dtype (local tables ignore it; server state stays fp32
+    # — the server dequantizes before apply): "fp32" exact; "fp16"
+    # halves the gradient block; "int8" = block-quantized int8 with
+    # per-block fp32 absmax scales (the PR 3 EQuARX scheme moved onto
+    # the sparse RPC wire) plus a client-side fp32 error-feedback
+    # residual per (table, key) that folds into the next push and
+    # drains over the fp32 wire at Communicator.quiesce()/checkpoint
+    # cuts. The slot/show/click head columns always stay exact fp32.
+    push_wire_dtype: str = "fp32"
+    # int8 scale-block size (elements per fp32 scale, blocks tile a
+    # row). Default 128 ≥ every stock embedx width → one scale per row
+    push_wire_block: int = 128
+    # int8-only: keep the quantization error client-side and re-inject
+    # it next push (EQuARX error feedback). Off = plain quantization
+    push_error_feedback: bool = True
+    # SSD cold-tier record encoding (storage="ssd" only): "fp16" stores
+    # the VALUE columns (embed_w + embedx_w) as IEEE fp16 on disk with
+    # fp32 optimizer state; every read widens, so digests/snapshots/
+    # replication see the widened canonical form (csrc/ssd_table.cc)
+    ssd_value_dtype: str = "fp32"
 
 
 class _SparseShard:
@@ -637,10 +658,14 @@ class SsdSparseTable(MemorySparseTable):
         self.accessor = make_accessor(
             self.config.accessor, self.config.accessor_config
         )
+        enforce(self.config.ssd_value_dtype in ("fp32", "fp16"),
+                f"TableConfig.ssd_value_dtype must be 'fp32' or 'fp16', "
+                f"got {self.config.ssd_value_dtype!r}")
         # native-only: the disk tier has no Python fallback
         self._native = SsdTableEngine(
             self.config.shard_num, self.config.accessor,
-            self.accessor.config, self.config.seed, path=self.path)
+            self.accessor.config, self.config.seed, path=self.path,
+            value_f16=self.config.ssd_value_dtype == "fp16")
         self._shards = []
         self._pool = None
 

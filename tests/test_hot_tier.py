@@ -23,7 +23,8 @@ from paddle_tpu.core import mesh as mesh_mod
 from paddle_tpu.data.dataset import InMemoryDataset, SlotDesc
 from paddle_tpu.models.ctr import CtrConfig, DeepFM
 from paddle_tpu.ps import rpc
-from paddle_tpu.ps.communicator import HalfAsyncCommunicator
+from paddle_tpu.ps.communicator import (HalfAsyncCommunicator,
+                                         SyncCommunicator)
 from paddle_tpu.ps.device_hash import (DynamicDeviceKeyMap,
                                        dynamic_map_lookup, split_keys)
 from paddle_tpu.ps.hot_tier import HotEmbeddingTier, HotTierConfig
@@ -504,3 +505,62 @@ def test_hot_tier_rejects_mismatched_embedx_dim():
             hot_tier=HotEmbeddingTier(
                 MemorySparseTable(TableConfig(shard_num=2, accessor="ctr")),
                 HotTierConfig(capacity=32)))
+
+
+def test_hot_tier_writebacks_route_fp32_under_int8_push_wire():
+    """ISSUE 14 satellite pin: an int8 PUSH wire (push_wire_dtype) must
+    not touch the tier's writeback path — dirty evictions/flushes ship
+    as fp32 full-row import_full frames, so the tier stays BIT-identical
+    to an fp32-wire RPC-only oracle even when the table config
+    quantizes push_sparse. (An oracle pushing through the int8 wire
+    would differ — that is the contract being pinned, not assumed.)"""
+    servers = [rpc.NativePsServer(n_trainers=1) for _ in range(2)]
+    eps = [f"127.0.0.1:{s.port}" for s in servers]
+    servers_o = [rpc.NativePsServer(n_trainers=1) for _ in range(2)]
+    eps_o = [f"127.0.0.1:{s.port}" for s in servers_o]
+    cli = rpc.RpcPsClient(eps)
+    cli_o = rpc.RpcPsClient(eps_o)
+    try:
+        # tier arm: table CONFIGURED for the quantized push wire; small
+        # capacity forces eviction churn so dirty writebacks really flow
+        cli.create_sparse_table(0, TableConfig(
+            table_id=0, shard_num=4, accessor="ctr",
+            push_wire_dtype="int8"))
+        # SYNC communicator: the documented bit-parity precondition
+        # (async oracle pulls are stale by queue depth — §5d)
+        comm = SyncCommunicator(cli)
+        comm.start()
+        tr = make_trainer(None, hot=HotTierConfig(capacity=224),
+                          communicator=comm)
+        ds = make_data(nid=400)
+        rb = tr.train_from_dataset(ds, batch_size=64)
+        assert rb["hot_tier"]["writebacks"] > 0
+        tr.hot_tier.flush()
+        comm.stop()
+        # oracle arm: plain fp32 wire, RPC-only
+        cli_o.create_sparse_table(0, TableConfig(
+            table_id=0, shard_num=4, accessor="ctr"))
+        comm_o = SyncCommunicator(cli_o)
+        comm_o.start()
+        tr_o = make_trainer(None, communicator=comm_o)
+        tr_o.train_from_dataset(ds, batch_size=64)
+        comm_o.barrier()
+        comm_o.stop()
+        _assert_bitwise_equal(_leaves(tr.params), _leaves(tr_o.params))
+        ka, va = cli.snapshot_items(0)
+        kb, vb = cli_o.snapshot_items(0)
+        ia, ib = np.argsort(ka), np.argsort(kb)
+        np.testing.assert_array_equal(ka[ia], kb[ib])
+        for c in range(va.shape[1]):
+            if c == _DELTA_COL:
+                continue
+            np.testing.assert_array_equal(va[ia][:, c], vb[ib][:, c],
+                                          err_msg=f"col {c}")
+        # and the int8 wire config left ZERO residuals behind: the tier
+        # never pushed through the quantized path at all
+        assert cli.push_residual_rows() == 0
+    finally:
+        cli.close()
+        cli_o.close()
+        for s in servers + servers_o:
+            s.stop()

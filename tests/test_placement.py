@@ -1,0 +1,281 @@
+"""Density-measured auto-placement (distributed/placement.py).
+
+Fast tier: DensitySeries window semantics incl. restart re-base, the
+PlacementPolicy hysteresis + Densifying caution, manager fence gating,
+and flush-during-residency digest consistency.
+
+Acceptance: a placement swap executed at a LIVE reshard epoch fence —
+the variable moves PS→collective mid-CtrStreamTrainer while the
+cluster grows 2→4, then back at a manual fence, with zero lost/doubled
+rows by PR 4 digests, no trainer-visible error, and final pulled rows
++ dense params BIT-identical to an un-resharded, un-placed oracle.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    not __import__("paddle_tpu.ps.rpc", fromlist=["rpc_available"]
+                   ).rpc_available(),
+    reason="native PS service unavailable")
+
+from paddle_tpu.distributed.placement import (DensitySeries,  # noqa: E402
+                                              PlacementConfig,
+                                              PlacementManager,
+                                              PlacementPolicy)
+from paddle_tpu.ps import ha  # noqa: E402
+from paddle_tpu.ps.table import TableConfig, row_digest  # noqa: E402
+
+MASK = 0xFFFFFFFFFFFFFFFF
+S, D = 3, 2
+
+
+# ---------------------------------------------------------------------------
+# DensitySeries
+# ---------------------------------------------------------------------------
+
+def test_density_series_window_and_ewma():
+    s = DensitySeries(window=4)
+    for v in (0.2, 0.4, 0.9, 0.1, 0.5):
+        s.update(v)
+    assert s.n == 4                      # bounded window
+    assert s.wmin == 0.1 and s.wmax == 0.9
+    # EWMA seeded from the FIRST sample, alpha 0.2
+    e = 0.2
+    for v in (0.4, 0.9, 0.1, 0.5):
+        e = 0.8 * e + 0.2 * v
+    assert abs(s.ewma - e) < 1e-12
+
+
+def test_density_series_restart_rebase():
+    """A fresh series (client restart) re-bases: the first post-restart
+    sample seeds the EWMA (no decay from zero) and the window holds
+    only post-restart samples."""
+    from paddle_tpu.obs.registry import Registry
+
+    reg = Registry()
+    g = reg.gauge("ps_client_density", table="0", dir="push")
+    gmin = reg.gauge("ps_client_density_min", table="0", dir="push")
+    gmax = reg.gauge("ps_client_density_max", table="0", dir="push")
+    s1 = DensitySeries(gauge=g, gmin=gmin, gmax=gmax, window=8)
+    for v in (0.01, 0.02, 0.99):
+        s1.update(v)
+    assert gmin.value == 0.01 and gmax.value == 0.99
+    # "restart": a new incarnation binds the same gauges
+    s2 = DensitySeries(gauge=g, gmin=gmin, gmax=gmax, window=8)
+    s2.update(0.7)
+    assert s2.ewma == 0.7                # re-based, not decayed from 0
+    assert s2.n == 1
+    assert gmin.value == 0.7 and gmax.value == 0.7  # window re-based too
+
+
+def test_density_series_feeds_registry_family():
+    """The client's push path still lands in the PR 8
+    ps_client_density family (last-write + the Gauge's own EWMA)."""
+    from paddle_tpu.ps.rpc import NativePsServer, RpcPsClient
+
+    srv = NativePsServer()
+    try:
+        cli = RpcPsClient([f"127.0.0.1:{srv.port}"])
+        cli.create_sparse_table(0, TableConfig())
+        keys = np.arange(1, 33, dtype=np.uint64)
+        cli.pull_sparse(0, keys)
+        push = np.zeros((len(keys), 12), np.float32)
+        push[:, 1] = 1.0
+        push[:, 3:] = 0.5  # fully dense gradient block
+        cli.push_sparse(0, keys, push)
+        s = cli.density_series(0, "push")
+        assert s is not None and s.n == 1 and s.ewma == 1.0
+        from paddle_tpu.obs import registry as _reg
+
+        snap = _reg.REGISTRY.snapshot()["metrics"]
+        vals = {tuple(sorted(r["labels"].items())): r["value"]
+                for r in snap["ps_client_density"]["series"]}
+        assert vals[(("dir", "push"), ("table", "0"))] == 1.0
+        assert "ps_client_density_min" in snap
+        assert "ps_client_density_max" in snap
+        cli.close()
+    finally:
+        srv.stop()
+        srv.close()
+
+
+# ---------------------------------------------------------------------------
+# policy
+# ---------------------------------------------------------------------------
+
+def _fed(values, window=16):
+    s = DensitySeries(window=window)
+    for v in values:
+        s.update(v)
+    return s
+
+
+def test_policy_min_samples_gate():
+    p = PlacementPolicy(PlacementConfig(min_samples=8))
+    assert p.decide("ps", _fed([0.9] * 7)) is None
+    assert p.decide("ps", _fed([0.9] * 8)) == "collective"
+    assert p.decide("ps", None) is None
+
+
+def test_policy_densifying_caution_window_min():
+    """One sparse batch inside the window blocks densify even when the
+    EWMA clears the bar — density is a measured property of the WINDOW,
+    not of the latest batch (the Densifying cautionary baseline)."""
+    p = PlacementPolicy(PlacementConfig(densify_threshold=0.6,
+                                        sparsify_threshold=0.25,
+                                        min_samples=4))
+    dense_burst = _fed([0.9] * 10 + [0.1] + [0.9] * 5)   # dipped once
+    assert dense_burst.ewma > 0.6
+    assert p.decide("ps", dense_burst) is None            # blocked
+    steady = _fed([0.9] * 16)
+    assert p.decide("ps", steady) == "collective"
+
+
+def test_policy_hysteresis_band():
+    p = PlacementPolicy(PlacementConfig(densify_threshold=0.6,
+                                        sparsify_threshold=0.25,
+                                        min_samples=4))
+    mid = _fed([0.4] * 8)   # inside the band: no flapping either way
+    assert p.decide("ps", mid) is None
+    assert p.decide("collective", mid) is None
+    sparse = _fed([0.05] * 8)
+    assert p.decide("collective", sparse) == "ps"
+    assert p.decide("ps", sparse) is None
+
+
+# ---------------------------------------------------------------------------
+# manager (real cluster + trainer)
+# ---------------------------------------------------------------------------
+
+def _stream_trainer(cli, placement=None):
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM
+    from paddle_tpu.ps.communicator import SyncCommunicator
+    from paddle_tpu.ps.ps_trainer import CtrStreamTrainer
+
+    comm = SyncCommunicator(cli)
+    comm.start()
+    pt.seed(0)
+    tr = CtrStreamTrainer(
+        DeepFM(CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=8,
+                         dnn_hidden=(8,))),
+        optimizer.Adam(1e-2), None, communicator=comm, table_id=0,
+        embedx_dim=8, placement=placement,
+        sparse_slots=[f"s{i}" for i in range(S)],
+        dense_slots=[f"d{i}" for i in range(D)], label_slot="label")
+    return tr, comm
+
+
+def _data(n, seed=0):
+    import sys
+
+    sys.path.insert(0, "tests")
+    from test_reshard import _stream_data
+
+    return _stream_data(n, S, D, seed=seed)
+
+
+def test_fence_gates_the_swap():
+    """An armed swap does NOT execute until an epoch fence passes; the
+    first poll after fence() applies it at the batch boundary."""
+    with ha.HACluster(num_shards=2, replication=1, sync=True) as c:
+        cli = c.client()
+        cli.create_sparse_table(0, TableConfig(table_id=0, shard_num=4))
+        mgr = PlacementManager(cli, 0, PlacementConfig(
+            min_samples=4, auto=False))
+        tr, comm = _stream_trainer(cli, mgr)
+        tr.train_from_dataset(_data(128), batch_size=64)
+        mgr.arm("collective")
+        tr.train_from_dataset(_data(128, seed=1), batch_size=64)
+        assert mgr.placement == "ps"          # no fence yet
+        mgr.fence()                            # manual epoch fence
+        tr.train_from_dataset(_data(128, seed=2), batch_size=64)
+        assert mgr.placement == "collective"
+        assert mgr.local_table is not None
+        assert [e["to"] for e in mgr.events] == ["collective"]
+        comm.stop()
+
+
+def test_flush_keeps_checkpoint_cut_complete():
+    """While collective-resident, flush() writes every local row back:
+    the PS digest equals the local rows' digest — a job-checkpoint
+    capture taken now is complete without knowing the plane exists."""
+    with ha.HACluster(num_shards=2, replication=1, sync=True) as c:
+        cli = c.client()
+        cli.create_sparse_table(0, TableConfig(table_id=0, shard_num=4))
+        mgr = PlacementManager(cli, 0, PlacementConfig(
+            min_samples=4, auto=False, require_fence=False))
+        tr, comm = _stream_trainer(cli, mgr)
+        tr.train_from_dataset(_data(192), batch_size=64)
+        mgr.arm("collective")
+        tr.train_from_dataset(_data(192, seed=1), batch_size=64)
+        assert mgr.placement == "collective"
+        rows = mgr.flush()
+        assert rows > 0
+        k, v = mgr.local_table.snapshot_items()
+        assert (sum(cli.digest_routed(0)) & MASK) == row_digest(k, v)
+        # reset_to_ps (the restore path) drops residence without a
+        # write-back — the next pulls go to the PS again
+        mgr.reset_to_ps()
+        assert mgr.placement == "ps" and mgr.local_table is None
+        comm.stop()
+
+
+def test_swap_at_live_reshard_fence_bit_identical_to_oracle():
+    """THE acceptance: mid-stream, a reshard grow 2→4 fires the epoch
+    fence; the armed densify executes at the next batch boundary (rows
+    verified by digests), training continues on the collective plane,
+    then a manual fence moves it back. Final pulled rows, server
+    digests and dense params are BIT-identical to an oracle that never
+    resharded and never swapped."""
+    import jax
+    from paddle_tpu.ps.reshard import ReshardController
+
+    def run(place):
+        with ha.HACluster(num_shards=2, replication=1, sync=True) as c:
+            cli = c.client()
+            cli.create_sparse_table(0, TableConfig(table_id=0, shard_num=4))
+            mgr = ctl = None
+            if place:
+                ctl = ReshardController(c)
+                # the CTR stream's gradient block is fully dense →
+                # densify arms from measured density, not a manual arm
+                mgr = PlacementManager(cli, 0, PlacementConfig(
+                    densify_threshold=0.5, min_samples=4), controller=ctl)
+            tr, comm = _stream_trainer(cli, mgr)
+            tr.train_from_dataset(_data(384), batch_size=64)
+            if place:
+                assert mgr.placement == "ps"   # armed, but no fence yet
+                ctl.grow(2)                    # pre-cutover hook = fence
+                tr.on_reshard()                # batch boundary: applies
+                assert mgr.placement == "collective"
+                assert cli.num_servers == 4
+            tr.train_from_dataset(_data(384, seed=1), batch_size=64)
+            if place:
+                assert mgr.placement == "collective"  # zero PS RPCs here
+                mgr.arm("ps")
+                mgr.fence()
+            tr.train_from_dataset(_data(192, seed=2), batch_size=64)
+            if place:
+                assert mgr.placement == "ps"
+                assert [e["to"] for e in mgr.events] == ["collective", "ps"]
+            comm.barrier()
+            probe = np.unique(
+                (np.arange(0, 48, dtype=np.uint64)[None, :]
+                 + (np.arange(S, dtype=np.uint64)[:, None]
+                    << np.uint64(32))).reshape(-1))
+            pulled = cli.pull_sparse(0, probe, create=False)
+            dig = sum(cli.digest_routed(0)) & MASK
+            params = jax.tree_util.tree_map(np.asarray, tr.params)
+            comm.stop()
+            return pulled, dig, params
+
+    pulled_p, dig_p, params_p = run(place=True)
+    pulled_o, dig_o, params_o = run(place=False)
+    assert dig_p == dig_o                      # zero lost/doubled rows
+    np.testing.assert_array_equal(pulled_p, pulled_o)
+    for a, b in zip(jax.tree_util.tree_leaves(params_p),
+                    jax.tree_util.tree_leaves(params_o)):
+        np.testing.assert_array_equal(a, b)

@@ -1,0 +1,310 @@
+"""Quantized sparse push wire (TableConfig.push_wire_dtype) + the SSD
+fp16 record format's client-visible half.
+
+Covers the ISSUE 14 tentpole leg 1 contracts:
+- the PR 8 per-table byte counters measure the ENCODED wire (the ≥3x
+  int8-vs-fp32 reduction the CI gate asserts);
+- server dequant ≡ client dequant bit-for-bit (an fp32-wire push of the
+  client-side dequantized values lands the identical table state);
+- error-feedback residuals live per (table, key) on the client, fold
+  into the next push, survive merge/dedup, and DRAIN at
+  Communicator.quiesce() — zero residual rows after a cut (the
+  digest-consistency contract) — with int8-wire training pinned against
+  the fp32-wire oracle at a stated tolerance;
+- a replicated backup replaying the TAPPED quantized frames converges
+  bit-identically to the primary;
+- malformed quantized frames reject whole (kErrBadSize) before any
+  state change.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    not __import__("paddle_tpu.ps.rpc", fromlist=["rpc_available"]
+                   ).rpc_available(),
+    reason="native PS service unavailable")
+
+import paddle_tpu as pt  # noqa: E402
+from paddle_tpu.ps import ha  # noqa: E402
+from paddle_tpu.ps.accessor import AccessorConfig  # noqa: E402
+from paddle_tpu.ps.communicator import SyncCommunicator  # noqa: E402
+from paddle_tpu.ps.rpc import (NativePsServer, RpcPsClient,  # noqa: E402
+                               _PUSH_WIRE_BLOCK_SHIFT, _PUSH_WIRE_I8,
+                               _PUSH_SPARSE, _dequant_push_int8,
+                               _quant_push_int8)
+from paddle_tpu.ps.table import TableConfig, row_digest  # noqa: E402
+
+MASK = 0xFFFFFFFFFFFFFFFF
+
+
+def _acc(xd=64, th=0.0):
+    # embedx_threshold 0: embedx initializes on the first push, so the
+    # quantized gradient block actually lands in embedx weights
+    return AccessorConfig(embedx_dim=xd, embedx_threshold=th)
+
+
+def _mk_cluster(n=2):
+    srvs = [NativePsServer() for _ in range(n)]
+    return srvs, [f"127.0.0.1:{s.port}" for s in srvs]
+
+
+def _stop(srvs):
+    for s in srvs:
+        s.stop()
+        s.close()
+
+
+def _pushes(cli, tid, keys, gd, steps, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(steps):
+        push = np.zeros((len(keys), 3 + gd), np.float32)
+        push[:, 1] = 1.0
+        push[:, 3:] = rng.normal(0, 0.1, (len(keys), gd)).astype(np.float32)
+        cli.push_sparse(tid, keys, push)
+
+
+def _push_bytes(cli, tid):
+    from paddle_tpu.obs import registry as _reg
+
+    snap = _reg.REGISTRY.snapshot()["metrics"]
+    fam = snap.get("ps_client_wire_bytes", {"series": []})
+    return sum(s["value"] for s in fam["series"]
+               if s["labels"].get("dir") == "push"
+               and s["labels"].get("table") == str(tid))
+
+
+def test_push_wire_byte_ratio_int8_ge_3x():
+    """THE wire-byte acceptance: identical workload, per-table byte
+    counters; int8 moves ≥3x fewer push bytes than fp32 (fp16 sits in
+    between). The counters measure the ENCODED payload."""
+    from paddle_tpu.obs import registry as _reg
+
+    got = {}
+    for tid, wire in ((1, "fp32"), (2, "fp16"), (3, "int8")):
+        srvs, eps = _mk_cluster()
+        try:
+            cli = RpcPsClient(eps)
+            cli.create_sparse_table(tid, TableConfig(
+                table_id=tid, accessor_config=_acc(64), seed=5,
+                push_wire_dtype=wire))
+            keys = np.arange(1, 301, dtype=np.uint64)
+            cli.pull_sparse(tid, keys)
+            before = _push_bytes(cli, tid)
+            _pushes(cli, tid, keys, 65, steps=4)
+            got[wire] = _push_bytes(cli, tid) - before
+            cli.close()
+        finally:
+            _stop(srvs)
+    assert got["fp32"] >= 3.0 * got["int8"], got
+    assert got["int8"] < got["fp16"] < got["fp32"], got
+    _reg.REGISTRY.reset()
+
+
+def test_server_dequant_matches_client_dequant_bitwise():
+    """Cluster A pushes over the int8 wire; cluster B pushes the
+    client-side DEQUANTIZED values over the fp32 wire. Final digests
+    must be equal bit-for-bit — the server's decode multiplies the same
+    int8 by the same f32 scale."""
+    digs = []
+    rng = np.random.default_rng(3)
+    keys = rng.integers(1, 1 << 40, 200).astype(np.uint64)
+    grads = [rng.normal(0, 0.2, (len(keys), 9)).astype(np.float32)
+             for _ in range(3)]
+    for mode in ("int8", "predequantized_fp32"):
+        srvs, eps = _mk_cluster()
+        try:
+            cli = RpcPsClient(eps)
+            cli.create_sparse_table(0, TableConfig(
+                accessor_config=_acc(8), seed=9,
+                push_wire_dtype="int8" if mode == "int8" else "fp32",
+                push_error_feedback=False))
+            cli.pull_sparse(0, keys)
+            for g in grads:
+                push = np.zeros((len(keys), 12), np.float32)
+                push[:, 1] = 1.0
+                if mode == "int8":
+                    push[:, 3:] = g
+                else:  # blk = min(push_wire_block=128, gd=9) client-side
+                    q, sc = _quant_push_int8(g, 9)
+                    push[:, 3:] = _dequant_push_int8(q, sc, 9)
+                cli.push_sparse(0, keys, push)
+            digs.append(sum(cli.digest(0)) & MASK)
+            cli.close()
+        finally:
+            _stop(srvs)
+    assert digs[0] == digs[1]
+
+
+def test_error_feedback_survives_and_drains_at_quiesce():
+    """int8 + EF: residuals accumulate per (table, key), quiesce()
+    drains them over the fp32 wire (zero rows left — the checkpoint cut
+    is digest-complete), and the final embedding weights land within a
+    stated tolerance of the fp32-wire oracle."""
+    results = {}
+    for wire in ("fp32", "int8"):
+        srvs, eps = _mk_cluster()
+        try:
+            cli = RpcPsClient(eps)
+            comm = SyncCommunicator(cli)
+            comm.start()
+            cli.create_sparse_table(0, TableConfig(
+                accessor_config=_acc(8), seed=11, push_wire_dtype=wire))
+            keys = np.arange(1, 129, dtype=np.uint64)
+            cli.pull_sparse(0, keys)
+            rng = np.random.default_rng(1)
+            for _ in range(20):
+                push = np.zeros((len(keys), 12), np.float32)
+                push[:, 1] = 1.0
+                push[:, 3:] = rng.normal(0, 0.05,
+                                         (len(keys), 9)).astype(np.float32)
+                comm.send_sparse(0, keys, push)
+            if wire == "int8":
+                assert cli.push_residual_rows(0) == len(keys)
+            comm.quiesce()  # drains queued pushes AND EF residuals
+            assert cli.push_residual_rows() == 0
+            k, v = cli.snapshot_items(0)
+            order = np.argsort(k)
+            results[wire] = v[order]
+            comm.stop()
+            cli.close()
+        finally:
+            _stop(srvs)
+    a, b = results["fp32"], results["int8"]
+    # stated tolerance: block-int8 with error feedback + terminal drain
+    # tracks the fp32 wire to ~1e-3 absolute on these magnitudes
+    emb = slice(5, 6)  # embed_w column
+    np.testing.assert_allclose(b[:, 5], a[:, 5], atol=2e-3)
+    np.testing.assert_allclose(b[:, 8:17], a[:, 8:17], atol=2e-3)
+    assert not np.array_equal(b, a)  # quantization really happened
+
+
+def test_merge_dedup_folds_one_residual_per_key():
+    """Duplicate keys in one push merge BEFORE quantization — exactly
+    one residual row per unique key."""
+    srvs, eps = _mk_cluster(1)
+    try:
+        cli = RpcPsClient(eps)
+        cli.create_sparse_table(0, TableConfig(
+            accessor_config=_acc(8), seed=2, push_wire_dtype="int8"))
+        keys = np.array([7, 7, 9, 9, 9, 11], np.uint64)
+        cli.pull_sparse(0, keys)
+        push = np.zeros((len(keys), 12), np.float32)
+        push[:, 1] = 1.0
+        push[:, 3:] = np.random.default_rng(0).normal(
+            0, 0.1, (len(keys), 9)).astype(np.float32)
+        cli.push_sparse(0, keys, push)
+        assert cli.push_residual_rows(0) == 3  # unique keys only
+        cli.close()
+    finally:
+        _stop(srvs)
+
+
+def test_ef_store_overflow_drains_itself():
+    """Past FLAGS_ps_push_ef_max_rows the whole table's residuals drain
+    over the fp32 wire — client RAM stays bounded, signal is kept."""
+    srvs, eps = _mk_cluster(1)
+    try:
+        cli = RpcPsClient(eps)
+        cli.create_sparse_table(0, TableConfig(
+            accessor_config=_acc(8), seed=2, push_wire_dtype="int8"))
+        keys = np.arange(1, 65, dtype=np.uint64)
+        cli.pull_sparse(0, keys)
+        pt.set_flags({"ps_push_ef_max_rows": 16})
+        try:
+            push = np.zeros((len(keys), 12), np.float32)
+            push[:, 1] = 1.0
+            push[:, 3:] = 0.01
+            cli.push_sparse(0, keys, push)
+            assert cli.push_residual_rows(0) == 0  # 64 > 16 → drained
+        finally:
+            pt.set_flags({"ps_push_ef_max_rows": 1 << 20})
+        cli.close()
+    finally:
+        _stop(srvs)
+
+
+def test_quantized_frames_replicate_bit_identically():
+    """Sync replication with an int8 push wire: the backup replays the
+    TAPPED quantized frames (same aux, same bytes) and converges
+    bit-identically to the primary."""
+    with ha.HACluster(num_shards=2, replication=2, sync=True) as c:
+        cli = c.client()
+        cli.create_sparse_table(0, TableConfig(
+            table_id=0, shard_num=4, accessor_config=_acc(8),
+            push_wire_dtype="int8"))
+        keys = np.arange(1, 201, dtype=np.uint64)
+        cli.pull_sparse(0, keys)
+        _pushes(cli, 0, keys, 9, steps=4, seed=4)
+        cli.drain_push_residuals()
+        c.drain()
+        for shard in range(2):
+            dg = c.digests(0, shard)
+            assert len(set(dg.values())) == 1, dg
+
+
+def test_malformed_quantized_frame_rejects_whole():
+    """A quantized push whose payload length disagrees with its aux
+    flags bounces kErrBadSize BEFORE any apply — and a quantized push
+    to a gradient-less table (pd <= 3) is likewise refused."""
+    srvs, eps = _mk_cluster(1)
+    try:
+        cli = RpcPsClient(eps)
+        cli.create_sparse_table(0, TableConfig(
+            accessor_config=_acc(8), seed=2))
+        keys = np.arange(1, 9, dtype=np.uint64)
+        cli.pull_sparse(0, keys)
+        dig0 = cli.digest(0)
+        conn = cli._conns[0]
+        # int8 flags but an fp32-sized payload
+        bad = np.zeros((len(keys), 12), np.float32)
+        aux = _PUSH_WIRE_I8 | (128 << _PUSH_WIRE_BLOCK_SHIFT)
+        status, _ = conn.call(_PUSH_SPARSE, 0, n=len(keys), aux=aux,
+                              payload=(keys, bad))
+        assert status == -3  # kErrBadSize
+        # block size 0 is refused
+        status, _ = conn.call(_PUSH_SPARSE, 0, n=len(keys),
+                              aux=_PUSH_WIRE_I8, payload=(keys, bad))
+        assert status == -3
+        # hostile header: a huge n with a tiny payload must reject with
+        # kErrBadSize BEFORE the decode scratch is sized from n (a
+        # resize-first would throw and take the server down)
+        status, _ = conn.call(_PUSH_SPARSE, 0, n=1 << 31, aux=aux,
+                              payload=keys)
+        assert status == -3
+        assert cli.digest(0) == dig0  # nothing applied, server alive
+        cli.close()
+    finally:
+        _stop(srvs)
+
+
+def test_ragged_block_and_multi_block_rows():
+    """Block sizes that do not divide the gradient width quantize and
+    decode correctly (the last block of each row is ragged)."""
+    for block in (4, 7, 9, 128):
+        srvs, eps = _mk_cluster(1)
+        try:
+            cli = RpcPsClient(eps)
+            cli.create_sparse_table(0, TableConfig(
+                accessor_config=_acc(8), seed=2, push_wire_dtype="int8",
+                push_wire_block=block, push_error_feedback=False))
+            keys = np.arange(1, 33, dtype=np.uint64)
+            cli.pull_sparse(0, keys)
+            g = np.random.default_rng(block).normal(
+                0, 0.1, (len(keys), 9)).astype(np.float32)
+            push = np.zeros((len(keys), 12), np.float32)
+            push[:, 1] = 1.0
+            push[:, 3:] = g
+            cli.push_sparse(0, keys, push)  # must not raise
+            # server state equals an fp32 push of the dequantized grads
+            blk = min(block, 9)
+            q, sc = _quant_push_int8(g, blk)
+            deq = _dequant_push_int8(q, sc, blk)
+            # per-element error ≤ scale/2 = block_absmax/254; the global
+            # absmax bounds every block's scale
+            np.testing.assert_allclose(deq, g,
+                                       atol=float(np.abs(g).max()) / 254
+                                       * 1.01)
+            cli.close()
+        finally:
+            _stop(srvs)

@@ -426,3 +426,140 @@ def test_pass_trainer_over_ssd_table(tmp_path, rng):
         losses.append(tr.train_from_dataset(ds, batch_size=256)["loss"])
     assert losses[-1] < losses[0] * 0.95, losses
     assert table.size() > 0
+
+
+# ---------------------------------------------------------------------------
+# fp16 record format (TableConfig.ssd_value_dtype="fp16"; ISSUE 14)
+# ---------------------------------------------------------------------------
+
+def _f16_cfg(**kw):
+    kw.setdefault("storage", "ssd")
+    kw.setdefault("ssd_value_dtype", "fp16")
+    return _cfg(**kw)
+
+
+def _fill(table, rng, n=400):
+    keys = rng.integers(1, 1 << 40, n).astype(np.uint64)
+    vals = rng.normal(0, 1, (n, table.full_dim)).astype(np.float32)
+    vals[:, 0] = (keys % 8).astype(np.float32)  # slot
+    table.import_full(keys, vals)
+    return keys, vals
+
+
+def test_fp16_records_digest_widened_canonical_form(tmp_path):
+    """The digest of an fp16 table IS the digest of its widened rows
+    (snapshot_items) at every moment — with hot rows un-rounded and
+    cold rows on the fp16 grid, the canonical form is what every read
+    path returns."""
+    from paddle_tpu.ps.table import row_digest
+
+    t = SsdSparseTable(tmp_path / "a", _f16_cfg())
+    keys, _ = _fill(t, np.random.default_rng(0))
+    t.spill(100)  # mixed tiers: some rows rounded, some not
+    k, v = t.snapshot_items()
+    assert t.digest() == row_digest(k, v)
+    # the value columns of COLD rows are exactly fp16-representable
+    st = t.stats()
+    assert st["cold_rows"] > 0 and st["hot_rows"] > 0
+    t.close()
+
+
+def test_fp16_records_round_trip_snapshot_restore(tmp_path):
+    """Fully-spilled fp16 table → snapshot → restore into a fresh fp16
+    table via BOTH tiers: digests equal the widened canonical form
+    (re-narrowing an fp16-grid value is the identity)."""
+    t = SsdSparseTable(tmp_path / "a", _f16_cfg())
+    _fill(t, np.random.default_rng(1))
+    t.spill(0)  # everything cold → every value column on the fp16 grid
+    k, v = t.snapshot_items()
+    dg = t.digest()
+    cold = SsdSparseTable(tmp_path / "b", _f16_cfg())
+    cold.load_cold(k, v)
+    assert cold.digest() == dg
+    hot = SsdSparseTable(tmp_path / "c", _f16_cfg())
+    hot.import_full(k, v)
+    assert hot.digest() == dg
+    # ...and a full spill of the hot restore re-rounds to the same grid
+    hot.spill(0)
+    assert hot.digest() == dg
+    t.close(); cold.close(); hot.close()
+
+
+def test_fp16_records_shrink_disk_bytes(tmp_path):
+    """The point of the format: cold-tier records are materially
+    smaller (embedx 4 + CTR state: 8B key + 4B flag + mixed row)."""
+    rng = np.random.default_rng(2)
+    keys = rng.integers(1, 1 << 40, 500).astype(np.uint64)
+    sizes = {}
+    for name, dt in (("f32", "fp32"), ("f16", "fp16")):
+        t = SsdSparseTable(tmp_path / name, _cfg(
+            storage="ssd", ssd_value_dtype=dt))
+        vals = rng.normal(0, 1, (len(keys), t.full_dim)).astype(np.float32)
+        vals[:, 0] = 0
+        t.import_full(keys, vals)
+        t.spill(0)
+        sizes[dt] = t.stats()["disk_bytes"]
+        t.close()
+    assert sizes["fp16"] < 0.85 * sizes["fp32"], sizes
+
+
+def test_fp16_crash_replay_and_value_grid(tmp_path):
+    """Crash recovery (re-open = log replay) preserves fp16 records
+    exactly, and widened value columns round-trip float16 losslessly."""
+    path = tmp_path / "a"
+    t = SsdSparseTable(path, _f16_cfg())
+    _fill(t, np.random.default_rng(3))
+    t.spill(0)
+    k, v = t.snapshot_items()
+    dg = t.digest()
+    t.close()  # no clean shutdown protocol — reopen replays the log
+    t2 = SsdSparseTable(path, _f16_cfg())
+    assert t2.digest() == dg
+    k2, v2 = t2.snapshot_items()
+    order, order2 = np.argsort(k), np.argsort(k2)
+    np.testing.assert_array_equal(v[order], v2[order2])
+    # value columns are on the fp16 grid (cold rows), opt state is NOT
+    # narrowed: unseen/show/click columns keep full fp32 content
+    emb = v2[:, 5]
+    np.testing.assert_array_equal(
+        emb, emb.astype(np.float16).astype(np.float32))
+    t2.close()
+
+
+def test_fp16_replication_full_sync_digest_equal():
+    """HA replication of an fp16 SSD table: before any spill the
+    replicated ops apply identically (digests EQUAL across replicas),
+    and after a primary-side spill — the documented one-time lossy
+    moment replication does not see — the primary's digest still
+    equals its widened canonical rows (snapshot/replication always
+    exchange the widened form, never raw fp16 records)."""
+    from paddle_tpu.ps import ha
+    from paddle_tpu.ps.rpc import rpc_available
+    from paddle_tpu.ps.table import row_digest
+
+    if not rpc_available():
+        pytest.skip("native PS service unavailable")
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        with ha.HACluster(num_shards=1, replication=2, sync=True) as c:
+            cli = c.client()
+            cli.create_sparse_table(0, _f16_cfg(ssd_path=d))
+            rng = np.random.default_rng(4)
+            keys = rng.integers(1, 1 << 40, 300).astype(np.uint64)
+            cli.pull_sparse(0, keys)
+            push = np.zeros((len(keys), 8), np.float32)  # 3 + (1 + xd=4)
+            push[:, 1] = 1.0
+            push[:, 3:] = 0.05
+            cli.push_sparse(0, keys, push)
+            c.drain()
+            # pre-spill: the replicated stream converges bit-identically
+            dg = c.digests(0, 0)
+            assert len(set(dg.values())) == 1, dg
+            # primary-side spill rounds its coldest rows (kSpill is
+            # deliberately unreplicated — OPERATIONS §5b caveat); the
+            # primary's digest tracks its OWN widened canonical form
+            cli.spill(0, 50)
+            k, v = cli.snapshot_items(0)
+            primary_dg = cli.digest(0)[0]
+            assert primary_dg == row_digest(k, v)

@@ -106,3 +106,40 @@ def test_python_mirrors_agree_with_runtime_modules():
     assert trace.WIRE_CONTEXT_BYTES == py.wire_context_bytes
     assert ha._HDR.format.lstrip("<") == py.hdr_format.lstrip("<")
     assert ha._HDR.size == py.req_header_bytes
+
+
+def test_push_wire_flags_mirrored(cs, py):
+    """The quantized push-payload aux bits (PushWireFlag) are pinned in
+    both languages — the aux word rides the tapped replication frames,
+    so a drifted flag silently corrupts every replaying backup."""
+    assert cs.flags, "extractor found no PushWireFlag enum"
+    assert set(wc.FLAG_CONTRACT) == set(cs.flags)
+    for name, (val, (mod, const)) in wc.FLAG_CONTRACT.items():
+        assert cs.flags[name][0] == val, \
+            f"{name}: contract {val} != csrc {cs.flags[name][0]}"
+        got = py.consts[mod].get(const)
+        assert got is not None, f"python mirror {const} missing"
+        assert got[0] == val, f"{const} = {got[0]} != csrc {name} = {val}"
+
+
+def test_push_wire_flag_drift_detected(tmp_path):
+    """Perturbation pin: a drifted flag value in a csrc copy trips
+    wire-flag-drift (the extractor really reads the enum, the check
+    really compares it)."""
+    src = open(CSRC, encoding="utf-8").read()
+    bad = src.replace("kPushWireI8 = 2,", "kPushWireI8 = 4,")
+    assert bad != src
+    perturbed = wc.extract_csrc(_write_tmp(tmp_path, bad))
+    assert perturbed.flags["kPushWireI8"][0] == 4
+    # and the runtime constants agree with the real enum
+    from paddle_tpu.ps import rpc
+    assert rpc._PUSH_WIRE_F16 == wc.FLAG_CONTRACT["kPushWireF16"][0]
+    assert rpc._PUSH_WIRE_I8 == wc.FLAG_CONTRACT["kPushWireI8"][0]
+    assert rpc._PUSH_WIRE_BLOCK_SHIFT == \
+        wc.FLAG_CONTRACT["kPushWireBlockShift"][0]
+
+
+def _write_tmp(tmp_path, content):
+    p = tmp_path / "ps_service.cc"
+    p.write_text(content, encoding="utf-8")
+    return str(p)

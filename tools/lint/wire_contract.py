@@ -35,6 +35,9 @@ Rules (all fatal; none are allowlisted in practice — drift is a bug):
   wire-err-drift        csrc Err enum vs CONTRACT
   wire-err-mirror       Python error mirror (const or raised exception)
                         missing or value drift
+  wire-flag-drift       csrc PushWireFlag enum (quantized push-payload
+                        aux bits + block shift) vs FLAG_CONTRACT
+  wire-flag-mirror      Python _PUSH_WIRE_* constant missing or drifted
   wire-header-drift     ReqHeader fields vs ha._HDR format vs
                         rpc._REQ_HEADER_BYTES vs trace.WIRE_CONTEXT_BYTES;
                         ObsSpan vs trace.SERVER_SPAN_STRUCT
@@ -148,6 +151,17 @@ CONTRACT: Dict[str, CmdSpec] = {
     "kRetain": CmdSpec(44, ("rpc", "_RETAIN"), tap="cond", gate="cond"),
 }
 
+# quantized-payload wire flags (csrc PushWireFlag — kPushSparse aux
+# bits + the int8 block-size shift). A new encoding flag must appear
+# here AND in both languages, or the gate fails: the aux word is part
+# of the frame the oplog taps, so a drifted flag silently corrupts
+# every replaying backup.
+FLAG_CONTRACT: Dict[str, Tuple[int, Tuple[str, str]]] = {
+    "kPushWireF16": (1, ("rpc", "_PUSH_WIRE_F16")),
+    "kPushWireI8": (2, ("rpc", "_PUSH_WIRE_I8")),
+    "kPushWireBlockShift": (8, ("rpc", "_PUSH_WIRE_BLOCK_SHIFT")),
+}
+
 # error codes: py mirror is either a module-level constant in ha.py or
 # the exception _ServerConn.check raises for that status (or None)
 ERR_CONTRACT: Dict[str, Tuple[int, Optional[Tuple[str, str]]]] = {
@@ -184,6 +198,7 @@ RELEVANT_FILES = (_CSRC, *_PY_FILES.values(),
 class CsrcContract:
     cmds: Dict[str, Tuple[int, int]] = field(default_factory=dict)  # val,line
     errs: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+    flags: Dict[str, Tuple[int, int]] = field(default_factory=dict)
     structs: Dict[str, List[Tuple[str, str, int]]] = \
         field(default_factory=dict)            # name -> [(ctype, field, line)]
     classify: Dict[str, Dict[str, str]] = \
@@ -211,7 +226,7 @@ def extract_csrc(path: str) -> CsrcContract:
         line = raw.split("//")[0]
         if mode is None:
             m = _ENUM_START_RE.search(line)
-            if m and m.group(1) in ("Cmd", "Err"):
+            if m and m.group(1) in ("Cmd", "Err", "PushWireFlag"):
                 mode = ("enum", m.group(1))
                 continue
             m = _STRUCT_START_RE.search(line)
@@ -229,7 +244,8 @@ def extract_csrc(path: str) -> CsrcContract:
         if kind == "enum":
             m = _ENUM_ENTRY_RE.match(line)
             if m:
-                tgt = out.cmds if name == "Cmd" else out.errs
+                tgt = {"Cmd": out.cmds, "Err": out.errs,
+                       "PushWireFlag": out.flags}[name]
                 tgt[m.group(1)] = (int(m.group(2)), i)
             if "}" in line:
                 mode = None
@@ -474,6 +490,30 @@ def check(root: str) -> List[Diagnostic]:
             d(_PY_FILES["rpc"], line, "wire-err-mirror",
               f"_ServerConn.check maps status {val} (`{exc}`) but no csrc "
               "error code has that value")
+
+    # -- quantized-payload wire flags (PushWireFlag) -------------------------
+    for name, (val, (mod, const)) in FLAG_CONTRACT.items():
+        got = cs.flags.get(name)
+        if got is None:
+            d(rel_csrc, 1, "wire-flag-drift",
+              f"contract wire flag `{name}` (= {val}) missing from the "
+              "csrc PushWireFlag enum")
+        elif got[0] != val:
+            d(rel_csrc, got[1], "wire-flag-drift",
+              f"`{name}` = {got[0]} in csrc but {val} in the contract")
+        rel_py = _PY_FILES[mod]
+        got_py = py.consts.get(mod, {}).get(const)
+        if got_py is None:
+            d(rel_py, 1, "wire-flag-mirror",
+              f"`{const}` (mirror of csrc {name} = {val}) is missing")
+        elif got_py[0] != val:
+            d(rel_py, got_py[1], "wire-flag-mirror",
+              f"`{const}` = {got_py[0]} but csrc {name} = {val}")
+    for name, (val, line) in cs.flags.items():
+        if name not in FLAG_CONTRACT:
+            d(rel_csrc, line, "wire-flag-drift",
+              f"csrc wire flag `{name}` = {val} is not in FLAG_CONTRACT "
+              "— classify it (tools/lint/wire_contract.py)")
 
     # -- header layouts ------------------------------------------------------
     req = cs.structs.get("ReqHeader")
