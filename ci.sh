@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI gate (reference L0's cmake+ctest role): graftlint, native build,
 # fast test gate, then the full matrix.
-# Usage: ./ci.sh [lint [--changed]|fast|full|chaos|ckpt|hot_tier|serving|obs|slo|reshard]
+# Usage: ./ci.sh [lint [--changed]|fast|full|chaos|ckpt|hot_tier|serving|serving_fleet|obs|slo|reshard]
 #   chaos — PS high-availability fast-gate: every failover/replication
 #   test with faultpoints armed (incl. the slow e2e kill-shard runs)
 #   plus the chaos_ps demo with its recovery/overhead acceptance checks.
@@ -198,6 +198,48 @@ print('serving OK: warm p99=%.1fms qps=%.0f, push→servable p95=%.1fms'
   }
   check_serving || { echo "serving retry (ambient-load outlier)"; check_serving; }
   echo "CI OK (serving)"
+  exit 0
+fi
+
+if [[ "${1:-fast}" == "serving_fleet" ]]; then
+  echo "== serving_fleet gate: router / fleet / rollout suite =="
+  # -m "" for symmetry; the suite is all fast (stub-member router
+  # semantics + real-replica fleet joins/drains/crash + the rollout
+  # lifecycle incl. the primary-promotion re-attach heal)
+  python -m pytest tests/test_serving_fleet.py -q -m ""
+  echo "== fleet bench (open-loop replay + chaos + canary cycle) =="
+  # gate the INVARIANTS exactly (zero errors through a kill-replica
+  # round AND a draining restart, hedge rate bounded, warm-handoff
+  # misses < cold-join misses, canary split exact + digest-pinned
+  # rollback) and the throughput only loosely — absolute qps/p99 on a
+  # shared 1-core box swing 2-3x with ambient load (the committed
+  # SERVING_FLEET.json is the quiet-host run that also meets the
+  # ≥baseline-qps / ≤2x-p99 acceptance); one retry absorbs outliers
+  check_fleet() {
+    PYTHONPATH="$PWD:${PYTHONPATH:-}" JAX_PLATFORMS=cpu \
+      SFB_KEYS=8000 SFB_STEADY=2000 SFB_CHUNK=800 \
+      python tools/serving_fleet_bench.py | python -c "
+import json, sys
+d = json.loads([l for l in sys.stdin.read().splitlines()
+                if l.startswith('{')][-1])
+assert 'error' not in d, d
+assert d['steady']['errors'] == 0, d['steady']
+assert d['chaos_kill']['errors'] == 0, d['chaos_kill']
+assert d['drain_restart']['errors'] == 0, d['drain_restart']
+assert d['chaos_kill']['members_after'] == d['chaos_kill']['members_before'] - 1
+assert d['steady']['hedge_rate'] <= 0.25, d['steady']
+assert d['join']['warm']['misses'] < d['join']['cold']['misses'], d['join']
+assert d['canary']['split_exact'], d['canary']
+assert d['canary']['rollback_digest_ok'], d['canary']
+assert d['steady']['achieved_qps'] >= 0.5 * d['steady']['target_qps'], d['steady']
+print('serving_fleet OK: steady %.0f qps (p99 %.1f ms), capacity %.0f qps, '
+      'kill+drain 0 errors, hedge %.1f%%, warm/cold misses %d/%d'
+      % (d['steady']['achieved_qps'], d['steady']['request_ms']['p99_ms'],
+         d['saturation']['achieved_qps'], 100 * d['steady']['hedge_rate'],
+         d['join']['warm']['misses'], d['join']['cold']['misses']))"
+  }
+  check_fleet || { echo "serving_fleet retry (ambient-load outlier)"; check_fleet; }
+  echo "CI OK (serving_fleet)"
   exit 0
 fi
 
@@ -490,6 +532,7 @@ print('bench degradation ladder OK')"
       tests/test_rpc_robustness.py tests/test_dist_graph.py \
       tests/test_rpc_parallel.py tests/test_ps_ha.py \
       tests/test_job_checkpoint.py tests/test_serving.py \
+      tests/test_serving_fleet.py \
       tests/test_obs.py tests/test_slo.py tests/test_flightrec.py \
       tests/test_reshard.py tests/test_autoscale.py \
       tests/test_sparse_wire.py -q -m ""
@@ -512,6 +555,7 @@ print('bench degradation ladder OK')"
       tests/test_rpc_robustness.py tests/test_dist_graph.py \
       tests/test_rpc_parallel.py tests/test_ps_ha.py \
       tests/test_job_checkpoint.py tests/test_serving.py \
+      tests/test_serving_fleet.py \
       tests/test_obs.py tests/test_slo.py tests/test_flightrec.py \
       tests/test_reshard.py tests/test_autoscale.py \
       tests/test_sparse_wire.py -q -m ""
@@ -533,6 +577,7 @@ print('bench degradation ladder OK')"
       tests/test_rpc_robustness.py tests/test_dist_graph.py \
       tests/test_rpc_parallel.py tests/test_ps_ha.py \
       tests/test_job_checkpoint.py tests/test_serving.py \
+      tests/test_serving_fleet.py \
       tests/test_obs.py tests/test_slo.py tests/test_flightrec.py \
       tests/test_reshard.py tests/test_autoscale.py \
       tests/test_sparse_wire.py -q -m ""

@@ -302,6 +302,8 @@ def default_rules(step_p95_s: float = 1.0,
                   freshness_p95_s: float = 0.25,
                   repl_lag_entries: float = 1000.0,
                   checkpoint_age_s: float = 600.0,
+                  fleet_p99_s: Optional[float] = None,
+                  hedge_rate_per_s: float = 100.0,
                   long_s: float = 60.0, short_s: float = 10.0
                   ) -> List[SloRule]:
     """The stock rule set over the families the framework already
@@ -342,5 +344,22 @@ def default_rules(step_p95_s: float = 1.0,
                 windows=((short_s, 1.0),)),
         SloRule("checkpoint_staleness", "job_checkpoint_last_wall_s",
                 kind="threshold", agg="age", threshold=checkpoint_age_s,
+                windows=((short_s, 1.0),)),
+        # -- fleet aggregates (ISSUE 15): the ROUTER's end-to-end view
+        # (submit → first winning completion across reroutes/hedges) is
+        # the user-facing latency — a single replica's p99 can be green
+        # while the fleet's is burning on reroute tails. The hedge-rate
+        # rule catches a fleet quietly paying for its tail in duplicate
+        # work: hedges are normal at the margin, pathological in bulk
+        # (a member with a degraded p95 pulls every request past its
+        # budget).
+        SloRule("fleet_serving_p99", "serving_latency_s",
+                labels={"recorder": "router_request"},
+                threshold=(serving_p99_s if fleet_p99_s is None
+                           else fleet_p99_s),
+                budget=0.01, windows=w, min_count=n(0.01)),
+        SloRule("fleet_hedge_rate", "serving_hedges",
+                labels={"outcome": "launched"}, kind="threshold",
+                field="delta", agg="rate", threshold=hedge_rate_per_s,
                 windows=((short_s, 1.0),)),
     ]

@@ -577,6 +577,25 @@ class HotEmbeddingTier:
             self._free[self._row_bank[r]].append(int(r))
         return len(rows)
 
+    def resident_keys(self) -> np.ndarray:
+        """[occupancy] u64 — every key currently resident, in row
+        order. The warm-handoff manifest (serving/fleet): a joining
+        serving replica bulk-ensures a PEER's resident set instead of
+        discovering it one cold miss at a time. A control-plane read
+        (host arrays only — no device I/O).
+
+        Concurrency: the tier is single-threaded by design (its owner
+        thread mutates ``_keys``/``_valid``); this read is the ONE
+        sanctioned cross-thread peek, and it is a BEST-EFFORT snapshot
+        — the mask is copied before the key gather, so a row evicted
+        or admitted mid-read yields at worst a stale or missing key in
+        the manifest. Both are harmless to the consumer: a stale key
+        bulk-admits one unused row on the joiner, a missed key is one
+        ordinary cold miss later. Do not use this for anything that
+        needs an exact set — quiesce the owner first."""
+        valid = self._valid.copy()
+        return self._keys[valid].copy()
+
     # -- observability ----------------------------------------------------
 
     def stats(self) -> Dict[str, Any]:

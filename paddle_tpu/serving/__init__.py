@@ -30,16 +30,31 @@ class (short deadlines, separate circuit breakers — ps/rpc.py
 stale-but-bounded data (``status()["since_last_apply_s"]`` exposes the
 blip) and re-attaches on the promoted primary's epoch.
 
-Operational guide: docs/OPERATIONS.md §12. Bench: tools/serving_bench.py
-(committed SERVING.json).
+The FLEET layer (ISSUE 15) turns one replica into a tier:
+:class:`~paddle_tpu.serving.router.ServingRouter` balances requests
+over N members (bounded-load consistent hashing on the sparse
+key-block for CachedLookup affinity, power-of-two-choices for
+dense-only traffic, p95-budget hedging with dedupe, failure reroute);
+:class:`~paddle_tpu.serving.fleet.ServingFleet` owns membership off
+the TTL observer leases (drain restarts, warm-handoff joins, the
+PR 11 autoscaler as the elasticity controller); and
+:class:`~paddle_tpu.serving.rollout.RolloutManager` makes a model push
+a routed event (canary band → promote → digest-pinned rollback).
+
+Operational guide: docs/OPERATIONS.md §12 (single replica), §17
+(fleet). Benches: tools/serving_bench.py (SERVING.json),
+tools/serving_fleet_bench.py (SERVING_FLEET.json).
 """
 
+from .fleet import FleetConfig, FleetController, FleetMember, ServingFleet
 from .frontend import (DeadlineExceeded, FrontendConfig, PendingResult,
                        RequestRejected, ServingFrontend)
 from .lookup import CachedLookup, ReplicaLookup
 from .metrics import FreshnessProbe, LatencyRecorder
 from .replica import (DenseTowerPublisher, DenseTowerSync, ServingReplica,
                       make_serve_client)
+from .rollout import DenseModel, RolloutConfig, RolloutManager
+from .router import RoutedRequest, RouterConfig, ServingRouter
 
 __all__ = [
     "ServingReplica",
@@ -55,4 +70,14 @@ __all__ = [
     "make_serve_client",
     "LatencyRecorder",
     "FreshnessProbe",
+    "ServingRouter",
+    "RouterConfig",
+    "RoutedRequest",
+    "ServingFleet",
+    "FleetConfig",
+    "FleetMember",
+    "FleetController",
+    "RolloutManager",
+    "RolloutConfig",
+    "DenseModel",
 ]

@@ -61,8 +61,15 @@ class FrontendConfig:
     queue_cap: int = 1024
     #: per-request deadline when submit() doesn't pass one
     default_deadline_ms: float = 50.0
-    #: retry-after hint stamped on shed requests
+    #: retry-after FLOOR for shed requests. The quoted hint is derived
+    #: from the measured queue drain rate (backlog ÷ requests-per-second
+    #: the worker is actually clearing) so a backlogged frontend quotes
+    #: a genuinely useful backoff — this floor is what an idle frontend
+    #: (or one that has not served a batch yet) answers
     retry_after_ms: float = 20.0
+    #: ceiling on the derived retry-after (a wedged worker must not
+    #: quote minutes)
+    retry_after_max_ms: float = 5000.0
     #: latency-recorder window (bounded observability state)
     latency_window: int = 4096
 
@@ -82,7 +89,7 @@ class DeadlineExceeded(RuntimeError):
 
 class _Request:
     __slots__ = ("keys", "dense", "deadline", "t_submit", "event", "value",
-                 "error")
+                 "error", "cb_mu", "cbs")
 
     def __init__(self, keys, dense, deadline) -> None:
         self.keys = keys
@@ -92,14 +99,29 @@ class _Request:
         self.event = threading.Event()
         self.value = None
         self.error: Optional[BaseException] = None
+        # completion callbacks (the router's hedge/retry scatter-back
+        # path) — registered under cb_mu so a callback added while the
+        # worker delivers fires exactly once
+        self.cb_mu = threading.Lock()
+        self.cbs: List[Callable] = []
+
+    def _finish(self) -> None:
+        self.event.set()
+        with self.cb_mu:
+            cbs, self.cbs = self.cbs, []
+        for cb in cbs:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — callback owns its errors
+                pass
 
     def deliver(self, value) -> None:
         self.value = value
-        self.event.set()
+        self._finish()
 
     def fail(self, err: BaseException) -> None:
         self.error = err
-        self.event.set()
+        self._finish()
 
 
 class PendingResult:
@@ -115,6 +137,33 @@ class PendingResult:
             raise self._req.error
         return self._req.value
 
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block up to ``timeout``; True once the result (or error) is
+        in. Unlike :meth:`result`, never raises — the router's hedge
+        path probes completion without consuming it."""
+        return self._req.event.wait(timeout)
+
+    def exception(self) -> Optional[BaseException]:
+        """The failure, if the request is done and failed (None while
+        pending or on success) — the non-raising twin of result()."""
+        return self._req.error if self._req.event.is_set() else None
+
+    def value(self):
+        """The delivered value (only meaningful once done() and
+        exception() is None)."""
+        return self._req.value
+
+    def add_done_callback(self, fn: Callable[[], None]) -> None:
+        """Run ``fn()`` when the request completes (delivered OR
+        failed); fires immediately if already done. Callbacks run on
+        the frontend worker thread — keep them cheap (the router's
+        scatter-back bookkeeping is the intended shape)."""
+        with self._req.cb_mu:
+            if not self._req.event.is_set():
+                self._req.cbs.append(fn)
+                return
+        fn()
+
     def done(self) -> bool:
         return self._req.event.is_set()
 
@@ -128,10 +177,16 @@ class ServingFrontend:
 
     def __init__(self, lookup, infer: Optional[Callable] = None,
                  config: Optional[FrontendConfig] = None,
-                 idle_pop_s: float = 0.02) -> None:
+                 idle_pop_s: float = 0.02,
+                 replica_label: str = "-") -> None:
         self.lookup = lookup
         self.infer = infer
         self.config = config or FrontendConfig()
+        #: per-replica identity on every obs family this frontend emits
+        #: (serving_latency_s / serving_frontend_events) — the fleet
+        #: router aggregates across these; cardinality stays bounded by
+        #: the registry's max_series overflow rule
+        self.replica_label = str(replica_label)
         #: worker's idle queue-pop timeout — bounds stop() latency and
         #: is constructor-injectable (uninjectable-clock lint contract;
         #: the batching cadence itself lives in FrontendConfig)
@@ -149,14 +204,25 @@ class ServingFrontend:
             "serving_frontend_events",
             ("accepted", "served", "shed", "deadline_dropped",
              "deadline_misses", "batches", "errors"),
-            max_series=1024, frontend=str(next(_FRONTEND_SEQ)))
+            max_series=1024, frontend=str(next(_FRONTEND_SEQ)),
+            replica=self.replica_label)
         #: end-to-end request latency (submit → result delivered)
         self.request_latency = LatencyRecorder(cfg.latency_window,
-                                               name="frontend_request")
+                                               name="frontend_request",
+                                               replica=self.replica_label)
         #: lookup+infer time per micro-batch (the compute floor the
         #: SERVING.json single-digit-ms acceptance names)
         self.serve_latency = LatencyRecorder(cfg.latency_window,
-                                             name="frontend_serve")
+                                             name="frontend_serve",
+                                             replica=self.replica_label)
+        #: measured drain rate (requests the worker cleared per second,
+        #: EWMA — guarded by _mu) feeding the shed retry-after hint
+        self._drain_rate = 0.0
+        self._last_batch_t: Optional[float] = None
+        #: worker-is-serving flag: drain ("finish in-flight") waits for
+        #: queue empty AND this clear (plain bool — single writer, the
+        #: worker; readers tolerate one-batch staleness)
+        self._busy = False
         self._stopping = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="serving-frontend")
@@ -193,13 +259,31 @@ class ServingFrontend:
                 self._q.put_nowait(req)
                 self.counters["accepted"] += 1
         except queue.Full:
+            hint = self.retry_after_hint_ms()
             with self._mu:
                 self.counters["shed"] += 1
             raise RequestRejected(
                 f"admission queue full ({cfg.queue_cap}) — retry after "
-                f"{cfg.retry_after_ms:.0f} ms",
-                retry_after_ms=cfg.retry_after_ms)
+                f"{hint:.0f} ms",
+                retry_after_ms=hint)
         return PendingResult(req)
+
+    def retry_after_hint_ms(self) -> float:
+        """Shed backoff derived from the measured queue drain rate:
+        time to clear the CURRENT backlog at the rate the worker is
+        actually serving, clamped to [retry_after_ms,
+        retry_after_max_ms]. An idle frontend (or one that has not
+        served a batch yet) quotes the floor — a backlogged one quotes
+        how long the backlog genuinely takes to drain, so shed clients
+        back off proportionally instead of hammering a constant."""
+        cfg = self.config
+        backlog = self._q.qsize()
+        with self._mu:
+            rate = self._drain_rate
+        if rate <= 0.0 or backlog <= 0:
+            return cfg.retry_after_ms
+        return float(min(max(cfg.retry_after_ms, 1e3 * backlog / rate),
+                         cfg.retry_after_max_ms))
 
     def __call__(self, keys, dense=None, deadline_ms=None,
                  timeout: float = 10.0):
@@ -217,17 +301,37 @@ class ServingFrontend:
                 if self._stopping.is_set():
                     return
                 continue
-            batch = [first]
-            coalesce_until = time.perf_counter() + cfg.max_delay_us / 1e6
-            while len(batch) < cfg.max_batch:
-                rem = coalesce_until - time.perf_counter()
-                if rem <= 0:
-                    break
-                try:
-                    batch.append(self._q.get(timeout=rem))
-                except queue.Empty:
-                    break
-            self._serve(batch)
+            self._busy = True
+            try:
+                batch = [first]
+                coalesce_until = time.perf_counter() + cfg.max_delay_us / 1e6
+                while len(batch) < cfg.max_batch:
+                    rem = coalesce_until - time.perf_counter()
+                    if rem <= 0:
+                        break
+                    try:
+                        batch.append(self._q.get(timeout=rem))
+                    except queue.Empty:
+                        break
+                self._serve(batch)
+                self._note_drained(len(batch))
+            finally:
+                self._busy = False
+
+    def _note_drained(self, n: int) -> None:
+        """EWMA the worker's clearing rate (requests/s) off the
+        inter-batch cadence — dropped-deadline requests count too, they
+        left the queue."""
+        now = time.perf_counter()
+        with self._mu:
+            if self._last_batch_t is not None:
+                dt = now - self._last_batch_t
+                if dt > 0:
+                    sample = n / dt
+                    self._drain_rate = (sample if self._drain_rate == 0.0
+                                        else 0.8 * self._drain_rate
+                                        + 0.2 * sample)
+            self._last_batch_t = now
 
     def _serve(self, batch: List[_Request]) -> None:
         now = time.perf_counter()
@@ -293,10 +397,28 @@ class ServingFrontend:
         self.request_latency.reset()
         self.serve_latency.reset()
 
+    @property
+    def queue_depth(self) -> int:
+        """Live admission-queue depth (the router's P2C load signal)."""
+        return self._q.qsize()
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopping.is_set()
+
+    def idle(self) -> bool:
+        """True when nothing is queued and the worker is between
+        batches — the fleet's draining-restart predicate ("finish
+        in-flight" is: stop admitting at the router, then wait for
+        this)."""
+        return self._q.qsize() == 0 and not self._busy
+
     def stats(self) -> Dict[str, Any]:
         with self._mu:
             out: Dict[str, Any] = dict(self.counters)
+            out["drain_rate_rps"] = round(self._drain_rate, 1)
         out["queue_depth"] = self._q.qsize()
+        out["retry_after_hint_ms"] = round(self.retry_after_hint_ms(), 1)
         out["request"] = self.request_latency.percentiles()
         out["serve_batch"] = self.serve_latency.percentiles()
         if out["batches"]:

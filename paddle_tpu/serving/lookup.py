@@ -96,6 +96,27 @@ class CachedLookup:
         self.refreshes += len(stale_keys)
         return dropped
 
+    def admit(self, keys: np.ndarray) -> int:
+        """Bulk-admit ``keys`` into the resident set and stamp them
+        fresh-as-of-NOW — the warm-handoff ingest path (serving/fleet):
+        a joining replica replays a peer's resident-set manifest in
+        big chunks through ONE fetch per chunk instead of paying the
+        per-request cold-miss storm. Stamping matters: rows admitted
+        through the raw tier would carry seq 0 and be invalidated as
+        stale on their first post-join lookup, refetching everything
+        the handoff just moved. Returns rows made resident."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        if len(keys) == 0:
+            return 0
+        now = time.perf_counter()
+        seq = self.replica.applied_seq if self.replica is not None else 0
+        pre = self.tier.device_map.lookup_host(keys)
+        rows = self.tier.ensure(keys, mark_dirty=False)
+        fresh = np.unique(rows[pre < 0])
+        self._row_seq[fresh] = seq
+        self._row_t[fresh] = now
+        return len(fresh)
+
     def lookup(self, keys: np.ndarray) -> np.ndarray:
         keys = np.ascontiguousarray(keys, np.uint64)
         now = time.perf_counter()

@@ -35,14 +35,20 @@ class LatencyRecorder:
     PR 7 tests and SERVING.json thresholds read."""
 
     def __init__(self, window: int = 4096,
-                 name: Optional[str] = None) -> None:
+                 name: Optional[str] = None,
+                 replica: str = "-") -> None:
         self._ring: deque = deque(maxlen=window)
         self._mu = threading.Lock()
         self.count = 0
+        # `replica` identifies which fleet member emitted the sample
+        # ("-" outside a fleet): the ISSUE 15 per-replica breakdown the
+        # router's SLO rules and the /metrics fleet view read.
+        # Cardinality is bounded by max_series (PR 8 overflow rule).
         self._hist = _obs_registry.REGISTRY.histogram(
             "serving_latency_s", max_series=1024,
             recorder=name if name is not None
-            else f"latency{next(_REC_SEQ)}")
+            else f"latency{next(_REC_SEQ)}",
+            replica=str(replica))
 
     def record(self, seconds: float) -> None:
         self._hist.observe(seconds)
@@ -85,18 +91,22 @@ class FreshnessProbe:
     """
 
     def __init__(self, window: int = 1024, timeout_s: float = 5.0,
-                 poll_s: float = 0.0005) -> None:
-        self.latency = LatencyRecorder(window, name="freshness")
+                 poll_s: float = 0.0005, replica: str = "-") -> None:
+        self.latency = LatencyRecorder(window, name="freshness",
+                                       replica=replica)
         self.timeout_s = timeout_s
         self.poll_s = poll_s
         self.failures = 0
         self.probes = 0
         # job-wide counters next to the latency histogram: a broken
-        # feed shows up in the aggregate, not only in local stats()
+        # feed shows up in the aggregate, not only in local stats() —
+        # labeled per replica so a fleet's one stale member is visible
         self._c_probes = _obs_registry.REGISTRY.counter(
-            "serving_freshness_probes", outcome="ok")
+            "serving_freshness_probes", max_series=1024, outcome="ok",
+            replica=str(replica))
         self._c_failures = _obs_registry.REGISTRY.counter(
-            "serving_freshness_probes", outcome="timeout")
+            "serving_freshness_probes", max_series=1024, outcome="timeout",
+            replica=str(replica))
 
     def measure(self, write, read, target) -> Optional[float]:
         """``write()`` publishes the marker (returns None); ``read()``

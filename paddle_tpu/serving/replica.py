@@ -191,6 +191,22 @@ class ServingReplica:
 
     # -- lifecycle ---------------------------------------------------------
 
+    def kill(self) -> None:
+        """Crash simulation (chaos harness): the server and watcher die
+        NOW but the lease is NOT released — it expires by TTL, exactly
+        what a SIGKILL'd replica looks like to the fleet's lease watch
+        and the primary's shipper."""
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._lease.stop()          # heartbeat dies; key expires by TTL
+        for cli in self._clients:
+            try:
+                cli.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        self.server.stop()
+        self.server.close()
+
     def stop(self) -> None:
         """Graceful detach: the observer lease is deleted NOW (the
         primary's shipper drops us on its next poll), then the server

@@ -1231,6 +1231,157 @@ def test_uninjectable_clock_reshard_and_autoscale_ship_clean():
         assert not diags, diags
 
 
+# -- pass 6b: control-loop rng injectability (uninjectable-rng) -------------
+
+_RNG_LOOP_BODY = """
+    import random
+    import threading
+
+    class Chooser:
+        def __init__(self{extra}, poll_s=0.1):
+            self._stop = threading.Event()
+            self._t = threading.Thread(target=self._loop, daemon=True,
+                                       name="chooser")
+
+        def _loop(self):
+            while not self._stop.is_set():
+                _ = random.{draw}
+"""
+
+
+def test_uninjectable_rng_flagged(tmp_path):
+    diags = _loop_diags(tmp_path,
+                        _RNG_LOOP_BODY.format(extra="", draw="random()"))
+    assert _rules(diags) == {"uninjectable-rng"}
+
+
+def test_uninjectable_rng_choice_flagged(tmp_path):
+    diags = _loop_diags(tmp_path, _RNG_LOOP_BODY.format(
+        extra="", draw="choice([1, 2])"))
+    assert _rules(diags) == {"uninjectable-rng"}
+
+
+def test_uninjectable_rng_rng_param_passes(tmp_path):
+    diags = _loop_diags(tmp_path,
+                        _RNG_LOOP_BODY.format(extra=", rng=None",
+                                              draw="random()"))
+    assert not diags
+
+
+def test_uninjectable_rng_seed_param_passes(tmp_path):
+    diags = _loop_diags(tmp_path,
+                        _RNG_LOOP_BODY.format(extra=", jitter_seed=0",
+                                              draw="random()"))
+    assert not diags
+
+
+def test_uninjectable_rng_np_random_flagged(tmp_path):
+    diags = _loop_diags(tmp_path, """
+        import threading
+        import numpy as np
+
+        class NpChooser:
+            def __init__(self, poll_s=0.1):
+                self._t = threading.Thread(target=self._loop, daemon=True,
+                                           name="np-chooser")
+
+            def _loop(self):
+                while True:
+                    _ = np.random.randint(0, 4)
+    """)
+    assert _rules(diags) == {"uninjectable-rng"}
+
+
+def test_uninjectable_rng_instance_rng_draw_passes(tmp_path):
+    # drawing from an INJECTED generator is exactly the sanctioned
+    # pattern — self._rng.choice is not a global draw
+    diags = _loop_diags(tmp_path, """
+        import random
+        import threading
+
+        class Seeded:
+            def __init__(self, rng=None, poll_s=0.1):
+                self._rng = rng or random.Random()
+                self._t = threading.Thread(target=self._loop, daemon=True,
+                                           name="seeded")
+
+            def _loop(self):
+                while True:
+                    _ = self._rng.choice([1, 2])
+    """)
+    assert not diags
+
+
+def test_uninjectable_rng_draw_outside_loop_passes(tmp_path):
+    # one-shot construction-time jitter (no thread target draws) is
+    # not a control-loop decision
+    diags = _loop_diags(tmp_path, """
+        import random
+        import threading
+
+        class JitterAtBirth:
+            def __init__(self, poll_s=0.1):
+                self.offset = random.random()
+                self._go = threading.Event()
+                self._t = threading.Thread(target=self._loop, daemon=True,
+                                           name="jab")
+
+            def _loop(self):
+                while True:
+                    self._go.wait()
+    """)
+    assert not diags
+
+
+def test_uninjectable_rng_helper_one_level_flagged(tmp_path):
+    diags = _loop_diags(tmp_path, """
+        import random
+        import threading
+
+        class Delegating:
+            def __init__(self, poll_s=0.1):
+                self._t = threading.Thread(target=self._loop, daemon=True,
+                                           name="d")
+
+            def _pick(self):
+                return random.randint(0, 3)
+
+            def _loop(self):
+                while True:
+                    self._pick()
+    """)
+    assert _rules(diags) == {"uninjectable-rng"}
+
+
+def test_uninjectable_rng_ignore_comment(tmp_path):
+    diags = _loop_diags(tmp_path, """
+        import random
+        import threading
+
+        class Chaos:  # graftlint: ignore[uninjectable-rng]
+            def __init__(self, poll_s=0.1):
+                self._t = threading.Thread(target=self._loop, daemon=True,
+                                           name="c")
+
+            def _loop(self):
+                while True:
+                    random.random()
+    """)
+    assert not diags
+
+
+def test_uninjectable_rng_router_ships_clean():
+    # the motivating classes pass the rule they motivated
+    import os as _os
+    from common import REPO_ROOT
+    for mod in ("paddle_tpu/serving/router.py",
+                "paddle_tpu/serving/fleet.py",
+                "paddle_tpu/serving/rollout.py"):
+        diags = control_loops.check_file(
+            _os.path.join(REPO_ROOT, mod), REPO_ROOT)
+        assert not diags, diags
+
+
 # ---------------------------------------------------------------------------
 # pass 7: Python lock discipline (py_locks)
 # ---------------------------------------------------------------------------

@@ -322,7 +322,10 @@ def test_frontend_admission_control_sheds_under_overload():
                                           deadline_ms=30000))
             except RequestRejected as e:
                 shed += 1
-                assert e.retry_after_ms == 7.0
+                # the configured value is the FLOOR; the quoted hint
+                # scales with the measured backlog/drain rate (ISSUE 15
+                # satellite — test_serving_fleet pins the derivation)
+                assert e.retry_after_ms >= 7.0
         assert shed > 0, "overload never shed"
         assert fe.stats()["shed"] == shed
         # everything ADMITTED completes (bounded queue drains; nothing

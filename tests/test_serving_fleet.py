@@ -1,0 +1,903 @@
+"""Serving fleet (ISSUE 15): router balancing/hedging/reroute, fleet
+membership + drain + warm handoff, autoscaler lever, and the versioned
+dense-tower rollout lifecycle.
+
+Layers, bottom-up: the ServingRouter's bounded-load consistent-hash
+affinity, P2C, hedge-with-dedupe and failure-reroute semantics (stub
+members — deterministic under injected rng/clock); the frontend's
+drain-rate-derived retry-after (satellite 1); fleet join/drain/crash
+over REAL replicas with the TTL-lease watch; warm handoff vs a cold
+join (the miss-storm comparison SERVING_FLEET.json curves); the PR 11
+Autoscaler driving replica count; canary/promote/rollback with exact
+split counting and digest-pinned rollback (satellite 3); per-replica
+metric labels + fleet SLO rules + the router-process /metrics view
+(satellite 4)."""
+
+import random
+import threading
+import time
+import urllib.request
+
+import numpy as np
+# eager: numpy.testing's lazy import forks (SVE probe) — deadlocks the
+# sanitizer sweeps once cluster threads are live (test_serving.py note)
+import numpy.testing  # noqa: F401
+import pytest
+
+from paddle_tpu.io.fs import crc32c
+from paddle_tpu.obs import registry as obs_registry
+from paddle_tpu.ps.accessor import AccessorConfig
+from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+from paddle_tpu.ps.table import TableConfig
+
+rpc = pytest.importorskip("paddle_tpu.ps.rpc")
+
+pytestmark = pytest.mark.skipif(
+    not rpc.rpc_available(), reason="native toolchain unavailable")
+
+from paddle_tpu.distributed import elastic  # noqa: E402
+from paddle_tpu.ps import ha  # noqa: E402
+from paddle_tpu.ps.autoscale import AutoscaleConfig, Autoscaler  # noqa: E402
+from paddle_tpu.ps.hot_tier import (HotEmbeddingTier,  # noqa: E402
+                                    HotTierConfig)
+from paddle_tpu.serving import (CachedLookup, DenseModel,  # noqa: E402
+                                FleetConfig, FleetMember, FrontendConfig,
+                                RequestRejected, RolloutConfig,
+                                RolloutManager, RouterConfig, RoutedRequest,
+                                ServingFleet, ServingFrontend,
+                                ServingReplica, ServingRouter)
+from paddle_tpu.serving.router import _splitmix64  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# stub plumbing (router-only tests: no cluster, no RPC)
+# ---------------------------------------------------------------------------
+
+class _StubLookup:
+    def __init__(self, delay_s=0.0, tag=0.0):
+        self.delay_s = delay_s
+        self.tag = tag
+        self.calls = 0
+
+    def lookup(self, keys):
+        self.calls += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        k = keys.astype(np.float64)
+        return np.stack([k, k + self.tag], axis=1).astype(np.float32)
+
+
+class _FakeReplicaHandle:
+    """Replica-shaped stub for FleetMember lifecycle tests."""
+
+    class _Srv:
+        stopped = False
+
+    def __init__(self, endpoint):
+        self.endpoint = endpoint
+        self.server = self._Srv()
+
+    def status(self):
+        return {"endpoint": self.endpoint}
+
+    def close(self):
+        self.server.stopped = True
+
+    def kill(self):
+        self.server.stopped = True
+
+
+class _StubMember:
+    """Router-protocol member over a real frontend + stub lookup."""
+
+    def __init__(self, name, delay_s=0.0, tag=0.5, model=None, **fe_kw):
+        self.endpoint = name
+        self.lookup = _StubLookup(delay_s, tag)
+        fe_kw.setdefault("max_batch", 8)
+        fe_kw.setdefault("max_delay_us", 100)
+        fe_kw.setdefault("queue_cap", 256)
+        self.frontend = ServingFrontend(self.lookup,
+                                        config=FrontendConfig(**fe_kw),
+                                        replica_label=name)
+        self.model = model
+
+    @property
+    def healthy(self):
+        return not self.frontend.stopped
+
+    def stop(self):
+        self.frontend.stop()
+
+
+def _router(**kw):
+    kw.setdefault("rng", random.Random(0))
+    cfg = kw.pop("config", None) or RouterConfig()
+    return ServingRouter(cfg, **kw)
+
+
+def _keys_for_block(block, shift=6, n=8):
+    base = block << shift
+    return np.arange(base, base + n, dtype=np.uint64)
+
+
+# ---------------------------------------------------------------------------
+# router: affinity, bounded load, P2C, hedging, reroute
+# ---------------------------------------------------------------------------
+
+def test_ch_affinity_same_block_same_member_blocks_spread():
+    members = [_StubMember(f"m{i}") for i in range(3)]
+    with _router() as r:
+        for m in members:
+            r.attach(m)
+        try:
+            # same block → same member, every time (CachedLookup
+            # residency is per-member; affinity IS the warm hit rate)
+            picks = set()
+            for _ in range(8):
+                rr = r.submit(_keys_for_block(5), deadline_ms=5000)
+                rr.result(10)
+                picks.add(rr.tried[0])
+            assert len(picks) == 1
+            # distinct blocks cover the whole fleet
+            eps = set()
+            for b in range(48):
+                rr = r.submit(_keys_for_block(b), deadline_ms=5000)
+                rr.result(10)
+                eps.add(rr.tried[0])
+            assert eps == {m.endpoint for m in members}
+            st = r.stats()
+            assert st["sparse_ch"] == 8 + 48
+            assert st["errors"] == 0 and st["reroutes"] == 0
+        finally:
+            for m in members:
+                m.stop()
+
+
+def test_bounded_load_diverts_overloaded_member():
+    members = [_StubMember(f"m{i}") for i in range(3)]
+    with _router() as r:
+        for m in members:
+            r.attach(m)
+        try:
+            rr = r.submit(_keys_for_block(5), deadline_ms=5000)
+            rr.result(10)
+            home = rr.tried[0]
+            # saturate the home member's in-flight ledger: the CH walk
+            # must skip past it to the NEXT ring choice
+            with r._mu:
+                r._members[home].inflight = 100
+            rr2 = r.submit(_keys_for_block(5), deadline_ms=5000)
+            rr2.result(10)
+            assert rr2.tried[0] != home
+            with r._mu:
+                r._members[home].inflight = 0
+            rr3 = r.submit(_keys_for_block(5), deadline_ms=5000)
+            rr3.result(10)
+            assert rr3.tried[0] == home     # load gone → affinity back
+        finally:
+            for m in members:
+                m.stop()
+
+
+def test_p2c_dense_prefers_shallower_queue():
+    # m0's worker is wedged on a slow batch with a backlog queued; P2C
+    # (seeded rng) must steer non-affinity traffic to m1
+    m0 = _StubMember("m0", delay_s=0.2, max_batch=1, max_delay_us=10)
+    m1 = _StubMember("m1")
+    with _router() as r:
+        r.attach(m0)
+        r.attach(m1)
+        try:
+            backlog = [m0.frontend.submit(_keys_for_block(1),
+                                          deadline_ms=30000)
+                       for _ in range(8)]
+            picks = []
+            for _ in range(12):
+                rr = r.submit(_keys_for_block(2), deadline_ms=30000,
+                              affinity=False)
+                rr.result(30)
+                picks.append(rr.tried[0])
+            assert picks.count("m1") > picks.count("m0"), picks
+            assert r.stats()["dense_p2c"] == 12
+            for p in backlog:
+                p.result(30)
+        finally:
+            m0.stop()
+            m1.stop()
+
+
+def test_hedge_fires_after_budget_dedupes_and_meters():
+    slow = _StubMember("slow", delay_s=0.4, tag=100.0)
+    fast = _StubMember("fast", tag=0.5)
+    cfg = RouterConfig(hedge_default_ms=20.0, hedge_min_samples=1 << 30)
+    with _router(config=cfg) as r:
+        r.attach(slow)
+        r.attach(fast)
+        try:
+            # find a block whose first choice is the slow member
+            block = next(b for b in range(64) if r._pick(
+                RoutedRequest(r, None, None, 1e4, b, "-")).endpoint
+                == "slow")
+            t0 = time.perf_counter()
+            rr = r.submit(_keys_for_block(block), deadline_ms=10000)
+            out = rr.result(10)
+            dt = time.perf_counter() - t0
+            # the hedge (fast member) answered: its tag, well under the
+            # slow member's 400 ms
+            assert np.allclose(out[:, 1] - out[:, 0], 0.5)
+            assert dt < 0.35, dt
+            assert rr.tried == ["slow", "fast"]
+            st = r.stats()
+            assert st["hedges"] == 1 and st["hedge_wins"] == 1
+            # the loser completes later and is deduped, not delivered
+            deadline = time.monotonic() + 5
+            while r.stats()["hedge_lost"] < 1:
+                assert time.monotonic() < deadline, r.stats()
+                time.sleep(0.02)
+            assert st["errors"] == 0
+        finally:
+            slow.stop()
+            fast.stop()
+
+
+def test_failure_reroutes_and_ejects_dead_member():
+    members = [_StubMember(f"m{i}") for i in range(3)]
+    with _router() as r:
+        for m in members:
+            r.attach(m)
+        try:
+            rr = r.submit(_keys_for_block(7), deadline_ms=5000)
+            rr.result(10)
+            home = rr.tried[0]
+            # SIGKILL-shaped: the frontend dies; queued+new submits fail
+            next(m for m in members if m.endpoint == home).stop()
+            for _ in range(4):
+                out = r.submit(_keys_for_block(7),
+                               deadline_ms=5000).result(10)
+                assert out.shape == (8, 2)
+            st = r.stats()
+            assert st["reroutes"] >= 1
+            assert st["errors"] == 0
+            assert home not in r.endpoints()      # ejected on failure
+            # no members at all → immediate, honest rejection
+            for m in members:
+                m.stop()
+            for ep in [m.endpoint for m in members]:
+                r.remove(ep)
+            with pytest.raises(RequestRejected, match="no live"):
+                r.submit(_keys_for_block(1), deadline_ms=1000)
+        finally:
+            for m in members:
+                m.stop()
+
+
+def test_ring_hash_is_process_stable():
+    # ring placement must not ride PYTHONHASHSEED (a salted hash routes
+    # the same block to different members in different processes) —
+    # golden values pin the cross-process contract
+    from paddle_tpu.serving.router import _stable_str_hash
+
+    assert _stable_str_hash("127.0.0.1:7001") == 17876159239217230246
+    assert _stable_str_hash("127.0.0.1:7002") == 15823385287752048255
+    assert _stable_str_hash("") == _stable_str_hash("")
+
+
+def test_failure_with_hedge_outstanding_waits_for_sibling():
+    """A failed sub must not finalize the request while its hedge is
+    still in flight — the hedge may (and here does) deliver the
+    answer."""
+
+    class _FailingLookup:
+        def lookup(self, keys):
+            time.sleep(0.1)
+            raise RuntimeError("replica storage gone")
+
+    bad = _StubMember("bad")
+    bad.lookup = None  # replaced below via frontend
+    bad = _StubMember.__new__(_StubMember)
+    bad.endpoint = "bad"
+    bad.lookup = _FailingLookup()
+    bad.frontend = ServingFrontend(bad.lookup, config=FrontendConfig(
+        max_batch=8, max_delay_us=100, queue_cap=64))
+    bad.model = None
+    slow_ok = _StubMember("slow-ok", delay_s=0.3, tag=0.5)
+    cfg = RouterConfig(hedge_default_ms=20.0, hedge_min_samples=1 << 30,
+                       max_attempts=2)
+    with _router(config=cfg) as r:
+        r.attach(bad)
+        r.attach(slow_ok)
+        try:
+            block = next(b for b in range(64) if r._pick(
+                RoutedRequest(r, None, None, 1e4, b, "-")).endpoint
+                == "bad")
+            rr = r.submit(_keys_for_block(block), deadline_ms=10000)
+            # timeline: hedge to slow-ok at ~20 ms; bad FAILS at
+            # ~100 ms (no attempts left, but the hedge is outstanding);
+            # slow-ok delivers at ~300 ms — the caller must get it
+            out = rr.result(10)
+            assert np.allclose(out[:, 1] - out[:, 0], 0.5)
+            assert r.stats()["errors"] == 0
+        finally:
+            bad.frontend.stop()
+            slow_ok.stop()
+
+
+def test_drain_marker_blocks_watcher_readmission():
+    """tick() must not re-admit a healthy, leased member that drain()
+    deliberately ejected (the drain-vs-watcher race)."""
+    store = elastic.MemoryStore()
+    sm = _StubMember("dr1")
+    member = FleetMember(_FakeReplicaHandle("dr1"), sm.lookup, sm.frontend)
+    router = _router()
+    fleet = ServingFleet(store, "dr-job", lambda: member, router)
+    try:
+        with fleet._mu:
+            fleet._members["dr1"] = member
+            fleet._join_order.append("dr1")
+        router.attach(member)
+        store.put("ps/dr-job/obs/0/dr1", "{}", ttl=30.0)  # leased
+        router.eject("dr1")
+        fleet.tick()
+        # healthy + leased + unrouted ⇒ the watcher re-admits (the
+        # transient-error heal path)
+        assert "dr1" in router.endpoints()
+        router.eject("dr1")
+        with fleet._mu:
+            fleet._draining.add("dr1")
+        fleet.tick()
+        assert "dr1" not in router.endpoints()   # drain owns the eject
+    finally:
+        sm.stop()
+        fleet.stop()
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: retry-after from measured drain rate
+# ---------------------------------------------------------------------------
+
+def test_retry_after_derived_from_drain_rate():
+    idle = _StubMember("idle")
+    slow = _StubMember("busy", delay_s=0.02, max_batch=1, max_delay_us=10,
+                       queue_cap=64)
+    try:
+        # idle: no backlog → the config floor
+        assert idle.frontend.retry_after_hint_ms() == \
+            idle.frontend.config.retry_after_ms
+        # measure a drain rate (a few served batches), then pile a
+        # backlog: the quoted backoff must scale with backlog/rate
+        for _ in range(4):
+            slow.frontend.submit(_keys_for_block(0),
+                                 deadline_ms=30000).result(30)
+        backlog = [slow.frontend.submit(_keys_for_block(0),
+                                        deadline_ms=30000)
+                   for _ in range(40)]
+        hint = slow.frontend.retry_after_hint_ms()
+        assert hint > idle.frontend.retry_after_hint_ms()
+        assert hint > 100.0, hint       # 40 queued at ~50/s ≈ 800 ms
+        assert hint <= slow.frontend.config.retry_after_max_ms
+        # a shed request carries the measured hint, not the constant
+        shed_hint = None
+        try:
+            for _ in range(80):
+                backlog.append(slow.frontend.submit(
+                    _keys_for_block(0), deadline_ms=30000))
+        except RequestRejected as e:
+            shed_hint = e.retry_after_ms
+        assert shed_hint is not None and shed_hint > 100.0, shed_hint
+        for p in backlog:
+            p.result(60)
+    finally:
+        idle.stop()
+        slow.stop()
+
+
+# ---------------------------------------------------------------------------
+# real-cluster plumbing
+# ---------------------------------------------------------------------------
+
+def _acc(dim=4):
+    return AccessorConfig(embedx_dim=dim, embedx_threshold=0.0,
+                          sgd=SGDRuleConfig(initial_range=0.01))
+
+
+def _cfg(dim=4):
+    return TableConfig(shard_num=4, accessor_config=_acc(dim))
+
+
+def _push(rng, keys, width):
+    push = np.zeros((len(keys), width), np.float32)
+    push[:, 1] = 1.0
+    push[:, 2:] = rng.normal(0, 0.1, (len(keys), width - 2)).astype(
+        np.float32)
+    return push
+
+
+def _cluster(**kw):
+    kw.setdefault("num_shards", 1)
+    kw.setdefault("replication", 1)
+    kw.setdefault("sync", True)
+    return ha.HACluster(**kw)
+
+
+def _wait_caught_up(cluster, serve_cli, table_id=0, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while True:
+        prim = cluster.primary(0)
+        dg_p = cluster.digests(table_id, 0).get(prim.endpoint)
+        dg_r = serve_cli.digest(table_id)[0]
+        if dg_p is not None and dg_p == dg_r:
+            return
+        assert time.monotonic() < deadline, "replica never caught up"
+        time.sleep(0.02)
+
+
+def _member_factory(cluster, table_cfg, capacity=1 << 11, model_flat=None,
+                    unravel=None):
+    """Real fleet member: replica (fast lease), caught-up serve view,
+    read-only tier + CachedLookup, frontend labeled by endpoint."""
+
+    def build():
+        rep = ServingReplica(cluster.store, cluster.job_id, shard=0,
+                             hb_interval=0.05, hb_ttl=0.4)
+        serve = rep.client()
+        view = rep.serve_view(0, table_cfg, client=serve)
+        _wait_caught_up(cluster, serve)
+        tier = HotEmbeddingTier(view, HotTierConfig(
+            capacity=capacity, create_on_miss=False))
+        cl = CachedLookup(tier, replica=rep, freshness_budget_s=30.0)
+        model = None
+        if model_flat is not None:
+            model = DenseModel(unravel or (lambda f: f), model_flat)
+        fe = ServingFrontend(cl, config=FrontendConfig(
+            max_batch=16, max_delay_us=200, queue_cap=512,
+            default_deadline_ms=5000.0), replica_label=rep.endpoint)
+        return FleetMember(rep, cl, fe, model=model)
+
+    return build
+
+
+def _preload(cli, keys, rng):
+    cli.create_sparse_table(0, _cfg())
+    cli.pull_sparse(0, keys)
+    width = cli._dims(0)[1]
+    cli.push_sparse(0, keys, _push(rng, keys, width))
+    return width
+
+
+# ---------------------------------------------------------------------------
+# fleet: join / drain / crash-by-lease / warm handoff
+# ---------------------------------------------------------------------------
+
+def test_fleet_join_drain_and_crash_lease_removal():
+    with _cluster() as cluster:
+        cli = cluster.client()
+        rng = np.random.default_rng(0)
+        keys = np.arange(512, dtype=np.uint64)
+        _preload(cli, keys, rng)
+        router = _router()
+        fleet = ServingFleet(cluster.store, cluster.job_id,
+                             _member_factory(cluster, _cfg()), router,
+                             config=FleetConfig(poll_s=0.05))
+        try:
+            m1, m2 = fleet.add(2, warm=False)
+            assert fleet.size() == 2
+            assert set(router.endpoints()) == {m1.endpoint, m2.endpoint}
+            # traffic lands across the fleet, zero errors
+            for b in range(8):
+                out = router.submit(keys[b * 64:b * 64 + 8],
+                                    deadline_ms=5000).result(10)
+                assert out.shape == (8, 5)
+            # draining restart: eject → finish in-flight → lease gone
+            assert fleet.drain(m1.endpoint)
+            assert fleet.size() == 1
+            assert m1.endpoint not in router.endpoints()
+            assert m1.endpoint not in fleet._leased_endpoints()
+            # requests keep flowing through the survivor
+            out = router.submit(keys[:8], deadline_ms=5000).result(10)
+            assert out.shape == (8, 5)
+            # crash: lease expires by TTL; the watch removes the member
+            m2.crash()
+            deadline = time.monotonic() + 10
+            while fleet.members(live_only=False):
+                fleet.tick()
+                assert time.monotonic() < deadline, "crash never expired"
+                time.sleep(0.05)
+            assert m2.endpoint not in router.endpoints(live_only=False)
+            assert fleet.counters["crashes_removed"] == 1
+            # the fleet recovers by joining a fresh member
+            fleet.add(1, warm=False)
+            out = router.submit(keys[:8], deadline_ms=5000).result(10)
+            assert out.shape == (8, 5)
+        finally:
+            fleet.stop()
+            router.stop()
+
+
+def test_warm_handoff_beats_cold_join():
+    with _cluster() as cluster:
+        cli = cluster.client()
+        rng = np.random.default_rng(1)
+        keys = np.arange(1024, dtype=np.uint64)
+        _preload(cli, keys, rng)
+        router = _router()
+        fleet = ServingFleet(cluster.store, cluster.job_id,
+                             _member_factory(cluster, _cfg()), router,
+                             config=FleetConfig(poll_s=0.05,
+                                                warm_chunk=256))
+        try:
+            (seed,) = fleet.add(1, warm=False)
+            # season the peer: its resident set IS the working set
+            for lo in range(0, len(keys), 64):
+                seed.lookup.lookup(keys[lo:lo + 64])
+            occ = seed.lookup.tier.stats()["occupancy"]
+            assert occ >= len(keys)
+            # WARM join: the peer's manifest is bulk-admitted
+            (warm,) = fleet.add(1, warm=True)
+            handoff = fleet.events[-1]["handoff"]
+            assert handoff is not None and handoff["rows"] >= len(keys)
+            warm_miss0 = warm.lookup.tier.counters["misses"]
+            for lo in range(0, len(keys), 64):
+                warm.lookup.lookup(keys[lo:lo + 64])
+            warm_misses = warm.lookup.tier.counters["misses"] - warm_miss0
+            # COLD join: every row is a serving-path miss
+            (cold,) = fleet.add(1, warm=False)
+            cold_miss0 = cold.lookup.tier.counters["misses"]
+            for lo in range(0, len(keys), 64):
+                cold.lookup.lookup(keys[lo:lo + 64])
+            cold_misses = cold.lookup.tier.counters["misses"] - cold_miss0
+            assert warm_misses == 0, warm_misses
+            assert cold_misses >= len(keys)
+            assert warm_misses < cold_misses
+            # the handoff rows were stamped fresh: values match the
+            # cold-join (feed-converged) reads bit-for-bit
+            np.testing.assert_array_equal(warm.lookup.lookup(keys[:64]),
+                                          cold.lookup.lookup(keys[:64]))
+        finally:
+            fleet.stop()
+            router.stop()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler lever: PR 11 hysteresis, replica count as the actuator
+# ---------------------------------------------------------------------------
+
+class _Alert:
+    def __init__(self, rule):
+        self.rule = rule
+
+
+def test_autoscaler_drives_replica_count():
+    store = elastic.MemoryStore()
+
+    def stub_factory():
+        name = f"as-m{next(_SEQ)}"
+        sm = _StubMember(name)
+        rep = _FakeReplicaHandle(name)
+        member = FleetMember(rep, sm.lookup, sm.frontend)
+        return member
+
+    router = _router()
+    fleet = ServingFleet(store, "as-job", stub_factory, router,
+                         config=FleetConfig(min_replicas=2,
+                                            max_replicas=8))
+    t = [0.0]
+    scaler = Autoscaler(fleet.controller(), config=AutoscaleConfig(
+        min_shards=2, max_shards=8,
+        up_rules=("fleet_serving_p99", "serving_p99"),
+        cooldown_up_s=5.0, cooldown_down_s=10.0, clear_hold_s=4.0),
+        clock=lambda: t[0])
+    try:
+        fleet.add(2, warm=False)
+        assert scaler.step() is None                  # quiet
+        scaler.notify_fire(_Alert("fleet_serving_p99"))
+        assert scaler.step() == "up" and fleet.size() == 4
+        assert scaler.events[-1]["kind"] == "scale"
+        t[0] = 2.0
+        assert scaler.step() is None                  # up-cooldown holds
+        scaler.notify_clear(_Alert("fleet_serving_p99"))
+        t[0] = 4.0
+        assert scaler.step() is None                  # quiet-hold not met
+        t[0] = 20.0
+        assert scaler.step() == "down" and fleet.size() == 2
+        # journal landed in the serving namespace of the elastic store
+        assert store.list_prefix("ps/as-job/serving/scale/")
+    finally:
+        fleet.stop()
+        router.stop()
+
+
+_SEQ = iter(range(1, 1 << 20))
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: dense-version lifecycle (canary → promote → rollback)
+# ---------------------------------------------------------------------------
+
+def _model_member(name, dim=16):
+    holder = {}
+    flat = np.arange(dim, dtype=np.float32)
+    model = DenseModel(lambda f: f, flat, version=1,
+                       sink=lambda p: holder.__setitem__("p", p))
+    m = _StubMember(name, model=model)
+    m.holder = holder
+    return m
+
+
+def test_canary_split_exact_counted_per_version():
+    members = [_model_member(f"c{i}") for i in range(4)]
+    with _router() as r:
+        for m in members:
+            r.attach(m)
+        try:
+            mgr = RolloutManager(lambda: members, r,
+                                 RolloutConfig(canary_members=1))
+            v1 = mgr.register_baseline(np.arange(16, dtype=np.float32))
+            v2 = mgr.begin_canary(np.arange(16, dtype=np.float32) + 1.0,
+                                  fraction=0.3)
+            canary_eps = {ep for ep in r.stats()["canary"]["endpoints"]}
+            assert len(canary_eps) == 1
+            blocks = list(range(400))
+            expect_canary = sum(r.in_canary_band(b, 0.3) for b in blocks)
+            assert 0 < expect_canary < len(blocks)   # a real split
+            for b in blocks:
+                rr = r.submit(_keys_for_block(b), deadline_ms=5000)
+                rr.result(10)
+                # the routed member matches the band side, exactly
+                assert (rr.tried[0] in canary_eps) == \
+                    r.in_canary_band(b, 0.3)
+            counts = r.stats()["version_counts"]
+            assert counts == {str(v2): expect_canary,
+                              str(v1): len(blocks) - expect_canary}
+        finally:
+            for m in members:
+                m.stop()
+
+
+def test_promote_flips_fleet_rollback_digest_identical():
+    members = [_model_member(f"p{i}") for i in range(3)]
+    with _router() as r:
+        for m in members:
+            r.attach(m)
+        try:
+            mgr = RolloutManager(lambda: members, r)
+            flat1 = np.arange(16, dtype=np.float32)
+            flat2 = flat1 + 2.0
+            dg1 = crc32c(np.ascontiguousarray(flat1).tobytes())
+            dg2 = crc32c(np.ascontiguousarray(flat2).tobytes())
+            v1 = mgr.register_baseline(flat1)
+            for m in members:
+                m.model.set(v1, flat1)
+            v2 = mgr.begin_canary(flat2, fraction=0.34)
+            vers = mgr.fleet_versions()
+            assert sorted(v for v, _ in vers.values()) == [v1, v1, v2]
+            # promotion flips EVERY member to v2
+            assert mgr.promote() == v2
+            assert set(mgr.fleet_versions().values()) == {(v2, dg2)}
+            assert mgr.canary_open() is None
+            # the promoted params actually reached the live sinks
+            for m in members:
+                np.testing.assert_array_equal(m.holder["p"], flat2)
+            # one-epoch rollback: v1 restored BIT-identical everywhere,
+            # digest-pinned at load time
+            assert mgr.rollback() == v1
+            assert set(mgr.fleet_versions().values()) == {(v1, dg1)}
+            for m in members:
+                np.testing.assert_array_equal(m.holder["p"], flat1)
+            assert mgr.version_digest(v1) == dg1
+        finally:
+            for m in members:
+                m.stop()
+
+
+def test_canary_requires_registered_baseline():
+    from paddle_tpu.core.enforce import PreconditionNotMetError
+
+    members = [_model_member(f"nb{i}") for i in range(2)]
+    with _router() as r:
+        for m in members:
+            r.attach(m)
+        try:
+            mgr = RolloutManager(lambda: members, r)
+            # no baseline: the rollback target would be unpinned — the
+            # canary must refuse up front, not KeyError at rollback
+            # time (possibly on the watchdog's auto-rollback thread)
+            with pytest.raises(PreconditionNotMetError,
+                               match="register_baseline"):
+                mgr.begin_canary(np.ones(8, np.float32))
+            v1 = mgr.register_baseline(np.zeros(8, np.float32))
+            for m in members:
+                m.model.set(v1, np.zeros(8, np.float32))
+            mgr.begin_canary(np.ones(8, np.float32))
+            # assignments are already consistent mid-canary: a fleet
+            # tick heals nothing (the set-before-load ordering)
+            assert mgr.assert_assignments() == 0
+        finally:
+            for m in members:
+                m.stop()
+
+
+def test_version_store_never_evicts_live_baseline():
+    """keep_versions churn must not evict the CURRENT version: a
+    baseline plus keep_versions aborted canary cycles used to pop the
+    rollback target and KeyError on the watchdog's auto-rollback."""
+    members = [_model_member(f"ev{i}") for i in range(2)]
+    with _router() as r:
+        for m in members:
+            r.attach(m)
+        try:
+            mgr = RolloutManager(lambda: members, r)
+            flat1 = np.zeros(8, np.float32)
+            v1 = mgr.register_baseline(flat1)
+            dg1 = mgr.version_digest(v1)
+            for m in members:
+                m.model.set(v1, flat1)
+            for cycle in range(mgr.config.keep_versions + 2):
+                mgr.begin_canary(np.full(8, cycle + 1.0, np.float32))
+                mgr.rollback(reason="aborted")     # was KeyError here
+            assert mgr.current == v1
+            assert mgr.version_digest(v1) == dg1
+            assert set(mgr.fleet_versions().values()) == {(v1, dg1)}
+        finally:
+            for m in members:
+                m.stop()
+
+
+def test_auto_rollback_on_fired_alert():
+    members = [_model_member(f"g{i}") for i in range(2)]
+    with _router() as r:
+        for m in members:
+            r.attach(m)
+        try:
+            mgr = RolloutManager(lambda: members, r)
+            v1 = mgr.register_baseline(np.zeros(8, np.float32))
+            for m in members:
+                m.model.set(v1, np.zeros(8, np.float32))
+            mgr.begin_canary(np.ones(8, np.float32))
+            assert mgr.canary_open() is not None
+            # a non-guard rule does nothing
+            mgr._on_alert(_Alert("checkpoint_staleness"))
+            assert mgr.canary_open() is not None
+            # a guard rule rolls the canary back
+            mgr._on_alert(_Alert("fleet_serving_p99"))
+            assert mgr.canary_open() is None
+            assert mgr.current == v1
+            assert set(v for v, _ in mgr.fleet_versions().values()) == {v1}
+            assert mgr.events[-1]["reason"] == \
+                "slo_alert:fleet_serving_p99"
+        finally:
+            for m in members:
+                m.stop()
+
+
+def test_reattached_replica_rejoins_at_correct_version():
+    """PR 7 epoch fence: kill the primary, the replica re-attaches on
+    the promoted epoch (its dense table re-synced by the new primary's
+    snapshot may have rewritten the live tower); the fleet tick's
+    assignment heal re-pins the member to the ASSIGNED version,
+    digest-checked."""
+    with _cluster(replication=2) as cluster:
+        cli = cluster.client()
+        rng = np.random.default_rng(3)
+        keys = np.arange(256, dtype=np.uint64)
+        _preload(cli, keys, rng)
+        flat1 = np.arange(8, dtype=np.float32)
+        flat2 = flat1 + 5.0
+        router = _router()
+        fleet = ServingFleet(cluster.store, cluster.job_id,
+                             _member_factory(cluster, _cfg(),
+                                             model_flat=flat1), router,
+                             config=FleetConfig(poll_s=0.05))
+        try:
+            m1, m2 = fleet.add(2, warm=False)
+            mgr = RolloutManager(lambda: fleet.members(), router)
+            fleet.rollout = mgr
+            mgr.register_baseline(flat1)
+            mgr.begin_canary(flat2, fraction=0.5)
+            v2 = mgr.promote()
+            dg2 = mgr.version_digest(v2)
+            assert set(mgr.fleet_versions().values()) == {(v2, dg2)}
+            # kill the primary mid-fleet; both replicas must survive the
+            # promotion and re-attach on the new epoch
+            prim = cluster.primary(0)
+            epochs0 = {m.endpoint: m.replica.status()["epoch"]
+                       for m in (m1, m2)}
+            prim.server.arm_fault("kill-shard", cmd=rpc._PUSH_SPARSE,
+                                  after=2)
+            width = cli._dims(0)[1]
+            for _ in range(4):
+                cli.push_sparse(0, keys[:32], _push(rng, keys[:32], width))
+                time.sleep(0.02)
+            cluster.wait_promoted(0, prim.endpoint)
+            deadline = time.monotonic() + 15
+            for m in (m1, m2):
+                while m.replica.status()["epoch"] <= \
+                        epochs0[m.endpoint]:
+                    assert time.monotonic() < deadline, \
+                        "replica never re-attached on the new epoch"
+                    time.sleep(0.05)
+            # the re-attach rewrote one member's live tower (the dense
+            # snapshot carries the FEED's values, not the rollout's)
+            m1.model.set(1, flat1)
+            assert mgr.fleet_versions()[m1.endpoint][0] != v2
+            healed = fleet.tick()["healed"]
+            assert healed == 1
+            # back at the assigned version, digest-identical, fleet-wide
+            assert set(mgr.fleet_versions().values()) == {(v2, dg2)}
+            # and the fleet still serves through the promoted feed
+            out = router.submit(keys[:8], deadline_ms=5000).result(10)
+            assert out.shape == (8, 5)
+        finally:
+            fleet.stop()
+            router.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite 4: per-replica labels, fleet SLO rules, router /metrics view
+# ---------------------------------------------------------------------------
+
+def test_per_replica_labels_and_fleet_slo_rules():
+    from paddle_tpu.obs import slo
+
+    m = _StubMember("127.0.0.1:9999")
+    try:
+        m.frontend.submit(_keys_for_block(0), deadline_ms=5000).result(10)
+        snap = obs_registry.REGISTRY.snapshot()
+        lat = snap["metrics"]["serving_latency_s"]["series"]
+        assert any(s["labels"].get("replica") == "127.0.0.1:9999"
+                   and s["labels"].get("recorder") == "frontend_request"
+                   for s in lat)
+        adm = snap["metrics"]["serving_frontend_events"]["series"]
+        assert any(s["labels"].get("replica") == "127.0.0.1:9999"
+                   for s in adm)
+    finally:
+        m.stop()
+    rules = {r.name: r for r in slo.default_rules()}
+    assert "fleet_serving_p99" in rules and "fleet_hedge_rate" in rules
+    assert rules["fleet_serving_p99"].labels == \
+        {"recorder": "router_request"}
+    assert rules["fleet_hedge_rate"].family == "serving_hedges"
+
+
+def test_router_process_metrics_carries_fleet_view():
+    from paddle_tpu.obs.exporter import ObsExporter, parse_openmetrics
+
+    members = [_StubMember(f"127.0.0.1:{7000 + i}") for i in range(2)]
+    with _router() as r:
+        for mm in members:
+            r.attach(mm)
+        exp = ObsExporter(lambda: obs_registry.REGISTRY.snapshot()).start()
+        try:
+            for b in range(32):
+                r.submit(_keys_for_block(b), deadline_ms=5000).result(10)
+            with urllib.request.urlopen(exp.url + "/metrics",
+                                        timeout=10) as resp:
+                text = resp.read().decode()
+            fams = parse_openmetrics(text)
+            # the fleet view: size gauge, router events, per-replica
+            # latency series — one scrape of the ROUTER process
+            assert "serving_fleet_size" in fams
+            assert "serving_router_events" in fams
+            lat = [lbl for n, lbl, v in
+                   fams["serving_latency_s"]["samples"]
+                   if lbl.get("recorder") == "router_member"]
+            assert {lbl["replica"] for lbl in lat} >= \
+                {m.endpoint for m in members}
+        finally:
+            exp.stop()
+            for mm in members:
+                mm.stop()
+
+
+# ---------------------------------------------------------------------------
+# fleet member protocol sanity over stub handles
+# ---------------------------------------------------------------------------
+
+def test_fleet_member_lifecycle_with_stub_handles():
+    sm = _StubMember("h1")
+    rep = _FakeReplicaHandle("h1")
+    member = FleetMember(rep, sm.lookup, sm.frontend)
+    assert member.healthy
+    assert member.resident_keys().size == 0     # non-cached lookup
+    member.stop()
+    assert not member.healthy and rep.server.stopped

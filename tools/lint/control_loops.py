@@ -1,4 +1,4 @@
-"""graftlint pass 6: control-loop timing injectability.
+"""graftlint pass 6: control-loop timing + randomness injectability.
 
   uninjectable-clock  a class that runs its own CONTROL LOOP — it
                    constructs a ``threading.Thread`` whose ``target``
@@ -17,6 +17,24 @@
                    ``sleep=time.sleep``) — the way Sampler(period_s),
                    Lease(interval), CircuitBreaker(clock) and
                    ReshardController(clock, sleep) already do.
+
+  uninjectable-rng  the same control-loop shape drawing from the
+                   PROCESS-GLOBAL rng (``random.random()``/
+                   ``random.choice``/… or ``np.random.*``) with no
+                   rng/seed injection point in ``__init__``. A routing
+                   or retry decision made from global randomness on a
+                   background thread cannot be replayed: the test
+                   cannot seed it without seeding the whole process
+                   (racing every other draw), so "which member did the
+                   router pick" becomes unassertable — the serving
+                   router's P2C/hedge choices are the motivating case.
+                   Take ``rng=random.Random()`` (or a ``seed=``) in the
+                   constructor and draw from it, the way
+                   HARouter(jitter_seed) and ServingRouter(rng) do.
+                   Module-level draws outside a thread loop (bench
+                   setup, one-shot jitter at construction) are fine —
+                   the rule fires only where a loop's DECISIONS hide
+                   behind global state.
 
 An ``__init__`` parameter counts as a timing injection point when its
 name is one of the CLOCK names (clock, sleep, sleep_fn, now, now_fn,
@@ -47,6 +65,7 @@ from common import (Diagnostic, dotted, line_ignores,  # noqa: E402
                     relpath, walk_py)
 
 RULE = "uninjectable-clock"
+RULE_RNG = "uninjectable-rng"
 
 _CLOCK_PARAM_NAMES = {"clock", "sleep", "sleep_fn", "sleep_s", "now",
                       "now_fn", "timer", "tick"}
@@ -56,15 +75,44 @@ _CADENCE_FRAGMENTS = ("interval", "period", "poll", "timeout", "ttl",
 
 _TIME_FUNCS = {"sleep", "monotonic", "perf_counter", "time"}
 
+_RNG_PARAM_NAMES = {"rng", "seed", "random", "rand", "generator"}
+_RNG_FRAGMENTS = ("rng", "seed")
+
+#: stdlib `random` module draws (global-state; `random.Random(...)`
+#: CONSTRUCTION is not a draw and is excluded below)
+_RANDOM_FUNCS = {"random", "randint", "randrange", "choice", "choices",
+                 "shuffle", "sample", "uniform", "gauss", "normalvariate",
+                 "expovariate", "betavariate", "triangular", "getrandbits",
+                 "randbytes"}
+#: numpy legacy global-state draws (np.random.<f>); default_rng(...) is
+#: a constructor, not a draw
+_NP_RANDOM_FUNCS = {"rand", "randn", "randint", "random", "random_sample",
+                    "choice", "shuffle", "permutation", "uniform", "normal",
+                    "standard_normal", "exponential", "beta", "binomial",
+                    "poisson"}
+
+
+def _init_params(init: ast.FunctionDef):
+    return list(init.args.posonlyargs) + list(init.args.args) + \
+        list(init.args.kwonlyargs)
+
 
 def _init_injects_timing(init: ast.FunctionDef) -> bool:
-    args = list(init.args.posonlyargs) + list(init.args.args) + \
-        list(init.args.kwonlyargs)
-    for a in args:
+    for a in _init_params(init):
         name = a.arg.lower()
         if name in _CLOCK_PARAM_NAMES:
             return True
         if any(frag in name for frag in _CADENCE_FRAGMENTS):
+            return True
+    return False
+
+
+def _init_injects_rng(init: ast.FunctionDef) -> bool:
+    for a in _init_params(init):
+        name = a.arg.lower()
+        if name in _RNG_PARAM_NAMES:
+            return True
+        if any(frag in name for frag in _RNG_FRAGMENTS):
             return True
     return False
 
@@ -106,17 +154,36 @@ def _timing_call(node: ast.Call, time_aliases: Set[str],
     return False
 
 
+def _rng_call(node: ast.Call, random_aliases: Set[str],
+              numpy_aliases: Set[str], npr_aliases: Set[str],
+              bare_random_funcs: Set[str]) -> bool:
+    name = dotted(node.func)
+    if name in bare_random_funcs:
+        return True
+    if name and "." in name:
+        mod, _, attr = name.rpartition(".")
+        if mod in random_aliases and attr in _RANDOM_FUNCS:
+            return True
+        if attr in _NP_RANDOM_FUNCS:
+            if mod in npr_aliases:
+                return True
+            parts = mod.split(".")
+            if len(parts) == 2 and parts[0] in numpy_aliases \
+                    and parts[1] == "random":
+                return True
+    return False
+
+
 def _method_map(cls: ast.ClassDef) -> Dict[str, ast.FunctionDef]:
     return {n.name: n for n in cls.body
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
 
 
-def _loop_reads_time(target: ast.FunctionDef,
+def _loop_first_call(target: ast.FunctionDef,
                      methods: Dict[str, ast.FunctionDef],
-                     time_aliases: Set[str],
-                     bare_time_funcs: Set[str]) -> Optional[ast.Call]:
-    """The first timing call in the thread target or one level of its
-    ``self._helper()`` callees."""
+                     pred) -> Optional[ast.Call]:
+    """The first call matching ``pred`` in the thread target or one
+    level of its ``self._helper()`` callees."""
     scopes = [target]
     for node in ast.walk(target):
         if isinstance(node, ast.Call) and isinstance(node.func,
@@ -127,8 +194,7 @@ def _loop_reads_time(target: ast.FunctionDef,
             scopes.append(methods[node.func.attr])
     for scope in scopes:
         for node in ast.walk(scope):
-            if isinstance(node, ast.Call) and _timing_call(
-                    node, time_aliases, bare_time_funcs):
+            if isinstance(node, ast.Call) and pred(node):
                 return node
     return None
 
@@ -146,16 +212,36 @@ def check_file(path: str, root: str) -> List[Diagnostic]:
 
     time_aliases = {"time"}
     bare_time_funcs: Set[str] = set()
+    random_aliases: Set[str] = set()
+    numpy_aliases: Set[str] = set()
+    npr_aliases: Set[str] = set()
+    bare_random_funcs: Set[str] = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
                 if a.name == "time":
                     time_aliases.add(a.asname or "time")
+                elif a.name == "random":
+                    random_aliases.add(a.asname or "random")
+                elif a.name == "numpy":
+                    numpy_aliases.add(a.asname or "numpy")
+                elif a.name == "numpy.random":
+                    npr_aliases.add(a.asname or "numpy.random")
         elif isinstance(node, ast.ImportFrom):
-            if node.module == "time" and not node.level:
+            if node.level:
+                continue
+            if node.module == "time":
                 for a in node.names:
                     if a.name in _TIME_FUNCS:
                         bare_time_funcs.add(a.asname or a.name)
+            elif node.module == "random":
+                for a in node.names:
+                    if a.name in _RANDOM_FUNCS:
+                        bare_random_funcs.add(a.asname or a.name)
+            elif node.module == "numpy":
+                for a in node.names:
+                    if a.name == "random":
+                        npr_aliases.add(a.asname or "random")
 
     for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
         targets = _self_thread_targets(cls)
@@ -163,29 +249,47 @@ def check_file(path: str, root: str) -> List[Diagnostic]:
             continue
         methods = _method_map(cls)
         init = methods.get("__init__")
-        if init is not None and _init_injects_timing(init):
-            continue
-        for mname in sorted(targets):
-            m = methods.get(mname)
-            if m is None:
-                continue
-            hit = _loop_reads_time(m, methods, time_aliases,
-                                   bare_time_funcs)
-            if hit is None:
-                continue
-            if RULE in line_ignores(lines, cls.lineno):
-                continue
-            diags.append(Diagnostic(
-                rel, cls.lineno, RULE,
-                f"`{cls.name}` runs a thread control loop "
-                f"(`{mname}` sleeps/reads the clock at line "
-                f"{hit.lineno}) but __init__ exposes no timing "
-                "injection point — deterministic tests are impossible; "
+        checks = []
+        if init is None or not _init_injects_timing(init):
+            checks.append((
+                RULE,
+                lambda m: _loop_first_call(
+                    m, methods,
+                    lambda c: _timing_call(c, time_aliases,
+                                           bare_time_funcs)),
+                "sleeps/reads the clock",
                 "take the cadence (period_s=/poll_s=/…) or the "
                 "clock/sleep callables as constructor parameters "
-                "(the Sampler/Lease/CircuitBreaker pattern), or "
-                "justify with an ignore/allowlist entry"))
-            break  # one diagnostic per class
+                "(the Sampler/Lease/CircuitBreaker pattern)"))
+        if init is None or not _init_injects_rng(init):
+            checks.append((
+                RULE_RNG,
+                lambda m: _loop_first_call(
+                    m, methods,
+                    lambda c: _rng_call(c, random_aliases, numpy_aliases,
+                                        npr_aliases, bare_random_funcs)),
+                "draws from the process-global rng",
+                "take rng=random.Random()/a seed= as a constructor "
+                "parameter and draw from it (the HARouter(jitter_seed)/"
+                "ServingRouter(rng) pattern)"))
+        for rule, finder, what, fix in checks:
+            for mname in sorted(targets):
+                m = methods.get(mname)
+                if m is None:
+                    continue
+                hit = finder(m)
+                if hit is None:
+                    continue
+                if rule in line_ignores(lines, cls.lineno):
+                    break
+                diags.append(Diagnostic(
+                    rel, cls.lineno, rule,
+                    f"`{cls.name}` runs a thread control loop "
+                    f"(`{mname}` {what} at line {hit.lineno}) but "
+                    f"__init__ exposes no injection point — "
+                    f"deterministic tests are impossible; {fix}, or "
+                    "justify with an ignore/allowlist entry"))
+                break  # one diagnostic per class per rule
     return diags
 
 
