@@ -1,7 +1,13 @@
 #!/usr/bin/env bash
 # CI gate (reference L0's cmake+ctest role): graftlint, native build,
 # fast test gate, then the full matrix.
-# Usage: ./ci.sh [lint [--changed]|fast|full|chaos|ckpt|hot_tier|serving|serving_fleet|obs|slo|reshard]
+# Usage: ./ci.sh [lint [--changed]|sched|fast|full|chaos|ckpt|hot_tier|serving|serving_fleet|obs|slo|reshard]
+#   sched — graftsched gate: deterministic-schedule exploration of the
+#   control-plane protocol harnesses (tools/sched/models.py) — the
+#   preemption-bound-2 schedule space EXHAUSTED plus seeded random
+#   walks, every failure replayable from the printed seed, dynamic
+#   lock-order observations cross-checked against the py_locks decls.
+#   The JSON summary is archived like the lint one (SCHED_JSON).
 #   chaos — PS high-availability fast-gate: every failover/replication
 #   test with faultpoints armed (incl. the slow e2e kill-shard runs)
 #   plus the chaos_ps demo with its recovery/overhead acceptance checks.
@@ -50,7 +56,7 @@ cd "$(dirname "$0")"
 # noisy pass is visible in the log; run.py itself warns past the 10 s
 # soft budget. `./ci.sh lint --changed` lints only files changed vs
 # merge-base(HEAD, origin/main) — the sub-second pre-commit loop.
-echo "== graftlint (8 passes: tracer/hot-path/locks-cc/locks-py/wire/conv/obs/loops) =="
+echo "== graftlint (9 passes: tracer/hot-path/locks-cc/locks-py/wire/conv/obs/loops/sync-shim) =="
 LINT_JSON=${LINT_JSON:-/tmp/ci_lint_summary.json}
 # --changed is a lint-mode-only knob: the full gates must always lint
 # the whole tree (staleness + cross-module reachability need it)
@@ -76,6 +82,26 @@ fi
 
 echo "== native build =="
 make -C paddle_tpu/csrc -s
+
+if [[ "${1:-fast}" == "sched" ]]; then
+  echo "== graftsched (schedule exploration: 3 protocol harnesses) =="
+  # ~20k schedules in well under a minute on the CI host; the 240 s
+  # budget is the wedge guard, not the expected cost. SCHED_SEED pins
+  # the random-walk base seed for a bisection; every failure prints its
+  # own standalone replay seed regardless.
+  SCHED_JSON=${SCHED_JSON:-/tmp/ci_sched_summary.json}
+  python tools/sched/run.py --json "$SCHED_JSON" --budget-s 240 \
+    ${SCHED_SEED:+--seed "$SCHED_SEED"}
+  python - "$SCHED_JSON" <<'PYEOF'
+import json, sys
+s = json.load(open(sys.argv[1]))
+print("sched summary archived -> %s  (%d schedules, %.1fs)" % (
+    sys.argv[1], s.get("total_schedules", 0),
+    s.get("wall_ms", 0) / 1000.0))
+PYEOF
+  echo "CI OK (sched)"
+  exit 0
+fi
 
 if [[ "${1:-fast}" == "chaos" ]]; then
   echo "== chaos gate: PS HA failover/replication (faultpoints armed) =="
@@ -525,6 +551,21 @@ print('bench degradation ladder OK')"
   # first lazy `np.testing` import runs an lscpu subprocess — the whole
   # sweep wedged there, 0% CPU). BLAS parallelism buys nothing under a
   # 10-20x sanitizer anyway.
+  # shim pass-through smoke FIRST: under the sanitizer the sync shim
+  # must hand back raw threading primitives (scheduler uninstalled) so
+  # TSAN instruments the real locks — a shim that wrapped them in
+  # Python objects would mask every native-level report below
+  LD_PRELOAD="$(gcc -print-file-name=libtsan.so)" OPENBLAS_NUM_THREADS=1 \
+    TSAN_OPTIONS="suppressions=$PWD/paddle_tpu/csrc/tsan.supp,halt_on_error=0,exitcode=0,log_path=/tmp/ci_tsan_report" \
+    python -c "
+import queue, threading
+from paddle_tpu.core import sync as _sync
+assert _sync.current_scheduler() is None
+assert isinstance(_sync.Lock(), type(threading.Lock()))
+assert isinstance(_sync.Condition(), threading.Condition)
+assert isinstance(_sync.Queue(maxsize=2), queue.Queue)
+t = _sync.Thread(target=lambda: None, name='shim-smoke'); t.start(); t.join()
+print('sync shim pass-through OK (sanitizer sees raw primitives)')"
   LD_PRELOAD="$(gcc -print-file-name=libtsan.so)" OPENBLAS_NUM_THREADS=1 \
     TSAN_OPTIONS="suppressions=$PWD/paddle_tpu/csrc/tsan.supp,halt_on_error=0,exitcode=0,log_path=/tmp/ci_tsan_report" \
     python -m pytest tests/test_table_concurrency.py tests/test_ssd_table.py \
@@ -550,6 +591,17 @@ print('bench degradation ladder OK')"
   rm -f /tmp/ci_asan_report*
   LD_PRELOAD="$(gcc -print-file-name=libasan.so)" OPENBLAS_NUM_THREADS=1 \
     ASAN_OPTIONS="detect_leaks=0,halt_on_error=0,exitcode=0,log_path=/tmp/ci_asan_report" \
+    python -c "
+import queue, threading
+from paddle_tpu.core import sync as _sync
+assert _sync.current_scheduler() is None
+assert isinstance(_sync.Lock(), type(threading.Lock()))
+assert isinstance(_sync.Condition(), threading.Condition)
+assert isinstance(_sync.Queue(maxsize=2), queue.Queue)
+t = _sync.Thread(target=lambda: None, name='shim-smoke'); t.start(); t.join()
+print('sync shim pass-through OK (sanitizer sees raw primitives)')"
+  LD_PRELOAD="$(gcc -print-file-name=libasan.so)" OPENBLAS_NUM_THREADS=1 \
+    ASAN_OPTIONS="detect_leaks=0,halt_on_error=0,exitcode=0,log_path=/tmp/ci_asan_report" \
     python -m pytest tests/test_table_concurrency.py tests/test_ssd_table.py \
       tests/test_native_table.py tests/test_ps_rpc.py \
       tests/test_rpc_robustness.py tests/test_dist_graph.py \
@@ -570,6 +622,17 @@ print('bench degradation ladder OK')"
   # LD_PRELOAD; halt_on_error=0 collects every report into the log
   make -C paddle_tpu/csrc SANITIZE=undefined -s
   rm -f /tmp/ci_ubsan_report*
+  OPENBLAS_NUM_THREADS=1 \
+    UBSAN_OPTIONS="print_stacktrace=1,halt_on_error=0,log_path=/tmp/ci_ubsan_report" \
+    python -c "
+import queue, threading
+from paddle_tpu.core import sync as _sync
+assert _sync.current_scheduler() is None
+assert isinstance(_sync.Lock(), type(threading.Lock()))
+assert isinstance(_sync.Condition(), threading.Condition)
+assert isinstance(_sync.Queue(maxsize=2), queue.Queue)
+t = _sync.Thread(target=lambda: None, name='shim-smoke'); t.start(); t.join()
+print('sync shim pass-through OK (sanitizer sees raw primitives)')"
   OPENBLAS_NUM_THREADS=1 \
     UBSAN_OPTIONS="print_stacktrace=1,halt_on_error=0,log_path=/tmp/ci_ubsan_report" \
     python -m pytest tests/test_table_concurrency.py tests/test_ssd_table.py \

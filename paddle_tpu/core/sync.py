@@ -1,0 +1,111 @@
+"""Instrumentable synchronization layer (the graftsched shim).
+
+Every threading module in the tree constructs its primitives through
+these factories instead of calling ``threading.Lock()`` /
+``queue.Queue()`` directly (graftlint pass 9, ``raw-sync``, enforces
+this).  In production the factories are zero-cost pass-throughs: one
+module-global ``is None`` check at CONSTRUCTION time, then the caller
+holds a raw ``threading`` / ``queue`` object — no wrapper, no
+indirection on the acquire/release hot path, and nothing that masks
+TSAN (the sanitizer sweeps smoke-test exactly this, see ci.sh).
+
+Under the deterministic concurrency explorer
+(:mod:`paddle_tpu.testing.sched`) a scheduler is installed first and
+the same factories return *controlled* primitives: every operation on
+them is a scheduling point, so the explorer can serialize all threads
+onto one runnable-set and enumerate interleavings.  The contract is
+construction-time binding: install the scheduler BEFORE constructing
+the objects under test (primitives built earlier stay raw and
+invisible to the explorer — that is a harness bug, not a feature).
+
+The optional ``name=`` keyword names a lock for the DYNAMIC lock-order
+checker; unnamed locks are adopted by attribute name via
+``Scheduler.name_locks(obj)``, matching the static pass's
+(py_locks) final-attribute-segment convention.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading as _threading
+from typing import Any, Optional
+
+__all__ = [
+    "Lock", "RLock", "Condition", "Event", "Semaphore", "Queue", "Thread",
+    "install_scheduler", "uninstall_scheduler", "current_scheduler",
+]
+
+#: the installed controlled scheduler, or None (production). Module
+#: global on purpose: the pass-through cost is one load + is-None test
+#: per CONSTRUCTION, nothing per operation.
+_scheduler: Optional[Any] = None
+
+
+def install_scheduler(sched: Any) -> None:
+    """Route subsequent constructions to ``sched`` (test harness only).
+
+    ``sched`` provides ``make_lock/make_rlock/make_condition/make_event/
+    make_semaphore/make_queue/make_thread`` — duck-typed so this module
+    never imports the explorer (production import graph stays clean).
+    """
+    global _scheduler
+    _scheduler = sched
+
+
+def uninstall_scheduler() -> None:
+    global _scheduler
+    _scheduler = None
+
+
+def current_scheduler() -> Optional[Any]:
+    return _scheduler
+
+
+# -- factories ---------------------------------------------------------------
+#
+# Signatures mirror the stdlib ones plus the optional ``name=``; the
+# production path IGNORES name (raw objects carry no metadata) so the
+# shim stays a pure pass-through.
+
+def Lock(name: Optional[str] = None):
+    if _scheduler is None:
+        return _threading.Lock()
+    return _scheduler.make_lock(name)
+
+
+def RLock(name: Optional[str] = None):
+    if _scheduler is None:
+        return _threading.RLock()
+    return _scheduler.make_rlock(name)
+
+
+def Condition(lock=None, name: Optional[str] = None):
+    if _scheduler is None:
+        return _threading.Condition(lock)
+    return _scheduler.make_condition(lock, name)
+
+
+def Event(name: Optional[str] = None):
+    if _scheduler is None:
+        return _threading.Event()
+    return _scheduler.make_event(name)
+
+
+def Semaphore(value: int = 1, name: Optional[str] = None):
+    if _scheduler is None:
+        return _threading.Semaphore(value)
+    return _scheduler.make_semaphore(value, name)
+
+
+def Queue(maxsize: int = 0, name: Optional[str] = None):
+    if _scheduler is None:
+        return _queue.Queue(maxsize=maxsize)
+    return _scheduler.make_queue(maxsize, name)
+
+
+def Thread(target=None, name: Optional[str] = None, args=(), kwargs=None,
+           daemon: Optional[bool] = None):
+    if _scheduler is None:
+        return _threading.Thread(target=target, name=name, args=args,
+                                 kwargs=kwargs or {}, daemon=daemon)
+    return _scheduler.make_thread(target, name, args, kwargs or {}, daemon)

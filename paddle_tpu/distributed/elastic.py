@@ -26,6 +26,8 @@ import time
 import urllib.parse
 from typing import Callable, Dict, List, Optional
 
+from ..core import sync as _sync
+
 __all__ = ["ElasticStatus", "ElasticManager", "MemoryStore", "FileStore",
            "TcpElasticStore", "store_from_spec", "Lease",
            "set_desired_np", "desired_np_key"]
@@ -59,7 +61,7 @@ class MemoryStore:
 
     def __init__(self) -> None:
         self._d: Dict[str, tuple] = {}
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock()
 
     def put(self, key: str, value: str, ttl: float = 0.0) -> None:
         with self._lock:
@@ -181,7 +183,7 @@ class Lease:
         self.value = value
         self.ttl = ttl
         self.interval = interval if interval is not None else ttl / 3.0
-        self._stop = threading.Event()
+        self._stop = _sync.Event()
         self._thread: Optional[threading.Thread] = None
 
     def refresh(self, value: Optional[str] = None) -> None:
@@ -191,7 +193,7 @@ class Lease:
 
     def start(self) -> "Lease":
         self.refresh()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
+        self._thread = _sync.Thread(target=self._loop, daemon=True,
                                         name=f"lease:{self.key}")
         self._thread.start()
         return self
@@ -262,7 +264,7 @@ class ElasticManager:
         self._hb_ttl = heartbeat_ttl
         self._timeout = elastic_timeout
         self._prefix = f"elastic/{job_id}/nodes/"
-        self._stop = threading.Event()
+        self._stop = _sync.Event()
         self._thread: Optional[threading.Thread] = None
         self._last_change = time.monotonic()
         self._known: List[str] = []
@@ -271,7 +273,7 @@ class ElasticManager:
 
     def start(self) -> None:
         self._beat()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
+        self._thread = _sync.Thread(target=self._loop, daemon=True,
                                         name="lease-heartbeat")
         self._thread.start()
 

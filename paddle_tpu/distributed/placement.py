@@ -50,6 +50,7 @@ import threading
 from collections import deque
 from typing import List, Optional
 
+from ..core import sync as _sync
 from ..core.enforce import PreconditionNotMetError, enforce
 
 __all__ = [
@@ -204,7 +205,7 @@ class PlacementManager:
         self.placement = "ps"
         #: trainer-local residence while on the collective plane
         self.local_table = None
-        self._mu = threading.Lock()
+        self._mu = _sync.Lock()
         self._armed: Optional[str] = None
         self._armed_at_fence = 0
         self._fence_gen = 0

@@ -64,6 +64,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
+from ..core import sync as _sync
 from ..core.enforce import (NotFoundError, PreconditionNotMetError, enforce)
 from ..core.flags import define_flag, flag
 from ..obs import registry as _obs_registry
@@ -234,7 +235,7 @@ class JobCheckpointManager:
         self.gate = gate  # context manager (ha.CheckpointGate) or None
         os.makedirs(root, exist_ok=True)
         self._tables: Dict[str, Any] = {}
-        self._wq: "queue.Queue[_Snapshot]" = queue.Queue(
+        self._wq: "queue.Queue[_Snapshot]" = _sync.Queue(
             maxsize=(queue_depth if queue_depth is not None
                      else int(flag("job_ckpt_queue_depth"))))
         # two locks with disjoint concerns: _mu orders lifecycle
@@ -246,10 +247,10 @@ class JobCheckpointManager:
         # (condition on _mu) is what keeps the put-vs-shutdown-sentinel
         # ordering instead (blocking-under-lock lint rule).
         # LOCK LEAF: _mu _err_mu
-        self._mu = threading.Lock()
+        self._mu = _sync.Lock()
         self._inflight = 0                      # accepted, put not landed
-        self._quiesced = threading.Condition(self._mu)
-        self._err_mu = threading.Lock()
+        self._quiesced = _sync.Condition(self._mu)
+        self._err_mu = _sync.Lock()
         self._error: Optional[BaseException] = None
         self._thread: Optional[threading.Thread] = None
         self._stopped = False
@@ -353,7 +354,7 @@ class JobCheckpointManager:
 
     def _ensure_writer(self) -> None:
         if self._thread is None or not self._thread.is_alive():
-            self._thread = threading.Thread(
+            self._thread = _sync.Thread(
                 target=self._writer_loop, daemon=True, name="job-ckpt-writer")
             self._thread.start()
 

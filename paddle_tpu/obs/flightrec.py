@@ -46,6 +46,7 @@ import threading
 from collections import deque
 from typing import Any, Dict, List, Optional, Set
 
+from ..core import sync as _sync
 from . import registry as _registry
 from . import trace as _trace
 from .trace import wall_s
@@ -85,7 +86,7 @@ class FlightRecorder:
                         else set(dump_on))
         self.keep = int(keep)
         self.min_interval_s = float(min_interval_s)
-        self._mu = threading.Lock()
+        self._mu = _sync.Lock()
         self._events: deque = deque(maxlen=int(tail_events))
         self._last_dump_t = float("-inf")
         self._dumping = False

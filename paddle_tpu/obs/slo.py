@@ -44,6 +44,7 @@ import threading
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..core import sync as _sync
 from . import flightrec as _flightrec
 from . import registry as _registry
 from .timeseries import MetricRing, Sampler
@@ -158,9 +159,9 @@ class SloWatchdog:
         self.rules: List[SloRule] = []
         self._handles: Dict[str, Tuple[Any, Any]] = {}
         self._active: Dict[str, Alert] = {}
-        self._mu = threading.Lock()
+        self._mu = _sync.Lock()
         self._log: deque = deque(maxlen=int(log_cap))
-        self._stop = threading.Event()
+        self._stop = _sync.Event()
         self._thread: Optional[threading.Thread] = None
         #: own-thread evaluation cadence (start() default) — a
         #: constructor knob, not a buried literal (injectable-clock
@@ -285,7 +286,7 @@ class SloWatchdog:
                 while not self._stop.wait(period):
                     self.evaluate()
 
-            self._thread = threading.Thread(target=loop, daemon=True,
+            self._thread = _sync.Thread(target=loop, daemon=True,
                                             name="slo-watchdog")
             self._thread.start()
         return self

@@ -43,6 +43,7 @@ import time
 from collections import deque
 from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple)
 
+from ..core import sync as _sync
 from . import registry as _registry
 from .trace import wall_s
 
@@ -107,7 +108,7 @@ class MetricRing:
     """
 
     def __init__(self, capacity: int = 512) -> None:
-        self._mu = threading.Lock()
+        self._mu = _sync.Lock()
         self._ring: deque = deque(maxlen=int(capacity))
         # previous ABSOLUTE values per (family, labels): scalar for
         # counters, (count, sum, buckets) for histograms
@@ -336,7 +337,7 @@ class Sampler:
         self._probes: List[Callable[[], None]] = []
         self._listeners: List[Callable[[float], None]] = []
         self._name = name
-        self._stop = threading.Event()
+        self._stop = _sync.Event()
         self._thread: Optional[threading.Thread] = None
         # self-metrics: pre-bound (cold path), so the sampler's own
         # health is a curve too
@@ -396,7 +397,7 @@ class Sampler:
     def start(self) -> "Sampler":
         if self._thread is None:
             self._stop.clear()
-            self._thread = threading.Thread(target=self._loop, daemon=True,
+            self._thread = _sync.Thread(target=self._loop, daemon=True,
                                             name=self._name)
             self._thread.start()
         return self
@@ -432,7 +433,7 @@ class JobCollector(Sampler):
         self.extra = list(extra)
         self.shard_errors = 0
         self._latest: Optional[Dict[str, Any]] = None
-        self._latest_mu = threading.Lock()
+        self._latest_mu = _sync.Lock()
 
     def _collect(self) -> Dict[str, Any]:
         from . import aggregate

@@ -52,6 +52,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..core import sync as _sync
 from ..distributed import elastic as _elastic
 from ..obs import registry as _obs_registry
 from ..obs import trace as _obs_trace
@@ -105,15 +106,15 @@ class Autoscaler:
         self.ring = ring
         self._clock = clock
         self.poll_s = float(poll_s)
-        self._mu = threading.Lock()
+        self._mu = _sync.Lock()
         self._active_up: set = set()
         now = clock()
         #: when the up-rule set last became (or started) empty — the
         #: quiet-hold clock; None while an up-rule is active
         self._quiet_since: Optional[float] = now
         self._last_scale_t: Optional[float] = None
-        self._wake = threading.Event()
-        self._stop = threading.Event()
+        self._wake = _sync.Event()
+        self._stop = _sync.Event()
         self._thread: Optional[threading.Thread] = None
         #: decision journal (executed, refused-at-bound, failed)
         self.events: deque = deque(maxlen=512)
@@ -246,7 +247,7 @@ class Autoscaler:
     def start(self) -> "Autoscaler":
         if self._thread is None:
             self._stop.clear()
-            self._thread = threading.Thread(target=self._loop, daemon=True,
+            self._thread = _sync.Thread(target=self._loop, daemon=True,
                                             name="ps-autoscaler")
             self._thread.start()
         return self

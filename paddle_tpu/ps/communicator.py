@@ -32,6 +32,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..core import sync as _sync
 from ..core.flags import define_flag, flag
 from ..obs import registry as _obs_registry
 from ..obs import trace as _trace
@@ -84,7 +85,7 @@ class _BaseCommunicator:
         self._queues: Dict[int, "queue.Queue"] = {}
         self._running = False
         self._thread: Optional[threading.Thread] = None
-        self._drained = threading.Event()
+        self._drained = _sync.Event()
         self._drained.set()
         # a push that dies on the background thread must not vanish: the
         # error is stored and re-raised at the next barrier()/stop() —
@@ -98,7 +99,7 @@ class _BaseCommunicator:
         # must drain these too (a HalfAsync join means "no PS traffic
         # from me is outstanding", pulls included)
         self._pull_pool: Optional[ThreadPoolExecutor] = None
-        self._pull_mu = threading.Lock()
+        self._pull_mu = _sync.Lock()
         self._inflight_pulls: set = set()
         # obs (pre-bound, cold path): merged-push throughput counters +
         # the send-queue depth gauge — the sampler turns these into the
@@ -205,7 +206,7 @@ class _BaseCommunicator:
 
     def _queue_for(self, table_id: int) -> "queue.Queue":
         if table_id not in self._queues:
-            self._queues[table_id] = queue.Queue(maxsize=self.config.send_queue_size)
+            self._queues[table_id] = _sync.Queue(maxsize=self.config.send_queue_size)
         return self._queues[table_id]
 
     # -- lifecycle --------------------------------------------------------
@@ -214,7 +215,7 @@ class _BaseCommunicator:
         if self._running:
             return
         self._running = True
-        self._thread = threading.Thread(target=self._main_loop, daemon=True,
+        self._thread = _sync.Thread(target=self._main_loop, daemon=True,
                                         name="communicator-main")
         self._thread.start()
 
@@ -399,7 +400,7 @@ class GeoCommunicator(_BaseCommunicator):
         self.geo_step = geo_step
         self._send_count = 0
         self._pending: Dict[int, List[Tuple[np.ndarray, np.ndarray]]] = {}
-        self._lock = threading.Lock()
+        self._lock = _sync.Lock()
 
     def send_sparse_delta(self, table_id: int, keys: np.ndarray, delta: np.ndarray) -> None:
         """delta: local_param - last_synced_param rows for ``keys``."""

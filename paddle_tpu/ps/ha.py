@@ -56,19 +56,21 @@ import json
 import random
 import struct
 # lock discipline (tools/lint/py_locks.py; docs/STATIC_ANALYSIS.md):
-# every mutex here is a LEAF — breaker/coordinator/server `_mu` and the
-# coordinator's `_step_mu` guard small in-memory state and may never
-# nest another lock or block. The cluster-wide `control_mu` (RLock) is
-# the control plane's OUTERMOST lock: reshard cutovers and checkpoint
-# gates serialize under it before touching any server state.
+# every mutex here is a LEAF — breaker/coordinator/server `_mu`, the
+# coordinator's `_step_mu` and `_susp_mu` guard small in-memory state
+# and may never nest another lock or block. The cluster-wide
+# `control_mu` (RLock) is the control plane's OUTERMOST lock: reshard
+# cutovers and checkpoint gates serialize under it before touching any
+# server state.
 # LOCK ORDER: control_mu < _mu
-# LOCK LEAF: _mu _step_mu
+# LOCK LEAF: _mu _step_mu _susp_mu
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..core import sync as _sync
 from ..core.enforce import (PreconditionNotMetError, PsTransportError,
                             enforce)
 from ..core.flags import define_flag, flag
@@ -181,7 +183,7 @@ class CircuitBreaker:
         self.cooldown_s = (cooldown_s if cooldown_s is not None
                            else int(flag("ps_breaker_cooldown_ms")) / 1000.0)
         self._clock = clock
-        self._mu = threading.Lock()
+        self._mu = _sync.Lock()
         self._state = self.CLOSED
         self._consecutive = 0
         self._opened_at = 0.0
@@ -311,7 +313,7 @@ class HARouter:
             else int(flag("ps_ha_failover_timeout_ms")) / 1000.0)
         self.poll_s = poll_s
         self._breakers: Dict[str, CircuitBreaker] = {}
-        self._mu = threading.Lock()
+        self._mu = _sync.Lock()
 
     def breaker(self, endpoint: str) -> CircuitBreaker:
         with self._mu:
@@ -411,8 +413,8 @@ class ReplicationManager:
         self._cap = (oplog_cap if oplog_cap is not None
                      else int(flag("ps_ha_oplog_cap")))
         self._backups: Dict[str, dict] = {}  # ep -> {conn, acked}
-        self._mu = threading.Lock()
-        self._stop = threading.Event()
+        self._mu = _sync.Lock()
+        self._stop = _sync.Event()
         self._thread: Optional[threading.Thread] = None
         self._bg_syncs: List[threading.Thread] = []
         self._self_conn = None
@@ -427,7 +429,7 @@ class ReplicationManager:
 
     def start(self) -> "ReplicationManager":
         self.server.set_replication(True, self._cap)
-        self._thread = threading.Thread(target=self._loop, daemon=True,
+        self._thread = _sync.Thread(target=self._loop, daemon=True,
                                         name=f"ps-repl:{self.shard}")
         self._thread.start()
         return self
@@ -659,7 +661,7 @@ class ReplicationManager:
             finally:
                 st["syncing"] = False
 
-        t = threading.Thread(target=run, daemon=True,
+        t = _sync.Thread(target=run, daemon=True,
                              name=f"ps-migrate:{self.shard}->{ep}")
         # prune finished stragglers so a long-lived shipper doesn't
         # accumulate thread handles across many reshard cycles
@@ -927,7 +929,24 @@ class CheckpointGate:
 
     def __enter__(self) -> "CheckpointGate":
         self._locked = False
+        self._suspended_coord = None
         if self.cluster is not None:
+            # suspend failover scans FIRST (mirrors the reshard
+            # cutover): control_mu serializes against other control
+            # operations, but the coordinator's scan loop never takes
+            # it — a promotion landing mid-capture re-routes the shard
+            # onto an UNPAUSED backup, and both this gate's re-resolved
+            # capture stream and the (unpaused) writers follow it: a
+            # torn cut. suspend() is depth-counted, so nesting inside a
+            # cutover's own suspension is safe; the ordering (suspend
+            # BEFORE control_mu) keeps the barrier in suspend() from
+            # waiting on a scan that is itself… impossible, since scans
+            # never take control_mu — but it also bounds the suspension
+            # to exactly the window we hold the mutex
+            coord = getattr(self.cluster, "coordinator", None)
+            if coord is not None:
+                coord.suspend()
+                self._suspended_coord = coord
             # serialize against a reshard cutover (cluster.control_mu):
             # the depth-counted pauses NEST fine, but a capture
             # interleaved with the cutover's retain would snapshot a
@@ -935,8 +954,14 @@ class CheckpointGate:
             # source shard while this capture's client still routes to
             # it. Taking the mutex ALSO pins the shard set for the
             # whole `with gate:` block (targets can't move mid-capture)
-            self.cluster.control_mu.acquire()
-            self._locked = True
+            try:
+                self.cluster.control_mu.acquire()
+                self._locked = True
+            except BaseException:
+                if self._suspended_coord is not None:
+                    self._suspended_coord = None
+                    coord.resume_scans()
+                raise
         paused = []
         try:
             for srv in self._targets():
@@ -953,6 +978,9 @@ class CheckpointGate:
             if self._locked:
                 self._locked = False
                 self.cluster.control_mu.release()
+            coord, self._suspended_coord = self._suspended_coord, None
+            if coord is not None:
+                coord.resume_scans()
             raise
         self._paused = paused
         return self
@@ -964,6 +992,10 @@ class CheckpointGate:
         if getattr(self, "_locked", False):
             self._locked = False
             self.cluster.control_mu.release()
+        coord = getattr(self, "_suspended_coord", None)
+        self._suspended_coord = None
+        if coord is not None:
+            coord.resume_scans()
 
 
 # ---------------------------------------------------------------------------
@@ -997,7 +1029,7 @@ class HAServer:
                         else int(flag("ps_ha_lease_ttl_ms")) / 1000.0)
         self._oplog_cap = oplog_cap
         self.rm: Optional[ReplicationManager] = None
-        self._stop = threading.Event()
+        self._stop = _sync.Event()
         self._graceful = False
         self._thread: Optional[threading.Thread] = None
         self._lease = Lease(store, _hb_key(job_id, self.endpoint),
@@ -1010,7 +1042,7 @@ class HAServer:
         self.server.set_replication(True, self._oplog_cap
                                     or int(flag("ps_ha_oplog_cap")))
         self._lease.refresh()
-        self._thread = threading.Thread(target=self._hb_loop, daemon=True,
+        self._thread = _sync.Thread(target=self._hb_loop, daemon=True,
                                         name=f"ps-ha:{self.endpoint}")
         self._thread.start()
         return self
@@ -1101,9 +1133,11 @@ class FailoverCoordinator:
         self.on_promote = on_promote
         self.promotions = 0
         self._missing_since: Dict[str, float] = {}
-        self._stop = threading.Event()
-        self._suspended = threading.Event()
-        self._step_mu = threading.Lock()  # one scan at a time; suspend()
+        self._stop = _sync.Event()
+        self._suspended = _sync.Event()
+        self._step_mu = _sync.Lock()  # one scan at a time; suspend()
+        self._susp_mu = _sync.Lock()  # guards _susp_depth; suspend()
+        self._susp_depth = 0          # nests (gate inside cutover etc.)
         self._thread: Optional[threading.Thread] = None  # barriers on it
         # obs: promotions are a job-wide counter (the watchdog's
         # failover rule) AND a flight-recorder trigger
@@ -1200,24 +1234,38 @@ class FailoverCoordinator:
         return promoted
 
     def start(self) -> "FailoverCoordinator":
-        self._thread = threading.Thread(target=self._loop, daemon=True,
+        self._thread = _sync.Thread(target=self._loop, daemon=True,
                                         name=f"ps-ha-coord:{self.job_id}")
         self._thread.start()
         return self
 
     def suspend(self) -> None:
         """Pause scans (no promotions, no publishes). The routing table
-        has ONE writer; a reshard cutover (ps/reshard.py) must briefly
-        become that writer — a scan racing its publish could clobber
-        the flipped document with a stale read-modify-write. The
-        suspension window is the (ms-scale) cutover, not the
-        bootstrap; call :meth:`resume_scans` right after."""
-        self._suspended.set()
+        has ONE writer; a reshard cutover (ps/reshard.py) or a
+        checkpoint gate must briefly become that writer — a scan racing
+        their publish could clobber the flipped document with a stale
+        read-modify-write, and a promotion mid-capture would re-route
+        the gate's paused cut onto an UNPAUSED backup. The suspension
+        window is ms-scale; call :meth:`resume_scans` right after.
+
+        Depth-counted: a checkpoint gate that overlaps a reshard
+        cutover (both legitimately suspend) must not have the inner
+        resume un-suspend the outer holder — a bare Event did exactly
+        that, and the schedule explorer (tools/sched) found the
+        resulting clobbered publish. Scans stay off until the LAST
+        holder resumes."""
+        with self._susp_mu:
+            self._susp_depth += 1
+            self._suspended.set()
         with self._step_mu:
             pass  # barrier: any in-flight scan finishes before we return
 
     def resume_scans(self) -> None:
-        self._suspended.clear()
+        with self._susp_mu:
+            self._susp_depth -= 1
+            if self._susp_depth <= 0:
+                self._susp_depth = 0
+                self._suspended.clear()
 
     def _loop(self) -> None:
         while not self._stop.wait(self.poll_s):
@@ -1269,7 +1317,7 @@ class HACluster:
         #: snapshot a half-migrated key set (rows already dropped from
         #: the source while the capture client still routes to it).
         #: RLock: a holder's nested gate may re-acquire.
-        self.control_mu = threading.RLock()
+        self.control_mu = _sync.RLock()
         shards_doc = []
         for si in range(num_shards):
             replicas = [HAServer(self.store, job_id, si,

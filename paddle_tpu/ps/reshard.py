@@ -70,6 +70,7 @@ import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from ..core import sync as _sync
 from ..core.enforce import PreconditionNotMetError, enforce
 from ..obs import flightrec as _flightrec
 from ..obs import registry as _obs_registry
@@ -166,7 +167,7 @@ class ReshardController:
         self.poll_s = float(poll_s)
         self._clock = clock
         self._sleep = sleep
-        self._op_mu = threading.Lock()
+        self._op_mu = _sync.Lock()
         self._ctrl_conns: Dict[str, object] = {}
         #: cutover gate-hold milliseconds (the demo's p50/p95 artifact)
         self.pause_ms: deque = deque(maxlen=512)

@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from ..core import sync as _sync
 from ..core.enforce import (NotFoundError, PreconditionNotMetError,
                             PsTransportError, WrongShardError, enforce)
 from ..core.flags import define_flag, flag
@@ -290,7 +291,7 @@ class NativePsServer:
         self._h = self._lib.pss_create(port, n_trainers)
         enforce(self._h is not None, f"failed to bind PS server port {port}")
         self.port = int(self._lib.pss_port(self._h))
-        self._pause_mu = threading.Lock()
+        self._pause_mu = _sync.Lock()
         self._pause_depth = 0
 
     def stop(self) -> None:
@@ -451,7 +452,7 @@ class _ServerConn:
         # the C++ mutex only protects a single psc_call, but reconnect
         # DELETES the PsConn — without this lock a trainer-thread retry
         # could free the handle under the Communicator's in-flight push
-        self._mu = threading.RLock()
+        self._mu = _sync.RLock()
         self._connect()
 
     def _connect(self) -> None:
@@ -724,15 +725,15 @@ class RpcPsClient(PSClient):
         # over the fp32 wire at drain_push_residuals() (quiesce/
         # checkpoint cuts — no training signal lives here across a cut)
         self._push_ef: Dict[int, Dict[int, np.ndarray]] = {}
-        self._ef_mu = threading.Lock()  # LOCK: _ef_mu (leaf — see header)
+        self._ef_mu = _sync.Lock()  # LOCK: _ef_mu (leaf — see header)
         self._pool: Optional[ThreadPoolExecutor] = None
-        self._pool_mu = threading.Lock()
+        self._pool_mu = _sync.Lock()
         #: HA router (ps/ha.py HARouter): resolves the epoch-stamped
         #: routing table, gates endpoints through the circuit breaker,
         #: and answers "who replaced this dead primary?". None = the
         #: static single-replica topology (behavior unchanged).
         self._router = router
-        self._conns_mu = threading.Lock()  # serializes failover conn swaps
+        self._conns_mu = _sync.Lock()  # serializes failover conn swaps
         # live resharding (ps/reshard.py): a grow replaces the fan-out
         # pool with a wider one; pools that may still carry in-flight
         # fan-outs retire here and shut down at close()
@@ -748,7 +749,7 @@ class RpcPsClient(PSClient):
         self._ops = CounterGroup("ps_client_ops", _OP_NAMES,
                                  max_series=1024, client=self._client_tag)
         self._op_base: Dict[str, int] = {op: 0 for op in _OP_NAMES}
-        self._count_mu = threading.Lock()
+        self._count_mu = _sync.Lock()
         # per-table wire/density handles, bound at table-create time
         # (the cold path — the metric-in-hot-path lint rule's contract)
         self._tbl_obs: Dict[int, Dict[str, object]] = {}

@@ -52,6 +52,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..core import sync as _sync
 from ..core.enforce import enforce
 from ..obs import registry as _obs_registry
 from ..obs import trace as _obs_trace
@@ -162,7 +163,7 @@ class ServingFleet:
         self.config = config or FleetConfig()
         self._clock = clock
         self._sleep = sleep
-        self._mu = threading.Lock()
+        self._mu = _sync.Lock()
         self._members: Dict[str, FleetMember] = {}
         self._join_order: List[str] = []
         #: endpoints mid-drain: the watcher must NOT re-admit these
@@ -175,7 +176,7 @@ class ServingFleet:
             ("joins", "drains", "crashes_removed", "warm_rows",
              "heals", "ticks"),
             max_series=64, job=self.job_id)
-        self._stop = threading.Event()
+        self._stop = _sync.Event()
         self._thread: Optional[threading.Thread] = None
 
     # -- membership --------------------------------------------------------
@@ -322,8 +323,14 @@ class ServingFleet:
                     # BETWEEN the check above and the attach — re-eject
                     # here so every interleaving ends with the leaving
                     # member out of routing (drain's own eject covers
-                    # the drain-marked-after-this-recheck ordering)
-                    raced = ep in self._draining
+                    # the drain-marked-after-this-recheck ordering).
+                    # The membership test matters too: a drain that runs
+                    # to COMPLETION inside the attach window has already
+                    # discarded its draining mark, and only the popped
+                    # member betrays it (drain pops under _mu before it
+                    # discards, so one of the two is always visible)
+                    raced = (ep in self._draining
+                             or ep not in self._members)
                 if raced:
                     self.router.eject(ep)
                     continue
@@ -344,7 +351,7 @@ class ServingFleet:
     def start(self) -> "ServingFleet":
         if self._thread is None:
             self._stop.clear()
-            self._thread = threading.Thread(
+            self._thread = _sync.Thread(
                 target=self._watch, daemon=True,
                 name=f"serving-fleet:{self.job_id}")
             self._thread.start()

@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..core import sync as _sync
 from ..core.enforce import enforce
 from ..obs import flightrec as _flightrec
 from ..obs.registry import CounterGroup
@@ -96,13 +97,13 @@ class _Request:
         self.dense = dense
         self.deadline = deadline
         self.t_submit = time.perf_counter()
-        self.event = threading.Event()
+        self.event = _sync.Event()
         self.value = None
         self.error: Optional[BaseException] = None
         # completion callbacks (the router's hedge/retry scatter-back
         # path) — registered under cb_mu so a callback added while the
         # worker delivers fires exactly once
-        self.cb_mu = threading.Lock()
+        self.cb_mu = _sync.Lock()
         self.cbs: List[Callable] = []
 
     def _finish(self) -> None:
@@ -194,9 +195,9 @@ class ServingFrontend:
         cfg = self.config
         enforce(cfg.max_batch > 0 and cfg.queue_cap > 0,
                 "FrontendConfig max_batch/queue_cap must be positive")
-        self._q: "queue.Queue[_Request]" = queue.Queue(maxsize=cfg.queue_cap)
+        self._q: "queue.Queue[_Request]" = _sync.Queue(maxsize=cfg.queue_cap)
         self._keys_per_req: Optional[int] = None
-        self._mu = threading.Lock()
+        self._mu = _sync.Lock()
         # registry-backed (obs/registry.py CounterGroup): the dict
         # increments below are unchanged, the job-wide snapshot sees
         # the admission/shedding counters under serving_frontend_events
@@ -223,8 +224,8 @@ class ServingFrontend:
         #: queue empty AND this clear (plain bool — single writer, the
         #: worker; readers tolerate one-batch staleness)
         self._busy = False
-        self._stopping = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
+        self._stopping = _sync.Event()
+        self._thread = _sync.Thread(target=self._loop, daemon=True,
                                         name="serving-frontend")
         self._thread.start()
 

@@ -48,6 +48,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..core import sync as _sync
 from ..core.enforce import enforce
 from ..io.fs import crc32c
 from ..obs import registry as _obs_registry
@@ -68,7 +69,7 @@ class DenseModel:
                  sink: Optional[Callable] = None) -> None:
         self._unravel = unravel
         self._sink = sink
-        self._mu = threading.Lock()  # LOCK LEAF: _mu
+        self._mu = _sync.Lock()  # LOCK LEAF: _mu
         self.version = 0
         self.digest = 0
         self.flat: Optional[np.ndarray] = None
@@ -123,7 +124,7 @@ class RolloutManager:
         self._members = members
         self.router = router
         self.config = config or RolloutConfig()
-        self._mu = threading.Lock()
+        self._mu = _sync.Lock()
         #: version → (flat f32 vector, digest). Bounded: _register
         #: evicts the oldest UNPROTECTED versions past keep_versions —
         #: the live current and an open canary are never evicted (a

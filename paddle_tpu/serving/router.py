@@ -66,6 +66,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..core import sync as _sync
 from ..core.enforce import enforce
 from ..obs import registry as _obs_registry
 from ..obs.registry import CounterGroup
@@ -193,10 +194,10 @@ class RoutedRequest:
         self.sparse = block is not None
         self.version = version
         self.t0 = router._clock()
-        self.event = threading.Event()
+        self.event = _sync.Event()
         self.value = None
         self.error: Optional[BaseException] = None
-        self.mu = threading.Lock()
+        self.mu = _sync.Lock()
         self.tried: List[str] = []
         self.hedged = False
         self.hedge_at: Optional[float] = None
@@ -340,7 +341,7 @@ class ServingRouter:
         self._clock = clock
         self._sleep = sleep
         self._hedge_poll_s = float(hedge_poll_s)
-        self._mu = threading.Lock()
+        self._mu = _sync.Lock()
         self._members: Dict[str, _MemberState] = {}
         self._ejected: set = set()
         self._ring: List[Tuple[int, str]] = []
@@ -372,11 +373,11 @@ class ServingRouter:
         # hedge timer: a heap of (fire_t, request); fires maybe_hedge.
         # Condition-based so an earlier deadline pushed mid-wait wakes
         # the timer instead of sleeping past it.
-        self._hcv = threading.Condition()
+        self._hcv = _sync.Condition()
         self._hheap: List[Tuple[float, int, RoutedRequest]] = []
         self._hseq = 0
-        self._stop = threading.Event()
-        self._timer = threading.Thread(target=self._hedge_loop, daemon=True,
+        self._stop = _sync.Event()
+        self._timer = _sync.Thread(target=self._hedge_loop, daemon=True,
                                        name=f"serving-router-hedge:{tag}")
         self._timer.start()
 

@@ -1,0 +1,6 @@
+"""Test-only machinery (deterministic concurrency explorer).
+
+Nothing in paddle_tpu's production import graph may import this
+package; the sync shim (core/sync.py) reaches it only indirectly,
+through a scheduler the HARNESS installs first.
+"""

@@ -856,7 +856,8 @@ def test_run_py_green_on_tree_and_red_on_violation(tmp_path):
     assert summary["new"] == 0
     assert set(summary["per_pass"]) == {
         "tracer_safety", "hot_path", "lock_order", "py_locks",
-        "wire_contract", "conventions", "obs_metrics", "control_loops"}
+        "wire_contract", "conventions", "obs_metrics", "control_loops",
+        "sync_shim"}
 
     # an injected violation must turn the gate red with file:line:rule
     bad = tmp_path / "tree" / "paddle_tpu"
@@ -1903,8 +1904,20 @@ def test_wire_contract_real_tree_is_clean():
 # driver satellites: stale-allowlist gate, --changed, per-pass timings
 # ---------------------------------------------------------------------------
 
+def _lint_runner():
+    """Load tools/lint/run.py under a unique module name: a bare
+    `import run` collides with tools/sched/run.py (test_sched.py puts
+    that dir on sys.path too, and sys.modules caches whichever `run`
+    wins the path race)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "paddle_lint_run", os.path.join(REPO, "tools", "lint", "run.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
 def test_stale_allowlist_entry_fails_full_gate(tmp_path, monkeypatch):
-    import run as runner
+    runner = _lint_runner()
     bad = tmp_path / "tree" / "paddle_tpu"
     bad.mkdir(parents=True)
     (bad / "__init__.py").write_text("")
@@ -1925,7 +1938,7 @@ def test_stale_allowlist_entry_fails_full_gate(tmp_path, monkeypatch):
 
 
 def test_changed_mode_filters_and_skips_staleness(tmp_path, monkeypatch):
-    import run as runner
+    runner = _lint_runner()
     tree = tmp_path / "tree"
     pkg = tree / "paddle_tpu"
     pkg.mkdir(parents=True)
@@ -1957,7 +1970,7 @@ def test_changed_mode_filters_and_skips_staleness(tmp_path, monkeypatch):
 
 
 def test_json_summary_carries_timings_and_why(tmp_path, monkeypatch):
-    import run as runner
+    runner = _lint_runner()
     tree = tmp_path / "tree"
     pkg = tree / "paddle_tpu"
     pkg.mkdir(parents=True)
@@ -1974,7 +1987,8 @@ def test_json_summary_carries_timings_and_why(tmp_path, monkeypatch):
     s = json.loads(summary.read_text())
     assert set(s["per_pass"]) == {
         "tracer_safety", "hot_path", "lock_order", "py_locks",
-        "wire_contract", "conventions", "obs_metrics", "control_loops"}
+        "wire_contract", "conventions", "obs_metrics", "control_loops",
+        "sync_shim"}
     for rec in s["per_pass"].values():
         assert rec["wall_ms"] >= 0 and rec["violations"] >= 0
     assert s["wall_s"] >= 0
@@ -1998,7 +2012,7 @@ def test_pylock_malformed_decl_flagged(tmp_path):
 
 
 def test_time_budget_warning_is_soft(tmp_path, monkeypatch, capsys):
-    import run as runner
+    runner = _lint_runner()
     tree = tmp_path / "tree"
     (tree / "paddle_tpu").mkdir(parents=True)
     (tree / "paddle_tpu" / "__init__.py").write_text("")
@@ -2046,7 +2060,10 @@ def test_pylock_cv_wait_bound_to_other_lock_flagged(tmp_path):
                 with self._mu:
                     self._cv.wait()
     """)
-    assert _rules(diags) == {"blocking-under-lock"}
+    # the no-predicate rule (ISSUE 16) independently fires on the same
+    # site: the wait is both under the wrong lock AND unlooped
+    assert _rules(diags) == {"blocking-under-lock",
+                             "cond-wait-no-predicate"}
 
 
 def test_pylock_cv_wait_bound_to_held_lock_ok(tmp_path):
@@ -2091,7 +2108,7 @@ def test_pylock_lock_ok_does_not_waive_ordering_rules(tmp_path):
 def test_changed_files_handles_spaces_in_paths(tmp_path):
     import subprocess as sp
 
-    import run as runner
+    runner = _lint_runner()
     repo = tmp_path / "r"
     repo.mkdir()
 
@@ -2108,3 +2125,288 @@ def test_changed_files_handles_spaces_in_paths(tmp_path):
     (repo / "base.py").write_text("x = 3\n")     # modified
     got = runner.changed_files(str(repo))
     assert got == {"base.py", "my mod.py"}
+
+
+# ---------------------------------------------------------------------------
+# pass 9: sync-shim discipline (sync_shim)
+# ---------------------------------------------------------------------------
+
+import sync_shim  # noqa: E402
+
+
+def _shim_diags(tmp_path, source, fname="paddle_tpu/ps/mod.py"):
+    p = tmp_path / fname
+    p.parent.mkdir(parents=True, exist_ok=True)
+    init = tmp_path / "paddle_tpu" / "__init__.py"
+    if not init.exists():
+        init.write_text("")
+    p.write_text(textwrap.dedent(source))
+    return sync_shim.run(str(tmp_path))
+
+
+def test_raw_sync_in_migrated_module_flagged(tmp_path):
+    diags = _shim_diags(tmp_path, """
+        import threading
+        import queue
+
+        from ..core import sync as _sync
+
+        class C:
+            def __init__(self):
+                self._mu = _sync.Lock()
+                self._raw = threading.Lock()
+                self._ev = threading.Event()
+                self._q = queue.Queue(maxsize=4)
+                self._t = threading.Thread(target=self.f, name="w")
+    """)
+    assert _rules(diags) == {"raw-sync"}
+    assert len(diags) == 4  # Lock + Event + Queue + Thread
+    assert "_sync.Lock(" in diags[0].message
+
+
+def test_raw_sync_unmigrated_module_ok(tmp_path):
+    # no shim import: raw construction is NOT a violation — migration
+    # is deliberate, the pass is a ratchet not a mandate
+    diags = _shim_diags(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._t = threading.Thread(target=self.f, name="w")
+    """)
+    assert diags == []
+
+
+def test_raw_sync_escape_with_reason_ok_without_reason_syntax(tmp_path):
+    diags = _shim_diags(tmp_path, """
+        import threading
+
+        from ..core import sync as _sync
+
+        class C:
+            def __init__(self):
+                self._mu = _sync.Lock()
+                self._wd = threading.Thread(  # graftlint: raw-sync watchdog outlives the test run
+                    target=self.f, name="w")
+                self._bad = threading.Lock()  # graftlint: raw-sync
+    """)
+    assert _rules(diags) == {"raw-sync-syntax"}
+
+
+def test_raw_sync_ignore_comment_and_alias_forms(tmp_path):
+    # ignore[] suppresses too, and the level-0 import form + a renamed
+    # alias are both recognized as migration markers
+    diags = _shim_diags(tmp_path, """
+        import threading
+
+        from paddle_tpu.core import sync as S
+
+        class C:
+            def __init__(self):
+                self._mu = S.Lock()
+                self._raw = threading.RLock()  # graftlint: ignore[raw-sync]
+                self._cv = threading.Condition()
+    """)
+    assert _rules(diags) == {"raw-sync"}
+    assert len(diags) == 1
+
+
+def test_raw_sync_shim_and_testing_modules_skipped(tmp_path):
+    # the shim itself and the explorer construct raw primitives BY
+    # DESIGN
+    for fname in ("paddle_tpu/core/sync.py", "paddle_tpu/testing/sched.py"):
+        diags = _shim_diags(tmp_path, """
+            import threading
+
+            from ..core import sync as _sync
+
+            _mu = threading.Lock()
+        """, fname=fname)
+        assert diags == []
+
+
+def test_real_tree_shim_migration_is_complete():
+    diags = sync_shim.run(REPO)
+    assert diags == [], diags
+
+
+# ---------------------------------------------------------------------------
+# py_locks: cond-wait-no-predicate + sync-shim recognition
+# ---------------------------------------------------------------------------
+
+def test_cond_wait_outside_while_flagged(tmp_path):
+    diags = _pylock_diags(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._cv = threading.Condition(self._mu)
+
+            def bad(self):
+                with self._mu:
+                    if not self.ready:
+                        self._cv.wait()
+    """)
+    assert "cond-wait-no-predicate" in _rules(diags)
+
+
+def test_cond_wait_in_while_ok(tmp_path):
+    diags = _pylock_diags(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._cv = threading.Condition(self._mu)
+
+            def good(self):
+                with self._mu:
+                    while not self.ready:
+                        self._cv.wait()
+    """)
+    assert diags == []
+
+
+def test_cond_wait_nested_def_resets_loop_context(tmp_path):
+    # a closure's body does not inherit the enclosing while
+    diags = _pylock_diags(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._cv = threading.Condition(self._mu)
+
+            def bad(self):
+                while True:
+                    def inner():
+                        with self._mu:
+                            self._cv.wait()
+                    inner()
+    """)
+    assert "cond-wait-no-predicate" in _rules(diags)
+
+
+def test_cond_wait_event_wait_not_flagged(tmp_path):
+    # Events are level-triggered: wait() needs no predicate loop (the
+    # rule keys on tracked Conditions only)
+    diags = _pylock_diags(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._stop = threading.Event()
+
+            def loop(self):
+                self._stop.wait(0.05)
+    """)
+    assert diags == []
+
+
+def test_pylock_sync_shim_condition_recognized(tmp_path):
+    # the shim's Condition binds to its lock exactly like threading's:
+    # cv protocol under the bound lock is exempt, and the shim Queue's
+    # boundedness feeds blocking-under-lock
+    diags = _pylock_diags(tmp_path, """
+        from ..core import sync as _sync
+
+        class C:
+            def __init__(self):
+                self._mu = _sync.Lock()
+                self._cv = _sync.Condition(self._mu)
+                self._wq = _sync.Queue(maxsize=2)
+
+            def good(self):
+                with self._mu:
+                    while self.busy:
+                        self._cv.wait()
+                    self._cv.notify_all()
+
+            def bad(self):
+                with self._mu:
+                    self._wq.put(1)
+    """)
+    assert _rules(diags) == {"blocking-under-lock"}
+    assert len(diags) == 1
+
+
+# ---------------------------------------------------------------------------
+# conventions: sync-shim recognition
+# ---------------------------------------------------------------------------
+
+def test_conventions_sync_shim_queue_and_thread(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        from ..core import sync as _sync
+
+        class C:
+            def __init__(self):
+                self._q = _sync.Queue()
+                self._t = _sync.Thread(target=self.f)
+    """)
+    assert _rules(diags) == {"unbounded-queue", "anonymous-thread"}
+
+
+def test_conventions_sync_shim_bounded_named_ok(tmp_path):
+    diags = _conv_diags(tmp_path, """
+        from ..core import sync as _sync
+
+        class C:
+            def __init__(self):
+                self._q = _sync.Queue(maxsize=8)
+                self._t = _sync.Thread(target=self.f, name="c:writer")
+    """)
+    assert diags == []
+
+
+# ---------------------------------------------------------------------------
+# --changed must re-run cross-file passes over the whole tree
+# ---------------------------------------------------------------------------
+
+def test_changed_mode_runs_cross_file_passes_fully(tmp_path, monkeypatch):
+    import subprocess as sp
+
+    runner = _lint_runner()
+    repo = tmp_path / "r"
+    pkg = repo / "paddle_tpu"
+    pkg.mkdir(parents=True)
+    (repo / "tools").mkdir()
+    (pkg / "__init__.py").write_text("")
+    # UNCHANGED file with a py_locks violation a partial view would miss
+    (pkg / "steady.py").write_text(textwrap.dedent("""
+        import time
+        import threading
+
+        _mu = threading.Lock()
+
+        def f():
+            with _mu:
+                time.sleep(1.0)
+    """))
+    (pkg / "touched.py").write_text("x = 1\n")
+
+    def g(*args):
+        sp.run(["git", "-C", str(repo), "-c", "user.email=t@t",
+                "-c", "user.name=t", *args], check=True,
+               capture_output=True)
+
+    g("init", "-q")
+    g("add", "-A")
+    g("commit", "-qm", "base")
+    (pkg / "touched.py").write_text("x = 2\n")   # the only change
+
+    allow = tmp_path / "allow.txt"
+    allow.write_text("")
+    monkeypatch.setattr(runner, "ALLOW_PATH", str(allow))
+    summary = tmp_path / "s.json"
+    rc = runner.main(["--root", str(repo), "--changed",
+                      "--json", str(summary)])
+    s = json.loads(summary.read_text())
+    assert s["changed_files"] == ["paddle_tpu/touched.py"]
+    # the cross-file py_locks pass saw the WHOLE tree: the violation in
+    # the unchanged file is reported and the gate goes red
+    assert any(v["rule"] == "blocking-under-lock"
+               and v["path"] == "paddle_tpu/steady.py"
+               for v in s["violations"]), s["violations"]
+    assert rc == 1

@@ -328,6 +328,11 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
     threaded = False               # module imports threading
     threading_mod_aliases: Set[str] = set()  # import threading [as t]
     thread_bare: Set[str] = set()  # from threading import Thread [as T]
+    # the core.sync shim (imported RELATIVELY: `from ..core import sync
+    # as _sync`, any level) wraps the same constructors — its Queue is
+    # an unbounded queue, its Thread an anonymous thread, and a module
+    # that imports it runs threads by definition
+    sync_mod_aliases: Set[str] = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
@@ -342,6 +347,9 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
                 elif a.name == "threading":
                     threaded = True
                     threading_mod_aliases.add(a.asname or "threading")
+                elif a.name.endswith("core.sync"):
+                    threaded = True
+                    sync_mod_aliases.add(a.asname or a.name)
         elif isinstance(node, ast.ImportFrom):
             if node.module == "time" and not node.level:
                 for a in node.names:
@@ -366,6 +374,11 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
                 for a in node.names:
                     if a.name == "Thread":
                         thread_bare.add(a.asname or "Thread")
+            if (node.module or "").split(".")[-1] == "core":
+                for a in node.names:
+                    if a.name == "sync":
+                        threaded = True
+                        sync_mod_aliases.add(a.asname or a.name)
 
     def _queue_kind(call: ast.Call):
         name = dotted(call.func)
@@ -376,6 +389,8 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
         if name and "." in name:
             mod, _, attr = name.rpartition(".")
             if mod in queue_mod_aliases and attr in _QUEUE_ATTRS:
+                return "queue"
+            if mod in sync_mod_aliases and attr == "Queue":
                 return "queue"
             if mod in coll_mod_aliases and attr == "deque":
                 return "deque"
@@ -462,8 +477,9 @@ def check_file(path: str, root: str, rules: Set[str]) -> List[Diagnostic]:
             is_thread_ctor = name in thread_bare
             if name and "." in name:
                 mod, _, attr = name.rpartition(".")
-                is_thread_ctor |= (mod in threading_mod_aliases
-                                   and attr == "Thread")
+                is_thread_ctor |= (attr == "Thread"
+                                   and (mod in threading_mod_aliases
+                                        or mod in sync_mod_aliases))
             if is_thread_ctor and not any(kw.arg == "name"
                                           for kw in node.keywords):
                 emit(node, "anonymous-thread",

@@ -53,6 +53,17 @@ Rules:
                        AFTER release. ``cond.notify{,_all}()`` on a
                        tracked lock/condition is exempt (that is the
                        condition-variable protocol, not a callback).
+  cond-wait-no-predicate
+                       `cv.wait()` on a Condition outside a `while`
+                       loop: a condition wake is a HINT, not a
+                       guarantee — spurious wakeups, stolen wakeups
+                       (another waiter consumed the state first) and
+                       timeouts all return with the predicate false,
+                       so the wait must live in
+                       `while not pred: cv.wait()`. The scheduler
+                       explorer (tools/sched) detects the RESULTING
+                       lost wakeups dynamically; this rule catches the
+                       shape statically.
   blocking-under-lock  a blocking operation inside a lock region:
                        ``time.sleep``, socket IO, thread/queue
                        ``join``, ``<q>.put`` on a BOUNDED queue /
@@ -129,9 +140,15 @@ _BLOCKING_FUNCS = {
 _LOCAL_BLOCKING_FUNCS = {"make_conn": "TCP connect",
                          "_ServerConn": "TCP connect"}
 
+# the core.sync shim factories construct the same objects (or their
+# schedulable doubles under tools/sched) — lock regions and queue
+# boundedness carry over verbatim
 _THREADING_LOCKS = {"threading.Lock", "threading.RLock",
-                    "threading.Condition"}
-_QUEUE_CLASSES = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue"}
+                    "threading.Condition",
+                    "core.sync.Lock", "core.sync.RLock",
+                    "core.sync.Condition"}
+_QUEUE_CLASSES = {"queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+                  "core.sync.Queue"}
 
 
 def _parse_decls(lines: List[str], path: str) -> Tuple[
@@ -180,10 +197,20 @@ class _Aliases:
             if isinstance(node, ast.Import):
                 for a in node.names:
                     self.mod[a.asname or a.name.split(".")[0]] = a.name
-            elif isinstance(node, ast.ImportFrom) and node.module \
-                    and node.level == 0:
+            elif isinstance(node, ast.ImportFrom):
+                if node.module and node.level == 0:
+                    for a in node.names:
+                        self.sym[a.asname or a.name] = \
+                            f"{node.module}.{a.name}"
+                # the sync shim is imported RELATIVELY in production
+                # modules (`from ..core import sync as _sync`) — level-N
+                # ImportFrom of `sync` out of a `core` package resolves
+                # to the canonical `core.sync` module name so its
+                # factories classify like the stdlib constructors
                 for a in node.names:
-                    self.sym[a.asname or a.name] = f"{node.module}.{a.name}"
+                    if a.name == "sync" and \
+                            (node.module or "").split(".")[-1] == "core":
+                        self.mod[a.asname or a.name] = "core.sync"
 
     def resolve(self, name: Optional[str]) -> Optional[str]:
         if not name:
@@ -246,7 +273,7 @@ def _collect_locks(tree: ast.Module, ctx: _FileCtx) -> None:
                 continue
             if callee in _THREADING_LOCKS:
                 (ctx.locks_attr if is_attr else ctx.locks_mod).add(name)
-                if callee == "threading.Condition":
+                if callee.endswith(".Condition"):
                     # Condition(lock) waits/notifies on THAT lock; a
                     # bare Condition() owns its own
                     bound = (_final_segment(node.value.args[0])
@@ -660,6 +687,36 @@ class _FunctionScan:
         return True
 
 
+def _check_cond_waits(tree: ast.Module, ctx: _FileCtx) -> None:
+    """cond-wait-no-predicate: every `.wait()` on a tracked Condition
+    must be lexically inside a `while` (test or body) — the re-checked
+    predicate is what makes the CV protocol correct under spurious and
+    stolen wakeups. A nested def resets the loop context: its body does
+    not inherit the enclosing loop's guard."""
+
+    def walk(node: ast.AST, in_while: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                walk(child, False)
+                continue
+            if isinstance(child, ast.Call) and \
+                    isinstance(child.func, ast.Attribute) and \
+                    child.func.attr == "wait" and not in_while:
+                seg = _final_segment(child.func.value)
+                if seg in ctx.cond_bound:
+                    _emit(ctx, child.lineno, "cond-wait-no-predicate",
+                          f"`{seg}.wait()` outside a while-predicate "
+                          "loop — a Condition wake is a hint, not a "
+                          "guarantee (spurious/stolen wakeups, "
+                          "timeouts): use `while not pred: "
+                          f"{seg}.wait()`",
+                          getattr(child, "end_lineno", None))
+            walk(child, in_while or isinstance(child, ast.While))
+
+    walk(tree, False)
+
+
 def check_file(path: str, root: str) -> List[Diagnostic]:
     with open(path, encoding="utf-8") as f:
         src = f.read()
@@ -694,6 +751,7 @@ def check_file(path: str, root: str) -> List[Diagnostic]:
     for node in ast.walk(tree):
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             _FunctionScan(node, ctx).scan()
+    _check_cond_waits(tree, ctx)
     return diags
 
 

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""graftlint driver: run all eight passes, apply the allowlist, report.
+"""graftlint driver: run all nine passes, apply the allowlist, report.
 
 Usage:
   python tools/lint/run.py              # gate: exit 1 on NEW violations
@@ -18,6 +18,10 @@ site at its new line. `--changed` (a deliberately partial view) skips
 the staleness check entirely: most entries legitimately reference
 unchanged files there, and the call-graph passes lose cross-module
 reachability on a subset — the full gate owns allowlist hygiene.
+Cross-file passes (_CROSS_FILE_PASSES) are the exception to the
+partial view: when a changed file is in their domain they re-run over
+the whole tree, because their findings are RELATIONS between files —
+a partial input doesn't just miss findings, it fabricates them.
 
 The JSON summary carries per-pass wall time + finding counts (ci.sh
 archives it) and each allowlisted violation's `why` justification; a
@@ -41,6 +45,7 @@ import conventions  # noqa: E402
 import lock_order  # noqa: E402
 import obs_metrics  # noqa: E402
 import py_locks  # noqa: E402
+import sync_shim  # noqa: E402
 import tracer_safety  # noqa: E402
 import wire_contract  # noqa: E402
 from common import (REPO_ROOT, load_allowlist,  # noqa: E402
@@ -54,6 +59,19 @@ ALLOW_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 TIME_BUDGET_S = 10.0
 
 _LINT_EXTS = (".py", ".cc", ".h")
+
+#: passes whose findings depend on MORE than the file being linted:
+#: wire_contract cross-checks the Python wire tables against the csrc
+#: enums (a partial view sees "missing counterpart" everywhere — or,
+#: worse, nothing), and the lock passes merge `LOCK ORDER` decls that
+#: neighbours contribute. Under --changed these run on the WHOLE tree
+#: whenever any changed file is in their extension domain; the other
+#: passes are strictly per-file and keep the fast partial view.
+_CROSS_FILE_PASSES = {
+    "lock_order": (".cc", ".h"),
+    "py_locks": (".py",),
+    "wire_contract": (".py", ".cc", ".h"),
+}
 
 
 def changed_files(root: str) -> set:
@@ -116,13 +134,24 @@ def main(argv=None) -> int:
         "conventions": conventions.run,
         "obs_metrics": obs_metrics.run,
         "control_loops": control_loops.run,
+        "sync_shim": sync_shim.run,
     }
     diags = []
     per_pass = {}
     t_total0 = time.perf_counter()
     for name, fn in passes.items():
+        pass_only = only
+        if only is not None and name in _CROSS_FILE_PASSES:
+            exts = _CROSS_FILE_PASSES[name]
+            if any(f.endswith(exts) for f in only):
+                # a cross-file pass on a PARTIAL file set silently loses
+                # findings (wire_contract diffs the py/cc surfaces
+                # against each other; lock_order/py_locks merge decls
+                # across a module's neighbors): one changed file in the
+                # pass's domain re-runs the WHOLE pass
+                pass_only = None
         t0 = time.perf_counter()
-        got = fn(args.root, only=only)
+        got = fn(args.root, only=pass_only)
         per_pass[name] = {
             "violations": len(got),
             "wall_ms": round((time.perf_counter() - t0) * 1000.0, 1),

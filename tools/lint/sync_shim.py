@@ -1,0 +1,147 @@
+"""graftlint pass 9: sync-shim discipline for schedulable modules.
+
+A module that imports the ``paddle_tpu.core.sync`` shim has opted into
+deterministic-schedule testing (paddle_tpu/testing/sched.py): every
+lock, condition, event, semaphore, queue and thread it constructs must
+go through the shim's factories so the explorer can interpose. ONE raw
+``threading.Lock()`` in such a module is an invisible hole — the
+explorer never sees its acquire/release, schedules stop being
+serializable, and a "verified" protocol quietly regains real
+nondeterminism. This pass makes the migration a ratchet: once a module
+is shim-migrated, raw construction there is a violation.
+
+Scope: a module is *shim-migrated* iff it imports ``sync`` out of a
+``core`` package (``from ..core import sync as _sync``, any relative
+level or alias, or ``import paddle_tpu.core.sync``). Non-migrated
+modules are untouched — adopting the shim is deliberate, not ambient.
+The shim's own implementation (``paddle_tpu/core/sync.py``) and the
+test-only explorer (``paddle_tpu/testing/``) construct raw primitives
+by design and are skipped.
+
+Rules:
+
+  raw-sync         constructing ``threading.Lock/RLock/Condition/
+                   Event/Semaphore/BoundedSemaphore/Thread`` or
+                   ``queue.Queue/LifoQueue/PriorityQueue`` in a
+                   shim-migrated module — use the ``_sync.*`` factory
+  raw-sync-syntax  a ``# graftlint: raw-sync`` escape without a reason
+
+Escape: ``# graftlint: raw-sync <reason>`` trailing the construction
+line keeps a deliberate raw primitive (e.g. the scheduler must never
+interpose on a watchdog that OUTLIVES a test run); the reason is
+required. ``# graftlint: ignore[raw-sync]`` also works.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import List, Optional, Set
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from common import Diagnostic, dotted, line_ignores, relpath, walk_py  # noqa: E402
+from py_locks import _Aliases  # noqa: E402
+
+_RAW_SYNC_RE = re.compile(r"#\s*graftlint:\s*raw-sync\b[:\s]*(.*)$")
+
+#: raw constructors the shim wraps — resolved through import aliases
+_RAW_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Event", "threading.Semaphore",
+    "threading.BoundedSemaphore", "threading.Thread",
+    "queue.Queue", "queue.LifoQueue", "queue.PriorityQueue",
+}
+
+#: files that construct raw primitives BY DESIGN
+_SKIP_SUFFIXES = (
+    os.path.join("paddle_tpu", "core", "sync.py"),
+)
+_SKIP_DIRS = (os.path.join("paddle_tpu", "testing") + os.sep,)
+
+
+def _shim_alias_names(tree: ast.Module) -> Set[str]:
+    """Local names bound to the core.sync shim module."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith("core.sync"):
+                    names.add(a.asname or a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "sync" and \
+                        (node.module or "").split(".")[-1] == "core":
+                    names.add(a.asname or a.name)
+    return names
+
+
+def _escape(lines: List[str], line: int, end_line: int,
+            rel: str, diags: List[Diagnostic]) -> bool:
+    """raw-sync escape / ignore on any of the statement's lines."""
+    for ln in range(line, min(end_line, line + 8) + 1):
+        if "raw-sync" in line_ignores(lines, ln):
+            return True
+        if 1 <= ln <= len(lines):
+            m = _RAW_SYNC_RE.search(lines[ln - 1])
+            if m:
+                if m.group(1).strip():
+                    return True
+                diags.append(Diagnostic(
+                    rel, ln, "raw-sync-syntax",
+                    "`# graftlint: raw-sync` needs a reason (`# "
+                    "graftlint: raw-sync <why this primitive must "
+                    "stay raw>`)"))
+                return True  # malformed escape reported; don't double up
+    return False
+
+
+def check_file(path: str, root: str) -> List[Diagnostic]:
+    rel = relpath(path, root)
+    if rel.replace("/", os.sep).endswith(_SKIP_SUFFIXES) or \
+            any(rel.replace("/", os.sep).startswith(d)
+                for d in _SKIP_DIRS):
+        return []
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []  # py_locks already reports unparsable files
+    if not _shim_alias_names(tree):
+        return []  # not shim-migrated: raw construction is fine
+    lines = src.splitlines()
+    aliases = _Aliases(tree)
+    diags: List[Diagnostic] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = aliases.resolve(dotted(node.func))
+        if callee not in _RAW_CTORS:
+            continue
+        end = getattr(node, "end_lineno", None) or node.lineno
+        if _escape(lines, node.lineno, end, rel, diags):
+            continue
+        factory = callee.rsplit(".", 1)[-1]
+        diags.append(Diagnostic(
+            rel, node.lineno, "raw-sync",
+            f"raw `{callee}()` in a shim-migrated module — construct "
+            f"through the sync shim (`_sync.{factory}(...)`) so the "
+            "schedule explorer can interpose, or justify with "
+            "`# graftlint: raw-sync <reason>`"))
+    return diags
+
+
+def run(root: str, subdirs=("paddle_tpu",), files=(),
+        only: Optional[Set[str]] = None) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for p in walk_py(root, subdirs, files, only=only):
+        diags.extend(check_file(p, root))
+    return sorted(diags, key=lambda d: (d.path, d.line, d.rule))
+
+
+if __name__ == "__main__":
+    from common import REPO_ROOT
+    for d in run(REPO_ROOT):
+        print(d)

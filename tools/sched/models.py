@@ -1,0 +1,414 @@
+"""Model harnesses for the control-plane protocols graftsched explores.
+
+Three harnesses, matching the three protocols whose interlocks were
+each added reactively (see docs/STATIC_ANALYSIS.md, explorer section):
+
+* ``three_way_model`` — checkpoint-gate × reshard-cutover ×
+  failover-``suspend()``: a faithful miniature of the ps/ha.py +
+  ps/reshard.py protocol steps using the REAL lock names
+  (``control_mu``, ``_step_mu``, ``_op_mu``, ``_pause_mu``,
+  ``_susp_mu``), so the dynamic lock-order checker validates the same
+  ``# LOCK ORDER:`` declarations the static pass reads.  Two knobs
+  replay the protocol's history: ``gate_suspends=False`` reproduces
+  the pre-fix CheckpointGate (no ``coordinator.suspend()`` — a
+  mid-capture promotion routes the capture to an unpaused backup: the
+  torn-cut bug this explorer surfaced), and ``depth_counted=False``
+  reproduces the naive single-Event suspend (a reshard overlapping a
+  gate clears the GATE's suspension from its ``finally`` — the
+  second-order bug that makes the fix need nesting).  Defaults mirror
+  the fixed production protocol and must explore clean.
+
+* ``fleet_drain_tick_model`` — drives the REAL
+  serving.fleet.ServingFleet (stub router/store/members) through
+  ``drain()`` racing watcher ``tick()``s: the three seeded re-admit
+  races fixed in its history must stay closed in EVERY interleaving.
+
+* ``ckpt_writer_model`` — drives the REAL
+  io.job_checkpoint.JobCheckpointManager (``_write`` stubbed) through
+  two ``save()``s racing ``stop()``: every admitted snapshot must land
+  ahead of the shutdown sentinel, and stop() must terminate.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+import tempfile
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from paddle_tpu.core import sync as _sync  # noqa: E402
+
+#: sources whose `# LOCK ORDER:` / `# LOCK LEAF:` declarations the
+#: dynamic checker loads (testing.sched.load_lock_order) — the models
+#: use these exact lock names
+DECL_FILES = (
+    "paddle_tpu/ps/ha.py",
+    "paddle_tpu/ps/rpc.py",
+    "paddle_tpu/ps/reshard.py",
+    "paddle_tpu/serving/fleet.py",
+    "paddle_tpu/io/job_checkpoint.py",
+)
+
+
+# ---------------------------------------------------------------------------
+# 1. checkpoint-gate × reshard-cutover × failover three-way
+# ---------------------------------------------------------------------------
+
+class _ModelServer:
+    """One shard replica: pause depth + a data version (a write bumps
+    it; the capture must see a frozen version)."""
+
+    def __init__(self, ep: str) -> None:
+        self.ep = ep
+        self.pause_mu = _sync.Lock(name="_pause_mu")
+        self.pause_depth = 0
+        self.data = 0
+
+
+class _ThreeWay:
+    """ps/ha.py + ps/reshard.py control-plane protocol in miniature."""
+
+    def __init__(self, sched, gate_suspends: bool,
+                 depth_counted: bool) -> None:
+        self.sched = sched
+        self.gate_suspends = gate_suspends
+        self.depth_counted = depth_counted
+        # the real primitives, real names (HACluster / FailoverCoordinator
+        # / ReshardController)
+        self.control_mu = _sync.RLock(name="control_mu")
+        self.step_mu = _sync.Lock(name="_step_mu")
+        self.op_mu = _sync.Lock(name="_op_mu")
+        self.susp_mu = _sync.Lock(name="_susp_mu")
+        self.suspended = _sync.Event(name="suspended")
+        self.susp_depth = 0
+        self.servers = {"s0a": _ModelServer("s0a"),
+                        "s0b": _ModelServer("s0b")}
+        self.routing = {"epoch": 0,
+                        "shards": [{"primary": "s0a", "backups": ["s0b"]}]}
+        # the failure-detector's view: s0a's lease has expired (the
+        # gate's drain delayed its heartbeats past the TTL) — the
+        # coordinator WILL promote s0b if allowed to scan
+        self.alive = {"s0b"}
+
+    # routing store (read-modify-write; publish must be single-writer)
+    def read_routing(self):
+        self.sched.yield_point("routing.read")
+        shards = [dict(sh, backups=list(sh["backups"]))
+                  for sh in self.routing["shards"]]
+        return self.routing["epoch"], shards
+
+    def publish(self, epoch, shards):
+        self.sched.yield_point("routing.publish")
+        self.sched.check(
+            epoch == self.routing["epoch"] + 1,
+            f"routing clobbered: publish(epoch={epoch}) over live epoch "
+            f"{self.routing['epoch']} — a stale read-modify-write won the "
+            "race (suspend() exists to keep the routing table single-"
+            "writer)")
+        self.routing = {"epoch": epoch, "shards": shards}
+
+    def pause(self, ep: str, on: bool) -> None:
+        srv = self.servers[ep]
+        with srv.pause_mu:
+            srv.pause_depth += 1 if on else -1
+
+    # FailoverCoordinator.suspend()/resume_scans()
+    def suspend(self) -> None:
+        if self.depth_counted:
+            with self.susp_mu:
+                self.susp_depth += 1
+                self.suspended.set()
+        else:
+            self.suspended.set()
+        with self.step_mu:
+            pass            # barrier: in-flight scan finishes
+
+    def resume_scans(self) -> None:
+        if self.depth_counted:
+            with self.susp_mu:
+                self.susp_depth = max(0, self.susp_depth - 1)
+                if self.susp_depth == 0:
+                    self.suspended.clear()
+        else:
+            self.suspended.clear()
+
+    # -- tasks ------------------------------------------------------------
+
+    def failover_step(self) -> None:
+        """FailoverCoordinator.step(): promote the backup of a
+        lease-expired primary, fence, publish."""
+        with self.step_mu:
+            if self.suspended.is_set():
+                return
+            epoch, shards = self.read_routing()
+            sh = shards[0]
+            if sh["primary"] in self.alive:
+                return
+            cands = [b for b in sh["backups"] if b in self.alive]
+            if not cands:
+                return
+            new_prim = cands[0]
+            self.sched.yield_point("fence")     # epoch fence RPC
+            sh["primary"] = new_prim
+            sh["backups"] = [b for b in sh["backups"] if b != new_prim]
+            self.publish(epoch + 1, shards)
+
+    def gate_capture(self) -> None:
+        """CheckpointGate + the capture loop of JobCheckpointManager.
+        _capture: pause the routed primaries under control_mu, then
+        stream each table off the (re-resolved) routed primary."""
+        if self.gate_suspends:
+            self.suspend()
+        self.control_mu.acquire()
+        targets = []
+        try:
+            _, shards = self.read_routing()
+            targets = [sh["primary"] for sh in shards]
+            for ep in targets:
+                self.pause(ep, True)
+            # two registered tables; each read re-resolves the topology
+            # (RemoteSparseTable.refresh_routing under the gate)
+            captured = []
+            for tbl in range(2):
+                _, now = self.read_routing()
+                ep = now[0]["primary"]
+                srv = self.servers[ep]
+                with srv.pause_mu:
+                    self.sched.check(
+                        srv.pause_depth > 0,
+                        f"torn cut: capture streamed table{tbl} from "
+                        f"UNPAUSED {ep} — a mid-capture promotion routed "
+                        "the capture (and the writers) to a backup the "
+                        "gate never paused")
+                    captured.append(srv.data)
+            self.sched.check(
+                captured[0] == captured[1],
+                f"torn cut: tables captured at different data versions "
+                f"{captured} — mutations landed between table streams")
+        finally:
+            for ep in reversed(targets):
+                self.pause(ep, False)
+            self.control_mu.release()
+            if self.gate_suspends:
+                self.resume_scans()
+
+    def reshard_cutover(self) -> None:
+        """ReshardController._cutover: suspend scans, flip the routing
+        epoch under control_mu with sources paused."""
+        with self.op_mu:
+            self.suspend()
+            prims = []
+            try:
+                self.control_mu.acquire()
+                try:
+                    epoch, shards = self.read_routing()
+                    prims = [sh["primary"] for sh in shards]
+                    for ep in prims:
+                        self.pause(ep, True)
+                    self.publish(epoch + 1, shards)   # the flip
+                finally:
+                    self.control_mu.release()
+                # resume OUTSIDE control_mu (the real finally's order)
+                for ep in reversed(prims):
+                    self.pause(ep, False)
+            finally:
+                self.resume_scans()
+
+    def writer(self) -> None:
+        """A trainer push path: route, then mutate iff unpaused."""
+        for _ in range(2):
+            _, shards = self.read_routing()
+            srv = self.servers[shards[0]["primary"]]
+            with srv.pause_mu:
+                if srv.pause_depth == 0:
+                    srv.data += 1
+
+
+def three_way_model(gate_suspends: bool = True, depth_counted: bool = True,
+                    with_reshard: bool = True, with_writer: bool = True):
+    """Model factory for Explorer: gate × failover × reshard (+writer).
+
+    The writer widens the schedule space considerably; the systematic
+    pb-2 sweep runs the pure three-way (``with_writer=False``, where
+    the UNPAUSED-read check alone detects the torn cut) to exhaustion,
+    and the random-walk sweep adds the writer back for data-version
+    tears."""
+
+    def model(sched):
+        tw = _ThreeWay(sched, gate_suspends, depth_counted)
+        sched.spawn(tw.gate_capture, name="gate")
+        sched.spawn(tw.failover_step, name="failover")
+        if with_reshard:
+            sched.spawn(tw.reshard_cutover, name="reshard")
+        if with_writer:
+            sched.spawn(tw.writer, name="writer")
+
+    return model
+
+
+# ---------------------------------------------------------------------------
+# 2. ServingFleet drain vs watcher tick (REAL class under the scheduler)
+# ---------------------------------------------------------------------------
+
+class _StubFrontend:
+    def __init__(self):
+        self.stopped = False
+
+    def idle(self) -> bool:
+        return True
+
+    def stop(self) -> None:
+        self.stopped = True
+
+
+class _StubReplica:
+    def close(self) -> None:
+        pass
+
+    def kill(self) -> None:
+        pass
+
+
+class _StubMember:
+    """Duck-typed FleetMember: healthy, leased, no warm-handoff tier."""
+
+    def __init__(self, ep: str) -> None:
+        self.endpoint = ep
+        self.frontend = _StubFrontend()
+        self.replica = _StubReplica()
+        self.lookup = None
+
+    @property
+    def healthy(self) -> bool:
+        return True
+
+    def stop(self) -> None:
+        self.frontend.stop()
+
+    def crash(self) -> None:
+        self.frontend.stop()
+
+
+class _StubRouter:
+    def __init__(self):
+        self._mu = _sync.Lock(name="router_mu")
+        self._eps = []
+
+    def attach(self, member) -> None:
+        with self._mu:
+            if member.endpoint not in self._eps:
+                self._eps.append(member.endpoint)
+
+    def eject(self, ep: str) -> None:
+        with self._mu:
+            if ep in self._eps:
+                self._eps.remove(ep)
+
+    def remove(self, ep: str) -> None:
+        with self._mu:
+            if ep in self._eps:
+                self._eps.remove(ep)
+
+    def endpoints(self):
+        with self._mu:
+            return list(self._eps)
+
+    def inflight(self, ep: str) -> int:
+        return 0
+
+
+class _StubStore:
+    """Both members hold live observer leases for the whole run."""
+
+    def list_prefix(self, prefix: str):
+        return [f"{prefix}m0", f"{prefix}m1"]
+
+
+def fleet_drain_tick_model():
+    """drain("m1") racing two watcher tick()s.  Starting state: m1 was
+    ejected by the router on a transient error (the heal path's
+    trigger), so every tick WANTS to re-admit it while the drain is
+    taking it out on purpose.  Every interleaving must end with m1
+    out of routing, out of membership, and stopped."""
+    from paddle_tpu.serving.fleet import ServingFleet
+
+    def model(sched):
+        router = _StubRouter()
+        fleet = ServingFleet(_StubStore(), "sched", lambda: None, router,
+                             clock=lambda: 0.0, sleep=lambda s: None)
+        m0, m1 = _StubMember("m0"), _StubMember("m1")
+        fleet._members = {"m0": m0, "m1": m1}
+        fleet._join_order = ["m0", "m1"]
+        router._eps = ["m0"]           # m1 ejected on a transient error
+
+        def drainer():
+            fleet.drain("m1")
+
+        def ticker():
+            for _ in range(2):
+                fleet.tick()
+
+        sched.spawn(drainer, name="drain")
+        sched.spawn(ticker, name="tick")
+
+        def finish():
+            assert "m1" not in router.endpoints(), \
+                "drained member re-admitted to routing after drain()"
+            assert "m1" not in fleet._members, \
+                "drained member still in fleet membership"
+            assert m1.frontend.stopped, "drained member never stopped"
+            assert "m0" in router.endpoints(), \
+                "healthy member m0 fell out of routing"
+        sched.on_finish(finish)
+
+    return model
+
+
+# ---------------------------------------------------------------------------
+# 3. JobCheckpointManager writer vs save()/stop() (REAL class)
+# ---------------------------------------------------------------------------
+
+def ckpt_writer_model(root: str = None):
+    """Two save()s racing stop() over a depth-1 queue: admission is
+    atomic under _mu, the backpressured put is lock-free, and stop()'s
+    sentinel must land BEHIND every admitted snapshot."""
+    from paddle_tpu.io.job_checkpoint import JobCheckpointManager
+
+    base = root or os.path.join(tempfile.gettempdir(), "graftsched-ckpt")
+
+    def model(sched):
+        shutil.rmtree(base, ignore_errors=True)
+        os.makedirs(base, exist_ok=True)
+        mgr = JobCheckpointManager(base, max_keep=4, queue_depth=1)
+        written = []
+        mgr._write = lambda snap: written.append(snap.ckpt_id)
+        admitted = []
+
+        def saver(step):
+            try:
+                admitted.append(mgr.save(step))
+            except Exception:      # noqa: BLE001 — save-after-stop is a
+                pass               # legal loser of the race
+
+        def stopper():
+            mgr.stop()
+
+        sched.spawn(lambda: saver(1), name="saver1")
+        sched.spawn(lambda: saver(2), name="saver2")
+        sched.spawn(stopper, name="stop")
+
+        def finish():
+            assert set(admitted) <= set(written), \
+                f"admitted snapshot lost: save() returned {admitted} but " \
+                f"writer only wrote {written} — a snapshot landed behind " \
+                "the shutdown sentinel"
+            assert mgr._thread is None or not mgr._thread.is_alive(), \
+                "writer thread survived stop()"
+        sched.on_finish(finish)
+
+    return model
