@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI gate (reference L0's cmake+ctest role): graftlint, native build,
 # fast test gate, then the full matrix.
-# Usage: ./ci.sh [lint [--changed]|sched|fast|full|chaos|ckpt|hot_tier|serving|serving_fleet|obs|slo|reshard]
+# Usage: ./ci.sh [lint [--changed]|sched|fast|full|chaos|ckpt|hot_tier|serving|serving_fleet|obs|slo|reshard|endurance]
 #   sched — graftsched gate: deterministic-schedule exploration of the
 #   control-plane protocol harnesses (tools/sched/models.py) — the
 #   preemption-bound-2 schedule space EXHAUSTED plus seeded random
@@ -36,6 +36,14 @@
 #   contains the firing window, and the live exporter's /metrics must
 #   validate as well-formed OpenMetrics; the overhead bench re-asserts
 #   the sampler+watchdog cost inside the 2% budget.
+#   endurance — cold-tier scale gate: the ssd cold-tier suite (admission
+#   / compact index / block compression / io-budgeted bg compaction,
+#   incl. the SIGKILL-mid-compaction chaos test), then the endurance
+#   demo — a Zipf stream over a universe 50x the hot budget must admit
+#   <=1/3 of offered uniques at the default threshold, measure <=16
+#   index bytes per cold row, keep serve pull p99 bounded while the
+#   background compactor churns, and checkpoint/restore digest-exact
+#   mid-compaction (SSD_ENDURANCE.json is the archived artifact).
 #   reshard — live elastic resharding + SLO-driven autoscaling gate:
 #   the full reshard/autoscale suites incl. the slow chaos e2e (grow
 #   2→4 and shrink back mid-CtrStreamTrainer with an armed kill-shard
@@ -100,6 +108,55 @@ print("sched summary archived -> %s  (%d schedules, %.1fs)" % (
     s.get("wall_ms", 0) / 1000.0))
 PYEOF
   echo "CI OK (sched)"
+  exit 0
+fi
+
+if [[ "${1:-fast}" == "endurance" ]]; then
+  echo "== endurance gate: cold-tier admission/index/compression/io-budget =="
+  # the suite first: a format or reconcile regression fails in seconds,
+  # before the demo pays its stream (incl. the armed-SIGKILL chaos run)
+  python -m pytest tests/test_ssd_cold_tier.py -q
+  echo "== ssd endurance demo (Zipf stream, universe 50x hot budget) =="
+  # the admission / index / digest asserts are exact; the p99 ratio and
+  # RSS bounds carry shared-1-core-host headroom (the committed
+  # SSD_ENDURANCE.json shows the quiet-host numbers: ~1.5x churn p99,
+  # ~22 MB growth) — one retry absorbs ambient-load outliers
+  check_endurance() {
+    PYTHONPATH="$PWD:${PYTHONPATH:-}" JAX_PLATFORMS=cpu \
+      SSD_END_OUT=${SSD_END_OUT:-/tmp/ci_ssd_endurance.json} \
+      python tools/ssd_endurance_demo.py | python -c "
+import json, sys
+d = json.loads([l for l in sys.stdin.read().splitlines()
+                if l.startswith('{')][-1])
+assert 'error' not in d, d
+assert d['universe'] >= 10 * d['hot_budget'], d
+# THE admission acceptance: >=3x fewer rows than offered uniques at
+# the default threshold (the singleton tail never earns a row)
+assert d['offered_over_admitted'] >= 3.0, d
+assert d['admit_rejects'] > 0, d
+# THE index acceptance: <=16 measured bytes per cold row (44.7 baseline)
+assert 0 < d['index_bytes_per_row'] <= 16.0, d
+# io-budget isolation: serve p99 under compactor churn stays within a
+# bounded multiple of the no-compaction baseline
+assert d['pull_p99_ratio'] <= 10.0, d
+assert d['bg_compactions'] > 0 and d['bg_backlog_final'] == 0, d
+assert d['io_bg_bytes'] > 0, d
+# durability: checkpoint taken mid-compaction restores digest-exact
+assert d['digest_exact'] and d['digest_stable_under_churn'], d
+assert d['restored_rows'] == d['saved_rows'] > 0, d
+# RSS tracks the hot budget + index, never the universe
+assert d['rss_growth_bytes'] <= 256 * 1024 * 1024, d
+print('endurance OK: %.1fx admission leverage (%d uniques -> %d rows), '
+      '%.1f index B/row, churn p99 %.2fx baseline (%.1fms), '
+      'digest-exact restore of %d rows'
+      % (d['offered_over_admitted'], d['offered_uniques'],
+         d['admitted_rows'], d['index_bytes_per_row'],
+         d['pull_p99_ratio'], d['pull_p99_ms_churn'],
+         d['restored_rows']))"
+  }
+  check_endurance || { echo "endurance retry (ambient-load outlier)"; \
+    check_endurance; }
+  echo "CI OK (endurance)"
   exit 0
 fi
 

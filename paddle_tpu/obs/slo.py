@@ -50,7 +50,8 @@ from . import registry as _registry
 from .timeseries import MetricRing, Sampler
 from .trace import wall_s
 
-__all__ = ["SloRule", "Alert", "SloWatchdog", "default_rules"]
+__all__ = ["SloRule", "Alert", "SloWatchdog", "default_rules",
+           "cold_tier_rules"]
 
 
 @dataclasses.dataclass
@@ -363,4 +364,40 @@ def default_rules(step_p95_s: float = 1.0,
                 labels={"outcome": "launched"}, kind="threshold",
                 field="delta", agg="rate", threshold=hedge_rate_per_s,
                 windows=((short_s, 1.0),)),
+    ]
+
+
+def cold_tier_rules(backlog_shards: float = 0.5,
+                    bg_wait_ms_per_s: float = 500.0,
+                    index_bytes_per_row: float = 16.0,
+                    long_s: float = 120.0) -> List[SloRule]:
+    """SSD cold-tier rules over the ``ssd_*`` families that
+    SsdSparseTable.obs_probe exports (docs/OPERATIONS.md cold-tier
+    runbook). The first two triage the same symptom (disk bytes
+    climbing) into opposite causes:
+
+    - ``cold_compaction_starved`` — the deferred-compaction backlog
+      stays nonzero across the window: shards keep being marked dirty
+      but the worker never drains them. If ``ssd_io_bg_wait_ms`` is
+      ALSO burning the budget is the bottleneck; otherwise the worker
+      is wedged or stopped.
+    - ``cold_io_budget_tight`` — the compactor spends more than
+      ``bg_wait_ms_per_s`` ms per second parked on the token bucket:
+      compaction cannot keep up AT THIS BUDGET. Raise the budget (or
+      schedule compaction off-peak) before the log-garbage ratio grows.
+    - ``cold_index_bloat`` — measured index bytes/row above the design
+      ceiling: the open-addressing table degenerated (mass deletes
+      without a rebuild) or the shard row estimate drifted.
+    """
+    w = ((long_s, 1.0),)
+    return [
+        SloRule("cold_compaction_starved", "ssd_bg_backlog",
+                kind="threshold", agg="mean", threshold=backlog_shards,
+                windows=w, min_count=3),
+        SloRule("cold_io_budget_tight", "ssd_io_bg_wait_ms",
+                kind="threshold", field="delta", agg="rate",
+                threshold=bg_wait_ms_per_s, windows=w, min_count=3),
+        SloRule("cold_index_bloat", "ssd_index_bytes_per_row",
+                kind="threshold", agg="max",
+                threshold=index_bytes_per_row, windows=w, min_count=3),
     ]

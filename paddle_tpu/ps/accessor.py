@@ -46,6 +46,14 @@ class AccessorConfig:
     delete_threshold: float = 0.8
     delete_after_unseen_days: float = 30.0
     embedx_threshold: float = 10.0  # create embedx lazily past this score
+    # SSD cold-tier row admission: a key must be OBSERVED (pushed) this
+    # many times before it materializes a durable embedding row — the
+    # lifecycle's front door, the same way embedx_threshold gates the
+    # extended columns. 0/1 admits everything. TableConfig.
+    # ssd_admission_threshold overrides when set; this is the
+    # accessor-level default so per-accessor policies travel with the
+    # accessor config exactly like the other lifecycle thresholds.
+    admission_threshold: int = 0
     embed_sgd_rule: str = "adagrad"
     embedx_sgd_rule: str = "adagrad"
     sgd: SGDRuleConfig = dataclasses.field(default_factory=SGDRuleConfig)

@@ -114,6 +114,14 @@ class PSClient:
         transport, table.digest locally)."""
         raise NotImplementedError
 
+    def table_stats(self, table_id: int) -> Dict[str, int]:
+        """Storage statistics of a sparse table. For SSD tables this is
+        the full cold-tier vector (admission hit/miss, index + sketch
+        bytes, io-budget counters, compaction backlog — ps/table.py
+        SsdSparseTable.stats); memory tables report {} — the obs
+        exporter treats absence as 'no cold tier'."""
+        raise NotImplementedError
+
 
 class LocalPsClient(PSClient):
     def __init__(self, server: PsServerHandle) -> None:
@@ -173,3 +181,8 @@ class LocalPsClient(PSClient):
 
     def digest(self, table_id):
         return self._sparse(table_id).digest()
+
+    def table_stats(self, table_id):
+        table = self._sparse(table_id)
+        stats = getattr(table, "stats", None)
+        return dict(stats()) if callable(stats) else {}

@@ -157,6 +157,20 @@ def load_ps_config(source: Union[str, Dict[str, Any]]) -> PsJobConfig:
         accessor=accessor_name,
         accessor_config=AccessorConfig(embedx_dim=feature_dim - 1),
         converter=_get(cfg, "table_parameters.converter"),
+        # SSD cold-tier knobs (ignored for storage="memory" tables; the
+        # storage/ssd_path pair itself is set by the server launcher)
+        ssd_value_dtype=str(_get(cfg, "table_parameters.ssd_value_dtype",
+                                 "fp32")),
+        ssd_block_compress=bool(_get(
+            cfg, "table_parameters.ssd_block_compress", False)),
+        ssd_admission_threshold=int(_get(
+            cfg, "table_parameters.ssd_admission_threshold", 0)),
+        ssd_admission_sketch_kb=int(_get(
+            cfg, "table_parameters.ssd_admission_sketch_kb", 64)),
+        ssd_bg_compact=bool(_get(
+            cfg, "table_parameters.ssd_bg_compact", False)),
+        ssd_io_budget_mbps=float(_get(
+            cfg, "table_parameters.ssd_io_budget_mbps", 0.0)),
     )
 
     return PsJobConfig(

@@ -12,7 +12,7 @@ import ctypes
 import os
 import subprocess
 import threading
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -547,6 +547,36 @@ class NativeSparseTableEngine:
 # SSD (two-tier) sparse-table engine (csrc/ssd_table.cc)
 # ---------------------------------------------------------------------------
 
+# sst_create2 flag bits — mirror of the csrc flag contract
+SST_FLAG_VALUE_F16 = 1       # value columns stored fp16 on disk
+SST_FLAG_BLOCK_COMPRESS = 2  # log block-compressed (deflate + shared dict)
+
+# sst_stats2 field layout — EXACT mirror of ssd_table.cc's SstStatField
+# enum (graftlint wire_contract cross-checks name order and indices)
+SST_STAT_FIELDS = {
+    "hot_rows": 0,
+    "cold_rows": 1,
+    "disk_bytes": 2,
+    "index_bytes": 3,
+    "sketch_bytes": 4,
+    "admit_checks": 5,
+    "admit_rejects": 6,
+    "admit_admitted": 7,
+    "bg_compactions": 8,
+    "bg_backlog": 9,
+    "io_serve_bytes": 10,
+    "io_bg_bytes": 11,
+    "io_bg_wait_ms": 12,
+    "open_block_bytes": 13,
+}
+SST_STAT_COUNT = 14
+
+# block-compressed log record format — mirror of the csrc constants; the
+# wire_contract pass fails tier-1 if either side drifts
+SST_BLOCK_MAGIC = 0x4B4C4253  # 'SBLK' little-endian
+SST_BLOCK_RECS = 128          # records per sealed block
+SST_BLOCK_HDR_BYTES = 16      # u32 magic | u32 comp_len | u32 n_recs | u32 crc
+
 
 def _configure_sst(lib: ctypes.CDLL) -> None:
     u64p = ctypes.POINTER(ctypes.c_uint64)
@@ -595,6 +625,22 @@ def _configure_sst(lib: ctypes.CDLL) -> None:
     if hasattr(lib, "sst_digest"):
         lib.sst_digest.restype = ctypes.c_uint64
         lib.sst_digest.argtypes = [ctypes.c_void_p]
+    # cold-tier scale surface (admission / compact index / io budget /
+    # background compaction) — optional so a stale .so still loads for
+    # the legacy paths; SsdTableEngine raises lazily where required
+    if hasattr(lib, "sst_stats2"):
+        lib.sst_stats2.restype = ctypes.c_int32
+        lib.sst_stats2.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int32]
+        lib.sst_admission_config.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                             ctypes.c_int32]
+        lib.sst_io_budget.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                      ctypes.c_int64]
+        lib.sst_bg_start.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.sst_bg_stop.argtypes = [ctypes.c_void_p]
+        lib.sst_bg_step.restype = ctypes.c_int32
+        lib.sst_bg_step.argtypes = [ctypes.c_void_p, ctypes.c_int32,
+                                    ctypes.c_int32]
+        lib.sst_compact_async.argtypes = [ctypes.c_void_p]
 
 
 class SsdTableEngine:
@@ -605,7 +651,8 @@ class SsdTableEngine:
     fallback for the disk tier."""
 
     def __init__(self, shard_num: int, accessor: str, acc_cfg,
-                 seed: int, path: str, value_f16: bool = False) -> None:
+                 seed: int, path: str, value_f16: bool = False,
+                 block_compress: bool = False) -> None:
         self._lib = load_native()
         if self._lib is None:
             raise RuntimeError("native library unavailable")
@@ -617,9 +664,10 @@ class SsdTableEngine:
             self._lib._sst_configured = True
         iparams, fparams = table_native_params(shard_num, accessor, acc_cfg,
                                                seed)
+        flags = (SST_FLAG_VALUE_F16 if value_f16 else 0) | \
+            (SST_FLAG_BLOCK_COMPRESS if block_compress else 0)
         self._h = self._lib.sst_create2(_i32(iparams), _f32(fparams),
-                                        str(path).encode(),
-                                        1 if value_f16 else 0)
+                                        str(path).encode(), flags)
         if not self._h:
             raise RuntimeError(f"ssd table open failed at {path!r}")
         self._save_lock = threading.Lock()
@@ -678,8 +726,65 @@ class SsdTableEngine:
         return int(self._lib.sst_spill(self._h, ctypes.c_int64(budget)))
 
     def compact(self) -> int:
-        """Rewrite the logs to live records only; returns disk bytes after."""
+        """Rewrite the logs to live records only; returns disk bytes after.
+        With the background compactor running this marks every shard
+        forced and BLOCKS until the worker drains them."""
         return int(self._lib.sst_compact(self._h))
+
+    def _require_scale_api(self) -> None:
+        if not hasattr(self._lib, "sst_stats2"):
+            raise RuntimeError("stale native library lacks cold-tier scale "
+                               "symbols (sst_stats2…) — rebuild paddle_tpu/csrc")
+
+    def stats2(self) -> Dict[str, int]:
+        """Full cold-tier stat vector keyed by SST_STAT_FIELDS (admission
+        hit/miss, index + sketch bytes, io-budget counters, compaction
+        backlog…)."""
+        self._require_scale_api()
+        out = np.zeros(SST_STAT_COUNT, np.int64)
+        n = int(self._lib.sst_stats2(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            SST_STAT_COUNT))
+        return {name: int(out[i]) for name, i in SST_STAT_FIELDS.items()
+                if i < n}
+
+    def admission_config(self, threshold: int, sketch_kb: int = 64) -> None:
+        """A key earns a durable row only after `threshold` observations
+        (push misses); 0/1 disables the pre-filter. `sketch_kb` sizes the
+        per-shard counting sketch."""
+        self._require_scale_api()
+        self._lib.sst_admission_config(self._h, int(threshold),
+                                       int(sketch_kb))
+
+    def io_budget(self, rate_bps: int, cap_bytes: int = 0) -> None:
+        """Token-bucket disk budget shared by serve-class IO and the
+        background compactor (serve never blocks; bg waits). 0 disables
+        metering."""
+        self._require_scale_api()
+        self._lib.sst_io_budget(self._h, int(rate_bps), int(cap_bytes))
+
+    def bg_start(self, interval_ms: int = 200) -> None:
+        """Start the background compaction thread (sweeps the compaction
+        policy every `interval_ms`, wakes early on explicit requests)."""
+        self._require_scale_api()
+        self._lib.sst_bg_start(self._h, int(interval_ms))
+
+    def bg_stop(self) -> None:
+        self._require_scale_api()
+        self._lib.sst_bg_stop(self._h)
+
+    def bg_step(self, shard: int, force: bool = False) -> int:
+        """Run ONE background-compaction step inline (deterministic test
+        hook; refused with -1 while the live thread runs)."""
+        self._require_scale_api()
+        return int(self._lib.sst_bg_step(self._h, int(shard),
+                                         1 if force else 0))
+
+    def compact_async(self) -> None:
+        """Request a forced compaction of every shard WITHOUT waiting
+        (the bg thread picks it up; no-op queue marker when bg is off)."""
+        self._require_scale_api()
+        self._lib.sst_compact_async(self._h)
 
     def flush(self) -> None:
         self._lib.sst_flush(self._h)

@@ -100,6 +100,14 @@ def converter_entry(name: Optional[str]):
     return _CONVERTERS[name]
 
 
+def _hard_kill_process() -> None:
+    # kill-job faultpoint callable: die like a preemption — no atexit,
+    # no flushes, nothing graceful (io/job_checkpoint.py idiom)
+    import signal
+
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
 def row_digest(keys: np.ndarray, values: np.ndarray) -> int:
     """Python mirror of the native content digest (pstpu::row_hash,
     sparse_table.h): per-row FNV-1a over [key bytes ++ full-row float
@@ -207,6 +215,22 @@ class TableConfig:
     # fp32 optimizer state; every read widens, so digests/snapshots/
     # replication see the widened canonical form (csrc/ssd_table.cc)
     ssd_value_dtype: str = "fp32"
+    # SSD cold-tier scale knobs (storage="ssd" only, csrc/ssd_table.cc):
+    # block-compress the disk logs (records grouped 128/block, deflate +
+    # shared dictionary — pairs well with ssd_value_dtype="fp16")
+    ssd_block_compress: bool = False
+    # a key earns a durable embedding row only after this many push
+    # observations (counting-sketch pre-filter, decayed by shrink);
+    # 0/1 = admit everything (default — training parity unchanged)
+    ssd_admission_threshold: int = 0
+    # per-shard admission sketch size
+    ssd_admission_sketch_kb: int = 64
+    # run compaction/shrink sweeps on a background thread instead of
+    # inline on the push path (default off: deterministic tests)
+    ssd_bg_compact: bool = False
+    # token-bucket disk budget in MB/s shared by serve-class IO and the
+    # background compactor (serve never blocks; bg waits). 0 = unmetered
+    ssd_io_budget_mbps: float = 0.0
 
 
 class _SparseShard:
@@ -665,9 +689,22 @@ class SsdSparseTable(MemorySparseTable):
         self._native = SsdTableEngine(
             self.config.shard_num, self.config.accessor,
             self.accessor.config, self.config.seed, path=self.path,
-            value_f16=self.config.ssd_value_dtype == "fp16")
+            value_f16=self.config.ssd_value_dtype == "fp16",
+            block_compress=bool(self.config.ssd_block_compress))
         self._shards = []
         self._pool = None
+        # TableConfig wins; the accessor-level default travels with the
+        # rest of the lifecycle thresholds (AccessorConfig)
+        admit = (self.config.ssd_admission_threshold
+                 or getattr(self.accessor.config, "admission_threshold", 0))
+        if admit > 1:
+            self._native.admission_config(
+                admit, self.config.ssd_admission_sketch_kb)
+        if self.config.ssd_io_budget_mbps > 0:
+            self._native.io_budget(
+                int(self.config.ssd_io_budget_mbps * 1024 * 1024))
+        if self.config.ssd_bg_compact:
+            self._native.bg_start()
 
     @property
     def backend(self) -> str:
@@ -683,7 +720,65 @@ class SsdSparseTable(MemorySparseTable):
 
     def stats(self) -> Dict[str, int]:
         hot, cold, disk_bytes = self._native.stats()
-        return {"hot_rows": hot, "cold_rows": cold, "disk_bytes": disk_bytes}
+        out = {"hot_rows": hot, "cold_rows": cold, "disk_bytes": disk_bytes}
+        try:
+            full = self._native.stats2()
+        except RuntimeError:  # stale .so: legacy triple only
+            return out
+        out.update(full)
+        # derived: operators read bytes/row, not raw index bytes
+        out["index_bytes_per_row"] = (
+            full["index_bytes"] / cold if cold else 0.0)
+        return out
+
+    def compact_async(self) -> None:
+        """Request forced compaction without blocking (bg thread)."""
+        from .faultpoints import faultpoint
+
+        self._native.compact_async()
+        # chaos site: die like a preemption with the background sweep
+        # mid-copy (its `.compact` temp half-written) — recovery must
+        # replay the durable log and ignore the orphan temp file
+        faultpoint("ssd.compact", kill=_hard_kill_process)
+
+    # cold-tier stat → obs family map: monotonic fields become registry
+    # counters (ring stores rates), level fields become gauges
+    _OBS_COUNTERS = ("admit_checks", "admit_rejects", "admit_admitted",
+                     "bg_compactions", "io_serve_bytes", "io_bg_bytes",
+                     "io_bg_wait_ms")
+    _OBS_GAUGES = ("hot_rows", "cold_rows", "disk_bytes", "index_bytes",
+                   "sketch_bytes", "bg_backlog", "open_block_bytes",
+                   "index_bytes_per_row")
+
+    def obs_probe(self) -> None:
+        """Sampler probe (obs/timeseries.py ``add_probe``): export the
+        cold-tier stat vector as ``ssd_<name>`` series — admission
+        hit/miss rates, index bytes/row, io-budget utilization and the
+        deferred-compaction backlog become queryable curves that
+        obs/slo.py ``cold_tier_rules`` watch."""
+        from ..obs import registry as _obs_registry
+
+        st = self.stats()
+        if "admit_checks" not in st:  # stale .so: legacy triple only
+            return
+        tid = str(self.config.table_id)
+        handles = getattr(self, "_obs_handles", None)
+        if handles is None:
+            reg = _obs_registry.REGISTRY
+            handles = self._obs_handles = {
+                n: reg.counter(f"ssd_{n}", table=tid)
+                for n in self._OBS_COUNTERS}
+            handles.update({
+                n: reg.gauge(f"ssd_{n}", table=tid)
+                for n in self._OBS_GAUGES})
+            self._obs_last = {n: 0 for n in self._OBS_COUNTERS}
+        for n in self._OBS_COUNTERS:
+            delta = int(st[n]) - self._obs_last[n]
+            if delta > 0:
+                handles[n].inc(delta)
+                self._obs_last[n] = int(st[n])
+        for n in self._OBS_GAUGES:
+            handles[n].set(float(st[n]))
 
     def flush(self) -> None:
         self._native.flush()

@@ -563,13 +563,16 @@ def _transitive_closure(edges: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
 def load_lock_order(paths: Sequence[str]) -> Tuple[Dict[str, Set[str]],
                                                    Set[str]]:
     """Merged ``LOCK ORDER``/``LOCK LEAF`` declarations from the
-    given source files, parsed by the SAME grammar as the static pass
-    (tools/lint/py_locks._parse_decls) so dynamic checking can never
-    drift from what pass 7 enforces."""
+    given source files, parsed by the SAME grammar as the static
+    passes (tools/lint/py_locks._parse_decls for ``#`` comments,
+    tools/lint/lock_order._parse_order for ``//`` comments in
+    csrc/*.cc) so dynamic checking can never drift from what passes
+    2 and 7 enforce."""
     import sys
     lint_dir = os.path.join(_REPO_ROOT, "tools", "lint")
     if lint_dir not in sys.path:
         sys.path.insert(0, lint_dir)
+    import lock_order  # noqa: PLC0415 — test-only, lazy on purpose
     import py_locks  # noqa: PLC0415 — test-only, lazy on purpose
     edges: Dict[str, Set[str]] = {}
     leaves: Set[str] = set()
@@ -578,7 +581,10 @@ def load_lock_order(paths: Sequence[str]) -> Tuple[Dict[str, Set[str]],
             p = os.path.join(_REPO_ROOT, p)
         with open(p, encoding="utf-8") as f:
             lines = f.read().splitlines()
-        e, l, diags = py_locks._parse_decls(lines, p)
+        if p.endswith((".cc", ".h")):
+            e, l, diags = lock_order._parse_order(lines, p)
+        else:
+            e, l, diags = py_locks._parse_decls(lines, p)
         bad = [d for d in diags if d.rule == "lock-order-syntax"]
         if bad:
             raise ValueError(f"malformed lock decl: {bad[0]}")

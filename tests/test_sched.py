@@ -272,6 +272,59 @@ def test_fleet_drain_tick_pb2_exhausts_clean():
 
 
 # ---------------------------------------------------------------------------
+# cold-tier two-phase compactor harness (csrc/ssd_table.cc miniature)
+# ---------------------------------------------------------------------------
+
+#: the bug class the phase-B reconcile exists for: a naive publisher
+#: installing the phase-A snapshot verbatim loses the push-path rewrite
+#: that landed during the unlocked copy. Five choices, explorer-shrunk.
+SSD_STALE_PUBLISH_SCHEDULE = ["bg", "bg", "bg", "bg", "save"]
+
+
+def test_ssd_compact_naive_publisher_found_and_pins():
+    ex = Explorer(models.ssd_compact_model(two_phase=False,
+                                           with_shrink=False),
+                  order_decls=_DECLS)
+    f, _ = ex.explore_dfs(bound=2, max_schedules=5000)
+    assert f is not None and f.kind == "invariant"
+    # both manifestations of the missing reconcile are legal first finds
+    assert any(s in f.message for s in
+               ("lost", "BOTH tiers", "resurrected"))
+    pinned = ex.replay_choices(SSD_STALE_PUBLISH_SCHEDULE)
+    assert pinned.failure is not None
+    assert "rewrite lost" in pinned.failure.message
+
+
+def test_ssd_compact_fixed_pb1_exhausts_clean():
+    # pb-1 here for test-suite speed; ci.sh sched runs the pb-2 space
+    # (~100k schedules) to exhaustion
+    ex = Explorer(models.ssd_compact_model(with_shrink=False),
+                  order_decls=_DECLS)
+    f, exhausted = ex.explore_dfs(bound=1, max_schedules=10000)
+    assert f is None, f and f.format()
+    assert exhausted
+    # the stale-publish schedule replays CLEAN against phase-B reconcile
+    pinned = ex.replay_choices(SSD_STALE_PUBLISH_SCHEDULE)
+    assert pinned.failure is None, pinned.failure
+
+
+def test_ssd_compact_random_walk_with_shrink_clean():
+    ex = Explorer(models.ssd_compact_model(), order_decls=_DECLS)
+    f = ex.explore_random(300, base_seed=20260807)
+    assert f is None, f and f.format()
+
+
+def test_ssd_csrc_lock_decls_loaded():
+    # load_lock_order dispatches to the csrc `//` grammar for .cc files:
+    # the compactor's declaration must be in the merged order
+    edges, leaves = _DECLS
+    assert "bg_mu" in edges.get("disk_mu", set())
+    assert "disk_mu" in edges.get("shard_mu", set())
+    assert "mem_save_mu" in edges.get("ssd_save_mu", set())
+    assert "io_mu" in leaves
+
+
+# ---------------------------------------------------------------------------
 # JobCheckpointManager writer vs. save/stop harness
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,507 @@
+"""Trillion-feature cold tier: Bloom-gated admission, compact key
+index, block-compressed values, io-budgeted background compaction.
+
+Covers the four cost attacks of the cold-tier scale work end to end
+through the Python table layer:
+
+* admission — a key earns an embedding row only after the configured
+  number of push observations; unadmitted reads serve the deterministic
+  init row (byte-equal to what create would have made), and the sketch
+  decays with the lifecycle shrink;
+* index — measured bytes/row of the open-addressing compact index stays
+  under the 16 B/row target (vs ~44.7 for the hash-map baseline);
+* storage — fp16 + block-compressed value logs round-trip digest-exact
+  through write → shrink → compact → checkpoint → restore → replay;
+* io-budget isolation — the background compactor is digest-invariant
+  under churn, and a SIGKILL landing mid-copy (armed via
+  ps/faultpoints.py at the ``ssd.compact`` site) never loses durable
+  rows: the orphan ``.compact`` temp is ignored on recovery.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.ps.accessor import AccessorConfig
+from paddle_tpu.ps.native import native_available
+from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+from paddle_tpu.ps.table import SsdSparseTable, TableConfig
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native library unavailable")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _acc(**kw):
+    kw.setdefault("sgd", SGDRuleConfig(initial_range=0.0))
+    kw.setdefault("embedx_dim", 4)
+    kw.setdefault("embedx_threshold", 0.0)
+    return AccessorConfig(**kw)
+
+
+def _cfg(**kw):
+    kw.setdefault("shard_num", 4)
+    kw.setdefault("storage", "ssd")
+    kw.setdefault("accessor_config", _acc())
+    return TableConfig(**kw)
+
+
+def _grad(table, keys, seed=0):
+    rng = np.random.default_rng(seed)
+    push = np.zeros((len(keys), table.accessor.push_dim), np.float32)
+    push[:, 0] = (keys % 8).astype(np.float32)
+    push[:, 1] = 1.0
+    push[:, 3:] = rng.normal(size=(len(keys), push.shape[1] - 3)) \
+        .astype(np.float32)
+    return push
+
+
+def _fill_cold(table, n=400, seed=0, scale=1.0):
+    """Cold-tier population with realistic sparsity (zero opt state,
+    nonzero show + embedding) so block compression has signal."""
+    rng = np.random.default_rng(seed)
+    keys = np.unique(rng.integers(1, 1 << 40, n).astype(np.uint64))
+    vals = np.zeros((len(keys), table.full_dim), np.float32)
+    vals[:, 3] = 1.0                                      # show
+    vals[:, 5] = scale * rng.normal(size=len(keys)).astype(np.float32)
+    table.import_full(keys, vals)
+    return keys, vals
+
+
+# ---------------------------------------------------------------------------
+# admission (counting-Bloom pre-filter)
+# ---------------------------------------------------------------------------
+
+def test_admission_gate_defers_row_creation(tmp_path):
+    """threshold=2: pulls never admit, the first push only bumps the
+    sketch (gradient dropped), the second push creates the row and
+    applies its gradient — byte-equal to one push on an ungated table."""
+    gated = SsdSparseTable(str(tmp_path / "g"),
+                           _cfg(ssd_admission_threshold=2))
+    plain = SsdSparseTable(str(tmp_path / "p"), _cfg())
+    keys = np.arange(1, 201, dtype=np.uint64)
+
+    gated.pull_sparse(keys, create=True)
+    assert gated.size() == 0, "pull admitted rows below threshold"
+
+    g1 = _grad(gated, keys, seed=1)
+    gated.push_sparse(keys, g1)
+    assert gated.size() == 0, "first push admitted below threshold"
+
+    g2 = _grad(gated, keys, seed=2)
+    gated.push_sparse(keys, g2)
+    assert gated.size() == len(keys)
+    # the admitting push applies ITS gradient (the sub-threshold one
+    # was dropped): mirror = a single push on the ungated table
+    plain.push_sparse(keys, g2)
+    np.testing.assert_array_equal(
+        gated.pull_sparse(keys, create=False),
+        plain.pull_sparse(keys, create=False))
+
+
+def test_unadmitted_pull_serves_init_rows(tmp_path):
+    """Below-threshold pulls return the deterministic init row — the
+    exact bytes create would have produced — so training code can't
+    tell a gated key from a fresh one."""
+    acc = _acc(sgd=SGDRuleConfig(initial_range=0.1))
+    gated = SsdSparseTable(str(tmp_path / "g"),
+                           _cfg(accessor_config=acc,
+                                ssd_admission_threshold=3))
+    plain = SsdSparseTable(str(tmp_path / "p"), _cfg(accessor_config=acc))
+    keys = np.arange(1, 301, dtype=np.uint64)
+    np.testing.assert_array_equal(gated.pull_sparse(keys, create=True),
+                                  plain.pull_sparse(keys, create=True))
+    assert gated.size() == 0 and plain.size() == len(keys)
+
+
+def test_admission_sketch_decays_with_shrink(tmp_path):
+    """shrink() halves every sketch counter: stale near-admissions age
+    out instead of accumulating forever."""
+    t = SsdSparseTable(str(tmp_path / "t"), _cfg(ssd_admission_threshold=2))
+    keys = np.arange(1, 101, dtype=np.uint64)
+    t.push_sparse(keys, _grad(t, keys))    # count 1
+    t.shrink()                             # decay: 1 -> 0
+    t.push_sparse(keys, _grad(t, keys))    # count 1 again — not 2
+    assert t.size() == 0, "decayed sketch still admitted"
+    t.push_sparse(keys, _grad(t, keys))    # count 2 -> admit
+    assert t.size() == len(keys)
+
+
+def test_admission_stats_and_table_config_threshold(tmp_path):
+    """The stat vector tells the admission story: checks = gated push
+    observations, rejects + admitted partition them."""
+    t = SsdSparseTable(str(tmp_path / "t"), _cfg(ssd_admission_threshold=2))
+    keys = np.arange(1, 151, dtype=np.uint64)
+    t.push_sparse(keys, _grad(t, keys))
+    t.push_sparse(keys, _grad(t, keys))
+    st = t.stats()
+    assert st["admit_checks"] >= 2 * len(keys)
+    assert st["admit_admitted"] == len(keys)
+    assert st["admit_rejects"] >= len(keys)
+    assert st["sketch_bytes"] > 0
+
+
+def test_accessor_admission_threshold_default(tmp_path):
+    """AccessorConfig.admission_threshold flows through when the table
+    knob is unset (TableConfig.ssd_admission_threshold overrides)."""
+    t = SsdSparseTable(
+        str(tmp_path / "t"),
+        _cfg(accessor_config=_acc(admission_threshold=2)))
+    keys = np.arange(1, 51, dtype=np.uint64)
+    t.push_sparse(keys, _grad(t, keys))
+    assert t.size() == 0
+    t.push_sparse(keys, _grad(t, keys))
+    assert t.size() == len(keys)
+
+
+# ---------------------------------------------------------------------------
+# compact index
+# ---------------------------------------------------------------------------
+
+def test_index_bytes_per_row_within_target(tmp_path):
+    """The acceptance bound: measured index bytes/row <= 16 (6-byte
+    slots at <= 75% occupancy + power-of-two growth headroom)."""
+    t = SsdSparseTable(str(tmp_path / "t"), _cfg())
+    _fill_cold(t, n=60_000, seed=0)
+    t.spill(0)
+    st = t.stats()
+    assert st["cold_rows"] > 50_000
+    assert st["index_bytes"] > 0
+    assert st["index_bytes_per_row"] <= 16.0, st["index_bytes_per_row"]
+
+
+# ---------------------------------------------------------------------------
+# block-compressed fp16 value files
+# ---------------------------------------------------------------------------
+
+def _comp_cfg(**kw):
+    kw.setdefault("ssd_value_dtype", "fp16")
+    kw.setdefault("ssd_block_compress", True)
+    # shrink in these lifecycle tests must age rows, not delete them
+    kw.setdefault("accessor_config", _acc(delete_threshold=0.0))
+    return _cfg(**kw)
+
+
+def test_block_compress_roundtrip_digest_exact(tmp_path):
+    """The full lifecycle on the compressed format: write → spill →
+    shrink → compact → crash-replay (reopen) → checkpoint → restore,
+    digest-exact at every hop."""
+    path = str(tmp_path / "a")
+    t = SsdSparseTable(path, _comp_cfg())
+    keys, _ = _fill_cold(t, n=3000, seed=1)
+    t.spill(0)
+    t.shrink()           # ages + rewrites every live cold row
+    assert t.size() == len(keys), "shrink deleted rows it should age"
+    dg = t.digest()
+    want = t.pull_sparse(keys[:64], create=False)
+    t.spill(0)           # re-spill what the pull promoted
+    assert t.digest() == dg
+
+    t.compact()
+    assert t.digest() == dg
+
+    t.flush()
+    t.close()            # no clean-shutdown protocol: reopen = replay
+    t2 = SsdSparseTable(path, _comp_cfg())
+    assert t2.digest() == dg
+    np.testing.assert_array_equal(
+        t2.pull_sparse(keys[:64], create=False), want)
+
+    n = t2.save_file(str(tmp_path / "ck.raw"), fmt="raw")
+    assert n == len(keys)
+    t3 = SsdSparseTable(str(tmp_path / "b"), _comp_cfg())
+    assert t3.load_file(str(tmp_path / "ck.raw"), fmt="raw") == n
+    assert t3.digest() == dg
+    t2.close(); t3.close()
+
+
+def test_block_compress_shrinks_disk_bytes(tmp_path):
+    """The point of the format: sparse CTR rows (zero opt state) pack
+    materially smaller than the raw fp16 log."""
+    sizes = {}
+    for name, cfg in (("raw", _cfg(ssd_value_dtype="fp16")),
+                      ("comp", _comp_cfg())):
+        t = SsdSparseTable(str(tmp_path / name), cfg)
+        _fill_cold(t, n=4000, seed=2)
+        t.spill(0)
+        t.flush()
+        sizes[name] = t.stats()["disk_bytes"]
+        t.close()
+    assert sizes["comp"] < 0.7 * sizes["raw"], sizes
+
+
+def test_block_compress_torn_tail_recovers_prefix(tmp_path):
+    """A crash can tear the last block write: replay must keep every
+    sealed block before the tear and drop the torn tail, not refuse
+    the file."""
+    path = str(tmp_path / "t")
+    t = SsdSparseTable(path, _comp_cfg(shard_num=1))
+    keys, _ = _fill_cold(t, n=2000, seed=3)
+    t.spill(0)
+    t.flush()
+    t.close()
+    shard = glob.glob(os.path.join(path, "*"))
+    shard = [f for f in shard if not f.endswith(".compact")]
+    assert len(shard) == 1
+    size = os.path.getsize(shard[0])
+    with open(shard[0], "r+b") as f:   # tear mid-block
+        f.truncate(size - 37)
+    t2 = SsdSparseTable(path, _comp_cfg(shard_num=1))
+    st = t2.stats()
+    # sealed prefix survives (128-record blocks: at most one block lost)
+    assert 0 < st["cold_rows"] >= len(keys) - 128
+    got = t2.pull_sparse(keys, create=False)
+    assert np.isfinite(got).all()
+    t2.close()
+
+
+# ---------------------------------------------------------------------------
+# background compaction + io budget
+# ---------------------------------------------------------------------------
+
+def test_bg_compaction_digest_invariant_under_churn(tmp_path):
+    """TableConfig.ssd_bg_compact=True moves compaction off the push
+    path; content digests must be invariant through the churn it
+    absorbs, and the backlog must drain."""
+    t = SsdSparseTable(str(tmp_path / "t"),
+                       _cfg(ssd_bg_compact=True, ssd_io_budget_mbps=64.0))
+    keys, _ = _fill_cold(t, n=2000, seed=4)
+    t.spill(0)
+    dg = t.digest()
+    for _ in range(4):                   # content-invariant churn
+        t.pull_sparse(keys, create=False)
+        t.spill(0)
+    t.compact_async()
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        st = t.stats()
+        if st["bg_compactions"] > 0 and st["bg_backlog"] == 0:
+            break
+        time.sleep(0.05)
+    st = t.stats()
+    assert st["bg_compactions"] > 0, "background worker never compacted"
+    assert st["bg_backlog"] == 0, "forced compaction backlog never drained"
+    assert t.digest() == dg
+    t.close()
+
+
+def test_io_budget_meters_background_bytes(tmp_path):
+    """With a starved budget the worker pays wall-clock for its bytes:
+    bg_wait_ms becomes visible in the stat vector."""
+    t = SsdSparseTable(str(tmp_path / "t"), _cfg(shard_num=2))
+    keys, _ = _fill_cold(t, n=4000, seed=5)
+    t.spill(0)
+    for _ in range(3):
+        t.pull_sparse(keys, create=False)
+        t.spill(0)
+    t._native.io_budget(256 * 1024, 64 * 1024)   # 256 KB/s, 64 KB bucket
+    t._native.bg_start(20)
+    t.compact_async()
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        st = t.stats()
+        if st["bg_compactions"] >= 2 and st["bg_backlog"] == 0:
+            break
+        time.sleep(0.05)
+    st = t.stats()
+    assert st["bg_compactions"] >= 2
+    assert st["io_bg_bytes"] > 0
+    assert st["io_bg_wait_ms"] > 0, "starved budget never made the bg wait"
+    assert t.digest() is not None
+    t.close()
+
+
+_CRASH_CHILD = textwrap.dedent("""
+    import os, sys, time
+    import numpy as np
+    sys.path.insert(0, {repo!r})
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.faultpoints import arm_faultpoint
+    from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+    from paddle_tpu.ps.table import SsdSparseTable, TableConfig
+
+    path = sys.argv[1]
+    cfg = TableConfig(
+        shard_num=2, storage="ssd", ssd_value_dtype="fp16",
+        ssd_block_compress=True,
+        accessor_config=AccessorConfig(
+            sgd=SGDRuleConfig(initial_range=0.0), embedx_dim=4,
+            embedx_threshold=0.0))
+    t = SsdSparseTable(path, cfg)
+    rng = np.random.default_rng(0)
+    keys = np.arange(1, 20001, dtype=np.uint64)
+    vals = np.zeros((len(keys), t.full_dim), np.float32)
+    vals[:, 3] = 1.0
+    vals[:, 5] = rng.normal(size=len(keys)).astype(np.float32)
+    t.import_full(keys, vals)
+    t.spill(0)
+    # content-invariant churn so the logs carry garbage worth compacting
+    for _ in range(2):
+        t.pull_sparse(keys, create=False)
+        t.spill(0)
+    t.flush()
+    print("DIGEST", t.digest(), flush=True)
+    # starved budget: shard 0's copy passes on the full bucket, shard 1
+    # parks in acquire_bg for ~10s with its .compact already created
+    t._native.io_budget(64 * 1024, 64 * 1024)
+    t._native.bg_start(20)
+    t.compact_async()
+    time.sleep(1.0)
+    arm_faultpoint("ssd.compact", "kill-job")
+    t.compact_async()      # the armed site SIGKILLs the process
+    print("SURVIVED", flush=True)
+    sys.exit(3)
+""")
+
+
+def test_crash_mid_compaction_preserves_durable_rows(tmp_path):
+    """SIGKILL with the background sweep mid-copy (ps/faultpoints.py
+    ``ssd.compact`` site): recovery replays the durable log, ignores
+    the orphan ``.compact`` temp, and the digest is exact."""
+    path = str(tmp_path / "t")
+    proc = subprocess.run(
+        [sys.executable, "-c", _CRASH_CHILD.format(repo=REPO), path],
+        capture_output=True, text=True, timeout=180,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == -signal.SIGKILL, (proc.returncode,
+                                                proc.stdout, proc.stderr)
+    assert "SURVIVED" not in proc.stdout
+    dg_line = [ln for ln in proc.stdout.splitlines()
+               if ln.startswith("DIGEST ")]
+    assert dg_line, proc.stdout
+    want = int(dg_line[0].split()[1])
+
+    # the kill landed mid-copy: the torn temp is still on disk
+    orphans = glob.glob(os.path.join(path, "*.compact"))
+    assert orphans, "no .compact temp at crash time — kill landed too late"
+
+    cfg = _comp_cfg(shard_num=2)
+    back = SsdSparseTable(path, cfg)
+    assert back.digest() == want, \
+        "durable rows lost across a crash mid-compaction"
+    # and compaction of the recovered table is still digest-exact
+    back.compact()
+    assert back.digest() == want
+    back.close()
+
+
+# ---------------------------------------------------------------------------
+# observability + client plumbing
+# ---------------------------------------------------------------------------
+
+def test_obs_probe_exports_cold_tier_series(tmp_path):
+    from paddle_tpu.obs import registry as obs_registry
+
+    t = SsdSparseTable(str(tmp_path / "t"),
+                       _cfg(table_id=7, ssd_admission_threshold=2))
+    keys = np.arange(1, 101, dtype=np.uint64)
+    t.push_sparse(keys, _grad(t, keys))
+    t.push_sparse(keys, _grad(t, keys))
+    t.spill(0)
+    t.obs_probe()
+    fams = obs_registry.REGISTRY.snapshot()["metrics"]
+    for fam in ("ssd_admit_checks", "ssd_admit_rejects", "ssd_cold_rows",
+                "ssd_index_bytes_per_row", "ssd_bg_backlog"):
+        assert fam in fams, f"{fam} not exported"
+        series = fams[fam]["series"]
+        assert any(s["labels"].get("table") == "7" for s in series)
+
+
+def test_cold_tier_slo_rules_construct():
+    from paddle_tpu.obs.slo import cold_tier_rules
+
+    rules = cold_tier_rules()
+    names = {r.name for r in rules}
+    assert names == {"cold_compaction_starved", "cold_io_budget_tight",
+                     "cold_index_bloat"}
+    fams = {r.family for r in rules}
+    assert "ssd_bg_backlog" in fams and "ssd_index_bytes_per_row" in fams
+
+
+def test_client_table_stats_passthrough(tmp_path):
+    from paddle_tpu.ps.client import LocalPsClient, PsServerHandle
+
+    server = PsServerHandle()
+    cli = LocalPsClient(server)
+    server.create_sparse_table(
+        0, _cfg(table_id=0, ssd_path=str(tmp_path / "t")))
+    server.create_sparse_table(1, TableConfig(table_id=1,
+                                              accessor_config=_acc()))
+    keys = np.arange(1, 51, dtype=np.uint64)
+    cli.pull_sparse(0, keys)
+    st = cli.table_stats(0)
+    assert st["hot_rows"] == len(keys)
+    assert "admit_checks" in st and "index_bytes" in st
+    assert cli.table_stats(1) == {}
+
+
+def test_config_file_cold_tier_knobs():
+    from paddle_tpu.ps.config import load_ps_config
+
+    job = load_ps_config({
+        "hyper_parameters": {"sparse_feature_dim": 9},
+        "table_parameters": {
+            "storage": "ssd",
+            "ssd_value_dtype": "fp16",
+            "ssd_block_compress": True,
+            "ssd_admission_threshold": 5,
+            "ssd_admission_sketch_kb": 32,
+            "ssd_bg_compact": True,
+            "ssd_io_budget_mbps": 128.0,
+        },
+    })
+    t = job.table
+    assert t.ssd_value_dtype == "fp16"
+    assert t.ssd_block_compress is True
+    assert t.ssd_admission_threshold == 5
+    assert t.ssd_admission_sketch_kb == 32
+    assert t.ssd_bg_compact is True
+    assert t.ssd_io_budget_mbps == 128.0
+
+    # defaults when the block omits the cold-tier knobs
+    d = load_ps_config({"hyper_parameters": {}}).table
+    assert d.ssd_block_compress is False
+    assert d.ssd_admission_threshold == 0
+    assert d.ssd_bg_compact is False
+
+
+# ---------------------------------------------------------------------------
+# endurance demo — full profile (quick profile runs in `ci.sh endurance`)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_endurance_demo_full_profile(tmp_path):
+    """The committed-artifact gates at 4x the quick-profile stream: a
+    2M-key universe over a 40k hot budget (50x) must still clear the
+    admission-leverage, index-bytes, p99-isolation and digest-exact
+    acceptance bounds asserted by ``ci.sh endurance``."""
+    env = dict(os.environ,
+               PYTHONPATH=REPO, JAX_PLATFORMS="cpu",
+               SSD_END_UNIVERSE="2000000", SSD_END_HOT="40000",
+               SSD_END_BATCHES="120", SSD_END_BATCH_KEYS="8192",
+               SSD_END_PULL_BATCHES="400",
+               SSD_END_DIR=str(tmp_path / "end"))
+    (tmp_path / "end").mkdir()
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "ssd_endurance_demo.py")],
+        env=env, capture_output=True, text=True, timeout=600)
+    line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+    d = json.loads(line)
+    assert "error" not in d, d
+    assert d["universe"] >= 10 * d["hot_budget"], d
+    assert d["offered_over_admitted"] >= 3.0, d
+    assert 0 < d["index_bytes_per_row"] <= 16.0, d
+    assert d["pull_p99_ratio"] <= 10.0, d
+    assert d["bg_compactions"] > 0 and d["bg_backlog_final"] == 0, d
+    assert d["digest_exact"] and d["digest_stable_under_churn"], d
+    assert d["rss_growth_bytes"] <= 512 * 1024 * 1024, d

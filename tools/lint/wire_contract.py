@@ -184,9 +184,72 @@ _PY_FILES = {"rpc": "paddle_tpu/ps/rpc.py",
              "graph": "paddle_tpu/ps/graph_client.py",
              "ha": "paddle_tpu/ps/ha.py",
              "trace": "paddle_tpu/obs/trace.py"}
+
+# ---------------------------------------------------------------------------
+# SSD cold-tier ABI contract (csrc/ssd_table.cc ↔ ps/native.py)
+# ---------------------------------------------------------------------------
+# The sst_* surface is an in-process ctypes ABI, not an RPC wire — but
+# it drifts the same way: ssd_table.cc owns the entry points, the
+# SstStatField enum and the block-record format; native.py hand-mirrors
+# the symbol bindings, SST_STAT_FIELDS and the SST_BLOCK_*/SST_FLAG_*
+# constants. Every extern "C" sst_* definition must be listed here and
+# referenced from native.py; the stat enum and format constants must
+# agree in both languages, value for value.
+
+_SST_CSRC = "paddle_tpu/csrc/ssd_table.cc"
+_SST_PY = "paddle_tpu/ps/native.py"
+
+#: every extern "C" sst_* entry point, reviewed. A new one fails the
+#: gate until it is added here AND bound in native.py.
+SST_ENTRY_CONTRACT = (
+    "sst_create", "sst_create2", "sst_destroy",
+    "sst_pull_dim", "sst_push_dim", "sst_full_dim",
+    "sst_stats", "sst_stats2", "sst_shard_sizes", "sst_size",
+    "sst_digest",
+    "sst_pull", "sst_push", "sst_export", "sst_insert_full",
+    "sst_load_cold", "sst_spill", "sst_shrink", "sst_compact",
+    "sst_admission_config", "sst_io_budget",
+    "sst_bg_start", "sst_bg_stop", "sst_bg_step", "sst_compact_async",
+    "sst_save_begin", "sst_save_fetch", "sst_flush",
+    "sst_save_file", "sst_load_file",
+)
+
+#: SstStatField enum (csrc) ↔ SST_STAT_FIELDS dict (native.py):
+#: csrc name → (python key, index)
+SST_STAT_CONTRACT: Dict[str, Tuple[str, int]] = {
+    "kSstHotRows": ("hot_rows", 0),
+    "kSstColdRows": ("cold_rows", 1),
+    "kSstDiskBytes": ("disk_bytes", 2),
+    "kSstIndexBytes": ("index_bytes", 3),
+    "kSstSketchBytes": ("sketch_bytes", 4),
+    "kSstAdmitChecks": ("admit_checks", 5),
+    "kSstAdmitRejects": ("admit_rejects", 6),
+    "kSstAdmitAdmitted": ("admit_admitted", 7),
+    "kSstBgCompactions": ("bg_compactions", 8),
+    "kSstBgBacklog": ("bg_backlog", 9),
+    "kSstIoServeBytes": ("io_serve_bytes", 10),
+    "kSstIoBgBytes": ("io_bg_bytes", 11),
+    "kSstIoBgWaitMs": ("io_bg_wait_ms", 12),
+    "kSstOpenBlockBytes": ("open_block_bytes", 13),
+}
+SST_STAT_COUNT = 14
+
+#: block-record format + create-flag bits: csrc constexpr name →
+#: (python constant in native.py, reviewed value). The python flag
+#: constants have no named csrc twin (sst_create2 reads the bits
+#: directly) — csrc_name None pins the python side to the contract.
+SST_FORMAT_CONTRACT: Dict[str, Tuple[Optional[str], int]] = {
+    "SST_BLOCK_MAGIC": ("kSstBlkMagic", 0x4B4C4253),
+    "SST_BLOCK_RECS": ("kSstBlockRecs", 128),
+    "SST_BLOCK_HDR_BYTES": ("kSstBlockHdrBytes", 16),
+    "SST_FLAG_VALUE_F16": (None, 1),
+    "SST_FLAG_BLOCK_COMPRESS": (None, 2),
+    "SST_STAT_COUNT": ("kSstStatCount", SST_STAT_COUNT),
+}
+
 # the pass's own file is relevant too: a CONTRACT edit must re-run the
 # cross-validation in --changed mode
-RELEVANT_FILES = (_CSRC, *_PY_FILES.values(),
+RELEVANT_FILES = (_CSRC, *_PY_FILES.values(), _SST_CSRC, _SST_PY,
                   "tools/lint/wire_contract.py")
 
 
@@ -286,6 +349,170 @@ def extract_csrc(path: str) -> CsrcContract:
 
 def struct_format(fields: List[Tuple[str, str, int]]) -> str:
     return "<" + "".join(_CTYPE_FMT[t] for t, _, _ in fields)
+
+
+# ---------------------------------------------------------------------------
+# SSD cold-tier extractors
+# ---------------------------------------------------------------------------
+
+@dataclass
+class SstCsrcContract:
+    entries: Dict[str, int] = field(default_factory=dict)   # name -> line
+    stats: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+    consts: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+
+
+_SST_ENTRY_RE = re.compile(
+    r"^[A-Za-z_][A-Za-z0-9_]*\s*\*?\s+\*?(sst_\w+)\s*\(")
+_SST_CONST_RE = re.compile(
+    r"^constexpr\s+\w+\s+(k\w+)\s*=\s*(0[xX][0-9a-fA-F]+|\d+)[uU]?\s*;")
+
+
+def extract_sst_csrc(path: str) -> SstCsrcContract:
+    out = SstCsrcContract()
+    with open(path, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    in_enum = False
+    for i, raw in enumerate(lines, 1):
+        line = raw.split("//")[0]
+        if in_enum:
+            m = _ENUM_ENTRY_RE.match(line)
+            if m:
+                out.stats[m.group(1)] = (int(m.group(2)), i)
+            if "}" in line:
+                in_enum = False
+            continue
+        m = _ENUM_START_RE.search(line)
+        if m and m.group(1) == "SstStatField":
+            in_enum = True
+            continue
+        m = _SST_CONST_RE.match(line)
+        if m:
+            out.consts[m.group(1)] = (int(m.group(2), 0), i)
+            continue
+        m = _SST_ENTRY_RE.match(line)
+        if m:
+            out.entries[m.group(1)] = i
+    return out
+
+
+@dataclass
+class SstPyContract:
+    refs: Dict[str, int] = field(default_factory=dict)    # sst_* attr -> line
+    consts: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+    stat_fields: Dict[str, Tuple[int, int]] = field(default_factory=dict)
+    stat_fields_line: int = 0
+
+
+def extract_sst_python(path: str) -> SstPyContract:
+    out = SstPyContract()
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read())
+    out.consts = _int_consts(tree)
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "SST_STAT_FIELDS" and \
+                isinstance(node.value, ast.Dict):
+            out.stat_fields_line = node.lineno
+            for k, v in zip(node.value.keys, node.value.values):
+                if isinstance(k, ast.Constant) and \
+                        isinstance(v, ast.Constant) and \
+                        isinstance(v.value, int):
+                    out.stat_fields[str(k.value)] = (v.value, k.lineno)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and \
+                node.attr.startswith("sst_"):
+            out.refs.setdefault(node.attr, node.lineno)
+        elif isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                node.value.startswith("sst_"):
+            # hasattr(lib, "sst_digest") / getattr-by-name bindings
+            out.refs.setdefault(node.value, node.lineno)
+    return out
+
+
+def check_sst(root: str) -> List[Diagnostic]:
+    csrc_path = os.path.join(root, _SST_CSRC)
+    py_path = os.path.join(root, _SST_PY)
+    if not (os.path.exists(csrc_path) and os.path.exists(py_path)):
+        return []   # scratch trees: fail open like the rpc section
+    cs = extract_sst_csrc(csrc_path)
+    py = extract_sst_python(py_path)
+    diags: List[Diagnostic] = []
+
+    def d(path: str, line: int, rule: str, msg: str) -> None:
+        diags.append(Diagnostic(path, line, rule, msg))
+
+    # -- entry points --------------------------------------------------------
+    for name in SST_ENTRY_CONTRACT:
+        if name not in cs.entries:
+            d(_SST_CSRC, 1, "sst-entry-drift",
+              f"contract entry point `{name}` has no extern \"C\" "
+              "definition in ssd_table.cc")
+        if name not in py.refs:
+            d(_SST_PY, 1, "sst-entry-mirror",
+              f"`{name}` (contract ABI entry) is never bound or "
+              "referenced in ps/native.py")
+    for name, line in cs.entries.items():
+        if name not in SST_ENTRY_CONTRACT:
+            d(_SST_CSRC, line, "sst-entry-drift",
+              f"extern \"C\" `{name}` is not in SST_ENTRY_CONTRACT — "
+              "add it there AND bind it in ps/native.py "
+              "(tools/lint/wire_contract.py)")
+
+    # -- stat enum -----------------------------------------------------------
+    for cname, (pykey, idx) in SST_STAT_CONTRACT.items():
+        got = cs.stats.get(cname)
+        if got is None:
+            d(_SST_CSRC, 1, "sst-stat-drift",
+              f"contract stat `{cname}` (= {idx}) missing from the csrc "
+              "SstStatField enum")
+        elif got[0] != idx:
+            d(_SST_CSRC, got[1], "sst-stat-drift",
+              f"`{cname}` = {got[0]} in csrc but {idx} in the contract")
+        got_py = py.stat_fields.get(pykey)
+        if got_py is None:
+            d(_SST_PY, py.stat_fields_line or 1, "sst-stat-mirror",
+              f"SST_STAT_FIELDS lacks `{pykey}` (mirror of csrc "
+              f"{cname} = {idx})")
+        elif got_py[0] != idx:
+            d(_SST_PY, got_py[1], "sst-stat-mirror",
+              f"SST_STAT_FIELDS[{pykey!r}] = {got_py[0]} but csrc "
+              f"{cname} = {idx}")
+    known_idx = {i for _, i in SST_STAT_CONTRACT.values()}
+    for cname, (val, line) in cs.stats.items():
+        if cname == "kSstStatCount":
+            continue
+        if cname not in SST_STAT_CONTRACT:
+            d(_SST_CSRC, line, "sst-stat-drift",
+              f"csrc stat `{cname}` = {val} is not in SST_STAT_CONTRACT")
+    for pykey, (val, line) in py.stat_fields.items():
+        if val not in known_idx:
+            d(_SST_PY, line, "sst-stat-mirror",
+              f"SST_STAT_FIELDS[{pykey!r}] = {val} has no contract twin")
+
+    # -- record format + flag bits -------------------------------------------
+    for pyname, (cname, want) in SST_FORMAT_CONTRACT.items():
+        if cname is not None:
+            got = cs.consts.get(cname) or cs.stats.get(cname)
+            if got is None:
+                d(_SST_CSRC, 1, "sst-format-drift",
+                  f"csrc constant `{cname}` (contract value {want}) not "
+                  "found in ssd_table.cc")
+            elif got[0] != want:
+                d(_SST_CSRC, got[1], "sst-format-drift",
+                  f"`{cname}` = {got[0]} in csrc but {want} in the "
+                  "contract")
+        got_py = py.consts.get(pyname)
+        if got_py is None:
+            d(_SST_PY, 1, "sst-format-mirror",
+              f"`{pyname}` (contract value {want}) missing from "
+              "ps/native.py")
+        elif got_py[0] != want:
+            d(_SST_PY, got_py[1], "sst-format-mirror",
+              f"`{pyname}` = {got_py[0]} but the contract says {want}")
+    return diags
 
 
 # ---------------------------------------------------------------------------
@@ -597,7 +824,8 @@ def check(root: str) -> List[Diagnostic]:
 def run(root: str, only=None) -> List[Diagnostic]:
     if only is not None and not any(f in only for f in RELEVANT_FILES):
         return []
-    return sorted(check(root), key=lambda d: (d.path, d.line, d.rule))
+    return sorted(check(root) + check_sst(root),
+                  key=lambda d: (d.path, d.line, d.rule))
 
 
 if __name__ == "__main__":

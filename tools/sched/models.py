@@ -52,7 +52,8 @@ DECL_FILES = (
     "paddle_tpu/ps/reshard.py",
     "paddle_tpu/serving/fleet.py",
     "paddle_tpu/io/job_checkpoint.py",
-)
+    "paddle_tpu/csrc/ssd_table.cc",   # `//` grammar — load_lock_order
+)                                     # dispatches on extension
 
 
 # ---------------------------------------------------------------------------
@@ -372,6 +373,189 @@ def fleet_drain_tick_model():
 # ---------------------------------------------------------------------------
 # 3. JobCheckpointManager writer vs save()/stop() (REAL class)
 # ---------------------------------------------------------------------------
+
+def ssd_compact_model(two_phase: bool = True, with_shrink: bool = True):
+    """Cold-tier background compactor (csrc/ssd_table.cc) in miniature:
+    the two-phase compaction sweep racing a push-path rewrite, a
+    promote-on-read, a save snapshot and (full variant) a lifecycle
+    shrink, using the REAL lock names from the csrc declaration
+    (``ssd_save_mu < mem_save_mu < shard_mu < disk_mu < bg_mu``, leaf
+    ``io_mu``) so the dynamic checker validates the same ``// LOCK
+    ORDER:`` grammar pass 2 reads statically.
+
+    ``two_phase=False`` reproduces the naive single-phase publisher
+    (install the phase-A snapshot verbatim instead of reconciling
+    against the live index under ``disk_mu``): a rewrite landing during
+    the unlocked copy is reverted to its stale version, and a key
+    promoted to RAM during the copy is resurrected on disk — the save
+    snapshot then sees it in BOTH tiers.  The default (the shipped
+    phase-B reconcile) must explore clean."""
+
+    def model(sched):
+        sh = _SsdShardModel(sched, two_phase)
+        sched.spawn(sh.writer, name="push")
+        sched.spawn(sh.bg_worker, name="bg")
+        sched.spawn(sh.reader, name="pull")
+        sched.spawn(sh.saver, name="save")
+        if with_shrink:
+            sched.spawn(sh.shrinker, name="shrink")
+
+        def finish():
+            assert sh.index_val("k0") == 2, \
+                f"push-path rewrite lost: k0 is {sh.index_val('k0')!r} " \
+                "on disk, last write was 2 — a compaction published a " \
+                "stale phase-A copy over it"
+            assert "k1" in sh.hot and "k1" not in sh.index, \
+                "promoted key resurrected on disk by compaction " \
+                f"(hot={'k1' in sh.hot}, cold={'k1' in sh.index})"
+            assert sh.index_val("k2") == 1, "bystander row k2 lost"
+        sched.on_finish(finish)
+
+    return model
+
+
+class _SsdShardModel:
+    """One cold shard: append-only ``log`` of (key, flag, value)
+    records (ordinal = position, flag 0 = dead), ``index`` key ->
+    ordinal, ``hot`` the RAM tier.  A key lives in at most ONE tier."""
+
+    def __init__(self, sched, two_phase: bool) -> None:
+        self.sched = sched
+        self.two_phase = two_phase
+        self.save_mu = _sync.Lock(name="ssd_save_mu")
+        self.mem_save_mu = _sync.Lock(name="mem_save_mu")
+        self.shard_mu = _sync.Lock(name="shard_mu")
+        self.disk_mu = _sync.Lock(name="disk_mu")
+        self.bg_mu = _sync.Lock(name="bg_mu")
+        self.io_mu = _sync.Lock(name="io_mu")
+        # k0 will be rewritten by the push path, k1 promoted by the
+        # read path, k2 is the bystander; ord 3 is pre-existing garbage
+        # (the policy debt that seeds bg_dirty)
+        self.log = [("k0", 1, 1), ("k1", 1, 1), ("k2", 1, 1),
+                    ("k0", 0, 0)]
+        self.index = {"k0": 0, "k1": 1, "k2": 2}
+        self.hot = {"h0": 1}
+        self.bg_dirty = 1
+        self.bg_busy = False
+
+    def index_val(self, key):
+        ord_ = self.index.get(key)
+        return None if ord_ is None else self.log[ord_][2]
+
+    def _request_bg(self, level: int) -> None:
+        with self.bg_mu:          # nested under shard_mu+disk_mu
+            if self.bg_dirty < level:
+                self.bg_dirty = level
+
+    def _check_index(self) -> None:
+        for key, ord_ in self.index.items():
+            rec = self.log[ord_] if 0 <= ord_ < len(self.log) else None
+            self.sched.check(
+                rec is not None and rec[0] == key and rec[1] == 1,
+                f"index[{key}] = {ord_} points at a dead or mismatched "
+                "record after publish")
+
+    # -- tasks ------------------------------------------------------------
+
+    def writer(self) -> None:
+        """Push path: rewrite k0's cold row (append + repoint), then
+        hand the garbage to the worker (maybe_compact)."""
+        with self.shard_mu:
+            with self.disk_mu:
+                self.sched.yield_point("push.rewrite")
+                self.log.append(("k0", 1, 2))
+                self.index["k0"] = len(self.log) - 1
+                self._request_bg(1)
+
+    def reader(self) -> None:
+        """Pull path: serve k1 from disk (io charge — leaf lock under
+        disk_mu), then promote it: hot insert + INDEX-ONLY erase."""
+        with self.shard_mu:
+            with self.disk_mu:
+                with self.io_mu:   # charge_serve: leaf, never blocks
+                    pass
+                self.sched.yield_point("pull.promote")
+                ord_ = self.index.pop("k1", None)
+                if ord_ is not None:
+                    self.hot["k1"] = self.log[ord_][2]
+
+    def saver(self) -> None:
+        """sst_save_begin: both save locks, then both tier locks per
+        shard — the snapshot must see every key in exactly one tier."""
+        with self.save_mu:
+            with self.mem_save_mu:
+                with self.shard_mu:
+                    with self.disk_mu:
+                        self.sched.yield_point("save.snapshot")
+                        both = set(self.hot) & set(self.index)
+                        self.sched.check(
+                            not both,
+                            f"save snapshot sees {sorted(both)} in BOTH "
+                            "tiers — a compaction resurrected a "
+                            "promoted key on disk")
+
+    def shrinker(self) -> None:
+        """sst_shrink's disk sweep: rewrite every live cold row, then
+        force-request compaction of the garbage it just made."""
+        with self.shard_mu:
+            with self.disk_mu:
+                for key in sorted(self.index):
+                    val = self.log[self.index[key]][2]
+                    self.sched.yield_point("shrink.rewrite")
+                    self.log.append((key, 1, val))
+                    self.index[key] = len(self.log) - 1
+                self._request_bg(2)
+
+    def bg_worker(self) -> None:
+        """bg_main in miniature: two dirty-flag sweeps, each running
+        the two-phase compaction off the flag set under bg_mu."""
+        for _ in range(2):
+            with self.bg_mu:
+                dirty = self.bg_dirty
+                self.bg_dirty = 0
+                self.bg_busy = dirty > 0
+            if dirty:
+                self._compact_bg()
+                with self.bg_mu:
+                    self.bg_busy = False
+
+    def _compact_bg(self) -> None:
+        # phase A: snapshot under disk_mu
+        with self.disk_mu:
+            snap_log = list(self.log)
+            snap_ords = sorted(self.index.values())
+        # unlocked budgeted copy (io_mu = acquire_bg's token bucket)
+        self.sched.yield_point("compact.copy")
+        with self.io_mu:
+            pass
+        new_log = []
+        new_of = {}
+        for ord_ in snap_ords:
+            key, flag, val = snap_log[ord_]
+            if not flag:
+                continue
+            new_of[ord_] = len(new_log)
+            new_log.append((key, flag, val))
+        # phase B: reconcile against the LIVE index + swap, under the
+        # lock.  The naive publisher skips reconciliation and installs
+        # the snapshot's view verbatim.
+        with self.disk_mu:
+            self.sched.yield_point("compact.publish")
+            if self.two_phase:
+                fresh = {}
+                for key, ord_ in self.index.items():
+                    if ord_ not in new_of:
+                        # appended/rewritten during the copy: take the
+                        # live record now, under the lock
+                        new_of[ord_] = len(new_log)
+                        new_log.append(self.log[ord_])
+                    fresh[key] = new_of[ord_]
+            else:
+                fresh = {snap_log[o][0]: n for o, n in new_of.items()}
+            self.log = new_log
+            self.index = fresh
+            self._check_index()
+
 
 def ckpt_writer_model(root: str = None):
     """Two save()s racing stop() over a depth-1 queue: admission is

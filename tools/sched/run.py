@@ -61,6 +61,15 @@ HARNESSES: Dict[str, Dict[str, Any]] = {
         "bound": 1,
         "random_n": 2000,
     },
+    # the cold-tier compactor drops the shrink sweep for the pb sweep
+    # (the push/pull/save races alone cover the phase-B reconcile) and
+    # adds it back for the random walk
+    "ssd_compact": {
+        "dfs": lambda: models.ssd_compact_model(with_shrink=False),
+        "full": models.ssd_compact_model,
+        "bound": 2,
+        "random_n": 2000,
+    },
 }
 
 
