@@ -338,6 +338,9 @@ def _run_measurement() -> None:
     sparse_hot = _sparse_hot_attempt()
     if sparse_hot is not None:
         extra["sparse_hot"] = sparse_hot
+    recsys = _recsys_attempt()
+    if recsys is not None:
+        extra["recsys"] = recsys
     _emit(round(samples_per_sec, 1), round(samples_per_sec / baseline, 4),
           slab=slab, mode=mode_used,
           platform=jax.devices()[0].platform, **extra)
@@ -399,6 +402,53 @@ def _sparse_hot_attempt():
         import sparse_hot_bench
 
         return sparse_hot_bench.run()
+    except Exception as e:  # noqa: BLE001 — optional field, never fatal
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _recsys_attempt():
+    """End-to-end recsys rung (tools/recsys_replay.py): the
+    retrieval→ranking pipeline replay over a multi-process member
+    fleet — e2e qps + per-phase p99 + push→servable freshness p95,
+    platform-tagged, embedded under ``recsys``. Always a subprocess:
+    the replay spawns its own member processes and a full control
+    plane, and must not share this interpreter's jax state. A compact
+    profile keeps the rung minutes-bounded; ``BENCH_RECSYS=0`` skips
+    it. A failure here costs the field, never the headline metric."""
+    if os.environ.get("BENCH_RECSYS", "1") != "1":
+        return None
+    try:
+        import subprocess
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        for k, v in (("RRB_KEYS", "8000"), ("RRB_MEMBERS", "2"),
+                     ("RRB_BASE_QPS", "10"), ("RRB_PEAK_QPS", "40"),
+                     ("RRB_SPIKE_X", "4"), ("RRB_SLO_MS", "60"),
+                     ("RRB_DEADLINE_MS", "8000"), ("RRB_RAMP_S", "6"),
+                     ("RRB_SPIKE_S", "4"), ("RRB_TAIL_S", "4"),
+                     ("RRB_SCALE_WAIT_S", "30"), ("RRB_VERBOSE", "0")):
+            env.setdefault(k, v)
+        out = subprocess.run(
+            [sys.executable, os.path.join(here, "tools",
+                                          "recsys_replay.py")],
+            env=env, capture_output=True, text=True, timeout=540)
+        line = [l for l in out.stdout.splitlines() if l.startswith("{")][-1]
+        d = json.loads(line)
+        if "error" in d:
+            return {"error": d["error"]}
+        return {
+            "e2e_qps": d["value"],
+            "errors_total": d["errors_total"],
+            "ramp_p99_ms": d["ramp"]["e2e_ms"]["p99_ms"],
+            "spike_p99_ms": d["spike"]["e2e_ms"]["p99_ms"],
+            "tail_p99_ms": d["tail"]["e2e_ms"]["p99_ms"],
+            "coalesce_factor": d["pipeline"]["coalesce_factor"],
+            "freshness_p95_s": d["freshness_under_training"]["p95_s"],
+            "autoscaler_grew": d["autoscale"]["grew"],
+            "platform": d["platform"],
+        }
     except Exception as e:  # noqa: BLE001 — optional field, never fatal
         return {"error": f"{type(e).__name__}: {e}"[:200]}
 

@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI gate (reference L0's cmake+ctest role): graftlint, native build,
 # fast test gate, then the full matrix.
-# Usage: ./ci.sh [lint [--changed]|sched|fast|full|chaos|ckpt|hot_tier|serving|serving_fleet|obs|slo|reshard|endurance]
+# Usage: ./ci.sh [lint [--changed]|sched|fast|full|chaos|ckpt|hot_tier|serving|serving_fleet|recsys|obs|slo|reshard|endurance]
 #   sched — graftsched gate: deterministic-schedule exploration of the
 #   control-plane protocol harnesses (tools/sched/models.py) — the
 #   preemption-bound-2 schedule space EXHAUSTED plus seeded random
@@ -326,6 +326,63 @@ print('serving_fleet OK: steady %.0f qps (p99 %.1f ms), capacity %.0f qps, '
   exit 0
 fi
 
+if [[ "${1:-fast}" == "recsys" ]]; then
+  echo "== recsys gate: retrieval→ranking pipeline suite (incl. slow e2e) =="
+  # -m "" deliberately includes the slow multi-process chaos e2e test
+  python -m pytest tests/test_recsys_pipeline.py -q -m ""
+  echo "== recsys replay (ramp + flash crowd + chaos + canary, multi-host members) =="
+  # gate the INVARIANTS exactly (zero errors through the chaos kill and
+  # the flash crowd, autoscaler journaled a grow, ranking actually
+  # coalesced across requests, fleet-wide freshness bounded while the
+  # trainer streams, canary/promote/rollback verified over the wire)
+  # and latency only against the request deadline — absolute p99 on a
+  # shared 1-core box swings with ambient load; one retry absorbs it.
+  # The committed RECSYS_E2E.json is the quiet-host run of this exact
+  # profile.
+  check_recsys() {
+    PYTHONPATH="$PWD:${PYTHONPATH:-}" JAX_PLATFORMS=cpu \
+      RRB_KEYS=8000 RRB_MEMBERS=2 RRB_BASE_QPS=10 RRB_PEAK_QPS=40 \
+      RRB_SPIKE_X=4 RRB_SLO_MS=60 RRB_DEADLINE_MS=8000 \
+      RRB_RAMP_S=10 RRB_SPIKE_S=6 RRB_TAIL_S=6 RRB_SCALE_WAIT_S=45 \
+      python tools/recsys_replay.py | tee /tmp/recsys_e2e_ci.json \
+      | python -c "
+import json, sys
+d = json.loads([l for l in sys.stdin.read().splitlines()
+                if l.startswith('{')][-1])
+assert 'error' not in d, d
+assert d['errors_total'] == 0, d['errors_total']
+for ph in ('ramp', 'spike', 'tail'):
+    assert d[ph]['within_deadline'], (ph, d[ph])
+assert d['ramp']['members_before'] >= 2 and d['ramp']['killed'], d['ramp']
+assert d['autoscale']['grew'], d['autoscale']
+assert d['pipeline']['coalesce_factor'] > 1.0, d['pipeline']
+assert d['spike']['coalesce_factor'] > 1.5, d['spike']
+f = d['freshness_under_training']
+assert f['failures'] == 0 and f['probes'] >= 5, f
+assert f['p95_s'] is not None and f['p95_s'] <= 5.0, f
+assert d['canary']['both_versions_served'], d['canary']
+assert d['canary']['promoted_all'], d['canary']
+assert d['canary']['rollback_digest_ok'], d['canary']
+assert all(m['multi_host'] for m in d['members'].values()), d['members']
+print('recsys OK: e2e %.0f qps, ramp/spike/tail p99 %.0f/%.0f/%.0f ms, '
+      'coalesce %.2fx (spike %.2fx), freshness p95 %.2f s, '
+      'grew=%s, 0 errors through chaos'
+      % (d['value'], d['ramp']['e2e_ms']['p99_ms'],
+         d['spike']['e2e_ms']['p99_ms'], d['tail']['e2e_ms']['p99_ms'],
+         d['pipeline']['coalesce_factor'], d['spike']['coalesce_factor'],
+         f['p95_s'], d['autoscale']['grew']))"
+  }
+  check_recsys || { echo "recsys retry (ambient-load outlier)"; check_recsys; }
+  python -c "
+import json
+d = json.loads([l for l in open('/tmp/recsys_e2e_ci.json')
+                if l.startswith('{')][-1])
+open('RECSYS_E2E.json', 'w').write(json.dumps(d, indent=4) + '\n')
+" 2>/dev/null || true
+  echo "CI OK (recsys)"
+  exit 0
+fi
+
 if [[ "${1:-fast}" == "slo" ]]; then
   echo "== slo gate: continuous telemetry / watchdog / flight recorder =="
   # -m "" includes the slow e2e: kill-shard mid-CtrStreamTrainer →
@@ -631,6 +688,7 @@ print('sync shim pass-through OK (sanitizer sees raw primitives)')"
       tests/test_rpc_parallel.py tests/test_ps_ha.py \
       tests/test_job_checkpoint.py tests/test_serving.py \
       tests/test_serving_fleet.py \
+      tests/test_recsys_pipeline.py \
       tests/test_obs.py tests/test_slo.py tests/test_flightrec.py \
       tests/test_reshard.py tests/test_autoscale.py \
       tests/test_sparse_wire.py -q -m ""
@@ -665,6 +723,7 @@ print('sync shim pass-through OK (sanitizer sees raw primitives)')"
       tests/test_rpc_parallel.py tests/test_ps_ha.py \
       tests/test_job_checkpoint.py tests/test_serving.py \
       tests/test_serving_fleet.py \
+      tests/test_recsys_pipeline.py \
       tests/test_obs.py tests/test_slo.py tests/test_flightrec.py \
       tests/test_reshard.py tests/test_autoscale.py \
       tests/test_sparse_wire.py -q -m ""
@@ -698,6 +757,7 @@ print('sync shim pass-through OK (sanitizer sees raw primitives)')"
       tests/test_rpc_parallel.py tests/test_ps_ha.py \
       tests/test_job_checkpoint.py tests/test_serving.py \
       tests/test_serving_fleet.py \
+      tests/test_recsys_pipeline.py \
       tests/test_obs.py tests/test_slo.py tests/test_flightrec.py \
       tests/test_reshard.py tests/test_autoscale.py \
       tests/test_sparse_wire.py -q -m ""

@@ -26,7 +26,8 @@ from ..nn.layer import Layer
 from ..ps.embedding_cache import CacheConfig
 from .ctr import _DNN, _ctr_step_body, _weighted_mean
 
-__all__ = ["DSSM", "make_dssm_train_step", "export_dssm_towers"]
+__all__ = ["DSSM", "make_dssm_train_step", "export_dssm_towers",
+           "make_dssm_ranker"]
 
 
 def _l2_normalize(x):
@@ -175,6 +176,58 @@ def export_dssm_towers(dirname: str, model: DSSM, cache, query_slot_ids,
         example = (jax.ShapeDtypeStruct((b, S), jnp.uint32),)
         save_inference_model(os.path.join(dirname, which), fn, serving,
                              example)
+
+
+def make_dssm_ranker(model: DSSM, params=None) -> Callable:
+    """Serving-side stacked ranker (ISSUE 18 — the pipeline's ranking
+    stage, two-tower face): ``rank(hist_emb [B, H, 1+dim], lengths [B],
+    cand_emb [B, K, 1+dim]) → scores [B, K]``. The H history rows ARE
+    the query slots (H must equal ``num_query_slots``) and each
+    candidate is a one-slot doc (``num_doc_slots`` must be 1) — the
+    shape the pipeline's coalesced gather produces. ``lengths`` is
+    accepted for ranker-contract uniformity and unused (DSSM has no
+    sequence mask). Params ride in as traced arguments; B pads to the
+    next pow2 so coalesced batch sizes reuse compiled buckets."""
+    from ..nn.layer import get_state
+
+    enforce_msg = (f"make_dssm_ranker: model towers are "
+                   f"(sq={model.sq}, sd={model.sd}); the ranker "
+                   f"contract needs H == sq history rows and sd == 1")
+    if model.sd != 1:
+        raise ValueError(enforce_msg)
+
+    @jax.jit
+    def _rank(state, hist, cand):
+        B, K, d = cand.shape
+        with _bind_params(model.query_tower, state["query"]):
+            q = _l2_normalize(model.query_tower(hist.reshape(B, -1)))
+        with _bind_params(model.doc_tower, state["doc"]):
+            v = _l2_normalize(model.doc_tower(
+                cand.reshape(B * K, d)).reshape(B, K, -1))
+        return jnp.einsum("bo,bko->bk", q, v)
+
+    def rank(hist_emb, lengths, cand_emb) -> np.ndarray:
+        del lengths
+        if params is not None:
+            state = params
+        else:
+            state = {"query": get_state(model.query_tower),
+                     "doc": get_state(model.doc_tower)}
+        hist = np.ascontiguousarray(hist_emb, np.float32)
+        cand = np.ascontiguousarray(cand_emb, np.float32)
+        if hist.shape[1] != model.sq:
+            raise ValueError(enforce_msg + f" (got H={hist.shape[1]})")
+        B = hist.shape[0]
+        Bp = 1 << (max(B, 1) - 1).bit_length()
+        if Bp != B:
+            pad = Bp - B
+            hist = np.concatenate(
+                [hist, np.zeros((pad,) + hist.shape[1:], np.float32)])
+            cand = np.concatenate(
+                [cand, np.zeros((pad,) + cand.shape[1:], np.float32)])
+        return np.asarray(_rank(state, hist, cand))[:B]
+
+    return rank
 
 
 @contextlib.contextmanager

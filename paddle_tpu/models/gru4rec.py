@@ -26,7 +26,7 @@ from ..ps.embedding_cache import CacheConfig, cache_pull, cache_push
 from .dssm import DSSM, _l2_normalize
 
 __all__ = ["GRU4Rec", "make_gru4rec_train_step", "item_keys",
-           "export_gru4rec_towers"]
+           "export_gru4rec_towers", "make_gru4rec_ranker"]
 
 
 def item_keys(item_ids: np.ndarray) -> np.ndarray:
@@ -100,6 +100,47 @@ def make_gru4rec_train_step(model: GRU4Rec, optimizer,
         return new_params, new_opt, new_cache, loss
 
     return jax.jit(step, donate_argnums=(0, 1, 2) if donate else ())
+
+
+def make_gru4rec_ranker(model: GRU4Rec, params=None) -> Callable:
+    """Serving-side stacked ranker (ISSUE 18 — the pipeline's ranking
+    stage): ``rank(hist_emb [B, H, 1+dim], lengths [B], cand_emb
+    [B, K, 1+dim]) → scores [B, K]`` (session·candidate cosine, the
+    training objective's inference face). One jitted program shared by
+    EVERY coalesced batch: params/state ride in as traced arguments
+    (the :func:`_beam_scorer` rule — closing over them would bake the
+    state in as constants), B pads to the next pow2 so the coalescer's
+    variable batch sizes reuse a handful of compiled buckets.
+    ``params`` defaults to the model's live state (a serving process
+    that refreshes dense towers passes each new state explicitly)."""
+    from ..nn.layer import get_state
+
+    @jax.jit
+    def _rank(state, hist, lengths, cand):
+        # forward's item_proj is pointwise over the trailing dim, so
+        # the [B, K, 1+dim] candidate block rides through unchanged
+        (u, v), _ = nn.functional_call(model, state, hist, cand,
+                                       lengths, training=False)
+        return jnp.einsum("bo,bko->bk", u, v)
+
+    def rank(hist_emb, lengths, cand_emb) -> np.ndarray:
+        state = params if params is not None else get_state(model)
+        hist = np.ascontiguousarray(hist_emb, np.float32)
+        cand = np.ascontiguousarray(cand_emb, np.float32)
+        lens = np.ascontiguousarray(lengths, np.int32)
+        B = hist.shape[0]
+        Bp = 1 << (max(B, 1) - 1).bit_length()
+        if Bp != B:
+            pad = Bp - B
+            hist = np.concatenate(
+                [hist, np.zeros((pad,) + hist.shape[1:], np.float32)])
+            cand = np.concatenate(
+                [cand, np.zeros((pad,) + cand.shape[1:], np.float32)])
+            # length 1, not 0: padding rows must still be a valid scan
+            lens = np.concatenate([lens, np.ones(pad, np.int32)])
+        return np.asarray(_rank(state, hist, lens, cand))[:B]
+
+    return rank
 
 
 def export_gru4rec_towers(dirname: str, model: GRU4Rec, cache,

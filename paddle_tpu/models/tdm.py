@@ -39,7 +39,7 @@ from ..ps.embedding_cache import CacheConfig, cache_pull, cache_push
 from .ctr import _DNN
 
 __all__ = ["TDM", "make_tdm_train_step", "tdm_sample_batch",
-           "beam_search_retrieve", "node_keys"]
+           "beam_search_retrieve", "node_keys", "ServingBeamSource"]
 
 
 def node_keys(codes: np.ndarray) -> np.ndarray:
@@ -201,3 +201,65 @@ def beam_search_retrieve(tree: TreeIndex, model: TDM, params, cache,
         beam = [cand[i] for i in order[:k]]
     items = tree.get_items_of_codes(beam)
     return [i for i in items if i is not None][:k]
+
+
+class ServingBeamSource:
+    """Serving-path ``cache`` duck type for :func:`beam_search_retrieve`
+    (ISSUE 18 inference entry point): the beam walker wants HBM-cache
+    semantics — ``.state`` with ``embed_w``/``embedx_w`` arrays plus
+    ``lookup(keys) → row indices`` — but at serve time node embeddings
+    live behind a read-only :class:`~paddle_tpu.serving.lookup.
+    CachedLookup` (ServingReplica feed underneath). This adapter pulls
+    VALUES through the serving lookup and materializes them into a
+    fixed-shape local state block the jitted ``_beam_scorer`` can
+    gather from — fixed shape, because the scorer takes ``state`` as a
+    traced argument and a growing table would recompile every level.
+
+    Size ``capacity`` past the walk's working set (history leaves +
+    ``k·branch`` candidates per level × height): overflow FLUSHES the
+    block (correct — the next level re-fetches — but it invalidates
+    user rows computed before the flush, so the walker's one-shot
+    ``user_rows`` would gather stale slots; the enforce below makes
+    that loud). Row ``capacity`` is the zero sentinel, matching the
+    train-side convention (``rows < C`` masks it out)."""
+
+    def __init__(self, lookup, capacity: int = 1 << 14) -> None:
+        self._lookup = lookup
+        self.capacity = int(capacity)
+        # learn the row width from the lookup (a miss reads zeros — the
+        # serving contract — so probing key 0 is shape-only, harmless)
+        width = int(np.asarray(
+            lookup.lookup(np.zeros(1, np.uint64))).shape[1])
+        enforce(width >= 2, f"serving rows must be [show ++ embedx], "
+                            f"got width {width}")
+        self.state = {
+            "embed_w": np.zeros((self.capacity + 1, 1), np.float32),
+            "embedx_w": np.zeros((self.capacity + 1, width - 1),
+                                 np.float32)}
+        self._slots: dict = {}
+        self._next = 0
+        self.flushes = 0
+
+    def lookup(self, keys) -> np.ndarray:
+        keys = np.asarray(keys, np.uint64).reshape(-1)
+        missing = [int(k) for k in keys if int(k) not in self._slots]
+        if missing:
+            if self._next + len(missing) > self.capacity:
+                enforce(len(missing) <= self.capacity,
+                        f"beam working set {len(missing)} exceeds "
+                        f"ServingBeamSource capacity {self.capacity}")
+                self._slots.clear()
+                self._next = 0
+                self.state["embed_w"][:] = 0.0
+                self.state["embedx_w"][:] = 0.0
+                self.flushes += 1
+                missing = [int(k) for k in keys]
+            vals = np.asarray(self._lookup.lookup(
+                np.asarray(missing, np.uint64)), np.float32)
+            for k, v in zip(missing, vals):
+                slot = self._next
+                self._next += 1
+                self._slots[k] = slot
+                self.state["embed_w"][slot] = v[:1]
+                self.state["embedx_w"][slot] = v[1:]
+        return np.asarray([self._slots[int(k)] for k in keys], np.int32)

@@ -51,7 +51,7 @@ from .timeseries import MetricRing, Sampler
 from .trace import wall_s
 
 __all__ = ["SloRule", "Alert", "SloWatchdog", "default_rules",
-           "cold_tier_rules"]
+           "cold_tier_rules", "recsys_rules"]
 
 
 @dataclasses.dataclass
@@ -364,6 +364,58 @@ def default_rules(step_p95_s: float = 1.0,
                 labels={"outcome": "launched"}, kind="threshold",
                 field="delta", agg="rate", threshold=hedge_rate_per_s,
                 windows=((short_s, 1.0),)),
+    ]
+
+
+def recsys_rules(e2e_p99_s: float = 0.25,
+                 stage_retrieval_p99_s: Optional[float] = None,
+                 freshness_training_p95_s: float = 2.0,
+                 long_s: float = 60.0, short_s: float = 10.0
+                 ) -> List[SloRule]:
+    """Fleet rules for the ISSUE 18 retrieval→ranking pipeline, on top
+    of :func:`default_rules`:
+
+    - ``recsys_e2e_p99`` — the USER-facing objective: end-to-end
+      pipeline latency (retrieval fan-out through coalesced ranking,
+      the ``recorder="recsys_e2e"`` series the
+      :class:`~paddle_tpu.serving.pipeline.PipelineFrontend` emits)
+      must keep its p99 inside the request budget. This is the rule
+      the autoscaler's ``up_rules`` should name for a serving fleet —
+      per-member p99s can all be green while budget-carving skew burns
+      the end-to-end budget.
+    - ``recsys_stage_retrieval_p99`` — the triage split: when
+      ``recsys_e2e_p99`` fires, this says which stage ate the budget
+      (``serving_stage_latency_s{stage=retrieval}`` burning → the
+      fan-out/hedging side; quiet → the ranking coalescer). Defaults
+      to the retrieval share of the e2e budget.
+    - ``freshness_under_training`` — push→servable freshness measured
+      WHILE a CtrStreamTrainer is pushing to the served tables. A
+      deliberately looser threshold than the idle-feed
+      ``freshness_p95`` rule: under training load the oplog feed
+      carries real traffic and the replica applies between serve
+      batches, so the idle bound would page on every training burst
+      (docs/OPERATIONS.md §19 caveat).
+    """
+    w = ((long_s, 1.0), (short_s, 1.0))
+
+    def n(budget):
+        return int(round(1.0 / budget)) + 1
+
+    if stage_retrieval_p99_s is None:
+        stage_retrieval_p99_s = 0.6 * e2e_p99_s
+    return [
+        SloRule("recsys_e2e_p99", "serving_latency_s",
+                labels={"recorder": "recsys_e2e"},
+                threshold=e2e_p99_s, budget=0.01, windows=w,
+                min_count=n(0.01)),
+        SloRule("recsys_stage_retrieval_p99", "serving_stage_latency_s",
+                labels={"stage": "retrieval"},
+                threshold=stage_retrieval_p99_s, budget=0.01, windows=w,
+                min_count=n(0.01)),
+        SloRule("freshness_under_training", "serving_latency_s",
+                labels={"recorder": "freshness"},
+                threshold=freshness_training_p95_s, budget=0.05,
+                windows=w, min_count=n(0.05)),
     ]
 
 

@@ -41,16 +41,27 @@ PR 11 autoscaler as the elasticity controller); and
 :class:`~paddle_tpu.serving.rollout.RolloutManager` makes a model push
 a routed event (canary band → promote → digest-pinned rollback).
 
+The PIPELINE layer (ISSUE 18) chains retrieval into ranking behind ONE
+deadline: :class:`~paddle_tpu.serving.pipeline.PipelineFrontend` carves
+a per-request budget into stage budgets (candidate fan-out over the
+fleet with an early top-K cut, then cross-request coalesced ranking —
+one pow2-padded gather + one stacked infer for MANY requests), and
+:mod:`~paddle_tpu.serving.member_host` makes fleet members genuinely
+multi-host (one member per OS process, reachable only by endpoint).
+
 Operational guide: docs/OPERATIONS.md §12 (single replica), §17
-(fleet). Benches: tools/serving_bench.py (SERVING.json),
-tools/serving_fleet_bench.py (SERVING_FLEET.json).
+(fleet), §19 (pipeline). Benches: tools/serving_bench.py
+(SERVING.json), tools/serving_fleet_bench.py (SERVING_FLEET.json),
+tools/recsys_replay.py (RECSYS_E2E.json).
 """
 
 from .fleet import FleetConfig, FleetController, FleetMember, ServingFleet
 from .frontend import (DeadlineExceeded, FrontendConfig, PendingResult,
                        RequestRejected, ServingFrontend)
 from .lookup import CachedLookup, ReplicaLookup
+from .member_host import RemoteFrontend, RemoteModel, spawn_member
 from .metrics import FreshnessProbe, LatencyRecorder
+from .pipeline import PipelineConfig, PipelineFrontend
 from .replica import (DenseTowerPublisher, DenseTowerSync, ServingReplica,
                       make_serve_client)
 from .rollout import DenseModel, RolloutConfig, RolloutManager
@@ -80,4 +91,9 @@ __all__ = [
     "RolloutManager",
     "RolloutConfig",
     "DenseModel",
+    "PipelineFrontend",
+    "PipelineConfig",
+    "spawn_member",
+    "RemoteFrontend",
+    "RemoteModel",
 ]

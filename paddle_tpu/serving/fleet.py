@@ -142,6 +142,14 @@ class FleetConfig:
     #: with the fleet)
     min_replicas: int = 1
     max_replicas: int = 8
+    #: consecutive tick lease-misses before crash-removal. Removal is
+    #: violent (router.remove + SIGKILL the member), so one stale
+    #: list_prefix read — or a member whose heartbeat thread got starved
+    #: for a beat on an oversubscribed host — must not execute a healthy
+    #: member. Two misses poll_s apart means the lease stayed expired
+    #: across a full re-read, the same double-confirmation the HA
+    #: coordinator applies before declaring a primary dead.
+    evict_misses: int = 2
 
 
 class ServingFleet:
@@ -169,6 +177,9 @@ class ServingFleet:
         #: endpoints mid-drain: the watcher must NOT re-admit these
         #: (they are ejected on purpose — healthy, leased, and leaving)
         self._draining: set = set()
+        #: per-endpoint consecutive lease-miss counts (tick-only state;
+        #: guarded by _mu alongside _members)
+        self._lease_misses: Dict[str, int] = {}
         self.rollout = None           # optional RolloutManager
         self.events: deque = deque(maxlen=512)
         self.counters = _obs_registry.CounterGroup(
@@ -297,12 +308,27 @@ class ServingFleet:
         for ep, member in known:
             if ep in draining:
                 continue     # leaving on purpose — drain() owns it
+            if ep in leased:
+                with self._mu:
+                    # a hit resets the grace window — only CONSECUTIVE
+                    # misses count toward eviction
+                    self._lease_misses.pop(ep, None)
             if ep not in leased:
                 # crash path: the lease expired — the same signal that
-                # detaches it from the primary's shipper
+                # detaches it from the primary's shipper. Tolerate
+                # evict_misses-1 transient misses (stale store read,
+                # starved heartbeat) before the violent removal; a
+                # member whose child PROCESS is verifiably gone skips
+                # the grace — there is nothing left to spare.
+                with self._mu:
+                    misses = self._lease_misses.get(ep, 0) + 1
+                    self._lease_misses[ep] = misses
+                if misses < self.config.evict_misses and member.healthy:
+                    continue
                 self.router.remove(ep)
                 with self._mu:
                     self._members.pop(ep, None)
+                    self._lease_misses.pop(ep, None)
                     self.counters["crashes_removed"] += 1
                 removed.append(ep)
                 try:

@@ -32,11 +32,19 @@ class LatencyRecorder:
     ``name`` — the frontend names its recorders request/serve/…), so
     the aggregated snapshot carries serving latency next to the PS wire
     counters. ``percentiles()`` stays the exact ring-based accessor the
-    PR 7 tests and SERVING.json thresholds read."""
+    PR 7 tests and SERVING.json thresholds read.
+
+    ``family`` redirects the registry samples into a different
+    histogram family — the pipeline's per-stage recorders land in
+    ``serving_stage_latency_s{stage=retrieval|ranking}`` (ISSUE 18)
+    while keeping the exact ring accessor; extra keyword ``labels``
+    ride along (e.g. ``stage="retrieval"``)."""
 
     def __init__(self, window: int = 4096,
                  name: Optional[str] = None,
-                 replica: str = "-") -> None:
+                 replica: str = "-",
+                 family: str = "serving_latency_s",
+                 **labels: str) -> None:
         self._ring: deque = deque(maxlen=window)
         self._mu = threading.Lock()
         self.count = 0
@@ -45,10 +53,11 @@ class LatencyRecorder:
         # router's SLO rules and the /metrics fleet view read.
         # Cardinality is bounded by max_series (PR 8 overflow rule).
         self._hist = _obs_registry.REGISTRY.histogram(
-            "serving_latency_s", max_series=1024,
+            family, max_series=1024,
             recorder=name if name is not None
             else f"latency{next(_REC_SEQ)}",
-            replica=str(replica))
+            replica=str(replica),
+            **{k: str(v) for k, v in labels.items()})
 
     def record(self, seconds: float) -> None:
         self._hist.observe(seconds)

@@ -134,6 +134,14 @@ class RouterConfig:
     hedge: bool = True
     #: total members tried per request (first choice + reroutes/hedges)
     max_attempts: int = 3
+    #: minimum MEASURED remaining budget (ms) a hedge/reroute must have
+    #: to launch, and the floor a sub-request's deadline header may
+    #: carry. A late-life duplicate below this cannot possibly answer
+    #: in time — launching it burns a member slot on a request whose
+    #: caller has already given up (the ISSUE 18 bugfix: the old path
+    #: floored an EXPIRED request's sub-deadline at a fabricated 1 ms
+    #: and checked expiry against a stale batch timestamp)
+    min_sub_budget_ms: float = 1.0
     #: EWMA weight for the P2C queue-depth signal
     ewma_alpha: float = 0.3
     #: per-member latency window backing the p95 hedge budget
@@ -181,7 +189,8 @@ class RoutedRequest:
     __slots__ = ("router", "keys", "dense", "deadline_ms", "block",
                  "version", "t0", "event", "value", "error", "mu",
                  "tried", "hedged", "hedge_at", "claimed", "subs",
-                 "sparse", "submitted", "outstanding", "last_error")
+                 "sparse", "submitted", "outstanding", "last_error",
+                 "cbs")
 
     def __init__(self, router: "ServingRouter", keys, dense,
                  deadline_ms: float, block: Optional[int],
@@ -212,6 +221,10 @@ class RoutedRequest:
         self.submitted = 0
         self.outstanding = 0
         self.last_error: Optional[BaseException] = None
+        #: completion callbacks (guarded by mu until fired) — the
+        #: pipeline's scatter-back hook; fired once, outside mu, on the
+        #: delivering frontend's worker thread
+        self.cbs: List[Callable[["RoutedRequest"], None]] = []
 
     # -- caller surface ----------------------------------------------------
 
@@ -224,6 +237,27 @@ class RoutedRequest:
 
     def done(self) -> bool:
         return self.event.is_set()
+
+    def add_done_callback(self, fn: Callable[["RoutedRequest"], None]
+                          ) -> None:
+        """Run ``fn(self)`` when the routed request completes (won OR
+        errored); fires immediately if already done. Callbacks run on
+        the completing frontend's worker thread — keep them cheap (the
+        pipeline stage hand-off is the intended shape)."""
+        with self.mu:
+            if not self.event.is_set():
+                self.cbs.append(fn)
+                return
+        fn(self)
+
+    def _fire_callbacks(self) -> None:
+        with self.mu:
+            cbs, self.cbs = self.cbs, []
+        for cb in cbs:
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — callback owns its errors
+                pass
 
     def remaining_ms(self, now: Optional[float] = None) -> float:
         now = self.router._clock() if now is None else now
@@ -243,7 +277,12 @@ class RoutedRequest:
                 return False
             self.hedged = True
             self.submitted += 1          # reserve the attempt slot
-        if self.remaining_ms(now) <= 0:
+        # expiry check against a FRESH clock read, never the (possibly
+        # stale) batch timestamp the hedge loop captured before firing
+        # a whole batch of due hedges: with a stale `now` an already-
+        # expired request would still hedge with a fabricated budget.
+        # A hedge below min_sub_budget_ms cannot answer in time either.
+        if self.remaining_ms() <= self.router.config.min_sub_budget_ms:
             with self.mu:
                 self.submitted -= 1
                 self.hedged = False     # aborted, not launched — a
@@ -285,6 +324,7 @@ class RoutedRequest:
             dt = self.router._clock() - self.t0
             self.router._record_win(self, endpoint, dt)
             self.event.set()
+            self._fire_callbacks()
             return
         # failure: reroute while a member, an attempt slot, and deadline
         # budget remain. DeadlineExceeded is final — the caller's budget
@@ -296,7 +336,8 @@ class RoutedRequest:
             self.last_error = err
             if not self.claimed and not final \
                     and self.submitted < self.router.config.max_attempts \
-                    and self.remaining_ms() > 0:
+                    and self.remaining_ms() \
+                    > self.router.config.min_sub_budget_ms:
                 retry = True
                 self.submitted += 1      # reserve the attempt slot
         if retry:
@@ -317,6 +358,7 @@ class RoutedRequest:
             self.error = self.last_error or err
         self.router._count("errors")
         self.event.set()
+        self._fire_callbacks()
 
 
 class ServingRouter:
@@ -606,9 +648,15 @@ class ServingRouter:
                     self.config) / 1e3
             self._arm_hedge(rr)
         try:
+            # the sub-request header carries the MEASURED remaining
+            # budget — a hedge/reroute launched late in the request's
+            # life inherits what is actually left, never the original
+            # full deadline (and never a fabricated floor: an expired
+            # request's sub-deadline goes out non-positive, so the
+            # member drops it pre-lookup as DeadlineExceeded — final)
             pending = state.member.frontend.submit(
                 rr.keys, dense=rr.dense,
-                deadline_ms=max(rr.remaining_ms(), 1.0))
+                deadline_ms=rr.remaining_ms())
         except BaseException as e:  # noqa: BLE001 — rerouted like a fail
             # _sub_failed → _note_done balances the inflight increment
             self._sub_failed(rr, ep, e)

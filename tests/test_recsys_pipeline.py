@@ -1,0 +1,815 @@
+"""End-to-end recsys pipeline (ISSUE 18): staged deadline budgets,
+cross-request ranking coalescing, the router remaining-budget bugfix,
+recsys SLO rules, model inference smoke through the serving read path,
+and the multi-host (subprocess) fleet member.
+
+Layers, bottom-up: PipelineFrontend unit behavior over a stub router
+(budget carving, early top-K cut + straggler metering, coalesce factor,
+rank-queue deadline drops — deterministic under an injected clock); the
+ISSUE 18 router pin tests (a hedge/reroute launched late carries the
+MEASURED remaining budget, and a nearly-expired request cannot hedge
+even when the hedge-loop hands maybe_hedge a stale timestamp);
+obs/slo.py recsys_rules both directions; TDM/GRU4Rec/DSSM inference
+served through a read-only ServingReplica + CachedLookup; and the
+member_host subprocess member — wire lookups, model push, crash
+fidelity (chaos: kill a member mid-stream, zero user-visible errors).
+"""
+
+import random
+import time
+
+import numpy as np
+# eager: numpy.testing's lazy import forks (SVE probe) — deadlocks the
+# sanitizer sweeps once cluster threads are live (test_serving.py note)
+import numpy.testing  # noqa: F401
+import pytest
+
+from paddle_tpu.core.enforce import EnforceNotMet
+from paddle_tpu.obs import slo
+from paddle_tpu.obs import registry as obs_registry
+from paddle_tpu.obs.registry import Registry
+from paddle_tpu.obs.timeseries import MetricRing
+from paddle_tpu.ps.accessor import AccessorConfig
+from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+from paddle_tpu.ps.table import TableConfig
+
+rpc = pytest.importorskip("paddle_tpu.ps.rpc")
+
+pytestmark = pytest.mark.skipif(
+    not rpc.rpc_available(), reason="native toolchain unavailable")
+
+from paddle_tpu.data.index_dataset import TreeIndex  # noqa: E402
+from paddle_tpu.distributed import elastic  # noqa: E402
+from paddle_tpu.models.dssm import DSSM, make_dssm_ranker  # noqa: E402
+from paddle_tpu.models.gru4rec import (GRU4Rec,  # noqa: E402
+                                       make_gru4rec_ranker)
+from paddle_tpu.models.tdm import (TDM, ServingBeamSource,  # noqa: E402
+                                   beam_search_retrieve, node_keys)
+from paddle_tpu.ps import ha  # noqa: E402
+from paddle_tpu.ps.hot_tier import (HotEmbeddingTier,  # noqa: E402
+                                    HotTierConfig)
+from paddle_tpu.serving import (CachedLookup, DeadlineExceeded,  # noqa: E402
+                                PipelineConfig, PipelineFrontend,
+                                RequestRejected, RouterConfig,
+                                ServingReplica, ServingRouter,
+                                spawn_member)
+
+
+# ---------------------------------------------------------------------------
+# stub plumbing: pipeline unit tests (no cluster, no RPC)
+# ---------------------------------------------------------------------------
+
+class _Clk:
+    """Injectable clock: tests advance ``t`` by assignment."""
+
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+def _rows_for(keys, width=3):
+    """Deterministic member/lookup rows: [show=1, key, 1, ...]."""
+    k = np.asarray(keys, np.float64)
+    out = np.ones((len(k), width), np.float32)
+    out[:, 1] = k
+    return out
+
+
+class _FanRR:
+    """RoutedRequest-shaped stub the pipeline's fan callbacks drive."""
+
+    def __init__(self, keys, deadline_ms):
+        self.keys = np.asarray(keys, np.uint64)
+        self.deadline_ms = deadline_ms
+        self.value = None
+        self.error = None
+        self._done = False
+        self._cbs = []
+
+    def add_done_callback(self, fn):
+        if self._done:
+            fn(self)
+        else:
+            self._cbs.append(fn)
+
+    def settle(self, value=None, error=None):
+        self.value, self.error, self._done = value, error, True
+        for cb in self._cbs:
+            cb(self)
+
+
+class _PipeRouter:
+    """Stub fleet: records every fan sub-request (keys + the deadline
+    the pipeline carved); ``auto=True`` answers immediately with
+    deterministic rows, ``auto=False`` leaves settling to the test."""
+
+    def __init__(self, auto=True, width=3):
+        self.auto = auto
+        self.width = width
+        self.requests = []
+
+    def submit(self, keys, deadline_ms=None, **kw):
+        rr = _FanRR(keys, deadline_ms)
+        self.requests.append(rr)
+        if self.auto:
+            rr.settle(value=_rows_for(keys, self.width))
+        return rr
+
+
+class _PipeLookup:
+    """Ranking-side embedding source (one fused gather per batch)."""
+
+    def __init__(self, width=3):
+        self.width = width
+        self.calls = 0
+        self.sizes = []
+
+    def lookup(self, keys):
+        self.calls += 1
+        self.sizes.append(len(keys))
+        return _rows_for(keys, self.width)
+
+
+_UV = np.array([1.0, 0.0], np.float32)    # retrieval score == key value
+
+
+def _pipe(router=None, lookup=None, **cfg_kw):
+    cfg_kw.setdefault("fanout", 2)
+    cfg_kw.setdefault("fan_width", 4)
+    cfg_kw.setdefault("topk", 4)
+    cfg_kw.setdefault("early_cut_frac", 1.0)
+    cfg_kw.setdefault("rank_max_delay_us", 200)
+    clock = cfg_kw.pop("clock", time.perf_counter)
+    idle = cfg_kw.pop("idle_pop_s", 0.002)
+    return PipelineFrontend(router or _PipeRouter(),
+                            lookup or _PipeLookup(),
+                            config=PipelineConfig(**cfg_kw),
+                            clock=clock, idle_pop_s=idle)
+
+
+# ---------------------------------------------------------------------------
+# pipeline: staged budgets, early cut, coalescing
+# ---------------------------------------------------------------------------
+
+def test_pipeline_basic_topk_ordering_and_fused_gather():
+    router, lookup = _PipeRouter(), _PipeLookup()
+    with _pipe(router, lookup) as pipe:
+        pr = pipe.submit(_UV, [10, 11], np.arange(1, 9, dtype=np.uint64))
+        keys, scores = pr.result(10)
+        # retrieval scores == key → top-4 of 1..8, best first; the
+        # default ranker (mean-history · candidate) preserves the order
+        assert list(keys) == [8, 7, 6, 5]
+        assert (np.diff(scores) < 0).all()
+        st = pipe.stats()
+        assert st["accepted"] == st["served"] == st["early_cuts"] == 1
+        assert st["errors"] == st["shed"] == 0
+        assert st["stragglers_abandoned"] == st["stragglers_late"] == 0
+        # ONE gather carried history + top-K together
+        assert lookup.calls == 1 and lookup.sizes[0] == 2 + 4
+        assert st["e2e_ms"]["count"] == 1
+        assert st["stage_retrieval_ms"]["count"] == 1
+        assert st["stage_ranking_ms"]["count"] == 1
+
+
+def test_pipeline_budget_carving_is_retrieval_share_of_remaining():
+    clk = _Clk()
+    router = _PipeRouter()
+    with _pipe(router, clock=clk, retrieval_frac=0.6) as pipe:
+        pr = pipe.submit(_UV, [1, 2], np.arange(8, dtype=np.uint64),
+                         deadline_ms=100.0)
+        pr.result(10)
+        # frozen clock: nothing elapsed between accept and fan-out, so
+        # each fan's sub-deadline is EXACTLY the retrieval share
+        assert len(router.requests) == 2
+        for rr in router.requests:
+            assert rr.deadline_ms == pytest.approx(60.0)
+
+
+def test_pipeline_coalesces_across_concurrent_requests():
+    with _pipe(fanout=1, topk=2, rank_max_delay_us=100_000,
+               rank_max_batch=64) as pipe:
+        pending = [pipe.submit(_UV, [30 + i, 40 + i],
+                               np.arange(4 * i, 4 * i + 4, dtype=np.uint64))
+                   for i in range(8)]
+        for pr in pending:
+            keys, scores = pr.result(10)
+            assert keys.shape == scores.shape == (2,)
+        st = pipe.stats()
+        assert st["served"] == 8
+        # the whole burst landed in fewer stacked infers than requests
+        assert st["rank_batches"] < 8
+        assert st["coalesce_factor"] > 1.0
+
+
+def test_pipeline_early_cut_meters_stragglers():
+    router = _PipeRouter(auto=False)
+    with _pipe(router, fanout=4, fan_width=2,
+               early_cut_frac=0.75) as pipe:
+        pr = pipe.submit(_UV, [20, 21], np.arange(1, 9, dtype=np.uint64))
+        fans = router.requests
+        assert len(fans) == 4
+        for rr in fans[:3]:                 # need = ceil(.75×4) = 3
+            rr.settle(value=_rows_for(rr.keys))
+        keys, _ = pr.result(10)
+        # only the settled fans' pool (keys 1..6) competed
+        assert list(keys) == [6, 5, 4, 3]
+        st = pipe.stats()
+        assert st["early_cuts"] == 1
+        assert st["stragglers_abandoned"] == 1
+        # the abandoned fan answers anyway → metered late, not delivered
+        fans[3].settle(value=_rows_for(fans[3].keys))
+        assert pipe.stats()["stragglers_late"] == 1
+        assert pipe.stats()["served"] == 1
+
+
+def test_pipeline_fan_failures_partial_and_total():
+    router = _PipeRouter(auto=False)
+    with _pipe(router, fanout=4, fan_width=2,
+               early_cut_frac=0.75) as pipe:
+        # partial: one fan fails, the cut still fires off three values
+        pr = pipe.submit(_UV, [7, 8], np.arange(1, 9, dtype=np.uint64))
+        fans = router.requests
+        fans[0].settle(error=RequestRejected("member down"))
+        for rr in fans[1:]:
+            rr.settle(value=_rows_for(rr.keys))
+        keys, _ = pr.result(10)
+        assert list(keys) == [8, 7, 6, 5]
+        assert pipe.stats()["fan_failures"] == 1
+        assert pipe.stats()["errors"] == 0
+        # total: every fan fails → the request fails with the last error
+        pr2 = pipe.submit(_UV, [7, 8], np.arange(1, 9, dtype=np.uint64))
+        for rr in router.requests[4:]:
+            rr.settle(error=RequestRejected("fleet gone"))
+        with pytest.raises(RequestRejected):
+            pr2.result(10)
+        st = pipe.stats()
+        assert st["fan_failures"] == 1 + 4 and st["errors"] == 1
+
+
+def test_pipeline_budget_spent_in_retrieval_is_deadline_exceeded():
+    clk = _Clk()
+    router = _PipeRouter(auto=False)
+    with _pipe(router, clock=clk) as pipe:
+        pr = pipe.submit(_UV, [1, 2], np.arange(8, dtype=np.uint64),
+                         deadline_ms=50.0)
+        clk.t = 0.2                          # fans answer after the budget
+        for rr in router.requests:
+            rr.settle(value=_rows_for(rr.keys))
+        with pytest.raises(DeadlineExceeded):
+            pr.result(10)
+        assert pipe.stats()["retrieval_deadline"] == 1
+
+
+def test_pipeline_drops_requests_expired_in_rank_queue():
+    clk = _Clk()
+    # a long coalesce window holds the batch open while the test
+    # expires the request's deadline on the injected clock
+    with _pipe(clock=clk, rank_max_delay_us=200_000,
+               default_deadline_ms=50.0) as pipe:
+        pr = pipe.submit(_UV, [1, 2], np.arange(8, dtype=np.uint64))
+        clk.t = 1.0
+        with pytest.raises(DeadlineExceeded):
+            pr.result(10)
+        assert pipe.stats()["rank_deadline_dropped"] == 1
+        assert pipe.stats()["served"] == 0
+
+
+def test_pipeline_shape_pins():
+    with _pipe() as pipe:
+        # candidate count must be exactly fanout × fan_width
+        with pytest.raises(EnforceNotMet):
+            pipe.submit(_UV, [1, 2], np.arange(5, dtype=np.uint64))
+        pipe.submit(_UV, [1, 2], np.arange(8, dtype=np.uint64)).result(10)
+        # history length pins on first submit (one stacked ranker shape)
+        with pytest.raises(EnforceNotMet):
+            pipe.submit(_UV, [1, 2, 3], np.arange(8, dtype=np.uint64))
+
+
+def test_pipeline_stage_metric_families_in_registry():
+    with _pipe() as pipe:
+        pipe.submit(_UV, [1, 2], np.arange(8, dtype=np.uint64)).result(10)
+        snap = obs_registry.REGISTRY.snapshot()["metrics"]
+        stages = {s["labels"].get("stage")
+                  for s in snap["serving_stage_latency_s"]["series"]}
+        assert {"retrieval", "ranking"} <= stages
+        e2e = [s for s in snap["serving_latency_s"]["series"]
+               if s["labels"].get("recorder") == "recsys_e2e"]
+        assert e2e and sum(s["count"] for s in e2e) >= 1
+
+
+def test_pipeline_stop_rejects_and_fails_queued():
+    with _pipe() as pipe:
+        pipe.stop()
+        with pytest.raises(RequestRejected):
+            pipe.submit(_UV, [1, 2], np.arange(8, dtype=np.uint64))
+
+
+# ---------------------------------------------------------------------------
+# router pin tests (ISSUE 18 bugfix): remaining budget, stale-now hedge
+# ---------------------------------------------------------------------------
+
+class _RecSub:
+    """PendingResult-shaped stub settled by the test (the frontend's
+    zero-arg callback convention)."""
+
+    def __init__(self):
+        self._cbs = []
+        self._err = None
+        self._val = None
+        self._done = False
+
+    def add_done_callback(self, fn):
+        if self._done:
+            fn()
+        else:
+            self._cbs.append(fn)
+
+    def exception(self):
+        return self._err
+
+    def value(self):
+        return self._val
+
+    def settle(self, val=None, err=None):
+        self._val, self._err, self._done = val, err, True
+        for cb in self._cbs:
+            cb()
+
+
+class _RecFrontend:
+    """Member frontend recording the deadline each sub-request carried."""
+
+    def __init__(self):
+        self.deadlines = []
+        self.subs = []
+        self.queue_depth = 0
+        self.stopped = False
+
+    def submit(self, keys, dense=None, deadline_ms=None):
+        self.deadlines.append(float(deadline_ms))
+        sub = _RecSub()
+        self.subs.append(sub)
+        return sub
+
+
+class _RecMember:
+    def __init__(self, name):
+        self.endpoint = name
+        self.healthy = True
+        self.frontend = _RecFrontend()
+
+
+def _pin_router(clk, **cfg_kw):
+    cfg_kw.setdefault("hedge_default_ms", 20.0)
+    cfg_kw.setdefault("hedge_min_samples", 1 << 30)
+    r = ServingRouter(RouterConfig(**cfg_kw), rng=random.Random(0),
+                      clock=clk)
+    members = [_RecMember("m0"), _RecMember("m1")]
+    for m in members:
+        r.attach(m)
+    return r, members
+
+
+def _total_subs(members):
+    return sum(len(m.frontend.deadlines) for m in members)
+
+
+def _all_deadlines(members):
+    return [d for m in members for d in m.frontend.deadlines]
+
+
+def test_hedge_carries_measured_remaining_budget():
+    clk = _Clk()
+    r, members = _pin_router(clk)
+    try:
+        rr = r.submit(np.arange(8, dtype=np.uint64), deadline_ms=100.0)
+        assert _all_deadlines(members) == [pytest.approx(100.0)]
+        # 60 ms into a 100 ms request: the hedge header must say 40,
+        # never the original 100 (the pinned bugfix)
+        clk.t = 0.060
+        assert rr.maybe_hedge() is True
+        assert sorted(_all_deadlines(members)) == [
+            pytest.approx(40.0), pytest.approx(100.0)]
+        assert rr.tried == ["m0", "m1"] or rr.tried == ["m1", "m0"]
+        # the hedge wins; the primary's late answer is deduped
+        for m in members:
+            for sub in m.frontend.subs:
+                sub.settle(val=_rows_for(np.arange(8)))
+        assert rr.result(10).shape == (8, 3)
+    finally:
+        r.stop()
+
+
+def test_nearly_expired_request_cannot_hedge_with_stale_now():
+    clk = _Clk()
+    r, members = _pin_router(clk)
+    try:
+        rr = r.submit(np.arange(8, dtype=np.uint64), deadline_ms=100.0)
+        # the request expires; the hedge loop wakes with a timestamp it
+        # captured BEFORE the batch — the fresh-clock re-check must
+        # refuse to launch a duplicate with a fabricated budget
+        clk.t = 0.250
+        assert rr.maybe_hedge(now=0.030) is False
+        assert _total_subs(members) == 1
+        assert rr.hedged is False            # aborted, not launched
+        # sub-millimeter remaining (0.5 ms < min_sub_budget_ms): same
+        clk.t = 0.0995
+        assert rr.maybe_hedge(now=0.030) is False
+        assert _total_subs(members) == 1
+    finally:
+        r.stop()
+
+
+def test_reroute_inherits_remaining_and_expiry_is_final():
+    clk = _Clk()
+    r, members = _pin_router(clk, hedge=False)
+    try:
+        # mid-life failure: the reroute carries 100 − 30 = 70 ms
+        rr = r.submit(np.arange(8, dtype=np.uint64), deadline_ms=100.0)
+        clk.t = 0.030
+        first = [m for m in members if m.frontend.subs][0]
+        first.frontend.subs[0].settle(err=RuntimeError("conn reset"))
+        assert _total_subs(members) == 2
+        assert sorted(_all_deadlines(members)) == [
+            pytest.approx(70.0), pytest.approx(100.0)]
+        other = [m for m in members if m is not first][0]
+        other.frontend.subs[0].settle(val=_rows_for(np.arange(8)))
+        assert rr.result(10).shape == (8, 3)
+        assert r.stats()["reroutes"] == 1
+
+        # budget already spent: a failure must NOT reroute
+        base = _total_subs(members)
+        clk.t = 1.0
+        rr2 = r.submit(np.arange(8, dtype=np.uint64), deadline_ms=50.0)
+        clk.t = 1.2
+        last = [m for m in members if len(m.frontend.subs)
+                and not m.frontend.subs[-1]._done][0]
+        last.frontend.subs[-1].settle(err=RuntimeError("conn reset"))
+        assert _total_subs(members) == base + 1
+        with pytest.raises(RuntimeError):
+            rr2.result(10)
+
+        # DeadlineExceeded from a member is final even with budget left
+        base = _total_subs(members)
+        clk.t = 2.0
+        rr3 = r.submit(np.arange(8, dtype=np.uint64), deadline_ms=100.0)
+        clk.t = 2.01
+        last = [m for m in members if len(m.frontend.subs)
+                and not m.frontend.subs[-1]._done][0]
+        last.frontend.subs[-1].settle(err=DeadlineExceeded("member"))
+        assert _total_subs(members) == base + 1
+        with pytest.raises(DeadlineExceeded):
+            rr3.result(10)
+    finally:
+        r.stop()
+
+
+# ---------------------------------------------------------------------------
+# obs: recsys SLO rules, both directions
+# ---------------------------------------------------------------------------
+
+def _recsys_ring(pattern, family, labels, dt=0.05):
+    """Ring with one recsys-labeled histogram: 'g' ticks observe 0.05
+    (good vs threshold 1.0), 'b' ticks 5.0 (bad)."""
+    reg = Registry()
+    h = reg.histogram(family, buckets=(0.1, 1.0), **labels)
+    ring = MetricRing()
+    t = 0.0
+    for ch in pattern:
+        h.observe(0.05 if ch == "g" else 5.0)
+        ring.append(reg.snapshot(), t=t)
+        t += dt
+    return ring, t - dt
+
+
+def _recsys_rule(name):
+    rules = slo.recsys_rules(e2e_p99_s=1.0, stage_retrieval_p99_s=1.0,
+                             freshness_training_p95_s=1.0)
+    return next(r for r in rules if r.name == name)
+
+
+def test_recsys_e2e_rule_fires_and_stays_quiet():
+    rule = _recsys_rule("recsys_e2e_p99")
+    labels = {"recorder": "recsys_e2e", "replica": "-"}
+    ring, now = _recsys_ring("g" * 150 + "b" * 150,
+                             "serving_latency_s", labels)
+    fired = slo.SloWatchdog(ring, [rule]).evaluate(now=now)
+    assert [a.rule for a in fired] == ["recsys_e2e_p99"]
+    ring2, now2 = _recsys_ring("g" * 300, "serving_latency_s", labels)
+    assert slo.SloWatchdog(ring2, [rule]).evaluate(now=now2) == []
+    # label selectivity: a burning NON-recsys recorder must not page it
+    ring3, now3 = _recsys_ring("b" * 300, "serving_latency_s",
+                               {"recorder": "request", "replica": "-"})
+    assert slo.SloWatchdog(ring3, [rule]).evaluate(now=now3) == []
+
+
+def test_recsys_stage_retrieval_rule_selects_its_stage():
+    rule = _recsys_rule("recsys_stage_retrieval_p99")
+    ring, now = _recsys_ring("g" * 150 + "b" * 150,
+                             "serving_stage_latency_s",
+                             {"recorder": "pipeline_stage", "replica": "-",
+                              "stage": "retrieval"})
+    fired = slo.SloWatchdog(ring, [rule]).evaluate(now=now)
+    assert [a.rule for a in fired] == ["recsys_stage_retrieval_p99"]
+    # a burning RANKING stage is the other triage branch — quiet here
+    ring2, now2 = _recsys_ring("b" * 300, "serving_stage_latency_s",
+                               {"recorder": "pipeline_stage",
+                                "replica": "-", "stage": "ranking"})
+    assert slo.SloWatchdog(ring2, [rule]).evaluate(now=now2) == []
+
+
+def test_freshness_under_training_rule_both_directions():
+    rule = _recsys_rule("freshness_under_training")
+    labels = {"recorder": "freshness", "replica": "-"}
+    ring, now = _recsys_ring("g" * 100 + "b" * 100,
+                             "serving_latency_s", labels)
+    fired = slo.SloWatchdog(ring, [rule]).evaluate(now=now)
+    assert [a.rule for a in fired] == ["freshness_under_training"]
+    ring2, now2 = _recsys_ring("g" * 200, "serving_latency_s", labels)
+    assert slo.SloWatchdog(ring2, [rule]).evaluate(now=now2) == []
+
+
+def test_recsys_rules_default_stage_budget_is_retrieval_share():
+    rules = {r.name: r for r in slo.recsys_rules(e2e_p99_s=0.5)}
+    assert rules["recsys_stage_retrieval_p99"].threshold == \
+        pytest.approx(0.3)
+    assert rules["recsys_e2e_p99"].labels == {"recorder": "recsys_e2e"}
+    assert rules["freshness_under_training"].budget == pytest.approx(0.05)
+
+
+# ---------------------------------------------------------------------------
+# real-cluster plumbing (shared with the model smoke + subprocess tests)
+# ---------------------------------------------------------------------------
+
+def _acc(dim=4):
+    return AccessorConfig(embedx_dim=dim, embedx_threshold=0.0,
+                          sgd=SGDRuleConfig(initial_range=0.01))
+
+
+def _cfg(dim=4):
+    return TableConfig(shard_num=4, accessor_config=_acc(dim))
+
+
+def _push(rng, keys, width):
+    push = np.zeros((len(keys), width), np.float32)
+    push[:, 1] = 1.0
+    push[:, 2:] = rng.normal(0, 0.1, (len(keys), width - 2)).astype(
+        np.float32)
+    return push
+
+
+def _cluster(**kw):
+    kw.setdefault("num_shards", 1)
+    kw.setdefault("replication", 1)
+    kw.setdefault("sync", True)
+    return ha.HACluster(**kw)
+
+
+def _preload(cli, keys, rng, dim=4):
+    cli.create_sparse_table(0, _cfg(dim))
+    cli.pull_sparse(0, keys)
+    width = cli._dims(0)[1]
+    cli.push_sparse(0, keys, _push(rng, keys, width))
+    return width
+
+
+def _wait_caught_up(cluster, serve_cli, table_id=0, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while True:
+        prim = cluster.primary(0)
+        dg_p = cluster.digests(table_id, 0).get(prim.endpoint)
+        dg_r = serve_cli.digest(table_id)[0]
+        if dg_p is not None and dg_p == dg_r:
+            return
+        assert time.monotonic() < deadline, "replica never caught up"
+        time.sleep(0.02)
+
+
+def _serving_stack(cluster, dim=4, capacity=1 << 12):
+    """Read-only replica + caught-up CachedLookup (the serve path every
+    model smoke test pulls embeddings through)."""
+    rep = ServingReplica(cluster.store, cluster.job_id, shard=0,
+                         hb_interval=0.05, hb_ttl=0.5)
+    serve = rep.client()
+    view = rep.serve_view(0, _cfg(dim), client=serve)
+    _wait_caught_up(cluster, serve)
+    tier = HotEmbeddingTier(view, HotTierConfig(
+        capacity=capacity, create_on_miss=False))
+    return rep, CachedLookup(tier, replica=rep, freshness_budget_s=30.0)
+
+
+def _emb_block(lookup, keys_2d):
+    """[B, S] uint64 keys → [B, S, width] served embedding block."""
+    keys_2d = np.asarray(keys_2d, np.uint64)
+    rows = np.asarray(lookup.lookup(keys_2d.reshape(-1)), np.float32)
+    return rows.reshape(keys_2d.shape + (rows.shape[-1],))
+
+
+# ---------------------------------------------------------------------------
+# model inference smoke through the serving read path (satellite 1)
+# ---------------------------------------------------------------------------
+
+def test_tdm_beam_search_through_serving_lookup():
+    tree = TreeIndex(list(range(16)), branch=2)
+    with _cluster() as cluster:
+        cli = cluster.client()
+        rng = np.random.default_rng(0)
+        _preload(cli, node_keys(np.arange(tree.total_node_num())), rng)
+        rep, lookup = _serving_stack(cluster)
+        try:
+            model = TDM(embedx_dim=4, hidden=(8, 8))
+            params = {"params": dict(model.named_parameters()),
+                      "buffers": {}}
+            src = ServingBeamSource(lookup, capacity=1 << 10)
+            got = beam_search_retrieve(tree, model, params, src,
+                                       [0, 3, 5], k=4)
+            assert got and len(got) <= 4
+            assert all(0 <= i < 16 for i in got)
+            assert src.flushes == 0
+            # a second walk reuses the resident block
+            got2 = beam_search_retrieve(tree, model, params, src,
+                                        [9, 12], k=4)
+            assert got2
+        finally:
+            rep.close()
+
+
+def test_gru4rec_ranker_over_served_embeddings():
+    with _cluster() as cluster:
+        cli = cluster.client()
+        rng = np.random.default_rng(1)
+        keys = np.arange(64, dtype=np.uint64)
+        _preload(cli, keys, rng)
+        rep, lookup = _serving_stack(cluster)
+        try:
+            model = GRU4Rec(embedx_dim=4, hidden=8, out_dim=8)
+            ranker = make_gru4rec_ranker(model)
+            B, H, K = 3, 5, 4
+            hist = _emb_block(lookup, keys[:B * H].reshape(B, H))
+            cand = _emb_block(lookup, keys[32:32 + B * K].reshape(B, K))
+            lengths = np.full(B, H, np.int32)
+            scores = ranker(hist, lengths, cand)
+            assert scores.shape == (B, K)
+            assert np.isfinite(scores).all()
+            # cosine of L2-normalized towers, and deterministic
+            assert (np.abs(scores) <= 1.0 + 1e-5).all()
+            np.testing.assert_array_equal(
+                scores, ranker(hist, lengths, cand))
+        finally:
+            rep.close()
+
+
+def test_dssm_ranker_over_served_embeddings():
+    with _cluster() as cluster:
+        cli = cluster.client()
+        rng = np.random.default_rng(2)
+        keys = np.arange(64, dtype=np.uint64)
+        _preload(cli, keys, rng)
+        rep, lookup = _serving_stack(cluster)
+        try:
+            model = DSSM(num_query_slots=3, num_doc_slots=1,
+                         embedx_dim=4, hidden=(8,), out_dim=8)
+            ranker = make_dssm_ranker(model)
+            B, K = 2, 4
+            hist = _emb_block(lookup, keys[:B * 3].reshape(B, 3))
+            cand = _emb_block(lookup, keys[40:40 + B * K].reshape(B, K))
+            scores = ranker(hist, np.full(B, 3, np.int32), cand)
+            assert scores.shape == (B, K)
+            assert np.isfinite(scores).all()
+            assert (np.abs(scores) <= 1.0 + 1e-5).all()
+        finally:
+            rep.close()
+    # contract guard: a multi-slot doc tower cannot be a pipeline ranker
+    with pytest.raises(ValueError):
+        make_dssm_ranker(DSSM(num_query_slots=2, num_doc_slots=2,
+                              embedx_dim=4))
+
+
+def test_pipeline_with_real_ranker_and_served_lookup():
+    """Stub retrieval fleet, REAL ranking stage: coalesced CachedLookup
+    gather + stacked GRU4Rec infer, scattered back per request."""
+    with _cluster() as cluster:
+        cli = cluster.client()
+        rng = np.random.default_rng(4)
+        keys = np.arange(256, dtype=np.uint64)
+        _preload(cli, keys, rng)
+        rep, lookup = _serving_stack(cluster)
+        try:
+            model = GRU4Rec(embedx_dim=4, hidden=8, out_dim=8)
+            pipe = PipelineFrontend(
+                _PipeRouter(), lookup, ranker=make_gru4rec_ranker(model),
+                config=PipelineConfig(fanout=2, fan_width=4, topk=4,
+                                      early_cut_frac=1.0,
+                                      rank_max_delay_us=20_000),
+                idle_pop_s=0.002)
+            with pipe:
+                pending = [pipe.submit(_UV, keys[i * 2:i * 2 + 2],
+                                       keys[64 + 8 * i:72 + 8 * i])
+                           for i in range(6)]
+                for pr in pending:
+                    ks, sc = pr.result(30)
+                    assert ks.shape == sc.shape == (4,)
+                    assert np.isfinite(sc).all()
+                    assert (np.diff(sc) <= 1e-6).all()   # best first
+                st = pipe.stats()
+                assert st["served"] == 6 and st["errors"] == 0
+                assert st["coalesce_factor"] > 1.0
+        finally:
+            rep.close()
+
+
+# ---------------------------------------------------------------------------
+# multi-host member (subprocess) + chaos
+# ---------------------------------------------------------------------------
+
+def test_spawn_member_subprocess_end_to_end(tmp_path):
+    store_dir = str(tmp_path / "store")
+    with _cluster(store=elastic.FileStore(store_dir)) as cluster:
+        cli = cluster.client()
+        rng = np.random.default_rng(3)
+        keys = np.arange(256, dtype=np.uint64)
+        _preload(cli, keys, rng)
+        member = spawn_member(f"file:{store_dir}", cluster.job_id,
+                              embedx_dim=4, dense_len=8, hb_ttl=1.0)
+        rep = None
+        try:
+            assert member.healthy
+            out = member.frontend.submit(keys[:8],
+                                         deadline_ms=5000).result(15)
+            assert out.shape == (8, 5)
+            # the child process serves the SAME rows the parent-side
+            # replica reads — the wire is value-faithful
+            rep, lookup = _serving_stack(cluster)
+            np.testing.assert_allclose(
+                out, np.asarray(lookup.lookup(keys[:8]), np.float32),
+                rtol=1e-6)
+            status = member.replica.status()
+            assert status["multi_host"] is True
+            # dense model push over the wire: version + digest echo
+            v0, d0 = member.model.identity()
+            member.model.set(v0 + 1, np.ones(8, np.float32))
+            v1, d1 = member.model.identity()
+            assert v1 == v0 + 1 and d1 != d0
+            # digest pinning rejects a mismatched payload (rollback arm)
+            with pytest.raises(RuntimeError):
+                member.model.set(v1 + 1, np.zeros(8, np.float32),
+                                 expect_digest=d1)
+            # warm op + proxied child-frontend stats
+            member.warm(keys[:32])
+            stats = member.frontend.stats()
+            assert stats.get("served", 0) >= 1
+        finally:
+            if rep is not None:
+                rep.close()
+            member.frontend.stop()
+            member.replica.stop()
+    assert member.replica.server.stopped
+
+
+@pytest.mark.slow
+def test_pipeline_chaos_kill_member_zero_visible_errors(tmp_path):
+    """ISSUE 18 chaos gate: kill one of two subprocess members while a
+    request stream is in flight — reroute + early cut must keep EVERY
+    request user-visible-error free."""
+    store_dir = str(tmp_path / "store")
+    with _cluster(store=elastic.FileStore(store_dir)) as cluster:
+        cli = cluster.client()
+        rng = np.random.default_rng(5)
+        keys = np.arange(1024, dtype=np.uint64)
+        _preload(cli, keys, rng)
+        members = [spawn_member(f"file:{store_dir}", cluster.job_id,
+                                embedx_dim=4, dense_len=8, hb_ttl=1.0)
+                   for _ in range(2)]
+        router = ServingRouter(RouterConfig(), rng=random.Random(0))
+        pipe = PipelineFrontend(
+            router, _PipeLookup(width=5),
+            config=PipelineConfig(fanout=2, fan_width=8, topk=4,
+                                  early_cut_frac=0.5,
+                                  default_deadline_ms=4000.0,
+                                  rank_max_delay_us=1000))
+        try:
+            for m in members:
+                router.attach(m)
+            uv = np.zeros(4, np.float32)
+            uv[0] = 1.0
+            hist = keys[:4]
+            pending = []
+            for i in range(40):
+                lo = (i * 16) % 992
+                pending.append(pipe.submit(uv, hist, keys[lo:lo + 16]))
+                if i == 15:          # mid-stream, requests in flight
+                    members[0].replica.kill()
+                time.sleep(0.01)
+            for pr in pending:
+                ks, sc = pr.result(30)
+                assert ks.shape == (4,) and np.isfinite(sc).all()
+            st = pipe.stats()
+            assert st["served"] == 40
+            assert st["errors"] == 0
+        finally:
+            pipe.stop()
+            router.stop()
+            for m in members:
+                m.crash()

@@ -352,6 +352,60 @@ def test_drain_marker_blocks_watcher_readmission():
         router.stop()
 
 
+def test_lease_miss_grace_before_eviction():
+    """Crash-removal SIGKILLs, so one stale lease read must not execute
+    a healthy member: eviction needs ``FleetConfig.evict_misses``
+    CONSECUTIVE misses, a hit resets the count, and a member whose
+    process is verifiably dead skips the grace entirely."""
+    store = elastic.MemoryStore()
+    sm = _StubMember("ev1")
+    member = FleetMember(_FakeReplicaHandle("ev1"), sm.lookup, sm.frontend)
+    router = _router()
+    fleet = ServingFleet(store, "ev-job", lambda: member, router)
+    try:
+        with fleet._mu:
+            fleet._members["ev1"] = member
+            fleet._join_order.append("ev1")
+        router.attach(member)
+        lease = "ps/ev-job/obs/0/ev1"
+        store.put(lease, "{}", ttl=30.0)
+        # miss 1 (transient): retained, still routed, nothing killed
+        store.delete(lease)
+        fleet.tick()
+        assert fleet.member("ev1") is member
+        assert member.healthy and "ev1" in router.endpoints()
+        assert fleet.counters["crashes_removed"] == 0
+        # a hit RESETS the consecutive count…
+        store.put(lease, "{}", ttl=30.0)
+        fleet.tick()
+        # …so the next single miss is again only miss 1
+        store.delete(lease)
+        fleet.tick()
+        assert fleet.member("ev1") is member and member.healthy
+        # miss 2 consecutive: evicted for real (removed + crashed)
+        fleet.tick()
+        assert fleet.member("ev1") is None
+        assert not member.healthy
+        assert fleet.counters["crashes_removed"] == 1
+        assert "ev1" not in router.endpoints(live_only=False)
+        # a DEAD member gets no grace: first miss removes it
+        sm2 = _StubMember("ev2")
+        member2 = FleetMember(_FakeReplicaHandle("ev2"), sm2.lookup,
+                              sm2.frontend)
+        with fleet._mu:
+            fleet._members["ev2"] = member2
+            fleet._join_order.append("ev2")
+        member2.replica.kill()           # proc verifiably gone
+        fleet.tick()
+        assert fleet.member("ev2") is None
+        assert fleet.counters["crashes_removed"] == 2
+        sm2.stop()
+    finally:
+        sm.stop()
+        fleet.stop()
+        router.stop()
+
+
 # ---------------------------------------------------------------------------
 # satellite 1: retry-after from measured drain rate
 # ---------------------------------------------------------------------------
