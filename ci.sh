@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI gate (reference L0's cmake+ctest role): graftlint, native build,
 # fast test gate, then the full matrix.
-# Usage: ./ci.sh [lint [--changed]|sched|fast|full|chaos|ckpt|hot_tier|serving|serving_fleet|recsys|obs|slo|reshard|endurance]
+# Usage: ./ci.sh [lint [--changed]|sched|fast|full|chaos|ckpt|hot_tier|serving|serving_fleet|recsys|obs|slo|reshard|endurance|tenancy]
 #   sched — graftsched gate: deterministic-schedule exploration of the
 #   control-plane protocol harnesses (tools/sched/models.py) — the
 #   preemption-bound-2 schedule space EXHAUSTED plus seeded random
@@ -54,6 +54,16 @@
 #   alert clears and it shrinks back — RESHARD.json records the
 #   cutover pause p50/p95 (asserted well under the full-copy bootstrap
 #   time) and the scale-event journal.
+#   tenancy — multi-tenant isolation gate: the full tenancy suite
+#   (wire-enforced namespaces, weighted admission, per-tenant quotas,
+#   tenant-scoped control plane — incl. the slow abusive-neighbor
+#   interference e2e), then the tenancy bench: a four-tenant workload
+#   zoo (CTR streaming / routed-MoE / GNN sampling / TDM retrieval)
+#   shares one cluster with a deliberately abusive tenant, and the
+#   gate asserts the abuser's MARGINAL p99 damage stays bounded while
+#   its meter shows throttles + quota refusals, every cross-tenant
+#   probe bounces, and the neighbors' namespaces stay digest-identical
+#   (TENANCY.json is the archived quiet-host artifact).
 set -euo pipefail
 cd "$(dirname "$0")"
 
@@ -514,6 +524,47 @@ print('obs trace demo OK: %d flow links across %d events, %d processes'
   exit 0
 fi
 
+if [[ "${1:-fast}" == "tenancy" ]]; then
+  echo "== tenancy gate: multi-tenant isolation suite (incl. slow interference e2e) =="
+  # -m "" deliberately includes the slow abusive-neighbor e2e (four
+  # well-behaved tenants + a flood that must throttle/quota-refuse
+  # without moving a neighbor's p99 or writing one foreign row)
+  python -m pytest tests/test_tenancy.py -q -m ""
+  echo "== tenancy bench (workload zoo + abusive neighbor, marginal-p99 isolation) =="
+  # the namespace/quota/digest asserts are exact on every attempt; the
+  # p99 gate is the abuser's MARGINAL damage (abused vs shared — the
+  # zoo running without the abuser), because solo→shared movement on a
+  # shared 1-core box is CPU scheduling, not an isolation failure. The
+  # 5x + 20 ms bound carries ambient-load headroom (the committed
+  # TENANCY.json shows the quiet-host worst ratio: ~1.3x); one retry
+  # absorbs the residual outliers.
+  check_tenancy() {
+    PYTHONPATH="$PWD:${PYTHONPATH:-}" JAX_PLATFORMS=cpu \
+      python tools/tenancy_bench.py | python -c "
+import json, sys
+d = json.loads([l for l in sys.stdin.read().splitlines()
+                if l.startswith('{')][-1])
+assert 'error' not in d, d
+for n, t in d['tenants'].items():
+    assert t['abused']['p99_ms'] <= 5.0 * t['shared']['p99_ms'] + 20.0, (n, t)
+assert d['abuse']['flood']['throttled'] > 0, d['abuse']
+assert d['abuse']['rows_within_cap'], d['abuse']
+assert d['isolation']['cross_tenant_breaches'] == 0, d['isolation']
+assert d['isolation']['cross_tenant_probes_bounced'] > 0, d['isolation']
+assert d['isolation']['digest_stable_under_abuse'], d['isolation']
+assert d['isolation']['wb_rows_unchanged'], d['isolation']
+worst = max(d['tenants'].items(), key=lambda kv: kv[1]['p99_ratio'])
+print('tenancy OK: worst marginal p99 %.2fx (%s), abuser throttled %d / '
+      'quota-refused %d, %d cross-tenant probes bounced, 0 breaches'
+      % (worst[1]['p99_ratio'], worst[0],
+         d['abuse']['flood']['throttled'], d['abuse']['flood']['quota'],
+         d['isolation']['cross_tenant_probes_bounced']))"
+  }
+  check_tenancy || { echo "tenancy retry (ambient-load outlier)"; check_tenancy; }
+  echo "CI OK (tenancy)"
+  exit 0
+fi
+
 echo "== hot-tier fast checks (parity / eviction churn / 0-RPC warm) =="
 # the hot tier's bit-parity contract is the cheapest place to catch a
 # sparse-rule or flush-back regression — fail it before the full matrix
@@ -691,7 +742,7 @@ print('sync shim pass-through OK (sanitizer sees raw primitives)')"
       tests/test_recsys_pipeline.py \
       tests/test_obs.py tests/test_slo.py tests/test_flightrec.py \
       tests/test_reshard.py tests/test_autoscale.py \
-      tests/test_sparse_wire.py -q -m ""
+      tests/test_sparse_wire.py tests/test_tenancy.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_tsan_report* 2>/dev/null; then
     echo "TSAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_tsan_report*)"
     exit 1
@@ -726,7 +777,7 @@ print('sync shim pass-through OK (sanitizer sees raw primitives)')"
       tests/test_recsys_pipeline.py \
       tests/test_obs.py tests/test_slo.py tests/test_flightrec.py \
       tests/test_reshard.py tests/test_autoscale.py \
-      tests/test_sparse_wire.py -q -m ""
+      tests/test_sparse_wire.py tests/test_tenancy.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_asan_report* 2>/dev/null; then
     echo "ASAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_asan_report*)"
     exit 1
@@ -760,7 +811,7 @@ print('sync shim pass-through OK (sanitizer sees raw primitives)')"
       tests/test_recsys_pipeline.py \
       tests/test_obs.py tests/test_slo.py tests/test_flightrec.py \
       tests/test_reshard.py tests/test_autoscale.py \
-      tests/test_sparse_wire.py -q -m ""
+      tests/test_sparse_wire.py tests/test_tenancy.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_ubsan_report* 2>/dev/null; then
     echo "UBSAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_ubsan_report*)"
     exit 1

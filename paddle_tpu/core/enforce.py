@@ -81,6 +81,38 @@ class WrongShardError(PreconditionNotMetError):
     the server answered, so the breaker and failover paths stay cold."""
 
 
+class WrongTenantError(PreconditionNotMetError):
+    """A PS request crossed a tenant-namespace fence (csrc
+    kErrWrongTenant): the frame addressed a table outside the
+    connection's bound tenant (table_id high byte, ps/tenancy.py), named
+    an unknown tenant or bad hello token, or was a control-plane command
+    from a non-operator connection. Rejected WHOLE before any state
+    change or oplog tap. NOT a transport error and NOT retryable:
+    retrying the same frame on the same connection fails identically —
+    this is a credential/addressing bug, not a routing race."""
+
+
+class QuotaExceededError(PreconditionNotMetError):
+    """The tenant's enforced row/SSD-byte quota is exhausted (csrc
+    kErrQuota): the server refused a ROW-CREATING command whole —
+    including pushes, whose lookup_or_insert creates rows. Another
+    tenant's rows are never evicted to make room; the tenant must
+    shrink its tables or an operator must raise the quota
+    (docs/OPERATIONS.md §20). Not retryable without freeing space."""
+
+
+class ThrottledError(PreconditionNotMetError):
+    """The tenant's token-bucket request budget is dry (csrc
+    kErrThrottled): the frame was shed BEFORE any state change, with a
+    server-suggested backoff in `retry_after_ms`. Retryable — wait at
+    least that long; serve-class (pclass 0) tenants queue briefly
+    server-side before this surfaces, batch classes shed immediately."""
+
+    def __init__(self, msg: str = "", retry_after_ms: int = 0):
+        super().__init__(msg)
+        self.retry_after_ms = int(retry_after_ms)
+
+
 class UnimplementedError(EnforceNotMet, NotImplementedError):
     pass
 

@@ -45,9 +45,14 @@
 // LEAF lock — obs_account() and the kObsSnap handler acquire exactly
 // it, never while holding any other lock, and never enter table code
 // under it.
+// The tenancy additions (ISSUE 19) likewise: tenants_mu (the tenant
+// registry — token buckets, quotas, shed counters) is a LEAF lock.
+// tenant_admit() copies the tenant's config out under it, releases it,
+// and only then walks tables_mu for the quota usage probe; the bucket
+// charge re-acquires it alone.
 // LOCK ORDER: tables_mu < save_mu < shard_mu
 // LOCK ORDER: tables_mu < dense_mu
-// LOCK LEAF: conn_mu bar_mu mu oplog_mu gate_mu fault_mu obs_mu
+// LOCK LEAF: conn_mu bar_mu mu oplog_mu gate_mu fault_mu obs_mu tenants_mu
 
 #include <arpa/inet.h>
 #include <fcntl.h>
@@ -208,6 +213,21 @@ enum Cmd : uint32_t {
                   // two record structs (SERVER_WIRE_STRUCT /
                   // SERVER_SPAN_STRUCT); drift = parse failure in
                   // tests, not silent misreads (sizes are asserted).
+  // -- multi-tenancy (ps/tenancy.py drives these; docs/OPERATIONS.md
+  // §20). The tenant tag is the table_id's HIGH BYTE (kTenantShift):
+  // a connection bound to tenant T != 0 can only address tables tagged
+  // T, so one tenant can never read or write another tenant's rows.
+  kTenantHello = 45,   // bind THIS connection to tenant n (1..255);
+                       // payload = auth token bytes. Tenant 0 (the
+                       // operator/default plane — legacy clients,
+                       // replication shippers, control tools) needs no
+                       // hello and sees the whole server.
+  kTenantConfig = 46,  // operator plane only. n = 1: install/update a
+                       // tenant from the packed payload (id, priority
+                       // class, token-bucket rate/burst, row/SSD-byte
+                       // quotas, token). n = 0: read the tenant's usage
+                       // meter → [rows, ssd_bytes, throttled,
+                       // quota_refused i64×4][tokens f64][pclass i64].
 };
 
 enum Err : int64_t {
@@ -224,6 +244,18 @@ enum Err : int64_t {
                         // must re-resolve the routing table and replay
                         // (rejected whole, before any state change, so
                         // the replay applies each key exactly once)
+  kErrWrongTenant = -9,  // the cmd addressed a table outside the
+                         // connection's tenant namespace (table_id high
+                         // byte), named an unknown tenant or bad hello
+                         // token, or is a control-plane cmd from a
+                         // non-operator connection. Rejected whole,
+                         // before any state change or oplog tap.
+  kErrQuota = -10,       // the tenant's row/SSD-byte quota is exhausted:
+                         // row-creating commands refuse whole — another
+                         // tenant's rows are NEVER evicted to make room
+  kErrThrottled = -11,   // the tenant's token-bucket request budget is
+                         // dry: shed with a hint — response payload is
+                         // one i64, the suggested retry_after_ms
 };
 
 // commands whose application changes table state: these are the ops a
@@ -322,7 +354,74 @@ inline bool is_training_plane_cmd(uint32_t cmd, int32_t aux, int64_t n) {
   }
 }
 
+// commands a tenant-bound (non-operator) connection may issue: the
+// table-addressed data/util plane plus kPing. Everything else —
+// replication, epoch fencing, server-local save/load paths, stop,
+// obs drains, ownership installs, barriers — is the operator plane
+// (tenant 0) and bounces with kErrWrongTenant.
+inline bool is_tenant_cmd(uint32_t cmd) {
+  switch (cmd) {
+    case kPing:
+    case kCreateSparse:
+    case kCreateDense:
+    case kCreateGeo:
+    case kPullSparse:
+    case kPushSparse:
+    case kPullDense:
+    case kPushDense:
+    case kSetDense:
+    case kSize:
+    case kShrink:
+    case kInsertFull:
+    case kExport:
+    case kSpill:
+    case kStats:
+    case kCompact:
+    case kLoadCold:
+    case kSaveAll:
+    case kDigest:
+    case kCreateGraph:
+    case kGraphAddNodes:
+    case kGraphAddEdges:
+    case kGraphSampleNeighbors:
+    case kGraphDegree:
+    case kGraphNodeFeat:
+    case kGraphSetNodeFeat:
+    case kGraphSampleNodes:
+    case kGraphStats:
+    case kPushGeo:
+    case kPullGeo:
+      return true;
+    default:
+      return false;
+  }
+}
+
+// commands that may CREATE rows (quota enforcement point): creates,
+// bulk inserts, pushes (lookup_or_insert on miss), and pull/export
+// with the insert-on-miss bit. Kept in lockstep with the case bodies.
+inline bool is_row_creating_cmd(uint32_t cmd, int32_t aux) {
+  switch (cmd) {
+    case kCreateSparse:
+    case kCreateDense:
+    case kCreateGeo:
+    case kPushSparse:
+    case kInsertFull:
+    case kLoadCold:
+      return true;
+    case kPullSparse:
+    case kExport:
+      return (aux & 1) != 0;
+    default:
+      return false;
+  }
+}
+
 constexpr uint64_t kMaxPayload = 1ULL << 32;  // 4 GiB frame cap
+
+// tenant namespace tag: table_id's high byte (ps/tenancy.py mirrors
+// this as TENANT_SHIFT — pinned by tests/test_tenancy.py)
+constexpr uint32_t kTenantShift = 24;
 
 // fp16 wire conversions live in sparse_table.h (pstpu::f32_to_f16 /
 // f16_to_f32 — shared with the SSD fp16 record format). Used by the
@@ -567,6 +666,9 @@ inline int64_t wall_us() {
 // obs_account() after the handler returns.
 thread_local uint64_t t_resp_bytes = 0;
 thread_local int64_t t_gate_wait_us = 0;
+// tenant_admit()'s retry hint for a kErrThrottled response (ms) — set
+// on the shed path, consumed by the respond site in handle()
+thread_local int64_t t_retry_after_ms = 0;
 
 bool read_full(int fd, void* buf, size_t len) {
   char* p = static_cast<char*>(buf);
@@ -768,6 +870,30 @@ struct PsServer {
   };
   std::map<std::string, Fault> faults;
   std::mutex fault_mu;  // leaf
+
+  // -- multi-tenancy (kTenantHello/kTenantConfig; ps/tenancy.py) --------
+  // Registered tenants, keyed by tenant id (1..255). A connection binds
+  // via kTenantHello and is then confined to its namespace, its token
+  // bucket, and its quotas — all enforced in handle() BEFORE the
+  // read-only check, the pause gate, the ownership fence and the oplog
+  // tap, so a refused frame changed state nowhere and was never
+  // replicated. The replication plane bypasses tenancy entirely
+  // (kReplicate arrives on operator-plane connections; apply_op runs no
+  // tenant checks), so namespaced frames replay on backups unchanged.
+  struct TenantState {
+    int32_t pclass = 1;         // 0 = serve (queues briefly), >=1 = batch
+    double rate = 0.0;          // bucket refill, cost units/s (0 = unmetered)
+    double burst = 0.0;         // bucket depth
+    double tokens = 0.0;
+    int64_t last_refill_us = 0;
+    int64_t max_rows = 0;       // row quota across the namespace (0 = none)
+    int64_t max_ssd_bytes = 0;  // SSD file-byte quota (0 = none)
+    int64_t throttled = 0;      // requests shed with kErrThrottled
+    int64_t quota_refused = 0;  // requests refused with kErrQuota
+    std::string token;          // hello credential
+  };
+  std::map<uint32_t, TenantState> tenants;
+  std::mutex tenants_mu;  // leaf: small-struct copies/updates only
 
   // -- observability (kObsSnap; paddle_tpu/obs consumes) ----------------
   // per-table wire accounting: "in" = client→server payload bytes/rows
@@ -1070,6 +1196,185 @@ struct PsServer {
     return true;
   }
 
+  // -- tenancy: admission, metering, quota -----------------------------
+
+  // Billing meter: rows + SSD file bytes across every sparse table in
+  // the tenant's namespace. Walks tables_mu only to collect SparseRefs
+  // (cheap map scan); the per-table probes are lock-free (sparse_rows
+  // reads atomics, sst_stats reads the tier's own counters).
+  void tenant_usage(uint32_t tenant, int64_t* rows, int64_t* ssd_bytes) {
+    std::vector<SparseRef> refs;
+    {
+      std::lock_guard<std::mutex> g(tables_mu);  // LOCK: tables_mu
+      for (auto& kv : sparse)
+        if ((kv.first >> kTenantShift) == tenant) refs.push_back(kv.second);
+    }
+    *rows = 0;
+    *ssd_bytes = 0;
+    for (auto& t : refs) {
+      *rows += sparse_rows(t);
+      if (t.ssd) {
+        int64_t s3[3] = {0, 0, 0};
+        sst_stats(t.ssd, s3);
+        *ssd_bytes += s3[2];
+      }
+    }
+  }
+
+  // Refill-and-charge against the tenant's token bucket. Returns true
+  // if the bucket covered the cost. rate == 0 means unmetered.
+  bool try_charge(uint32_t tenant, double cost) {
+    std::lock_guard<std::mutex> g(tenants_mu);  // LOCK: tenants_mu
+    auto it = tenants.find(tenant);
+    if (it == tenants.end()) return true;
+    TenantState& t = it->second;
+    if (t.rate <= 0) return true;
+    int64_t now = mono_us();
+    t.tokens = std::min(
+        t.burst, t.tokens + (now - t.last_refill_us) * 1e-6 * t.rate);
+    t.last_refill_us = now;
+    if (t.tokens >= cost) {
+      t.tokens -= cost;
+      return true;
+    }
+    return false;
+  }
+
+  // Weighted admission for a tenant-bound connection. Returns 0 to
+  // admit, else the error status to bounce the frame with. Ordering:
+  // namespace fence first (a frame addressing another tenant's table is
+  // wrong regardless of budget), then the token bucket, then quota on
+  // row-creating commands. NEVER holds tenants_mu across tables_mu:
+  // config is copied out, usage probed, counters bumped on re-acquire.
+  int64_t tenant_admit(uint32_t tenant, const ReqHeader& h) {
+    if (!is_tenant_cmd(h.cmd)) return kErrWrongTenant;
+    if (h.cmd != kPing && (h.table_id >> kTenantShift) != tenant)
+      return kErrWrongTenant;
+    int32_t pclass;
+    double rate;
+    int64_t max_rows, max_ssd;
+    {
+      std::lock_guard<std::mutex> g(tenants_mu);  // LOCK: tenants_mu
+      auto it = tenants.find(tenant);
+      if (it == tenants.end()) return kErrWrongTenant;
+      pclass = it->second.pclass;
+      rate = it->second.rate;
+      max_rows = it->second.max_rows;
+      max_ssd = it->second.max_ssd_bytes;
+    }
+    if (rate > 0) {
+      // cost = 1 per frame + 1 per key/row it names, so a hot-key flood
+      // of fat pulls drains the bucket proportionally to server work
+      double cost = 1.0 + static_cast<double>(std::max<int64_t>(0, h.n));
+      bool ok = try_charge(tenant, cost);
+      if (!ok && pclass == 0) {
+        // serve class QUEUES briefly instead of shedding: one bounded
+        // wait sized to the refill the charge needs, then re-try
+        int64_t wait_ms = std::min<int64_t>(
+            50, static_cast<int64_t>(cost / rate * 1e3) + 1);
+        std::this_thread::sleep_for(std::chrono::milliseconds(wait_ms));
+        ok = try_charge(tenant, cost);
+      }
+      if (!ok) {
+        std::lock_guard<std::mutex> g(tenants_mu);  // LOCK: tenants_mu
+        auto it = tenants.find(tenant);
+        if (it != tenants.end()) {
+          ++it->second.throttled;
+          t_retry_after_ms = std::max<int64_t>(
+              1, static_cast<int64_t>((cost - it->second.tokens) /
+                                      std::max(rate, 1e-9) * 1e3));
+        } else {
+          t_retry_after_ms = 1;
+        }
+        return kErrThrottled;
+      }
+    }
+    if ((max_rows > 0 || max_ssd > 0) && is_row_creating_cmd(h.cmd, h.aux)) {
+      // Quota is enforced at batch granularity: the LAST admitted batch
+      // may overshoot the cap, but the next row-creating frame refuses.
+      // kPushSparse counts as row-creating (lookup_or_insert), so a
+      // tenant at quota sees pushes refuse too — by design: shrink or
+      // raise the quota, we never evict another tenant's rows to make
+      // room (see docs/OPERATIONS.md §20).
+      int64_t rows = 0, ssd_bytes = 0;
+      tenant_usage(tenant, &rows, &ssd_bytes);
+      if ((max_rows > 0 && rows >= max_rows) ||
+          (max_ssd > 0 && ssd_bytes >= max_ssd)) {
+        std::lock_guard<std::mutex> g(tenants_mu);  // LOCK: tenants_mu
+        auto it = tenants.find(tenant);
+        if (it != tenants.end()) ++it->second.quota_refused;
+        return kErrQuota;
+      }
+    }
+    return 0;
+  }
+
+  // kTenantConfig body (operator plane only — handle() enforces that).
+  // n == 1: install/update from packed payload
+  //   [u32 tenant_id][i32 pclass][f64 rate][f64 burst][i64 max_rows]
+  //   [i64 max_ssd_bytes][u32 token_len][u32 pad][token bytes]
+  // n == 0: read h.table_id's usage meter →
+  //   [rows, ssd_bytes, throttled, quota_refused i64×4][tokens f64]
+  //   [pclass i64]
+  bool do_tenant_config(int fd, const ReqHeader& h, const char* p) {
+    if (h.n == 1) {
+      constexpr uint64_t kFixed = 4 + 4 + 8 + 8 + 8 + 8 + 4 + 4;
+      if (h.payload_len < kFixed) return respond(fd, kErrBadSize, nullptr, 0);
+      uint32_t tid, token_len;
+      int32_t pclass;
+      double rate, burst;
+      int64_t max_rows, max_ssd;
+      std::memcpy(&tid, p, 4);
+      std::memcpy(&pclass, p + 4, 4);
+      std::memcpy(&rate, p + 8, 8);
+      std::memcpy(&burst, p + 16, 8);
+      std::memcpy(&max_rows, p + 24, 8);
+      std::memcpy(&max_ssd, p + 32, 8);
+      std::memcpy(&token_len, p + 40, 4);
+      if (h.payload_len != kFixed + token_len)
+        return respond(fd, kErrBadSize, nullptr, 0);
+      if (tid == 0 || tid > 255)  // 0 = operator plane, not registrable
+        return respond(fd, kErrBadSize, nullptr, 0);
+      std::lock_guard<std::mutex> g(tenants_mu);  // LOCK: tenants_mu
+      TenantState& t = tenants[tid];
+      t.pclass = pclass;
+      t.rate = rate;
+      t.burst = burst;
+      // a (re)config starts the bucket full so admission ramps cleanly
+      t.tokens = burst;
+      t.last_refill_us = mono_us();
+      t.max_rows = max_rows;
+      t.max_ssd_bytes = max_ssd;
+      t.token.assign(p + kFixed, token_len);
+      return respond(fd, 0, nullptr, 0);
+    }
+    if (h.n == 0) {
+      uint32_t tid = h.table_id;
+      int64_t rows = 0, ssd_bytes = 0;
+      tenant_usage(tid, &rows, &ssd_bytes);
+      int64_t throttled = 0, refused = 0, pclass = 1;
+      double tokens = 0;
+      {
+        std::lock_guard<std::mutex> g(tenants_mu);  // LOCK: tenants_mu
+        auto it = tenants.find(tid);
+        if (it == tenants.end()) return respond(fd, kErrNoTable, nullptr, 0);
+        throttled = it->second.throttled;
+        refused = it->second.quota_refused;
+        tokens = it->second.tokens;
+        pclass = it->second.pclass;
+      }
+      char out[48];
+      std::memcpy(out, &rows, 8);
+      std::memcpy(out + 8, &ssd_bytes, 8);
+      std::memcpy(out + 16, &throttled, 8);
+      std::memcpy(out + 24, &refused, 8);
+      std::memcpy(out + 32, &tokens, 8);
+      std::memcpy(out + 40, &pclass, 8);
+      return respond(fd, 0, out, sizeof(out));
+    }
+    return respond(fd, kErrBadCmd, nullptr, 0);
+  }
+
   // -- create bodies, shared by the interactive path (handle) and the
   // replication catalog-replay path (apply_op) -------------------------
 
@@ -1346,6 +1651,11 @@ struct PsServer {
 
   void serve_conn(int fd) {
     std::vector<char> buf;
+    // tenant binding is per-CONNECTION: 0 (operator/default plane) until
+    // a kTenantHello lands, then pinned to that tenant for the socket's
+    // lifetime — a rebind attempt is refused, so a leaked descriptor
+    // can't hop namespaces
+    uint32_t conn_tenant = 0;
     while (true) {
       ReqHeader h;
       if (!read_full(fd, &h, sizeof(h))) break;
@@ -1358,7 +1668,7 @@ struct PsServer {
       t_gate_wait_us = 0;
       int64_t ob_ts = wall_us();
       int64_t ob_t0 = mono_us();
-      bool ok = handle(fd, h, buf.data());
+      bool ok = handle(fd, h, buf.data(), &conn_tenant);
       obs_account(h, ob_ts, mono_us() - ob_t0);
       if (!ok) break;
       if (h.cmd == kStop) break;
@@ -1373,8 +1683,10 @@ struct PsServer {
   }
 
   // h by VALUE: read-only mode may downgrade a pull's insert-on-miss
-  // bit before dispatch (24 trivially-copyable bytes)
-  bool handle(int fd, ReqHeader h, const char* p) {
+  // bit before dispatch (24 trivially-copyable bytes). `tenant` is the
+  // connection's binding slot (serve_conn local): kTenantHello writes
+  // it, every later frame is admitted against it.
+  bool handle(int fd, ReqHeader h, const char* p, uint32_t* tenant) {
     // global count sanity bound BEFORE any `h.n * width` arithmetic: a
     // huge n would overflow the int64 size checks (n*8 ≡ 0 mod 2^64)
     // and bypass them into out-of-bounds reads. No legitimate command
@@ -1403,6 +1715,41 @@ struct PsServer {
         ::shutdown(fd, SHUT_RDWR);
         return false;
       }
+    }
+    // -- tenancy fence: runs BEFORE the read-only check, the pause
+    // gate, the ownership fence and the oplog tap, so a refused frame
+    // changed state nowhere and never entered the replication stream.
+    if (h.cmd == kTenantHello) {
+      // bind this connection to tenant h.n; payload = auth token
+      if (h.n < 1 || h.n > 255) return respond(fd, kErrBadSize, nullptr, 0);
+      if (*tenant != 0)  // rebind refused — binding is socket-lifetime
+        return respond(fd, kErrWrongTenant, nullptr, 0);
+      bool ok = false;
+      {
+        std::lock_guard<std::mutex> g(tenants_mu);  // LOCK: tenants_mu
+        auto it = tenants.find(static_cast<uint32_t>(h.n));
+        ok = it != tenants.end() &&
+             it->second.token ==
+                 std::string(p, static_cast<size_t>(h.payload_len));
+      }
+      if (!ok) return respond(fd, kErrWrongTenant, nullptr, 0);
+      *tenant = static_cast<uint32_t>(h.n);
+      return respond(fd, 0, nullptr, 0);
+    }
+    if (h.cmd == kTenantConfig) {
+      // operator plane only: a tenant-bound connection may not inspect
+      // or rewrite the tenant registry (not even its own entry — quota
+      // self-service would defeat the point)
+      if (*tenant != 0) return respond(fd, kErrWrongTenant, nullptr, 0);
+      return do_tenant_config(fd, h, p);
+    }
+    if (*tenant != 0) {
+      int64_t st = tenant_admit(*tenant, h);
+      if (st == kErrThrottled) {
+        int64_t retry = t_retry_after_ms;
+        return respond(fd, kErrThrottled, &retry, 8);
+      }
+      if (st < 0) return respond(fd, st, nullptr, 0);
     }
     // read-only attach mode (serving replicas): refuse the training
     // data plane outright, BEFORE the pause gate and the oplog tap — a

@@ -215,10 +215,12 @@ class PlacementManager:
         #: swap journal (tests/operators read it; mirrors flightrec)
         self.events: List[dict] = []
         from ..obs import registry as _obs_registry
+        # max_series: one series per embedding table a job places —
+        # sized, not defaulted (graftlint unbounded-label)
         self._c_swaps = _obs_registry.REGISTRY.counter(
-            "placement_swaps", table=str(table_id))
+            "placement_swaps", max_series=256, table=str(table_id))
         self._g_state = _obs_registry.REGISTRY.gauge(
-            "placement_state", table=str(table_id))
+            "placement_state", max_series=256, table=str(table_id))
         self._g_state.set(0.0)
         if controller is not None:
             controller.on_pre_cutover(self.fence)

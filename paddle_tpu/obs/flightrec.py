@@ -76,9 +76,17 @@ class FlightRecorder:
                  ring=None, watchdog=None, client=None,
                  dump_on: Optional[Set[str]] = None,
                  keep: int = 8, min_interval_s: float = 5.0,
-                 tail_events: int = 1024) -> None:
+                 tail_events: int = 1024,
+                 scope: Optional[Dict[str, str]] = None) -> None:
         self.out_dir = out_dir
         os.makedirs(out_dir, exist_ok=True)
+        #: label-subset filter for SCOPED bundles (multi-tenant
+        #: clusters: one recorder per tenant, scope={"tenant": name} —
+        #: ps/tenancy.py): alerts whose labels don't carry the scope
+        #: subset are filtered OUT of the bundle, and the manifest
+        #: records the scope, so a tenant's postmortem never leaks a
+        #: neighbor's alert stream. None = whole-cluster recorder.
+        self.scope = dict(scope) if scope else None
         self.ring = ring
         self.watchdog = watchdog
         self.client = client
@@ -211,6 +219,10 @@ class FlightRecorder:
         from ..io import fs as _fs
 
         alerts = self.watchdog.alerts() if self.watchdog is not None else []
+        if self.scope:
+            alerts = [a for a in alerts
+                      if all((a.get("labels") or {}).get(k) == v
+                             for k, v in self.scope.items())]
         records = self.ring.records() if self.ring is not None else []
         tmp = os.path.join(self.out_dir, f"{BUNDLE_PREFIX}{bundle_id}.tmp")
         final = os.path.join(self.out_dir, f"{BUNDLE_PREFIX}{bundle_id}")
@@ -229,6 +241,7 @@ class FlightRecorder:
                 json.dump(blob, f)
         manifest = {
             "reason": reason,
+            **({"scope": self.scope} if self.scope else {}),
             "info": {k: v for k, v in info.items()
                      if isinstance(v, (str, int, float, bool, list, dict))},
             "wall_s": now,

@@ -257,6 +257,14 @@ class Registry:
         self._families: Dict[str, _Family] = {}
         self._role = "proc"
         self._start = time.perf_counter()
+        # per-family drop counters (family name → Counter in the
+        # ``obs_dropped_series`` family, labeled {"family": name}):
+        # makes a cardinality blowout ATTRIBUTABLE — "which family (and
+        # so whose label, e.g. which tenant's request-derived value)
+        # overflowed" instead of one opaque per-family integer buried in
+        # the snapshot. Lazily bound on the first drop (the overflow
+        # path is cold by definition).
+        self._drop_handles: Dict[str, Counter] = {}
 
     # -- handle creation (the cold, registry-locked path) -----------------
 
@@ -284,12 +292,29 @@ class Registry:
                     # cardinality bound: collapse into the one shared
                     # overflow series instead of growing without limit
                     fam.dropped += 1
+                    self._count_drop(name)
                     if fam.overflow is None:
                         fam.overflow = fam.make()
                     return fam.overflow
                 h = fam.make()
                 fam.series[key] = h
             return h
+
+    def _count_drop(self, family: str) -> None:
+        """Attribute one dropped label-set to its family in the
+        ``obs_dropped_series`` family. Called under ``_mu`` (RLock — the
+        nested ``_handle`` re-entry is safe); the meta-family is exempt
+        from its own accounting so a pathological process with more
+        overflowing families than ``obs_dropped_series``'s own series
+        cap cannot recurse."""
+        if family == "obs_dropped_series":
+            return
+        h = self._drop_handles.get(family)
+        if h is None:
+            h = self._handle("counter", "obs_dropped_series", None,
+                             256, {"family": family})
+            self._drop_handles[family] = h
+        h.inc()
 
     def counter(self, name: str, max_series: Optional[int] = None,
                 **labels: Any) -> Counter:
@@ -364,6 +389,7 @@ class Registry:
         re-create them after a reset."""
         with self._mu:
             self._families.clear()
+            self._drop_handles.clear()
 
 
 #: the process default registry — what ``snapshot()`` exports and the

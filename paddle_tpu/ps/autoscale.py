@@ -100,9 +100,17 @@ class Autoscaler:
                  config: Optional[AutoscaleConfig] = None,
                  ring=None,
                  clock: Callable[[], float] = time.monotonic,
-                 poll_s: float = 0.25) -> None:
+                 poll_s: float = 0.25,
+                 tenant: Optional[str] = None) -> None:
         self.controller = controller
         self.config = config or AutoscaleConfig()
+        #: tenant whose SLO lever this instance answers to (multi-tenant
+        #: clusters run one Autoscaler per tenant, each subscribed to
+        #: that tenant's labeled rules — ps/tenancy.py tenant_slo_rules;
+        #: None = the single-tenant whole-cluster scaler, unchanged).
+        #: Journal entries carry the tag so incident triage can tell
+        #: whose wave moved the fleet.
+        self.tenant = tenant
         self.ring = ring
         self._clock = clock
         self.poll_s = float(poll_s)
@@ -154,7 +162,16 @@ class Autoscaler:
     # -- journal -----------------------------------------------------------
 
     def _journal(self, event: dict) -> None:
-        event = dict(event, t=_obs_trace.wall_s())
+        # `wall_s` is the cross-subsystem alignment key: flight-recorder
+        # bundle manifests stamp the same field, so incident triage can
+        # line a scale decision up against a tenant's bundle without
+        # consulting the elastic-store sequence (which only orders
+        # entries, it doesn't place them in time). `t` is the legacy
+        # alias kept for existing journal consumers.
+        wall = _obs_trace.wall_s()
+        event = dict(event, t=wall, wall_s=wall)
+        if self.tenant is not None:
+            event["tenant"] = self.tenant
         self.events.append(event)
         self._seq += 1
         cluster = self.controller.cluster

@@ -1142,7 +1142,7 @@ class FailoverCoordinator:
         # obs: promotions are a job-wide counter (the watchdog's
         # failover rule) AND a flight-recorder trigger
         self._c_promotions = _obs_registry.REGISTRY.counter(
-            "ha_promotions", job=str(job_id))
+            "ha_promotions", max_series=64, job=str(job_id))
 
     def _alive(self) -> set:
         pref = _hb_prefix(self.job_id)

@@ -49,7 +49,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -120,9 +120,27 @@ class HotTierConfig:
     #: it. None = one bank per mesh shard (sharded) or 1 (single-chip);
     #: must be a power of two and a multiple of the shard count.
     banks: Optional[int] = None
+    #: multi-tenant HBM-slot caps (ps/tenancy.py; docs/OPERATIONS.md
+    #: §20): tenant id → max resident rows the tenant may hold across
+    #: the whole tier. ENFORCED at admission — a tenant pushing past its
+    #: cap evicts ITS OWN least-valuable rows to make room, never a
+    #: neighbor's; capacity-pressure eviction below stays tenant-blind
+    #: (a shared cache is still a cache for whoever is under cap). Caps
+    #: may oversubscribe capacity. None = single-tenant tier, unchanged.
+    tenant_slots: Optional[Dict[int, int]] = None
+    #: vectorized keys → tenant ids (np.uint64 array in, int array
+    #: out). None = the tenancy key-namespacing default: the tenant id
+    #: rides the key's top byte (ps/tenancy.py namespace_keys).
+    tenant_of_key: Optional[Callable[[np.ndarray], np.ndarray]] = None
 
 
 _TIER_SEQ = iter(range(1, 1 << 30))  # per-process tier tag allocator
+
+
+def _tenant_of_key_default(keys: np.ndarray) -> np.ndarray:
+    """Tenant id from the key's top byte — the ps/tenancy.py
+    namespace_keys layout shared tiers use."""
+    return (np.asarray(keys, np.uint64) >> np.uint64(56)).astype(np.int64)
 
 
 def _pow2_pad(n: int, floor: int = 8) -> int:
@@ -215,9 +233,17 @@ class HotEmbeddingTier:
         self._xs = ec.embedx_rule.state_dim
         self._xd = ec.config.embedx_dim
 
+        # multi-tenant slot caps (tenancy): row → owning tenant, kept in
+        # the control plane so cap enforcement never touches the device
+        self._tenant_slots = (dict(self.config.tenant_slots)
+                              if self.config.tenant_slots else None)
+        self._tenant_of = (self.config.tenant_of_key
+                           or _tenant_of_key_default)
+
         # host control plane (membership/policy/dirtiness — row values
         # live in HBM, never here)
         self._keys = np.zeros(C, np.uint64)
+        self._row_tenant = np.zeros(C, np.int64)
         self._valid = np.zeros(C, bool)
         self._dirty = np.zeros(C, bool)
         self._freq = np.zeros(C, np.int64)
@@ -239,7 +265,7 @@ class HotEmbeddingTier:
         self.counters = CounterGroup(
             "hot_tier_events",
             ("hits", "misses", "evictions", "writebacks", "cold_fetches",
-             "flushes", "reshards"),
+             "flushes", "reshards", "tenant_cap_evictions"),
             max_series=1024, tier=str(next(_TIER_SEQ)))
 
     def _reset_resident_set(self) -> None:
@@ -255,6 +281,7 @@ class HotEmbeddingTier:
         self._freq[:] = 0
         self._tick[:] = 0
         self._keys[:] = 0
+        self._row_tenant[:] = 0
         # per-bank free row lists: bank b owns the contiguous block
         # [b·C/banks, (b+1)·C/banks) — the bucketized bank layout. Keys
         # hash uniformly over banks (DynamicDeviceKeyMap.bank_of), so
@@ -417,6 +444,12 @@ class HotEmbeddingTier:
                batch_keys: np.ndarray) -> None:
         if len(missing) == 0:
             return
+        # tenant slot caps come FIRST: an over-cap tenant frees its own
+        # rows before the bank-shortfall pass sees the free lists, so
+        # capacity pressure from a capped tenant can never force the
+        # tenant-blind eviction below onto a neighbor's working set
+        if self._tenant_slots:
+            self._enforce_tenant_caps(missing, batch_keys)
         # per-bank shortfall: each key admits into ITS bank's row block
         bk = self.device_map.bank_of(missing)
         counts = np.bincount(bk, minlength=self._banks)
@@ -424,6 +457,8 @@ class HotEmbeddingTier:
         if (needs > 0).any():
             self._evict(np.maximum(needs, 0), batch_keys)
         new_rows = np.asarray([self._free[b].pop() for b in bk], np.int64)
+        if self._tenant_slots:
+            self._row_tenant[new_rows] = self._tenant_of(missing)
         cols = self._full_to_cols(values)
         k = _pow2_pad(len(missing))
         pad_rows = np.full(k, self.config.capacity, np.int64)
@@ -466,13 +501,69 @@ class HotEmbeddingTier:
             victims_all.append(cand[order[:count]])
         victims = np.concatenate(victims_all) if victims_all else \
             np.zeros(0, np.int64)
+        self._evict_rows(victims)
+        self.counters["evictions"] += len(victims)
+
+    def _evict_rows(self, victims: np.ndarray) -> None:
+        """Shared eviction mechanics: dirty writeback, map removal,
+        control-plane invalidation, rows returned to their banks'
+        free lists. Callers count their own eviction flavor."""
+        if len(victims) == 0:
+            return
         self.writeback(victims[self._dirty[victims]])
         self.device_map.remove(self._keys[victims])
         self._valid[victims] = False
         self._dirty[victims] = False
         for v in victims:
             self._free[self._row_bank[v]].append(int(v))
-        self.counters["evictions"] += len(victims)
+
+    def _enforce_tenant_caps(self, missing: np.ndarray,
+                             batch_keys: np.ndarray) -> None:
+        """Per-tenant HBM-slot quota (tenancy): for each capped tenant
+        whose resident + incoming rows would exceed its cap, evict the
+        OVERAGE from that tenant's own rows (policy order, batch keys
+        protected) — the freed slots return to their banks, so the
+        bank-shortfall pass that follows sees them. A tenant whose cap
+        is smaller than one batch's working set is a config error."""
+        t_in = self._tenant_of(missing)
+        protect = np.zeros(self.config.capacity, bool)
+        r = self.device_map.lookup_host(batch_keys)
+        protect[r[r >= 0]] = True
+        for t, cap in self._tenant_slots.items():
+            incoming = int((t_in == t).sum())
+            if incoming == 0:
+                continue
+            enforce(incoming <= cap,
+                    f"hot tier tenant {t}: one batch admits {incoming} "
+                    f"rows but tenant_slots caps it at {cap} — raise the "
+                    "cap (it must cover a batch's working set)")
+            resident = self._valid & (self._row_tenant == t)
+            over = int(resident.sum()) + incoming - cap
+            if over <= 0:
+                continue
+            cand = np.flatnonzero(resident & ~protect)
+            enforce(len(cand) >= over,
+                    f"hot tier tenant {t}: cap {cap} cannot fit the "
+                    "current batch even after evicting every unprotected "
+                    f"resident row ({len(cand)} evictable, need {over})")
+            if self.config.policy == "lfu":
+                order = np.lexsort((cand, self._tick[cand],
+                                    self._freq[cand]))
+            else:  # lru
+                order = np.lexsort((cand, self._freq[cand],
+                                    self._tick[cand]))
+            victims = cand[order[:over]]
+            self._evict_rows(victims)
+            self.counters["tenant_cap_evictions"] += len(victims)
+
+    def tenant_residency(self) -> Dict[int, int]:
+        """Resident row count per tenant (control-plane read): the
+        hot-tier leg of the tenancy billing meter."""
+        rows = self._row_tenant[self._valid]
+        out: Dict[int, int] = {}
+        for t in np.unique(rows):
+            out[int(t)] = int((rows == t).sum())
+        return out
 
     # -- flush-back (EndPass semantics, incremental) ----------------------
 
@@ -602,8 +693,11 @@ class HotEmbeddingTier:
         """Counters the bench and chaos gates assert on (satellite):
         hit-rate, churn, and occupancy — not timing alone."""
         total = self.counters["hits"] + self.counters["misses"]
+        tenants = ({"tenants": self.tenant_residency()}
+                   if self._tenant_slots else {})
         return {
             **self.counters,
+            **tenants,
             "hit_rate": self.counters["hits"] / total if total else 0.0,
             "occupancy": int(self._valid.sum()),
             "capacity": self.config.capacity,

@@ -579,7 +579,7 @@ class CtrStreamTrainer:
         # the step-time SLO rule (obs/slo.py) burns against. Bound here
         # (cold path); observed once per step (lock-cheap)
         self._h_step = _obs_registry.REGISTRY.histogram(
-            "trainer_step_time_s", table=str(table_id))
+            "trainer_step_time_s", max_series=256, table=str(table_id))
 
         #: persistent HBM hot-embedding tier (ps/hot_tier.py): warm ids
         #: resolve/pull/push INSIDE the compiled step — a warm

@@ -180,9 +180,9 @@ class ReshardController:
         self._pre_cutover: List[Callable[[ReshardPlan], None]] = []
         # obs: shard count is a curve; reshards are counted incidents
         self._g_shards = _obs_registry.REGISTRY.gauge(
-            "ps_shard_count", job=str(cluster.job_id))
+            "ps_shard_count", max_series=64, job=str(cluster.job_id))
         self._c_reshards = _obs_registry.REGISTRY.counter(
-            "ps_reshards", job=str(cluster.job_id))
+            "ps_reshards", max_series=64, job=str(cluster.job_id))
         self._g_shards.set(cluster.num_shards)
 
     # -- wiring ------------------------------------------------------------

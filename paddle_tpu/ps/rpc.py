@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import struct
 # lock discipline (tools/lint/py_locks.py; docs/STATIC_ANALYSIS.md):
 # all client/server mutexes are LEAVES. `_mu` is the per-connection
 # wire mutex (serializes connect/call/close on ONE socket — the IO is
@@ -38,7 +39,9 @@ import numpy as np
 
 from ..core import sync as _sync
 from ..core.enforce import (NotFoundError, PreconditionNotMetError,
-                            PsTransportError, WrongShardError, enforce)
+                            PsTransportError, QuotaExceededError,
+                            ThrottledError, WrongShardError,
+                            WrongTenantError, enforce)
 from ..core.flags import define_flag, flag
 from ..core.profiler import RecordEvent
 from ..obs import flightrec as _flightrec
@@ -146,6 +149,13 @@ _OBS_SNAP = 43
 # live elastic resharding (ps/reshard.py; docs/OPERATIONS.md §15):
 # n = modulus (0 = read ownership), aux = residue (-1 = fence out)
 _RETAIN = 44
+# multi-tenancy (ps/tenancy.py; docs/OPERATIONS.md §20): hello binds a
+# connection to tenant n with a token payload; config is operator-plane
+# tenant install/usage-meter. The tenant tag rides the table_id HIGH
+# BYTE (_TENANT_SHIFT) — the ReqHeader is contract-pinned and never grows
+_TENANT_HELLO = 45
+_TENANT_CONFIG = 46
+_TENANT_SHIFT = 24  # csrc kTenantShift
 
 # push-value wire encodings (csrc PushWireFlag — kPushSparse aux bits;
 # TableConfig.push_wire_dtype resolves them at create time). Pinned
@@ -438,7 +448,8 @@ class _ServerConn:
 
     def __init__(self, lib: ctypes.CDLL, host: str, port: int,
                  io_timeout_flag: str = "pserver_timeout_ms",
-                 max_retry_flag: str = "pserver_max_retry") -> None:
+                 max_retry_flag: str = "pserver_max_retry",
+                 hello: Optional[Tuple[int, bytes]] = None) -> None:
         self._lib = lib
         self._host, self._port = host, port
         self.endpoint = f"{host}:{port}"
@@ -448,6 +459,15 @@ class _ServerConn:
         # read live at (re)connect/call time like the train path always did
         self._io_flag = io_timeout_flag
         self._retry_flag = max_retry_flag
+        # tenant binding, replayed after EVERY (re)connect: the binding
+        # is per-SOCKET server-side, and a silently rebuilt socket would
+        # otherwise come back on the operator plane (tenant 0) — a
+        # transport blip must never widen a tenant's blast radius.
+        # Passing ``hello`` at construction binds the very first socket
+        # too (tenant-scoped clients hand it through conn_kw, so
+        # failover/reshard replacement conns inherit the binding).
+        self._hello: Optional[Tuple[int, bytes]] = (
+            (int(hello[0]), bytes(hello[1])) if hello else None)
         # serializes the whole call/close/reconnect/set_timeout sequence:
         # the C++ mutex only protects a single psc_call, but reconnect
         # DELETES the PsConn — without this lock a trainer-thread retry
@@ -464,6 +484,24 @@ class _ServerConn:
             raise PsTransportError(
                 f"cannot connect to PS server {self._host}:{self._port} "
                 f"(connect timeout {flag('pserver_connect_timeout_ms')} ms)")
+        if self._hello is not None:
+            tenant, token = self._hello
+            ptrs = (ctypes.c_void_p * 1)()
+            lens = (ctypes.c_uint64 * 1)()
+            nparts = 0
+            if token:
+                ptrs[0] = ctypes.cast(ctypes.c_char_p(token),
+                                      ctypes.c_void_p)
+                lens[0] = len(token)
+                nparts = 1
+            st, _ = self._call_once(_TENANT_HELLO, 0, tenant, 0,
+                                    ptrs, lens, nparts, None, False)
+            if st < 0:
+                self.close()
+                raise WrongTenantError(
+                    f"tenant {tenant} hello refused by "
+                    f"{self._host}:{self._port} on reconnect "
+                    f"(status {st})")
 
     def close(self) -> None:
         with self._mu:
@@ -596,8 +634,67 @@ class _ServerConn:
                 f"this request (cmd {cmd}, table {table_id}) — the "
                 "shard topology moved (live reshard); re-resolve the "
                 "routing table and replay")
+        if status == -9:
+            raise WrongTenantError(
+                f"PS server {self.endpoint} refused cmd {cmd} on table "
+                f"{table_id}: outside this connection's tenant namespace "
+                "(or unknown tenant / bad hello token / operator-plane "
+                "command from a tenant connection)")
+        if status == -10:
+            raise QuotaExceededError(
+                f"PS server {self.endpoint} refused row-creating cmd "
+                f"{cmd} on table {table_id}: tenant row/SSD-byte quota "
+                "exhausted — shrink tables or raise the quota; other "
+                "tenants' rows are never evicted to make room")
+        if status == -11:
+            # the shed response carries the server's backoff hint
+            # resp may be bytes or a uint8 ndarray view — len() works
+            # for both; a payload-less shed falls back to 1 ms
+            retry_ms = (struct.unpack("<q", bytes(resp[:8]))[0]
+                        if len(resp) >= 8 else 1)
+            raise ThrottledError(
+                f"PS server {self.endpoint} shed cmd {cmd}: tenant "
+                f"request budget dry, retry after {retry_ms} ms",
+                retry_after_ms=retry_ms)
         enforce(status >= 0, f"PS command {cmd} failed with status {status}")
         return status, resp
+
+    # -- tenancy (ps/tenancy.py drives these; docs/OPERATIONS.md §20) ----
+
+    def tenant_hello(self, tenant: int, token: bytes) -> None:
+        """Bind THIS connection to ``tenant`` (1..255). Every later
+        frame on the socket is admitted against that tenant's namespace,
+        token bucket and quotas; a rebind is refused server-side. The
+        binding is recorded and REPLAYED after any reconnect, so a
+        transport blip can't drop the socket back onto the operator
+        plane."""
+        token = bytes(token)
+        self.check(_TENANT_HELLO, 0, int(tenant), 0, token, retries=0)
+        self._hello = (int(tenant), token)
+
+    def tenant_config(self, tenant: int, *, pclass: int = 1,
+                      rate: float = 0.0, burst: float = 0.0,
+                      max_rows: int = 0, max_ssd_bytes: int = 0,
+                      token: bytes = b"") -> None:
+        """Install/update a tenant on this server (operator plane only).
+        ``rate``/``burst`` meter the token bucket in cost units (1 per
+        frame + 1 per key); 0 = unmetered. ``pclass`` 0 = serve (queues
+        briefly when dry), >= 1 = batch (sheds immediately)."""
+        token = bytes(token)
+        payload = struct.pack("<IiddqqII", int(tenant), int(pclass),
+                              float(rate), float(burst), int(max_rows),
+                              int(max_ssd_bytes), len(token), 0) + token
+        self.check(_TENANT_CONFIG, 0, 1, 0, payload)
+
+    def tenant_usage(self, tenant: int) -> Dict[str, float]:
+        """Read a tenant's billing meter: resident rows, SSD bytes, shed
+        and quota-refusal counters, current bucket tokens, class."""
+        _, resp = self.check(_TENANT_CONFIG, int(tenant), 0, 0, None)
+        rows, ssd_bytes, throttled, refused, tokens, pclass = \
+            struct.unpack("<qqqqdq", bytes(resp[:48]))
+        return {"rows": rows, "ssd_bytes": ssd_bytes,
+                "throttled": throttled, "quota_refused": refused,
+                "tokens": tokens, "pclass": pclass}
 
 
 class _ColdBounce(Exception):
@@ -691,7 +788,8 @@ class RpcPsClient(PSClient):
 
     def __init__(self, endpoints: Sequence[str],
                  router: Optional[object] = None,
-                 qos: str = "train") -> None:
+                 qos: str = "train",
+                 tenant: Optional[Tuple[int, bytes]] = None) -> None:
         lib = _rpc_lib()
         self._lib = lib
         enforce(qos in ("train", "serve"),
@@ -708,6 +806,14 @@ class RpcPsClient(PSClient):
         if qos == "serve":
             conn_kw = dict(io_timeout_flag="pserver_serve_timeout_ms",
                            max_retry_flag="pserver_serve_max_retry")
+        if tenant is not None:
+            # tenant-scoped client (ps/tenancy.py TenantClient): EVERY
+            # connection this client ever builds — including failover
+            # and reshard replacements — binds to the tenant before the
+            # first data frame, so no code path can leak an
+            # operator-plane socket into tenant traffic
+            conn_kw = dict(conn_kw, hello=(int(tenant[0]),
+                                           bytes(tenant[1])))
         self._conn_kw = conn_kw
         self._conns: List[_ServerConn] = []
         for ep in endpoints:

@@ -1,0 +1,336 @@
+"""Multi-tenant PS cloud: many models on one HACluster with ENFORCED
+isolation (ISSUE 19; docs/OPERATIONS.md §20).
+
+The reference's production clusters run many jobs against one shared
+parameter-server fleet — Wide&Deep trillion-feature CTR next to DeepFM
+and ERNIE on the same servers. This module is that scenario's control
+plane, stitched over seams earlier PRs built one at a time:
+
+- **Namespaces** (csrc kTenantShift): a tenant's tables live under
+  table ids whose HIGH BYTE is the tenant id. The namespace is
+  WIRE-ENFORCED, not advisory: a connection binds to its tenant via
+  kTenantHello and the server bounces any frame addressing another
+  tenant's table with kErrWrongTenant — before the pause gate, the
+  ownership fence and the oplog tap, so a refused frame changed state
+  nowhere. The ReqHeader is contract-pinned and never grows; the tag
+  rides bits the 32-bit table id always had.
+- **Priority classes + weighted admission** (csrc tenant_admit): each
+  tenant carries a token-bucket request budget per shard (cost = 1 per
+  frame + 1 per key, so hot-key floods of fat pulls drain it in
+  proportion to server work). Over budget, serve-class (pclass 0)
+  traffic queues briefly server-side; batch classes shed immediately
+  with kErrThrottled + a retry_after_ms hint. Other tenants' buckets
+  are untouched — admission happens before any shared resource is held.
+- **Enforced quotas**: PS RAM rows and SSD bytes are metered from the
+  live engines (csrc tenant_usage — the PR 8 registry families' billing
+  view, read via kTenantConfig n=0) and row-creating commands refuse
+  with kErrQuota at the cap; another tenant's rows are NEVER evicted to
+  make room. Hot-tier HBM slots cap per tenant inside
+  HotEmbeddingTier (HotTierConfig.tenant_slots) — an over-cap tenant
+  evicts its OWN least-valuable rows.
+- **Per-tenant control plane**: tenant-labeled metric families with
+  bounded cardinality (max 256 tenants — the id is one byte),
+  per-tenant SLO rules (:func:`tenant_slo_rules`), scoped
+  flight-recorder bundles (:func:`tenant_flight_recorder`), and a
+  per-tenant autoscaler lever (an Autoscaler subscribed to one
+  tenant's rules; ps/autoscale.py ``tenant=``).
+
+Proof: tools/tenancy_bench.py runs the workload zoo as concurrent
+tenants with one deliberately abusive tenant and asserts the
+well-behaved tenants' p99 stays within a CI-gated bound of their solo
+baselines (TENANCY.json; ci.sh tenancy gate).
+"""
+
+# lock discipline (tools/lint/py_locks.py): the directory's _mu is a
+# LEAF — never held across calls into rpc/ha (register/usage do their
+# wire work lock-free and only fence the tenant map itself)
+# LOCK LEAF: _mu
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+import numpy as np
+
+from ..core import sync as _sync
+from ..core.enforce import enforce
+from ..obs import registry as _obs_registry
+from .rpc import RpcPsClient, make_conn
+
+__all__ = [
+    "TENANT_SHIFT", "KEY_TENANT_SHIFT", "MAX_TENANTS",
+    "tenant_table_id", "split_table_id", "namespace_keys",
+    "tenant_of_keys", "Tenant", "TenantDirectory", "tenant_slo_rules",
+    "tenant_flight_recorder",
+]
+
+#: table-id namespace shift (csrc kTenantShift, rpc._TENANT_SHIFT):
+#: table_id = (tenant << TENANT_SHIFT) | local_id
+TENANT_SHIFT = 24
+#: key namespace shift for SHARED caches/tiers (hot_tier tenant caps):
+#: the top byte of a u64 feature key carries the tenant. The PS server
+#: itself needs no key namespacing — tables are already namespaced —
+#: but a shared HotEmbeddingTier admits keys from many tenants into one
+#: row space and must attribute each row to its owner.
+KEY_TENANT_SHIFT = 56
+#: tenant ids are one byte; 0 is the operator/default plane
+MAX_TENANTS = 255
+
+
+def tenant_table_id(tenant: int, local_id: int) -> int:
+    """The wire table id of ``local_id`` inside ``tenant``'s namespace."""
+    enforce(0 < tenant <= MAX_TENANTS,
+            f"tenant id must be 1..{MAX_TENANTS}, got {tenant}")
+    enforce(0 <= local_id < (1 << TENANT_SHIFT),
+            f"local table id must fit below the tenant tag, got {local_id}")
+    return (int(tenant) << TENANT_SHIFT) | int(local_id)
+
+
+def split_table_id(table_id: int) -> tuple:
+    """(tenant, local_id) of a wire table id (tenant 0 = operator)."""
+    return (int(table_id) >> TENANT_SHIFT) & 0xff, \
+        int(table_id) & ((1 << TENANT_SHIFT) - 1)
+
+
+def namespace_keys(tenant: int, keys: np.ndarray) -> np.ndarray:
+    """Stamp ``tenant`` into the top byte of u64 feature keys (shared
+    hot-tier layout). Keys must leave the top byte free — CTR feature
+    hashes do (they are 64-bit hashes; callers mask to 56 bits)."""
+    enforce(0 < tenant <= MAX_TENANTS,
+            f"tenant id must be 1..{MAX_TENANTS}, got {tenant}")
+    k = np.asarray(keys, np.uint64)
+    mask = np.uint64((1 << KEY_TENANT_SHIFT) - 1)
+    return (k & mask) | (np.uint64(tenant) << np.uint64(KEY_TENANT_SHIFT))
+
+
+def tenant_of_keys(keys: np.ndarray) -> np.ndarray:
+    """Tenant ids from namespaced u64 keys (top byte)."""
+    return (np.asarray(keys, np.uint64)
+            >> np.uint64(KEY_TENANT_SHIFT)).astype(np.int64)
+
+
+@dataclasses.dataclass
+class Tenant:
+    """One tenant's declared envelope — what the operator installs on
+    every server replica and what the billing meter reports against."""
+
+    name: str
+    tid: int                      # 1..255 — the namespace tag
+    #: 0 = serve (over-budget requests queue briefly), >= 1 = batch
+    #: (over-budget requests shed immediately with retry_after)
+    pclass: int = 1
+    #: token-bucket refill in cost units/s PER SHARD (1 per frame + 1
+    #: per key); 0 = unmetered
+    rate: float = 0.0
+    #: bucket depth (burst allowance) per shard
+    burst: float = 0.0
+    #: max resident rows across the tenant's namespace per shard
+    #: (0 = no cap)
+    max_rows: int = 0
+    #: max SSD file bytes across the namespace per shard (0 = no cap)
+    max_ssd_bytes: int = 0
+    #: hot-tier HBM slot cap (HotTierConfig.tenant_slots feed;
+    #: 0 = no cap — advisory here, the tier enforces it)
+    hot_slots: int = 0
+    #: hello credential; empty is legal (id-only isolation for tests)
+    token: bytes = b""
+
+    def __post_init__(self) -> None:
+        enforce(0 < self.tid <= MAX_TENANTS,
+                f"tenant id must be 1..{MAX_TENANTS}, got {self.tid}")
+
+    def table_id(self, local_id: int) -> int:
+        return tenant_table_id(self.tid, local_id)
+
+
+class TenantDirectory:
+    """Operator-side tenant registry for one :class:`~.ha.HACluster`.
+
+    ``register`` installs/updates a tenant on EVERY replica of every
+    shard (backups too: kTenantConfig is accepted in read-only mode, so
+    a failover promotes a server that already enforces the same
+    envelope). ``client`` hands out tenant-BOUND clients — every
+    connection they ever build (including failover/reshard
+    replacements) hellos before its first data frame. ``usage``
+    aggregates the per-shard billing meters.
+
+    Thread-safety: the directory itself is a small registry under one
+    leaf lock; the heavy lifting (admission, quotas) lives server-side.
+    """
+
+    def __init__(self, cluster) -> None:
+        self.cluster = cluster
+        self._mu = _sync.Lock()  # LOCK: _mu (leaf)
+        self._tenants: Dict[str, Tenant] = {}
+        # bounded-cardinality tenant-labeled meter gauges (≤ 256
+        # tenants by construction — the id is one byte). Bound at
+        # REGISTER time, the cold path; refresh_usage() only .set()s.
+        self._g_rows: Dict[str, object] = {}
+        self._g_ssd: Dict[str, object] = {}
+        self._g_throttled: Dict[str, object] = {}
+
+    # -- registration -----------------------------------------------------
+
+    def _all_endpoints(self) -> List[str]:
+        eps: List[str] = []
+        for row in self.cluster.servers:
+            for r in row:
+                if not r.server.stopped:
+                    eps.append(r.endpoint)
+        return eps
+
+    def register(self, tenant: Tenant) -> Tenant:
+        """Install (or update) ``tenant`` on every live replica."""
+        with self._mu:
+            for existing in self._tenants.values():
+                enforce(existing.tid != tenant.tid
+                        or existing.name == tenant.name,
+                        f"tenant id {tenant.tid} already registered "
+                        f"as {existing.name!r}")
+            self._tenants[tenant.name] = tenant
+            reg = _obs_registry.REGISTRY
+            if tenant.name not in self._g_rows:
+                self._g_rows[tenant.name] = reg.gauge(
+                    "tenant_rows", max_series=MAX_TENANTS + 1,
+                    tenant=tenant.name)
+                self._g_ssd[tenant.name] = reg.gauge(
+                    "tenant_ssd_bytes", max_series=MAX_TENANTS + 1,
+                    tenant=tenant.name)
+                self._g_throttled[tenant.name] = reg.gauge(
+                    "tenant_throttled", max_series=MAX_TENANTS + 1,
+                    tenant=tenant.name)
+        self._push(tenant)
+        return tenant
+
+    def _push(self, tenant: Tenant) -> None:
+        for ep in self._all_endpoints():
+            conn = make_conn(ep)
+            try:
+                conn.tenant_config(
+                    tenant.tid, pclass=tenant.pclass, rate=tenant.rate,
+                    burst=tenant.burst, max_rows=tenant.max_rows,
+                    max_ssd_bytes=tenant.max_ssd_bytes,
+                    token=tenant.token)
+            finally:
+                conn.close()
+
+    def sync_server(self, endpoint: str) -> int:
+        """Re-push every registered tenant to ONE server (a restarted
+        replica rejoins with an empty tenant registry — the operator
+        restart runbook step). Returns the number pushed."""
+        with self._mu:
+            tenants = list(self._tenants.values())
+        for t in tenants:
+            conn = make_conn(endpoint)
+            try:
+                conn.tenant_config(
+                    t.tid, pclass=t.pclass, rate=t.rate, burst=t.burst,
+                    max_rows=t.max_rows, max_ssd_bytes=t.max_ssd_bytes,
+                    token=t.token)
+            finally:
+                conn.close()
+        return len(tenants)
+
+    def get(self, name: str) -> Tenant:
+        with self._mu:
+            return self._tenants[name]
+
+    def tenants(self) -> List[Tenant]:
+        with self._mu:
+            return list(self._tenants.values())
+
+    # -- tenant-scoped clients --------------------------------------------
+
+    def client(self, name: str, qos: str = "train",
+               with_router: bool = True, **router_kw) -> RpcPsClient:
+        """A router-wired client BOUND to ``name``'s namespace: every
+        connection hellos before its first data frame, so the server
+        enforces the namespace/budget/quota on everything it sends."""
+        t = self.get(name)
+        cli = RpcPsClient(
+            self.cluster.routing.primaries(),
+            router=(self.cluster.router(qos=qos, **router_kw)
+                    if with_router else None),
+            qos=qos, tenant=(t.tid, t.token))
+        self.cluster._clients.append(cli)
+        return cli
+
+    # -- the billing meter ------------------------------------------------
+
+    def usage(self, name: str) -> Dict[str, int]:
+        """Aggregate ``name``'s meter across every PRIMARY shard:
+        resident rows, SSD bytes, shed/refused counters."""
+        t = self.get(name)
+        total = {"rows": 0, "ssd_bytes": 0, "throttled": 0,
+                 "quota_refused": 0}
+        for shard in range(self.cluster.num_shards):
+            ep = self.cluster.primary(shard).endpoint
+            conn = make_conn(ep)
+            try:
+                u = conn.tenant_usage(t.tid)
+            finally:
+                conn.close()
+            for k in total:
+                total[k] += int(u[k])
+        return total
+
+    def refresh_usage(self) -> Dict[str, Dict[str, int]]:
+        """Read every tenant's meter and export it through the
+        tenant-labeled gauges (the sampler-visible billing feed).
+        Returns {tenant name: usage dict}."""
+        out = {}
+        for t in self.tenants():
+            u = self.usage(t.name)
+            out[t.name] = u
+            with self._mu:
+                self._g_rows[t.name].set(u["rows"])
+                self._g_ssd[t.name].set(u["ssd_bytes"])
+                self._g_throttled[t.name].set(u["throttled"])
+        return out
+
+
+# ---------------------------------------------------------------------------
+# per-tenant control plane glue
+# ---------------------------------------------------------------------------
+
+
+def tenant_slo_rules(tenant: str,
+                     pull_p99_s: float = 0.05,
+                     throttled_per_s: float = 50.0) -> List:
+    """Per-tenant SLO rules (obs/slo.py), labeled {"tenant": name} so
+    one tenant's burn can neither fire nor clear a neighbor's rule.
+    Subscribe them to the tenant's Autoscaler (``config.up_rules`` +
+    ``tenant=``) for the per-tenant scaling lever, and to a scoped
+    flight recorder for tenant-only bundles.
+
+    - ``{tenant}_pull_p99``: threshold on the tenant-labeled pull
+      latency gauge family ``tenant_pull_s``.
+    - ``{tenant}_throttle_rate``: the tenant is being shed faster than
+      ``throttled_per_s`` — its own overload (or under-provisioned
+      budget), surfaced on ITS control plane, not the neighbors'.
+    """
+    from ..obs.slo import SloRule
+
+    return [
+        SloRule(name=f"{tenant}_pull_p99", family="tenant_pull_s",
+                kind="threshold", threshold=pull_p99_s, agg="max",
+                labels={"tenant": tenant},
+                windows=((10.0, 1.0),), min_count=3),
+        SloRule(name=f"{tenant}_throttle_rate", family="tenant_throttled",
+                kind="threshold", threshold=throttled_per_s, agg="rate",
+                field="rate", labels={"tenant": tenant},
+                windows=((10.0, 1.0),), min_count=3),
+    ]
+
+
+def tenant_flight_recorder(out_dir: str, tenant: str, **kw):
+    """A flight recorder whose bundles are SCOPED to one tenant: its
+    own bundle directory and an alert filter on the tenant label
+    (obs/flightrec.py ``scope``) — a tenant postmortem never leaks a
+    neighbor's alert stream."""
+    import os
+
+    from ..obs.flightrec import FlightRecorder
+
+    return FlightRecorder(os.path.join(out_dir, f"tenant_{tenant}"),
+                          scope={"tenant": tenant}, **kw)

@@ -6,7 +6,16 @@ Request flow: ``submit()`` enqueues into a BOUNDED admission queue
 sheds instead of growing memory and latency without bound); the worker
 thread coalesces up to ``max_batch`` requests or ``max_delay_us`` of
 waiting — whichever comes first — into ONE embedding lookup + ONE
-inference call, then scatters results. Requests whose deadline expired
+inference call, then scatters results.
+
+Two priority classes share the frontend (the multi-tenant cloud's
+serve-plane mirror of the PS-side admission classes): ``serve``
+requests land in the primary queue and are always popped first;
+``batch`` requests (offline scoring, backfills) land in a SEPARATE
+bounded queue that only fills micro-batch slots serve traffic left
+over, and is shed independently — a batch flood fills its own queue
+and sheds batch, never a serve request, while serve overload sheds
+serve without being widened by the batch backlog. Requests whose deadline expired
 while queued are dropped before paying any lookup (their slot in the
 batch goes to live traffic); a result that completes past its deadline
 is still delivered but counted (``deadline_misses``) so the SLO monitor
@@ -196,6 +205,11 @@ class ServingFrontend:
         enforce(cfg.max_batch > 0 and cfg.queue_cap > 0,
                 "FrontendConfig max_batch/queue_cap must be positive")
         self._q: "queue.Queue[_Request]" = _sync.Queue(maxsize=cfg.queue_cap)
+        # batch-class admission queue: same bound, popped only when the
+        # serve queue is empty / has slack in the micro-batch. Separate
+        # bounded queues (not one priority heap) keep the shed decision
+        # per-class: a batch flood can only fill — and shed — batch
+        self._bq: "queue.Queue[_Request]" = _sync.Queue(maxsize=cfg.queue_cap)
         self._keys_per_req: Optional[int] = None
         self._mu = _sync.Lock()
         # registry-backed (obs/registry.py CounterGroup): the dict
@@ -204,7 +218,8 @@ class ServingFrontend:
         self.counters: CounterGroup = CounterGroup(
             "serving_frontend_events",
             ("accepted", "served", "shed", "deadline_dropped",
-             "deadline_misses", "batches", "errors"),
+             "deadline_misses", "batches", "errors",
+             "accepted_batch", "shed_batch"),
             max_series=1024, frontend=str(next(_FRONTEND_SEQ)),
             replica=self.replica_label)
         #: end-to-end request latency (submit → result delivered)
@@ -232,8 +247,11 @@ class ServingFrontend:
     # -- admission ---------------------------------------------------------
 
     def submit(self, keys, dense=None,
-               deadline_ms: Optional[float] = None) -> PendingResult:
+               deadline_ms: Optional[float] = None,
+               priority: str = "serve") -> PendingResult:
         cfg = self.config
+        enforce(priority in ("serve", "batch"),
+                f"priority must be 'serve' or 'batch' (got {priority!r})")
         if self._stopping.is_set():
             raise RequestRejected("frontend stopped")
         keys = np.ascontiguousarray(keys, np.uint64).reshape(-1)
@@ -249,6 +267,8 @@ class ServingFrontend:
                        None if dense is None
                        else np.ascontiguousarray(dense, np.float32),
                        time.perf_counter() + dl_ms / 1e3)
+        q = self._q if priority == "serve" else self._bq
+        acc = "accepted" if priority == "serve" else "accepted_batch"
         try:
             with self._mu:
                 # stopping-check + put are atomic with stop()'s
@@ -257,15 +277,16 @@ class ServingFrontend:
                 # result() that nobody will ever deliver)
                 if self._stopping.is_set():
                     raise RequestRejected("frontend stopped")
-                self._q.put_nowait(req)
-                self.counters["accepted"] += 1
+                q.put_nowait(req)
+                self.counters[acc] += 1
         except queue.Full:
             hint = self.retry_after_hint_ms()
             with self._mu:
-                self.counters["shed"] += 1
+                self.counters["shed" if priority == "serve"
+                              else "shed_batch"] += 1
             raise RequestRejected(
-                f"admission queue full ({cfg.queue_cap}) — retry after "
-                f"{hint:.0f} ms",
+                f"{priority} admission queue full ({cfg.queue_cap}) — "
+                f"retry after {hint:.0f} ms",
                 retry_after_ms=hint)
         return PendingResult(req)
 
@@ -278,7 +299,7 @@ class ServingFrontend:
         how long the backlog genuinely takes to drain, so shed clients
         back off proportionally instead of hammering a constant."""
         cfg = self.config
-        backlog = self._q.qsize()
+        backlog = self._q.qsize() + self._bq.qsize()
         with self._mu:
             rate = self._drain_rate
         if rate <= 0.0 or backlog <= 0:
@@ -287,9 +308,10 @@ class ServingFrontend:
                          cfg.retry_after_max_ms))
 
     def __call__(self, keys, dense=None, deadline_ms=None,
-                 timeout: float = 10.0):
+                 timeout: float = 10.0, priority: str = "serve"):
         """Synchronous convenience: submit + wait."""
-        return self.submit(keys, dense, deadline_ms).result(timeout)
+        return self.submit(keys, dense, deadline_ms,
+                           priority=priority).result(timeout)
 
     # -- worker ------------------------------------------------------------
 
@@ -297,11 +319,19 @@ class ServingFrontend:
         cfg = self.config
         while True:
             try:
-                first = self._q.get(timeout=self.idle_pop_s)
+                # batch work pending shortens the serve-pop timeout to a
+                # sliver: serve still wins any race (it is checked
+                # first), but an idle serve plane doesn't starve batch
+                # for idle_pop_s per round
+                first = self._q.get(timeout=(0.001 if self._bq.qsize()
+                                             else self.idle_pop_s))
             except queue.Empty:
-                if self._stopping.is_set():
-                    return
-                continue
+                try:
+                    first = self._bq.get_nowait()
+                except queue.Empty:
+                    if self._stopping.is_set():
+                        return
+                    continue
             self._busy = True
             try:
                 batch = [first]
@@ -312,6 +342,14 @@ class ServingFrontend:
                         break
                     try:
                         batch.append(self._q.get(timeout=rem))
+                    except queue.Empty:
+                        break
+                # leftover micro-batch slots go to batch-class — no
+                # waiting (batch has no latency target); serve traffic
+                # filled first so a full serve round ships untouched
+                while len(batch) < cfg.max_batch:
+                    try:
+                        batch.append(self._bq.get_nowait())
                     except queue.Empty:
                         break
                 self._serve(batch)
@@ -400,8 +438,9 @@ class ServingFrontend:
 
     @property
     def queue_depth(self) -> int:
-        """Live admission-queue depth (the router's P2C load signal)."""
-        return self._q.qsize()
+        """Live admission-queue depth, both classes (the router's P2C
+        load signal)."""
+        return self._q.qsize() + self._bq.qsize()
 
     @property
     def stopped(self) -> bool:
@@ -412,7 +451,8 @@ class ServingFrontend:
         batches — the fleet's draining-restart predicate ("finish
         in-flight" is: stop admitting at the router, then wait for
         this)."""
-        return self._q.qsize() == 0 and not self._busy
+        return (self._q.qsize() == 0 and self._bq.qsize() == 0
+                and not self._busy)
 
     def stats(self) -> Dict[str, Any]:
         with self._mu:
@@ -431,12 +471,13 @@ class ServingFrontend:
         with self._mu:   # fences concurrent submit()s' check-and-put
             self._stopping.set()
         self._thread.join(timeout=10)
-        while True:
-            try:
-                req = self._q.get_nowait()
-            except queue.Empty:
-                break
-            req.fail(RequestRejected("frontend stopped"))
+        for q in (self._q, self._bq):
+            while True:
+                try:
+                    req = q.get_nowait()
+                except queue.Empty:
+                    break
+                req.fail(RequestRejected("frontend stopped"))
 
     def __enter__(self) -> "ServingFrontend":
         return self

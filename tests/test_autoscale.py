@@ -123,6 +123,36 @@ def test_failed_scale_is_journaled_and_cooled_down():
     assert a.errors == 1                         # no hot-looping the break
 
 
+def test_journal_wall_clock_and_tenant_labels():
+    """ISSUE 19 satellite: every journal event carries a wall-clock
+    ``wall_s`` (the cross-subsystem alignment key — flight-recorder
+    manifests and obs spans stamp the same field) alongside the legacy
+    ``t`` alias, and a tenant-scoped autoscaler stamps its tenant on
+    every event so one journal stream splits cleanly per tenant."""
+    ctrl = _FakeController()
+    t = [0.0]
+    cfg = AutoscaleConfig(min_shards=2, max_shards=8, cooldown_up_s=5.0,
+                          cooldown_down_s=10.0, clear_hold_s=4.0)
+    a = Autoscaler(ctrl, config=cfg, clock=lambda: t[0], tenant="ctr_team")
+    a.notify_fire(_Alert("step_time_p95"))
+    import time as _time
+    before = _time.time() - 1.0
+    assert a.step() == "up"
+    ev = a.events[-1]
+    assert ev["tenant"] == "ctr_team"
+    # wall_s is REAL wall time (journals are read offline, cross-host),
+    # not the injected control-loop clock
+    assert ev["wall_s"] >= before
+    assert ev["t"] == ev["wall_s"]
+    # an unscoped autoscaler journals no tenant key at all — absence
+    # (not null) is the single-tenant wire shape
+    _, _, a2 = _scaler()
+    a2.notify_fire(_Alert("step_time_p95"))
+    a2.step()
+    assert "tenant" not in a2.events[-1]
+    assert a2.events[-1]["wall_s"] >= before
+
+
 def test_journal_mirrors_into_elastic_store():
     ctrl, t, a = _scaler()
     a.notify_fire(_Alert("step_time_p95"))

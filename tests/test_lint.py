@@ -1003,6 +1003,72 @@ def test_metric_nested_def_in_loop_not_flagged(tmp_path):
     assert diags == []
 
 
+# -- unbounded-label (ISSUE 19 satellite; obs_metrics pass) ------------------
+
+def test_unbounded_label_id_value_flagged(tmp_path):
+    # the canonical offense: a per-request identity as a label value,
+    # no explicit cardinality bound — fires at ANY scope
+    diags = _obs_diags(tmp_path, """
+        from paddle_tpu.obs import registry
+
+        def bind(reg, user_id, request_id):
+            a = reg.counter("fam", user=user_id)
+            b = reg.gauge("fam2", req=str(request_id))
+            return a, b
+    """)
+    assert _rules(diags) == {"unbounded-label"}
+    assert [d.line for d in diags] == [5, 6]
+
+
+def test_unbounded_label_splat_flagged(tmp_path):
+    diags = _obs_diags(tmp_path, """
+        from paddle_tpu.obs import registry
+
+        def bind(reg, labels):
+            return reg.counter("fam", **labels)
+    """)
+    assert _rules(diags) == {"unbounded-label"}
+    assert "**labels" in diags[0].message
+
+
+def test_unbounded_label_max_series_not_flagged(tmp_path):
+    # explicit max_series= IS the fix: the author sized the family
+    diags = _obs_diags(tmp_path, """
+        from paddle_tpu.obs import registry
+
+        def bind(reg, user_id, labels):
+            a = reg.counter("fam", max_series=64, user=user_id)
+            b = reg.histogram("fam2", max_series=128, **labels)
+            return a, b
+    """)
+    assert diags == []
+
+
+def test_unbounded_label_benign_names_not_flagged(tmp_path):
+    # bounded-domain labels (table/tier/shard/replica) and literal
+    # values don't match the unbounded-id pattern — and `table_id`-like
+    # SUBSTRINGS only match on whole _-tokens (`id` does, `idx` not)
+    diags = _obs_diags(tmp_path, """
+        from paddle_tpu.obs import registry
+
+        def bind(reg, tier, shard_idx):
+            a = reg.counter("fam", tier=tier, shard=str(shard_idx))
+            b = reg.gauge("fam2", table="0")
+            return a, b
+    """)
+    assert diags == []
+
+
+def test_unbounded_label_ignore_comment_suppresses(tmp_path):
+    diags = _obs_diags(tmp_path, """
+        from paddle_tpu.obs import registry
+
+        def bind(reg, job_id):
+            return reg.counter("fam", job=job_id)  # graftlint: ignore[unbounded-label]
+    """)
+    assert diags == []
+
+
 # -- anonymous-thread (ISSUE 10 satellite) ----------------------------------
 
 def test_anonymous_thread_flagged(tmp_path):

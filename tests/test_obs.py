@@ -80,6 +80,30 @@ def test_label_cardinality_bounded():
     assert len(series) == 5
 
 
+def test_overflow_emits_per_family_drop_counter():
+    """ISSUE 19 satellite: cardinality overflow is itself a metric —
+    ``obs_dropped_series{family=...}`` counts drops PER FAMILY so the
+    watchdog can alert on the one family that is churning labels
+    (snapshot()'s per-family ``dropped_series`` number requires a human
+    to diff; the counter is alertable)."""
+    reg = Registry()
+    for i in range(7):
+        reg.counter("noisy", max_series=2, k=str(i)).inc()
+    for i in range(4):
+        reg.counter("chatty", max_series=2, k=str(i)).inc()
+    snap = reg.snapshot()["metrics"]
+    drops = {s["labels"]["family"]: s["value"]
+             for s in snap["obs_dropped_series"]["series"]}
+    assert drops == {"noisy": 5, "chatty": 2}
+    # the drop family can NEVER recurse into itself (it is bounded and
+    # exempt): overflow IT and the registry stays standing
+    for i in range(300):
+        reg.counter("f" + str(i), max_series=1, k=str(i))
+    assert reg.snapshot()["metrics"]["obs_dropped_series"] is not None
+    reg.reset()
+    assert "obs_dropped_series" not in reg.snapshot()["metrics"]
+
+
 def test_disabled_mode_null_handles():
     was = get_flags(["obs_metrics"])["obs_metrics"]
     set_flags({"obs_metrics": False})

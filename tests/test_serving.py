@@ -339,6 +339,73 @@ def test_frontend_admission_control_sheds_under_overload():
         fe.submit(np.arange(4, dtype=np.uint64))
 
 
+def test_frontend_priority_classes_shed_and_serve_order():
+    """Two concurrent priority classes on one frontend: batch-class
+    floods fill (and shed) ONLY the batch queue — serve admission stays
+    open and sheds independently — and when both classes are queued the
+    worker serves every serve-class request before any batch-class one
+    (the multi-tenant cloud's serve-plane ordering guarantee)."""
+
+    class _GatedLookup(_StubLookup):
+        def __init__(self):
+            super().__init__()
+            self.gate = threading.Event()
+
+        def lookup(self, keys):
+            self.gate.wait(30)
+            return super().lookup(keys)
+
+    src = _GatedLookup()
+    fe = ServingFrontend(src, config=FrontendConfig(
+        max_batch=1, max_delay_us=100, queue_cap=2, retry_after_ms=5.0),
+        idle_pop_s=0.005)
+    try:
+        # occupy the worker so admitted requests stay queued
+        plug = fe.submit(np.arange(2, dtype=np.uint64), deadline_ms=60000)
+        time.sleep(0.05)
+
+        # batch flood: cap admits 2, the 3rd sheds — as shed_batch
+        order = []
+        batch_p = []
+        for i in range(2):
+            p = fe.submit(np.arange(2, dtype=np.uint64),
+                          deadline_ms=60000, priority="batch")
+            p.add_done_callback(lambda: order.append("batch"))
+            batch_p.append(p)
+        with pytest.raises(RequestRejected) as ei:
+            fe.submit(np.arange(2, dtype=np.uint64),
+                      deadline_ms=60000, priority="batch")
+        assert ei.value.retry_after_ms >= 5.0
+        st = fe.stats()
+        assert st["shed_batch"] == 1 and st["shed"] == 0, \
+            "batch flood must shed batch-class only"
+
+        # serve admission is still open despite the full batch queue —
+        # submitted AFTER batch, they must complete FIRST
+        serve_p = []
+        for i in range(2):
+            p = fe.submit(np.arange(2, dtype=np.uint64),
+                          deadline_ms=60000, priority="serve")
+            p.add_done_callback(lambda: order.append("serve"))
+            serve_p.append(p)
+        # serve overload sheds under its own counter
+        with pytest.raises(RequestRejected):
+            fe.submit(np.arange(2, dtype=np.uint64),
+                      deadline_ms=60000, priority="serve")
+        st = fe.stats()
+        assert st["shed"] == 1 and st["shed_batch"] == 1
+        assert st["accepted"] == 3 and st["accepted_batch"] == 2
+
+        src.gate.set()
+        for p in serve_p + batch_p:
+            p.result(30)
+        plug.result(30)
+        assert order == ["serve", "serve", "batch", "batch"], order
+        assert fe.stats()["served"] == 5
+    finally:
+        fe.stop()
+
+
 def test_frontend_deadline_dropped_before_lookup():
     src = _StubLookup(delay_s=0.03)
     with ServingFrontend(src, config=FrontendConfig(

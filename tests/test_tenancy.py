@@ -1,0 +1,634 @@
+"""Multi-tenant PS cloud (ps/tenancy.py + the csrc tenancy fence;
+ISSUE 19).
+
+Layers under test, bottom-up: the namespace/shift constants pinned
+against the csrc enums, connection binding (kTenantHello: token check,
+rebind refusal, replay after reconnect), the wire-enforced namespace
+fence (kErrWrongTenant), operator-plane-only kTenantConfig, enforced
+quotas (RAM rows + SSD bytes — refusal, never eviction), the
+token-bucket admission classes (batch sheds with retry_after, serve
+queues briefly), the TenantDirectory control plane over an HACluster
+(register-to-every-replica, tenant-bound clients across failover,
+billing meters, restarted-replica re-sync), hot-tier per-tenant HBM
+slot caps, the per-tenant SLO/flight-recorder scoping, and the slow
+interference e2e: three well-behaved tenants + one abusive tenant on
+ONE shared cluster, with p99 isolation and digest-proven zero
+cross-tenant writes.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+# numpy lazy-loads np.testing, and ITS import runs a subprocess (SVE
+# probe). Under the TSAN sweep, a fork once cluster threads are live
+# deadlocks the child — import it NOW, while this is the only thread.
+import numpy.testing  # noqa: F401
+import pytest
+
+from paddle_tpu.core.enforce import (QuotaExceededError, ThrottledError,
+                                     WrongTenantError)
+from paddle_tpu.ps.accessor import AccessorConfig
+from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+from paddle_tpu.ps.table import MemorySparseTable, TableConfig
+
+rpc = pytest.importorskip("paddle_tpu.ps.rpc")
+
+pytestmark = pytest.mark.skipif(
+    not rpc.rpc_available(), reason="native toolchain unavailable")
+
+from paddle_tpu.ps import ha, tenancy  # noqa: E402
+from paddle_tpu.ps.tenancy import (Tenant, TenantDirectory,  # noqa: E402
+                                   namespace_keys, split_table_id,
+                                   tenant_flight_recorder, tenant_of_keys,
+                                   tenant_slo_rules, tenant_table_id)
+
+_CSRC = os.path.join(os.path.dirname(__file__), os.pardir,
+                     "paddle_tpu", "csrc", "ps_service.cc")
+
+
+def _acc():
+    return AccessorConfig(sgd=SGDRuleConfig(initial_range=0.0))
+
+
+def _cfg(shards=4):
+    return TableConfig(shard_num=shards, accessor_config=_acc())
+
+
+@pytest.fixture
+def server():
+    s = rpc.NativePsServer(n_trainers=1)
+    yield s
+    s.close()
+
+
+def _op(server):
+    """Operator-plane conn (no hello — tenant 0)."""
+    return rpc.make_conn(f"127.0.0.1:{server.port}")
+
+
+def _register(server, tid, token=b"", **kw):
+    conn = _op(server)
+    try:
+        conn.tenant_config(tid, token=token, **kw)
+    finally:
+        conn.close()
+
+
+def _client(server, tid, token=b""):
+    return rpc.RpcPsClient([f"127.0.0.1:{server.port}"],
+                           tenant=(tid, token))
+
+
+def _fill(cli, table, keys):
+    """Push non-trivial rows (width from the client's dims cache)."""
+    width = cli._dims(table)[1]
+    push = np.zeros((len(keys), width), np.float32)
+    push[:, 1] = 1.0
+    cli.push_sparse(table, np.asarray(keys, np.uint64), push)
+
+
+# ---------------------------------------------------------------------------
+# namespace constants + helpers
+# ---------------------------------------------------------------------------
+
+
+def test_shift_constants_pinned_against_csrc():
+    # one byte of tenant tag in the 32-bit table id, top byte of u64
+    # keys for shared tiers — pinned on BOTH sides of the wire
+    assert tenancy.TENANT_SHIFT == rpc._TENANT_SHIFT == 24
+    assert tenancy.KEY_TENANT_SHIFT == 56
+    assert tenancy.MAX_TENANTS == 255
+    src = open(_CSRC, encoding="utf-8").read()
+    assert "kTenantShift = 24" in src, \
+        "csrc kTenantShift moved without updating ps/tenancy.py"
+
+    t = tenant_table_id(7, 42)
+    assert split_table_id(t) == (7, 42)
+    assert split_table_id(42) == (0, 42)       # operator plane untagged
+    with pytest.raises(Exception):
+        tenant_table_id(0, 1)                  # 0 is the operator plane
+    with pytest.raises(Exception):
+        tenant_table_id(256, 1)
+    with pytest.raises(Exception):
+        tenant_table_id(1, 1 << 24)
+
+    keys = np.asarray([1, 2, (1 << 56) - 1], np.uint64)
+    nk = namespace_keys(9, keys)
+    assert (tenant_of_keys(nk) == 9).all()
+    # the low 56 bits ride through untouched
+    mask = np.uint64((1 << 56) - 1)
+    np.testing.assert_array_equal(nk & mask, keys & mask)
+
+
+# ---------------------------------------------------------------------------
+# wire fence: hello, namespace, operator plane
+# ---------------------------------------------------------------------------
+
+
+def test_hello_binds_and_namespace_is_wire_enforced(server):
+    _register(server, 1, token=b"alpha")
+    _register(server, 2, token=b"beta")
+    c1 = _client(server, 1, b"alpha")
+    t1 = tenant_table_id(1, 0)
+    c1.create_sparse_table(t1, _cfg())
+    keys = np.arange(1, 9, dtype=np.uint64)
+    out = c1.pull_sparse(t1, keys)
+    assert out.shape[0] == 8
+    _fill(c1, t1, keys)
+    assert c1.size(t1) == 8
+
+    # another tenant's namespace — and the operator's — bounce ON THE
+    # WIRE with kErrWrongTenant (size() goes straight to the server:
+    # no client-side dims cache softens the probe)
+    with pytest.raises(WrongTenantError):
+        c1.size(tenant_table_id(2, 0))
+    with pytest.raises(WrongTenantError):
+        c1.size(0)
+    # the refused probes changed nothing: the table still answers
+    assert c1.size(t1) == 8
+    c1.close()
+
+
+def test_unknown_tenant_bad_token_and_rebind_refused(server):
+    _register(server, 1, token=b"alpha")
+    # wrong token: refused at BIND time (client construction connects)
+    with pytest.raises(WrongTenantError):
+        _client(server, 1, b"wrong")
+    # unknown tenant id: same refusal (no information leak about which)
+    with pytest.raises(WrongTenantError):
+        _client(server, 9, b"")
+    # a bound connection cannot rebind (no tenant hopping mid-stream)
+    conn = _op(server)
+    try:
+        conn.tenant_hello(1, b"alpha")
+        with pytest.raises(WrongTenantError):
+            conn.tenant_hello(1, b"alpha")
+    finally:
+        conn.close()
+
+
+def test_tenant_config_is_operator_plane_only(server):
+    _register(server, 1, token=b"alpha")
+    conn = _op(server)
+    try:
+        conn.tenant_hello(1, b"alpha")
+        # a bound (tenant) connection may neither install envelopes nor
+        # read other meters — the config/billing plane is tenant 0's
+        with pytest.raises(WrongTenantError):
+            conn.tenant_config(3, token=b"x")
+        with pytest.raises(WrongTenantError):
+            conn.tenant_usage(1)
+    finally:
+        conn.close()
+    # the operator reads the meter fine
+    op = _op(server)
+    try:
+        u = op.tenant_usage(1)
+        assert u["rows"] == 0 and u["pclass"] == 1
+    finally:
+        op.close()
+
+
+# ---------------------------------------------------------------------------
+# quotas: RAM rows + SSD bytes — refuse, never evict
+# ---------------------------------------------------------------------------
+
+
+def test_row_quota_refuses_and_never_touches_neighbors(server):
+    _register(server, 1, token=b"a", max_rows=8)
+    _register(server, 2, token=b"b")
+    c1, c2 = _client(server, 1, b"a"), _client(server, 2, b"b")
+    t1, t2 = tenant_table_id(1, 0), tenant_table_id(2, 0)
+    c1.create_sparse_table(t1, _cfg())
+    c2.create_sparse_table(t2, _cfg())
+    _fill(c2, t2, np.arange(1, 21, dtype=np.uint64))
+    assert c2.size(t2) == 20
+
+    # quota is enforced at BATCH granularity: an under-cap tenant's
+    # batch may land whole (documented overshoot ≤ one batch), the
+    # next row-creating frame refuses
+    refused = False
+    for i in range(10):
+        try:
+            _fill(c1, t1, np.arange(i * 4 + 1, i * 4 + 5, dtype=np.uint64))
+        except QuotaExceededError:
+            refused = True
+            break
+    assert refused, "row quota never refused"
+    rows_at_refusal = c1.size(t1)
+    assert rows_at_refusal <= 8 + 4          # cap + one batch overshoot
+
+    # refusal is REFUSAL: repeated over-quota attempts neither grow the
+    # tenant nor evict anyone — the neighbor's rows are untouchable
+    with pytest.raises(QuotaExceededError):
+        _fill(c1, t1, np.asarray([777], np.uint64))
+    assert c1.size(t1) == rows_at_refusal
+    assert c2.size(t2) == 20
+    _fill(c2, t2, np.asarray([999], np.uint64))   # neighbor still grows
+    assert c2.size(t2) == 21
+
+    op = _op(server)
+    try:
+        u = op.tenant_usage(1)
+        assert u["rows"] == rows_at_refusal and u["quota_refused"] >= 2
+        assert op.tenant_usage(2)["quota_refused"] == 0
+    finally:
+        op.close()
+    c1.close()
+    c2.close()
+
+
+def test_ssd_bytes_quota_metered_from_live_sst_stats(server, tmp_path):
+    acc = AccessorConfig(embedx_dim=4, embedx_threshold=0.0,
+                         sgd=SGDRuleConfig(initial_range=0.0))
+    cfg = TableConfig(shard_num=4, accessor_config=acc, storage="ssd",
+                      ssd_path=str(tmp_path / "tiers"))
+    _register(server, 5, token=b"ssd")
+    c = _client(server, 5, b"ssd")
+    t = tenant_table_id(5, 0)
+    c.create_sparse_table(t, cfg)
+    keys = np.arange(1, 201, dtype=np.uint64)
+    _fill(c, t, keys)
+    # spill the working set cold: SSD bytes appear on the meter
+    assert c.spill(t, hot_budget=0) == 200
+    op = _op(server)
+    try:
+        bytes_used = op.tenant_usage(5)["ssd_bytes"]
+    finally:
+        op.close()
+    assert bytes_used > 0
+
+    # the operator tightens the envelope below current usage: every
+    # further row-creating frame refuses (rows stay put — quota is
+    # admission control, not eviction)
+    _register(server, 5, token=b"ssd", max_ssd_bytes=1)
+    with pytest.raises(QuotaExceededError):
+        _fill(c, t, np.asarray([10_001], np.uint64))
+    assert c.size(t) == 200
+    # reads are NOT row-creating: the tenant still serves its data
+    got = c.pull_sparse(t, keys[:8], create=False)
+    assert got.shape[0] == 8
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# weighted admission: batch sheds, serve queues
+# ---------------------------------------------------------------------------
+
+
+def test_batch_class_sheds_with_retry_after(server):
+    # rate 5/s, burst 10, cost = 1 + n keys = 4 per pull → two pulls
+    # fit the bucket, the third sheds (refill over test time ≪ 1 token)
+    _register(server, 1, token=b"a", pclass=1, rate=5.0, burst=10.0)
+    _register(server, 2, token=b"b")
+    c1 = _client(server, 1, b"a")
+    t1 = tenant_table_id(1, 0)
+    c1.create_sparse_table(t1, _cfg())
+    keys = np.arange(1, 4, dtype=np.uint64)
+    shed = None
+    for _ in range(4):
+        try:
+            c1.pull_sparse(t1, keys)
+        except ThrottledError as e:
+            shed = e
+            break
+    assert shed is not None, "token bucket never shed"
+    assert shed.retry_after_ms >= 1          # the hint is actionable
+
+    # the neighbor's bucket is untouched — admission is per-tenant
+    c2 = _client(server, 2, b"b")
+    t2 = tenant_table_id(2, 0)
+    c2.create_sparse_table(t2, _cfg())
+    for _ in range(6):
+        c2.pull_sparse(t2, keys)
+    op = _op(server)
+    try:
+        assert op.tenant_usage(1)["throttled"] >= 1
+        assert op.tenant_usage(2)["throttled"] == 0
+    finally:
+        op.close()
+    c1.close()
+    c2.close()
+
+
+def test_serve_class_queues_briefly_instead_of_shedding(server):
+    # serve (pclass 0) at a refill rate that recovers within the
+    # server's brief wait: a modest overload RIDES THROUGH — no
+    # ThrottledError surfaces to the serving path
+    _register(server, 3, token=b"s", pclass=0, rate=2000.0, burst=5.0)
+    c = _client(server, 3, b"s")
+    t = tenant_table_id(3, 0)
+    c.create_sparse_table(t, _cfg())
+    keys = np.arange(1, 4, dtype=np.uint64)
+    for _ in range(10):
+        c.pull_sparse(t, keys)               # must not raise
+    op = _op(server)
+    try:
+        assert op.tenant_usage(3)["throttled"] == 0
+    finally:
+        op.close()
+    c.close()
+
+
+def test_reconnect_replays_hello(server):
+    _register(server, 1, token=b"a")
+    c = _client(server, 1, b"a")
+    t = tenant_table_id(1, 0)
+    c.create_sparse_table(t, _cfg())
+    _fill(c, t, np.arange(1, 9, dtype=np.uint64))
+    # sever every transport socket under the client: the next call
+    # reconnects and MUST replay the hello first — a bare reconnect
+    # would bounce off the namespace fence as tenant 0
+    for conn in c._conns:
+        conn.close()
+    assert c.size(t) == 8
+    with pytest.raises(WrongTenantError):
+        c.size(tenant_table_id(2, 0))
+    c.close()
+
+
+# ---------------------------------------------------------------------------
+# TenantDirectory over an HACluster
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_directory_register_client_usage_failover():
+    from paddle_tpu.obs.registry import REGISTRY
+    REGISTRY.reset()
+    with ha.HACluster(num_shards=2, replication=2, sync=True) as cluster:
+        d = TenantDirectory(cluster)
+        ctr = d.register(Tenant(name="ctr", tid=1, token=b"ctr",
+                                max_rows=10_000))
+        d.register(Tenant(name="moe", tid=2, token=b"moe"))
+        # one id, one tenant
+        with pytest.raises(Exception):
+            d.register(Tenant(name="imposter", tid=1))
+
+        cli = d.client("ctr")
+        t = ctr.table_id(0)
+        cli.create_sparse_table(t, _cfg())
+        keys = np.arange(1, 65, dtype=np.uint64)
+        width = cli._dims(t)[1]
+        push = np.zeros((len(keys), width), np.float32)
+        push[:, 1] = 1.0
+        cli.pull_sparse(t, keys)
+        cli.push_sparse(t, keys, push)
+        cluster.drain()
+        assert d.usage("ctr")["rows"] == 64
+        assert d.usage("moe")["rows"] == 0
+
+        # the billing feed: tenant-labeled gauges export the meter
+        usages = d.refresh_usage()
+        assert usages["ctr"]["rows"] == 64
+        snap = REGISTRY.snapshot()["metrics"]["tenant_rows"]
+        by_tenant = {s["labels"]["tenant"]: s["value"]
+                     for s in snap["series"]}
+        assert by_tenant["ctr"] == 64 and by_tenant["moe"] == 0
+
+        # kill the primary of shard 0: register() installed the
+        # envelope on the BACKUPS too, and the tenant-bound client's
+        # replacement conns replay the hello — the tenant rides the
+        # failover with the fence intact
+        before = cli.pull_sparse(t, keys, create=False)
+        dead = cluster.kill_primary(0)
+        after = cli.pull_sparse(t, keys, create=False)
+        np.testing.assert_array_equal(before, after)
+        assert cluster.wait_promoted(0, dead) != dead
+        with pytest.raises(WrongTenantError):
+            cli.size(tenant_table_id(2, 0))
+        assert d.usage("ctr")["rows"] == 64
+
+        # a restarted replica rejoins with an EMPTY tenant registry —
+        # sync_server is the runbook step that re-arms it
+        back = cluster.restart_replica(0, dead)
+        assert d.sync_server(back.endpoint) == 2
+
+
+# ---------------------------------------------------------------------------
+# hot-tier HBM slot caps
+# ---------------------------------------------------------------------------
+
+
+def test_hot_tier_tenant_caps_evict_own_rows_only():
+    table = MemorySparseTable(TableConfig(shard_num=2, accessor="ctr"))
+    from paddle_tpu.ps.hot_tier import HotEmbeddingTier, HotTierConfig
+    tier = HotEmbeddingTier(table, HotTierConfig(
+        capacity=64, tenant_slots={1: 8}))
+
+    t2_keys = namespace_keys(2, np.arange(1, 17, dtype=np.uint64))
+    tier.ensure(t2_keys)                      # uncapped tenant resident
+    # tenant 1 streams 3 batches of 8 through an 8-slot cap: each batch
+    # fits by evicting tenant 1's OWN previous batch
+    for i in range(3):
+        tier.ensure(namespace_keys(
+            1, np.arange(100 + i * 8, 108 + i * 8, dtype=np.uint64)))
+        res = tier.tenant_residency()
+        assert res.get(1, 0) <= 8, res
+
+    st = tier.stats()
+    assert st["tenants"][1] <= 8              # per-tenant residency view
+    assert tier.counters["tenant_cap_evictions"] >= 16
+
+    # tenant 2's working set was NEVER collateral: re-touching it is
+    # all hits (no misses added — its rows stayed resident throughout)
+    misses_before = tier.stats()["misses"]
+    tier.ensure(t2_keys)
+    assert tier.stats()["misses"] == misses_before
+    assert tier.tenant_residency()[2] == 16
+
+    # an incoming batch larger than the cap can never fit: loud error,
+    # not silent thrash
+    with pytest.raises(Exception):
+        tier.ensure(namespace_keys(
+            1, np.arange(500, 512, dtype=np.uint64)))
+
+
+# ---------------------------------------------------------------------------
+# per-tenant control plane: SLO rules + scoped flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_tenant_slo_rules_fire_per_tenant_only(tmp_path):
+    import json
+
+    from paddle_tpu.obs import slo as slo_mod
+    from paddle_tpu.obs.registry import Registry
+    from paddle_tpu.obs.timeseries import MetricRing
+
+    reg = Registry()
+    ring = MetricRing()
+    g_a = reg.gauge("tenant_pull_s", max_series=8, tenant="ctr")
+    g_b = reg.gauge("tenant_pull_s", max_series=8, tenant="moe")
+    for i in range(4):
+        g_a.set(0.2)                          # ctr breaches 50 ms
+        g_b.set(0.001)                        # moe is healthy
+        ring.append(reg.snapshot(), t=float(i))
+
+    rules = tenant_slo_rules("ctr") + tenant_slo_rules("moe")
+    wd = slo_mod.SloWatchdog(ring, rules)
+    fired = {a.rule for a in wd.evaluate(now=3.0)}
+    assert "ctr_pull_p99" in fired
+    assert not any(r.startswith("moe_") for r in fired)
+
+    # the scoped recorder: a ctr postmortem bundle carries ONLY
+    # ctr-labeled alerts, and stamps its scope in the manifest
+    rec = tenant_flight_recorder(str(tmp_path), "ctr", ring=ring,
+                                 watchdog=wd, min_interval_s=0.0)
+    path = rec.trigger("tenant_slo")
+    assert path is not None and "tenant_ctr" in path
+    man = json.load(open(os.path.join(path, "manifest.json")))
+    assert man["scope"] == {"tenant": "ctr"}
+    alerts = json.load(open(os.path.join(path, "alerts.json")))["alerts"]
+    assert alerts, "scoped bundle dropped the tenant's own alerts"
+    assert all((a.get("labels") or {}).get("tenant") == "ctr"
+               for a in alerts)
+
+
+def test_tenant_autoscaler_lever_is_scoped():
+    """A per-tenant Autoscaler subscribes to ONE tenant's rules and
+    journals under its tenant tag — the per-tenant scaling lever."""
+    from paddle_tpu.ps.autoscale import AutoscaleConfig, Autoscaler
+    from tests.test_autoscale import _FakeController, _Alert
+
+    rules = tenant_slo_rules("ctr", pull_p99_s=0.05)
+    ctrl = _FakeController()
+    t = [0.0]
+    a = Autoscaler(ctrl, config=AutoscaleConfig(
+        min_shards=2, max_shards=8, cooldown_up_s=5.0,
+        cooldown_down_s=10.0, clear_hold_s=4.0,
+        up_rules=("ctr_pull_p99",)), clock=lambda: t[0], tenant="ctr")
+    a.notify_fire(_Alert("moe_pull_p99"))     # neighbor's burn: ignored
+    assert a.step() is None
+    a.notify_fire(_Alert(rules[0].name))
+    assert a.step() == "up"
+    assert a.events[-1]["tenant"] == "ctr"
+
+
+# ---------------------------------------------------------------------------
+# the interference e2e (slow): shared cluster, abusive neighbor
+# ---------------------------------------------------------------------------
+
+
+def _run_tenant_loop(cli, table, shape, stop, lat, push_every=0):
+    """One tenant's serving loop: pull `shape` keys; optionally push."""
+    rng = np.random.default_rng(hash(table) & 0xffff)
+    width = cli._dims(table)[1]
+    i = 0
+    while not stop.is_set():
+        keys = rng.integers(1, 2000, shape).astype(np.uint64)
+        t0 = time.perf_counter()
+        cli.pull_sparse(table, keys)
+        lat.append(time.perf_counter() - t0)
+        if push_every and i % push_every == 0:
+            push = np.zeros((len(keys), width), np.float32)
+            push[:, 1] = 1.0
+            cli.push_sparse(table, keys, push)
+        i += 1
+
+
+def _p99(xs):
+    return float(np.percentile(np.asarray(xs), 99)) if xs else 0.0
+
+
+@pytest.mark.slow
+def test_interference_e2e_abusive_tenant_cannot_move_neighbor_p99():
+    """Three well-behaved tenants + one deliberately abusive tenant on
+    ONE shared cluster: the abuser is throttled and quota-refused; each
+    well-behaved tenant's pull p99 stays within the CI-gated bound of
+    its solo baseline; per-tenant digests prove the abuser changed ZERO
+    bytes outside its own namespace."""
+    with ha.HACluster(num_shards=2, replication=1, sync=True) as cluster:
+        d = TenantDirectory(cluster)
+        wb_names = ["ctr", "moe", "tdm"]
+        shapes = {"ctr": 64, "moe": 16, "tdm": 8}
+        for i, name in enumerate(wb_names):
+            d.register(Tenant(name=name, tid=i + 1,
+                              token=name.encode()))
+        # the abuser: metered hard (shallow bucket) and row-capped
+        d.register(Tenant(name="abuse", tid=9, token=b"abuse", pclass=1,
+                          rate=500.0, burst=500.0, max_rows=500))
+
+        clis, tables = {}, {}
+        for name in wb_names + ["abuse"]:
+            cli = d.client(name)
+            t = d.get(name).table_id(0)
+            cli.create_sparse_table(t, _cfg())
+            width = cli._dims(t)[1]
+            keys = np.arange(1, 2001, dtype=np.uint64)
+            push = np.zeros((len(keys), width), np.float32)
+            push[:, 1] = 1.0
+            if name != "abuse":
+                cli.push_sparse(t, keys, push)
+            clis[name], tables[name] = cli, t
+        cluster.drain()
+
+        def measure(active, duration):
+            stop = threading.Event()
+            lats = {n: [] for n in active}
+            thr = [threading.Thread(
+                target=_run_tenant_loop,
+                args=(clis[n], tables[n], shapes[n], stop, lats[n]),
+                kwargs=dict(push_every=4 if n == "ctr" else 0),
+                daemon=True, name=f"tenant-{n}") for n in active]
+            for th in thr:
+                th.start()
+            time.sleep(duration)
+            stop.set()
+            for th in thr:
+                th.join(10)
+            return {n: _p99(v) for n, v in lats.items()}
+
+        def abuse_flood(stop):
+            """Fat pulls + row-creation churn + cross-tenant probes."""
+            cli, t = clis["abuse"], tables["abuse"]
+            rng = np.random.default_rng(7)
+            while not stop.is_set():
+                keys = rng.integers(1, 1 << 40, 512).astype(np.uint64)
+                try:
+                    cli.pull_sparse(t, keys, create=True)
+                except (ThrottledError, QuotaExceededError):
+                    pass
+                try:
+                    cli.size(tables["ctr"])   # cross-tenant probe
+                except WrongTenantError:
+                    pass
+
+        # solo baselines (abuser idle)
+        solo = measure(wb_names, 1.0)
+        digests_before = {n: clis[n].digest(tables[n])
+                          for n in wb_names}
+        rows_before = {n: d.usage(n)["rows"] for n in wb_names}
+
+        # contention: all three + the abusive flood
+        stop = threading.Event()
+        flood = threading.Thread(target=abuse_flood, args=(stop,),
+                                 daemon=True, name="tenant-abuse")
+        flood.start()
+        loaded = measure(wb_names, 1.5)
+        stop.set()
+        flood.join(10)
+
+        # the gate the bench CI-asserts too: p99 under abuse within
+        # 5× solo + 20 ms scheduling slack (loose on shared CI boxes;
+        # without admission control the abuser inflates this 100×)
+        for n in wb_names:
+            bound = 5.0 * solo[n] + 0.020
+            assert loaded[n] <= bound, \
+                (n, "p99 moved", solo[n], loaded[n], bound)
+
+        # the abuser was actually contained
+        au = d.usage("abuse")
+        assert au["throttled"] > 0, "flood never throttled"
+        # max_rows is PER SHARD (usage() aggregates): cap + one batch
+        # of overshoot on each of the two shards
+        assert au["rows"] <= 2 * (500 + 512)
+
+        # zero cross-tenant writes: each well-behaved namespace is
+        # digest-identical (the ctr pushes stopped before the digest)
+        for n in wb_names:
+            if n == "ctr":
+                continue                     # its own loop only pulls
+            assert clis[n].digest(tables[n]) == digests_before[n], n
+            assert d.usage(n)["rows"] == rows_before[n]

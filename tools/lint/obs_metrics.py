@@ -27,12 +27,32 @@ internals, generic re-export shims) are not creations at the call
 site and pass. Suppression: trailing
 ``# graftlint: ignore[metric-in-hot-path]``; known-bounded sites go in
 tools/lint/allow.txt with a justification.
+
+Second rule in this pass: ``unbounded-label`` — a label value drawn
+from an unbounded domain needs an EXPLICIT ``max_series=`` bound at
+the creation site. The registry clamps every family to
+``FLAGS_obs_max_series`` (64) as a last resort, but a site that feeds
+a per-key/per-user/per-request identifier into a label is designing
+for overflow: the series it actually wants get collapsed into the
+``overflow="true"`` bucket and the operator loses exactly the
+per-tenant/per-id breakdown the label was added for. The rule is
+syntactic: a creation call (same definition as above) where a label
+kwarg's VALUE expression references an identifier matching the
+unbounded-id pattern (``key``/``keys``/``user``/``uid``/``request``/
+``req``/``trace``/``span``/``endpoint``/``item``/``url``/``addr``/
+``id``/``ids`` as a whole ``_``-separated token — so ``uid``,
+``user_id``, ``request_id``, ``trace_id`` match; ``table``, ``tier``,
+``shard`` don't), or a ``**labels`` splat, with NO ``max_series=``
+kwarg on the call. Passing ``max_series=`` — ANY value — is the fix:
+it proves the author sized the family's cardinality on purpose.
+Suppression: ``# graftlint: ignore[unbounded-label]``.
 """
 
 from __future__ import annotations
 
 import ast
 import os
+import re
 import sys
 from typing import Dict, List, Tuple
 
@@ -44,8 +64,21 @@ from tracer_safety import (FuncDef, ModuleInfo, _callees,  # noqa: E402
                            _marked)
 
 RULE = "metric-in-hot-path"
+RULE_LABEL = "unbounded-label"
 _CREATORS = {"counter", "gauge", "histogram"}
 _CTOR = "CounterGroup"
+
+#: identifiers (as whole ``_``-separated tokens anywhere in the dotted
+#: name) whose domain is unbounded by construction: feature keys, user
+#: / request / trace identities, endpoints. ``id`` is the deliberate
+#: wide net — ``job_id``/``trace_id``/``span_id`` label values churn
+#: forever; a genuinely bounded id label states its bound via
+#: ``max_series=`` and the rule stands down.
+_UNBOUNDED_ID = re.compile(
+    r"(?:^|_)(?:key|keys|user|uid|request|req|trace|span|endpoint|"
+    r"item|url|addr|id|ids)(?:_|$)")
+#: kwargs on a creation call that are NOT labels
+_NONLABEL_KW = {"max_series", "buckets"}
 
 
 def _is_creation(node: ast.AST) -> bool:
@@ -62,10 +95,52 @@ def _is_creation(node: ast.AST) -> bool:
 
 
 def _emit(mi: ModuleInfo, node: ast.AST, msg: str,
-          out: List[Diagnostic]) -> None:
+          out: List[Diagnostic], rule: str = RULE) -> None:
     line = getattr(node, "lineno", 1)
-    if RULE not in line_ignores(mi.source_lines, line):
-        out.append(Diagnostic(mi.path, line, RULE, msg))
+    if rule not in line_ignores(mi.source_lines, line):
+        out.append(Diagnostic(mi.path, line, rule, msg))
+
+
+def _unbounded_labels(node: ast.Call) -> List[str]:
+    """Offending label kwargs on a creation call: value expression
+    references an unbounded-domain identifier (or is a ``**labels``
+    splat) and the call carries no explicit ``max_series=``."""
+    if any(kw.arg == "max_series" for kw in node.keywords):
+        return []
+    hits: List[str] = []
+    for kw in node.keywords:
+        if kw.arg in _NONLABEL_KW:
+            continue
+        if kw.arg is None:  # **labels: caller-controlled, unbounded
+            hits.append("**" + (dotted(kw.value) or "labels"))
+            continue
+        for sub in ast.walk(kw.value):
+            ident = (sub.id if isinstance(sub, ast.Name)
+                     else sub.attr if isinstance(sub, ast.Attribute)
+                     else None)
+            if ident is not None and _UNBOUNDED_ID.search(ident):
+                hits.append(f"{kw.arg}={ident}")
+                break
+    return hits
+
+
+def _scan_labels(mi: ModuleInfo) -> List[Diagnostic]:
+    """unbounded-label: every creation call in the module, any scope —
+    an unbounded label value is wrong at constructor scope too (the
+    overflow happens across calls, not within a loop)."""
+    diags: List[Diagnostic] = []
+    for node in ast.walk(mi.tree):
+        if not _is_creation(node):
+            continue
+        for hit in _unbounded_labels(node):
+            _emit(mi, node,
+                  f"label `{hit}` draws from an unbounded domain with no "
+                  f"explicit max_series= on the creation — the family "
+                  "will collapse into the overflow series exactly when "
+                  "the breakdown matters; size the cardinality "
+                  "(max_series=N) or drop the label",
+                  diags, rule=RULE_LABEL)
+    return diags
 
 
 def _scan_loops(mi: ModuleInfo) -> List[Diagnostic]:
@@ -142,6 +217,7 @@ def run(root: str, subdirs=("paddle_tpu",), files=("bench.py",),
     diags: List[Diagnostic] = []
     for mi in modules:
         diags.extend(_scan_loops(mi))
+        diags.extend(_scan_labels(mi))
 
     # the same hot-path closure as tracer_safety.run_hot_path: roots
     # marked `# graftlint: hot-path`, stopping at `# graftlint: cold-path`

@@ -149,6 +149,15 @@ CONTRACT: Dict[str, CmdSpec] = {
     "kDenseRestore": CmdSpec(42, ("rpc", "_DENSE_RESTORE"), tap="yes"),
     "kObsSnap": CmdSpec(43, ("rpc", "_OBS_SNAP"), local_only=True),
     "kRetain": CmdSpec(44, ("rpc", "_RETAIN"), tap="cond", gate="cond"),
+    # multi-tenancy (ps/tenancy.py): hello binds a connection to its
+    # tenant; config is the operator-plane registry/usage-meter. Both
+    # are pure control plane — never tapped, never gated, and config is
+    # local_only (the tenant registry is per-server state an operator
+    # installs on every shard; it must not ride the oplog to backups
+    # that may serve a different tenant set).
+    "kTenantHello": CmdSpec(45, ("rpc", "_TENANT_HELLO")),
+    "kTenantConfig": CmdSpec(46, ("rpc", "_TENANT_CONFIG"),
+                             local_only=True),
 }
 
 # quantized-payload wire flags (csrc PushWireFlag — kPushSparse aux
@@ -173,6 +182,9 @@ ERR_CONTRACT: Dict[str, Tuple[int, Optional[Tuple[str, str]]]] = {
     "kErrSeqGap": (-6, ("ha", "_rpc_err_seq_gap")),
     "kErrReadOnly": (-7, ("raise", "PreconditionNotMetError")),
     "kErrWrongShard": (-8, ("raise", "WrongShardError")),
+    "kErrWrongTenant": (-9, ("raise", "WrongTenantError")),
+    "kErrQuota": (-10, ("raise", "QuotaExceededError")),
+    "kErrThrottled": (-11, ("raise", "ThrottledError")),
 }
 
 _CTYPE_FMT = {"uint64_t": "Q", "int64_t": "q", "uint32_t": "I",

@@ -1,0 +1,285 @@
+"""Multi-tenant interference bench (ISSUE 19 acceptance; TENANCY.json).
+
+The workload zoo runs as CONCURRENT TENANTS of one shared HACluster —
+each under its own wire-enforced namespace, admission budget and quota
+(ps/tenancy.py + the csrc tenancy fence):
+
+- **ctr** — streaming CTR: pulls of 64 keys with a push every 4th
+  round (the Wide&Deep trainer's wire shape).
+- **moe** — routed-MoE: small skewed pulls (16 keys, zipf-ish routing
+  concentrated on hot experts).
+- **gnn** — graph_table: neighbor sampling over a DistGraphClient on
+  the tenant's namespaced graph table.
+- **tdm** — TDM retrieval: a 3-level beam descent of small sequential
+  pulls (8 keys per level) — latency-critical, dependency-chained.
+- **abuse** — the deliberately abusive neighbor: fat 1024-key
+  create-on-miss pulls as fast as the socket allows, row-creation
+  churn against its quota, plus cross-tenant probes that must bounce.
+
+Protocol, three phases: each well-behaved tenant runs SOLO for a
+reference p99; all four run together WITHOUT the abuser (``shared`` —
+the honest multi-tenancy baseline: on a small CI box the four zoo
+loops already contend for cores); then the same four run WITH the
+abusive flood (``abused``). The metric is the worst per-tenant
+abused/shared p99 ratio — the abuser's MARGINAL damage, which is what
+admission control owns (solo→shared movement is CPU scheduling, not
+isolation). ci.sh's tenancy gate asserts abused p99 ≤ RATIO× shared +
+SLACK ms per tenant; the committed TENANCY.json is a quiet-host run.
+The bench also proves the negative: the abuser's meter shows
+throttles (and quota refusals once its namespace fills), its rows
+stay ≤ per-shard cap + one batch per shard, and every well-behaved
+namespace is digest-identical across an abuse-only flood (zero
+cross-tenant row writes).
+
+Standalone: prints exactly ONE JSON line (driver contract).
+Importable: ``run()`` returns the record. Env knobs: TB_SHARDS (2),
+TB_SOLO_S (0.7 per tenant), TB_LOAD_S (1.5 per loaded phase),
+TB_ABUSE_RATE (500 token cost units/s/shard), TB_ABUSE_BURST (1500 —
+above one fat frame's cost, so the flood LANDS bursts before the
+bucket clamps it), TB_ABUSE_ROWS (1000 rows/shard).
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+METRIC = "tenancy_p99_isolation_ratio"
+
+
+def _pct(xs, q):
+    import numpy as np
+
+    return float(np.percentile(np.asarray(xs), q)) if len(xs) else 0.0
+
+
+def run() -> dict:
+    import jax
+    import numpy as np
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from paddle_tpu.core.enforce import (QuotaExceededError, ThrottledError,
+                                         WrongTenantError)
+    from paddle_tpu.ps import ha
+    from paddle_tpu.ps.accessor import AccessorConfig
+    from paddle_tpu.ps.graph_client import DistGraphClient
+    from paddle_tpu.ps.sgd_rule import SGDRuleConfig
+    from paddle_tpu.ps.table import TableConfig
+    from paddle_tpu.ps.tenancy import Tenant, TenantDirectory
+
+    shards = int(os.environ.get("TB_SHARDS", 2))
+    solo_s = float(os.environ.get("TB_SOLO_S", 0.7))
+    load_s = float(os.environ.get("TB_LOAD_S", 1.5))
+    abuse_rate = float(os.environ.get("TB_ABUSE_RATE", 500.0))
+    abuse_burst = float(os.environ.get("TB_ABUSE_BURST", 1500.0))
+    abuse_rows = int(os.environ.get("TB_ABUSE_ROWS", 1000))
+
+    def cfg():
+        return TableConfig(
+            shard_num=4, accessor_config=AccessorConfig(
+                sgd=SGDRuleConfig(initial_range=0.0)))
+
+    with ha.HACluster(num_shards=shards, replication=1,
+                      sync=True) as cluster:
+        d = TenantDirectory(cluster)
+        d.register(Tenant(name="ctr", tid=1, token=b"ctr"))
+        d.register(Tenant(name="moe", tid=2, token=b"moe"))
+        d.register(Tenant(name="gnn", tid=3, token=b"gnn"))
+        d.register(Tenant(name="tdm", tid=4, token=b"tdm"))
+        d.register(Tenant(name="abuse", tid=9, token=b"abuse", pclass=1,
+                          rate=abuse_rate, burst=abuse_burst,
+                          max_rows=abuse_rows))
+
+        clis = {n: d.client(n) for n in
+                ("ctr", "moe", "gnn", "tdm", "abuse")}
+        tables = {n: d.get(n).table_id(0) for n in clis}
+
+        # -- populate each tenant's namespace --------------------------
+        def fill(name, n_keys):
+            cli, t = clis[name], tables[name]
+            cli.create_sparse_table(t, cfg())
+            keys = np.arange(1, n_keys + 1, dtype=np.uint64)
+            width = cli._dims(t)[1]
+            push = np.zeros((len(keys), width), np.float32)
+            push[:, 1] = 1.0
+            cli.push_sparse(t, keys, push)
+
+        fill("ctr", 4000)
+        fill("moe", 4096)
+        fill("tdm", 1024)
+        clis["abuse"].create_sparse_table(tables["abuse"], cfg())
+        # gnn: a namespaced graph table + a small power-lawish graph
+        graph = DistGraphClient(clis["gnn"], table_id=d.get(
+            "gnn").table_id(1), shard_num=8)
+        rng = np.random.default_rng(0)
+        nodes = np.arange(1, 2001, dtype=np.uint64)
+        graph.add_graph_node(nodes)
+        graph.add_edges(rng.choice(nodes, 8000), rng.choice(nodes, 8000))
+        cluster.drain()
+
+        # -- the zoo's per-tenant request shapes -----------------------
+        def op_ctr(i, rng):
+            keys = rng.integers(1, 4000, 64).astype(np.uint64)
+            clis["ctr"].pull_sparse(tables["ctr"], keys)
+            if i % 4 == 0:
+                width = clis["ctr"]._dims(tables["ctr"])[1]
+                push = np.zeros((len(keys), width), np.float32)
+                push[:, 1] = 1.0
+                clis["ctr"].push_sparse(tables["ctr"], keys, push)
+
+        def op_moe(i, rng):
+            # routing concentrates on hot experts (low ids)
+            experts = np.minimum(
+                rng.zipf(1.3, 16), 4095).astype(np.uint64) + 1
+            clis["moe"].pull_sparse(tables["moe"], experts)
+
+        def op_gnn(i, rng):
+            seeds = rng.choice(nodes, 16)
+            graph.sample_neighbors(seeds, 8)
+
+        def op_tdm(i, rng):
+            # beam descent: 3 dependency-chained levels of 8
+            for _ in range(3):
+                keys = rng.integers(1, 1024, 8).astype(np.uint64)
+                clis["tdm"].pull_sparse(tables["tdm"], keys)
+
+        ops = {"ctr": op_ctr, "moe": op_moe, "gnn": op_gnn,
+               "tdm": op_tdm}
+        wb = list(ops)
+
+        def loop(name, stop, lat):
+            rng = np.random.default_rng(abs(hash(name)) & 0xffff)
+            i = 0
+            while not stop.is_set():
+                t0 = time.perf_counter()
+                ops[name](i, rng)
+                lat.append(time.perf_counter() - t0)
+                i += 1
+
+        def abuse_flood(stop, counters):
+            cli, t = clis["abuse"], tables["abuse"]
+            rng = np.random.default_rng(7)
+            while not stop.is_set():
+                keys = rng.integers(1, 1 << 40, 1024).astype(np.uint64)
+                try:
+                    cli.pull_sparse(t, keys, create=True)
+                    counters["landed"] += 1
+                except ThrottledError:
+                    counters["throttled"] += 1
+                except QuotaExceededError:
+                    counters["quota"] += 1
+                try:
+                    cli.size(tables["ctr"])
+                    counters["breach"] += 1       # must never happen
+                except WrongTenantError:
+                    counters["bounced"] += 1
+
+        def measure(names, duration, with_abuse, counters):
+            stop = threading.Event()
+            lats = {n: [] for n in names}
+            thr = [threading.Thread(target=loop, args=(n, stop, lats[n]),
+                                    daemon=True, name=f"tenant-{n}")
+                   for n in names]
+            if with_abuse:
+                thr.append(threading.Thread(target=abuse_flood,
+                                            args=(stop, counters),
+                                            daemon=True,
+                                            name="tenant-abuse"))
+            for th in thr:
+                th.start()
+            time.sleep(duration)
+            stop.set()
+            for th in thr:
+                th.join(15)
+            return lats
+
+        def summarize(lats):
+            return {n: {"p50_ms": round(_pct(v, 50) * 1e3, 3),
+                        "p99_ms": round(_pct(v, 99) * 1e3, 3),
+                        "ops": len(v)}
+                    for n, v in lats.items()}
+
+        # -- solo references (one tenant at a time, abuser idle) -------
+        solo = {}
+        for n in wb:
+            solo.update(summarize(measure([n], solo_s, False, None)))
+
+        # -- shared baseline: the whole zoo, abuser idle ---------------
+        shared = summarize(measure(wb, load_s, False, None))
+
+        digests = {n: clis[n].digest(tables[n]) for n in ("moe", "tdm")}
+        rows_before = {n: d.usage(n)["rows"] for n in wb}
+
+        # -- the whole zoo + the abusive flood -------------------------
+        counters = {"landed": 0, "throttled": 0, "quota": 0,
+                    "bounced": 0, "breach": 0}
+        abused = summarize(measure(wb, load_s, True, counters))
+
+        ratios = {n: round(abused[n]["p99_ms"]
+                           / max(shared[n]["p99_ms"], 1e-3), 2)
+                  for n in wb}
+        worst = max(ratios.values())
+
+        # -- digest proof: an abuse-only flood writes ZERO foreign rows
+        stop = threading.Event()
+        fl = threading.Thread(target=abuse_flood, args=(stop, counters),
+                              daemon=True, name="tenant-abuse2")
+        fl.start()
+        time.sleep(0.5)
+        stop.set()
+        fl.join(15)
+        digest_stable = all(clis[n].digest(tables[n]) == digests[n]
+                            for n in ("moe", "tdm"))
+        rows_after = {n: d.usage(n)["rows"] for n in wb}
+
+        au = d.usage("abuse")
+        usage = d.refresh_usage()
+
+        return {
+            "metric": METRIC,
+            "value": worst,
+            "unit": "x",
+            "tenants": {n: {"solo": solo[n], "shared": shared[n],
+                            "abused": abused[n],
+                            "p99_ratio": ratios[n]} for n in wb},
+            "abuse": {
+                "flood": counters,
+                "usage": au,
+                "rows_cap_per_shard": abuse_rows,
+                "rows_within_cap": au["rows"] <= shards * (abuse_rows
+                                                           + 1024),
+                "rate_units_per_s_per_shard": abuse_rate,
+                "burst_units_per_shard": abuse_burst,
+            },
+            "isolation": {
+                "cross_tenant_probes_bounced": counters["bounced"],
+                "cross_tenant_breaches": counters["breach"],
+                "digest_stable_under_abuse": bool(digest_stable),
+                "wb_rows_unchanged": rows_after == rows_before,
+            },
+            "billing": {n: usage[n] for n in usage},
+            "shards": shards,
+            "solo_s": solo_s,
+            "load_s": load_s,
+            "platform": jax.devices()[0].platform,
+            "host_cores": os.cpu_count(),
+        }
+
+
+def main() -> None:
+    try:
+        rec = run()
+    except Exception as e:  # noqa: BLE001 — one-JSON-line contract
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        rec = {"metric": METRIC, "value": 0.0,
+               "error": f"{type(e).__name__}: {e}"[:300]}
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
