@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # CI gate (reference L0's cmake+ctest role): graftlint, native build,
 # fast test gate, then the full matrix.
-# Usage: ./ci.sh [lint [--changed]|sched|fast|full|chaos|ckpt|hot_tier|serving|serving_fleet|recsys|obs|slo|reshard|endurance|tenancy]
+# Usage: ./ci.sh [lint [--changed]|sched|fast|full|chaos|ckpt|hot_tier|serving|serving_fleet|recsys|obs|slo|reshard|reconcile|endurance|tenancy]
 #   sched — graftsched gate: deterministic-schedule exploration of the
 #   control-plane protocol harnesses (tools/sched/models.py) — the
 #   preemption-bound-2 schedule space EXHAUSTED plus seeded random
@@ -54,6 +54,14 @@
 #   alert clears and it shrinks back — RESHARD.json records the
 #   cutover pause p50/p95 (asserted well under the full-copy bootstrap
 #   time) and the scale-event journal.
+#   reconcile — declarative-control-plane gate: the spec/reconciler/
+#   simulator suite incl. the slow compound-transition chaos e2e
+#   (canary open + grow 2→4 as ONE spec update with a kill-shard armed
+#   mid-bootstrap, bit-identical to a sequential direct-primitive
+#   oracle), then the game-day chaos schedule (tools/gameday.py —
+#   every transition driven by writing desired state; GAMEDAY.json is
+#   the committed artifact) and the policy simulator replaying both
+#   committed traces at 1000-shard scale in well under a minute.
 #   tenancy — multi-tenant isolation gate: the full tenancy suite
 #   (wire-enforced namespaces, weighted admission, per-tenant quotas,
 #   tenant-scoped control plane — incl. the slow abusive-neighbor
@@ -74,7 +82,7 @@ cd "$(dirname "$0")"
 # noisy pass is visible in the log; run.py itself warns past the 10 s
 # soft budget. `./ci.sh lint --changed` lints only files changed vs
 # merge-base(HEAD, origin/main) — the sub-second pre-commit loop.
-echo "== graftlint (9 passes: tracer/hot-path/locks-cc/locks-py/wire/conv/obs/loops/sync-shim) =="
+echo "== graftlint (10 passes: tracer/hot-path/locks-cc/locks-py/wire/conv/obs/loops/sync-shim/actuation) =="
 LINT_JSON=${LINT_JSON:-/tmp/ci_lint_summary.json}
 # --changed is a lint-mode-only knob: the full gates must always lint
 # the whole tree (staleness + cross-module reachability need it)
@@ -479,6 +487,69 @@ print('reshard demo OK: wave fired %s, grow pause %.0fms vs copy '
   exit 0
 fi
 
+if [[ "${1:-fast}" == "reconcile" ]]; then
+  echo "== reconcile gate: declarative control plane (spec/reconciler/simulator) =="
+  # -m "" includes the slow compound-transition chaos e2e: canary open
+  # + grow 2→4 proposed as ONE spec update, kill-shard mid-bootstrap,
+  # digests/params bit-identical to a sequential direct-primitive oracle
+  python -m pytest tests/test_reconcile.py -q -m ""
+  echo "== game-day chaos schedule (spec-driven drill, armed faultpoints) =="
+  # grow-under-fire / canary open+rollback via spec / shrink back —
+  # every transition written as desired state, the journal must close
+  # the loop on every step and the content digest must round-trip
+  check_gameday() {
+    PYTHONPATH="$PWD:${PYTHONPATH:-}" JAX_PLATFORMS=cpu \
+      GAMEDAY_OUT=${GAMEDAY_OUT:-/tmp/ci_gameday.json} \
+      python tools/gameday.py | python -c "
+import json, sys
+d = json.loads([l for l in sys.stdin.read().splitlines()
+                if l.startswith('{')][-1])
+assert 'error' not in d, d
+assert d['digest_ok'] and d['traffic']['errors'] == 0, d
+assert d['shards_final'] == 2, d
+assert d['promotions'] >= 1, d   # the kill really fired mid-grow
+steps = {s['step'] for s in d['schedule']}
+assert steps == {'grow_under_fire', 'canary_open', 'canary_rollback',
+                 'shrink'}, steps
+assert all(s['converged'] for s in d['schedule']), d['schedule']
+print('gameday OK: %d schedule steps converged, %d promotions under '
+      'fire, digest round-tripped, %d pulls 0 errors (%.1fs)'
+      % (len(d['schedule']), d['promotions'], d['traffic']['pulls'],
+         d['wall_s']))"
+  }
+  check_gameday || { echo "gameday retry (ambient-load outlier)"; \
+    check_gameday; }
+  echo "== policy simulator (committed traces, 1000-shard scale) =="
+  # the acceptance case: the stock policy rides RESHARD.json's diurnal
+  # wave cleanly AND a hysteresis inversion is caught as oscillation —
+  # both replays must finish inside the wall budget
+  PYTHONPATH="$PWD:${PYTHONPATH:-}" JAX_PLATFORMS=cpu python -c "
+from paddle_tpu.ps.autoscale import AutoscaleConfig
+from paddle_tpu.ps.simulate import (diurnal_wave_profile,
+                                    flash_crowd_profile, simulate)
+stock = simulate(AutoscaleConfig(min_shards=256, max_shards=1024),
+                 diurnal_wave_profile('RESHARD.json', base_shards=512))
+assert stock.wall_s < 60.0 and stock.max_shards_seen() == 1024, vars(stock)
+assert stock.oscillations(15.0) == 0, stock.scale_events
+broken = simulate(AutoscaleConfig(min_shards=256, max_shards=1024,
+                                  cooldown_up_s=0.0, cooldown_down_s=0.0,
+                                  clear_hold_s=0.0),
+                  diurnal_wave_profile('RESHARD.json', base_shards=512),
+                  fire_after_ticks=1, clear_after_ticks=1)
+assert broken.oscillations(15.0) >= 5, broken.scale_events
+flash = simulate(AutoscaleConfig(min_shards=256, max_shards=1024),
+                 flash_crowd_profile('RECSYS_E2E.json', base_shards=256))
+assert flash.wall_s < 60.0 and flash.oscillations(15.0) == 0, vars(flash)
+print('simulator OK: diurnal %d ticks %.3fs wall (peak %d, 0 osc), '
+      'inverted hysteresis caught (%d rapid reversals), flash crowd '
+      'peak %d -> final %d'
+      % (stock.ticks, stock.wall_s, stock.max_shards_seen(),
+         broken.oscillations(15.0), flash.max_shards_seen(),
+         flash.final_shards))"
+  echo "CI OK (reconcile)"
+  exit 0
+fi
+
 if [[ "${1:-fast}" == "obs" ]]; then
   echo "== obs gate: unified observability plane =="
   python -m pytest tests/test_obs.py -q -m ""
@@ -742,6 +813,7 @@ print('sync shim pass-through OK (sanitizer sees raw primitives)')"
       tests/test_recsys_pipeline.py \
       tests/test_obs.py tests/test_slo.py tests/test_flightrec.py \
       tests/test_reshard.py tests/test_autoscale.py \
+      tests/test_reconcile.py \
       tests/test_sparse_wire.py tests/test_tenancy.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_tsan_report* 2>/dev/null; then
     echo "TSAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_tsan_report*)"
@@ -777,6 +849,7 @@ print('sync shim pass-through OK (sanitizer sees raw primitives)')"
       tests/test_recsys_pipeline.py \
       tests/test_obs.py tests/test_slo.py tests/test_flightrec.py \
       tests/test_reshard.py tests/test_autoscale.py \
+      tests/test_reconcile.py \
       tests/test_sparse_wire.py tests/test_tenancy.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_asan_report* 2>/dev/null; then
     echo "ASAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_asan_report*)"
@@ -811,6 +884,7 @@ print('sync shim pass-through OK (sanitizer sees raw primitives)')"
       tests/test_recsys_pipeline.py \
       tests/test_obs.py tests/test_slo.py tests/test_flightrec.py \
       tests/test_reshard.py tests/test_autoscale.py \
+      tests/test_reconcile.py \
       tests/test_sparse_wire.py tests/test_tenancy.py -q -m ""
   if grep -l "libpaddle_tpu_native" /tmp/ci_ubsan_report* 2>/dev/null; then
     echo "UBSAN: reports implicate libpaddle_tpu_native.so (see /tmp/ci_ubsan_report*)"

@@ -286,8 +286,25 @@ class PlacementManager:
         with self._mu:
             self._fence_gen += 1
 
+    def armed(self) -> Optional[str]:
+        """The queued swap target, None when nothing is armed (the
+        reconciler's in-flight check — it must not re-arm a pending
+        swap every tick)."""
+        with self._mu:
+            return self._armed
+
+    def set_proposer(self, proposer) -> "PlacementManager":
+        """Demote the auto policy to a spec PROPOSER: with a Reconciler
+        (ps/reconcile.py) wired in, :meth:`decide` writes the desired
+        plane into the ClusterSpec (propose_placement) instead of
+        arming directly — the actuator arms and fences serially with
+        every other transition."""
+        self._proposer = proposer
+        return self
+
     def decide(self) -> Optional[str]:
-        """Run the policy against the active series; arms the result.
+        """Run the policy against the active series; arms the result
+        (or proposes it, when a reconciler proposer is wired in).
         Densify decisions on tables that cannot take local residence
         (SSD cold tiers) are dropped, not raised — the auto loop runs
         on the training thread."""
@@ -295,6 +312,11 @@ class PlacementManager:
         if tgt == "collective" and not self._collective_capable():
             return None
         if tgt is not None:
+            proposer = getattr(self, "_proposer", None)
+            if proposer is not None:
+                proposer.propose_placement(str(self._table_id), tgt,
+                                           origin="placement")
+                return tgt
             self.arm(tgt)
         return tgt
 

@@ -62,6 +62,7 @@ BUNDLE_PREFIX = "postmortem_"
 DEFAULT_DUMP_ON = frozenset({
     "failover_promotion", "breaker_open", "faultpoint",
     "trainer_exception", "serving_exception", "sigterm",
+    "reconcile_stall", "spec_abort",
 })
 
 

@@ -344,6 +344,14 @@ def default_rules(step_p95_s: float = 1.0,
         SloRule("replication_lag", "ps_replication_lag_entries",
                 kind="threshold", agg="max", threshold=repl_lag_entries,
                 windows=((short_s, 1.0),)),
+        # the reconciler diffing observed != desired for this many
+        # consecutive ticks means an actuation is wedged (or a proposer
+        # wrote unreachable state) — the reconciler also dumps a
+        # flight-recorder bundle with the spec diff when it crosses its
+        # own stall threshold; the rule makes the condition page
+        SloRule("reconcile_stall", "reconcile_stall_ticks",
+                kind="threshold", agg="max", threshold=8.0,
+                windows=((short_s, 1.0),), min_count=1),
         SloRule("checkpoint_staleness", "job_checkpoint_last_wall_s",
                 kind="threshold", agg="age", threshold=checkpoint_age_s,
                 windows=((short_s, 1.0),)),

@@ -101,9 +101,17 @@ class Autoscaler:
                  ring=None,
                  clock: Callable[[], float] = time.monotonic,
                  poll_s: float = 0.25,
-                 tenant: Optional[str] = None) -> None:
+                 tenant: Optional[str] = None,
+                 proposer=None) -> None:
         self.controller = controller
         self.config = config or AutoscaleConfig()
+        #: when a Reconciler (ps/reconcile.py) is wired in, this loop
+        #: is a spec PROPOSER: a scale decision writes the desired
+        #: shard count through proposer.propose_shards and the single
+        #: serialized actuator runs the reshard. Without one (None,
+        #: standalone deployments) the legacy direct-actuation branch
+        #: in _execute stays live.
+        self.proposer = proposer
         #: tenant whose SLO lever this instance answers to (multi-tenant
         #: clusters run one Autoscaler per tenant, each subscribed to
         #: that tenant's labeled rules — ps/tenancy.py tenant_slo_rules;
@@ -230,12 +238,14 @@ class Autoscaler:
     def _execute(self, direction: str, from_n: int, to_n: int
                  ) -> Optional[str]:
         cfg = self.config
+        if self.proposer is not None:
+            return self._propose(direction, from_n, to_n)
         try:
             if direction == "up":
-                rec = self.controller.grow(cfg.factor)
+                rec = self.controller.grow(cfg.factor)  # graftlint: actuate-ok standalone mode — no reconciler wired, this loop is the sole actuator
                 self._c_up.inc()
             else:
-                rec = self.controller.shrink(cfg.factor)
+                rec = self.controller.shrink(cfg.factor)  # graftlint: actuate-ok standalone mode — no reconciler wired, this loop is the sole actuator
                 self._c_down.inc()
         except Exception as e:  # noqa: BLE001 — journaled, cooled down
             self.errors += 1
@@ -257,6 +267,32 @@ class Autoscaler:
                                     cfg.elastic_job_id, want_np)
             self._journal({"kind": "trainer_target", "np": want_np,
                            "shards": to_n})
+        return direction
+
+    def _propose(self, direction: str, from_n: int, to_n: int
+                 ) -> Optional[str]:
+        """Proposer mode: write the desired shard count into the
+        ClusterSpec and let the reconciler's actuator run the reshard.
+        The cooldown starts at PROPOSAL time (the decision, not the
+        cutover, is what hysteresis paces); SpecStore's no-op dedup
+        keeps an idempotent re-proposal from churning spec versions."""
+        cfg = self.config
+        try:
+            spec = self.proposer.propose_shards(to_n, origin="autoscaler")
+        except Exception as e:  # noqa: BLE001 — journaled, cooled down
+            self.errors += 1
+            self._journal({"kind": "scale_failed", "direction": direction,
+                           "from_shards": from_n, "to_shards": to_n,
+                           "error": f"{type(e).__name__}: {e}",
+                           **self._context()})
+            self._last_scale_t = self._clock()
+            return None
+        (self._c_up if direction == "up" else self._c_down).inc()
+        self._last_scale_t = self._clock()
+        self._journal({"kind": "scale_proposed", "direction": direction,
+                       "from_shards": from_n, "to_shards": to_n,
+                       "spec_version": spec.version,
+                       **self._context()})
         return direction
 
     # -- worker ------------------------------------------------------------

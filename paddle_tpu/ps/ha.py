@@ -52,6 +52,7 @@ RAM-table-only (the runbook's restore flow covers SSD).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import random
 import struct
@@ -59,9 +60,12 @@ import struct
 # every mutex here is a LEAF — breaker/coordinator/server `_mu`, the
 # coordinator's `_step_mu` and `_susp_mu` guard small in-memory state
 # and may never nest another lock or block. The cluster-wide
-# `control_mu` (RLock) is the control plane's OUTERMOST lock: reshard
-# cutovers and checkpoint gates serialize under it before touching any
-# server state.
+# `control_mu` (RLock) is the control plane's innermost NON-leaf lock:
+# reshard cutovers and checkpoint gates serialize under it (always via
+# HACluster.begin_actuation/end_actuation, which pairs it with
+# coordinator suspension) before touching any server state; the
+# reconciler's actuator mutex (`_act_mu`, ps/reconcile.py) sits above
+# it.
 # LOCK ORDER: control_mu < _mu
 # LOCK LEAF: _mu _step_mu _susp_mu
 import threading
@@ -928,40 +932,21 @@ class CheckpointGate:
                 for si in range(len(shards))]
 
     def __enter__(self) -> "CheckpointGate":
-        self._locked = False
-        self._suspended_coord = None
+        self._in_actuation = False
         if self.cluster is not None:
-            # suspend failover scans FIRST (mirrors the reshard
-            # cutover): control_mu serializes against other control
-            # operations, but the coordinator's scan loop never takes
-            # it — a promotion landing mid-capture re-routes the shard
-            # onto an UNPAUSED backup, and both this gate's re-resolved
-            # capture stream and the (unpaused) writers follow it: a
-            # torn cut. suspend() is depth-counted, so nesting inside a
-            # cutover's own suspension is safe; the ordering (suspend
-            # BEFORE control_mu) keeps the barrier in suspend() from
-            # waiting on a scan that is itself… impossible, since scans
-            # never take control_mu — but it also bounds the suspension
-            # to exactly the window we hold the mutex
-            coord = getattr(self.cluster, "coordinator", None)
-            if coord is not None:
-                coord.suspend()
-                self._suspended_coord = coord
-            # serialize against a reshard cutover (cluster.control_mu):
-            # the depth-counted pauses NEST fine, but a capture
-            # interleaved with the cutover's retain would snapshot a
-            # half-migrated key set — rows already dropped from the
-            # source shard while this capture's client still routes to
-            # it. Taking the mutex ALSO pins the shard set for the
-            # whole `with gate:` block (targets can't move mid-capture)
-            try:
-                self.cluster.control_mu.acquire()
-                self._locked = True
-            except BaseException:
-                if self._suspended_coord is not None:
-                    self._suspended_coord = None
-                    coord.resume_scans()
-                raise
+            # the cluster-wide actuation critical section
+            # (HACluster.begin_actuation — suspend failover scans, then
+            # control_mu): a capture interleaved with a reshard
+            # cutover's retain step would snapshot a half-migrated key
+            # set, and a promotion landing mid-capture would re-route
+            # the shard onto an UNPAUSED backup — a torn cut either
+            # way. Both suspend() and control_mu are reentrant, so a
+            # gate nested inside a cutover (or the reconciler's
+            # actuator) is safe. Holding it ALSO pins the shard set for
+            # the whole `with gate:` block (targets can't move
+            # mid-capture).
+            self.cluster.begin_actuation()
+            self._in_actuation = True
         paused = []
         try:
             for srv in self._targets():
@@ -975,12 +960,9 @@ class CheckpointGate:
         except BaseException:
             for srv in reversed(paused):
                 srv.pause_mutations(False)
-            if self._locked:
-                self._locked = False
-                self.cluster.control_mu.release()
-            coord, self._suspended_coord = self._suspended_coord, None
-            if coord is not None:
-                coord.resume_scans()
+            if self._in_actuation:
+                self._in_actuation = False
+                self.cluster.end_actuation()
             raise
         self._paused = paused
         return self
@@ -989,13 +971,9 @@ class CheckpointGate:
         paused, self._paused = self._paused, []
         for srv in reversed(paused):
             srv.pause_mutations(False)
-        if getattr(self, "_locked", False):
-            self._locked = False
-            self.cluster.control_mu.release()
-        coord = getattr(self, "_suspended_coord", None)
-        self._suspended_coord = None
-        if coord is not None:
-            coord.resume_scans()
+        if getattr(self, "_in_actuation", False):
+            self._in_actuation = False
+            self.cluster.end_actuation()
 
 
 # ---------------------------------------------------------------------------
@@ -1316,7 +1294,12 @@ class HACluster:
         #: a capture interleaved with the cutover's retain step would
         #: snapshot a half-migrated key set (rows already dropped from
         #: the source while the capture client still routes to it).
-        #: RLock: a holder's nested gate may re-acquire.
+        #: RLock: a holder's nested gate may re-acquire. Taken ONLY
+        #: through :meth:`begin_actuation`/:meth:`end_actuation` (the
+        #: compound primitive that pairs it with coordinator
+        #: suspension) — never raw; the reconciler's actuator
+        #: (ps/reconcile.py) sequences all compound transitions above
+        #: it under its own ``_act_mu``.
         self.control_mu = _sync.RLock()
         shards_doc = []
         for si in range(num_shards):
@@ -1336,6 +1319,51 @@ class HACluster:
             self.store, job_id, grace_s=grace_s,
             poll_s=coordinator_poll_s).start()
         self._clients: List[RpcPsClient] = []
+
+    # -- the actuation primitive ------------------------------------------
+
+    def begin_actuation(self) -> None:
+        """Enter the cluster-wide actuation critical section: suspend
+        failover scans, then take ``control_mu``. This is THE compound
+        primitive every control-plane mutation serializes through —
+        reshard cutovers, checkpoint gates, and the reconciler's
+        actuator all call it instead of hand-rolling the
+        suspend()+control_mu pair (the reactive pairwise interlocks it
+        collapsed; see ps/reconcile.py).
+
+        Suspend comes FIRST: control_mu serializes against other
+        control operations, but the coordinator's scan loop never
+        takes it — a promotion landing mid-actuation would re-route a
+        shard onto state the actuation is mutating. suspend() is
+        depth-counted and control_mu is an RLock, so nesting (a
+        checkpoint gate inside a cutover inside the actuator) is safe;
+        the suspend-before-mutex ordering bounds the suspension to
+        exactly the window the mutex is held."""
+        coord = getattr(self, "coordinator", None)
+        if coord is not None:
+            coord.suspend()
+        try:
+            self.control_mu.acquire()
+        except BaseException:
+            if coord is not None:
+                coord.resume_scans()
+            raise
+
+    def end_actuation(self) -> None:
+        """Leave the actuation critical section: release ``control_mu``,
+        then resume failover scans (reverse of :meth:`begin_actuation`)."""
+        self.control_mu.release()
+        coord = getattr(self, "coordinator", None)
+        if coord is not None:
+            coord.resume_scans()
+
+    @contextlib.contextmanager
+    def actuation(self):
+        self.begin_actuation()
+        try:
+            yield self
+        finally:
+            self.end_actuation()
 
     # -- topology accessors ----------------------------------------------
 

@@ -456,11 +456,13 @@ class ReshardController:
         for fn in self._pre_cutover:
             fn(plan)
         faultpoint("reshard.cutover")
-        cluster.coordinator.suspend()
         paused = []
         t0 = time.perf_counter()
-        try:
-            with cluster.control_mu:
+        # the cluster-wide actuation critical section (suspend failover
+        # scans + control_mu, via HACluster.begin_actuation — the single
+        # primitive the old suspend()+control_mu pair collapsed into)
+        with cluster.actuation():
+            try:
                 # pause source primaries (depth-counted; nests with a
                 # concurrent CheckpointGate) and drain the tails — from
                 # here the moving classes are frozen
@@ -530,10 +532,9 @@ class ReshardController:
                                 f"digest mismatch on table {tid}",
                                 ReshardError)
                 self._drain_sync_backups(srcs)
-        finally:
-            for srv in reversed(paused):
-                srv.pause_mutations(False)
-            cluster.coordinator.resume_scans()
+            finally:
+                for srv in reversed(paused):
+                    srv.pause_mutations(False)
         return (time.perf_counter() - t0) * 1000.0, moved
 
     # -- shrink ------------------------------------------------------------
@@ -612,11 +613,11 @@ class ReshardController:
         for fn in self._pre_cutover:
             fn(plan)
         faultpoint("reshard.cutover")
-        cluster.coordinator.suspend()
         paused = []
         t0 = time.perf_counter()
-        try:
-            with cluster.control_mu:
+        # actuation critical section — see _cutover_grow
+        with cluster.actuation():
+            try:
                 # pause the RETIREES only: survivors keep taking their
                 # own traffic — the retirees' residue classes are
                 # frozen (clients still route them to the retirees,
@@ -657,8 +658,7 @@ class ReshardController:
                 # fence retain was tapped and ships on a best-effort
                 # tail during the lame-duck window)
                 self._drain_sync_backups(sorted({m.dst for m in migs}))
-        finally:
-            for srv in reversed(paused):
-                srv.pause_mutations(False)
-            cluster.coordinator.resume_scans()
+            finally:
+                for srv in reversed(paused):
+                    srv.pause_mutations(False)
         return (time.perf_counter() - t0) * 1000.0

@@ -135,6 +135,9 @@ class RolloutManager:
         self.current: int = 0
         #: open canary: (version, frozenset(endpoints)) or None
         self._canary: Optional[Tuple[int, frozenset]] = None
+        #: traffic fraction of the open canary (observability for the
+        #: reconciler's observed-state diff; None when no canary)
+        self._canary_fraction: Optional[float] = None
         self.events: deque = deque(maxlen=256)
         self._c_roll = _obs_registry.REGISTRY.counter(
             "serving_rollouts", max_series=64, kind="promote")
@@ -211,6 +214,7 @@ class RolloutManager:
             # (band opens routing canary-version traffic to members
             # actually serving stable bytes)
             self._canary = (version, frozenset(m.endpoint for m in canary))
+            self._canary_fraction = fraction
         for m in canary:
             m.model.set(version, flatv, expect_digest=dg)
         self.router.set_canary([m.endpoint for m in canary], fraction,
@@ -237,6 +241,7 @@ class RolloutManager:
             # promote()/rollback() returned
             self.current = version
             self._canary = None
+            self._canary_fraction = None
         for m in sorted(self._members(), key=lambda m: m.endpoint):
             if m.model.identity() != (version, dg):
                 m.model.set(version, flat, expect_digest=dg)
@@ -261,6 +266,7 @@ class RolloutManager:
             flat, dg = self._store[target]
             # assignment flips first — same reasoning as promote()
             self._canary = None
+            self._canary_fraction = None
             self.current = target
         for m in sorted(self._members(), key=lambda m: m.endpoint):
             m.model.set(target, flat, expect_digest=dg)
@@ -279,12 +285,26 @@ class RolloutManager:
         watchdog.on_fire(self._on_alert)
         return self
 
+    def set_proposer(self, proposer) -> "RolloutManager":
+        """Demote the auto-rollback guard to a spec PROPOSER: with a
+        Reconciler (ps/reconcile.py) wired in, a guard alert clears the
+        canary from the ClusterSpec (propose_rollback) and the single
+        serialized actuator performs the rollback — a guard firing
+        mid-reshard no longer actuates concurrently with the cutover."""
+        self._proposer = proposer
+        return self
+
     def _on_alert(self, alert) -> None:
         if alert.rule not in self.config.guard_rules:
             return
         with self._mu:
             open_canary = self._canary is not None
         if open_canary:
+            proposer = getattr(self, "_proposer", None)
+            if proposer is not None:
+                proposer.propose_rollback(
+                    reason=f"slo_alert:{alert.rule}", origin="rollout")
+                return
             self.rollback(reason=f"slo_alert:{alert.rule}")
 
     # -- re-attach healing -------------------------------------------------
@@ -323,6 +343,17 @@ class RolloutManager:
         """endpoint → (version, digest) actually loaded — the
         digest-identical acceptance reads this."""
         return {m.endpoint: m.model.identity() for m in self._members()}
+
+    def stable_version(self) -> int:
+        """The fleet-wide stable version (the reconciler's observed
+        ``stable_version``)."""
+        with self._mu:
+            return self.current
+
+    def fraction(self) -> Optional[float]:
+        """Traffic fraction of the open canary, None when closed."""
+        with self._mu:
+            return self._canary_fraction
 
     def canary_open(self) -> Optional[int]:
         with self._mu:

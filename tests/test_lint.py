@@ -857,7 +857,7 @@ def test_run_py_green_on_tree_and_red_on_violation(tmp_path):
     assert set(summary["per_pass"]) == {
         "tracer_safety", "hot_path", "lock_order", "py_locks",
         "wire_contract", "conventions", "obs_metrics", "control_loops",
-        "sync_shim"}
+        "sync_shim", "actuation"}
 
     # an injected violation must turn the gate red with file:line:rule
     bad = tmp_path / "tree" / "paddle_tpu"
@@ -2054,7 +2054,7 @@ def test_json_summary_carries_timings_and_why(tmp_path, monkeypatch):
     assert set(s["per_pass"]) == {
         "tracer_safety", "hot_path", "lock_order", "py_locks",
         "wire_contract", "conventions", "obs_metrics", "control_loops",
-        "sync_shim"}
+        "sync_shim", "actuation"}
     for rec in s["per_pass"].values():
         assert rec["wall_ms"] >= 0 and rec["violations"] >= 0
     assert s["wall_s"] >= 0
@@ -2476,3 +2476,114 @@ def test_changed_mode_runs_cross_file_passes_fully(tmp_path, monkeypatch):
                and v["path"] == "paddle_tpu/steady.py"
                for v in s["violations"]), s["violations"]
     assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# pass 10: one actuator — control loops must not actuate (actuation)
+# ---------------------------------------------------------------------------
+
+import actuation  # noqa: E402
+
+
+def _act_diags(tmp_path, source, fname="paddle_tpu/mod.py"):
+    p = tmp_path / fname
+    p.parent.mkdir(parents=True, exist_ok=True)
+    init = tmp_path / "paddle_tpu" / "__init__.py"
+    if not init.exists():
+        init.write_text("")
+    p.write_text(textwrap.dedent(source))
+    return actuation.run(str(tmp_path))
+
+
+_ACT_BODY = """
+    import threading
+
+    class Scaler:
+        def __init__(self, controller, poll_s=0.1):
+            self.controller = controller
+            self._t = threading.Thread(target=self._loop, daemon=True,
+                                       name="scaler")
+
+        def _loop(self):
+            self._tick()
+
+        def _tick(self):
+            self._deep()
+
+        def _deep(self):
+            self.controller.grow(2){escape}
+"""
+
+
+def test_direct_actuation_flagged_transitively(tmp_path):
+    # grow() is two helper hops below the thread target — the closure
+    # is transitive, unlike the clock rule's one-level scan
+    diags = _act_diags(tmp_path, _ACT_BODY.format(escape=""))
+    assert _rules(diags) == {"direct-actuation"}
+    assert "propose" in diags[0].message
+
+
+def test_direct_actuation_actuate_ok_with_reason_passes(tmp_path):
+    diags = _act_diags(tmp_path, _ACT_BODY.format(
+        escape="  # graftlint: actuate-ok standalone mode, no reconciler"))
+    assert not diags
+
+
+def test_direct_actuation_bare_actuate_ok_still_flagged(tmp_path):
+    # the escape hatch without a WHY is itself a violation
+    diags = _act_diags(tmp_path, _ACT_BODY.format(
+        escape="  # graftlint: actuate-ok"))
+    assert _rules(diags) == {"direct-actuation"}
+    assert "reason" in diags[0].message
+
+
+def test_direct_actuation_ignore_comment_passes(tmp_path):
+    diags = _act_diags(tmp_path, _ACT_BODY.format(
+        escape="  # graftlint: ignore[direct-actuation]"))
+    assert not diags
+
+
+def test_direct_actuation_self_calls_pass(tmp_path):
+    # a class driving ITS OWN lifecycle (self.promote()) is not
+    # cross-subsystem actuation
+    diags = _act_diags(tmp_path, """
+        import threading
+
+        class Rollout:
+            def __init__(self, poll_s=0.1):
+                self._t = threading.Thread(target=self._loop, daemon=True,
+                                           name="r")
+
+            def _loop(self):
+                self.promote()
+
+            def promote(self):
+                pass
+    """)
+    assert not diags
+
+
+def test_direct_actuation_non_loop_class_passes(tmp_path):
+    # no thread target → not a control loop → out of scope (the
+    # reconciler calls these primitives from plain methods)
+    diags = _act_diags(tmp_path, """
+        class Plain:
+            def __init__(self, controller):
+                self.controller = controller
+
+            def act(self):
+                self.controller.grow(2)
+    """)
+    assert not diags
+
+
+def test_direct_actuation_reconciler_module_exempt(tmp_path):
+    diags = _act_diags(tmp_path, _ACT_BODY.format(escape=""),
+                       fname="paddle_tpu/ps/reconcile.py")
+    assert not diags
+
+
+def test_direct_actuation_ship_tree_clean():
+    # the committed tree's only direct-actuation sites carry justified
+    # actuate-ok escapes (the autoscaler's standalone-mode branch)
+    assert actuation.run(REPO) == []

@@ -1,0 +1,718 @@
+"""The declarative control plane (ps/spec.py + ps/reconcile.py) and
+its policy simulator (ps/simulate.py).
+
+Fast tier: ClusterSpec document semantics, the pure transition planner
+(ordering, grow/shrink arithmetic, unreachable surfacing), SpecStore
+single-writer discipline, the reconciler against duck-typed fakes
+(convergence, abort/backoff, stall detection + flight-recorder
+bundles, the autoscaler-as-proposer and rollout-guard-as-proposer
+paths), the ``reconcile_stall`` SLO rule, and the simulator replaying
+both committed traces — including the acceptance case where a
+hysteresis inversion is CAUGHT as oscillation before it ships.
+
+Slow tier (ci.sh reconcile gate / full): the compound-transition chaos
+e2e — canary open + grow 2→4 proposed as ONE spec update with a
+kill-shard faultpoint armed mid-bootstrap, content digests and dense
+params bit-identical to a sequential direct-primitive oracle.
+"""
+
+import json
+import os
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.enforce import PreconditionNotMetError
+from paddle_tpu.obs import flightrec
+from paddle_tpu.obs import slo
+from paddle_tpu.obs.registry import Registry
+from paddle_tpu.obs.timeseries import MetricRing
+from paddle_tpu.ps.reconcile import Reconciler
+from paddle_tpu.ps.simulate import (SimClock, SimCluster, SimController,
+                                    diurnal_wave_profile,
+                                    flash_crowd_profile, simulate)
+from paddle_tpu.ps.spec import (ClusterSpec, SpecStore, plan_transitions,
+                                spec_delta)
+from paddle_tpu.ps.autoscale import AutoscaleConfig, Autoscaler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+MASK = 0xFFFFFFFFFFFFFFFF
+
+try:
+    from paddle_tpu.ps.rpc import rpc_available
+    _HAVE_RPC = rpc_available()
+except Exception:  # pragma: no cover - import guard only
+    _HAVE_RPC = False
+needs_rpc = pytest.mark.skipif(not _HAVE_RPC,
+                               reason="native PS service unavailable")
+
+
+@pytest.fixture(autouse=True)
+def _clean_flightrec():
+    yield
+    flightrec.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# ClusterSpec: the document
+# ---------------------------------------------------------------------------
+
+def test_spec_roundtrip_and_copy_isolation():
+    s = ClusterSpec(version=3, shards=4, replication=2, model_version=7,
+                    canary={"version": 8, "fraction": 0.25},
+                    placements={"0": "collective"}, trainer_np=16,
+                    origin="gameday")
+    s2 = ClusterSpec.from_json(s.to_json())
+    assert s2 == s
+    c = s.copy()
+    c.placements["1"] = "ps"
+    assert "1" not in s.placements  # dict fields are deep-copied
+
+
+def test_spec_validate_rejects_bad_documents():
+    with pytest.raises(PreconditionNotMetError):
+        ClusterSpec(shards=0).validate()
+    with pytest.raises(PreconditionNotMetError):
+        ClusterSpec(canary={"version": 2, "fraction": 1.5}).validate()
+    with pytest.raises(PreconditionNotMetError):
+        ClusterSpec(canary={"fraction": 0.25}).validate()  # no version
+    with pytest.raises(PreconditionNotMetError):
+        ClusterSpec(placements={"0": "gpu"}).validate()
+    with pytest.raises(PreconditionNotMetError):
+        ClusterSpec(trainer_np=0).validate()
+    ClusterSpec(shards=8, canary={"version": 2, "fraction": 0.5},
+                placements={"0": "ps"}, trainer_np=4).validate()
+
+
+def test_spec_delta_skips_version_and_origin():
+    a = ClusterSpec(version=1, shards=2, origin="operator")
+    b = ClusterSpec(version=9, shards=4, origin="autoscaler")
+    d = spec_delta(a, b)
+    assert d == {"shards": {"from": 2, "to": 4}}
+    assert spec_delta(a, a.copy()) == {}
+
+
+# ---------------------------------------------------------------------------
+# plan_transitions: the pure diff
+# ---------------------------------------------------------------------------
+
+def _obs(shards=2, stable=None, canary=None, placements=None,
+         trainer_np=None):
+    return {"shards": shards, "stable_version": stable, "canary": canary,
+            "placements": placements or {}, "trainer_np": trainer_np}
+
+
+def test_plan_grow_is_one_factor_step():
+    steps = plan_transitions(ClusterSpec(shards=8), _obs(shards=2))
+    assert [s.kind for s in steps] == ["reshard_grow"]
+    assert steps[0].detail == {"factor": 4, "from": 2, "to": 8}
+
+
+def test_plan_shrink_chains_halvings():
+    steps = plan_transitions(ClusterSpec(shards=2), _obs(shards=8))
+    assert [s.kind for s in steps] == ["reshard_shrink", "reshard_shrink"]
+    assert [s.detail["to"] for s in steps] == [4, 2]
+
+
+def test_plan_unreachable_is_surfaced_not_dropped():
+    up = plan_transitions(ClusterSpec(shards=3), _obs(shards=2))
+    assert [s.kind for s in up] == ["unreachable"]
+    down = plan_transitions(ClusterSpec(shards=4), _obs(shards=6))
+    assert [s.kind for s in down] == ["unreachable"]
+    assert down[0].detail == {"field": "shards", "from": 6, "to": 4}
+
+
+def test_plan_canary_moves_precede_the_reshard():
+    spec = ClusterSpec(shards=4, model_version=1,
+                       canary={"version": 2, "fraction": 0.25})
+    steps = plan_transitions(spec, _obs(shards=2, stable=1))
+    assert [s.kind for s in steps] == ["canary_open", "reshard_grow"]
+
+
+def test_plan_canary_clear_promotes_or_rolls_back():
+    obs = _obs(stable=1, canary={"version": 2, "fraction": 0.25})
+    promote = plan_transitions(ClusterSpec(shards=2, model_version=2), obs)
+    assert [s.kind for s in promote] == ["canary_promote"]
+    rollback = plan_transitions(ClusterSpec(shards=2, model_version=1), obs)
+    assert [s.kind for s in rollback] == ["canary_rollback"]
+
+
+def test_plan_canary_retarget_is_rollback_then_open():
+    spec = ClusterSpec(shards=2, model_version=1,
+                       canary={"version": 3, "fraction": 0.5})
+    steps = plan_transitions(
+        spec, _obs(stable=1, canary={"version": 2, "fraction": 0.25}))
+    assert [s.kind for s in steps] == ["canary_rollback", "canary_open"]
+    assert steps[1].detail == {"version": 3, "fraction": 0.5}
+
+
+def test_plan_canary_open_skipped_when_already_stable():
+    # a promote raced the proposal: the canary version already IS the
+    # fleet-wide stable — nothing to open
+    spec = ClusterSpec(shards=2, model_version=2,
+                       canary={"version": 2, "fraction": 0.25})
+    assert plan_transitions(spec, _obs(stable=2)) == []
+
+
+def test_plan_placement_and_trainer_lever():
+    spec = ClusterSpec(shards=2, placements={"0": "collective", "1": "ps"},
+                       trainer_np=8)
+    steps = plan_transitions(spec, _obs(trainer_np=4))
+    # observed placement defaults to "ps": only table 0 moves
+    assert [(s.kind, s.detail.get("table")) for s in steps] == \
+        [("placement", "0"), ("trainer_np", None)]
+    assert steps[1].detail == {"np": 8}
+
+
+# ---------------------------------------------------------------------------
+# SpecStore: single-writer versioning
+# ---------------------------------------------------------------------------
+
+def test_spec_store_initialize_refuses_clobber():
+    cluster = SimCluster(2, job_id="specstore-a")
+    st = SpecStore(cluster.store, cluster.job_id)
+    st.initialize(ClusterSpec(version=0, shards=2))
+    with pytest.raises(PreconditionNotMetError):
+        st.initialize(ClusterSpec(version=0, shards=4))
+
+
+def test_spec_store_propose_dedups_and_journals():
+    cluster = SimCluster(2, job_id="specstore-b")
+    st = SpecStore(cluster.store, cluster.job_id)
+    st.initialize(ClusterSpec(version=0, shards=2))
+    seen = []
+    st.subscribe(seen.append)
+
+    def noop(s):
+        s.shards = 2
+    assert st.propose("autoscaler", noop).version == 0  # no-op: no bump
+    assert st.log() == [] and seen == []
+
+    def grow(s):
+        s.shards = 4
+    new = st.propose("autoscaler", grow)
+    assert new.version == 1 and new.origin == "autoscaler"
+    assert [s.version for s in seen] == [1]
+    log = st.log()
+    assert len(log) == 1
+    assert log[0]["delta"] == {"shards": {"from": 2, "to": 4}}
+    # re-asserting the same target every poll does not churn versions
+    assert st.propose("autoscaler", grow).version == 1
+    assert len(st.log()) == 1
+
+
+# ---------------------------------------------------------------------------
+# Reconciler against duck-typed fakes
+# ---------------------------------------------------------------------------
+
+def _sim_rig(job_id, shards=2, **kw):
+    clock = SimClock()
+    cluster = SimCluster(shards, job_id=job_id)
+    ctrl = SimController(cluster, clock)
+    rec = Reconciler(cluster, ctrl, clock=clock.now,
+                     sleep=lambda s: clock.advance(s), **kw)
+    rec.capture()
+    return clock, cluster, ctrl, rec
+
+
+def test_capture_is_idempotent_version_zero():
+    _, cluster, _, rec = _sim_rig("cap-a")
+    spec = rec.capture()
+    assert spec.version == 0 and spec.shards == 2
+    assert spec.origin == "capture"
+    rec.propose_shards(4)
+    assert rec.capture().version == 1  # never clobbers the live doc
+
+
+def test_reconcile_grow_converges_in_one_pass():
+    _, cluster, ctrl, rec = _sim_rig("grow-a")
+    spec = rec.propose_shards(8, origin="operator")
+    assert spec.version == 1
+    assert not rec.converged()
+    assert rec.step(now=0.0) == 1           # ONE factor-4 grow
+    assert cluster.num_shards == 8
+    assert rec.converged() and rec.stalled_ticks() == 0
+    kinds = [e["kind"] for e in rec.events]
+    assert kinds.count("transition") == 1
+    tr = next(e for e in rec.events if e["kind"] == "transition")
+    assert tr["transition"] == "reshard_grow"
+    assert tr["spec_version"] == 1
+    assert tr["info"]["to_shards"] == 8
+    # journal mirrored to the elastic store
+    assert cluster.store.get("ps/grow-a/reconcile/1") is not None
+
+
+def test_reconcile_shrink_chain_verified_per_step():
+    _, cluster, ctrl, rec = _sim_rig("shrink-a", shards=8)
+    rec.propose_shards(2)
+    assert rec.step(now=0.0) == 2           # two halvings, one pass
+    assert cluster.num_shards == 2
+    assert [op["to_shards"] for op in ctrl.ops] == [4, 2]
+
+
+def test_reconcile_trainer_np_lever():
+    _, cluster, _, rec = _sim_rig(
+        "np-a", elastic_job_id="np-a-job", trainer_np_fn=lambda n: 2 * n)
+    rec.propose_shards(4)                   # trainer_np rides the shards
+    assert rec.step(now=0.0) == 2
+    assert rec.observe()["trainer_np"] == 8
+    kinds = [e["transition"] for e in rec.events
+             if e["kind"] == "transition"]
+    assert kinds == ["reshard_grow", "trainer_np"]
+
+
+def test_autoscaler_proposes_and_reconciler_actuates():
+    clock, cluster, ctrl, rec = _sim_rig("as-a")
+    cfg = AutoscaleConfig(min_shards=1, max_shards=8, cooldown_up_s=30.0)
+    scaler = Autoscaler(ctrl, config=cfg, clock=clock.now, proposer=rec)
+    scaler.notify_fire(types.SimpleNamespace(rule="step_time_p95"))
+    assert scaler.step(now=0.0) == "up"
+    # the decision only WROTE desired state — nothing actuated yet
+    assert cluster.num_shards == 2
+    spec = rec.spec_store.read()
+    assert (spec.version, spec.shards, spec.origin) == (1, 4, "autoscaler")
+    ev = [e for e in scaler.events if e["kind"] == "scale_proposed"]
+    assert len(ev) == 1 and ev[0]["spec_version"] == 1
+    assert rec.step(now=0.0) == 1
+    assert cluster.num_shards == 4
+    # hysteresis paces the DECISION: cooldown starts at proposal time
+    assert scaler.step(now=1.0) is None
+    assert scaler.step(now=31.0) == "up"
+    assert rec.spec_store.read().shards == 8
+
+
+class _FailController:
+    """grow/shrink raise until ``healed`` — the abort/stall rigs."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self.healed = False
+        self.calls = 0
+
+    def grow(self, factor, replication=None):
+        self.calls += 1
+        if not self.healed:
+            raise RuntimeError("cutover refused (injected)")
+        self.cluster._n *= int(factor)
+        return {"to_shards": self.cluster._n, "cutover_pause_ms": 1.0}
+
+    def shrink(self, divisor=2):
+        raise RuntimeError("cutover refused (injected)")
+
+
+def test_abort_journals_dumps_bundle_and_backs_off(tmp_path):
+    fr = flightrec.install(
+        flightrec.FlightRecorder(str(tmp_path), min_interval_s=0.0))
+    cluster = SimCluster(2, job_id="abort-a")
+    ctrl = _FailController(cluster)
+    rec = Reconciler(cluster, ctrl, abort_backoff_s=5.0)
+    rec.capture()
+    rec.propose_shards(4)
+    assert rec.step(now=0.0) == 0
+    assert rec.aborts() == 1
+    ab = [e for e in rec.events if e["kind"] == "spec_abort"]
+    assert len(ab) == 1 and "cutover refused" in ab[0]["error"]
+    assert ab[0]["transition"] == "reshard_grow"
+    # the postmortem bundle carries the observed-vs-desired spec diff
+    mans = [json.load(open(os.path.join(b, "manifest.json")))
+            for b in fr.bundles()]
+    man = next(m for m in mans if m["reason"] == "spec_abort")
+    assert man["info"]["spec_diff"]["shards"] == {"from": 2, "to": 4}
+    # cooldown: no re-actuation attempt inside the backoff window
+    assert rec.step(now=1.0) == 0 and ctrl.calls == 1
+    assert rec.step(now=6.0) == 0 and ctrl.calls == 2
+    assert rec.aborts() == 2
+    # heal the primitive: the same spec converges, stall state clears
+    ctrl.healed = True
+    assert rec.step(now=12.0) == 1
+    assert cluster.num_shards == 4 and rec.converged()
+    assert rec.stalled_ticks() == 0
+
+
+def test_stall_detection_dumps_once_per_episode(tmp_path):
+    fr = flightrec.install(
+        flightrec.FlightRecorder(str(tmp_path), min_interval_s=0.0))
+    cluster = SimCluster(2, job_id="stall-a")
+    ctrl = _FailController(cluster)
+    rec = Reconciler(cluster, ctrl, stall_ticks=3, abort_backoff_s=0.0)
+    rec.capture()
+    rec.propose_shards(4)
+    for i in range(8):
+        assert rec.step(now=float(i)) == 0
+    assert rec.stalled_ticks() == 8
+    stalls = [e for e in rec.events if e["kind"] == "reconcile_stall"]
+    assert len(stalls) == 1                 # once per episode, not per tick
+    assert stalls[0]["ticks"] == 4 and stalls[0]["pending"] == \
+        ["reshard_grow"]
+    mans = [json.load(open(os.path.join(b, "manifest.json")))
+            for b in fr.bundles()]
+    stall_mans = [m for m in mans if m["reason"] == "reconcile_stall"]
+    assert len(stall_mans) == 1
+    assert stall_mans[0]["info"]["spec_diff"]["shards"]["to"] == 4
+    # a completed transition ends the episode and re-arms the dump
+    ctrl.healed = True
+    assert rec.step(now=9.0) == 1
+    assert rec.stalled_ticks() == 0
+
+
+def test_unreachable_spec_aborts_with_the_reason():
+    _, cluster, _, rec = _sim_rig("unreach-a")
+    rec.propose_shards(3)                   # 2 -> 3: no primitive reaches it
+    assert rec.step(now=0.0) == 0
+    assert rec.aborts() == 1
+    ab = [e for e in rec.events if e["kind"] == "spec_abort"]
+    assert "unreachable" in ab[0]["error"]
+    assert cluster.num_shards == 2
+
+
+def test_reconcile_stall_slo_rule_fires():
+    reg = Registry()
+    reg.gauge("reconcile_stall_ticks", job="slo-a").set(12.0)
+    ring = MetricRing()
+    ring.append(reg.snapshot(), t=100.0)
+    rules = [r for r in slo.default_rules() if r.name == "reconcile_stall"]
+    assert len(rules) == 1
+    wd = slo.SloWatchdog(ring, rules)
+    assert [a.rule for a in wd.evaluate(now=100.0)] == ["reconcile_stall"]
+
+
+# ---------------------------------------------------------------------------
+# rollout guard as proposer (serving plane under spec control)
+# ---------------------------------------------------------------------------
+
+def _serving_rig(rec_factory, job_id):
+    """4-member router-protocol fleet over real frontends (the gameday
+    stubs), a RolloutManager, and a Reconciler wired as its proposer."""
+    import random as _random
+
+    from paddle_tpu.serving import (DenseModel, FrontendConfig,
+                                    RolloutConfig, RolloutManager,
+                                    RouterConfig, ServingFrontend,
+                                    ServingRouter)
+
+    class _Lookup:
+        def lookup(self, keys):
+            k = keys.astype(np.float64)
+            return np.stack([k, k + 0.5], axis=1).astype(np.float32)
+
+    class _Member:
+        def __init__(self, name, flat):
+            self.endpoint = name
+            self.lookup = _Lookup()
+            self.frontend = ServingFrontend(
+                self.lookup, config=FrontendConfig(
+                    max_batch=8, max_delay_us=100, queue_cap=256),
+                replica_label=name)
+            self.model = DenseModel(lambda f: f, flat.copy(), version=1,
+                                    sink=lambda p: None)
+
+        @property
+        def healthy(self):
+            return not self.frontend.stopped
+
+        def stop(self):
+            self.frontend.stop()
+
+    flat1 = np.arange(16, dtype=np.float32)
+    flat2 = flat1 + 2.0
+    members = [_Member(f"m{i}", flat1) for i in range(4)]
+    router = ServingRouter(RouterConfig(), rng=_random.Random(0))
+    for m in members:
+        router.attach(m)
+    rollout = RolloutManager(lambda: members, router,
+                             RolloutConfig(canary_members=1))
+    v1 = rollout.register_baseline(flat1)
+    for m in members:
+        m.model.set(v1, flat1)
+    rec = rec_factory(rollout, lambda v: {2: flat2}[v])
+    rollout.set_proposer(rec)
+    return members, router, rollout, rec
+
+
+def test_rollout_guard_rolls_back_through_the_spec():
+    cluster = SimCluster(2, job_id="guard-a")
+    members, router, rollout, rec = _serving_rig(
+        lambda ro, src: Reconciler(cluster, None, rollout=ro,
+                                   model_source=src), "guard-a")
+    try:
+        rec.capture()
+        rec.propose_canary(2, 0.25)
+        assert rec.step(now=0.0) == 1
+        assert rollout.canary_open() == 2
+        # SLO guard fires: the guard PROPOSES (clears spec.canary) —
+        # the canary stays open until the actuator runs the rollback
+        rollout._on_alert(types.SimpleNamespace(rule="serving_p99"))
+        assert rec.spec_store.read().canary is None
+        assert rollout.canary_open() == 2
+        rb = [e for e in rec.events if e["kind"] == "rollback_proposed"]
+        assert rb and rb[0]["reason"] == "slo_alert:serving_p99"
+        assert rec.step(now=1.0) == 1
+        assert rollout.canary_open() is None
+        assert all(v == 1 for v, _ in rollout.fleet_versions().values())
+    finally:
+        for m in members:
+            m.stop()
+        router.stop()
+
+
+def test_spec_promote_flips_the_fleet():
+    cluster = SimCluster(2, job_id="promote-a")
+    members, router, rollout, rec = _serving_rig(
+        lambda ro, src: Reconciler(cluster, None, rollout=ro,
+                                   model_source=src), "promote-a")
+    try:
+        rec.capture()
+        rec.propose_canary(2, 0.25)
+        assert rec.step(now=0.0) == 1
+        rec.propose_promote()
+        assert rec.step(now=1.0) == 1
+        assert rollout.canary_open() is None
+        assert rollout.stable_version() == 2
+        assert all(v == 2 for v, _ in rollout.fleet_versions().values())
+        assert rec.converged()
+    finally:
+        for m in members:
+            m.stop()
+        router.stop()
+
+
+# ---------------------------------------------------------------------------
+# the policy simulator: committed traces, 1000-shard scale
+# ---------------------------------------------------------------------------
+
+STOCK = dict(min_shards=256, max_shards=1024)
+
+
+def test_sim_diurnal_wave_stock_policy_is_stable():
+    """RESHARD.json's measured diurnal wave at 1000-shard scale: the
+    stock hysteresis tracks the wave without flapping, inside the
+    acceptance wall budget."""
+    res = simulate(AutoscaleConfig(**STOCK),
+                   diurnal_wave_profile(os.path.join(REPO, "RESHARD.json"),
+                                        base_shards=512))
+    assert res.wall_s < 60.0
+    assert res.scale_events, "the wave must move the fleet"
+    assert res.max_shards_seen() == 1024            # rode the peak...
+    assert res.final_shards < 1024                  # ...and came back down
+    assert res.oscillations(window_s=15.0) == 0     # no flapping
+    assert all(t["shards"] >= 256 for t in res.timeline)
+    assert res.spec_version >= 1
+
+
+def test_sim_hysteresis_inversion_caught_as_oscillation():
+    """The acceptance misconfiguration: cooldowns/hold collapsed to
+    zero (hysteresis inverted away) flaps the fleet on the SAME trace
+    the stock policy rides cleanly — the simulator catches the policy
+    bug before it ships."""
+    profile = lambda: diurnal_wave_profile(  # noqa: E731
+        os.path.join(REPO, "RESHARD.json"), base_shards=256)
+    stock = simulate(AutoscaleConfig(**STOCK), profile(),
+                     fire_after_ticks=1, clear_after_ticks=1)
+    broken = simulate(
+        AutoscaleConfig(cooldown_up_s=0.0, cooldown_down_s=0.0,
+                        clear_hold_s=0.0, **STOCK),
+        profile(), fire_after_ticks=1, clear_after_ticks=1)
+    assert stock.oscillations(window_s=15.0) == 0
+    assert broken.oscillations(window_s=15.0) >= 5
+    assert len(broken.scale_events) > len(stock.scale_events)
+
+
+def test_sim_flash_crowd_scales_up_and_recovers():
+    res = simulate(AutoscaleConfig(**STOCK),
+                   flash_crowd_profile(os.path.join(REPO,
+                                                    "RECSYS_E2E.json"),
+                                       base_shards=256))
+    assert res.wall_s < 60.0
+    assert res.max_shards_seen() > 256              # the spike moved it
+    assert res.oscillations(window_s=15.0) == 0
+    assert res.final_shards >= 256
+    # every actuation the simulator ran came through the spec
+    assert res.spec_version >= len(res.scale_events)
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance e2e (slow): ONE compound spec update under chaos
+# ---------------------------------------------------------------------------
+
+def _table_cfg():
+    from paddle_tpu.ps.table import TableConfig
+    return TableConfig(table_id=0, shard_num=4, accessor="ctr")
+
+
+def _stream_data(n, S, D, seed=0):
+    from paddle_tpu.data.dataset import InMemoryDataset, SlotDesc
+
+    rng = np.random.default_rng(seed)
+    lines = []
+    for _ in range(n):
+        ids = rng.integers(0, 48, S)
+        dense = rng.normal(size=D)
+        label = int((ids % 5 == 0).sum() + dense[0] > 1.0)
+        lines.append(" ".join([f"1 {v}" for v in ids]
+                              + [f"1 {v:.4f}" for v in dense]
+                              + [f"1 {label}"]))
+    slots = ([SlotDesc(f"s{i}", is_float=False, max_len=1)
+              for i in range(S)]
+             + [SlotDesc(f"d{i}", is_float=True, max_len=1)
+                for i in range(D)]
+             + [SlotDesc("label", is_float=True, max_len=1)])
+    ds = InMemoryDataset(slots, seed=0)
+    ds.load_from_lines(lines)
+    return ds
+
+
+def _stream_trainer(cli, cluster, S=3, D=2):
+    import paddle_tpu as pt
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.ctr import CtrConfig, DeepFM
+    from paddle_tpu.ps.communicator import SyncCommunicator
+    from paddle_tpu.ps.ps_trainer import CtrStreamTrainer
+
+    comm = SyncCommunicator(cli)
+    # sync replication made AIRTIGHT per batch: nothing is
+    # acked-but-unshipped when the chaos kill fires
+    base_send = comm.send_sparse
+
+    def send_and_drain(table_id, keys, values):
+        base_send(table_id, keys, values)
+        cluster.drain()
+
+    comm.send_sparse = send_and_drain
+    comm.start()
+    pt.seed(0)
+    tr = CtrStreamTrainer(
+        DeepFM(CtrConfig(num_sparse_slots=S, num_dense=D, embedx_dim=8,
+                         dnn_hidden=(8,))),
+        optimizer.Adam(1e-2), None, communicator=comm, table_id=0,
+        embedx_dim=8,
+        sparse_slots=[f"s{i}" for i in range(S)],
+        dense_slots=[f"d{i}" for i in range(D)], label_slot="label")
+    return tr, comm
+
+
+def _jax_flatten(tree):
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(k), np.asarray(v)) for k, v in flat]
+
+
+@needs_rpc
+@pytest.mark.slow
+def test_compound_transition_chaos_e2e():
+    """Canary open (v2 at 0.25) + grow 2→4 proposed as ONE spec update
+    while a CtrStreamTrainer streams (sync replication) and a
+    kill-shard faultpoint fires mid-bootstrap: the reconciler sequences
+    canary-before-reshard at the SAME spec version, the coordinator's
+    promotion repairs observed state under the in-flight transition,
+    and the result is bit-identical to a sequential direct-primitive
+    oracle — rows, content digest, pulled probe, dense params."""
+    import jax  # noqa: F401 - fail fast if params can't be compared
+
+    from paddle_tpu.ps import ha, rpc
+    from paddle_tpu.ps.reshard import ReshardController
+
+    S, D = 3, 2
+    EPOCHS = 4
+    BLOCKS = 64
+
+    def run(compound: bool):
+        with ha.HACluster(num_shards=2, replication=2, sync=True) as c:
+            members = router = rec = comm = None
+            try:
+                cli = c.client()
+                cli.create_sparse_table(0, _table_cfg())
+                ctrl = ReshardController(c)
+                if compound:
+                    members, router, rollout, rec = _serving_rig(
+                        lambda ro, src: Reconciler(
+                            c, ctrl, rollout=ro, model_source=src,
+                            poll_s=0.02).start(), "compound")
+                else:
+                    members, router, rollout, _ = _serving_rig(
+                        lambda ro, src: None, "oracle")
+                tr, comm = _stream_trainer(cli, c, S, D)
+                steps = 0
+                for e in range(EPOCHS):
+                    if e == 1:
+                        if compound:
+                            # die on the FIRST bootstrap snapshot read
+                            # of shard 0's primary — mid-transition
+                            c.primary(0).server.arm_fault(
+                                "kill-shard", cmd=rpc._SAVE_ALL, after=1)
+
+                            def mut(s):
+                                s.canary = {"version": 2,
+                                            "fraction": 0.25}
+                                s.shards = 4
+                            spec = rec.propose("e2e", mut)
+                            assert spec.version == 1
+                        else:
+                            # the sequential oracle: same moves, direct
+                            # primitives, no reconciler, no chaos
+                            rollout.begin_canary(np.arange(
+                                16, dtype=np.float32) + 2.0,
+                                fraction=0.25)
+                            ctrl.grow(2)
+                    out = tr.train_from_dataset(
+                        _stream_data(768, S, D, seed=e), batch_size=128)
+                    steps += out["steps"]
+                if compound:
+                    assert rec.wait_converged(120.0), list(rec.events)
+                    # compound ordering: canary opened BEFORE the grow,
+                    # both under the same spec version
+                    trans = [e for e in rec.events
+                             if e["kind"] == "transition"]
+                    kinds = [t["transition"] for t in trans]
+                    assert kinds.index("canary_open") < \
+                        kinds.index("reshard_grow")
+                    assert {t["spec_version"] for t in trans} == {1}
+                    # the kill landed mid-transition and was repaired
+                    assert c.coordinator.promotions >= 1
+                    assert any(e["kind"] == "observed_repair"
+                               for e in rec.events)
+                comm.barrier()
+                c.drain()
+                assert len(c.routing.read()[1]) == 4
+                assert rollout.canary_open() == 2
+                # exact split: request routing against band arithmetic
+                expect = sum(router.in_canary_band(b, 0.25)
+                             for b in range(BLOCKS))
+                for b in range(BLOCKS):
+                    router.submit(
+                        np.arange(b << 6, (b << 6) + 8, dtype=np.uint64),
+                        deadline_ms=5000).result(10)
+                counts = router.stats()["version_counts"]
+                assert counts.get("2", 0) == expect, (counts, expect)
+                probe = np.unique(
+                    (np.arange(0, 48, dtype=np.uint64)[None, :]
+                     + (np.arange(S, dtype=np.uint64)[:, None]
+                        << np.uint64(32))).reshape(-1))
+                pulled = cli.pull_sparse(0, probe, create=False)
+                digest = sum(cli.digest(0)) & MASK
+                rows = cli.size(0)
+                params = jax.tree_util.tree_map(np.asarray, tr.params)
+                return pulled, params, digest, rows, steps
+            finally:
+                if rec is not None:
+                    rec.stop()
+                if comm is not None:
+                    comm.stop()
+                if members is not None:
+                    for m in members:
+                        m.stop()
+                if router is not None:
+                    router.stop()
+
+    p_c, w_c, d_c, n_c, s1 = run(compound=True)
+    p_o, w_o, d_o, n_o, s2 = run(compound=False)
+    assert s1 == s2                 # identical batch sequences
+    assert n_c == n_o               # zero lost or doubled rows...
+    assert d_c == d_o               # ...bit-exactly (content digests)
+    np.testing.assert_array_equal(p_c, p_o)
+    for (ka, va), (kb, vb) in zip(sorted(_jax_flatten(w_c)),
+                                  sorted(_jax_flatten(w_o))):
+        assert ka == kb
+        np.testing.assert_array_equal(va, vb)

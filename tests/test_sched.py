@@ -410,3 +410,41 @@ def test_sched_cli_replay_seed():
         capture_output=True, text=True)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "ran clean" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# declarative reconciler: proposers × serialized actuator × failover
+# ---------------------------------------------------------------------------
+
+def test_reconciler_unserialized_actuation_found():
+    # knob OFF reproduces the pre-reconciler world: two control loops
+    # each diffing observed-vs-desired and actuating directly, no
+    # actuator mutex between diff and apply — the second loop admits a
+    # transition planned against a topology the first already changed
+    ex = Explorer(models.reconciler_model(serialized=False,
+                                          with_np_proposer=False),
+                  order_decls=_DECLS)
+    f, _ = ex.explore_dfs(bound=2, max_schedules=20000)
+    assert f is not None and f.kind == "invariant"
+    assert "stale transition" in f.message
+    small = ex.shrink(f)
+    assert small.kind == "invariant"
+
+
+def test_reconciler_fixed_protocol_pb2_exhausts_clean():
+    # the acceptance sweep: one serialized actuator — the whole pb-2
+    # schedule space of proposer-write × actuator-diff × lease-expiry
+    # interleavings, exhausted, with the dynamic lock-order checker
+    # validating reconcile.py/spec.py's declarations
+    ex = Explorer(models.reconciler_model(with_np_proposer=False),
+                  order_decls=_DECLS)
+    f, exhausted = ex.explore_dfs(bound=2, max_schedules=50000)
+    assert f is None, f and f.format()
+    assert exhausted
+    assert ex.schedules_run > 1000
+
+
+def test_reconciler_random_walk_two_proposers_clean():
+    ex = Explorer(models.reconciler_model(), order_decls=_DECLS)
+    f = ex.explore_random(400, base_seed=20260807)
+    assert f is None, f and f.format()

@@ -1,0 +1,267 @@
+"""Game-day chaos schedule for the declarative control plane (ISSUE 20
+acceptance; the committed GAMEDAY.json artifact).
+
+A game day is a TIMED sequence of spec perturbations and armed
+faultpoints run against a live cluster — the fire-drill discipline:
+every transition is driven by writing desired state (never by calling
+primitives), and the drill passes only when the reconciler's journal
+closes the loop on every step. The stock schedule:
+
+1. bring up a 2-shard HACluster (sync ×2) + ReshardController +
+   a 4-member serving fleet under a RolloutManager, all behind ONE
+   :class:`~paddle_tpu.ps.reconcile.Reconciler`; seed the PS table and
+   record the content digest; start background pull traffic;
+2. **grow-under-fire**: arm a kill-shard faultpoint on the shard-0
+   primary (fires mid-bootstrap, during the grow's snapshot save),
+   then propose ``shards: 4`` — the coordinator promotes the backup
+   WHILE the reconciler's transition is in flight, and the transition
+   still converges (the observed-repair event lands in the journal);
+3. **canary open** via spec (version 2 at an exact fraction) — the
+   router split is counted request-by-request and must match the band
+   arithmetic exactly;
+4. **canary rollback** via spec (clear the canary) — the fleet returns
+   to the baseline version, digest-pinned;
+5. **shrink back** to 2 shards via spec;
+6. final: the table content digest is bit-identical to the seed, the
+   background traffic saw zero errors, and every schedule step
+   converged within its deadline.
+
+Standalone: prints exactly ONE JSON line (driver contract) and writes
+GAMEDAY.json (env GAMEDAY_OUT overrides). Env knobs: GAMEDAY_ROWS,
+GAMEDAY_BLOCKS.
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+METRIC = "gameday"
+
+
+def run(out_path: str) -> dict:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import numpy as np
+
+    from paddle_tpu.io.fs import crc32c
+    from paddle_tpu.ps import ha, rpc
+    from paddle_tpu.ps.reconcile import Reconciler
+    from paddle_tpu.ps.reshard import ReshardController
+    from paddle_tpu.ps.table import TableConfig
+    from paddle_tpu.serving import (DenseModel, FrontendConfig,
+                                    RolloutConfig, RolloutManager,
+                                    RouterConfig, ServingFrontend,
+                                    ServingRouter)
+    from paddle_tpu.core import sync as _sync
+
+    rows = int(os.environ.get("GAMEDAY_ROWS", 20000))
+    blocks = int(os.environ.get("GAMEDAY_BLOCKS", 200))
+    dim = 16
+
+    # -- serving-side stubs (router-protocol members over real
+    # frontends; the rollout lifecycle needs real model slots) ---------
+    class _Lookup:
+        def lookup(self, keys):
+            k = keys.astype(np.float64)
+            return np.stack([k, k + 0.5], axis=1).astype(np.float32)
+
+    class _Member:
+        def __init__(self, name, flat):
+            self.endpoint = name
+            self.lookup = _Lookup()
+            self.frontend = ServingFrontend(
+                self.lookup, config=FrontendConfig(
+                    max_batch=8, max_delay_us=100, queue_cap=256),
+                replica_label=name)
+            self.model = DenseModel(lambda f: f, flat.copy(), version=1,
+                                    sink=lambda p: None)
+
+        @property
+        def healthy(self):
+            return not self.frontend.stopped
+
+        def stop(self):
+            self.frontend.stop()
+
+    wall0 = time.time()  # graftlint: ignore[time-time] — artifact wall timestamps
+    cluster = ha.HACluster(num_shards=2, replication=2, sync=True,
+                           job_id="gameday")
+    members = []
+    router = None
+    stop_traffic = _sync.Event()
+    traffic = {"pulls": 0, "errors": 0}
+    schedule = []
+    try:
+        client = cluster.client()
+        client.create_sparse_table(
+            0, TableConfig(table_id=0, shard_num=8, accessor="ctr"))
+        keys = np.arange(1, rows + 1, dtype=np.uint64)
+        for lo in range(0, rows, 1 << 14):
+            client.pull_sparse(0, keys[lo:lo + (1 << 14)])
+        cluster.drain()
+        seed_digest = crc32c(
+            np.ascontiguousarray(client.pull_sparse(0, keys)).tobytes())
+
+        flat1 = np.arange(dim, dtype=np.float32)
+        flat2 = flat1 + 2.0
+        members = [_Member(f"gd{i}", flat1) for i in range(4)]
+        router = ServingRouter(RouterConfig(), rng=random.Random(0))
+        for m in members:
+            router.attach(m)
+        rollout = RolloutManager(lambda: members, router,
+                                 RolloutConfig(canary_members=1))
+        v1 = rollout.register_baseline(flat1)
+        for m in members:
+            m.model.set(v1, flat1)
+        versions = {2: flat2}
+
+        ctrl = ReshardController(cluster)
+        rec = Reconciler(cluster, ctrl, rollout=rollout,
+                         model_source=lambda v: versions[v],
+                         poll_s=0.05).start()
+        rollout.set_proposer(rec)
+
+        # -- background pull traffic (reads only: content must stay
+        # bit-stable through every transition) -------------------------
+        def _pull_loop():
+            rng = np.random.default_rng(7)
+            # share the seeding client (it holds the table catalog);
+            # the main thread only touches it before the puller starts
+            # and after it stops
+            cli = client
+            while not stop_traffic.is_set():
+                batch = rng.choice(keys, size=64, replace=False)
+                try:
+                    cli.pull_sparse(0, np.sort(batch).astype(np.uint64))
+                    traffic["pulls"] += 1
+                except Exception:
+                    traffic["errors"] += 1
+                time.sleep(0.002)
+
+        puller = _sync.Thread(target=_pull_loop, daemon=True,
+                              name="gameday-puller")
+        puller.start()
+
+        def step(name, deadline_s=60.0, **info):
+            t0 = time.time()  # graftlint: ignore[time-time] — artifact wall timestamps
+            entry = {"step": name, "t_offset_s": round(t0 - wall0, 3),
+                     **info}
+            schedule.append(entry)
+            return entry, t0
+
+        # -- 1. grow-under-fire ----------------------------------------
+        entry, t0 = step("grow_under_fire", shards=4, kill="shard0-primary")
+        victim = cluster.primary(0)
+        victim.server.arm_fault("kill-shard", cmd=rpc._SAVE_ALL, after=1)
+        spec = rec.propose_shards(4, origin="gameday")
+        entry["spec_version"] = spec.version
+        assert rec.wait_converged(90.0), (
+            f"grow 2->4 never converged (journal: {list(rec.events)})")
+        assert cluster.num_shards == 4, cluster.num_shards
+        entry["converged"] = True
+        entry["elapsed_s"] = round(time.time() - t0, 3)  # graftlint: ignore[time-time] — artifact wall timestamps
+        promotions = [e for e in rec.events if e["kind"] == "observed_repair"]
+        entry["promotions"] = len(promotions)
+
+        # -- 2. canary open via spec -----------------------------------
+        entry, t0 = step("canary_open", version=2, fraction=0.25)
+        spec = rec.propose_canary(2, 0.25, origin="gameday")
+        entry["spec_version"] = spec.version
+        assert rec.wait_converged(30.0), list(rec.events)
+        assert rollout.canary_open() == 2
+        # exact split: count request routing against the band arithmetic
+        expect = sum(router.in_canary_band(b, 0.25) for b in range(blocks))
+        for b in range(blocks):
+            rr = router.submit(
+                np.arange(b << 6, (b << 6) + 8, dtype=np.uint64),
+                deadline_ms=5000)
+            rr.result(10)
+        counts = router.stats()["version_counts"]
+        assert counts.get("2", 0) == expect, (counts, expect)
+        assert counts.get("1", 0) == blocks - expect, (counts, expect)
+        entry["converged"] = True
+        entry["split"] = {"canary": expect, "stable": blocks - expect}
+        entry["elapsed_s"] = round(time.time() - t0, 3)  # graftlint: ignore[time-time] — artifact wall timestamps
+
+        # -- 3. canary rollback via spec -------------------------------
+        entry, t0 = step("canary_rollback")
+        spec = rec.propose_rollback(reason="gameday drill",
+                                    origin="gameday")
+        entry["spec_version"] = spec.version
+        assert rec.wait_converged(30.0), list(rec.events)
+        assert rollout.canary_open() is None
+        assert all(v == v1 for v, _ in rollout.fleet_versions().values())
+        entry["converged"] = True
+        entry["elapsed_s"] = round(time.time() - t0, 3)  # graftlint: ignore[time-time] — artifact wall timestamps
+
+        # -- 4. shrink back --------------------------------------------
+        entry, t0 = step("shrink", shards=2)
+        spec = rec.propose_shards(2, origin="gameday")
+        entry["spec_version"] = spec.version
+        assert rec.wait_converged(90.0), list(rec.events)
+        # the ROUTED topology is back to 2; the retirees linger in
+        # cluster.servers for the lame-duck window before stopping
+        assert len(cluster.routing.read()[1]) == 2
+        entry["converged"] = True
+        entry["elapsed_s"] = round(time.time() - t0, 3)  # graftlint: ignore[time-time] — artifact wall timestamps
+
+        # -- close the loop --------------------------------------------
+        stop_traffic.set()
+        puller.join(timeout=10)
+        final_digest = crc32c(
+            np.ascontiguousarray(client.pull_sparse(0, keys)).tobytes())
+        digest_ok = bool(final_digest == seed_digest)
+        assert digest_ok, (seed_digest, final_digest)
+        assert traffic["errors"] == 0, traffic
+        assert all(s.get("converged") for s in schedule), schedule
+        journal = list(rec.events)
+        transitions = [e for e in journal if e["kind"] == "transition"]
+        rec.stop()
+
+        out = {
+            "metric": METRIC,
+            "rows": rows,
+            "schedule": schedule,
+            "transitions": transitions,
+            "journal": journal,
+            "spec_log": rec.spec_store.log(),
+            "promotions": len([e for e in journal
+                               if e["kind"] == "observed_repair"]),
+            "digest_ok": digest_ok,
+            "seed_digest": int(seed_digest),
+            "final_digest": int(final_digest),
+            "traffic": dict(traffic),
+            "shards_final": len(cluster.routing.read()[1]),
+            "wall_s": round(time.time() - wall0, 2),  # graftlint: ignore[time-time] — artifact wall timestamps
+        }
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+        return out
+    finally:
+        stop_traffic.set()
+        for m in members:
+            m.stop()
+        if router is not None:
+            router.stop()
+        cluster.stop()
+
+
+def main() -> int:
+    out = os.environ.get("GAMEDAY_OUT", os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "GAMEDAY.json"))
+    try:
+        rec = run(out)
+        rec = {k: v for k, v in rec.items()
+               if k not in ("transitions", "journal", "spec_log")}
+    except Exception as e:  # one-JSON-line driver contract
+        rec = {"metric": METRIC, "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(rec))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,167 @@
+"""graftlint pass 10: one actuator — control loops must not actuate.
+
+  direct-actuation  a class that runs its own CONTROL LOOP (constructs
+                   a ``threading.Thread`` whose ``target`` is one of
+                   its own methods) calls a cluster-mutating primitive
+                   — ``grow``/``shrink`` (reshard cutover),
+                   ``begin_canary``/``promote``/``rollback`` (model
+                   rollout), ``suspend``/``resume_scans`` (failover
+                   scan gate) — on some OTHER object from code
+                   reachable from that loop. Under the declarative
+                   control plane there is exactly ONE actuator
+                   (``ps/reconcile.py``): every other loop observes,
+                   decides, and PROPOSES a spec change; the reconciler
+                   serializes the actuation. A second loop that
+                   actuates directly reintroduces the
+                   concurrent-cutover races the reconciler exists to
+                   remove (two writers interleaving routing flips,
+                   promotion during an unfenced cutover). Route the
+                   decision through ``Reconciler.propose_*`` instead.
+
+The loop-body scan is the TRANSITIVE closure of ``self._method()``
+calls reachable from the thread target — an actuation buried two
+helpers deep is still actuation on the loop's thread. Calls on bare
+``self`` (``self.promote()``) are the class mutating ITSELF and are
+fine; the rule fires when the receiver is another object
+(``self.controller.grow(...)``, ``coordinator.suspend()``).
+
+Scope: ``paddle_tpu/`` except ``paddle_tpu/ps/reconcile.py`` (the one
+sanctioned actuator). Suppression, in preference order:
+
+  # graftlint: actuate-ok <reason>    on the CALL line — the reason
+                   (>= 3 chars) is mandatory; an escape hatch without
+                   a why is itself flagged. For loops that genuinely
+                   own actuation (standalone mode, no reconciler
+                   wired).
+  # graftlint: ignore[direct-actuation]   blanket per-line ignore, or
+                   an allow.txt entry with justification.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Set
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from common import (Diagnostic, line_ignores,  # noqa: E402
+                    relpath, walk_py)
+from control_loops import (_method_map,  # noqa: E402
+                           _self_thread_targets)
+
+RULE = "direct-actuation"
+
+#: the cluster-mutating primitives the reconciler sequences. Attribute
+#: names, not dotted paths: `self.controller.grow`, `ctrl.grow`, and
+#: `cluster.coordinator.suspend` all resolve to their final attr.
+_ACTUATION_ATTRS = {"grow", "shrink", "begin_canary", "promote",
+                    "rollback", "suspend", "resume_scans"}
+
+#: the one module allowed to actuate
+_ACTUATOR_MODULES = {"paddle_tpu/ps/reconcile.py"}
+
+_ACTUATE_OK_RE = re.compile(r"#\s*graftlint:\s*actuate-ok\b[ \t]*(.*)$")
+
+
+def _closure(targets: Dict[str, ast.Call],
+             methods: Dict[str, ast.FunctionDef]) -> List[ast.FunctionDef]:
+    """All of the class's own methods transitively reachable from its
+    thread targets via ``self._helper()`` calls (any depth — unlike the
+    clock rule's one-level scan, an actuation buried in a helper chain
+    still runs on the loop's thread)."""
+    seen: Set[str] = set()
+    work = [m for m in targets if m in methods]
+    while work:
+        name = work.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for node in ast.walk(methods[name]):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "self" \
+                    and node.func.attr in methods:
+                work.append(node.func.attr)
+    return [methods[n] for n in sorted(seen)]
+
+
+def _actuation_call(node: ast.Call) -> bool:
+    """A call to an actuation primitive on a receiver other than bare
+    ``self``."""
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    if node.func.attr not in _ACTUATION_ATTRS:
+        return False
+    recv = node.func.value
+    if isinstance(recv, ast.Name) and recv.id == "self":
+        return False  # the class mutating itself, not another subsystem
+    return True
+
+
+def check_file(path: str, root: str) -> List[Diagnostic]:
+    rel = relpath(path, root)
+    if rel in _ACTUATOR_MODULES:
+        return []
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []
+    lines = src.splitlines()
+    diags: List[Diagnostic] = []
+
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        targets = _self_thread_targets(cls)
+        if not targets:
+            continue
+        methods = _method_map(cls)
+        for m in _closure(targets, methods):
+            for node in ast.walk(m):
+                if not (isinstance(node, ast.Call)
+                        and _actuation_call(node)):
+                    continue
+                if RULE in line_ignores(lines, node.lineno):
+                    continue
+                line_src = lines[node.lineno - 1] \
+                    if node.lineno - 1 < len(lines) else ""
+                ok = _ACTUATE_OK_RE.search(line_src)
+                if ok is not None:
+                    reason = ok.group(1).strip()
+                    if len(reason) >= 3:
+                        continue
+                    diags.append(Diagnostic(
+                        rel, node.lineno, RULE,
+                        f"`{cls.name}.{m.name}` carries a bare "
+                        "`# graftlint: actuate-ok` — the escape hatch "
+                        "requires a reason (>= 3 chars) saying WHY this "
+                        "loop may actuate directly"))
+                    continue
+                target = ast.unparse(node.func) \
+                    if hasattr(ast, "unparse") else node.func.attr
+                diags.append(Diagnostic(
+                    rel, node.lineno, RULE,
+                    f"`{cls.name}` runs a thread control loop and "
+                    f"`{m.name}` calls the actuation primitive "
+                    f"`{target}(...)` directly — under the declarative "
+                    "control plane only the reconciler actuates "
+                    "(ps/reconcile.py); propose the change via "
+                    "`Reconciler.propose_*` instead, or justify with "
+                    "`# graftlint: actuate-ok <reason>`"))
+    return diags
+
+
+def run(root: str, only=None) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    for p in walk_py(root, ("paddle_tpu",), only=only):
+        diags.extend(check_file(p, root))
+    return sorted(diags, key=lambda d: (d.path, d.line, d.rule))
+
+
+if __name__ == "__main__":
+    from common import REPO_ROOT
+    for d in run(REPO_ROOT):
+        print(d)

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""graftlint driver: run all nine passes, apply the allowlist, report.
+"""graftlint driver: run all ten passes, apply the allowlist, report.
 
 Usage:
   python tools/lint/run.py              # gate: exit 1 on NEW violations
@@ -40,6 +40,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+import actuation  # noqa: E402
 import control_loops  # noqa: E402
 import conventions  # noqa: E402
 import lock_order  # noqa: E402
@@ -135,6 +136,7 @@ def main(argv=None) -> int:
         "obs_metrics": obs_metrics.run,
         "control_loops": control_loops.run,
         "sync_shim": sync_shim.run,
+        "actuation": actuation.run,
     }
     diags = []
     per_pass = {}

@@ -50,6 +50,8 @@ DECL_FILES = (
     "paddle_tpu/ps/ha.py",
     "paddle_tpu/ps/rpc.py",
     "paddle_tpu/ps/reshard.py",
+    "paddle_tpu/ps/reconcile.py",
+    "paddle_tpu/ps/spec.py",
     "paddle_tpu/serving/fleet.py",
     "paddle_tpu/io/job_checkpoint.py",
     "paddle_tpu/csrc/ssd_table.cc",   # `//` grammar — load_lock_order
@@ -593,6 +595,176 @@ def ckpt_writer_model(root: str = None):
                 "the shutdown sentinel"
             assert mgr._thread is None or not mgr._thread.is_alive(), \
                 "writer thread survived stop()"
+        sched.on_finish(finish)
+
+    return model
+
+
+# ---------------------------------------------------------------------------
+# 4. declarative reconciler: proposers × serialized actuator × failover
+# ---------------------------------------------------------------------------
+
+class _ReconcilerModel:
+    """ps/reconcile.py actuation protocol in miniature, REAL lock names
+    (``_act_mu``, ``control_mu``, ``_step_mu``, ``_susp_mu``,
+    ``_spec_mu``): proposers read-modify-write the versioned spec doc,
+    actuator passes diff observed vs desired and sequence cutovers
+    through ``begin_actuation`` (suspend scans, then ``control_mu``),
+    and a lease-expiry failover scan races both.  ``serialized=False``
+    reproduces the pre-reconciler world — two control loops each
+    diffing and actuating directly, with no actuator mutex between
+    diff and apply: both observe 2 shards with 4 desired and both
+    admit the grow, so the second one actuates a STALE plan (the
+    doubled-transition bug the single-actuator discipline removes).
+    The default must explore clean."""
+
+    def __init__(self, sched, serialized: bool) -> None:
+        self.sched = sched
+        self.serialized = serialized
+        self.act_mu = _sync.Lock(name="_act_mu")
+        self.control_mu = _sync.RLock(name="control_mu")
+        self.step_mu = _sync.Lock(name="_step_mu")
+        self.susp_mu = _sync.Lock(name="_susp_mu")
+        self.spec_mu = _sync.Lock(name="_spec_mu")
+        self.suspended = _sync.Event(name="suspended")
+        self.susp_depth = 0
+        self.spec = {"version": 0, "shards": 2, "trainer_np": 4}
+        self.routing = {"epoch": 0, "shards": 2, "primary": "s0a"}
+        # s0a's lease has expired; the scan WILL promote s0b if allowed
+        self.alive = {"s0b"}
+
+    # -- spec store (SpecStore.propose: rmw under _spec_mu) ---------------
+
+    def read_spec(self) -> dict:
+        self.sched.yield_point("spec.read")
+        return dict(self.spec)
+
+    def propose(self, field: str, value) -> None:
+        with self.spec_mu:
+            cur = dict(self.spec)
+            self.sched.yield_point("spec.rmw")
+            if cur[field] == value:
+                return
+            cur[field] = value
+            cur["version"] = self.spec["version"] + 1
+            self.spec = cur
+
+    # -- routing + failover-suspend (HACluster/FailoverCoordinator) -------
+
+    def publish(self, epoch: int, **delta) -> None:
+        self.sched.yield_point("routing.publish")
+        self.sched.check(
+            epoch == self.routing["epoch"] + 1,
+            f"routing clobbered: publish(epoch={epoch}) over live epoch "
+            f"{self.routing['epoch']} — the routing table must stay "
+            "single-writer (begin_actuation's suspend exists for this)")
+        self.routing = dict(self.routing, epoch=epoch, **delta)
+
+    def suspend(self) -> None:
+        with self.susp_mu:
+            self.susp_depth += 1
+            self.suspended.set()
+        with self.step_mu:
+            pass            # barrier: in-flight scan finishes
+
+    def resume_scans(self) -> None:
+        with self.susp_mu:
+            self.susp_depth = max(0, self.susp_depth - 1)
+            if self.susp_depth == 0:
+                self.suspended.clear()
+
+    # -- tasks ------------------------------------------------------------
+
+    def proposer_shards(self) -> None:
+        """Autoscaler-as-proposer: desired shards 2 -> 4."""
+        self.propose("shards", 4)
+
+    def proposer_np(self) -> None:
+        """Elastic-trainer proposer: desired trainer_np 4 -> 8."""
+        self.propose("trainer_np", 8)
+
+    def failover_step(self) -> None:
+        """FailoverCoordinator.step(): promote the expired primary's
+        backup unless actuation has the scans suspended."""
+        with self.step_mu:
+            if self.suspended.is_set():
+                return
+            self.sched.yield_point("scan.read")
+            epoch = self.routing["epoch"]
+            if self.routing["primary"] in self.alive:
+                return
+            self.sched.yield_point("scan.fence")
+            self.publish(epoch + 1, primary="s0b")
+
+    def actuator(self, who: str) -> None:
+        """One reconcile pass: diff spec vs observed, actuate to
+        convergence.  The real Reconciler holds ``_act_mu`` across the
+        WHOLE diff-and-apply; the knob drops it."""
+        if self.serialized:
+            self.act_mu.acquire()
+        try:
+            self._reconcile_pass(who)
+        finally:
+            if self.serialized:
+                self.act_mu.release()
+
+    def _reconcile_pass(self, who: str) -> None:
+        desired = self.read_spec()["shards"]
+        self.sched.yield_point("reconcile.observe")
+        observed = self.routing["shards"]
+        while observed != desired:
+            self.suspend()       # begin_actuation: scans first,
+            try:                 # then the control mutex
+                self.control_mu.acquire()
+                try:
+                    live = self.routing["shards"]
+                    self.sched.check(
+                        live == observed,
+                        f"stale transition admitted by {who}: planned "
+                        f"{'grow' if desired > observed else 'shrink'} "
+                        f"from {observed} shards but the live topology "
+                        f"has {live} — a second actuator applied the "
+                        "step first (the actuator mutex + per-step "
+                        "verification exist to refuse exactly this)")
+                    new_n = live * 2 if desired > live else live // 2
+                    self.publish(self.routing["epoch"] + 1, shards=new_n)
+                finally:
+                    self.control_mu.release()
+            finally:
+                self.resume_scans()
+            self.sched.yield_point("reconcile.observe")
+            observed = self.routing["shards"]
+
+
+def reconciler_model(serialized: bool = True,
+                     with_np_proposer: bool = True):
+    """Model factory for Explorer: proposer(s) × two actuator passes ×
+    lease-expiry failover.  The pb-2 sweep runs the lean variant (one
+    proposer) to exhaustion; the random walk adds the trainer_np
+    proposer back for spec-write interleavings."""
+
+    def model(sched):
+        rc = _ReconcilerModel(sched, serialized)
+        sched.spawn(rc.proposer_shards, name="propose")
+        if with_np_proposer:
+            sched.spawn(rc.proposer_np, name="propose-np")
+        sched.spawn(lambda: rc.actuator("act1"), name="act1")
+        sched.spawn(lambda: rc.actuator("act2"), name="act2")
+        sched.spawn(rc.failover_step, name="failover")
+
+        def finish():
+            assert rc.routing["shards"] in (2, 4), \
+                f"topology overshot: {rc.routing['shards']} shards " \
+                "(desired never exceeded 4) — doubled actuation"
+            assert rc.spec["shards"] == 4, \
+                f"shards proposal lost: spec says {rc.spec['shards']}"
+            want_ver = 2 if with_np_proposer else 1
+            assert rc.spec["version"] == want_ver, \
+                f"spec version {rc.spec['version']} != {want_ver} — a " \
+                "proposal was lost or double-counted under _spec_mu"
+            if with_np_proposer:
+                assert rc.spec["trainer_np"] == 8, \
+                    "trainer_np proposal lost to a concurrent rmw"
         sched.on_finish(finish)
 
     return model

@@ -61,6 +61,15 @@ HARNESSES: Dict[str, Dict[str, Any]] = {
         "bound": 1,
         "random_n": 2000,
     },
+    # the reconciler's single-actuator discipline: the pb sweep runs
+    # the lean variant (one proposer) to exhaustion; the random walk
+    # adds the trainer_np proposer back for spec-write interleavings
+    "reconciler": {
+        "dfs": lambda: models.reconciler_model(with_np_proposer=False),
+        "full": models.reconciler_model,
+        "bound": 2,
+        "random_n": 2000,
+    },
     # the cold-tier compactor drops the shrink sweep for the pb sweep
     # (the push/pull/save races alone cover the phase-B reconcile) and
     # adds it back for the random walk
